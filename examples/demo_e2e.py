@@ -1,0 +1,145 @@
+"""End-to-end demo: a live virtual TPU kubelet, driven like a user would.
+
+Wires the full stack — fake Cloud TPU API (real HTTP server), TPU client,
+node + pod controllers, provider with all background loops, kubelet API
+server (real HTTP) — then plays the role of the K8s scheduler and a user:
+
+  1. register the virtual node (capacity, taint, lease)
+  2. "schedule" a MaxText-style pod requesting google.com/tpu: 16
+  3. watch it go Pending -> gang launch on 4 workers -> Running
+  4. curl the kubelet API for /pods and per-worker logs
+  5. simulate a maintenance preemption -> observe gang-fail -> Failed
+  6. delete the pod -> slice terminated
+
+Run: python examples/demo_e2e.py
+"""
+
+import json
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, ".")
+
+from k8s_runpod_kubelet_tpu.cloud import HttpTransport, TpuClient
+from k8s_runpod_kubelet_tpu.cloud.fake_server import FakeTpuServer
+from k8s_runpod_kubelet_tpu.config import Config
+from k8s_runpod_kubelet_tpu.gang import GangExecutor, InMemoryWorkerTransport
+from k8s_runpod_kubelet_tpu.kube import FakeKubeClient
+from k8s_runpod_kubelet_tpu.kube import objects as ko
+from k8s_runpod_kubelet_tpu.node import KubeletApiServer, NodeController, PodController
+from k8s_runpod_kubelet_tpu.provider import Provider
+from k8s_runpod_kubelet_tpu.provider.annotations import Annotations as A
+
+
+def log(msg):
+    print(f"[demo] {msg}", flush=True)
+
+
+def wait_for(cond, timeout=15.0, what="condition"):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return time.time() - t0
+        time.sleep(0.05)
+    raise SystemExit(f"TIMEOUT waiting for {what}")
+
+
+def main():
+    # -- boot the kubelet ------------------------------------------------------
+    server = FakeTpuServer(provision_delay_s=0.5).start()
+    kube = FakeKubeClient()
+    cfg = Config(node_name="virtual-tpu", zone="us-central2-b",
+                 reconcile_interval_s=0.3, notify_interval_s=0.3,
+                 pending_retry_interval_s=0.5, cleanup_interval_s=1.0)
+    tpu = TpuClient(HttpTransport(server.base_url, token="demo"), "demo-proj",
+                    cfg.zone)
+    transport = InMemoryWorkerTransport()
+    provider = Provider(cfg, kube, tpu, gang_executor=GangExecutor(transport))
+    nc = NodeController(kube, provider, status_interval_s=1.0)
+    pc = PodController(kube, provider, cfg.node_name, resync_interval_s=5.0)
+    api = KubeletApiServer(provider, address="127.0.0.1", port=0)
+    nc.start()
+    pc.start()
+    api.start()
+    provider.start()
+    provider.load_running()
+    log(f"kubelet up; kubelet API on :{api.port}")
+
+    node = kube.get_node("virtual-tpu")
+    log(f"node registered: capacity google.com/tpu={node['status']['capacity']['google.com/tpu']}, "
+        f"taint={node['spec']['taints'][0]['key']}={node['spec']['taints'][0]['value']}")
+    lease = kube.get_lease("virtual-tpu")
+    log(f"lease held by {lease['spec']['holderIdentity']}")
+
+    # -- schedule a training pod ----------------------------------------------
+    pod = {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "maxtext-llama3-8b", "namespace": "default",
+                     "annotations": {A.GENERATION: "v5e"}},
+        "spec": {"nodeName": "virtual-tpu", "restartPolicy": "Never",
+                 "containers": [{
+                     "name": "train", "image": "gcr.io/demo/maxtext:latest",
+                     "resources": {"limits": {"google.com/tpu": "16"}},
+                     "env": [{"name": "MODEL", "value": "llama3-8b"}]}]},
+    }
+    kube.create_pod(pod)
+    log("pod maxtext-llama3-8b scheduled onto virtual-tpu (16 chips requested)")
+
+    dt = wait_for(lambda: ko.annotations(kube.get_pod("default", "maxtext-llama3-8b"))
+                  .get(A.QUEUED_RESOURCE), what="slice deploy")
+    p = kube.get_pod("default", "maxtext-llama3-8b")
+    ann = ko.annotations(p)
+    log(f"deployed after {dt:.2f}s: slice={ann[A.QUEUED_RESOURCE]} "
+        f"type={ann[A.ACCELERATOR_TYPE]} cost=${ann[A.COST_PER_HR]}/hr")
+
+    dt = wait_for(lambda: ko.phase(kube.get_pod("default", "maxtext-llama3-8b")) == "Running",
+                  what="pod Running")
+    p = kube.get_pod("default", "maxtext-llama3-8b")
+    log(f"pod RUNNING after {dt:.2f}s; podIP={p['status']['podIP']}")
+    qr = server.service.get(ann[A.QUEUED_RESOURCE])
+    log(f"gang: {len(qr.runtime)} workers launched; worker env sample: "
+        f"TPU_WORKER_ID={qr.worker_env[2]['TPU_WORKER_ID']} "
+        f"JAX_COORDINATOR_ADDRESS={qr.worker_env[2]['JAX_COORDINATOR_ADDRESS']} "
+        f"TPU_TOPOLOGY={qr.worker_env[2]['TPU_TOPOLOGY']}")
+
+    # -- kubelet API ----------------------------------------------------------
+    base = f"http://127.0.0.1:{api.port}"
+    pods = json.load(urllib.request.urlopen(f"{base}/pods"))
+    log(f"GET /pods -> {len(pods['items'])} pod(s): "
+        f"{[i['metadata']['name'] for i in pods['items']]}")
+    for w in range(4):
+        transport.append_log(qr.name, w, f"step 42 loss=2.17 worker={w}")
+    logs = urllib.request.urlopen(
+        f"{base}/containerLogs/default/maxtext-llama3-8b/train?worker=1").read().decode()
+    log(f"GET /containerLogs?worker=1 -> {logs.strip()!r}")
+
+    # -- preemption (the TPU-normal failure) ----------------------------------
+    log("injecting maintenance preemption of worker 2 ...")
+    server.service.preempt(qr.name, worker_id=2)
+    wait_for(lambda: ko.phase(kube.get_pod("default", "maxtext-llama3-8b")) == "Failed",
+             what="gang-fail")
+    st = kube.get_pod("default", "maxtext-llama3-8b")["status"]
+    log(f"pod FAILED: reason={st['reason']} msg={st['message'][:60]}...")
+
+    # -- delete ---------------------------------------------------------------
+    kube.delete_pod("default", "maxtext-llama3-8b")
+    wait_for(lambda: kube.list_pods() == [], what="pod finalized")
+    wait_for(lambda: server.service.resources == {}, what="slice terminated")
+    log("pod deleted; slice terminated; cluster clean")
+
+    # -- metrics --------------------------------------------------------------
+    ready_lat = provider.metrics.get_observations("tpu_kubelet_schedule_to_ready_seconds")
+    log(f"north-star metric (schedule->gang-running): {ready_lat[0]:.2f}s" if ready_lat
+        else "no latency recorded")
+
+    provider.stop()
+    pc.stop()
+    nc.stop()
+    api.stop()
+    server.stop()
+    log("DEMO PASSED")
+
+
+if __name__ == "__main__":
+    main()

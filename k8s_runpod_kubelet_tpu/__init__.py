@@ -1,0 +1,27 @@
+"""TPU-native virtual kubelet + workload framework.
+
+A brand-new framework with the capability surface of BSVogler/k8s-runpod-kubelet
+(reference at /root/reference, surveyed in SURVEY.md), rebuilt TPU-first:
+
+- ``cloud/``     L1': Cloud TPU client (QueuedResources) — the TPU-native analog of the
+                 reference's RunPod REST/GraphQL client (runpod_client.go).
+- ``kube/``      Minimal Kubernetes API client + hermetic in-memory fake.
+- ``node/``      L3': node registration, lease heartbeat, pod-watch controller, kubelet
+                 HTTP API — replaces the external virtual-kubelet library the reference
+                 leans on (go.mod:53).
+- ``provider/``  L2': pod lifecycle, spec translation, status translation, reconcile
+                 loops, cleanup & crash recovery (kubelet.go).
+- ``gang/``      Net-new: multi-host slice gang scheduling, per-worker env injection and
+                 exec/log transport (SURVEY.md §2.4, §5.8).
+- ``parallel/``  Device-mesh + sharding utilities (dp/fsdp/tp/sp/pp/ep), jax.distributed
+                 bootstrap from kubelet-injected env.
+- ``models/``    Flagship workloads: Llama-family transformer, MNIST, Gemma serving cfg.
+- ``ops/``       TPU kernels: flash/ring attention (Pallas with XLA fallback), rmsnorm,
+                 rotary embeddings.
+- ``workloads/`` Training step (optax/orbax) and a JetStream-style serving engine.
+
+Control-plane modules import no JAX so the kubelet stays lightweight; the workload
+stack is imported lazily.
+"""
+
+__version__ = "0.1.0"

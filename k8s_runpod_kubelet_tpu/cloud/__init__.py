@@ -1,0 +1,39 @@
+"""L1': Cloud TPU client layer.
+
+TPU-native analog of the reference's cloud client
+(/root/reference/pkg/virtual_kubelet/runpod_client.go). Where the reference speaks
+RunPod REST/GraphQL and selects GPUs by price, this layer speaks the Cloud TPU
+QueuedResources API shape and selects accelerator generation + slice topology.
+"""
+
+from .types import (
+    AcceleratorType,
+    QueuedResource,
+    QueuedResourceState,
+    TpuWorker,
+    WorkerRuntimeInfo,
+    DetailedStatus,
+    ACCELERATOR_CATALOG,
+    lookup_accelerator,
+    select_accelerator,
+)
+from .tpu_client import TpuClient, TpuApiError, NotFoundError, QuotaError
+from .transport import HttpTransport, TransportError
+
+__all__ = [
+    "AcceleratorType",
+    "QueuedResource",
+    "QueuedResourceState",
+    "TpuWorker",
+    "WorkerRuntimeInfo",
+    "DetailedStatus",
+    "ACCELERATOR_CATALOG",
+    "lookup_accelerator",
+    "select_accelerator",
+    "TpuClient",
+    "TpuApiError",
+    "NotFoundError",
+    "QuotaError",
+    "HttpTransport",
+    "TransportError",
+]

@@ -1,0 +1,345 @@
+"""In-process fake Cloud TPU API server for hermetic tests.
+
+The reference has NO API fake (SURVEY.md §4: "no mock/fake RunPod API server (no
+httptest anywhere)") and its integration tests hit the live paid cloud. This module
+inverts that: an httptest-style threading HTTP server that implements the exact
+REST surface TpuClient speaks, with
+
+- a lazy-clock state machine (ACCEPTED -> PROVISIONING -> ACTIVE on read, after
+  configurable delays, or instantly via advance()/set_state()),
+- workload simulation (gang launch marks every worker running; finish_workload()
+  or auto_finish_s drives per-worker exits), and
+- fault injection (SURVEY.md §5.3 gap): quota exhaustion, API blackout, worker
+  preemption, slice vanish (NOT_FOUND paths).
+
+Tests drive failure paths the reference never covered.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import re
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from .types import ACCELERATOR_CATALOG, QueuedResourceState, lookup_accelerator
+
+_QR_PATH = re.compile(
+    r"^/v2/projects/(?P<project>[^/]+)/locations/(?P<zone>[^/]+)/queuedResources"
+    r"(?:/(?P<name>[^/:]+))?(?::(?P<verb>detailed|workload))?$")
+_CATALOG_PATH = re.compile(
+    r"^/v2/projects/(?P<project>[^/]+)/locations/(?P<zone>[^/]+)/acceleratorTypes$")
+
+
+class _FakeResource:
+    """Server-side record: slice lifecycle + per-worker workload simulation."""
+
+    def __init__(self, name: str, body: dict, now: float, provision_delay_s: float):
+        self.name = name
+        self.accelerator_type = body["accelerator_type"]
+        self.runtime_version = body.get("runtime_version", "")
+        self.zone = body.get("zone", "")
+        self.spot = body.get("spot", False)
+        self.reservation = body.get("reservation", "")
+        self.labels = body.get("labels", {})
+        self.workload = body.get("workload", {})
+        self.create_time = now
+        self.state = QueuedResourceState.ACCEPTED
+        self.state_message = "queued"
+        self.state_since = now
+        self.provision_delay_s = provision_delay_s
+        self.deleting_since: Optional[float] = None
+        self.workers: list[dict] = []
+        self.runtime: list[dict] = []
+        self.ports: dict[int, int] = {}
+        self.workload_started_at: Optional[float] = None
+        self.auto_finish_s: Optional[float] = None
+        self.worker_env: list[dict] = []
+
+    def _make_workers(self):
+        acc = lookup_accelerator(self.accelerator_type)
+        hosts = acc.hosts if acc else 1
+        self.workers = [
+            {"worker_id": i,
+             "hostname": f"{self.name}-w{i}",
+             "internal_ip": f"10.0.{hash(self.name) % 200}.{i + 2}",
+             "external_ip": "",
+             "state": "READY"}
+            for i in range(hosts)
+        ]
+
+    def advance(self, now: float):
+        """Lazy clock: move the state machine forward based on elapsed time."""
+        if self.state is QueuedResourceState.ACCEPTED:
+            if now - self.state_since >= self.provision_delay_s * 0.3:
+                self._set(QueuedResourceState.PROVISIONING, "creating TPU VMs", now)
+        if self.state is QueuedResourceState.PROVISIONING:
+            if now - self.state_since >= self.provision_delay_s * 0.7:
+                self._make_workers()
+                self._set(QueuedResourceState.ACTIVE, "slice ready", now)
+        if (self.state is QueuedResourceState.ACTIVE and self.workload_started_at
+                and self.auto_finish_s is not None
+                and now - self.workload_started_at >= self.auto_finish_s):
+            self.finish_workload()
+
+    def _set(self, state: QueuedResourceState, msg: str, now: float):
+        self.state = state
+        self.state_message = msg
+        self.state_since = now
+
+    def start_workload(self, spec: dict, worker_env: list[dict], now: float,
+                       auto_finish_s: Optional[float]):
+        self.workload = spec or self.workload
+        self.worker_env = worker_env
+        self.workload_started_at = now
+        self.auto_finish_s = auto_finish_s
+        self.runtime = [
+            {"worker_id": w["worker_id"], "hostname": w["hostname"],
+             "internal_ip": w["internal_ip"], "healthy": True,
+             "workload_running": True, "exit_code": None, "exit_message": "",
+             "started_at": now, "finished_at": None}
+            for w in self.workers
+        ]
+        for p in self.workload.get("ports", []):
+            port = int(str(p).split("/")[0])
+            self.ports[port] = 30000 + port % 2000
+
+    def finish_workload(self, exit_codes: Optional[list[int]] = None,
+                        message: str = ""):
+        now = time.time()
+        for i, r in enumerate(self.runtime):
+            code = exit_codes[i] if exit_codes and i < len(exit_codes) else 0
+            r["workload_running"] = False
+            r["exit_code"] = code
+            r["finished_at"] = now
+            r["exit_message"] = message or ("completed successfully" if code == 0
+                                            else f"exited with code {code}")
+
+    def to_json(self) -> dict:
+        return {
+            "name": self.name,
+            "acceleratorType": self.accelerator_type,
+            "runtimeVersion": self.runtime_version,
+            "state": self.state.value,
+            "zone": self.zone,
+            "stateMessage": self.state_message,
+            "spot": self.spot,
+            "reservation": self.reservation,
+            "workers": self.workers,
+            "labels": self.labels,
+            "createTime": self.create_time,
+        }
+
+
+class FakeTpuService:
+    """Shared mutable state + fault-injection switches (thread-safe)."""
+
+    def __init__(self, provision_delay_s: float = 0.0,
+                 workload_auto_finish_s: Optional[float] = None):
+        self.lock = threading.RLock()
+        self.resources: dict[str, _FakeResource] = {}
+        self.provision_delay_s = provision_delay_s
+        self.workload_auto_finish_s = workload_auto_finish_s
+        # fault injection
+        self.api_down = False            # every request -> 503
+        self.fail_next_create: Optional[tuple[int, str]] = None  # (status, message)
+        self.create_count = 0
+        self.delete_count = 0
+        self.request_log: list[tuple[str, str]] = []
+
+    # -- test hooks ------------------------------------------------------------
+
+    def get(self, name: str) -> _FakeResource:
+        with self.lock:
+            return self.resources[name]
+
+    def advance_all(self):
+        """Force every resource fully forward (ACCEPTED/PROVISIONING -> ACTIVE)."""
+        with self.lock:
+            for r in self.resources.values():
+                if r.state is QueuedResourceState.ACCEPTED:
+                    r._set(QueuedResourceState.PROVISIONING, "creating TPU VMs", time.time())
+                if r.state is QueuedResourceState.PROVISIONING:
+                    r._make_workers()
+                    r._set(QueuedResourceState.ACTIVE, "slice ready", time.time())
+
+    def preempt(self, name: str, worker_id: Optional[int] = None):
+        """Simulate a maintenance event: whole slice (or one worker) goes away."""
+        with self.lock:
+            r = self.resources[name]
+            if worker_id is None:
+                r._set(QueuedResourceState.SUSPENDED, "preempted by maintenance event",
+                       time.time())
+                for w in r.workers:
+                    w["state"] = "PREEMPTED"
+                for rt in r.runtime:
+                    rt["healthy"] = False
+                    rt["workload_running"] = False
+            else:
+                r.workers[worker_id]["state"] = "PREEMPTED"
+                if worker_id < len(r.runtime):
+                    r.runtime[worker_id]["healthy"] = False
+                    r.runtime[worker_id]["workload_running"] = False
+
+    def vanish(self, name: str):
+        """Simulate the slice disappearing entirely (NOT_FOUND path)."""
+        with self.lock:
+            self.resources.pop(name, None)
+
+    def stuck(self, name: str, state: QueuedResourceState, message: str = "stuck"):
+        """Pin a resource to a state (e.g. DELETING forever) for escalation tests."""
+        with self.lock:
+            r = self.resources[name]
+            r._set(state, message, time.time())
+            r.provision_delay_s = float("inf")
+
+    # -- request handling (called from the HTTP handler) -----------------------
+
+    def handle(self, method: str, path: str, query: dict, body: Optional[dict]):
+        """Returns (status, json_body_or_None)."""
+        with self.lock:
+            self.request_log.append((method, path))
+            if self.api_down:
+                return 503, {"error": "service unavailable"}
+            now = time.time()
+            for r in self.resources.values():
+                r.advance(now)
+
+            m = _CATALOG_PATH.match(path)
+            if m and method == "GET":
+                cat = [
+                    {"name": a.name, "generation": a.generation, "chips": a.chips,
+                     "hosts": a.hosts, "chips_per_host": a.chips_per_host,
+                     "topology": a.topology, "hbm_gib_per_chip": a.hbm_gib_per_chip,
+                     "default_runtime": a.default_runtime,
+                     "cost_per_chip_hr": a.cost_per_chip_hr}
+                    for a in ACCELERATOR_CATALOG.values()
+                ]
+                return 200, {"acceleratorTypes": cat}
+
+            m = _QR_PATH.match(path)
+            if not m:
+                return 404, {"error": f"no route {path}"}
+            name, verb = m.group("name"), m.group("verb")
+
+            if method == "POST" and name is None and verb is None:
+                return self._create(query, body, now)
+            if name is None and method == "GET":
+                return self._list(query)
+            if name not in self.resources:
+                return 404, {"error": f"queued resource {name} not found"}
+            r = self.resources[name]
+            if method == "GET" and verb == "detailed":
+                return 200, {"resource": r.to_json(), "runtime": r.runtime,
+                             "ports": {str(k): v for k, v in r.ports.items()}}
+            if method == "GET":
+                return 200, r.to_json()
+            if method == "POST" and verb == "workload":
+                if r.state is not QueuedResourceState.ACTIVE:
+                    return 409, {"error": f"slice {name} is {r.state.value}, not ACTIVE"}
+                r.start_workload(body.get("workload", {}), body.get("workerEnv", []),
+                                 now, self.workload_auto_finish_s)
+                return 200, {}
+            if method == "DELETE":
+                self.delete_count += 1
+                if r.provision_delay_s == float("inf") and r.state is QueuedResourceState.DELETING:
+                    return 200, {}  # stuck deleting: accept but never finish
+                del self.resources[name]
+                return 200, {}
+            return 405, {"error": f"{method} not allowed"}
+
+    def _create(self, query: dict, body: Optional[dict], now: float):
+        self.create_count += 1
+        if self.fail_next_create is not None:
+            status, msg = self.fail_next_create
+            self.fail_next_create = None
+            return status, {"error": msg}
+        name = (query.get("queued_resource_id") or [None])[0] or (body or {}).get("name")
+        if not name:
+            return 400, {"error": "missing queued_resource_id"}
+        if name in self.resources:
+            return 409, {"error": f"queued resource {name} already exists"}
+        if not lookup_accelerator(body["accelerator_type"]):
+            return 400, {"error": f"unknown accelerator type {body['accelerator_type']}"}
+        r = _FakeResource(name, body, now, self.provision_delay_s)
+        self.resources[name] = r
+        r.advance(now)  # delay 0 -> immediately ACTIVE
+        return 200, r.to_json()
+
+    def _list(self, query: dict):
+        states = None
+        if "states" in query:
+            states = {QueuedResourceState(s) for s in query["states"][0].split(",")}
+        items = [r.to_json() for r in self.resources.values()
+                 if states is None or r.state in states]
+        return 200, {"queuedResources": items}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    service: FakeTpuService  # set by server factory
+
+    def log_message(self, *a):  # silence
+        pass
+
+    def _dispatch(self, method: str):
+        parsed = urlparse(self.path)
+        body = None
+        length = int(self.headers.get("Content-Length") or 0)
+        if length:
+            try:
+                body = json.loads(self.rfile.read(length))
+            except json.JSONDecodeError:
+                body = None
+        try:
+            status, payload = self.service.handle(method, parsed.path,
+                                                  parse_qs(parsed.query), body)
+        except (KeyError, TypeError, ValueError) as e:
+            status, payload = 400, {"error": f"bad request: {e}"}
+        data = json.dumps(payload).encode() if payload is not None else b""
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+    def do_DELETE(self):
+        self._dispatch("DELETE")
+
+
+class FakeTpuServer:
+    """Owns the HTTP listener; use as a context manager or start()/stop()."""
+
+    def __init__(self, provision_delay_s: float = 0.0,
+                 workload_auto_finish_s: Optional[float] = None):
+        self.service = FakeTpuService(provision_delay_s, workload_auto_finish_s)
+        handler = type("BoundHandler", (_Handler,), {"service": self.service})
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+
+    @property
+    def base_url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "FakeTpuServer":
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def __enter__(self) -> "FakeTpuServer":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

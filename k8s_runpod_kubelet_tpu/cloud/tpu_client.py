@@ -1,0 +1,241 @@
+"""Cloud TPU client: queued-resource CRUD + catalog + workload launch.
+
+Method-for-capability mirror of the reference's RunPod client
+(/root/reference/pkg/virtual_kubelet/runpod_client.go):
+
+  create_queued_resource  ~ DeployPodREST        runpod_client.go:522 (POST /pods,
+                                                 60s deploy timeout :753-756)
+  get_queued_resource     ~ GetPodStatusREST     runpod_client.go:386
+  get_detailed_status     ~ GetDetailedPodStatus runpod_client.go:773-818
+                                                 (404 -> synthetic NOT_FOUND :788-793)
+  delete_queued_resource  ~ TerminatePod         runpod_client.go:712-739
+  list_queued_resources   ~ fetchRunPodInstancesByStatus kubelet.go:1637-1675
+  list_accelerator_types  ~ GetGPUTypes          runpod_client.go:431-520
+  start_workload          — net-new: a slice is bare VMs; the workload (container,
+                            per-worker env) is launched onto every worker as a gang.
+
+The wire protocol is a REST shape modeled on the Cloud TPU v2 API
+(projects/{p}/locations/{z}/queuedResources) plus two extension endpoints
+(:detailed, :workload) implemented by the in-repo fake server and, in a real
+deployment, by the worker-agent aggregator.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import re
+from typing import Any, Optional
+
+from .transport import HttpTransport, TransportError, DEPLOY_TIMEOUT_S
+from .types import (
+    AcceleratorType,
+    DetailedStatus,
+    QueuedResource,
+    QueuedResourceState,
+    TpuWorker,
+    WorkerRuntimeInfo,
+)
+
+log = logging.getLogger(__name__)
+
+# Queued-resource ids must be RFC-1035-ish, like GCE resource names.
+_NAME_RE = re.compile(r"^[a-z]([-a-z0-9]{0,61}[a-z0-9])?$")
+
+
+class TpuApiError(Exception):
+    def __init__(self, message: str, status: int = 0):
+        super().__init__(message)
+        self.status = status
+
+
+class NotFoundError(TpuApiError):
+    pass
+
+
+class QuotaError(TpuApiError):
+    """Out of capacity / quota — deploy should requeue, not fail the pod."""
+
+
+@dataclasses.dataclass
+class WorkloadSpec:
+    """What runs on every worker of the slice (gang semantics: same program, all hosts).
+
+    The analog of the reference's deployment params dict (runpod_client.go:1334-1372:
+    imageName/env/ports/containerDiskInGb...), minus GPU-isms, plus the per-worker
+    env template the TPU runtime needs (TPU_WORKER_ID etc. are appended per worker
+    by the server/agent, see gang/env.py).
+    """
+
+    image: str
+    command: list[str] = dataclasses.field(default_factory=list)
+    args: list[str] = dataclasses.field(default_factory=list)
+    env: dict[str, str] = dataclasses.field(default_factory=dict)
+    ports: list[str] = dataclasses.field(default_factory=list)  # "port/proto"
+    boot_disk_gb: int = 100
+    registry_auth_id: str = ""
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, d: dict) -> "WorkloadSpec":
+        return cls(**{k: d[k] for k in d if k in {f.name for f in dataclasses.fields(cls)}})
+
+
+@dataclasses.dataclass
+class TpuParameters:
+    """Full deploy request: slice shape + workload. Built by provider/translate.py."""
+
+    name: str
+    accelerator_type: str
+    runtime_version: str
+    zone: str
+    workload: WorkloadSpec
+    spot: bool = False
+    reservation: str = ""
+    labels: dict[str, str] = dataclasses.field(default_factory=dict)
+    valid_after_s: float = 0.0
+
+    def to_json(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["workload"] = self.workload.to_json()
+        return d
+
+
+def _resource_from_json(d: dict) -> QueuedResource:
+    workers = [TpuWorker(**w) for w in d.get("workers", [])]
+    return QueuedResource(
+        name=d["name"],
+        accelerator_type=d["acceleratorType"],
+        runtime_version=d.get("runtimeVersion", ""),
+        state=QueuedResourceState(d["state"]),
+        zone=d.get("zone", ""),
+        state_message=d.get("stateMessage", ""),
+        spot=d.get("spot", False),
+        reservation=d.get("reservation", ""),
+        workers=workers,
+        labels=d.get("labels", {}),
+        create_time=d.get("createTime", 0.0),
+    )
+
+
+class TpuClient:
+    """Typed client over the queued-resources REST surface."""
+
+    def __init__(self, transport: HttpTransport, project: str = "tpu-project",
+                 zone: str = "us-central2-b"):
+        self.transport = transport
+        self.project = project
+        self.zone = zone
+
+    def _base(self, zone: Optional[str] = None) -> str:
+        return f"/v2/projects/{self.project}/locations/{zone or self.zone}"
+
+    @staticmethod
+    def _wrap(e: TransportError, what: str) -> TpuApiError:
+        if e.status == 404:
+            return NotFoundError(f"{what}: not found", status=404)
+        if e.status in (403, 429) and ("quota" in e.body.lower() or "capacity" in e.body.lower()
+                                       or e.status == 429):
+            return QuotaError(f"{what}: {e.body or e}", status=e.status)
+        return TpuApiError(f"{what}: {e}", status=e.status)
+
+    # -- CRUD ------------------------------------------------------------------
+
+    def create_queued_resource(self, params: TpuParameters) -> QueuedResource:
+        if not _NAME_RE.match(params.name):
+            raise TpuApiError(f"invalid queued-resource name {params.name!r}")
+        try:
+            d = self.transport.request(
+                "POST", f"{self._base(params.zone)}/queuedResources"
+                        f"?queued_resource_id={params.name}",
+                body=params.to_json(), timeout_s=DEPLOY_TIMEOUT_S)
+        except TransportError as e:
+            raise self._wrap(e, f"create {params.name}") from e
+        return _resource_from_json(d)
+
+    def get_queued_resource(self, name: str, zone: Optional[str] = None) -> QueuedResource:
+        try:
+            d = self.transport.request("GET", f"{self._base(zone)}/queuedResources/{name}")
+        except TransportError as e:
+            raise self._wrap(e, f"get {name}") from e
+        return _resource_from_json(d)
+
+    def get_detailed_status(self, name: str, zone: Optional[str] = None) -> DetailedStatus:
+        """Slice state + per-worker runtime info; 404 becomes a synthetic NOT_FOUND
+        status rather than an exception (parity: runpod_client.go:788-793), so the
+        reconcile loop can treat disappearance as a state, not an error."""
+        try:
+            d = self.transport.request("GET", f"{self._base(zone)}/queuedResources/{name}:detailed")
+        except TransportError as e:
+            if e.status == 404:
+                return DetailedStatus(resource=QueuedResource(
+                    name=name, accelerator_type="", runtime_version="",
+                    state=QueuedResourceState.NOT_FOUND,
+                    state_message="queued resource not found"))
+            raise self._wrap(e, f"detailed status {name}") from e
+        runtime = [WorkerRuntimeInfo(**w) for w in d.get("runtime", [])]
+        ports = {int(k): int(v) for k, v in d.get("ports", {}).items()}
+        return DetailedStatus(resource=_resource_from_json(d["resource"]),
+                              runtime=runtime, ports=ports)
+
+    def delete_queued_resource(self, name: str, zone: Optional[str] = None,
+                               force: bool = True) -> None:
+        """Idempotent delete; 404 is success (parity: TerminatePod treats the
+        instance as gone, runpod_client.go:712-739 + kubelet 404 handling)."""
+        try:
+            self.transport.request(
+                "DELETE", f"{self._base(zone)}/queuedResources/{name}?force={str(force).lower()}",
+                expect_status=(200, 204))
+        except TransportError as e:
+            if e.status == 404:
+                return
+            raise self._wrap(e, f"delete {name}") from e
+
+    def list_queued_resources(self, states: Optional[list[QueuedResourceState]] = None,
+                              zone: Optional[str] = None) -> list[QueuedResource]:
+        q = ""
+        if states:
+            q = "?states=" + ",".join(s.value for s in states)
+        try:
+            d = self.transport.request("GET", f"{self._base(zone)}/queuedResources{q}")
+        except TransportError as e:
+            raise self._wrap(e, "list queued resources") from e
+        return [_resource_from_json(r) for r in d.get("queuedResources", [])]
+
+    # -- catalog / health ------------------------------------------------------
+
+    def list_accelerator_types(self, zone: Optional[str] = None) -> list[AcceleratorType]:
+        try:
+            d = self.transport.request("GET", f"{self._base(zone)}/acceleratorTypes")
+        except TransportError as e:
+            raise self._wrap(e, "list accelerator types") from e
+        return [AcceleratorType(**a) for a in d.get("acceleratorTypes", [])]
+
+    def health_check(self) -> bool:
+        """Cloud availability probe (parity: checkRunPodAPIHealth does GET gpuTypes,
+        kubelet.go:320-331)."""
+        try:
+            self.list_accelerator_types()
+            return True
+        except TpuApiError:
+            return False
+
+    # -- workload --------------------------------------------------------------
+
+    def start_workload(self, name: str, spec: WorkloadSpec,
+                       worker_env: Optional[list[dict[str, str]]] = None,
+                       zone: Optional[str] = None) -> None:
+        """Launch the workload on every worker of an ACTIVE slice (gang launch).
+        ``worker_env`` is the per-worker env overlay (TPU_WORKER_ID, coordinator...)
+        computed by gang/env.py."""
+        body: dict[str, Any] = {"workload": spec.to_json()}
+        if worker_env is not None:
+            body["workerEnv"] = worker_env
+        try:
+            self.transport.request(
+                "POST", f"{self._base(zone)}/queuedResources/{name}:workload",
+                body=body, expect_status=(200, 204))
+        except TransportError as e:
+            raise self._wrap(e, f"start workload on {name}") from e

@@ -1,0 +1,97 @@
+"""HTTP transport with auth, timeouts and bounded retry.
+
+Analog of the reference's REST plumbing (runpod_client.go:742-770 makeRESTRequest:
+Bearer auth, 30s default / 60s deploy timeouts; retry w/ linear backoff x3
+:275-307). stdlib-only so the control plane has zero third-party deps.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Callable, Optional
+
+log = logging.getLogger(__name__)
+
+DEFAULT_TIMEOUT_S = 30.0
+DEPLOY_TIMEOUT_S = 60.0
+MAX_RETRIES = 3
+BACKOFF_BASE_S = 0.5  # sleep 0.5s * attempt, as the reference does (:302)
+
+
+class TransportError(Exception):
+    """A request failed after retries. ``status`` is the last HTTP status (0 = network)."""
+
+    def __init__(self, message: str, status: int = 0, body: str = ""):
+        super().__init__(message)
+        self.status = status
+        self.body = body
+
+
+class HttpTransport:
+    """Tiny JSON-over-HTTP client: request(), with bearer auth and retry on 5xx/network.
+
+    4xx responses are NOT retried (they are deterministic), mirroring the
+    reference's retry helper which only loops on transport errors and 5xx.
+    """
+
+    def __init__(
+        self,
+        base_url: str,
+        token: str = "",
+        timeout_s: float = DEFAULT_TIMEOUT_S,
+        max_retries: int = MAX_RETRIES,
+        sleep: Callable[[float], None] = time.sleep,
+        user_agent: str = "tpu-virtual-kubelet/0.1",
+    ):
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        self.timeout_s = timeout_s
+        self.max_retries = max_retries
+        self._sleep = sleep
+        self.user_agent = user_agent
+
+    def request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[dict] = None,
+        timeout_s: Optional[float] = None,
+        expect_status: tuple[int, ...] = (200,),
+    ) -> Any:
+        """Issue a JSON request; returns the decoded JSON body (or None for empty)."""
+        url = self.base_url + path
+        data = json.dumps(body).encode() if body is not None else None
+        last_err: Optional[TransportError] = None
+        for attempt in range(1, self.max_retries + 1):
+            req = urllib.request.Request(url, data=data, method=method)
+            req.add_header("Content-Type", "application/json")
+            req.add_header("User-Agent", self.user_agent)
+            if self.token:
+                req.add_header("Authorization", f"Bearer {self.token}")
+            try:
+                with urllib.request.urlopen(req, timeout=timeout_s or self.timeout_s) as resp:
+                    raw = resp.read()
+                    if resp.status not in expect_status:
+                        raise TransportError(
+                            f"{method} {path}: unexpected status {resp.status}",
+                            status=resp.status, body=raw.decode(errors="replace"))
+                    return json.loads(raw) if raw else None
+            except urllib.error.HTTPError as e:
+                body_text = e.read().decode(errors="replace")
+                if e.code in expect_status:
+                    return json.loads(body_text) if body_text else None
+                last_err = TransportError(
+                    f"{method} {path}: HTTP {e.code}", status=e.code, body=body_text)
+                if e.code < 500:  # deterministic failure — don't retry
+                    raise last_err
+            except (urllib.error.URLError, TimeoutError, ConnectionError, OSError) as e:
+                last_err = TransportError(f"{method} {path}: {e}", status=0)
+            if attempt < self.max_retries:
+                self._sleep(BACKOFF_BASE_S * attempt)
+                log.debug("retrying %s %s (attempt %d): %s", method, path, attempt + 1, last_err)
+        assert last_err is not None
+        raise last_err

@@ -1,0 +1,242 @@
+"""Typed Cloud TPU API surface: states, catalog, queued-resource records.
+
+TPU-native redesign of the reference's cloud data model:
+- state enum          ~ runpod_client.go:55-64 (RUNNING/STARTING/TERMINATING/
+                        TERMINATED/NOT_FOUND/EXITED) — remapped onto QueuedResource
+                        lifecycle states, which include queueing (WAITING_FOR_RESOURCES)
+                        and preemption (SUSPENDED), both absent from the reference.
+- accelerator catalog ~ runpod_client.go:431-520 (GetGPUTypes price-filtered GPU
+                        selection) — replaced by a generation+topology selector, since
+                        TPU capacity is sold as whole slices, not per-GPU prices.
+- DetailedStatus      ~ runpod_client.go:111-134 (DetailedStatus/RuntimeInfo with
+                        portMappings and exit info) — replaced by per-worker runtime
+                        info, because a slice has N workers that must be aggregated.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from typing import Optional
+
+
+class QueuedResourceState(str, enum.Enum):
+    """Lifecycle of a Cloud TPU queued resource (plus synthetic terminal states).
+
+    Mapping to the reference's 6-state enum (runpod_client.go:55-64):
+      ACCEPTED / WAITING_FOR_RESOURCES / PROVISIONING -> STARTING
+      ACTIVE                                          -> RUNNING
+      SUSPENDING / DELETING                           -> TERMINATING
+      SUSPENDED                                       -> TERMINATED (preempted; common
+                                                        on TPU, edge-case on RunPod)
+      FAILED                                          -> EXITED (with failure)
+      NOT_FOUND                                       -> NOT_FOUND
+      EXITED is synthesized when the *workload* on an ACTIVE slice finishes
+      (per-worker exit aggregation) — see provider/status.py.
+    """
+
+    ACCEPTED = "ACCEPTED"
+    WAITING_FOR_RESOURCES = "WAITING_FOR_RESOURCES"
+    PROVISIONING = "PROVISIONING"
+    ACTIVE = "ACTIVE"
+    SUSPENDING = "SUSPENDING"
+    SUSPENDED = "SUSPENDED"
+    DELETING = "DELETING"
+    FAILED = "FAILED"
+    NOT_FOUND = "NOT_FOUND"  # synthetic: GET returned 404
+
+    @property
+    def is_terminal(self) -> bool:
+        return self in (
+            QueuedResourceState.SUSPENDED,
+            QueuedResourceState.FAILED,
+            QueuedResourceState.NOT_FOUND,
+        )
+
+    @property
+    def is_provisioning(self) -> bool:
+        return self in (
+            QueuedResourceState.ACCEPTED,
+            QueuedResourceState.WAITING_FOR_RESOURCES,
+            QueuedResourceState.PROVISIONING,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class AcceleratorType:
+    """One row of the accelerator catalog (replaces the reference's GPUType)."""
+
+    name: str              # e.g. "v5litepod-16"
+    generation: str        # e.g. "v5e"
+    chips: int             # total chips in the slice
+    hosts: int             # TPU VM workers (gang size)
+    chips_per_host: int
+    topology: str          # e.g. "4x4"
+    hbm_gib_per_chip: int
+    default_runtime: str   # e.g. "v2-alpha-tpuv5-lite"
+    cost_per_chip_hr: float  # USD, on-demand list price (cost visibility parity:
+                             # reference annotates runpod.io/cost-per-hr, kubelet.go:524)
+
+    @property
+    def cost_per_hr(self) -> float:
+        return round(self.cost_per_chip_hr * self.chips, 4)
+
+
+def _gen(generation: str, prefix: str, runtime: str, chips_per_host: int,
+         hbm: int, cost: float, slices: list[tuple[int, str]]) -> list[AcceleratorType]:
+    out = []
+    for chips, topology in slices:
+        hosts = max(1, chips // chips_per_host)
+        out.append(AcceleratorType(
+            name=f"{prefix}-{chips}", generation=generation, chips=chips,
+            hosts=hosts, chips_per_host=chips_per_host, topology=topology,
+            hbm_gib_per_chip=hbm, default_runtime=runtime, cost_per_chip_hr=cost))
+    return out
+
+
+# Static catalog of the TPU fleet the virtual node can offer. The fake API server
+# serves exactly this catalog; a real deployment would overlay live availability.
+ACCELERATOR_CATALOG: dict[str, AcceleratorType] = {
+    a.name: a
+    for a in (
+        _gen("v4", "v4", "tpu-vm-v4-base", 4, 32, 3.22, [
+            (8, "2x2x1"), (16, "2x2x2"), (32, "2x2x4"), (64, "2x4x4"),
+            (128, "4x4x4"), (256, "4x4x8"), (512, "4x8x8"),
+        ])
+        + _gen("v5e", "v5litepod", "v2-alpha-tpuv5-lite", 4, 16, 1.20, [
+            (1, "1x1"), (4, "2x2"), (8, "2x4"), (16, "4x4"),
+            (32, "4x8"), (64, "8x8"), (128, "8x16"), (256, "16x16"),
+        ])
+        + _gen("v5p", "v5p", "v2-alpha-tpuv5", 4, 95, 4.20, [
+            (8, "2x2x1"), (16, "2x2x2"), (32, "2x2x4"), (64, "2x4x4"),
+            (128, "4x4x4"), (256, "4x4x8"), (512, "4x8x8"),
+        ])
+        + _gen("v6e", "v6e", "v2-alpha-tpuv6e", 4, 32, 2.70, [
+            (1, "1x1"), (4, "2x2"), (8, "2x4"), (16, "4x4"),
+            (32, "4x8"), (64, "8x8"), (128, "8x16"), (256, "16x16"),
+        ])
+    )
+}
+
+# v5e single-host slices have special chips_per_host: v5litepod-1 is 1 chip / 1 host,
+# v5litepod-4 is 4 chips / 1 host, v5litepod-8 is 8 chips / 1 host (2 boards).
+for _name, _hosts, _cph in (("v5litepod-1", 1, 1), ("v5litepod-4", 1, 4),
+                            ("v5litepod-8", 1, 8), ("v6e-1", 1, 1),
+                            ("v6e-4", 1, 4), ("v6e-8", 1, 8)):
+    _a = ACCELERATOR_CATALOG[_name]
+    ACCELERATOR_CATALOG[_name] = dataclasses.replace(_a, hosts=_hosts, chips_per_host=_cph)
+
+
+def lookup_accelerator(name: str) -> Optional[AcceleratorType]:
+    return ACCELERATOR_CATALOG.get(name)
+
+
+def select_accelerator(
+    *,
+    chips: Optional[int] = None,
+    generation: Optional[str] = None,
+    topology: Optional[str] = None,
+    min_hbm_gib: Optional[int] = None,
+    max_cost_per_hr: Optional[float] = None,
+    limit: int = 5,
+) -> list[AcceleratorType]:
+    """Generation+topology selector.
+
+    Replaces the reference's GPU selection (runpod_client.go:465-509: filter by
+    cloudType/price/minRAM, sort by price, take top 5). Filters the catalog by the
+    pod's requested chip count / generation / topology / HBM floor / cost ceiling,
+    sorts by (cost, chips) ascending so the cheapest satisfying slice wins, and
+    returns up to ``limit`` candidates.
+    """
+    out = []
+    for a in ACCELERATOR_CATALOG.values():
+        if chips is not None and a.chips != chips:
+            continue
+        if generation is not None and a.generation != generation:
+            continue
+        if topology is not None and a.topology != topology:
+            continue
+        if min_hbm_gib is not None and a.hbm_gib_per_chip < min_hbm_gib:
+            continue
+        if max_cost_per_hr is not None and a.cost_per_hr > max_cost_per_hr:
+            continue
+        out.append(a)
+    out.sort(key=lambda a: (a.cost_per_hr, a.chips))
+    return out[:limit]
+
+
+@dataclasses.dataclass
+class WorkerRuntimeInfo:
+    """Per-worker workload state (analog of RuntimeInfo, runpod_client.go:128-134)."""
+
+    worker_id: int
+    hostname: str = ""
+    internal_ip: str = ""
+    healthy: bool = True
+    workload_running: bool = False
+    exit_code: Optional[int] = None
+    exit_message: str = ""
+    started_at: Optional[float] = None
+    finished_at: Optional[float] = None
+
+
+@dataclasses.dataclass
+class TpuWorker:
+    """One TPU VM of a slice."""
+
+    worker_id: int
+    hostname: str
+    internal_ip: str
+    external_ip: str = ""
+    state: str = "READY"  # CREATING / READY / UNHEALTHY / PREEMPTED
+
+
+@dataclasses.dataclass
+class QueuedResource:
+    """A queued-resource record as returned by the cloud API."""
+
+    name: str
+    accelerator_type: str
+    runtime_version: str
+    state: QueuedResourceState
+    zone: str = "us-central2-b"
+    state_message: str = ""
+    spot: bool = False
+    reservation: str = ""
+    workers: list[TpuWorker] = dataclasses.field(default_factory=list)
+    labels: dict[str, str] = dataclasses.field(default_factory=dict)
+    create_time: float = dataclasses.field(default_factory=time.time)
+
+    @property
+    def accelerator(self) -> Optional[AcceleratorType]:
+        return lookup_accelerator(self.accelerator_type)
+
+
+@dataclasses.dataclass
+class DetailedStatus:
+    """Aggregated slice + workload status for the reconcile loop.
+
+    Analog of the reference's DetailedStatus (runpod_client.go:111-126,
+    GetDetailedPodStatus :773-818), generalized from one container's port mappings
+    to N workers' runtime state. ``ports`` preserved for readiness parity.
+    """
+
+    resource: QueuedResource
+    runtime: list[WorkerRuntimeInfo] = dataclasses.field(default_factory=list)
+    ports: dict[int, int] = dataclasses.field(default_factory=dict)  # private->public
+
+    @property
+    def all_workers_healthy(self) -> bool:
+        if not self.runtime:
+            return False
+        return all(w.healthy for w in self.runtime)
+
+    @property
+    def all_exited(self) -> bool:
+        return bool(self.runtime) and all(w.exit_code is not None for w in self.runtime)
+
+    @property
+    def max_exit_code(self) -> Optional[int]:
+        codes = [w.exit_code for w in self.runtime if w.exit_code is not None]
+        return max(codes) if codes else None

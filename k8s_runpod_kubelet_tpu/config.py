@@ -1,0 +1,134 @@
+"""Single typed config: flags > env > YAML file, validated at startup.
+
+Fixes the reference's config wiring bugs by construction (SURVEY.md §5.6:
+--max-gpu-price parsed but never used, --log-level never applied,
+PendingJobThreshold/MaxPendingTime defined but dead): every field here is read
+somewhere, and load() applies a strict precedence.
+
+Timing defaults keep parity with the reference's control loop (BASELINE.md):
+30s reconcile, 30s pending retry, 15min pending give-up, 5min cleanup, and the
+5/10/15-minute stuck-terminating ladder — plus TPU-specific knobs the reference
+couldn't need (provisioning-queue tolerance, preemption requeue).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+@dataclasses.dataclass
+class Config:
+    # identity
+    node_name: str = "virtual-tpu"
+    namespace: str = "default"
+    internal_ip: str = "127.0.0.1"
+    operating_system: str = "Linux"
+
+    # cloud
+    project: str = "tpu-project"
+    zone: str = "us-central2-b"
+    zones: list[str] = dataclasses.field(default_factory=list)  # allowed zones filter
+    tpu_api_endpoint: str = "https://tpu.googleapis.com"
+    tpu_api_token: str = ""
+    default_generation: str = "v5e"
+    default_runtime_version: str = ""
+    max_cost_per_hr: float = 0.0  # 0 = unlimited; actually enforced, unlike the
+                                  # reference's --max-gpu-price (SURVEY.md §5.6)
+
+    # control loop timing (reference parity, kubelet.go)
+    reconcile_interval_s: float = 30.0       # status poll        (kubelet.go:293)
+    notify_interval_s: float = 10.0          # NotifyPods ticker  (kubelet.go:719)
+    pending_retry_interval_s: float = 30.0   # pending deployer   (kubelet.go:735)
+    max_pending_s: float = 15 * 60           # deploy give-up     (kubelet.go:788)
+    cleanup_interval_s: float = 5 * 60       # GC sweep           (kubelet.go:307)
+    node_status_interval_s: float = 30.0     # node push          (kubelet.go:1081)
+    # stuck-terminating escalation ladder (kubelet.go:1333/:1285/:1350)
+    stuck_reterminate_s: float = 5 * 60
+    stuck_unreachable_force_s: float = 10 * 60
+    stuck_force_delete_s: float = 15 * 60
+    # TPU-specific: how long a queued resource may sit ACCEPTED/WAITING before we
+    # fail the pod. 0 = forever (QueuedResources legitimately queue for hours;
+    # SURVEY.md §7.4 hard-part #3 says don't trip the 15-min ladder on queueing).
+    max_provisioning_s: float = 0.0
+    # preemption: resubmit the slice instead of failing the pod, this many times
+    preemption_requeue_limit: int = 0  # 0 = fail pod immediately (Job restarts it)
+
+    # servers
+    listen_port: int = 10250
+    health_address: str = ":8080"
+    metrics_enabled: bool = True
+
+    # logging
+    log_level: str = "info"
+    sentry_url: str = ""
+
+    # paths
+    kubeconfig: str = ""
+
+    def validate(self) -> "Config":
+        errs = []
+        if not self.node_name:
+            errs.append("node_name must be set")
+        if self.reconcile_interval_s <= 0:
+            errs.append("reconcile_interval_s must be > 0")
+        if self.max_pending_s <= 0:
+            errs.append("max_pending_s must be > 0")
+        if self.log_level.lower() not in ("debug", "info", "warning", "error"):
+            errs.append(f"unknown log_level {self.log_level!r}")
+        if self.zones and self.zone not in self.zones:
+            errs.append(f"zone {self.zone!r} not in allowed zones {self.zones}")
+        if errs:
+            raise ValueError("invalid config: " + "; ".join(errs))
+        return self
+
+
+_ENV_MAP = {
+    "TPU_API_TOKEN": "tpu_api_token",
+    "TPU_API_ENDPOINT": "tpu_api_endpoint",
+    "TPU_PROJECT": "project",
+    "TPU_ZONE": "zone",
+    "NODE_NAME": "node_name",
+    "NAMESPACE": "namespace",
+    "SENTRY_URL": "sentry_url",
+    "LOG_LEVEL": "log_level",
+}
+
+
+def load(file_path: Optional[str] = None, env: Optional[dict] = None,
+         overrides: Optional[dict] = None) -> Config:
+    """Build config with precedence: overrides (flags) > env > file > defaults."""
+    values: dict = {}
+    if file_path:
+        import yaml
+        with open(file_path) as f:
+            loaded = yaml.safe_load(f) or {}
+        known = {f.name for f in dataclasses.fields(Config)}
+        unknown = set(loaded) - known
+        if unknown:
+            raise ValueError(f"unknown config keys in {file_path}: {sorted(unknown)}")
+        values.update(loaded)
+    env = os.environ if env is None else env
+    for env_key, field in _ENV_MAP.items():
+        if env.get(env_key):
+            values[field] = env[env_key]
+    if overrides:
+        values.update({k: v for k, v in overrides.items() if v is not None})
+    # coerce numerics/lists that may arrive as strings from env/flags
+    cfg = Config()
+    for f in dataclasses.fields(Config):
+        if f.name not in values:
+            continue
+        v = values[f.name]
+        cur = getattr(cfg, f.name)
+        if isinstance(cur, bool) and isinstance(v, str):
+            v = v.lower() in ("1", "true", "yes")
+        elif isinstance(cur, float) and not isinstance(v, float):
+            v = float(v)
+        elif isinstance(cur, int) and not isinstance(v, (int, bool)):
+            v = int(v)
+        elif isinstance(cur, list) and isinstance(v, str):
+            v = [s.strip() for s in v.split(",") if s.strip()]
+        setattr(cfg, f.name, v)
+    return cfg.validate()

@@ -1,0 +1,26 @@
+"""Gang scheduling: one K8s pod <-> one multi-host TPU slice.
+
+The reference's single biggest capability gap (SURVEY.md §2.4): it maps one pod
+to one single-GPU instance and never reads the accelerator count. Here, a pod
+requesting ``google.com/tpu: N`` becomes an N-chip slice whose workers are
+launched together (all-or-nothing), each with the env that lets XLA form the ICI
+mesh and jax.distributed form the DCN ring:
+
+- ``env``:  per-worker env computation (TPU_WORKER_ID, TPU_WORKER_HOSTNAMES,
+  coordinator address, megascale/multislice vars).
+- ``exec``: per-worker exec/log transport (SSH for real TPU VMs, in-memory fake
+  for tests) backing the kubelet API's real logs/exec endpoints.
+"""
+
+from .env import compute_worker_env, coordinator_address, DEFAULT_COORDINATOR_PORT
+from .exec import WorkerTransport, SshWorkerTransport, InMemoryWorkerTransport, GangExecutor
+
+__all__ = [
+    "compute_worker_env",
+    "coordinator_address",
+    "DEFAULT_COORDINATOR_PORT",
+    "WorkerTransport",
+    "SshWorkerTransport",
+    "InMemoryWorkerTransport",
+    "GangExecutor",
+]

@@ -1,0 +1,87 @@
+"""Per-worker environment injection for gang-launched TPU workloads.
+
+This is the kubelet-side prerequisite for every parallelism strategy in
+SURVEY.md §2.4 and §5.7: a slice's workers must all run the same program with a
+correctly-formed mesh, which requires each worker to know (a) its identity in
+the gang, (b) every peer's address (ICI mesh formation), (c) the jax.distributed
+coordinator (DCN / multi-controller runtime), and (d) the multislice (megascale)
+coordinator when the job spans slices.
+
+The reference injects nothing (it ships env verbatim to one instance,
+runpod_client.go:1334-1342); this module is net-new capability.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..cloud.types import QueuedResource, lookup_accelerator
+
+DEFAULT_COORDINATOR_PORT = 8476
+DEFAULT_MEGASCALE_PORT = 8080
+
+
+def coordinator_address(qr: QueuedResource, port: int = DEFAULT_COORDINATOR_PORT) -> str:
+    """Worker 0 is the jax.distributed coordinator, by convention."""
+    host = qr.workers[0].internal_ip or qr.workers[0].hostname if qr.workers else ""
+    return f"{host}:{port}"
+
+
+def compute_worker_env(
+    qr: QueuedResource,
+    *,
+    coordinator_port: int = DEFAULT_COORDINATOR_PORT,
+    num_slices: int = 1,
+    slice_id: int = 0,
+    megascale_coordinator: Optional[str] = None,
+    megascale_port: int = DEFAULT_MEGASCALE_PORT,
+) -> list[dict[str, str]]:
+    """Build the per-worker env overlay for a gang launch.
+
+    Returns one dict per worker, merged over the user's workload env by the
+    worker agent. Keys follow the conventions GKE/TPU runtimes and
+    jax.distributed understand; ``parallel/distributed.py`` consumes the same
+    names on the workload side, closing the loop.
+
+    Single-slice: every worker gets the same TPU_WORKER_HOSTNAMES and the
+    worker-0 coordinator; ICI needs no config beyond "same program, all hosts".
+    Multislice: MEGASCALE_* vars describe the DCN mesh across slices; process
+    ids are globally offset so jax sees one flat process space.
+    """
+    acc = lookup_accelerator(qr.accelerator_type)
+    hosts = qr.workers
+    n = len(hosts)
+    hostnames = ",".join(w.hostname for w in hosts)
+    coord = coordinator_address(qr, coordinator_port)
+    if megascale_coordinator is None:
+        megascale_coordinator = coord.split(":")[0]
+
+    envs: list[dict[str, str]] = []
+    for w in hosts:
+        e = {
+            # TPU runtime identity (what GKE's device plugin would inject)
+            "TPU_WORKER_ID": str(w.worker_id),
+            "TPU_WORKER_HOSTNAMES": hostnames,
+            "TPU_ACCELERATOR_TYPE": qr.accelerator_type,
+            "TPU_TOPOLOGY": acc.topology if acc else "",
+            "TPU_CHIPS_PER_HOST": str(acc.chips_per_host if acc else 0),
+            "TPU_RUNTIME_VERSION": qr.runtime_version,
+            "TPU_SKIP_MDS_QUERY": "true",  # no GCE metadata server in our pods
+            # jax.distributed bootstrap (multi-controller)
+            "JAX_COORDINATOR_ADDRESS": coord,
+            "JAX_NUM_PROCESSES": str(n * num_slices),
+            "JAX_PROCESS_ID": str(slice_id * n + w.worker_id),
+            # slice identity for logging/metrics
+            "TPU_SLICE_NAME": qr.name,
+            "TPU_ZONE": qr.zone,
+        }
+        if num_slices > 1:
+            # DCN multislice (MegaScale) wiring — SURVEY.md §5.8
+            e.update({
+                "MEGASCALE_COORDINATOR_ADDRESS": f"{megascale_coordinator}:{megascale_port}",
+                "MEGASCALE_NUM_SLICES": str(num_slices),
+                "MEGASCALE_SLICE_ID": str(slice_id),
+                "MEGASCALE_PORT": str(megascale_port),
+            })
+        envs.append(e)
+    return envs

@@ -1,0 +1,172 @@
+"""Per-worker exec/log transport.
+
+Where RunPod offered no exec path (the reference stubs RunInContainer and
+GetContainerLogs, kubelet.go:2027-2066), TPU VMs are SSH-able. The kubelet API
+server's real /containerLogs and /run endpoints route through a GangExecutor,
+which fans a command out to all (or one) of a slice's workers.
+
+Transports:
+- SshWorkerTransport: shells out to ``ssh`` (TPU VMs with OS Login / metadata
+  keys). Used in real deployments.
+- InMemoryWorkerTransport: deterministic fake for hermetic tests.
+"""
+
+from __future__ import annotations
+
+import logging
+import shlex
+import subprocess
+import threading
+from typing import Optional
+
+from ..cloud.types import QueuedResource
+
+log = logging.getLogger(__name__)
+
+
+class WorkerExecError(Exception):
+    def __init__(self, message: str, exit_code: int = 1, output: str = ""):
+        super().__init__(message)
+        self.exit_code = exit_code
+        self.output = output
+
+
+class WorkerTransport:
+    """Protocol: run a command on one worker of a slice."""
+
+    def run(self, qr: QueuedResource, worker_id: int, cmd: list[str],
+            timeout_s: float = 60.0) -> str:
+        raise NotImplementedError
+
+    def logs(self, qr: QueuedResource, worker_id: int,
+             tail_lines: Optional[int] = None) -> str:
+        """Workload container logs on one worker."""
+        raise NotImplementedError
+
+
+class SshWorkerTransport(WorkerTransport):
+    """SSH to the TPU VM; the workload runs as container 'workload' under docker."""
+
+    def __init__(self, user: str = "tpu", ssh_opts: Optional[list[str]] = None,
+                 container_name: str = "workload"):
+        self.user = user
+        self.ssh_opts = ssh_opts or ["-o", "StrictHostKeyChecking=no",
+                                     "-o", "ConnectTimeout=10"]
+        self.container_name = container_name
+
+    def _target(self, qr: QueuedResource, worker_id: int) -> str:
+        w = qr.workers[worker_id]
+        return f"{self.user}@{w.external_ip or w.internal_ip or w.hostname}"
+
+    def _ssh(self, qr: QueuedResource, worker_id: int, remote_cmd: str,
+             timeout_s: float) -> str:
+        argv = ["ssh", *self.ssh_opts, self._target(qr, worker_id), remote_cmd]
+        try:
+            res = subprocess.run(argv, capture_output=True, text=True, timeout=timeout_s)
+        except subprocess.TimeoutExpired as e:
+            raise WorkerExecError(f"ssh to worker {worker_id} timed out") from e
+        if res.returncode != 0:
+            raise WorkerExecError(
+                f"worker {worker_id}: exit {res.returncode}: {res.stderr[:500]}",
+                exit_code=res.returncode, output=res.stdout)
+        return res.stdout
+
+    def run(self, qr, worker_id, cmd, timeout_s=60.0):
+        inner = " ".join(shlex.quote(c) for c in cmd)
+        return self._ssh(qr, worker_id,
+                         f"docker exec {self.container_name} {inner}", timeout_s)
+
+    def logs(self, qr, worker_id, tail_lines=None):
+        tail = f" --tail {tail_lines}" if tail_lines else ""
+        return self._ssh(qr, worker_id,
+                         f"docker logs{tail} {self.container_name}", timeout_s=30.0)
+
+
+class InMemoryWorkerTransport(WorkerTransport):
+    """Test fake: scripted outputs + recorded calls, per (slice, worker)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.calls: list[tuple[str, int, list[str]]] = []
+        self._logs: dict[tuple[str, int], list[str]] = {}
+        self.responses: dict[str, str] = {}  # cmd[0] -> canned stdout
+        self.fail_workers: set[tuple[str, int]] = set()
+
+    def append_log(self, qr_name: str, worker_id: int, line: str):
+        with self.lock:
+            self._logs.setdefault((qr_name, worker_id), []).append(line)
+
+    def run(self, qr, worker_id, cmd, timeout_s=60.0):
+        with self.lock:
+            self.calls.append((qr.name, worker_id, list(cmd)))
+            if (qr.name, worker_id) in self.fail_workers:
+                raise WorkerExecError(f"worker {worker_id} unreachable", exit_code=255)
+            return self.responses.get(cmd[0] if cmd else "", "")
+
+    def logs(self, qr, worker_id, tail_lines=None):
+        with self.lock:
+            if (qr.name, worker_id) in self.fail_workers:
+                raise WorkerExecError(f"worker {worker_id} unreachable", exit_code=255)
+            lines = self._logs.get((qr.name, worker_id), [])
+            if tail_lines:
+                lines = lines[-tail_lines:]
+            return "\n".join(lines) + ("\n" if lines else "")
+
+
+class GangExecutor:
+    """Fan-out over a slice's workers with all-or-nothing semantics."""
+
+    def __init__(self, transport: WorkerTransport):
+        self.transport = transport
+
+    def run_on_worker(self, qr: QueuedResource, worker_id: int, cmd: list[str],
+                      timeout_s: float = 60.0) -> str:
+        if not qr.workers or worker_id >= len(qr.workers):
+            raise WorkerExecError(f"slice {qr.name} has no worker {worker_id}")
+        return self.transport.run(qr, worker_id, cmd, timeout_s)
+
+    def run_on_all(self, qr: QueuedResource, cmd: list[str],
+                   timeout_s: float = 60.0) -> dict[int, str]:
+        """Run on every worker concurrently; raises if ANY worker fails (gang
+        semantics — a partial launch is a failed launch)."""
+        results: dict[int, str] = {}
+        errors: dict[int, Exception] = {}
+
+        def one(i: int):
+            try:
+                results[i] = self.transport.run(qr, i, cmd, timeout_s)
+            except Exception as e:  # noqa: BLE001
+                errors[i] = e
+
+        threads = {w.worker_id: threading.Thread(target=one, args=(w.worker_id,),
+                                                 daemon=True)
+                   for w in qr.workers}
+        for t in threads.values():
+            t.start()
+        for t in threads.values():
+            t.join(timeout=timeout_s + 5)
+        for wid, t in threads.items():
+            # a worker that outlived the join deadline is a failure, not a
+            # silent omission — all-or-nothing means ALL accounted for
+            if t.is_alive() and wid not in results and wid not in errors:
+                errors[wid] = WorkerExecError(f"worker {wid} still running after "
+                                              f"{timeout_s + 5:.0f}s deadline")
+        if errors:
+            detail = "; ".join(f"w{i}: {e}" for i, e in sorted(errors.items()))
+            raise WorkerExecError(
+                f"gang command failed on {len(errors)}/{len(qr.workers)} workers: {detail}")
+        return results
+
+    def logs(self, qr: QueuedResource, worker_id: Optional[int] = None,
+             tail_lines: Optional[int] = None) -> str:
+        """One worker's logs, or all workers' logs with [worker N] prefixes."""
+        if worker_id is not None:
+            return self.transport.logs(qr, worker_id, tail_lines)
+        chunks = []
+        for w in qr.workers:
+            try:
+                body = self.transport.logs(qr, w.worker_id, tail_lines)
+            except Exception as e:  # noqa: BLE001
+                body = f"<logs unavailable: {e}>\n"
+            chunks.append(f"==== worker {w.worker_id} ({w.hostname}) ====\n{body}")
+        return "".join(chunks)

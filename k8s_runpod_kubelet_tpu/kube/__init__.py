@@ -1,0 +1,17 @@
+"""Minimal Kubernetes API layer.
+
+The reference gets client-go + informers for free; the `kubernetes` Python package
+is not available in this image, so this is a from-scratch, stdlib-only client:
+
+- ``objects``: helpers over plain-dict K8s objects (pods/nodes/leases/events).
+- ``client``:  KubeClient protocol + RealKubeClient (in-cluster or kubeconfig,
+  JSON over HTTP, streaming watch).
+- ``fake``:    FakeKubeClient — in-memory API server double with resourceVersions,
+  watch streams and field selectors, the analog of client-go's
+  fake.NewSimpleClientset used by the reference's tests (annotations_test.go:38).
+"""
+
+from .client import KubeApiError, KubeClient, RealKubeClient, WatchEvent
+from .fake import FakeKubeClient
+
+__all__ = ["KubeApiError", "KubeClient", "RealKubeClient", "WatchEvent", "FakeKubeClient"]

@@ -1,0 +1,294 @@
+"""Kubernetes API client protocol + real HTTP implementation.
+
+Replaces client-go for the slice of the API the kubelet needs. The method set is
+exactly what the reference's provider calls through client-go (SURVEY.md §2 rows
+5-9,11: pods CRUD + status patch, secrets/jobs reads, node + lease writes, events)
+plus streaming watch for the L3' pod controller.
+
+Auth mirrors the reference's createK8sClient (main.go:464-494): in-cluster service
+account if present, else kubeconfig.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import json
+import logging
+import os
+import ssl
+import threading
+import urllib.parse
+from typing import Iterator, Optional
+
+log = logging.getLogger(__name__)
+
+LEASE_NAMESPACE = "kube-node-lease"
+
+
+class KubeApiError(Exception):
+    def __init__(self, message: str, status: int = 0):
+        super().__init__(message)
+        self.status = status
+
+    @property
+    def is_not_found(self) -> bool:
+        return self.status == 404
+
+    @property
+    def is_conflict(self) -> bool:
+        return self.status == 409
+
+
+@dataclasses.dataclass
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED | BOOKMARK | ERROR
+    object: dict
+
+
+class KubeClient:
+    """Protocol implemented by RealKubeClient and FakeKubeClient."""
+
+    # pods
+    def get_pod(self, ns: str, name: str) -> dict: raise NotImplementedError
+    def list_pods(self, ns: Optional[str] = None, field_selector: str = "",
+                  label_selector: str = "") -> list[dict]: raise NotImplementedError
+    def create_pod(self, pod: dict) -> dict: raise NotImplementedError
+    def update_pod(self, pod: dict) -> dict: raise NotImplementedError
+    def patch_pod(self, ns: str, name: str, patch: dict) -> dict: raise NotImplementedError
+    def patch_pod_status(self, ns: str, name: str, patch: dict) -> dict: raise NotImplementedError
+    def delete_pod(self, ns: str, name: str,
+                   grace_period_s: Optional[int] = None) -> None: raise NotImplementedError
+    def watch_pods(self, field_selector: str = "", label_selector: str = "",
+                   stop: Optional[threading.Event] = None) -> Iterator[WatchEvent]:
+        raise NotImplementedError
+
+    # reads the spec translator needs
+    def get_secret(self, ns: str, name: str) -> dict: raise NotImplementedError
+    def get_job(self, ns: str, name: str) -> dict: raise NotImplementedError
+
+    # node + lease (L3')
+    def get_node(self, name: str) -> dict: raise NotImplementedError
+    def create_node(self, node: dict) -> dict: raise NotImplementedError
+    def update_node(self, node: dict) -> dict: raise NotImplementedError
+    def patch_node_status(self, name: str, patch: dict) -> dict: raise NotImplementedError
+    def get_lease(self, name: str) -> dict: raise NotImplementedError
+    def create_lease(self, lease: dict) -> dict: raise NotImplementedError
+    def update_lease(self, lease: dict) -> dict: raise NotImplementedError
+
+    # events
+    def create_event(self, ns: str, event: dict) -> dict: raise NotImplementedError
+
+
+def _pod_path(ns: str, name: str = "", sub: str = "") -> str:
+    p = f"/api/v1/namespaces/{ns}/pods"
+    if name:
+        p += f"/{name}"
+    if sub:
+        p += f"/{sub}"
+    return p
+
+
+class RealKubeClient(KubeClient):
+    """JSON-over-HTTP client with streaming watch (stdlib only)."""
+
+    def __init__(self, server: str, token: str = "", ca_file: str = "",
+                 client_cert: str = "", client_key: str = "",
+                 insecure_skip_tls: bool = False, timeout_s: float = 30.0):
+        u = urllib.parse.urlparse(server)
+        self.host = u.hostname or "localhost"
+        self.port = u.port or (443 if u.scheme == "https" else 80)
+        self.tls = u.scheme == "https"
+        self.token = token
+        self.timeout_s = timeout_s
+        self.ssl_ctx: Optional[ssl.SSLContext] = None
+        if self.tls:
+            self.ssl_ctx = ssl.create_default_context(cafile=ca_file or None)
+            if client_cert:
+                self.ssl_ctx.load_cert_chain(client_cert, client_key or None)
+            if insecure_skip_tls:
+                self.ssl_ctx.check_hostname = False
+                self.ssl_ctx.verify_mode = ssl.CERT_NONE
+
+    # -- construction from environment ----------------------------------------
+
+    @classmethod
+    def from_env(cls, kubeconfig: str = "") -> "RealKubeClient":
+        """In-cluster config if the service-account mount exists, else kubeconfig
+        (parity: main.go:468-485)."""
+        sa = "/var/run/secrets/kubernetes.io/serviceaccount"
+        if not kubeconfig and os.path.exists(f"{sa}/token"):
+            host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            with open(f"{sa}/token") as f:
+                token = f.read().strip()
+            return cls(f"https://{host}:{port}", token=token, ca_file=f"{sa}/ca.crt")
+        return cls.from_kubeconfig(kubeconfig or os.path.expanduser("~/.kube/config"))
+
+    @classmethod
+    def from_kubeconfig(cls, path: str) -> "RealKubeClient":
+        import yaml
+        with open(path) as f:
+            cfg = yaml.safe_load(f)
+        ctx_name = cfg.get("current-context")
+        ctx = next(c["context"] for c in cfg["contexts"] if c["name"] == ctx_name)
+        cluster = next(c["cluster"] for c in cfg["clusters"] if c["name"] == ctx["cluster"])
+        user = next(u["user"] for u in cfg["users"] if u["name"] == ctx["user"])
+        return cls(
+            cluster["server"],
+            token=user.get("token", ""),
+            ca_file=cluster.get("certificate-authority", ""),
+            client_cert=user.get("client-certificate", ""),
+            client_key=user.get("client-key", ""),
+            insecure_skip_tls=cluster.get("insecure-skip-tls-verify", False),
+        )
+
+    # -- plumbing --------------------------------------------------------------
+
+    def _conn(self, timeout_s: Optional[float] = None) -> http.client.HTTPConnection:
+        if self.tls:
+            return http.client.HTTPSConnection(self.host, self.port,
+                                               timeout=timeout_s or self.timeout_s,
+                                               context=self.ssl_ctx)
+        return http.client.HTTPConnection(self.host, self.port,
+                                          timeout=timeout_s or self.timeout_s)
+
+    def _headers(self, content_type: str = "application/json") -> dict:
+        h = {"Accept": "application/json", "Content-Type": content_type}
+        if self.token:
+            h["Authorization"] = f"Bearer {self.token}"
+        return h
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None,
+                 content_type: str = "application/json") -> dict:
+        conn = self._conn()
+        try:
+            conn.request(method, path,
+                         body=json.dumps(body) if body is not None else None,
+                         headers=self._headers(content_type))
+            resp = conn.getresponse()
+            raw = resp.read()
+            if resp.status >= 400:
+                raise KubeApiError(f"{method} {path}: HTTP {resp.status}: "
+                                   f"{raw[:300].decode(errors='replace')}",
+                                   status=resp.status)
+            return json.loads(raw) if raw else {}
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _selector_query(field_selector: str, label_selector: str, extra: str = "") -> str:
+        parts = []
+        if field_selector:
+            parts.append("fieldSelector=" + urllib.parse.quote(field_selector))
+        if label_selector:
+            parts.append("labelSelector=" + urllib.parse.quote(label_selector))
+        if extra:
+            parts.append(extra)
+        return ("?" + "&".join(parts)) if parts else ""
+
+    # -- pods ------------------------------------------------------------------
+
+    def get_pod(self, ns, name):
+        return self._request("GET", _pod_path(ns, name))
+
+    def list_pods(self, ns=None, field_selector="", label_selector=""):
+        base = _pod_path(ns) if ns else "/api/v1/pods"
+        q = self._selector_query(field_selector, label_selector)
+        return self._request("GET", base + q).get("items", [])
+
+    def create_pod(self, pod):
+        ns = pod["metadata"].get("namespace", "default")
+        return self._request("POST", _pod_path(ns), pod)
+
+    def update_pod(self, pod):
+        m = pod["metadata"]
+        return self._request("PUT", _pod_path(m.get("namespace", "default"), m["name"]), pod)
+
+    def patch_pod(self, ns, name, patch):
+        return self._request("PATCH", _pod_path(ns, name), patch,
+                             content_type="application/merge-patch+json")
+
+    def patch_pod_status(self, ns, name, patch):
+        return self._request("PATCH", _pod_path(ns, name, "status"), patch,
+                             content_type="application/merge-patch+json")
+
+    def delete_pod(self, ns, name, grace_period_s=None):
+        body = None
+        if grace_period_s is not None:
+            body = {"gracePeriodSeconds": grace_period_s}
+        try:
+            self._request("DELETE", _pod_path(ns, name), body)
+        except KubeApiError as e:
+            if not e.is_not_found:
+                raise
+
+    def watch_pods(self, field_selector="", label_selector="", stop=None):
+        """Streaming watch; reconnects are the caller's job (node/pod_controller
+        wraps this in a resync loop). Yields WatchEvents until the stream or
+        ``stop`` ends."""
+        q = self._selector_query(field_selector, label_selector,
+                                 extra="watch=true&allowWatchBookmarks=true")
+        conn = self._conn(timeout_s=330)  # server closes watches ~5min; outlive it
+        try:
+            conn.request("GET", "/api/v1/pods" + q, headers=self._headers())
+            resp = conn.getresponse()
+            if resp.status >= 400:
+                raise KubeApiError(f"watch pods: HTTP {resp.status}", status=resp.status)
+            buf = b""
+            while not (stop and stop.is_set()):
+                chunk = resp.read1(65536)
+                if not chunk:
+                    return
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    if not line.strip():
+                        continue
+                    ev = json.loads(line)
+                    yield WatchEvent(type=ev.get("type", "ERROR"),
+                                     object=ev.get("object", {}))
+        finally:
+            conn.close()
+
+    # -- secrets / jobs --------------------------------------------------------
+
+    def get_secret(self, ns, name):
+        return self._request("GET", f"/api/v1/namespaces/{ns}/secrets/{name}")
+
+    def get_job(self, ns, name):
+        return self._request("GET", f"/apis/batch/v1/namespaces/{ns}/jobs/{name}")
+
+    # -- nodes / leases --------------------------------------------------------
+
+    def get_node(self, name):
+        return self._request("GET", f"/api/v1/nodes/{name}")
+
+    def create_node(self, node):
+        return self._request("POST", "/api/v1/nodes", node)
+
+    def update_node(self, node):
+        return self._request("PUT", f"/api/v1/nodes/{node['metadata']['name']}", node)
+
+    def patch_node_status(self, name, patch):
+        return self._request("PATCH", f"/api/v1/nodes/{name}/status", patch,
+                             content_type="application/merge-patch+json")
+
+    def get_lease(self, name):
+        return self._request(
+            "GET", f"/apis/coordination.k8s.io/v1/namespaces/{LEASE_NAMESPACE}/leases/{name}")
+
+    def create_lease(self, lease):
+        return self._request(
+            "POST", f"/apis/coordination.k8s.io/v1/namespaces/{LEASE_NAMESPACE}/leases", lease)
+
+    def update_lease(self, lease):
+        name = lease["metadata"]["name"]
+        return self._request(
+            "PUT", f"/apis/coordination.k8s.io/v1/namespaces/{LEASE_NAMESPACE}/leases/{name}",
+            lease)
+
+    # -- events ----------------------------------------------------------------
+
+    def create_event(self, ns, event):
+        return self._request("POST", f"/api/v1/namespaces/{ns}/events", event)

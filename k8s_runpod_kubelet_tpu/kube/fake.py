@@ -1,0 +1,257 @@
+"""In-memory fake Kubernetes API for hermetic tests.
+
+Analog of client-go's fake.NewSimpleClientset (used by the reference's tests,
+annotations_test.go:38) — but with watch streams and graceful-deletion semantics
+so the L3' controllers and the full reconcile loop can run against it, which the
+reference never achieved hermetically (SURVEY.md §4).
+
+Graceful delete mimics the API server: DELETE with grace>0 (or default) sets
+deletionTimestamp and emits MODIFIED — the object stays until a grace-0 delete
+(what ForceDeletePod issues) actually removes it and emits DELETED.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import uuid
+from typing import Iterator, Optional
+
+from .client import KubeApiError, KubeClient, WatchEvent
+from . import objects as ko
+
+
+class _Watcher:
+    def __init__(self, field_selector: str, label_selector: str,
+                 stop: Optional[threading.Event]):
+        self.q: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
+        self.field_selector = field_selector
+        self.label_selector = label_selector
+        self.stop = stop or threading.Event()
+
+
+class FakeKubeClient(KubeClient):
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.store: dict[tuple[str, str, str], dict] = {}
+        self.events: list[dict] = []
+        self._rv = 0
+        self._watchers: list[_Watcher] = []
+        # fault injection
+        self.fail_next: dict[str, KubeApiError] = {}  # op name -> error (one-shot)
+
+    # -- internals -------------------------------------------------------------
+
+    def _maybe_fail(self, op: str):
+        err = self.fail_next.pop(op, None)
+        if err:
+            raise err
+
+    def _bump(self, obj: dict) -> dict:
+        self._rv += 1
+        ko.meta(obj)["resourceVersion"] = str(self._rv)
+        return obj
+
+    def _key(self, kind: str, obj: dict) -> tuple[str, str, str]:
+        return (kind, ko.namespace(obj), ko.name(obj))
+
+    def _get(self, kind: str, ns: str, name: str) -> dict:
+        try:
+            return self.store[(kind, ns, name)]
+        except KeyError:
+            raise KubeApiError(f"{kind} {ns}/{name} not found", status=404) from None
+
+    def _create(self, kind: str, obj: dict) -> dict:
+        key = self._key(kind, obj)
+        if key in self.store:
+            raise KubeApiError(f"{kind} {key[1]}/{key[2]} already exists", status=409)
+        m = ko.meta(obj)
+        m.setdefault("uid", str(uuid.uuid4()))
+        m.setdefault("namespace", key[1])
+        m.setdefault("creationTimestamp", ko.now_iso())
+        self._bump(obj)
+        self.store[key] = obj
+        return ko.deep_copy(obj)
+
+    def _notify(self, ev_type: str, pod: dict):
+        snapshot = ko.deep_copy(pod)
+        for w in list(self._watchers):
+            if w.stop.is_set():
+                self._watchers.remove(w)
+                continue
+            if (ko.match_field_selector(snapshot, w.field_selector)
+                    and ko.match_label_selector(snapshot, w.label_selector)):
+                w.q.put(WatchEvent(type=ev_type, object=ko.deep_copy(snapshot)))
+
+    # -- pods ------------------------------------------------------------------
+
+    def get_pod(self, ns, name):
+        with self.lock:
+            self._maybe_fail("get_pod")
+            return ko.deep_copy(self._get("pods", ns, name))
+
+    def list_pods(self, ns=None, field_selector="", label_selector=""):
+        with self.lock:
+            self._maybe_fail("list_pods")
+            out = []
+            for (kind, ons, _), obj in self.store.items():
+                if kind != "pods" or (ns and ons != ns):
+                    continue
+                if (ko.match_field_selector(obj, field_selector)
+                        and ko.match_label_selector(obj, label_selector)):
+                    out.append(ko.deep_copy(obj))
+            return out
+
+    def create_pod(self, pod):
+        with self.lock:
+            self._maybe_fail("create_pod")
+            created = self._create("pods", pod)
+            self._notify("ADDED", created)
+            return created
+
+    def update_pod(self, pod):
+        with self.lock:
+            self._maybe_fail("update_pod")
+            key = self._key("pods", pod)
+            if key not in self.store:
+                raise KubeApiError(f"pod {key[1]}/{key[2]} not found", status=404)
+            self._bump(pod)
+            self.store[key] = ko.deep_copy(pod)
+            self._notify("MODIFIED", pod)
+            return ko.deep_copy(pod)
+
+    def patch_pod(self, ns, name, patch):
+        with self.lock:
+            self._maybe_fail("patch_pod")
+            obj = self._get("pods", ns, name)
+            ko.merge_patch(obj, patch)
+            self._bump(obj)
+            self._notify("MODIFIED", obj)
+            return ko.deep_copy(obj)
+
+    def patch_pod_status(self, ns, name, patch):
+        with self.lock:
+            self._maybe_fail("patch_pod_status")
+            obj = self._get("pods", ns, name)
+            ko.merge_patch(obj.setdefault("status", {}), patch.get("status", patch))
+            self._bump(obj)
+            self._notify("MODIFIED", obj)
+            return ko.deep_copy(obj)
+
+    def delete_pod(self, ns, name, grace_period_s=None):
+        with self.lock:
+            self._maybe_fail("delete_pod")
+            try:
+                obj = self._get("pods", ns, name)
+            except KubeApiError:
+                return
+            if grace_period_s == 0:
+                del self.store[("pods", ns, name)]
+                self._notify("DELETED", obj)
+            else:
+                ko.meta(obj)["deletionTimestamp"] = ko.now_iso()
+                ko.meta(obj)["deletionGracePeriodSeconds"] = grace_period_s or 30
+                self._bump(obj)
+                self._notify("MODIFIED", obj)
+
+    def watch_pods(self, field_selector="", label_selector="", stop=None
+                   ) -> Iterator[WatchEvent]:
+        w = _Watcher(field_selector, label_selector, stop)
+        with self.lock:
+            # initial ADDED burst, like a fresh watch with resourceVersion=0
+            for (kind, _, _), obj in self.store.items():
+                if kind == "pods" and ko.match_field_selector(obj, field_selector) \
+                        and ko.match_label_selector(obj, label_selector):
+                    w.q.put(WatchEvent(type="ADDED", object=ko.deep_copy(obj)))
+            self._watchers.append(w)
+
+        def gen():
+            while not w.stop.is_set():
+                try:
+                    ev = w.q.get(timeout=0.05)
+                except queue.Empty:
+                    continue
+                if ev is None:
+                    return
+                yield ev
+        return gen()
+
+    # -- secrets / jobs --------------------------------------------------------
+
+    def add_secret(self, ns: str, name: str, data: dict[str, str]):
+        """Test helper; ``data`` values are plain strings (stored base64 like K8s)."""
+        import base64
+        enc = {k: base64.b64encode(v.encode()).decode() for k, v in data.items()}
+        with self.lock:
+            self.store[("secrets", ns, name)] = {
+                "metadata": {"name": name, "namespace": ns}, "data": enc}
+
+    def get_secret(self, ns, name):
+        with self.lock:
+            self._maybe_fail("get_secret")
+            return ko.deep_copy(self._get("secrets", ns, name))
+
+    def add_job(self, job: dict):
+        with self.lock:
+            self._create("jobs", job)
+
+    def get_job(self, ns, name):
+        with self.lock:
+            self._maybe_fail("get_job")
+            return ko.deep_copy(self._get("jobs", ns, name))
+
+    # -- nodes / leases --------------------------------------------------------
+
+    def get_node(self, name):
+        with self.lock:
+            self._maybe_fail("get_node")
+            return ko.deep_copy(self._get("nodes", "", name))
+
+    def create_node(self, node):
+        with self.lock:
+            self._maybe_fail("create_node")
+            ko.meta(node)["namespace"] = ""
+            return self._create("nodes", node)
+
+    def update_node(self, node):
+        with self.lock:
+            self._maybe_fail("update_node")
+            key = ("nodes", "", ko.name(node))
+            if key not in self.store:
+                raise KubeApiError(f"node {ko.name(node)} not found", status=404)
+            self._bump(node)
+            self.store[key] = ko.deep_copy(node)
+            return ko.deep_copy(node)
+
+    def patch_node_status(self, name, patch):
+        with self.lock:
+            self._maybe_fail("patch_node_status")
+            obj = self._get("nodes", "", name)
+            ko.merge_patch(obj.setdefault("status", {}), patch.get("status", patch))
+            self._bump(obj)
+            return ko.deep_copy(obj)
+
+    def get_lease(self, name):
+        with self.lock:
+            return ko.deep_copy(self._get("leases", "kube-node-lease", name))
+
+    def create_lease(self, lease):
+        with self.lock:
+            ko.meta(lease)["namespace"] = "kube-node-lease"
+            return self._create("leases", lease)
+
+    def update_lease(self, lease):
+        with self.lock:
+            key = ("leases", "kube-node-lease", ko.name(lease))
+            self._bump(lease)
+            self.store[key] = ko.deep_copy(lease)
+            return ko.deep_copy(lease)
+
+    # -- events ----------------------------------------------------------------
+
+    def create_event(self, ns, event):
+        with self.lock:
+            event.setdefault("metadata", {}).setdefault("namespace", ns)
+            self.events.append(ko.deep_copy(event))
+            return event

@@ -1,0 +1,144 @@
+"""Helpers over plain-dict Kubernetes objects.
+
+We deliberately model K8s objects as the JSON dicts the API serves (the Python
+idiom for untyped clients), with accessor helpers instead of a generated type
+tree. Field paths mirror what the reference touches via client-go typed structs.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from typing import Any, Optional
+
+
+def meta(obj: dict) -> dict:
+    return obj.setdefault("metadata", {})
+
+
+def name(obj: dict) -> str:
+    return meta(obj).get("name", "")
+
+
+def namespace(obj: dict) -> str:
+    return meta(obj).get("namespace", "default")
+
+
+def uid(obj: dict) -> str:
+    return meta(obj).get("uid", "")
+
+
+def namespaced_name(obj: dict) -> str:
+    return f"{namespace(obj)}/{name(obj)}"
+
+
+def annotations(obj: dict) -> dict[str, str]:
+    return meta(obj).setdefault("annotations", {})
+
+
+def labels(obj: dict) -> dict[str, str]:
+    return meta(obj).setdefault("labels", {})
+
+
+def owner_references(obj: dict) -> list[dict]:
+    return meta(obj).get("ownerReferences", [])
+
+
+def node_name(pod: dict) -> str:
+    return pod.get("spec", {}).get("nodeName", "")
+
+
+def containers(pod: dict) -> list[dict]:
+    return pod.get("spec", {}).get("containers", [])
+
+
+def phase(pod: dict) -> str:
+    return pod.get("status", {}).get("phase", "")
+
+
+def deletion_timestamp(obj: dict) -> Optional[str]:
+    return meta(obj).get("deletionTimestamp")
+
+
+def is_terminal(pod: dict) -> bool:
+    return phase(pod) in ("Succeeded", "Failed")
+
+
+def now_iso(ts: Optional[float] = None) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(ts if ts is not None else time.time()))
+
+
+def deep_copy(obj: dict) -> dict:
+    return copy.deepcopy(obj)
+
+
+def tpu_chips_requested(pod: dict) -> int:
+    """Sum of ``google.com/tpu`` limits across containers.
+
+    The reference never reads the pod's nvidia.com/gpu request at deploy time
+    (SURVEY.md §2.4 'multi-host orchestration' row) — this fixes that: the chip
+    count drives slice selection.
+    """
+    total = 0
+    for c in containers(pod):
+        res = c.get("resources", {})
+        for src in ("limits", "requests"):
+            v = res.get(src, {}).get("google.com/tpu")
+            if v is not None:
+                total += int(str(v))
+                break
+    return total
+
+
+def merge_patch(obj: dict, patch: dict) -> dict:
+    """RFC 7386 JSON merge patch, applied in place (None deletes a key)."""
+    for k, v in patch.items():
+        if v is None:
+            obj.pop(k, None)
+        elif isinstance(v, dict) and isinstance(obj.get(k), dict):
+            merge_patch(obj[k], v)
+        else:
+            obj[k] = copy.deepcopy(v)
+    return obj
+
+
+def match_field_selector(obj: dict, selector: str) -> bool:
+    """Supports the subset the kubelet uses: ``spec.nodeName=X`` and
+    ``metadata.name=X`` / ``metadata.namespace=X``, comma-separated, with ``!=``.
+    (Parity: the reference scopes its pod informer with a spec.nodeName field
+    selector, main.go:153.)"""
+    if not selector:
+        return True
+    for clause in selector.split(","):
+        if "!=" in clause:
+            path, want = clause.split("!=", 1)
+            negate = True
+        else:
+            path, want = clause.split("=", 1)
+            negate = False
+        cur: Any = obj
+        for part in path.strip().split("."):
+            cur = cur.get(part, {}) if isinstance(cur, dict) else None
+        got = cur if isinstance(cur, str) else ""
+        if negate == (got == want):
+            return False
+    return True
+
+
+def match_label_selector(obj: dict, selector: str) -> bool:
+    if not selector:
+        return True
+    lbls = meta(obj).get("labels", {})
+    for clause in selector.split(","):
+        if "!=" in clause:
+            k, v = clause.split("!=", 1)
+            if lbls.get(k.strip()) == v.strip():
+                return False
+        elif "=" in clause:
+            k, v = clause.split("=", 1)
+            if lbls.get(k.strip()) != v.strip():
+                return False
+        else:
+            if clause.strip() not in lbls:
+                return False
+    return True

@@ -1,0 +1,129 @@
+"""Prometheus-style metrics registry (counters, gauges, histograms).
+
+The reference has NO metrics (SURVEY.md §5.5: GetStatsSummary/GetMetricsResource
+left nil). This build makes the north-star metric first-class: the
+schedule->first-step latency is recorded as a histogram per pod, alongside
+deploy/reconcile timings and slice-state gauges, served as Prometheus text on
+the health server's /metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+_DEFAULT_BUCKETS = (0.5, 1, 2.5, 5, 10, 30, 60, 120, 300, 600, 1800)
+
+
+class _Hist:
+    """Fixed-size cumulative buckets + sum/count, plus a bounded tail of raw
+    observations for tests/debugging — memory stays O(buckets) for a process
+    meant to run for months."""
+
+    __slots__ = ("bucket_counts", "sum", "count", "recent")
+
+    def __init__(self):
+        self.bucket_counts = [0] * len(_DEFAULT_BUCKETS)
+        self.sum = 0.0
+        self.count = 0
+        self.recent: list[float] = []
+
+    def observe(self, value: float):
+        for i, b in enumerate(_DEFAULT_BUCKETS):
+            if value <= b:
+                self.bucket_counts[i] += 1
+        self.sum += value
+        self.count += 1
+        self.recent.append(value)
+        if len(self.recent) > 1000:
+            del self.recent[:500]
+
+
+class Metrics:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.counters: dict[tuple[str, tuple], float] = {}
+        self.gauges: dict[tuple[str, tuple], float] = {}
+        self.histograms: dict[tuple[str, tuple], _Hist] = {}
+        self.help: dict[str, str] = {}
+
+    @staticmethod
+    def _key(name: str, labels: Optional[dict]) -> tuple[str, tuple]:
+        return name, tuple(sorted((labels or {}).items()))
+
+    def describe(self, name: str, help_text: str):
+        self.help[name] = help_text
+
+    def incr(self, name: str, value: float = 1.0, labels: Optional[dict] = None):
+        k = self._key(name, labels)
+        with self.lock:
+            self.counters[k] = self.counters.get(k, 0.0) + value
+
+    def set_gauge(self, name: str, value: float, labels: Optional[dict] = None):
+        with self.lock:
+            self.gauges[self._key(name, labels)] = value
+
+    def observe(self, name: str, value: float, labels: Optional[dict] = None):
+        with self.lock:
+            self.histograms.setdefault(self._key(name, labels), _Hist()).observe(value)
+
+    def time_block(self, name: str, labels: Optional[dict] = None):
+        return _Timer(self, name, labels)
+
+    def get_counter(self, name: str, labels: Optional[dict] = None) -> float:
+        return self.counters.get(self._key(name, labels), 0.0)
+
+    def get_observations(self, name: str, labels: Optional[dict] = None) -> list[float]:
+        """Most recent raw observations (bounded tail; for tests/debugging)."""
+        h = self.histograms.get(self._key(name, labels))
+        return list(h.recent) if h else []
+
+    # -- exposition ------------------------------------------------------------
+
+    @staticmethod
+    def _labels_str(labels: tuple) -> str:
+        if not labels:
+            return ""
+        inner = ",".join(f'{k}="{v}"' for k, v in labels)
+        return "{" + inner + "}"
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        out: list[str] = []
+        with self.lock:
+            names = sorted({n for n, _ in (*self.counters, *self.gauges, *self.histograms)})
+            for name in names:
+                if name in self.help:
+                    out.append(f"# HELP {name} {self.help[name]}")
+                for (n, lbls), v in sorted(self.counters.items()):
+                    if n == name:
+                        out.append(f"{name}_total{self._labels_str(lbls)} {v}")
+                for (n, lbls), v in sorted(self.gauges.items()):
+                    if n == name:
+                        out.append(f"{name}{self._labels_str(lbls)} {v}")
+                for (n, lbls), h in sorted(self.histograms.items()):
+                    if n != name:
+                        continue
+                    for b, c in zip(_DEFAULT_BUCKETS, h.bucket_counts):
+                        lb = dict(lbls)
+                        lb["le"] = str(b)
+                        out.append(f"{name}_bucket{self._labels_str(tuple(sorted(lb.items())))} {c}")
+                    lb = dict(lbls)
+                    lb["le"] = "+Inf"
+                    out.append(f"{name}_bucket{self._labels_str(tuple(sorted(lb.items())))} {h.count}")
+                    out.append(f"{name}_sum{self._labels_str(lbls)} {h.sum}")
+                    out.append(f"{name}_count{self._labels_str(lbls)} {h.count}")
+        return "\n".join(out) + "\n"
+
+
+class _Timer:
+    def __init__(self, m: Metrics, name: str, labels: Optional[dict]):
+        self.m, self.name, self.labels = m, name, labels
+
+    def __enter__(self):
+        self.t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        self.m.observe(self.name, time.monotonic() - self.t0, self.labels)

@@ -1,0 +1,22 @@
+"""L3': node + pod controllers and the kubelet HTTP API.
+
+The reference imports this whole layer from the external virtual-kubelet library
+(go.mod:53; node.NewPodController / node.NewNodeController / api.AttachPodRoutes,
+main.go:167-248). That library does not exist for us, so this package
+re-implements the reconciliation machinery from scratch (SURVEY.md §1 L3,
+§7.4 hard-part #2):
+
+- ``node_controller``: registers the virtual Node, renews its coordination lease,
+  pushes node status.
+- ``pod_controller``: watches pods field-scoped to our node and dispatches
+  lifecycle calls to the provider, with a periodic list-based resync.
+- ``api_server``: kubelet API on :10250 — and unlike the reference (which stubs
+  exec/logs, main.go:220-225), logs and exec are real, backed by per-worker
+  transports (SURVEY.md §5.8).
+"""
+
+from .node_controller import NodeController
+from .pod_controller import PodController
+from .api_server import KubeletApiServer
+
+__all__ = ["NodeController", "PodController", "KubeletApiServer"]

@@ -1,0 +1,127 @@
+"""Kubelet HTTP API (:10250): pods, container logs, command exec.
+
+Analog of api.AttachPodRoutes (main.go:217-248) — but where the reference stubs
+logs/exec ("not supported", main.go:220-225, kubelet.go:2027-2066), ours are real:
+they fan out to the slice's workers through the provider's gang executor
+(SURVEY.md §5.8 "our build should implement real GetContainerLogs/RunInContainer").
+
+Endpoints (kubelet-API shaped):
+  GET  /pods                                        -> v1.PodList of tracked pods
+  GET  /containerLogs/{ns}/{pod}/{container}        -> text logs (?tailLines=N,
+                                                       ?worker=I for one worker)
+  POST /run/{ns}/{pod}/{container}                  -> {"cmd": [...]} run on
+                                                       worker 0 (?worker=I), returns
+                                                       output (old-kubelet /run shape;
+                                                       SPDY streaming exec is out of
+                                                       scope for a virtual node)
+  GET  /healthz                                     -> "ok"
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+log = logging.getLogger(__name__)
+
+_LOGS_RE = re.compile(r"^/containerLogs/(?P<ns>[^/]+)/(?P<pod>[^/]+)/(?P<container>[^/]+)$")
+_RUN_RE = re.compile(r"^/run/(?P<ns>[^/]+)/(?P<pod>[^/]+)/(?P<container>[^/]+)$")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    provider = None  # bound by server factory
+
+    def log_message(self, *a):
+        pass
+
+    def _send(self, status: int, body: bytes, ctype: str = "text/plain"):
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        url = urlparse(self.path)
+        q = parse_qs(url.query)
+        if url.path == "/healthz":
+            return self._send(200, b"ok")
+        if url.path == "/pods":
+            pods = self.provider.get_pods()
+            body = json.dumps({"kind": "PodList", "apiVersion": "v1",
+                               "items": pods}).encode()
+            return self._send(200, body, "application/json")
+        m = _LOGS_RE.match(url.path)
+        if m:
+            try:
+                tail = int(q.get("tailLines", ["0"])[0]) or None
+                worker = q.get("worker", [None])[0]
+                worker = int(worker) if worker is not None else None
+            except ValueError as e:
+                return self._send(400, f"bad query parameter: {e}".encode())
+            try:
+                logs = self.provider.get_container_logs(
+                    m["ns"], m["pod"], m["container"], tail_lines=tail,
+                    worker=worker)
+            except KeyError:
+                return self._send(404, b"pod not found")
+            except Exception as e:  # noqa: BLE001
+                return self._send(500, f"logs failed: {e}".encode())
+            return self._send(200, logs.encode())
+        self._send(404, f"no route {url.path}".encode())
+
+    def do_POST(self):
+        url = urlparse(self.path)
+        q = parse_qs(url.query)
+        m = _RUN_RE.match(url.path)
+        if not m:
+            return self._send(404, f"no route {url.path}".encode())
+        length = int(self.headers.get("Content-Length") or 0)
+        try:
+            body = json.loads(self.rfile.read(length)) if length else {}
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+        except (json.JSONDecodeError, ValueError) as e:
+            return self._send(400, f"bad request body: {e}".encode())
+        cmd = body.get("cmd") or q.get("cmd", [])
+        if isinstance(cmd, str):
+            cmd = cmd.split()
+        try:
+            worker = int(q.get("worker", ["0"])[0])
+        except ValueError as e:
+            return self._send(400, f"bad query parameter: {e}".encode())
+        try:
+            out = self.provider.run_in_container(m["ns"], m["pod"], m["container"],
+                                                 cmd, worker=worker)
+        except KeyError:
+            return self._send(404, b"pod not found")
+        except NotImplementedError as e:
+            return self._send(501, str(e).encode())
+        except Exception as e:  # noqa: BLE001 — exec failure must not kill the handler
+            return self._send(500, f"exec failed: {e}".encode())
+        self._send(200, out.encode() if isinstance(out, str) else out)
+
+
+class KubeletApiServer:
+    def __init__(self, provider, address: str = "0.0.0.0", port: int = 10250):
+        handler = type("BoundHandler", (_Handler,), {"provider": provider})
+        self._httpd = ThreadingHTTPServer((address, port), handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="kubelet-api", daemon=True)
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "KubeletApiServer":
+        self._thread.start()
+        log.info("kubelet API listening on :%d", self.port)
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()

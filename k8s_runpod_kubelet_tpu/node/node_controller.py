@@ -1,0 +1,141 @@
+"""Node registration, lease heartbeat, and status push.
+
+Re-implements what the reference gets from node.NewNodeController
+(main.go:196-211): create-or-adopt the Node object, renew a coordination lease
+(kube-node-lease) so the cluster sees the kubelet as alive, and push node status
+on an interval and on demand (NotifyNodeStatus analog, kubelet.go:1079-1095).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Optional
+
+from ..kube.client import KubeApiError, KubeClient
+from ..kube import objects as ko
+
+log = logging.getLogger(__name__)
+
+DEFAULT_LEASE_DURATION_S = 40
+DEFAULT_STATUS_INTERVAL_S = 30.0
+
+
+class NodeController:
+    """Owns the virtual Node object's lifecycle.
+
+    ``node_provider`` must expose:
+      get_node() -> dict            full v1.Node (spec+status)
+      ping() -> bool                cloud reachability (kubelet.go:1070-1076)
+      set_status_listener(cb)       async "push node status now" callback
+    """
+
+    def __init__(self, kube: KubeClient, node_provider, *,
+                 status_interval_s: float = DEFAULT_STATUS_INTERVAL_S,
+                 lease_duration_s: int = DEFAULT_LEASE_DURATION_S):
+        self.kube = kube
+        self.node_provider = node_provider
+        self.status_interval_s = status_interval_s
+        self.lease_duration_s = lease_duration_s
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self.ready = threading.Event()
+
+    @property
+    def node_name(self) -> str:
+        return ko.name(self.node_provider.get_node())
+
+    # -- one-shot operations (also used directly by tests) ---------------------
+
+    def register_node(self) -> dict:
+        """Create the Node, or adopt+update it if it already exists."""
+        node = self.node_provider.get_node()
+        try:
+            created = self.kube.create_node(node)
+            log.info("registered virtual node %s", ko.name(node))
+            return created
+        except KubeApiError as e:
+            if not e.is_conflict:
+                raise
+            existing = self.kube.get_node(ko.name(node))
+            node["metadata"]["resourceVersion"] = existing["metadata"].get("resourceVersion")
+            updated = self.kube.update_node(node)
+            log.info("adopted existing virtual node %s", ko.name(node))
+            return updated
+
+    def push_status(self):
+        node = self.node_provider.get_node()
+        if not self.node_provider.ping():
+            for cond in node.get("status", {}).get("conditions", []):
+                if cond.get("type") == "Ready":
+                    cond["status"] = "False"
+                    cond["reason"] = "CloudAPIUnreachable"
+                    cond["message"] = "TPU API health check failing"
+        self.kube.patch_node_status(ko.name(node), {"status": node.get("status", {})})
+
+    def renew_lease(self):
+        """Coordination-lease heartbeat — the liveness signal node controllers in
+        the cluster watch. Create on first renew, then bump renewTime."""
+        import datetime
+        name = self.node_name
+        # metav1.MicroTime: fractional seconds BEFORE the zone designator
+        now_micro = datetime.datetime.now(datetime.timezone.utc).strftime(
+            "%Y-%m-%dT%H:%M:%S.%fZ")
+        lease_spec = {
+            "holderIdentity": name,
+            "leaseDurationSeconds": self.lease_duration_s,
+            "renewTime": now_micro,
+        }
+        try:
+            lease = self.kube.get_lease(name)
+            lease["spec"].update(lease_spec)
+            self.kube.update_lease(lease)
+        except KubeApiError as e:
+            if not e.is_not_found:
+                raise
+            self.kube.create_lease({
+                "metadata": {"name": name, "namespace": "kube-node-lease"},
+                "spec": {**lease_spec, "acquireTime": lease_spec["renewTime"]},
+            })
+
+    # -- run loops -------------------------------------------------------------
+
+    def start(self):
+        self.register_node()
+        self.push_status()
+        self.renew_lease()
+        self.node_provider.set_status_listener(self._on_notify)
+        self.ready.set()
+        self._threads = [
+            threading.Thread(target=self._status_loop, name="node-status", daemon=True),
+            threading.Thread(target=self._lease_loop, name="node-lease", daemon=True),
+        ]
+        for t in self._threads:
+            t.start()
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+
+    def _on_notify(self, _node: Optional[dict] = None):
+        try:
+            self.push_status()
+        except KubeApiError as e:
+            log.warning("async node status push failed: %s", e)
+
+    def _status_loop(self):
+        while not self._stop.wait(self.status_interval_s):
+            try:
+                self.push_status()
+            except KubeApiError as e:
+                log.warning("node status push failed: %s", e)
+
+    def _lease_loop(self):
+        # renew at 1/4 of the lease duration, like the kubelet does
+        interval = max(1.0, self.lease_duration_s / 4.0)
+        while not self._stop.wait(interval):
+            try:
+                self.renew_lease()
+            except KubeApiError as e:
+                log.warning("lease renew failed: %s", e)

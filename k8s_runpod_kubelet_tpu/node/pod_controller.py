@@ -1,0 +1,153 @@
+"""Pod-watch controller: informer-event dispatch to the provider.
+
+Re-implements node.NewPodController (main.go:180-193): a streaming watch,
+field-scoped to ``spec.nodeName=<our node>`` exactly like the reference's scoped
+informer (main.go:153), drives provider lifecycle calls:
+
+  ADDED (unknown uid)                       -> provider.create_pod
+  MODIFIED, no deletionTimestamp            -> provider.update_pod
+  MODIFIED with deletionTimestamp           -> provider.delete_pod (graceful intent)
+  DELETED                                   -> provider.delete_pod (object gone)
+
+A periodic full-list resync repairs anything a dropped watch missed (informer
+resync analog, main.go:151). Dispatch failures are retried with capped backoff
+via an in-memory work queue rather than crashing the watch loop.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Optional
+
+from ..kube.client import KubeApiError, KubeClient
+from ..kube import objects as ko
+
+log = logging.getLogger(__name__)
+
+MAX_DISPATCH_RETRIES = 4
+
+
+class PodController:
+    def __init__(self, kube: KubeClient, provider, node_name: str, *,
+                 resync_interval_s: float = 30.0):
+        self.kube = kube
+        self.provider = provider
+        self.node_name = node_name
+        self.resync_interval_s = resync_interval_s
+        self._known: dict[str, str] = {}  # pod uid -> last seen resourceVersion
+        self._deleting: set[str] = set()  # uids we already dispatched delete for
+        self._stop = threading.Event()
+        self._queue: "queue.Queue[tuple[str, dict, int]]" = queue.Queue()
+        self._threads: list[threading.Thread] = []
+        self.ready = threading.Event()
+
+    # -- event handling (synchronous core, directly testable) ------------------
+
+    def handle_event(self, ev_type: str, pod: dict):
+        pod_uid = ko.uid(pod)
+        if ev_type == "DELETED":
+            self._known.pop(pod_uid, None)
+            if pod_uid not in self._deleting:
+                self._dispatch("delete", pod)
+            self._deleting.discard(pod_uid)
+            return
+        if ev_type not in ("ADDED", "MODIFIED"):
+            return
+        if ko.deletion_timestamp(pod):
+            if pod_uid not in self._deleting:
+                self._deleting.add(pod_uid)
+                self._dispatch("delete", pod)
+            return
+        if pod_uid not in self._known:
+            self._known[pod_uid] = ko.meta(pod).get("resourceVersion", "")
+            self._dispatch("create", pod)
+        else:
+            rv = ko.meta(pod).get("resourceVersion", "")
+            if rv != self._known[pod_uid]:
+                self._known[pod_uid] = rv
+                self._dispatch("update", pod)
+
+    def resync(self):
+        """List-based repair: dispatch creates for unseen pods, deletes for pods
+        the API no longer has but the provider still tracks."""
+        pods = self.kube.list_pods(field_selector=f"spec.nodeName={self.node_name}")
+        seen = set()
+        for pod in pods:
+            seen.add(ko.uid(pod))
+            if ko.deletion_timestamp(pod):
+                self.handle_event("MODIFIED", pod)
+            elif ko.uid(pod) not in self._known:
+                self.handle_event("ADDED", pod)
+        for tracked in self.provider.get_pods():
+            if ko.uid(tracked) not in seen and not ko.is_terminal(tracked):
+                self.handle_event("DELETED", tracked)
+
+    def _dispatch(self, op: str, pod: dict, attempt: int = 1):
+        try:
+            if op == "create":
+                self.provider.create_pod(pod)
+            elif op == "update":
+                self.provider.update_pod(pod)
+            elif op == "delete":
+                self.provider.delete_pod(pod)
+        except Exception as e:  # noqa: BLE001 — a bad pod must not kill the loop
+            if attempt >= MAX_DISPATCH_RETRIES:
+                log.error("dispatch %s %s failed permanently: %s",
+                          op, ko.namespaced_name(pod), e)
+                return
+            log.warning("dispatch %s %s failed (attempt %d): %s — requeueing",
+                        op, ko.namespaced_name(pod), attempt, e)
+            self._queue.put((op, pod, attempt + 1))
+
+    # -- run loops -------------------------------------------------------------
+
+    def start(self):
+        self._threads = [
+            threading.Thread(target=self._watch_loop, name="pod-watch", daemon=True),
+            threading.Thread(target=self._retry_loop, name="pod-retry", daemon=True),
+            threading.Thread(target=self._resync_loop, name="pod-resync", daemon=True),
+        ]
+        for t in self._threads:
+            t.start()
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+
+    def _watch_loop(self):
+        backoff = 0.2
+        while not self._stop.is_set():
+            try:
+                stream = self.kube.watch_pods(
+                    field_selector=f"spec.nodeName={self.node_name}", stop=self._stop)
+                self.ready.set()
+                for ev in stream:
+                    if ev.type in ("BOOKMARK", "ERROR"):
+                        continue
+                    self.handle_event(ev.type, ev.object)
+                    backoff = 0.2
+            except (KubeApiError, OSError) as e:
+                log.warning("pod watch broken: %s — reconnecting in %.1fs", e, backoff)
+                if self._stop.wait(backoff):
+                    return
+                backoff = min(backoff * 2, 10.0)
+
+    def _retry_loop(self):
+        while not self._stop.is_set():
+            try:
+                op, pod, attempt = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            time.sleep(min(0.2 * attempt, 1.0))
+            self._dispatch(op, pod, attempt)
+
+    def _resync_loop(self):
+        while not self._stop.wait(self.resync_interval_s):
+            try:
+                self.resync()
+            except (KubeApiError, OSError) as e:
+                log.warning("resync failed: %s", e)

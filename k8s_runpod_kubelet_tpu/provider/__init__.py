@@ -1,0 +1,20 @@
+"""L2': Provider core — pod lifecycle, spec/status translation, reconcile loops.
+
+The TPU-native rebuild of the reference's Provider
+(/root/reference/pkg/virtual_kubelet/kubelet.go, 2,066 LoC). Split by concern:
+
+- ``annotations``: tpu.dev/* annotation schema + pod>Job fallback resolution.
+- ``translate``:   the pod -> slice-parameters compiler (env/secret extraction,
+                   accelerator selection, ports).
+- ``status``:      QueuedResource state + gang runtime -> v1.PodStatus.
+- ``node_spec``:   the virtual Node object (google.com/tpu capacity, topology
+                   labels, taint, conditions).
+- ``provider``:    the Provider class (caches, lifecycle handlers, deploy).
+- ``reconcile``:   steady-state loops (status poll, pending retry, GC ladder).
+- ``recovery``:    crash recovery (LoadRunning 3-way reconcile, orphan adoption).
+"""
+
+from .provider import InstanceInfo, Provider
+from .annotations import Annotations
+
+__all__ = ["Provider", "InstanceInfo", "Annotations"]

@@ -1,0 +1,113 @@
+"""tpu.dev/* annotation schema and resolution.
+
+TPU-native successor of the runpod.io/* annotation surface
+(runpod_client.go:37-46; SURVEY.md §2.2), with the same pod-over-Job fallback
+semantics (annotation on the pod wins; else the owning Job's annotation applies,
+runpod_client.go:1102-1112 + getOwnerJob :1057-1099 with owner-UID check).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..kube.client import KubeApiError, KubeClient
+from ..kube import objects as ko
+
+log = logging.getLogger(__name__)
+
+
+class Annotations:
+    PREFIX = "tpu.dev/"
+
+    # instance binding + cost (runpod.io/pod-id, runpod.io/cost-per-hr)
+    QUEUED_RESOURCE = "tpu.dev/queued-resource-id"
+    COST_PER_HR = "tpu.dev/cost-per-hr"
+    ZONE = "tpu.dev/zone"  # where the bound slice actually lives
+
+    # slice selection (replaces cloud-type/templateId/required-gpu-memory)
+    ACCELERATOR_TYPE = "tpu.dev/accelerator-type"   # exact, e.g. v5litepod-16
+    GENERATION = "tpu.dev/generation"               # e.g. v5e
+    TOPOLOGY = "tpu.dev/topology"                   # e.g. 4x4
+    RUNTIME_VERSION = "tpu.dev/runtime-version"
+    CAPACITY_TYPE = "tpu.dev/capacity-type"         # on-demand | spot | reserved
+    RESERVATION = "tpu.dev/reservation"
+    MIN_HBM_GIB = "tpu.dev/min-hbm-gib"             # ~ runpod.io/required-gpu-memory
+    MAX_COST_PER_HR = "tpu.dev/max-cost-per-hr"
+    ZONES = "tpu.dev/zones"                         # ~ runpod.io/datacenter-ids
+
+    # workload
+    PORTS = "tpu.dev/ports"                         # ~ runpod.io/ports override
+    REGISTRY_AUTH = "tpu.dev/registry-auth-id"      # ~ container-registry-auth-id
+
+    # multislice (net-new)
+    NUM_SLICES = "tpu.dev/num-slices"
+    SLICE_ID = "tpu.dev/slice-id"
+    MEGASCALE_COORDINATOR = "tpu.dev/megascale-coordinator"
+
+    # bookkeeping
+    EXTERNAL = "tpu.dev/external"                   # adopted orphan (kubelet.go:1580)
+    PREEMPTION_COUNT = "tpu.dev/preemption-count"
+
+    VALID_CAPACITY_TYPES = ("on-demand", "spot", "reserved")
+
+
+def get_owner_job(kube: KubeClient, pod: dict) -> Optional[dict]:
+    """The pod's owning Job, verified by owner-reference UID
+    (parity: runpod_client.go:1057-1099)."""
+    for ref in ko.owner_references(pod):
+        if ref.get("kind") == "Job":
+            try:
+                job = kube.get_job(ko.namespace(pod), ref["name"])
+            except KubeApiError as e:
+                if e.is_not_found:
+                    continue
+                raise
+            if ref.get("uid") and ko.uid(job) and ref["uid"] != ko.uid(job):
+                log.warning("job %s uid mismatch for pod %s — stale owner ref",
+                            ref["name"], ko.name(pod))
+                continue
+            return job
+    return None
+
+
+class AnnotationResolver:
+    """Resolves annotations with pod > owning-Job precedence. Fetches the Job at
+    most once per pod."""
+
+    def __init__(self, kube: KubeClient, pod: dict):
+        self.pod = pod
+        self._kube = kube
+        self._job: Optional[dict] = None
+        self._job_fetched = False
+
+    def _job_annotations(self) -> dict[str, str]:
+        if not self._job_fetched:
+            self._job_fetched = True
+            try:
+                self._job = get_owner_job(self._kube, self.pod)
+            except KubeApiError as e:
+                log.warning("owner-job lookup failed for %s: %s",
+                            ko.namespaced_name(self.pod), e)
+                self._job = None
+        return ko.annotations(self._job) if self._job else {}
+
+    def get(self, key: str, default: str = "") -> str:
+        v = ko.annotations(self.pod).get(key)
+        if v is not None and v != "":
+            return v
+        return self._job_annotations().get(key, default)
+
+    def get_float(self, key: str, default: float = 0.0) -> float:
+        v = self.get(key)
+        if not v:
+            return default
+        try:
+            return float(v)
+        except ValueError:
+            log.warning("pod %s: annotation %s=%r is not a number — using %s",
+                        ko.namespaced_name(self.pod), key, v, default)
+            return default
+
+    def get_int(self, key: str, default: int = 0) -> int:
+        return int(self.get_float(key, float(default)))

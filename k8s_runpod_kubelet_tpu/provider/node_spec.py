@@ -1,0 +1,78 @@
+"""The virtual Node object: capacity, labels, taint, conditions.
+
+Parity with GetNodeStatus (kubelet.go:1098-1186), retargeted: capacity
+advertises ``google.com/tpu`` (not nvidia.com/gpu:4), plus topology labels so
+mesh-aware workloads can size themselves (SURVEY.md §2.2 'Node identity' row,
+§5.7). The taint key keeps the virtual-kubelet convention with provider=tpu.
+"""
+
+from __future__ import annotations
+
+from ..cloud.types import ACCELERATOR_CATALOG
+from ..config import Config
+from ..kube import objects as ko
+
+TAINT_KEY = "virtual-kubelet.io/provider"
+TAINT_VALUE = "tpu"
+
+
+def build_node(cfg: Config, *, cloud_healthy: bool = True,
+               kubelet_port: int = 10250) -> dict:
+    max_chips = max(a.chips for a in ACCELERATOR_CATALOG.values())
+    generations = sorted({a.generation for a in ACCELERATOR_CATALOG.values()})
+    ready = "True" if cloud_healthy else "False"
+    now = ko.now_iso()
+    conditions = [
+        {"type": "Ready", "status": ready,
+         "reason": "KubeletReady" if cloud_healthy else "CloudAPIUnreachable",
+         "message": "virtual TPU kubelet is ready" if cloud_healthy
+                    else "TPU API health check failing",
+         "lastHeartbeatTime": now, "lastTransitionTime": now},
+        {"type": "MemoryPressure", "status": "False", "reason": "KubeletHasSufficientMemory",
+         "lastHeartbeatTime": now, "lastTransitionTime": now},
+        {"type": "DiskPressure", "status": "False", "reason": "KubeletHasNoDiskPressure",
+         "lastHeartbeatTime": now, "lastTransitionTime": now},
+        {"type": "PIDPressure", "status": "False", "reason": "KubeletHasSufficientPID",
+         "lastHeartbeatTime": now, "lastTransitionTime": now},
+    ]
+    capacity = {
+        "cpu": "1000",          # a slice fleet's worth of host CPU
+        "memory": "4Ti",
+        "pods": "100",          # parity: kubelet.go:1133
+        "google.com/tpu": str(max_chips),
+    }
+    return {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {
+            "name": cfg.node_name,
+            "labels": {
+                "type": "virtual-kubelet",
+                "kubernetes.io/role": "agent",
+                "kubernetes.io/hostname": cfg.node_name,
+                "kubernetes.io/os": cfg.operating_system.lower(),
+                "node.kubernetes.io/instance-type": "cloud-tpu-slice",
+                "tpu.dev/generations": "_".join(generations),
+                "tpu.dev/default-generation": cfg.default_generation,
+                "tpu.dev/zone": cfg.zone,
+            },
+        },
+        "spec": {
+            "taints": [{"key": TAINT_KEY, "value": TAINT_VALUE, "effect": "NoSchedule"}],
+        },
+        "status": {
+            "capacity": capacity,
+            "allocatable": dict(capacity),
+            "conditions": conditions,
+            "addresses": [
+                {"type": "InternalIP", "address": cfg.internal_ip},
+                {"type": "Hostname", "address": cfg.node_name},
+            ],
+            "daemonEndpoints": {"kubeletEndpoint": {"Port": kubelet_port}},
+            "nodeInfo": {
+                "operatingSystem": cfg.operating_system.lower(),
+                "architecture": "amd64",
+                "kubeletVersion": "v1.29.0-tpu-virtual-kubelet",
+            },
+        },
+    }

@@ -1,0 +1,354 @@
+"""The Provider: pod caches, lifecycle handlers, deploy path, node identity.
+
+Rebuild of the reference Provider (kubelet.go:27-731), TPU-native:
+
+- CreatePod caches + deploys; a deploy failure leaves the pod Pending for the
+  pending processor to retry (parity: kubelet.go:412-415).
+- Deploy is two-phase on TPU: (1) create the queued resource at CreatePod time,
+  (2) gang-launch the workload with per-worker env once the slice turns ACTIVE
+  (reconcile.py) — RunPod had no phase 2 because one instance boots one
+  container; a slice is N bare VMs that must start together.
+- The durable pod<->slice binding is the tpu.dev/queued-resource-id annotation
+  plus the cloud list API; in-memory maps are caches rebuilt by recovery.py
+  (state model parity: SURVEY.md §5.4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from ..cloud.tpu_client import (NotFoundError, QuotaError, TpuApiError, TpuClient)
+from ..cloud.types import DetailedStatus, QueuedResourceState as S
+from ..config import Config
+from ..gang import GangExecutor
+from ..kube.client import KubeApiError, KubeClient
+from ..kube import objects as ko
+from ..metrics import Metrics
+from .annotations import Annotations as A
+from .node_spec import build_node
+from .reconcile import ReconcileMixin
+from .recovery import RecoveryMixin
+from .translate import TranslationError, prepare_tpu_parameters
+
+log = logging.getLogger(__name__)
+
+HEALTH_PROBE_MIN_INTERVAL_S = 10.0
+
+
+@dataclasses.dataclass
+class InstanceInfo:
+    """Cache entry for one pod's slice (analog of the reference's InstanceInfo,
+    kubelet.go:391-401)."""
+
+    qr_name: str = ""
+    zone: str = ""
+    status: Optional[S] = None
+    accelerator_type: str = ""
+    cost_per_hr: float = 0.0
+    workload_launched: bool = False
+    ready: bool = False
+    pod_status: Optional[dict] = None       # last translated v1.PodStatus
+    fingerprint: tuple = ()
+    # pending-deploy bookkeeping (kubelet.go:747-814)
+    pending_since: Optional[float] = None
+    last_deploy_error: str = ""
+    # north-star latency timestamps
+    created_at: float = 0.0
+    active_at: Optional[float] = None
+    launched_at: Optional[float] = None
+    ready_at: Optional[float] = None
+    preemption_count: int = 0
+
+
+@dataclasses.dataclass
+class DeletedPodInfo:
+    """Tracks a deleted pod until its slice is confirmed gone
+    (analog: deletedPods map, kubelet.go:628-631)."""
+
+    qr_name: str
+    zone: str
+    deleted_at: float
+    last_terminate_at: float = 0.0
+    unreachable_since: Optional[float] = None
+
+
+class Provider(ReconcileMixin, RecoveryMixin):
+    def __init__(self, cfg: Config, kube: KubeClient, tpu: TpuClient,
+                 gang_executor: Optional[GangExecutor] = None,
+                 metrics: Optional[Metrics] = None,
+                 clock: Callable[[], float] = time.time):
+        self.cfg = cfg
+        self.kube = kube
+        self.tpu = tpu
+        self.gang = gang_executor
+        self.clock = clock
+        self.metrics = metrics or Metrics()
+
+        self.lock = threading.RLock()
+        self.pods: dict[str, dict] = {}                 # ns/name -> pod
+        self.instances: dict[str, InstanceInfo] = {}    # ns/name -> info
+        self.deleted: dict[str, DeletedPodInfo] = {}    # ns/name -> tombstone
+
+        self._notify_cb: Optional[Callable[[dict], None]] = None
+        self._node_status_cb: Optional[Callable[[], None]] = None
+        self._cloud_healthy = True
+        self._last_health_probe = 0.0
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+        self.metrics.describe("tpu_kubelet_schedule_to_ready_seconds",
+                              "pod bound -> gang running (north-star latency)")
+        self.metrics.describe("tpu_kubelet_deploys", "queued-resource create attempts")
+        self._probe_cloud(force=True)
+
+    # -- helpers ---------------------------------------------------------------
+
+    @staticmethod
+    def key_of(pod: dict) -> str:
+        return ko.namespaced_name(pod)
+
+    def _probe_cloud(self, force: bool = False) -> bool:
+        """Rate-limited cloud health probe (parity: checkRunPodAPIHealth
+        kubelet.go:320-331, re-probed by Ping :1070-1076)."""
+        now = self.clock()
+        if force or now - self._last_health_probe >= HEALTH_PROBE_MIN_INTERVAL_S:
+            self._last_health_probe = now
+            healthy = self.tpu.health_check()
+            if healthy != self._cloud_healthy:
+                log.warning("TPU API health changed: %s -> %s", self._cloud_healthy, healthy)
+                self._cloud_healthy = healthy
+                self._notify_node_status()
+            self.metrics.set_gauge("tpu_kubelet_cloud_healthy", 1.0 if healthy else 0.0)
+        return self._cloud_healthy
+
+    def _notify_node_status(self):
+        cb = self._node_status_cb
+        if cb:
+            try:
+                cb()
+            except Exception as e:  # noqa: BLE001
+                log.warning("node status notify failed: %s", e)
+
+    # -- PodLifecycleHandler (called by node/pod_controller) -------------------
+
+    def create_pod(self, pod: dict):
+        """Cache + deploy. Deploy failure is NOT an error: the pod stays Pending
+        and the pending processor retries (parity: kubelet.go:384-418)."""
+        key = self.key_of(pod)
+        now = self.clock()
+        with self.lock:
+            self.pods[key] = ko.deep_copy(pod)
+            info = self.instances.get(key) or InstanceInfo()
+            info.created_at = info.created_at or now
+            info.pending_since = info.pending_since or now
+            self.instances[key] = info
+        log.info("CreatePod %s", key)
+        self.deploy_pod(pod)
+
+    def update_pod(self, pod: dict):
+        key = self.key_of(pod)
+        with self.lock:
+            if key in self.pods:
+                self.pods[key] = ko.deep_copy(pod)
+
+    def delete_pod(self, pod: dict):
+        """Terminate the slice, tombstone for GC, drop caches, then confirm the
+        K8s deletion with a grace-0 delete (parity: kubelet.go:621-651; the K8s
+        removal is ours to do since we ARE the L3 controller layer)."""
+        key = self.key_of(pod)
+        with self.lock:
+            info = self.instances.get(key)
+            qr_name = info.qr_name if info else \
+                ko.annotations(pod).get(A.QUEUED_RESOURCE, "")
+            zone = info.zone if info and info.zone else self.cfg.zone
+            if qr_name:
+                self.deleted[key] = DeletedPodInfo(
+                    qr_name=qr_name, zone=zone, deleted_at=self.clock())
+        log.info("DeletePod %s (slice=%s)", key, qr_name or "<none>")
+        if qr_name:
+            try:
+                self.tpu.delete_queued_resource(qr_name, zone=zone)
+            except TpuApiError as e:
+                log.warning("terminate %s failed (cleanup loop will retry): %s",
+                            qr_name, e)
+        with self.lock:
+            self.pods.pop(key, None)
+            self.instances.pop(key, None)
+        try:
+            ns, name = key.split("/", 1)
+            self.kube.delete_pod(ns, name, grace_period_s=0)
+        except KubeApiError as e:
+            if not e.is_not_found:
+                log.warning("grace-0 delete of %s failed: %s", key, e)
+
+    def get_pod(self, ns: str, name: str) -> Optional[dict]:
+        with self.lock:
+            return ko.deep_copy(self.pods.get(f"{ns}/{name}"))
+
+    def get_pod_status(self, ns: str, name: str) -> Optional[dict]:
+        with self.lock:
+            info = self.instances.get(f"{ns}/{name}")
+            if info and info.pod_status:
+                return ko.deep_copy(info.pod_status)
+            pod = self.pods.get(f"{ns}/{name}")
+            return ko.deep_copy(pod.get("status", {})) if pod else None
+
+    def get_pods(self) -> list[dict]:
+        with self.lock:
+            return [ko.deep_copy(p) for p in self.pods.values()]
+
+    def notify_pods(self, callback: Callable[[dict], None]):
+        """Register the async status-change callback
+        (parity: NotifyPods kubelet.go:713-731)."""
+        self._notify_cb = callback
+
+    # -- deploy ----------------------------------------------------------------
+
+    def deploy_pod(self, pod: dict) -> bool:
+        """Create the queued resource and annotate the pod with the binding.
+        Returns True if the slice exists after the call."""
+        key = self.key_of(pod)
+        if not self._probe_cloud():
+            log.warning("skipping deploy of %s: TPU API unhealthy "
+                        "(parity: kubelet.go:458-460)", key)
+            return False
+        self.metrics.incr("tpu_kubelet_deploys")
+        try:
+            params = prepare_tpu_parameters(self.kube, pod, self.cfg)
+        except TranslationError as e:
+            with self.lock:
+                info = self.instances.get(key)
+                if info:
+                    info.last_deploy_error = str(e)
+            log.warning("cannot translate pod %s: %s", key, e)
+            return False
+
+        try:
+            qr = self.tpu.create_queued_resource(params)
+        except TpuApiError as e:
+            if e.status == 409:
+                # our deterministic name already exists — adopt it (idempotent
+                # retry after a crash between create and annotate)
+                try:
+                    qr = self.tpu.get_queued_resource(params.name, zone=params.zone)
+                except TpuApiError as e2:
+                    log.error("deploy %s: conflict but fetch failed: %s", key, e2)
+                    return False
+            else:
+                with self.lock:
+                    info = self.instances.get(key)
+                    if info:
+                        info.last_deploy_error = str(e)
+                lvl = logging.INFO if isinstance(e, QuotaError) else logging.WARNING
+                log.log(lvl, "deploy %s failed: %s", key, e)
+                return False
+
+        acc = qr.accelerator
+        cost = acc.cost_per_hr if acc else 0.0
+        with self.lock:
+            info = self.instances.setdefault(key, InstanceInfo())
+            info.qr_name = qr.name
+            info.zone = params.zone
+            info.status = qr.state
+            info.accelerator_type = qr.accelerator_type
+            info.cost_per_hr = cost
+            info.pending_since = None
+            info.last_deploy_error = ""
+        self._annotate_binding(pod, qr.name, params.zone, qr.accelerator_type, cost)
+        log.info("deployed %s -> slice %s (%s, $%.2f/hr, state %s)",
+                 key, qr.name, qr.accelerator_type, cost, qr.state.value)
+        return True
+
+    def _annotate_binding(self, pod: dict, qr_name: str, zone: str,
+                          accelerator: str, cost: float):
+        """Write the durable binding annotations
+        (parity: updatePodWithRunPodInfo kubelet.go:505-562)."""
+        patch = {"metadata": {"annotations": {
+            A.QUEUED_RESOURCE: qr_name,
+            A.ZONE: zone,
+            A.ACCELERATOR_TYPE: accelerator,
+            A.COST_PER_HR: f"{cost:.4f}",
+        }}}
+        try:
+            updated = self.kube.patch_pod(ko.namespace(pod), ko.name(pod), patch)
+            with self.lock:
+                self.pods[self.key_of(pod)] = updated
+        except KubeApiError as e:
+            # cache still holds the binding; recovery can re-derive it from the
+            # slice's pod-uid label even if this patch never lands
+            log.warning("annotate %s failed: %s", self.key_of(pod), e)
+
+    # -- NodeProvider ----------------------------------------------------------
+
+    def get_node(self) -> dict:
+        return build_node(self.cfg, cloud_healthy=self._cloud_healthy,
+                          kubelet_port=self.cfg.listen_port)
+
+    def ping(self) -> bool:
+        return self._probe_cloud()
+
+    def set_status_listener(self, cb: Callable[[], None]):
+        self._node_status_cb = cb
+
+    # -- kubelet API (logs/exec — real, unlike the reference's stubs) ----------
+
+    def _qr_for(self, ns: str, name: str):
+        with self.lock:
+            info = self.instances.get(f"{ns}/{name}")
+        if not info or not info.qr_name:
+            raise KeyError(f"pod {ns}/{name} has no slice")
+        return self.tpu.get_queued_resource(info.qr_name, zone=info.zone)
+
+    def get_container_logs(self, ns: str, name: str, container: str,
+                           tail_lines: Optional[int] = None,
+                           worker: Optional[int] = None) -> str:
+        if self.gang is None:
+            return "<no worker transport configured>\n"
+        try:
+            qr = self._qr_for(ns, name)
+        except (NotFoundError,) as e:
+            raise KeyError(str(e)) from e
+        return self.gang.logs(qr, worker_id=worker, tail_lines=tail_lines)
+
+    def run_in_container(self, ns: str, name: str, container: str,
+                         cmd: list[str], worker: int = 0) -> str:
+        if self.gang is None:
+            raise NotImplementedError("no worker transport configured")
+        try:
+            qr = self._qr_for(ns, name)
+        except (NotFoundError,) as e:
+            raise KeyError(str(e)) from e
+        return self.gang.run_on_worker(qr, worker, cmd)
+
+    # -- background loops (started by bootstrap; parity kubelet.go:374-376) ----
+
+    def start(self):
+        loops = [
+            ("status", self.cfg.reconcile_interval_s, self.update_all_pod_statuses),
+            ("notify", self.cfg.notify_interval_s, self.update_all_pod_statuses),
+            ("pending", self.cfg.pending_retry_interval_s, self.process_pending_pods),
+            ("cleanup", self.cfg.cleanup_interval_s, self.run_cleanup),
+        ]
+        for name, interval, fn in loops:
+            t = threading.Thread(target=self._loop, args=(name, interval, fn),
+                                 name=f"provider-{name}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+
+    def _loop(self, name: str, interval: float, fn: Callable[[], None]):
+        while not self._stop.wait(interval):
+            try:
+                with self.metrics.time_block("tpu_kubelet_loop_seconds",
+                                             {"loop": name}):
+                    fn()
+            except Exception as e:  # noqa: BLE001 — loops must survive anything
+                log.exception("%s loop iteration failed: %s", name, e)

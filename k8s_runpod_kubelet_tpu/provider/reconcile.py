@@ -1,0 +1,382 @@
+"""Steady-state reconcile loops: status diff-and-patch, pending retry, GC ladder.
+
+Rebuild of the reference's loops (kubelet.go:292-317, 734-974, 1188-1377):
+
+- update_all_pod_statuses: the hot loop — poll each slice, gang-launch on
+  ACTIVE (the TPU-specific phase 2), translate, patch K8s only on change, with
+  the notify-callback fallback wrapped in exception recovery (parity:
+  kubelet.go:816-974, panic recovery :938-946).
+- process_pending_pods: 30s redeploy of undeployed pods with the 15-min give-up
+  -> PodFailed (parity: kubelet.go:734-814). TPU twist: a slice QUEUED in the
+  cloud (WAITING_FOR_RESOURCES) is NOT pending-deploy — queueing is normal and
+  must not trip the ladder (SURVEY.md §7.4 hard-part #3); it gets its own
+  optional max_provisioning_s deadline.
+- run_cleanup: tombstone sweep + the stuck-terminating escalation ladder with
+  the reference's exact 5/10/15-minute thresholds (kubelet.go:1190-1377).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..cloud.tpu_client import TpuApiError
+from ..cloud.types import QueuedResourceState as S
+from ..gang.env import compute_worker_env
+from ..kube.client import KubeApiError
+from ..kube import objects as ko
+from .annotations import Annotations as A, AnnotationResolver
+from .status import gang_ready, status_fingerprint, translate_status
+from .translate import prepare_tpu_parameters, TranslationError
+
+log = logging.getLogger(__name__)
+
+
+class ReconcileMixin:
+    # -- the hot loop ----------------------------------------------------------
+
+    def update_all_pod_statuses(self):
+        """One reconcile pass (parity: updateAllPodStatuses kubelet.go:816-974).
+        Copy-then-act: snapshot under the lock, then talk to the cloud without
+        holding it (lock discipline parity: kubelet.go:817-823)."""
+        with self.lock:
+            snapshot = [(k, ko.deep_copy(p), self.instances.get(k))
+                        for k, p in self.pods.items()]
+        for key, pod, info in snapshot:
+            if info is None:
+                continue
+            if info.pod_status and info.pod_status.get("phase") in ("Succeeded", "Failed"):
+                continue  # terminal — skip (kubelet.go:836-838)
+            if not info.qr_name:
+                continue  # pending deploy — the pending processor owns it (:841-844)
+            try:
+                self._reconcile_one(key, pod, info)
+            except Exception as e:  # noqa: BLE001 — one bad pod must not stop the sweep
+                log.exception("reconcile %s failed: %s", key, e)
+
+    def _reconcile_one(self, key: str, pod: dict, info):
+        detailed = self.tpu.get_detailed_status(info.qr_name, zone=info.zone)
+        state = detailed.resource.state
+
+        if state is S.NOT_FOUND:
+            self.handle_missing_instance(pod)  # kubelet.go:861-863
+            return
+
+        now = self.clock()
+        if state is S.ACTIVE and info.active_at is None:
+            info.active_at = now
+            self.metrics.observe("tpu_kubelet_schedule_to_active_seconds",
+                                 now - info.created_at)
+        if not info.workload_launched and detailed.runtime:
+            # a previous launch succeeded server-side but we never saw the
+            # response (lost HTTP reply / restart) — adopt it, don't relaunch
+            with self.lock:
+                info.workload_launched = True
+        # TPU phase 2: slice is up, fan the workload out to every worker
+        if state is S.ACTIVE and not info.workload_launched:
+            self._gang_launch(key, pod, info, detailed)
+            detailed = self.tpu.get_detailed_status(info.qr_name, zone=info.zone)
+
+        # preemption requeue: a SUSPENDED slice can be resubmitted instead of
+        # failing the pod, up to cfg.preemption_requeue_limit times
+        if state in (S.SUSPENDING, S.SUSPENDED) \
+                and info.preemption_count < self.cfg.preemption_requeue_limit:
+            self._requeue_preempted(key, pod, info)
+            return
+
+        # provisioning-queue deadline (0 = queue forever; see module docstring)
+        if (state.is_provisioning and self.cfg.max_provisioning_s
+                and now - info.created_at > self.cfg.max_provisioning_s):
+            self._fail_pod(pod, "ProvisioningTimeout",
+                           f"slice {info.qr_name} not ACTIVE after "
+                           f"{self.cfg.max_provisioning_s:.0f}s")
+            self._release_slice(key, info)
+            return
+
+        status = translate_status(pod, detailed,
+                                  workload_launched=info.workload_launched)
+        fp = status_fingerprint(status)
+        with self.lock:
+            info.status = state
+            if fp == info.fingerprint:
+                return  # no change — don't patch (kubelet.go:870-872)
+            info.fingerprint = fp
+            info.pod_status = status
+            ready_now = status.get("phase") == "Running" and not info.ready
+            info.ready = status.get("phase") == "Running"
+            if ready_now and info.ready_at is None:
+                info.ready_at = now
+                self.metrics.observe("tpu_kubelet_schedule_to_ready_seconds",
+                                     now - info.created_at)
+                log.info("pod %s gang is RUNNING %.1fs after schedule "
+                         "(north-star latency)", key, now - info.created_at)
+        self._push_status(key, pod, status)
+        if status.get("phase") in ("Succeeded", "Failed"):
+            # Unlike a RunPod EXITED instance (stopped, not billing), an ACTIVE
+            # TPU slice bills until deleted — release it as soon as the pod is
+            # terminal. The binding annotation stays for post-mortem.
+            self._release_slice(key, info)
+
+    def _release_slice(self, key: str, info):
+        log.info("pod %s is terminal — deleting slice %s to stop billing",
+                 key, info.qr_name)
+        try:
+            self.tpu.delete_queued_resource(info.qr_name, zone=info.zone)
+            self.metrics.incr("tpu_kubelet_slices_released")
+        except TpuApiError as e:
+            log.warning("release of %s failed — tombstoning for the sweep: %s",
+                        info.qr_name, e)
+            from .provider import DeletedPodInfo
+            with self.lock:
+                self.deleted.setdefault(key + "/released", DeletedPodInfo(
+                    qr_name=info.qr_name, zone=info.zone, deleted_at=self.clock()))
+
+    def _requeue_preempted(self, key: str, pod: dict, info):
+        """Resubmit a preempted slice (net-new elasticity; SURVEY.md §5.3 notes
+        preemption is the common case on TPU). Deletes the dead slice, strips the
+        binding, and hands the pod back to the pending processor."""
+        info.preemption_count += 1
+        log.warning("slice %s of %s preempted — requeueing (attempt %d/%d)",
+                    info.qr_name, key, info.preemption_count,
+                    self.cfg.preemption_requeue_limit)
+        try:
+            self.tpu.delete_queued_resource(info.qr_name, zone=info.zone)
+        except TpuApiError as e:
+            log.warning("delete of preempted %s failed: %s", info.qr_name, e)
+        try:
+            self.kube.patch_pod(pod["metadata"].get("namespace", "default"),
+                                pod["metadata"]["name"], {"metadata": {"annotations": {
+                                    A.QUEUED_RESOURCE: None,
+                                    A.PREEMPTION_COUNT: str(info.preemption_count)}}})
+        except KubeApiError as e:
+            log.warning("preemption-count annotate of %s failed: %s", key, e)
+        with self.lock:
+            info.qr_name = ""
+            info.workload_launched = False
+            info.ready = False
+            info.fingerprint = ()
+            info.active_at = None
+            info.pending_since = self.clock()
+        self.metrics.incr("tpu_kubelet_preemption_requeues")
+
+    def _gang_launch(self, key: str, pod: dict, info, detailed):
+        """All-or-nothing workload launch with per-worker env (net-new;
+        SURVEY.md §2.4 multi-host row)."""
+        qr = detailed.resource
+        resolver = AnnotationResolver(self.kube, pod)
+        num_slices = max(1, resolver.get_int(A.NUM_SLICES, 1))
+        slice_id = resolver.get_int(A.SLICE_ID, 0)
+        mega = resolver.get(A.MEGASCALE_COORDINATOR) or None
+        worker_env = compute_worker_env(qr, num_slices=num_slices,
+                                        slice_id=slice_id,
+                                        megascale_coordinator=mega)
+        try:
+            params = prepare_tpu_parameters(self.kube, pod, self.cfg)
+        except TranslationError as e:
+            log.error("gang launch of %s: translation failed post-deploy: %s", key, e)
+            return
+        try:
+            self.tpu.start_workload(info.qr_name, params.workload,
+                                    worker_env=worker_env, zone=info.zone)
+        except TpuApiError as e:
+            log.warning("gang launch of %s on %s failed (will retry): %s",
+                        key, info.qr_name, e)
+            return
+        with self.lock:
+            info.workload_launched = True
+            info.launched_at = self.clock()
+        self.metrics.incr("tpu_kubelet_gang_launches")
+        log.info("gang-launched %s on %s (%d workers, %d slice(s))",
+                 key, info.qr_name, len(qr.workers), num_slices)
+
+    def _push_status(self, key: str, pod: dict, status: dict):
+        """Patch pods/status; on failure fall back to the notify callback with
+        exception recovery (parity: kubelet.go:915-957)."""
+        ns, name = key.split("/", 1)
+        try:
+            self.kube.patch_pod_status(ns, name, {"status": status})
+            return
+        except KubeApiError as e:
+            log.warning("status patch of %s failed: %s — trying notify fallback", key, e)
+        cb = self._notify_cb
+        if cb is None:
+            return
+        updated = ko.deep_copy(pod)
+        updated["status"] = status
+        try:
+            cb(updated)
+        except Exception as e:  # noqa: BLE001 — recovery parity kubelet.go:938-946
+            log.exception("notify callback panicked: %s", e)
+
+    def _fail_pod(self, pod: dict, reason: str, message: str):
+        key = self.key_of(pod)
+        status = {
+            "phase": "Failed", "reason": reason, "message": message,
+            "conditions": [{"type": "Ready", "status": "False", "reason": reason}],
+        }
+        with self.lock:
+            info = self.instances.get(key)
+            if info:
+                info.pod_status = status
+                info.fingerprint = status_fingerprint(status)
+        self._push_status(key, pod, status)
+        log.warning("pod %s failed: %s: %s", key, reason, message)
+
+    # -- pending deploys -------------------------------------------------------
+
+    def process_pending_pods(self):
+        """Retry undeployed pods; give up after max_pending_s
+        (parity: startPendingPodProcessor kubelet.go:734-814)."""
+        with self.lock:
+            pending = [(k, ko.deep_copy(p)) for k, p in self.pods.items()
+                       if (i := self.instances.get(k)) is not None
+                       and not i.qr_name and i.pending_since is not None]
+        now = self.clock()
+        for key, pod in pending:
+            with self.lock:
+                info = self.instances.get(key)
+                if info is None or info.qr_name:
+                    continue
+                waited = now - (info.pending_since or now)
+                last_err = info.last_deploy_error
+            if waited > self.cfg.max_pending_s:
+                self._fail_pod(pod, "DeploymentFailed",
+                               f"could not deploy for {waited:.0f}s"
+                               + (f"; last error: {last_err}" if last_err else ""))
+                with self.lock:
+                    if key in self.instances:
+                        self.instances[key].pending_since = None
+                continue
+            log.info("retrying deploy of pending pod %s (%.0fs elapsed)", key, waited)
+            self.deploy_pod(pod)
+
+    # -- garbage collection ----------------------------------------------------
+
+    def run_cleanup(self):
+        self.cleanup_deleted_pods()
+        self.cleanup_stuck_terminating_pods()
+        self.cleanup_orphaned_slices()
+
+    def cleanup_deleted_pods(self):
+        """Tombstone sweep: keep terminating the slice until it is actually gone,
+        then drop the tombstone (parity: cleanupDeletedPods kubelet.go:1190-1227)."""
+        with self.lock:
+            items = list(self.deleted.items())
+        for key, tomb in items:
+            try:
+                self.tpu.get_queued_resource(tomb.qr_name, zone=tomb.zone)
+            except TpuApiError as e:
+                if e.status == 404:
+                    with self.lock:
+                        self.deleted.pop(key, None)
+                    continue
+                log.warning("cleanup: status of %s unknown: %s", tomb.qr_name, e)
+                continue
+            now = self.clock()
+            if now - tomb.last_terminate_at > 60:
+                log.info("cleanup: slice %s of deleted pod %s still exists — "
+                         "re-terminating", tomb.qr_name, key)
+                try:
+                    self.tpu.delete_queued_resource(tomb.qr_name, zone=tomb.zone)
+                    tomb.last_terminate_at = now
+                except TpuApiError as e:
+                    log.warning("cleanup re-terminate %s failed: %s", tomb.qr_name, e)
+
+    def cleanup_stuck_terminating_pods(self):
+        """The escalation ladder for pods stuck Terminating, with the reference's
+        thresholds (parity: cleanupStuckTerminatingPods kubelet.go:1231-1377):
+          - no slice id                          -> force delete now   (:1253-1271)
+          - slice status unreachable > 10 min    -> force delete       (:1284-1301)
+          - slice still up, > 5 min              -> re-terminate       (:1332-1347)
+          - > 15 min regardless                  -> force delete       (:1350-1366)
+        """
+        try:
+            pods = self.kube.list_pods(
+                field_selector=f"spec.nodeName={self.cfg.node_name}")
+        except KubeApiError as e:
+            log.warning("stuck-terminating sweep: list failed: %s", e)
+            return
+        now = self.clock()
+        for pod in pods:
+            ts = ko.deletion_timestamp(pod)
+            if not ts:
+                continue
+            key = ko.namespaced_name(pod)
+            import calendar, time as _t
+            try:
+                deleting_for = now - calendar.timegm(
+                    _t.strptime(ts, "%Y-%m-%dT%H:%M:%SZ"))
+            except ValueError:
+                deleting_for = 0.0
+            qr_name = ko.annotations(pod).get(A.QUEUED_RESOURCE, "")
+            zone = ko.annotations(pod).get(A.ZONE, "") or self.cfg.zone
+            if not qr_name:
+                log.info("stuck pod %s has no slice — force deleting", key)
+                self.force_delete_pod(pod)
+                continue
+            try:
+                self.tpu.get_queued_resource(qr_name, zone=zone)
+                reachable = True
+            except TpuApiError as e:
+                reachable = e.status == 404
+                if e.status == 404:
+                    log.info("stuck pod %s: slice already gone — force deleting", key)
+                    self.force_delete_pod(pod)
+                    continue
+            if not reachable:
+                with self.lock:
+                    tomb = self.deleted.get(key)
+                    if tomb and tomb.unreachable_since is None:
+                        tomb.unreachable_since = now
+                    unreachable_for = now - (tomb.unreachable_since or now) if tomb else 0
+                if unreachable_for > self.cfg.stuck_unreachable_force_s \
+                        or deleting_for > self.cfg.stuck_unreachable_force_s:
+                    log.warning("stuck pod %s: slice unreachable >%.0fs — force deleting",
+                                key, self.cfg.stuck_unreachable_force_s)
+                    self.force_delete_pod(pod)
+                continue
+            if deleting_for > self.cfg.stuck_force_delete_s:
+                log.warning("stuck pod %s terminating for %.0fs — force deleting "
+                            "and abandoning slice %s to the tombstone sweep",
+                            key, deleting_for, qr_name)
+                self.force_delete_pod(pod)
+            elif deleting_for > self.cfg.stuck_reterminate_s:
+                log.info("stuck pod %s terminating for %.0fs — re-terminating %s",
+                         key, deleting_for, qr_name)
+                try:
+                    self.tpu.delete_queued_resource(qr_name, zone=zone)
+                except TpuApiError as e:
+                    log.warning("re-terminate %s failed: %s", qr_name, e)
+
+    def cleanup_orphaned_slices(self):
+        """Slices labeled as ours whose pod no longer exists in K8s -> delete.
+        Stronger than the reference (which only sweeps its in-memory deleted
+        map): this catches slices leaked across kubelet restarts."""
+        try:
+            slices = self.tpu.list_queued_resources()
+        except TpuApiError as e:
+            log.warning("orphan sweep: list failed: %s", e)
+            return
+        with self.lock:
+            known = {i.qr_name for i in self.instances.values() if i.qr_name}
+            tombs = {t.qr_name for t in self.deleted.values()}
+        for qr in slices:
+            if qr.labels.get("managed-by") != "tpu-virtual-kubelet":
+                continue
+            if qr.labels.get("node") != self.cfg.node_name:
+                continue
+            if qr.name in known or qr.name in tombs:
+                continue
+            ns = qr.labels.get("pod-namespace", "")
+            name = qr.labels.get("pod-name", "")
+            try:
+                self.kube.get_pod(ns, name)
+                continue  # pod exists; recovery will adopt it
+            except KubeApiError as e:
+                if not e.is_not_found:
+                    continue
+            log.warning("orphan sweep: slice %s has no pod %s/%s — deleting",
+                        qr.name, ns, name)
+            try:
+                self.tpu.delete_queued_resource(qr.name, zone=qr.zone or None)
+            except TpuApiError as e:
+                log.warning("orphan delete %s failed: %s", qr.name, e)

@@ -1,0 +1,202 @@
+"""QueuedResource state + gang runtime -> v1.PodStatus translation.
+
+Rebuild of translateRunPodStatus + checkPortsExposed + handlePodCompletion
+(kubelet.go:566-605, 976-1065, 1798-2024), re-thought for slices:
+
+- the reference's "RUNNING but ports not yet exposed => still Pending"
+  readiness heuristic generalizes to "slice ACTIVE but the gang isn't fully
+  running => still Pending" (SURVEY.md §7.4 hard-part #6);
+- EXITED message-sniffing (kubelet.go:1903-1926) becomes exact per-worker exit
+  codes, aggregated all-or-nothing;
+- a single unhealthy worker fails the WHOLE pod (gang-fail, SURVEY.md §5.3) —
+  preemption is a normal event on TPUs, and the Job controller is the retry
+  mechanism;
+- the pod IP is worker 0's real address, not a placeholder
+  (kubelet.go:2016-2017 used 10.0.0.1).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..cloud.types import DetailedStatus, QueuedResourceState as S
+from ..kube import objects as ko
+from .translate import HTTP_PORTS
+
+log = logging.getLogger(__name__)
+
+
+def check_ports_exposed(requested_ports: list[str], detailed: DetailedStatus) -> bool:
+    """Port-readiness parity (kubelet.go:566-605): HTTP-ish ports are assumed
+    ready; TCP ports must appear in the slice's port mappings."""
+    for p in requested_ports:
+        try:
+            port_s, _, proto = p.partition("/")
+            port = int(port_s)
+        except ValueError:
+            continue
+        if proto.lower() == "udp" or port in HTTP_PORTS:
+            continue
+        if port not in detailed.ports:
+            return False
+    return True
+
+
+def gang_ready(detailed: DetailedStatus) -> bool:
+    """The TPU readiness condition: every worker healthy and running the
+    workload. This is what 'ICI mesh can form' means from the control plane."""
+    return (detailed.all_workers_healthy
+            and bool(detailed.runtime)
+            and all(w.workload_running or w.exit_code is not None
+                    for w in detailed.runtime))
+
+
+def _container_name(pod: dict) -> str:
+    cs = ko.containers(pod)
+    return cs[0].get("name", "workload") if cs else "workload"
+
+
+def _base(pod: dict, phase: str, reason: str = "", message: str = "",
+          ready: bool = False, pod_ip: str = "", start_time: Optional[str] = None,
+          container_state: Optional[dict] = None,
+          container_ready: bool = False, restart_count: int = 0) -> dict:
+    conditions = [
+        {"type": "PodScheduled", "status": "True"},
+        {"type": "Initialized", "status": "True"},
+        {"type": "Ready", "status": "True" if ready else "False"},
+        {"type": "ContainersReady", "status": "True" if ready else "False"},
+    ]
+    status: dict = {"phase": phase, "conditions": conditions}
+    if reason:
+        status["reason"] = reason
+    if message:
+        status["message"] = message
+    if pod_ip:
+        status["podIP"] = pod_ip
+        status["podIPs"] = [{"ip": pod_ip}]
+    if start_time:
+        status["startTime"] = start_time
+    if container_state is not None:
+        status["containerStatuses"] = [{
+            "name": _container_name(pod),
+            "state": container_state,
+            "ready": container_ready,
+            "restartCount": restart_count,
+            "image": (ko.containers(pod)[0].get("image", "") if ko.containers(pod) else ""),
+            "imageID": "",
+            "containerID": "",
+        }]
+    return status
+
+
+def translate_status(pod: dict, detailed: DetailedStatus, *,
+                     workload_launched: bool,
+                     ports_exposed: Optional[bool] = None) -> dict:
+    """Main translation (parity: translateRunPodStatus kubelet.go:1848-2024)."""
+    qr = detailed.resource
+    state = qr.state
+    pod_ip = ""
+    if qr.workers:
+        pod_ip = qr.workers[0].internal_ip or ""
+    if ports_exposed is None:
+        ports_exposed = check_ports_exposed(
+            [p for c in ko.containers(pod) for p in
+             [f"{pp['containerPort']}/{pp.get('protocol', 'TCP').lower()}"
+              for pp in c.get("ports", [])]],
+            detailed)
+
+    if state in (S.ACCEPTED, S.WAITING_FOR_RESOURCES):
+        return _base(pod, "Pending", reason="SliceQueued",
+                     message=f"queued resource {qr.name}: {qr.state_message or state.value}",
+                     container_state={"waiting": {"reason": "SliceQueued",
+                                                  "message": "waiting for TPU capacity"}})
+    if state is S.PROVISIONING:
+        return _base(pod, "Pending", reason="SliceProvisioning",
+                     message=f"TPU VMs creating for {qr.name}",
+                     container_state={"waiting": {"reason": "SliceProvisioning",
+                                                  "message": "TPU VMs are being created"}})
+
+    if state is S.ACTIVE:
+        if detailed.all_exited:
+            return completion_status(pod, detailed)
+        if detailed.runtime and not detailed.all_workers_healthy:
+            # gang broken: one dead worker fails the pod (SURVEY.md §5.3)
+            bad = [w.worker_id for w in detailed.runtime if not w.healthy]
+            return _base(pod, "Failed", reason="GangBroken",
+                         message=f"workers {bad} unhealthy — slice gang broken; "
+                                 "the owning controller should recreate the pod",
+                         container_state={"terminated": {
+                             "exitCode": 137, "reason": "GangBroken"}})
+        if workload_launched and gang_ready(detailed) and ports_exposed:
+            started = min((w.started_at for w in detailed.runtime
+                           if w.started_at), default=None)
+            return _base(pod, "Running", ready=True, pod_ip=pod_ip,
+                         start_time=ko.now_iso(started),
+                         container_state={"running": {"startedAt": ko.now_iso(started)}},
+                         container_ready=True)
+        # ACTIVE but gang not fully up — the reference's RUNNING-without-ports
+        # => ContainerCreating case (kubelet.go:1867-1890)
+        return _base(pod, "Pending", reason="ContainerCreating", pod_ip=pod_ip,
+                     message="slice active; launching workload on all workers",
+                     container_state={"waiting": {"reason": "ContainerCreating",
+                                                  "message": "gang launch in progress"}})
+
+    if state in (S.SUSPENDING, S.SUSPENDED):
+        return _base(pod, "Failed", reason="Preempted",
+                     message=f"slice {qr.name} preempted: {qr.state_message}",
+                     container_state={"terminated": {"exitCode": 137,
+                                                     "reason": "Preempted"}})
+    if state is S.DELETING:
+        return _base(pod, "Running", reason="SliceDeleting",
+                     message=f"slice {qr.name} deleting", pod_ip=pod_ip,
+                     container_state={"terminated": {"exitCode": 0,
+                                                     "reason": "SliceDeleting"}})
+    if state is S.FAILED:
+        return _base(pod, "Failed", reason="SliceFailed",
+                     message=f"slice {qr.name} failed: {qr.state_message}",
+                     container_state={"terminated": {"exitCode": 1,
+                                                     "reason": "SliceFailed"}})
+    if state is S.NOT_FOUND:
+        return _base(pod, "Failed", reason="SliceNotFound",
+                     message=f"queued resource {qr.name} no longer exists "
+                             "(parity: kubelet.go:1953-1965)",
+                     container_state={"terminated": {"exitCode": 1,
+                                                     "reason": "SliceNotFound"}})
+    return _base(pod, "Unknown", reason="UnknownSliceState", message=str(state))
+
+
+def completion_status(pod: dict, detailed: DetailedStatus) -> dict:
+    """All workers exited -> Succeeded iff every exit code is 0 (parity:
+    handlePodCompletion kubelet.go:998-1065 + IsSuccessfulCompletion
+    runpod_client.go:821-843 — but with real per-worker exit codes instead of
+    message sniffing)."""
+    code = detailed.max_exit_code or 0
+    ok = code == 0
+    failed = {w.worker_id: w.exit_code for w in detailed.runtime
+              if w.exit_code not in (None, 0)}
+    finished = max((w.finished_at for w in detailed.runtime if w.finished_at),
+                   default=None)
+    msg = ("all workers completed successfully" if ok
+           else f"worker exit codes: {failed}")
+    return _base(pod, "Succeeded" if ok else "Failed",
+                 reason="Completed" if ok else "WorkersFailed",
+                 message=msg,
+                 container_state={"terminated": {
+                     "exitCode": code,
+                     "reason": "Completed" if ok else "Error",
+                     "message": msg,
+                     "finishedAt": ko.now_iso(finished),
+                 }})
+
+
+def status_fingerprint(status: dict) -> tuple:
+    """Change-detection key (parity: the reference patches only when status or
+    port-exposure changed, kubelet.go:870-872)."""
+    cs = status.get("containerStatuses") or [{}]
+    state = cs[0].get("state", {})
+    kind = next(iter(state), "")
+    return (status.get("phase"), status.get("reason"),
+            status.get("podIP", ""), kind,
+            state.get(kind, {}).get("exitCode"),
+            cs[0].get("ready"))

@@ -1,0 +1,260 @@
+"""Pod spec -> TPU slice parameters: the K8s->cloud compiler.
+
+TPU-native rebuild of PrepareRunPodParameters + ExtractEnvVars + port extraction
+(runpod_client.go:845-1393). Deliberate improvements over the reference, per
+SURVEY.md §7.2:
+
+- env/secrets are read from ALL containers, not Containers[0] only
+  (the reference's documented bug, runpod_client.go:1028-1030);
+- the accelerator request (google.com/tpu) actually drives slice sizing —
+  the reference never reads its GPU count (SURVEY.md §2.4);
+- the cost ceiling is enforced (the reference's --max-gpu-price is dead,
+  SURVEY.md §5.6);
+- queued-resource names derive deterministically from the pod UID so crash
+  recovery can re-map them by listing (SURVEY.md §5.4), and the slice carries
+  pod identity labels for the reverse mapping.
+"""
+
+from __future__ import annotations
+
+import base64
+import logging
+import re
+
+from ..cloud.tpu_client import TpuParameters, WorkloadSpec
+from ..cloud.types import AcceleratorType, lookup_accelerator, select_accelerator
+from ..config import Config
+from ..kube.client import KubeApiError, KubeClient
+from ..kube import objects as ko
+from .annotations import AnnotationResolver, Annotations as A
+
+log = logging.getLogger(__name__)
+
+
+class TranslationError(Exception):
+    """Pod spec cannot be translated; the pod should stay Pending and retry."""
+
+
+# Ports whose services speak HTTP — assumed ready without a mapping
+# (readiness-heuristic parity: runpod_client.go:1199-1208).
+HTTP_PORTS = {80, 443, 8080, 8000, 3000, 5000, 8888, 9000}
+
+# K8s auto-injects these into every container; forwarding them to the cloud
+# instance leaks cluster internals and breaks workloads
+# (filter parity: runpod_client.go:886-904).
+_AUTO_ENV_EXACT = {"KUBERNETES_SERVICE_HOST", "KUBERNETES_SERVICE_PORT",
+                   "KUBERNETES_SERVICE_PORT_HTTPS", "KUBERNETES_PORT"}
+_AUTO_ENV_RE = re.compile(r"^KUBERNETES_PORT_|_SERVICE_HOST$|_SERVICE_PORT$|_SERVICE_PORT_|_PORT_\d+_(TCP|UDP)")
+
+
+def is_auto_injected_env(name: str) -> bool:
+    return name in _AUTO_ENV_EXACT or bool(_AUTO_ENV_RE.search(name))
+
+
+def qr_name_for_pod(pod: dict) -> str:
+    """Deterministic queued-resource name from the pod UID (RFC-1035 safe).
+    The durable pod<->slice binding is this name + the annotation — no local DB
+    (state model parity: SURVEY.md §5.4)."""
+    u = ko.uid(pod).replace("-", "")[:16].lower() or "nouid"
+    return f"qr-{u}"
+
+
+def _decode_secret(secret: dict, key: str) -> str:
+    data = secret.get("data", {})
+    if key in data:
+        return base64.b64decode(data[key]).decode()
+    return secret.get("stringData", {}).get(key, "")
+
+
+def extract_env(kube: KubeClient, pod: dict) -> dict[str, str]:
+    """Collect env from ALL containers: plain values, secretKeyRef, envFrom
+    secretRef, and secret volumes flattened to env (parity:
+    runpod_client.go:949-1054), minus auto-injected cluster vars."""
+    env: dict[str, str] = {}
+    ns = ko.namespace(pod)
+    secret_cache: dict[str, dict] = {}
+
+    def fetch_secret(name: str) -> dict:
+        if name not in secret_cache:
+            secret_cache[name] = kube.get_secret(ns, name)
+        return secret_cache[name]
+
+    for c in ko.containers(pod):
+        for ef in c.get("envFrom", []):
+            ref = ef.get("secretRef")
+            if not ref:
+                continue
+            try:
+                secret = fetch_secret(ref["name"])
+            except KubeApiError as e:
+                raise TranslationError(f"envFrom secret {ref['name']}: {e}") from e
+            for key in secret.get("data", {}):
+                env[ef.get("prefix", "") + key] = _decode_secret(secret, key)
+        for e in c.get("env", []):
+            name = e.get("name", "")
+            if not name or is_auto_injected_env(name):
+                continue
+            if "value" in e:
+                env[name] = e["value"]
+                continue
+            src = e.get("valueFrom", {})
+            if "secretKeyRef" in src:
+                ref = src["secretKeyRef"]
+                try:
+                    secret = fetch_secret(ref["name"])
+                except KubeApiError as ex:
+                    if ref.get("optional"):
+                        continue
+                    raise TranslationError(f"secret {ref['name']}: {ex}") from ex
+                env[name] = _decode_secret(secret, ref["key"])
+            elif "fieldRef" in src:
+                fp = src["fieldRef"].get("fieldPath", "")
+                if fp == "metadata.name":
+                    env[name] = ko.name(pod)
+                elif fp == "metadata.namespace":
+                    env[name] = ns
+    # secret volumes -> env (runpod_client.go:949-979 flattening)
+    for vol in pod.get("spec", {}).get("volumes", []):
+        sec = vol.get("secret")
+        if not sec:
+            continue
+        try:
+            secret = fetch_secret(sec["secretName"])
+        except KubeApiError as e:
+            if sec.get("optional"):
+                continue
+            raise TranslationError(f"volume secret {sec['secretName']}: {e}") from e
+        for key in secret.get("data", {}):
+            env_name = re.sub(r"[^A-Za-z0-9_]", "_", key).upper()
+            env.setdefault(env_name, _decode_secret(secret, key))
+    return env
+
+
+def extract_ports(pod: dict, resolver: AnnotationResolver) -> list[str]:
+    """containerPorts across all containers as "port/proto", with the
+    tpu.dev/ports annotation as a manual override
+    (parity: runpod_client.go:1195-1246 + :1312-1327)."""
+    override = resolver.get(A.PORTS)
+    if override:
+        out = []
+        for part in override.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            out.append(part if "/" in part else f"{part}/tcp")
+        return out
+    ports = []
+    for c in ko.containers(pod):
+        for p in c.get("ports", []):
+            proto = p.get("protocol", "TCP").lower()
+            ports.append(f"{p['containerPort']}/{proto}")
+    return ports
+
+
+def select_slice(pod: dict, resolver: AnnotationResolver, cfg: Config) -> AcceleratorType:
+    """Pick the slice shape: exact annotation, else catalog search by
+    (chips requested, generation, topology, HBM floor, cost ceiling).
+    Replaces price-sorted GPU selection (runpod_client.go:431-520)."""
+    exact = resolver.get(A.ACCELERATOR_TYPE)
+    if exact:
+        acc = lookup_accelerator(exact)
+        if acc is None:
+            raise TranslationError(f"unknown accelerator type {exact!r}")
+        return acc
+    chips = ko.tpu_chips_requested(pod)
+    if chips == 0:
+        raise TranslationError(
+            "pod requests no google.com/tpu chips and sets no "
+            f"{A.ACCELERATOR_TYPE} annotation")
+    generation = resolver.get(A.GENERATION) or cfg.default_generation
+    topology = resolver.get(A.TOPOLOGY) or None
+    min_hbm = resolver.get_int(A.MIN_HBM_GIB, 0) or None
+    # the pod annotation may only LOWER the operator's ceiling, never raise it
+    max_cost = resolver.get_float(A.MAX_COST_PER_HR, 0.0) or None
+    if cfg.max_cost_per_hr:
+        max_cost = min(max_cost, cfg.max_cost_per_hr) if max_cost else cfg.max_cost_per_hr
+    candidates = select_accelerator(chips=chips, generation=generation,
+                                    topology=topology, min_hbm_gib=min_hbm,
+                                    max_cost_per_hr=max_cost)
+    if not candidates:
+        raise TranslationError(
+            f"no {generation} slice with {chips} chips"
+            + (f" topology {topology}" if topology else "")
+            + (f" under ${max_cost}/hr" if max_cost else ""))
+    return candidates[0]
+
+
+def resolve_zone(resolver: AnnotationResolver, cfg: Config) -> str:
+    """Zone selection with the allowed-zones compliance filter
+    (parity: datacenter filter, runpod_client.go:1137-1178)."""
+    requested = [z.strip() for z in resolver.get(A.ZONES).split(",") if z.strip()]
+    allowed = cfg.zones or None
+    if requested:
+        usable = [z for z in requested if allowed is None or z in allowed]
+        if not usable:
+            raise TranslationError(
+                f"requested zones {requested} all outside allowed zones {allowed}")
+        return usable[0]
+    return cfg.zone
+
+
+def prepare_tpu_parameters(kube: KubeClient, pod: dict, cfg: Config) -> TpuParameters:
+    """The full pod -> deploy-request pipeline
+    (parity: PrepareRunPodParameters, runpod_client.go:1250-1377)."""
+    cs = ko.containers(pod)
+    if not cs:
+        raise TranslationError("pod has no containers")
+    if len(cs) > 1:
+        # A TPU slice runs one gang program; sidecars have no analog. Be loud
+        # (the reference silently ignored extra containers for image selection).
+        log.warning("pod %s has %d containers; the first (%s) is the workload, "
+                    "env is merged from all", ko.namespaced_name(pod), len(cs),
+                    cs[0].get("name"))
+    resolver = AnnotationResolver(kube, pod)
+
+    capacity_type = resolver.get(A.CAPACITY_TYPE, "on-demand").lower()
+    if capacity_type not in A.VALID_CAPACITY_TYPES:
+        log.warning("pod %s: invalid capacity-type %r — defaulting to on-demand "
+                    "(validation parity: runpod_client.go:1115-1134)",
+                    ko.namespaced_name(pod), capacity_type)
+        capacity_type = "on-demand"
+    reservation = resolver.get(A.RESERVATION)
+    if capacity_type == "reserved" and not reservation:
+        raise TranslationError("capacity-type=reserved requires tpu.dev/reservation")
+
+    acc = select_slice(pod, resolver, cfg)
+    zone = resolve_zone(resolver, cfg)
+    if cfg.max_cost_per_hr and acc.cost_per_hr > cfg.max_cost_per_hr:
+        raise TranslationError(
+            f"slice {acc.name} costs ${acc.cost_per_hr}/hr > configured "
+            f"ceiling ${cfg.max_cost_per_hr}/hr")
+
+    main = cs[0]
+    workload = WorkloadSpec(
+        image=main.get("image", ""),
+        command=list(main.get("command", [])),
+        args=list(main.get("args", [])),
+        env=extract_env(kube, pod),
+        ports=extract_ports(pod, resolver),
+        registry_auth_id=resolver.get(A.REGISTRY_AUTH),
+    )
+    if not workload.image:
+        raise TranslationError("workload container has no image")
+
+    return TpuParameters(
+        name=qr_name_for_pod(pod),
+        accelerator_type=acc.name,
+        runtime_version=(resolver.get(A.RUNTIME_VERSION)
+                         or cfg.default_runtime_version or acc.default_runtime),
+        zone=zone,
+        workload=workload,
+        spot=capacity_type == "spot",
+        reservation=reservation,
+        labels={
+            "managed-by": "tpu-virtual-kubelet",
+            "pod-uid": ko.uid(pod),
+            "pod-namespace": ko.namespace(pod),
+            "pod-name": ko.name(pod),
+            "node": cfg.node_name,
+        },
+    )

@@ -1,0 +1,16 @@
+"""Test bootstrap.
+
+Forces JAX onto a virtual 8-device CPU mesh BEFORE any jax import, so sharding
+tests (tp/dp/fsdp/sp) exercise real multi-device compilation without TPU hardware.
+Control-plane tests never import jax; the env vars are harmless for them.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,83 @@
+"""Shared test harness: a fully-wired hermetic kubelet.
+
+FakeKubeClient + FakeTpuServer + TpuClient + InMemoryWorkerTransport + a
+controllable clock — the hermetic full-loop setup the reference never had
+(SURVEY.md §4 lesson).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from k8s_runpod_kubelet_tpu.cloud import HttpTransport, TpuClient
+from k8s_runpod_kubelet_tpu.cloud.fake_server import FakeTpuServer
+from k8s_runpod_kubelet_tpu.config import Config
+from k8s_runpod_kubelet_tpu.gang import GangExecutor, InMemoryWorkerTransport
+from k8s_runpod_kubelet_tpu.kube import FakeKubeClient
+from k8s_runpod_kubelet_tpu.provider import Provider
+
+
+class FakeClock:
+    def __init__(self, t: float = 1_000_000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float):
+        self.t += dt
+
+
+@dataclasses.dataclass
+class Harness:
+    server: FakeTpuServer
+    kube: FakeKubeClient
+    tpu: TpuClient
+    provider: Provider
+    clock: FakeClock
+    transport: InMemoryWorkerTransport
+    cfg: Config
+
+    def close(self):
+        self.server.stop()
+
+    @property
+    def fake(self):
+        return self.server.service
+
+
+def make_harness(provision_delay_s: float = 0.0,
+                 workload_auto_finish_s: Optional[float] = None,
+                 cfg: Optional[Config] = None) -> Harness:
+    server = FakeTpuServer(provision_delay_s=provision_delay_s,
+                           workload_auto_finish_s=workload_auto_finish_s).start()
+    kube = FakeKubeClient()
+    tpu = TpuClient(HttpTransport(server.base_url, token="t", sleep=lambda s: None),
+                    project="test-proj", zone="us-central2-b")
+    clock = FakeClock()
+    cfg = cfg or Config(node_name="virtual-tpu", zone="us-central2-b")
+    transport = InMemoryWorkerTransport()
+    provider = Provider(cfg, kube, tpu, gang_executor=GangExecutor(transport),
+                        clock=clock)
+    return Harness(server=server, kube=kube, tpu=tpu, provider=provider,
+                   clock=clock, transport=transport, cfg=cfg)
+
+
+def make_pod(name="train", ns="default", node="virtual-tpu", chips=16,
+             annotations: Optional[dict] = None, ports: Optional[list] = None,
+             containers: Optional[list] = None, uid: Optional[str] = None):
+    if containers is None:
+        c = {"name": "main", "image": "gcr.io/proj/maxtext:latest"}
+        if chips:
+            c["resources"] = {"limits": {"google.com/tpu": str(chips)}}
+        if ports:
+            c["ports"] = [{"containerPort": p, "protocol": "TCP"} for p in ports]
+        containers = [c]
+    meta = {"name": name, "namespace": ns}
+    if uid:
+        meta["uid"] = uid
+    if annotations:
+        meta["annotations"] = dict(annotations)
+    return {"apiVersion": "v1", "kind": "Pod", "metadata": meta,
+            "spec": {"nodeName": node, "containers": containers}}

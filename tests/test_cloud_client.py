@@ -1,0 +1,193 @@
+"""Cloud layer (L1') tests: client <-> fake server, catalog selector, fault paths.
+
+Covers what the reference never tested hermetically (SURVEY.md §4): deploy,
+status, detailed status, delete-idempotency, list filters, quota failures,
+API blackout, preemption, vanish->NOT_FOUND.
+"""
+
+import pytest
+
+from k8s_runpod_kubelet_tpu.cloud import (
+    HttpTransport,
+    NotFoundError,
+    QuotaError,
+    QueuedResourceState,
+    TpuClient,
+    select_accelerator,
+    lookup_accelerator,
+)
+from k8s_runpod_kubelet_tpu.cloud.fake_server import FakeTpuServer
+from k8s_runpod_kubelet_tpu.cloud.tpu_client import TpuApiError, TpuParameters, WorkloadSpec
+
+
+@pytest.fixture()
+def server():
+    with FakeTpuServer() as s:
+        yield s
+
+
+@pytest.fixture()
+def client(server):
+    t = HttpTransport(server.base_url, token="test-token", sleep=lambda s: None)
+    return TpuClient(t, project="test-proj", zone="us-central2-b")
+
+
+def params(name="qr-test", acc="v5litepod-16", **kw):
+    return TpuParameters(
+        name=name, accelerator_type=acc, runtime_version="v2-alpha-tpuv5-lite",
+        zone="us-central2-b",
+        workload=WorkloadSpec(image="gcr.io/test/maxtext:latest",
+                              env={"MODEL": "llama3-8b"}, ports=["8471/tcp"]),
+        **kw)
+
+
+class TestCatalog:
+    def test_lookup(self):
+        a = lookup_accelerator("v5litepod-16")
+        assert a.chips == 16 and a.hosts == 4 and a.topology == "4x4"
+        assert a.generation == "v5e"
+
+    def test_single_host_slices(self):
+        assert lookup_accelerator("v5litepod-1").hosts == 1
+        assert lookup_accelerator("v5litepod-8").hosts == 1
+        assert lookup_accelerator("v5litepod-8").chips_per_host == 8
+
+    def test_select_by_chips_sorted_by_cost(self):
+        got = select_accelerator(chips=16)
+        assert got and got[0].cost_per_hr == min(a.cost_per_hr for a in got)
+        assert all(a.chips == 16 for a in got)
+
+    def test_select_generation_topology(self):
+        got = select_accelerator(generation="v5p", topology="2x4x4")
+        assert len(got) == 1 and got[0].name == "v5p-64"
+
+    def test_select_cost_ceiling_and_limit(self):
+        got = select_accelerator(max_cost_per_hr=5.0)
+        assert len(got) <= 5
+        assert all(a.cost_per_hr <= 5.0 for a in got)
+
+
+class TestLifecycle:
+    def test_create_get_delete(self, client, server):
+        r = client.create_queued_resource(params())
+        assert r.name == "qr-test"
+        assert r.state is QueuedResourceState.ACTIVE  # zero provision delay
+        assert len(r.workers) == 4  # v5e-16 = 4 hosts
+        got = client.get_queued_resource("qr-test")
+        assert got.accelerator_type == "v5litepod-16"
+        client.delete_queued_resource("qr-test")
+        with pytest.raises(NotFoundError):
+            client.get_queued_resource("qr-test")
+
+    def test_delete_is_idempotent(self, client):
+        client.delete_queued_resource("never-existed")  # no raise
+
+    def test_provisioning_states(self):
+        with FakeTpuServer(provision_delay_s=3600) as s:
+            c = TpuClient(HttpTransport(s.base_url, sleep=lambda x: None), "p")
+            r = c.create_queued_resource(params())
+            assert r.state is QueuedResourceState.ACCEPTED
+            assert r.workers == []
+            s.service.advance_all()
+            r = c.get_queued_resource("qr-test")
+            assert r.state is QueuedResourceState.ACTIVE
+
+    def test_duplicate_create_conflicts(self, client):
+        client.create_queued_resource(params())
+        with pytest.raises(TpuApiError) as ei:
+            client.create_queued_resource(params())
+        assert ei.value.status == 409
+
+    def test_invalid_accelerator(self, client):
+        with pytest.raises(TpuApiError):
+            client.create_queued_resource(params(acc="h100-80gb"))
+
+    def test_invalid_name(self, client):
+        with pytest.raises(TpuApiError):
+            client.create_queued_resource(params(name="Bad_Name!"))
+
+    def test_list_with_state_filter(self, client, server):
+        client.create_queued_resource(params(name="qr-a"))
+        client.create_queued_resource(params(name="qr-b"))
+        server.service.preempt("qr-b")
+        active = client.list_queued_resources([QueuedResourceState.ACTIVE])
+        assert [r.name for r in active] == ["qr-a"]
+        susp = client.list_queued_resources([QueuedResourceState.SUSPENDED])
+        assert [r.name for r in susp] == ["qr-b"]
+
+
+class TestWorkload:
+    def test_gang_launch_and_finish(self, client, server):
+        client.create_queued_resource(params())
+        spec = WorkloadSpec(image="img", ports=["8471/tcp"])
+        env = [{"TPU_WORKER_ID": str(i)} for i in range(4)]
+        client.start_workload("qr-test", spec, worker_env=env)
+        d = client.get_detailed_status("qr-test")
+        assert len(d.runtime) == 4
+        assert all(w.workload_running for w in d.runtime)
+        assert d.all_workers_healthy and not d.all_exited
+        assert 8471 in d.ports
+        server.service.get("qr-test").finish_workload(exit_codes=[0, 0, 0, 1])
+        d = client.get_detailed_status("qr-test")
+        assert d.all_exited and d.max_exit_code == 1
+
+    def test_workload_requires_active(self):
+        with FakeTpuServer(provision_delay_s=3600) as s:
+            c = TpuClient(HttpTransport(s.base_url, sleep=lambda x: None), "p")
+            c.create_queued_resource(params())
+            with pytest.raises(TpuApiError) as ei:
+                c.start_workload("qr-test", WorkloadSpec(image="img"))
+            assert ei.value.status == 409
+
+
+class TestFaultInjection:
+    def test_detailed_status_vanished_is_not_found_not_error(self, client, server):
+        client.create_queued_resource(params())
+        server.service.vanish("qr-test")
+        d = client.get_detailed_status("qr-test")
+        assert d.resource.state is QueuedResourceState.NOT_FOUND
+
+    def test_quota_error_typed(self, client, server):
+        server.service.fail_next_create = (429, "insufficient v5e capacity in zone")
+        with pytest.raises(QuotaError):
+            client.create_queued_resource(params())
+        # next create succeeds (fault is one-shot)
+        r = client.create_queued_resource(params())
+        assert r.state is QueuedResourceState.ACTIVE
+
+    def test_api_down_health_check(self, client, server):
+        assert client.health_check() is True
+        server.service.api_down = True
+        assert client.health_check() is False
+
+    def test_preemption_surfaces_suspended(self, client, server):
+        client.create_queued_resource(params())
+        client.start_workload("qr-test", WorkloadSpec(image="img"))
+        server.service.preempt("qr-test")
+        d = client.get_detailed_status("qr-test")
+        assert d.resource.state is QueuedResourceState.SUSPENDED
+        assert not d.all_workers_healthy
+
+    def test_single_worker_preemption_breaks_gang_health(self, client, server):
+        client.create_queued_resource(params())
+        client.start_workload("qr-test", WorkloadSpec(image="img"))
+        server.service.preempt("qr-test", worker_id=2)
+        d = client.get_detailed_status("qr-test")
+        assert d.resource.state is QueuedResourceState.ACTIVE  # slice still "up"
+        assert not d.all_workers_healthy  # but the gang is broken
+
+    def test_5xx_retries_then_raises(self, server):
+        sleeps = []
+        t = HttpTransport(server.base_url, sleep=sleeps.append)
+        c = TpuClient(t, "p")
+        server.service.api_down = True
+        with pytest.raises(TpuApiError):
+            c.list_accelerator_types()
+        assert len(sleeps) == 2  # 3 attempts, 2 backoffs
+
+    def test_404_not_retried(self, server):
+        sleeps = []
+        c = TpuClient(HttpTransport(server.base_url, sleep=sleeps.append), "p")
+        with pytest.raises(NotFoundError):
+            c.get_queued_resource("nope")
+        assert sleeps == []

@@ -1,0 +1,150 @@
+"""L3' controllers e2e: node registration/lease, watch-driven pod dispatch,
+kubelet API — the full loop threaded against the fakes (SURVEY.md §7.3's
+"minimum end-to-end slice", hermetic)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from k8s_runpod_kubelet_tpu.node import KubeletApiServer, NodeController, PodController
+from k8s_runpod_kubelet_tpu.provider.annotations import Annotations as A
+from k8s_runpod_kubelet_tpu.kube import objects as ko
+
+from harness import make_harness, make_pod
+
+
+def wait_for(cond, timeout=8.0, interval=0.02, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.fixture()
+def h():
+    h = make_harness()
+    yield h
+    h.close()
+
+
+class TestNodeController:
+    def test_register_push_lease(self, h):
+        nc = NodeController(h.kube, h.provider)
+        nc.register_node()
+        node = h.kube.get_node("virtual-tpu")
+        assert node["status"]["capacity"]["google.com/tpu"] == "512"
+        assert node["spec"]["taints"][0]["key"] == "virtual-kubelet.io/provider"
+        assert node["metadata"]["labels"]["type"] == "virtual-kubelet"
+        nc.renew_lease()
+        lease = h.kube.get_lease("virtual-tpu")
+        assert lease["spec"]["holderIdentity"] == "virtual-tpu"
+        first_renew = lease["spec"]["renewTime"]
+        nc.renew_lease()  # update path
+        assert h.kube.get_lease("virtual-tpu")["spec"]["renewTime"] >= first_renew
+
+    def test_register_adopts_existing_node(self, h):
+        h.kube.create_node({"metadata": {"name": "virtual-tpu"}, "spec": {}})
+        nc = NodeController(h.kube, h.provider)
+        nc.register_node()  # conflict -> update, no raise
+        assert h.kube.get_node("virtual-tpu")["status"]["capacity"]["google.com/tpu"]
+
+    def test_unhealthy_cloud_flips_ready_condition(self, h):
+        nc = NodeController(h.kube, h.provider)
+        nc.register_node()
+        h.fake.api_down = True
+        h.provider._probe_cloud(force=True)
+        nc.push_status()
+        conds = {c["type"]: c for c in h.kube.get_node("virtual-tpu")["status"]["conditions"]}
+        assert conds["Ready"]["status"] == "False"
+
+
+class TestPodControllerE2E:
+    def test_full_lifecycle_through_watch(self, h):
+        pc = PodController(h.kube, h.provider, "virtual-tpu", resync_interval_s=3600)
+        pc.start()
+        try:
+            wait_for(pc.ready.is_set, msg="watch established")
+            h.kube.create_pod(make_pod(chips=16))
+            wait_for(lambda: h.provider.instances.get("default/train")
+                     and h.provider.instances["default/train"].qr_name,
+                     msg="provider deployed slice")
+            h.provider.update_all_pod_statuses()
+            wait_for(lambda: ko.phase(h.kube.get_pod("default", "train")) == "Running",
+                     msg="pod Running")
+            # graceful delete via API -> watch sees deletionTimestamp -> provider
+            # terminates slice and grace-0 finalizes
+            h.kube.delete_pod("default", "train")
+            wait_for(lambda: h.kube.list_pods() == [], msg="pod finalized")
+            assert h.fake.resources == {}  # slice gone too
+        finally:
+            pc.stop()
+
+    def test_resync_repairs_missed_events(self, h):
+        pc = PodController(h.kube, h.provider, "virtual-tpu", resync_interval_s=3600)
+        # no watch running: create a pod "while the kubelet was partitioned"
+        h.kube.create_pod(make_pod(chips=16))
+        pc.resync()
+        assert h.provider.instances["default/train"].qr_name
+        # pod force-deleted out-of-band: resync tells the provider
+        h.kube.delete_pod("default", "train", grace_period_s=0)
+        pc.resync()
+        assert h.provider.get_pods() == []
+
+    def test_dispatch_failure_requeues(self, h):
+        calls = {"n": 0}
+        real_create = h.provider.create_pod
+
+        def flaky(pod):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("transient")
+            return real_create(pod)
+
+        h.provider.create_pod = flaky
+        pc = PodController(h.kube, h.provider, "virtual-tpu", resync_interval_s=3600)
+        pc.start()
+        try:
+            wait_for(pc.ready.is_set, msg="watch up")
+            h.kube.create_pod(make_pod(chips=16))
+            wait_for(lambda: calls["n"] >= 2, msg="retry happened")
+            wait_for(lambda: h.provider.instances.get("default/train") is not None
+                     and h.provider.instances["default/train"].qr_name,
+                     msg="deploy after retry")
+        finally:
+            pc.stop()
+
+
+class TestKubeletApi:
+    def test_pods_logs_run_endpoints(self, h):
+        h.kube.create_pod(make_pod(chips=16))
+        h.provider.create_pod(h.kube.get_pod("default", "train"))
+        h.provider.update_all_pod_statuses()
+        qr = h.provider.instances["default/train"].qr_name
+        h.transport.append_log(qr, 0, "hello from w0")
+        h.transport.responses["echo"] = "ok\n"
+        srv = KubeletApiServer(h.provider, address="127.0.0.1", port=0).start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            pods = json.load(urllib.request.urlopen(f"{base}/pods"))
+            assert pods["items"][0]["metadata"]["name"] == "train"
+            logs = urllib.request.urlopen(
+                f"{base}/containerLogs/default/train/main?worker=0").read().decode()
+            assert logs.strip() == "hello from w0"
+            req = urllib.request.Request(
+                f"{base}/run/default/train/main",
+                data=json.dumps({"cmd": ["echo", "hi"]}).encode(), method="POST")
+            out = urllib.request.urlopen(req).read().decode()
+            assert out == "ok\n"
+            # 404 for unknown pod
+            try:
+                urllib.request.urlopen(f"{base}/containerLogs/default/nope/main")
+                assert False, "expected 404"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            srv.stop()

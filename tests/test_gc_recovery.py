@@ -1,0 +1,168 @@
+"""GC ladder + crash-recovery tests (kubelet.go:1188-1796 parity, hermetic)."""
+
+import pytest
+
+from k8s_runpod_kubelet_tpu.cloud.types import QueuedResourceState as S
+from k8s_runpod_kubelet_tpu.provider.annotations import Annotations as A
+from k8s_runpod_kubelet_tpu.kube import objects as ko
+
+from harness import make_harness, make_pod
+
+
+@pytest.fixture()
+def h():
+    h = make_harness()
+    yield h
+    h.close()
+
+
+def bind_pod(h, pod):
+    created = h.kube.create_pod(pod)
+    h.provider.create_pod(created)
+    return h.kube.get_pod(ko.namespace(created), ko.name(created))
+
+
+class TestCleanup:
+    def test_tombstone_reterminates_until_gone(self, h):
+        pod = bind_pod(h, make_pod(chips=16))
+        qr = ko.annotations(pod)[A.QUEUED_RESOURCE]
+        # make the slice survive the first delete (stuck DELETING)
+        h.fake.stuck(qr, S.DELETING)
+        h.provider.delete_pod(pod)
+        assert qr in h.fake.resources  # still there
+        assert "default/train" in h.provider.deleted
+        # sweep: re-terminates after 60s
+        h.clock.advance(120)
+        h.fake.get(qr).provision_delay_s = 0.0  # unstick: next delete works
+        h.provider.cleanup_deleted_pods()
+        h.provider.cleanup_deleted_pods()  # second pass notices 404, drops tombstone
+        assert qr not in h.fake.resources
+        assert h.provider.deleted == {}
+
+    def test_stuck_terminating_no_slice_forced(self, h):
+        pod = h.kube.create_pod(make_pod(name="zombie", chips=16))
+        h.kube.delete_pod("default", "zombie")  # graceful -> deletionTimestamp
+        h.provider.cleanup_stuck_terminating_pods()
+        assert h.kube.list_pods() == []  # forced immediately (kubelet.go:1253-1271)
+
+    def test_stuck_terminating_reterminate_after_5min(self, h):
+        pod = bind_pod(h, make_pod(chips=16))
+        qr = ko.annotations(pod)[A.QUEUED_RESOURCE]
+        h.kube.delete_pod("default", "train")  # deletionTimestamp now (real time)
+        deletes_before = h.fake.delete_count
+        h.provider.cleanup_stuck_terminating_pods()
+        assert h.fake.delete_count == deletes_before  # < 5 min: no action
+        # rewrite deletionTimestamp 6 minutes into the past
+        import time
+        past = ko.now_iso(time.time() - 6 * 60)
+        h.kube.store[("pods", "default", "train")]["metadata"]["deletionTimestamp"] = past
+        h.clock.t = time.time()  # align fake clock with wall time for this test
+        h.provider.cleanup_stuck_terminating_pods()
+        assert h.fake.delete_count == deletes_before + 1  # re-terminated (:1332-1347)
+        assert h.kube.list_pods() != []  # but pod not yet forced
+
+    def test_stuck_terminating_force_after_15min(self, h):
+        pod = bind_pod(h, make_pod(chips=16))
+        qr = ko.annotations(pod)[A.QUEUED_RESOURCE]
+        h.fake.stuck(qr, S.DELETING)
+        h.kube.delete_pod("default", "train")
+        import time
+        past = ko.now_iso(time.time() - 16 * 60)
+        h.kube.store[("pods", "default", "train")]["metadata"]["deletionTimestamp"] = past
+        h.clock.t = time.time()
+        h.provider.cleanup_stuck_terminating_pods()
+        assert h.kube.list_pods() == []  # forced regardless (:1350-1366)
+
+    def test_orphan_slice_swept_when_pod_gone(self, h):
+        pod = bind_pod(h, make_pod(chips=16))
+        qr = ko.annotations(pod)[A.QUEUED_RESOURCE]
+        # pod vanishes from K8s without the provider seeing a delete event;
+        # drop provider caches to simulate a restart that lost them
+        h.kube.delete_pod("default", "train", grace_period_s=0)
+        h.provider.pods.clear()
+        h.provider.instances.clear()
+        h.provider.cleanup_orphaned_slices()
+        assert qr not in h.fake.resources
+
+    def test_orphan_sweep_spares_foreign_slices(self, h):
+        from k8s_runpod_kubelet_tpu.cloud.tpu_client import TpuParameters, WorkloadSpec
+        h.tpu.create_queued_resource(TpuParameters(
+            name="qr-foreign", accelerator_type="v5litepod-4",
+            runtime_version="x", zone="us-central2-b",
+            workload=WorkloadSpec(image="img"),
+            labels={"managed-by": "someone-else"}))
+        h.provider.cleanup_orphaned_slices()
+        assert "qr-foreign" in h.fake.resources
+
+
+class TestRecovery:
+    def test_rebinds_annotated_pod(self, h):
+        pod = bind_pod(h, make_pod(chips=16))
+        h.provider.update_all_pod_statuses()  # launch workload
+        qr = ko.annotations(pod)[A.QUEUED_RESOURCE]
+        # simulate restart: fresh provider, same kube + cloud
+        from harness import make_harness as _mh
+        from k8s_runpod_kubelet_tpu.provider import Provider
+        from k8s_runpod_kubelet_tpu.gang import GangExecutor
+        p2 = Provider(h.cfg, h.kube, h.tpu,
+                      gang_executor=GangExecutor(h.transport), clock=h.clock)
+        p2.load_running()
+        info = p2.instances["default/train"]
+        assert info.qr_name == qr
+        assert info.workload_launched is True  # inferred from live runtime
+        p2.update_all_pod_statuses()
+        assert h.kube.get_pod("default", "train")["status"]["phase"] == "Running"
+
+    def test_rebinds_by_pod_uid_label_when_annotation_lost(self, h):
+        pod = bind_pod(h, make_pod(chips=16))
+        qr = ko.annotations(pod)[A.QUEUED_RESOURCE]
+        # annotation patch never landed (crash between create and annotate)
+        h.kube.patch_pod("default", "train",
+                         {"metadata": {"annotations": {A.QUEUED_RESOURCE: None}}})
+        from k8s_runpod_kubelet_tpu.provider import Provider
+        p2 = Provider(h.cfg, h.kube, h.tpu, clock=h.clock)
+        p2.load_running()
+        assert p2.instances["default/train"].qr_name == qr
+
+    def test_missing_slice_marks_failed(self, h):
+        pod = bind_pod(h, make_pod(chips=16))
+        h.fake.vanish(ko.annotations(pod)[A.QUEUED_RESOURCE])
+        from k8s_runpod_kubelet_tpu.provider import Provider
+        p2 = Provider(h.cfg, h.kube, h.tpu, clock=h.clock)
+        p2.load_running()
+        got = h.kube.get_pod("default", "train")
+        assert got["status"]["phase"] == "Failed"
+        assert A.QUEUED_RESOURCE not in ko.annotations(got)
+
+    def test_undeployed_pod_becomes_pending(self, h):
+        h.kube.create_pod(make_pod(chips=16))  # bound but provider never saw it
+        from k8s_runpod_kubelet_tpu.provider import Provider
+        p2 = Provider(h.cfg, h.kube, h.tpu, clock=h.clock)
+        p2.load_running()
+        assert p2.instances["default/train"].pending_since is not None
+        p2.process_pending_pods()  # deploys now
+        assert p2.instances["default/train"].qr_name
+
+    def test_orphan_running_slice_adopted_as_virtual_pod(self, h):
+        pod = bind_pod(h, make_pod(chips=16))
+        h.provider.update_all_pod_statuses()
+        qr = ko.annotations(pod)[A.QUEUED_RESOURCE]
+        h.kube.delete_pod("default", "train", grace_period_s=0)  # pod gone, slice alive
+        from k8s_runpod_kubelet_tpu.provider import Provider
+        p2 = Provider(h.cfg, h.kube, h.tpu, clock=h.clock)
+        p2.load_running()
+        adopted = h.kube.get_pod("default", "train")  # recreated from labels
+        assert ko.annotations(adopted)[A.EXTERNAL] == "true"  # kubelet.go:1580
+        assert ko.node_name(adopted) == "virtual-tpu"  # fixed node-name bug
+        assert p2.instances["default/train"].qr_name == qr
+
+    def test_orphan_terminal_slice_deleted_not_adopted(self, h):
+        pod = bind_pod(h, make_pod(chips=16))
+        qr = ko.annotations(pod)[A.QUEUED_RESOURCE]
+        h.fake.preempt(qr)
+        h.kube.delete_pod("default", "train", grace_period_s=0)
+        from k8s_runpod_kubelet_tpu.provider import Provider
+        p2 = Provider(h.cfg, h.kube, h.tpu, clock=h.clock)
+        p2.load_running()
+        assert qr not in h.fake.resources
+        assert h.kube.list_pods() == []

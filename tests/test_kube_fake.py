@@ -1,0 +1,101 @@
+"""Kube layer tests: fake clientset semantics the controllers depend on."""
+
+import threading
+
+import pytest
+
+from k8s_runpod_kubelet_tpu.kube import FakeKubeClient, KubeApiError
+from k8s_runpod_kubelet_tpu.kube import objects as ko
+
+
+def make_pod(name="p1", ns="default", node="tpu-node", **meta_extra):
+    return {
+        "metadata": {"name": name, "namespace": ns, **meta_extra},
+        "spec": {"nodeName": node,
+                 "containers": [{"name": "main", "image": "busybox"}]},
+    }
+
+
+def test_crud_and_404():
+    k = FakeKubeClient()
+    with pytest.raises(KubeApiError) as ei:
+        k.get_pod("default", "nope")
+    assert ei.value.is_not_found
+    created = k.create_pod(make_pod())
+    assert ko.uid(created)
+    assert k.get_pod("default", "p1")["spec"]["nodeName"] == "tpu-node"
+
+
+def test_field_selector_scoping():
+    k = FakeKubeClient()
+    k.create_pod(make_pod("a", node="tpu-node"))
+    k.create_pod(make_pod("b", node="other-node"))
+    got = k.list_pods(field_selector="spec.nodeName=tpu-node")
+    assert [ko.name(p) for p in got] == ["a"]
+    got = k.list_pods(field_selector="spec.nodeName!=tpu-node")
+    assert [ko.name(p) for p in got] == ["b"]
+
+
+def test_merge_patch_annotations_and_status():
+    k = FakeKubeClient()
+    k.create_pod(make_pod())
+    k.patch_pod("default", "p1", {"metadata": {"annotations": {"tpu.dev/qr": "x"}}})
+    k.patch_pod("default", "p1", {"metadata": {"annotations": {"tpu.dev/cost": "1.2"}}})
+    p = k.get_pod("default", "p1")
+    assert ko.annotations(p) == {"tpu.dev/qr": "x", "tpu.dev/cost": "1.2"}
+    k.patch_pod_status("default", "p1", {"status": {"phase": "Running"}})
+    assert ko.phase(k.get_pod("default", "p1")) == "Running"
+    # None deletes a key (annotation-strip path, kubelet.go:1708-1773 analog)
+    k.patch_pod("default", "p1", {"metadata": {"annotations": {"tpu.dev/qr": None}}})
+    assert "tpu.dev/qr" not in ko.annotations(k.get_pod("default", "p1"))
+
+
+def test_graceful_then_force_delete():
+    k = FakeKubeClient()
+    k.create_pod(make_pod())
+    k.delete_pod("default", "p1")  # graceful: sets deletionTimestamp
+    p = k.get_pod("default", "p1")
+    assert ko.deletion_timestamp(p)
+    k.delete_pod("default", "p1", grace_period_s=0)  # force: actually removes
+    with pytest.raises(KubeApiError):
+        k.get_pod("default", "p1")
+
+
+def test_watch_stream_sees_lifecycle():
+    k = FakeKubeClient()
+    k.create_pod(make_pod("pre"))
+    stop = threading.Event()
+    events = []
+
+    def consume():
+        for ev in k.watch_pods(field_selector="spec.nodeName=tpu-node", stop=stop):
+            events.append((ev.type, ko.name(ev.object)))
+            if len(events) >= 4:
+                stop.set()
+
+    t = threading.Thread(target=consume)
+    t.start()
+    k.create_pod(make_pod("live"))
+    k.patch_pod_status("default", "live", {"status": {"phase": "Running"}})
+    k.delete_pod("default", "live", grace_period_s=0)
+    k.create_pod(make_pod("other", node="not-ours"))  # filtered out
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert events == [("ADDED", "pre"), ("ADDED", "live"),
+                      ("MODIFIED", "live"), ("DELETED", "live")]
+
+
+def test_tpu_chips_requested():
+    pod = make_pod()
+    pod["spec"]["containers"][0]["resources"] = {"limits": {"google.com/tpu": "16"}}
+    assert ko.tpu_chips_requested(pod) == 16
+    assert ko.tpu_chips_requested(make_pod()) == 0
+
+
+def test_fault_injection_one_shot():
+    k = FakeKubeClient()
+    k.create_pod(make_pod())
+    k.fail_next["patch_pod_status"] = KubeApiError("boom", status=500)
+    with pytest.raises(KubeApiError):
+        k.patch_pod_status("default", "p1", {"status": {"phase": "Running"}})
+    k.patch_pod_status("default", "p1", {"status": {"phase": "Running"}})  # recovers

@@ -1,0 +1,231 @@
+"""Provider lifecycle e2e against the fakes: the full reconcile loop the
+reference never tested hermetically (SURVEY.md §4 lesson).
+
+Walks pod create -> slice deploy -> gang launch -> Running -> completion ->
+delete, plus the failure paths: deploy failure retry, quota, preemption
+(gang-fail), missing slice, API blackout.
+"""
+
+import pytest
+
+from k8s_runpod_kubelet_tpu.cloud.types import QueuedResourceState as S
+from k8s_runpod_kubelet_tpu.provider.annotations import Annotations as A
+from k8s_runpod_kubelet_tpu.kube import objects as ko
+
+from harness import make_harness, make_pod
+
+
+@pytest.fixture()
+def h():
+    h = make_harness()
+    yield h
+    h.close()
+
+
+def bind_pod(h, pod):
+    """Simulate the scheduler: create in K8s, then hand to the provider."""
+    created = h.kube.create_pod(pod)
+    h.provider.create_pod(created)
+    return h.kube.get_pod(ko.namespace(created), ko.name(created))
+
+
+class TestHappyPath:
+    def test_create_deploys_and_annotates(self, h):
+        pod = bind_pod(h, make_pod(chips=16))
+        ann = ko.annotations(pod)
+        assert ann[A.QUEUED_RESOURCE].startswith("qr-")
+        assert ann[A.ACCELERATOR_TYPE] == "v5litepod-16"
+        assert float(ann[A.COST_PER_HR]) == pytest.approx(19.2)
+        assert h.fake.create_count == 1
+
+    def test_reconcile_gang_launches_then_running(self, h):
+        pod = bind_pod(h, make_pod(chips=16))
+        qr_name = ko.annotations(pod)[A.QUEUED_RESOURCE]
+        h.provider.update_all_pod_statuses()  # pass 1: gang launch + status
+        fake_qr = h.fake.get(qr_name)
+        assert len(fake_qr.runtime) == 4  # 4 workers launched together
+        # per-worker env was injected
+        envs = fake_qr.worker_env
+        assert [e["TPU_WORKER_ID"] for e in envs] == ["0", "1", "2", "3"]
+        assert envs[0]["TPU_WORKER_HOSTNAMES"] == envs[3]["TPU_WORKER_HOSTNAMES"]
+        assert envs[1]["JAX_PROCESS_ID"] == "1"
+        assert envs[0]["JAX_COORDINATOR_ADDRESS"].endswith(":8476")
+        status = h.kube.get_pod("default", "train")["status"]
+        assert status["phase"] == "Running"
+        assert status["podIP"]
+        assert status["containerStatuses"][0]["ready"] is True
+
+    def test_completion_all_zero_is_succeeded(self, h):
+        pod = bind_pod(h, make_pod(chips=16))
+        h.provider.update_all_pod_statuses()
+        h.fake.get(ko.annotations(pod)[A.QUEUED_RESOURCE]).finish_workload()
+        h.provider.update_all_pod_statuses()
+        status = h.kube.get_pod("default", "train")["status"]
+        assert status["phase"] == "Succeeded"
+        cs = status["containerStatuses"][0]["state"]["terminated"]
+        assert cs["exitCode"] == 0 and cs["reason"] == "Completed"
+
+    def test_completion_nonzero_is_failed_with_code(self, h):
+        pod = bind_pod(h, make_pod(chips=16))
+        h.provider.update_all_pod_statuses()
+        h.fake.get(ko.annotations(pod)[A.QUEUED_RESOURCE]).finish_workload(
+            exit_codes=[0, 0, 137, 0])
+        h.provider.update_all_pod_statuses()
+        status = h.kube.get_pod("default", "train")["status"]
+        assert status["phase"] == "Failed"
+        assert status["containerStatuses"][0]["state"]["terminated"]["exitCode"] == 137
+
+    def test_delete_terminates_slice_and_removes_pod(self, h):
+        pod = bind_pod(h, make_pod(chips=16))
+        qr = ko.annotations(pod)[A.QUEUED_RESOURCE]
+        h.provider.delete_pod(pod)
+        assert qr not in h.fake.resources
+        assert h.kube.list_pods() == []
+        assert h.provider.get_pods() == []
+
+    def test_north_star_latency_recorded(self, h):
+        bind_pod(h, make_pod(chips=16))
+        h.clock.advance(7.5)
+        h.provider.update_all_pod_statuses()
+        obs = h.provider.metrics.get_observations("tpu_kubelet_schedule_to_ready_seconds")
+        assert len(obs) == 1 and obs[0] == pytest.approx(7.5)
+
+
+class TestProvisioningStates:
+    def test_queued_slice_is_pending_not_failed(self, h):
+        # slow-provisioning server: slice sits ACCEPTED
+        import harness
+        slow = harness.make_harness(provision_delay_s=3600)
+        try:
+            pod = bind_pod(slow, make_pod(chips=16))
+            slow.provider.update_all_pod_statuses()
+            status = slow.kube.get_pod("default", "train")["status"]
+            assert status["phase"] == "Pending"
+            assert status["reason"] in ("SliceQueued", "SliceProvisioning")
+            # hours of queueing must NOT fail the pod (hard-part #3)
+            slow.clock.advance(3600)
+            slow.provider.update_all_pod_statuses()
+            slow.provider.process_pending_pods()
+            assert slow.kube.get_pod("default", "train")["status"]["phase"] == "Pending"
+            # until capacity arrives
+            slow.fake.advance_all()
+            slow.provider.update_all_pod_statuses()
+            assert slow.kube.get_pod("default", "train")["status"]["phase"] == "Running"
+        finally:
+            slow.close()
+
+
+class TestFailurePaths:
+    def test_deploy_failure_keeps_pod_pending_then_retry_succeeds(self, h):
+        h.fake.fail_next_create = (429, "no v5e capacity")
+        pod = bind_pod(h, make_pod(chips=16))
+        assert A.QUEUED_RESOURCE not in ko.annotations(pod)
+        assert h.provider.get_pods()  # still tracked (kubelet.go:412-415)
+        h.clock.advance(30)
+        h.provider.process_pending_pods()  # retry succeeds now
+        pod = h.kube.get_pod("default", "train")
+        assert A.QUEUED_RESOURCE in ko.annotations(pod)
+
+    def test_pending_give_up_marks_failed(self, h):
+        h.fake.api_down = True
+        h.provider._probe_cloud(force=True)
+        bind_pod(h, make_pod(chips=16))
+        h.clock.advance(16 * 60)  # > 15 min give-up (kubelet.go:788)
+        h.provider.process_pending_pods()
+        status = h.kube.get_pod("default", "train")["status"]
+        assert status["phase"] == "Failed"
+        assert status["reason"] == "DeploymentFailed"
+
+    def test_deploy_skipped_while_cloud_down(self, h):
+        h.fake.api_down = True
+        h.provider._probe_cloud(force=True)
+        pod = bind_pod(h, make_pod(chips=16))
+        assert h.fake.create_count == 0  # parity: kubelet.go:458-460
+        assert A.QUEUED_RESOURCE not in ko.annotations(pod)
+
+    def test_preemption_fails_pod(self, h):
+        pod = bind_pod(h, make_pod(chips=16))
+        h.provider.update_all_pod_statuses()
+        h.fake.preempt(ko.annotations(pod)[A.QUEUED_RESOURCE])
+        h.provider.update_all_pod_statuses()
+        status = h.kube.get_pod("default", "train")["status"]
+        assert status["phase"] == "Failed" and status["reason"] == "Preempted"
+
+    def test_single_worker_death_gang_fails_whole_pod(self, h):
+        pod = bind_pod(h, make_pod(chips=16))
+        h.provider.update_all_pod_statuses()
+        assert h.kube.get_pod("default", "train")["status"]["phase"] == "Running"
+        h.fake.preempt(ko.annotations(pod)[A.QUEUED_RESOURCE], worker_id=2)
+        h.provider.update_all_pod_statuses()
+        status = h.kube.get_pod("default", "train")["status"]
+        assert status["phase"] == "Failed" and status["reason"] == "GangBroken"
+
+    def test_vanished_slice_strips_annotations_and_fails(self, h):
+        pod = bind_pod(h, make_pod(chips=16))
+        h.provider.update_all_pod_statuses()
+        h.fake.vanish(ko.annotations(pod)[A.QUEUED_RESOURCE])
+        h.provider.update_all_pod_statuses()
+        pod = h.kube.get_pod("default", "train")
+        assert pod["status"]["phase"] == "Failed"
+        assert pod["status"]["reason"] == "SliceNotFound"
+        assert A.QUEUED_RESOURCE not in ko.annotations(pod)  # kubelet.go:1708-1773
+
+    def test_status_patch_failure_falls_back_to_notify(self, h):
+        received = []
+        h.provider.notify_pods(received.append)
+        bind_pod(h, make_pod(chips=16))
+        h.kube.fail_next["patch_pod_status"] = __import__(
+            "k8s_runpod_kubelet_tpu.kube.client", fromlist=["KubeApiError"]
+        ).KubeApiError("boom", status=500)
+        h.provider.update_all_pod_statuses()
+        assert received and received[0]["status"]["phase"] == "Running"
+
+    def test_notify_callback_exception_recovered(self, h):
+        def bad_cb(pod):
+            raise RuntimeError("listener bug")
+        h.provider.notify_pods(bad_cb)
+        bind_pod(h, make_pod(chips=16))
+        h.kube.fail_next["patch_pod_status"] = __import__(
+            "k8s_runpod_kubelet_tpu.kube.client", fromlist=["KubeApiError"]
+        ).KubeApiError("boom", status=500)
+        h.provider.update_all_pod_statuses()  # must not raise (kubelet.go:938-946)
+
+
+class TestPorts:
+    def test_tcp_port_gates_readiness(self, h):
+        pod = bind_pod(h, make_pod(chips=16, ports=[8471]))
+        h.provider.update_all_pod_statuses()
+        # fake maps requested ports on launch, so it goes Running
+        assert h.kube.get_pod("default", "train")["status"]["phase"] == "Running"
+
+    def test_unmapped_tcp_port_blocks_readiness(self, h):
+        pod = bind_pod(h, make_pod(chips=16, ports=[8471]))
+        h.provider.update_all_pod_statuses()
+        qr = h.fake.get(ko.annotations(pod)[A.QUEUED_RESOURCE])
+        qr.ports.clear()  # mapping lost
+        h.provider.instances["default/train"].fingerprint = ()  # force re-eval
+        h.provider.update_all_pod_statuses()
+        status = h.kube.get_pod("default", "train")["status"]
+        assert status["phase"] == "Pending"
+        assert status["reason"] == "ContainerCreating"
+
+
+class TestExecAndLogs:
+    def test_logs_aggregated_across_workers(self, h):
+        pod = bind_pod(h, make_pod(chips=16))
+        h.provider.update_all_pod_statuses()
+        qr = ko.annotations(pod)[A.QUEUED_RESOURCE]
+        for w in range(4):
+            h.transport.append_log(qr, w, f"step 1 on worker {w}")
+        logs = h.provider.get_container_logs("default", "train", "main")
+        assert "worker 0" in logs and "step 1 on worker 3" in logs
+        one = h.provider.get_container_logs("default", "train", "main", worker=2)
+        assert one.strip() == "step 1 on worker 2"
+
+    def test_run_in_container(self, h):
+        bind_pod(h, make_pod(chips=16))
+        h.provider.update_all_pod_statuses()
+        h.transport.responses["hostname"] = "qr-host-w0\n"
+        out = h.provider.run_in_container("default", "train", "main", ["hostname"])
+        assert out == "qr-host-w0\n"
+        assert h.transport.calls[-1][2] == ["hostname"]

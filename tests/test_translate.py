@@ -1,0 +1,204 @@
+"""Spec-translation tests (parity with annotations_test.go's coverage, hermetic).
+
+Covers: annotation precedence pod>Job (annotations_test.go:126-147), Job
+fallback (:221-239), env/secret extraction including the auto-injected filter
+and the multi-container fix, ports override, slice selection, zone compliance,
+cost ceiling enforcement.
+"""
+
+import pytest
+
+from k8s_runpod_kubelet_tpu.config import Config
+from k8s_runpod_kubelet_tpu.kube import FakeKubeClient
+from k8s_runpod_kubelet_tpu.provider.annotations import Annotations as A, AnnotationResolver
+from k8s_runpod_kubelet_tpu.provider.translate import (
+    TranslationError,
+    extract_env,
+    is_auto_injected_env,
+    prepare_tpu_parameters,
+    qr_name_for_pod,
+)
+
+from harness import make_pod
+
+
+@pytest.fixture()
+def kube():
+    return FakeKubeClient()
+
+
+@pytest.fixture()
+def cfg():
+    return Config(node_name="virtual-tpu", zone="us-central2-b")
+
+
+def owned_pod(kube, job_annotations, pod_annotations=None, job_uid="job-uid-1"):
+    kube.add_job({"metadata": {"name": "train-job", "namespace": "default",
+                               "uid": job_uid, "annotations": job_annotations},
+                  "spec": {}})
+    pod = make_pod(annotations=pod_annotations, uid="pod-uid-1")
+    pod["metadata"]["ownerReferences"] = [
+        {"kind": "Job", "name": "train-job", "uid": job_uid}]
+    return pod
+
+
+class TestAnnotationResolution:
+    def test_pod_wins_over_job(self, kube):
+        pod = owned_pod(kube, {A.GENERATION: "v4"}, {A.GENERATION: "v5p"})
+        r = AnnotationResolver(kube, pod)
+        assert r.get(A.GENERATION) == "v5p"
+
+    def test_job_fallback(self, kube):
+        pod = owned_pod(kube, {A.GENERATION: "v4", A.ZONES: "us-central2-b"})
+        r = AnnotationResolver(kube, pod)
+        assert r.get(A.GENERATION) == "v4"
+        assert r.get(A.ZONES) == "us-central2-b"
+
+    def test_stale_owner_uid_ignored(self, kube):
+        pod = owned_pod(kube, {A.GENERATION: "v4"}, job_uid="job-uid-1")
+        pod["metadata"]["ownerReferences"][0]["uid"] = "different-uid"
+        r = AnnotationResolver(kube, pod)
+        assert r.get(A.GENERATION) == ""
+
+    def test_bad_numeric_annotation_falls_back(self, kube):
+        pod = make_pod(annotations={A.MAX_COST_PER_HR: "not-a-number"})
+        r = AnnotationResolver(kube, pod)
+        assert r.get_float(A.MAX_COST_PER_HR, 1.5) == 1.5
+
+
+class TestEnvExtraction:
+    def test_auto_injected_filter(self):
+        assert is_auto_injected_env("KUBERNETES_SERVICE_HOST")
+        assert is_auto_injected_env("KUBERNETES_PORT_443_TCP_ADDR")
+        assert is_auto_injected_env("MYAPP_SERVICE_HOST")
+        assert is_auto_injected_env("REDIS_PORT_6379_TCP")
+        assert not is_auto_injected_env("MODEL_NAME")
+        assert not is_auto_injected_env("PORT")
+
+    def test_env_from_all_containers_not_just_first(self, kube):
+        pod = make_pod(containers=[
+            {"name": "a", "image": "img-a",
+             "env": [{"name": "FROM_A", "value": "1"}]},
+            {"name": "b", "image": "img-b",
+             "env": [{"name": "FROM_B", "value": "2"}]},
+        ])
+        env = extract_env(kube, pod)
+        assert env == {"FROM_A": "1", "FROM_B": "2"}  # fixes Containers[0] bug
+
+    def test_secret_key_ref_and_env_from(self, kube):
+        kube.add_secret("default", "creds", {"API_KEY": "sk-123", "OTHER": "x"})
+        pod = make_pod(containers=[{
+            "name": "m", "image": "img",
+            "env": [{"name": "KEY", "valueFrom":
+                     {"secretKeyRef": {"name": "creds", "key": "API_KEY"}}}],
+            "envFrom": [{"secretRef": {"name": "creds"}, "prefix": "P_"}],
+        }])
+        env = extract_env(kube, pod)
+        assert env["KEY"] == "sk-123"
+        assert env["P_API_KEY"] == "sk-123" and env["P_OTHER"] == "x"
+
+    def test_missing_secret_raises_unless_optional(self, kube):
+        pod = make_pod(containers=[{
+            "name": "m", "image": "img",
+            "env": [{"name": "KEY", "valueFrom":
+                     {"secretKeyRef": {"name": "nope", "key": "k"}}}]}])
+        with pytest.raises(TranslationError):
+            extract_env(kube, pod)
+        pod["spec"]["containers"][0]["env"][0]["valueFrom"]["secretKeyRef"]["optional"] = True
+        assert extract_env(kube, pod) == {}
+
+    def test_volume_secret_flattened(self, kube):
+        kube.add_secret("default", "vol-secret", {"service-account.json": "{}"})
+        pod = make_pod()
+        pod["spec"]["volumes"] = [{"name": "v",
+                                   "secret": {"secretName": "vol-secret"}}]
+        env = extract_env(kube, pod)
+        assert env["SERVICE_ACCOUNT_JSON"] == "{}"
+
+    def test_field_ref(self, kube):
+        pod = make_pod(containers=[{
+            "name": "m", "image": "img",
+            "env": [{"name": "MY_NAME",
+                     "valueFrom": {"fieldRef": {"fieldPath": "metadata.name"}}}]}])
+        assert extract_env(kube, pod)["MY_NAME"] == "train"
+
+
+class TestSliceSelection:
+    def test_chips_drive_selection(self, kube, cfg):
+        p = prepare_tpu_parameters(kube, make_pod(chips=16, uid="u1"), cfg)
+        assert p.accelerator_type == "v5litepod-16"
+        assert p.runtime_version == "v2-alpha-tpuv5-lite"
+
+    def test_exact_annotation_wins(self, kube, cfg):
+        pod = make_pod(chips=16, uid="u1",
+                       annotations={A.ACCELERATOR_TYPE: "v5p-64"})
+        p = prepare_tpu_parameters(kube, pod, cfg)
+        assert p.accelerator_type == "v5p-64"
+
+    def test_generation_and_topology(self, kube, cfg):
+        pod = make_pod(chips=64, uid="u1",
+                       annotations={A.GENERATION: "v4", A.TOPOLOGY: "2x4x4"})
+        p = prepare_tpu_parameters(kube, pod, cfg)
+        assert p.accelerator_type == "v4-64"
+
+    def test_no_chips_no_annotation_fails(self, kube, cfg):
+        with pytest.raises(TranslationError):
+            prepare_tpu_parameters(kube, make_pod(chips=0, uid="u1"), cfg)
+
+    def test_cost_ceiling_enforced(self, kube, cfg):
+        cfg.max_cost_per_hr = 10.0
+        with pytest.raises(TranslationError):
+            # v5e-16 = 16 * $1.20 = $19.2/hr > $10
+            prepare_tpu_parameters(kube, make_pod(chips=16, uid="u1"), cfg)
+        ok = prepare_tpu_parameters(kube, make_pod(chips=4, uid="u1"), cfg)
+        assert ok.accelerator_type == "v5litepod-4"  # $4.8/hr fits
+
+    def test_spot_and_reservation(self, kube, cfg):
+        pod = make_pod(chips=16, uid="u1",
+                       annotations={A.CAPACITY_TYPE: "spot"})
+        assert prepare_tpu_parameters(kube, pod, cfg).spot is True
+        pod = make_pod(chips=16, uid="u1",
+                       annotations={A.CAPACITY_TYPE: "reserved"})
+        with pytest.raises(TranslationError):
+            prepare_tpu_parameters(kube, pod, cfg)  # reservation name required
+        pod["metadata"]["annotations"][A.RESERVATION] = "res-1"
+        p = prepare_tpu_parameters(kube, pod, cfg)
+        assert p.reservation == "res-1" and p.spot is False
+
+    def test_invalid_capacity_type_defaults_on_demand(self, kube, cfg):
+        pod = make_pod(chips=16, uid="u1",
+                       annotations={A.CAPACITY_TYPE: "COMMUNITY"})
+        assert prepare_tpu_parameters(kube, pod, cfg).spot is False
+
+
+class TestZonesAndPorts:
+    def test_zone_compliance_filter(self, kube, cfg):
+        cfg.zones = ["us-central2-b", "us-east5-a"]
+        pod = make_pod(chips=16, uid="u1",
+                       annotations={A.ZONES: "europe-west4-b, us-east5-a"})
+        p = prepare_tpu_parameters(kube, pod, cfg)
+        assert p.zone == "us-east5-a"
+        pod = make_pod(chips=16, uid="u2",
+                       annotations={A.ZONES: "europe-west4-b"})
+        with pytest.raises(TranslationError):
+            prepare_tpu_parameters(kube, pod, cfg)
+
+    def test_ports_from_containers_and_override(self, kube, cfg):
+        pod = make_pod(chips=16, uid="u1", ports=[8471, 9000])
+        p = prepare_tpu_parameters(kube, pod, cfg)
+        assert p.workload.ports == ["8471/tcp", "9000/tcp"]
+        pod = make_pod(chips=16, uid="u2", ports=[8471],
+                       annotations={A.PORTS: "6006, 2222/udp"})
+        p = prepare_tpu_parameters(kube, pod, cfg)
+        assert p.workload.ports == ["6006/tcp", "2222/udp"]
+
+    def test_qr_name_deterministic_and_valid(self):
+        pod = make_pod(uid="ABC-123-def-456")
+        assert qr_name_for_pod(pod) == qr_name_for_pod(pod)
+        assert qr_name_for_pod(pod).startswith("qr-abc123def456")
+
+    def test_labels_carry_pod_identity(self, kube, cfg):
+        p = prepare_tpu_parameters(kube, make_pod(chips=16, uid="u9"), cfg)
+        assert p.labels["pod-uid"] == "u9"
+        assert p.labels["pod-name"] == "train"
+        assert p.labels["node"] == "virtual-tpu"
