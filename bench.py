@@ -1,0 +1,104 @@
+"""Benchmark: training throughput of the flagship Llama-architecture model.
+
+Prints ONE JSON line:
+  {"metric": "train_tokens_per_sec_per_chip", "value": N,
+   "unit": "tok/s/chip", "vs_baseline": R, ...extras}
+
+The reference publishes no performance numbers (BASELINE.md: "None exist"), so
+vs_baseline is measured against the documented round-1 target in
+_TARGET_TOK_S_PER_CHIP — a model-flops roofline estimate for the bench config
+at 40% MFU on the detected chip generation. Beating 1.0 means beating that
+roofline fraction.
+
+Usage:
+  python bench.py            # full run (TPU: real numbers; first compile ~30s)
+  python bench.py --quick    # tiny config, CPU-friendly smoke (seconds)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# bf16 peak TFLOP/s per chip by generation (public spec sheets)
+_PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0,
+                "cpu": 0.1}
+_TARGET_MFU = 0.40
+
+
+def detect_generation() -> str:
+    import jax
+    if jax.default_backend() != "tpu":
+        return "cpu"
+    kind = jax.devices()[0].device_kind.lower()
+    for gen in ("v6e", "v5p", "v4"):
+        if gen in kind:
+            return gen
+    if "v5" in kind:  # v5 lite
+        return "v5e"
+    return "v5e"
+
+
+def main():
+    quick = "--quick" in sys.argv
+    import jax
+    import jax.numpy as jnp
+    from __graft_entry__ import _bench_config
+    from k8s_runpod_kubelet_tpu.workloads.train import (TrainConfig, Trainer,
+                                                        synthetic_batches)
+
+    n_chips = jax.device_count()
+    gen = detect_generation()
+    cfg = _bench_config(tiny=quick)
+    if quick:
+        tc = TrainConfig(batch_size=2, seq_len=64, steps=3, warmup_steps=1)
+        warmup_steps, timed_steps = 1, 2
+    else:
+        tc = TrainConfig(batch_size=8, seq_len=2048, steps=20, warmup_steps=1)
+        warmup_steps, timed_steps = 3, 10
+
+    mesh = None
+    if n_chips > 1:
+        from k8s_runpod_kubelet_tpu.parallel import MeshConfig, make_mesh
+        mesh = make_mesh(MeshConfig())  # pure data-parallel over chips
+        tc.batch_size *= n_chips
+
+    trainer = Trainer(cfg, tc, mesh=mesh)
+    batches = synthetic_batches(cfg, tc, mesh)
+
+    trainer.run(steps=warmup_steps, batches=batches)  # compile + warm
+    t0 = time.perf_counter()
+    trainer.run(steps=timed_steps, batches=batches)
+    wall = time.perf_counter() - t0
+
+    tokens = tc.batch_size * tc.seq_len * timed_steps
+    tok_s = tokens / wall
+    tok_s_chip = tok_s / n_chips
+
+    # model-flops roofline: 6*N flops per token (fwd+bwd)
+    n_params = cfg.param_count
+    mfu = (6.0 * n_params * tok_s_chip) / (_PEAK_TFLOPS[gen] * 1e12)
+    target_tok_s_chip = _TARGET_MFU * _PEAK_TFLOPS[gen] * 1e12 / (6.0 * n_params)
+    vs_baseline = tok_s_chip / target_tok_s_chip if target_tok_s_chip else 0.0
+
+    print(json.dumps({
+        "metric": "train_tokens_per_sec_per_chip",
+        "value": round(tok_s_chip, 1),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(vs_baseline, 3),
+        "chips": n_chips,
+        "generation": gen,
+        "model": cfg.name,
+        "params": n_params,
+        "mfu": round(mfu, 3),
+        "seq_len": tc.seq_len,
+        "global_batch": tc.batch_size,
+    }))
+
+
+if __name__ == "__main__":
+    main()

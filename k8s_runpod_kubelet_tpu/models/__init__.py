@@ -1,0 +1,16 @@
+"""Model zoo: the north-star workloads (BASELINE.json configs 2-5).
+
+- ``llama``: Llama-3-family decoder (flagship; 8B pretrain = config 3,
+  70B multislice = config 4) with GQA, RoPE, flash/ring attention, KV-cache
+  decode, and logical-axis sharding throughout.
+- ``gemma``: Gemma-7B config mapped onto the same decoder (serving = config 5).
+- ``mnist``: the small Flax CNN for the single-chip smoke workload (config 2).
+"""
+
+from .llama import (LlamaConfig, LlamaModel, llama3_8b, llama3_70b, gemma_7b,
+                    tiny_llama, init_params, param_logical_axes)
+from .mnist import MnistCNN, mnist_config
+
+__all__ = ["LlamaConfig", "LlamaModel", "llama3_8b", "llama3_70b", "gemma_7b",
+           "tiny_llama", "init_params", "param_logical_axes", "MnistCNN",
+           "mnist_config"]

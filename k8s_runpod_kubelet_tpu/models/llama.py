@@ -1,0 +1,316 @@
+"""Llama-3-family decoder, TPU-first.
+
+Design choices (vs a torch transliteration):
+- **Stacked layer params + lax.scan**: every layer leaf carries a leading
+  (n_layers, ...) axis and the forward pass scans one remat'd block over it —
+  compile time stays flat in depth and the block is pipeline-ready.
+- **Functional params** (plain pytree): shardings are explicit NamedShardings
+  from parallel/sharding.py's logical rules; orbax checkpoints the tree as-is.
+- **bf16 compute, f32 params** by default; all matmuls are MXU-shaped.
+- **flash/ring attention** from ops/ — ring engages when the mesh has a seq
+  axis (long context, SURVEY.md §5.7).
+- **KV-cache decode** path for the serving engine (JetStream-style, config 5).
+
+The same class covers Llama-3-8B/70B and Gemma-7B (explicit head_dim,
+tied/untied embeddings) — see the config constructors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ..ops import apply_rope, flash_attention, ring_attention, rms_norm, rope_frequencies
+from ..parallel.mesh import AXES
+from ..parallel.sharding import logical_sharding, shard_logical
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    name: str = "tiny"
+    vocab_size: int = 32000
+    embed_dim: int = 256
+    n_layers: int = 2
+    n_heads: int = 4
+    n_kv_heads: int = 2
+    head_dim: Optional[int] = None      # default embed_dim // n_heads (Gemma differs)
+    mlp_dim: int = 688
+    max_seq_len: int = 2048
+    rope_theta: float = 500_000.0
+    rope_scaling: Optional[dict] = None
+    norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16           # activation/compute dtype
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim or self.embed_dim // self.n_heads
+
+    @property
+    def param_count(self) -> int:
+        e, m, l, v = self.embed_dim, self.mlp_dim, self.n_layers, self.vocab_size
+        hd = self.head_dim_
+        attn = e * hd * (self.n_heads * 2 + self.n_kv_heads * 2)
+        mlp = 3 * e * m
+        norms = 2 * e
+        embed = v * e * (1 if self.tie_embeddings else 2)
+        return l * (attn + mlp + norms) + embed + e
+
+
+def llama3_8b() -> LlamaConfig:
+    return LlamaConfig(name="llama3-8b", vocab_size=128256, embed_dim=4096,
+                       n_layers=32, n_heads=32, n_kv_heads=8, mlp_dim=14336,
+                       max_seq_len=8192, rope_theta=500_000.0)
+
+
+def llama3_70b() -> LlamaConfig:
+    return LlamaConfig(name="llama3-70b", vocab_size=128256, embed_dim=8192,
+                       n_layers=80, n_heads=64, n_kv_heads=8, mlp_dim=28672,
+                       max_seq_len=8192, rope_theta=500_000.0)
+
+
+def gemma_7b() -> LlamaConfig:
+    # Gemma-7B mapped onto the decoder: wide head_dim, tied embeddings,
+    # GELU-family MLP approximated by the same SwiGLU block size.
+    return LlamaConfig(name="gemma-7b", vocab_size=256128, embed_dim=3072,
+                       n_layers=28, n_heads=16, n_kv_heads=16, head_dim=256,
+                       mlp_dim=24576, max_seq_len=8192, rope_theta=10_000.0,
+                       tie_embeddings=True)
+
+
+def tiny_llama(**kw) -> LlamaConfig:
+    return dataclasses.replace(LlamaConfig(), **kw)
+
+
+# -- params -------------------------------------------------------------------
+
+def param_logical_axes(cfg: LlamaConfig) -> Params:
+    """Pytree (matching init_params) of logical-axis tuples."""
+    layer = {
+        "attn_norm": ("layer", "norm"),
+        "wq": ("layer", "embed", "heads"),
+        "wk": ("layer", "embed", "kv_heads"),
+        "wv": ("layer", "embed", "kv_heads"),
+        "wo": ("layer", "heads", "embed"),
+        "mlp_norm": ("layer", "norm"),
+        "w_gate": ("layer", "embed", "mlp"),
+        "w_up": ("layer", "embed", "mlp"),
+        "w_down": ("layer", "mlp", "embed"),
+    }
+    tree: Params = {"tok_embed": ("vocab", "embed"),
+                    "final_norm": ("norm",),
+                    "layers": layer}
+    if not cfg.tie_embeddings:
+        tree["lm_head"] = ("embed", "vocab")
+    return tree
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array,
+                mesh: Optional[Mesh] = None) -> Params:
+    """Initialize (optionally directly sharded onto ``mesh``)."""
+    e, hd = cfg.embed_dim, cfg.head_dim_
+    shapes = {
+        "tok_embed": (cfg.vocab_size, e),
+        "final_norm": (e,),
+        "layers": {
+            "attn_norm": (cfg.n_layers, e),
+            "wq": (cfg.n_layers, e, cfg.n_heads * hd),
+            "wk": (cfg.n_layers, e, cfg.n_kv_heads * hd),
+            "wv": (cfg.n_layers, e, cfg.n_kv_heads * hd),
+            "wo": (cfg.n_layers, cfg.n_heads * hd, e),
+            "mlp_norm": (cfg.n_layers, e),
+            "w_gate": (cfg.n_layers, e, cfg.mlp_dim),
+            "w_up": (cfg.n_layers, e, cfg.mlp_dim),
+            "w_down": (cfg.n_layers, cfg.mlp_dim, e),
+        },
+    }
+    if not cfg.tie_embeddings:
+        shapes["lm_head"] = (e, cfg.vocab_size)
+
+    leaves, treedef = jax.tree_util.tree_flatten(shapes,
+                                                 is_leaf=lambda x: isinstance(x, tuple))
+    keys = jax.random.split(key, len(leaves))
+
+    def make(shape, k):
+        if len(shape) <= 2 and shape[-1] == e and len(shape) < 3:
+            # norm weights start at 1
+            if shape == (e,) or shape == (cfg.n_layers, e):
+                return jnp.ones(shape, cfg.param_dtype)
+        scale = 0.02
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(cfg.param_dtype)
+
+    params = jax.tree_util.tree_unflatten(
+        treedef, [make(s, k) for s, k in zip(leaves, keys)])
+    if mesh is not None:
+        axes = param_logical_axes(cfg)
+        params = jax.tree_util.tree_map(
+            lambda p, a: jax.device_put(p, logical_sharding(mesh, a)),
+            params, axes)
+    return params
+
+
+# -- forward ------------------------------------------------------------------
+
+def _constrain(x, mesh: Optional[Mesh], axes):
+    return shard_logical(x, mesh, axes) if mesh is not None else x
+
+
+def _attention_block(x, lp, cfg: LlamaConfig, cos, sin, mesh, positions=None):
+    b, s, e = x.shape
+    hd = cfg.head_dim_
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"].astype(cfg.dtype)).reshape(b, s, cfg.n_heads, hd)
+    k = (h @ lp["wk"].astype(cfg.dtype)).reshape(b, s, cfg.n_kv_heads, hd)
+    v = (h @ lp["wv"].astype(cfg.dtype)).reshape(b, s, cfg.n_kv_heads, hd)
+    q = apply_rope(q, cos, sin, positions)
+    k = apply_rope(k, cos, sin, positions)
+    q = _constrain(q, mesh, ("batch", "seq", "act_heads", "head_dim"))
+    # (B,S,H,D) -> (B,H,S,D)
+    qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+    if mesh is not None and mesh.shape.get(AXES.SEQ, 1) > 1:
+        o = ring_attention(qt, kt, vt, mesh, causal=True)
+    else:
+        o = flash_attention(qt, kt, vt, causal=True)
+    o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * hd)
+    return x + (o @ lp["wo"].astype(cfg.dtype))
+
+
+def _mlp_block(x, lp, cfg: LlamaConfig, mesh):
+    h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    gate = h @ lp["w_gate"].astype(cfg.dtype)
+    up = h @ lp["w_up"].astype(cfg.dtype)
+    act = _constrain(jax.nn.silu(gate) * up, mesh, ("batch", "seq", "act_mlp"))
+    return x + (act @ lp["w_down"].astype(cfg.dtype))
+
+
+class LlamaModel:
+    """Functional model: forward(params, tokens) and decode-step methods."""
+
+    def __init__(self, cfg: LlamaConfig, mesh: Optional[Mesh] = None):
+        self.cfg = cfg
+        self.mesh = mesh
+
+    def forward(self, params: Params, tokens: jax.Array,
+                positions: Optional[jax.Array] = None) -> jax.Array:
+        """tokens (B, S) int32 -> logits (B, S, V)."""
+        cfg, mesh = self.cfg, self.mesh
+        cos, sin = rope_frequencies(cfg.head_dim_, cfg.max_seq_len,
+                                    cfg.rope_theta, cfg.rope_scaling)
+        x = params["tok_embed"].astype(cfg.dtype)[tokens]
+        x = _constrain(x, mesh, ("batch", "seq", "act_embed"))
+
+        def block(carry, lp):
+            y = _attention_block(carry, lp, cfg, cos, sin, mesh, positions)
+            y = _mlp_block(y, lp, cfg, mesh)
+            y = _constrain(y, mesh, ("batch", "seq", "act_embed"))
+            return y, None
+
+        body = jax.checkpoint(block) if cfg.remat else block
+        x, _ = jax.lax.scan(body, x, params["layers"])
+        x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+        head = (params["tok_embed"].T if cfg.tie_embeddings
+                else params["lm_head"]).astype(cfg.dtype)
+        logits = x @ head
+        return _constrain(logits, mesh, ("batch", "seq", "act_vocab"))
+
+    def __call__(self, params, tokens, positions=None):
+        return self.forward(params, tokens, positions)
+
+    # -- decode (serving) ------------------------------------------------------
+
+    def init_cache(self, batch: int, max_len: Optional[int] = None) -> Params:
+        cfg = self.cfg
+        max_len = max_len or cfg.max_seq_len
+        shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim_)
+        return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype),
+                "index": jnp.zeros((), jnp.int32)}
+
+    def prefill(self, params: Params, tokens: jax.Array, cache: Params
+                ) -> tuple[jax.Array, Params]:
+        """Run the prompt through, filling the cache. Returns (last_logits, cache)."""
+        cfg = self.cfg
+        b, s = tokens.shape
+        cos, sin = rope_frequencies(cfg.head_dim_, cfg.max_seq_len,
+                                    cfg.rope_theta, cfg.rope_scaling)
+        x = params["tok_embed"].astype(cfg.dtype)[tokens]
+
+        # one scan over layers that also collects the K/V it computes
+        def block(carry, lp):
+            y = carry
+            h = rms_norm(y, lp["attn_norm"], cfg.norm_eps)
+            q = (h @ lp["wq"].astype(cfg.dtype)).reshape(b, s, cfg.n_heads, cfg.head_dim_)
+            k = (h @ lp["wk"].astype(cfg.dtype)).reshape(b, s, cfg.n_kv_heads, cfg.head_dim_)
+            v = (h @ lp["wv"].astype(cfg.dtype)).reshape(b, s, cfg.n_kv_heads, cfg.head_dim_)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            o = flash_attention(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                                v.transpose(0, 2, 1, 3), causal=True)
+            o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * cfg.head_dim_)
+            y = y + (o @ lp["wo"].astype(cfg.dtype))
+            y = _mlp_block(y, lp, cfg, self.mesh)
+            return y, (k, v)
+
+        x, (k_all, v_all) = jax.lax.scan(block, x, params["layers"])
+        x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+        head = (params["tok_embed"].T if cfg.tie_embeddings
+                else params["lm_head"]).astype(cfg.dtype)
+        logits = x[:, -1:] @ head
+        max_len = cache["k"].shape[2]
+        pad = [(0, 0), (0, 0), (0, max_len - s), (0, 0), (0, 0)]
+        cache = {"k": jnp.pad(k_all, pad), "v": jnp.pad(v_all, pad),
+                 "index": jnp.array(s, jnp.int32)}
+        return logits[:, 0], cache
+
+    def decode_step(self, params: Params, token: jax.Array, cache: Params
+                    ) -> tuple[jax.Array, Params]:
+        """One token for the whole batch: token (B,) -> (logits (B,V), cache)."""
+        cfg = self.cfg
+        b = token.shape[0]
+        idx = cache["index"]
+        cos, sin = rope_frequencies(cfg.head_dim_, cfg.max_seq_len,
+                                    cfg.rope_theta, cfg.rope_scaling)
+        x = params["tok_embed"].astype(cfg.dtype)[token[:, None]]  # (B,1,E)
+        positions = jnp.full((b, 1), idx, jnp.int32)
+        max_len = cache["k"].shape[2]
+        valid = (jnp.arange(max_len) <= idx)[None, None, None, :]  # (1,1,1,L)
+
+        def block(carry, inputs):
+            y = carry
+            lp, k_cache, v_cache = inputs
+            h = rms_norm(y, lp["attn_norm"], cfg.norm_eps)
+            q = (h @ lp["wq"].astype(cfg.dtype)).reshape(b, 1, cfg.n_heads, cfg.head_dim_)
+            k = (h @ lp["wk"].astype(cfg.dtype)).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim_)
+            v = (h @ lp["wv"].astype(cfg.dtype)).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim_)
+            q = apply_rope(q, cos, sin, positions)
+            k = apply_rope(k, cos, sin, positions)
+            k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, idx, 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, idx, 0, 0))
+            # attention of one query vs the cache (GQA)
+            group = cfg.n_heads // cfg.n_kv_heads
+            qg = (q.astype(jnp.float32) * cfg.head_dim_ ** -0.5
+                  ).reshape(b, cfg.n_kv_heads, group, cfg.head_dim_)
+            s = jnp.einsum("bhgd,bLhd->bhgL", qg, k_cache.astype(jnp.float32))
+            s = jnp.where(valid, s, -1e30)  # (1,1,1,L) broadcasts over (b,h,g,L)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhgL,bLhd->bhgd", p, v_cache.astype(jnp.float32))
+            o = o.reshape(b, 1, cfg.n_heads * cfg.head_dim_).astype(cfg.dtype)
+            y = y + (o @ lp["wo"].astype(cfg.dtype))
+            y = _mlp_block(y, lp, cfg, self.mesh)
+            return y, (k_cache, v_cache)
+
+        x, (k_new, v_new) = jax.lax.scan(
+            block, x, (params["layers"], cache["k"], cache["v"]))
+        x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+        head = (params["tok_embed"].T if cfg.tie_embeddings
+                else params["lm_head"]).astype(cfg.dtype)
+        logits = (x[:, 0] @ head).astype(jnp.float32)
+        return logits, {"k": k_new, "v": v_new, "index": idx + 1}

@@ -1,0 +1,33 @@
+"""Flax CNN for the single-chip MNIST smoke workload (BASELINE.json config 2)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MnistConfig:
+    features: tuple = (32, 64)
+    dense: int = 256
+    classes: int = 10
+
+
+def mnist_config() -> MnistConfig:
+    return MnistConfig()
+
+
+class MnistCNN(nn.Module):
+    cfg: MnistConfig = MnistConfig()
+
+    @nn.compact
+    def __call__(self, x):  # x: (B, 28, 28, 1)
+        for f in self.cfg.features:
+            x = nn.Conv(f, (3, 3))(x)
+            x = nn.relu(x)
+            x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.cfg.dense)(x))
+        return nn.Dense(self.cfg.classes)(x)

@@ -1,0 +1,17 @@
+"""TPU compute ops: Pallas kernels with XLA fallbacks.
+
+Every op has two paths:
+- a Pallas TPU kernel (the hot path on real hardware), and
+- a pure-XLA fallback (used on CPU test meshes and anywhere Pallas is
+  unavailable) that is numerically equivalent.
+
+``use_pallas=None`` auto-selects: Pallas on TPU backends, XLA elsewhere.
+"""
+
+from .rmsnorm import rms_norm
+from .rope import apply_rope, rope_frequencies
+from .attention import flash_attention
+from .ring_attention import ring_attention
+
+__all__ = ["rms_norm", "apply_rope", "rope_frequencies", "flash_attention",
+           "ring_attention"]

@@ -1,0 +1,156 @@
+"""Flash attention: blockwise online-softmax Pallas TPU kernel + XLA fallback.
+
+Kernel shape: grid over (batch, q_heads, q_blocks); K/V for the matching KV
+head (GQA native — no repeat materialization) live in VMEM and are consumed in
+block_k chunks with the online-softmax recurrence, so HBM sees each K/V tile
+once and the (S, S) score matrix never exists. Causal programs stop at their
+diagonal block (no wasted FLOPs past it).
+
+Layout: q (B, Hq, S, D); k, v (B, Hkv, S, D); Hq % Hkv == 0.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _use_pallas(flag: Optional[bool]) -> bool:
+    if flag is not None:
+        return flag
+    return jax.default_backend() == "tpu"
+
+
+def _attention_xla(q, k, v, *, causal: bool, sm_scale: float,
+                   q_offset: int = 0) -> jax.Array:
+    """Reference/fallback path; identical math, XLA-fused."""
+    b, hq, sq, d = q.shape
+    _, hkv, sk, _ = k.shape
+    group = hq // hkv
+    qf = q.astype(jnp.float32) * sm_scale
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    qg = qf.reshape(b, hkv, group, sq, d)
+    s = jnp.einsum("bhgqd,bhkd->bhgqk", qg, kf)
+    if causal:
+        q_pos = jnp.arange(sq) + q_offset
+        k_pos = jnp.arange(sk)
+        mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask[None, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhgqk,bhkd->bhgqd", p, vf)
+    return o.reshape(b, hq, sq, d).astype(q.dtype)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
+                  seq_k: int, causal: bool, sm_scale: float):
+    import jax.experimental.pallas as pl  # noqa: F401 (kernel-only import)
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale          # (bq, d)
+    d = q.shape[-1]
+
+    num_k_blocks = seq_k // block_k
+    if causal:
+        # highest k index this q block can see: (qi+1)*block_q - 1
+        last = (qi + 1) * block_q - 1
+        k_blocks = jnp.minimum((last // block_k) + 1, num_k_blocks)
+    else:
+        k_blocks = num_k_blocks
+
+    def body(j, carry):
+        acc, m, l = carry
+        kc = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vc = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kc, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # (bq, bk)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + jax.lax.dot_general(
+            p, vc, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, k_blocks, body, (acc0, m0, l0))
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_pallas(q, k, v, causal: bool, scale: float, block_q: int, block_k: int):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, hq, sq, d = q.shape
+    _, hkv, sk, _ = k.shape
+    group = hq // hkv
+    kernel = functools.partial(_flash_kernel, block_q=block_q, block_k=block_k,
+                               seq_k=sk, causal=causal, sm_scale=scale)
+    return pl.pallas_call(
+        kernel,
+        grid=(b, hq, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bb, h, i: (bb, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, sk, d), lambda bb, h, i: (bb, h // group, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, sk, d), lambda bb, h, i: (bb, h // group, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda bb, h, i: (bb, h, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_diff(q, k, v, causal, scale, block_q, block_k):
+    return _flash_pallas(q, k, v, causal, scale, block_q, block_k)
+
+
+def _flash_diff_fwd(q, k, v, causal, scale, block_q, block_k):
+    return _flash_pallas(q, k, v, causal, scale, block_q, block_k), (q, k, v)
+
+
+def _flash_diff_bwd(causal, scale, block_q, block_k, res, g):
+    # Backward recomputes through the XLA reference path (same math as the
+    # kernel) — flash-attention's standard recompute-in-bwd trade, with XLA
+    # doing the fusion. A fused Pallas bwd kernel can slot in here later.
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _attention_xla(q_, k_, v_, causal=causal,
+                                          sm_scale=scale), q, k, v)
+    return vjp(g)
+
+
+_flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "sm_scale", "use_pallas",
+                                             "block_q", "block_k"))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = True, sm_scale: Optional[float] = None,
+                    use_pallas: Optional[bool] = None,
+                    block_q: int = 128, block_k: int = 128) -> jax.Array:
+    """Multi-head attention with GQA. Shapes: q (B,Hq,S,D), k/v (B,Hkv,S,D)."""
+    b, hq, sq, d = q.shape
+    _, hkv, sk, _ = k.shape
+    if hq % hkv != 0:
+        raise ValueError(f"Hq={hq} not a multiple of Hkv={hkv}")
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    if (not _use_pallas(use_pallas) or sq % block_q != 0 or sk % block_k != 0
+            or sq < block_q):
+        return _attention_xla(q, k, v, causal=causal, sm_scale=scale)
+    return _flash_diff(q, k, v, causal, scale, block_q, block_k)

@@ -1,0 +1,101 @@
+"""Ring attention: sequence/context parallelism over the mesh's ``seq`` axis.
+
+Long-context path (SURVEY.md §5.7): the sequence is sharded across devices;
+each device keeps its Q shard resident and the K/V shards rotate around the
+ring via ``lax.ppermute`` (ICI neighbor exchange), with the online-softmax
+recurrence merging each visiting chunk — so attention over a sequence S costs
+each device O(S_local * S) compute and O(S_local) memory, and the K/V transfer
+overlaps with the chunk compute that XLA schedules.
+
+Built on shard_map so the collective schedule is explicit; the per-chunk math
+matches ops/attention.py exactly (same masks, same recurrence).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover — older jax
+    from jax.experimental.shard_map import shard_map
+
+from ..parallel.mesh import AXES
+from .attention import NEG_INF
+
+
+def _chunk_update(q, kc, vc, acc, m, l, *, q_offset, k_offset, causal, sm_scale):
+    """One online-softmax step: fold K/V chunk (global offset k_offset) into the
+    running (acc, m, l) for Q (global offset q_offset). Shapes:
+    q (B,Hq,Sq,D), kc/vc (B,Hkv,Sk,D); GQA via group reshape."""
+    b, hq, sq, d = q.shape
+    _, hkv, sk, _ = kc.shape
+    group = hq // hkv
+    qg = (q.astype(jnp.float32) * sm_scale).reshape(b, hkv, group, sq, d)
+    s = jnp.einsum("bhgqd,bhkd->bhgqk", qg, kc.astype(jnp.float32))
+    s = s.reshape(b, hq, sq, sk)
+    if causal:
+        q_pos = q_offset + jnp.arange(sq)
+        k_pos = k_offset + jnp.arange(sk)
+        s = jnp.where((q_pos[:, None] >= k_pos[None, :])[None, None], s, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+    pg = p.reshape(b, hkv, group, sq, sk)
+    o = jnp.einsum("bhgqk,bhkd->bhgqd", pg, vc.astype(jnp.float32))
+    acc_new = acc * corr + o.reshape(b, hq, sq, d)
+    return acc_new, m_new, l_new
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh, *,
+                   causal: bool = True, sm_scale: Optional[float] = None,
+                   axis: str = AXES.SEQ) -> jax.Array:
+    """Attention over sequence sharded on ``axis``. Global shapes:
+    q (B,Hq,S,D), k/v (B,Hkv,S,D), S divisible by the axis size."""
+    d = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    n = mesh.shape[axis]
+    if n == 1:
+        from .attention import flash_attention
+        return flash_attention(q, k, v, causal=causal, sm_scale=scale)
+
+    def local(qs, ks, vs):
+        idx = jax.lax.axis_index(axis)
+        b, hq, sq, dd = qs.shape
+        s_local = sq
+        # mark the accumulators device-varying over the ring axis so the scan
+        # carry type matches after the masked updates (jax >= 0.8 vma typing)
+        def varying(x):
+            try:
+                return jax.lax.pcast(x, (axis,), to="varying")
+            except (AttributeError, TypeError):
+                return x
+        acc0 = varying(jnp.zeros((b, hq, sq, dd), jnp.float32))
+        m0 = varying(jnp.full((b, hq, sq, 1), NEG_INF, jnp.float32))
+        l0 = varying(jnp.zeros((b, hq, sq, 1), jnp.float32))
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def step(t, carry):
+            acc, m, l, kc, vc = carry
+            src = (idx - t) % n  # whose shard we currently hold
+            acc, m, l = _chunk_update(
+                qs, kc, vc, acc, m, l,
+                q_offset=idx * s_local, k_offset=src * s_local,
+                causal=causal, sm_scale=scale)
+            kc = jax.lax.ppermute(kc, axis, perm)
+            vc = jax.lax.ppermute(vc, axis, perm)
+            return acc, m, l, kc, vc
+
+        acc, m, l, _, _ = jax.lax.fori_loop(
+            0, n, step, (acc0, m0, l0, ks, vs))
+        return (acc / jnp.maximum(l, 1e-30)).astype(qs.dtype)
+
+    spec = P(None, None, axis, None)
+    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec)(q, k, v)

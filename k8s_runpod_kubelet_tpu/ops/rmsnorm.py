@@ -1,0 +1,96 @@
+"""RMSNorm: Pallas TPU kernel + XLA fallback.
+
+The norm is HBM-bandwidth-bound; the kernel keeps each (block_rows, d) tile in
+VMEM, does the reduction and scale in one pass, and writes once. The fallback
+is the same math for XLA to fuse.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _use_pallas(flag: Optional[bool]) -> bool:
+    if flag is not None:
+        return flag
+    return jax.default_backend() == "tpu"
+
+
+def _rms_norm_xla(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    y = xf * jax.lax.rsqrt(var + eps)
+    return (y * weight.astype(jnp.float32)).astype(dtype)
+
+
+def _rms_kernel(x_ref, w_ref, o_ref, *, eps: float):
+    x = x_ref[:].astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    y = x * jax.lax.rsqrt(var + eps)
+    o_ref[:] = (y * w_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _rms_pallas_diff(x, weight, eps, block_rows):
+    return _rms_pallas(x, weight, eps, block_rows)
+
+
+def _rms_diff_fwd(x, weight, eps, block_rows):
+    return _rms_pallas(x, weight, eps, block_rows), (x, weight)
+
+
+def _rms_diff_bwd(eps, block_rows, res, g):
+    x, weight = res
+    _, vjp = jax.vjp(lambda x_, w_: _rms_norm_xla(x_, w_, eps), x, weight)
+    return vjp(g)
+
+
+_rms_pallas_diff.defvjp(_rms_diff_fwd, _rms_diff_bwd)
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "use_pallas", "block_rows"))
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6,
+             use_pallas: Optional[bool] = None, block_rows: int = 256) -> jax.Array:
+    """y = x * rsqrt(mean(x^2) + eps) * weight, over the last axis."""
+    if not _use_pallas(use_pallas):
+        return _rms_norm_xla(x, weight, eps)
+    rows = 1
+    for s in x.shape[:-1]:
+        rows *= s
+    if rows % min(block_rows, rows) != 0 or rows == 0:
+        return _rms_norm_xla(x, weight, eps)
+    return _rms_pallas_diff(x, weight, eps, block_rows)
+
+
+def _rms_pallas(x: jax.Array, weight: jax.Array, eps: float,
+                block_rows: int) -> jax.Array:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    rows = 1
+    for s in orig_shape[:-1]:
+        rows *= s
+    x2 = x.reshape(rows, d)
+    block_rows = min(block_rows, rows)
+    if rows % block_rows != 0:  # ragged: let XLA handle it
+        return _rms_norm_xla(x, weight, eps)
+    out = pl.pallas_call(
+        functools.partial(_rms_kernel, eps=eps),
+        grid=(rows // block_rows,),
+        in_specs=[
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((d,), lambda i: (0,), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows, d), x.dtype),
+    )(x2, weight)
+    return out.reshape(orig_shape)

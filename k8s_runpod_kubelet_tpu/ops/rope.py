@@ -1,0 +1,56 @@
+"""Rotary position embeddings (RoPE), Llama-3 style.
+
+Pure XLA: RoPE is elementwise and fuses into the surrounding matmuls; a Pallas
+kernel would only add launch overhead. Supports Llama-3's NTK-aware frequency
+scaling for long context.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, max_seq_len: int, theta: float = 500_000.0,
+                     scaling: Optional[dict] = None) -> tuple[jax.Array, jax.Array]:
+    """Precompute (cos, sin) tables of shape (max_seq_len, head_dim//2).
+
+    ``scaling`` (Llama-3.1 long-context recipe): dict with factor,
+    low_freq_factor, high_freq_factor, original_max_position.
+    """
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    if scaling:
+        factor = scaling.get("factor", 8.0)
+        low = scaling.get("low_freq_factor", 1.0)
+        high = scaling.get("high_freq_factor", 4.0)
+        orig = scaling.get("original_max_position", 8192)
+        wavelen = 2 * jnp.pi / inv_freq
+        low_wl = orig / low
+        high_wl = orig / high
+        smooth = (orig / wavelen - low) / (high - low)
+        scaled = jnp.where(
+            wavelen > low_wl, inv_freq / factor,
+            jnp.where(wavelen < high_wl, inv_freq,
+                      (1 - smooth) * inv_freq / factor + smooth * inv_freq))
+        inv_freq = scaled
+    t = jnp.arange(max_seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)  # (S, D/2)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array,
+               positions: Optional[jax.Array] = None) -> jax.Array:
+    """Rotate (B, S, H, D) by position. ``positions`` (B, S) overrides arange
+    (needed for decode steps and sequence-parallel shards)."""
+    b, s, h, d = x.shape
+    if positions is None:
+        c = cos[:s][None, :, None, :]
+        si = sin[:s][None, :, None, :]
+    else:
+        c = cos[positions][:, :, None, :]
+        si = sin[positions][:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * si, x2 * c + x1 * si], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,30 @@
+"""Device-mesh parallelism for TPU workloads.
+
+The workload-side half of SURVEY.md §2.4's parallelism table: every strategy the
+kubelet's gang scheduling enables (dp/fsdp/tp/sp/pp/ep over ICI, multislice DCN)
+is expressed here as mesh axes + sharding rules + jax.distributed bootstrap.
+All communication is XLA collectives over the mesh — no NCCL/MPI analog exists
+or is needed (SURVEY.md §5.8).
+
+- ``mesh``:        MeshConfig -> jax.sharding.Mesh (ICI-aware axis ordering).
+- ``sharding``:    logical-axis rules -> NamedSharding (MaxText-style).
+- ``distributed``: jax.distributed init from the env the kubelet injects
+                   (gang/env.py) — the two halves meet here.
+"""
+
+from .mesh import AXES, MeshConfig, make_mesh, best_mesh_for
+from .sharding import (
+    LOGICAL_RULES,
+    logical_sharding,
+    logical_spec,
+    shard_logical,
+    param_shardings,
+)
+from .distributed import initialize_from_env, process_env_summary
+
+__all__ = [
+    "AXES", "MeshConfig", "make_mesh", "best_mesh_for",
+    "LOGICAL_RULES", "logical_sharding", "logical_spec", "shard_logical",
+    "param_shardings",
+    "initialize_from_env", "process_env_summary",
+]

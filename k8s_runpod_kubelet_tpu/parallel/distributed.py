@@ -1,0 +1,70 @@
+"""jax.distributed bootstrap from kubelet-injected env.
+
+The workload-side consumer of gang/env.py's injection: the kubelet starts every
+worker of a slice with JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+JAX_PROCESS_ID (+ MEGASCALE_* for multislice); calling initialize_from_env() at
+program start forms the multi-controller runtime so ICI collectives see the
+full mesh (SURVEY.md §5.8: "the kubelet must start them together and expose
+slice topology; jax.distributed.initialize with a coordinator the kubelet
+chooses").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class ProcessEnv:
+    coordinator: str
+    num_processes: int
+    process_id: int
+    worker_id: int
+    num_slices: int
+    slice_id: int
+    accelerator_type: str
+    topology: str
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.num_processes > 1
+
+
+def process_env_summary(env: Optional[dict] = None) -> ProcessEnv:
+    e = os.environ if env is None else env
+    return ProcessEnv(
+        coordinator=e.get("JAX_COORDINATOR_ADDRESS", ""),
+        num_processes=int(e.get("JAX_NUM_PROCESSES", "1")),
+        process_id=int(e.get("JAX_PROCESS_ID", "0")),
+        worker_id=int(e.get("TPU_WORKER_ID", "0")),
+        num_slices=int(e.get("MEGASCALE_NUM_SLICES", "1")),
+        slice_id=int(e.get("MEGASCALE_SLICE_ID", "0")),
+        accelerator_type=e.get("TPU_ACCELERATOR_TYPE", ""),
+        topology=e.get("TPU_TOPOLOGY", ""),
+    )
+
+
+def initialize_from_env(env: Optional[dict] = None, timeout_s: int = 300) -> ProcessEnv:
+    """Form the multi-controller runtime if the kubelet injected gang env;
+    no-op for single-process runs (local dev, single-host slices)."""
+    pe = process_env_summary(env)
+    if not pe.is_distributed:
+        log.info("single-process run (no gang env) — skipping jax.distributed")
+        return pe
+    import jax
+    log.info("jax.distributed.initialize(coordinator=%s, num_processes=%d, "
+             "process_id=%d) [slice %d/%d]",
+             pe.coordinator, pe.num_processes, pe.process_id,
+             pe.slice_id, pe.num_slices)
+    jax.distributed.initialize(
+        coordinator_address=pe.coordinator,
+        num_processes=pe.num_processes,
+        process_id=pe.process_id,
+        initialization_timeout=timeout_s,
+    )
+    return pe

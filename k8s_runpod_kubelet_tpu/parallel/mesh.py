@@ -1,0 +1,93 @@
+"""Mesh construction: dp/fsdp/tp/sp/pp/ep axes over the device fabric.
+
+Axis layout follows the scaling-book recipe: put the most communication-hungry
+axes (tensor, sequence) innermost so their collectives ride ICI; data/fsdp
+outermost so cross-slice (DCN) traffic is infrequent gradient reduction only.
+``jax.experimental.mesh_utils.create_device_mesh`` handles the physical
+topology mapping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+
+class AXES:
+    """Canonical mesh axis names (order = outermost to innermost)."""
+
+    DATA = "data"        # pure data parallel (replicated params)
+    FSDP = "fsdp"        # data parallel with sharded params/optimizer (ZeRO-3)
+    STAGE = "stage"      # pipeline parallel
+    EXPERT = "expert"    # MoE expert parallel
+    SEQ = "seq"          # sequence/context parallel (ring attention)
+    TENSOR = "tensor"    # tensor (megatron-style) parallel
+
+    ALL = (DATA, FSDP, STAGE, EXPERT, SEQ, TENSOR)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Per-axis parallel degrees. -1 on data means "absorb remaining devices"."""
+
+    data: int = -1
+    fsdp: int = 1
+    stage: int = 1
+    expert: int = 1
+    seq: int = 1
+    tensor: int = 1
+
+    def resolve(self, n_devices: int) -> "MeshConfig":
+        fixed = self.fsdp * self.stage * self.expert * self.seq * self.tensor
+        data = self.data
+        if data == -1:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}")
+            data = n_devices // fixed
+        total = data * fixed
+        if total != n_devices:
+            raise ValueError(
+                f"mesh {self} needs {total} devices, have {n_devices}")
+        return dataclasses.replace(self, data=data)
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        return (self.data, self.fsdp, self.stage, self.expert, self.seq, self.tensor)
+
+
+def make_mesh(config: MeshConfig, devices: Optional[list] = None) -> Mesh:
+    """Build a named Mesh over the given (default: all) devices."""
+    devices = devices if devices is not None else jax.devices()
+    cfg = config.resolve(len(devices))
+    try:
+        dev_array = mesh_utils.create_device_mesh(cfg.shape, devices=devices)
+    except (ValueError, AssertionError):
+        # topology-aware layout can fail for odd shapes on virtual devices —
+        # fall back to a plain reshape (correct, possibly suboptimal ICI use)
+        dev_array = np.asarray(devices).reshape(cfg.shape)
+    return Mesh(dev_array, AXES.ALL)
+
+
+def best_mesh_for(n_devices: int, *, tensor: int = 1, seq: int = 1,
+                  fsdp: Optional[int] = None) -> Mesh:
+    """Convenience: a sensible mesh for n devices — tensor/seq as asked, fsdp
+    absorbing what data-parallel doesn't need. Used by bench/dryrun paths."""
+    tensor = min(tensor, n_devices)
+    remaining = n_devices // (tensor * seq)
+    if fsdp is None:
+        fsdp = remaining
+    data = n_devices // (fsdp * tensor * seq)
+    cfg = MeshConfig(data=data, fsdp=fsdp, seq=seq, tensor=tensor)
+    return make_mesh(cfg, jax.devices()[:n_devices])
+
+
+def mesh_summary(mesh: Mesh) -> str:
+    parts = [f"{name}={size}" for name, size in mesh.shape.items() if size > 1]
+    return ",".join(parts) or "single-device"

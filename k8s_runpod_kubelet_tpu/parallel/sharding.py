@@ -1,0 +1,79 @@
+"""Logical-axis sharding rules (GSPMD/MaxText style).
+
+Model code annotates tensors with LOGICAL axis names ("batch", "embed", ...);
+this module maps them to MESH axes per a rules table. Changing the parallelism
+strategy = changing the rules, not the model. XLA inserts the collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import AXES
+
+# logical axis -> mesh axis (or tuple of mesh axes, or None = replicated).
+# The default table implements: batch over (data, fsdp); params sharded over
+# fsdp (ZeRO-3) and tensor; activations' sequence over seq (ring attention);
+# heads/mlp over tensor; experts over expert.
+LOGICAL_RULES: dict[str, object] = {
+    # activation axes — batch soaks up both data-parallel axes; embed stays
+    # unsharded on activations (a duplicate mesh axis in one spec is illegal,
+    # and the fsdp all-gather happens on the PARAMS, not the activations)
+    "batch": (AXES.DATA, AXES.FSDP),
+    "seq": AXES.SEQ,               # context parallel (ring attention)
+    "act_embed": None,
+    "act_mlp": AXES.TENSOR,
+    "act_heads": AXES.TENSOR,
+    "act_vocab": AXES.TENSOR,
+    # parameter axes — embed sharded over fsdp (ZeRO-3), output dims over tensor
+    "embed": AXES.FSDP,
+    "mlp": AXES.TENSOR,
+    "heads": AXES.TENSOR,
+    "kv_heads": AXES.TENSOR,
+    "qkv": None,
+    "head_dim": None,
+    "vocab": AXES.TENSOR,
+    "expert": AXES.EXPERT,
+    "stage": AXES.STAGE,
+    "norm": None,
+    "layer": None,  # leading axis of scan-stacked layer params
+}
+
+
+def _mesh_axes_for(logical: Optional[str], rules: dict) -> object:
+    if logical is None:
+        return None
+    return rules.get(logical)
+
+
+def logical_spec(logical_axes: Sequence[Optional[str]],
+                 rules: Optional[dict] = None) -> P:
+    """('batch','seq','embed') -> PartitionSpec(('data','fsdp'),'seq','fsdp')."""
+    rules = rules or LOGICAL_RULES
+    return P(*[_mesh_axes_for(ax, rules) for ax in logical_axes])
+
+
+def logical_sharding(mesh: Mesh, logical_axes: Sequence[Optional[str]],
+                     rules: Optional[dict] = None) -> NamedSharding:
+    return NamedSharding(mesh, logical_spec(logical_axes, rules))
+
+
+def shard_logical(x, mesh: Mesh, logical_axes: Sequence[Optional[str]],
+                  rules: Optional[dict] = None):
+    """In-graph sharding constraint by logical axes (use inside jit)."""
+    return jax.lax.with_sharding_constraint(
+        x, logical_sharding(mesh, logical_axes, rules))
+
+
+def param_shardings(mesh: Mesh, logical_tree, rules: Optional[dict] = None):
+    """Map a pytree of logical-axis tuples to a pytree of NamedShardings.
+    ``logical_tree`` mirrors the param tree, leaves are tuples of logical
+    axis names (as produced by models' ``logical_axes()`` helpers)."""
+    return jax.tree_util.tree_map(
+        lambda axes: logical_sharding(mesh, axes, rules),
+        logical_tree,
+        is_leaf=lambda v: isinstance(v, tuple),
+    )

@@ -89,6 +89,7 @@ class Provider(ReconcileMixin, RecoveryMixin):
         self.metrics = metrics or Metrics()
 
         self.lock = threading.RLock()
+        self._reconcile_guard = threading.Lock()  # one reconcile pass at a time
         self.pods: dict[str, dict] = {}                 # ns/name -> pod
         self.instances: dict[str, InstanceInfo] = {}    # ns/name -> info
         self.deleted: dict[str, DeletedPodInfo] = {}    # ns/name -> tombstone

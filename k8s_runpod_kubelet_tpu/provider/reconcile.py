@@ -37,7 +37,19 @@ class ReconcileMixin:
     def update_all_pod_statuses(self):
         """One reconcile pass (parity: updateAllPodStatuses kubelet.go:816-974).
         Copy-then-act: snapshot under the lock, then talk to the cloud without
-        holding it (lock discipline parity: kubelet.go:817-823)."""
+        holding it (lock discipline parity: kubelet.go:817-823).
+
+        Non-reentrant: the 30s status loop and the 10s notify ticker both call
+        this; a pass already in flight makes the second caller a no-op, so the
+        same pod can never be gang-launched from two threads."""
+        if not self._reconcile_guard.acquire(blocking=False):
+            return
+        try:
+            self._update_all_pod_statuses_locked()
+        finally:
+            self._reconcile_guard.release()
+
+    def _update_all_pod_statuses_locked(self):
         with self.lock:
             snapshot = [(k, ko.deep_copy(p), self.instances.get(k))
                         for k, p in self.pods.items()]
@@ -101,8 +113,11 @@ class ReconcileMixin:
                 return  # no change — don't patch (kubelet.go:870-872)
             info.fingerprint = fp
             info.pod_status = status
-            ready_now = status.get("phase") == "Running" and not info.ready
-            info.ready = status.get("phase") == "Running"
+            is_ready = (status.get("phase") == "Running"
+                        and any(c.get("type") == "Ready" and c.get("status") == "True"
+                                for c in status.get("conditions", [])))
+            ready_now = is_ready and not info.ready
+            info.ready = is_ready
             if ready_now and info.ready_at is None:
                 info.ready_at = now
                 self.metrics.observe("tpu_kubelet_schedule_to_ready_seconds",
@@ -150,6 +165,14 @@ class ReconcileMixin:
         except KubeApiError as e:
             log.warning("preemption-count annotate of %s failed: %s", key, e)
         with self.lock:
+            # keep the cached pod in sync even if the API patch failed: the
+            # preemption count feeds qr_name_for_pod, which must never reuse
+            # the dying slice's name on the redeploy
+            cached = self.pods.get(key)
+            if cached is not None:
+                anns = cached.setdefault("metadata", {}).setdefault("annotations", {})
+                anns.pop(A.QUEUED_RESOURCE, None)
+                anns[A.PREEMPTION_COUNT] = str(info.preemption_count)
             info.qr_name = ""
             info.workload_launched = False
             info.ready = False

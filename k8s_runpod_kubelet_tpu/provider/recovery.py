@@ -38,9 +38,15 @@ class RecoveryMixin:
             slices = {qr.name: qr for qr in self.tpu.list_queued_resources()
                       if qr.labels.get("managed-by") == "tpu-virtual-kubelet"
                       and qr.labels.get("node") == self.cfg.node_name}
+            slices_listed = True
         except TpuApiError as e:
-            log.error("recovery: cannot list slices: %s — proceeding with pods only", e)
+            # A transient list failure must NOT make bound slices look missing —
+            # that would strip bindings and Fail healthy pods. Recover what the
+            # annotations alone allow; the reconcile loop completes the picture.
+            log.error("recovery: cannot list slices: %s — recovering by "
+                      "annotation only, skipping missing-slice handling", e)
             slices = {}
+            slices_listed = False
 
         now = self.clock()
         claimed: set[str] = set()
@@ -66,19 +72,32 @@ class RecoveryMixin:
                     if qr.labels.get("pod-uid") == ko.uid(pod):
                         qr_name = qr.name
                         break
-            if qr_name and qr_name in slices:
-                self._recover_instance(pod, slices[qr_name])
-                claimed.add(qr_name)
-                recovered += 1
-            elif qr_name:
-                self.handle_missing_instance(pod)  # :1484-1487
-                missing += 1
-            else:
-                with self.lock:  # no slice: let the pending processor deploy (:1488-1506)
-                    from .provider import InstanceInfo
-                    self.pods[key] = ko.deep_copy(pod)
-                    self.instances[key] = InstanceInfo(created_at=now, pending_since=now)
-                pending += 1
+            try:
+                if qr_name and qr_name in slices:
+                    self._recover_instance(pod, slices[qr_name])
+                    claimed.add(qr_name)
+                    recovered += 1
+                elif qr_name and slices_listed:
+                    self.handle_missing_instance(pod)  # :1484-1487
+                    missing += 1
+                elif qr_name:
+                    # list failed: trust the annotation, let reconcile verify
+                    self._recover_by_annotation(pod, qr_name)
+                    claimed.add(qr_name)
+                    recovered += 1
+                else:
+                    with self.lock:  # no slice: pending processor deploys (:1488-1506)
+                        from .provider import InstanceInfo
+                        self.pods[key] = ko.deep_copy(pod)
+                        self.instances[key] = InstanceInfo(created_at=now,
+                                                           pending_since=now)
+                    pending += 1
+            except TpuApiError as e:
+                # one pod's cloud hiccup must not abort recovery of the rest;
+                # the reconcile loop retries this pod every cycle anyway
+                log.warning("recovery of %s failed (%s) — deferring to the "
+                            "reconcile loop", key, e)
+                self._recover_by_annotation(pod, qr_name)
 
         # orphan adoption: slices with no K8s pod (:1510-1524)
         for qr in slices.values():
@@ -96,6 +115,23 @@ class RecoveryMixin:
                     log.warning("recovery: delete orphan %s failed: %s", qr.name, e)
         log.info("recovery complete: %d recovered, %d adopted, %d pending, "
                  "%d missing-slice", recovered, adopted, pending, missing)
+
+    def _recover_by_annotation(self, pod: dict, qr_name: str):
+        """Minimal re-bind when the cloud can't be consulted: cache the pod with
+        its annotated slice; the reconcile loop fills in live state (or routes
+        to handle_missing_instance if the slice really is gone)."""
+        from .provider import InstanceInfo
+        if not qr_name:
+            return
+        key = ko.namespaced_name(pod)
+        with self.lock:
+            self.pods[key] = ko.deep_copy(pod)
+            self.instances[key] = InstanceInfo(
+                qr_name=qr_name,
+                zone=ko.annotations(pod).get(A.ZONE, "") or self.cfg.zone,
+                accelerator_type=ko.annotations(pod).get(A.ACCELERATOR_TYPE, ""),
+                created_at=self.clock(),
+            )
 
     def _recover_instance(self, pod: dict, qr: QueuedResource):
         """Rebuild the cache entry from a live slice (kubelet.go:1455-1483)."""

@@ -148,10 +148,16 @@ def translate_status(pod: dict, detailed: DetailedStatus, *,
                      container_state={"terminated": {"exitCode": 137,
                                                      "reason": "Preempted"}})
     if state is S.DELETING:
-        return _base(pod, "Running", reason="SliceDeleting",
+        # keep whatever phase the pod already had — DELETING is transitional
+        # (the pod is usually being deleted anyway); never report Running for
+        # a gang that may never have run, and never mark it ready
+        prior = pod.get("status", {}).get("phase") or "Pending"
+        if prior in ("Succeeded", "Failed"):
+            prior_status = dict(pod["status"])
+            return prior_status
+        return _base(pod, prior, reason="SliceDeleting",
                      message=f"slice {qr.name} deleting", pod_ip=pod_ip,
-                     container_state={"terminated": {"exitCode": 0,
-                                                     "reason": "SliceDeleting"}})
+                     container_state={"waiting": {"reason": "SliceDeleting"}})
     if state is S.FAILED:
         return _base(pod, "Failed", reason="SliceFailed",
                      message=f"slice {qr.name} failed: {qr.state_message}",

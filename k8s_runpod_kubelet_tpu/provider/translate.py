@@ -54,9 +54,15 @@ def is_auto_injected_env(name: str) -> bool:
 def qr_name_for_pod(pod: dict) -> str:
     """Deterministic queued-resource name from the pod UID (RFC-1035 safe).
     The durable pod<->slice binding is this name + the annotation — no local DB
-    (state model parity: SURVEY.md §5.4)."""
+    (state model parity: SURVEY.md §5.4). After a preemption requeue the
+    tpu.dev/preemption-count annotation suffixes the name, so the retry can
+    never 409-collide with its own dying predecessor (whose delete may still
+    be in flight in the real, asynchronous cloud API)."""
+    from .annotations import Annotations as A
     u = ko.uid(pod).replace("-", "")[:16].lower() or "nouid"
-    return f"qr-{u}"
+    attempt = ko.annotations(pod).get(A.PREEMPTION_COUNT, "")
+    suffix = f"-r{attempt}" if attempt and attempt != "0" else ""
+    return f"qr-{u}{suffix}"
 
 
 def _decode_secret(secret: dict, key: str) -> str:

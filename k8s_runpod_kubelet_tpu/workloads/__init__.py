@@ -1,0 +1,9 @@
+"""Runnable workloads: training (MaxText-style) and serving (JetStream-style).
+
+These are what the kubelet's pods actually run — the in-repo implementations of
+the north-star workloads (BASELINE.json configs 2-5).
+"""
+
+from .train import TrainConfig, Trainer, make_train_step, synthetic_batches
+
+__all__ = ["TrainConfig", "Trainer", "make_train_step", "synthetic_batches"]

@@ -1,0 +1,175 @@
+"""Sharded training: loss, train step, data, checkpoint/resume.
+
+MaxText-equivalent pretrain loop, TPU-first: the step is one jit over the mesh
+(params sharded by the logical rules, batch over data axes), remat is in the
+model's scan body, optimizer state inherits param shardings automatically, and
+checkpointing is orbax with resume-by-step — the workload half of the
+checkpoint/resume story (control-plane half: SURVEY.md §5.4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.llama import LlamaConfig, LlamaModel, init_params, param_logical_axes
+from ..parallel.sharding import logical_sharding, param_shardings
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    learning_rate: float = 3e-4
+    warmup_steps: int = 100
+    weight_decay: float = 0.1
+    grad_clip: float = 1.0
+    batch_size: int = 8
+    seq_len: int = 512
+    steps: int = 100
+    checkpoint_dir: str = ""
+    checkpoint_every: int = 1000
+
+
+def cross_entropy_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Mean next-token NLL. logits (B,S,V) f32/bf16, targets (B,S) int32."""
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
+    schedule = optax.warmup_cosine_decay_schedule(
+        0.0, tc.learning_rate, tc.warmup_steps, max(tc.steps, tc.warmup_steps + 1))
+    return optax.chain(
+        optax.clip_by_global_norm(tc.grad_clip),
+        optax.adamw(schedule, b1=0.9, b2=0.95, weight_decay=tc.weight_decay),
+    )
+
+
+def make_train_step(model: LlamaModel, optimizer: optax.GradientTransformation,
+                    donate: bool = True):
+    """Returns jitted (params, opt_state, batch) -> (params, opt_state, metrics).
+    batch: tokens (B, S+1) — inputs are [:, :-1], targets [:, 1:]."""
+
+    def step(params, opt_state, batch):
+        inputs, targets = batch[:, :-1], batch[:, 1:]
+
+        def loss_fn(p):
+            return cross_entropy_loss(model.forward(p, inputs), targets)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        gnorm = optax.global_norm(grads)
+        return params, opt_state, {"loss": loss, "grad_norm": gnorm}
+
+    donate_argnums = (0, 1) if donate else ()
+    return jax.jit(step, donate_argnums=donate_argnums)
+
+
+def synthetic_batches(cfg: LlamaConfig, tc: TrainConfig,
+                      mesh: Optional[Mesh] = None,
+                      seed: int = 0) -> Iterator[jax.Array]:
+    """Deterministic synthetic token stream (pretrain-shaped), sharded on the
+    data axes when a mesh is given."""
+    sharding = None
+    if mesh is not None:
+        sharding = logical_sharding(mesh, ("batch", None))
+    i = seed
+    while True:
+        key = jax.random.PRNGKey(i)
+        batch = jax.random.randint(key, (tc.batch_size, tc.seq_len + 1),
+                                   0, cfg.vocab_size, jnp.int32)
+        if sharding is not None:
+            batch = jax.device_put(batch, sharding)
+        yield batch
+        i += 1
+
+
+class Trainer:
+    """End-to-end training harness: sharded init, step loop, orbax checkpoints."""
+
+    def __init__(self, cfg: LlamaConfig, tc: TrainConfig,
+                 mesh: Optional[Mesh] = None, seed: int = 0):
+        self.cfg = cfg
+        self.tc = tc
+        self.mesh = mesh
+        self.model = LlamaModel(cfg, mesh)
+        self.optimizer = make_optimizer(tc)
+        self.params = init_params(cfg, jax.random.PRNGKey(seed), mesh)
+        # optax state mirrors the (already-sharded) params, so it inherits
+        # their shardings — no separate placement pass needed
+        self.opt_state = self.optimizer.init(self.params)
+        self.step_fn = make_train_step(self.model, self.optimizer)
+        self.step = 0
+        self._ckpt = None
+        if tc.checkpoint_dir:
+            import orbax.checkpoint as ocp
+            self._ckpt = ocp.CheckpointManager(
+                tc.checkpoint_dir,
+                options=ocp.CheckpointManagerOptions(max_to_keep=3))
+
+    # -- checkpoint / resume ---------------------------------------------------
+
+    def save(self):
+        if self._ckpt is None:
+            return
+        import orbax.checkpoint as ocp
+        self._ckpt.save(self.step, args=ocp.args.StandardSave(
+            {"params": self.params, "opt_state": self.opt_state}))
+        self._ckpt.wait_until_finished()
+        log.info("checkpoint saved at step %d", self.step)
+
+    def restore(self) -> bool:
+        if self._ckpt is None or self._ckpt.latest_step() is None:
+            return False
+        import orbax.checkpoint as ocp
+        target = {"params": self.params, "opt_state": self.opt_state}
+        restored = self._ckpt.restore(
+            self._ckpt.latest_step(),
+            args=ocp.args.StandardRestore(target))
+        self.params = restored["params"]
+        self.opt_state = restored["opt_state"]
+        self.step = self._ckpt.latest_step()
+        log.info("resumed from checkpoint step %d", self.step)
+        return True
+
+    # -- loop ------------------------------------------------------------------
+
+    def run(self, steps: Optional[int] = None,
+            batches: Optional[Iterator] = None) -> dict:
+        steps = steps or self.tc.steps
+        batches = batches or synthetic_batches(self.cfg, self.tc, self.mesh)
+        metrics: dict = {}
+        t0 = time.perf_counter()
+        tokens_per_batch = self.tc.batch_size * self.tc.seq_len
+        first_step_s = None
+        for _ in range(steps):
+            batch = next(batches)
+            self.params, self.opt_state, metrics = self.step_fn(
+                self.params, self.opt_state, batch)
+            if first_step_s is None:
+                jax.block_until_ready(metrics["loss"])
+                first_step_s = time.perf_counter() - t0
+            self.step += 1
+            if self.tc.checkpoint_dir and self.step % self.tc.checkpoint_every == 0:
+                self.save()
+        jax.block_until_ready(metrics["loss"])
+        wall = time.perf_counter() - t0
+        return {
+            "steps": steps,
+            "final_loss": float(metrics["loss"]),
+            "grad_norm": float(metrics["grad_norm"]),
+            "wall_s": wall,
+            "first_step_s": first_step_s,
+            "tokens_per_s": tokens_per_batch * steps / wall,
+        }

@@ -8,9 +8,20 @@ Control-plane tests never import jax; the env vars are harmless for them.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # override axon/tpu: tests always run on CPU
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The axon image registers its TPU platform from sitecustomize.py at interpreter
+# start, before any conftest runs — the env var alone is too late. The config
+# update works as long as no backend has been initialized yet. jax stays an
+# optional dependency: the control-plane tests are stdlib-only.
+try:
+    import jax  # noqa: E402
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # pragma: no cover — jax-free environment
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,145 @@
+"""Numerics tests for ops/ against reference implementations, plus ring
+attention on the 8-device virtual CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_runpod_kubelet_tpu.ops import (apply_rope, flash_attention, rms_norm,
+                                        ring_attention, rope_frequencies)
+from k8s_runpod_kubelet_tpu.ops.attention import _attention_xla
+from k8s_runpod_kubelet_tpu.parallel import MeshConfig, make_mesh
+
+
+def test_devices_virtualized():
+    assert jax.device_count() == 8  # conftest forced the CPU mesh
+
+
+class TestRmsNorm:
+    def test_matches_reference(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 64, 256))
+        w = jax.random.normal(jax.random.PRNGKey(1), (256,)) * 0.1 + 1.0
+        got = rms_norm(x, w)
+        ref = x * (1.0 / np.sqrt((np.asarray(x) ** 2).mean(-1, keepdims=True) + 1e-6))
+        ref = ref * np.asarray(w)
+        np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-5, atol=2e-5)
+
+    def test_bf16_stable(self):
+        x = (jax.random.normal(jax.random.PRNGKey(0), (8, 128)) * 100).astype(jnp.bfloat16)
+        w = jnp.ones((128,), jnp.bfloat16)
+        y = rms_norm(x, w)
+        assert y.dtype == jnp.bfloat16
+        assert bool(jnp.all(jnp.isfinite(y.astype(jnp.float32))))
+
+
+class TestRope:
+    def test_rotation_preserves_norm(self):
+        cos, sin = rope_frequencies(64, 128)
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 128, 4, 64))
+        y = apply_rope(x, cos, sin)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(x), axis=-1),
+            np.linalg.norm(np.asarray(y), axis=-1), rtol=1e-5)
+
+    def test_position_zero_identity(self):
+        cos, sin = rope_frequencies(64, 128)
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 1, 2, 64))
+        y = apply_rope(x, cos, sin, positions=jnp.zeros((1, 1), jnp.int32))
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-6)
+
+    def test_relative_property(self):
+        # <rope(q,m), rope(k,n)> depends only on m-n: shift both by +5
+        cos, sin = rope_frequencies(64, 256)
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, 1, 1, 64))
+        k = jax.random.normal(jax.random.PRNGKey(1), (1, 1, 1, 64))
+        def dot_at(pm, pn):
+            qm = apply_rope(q, cos, sin, positions=jnp.array([[pm]]))
+            kn = apply_rope(k, cos, sin, positions=jnp.array([[pn]]))
+            return float(jnp.sum(qm * kn))
+        assert dot_at(10, 3) == pytest.approx(dot_at(15, 8), rel=1e-4)
+
+    def test_llama31_scaling_changes_low_freqs(self):
+        cos_a, _ = rope_frequencies(64, 64)
+        cos_b, _ = rope_frequencies(64, 64, scaling={"factor": 8.0,
+                                                     "original_max_position": 8192})
+        assert not np.allclose(np.asarray(cos_a), np.asarray(cos_b))
+
+
+def naive_attention(q, k, v, causal=True):
+    b, hq, sq, d = q.shape
+    _, hkv, sk, _ = k.shape
+    k = np.repeat(np.asarray(k), hq // hkv, axis=1)
+    v = np.repeat(np.asarray(v), hq // hkv, axis=1)
+    s = np.einsum("bhqd,bhkd->bhqk", np.asarray(q, np.float64), k.astype(np.float64))
+    s = s / np.sqrt(d)
+    if causal:
+        mask = np.tril(np.ones((sq, sk), bool))
+        s = np.where(mask, s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bhkd->bhqd", p, v.astype(np.float64))
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.parametrize("hq,hkv", [(4, 4), (8, 2)])
+    def test_matches_naive(self, causal, hq, hkv):
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(ks[0], (2, hq, 64, 32))
+        k = jax.random.normal(ks[1], (2, hkv, 64, 32))
+        v = jax.random.normal(ks[2], (2, hkv, 64, 32))
+        got = flash_attention(q, k, v, causal=causal)  # XLA path on CPU
+        ref = naive_attention(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-4, atol=2e-4)
+
+    def test_kernel_interpret_mode_matches(self):
+        """Run the actual Pallas kernel in interpreter mode on CPU."""
+        import functools
+        from jax.experimental import pallas as pl
+        from k8s_runpod_kubelet_tpu.ops.attention import _flash_kernel
+        b, hq, hkv, s, d, bq, bk = 1, 4, 2, 256, 32, 128, 128
+        ks = jax.random.split(jax.random.PRNGKey(1), 3)
+        q = jax.random.normal(ks[0], (b, hq, s, d))
+        k = jax.random.normal(ks[1], (b, hkv, s, d))
+        v = jax.random.normal(ks[2], (b, hkv, s, d))
+        group = hq // hkv
+        kernel = functools.partial(_flash_kernel, block_q=bq, block_k=bk,
+                                   seq_k=s, causal=True, sm_scale=d ** -0.5)
+        out = pl.pallas_call(
+            kernel,
+            grid=(b, hq, s // bq),
+            in_specs=[
+                pl.BlockSpec((1, 1, bq, d), lambda bb, h, i: (bb, h, i, 0)),
+                pl.BlockSpec((1, 1, s, d), lambda bb, h, i: (bb, h // group, 0, 0)),
+                pl.BlockSpec((1, 1, s, d), lambda bb, h, i: (bb, h // group, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, bq, d), lambda bb, h, i: (bb, h, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((b, hq, s, d), q.dtype),
+            interpret=True,
+        )(q, k, v)
+        ref = naive_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_single_device(self, causal):
+        mesh = make_mesh(MeshConfig(data=1, seq=8))
+        ks = jax.random.split(jax.random.PRNGKey(2), 3)
+        q = jax.random.normal(ks[0], (1, 4, 256, 32))
+        k = jax.random.normal(ks[1], (1, 2, 256, 32))
+        v = jax.random.normal(ks[2], (1, 2, 256, 32))
+        got = ring_attention(q, k, v, mesh, causal=causal)
+        ref = naive_attention(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-4, atol=2e-4)
+
+    def test_seq_axis_one_falls_through(self):
+        mesh = make_mesh(MeshConfig(data=8, seq=1))
+        ks = jax.random.split(jax.random.PRNGKey(3), 3)
+        q = jax.random.normal(ks[0], (8, 4, 64, 32))
+        k = jax.random.normal(ks[1], (8, 4, 64, 32))
+        v = jax.random.normal(ks[2], (8, 4, 64, 32))
+        got = ring_attention(q, k, v, mesh)
+        ref = naive_attention(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-4, atol=2e-4)
