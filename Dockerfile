@@ -1,0 +1,18 @@
+# Multi-stage build mirroring the reference's shape (Dockerfile:1-22: builder ->
+# distroless nonroot static binary). Python equivalent: deps layer -> slim
+# runtime, non-root UID 65532, stdlib-only control plane (no pip installs needed
+# for the kubelet itself; jax extras only for workload images).
+FROM python:3.12-slim AS builder
+WORKDIR /build
+COPY k8s_runpod_kubelet_tpu/ k8s_runpod_kubelet_tpu/
+COPY pyproject.toml .
+RUN python -m compileall -q k8s_runpod_kubelet_tpu
+
+FROM python:3.12-slim
+LABEL org.opencontainers.image.source=https://github.com/tpu-virtual-kubelet/tpu-virtual-kubelet
+WORKDIR /app
+COPY --from=builder /build/k8s_runpod_kubelet_tpu/ k8s_runpod_kubelet_tpu/
+# nonroot (parity: distroless nonroot uid 65532, Dockerfile:20)
+RUN groupadd -g 65532 nonroot && useradd -u 65532 -g 65532 -m nonroot
+USER 65532:65532
+ENTRYPOINT ["python", "-m", "k8s_runpod_kubelet_tpu.cmd.main"]

@@ -1,0 +1,33 @@
+{{/* Helper shape parity: helm/runpod-kubelet/templates/_helpers.tpl */}}
+{{- define "tpu-virtual-kubelet.name" -}}
+{{- default .Chart.Name .Values.nameOverride | trunc 63 | trimSuffix "-" }}
+{{- end }}
+
+{{- define "tpu-virtual-kubelet.fullname" -}}
+{{- if .Values.fullnameOverride }}
+{{- .Values.fullnameOverride | trunc 63 | trimSuffix "-" }}
+{{- else }}
+{{- printf "%s-%s" .Release.Name (include "tpu-virtual-kubelet.name" .) | trunc 63 | trimSuffix "-" }}
+{{- end }}
+{{- end }}
+
+{{- define "tpu-virtual-kubelet.labels" -}}
+helm.sh/chart: {{ printf "%s-%s" .Chart.Name .Chart.Version }}
+app.kubernetes.io/name: {{ include "tpu-virtual-kubelet.name" . }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/version: {{ .Chart.AppVersion | quote }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+{{- end }}
+
+{{- define "tpu-virtual-kubelet.selectorLabels" -}}
+app.kubernetes.io/name: {{ include "tpu-virtual-kubelet.name" . }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+{{- end }}
+
+{{- define "tpu-virtual-kubelet.serviceAccountName" -}}
+{{- if .Values.serviceAccount.create }}
+{{- default (include "tpu-virtual-kubelet.fullname" .) .Values.serviceAccount.name }}
+{{- else }}
+{{- default "default" .Values.serviceAccount.name }}
+{{- end }}
+{{- end }}

@@ -335,7 +335,8 @@ class FakeTpuServer:
         return self
 
     def stop(self):
-        self._httpd.shutdown()
+        if self._thread.is_alive():  # shutdown() deadlocks on a never-started server
+            self._httpd.shutdown()
         self._httpd.server_close()
 
     def __enter__(self) -> "FakeTpuServer":

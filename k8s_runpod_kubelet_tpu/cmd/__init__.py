@@ -1,0 +1,1 @@
+"""Process bootstrap (L4')."""

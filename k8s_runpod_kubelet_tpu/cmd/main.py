@@ -1,0 +1,132 @@
+"""Kubelet bootstrap: flags -> config -> clients -> provider -> controllers ->
+servers -> recovery -> run loop.
+
+Mirrors the reference's startup call stack (SURVEY.md §3.1, main.go:333-431)
+with the config bugs fixed (every flag is wired; SURVEY.md §5.6):
+
+  parse flags / env / file (precedence)      ~ main.go:59-90
+  logging (level APPLIED, error sink)        ~ main.go:111-144
+  K8s client (in-cluster || kubeconfig)      ~ main.go:464-494
+  TPU client + health probe                  ~ kubelet.go:338,365
+  Provider + background loops                ~ kubelet.go:334-379
+  Node + Pod controllers (in-repo L3')       ~ main.go:167-214
+  kubelet API server :10250                  ~ main.go:217-248
+  health server :8080 (readyz = Ping)        ~ main.go:395-404
+  LoadRunning state recovery                 ~ main.go:425-426
+  signal -> graceful shutdown                ~ main.go:344-350
+
+Run: python -m k8s_runpod_kubelet_tpu.cmd.main --node-name=virtual-tpu ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+import threading
+
+from .. import config as config_mod
+from ..cloud import HttpTransport, TpuClient
+from ..gang import GangExecutor, SshWorkerTransport
+from ..health import HealthServer
+from ..kube import RealKubeClient
+from ..logging_util import setup_logging
+from ..metrics import Metrics
+from ..node import KubeletApiServer, NodeController, PodController
+from ..provider import Provider
+
+log = logging.getLogger("tpu-kubelet")
+
+
+def parse_flags(argv: list[str]) -> argparse.Namespace:
+    p = argparse.ArgumentParser("tpu-virtual-kubelet")
+    # flag set mirrors main.go:59-73, GPU-isms retargeted
+    p.add_argument("--kubeconfig", default=None)
+    p.add_argument("--node-name", dest="node_name", default=None)
+    p.add_argument("--namespace", default=None)
+    p.add_argument("--internal-ip", dest="internal_ip", default=None)
+    p.add_argument("--listen-port", dest="listen_port", type=int, default=None)
+    p.add_argument("--health-server-address", dest="health_address", default=None)
+    p.add_argument("--reconcile-interval", dest="reconcile_interval_s",
+                   type=float, default=None)
+    p.add_argument("--max-cost-per-hr", dest="max_cost_per_hr", type=float,
+                   default=None, help="cost ceiling, actually enforced")
+    p.add_argument("--project", default=None)
+    p.add_argument("--zone", default=None)
+    p.add_argument("--zones", default=None, help="comma-separated allowed zones")
+    p.add_argument("--default-generation", dest="default_generation", default=None)
+    p.add_argument("--tpu-api-endpoint", dest="tpu_api_endpoint", default=None)
+    p.add_argument("--log-level", dest="log_level", default=None)
+    p.add_argument("--provider-config", dest="provider_config", default=None)
+    p.add_argument("--os", dest="operating_system", default=None)
+    return p.parse_args(argv)
+
+
+def build(cfg: config_mod.Config, kube=None, tpu=None, worker_transport=None):
+    """Wire the full kubelet; injectable clients for tests."""
+    metrics = Metrics()
+    kube = kube or RealKubeClient.from_env(cfg.kubeconfig)
+    tpu = tpu or TpuClient(
+        HttpTransport(cfg.tpu_api_endpoint, token=cfg.tpu_api_token),
+        project=cfg.project, zone=cfg.zone)
+    gang = GangExecutor(worker_transport or SshWorkerTransport())
+    provider = Provider(cfg, kube, tpu, gang_executor=gang, metrics=metrics)
+    node_controller = NodeController(kube, provider,
+                                     status_interval_s=cfg.node_status_interval_s)
+    pod_controller = PodController(kube, provider, cfg.node_name,
+                                   resync_interval_s=cfg.reconcile_interval_s)
+    api_server = KubeletApiServer(provider, port=cfg.listen_port)
+    health = HealthServer(cfg.health_address, ready_func=provider.ping,
+                          metrics=metrics)
+    return provider, node_controller, pod_controller, api_server, health
+
+
+def main(argv=None) -> int:
+    args = parse_flags(argv if argv is not None else sys.argv[1:])
+    overrides = {k: v for k, v in vars(args).items()
+                 if v is not None and k != "provider_config"}
+    cfg = config_mod.load(file_path=args.provider_config, overrides=overrides)
+    setup_logging(cfg.log_level, cfg.sentry_url,
+                  os.environ.get("environment", "production"))
+    log.info("starting tpu-virtual-kubelet node=%s project=%s zone=%s",
+             cfg.node_name, cfg.project, cfg.zone)
+
+    if not cfg.tpu_api_token and "googleapis.com" in cfg.tpu_api_endpoint:
+        log.error("TPU_API_TOKEN is required (parity: RUNPOD_API_KEY check, "
+                  "main.go:306-311)")
+        return 1
+
+    provider, nc, pc, api, health = build(cfg)
+
+    stop = threading.Event()
+
+    def on_signal(signum, frame):
+        log.info("signal %s — shutting down", signum)
+        stop.set()
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+
+    health.start()
+    nc.start()
+    pc.start()
+    api.start()
+    provider.start()
+    provider.load_running()  # crash recovery (main.go:425-426)
+    log.info("kubelet running: kubelet API :%d, health %s",
+             cfg.listen_port, cfg.health_address)
+    stop.wait()
+
+    provider.stop()
+    pc.stop()
+    nc.stop()
+    api.stop()
+    health.stop()
+    log.info("shutdown complete")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

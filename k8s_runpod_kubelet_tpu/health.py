@@ -1,0 +1,94 @@
+"""Health + metrics server.
+
+Parity with the reference's standalone health server (health.go:1-74:
+/healthz = liveness flag, /readyz = flag AND readyFunc — wired to provider.Ping
+at main.go:397-402), plus /metrics (Prometheus text) which the reference
+lacks entirely (SURVEY.md §5.5).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from .metrics import Metrics
+
+log = logging.getLogger(__name__)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_ref: "HealthServer"
+
+    def log_message(self, *a):
+        pass
+
+    def _send(self, status: int, body: bytes, ctype: str = "text/plain"):
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        hs = self.server_ref
+        if self.path == "/healthz":
+            if hs.healthy.is_set():
+                return self._send(200, b"ok")
+            return self._send(503, b"unhealthy")
+        if self.path == "/readyz":
+            ready = hs.healthy.is_set()
+            if ready and hs.ready_func is not None:
+                try:
+                    ready = bool(hs.ready_func())
+                except Exception as e:  # noqa: BLE001
+                    log.warning("readyz probe errored: %s", e)
+                    ready = False
+            return self._send(200 if ready else 503,
+                              b"ready" if ready else b"not ready")
+        if self.path == "/metrics" and hs.metrics is not None:
+            return self._send(200, hs.metrics.render().encode(),
+                              "text/plain; version=0.0.4")
+        self._send(404, b"not found")
+
+
+class HealthServer:
+    def __init__(self, address: str = ":8080",
+                 ready_func: Optional[Callable[[], bool]] = None,
+                 metrics: Optional[Metrics] = None):
+        host, _, port = address.rpartition(":")
+        self.ready_func = ready_func
+        self.metrics = metrics
+        self.healthy = threading.Event()
+        self.healthy.set()
+        handler = type("BoundHandler", (_Handler,), {"server_ref": self})
+        self._httpd = ThreadingHTTPServer((host or "0.0.0.0", int(port)), handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="health-server", daemon=True)
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "HealthServer":
+        self._thread.start()
+        log.info("health server on :%d (/healthz /readyz /metrics)", self.port)
+        return self
+
+    @property
+    def started(self) -> bool:
+        return self._thread.is_alive()
+
+    def set_healthy(self, healthy: bool):
+        if healthy:
+            self.healthy.set()
+        else:
+            self.healthy.clear()
+
+    def stop(self):
+        # shutdown() deadlocks if serve_forever never ran — only call it on a
+        # live server thread
+        if self._thread.is_alive():
+            self._httpd.shutdown()
+        self._httpd.server_close()

@@ -1,0 +1,77 @@
+"""Multi-sink structured logging.
+
+Parity with the reference's fan-out slog handler (loghandler.go:7-55: every
+record goes to stdout AND Sentry) — with two fixes the reference needed
+(SURVEY.md §5.5): the configured level is actually applied (the reference
+parses --log-level and ignores it, main.go:111-144), and the error sink is a
+dependency-free HTTP poster (SENTRY_URL-shaped) with a bounded in-memory ring
+of recent errors for the kubelet API/debug endpoints.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import threading
+import urllib.request
+from typing import Optional
+
+_LEVELS = {"debug": logging.DEBUG, "info": logging.INFO,
+           "warning": logging.WARNING, "error": logging.ERROR}
+
+
+class ErrorSinkHandler(logging.Handler):
+    """Posts WARNING+ records as JSON events to an HTTP sink (Sentry-shaped),
+    never blocking the caller: posts happen on a daemon thread, failures are
+    counted and dropped."""
+
+    def __init__(self, url: str, environment: str = "production",
+                 timeout_s: float = 3.0):
+        super().__init__(level=logging.WARNING)
+        self.url = url
+        self.environment = environment
+        self.timeout_s = timeout_s
+        self.dropped = 0
+        self.recent: collections.deque = collections.deque(maxlen=100)
+
+    def emit(self, record: logging.LogRecord):
+        event = {
+            "message": record.getMessage(),
+            "level": record.levelname.lower(),
+            "logger": record.name,
+            "environment": self.environment,
+            "timestamp": record.created,
+        }
+        self.recent.append(event)
+        t = threading.Thread(target=self._post, args=(event,), daemon=True)
+        t.start()
+
+    def _post(self, event: dict):
+        try:
+            req = urllib.request.Request(
+                self.url, data=json.dumps(event).encode(),
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=self.timeout_s).read()
+        except Exception:  # noqa: BLE001 — the error sink must never raise
+            self.dropped += 1
+
+
+def setup_logging(level: str = "info", sentry_url: str = "",
+                  environment: str = "production") -> list[logging.Handler]:
+    """Configure root logging: stdout always; HTTP error sink when configured.
+    Returns the installed handlers."""
+    root = logging.getLogger()
+    root.setLevel(_LEVELS.get(level.lower(), logging.INFO))  # level APPLIED
+    for h in list(root.handlers):
+        root.removeHandler(h)
+    stdout = logging.StreamHandler()
+    stdout.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname)s %(name)s: %(message)s"))
+    root.addHandler(stdout)
+    handlers: list[logging.Handler] = [stdout]
+    if sentry_url:
+        sink = ErrorSinkHandler(sentry_url, environment)
+        root.addHandler(sink)
+        handlers.append(sink)
+    return handlers

@@ -228,17 +228,28 @@ class LlamaModel:
     # -- decode (serving) ------------------------------------------------------
 
     def init_cache(self, batch: int, max_len: Optional[int] = None) -> Params:
+        """KV cache with PER-SLOT write indices — the decode batch is a set of
+        independent in-flight requests (continuous batching), not one sequence."""
         cfg = self.cfg
         max_len = max_len or cfg.max_seq_len
         shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim_)
         return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype),
-                "index": jnp.zeros((), jnp.int32)}
+                "index": jnp.zeros((batch,), jnp.int32)}
 
-    def prefill(self, params: Params, tokens: jax.Array, cache: Params
+    def prefill(self, params: Params, tokens: jax.Array, cache: Params,
+                true_length: Optional[jax.Array] = None
                 ) -> tuple[jax.Array, Params]:
-        """Run the prompt through, filling the cache. Returns (last_logits, cache)."""
+        """Run the prompt through, filling the cache. Returns (last_logits, cache).
+
+        ``true_length`` (B,) supports PADDED prompts (bucketed to a few fixed
+        shapes so serving admission never recompiles): logits are taken at each
+        row's last real token and the cache index starts there. Padded K/V
+        positions are never attended — decode overwrites position i exactly when
+        its index reaches i, before the mask exposes it."""
         cfg = self.cfg
         b, s = tokens.shape
+        if true_length is None:
+            true_length = jnp.full((b,), s, jnp.int32)
         cos, sin = rope_frequencies(cfg.head_dim_, cfg.max_seq_len,
                                     cfg.rope_theta, cfg.rope_scaling)
         x = params["tok_embed"].astype(cfg.dtype)[tokens]
@@ -263,25 +274,35 @@ class LlamaModel:
         x = rms_norm(x, params["final_norm"], cfg.norm_eps)
         head = (params["tok_embed"].T if cfg.tie_embeddings
                 else params["lm_head"]).astype(cfg.dtype)
-        logits = x[:, -1:] @ head
+        last = x[jnp.arange(b), true_length - 1]  # (B, E): each row's last real token
+        logits = last @ head
         max_len = cache["k"].shape[2]
         pad = [(0, 0), (0, 0), (0, max_len - s), (0, 0), (0, 0)]
         cache = {"k": jnp.pad(k_all, pad), "v": jnp.pad(v_all, pad),
-                 "index": jnp.array(s, jnp.int32)}
-        return logits[:, 0], cache
+                 "index": true_length.astype(jnp.int32)}
+        return logits, cache
 
-    def decode_step(self, params: Params, token: jax.Array, cache: Params
+    def decode_step(self, params: Params, token: jax.Array, cache: Params,
+                    active: Optional[jax.Array] = None
                     ) -> tuple[jax.Array, Params]:
-        """One token for the whole batch: token (B,) -> (logits (B,V), cache)."""
+        """One token per slot: token (B,) -> (logits (B,V), cache).
+
+        Each slot decodes at its own cache index (continuous batching).
+        ``active`` (B,) bool freezes inactive slots: their cache and index
+        stay untouched, so idle slots cost compute but not correctness."""
         cfg = self.cfg
         b = token.shape[0]
-        idx = cache["index"]
+        idx = cache["index"]  # (B,)
+        if active is None:
+            active = jnp.ones((b,), bool)
         cos, sin = rope_frequencies(cfg.head_dim_, cfg.max_seq_len,
                                     cfg.rope_theta, cfg.rope_scaling)
         x = params["tok_embed"].astype(cfg.dtype)[token[:, None]]  # (B,1,E)
-        positions = jnp.full((b, 1), idx, jnp.int32)
+        positions = idx[:, None]  # (B,1)
         max_len = cache["k"].shape[2]
-        valid = (jnp.arange(max_len) <= idx)[None, None, None, :]  # (1,1,1,L)
+        # (B,1,1,L): slot i may attend up to its own index
+        valid = (jnp.arange(max_len)[None, :] <= idx[:, None])[:, None, None, :]
+        batch_ids = jnp.arange(b)
 
         def block(carry, inputs):
             y = carry
@@ -292,14 +313,20 @@ class LlamaModel:
             v = (h @ lp["wv"].astype(cfg.dtype)).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim_)
             q = apply_rope(q, cos, sin, positions)
             k = apply_rope(k, cos, sin, positions)
-            k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, idx, 0, 0))
-            v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, idx, 0, 0))
+            # per-slot scatter at each slot's own index; frozen slots keep
+            # their previous cache line
+            k_new = jnp.where(active[:, None, None],
+                              k[:, 0], k_cache[batch_ids, idx])
+            v_new = jnp.where(active[:, None, None],
+                              v[:, 0], v_cache[batch_ids, idx])
+            k_cache = k_cache.at[batch_ids, idx].set(k_new)
+            v_cache = v_cache.at[batch_ids, idx].set(v_new)
             # attention of one query vs the cache (GQA)
             group = cfg.n_heads // cfg.n_kv_heads
             qg = (q.astype(jnp.float32) * cfg.head_dim_ ** -0.5
                   ).reshape(b, cfg.n_kv_heads, group, cfg.head_dim_)
             s = jnp.einsum("bhgd,bLhd->bhgL", qg, k_cache.astype(jnp.float32))
-            s = jnp.where(valid, s, -1e30)  # (1,1,1,L) broadcasts over (b,h,g,L)
+            s = jnp.where(valid, s, -1e30)
             p = jax.nn.softmax(s, axis=-1)
             o = jnp.einsum("bhgL,bLhd->bhgd", p, v_cache.astype(jnp.float32))
             o = o.reshape(b, 1, cfg.n_heads * cfg.head_dim_).astype(cfg.dtype)
@@ -313,4 +340,16 @@ class LlamaModel:
         head = (params["tok_embed"].T if cfg.tie_embeddings
                 else params["lm_head"]).astype(cfg.dtype)
         logits = (x[:, 0] @ head).astype(jnp.float32)
-        return logits, {"k": k_new, "v": v_new, "index": idx + 1}
+        new_idx = jnp.where(active, idx + 1, idx)
+        return logits, {"k": k_new, "v": v_new, "index": new_idx}
+
+    @staticmethod
+    def insert_into_slot(cache: Params, single: Params, slot: int | jax.Array
+                         ) -> Params:
+        """Place a freshly-prefilled single-request cache (batch 1) into slot
+        ``slot`` of the serving cache (continuous batching admission)."""
+        return {
+            "k": cache["k"].at[:, slot].set(single["k"][:, 0]),
+            "v": cache["v"].at[:, slot].set(single["v"][:, 0]),
+            "index": cache["index"].at[slot].set(single["index"][0]),
+        }

@@ -17,13 +17,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from .common import use_pallas as _use_pallas
+
 NEG_INF = -1e30
-
-
-def _use_pallas(flag: Optional[bool]) -> bool:
-    if flag is not None:
-        return flag
-    return jax.default_backend() == "tpu"
 
 
 def _attention_xla(q, k, v, *, causal: bool, sm_scale: float,

@@ -1,0 +1,18 @@
+"""Shared kernel-selection policy."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+def use_pallas(flag: Optional[bool]) -> bool:
+    """Auto-select the Pallas path: explicit flag wins; env kill-switch
+    (TPU_KUBELET_NO_PALLAS=1) next; else Pallas on TPU backends only."""
+    if flag is not None:
+        return flag
+    if os.environ.get("TPU_KUBELET_NO_PALLAS") == "1":
+        return False
+    return jax.default_backend() == "tpu"

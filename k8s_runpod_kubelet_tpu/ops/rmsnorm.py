@@ -13,11 +13,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-
-def _use_pallas(flag: Optional[bool]) -> bool:
-    if flag is not None:
-        return flag
-    return jax.default_backend() == "tpu"
+from .common import use_pallas as _use_pallas
 
 
 def _rms_norm_xla(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
@@ -62,8 +58,8 @@ def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6,
     rows = 1
     for s in x.shape[:-1]:
         rows *= s
-    if rows % min(block_rows, rows) != 0 or rows == 0:
-        return _rms_norm_xla(x, weight, eps)
+    if rows == 0 or rows % min(block_rows, rows) != 0:
+        return _rms_norm_xla(x, weight, eps)  # empty or ragged: XLA handles it
     return _rms_pallas_diff(x, weight, eps, block_rows)
 
 

@@ -98,6 +98,10 @@ class RecoveryMixin:
                 log.warning("recovery of %s failed (%s) — deferring to the "
                             "reconcile loop", key, e)
                 self._recover_by_annotation(pod, qr_name)
+                if qr_name:
+                    # still claimed: the orphan loop must not adopt or delete
+                    # the slice of a pod we just re-bound
+                    claimed.add(qr_name)
 
         # orphan adoption: slices with no K8s pod (:1510-1524)
         for qr in slices.values():

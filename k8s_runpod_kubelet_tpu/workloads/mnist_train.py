@@ -1,0 +1,87 @@
+"""Single-chip MNIST training workload (BASELINE.json config 2).
+
+The pod command for the v5e-1 smoke test: trains the Flax CNN and prints one
+status line per epoch + a final JSON summary the integration harness can parse.
+Uses the real MNIST if an npz is provided (no-egress images can't download),
+else deterministic synthetic digits that are still learnable.
+
+Run: python -m k8s_runpod_kubelet_tpu.workloads.mnist_train [--steps N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..models.mnist import MnistCNN
+
+
+def load_data(npz_path: str = "", n: int = 4096):
+    if npz_path:
+        d = np.load(npz_path)
+        return (d["x_train"].astype(np.float32)[..., None] / 255.0,
+                d["y_train"].astype(np.int32))
+    # synthetic learnable digits: class k = blob at a class-specific position
+    rng = np.random.RandomState(0)
+    ys = rng.randint(0, 10, size=n).astype(np.int32)
+    xs = rng.rand(n, 28, 28, 1).astype(np.float32) * 0.15
+    for i, y in enumerate(ys):
+        r, c = 3 + (y % 5) * 4, 3 + (y // 5) * 10
+        xs[i, r:r + 6, c:c + 6, 0] += 0.9
+    return xs, ys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=300)
+    p.add_argument("--batch", type=int, default=128)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--data", default="")
+    args = p.parse_args(argv)
+
+    xs, ys = load_data(args.data)
+    model = MnistCNN()
+    params = model.init(jax.random.PRNGKey(0), xs[:2])["params"]
+    tx = optax.adam(args.lr)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, x)
+            loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+            acc = jnp.mean(jnp.argmax(logits, -1) == y)
+            return loss, acc
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss, acc
+
+    t0 = time.perf_counter()
+    first_step_s = None
+    loss = acc = None
+    for i in range(args.steps):
+        idx = np.random.RandomState(i).randint(0, len(xs), args.batch)
+        params, opt_state, loss, acc = step(params, opt_state, xs[idx], ys[idx])
+        if first_step_s is None:
+            jax.block_until_ready(loss)
+            first_step_s = time.perf_counter() - t0
+        if i % 100 == 0:
+            print(f"step {i}: loss={float(loss):.4f} acc={float(acc):.3f}",
+                  flush=True)
+    jax.block_until_ready(loss)
+    summary = {"workload": "mnist", "backend": jax.default_backend(),
+               "steps": args.steps, "final_loss": float(loss),
+               "final_acc": float(acc), "first_step_s": round(first_step_s, 3),
+               "wall_s": round(time.perf_counter() - t0, 2)}
+    print(json.dumps(summary), flush=True)
+    return 0 if float(acc) > 0.9 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

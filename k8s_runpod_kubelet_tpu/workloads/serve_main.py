@@ -1,0 +1,118 @@
+"""Serving workload: HTTP front end over the ServingEngine (config 5).
+
+The pod command for autoscaled inference. Endpoints:
+  POST /generate   {"tokens": [...], "max_new_tokens": N, "temperature": T}
+                   -> {"tokens": [...], "rid": ..., "latency_s": ...}
+  GET  /metrics    Prometheus text incl. tpu_serving_queue_depth — the HPA
+                   signal (scale on queue depth, BASELINE.json config 5)
+  GET  /healthz    liveness
+
+Run: python -m k8s_runpod_kubelet_tpu.workloads.serve_main \
+        --model gemma-7b --slots 8 --port 8000
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import threading
+from concurrent.futures import TimeoutError as FutureTimeout
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+log = logging.getLogger("serve-main")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    engine = None  # bound below
+    request_timeout_s = 120.0
+
+    def log_message(self, *a):
+        pass
+
+    def _send(self, status: int, payload: dict | bytes,
+              ctype: str = "application/json"):
+        body = payload if isinstance(payload, bytes) else json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            return self._send(200, b"ok", "text/plain")
+        if self.path == "/metrics":
+            return self._send(200, self.engine.metrics.render().encode(),
+                              "text/plain; version=0.0.4")
+        self._send(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):
+        if self.path != "/generate":
+            return self._send(404, {"error": f"no route {self.path}"})
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            req = json.loads(self.rfile.read(length)) if length else {}
+            tokens = req["tokens"]
+            if not isinstance(tokens, list) or not all(
+                    isinstance(t, int) for t in tokens):
+                raise ValueError("tokens must be a list of ints")
+        except (json.JSONDecodeError, KeyError, ValueError, TypeError) as e:
+            return self._send(400, {"error": f"bad request: {e}"})
+        fut = self.engine.submit(tokens, req.get("max_new_tokens"),
+                                 req.get("temperature"))
+        try:
+            out = fut.result(timeout=self.request_timeout_s)
+        except FutureTimeout:
+            return self._send(504, {"error": "generation timed out"})
+        except ValueError as e:
+            return self._send(400, {"error": str(e)})
+        self._send(200, out)
+
+
+def serve(engine, port: int = 8000, request_timeout_s: float = 120.0):
+    handler = type("BoundHandler", (_Handler,),
+                   {"engine": engine, "request_timeout_s": request_timeout_s})
+    httpd = ThreadingHTTPServer(("0.0.0.0", port), handler)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    return httpd
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="gemma-7b",
+                   choices=["gemma-7b", "llama3-8b", "tiny"])
+    p.add_argument("--slots", type=int, default=8)
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--cache-len", type=int, default=2048)
+    p.add_argument("--max-new-tokens", type=int, default=256)
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    import jax
+    from ..models import gemma_7b, llama3_8b, tiny_llama, init_params
+    from .serving import ServingConfig, ServingEngine
+
+    cfg = {"gemma-7b": gemma_7b, "llama3-8b": llama3_8b,
+           "tiny": tiny_llama}[args.model]()
+    log.info("loading %s (%.2fB params) on %s", cfg.name,
+             cfg.param_count / 1e9, jax.default_backend())
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    engine = ServingEngine(cfg, params, ServingConfig(
+        slots=args.slots, cache_len=args.cache_len,
+        max_new_tokens=args.max_new_tokens,
+        max_prefill_len=args.cache_len // 2)).start()
+    httpd = serve(engine, args.port)
+    log.info("serving on :%d (POST /generate, GET /metrics)", args.port)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    httpd.shutdown()
+    engine.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

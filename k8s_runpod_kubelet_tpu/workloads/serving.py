@@ -1,0 +1,234 @@
+"""JetStream-style serving engine: continuous batching over fixed decode slots.
+
+The in-repo implementation of the autoscaled-serving workload (BASELINE.json
+config 5). TPU-first decisions:
+
+- **Fixed-shape decode**: the decode step is one jitted program over a constant
+  (slots, cache_len) batch — no recompilation as requests come and go; slots
+  activate/freeze via a boolean mask.
+- **Prefill/decode split**: prompts prefill as single-request batches (their
+  own jit), then the cache is inserted into a free slot — decode latency never
+  stalls behind a long prompt's attention.
+- **HPA signal**: queue depth + slot utilization are exported via Metrics; the
+  Helm chart scales serving pods on tpu_serving_queue_depth (SURVEY.md §5.5
+  gap — the reference has no metrics at all).
+
+Threading: callers submit() from anywhere; one engine thread owns the model
+state (JAX objects never cross threads mid-step).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue
+import threading
+import time
+import uuid
+from concurrent.futures import Future
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..metrics import Metrics
+from ..models.llama import LlamaConfig, LlamaModel, Params
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class ServingConfig:
+    slots: int = 4               # concurrent decode streams
+    max_prefill_len: int = 512
+    cache_len: int = 1024        # per-slot KV budget (prompt + generation)
+    max_new_tokens: int = 128
+    eos_token: int = -1          # -1 = never stop on a token
+    temperature: float = 0.0     # 0 = greedy
+
+
+@dataclasses.dataclass
+class Request:
+    prompt: list[int]
+    max_new_tokens: int
+    rid: str
+    future: Future
+    submitted_at: float
+    temperature: float
+
+
+@dataclasses.dataclass
+class _Slot:
+    request: Optional[Request] = None
+    generated: list[int] = dataclasses.field(default_factory=list)
+    remaining: int = 0
+    last_token: int = 0
+
+
+class ServingEngine:
+    def __init__(self, cfg: LlamaConfig, params: Params, sc: ServingConfig,
+                 metrics: Optional[Metrics] = None, seed: int = 0):
+        self.cfg = cfg
+        self.sc = sc
+        self.model = LlamaModel(cfg)
+        self.params = params
+        self.metrics = metrics or Metrics()
+        self.metrics.describe("tpu_serving_queue_depth",
+                              "requests waiting for a decode slot (HPA signal)")
+        # the HPA scrapes from pod start — the signal must exist before traffic
+        self.metrics.set_gauge("tpu_serving_queue_depth", 0)
+        self.metrics.set_gauge("tpu_serving_active_slots", 0)
+        self._queue: "queue.Queue[Request]" = queue.Queue()
+        self._slots = [_Slot() for _ in range(sc.slots)]
+        self._cache = self.model.init_cache(sc.slots, sc.cache_len)
+        self._tokens = jnp.zeros((sc.slots,), jnp.int32)
+        self._key = jax.random.PRNGKey(seed)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, name="serving-engine",
+                                        daemon=True)
+        self._decode = jax.jit(self.model.decode_step)
+        self._prefill = jax.jit(self.model.prefill)
+        self.total_generated = 0
+
+    # -- public API ------------------------------------------------------------
+
+    def start(self) -> "ServingEngine":
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=10)
+
+    def submit(self, prompt: list[int], max_new_tokens: Optional[int] = None,
+               temperature: Optional[float] = None) -> Future:
+        """Enqueue a generation request; resolves to {tokens, latency_s, rid}."""
+        if not prompt:
+            f: Future = Future()
+            f.set_exception(ValueError("empty prompt"))
+            return f
+        if len(prompt) > self.sc.max_prefill_len:
+            f = Future()
+            f.set_exception(ValueError(
+                f"prompt length {len(prompt)} > max_prefill_len "
+                f"{self.sc.max_prefill_len}"))
+            return f
+        req = Request(prompt=list(prompt),
+                      max_new_tokens=min(max_new_tokens or self.sc.max_new_tokens,
+                                         self.sc.cache_len - len(prompt)),
+                      rid=uuid.uuid4().hex[:8], future=Future(),
+                      submitted_at=time.perf_counter(),
+                      temperature=self.sc.temperature if temperature is None
+                      else temperature)
+        self._queue.put(req)
+        self.metrics.set_gauge("tpu_serving_queue_depth", self._queue.qsize())
+        return req.future
+
+    @property
+    def queue_depth(self) -> int:
+        return self._queue.qsize()
+
+    @property
+    def active_slots(self) -> int:
+        return sum(1 for s in self._slots if s.request is not None)
+
+    # -- engine loop -----------------------------------------------------------
+
+    def _loop(self):
+        while not self._stop.is_set():
+            admitted = self._admit()
+            if self.active_slots == 0:
+                if not admitted:
+                    time.sleep(0.002)
+                continue
+            self._decode_once()
+
+    def _bucket_len(self, n: int) -> int:
+        b = 16
+        while b < n:
+            b *= 2
+        return min(b, self.sc.max_prefill_len)
+
+    def _admit(self) -> bool:
+        """Move queued requests into free slots (prefill them)."""
+        admitted = False
+        for slot_id, slot in enumerate(self._slots):
+            if slot.request is not None:
+                continue
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            self.metrics.set_gauge("tpu_serving_queue_depth", self._queue.qsize())
+            single = self.model.init_cache(1, self.sc.cache_len)
+            # bucket the prompt to a few fixed lengths so the prefill jit
+            # compiles once per bucket, not once per prompt length
+            bucket = self._bucket_len(len(req.prompt))
+            padded = req.prompt + [0] * (bucket - len(req.prompt))
+            prompt = jnp.asarray([padded], jnp.int32)
+            true_len = jnp.asarray([len(req.prompt)], jnp.int32)
+            last_logits, single = self._prefill(self.params, prompt, single,
+                                                true_len)
+            first = self._sample(last_logits, req.temperature)[0]
+            self._cache = self.model.insert_into_slot(self._cache, single, slot_id)
+            self._tokens = self._tokens.at[slot_id].set(first)
+            slot.request = req
+            slot.generated = [int(first)]
+            slot.remaining = req.max_new_tokens - 1
+            slot.last_token = int(first)
+            admitted = True
+            self.metrics.incr("tpu_serving_admitted")
+            if self._finished(slot):
+                self._complete(slot_id, slot)
+        self.metrics.set_gauge("tpu_serving_active_slots", self.active_slots)
+        return admitted
+
+    def _decode_once(self):
+        active_mask = jnp.asarray([s.request is not None for s in self._slots])
+        logits, self._cache = self._decode(self.params, self._tokens,
+                                           self._cache, active_mask)
+        temps = [s.request.temperature if s.request else 0.0 for s in self._slots]
+        # sample per slot (temperatures can differ)
+        next_np = np.asarray(self._sample_batch(logits, temps))
+        for slot_id, slot in enumerate(self._slots):
+            if slot.request is None:
+                continue
+            tok = int(next_np[slot_id])
+            slot.generated.append(tok)
+            slot.last_token = tok
+            slot.remaining -= 1
+            self.total_generated += 1
+            if self._finished(slot):
+                self._complete(slot_id, slot)
+        self._tokens = jnp.asarray(next_np, jnp.int32)
+        self.metrics.incr("tpu_serving_decode_steps")
+
+    def _sample(self, logits: jax.Array, temperature: float) -> jax.Array:
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1)
+        self._key, sub = jax.random.split(self._key)
+        return jax.random.categorical(sub, logits / temperature, axis=-1)
+
+    def _sample_batch(self, logits: jax.Array, temps: list[float]) -> jax.Array:
+        greedy = jnp.argmax(logits, axis=-1)
+        if all(t <= 0.0 for t in temps):
+            return greedy
+        self._key, sub = jax.random.split(self._key)
+        t = jnp.asarray([max(tt, 1e-6) for tt in temps])[:, None]
+        sampled = jax.random.categorical(sub, logits / t, axis=-1)
+        use_sampled = jnp.asarray([tt > 0.0 for tt in temps])
+        return jnp.where(use_sampled, sampled, greedy)
+
+    def _finished(self, slot: _Slot) -> bool:
+        return (slot.remaining <= 0
+                or slot.last_token == self.sc.eos_token)
+
+    def _complete(self, slot_id: int, slot: _Slot):
+        req = slot.request
+        slot.request = None
+        latency = time.perf_counter() - req.submitted_at
+        self.metrics.observe("tpu_serving_request_latency_seconds", latency)
+        req.future.set_result({"rid": req.rid, "tokens": slot.generated,
+                               "latency_s": latency})
+        self.metrics.set_gauge("tpu_serving_active_slots", self.active_slots)

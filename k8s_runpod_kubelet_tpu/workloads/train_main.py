@@ -1,0 +1,85 @@
+"""Gang-scheduled pretrain workload (BASELINE.json configs 3-4, MaxText analog).
+
+The pod command for multi-host slices. On every worker:
+  1. jax.distributed forms from the kubelet-injected env (gang/env.py),
+  2. a mesh is built over the full slice (all hosts' chips),
+  3. the sharded train loop runs; worker 0 logs throughput + a JSON summary.
+
+Run: python -m k8s_runpod_kubelet_tpu.workloads.train_main \
+        --model llama3-8b --steps 100 --tensor 4 [--fsdp -1] [--seq 1]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import time
+
+from ..parallel.distributed import initialize_from_env
+
+log = logging.getLogger("train-main")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="llama3-8b",
+                   choices=["llama3-8b", "llama3-70b", "gemma-7b", "tiny"])
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=2048)
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--tensor", type=int, default=1)
+    p.add_argument("--seq", type=int, default=1, help="sequence-parallel degree")
+    p.add_argument("--fsdp", type=int, default=0, help="0 = all non-tp/sp devices")
+    p.add_argument("--checkpoint-dir", default="")
+    p.add_argument("--checkpoint-every", type=int, default=500)
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    # 1. the gang forms (no-op single process)
+    pe = initialize_from_env()
+
+    import jax
+    from ..models import llama3_8b, llama3_70b, gemma_7b, tiny_llama
+    from ..parallel import MeshConfig, make_mesh
+    from ..workloads.train import TrainConfig, Trainer
+
+    n = jax.device_count()
+    cfg = {"llama3-8b": llama3_8b, "llama3-70b": llama3_70b,
+           "gemma-7b": gemma_7b, "tiny": tiny_llama}[args.model]()
+    fsdp = args.fsdp or max(1, n // (args.tensor * args.seq))
+    mesh = make_mesh(MeshConfig(data=-1, fsdp=fsdp, seq=args.seq,
+                                tensor=args.tensor))
+    if pe.process_id == 0:
+        log.info("model=%s params=%.2fB devices=%d mesh=%s slice=%s",
+                 cfg.name, cfg.param_count / 1e9, n, dict(mesh.shape),
+                 pe.accelerator_type or "local")
+
+    # global batch must divide evenly over the data axes
+    dp_total = mesh.shape["data"] * mesh.shape["fsdp"]
+    batch = ((args.batch + dp_total - 1) // dp_total) * dp_total
+    if batch != args.batch:
+        log.info("batch %d -> %d (must divide data*fsdp=%d)",
+                 args.batch, batch, dp_total)
+    tc = TrainConfig(learning_rate=args.lr, batch_size=batch,
+                     seq_len=args.seq_len, steps=args.steps,
+                     checkpoint_dir=args.checkpoint_dir,
+                     checkpoint_every=args.checkpoint_every)
+    trainer = Trainer(cfg, tc, mesh=mesh)
+    if args.checkpoint_dir:
+        trainer.restore()  # resume-from-preemption path
+    out = trainer.run(steps=args.steps)
+    if args.checkpoint_dir:
+        trainer.save()
+
+    if pe.process_id == 0:
+        out.update({"workload": "pretrain", "model": cfg.name,
+                    "devices": n, "mesh": {k: v for k, v in mesh.shape.items()},
+                    "tokens_per_s_per_chip": round(out["tokens_per_s"] / n, 1)})
+        print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

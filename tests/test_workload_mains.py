@@ -1,0 +1,74 @@
+"""Workload entrypoint tests: mnist smoke, train_main tiny, serving HTTP."""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from k8s_runpod_kubelet_tpu.models import init_params, tiny_llama
+from k8s_runpod_kubelet_tpu.workloads.serving import ServingConfig, ServingEngine
+from k8s_runpod_kubelet_tpu.workloads.serve_main import serve
+
+
+def test_mnist_main_learns(capsys):
+    from k8s_runpod_kubelet_tpu.workloads.mnist_train import main
+    rc = main(["--steps", "120", "--batch", "64"])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    summary = json.loads(out)
+    assert rc == 0
+    assert summary["final_acc"] > 0.9
+    assert summary["first_step_s"] > 0
+
+
+def test_train_main_tiny(capsys):
+    from k8s_runpod_kubelet_tpu.workloads.train_main import main
+    rc = main(["--model", "tiny", "--steps", "2", "--batch", "2",
+               "--seq-len", "32", "--tensor", "2", "--seq", "1"])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["workload"] == "pretrain"
+    assert summary["mesh"]["tensor"] == 2
+    assert summary["tokens_per_s_per_chip"] > 0
+
+
+class TestServeHttp:
+    @pytest.fixture()
+    def server(self):
+        cfg = tiny_llama(vocab_size=128, embed_dim=64, n_layers=2, n_heads=4,
+                         n_kv_heads=2, mlp_dim=128, max_seq_len=256,
+                         dtype=jnp.float32, param_dtype=jnp.float32)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        engine = ServingEngine(cfg, params, ServingConfig(
+            slots=2, cache_len=64, max_new_tokens=8, max_prefill_len=32)).start()
+        httpd = serve(engine, port=0)
+        yield f"http://127.0.0.1:{httpd.server_address[1]}", engine
+        httpd.shutdown()
+        httpd.server_close()
+        engine.stop()
+
+    def test_generate_roundtrip(self, server):
+        base, _ = server
+        req = urllib.request.Request(
+            f"{base}/generate",
+            data=json.dumps({"tokens": [5, 9], "max_new_tokens": 4}).encode(),
+            headers={"Content-Type": "application/json"})
+        out = json.load(urllib.request.urlopen(req, timeout=60))
+        assert len(out["tokens"]) == 4
+        assert out["latency_s"] > 0
+
+    def test_metrics_expose_queue_depth(self, server):
+        base, _ = server
+        body = urllib.request.urlopen(f"{base}/metrics").read().decode()
+        assert "tpu_serving_queue_depth" in body
+
+    def test_bad_requests_400(self, server):
+        base, _ = server
+        for payload in [b"not json", b'{"tokens": "nope"}', b'{"tokens": [1.5]}',
+                        b"{}"]:
+            req = urllib.request.Request(f"{base}/generate", data=payload)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 400, payload
