@@ -11,6 +11,8 @@ RUN python -m compileall -q k8s_runpod_kubelet_tpu
 FROM python:3.12-slim
 LABEL org.opencontainers.image.source=https://github.com/tpu-virtual-kubelet/tpu-virtual-kubelet
 WORKDIR /app
+# pyyaml is the one required dep (pyproject.toml): --provider-config / kubeconfig parsing
+RUN pip install --no-cache-dir "pyyaml>=6" && pip cache purge || true
 COPY --from=builder /build/k8s_runpod_kubelet_tpu/ k8s_runpod_kubelet_tpu/
 # nonroot (parity: distroless nonroot uid 65532, Dockerfile:20)
 RUN groupadd -g 65532 nonroot && useradd -u 65532 -g 65532 -m nonroot

@@ -13,6 +13,7 @@ from __future__ import annotations
 import collections
 import json
 import logging
+import queue
 import threading
 import urllib.request
 from typing import Optional
@@ -23,17 +24,22 @@ _LEVELS = {"debug": logging.DEBUG, "info": logging.INFO,
 
 class ErrorSinkHandler(logging.Handler):
     """Posts WARNING+ records as JSON events to an HTTP sink (Sentry-shaped),
-    never blocking the caller: posts happen on a daemon thread, failures are
-    counted and dropped."""
+    never blocking the caller: one long-lived worker drains a bounded queue;
+    when the queue is full (error storm) events are counted as dropped rather
+    than spawning threads or blocking the logging call site."""
 
     def __init__(self, url: str, environment: str = "production",
-                 timeout_s: float = 3.0):
+                 timeout_s: float = 3.0, queue_size: int = 256):
         super().__init__(level=logging.WARNING)
         self.url = url
         self.environment = environment
         self.timeout_s = timeout_s
         self.dropped = 0
         self.recent: collections.deque = collections.deque(maxlen=100)
+        self._queue: "queue.Queue[Optional[dict]]" = queue.Queue(maxsize=queue_size)
+        self._worker = threading.Thread(target=self._drain, name="error-sink",
+                                        daemon=True)
+        self._worker.start()
 
     def emit(self, record: logging.LogRecord):
         event = {
@@ -44,17 +50,30 @@ class ErrorSinkHandler(logging.Handler):
             "timestamp": record.created,
         }
         self.recent.append(event)
-        t = threading.Thread(target=self._post, args=(event,), daemon=True)
-        t.start()
-
-    def _post(self, event: dict):
         try:
-            req = urllib.request.Request(
-                self.url, data=json.dumps(event).encode(),
-                headers={"Content-Type": "application/json"})
-            urllib.request.urlopen(req, timeout=self.timeout_s).read()
-        except Exception:  # noqa: BLE001 — the error sink must never raise
+            self._queue.put_nowait(event)
+        except queue.Full:
             self.dropped += 1
+
+    def close(self):
+        try:
+            self._queue.put_nowait(None)  # wake the worker so it can exit
+        except queue.Full:
+            pass
+        super().close()
+
+    def _drain(self):
+        while True:
+            event = self._queue.get()
+            if event is None:
+                return
+            try:
+                req = urllib.request.Request(
+                    self.url, data=json.dumps(event).encode(),
+                    headers={"Content-Type": "application/json"})
+                urllib.request.urlopen(req, timeout=self.timeout_s).read()
+            except Exception:  # noqa: BLE001 — the error sink must never raise
+                self.dropped += 1
 
 
 def setup_logging(level: str = "info", sentry_url: str = "",

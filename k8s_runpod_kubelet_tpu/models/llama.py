@@ -12,7 +12,8 @@ Design choices (vs a torch transliteration):
 - **KV-cache decode** path for the serving engine (JetStream-style, config 5).
 
 The same class covers Llama-3-8B/70B and Gemma-7B (explicit head_dim,
-tied/untied embeddings) — see the config constructors.
+tied/untied embeddings, GeGLU vs SwiGLU, sqrt(E) embedding scaling,
+zero-centered RMSNorm, optional logit softcap) — see the config constructors.
 """
 
 from __future__ import annotations
@@ -47,6 +48,10 @@ class LlamaConfig:
     rope_scaling: Optional[dict] = None
     norm_eps: float = 1e-5
     tie_embeddings: bool = False
+    mlp_activation: str = "silu"        # "silu" (SwiGLU) | "gelu_tanh" (GeGLU, Gemma)
+    embed_scale: bool = False           # scale embeddings by sqrt(embed_dim) (Gemma)
+    logit_softcap: Optional[float] = None  # tanh soft cap on lm-head logits (Gemma-2)
+    norm_zero_centered: bool = False    # RMSNorm weight stored as w, applied as (1+w) (Gemma)
     dtype: Any = jnp.bfloat16           # activation/compute dtype
     param_dtype: Any = jnp.float32
     remat: bool = True
@@ -79,12 +84,14 @@ def llama3_70b() -> LlamaConfig:
 
 
 def gemma_7b() -> LlamaConfig:
-    # Gemma-7B mapped onto the decoder: wide head_dim, tied embeddings,
-    # GELU-family MLP approximated by the same SwiGLU block size.
-    return LlamaConfig(name="gemma-7b", vocab_size=256128, embed_dim=3072,
+    # Gemma-7B, faithfully: MHA with wide head_dim, GeGLU MLP, embeddings
+    # scaled by sqrt(embed_dim), zero-centered RMSNorm, tied lm head.
+    return LlamaConfig(name="gemma-7b", vocab_size=256000, embed_dim=3072,
                        n_layers=28, n_heads=16, n_kv_heads=16, head_dim=256,
                        mlp_dim=24576, max_seq_len=8192, rope_theta=10_000.0,
-                       tie_embeddings=True)
+                       norm_eps=1e-6, tie_embeddings=True,
+                       mlp_activation="gelu_tanh", embed_scale=True,
+                       norm_zero_centered=True)
 
 
 def tiny_llama(**kw) -> LlamaConfig:
@@ -142,9 +149,10 @@ def init_params(cfg: LlamaConfig, key: jax.Array,
 
     def make(shape, k):
         if len(shape) <= 2 and shape[-1] == e and len(shape) < 3:
-            # norm weights start at 1
+            # norm weights: identity scale — 1, or 0 when applied as (1+w)
             if shape == (e,) or shape == (cfg.n_layers, e):
-                return jnp.ones(shape, cfg.param_dtype)
+                fill = 0.0 if cfg.norm_zero_centered else 1.0
+                return jnp.full(shape, fill, cfg.param_dtype)
         scale = 0.02
         return (jax.random.normal(k, shape, jnp.float32) * scale).astype(cfg.param_dtype)
 
@@ -164,10 +172,40 @@ def _constrain(x, mesh: Optional[Mesh], axes):
     return shard_logical(x, mesh, axes) if mesh is not None else x
 
 
+def _norm_w(w, cfg: LlamaConfig):
+    """Gemma stores RMSNorm weights zero-centered and applies (1 + w)."""
+    return w + 1 if cfg.norm_zero_centered else w
+
+
+def _activation(cfg: LlamaConfig):
+    if cfg.mlp_activation == "silu":
+        return jax.nn.silu
+    if cfg.mlp_activation == "gelu_tanh":
+        return functools.partial(jax.nn.gelu, approximate=True)
+    raise ValueError(f"unknown mlp_activation {cfg.mlp_activation!r}")
+
+
+def _embed(params: Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    x = params["tok_embed"].astype(cfg.dtype)[tokens]
+    if cfg.embed_scale:
+        x = x * jnp.asarray(cfg.embed_dim ** 0.5, cfg.dtype)
+    return x
+
+
+def _head_logits(x: jax.Array, params: Params, cfg: LlamaConfig) -> jax.Array:
+    head = (params["tok_embed"].T if cfg.tie_embeddings
+            else params["lm_head"]).astype(cfg.dtype)
+    logits = x @ head
+    if cfg.logit_softcap:
+        cap = jnp.asarray(cfg.logit_softcap, logits.dtype)
+        logits = jnp.tanh(logits / cap) * cap
+    return logits
+
+
 def _attention_block(x, lp, cfg: LlamaConfig, cos, sin, mesh, positions=None):
     b, s, e = x.shape
     hd = cfg.head_dim_
-    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    h = rms_norm(x, _norm_w(lp["attn_norm"], cfg), cfg.norm_eps)
     q = (h @ lp["wq"].astype(cfg.dtype)).reshape(b, s, cfg.n_heads, hd)
     k = (h @ lp["wk"].astype(cfg.dtype)).reshape(b, s, cfg.n_kv_heads, hd)
     v = (h @ lp["wv"].astype(cfg.dtype)).reshape(b, s, cfg.n_kv_heads, hd)
@@ -185,10 +223,10 @@ def _attention_block(x, lp, cfg: LlamaConfig, cos, sin, mesh, positions=None):
 
 
 def _mlp_block(x, lp, cfg: LlamaConfig, mesh):
-    h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    h = rms_norm(x, _norm_w(lp["mlp_norm"], cfg), cfg.norm_eps)
     gate = h @ lp["w_gate"].astype(cfg.dtype)
     up = h @ lp["w_up"].astype(cfg.dtype)
-    act = _constrain(jax.nn.silu(gate) * up, mesh, ("batch", "seq", "act_mlp"))
+    act = _constrain(_activation(cfg)(gate) * up, mesh, ("batch", "seq", "act_mlp"))
     return x + (act @ lp["w_down"].astype(cfg.dtype))
 
 
@@ -205,7 +243,7 @@ class LlamaModel:
         cfg, mesh = self.cfg, self.mesh
         cos, sin = rope_frequencies(cfg.head_dim_, cfg.max_seq_len,
                                     cfg.rope_theta, cfg.rope_scaling)
-        x = params["tok_embed"].astype(cfg.dtype)[tokens]
+        x = _embed(params, tokens, cfg)
         x = _constrain(x, mesh, ("batch", "seq", "act_embed"))
 
         def block(carry, lp):
@@ -216,10 +254,8 @@ class LlamaModel:
 
         body = jax.checkpoint(block) if cfg.remat else block
         x, _ = jax.lax.scan(body, x, params["layers"])
-        x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-        head = (params["tok_embed"].T if cfg.tie_embeddings
-                else params["lm_head"]).astype(cfg.dtype)
-        logits = x @ head
+        x = rms_norm(x, _norm_w(params["final_norm"], cfg), cfg.norm_eps)
+        logits = _head_logits(x, params, cfg)
         return _constrain(logits, mesh, ("batch", "seq", "act_vocab"))
 
     def __call__(self, params, tokens, positions=None):
@@ -252,12 +288,12 @@ class LlamaModel:
             true_length = jnp.full((b,), s, jnp.int32)
         cos, sin = rope_frequencies(cfg.head_dim_, cfg.max_seq_len,
                                     cfg.rope_theta, cfg.rope_scaling)
-        x = params["tok_embed"].astype(cfg.dtype)[tokens]
+        x = _embed(params, tokens, cfg)
 
         # one scan over layers that also collects the K/V it computes
         def block(carry, lp):
             y = carry
-            h = rms_norm(y, lp["attn_norm"], cfg.norm_eps)
+            h = rms_norm(y, _norm_w(lp["attn_norm"], cfg), cfg.norm_eps)
             q = (h @ lp["wq"].astype(cfg.dtype)).reshape(b, s, cfg.n_heads, cfg.head_dim_)
             k = (h @ lp["wk"].astype(cfg.dtype)).reshape(b, s, cfg.n_kv_heads, cfg.head_dim_)
             v = (h @ lp["wv"].astype(cfg.dtype)).reshape(b, s, cfg.n_kv_heads, cfg.head_dim_)
@@ -271,11 +307,9 @@ class LlamaModel:
             return y, (k, v)
 
         x, (k_all, v_all) = jax.lax.scan(block, x, params["layers"])
-        x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-        head = (params["tok_embed"].T if cfg.tie_embeddings
-                else params["lm_head"]).astype(cfg.dtype)
+        x = rms_norm(x, _norm_w(params["final_norm"], cfg), cfg.norm_eps)
         last = x[jnp.arange(b), true_length - 1]  # (B, E): each row's last real token
-        logits = last @ head
+        logits = _head_logits(last, params, cfg)
         max_len = cache["k"].shape[2]
         pad = [(0, 0), (0, 0), (0, max_len - s), (0, 0), (0, 0)]
         cache = {"k": jnp.pad(k_all, pad), "v": jnp.pad(v_all, pad),
@@ -297,7 +331,7 @@ class LlamaModel:
             active = jnp.ones((b,), bool)
         cos, sin = rope_frequencies(cfg.head_dim_, cfg.max_seq_len,
                                     cfg.rope_theta, cfg.rope_scaling)
-        x = params["tok_embed"].astype(cfg.dtype)[token[:, None]]  # (B,1,E)
+        x = _embed(params, token[:, None], cfg)  # (B,1,E)
         positions = idx[:, None]  # (B,1)
         max_len = cache["k"].shape[2]
         # (B,1,1,L): slot i may attend up to its own index
@@ -307,7 +341,7 @@ class LlamaModel:
         def block(carry, inputs):
             y = carry
             lp, k_cache, v_cache = inputs
-            h = rms_norm(y, lp["attn_norm"], cfg.norm_eps)
+            h = rms_norm(y, _norm_w(lp["attn_norm"], cfg), cfg.norm_eps)
             q = (h @ lp["wq"].astype(cfg.dtype)).reshape(b, 1, cfg.n_heads, cfg.head_dim_)
             k = (h @ lp["wk"].astype(cfg.dtype)).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim_)
             v = (h @ lp["wv"].astype(cfg.dtype)).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim_)
@@ -336,10 +370,8 @@ class LlamaModel:
 
         x, (k_new, v_new) = jax.lax.scan(
             block, x, (params["layers"], cache["k"], cache["v"]))
-        x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-        head = (params["tok_embed"].T if cfg.tie_embeddings
-                else params["lm_head"]).astype(cfg.dtype)
-        logits = (x[:, 0] @ head).astype(jnp.float32)
+        x = rms_norm(x, _norm_w(params["final_norm"], cfg), cfg.norm_eps)
+        logits = _head_logits(x[:, 0], params, cfg).astype(jnp.float32)
         new_idx = jnp.where(active, idx + 1, idx)
         return logits, {"k": k_new, "v": v_new, "index": new_idx}
 

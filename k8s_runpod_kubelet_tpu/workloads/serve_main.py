@@ -41,6 +41,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         if self.path == "/healthz":
+            if not self.engine.alive:
+                return self._send(503, b"engine thread dead", "text/plain")
             return self._send(200, b"ok", "text/plain")
         if self.path == "/metrics":
             return self._send(200, self.engine.metrics.render().encode(),

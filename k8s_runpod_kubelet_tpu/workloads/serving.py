@@ -89,7 +89,11 @@ class ServingEngine:
                                         daemon=True)
         self._decode = jax.jit(self.model.decode_step)
         self._prefill = jax.jit(self.model.prefill)
+        # donate the old cache so XLA updates the slot in place instead of
+        # copying the whole multi-layer K/V on every admission
+        self._insert = jax.jit(LlamaModel.insert_into_slot, donate_argnums=(0,))
         self.total_generated = 0
+        self.last_error: Optional[str] = None
 
     # -- public API ------------------------------------------------------------
 
@@ -114,16 +118,36 @@ class ServingEngine:
                 f"prompt length {len(prompt)} > max_prefill_len "
                 f"{self.sc.max_prefill_len}"))
             return f
+        if max_new_tokens is None:
+            max_new_tokens = self.sc.max_new_tokens
+        if not isinstance(max_new_tokens, int) or isinstance(max_new_tokens, bool) \
+                or max_new_tokens < 1:
+            f = Future()
+            f.set_exception(ValueError(
+                f"max_new_tokens must be a positive int, got {max_new_tokens!r}"))
+            return f
+        if temperature is None:
+            temperature = self.sc.temperature
+        if not isinstance(temperature, (int, float)) \
+                or isinstance(temperature, bool) or temperature < 0.0:
+            f = Future()
+            f.set_exception(ValueError(
+                f"temperature must be a non-negative number, got {temperature!r}"))
+            return f
         req = Request(prompt=list(prompt),
-                      max_new_tokens=min(max_new_tokens or self.sc.max_new_tokens,
+                      max_new_tokens=min(max_new_tokens,
                                          self.sc.cache_len - len(prompt)),
                       rid=uuid.uuid4().hex[:8], future=Future(),
                       submitted_at=time.perf_counter(),
-                      temperature=self.sc.temperature if temperature is None
-                      else temperature)
+                      temperature=float(temperature))
         self._queue.put(req)
         self.metrics.set_gauge("tpu_serving_queue_depth", self._queue.qsize())
         return req.future
+
+    @property
+    def alive(self) -> bool:
+        """Engine-thread liveness (k8s liveness probes should gate on this)."""
+        return self._thread.is_alive()
 
     @property
     def queue_depth(self) -> int:
@@ -137,12 +161,33 @@ class ServingEngine:
 
     def _loop(self):
         while not self._stop.is_set():
-            admitted = self._admit()
-            if self.active_slots == 0:
-                if not admitted:
-                    time.sleep(0.002)
-                continue
-            self._decode_once()
+            try:
+                admitted = self._admit()
+                if self.active_slots == 0:
+                    if not admitted:
+                        time.sleep(0.002)
+                    continue
+                self._decode_once()
+            except Exception as exc:  # noqa: BLE001 — engine must survive bad steps
+                # Fail everything in flight so no caller hangs, then keep
+                # serving: one poisoned request must not be a permanent outage.
+                log.exception("serving engine step failed; failing in-flight "
+                              "requests and continuing")
+                self.last_error = f"{type(exc).__name__}: {exc}"
+                self.metrics.incr("tpu_serving_engine_errors")
+                for slot in self._slots:
+                    req, slot.request = slot.request, None
+                    if req is not None and not req.future.done():
+                        req.future.set_exception(exc)
+                while True:
+                    try:
+                        req = self._queue.get_nowait()
+                    except queue.Empty:
+                        break
+                    if not req.future.done():
+                        req.future.set_exception(exc)
+                self.metrics.set_gauge("tpu_serving_queue_depth", 0)
+                self.metrics.set_gauge("tpu_serving_active_slots", 0)
 
     def _bucket_len(self, n: int) -> int:
         b = 16
@@ -171,7 +216,8 @@ class ServingEngine:
             last_logits, single = self._prefill(self.params, prompt, single,
                                                 true_len)
             first = self._sample(last_logits, req.temperature)[0]
-            self._cache = self.model.insert_into_slot(self._cache, single, slot_id)
+            self._cache = self._insert(self._cache, single,
+                                       jnp.asarray(slot_id, jnp.int32))
             self._tokens = self._tokens.at[slot_id].set(first)
             slot.request = req
             slot.generated = [int(first)]

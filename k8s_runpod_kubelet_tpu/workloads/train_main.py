@@ -31,7 +31,8 @@ def main(argv=None) -> int:
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument("--tensor", type=int, default=1)
     p.add_argument("--seq", type=int, default=1, help="sequence-parallel degree")
-    p.add_argument("--fsdp", type=int, default=0, help="0 = all non-tp/sp devices")
+    p.add_argument("--fsdp", type=int, default=0,
+                   help="0 or -1 = auto: all non-tp/sp devices")
     p.add_argument("--checkpoint-dir", default="")
     p.add_argument("--checkpoint-every", type=int, default=500)
     args = p.parse_args(argv)
@@ -48,7 +49,7 @@ def main(argv=None) -> int:
     n = jax.device_count()
     cfg = {"llama3-8b": llama3_8b, "llama3-70b": llama3_70b,
            "gemma-7b": gemma_7b, "tiny": tiny_llama}[args.model]()
-    fsdp = args.fsdp or max(1, n // (args.tensor * args.seq))
+    fsdp = args.fsdp if args.fsdp > 0 else max(1, n // (args.tensor * args.seq))
     mesh = make_mesh(MeshConfig(data=-1, fsdp=fsdp, seq=args.seq,
                                 tensor=args.tensor))
     if pe.process_id == 0:

@@ -88,6 +88,65 @@ class TestModel:
             assert p.ndim == len(a), (p.shape, a)
 
 
+GEMMA_CFG = tiny_llama(name="tiny-gemma", vocab_size=128, embed_dim=64,
+                       n_layers=2, n_heads=4, n_kv_heads=4, head_dim=32,
+                       mlp_dim=128, max_seq_len=128, rope_theta=10_000.0,
+                       tie_embeddings=True, mlp_activation="gelu_tanh",
+                       embed_scale=True, norm_zero_centered=True,
+                       logit_softcap=30.0, dtype=jnp.float32,
+                       param_dtype=jnp.float32)
+
+
+class TestGemmaFamily:
+    """Gemma architectural features: GeGLU, sqrt(E) embedding scale,
+    zero-centered RMSNorm, tied head, logit softcap."""
+
+    def test_real_config_is_faithful(self):
+        cfg = gemma_7b()
+        assert cfg.mlp_activation == "gelu_tanh"
+        assert cfg.embed_scale and cfg.norm_zero_centered and cfg.tie_embeddings
+        assert cfg.head_dim_ == 256 and cfg.n_kv_heads == 16
+
+    def test_norm_weights_init_zero_centered(self):
+        params = init_params(GEMMA_CFG, jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(np.asarray(params["final_norm"]), 0.0)
+        assert "lm_head" not in params  # tied
+
+    def test_forward_finite_and_softcapped(self):
+        model = LlamaModel(GEMMA_CFG)
+        params = init_params(GEMMA_CFG, jax.random.PRNGKey(0))
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        logits = model.forward(params, tokens)
+        assert bool(jnp.all(jnp.isfinite(logits)))
+        assert float(jnp.max(jnp.abs(logits))) <= 30.0
+
+    def test_embed_scale_changes_output(self):
+        import dataclasses as dc
+        params = init_params(GEMMA_CFG, jax.random.PRNGKey(0))
+        tokens = jnp.arange(8, dtype=jnp.int32)[None]
+        scaled = LlamaModel(GEMMA_CFG).forward(params, tokens)
+        unscaled = LlamaModel(dc.replace(GEMMA_CFG, embed_scale=False)).forward(
+            params, tokens)
+        assert not np.allclose(np.asarray(scaled), np.asarray(unscaled))
+
+    def test_decode_matches_forward(self):
+        """The serving path (prefill/decode) must honor every Gemma feature."""
+        model = LlamaModel(GEMMA_CFG)
+        params = init_params(GEMMA_CFG, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, 128)
+        full_logits = model.forward(params, tokens)
+        cache = model.init_cache(batch=2, max_len=32)
+        last, cache = model.prefill(params, tokens[:, :8], cache)
+        np.testing.assert_allclose(np.asarray(last),
+                                   np.asarray(full_logits[:, 7]),
+                                   rtol=2e-3, atol=2e-3)
+        for i in range(8, 12):
+            logits, cache = model.decode_step(params, tokens[:, i], cache)
+            np.testing.assert_allclose(np.asarray(logits),
+                                       np.asarray(full_logits[:, i]),
+                                       rtol=2e-3, atol=2e-3)
+
+
 class TestTraining:
     def test_loss_decreases_on_memorization(self):
         tc = TrainConfig(learning_rate=1e-2, warmup_steps=2, batch_size=2,
