@@ -4,13 +4,18 @@
   70B multislice = config 4) with GQA, RoPE, flash/ring attention, KV-cache
   decode, and logical-axis sharding throughout.
 - ``gemma``: Gemma-7B config mapped onto the same decoder (serving = config 5).
+- ``mixtral``: Mixtral-8x7B sparse-MoE config on the same decoder, routed
+  through the expert-parallel MoE MLP (``moe``).
 - ``mnist``: the small Flax CNN for the single-chip smoke workload (config 2).
 """
 
 from .llama import (LlamaConfig, LlamaModel, llama3_8b, llama3_70b, gemma_7b,
-                    tiny_llama, init_params, param_logical_axes)
+                    mixtral_8x7b, tiny_llama, tiny_moe, init_params,
+                    param_logical_axes)
 from .mnist import MnistCNN, mnist_config
+from .moe import moe_mlp, moe_mlp_dense_reference, moe_capacity
 
 __all__ = ["LlamaConfig", "LlamaModel", "llama3_8b", "llama3_70b", "gemma_7b",
-           "tiny_llama", "init_params", "param_logical_axes", "MnistCNN",
-           "mnist_config"]
+           "mixtral_8x7b", "tiny_llama", "tiny_moe", "init_params",
+           "param_logical_axes", "MnistCNN", "mnist_config", "moe_mlp",
+           "moe_mlp_dense_reference", "moe_capacity"]

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from ..ops import apply_rope, flash_attention, ring_attention, rms_norm, rope_frequencies
+from .moe import moe_mlp
 from ..parallel.mesh import AXES
 from ..parallel.sharding import logical_sharding, shard_logical
 
@@ -52,6 +53,12 @@ class LlamaConfig:
     embed_scale: bool = False           # scale embeddings by sqrt(embed_dim) (Gemma)
     logit_softcap: Optional[float] = None  # tanh soft cap on lm-head logits (Gemma-2)
     norm_zero_centered: bool = False    # RMSNorm weight stored as w, applied as (1+w) (Gemma)
+    # sparse MoE (Mixtral family): n_experts=0 means dense MLP
+    n_experts: int = 0
+    n_experts_per_tok: int = 2
+    capacity_factor: float = 1.25
+    router_aux_coef: float = 0.02       # load-balance loss coefficient
+    router_z_coef: float = 1e-3         # router z-loss coefficient
     dtype: Any = jnp.bfloat16           # activation/compute dtype
     param_dtype: Any = jnp.float32
     remat: bool = True
@@ -65,7 +72,10 @@ class LlamaConfig:
         e, m, l, v = self.embed_dim, self.mlp_dim, self.n_layers, self.vocab_size
         hd = self.head_dim_
         attn = e * hd * (self.n_heads * 2 + self.n_kv_heads * 2)
-        mlp = 3 * e * m
+        if self.n_experts:
+            mlp = 3 * e * m * self.n_experts + e * self.n_experts  # experts + router
+        else:
+            mlp = 3 * e * m
         norms = 2 * e
         embed = v * e * (1 if self.tie_embeddings else 2)
         return l * (attn + mlp + norms) + embed + e
@@ -94,7 +104,22 @@ def gemma_7b() -> LlamaConfig:
                        norm_zero_centered=True)
 
 
+def mixtral_8x7b() -> LlamaConfig:
+    # Mixtral-8x7B: Mistral-7B backbone with 8-expert top-2 sparse MLPs.
+    return LlamaConfig(name="mixtral-8x7b", vocab_size=32000, embed_dim=4096,
+                       n_layers=32, n_heads=32, n_kv_heads=8, mlp_dim=14336,
+                       max_seq_len=32768, rope_theta=1_000_000.0,
+                       n_experts=8, n_experts_per_tok=2)
+
+
 def tiny_llama(**kw) -> LlamaConfig:
+    return dataclasses.replace(LlamaConfig(), **kw)
+
+
+def tiny_moe(**kw) -> LlamaConfig:
+    kw.setdefault("name", "tiny-moe")
+    kw.setdefault("n_experts", 4)
+    kw.setdefault("n_experts_per_tok", 2)
     return dataclasses.replace(LlamaConfig(), **kw)
 
 
@@ -109,10 +134,20 @@ def param_logical_axes(cfg: LlamaConfig) -> Params:
         "wv": ("layer", "embed", "kv_heads"),
         "wo": ("layer", "heads", "embed"),
         "mlp_norm": ("layer", "norm"),
-        "w_gate": ("layer", "embed", "mlp"),
-        "w_up": ("layer", "embed", "mlp"),
-        "w_down": ("layer", "mlp", "embed"),
     }
+    if cfg.n_experts:
+        layer.update({
+            "router": ("layer", "embed", "expert"),
+            "we_gate": ("layer", "expert", "embed", "mlp"),
+            "we_up": ("layer", "expert", "embed", "mlp"),
+            "we_down": ("layer", "expert", "mlp", "embed"),
+        })
+    else:
+        layer.update({
+            "w_gate": ("layer", "embed", "mlp"),
+            "w_up": ("layer", "embed", "mlp"),
+            "w_down": ("layer", "mlp", "embed"),
+        })
     tree: Params = {"tok_embed": ("vocab", "embed"),
                     "final_norm": ("norm",),
                     "layers": layer}
@@ -135,11 +170,21 @@ def init_params(cfg: LlamaConfig, key: jax.Array,
             "wv": (cfg.n_layers, e, cfg.n_kv_heads * hd),
             "wo": (cfg.n_layers, cfg.n_heads * hd, e),
             "mlp_norm": (cfg.n_layers, e),
+        },
+    }
+    if cfg.n_experts:
+        shapes["layers"].update({
+            "router": (cfg.n_layers, e, cfg.n_experts),
+            "we_gate": (cfg.n_layers, cfg.n_experts, e, cfg.mlp_dim),
+            "we_up": (cfg.n_layers, cfg.n_experts, e, cfg.mlp_dim),
+            "we_down": (cfg.n_layers, cfg.n_experts, cfg.mlp_dim, e),
+        })
+    else:
+        shapes["layers"].update({
             "w_gate": (cfg.n_layers, e, cfg.mlp_dim),
             "w_up": (cfg.n_layers, e, cfg.mlp_dim),
             "w_down": (cfg.n_layers, cfg.mlp_dim, e),
-        },
-    }
+        })
     if not cfg.tie_embeddings:
         shapes["lm_head"] = (e, cfg.vocab_size)
 
@@ -222,12 +267,27 @@ def _attention_block(x, lp, cfg: LlamaConfig, cos, sin, mesh, positions=None):
     return x + (o @ lp["wo"].astype(cfg.dtype))
 
 
-def _mlp_block(x, lp, cfg: LlamaConfig, mesh):
+def _mlp_block(x, lp, cfg: LlamaConfig, mesh, train: bool = True):
+    """Dense SwiGLU/GeGLU MLP, or sparse MoE when cfg.n_experts > 0.
+    Returns (residual output, scaled router aux loss — 0.0 for dense).
+    ``train=False`` (serving prefill/decode) routes with a no-drop capacity
+    (factor = n_experts guarantees room for any load): capacity drops are a
+    training-throughput trade, never acceptable token corruption at
+    inference — reference Mixtral never drops."""
     h = rms_norm(x, _norm_w(lp["mlp_norm"], cfg), cfg.norm_eps)
+    if cfg.n_experts:
+        y, aux, z = moe_mlp(
+            h, lp["router"], lp["we_gate"], lp["we_up"], lp["we_down"],
+            n_experts_per_tok=cfg.n_experts_per_tok,
+            capacity_factor=(cfg.capacity_factor if train
+                             else float(cfg.n_experts)),
+            activation=_activation(cfg), dtype=cfg.dtype,
+            constrain=(lambda t, axes: _constrain(t, mesh, axes)))
+        return x + y, cfg.router_aux_coef * aux + cfg.router_z_coef * z
     gate = h @ lp["w_gate"].astype(cfg.dtype)
     up = h @ lp["w_up"].astype(cfg.dtype)
     act = _constrain(_activation(cfg)(gate) * up, mesh, ("batch", "seq", "act_mlp"))
-    return x + (act @ lp["w_down"].astype(cfg.dtype))
+    return x + (act @ lp["w_down"].astype(cfg.dtype)), jnp.float32(0.0)
 
 
 class LlamaModel:
@@ -238,8 +298,11 @@ class LlamaModel:
         self.mesh = mesh
 
     def forward(self, params: Params, tokens: jax.Array,
-                positions: Optional[jax.Array] = None) -> jax.Array:
-        """tokens (B, S) int32 -> logits (B, S, V)."""
+                positions: Optional[jax.Array] = None,
+                with_aux: bool = False):
+        """tokens (B, S) int32 -> logits (B, S, V).
+        ``with_aux=True`` additionally returns the summed (pre-scaled) router
+        aux loss — nonzero only for MoE configs; add it to the train loss."""
         cfg, mesh = self.cfg, self.mesh
         cos, sin = rope_frequencies(cfg.head_dim_, cfg.max_seq_len,
                                     cfg.rope_theta, cfg.rope_scaling)
@@ -248,15 +311,18 @@ class LlamaModel:
 
         def block(carry, lp):
             y = _attention_block(carry, lp, cfg, cos, sin, mesh, positions)
-            y = _mlp_block(y, lp, cfg, mesh)
+            y, aux = _mlp_block(y, lp, cfg, mesh)
             y = _constrain(y, mesh, ("batch", "seq", "act_embed"))
-            return y, None
+            return y, aux
 
         body = jax.checkpoint(block) if cfg.remat else block
-        x, _ = jax.lax.scan(body, x, params["layers"])
+        x, aux_layers = jax.lax.scan(body, x, params["layers"])
         x = rms_norm(x, _norm_w(params["final_norm"], cfg), cfg.norm_eps)
         logits = _head_logits(x, params, cfg)
-        return _constrain(logits, mesh, ("batch", "seq", "act_vocab"))
+        logits = _constrain(logits, mesh, ("batch", "seq", "act_vocab"))
+        if with_aux:
+            return logits, jnp.sum(aux_layers)
+        return logits
 
     def __call__(self, params, tokens, positions=None):
         return self.forward(params, tokens, positions)
@@ -303,7 +369,7 @@ class LlamaModel:
                                 v.transpose(0, 2, 1, 3), causal=True)
             o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * cfg.head_dim_)
             y = y + (o @ lp["wo"].astype(cfg.dtype))
-            y = _mlp_block(y, lp, cfg, self.mesh)
+            y, _ = _mlp_block(y, lp, cfg, self.mesh, train=False)
             return y, (k, v)
 
         x, (k_all, v_all) = jax.lax.scan(block, x, params["layers"])
@@ -365,7 +431,7 @@ class LlamaModel:
             o = jnp.einsum("bhgL,bLhd->bhgd", p, v_cache.astype(jnp.float32))
             o = o.reshape(b, 1, cfg.n_heads * cfg.head_dim_).astype(cfg.dtype)
             y = y + (o @ lp["wo"].astype(cfg.dtype))
-            y = _mlp_block(y, lp, cfg, self.mesh)
+            y, _ = _mlp_block(y, lp, cfg, self.mesh, train=False)
             return y, (k_cache, v_cache)
 
         x, (k_new, v_new) = jax.lax.scan(

@@ -64,13 +64,21 @@ def make_train_step(model: LlamaModel, optimizer: optax.GradientTransformation,
         inputs, targets = batch[:, :-1], batch[:, 1:]
 
         def loss_fn(p):
-            return cross_entropy_loss(model.forward(p, inputs), targets)
+            # optimize CE + router aux, but report them separately so MoE
+            # loss curves stay comparable to dense runs (exp(loss) = ppl)
+            if model.cfg.n_experts:
+                logits, aux = model.forward(p, inputs, with_aux=True)
+                ce = cross_entropy_loss(logits, targets)
+                return ce + aux, (ce, aux)
+            ce = cross_entropy_loss(model.forward(p, inputs), targets)
+            return ce, (ce, jnp.float32(0.0))
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
+        (_, (ce, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         gnorm = optax.global_norm(grads)
-        return params, opt_state, {"loss": loss, "grad_norm": gnorm}
+        return params, opt_state, {"loss": ce, "aux_loss": aux,
+                                   "grad_norm": gnorm}
 
     donate_argnums = (0, 1) if donate else ()
     return jax.jit(step, donate_argnums=donate_argnums)

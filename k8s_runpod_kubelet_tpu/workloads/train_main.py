@@ -24,7 +24,8 @@ log = logging.getLogger("train-main")
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="llama3-8b",
-                   choices=["llama3-8b", "llama3-70b", "gemma-7b", "tiny"])
+                   choices=["llama3-8b", "llama3-70b", "gemma-7b",
+                            "mixtral-8x7b", "tiny", "tiny-moe"])
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--seq-len", type=int, default=2048)
@@ -42,13 +43,14 @@ def main(argv=None) -> int:
     pe = initialize_from_env()
 
     import jax
-    from ..models import llama3_8b, llama3_70b, gemma_7b, tiny_llama
+    from ..models import llama3_8b, llama3_70b, gemma_7b, mixtral_8x7b, tiny_llama, tiny_moe
     from ..parallel import MeshConfig, make_mesh
     from ..workloads.train import TrainConfig, Trainer
 
     n = jax.device_count()
     cfg = {"llama3-8b": llama3_8b, "llama3-70b": llama3_70b,
-           "gemma-7b": gemma_7b, "tiny": tiny_llama}[args.model]()
+           "gemma-7b": gemma_7b, "mixtral-8x7b": mixtral_8x7b,
+           "tiny": tiny_llama, "tiny-moe": tiny_moe}[args.model]()
     fsdp = args.fsdp if args.fsdp > 0 else max(1, n // (args.tensor * args.seq))
     mesh = make_mesh(MeshConfig(data=-1, fsdp=fsdp, seq=args.seq,
                                 tensor=args.tensor))
