@@ -29,6 +29,7 @@ from jax.sharding import Mesh
 from ..ops import apply_rope, flash_attention, ring_attention, rms_norm, rope_frequencies
 from .moe import moe_mlp
 from ..parallel.mesh import AXES
+from ..parallel.pipeline import pipeline_spmd, pipeline_stages
 from ..parallel.sharding import logical_sharding, shard_logical
 
 Params = dict[str, Any]
@@ -59,6 +60,9 @@ class LlamaConfig:
     capacity_factor: float = 1.25
     router_aux_coef: float = 0.02       # load-balance loss coefficient
     router_z_coef: float = 1e-3         # router z-loss coefficient
+    # pipeline parallelism: microbatch count when the mesh has a stage axis
+    # (default = n_stages; more microbatches shrink the GPipe bubble)
+    pipeline_microbatches: Optional[int] = None
     dtype: Any = jnp.bfloat16           # activation/compute dtype
     param_dtype: Any = jnp.float32
     remat: bool = True
@@ -271,7 +275,8 @@ def _mlp_block(x, lp, cfg: LlamaConfig, mesh, train: bool = True):
     """Dense SwiGLU/GeGLU MLP, or sparse MoE when cfg.n_experts > 0.
     Returns (residual output, scaled router aux loss — 0.0 for dense).
     ``train=False`` (serving prefill/decode) routes with a no-drop capacity
-    (factor = n_experts guarantees room for any load): capacity drops are a
+    (factor = n_experts/k ⇒ cap = G, the most tokens any one expert can get
+    since a token's top-k picks are distinct): capacity drops are a
     training-throughput trade, never acceptable token corruption at
     inference — reference Mixtral never drops."""
     h = rms_norm(x, _norm_w(lp["mlp_norm"], cfg), cfg.norm_eps)
@@ -280,7 +285,7 @@ def _mlp_block(x, lp, cfg: LlamaConfig, mesh, train: bool = True):
             h, lp["router"], lp["we_gate"], lp["we_up"], lp["we_down"],
             n_experts_per_tok=cfg.n_experts_per_tok,
             capacity_factor=(cfg.capacity_factor if train
-                             else float(cfg.n_experts)),
+                             else cfg.n_experts / cfg.n_experts_per_tok),
             activation=_activation(cfg), dtype=cfg.dtype,
             constrain=(lambda t, axes: _constrain(t, mesh, axes)))
         return x + y, cfg.router_aux_coef * aux + cfg.router_z_coef * z
@@ -309,14 +314,46 @@ class LlamaModel:
         x = _embed(params, tokens, cfg)
         x = _constrain(x, mesh, ("batch", "seq", "act_embed"))
 
-        def block(carry, lp):
-            y = _attention_block(carry, lp, cfg, cos, sin, mesh, positions)
-            y, aux = _mlp_block(y, lp, cfg, mesh)
-            y = _constrain(y, mesh, ("batch", "seq", "act_embed"))
-            return y, aux
+        n_stages = pipeline_stages(mesh)
+        if n_stages > 1:
+            # GPipe over the stage axis (parallel/pipeline.py). Blocks run
+            # mesh-free inside the vmapped stage: GSPMD shardings never change
+            # values, and XLA still propagates the tensor-axis layout from the
+            # param shardings; ring attention (a manual shard_map) is the one
+            # thing that can't nest here, so seq stays XLA-managed.
+            if positions is not None:
+                raise ValueError("pipeline forward does not take positions")
+            if mesh.shape.get(AXES.SEQ, 1) > 1:
+                raise ValueError(
+                    "stage>1 does not compose with seq>1: the pipeline stage "
+                    "runs mesh-free, so ring attention never engages and the "
+                    "seq-axis devices would sit idle — use fsdp/tensor/data "
+                    "for the remaining devices instead")
 
-        body = jax.checkpoint(block) if cfg.remat else block
-        x, aux_layers = jax.lax.scan(body, x, params["layers"])
+            def stage_block(carry, lp):
+                y = _attention_block(carry, lp, cfg, cos, sin, None)
+                y, aux = _mlp_block(y, lp, cfg, None)
+                return y, aux
+
+            sbody = jax.checkpoint(stage_block) if cfg.remat else stage_block
+
+            def stage_fn(stage_layers, x_mb):
+                y, auxes = jax.lax.scan(sbody, x_mb, stage_layers)
+                return y, jnp.sum(auxes)
+
+            x, aux_total = pipeline_spmd(
+                params["layers"], x, stage_fn, mesh=mesh,
+                n_microbatches=cfg.pipeline_microbatches)
+            aux_layers = aux_total[None]
+        else:
+            def block(carry, lp):
+                y = _attention_block(carry, lp, cfg, cos, sin, mesh, positions)
+                y, aux = _mlp_block(y, lp, cfg, mesh)
+                y = _constrain(y, mesh, ("batch", "seq", "act_embed"))
+                return y, aux
+
+            body = jax.checkpoint(block) if cfg.remat else block
+            x, aux_layers = jax.lax.scan(body, x, params["layers"])
         x = rms_norm(x, _norm_w(params["final_norm"], cfg), cfg.norm_eps)
         logits = _head_logits(x, params, cfg)
         logits = _constrain(logits, mesh, ("batch", "seq", "act_vocab"))

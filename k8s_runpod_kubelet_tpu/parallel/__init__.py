@@ -21,10 +21,12 @@ from .sharding import (
     param_shardings,
 )
 from .distributed import initialize_from_env, process_env_summary
+from .pipeline import pipeline_spmd, pipeline_stages
 
 __all__ = [
     "AXES", "MeshConfig", "make_mesh", "best_mesh_for",
     "LOGICAL_RULES", "logical_sharding", "logical_spec", "shard_logical",
     "param_shardings",
     "initialize_from_env", "process_env_summary",
+    "pipeline_spmd", "pipeline_stages",
 ]

@@ -39,7 +39,9 @@ LOGICAL_RULES: dict[str, object] = {
     "expert": AXES.EXPERT,
     "stage": AXES.STAGE,
     "norm": None,
-    "layer": None,  # leading axis of scan-stacked layer params
+    # leading axis of scan-stacked layer params: sharded over the stage axis
+    # so each pipeline stage's layers live on its devices (no-op at stage=1)
+    "layer": AXES.STAGE,
 }
 
 

@@ -12,6 +12,7 @@ Run: python -m k8s_runpod_kubelet_tpu.workloads.train_main \
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import logging
 import time
@@ -32,8 +33,11 @@ def main(argv=None) -> int:
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument("--tensor", type=int, default=1)
     p.add_argument("--seq", type=int, default=1, help="sequence-parallel degree")
+    p.add_argument("--stage", type=int, default=1, help="pipeline-parallel degree")
+    p.add_argument("--microbatches", type=int, default=0,
+                   help="pipeline microbatches (0 = one per stage)")
     p.add_argument("--fsdp", type=int, default=0,
-                   help="0 or -1 = auto: all non-tp/sp devices")
+                   help="0 or -1 = auto: all non-tp/sp/pp devices")
     p.add_argument("--checkpoint-dir", default="")
     p.add_argument("--checkpoint-every", type=int, default=500)
     args = p.parse_args(argv)
@@ -51,19 +55,33 @@ def main(argv=None) -> int:
     cfg = {"llama3-8b": llama3_8b, "llama3-70b": llama3_70b,
            "gemma-7b": gemma_7b, "mixtral-8x7b": mixtral_8x7b,
            "tiny": tiny_llama, "tiny-moe": tiny_moe}[args.model]()
-    fsdp = args.fsdp if args.fsdp > 0 else max(1, n // (args.tensor * args.seq))
+    if args.stage > 1:
+        if cfg.n_layers % args.stage:
+            raise SystemExit(f"--stage {args.stage} must divide "
+                             f"n_layers={cfg.n_layers}")
+        if args.seq > 1:
+            raise SystemExit("--stage does not compose with --seq: the "
+                             "pipelined forward cannot ring-shard the "
+                             "sequence; give those devices to --fsdp/--tensor")
+        cfg = dataclasses.replace(
+            cfg, pipeline_microbatches=args.microbatches or None)
+    fsdp = args.fsdp if args.fsdp > 0 else max(
+        1, n // (args.tensor * args.seq * args.stage))
     mesh = make_mesh(MeshConfig(data=-1, fsdp=fsdp, seq=args.seq,
-                                tensor=args.tensor))
+                                stage=args.stage, tensor=args.tensor))
     if pe.process_id == 0:
         log.info("model=%s params=%.2fB devices=%d mesh=%s slice=%s",
                  cfg.name, cfg.param_count / 1e9, n, dict(mesh.shape),
                  pe.accelerator_type or "local")
 
-    # global batch must divide evenly over the data axes
+    # global batch must divide evenly over the data axes (and, when
+    # pipelining, over the microbatch count)
     dp_total = mesh.shape["data"] * mesh.shape["fsdp"]
+    if args.stage > 1:
+        dp_total *= (args.microbatches or args.stage)
     batch = ((args.batch + dp_total - 1) // dp_total) * dp_total
     if batch != args.batch:
-        log.info("batch %d -> %d (must divide data*fsdp=%d)",
+        log.info("batch %d -> %d (must divide data*fsdp*microbatches=%d)",
                  args.batch, batch, dp_total)
     tc = TrainConfig(learning_rate=args.lr, batch_size=batch,
                      seq_len=args.seq_len, steps=args.steps,
