@@ -1,0 +1,295 @@
+"""Training-data input pipeline: native C++ loader + pure-Python fallback.
+
+Binds native/tokenloader.cc via ctypes (built on demand with g++ — no build
+system or pip dependency). Both implementations produce the *identical*
+deterministic batch stream for a given (seed, seq_len, batch, shard) tuple:
+the native one from background threads with a reorder buffer, the Python one
+inline. Parity is asserted in tests/test_data_loader.py, so either path can
+serve any worker.
+
+Data format: raw little-endian int32 token stream on disk (pre-tokenized
+corpus, MaxText-style). ``path=None`` gives the synthetic xorshift stream used
+by benches — infinite, seeded, no disk.
+
+SPMD sharding: every worker process opens its own (shard_id, num_shards)
+loader and reads a disjoint window range — no cross-host data coordination,
+matching the same-program-own-shard model the gang scheduler sets up
+(gang/env.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Iterator, Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "native", "tokenloader.cc")
+_LIB_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB = os.path.join(_LIB_DIR, "_tokenloader.so")
+
+_build_lock = threading.Lock()
+_lib_handle = None
+_MASK64 = (1 << 64) - 1
+
+
+def _build_native() -> Optional[str]:
+    """Compile the loader with g++ if the .so is missing/stale. None if no
+    toolchain — callers fall back to the Python path."""
+    try:
+        src_mtime = os.path.getmtime(_SRC)
+    except OSError:
+        return _LIB if os.path.exists(_LIB) else None
+    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= src_mtime:
+        return _LIB
+    with _build_lock:
+        if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= src_mtime:
+            return _LIB
+        tmp = tempfile.mktemp(suffix=".so", dir=_LIB_DIR)
+        cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
+               _SRC, "-o", tmp]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp, _LIB)  # atomic: concurrent builders see old or new
+        except (OSError, subprocess.SubprocessError) as exc:
+            log.warning("native tokenloader build failed (%s); "
+                        "using Python fallback", exc)
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            return None
+    return _LIB
+
+
+def _native_lib():
+    global _lib_handle
+    if _lib_handle is not None:
+        return _lib_handle
+    path = _build_native()
+    if path is None:
+        return None
+    lib = ctypes.CDLL(path)
+    lib.tl_open.restype = ctypes.c_void_p
+    lib.tl_open.argtypes = [ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+                            ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32,
+                            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                            ctypes.c_uint64]
+    lib.tl_next.restype = ctypes.c_int32
+    lib.tl_next.argtypes = [ctypes.c_void_p,
+                            ctypes.POINTER(ctypes.c_int32)]
+    lib.tl_num_tokens.restype = ctypes.c_int64
+    lib.tl_num_tokens.argtypes = [ctypes.c_void_p]
+    lib.tl_batches_per_epoch.restype = ctypes.c_int64
+    lib.tl_batches_per_epoch.argtypes = [ctypes.c_void_p]
+    lib.tl_close.restype = None
+    lib.tl_close.argtypes = [ctypes.c_void_p]
+    _lib_handle = lib
+    return lib
+
+
+def native_available() -> bool:
+    return _native_lib() is not None
+
+
+class NativeTokenLoader:
+    """Background-threaded batch producer over the C++ loader."""
+
+    def __init__(self, path: Optional[str], seq_len: int, batch_size: int,
+                 seed: int = 0, vocab_size: int = 32768, threads: int = 2,
+                 capacity: int = 8, shard_id: int = 0, num_shards: int = 1,
+                 start_batch: int = 0):
+        lib = _native_lib()
+        if lib is None:
+            raise RuntimeError("native tokenloader unavailable (no g++?)")
+        self._lib = lib
+        self.seq_len = seq_len
+        self.batch_size = batch_size
+        self._vocab_size = vocab_size
+        self._h = lib.tl_open(path.encode() if path else None, seq_len,
+                              batch_size, seed & _MASK64, threads, capacity,
+                              vocab_size, shard_id, num_shards, start_batch)
+        if not self._h:
+            raise ValueError(
+                f"tl_open failed: path={path!r} seq_len={seq_len} "
+                f"batch={batch_size} shard={shard_id}/{num_shards} "
+                "(missing/short file, or shard smaller than one batch?)")
+
+    @property
+    def num_tokens(self) -> int:
+        return self._lib.tl_num_tokens(self._h)
+
+    @property
+    def batches_per_epoch(self) -> int:
+        return self._lib.tl_batches_per_epoch(self._h)
+
+    def next(self) -> np.ndarray:
+        out = np.empty((self.batch_size, self.seq_len + 1), np.int32)
+        rc = self._lib.tl_next(
+            self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if rc != 0:
+            raise RuntimeError("tokenloader stopped")
+        _check_token_range(out, self._vocab_size)
+        return out
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        while True:
+            yield self.next()
+
+    def close(self):
+        if self._h:
+            self._lib.tl_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _check_token_range(batch: np.ndarray, vocab_size: int):
+    """A corpus tokenized with a bigger-vocab tokenizer must fail loudly:
+    jnp.take/one_hot clamp or zero out-of-range ids, which would otherwise
+    train silently on garbage embeddings."""
+    lo, hi = int(batch.min()), int(batch.max())
+    if lo < 0 or hi >= vocab_size:
+        raise ValueError(
+            f"corpus token id range [{lo}, {hi}] outside model vocab "
+            f"[0, {vocab_size}) — wrong tokenizer for this model?")
+
+
+def _splitmix64(x: int) -> int:
+    x = (x + 0x9E3779B97F4A7C15) & _MASK64
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _MASK64
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _MASK64
+    return x ^ (x >> 31)
+
+
+class PyTokenLoader:
+    """Pure-Python twin of NativeTokenLoader — bit-identical stream."""
+
+    def __init__(self, path: Optional[str], seq_len: int, batch_size: int,
+                 seed: int = 0, vocab_size: int = 32768, threads: int = 0,
+                 capacity: int = 0, shard_id: int = 0, num_shards: int = 1,
+                 start_batch: int = 0):
+        del threads, capacity  # signature parity with the native loader
+        if not (0 <= shard_id < num_shards):
+            raise ValueError(f"shard_id {shard_id} not in [0, {num_shards})")
+        self.seq_len, self.batch_size = seq_len, batch_size
+        self.seed = seed & _MASK64
+        self.vocab_size, self.shard_id = vocab_size, shard_id
+        self._tokens: Optional[np.ndarray] = None
+        if path:
+            # memmap, not fromfile: the fallback must handle multi-GB corpora
+            # with the same lazy paging as the native mmap path
+            self._tokens = np.memmap(path, np.int32, mode="r")
+            if self._tokens.size < seq_len + 1:
+                raise ValueError(f"{path}: fewer than seq_len+1 tokens")
+            total_windows = (self._tokens.size - 1) // seq_len
+        else:
+            total_windows = 1 << 40
+        self._shard_windows = (total_windows // num_shards
+                               if num_shards > 1 else total_windows)
+        if self._shard_windows < batch_size:
+            raise ValueError(f"shard has {self._shard_windows} windows < "
+                             f"batch {batch_size}")
+        self._i = start_batch
+
+    @property
+    def num_tokens(self) -> int:
+        return self._shard_windows * self.seq_len if self._tokens is not None else -1
+
+    @property
+    def batches_per_epoch(self) -> int:
+        return self._shard_windows // self.batch_size
+
+    def _window_for(self, gs: int) -> int:
+        # cycle-walked affine bijection — must mirror tokenloader.cc WindowFor
+        n = self._shard_windows
+        m = 1
+        while m < n:
+            m <<= 1
+        epoch, i = divmod(gs, n)
+        a = _splitmix64(self.seed ^ ((epoch * 2654435761) & _MASK64)) | 1
+        b = _splitmix64((self.seed + epoch + 0x51ED270B) & _MASK64)
+        w = i
+        while True:
+            w = ((a * w + b) & _MASK64) & (m - 1)
+            if w < n:
+                break
+        return w + self.shard_id * self._shard_windows
+
+    def _fill(self, gs: int, dst: np.ndarray):
+        span = self.seq_len + 1
+        if self._tokens is not None:
+            w = self._window_for(gs)
+            dst[:] = self._tokens[w * self.seq_len: w * self.seq_len + span]
+        else:
+            s = _splitmix64(self.seed ^ ((gs * 0x9E3779B9) & _MASK64)
+                            ^ ((self.shard_id << 48) & _MASK64))
+            for t in range(span):
+                s = (s ^ (s << 13)) & _MASK64
+                s ^= s >> 7
+                s = (s ^ (s << 17)) & _MASK64
+                dst[t] = s % self.vocab_size  # vocab < 2^31 keeps this in int32
+
+    def next(self) -> np.ndarray:
+        out = np.empty((self.batch_size, self.seq_len + 1), np.int32)
+        for s in range(self.batch_size):
+            self._fill(self._i * self.batch_size + s, out[s])
+        self._i += 1
+        _check_token_range(out, self.vocab_size)
+        return out
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        while True:
+            yield self.next()
+
+    def close(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def make_loader(path: Optional[str], seq_len: int, batch_size: int, **kw):
+    """Native if buildable, else Python — identical stream either way."""
+    if native_available():
+        return NativeTokenLoader(path, seq_len, batch_size, **kw)
+    return PyTokenLoader(path, seq_len, batch_size, **kw)
+
+
+def device_batches(loader, mesh=None) -> Iterator:
+    """Adapts a loader to the Trainer: device_put on the data axes.
+
+    Multi-host: each process's loader holds a disjoint shard and yields its
+    *local* rows (global_batch / num_processes); the global array is assembled
+    with make_array_from_process_local_data so every shard's stream is
+    consumed exactly once — a plain device_put of per-host-different data
+    would silently keep only the addressable rows of each host's copy.
+    """
+    import jax
+    from ..parallel.sharding import logical_sharding
+    if mesh is None:
+        for batch in loader:
+            yield jax.numpy.asarray(batch)
+        return
+    sharding = logical_sharding(mesh, ("batch", None))
+    if jax.process_count() == 1:
+        for batch in loader:
+            yield jax.device_put(batch, sharding)
+        return
+    for batch in loader:
+        global_shape = (batch.shape[0] * jax.process_count(), batch.shape[1])
+        yield jax.make_array_from_process_local_data(sharding, batch,
+                                                     global_shape)

@@ -40,6 +40,10 @@ def main(argv=None) -> int:
                    help="0 or -1 = auto: all non-tp/sp/pp devices")
     p.add_argument("--checkpoint-dir", default="")
     p.add_argument("--checkpoint-every", type=int, default=500)
+    p.add_argument("--data", default="",
+                   help="pre-tokenized int32 corpus file (empty = synthetic); "
+                        "read through the native loader, sharded per process")
+    p.add_argument("--data-threads", type=int, default=2)
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -90,7 +94,28 @@ def main(argv=None) -> int:
     trainer = Trainer(cfg, tc, mesh=mesh)
     if args.checkpoint_dir:
         trainer.restore()  # resume-from-preemption path
-    out = trainer.run(steps=args.steps)
+    batches = None
+    loader = None
+    if args.data:
+        from ..data import device_batches, make_loader
+        if batch % pe.num_processes:
+            raise SystemExit(f"global batch {batch} must divide over "
+                             f"{pe.num_processes} processes")
+        # per-process local rows; device_batches assembles the global array.
+        # start_batch seeks past data a resumed run already consumed.
+        loader = make_loader(args.data, seq_len=args.seq_len,
+                             batch_size=batch // pe.num_processes,
+                             vocab_size=cfg.vocab_size,
+                             threads=args.data_threads,
+                             shard_id=pe.process_id,
+                             num_shards=pe.num_processes,
+                             start_batch=trainer.step)
+        batches = device_batches(loader, mesh)
+    try:
+        out = trainer.run(steps=args.steps, batches=batches)
+    finally:
+        if loader is not None:
+            loader.close()
     if args.checkpoint_dir:
         trainer.save()
 

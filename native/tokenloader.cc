@@ -1,0 +1,251 @@
+// Native training-data loader: mmap'd token files -> packed (B, S+1) batches.
+//
+// The IO half of the training input pipeline (the reference repo has no native
+// components at all — SURVEY.md §2.1; this is part of the "exceeds" surface,
+// filling the framework-runtime role a torch DataLoader's C++ workers play,
+// TPU-shaped: fixed-size int32 batches ready for device_put, produced by
+// background threads so host IO never sits on the device-step critical path).
+//
+// Data format: a raw little-endian int32 token stream (MaxText-style
+// pre-tokenized corpus). Batches are windows of seq_len+1 tokens; window order
+// is a seeded affine permutation over all windows, re-derived per epoch, so
+// every worker process can compute its own disjoint shard deterministically
+// (no coordination traffic — matches the SPMD "same program, own shard"
+// model).
+//
+// Concurrency: N producer threads claim global batch indices with an atomic
+// counter, build batches independently, and retire them through a bounded
+// reorder buffer so the consumer sees batch 0, 1, 2, ... in order no matter
+// which thread finished first. Determinism is therefore independent of thread
+// count — a (seed, seq_len, batch, shard) tuple names the exact stream.
+//
+// extern "C" API (consumed by ctypes from
+// k8s_runpod_kubelet_tpu/data/loader.py — keep in sync):
+//   tl_open(path, seq_len, batch, seed, threads, capacity, vocab,
+//           shard_id, num_shards, start_batch) -> handle (NULL on error;
+//           path=="" => synthetic xorshift stream, the bench input path;
+//           start_batch seeks the deterministic stream — checkpoint resume)
+//   tl_next(handle, out_ptr) -> 0 (fills batch*(seq_len+1) int32s)
+//   tl_num_tokens(handle) -> total tokens visible to this shard
+//   tl_batches_per_epoch(handle)
+//   tl_close(handle)
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+// splitmix64: seeds the per-sample xorshift streams; also the permutation hash.
+static inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
+struct Batch {
+  std::vector<int32_t> data;
+};
+
+class Loader {
+ public:
+  Loader(const std::string& path, int64_t seq_len, int64_t batch,
+         uint64_t seed, int threads, int capacity, int64_t vocab,
+         int64_t shard_id, int64_t num_shards, uint64_t start_batch)
+      : seq_len_(seq_len), batch_(batch), seed_(seed), vocab_(vocab),
+        shard_id_(shard_id), num_shards_(num_shards),
+        capacity_(capacity < 2 ? 2 : capacity),
+        next_claim_(start_batch), next_consume_(start_batch) {
+    if (!path.empty()) {
+      fd_ = ::open(path.c_str(), O_RDONLY);
+      if (fd_ < 0) { ok_ = false; return; }
+      struct stat st;
+      if (fstat(fd_, &st) != 0 || st.st_size < (seq_len_ + 1) * 4) {
+        ok_ = false; return;
+      }
+      file_tokens_ = st.st_size / 4;
+      map_ = static_cast<int32_t*>(
+          mmap(nullptr, static_cast<size_t>(st.st_size), PROT_READ,
+               MAP_PRIVATE, fd_, 0));
+      if (map_ == MAP_FAILED) { map_ = nullptr; ok_ = false; return; }
+      // windows stride by seq_len (the +1 target overlaps the next window's
+      // first token — standard next-token-prediction packing)
+      total_windows_ = (file_tokens_ - 1) / seq_len_;
+    } else {
+      // synthetic mode: "infinite" corpus
+      total_windows_ = 1LL << 40;
+    }
+    if (num_shards_ > 1) {
+      shard_windows_ = total_windows_ / num_shards_;
+    } else {
+      shard_windows_ = total_windows_;
+    }
+    if (shard_windows_ < batch_) { ok_ = false; return; }
+    int n = threads < 1 ? 1 : (threads > 16 ? 16 : threads);
+    for (int i = 0; i < n; ++i) {
+      workers_.emplace_back([this] { Work(); });
+    }
+  }
+
+  ~Loader() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_space_.notify_all();
+    cv_ready_.notify_all();
+    for (auto& t : workers_) t.join();
+    if (map_) munmap(map_, static_cast<size_t>(file_tokens_ * 4));
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  bool ok() const { return ok_; }
+  int64_t num_tokens() const {
+    return map_ ? shard_windows_ * seq_len_ : -1;
+  }
+  int64_t batches_per_epoch() const { return shard_windows_ / batch_; }
+
+  // Blocking: copies the next in-order batch into out (batch*(seq_len+1)).
+  int Next(int32_t* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    const uint64_t want = next_consume_++;
+    cv_ready_.wait(lk, [&] { return stop_ || done_.count(want) > 0; });
+    if (stop_) return -1;
+    Batch b = std::move(done_[want]);
+    done_.erase(want);
+    lk.unlock();
+    cv_space_.notify_all();
+    std::memcpy(out, b.data.data(), b.data.size() * sizeof(int32_t));
+    return 0;
+  }
+
+ private:
+  // Seeded per-epoch permutation over the shard's windows, no materialized
+  // index array even for billion-window corpora: an affine map with odd `a`
+  // is a bijection mod the next power of two, and cycle-walking (re-applying
+  // the map while the value lands in the pow2 overhang) restricts it to a
+  // bijection on [0, shard_windows) — expected <2 steps since m < 2n.
+  int64_t WindowFor(uint64_t global_sample) const {
+    const uint64_t n = static_cast<uint64_t>(shard_windows_);
+    uint64_t m = 1;
+    while (m < n) m <<= 1;
+    const uint64_t mask = m - 1;
+    const uint64_t epoch = global_sample / n;
+    const uint64_t i = global_sample % n;
+    const uint64_t a = splitmix64(seed_ ^ (epoch * 2654435761ULL)) | 1ULL;
+    const uint64_t b = splitmix64(seed_ + epoch + 0x51ed270bULL);
+    uint64_t w = i;
+    do {
+      w = (a * w + b) & mask;
+    } while (w >= n);
+    return static_cast<int64_t>(w) + shard_id_ * shard_windows_;
+  }
+
+  void FillSample(uint64_t global_sample, int32_t* dst) const {
+    const int64_t span = seq_len_ + 1;
+    if (map_) {
+      const int64_t w = WindowFor(global_sample);
+      std::memcpy(dst, map_ + w * seq_len_,
+                  static_cast<size_t>(span) * sizeof(int32_t));
+    } else {
+      uint64_t s = splitmix64(seed_ ^ (global_sample * 0x9e3779b9ULL)
+                              ^ (static_cast<uint64_t>(shard_id_) << 48));
+      for (int64_t t = 0; t < span; ++t) {
+        s ^= s << 13; s ^= s >> 7; s ^= s << 17;  // xorshift64
+        dst[t] = static_cast<int32_t>(s % static_cast<uint64_t>(vocab_));
+      }
+    }
+  }
+
+  void Work() {
+    const int64_t span = seq_len_ + 1;
+    for (;;) {
+      uint64_t idx;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_space_.wait(lk, [&] {
+          return stop_ || next_claim_ < next_consume_ + capacity_;
+        });
+        if (stop_) return;
+        idx = next_claim_++;
+      }
+      Batch b;
+      b.data.resize(static_cast<size_t>(batch_ * span));
+      for (int64_t s = 0; s < batch_; ++s) {
+        FillSample(idx * batch_ + s, b.data.data() + s * span);
+      }
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        done_[idx] = std::move(b);
+      }
+      cv_ready_.notify_all();
+    }
+  }
+
+  const int64_t seq_len_, batch_;
+  const uint64_t seed_;
+  const int64_t vocab_, shard_id_, num_shards_;
+  const uint64_t capacity_;
+
+  int fd_ = -1;
+  int32_t* map_ = nullptr;
+  int64_t file_tokens_ = 0;
+  int64_t total_windows_ = 0;
+  int64_t shard_windows_ = 0;
+  bool ok_ = true;
+
+  std::mutex mu_;
+  std::condition_variable cv_ready_, cv_space_;
+  std::map<uint64_t, Batch> done_;   // reorder buffer, keyed by batch index
+  uint64_t next_claim_;              // next batch index a worker builds
+  uint64_t next_consume_;            // next batch index Next() hands out
+  bool stop_ = false;
+  std::vector<std::thread> workers_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* tl_open(const char* path, int64_t seq_len, int64_t batch, uint64_t seed,
+              int32_t threads, int32_t capacity, int64_t vocab,
+              int64_t shard_id, int64_t num_shards, uint64_t start_batch) {
+  if (seq_len < 1 || batch < 1 || vocab < 2 || num_shards < 1 ||
+      shard_id < 0 || shard_id >= num_shards) {
+    return nullptr;
+  }
+  auto* l = new Loader(path ? std::string(path) : std::string(), seq_len,
+                       batch, seed, threads, capacity, vocab, shard_id,
+                       num_shards, start_batch);
+  if (!l->ok()) { delete l; return nullptr; }
+  return l;
+}
+
+int32_t tl_next(void* h, int32_t* out) {
+  return h ? static_cast<Loader*>(h)->Next(out) : -1;
+}
+
+int64_t tl_num_tokens(void* h) {
+  return h ? static_cast<Loader*>(h)->num_tokens() : -1;
+}
+
+int64_t tl_batches_per_epoch(void* h) {
+  return h ? static_cast<Loader*>(h)->batches_per_epoch() : -1;
+}
+
+void tl_close(void* h) { delete static_cast<Loader*>(h); }
+
+}  // extern "C"

@@ -1,0 +1,153 @@
+"""Native C++ token loader + Python twin: build, determinism, parity, sharding.
+
+The reference has no data pipeline at all (SURVEY.md §2.1: zero native
+components, workloads are opaque containers) — this covers the net-new input
+pipeline that feeds workloads/train.py.
+"""
+
+import numpy as np
+import pytest
+
+from k8s_runpod_kubelet_tpu.data import (NativeTokenLoader, PyTokenLoader,
+                                         make_loader, native_available)
+
+
+@pytest.fixture(scope="module")
+def token_file(tmp_path_factory):
+    rng = np.random.default_rng(7)
+    # 64Ki+1 tokens: 512 windows at seq 128 / 1024 at seq 64 — divisible by
+    # the batch sizes used below, so one "epoch" is a whole number of batches
+    toks = rng.integers(0, 1000, size=64 * 1024 + 1, dtype=np.int32)
+    p = tmp_path_factory.mktemp("data") / "corpus.bin"
+    toks.tofile(p)
+    return str(p), toks
+
+
+def test_native_builds():
+    import shutil
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ toolchain; Python fallback covers this box")
+    assert native_available(), "g++ toolchain present but native build failed"
+
+
+def test_wrong_tokenizer_fails_loudly(token_file):
+    path, _ = token_file  # corpus ids go up to 999
+    with PyTokenLoader(path, seq_len=64, batch_size=4, vocab_size=500) as py:
+        with pytest.raises(ValueError, match="vocab"):
+            py.next()
+    with NativeTokenLoader(path, seq_len=64, batch_size=4,
+                           vocab_size=500) as nat:
+        with pytest.raises(ValueError, match="vocab"):
+            nat.next()
+
+
+def test_python_file_batches_are_file_windows(token_file):
+    path, toks = token_file
+    with PyTokenLoader(path, seq_len=128, batch_size=4, seed=3) as ld:
+        batch = ld.next()
+    assert batch.shape == (4, 129)
+    # every sample must be a contiguous seq_len-strided window of the corpus
+    windows = {toks[w * 128: w * 128 + 129].tobytes()
+               for w in range((toks.size - 1) // 128)}
+    for row in batch:
+        assert row.tobytes() in windows
+
+
+def test_native_matches_python_on_file(token_file):
+    path, _ = token_file
+    kw = dict(seq_len=64, batch_size=8, seed=11)
+    with NativeTokenLoader(path, threads=4, **kw) as nat, \
+            PyTokenLoader(path, **kw) as py:
+        assert nat.num_tokens == py.num_tokens
+        assert nat.batches_per_epoch == py.batches_per_epoch
+        for _ in range(20):
+            np.testing.assert_array_equal(nat.next(), py.next())
+
+
+def test_native_matches_python_synthetic():
+    kw = dict(seq_len=32, batch_size=4, seed=5, vocab_size=501)
+    with NativeTokenLoader(None, threads=3, **kw) as nat, \
+            PyTokenLoader(None, **kw) as py:
+        for _ in range(10):
+            a, b = nat.next(), py.next()
+            np.testing.assert_array_equal(a, b)
+            assert a.min() >= 0 and a.max() < 501
+
+
+def test_determinism_independent_of_thread_count(token_file):
+    path, _ = token_file
+    kw = dict(seq_len=64, batch_size=4, seed=9)
+    with NativeTokenLoader(path, threads=1, **kw) as a, \
+            NativeTokenLoader(path, threads=8, **kw) as b:
+        for _ in range(30):
+            np.testing.assert_array_equal(a.next(), b.next())
+
+
+def test_epoch_reshuffles_but_covers(token_file):
+    path, toks = token_file
+    seq, bs = 128, 4
+    with PyTokenLoader(path, seq_len=seq, batch_size=bs, seed=1) as ld:
+        per_epoch = ld.batches_per_epoch
+        e0 = [ld.next() for _ in range(per_epoch)]
+        e1 = [ld.next() for _ in range(per_epoch)]
+    flat0 = np.concatenate([b[:, 0] for b in e0])
+    flat1 = np.concatenate([b[:, 0] for b in e1])
+    assert not np.array_equal(flat0, flat1), "epochs must reshuffle"
+    # same multiset of windows each epoch (affine perm is a bijection)
+    assert sorted(flat0.tolist()) == sorted(flat1.tolist())
+
+
+def test_shards_are_disjoint(token_file):
+    path, _ = token_file
+    kw = dict(seq_len=64, batch_size=4, seed=2, num_shards=2)
+    with NativeTokenLoader(path, shard_id=0, **kw) as s0, \
+            NativeTokenLoader(path, shard_id=1, **kw) as s1:
+        rows0 = {s0.next().tobytes() for _ in range(10)}
+        rows1 = {s1.next().tobytes() for _ in range(10)}
+    assert not (rows0 & rows1)
+
+
+def test_start_batch_seeks_the_stream(token_file):
+    path, _ = token_file
+    kw = dict(seq_len=64, batch_size=4, seed=13)
+    with PyTokenLoader(path, **kw) as ref:
+        expect = [ref.next() for _ in range(8)]
+    with NativeTokenLoader(path, start_batch=5, **kw) as nat, \
+            PyTokenLoader(path, start_batch=5, **kw) as py:
+        np.testing.assert_array_equal(nat.next(), expect[5])
+        np.testing.assert_array_equal(py.next(), expect[5])
+        np.testing.assert_array_equal(nat.next(), expect[6])
+
+
+def test_open_errors():
+    with pytest.raises(ValueError):
+        NativeTokenLoader("/nonexistent/corpus.bin", seq_len=64, batch_size=4)
+    with pytest.raises(ValueError):
+        PyTokenLoader(None, seq_len=64, batch_size=4, num_shards=4,
+                      shard_id=99)
+
+
+def test_make_loader_prefers_native(token_file):
+    path, _ = token_file
+    ld = make_loader(path, seq_len=64, batch_size=2)
+    try:
+        assert isinstance(ld, NativeTokenLoader)
+        assert ld.next().shape == (2, 65)
+    finally:
+        ld.close()
+
+
+def test_feeds_trainer(token_file):
+    import jax.numpy as jnp
+    from k8s_runpod_kubelet_tpu.data import device_batches
+    from k8s_runpod_kubelet_tpu.models import tiny_llama
+    from k8s_runpod_kubelet_tpu.workloads.train import TrainConfig, Trainer
+
+    path, _ = token_file
+    cfg = tiny_llama(vocab_size=1024, embed_dim=32, n_layers=1, n_heads=2,
+                     n_kv_heads=1, mlp_dim=64, max_seq_len=64,
+                     dtype=jnp.float32, param_dtype=jnp.float32)
+    tc = TrainConfig(batch_size=2, seq_len=32, steps=2, warmup_steps=1)
+    with make_loader(path, seq_len=32, batch_size=2) as ld:
+        out = Trainer(cfg, tc).run(steps=2, batches=device_batches(ld))
+    assert np.isfinite(out["final_loss"])

@@ -34,6 +34,19 @@ def test_train_main_tiny(capsys):
     assert summary["tokens_per_s_per_chip"] > 0
 
 
+def test_train_main_with_data_file(capsys, tmp_path):
+    import numpy as np
+    from k8s_runpod_kubelet_tpu.workloads.train_main import main
+    corpus = tmp_path / "corpus.bin"
+    np.random.default_rng(0).integers(
+        0, 32000, size=16 * 1024, dtype=np.int32).tofile(corpus)
+    rc = main(["--model", "tiny", "--steps", "2", "--batch", "2",
+               "--seq-len", "32", "--data", str(corpus)])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["tokens_per_s_per_chip"] > 0
+
+
 class TestServeHttp:
     @pytest.fixture()
     def server(self):
