@@ -75,7 +75,18 @@ def _native_lib():
     path = _build_native()
     if path is None:
         return None
-    lib = ctypes.CDLL(path)
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError as exc:
+        # corrupt/ABI-stale cached .so (image/arch change, disk-full
+        # truncation): drop it so a later call rebuilds; fall back for now
+        log.warning("cached %s unloadable (%s); using Python fallback",
+                    path, exc)
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return None
     lib.tl_open.restype = ctypes.c_void_p
     lib.tl_open.argtypes = [ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
                             ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32,
@@ -111,6 +122,10 @@ class NativeTokenLoader:
         self._lib = lib
         self.seq_len = seq_len
         self.batch_size = batch_size
+        # wrong-tokenizer guard applies to file corpora only: the synthetic
+        # stream emits `s % vocab`, in range by construction — don't pay a
+        # per-batch scan on the consumer thread for it
+        self._check_range = bool(path)
         self._vocab_size = vocab_size
         self._h = lib.tl_open(path.encode() if path else None, seq_len,
                               batch_size, seed & _MASK64, threads, capacity,
@@ -135,7 +150,8 @@ class NativeTokenLoader:
             self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
         if rc != 0:
             raise RuntimeError("tokenloader stopped")
-        _check_token_range(out, self._vocab_size)
+        if self._check_range:
+            _check_token_range(out, self._vocab_size)
         return out
 
     def __iter__(self) -> Iterator[np.ndarray]:
@@ -245,7 +261,8 @@ class PyTokenLoader:
         for s in range(self.batch_size):
             self._fill(self._i * self.batch_size + s, out[s])
         self._i += 1
-        _check_token_range(out, self.vocab_size)
+        if self._tokens is not None:
+            _check_token_range(out, self.vocab_size)
         return out
 
     def __iter__(self) -> Iterator[np.ndarray]:

@@ -66,6 +66,10 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16           # activation/compute dtype
     param_dtype: Any = jnp.float32
     remat: bool = True
+    # "full": recompute the whole layer in backward (min HBM, +1 fwd of
+    # FLOPs); "dots": save matmul outputs, recompute elementwise (MaxText's
+    # default trade at scale — needs the activation HBM); "none": save all.
+    remat_policy: str = "full"
 
     @property
     def head_dim_(self) -> int:
@@ -221,6 +225,18 @@ def _constrain(x, mesh: Optional[Mesh], axes):
     return shard_logical(x, mesh, axes) if mesh is not None else x
 
 
+def _maybe_remat(fn, cfg: LlamaConfig):
+    """Wraps a scan block with the configured rematerialization policy."""
+    if not cfg.remat or cfg.remat_policy == "none":
+        return fn
+    if cfg.remat_policy == "dots":
+        return jax.checkpoint(
+            fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    if cfg.remat_policy != "full":
+        raise ValueError(f"unknown remat_policy {cfg.remat_policy!r}")
+    return jax.checkpoint(fn)
+
+
 def _norm_w(w, cfg: LlamaConfig):
     """Gemma stores RMSNorm weights zero-centered and applies (1 + w)."""
     return w + 1 if cfg.norm_zero_centered else w
@@ -335,7 +351,7 @@ class LlamaModel:
                 y, aux = _mlp_block(y, lp, cfg, None)
                 return y, aux
 
-            sbody = jax.checkpoint(stage_block) if cfg.remat else stage_block
+            sbody = _maybe_remat(stage_block, cfg)
 
             def stage_fn(stage_layers, x_mb):
                 y, auxes = jax.lax.scan(sbody, x_mb, stage_layers)
@@ -352,7 +368,7 @@ class LlamaModel:
                 y = _constrain(y, mesh, ("batch", "seq", "act_embed"))
                 return y, aux
 
-            body = jax.checkpoint(block) if cfg.remat else block
+            body = _maybe_remat(block, cfg)
             x, aux_layers = jax.lax.scan(body, x, params["layers"])
         x = rms_norm(x, _norm_w(params["final_norm"], cfg), cfg.norm_eps)
         logits = _head_logits(x, params, cfg)

@@ -1,10 +1,22 @@
-"""Flash attention: blockwise online-softmax Pallas TPU kernel + XLA fallback.
+"""Flash attention: blockwise online-softmax Pallas TPU kernels + XLA fallback.
 
-Kernel shape: grid over (batch, q_heads, q_blocks); K/V for the matching KV
-head (GQA native — no repeat materialization) live in VMEM and are consumed in
+Forward: grid over (batch, q_heads, q_blocks); K/V for the matching KV head
+(GQA native — no repeat materialization) live in VMEM and are consumed in
 block_k chunks with the online-softmax recurrence, so HBM sees each K/V tile
 once and the (S, S) score matrix never exists. Causal programs stop at their
-diagonal block (no wasted FLOPs past it).
+diagonal block (no wasted FLOPs past it). The kernel also emits the row
+log-sum-exp, which makes the backward exact without re-running the softmax
+reduction.
+
+Backward: two Pallas kernels (the standard flash-attention split):
+  - dQ:    grid (b, hq, q_blocks); streams K/V tiles, rebuilds p from the
+           saved LSE, accumulates dq = sum_j (p∘(dp-δ)) Kj.
+  - dK/dV: grid (b, hq, k_blocks); streams Q/dO tiles, accumulates per-q-head
+           dk/dv, which XLA then sum-reduces over each GQA group.
+δ = rowsum(dO ∘ O) is precomputed in XLA. All matmuls run in the input dtype
+with f32 accumulation (MXU-native); only softmax/statistics math is f32.
+No (S, S) buffer exists in either direction — memory stays O(S·d) per
+program, which is what lets long-context batches fit HBM.
 
 Layout: q (B, Hq, S, D); k, v (B, Hkv, S, D); Hq % Hkv == 0.
 """
@@ -24,27 +36,30 @@ NEG_INF = -1e30
 
 def _attention_xla(q, k, v, *, causal: bool, sm_scale: float,
                    q_offset: int = 0) -> jax.Array:
-    """Reference/fallback path; identical math, XLA-fused."""
+    """Reference/fallback path; identical math, XLA-fused. Matmuls stay in
+    the input dtype with f32 accumulation (bf16 inputs keep the MXU on its
+    fast path); softmax statistics are f32."""
     b, hq, sq, d = q.shape
     _, hkv, sk, _ = k.shape
     group = hq // hkv
-    qf = q.astype(jnp.float32) * sm_scale
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    qg = qf.reshape(b, hkv, group, sq, d)
-    s = jnp.einsum("bhgqd,bhkd->bhgqk", qg, kf)
+    qg = (q * jnp.asarray(sm_scale, q.dtype)).reshape(b, hkv, group, sq, d)
+    s = jnp.einsum("bhgqd,bhkd->bhgqk", qg, k,
+                   preferred_element_type=jnp.float32)
     if causal:
         q_pos = jnp.arange(sq) + q_offset
         k_pos = jnp.arange(sk)
         mask = q_pos[:, None] >= k_pos[None, :]
         s = jnp.where(mask[None, None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    o = jnp.einsum("bhgqk,bhkd->bhgqd", p, vf)
+    o = jnp.einsum("bhgqk,bhkd->bhgqd", p.astype(q.dtype), v,
+                   preferred_element_type=jnp.float32)
     return o.reshape(b, hq, sq, d).astype(q.dtype)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
-                  seq_k: int, causal: bool, sm_scale: float):
+# -- forward kernel -----------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
+                block_k: int, seq_k: int, causal: bool, sm_scale: float):
     import jax.experimental.pallas as pl  # noqa: F401 (kernel-only import)
     qi = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32) * sm_scale          # (bq, d)
@@ -82,71 +97,231 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc, m, l = jax.lax.fori_loop(0, k_blocks, body, (acc0, m0, l0))
-    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
 
 
-def _flash_pallas(q, k, v, causal: bool, scale: float, block_q: int, block_k: int):
+def _flash_fwd_pallas(q, k, v, causal: bool, scale: float, block_q: int,
+                      block_k: int, interpret: bool = False):
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
     b, hq, sq, d = q.shape
     _, hkv, sk, _ = k.shape
     group = hq // hkv
-    kernel = functools.partial(_flash_kernel, block_q=block_q, block_k=block_k,
+    kernel = functools.partial(_fwd_kernel, block_q=block_q, block_k=block_k,
                                seq_k=sk, causal=causal, sm_scale=scale)
     return pl.pallas_call(
         kernel,
         grid=(b, hq, sq // block_q),
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bb, h, i: (bb, h, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, sk, d), lambda bb, h, i: (bb, h // group, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, sk, d), lambda bb, h, i: (bb, h // group, 0, 0),
-                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q, d), lambda bb, h, i: (bb, h, i, 0)),
+            pl.BlockSpec((1, 1, sk, d), lambda bb, h, i: (bb, h // group, 0, 0)),
+            pl.BlockSpec((1, 1, sk, d), lambda bb, h, i: (bb, h // group, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda bb, h, i: (bb, h, i, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bb, h, i: (bb, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bb, h, i: (bb, h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, hq, sq), jnp.float32),
+        ],
+        interpret=interpret,
     )(q, k, v)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_diff(q, k, v, causal, scale, block_q, block_k):
-    return _flash_pallas(q, k, v, causal, scale, block_q, block_k)
+# -- backward kernels ---------------------------------------------------------
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               block_q: int, block_k: int, seq_k: int, causal: bool,
+               sm_scale: float):
+    import jax.experimental.pallas as pl  # noqa: F401
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale           # (bq, d)
+    do = do_ref[0, 0].astype(jnp.float32)                    # (bq, d)
+    lse = lse_ref[0, 0][:, None]                             # (bq, 1)
+    delta = delta_ref[0, 0][:, None]                         # (bq, 1)
+    d = q.shape[-1]
+
+    num_k_blocks = seq_k // block_k
+    if causal:
+        last = (qi + 1) * block_q - 1
+        k_blocks = jnp.minimum((last // block_k) + 1, num_k_blocks)
+    else:
+        k_blocks = num_k_blocks
+
+    def body(j, dq):
+        kc = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vc = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kc, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                                 # (bq, bk)
+        dp = jax.lax.dot_general(do, vc, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot_general(ds, kc, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, k_blocks, body,
+                           jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0, 0] = (dq * sm_scale).astype(dq_ref.dtype)
 
 
-def _flash_diff_fwd(q, k, v, causal, scale, block_q, block_k):
-    return _flash_pallas(q, k, v, causal, scale, block_q, block_k), (q, k, v)
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, block_q: int, block_k: int, seq_q: int,
+                causal: bool, sm_scale: float):
+    import jax.experimental.pallas as pl  # noqa: F401
+    ki = pl.program_id(2)
+    k = k_ref[0, 0].astype(jnp.float32)                      # (bk, d)
+    v = v_ref[0, 0].astype(jnp.float32)                      # (bk, d)
+    d = k.shape[-1]
+
+    num_q_blocks = seq_q // block_q
+    # causal: q blocks strictly before this k block's first row see nothing
+    q_start = (ki * block_k) // block_q if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        qc = q_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        doc = do_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
+        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
+        s = jax.lax.dot_general(qc * sm_scale, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # (bq, bk)
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                                 # (bq, bk)
+        dv_new = dv + jax.lax.dot_general(
+            p, doc, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (bk, d)
+        dp = jax.lax.dot_general(doc, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)                                # (bq, bk)
+        dk_new = dk + jax.lax.dot_general(
+            ds, qc, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (bk, d)
+        return dk_new, dv_new
+
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(q_start, num_q_blocks, body, (dk0, dv0))
+    dk_ref[0, 0] = (dk * sm_scale).astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_diff_bwd(causal, scale, block_q, block_k, res, g):
-    # Backward recomputes through the XLA reference path (same math as the
-    # kernel) — flash-attention's standard recompute-in-bwd trade, with XLA
-    # doing the fusion. A fused Pallas bwd kernel can slot in here later.
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _attention_xla(q_, k_, v_, causal=causal,
-                                          sm_scale=scale), q, k, v)
-    return vjp(g)
+def _flash_bwd_pallas(q, k, v, o, lse, do, causal: bool, scale: float,
+                      block_q: int, block_k: int, interpret: bool = False):
+    from jax.experimental import pallas as pl
+
+    b, hq, sq, d = q.shape
+    _, hkv, sk, _ = k.shape
+    group = hq // hkv
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                                  # (b, hq, sq)
+
+    dq_kernel = functools.partial(_dq_kernel, block_q=block_q,
+                                  block_k=block_k, seq_k=sk, causal=causal,
+                                  sm_scale=scale)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b, hq, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bb, h, i: (bb, h, i, 0)),
+            pl.BlockSpec((1, 1, sk, d), lambda bb, h, i: (bb, h // group, 0, 0)),
+            pl.BlockSpec((1, 1, sk, d), lambda bb, h, i: (bb, h // group, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda bb, h, i: (bb, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bb, h, i: (bb, h, i)),
+            pl.BlockSpec((1, 1, block_q), lambda bb, h, i: (bb, h, i)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda bb, h, i: (bb, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dkv_kernel = functools.partial(_dkv_kernel, block_q=block_q,
+                                   block_k=block_k, seq_q=sq, causal=causal,
+                                   sm_scale=scale)
+    # per-q-head dk/dv (f32 accumulators); the GQA group-sum happens in XLA
+    dk_h, dv_h = pl.pallas_call(
+        dkv_kernel,
+        grid=(b, hq, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, sq, d), lambda bb, h, j: (bb, h, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bb, h, j: (bb, h // group, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bb, h, j: (bb, h // group, j, 0)),
+            pl.BlockSpec((1, 1, sq, d), lambda bb, h, j: (bb, h, 0, 0)),
+            pl.BlockSpec((1, 1, sq), lambda bb, h, j: (bb, h, 0)),
+            pl.BlockSpec((1, 1, sq), lambda bb, h, j: (bb, h, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), lambda bb, h, j: (bb, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bb, h, j: (bb, h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, sk, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, hq, sk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk = dk_h.reshape(b, hkv, group, sk, d).sum(axis=2).astype(k.dtype)
+    dv = dv_h.reshape(b, hkv, group, sk, d).sum(axis=2).astype(v.dtype)
+    return dq, dk, dv
+
+
+# -- differentiable wrapper ---------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_diff(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, _ = _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
+                             interpret)
+    return o
+
+
+def _flash_diff_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, lse = _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
+                               interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_diff_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, o, lse = res
+    return _flash_bwd_pallas(q, k, v, o, lse, g, causal, scale, block_q,
+                             block_k, interpret)
 
 
 _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "sm_scale", "use_pallas",
-                                             "block_q", "block_k"))
+                                             "block_q", "block_k", "interpret"))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = True, sm_scale: Optional[float] = None,
                     use_pallas: Optional[bool] = None,
-                    block_q: int = 128, block_k: int = 128) -> jax.Array:
-    """Multi-head attention with GQA. Shapes: q (B,Hq,S,D), k/v (B,Hkv,S,D)."""
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool = False) -> jax.Array:
+    """Multi-head attention with GQA. Shapes: q (B,Hq,S,D), k/v (B,Hkv,S,D).
+    ``interpret=True`` forces the Pallas kernels through the interpreter
+    (CPU-testable path for the exact kernel code)."""
     b, hq, sq, d = q.shape
     _, hkv, sk, _ = k.shape
     if hq % hkv != 0:
         raise ValueError(f"Hq={hq} not a multiple of Hkv={hkv}")
     scale = sm_scale if sm_scale is not None else d ** -0.5
-    if (not _use_pallas(use_pallas) or sq % block_q != 0 or sk % block_k != 0
-            or sq < block_q):
+    pallas_ok = (_use_pallas(use_pallas) or interpret) and \
+        sq % block_q == 0 and sk % block_k == 0 and sq >= block_q
+    if not pallas_ok:
         return _attention_xla(q, k, v, causal=causal, sm_scale=scale)
-    return _flash_diff(q, k, v, causal, scale, block_q, block_k)
+    return _flash_diff(q, k, v, causal, scale, block_q, block_k, interpret)

@@ -94,32 +94,89 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-4, atol=2e-4)
 
     def test_kernel_interpret_mode_matches(self):
-        """Run the actual Pallas kernel in interpreter mode on CPU."""
-        import functools
-        from jax.experimental import pallas as pl
-        from k8s_runpod_kubelet_tpu.ops.attention import _flash_kernel
-        b, hq, hkv, s, d, bq, bk = 1, 4, 2, 256, 32, 128, 128
+        """Run the actual Pallas forward kernel in interpreter mode on CPU,
+        checking both the output and the row log-sum-exp it emits."""
+        from k8s_runpod_kubelet_tpu.ops.attention import _flash_fwd_pallas
+        b, hq, hkv, s, d = 1, 4, 2, 256, 32
         ks = jax.random.split(jax.random.PRNGKey(1), 3)
         q = jax.random.normal(ks[0], (b, hq, s, d))
         k = jax.random.normal(ks[1], (b, hkv, s, d))
         v = jax.random.normal(ks[2], (b, hkv, s, d))
-        group = hq // hkv
-        kernel = functools.partial(_flash_kernel, block_q=bq, block_k=bk,
-                                   seq_k=s, causal=True, sm_scale=d ** -0.5)
-        out = pl.pallas_call(
-            kernel,
-            grid=(b, hq, s // bq),
-            in_specs=[
-                pl.BlockSpec((1, 1, bq, d), lambda bb, h, i: (bb, h, i, 0)),
-                pl.BlockSpec((1, 1, s, d), lambda bb, h, i: (bb, h // group, 0, 0)),
-                pl.BlockSpec((1, 1, s, d), lambda bb, h, i: (bb, h // group, 0, 0)),
-            ],
-            out_specs=pl.BlockSpec((1, 1, bq, d), lambda bb, h, i: (bb, h, i, 0)),
-            out_shape=jax.ShapeDtypeStruct((b, hq, s, d), q.dtype),
-            interpret=True,
-        )(q, k, v)
+        out, lse = _flash_fwd_pallas(q, k, v, causal=True, scale=d ** -0.5,
+                                     block_q=128, block_k=128, interpret=True)
         ref = naive_attention(q, k, v, causal=True)
         np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
+        # reference LSE from the naive score matrix
+        kk = np.repeat(np.asarray(k), hq // hkv, axis=1)
+        sc = np.einsum("bhqd,bhkd->bhqk", np.asarray(q, np.float64),
+                       kk.astype(np.float64)) / np.sqrt(d)
+        sc = np.where(np.tril(np.ones((s, s), bool)), sc, -1e30)
+        ref_lse = np.log(np.exp(sc - sc.max(-1, keepdims=True))
+                         .sum(-1)) + sc.max(-1)
+        np.testing.assert_allclose(np.asarray(lse), ref_lse, rtol=1e-4,
+                                   atol=1e-4)
+
+
+class TestFlashAttentionBackward:
+    """The Pallas fwd+bwd kernels (interpret mode = exact kernel code on CPU)
+    against jax.grad through the XLA reference path."""
+
+    @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.parametrize("hq,hkv", [(4, 4), (8, 2)])
+    def test_grads_match_reference(self, causal, hq, hkv):
+        ks = jax.random.split(jax.random.PRNGKey(3), 4)
+        b, s, d = 2, 256, 32
+        q = jax.random.normal(ks[0], (b, hq, s, d))
+        k = jax.random.normal(ks[1], (b, hkv, s, d))
+        v = jax.random.normal(ks[2], (b, hkv, s, d))
+        g = jax.random.normal(ks[3], (b, hq, s, d))
+
+        def loss_kernel(q, k, v):
+            o = flash_attention(q, k, v, causal=causal, interpret=True,
+                                block_q=128, block_k=128)
+            return jnp.sum(o * g)
+
+        def loss_ref(q, k, v):
+            o = _attention_xla(q, k, v, causal=causal, sm_scale=d ** -0.5)
+            return jnp.sum(o * g)
+
+        got = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+        ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b_ in zip("qkv", got, ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=2e-3, atol=2e-3,
+                                       err_msg=f"d{name} mismatch")
+
+    def test_forward_lse_path_matches(self):
+        # the interpret path (kernel fwd with LSE output) must equal XLA
+        ks = jax.random.split(jax.random.PRNGKey(4), 3)
+        q = jax.random.normal(ks[0], (1, 4, 256, 32))
+        k = jax.random.normal(ks[1], (1, 2, 256, 32))
+        v = jax.random.normal(ks[2], (1, 2, 256, 32))
+        got = flash_attention(q, k, v, causal=True, interpret=True)
+        ref = naive_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-4, atol=2e-4)
+
+    def test_value_and_grad_through_model_loss(self):
+        # end-to-end: CE loss over the kernel path vs the XLA path
+        ks = jax.random.split(jax.random.PRNGKey(5), 3)
+        q = jax.random.normal(ks[0], (1, 2, 128, 16))
+        k = jax.random.normal(ks[1], (1, 2, 128, 16))
+        v = jax.random.normal(ks[2], (1, 2, 128, 16))
+
+        def f(use_kernel):
+            def loss(q):
+                o = flash_attention(q, k, v, causal=True,
+                                    interpret=use_kernel,
+                                    use_pallas=use_kernel,
+                                    block_q=64, block_k=64)
+                return jnp.mean(jax.nn.log_softmax(o.reshape(128, -1)) ** 2)
+            return jax.value_and_grad(loss)(q)
+
+        (l_a, g_a), (l_b, g_b) = f(True), f(False)
+        assert l_a == pytest.approx(float(l_b), rel=1e-4)
+        np.testing.assert_allclose(np.asarray(g_a), np.asarray(g_b),
+                                   rtol=2e-3, atol=2e-3)
 
 
 class TestRingAttention:
