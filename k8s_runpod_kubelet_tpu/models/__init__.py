@@ -10,12 +10,12 @@
 """
 
 from .llama import (LlamaConfig, LlamaModel, llama3_8b, llama3_70b, gemma_7b,
-                    mixtral_8x7b, tiny_llama, tiny_moe, init_params,
+                    mixtral_8x7b, qwen2_7b, tiny_llama, tiny_moe, init_params,
                     param_logical_axes)
 from .mnist import MnistCNN, mnist_config
 from .moe import moe_mlp, moe_mlp_dense_reference, moe_capacity
 
 __all__ = ["LlamaConfig", "LlamaModel", "llama3_8b", "llama3_70b", "gemma_7b",
-           "mixtral_8x7b", "tiny_llama", "tiny_moe", "init_params",
+           "mixtral_8x7b", "qwen2_7b", "tiny_llama", "tiny_moe", "init_params",
            "param_logical_axes", "MnistCNN", "mnist_config", "moe_mlp",
            "moe_mlp_dense_reference", "moe_capacity"]

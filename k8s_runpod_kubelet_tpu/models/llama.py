@@ -54,6 +54,7 @@ class LlamaConfig:
     embed_scale: bool = False           # scale embeddings by sqrt(embed_dim) (Gemma)
     logit_softcap: Optional[float] = None  # tanh soft cap on lm-head logits (Gemma-2)
     norm_zero_centered: bool = False    # RMSNorm weight stored as w, applied as (1+w) (Gemma)
+    qkv_bias: bool = False              # bias on q/k/v projections (Qwen2)
     # sparse MoE (Mixtral family): n_experts=0 means dense MLP
     n_experts: int = 0
     n_experts_per_tok: int = 2
@@ -80,6 +81,8 @@ class LlamaConfig:
         e, m, l, v = self.embed_dim, self.mlp_dim, self.n_layers, self.vocab_size
         hd = self.head_dim_
         attn = e * hd * (self.n_heads * 2 + self.n_kv_heads * 2)
+        if self.qkv_bias:
+            attn += hd * (self.n_heads + 2 * self.n_kv_heads)
         if self.n_experts:
             mlp = 3 * e * m * self.n_experts + e * self.n_experts  # experts + router
         else:
@@ -120,6 +123,14 @@ def mixtral_8x7b() -> LlamaConfig:
                        n_experts=8, n_experts_per_tok=2)
 
 
+def qwen2_7b() -> LlamaConfig:
+    # Qwen2-7B: Llama-style GQA decoder with biased q/k/v projections.
+    return LlamaConfig(name="qwen2-7b", vocab_size=152064, embed_dim=3584,
+                       n_layers=28, n_heads=28, n_kv_heads=4, mlp_dim=18944,
+                       max_seq_len=32768, rope_theta=1_000_000.0,
+                       norm_eps=1e-6, qkv_bias=True)
+
+
 def tiny_llama(**kw) -> LlamaConfig:
     return dataclasses.replace(LlamaConfig(), **kw)
 
@@ -143,6 +154,10 @@ def param_logical_axes(cfg: LlamaConfig) -> Params:
         "wo": ("layer", "heads", "embed"),
         "mlp_norm": ("layer", "norm"),
     }
+    if cfg.qkv_bias:
+        layer.update({"wq_b": ("layer", "heads"),
+                      "wk_b": ("layer", "kv_heads"),
+                      "wv_b": ("layer", "kv_heads")})
     if cfg.n_experts:
         layer.update({
             "router": ("layer", "embed", "expert"),
@@ -180,6 +195,12 @@ def init_params(cfg: LlamaConfig, key: jax.Array,
             "mlp_norm": (cfg.n_layers, e),
         },
     }
+    if cfg.qkv_bias:
+        shapes["layers"].update({
+            "wq_b": (cfg.n_layers, cfg.n_heads * hd),
+            "wk_b": (cfg.n_layers, cfg.n_kv_heads * hd),
+            "wv_b": (cfg.n_layers, cfg.n_kv_heads * hd),
+        })
     if cfg.n_experts:
         shapes["layers"].update({
             "router": (cfg.n_layers, e, cfg.n_experts),
@@ -211,6 +232,9 @@ def init_params(cfg: LlamaConfig, key: jax.Array,
 
     params = jax.tree_util.tree_unflatten(
         treedef, [make(s, k) for s, k in zip(leaves, keys)])
+    if cfg.qkv_bias:
+        for name in ("wq_b", "wk_b", "wv_b"):
+            params["layers"][name] = jnp.zeros_like(params["layers"][name])
     if mesh is not None:
         axes = param_logical_axes(cfg)
         params = jax.tree_util.tree_map(
@@ -267,13 +291,26 @@ def _head_logits(x: jax.Array, params: Params, cfg: LlamaConfig) -> jax.Array:
     return logits
 
 
+def _qkv(h, lp, cfg: LlamaConfig, b: int, s: int):
+    """q/k/v projections (+ Qwen-style bias when configured), head-split."""
+    hd = cfg.head_dim_
+    q = h @ lp["wq"].astype(cfg.dtype)
+    k = h @ lp["wk"].astype(cfg.dtype)
+    v = h @ lp["wv"].astype(cfg.dtype)
+    if cfg.qkv_bias:
+        q = q + lp["wq_b"].astype(cfg.dtype)
+        k = k + lp["wk_b"].astype(cfg.dtype)
+        v = v + lp["wv_b"].astype(cfg.dtype)
+    return (q.reshape(b, s, cfg.n_heads, hd),
+            k.reshape(b, s, cfg.n_kv_heads, hd),
+            v.reshape(b, s, cfg.n_kv_heads, hd))
+
+
 def _attention_block(x, lp, cfg: LlamaConfig, cos, sin, mesh, positions=None):
     b, s, e = x.shape
     hd = cfg.head_dim_
     h = rms_norm(x, _norm_w(lp["attn_norm"], cfg), cfg.norm_eps)
-    q = (h @ lp["wq"].astype(cfg.dtype)).reshape(b, s, cfg.n_heads, hd)
-    k = (h @ lp["wk"].astype(cfg.dtype)).reshape(b, s, cfg.n_kv_heads, hd)
-    v = (h @ lp["wv"].astype(cfg.dtype)).reshape(b, s, cfg.n_kv_heads, hd)
+    q, k, v = _qkv(h, lp, cfg, b, s)
     q = apply_rope(q, cos, sin, positions)
     k = apply_rope(k, cos, sin, positions)
     q = _constrain(q, mesh, ("batch", "seq", "act_heads", "head_dim"))
@@ -413,9 +450,7 @@ class LlamaModel:
         def block(carry, lp):
             y = carry
             h = rms_norm(y, _norm_w(lp["attn_norm"], cfg), cfg.norm_eps)
-            q = (h @ lp["wq"].astype(cfg.dtype)).reshape(b, s, cfg.n_heads, cfg.head_dim_)
-            k = (h @ lp["wk"].astype(cfg.dtype)).reshape(b, s, cfg.n_kv_heads, cfg.head_dim_)
-            v = (h @ lp["wv"].astype(cfg.dtype)).reshape(b, s, cfg.n_kv_heads, cfg.head_dim_)
+            q, k, v = _qkv(h, lp, cfg, b, s)
             q = apply_rope(q, cos, sin)
             k = apply_rope(k, cos, sin)
             o = flash_attention(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
@@ -461,9 +496,7 @@ class LlamaModel:
             y = carry
             lp, k_cache, v_cache = inputs
             h = rms_norm(y, _norm_w(lp["attn_norm"], cfg), cfg.norm_eps)
-            q = (h @ lp["wq"].astype(cfg.dtype)).reshape(b, 1, cfg.n_heads, cfg.head_dim_)
-            k = (h @ lp["wk"].astype(cfg.dtype)).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim_)
-            v = (h @ lp["wv"].astype(cfg.dtype)).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim_)
+            q, k, v = _qkv(h, lp, cfg, b, 1)
             q = apply_rope(q, cos, sin, positions)
             k = apply_rope(k, cos, sin, positions)
             # per-slot scatter at each slot's own index; frozen slots keep

@@ -3,6 +3,9 @@
 The pod command for autoscaled inference. Endpoints:
   POST /generate   {"tokens": [...], "max_new_tokens": N, "temperature": T}
                    -> {"tokens": [...], "rid": ..., "latency_s": ...}
+                   with "stream": true -> chunked NDJSON: one {"token": N}
+                   line per decoded token, then the final result object
+                   (JetStream-style streamed decode)
   GET  /metrics    Prometheus text incl. tpu_serving_queue_depth — the HPA
                    signal (scale on queue depth, BASELINE.json config 5)
   GET  /healthz    liveness
@@ -26,6 +29,10 @@ log = logging.getLogger("serve-main")
 class _Handler(BaseHTTPRequestHandler):
     engine = None  # bound below
     request_timeout_s = 120.0
+    # chunked transfer framing is an HTTP/1.1 construct; 1.0 clients would
+    # read raw chunk framing as the body (non-stream responses all send
+    # Content-Length, so keep-alive stays correct)
+    protocol_version = "HTTP/1.1"
 
     def log_message(self, *a):
         pass
@@ -61,6 +68,8 @@ class _Handler(BaseHTTPRequestHandler):
                 raise ValueError("tokens must be a list of ints")
         except (json.JSONDecodeError, KeyError, ValueError, TypeError) as e:
             return self._send(400, {"error": f"bad request: {e}"})
+        if req.get("stream"):
+            return self._generate_stream(tokens, req)
         fut = self.engine.submit(tokens, req.get("max_new_tokens"),
                                  req.get("temperature"))
         try:
@@ -70,6 +79,55 @@ class _Handler(BaseHTTPRequestHandler):
         except ValueError as e:
             return self._send(400, {"error": str(e)})
         self._send(200, out)
+
+    def _generate_stream(self, tokens: list, req: dict):
+        """Chunked NDJSON: engine thread pushes tokens into a queue, this
+        handler thread drains it to the socket. A broken pipe propagates back
+        into the engine's next on_token call, which cancels the request."""
+        import queue as _q
+        q: "_q.Queue" = _q.Queue()
+        dead = threading.Event()
+
+        def on_token(t):
+            if dead.is_set():  # client gone: raising cancels in the engine
+                raise ConnectionError("stream client disconnected")
+            q.put(("tok", t))
+
+        fut = self.engine.submit(tokens, req.get("max_new_tokens"),
+                                 req.get("temperature"), on_token=on_token)
+        if fut.done() and fut.exception() is not None:
+            return self._send(400, {"error": str(fut.exception())})
+        fut.add_done_callback(lambda f: q.put(("end", f)))
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def chunk(payload: dict):
+            body = (json.dumps(payload) + "\n").encode()
+            self.wfile.write(f"{len(body):x}\r\n".encode() + body + b"\r\n")
+            self.wfile.flush()
+
+        try:
+            while True:
+                try:
+                    kind, val = q.get(timeout=self.request_timeout_s)
+                except _q.Empty:
+                    # stalled decode: tell the client and stop the engine-side
+                    # request (same semantics as the non-stream 504)
+                    dead.set()
+                    chunk({"error": "generation timed out"})
+                    break
+                if kind == "tok":
+                    chunk({"token": val})
+                else:
+                    exc = val.exception()
+                    chunk({"error": str(exc)} if exc else val.result())
+                    break
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionError, OSError):
+            dead.set()  # engine cancels at its next on_token call
 
 
 def serve(engine, port: int = 8000, request_timeout_s: float = 120.0):
@@ -84,7 +142,7 @@ def serve(engine, port: int = 8000, request_timeout_s: float = 120.0):
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="gemma-7b",
-                   choices=["gemma-7b", "llama3-8b", "mixtral-8x7b",
+                   choices=["gemma-7b", "llama3-8b", "mixtral-8x7b", "qwen2-7b",
                             "tiny", "tiny-moe"])
     p.add_argument("--slots", type=int, default=8)
     p.add_argument("--port", type=int, default=8000)
@@ -94,11 +152,11 @@ def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO)
 
     import jax
-    from ..models import gemma_7b, llama3_8b, mixtral_8x7b, tiny_llama, tiny_moe, init_params
+    from ..models import gemma_7b, llama3_8b, mixtral_8x7b, qwen2_7b, tiny_llama, tiny_moe, init_params
     from .serving import ServingConfig, ServingEngine
 
     cfg = {"gemma-7b": gemma_7b, "llama3-8b": llama3_8b,
-           "mixtral-8x7b": mixtral_8x7b, "tiny": tiny_llama,
+           "mixtral-8x7b": mixtral_8x7b, "qwen2-7b": qwen2_7b, "tiny": tiny_llama,
            "tiny-moe": tiny_moe}[args.model]()
     log.info("loading %s (%.2fB params) on %s", cfg.name,
              cfg.param_count / 1e9, jax.default_backend())

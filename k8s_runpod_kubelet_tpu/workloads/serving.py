@@ -56,6 +56,9 @@ class Request:
     future: Future
     submitted_at: float
     temperature: float
+    # streaming: called with each generated token id, from the engine thread.
+    # A raising callback (client gone) cancels the request at the next token.
+    on_token: Optional[Any] = None
 
 
 @dataclasses.dataclass
@@ -106,8 +109,10 @@ class ServingEngine:
         self._thread.join(timeout=10)
 
     def submit(self, prompt: list[int], max_new_tokens: Optional[int] = None,
-               temperature: Optional[float] = None) -> Future:
-        """Enqueue a generation request; resolves to {tokens, latency_s, rid}."""
+               temperature: Optional[float] = None,
+               on_token=None) -> Future:
+        """Enqueue a generation request; resolves to {tokens, latency_s, rid}.
+        ``on_token(tok)`` streams each generated token id as it decodes."""
         if not prompt:
             f: Future = Future()
             f.set_exception(ValueError("empty prompt"))
@@ -139,7 +144,7 @@ class ServingEngine:
                                          self.sc.cache_len - len(prompt)),
                       rid=uuid.uuid4().hex[:8], future=Future(),
                       submitted_at=time.perf_counter(),
-                      temperature=float(temperature))
+                      temperature=float(temperature), on_token=on_token)
         self._queue.put(req)
         self.metrics.set_gauge("tpu_serving_queue_depth", self._queue.qsize())
         return req.future
@@ -223,6 +228,7 @@ class ServingEngine:
             slot.generated = [int(first)]
             slot.remaining = req.max_new_tokens - 1
             slot.last_token = int(first)
+            self._emit(slot, int(first))
             admitted = True
             self.metrics.incr("tpu_serving_admitted")
             if self._finished(slot):
@@ -244,6 +250,7 @@ class ServingEngine:
             slot.generated.append(tok)
             slot.last_token = tok
             slot.remaining -= 1
+            self._emit(slot, tok)
             self.total_generated += 1
             if self._finished(slot):
                 self._complete(slot_id, slot)
@@ -265,6 +272,20 @@ class ServingEngine:
         sampled = jax.random.categorical(sub, logits / t, axis=-1)
         use_sampled = jnp.asarray([tt > 0.0 for tt in temps])
         return jnp.where(use_sampled, sampled, greedy)
+
+    def _emit(self, slot: _Slot, tok: int):
+        """Stream a token to the requester; a raising callback means the
+        client is gone — finish the request now with what it has."""
+        req = slot.request
+        if req is None or req.on_token is None:
+            return
+        try:
+            req.on_token(tok)
+        except Exception:  # noqa: BLE001 — client callback, not engine state
+            log.info("stream callback failed for %s; cancelling", req.rid)
+            req.on_token = None
+            slot.remaining = 0
+            self.metrics.incr("tpu_serving_stream_cancelled")
 
     def _finished(self, slot: _Slot) -> bool:
         return (slot.remaining <= 0

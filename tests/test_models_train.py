@@ -147,6 +147,71 @@ class TestGemmaFamily:
                                        rtol=2e-3, atol=2e-3)
 
 
+class TestQwenFamily:
+    """Qwen2 architectural feature: biased q/k/v projections."""
+
+    QCFG = tiny_llama(name="tiny-qwen", vocab_size=128, embed_dim=64,
+                      n_layers=2, n_heads=4, n_kv_heads=2, mlp_dim=128,
+                      max_seq_len=128, qkv_bias=True,
+                      dtype=jnp.float32, param_dtype=jnp.float32)
+
+    def test_real_config_is_faithful(self):
+        from k8s_runpod_kubelet_tpu.models import qwen2_7b
+        cfg = qwen2_7b()
+        assert cfg.qkv_bias and cfg.n_kv_heads == 4 and cfg.mlp_dim == 18944
+        # param count within 2% of the published 7.6B
+        assert abs(cfg.param_count - 7.62e9) / 7.62e9 < 0.02
+
+    def test_bias_params_exist_and_init_zero(self):
+        params = init_params(self.QCFG, jax.random.PRNGKey(0))
+        for name in ("wq_b", "wk_b", "wv_b"):
+            np.testing.assert_array_equal(np.asarray(params["layers"][name]), 0.0)
+        axes = param_logical_axes(self.QCFG)
+        assert axes["layers"]["wq_b"] == ("layer", "heads")
+
+    def test_zero_bias_matches_biasless_model(self):
+        import dataclasses as dc
+        params = init_params(self.QCFG, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 128)
+        with_bias = LlamaModel(self.QCFG).forward(params, tokens)
+        plain = {k: v for k, v in params.items()}
+        plain["layers"] = {k: v for k, v in params["layers"].items()
+                           if not k.endswith("_b")}
+        without = LlamaModel(dc.replace(self.QCFG, qkv_bias=False)).forward(
+            plain, tokens)
+        np.testing.assert_allclose(np.asarray(with_bias), np.asarray(without),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_nonzero_bias_changes_output_and_decode_matches(self):
+        params = init_params(self.QCFG, jax.random.PRNGKey(0))
+        zeroed = LlamaModel(self.QCFG).forward(
+            params, jnp.arange(8, dtype=jnp.int32)[None])
+        params["layers"]["wq_b"] = jnp.full_like(params["layers"]["wq_b"], 0.3)
+        params["layers"]["wk_b"] = jnp.full_like(params["layers"]["wk_b"], -0.2)
+        params["layers"]["wv_b"] = jnp.full_like(params["layers"]["wv_b"], 0.1)
+        model = LlamaModel(self.QCFG)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, 128)
+        full_logits = model.forward(params, tokens)
+        assert not np.allclose(np.asarray(full_logits[:1, :8]), np.asarray(zeroed))
+        # serving path honors the bias
+        cache = model.init_cache(batch=2, max_len=32)
+        last, cache = model.prefill(params, tokens[:, :8], cache)
+        np.testing.assert_allclose(np.asarray(last),
+                                   np.asarray(full_logits[:, 7]),
+                                   rtol=2e-3, atol=2e-3)
+        for i in range(8, 12):
+            logits, cache = model.decode_step(params, tokens[:, i], cache)
+            np.testing.assert_allclose(np.asarray(logits),
+                                       np.asarray(full_logits[:, i]),
+                                       rtol=2e-3, atol=2e-3)
+
+    def test_trains_on_mesh(self):
+        mesh = make_mesh(MeshConfig(data=2, fsdp=2, tensor=2))
+        tc = TrainConfig(batch_size=4, seq_len=16, steps=2, warmup_steps=1)
+        out = Trainer(self.QCFG, tc, mesh=mesh).run(steps=2)
+        assert np.isfinite(out["final_loss"])
+
+
 class TestTraining:
     def test_loss_decreases_on_memorization(self):
         tc = TrainConfig(learning_rate=1e-2, warmup_steps=2, batch_size=2,

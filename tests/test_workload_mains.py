@@ -77,6 +77,42 @@ class TestServeHttp:
         body = urllib.request.urlopen(f"{base}/metrics").read().decode()
         assert "tpu_serving_queue_depth" in body
 
+    def test_streaming_ndjson(self, server):
+        base, _ = server
+        req = urllib.request.Request(
+            f"{base}/generate",
+            data=json.dumps({"tokens": [5, 9], "max_new_tokens": 4,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        resp = urllib.request.urlopen(req, timeout=60)
+        assert resp.headers["Content-Type"] == "application/x-ndjson"
+        lines = [json.loads(l) for l in resp.read().decode().splitlines() if l]
+        streamed = [l["token"] for l in lines if "token" in l]
+        final = lines[-1]
+        assert streamed == final["tokens"] and len(streamed) == 4
+
+    def test_streaming_callback_engine_level(self, server):
+        _, engine = server
+        got = []
+        fut = engine.submit([3, 7, 1], max_new_tokens=5,
+                            on_token=got.append)
+        out = fut.result(timeout=60)
+        assert got == out["tokens"] and len(got) == 5
+
+    def test_streaming_callback_raise_cancels(self, server):
+        _, engine = server
+
+        def boom(tok):
+            raise ConnectionError("client gone")
+
+        fut = engine.submit([3, 7, 1], max_new_tokens=50, on_token=boom)
+        out = fut.result(timeout=60)
+        # cancelled at the first emitted token: far fewer than requested
+        assert 1 <= len(out["tokens"]) < 50
+        # the engine must still serve subsequent requests
+        again = engine.submit([2, 4], max_new_tokens=3).result(timeout=60)
+        assert len(again["tokens"]) == 3
+
     def test_bad_requests_400(self, server):
         base, _ = server
         for payload in [b"not json", b'{"tokens": "nope"}', b'{"tokens": [1.5]}',
