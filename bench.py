@@ -5,29 +5,58 @@ Prints ONE JSON line:
    "unit": "tok/s/chip", "vs_baseline": R, ...extras}
 
 The reference publishes no performance numbers (BASELINE.md: "None exist"), so
-vs_baseline is measured against the documented round-1 target in
-_TARGET_TOK_S_PER_CHIP — a model-flops roofline estimate for the bench config
-at 40% MFU on the detected chip generation. Beating 1.0 means beating that
-roofline fraction.
+vs_baseline is measured against the documented target in _TARGET_MFU — a
+model-flops roofline estimate for the bench config at 40% MFU on the detected
+chip generation. Beating 1.0 means beating that roofline fraction.
+
+Robustness (round-2 fix): the default invocation is an *orchestrator* that
+imports no jax.  It runs the real bench in a child process (``--run``) with a
+hard timeout, retries TPU-backend initialization (the axon TPU tunnel can be
+slow or transiently unavailable), and falls back to a CPU smoke run if the TPU
+never comes up — so this script always emits exactly one parseable JSON line,
+never a bare traceback.
 
 Usage:
-  python bench.py            # full run (TPU: real numbers; first compile ~30s)
+  python bench.py            # full run (TPU: real numbers; first compile ~40s)
   python bench.py --quick    # tiny config, CPU-friendly smoke (seconds)
+  python bench.py --run      # internal: run the bench in-process
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
 
 # bf16 peak TFLOP/s per chip by generation (public spec sheets)
 _PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0,
                 "cpu": 0.1}
 _TARGET_MFU = 0.40
+
+_TPU_ATTEMPTS = 3          # orchestrator: tries at the TPU backend
+_TPU_TIMEOUT_S = 1500      # per attempt: first compile can take minutes
+_TPU_RETRY_SLEEP_S = 20
+_CPU_TIMEOUT_S = 600
+
+
+# --------------------------------------------------------------------------
+# child: the actual benchmark, run in-process
+# --------------------------------------------------------------------------
+
+def _force_platform_from_env() -> None:
+    """Honor JAX_PLATFORMS=cpu even on images (axon) whose sitecustomize
+    registers a TPU platform before env vars are read."""
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        import jax
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass  # backend already initialized; use what we have
 
 
 def detect_generation() -> str:
@@ -38,20 +67,37 @@ def detect_generation() -> str:
     for gen in ("v6e", "v5p", "v4"):
         if gen in kind:
             return gen
-    if "v5" in kind:  # v5 lite
-        return "v5e"
-    return "v5e"
+    return "v5e"  # v5 lite and unknown-v5 default
 
 
-def main():
-    quick = "--quick" in sys.argv
+def _emit(obj: dict) -> None:
+    print(json.dumps(obj), flush=True)
+
+
+def run_bench(quick: bool, expect_tpu: bool = False) -> dict:
+    _force_platform_from_env()
     import jax
-    import jax.numpy as jnp
+
+    # Fail fast (with a parseable error) instead of a traceback if the
+    # backend cannot initialize — the orchestrator retries / falls back.
+    try:
+        n_chips = jax.device_count()
+        backend = jax.default_backend()
+    except Exception as e:  # noqa: BLE001 - any backend-init failure
+        return {"metric": "train_tokens_per_sec_per_chip", "value": None,
+                "unit": "tok/s/chip", "vs_baseline": None,
+                "error": f"backend-init: {type(e).__name__}: {e}"[:500]}
+    if expect_tpu and backend != "tpu":
+        # jax silently fell back to CPU — don't burn an hour running the
+        # full config there; let the orchestrator take the quick CPU path.
+        return {"metric": "train_tokens_per_sec_per_chip", "value": None,
+                "unit": "tok/s/chip", "vs_baseline": None,
+                "error": f"expected tpu backend, got {backend!r}"}
+
     from __graft_entry__ import _bench_config
     from k8s_runpod_kubelet_tpu.workloads.train import (TrainConfig, Trainer,
                                                         synthetic_batches)
 
-    n_chips = jax.device_count()
     gen = detect_generation()
     cfg = _bench_config(tiny=quick)
     if quick:
@@ -85,7 +131,7 @@ def main():
     target_tok_s_chip = _TARGET_MFU * _PEAK_TFLOPS[gen] * 1e12 / (6.0 * n_params)
     vs_baseline = tok_s_chip / target_tok_s_chip if target_tok_s_chip else 0.0
 
-    print(json.dumps({
+    return {
         "metric": "train_tokens_per_sec_per_chip",
         "value": round(tok_s_chip, 1),
         "unit": "tok/s/chip",
@@ -97,8 +143,90 @@ def main():
         "mfu": round(mfu, 3),
         "seq_len": tc.seq_len,
         "global_batch": tc.batch_size,
-    }))
+    }
+
+
+# --------------------------------------------------------------------------
+# parent: orchestrator (imports no jax; always emits one JSON line)
+# --------------------------------------------------------------------------
+
+def _last_json_line(text: str) -> dict | None:
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def _run_child(quick: bool, platform: str | None, timeout_s: int):
+    """Returns (parsed_json_or_None, rc, tail)."""
+    env = dict(os.environ)
+    cmd = [sys.executable, os.path.abspath(__file__), "--run"]
+    if platform is not None:
+        env["JAX_PLATFORMS"] = platform
+    else:
+        cmd.append("--expect-tpu")  # fail fast if jax falls back to CPU
+    if quick:
+        cmd.append("--quick")
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s, env=env, cwd=_HERE)
+        out = proc.stdout or ""
+        parsed = _last_json_line(out)
+        tail = ((proc.stderr or "")[-800:]) if parsed is None else ""
+        return parsed, proc.returncode, tail
+    except subprocess.TimeoutExpired as e:
+        partial = e.stderr or b""
+        if isinstance(partial, bytes):
+            partial = partial.decode(errors="replace")
+        return None, -1, f"timeout after {timeout_s}s; stderr tail: {partial[-800:]}"
+    except Exception as e:  # noqa: BLE001
+        return None, -2, f"{type(e).__name__}: {e}"
+
+
+def orchestrate(quick: bool) -> int:
+    errors = []
+    # 1) TPU (default platform) with retries — the tunnel can be slow.
+    for attempt in range(1, _TPU_ATTEMPTS + 1):
+        parsed, rc, tail = _run_child(quick, platform=None,
+                                      timeout_s=_TPU_TIMEOUT_S)
+        if parsed is not None and parsed.get("value") is not None:
+            _emit(parsed)
+            return 0
+        err = (parsed or {}).get("error") or tail or f"rc={rc}"
+        errors.append(f"tpu[{attempt}]: {err}")
+        print(f"[bench] TPU attempt {attempt}/{_TPU_ATTEMPTS} failed: {err}",
+              file=sys.stderr, flush=True)
+        if attempt < _TPU_ATTEMPTS:
+            time.sleep(_TPU_RETRY_SLEEP_S)
+
+    # 2) CPU fallback: quick config so it finishes in seconds-to-minutes.
+    parsed, rc, tail = _run_child(quick=True, platform="cpu",
+                                  timeout_s=_CPU_TIMEOUT_S)
+    if parsed is not None and parsed.get("value") is not None:
+        parsed["fallback"] = "cpu"
+        parsed["tpu_errors"] = errors[-2:]
+        _emit(parsed)
+        return 0
+
+    errors.append(f"cpu: {(parsed or {}).get('error') or tail or f'rc={rc}'}")
+    _emit({"metric": "train_tokens_per_sec_per_chip", "value": None,
+           "unit": "tok/s/chip", "vs_baseline": None,
+           "error": "; ".join(errors)[:1500]})
+    return 1
+
+
+def main() -> int:
+    quick = "--quick" in sys.argv
+    if "--run" in sys.argv:
+        result = run_bench(quick, expect_tpu="--expect-tpu" in sys.argv)
+        _emit(result)
+        return 0 if result.get("value") is not None else 1
+    return orchestrate(quick)
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

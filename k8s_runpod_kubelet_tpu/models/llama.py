@@ -274,8 +274,21 @@ def _activation(cfg: LlamaConfig):
     raise ValueError(f"unknown mlp_activation {cfg.mlp_activation!r}")
 
 
-def _embed(params: Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
-    x = params["tok_embed"].astype(cfg.dtype)[tokens]
+def _embed(params: Params, tokens: jax.Array, cfg: LlamaConfig,
+           mesh: Optional[Mesh] = None) -> jax.Array:
+    table = params["tok_embed"].astype(cfg.dtype)
+    if mesh is not None and mesh.shape.get(AXES.TENSOR, 1) > 1:
+        # The table's vocab dim is tensor-sharded (sharding.py rules); a
+        # gather from it forces the SPMD partitioner into involuntary full
+        # rematerialization (replicate-then-reshard, spmd_partitioner.cc
+        # warning seen in MULTICHIP_r01).  A one-hot contraction instead
+        # rides the MXU and turns the vocab-sharded axis into a clean psum
+        # over `tensor` — XLA fuses the iota/compare into the matmul loop.
+        tokens = _constrain(tokens, mesh, ("batch", "seq"))
+        one_hot = jax.nn.one_hot(tokens, cfg.vocab_size, dtype=cfg.dtype)
+        x = one_hot @ table
+    else:
+        x = table[tokens]
     if cfg.embed_scale:
         x = x * jnp.asarray(cfg.embed_dim ** 0.5, cfg.dtype)
     return x
@@ -364,7 +377,7 @@ class LlamaModel:
         cfg, mesh = self.cfg, self.mesh
         cos, sin = rope_frequencies(cfg.head_dim_, cfg.max_seq_len,
                                     cfg.rope_theta, cfg.rope_scaling)
-        x = _embed(params, tokens, cfg)
+        x = _embed(params, tokens, cfg, mesh)
         x = _constrain(x, mesh, ("batch", "seq", "act_embed"))
 
         n_stages = pipeline_stages(mesh)
@@ -444,7 +457,7 @@ class LlamaModel:
             true_length = jnp.full((b,), s, jnp.int32)
         cos, sin = rope_frequencies(cfg.head_dim_, cfg.max_seq_len,
                                     cfg.rope_theta, cfg.rope_scaling)
-        x = _embed(params, tokens, cfg)
+        x = _embed(params, tokens, cfg, self.mesh)
 
         # one scan over layers that also collects the K/V it computes
         def block(carry, lp):
@@ -485,7 +498,7 @@ class LlamaModel:
             active = jnp.ones((b,), bool)
         cos, sin = rope_frequencies(cfg.head_dim_, cfg.max_seq_len,
                                     cfg.rope_theta, cfg.rope_scaling)
-        x = _embed(params, token[:, None], cfg)  # (B,1,E)
+        x = _embed(params, token[:, None], cfg, self.mesh)  # (B,1,E)
         positions = idx[:, None]  # (B,1)
         max_len = cache["k"].shape[2]
         # (B,1,1,L): slot i may attend up to its own index
