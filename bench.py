@@ -20,6 +20,9 @@ Usage:
   python bench.py            # full run (TPU: real numbers; first compile ~40s)
   python bench.py --quick    # tiny config, CPU-friendly smoke (seconds)
   python bench.py --run      # internal: run the bench in-process
+  python bench.py --attn     # flash-attention microbench: Pallas vs XLA at
+                             # S in {2k, 8k} + a 32k Pallas-only run (one
+                             # JSON line per config; needs a TPU)
 """
 
 from __future__ import annotations
@@ -146,6 +149,66 @@ def run_bench(quick: bool, expect_tpu: bool = False) -> dict:
     }
 
 
+def run_attn_bench() -> int:
+    """Flash-attention microbench (VERDICT r1 item 4): Pallas vs XLA,
+    fwd+bwd, llama3-8b head geometry (Hq=32, Hkv=8, D=128), bf16.
+    The XLA path materializes the (S, S) scores so it is only feasible at
+    2k/8k; 32k runs Pallas-only to prove the streamed K/V fits VMEM."""
+    _force_platform_from_env()
+    import jax
+    import jax.numpy as jnp
+    from k8s_runpod_kubelet_tpu.ops.attention import (_attention_xla,
+                                                      flash_attention,
+                                                      tuned_block_sizes)
+
+    if jax.default_backend() != "tpu":
+        _emit({"metric": "flash_attn_speedup", "value": None,
+               "error": f"attn bench needs a TPU, got {jax.default_backend()!r}"})
+        return 1
+
+    b, hq, hkv, d = 1, 32, 8, 128
+    key = jax.random.PRNGKey(0)
+
+    def time_fn(f, *args, iters=20):
+        f(*args)[0].block_until_ready()  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = f(*args)
+        jax.tree_util.tree_leaves(out)[0].block_until_ready()
+        return (time.perf_counter() - t0) / iters
+
+    for s, with_xla in ((2048, True), (8192, True), (32768, False)):
+        ks = jax.random.split(key, 4)
+        q = jax.random.normal(ks[0], (b, hq, s, d), jnp.bfloat16)
+        k = jax.random.normal(ks[1], (b, hkv, s, d), jnp.bfloat16)
+        v = jax.random.normal(ks[2], (b, hkv, s, d), jnp.bfloat16)
+        g = jax.random.normal(ks[3], (b, hq, s, d), jnp.bfloat16)
+
+        def vjp_of(fn):
+            def run(q, k, v):
+                out, pull = jax.vjp(fn, q, k, v)
+                return pull(g)
+            return jax.jit(run)
+
+        pallas_fn = vjp_of(lambda q, k, v: flash_attention(
+            q, k, v, causal=True, use_pallas=True))
+        t_pallas = time_fn(pallas_fn, q, k, v)
+        # causal fwd+bwd model flops: fwd 2 matmuls, bwd 5 -> 3.5x fwd pair
+        flops = 3.5 * 2 * b * hq * s * s * d  # causal halves via /2 below
+        rec = {"metric": f"flash_attn_s{s}", "unit": "ms",
+               "value": round(t_pallas * 1e3, 3),
+               "tflops": round(flops / 2 / t_pallas / 1e12, 1),
+               "blocks": tuned_block_sizes(s, s)}
+        if with_xla:
+            xla_fn = vjp_of(lambda q, k, v: _attention_xla(
+                q, k, v, causal=True, sm_scale=d ** -0.5))
+            t_xla = time_fn(xla_fn, q, k, v)
+            rec["xla_ms"] = round(t_xla * 1e3, 3)
+            rec["speedup_vs_xla"] = round(t_xla / t_pallas, 2)
+        _emit(rec)
+    return 0
+
+
 # --------------------------------------------------------------------------
 # parent: orchestrator (imports no jax; always emits one JSON line)
 # --------------------------------------------------------------------------
@@ -221,6 +284,8 @@ def orchestrate(quick: bool) -> int:
 
 def main() -> int:
     quick = "--quick" in sys.argv
+    if "--attn" in sys.argv:
+        return run_attn_bench()
     if "--run" in sys.argv:
         result = run_bench(quick, expect_tpu="--expect-tpu" in sys.argv)
         _emit(result)

@@ -61,6 +61,17 @@ def parse_flags(argv: list[str]) -> argparse.Namespace:
     p.add_argument("--log-level", dest="log_level", default=None)
     p.add_argument("--provider-config", dest="provider_config", default=None)
     p.add_argument("--os", dest="operating_system", default=None)
+    p.add_argument("--preemption-requeue-limit", dest="preemption_requeue_limit",
+                   type=int, default=None,
+                   help="resubmit a preempted slice this many times before "
+                        "failing the pod (elasticity; default 2)")
+    p.add_argument("--max-provisioning-s", dest="max_provisioning_s",
+                   type=float, default=None,
+                   help="fail a pod whose slice queues longer than this "
+                        "(0 = queue forever)")
+    p.add_argument("--tls-cert-file", dest="tls_cert_file", default=None,
+                   help="serve the kubelet API over TLS with this cert")
+    p.add_argument("--tls-key-file", dest="tls_key_file", default=None)
     return p.parse_args(argv)
 
 
@@ -77,7 +88,10 @@ def build(cfg: config_mod.Config, kube=None, tpu=None, worker_transport=None):
                                      status_interval_s=cfg.node_status_interval_s)
     pod_controller = PodController(kube, provider, cfg.node_name,
                                    resync_interval_s=cfg.reconcile_interval_s)
-    api_server = KubeletApiServer(provider, port=cfg.listen_port)
+    api_server = KubeletApiServer(provider, port=cfg.listen_port,
+                                  tls_cert=cfg.tls_cert_file,
+                                  tls_key=cfg.tls_key_file,
+                                  auth_token=cfg.api_auth_token)
     health = HealthServer(cfg.health_address, ready_func=provider.ping,
                           metrics=metrics)
     return provider, node_controller, pod_controller, api_server, health

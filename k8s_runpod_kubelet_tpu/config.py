@@ -52,13 +52,23 @@ class Config:
     # fail the pod. 0 = forever (QueuedResources legitimately queue for hours;
     # SURVEY.md §7.4 hard-part #3 says don't trip the 15-min ladder on queueing).
     max_provisioning_s: float = 0.0
-    # preemption: resubmit the slice instead of failing the pod, this many times
-    preemption_requeue_limit: int = 0  # 0 = fail pod immediately (Job restarts it)
+    # preemption: resubmit the slice instead of failing the pod, this many
+    # times. Default 2: preemption is the COMMON case on spot/maintenance TPU
+    # capacity (SURVEY.md §5.3), so the headline elasticity feature must be on
+    # out of the box. 0 = fail the pod immediately (its Job restarts it).
+    preemption_requeue_limit: int = 2
 
     # servers
     listen_port: int = 10250
     health_address: str = ":8080"
     metrics_enabled: bool = True
+    # kubelet API security (exposure-model parity: the reference serves :10250
+    # through the virtual-kubelet lib's cert-based server, main.go:217-248).
+    # Our /run endpoint can exec on workers, so production deploys must set
+    # these; empty = plaintext/unauthenticated (dev only).
+    tls_cert_file: str = ""
+    tls_key_file: str = ""
+    api_auth_token: str = ""
 
     # logging
     log_level: str = "info"
@@ -85,6 +95,7 @@ class Config:
 
 
 _ENV_MAP = {
+    "KUBELET_API_TOKEN": "api_auth_token",
     "TPU_API_TOKEN": "tpu_api_token",
     "TPU_API_ENDPOINT": "tpu_api_endpoint",
     "TPU_PROJECT": "project",

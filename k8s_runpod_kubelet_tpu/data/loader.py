@@ -25,6 +25,7 @@ import os
 import subprocess
 import tempfile
 import threading
+import weakref
 from typing import Iterator, Optional
 
 import numpy as np
@@ -135,6 +136,9 @@ class NativeTokenLoader:
                 f"tl_open failed: path={path!r} seq_len={seq_len} "
                 f"batch={batch_size} shard={shard_id}/{num_shards} "
                 "(missing/short file, or shard smaller than one batch?)")
+        # safety net for loaders dropped without close(): otherwise the C++
+        # worker threads, mmap, and fd leak for the process lifetime
+        self._finalizer = weakref.finalize(self, lib.tl_close, self._h)
 
     @property
     def num_tokens(self) -> int:
@@ -160,6 +164,7 @@ class NativeTokenLoader:
 
     def close(self):
         if self._h:
+            self._finalizer.detach()
             self._lib.tl_close(self._h)
             self._h = None
 
@@ -233,8 +238,9 @@ class PyTokenLoader:
         while m < n:
             m <<= 1
         epoch, i = divmod(gs, n)
-        a = _splitmix64(self.seed ^ ((epoch * 2654435761) & _MASK64)) | 1
-        b = _splitmix64((self.seed + epoch + 0x51ED270B) & _MASK64)
+        sh = (self.shard_id * 0x9E3779B97F4A7C15) & _MASK64
+        a = _splitmix64(self.seed ^ ((epoch * 2654435761) & _MASK64) ^ sh) | 1
+        b = _splitmix64((self.seed + epoch + 0x51ED270B + sh) & _MASK64)
         w = i
         while True:
             w = ((a * w + b) & _MASK64) & (m - 1)

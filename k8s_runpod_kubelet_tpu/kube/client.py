@@ -53,6 +53,11 @@ class KubeClient:
     def get_pod(self, ns: str, name: str) -> dict: raise NotImplementedError
     def list_pods(self, ns: Optional[str] = None, field_selector: str = "",
                   label_selector: str = "") -> list[dict]: raise NotImplementedError
+    def list_pods_rv(self, ns: Optional[str] = None, field_selector: str = "",
+                     label_selector: str = "") -> tuple[list[dict], str]:
+        """List plus the PodList's resourceVersion — the anchor a subsequent
+        watch starts from (client-go ListWatch semantics)."""
+        raise NotImplementedError
     def create_pod(self, pod: dict) -> dict: raise NotImplementedError
     def update_pod(self, pod: dict) -> dict: raise NotImplementedError
     def patch_pod(self, ns: str, name: str, patch: dict) -> dict: raise NotImplementedError
@@ -60,7 +65,11 @@ class KubeClient:
     def delete_pod(self, ns: str, name: str,
                    grace_period_s: Optional[int] = None) -> None: raise NotImplementedError
     def watch_pods(self, field_selector: str = "", label_selector: str = "",
-                   stop: Optional[threading.Event] = None) -> Iterator[WatchEvent]:
+                   stop: Optional[threading.Event] = None,
+                   resource_version: Optional[str] = None) -> Iterator[WatchEvent]:
+        """``resource_version=None`` = fresh watch (server picks the start;
+        callers should list first). A set value resumes after that RV; a
+        compacted/too-old RV raises KubeApiError(status=410) — relist."""
         raise NotImplementedError
 
     # reads the spec translator needs
@@ -193,9 +202,14 @@ class RealKubeClient(KubeClient):
         return self._request("GET", _pod_path(ns, name))
 
     def list_pods(self, ns=None, field_selector="", label_selector=""):
+        return self.list_pods_rv(ns, field_selector, label_selector)[0]
+
+    def list_pods_rv(self, ns=None, field_selector="", label_selector=""):
         base = _pod_path(ns) if ns else "/api/v1/pods"
         q = self._selector_query(field_selector, label_selector)
-        return self._request("GET", base + q).get("items", [])
+        body = self._request("GET", base + q)
+        return (body.get("items", []),
+                body.get("metadata", {}).get("resourceVersion", ""))
 
     def create_pod(self, pod):
         ns = pod["metadata"].get("namespace", "default")
@@ -223,12 +237,16 @@ class RealKubeClient(KubeClient):
             if not e.is_not_found:
                 raise
 
-    def watch_pods(self, field_selector="", label_selector="", stop=None):
+    def watch_pods(self, field_selector="", label_selector="", stop=None,
+                   resource_version=None):
         """Streaming watch; reconnects are the caller's job (node/pod_controller
-        wraps this in a resync loop). Yields WatchEvents until the stream or
-        ``stop`` ends."""
-        q = self._selector_query(field_selector, label_selector,
-                                 extra="watch=true&allowWatchBookmarks=true")
+        tracks the last-seen resourceVersion and resumes from it, relisting on
+        410 Gone — client-go Reflector semantics). Yields WatchEvents until the
+        stream or ``stop`` ends."""
+        extra = "watch=true&allowWatchBookmarks=true"
+        if resource_version:
+            extra += "&resourceVersion=" + urllib.parse.quote(resource_version)
+        q = self._selector_query(field_selector, label_selector, extra=extra)
         conn = self._conn(timeout_s=330)  # server closes watches ~5min; outlive it
         try:
             conn.request("GET", "/api/v1/pods" + q, headers=self._headers())
@@ -246,8 +264,16 @@ class RealKubeClient(KubeClient):
                     if not line.strip():
                         continue
                     ev = json.loads(line)
-                    yield WatchEvent(type=ev.get("type", "ERROR"),
-                                     object=ev.get("object", {}))
+                    ev_type = ev.get("type", "ERROR")
+                    obj = ev.get("object", {})
+                    if ev_type == "ERROR":
+                        # the server reports expired RVs as an in-stream
+                        # Status with code 410, not an HTTP error
+                        code = obj.get("code", 0)
+                        raise KubeApiError(
+                            f"watch pods: {obj.get('message', 'stream error')}",
+                            status=code or 500)
+                    yield WatchEvent(type=ev_type, object=obj)
         finally:
             conn.close()
 

@@ -38,6 +38,9 @@ class FakeKubeClient(KubeClient):
         self.events: list[dict] = []
         self._rv = 0
         self._watchers: list[_Watcher] = []
+        # pod watch history for resourceVersion resume: (rv, type, snapshot)
+        self._pod_history: list[tuple[int, str, dict]] = []
+        self._compacted_rv = 0  # RVs <= this are gone (watch from them -> 410)
         # fault injection
         self.fail_next: dict[str, KubeApiError] = {}  # op name -> error (one-shot)
 
@@ -76,6 +79,8 @@ class FakeKubeClient(KubeClient):
 
     def _notify(self, ev_type: str, pod: dict):
         snapshot = ko.deep_copy(pod)
+        rv = int(ko.meta(snapshot).get("resourceVersion", "0") or 0)
+        self._pod_history.append((rv, ev_type, snapshot))
         for w in list(self._watchers):
             if w.stop.is_set():
                 self._watchers.remove(w)
@@ -83,6 +88,25 @@ class FakeKubeClient(KubeClient):
             if (ko.match_field_selector(snapshot, w.field_selector)
                     and ko.match_label_selector(snapshot, w.label_selector)):
                 w.q.put(WatchEvent(type=ev_type, object=ko.deep_copy(snapshot)))
+
+    # -- watch fault injection (for continuity tests) --------------------------
+
+    def drop_watches(self):
+        """Terminate every open watch stream, as the API server does every few
+        minutes. Events emitted afterwards land only in the history, so a
+        correct client must resume from its last-seen resourceVersion."""
+        with self.lock:
+            for w in self._watchers:
+                w.q.put(None)
+            self._watchers.clear()
+
+    def compact(self, up_to_rv: Optional[int] = None):
+        """Forget watch history up to ``up_to_rv`` (default: everything so
+        far) — a resume from a compacted RV gets 410 Gone, like etcd."""
+        with self.lock:
+            self._compacted_rv = self._rv if up_to_rv is None else up_to_rv
+            self._pod_history = [h for h in self._pod_history
+                                 if h[0] > self._compacted_rv]
 
     # -- pods ------------------------------------------------------------------
 
@@ -92,6 +116,9 @@ class FakeKubeClient(KubeClient):
             return ko.deep_copy(self._get("pods", ns, name))
 
     def list_pods(self, ns=None, field_selector="", label_selector=""):
+        return self.list_pods_rv(ns, field_selector, label_selector)[0]
+
+    def list_pods_rv(self, ns=None, field_selector="", label_selector=""):
         with self.lock:
             self._maybe_fail("list_pods")
             out = []
@@ -101,7 +128,7 @@ class FakeKubeClient(KubeClient):
                 if (ko.match_field_selector(obj, field_selector)
                         and ko.match_label_selector(obj, label_selector)):
                     out.append(ko.deep_copy(obj))
-            return out
+            return out, str(self._rv)
 
     def create_pod(self, pod):
         with self.lock:
@@ -147,6 +174,7 @@ class FakeKubeClient(KubeClient):
             except KubeApiError:
                 return
             if grace_period_s == 0:
+                self._bump(obj)  # deletes advance the RV, as in the real API
                 del self.store[("pods", ns, name)]
                 self._notify("DELETED", obj)
             else:
@@ -155,15 +183,27 @@ class FakeKubeClient(KubeClient):
                 self._bump(obj)
                 self._notify("MODIFIED", obj)
 
-    def watch_pods(self, field_selector="", label_selector="", stop=None
-                   ) -> Iterator[WatchEvent]:
+    def watch_pods(self, field_selector="", label_selector="", stop=None,
+                   resource_version=None) -> Iterator[WatchEvent]:
         w = _Watcher(field_selector, label_selector, stop)
         with self.lock:
-            # initial ADDED burst, like a fresh watch with resourceVersion=0
-            for (kind, _, _), obj in self.store.items():
-                if kind == "pods" and ko.match_field_selector(obj, field_selector) \
-                        and ko.match_label_selector(obj, label_selector):
-                    w.q.put(WatchEvent(type="ADDED", object=ko.deep_copy(obj)))
+            if resource_version is None:
+                # fresh watch: initial ADDED burst (resourceVersion=0 style)
+                for (kind, _, _), obj in self.store.items():
+                    if kind == "pods" and ko.match_field_selector(obj, field_selector) \
+                            and ko.match_label_selector(obj, label_selector):
+                        w.q.put(WatchEvent(type="ADDED", object=ko.deep_copy(obj)))
+            else:
+                rv = int(resource_version or 0)
+                if rv < self._compacted_rv:
+                    raise KubeApiError(
+                        f"too old resource version: {rv} (compacted to "
+                        f"{self._compacted_rv})", status=410)
+                # replay everything after the resume point, then go live
+                for erv, et, obj in self._pod_history:
+                    if erv > rv and ko.match_field_selector(obj, field_selector) \
+                            and ko.match_label_selector(obj, label_selector):
+                        w.q.put(WatchEvent(type=et, object=ko.deep_copy(obj)))
             self._watchers.append(w)
 
         def gen():

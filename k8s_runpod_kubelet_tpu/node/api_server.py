@@ -15,13 +15,21 @@ Endpoints (kubelet-API shaped):
                                                        SPDY streaming exec is out of
                                                        scope for a virtual node)
   GET  /healthz                                     -> "ok"
+
+Security: the reference serves :10250 through the virtual-kubelet lib's
+cert-based API server (main.go:217-248). Ours matches that exposure model:
+pass ``tls_cert``/``tls_key`` to serve HTTPS, and ``auth_token`` to require
+``Authorization: Bearer <token>`` on every route except /healthz — our
+endpoints can exec on workers, so they must never ship open.
 """
 
 from __future__ import annotations
 
+import hmac
 import json
 import logging
 import re
+import ssl
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
@@ -33,7 +41,11 @@ _RUN_RE = re.compile(r"^/run/(?P<ns>[^/]+)/(?P<pod>[^/]+)/(?P<container>[^/]+)$"
 
 
 class _Handler(BaseHTTPRequestHandler):
-    provider = None  # bound by server factory
+    provider = None    # bound by server factory
+    auth_token = None  # bound by server factory; None = no auth required
+    # per-connection socket timeout: bounds how long a stalled peer (or a
+    # deliberately idle TLS handshake) can pin its handler thread
+    timeout = 30
 
     def log_message(self, *a):
         pass
@@ -45,11 +57,20 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _authorized(self) -> bool:
+        """Bearer-token gate on every route but /healthz."""
+        if self.auth_token is None:
+            return True
+        got = self.headers.get("Authorization", "")
+        return hmac.compare_digest(got, f"Bearer {self.auth_token}")
+
     def do_GET(self):
         url = urlparse(self.path)
         q = parse_qs(url.query)
         if url.path == "/healthz":
             return self._send(200, b"ok")
+        if not self._authorized():
+            return self._send(401, b"unauthorized")
         if url.path == "/pods":
             pods = self.provider.get_pods()
             body = json.dumps({"kind": "PodList", "apiVersion": "v1",
@@ -75,6 +96,8 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(404, f"no route {url.path}".encode())
 
     def do_POST(self):
+        if not self._authorized():
+            return self._send(401, b"unauthorized")
         url = urlparse(self.path)
         q = parse_qs(url.query)
         m = _RUN_RE.match(url.path)
@@ -107,9 +130,24 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class KubeletApiServer:
-    def __init__(self, provider, address: str = "0.0.0.0", port: int = 10250):
-        handler = type("BoundHandler", (_Handler,), {"provider": provider})
+    def __init__(self, provider, address: str = "0.0.0.0", port: int = 10250,
+                 tls_cert: str = "", tls_key: str = "",
+                 auth_token: str = ""):
+        handler = type("BoundHandler", (_Handler,),
+                       {"provider": provider,
+                        "auth_token": auth_token or None})
         self._httpd = ThreadingHTTPServer((address, port), handler)
+        self.tls = bool(tls_cert)
+        if tls_cert:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls_cert, tls_key or None)
+            # do_handshake_on_connect=False: accept() must not block the
+            # single accept loop on a peer's handshake — the handshake runs
+            # lazily on first I/O in the per-connection handler thread, and
+            # the handler's socket timeout bounds a stalled peer
+            self._httpd.socket = ctx.wrap_socket(self._httpd.socket,
+                                                 server_side=True,
+                                                 do_handshake_on_connect=False)
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name="kubelet-api", daemon=True)
 

@@ -73,7 +73,10 @@ class PodController:
     def resync(self):
         """List-based repair: dispatch creates for unseen pods, deletes for pods
         the API no longer has but the provider still tracks."""
-        pods = self.kube.list_pods(field_selector=f"spec.nodeName={self.node_name}")
+        self._sync_list(
+            self.kube.list_pods(field_selector=f"spec.nodeName={self.node_name}"))
+
+    def _sync_list(self, pods: list[dict]):
         seen = set()
         for pod in pods:
             seen.add(ko.uid(pod))
@@ -119,18 +122,39 @@ class PodController:
             t.join(timeout=2)
 
     def _watch_loop(self):
+        """List+watch with resourceVersion continuity (client-go Reflector):
+        list anchors the RV, the watch resumes from it across reconnects so no
+        event between streams is lost, and 410 Gone triggers a fresh list."""
         backoff = 0.2
+        rv: Optional[str] = None
+        selector = f"spec.nodeName={self.node_name}"
         while not self._stop.is_set():
             try:
-                stream = self.kube.watch_pods(
-                    field_selector=f"spec.nodeName={self.node_name}", stop=self._stop)
+                if rv is None:
+                    pods, rv = self.kube.list_pods_rv(field_selector=selector)
+                    self._sync_list(pods)
+                stream = self.kube.watch_pods(field_selector=selector,
+                                              stop=self._stop,
+                                              resource_version=rv)
                 self.ready.set()
                 for ev in stream:
-                    if ev.type in ("BOOKMARK", "ERROR"):
+                    obj_rv = ko.meta(ev.object).get("resourceVersion", "")
+                    if obj_rv:
+                        rv = obj_rv  # resume point advances with every event
+                    if ev.type == "BOOKMARK":
                         continue
                     self.handle_event(ev.type, ev.object)
                     backoff = 0.2
-            except (KubeApiError, OSError) as e:
+            except KubeApiError as e:
+                if e.status == 410:
+                    log.info("pod watch expired (410 Gone) — relisting")
+                    rv = None
+                    continue
+                log.warning("pod watch broken: %s — reconnecting in %.1fs", e, backoff)
+                if self._stop.wait(backoff):
+                    return
+                backoff = min(backoff * 2, 10.0)
+            except OSError as e:
                 log.warning("pod watch broken: %s — reconnecting in %.1fs", e, backoff)
                 if self._stop.wait(backoff):
                     return

@@ -93,6 +93,9 @@ class Provider(ReconcileMixin, RecoveryMixin):
         self.pods: dict[str, dict] = {}                 # ns/name -> pod
         self.instances: dict[str, InstanceInfo] = {}    # ns/name -> info
         self.deleted: dict[str, DeletedPodInfo] = {}    # ns/name -> tombstone
+        # stuck-terminating pods whose slice status is erroring (non-404):
+        # ns/name -> first-unreachable timestamp (see reconcile.py ladder)
+        self._stuck_unreachable: dict[str, float] = {}
 
         self._notify_cb: Optional[Callable[[dict], None]] = None
         self._node_status_cb: Optional[Callable[[], None]] = None
@@ -111,6 +114,30 @@ class Provider(ReconcileMixin, RecoveryMixin):
     @staticmethod
     def key_of(pod: dict) -> str:
         return ko.namespaced_name(pod)
+
+    def emit_event(self, pod: dict, reason: str, message: str,
+                   event_type: str = "Normal"):
+        """Broadcast a K8s event on the pod so `kubectl describe pod` shows the
+        lifecycle trail (parity: the reference's event recorder,
+        main.go:172-177). Event failures never disrupt the control loop."""
+        ns = ko.namespace(pod)
+        ts = ko.now_iso(self.clock())
+        event = {
+            "metadata": {"generateName": f"{ko.name(pod)}.", "namespace": ns},
+            "type": event_type,
+            "reason": reason,
+            "message": message,
+            "involvedObject": {"kind": "Pod", "namespace": ns,
+                               "name": ko.name(pod),
+                               "uid": ko.meta(pod).get("uid", "")},
+            "source": {"component": "tpu-virtual-kubelet",
+                       "host": self.cfg.node_name},
+            "firstTimestamp": ts, "lastTimestamp": ts, "count": 1,
+        }
+        try:
+            self.kube.create_event(ns, event)
+        except KubeApiError as e:
+            log.debug("event %s on %s failed: %s", reason, self.key_of(pod), e)
 
     def _probe_cloud(self, force: bool = False) -> bool:
         """Rate-limited cloud health probe (parity: checkRunPodAPIHealth
@@ -246,6 +273,9 @@ class Provider(ReconcileMixin, RecoveryMixin):
                         info.last_deploy_error = str(e)
                 lvl = logging.INFO if isinstance(e, QuotaError) else logging.WARNING
                 log.log(lvl, "deploy %s failed: %s", key, e)
+                self.emit_event(pod, "DeployFailed",
+                                f"creating queued resource failed: {e}",
+                                event_type="Warning")
                 return False
 
         acc = qr.accelerator
@@ -262,6 +292,9 @@ class Provider(ReconcileMixin, RecoveryMixin):
         self._annotate_binding(pod, qr.name, params.zone, qr.accelerator_type, cost)
         log.info("deployed %s -> slice %s (%s, $%.2f/hr, state %s)",
                  key, qr.name, qr.accelerator_type, cost, qr.state.value)
+        self.emit_event(pod, "SliceCreated",
+                        f"created queued resource {qr.name} "
+                        f"({qr.accelerator_type}, ${cost:.2f}/hr)")
         return True
 
     def _annotate_binding(self, pod: dict, qr_name: str, zone: str,

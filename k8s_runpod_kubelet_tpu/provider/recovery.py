@@ -236,6 +236,9 @@ class RecoveryMixin:
 
     def force_delete_pod(self, pod: dict):
         """Grace-0 delete (parity: ForceDeletePod kubelet.go:1776-1796)."""
+        self.emit_event(pod, "ForceDeleted",
+                        "stuck terminating — force deleting with grace 0",
+                        event_type="Warning")
         try:
             self.kube.delete_pod(ko.namespace(pod), ko.name(pod), grace_period_s=0)
         except KubeApiError as e:
@@ -245,3 +248,7 @@ class RecoveryMixin:
         with self.lock:
             self.pods.pop(key, None)
             self.instances.pop(key, None)
+            # clear unreachable tracking on every exit from the stuck ladder,
+            # else a later same-named pod inherits a stale timestamp and gets
+            # force-deleted without its 10-minute grace
+            self._stuck_unreachable.pop(key, None)

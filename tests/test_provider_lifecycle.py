@@ -55,6 +55,35 @@ class TestHappyPath:
         assert status["podIP"]
         assert status["containerStatuses"][0]["ready"] is True
 
+    def test_lifecycle_emits_kubectl_describe_events(self, h):
+        """The event trail an operator sees in `kubectl describe pod`
+        (parity: the reference's event recorder, main.go:172-177)."""
+        pod = bind_pod(h, make_pod(chips=16))
+        h.provider.update_all_pod_statuses()  # gang launch -> Running
+        reasons = [e["reason"] for e in h.kube.events]
+        assert reasons[:3] == ["SliceCreated", "GangLaunched", "GangRunning"]
+        for e in h.kube.events:
+            assert e["type"] == "Normal"
+            assert e["involvedObject"]["name"] == "train"
+            assert e["source"]["component"] == "tpu-virtual-kubelet"
+        # preemption: requeue event (Warning)
+        h.fake.preempt(ko.annotations(pod)[A.QUEUED_RESOURCE])
+        h.provider.update_all_pod_statuses()
+        assert any(e["reason"] == "Preempted" and e["type"] == "Warning"
+                   for e in h.kube.events)
+
+    def test_deploy_failure_and_giveup_emit_warning_events(self, h):
+        h.fake.fail_next_create = (400, "boom")  # 4xx: not retried
+        bind_pod(h, make_pod(chips=16))
+        assert any(e["reason"] == "DeployFailed" and e["type"] == "Warning"
+                   for e in h.kube.events)
+        h.clock.advance(h.cfg.max_pending_s + 1)
+        h.fake.api_down = True  # retries keep failing
+        h.provider._probe_cloud(force=True)
+        h.provider.process_pending_pods()  # give-up -> Failed
+        assert any(e["reason"] == "DeploymentFailed" and e["type"] == "Warning"
+                   for e in h.kube.events)
+
     def test_completion_all_zero_is_succeeded(self, h):
         pod = bind_pod(h, make_pod(chips=16))
         h.provider.update_all_pod_statuses()
@@ -144,6 +173,7 @@ class TestFailurePaths:
         assert A.QUEUED_RESOURCE not in ko.annotations(pod)
 
     def test_preemption_fails_pod(self, h):
+        h.cfg.preemption_requeue_limit = 0  # opt out of the default requeue
         pod = bind_pod(h, make_pod(chips=16))
         h.provider.update_all_pod_statuses()
         h.fake.preempt(ko.annotations(pod)[A.QUEUED_RESOURCE])
