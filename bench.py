@@ -23,6 +23,9 @@ Usage:
   python bench.py --attn     # flash-attention microbench: Pallas vs XLA at
                              # S in {2k, 8k} + a 32k Pallas-only run (one
                              # JSON line per config; needs a TPU)
+  python bench.py --serve    # serving bench: tokens/sec + p50/p99 latency
+                             # under concurrent load (CPU-capable with the
+                             # tiny model; real numbers on TPU)
 """
 
 from __future__ import annotations
@@ -209,6 +212,54 @@ def run_attn_bench() -> int:
     return 0
 
 
+def run_serve_bench(quick: bool) -> int:
+    """Serving throughput/latency under concurrent load (VERDICT r1 item 8):
+    continuous batching with the prefill thread; reports tokens/sec, p50/p99
+    request latency, and the HPA queue-depth signal."""
+    _force_platform_from_env()
+    import jax
+    from __graft_entry__ import _bench_config
+    from k8s_runpod_kubelet_tpu.models import init_params
+    from k8s_runpod_kubelet_tpu.workloads.serving import (ServingConfig,
+                                                          ServingEngine)
+
+    tiny = quick or jax.default_backend() != "tpu"
+    cfg = _bench_config(tiny=tiny)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    slots, n_req, new_toks = (4, 12, 16) if tiny else (8, 48, 64)
+    sc = ServingConfig(slots=slots, max_prefill_len=64,
+                       cache_len=128 if tiny else 1024,
+                       max_new_tokens=new_toks)
+    engine = ServingEngine(cfg, params, sc).start()
+    try:
+        engine.submit([1, 2, 3], max_new_tokens=2).result(timeout=900)  # warm
+        t0 = time.perf_counter()
+        futs = [engine.submit([(j % 250) + 1 for j in range(1 + i % 32)],
+                              max_new_tokens=new_toks)
+                for i in range(n_req)]
+        peak_queue = max(engine.queue_depth, 1)
+        outs = [f.result(timeout=900) for f in futs]
+        wall = time.perf_counter() - t0
+    finally:
+        engine.stop()
+    toks = sum(len(o["tokens"]) for o in outs)
+    lats = sorted(o["latency_s"] for o in outs)
+    _emit({
+        "metric": "serving_tokens_per_sec",
+        "value": round(toks / wall, 1),
+        "unit": "tok/s",
+        "p50_latency_s": round(lats[len(lats) // 2], 3),
+        "p99_latency_s": round(lats[min(len(lats) - 1,
+                                        int(len(lats) * 0.99))], 3),
+        "requests": n_req, "slots": slots,
+        "new_tokens_per_request": new_toks,
+        "peak_queue_depth": peak_queue,
+        "model": cfg.name,
+        "backend": jax.default_backend(),
+    })
+    return 0
+
+
 # --------------------------------------------------------------------------
 # parent: orchestrator (imports no jax; always emits one JSON line)
 # --------------------------------------------------------------------------
@@ -286,6 +337,8 @@ def main() -> int:
     quick = "--quick" in sys.argv
     if "--attn" in sys.argv:
         return run_attn_bench()
+    if "--serve" in sys.argv:
+        return run_serve_bench(quick)
     if "--run" in sys.argv:
         result = run_bench(quick, expect_tpu="--expect-tpu" in sys.argv)
         _emit(result)

@@ -19,8 +19,14 @@ from .types import (
 )
 from .tpu_client import TpuClient, TpuApiError, NotFoundError, QuotaError
 from .transport import HttpTransport, TransportError
+from .workload_backend import (ApiWorkloadBackend, SshWorkloadBackend,
+                               WorkloadBackend, WorkloadBackendError)
 
 __all__ = [
+    "ApiWorkloadBackend",
+    "SshWorkloadBackend",
+    "WorkloadBackend",
+    "WorkloadBackendError",
     "AcceleratorType",
     "QueuedResource",
     "QueuedResourceState",

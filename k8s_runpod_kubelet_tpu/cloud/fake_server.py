@@ -143,6 +143,11 @@ class FakeTpuService:
         self.resources: dict[str, _FakeResource] = {}
         self.provision_delay_s = provision_delay_s
         self.workload_auto_finish_s = workload_auto_finish_s
+        # extensions_enabled=False emulates the PLAIN Cloud TPU v2 surface
+        # (create/get/list/delete only): :detailed and :workload 404, as they
+        # would against the real googleapis endpoint — the SSH workload
+        # backend must carry the whole workload half (tests/test_ssh_workload)
+        self.extensions_enabled = True
         # fault injection
         self.api_down = False            # every request -> 503
         self.fail_next_create: Optional[tuple[int, str]] = None  # (status, message)
@@ -232,6 +237,8 @@ class FakeTpuService:
             if name not in self.resources:
                 return 404, {"error": f"queued resource {name} not found"}
             r = self.resources[name]
+            if verb in ("detailed", "workload") and not self.extensions_enabled:
+                return 404, {"error": f"no route {path} (plain v2 surface)"}
             if method == "GET" and verb == "detailed":
                 return 200, {"resource": r.to_json(), "runtime": r.runtime,
                              "ports": {str(k): v for k, v in r.ports.items()}}

@@ -72,17 +72,28 @@ def parse_flags(argv: list[str]) -> argparse.Namespace:
     p.add_argument("--tls-cert-file", dest="tls_cert_file", default=None,
                    help="serve the kubelet API over TLS with this cert")
     p.add_argument("--tls-key-file", dest="tls_key_file", default=None)
+    p.add_argument("--workload-path", dest="workload_path", default=None,
+                   choices=["ssh", "api"],
+                   help="workload launch/status path: 'ssh' drives docker on "
+                        "the TPU VMs (real Cloud TPU API); 'api' uses the "
+                        ":workload/:detailed aggregator endpoints")
     return p.parse_args(argv)
 
 
 def build(cfg: config_mod.Config, kube=None, tpu=None, worker_transport=None):
     """Wire the full kubelet; injectable clients for tests."""
+    from ..cloud import SshWorkloadBackend
+
     metrics = Metrics()
     kube = kube or RealKubeClient.from_env(cfg.kubeconfig)
+    gang = GangExecutor(worker_transport or SshWorkerTransport())
+    # "ssh": workload launch/status over the worker transport — works against
+    # the PLAIN Cloud TPU v2 surface. "api": the :workload/:detailed extension
+    # endpoints (fake server or a worker-agent aggregator deployment).
+    backend = SshWorkloadBackend(gang) if cfg.workload_path == "ssh" else None
     tpu = tpu or TpuClient(
         HttpTransport(cfg.tpu_api_endpoint, token=cfg.tpu_api_token),
-        project=cfg.project, zone=cfg.zone)
-    gang = GangExecutor(worker_transport or SshWorkerTransport())
+        project=cfg.project, zone=cfg.zone, workload_backend=backend)
     provider = Provider(cfg, kube, tpu, gang_executor=gang, metrics=metrics)
     node_controller = NodeController(kube, provider,
                                      status_interval_s=cfg.node_status_interval_s)

@@ -34,6 +34,12 @@ class Config:
     tpu_api_token: str = ""
     default_generation: str = "v5e"
     default_runtime_version: str = ""
+    # how workloads launch + report per-worker status:
+    #   "ssh" (default) — drive docker on the TPU VMs over SSH; needs only the
+    #          real Cloud TPU v2 CRUD surface (cloud/workload_backend.py)
+    #   "api" — POST :workload / GET :detailed extension endpoints (the fake
+    #          server, or a worker-agent aggregator service)
+    workload_path: str = "ssh"
     max_cost_per_hr: float = 0.0  # 0 = unlimited; actually enforced, unlike the
                                   # reference's --max-gpu-price (SURVEY.md §5.6)
 
@@ -87,6 +93,9 @@ class Config:
             errs.append("max_pending_s must be > 0")
         if self.log_level.lower() not in ("debug", "info", "warning", "error"):
             errs.append(f"unknown log_level {self.log_level!r}")
+        if self.workload_path not in ("ssh", "api"):
+            errs.append(f"workload_path must be 'ssh' or 'api', "
+                        f"got {self.workload_path!r}")
         if self.zones and self.zone not in self.zones:
             errs.append(f"zone {self.zone!r} not in allowed zones {self.zones}")
         if errs:

@@ -14,6 +14,7 @@ mesh and jax.distributed form the DCN ring:
 
 from .env import compute_worker_env, coordinator_address, DEFAULT_COORDINATOR_PORT
 from .exec import WorkerTransport, SshWorkerTransport, InMemoryWorkerTransport, GangExecutor
+from .fake_host import FakeWorkerHost
 
 __all__ = [
     "compute_worker_env",
@@ -22,5 +23,6 @@ __all__ = [
     "WorkerTransport",
     "SshWorkerTransport",
     "InMemoryWorkerTransport",
+    "FakeWorkerHost",
     "GangExecutor",
 ]

@@ -54,7 +54,11 @@ def compute_worker_env(
     hostnames = ",".join(w.hostname for w in hosts)
     coord = coordinator_address(qr, coordinator_port)
     if megascale_coordinator is None:
-        megascale_coordinator = coord.split(":")[0]
+        # prefer the hostname: slice 0's default must equal the string other
+        # slices put in their tpu.dev/megascale-coordinator annotation (the
+        # config4 pattern names slice 0's worker-0 by hostname)
+        megascale_coordinator = ((hosts[0].hostname or hosts[0].internal_ip)
+                                 if hosts else "")
 
     envs: list[dict[str, str]] = []
     for w in hosts:

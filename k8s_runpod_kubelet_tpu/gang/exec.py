@@ -36,6 +36,13 @@ class WorkerTransport:
 
     def run(self, qr: QueuedResource, worker_id: int, cmd: list[str],
             timeout_s: float = 60.0) -> str:
+        """Run a command INSIDE the workload container (kubectl-exec shape)."""
+        raise NotImplementedError
+
+    def host_run(self, qr: QueuedResource, worker_id: int, cmd: list[str],
+                 timeout_s: float = 60.0) -> str:
+        """Run a command on the worker VM itself — the surface the SSH
+        workload backend drives docker through (cloud/workload_backend.py)."""
         raise NotImplementedError
 
     def logs(self, qr: QueuedResource, worker_id: int,
@@ -76,6 +83,10 @@ class SshWorkerTransport(WorkerTransport):
         return self._ssh(qr, worker_id,
                          f"docker exec {self.container_name} {inner}", timeout_s)
 
+    def host_run(self, qr, worker_id, cmd, timeout_s=60.0):
+        return self._ssh(qr, worker_id,
+                         " ".join(shlex.quote(c) for c in cmd), timeout_s)
+
     def logs(self, qr, worker_id, tail_lines=None):
         tail = f" --tail {tail_lines}" if tail_lines else ""
         return self._ssh(qr, worker_id,
@@ -103,6 +114,9 @@ class InMemoryWorkerTransport(WorkerTransport):
                 raise WorkerExecError(f"worker {worker_id} unreachable", exit_code=255)
             return self.responses.get(cmd[0] if cmd else "", "")
 
+    def host_run(self, qr, worker_id, cmd, timeout_s=60.0):
+        return self.run(qr, worker_id, cmd, timeout_s)
+
     def logs(self, qr, worker_id, tail_lines=None):
         with self.lock:
             if (qr.name, worker_id) in self.fail_workers:
@@ -120,27 +134,38 @@ class GangExecutor:
         self.transport = transport
 
     def run_on_worker(self, qr: QueuedResource, worker_id: int, cmd: list[str],
-                      timeout_s: float = 60.0) -> str:
+                      timeout_s: float = 60.0, host: bool = False) -> str:
         if not qr.workers or worker_id >= len(qr.workers):
             raise WorkerExecError(f"slice {qr.name} has no worker {worker_id}")
-        return self.transport.run(qr, worker_id, cmd, timeout_s)
+        fn = self.transport.host_run if host else self.transport.run
+        return fn(qr, worker_id, cmd, timeout_s)
 
     def run_on_all(self, qr: QueuedResource, cmd: list[str],
-                   timeout_s: float = 60.0) -> dict[int, str]:
-        """Run on every worker concurrently; raises if ANY worker fails (gang
-        semantics — a partial launch is a failed launch)."""
+                   timeout_s: float = 60.0, host: bool = False) -> dict[int, str]:
+        """Run the SAME command on every worker concurrently; raises if ANY
+        worker fails (gang semantics — a partial launch is a failed launch)."""
+        return self.run_per_worker(qr, {w.worker_id: cmd for w in qr.workers},
+                                   timeout_s=timeout_s, host=host)
+
+    def run_per_worker(self, qr: QueuedResource, cmds: dict[int, list[str]],
+                       timeout_s: float = 60.0, host: bool = False
+                       ) -> dict[int, str]:
+        """Run a per-worker command map concurrently, all-or-nothing (the
+        gang-launch shape: same program, per-worker env baked into each
+        command)."""
         results: dict[int, str] = {}
         errors: dict[int, Exception] = {}
+        fn = self.transport.host_run if host else self.transport.run
 
         def one(i: int):
             try:
-                results[i] = self.transport.run(qr, i, cmd, timeout_s)
+                results[i] = fn(qr, i, cmds[i], timeout_s)
             except Exception as e:  # noqa: BLE001
                 errors[i] = e
 
         threads = {w.worker_id: threading.Thread(target=one, args=(w.worker_id,),
                                                  daemon=True)
-                   for w in qr.workers}
+                   for w in qr.workers if w.worker_id in cmds}
         for t in threads.values():
             t.start()
         for t in threads.values():

@@ -1,0 +1,191 @@
+"""FakeWorkerHost: a docker-lite worker-VM simulator for hermetic tests.
+
+Where InMemoryWorkerTransport replays canned strings, this transport actually
+*models* each TPU VM's container state, understanding the command grammar the
+SSH workload backend issues (cloud/workload_backend.py):
+
+  sh -c "docker rm -f NAME ... ; docker run -d --name NAME ... IMAGE CMD..."
+  docker inspect --format '...' NAME
+  docker logs [--tail N] NAME        (via .logs(), as SshWorkerTransport does)
+  docker exec NAME CMD...
+
+so the full real-cloud lifecycle — gang launch over "SSH", per-worker docker
+state aggregation, worker death, exit codes — runs without a cloud or a
+daemon. Fault injection: ``kill_worker`` (VM unreachable), ``finish``
+(container exits), ``fail_next_run`` (docker run errors once).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import shlex
+import threading
+import time
+from typing import Optional
+
+from .exec import WorkerExecError, WorkerTransport
+
+_UNREACHABLE_EXIT = 255  # ssh's own exit code when the host is unreachable
+
+
+@dataclasses.dataclass
+class _Container:
+    name: str
+    image: str
+    env: dict[str, str]
+    command: list[str]
+    labels: dict[str, str] = dataclasses.field(default_factory=dict)
+    status: str = "running"            # running | exited | dead
+    exit_code: int = 0
+    started_at: float = dataclasses.field(default_factory=time.time)
+    log_lines: list[str] = dataclasses.field(default_factory=list)
+
+
+class FakeWorkerHost(WorkerTransport):
+    def __init__(self):
+        self.lock = threading.RLock()
+        # (qr_name, worker_id) -> {container_name: _Container}
+        self.hosts: dict[tuple[str, int], dict[str, _Container]] = {}
+        self.dead_workers: set[tuple[str, int]] = set()
+        self.fail_next_run: set[tuple[str, int]] = set()
+        self.calls: list[tuple[str, int, list[str]]] = []
+
+    # -- fault injection / assertions ------------------------------------------
+
+    def kill_worker(self, qr_name: str, worker_id: int):
+        """VM becomes unreachable (maintenance/preemption); its containers
+        die with it."""
+        with self.lock:
+            self.dead_workers.add((qr_name, worker_id))
+
+    def finish(self, qr_name: str, exit_codes: Optional[list[int]] = None,
+               container: str = "workload"):
+        """Workload exits on every worker (exit_codes[i] or 0)."""
+        with self.lock:
+            workers = sorted(k for k in self.hosts if k[0] == qr_name)
+            for i, key in enumerate(workers):
+                c = self.hosts[key].get(container)
+                if c and c.status == "running":
+                    c.status = "exited"
+                    c.exit_code = (exit_codes[i] if exit_codes
+                                   and i < len(exit_codes) else 0)
+
+    def container(self, qr_name: str, worker_id: int,
+                  name: str = "workload") -> Optional[_Container]:
+        with self.lock:
+            return self.hosts.get((qr_name, worker_id), {}).get(name)
+
+    def append_log(self, qr_name: str, worker_id: int, line: str,
+                   container: str = "workload"):
+        with self.lock:
+            c = self.container(qr_name, worker_id, container)
+            if c:
+                c.log_lines.append(line)
+
+    # -- the docker-lite grammar ------------------------------------------------
+
+    def host_run(self, qr, worker_id, cmd, timeout_s=60.0):
+        """Host-level command on the VM (the workload backend's surface)."""
+        key = (qr.name, worker_id)
+        with self.lock:
+            self.calls.append((qr.name, worker_id, list(cmd)))
+            if key in self.dead_workers:
+                raise WorkerExecError(f"ssh: connect to worker {worker_id}: "
+                                      "No route to host",
+                                      exit_code=_UNREACHABLE_EXIT)
+            host = self.hosts.setdefault(key, {})
+            if cmd[:2] == ["sh", "-c"]:
+                return self._shell(key, host, cmd[2])
+            if cmd[:2] == ["docker", "inspect"]:
+                return self._inspect(host, cmd[-1])
+            if cmd[:2] == ["docker", "exec"]:
+                return self._exec(host, cmd[2], cmd[3:])
+            return ""  # unknown command: succeed silently, like a quiet shell
+
+    def run(self, qr, worker_id, cmd, timeout_s=60.0):
+        """In-container exec (the kubelet API's /run surface)."""
+        key = (qr.name, worker_id)
+        with self.lock:
+            self.calls.append((qr.name, worker_id, list(cmd)))
+            if key in self.dead_workers:
+                raise WorkerExecError("ssh: No route to host",
+                                      exit_code=_UNREACHABLE_EXIT)
+            host = self.hosts.setdefault(key, {})
+            return self._exec(host, "workload", cmd)
+
+    def _shell(self, key, host, script: str) -> str:
+        out = ""
+        for segment in script.split(";"):
+            toks = shlex.split(segment)
+            # strip trailing `|| true` / redirections appended by the backend
+            toks = [t for t in toks
+                    if t not in ("||", "true") and not t.startswith(">")
+                    and t not in ("2>&1",)]
+            if toks[:3] == ["docker", "rm", "-f"]:
+                host.pop(toks[3], None)
+            elif toks[:2] == ["docker", "run"]:
+                out = self._docker_run(key, host, toks)
+        return out
+
+    def _docker_run(self, key, host, toks: list[str]) -> str:
+        if key in self.fail_next_run:
+            self.fail_next_run.discard(key)
+            raise WorkerExecError("docker: Error response from daemon: "
+                                  "failed to create task", exit_code=125)
+        env: dict[str, str] = {}
+        labels: dict[str, str] = {}
+        name = "workload"
+        i = 2
+        while i < len(toks):
+            t = toks[i]
+            if t == "-e" and i + 1 < len(toks):
+                k, _, v = toks[i + 1].partition("=")
+                env[k] = v
+                i += 2
+            elif t in ("-l", "--label") and i + 1 < len(toks):
+                k, _, v = toks[i + 1].partition("=")
+                labels[k] = v
+                i += 2
+            elif t == "--name" and i + 1 < len(toks):
+                name = toks[i + 1]
+                i += 2
+            elif t.startswith("-"):
+                i += 1
+            else:
+                break
+        if i >= len(toks):
+            raise WorkerExecError("docker run: no image given", exit_code=125)
+        image, command = toks[i], toks[i + 1:]
+        if name in host:
+            raise WorkerExecError(
+                f'docker: Error response from daemon: Conflict. The container '
+                f'name "/{name}" is already in use', exit_code=125)
+        host[name] = _Container(name=name, image=image, env=env,
+                                labels=labels, command=command)
+        return "deadbeef" + name  # container id
+
+    def _inspect(self, host, name: str) -> str:
+        c = host.get(name)
+        if c is None:
+            raise WorkerExecError(f"Error: No such object: {name}", exit_code=1)
+        ports = c.labels.get("tpu-ports", "-")
+        return f"{c.status} {c.exit_code} {c.started_at} {ports}\n"
+
+    def _exec(self, host, name: str, cmd: list[str]) -> str:
+        c = host.get(name)
+        if c is None or c.status != "running":
+            raise WorkerExecError(f"container {name} is not running", exit_code=1)
+        return f"exec:{' '.join(cmd)}\n"
+
+    def logs(self, qr, worker_id, tail_lines=None):
+        key = (qr.name, worker_id)
+        with self.lock:
+            if key in self.dead_workers:
+                raise WorkerExecError("ssh: No route to host",
+                                      exit_code=_UNREACHABLE_EXIT)
+            c = self.hosts.get(key, {}).get("workload")
+            if c is None:
+                raise WorkerExecError("Error: No such container: workload",
+                                      exit_code=1)
+            lines = c.log_lines[-tail_lines:] if tail_lines else c.log_lines
+            return "\n".join(lines) + ("\n" if lines else "")

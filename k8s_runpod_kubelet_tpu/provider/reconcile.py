@@ -337,6 +337,14 @@ class ReconcileMixin:
             log.warning("stuck-terminating sweep: list failed: %s", e)
             return
         now = self.clock()
+        # prune unreachable-tracking for pods that left by ANY path (external
+        # force-delete included) — a later same-named pod must not inherit a
+        # stale first-unreachable timestamp and lose its grace period
+        with self.lock:
+            live = {ko.namespaced_name(p) for p in pods}
+            for k in list(self._stuck_unreachable):
+                if k not in live:
+                    self._stuck_unreachable.pop(k, None)
         for pod in pods:
             ts = ko.deletion_timestamp(pod)
             if not ts:

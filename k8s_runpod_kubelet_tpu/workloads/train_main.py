@@ -44,6 +44,13 @@ def main(argv=None) -> int:
                    help="pre-tokenized int32 corpus file (empty = synthetic); "
                         "read through the native loader, sharded per process")
     p.add_argument("--data-threads", type=int, default=2)
+    p.add_argument("--profile-dir", default="",
+                   help="capture a JAX/XLA profiler trace of a few post-warmup "
+                        "steps into this directory (TensorBoard-readable)")
+    p.add_argument("--profiler-port", type=int, default=0,
+                   help="start the on-demand jax.profiler server on this port "
+                        "(0 = off); lets an operator capture traces from a "
+                        "running worker without restarting it")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -51,6 +58,9 @@ def main(argv=None) -> int:
     pe = initialize_from_env()
 
     import jax
+    if args.profiler_port:
+        jax.profiler.start_server(args.profiler_port)
+        log.info("jax profiler server on :%d", args.profiler_port)
     from ..models import llama3_8b, llama3_70b, gemma_7b, mixtral_8x7b, qwen2_7b, tiny_llama, tiny_moe
     from ..parallel import MeshConfig, make_mesh
     from ..workloads.train import TrainConfig, Trainer
@@ -113,7 +123,17 @@ def main(argv=None) -> int:
                              start_batch=trainer.step)
         batches = device_batches(loader, mesh)
     try:
-        out = trainer.run(steps=args.steps, batches=batches)
+        if args.profile_dir and args.steps > 4:
+            # §5.1: profiler hooks on workers — capture a few POST-compile
+            # steps so the trace shows steady-state device time, not tracing
+            trainer.run(steps=2, batches=batches)
+            with jax.profiler.trace(args.profile_dir):
+                out = trainer.run(steps=3, batches=batches)
+            log.info("profiler trace written to %s", args.profile_dir)
+            if args.steps > 5:  # steps=0 would mean "tc.steps more" to run()
+                out = trainer.run(steps=args.steps - 5, batches=batches)
+        else:
+            out = trainer.run(steps=args.steps, batches=batches)
     finally:
         if loader is not None:
             loader.close()

@@ -64,6 +64,29 @@ def make_harness(provision_delay_s: float = 0.0,
                    clock=clock, transport=transport, cfg=cfg)
 
 
+def make_ssh_harness(provision_delay_s: float = 0.0,
+                     cfg: Optional[Config] = None) -> Harness:
+    """Real-cloud-path harness: the fake server exposes ONLY the plain Cloud
+    TPU v2 surface (:detailed/:workload 404), and workload launch/status flow
+    through the SSH workload backend onto a docker-lite FakeWorkerHost."""
+    from k8s_runpod_kubelet_tpu.cloud import SshWorkloadBackend
+    from k8s_runpod_kubelet_tpu.gang import FakeWorkerHost
+
+    server = FakeTpuServer(provision_delay_s=provision_delay_s).start()
+    server.service.extensions_enabled = False
+    kube = FakeKubeClient()
+    clock = FakeClock()
+    cfg = cfg or Config(node_name="virtual-tpu", zone="us-central2-b")
+    transport = FakeWorkerHost()
+    gang = GangExecutor(transport)
+    tpu = TpuClient(HttpTransport(server.base_url, token="t", sleep=lambda s: None),
+                    project="test-proj", zone="us-central2-b",
+                    workload_backend=SshWorkloadBackend(gang))
+    provider = Provider(cfg, kube, tpu, gang_executor=gang, clock=clock)
+    return Harness(server=server, kube=kube, tpu=tpu, provider=provider,
+                   clock=clock, transport=transport, cfg=cfg)
+
+
 def make_pod(name="train", ns="default", node="virtual-tpu", chips=16,
              annotations: Optional[dict] = None, ports: Optional[list] = None,
              containers: Optional[list] = None, uid: Optional[str] = None):

@@ -1,0 +1,181 @@
+"""Real-cloud-path e2e: the full pod lifecycle against a fake server that
+exposes ONLY the plain Cloud TPU v2 surface (create/get/list/delete — what
+actually exists at googleapis), with workload launch + per-worker status
+flowing through the SSH workload backend (VERDICT r1 item 2).
+
+Reference contract being matched: deploy runs the image
+(runpod_client.go:522-634) and GetDetailedPodStatus reports runtime state
+(:773-818) — capabilities RunPod's API had built in and Cloud TPU does not,
+so the kubelet carries them over the worker exec transport.
+"""
+
+import pytest
+
+from k8s_runpod_kubelet_tpu.provider.annotations import Annotations as A
+from k8s_runpod_kubelet_tpu.kube import objects as ko
+
+from harness import make_ssh_harness, make_pod
+
+
+@pytest.fixture()
+def h():
+    h = make_ssh_harness()
+    yield h
+    h.close()
+
+
+def bind_pod(h, pod):
+    created = h.kube.create_pod(pod)
+    h.provider.create_pod(created)
+    return h.kube.get_pod(ko.namespace(created), ko.name(created))
+
+
+def extension_requests(h):
+    return [(m, p) for m, p in h.fake.request_log
+            if ":detailed" in p or ":workload" in p]
+
+
+class TestSshLifecycle:
+    def test_full_lifecycle_plain_v2_surface_only(self, h):
+        pod = bind_pod(h, make_pod(chips=16))
+        qr = ko.annotations(pod)[A.QUEUED_RESOURCE]
+        h.provider.update_all_pod_statuses()  # gang launch over "ssh"
+        # the workload container exists on all 4 workers with per-worker env
+        for wid in range(4):
+            c = h.transport.container(qr, wid)
+            assert c is not None and c.status == "running"
+            assert c.image == "gcr.io/proj/maxtext:latest"
+            assert c.env["TPU_WORKER_ID"] == str(wid)
+            assert c.env["JAX_PROCESS_ID"] == str(wid)
+        assert (h.transport.container(qr, 0).env["TPU_WORKER_HOSTNAMES"]
+                == h.transport.container(qr, 3).env["TPU_WORKER_HOSTNAMES"])
+        status = h.kube.get_pod("default", "train")["status"]
+        assert status["phase"] == "Running"
+        assert status["containerStatuses"][0]["ready"] is True
+        # completion: all workers exit 0 -> Succeeded with exit code
+        h.transport.finish(qr)
+        h.provider.update_all_pod_statuses()
+        status = h.kube.get_pod("default", "train")["status"]
+        assert status["phase"] == "Succeeded"
+        assert status["containerStatuses"][0]["state"]["terminated"]["exitCode"] == 0
+        # the server only ever saw the plain v2 surface
+        assert extension_requests(h) == []
+
+    def test_nonzero_exit_fails_with_code(self, h):
+        pod = bind_pod(h, make_pod(chips=16))
+        qr = ko.annotations(pod)[A.QUEUED_RESOURCE]
+        h.provider.update_all_pod_statuses()
+        h.transport.finish(qr, exit_codes=[0, 0, 137, 0])
+        h.provider.update_all_pod_statuses()
+        status = h.kube.get_pod("default", "train")["status"]
+        assert status["phase"] == "Failed"
+        assert status["containerStatuses"][0]["state"]["terminated"]["exitCode"] == 137
+
+    def test_gang_launch_all_or_nothing_with_teardown_and_retry(self, h):
+        pod = bind_pod(h, make_pod(chips=16))
+        qr = ko.annotations(pod)[A.QUEUED_RESOURCE]
+        h.transport.fail_next_run.add((qr, 2))  # docker run fails on worker 2
+        h.provider.update_all_pod_statuses()
+        # partial launch torn down: no worker keeps a container
+        for wid in range(4):
+            assert h.transport.container(qr, wid) is None, wid
+        assert not h.provider.instances["default/train"].workload_launched
+        # next reconcile pass retries and succeeds
+        h.provider.update_all_pod_statuses()
+        assert h.provider.instances["default/train"].workload_launched
+        assert h.kube.get_pod("default", "train")["status"]["phase"] == "Running"
+
+    def test_worker_death_gang_fails_pod(self, h):
+        pod = bind_pod(h, make_pod(chips=16))
+        qr = ko.annotations(pod)[A.QUEUED_RESOURCE]
+        h.provider.update_all_pod_statuses()
+        assert h.kube.get_pod("default", "train")["status"]["phase"] == "Running"
+        h.transport.kill_worker(qr, 2)  # VM unreachable (maintenance event)
+        h.provider.update_all_pod_statuses()
+        status = h.kube.get_pod("default", "train")["status"]
+        assert status["phase"] == "Failed" and status["reason"] == "GangBroken"
+
+    def test_logs_and_exec_through_kubelet_api_surface(self, h):
+        pod = bind_pod(h, make_pod(chips=16))
+        qr = ko.annotations(pod)[A.QUEUED_RESOURCE]
+        h.provider.update_all_pod_statuses()
+        h.transport.append_log(qr, 1, "step 42 loss=2.17")
+        logs = h.provider.get_container_logs("default", "train", "main", worker=1)
+        assert "step 42 loss=2.17" in logs
+        out = h.provider.run_in_container("default", "train", "main",
+                                          ["nvidia-smi" if False else "date"],
+                                          worker=0)
+        assert out.startswith("exec:")
+
+    def test_restart_adopts_running_workload_without_relaunch(self, h):
+        """A kubelet restart between launch and the next poll must ADOPT the
+        running containers from docker state, not relaunch them
+        (reconcile.py's launch-adoption path, now fed by SSH inspect)."""
+        pod = bind_pod(h, make_pod(chips=16))
+        qr = ko.annotations(pod)[A.QUEUED_RESOURCE]
+        h.provider.update_all_pod_statuses()
+        started = h.transport.container(qr, 0).started_at
+        runs_before = sum(1 for _, _, cmd in h.transport.calls
+                          if cmd[:2] == ["sh", "-c"] and "docker run" in cmd[2])
+        # fresh provider (restart), same cloud + workers
+        from k8s_runpod_kubelet_tpu.provider import Provider
+        p2 = Provider(h.cfg, h.kube, h.tpu, gang_executor=h.provider.gang,
+                      clock=h.clock)
+        p2.load_running()
+        p2.update_all_pod_statuses()
+        assert p2.instances["default/train"].workload_launched
+        runs_after = sum(1 for _, _, cmd in h.transport.calls
+                         if cmd[:2] == ["sh", "-c"] and "docker run" in cmd[2])
+        assert runs_after == runs_before  # no relaunch
+        assert h.transport.container(qr, 0).started_at == started
+        assert h.kube.get_pod("default", "train")["status"]["phase"] == "Running"
+
+    def test_all_workers_unreachable_is_gang_broken_not_limbo(self, h):
+        """Whole-slice VM loss after launch must fail the pod (r2 review
+        finding: an all-dead gang used to look like 'pre-launch' and the pod
+        sat non-terminal forever)."""
+        pod = bind_pod(h, make_pod(chips=16))
+        qr = ko.annotations(pod)[A.QUEUED_RESOURCE]
+        h.provider.update_all_pod_statuses()
+        assert h.kube.get_pod("default", "train")["status"]["phase"] == "Running"
+        for wid in range(4):
+            h.transport.kill_worker(qr, wid)
+        h.provider.update_all_pod_statuses()
+        status = h.kube.get_pod("default", "train")["status"]
+        assert status["phase"] == "Failed" and status["reason"] == "GangBroken"
+
+    def test_ports_survive_kubelet_restart_via_container_label(self, h):
+        """Readiness of a TCP-port workload must survive a kubelet restart:
+        the port list rides a docker label and is recovered by inspect
+        (r2 review finding: the in-memory cache started empty on restart,
+        leaving the pod NotReady forever)."""
+        pod = bind_pod(h, make_pod(chips=16, ports=[7000]))
+        qr = ko.annotations(pod)[A.QUEUED_RESOURCE]
+        h.provider.update_all_pod_statuses()
+        assert h.kube.get_pod("default", "train")["status"]["phase"] == "Running"
+        # restart: fresh provider AND fresh backend (empty ports cache)
+        from k8s_runpod_kubelet_tpu.cloud import SshWorkloadBackend
+        from k8s_runpod_kubelet_tpu.provider import Provider
+        h.tpu.workload_backend = SshWorkloadBackend(h.provider.gang)
+        p2 = Provider(h.cfg, h.kube, h.tpu, gang_executor=h.provider.gang,
+                      clock=h.clock)
+        p2.load_running()
+        p2.update_all_pod_statuses()
+        status = h.kube.get_pod("default", "train")["status"]
+        assert status["phase"] == "Running"
+        assert status["containerStatuses"][0]["ready"] is True
+
+    def test_preemption_requeues_through_plain_surface(self, h):
+        h.cfg.preemption_requeue_limit = 1
+        pod = bind_pod(h, make_pod(chips=16))
+        qr1 = ko.annotations(pod)[A.QUEUED_RESOURCE]
+        h.provider.update_all_pod_statuses()
+        h.fake.preempt(qr1)  # whole-slice SUSPENDED (server-side state)
+        h.provider.update_all_pod_statuses()  # requeue
+        h.provider.process_pending_pods()     # redeploy under a fresh name
+        pod = h.kube.get_pod("default", "train")
+        qr2 = ko.annotations(pod)[A.QUEUED_RESOURCE]
+        assert qr2 and qr2 != qr1
+        h.provider.update_all_pod_statuses()
+        assert h.kube.get_pod("default", "train")["status"]["phase"] == "Running"
+        assert extension_requests(h) == []

@@ -34,6 +34,21 @@ def test_train_main_tiny(capsys):
     assert summary["tokens_per_s_per_chip"] > 0
 
 
+def test_train_main_profile_trace(capsys, tmp_path):
+    """--profile-dir captures a TensorBoard-readable trace of post-warmup
+    steps (SURVEY.md §5.1: profiler hooks on workers)."""
+    import os
+    from k8s_runpod_kubelet_tpu.workloads.train_main import main
+    trace_dir = str(tmp_path / "trace")
+    rc = main(["--model", "tiny", "--steps", "6", "--batch", "2",
+               "--seq-len", "32", "--profile-dir", trace_dir])
+    assert rc == 0
+    found = [os.path.join(r, f) for r, _, fs in os.walk(trace_dir) for f in fs]
+    assert any(f.endswith((".trace.json.gz", ".xplane.pb")) for f in found), found
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["tokens_per_s_per_chip"] > 0
+
+
 def test_train_main_with_data_file(capsys, tmp_path):
     import numpy as np
     from k8s_runpod_kubelet_tpu.workloads.train_main import main
