@@ -45,6 +45,14 @@ class WorkerTransport:
         workload backend drives docker through (cloud/workload_backend.py)."""
         raise NotImplementedError
 
+    def stream_exec(self, qr: QueuedResource, worker_id: int, cmd: list[str],
+                    tty: bool = False):
+        """Interactive exec in the workload container: returns a Popen-like
+        object with binary ``.stdin``/``.stdout`` pipes, ``.poll()``,
+        ``.wait()`` and ``.kill()`` — the kubectl-exec streaming surface
+        (node/api_server.py bridges it over WebSocket)."""
+        raise NotImplementedError
+
     def logs(self, qr: QueuedResource, worker_id: int,
              tail_lines: Optional[int] = None) -> str:
         """Workload container logs on one worker."""
@@ -86,6 +94,18 @@ class SshWorkerTransport(WorkerTransport):
     def host_run(self, qr, worker_id, cmd, timeout_s=60.0):
         return self._ssh(qr, worker_id,
                          " ".join(shlex.quote(c) for c in cmd), timeout_s)
+
+    def stream_exec(self, qr, worker_id, cmd, tty=False):
+        inner = " ".join(shlex.quote(c) for c in cmd)
+        flags = "-it" if tty else "-i"
+        argv = ["ssh", *self.ssh_opts]
+        if tty:
+            argv.append("-tt")  # force a remote pty for the container's tty
+        argv += [self._target(qr, worker_id),
+                 f"docker exec {flags} {self.container_name} {inner}"]
+        return subprocess.Popen(argv, stdin=subprocess.PIPE,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT)
 
     def logs(self, qr, worker_id, tail_lines=None):
         tail = f" --tail {tail_lines}" if tail_lines else ""
@@ -139,6 +159,12 @@ class GangExecutor:
             raise WorkerExecError(f"slice {qr.name} has no worker {worker_id}")
         fn = self.transport.host_run if host else self.transport.run
         return fn(qr, worker_id, cmd, timeout_s)
+
+    def stream_exec(self, qr: QueuedResource, worker_id: int, cmd: list[str],
+                    tty: bool = False):
+        if not qr.workers or worker_id >= len(qr.workers):
+            raise WorkerExecError(f"slice {qr.name} has no worker {worker_id}")
+        return self.transport.stream_exec(qr, worker_id, cmd, tty=tty)
 
     def run_on_all(self, qr: QueuedResource, cmd: list[str],
                    timeout_s: float = 60.0, host: bool = False) -> dict[int, str]:

@@ -177,6 +177,24 @@ class FakeWorkerHost(WorkerTransport):
             raise WorkerExecError(f"container {name} is not running", exit_code=1)
         return f"exec:{' '.join(cmd)}\n"
 
+    def stream_exec(self, qr, worker_id, cmd, tty=False):
+        """Interactive exec simulation: requires a running workload container
+        on the worker, then runs the command as a LOCAL subprocess so the
+        WebSocket bridge is exercised against real pipes/exit codes."""
+        import subprocess
+        key = (qr.name, worker_id)
+        with self.lock:
+            if key in self.dead_workers:
+                raise WorkerExecError("ssh: No route to host",
+                                      exit_code=_UNREACHABLE_EXIT)
+            c = self.hosts.get(key, {}).get("workload")
+            if c is None or c.status != "running":
+                raise WorkerExecError("container workload is not running",
+                                      exit_code=1)
+        return subprocess.Popen(cmd, stdin=subprocess.PIPE,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT)
+
     def logs(self, qr, worker_id, tail_lines=None):
         key = (qr.name, worker_id)
         with self.lock:

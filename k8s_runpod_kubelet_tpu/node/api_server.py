@@ -11,9 +11,14 @@ Endpoints (kubelet-API shaped):
                                                        ?worker=I for one worker)
   POST /run/{ns}/{pod}/{container}                  -> {"cmd": [...]} run on
                                                        worker 0 (?worker=I), returns
-                                                       output (old-kubelet /run shape;
-                                                       SPDY streaming exec is out of
-                                                       scope for a virtual node)
+                                                       output (old-kubelet /run shape)
+  GET  /exec/{ns}/{pod}/{container}?command=...     -> WebSocket upgrade with the
+                                                       Kubernetes channel protocol
+                                                       (v4.channel.k8s.io): real
+                                                       streaming `kubectl exec -it`
+                                                       bridged to the worker
+                                                       (?worker=I, &tty=true,
+                                                       repeated &command= args)
   GET  /healthz                                     -> "ok"
 
 Security: the reference serves :10250 through the virtual-kubelet lib's
@@ -34,10 +39,13 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
+from . import ws
+
 log = logging.getLogger(__name__)
 
 _LOGS_RE = re.compile(r"^/containerLogs/(?P<ns>[^/]+)/(?P<pod>[^/]+)/(?P<container>[^/]+)$")
 _RUN_RE = re.compile(r"^/run/(?P<ns>[^/]+)/(?P<pod>[^/]+)/(?P<container>[^/]+)$")
+_EXEC_RE = re.compile(r"^/exec/(?P<ns>[^/]+)/(?P<pod>[^/]+)/(?P<container>[^/]+)$")
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -76,6 +84,9 @@ class _Handler(BaseHTTPRequestHandler):
             body = json.dumps({"kind": "PodList", "apiVersion": "v1",
                                "items": pods}).encode()
             return self._send(200, body, "application/json")
+        m = _EXEC_RE.match(url.path)
+        if m:
+            return self._do_exec_ws(m, q)
         m = _LOGS_RE.match(url.path)
         if m:
             try:
@@ -94,6 +105,103 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send(500, f"logs failed: {e}".encode())
             return self._send(200, logs.encode())
         self._send(404, f"no route {url.path}".encode())
+
+    # -- streaming exec (kubectl exec -it) -------------------------------------
+
+    def _do_exec_ws(self, m, q):
+        """Bridge a worker-side interactive exec over the WebSocket channel
+        protocol. The whole session runs on this connection's handler thread
+        plus one stdout pump thread."""
+        if not ws.is_upgrade(self.headers):
+            return self._send(400, b"exec requires a WebSocket upgrade "
+                                   b"(kubectl exec dials ws)")
+        cmd = q.get("command", [])
+        if not cmd:
+            return self._send(400, b"missing ?command=")
+        try:
+            worker = int(q.get("worker", ["0"])[0])
+        except ValueError as e:
+            return self._send(400, f"bad query parameter: {e}".encode())
+        tty = q.get("tty", ["false"])[0].lower() in ("1", "true")
+        try:
+            proc = self.provider.stream_in_container(
+                m["ns"], m["pod"], m["container"], cmd, worker=worker, tty=tty)
+        except KeyError:
+            return self._send(404, b"pod not found")
+        except NotImplementedError as e:
+            return self._send(501, str(e).encode())
+        except Exception as e:  # noqa: BLE001
+            return self._send(500, f"exec failed: {e}".encode())
+        try:
+            resp, _ = ws.handshake_response(self.headers)
+        except ws.WsError as e:
+            proc.kill()
+            return self._send(400, str(e).encode())
+        self.connection.sendall(resp.encode())
+        self.close_connection = True
+        self.connection.settimeout(None)  # interactive sessions idle freely
+        wlock = threading.Lock()
+
+        def send(channel: int, data: bytes):
+            with wlock:
+                ws.send_channel(self.wfile, channel, data)
+
+        def pump_stdout():
+            import os as _os
+            fd = proc.stdout.fileno()
+            try:
+                while True:
+                    data = _os.read(fd, 65536)
+                    if not data:
+                        break
+                    send(ws.STDOUT, data)
+            except (OSError, ValueError):
+                pass
+            rc = proc.wait()
+            status = ({"metadata": {}, "status": "Success"} if rc == 0 else
+                      {"metadata": {}, "status": "Failure",
+                       "reason": "NonZeroExitCode",
+                       "message": f"command terminated with exit code {rc}",
+                       "details": {"causes": [{"reason": "ExitCode",
+                                               "message": str(rc)}]}})
+            try:
+                send(ws.ERROR, json.dumps(status).encode())
+                with wlock:
+                    ws.send_close(self.wfile)
+            except OSError:
+                pass  # client already gone
+
+        pump = threading.Thread(target=pump_stdout, daemon=True)
+        pump.start()
+        try:
+            while True:
+                opcode, payload = ws.read_frame(self.rfile)
+                if opcode == ws.CLOSE:
+                    break
+                if opcode == ws.PING:
+                    with wlock:
+                        ws.write_frame(self.wfile, payload, ws.PONG)
+                    continue
+                if opcode not in (ws.BINARY, ws.TEXT) or not payload:
+                    continue
+                channel, data = payload[0], payload[1:]
+                if channel == ws.STDIN and data:
+                    try:
+                        proc.stdin.write(data)
+                        proc.stdin.flush()
+                    except (OSError, ValueError):
+                        break  # process ended; close frame follows from pump
+                # RESIZE ignored: worker-side docker exec owns the pty size
+        except (ws.WsError, OSError):
+            pass  # client disconnected
+        finally:
+            try:
+                proc.stdin.close()
+            except (OSError, ValueError):
+                pass
+            if proc.poll() is None:
+                proc.kill()
+            pump.join(timeout=5)
 
     def do_POST(self):
         if not self._authorized():

@@ -1,0 +1,141 @@
+"""Minimal RFC 6455 (WebSocket) server-side framing for the kubelet API.
+
+Implements exactly what `kubectl exec/attach` needs when it dials the kubelet
+over WebSocket with the Kubernetes channel subprotocol
+(`v4.channel.k8s.io`): handshake, masked client frames, binary server
+frames, ping/pong, close. First payload byte is the channel id:
+
+  0 stdin   (client -> kubelet)
+  1 stdout  (kubelet -> client)
+  2 stderr  (kubelet -> client)
+  3 error   (kubelet -> client; terminal v1.Status JSON)
+  4 resize  (client -> kubelet; {"Width":..,"Height":..})
+
+The reference never had this — its exec/logs endpoints are stubs
+(main.go:220-225, kubelet.go:2027-2066). Stdlib-only by design, like the
+rest of the kubelet's HTTP surface.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import struct
+from typing import BinaryIO, Optional
+
+_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+# opcodes
+TEXT = 0x1
+BINARY = 0x2
+CLOSE = 0x8
+PING = 0x9
+PONG = 0xA
+
+# k8s channel protocol channels
+STDIN = 0
+STDOUT = 1
+STDERR = 2
+ERROR = 3
+RESIZE = 4
+
+SUBPROTOCOLS = ("v4.channel.k8s.io", "v3.channel.k8s.io", "channel.k8s.io")
+
+
+class WsError(Exception):
+    pass
+
+
+def accept_key(client_key: str) -> str:
+    digest = hashlib.sha1((client_key + _GUID).encode()).digest()
+    return base64.b64encode(digest).decode()
+
+
+def is_upgrade(headers) -> bool:
+    return ("websocket" in (headers.get("Upgrade", "") or "").lower()
+            and "upgrade" in (headers.get("Connection", "") or "").lower())
+
+
+def choose_subprotocol(headers) -> Optional[str]:
+    offered = [p.strip() for p in
+               (headers.get("Sec-WebSocket-Protocol", "") or "").split(",")
+               if p.strip()]
+    for want in SUBPROTOCOLS:
+        if want in offered:
+            return want
+    return offered[0] if offered else None
+
+
+def handshake_response(headers) -> tuple[str, Optional[str]]:
+    """Returns (response_text, subprotocol). Raises WsError on a bad request."""
+    key = headers.get("Sec-WebSocket-Key")
+    if not key:
+        raise WsError("missing Sec-WebSocket-Key")
+    sub = choose_subprotocol(headers)
+    lines = [
+        "HTTP/1.1 101 Switching Protocols",
+        "Upgrade: websocket",
+        "Connection: Upgrade",
+        f"Sec-WebSocket-Accept: {accept_key(key)}",
+    ]
+    if sub:
+        lines.append(f"Sec-WebSocket-Protocol: {sub}")
+    return "\r\n".join(lines) + "\r\n\r\n", sub
+
+
+def _read_exact(rfile: BinaryIO, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = rfile.read(n - len(buf))
+        if not chunk:
+            raise WsError("connection closed mid-frame")
+        buf += chunk
+    return buf
+
+
+def read_frame(rfile: BinaryIO) -> tuple[int, bytes]:
+    """Returns (opcode, payload) of one complete message (fragments joined)."""
+    opcode = None
+    payload = b""
+    while True:
+        b1, b2 = _read_exact(rfile, 2)
+        fin = b1 & 0x80
+        op = b1 & 0x0F
+        masked = b2 & 0x80
+        length = b2 & 0x7F
+        if length == 126:
+            length = struct.unpack(">H", _read_exact(rfile, 2))[0]
+        elif length == 127:
+            length = struct.unpack(">Q", _read_exact(rfile, 8))[0]
+        if length > 32 * 1024 * 1024:
+            raise WsError(f"frame too large: {length}")
+        mask = _read_exact(rfile, 4) if masked else b""
+        data = _read_exact(rfile, length) if length else b""
+        if mask:
+            data = bytes(c ^ mask[i % 4] for i, c in enumerate(data))
+        if op != 0:  # not a continuation
+            opcode = op
+        payload += data
+        if fin:
+            return opcode if opcode is not None else 0, payload
+
+
+def write_frame(wfile: BinaryIO, payload: bytes, opcode: int = BINARY) -> None:
+    header = bytes([0x80 | opcode])
+    n = len(payload)
+    if n < 126:
+        header += bytes([n])
+    elif n < (1 << 16):
+        header += bytes([126]) + struct.pack(">H", n)
+    else:
+        header += bytes([127]) + struct.pack(">Q", n)
+    wfile.write(header + payload)
+    wfile.flush()
+
+
+def send_channel(wfile: BinaryIO, channel: int, data: bytes) -> None:
+    write_frame(wfile, bytes([channel]) + data, BINARY)
+
+
+def send_close(wfile: BinaryIO, code: int = 1000) -> None:
+    write_frame(wfile, struct.pack(">H", code), CLOSE)

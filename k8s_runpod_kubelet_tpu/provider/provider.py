@@ -358,6 +358,19 @@ class Provider(ReconcileMixin, RecoveryMixin):
             raise KeyError(str(e)) from e
         return self.gang.run_on_worker(qr, worker, cmd)
 
+    def stream_in_container(self, ns: str, name: str, container: str,
+                            cmd: list[str], worker: int = 0,
+                            tty: bool = False):
+        """Interactive exec (kubectl exec -it): a Popen-like handle the
+        kubelet API bridges over the WebSocket channel protocol."""
+        if self.gang is None:
+            raise NotImplementedError("no worker transport configured")
+        try:
+            qr = self._qr_for(ns, name)
+        except (NotFoundError,) as e:
+            raise KeyError(str(e)) from e
+        return self.gang.stream_exec(qr, worker, cmd, tty=tty)
+
     # -- background loops (started by bootstrap; parity kubelet.go:374-376) ----
 
     def start(self):
