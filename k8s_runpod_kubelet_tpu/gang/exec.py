@@ -103,9 +103,12 @@ class SshWorkerTransport(WorkerTransport):
             argv.append("-tt")  # force a remote pty for the container's tty
         argv += [self._target(qr, worker_id),
                  f"docker exec {flags} {self.container_name} {inner}"]
+        # stderr stays a separate pipe: the channel protocol has a dedicated
+        # STDERR channel, and ssh's own diagnostics (host-key warnings) must
+        # never interleave into a binary stdout stream
         return subprocess.Popen(argv, stdin=subprocess.PIPE,
                                 stdout=subprocess.PIPE,
-                                stderr=subprocess.STDOUT)
+                                stderr=subprocess.PIPE)
 
     def logs(self, qr, worker_id, tail_lines=None):
         tail = f" --tail {tail_lines}" if tail_lines else ""
@@ -155,14 +158,14 @@ class GangExecutor:
 
     def run_on_worker(self, qr: QueuedResource, worker_id: int, cmd: list[str],
                       timeout_s: float = 60.0, host: bool = False) -> str:
-        if not qr.workers or worker_id >= len(qr.workers):
+        if not 0 <= worker_id < len(qr.workers):
             raise WorkerExecError(f"slice {qr.name} has no worker {worker_id}")
         fn = self.transport.host_run if host else self.transport.run
         return fn(qr, worker_id, cmd, timeout_s)
 
     def stream_exec(self, qr: QueuedResource, worker_id: int, cmd: list[str],
                     tty: bool = False):
-        if not qr.workers or worker_id >= len(qr.workers):
+        if not 0 <= worker_id < len(qr.workers):
             raise WorkerExecError(f"slice {qr.name} has no worker {worker_id}")
         return self.transport.stream_exec(qr, worker_id, cmd, tty=tty)
 

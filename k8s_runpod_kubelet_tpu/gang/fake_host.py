@@ -193,7 +193,7 @@ class FakeWorkerHost(WorkerTransport):
                                       exit_code=1)
         return subprocess.Popen(cmd, stdin=subprocess.PIPE,
                                 stdout=subprocess.PIPE,
-                                stderr=subprocess.STDOUT)
+                                stderr=subprocess.PIPE)
 
     def logs(self, qr, worker_id, tail_lines=None):
         key = (qr.name, worker_id)

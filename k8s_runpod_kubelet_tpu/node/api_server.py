@@ -49,6 +49,10 @@ _EXEC_RE = re.compile(r"^/exec/(?P<ns>[^/]+)/(?P<pod>[^/]+)/(?P<container>[^/]+)
 
 
 class _Handler(BaseHTTPRequestHandler):
+    # kubectl and client-go speak HTTP/1.1 and expect it back; the stdlib
+    # default (HTTP/1.0) also disables keep-alive, which breaks clients that
+    # pipeline /pods polls over one connection
+    protocol_version = "HTTP/1.1"
     provider = None    # bound by server factory
     auth_token = None  # bound by server factory; None = no auth required
     # per-connection socket timeout: bounds how long a stalled peer (or a
@@ -59,9 +63,16 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def _send(self, status: int, body: bytes, ctype: str = "text/plain"):
+        if status >= 400:
+            # error paths can return before reading a POST body; under
+            # HTTP/1.1 keep-alive the unread bytes would be parsed as the
+            # next request line — close instead of desyncing the connection
+            self.close_connection = True
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        if status >= 400:
+            self.send_header("Connection", "close")
         self.end_headers()
         self.wfile.write(body)
 
@@ -123,6 +134,19 @@ class _Handler(BaseHTTPRequestHandler):
         except ValueError as e:
             return self._send(400, f"bad query parameter: {e}".encode())
         tty = q.get("tty", ["false"])[0].lower() in ("1", "true")
+        # validate the whole handshake BEFORE spawning: the exec command has
+        # side effects on the worker, so a client whose session will never
+        # establish (bad key, or only unsupported subprotocols offered) must
+        # be rejected without anything having run
+        offered = (self.headers.get("Sec-WebSocket-Protocol", "") or "").strip()
+        try:
+            resp, sub = ws.handshake_response(self.headers)
+        except ws.WsError as e:
+            return self._send(400, str(e).encode())
+        if offered and sub is None:
+            return self._send(400, b"no supported subprotocol offered "
+                                   b"(server speaks " +
+                              ", ".join(ws.SUBPROTOCOLS).encode() + b")")
         try:
             proc = self.provider.stream_in_container(
                 m["ns"], m["pod"], m["container"], cmd, worker=worker, tty=tty)
@@ -132,11 +156,6 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send(501, str(e).encode())
         except Exception as e:  # noqa: BLE001
             return self._send(500, f"exec failed: {e}".encode())
-        try:
-            resp, _ = ws.handshake_response(self.headers)
-        except ws.WsError as e:
-            proc.kill()
-            return self._send(400, str(e).encode())
         self.connection.sendall(resp.encode())
         self.close_connection = True
         self.connection.settimeout(None)  # interactive sessions idle freely
@@ -146,17 +165,31 @@ class _Handler(BaseHTTPRequestHandler):
             with wlock:
                 ws.send_channel(self.wfile, channel, data)
 
-        def pump_stdout():
+        def pump_stream(stream, channel: int):
             import os as _os
-            fd = proc.stdout.fileno()
+            fd = stream.fileno()
             try:
                 while True:
                     data = _os.read(fd, 65536)
                     if not data:
                         break
-                    send(ws.STDOUT, data)
+                    send(channel, data)
             except (OSError, ValueError):
                 pass
+
+        # stdout and (when the transport keeps it separate) stderr each get
+        # their own pump onto their own k8s channel; the finisher waits for
+        # both before reporting exit status and closing
+        pumps = [threading.Thread(target=pump_stream,
+                                  args=(proc.stdout, ws.STDOUT), daemon=True)]
+        if getattr(proc, "stderr", None) is not None:
+            pumps.append(threading.Thread(target=pump_stream,
+                                          args=(proc.stderr, ws.STDERR),
+                                          daemon=True))
+
+        def finisher():
+            for t in pumps:
+                t.join()
             rc = proc.wait()
             status = ({"metadata": {}, "status": "Success"} if rc == 0 else
                       {"metadata": {}, "status": "Failure",
@@ -171,11 +204,14 @@ class _Handler(BaseHTTPRequestHandler):
             except OSError:
                 pass  # client already gone
 
-        pump = threading.Thread(target=pump_stdout, daemon=True)
+        for t in pumps:
+            t.start()
+        pump = threading.Thread(target=finisher, daemon=True)
         pump.start()
+        reader = ws.MessageReader(self.rfile)
         try:
             while True:
-                opcode, payload = ws.read_frame(self.rfile)
+                opcode, payload = reader.next()
                 if opcode == ws.CLOSE:
                     break
                 if opcode == ws.PING:

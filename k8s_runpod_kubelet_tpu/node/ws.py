@@ -57,13 +57,16 @@ def is_upgrade(headers) -> bool:
 
 
 def choose_subprotocol(headers) -> Optional[str]:
+    """Pick the first channel protocol we actually implement. RFC 6455 §4.2.2:
+    never echo an unknown offer — a client offered only v5.channel.k8s.io
+    would otherwise assume v5 semantics (stdin half-close) we don't speak."""
     offered = [p.strip() for p in
                (headers.get("Sec-WebSocket-Protocol", "") or "").split(",")
                if p.strip()]
     for want in SUBPROTOCOLS:
         if want in offered:
             return want
-    return offered[0] if offered else None
+    return None
 
 
 def handshake_response(headers) -> tuple[str, Optional[str]]:
@@ -93,31 +96,61 @@ def _read_exact(rfile: BinaryIO, n: int) -> bytes:
     return buf
 
 
+def read_raw_frame(rfile: BinaryIO) -> tuple[bool, int, bytes]:
+    """One wire frame: (fin, opcode, unmasked payload)."""
+    b1, b2 = _read_exact(rfile, 2)
+    fin = bool(b1 & 0x80)
+    op = b1 & 0x0F
+    masked = b2 & 0x80
+    length = b2 & 0x7F
+    if length == 126:
+        length = struct.unpack(">H", _read_exact(rfile, 2))[0]
+    elif length == 127:
+        length = struct.unpack(">Q", _read_exact(rfile, 8))[0]
+    if length > 32 * 1024 * 1024:
+        raise WsError(f"frame too large: {length}")
+    mask = _read_exact(rfile, 4) if masked else b""
+    data = _read_exact(rfile, length) if length else b""
+    if mask:
+        data = bytes(c ^ mask[i % 4] for i, c in enumerate(data))
+    return fin, op, data
+
+
+class MessageReader:
+    """Assembles fragmented data messages while letting control frames
+    (PING/PONG/CLOSE) interleave between fragments, as RFC 6455 §5.4 allows —
+    a control frame returns immediately without disturbing the in-progress
+    fragment sequence, which is preserved across calls."""
+
+    def __init__(self, rfile: BinaryIO):
+        self._rfile = rfile
+        self._op: Optional[int] = None
+        self._buf = b""
+
+    def next(self) -> tuple[int, bytes]:
+        while True:
+            fin, op, data = read_raw_frame(self._rfile)
+            if op >= 0x8:  # control frames are never fragmented
+                return op, data
+            if op != 0:
+                self._op, self._buf = op, data
+            else:
+                if len(self._buf) + len(data) > 32 * 1024 * 1024:
+                    # the per-frame cap must also bound the ASSEMBLED message,
+                    # or endless fin=0 fragments grow _buf without limit
+                    raise WsError("fragmented message too large")
+                self._buf += data
+            if fin:
+                out = (self._op if self._op is not None else 0, self._buf)
+                self._op, self._buf = None, b""
+                return out
+
+
 def read_frame(rfile: BinaryIO) -> tuple[int, bytes]:
-    """Returns (opcode, payload) of one complete message (fragments joined)."""
-    opcode = None
-    payload = b""
-    while True:
-        b1, b2 = _read_exact(rfile, 2)
-        fin = b1 & 0x80
-        op = b1 & 0x0F
-        masked = b2 & 0x80
-        length = b2 & 0x7F
-        if length == 126:
-            length = struct.unpack(">H", _read_exact(rfile, 2))[0]
-        elif length == 127:
-            length = struct.unpack(">Q", _read_exact(rfile, 8))[0]
-        if length > 32 * 1024 * 1024:
-            raise WsError(f"frame too large: {length}")
-        mask = _read_exact(rfile, 4) if masked else b""
-        data = _read_exact(rfile, length) if length else b""
-        if mask:
-            data = bytes(c ^ mask[i % 4] for i, c in enumerate(data))
-        if op != 0:  # not a continuation
-            opcode = op
-        payload += data
-        if fin:
-            return opcode if opcode is not None else 0, payload
+    """Returns (opcode, payload) of one complete message. For streams where a
+    control frame may interleave a fragmented message, hold a MessageReader
+    instead (this helper cannot keep fragment state across calls)."""
+    return MessageReader(rfile).next()
 
 
 def write_frame(wfile: BinaryIO, payload: bytes, opcode: int = BINARY) -> None:

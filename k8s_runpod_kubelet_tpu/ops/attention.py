@@ -153,7 +153,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
     def _finalize():
         l = jnp.maximum(l_ref[:, :1], 1e-30)
         o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
-        lse_ref[0, 0] = (m_ref[:, :1] + jnp.log(l))[:, 0]
+        lse_ref[0, 0] = m_ref[:, :1] + jnp.log(l)              # (bq, 1)
 
 
 def _flash_fwd_pallas(q, k, v, causal: bool, scale: float, block_q: int,
@@ -180,11 +180,14 @@ def _flash_fwd_pallas(q, k, v, causal: bool, scale: float, block_q: int,
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda bb, h, i, j: (bb, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda bb, h, i, j: (bb, h, i)),
+            # lse rides a trailing singleton dim: TPU tiling requires the last
+            # two block dims to divide (8, 128) or equal the array dims, so a
+            # rank-3 (1, 1, block_q) block can't lower; (block_q, 1) can
+            pl.BlockSpec((1, 1, block_q, 1), lambda bb, h, i, j: (bb, h, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b, hq, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b, hq, sq, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -214,8 +217,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _compute():
         q = q_ref[0, 0].astype(jnp.float32) * sm_scale        # (bq, d)
         do = do_ref[0, 0].astype(jnp.float32)                 # (bq, d)
-        lse = lse_ref[0, 0][:, None]                          # (bq, 1)
-        delta = delta_ref[0, 0][:, None]                      # (bq, 1)
+        lse = lse_ref[0, 0]                                   # (bq, 1)
+        delta = delta_ref[0, 0]                               # (bq, 1)
         kc = k_ref[0, 0].astype(jnp.float32)                  # (bk, d)
         vc = v_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(q, kc, (((1,), (1,)), ((), ())),
@@ -258,8 +261,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         v = v_ref[0, 0].astype(jnp.float32)
         qc = q_ref[0, 0].astype(jnp.float32)                  # (bq, d)
         doc = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, None]                          # (bq, 1)
-        delta = delta_ref[0, 0][:, None]
+        lse = lse_ref[0, 0]                                   # (bq, 1)
+        delta = delta_ref[0, 0]
         s = jax.lax.dot_general(qc * sm_scale, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # (bq, bk)
         if causal:
@@ -298,7 +301,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal: bool, scale: float,
     num_q_blocks = sq // block_q
     num_k_blocks = sk // block_k
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1)                                  # (b, hq, sq)
+                    axis=-1, keepdims=True)                   # (b, hq, sq, 1)
 
     dq_kernel = functools.partial(_dq_kernel, block_q=block_q,
                                   block_k=block_k, num_k_blocks=num_k_blocks,
@@ -313,8 +316,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal: bool, scale: float,
             pl.BlockSpec((1, 1, block_k, d),
                          lambda bb, h, i, j: (bb, h // group, j, 0)),
             pl.BlockSpec((1, 1, block_q, d), lambda bb, h, i, j: (bb, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda bb, h, i, j: (bb, h, i)),
-            pl.BlockSpec((1, 1, block_q), lambda bb, h, i, j: (bb, h, i)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bb, h, i, j: (bb, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bb, h, i, j: (bb, h, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, d),
                                lambda bb, h, i, j: (bb, h, i, 0)),
@@ -350,12 +353,12 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal: bool, scale: float,
             pl.BlockSpec((1, 1, block_q, d),
                          lambda bb, kh, j, t: (bb, _qh(bb, kh, j, t),
                                                t % num_q_blocks, 0)),
-            pl.BlockSpec((1, 1, block_q),
+            pl.BlockSpec((1, 1, block_q, 1),
                          lambda bb, kh, j, t: (bb, _qh(bb, kh, j, t),
-                                               t % num_q_blocks)),
-            pl.BlockSpec((1, 1, block_q),
+                                               t % num_q_blocks, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
                          lambda bb, kh, j, t: (bb, _qh(bb, kh, j, t),
-                                               t % num_q_blocks)),
+                                               t % num_q_blocks, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_k, d), lambda bb, kh, j, t: (bb, kh, j, 0)),

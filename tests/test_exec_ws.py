@@ -24,6 +24,22 @@ from harness import make_ssh_harness, make_pod
 
 # -- minimal RFC6455 client (client->server frames masked, per spec) ----------
 
+class _WsReader:
+    """File-like over the socket that first drains bytes received past the
+    handshake boundary — a fast-exiting exec can deliver its first frames in
+    the same recv() chunk as the 101 headers."""
+
+    def __init__(self, sock, leftover: bytes):
+        self._buf = leftover
+        self._f = sock.makefile("rb")
+
+    def read(self, n: int) -> bytes:
+        if self._buf:
+            out, self._buf = self._buf[:n], self._buf[n:]
+            return out
+        return self._f.read(n)
+
+
 def ws_connect(port, path, token=None):
     sock = socket.create_connection(("127.0.0.1", port), timeout=10)
     key = base64.b64encode(os.urandom(16)).decode()
@@ -41,8 +57,8 @@ def ws_connect(port, path, token=None):
         if not chunk:
             break
         buf += chunk
-    head = buf.split(b"\r\n\r\n")[0]
-    return sock, head.decode(errors="replace")
+    head, _, rest = buf.partition(b"\r\n\r\n")
+    return sock, head.decode(errors="replace"), _WsReader(sock, rest)
 
 
 def send_channel(sock, channel, data: bytes):
@@ -58,9 +74,8 @@ def send_channel(sock, channel, data: bytes):
     sock.sendall(header + mask + masked)
 
 
-def read_until_close(sock):
+def read_until_close(f):
     """Returns (stdout_bytes, error_channel_payloads)."""
-    f = sock.makefile("rb")
     out, errs = b"", []
     while True:
         opcode, payload = ws.read_frame(f)
@@ -96,20 +111,20 @@ def exec_path(cmd_args, worker=0):
 class TestExecWebSocket:
     def test_stdin_stdout_roundtrip_and_success_status(self, rig):
         _, srv = rig
-        sock, head = ws_connect(srv.port, exec_path(
+        sock, head, f = ws_connect(srv.port, exec_path(
             ["sh", "-c", "read line; echo got:$line"]))
         assert "101" in head and "v4.channel.k8s.io" in head
         send_channel(sock, ws.STDIN, b"hello\n")
-        out, errs = read_until_close(sock)
+        out, errs = read_until_close(f)
         sock.close()
         assert b"got:hello" in out
         assert errs and errs[-1]["status"] == "Success"
 
     def test_nonzero_exit_reported_on_error_channel(self, rig):
         _, srv = rig
-        sock, head = ws_connect(srv.port, exec_path(["sh", "-c", "exit 3"]))
+        sock, head, f = ws_connect(srv.port, exec_path(["sh", "-c", "exit 3"]))
         assert "101" in head
-        _, errs = read_until_close(sock)
+        _, errs = read_until_close(f)
         sock.close()
         st = errs[-1]
         assert st["status"] == "Failure" and st["reason"] == "NonZeroExitCode"
@@ -118,9 +133,8 @@ class TestExecWebSocket:
     def test_streaming_is_incremental_not_buffered(self, rig):
         """Output must arrive as produced (streamed), not after exit."""
         _, srv = rig
-        sock, _ = ws_connect(srv.port, exec_path(
+        sock, _, f = ws_connect(srv.port, exec_path(
             ["sh", "-c", "echo first; read line; echo second:$line"]))
-        f = sock.makefile("rb")
         opcode, payload = ws.read_frame(f)
         assert payload[0] == ws.STDOUT and b"first" in payload[1:]
         # the process is still alive waiting on stdin — now feed it
@@ -137,13 +151,13 @@ class TestExecWebSocket:
         srv2 = KubeletApiServer(h.provider, address="127.0.0.1", port=0,
                                 auth_token="s3cret").start()
         try:
-            sock, head = ws_connect(srv2.port, exec_path(["true"]))
+            sock, head, _ = ws_connect(srv2.port, exec_path(["true"]))
             assert head.startswith("HTTP/1.1 401")
             sock.close()
-            sock, head = ws_connect(srv2.port, exec_path(
+            sock, head, f = ws_connect(srv2.port, exec_path(
                 ["sh", "-c", "exit 0"]), token="s3cret")
             assert "101" in head
-            _, errs = read_until_close(sock)
+            _, errs = read_until_close(f)
             assert errs[-1]["status"] == "Success"
             sock.close()
         finally:
@@ -158,7 +172,91 @@ class TestExecWebSocket:
             urllib.request.urlopen(f"{base}/exec/default/train/main?command=ls",
                                    timeout=5)
         assert ei.value.code == 400  # no websocket upgrade
-        sock, head = ws_connect(srv.port,
-                                "/exec/default/nope/main?command=ls")
+        sock, head, _ = ws_connect(srv.port,
+                                   "/exec/default/nope/main?command=ls")
         assert head.startswith("HTTP/1.1 404")
         sock.close()
+
+
+class TestExecChannelFixes:
+    def test_stderr_arrives_on_its_own_channel(self, rig):
+        """ssh diagnostics / command stderr must not corrupt binary stdout:
+        the channel protocol has a dedicated STDERR channel (2)."""
+        _, srv = rig
+        sock, head, f = ws_connect(srv.port, exec_path(
+            ["sh", "-c", "echo out; echo err >&2"]))
+        assert "101" in head
+        out, err = b"", b""
+        while True:
+            opcode, payload = ws.read_frame(f)
+            if opcode == ws.CLOSE:
+                break
+            if opcode != ws.BINARY or not payload:
+                continue
+            if payload[0] == ws.STDOUT:
+                out += payload[1:]
+            elif payload[0] == ws.STDERR:
+                err += payload[1:]
+        sock.close()
+        assert b"out" in out and b"err" not in out
+        assert b"err" in err
+
+    def test_negative_worker_is_rejected(self, rig):
+        """worker=-1 must error, not silently exec on the last worker."""
+        _, srv = rig
+        sock, head, _ = ws_connect(srv.port, exec_path(["true"], worker=-1))
+        assert head.startswith("HTTP/1.1 5") or head.startswith("HTTP/1.1 4")
+        sock.close()
+
+    def test_unsupported_subprotocol_rejected_before_exec(self, rig):
+        """A client offering only an unknown protocol is rejected with 400
+        BEFORE the command is spawned (exec has side effects on the worker)."""
+        h, srv = rig
+        calls_before = len(h.transport.calls)
+        sock = socket.create_connection(("127.0.0.1", srv.port), timeout=10)
+        key = base64.b64encode(os.urandom(16)).decode()
+        req = ("GET " + exec_path(["true"]) + " HTTP/1.1\r\nHost: x\r\n"
+               "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+               f"Sec-WebSocket-Key: {key}\r\nSec-WebSocket-Version: 13\r\n"
+               "Sec-WebSocket-Protocol: v9.future.k8s.io\r\n\r\n")
+        sock.sendall(req.encode())
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            chunk = sock.recv(4096)
+            if not chunk:
+                break
+            buf += chunk
+        head = buf.split(b"\r\n\r\n")[0].decode()
+        assert head.startswith("HTTP/1.1 400")
+        assert "v9.future.k8s.io" not in head
+        assert len(h.transport.calls) == calls_before  # nothing ran
+        sock.close()
+
+    def test_keepalive_survives_unauthorized_post_with_body(self):
+        """Under HTTP/1.1 an early-401 POST with an unread body must not
+        desync the connection for the next request (connection closes)."""
+        import http.client
+        h = make_ssh_harness()
+        try:
+            srv = KubeletApiServer(h.provider, address="127.0.0.1", port=0,
+                                   auth_token="tok").start()
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                                  timeout=5)
+                conn.request("POST", "/run/default/p/c",
+                             body=json.dumps({"cmd": ["ls"]}),
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                assert resp.status == 401
+                resp.read()
+                # server signalled close — a fresh connection must work fine
+                conn2 = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                                   timeout=5)
+                conn2.request("GET", "/healthz")
+                assert conn2.getresponse().status == 200
+                conn2.close()
+                conn.close()
+            finally:
+                srv.stop()
+        finally:
+            h.close()
