@@ -215,6 +215,9 @@ class GangExecutor:
              tail_lines: Optional[int] = None) -> str:
         """One worker's logs, or all workers' logs with [worker N] prefixes."""
         if worker_id is not None:
+            if not 0 <= worker_id < len(qr.workers):
+                raise WorkerExecError(
+                    f"slice {qr.name} has no worker {worker_id}")
             return self.transport.logs(qr, worker_id, tail_lines)
         chunks = []
         for w in qr.workers:

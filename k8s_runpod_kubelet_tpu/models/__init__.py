@@ -7,6 +7,8 @@
 - ``mixtral``: Mixtral-8x7B sparse-MoE config on the same decoder, routed
   through the expert-parallel MoE MLP (``moe``).
 - ``mnist``: the small Flax CNN for the single-chip smoke workload (config 2).
+- ``convert``: HuggingFace checkpoint import/export (``load_hf``), logits-
+  parity-tested against ``transformers`` for every family.
 """
 
 from .llama import (LlamaConfig, LlamaModel, llama3_8b, llama3_70b, gemma_7b,
@@ -14,8 +16,10 @@ from .llama import (LlamaConfig, LlamaModel, llama3_8b, llama3_70b, gemma_7b,
                     param_logical_axes)
 from .mnist import MnistCNN, mnist_config
 from .moe import moe_mlp, moe_mlp_dense_reference, moe_capacity
+from .convert import load_hf, from_hf_state_dict, to_hf_state_dict
 
 __all__ = ["LlamaConfig", "LlamaModel", "llama3_8b", "llama3_70b", "gemma_7b",
            "mixtral_8x7b", "qwen2_7b", "tiny_llama", "tiny_moe", "init_params",
            "param_logical_axes", "MnistCNN", "mnist_config", "moe_mlp",
-           "moe_mlp_dense_reference", "moe_capacity"]
+           "moe_mlp_dense_reference", "moe_capacity", "load_hf",
+           "from_hf_state_dict", "to_hf_state_dict"]

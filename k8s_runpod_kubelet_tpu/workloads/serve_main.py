@@ -156,6 +156,9 @@ def main(argv=None) -> int:
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--cache-len", type=int, default=2048)
     p.add_argument("--max-new-tokens", type=int, default=256)
+    p.add_argument("--hf-checkpoint", default="",
+                   help="HuggingFace model directory (safetensors/bin) to "
+                        "load real weights from; empty = random init")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -168,7 +171,12 @@ def main(argv=None) -> int:
            "tiny-moe": tiny_moe}[args.model]()
     log.info("loading %s (%.2fB params) on %s", cfg.name,
              cfg.param_count / 1e9, jax.default_backend())
-    params = init_params(cfg, jax.random.PRNGKey(0))
+    if args.hf_checkpoint:
+        from ..models import load_hf
+        # host tree -> one device_put (serving is single-host per replica)
+        params = jax.device_put(load_hf(cfg, args.hf_checkpoint))
+    else:
+        params = init_params(cfg, jax.random.PRNGKey(0))
     engine = ServingEngine(cfg, params, ServingConfig(
         slots=args.slots, cache_len=args.cache_len,
         max_new_tokens=args.max_new_tokens,

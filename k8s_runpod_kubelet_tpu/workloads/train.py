@@ -107,13 +107,27 @@ class Trainer:
     """End-to-end training harness: sharded init, step loop, orbax checkpoints."""
 
     def __init__(self, cfg: LlamaConfig, tc: TrainConfig,
-                 mesh: Optional[Mesh] = None, seed: int = 0):
+                 mesh: Optional[Mesh] = None, seed: int = 0,
+                 initial_params: Optional[Params] = None):
         self.cfg = cfg
         self.tc = tc
         self.mesh = mesh
         self.model = LlamaModel(cfg, mesh)
         self.optimizer = make_optimizer(tc)
-        self.params = init_params(cfg, jax.random.PRNGKey(seed), mesh)
+        if initial_params is not None:
+            # host (e.g. HF-converted) tree: commit straight to the target
+            # shardings — never a random init that would be thrown away, and
+            # never a full copy on one device first
+            if mesh is not None:
+                axes = param_logical_axes(cfg)
+                self.params = jax.tree_util.tree_map(
+                    lambda p, a: jax.device_put(p, logical_sharding(mesh, a)),
+                    initial_params, axes)
+            else:
+                self.params = jax.tree_util.tree_map(jnp.asarray,
+                                                     initial_params)
+        else:
+            self.params = init_params(cfg, jax.random.PRNGKey(seed), mesh)
         # optax state mirrors the (already-sharded) params, so it inherits
         # their shardings — no separate placement pass needed
         self.opt_state = self.optimizer.init(self.params)

@@ -38,6 +38,10 @@ def main(argv=None) -> int:
                    help="pipeline microbatches (0 = one per stage)")
     p.add_argument("--fsdp", type=int, default=0,
                    help="0 or -1 = auto: all non-tp/sp/pp devices")
+    p.add_argument("--hf-checkpoint", default="",
+                   help="initialize weights from a HuggingFace model "
+                        "directory (fine-tune); an orbax checkpoint in "
+                        "--checkpoint-dir still wins on resume")
     p.add_argument("--checkpoint-dir", default="")
     p.add_argument("--checkpoint-every", type=int, default=500)
     p.add_argument("--data", default="",
@@ -102,9 +106,14 @@ def main(argv=None) -> int:
                      seq_len=args.seq_len, steps=args.steps,
                      checkpoint_dir=args.checkpoint_dir,
                      checkpoint_every=args.checkpoint_every)
-    trainer = Trainer(cfg, tc, mesh=mesh)
+    initial = None
+    if args.hf_checkpoint:
+        from ..models import load_hf
+        initial = load_hf(cfg, args.hf_checkpoint)  # host tree; Trainer shards
+        log.info("initializing from HF checkpoint %s", args.hf_checkpoint)
+    trainer = Trainer(cfg, tc, mesh=mesh, initial_params=initial)
     if args.checkpoint_dir:
-        trainer.restore()  # resume-from-preemption path
+        trainer.restore()  # resume-from-preemption path (wins over --hf-checkpoint)
     batches = None
     loader = None
     if args.data:

@@ -1,0 +1,205 @@
+"""HF checkpoint import: logits parity against the `transformers` reference
+implementations on randomly-initialized tiny models of every supported family.
+
+This is the strongest architecture-fidelity test in the repo: it pins the RoPE
+convention, GQA layout, norm placement/centering, activation, embedding
+scaling/tying, qkv bias, and MoE routing all at once — any mismatch shows up
+as diverged logits. (The reference framework has no model code to compare
+against, SURVEY.md §2.4; `transformers` is the de-facto ground truth for these
+architectures.)
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from k8s_runpod_kubelet_tpu.models import LlamaModel, tiny_llama, tiny_moe
+from k8s_runpod_kubelet_tpu.models.convert import (from_hf_state_dict, load_hf,
+                                                   to_hf_state_dict)
+
+B, S = 2, 16
+
+
+def _f32(cfg):
+    return dataclasses.replace(cfg, dtype=jnp.float32,
+                               param_dtype=jnp.float32, remat=False)
+
+
+def _tokens(vocab):
+    rng = np.random.default_rng(0)
+    return rng.integers(0, vocab, (B, S)).astype(np.int32)
+
+
+def _compare(cfg, hf_model, atol=3e-4):
+    hf_model.eval()
+    toks = _tokens(cfg.vocab_size)
+    with torch.no_grad():
+        ref = hf_model(torch.from_numpy(toks.astype(np.int64))).logits.numpy()
+    params = load_hf(cfg, hf_model)
+    ours = np.asarray(LlamaModel(cfg).forward(params, jnp.asarray(toks)))
+    np.testing.assert_allclose(ours, ref, atol=atol, rtol=3e-4)
+
+
+class TestLogitsParity:
+    def test_llama_gqa(self):
+        torch.manual_seed(0)
+        hf = transformers.LlamaForCausalLM(transformers.LlamaConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=112,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, rope_theta=10_000.0,
+            rms_norm_eps=1e-5, tie_word_embeddings=False,
+            attn_implementation="eager"))
+        cfg = _f32(tiny_llama(vocab_size=128, embed_dim=64, n_layers=2,
+                              n_heads=4, n_kv_heads=2, mlp_dim=112,
+                              max_seq_len=64, rope_theta=10_000.0))
+        _compare(cfg, hf)
+
+    def test_qwen2_with_qkv_bias(self):
+        torch.manual_seed(1)
+        hf = transformers.Qwen2ForCausalLM(transformers.Qwen2Config(
+            vocab_size=128, hidden_size=64, intermediate_size=112,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, rope_theta=10_000.0,
+            rms_norm_eps=1e-6, tie_word_embeddings=False,
+            attn_implementation="eager"))
+        # Qwen2 puts bias on q/k/v projections — make sure the checkpoint
+        # really has them, then require our config to carry them over
+        assert "model.layers.0.self_attn.q_proj.bias" in hf.state_dict()
+        cfg = _f32(tiny_llama(vocab_size=128, embed_dim=64, n_layers=2,
+                              n_heads=4, n_kv_heads=2, mlp_dim=112,
+                              max_seq_len=64, rope_theta=10_000.0,
+                              norm_eps=1e-6, qkv_bias=True))
+        _compare(cfg, hf)
+
+    def test_gemma_tied_gelu_zero_centered_norm(self):
+        torch.manual_seed(2)
+        hf = transformers.GemmaForCausalLM(transformers.GemmaConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=112,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+            head_dim=16, max_position_embeddings=64, rope_theta=10_000.0,
+            rms_norm_eps=1e-6, hidden_activation="gelu_pytorch_tanh",
+            attn_implementation="eager"))
+        cfg = _f32(tiny_llama(vocab_size=128, embed_dim=64, n_layers=2,
+                              n_heads=4, n_kv_heads=4, head_dim=16,
+                              mlp_dim=112, max_seq_len=64,
+                              rope_theta=10_000.0, norm_eps=1e-6,
+                              tie_embeddings=True, mlp_activation="gelu_tanh",
+                              embed_scale=True, norm_zero_centered=True))
+        _compare(cfg, hf, atol=1e-3)  # sqrt(E)-scaled embeddings amplify eps
+
+    def test_mixtral_sparse_moe(self):
+        torch.manual_seed(3)
+        hf = transformers.MixtralForCausalLM(transformers.MixtralConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=96,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            num_local_experts=4, num_experts_per_tok=2,
+            max_position_embeddings=64, rope_theta=10_000.0,
+            rms_norm_eps=1e-5, tie_word_embeddings=False,
+            attn_implementation="eager"))
+        # capacity n_experts/k = no token ever drops — required for exact
+        # parity with HF's dense expert loop
+        cfg = _f32(tiny_moe(vocab_size=128, embed_dim=64, n_layers=2,
+                            n_heads=4, n_kv_heads=2, mlp_dim=96,
+                            max_seq_len=64, rope_theta=10_000.0,
+                            n_experts=4, n_experts_per_tok=2,
+                            capacity_factor=2.0))
+        _compare(cfg, hf)
+
+
+class TestRoundTrip:
+    def test_export_import_identity(self):
+        import jax
+        from k8s_runpod_kubelet_tpu.models import init_params
+        cfg = _f32(tiny_llama(vocab_size=64, embed_dim=32, n_layers=2,
+                              n_heads=2, n_kv_heads=1, mlp_dim=48,
+                              qkv_bias=True))
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        sd = to_hf_state_dict(cfg, params)
+        back = from_hf_state_dict(cfg, sd)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                       np.asarray(b)),
+            params, back)
+
+    def test_tied_checkpoint_into_untied_config(self):
+        """A tied-embedding checkpoint (no lm_head key) must load into an
+        untied config by materializing the tie."""
+        torch.manual_seed(4)
+        hf = transformers.LlamaForCausalLM(transformers.LlamaConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=48,
+            num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+            tie_word_embeddings=True, attn_implementation="eager"))
+        sd = {k: v for k, v in hf.state_dict().items() if k != "lm_head.weight"}
+        cfg = _f32(tiny_llama(vocab_size=64, embed_dim=32, n_layers=1,
+                              n_heads=2, n_kv_heads=2, mlp_dim=48))
+        params = from_hf_state_dict(cfg, sd)
+        np.testing.assert_allclose(np.asarray(params["lm_head"]),
+                                   np.asarray(params["tok_embed"]).T)
+
+
+class TestDirectoryLoading:
+    def test_load_from_safetensors_dir(self, tmp_path):
+        """load_hf(path): a save_pretrained directory (safetensors) loads and
+        produces the same logits as the in-memory state dict."""
+        torch.manual_seed(5)
+        hf = transformers.LlamaForCausalLM(transformers.LlamaConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=48,
+            num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=1,
+            tie_word_embeddings=False, attn_implementation="eager"))
+        hf.save_pretrained(tmp_path, safe_serialization=True)
+        cfg = _f32(tiny_llama(vocab_size=64, embed_dim=32, n_layers=2,
+                              n_heads=2, n_kv_heads=1, mlp_dim=48))
+        from_dir = load_hf(cfg, str(tmp_path))
+        from_mem = load_hf(cfg, hf)
+        import jax
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-6),
+            from_dir, from_mem)
+
+
+class TestHostPlacement:
+    def test_load_hf_returns_host_arrays(self):
+        """Leaves must stay numpy (host): a model bigger than one chip's HBM
+        must never materialize on device 0 before the caller shards it."""
+        torch.manual_seed(6)
+        hf = transformers.LlamaForCausalLM(transformers.LlamaConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=48,
+            num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+            tie_word_embeddings=False, attn_implementation="eager"))
+        import jax
+        params = load_hf(_f32(tiny_llama(vocab_size=64, embed_dim=32,
+                                         n_layers=1, n_heads=2, n_kv_heads=2,
+                                         mlp_dim=48)), hf)
+        for leaf in jax.tree_util.tree_leaves(params):
+            assert isinstance(leaf, np.ndarray), type(leaf)
+
+    def test_trainer_initial_params_sharded_onto_mesh(self):
+        """Trainer(initial_params=...) commits the host tree with the same
+        shardings init_params would use, and trains from it."""
+        import jax
+        from k8s_runpod_kubelet_tpu.parallel import MeshConfig, make_mesh
+        from k8s_runpod_kubelet_tpu.workloads.train import TrainConfig, Trainer
+        torch.manual_seed(7)
+        hf = transformers.LlamaForCausalLM(transformers.LlamaConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=48,
+            num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=2,
+            tie_word_embeddings=False, attn_implementation="eager"))
+        cfg = _f32(tiny_llama(vocab_size=64, embed_dim=32, n_layers=2,
+                              n_heads=2, n_kv_heads=2, mlp_dim=48,
+                              max_seq_len=64))
+        mesh = make_mesh(MeshConfig(data=-1, fsdp=2, tensor=2))
+        host = load_hf(cfg, hf)
+        tr = Trainer(cfg, TrainConfig(batch_size=4, seq_len=16, steps=2),
+                     mesh=mesh, initial_params=host)
+        ref = Trainer(cfg, TrainConfig(batch_size=4, seq_len=16, steps=2),
+                      mesh=mesh)
+        shard_of = lambda t: jax.tree_util.tree_map(lambda x: x.sharding, t)
+        assert shard_of(tr.params) == shard_of(ref.params)
+        out = tr.run(steps=2)
+        assert np.isfinite(out["final_loss"])
