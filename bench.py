@@ -235,7 +235,8 @@ def run_serve_bench(quick: bool) -> int:
     slots, n_req, new_toks = (4, 12, 16) if tiny else (8, 48, 64)
     sc = ServingConfig(slots=slots, max_prefill_len=64,
                        cache_len=128 if tiny else 1024,
-                       max_new_tokens=new_toks)
+                       max_new_tokens=new_toks,
+                       quantize_int8="--int8" in sys.argv)
     engine = ServingEngine(cfg, params, sc).start()
     try:
         engine.submit([1, 2, 3], max_new_tokens=2).result(timeout=900)  # warm

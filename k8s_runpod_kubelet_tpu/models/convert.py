@@ -34,24 +34,27 @@ from .llama import LlamaConfig, Params
 __all__ = ["from_hf_state_dict", "load_hf", "to_hf_state_dict"]
 
 
-def _np(t) -> np.ndarray:
-    """torch tensor / np array -> float32 numpy (bf16 torch can't view as np)."""
+def _np(t, dt: np.dtype) -> np.ndarray:
+    """torch tensor / np array -> numpy in the TARGET dtype. Casting per
+    tensor at read time (instead of a whole-tree f32 pass at the end) bounds
+    peak host RAM to checkpoint + converted tree + ONE transient tensor —
+    a 70B bf16 checkpoint converts without a ~4x f32 blowup."""
     if hasattr(t, "detach"):  # torch.Tensor without importing torch
         t = t.detach().cpu()
         if str(t.dtype) in ("torch.bfloat16", "torch.float16"):
-            t = t.float()
+            t = t.float()  # transient f32, this one tensor only
         t = t.numpy()
-    return np.asarray(t, dtype=np.float32)
+    return np.asarray(t).astype(dt, copy=False)
 
 
-def _stack(sd: Mapping[str, Any], fmt: str, n_layers: int,
+def _stack(sd: Mapping[str, Any], fmt: str, n_layers: int, dt: np.dtype,
            transpose: bool = False) -> np.ndarray:
     outs = []
     for i in range(n_layers):
         name = fmt.format(i=i)
         if name not in sd:
             raise KeyError(f"HF checkpoint missing {name!r}")
-        w = _np(sd[name])
+        w = _np(sd[name], dt)
         outs.append(w.T if transpose else w)
     return np.stack(outs)
 
@@ -73,30 +76,31 @@ def from_hf_state_dict(cfg: LlamaConfig, sd: Mapping[str, Any],
         norm[k[len("model."):] if k.startswith("model.") else k] = v
     sd = norm
     L = cfg.n_layers
+    dt = np.dtype(dtype or cfg.param_dtype)  # jnp.bfloat16 works via ml_dtypes
     pre = "layers.{i}."
 
     layers: dict[str, np.ndarray] = {
-        "attn_norm": _stack(sd, pre + "input_layernorm.weight", L),
-        "wq": _stack(sd, pre + "self_attn.q_proj.weight", L, transpose=True),
-        "wk": _stack(sd, pre + "self_attn.k_proj.weight", L, transpose=True),
-        "wv": _stack(sd, pre + "self_attn.v_proj.weight", L, transpose=True),
-        "wo": _stack(sd, pre + "self_attn.o_proj.weight", L, transpose=True),
-        "mlp_norm": _stack(sd, pre + "post_attention_layernorm.weight", L),
+        "attn_norm": _stack(sd, pre + "input_layernorm.weight", L, dt),
+        "wq": _stack(sd, pre + "self_attn.q_proj.weight", L, dt, transpose=True),
+        "wk": _stack(sd, pre + "self_attn.k_proj.weight", L, dt, transpose=True),
+        "wv": _stack(sd, pre + "self_attn.v_proj.weight", L, dt, transpose=True),
+        "wo": _stack(sd, pre + "self_attn.o_proj.weight", L, dt, transpose=True),
+        "mlp_norm": _stack(sd, pre + "post_attention_layernorm.weight", L, dt),
     }
     if cfg.qkv_bias:
-        layers["wq_b"] = _stack(sd, pre + "self_attn.q_proj.bias", L)
-        layers["wk_b"] = _stack(sd, pre + "self_attn.k_proj.bias", L)
-        layers["wv_b"] = _stack(sd, pre + "self_attn.v_proj.bias", L)
+        layers["wq_b"] = _stack(sd, pre + "self_attn.q_proj.bias", L, dt)
+        layers["wk_b"] = _stack(sd, pre + "self_attn.k_proj.bias", L, dt)
+        layers["wv_b"] = _stack(sd, pre + "self_attn.v_proj.bias", L, dt)
     if cfg.n_experts:
         layers["router"] = _stack(
-            sd, pre + "block_sparse_moe.gate.weight", L, transpose=True)
+            sd, pre + "block_sparse_moe.gate.weight", L, dt, transpose=True)
         gates, ups, downs = [], [], []
         for i in range(L):
-            g = [_np(sd[f"layers.{i}.block_sparse_moe.experts.{e}.w1.weight"]).T
+            g = [_np(sd[f"layers.{i}.block_sparse_moe.experts.{e}.w1.weight"], dt).T
                  for e in range(cfg.n_experts)]
-            u = [_np(sd[f"layers.{i}.block_sparse_moe.experts.{e}.w3.weight"]).T
+            u = [_np(sd[f"layers.{i}.block_sparse_moe.experts.{e}.w3.weight"], dt).T
                  for e in range(cfg.n_experts)]
-            d = [_np(sd[f"layers.{i}.block_sparse_moe.experts.{e}.w2.weight"]).T
+            d = [_np(sd[f"layers.{i}.block_sparse_moe.experts.{e}.w2.weight"], dt).T
                  for e in range(cfg.n_experts)]
             gates.append(np.stack(g))
             ups.append(np.stack(u))
@@ -105,27 +109,24 @@ def from_hf_state_dict(cfg: LlamaConfig, sd: Mapping[str, Any],
         layers["we_up"] = np.stack(ups)
         layers["we_down"] = np.stack(downs)
     else:
-        layers["w_gate"] = _stack(sd, pre + "mlp.gate_proj.weight", L,
+        layers["w_gate"] = _stack(sd, pre + "mlp.gate_proj.weight", L, dt,
                                   transpose=True)
-        layers["w_up"] = _stack(sd, pre + "mlp.up_proj.weight", L,
+        layers["w_up"] = _stack(sd, pre + "mlp.up_proj.weight", L, dt,
                                 transpose=True)
-        layers["w_down"] = _stack(sd, pre + "mlp.down_proj.weight", L,
+        layers["w_down"] = _stack(sd, pre + "mlp.down_proj.weight", L, dt,
                                   transpose=True)
 
     params: Params = {
-        "tok_embed": _np(sd["embed_tokens.weight"]),
-        "final_norm": _np(sd["norm.weight"]),
+        "tok_embed": _np(sd["embed_tokens.weight"], dt),
+        "final_norm": _np(sd["norm.weight"], dt),
         "layers": layers,
     }
     if not cfg.tie_embeddings:
         if "lm_head.weight" in sd:
-            params["lm_head"] = _np(sd["lm_head.weight"]).T
+            params["lm_head"] = _np(sd["lm_head.weight"], dt).T
         else:  # checkpoint ties but config doesn't: materialize the tie
             params["lm_head"] = params["tok_embed"].T.copy()
-
-    dt = np.dtype(dtype or cfg.param_dtype)  # jnp.bfloat16 works via ml_dtypes
-    import jax
-    return jax.tree_util.tree_map(lambda a: np.asarray(a).astype(dt), params)
+    return params
 
 
 def to_hf_state_dict(cfg: LlamaConfig, params: Params) -> dict[str, np.ndarray]:

@@ -261,6 +261,15 @@ def _maybe_remat(fn, cfg: LlamaConfig):
     return jax.checkpoint(fn)
 
 
+def _mm(h, w, dtype):
+    """Matmul against a raw weight or a weight-only-int8 dict leaf
+    ({"q8", "scale"}, models/quant.py). The dequant multiply sits in the
+    matmul epilogue where XLA fuses it — HBM reads stay int8."""
+    if isinstance(w, dict):
+        return (h @ w["q8"].astype(dtype)) * w["scale"].astype(dtype)
+    return h @ w.astype(dtype)
+
+
 def _norm_w(w, cfg: LlamaConfig):
     """Gemma stores RMSNorm weights zero-centered and applies (1 + w)."""
     return w + 1 if cfg.norm_zero_centered else w
@@ -295,9 +304,10 @@ def _embed(params: Params, tokens: jax.Array, cfg: LlamaConfig,
 
 
 def _head_logits(x: jax.Array, params: Params, cfg: LlamaConfig) -> jax.Array:
-    head = (params["tok_embed"].T if cfg.tie_embeddings
-            else params["lm_head"]).astype(cfg.dtype)
-    logits = x @ head
+    if cfg.tie_embeddings:
+        logits = x @ params["tok_embed"].T.astype(cfg.dtype)
+    else:
+        logits = _mm(x, params["lm_head"], cfg.dtype)
     if cfg.logit_softcap:
         cap = jnp.asarray(cfg.logit_softcap, logits.dtype)
         logits = jnp.tanh(logits / cap) * cap
@@ -307,9 +317,9 @@ def _head_logits(x: jax.Array, params: Params, cfg: LlamaConfig) -> jax.Array:
 def _qkv(h, lp, cfg: LlamaConfig, b: int, s: int):
     """q/k/v projections (+ Qwen-style bias when configured), head-split."""
     hd = cfg.head_dim_
-    q = h @ lp["wq"].astype(cfg.dtype)
-    k = h @ lp["wk"].astype(cfg.dtype)
-    v = h @ lp["wv"].astype(cfg.dtype)
+    q = _mm(h, lp["wq"], cfg.dtype)
+    k = _mm(h, lp["wk"], cfg.dtype)
+    v = _mm(h, lp["wv"], cfg.dtype)
     if cfg.qkv_bias:
         q = q + lp["wq_b"].astype(cfg.dtype)
         k = k + lp["wk_b"].astype(cfg.dtype)
@@ -334,7 +344,7 @@ def _attention_block(x, lp, cfg: LlamaConfig, cos, sin, mesh, positions=None):
     else:
         o = flash_attention(qt, kt, vt, causal=True)
     o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * hd)
-    return x + (o @ lp["wo"].astype(cfg.dtype))
+    return x + _mm(o, lp["wo"], cfg.dtype)
 
 
 def _mlp_block(x, lp, cfg: LlamaConfig, mesh, train: bool = True):
@@ -355,10 +365,10 @@ def _mlp_block(x, lp, cfg: LlamaConfig, mesh, train: bool = True):
             activation=_activation(cfg), dtype=cfg.dtype,
             constrain=(lambda t, axes: _constrain(t, mesh, axes)))
         return x + y, cfg.router_aux_coef * aux + cfg.router_z_coef * z
-    gate = h @ lp["w_gate"].astype(cfg.dtype)
-    up = h @ lp["w_up"].astype(cfg.dtype)
+    gate = _mm(h, lp["w_gate"], cfg.dtype)
+    up = _mm(h, lp["w_up"], cfg.dtype)
     act = _constrain(_activation(cfg)(gate) * up, mesh, ("batch", "seq", "act_mlp"))
-    return x + (act @ lp["w_down"].astype(cfg.dtype)), jnp.float32(0.0)
+    return x + _mm(act, lp["w_down"], cfg.dtype), jnp.float32(0.0)
 
 
 class LlamaModel:
@@ -469,7 +479,7 @@ class LlamaModel:
             o = flash_attention(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
                                 v.transpose(0, 2, 1, 3), causal=True)
             o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * cfg.head_dim_)
-            y = y + (o @ lp["wo"].astype(cfg.dtype))
+            y = y + _mm(o, lp["wo"], cfg.dtype)
             y, _ = _mlp_block(y, lp, cfg, self.mesh, train=False)
             return y, (k, v)
 
@@ -529,7 +539,7 @@ class LlamaModel:
             p = jax.nn.softmax(s, axis=-1)
             o = jnp.einsum("bhgL,bLhd->bhgd", p, v_cache.astype(jnp.float32))
             o = o.reshape(b, 1, cfg.n_heads * cfg.head_dim_).astype(cfg.dtype)
-            y = y + (o @ lp["wo"].astype(cfg.dtype))
+            y = y + _mm(o, lp["wo"], cfg.dtype)
             y, _ = _mlp_block(y, lp, cfg, self.mesh, train=False)
             return y, (k_cache, v_cache)
 

@@ -50,6 +50,9 @@ class ServingConfig:
     max_new_tokens: int = 128
     eos_token: int = -1          # -1 = never stop on a token
     temperature: float = 0.0     # 0 = greedy
+    quantize_int8: bool = False  # weight-only int8 (models/quant.py): halves
+                                 # weight HBM traffic on the bandwidth-bound
+                                 # decode step
 
 
 @dataclasses.dataclass
@@ -79,6 +82,9 @@ class ServingEngine:
         self.cfg = cfg
         self.sc = sc
         self.model = LlamaModel(cfg)
+        if sc.quantize_int8:
+            from ..models.quant import quantize_params
+            params = quantize_params(cfg, params)
         self.params = params
         self.metrics = metrics or Metrics()
         self.metrics.describe("tpu_serving_queue_depth",
