@@ -1,7 +1,8 @@
 """Serving workload: HTTP front end over the ServingEngine (config 5).
 
 The pod command for autoscaled inference. Endpoints:
-  POST /generate   {"tokens": [...], "max_new_tokens": N, "temperature": T}
+  POST /generate   {"tokens": [...], "max_new_tokens": N, "temperature": T,
+                    "top_k": K, "top_p": P}
                    -> {"tokens": [...], "rid": ..., "latency_s": ...}
                    with "stream": true -> chunked NDJSON: one {"token": N}
                    line per decoded token, then the final result object
@@ -24,6 +25,12 @@ from concurrent.futures import TimeoutError as FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 log = logging.getLogger("serve-main")
+
+
+def _or(value, default):
+    """JSON null falls back to the default, matching absent-key handling
+    (clients serialize unset option structs as nulls)."""
+    return default if value is None else value
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -71,7 +78,9 @@ class _Handler(BaseHTTPRequestHandler):
         if req.get("stream"):
             return self._generate_stream(tokens, req)
         fut = self.engine.submit(tokens, req.get("max_new_tokens"),
-                                 req.get("temperature"))
+                                 req.get("temperature"),
+                                 top_k=_or(req.get("top_k"), 0),
+                                 top_p=_or(req.get("top_p"), 1.0))
         try:
             out = fut.result(timeout=self.request_timeout_s)
         except FutureTimeout:
@@ -94,7 +103,10 @@ class _Handler(BaseHTTPRequestHandler):
             q.put(("tok", t))
 
         fut = self.engine.submit(tokens, req.get("max_new_tokens"),
-                                 req.get("temperature"), on_token=on_token)
+                                 req.get("temperature"),
+                                 top_k=_or(req.get("top_k"), 0),
+                                 top_p=_or(req.get("top_p"), 1.0),
+                                 on_token=on_token)
         if fut.done() and fut.exception() is not None:
             return self._send(400, {"error": str(fut.exception())})
         fut.add_done_callback(lambda f: q.put(("end", f)))

@@ -63,6 +63,8 @@ class Request:
     future: Future
     submitted_at: float
     temperature: float
+    top_k: int = 0          # 0 = no top-k filter
+    top_p: float = 1.0      # 1.0 = no nucleus filter
     # streaming: called with each generated token id, from the engine thread.
     # A raising callback (client gone) cancels the request at the next token.
     on_token: Optional[Any] = None
@@ -74,6 +76,44 @@ class _Slot:
     generated: list[int] = dataclasses.field(default_factory=list)
     remaining: int = 0
     last_token: int = 0
+
+
+def _sample(logits: jax.Array, key: jax.Array, temps: list[float],
+            top_ks: Optional[list[int]] = None,
+            top_ps: Optional[list[float]] = None) -> jax.Array:
+    """Per-row temperature + top-k + nucleus (top-p) sampling. Pure: callers
+    (engine decode thread, prefill thread) pass their own PRNG key. Filters
+    operate on the temperature-scaled distribution; the (B, V) sort is cheap
+    at serving batch sizes (JetStream does the same)."""
+    greedy = jnp.argmax(logits, axis=-1)
+    if all(t <= 0.0 for t in temps):
+        return greedy
+    b, v = logits.shape
+    top_ks = top_ks or [0] * b
+    top_ps = top_ps or [1.0] * b
+    t = jnp.asarray([max(tt, 1e-6) for tt in temps])[:, None]
+    scaled = (logits / t).astype(jnp.float32)
+    if all(k <= 0 for k in top_ks) and all(p >= 1.0 for p in top_ps):
+        # unfiltered fast path: no (B, V) sort on the per-token hot loop
+        sampled = jax.random.categorical(key, scaled, axis=-1)
+    else:
+        sorted_desc = -jnp.sort(-scaled, axis=-1)              # (B, V) desc
+        # top-k threshold: the k-th largest logit (k=0 -> keep all)
+        ks = jnp.asarray([k if k > 0 else v for k in top_ks])
+        thresh_k = jnp.take_along_axis(
+            sorted_desc, jnp.clip(ks - 1, 0, v - 1)[:, None], axis=-1)
+        # top-p threshold: smallest prefix of the sorted distribution with
+        # cumulative mass >= p; "cum before this token < p" keeps >= 1 token
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        before = jnp.cumsum(probs, axis=-1) - probs
+        keep = before < jnp.asarray(top_ps)[:, None]
+        idx_p = jnp.sum(keep, axis=-1) - 1                     # last kept
+        thresh_p = jnp.take_along_axis(sorted_desc, idx_p[:, None], axis=-1)
+        thresh = jnp.maximum(thresh_k, thresh_p)
+        filtered = jnp.where(scaled >= thresh, scaled, -jnp.inf)
+        sampled = jax.random.categorical(key, filtered, axis=-1)
+    use_sampled = jnp.asarray([tt > 0.0 for tt in temps])
+    return jnp.where(use_sampled, sampled, greedy)
 
 
 class ServingEngine:
@@ -128,9 +168,12 @@ class ServingEngine:
 
     def submit(self, prompt: list[int], max_new_tokens: Optional[int] = None,
                temperature: Optional[float] = None,
+               top_k: int = 0, top_p: float = 1.0,
                on_token=None) -> Future:
         """Enqueue a generation request; resolves to {tokens, latency_s, rid}.
-        ``on_token(tok)`` streams each generated token id as it decodes."""
+        ``on_token(tok)`` streams each generated token id as it decodes.
+        ``top_k``/``top_p`` filter the sampling distribution per request
+        (active only when temperature > 0)."""
         if not prompt:
             f: Future = Future()
             f.set_exception(ValueError("empty prompt"))
@@ -157,12 +200,24 @@ class ServingEngine:
             f.set_exception(ValueError(
                 f"temperature must be a non-negative number, got {temperature!r}"))
             return f
+        if not isinstance(top_k, int) or isinstance(top_k, bool) or top_k < 0:
+            f = Future()
+            f.set_exception(ValueError(
+                f"top_k must be a non-negative int, got {top_k!r}"))
+            return f
+        if not isinstance(top_p, (int, float)) or isinstance(top_p, bool) \
+                or not 0.0 < top_p <= 1.0:
+            f = Future()
+            f.set_exception(ValueError(
+                f"top_p must be in (0, 1], got {top_p!r}"))
+            return f
         req = Request(prompt=list(prompt),
                       max_new_tokens=min(max_new_tokens,
                                          self.sc.cache_len - len(prompt)),
                       rid=uuid.uuid4().hex[:8], future=Future(),
                       submitted_at=time.perf_counter(),
-                      temperature=float(temperature), on_token=on_token)
+                      temperature=float(temperature),
+                      top_k=top_k, top_p=float(top_p), on_token=on_token)
         self._queue.put(req)
         self.metrics.set_gauge("tpu_serving_queue_depth", self._queue.qsize())
         return req.future
@@ -246,12 +301,9 @@ class ServingEngine:
                 true_len = jnp.asarray([len(req.prompt)], jnp.int32)
                 last_logits, single = self._prefill(self.params, prompt,
                                                     single, true_len)
-                if req.temperature <= 0.0:
-                    first = int(jnp.argmax(last_logits, axis=-1)[0])
-                else:
-                    self._prefill_key, sub = jax.random.split(self._prefill_key)
-                    first = int(jax.random.categorical(
-                        sub, last_logits / req.temperature, axis=-1)[0])
+                self._prefill_key, sub = jax.random.split(self._prefill_key)
+                first = int(_sample(last_logits, sub, [req.temperature],
+                                    [req.top_k], [req.top_p])[0])
             except Exception as exc:  # noqa: BLE001 — poisoned prompt only
                 log.exception("prefill of %s failed", req.rid)
                 self.metrics.incr("tpu_serving_prefill_errors")
@@ -295,9 +347,12 @@ class ServingEngine:
         active_mask = jnp.asarray([s.request is not None for s in self._slots])
         logits, self._cache = self._decode(self.params, self._tokens,
                                            self._cache, active_mask)
-        temps = [s.request.temperature if s.request else 0.0 for s in self._slots]
-        # sample per slot (temperatures can differ)
-        next_np = np.asarray(self._sample_batch(logits, temps))
+        reqs = [s.request for s in self._slots]
+        temps = [r.temperature if r else 0.0 for r in reqs]
+        ks = [r.top_k if r else 0 for r in reqs]
+        ps = [r.top_p if r else 1.0 for r in reqs]
+        # sample per slot (temperature / top-k / top-p can differ per request)
+        next_np = np.asarray(self._sample_batch(logits, temps, ks, ps))
         for slot_id, slot in enumerate(self._slots):
             if slot.request is None:
                 continue
@@ -312,15 +367,11 @@ class ServingEngine:
         self._tokens = jnp.asarray(next_np, jnp.int32)
         self.metrics.incr("tpu_serving_decode_steps")
 
-    def _sample_batch(self, logits: jax.Array, temps: list[float]) -> jax.Array:
-        greedy = jnp.argmax(logits, axis=-1)
-        if all(t <= 0.0 for t in temps):
-            return greedy
+    def _sample_batch(self, logits: jax.Array, temps: list[float],
+                      top_ks: Optional[list[int]] = None,
+                      top_ps: Optional[list[float]] = None) -> jax.Array:
         self._key, sub = jax.random.split(self._key)
-        t = jnp.asarray([max(tt, 1e-6) for tt in temps])[:, None]
-        sampled = jax.random.categorical(sub, logits / t, axis=-1)
-        use_sampled = jnp.asarray([tt > 0.0 for tt in temps])
-        return jnp.where(use_sampled, sampled, greedy)
+        return _sample(logits, sub, temps, top_ks, top_ps)
 
     def _emit(self, slot: _Slot, tok: int):
         """Stream a token to the requester; a raising callback means the

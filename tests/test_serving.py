@@ -143,3 +143,80 @@ class TestPrefillDecodeOverlap:
             assert len(good.result(timeout=60)["tokens"]) == 4
         finally:
             e.stop()
+
+
+class TestSampling:
+    """_sample_batch: per-slot temperature / top-k / nucleus filtering."""
+
+    def _engine(self):
+        import dataclasses
+        import jax.numpy as jnp
+        from k8s_runpod_kubelet_tpu.models import init_params, tiny_llama
+        from k8s_runpod_kubelet_tpu.workloads.serving import (ServingConfig,
+                                                              ServingEngine)
+        cfg = dataclasses.replace(
+            tiny_llama(vocab_size=32, embed_dim=32, n_layers=1, n_heads=2,
+                       n_kv_heads=1, mlp_dim=48, max_seq_len=64),
+            dtype=jnp.float32, param_dtype=jnp.float32)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        return ServingEngine(cfg, params, ServingConfig(slots=2, cache_len=32))
+
+    def test_top_k_restricts_support(self):
+        import jax.numpy as jnp
+        import numpy as np
+        eng = self._engine()
+        logits = jnp.asarray(np.random.default_rng(0).normal(
+            size=(2, 32)).astype(np.float32))
+        top2 = np.argsort(-np.asarray(logits), axis=-1)[:, :2]
+        for _ in range(24):
+            toks = np.asarray(eng._sample_batch(
+                logits, temps=[1.5, 1.5], top_ks=[2, 2], top_ps=[1.0, 1.0]))
+            for row in range(2):
+                assert toks[row] in top2[row], (toks[row], top2[row])
+
+    def test_top_p_tiny_equals_greedy(self):
+        import jax.numpy as jnp
+        import numpy as np
+        eng = self._engine()
+        logits = jnp.asarray(np.random.default_rng(1).normal(
+            size=(2, 32)).astype(np.float32))
+        greedy = np.argmax(np.asarray(logits), axis=-1)
+        for _ in range(8):
+            toks = np.asarray(eng._sample_batch(
+                logits, temps=[1.0, 1.0], top_ks=[0, 0], top_ps=[1e-6, 1e-6]))
+            assert (toks == greedy).all()
+
+    def test_mixed_slots_greedy_and_filtered(self):
+        import jax.numpy as jnp
+        import numpy as np
+        eng = self._engine()
+        logits = jnp.asarray(np.random.default_rng(2).normal(
+            size=(2, 32)).astype(np.float32))
+        greedy = np.argmax(np.asarray(logits), axis=-1)
+        top3 = np.argsort(-np.asarray(logits), axis=-1)[1, :3]
+        for _ in range(16):
+            toks = np.asarray(eng._sample_batch(
+                logits, temps=[0.0, 2.0], top_ks=[0, 3], top_ps=[1.0, 1.0]))
+            assert toks[0] == greedy[0]      # slot 0: temperature 0 = greedy
+            assert toks[1] in top3           # slot 1: top-3 filtered
+
+    def test_invalid_params_rejected(self):
+        eng = self._engine()
+        assert isinstance(eng.submit([1], top_k=-1).exception(), ValueError)
+        assert isinstance(eng.submit([1], top_p=0.0).exception(), ValueError)
+        assert isinstance(eng.submit([1], top_p=1.5).exception(), ValueError)
+
+    def test_first_token_honors_top_k(self):
+        """Regression: the prefill-sampled FIRST token must apply the
+        request's top_k/top_p (top_k=1 at any temperature == greedy)."""
+        import numpy as np
+        eng = self._engine().start()
+        try:
+            greedy = eng.submit([3, 4, 5], max_new_tokens=1,
+                                temperature=0.0).result(timeout=300)["tokens"]
+            for _ in range(6):
+                hot = eng.submit([3, 4, 5], max_new_tokens=1, temperature=3.0,
+                                 top_k=1).result(timeout=300)["tokens"]
+                assert hot == greedy, (hot, greedy)
+        finally:
+            eng.stop()
