@@ -262,10 +262,17 @@ def _maybe_remat(fn, cfg: LlamaConfig):
 
 
 def _mm(h, w, dtype):
-    """Matmul against a raw weight or a weight-only-int8 dict leaf
-    ({"q8", "scale"}, models/quant.py). The dequant multiply sits in the
-    matmul epilogue where XLA fuses it — HBM reads stay int8."""
+    """Matmul against a raw weight or a structured dict leaf:
+    - int8 weight-only quant {"q8", "scale"} (models/quant.py): the dequant
+      multiply sits in the matmul epilogue where XLA fuses it — HBM reads
+      stay int8.
+    - LoRA adapter {"w", "lora_a", "lora_b", "scale"} (models/lora.py): the
+      base weight is stop_gradient'd so backward exists only for A/B."""
     if isinstance(w, dict):
+        if "lora_a" in w:
+            base = h @ jax.lax.stop_gradient(w["w"]).astype(dtype)
+            delta = (h @ w["lora_a"].astype(dtype)) @ w["lora_b"].astype(dtype)
+            return base + delta * w["scale"].astype(dtype)
         return (h @ w["q8"].astype(dtype)) * w["scale"].astype(dtype)
     return h @ w.astype(dtype)
 

@@ -1,0 +1,145 @@
+"""LoRA fine-tuning: rank-decomposed adapters on frozen base weights.
+
+MaxText-style parameter-efficient fine-tuning for the same decoder. A LoRA-
+wrapped projection is a dict leaf ``{"w": base, "lora_a": (..., in, r),
+"lora_b": (..., r, out), "scale": alpha/r}``; the model's matmul helper
+(llama._mm) computes
+
+    y = x @ stop_gradient(w) + ((x @ A) @ B) * scale
+
+so gradients exist ONLY for A/B — XLA dead-code-eliminates the base weight's
+backward matmuls, which is what makes LoRA cheap. ``lora_mask`` feeds both
+the label-partitioned optimizer (zero updates, no Adam moments for frozen
+leaves) and the train step's stop_gradient pass (no gradient HBM for any
+frozen leaf, adapter-only grad_norm) — that, not the forward, is where
+LoRA's memory win lives.
+
+A ~ N(0, 1/d_in) (Kaiming-style fan-in), B = 0 (standard LoRA): step 0 is
+exactly the base model.
+``merge_lora`` folds ``w + A @ B * scale`` back into plain leaves for
+serving/export (including to_hf_state_dict). Adapters are tiny, so they stay
+replicated on every mesh device — no sharding rules needed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .llama import LlamaConfig, Params
+
+__all__ = ["LoraConfig", "apply_lora", "merge_lora", "lora_mask",
+           "is_lora", "lora_param_count"]
+
+_DEFAULT_TARGETS = ("wq", "wv")  # the original-paper default
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    # which stacked-layer projections get adapters; any of
+    # wq/wk/wv/wo/w_gate/w_up/w_down
+    targets: tuple[str, ...] = _DEFAULT_TARGETS
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+
+def is_lora(w: Any) -> bool:
+    return isinstance(w, dict) and "lora_a" in w
+
+
+def apply_lora(cfg: LlamaConfig, params: Params, lc: LoraConfig,
+               key: jax.Array, mesh=None) -> Params:
+    """Wrap the target projections of ``params`` with fresh adapters.
+    A is fan-in-scaled gaussian, B = 0, so the wrapped model initially
+    computes exactly the base model. ``mesh`` replicates adapters across it."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if not lc.targets:
+        raise ValueError("LoRA with no targets would freeze the whole model "
+                         "and train nothing")
+    unknown = set(lc.targets) - {"wq", "wk", "wv", "wo",
+                                 "w_gate", "w_up", "w_down"}
+    if unknown:
+        raise ValueError(f"unknown LoRA targets {sorted(unknown)}")
+    replicate = (NamedSharding(mesh, PartitionSpec()) if mesh is not None
+                 else None)
+    keys = jax.random.split(key, max(len(lc.targets), 1))
+    layers = dict(params["layers"])
+    for k, name in zip(keys, lc.targets):
+        if name not in layers:
+            raise ValueError(f"LoRA target {name!r} not in this model "
+                             f"(MoE configs have no dense mlp weights)")
+        w = layers[name]
+        if is_lora(w):
+            raise ValueError(f"{name} already has a LoRA adapter")
+        d_in, d_out = w.shape[-2], w.shape[-1]
+        lead = w.shape[:-2]
+        a = (jax.random.normal(k, (*lead, d_in, lc.rank), jnp.float32)
+             / jnp.sqrt(d_in)).astype(w.dtype)  # Kaiming-style fan-in init
+        b = jnp.zeros((*lead, lc.rank, d_out), w.dtype)
+        # scale is shaped (n_layers,) so the layers tree stays lax.scan-able
+        # (every leaf needs the leading layer axis; scan hands each layer a
+        # () scalar that broadcasts in the matmul helper)
+        scale = jnp.full(lead or (), lc.scale, jnp.float32)
+        if replicate is not None:
+            a = jax.device_put(a, replicate)
+            b = jax.device_put(b, replicate)
+            scale = jax.device_put(scale, replicate)
+        layers[name] = {"w": w, "lora_a": a, "lora_b": b, "scale": scale}
+    out = dict(params)
+    out["layers"] = layers
+    return out
+
+
+def merge_lora(params: Params) -> Params:
+    """Fold every adapter into its base weight: plain tree back (serving,
+    export, or continued full fine-tuning)."""
+    def fold(w):
+        if is_lora(w):
+            # delta math in f32 (adapters are tiny), but NEVER upcast the
+            # stacked base weight — an f32 copy of a 70B-scale leaf is a
+            # multi-GB transient (same hazard quant.py avoids)
+            delta = jnp.einsum("...ir,...ro->...io",
+                               w["lora_a"].astype(jnp.float32),
+                               w["lora_b"].astype(jnp.float32))
+            delta = delta * jnp.reshape(w["scale"],
+                                        w["scale"].shape + (1, 1))
+            return w["w"] + delta.astype(w["w"].dtype)
+        return w
+    layers = {k: fold(v) for k, v in params["layers"].items()}
+    out = dict(params)
+    out["layers"] = layers
+    return out
+
+
+def lora_mask(params: Params) -> Params:
+    """Boolean tree (same structure): True only on adapter leaves — feeds
+    the label-partitioned optimizer (train.make_optimizer) so the frozen base
+    gets zero updates and no optimizer state, and the train step's
+    stop_gradient pass so no frozen-leaf gradients are even computed."""
+    def mask(w):
+        if is_lora(w):
+            return {"w": False, "lora_a": True, "lora_b": True, "scale": False}
+        return False
+
+    def walk(node):
+        if isinstance(node, dict) and not is_lora(node):
+            return {k: walk(v) for k, v in node.items()}
+        return mask(node) if is_lora(node) else False
+
+    return walk(params)
+
+
+def lora_param_count(params: Params) -> int:
+    n = 0
+    for w in params["layers"].values():
+        if is_lora(w):
+            n += w["lora_a"].size + w["lora_b"].size
+    return n

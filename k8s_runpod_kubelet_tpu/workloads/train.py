@@ -46,24 +46,46 @@ def cross_entropy_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
     return jnp.mean(nll)
 
 
-def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
+def make_optimizer(tc: TrainConfig, trainable_mask=None
+                   ) -> optax.GradientTransformation:
+    """``trainable_mask``: boolean tree (e.g. models.lora.lora_mask) — frozen
+    leaves get ZERO updates and no Adam moments (multi_transform allocates
+    state only under the "train" label; that's LoRA's memory win). NOT
+    optax.masked(opt, mask): masked passes mask-False updates through
+    UNTRANSFORMED, i.e. raw gradients would be added to the frozen weights."""
     schedule = optax.warmup_cosine_decay_schedule(
         0.0, tc.learning_rate, tc.warmup_steps, max(tc.steps, tc.warmup_steps + 1))
-    return optax.chain(
+    opt = optax.chain(
         optax.clip_by_global_norm(tc.grad_clip),
         optax.adamw(schedule, b1=0.9, b2=0.95, weight_decay=tc.weight_decay),
     )
+    if trainable_mask is not None:
+        labels = jax.tree_util.tree_map(
+            lambda m: "train" if m else "freeze", trainable_mask)
+        opt = optax.multi_transform(
+            {"train": opt, "freeze": optax.set_to_zero()},
+            param_labels=labels)
+    return opt
 
 
 def make_train_step(model: LlamaModel, optimizer: optax.GradientTransformation,
-                    donate: bool = True):
+                    donate: bool = True, trainable_mask=None):
     """Returns jitted (params, opt_state, batch) -> (params, opt_state, metrics).
-    batch: tokens (B, S+1) — inputs are [:, :-1], targets [:, 1:]."""
+    batch: tokens (B, S+1) — inputs are [:, :-1], targets [:, 1:].
+    ``trainable_mask``: frozen (False) leaves are stop_gradient'd INSIDE the
+    loss, so their backward matmuls are dead code XLA eliminates and no
+    gradient HBM is allocated for them — the optimizer-level freeze alone
+    would still compute and materialize a full gradient tree every step, and
+    grad_norm would be dominated by never-applied gradients."""
 
     def step(params, opt_state, batch):
         inputs, targets = batch[:, :-1], batch[:, 1:]
 
         def loss_fn(p):
+            if trainable_mask is not None:
+                p = jax.tree_util.tree_map(
+                    lambda leaf, m: leaf if m else jax.lax.stop_gradient(leaf),
+                    p, trainable_mask)
             # optimize CE + router aux, but report them separately so MoE
             # loss curves stay comparable to dense runs (exp(loss) = ppl)
             if model.cfg.n_experts:
@@ -108,12 +130,12 @@ class Trainer:
 
     def __init__(self, cfg: LlamaConfig, tc: TrainConfig,
                  mesh: Optional[Mesh] = None, seed: int = 0,
-                 initial_params: Optional[Params] = None):
+                 initial_params: Optional[Params] = None,
+                 lora: Optional[Any] = None):
         self.cfg = cfg
         self.tc = tc
         self.mesh = mesh
         self.model = LlamaModel(cfg, mesh)
-        self.optimizer = make_optimizer(tc)
         if initial_params is not None:
             # host (e.g. HF-converted) tree: commit straight to the target
             # shardings — never a random init that would be thrown away, and
@@ -128,10 +150,18 @@ class Trainer:
                                                      initial_params)
         else:
             self.params = init_params(cfg, jax.random.PRNGKey(seed), mesh)
+        mask = None
+        if lora is not None:
+            from ..models.lora import apply_lora, lora_mask
+            self.params = apply_lora(cfg, self.params, lora,
+                                     jax.random.PRNGKey(seed + 1), mesh)
+            mask = lora_mask(self.params)
+        self.optimizer = make_optimizer(tc, trainable_mask=mask)
         # optax state mirrors the (already-sharded) params, so it inherits
         # their shardings — no separate placement pass needed
         self.opt_state = self.optimizer.init(self.params)
-        self.step_fn = make_train_step(self.model, self.optimizer)
+        self.step_fn = make_train_step(self.model, self.optimizer,
+                                       trainable_mask=mask)
         self.step = 0
         self._ckpt = None
         if tc.checkpoint_dir:

@@ -38,6 +38,13 @@ def main(argv=None) -> int:
                    help="pipeline microbatches (0 = one per stage)")
     p.add_argument("--fsdp", type=int, default=0,
                    help="0 or -1 = auto: all non-tp/sp/pp devices")
+    p.add_argument("--lora-rank", type=int, default=0,
+                   help="enable LoRA fine-tuning at this rank (0 = full "
+                        "fine-tune); base weights freeze, only adapters train")
+    p.add_argument("--lora-alpha", type=float, default=16.0)
+    p.add_argument("--lora-targets", default="wq,wv",
+                   help="comma list of projections to adapt "
+                        "(wq,wk,wv,wo,w_gate,w_up,w_down)")
     p.add_argument("--hf-checkpoint", default="",
                    help="initialize weights from a HuggingFace model "
                         "directory (fine-tune); an orbax checkpoint in "
@@ -111,7 +118,18 @@ def main(argv=None) -> int:
         from ..models import load_hf
         initial = load_hf(cfg, args.hf_checkpoint)  # host tree; Trainer shards
         log.info("initializing from HF checkpoint %s", args.hf_checkpoint)
-    trainer = Trainer(cfg, tc, mesh=mesh, initial_params=initial)
+    lora = None
+    if args.lora_rank > 0:
+        from ..models import LoraConfig
+        lora = LoraConfig(rank=args.lora_rank, alpha=args.lora_alpha,
+                          targets=tuple(t for t in
+                                        args.lora_targets.split(",") if t))
+    trainer = Trainer(cfg, tc, mesh=mesh, initial_params=initial, lora=lora)
+    if lora is not None and pe.process_id == 0:
+        from ..models import lora_param_count
+        log.info("LoRA r=%d: %.2fM trainable of %.2fB total",
+                 args.lora_rank, lora_param_count(trainer.params) / 1e6,
+                 cfg.param_count / 1e9)
     if args.checkpoint_dir:
         trainer.restore()  # resume-from-preemption path (wins over --hf-checkpoint)
     batches = None

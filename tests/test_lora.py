@@ -1,0 +1,135 @@
+"""LoRA fine-tuning (models/lora.py): identity at init, frozen base, adapter
+merging, masked optimizer state, mesh training, checkpoint round-trip."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from k8s_runpod_kubelet_tpu.models import (LlamaModel, LoraConfig, apply_lora,
+                                           init_params, lora_mask,
+                                           lora_param_count, merge_lora,
+                                           tiny_llama)
+from k8s_runpod_kubelet_tpu.workloads.train import TrainConfig, Trainer
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=128, embed_dim=64, n_layers=2, n_heads=4,
+                n_kv_heads=2, mlp_dim=96, max_seq_len=64,
+                dtype=jnp.float32, param_dtype=jnp.float32, remat=False)
+    base.update(kw)
+    return tiny_llama(**base)
+
+
+class TestLoraForward:
+    def test_zero_init_is_identity(self):
+        """B=0 at init: wrapped model == base model exactly."""
+        cfg = _cfg()
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        wrapped = apply_lora(cfg, params, LoraConfig(rank=4),
+                             jax.random.PRNGKey(1))
+        toks = jnp.asarray([[1, 2, 3, 4, 5]])
+        model = LlamaModel(cfg)
+        np.testing.assert_allclose(np.asarray(model.forward(params, toks)),
+                                   np.asarray(model.forward(wrapped, toks)),
+                                   atol=1e-6)
+
+    def test_merge_matches_wrapped_forward(self):
+        """After perturbing B, merge_lora folds the delta exactly."""
+        cfg = _cfg()
+        params = init_params(cfg, jax.random.PRNGKey(2))
+        wrapped = apply_lora(cfg, params, LoraConfig(rank=4, targets=("wq", "wv", "w_up")),
+                             jax.random.PRNGKey(3))
+        # make the adapters non-trivial
+        wrapped["layers"]["wq"]["lora_b"] = jax.random.normal(
+            jax.random.PRNGKey(4), wrapped["layers"]["wq"]["lora_b"].shape) * 0.1
+        toks = jnp.asarray([[7, 8, 9]])
+        model = LlamaModel(cfg)
+        a = np.asarray(model.forward(wrapped, toks))
+        b = np.asarray(model.forward(merge_lora(wrapped), toks))
+        np.testing.assert_allclose(a, b, atol=2e-5)
+
+    def test_base_grads_are_zero(self):
+        cfg = _cfg()
+        params = init_params(cfg, jax.random.PRNGKey(5))
+        wrapped = apply_lora(cfg, params, LoraConfig(rank=4),
+                             jax.random.PRNGKey(6))
+        model = LlamaModel(cfg)
+        toks = jnp.asarray([[1, 2, 3, 4]])
+
+        def loss(p):
+            return jnp.sum(model.forward(p, toks).astype(jnp.float32) ** 2)
+
+        grads = jax.grad(loss)(wrapped)
+        wq = grads["layers"]["wq"]
+        assert float(jnp.abs(wq["w"]).max()) == 0.0          # frozen base
+        # at init B=0, so dA = f(B) = 0 exactly — B carries the first signal
+        assert float(jnp.abs(wq["lora_b"]).max()) > 0.0      # adapters live
+        # un-adapted projections still get grads (they're not frozen unless
+        # targeted — full-model grads flow; the optimizer mask freezes them)
+        assert float(jnp.abs(grads["layers"]["wo"]).max()) > 0.0
+
+
+class TestLoraTraining:
+    def test_only_adapters_change_and_loss_falls(self):
+        cfg = _cfg()
+        tc = TrainConfig(batch_size=4, seq_len=16, steps=8, warmup_steps=1,
+                         learning_rate=3e-3, weight_decay=0.0)
+        tr = Trainer(cfg, tc, lora=LoraConfig(rank=4))
+        before_w = np.asarray(tr.params["layers"]["wq"]["w"]).copy()
+        before_wo = np.asarray(tr.params["layers"]["wo"]).copy()
+        before_b = np.asarray(tr.params["layers"]["wq"]["lora_b"]).copy()
+
+        # fixed batch -> loss must drop as adapters learn it
+        batch = jax.random.randint(jax.random.PRNGKey(7), (4, 17), 0,
+                                   cfg.vocab_size, jnp.int32)
+        losses = []
+        for _ in range(8):
+            tr.params, tr.opt_state, m = tr.step_fn(tr.params, tr.opt_state,
+                                                    batch)
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0], losses
+        np.testing.assert_array_equal(
+            np.asarray(tr.params["layers"]["wq"]["w"]), before_w)
+        np.testing.assert_array_equal(
+            np.asarray(tr.params["layers"]["wo"]), before_wo)  # masked frozen
+        assert not np.array_equal(
+            np.asarray(tr.params["layers"]["wq"]["lora_b"]), before_b)
+
+    def test_trains_on_mesh(self):
+        from k8s_runpod_kubelet_tpu.parallel import MeshConfig, make_mesh
+        cfg = _cfg()
+        mesh = make_mesh(MeshConfig(data=-1, fsdp=2, tensor=2))
+        tc = TrainConfig(batch_size=4, seq_len=16, steps=2, warmup_steps=1)
+        tr = Trainer(cfg, tc, mesh=mesh, lora=LoraConfig(rank=4))
+        out = tr.run(steps=2)
+        assert np.isfinite(out["final_loss"])
+
+    def test_checkpoint_roundtrip(self, tmp_path):
+        cfg = _cfg()
+        tc = TrainConfig(batch_size=2, seq_len=16, steps=2, warmup_steps=1,
+                         checkpoint_dir=str(tmp_path))
+        tr = Trainer(cfg, tc, lora=LoraConfig(rank=4))
+        tr.run(steps=2)
+        tr.save()
+        tr2 = Trainer(cfg, tc, lora=LoraConfig(rank=4))
+        assert tr2.restore()
+        np.testing.assert_array_equal(
+            np.asarray(tr.params["layers"]["wq"]["lora_b"]),
+            np.asarray(tr2.params["layers"]["wq"]["lora_b"]))
+
+    def test_param_count_and_mask(self):
+        cfg = _cfg()
+        params = init_params(cfg, jax.random.PRNGKey(8))
+        wrapped = apply_lora(cfg, params, LoraConfig(rank=4),
+                             jax.random.PRNGKey(9))
+        n = lora_param_count(wrapped)
+        hd = cfg.head_dim_
+        expect = cfg.n_layers * (cfg.embed_dim * 4 + 4 * cfg.n_heads * hd
+                                 + cfg.embed_dim * 4 + 4 * cfg.n_kv_heads * hd)
+        assert n == expect, (n, expect)
+        mask = lora_mask(wrapped)
+        assert mask["layers"]["wq"]["lora_a"] is True
+        assert mask["layers"]["wq"]["w"] is False
+        assert mask["tok_embed"] is False
