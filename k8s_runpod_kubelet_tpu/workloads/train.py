@@ -31,9 +31,14 @@ class TrainConfig:
     warmup_steps: int = 100
     weight_decay: float = 0.1
     grad_clip: float = 1.0
-    batch_size: int = 8
+    batch_size: int = 8          # GLOBAL batch per optimizer step
     seq_len: int = 512
     steps: int = 100
+    # >1: split the global batch into this many microbatches, accumulate
+    # grads over a lax.scan, apply ONE optimizer update — fits large global
+    # batches in fixed activation memory (activations scale with the
+    # microbatch, optimizer cost is unchanged)
+    grad_accum_steps: int = 1
     checkpoint_dir: str = ""
     checkpoint_every: int = 1000
 
@@ -69,18 +74,19 @@ def make_optimizer(tc: TrainConfig, trainable_mask=None
 
 
 def make_train_step(model: LlamaModel, optimizer: optax.GradientTransformation,
-                    donate: bool = True, trainable_mask=None):
+                    donate: bool = True, trainable_mask=None,
+                    grad_accum_steps: int = 1):
     """Returns jitted (params, opt_state, batch) -> (params, opt_state, metrics).
     batch: tokens (B, S+1) — inputs are [:, :-1], targets [:, 1:].
     ``trainable_mask``: frozen (False) leaves are stop_gradient'd INSIDE the
     loss, so their backward matmuls are dead code XLA eliminates and no
     gradient HBM is allocated for them — the optimizer-level freeze alone
     would still compute and materialize a full gradient tree every step, and
-    grad_norm would be dominated by never-applied gradients."""
+    grad_norm would be dominated by never-applied gradients.
+    ``grad_accum_steps`` > 1 scans over that many microbatches of B/accum
+    rows, averaging grads, before the single optimizer update."""
 
-    def step(params, opt_state, batch):
-        inputs, targets = batch[:, :-1], batch[:, 1:]
-
+    def loss_and_grads(params, inputs, targets):
         def loss_fn(p):
             if trainable_mask is not None:
                 p = jax.tree_util.tree_map(
@@ -96,6 +102,56 @@ def make_train_step(model: LlamaModel, optimizer: optax.GradientTransformation,
             return ce, (ce, jnp.float32(0.0))
 
         (_, (ce, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        return ce, aux, grads
+
+    def _shrink(grads, params):
+        """Frozen leaves carry a () placeholder through the scan instead of a
+        full zeros buffer — otherwise the accumulator re-materializes the
+        full-gradient-tree HBM cost this code exists to avoid."""
+        if trainable_mask is None:
+            return grads
+        return jax.tree_util.tree_map(
+            lambda g, m: g if m else jnp.zeros((), g.dtype),
+            grads, trainable_mask)
+
+    def _expand(grads, params):
+        if trainable_mask is None:
+            return grads
+        return jax.tree_util.tree_map(
+            lambda g, p, m: g if m else jnp.zeros_like(p),
+            grads, params, trainable_mask)
+
+    def step(params, opt_state, batch):
+        if grad_accum_steps > 1:
+            b = batch.shape[0]
+            if b % grad_accum_steps:
+                raise ValueError(f"batch {b} not divisible by "
+                                 f"grad_accum_steps {grad_accum_steps}")
+            # STRIDED split (microbatch m = rows m::accum): each microbatch
+            # keeps rows from every data-parallel shard, so a batch sharded
+            # over the data axes stays balanced — a contiguous reshape would
+            # hand each microbatch to a subset of devices and force a
+            # reshard every scan iteration
+            micro = batch.reshape(b // grad_accum_steps, grad_accum_steps,
+                                  batch.shape[1]).swapaxes(0, 1)
+
+            def accum(carry, mb):
+                ce, aux, grads = loss_and_grads(params, mb[:, :-1], mb[:, 1:])
+                carry = jax.tree_util.tree_map(
+                    jnp.add, carry, (_shrink(grads, params), ce, aux))
+                return carry, None
+
+            zeros = (_shrink(jax.tree_util.tree_map(
+                         lambda p: jnp.zeros(p.shape, jnp.float32), params),
+                         params),
+                     jnp.float32(0.0), jnp.float32(0.0))
+            (grads, ce, aux), _ = jax.lax.scan(accum, zeros, micro)
+            scale = 1.0 / grad_accum_steps
+            grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+            grads = _expand(grads, params)
+            ce, aux = ce * scale, aux * scale
+        else:
+            ce, aux, grads = loss_and_grads(params, batch[:, :-1], batch[:, 1:])
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         gnorm = optax.global_norm(grads)
@@ -161,8 +217,10 @@ class Trainer:
         # their shardings — no separate placement pass needed
         self.opt_state = self.optimizer.init(self.params)
         self.step_fn = make_train_step(self.model, self.optimizer,
-                                       trainable_mask=mask)
+                                       trainable_mask=mask,
+                                       grad_accum_steps=tc.grad_accum_steps)
         self.step = 0
+        self._eval_fn = None
         self._ckpt = None
         if tc.checkpoint_dir:
             import orbax.checkpoint as ocp
@@ -194,6 +252,33 @@ class Trainer:
         self.step = self._ckpt.latest_step()
         log.info("resumed from checkpoint step %d", self.step)
         return True
+
+    # -- eval ------------------------------------------------------------------
+
+    def evaluate(self, batches: Optional[Iterator] = None,
+                 steps: int = 10) -> dict:
+        """Forward-only held-out evaluation: mean next-token NLL and
+        perplexity over ``steps`` batches (MaxText's eval loop shape).
+        Default batches use the MICRObatch size — a run whose global batch
+        only fits via grad accumulation must not OOM in its final eval."""
+        if batches is None:
+            etc = dataclasses.replace(
+                self.tc,
+                batch_size=max(1, self.tc.batch_size
+                               // max(1, self.tc.grad_accum_steps)))
+            batches = synthetic_batches(self.cfg, etc, self.mesh,
+                                        seed=10_000_019)
+        if self._eval_fn is None:
+            def eval_loss(params, batch):
+                logits = self.model.forward(params, batch[:, :-1])
+                return cross_entropy_loss(logits, batch[:, 1:])
+            self._eval_fn = jax.jit(eval_loss)
+        total = 0.0
+        for _ in range(steps):
+            total += float(self._eval_fn(self.params, next(batches)))
+        nll = total / max(steps, 1)
+        return {"eval_loss": nll, "eval_ppl": float(jnp.exp(jnp.float32(nll))),
+                "eval_steps": steps}
 
     # -- loop ------------------------------------------------------------------
 

@@ -38,6 +38,11 @@ def main(argv=None) -> int:
                    help="pipeline microbatches (0 = one per stage)")
     p.add_argument("--fsdp", type=int, default=0,
                    help="0 or -1 = auto: all non-tp/sp/pp devices")
+    p.add_argument("--grad-accum", type=int, default=1,
+                   help="microbatches per optimizer step (grad accumulation)")
+    p.add_argument("--eval-steps", type=int, default=0,
+                   help="run a held-out eval of this many batches at the end "
+                        "(and report eval_loss/eval_ppl)")
     p.add_argument("--lora-rank", type=int, default=0,
                    help="enable LoRA fine-tuning at this rank (0 = full "
                         "fine-tune); base weights freeze, only adapters train")
@@ -105,12 +110,14 @@ def main(argv=None) -> int:
     dp_total = mesh.shape["data"] * mesh.shape["fsdp"]
     if args.stage > 1:
         dp_total *= (args.microbatches or args.stage)
-    batch = ((args.batch + dp_total - 1) // dp_total) * dp_total
+    multiple = dp_total * max(1, args.grad_accum)
+    batch = ((args.batch + multiple - 1) // multiple) * multiple
     if batch != args.batch:
-        log.info("batch %d -> %d (must divide data*fsdp*microbatches=%d)",
-                 args.batch, batch, dp_total)
+        log.info("batch %d -> %d (must divide data*fsdp*microbatches"
+                 "*grad_accum=%d)", args.batch, batch, multiple)
     tc = TrainConfig(learning_rate=args.lr, batch_size=batch,
                      seq_len=args.seq_len, steps=args.steps,
+                     grad_accum_steps=args.grad_accum,
                      checkpoint_dir=args.checkpoint_dir,
                      checkpoint_every=args.checkpoint_every)
     initial = None
@@ -167,6 +174,8 @@ def main(argv=None) -> int:
     if args.checkpoint_dir:
         trainer.save()
 
+    if args.eval_steps > 0:
+        out.update(trainer.evaluate(steps=args.eval_steps))
     if pe.process_id == 0:
         out.update({"workload": "pretrain", "model": cfg.name,
                     "devices": n, "mesh": {k: v for k, v in mesh.shape.items()},
