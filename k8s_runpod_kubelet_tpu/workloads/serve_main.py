@@ -3,6 +3,8 @@
 The pod command for autoscaled inference. Endpoints:
   POST /generate   {"tokens": [...], "max_new_tokens": N, "temperature": T,
                     "top_k": K, "top_p": P}
+                   or {"text": "..."} when --tokenizer is set (the response
+                   then also carries decoded "text")
                    -> {"tokens": [...], "rid": ..., "latency_s": ...}
                    with "stream": true -> chunked NDJSON: one {"token": N}
                    line per decoded token, then the final result object
@@ -35,6 +37,7 @@ def _or(value, default):
 
 class _Handler(BaseHTTPRequestHandler):
     engine = None  # bound below
+    tokenizer = None  # bound below; None = token-ids-only API
     request_timeout_s = 120.0
     # chunked transfer framing is an HTTP/1.1 construct; 1.0 clients would
     # read raw chunk framing as the body (non-stream responses all send
@@ -69,7 +72,18 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             length = int(self.headers.get("Content-Length") or 0)
             req = json.loads(self.rfile.read(length)) if length else {}
-            tokens = req["tokens"]
+            if "text" in req and "tokens" not in req:
+                if self.tokenizer is None:
+                    raise ValueError(
+                        'server has no tokenizer (start with --tokenizer '
+                        'bytes or a HF tokenizer dir) — send "tokens"')
+                if not isinstance(req["text"], str):
+                    raise ValueError("text must be a string")
+                tokens = self.tokenizer.encode(req["text"])
+                if not tokens:
+                    raise ValueError("text tokenized to nothing")
+            else:
+                tokens = req["tokens"]
             if not isinstance(tokens, list) or not all(
                     isinstance(t, int) for t in tokens):
                 raise ValueError("tokens must be a list of ints")
@@ -87,6 +101,9 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send(504, {"error": "generation timed out"})
         except ValueError as e:
             return self._send(400, {"error": str(e)})
+        if self.tokenizer is not None:
+            out = dict(out)
+            out["text"] = self.tokenizer.decode(out["tokens"])
         self._send(200, out)
 
     def _generate_stream(self, tokens: list, req: dict):
@@ -142,7 +159,14 @@ class _Handler(BaseHTTPRequestHandler):
                     chunk({"token": val})
                 else:
                     exc = val.exception()
-                    chunk({"error": str(exc)} if exc else val.result())
+                    if exc:
+                        chunk({"error": str(exc)})
+                    else:
+                        out = val.result()
+                        if self.tokenizer is not None:
+                            out = dict(out)
+                            out["text"] = self.tokenizer.decode(out["tokens"])
+                        chunk(out)
                     break
             self.wfile.write(b"0\r\n\r\n")
             self.wfile.flush()
@@ -150,9 +174,11 @@ class _Handler(BaseHTTPRequestHandler):
             dead.set()  # engine cancels at its next on_token call
 
 
-def serve(engine, port: int = 8000, request_timeout_s: float = 120.0):
+def serve(engine, port: int = 8000, request_timeout_s: float = 120.0,
+          tokenizer=None):
     handler = type("BoundHandler", (_Handler,),
-                   {"engine": engine, "request_timeout_s": request_timeout_s})
+                   {"engine": engine, "request_timeout_s": request_timeout_s,
+                    "tokenizer": tokenizer})
     httpd = ThreadingHTTPServer(("0.0.0.0", port), handler)
     thread = threading.Thread(target=httpd.serve_forever, daemon=True)
     thread.start()
@@ -168,6 +194,10 @@ def main(argv=None) -> int:
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--cache-len", type=int, default=2048)
     p.add_argument("--max-new-tokens", type=int, default=256)
+    p.add_argument("--tokenizer", default="",
+                   help='"bytes" (UTF-8 byte ids, any model with vocab>=257) '
+                        "or a HuggingFace tokenizer directory; enables "
+                        '{"text": ...} requests and decoded responses')
     p.add_argument("--int8", action="store_true",
                    help="weight-only int8 quantization (halves decode HBM "
                         "traffic; JetStream-style serving optimization)")
@@ -186,6 +216,9 @@ def main(argv=None) -> int:
            "tiny-moe": tiny_moe}[args.model]()
     log.info("loading %s (%.2fB params) on %s", cfg.name,
              cfg.param_count / 1e9, jax.default_backend())
+    from .tokenizer import get_tokenizer
+    tokenizer = get_tokenizer(args.tokenizer)  # before the expensive load:
+    # a bad --tokenizer path must fail fast, not after minutes of weights
     if args.hf_checkpoint:
         from ..models import load_hf
         params = load_hf(cfg, args.hf_checkpoint)  # host tree
@@ -200,8 +233,11 @@ def main(argv=None) -> int:
         slots=args.slots, cache_len=args.cache_len,
         max_new_tokens=args.max_new_tokens,
         max_prefill_len=args.cache_len // 2,
-        quantize_int8=args.int8)).start()
-    httpd = serve(engine, args.port)
+        quantize_int8=args.int8,
+        # text mode stops at the tokenizer's EOS instead of always burning
+        # the full max_new_tokens budget
+        eos_token=(tokenizer.eos_id if tokenizer is not None else -1))).start()
+    httpd = serve(engine, args.port, tokenizer=tokenizer)
     log.info("serving on :%d (POST /generate, GET /metrics)", args.port)
     try:
         threading.Event().wait()

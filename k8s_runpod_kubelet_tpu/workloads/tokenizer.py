@@ -1,0 +1,71 @@
+"""Tokenizers for the serving front end: text in, text out.
+
+The engine itself speaks token ids (the JetStream shape); this adapts the
+HTTP surface for human clients:
+
+- ``ByteTokenizer``: dependency-free UTF-8 byte tokenizer (id = byte value).
+  Works with any model whose vocab >= 256 — the hermetic-test / smoke-demo
+  tokenizer, and a sane default for random-weight models.
+- ``HfTokenizer``: wraps a HuggingFace tokenizer directory
+  (``transformers.AutoTokenizer``) for real checkpoints — pairs with
+  ``--hf-checkpoint`` so text round-trips through the model's true vocab.
+
+``get_tokenizer("bytes")`` or ``get_tokenizer("/path/to/hf_dir")``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Protocol
+
+__all__ = ["ByteTokenizer", "HfTokenizer", "get_tokenizer"]
+
+
+class Tokenizer(Protocol):
+    def encode(self, text: str) -> list[int]: ...
+    def decode(self, tokens: list[int]) -> str: ...
+    @property
+    def eos_id(self) -> int: ...
+
+
+class ByteTokenizer:
+    """UTF-8 bytes as token ids (0..255); id 256 = EOS. Lossless round-trip
+    for any text; needs model vocab >= 257 (EOS optional at >= 256)."""
+
+    vocab_size = 257
+
+    @property
+    def eos_id(self) -> int:
+        return 256
+
+    def encode(self, text: str) -> list[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, tokens: list[int]) -> str:
+        return bytes(t for t in tokens if 0 <= t < 256).decode(
+            "utf-8", errors="replace")
+
+
+class HfTokenizer:
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+        self._tok = AutoTokenizer.from_pretrained(path)
+
+    @property
+    def eos_id(self) -> int:
+        return self._tok.eos_token_id if self._tok.eos_token_id is not None else -1
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text)
+
+    def decode(self, tokens: list[int]) -> str:
+        return self._tok.decode(tokens, skip_special_tokens=True)
+
+
+def get_tokenizer(spec: Optional[str]):
+    """None/"" -> None (ids-only API); "bytes" -> ByteTokenizer;
+    anything else -> HF tokenizer directory."""
+    if not spec:
+        return None
+    if spec == "bytes":
+        return ByteTokenizer()
+    return HfTokenizer(spec)

@@ -220,3 +220,73 @@ class TestSampling:
                 assert hot == greedy, (hot, greedy)
         finally:
             eng.stop()
+
+
+class TestTextApi:
+    def test_byte_tokenizer_roundtrip(self):
+        from k8s_runpod_kubelet_tpu.workloads.tokenizer import ByteTokenizer
+        tok = ByteTokenizer()
+        for s in ("hello world", "ünïcødé ≈ 😀", ""):
+            assert tok.decode(tok.encode(s)) == s
+        assert tok.decode([104, 105, tok.eos_id]) == "hi"  # eos dropped
+
+    def test_text_request_over_http(self):
+        """--tokenizer bytes: {"text": ...} in, decoded "text" out."""
+        import dataclasses, json, urllib.request
+        import jax.numpy as jnp
+        from k8s_runpod_kubelet_tpu.models import init_params, tiny_llama
+        from k8s_runpod_kubelet_tpu.workloads.serve_main import serve
+        from k8s_runpod_kubelet_tpu.workloads.serving import (ServingConfig,
+                                                              ServingEngine)
+        from k8s_runpod_kubelet_tpu.workloads.tokenizer import get_tokenizer
+        cfg = dataclasses.replace(
+            tiny_llama(vocab_size=300, embed_dim=32, n_layers=1, n_heads=2,
+                       n_kv_heads=1, mlp_dim=48, max_seq_len=64),
+            dtype=jnp.float32, param_dtype=jnp.float32)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        engine = ServingEngine(cfg, params, ServingConfig(
+            slots=2, cache_len=48, max_new_tokens=8,
+            max_prefill_len=16)).start()
+        httpd = serve(engine, port=0, tokenizer=get_tokenizer("bytes"))
+        port = httpd.server_address[1]
+        try:
+            body = json.dumps({"text": "hi", "max_new_tokens": 4}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate", data=body,
+                headers={"Content-Type": "application/json"})
+            out = json.loads(urllib.request.urlopen(req, timeout=120).read())
+            assert len(out["tokens"]) == 4
+            assert isinstance(out["text"], str)
+        finally:
+            httpd.shutdown()
+            engine.stop()
+
+    def test_text_without_tokenizer_is_400(self):
+        import dataclasses, json, urllib.error, urllib.request
+        import jax.numpy as jnp
+        from k8s_runpod_kubelet_tpu.models import init_params, tiny_llama
+        from k8s_runpod_kubelet_tpu.workloads.serve_main import serve
+        from k8s_runpod_kubelet_tpu.workloads.serving import (ServingConfig,
+                                                              ServingEngine)
+        cfg = dataclasses.replace(
+            tiny_llama(vocab_size=300, embed_dim=32, n_layers=1, n_heads=2,
+                       n_kv_heads=1, mlp_dim=48, max_seq_len=64),
+            dtype=jnp.float32, param_dtype=jnp.float32)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        engine = ServingEngine(cfg, params, ServingConfig(
+            slots=1, cache_len=32)).start()
+        httpd = serve(engine, port=0)
+        port = httpd.server_address[1]
+        try:
+            body = json.dumps({"text": "hi"}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate", data=body,
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(req, timeout=30)
+                raise AssertionError("expected 400")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        finally:
+            httpd.shutdown()
+            engine.stop()
