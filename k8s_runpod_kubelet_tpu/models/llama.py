@@ -507,45 +507,71 @@ class LlamaModel:
 
         Each slot decodes at its own cache index (continuous batching).
         ``active`` (B,) bool freezes inactive slots: their cache and index
-        stay untouched, so idle slots cost compute but not correctness."""
+        stay untouched, so idle slots cost compute but not correctness.
+        This is the K=1 case of ``verify_step`` (one kernel to maintain),
+        plus the index advance the verify path leaves to its caller."""
+        if active is None:
+            active = jnp.ones((token.shape[0],), bool)
+        logits, cache = self.verify_step(params, token[:, None], cache, active)
+        cache = dict(cache)
+        cache["index"] = jnp.where(active, cache["index"] + 1, cache["index"])
+        return logits[:, 0], cache
+
+    def verify_step(self, params: Params, tokens: jax.Array, cache: Params,
+                    active: Optional[jax.Array] = None
+                    ) -> tuple[jax.Array, Params]:
+        """Speculative-decoding verification: K tokens per slot in ONE pass.
+
+        tokens (B, K) — position j of slot b sits at cache index idx[b]+j
+        (token 0 is the slot's last committed token; 1..K-1 are draft
+        proposals). Returns (logits (B, K, V) f32, cache): ``logits[:, j]``
+        is the next-token distribution after consuming tokens[:, :j+1] —
+        exactly what ``decode_step`` would produce sequentially, in one
+        memory-bound sweep instead of K.
+
+        All K KV entries are written; the cache ``index`` is NOT advanced —
+        the caller commits the accepted prefix by setting ``index += m``.
+        Rejected positions hold garbage KV but stay invisible: attention
+        masks to ``<= index`` and later writes overwrite them (the same
+        invariant decode_step relies on)."""
         cfg = self.cfg
-        b = token.shape[0]
+        b, kk = tokens.shape
         idx = cache["index"]  # (B,)
         if active is None:
             active = jnp.ones((b,), bool)
         cos, sin = rope_frequencies(cfg.head_dim_, cfg.max_seq_len,
                                     cfg.rope_theta, cfg.rope_scaling)
-        x = _embed(params, token[:, None], cfg, self.mesh)  # (B,1,E)
-        positions = idx[:, None]  # (B,1)
+        x = _embed(params, tokens, cfg, self.mesh)                 # (B,K,E)
+        positions = idx[:, None] + jnp.arange(kk)[None, :]         # (B,K)
         max_len = cache["k"].shape[2]
-        # (B,1,1,L): slot i may attend up to its own index
-        valid = (jnp.arange(max_len)[None, :] <= idx[:, None])[:, None, None, :]
-        batch_ids = jnp.arange(b)
+        # (B,1,1,K,L): query j of slot b attends cache positions <= idx[b]+j
+        valid = (jnp.arange(max_len)[None, None, :]
+                 <= positions[:, :, None])[:, None, None]
+        batch_ids = jnp.arange(b)[:, None]                         # (B,1)
 
         def block(carry, inputs):
             y = carry
             lp, k_cache, v_cache = inputs
             h = rms_norm(y, _norm_w(lp["attn_norm"], cfg), cfg.norm_eps)
-            q, k, v = _qkv(h, lp, cfg, b, 1)
+            q, k, v = _qkv(h, lp, cfg, b, kk)
             q = apply_rope(q, cos, sin, positions)
             k = apply_rope(k, cos, sin, positions)
-            # per-slot scatter at each slot's own index; frozen slots keep
-            # their previous cache line
-            k_new = jnp.where(active[:, None, None],
-                              k[:, 0], k_cache[batch_ids, idx])
-            v_new = jnp.where(active[:, None, None],
-                              v[:, 0], v_cache[batch_ids, idx])
-            k_cache = k_cache.at[batch_ids, idx].set(k_new)
-            v_cache = v_cache.at[batch_ids, idx].set(v_new)
-            # attention of one query vs the cache (GQA)
+            old_k = k_cache[batch_ids, positions]                  # (B,K,h,d)
+            old_v = v_cache[batch_ids, positions]
+            k_w = jnp.where(active[:, None, None, None], k, old_k)
+            v_w = jnp.where(active[:, None, None, None], v, old_v)
+            k_cache = k_cache.at[batch_ids, positions].set(k_w)
+            v_cache = v_cache.at[batch_ids, positions].set(v_w)
             group = cfg.n_heads // cfg.n_kv_heads
             qg = (q.astype(jnp.float32) * cfg.head_dim_ ** -0.5
-                  ).reshape(b, cfg.n_kv_heads, group, cfg.head_dim_)
-            s = jnp.einsum("bhgd,bLhd->bhgL", qg, k_cache.astype(jnp.float32))
+                  ).reshape(b, kk, cfg.n_kv_heads, group, cfg.head_dim_)
+            s = jnp.einsum("bqhgd,bLhd->bhgqL", qg,
+                           k_cache.astype(jnp.float32))
             s = jnp.where(valid, s, -1e30)
             p = jax.nn.softmax(s, axis=-1)
-            o = jnp.einsum("bhgL,bLhd->bhgd", p, v_cache.astype(jnp.float32))
-            o = o.reshape(b, 1, cfg.n_heads * cfg.head_dim_).astype(cfg.dtype)
+            o = jnp.einsum("bhgqL,bLhd->bqhgd", p,
+                           v_cache.astype(jnp.float32))
+            o = o.reshape(b, kk, cfg.n_heads * cfg.head_dim_).astype(cfg.dtype)
             y = y + _mm(o, lp["wo"], cfg.dtype)
             y, _ = _mlp_block(y, lp, cfg, self.mesh, train=False)
             return y, (k_cache, v_cache)
@@ -553,9 +579,8 @@ class LlamaModel:
         x, (k_new, v_new) = jax.lax.scan(
             block, x, (params["layers"], cache["k"], cache["v"]))
         x = rms_norm(x, _norm_w(params["final_norm"], cfg), cfg.norm_eps)
-        logits = _head_logits(x[:, 0], params, cfg).astype(jnp.float32)
-        new_idx = jnp.where(active, idx + 1, idx)
-        return logits, {"k": k_new, "v": v_new, "index": new_idx}
+        logits = _head_logits(x, params, cfg).astype(jnp.float32)  # (B,K,V)
+        return logits, {"k": k_new, "v": v_new, "index": idx}
 
     @staticmethod
     def insert_into_slot(cache: Params, single: Params, slot: int | jax.Array

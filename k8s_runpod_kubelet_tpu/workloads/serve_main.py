@@ -198,6 +198,11 @@ def main(argv=None) -> int:
                    help='"bytes" (UTF-8 byte ids, any model with vocab>=257) '
                         "or a HuggingFace tokenizer directory; enables "
                         '{"text": ...} requests and decoded responses')
+    p.add_argument("--speculate", type=int, default=0,
+                   help="speculative decoding: draft this many tokens per "
+                        "step via prompt-lookup and verify in one pass "
+                        "(exact greedy output, lower latency on repetitive "
+                        "text); 0 = off")
     p.add_argument("--int8", action="store_true",
                    help="weight-only int8 quantization (halves decode HBM "
                         "traffic; JetStream-style serving optimization)")
@@ -234,6 +239,7 @@ def main(argv=None) -> int:
         max_new_tokens=args.max_new_tokens,
         max_prefill_len=args.cache_len // 2,
         quantize_int8=args.int8,
+        speculate_k=args.speculate,
         # text mode stops at the tokenizer's EOS instead of always burning
         # the full max_new_tokens budget
         eos_token=(tokenizer.eos_id if tokenizer is not None else -1))).start()

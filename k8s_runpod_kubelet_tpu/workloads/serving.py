@@ -53,6 +53,16 @@ class ServingConfig:
     quantize_int8: bool = False  # weight-only int8 (models/quant.py): halves
                                  # weight HBM traffic on the bandwidth-bound
                                  # decode step
+    # speculative decoding via prompt-lookup (n-gram) proposals: draft this
+    # many tokens per decode step and verify them in ONE forward pass
+    # (models/llama.py verify_step). Greedy slots commit every matched draft
+    # token "for free" (decode is memory-bound, so a K-token verify costs
+    # about one decode step); sampled slots fall back to 1 token/step.
+    # Greedy output equals the non-speculative engine's on the pinned f32
+    # test model; the K-wide and 1-wide kernels can reduce in different
+    # orders, so logits within ~1 ulp of a tie may tie-break differently
+    # (bf16 especially) — same model quality, not a correctness loss.
+    speculate_k: int = 0
 
 
 @dataclasses.dataclass
@@ -147,6 +157,13 @@ class ServingEngine:
         self._prefill_thread = threading.Thread(
             target=self._prefill_loop, name="serving-prefill", daemon=True)
         self._decode = jax.jit(self.model.decode_step)
+        self._verify = (jax.jit(self.model.verify_step)
+                        if sc.speculate_k > 0 else None)
+        if self._verify is not None:
+            # zero-seed so acceptance-rate dashboards see the series from
+            # pod start, not first acceptance
+            self.metrics.incr("tpu_serving_spec_proposed", 0)
+            self.metrics.incr("tpu_serving_spec_accepted", 0)
         self._prefill = jax.jit(self.model.prefill)
         # donate the old cache so XLA updates the slot in place instead of
         # copying the whole multi-layer K/V on every admission
@@ -343,7 +360,108 @@ class ServingEngine:
         self.metrics.set_gauge("tpu_serving_active_slots", self.active_slots)
         return admitted
 
+    def _propose(self, slot: _Slot, k: int) -> list[int]:
+        """Prompt-lookup drafting: find the latest prior occurrence of the
+        context's final bigram and propose the k tokens that followed it —
+        free accuracy on repetitive spans (code, quotes, lists). Falls back
+        to repeating the last token (wrong guesses only cost the slack the
+        verify pass already paid for)."""
+        ctx = slot.request.prompt + slot.generated
+        draft: list[int] = []
+        if len(ctx) >= 3:
+            big = (ctx[-2], ctx[-1])
+            for i in range(len(ctx) - 3, -1, -1):
+                if (ctx[i], ctx[i + 1]) == big:
+                    draft = ctx[i + 2:i + 2 + k]
+                    break
+        last = ctx[-1]
+        while len(draft) < k:
+            draft.append(last)
+        return draft[:k]
+
+    def _decode_once_speculative(self) -> bool:
+        """One verify pass over [last_token, draft...]: greedy slots commit
+        the matched prefix plus one corrected token; sampled slots commit 1.
+        Returns False (deferring to the plain path) when no active slot is
+        greedy — a (k+1)-wide verify would then be pure overhead."""
+        k = self.sc.speculate_k
+        slots = self._slots
+        b = len(slots)
+        active = [s.request is not None for s in slots]
+        if not any(active[i] and slots[i].request.temperature <= 0.0
+                   for i in range(b)):
+            return False
+        active_mask = jnp.asarray(active)
+        toks_in = np.zeros((b, k + 1), np.int32)
+        n_greedy = 0
+        for i, slot in enumerate(slots):
+            if not active[i]:
+                continue
+            toks_in[i, 0] = slot.last_token
+            if slot.request.temperature <= 0.0:
+                toks_in[i, 1:] = self._propose(slot, k)
+                n_greedy += 1
+            else:
+                toks_in[i, 1:] = slot.last_token  # placeholder, never checked
+        logits, self._cache = self._verify(self.params,
+                                           jnp.asarray(toks_in),
+                                           self._cache, active_mask)
+        greedy_np = np.asarray(jnp.argmax(logits, axis=-1))   # (B, K+1)
+        # sampled slots draw token 1 from the same distribution decode_step
+        # would have produced (logits[:, 0])
+        reqs = [s.request for s in slots]
+        temps = [r.temperature if r else 0.0 for r in reqs]
+        sampled_np = None
+        if any(t > 0.0 for t in temps):
+            sampled_np = np.asarray(self._sample_batch(
+                logits[:, 0], temps,
+                [r.top_k if r else 0 for r in reqs],
+                [r.top_p if r else 1.0 for r in reqs]))
+        self.metrics.incr("tpu_serving_spec_proposed", k * n_greedy)
+
+        advance = np.zeros((b,), np.int32)
+        for i, slot in enumerate(slots):
+            if not active[i]:
+                continue
+            greedy_slot = slot.request.temperature <= 0.0
+            if greedy_slot:
+                committed = []
+                for j in range(k + 1):
+                    g = int(greedy_np[i, j])
+                    committed.append(g)
+                    if j >= k or g != int(toks_in[i, j + 1]):
+                        break  # mismatch: g is the corrected token
+            else:
+                committed = [int(sampled_np[i])]
+            # positions idx..idx+m-1 hold KV for toks_in[0..m-1], all of
+            # which are now committed (m-1 matched drafts + the last token)
+            appended = 0
+            for tok in committed:
+                if slot.request is None:
+                    break  # finished mid-run (eos / budget)
+                slot.generated.append(tok)
+                slot.last_token = tok
+                slot.remaining -= 1
+                appended += 1
+                self._emit(slot, tok)
+                self.total_generated += 1
+                if self._finished(slot):
+                    self._complete(i, slot)
+            advance[i] = appended
+            if greedy_slot and appended > 1:
+                # accepted = drafts actually CONSUMED (an early finish must
+                # not inflate the exported acceptance rate)
+                self.metrics.incr("tpu_serving_spec_accepted", appended - 1)
+        idx = self._cache["index"]
+        self._cache = dict(self._cache)
+        self._cache["index"] = idx + jnp.asarray(advance)
+        self._tokens = jnp.asarray([s.last_token for s in slots], jnp.int32)
+        self.metrics.incr("tpu_serving_decode_steps")
+        return True
+
     def _decode_once(self):
+        if self._verify is not None and self._decode_once_speculative():
+            return
         active_mask = jnp.asarray([s.request is not None for s in self._slots])
         logits, self._cache = self._decode(self.params, self._tokens,
                                            self._cache, active_mask)

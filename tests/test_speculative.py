@@ -1,0 +1,103 @@
+"""Speculative decoding: verify_step exactness vs sequential decode_step,
+and the serving engine's greedy output invariance with speculation on."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from k8s_runpod_kubelet_tpu.models import LlamaModel, init_params, tiny_llama
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=128, embed_dim=64, n_layers=2, n_heads=4,
+                n_kv_heads=2, mlp_dim=96, max_seq_len=64,
+                dtype=jnp.float32, param_dtype=jnp.float32, remat=False)
+    base.update(kw)
+    return tiny_llama(**base)
+
+
+class TestVerifyStep:
+    def test_matches_sequential_decode(self):
+        """verify_step's K logits == K sequential decode_step logits, and the
+        caches agree on every committed position."""
+        cfg = _cfg()
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        model = LlamaModel(cfg)
+        b, kk = 2, 4
+        prompt = jnp.asarray([[5, 6, 7], [9, 8, 7]], jnp.int32)
+        cache0 = model.init_cache(b, 32)
+        _, cache0 = model.prefill(params, prompt, cache0)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (b, kk), 0,
+                                  cfg.vocab_size, jnp.int32)
+
+        # sequential reference
+        seq_cache = jax.tree_util.tree_map(lambda x: x, cache0)
+        seq_logits = []
+        for j in range(kk):
+            lg, seq_cache = model.decode_step(params, toks[:, j], seq_cache)
+            seq_logits.append(np.asarray(lg))
+
+        ver_logits, ver_cache = model.verify_step(params, toks, cache0)
+        for j in range(kk):
+            np.testing.assert_allclose(np.asarray(ver_logits[:, j]),
+                                       seq_logits[j], atol=2e-4, rtol=2e-4)
+        # KV written at idx..idx+K-1 must match the sequential cache
+        idx0 = np.asarray(cache0["index"])
+        for row in range(b):
+            sl = slice(idx0[row], idx0[row] + kk)
+            np.testing.assert_allclose(
+                np.asarray(ver_cache["k"][:, row, sl]),
+                np.asarray(seq_cache["k"][:, row, sl]), atol=1e-5)
+        # verify_step does NOT advance the index (caller commits)
+        np.testing.assert_array_equal(np.asarray(ver_cache["index"]), idx0)
+
+    def test_inactive_slots_untouched(self):
+        cfg = _cfg()
+        params = init_params(cfg, jax.random.PRNGKey(2))
+        model = LlamaModel(cfg)
+        cache = model.init_cache(2, 32)
+        _, cache = model.prefill(params, jnp.asarray([[1, 2], [3, 4]]), cache)
+        before_k = np.asarray(cache["k"]).copy()
+        toks = jnp.asarray([[1, 2, 3], [4, 5, 6]], jnp.int32)
+        active = jnp.asarray([True, False])
+        _, cache2 = model.verify_step(params, toks, cache, active)
+        np.testing.assert_array_equal(np.asarray(cache2["k"][:, 1]),
+                                      before_k[:, 1])  # frozen slot intact
+        assert not np.array_equal(np.asarray(cache2["k"][:, 0]),
+                                  before_k[:, 0])      # live slot wrote
+
+
+class TestSpeculativeServing:
+    def _run_engine(self, spec_k, prompts, cfg=None, new_toks=12):
+        from k8s_runpod_kubelet_tpu.workloads.serving import (ServingConfig,
+                                                              ServingEngine)
+        cfg = cfg or _cfg()
+        params = init_params(cfg, jax.random.PRNGKey(3))
+        eng = ServingEngine(cfg, params, ServingConfig(
+            slots=2, cache_len=64, max_new_tokens=new_toks,
+            max_prefill_len=16, speculate_k=spec_k)).start()
+        try:
+            futs = [eng.submit(p, max_new_tokens=new_toks) for p in prompts]
+            outs = [f.result(timeout=300)["tokens"] for f in futs]
+            stats = dict(eng.metrics.counters) if hasattr(eng.metrics,
+                                                          "counters") else {}
+            return outs, eng
+        finally:
+            eng.stop()
+
+    def test_greedy_output_identical_with_speculation(self):
+        """The load-bearing exactness property: speculation must change WHEN
+        tokens are produced, never WHICH tokens."""
+        prompts = [[1, 2, 3, 1, 2], [7, 8, 9, 7, 8, 9, 7]]
+        base, _ = self._run_engine(0, prompts)
+        spec, eng = self._run_engine(3, prompts)
+        assert base == spec, (base, spec)
+
+    def test_acceptance_metric_present(self):
+        prompts = [[4, 4, 4, 4, 4, 4]]
+        _, eng = self._run_engine(3, prompts)
+        text = eng.metrics.render()
+        assert "tpu_serving_spec_proposed" in text
+        assert "tpu_serving_spec_accepted" in text
