@@ -157,8 +157,11 @@ class ServingEngine:
         self._prefill_thread = threading.Thread(
             target=self._prefill_loop, name="serving-prefill", daemon=True)
         self._decode = jax.jit(self.model.decode_step)
-        self._verify = (jax.jit(self.model.verify_step)
-                        if sc.speculate_k > 0 else None)
+        # one jitted verify kernel serves both speculative decode (engine
+        # thread) and chunked prefill (prefill thread) — jit dispatch is
+        # thread-safe and the compile cache is shared
+        self._verify_fn = jax.jit(self.model.verify_step)
+        self._verify = self._verify_fn if sc.speculate_k > 0 else None
         if self._verify is not None:
             # zero-seed so acceptance-rate dashboards see the series from
             # pod start, not first acceptance
@@ -195,11 +198,14 @@ class ServingEngine:
             f: Future = Future()
             f.set_exception(ValueError("empty prompt"))
             return f
-        if len(prompt) > self.sc.max_prefill_len:
+        if len(prompt) > self.sc.cache_len - 1:
+            # prompts longer than one prefill bucket run CHUNKED (the
+            # verify kernel appends each chunk to the cache), so the real
+            # ceiling is the per-slot KV budget minus one generated token
             f = Future()
             f.set_exception(ValueError(
-                f"prompt length {len(prompt)} > max_prefill_len "
-                f"{self.sc.max_prefill_len}"))
+                f"prompt length {len(prompt)} > cache budget "
+                f"{self.sc.cache_len - 1}"))
             return f
         if max_new_tokens is None:
             max_new_tokens = self.sc.max_new_tokens
@@ -291,6 +297,13 @@ class ServingEngine:
                 self.metrics.set_gauge("tpu_serving_queue_depth", 0)
                 self.metrics.set_gauge("tpu_serving_active_slots", 0)
 
+    def _padded(self, toks: list[int]) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """Zero-pad to the compile bucket; returns (tokens (1, bucket),
+        true_len (1,)) — one policy for the head and every chunk."""
+        bucket = self._bucket_len(len(toks))
+        arr = jnp.asarray([toks + [0] * (bucket - len(toks))], jnp.int32)
+        return arr, jnp.asarray([len(toks)], jnp.int32)
+
     def _bucket_len(self, n: int) -> int:
         b = 16
         while b < n:
@@ -311,13 +324,25 @@ class ServingEngine:
             try:
                 single = self.model.init_cache(1, self.sc.cache_len)
                 # bucket the prompt to a few fixed lengths so the prefill jit
-                # compiles once per bucket, not once per prompt length
-                bucket = self._bucket_len(len(req.prompt))
-                padded = req.prompt + [0] * (bucket - len(req.prompt))
-                prompt = jnp.asarray([padded], jnp.int32)
-                true_len = jnp.asarray([len(req.prompt)], jnp.int32)
+                # compiles once per bucket, not once per prompt length; a
+                # prompt longer than max_prefill_len runs CHUNKED — the
+                # first chunk through prefill, the rest appended through the
+                # verify kernel (each chunk's padding KV lands beyond the
+                # committed index, so it is never attended and is later
+                # overwritten — the decode-path invariant)
+                head = req.prompt[:self.sc.max_prefill_len]
+                prompt, true_len = self._padded(head)
                 last_logits, single = self._prefill(self.params, prompt,
                                                     single, true_len)
+                for start in range(self.sc.max_prefill_len, len(req.prompt),
+                                   self.sc.max_prefill_len):
+                    chunk = req.prompt[start:start + self.sc.max_prefill_len]
+                    ctoks, _ = self._padded(chunk)
+                    logits_k, single = self._verify_fn(self.params, ctoks,
+                                                       single)
+                    single = dict(single)
+                    single["index"] = single["index"] + len(chunk)
+                    last_logits = logits_k[:, len(chunk) - 1]
                 self._prefill_key, sub = jax.random.split(self._prefill_key)
                 first = int(_sample(last_logits, sub, [req.temperature],
                                     [req.top_k], [req.top_p])[0])
