@@ -49,6 +49,11 @@ class LlamaConfig:
     rope_theta: float = 500_000.0
     rope_scaling: Optional[dict] = None
     norm_eps: float = 1e-5
+    # Mistral-style sliding-window attention: each position attends only the
+    # last W tokens (None = full causal). The flash kernels skip blocks
+    # outside the band, so long-context cost is O(S*W); decode masks the
+    # cache the same way.
+    sliding_window: Optional[int] = None
     tie_embeddings: bool = False
     mlp_activation: str = "silu"        # "silu" (SwiGLU) | "gelu_tanh" (GeGLU, Gemma)
     embed_scale: bool = False           # scale embeddings by sqrt(embed_dim) (Gemma)
@@ -121,6 +126,15 @@ def mixtral_8x7b() -> LlamaConfig:
                        n_layers=32, n_heads=32, n_kv_heads=8, mlp_dim=14336,
                        max_seq_len=32768, rope_theta=1_000_000.0,
                        n_experts=8, n_experts_per_tok=2)
+
+
+def mistral_7b() -> LlamaConfig:
+    # Mistral-7B-v0.1: Llama-shaped GQA decoder with 4096-token sliding-
+    # window attention.
+    return LlamaConfig(name="mistral-7b", vocab_size=32000, embed_dim=4096,
+                       n_layers=32, n_heads=32, n_kv_heads=8, mlp_dim=14336,
+                       max_seq_len=32768, rope_theta=10_000.0,
+                       sliding_window=4096)
 
 
 def qwen2_7b() -> LlamaConfig:
@@ -347,9 +361,15 @@ def _attention_block(x, lp, cfg: LlamaConfig, cos, sin, mesh, positions=None):
     # (B,S,H,D) -> (B,H,S,D)
     qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
     if mesh is not None and mesh.shape.get(AXES.SEQ, 1) > 1:
+        if cfg.sliding_window is not None:
+            raise ValueError("sliding_window does not compose with the seq "
+                             "axis (ring attention) — window ≪ context makes "
+                             "sequence parallelism unnecessary; use "
+                             "fsdp/tensor for those devices")
         o = ring_attention(qt, kt, vt, mesh, causal=True)
     else:
-        o = flash_attention(qt, kt, vt, causal=True)
+        o = flash_attention(qt, kt, vt, causal=True,
+                            sliding_window=cfg.sliding_window)
     o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * hd)
     return x + _mm(o, lp["wo"], cfg.dtype)
 
@@ -484,7 +504,8 @@ class LlamaModel:
             q = apply_rope(q, cos, sin)
             k = apply_rope(k, cos, sin)
             o = flash_attention(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-                                v.transpose(0, 2, 1, 3), causal=True)
+                                v.transpose(0, 2, 1, 3), causal=True,
+                                sliding_window=cfg.sliding_window)
             o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * cfg.head_dim_)
             y = y + _mm(o, lp["wo"], cfg.dtype)
             y, _ = _mlp_block(y, lp, cfg, self.mesh, train=False)
@@ -545,8 +566,11 @@ class LlamaModel:
         positions = idx[:, None] + jnp.arange(kk)[None, :]         # (B,K)
         max_len = cache["k"].shape[2]
         # (B,1,1,K,L): query j of slot b attends cache positions <= idx[b]+j
-        valid = (jnp.arange(max_len)[None, None, :]
-                 <= positions[:, :, None])[:, None, None]
+        pos_l = jnp.arange(max_len)[None, None, :]
+        valid = pos_l <= positions[:, :, None]
+        if cfg.sliding_window is not None:
+            valid &= (positions[:, :, None] - pos_l) < cfg.sliding_window
+        valid = valid[:, None, None]
         batch_ids = jnp.arange(b)[:, None]                         # (B,1)
 
         def block(carry, inputs):

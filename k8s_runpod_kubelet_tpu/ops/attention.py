@@ -79,10 +79,12 @@ def tuned_block_sizes(sq: int, sk: int,
 
 
 def _attention_xla(q, k, v, *, causal: bool, sm_scale: float,
-                   q_offset: int = 0) -> jax.Array:
+                   q_offset: int = 0,
+                   sliding_window: Optional[int] = None) -> jax.Array:
     """Reference/fallback path; identical math, XLA-fused. Matmuls stay in
     the input dtype with f32 accumulation (bf16 inputs keep the MXU on its
-    fast path); softmax statistics are f32."""
+    fast path); softmax statistics are f32. ``sliding_window`` (Mistral):
+    each query attends only the last W positions (requires causal)."""
     b, hq, sq, d = q.shape
     _, hkv, sk, _ = k.shape
     group = hq // hkv
@@ -93,26 +95,33 @@ def _attention_xla(q, k, v, *, causal: bool, sm_scale: float,
         q_pos = jnp.arange(sq) + q_offset
         k_pos = jnp.arange(sk)
         mask = q_pos[:, None] >= k_pos[None, :]
+        if sliding_window is not None:
+            mask &= (q_pos[:, None] - k_pos[None, :]) < sliding_window
         s = jnp.where(mask[None, None, None], s, NEG_INF)
+    elif sliding_window is not None:
+        raise ValueError("sliding_window requires causal attention")
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bhgqk,bhkd->bhgqd", p.astype(q.dtype), v,
                    preferred_element_type=jnp.float32)
     return o.reshape(b, hq, sq, d).astype(q.dtype)
 
 
-def _causal_mask(s, qi, kj, block_q, block_k):
+def _causal_mask(s, qi, kj, block_q, block_k, window=None):
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     k_pos = kj * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
-    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+    keep = q_pos >= k_pos
+    if window is not None:
+        keep &= (q_pos - k_pos) < window
+    return jnp.where(keep, s, NEG_INF)
 
 
 # -- forward kernel -----------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
                 block_q: int, block_k: int, num_k_blocks: int, causal: bool,
-                sm_scale: float):
+                sm_scale: float, window: Optional[int] = None):
     import jax.experimental.pallas as pl  # noqa: F401 (kernel-only import)
     qi = pl.program_id(2)
     kj = pl.program_id(3)
@@ -130,7 +139,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         s = jax.lax.dot_general(q, kc, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # (bq, bk)
         if causal:
-            s = _causal_mask(s, qi, kj, block_q, block_k)
+            s = _causal_mask(s, qi, kj, block_q, block_k, window)
         m_prev = m_ref[:, :1]                                 # (bq, 1)
         l_prev = l_ref[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -145,7 +154,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
 
     if causal:
         # this k block participates iff its first k pos <= the last q pos
-        pl.when(kj * block_k < (qi + 1) * block_q)(_compute)
+        # and (windowed) its last k pos is within the window of some q
+        cond = kj * block_k < (qi + 1) * block_q
+        if window is not None:
+            cond &= (kj + 1) * block_k > qi * block_q - window + 1
+        pl.when(cond)(_compute)
     else:
         _compute()
 
@@ -157,7 +170,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
 
 
 def _flash_fwd_pallas(q, k, v, causal: bool, scale: float, block_q: int,
-                      block_k: int, interpret: bool = False):
+                      block_k: int, interpret: bool = False,
+                      window: Optional[int] = None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -167,7 +181,7 @@ def _flash_fwd_pallas(q, k, v, causal: bool, scale: float, block_q: int,
     num_k_blocks = sk // block_k
     kernel = functools.partial(_fwd_kernel, block_q=block_q, block_k=block_k,
                                num_k_blocks=num_k_blocks, causal=causal,
-                               sm_scale=scale)
+                               sm_scale=scale, window=window)
     return pl.pallas_call(
         kernel,
         grid=(b, hq, sq // block_q, num_k_blocks),
@@ -205,7 +219,7 @@ def _flash_fwd_pallas(q, k, v, causal: bool, scale: float, block_q: int,
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                acc_ref, *, block_q: int, block_k: int, num_k_blocks: int,
-               causal: bool, sm_scale: float):
+               causal: bool, sm_scale: float, window: Optional[int] = None):
     import jax.experimental.pallas as pl  # noqa: F401
     qi = pl.program_id(2)
     kj = pl.program_id(3)
@@ -224,7 +238,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jax.lax.dot_general(q, kc, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
-            s = _causal_mask(s, qi, kj, block_q, block_k)
+            s = _causal_mask(s, qi, kj, block_q, block_k, window)
         p = jnp.exp(s - lse)                                  # (bq, bk)
         dp = jax.lax.dot_general(do, vc, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -234,7 +248,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             preferred_element_type=jnp.float32)
 
     if causal:
-        pl.when(kj * block_k < (qi + 1) * block_q)(_compute)
+        cond = kj * block_k < (qi + 1) * block_q
+        if window is not None:
+            cond &= (kj + 1) * block_k > qi * block_q - window + 1
+        pl.when(cond)(_compute)
     else:
         _compute()
 
@@ -245,7 +262,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_acc, dv_acc, *, block_q: int, block_k: int,
-                num_q_blocks: int, num_t: int, causal: bool, sm_scale: float):
+                num_q_blocks: int, num_t: int, causal: bool, sm_scale: float,
+                window: Optional[int] = None):
     import jax.experimental.pallas as pl  # noqa: F401
     kj = pl.program_id(2)
     t = pl.program_id(3)          # t = qh_in_group * num_q_blocks + q_block
@@ -266,7 +284,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(qc * sm_scale, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # (bq, bk)
         if causal:
-            s = _causal_mask(s, qi, kj, block_q, block_k)
+            s = _causal_mask(s, qi, kj, block_q, block_k, window)
         p = jnp.exp(s - lse)                                  # (bq, bk)
         dv_acc[...] += jax.lax.dot_general(
             p, doc, (((0,), (0,)), ((), ())),
@@ -280,7 +298,11 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     if causal:
         # this q block contributes iff its last q pos >= the first k pos
-        pl.when((qi + 1) * block_q > kj * block_k)(_compute)
+        # and (windowed) its first q pos still sees this k block
+        cond = (qi + 1) * block_q > kj * block_k
+        if window is not None:
+            cond &= qi * block_q < (kj + 1) * block_k + window - 1
+        pl.when(cond)(_compute)
     else:
         _compute()
 
@@ -291,7 +313,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_pallas(q, k, v, o, lse, do, causal: bool, scale: float,
-                      block_q: int, block_k: int, interpret: bool = False):
+                      block_q: int, block_k: int, interpret: bool = False,
+                      window: Optional[int] = None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -305,7 +328,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal: bool, scale: float,
 
     dq_kernel = functools.partial(_dq_kernel, block_q=block_q,
                                   block_k=block_k, num_k_blocks=num_k_blocks,
-                                  causal=causal, sm_scale=scale)
+                                  causal=causal, sm_scale=scale, window=window)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(b, hq, num_q_blocks, num_k_blocks),
@@ -336,7 +359,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal: bool, scale: float,
     dkv_kernel = functools.partial(_dkv_kernel, block_q=block_q,
                                    block_k=block_k,
                                    num_q_blocks=num_q_blocks, num_t=num_t,
-                                   causal=causal, sm_scale=scale)
+                                   causal=causal, sm_scale=scale,
+                                   window=window)
 
     def _qh(bb, kh, j, t):
         return kh * group + t // num_q_blocks
@@ -382,44 +406,57 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal: bool, scale: float,
 
 # -- differentiable wrapper ---------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_diff(q, k, v, causal, scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_diff(q, k, v, causal, scale, block_q, block_k, interpret, window):
     o, _ = _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
-                             interpret)
+                             interpret, window)
     return o
 
 
-def _flash_diff_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_diff_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+                    window):
     o, lse = _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
-                               interpret)
+                               interpret, window)
     return o, (q, k, v, o, lse)
 
 
-def _flash_diff_bwd(causal, scale, block_q, block_k, interpret, res, g):
+def _flash_diff_bwd(causal, scale, block_q, block_k, interpret, window,
+                    res, g):
     q, k, v, o, lse = res
     return _flash_bwd_pallas(q, k, v, o, lse, g, causal, scale, block_q,
-                             block_k, interpret)
+                             block_k, interpret, window)
 
 
 _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "sm_scale", "use_pallas",
-                                             "block_q", "block_k", "interpret"))
+                                             "block_q", "block_k", "interpret",
+                                             "sliding_window"))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = True, sm_scale: Optional[float] = None,
                     use_pallas: Optional[bool] = None,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
-                    interpret: bool = False) -> jax.Array:
+                    interpret: bool = False,
+                    sliding_window: Optional[int] = None) -> jax.Array:
     """Multi-head attention with GQA. Shapes: q (B,Hq,S,D), k/v (B,Hkv,S,D).
     ``block_q``/``block_k`` default to the per-generation tuned pick.
     ``interpret=True`` forces the Pallas kernels through the interpreter
-    (CPU-testable path for the exact kernel code)."""
+    (CPU-testable path for the exact kernel code). ``sliding_window``
+    (Mistral-style) limits each query to the last W positions — the causal
+    kernels skip blocks fully outside the band, so long-context windowed
+    attention costs O(S*W) not O(S^2)."""
     b, hq, sq, d = q.shape
     _, hkv, sk, _ = k.shape
     if hq % hkv != 0:
         raise ValueError(f"Hq={hq} not a multiple of Hkv={hkv}")
+    if sliding_window is not None:
+        if not causal:
+            raise ValueError("sliding_window requires causal attention")
+        if sliding_window <= 0:
+            raise ValueError(f"sliding_window must be positive, "
+                             f"got {sliding_window}")
     scale = sm_scale if sm_scale is not None else d ** -0.5
     auto_q, auto_k = tuned_block_sizes(sq, sk)
     bq = block_q or auto_q
@@ -427,5 +464,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     pallas_ok = (_use_pallas(use_pallas) or interpret) and bq and bk and \
         sq % bq == 0 and sk % bk == 0 and sq >= bq
     if not pallas_ok:
-        return _attention_xla(q, k, v, causal=causal, sm_scale=scale)
-    return _flash_diff(q, k, v, causal, scale, bq, bk, interpret)
+        return _attention_xla(q, k, v, causal=causal, sm_scale=scale,
+                              sliding_window=sliding_window)
+    return _flash_diff(q, k, v, causal, scale, bq, bk, interpret,
+                       sliding_window)

@@ -203,3 +203,21 @@ class TestHostPlacement:
         assert shard_of(tr.params) == shard_of(ref.params)
         out = tr.run(steps=2)
         assert np.isfinite(out["final_loss"])
+
+
+class TestMistralSlidingWindow:
+    def test_mistral_window_logits_parity(self):
+        """Window (8) < sequence (16): parity proves the sliding-window mask
+        matches HF Mistral's, not just the weight mapping."""
+        torch.manual_seed(8)
+        hf = transformers.MistralForCausalLM(transformers.MistralConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=112,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, rope_theta=10_000.0,
+            rms_norm_eps=1e-5, sliding_window=8, tie_word_embeddings=False,
+            attn_implementation="eager"))
+        cfg = _f32(tiny_llama(vocab_size=128, embed_dim=64, n_layers=2,
+                              n_heads=4, n_kv_heads=2, mlp_dim=112,
+                              max_seq_len=64, rope_theta=10_000.0,
+                              sliding_window=8))
+        _compare(cfg, hf)

@@ -200,3 +200,65 @@ class TestRingAttention:
         got = ring_attention(q, k, v, mesh)
         ref = naive_attention(q, k, v, True)
         np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-4, atol=2e-4)
+
+
+class TestSlidingWindow:
+    def naive_window(self, q, k, v, window):
+        b, hq, sq, d = q.shape
+        _, hkv, sk, _ = k.shape
+        kk = np.repeat(np.asarray(k), hq // hkv, axis=1)
+        vv = np.repeat(np.asarray(v), hq // hkv, axis=1)
+        s = np.einsum("bhqd,bhkd->bhqk", np.asarray(q, np.float64),
+                      kk.astype(np.float64)) / np.sqrt(d)
+        qpos = np.arange(sq)[:, None]
+        kpos = np.arange(sk)[None, :]
+        mask = (qpos >= kpos) & (qpos - kpos < window)
+        s = np.where(mask, s, -1e30)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        return np.einsum("bhqk,bhkd->bhqd", p, vv.astype(np.float64))
+
+    def test_xla_path_matches_naive(self):
+        ks = jax.random.split(jax.random.PRNGKey(10), 3)
+        q = jax.random.normal(ks[0], (1, 4, 64, 32))
+        k = jax.random.normal(ks[1], (1, 2, 64, 32))
+        v = jax.random.normal(ks[2], (1, 2, 64, 32))
+        got = flash_attention(q, k, v, causal=True, sliding_window=16)
+        ref = self.naive_window(q, k, v, 16)
+        np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-4, atol=2e-4)
+
+    def test_pallas_kernels_match_naive_incl_grads(self):
+        """Window not aligned to block size (W=200, blocks 128): both the
+        in-block mask and the block-skip bounds must be right, fwd and bwd."""
+        ks = jax.random.split(jax.random.PRNGKey(11), 4)
+        b, hq, hkv, s, d, w = 1, 4, 2, 512, 32, 200
+        q = jax.random.normal(ks[0], (b, hq, s, d))
+        k = jax.random.normal(ks[1], (b, hkv, s, d))
+        v = jax.random.normal(ks[2], (b, hkv, s, d))
+        g = jax.random.normal(ks[3], (b, hq, s, d))
+
+        def loss_kernel(q, k, v):
+            o = flash_attention(q, k, v, causal=True, interpret=True,
+                                block_q=128, block_k=128, sliding_window=w)
+            return jnp.sum(o * g), o
+
+        def loss_ref(q, k, v):
+            o = _attention_xla(q, k, v, causal=True, sm_scale=d ** -0.5,
+                               sliding_window=w)
+            return jnp.sum(o * g), o
+
+        (l1, o1), g1 = jax.value_and_grad(loss_kernel, argnums=(0, 1, 2),
+                                          has_aux=True)(q, k, v)
+        (l2, o2), g2 = jax.value_and_grad(loss_ref, argnums=(0, 1, 2),
+                                          has_aux=True)(q, k, v)
+        np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
+                                   rtol=2e-4, atol=2e-4)
+        for a, b_ in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=2e-3, atol=2e-3)
+
+    def test_window_requires_causal(self):
+        import pytest
+        q = jnp.zeros((1, 2, 64, 16))
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, q, q, causal=False, sliding_window=8)

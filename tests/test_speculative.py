@@ -162,3 +162,30 @@ class TestChunkedPrefill:
             slots=1, cache_len=32, max_prefill_len=16))
         fut = eng.submit([1] * 40)
         assert isinstance(fut.exception(), ValueError)
+
+
+class TestSlidingWindowDecode:
+    def test_windowed_decode_matches_forward_rollout(self):
+        """Decode with a sliding window must equal a full windowed forward:
+        the cache mask (<= idx AND within window) is the decode-side of the
+        same mask the training kernels apply."""
+        cfg = _cfg(sliding_window=6)
+        params = init_params(cfg, jax.random.PRNGKey(7))
+        model = LlamaModel(cfg)
+        prompt = [3, 9, 4, 1, 5, 9, 2, 6]  # longer than the window
+        cache = model.init_cache(1, 32)
+        logits, cache = model.prefill(params, jnp.asarray([prompt]), cache)
+        toks = [int(np.argmax(np.asarray(logits[0])))]
+        for _ in range(5):
+            lg, cache = model.decode_step(
+                params, jnp.asarray(toks[-1:], jnp.int32), cache)
+            toks.append(int(np.argmax(np.asarray(lg[0]))))
+        # reference: rerun the whole sequence through forward each step
+        ref = []
+        cur = list(prompt)
+        for _ in range(6):
+            fl = model.forward(params, jnp.asarray([cur], jnp.int32))
+            nxt = int(np.argmax(np.asarray(fl[0, -1])))
+            ref.append(nxt)
+            cur.append(nxt)
+        assert toks == ref, (toks, ref)
