@@ -47,6 +47,12 @@ _TARGET_MFU = 0.40
 _TPU_ATTEMPTS = 3          # orchestrator: tries at the TPU backend
 _TPU_TIMEOUT_S = 1500      # per attempt: first compile can take minutes
 _TPU_RETRY_SLEEP_S = 20
+_PROBE_TIMEOUT_S = 300     # one cheap backend-init probe before the attempt
+                           # loop: a WEDGED tunnel hangs (not errors), and
+                           # burning the full attempt timeout x3 on hangs
+                           # could outlast the driver's own deadline. 300s is
+                           # deliberately generous — a slow-but-alive tunnel
+                           # must not be misread as dead.
 _CPU_TIMEOUT_S = 600
 
 
@@ -282,6 +288,26 @@ def _last_json_line(text: str) -> dict | None:
     return None
 
 
+def _probe_tpu() -> tuple[bool, str]:
+    """Can a child process even initialize the TPU backend? Bounded by
+    _PROBE_TIMEOUT_S so a hung tunnel costs minutes, not attempt-timeouts.
+    Returns (ok, diagnostic) — the stderr tail distinguishes a hang from a
+    deterministic init error."""
+    code = ("import jax, sys; "
+            "sys.exit(0 if jax.default_backend() == 'tpu' else 1)")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=_PROBE_TIMEOUT_S)
+        if proc.returncode == 0:
+            return True, ""
+        return False, (proc.stderr or "")[-400:]
+    except subprocess.TimeoutExpired:
+        return False, f"probe hung > {_PROBE_TIMEOUT_S}s (tunnel wedged?)"
+    except Exception as e:  # noqa: BLE001 - spawn failure
+        return False, f"{type(e).__name__}: {e}"
+
+
 def _run_child(quick: bool, platform: str | None, timeout_s: int):
     """Returns (parsed_json_or_None, rc, tail)."""
     env = dict(os.environ)
@@ -310,8 +336,16 @@ def _run_child(quick: bool, platform: str | None, timeout_s: int):
 
 def orchestrate(quick: bool) -> int:
     errors = []
+    # 0) one bounded probe: only gate the expensive attempts on it when the
+    # backend cannot initialize at all (hang or hard error) — a probe pass
+    # costs one init; a probe fail saves 3 x 1500s of guaranteed hangs.
+    ok, diag = _probe_tpu()
+    attempts = _TPU_ATTEMPTS if ok else 0
+    if not ok:
+        errors.append(f"tpu probe: {diag}")
+        print(f"[bench] TPU probe failed: {diag}", file=sys.stderr, flush=True)
     # 1) TPU (default platform) with retries — the tunnel can be slow.
-    for attempt in range(1, _TPU_ATTEMPTS + 1):
+    for attempt in range(1, attempts + 1):
         parsed, rc, tail = _run_child(quick, platform=None,
                                       timeout_s=_TPU_TIMEOUT_S)
         if parsed is not None and parsed.get("value") is not None:
@@ -321,7 +355,7 @@ def orchestrate(quick: bool) -> int:
         errors.append(f"tpu[{attempt}]: {err}")
         print(f"[bench] TPU attempt {attempt}/{_TPU_ATTEMPTS} failed: {err}",
               file=sys.stderr, flush=True)
-        if attempt < _TPU_ATTEMPTS:
+        if attempt < attempts:
             time.sleep(_TPU_RETRY_SLEEP_S)
 
     # 2) CPU fallback: quick config so it finishes in seconds-to-minutes.
