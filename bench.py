@@ -239,10 +239,12 @@ def run_serve_bench(quick: bool) -> int:
     cfg = _bench_config(tiny=tiny)
     params = init_params(cfg, jax.random.PRNGKey(0))
     slots, n_req, new_toks = (4, 12, 16) if tiny else (8, 48, 64)
+    spec = 3 if "--speculate" in sys.argv else 0
     sc = ServingConfig(slots=slots, max_prefill_len=64,
                        cache_len=128 if tiny else 1024,
                        max_new_tokens=new_toks,
-                       quantize_int8="--int8" in sys.argv)
+                       quantize_int8="--int8" in sys.argv,
+                       speculate_k=spec)
     engine = ServingEngine(cfg, params, sc).start()
     try:
         engine.submit([1, 2, 3], max_new_tokens=2).result(timeout=900)  # warm
@@ -267,6 +269,8 @@ def run_serve_bench(quick: bool) -> int:
         "requests": n_req, "slots": slots,
         "new_tokens_per_request": new_toks,
         "peak_queue_depth": peak_queue,
+        "int8": sc.quantize_int8,
+        "speculate_k": sc.speculate_k,
         "model": cfg.name,
         "backend": jax.default_backend(),
     })
