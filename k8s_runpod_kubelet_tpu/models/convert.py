@@ -85,8 +85,20 @@ def from_hf_state_dict(cfg: LlamaConfig, sd: Mapping[str, Any],
         "wk": _stack(sd, pre + "self_attn.k_proj.weight", L, dt, transpose=True),
         "wv": _stack(sd, pre + "self_attn.v_proj.weight", L, dt, transpose=True),
         "wo": _stack(sd, pre + "self_attn.o_proj.weight", L, dt, transpose=True),
-        "mlp_norm": _stack(sd, pre + "post_attention_layernorm.weight", L, dt),
     }
+    if cfg.post_norms:
+        # Gemma-2 sandwich norms: HF's post_attention_layernorm is the
+        # POST-attention output norm; the pre-MLP norm is
+        # pre_feedforward_layernorm
+        layers["attn_post_norm"] = _stack(
+            sd, pre + "post_attention_layernorm.weight", L, dt)
+        layers["mlp_norm"] = _stack(
+            sd, pre + "pre_feedforward_layernorm.weight", L, dt)
+        layers["mlp_post_norm"] = _stack(
+            sd, pre + "post_feedforward_layernorm.weight", L, dt)
+    else:
+        layers["mlp_norm"] = _stack(
+            sd, pre + "post_attention_layernorm.weight", L, dt)
     if cfg.qkv_bias:
         layers["wq_b"] = _stack(sd, pre + "self_attn.q_proj.bias", L, dt)
         layers["wk_b"] = _stack(sd, pre + "self_attn.k_proj.bias", L, dt)
@@ -145,8 +157,16 @@ def to_hf_state_dict(cfg: LlamaConfig, params: Params) -> dict[str, np.ndarray]:
 
     for i in range(cfg.n_layers):
         put(i, "input_layernorm.weight", np.asarray(lp["attn_norm"][i], np.float32))
-        put(i, "post_attention_layernorm.weight",
-            np.asarray(lp["mlp_norm"][i], np.float32))
+        if cfg.post_norms:
+            put(i, "post_attention_layernorm.weight",
+                np.asarray(lp["attn_post_norm"][i], np.float32))
+            put(i, "pre_feedforward_layernorm.weight",
+                np.asarray(lp["mlp_norm"][i], np.float32))
+            put(i, "post_feedforward_layernorm.weight",
+                np.asarray(lp["mlp_post_norm"][i], np.float32))
+        else:
+            put(i, "post_attention_layernorm.weight",
+                np.asarray(lp["mlp_norm"][i], np.float32))
         for ours, theirs in (("wq", "self_attn.q_proj.weight"),
                              ("wk", "self_attn.k_proj.weight"),
                              ("wv", "self_attn.v_proj.weight"),

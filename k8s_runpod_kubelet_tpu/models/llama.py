@@ -54,6 +54,19 @@ class LlamaConfig:
     # outside the band, so long-context cost is O(S*W); decode masks the
     # cache the same way.
     sliding_window: Optional[int] = None
+    # Gemma-2/3-style local/global interleave: layers repeat in groups of
+    # ``sliding_window_pattern``; within a group the LAST layer is global
+    # (full causal) and the rest use ``sliding_window``. 1 = every layer
+    # windowed (Mistral). Implemented by scanning over layer GROUPS with the
+    # per-sublayer window static inside the body — no data-dependent masks.
+    sliding_window_pattern: int = 1
+    # Gemma-2: attention scores pass cap*tanh(s/cap) before the causal mask
+    attn_logit_softcap: Optional[float] = None
+    # Gemma-2: q is scaled by this**-0.5 instead of head_dim**-0.5
+    query_pre_attn_scalar: Optional[float] = None
+    # Gemma-2 "sandwich" norms: extra RMSNorm on each sublayer OUTPUT
+    # (post-attention and post-MLP), before the residual add
+    post_norms: bool = False
     tie_embeddings: bool = False
     mlp_activation: str = "silu"        # "silu" (SwiGLU) | "gelu_tanh" (GeGLU, Gemma)
     embed_scale: bool = False           # scale embeddings by sqrt(embed_dim) (Gemma)
@@ -82,6 +95,22 @@ class LlamaConfig:
         return self.head_dim or self.embed_dim // self.n_heads
 
     @property
+    def sm_scale(self) -> float:
+        base = (self.query_pre_attn_scalar
+                if self.query_pre_attn_scalar is not None else self.head_dim_)
+        return base ** -0.5
+
+    def layer_windows(self) -> tuple[Optional[int], ...]:
+        """Static per-sublayer window for one scan group (len = pattern)."""
+        p = self.sliding_window_pattern
+        if self.sliding_window is None:
+            return (None,) * p
+        if p == 1:
+            return (self.sliding_window,)
+        return tuple(self.sliding_window if j != p - 1 else None
+                     for j in range(p))
+
+    @property
     def param_count(self) -> int:
         e, m, l, v = self.embed_dim, self.mlp_dim, self.n_layers, self.vocab_size
         hd = self.head_dim_
@@ -92,7 +121,7 @@ class LlamaConfig:
             mlp = 3 * e * m * self.n_experts + e * self.n_experts  # experts + router
         else:
             mlp = 3 * e * m
-        norms = 2 * e
+        norms = (4 if self.post_norms else 2) * e
         embed = v * e * (1 if self.tie_embeddings else 2)
         return l * (attn + mlp + norms) + embed + e
 
@@ -118,6 +147,21 @@ def gemma_7b() -> LlamaConfig:
                        norm_eps=1e-6, tie_embeddings=True,
                        mlp_activation="gelu_tanh", embed_scale=True,
                        norm_zero_centered=True)
+
+
+def gemma2_9b() -> LlamaConfig:
+    # Gemma-2-9B: alternating local(4096)/global attention (even layers
+    # local), tanh soft caps on attention scores (50) and final logits (30),
+    # sandwich norms around both sublayers, GQA with wide heads.
+    return LlamaConfig(name="gemma2-9b", vocab_size=256000, embed_dim=3584,
+                       n_layers=42, n_heads=16, n_kv_heads=8, head_dim=256,
+                       mlp_dim=14336, max_seq_len=8192, rope_theta=10_000.0,
+                       norm_eps=1e-6, tie_embeddings=True,
+                       mlp_activation="gelu_tanh", embed_scale=True,
+                       norm_zero_centered=True,
+                       sliding_window=4096, sliding_window_pattern=2,
+                       attn_logit_softcap=50.0, logit_softcap=30.0,
+                       query_pre_attn_scalar=256.0, post_norms=True)
 
 
 def mixtral_8x7b() -> LlamaConfig:
@@ -168,6 +212,9 @@ def param_logical_axes(cfg: LlamaConfig) -> Params:
         "wo": ("layer", "heads", "embed"),
         "mlp_norm": ("layer", "norm"),
     }
+    if cfg.post_norms:
+        layer.update({"attn_post_norm": ("layer", "norm"),
+                      "mlp_post_norm": ("layer", "norm")})
     if cfg.qkv_bias:
         layer.update({"wq_b": ("layer", "heads"),
                       "wk_b": ("layer", "kv_heads"),
@@ -209,6 +256,11 @@ def init_params(cfg: LlamaConfig, key: jax.Array,
             "mlp_norm": (cfg.n_layers, e),
         },
     }
+    if cfg.post_norms:
+        shapes["layers"].update({
+            "attn_post_norm": (cfg.n_layers, e),
+            "mlp_post_norm": (cfg.n_layers, e),
+        })
     if cfg.qkv_bias:
         shapes["layers"].update({
             "wq_b": (cfg.n_layers, cfg.n_heads * hd),
@@ -261,6 +313,29 @@ def init_params(cfg: LlamaConfig, key: jax.Array,
 
 def _constrain(x, mesh: Optional[Mesh], axes):
     return shard_logical(x, mesh, axes) if mesh is not None else x
+
+
+def _group_layers(tree, p: int):
+    """Reshape stacked layer leaves (L, ...) -> (L//p, p, ...) so a scan over
+    layer GROUPS can give each sublayer a different STATIC attention window
+    (Gemma-2 local/global interleave). p=1 returns the tree unchanged."""
+    if p == 1:
+        return tree
+
+    def reshape(a):
+        if a.shape[0] % p:
+            raise ValueError(f"n_layers {a.shape[0]} not divisible by "
+                             f"sliding_window_pattern {p}")
+        return a.reshape((a.shape[0] // p, p) + a.shape[1:])
+
+    return jax.tree_util.tree_map(reshape, tree)
+
+
+def _sublayer(tree, j: int, p: int):
+    """Select sublayer ``j`` of a group (identity when p=1)."""
+    if p == 1:
+        return tree
+    return jax.tree_util.tree_map(lambda a: a[j], tree)
 
 
 def _maybe_remat(fn, cfg: LlamaConfig):
@@ -350,7 +425,8 @@ def _qkv(h, lp, cfg: LlamaConfig, b: int, s: int):
             v.reshape(b, s, cfg.n_kv_heads, hd))
 
 
-def _attention_block(x, lp, cfg: LlamaConfig, cos, sin, mesh, positions=None):
+def _attention_block(x, lp, cfg: LlamaConfig, cos, sin, mesh, positions=None,
+                     window: Optional[int] = None, return_kv: bool = False):
     b, s, e = x.shape
     hd = cfg.head_dim_
     h = rms_norm(x, _norm_w(lp["attn_norm"], cfg), cfg.norm_eps)
@@ -361,17 +437,27 @@ def _attention_block(x, lp, cfg: LlamaConfig, cos, sin, mesh, positions=None):
     # (B,S,H,D) -> (B,H,S,D)
     qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
     if mesh is not None and mesh.shape.get(AXES.SEQ, 1) > 1:
-        if cfg.sliding_window is not None:
+        if window is not None:
             raise ValueError("sliding_window does not compose with the seq "
                              "axis (ring attention) — window ≪ context makes "
                              "sequence parallelism unnecessary; use "
                              "fsdp/tensor for those devices")
-        o = ring_attention(qt, kt, vt, mesh, causal=True)
+        if cfg.attn_logit_softcap is not None:
+            raise ValueError("attn_logit_softcap is not supported on the "
+                             "ring-attention (seq axis) path yet")
+        o = ring_attention(qt, kt, vt, mesh, causal=True,
+                           sm_scale=cfg.sm_scale)
     else:
-        o = flash_attention(qt, kt, vt, causal=True,
-                            sliding_window=cfg.sliding_window)
+        o = flash_attention(qt, kt, vt, causal=True, sm_scale=cfg.sm_scale,
+                            sliding_window=window,
+                            logit_soft_cap=cfg.attn_logit_softcap)
     o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * hd)
-    return x + _mm(o, lp["wo"], cfg.dtype)
+    o = _mm(o, lp["wo"], cfg.dtype)
+    if cfg.post_norms:
+        o = rms_norm(o, _norm_w(lp["attn_post_norm"], cfg), cfg.norm_eps)
+    if return_kv:
+        return x + o, k, v  # (B,S,Hkv,D) rope'd — the prefill cache layout
+    return x + o
 
 
 def _mlp_block(x, lp, cfg: LlamaConfig, mesh, train: bool = True):
@@ -391,11 +477,17 @@ def _mlp_block(x, lp, cfg: LlamaConfig, mesh, train: bool = True):
                              else cfg.n_experts / cfg.n_experts_per_tok),
             activation=_activation(cfg), dtype=cfg.dtype,
             constrain=(lambda t, axes: _constrain(t, mesh, axes)))
-        return x + y, cfg.router_aux_coef * aux + cfg.router_z_coef * z
-    gate = _mm(h, lp["w_gate"], cfg.dtype)
-    up = _mm(h, lp["w_up"], cfg.dtype)
-    act = _constrain(_activation(cfg)(gate) * up, mesh, ("batch", "seq", "act_mlp"))
-    return x + _mm(act, lp["w_down"], cfg.dtype), jnp.float32(0.0)
+        aux = cfg.router_aux_coef * aux + cfg.router_z_coef * z
+    else:
+        gate = _mm(h, lp["w_gate"], cfg.dtype)
+        up = _mm(h, lp["w_up"], cfg.dtype)
+        act = _constrain(_activation(cfg)(gate) * up, mesh,
+                         ("batch", "seq", "act_mlp"))
+        y = _mm(act, lp["w_down"], cfg.dtype)
+        aux = jnp.float32(0.0)
+    if cfg.post_norms:
+        y = rms_norm(y, _norm_w(lp["mlp_post_norm"], cfg), cfg.norm_eps)
+    return x + y, aux
 
 
 class LlamaModel:
@@ -417,8 +509,13 @@ class LlamaModel:
         x = _embed(params, tokens, cfg, mesh)
         x = _constrain(x, mesh, ("batch", "seq", "act_embed"))
 
+        pat = cfg.sliding_window_pattern
+        windows = cfg.layer_windows()
         n_stages = pipeline_stages(mesh)
         if n_stages > 1:
+            if pat > 1:
+                raise ValueError("sliding_window_pattern > 1 does not "
+                                 "compose with pipeline parallelism yet")
             # GPipe over the stage axis (parallel/pipeline.py). Blocks run
             # mesh-free inside the vmapped stage: GSPMD shardings never change
             # values, and XLA still propagates the tensor-axis layout from the
@@ -434,7 +531,8 @@ class LlamaModel:
                     "for the remaining devices instead")
 
             def stage_block(carry, lp):
-                y = _attention_block(carry, lp, cfg, cos, sin, None)
+                y = _attention_block(carry, lp, cfg, cos, sin, None,
+                                     window=cfg.sliding_window)
                 y, aux = _mlp_block(y, lp, cfg, None)
                 return y, aux
 
@@ -449,14 +547,26 @@ class LlamaModel:
                 n_microbatches=cfg.pipeline_microbatches)
             aux_layers = aux_total[None]
         else:
-            def block(carry, lp):
-                y = _attention_block(carry, lp, cfg, cos, sin, mesh, positions)
-                y, aux = _mlp_block(y, lp, cfg, mesh)
-                y = _constrain(y, mesh, ("batch", "seq", "act_embed"))
+            if pat > 1 and mesh is not None and mesh.shape.get(AXES.SEQ, 1) > 1:
+                raise ValueError("sliding_window_pattern > 1 does not compose "
+                                 "with the seq axis: local sublayers cannot "
+                                 "ring-attend")
+
+            def block(carry, lp_group):
+                y = carry
+                aux = jnp.float32(0.0)
+                for j, win in enumerate(windows):
+                    lp = _sublayer(lp_group, j, pat)
+                    y = _attention_block(y, lp, cfg, cos, sin, mesh,
+                                         positions, window=win)
+                    y, a = _mlp_block(y, lp, cfg, mesh)
+                    y = _constrain(y, mesh, ("batch", "seq", "act_embed"))
+                    aux = aux + a
                 return y, aux
 
             body = _maybe_remat(block, cfg)
-            x, aux_layers = jax.lax.scan(body, x, params["layers"])
+            x, aux_layers = jax.lax.scan(body, x,
+                                         _group_layers(params["layers"], pat))
         x = rms_norm(x, _norm_w(params["final_norm"], cfg), cfg.norm_eps)
         logits = _head_logits(x, params, cfg)
         logits = _constrain(logits, mesh, ("batch", "seq", "act_vocab"))
@@ -496,22 +606,29 @@ class LlamaModel:
                                     cfg.rope_theta, cfg.rope_scaling)
         x = _embed(params, tokens, cfg, self.mesh)
 
-        # one scan over layers that also collects the K/V it computes
-        def block(carry, lp):
-            y = carry
-            h = rms_norm(y, _norm_w(lp["attn_norm"], cfg), cfg.norm_eps)
-            q, k, v = _qkv(h, lp, cfg, b, s)
-            q = apply_rope(q, cos, sin)
-            k = apply_rope(k, cos, sin)
-            o = flash_attention(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-                                v.transpose(0, 2, 1, 3), causal=True,
-                                sliding_window=cfg.sliding_window)
-            o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * cfg.head_dim_)
-            y = y + _mm(o, lp["wo"], cfg.dtype)
-            y, _ = _mlp_block(y, lp, cfg, self.mesh, train=False)
-            return y, (k, v)
+        # one scan over layer groups that also collects the K/V it computes
+        pat = cfg.sliding_window_pattern
+        windows = cfg.layer_windows()
 
-        x, (k_all, v_all) = jax.lax.scan(block, x, params["layers"])
+        def block(carry, lp_group):
+            y = carry
+            ks, vs = [], []
+            for j, win in enumerate(windows):
+                lp = _sublayer(lp_group, j, pat)
+                y, k, v = _attention_block(y, lp, cfg, cos, sin, None,
+                                           window=win, return_kv=True)
+                y, _ = _mlp_block(y, lp, cfg, self.mesh, train=False)
+                ks.append(k)
+                vs.append(v)
+            if pat > 1:
+                return y, (jnp.stack(ks), jnp.stack(vs))
+            return y, (ks[0], vs[0])
+
+        x, (k_all, v_all) = jax.lax.scan(block, x,
+                                         _group_layers(params["layers"], pat))
+        if pat > 1:  # (L//p, p, B, S, h, d) -> (L, B, S, h, d)
+            k_all = k_all.reshape((cfg.n_layers,) + k_all.shape[2:])
+            v_all = v_all.reshape((cfg.n_layers,) + v_all.shape[2:])
         x = rms_norm(x, _norm_w(params["final_norm"], cfg), cfg.norm_eps)
         last = x[jnp.arange(b), true_length - 1]  # (B, E): each row's last real token
         logits = _head_logits(last, params, cfg)
@@ -565,17 +682,20 @@ class LlamaModel:
         x = _embed(params, tokens, cfg, self.mesh)                 # (B,K,E)
         positions = idx[:, None] + jnp.arange(kk)[None, :]         # (B,K)
         max_len = cache["k"].shape[2]
-        # (B,1,1,K,L): query j of slot b attends cache positions <= idx[b]+j
+        # (B,1,1,K,L): query j of slot b attends cache positions <= idx[b]+j;
+        # one STATIC mask per sublayer window (Gemma-2 local/global interleave)
+        pat = cfg.sliding_window_pattern
+        windows = cfg.layer_windows()
         pos_l = jnp.arange(max_len)[None, None, :]
-        valid = pos_l <= positions[:, :, None]
-        if cfg.sliding_window is not None:
-            valid &= (positions[:, :, None] - pos_l) < cfg.sliding_window
-        valid = valid[:, None, None]
+        causal_valid = pos_l <= positions[:, :, None]
+        masks = []
+        for win in windows:
+            m = causal_valid if win is None else (
+                causal_valid & ((positions[:, :, None] - pos_l) < win))
+            masks.append(m[:, None, None])
         batch_ids = jnp.arange(b)[:, None]                         # (B,1)
 
-        def block(carry, inputs):
-            y = carry
-            lp, k_cache, v_cache = inputs
+        def sub_block(y, lp, k_cache, v_cache, valid):
             h = rms_norm(y, _norm_w(lp["attn_norm"], cfg), cfg.norm_eps)
             q, k, v = _qkv(h, lp, cfg, b, kk)
             q = apply_rope(q, cos, sin, positions)
@@ -587,21 +707,48 @@ class LlamaModel:
             k_cache = k_cache.at[batch_ids, positions].set(k_w)
             v_cache = v_cache.at[batch_ids, positions].set(v_w)
             group = cfg.n_heads // cfg.n_kv_heads
-            qg = (q.astype(jnp.float32) * cfg.head_dim_ ** -0.5
+            qg = (q.astype(jnp.float32) * cfg.sm_scale
                   ).reshape(b, kk, cfg.n_kv_heads, group, cfg.head_dim_)
             s = jnp.einsum("bqhgd,bLhd->bhgqL", qg,
                            k_cache.astype(jnp.float32))
+            if cfg.attn_logit_softcap is not None:
+                cap = cfg.attn_logit_softcap
+                s = jnp.tanh(s / cap) * cap
             s = jnp.where(valid, s, -1e30)
             p = jax.nn.softmax(s, axis=-1)
             o = jnp.einsum("bhgqL,bLhd->bqhgd", p,
                            v_cache.astype(jnp.float32))
             o = o.reshape(b, kk, cfg.n_heads * cfg.head_dim_).astype(cfg.dtype)
-            y = y + _mm(o, lp["wo"], cfg.dtype)
+            o = _mm(o, lp["wo"], cfg.dtype)
+            if cfg.post_norms:
+                o = rms_norm(o, _norm_w(lp["attn_post_norm"], cfg),
+                             cfg.norm_eps)
+            y = y + o
             y, _ = _mlp_block(y, lp, cfg, self.mesh, train=False)
-            return y, (k_cache, v_cache)
+            return y, k_cache, v_cache
 
+        def block(carry, inputs):
+            y = carry
+            lp_g, k_g, v_g = inputs
+            if pat == 1:
+                y, k_new, v_new = sub_block(y, lp_g, k_g, v_g, masks[0])
+                return y, (k_new, v_new)
+            k_outs, v_outs = [], []
+            for j in range(pat):
+                y, k_new, v_new = sub_block(y, _sublayer(lp_g, j, pat),
+                                            k_g[j], v_g[j], masks[j])
+                k_outs.append(k_new)
+                v_outs.append(v_new)
+            return y, (jnp.stack(k_outs), jnp.stack(v_outs))
+
+        grouped_cache_k = _group_layers(cache["k"], pat)
+        grouped_cache_v = _group_layers(cache["v"], pat)
         x, (k_new, v_new) = jax.lax.scan(
-            block, x, (params["layers"], cache["k"], cache["v"]))
+            block, x, (_group_layers(params["layers"], pat),
+                       grouped_cache_k, grouped_cache_v))
+        if pat > 1:  # (L//p, p, B, L, h, d) -> (L, B, L, h, d)
+            k_new = k_new.reshape((cfg.n_layers,) + k_new.shape[2:])
+            v_new = v_new.reshape((cfg.n_layers,) + v_new.shape[2:])
         x = rms_norm(x, _norm_w(params["final_norm"], cfg), cfg.norm_eps)
         logits = _head_logits(x, params, cfg).astype(jnp.float32)  # (B,K,V)
         return logits, {"k": k_new, "v": v_new, "index": idx}

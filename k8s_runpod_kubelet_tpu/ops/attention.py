@@ -80,17 +80,22 @@ def tuned_block_sizes(sq: int, sk: int,
 
 def _attention_xla(q, k, v, *, causal: bool, sm_scale: float,
                    q_offset: int = 0,
-                   sliding_window: Optional[int] = None) -> jax.Array:
+                   sliding_window: Optional[int] = None,
+                   logit_soft_cap: Optional[float] = None) -> jax.Array:
     """Reference/fallback path; identical math, XLA-fused. Matmuls stay in
     the input dtype with f32 accumulation (bf16 inputs keep the MXU on its
     fast path); softmax statistics are f32. ``sliding_window`` (Mistral):
-    each query attends only the last W positions (requires causal)."""
+    each query attends only the last W positions (requires causal).
+    ``logit_soft_cap`` (Gemma-2): scores pass cap*tanh(s/cap) before the
+    mask, bounding attention logits smoothly."""
     b, hq, sq, d = q.shape
     _, hkv, sk, _ = k.shape
     group = hq // hkv
     qg = (q * jnp.asarray(sm_scale, q.dtype)).reshape(b, hkv, group, sq, d)
     s = jnp.einsum("bhgqd,bhkd->bhgqk", qg, k,
                    preferred_element_type=jnp.float32)
+    if logit_soft_cap is not None:
+        s = jnp.tanh(s / logit_soft_cap) * logit_soft_cap
     if causal:
         q_pos = jnp.arange(sq) + q_offset
         k_pos = jnp.arange(sk)
@@ -121,7 +126,8 @@ def _causal_mask(s, qi, kj, block_q, block_k, window=None):
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
                 block_q: int, block_k: int, num_k_blocks: int, causal: bool,
-                sm_scale: float, window: Optional[int] = None):
+                sm_scale: float, window: Optional[int] = None,
+                soft_cap: Optional[float] = None):
     import jax.experimental.pallas as pl  # noqa: F401 (kernel-only import)
     qi = pl.program_id(2)
     kj = pl.program_id(3)
@@ -138,6 +144,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         vc = v_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(q, kc, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # (bq, bk)
+        if soft_cap is not None:
+            s = jnp.tanh(s / soft_cap) * soft_cap
         if causal:
             s = _causal_mask(s, qi, kj, block_q, block_k, window)
         m_prev = m_ref[:, :1]                                 # (bq, 1)
@@ -171,7 +179,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
 
 def _flash_fwd_pallas(q, k, v, causal: bool, scale: float, block_q: int,
                       block_k: int, interpret: bool = False,
-                      window: Optional[int] = None):
+                      window: Optional[int] = None,
+                      soft_cap: Optional[float] = None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -181,7 +190,8 @@ def _flash_fwd_pallas(q, k, v, causal: bool, scale: float, block_q: int,
     num_k_blocks = sk // block_k
     kernel = functools.partial(_fwd_kernel, block_q=block_q, block_k=block_k,
                                num_k_blocks=num_k_blocks, causal=causal,
-                               sm_scale=scale, window=window)
+                               sm_scale=scale, window=window,
+                               soft_cap=soft_cap)
     return pl.pallas_call(
         kernel,
         grid=(b, hq, sq // block_q, num_k_blocks),
@@ -219,7 +229,8 @@ def _flash_fwd_pallas(q, k, v, causal: bool, scale: float, block_q: int,
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                acc_ref, *, block_q: int, block_k: int, num_k_blocks: int,
-               causal: bool, sm_scale: float, window: Optional[int] = None):
+               causal: bool, sm_scale: float, window: Optional[int] = None,
+               soft_cap: Optional[float] = None):
     import jax.experimental.pallas as pl  # noqa: F401
     qi = pl.program_id(2)
     kj = pl.program_id(3)
@@ -237,12 +248,17 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         vc = v_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(q, kc, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        if soft_cap is not None:
+            t = jnp.tanh(s / soft_cap)
+            s = t * soft_cap
         if causal:
             s = _causal_mask(s, qi, kj, block_q, block_k, window)
         p = jnp.exp(s - lse)                                  # (bq, bk)
         dp = jax.lax.dot_general(do, vc, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
+        if soft_cap is not None:
+            ds = ds * (1.0 - t * t)  # d/ds_raw of cap*tanh(s_raw/cap)
         acc_ref[...] += jax.lax.dot_general(
             ds, kc, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -263,7 +279,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_acc, dv_acc, *, block_q: int, block_k: int,
                 num_q_blocks: int, num_t: int, causal: bool, sm_scale: float,
-                window: Optional[int] = None):
+                window: Optional[int] = None,
+                soft_cap: Optional[float] = None):
     import jax.experimental.pallas as pl  # noqa: F401
     kj = pl.program_id(2)
     t = pl.program_id(3)          # t = qh_in_group * num_q_blocks + q_block
@@ -283,6 +300,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, 0]
         s = jax.lax.dot_general(qc * sm_scale, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # (bq, bk)
+        if soft_cap is not None:
+            th = jnp.tanh(s / soft_cap)  # NOT `t` — that's the grid index
+            s = th * soft_cap
         if causal:
             s = _causal_mask(s, qi, kj, block_q, block_k, window)
         p = jnp.exp(s - lse)                                  # (bq, bk)
@@ -292,6 +312,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(doc, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta)                                 # (bq, bk)
+        if soft_cap is not None:
+            ds = ds * (1.0 - th * th)
         dk_acc[...] += jax.lax.dot_general(
             ds, qc, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)               # (bk, d)
@@ -314,7 +336,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd_pallas(q, k, v, o, lse, do, causal: bool, scale: float,
                       block_q: int, block_k: int, interpret: bool = False,
-                      window: Optional[int] = None):
+                      window: Optional[int] = None,
+                      soft_cap: Optional[float] = None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -328,7 +351,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal: bool, scale: float,
 
     dq_kernel = functools.partial(_dq_kernel, block_q=block_q,
                                   block_k=block_k, num_k_blocks=num_k_blocks,
-                                  causal=causal, sm_scale=scale, window=window)
+                                  causal=causal, sm_scale=scale, window=window,
+                                  soft_cap=soft_cap)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(b, hq, num_q_blocks, num_k_blocks),
@@ -360,7 +384,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal: bool, scale: float,
                                    block_k=block_k,
                                    num_q_blocks=num_q_blocks, num_t=num_t,
                                    causal=causal, sm_scale=scale,
-                                   window=window)
+                                   window=window, soft_cap=soft_cap)
 
     def _qh(bb, kh, j, t):
         return kh * group + t // num_q_blocks
@@ -406,25 +430,26 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal: bool, scale: float,
 
 # -- differentiable wrapper ---------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash_diff(q, k, v, causal, scale, block_q, block_k, interpret, window):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash_diff(q, k, v, causal, scale, block_q, block_k, interpret, window,
+                soft_cap):
     o, _ = _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
-                             interpret, window)
+                             interpret, window, soft_cap)
     return o
 
 
 def _flash_diff_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-                    window):
+                    window, soft_cap):
     o, lse = _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
-                               interpret, window)
+                               interpret, window, soft_cap)
     return o, (q, k, v, o, lse)
 
 
 def _flash_diff_bwd(causal, scale, block_q, block_k, interpret, window,
-                    res, g):
+                    soft_cap, res, g):
     q, k, v, o, lse = res
     return _flash_bwd_pallas(q, k, v, o, lse, g, causal, scale, block_q,
-                             block_k, interpret, window)
+                             block_k, interpret, window, soft_cap)
 
 
 _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
@@ -432,21 +457,25 @@ _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
 
 @functools.partial(jax.jit, static_argnames=("causal", "sm_scale", "use_pallas",
                                              "block_q", "block_k", "interpret",
-                                             "sliding_window"))
+                                             "sliding_window",
+                                             "logit_soft_cap"))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = True, sm_scale: Optional[float] = None,
                     use_pallas: Optional[bool] = None,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
                     interpret: bool = False,
-                    sliding_window: Optional[int] = None) -> jax.Array:
+                    sliding_window: Optional[int] = None,
+                    logit_soft_cap: Optional[float] = None) -> jax.Array:
     """Multi-head attention with GQA. Shapes: q (B,Hq,S,D), k/v (B,Hkv,S,D).
     ``block_q``/``block_k`` default to the per-generation tuned pick.
     ``interpret=True`` forces the Pallas kernels through the interpreter
     (CPU-testable path for the exact kernel code). ``sliding_window``
     (Mistral-style) limits each query to the last W positions — the causal
     kernels skip blocks fully outside the band, so long-context windowed
-    attention costs O(S*W) not O(S^2)."""
+    attention costs O(S*W) not O(S^2). ``logit_soft_cap`` (Gemma-2-style)
+    passes scores through cap*tanh(s/cap) before masking; the backward
+    kernels carry the tanh derivative exactly."""
     b, hq, sq, d = q.shape
     _, hkv, sk, _ = k.shape
     if hq % hkv != 0:
@@ -457,6 +486,9 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         if sliding_window <= 0:
             raise ValueError(f"sliding_window must be positive, "
                              f"got {sliding_window}")
+    if logit_soft_cap is not None and logit_soft_cap <= 0:
+        raise ValueError(f"logit_soft_cap must be positive, "
+                         f"got {logit_soft_cap}")
     scale = sm_scale if sm_scale is not None else d ** -0.5
     auto_q, auto_k = tuned_block_sizes(sq, sk)
     bq = block_q or auto_q
@@ -465,6 +497,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         sq % bq == 0 and sk % bk == 0 and sq >= bq
     if not pallas_ok:
         return _attention_xla(q, k, v, causal=causal, sm_scale=scale,
-                              sliding_window=sliding_window)
+                              sliding_window=sliding_window,
+                              logit_soft_cap=logit_soft_cap)
     return _flash_diff(q, k, v, causal, scale, bq, bk, interpret,
-                       sliding_window)
+                       sliding_window, logit_soft_cap)

@@ -92,6 +92,31 @@ class TestLogitsParity:
                               embed_scale=True, norm_zero_centered=True))
         _compare(cfg, hf, atol=1e-3)  # sqrt(E)-scaled embeddings amplify eps
 
+    def test_gemma2_interleave_softcaps_sandwich_norms(self):
+        """Gemma-2 pins the hardest feature set at once: alternating
+        local/global attention (layer 0 sliding in HF), tanh soft caps on
+        attention scores and final logits, query_pre_attn_scalar scaling,
+        and pre+post sandwich norms. S=16 > W=8 so the window binds."""
+        torch.manual_seed(4)
+        hf = transformers.Gemma2ForCausalLM(transformers.Gemma2Config(
+            vocab_size=128, hidden_size=64, intermediate_size=112,
+            num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+            head_dim=16, max_position_embeddings=64, rope_theta=10_000.0,
+            rms_norm_eps=1e-6, hidden_activation="gelu_pytorch_tanh",
+            query_pre_attn_scalar=32.0, sliding_window=8,
+            attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+            attn_implementation="eager"))
+        cfg = _f32(tiny_llama(vocab_size=128, embed_dim=64, n_layers=4,
+                              n_heads=4, n_kv_heads=2, head_dim=16,
+                              mlp_dim=112, max_seq_len=64,
+                              rope_theta=10_000.0, norm_eps=1e-6,
+                              tie_embeddings=True, mlp_activation="gelu_tanh",
+                              embed_scale=True, norm_zero_centered=True,
+                              attn_logit_softcap=50.0, logit_softcap=30.0,
+                              query_pre_attn_scalar=32.0, sliding_window=8,
+                              sliding_window_pattern=2, post_norms=True))
+        _compare(cfg, hf, atol=1e-3)
+
     def test_mixtral_sparse_moe(self):
         torch.manual_seed(3)
         hf = transformers.MixtralForCausalLM(transformers.MixtralConfig(

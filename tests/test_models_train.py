@@ -147,6 +147,90 @@ class TestGemmaFamily:
                                        rtol=2e-3, atol=2e-3)
 
 
+GEMMA2_CFG = tiny_llama(name="tiny-gemma2", vocab_size=128, embed_dim=64,
+                        n_layers=4, n_heads=4, n_kv_heads=2, head_dim=32,
+                        mlp_dim=128, max_seq_len=128, rope_theta=10_000.0,
+                        tie_embeddings=True, mlp_activation="gelu_tanh",
+                        embed_scale=True, norm_zero_centered=True,
+                        logit_softcap=30.0, attn_logit_softcap=50.0,
+                        query_pre_attn_scalar=64.0, post_norms=True,
+                        sliding_window=8, sliding_window_pattern=2,
+                        dtype=jnp.float32, param_dtype=jnp.float32)
+
+
+class TestGemma2Family:
+    """Gemma-2 features on top of Gemma-1: local/global attention interleave,
+    attention-score soft cap, query_pre_attn_scalar scaling, sandwich norms."""
+
+    def test_real_config_is_faithful(self):
+        from k8s_runpod_kubelet_tpu.models import gemma2_9b
+        cfg = gemma2_9b()
+        assert cfg.sliding_window == 4096 and cfg.sliding_window_pattern == 2
+        assert cfg.attn_logit_softcap == 50.0 and cfg.logit_softcap == 30.0
+        assert cfg.post_norms and cfg.tie_embeddings
+        assert cfg.query_pre_attn_scalar == 256.0
+        assert cfg.n_layers % cfg.sliding_window_pattern == 0
+
+    def test_post_norm_params_exist(self):
+        params = init_params(GEMMA2_CFG, jax.random.PRNGKey(0))
+        assert params["layers"]["attn_post_norm"].shape == (4, 64)
+        assert params["layers"]["mlp_post_norm"].shape == (4, 64)
+        # zero-centered init (applied as 1+w)
+        np.testing.assert_array_equal(
+            np.asarray(params["layers"]["attn_post_norm"]), 0.0)
+
+    def test_local_layers_actually_windowed(self):
+        """Perturbing a token beyond every local window but inside the causal
+        span must still change the output (global layers see it), while the
+        same perturbation with pattern=1 (all-local) must NOT change
+        positions more than W past it in a 1-layer model."""
+        import dataclasses as dc
+        cfg1 = dc.replace(GEMMA2_CFG, n_layers=1, sliding_window_pattern=1,
+                          logit_softcap=None)
+        model = LlamaModel(cfg1)
+        params = init_params(cfg1, jax.random.PRNGKey(0))
+        toks = jax.random.randint(jax.random.PRNGKey(1), (1, 32), 0, 128)
+        toks2 = toks.at[0, 0].set((toks[0, 0] + 1) % 128)
+        a = model.forward(params, toks)
+        b = model.forward(params, toks2)
+        # position >= W(=8): token 0 is outside the window -> logits equal
+        np.testing.assert_allclose(np.asarray(a[0, 12:]),
+                                   np.asarray(b[0, 12:]), atol=1e-5)
+        assert not np.allclose(np.asarray(a[0, 1:6]), np.asarray(b[0, 1:6]))
+        # with the interleave, the global sublayer carries token 0 everywhere
+        model2 = LlamaModel(GEMMA2_CFG)
+        params2 = init_params(GEMMA2_CFG, jax.random.PRNGKey(0))
+        a2 = model2.forward(params2, toks)
+        b2 = model2.forward(params2, toks2)
+        assert not np.allclose(np.asarray(a2[0, 12:]), np.asarray(b2[0, 12:]))
+
+    def test_decode_matches_forward(self):
+        """Prefill + decode must honor windows per sublayer, soft caps, and
+        post-norms — parity with the training forward, past the window edge."""
+        model = LlamaModel(GEMMA2_CFG)
+        params = init_params(GEMMA2_CFG, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 20), 0, 128)
+        full_logits = model.forward(params, tokens)
+        cache = model.init_cache(batch=2, max_len=32)
+        last, cache = model.prefill(params, tokens[:, :8], cache)
+        np.testing.assert_allclose(np.asarray(last),
+                                   np.asarray(full_logits[:, 7]),
+                                   rtol=2e-3, atol=2e-3)
+        for i in range(8, 20):  # decode well past the W=8 window boundary
+            logits, cache = model.decode_step(params, tokens[:, i], cache)
+            np.testing.assert_allclose(np.asarray(logits),
+                                       np.asarray(full_logits[:, i]),
+                                       rtol=2e-3, atol=2e-3)
+
+    def test_pattern_must_divide_layers(self):
+        import dataclasses as dc
+        bad = dc.replace(GEMMA2_CFG, n_layers=3)
+        model = LlamaModel(bad)
+        params = init_params(bad, jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="not divisible"):
+            model.forward(params, jnp.zeros((1, 8), jnp.int32))
+
+
 class TestQwenFamily:
     """Qwen2 architectural feature: biased q/k/v projections."""
 

@@ -262,3 +262,68 @@ class TestSlidingWindow:
         q = jnp.zeros((1, 2, 64, 16))
         with pytest.raises(ValueError, match="causal"):
             flash_attention(q, q, q, causal=False, sliding_window=8)
+
+
+class TestLogitSoftCap:
+    """Gemma-2 attention-score soft capping through the Pallas kernels."""
+
+    def test_pallas_kernels_match_xla_incl_grads(self):
+        ks = jax.random.split(jax.random.PRNGKey(12), 4)
+        b, hq, hkv, s, d, cap = 1, 4, 2, 256, 32, 5.0
+        # scale q up so scores actually reach the saturating region of tanh
+        q = jax.random.normal(ks[0], (b, hq, s, d)) * 3
+        k = jax.random.normal(ks[1], (b, hkv, s, d)) * 3
+        v = jax.random.normal(ks[2], (b, hkv, s, d))
+        g = jax.random.normal(ks[3], (b, hq, s, d))
+
+        def loss_kernel(q, k, v):
+            o = flash_attention(q, k, v, causal=True, interpret=True,
+                                block_q=128, block_k=128, logit_soft_cap=cap)
+            return jnp.sum(o * g), o
+
+        def loss_ref(q, k, v):
+            o = _attention_xla(q, k, v, causal=True, sm_scale=d ** -0.5,
+                               logit_soft_cap=cap)
+            return jnp.sum(o * g), o
+
+        (l1, o1), g1 = jax.value_and_grad(loss_kernel, argnums=(0, 1, 2),
+                                          has_aux=True)(q, k, v)
+        (l2, o2), g2 = jax.value_and_grad(loss_ref, argnums=(0, 1, 2),
+                                          has_aux=True)(q, k, v)
+        np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
+                                   rtol=2e-4, atol=2e-4)
+        for a, b_ in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=2e-3, atol=2e-3)
+
+    def test_cap_actually_bounds_scores(self):
+        """With a tiny cap the output must equal near-uniform attention."""
+        ks = jax.random.split(jax.random.PRNGKey(13), 3)
+        q = jax.random.normal(ks[0], (1, 2, 64, 16)) * 100
+        k = jax.random.normal(ks[1], (1, 2, 64, 16)) * 100
+        v = jax.random.normal(ks[2], (1, 2, 64, 16))
+        o = flash_attention(q, k, v, causal=False, logit_soft_cap=1e-4)
+        uniform = jnp.mean(v, axis=2, keepdims=True)
+        np.testing.assert_allclose(np.asarray(o),
+                                   np.broadcast_to(np.asarray(uniform),
+                                                   o.shape),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_composes_with_sliding_window(self):
+        ks = jax.random.split(jax.random.PRNGKey(14), 3)
+        q = jax.random.normal(ks[0], (1, 2, 256, 32))
+        k = jax.random.normal(ks[1], (1, 2, 256, 32))
+        v = jax.random.normal(ks[2], (1, 2, 256, 32))
+        got = flash_attention(q, k, v, causal=True, interpret=True,
+                              block_q=128, block_k=128,
+                              sliding_window=40, logit_soft_cap=50.0)
+        ref = _attention_xla(q, k, v, causal=True, sm_scale=32 ** -0.5,
+                             sliding_window=40, logit_soft_cap=50.0)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_cap_must_be_positive(self):
+        import pytest
+        q = jnp.zeros((1, 2, 64, 16))
+        with pytest.raises(ValueError, match="positive"):
+            flash_attention(q, q, q, logit_soft_cap=-1.0)
