@@ -588,6 +588,33 @@ class LlamaModel:
         return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype),
                 "index": jnp.zeros((batch,), jnp.int32)}
 
+    def init_ring_cache(self, batch: int, ring_len: int) -> Params:
+        """RING KV cache for uniformly-windowed models (Mistral): physical
+        size ``ring_len`` regardless of logical sequence length — position p
+        lives in ring slot p % ring_len, and ``abs_pos`` (B, R) records which
+        absolute position each slot currently holds (-1 = empty). Attention
+        masks on abs_pos, so visibility is exact under chunked prefill and
+        speculative rejections alike. Memory: O(W) per slot instead of
+        O(cache_len) — a 32k-budget Mistral slot shrinks ~7x.
+
+        Caller contract (the serving engine honors it): ring_len must be
+        >= window + the largest number of tokens any single prefill/verify
+        call writes, so a call can never overwrite a slot still inside some
+        query's window."""
+        cfg = self.cfg
+        if cfg.sliding_window is None or cfg.sliding_window_pattern != 1:
+            raise ValueError("ring cache requires a uniform sliding_window "
+                             "(pattern 1); global-attention layers need the "
+                             "full history")
+        if ring_len <= cfg.sliding_window:
+            raise ValueError(f"ring_len {ring_len} must exceed the window "
+                             f"{cfg.sliding_window} (write slack)")
+        shape = (cfg.n_layers, batch, ring_len, cfg.n_kv_heads, cfg.head_dim_)
+        return {"k": jnp.zeros(shape, cfg.dtype),
+                "v": jnp.zeros(shape, cfg.dtype),
+                "index": jnp.zeros((batch,), jnp.int32),
+                "abs_pos": jnp.full((batch, ring_len), -1, jnp.int32)}
+
     def prefill(self, params: Params, tokens: jax.Array, cache: Params,
                 true_length: Optional[jax.Array] = None
                 ) -> tuple[jax.Array, Params]:
@@ -633,10 +660,17 @@ class LlamaModel:
         last = x[jnp.arange(b), true_length - 1]  # (B, E): each row's last real token
         logits = _head_logits(last, params, cfg)
         max_len = cache["k"].shape[2]
+        if s > max_len:
+            raise ValueError(f"prompt length {s} exceeds cache length "
+                             f"{max_len}")
         pad = [(0, 0), (0, 0), (0, max_len - s), (0, 0), (0, 0)]
-        cache = {"k": jnp.pad(k_all, pad), "v": jnp.pad(v_all, pad),
-                 "index": true_length.astype(jnp.int32)}
-        return logits, cache
+        new_cache = {"k": jnp.pad(k_all, pad), "v": jnp.pad(v_all, pad),
+                     "index": true_length.astype(jnp.int32)}
+        if "abs_pos" in cache:  # ring: slots 0..true_len-1 hold those positions
+            slot_ids = jnp.arange(max_len)[None, :]
+            new_cache["abs_pos"] = jnp.where(
+                slot_ids < true_length[:, None], slot_ids, -1).astype(jnp.int32)
+        return logits, new_cache
 
     def decode_step(self, params: Params, token: jax.Array, cache: Params,
                     active: Optional[jax.Array] = None
@@ -682,30 +716,46 @@ class LlamaModel:
         x = _embed(params, tokens, cfg, self.mesh)                 # (B,K,E)
         positions = idx[:, None] + jnp.arange(kk)[None, :]         # (B,K)
         max_len = cache["k"].shape[2]
-        # (B,1,1,K,L): query j of slot b attends cache positions <= idx[b]+j;
-        # one STATIC mask per sublayer window (Gemma-2 local/global interleave)
         pat = cfg.sliding_window_pattern
         windows = cfg.layer_windows()
-        pos_l = jnp.arange(max_len)[None, None, :]
-        causal_valid = pos_l <= positions[:, :, None]
+        batch_ids = jnp.arange(b)[:, None]                         # (B,1)
+        ring = "abs_pos" in cache
+        if ring:
+            # ring addressing: position p writes slot p % R; the mask comes
+            # from abs_pos AFTER this call's writes (every layer writes the
+            # same slots, so one abs_pos array serves the whole scan). Slots
+            # holding not-yet-committed draft positions (> idx+j) fail the
+            # causal test, so rejected-draft garbage stays invisible until
+            # genuinely overwritten.
+            slots = positions % max_len                            # (B,K)
+            old_abs = cache["abs_pos"][batch_ids, slots]
+            new_abs = cache["abs_pos"].at[batch_ids, slots].set(
+                jnp.where(active[:, None], positions, old_abs))
+            pos_l = new_abs[:, None, :]                            # (B,1,R)
+        else:
+            slots = positions
+            new_abs = None
+            pos_l = jnp.arange(max_len)[None, None, :]
+        # (B,1,1,K,L): query j of slot b attends cache positions <= idx[b]+j;
+        # one STATIC mask per sublayer window (Gemma-2 local/global interleave)
+        causal_valid = (pos_l >= 0) & (pos_l <= positions[:, :, None])
         masks = []
         for win in windows:
             m = causal_valid if win is None else (
                 causal_valid & ((positions[:, :, None] - pos_l) < win))
             masks.append(m[:, None, None])
-        batch_ids = jnp.arange(b)[:, None]                         # (B,1)
 
         def sub_block(y, lp, k_cache, v_cache, valid):
             h = rms_norm(y, _norm_w(lp["attn_norm"], cfg), cfg.norm_eps)
             q, k, v = _qkv(h, lp, cfg, b, kk)
             q = apply_rope(q, cos, sin, positions)
             k = apply_rope(k, cos, sin, positions)
-            old_k = k_cache[batch_ids, positions]                  # (B,K,h,d)
-            old_v = v_cache[batch_ids, positions]
+            old_k = k_cache[batch_ids, slots]                      # (B,K,h,d)
+            old_v = v_cache[batch_ids, slots]
             k_w = jnp.where(active[:, None, None, None], k, old_k)
             v_w = jnp.where(active[:, None, None, None], v, old_v)
-            k_cache = k_cache.at[batch_ids, positions].set(k_w)
-            v_cache = v_cache.at[batch_ids, positions].set(v_w)
+            k_cache = k_cache.at[batch_ids, slots].set(k_w)
+            v_cache = v_cache.at[batch_ids, slots].set(v_w)
             group = cfg.n_heads // cfg.n_kv_heads
             qg = (q.astype(jnp.float32) * cfg.sm_scale
                   ).reshape(b, kk, cfg.n_kv_heads, group, cfg.head_dim_)
@@ -751,15 +801,21 @@ class LlamaModel:
             v_new = v_new.reshape((cfg.n_layers,) + v_new.shape[2:])
         x = rms_norm(x, _norm_w(params["final_norm"], cfg), cfg.norm_eps)
         logits = _head_logits(x, params, cfg).astype(jnp.float32)  # (B,K,V)
-        return logits, {"k": k_new, "v": v_new, "index": idx}
+        out = {"k": k_new, "v": v_new, "index": idx}
+        if ring:
+            out["abs_pos"] = new_abs
+        return logits, out
 
     @staticmethod
     def insert_into_slot(cache: Params, single: Params, slot: int | jax.Array
                          ) -> Params:
         """Place a freshly-prefilled single-request cache (batch 1) into slot
         ``slot`` of the serving cache (continuous batching admission)."""
-        return {
+        out = {
             "k": cache["k"].at[:, slot].set(single["k"][:, 0]),
             "v": cache["v"].at[:, slot].set(single["v"][:, 0]),
             "index": cache["index"].at[slot].set(single["index"][0]),
         }
+        if "abs_pos" in cache:
+            out["abs_pos"] = cache["abs_pos"].at[slot].set(single["abs_pos"][0])
+        return out

@@ -63,6 +63,13 @@ class ServingConfig:
     # orders, so logits within ~1 ulp of a tie may tie-break differently
     # (bf16 especially) — same model quality, not a correctness loss.
     speculate_k: int = 0
+    # Ring KV cache for uniformly-windowed models (Mistral): physical cache
+    # per slot shrinks to ~window + write slack while cache_len stays the
+    # LOGICAL budget (prompt + generation length cap). None = auto: on
+    # whenever the model has a uniform sliding window and the ring is
+    # actually smaller; True forces it (error if the model can't); False
+    # disables.
+    ring_cache: Optional[bool] = None
 
 
 @dataclasses.dataclass
@@ -147,7 +154,11 @@ class ServingEngine:
         self._ready: "queue.Queue[tuple[Request, Params, int]]" = \
             queue.Queue(maxsize=sc.slots)
         self._slots = [_Slot() for _ in range(sc.slots)]
-        self._cache = self.model.init_cache(sc.slots, sc.cache_len)
+        self._ring_len = self._pick_ring_len(cfg, sc)
+        if self._ring_len is not None:
+            self._cache = self.model.init_ring_cache(sc.slots, self._ring_len)
+        else:
+            self._cache = self.model.init_cache(sc.slots, sc.cache_len)
         self._tokens = jnp.zeros((sc.slots,), jnp.int32)
         key = jax.random.PRNGKey(seed)
         self._key, self._prefill_key = jax.random.split(key)
@@ -173,6 +184,25 @@ class ServingEngine:
         self._insert = jax.jit(LlamaModel.insert_into_slot, donate_argnums=(0,))
         self.total_generated = 0
         self.last_error: Optional[str] = None
+
+    @staticmethod
+    def _pick_ring_len(cfg: LlamaConfig, sc: ServingConfig) -> Optional[int]:
+        """Physical ring size, or None for a plain linear cache. The slack
+        term is the most tokens one prefill/verify call can write — the ring
+        invariant (init_ring_cache docstring) that keeps every in-window
+        entry alive across chunked prefill and speculative rejections."""
+        windowed = (cfg.sliding_window is not None
+                    and cfg.sliding_window_pattern == 1)
+        if sc.ring_cache is False or (sc.ring_cache is None and not windowed):
+            return None
+        if not windowed:
+            raise ValueError("ring_cache=True needs a model with a uniform "
+                             "sliding window")
+        slack = max(sc.max_prefill_len, sc.speculate_k + 1)
+        ring = -(-(cfg.sliding_window + slack) // 128) * 128
+        if sc.ring_cache is None and ring >= sc.cache_len:
+            return None  # no memory win — stay linear
+        return ring
 
     # -- public API ------------------------------------------------------------
 
@@ -322,7 +352,10 @@ class ServingEngine:
                 continue
             self.metrics.set_gauge("tpu_serving_queue_depth", self._queue.qsize())
             try:
-                single = self.model.init_cache(1, self.sc.cache_len)
+                if self._ring_len is not None:
+                    single = self.model.init_ring_cache(1, self._ring_len)
+                else:
+                    single = self.model.init_cache(1, self.sc.cache_len)
                 # bucket the prompt to a few fixed lengths so the prefill jit
                 # compiles once per bucket, not once per prompt length; a
                 # prompt longer than max_prefill_len runs CHUNKED — the

@@ -1,0 +1,188 @@
+"""Ring KV cache for uniformly-windowed models (Mistral-style serving).
+
+The ring stores only ~window + write-slack positions per slot; ``abs_pos``
+records which absolute position each ring slot holds and attention masks on
+it. These tests pin the three hard invariants:
+- decode parity with the full (windowed) forward PAST the wraparound point,
+- chunked prefill + speculative rejections never corrupt visible entries,
+- the engine picks the ring automatically for windowed models and its
+  greedy output is identical to the linear-cache engine's.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_runpod_kubelet_tpu.models import LlamaModel, init_params, tiny_llama
+from k8s_runpod_kubelet_tpu.workloads.serving import ServingConfig, ServingEngine
+
+# W=8 window, ring R=16 (slack 8): positions wrap after 16 tokens
+WCFG = tiny_llama(name="tiny-window", vocab_size=128, embed_dim=64,
+                  n_layers=2, n_heads=4, n_kv_heads=2, mlp_dim=128,
+                  max_seq_len=256, sliding_window=8,
+                  dtype=jnp.float32, param_dtype=jnp.float32)
+RING = 16
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(WCFG, jax.random.PRNGKey(0))
+
+
+class TestRingCacheModel:
+    def test_requires_uniform_window(self):
+        model = LlamaModel(tiny_llama(vocab_size=64, embed_dim=32, n_layers=2,
+                                      n_heads=2, n_kv_heads=1, mlp_dim=48))
+        with pytest.raises(ValueError, match="uniform sliding_window"):
+            model.init_ring_cache(1, 64)
+        g2 = tiny_llama(vocab_size=64, embed_dim=32, n_layers=2, n_heads=2,
+                        n_kv_heads=1, mlp_dim=48, sliding_window=8,
+                        sliding_window_pattern=2)
+        with pytest.raises(ValueError, match="uniform sliding_window"):
+            LlamaModel(g2).init_ring_cache(1, 64)
+        with pytest.raises(ValueError, match="exceed the window"):
+            LlamaModel(WCFG).init_ring_cache(1, 8)
+
+    def test_decode_matches_forward_past_wraparound(self, params):
+        """Logical position runs to 40 on a 16-slot ring (2.5 wraps); every
+        decoded logit must match the windowed full forward."""
+        model = LlamaModel(WCFG)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (2, 40), 0, 128)
+        full = model.forward(params, toks)
+        cache = model.init_ring_cache(2, RING)
+        last, cache = model.prefill(params, toks[:, :6], cache)
+        np.testing.assert_allclose(np.asarray(last), np.asarray(full[:, 5]),
+                                   rtol=2e-3, atol=2e-3)
+        for i in range(6, 40):
+            logits, cache = model.decode_step(params, toks[:, i], cache)
+            np.testing.assert_allclose(
+                np.asarray(logits), np.asarray(full[:, i]),
+                rtol=2e-3, atol=2e-3, err_msg=f"position {i}")
+
+    def test_ring_equals_linear_cache_decode(self, params):
+        """Same token stream through ring and linear caches: identical."""
+        model = LlamaModel(WCFG)
+        toks = jax.random.randint(jax.random.PRNGKey(2), (1, 30), 0, 128)
+        ring = model.init_ring_cache(1, RING)
+        lin = model.init_cache(1, 64)
+        l_r, ring = model.prefill(params, toks[:, :4], ring)
+        l_l, lin = model.prefill(params, toks[:, :4], lin)
+        np.testing.assert_allclose(np.asarray(l_r), np.asarray(l_l),
+                                   rtol=1e-5, atol=1e-5)
+        for i in range(4, 30):
+            o_r, ring = model.decode_step(params, toks[:, i], ring)
+            o_l, lin = model.decode_step(params, toks[:, i], lin)
+            np.testing.assert_allclose(np.asarray(o_r), np.asarray(o_l),
+                                       rtol=1e-5, atol=1e-5)
+
+    def test_padded_prefill_stamps_only_real_positions(self, params):
+        model = LlamaModel(WCFG)
+        cache = model.init_ring_cache(1, RING)
+        toks = jnp.asarray([[5, 6, 7, 0, 0, 0, 0, 0]], jnp.int32)
+        _, cache = model.prefill(params, toks, cache,
+                                 true_length=jnp.asarray([3], jnp.int32))
+        abs_pos = np.asarray(cache["abs_pos"][0])
+        np.testing.assert_array_equal(abs_pos[:3], [0, 1, 2])
+        np.testing.assert_array_equal(abs_pos[3:], -1)
+
+    def test_verify_rejection_then_decode_stays_exact(self, params):
+        """Speculative shape: verify writes K=4 tokens, only 1 commits
+        (worst-case rejection), then plain decode continues across the
+        wraparound — logits must still match the full forward."""
+        model = LlamaModel(WCFG)
+        verify = jax.jit(model.verify_step)
+        toks = jax.random.randint(jax.random.PRNGKey(3), (1, 36), 0, 128)
+        full = model.forward(params, toks)
+        cache = model.init_ring_cache(1, RING)
+        _, cache = model.prefill(params, toks[:, :6], cache)
+        i = 6
+        # alternate: one verify call with 3 junk drafts (rejected), commit 1,
+        # then two plain decode steps; repeat
+        while i < 33:
+            tin = jnp.concatenate(
+                [toks[:, i:i + 1],
+                 jnp.full((1, 3), 99, jnp.int32)], axis=1)  # junk drafts
+            logits, cache = verify(params, tin, cache)
+            np.testing.assert_allclose(np.asarray(logits[:, 0]),
+                                       np.asarray(full[:, i]),
+                                       rtol=2e-3, atol=2e-3,
+                                       err_msg=f"verify at {i}")
+            cache = dict(cache)
+            cache["index"] = cache["index"] + 1  # commit only token 0
+            i += 1
+            for _ in range(2):
+                logits, cache = model.decode_step(params, toks[:, i], cache)
+                np.testing.assert_allclose(np.asarray(logits),
+                                           np.asarray(full[:, i]),
+                                           rtol=2e-3, atol=2e-3,
+                                           err_msg=f"decode at {i}")
+                i += 1
+
+    def test_insert_into_slot_carries_abs_pos(self, params):
+        model = LlamaModel(WCFG)
+        big = model.init_ring_cache(2, RING)
+        single = model.init_ring_cache(1, RING)
+        _, single = model.prefill(params, jnp.asarray([[1, 2, 3]], jnp.int32),
+                                  single)
+        big = LlamaModel.insert_into_slot(big, single, 1)
+        np.testing.assert_array_equal(np.asarray(big["abs_pos"][1]),
+                                      np.asarray(single["abs_pos"][0]))
+        np.testing.assert_array_equal(np.asarray(big["abs_pos"][0]), -1)
+
+
+class TestRingCacheEngine:
+    def _engine(self, params, ring, **kw):
+        sc = ServingConfig(slots=2, max_prefill_len=16, cache_len=256,
+                           max_new_tokens=24, ring_cache=ring, **kw)
+        return ServingEngine(WCFG, params, sc).start()
+
+    def test_auto_on_for_windowed_model_and_matches_linear(self, params):
+        e_ring = self._engine(params, ring=None)
+        e_lin = self._engine(params, ring=False)
+        try:
+            # 8 window + 16 slack -> rounds up to one 128 lane tile, and
+            # 128 < cache_len 256 so auto enables
+            assert e_ring._ring_len == 128
+            assert "abs_pos" in e_ring._cache
+            assert "abs_pos" not in e_lin._cache
+            prompts = [[(7 * j + i) % 128 for j in range(1 + 3 * i)]
+                       for i in range(4)]
+            outs_r = [e_ring.submit(p, max_new_tokens=24).result(timeout=60)
+                      for p in prompts]
+            outs_l = [e_lin.submit(p, max_new_tokens=24).result(timeout=60)
+                      for p in prompts]
+            for r, l in zip(outs_r, outs_l):
+                assert r["tokens"] == l["tokens"]
+        finally:
+            e_ring.stop()
+            e_lin.stop()
+
+    def test_speculative_on_ring_matches_linear(self, params):
+        e_ring = self._engine(params, ring=True, speculate_k=3)
+        e_lin = self._engine(params, ring=False, speculate_k=3)
+        try:
+            prompt = [3, 1, 4, 1, 5, 9, 2, 6, 3, 1, 4, 1, 5]  # repeats help PLD
+            r = e_ring.submit(prompt, max_new_tokens=24).result(timeout=60)
+            l = e_lin.submit(prompt, max_new_tokens=24).result(timeout=60)
+            assert r["tokens"] == l["tokens"]
+        finally:
+            e_ring.stop()
+            e_lin.stop()
+
+    def test_forcing_ring_on_unwindowed_model_raises(self):
+        cfg = tiny_llama(vocab_size=64, embed_dim=32, n_layers=2, n_heads=2,
+                         n_kv_heads=1, mlp_dim=48, dtype=jnp.float32,
+                         param_dtype=jnp.float32)
+        p = init_params(cfg, jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="uniform sliding window"):
+            ServingEngine(cfg, p, ServingConfig(slots=1, ring_cache=True))
+
+    def test_auto_off_when_no_memory_win(self, params):
+        sc = ServingConfig(slots=1, max_prefill_len=16, cache_len=64,
+                           ring_cache=None)
+        e = ServingEngine(WCFG, params, sc)
+        # ring would be 128 >= cache_len 64 -> linear
+        assert e._ring_len is None and "abs_pos" not in e._cache
