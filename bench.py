@@ -244,6 +244,7 @@ def run_serve_bench(quick: bool) -> int:
                        cache_len=128 if tiny else 1024,
                        max_new_tokens=new_toks,
                        quantize_int8="--int8" in sys.argv,
+                       quantize_kv_int8="--kv-int8" in sys.argv,
                        speculate_k=spec)
     engine = ServingEngine(cfg, params, sc).start()
     try:
@@ -270,6 +271,7 @@ def run_serve_bench(quick: bool) -> int:
         "new_tokens_per_request": new_toks,
         "peak_queue_depth": peak_queue,
         "int8": sc.quantize_int8,
+        "kv_int8": sc.quantize_kv_int8,
         "speculate_k": sc.speculate_k,
         "model": cfg.name,
         "backend": jax.default_backend(),

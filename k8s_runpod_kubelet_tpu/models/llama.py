@@ -490,6 +490,19 @@ def _mlp_block(x, lp, cfg: LlamaConfig, mesh, train: bool = True):
     return x + y, aux
 
 
+def _kv_quant(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Symmetric int8 rows over the last (head_dim) axis: (..., d) ->
+    (int8 (..., d), f32 scale (...,))."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.round(x.astype(jnp.float32) / scale[..., None])
+    return jnp.clip(q, -127, 127).astype(jnp.int8), scale
+
+
+def _kv_dequant(q: jax.Array, scale: jax.Array, dtype=jnp.float32) -> jax.Array:
+    return q.astype(dtype) * scale[..., None].astype(dtype)
+
+
 class LlamaModel:
     """Functional model: forward(params, tokens) and decode-step methods."""
 
@@ -579,16 +592,32 @@ class LlamaModel:
 
     # -- decode (serving) ------------------------------------------------------
 
-    def init_cache(self, batch: int, max_len: Optional[int] = None) -> Params:
+    def init_cache(self, batch: int, max_len: Optional[int] = None,
+                   quantize: bool = False) -> Params:
         """KV cache with PER-SLOT write indices — the decode batch is a set of
-        independent in-flight requests (continuous batching), not one sequence."""
+        independent in-flight requests (continuous batching), not one sequence.
+
+        ``quantize=True`` stores K/V as int8 with per-(position, kv-head)
+        f32 scales ("k_scale"/"v_scale"): decode is HBM-bandwidth-bound on
+        cache reads, so int8 halves the traffic AND doubles how many slots
+        fit; dequantization happens in-register after the load."""
         cfg = self.cfg
         max_len = max_len or cfg.max_seq_len
-        shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim_)
-        return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype),
-                "index": jnp.zeros((batch,), jnp.int32)}
+        return self._empty_cache(batch, max_len, quantize)
 
-    def init_ring_cache(self, batch: int, ring_len: int) -> Params:
+    def _empty_cache(self, batch: int, length: int, quantize: bool) -> Params:
+        cfg = self.cfg
+        shape = (cfg.n_layers, batch, length, cfg.n_kv_heads, cfg.head_dim_)
+        dt = jnp.int8 if quantize else cfg.dtype
+        cache = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt),
+                 "index": jnp.zeros((batch,), jnp.int32)}
+        if quantize:
+            cache["k_scale"] = jnp.zeros(shape[:-1], jnp.float32)
+            cache["v_scale"] = jnp.zeros(shape[:-1], jnp.float32)
+        return cache
+
+    def init_ring_cache(self, batch: int, ring_len: int,
+                        quantize: bool = False) -> Params:
         """RING KV cache for uniformly-windowed models (Mistral): physical
         size ``ring_len`` regardless of logical sequence length — position p
         lives in ring slot p % ring_len, and ``abs_pos`` (B, R) records which
@@ -609,11 +638,9 @@ class LlamaModel:
         if ring_len <= cfg.sliding_window:
             raise ValueError(f"ring_len {ring_len} must exceed the window "
                              f"{cfg.sliding_window} (write slack)")
-        shape = (cfg.n_layers, batch, ring_len, cfg.n_kv_heads, cfg.head_dim_)
-        return {"k": jnp.zeros(shape, cfg.dtype),
-                "v": jnp.zeros(shape, cfg.dtype),
-                "index": jnp.zeros((batch,), jnp.int32),
-                "abs_pos": jnp.full((batch, ring_len), -1, jnp.int32)}
+        cache = self._empty_cache(batch, ring_len, quantize)
+        cache["abs_pos"] = jnp.full((batch, ring_len), -1, jnp.int32)
+        return cache
 
     def prefill(self, params: Params, tokens: jax.Array, cache: Params,
                 true_length: Optional[jax.Array] = None
@@ -664,8 +691,14 @@ class LlamaModel:
             raise ValueError(f"prompt length {s} exceeds cache length "
                              f"{max_len}")
         pad = [(0, 0), (0, 0), (0, max_len - s), (0, 0), (0, 0)]
-        new_cache = {"k": jnp.pad(k_all, pad), "v": jnp.pad(v_all, pad),
-                     "index": true_length.astype(jnp.int32)}
+        new_cache = {"index": true_length.astype(jnp.int32)}
+        if "k_scale" in cache:  # int8 cache: quantize the collected K/V
+            k_all, k_sc = _kv_quant(k_all)             # (L,B,S,h,d) + (L,B,S,h)
+            v_all, v_sc = _kv_quant(v_all)
+            new_cache["k_scale"] = jnp.pad(k_sc, pad[:-1])
+            new_cache["v_scale"] = jnp.pad(v_sc, pad[:-1])
+        new_cache["k"] = jnp.pad(k_all, pad)
+        new_cache["v"] = jnp.pad(v_all, pad)
         if "abs_pos" in cache:  # ring: slots 0..true_len-1 hold those positions
             slot_ids = jnp.arange(max_len)[None, :]
             new_cache["abs_pos"] = jnp.where(
@@ -745,29 +778,42 @@ class LlamaModel:
                 causal_valid & ((positions[:, :, None] - pos_l) < win))
             masks.append(m[:, None, None])
 
-        def sub_block(y, lp, k_cache, v_cache, valid):
+        quant = "k_scale" in cache
+
+        def sub_block(y, lp, k_cache, v_cache, k_scale, v_scale, valid):
             h = rms_norm(y, _norm_w(lp["attn_norm"], cfg), cfg.norm_eps)
             q, k, v = _qkv(h, lp, cfg, b, kk)
             q = apply_rope(q, cos, sin, positions)
             k = apply_rope(k, cos, sin, positions)
+            act3 = active[:, None, None]
+            act4 = active[:, None, None, None]
+            if quant:  # int8 cache: quantize the new rows, scales alongside
+                k, k_s = _kv_quant(k)                          # i8, (B,K,h)
+                v, v_s = _kv_quant(v)
+                k_scale = k_scale.at[batch_ids, slots].set(
+                    jnp.where(act3, k_s, k_scale[batch_ids, slots]))
+                v_scale = v_scale.at[batch_ids, slots].set(
+                    jnp.where(act3, v_s, v_scale[batch_ids, slots]))
             old_k = k_cache[batch_ids, slots]                      # (B,K,h,d)
             old_v = v_cache[batch_ids, slots]
-            k_w = jnp.where(active[:, None, None, None], k, old_k)
-            v_w = jnp.where(active[:, None, None, None], v, old_v)
-            k_cache = k_cache.at[batch_ids, slots].set(k_w)
-            v_cache = v_cache.at[batch_ids, slots].set(v_w)
+            k_cache = k_cache.at[batch_ids, slots].set(
+                jnp.where(act4, k, old_k))
+            v_cache = v_cache.at[batch_ids, slots].set(
+                jnp.where(act4, v, old_v))
+            k_read = (_kv_dequant(k_cache, k_scale) if quant
+                      else k_cache.astype(jnp.float32))
+            v_read = (_kv_dequant(v_cache, v_scale) if quant
+                      else v_cache.astype(jnp.float32))
             group = cfg.n_heads // cfg.n_kv_heads
             qg = (q.astype(jnp.float32) * cfg.sm_scale
                   ).reshape(b, kk, cfg.n_kv_heads, group, cfg.head_dim_)
-            s = jnp.einsum("bqhgd,bLhd->bhgqL", qg,
-                           k_cache.astype(jnp.float32))
+            s = jnp.einsum("bqhgd,bLhd->bhgqL", qg, k_read)
             if cfg.attn_logit_softcap is not None:
                 cap = cfg.attn_logit_softcap
                 s = jnp.tanh(s / cap) * cap
             s = jnp.where(valid, s, -1e30)
             p = jax.nn.softmax(s, axis=-1)
-            o = jnp.einsum("bhgqL,bLhd->bqhgd", p,
-                           v_cache.astype(jnp.float32))
+            o = jnp.einsum("bhgqL,bLhd->bqhgd", p, v_read)
             o = o.reshape(b, kk, cfg.n_heads * cfg.head_dim_).astype(cfg.dtype)
             o = _mm(o, lp["wo"], cfg.dtype)
             if cfg.post_norms:
@@ -775,33 +821,47 @@ class LlamaModel:
                              cfg.norm_eps)
             y = y + o
             y, _ = _mlp_block(y, lp, cfg, self.mesh, train=False)
-            return y, k_cache, v_cache
+            return y, k_cache, v_cache, k_scale, v_scale
 
         def block(carry, inputs):
             y = carry
-            lp_g, k_g, v_g = inputs
+            lp_g, k_g, v_g = inputs["lp"], inputs["k"], inputs["v"]
+            ks_g, vs_g = inputs.get("ks"), inputs.get("vs")
             if pat == 1:
-                y, k_new, v_new = sub_block(y, lp_g, k_g, v_g, masks[0])
-                return y, (k_new, v_new)
-            k_outs, v_outs = [], []
+                y, k_n, v_n, ks_n, vs_n = sub_block(y, lp_g, k_g, v_g,
+                                                    ks_g, vs_g, masks[0])
+                out = {"k": k_n, "v": v_n}
+                if quant:
+                    out["ks"], out["vs"] = ks_n, vs_n
+                return y, out
+            outs: dict[str, list] = {"k": [], "v": [], "ks": [], "vs": []}
             for j in range(pat):
-                y, k_new, v_new = sub_block(y, _sublayer(lp_g, j, pat),
-                                            k_g[j], v_g[j], masks[j])
-                k_outs.append(k_new)
-                v_outs.append(v_new)
-            return y, (jnp.stack(k_outs), jnp.stack(v_outs))
+                y, k_n, v_n, ks_n, vs_n = sub_block(
+                    y, _sublayer(lp_g, j, pat), k_g[j], v_g[j],
+                    None if ks_g is None else ks_g[j],
+                    None if vs_g is None else vs_g[j], masks[j])
+                outs["k"].append(k_n)
+                outs["v"].append(v_n)
+                if quant:
+                    outs["ks"].append(ks_n)
+                    outs["vs"].append(vs_n)
+            return y, {kk_: jnp.stack(v_) for kk_, v_ in outs.items() if v_}
 
-        grouped_cache_k = _group_layers(cache["k"], pat)
-        grouped_cache_v = _group_layers(cache["v"], pat)
-        x, (k_new, v_new) = jax.lax.scan(
-            block, x, (_group_layers(params["layers"], pat),
-                       grouped_cache_k, grouped_cache_v))
-        if pat > 1:  # (L//p, p, B, L, h, d) -> (L, B, L, h, d)
-            k_new = k_new.reshape((cfg.n_layers,) + k_new.shape[2:])
-            v_new = v_new.reshape((cfg.n_layers,) + v_new.shape[2:])
+        xs = {"lp": _group_layers(params["layers"], pat),
+              "k": _group_layers(cache["k"], pat),
+              "v": _group_layers(cache["v"], pat)}
+        if quant:
+            xs["ks"] = _group_layers(cache["k_scale"], pat)
+            xs["vs"] = _group_layers(cache["v_scale"], pat)
+        x, new_kv = jax.lax.scan(block, x, xs)
+        if pat > 1:  # (L//p, p, B, L, ...) -> (L, B, L, ...)
+            new_kv = {kk_: a.reshape((cfg.n_layers,) + a.shape[2:])
+                      for kk_, a in new_kv.items()}
         x = rms_norm(x, _norm_w(params["final_norm"], cfg), cfg.norm_eps)
         logits = _head_logits(x, params, cfg).astype(jnp.float32)  # (B,K,V)
-        out = {"k": k_new, "v": v_new, "index": idx}
+        out = {"k": new_kv["k"], "v": new_kv["v"], "index": idx}
+        if quant:
+            out["k_scale"], out["v_scale"] = new_kv["ks"], new_kv["vs"]
         if ring:
             out["abs_pos"] = new_abs
         return logits, out
@@ -816,6 +876,9 @@ class LlamaModel:
             "v": cache["v"].at[:, slot].set(single["v"][:, 0]),
             "index": cache["index"].at[slot].set(single["index"][0]),
         }
+        for extra in ("k_scale", "v_scale"):
+            if extra in cache:
+                out[extra] = cache[extra].at[:, slot].set(single[extra][:, 0])
         if "abs_pos" in cache:
             out["abs_pos"] = cache["abs_pos"].at[slot].set(single["abs_pos"][0])
         return out

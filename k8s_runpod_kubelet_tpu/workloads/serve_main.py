@@ -188,7 +188,8 @@ def serve(engine, port: int = 8000, request_timeout_s: float = 120.0,
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="gemma-7b",
-                   choices=["gemma-7b", "llama3-8b", "mixtral-8x7b", "mistral-7b", "qwen2-7b",
+                   choices=["gemma-7b", "gemma2-9b", "llama3-8b",
+                            "mixtral-8x7b", "mistral-7b", "qwen2-7b",
                             "tiny", "tiny-moe"])
     p.add_argument("--slots", type=int, default=8)
     p.add_argument("--port", type=int, default=8000)
@@ -206,6 +207,14 @@ def main(argv=None) -> int:
     p.add_argument("--int8", action="store_true",
                    help="weight-only int8 quantization (halves decode HBM "
                         "traffic; JetStream-style serving optimization)")
+    p.add_argument("--kv-int8", action="store_true",
+                   help="int8 KV cache with per-position scales (halves "
+                        "cache HBM traffic and doubles slot capacity)")
+    p.add_argument("--ring-cache", default=None,
+                   choices=["auto", "on", "off"],
+                   help="ring KV cache for sliding-window models: physical "
+                        "cache shrinks to ~window while --cache-len stays "
+                        "the logical budget (default auto)")
     p.add_argument("--hf-checkpoint", default="",
                    help="HuggingFace model directory (safetensors/bin) to "
                         "load real weights from; empty = random init")
@@ -213,12 +222,15 @@ def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO)
 
     import jax
-    from ..models import gemma_7b, llama3_8b, mixtral_8x7b, mistral_7b, qwen2_7b, tiny_llama, tiny_moe, init_params
+    from ..models import (gemma_7b, gemma2_9b, llama3_8b, mixtral_8x7b,
+                          mistral_7b, qwen2_7b, tiny_llama, tiny_moe,
+                          init_params)
     from .serving import ServingConfig, ServingEngine
 
-    cfg = {"gemma-7b": gemma_7b, "llama3-8b": llama3_8b,
-           "mixtral-8x7b": mixtral_8x7b, "mistral-7b": mistral_7b, "qwen2-7b": qwen2_7b, "tiny": tiny_llama,
-           "tiny-moe": tiny_moe}[args.model]()
+    cfg = {"gemma-7b": gemma_7b, "gemma2-9b": gemma2_9b,
+           "llama3-8b": llama3_8b, "mixtral-8x7b": mixtral_8x7b,
+           "mistral-7b": mistral_7b, "qwen2-7b": qwen2_7b,
+           "tiny": tiny_llama, "tiny-moe": tiny_moe}[args.model]()
     log.info("loading %s (%.2fB params) on %s", cfg.name,
              cfg.param_count / 1e9, jax.default_backend())
     from .tokenizer import get_tokenizer
@@ -239,6 +251,9 @@ def main(argv=None) -> int:
         max_new_tokens=args.max_new_tokens,
         max_prefill_len=args.cache_len // 2,
         quantize_int8=args.int8,
+        quantize_kv_int8=args.kv_int8,
+        ring_cache={None: None, "auto": None, "on": True,
+                    "off": False}[args.ring_cache],
         speculate_k=args.speculate,
         # text mode stops at the tokenizer's EOS instead of always burning
         # the full max_new_tokens budget

@@ -1,0 +1,135 @@
+"""int8 KV cache: per-(position, kv-head) scales, decode-path accuracy.
+
+Decode reads the whole KV cache every step (HBM-bandwidth-bound), so int8
+halves the traffic and doubles slot capacity. These tests pin: logits stay
+close to the f32-cache path, greedy generations match on the tiny model,
+and the quantized cache composes with the ring cache and speculation.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_runpod_kubelet_tpu.models import LlamaModel, init_params, tiny_llama
+from k8s_runpod_kubelet_tpu.workloads.serving import ServingConfig, ServingEngine
+
+CFG = tiny_llama(vocab_size=128, embed_dim=64, n_layers=2, n_heads=4,
+                 n_kv_heads=2, mlp_dim=128, max_seq_len=256,
+                 dtype=jnp.float32, param_dtype=jnp.float32)
+WCFG = tiny_llama(name="tiny-window", vocab_size=128, embed_dim=64,
+                  n_layers=2, n_heads=4, n_kv_heads=2, mlp_dim=128,
+                  max_seq_len=256, sliding_window=8,
+                  dtype=jnp.float32, param_dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+class TestKvQuantModel:
+    def test_cache_dtypes_and_shapes(self, params):
+        model = LlamaModel(CFG)
+        cache = model.init_cache(2, 32, quantize=True)
+        assert cache["k"].dtype == jnp.int8
+        assert cache["k_scale"].shape == (2, 2, 32, 2)
+        assert cache["k_scale"].dtype == jnp.float32
+
+    def test_decode_close_to_f32_cache(self, params):
+        """Logits through the int8 cache track the f32-cache logits."""
+        model = LlamaModel(CFG)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (2, 24), 0, 128)
+        qc = model.init_cache(2, 32, quantize=True)
+        fc = model.init_cache(2, 32)
+        lq, qc = model.prefill(params, toks[:, :8], qc)
+        lf, fc = model.prefill(params, toks[:, :8], fc)
+        np.testing.assert_allclose(np.asarray(lq), np.asarray(lf),
+                                   rtol=0.05, atol=0.05)
+        for i in range(8, 24):
+            oq, qc = model.decode_step(params, toks[:, i], qc)
+            of, fc = model.decode_step(params, toks[:, i], fc)
+            np.testing.assert_allclose(np.asarray(oq), np.asarray(of),
+                                       rtol=0.08, atol=0.08,
+                                       err_msg=f"position {i}")
+
+    def test_greedy_generation_matches_f32_cache(self, params):
+        """On the pinned tiny model, int8-KV greedy decode picks the same
+        tokens as the f32 cache (the perturbation is far below the argmax
+        margins of a random-init model)."""
+        model = LlamaModel(CFG)
+        prompt = jnp.asarray([[5, 17, 99, 3, 42, 7]], jnp.int32)
+        outs = {}
+        for name, quant in (("f32", False), ("int8", True)):
+            cache = model.init_cache(1, 64, quantize=quant)
+            logits, cache = model.prefill(params, prompt, cache)
+            toks = [int(jnp.argmax(logits[0]))]
+            for _ in range(20):
+                logits, cache = model.decode_step(
+                    params, jnp.asarray([toks[-1]], jnp.int32), cache)
+                toks.append(int(jnp.argmax(logits[0])))
+            outs[name] = toks
+        assert outs["f32"] == outs["int8"]
+
+    def test_composes_with_ring(self):
+        wparams = init_params(WCFG, jax.random.PRNGKey(0))
+        model = LlamaModel(WCFG)
+        toks = jax.random.randint(jax.random.PRNGKey(2), (1, 30), 0, 128)
+        rq = model.init_ring_cache(1, 16, quantize=True)
+        assert rq["k"].dtype == jnp.int8 and "abs_pos" in rq
+        full = model.forward(wparams, toks)
+        _, rq = model.prefill(wparams, toks[:, :6], rq)
+        for i in range(6, 30):
+            logits, rq = model.decode_step(wparams, toks[:, i], rq)
+            np.testing.assert_allclose(np.asarray(logits),
+                                       np.asarray(full[:, i]),
+                                       rtol=0.08, atol=0.08,
+                                       err_msg=f"position {i}")
+
+    def test_inactive_slots_untouched(self, params):
+        model = LlamaModel(CFG)
+        cache = model.init_cache(2, 32, quantize=True)
+        _, cache = model.prefill(params, jnp.asarray([[1, 2, 3], [4, 5, 6]],
+                                                     jnp.int32), cache)
+        before_k = np.asarray(cache["k"][:, 1])
+        before_s = np.asarray(cache["k_scale"][:, 1])
+        active = jnp.asarray([True, False])
+        _, cache = model.decode_step(params, jnp.asarray([7, 8], jnp.int32),
+                                     cache, active)
+        np.testing.assert_array_equal(np.asarray(cache["k"][:, 1]), before_k)
+        np.testing.assert_array_equal(np.asarray(cache["k_scale"][:, 1]),
+                                      before_s)
+        assert int(cache["index"][1]) == 3  # frozen
+
+
+class TestKvQuantEngine:
+    def test_engine_greedy_matches_unquantized(self, params):
+        sc_q = ServingConfig(slots=2, max_prefill_len=16, cache_len=64,
+                             max_new_tokens=16, quantize_kv_int8=True)
+        sc_f = ServingConfig(slots=2, max_prefill_len=16, cache_len=64,
+                             max_new_tokens=16)
+        e_q = ServingEngine(CFG, params, sc_q).start()
+        e_f = ServingEngine(CFG, params, sc_f).start()
+        try:
+            assert e_q._cache["k"].dtype == jnp.int8
+            prompts = [[(11 * j + i) % 128 for j in range(2 + 3 * i)]
+                       for i in range(4)]
+            for p in prompts:
+                q = e_q.submit(p, max_new_tokens=16).result(timeout=60)
+                f = e_f.submit(p, max_new_tokens=16).result(timeout=60)
+                assert q["tokens"] == f["tokens"]
+        finally:
+            e_q.stop()
+            e_f.stop()
+
+    def test_speculative_on_quantized_cache(self, params):
+        sc = ServingConfig(slots=2, max_prefill_len=16, cache_len=64,
+                           max_new_tokens=16, quantize_kv_int8=True,
+                           speculate_k=3)
+        e = ServingEngine(CFG, params, sc).start()
+        try:
+            prompt = [3, 1, 4, 1, 5, 9, 2, 6, 3, 1, 4, 1, 5]
+            out = e.submit(prompt, max_new_tokens=16).result(timeout=60)
+            assert len(out["tokens"]) == 16
+        finally:
+            e.stop()
