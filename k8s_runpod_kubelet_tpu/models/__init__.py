@@ -12,8 +12,8 @@
 """
 
 from .llama import (LlamaConfig, LlamaModel, llama3_8b, llama3_70b, gemma_7b,
-                    gemma2_9b, mixtral_8x7b, mistral_7b, qwen2_7b, tiny_llama,
-                    tiny_moe, init_params, param_logical_axes)
+                    gemma2_9b, gemma3_12b, mixtral_8x7b, mistral_7b, qwen2_7b,
+                    tiny_llama, tiny_moe, init_params, param_logical_axes)
 from .mnist import MnistCNN, mnist_config
 from .moe import moe_mlp, moe_mlp_dense_reference, moe_capacity
 from .convert import load_hf, from_hf_state_dict, to_hf_state_dict
@@ -21,7 +21,7 @@ from .quant import quantize_params, is_quantized
 from .lora import LoraConfig, apply_lora, merge_lora, lora_mask, lora_param_count
 
 __all__ = ["LlamaConfig", "LlamaModel", "llama3_8b", "llama3_70b", "gemma_7b",
-           "gemma2_9b", "mixtral_8x7b", "mistral_7b", "qwen2_7b",
+           "gemma2_9b", "gemma3_12b", "mixtral_8x7b", "mistral_7b", "qwen2_7b",
            "tiny_llama", "tiny_moe", "init_params",
            "param_logical_axes", "MnistCNN", "mnist_config", "moe_mlp",
            "moe_mlp_dense_reference", "moe_capacity", "load_hf",

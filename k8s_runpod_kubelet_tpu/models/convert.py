@@ -99,6 +99,9 @@ def from_hf_state_dict(cfg: LlamaConfig, sd: Mapping[str, Any],
     else:
         layers["mlp_norm"] = _stack(
             sd, pre + "post_attention_layernorm.weight", L, dt)
+    if cfg.qk_norm:
+        layers["q_norm"] = _stack(sd, pre + "self_attn.q_norm.weight", L, dt)
+        layers["k_norm"] = _stack(sd, pre + "self_attn.k_norm.weight", L, dt)
     if cfg.qkv_bias:
         layers["wq_b"] = _stack(sd, pre + "self_attn.q_proj.bias", L, dt)
         layers["wk_b"] = _stack(sd, pre + "self_attn.k_proj.bias", L, dt)
@@ -172,6 +175,11 @@ def to_hf_state_dict(cfg: LlamaConfig, params: Params) -> dict[str, np.ndarray]:
                              ("wv", "self_attn.v_proj.weight"),
                              ("wo", "self_attn.o_proj.weight")):
             put(i, theirs, np.asarray(lp[ours][i], np.float32).T)
+        if cfg.qk_norm:
+            put(i, "self_attn.q_norm.weight",
+                np.asarray(lp["q_norm"][i], np.float32))
+            put(i, "self_attn.k_norm.weight",
+                np.asarray(lp["k_norm"][i], np.float32))
         if cfg.qkv_bias:
             for ours, theirs in (("wq_b", "self_attn.q_proj.bias"),
                                  ("wk_b", "self_attn.k_proj.bias"),

@@ -67,6 +67,12 @@ class LlamaConfig:
     # Gemma-2 "sandwich" norms: extra RMSNorm on each sublayer OUTPUT
     # (post-attention and post-MLP), before the residual add
     post_norms: bool = False
+    # Gemma-3: RMSNorm over head_dim on q and k (per layer, shared across
+    # heads), applied BEFORE RoPE
+    qk_norm: bool = False
+    # Gemma-3: local (windowed) sublayers rotate with this RoPE base while
+    # global sublayers use rope_theta (+ rope_scaling); None = one base
+    rope_local_theta: Optional[float] = None
     tie_embeddings: bool = False
     mlp_activation: str = "silu"        # "silu" (SwiGLU) | "gelu_tanh" (GeGLU, Gemma)
     embed_scale: bool = False           # scale embeddings by sqrt(embed_dim) (Gemma)
@@ -117,6 +123,8 @@ class LlamaConfig:
         attn = e * hd * (self.n_heads * 2 + self.n_kv_heads * 2)
         if self.qkv_bias:
             attn += hd * (self.n_heads + 2 * self.n_kv_heads)
+        if self.qk_norm:
+            attn += 2 * hd
         if self.n_experts:
             mlp = 3 * e * m * self.n_experts + e * self.n_experts  # experts + router
         else:
@@ -162,6 +170,23 @@ def gemma2_9b() -> LlamaConfig:
                        sliding_window=4096, sliding_window_pattern=2,
                        attn_logit_softcap=50.0, logit_softcap=30.0,
                        query_pre_attn_scalar=256.0, post_norms=True)
+
+
+def gemma3_12b() -> LlamaConfig:
+    # Gemma-3-12B (text): 5 local(1024) : 1 global interleave, per-kind RoPE
+    # bases (local 10k, global 1M with linear x8 scaling), RMSNorm on q/k,
+    # sandwich norms; no tanh soft caps (qk-norm replaced them).
+    return LlamaConfig(name="gemma3-12b", vocab_size=262208, embed_dim=3840,
+                       n_layers=48, n_heads=16, n_kv_heads=8, head_dim=256,
+                       mlp_dim=15360, max_seq_len=32768,
+                       rope_theta=1_000_000.0, rope_local_theta=10_000.0,
+                       rope_scaling={"rope_type": "linear", "factor": 8.0},
+                       norm_eps=1e-6, tie_embeddings=True,
+                       mlp_activation="gelu_tanh", embed_scale=True,
+                       norm_zero_centered=True,
+                       sliding_window=1024, sliding_window_pattern=6,
+                       query_pre_attn_scalar=256.0, post_norms=True,
+                       qk_norm=True)
 
 
 def mixtral_8x7b() -> LlamaConfig:
@@ -215,6 +240,9 @@ def param_logical_axes(cfg: LlamaConfig) -> Params:
     if cfg.post_norms:
         layer.update({"attn_post_norm": ("layer", "norm"),
                       "mlp_post_norm": ("layer", "norm")})
+    if cfg.qk_norm:
+        layer.update({"q_norm": ("layer", "norm"),
+                      "k_norm": ("layer", "norm")})
     if cfg.qkv_bias:
         layer.update({"wq_b": ("layer", "heads"),
                       "wk_b": ("layer", "kv_heads"),
@@ -261,6 +289,11 @@ def init_params(cfg: LlamaConfig, key: jax.Array,
             "attn_post_norm": (cfg.n_layers, e),
             "mlp_post_norm": (cfg.n_layers, e),
         })
+    if cfg.qk_norm:
+        shapes["layers"].update({
+            "q_norm": (cfg.n_layers, hd),
+            "k_norm": (cfg.n_layers, hd),
+        })
     if cfg.qkv_bias:
         shapes["layers"].update({
             "wq_b": (cfg.n_layers, cfg.n_heads * hd),
@@ -301,6 +334,10 @@ def init_params(cfg: LlamaConfig, key: jax.Array,
     if cfg.qkv_bias:
         for name in ("wq_b", "wk_b", "wv_b"):
             params["layers"][name] = jnp.zeros_like(params["layers"][name])
+    if cfg.qk_norm:  # identity norm init ((L, hd) misses make()'s (L, e) rule)
+        fill = 0.0 if cfg.norm_zero_centered else 1.0
+        for name in ("q_norm", "k_norm"):
+            params["layers"][name] = jnp.full_like(params["layers"][name], fill)
     if mesh is not None:
         axes = param_logical_axes(cfg)
         params = jax.tree_util.tree_map(
@@ -313,6 +350,23 @@ def init_params(cfg: LlamaConfig, key: jax.Array,
 
 def _constrain(x, mesh: Optional[Mesh], axes):
     return shard_logical(x, mesh, axes) if mesh is not None else x
+
+
+def _rope_tables(cfg: LlamaConfig):
+    """(global, local) RoPE tables. Local sublayers (windowed) rotate with
+    rope_local_theta and NO position scaling (Gemma-3); without a local
+    theta both kinds share the global table."""
+    g = rope_frequencies(cfg.head_dim_, cfg.max_seq_len, cfg.rope_theta,
+                         cfg.rope_scaling)
+    if cfg.rope_local_theta is None:
+        return g, g
+    loc = rope_frequencies(cfg.head_dim_, cfg.max_seq_len,
+                           cfg.rope_local_theta, None)
+    return g, loc
+
+
+def _rope_for(tables, window: Optional[int]):
+    return tables[1] if window is not None else tables[0]
 
 
 def _group_layers(tree, p: int):
@@ -431,6 +485,9 @@ def _attention_block(x, lp, cfg: LlamaConfig, cos, sin, mesh, positions=None,
     hd = cfg.head_dim_
     h = rms_norm(x, _norm_w(lp["attn_norm"], cfg), cfg.norm_eps)
     q, k, v = _qkv(h, lp, cfg, b, s)
+    if cfg.qk_norm:  # Gemma-3: per-head RMSNorm on q/k, before RoPE
+        q = rms_norm(q, _norm_w(lp["q_norm"], cfg), cfg.norm_eps)
+        k = rms_norm(k, _norm_w(lp["k_norm"], cfg), cfg.norm_eps)
     q = apply_rope(q, cos, sin, positions)
     k = apply_rope(k, cos, sin, positions)
     q = _constrain(q, mesh, ("batch", "seq", "act_heads", "head_dim"))
@@ -517,8 +574,7 @@ class LlamaModel:
         ``with_aux=True`` additionally returns the summed (pre-scaled) router
         aux loss — nonzero only for MoE configs; add it to the train loss."""
         cfg, mesh = self.cfg, self.mesh
-        cos, sin = rope_frequencies(cfg.head_dim_, cfg.max_seq_len,
-                                    cfg.rope_theta, cfg.rope_scaling)
+        ropes = _rope_tables(cfg)
         x = _embed(params, tokens, cfg, mesh)
         x = _constrain(x, mesh, ("batch", "seq", "act_embed"))
 
@@ -544,7 +600,8 @@ class LlamaModel:
                     "for the remaining devices instead")
 
             def stage_block(carry, lp):
-                y = _attention_block(carry, lp, cfg, cos, sin, None,
+                cs, sn = _rope_for(ropes, cfg.sliding_window)
+                y = _attention_block(carry, lp, cfg, cs, sn, None,
                                      window=cfg.sliding_window)
                 y, aux = _mlp_block(y, lp, cfg, None)
                 return y, aux
@@ -570,7 +627,8 @@ class LlamaModel:
                 aux = jnp.float32(0.0)
                 for j, win in enumerate(windows):
                     lp = _sublayer(lp_group, j, pat)
-                    y = _attention_block(y, lp, cfg, cos, sin, mesh,
+                    cs, sn = _rope_for(ropes, win)
+                    y = _attention_block(y, lp, cfg, cs, sn, mesh,
                                          positions, window=win)
                     y, a = _mlp_block(y, lp, cfg, mesh)
                     y = _constrain(y, mesh, ("batch", "seq", "act_embed"))
@@ -656,8 +714,7 @@ class LlamaModel:
         b, s = tokens.shape
         if true_length is None:
             true_length = jnp.full((b,), s, jnp.int32)
-        cos, sin = rope_frequencies(cfg.head_dim_, cfg.max_seq_len,
-                                    cfg.rope_theta, cfg.rope_scaling)
+        ropes = _rope_tables(cfg)
         x = _embed(params, tokens, cfg, self.mesh)
 
         # one scan over layer groups that also collects the K/V it computes
@@ -669,7 +726,8 @@ class LlamaModel:
             ks, vs = [], []
             for j, win in enumerate(windows):
                 lp = _sublayer(lp_group, j, pat)
-                y, k, v = _attention_block(y, lp, cfg, cos, sin, None,
+                cs, sn = _rope_for(ropes, win)
+                y, k, v = _attention_block(y, lp, cfg, cs, sn, None,
                                            window=win, return_kv=True)
                 y, _ = _mlp_block(y, lp, cfg, self.mesh, train=False)
                 ks.append(k)
@@ -744,8 +802,7 @@ class LlamaModel:
         idx = cache["index"]  # (B,)
         if active is None:
             active = jnp.ones((b,), bool)
-        cos, sin = rope_frequencies(cfg.head_dim_, cfg.max_seq_len,
-                                    cfg.rope_theta, cfg.rope_scaling)
+        ropes = _rope_tables(cfg)
         x = _embed(params, tokens, cfg, self.mesh)                 # (B,K,E)
         positions = idx[:, None] + jnp.arange(kk)[None, :]         # (B,K)
         max_len = cache["k"].shape[2]
@@ -780,9 +837,13 @@ class LlamaModel:
 
         quant = "k_scale" in cache
 
-        def sub_block(y, lp, k_cache, v_cache, k_scale, v_scale, valid):
+        def sub_block(y, lp, k_cache, v_cache, k_scale, v_scale, valid, rope):
+            cos, sin = rope
             h = rms_norm(y, _norm_w(lp["attn_norm"], cfg), cfg.norm_eps)
             q, k, v = _qkv(h, lp, cfg, b, kk)
+            if cfg.qk_norm:
+                q = rms_norm(q, _norm_w(lp["q_norm"], cfg), cfg.norm_eps)
+                k = rms_norm(k, _norm_w(lp["k_norm"], cfg), cfg.norm_eps)
             q = apply_rope(q, cos, sin, positions)
             k = apply_rope(k, cos, sin, positions)
             act3 = active[:, None, None]
@@ -828,8 +889,9 @@ class LlamaModel:
             lp_g, k_g, v_g = inputs["lp"], inputs["k"], inputs["v"]
             ks_g, vs_g = inputs.get("ks"), inputs.get("vs")
             if pat == 1:
-                y, k_n, v_n, ks_n, vs_n = sub_block(y, lp_g, k_g, v_g,
-                                                    ks_g, vs_g, masks[0])
+                y, k_n, v_n, ks_n, vs_n = sub_block(
+                    y, lp_g, k_g, v_g, ks_g, vs_g, masks[0],
+                    _rope_for(ropes, windows[0]))
                 out = {"k": k_n, "v": v_n}
                 if quant:
                     out["ks"], out["vs"] = ks_n, vs_n
@@ -839,7 +901,8 @@ class LlamaModel:
                 y, k_n, v_n, ks_n, vs_n = sub_block(
                     y, _sublayer(lp_g, j, pat), k_g[j], v_g[j],
                     None if ks_g is None else ks_g[j],
-                    None if vs_g is None else vs_g[j], masks[j])
+                    None if vs_g is None else vs_g[j], masks[j],
+                    _rope_for(ropes, windows[j]))
                 outs["k"].append(k_n)
                 outs["v"].append(v_n)
                 if quant:

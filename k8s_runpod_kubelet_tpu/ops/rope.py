@@ -17,11 +17,22 @@ def rope_frequencies(head_dim: int, max_seq_len: int, theta: float = 500_000.0,
                      scaling: Optional[dict] = None) -> tuple[jax.Array, jax.Array]:
     """Precompute (cos, sin) tables of shape (max_seq_len, head_dim//2).
 
-    ``scaling`` (Llama-3.1 long-context recipe): dict with factor,
-    low_freq_factor, high_freq_factor, original_max_position.
+    ``scaling``: either the Llama-3.1 NTK recipe (dict with factor,
+    low_freq_factor, high_freq_factor, original_max_position) or plain
+    linear position interpolation ({"rope_type": "linear", "factor": f} —
+    Gemma-3 global layers): all frequencies divided by f.
     """
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
-    if scaling:
+    rope_type = (scaling or {}).get("rope_type",
+                                    (scaling or {}).get("type", "llama3"))
+    if scaling and rope_type == "linear":
+        inv_freq = inv_freq / scaling.get("factor", 1.0)
+    elif scaling and rope_type not in ("llama3", "default"):
+        # refuse to silently misread a yarn/dynamic/... dict as the Llama-3.1
+        # recipe — wrong tables degrade logits without erroring anywhere
+        raise ValueError(f"unsupported rope_scaling type {rope_type!r} "
+                         "(supported: linear, llama3)")
+    elif scaling:
         factor = scaling.get("factor", 8.0)
         low = scaling.get("low_freq_factor", 1.0)
         high = scaling.get("high_freq_factor", 4.0)

@@ -188,9 +188,9 @@ def serve(engine, port: int = 8000, request_timeout_s: float = 120.0,
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="gemma-7b",
-                   choices=["gemma-7b", "gemma2-9b", "llama3-8b",
-                            "mixtral-8x7b", "mistral-7b", "qwen2-7b",
-                            "tiny", "tiny-moe"])
+                   choices=["gemma-7b", "gemma2-9b", "gemma3-12b",
+                            "llama3-8b", "mixtral-8x7b", "mistral-7b",
+                            "qwen2-7b", "tiny", "tiny-moe"])
     p.add_argument("--slots", type=int, default=8)
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--cache-len", type=int, default=2048)
@@ -222,15 +222,16 @@ def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO)
 
     import jax
-    from ..models import (gemma_7b, gemma2_9b, llama3_8b, mixtral_8x7b,
-                          mistral_7b, qwen2_7b, tiny_llama, tiny_moe,
-                          init_params)
+    from ..models import (gemma_7b, gemma2_9b, gemma3_12b, llama3_8b,
+                          mixtral_8x7b, mistral_7b, qwen2_7b, tiny_llama,
+                          tiny_moe, init_params)
     from .serving import ServingConfig, ServingEngine
 
     cfg = {"gemma-7b": gemma_7b, "gemma2-9b": gemma2_9b,
-           "llama3-8b": llama3_8b, "mixtral-8x7b": mixtral_8x7b,
-           "mistral-7b": mistral_7b, "qwen2-7b": qwen2_7b,
-           "tiny": tiny_llama, "tiny-moe": tiny_moe}[args.model]()
+           "gemma3-12b": gemma3_12b, "llama3-8b": llama3_8b,
+           "mixtral-8x7b": mixtral_8x7b, "mistral-7b": mistral_7b,
+           "qwen2-7b": qwen2_7b, "tiny": tiny_llama,
+           "tiny-moe": tiny_moe}[args.model]()
     log.info("loading %s (%.2fB params) on %s", cfg.name,
              cfg.param_count / 1e9, jax.default_backend())
     from .tokenizer import get_tokenizer

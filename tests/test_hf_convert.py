@@ -117,6 +117,35 @@ class TestLogitsParity:
                               sliding_window_pattern=2, post_norms=True))
         _compare(cfg, hf, atol=1e-3)
 
+    def test_gemma3_qk_norm_dual_rope(self):
+        """Gemma-3 pins qk-norm (RMSNorm on q/k before RoPE), per-kind RoPE
+        bases (local vs global), linear rope scaling on global layers, and
+        the 5:1 local/global interleave."""
+        torch.manual_seed(5)
+        hf = transformers.Gemma3ForCausalLM(transformers.Gemma3TextConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=112,
+            num_hidden_layers=6, num_attention_heads=4, num_key_value_heads=2,
+            head_dim=16, max_position_embeddings=64,
+            rope_theta=100_000.0, rope_local_base_freq=10_000.0,
+            rope_scaling={"rope_type": "linear", "factor": 2.0},
+            rms_norm_eps=1e-6, hidden_activation="gelu_pytorch_tanh",
+            query_pre_attn_scalar=32.0, sliding_window=8,
+            layer_types=["sliding_attention"] * 5 + ["full_attention"],
+            attn_implementation="eager"))
+        cfg = _f32(tiny_llama(vocab_size=128, embed_dim=64, n_layers=6,
+                              n_heads=4, n_kv_heads=2, head_dim=16,
+                              mlp_dim=112, max_seq_len=64,
+                              rope_theta=100_000.0, rope_local_theta=10_000.0,
+                              rope_scaling={"rope_type": "linear",
+                                            "factor": 2.0},
+                              norm_eps=1e-6, tie_embeddings=True,
+                              mlp_activation="gelu_tanh",
+                              embed_scale=True, norm_zero_centered=True,
+                              query_pre_attn_scalar=32.0, sliding_window=8,
+                              sliding_window_pattern=6, post_norms=True,
+                              qk_norm=True))
+        _compare(cfg, hf, atol=1e-3)
+
     def test_mixtral_sparse_moe(self):
         torch.manual_seed(3)
         hf = transformers.MixtralForCausalLM(transformers.MixtralConfig(
