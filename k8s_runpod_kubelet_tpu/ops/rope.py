@@ -27,11 +27,13 @@ def rope_frequencies(head_dim: int, max_seq_len: int, theta: float = 500_000.0,
                                     (scaling or {}).get("type", "llama3"))
     if scaling and rope_type == "linear":
         inv_freq = inv_freq / scaling.get("factor", 1.0)
-    elif scaling and rope_type not in ("llama3", "default"):
+    elif scaling and rope_type == "default":
+        pass  # HF "default" = plain unscaled RoPE
+    elif scaling and rope_type != "llama3":
         # refuse to silently misread a yarn/dynamic/... dict as the Llama-3.1
         # recipe — wrong tables degrade logits without erroring anywhere
         raise ValueError(f"unsupported rope_scaling type {rope_type!r} "
-                         "(supported: linear, llama3)")
+                         "(supported: linear, llama3, default)")
     elif scaling:
         factor = scaling.get("factor", 8.0)
         low = scaling.get("low_freq_factor", 1.0)

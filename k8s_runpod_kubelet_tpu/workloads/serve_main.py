@@ -67,7 +67,7 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(404, {"error": f"no route {self.path}"})
 
     def do_POST(self):
-        if self.path != "/generate":
+        if self.path not in ("/generate", "/prefix"):
             return self._send(404, {"error": f"no route {self.path}"})
         try:
             length = int(self.headers.get("Content-Length") or 0)
@@ -89,6 +89,15 @@ class _Handler(BaseHTTPRequestHandler):
                 raise ValueError("tokens must be a list of ints")
         except (json.JSONDecodeError, KeyError, ValueError, TypeError) as e:
             return self._send(400, {"error": f"bad request: {e}"})
+        if self.path == "/prefix":
+            # register a shared prompt prefix (system prompt): its KV is
+            # prefilled once and every later prompt starting with it skips
+            # straight to the stored cache
+            try:
+                self.engine.register_prefix(tokens)
+            except ValueError as e:
+                return self._send(400, {"error": str(e)})
+            return self._send(200, {"registered": len(tokens)})
         if req.get("stream"):
             return self._generate_stream(tokens, req)
         fut = self.engine.submit(tokens, req.get("max_new_tokens"),

@@ -76,6 +76,9 @@ class ServingConfig:
     # quantize_int8 (weights). Accuracy: ~1e-2-level logit perturbation —
     # greedy outputs typically identical, pinned by tests on the tiny model.
     quantize_kv_int8: bool = False
+    # registered-prefix cap: each register_prefix() pins one single-slot KV
+    # cache in HBM until restart
+    max_prefixes: int = 8
 
 
 @dataclasses.dataclass
@@ -155,6 +158,10 @@ class ServingEngine:
         # the HPA scrapes from pod start — the signal must exist before traffic
         self.metrics.set_gauge("tpu_serving_queue_depth", 0)
         self.metrics.set_gauge("tpu_serving_active_slots", 0)
+        # registered prompt prefixes: (tokens, last_logits, single cache),
+        # longest first; read by the prefill thread, written by callers
+        self._prefixes: list[tuple[list[int], Any, Params]] = []
+        self._prefix_lock = threading.Lock()
         self._queue: "queue.Queue[Request]" = queue.Queue()
         # prefill thread -> engine thread: (request, single cache, first token)
         self._ready: "queue.Queue[tuple[Request, Params, int]]" = \
@@ -348,6 +355,83 @@ class ServingEngine:
             b *= 2
         return min(b, self.sc.max_prefill_len)
 
+    def _append_chunks(self, single: Params, toks: list[int], last_logits):
+        """Append ``toks`` to a single-request cache in max_prefill_len
+        chunks through the verify kernel (each chunk's padding KV lands
+        beyond the committed index, so it is never attended and is later
+        overwritten — the decode-path invariant). Returns (logits, cache)."""
+        for start in range(0, len(toks), self.sc.max_prefill_len):
+            chunk = toks[start:start + self.sc.max_prefill_len]
+            ctoks, _ = self._padded(chunk)
+            logits_k, single = self._verify_fn(self.params, ctoks, single)
+            single = dict(single)
+            single["index"] = single["index"] + len(chunk)
+            last_logits = logits_k[:, len(chunk) - 1]
+        return last_logits, single
+
+    def _prefill_tokens(self, tokens: list[int]) -> tuple[Any, Params]:
+        """Full prompt -> (last_logits, single-request cache). The head goes
+        through the prefill jit (bucketed to a few fixed lengths so it
+        compiles once per bucket, not per prompt length); a prompt longer
+        than max_prefill_len continues CHUNKED through the verify kernel.
+        A registered prefix of the prompt skips straight to its stored
+        cache and appends only the suffix."""
+        start = 0
+        last_logits = None
+        single = None
+        with self._prefix_lock:
+            hit = next((p for p in self._prefixes
+                        if len(p[0]) <= len(tokens)
+                        and tokens[:len(p[0])] == p[0]), None)
+        if hit is not None:
+            ptoks, last_logits, single = hit
+            start = len(ptoks)
+            self.metrics.incr("tpu_serving_prefix_hits")
+        else:
+            if self._ring_len is not None:
+                single = self.model.init_ring_cache(
+                    1, self._ring_len, quantize=self.sc.quantize_kv_int8)
+            else:
+                single = self.model.init_cache(
+                    1, self.sc.cache_len, quantize=self.sc.quantize_kv_int8)
+            head = tokens[:self.sc.max_prefill_len]
+            prompt, true_len = self._padded(head)
+            last_logits, single = self._prefill(self.params, prompt,
+                                                single, true_len)
+            start = len(head)
+        return self._append_chunks(single, tokens[start:], last_logits)
+
+    def register_prefix(self, tokens: list[int]) -> None:
+        """Cache the KV of a shared prompt prefix (system prompt) ONCE; any
+        later prompt that starts with it skips its prefill entirely (the
+        stored immutable cache is the starting point — verify-kernel writes
+        produce fresh buffers, never mutating it). Longest match wins.
+
+        Each entry pins one single-slot KV cache in HBM, so registrations
+        are DEDUPED (re-registering the same tokens is a no-op) and capped
+        at ``max_prefixes`` — a restart/retry loop against /prefix must not
+        leak a cache per POST until the pod OOMs."""
+        if not tokens:
+            raise ValueError("empty prefix")
+        if len(tokens) > self.sc.cache_len - 1:
+            raise ValueError(f"prefix length {len(tokens)} > cache budget "
+                             f"{self.sc.cache_len - 1}")
+        tokens = list(tokens)
+        with self._prefix_lock:
+            if any(p[0] == tokens for p in self._prefixes):
+                return  # idempotent
+            if len(self._prefixes) >= self.sc.max_prefixes:
+                raise ValueError(
+                    f"prefix registry full ({self.sc.max_prefixes}); each "
+                    "entry pins a KV cache in HBM — raise max_prefixes or "
+                    "restart to clear")
+        logits, single = self._prefill_tokens(tokens)
+        with self._prefix_lock:
+            if any(p[0] == tokens for p in self._prefixes):
+                return  # raced with an identical registration
+            self._prefixes.append((tokens, logits, single))
+            self._prefixes.sort(key=lambda p: -len(p[0]))  # longest first
+
     def _prefill_loop(self):
         """Dedicated prefill worker: drains the request queue, runs the
         prefill jit, and hands (request, cache, first token) to the engine.
@@ -360,32 +444,7 @@ class ServingEngine:
                 continue
             self.metrics.set_gauge("tpu_serving_queue_depth", self._queue.qsize())
             try:
-                if self._ring_len is not None:
-                    single = self.model.init_ring_cache(
-                        1, self._ring_len, quantize=self.sc.quantize_kv_int8)
-                else:
-                    single = self.model.init_cache(
-                        1, self.sc.cache_len, quantize=self.sc.quantize_kv_int8)
-                # bucket the prompt to a few fixed lengths so the prefill jit
-                # compiles once per bucket, not once per prompt length; a
-                # prompt longer than max_prefill_len runs CHUNKED — the
-                # first chunk through prefill, the rest appended through the
-                # verify kernel (each chunk's padding KV lands beyond the
-                # committed index, so it is never attended and is later
-                # overwritten — the decode-path invariant)
-                head = req.prompt[:self.sc.max_prefill_len]
-                prompt, true_len = self._padded(head)
-                last_logits, single = self._prefill(self.params, prompt,
-                                                    single, true_len)
-                for start in range(self.sc.max_prefill_len, len(req.prompt),
-                                   self.sc.max_prefill_len):
-                    chunk = req.prompt[start:start + self.sc.max_prefill_len]
-                    ctoks, _ = self._padded(chunk)
-                    logits_k, single = self._verify_fn(self.params, ctoks,
-                                                       single)
-                    single = dict(single)
-                    single["index"] = single["index"] + len(chunk)
-                    last_logits = logits_k[:, len(chunk) - 1]
+                last_logits, single = self._prefill_tokens(req.prompt)
                 self._prefill_key, sub = jax.random.split(self._prefill_key)
                 first = int(_sample(last_logits, sub, [req.temperature],
                                     [req.top_k], [req.top_p])[0])
