@@ -2,13 +2,19 @@
 
 The pod command for autoscaled inference. Endpoints:
   POST /generate   {"tokens": [...], "max_new_tokens": N, "temperature": T,
-                    "top_k": K, "top_p": P}
+                    "top_k": K, "top_p": P, "stop": [[...], ...]}
                    or {"text": "..."} when --tokenizer is set (the response
-                   then also carries decoded "text")
+                   then also carries decoded "text"; "stop" may then be
+                   strings)
                    -> {"tokens": [...], "rid": ..., "latency_s": ...}
                    with "stream": true -> chunked NDJSON: one {"token": N}
                    line per decoded token, then the final result object
                    (JetStream-style streamed decode)
+  POST /v1/completions  OpenAI-compatible completions (prompt/max_tokens/
+                   temperature/top_p/stop/stream-SSE), so OpenAI-SDK
+                   clients point here unchanged
+  POST /prefix     register a shared prompt prefix (system prompt): its KV
+                   prefills once; prompts starting with it skip it
   GET  /metrics    Prometheus text incl. tpu_serving_queue_depth — the HPA
                    signal (scale on queue depth, BASELINE.json config 5)
   GET  /healthz    liveness
@@ -66,7 +72,30 @@ class _Handler(BaseHTTPRequestHandler):
                               "text/plain; version=0.0.4")
         self._send(404, {"error": f"no route {self.path}"})
 
+    def _parse_stop(self, raw) -> list:
+        """OpenAI-style ``stop``: a string, list of strings (needs the
+        tokenizer), or list of token lists. Returns token sequences."""
+        if raw is None:
+            return []
+        if isinstance(raw, str):
+            raw = [raw]
+        out = []
+        for s in raw:
+            if isinstance(s, str):
+                if self.tokenizer is None:
+                    raise ValueError("string stop sequences need --tokenizer")
+                toks = self.tokenizer.encode(s)
+                if toks:
+                    out.append(toks)
+            elif isinstance(s, list):
+                out.append(s)
+            else:
+                raise ValueError("stop must be string(s) or token lists")
+        return out
+
     def do_POST(self):
+        if self.path == "/v1/completions":
+            return self._openai_completion()
         if self.path not in ("/generate", "/prefix"):
             return self._send(404, {"error": f"no route {self.path}"})
         try:
@@ -100,10 +129,15 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send(200, {"registered": len(tokens)})
         if req.get("stream"):
             return self._generate_stream(tokens, req)
+        try:
+            stop = self._parse_stop(req.get("stop"))
+        except ValueError as e:
+            return self._send(400, {"error": str(e)})
         fut = self.engine.submit(tokens, req.get("max_new_tokens"),
                                  req.get("temperature"),
                                  top_k=_or(req.get("top_k"), 0),
-                                 top_p=_or(req.get("top_p"), 1.0))
+                                 top_p=_or(req.get("top_p"), 1.0),
+                                 stop=stop)
         try:
             out = fut.result(timeout=self.request_timeout_s)
         except FutureTimeout:
@@ -115,11 +149,20 @@ class _Handler(BaseHTTPRequestHandler):
             out["text"] = self.tokenizer.decode(out["tokens"])
         self._send(200, out)
 
-    def _generate_stream(self, tokens: list, req: dict):
-        """Chunked NDJSON: engine thread pushes tokens into a queue, this
-        handler thread drains it to the socket. A broken pipe propagates back
-        into the engine's next on_token call, which cancels the request."""
+    def _stream_pump(self, tokens: list, kw: dict, ctype: str, fmt: dict):
+        """Shared streamed-generation pump (NDJSON /generate and SSE
+        /v1/completions ride the same concurrency/deadline machinery):
+        engine thread pushes tokens into a queue, this handler thread
+        drains it to the socket. A broken pipe propagates back into the
+        engine's next on_token call, which cancels the request. The
+        request_timeout_s deadline bounds the WHOLE request, like the
+        non-stream path's fut.result(timeout=...) — not a per-token gap,
+        which would let a slow-but-steady stream run unboundedly (ADVICE r1).
+
+        ``fmt`` callbacks each return a list of body bytes to emit:
+        token(t), timeout(), error(msg), end(result_dict)."""
         import queue as _q
+        import time as _time
         q: "_q.Queue" = _q.Queue()
         dead = threading.Event()
 
@@ -128,28 +171,19 @@ class _Handler(BaseHTTPRequestHandler):
                 raise ConnectionError("stream client disconnected")
             q.put(("tok", t))
 
-        fut = self.engine.submit(tokens, req.get("max_new_tokens"),
-                                 req.get("temperature"),
-                                 top_k=_or(req.get("top_k"), 0),
-                                 top_p=_or(req.get("top_p"), 1.0),
-                                 on_token=on_token)
+        fut = self.engine.submit(tokens, on_token=on_token, **kw)
         if fut.done() and fut.exception() is not None:
-            return self._send(400, {"error": str(fut.exception())})
+            return self._send(400, fmt["badreq"](str(fut.exception())))
         fut.add_done_callback(lambda f: q.put(("end", f)))
         self.send_response(200)
-        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Content-Type", ctype)
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
 
-        def chunk(payload: dict):
-            body = (json.dumps(payload) + "\n").encode()
+        def chunk(body: bytes):
             self.wfile.write(f"{len(body):x}\r\n".encode() + body + b"\r\n")
             self.wfile.flush()
 
-        # request_timeout_s is a WHOLE-REQUEST deadline, like the non-stream
-        # path's fut.result(timeout=...) — not a per-token gap, which would
-        # let a slow-but-steady stream run unboundedly (ADVICE r1)
-        import time as _time
         deadline = _time.monotonic() + self.request_timeout_s
         try:
             while True:
@@ -159,28 +193,172 @@ class _Handler(BaseHTTPRequestHandler):
                         raise _q.Empty
                     kind, val = q.get(timeout=remaining)
                 except _q.Empty:
-                    # deadline passed: tell the client and stop the engine-side
-                    # request (same semantics as the non-stream 504)
+                    # deadline passed: tell the client and stop the
+                    # engine-side request (the non-stream paths' 504)
                     dead.set()
-                    chunk({"error": "generation timed out"})
+                    for body in fmt["timeout"]():
+                        chunk(body)
                     break
                 if kind == "tok":
-                    chunk({"token": val})
+                    for body in fmt["token"](val):
+                        chunk(body)
                 else:
                     exc = val.exception()
-                    if exc:
-                        chunk({"error": str(exc)})
-                    else:
-                        out = val.result()
-                        if self.tokenizer is not None:
-                            out = dict(out)
-                            out["text"] = self.tokenizer.decode(out["tokens"])
-                        chunk(out)
+                    bodies = (fmt["error"](str(exc)) if exc
+                              else fmt["end"](val.result()))
+                    for body in bodies:
+                        chunk(body)
                     break
             self.wfile.write(b"0\r\n\r\n")
             self.wfile.flush()
         except (BrokenPipeError, ConnectionError, OSError):
             dead.set()  # engine cancels at its next on_token call
+
+    def _openai_completion(self):
+        """OpenAI-compatible POST /v1/completions: lets existing OpenAI-SDK
+        clients point at this server unchanged. Supports prompt (string
+        needs --tokenizer; token list always works), max_tokens,
+        temperature, top_p, stop, and SSE streaming. The matched stop
+        sequence (or EOS) never appears in the returned text, stream or
+        not (OpenAI semantics) — streaming holds back the longest-possible
+        stop tail until it is known not to be one."""
+        import time as _time
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            req = json.loads(self.rfile.read(length)) if length else {}
+            prompt = req.get("prompt", "")
+            if isinstance(prompt, list) and all(
+                    isinstance(t, int) for t in prompt):
+                tokens = prompt
+            elif isinstance(prompt, str):
+                if self.tokenizer is None:
+                    raise ValueError("string prompts need --tokenizer; "
+                                     "send a token list instead")
+                tokens = self.tokenizer.encode(prompt)
+            else:
+                raise ValueError("prompt must be a string or token list")
+            if not tokens:
+                raise ValueError("empty prompt")
+            stop = self._parse_stop(req.get("stop"))
+            kw = dict(max_new_tokens=req.get("max_tokens"),
+                      temperature=_or(req.get("temperature"), 1.0),
+                      top_p=_or(req.get("top_p"), 1.0), stop=stop)
+        except (json.JSONDecodeError, ValueError, TypeError) as e:
+            return self._send(400, {"error": {"message": f"{e}",
+                                              "type": "invalid_request_error"}})
+        rid = f"cmpl-{_time.time_ns():x}"
+        created = int(_time.time())
+        model_name = req.get("model") or self.engine.cfg.name
+
+        def finish_reason(toks: list) -> tuple[str, list]:
+            """(reason, tokens with any matched stop/EOS tail stripped)."""
+            for s in stop:
+                if len(s) <= len(toks) and toks[-len(s):] == s:
+                    return "stop", toks[:-len(s)]
+            if toks and toks[-1] == self.engine.sc.eos_token:
+                return "stop", toks[:-1]
+            return "length", toks
+
+        def decode(toks: list) -> str:
+            return (self.tokenizer.decode(toks) if self.tokenizer is not None
+                    else "")
+
+        def chunk_obj(text: str, reason=None) -> dict:
+            return {"id": rid, "object": "text_completion",
+                    "created": created, "model": model_name,
+                    "choices": [{"text": text, "index": 0,
+                                 "finish_reason": reason}]}
+
+        def sse(payload) -> bytes:
+            data = payload if isinstance(payload, str) else json.dumps(payload)
+            return f"data: {data}\n\n".encode()
+
+        if req.get("stream"):
+            # hold back the longest tail that could still become a stop/EOS
+            # match, so stop text never reaches the client
+            holdback = max([len(s) for s in stop] or [0])
+            if self.engine.sc.eos_token >= 0:
+                holdback = max(holdback, 1)
+            pending: list = []
+
+            def fmt_token(t) -> list:
+                pending.append(t)
+                if len(pending) > holdback:
+                    emit = pending[:len(pending) - holdback]
+                    del pending[:len(pending) - holdback]
+                    return [sse(chunk_obj(decode(emit)))]
+                return []
+
+            def fmt_end(out) -> list:
+                reason, stripped = finish_reason(out["tokens"])
+                n_strip = len(out["tokens"]) - len(stripped)
+                tail = pending[:len(pending) - n_strip] if n_strip else pending
+                bodies = []
+                if tail:
+                    bodies.append(sse(chunk_obj(decode(tail))))
+                bodies.append(sse(chunk_obj("", reason)))
+                bodies.append(sse("[DONE]"))
+                return bodies
+
+            return self._stream_pump(
+                tokens, kw, "text/event-stream",
+                {"token": fmt_token,
+                 "end": fmt_end,
+                 "timeout": lambda: [sse({"error": {
+                     "message": "generation timed out",
+                     "type": "timeout"}}), sse("[DONE]")],
+                 "error": lambda msg: [sse({"error": {
+                     "message": msg, "type": "server_error"}}), sse("[DONE]")],
+                 "badreq": lambda msg: {"error": {
+                     "message": msg, "type": "invalid_request_error"}}})
+
+        fut = self.engine.submit(tokens, **kw)
+        try:
+            out = fut.result(timeout=self.request_timeout_s)
+        except FutureTimeout:
+            return self._send(504, {"error": {"message": "generation timed out",
+                                              "type": "timeout"}})
+        except ValueError as e:
+            return self._send(400, {"error": {"message": str(e),
+                                              "type": "invalid_request_error"}})
+        reason, toks = finish_reason(out["tokens"])
+        return self._send(200, {
+            "id": rid, "object": "text_completion", "created": created,
+            "model": model_name,
+            "choices": [{"text": decode(toks), "index": 0,
+                         "logprobs": None, "finish_reason": reason}],
+            "usage": {"prompt_tokens": len(tokens),
+                      "completion_tokens": len(out["tokens"]),
+                      "total_tokens": len(tokens) + len(out["tokens"])}})
+
+    def _generate_stream(self, tokens: list, req: dict):
+        """Chunked NDJSON over the shared pump: one {"token": N} line per
+        decoded token, then the final result object (or {"error": ...})."""
+        try:
+            stop = self._parse_stop(req.get("stop"))
+        except ValueError as e:
+            return self._send(400, {"error": str(e)})
+        kw = dict(max_new_tokens=req.get("max_new_tokens"),
+                  temperature=req.get("temperature"),
+                  top_k=_or(req.get("top_k"), 0),
+                  top_p=_or(req.get("top_p"), 1.0), stop=stop)
+
+        def line(payload: dict) -> bytes:
+            return (json.dumps(payload) + "\n").encode()
+
+        def fmt_end(out) -> list:
+            if self.tokenizer is not None:
+                out = dict(out)
+                out["text"] = self.tokenizer.decode(out["tokens"])
+            return [line(out)]
+
+        return self._stream_pump(
+            tokens, kw, "application/x-ndjson",
+            {"token": lambda t: [line({"token": t})],
+             "end": fmt_end,
+             "timeout": lambda: [line({"error": "generation timed out"})],
+             "error": lambda msg: [line({"error": msg})],
+             "badreq": lambda msg: {"error": msg}})
 
 
 def serve(engine, port: int = 8000, request_timeout_s: float = 120.0,

@@ -91,6 +91,10 @@ class Request:
     temperature: float
     top_k: int = 0          # 0 = no top-k filter
     top_p: float = 1.0      # 1.0 = no nucleus filter
+    # stop token SEQUENCES: generation ends when the generated tail equals
+    # one (the matched sequence stays in the output; callers strip it).
+    # Checked host-side per committed token — no jit impact.
+    stop: list = dataclasses.field(default_factory=list)
     # streaming: called with each generated token id, from the engine thread.
     # A raising callback (client gone) cancels the request at the next token.
     on_token: Optional[Any] = None
@@ -234,11 +238,13 @@ class ServingEngine:
     def submit(self, prompt: list[int], max_new_tokens: Optional[int] = None,
                temperature: Optional[float] = None,
                top_k: int = 0, top_p: float = 1.0,
+               stop: Optional[list] = None,
                on_token=None) -> Future:
         """Enqueue a generation request; resolves to {tokens, latency_s, rid}.
         ``on_token(tok)`` streams each generated token id as it decodes.
         ``top_k``/``top_p`` filter the sampling distribution per request
-        (active only when temperature > 0)."""
+        (active only when temperature > 0). ``stop``: list of token
+        sequences; generation ends when the output tail equals one."""
         if not prompt:
             f: Future = Future()
             f.set_exception(ValueError("empty prompt"))
@@ -279,13 +285,22 @@ class ServingEngine:
             f.set_exception(ValueError(
                 f"top_p must be in (0, 1], got {top_p!r}"))
             return f
+        stop = stop or []
+        if not (isinstance(stop, list) and all(
+                isinstance(s, list) and s
+                and all(isinstance(t, int) for t in s) for s in stop)):
+            f = Future()
+            f.set_exception(ValueError(
+                "stop must be a list of non-empty token lists"))
+            return f
         req = Request(prompt=list(prompt),
                       max_new_tokens=min(max_new_tokens,
                                          self.sc.cache_len - len(prompt)),
                       rid=uuid.uuid4().hex[:8], future=Future(),
                       submitted_at=time.perf_counter(),
                       temperature=float(temperature),
-                      top_k=top_k, top_p=float(top_p), on_token=on_token)
+                      top_k=top_k, top_p=float(top_p),
+                      stop=[list(s) for s in stop], on_token=on_token)
         self._queue.put(req)
         self.metrics.set_gauge("tpu_serving_queue_depth", self._queue.qsize())
         return req.future
@@ -429,6 +444,13 @@ class ServingEngine:
         with self._prefix_lock:
             if any(p[0] == tokens for p in self._prefixes):
                 return  # raced with an identical registration
+            if len(self._prefixes) >= self.sc.max_prefixes:
+                # re-check: a concurrent registration may have filled the
+                # registry while we prefilled outside the lock
+                raise ValueError(
+                    f"prefix registry full ({self.sc.max_prefixes}); each "
+                    "entry pins a KV cache in HBM — raise max_prefixes or "
+                    "restart to clear")
             self._prefixes.append((tokens, logits, single))
             self._prefixes.sort(key=lambda p: -len(p[0]))  # longest first
 
@@ -633,8 +655,11 @@ class ServingEngine:
             self.metrics.incr("tpu_serving_stream_cancelled")
 
     def _finished(self, slot: _Slot) -> bool:
-        return (slot.remaining <= 0
-                or slot.last_token == self.sc.eos_token)
+        if slot.remaining <= 0 or slot.last_token == self.sc.eos_token:
+            return True
+        gen = slot.generated
+        return any(len(s) <= len(gen) and gen[-len(s):] == s
+                   for s in slot.request.stop)
 
     def _complete(self, slot_id: int, slot: _Slot):
         req = slot.request

@@ -1,0 +1,175 @@
+"""Stop sequences + the OpenAI-compatible /v1/completions endpoint."""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from k8s_runpod_kubelet_tpu.models import init_params, tiny_llama
+from k8s_runpod_kubelet_tpu.workloads.serving import ServingConfig, ServingEngine
+
+CFG = tiny_llama(vocab_size=300, embed_dim=64, n_layers=2, n_heads=4,
+                 n_kv_heads=2, mlp_dim=128, max_seq_len=256,
+                 dtype=jnp.float32, param_dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def engine(params):
+    e = ServingEngine(CFG, params,
+                      ServingConfig(slots=2, max_prefill_len=16, cache_len=64,
+                                    max_new_tokens=16)).start()
+    yield e
+    e.stop()
+
+
+class TestStopSequences:
+    def test_stop_cuts_generation(self, engine):
+        full = engine.submit([5, 9, 2], max_new_tokens=12).result(timeout=60)
+        assert len(full["tokens"]) == 12
+        # pick a bigram from the middle of the greedy output as the stop seq
+        stop = full["tokens"][3:5]
+        out = engine.submit([5, 9, 2], max_new_tokens=12,
+                            stop=[stop]).result(timeout=60)
+        assert out["tokens"] == full["tokens"][:5]
+        assert out["tokens"][-2:] == stop
+
+    def test_single_token_stop(self, engine):
+        full = engine.submit([7, 3], max_new_tokens=10).result(timeout=60)
+        tok = full["tokens"][2]
+        out = engine.submit([7, 3], max_new_tokens=10,
+                            stop=[[tok]]).result(timeout=60)
+        assert out["tokens"][-1] == tok
+        assert len(out["tokens"]) <= len(full["tokens"])
+
+    def test_unmatched_stop_runs_to_budget(self, engine):
+        out = engine.submit([1, 2], max_new_tokens=6,
+                            stop=[[299]]).result(timeout=60)
+        assert len(out["tokens"]) == 6 or out["tokens"][-1] == 299
+
+    def test_invalid_stop_rejected(self, engine):
+        with pytest.raises(ValueError):
+            engine.submit([1], stop=[[]]).result(timeout=10)
+        with pytest.raises(ValueError):
+            engine.submit([1], stop=["text"]).result(timeout=10)
+
+
+def _post(port, path, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", json.dumps(payload).encode(),
+        {"Content-Type": "application/json"})
+    return json.loads(urllib.request.urlopen(req, timeout=120).read())
+
+
+class TestOpenAiCompletions:
+    @pytest.fixture(scope="class")
+    def server(self, params):
+        from k8s_runpod_kubelet_tpu.workloads.serve_main import serve
+        from k8s_runpod_kubelet_tpu.workloads.tokenizer import get_tokenizer
+        e = ServingEngine(CFG, params,
+                          ServingConfig(slots=2, max_prefill_len=16,
+                                        cache_len=64, max_new_tokens=16)
+                          ).start()
+        httpd = serve(e, 0, tokenizer=get_tokenizer("bytes"))
+        yield httpd.server_address[1]
+        httpd.shutdown()
+        e.stop()
+
+    def test_token_prompt_completion_shape(self, server):
+        out = _post(server, "/v1/completions",
+                    {"prompt": [5, 9, 2], "max_tokens": 6})
+        assert out["object"] == "text_completion"
+        assert out["choices"][0]["finish_reason"] in ("length", "stop")
+        assert out["usage"]["prompt_tokens"] == 3
+        assert out["usage"]["completion_tokens"] == 6
+        assert isinstance(out["choices"][0]["text"], str)
+
+    def test_string_prompt_roundtrip(self, server):
+        out = _post(server, "/v1/completions",
+                    {"prompt": "hi", "max_tokens": 4, "temperature": 0})
+        assert out["usage"]["prompt_tokens"] == 2  # byte tokenizer
+        assert out["choices"][0]["text"]  # decoded bytes
+
+    def test_stop_string_stripped(self, server):
+        # find the greedy continuation, then stop on its 3rd-4th bytes
+        full = _post(server, "/v1/completions",
+                     {"prompt": [65, 66], "max_tokens": 8, "temperature": 0})
+        toks = _post(server, "/generate",
+                     {"tokens": [65, 66], "max_new_tokens": 8})["tokens"]
+        stop_seq = toks[2:4]
+        out = _post(server, "/v1/completions",
+                    {"prompt": [65, 66], "max_tokens": 8, "temperature": 0,
+                     "stop": [stop_seq]})
+        assert out["choices"][0]["finish_reason"] == "stop"
+        # matched stop tail is stripped (OpenAI semantics): 2 tokens of text
+        assert out["usage"]["completion_tokens"] == 4
+        assert full["choices"][0]["text"].startswith(
+            out["choices"][0]["text"])
+
+    def test_sse_stream(self, server):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server}/v1/completions",
+            json.dumps({"prompt": [5, 9], "max_tokens": 4, "temperature": 0,
+                        "stream": True}).encode(),
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            assert resp.headers["Content-Type"] == "text/event-stream"
+            body = resp.read().decode()
+        events = [l[6:] for l in body.splitlines() if l.startswith("data: ")]
+        assert events[-1] == "[DONE]"
+        payloads = [json.loads(e) for e in events[:-1]]
+        token_chunks = [p for p in payloads
+                        if p["choices"][0]["finish_reason"] is None]
+        assert len(token_chunks) == 4
+        assert payloads[-1]["choices"][0]["finish_reason"] in ("length",
+                                                               "stop")
+
+    def test_sse_stream_strips_stop_text(self, server):
+        """Streamed text must equal the non-stream text — the stop tail is
+        held back and never reaches the client (OpenAI semantics)."""
+        toks = _post(server, "/generate",
+                     {"tokens": [65, 66], "max_new_tokens": 8})["tokens"]
+        stop_seq = toks[2:4]
+        plain = _post(server, "/v1/completions",
+                      {"prompt": [65, 66], "max_tokens": 8, "temperature": 0,
+                       "stop": [stop_seq]})
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server}/v1/completions",
+            json.dumps({"prompt": [65, 66], "max_tokens": 8,
+                        "temperature": 0, "stop": [stop_seq],
+                        "stream": True}).encode(),
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            body = resp.read().decode()
+        events = [l[6:] for l in body.splitlines() if l.startswith("data: ")]
+        assert events[-1] == "[DONE]"
+        payloads = [json.loads(e) for e in events[:-1]]
+        streamed = "".join(p["choices"][0]["text"] for p in payloads)
+        assert streamed == plain["choices"][0]["text"]
+        assert payloads[-1]["choices"][0]["finish_reason"] == "stop"
+
+    def test_bad_request_shape(self, server):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(server, "/v1/completions", {"prompt": {"not": "valid"}})
+        assert ei.value.code == 400
+        err = json.loads(ei.value.read())
+        assert err["error"]["type"] == "invalid_request_error"
+
+    def test_generate_endpoint_stop_strings(self, server):
+        """/generate also takes stop strings when a tokenizer is present."""
+        full = _post(server, "/generate",
+                     {"tokens": [65, 66], "max_new_tokens": 8})
+        stop_toks = full["tokens"][2:4]
+        from k8s_runpod_kubelet_tpu.workloads.tokenizer import get_tokenizer
+        stop_str = get_tokenizer("bytes").decode(stop_toks)
+        out = _post(server, "/generate",
+                    {"tokens": [65, 66], "max_new_tokens": 8,
+                     "stop": stop_str})
+        assert out["tokens"] == full["tokens"][:4]
