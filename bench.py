@@ -202,6 +202,17 @@ def run_attn_bench() -> int:
         pallas_fn = vjp_of(lambda q, k, v: flash_attention(
             q, k, v, causal=True, use_pallas=True))
         t_pallas = time_fn(pallas_fn, q, k, v)
+        if s >= 8192:
+            # Mistral geometry: W=4096 sliding window — the kernels skip
+            # blocks outside the band, so windowed time should approach
+            # W/S of full-causal as S grows (the O(S*W) claim, measured)
+            win_fn = vjp_of(lambda q, k, v: flash_attention(
+                q, k, v, causal=True, use_pallas=True, sliding_window=4096))
+            t_win = time_fn(win_fn, q, k, v)
+            _emit({"metric": f"flash_attn_sw4096_s{s}", "unit": "ms",
+                   "value": round(t_win * 1e3, 3),
+                   "full_causal_ms": round(t_pallas * 1e3, 3),
+                   "speedup_vs_full": round(t_pallas / t_win, 2)})
         # causal fwd+bwd model flops: fwd 2 matmuls, bwd 5 -> 3.5x fwd pair
         flops = 3.5 * 2 * b * hq * s * s * d  # causal halves via /2 below
         rec = {"metric": f"flash_attn_s{s}", "unit": "ms",

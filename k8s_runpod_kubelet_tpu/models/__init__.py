@@ -11,7 +11,7 @@
   parity-tested against ``transformers`` for every family.
 """
 
-from .llama import (LlamaConfig, LlamaModel, llama3_8b, llama3_70b, gemma_7b,
+from .llama import (LlamaConfig, LlamaModel, llama3_8b, llama3_70b, llama31_8b, gemma_7b,
                     gemma2_9b, gemma3_12b, mixtral_8x7b, mistral_7b, qwen2_7b,
                     tiny_llama, tiny_moe, init_params, param_logical_axes)
 from .mnist import MnistCNN, mnist_config
@@ -20,7 +20,7 @@ from .convert import load_hf, from_hf_state_dict, to_hf_state_dict
 from .quant import quantize_params, is_quantized
 from .lora import LoraConfig, apply_lora, merge_lora, lora_mask, lora_param_count
 
-__all__ = ["LlamaConfig", "LlamaModel", "llama3_8b", "llama3_70b", "gemma_7b",
+__all__ = ["LlamaConfig", "LlamaModel", "llama3_8b", "llama3_70b", "llama31_8b", "gemma_7b",
            "gemma2_9b", "gemma3_12b", "mixtral_8x7b", "mistral_7b", "qwen2_7b",
            "tiny_llama", "tiny_moe", "init_params",
            "param_logical_axes", "MnistCNN", "mnist_config", "moe_mlp",

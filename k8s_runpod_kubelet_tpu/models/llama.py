@@ -146,6 +146,17 @@ def llama3_70b() -> LlamaConfig:
                        max_seq_len=8192, rope_theta=500_000.0)
 
 
+def llama31_8b() -> LlamaConfig:
+    # Llama-3.1-8B: the 3.0 backbone at 128k context via the NTK-aware
+    # frequency warp (ops/rope.py rope_frequencies scaling branch).
+    return LlamaConfig(name="llama31-8b", vocab_size=128256, embed_dim=4096,
+                       n_layers=32, n_heads=32, n_kv_heads=8, mlp_dim=14336,
+                       max_seq_len=131072, rope_theta=500_000.0,
+                       rope_scaling={"factor": 8.0, "low_freq_factor": 1.0,
+                                     "high_freq_factor": 4.0,
+                                     "original_max_position": 8192})
+
+
 def gemma_7b() -> LlamaConfig:
     # Gemma-7B, faithfully: MHA with wide head_dim, GeGLU MLP, embeddings
     # scaled by sqrt(embed_dim), zero-centered RMSNorm, tied lm head.

@@ -74,7 +74,12 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _parse_stop(self, raw) -> list:
         """OpenAI-style ``stop``: a string, list of strings (needs the
-        tokenizer), or list of token lists. Returns token sequences."""
+        tokenizer), or list of token lists. Returns token sequences,
+        encoded WITHOUT special tokens (a BOS-prefixed sequence could
+        never match a generated tail). Matching is token-level: exact for
+        byte-level tokenizers; for BPE vocabularies a stop string only
+        matches when the model generates that same tokenization (the
+        common case for delimiters like newlines, but not guaranteed)."""
         if raw is None:
             return []
         if isinstance(raw, str):
@@ -84,7 +89,7 @@ class _Handler(BaseHTTPRequestHandler):
             if isinstance(s, str):
                 if self.tokenizer is None:
                     raise ValueError("string stop sequences need --tokenizer")
-                toks = self.tokenizer.encode(s)
+                toks = self.tokenizer.encode_plain(s)
                 if toks:
                     out.append(toks)
             elif isinstance(s, list):
@@ -279,23 +284,42 @@ class _Handler(BaseHTTPRequestHandler):
             holdback = max([len(s) for s in stop] or [0])
             if self.engine.sc.eos_token >= 0:
                 holdback = max(holdback, 1)
-            pending: list = []
+            pending: list = []   # tokens still inside the stop-tail window
+            released: list = []  # tokens cleared for emission, cumulative
+            sent = [0]           # chars of decode(released) already streamed
+
+            def text_delta(final: bool) -> str:
+                """Incremental decode by cumulative diff: per-fragment
+                decode would corrupt multi-byte UTF-8 chars (and BPE
+                word-boundary merges) split across chunks. A trailing
+                U+FFFD may be an incomplete char mid-stream — hold it
+                until more bytes arrive (or the stream ends)."""
+                text = decode(released)
+                if not final and text.endswith("�"):
+                    text = text[:-1]
+                delta = text[sent[0]:]
+                sent[0] += len(delta)
+                return delta
 
             def fmt_token(t) -> list:
                 pending.append(t)
                 if len(pending) > holdback:
-                    emit = pending[:len(pending) - holdback]
+                    released.extend(pending[:len(pending) - holdback])
                     del pending[:len(pending) - holdback]
-                    return [sse(chunk_obj(decode(emit)))]
+                    delta = text_delta(final=False)
+                    if delta:
+                        return [sse(chunk_obj(delta))]
                 return []
 
             def fmt_end(out) -> list:
                 reason, stripped = finish_reason(out["tokens"])
                 n_strip = len(out["tokens"]) - len(stripped)
-                tail = pending[:len(pending) - n_strip] if n_strip else pending
+                released.extend(pending[:len(pending) - n_strip]
+                                if n_strip else pending)
                 bodies = []
-                if tail:
-                    bodies.append(sse(chunk_obj(decode(tail))))
+                delta = text_delta(final=True)
+                if delta:
+                    bodies.append(sse(chunk_obj(delta)))
                 bodies.append(sse(chunk_obj("", reason)))
                 bodies.append(sse("[DONE]"))
                 return bodies
@@ -376,7 +400,7 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="gemma-7b",
                    choices=["gemma-7b", "gemma2-9b", "gemma3-12b",
-                            "llama3-8b", "mixtral-8x7b", "mistral-7b",
+                            "llama3-8b", "llama31-8b", "mixtral-8x7b", "mistral-7b",
                             "qwen2-7b", "tiny", "tiny-moe"])
     p.add_argument("--slots", type=int, default=8)
     p.add_argument("--port", type=int, default=8000)
@@ -409,13 +433,14 @@ def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO)
 
     import jax
-    from ..models import (gemma_7b, gemma2_9b, gemma3_12b, llama3_8b,
+    from ..models import (gemma_7b, gemma2_9b, gemma3_12b, llama3_8b, llama31_8b,
                           mixtral_8x7b, mistral_7b, qwen2_7b, tiny_llama,
                           tiny_moe, init_params)
     from .serving import ServingConfig, ServingEngine
 
     cfg = {"gemma-7b": gemma_7b, "gemma2-9b": gemma2_9b,
            "gemma3-12b": gemma3_12b, "llama3-8b": llama3_8b,
+           "llama31-8b": llama31_8b,
            "mixtral-8x7b": mixtral_8x7b, "mistral-7b": mistral_7b,
            "qwen2-7b": qwen2_7b, "tiny": tiny_llama,
            "tiny-moe": tiny_moe}[args.model]()

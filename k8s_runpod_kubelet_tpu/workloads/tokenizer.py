@@ -40,6 +40,10 @@ class ByteTokenizer:
     def encode(self, text: str) -> list[int]:
         return list(text.encode("utf-8"))
 
+    def encode_plain(self, text: str) -> list[int]:
+        """No special tokens — for stop-sequence matching."""
+        return self.encode(text)
+
     def decode(self, tokens: list[int]) -> str:
         return bytes(t for t in tokens if 0 <= t < 256).decode(
             "utf-8", errors="replace")
@@ -56,6 +60,11 @@ class HfTokenizer:
 
     def encode(self, text: str) -> list[int]:
         return self._tok.encode(text)
+
+    def encode_plain(self, text: str) -> list[int]:
+        """No BOS/EOS — a stop sequence with a BOS prepended could never
+        match a generated tail."""
+        return self._tok.encode(text, add_special_tokens=False)
 
     def decode(self, tokens: list[int]) -> str:
         return self._tok.decode(tokens, skip_special_tokens=True)

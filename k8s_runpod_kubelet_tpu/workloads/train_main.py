@@ -25,7 +25,7 @@ log = logging.getLogger("train-main")
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="llama3-8b",
-                   choices=["llama3-8b", "llama3-70b", "gemma-7b",
+                   choices=["llama3-8b", "llama3-70b", "llama31-8b", "gemma-7b",
                             "gemma2-9b", "gemma3-12b", "mixtral-8x7b",
                             "mistral-7b", "qwen2-7b", "tiny", "tiny-moe"])
     p.add_argument("--steps", type=int, default=100)
@@ -78,7 +78,7 @@ def main(argv=None) -> int:
     if args.profiler_port:
         jax.profiler.start_server(args.profiler_port)
         log.info("jax profiler server on :%d", args.profiler_port)
-    from ..models import (llama3_8b, llama3_70b, gemma_7b, gemma2_9b,
+    from ..models import (llama3_8b, llama3_70b, llama31_8b, gemma_7b, gemma2_9b,
                           gemma3_12b, mixtral_8x7b, mistral_7b, qwen2_7b,
                           tiny_llama, tiny_moe)
     from ..parallel import MeshConfig, make_mesh
@@ -86,6 +86,7 @@ def main(argv=None) -> int:
 
     n = jax.device_count()
     cfg = {"llama3-8b": llama3_8b, "llama3-70b": llama3_70b,
+           "llama31-8b": llama31_8b,
            "gemma-7b": gemma_7b, "gemma2-9b": gemma2_9b,
            "gemma3-12b": gemma3_12b, "mixtral-8x7b": mixtral_8x7b,
            "mistral-7b": mistral_7b, "qwen2-7b": qwen2_7b,

@@ -59,6 +59,36 @@ class TestLogitsParity:
                               max_seq_len=64, rope_theta=10_000.0))
         _compare(cfg, hf)
 
+    def test_llama31_ntk_rope_scaling(self):
+        """Pins ops/rope.py's NTK frequency warp against HF's llama3 rope
+        scaling — S=48 spans positions past original_max_position/4 so the
+        warped low frequencies actually matter."""
+        torch.manual_seed(7)
+        hf = transformers.LlamaForCausalLM(transformers.LlamaConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=112,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=128, rope_theta=500_000.0,
+            rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                          "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                          "original_max_position_embeddings": 64},
+            rms_norm_eps=1e-5, tie_word_embeddings=False,
+            attn_implementation="eager"))
+        cfg = _f32(tiny_llama(vocab_size=128, embed_dim=64, n_layers=2,
+                              n_heads=4, n_kv_heads=2, mlp_dim=112,
+                              max_seq_len=128, rope_theta=500_000.0,
+                              rope_scaling={"factor": 8.0,
+                                            "low_freq_factor": 1.0,
+                                            "high_freq_factor": 4.0,
+                                            "original_max_position": 64}))
+        hf.eval()
+        rng = np.random.default_rng(1)
+        toks = rng.integers(0, 128, (2, 48)).astype(np.int32)
+        with torch.no_grad():
+            ref = hf(torch.from_numpy(toks.astype(np.int64))).logits.numpy()
+        params = load_hf(cfg, hf)
+        ours = np.asarray(LlamaModel(cfg).forward(params, jnp.asarray(toks)))
+        np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-4)
+
     def test_qwen2_with_qkv_bias(self):
         torch.manual_seed(1)
         hf = transformers.Qwen2ForCausalLM(transformers.Qwen2Config(
