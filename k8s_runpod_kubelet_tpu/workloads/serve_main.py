@@ -100,7 +100,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         if self.path == "/v1/completions":
-            return self._openai_completion()
+            return self._openai_completion(chat=False)
+        if self.path == "/v1/chat/completions":
+            return self._openai_completion(chat=True)
         if self.path not in ("/generate", "/prefix"):
             return self._send(404, {"error": f"no route {self.path}"})
         try:
@@ -142,7 +144,7 @@ class _Handler(BaseHTTPRequestHandler):
                                  req.get("temperature"),
                                  top_k=_or(req.get("top_k"), 0),
                                  top_p=_or(req.get("top_p"), 1.0),
-                                 stop=stop)
+                                 stop=stop, logprobs=bool(req.get("logprobs")))
         try:
             out = fut.result(timeout=self.request_timeout_s)
         except FutureTimeout:
@@ -219,10 +221,13 @@ class _Handler(BaseHTTPRequestHandler):
         except (BrokenPipeError, ConnectionError, OSError):
             dead.set()  # engine cancels at its next on_token call
 
-    def _openai_completion(self):
-        """OpenAI-compatible POST /v1/completions: lets existing OpenAI-SDK
-        clients point at this server unchanged. Supports prompt (string
-        needs --tokenizer; token list always works), max_tokens,
+    def _openai_completion(self, chat: bool):
+        """OpenAI-compatible POST /v1/completions and /v1/chat/completions:
+        lets existing OpenAI-SDK clients point at this server unchanged.
+        Completions take prompt (string needs --tokenizer; token list
+        always works) + optional logprobs; chat takes messages rendered
+        through the model's own chat template when the HF tokenizer ships
+        one (role-prefix fallback otherwise). Both support max_tokens,
         temperature, top_p, stop, and SSE streaming. The matched stop
         sequence (or EOS) never appears in the returned text, stream or
         not (OpenAI semantics) — streaming holds back the longest-possible
@@ -231,29 +236,48 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             length = int(self.headers.get("Content-Length") or 0)
             req = json.loads(self.rfile.read(length)) if length else {}
-            prompt = req.get("prompt", "")
-            if isinstance(prompt, list) and all(
-                    isinstance(t, int) for t in prompt):
-                tokens = prompt
-            elif isinstance(prompt, str):
+            if chat:
+                messages = req.get("messages")
+                if not (isinstance(messages, list) and messages and all(
+                        isinstance(m, dict) and isinstance(m.get("role"), str)
+                        and isinstance(m.get("content"), str)
+                        for m in messages)):
+                    raise ValueError("messages must be a non-empty list of "
+                                     "{role, content} objects")
                 if self.tokenizer is None:
-                    raise ValueError("string prompts need --tokenizer; "
-                                     "send a token list instead")
-                tokens = self.tokenizer.encode(prompt)
+                    raise ValueError("chat completions need --tokenizer")
+                tokens = list(self.tokenizer.apply_chat(messages))
             else:
-                raise ValueError("prompt must be a string or token list")
+                prompt = req.get("prompt", "")
+                if isinstance(prompt, list) and all(
+                        isinstance(t, int) for t in prompt):
+                    tokens = prompt
+                elif isinstance(prompt, str):
+                    if self.tokenizer is None:
+                        raise ValueError("string prompts need --tokenizer; "
+                                         "send a token list instead")
+                    tokens = self.tokenizer.encode(prompt)
+                else:
+                    raise ValueError("prompt must be a string or token list")
             if not tokens:
                 raise ValueError("empty prompt")
             stop = self._parse_stop(req.get("stop"))
+            # logprobs: completions-only, non-stream only (SSE chunks don't
+            # carry them — don't make the engine compute what we'd discard)
+            want_lp = (bool(req.get("logprobs")) and not chat
+                       and not req.get("stream"))
             kw = dict(max_new_tokens=req.get("max_tokens"),
                       temperature=_or(req.get("temperature"), 1.0),
-                      top_p=_or(req.get("top_p"), 1.0), stop=stop)
+                      top_p=_or(req.get("top_p"), 1.0), stop=stop,
+                      logprobs=want_lp)
         except (json.JSONDecodeError, ValueError, TypeError) as e:
             return self._send(400, {"error": {"message": f"{e}",
                                               "type": "invalid_request_error"}})
-        rid = f"cmpl-{_time.time_ns():x}"
+        rid = (f"chatcmpl-{_time.time_ns():x}" if chat
+               else f"cmpl-{_time.time_ns():x}")
         created = int(_time.time())
         model_name = req.get("model") or self.engine.cfg.name
+        obj = "chat.completion" if chat else "text_completion"
 
         def finish_reason(toks: list) -> tuple[str, list]:
             """(reason, tokens with any matched stop/EOS tail stripped)."""
@@ -268,7 +292,18 @@ class _Handler(BaseHTTPRequestHandler):
             return (self.tokenizer.decode(toks) if self.tokenizer is not None
                     else "")
 
+        first_chunk = [True]
+
         def chunk_obj(text: str, reason=None) -> dict:
+            if chat:
+                delta: dict = {"content": text} if text else {}
+                if first_chunk[0]:
+                    delta = {"role": "assistant", **delta}
+                    first_chunk[0] = False
+                choice = {"delta": delta, "index": 0, "finish_reason": reason}
+                return {"id": rid, "object": "chat.completion.chunk",
+                        "created": created, "model": model_name,
+                        "choices": [choice]}
             return {"id": rid, "object": "text_completion",
                     "created": created, "model": model_name,
                     "choices": [{"text": text, "index": 0,
@@ -346,11 +381,21 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send(400, {"error": {"message": str(e),
                                               "type": "invalid_request_error"}})
         reason, toks = finish_reason(out["tokens"])
+        if chat:
+            choice: dict = {"index": 0, "finish_reason": reason,
+                            "message": {"role": "assistant",
+                                        "content": decode(toks)}}
+        else:
+            choice = {"text": decode(toks), "index": 0,
+                      "logprobs": None, "finish_reason": reason}
+            if kw["logprobs"]:
+                choice["logprobs"] = {
+                    "token_logprobs": out.get("logprobs", [])[:len(toks)],
+                    "tokens": [decode([t]) for t in toks],
+                    "top_logprobs": None}
         return self._send(200, {
-            "id": rid, "object": "text_completion", "created": created,
-            "model": model_name,
-            "choices": [{"text": decode(toks), "index": 0,
-                         "logprobs": None, "finish_reason": reason}],
+            "id": rid, "object": obj, "created": created,
+            "model": model_name, "choices": [choice],
             "usage": {"prompt_tokens": len(tokens),
                       "completion_tokens": len(out["tokens"]),
                       "total_tokens": len(tokens) + len(out["tokens"])}})
@@ -369,6 +414,8 @@ class _Handler(BaseHTTPRequestHandler):
 
         def line(payload: dict) -> bytes:
             return (json.dumps(payload) + "\n").encode()
+
+        kw["logprobs"] = bool(req.get("logprobs"))
 
         def fmt_end(out) -> list:
             if self.tokenizer is not None:

@@ -95,6 +95,8 @@ class Request:
     # one (the matched sequence stays in the output; callers strip it).
     # Checked host-side per committed token — no jit impact.
     stop: list = dataclasses.field(default_factory=list)
+    # return per-token log P(token | prefix) of each generated token
+    logprobs: bool = False
     # streaming: called with each generated token id, from the engine thread.
     # A raising callback (client gone) cancels the request at the next token.
     on_token: Optional[Any] = None
@@ -104,6 +106,7 @@ class Request:
 class _Slot:
     request: Optional[Request] = None
     generated: list[int] = dataclasses.field(default_factory=list)
+    logprobs: list[float] = dataclasses.field(default_factory=list)
     remaining: int = 0
     last_token: int = 0
 
@@ -238,13 +241,14 @@ class ServingEngine:
     def submit(self, prompt: list[int], max_new_tokens: Optional[int] = None,
                temperature: Optional[float] = None,
                top_k: int = 0, top_p: float = 1.0,
-               stop: Optional[list] = None,
+               stop: Optional[list] = None, logprobs: bool = False,
                on_token=None) -> Future:
-        """Enqueue a generation request; resolves to {tokens, latency_s, rid}.
-        ``on_token(tok)`` streams each generated token id as it decodes.
-        ``top_k``/``top_p`` filter the sampling distribution per request
-        (active only when temperature > 0). ``stop``: list of token
-        sequences; generation ends when the output tail equals one."""
+        """Enqueue a generation request; resolves to {tokens, latency_s, rid}
+        (+ per-token "logprobs" when requested). ``on_token(tok)`` streams
+        each generated token id as it decodes. ``top_k``/``top_p`` filter
+        the sampling distribution per request (active only when
+        temperature > 0). ``stop``: list of token sequences; generation
+        ends when the output tail equals one."""
         if not prompt:
             f: Future = Future()
             f.set_exception(ValueError("empty prompt"))
@@ -300,7 +304,8 @@ class ServingEngine:
                       submitted_at=time.perf_counter(),
                       temperature=float(temperature),
                       top_k=top_k, top_p=float(top_p),
-                      stop=[list(s) for s in stop], on_token=on_token)
+                      stop=[list(s) for s in stop], logprobs=bool(logprobs),
+                      on_token=on_token)
         self._queue.put(req)
         self.metrics.set_gauge("tpu_serving_queue_depth", self._queue.qsize())
         return req.future
@@ -349,7 +354,7 @@ class ServingEngine:
                         req.future.set_exception(exc)
                 while True:
                     try:
-                        req, _, _ = self._ready.get_nowait()
+                        req, *_ = self._ready.get_nowait()
                     except queue.Empty:
                         break
                     if not req.future.done():
@@ -470,6 +475,10 @@ class ServingEngine:
                 self._prefill_key, sub = jax.random.split(self._prefill_key)
                 first = int(_sample(last_logits, sub, [req.temperature],
                                     [req.top_k], [req.top_p])[0])
+                first_lp = None
+                if req.logprobs:
+                    first_lp = float(jax.nn.log_softmax(
+                        last_logits[0].astype(jnp.float32))[first])
             except Exception as exc:  # noqa: BLE001 — poisoned prompt only
                 log.exception("prefill of %s failed", req.rid)
                 self.metrics.incr("tpu_serving_prefill_errors")
@@ -478,7 +487,7 @@ class ServingEngine:
                 continue
             while not self._stop.is_set():
                 try:
-                    self._ready.put((req, single, first), timeout=0.1)
+                    self._ready.put((req, single, first, first_lp), timeout=0.1)
                     break
                 except queue.Full:
                     continue
@@ -491,7 +500,7 @@ class ServingEngine:
             if slot.request is not None:
                 continue
             try:
-                req, single, first = self._ready.get_nowait()
+                req, single, first, first_lp = self._ready.get_nowait()
             except queue.Empty:
                 break
             self._cache = self._insert(self._cache, single,
@@ -499,6 +508,7 @@ class ServingEngine:
             self._tokens = self._tokens.at[slot_id].set(first)
             slot.request = req
             slot.generated = [first]
+            slot.logprobs = [first_lp] if first_lp is not None else []
             slot.remaining = req.max_new_tokens - 1
             slot.last_token = first
             self._emit(slot, first)
@@ -560,12 +570,25 @@ class ServingEngine:
         # would have produced (logits[:, 0])
         reqs = [s.request for s in slots]
         temps = [r.temperature if r else 0.0 for r in reqs]
-        sampled_np = None
+        # verify_step logits are f32 by contract, so these lp reductions are
+        # full-precision; gate each on the slot kind that actually reads it
+        greedy_lp = None
+        if any(r is not None and r.logprobs and r.temperature <= 0.0
+               for r in reqs):
+            # lp of the argmax token = max - logsumexp, no (V,) gather
+            greedy_lp = np.asarray(jnp.max(logits, axis=-1)
+                                   - jax.nn.logsumexp(logits, axis=-1))
+        sampled_np = sampled_lp = None
         if any(t > 0.0 for t in temps):
             sampled_np = np.asarray(self._sample_batch(
                 logits[:, 0], temps,
                 [r.top_k if r else 0 for r in reqs],
                 [r.top_p if r else 1.0 for r in reqs]))
+            if any(r is not None and r.logprobs and r.temperature > 0.0
+                   for r in reqs):
+                logp0 = jax.nn.log_softmax(logits[:, 0], axis=-1)
+                sampled_lp = np.asarray(jnp.take_along_axis(
+                    logp0, jnp.asarray(sampled_np)[:, None], axis=-1)[:, 0])
         self.metrics.incr("tpu_serving_spec_proposed", k * n_greedy)
 
         advance = np.zeros((b,), np.int32)
@@ -585,10 +608,14 @@ class ServingEngine:
             # positions idx..idx+m-1 hold KV for toks_in[0..m-1], all of
             # which are now committed (m-1 matched drafts + the last token)
             appended = 0
-            for tok in committed:
+            for jc, tok in enumerate(committed):
                 if slot.request is None:
                     break  # finished mid-run (eos / budget)
                 slot.generated.append(tok)
+                if slot.request.logprobs:
+                    slot.logprobs.append(
+                        float(greedy_lp[i, jc]) if greedy_slot
+                        else float(sampled_lp[i]))
                 slot.last_token = tok
                 slot.remaining -= 1
                 appended += 1
@@ -620,11 +647,18 @@ class ServingEngine:
         ps = [r.top_p if r else 1.0 for r in reqs]
         # sample per slot (temperature / top-k / top-p can differ per request)
         next_np = np.asarray(self._sample_batch(logits, temps, ks, ps))
+        lp_np = None
+        if any(r is not None and r.logprobs for r in reqs):
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            lp_np = np.asarray(jnp.take_along_axis(
+                logp, jnp.asarray(next_np)[:, None], axis=-1)[:, 0])
         for slot_id, slot in enumerate(self._slots):
             if slot.request is None:
                 continue
             tok = int(next_np[slot_id])
             slot.generated.append(tok)
+            if slot.request.logprobs and lp_np is not None:
+                slot.logprobs.append(float(lp_np[slot_id]))
             slot.last_token = tok
             slot.remaining -= 1
             self._emit(slot, tok)
@@ -666,6 +700,8 @@ class ServingEngine:
         slot.request = None
         latency = time.perf_counter() - req.submitted_at
         self.metrics.observe("tpu_serving_request_latency_seconds", latency)
-        req.future.set_result({"rid": req.rid, "tokens": slot.generated,
-                               "latency_s": latency})
+        out = {"rid": req.rid, "tokens": slot.generated, "latency_s": latency}
+        if req.logprobs:
+            out["logprobs"] = slot.logprobs
+        req.future.set_result(out)
         self.metrics.set_gauge("tpu_serving_active_slots", self.active_slots)

@@ -27,6 +27,13 @@ class Tokenizer(Protocol):
     def eos_id(self) -> int: ...
 
 
+def _chat_fallback_text(messages: list[dict]) -> str:
+    """Shared minimal chat template: "role: content" lines + assistant cue
+    (used whenever no model-native chat template exists)."""
+    return "".join(f"{m['role']}: {m['content']}\n" for m in messages) \
+        + "assistant:"
+
+
 class ByteTokenizer:
     """UTF-8 bytes as token ids (0..255); id 256 = EOS. Lossless round-trip
     for any text; needs model vocab >= 257 (EOS optional at >= 256)."""
@@ -48,6 +55,9 @@ class ByteTokenizer:
         return bytes(t for t in tokens if 0 <= t < 256).decode(
             "utf-8", errors="replace")
 
+    def apply_chat(self, messages: list[dict]) -> list[int]:
+        return self.encode(_chat_fallback_text(messages))
+
 
 class HfTokenizer:
     def __init__(self, path: str):
@@ -68,6 +78,14 @@ class HfTokenizer:
 
     def decode(self, tokens: list[int]) -> str:
         return self._tok.decode(tokens, skip_special_tokens=True)
+
+    def apply_chat(self, messages: list[dict]) -> list[int]:
+        """The model's own chat template when the tokenizer ships one;
+        otherwise the same minimal role-prefix fallback as ByteTokenizer."""
+        if getattr(self._tok, "chat_template", None):
+            return self._tok.apply_chat_template(messages,
+                                                 add_generation_prompt=True)
+        return self._tok.encode(_chat_fallback_text(messages))
 
 
 def get_tokenizer(spec: Optional[str]):

@@ -186,6 +186,85 @@ class TestOpenAiCompletions:
         err = json.loads(ei.value.read())
         assert err["error"]["type"] == "invalid_request_error"
 
+    def test_logprobs_returned_and_consistent(self, server, params):
+        """Greedy logprobs: finite, <= 0, one per generated token, and the
+        first-token logprob matches the model's log-softmax at the prompt's
+        last position."""
+        import numpy as np
+        from k8s_runpod_kubelet_tpu.models import LlamaModel
+        out = _post(server, "/v1/completions",
+                    {"prompt": [5, 9, 2], "max_tokens": 5, "temperature": 0,
+                     "logprobs": 1})
+        lp = out["choices"][0]["logprobs"]["token_logprobs"]
+        assert len(lp) == 5 and all(l <= 0 for l in lp)
+        gen = _post(server, "/generate",
+                    {"tokens": [5, 9, 2], "max_new_tokens": 5,
+                     "logprobs": True})
+        np.testing.assert_allclose(gen["logprobs"], lp, rtol=1e-5, atol=1e-5)
+        import jax
+        import jax.numpy as jnp
+        logits = LlamaModel(CFG).forward(
+            params, jnp.asarray([[5, 9, 2]], jnp.int32))
+        ref = jax.nn.log_softmax(logits[0, -1].astype(jnp.float32))
+        first = gen["tokens"][0]
+        np.testing.assert_allclose(lp[0], float(ref[first]),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_logprobs_with_speculation_match_plain(self, params):
+        """The speculative path must report the same greedy logprobs as the
+        plain decode path (max - logsumexp identity)."""
+        import numpy as np
+        sc_s = ServingConfig(slots=2, max_prefill_len=16, cache_len=64,
+                             max_new_tokens=12, speculate_k=3)
+        sc_p = ServingConfig(slots=2, max_prefill_len=16, cache_len=64,
+                             max_new_tokens=12)
+        e_s = ServingEngine(CFG, params, sc_s).start()
+        e_p = ServingEngine(CFG, params, sc_p).start()
+        try:
+            prompt = [3, 1, 4, 1, 5, 9, 2, 6, 3, 1, 4, 1, 5]
+            a = e_s.submit(prompt, max_new_tokens=12,
+                           logprobs=True).result(timeout=60)
+            b = e_p.submit(prompt, max_new_tokens=12,
+                           logprobs=True).result(timeout=60)
+            assert a["tokens"] == b["tokens"]
+            np.testing.assert_allclose(a["logprobs"], b["logprobs"],
+                                       rtol=2e-4, atol=2e-4)
+        finally:
+            e_s.stop()
+            e_p.stop()
+
+    def test_chat_completions(self, server):
+        out = _post(server, "/v1/chat/completions",
+                    {"messages": [{"role": "system", "content": "be brief"},
+                                  {"role": "user", "content": "hi"}],
+                     "max_tokens": 6, "temperature": 0})
+        assert out["object"] == "chat.completion"
+        msg = out["choices"][0]["message"]
+        assert msg["role"] == "assistant" and isinstance(msg["content"], str)
+        assert out["usage"]["completion_tokens"] == 6
+
+    def test_chat_stream_delta_shape(self, server):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server}/v1/chat/completions",
+            json.dumps({"messages": [{"role": "user", "content": "hey"}],
+                        "max_tokens": 4, "temperature": 0,
+                        "stream": True}).encode(),
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            body = resp.read().decode()
+        events = [l[6:] for l in body.splitlines() if l.startswith("data: ")]
+        assert events[-1] == "[DONE]"
+        payloads = [json.loads(e) for e in events[:-1]]
+        assert all(p["object"] == "chat.completion.chunk" for p in payloads)
+        assert payloads[0]["choices"][0]["delta"].get("role") == "assistant"
+        assert payloads[-1]["choices"][0]["finish_reason"] in ("length",
+                                                               "stop")
+
+    def test_chat_bad_messages(self, server):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(server, "/v1/chat/completions", {"messages": "nope"})
+        assert ei.value.code == 400
+
     def test_generate_endpoint_stop_strings(self, server):
         """/generate also takes stop strings when a tokenizer is present."""
         full = _post(server, "/generate",
