@@ -175,12 +175,7 @@ class ServingEngine:
             queue.Queue(maxsize=sc.slots)
         self._slots = [_Slot() for _ in range(sc.slots)]
         self._ring_len = self._pick_ring_len(cfg, sc)
-        if self._ring_len is not None:
-            self._cache = self.model.init_ring_cache(
-                sc.slots, self._ring_len, quantize=sc.quantize_kv_int8)
-        else:
-            self._cache = self.model.init_cache(
-                sc.slots, sc.cache_len, quantize=sc.quantize_kv_int8)
+        self._cache = self._fresh_cache(sc.slots)
         self._tokens = jnp.zeros((sc.slots,), jnp.int32)
         key = jax.random.PRNGKey(seed)
         self._key, self._prefill_key = jax.random.split(key)
@@ -189,12 +184,17 @@ class ServingEngine:
                                         daemon=True)
         self._prefill_thread = threading.Thread(
             target=self._prefill_loop, name="serving-prefill", daemon=True)
-        self._decode = jax.jit(self.model.decode_step)
-        # one jitted verify kernel serves both speculative decode (engine
-        # thread) and chunked prefill (prefill thread) — jit dispatch is
-        # thread-safe and the compile cache is shared
+        # the engine-loop cache is DONATED into decode/verify so XLA updates
+        # the K-token slice in place instead of copying the whole
+        # (L, slots, len, h, d) cache every step — on HBM that's the
+        # difference between O(tokens written) and O(cache bytes) per step
+        self._decode = jax.jit(self.model.decode_step, donate_argnums=(2,))
+        self._verify = (jax.jit(self.model.verify_step, donate_argnums=(2,))
+                        if sc.speculate_k > 0 else None)
+        # the prefill thread's verify is NOT donated: a prefix-cache hit
+        # starts chunked appends from the stored registry cache, which must
+        # survive for future hits
         self._verify_fn = jax.jit(self.model.verify_step)
-        self._verify = self._verify_fn if sc.speculate_k > 0 else None
         if self._verify is not None:
             # zero-seed so acceptance-rate dashboards see the series from
             # pod start, not first acceptance
@@ -206,6 +206,15 @@ class ServingEngine:
         self._insert = jax.jit(LlamaModel.insert_into_slot, donate_argnums=(0,))
         self.total_generated = 0
         self.last_error: Optional[str] = None
+
+    def _fresh_cache(self, batch: int) -> Params:
+        """One construction path for every cache this engine makes (the
+        batch cache, prefill singles, and the post-crash rebuild)."""
+        if self._ring_len is not None:
+            return self.model.init_ring_cache(
+                batch, self._ring_len, quantize=self.sc.quantize_kv_int8)
+        return self.model.init_cache(
+            batch, self.sc.cache_len, quantize=self.sc.quantize_kv_int8)
 
     @staticmethod
     def _pick_ring_len(cfg: LlamaConfig, sc: ServingConfig) -> Optional[int]:
@@ -361,6 +370,13 @@ class ServingEngine:
                         req.future.set_exception(exc)
                 self.metrics.set_gauge("tpu_serving_queue_depth", 0)
                 self.metrics.set_gauge("tpu_serving_active_slots", 0)
+                # LAST, after every in-flight future is failed: the crashed
+                # step may have DONATED the cache buffers before raising, so
+                # decode needs fresh ones. If even this allocation fails
+                # (e.g. the same HBM OOM), the engine thread dies — but no
+                # caller is left hanging, and `alive` flips for the probes.
+                self._cache = self._fresh_cache(self.sc.slots)
+                self._tokens = jnp.zeros((self.sc.slots,), jnp.int32)
 
     def _padded(self, toks: list[int]) -> tuple[jnp.ndarray, jnp.ndarray]:
         """Zero-pad to the compile bucket; returns (tokens (1, bucket),
@@ -408,12 +424,7 @@ class ServingEngine:
             start = len(ptoks)
             self.metrics.incr("tpu_serving_prefix_hits")
         else:
-            if self._ring_len is not None:
-                single = self.model.init_ring_cache(
-                    1, self._ring_len, quantize=self.sc.quantize_kv_int8)
-            else:
-                single = self.model.init_cache(
-                    1, self.sc.cache_len, quantize=self.sc.quantize_kv_int8)
+            single = self._fresh_cache(1)
             head = tokens[:self.sc.max_prefill_len]
             prompt, true_len = self._padded(head)
             last_logits, single = self._prefill(self.params, prompt,

@@ -83,6 +83,43 @@ class TestEngine:
             e.stop()
 
 
+class TestEngineRecovery:
+    def test_step_failure_rebuilds_cache_and_keeps_serving(self, params):
+        """A poisoned decode step fails the in-flight requests AND rebuilds
+        the (donated) cache, so the next request decodes on fresh buffers."""
+        e = ServingEngine(CFG, params,
+                          ServingConfig(slots=2, max_prefill_len=32,
+                                        cache_len=64, max_new_tokens=8)
+                          ).start()
+        try:
+            good = e.submit([5, 9, 2], max_new_tokens=6).result(timeout=60)
+            real_decode = e._decode
+            calls = {"n": 0}
+
+            def bomb(*a, **kw):
+                calls["n"] += 1
+                raise RuntimeError("injected decode failure")
+
+            e._decode = bomb
+            f = e.submit([5, 9, 2], max_new_tokens=6)
+            with pytest.raises(RuntimeError, match="injected"):
+                f.result(timeout=60)
+            assert calls["n"] >= 1
+            e._decode = real_decode
+            # the handler drains the queues AFTER failing f; wait until it
+            # finishes (active slots gauge reset happens at the end) or a
+            # fresh submit could be swept up in the drain
+            deadline = time.time() + 30
+            while (e.active_slots or e.queue_depth) and time.time() < deadline:
+                time.sleep(0.02)
+            time.sleep(0.1)
+            again = e.submit([5, 9, 2], max_new_tokens=6).result(timeout=60)
+            assert again["tokens"] == good["tokens"]  # fresh cache, same model
+            assert e.last_error and "injected" in e.last_error
+        finally:
+            e.stop()
+
+
 class TestPrefillDecodeOverlap:
     def test_decode_cadence_unaffected_by_slow_prefill(self, params):
         """A long prompt's prefill must not stall in-flight decode streams:
