@@ -491,11 +491,14 @@ def _qkv(h, lp, cfg: LlamaConfig, b: int, s: int):
 
 
 def _attention_block(x, lp, cfg: LlamaConfig, cos, sin, mesh, positions=None,
-                     window: Optional[int] = None, return_kv: bool = False):
+                     window: Optional[int] = None, return_kv: bool = False,
+                     ad: Optional[dict] = None,
+                     ad_ids: Optional[jax.Array] = None):
     b, s, e = x.shape
     hd = cfg.head_dim_
     h = rms_norm(x, _norm_w(lp["attn_norm"], cfg), cfg.norm_eps)
     q, k, v = _qkv(h, lp, cfg, b, s)
+    q, k, v = _ml_qkv_deltas(h, q, k, v, ad, ad_ids)  # multi-LoRA serving
     if cfg.qk_norm:  # Gemma-3: per-head RMSNorm on q/k, before RoPE
         q = rms_norm(q, _norm_w(lp["q_norm"], cfg), cfg.norm_eps)
         k = rms_norm(k, _norm_w(lp["k_norm"], cfg), cfg.norm_eps)
@@ -520,7 +523,10 @@ def _attention_block(x, lp, cfg: LlamaConfig, cos, sin, mesh, positions=None,
                             sliding_window=window,
                             logit_soft_cap=cfg.attn_logit_softcap)
     o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * hd)
+    o_in = o
     o = _mm(o, lp["wo"], cfg.dtype)
+    if ad and "wo" in ad:
+        o = o + _ml_delta(o_in, ad["wo"], ad_ids)
     if cfg.post_norms:
         o = rms_norm(o, _norm_w(lp["attn_post_norm"], cfg), cfg.norm_eps)
     if return_kv:
@@ -528,7 +534,9 @@ def _attention_block(x, lp, cfg: LlamaConfig, cos, sin, mesh, positions=None,
     return x + o
 
 
-def _mlp_block(x, lp, cfg: LlamaConfig, mesh, train: bool = True):
+def _mlp_block(x, lp, cfg: LlamaConfig, mesh, train: bool = True,
+               ad: Optional[dict] = None,
+               ad_ids: Optional[jax.Array] = None):
     """Dense SwiGLU/GeGLU MLP, or sparse MoE when cfg.n_experts > 0.
     Returns (residual output, scaled router aux loss — 0.0 for dense).
     ``train=False`` (serving prefill/decode) routes with a no-drop capacity
@@ -549,13 +557,47 @@ def _mlp_block(x, lp, cfg: LlamaConfig, mesh, train: bool = True):
     else:
         gate = _mm(h, lp["w_gate"], cfg.dtype)
         up = _mm(h, lp["w_up"], cfg.dtype)
+        if ad:
+            if "w_gate" in ad:
+                gate = gate + _ml_delta(h, ad["w_gate"], ad_ids)
+            if "w_up" in ad:
+                up = up + _ml_delta(h, ad["w_up"], ad_ids)
         act = _constrain(_activation(cfg)(gate) * up, mesh,
                          ("batch", "seq", "act_mlp"))
         y = _mm(act, lp["w_down"], cfg.dtype)
+        if ad and "w_down" in ad:
+            y = y + _ml_delta(act, ad["w_down"], ad_ids)
         aux = jnp.float32(0.0)
     if cfg.post_norms:
         y = rms_norm(y, _norm_w(lp["mlp_post_norm"], cfg), cfg.norm_eps)
     return x + y, aux
+
+
+def _ml_qkv_deltas(h, q, k, v, ad: Optional[dict], ids):
+    """Apply per-row adapter deltas to the q/k/v projections (one helper so
+    the prefill and decode kernels cannot drift)."""
+    if ad:
+        if "wq" in ad:
+            q = q + _ml_delta(h, ad["wq"], ids).reshape(q.shape)
+        if "wk" in ad:
+            k = k + _ml_delta(h, ad["wk"], ids).reshape(k.shape)
+        if "wv" in ad:
+            v = v + _ml_delta(h, ad["wv"], ids).reshape(v.shape)
+    return q, k, v
+
+
+def _ml_delta(x: jax.Array, ad: dict, ids: jax.Array) -> jax.Array:
+    """Batched multi-LoRA delta with PER-ROW adapter selection (multi-tenant
+    serving: requests in the same decode batch use different adapters).
+    x (B, S, in); ad {"a": (N, in, r), "b": (N, r, out), "scale": (N,)};
+    ids (B,) int32 into the adapter axis. Slot 0 is all-zeros = base model,
+    so "no adapter" needs no conditional. The gathers move only
+    O(B * r * (in + out)) bytes — tiny next to the base matmul."""
+    a_sel = ad["a"][ids].astype(x.dtype)               # (B, in, r)
+    b_sel = ad["b"][ids].astype(x.dtype)               # (B, r, out)
+    d = jnp.einsum("bsi,bir->bsr", x, a_sel)
+    d = jnp.einsum("bsr,bro->bso", d, b_sel)
+    return d * ad["scale"][ids].astype(x.dtype)[:, None, None]
 
 
 def _kv_quant(x: jax.Array) -> tuple[jax.Array, jax.Array]:
@@ -712,7 +754,9 @@ class LlamaModel:
         return cache
 
     def prefill(self, params: Params, tokens: jax.Array, cache: Params,
-                true_length: Optional[jax.Array] = None
+                true_length: Optional[jax.Array] = None,
+                adapters: Optional[dict] = None,
+                adapter_ids: Optional[jax.Array] = None
                 ) -> tuple[jax.Array, Params]:
         """Run the prompt through, filling the cache. Returns (last_logits, cache).
 
@@ -732,23 +776,31 @@ class LlamaModel:
         pat = cfg.sliding_window_pattern
         windows = cfg.layer_windows()
 
-        def block(carry, lp_group):
+        def block(carry, inputs):
             y = carry
+            lp_group = inputs["lp"]
+            ad_group = inputs.get("ad")
             ks, vs = [], []
             for j, win in enumerate(windows):
                 lp = _sublayer(lp_group, j, pat)
+                adj = (_sublayer(ad_group, j, pat)
+                       if ad_group is not None else None)
                 cs, sn = _rope_for(ropes, win)
                 y, k, v = _attention_block(y, lp, cfg, cs, sn, None,
-                                           window=win, return_kv=True)
-                y, _ = _mlp_block(y, lp, cfg, self.mesh, train=False)
+                                           window=win, return_kv=True,
+                                           ad=adj, ad_ids=adapter_ids)
+                y, _ = _mlp_block(y, lp, cfg, self.mesh, train=False,
+                                  ad=adj, ad_ids=adapter_ids)
                 ks.append(k)
                 vs.append(v)
             if pat > 1:
                 return y, (jnp.stack(ks), jnp.stack(vs))
             return y, (ks[0], vs[0])
 
-        x, (k_all, v_all) = jax.lax.scan(block, x,
-                                         _group_layers(params["layers"], pat))
+        xs = {"lp": _group_layers(params["layers"], pat)}
+        if adapters:
+            xs["ad"] = _group_layers(adapters, pat)
+        x, (k_all, v_all) = jax.lax.scan(block, x, xs)
         if pat > 1:  # (L//p, p, B, S, h, d) -> (L, B, S, h, d)
             k_all = k_all.reshape((cfg.n_layers,) + k_all.shape[2:])
             v_all = v_all.reshape((cfg.n_layers,) + v_all.shape[2:])
@@ -775,7 +827,9 @@ class LlamaModel:
         return logits, new_cache
 
     def decode_step(self, params: Params, token: jax.Array, cache: Params,
-                    active: Optional[jax.Array] = None
+                    active: Optional[jax.Array] = None,
+                    adapters: Optional[dict] = None,
+                    adapter_ids: Optional[jax.Array] = None
                     ) -> tuple[jax.Array, Params]:
         """One token per slot: token (B,) -> (logits (B,V), cache).
 
@@ -786,13 +840,16 @@ class LlamaModel:
         plus the index advance the verify path leaves to its caller."""
         if active is None:
             active = jnp.ones((token.shape[0],), bool)
-        logits, cache = self.verify_step(params, token[:, None], cache, active)
+        logits, cache = self.verify_step(params, token[:, None], cache, active,
+                                         adapters, adapter_ids)
         cache = dict(cache)
         cache["index"] = jnp.where(active, cache["index"] + 1, cache["index"])
         return logits[:, 0], cache
 
     def verify_step(self, params: Params, tokens: jax.Array, cache: Params,
-                    active: Optional[jax.Array] = None
+                    active: Optional[jax.Array] = None,
+                    adapters: Optional[dict] = None,
+                    adapter_ids: Optional[jax.Array] = None
                     ) -> tuple[jax.Array, Params]:
         """Speculative-decoding verification: K tokens per slot in ONE pass.
 
@@ -848,10 +905,12 @@ class LlamaModel:
 
         quant = "k_scale" in cache
 
-        def sub_block(y, lp, k_cache, v_cache, k_scale, v_scale, valid, rope):
+        def sub_block(y, lp, k_cache, v_cache, k_scale, v_scale, valid, rope,
+                      adj):
             cos, sin = rope
             h = rms_norm(y, _norm_w(lp["attn_norm"], cfg), cfg.norm_eps)
             q, k, v = _qkv(h, lp, cfg, b, kk)
+            q, k, v = _ml_qkv_deltas(h, q, k, v, adj, adapter_ids)
             if cfg.qk_norm:
                 q = rms_norm(q, _norm_w(lp["q_norm"], cfg), cfg.norm_eps)
                 k = rms_norm(k, _norm_w(lp["k_norm"], cfg), cfg.norm_eps)
@@ -887,22 +946,27 @@ class LlamaModel:
             p = jax.nn.softmax(s, axis=-1)
             o = jnp.einsum("bhgqL,bLhd->bqhgd", p, v_read)
             o = o.reshape(b, kk, cfg.n_heads * cfg.head_dim_).astype(cfg.dtype)
+            o_in = o
             o = _mm(o, lp["wo"], cfg.dtype)
+            if adj and "wo" in adj:
+                o = o + _ml_delta(o_in, adj["wo"], adapter_ids)
             if cfg.post_norms:
                 o = rms_norm(o, _norm_w(lp["attn_post_norm"], cfg),
                              cfg.norm_eps)
             y = y + o
-            y, _ = _mlp_block(y, lp, cfg, self.mesh, train=False)
+            y, _ = _mlp_block(y, lp, cfg, self.mesh, train=False,
+                              ad=adj, ad_ids=adapter_ids)
             return y, k_cache, v_cache, k_scale, v_scale
 
         def block(carry, inputs):
             y = carry
             lp_g, k_g, v_g = inputs["lp"], inputs["k"], inputs["v"]
             ks_g, vs_g = inputs.get("ks"), inputs.get("vs")
+            ad_g = inputs.get("ad")
             if pat == 1:
                 y, k_n, v_n, ks_n, vs_n = sub_block(
                     y, lp_g, k_g, v_g, ks_g, vs_g, masks[0],
-                    _rope_for(ropes, windows[0]))
+                    _rope_for(ropes, windows[0]), ad_g)
                 out = {"k": k_n, "v": v_n}
                 if quant:
                     out["ks"], out["vs"] = ks_n, vs_n
@@ -913,7 +977,8 @@ class LlamaModel:
                     y, _sublayer(lp_g, j, pat), k_g[j], v_g[j],
                     None if ks_g is None else ks_g[j],
                     None if vs_g is None else vs_g[j], masks[j],
-                    _rope_for(ropes, windows[j]))
+                    _rope_for(ropes, windows[j]),
+                    None if ad_g is None else _sublayer(ad_g, j, pat))
                 outs["k"].append(k_n)
                 outs["v"].append(v_n)
                 if quant:
@@ -927,6 +992,8 @@ class LlamaModel:
         if quant:
             xs["ks"] = _group_layers(cache["k_scale"], pat)
             xs["vs"] = _group_layers(cache["v_scale"], pat)
+        if adapters:
+            xs["ad"] = _group_layers(adapters, pat)
         x, new_kv = jax.lax.scan(block, x, xs)
         if pat > 1:  # (L//p, p, B, L, ...) -> (L, B, L, ...)
             new_kv = {kk_: a.reshape((cfg.n_layers,) + a.shape[2:])

@@ -79,6 +79,14 @@ class ServingConfig:
     # registered-prefix cap: each register_prefix() pins one single-slot KV
     # cache in HBM until restart
     max_prefixes: int = 8
+    # multi-LoRA serving (vLLM-style multi-tenant adapters): rank > 0
+    # preallocates zero-filled adapter stacks of this rank over
+    # ``lora_targets`` so adapters register WITHOUT recompiling the decode
+    # jit (the adapter axis is fixed at max_adapters+1; slot 0 = all-zeros
+    # = base model). Requests pick an adapter by name via submit(adapter=).
+    lora_rank: int = 0
+    lora_targets: tuple = ("wq", "wv")
+    max_adapters: int = 8
 
 
 @dataclasses.dataclass
@@ -91,6 +99,7 @@ class Request:
     temperature: float
     top_k: int = 0          # 0 = no top-k filter
     top_p: float = 1.0      # 1.0 = no nucleus filter
+    adapter_id: int = 0     # multi-LoRA slot (0 = base model)
     # stop token SEQUENCES: generation ends when the generated tail equals
     # one (the matched sequence stays in the output; callers strip it).
     # Checked host-side per committed token — no jit impact.
@@ -176,6 +185,34 @@ class ServingEngine:
         self._slots = [_Slot() for _ in range(sc.slots)]
         self._ring_len = self._pick_ring_len(cfg, sc)
         self._cache = self._fresh_cache(sc.slots)
+        # multi-LoRA: preallocated zero stacks; slot 0 stays zero forever
+        # (= base model), so adapter selection needs no conditionals
+        self._adapters: Optional[dict] = None
+        self._adapter_names: dict[str, int] = {}
+        self._adapter_lock = threading.Lock()
+        self._slot_adapter = np.zeros((sc.slots,), np.int32)
+        if sc.lora_rank > 0:
+            e, hd, m = cfg.embed_dim, cfg.head_dim_, cfg.mlp_dim
+            dims = {"wq": (e, cfg.n_heads * hd),
+                    "wk": (e, cfg.n_kv_heads * hd),
+                    "wv": (e, cfg.n_kv_heads * hd),
+                    "wo": (cfg.n_heads * hd, e),
+                    "w_gate": (e, m), "w_up": (e, m), "w_down": (m, e)}
+            unknown = set(sc.lora_targets) - set(dims)
+            if unknown:
+                raise ValueError(f"unknown lora_targets {sorted(unknown)}")
+            if cfg.n_experts and set(sc.lora_targets) & {"w_gate", "w_up",
+                                                         "w_down"}:
+                raise ValueError("MoE configs have no dense mlp weights to "
+                                 "adapt; use attention targets")
+            n = sc.max_adapters + 1
+            self._adapters = {
+                t: {"a": jnp.zeros((cfg.n_layers, n, dims[t][0],
+                                    sc.lora_rank), cfg.dtype),
+                    "b": jnp.zeros((cfg.n_layers, n, sc.lora_rank,
+                                    dims[t][1]), cfg.dtype),
+                    "scale": jnp.zeros((cfg.n_layers, n), jnp.float32)}
+                for t in sc.lora_targets}
         self._tokens = jnp.zeros((sc.slots,), jnp.int32)
         key = jax.random.PRNGKey(seed)
         self._key, self._prefill_key = jax.random.split(key)
@@ -251,7 +288,7 @@ class ServingEngine:
                temperature: Optional[float] = None,
                top_k: int = 0, top_p: float = 1.0,
                stop: Optional[list] = None, logprobs: bool = False,
-               on_token=None) -> Future:
+               adapter: str = "", on_token=None) -> Future:
         """Enqueue a generation request; resolves to {tokens, latency_s, rid}
         (+ per-token "logprobs" when requested). ``on_token(tok)`` streams
         each generated token id as it decodes. ``top_k``/``top_p`` filter
@@ -306,6 +343,15 @@ class ServingEngine:
             f.set_exception(ValueError(
                 "stop must be a list of non-empty token lists"))
             return f
+        adapter_id = 0
+        if adapter:
+            with self._adapter_lock:
+                aid = self._adapter_names.get(adapter)
+            if aid is None:
+                f = Future()
+                f.set_exception(ValueError(f"unknown adapter {adapter!r}"))
+                return f
+            adapter_id = aid
         req = Request(prompt=list(prompt),
                       max_new_tokens=min(max_new_tokens,
                                          self.sc.cache_len - len(prompt)),
@@ -314,7 +360,7 @@ class ServingEngine:
                       temperature=float(temperature),
                       top_k=top_k, top_p=float(top_p),
                       stop=[list(s) for s in stop], logprobs=bool(logprobs),
-                      on_token=on_token)
+                      adapter_id=adapter_id, on_token=on_token)
         self._queue.put(req)
         self.metrics.set_gauge("tpu_serving_queue_depth", self._queue.qsize())
         return req.future
@@ -377,6 +423,7 @@ class ServingEngine:
                 # caller is left hanging, and `alive` flips for the probes.
                 self._cache = self._fresh_cache(self.sc.slots)
                 self._tokens = jnp.zeros((self.sc.slots,), jnp.int32)
+                self._slot_adapter[:] = 0
 
     def _padded(self, toks: list[int]) -> tuple[jnp.ndarray, jnp.ndarray]:
         """Zero-pad to the compile bucket; returns (tokens (1, bucket),
@@ -391,34 +438,51 @@ class ServingEngine:
             b *= 2
         return min(b, self.sc.max_prefill_len)
 
-    def _append_chunks(self, single: Params, toks: list[int], last_logits):
+    def _append_chunks(self, single: Params, toks: list[int], last_logits,
+                       adapter_id: int = 0, adapters: Optional[dict] = None):
         """Append ``toks`` to a single-request cache in max_prefill_len
         chunks through the verify kernel (each chunk's padding KV lands
         beyond the committed index, so it is never attended and is later
-        overwritten — the decode-path invariant). Returns (logits, cache)."""
+        overwritten — the decode-path invariant). Returns (logits, cache).
+        ``adapters`` is the caller's SNAPSHOT of the adapter tree, so one
+        request never mixes weights across a concurrent re-registration."""
+        ad_ids = self._single_ad_ids(adapter_id)
         for start in range(0, len(toks), self.sc.max_prefill_len):
             chunk = toks[start:start + self.sc.max_prefill_len]
             ctoks, _ = self._padded(chunk)
-            logits_k, single = self._verify_fn(self.params, ctoks, single)
+            logits_k, single = self._verify_fn(self.params, ctoks, single,
+                                               None, adapters, ad_ids)
             single = dict(single)
             single["index"] = single["index"] + len(chunk)
             last_logits = logits_k[:, len(chunk) - 1]
         return last_logits, single
 
-    def _prefill_tokens(self, tokens: list[int]) -> tuple[Any, Params]:
+    def _single_ad_ids(self, adapter_id: int):
+        if self._adapters is None:
+            return None
+        return jnp.asarray([adapter_id], jnp.int32)
+
+    def _prefill_tokens(self, tokens: list[int], adapter_id: int = 0
+                        ) -> tuple[Any, Params]:
         """Full prompt -> (last_logits, single-request cache). The head goes
         through the prefill jit (bucketed to a few fixed lengths so it
         compiles once per bucket, not per prompt length); a prompt longer
         than max_prefill_len continues CHUNKED through the verify kernel.
         A registered prefix of the prompt skips straight to its stored
-        cache and appends only the suffix."""
+        cache and appends only the suffix (base-model requests only —
+        prefix KV computed with the base would be wrong under an
+        adapter)."""
         start = 0
         last_logits = None
         single = None
-        with self._prefix_lock:
-            hit = next((p for p in self._prefixes
-                        if len(p[0]) <= len(tokens)
-                        and tokens[:len(p[0])] == p[0]), None)
+        hit = None
+        adapters = self._adapters  # one snapshot per request: a concurrent
+        # re-registration must not mix weights between head and chunks
+        if adapter_id == 0:
+            with self._prefix_lock:
+                hit = next((p for p in self._prefixes
+                            if len(p[0]) <= len(tokens)
+                            and tokens[:len(p[0])] == p[0]), None)
         if hit is not None:
             ptoks, last_logits, single = hit
             start = len(ptoks)
@@ -427,10 +491,68 @@ class ServingEngine:
             single = self._fresh_cache(1)
             head = tokens[:self.sc.max_prefill_len]
             prompt, true_len = self._padded(head)
-            last_logits, single = self._prefill(self.params, prompt,
-                                                single, true_len)
+            last_logits, single = self._prefill(
+                self.params, prompt, single, true_len, adapters,
+                self._single_ad_ids(adapter_id))
             start = len(head)
-        return self._append_chunks(single, tokens[start:], last_logits)
+        return self._append_chunks(single, tokens[start:], last_logits,
+                                   adapter_id, adapters)
+
+    def register_adapter(self, name: str, source) -> None:
+        """Install a LoRA adapter into a free slot of the preallocated
+        stacks (no decode-jit recompile — the adapter axis is fixed).
+        ``source``: a LoRA-wrapped params tree (models.lora.apply_lora /
+        a trained checkpoint) or {target: {"a": (L, in, r), "b": (L, r,
+        out), "scale": (L,) or scalar}}. Targets absent from the source
+        stay zero (base behavior for that projection); targets not in
+        ServingConfig.lora_targets are rejected."""
+        if self._adapters is None:
+            raise ValueError("engine built without lora_rank; set "
+                             "ServingConfig.lora_rank to enable adapters")
+        if not name:
+            raise ValueError("adapter name required")
+        from ..models.lora import is_lora
+        if isinstance(source, dict) and "layers" in source:
+            src = {t: {"a": w["lora_a"], "b": w["lora_b"],
+                       "scale": w["scale"]}
+                   for t, w in source["layers"].items() if is_lora(w)}
+        else:
+            src = source
+        if not src:
+            raise ValueError("source carries no LoRA adapters")
+        extra = set(src) - set(self.sc.lora_targets)
+        if extra:
+            raise ValueError(f"adapter targets {sorted(extra)} not in "
+                             f"lora_targets {self.sc.lora_targets}")
+        with self._adapter_lock:
+            slot = self._adapter_names.get(name)
+            if slot is None:
+                slot = len(self._adapter_names) + 1
+                if slot > self.sc.max_adapters:
+                    raise ValueError(
+                        f"adapter registry full ({self.sc.max_adapters})")
+            new_tree = {}
+            for t, ad in self._adapters.items():
+                if t not in src:
+                    new_tree[t] = ad
+                    continue
+                a = jnp.asarray(src[t]["a"], ad["a"].dtype)
+                bm = jnp.asarray(src[t]["b"], ad["b"].dtype)
+                want_a = ad["a"].shape[0], ad["a"].shape[2], ad["a"].shape[3]
+                if a.shape != want_a or bm.shape != (
+                        ad["b"].shape[0], ad["b"].shape[2], ad["b"].shape[3]):
+                    raise ValueError(
+                        f"{t}: adapter shapes {a.shape}/{bm.shape} don't "
+                        f"match rank-{self.sc.lora_rank} stacks for this "
+                        "model")
+                scale = jnp.broadcast_to(
+                    jnp.asarray(src[t]["scale"], jnp.float32),
+                    (ad["scale"].shape[0],))
+                new_tree[t] = {"a": ad["a"].at[:, slot].set(a),
+                               "b": ad["b"].at[:, slot].set(bm),
+                               "scale": ad["scale"].at[:, slot].set(scale)}
+            self._adapters = new_tree
+            self._adapter_names[name] = slot
 
     def register_prefix(self, tokens: list[int]) -> None:
         """Cache the KV of a shared prompt prefix (system prompt) ONCE; any
@@ -482,7 +604,8 @@ class ServingEngine:
                 continue
             self.metrics.set_gauge("tpu_serving_queue_depth", self._queue.qsize())
             try:
-                last_logits, single = self._prefill_tokens(req.prompt)
+                last_logits, single = self._prefill_tokens(req.prompt,
+                                                           req.adapter_id)
                 self._prefill_key, sub = jax.random.split(self._prefill_key)
                 first = int(_sample(last_logits, sub, [req.temperature],
                                     [req.top_k], [req.top_p])[0])
@@ -517,6 +640,7 @@ class ServingEngine:
             self._cache = self._insert(self._cache, single,
                                        jnp.asarray(slot_id, jnp.int32))
             self._tokens = self._tokens.at[slot_id].set(first)
+            self._slot_adapter[slot_id] = req.adapter_id
             slot.request = req
             slot.generated = [first]
             slot.logprobs = [first_lp] if first_lp is not None else []
@@ -573,9 +697,11 @@ class ServingEngine:
                 n_greedy += 1
             else:
                 toks_in[i, 1:] = slot.last_token  # placeholder, never checked
-        logits, self._cache = self._verify(self.params,
-                                           jnp.asarray(toks_in),
-                                           self._cache, active_mask)
+        logits, self._cache = self._verify(
+            self.params, jnp.asarray(toks_in), self._cache, active_mask,
+            self._adapters,
+            None if self._adapters is None
+            else jnp.asarray(self._slot_adapter))
         greedy_np = np.asarray(jnp.argmax(logits, axis=-1))   # (B, K+1)
         # sampled slots draw token 1 from the same distribution decode_step
         # would have produced (logits[:, 0])
@@ -650,8 +776,11 @@ class ServingEngine:
         if self._verify is not None and self._decode_once_speculative():
             return
         active_mask = jnp.asarray([s.request is not None for s in self._slots])
-        logits, self._cache = self._decode(self.params, self._tokens,
-                                           self._cache, active_mask)
+        logits, self._cache = self._decode(
+            self.params, self._tokens, self._cache, active_mask,
+            self._adapters,
+            None if self._adapters is None
+            else jnp.asarray(self._slot_adapter))
         reqs = [s.request for s in self._slots]
         temps = [r.temperature if r else 0.0 for r in reqs]
         ks = [r.top_k if r else 0 for r in reqs]
@@ -709,6 +838,7 @@ class ServingEngine:
     def _complete(self, slot_id: int, slot: _Slot):
         req = slot.request
         slot.request = None
+        self._slot_adapter[slot_id] = 0
         latency = time.perf_counter() - req.submitted_at
         self.metrics.observe("tpu_serving_request_latency_seconds", latency)
         out = {"rid": req.rid, "tokens": slot.generated, "latency_s": latency}
