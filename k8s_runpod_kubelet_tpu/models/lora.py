@@ -32,7 +32,8 @@ import jax.numpy as jnp
 from .llama import LlamaConfig, Params
 
 __all__ = ["LoraConfig", "apply_lora", "merge_lora", "lora_mask",
-           "is_lora", "lora_param_count"]
+           "is_lora", "lora_param_count", "extract_adapter", "save_adapter",
+           "load_adapter"]
 
 _DEFAULT_TARGETS = ("wq", "wv")  # the original-paper default
 
@@ -143,3 +144,53 @@ def lora_param_count(params: Params) -> int:
         if is_lora(w):
             n += w["lora_a"].size + w["lora_b"].size
     return n
+
+
+def extract_adapter(params: Params) -> dict:
+    """LoRA-wrapped params -> {target: {"a": (L, in, r), "b": (L, r, out),
+    "scale": (L,)}} — the shape the serving engine's register_adapter and
+    the adapter file format share."""
+    out = {}
+    for name, w in params["layers"].items():
+        if is_lora(w):
+            out[name] = {"a": w["lora_a"], "b": w["lora_b"],
+                         "scale": w["scale"]}
+    if not out:
+        raise ValueError("params carry no LoRA adapters")
+    return out
+
+
+def save_adapter(path: str, params_or_adapter) -> str:
+    """Write an adapter to a portable .npz ("wq.a", "wq.b", "wq.scale", ...)
+    — the train -> serve hand-off artifact (a full orbax checkpoint carries
+    the frozen base too; the adapter alone is a few MB). Returns the path
+    actually written: np.savez appends ".npz" itself, so we normalize first
+    rather than report a filename that doesn't exist."""
+    import numpy as np
+    if not path.endswith(".npz"):
+        path += ".npz"
+    src = (extract_adapter(params_or_adapter)
+           if "layers" in params_or_adapter else params_or_adapter)
+    flat = {}
+    for t, ad in src.items():
+        for k in ("a", "b", "scale"):
+            flat[f"{t}.{k}"] = np.asarray(ad[k])
+    np.savez(path, **flat)
+    return path
+
+
+def load_adapter(path: str) -> dict:
+    """Read a save_adapter() .npz back into {target: {"a","b","scale"}}."""
+    import numpy as np
+    with np.load(path) as z:
+        out: dict = {}
+        for key in z.files:
+            t, _, k = key.rpartition(".")
+            if not t or k not in ("a", "b", "scale"):
+                raise ValueError(f"{path}: unexpected entry {key!r}")
+            out.setdefault(t, {})[k] = z[key]
+    for t, ad in out.items():
+        missing = {"a", "b", "scale"} - set(ad)
+        if missing:
+            raise ValueError(f"{path}: {t} missing {sorted(missing)}")
+    return out

@@ -11,10 +11,15 @@ The pod command for autoscaled inference. Endpoints:
                    line per decoded token, then the final result object
                    (JetStream-style streamed decode)
   POST /v1/completions  OpenAI-compatible completions (prompt/max_tokens/
-                   temperature/top_p/stop/stream-SSE), so OpenAI-SDK
-                   clients point here unchanged
+                   temperature/top_p/stop/logprobs/stream-SSE), so
+                   OpenAI-SDK clients point here unchanged; "model" selects
+                   a registered LoRA adapter (vLLM convention)
+  POST /v1/chat/completions  OpenAI chat (messages through the model's own
+                   HF chat template when present), stream or not
   POST /prefix     register a shared prompt prefix (system prompt): its KV
                    prefills once; prompts starting with it skip it
+  POST /adapters   {"name": ..., "path": adapter.npz} — register a trained
+                   LoRA adapter (train_main --export-adapter) live
   GET  /metrics    Prometheus text incl. tpu_serving_queue_depth — the HPA
                    signal (scale on queue depth, BASELINE.json config 5)
   GET  /healthz    liveness
@@ -72,6 +77,11 @@ class _Handler(BaseHTTPRequestHandler):
                               "text/plain; version=0.0.4")
         self._send(404, {"error": f"no route {self.path}"})
 
+    def _read_json(self) -> dict:
+        """One body-parsing idiom for every POST route."""
+        length = int(self.headers.get("Content-Length") or 0)
+        return json.loads(self.rfile.read(length)) if length else {}
+
     def _parse_stop(self, raw) -> list:
         """OpenAI-style ``stop``: a string, list of strings (needs the
         tokenizer), or list of token lists. Returns token sequences,
@@ -103,11 +113,26 @@ class _Handler(BaseHTTPRequestHandler):
             return self._openai_completion(chat=False)
         if self.path == "/v1/chat/completions":
             return self._openai_completion(chat=True)
+        if self.path == "/adapters":
+            # register a LoRA adapter from a save_adapter() .npz so trained
+            # adapters go live without a restart (multi-LoRA serving)
+            try:
+                req = self._read_json()
+                name, path = req.get("name"), req.get("path")
+                if not (isinstance(name, str) and name
+                        and isinstance(path, str) and path):
+                    raise ValueError('need "name" and "path" (adapter .npz)')
+                from ..models.lora import load_adapter
+                self.engine.register_adapter(name, load_adapter(path))
+            except Exception as e:  # noqa: BLE001 — corrupt zips raise
+                # BadZipFile/TypeError/..., not just ValueError; an operator
+                # endpoint must answer 400, not reset the connection
+                return self._send(400, {"error": f"{type(e).__name__}: {e}"})
+            return self._send(200, {"registered": name})
         if self.path not in ("/generate", "/prefix"):
             return self._send(404, {"error": f"no route {self.path}"})
         try:
-            length = int(self.headers.get("Content-Length") or 0)
-            req = json.loads(self.rfile.read(length)) if length else {}
+            req = self._read_json()
             if "text" in req and "tokens" not in req:
                 if self.tokenizer is None:
                     raise ValueError(
@@ -144,7 +169,8 @@ class _Handler(BaseHTTPRequestHandler):
                                  req.get("temperature"),
                                  top_k=_or(req.get("top_k"), 0),
                                  top_p=_or(req.get("top_p"), 1.0),
-                                 stop=stop, logprobs=bool(req.get("logprobs")))
+                                 stop=stop, logprobs=bool(req.get("logprobs")),
+                                 adapter=req.get("adapter") or "")
         try:
             out = fut.result(timeout=self.request_timeout_s)
         except FutureTimeout:
@@ -234,8 +260,7 @@ class _Handler(BaseHTTPRequestHandler):
         stop tail until it is known not to be one."""
         import time as _time
         try:
-            length = int(self.headers.get("Content-Length") or 0)
-            req = json.loads(self.rfile.read(length)) if length else {}
+            req = self._read_json()
             if chat:
                 messages = req.get("messages")
                 if not (isinstance(messages, list) and messages and all(
@@ -266,10 +291,24 @@ class _Handler(BaseHTTPRequestHandler):
             # carry them — don't make the engine compute what we'd discard)
             want_lp = (bool(req.get("logprobs")) and not chat
                        and not req.get("stream"))
+            # vLLM convention: the OpenAI "model" field selects a registered
+            # LoRA adapter; the base model's own name (or an absent field)
+            # serves the base; anything else is a 404-style error rather
+            # than silently serving the wrong tenant's weights
+            model_req = req.get("model") or ""
+            adapter = ""
+            if model_req and model_req != self.engine.cfg.name:
+                if model_req not in self.engine.adapter_names:
+                    return self._send(404, {"error": {
+                        "message": f"model {model_req!r} does not exist "
+                                   "(not the base model or a registered "
+                                   "adapter)",
+                        "type": "invalid_request_error"}})
+                adapter = model_req
             kw = dict(max_new_tokens=req.get("max_tokens"),
                       temperature=_or(req.get("temperature"), 1.0),
                       top_p=_or(req.get("top_p"), 1.0), stop=stop,
-                      logprobs=want_lp)
+                      logprobs=want_lp, adapter=adapter)
         except (json.JSONDecodeError, ValueError, TypeError) as e:
             return self._send(400, {"error": {"message": f"{e}",
                                               "type": "invalid_request_error"}})
@@ -410,7 +449,8 @@ class _Handler(BaseHTTPRequestHandler):
         kw = dict(max_new_tokens=req.get("max_new_tokens"),
                   temperature=req.get("temperature"),
                   top_k=_or(req.get("top_k"), 0),
-                  top_p=_or(req.get("top_p"), 1.0), stop=stop)
+                  top_p=_or(req.get("top_p"), 1.0), stop=stop,
+                  adapter=req.get("adapter") or "")
 
         def line(payload: dict) -> bytes:
             return (json.dumps(payload) + "\n").encode()
@@ -468,6 +508,15 @@ def main(argv=None) -> int:
     p.add_argument("--kv-int8", action="store_true",
                    help="int8 KV cache with per-position scales (halves "
                         "cache HBM traffic and doubles slot capacity)")
+    p.add_argument("--lora-rank", type=int, default=0,
+                   help="enable multi-LoRA serving at this adapter rank; "
+                        "register adapters via POST /adapters and select "
+                        'per request with "adapter" (or the OpenAI "model" '
+                        "field)")
+    p.add_argument("--lora-targets", default="wq,wv",
+                   help="projections the adapters cover (must match how "
+                        "they were trained)")
+    p.add_argument("--max-adapters", type=int, default=8)
     p.add_argument("--ring-cache", default=None,
                    choices=["auto", "on", "off"],
                    help="ring KV cache for sliding-window models: physical "
@@ -512,6 +561,9 @@ def main(argv=None) -> int:
         max_prefill_len=args.cache_len // 2,
         quantize_int8=args.int8,
         quantize_kv_int8=args.kv_int8,
+        lora_rank=args.lora_rank,
+        lora_targets=tuple(t for t in args.lora_targets.split(",") if t),
+        max_adapters=args.max_adapters,
         ring_cache={None: None, "auto": None, "on": True,
                     "off": False}[args.ring_cache],
         speculate_k=args.speculate,

@@ -371,6 +371,11 @@ class ServingEngine:
         return self._thread.is_alive()
 
     @property
+    def adapter_names(self) -> tuple[str, ...]:
+        with self._adapter_lock:
+            return tuple(self._adapter_names)
+
+    @property
     def queue_depth(self) -> int:
         return self._queue.qsize()
 

@@ -51,6 +51,10 @@ def main(argv=None) -> int:
     p.add_argument("--lora-targets", default="wq,wv",
                    help="comma list of projections to adapt "
                         "(wq,wk,wv,wo,w_gate,w_up,w_down)")
+    p.add_argument("--export-adapter", default="",
+                   help="after a --lora-rank run, write the trained adapter "
+                        "alone to this .npz (a few MB) — POST it to the "
+                        "serving /adapters endpoint for multi-LoRA serving")
     p.add_argument("--hf-checkpoint", default="",
                    help="initialize weights from a HuggingFace model "
                         "directory (fine-tune); an orbax checkpoint in "
@@ -69,6 +73,9 @@ def main(argv=None) -> int:
                         "(0 = off); lets an operator capture traces from a "
                         "running worker without restarting it")
     args = p.parse_args(argv)
+    if args.export_adapter and args.lora_rank <= 0:
+        # fail at arg time, not after a multi-hour run
+        p.error("--export-adapter needs --lora-rank")
     logging.basicConfig(level=logging.INFO)
 
     # 1. the gang forms (no-op single process)
@@ -178,6 +185,12 @@ def main(argv=None) -> int:
             loader.close()
     if args.checkpoint_dir:
         trainer.save()
+    if args.export_adapter and pe.process_id == 0:
+        # adapters are fully replicated across the mesh (apply_lora), so
+        # process 0 holds every value even on multi-host runs
+        from ..models.lora import save_adapter
+        written = save_adapter(args.export_adapter, trainer.params)
+        log.info("adapter written to %s", written)
 
     if args.eval_steps > 0:
         out.update(trainer.evaluate(steps=args.eval_steps))

@@ -163,6 +163,62 @@ class TestMultiLora:
         with pytest.raises(ValueError, match="lora_rank"):
             e.register_adapter("a", _trained_lora(params, seed=1))
 
+    def test_adapter_file_roundtrip_and_http_flow(self, params, tmp_path):
+        """The full operator loop: export a trained adapter to .npz,
+        register it over POST /adapters, select it via "adapter" on
+        /generate and the OpenAI "model" field — outputs equal the
+        merged-model reference."""
+        import json
+        import urllib.request
+        from k8s_runpod_kubelet_tpu.models.lora import (load_adapter,
+                                                        save_adapter)
+        from k8s_runpod_kubelet_tpu.workloads.serve_main import serve
+        wrapped = _trained_lora(params, seed=5)
+        path = str(tmp_path / "tenant.npz")
+        save_adapter(path, wrapped)
+        ad = load_adapter(path)
+        assert set(ad) == set(TARGETS)
+        e = _engine(params)
+        httpd = serve(e, 0)
+        port = httpd.server_address[1]
+
+        def post(route, payload):
+            r = urllib.request.Request(
+                f"http://127.0.0.1:{port}{route}",
+                json.dumps(payload).encode(),
+                {"Content-Type": "application/json"})
+            return json.loads(urllib.request.urlopen(r, timeout=60).read())
+
+        try:
+            assert post("/adapters", {"name": "tenant",
+                                      "path": path}) == {"registered": "tenant"}
+            prompt = [5, 9, 2, 77]
+            ref = _greedy_merged(wrapped, prompt, 8)
+            out = post("/generate", {"tokens": prompt, "max_new_tokens": 8,
+                                     "adapter": "tenant"})
+            assert out["tokens"] == ref
+            oa = post("/v1/completions", {"model": "tenant", "prompt": prompt,
+                                          "max_tokens": 8, "temperature": 0})
+            assert oa["usage"]["completion_tokens"] == 8
+            base = post("/generate", {"tokens": prompt, "max_new_tokens": 8})
+            assert base["tokens"] != ref  # adapter actually selected
+            # unknown model name -> 404, never a silent base fallback
+            import urllib.error
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                post("/v1/completions", {"model": "typo", "prompt": prompt,
+                                         "max_tokens": 4})
+            assert ei.value.code == 404
+            # corrupt adapter file -> clean 400
+            bad = str(tmp_path / "bad.npz")
+            with open(bad, "w") as f:
+                f.write("not a zip")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                post("/adapters", {"name": "bad", "path": bad})
+            assert ei.value.code == 400
+        finally:
+            httpd.shutdown()
+            e.stop()
+
     def test_reregister_replaces_in_place(self, params):
         w1 = _trained_lora(params, seed=1)
         w2 = _trained_lora(params, seed=2)
