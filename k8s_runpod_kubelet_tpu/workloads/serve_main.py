@@ -50,6 +50,7 @@ class _Handler(BaseHTTPRequestHandler):
     engine = None  # bound below
     tokenizer = None  # bound below; None = token-ids-only API
     request_timeout_s = 120.0
+    allow_adapters = False  # POST /adapters opt-in (--dynamic-adapters)
     # chunked transfer framing is an HTTP/1.1 construct; 1.0 clients would
     # read raw chunk framing as the body (non-stream responses all send
     # Content-Length, so keep-alive stays correct)
@@ -115,7 +116,14 @@ class _Handler(BaseHTTPRequestHandler):
             return self._openai_completion(chat=True)
         if self.path == "/adapters":
             # register a LoRA adapter from a save_adapter() .npz so trained
-            # adapters go live without a restart (multi-LoRA serving)
+            # adapters go live without a restart (multi-LoRA serving).
+            # Opt-in only (--dynamic-adapters): this endpoint makes the
+            # server open a caller-chosen filesystem path and hot-swap live
+            # tenant weights — vLLM gates its equivalent the same way.
+            if not self.allow_adapters:
+                return self._send(403, {
+                    "error": "dynamic adapter registration is disabled "
+                             "(start with --dynamic-adapters)"})
             try:
                 req = self._read_json()
                 name, path = req.get("name"), req.get("path")
@@ -126,8 +134,13 @@ class _Handler(BaseHTTPRequestHandler):
                 self.engine.register_adapter(name, load_adapter(path))
             except Exception as e:  # noqa: BLE001 — corrupt zips raise
                 # BadZipFile/TypeError/..., not just ValueError; an operator
-                # endpoint must answer 400, not reset the connection
-                return self._send(400, {"error": f"{type(e).__name__}: {e}"})
+                # endpoint must answer 400, not reset the connection. Log
+                # the detail server-side; don't hand path-probing oracles
+                # (FileNotFoundError vs BadZipFile) to the client.
+                log.warning("adapter registration failed: %s: %s",
+                            type(e).__name__, e)
+                return self._send(400, {"error": "adapter registration "
+                                                 "failed (see server log)"})
             return self._send(200, {"registered": name})
         if self.path not in ("/generate", "/prefix"):
             return self._send(404, {"error": f"no route {self.path}"})
@@ -291,13 +304,16 @@ class _Handler(BaseHTTPRequestHandler):
             # carry them — don't make the engine compute what we'd discard)
             want_lp = (bool(req.get("logprobs")) and not chat
                        and not req.get("stream"))
-            # vLLM convention: the OpenAI "model" field selects a registered
-            # LoRA adapter; the base model's own name (or an absent field)
-            # serves the base; anything else is a 404-style error rather
-            # than silently serving the wrong tenant's weights
+            # vLLM convention: with multi-LoRA enabled, the OpenAI "model"
+            # field selects a registered adapter; the base model's own name
+            # (or an absent field) serves the base, and an unknown name is a
+            # 404 rather than silently serving the wrong tenant's weights.
+            # WITHOUT multi-LoRA the field stays echo-only (clients often
+            # send HF repo ids or placeholders — don't break them).
             model_req = req.get("model") or ""
             adapter = ""
-            if model_req and model_req != self.engine.cfg.name:
+            if (self.engine.multi_lora_enabled and model_req
+                    and model_req != self.engine.cfg.name):
                 if model_req not in self.engine.adapter_names:
                     return self._send(404, {"error": {
                         "message": f"model {model_req!r} does not exist "
@@ -473,10 +489,10 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 def serve(engine, port: int = 8000, request_timeout_s: float = 120.0,
-          tokenizer=None):
+          tokenizer=None, allow_adapters: bool = False):
     handler = type("BoundHandler", (_Handler,),
                    {"engine": engine, "request_timeout_s": request_timeout_s,
-                    "tokenizer": tokenizer})
+                    "tokenizer": tokenizer, "allow_adapters": allow_adapters})
     httpd = ThreadingHTTPServer(("0.0.0.0", port), handler)
     thread = threading.Thread(target=httpd.serve_forever, daemon=True)
     thread.start()
@@ -517,6 +533,11 @@ def main(argv=None) -> int:
                    help="projections the adapters cover (must match how "
                         "they were trained)")
     p.add_argument("--max-adapters", type=int, default=8)
+    p.add_argument("--dynamic-adapters", action="store_true",
+                   help="enable POST /adapters (live adapter registration "
+                        "from a server-readable .npz path) — off by "
+                        "default because it lets API clients load "
+                        "filesystem paths and replace live tenant weights")
     p.add_argument("--ring-cache", default=None,
                    choices=["auto", "on", "off"],
                    help="ring KV cache for sliding-window models: physical "
@@ -570,7 +591,8 @@ def main(argv=None) -> int:
         # text mode stops at the tokenizer's EOS instead of always burning
         # the full max_new_tokens budget
         eos_token=(tokenizer.eos_id if tokenizer is not None else -1))).start()
-    httpd = serve(engine, args.port, tokenizer=tokenizer)
+    httpd = serve(engine, args.port, tokenizer=tokenizer,
+                  allow_adapters=args.dynamic_adapters)
     log.info("serving on :%d (POST /generate, GET /metrics)", args.port)
     try:
         threading.Event().wait()

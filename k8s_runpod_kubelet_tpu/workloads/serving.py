@@ -376,6 +376,10 @@ class ServingEngine:
             return tuple(self._adapter_names)
 
     @property
+    def multi_lora_enabled(self) -> bool:
+        return self._adapters is not None
+
+    @property
     def queue_depth(self) -> int:
         return self._queue.qsize()
 

@@ -179,7 +179,7 @@ class TestMultiLora:
         ad = load_adapter(path)
         assert set(ad) == set(TARGETS)
         e = _engine(params)
-        httpd = serve(e, 0)
+        httpd = serve(e, 0, allow_adapters=True)
         port = httpd.server_address[1]
 
         def post(route, payload):
@@ -215,6 +215,28 @@ class TestMultiLora:
             with pytest.raises(urllib.error.HTTPError) as ei:
                 post("/adapters", {"name": "bad", "path": bad})
             assert ei.value.code == 400
+        finally:
+            httpd.shutdown()
+            e.stop()
+
+    def test_adapters_endpoint_requires_opt_in(self, params):
+        """POST /adapters is 403 unless --dynamic-adapters: it loads
+        server-filesystem paths and hot-swaps live tenant weights."""
+        import json
+        import urllib.error
+        import urllib.request
+        from k8s_runpod_kubelet_tpu.workloads.serve_main import serve
+        e = _engine(params)
+        httpd = serve(e, 0)  # default: disabled
+        port = httpd.server_address[1]
+        try:
+            r = urllib.request.Request(
+                f"http://127.0.0.1:{port}/adapters",
+                json.dumps({"name": "x", "path": "/etc/passwd"}).encode(),
+                {"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(r, timeout=30)
+            assert ei.value.code == 403
         finally:
             httpd.shutdown()
             e.stop()
