@@ -73,6 +73,16 @@ class _Handler(BaseHTTPRequestHandler):
             if not self.engine.alive:
                 return self._send(503, b"engine thread dead", "text/plain")
             return self._send(200, b"ok", "text/plain")
+        if self.path == "/v1/models":
+            # OpenAI model listing: the base model plus registered adapters
+            import time as _time
+            now = int(_time.time())
+            data = [{"id": self.engine.cfg.name, "object": "model",
+                     "created": now, "owned_by": "base"}]
+            data += [{"id": n, "object": "model", "created": now,
+                      "owned_by": "adapter"}
+                     for n in self.engine.adapter_names]
+            return self._send(200, {"object": "list", "data": data})
         if self.path == "/metrics":
             return self._send(200, self.engine.metrics.render().encode(),
                               "text/plain; version=0.0.4")
@@ -183,7 +193,8 @@ class _Handler(BaseHTTPRequestHandler):
                                  top_k=_or(req.get("top_k"), 0),
                                  top_p=_or(req.get("top_p"), 1.0),
                                  stop=stop, logprobs=bool(req.get("logprobs")),
-                                 adapter=req.get("adapter") or "")
+                                 adapter=req.get("adapter") or "",
+                                 seed=req.get("seed"))
         try:
             out = fut.result(timeout=self.request_timeout_s)
         except FutureTimeout:
@@ -324,7 +335,8 @@ class _Handler(BaseHTTPRequestHandler):
             kw = dict(max_new_tokens=req.get("max_tokens"),
                       temperature=_or(req.get("temperature"), 1.0),
                       top_p=_or(req.get("top_p"), 1.0), stop=stop,
-                      logprobs=want_lp, adapter=adapter)
+                      logprobs=want_lp, adapter=adapter,
+                      seed=req.get("seed"))
         except (json.JSONDecodeError, ValueError, TypeError) as e:
             return self._send(400, {"error": {"message": f"{e}",
                                               "type": "invalid_request_error"}})
@@ -466,7 +478,7 @@ class _Handler(BaseHTTPRequestHandler):
                   temperature=req.get("temperature"),
                   top_k=_or(req.get("top_k"), 0),
                   top_p=_or(req.get("top_p"), 1.0), stop=stop,
-                  adapter=req.get("adapter") or "")
+                  adapter=req.get("adapter") or "", seed=req.get("seed"))
 
         def line(payload: dict) -> bytes:
             return (json.dumps(payload) + "\n").encode()

@@ -106,6 +106,14 @@ class Request:
     stop: list = dataclasses.field(default_factory=list)
     # return per-token log P(token | prefix) of each generated token
     logprobs: bool = False
+    # sampling seed (resolved at submit): the PRNG stream is a pure
+    # function of (seed, draw index), independent of slot placement and
+    # neighbors. On speculative engines bit-exactness additionally needs
+    # the logits to be batch-independent — a bf16 near-tie can round
+    # differently between the K-wide and 1-wide kernels (ServingConfig.
+    # speculate_k caveat), so there "same seed = same distribution" is
+    # the hard guarantee and exact tokens the overwhelmingly common case.
+    seed: int = 0
     # streaming: called with each generated token id, from the engine thread.
     # A raising callback (client gone) cancels the request at the next token.
     on_token: Optional[Any] = None
@@ -120,13 +128,22 @@ class _Slot:
     last_token: int = 0
 
 
-def _sample(logits: jax.Array, key: jax.Array, temps: list[float],
+def _row_keys(seeds: jax.Array, draws: jax.Array) -> jax.Array:
+    """Per-row PRNG keys from (request seed, samples drawn so far): sampling
+    is reproducible PER REQUEST (OpenAI ``seed``) and independent of which
+    slot a request lands in or what else shares the batch."""
+    def one(s, d):
+        return jax.random.fold_in(jax.random.PRNGKey(s), d)
+    return jax.vmap(one)(seeds, draws)
+
+
+def _sample(logits: jax.Array, keys: jax.Array, temps: list[float],
             top_ks: Optional[list[int]] = None,
             top_ps: Optional[list[float]] = None) -> jax.Array:
-    """Per-row temperature + top-k + nucleus (top-p) sampling. Pure: callers
-    (engine decode thread, prefill thread) pass their own PRNG key. Filters
-    operate on the temperature-scaled distribution; the (B, V) sort is cheap
-    at serving batch sizes (JetStream does the same)."""
+    """Per-row temperature + top-k + nucleus (top-p) sampling with PER-ROW
+    PRNG keys (``keys`` (B, 2) from _row_keys). Filters operate on the
+    temperature-scaled distribution; the (B, V) sort is cheap at serving
+    batch sizes (JetStream does the same)."""
     greedy = jnp.argmax(logits, axis=-1)
     if all(t <= 0.0 for t in temps):
         return greedy
@@ -137,7 +154,7 @@ def _sample(logits: jax.Array, key: jax.Array, temps: list[float],
     scaled = (logits / t).astype(jnp.float32)
     if all(k <= 0 for k in top_ks) and all(p >= 1.0 for p in top_ps):
         # unfiltered fast path: no (B, V) sort on the per-token hot loop
-        sampled = jax.random.categorical(key, scaled, axis=-1)
+        sampled = jax.vmap(jax.random.categorical)(keys, scaled)
     else:
         sorted_desc = -jnp.sort(-scaled, axis=-1)              # (B, V) desc
         # top-k threshold: the k-th largest logit (k=0 -> keep all)
@@ -153,7 +170,7 @@ def _sample(logits: jax.Array, key: jax.Array, temps: list[float],
         thresh_p = jnp.take_along_axis(sorted_desc, idx_p[:, None], axis=-1)
         thresh = jnp.maximum(thresh_k, thresh_p)
         filtered = jnp.where(scaled >= thresh, scaled, -jnp.inf)
-        sampled = jax.random.categorical(key, filtered, axis=-1)
+        sampled = jax.vmap(jax.random.categorical)(keys, filtered)
     use_sampled = jnp.asarray([tt > 0.0 for tt in temps])
     return jnp.where(use_sampled, sampled, greedy)
 
@@ -185,6 +202,10 @@ class ServingEngine:
         self._slots = [_Slot() for _ in range(sc.slots)]
         self._ring_len = self._pick_ring_len(cfg, sc)
         self._cache = self._fresh_cache(sc.slots)
+        # per-slot sampling state: (request seed, draws so far) -> PRNG key
+        self._slot_seed = np.zeros((sc.slots,), np.uint32)
+        self._slot_draws = np.zeros((sc.slots,), np.int32)
+        self._row_keys = jax.jit(_row_keys)
         # multi-LoRA: preallocated zero stacks; slot 0 stays zero forever
         # (= base model), so adapter selection needs no conditionals
         self._adapters: Optional[dict] = None
@@ -214,8 +235,11 @@ class ServingEngine:
                     "scale": jnp.zeros((cfg.n_layers, n), jnp.float32)}
                 for t in sc.lora_targets}
         self._tokens = jnp.zeros((sc.slots,), jnp.int32)
-        key = jax.random.PRNGKey(seed)
-        self._key, self._prefill_key = jax.random.split(key)
+        # requests without an explicit seed draw one from this stream, so
+        # an engine built with the same seed handling the same requests in
+        # the same order is deterministic end to end
+        self._seed_rng = np.random.default_rng(seed)
+        self._seed_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, name="serving-engine",
                                         daemon=True)
@@ -288,13 +312,16 @@ class ServingEngine:
                temperature: Optional[float] = None,
                top_k: int = 0, top_p: float = 1.0,
                stop: Optional[list] = None, logprobs: bool = False,
-               adapter: str = "", on_token=None) -> Future:
+               adapter: str = "", seed: Optional[int] = None,
+               on_token=None) -> Future:
         """Enqueue a generation request; resolves to {tokens, latency_s, rid}
         (+ per-token "logprobs" when requested). ``on_token(tok)`` streams
         each generated token id as it decodes. ``top_k``/``top_p`` filter
         the sampling distribution per request (active only when
         temperature > 0). ``stop``: list of token sequences; generation
-        ends when the output tail equals one."""
+        ends when the output tail equals one. ``seed`` makes sampling
+        reproducible for this request regardless of slot placement or
+        co-resident traffic."""
         if not prompt:
             f: Future = Future()
             f.set_exception(ValueError("empty prompt"))
@@ -352,6 +379,13 @@ class ServingEngine:
                 f.set_exception(ValueError(f"unknown adapter {adapter!r}"))
                 return f
             adapter_id = aid
+        if seed is None:
+            with self._seed_lock:
+                seed = int(self._seed_rng.integers(0, 2 ** 32))
+        elif not isinstance(seed, int) or isinstance(seed, bool):
+            f = Future()
+            f.set_exception(ValueError(f"seed must be an int, got {seed!r}"))
+            return f
         req = Request(prompt=list(prompt),
                       max_new_tokens=min(max_new_tokens,
                                          self.sc.cache_len - len(prompt)),
@@ -360,7 +394,8 @@ class ServingEngine:
                       temperature=float(temperature),
                       top_k=top_k, top_p=float(top_p),
                       stop=[list(s) for s in stop], logprobs=bool(logprobs),
-                      adapter_id=adapter_id, on_token=on_token)
+                      adapter_id=adapter_id, seed=seed & 0xFFFFFFFF,
+                      on_token=on_token)
         self._queue.put(req)
         self.metrics.set_gauge("tpu_serving_queue_depth", self._queue.qsize())
         return req.future
@@ -615,8 +650,9 @@ class ServingEngine:
             try:
                 last_logits, single = self._prefill_tokens(req.prompt,
                                                            req.adapter_id)
-                self._prefill_key, sub = jax.random.split(self._prefill_key)
-                first = int(_sample(last_logits, sub, [req.temperature],
+                keys = self._row_keys(jnp.asarray([req.seed], jnp.uint32),
+                                      jnp.asarray([0], jnp.int32))
+                first = int(_sample(last_logits, keys, [req.temperature],
                                     [req.top_k], [req.top_p])[0])
                 first_lp = None
                 if req.logprobs:
@@ -650,6 +686,8 @@ class ServingEngine:
                                        jnp.asarray(slot_id, jnp.int32))
             self._tokens = self._tokens.at[slot_id].set(first)
             self._slot_adapter[slot_id] = req.adapter_id
+            self._slot_seed[slot_id] = req.seed
+            self._slot_draws[slot_id] = 1  # draw 0 was the prefill token
             slot.request = req
             slot.generated = [first]
             slot.logprobs = [first_lp] if first_lp is not None else []
@@ -820,8 +858,12 @@ class ServingEngine:
     def _sample_batch(self, logits: jax.Array, temps: list[float],
                       top_ks: Optional[list[int]] = None,
                       top_ps: Optional[list[float]] = None) -> jax.Array:
-        self._key, sub = jax.random.split(self._key)
-        return _sample(logits, sub, temps, top_ks, top_ps)
+        """Per-slot keys from (request seed, draws so far); one draw is
+        consumed per call for every slot (greedy slots ignore theirs)."""
+        keys = self._row_keys(jnp.asarray(self._slot_seed),
+                              jnp.asarray(self._slot_draws))
+        self._slot_draws += 1
+        return _sample(logits, keys, temps, top_ks, top_ps)
 
     def _emit(self, slot: _Slot, tok: int):
         """Stream a token to the requester; a raising callback means the

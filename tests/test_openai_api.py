@@ -260,6 +260,42 @@ class TestOpenAiCompletions:
         assert payloads[-1]["choices"][0]["finish_reason"] in ("length",
                                                                "stop")
 
+    def test_seed_reproducible_sampling(self, server):
+        """Same seed + temperature => identical sampled output; different
+        seeds diverge (vocab 300, 10 tokens — collision odds ~0)."""
+        body = {"prompt": [5, 9, 2], "max_tokens": 10, "temperature": 1.0,
+                "seed": 1234}
+        a = _post(server, "/v1/completions", body)
+        b = _post(server, "/v1/completions", body)
+        assert a["choices"][0]["text"] == b["choices"][0]["text"]
+        c = _post(server, "/v1/completions", {**body, "seed": 99})
+        assert c["choices"][0]["text"] != a["choices"][0]["text"]
+
+    def test_seed_independent_of_batch_neighbors(self, params):
+        """A seeded request returns the same tokens whether it runs alone
+        or next to other sampled traffic (per-slot key streams)."""
+        e = ServingEngine(CFG, params,
+                          ServingConfig(slots=2, max_prefill_len=16,
+                                        cache_len=64, max_new_tokens=10)
+                          ).start()
+        try:
+            alone = e.submit([5, 9, 2], max_new_tokens=10, temperature=1.0,
+                             seed=777).result(timeout=60)
+            futs = [e.submit([8, 8, 8], max_new_tokens=10, temperature=0.9),
+                    e.submit([5, 9, 2], max_new_tokens=10, temperature=1.0,
+                             seed=777)]
+            crowded = futs[1].result(timeout=60)
+            futs[0].result(timeout=60)
+            assert crowded["tokens"] == alone["tokens"]
+        finally:
+            e.stop()
+
+    def test_models_listing(self, server):
+        out = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{server}/v1/models", timeout=30).read())
+        assert out["object"] == "list"
+        assert out["data"][0]["id"] == CFG.name
+
     def test_chat_bad_messages(self, server):
         with pytest.raises(urllib.error.HTTPError) as ei:
             _post(server, "/v1/chat/completions", {"messages": "nope"})
