@@ -748,7 +748,7 @@ class ServingEngine:
             self.params, jnp.asarray(toks_in), self._cache, active_mask,
             self._adapters,
             None if self._adapters is None
-            else jnp.asarray(self._slot_adapter))
+            else jnp.asarray(self._slot_adapter.copy()))
         greedy_np = np.asarray(jnp.argmax(logits, axis=-1))   # (B, K+1)
         # sampled slots draw token 1 from the same distribution decode_step
         # would have produced (logits[:, 0])
@@ -827,7 +827,7 @@ class ServingEngine:
             self.params, self._tokens, self._cache, active_mask,
             self._adapters,
             None if self._adapters is None
-            else jnp.asarray(self._slot_adapter))
+            else jnp.asarray(self._slot_adapter.copy()))
         reqs = [s.request for s in self._slots]
         temps = [r.temperature if r else 0.0 for r in reqs]
         ks = [r.top_k if r else 0 for r in reqs]
@@ -859,9 +859,15 @@ class ServingEngine:
                       top_ks: Optional[list[int]] = None,
                       top_ps: Optional[list[float]] = None) -> jax.Array:
         """Per-slot keys from (request seed, draws so far); one draw is
-        consumed per call for every slot (greedy slots ignore theirs)."""
-        keys = self._row_keys(jnp.asarray(self._slot_seed),
-                              jnp.asarray(self._slot_draws))
+        consumed per call for every slot (greedy slots ignore theirs).
+
+        The .copy() calls are LOAD-BEARING: jax's CPU backend may zero-copy
+        alias a numpy input as the device buffer, so handing it the live
+        bookkeeping arrays (mutated by += below / _admit) lets the in-place
+        write race the still-in-flight async computation — a one-draw slip
+        that breaks seed reproducibility once in ~dozens of requests."""
+        keys = self._row_keys(jnp.asarray(self._slot_seed.copy()),
+                              jnp.asarray(self._slot_draws.copy()))
         self._slot_draws += 1
         return _sample(logits, keys, temps, top_ks, top_ps)
 
