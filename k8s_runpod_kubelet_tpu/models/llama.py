@@ -753,6 +753,42 @@ class LlamaModel:
         cache["abs_pos"] = jnp.full((batch, ring_len), -1, jnp.int32)
         return cache
 
+    def init_mixed_cache(self, batch: int, max_len: int,
+                         ring_len: int) -> Params:
+        """Split cache for local/global interleave models (Gemma-2/3):
+        LOCAL (windowed) sublayers get a ring of ``ring_len`` slots (they
+        can never attend further back than the window), GLOBAL sublayers
+        keep the full ``max_len``. For gemma3-12b (5 local : 1 global,
+        W=1024) this cuts cache memory ~6x at long contexts. Layout:
+        "k_l"/"v_l" (n_local, B, R, h, d) in LAYER-GROUP ORDER (group g's
+        local sublayers are rows g*(p-1)..), "k_g"/"v_g" (n_global, B,
+        max_len, h, d); one shared "abs_pos" ring ownership map (every
+        local layer writes the same slots). Same write-slack contract as
+        init_ring_cache."""
+        cfg = self.cfg
+        p = cfg.sliding_window_pattern
+        if cfg.sliding_window is None or p <= 1:
+            raise ValueError("mixed cache requires a windowed interleave "
+                             "(sliding_window set and pattern > 1); use "
+                             "init_ring_cache/init_cache instead")
+        if ring_len <= cfg.sliding_window:
+            raise ValueError(f"ring_len {ring_len} must exceed the window "
+                             f"{cfg.sliding_window} (write slack)")
+        if cfg.n_layers % p:
+            raise ValueError(f"n_layers {cfg.n_layers} not divisible by "
+                             f"pattern {p}")
+        n_groups = cfg.n_layers // p
+        n_local = n_groups * (p - 1)
+        h, d = cfg.n_kv_heads, cfg.head_dim_
+        return {
+            "k_l": jnp.zeros((n_local, batch, ring_len, h, d), cfg.dtype),
+            "v_l": jnp.zeros((n_local, batch, ring_len, h, d), cfg.dtype),
+            "k_g": jnp.zeros((n_groups, batch, max_len, h, d), cfg.dtype),
+            "v_g": jnp.zeros((n_groups, batch, max_len, h, d), cfg.dtype),
+            "index": jnp.zeros((batch,), jnp.int32),
+            "abs_pos": jnp.full((batch, ring_len), -1, jnp.int32),
+        }
+
     def prefill(self, params: Params, tokens: jax.Array, cache: Params,
                 true_length: Optional[jax.Array] = None,
                 adapters: Optional[dict] = None,
@@ -807,6 +843,30 @@ class LlamaModel:
         x = rms_norm(x, _norm_w(params["final_norm"], cfg), cfg.norm_eps)
         last = x[jnp.arange(b), true_length - 1]  # (B, E): each row's last real token
         logits = _head_logits(last, params, cfg)
+        if "k_l" in cache:  # mixed local/global split cache (Gemma-2/3)
+            ring = cache["k_l"].shape[2]
+            max_g = cache["k_g"].shape[2]
+            if s > ring or s > max_g:
+                raise ValueError(f"prompt chunk {s} exceeds cache sections "
+                                 f"(ring {ring}, global {max_g})")
+            n_groups = cfg.n_layers // pat
+            grouped_k = k_all.reshape((n_groups, pat) + k_all.shape[1:])
+            grouped_v = v_all.reshape((n_groups, pat) + v_all.shape[1:])
+            loc_shape = (n_groups * (pat - 1),) + k_all.shape[1:]
+            pad_l = [(0, 0), (0, 0), (0, ring - s), (0, 0), (0, 0)]
+            pad_g = [(0, 0), (0, 0), (0, max_g - s), (0, 0), (0, 0)]
+            slot_ids = jnp.arange(ring)[None, :]
+            return logits, {
+                "k_l": jnp.pad(grouped_k[:, :pat - 1].reshape(loc_shape),
+                               pad_l),
+                "v_l": jnp.pad(grouped_v[:, :pat - 1].reshape(loc_shape),
+                               pad_l),
+                "k_g": jnp.pad(grouped_k[:, pat - 1], pad_g),
+                "v_g": jnp.pad(grouped_v[:, pat - 1], pad_g),
+                "index": true_length.astype(jnp.int32),
+                "abs_pos": jnp.where(slot_ids < true_length[:, None],
+                                     slot_ids, -1).astype(jnp.int32),
+            }
         max_len = cache["k"].shape[2]
         if s > max_len:
             raise ValueError(f"prompt length {s} exceeds cache length "
@@ -873,40 +933,53 @@ class LlamaModel:
         ropes = _rope_tables(cfg)
         x = _embed(params, tokens, cfg, self.mesh)                 # (B,K,E)
         positions = idx[:, None] + jnp.arange(kk)[None, :]         # (B,K)
-        max_len = cache["k"].shape[2]
         pat = cfg.sliding_window_pattern
         windows = cfg.layer_windows()
         batch_ids = jnp.arange(b)[:, None]                         # (B,1)
-        ring = "abs_pos" in cache
-        if ring:
+        mixed = "k_l" in cache   # split local-ring/global cache (Gemma-2/3)
+        ring = (not mixed) and "abs_pos" in cache
+
+        def ring_state(ring_len):
             # ring addressing: position p writes slot p % R; the mask comes
-            # from abs_pos AFTER this call's writes (every layer writes the
-            # same slots, so one abs_pos array serves the whole scan). Slots
-            # holding not-yet-committed draft positions (> idx+j) fail the
-            # causal test, so rejected-draft garbage stays invisible until
-            # genuinely overwritten.
-            slots = positions % max_len                            # (B,K)
-            old_abs = cache["abs_pos"][batch_ids, slots]
-            new_abs = cache["abs_pos"].at[batch_ids, slots].set(
+            # from abs_pos AFTER this call's writes (every ring layer writes
+            # the same slots, so one abs_pos array serves the whole scan).
+            # Slots holding not-yet-committed draft positions (> idx+j) fail
+            # the causal test, so rejected-draft garbage stays invisible
+            # until genuinely overwritten.
+            slots_r = positions % ring_len                         # (B,K)
+            old_abs = cache["abs_pos"][batch_ids, slots_r]
+            return slots_r, cache["abs_pos"].at[batch_ids, slots_r].set(
                 jnp.where(active[:, None], positions, old_abs))
-            pos_l = new_abs[:, None, :]                            # (B,1,R)
+
+        def make_mask(pos_l, win):
+            # (B,1,1,K,L): query j of slot b attends positions <= idx[b]+j
+            cv = (pos_l >= 0) & (pos_l <= positions[:, :, None])
+            if win is not None:
+                cv &= (positions[:, :, None] - pos_l) < win
+            return cv[:, None, None]
+
+        new_abs = None
+        if mixed:
+            slots_loc, new_abs = ring_state(cache["k_l"].shape[2])
+            pos_loc = new_abs[:, None, :]
+            pos_glob = jnp.arange(cache["k_g"].shape[2])[None, None, :]
+            masks = [make_mask(pos_loc if windows[j] is not None else pos_glob,
+                               windows[j]) for j in range(pat)]
+            slot_map = [slots_loc if windows[j] is not None else positions
+                        for j in range(pat)]
+        elif ring:
+            slots_r, new_abs = ring_state(cache["k"].shape[2])
+            masks = [make_mask(new_abs[:, None, :], win) for win in windows]
+            slot_map = [slots_r] * pat
         else:
-            slots = positions
-            new_abs = None
-            pos_l = jnp.arange(max_len)[None, None, :]
-        # (B,1,1,K,L): query j of slot b attends cache positions <= idx[b]+j;
-        # one STATIC mask per sublayer window (Gemma-2 local/global interleave)
-        causal_valid = (pos_l >= 0) & (pos_l <= positions[:, :, None])
-        masks = []
-        for win in windows:
-            m = causal_valid if win is None else (
-                causal_valid & ((positions[:, :, None] - pos_l) < win))
-            masks.append(m[:, None, None])
+            pos_l = jnp.arange(cache["k"].shape[2])[None, None, :]
+            masks = [make_mask(pos_l, win) for win in windows]
+            slot_map = [positions] * pat
 
         quant = "k_scale" in cache
 
         def sub_block(y, lp, k_cache, v_cache, k_scale, v_scale, valid, rope,
-                      adj):
+                      adj, slots):
             cos, sin = rope
             h = rms_norm(y, _norm_w(lp["attn_norm"], cfg), cfg.norm_eps)
             q, k, v = _qkv(h, lp, cfg, b, kk)
@@ -960,13 +1033,35 @@ class LlamaModel:
 
         def block(carry, inputs):
             y = carry
-            lp_g, k_g, v_g = inputs["lp"], inputs["k"], inputs["v"]
-            ks_g, vs_g = inputs.get("ks"), inputs.get("vs")
+            lp_g = inputs["lp"]
             ad_g = inputs.get("ad")
+            if mixed:
+                kl, vl = inputs["kl"], inputs["vl"]   # (p-1, B, R, h, d)
+                kgl, vgl = inputs["kg"], inputs["vg"]  # (B, G, h, d)
+                kl_out, vl_out = [], []
+                kg_out = vg_out = None
+                for j in range(pat):
+                    local = windows[j] is not None
+                    y, k_n, v_n, _, _ = sub_block(
+                        y, _sublayer(lp_g, j, pat),
+                        kl[j] if local else kgl,
+                        vl[j] if local else vgl,
+                        None, None, masks[j], _rope_for(ropes, windows[j]),
+                        None if ad_g is None else _sublayer(ad_g, j, pat),
+                        slot_map[j])
+                    if local:
+                        kl_out.append(k_n)
+                        vl_out.append(v_n)
+                    else:
+                        kg_out, vg_out = k_n, v_n
+                return y, {"kl": jnp.stack(kl_out), "vl": jnp.stack(vl_out),
+                           "kg": kg_out, "vg": vg_out}
+            k_g, v_g = inputs["k"], inputs["v"]
+            ks_g, vs_g = inputs.get("ks"), inputs.get("vs")
             if pat == 1:
                 y, k_n, v_n, ks_n, vs_n = sub_block(
                     y, lp_g, k_g, v_g, ks_g, vs_g, masks[0],
-                    _rope_for(ropes, windows[0]), ad_g)
+                    _rope_for(ropes, windows[0]), ad_g, slot_map[0])
                 out = {"k": k_n, "v": v_n}
                 if quant:
                     out["ks"], out["vs"] = ks_n, vs_n
@@ -978,7 +1073,8 @@ class LlamaModel:
                     None if ks_g is None else ks_g[j],
                     None if vs_g is None else vs_g[j], masks[j],
                     _rope_for(ropes, windows[j]),
-                    None if ad_g is None else _sublayer(ad_g, j, pat))
+                    None if ad_g is None else _sublayer(ad_g, j, pat),
+                    slot_map[j])
                 outs["k"].append(k_n)
                 outs["v"].append(v_n)
                 if quant:
@@ -986,20 +1082,36 @@ class LlamaModel:
                     outs["vs"].append(vs_n)
             return y, {kk_: jnp.stack(v_) for kk_, v_ in outs.items() if v_}
 
-        xs = {"lp": _group_layers(params["layers"], pat),
-              "k": _group_layers(cache["k"], pat),
-              "v": _group_layers(cache["v"], pat)}
-        if quant:
-            xs["ks"] = _group_layers(cache["k_scale"], pat)
-            xs["vs"] = _group_layers(cache["v_scale"], pat)
+        xs = {"lp": _group_layers(params["layers"], pat)}
+        if mixed:
+            n_groups = cfg.n_layers // pat
+            xs["kl"] = cache["k_l"].reshape(
+                (n_groups, pat - 1) + cache["k_l"].shape[1:])
+            xs["vl"] = cache["v_l"].reshape(
+                (n_groups, pat - 1) + cache["v_l"].shape[1:])
+            xs["kg"] = cache["k_g"]
+            xs["vg"] = cache["v_g"]
+        else:
+            xs["k"] = _group_layers(cache["k"], pat)
+            xs["v"] = _group_layers(cache["v"], pat)
+            if quant:
+                xs["ks"] = _group_layers(cache["k_scale"], pat)
+                xs["vs"] = _group_layers(cache["v_scale"], pat)
         if adapters:
             xs["ad"] = _group_layers(adapters, pat)
         x, new_kv = jax.lax.scan(block, x, xs)
+        x = rms_norm(x, _norm_w(params["final_norm"], cfg), cfg.norm_eps)
+        logits = _head_logits(x, params, cfg).astype(jnp.float32)  # (B,K,V)
+        if mixed:
+            nl = new_kv["kl"]  # (n_groups, p-1, B, R, h, d)
+            out = {"k_l": nl.reshape((-1,) + nl.shape[2:]),
+                   "v_l": new_kv["vl"].reshape((-1,) + nl.shape[2:]),
+                   "k_g": new_kv["kg"], "v_g": new_kv["vg"],
+                   "index": idx, "abs_pos": new_abs}
+            return logits, out
         if pat > 1:  # (L//p, p, B, L, ...) -> (L, B, L, ...)
             new_kv = {kk_: a.reshape((cfg.n_layers,) + a.shape[2:])
                       for kk_, a in new_kv.items()}
-        x = rms_norm(x, _norm_w(params["final_norm"], cfg), cfg.norm_eps)
-        logits = _head_logits(x, params, cfg).astype(jnp.float32)  # (B,K,V)
         out = {"k": new_kv["k"], "v": new_kv["v"], "index": idx}
         if quant:
             out["k_scale"], out["v_scale"] = new_kv["ks"], new_kv["vs"]
@@ -1012,14 +1124,12 @@ class LlamaModel:
                          ) -> Params:
         """Place a freshly-prefilled single-request cache (batch 1) into slot
         ``slot`` of the serving cache (continuous batching admission)."""
-        out = {
-            "k": cache["k"].at[:, slot].set(single["k"][:, 0]),
-            "v": cache["v"].at[:, slot].set(single["v"][:, 0]),
-            "index": cache["index"].at[slot].set(single["index"][0]),
-        }
-        for extra in ("k_scale", "v_scale"):
-            if extra in cache:
-                out[extra] = cache[extra].at[:, slot].set(single[extra][:, 0])
+        out = {"index": cache["index"].at[slot].set(single["index"][0])}
+        # every stacked-KV section shares the (layers, batch, ...) layout
+        for sect in ("k", "v", "k_l", "v_l", "k_g", "v_g",
+                     "k_scale", "v_scale"):
+            if sect in cache:
+                out[sect] = cache[sect].at[:, slot].set(single[sect][:, 0])
         if "abs_pos" in cache:
             out["abs_pos"] = cache["abs_pos"].at[slot].set(single["abs_pos"][0])
         return out

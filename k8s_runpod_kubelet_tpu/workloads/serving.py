@@ -272,6 +272,10 @@ class ServingEngine:
         """One construction path for every cache this engine makes (the
         batch cache, prefill singles, and the post-crash rebuild)."""
         if self._ring_len is not None:
+            if self.cfg.sliding_window_pattern > 1:
+                # Gemma-2/3: ring for local sublayers, full for global
+                return self.model.init_mixed_cache(batch, self.sc.cache_len,
+                                                   self._ring_len)
             return self.model.init_ring_cache(
                 batch, self._ring_len, quantize=self.sc.quantize_kv_int8)
         return self.model.init_cache(
@@ -279,17 +283,26 @@ class ServingEngine:
 
     @staticmethod
     def _pick_ring_len(cfg: LlamaConfig, sc: ServingConfig) -> Optional[int]:
-        """Physical ring size, or None for a plain linear cache. The slack
-        term is the most tokens one prefill/verify call can write — the ring
-        invariant (init_ring_cache docstring) that keeps every in-window
-        entry alive across chunked prefill and speculative rejections."""
-        windowed = (cfg.sliding_window is not None
-                    and cfg.sliding_window_pattern == 1)
+        """Physical ring size for the windowed layers, or None for a plain
+        linear cache. The slack term is the most tokens one prefill/verify
+        call can write — the ring invariant (init_ring_cache docstring) that
+        keeps every in-window entry alive across chunked prefill and
+        speculative rejections. Uniform-window models (Mistral) ring every
+        layer; interleave models (Gemma-2/3) get the SPLIT cache — rings
+        for local sublayers, full length for global ones — which doesn't
+        compose with the int8 KV cache yet."""
+        windowed = cfg.sliding_window is not None
+        mixed = windowed and cfg.sliding_window_pattern > 1
         if sc.ring_cache is False or (sc.ring_cache is None and not windowed):
             return None
         if not windowed:
-            raise ValueError("ring_cache=True needs a model with a uniform "
+            raise ValueError("ring_cache=True needs a model with a "
                              "sliding window")
+        if mixed and sc.quantize_kv_int8:
+            if sc.ring_cache:  # explicit request that can't be honored
+                raise ValueError("the split (mixed) cache does not support "
+                                 "quantize_kv_int8 yet")
+            return None
         slack = max(sc.max_prefill_len, sc.speculate_k + 1)
         ring = -(-(cfg.sliding_window + slack) // 128) * 128
         if sc.ring_cache is None and ring >= sc.cache_len:

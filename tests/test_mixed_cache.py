@@ -1,0 +1,154 @@
+"""Split (mixed) KV cache for local/global interleave models (Gemma-2/3):
+ring-sized caches for windowed sublayers, full length only for global ones.
+Correctness bar: decode parity with the full forward past the ring
+wraparound, and engine-output equality with the linear cache."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_runpod_kubelet_tpu.models import LlamaModel, init_params, tiny_llama
+from k8s_runpod_kubelet_tpu.workloads.serving import ServingConfig, ServingEngine
+
+# Gemma-2-shaped tiny config: W=8 local / global alternating, soft caps,
+# sandwich norms; ring R=16 wraps quickly
+G2 = tiny_llama(name="tiny-g2", vocab_size=128, embed_dim=64, n_layers=4,
+                n_heads=4, n_kv_heads=2, head_dim=32, mlp_dim=128,
+                max_seq_len=256, sliding_window=8, sliding_window_pattern=2,
+                attn_logit_softcap=50.0, query_pre_attn_scalar=64.0,
+                post_norms=True, logit_softcap=30.0,
+                dtype=jnp.float32, param_dtype=jnp.float32)
+RING = 16
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(G2, jax.random.PRNGKey(0))
+
+
+class TestMixedCacheModel:
+    def test_shapes_and_validation(self, params):
+        model = LlamaModel(G2)
+        c = model.init_mixed_cache(2, 64, RING)
+        assert c["k_l"].shape == (2, 2, RING, 2, 32)   # 2 local layers
+        assert c["k_g"].shape == (2, 2, 64, 2, 32)     # 2 global layers
+        assert c["abs_pos"].shape == (2, RING)
+        with pytest.raises(ValueError, match="exceed the window"):
+            model.init_mixed_cache(1, 64, 8)
+        uni = tiny_llama(vocab_size=64, embed_dim=32, n_layers=2, n_heads=2,
+                         n_kv_heads=1, mlp_dim=48, sliding_window=8)
+        with pytest.raises(ValueError, match="interleave"):
+            LlamaModel(uni).init_mixed_cache(1, 64, 16)
+
+    def test_decode_matches_forward_past_wraparound(self, params):
+        """Logical position runs to 40 on a 16-slot local ring (2.5 wraps);
+        the global layers keep full history — every decoded logit must
+        match the windowed-interleave full forward."""
+        model = LlamaModel(G2)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (2, 40), 0, 128)
+        full = model.forward(params, toks)
+        cache = model.init_mixed_cache(2, 64, RING)
+        last, cache = model.prefill(params, toks[:, :6], cache)
+        np.testing.assert_allclose(np.asarray(last), np.asarray(full[:, 5]),
+                                   rtol=2e-3, atol=2e-3)
+        for i in range(6, 40):
+            logits, cache = model.decode_step(params, toks[:, i], cache)
+            np.testing.assert_allclose(
+                np.asarray(logits), np.asarray(full[:, i]),
+                rtol=2e-3, atol=2e-3, err_msg=f"position {i}")
+
+    def test_mixed_equals_linear_cache(self, params):
+        model = LlamaModel(G2)
+        toks = jax.random.randint(jax.random.PRNGKey(2), (1, 30), 0, 128)
+        mc = model.init_mixed_cache(1, 64, RING)
+        lc = model.init_cache(1, 64)
+        l_m, mc = model.prefill(params, toks[:, :4], mc)
+        l_l, lc = model.prefill(params, toks[:, :4], lc)
+        np.testing.assert_allclose(np.asarray(l_m), np.asarray(l_l),
+                                   rtol=1e-5, atol=1e-5)
+        for i in range(4, 30):
+            o_m, mc = model.decode_step(params, toks[:, i], mc)
+            o_l, lc = model.decode_step(params, toks[:, i], lc)
+            np.testing.assert_allclose(np.asarray(o_m), np.asarray(o_l),
+                                       rtol=1e-5, atol=1e-5,
+                                       err_msg=f"position {i}")
+
+    def test_verify_rejection_stays_exact(self, params):
+        """Speculative shape on the mixed cache: rejected drafts must stay
+        invisible in BOTH sections."""
+        model = LlamaModel(G2)
+        verify = jax.jit(model.verify_step)
+        toks = jax.random.randint(jax.random.PRNGKey(3), (1, 30), 0, 128)
+        full = model.forward(params, toks)
+        cache = model.init_mixed_cache(1, 64, RING)
+        _, cache = model.prefill(params, toks[:, :6], cache)
+        i = 6
+        while i < 28:
+            tin = jnp.concatenate([toks[:, i:i + 1],
+                                   jnp.full((1, 3), 99, jnp.int32)], axis=1)
+            logits, cache = verify(params, tin, cache)
+            np.testing.assert_allclose(np.asarray(logits[:, 0]),
+                                       np.asarray(full[:, i]),
+                                       rtol=2e-3, atol=2e-3,
+                                       err_msg=f"verify at {i}")
+            cache = dict(cache)
+            cache["index"] = cache["index"] + 1
+            i += 1
+            logits, cache = model.decode_step(params, toks[:, i], cache)
+            np.testing.assert_allclose(np.asarray(logits),
+                                       np.asarray(full[:, i]),
+                                       rtol=2e-3, atol=2e-3,
+                                       err_msg=f"decode at {i}")
+            i += 1
+
+
+class TestMixedCacheEngine:
+    def _engine(self, params, **kw):
+        sc = ServingConfig(slots=2, max_prefill_len=16, cache_len=256,
+                           max_new_tokens=24, **kw)
+        return ServingEngine(G2, params, sc).start()
+
+    def test_auto_on_and_matches_linear_engine(self, params):
+        e_mixed = self._engine(params)           # auto: windowed interleave
+        e_lin = self._engine(params, ring_cache=False)
+        try:
+            assert "k_l" in e_mixed._cache and "k" in e_lin._cache
+            # memory win: local layers hold R=128 not 256 slots
+            assert e_mixed._cache["k_l"].shape[2] == 128
+            prompts = [[(7 * j + i) % 128 for j in range(1 + 5 * i)]
+                       for i in range(4)]
+            for p in prompts:
+                a = e_mixed.submit(p, max_new_tokens=24).result(timeout=60)
+                b = e_lin.submit(p, max_new_tokens=24).result(timeout=60)
+                assert a["tokens"] == b["tokens"], p
+        finally:
+            e_mixed.stop()
+            e_lin.stop()
+
+    def test_speculative_on_mixed(self, params):
+        e_m = self._engine(params, speculate_k=3)
+        e_l = self._engine(params, ring_cache=False, speculate_k=3)
+        try:
+            prompt = [3, 1, 4, 1, 5, 9, 2, 6, 3, 1, 4, 1, 5]
+            a = e_m.submit(prompt, max_new_tokens=20).result(timeout=60)
+            b = e_l.submit(prompt, max_new_tokens=20).result(timeout=60)
+            assert a["tokens"] == b["tokens"]
+        finally:
+            e_m.stop()
+            e_l.stop()
+
+    def test_kv_int8_falls_back_to_linear(self, params):
+        e = self._engine(params, quantize_kv_int8=True)
+        try:
+            assert e._ring_len is None and "k" in e._cache
+            out = e.submit([1, 2, 3], max_new_tokens=4).result(timeout=60)
+            assert len(out["tokens"]) == 4
+        finally:
+            e.stop()
+        with pytest.raises(ValueError, match="mixed"):
+            ServingEngine(G2, params,
+                          ServingConfig(slots=1, ring_cache=True,
+                                        quantize_kv_int8=True))

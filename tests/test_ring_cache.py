@@ -177,7 +177,7 @@ class TestRingCacheEngine:
                          n_kv_heads=1, mlp_dim=48, dtype=jnp.float32,
                          param_dtype=jnp.float32)
         p = init_params(cfg, jax.random.PRNGKey(0))
-        with pytest.raises(ValueError, match="uniform sliding window"):
+        with pytest.raises(ValueError, match="sliding window"):
             ServingEngine(cfg, p, ServingConfig(slots=1, ring_cache=True))
 
     def test_auto_off_when_no_memory_win(self, params):
