@@ -16,6 +16,13 @@ config 5). TPU-first decisions:
 - **HPA signal**: queue depth + slot utilization are exported via Metrics; the
   Helm chart scales serving pods on tpu_serving_queue_depth (SURVEY.md §5.5
   gap — the reference has no metrics at all).
+- **Cache economics**: the engine cache is DONATED through the decode jit
+  (in-place updates, not full-cache copies per step); sliding-window models
+  ring at O(window) memory (Gemma-2/3 interleaves split local-ring/
+  global-full); optional int8 KV halves cache read bandwidth.
+- **Multi-tenant**: prefix caching (shared system prompts prefill once),
+  multi-LoRA (per-request adapters inside one decode batch), per-request
+  seeds/stop sequences/logprobs, speculative decoding.
 
 Threading: callers submit() from anywhere; one engine thread owns the model
 state (JAX objects never cross threads mid-step).
