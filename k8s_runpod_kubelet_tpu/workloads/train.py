@@ -31,6 +31,9 @@ class TrainConfig:
     warmup_steps: int = 100
     weight_decay: float = 0.1
     grad_clip: float = 1.0
+    # MaxText-style z-loss coefficient (0 = off; 1e-4 at scale): penalizes
+    # log Z^2 of the LM head so logit magnitudes stay bounded in bf16
+    z_loss_coef: float = 0.0
     batch_size: int = 8          # GLOBAL batch per optimizer step
     seq_len: int = 512
     steps: int = 100
@@ -45,10 +48,23 @@ class TrainConfig:
 
 def cross_entropy_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
     """Mean next-token NLL. logits (B,S,V) f32/bf16, targets (B,S) int32."""
+    ce, _ = _ce_and_zloss(logits, targets, 0.0)
+    return ce
+
+
+def _ce_and_zloss(logits: jax.Array, targets: jax.Array,
+                  z_loss_coef: float) -> tuple[jax.Array, jax.Array]:
+    """(mean NLL, z-loss term), SHARING one logsumexp reduction: the CE is
+    lse - picked_logit (== -log_softmax[target]) and the MaxText-style
+    z-loss is coef * mean(lse^2) — no second O(B*S*V) pass over the
+    step's largest activation."""
     logits = logits.astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    lse = jax.nn.logsumexp(logits, axis=-1)                # (B,S)
+    picked = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    ce = jnp.mean(lse - picked)
+    z = (z_loss_coef * jnp.mean(jnp.square(lse)) if z_loss_coef
+         else jnp.float32(0.0))
+    return ce, z
 
 
 def make_optimizer(tc: TrainConfig, trainable_mask=None
@@ -75,7 +91,7 @@ def make_optimizer(tc: TrainConfig, trainable_mask=None
 
 def make_train_step(model: LlamaModel, optimizer: optax.GradientTransformation,
                     donate: bool = True, trainable_mask=None,
-                    grad_accum_steps: int = 1):
+                    grad_accum_steps: int = 1, z_loss_coef: float = 0.0):
     """Returns jitted (params, opt_state, batch) -> (params, opt_state, metrics).
     batch: tokens (B, S+1) — inputs are [:, :-1], targets [:, 1:].
     ``trainable_mask``: frozen (False) leaves are stop_gradient'd INSIDE the
@@ -92,14 +108,18 @@ def make_train_step(model: LlamaModel, optimizer: optax.GradientTransformation,
                 p = jax.tree_util.tree_map(
                     lambda leaf, m: leaf if m else jax.lax.stop_gradient(leaf),
                     p, trainable_mask)
-            # optimize CE + router aux, but report them separately so MoE
-            # loss curves stay comparable to dense runs (exp(loss) = ppl)
+            # optimize CE + router aux (+ z-loss), but report CE separately
+            # so MoE/z-loss loss curves stay comparable (exp(loss) = ppl)
             if model.cfg.n_experts:
                 logits, aux = model.forward(p, inputs, with_aux=True)
-                ce = cross_entropy_loss(logits, targets)
-                return ce + aux, (ce, aux)
-            ce = cross_entropy_loss(model.forward(p, inputs), targets)
-            return ce, (ce, jnp.float32(0.0))
+            else:
+                logits = model.forward(p, inputs)
+                aux = jnp.float32(0.0)
+            # z-loss keeps logit magnitudes from drifting (bf16 LM heads
+            # saturate without it at scale); its logsumexp is shared with
+            # the CE computation
+            ce, z = _ce_and_zloss(logits, targets, z_loss_coef)
+            return ce + aux + z, (ce, aux)
 
         (_, (ce, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         return ce, aux, grads
@@ -218,7 +238,8 @@ class Trainer:
         self.opt_state = self.optimizer.init(self.params)
         self.step_fn = make_train_step(self.model, self.optimizer,
                                        trainable_mask=mask,
-                                       grad_accum_steps=tc.grad_accum_steps)
+                                       grad_accum_steps=tc.grad_accum_steps,
+                                       z_loss_coef=tc.z_loss_coef)
         self.step = 0
         self._eval_fn = None
         self._ckpt = None

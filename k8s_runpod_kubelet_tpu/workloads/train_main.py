@@ -32,6 +32,9 @@ def main(argv=None) -> int:
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--seq-len", type=int, default=2048)
     p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--z-loss", type=float, default=0.0,
+                   help="z-loss coefficient (MaxText uses 1e-4 at scale): "
+                        "keeps LM-head logit magnitudes bounded in bf16")
     p.add_argument("--tensor", type=int, default=1)
     p.add_argument("--seq", type=int, default=1, help="sequence-parallel degree")
     p.add_argument("--stage", type=int, default=1, help="pipeline-parallel degree")
@@ -129,6 +132,7 @@ def main(argv=None) -> int:
                  "*grad_accum=%d)", args.batch, batch, multiple)
     tc = TrainConfig(learning_rate=args.lr, batch_size=batch,
                      seq_len=args.seq_len, steps=args.steps,
+                     z_loss_coef=args.z_loss,
                      grad_accum_steps=args.grad_accum,
                      checkpoint_dir=args.checkpoint_dir,
                      checkpoint_every=args.checkpoint_every)

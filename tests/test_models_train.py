@@ -416,6 +416,27 @@ class TestTraining:
             np.log(10), rel=1e-5)
 
 
+class TestZLoss:
+    def test_z_loss_bounds_logit_magnitude(self):
+        """Training WITH z-loss keeps mean |log Z| smaller than without,
+        while the reported loss stays the plain CE (curves comparable)."""
+        tc_kw = dict(batch_size=4, seq_len=32, steps=60, warmup_steps=5,
+                     learning_rate=3e-3)
+        outs = {}
+        for name, coef in (("plain", 0.0), ("zloss", 1e-2)):
+            tc = TrainConfig(z_loss_coef=coef, **tc_kw)
+            trainer = Trainer(CFG, tc, seed=0)
+            batches = synthetic_batches(CFG, tc)
+            out = trainer.run(steps=60, batches=batches)
+            logits = trainer.model.forward(trainer.params,
+                                           next(batches)[:, :-1])
+            lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+            outs[name] = (out["final_loss"], float(jnp.mean(jnp.abs(lse))))
+        assert outs["zloss"][1] < outs["plain"][1]
+        # reported loss is CE only: same order of magnitude either way
+        assert abs(outs["zloss"][0] - outs["plain"][0]) < 1.0
+
+
 class TestGradAccumAndEval:
     def _cfg(self):
         import dataclasses
