@@ -25,3 +25,22 @@ except ImportError:  # pragma: no cover — jax-free environment
     pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _bound_xla_compiler_state():
+    """Clear jax's compilation caches between test MODULES: the full
+    suite accumulates hundreds of distinct CPU-backend executables and
+    the XLA CPU compiler has been observed to segfault (inside
+    backend_compile_and_load) only deep into such runs — never when the
+    same tests run standalone. Per-module clearing bounds that state at
+    a small recompile cost; module-scoped fixtures (params trees etc.)
+    are plain arrays and survive just fine."""
+    yield
+    try:
+        import jax as _jax
+        _jax.clear_caches()
+    except Exception:  # pragma: no cover — jax-free environment
+        pass
