@@ -198,6 +198,7 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             out = fut.result(timeout=self.request_timeout_s)
         except FutureTimeout:
+            fut.cancel()  # engine frees the slot at its next step
             return self._send(504, {"error": "generation timed out"})
         except ValueError as e:
             return self._send(400, {"error": str(e)})
@@ -251,8 +252,11 @@ class _Handler(BaseHTTPRequestHandler):
                     kind, val = q.get(timeout=remaining)
                 except _q.Empty:
                     # deadline passed: tell the client and stop the
-                    # engine-side request (the non-stream paths' 504)
+                    # engine-side request (the non-stream paths' 504).
+                    # cancel() covers a request still QUEUED (on_token never
+                    # fires there, so dead alone would never reach it)
                     dead.set()
+                    fut.cancel()
                     for body in fmt["timeout"]():
                         chunk(body)
                     break
@@ -311,6 +315,17 @@ class _Handler(BaseHTTPRequestHandler):
             if not tokens:
                 raise ValueError("empty prompt")
             stop = self._parse_stop(req.get("stop"))
+            n = req.get("n")
+            n = 1 if n is None else n
+            if not isinstance(n, int) or isinstance(n, bool) \
+                    or not 1 <= n <= 16:
+                raise ValueError(f"n must be an int in [1, 16], got {n!r}")
+            if n > 1 and req.get("stream"):
+                raise ValueError("streaming supports n=1")
+            seed = req.get("seed")
+            if seed is not None and (not isinstance(seed, int)
+                                     or isinstance(seed, bool)):
+                raise ValueError(f"seed must be an int, got {seed!r}")
             # logprobs: completions-only, non-stream only (SSE chunks don't
             # carry them — don't make the engine compute what we'd discard)
             want_lp = (bool(req.get("logprobs")) and not chat
@@ -335,8 +350,7 @@ class _Handler(BaseHTTPRequestHandler):
             kw = dict(max_new_tokens=req.get("max_tokens"),
                       temperature=_or(req.get("temperature"), 1.0),
                       top_p=_or(req.get("top_p"), 1.0), stop=stop,
-                      logprobs=want_lp, adapter=adapter,
-                      seed=req.get("seed"))
+                      logprobs=want_lp, adapter=adapter, seed=seed)
         except (json.JSONDecodeError, ValueError, TypeError) as e:
             return self._send(400, {"error": {"message": f"{e}",
                                               "type": "invalid_request_error"}})
@@ -438,34 +452,52 @@ class _Handler(BaseHTTPRequestHandler):
                  "badreq": lambda msg: {"error": {
                      "message": msg, "type": "invalid_request_error"}}})
 
-        fut = self.engine.submit(tokens, **kw)
+        # n choices = n engine requests sharing the continuous batch; with
+        # an explicit seed each choice offsets it so the samples differ
+        # (OpenAI's n returns distinct samples, not n copies)
+        base_seed = kw.pop("seed", None)
+        futs = []
+        for i in range(n):
+            seed_i = None if base_seed is None else base_seed + i
+            futs.append(self.engine.submit(tokens, seed=seed_i, **kw))
+        deadline = _time.monotonic() + self.request_timeout_s  # SHARED:
+        # per-future timeouts would let n=16 hold the connection 16x longer
         try:
-            out = fut.result(timeout=self.request_timeout_s)
+            outs = [f.result(timeout=max(0.0, deadline - _time.monotonic()))
+                    for f in futs]
         except FutureTimeout:
+            for f in futs:
+                f.cancel()  # engine frees the slots at their next step
             return self._send(504, {"error": {"message": "generation timed out",
                                               "type": "timeout"}})
         except ValueError as e:
+            for f in futs:
+                f.cancel()
             return self._send(400, {"error": {"message": str(e),
                                               "type": "invalid_request_error"}})
-        reason, toks = finish_reason(out["tokens"])
-        if chat:
-            choice: dict = {"index": 0, "finish_reason": reason,
-                            "message": {"role": "assistant",
-                                        "content": decode(toks)}}
-        else:
-            choice = {"text": decode(toks), "index": 0,
-                      "logprobs": None, "finish_reason": reason}
-            if kw["logprobs"]:
-                choice["logprobs"] = {
-                    "token_logprobs": out.get("logprobs", [])[:len(toks)],
-                    "tokens": [decode([t]) for t in toks],
-                    "top_logprobs": None}
+        choices = []
+        for i, out in enumerate(outs):
+            reason, toks = finish_reason(out["tokens"])
+            if chat:
+                choice: dict = {"index": i, "finish_reason": reason,
+                                "message": {"role": "assistant",
+                                            "content": decode(toks)}}
+            else:
+                choice = {"text": decode(toks), "index": i,
+                          "logprobs": None, "finish_reason": reason}
+                if kw["logprobs"]:
+                    choice["logprobs"] = {
+                        "token_logprobs": out.get("logprobs", [])[:len(toks)],
+                        "tokens": [decode([t]) for t in toks],
+                        "top_logprobs": None}
+            choices.append(choice)
+        gen_tokens = sum(len(o["tokens"]) for o in outs)
         return self._send(200, {
             "id": rid, "object": obj, "created": created,
-            "model": model_name, "choices": [choice],
+            "model": model_name, "choices": choices,
             "usage": {"prompt_tokens": len(tokens),
-                      "completion_tokens": len(out["tokens"]),
-                      "total_tokens": len(tokens) + len(out["tokens"])}})
+                      "completion_tokens": gen_tokens,
+                      "total_tokens": len(tokens) + gen_tokens}})
 
     def _generate_stream(self, tokens: list, req: dict):
         """Chunked NDJSON over the shared pump: one {"token": N} line per

@@ -135,6 +135,17 @@ class _Slot:
     last_token: int = 0
 
 
+def _fail_future(fut: Future, exc: BaseException) -> None:
+    """set_exception tolerant of a client cancel landing between a done()
+    check and the call — InvalidStateError here must never kill an engine
+    or prefill thread."""
+    try:
+        if not fut.done():
+            fut.set_exception(exc)
+    except Exception:  # noqa: BLE001 — racing future.cancel()
+        pass
+
+
 def _row_keys(seeds: jax.Array, draws: jax.Array) -> jax.Array:
     """Per-row PRNG keys from (request seed, samples drawn so far): sampling
     is reproducible PER REQUEST (OpenAI ``seed``) and independent of which
@@ -462,22 +473,20 @@ class ServingEngine:
                 self.metrics.incr("tpu_serving_engine_errors")
                 for slot in self._slots:
                     req, slot.request = slot.request, None
-                    if req is not None and not req.future.done():
-                        req.future.set_exception(exc)
+                    if req is not None:
+                        _fail_future(req.future, exc)
                 while True:
                     try:
                         req = self._queue.get_nowait()
                     except queue.Empty:
                         break
-                    if not req.future.done():
-                        req.future.set_exception(exc)
+                    _fail_future(req.future, exc)
                 while True:
                     try:
                         req, *_ = self._ready.get_nowait()
                     except queue.Empty:
                         break
-                    if not req.future.done():
-                        req.future.set_exception(exc)
+                    _fail_future(req.future, exc)
                 self.metrics.set_gauge("tpu_serving_queue_depth", 0)
                 self.metrics.set_gauge("tpu_serving_active_slots", 0)
                 # LAST, after every in-flight future is failed: the crashed
@@ -667,6 +676,9 @@ class ServingEngine:
             except queue.Empty:
                 continue
             self.metrics.set_gauge("tpu_serving_queue_depth", self._queue.qsize())
+            if req.future.cancelled():
+                self.metrics.incr("tpu_serving_cancelled")
+                continue  # caller gave up while queued: skip the prefill
             try:
                 last_logits, single = self._prefill_tokens(req.prompt,
                                                            req.adapter_id)
@@ -681,8 +693,7 @@ class ServingEngine:
             except Exception as exc:  # noqa: BLE001 — poisoned prompt only
                 log.exception("prefill of %s failed", req.rid)
                 self.metrics.incr("tpu_serving_prefill_errors")
-                if not req.future.done():
-                    req.future.set_exception(exc)
+                _fail_future(req.future, exc)
                 continue
             while not self._stop.is_set():
                 try:
@@ -906,6 +917,8 @@ class ServingEngine:
             self.metrics.incr("tpu_serving_stream_cancelled")
 
     def _finished(self, slot: _Slot) -> bool:
+        if slot.request.future.cancelled():
+            return True  # caller gave up (timeout/disconnect): free the slot
         if slot.remaining <= 0 or slot.last_token == self.sc.eos_token:
             return True
         gen = slot.generated
@@ -918,8 +931,19 @@ class ServingEngine:
         self._slot_adapter[slot_id] = 0
         latency = time.perf_counter() - req.submitted_at
         self.metrics.observe("tpu_serving_request_latency_seconds", latency)
-        out = {"rid": req.rid, "tokens": slot.generated, "latency_s": latency}
+        out = {"rid": req.rid, "tokens": slot.generated,
+               "latency_s": latency}
         if req.logprobs:
             out["logprobs"] = slot.logprobs
-        req.future.set_result(out)
+        try:
+            # set_running_or_notify_cancel is the ATOMIC claim: it returns
+            # False iff the client's cancel won (a cancel landing between a
+            # cancelled() check and set_result would otherwise raise
+            # InvalidStateError and trip the whole-engine recovery path)
+            if req.future.set_running_or_notify_cancel():
+                req.future.set_result(out)
+            else:
+                self.metrics.incr("tpu_serving_cancelled")
+        except Exception:  # noqa: BLE001 — future already resolved elsewhere
+            pass
         self.metrics.set_gauge("tpu_serving_active_slots", self.active_slots)

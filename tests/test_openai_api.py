@@ -290,6 +290,27 @@ class TestOpenAiCompletions:
         finally:
             e.stop()
 
+    def test_n_choices(self, server):
+        """n > 1 returns that many indexed choices; with temperature they
+        are distinct samples (per-choice seed offset), and usage counts
+        the total generated tokens."""
+        out = _post(server, "/v1/completions",
+                    {"prompt": [5, 9, 2], "max_tokens": 8,
+                     "temperature": 1.0, "n": 3, "seed": 42})
+        assert [c["index"] for c in out["choices"]] == [0, 1, 2]
+        texts = [c["text"] for c in out["choices"]]
+        assert len(set(texts)) > 1  # distinct samples
+        assert out["usage"]["completion_tokens"] == 24
+        # reproducible: same request, same 3 choices
+        again = _post(server, "/v1/completions",
+                      {"prompt": [5, 9, 2], "max_tokens": 8,
+                       "temperature": 1.0, "n": 3, "seed": 42})
+        assert [c["text"] for c in again["choices"]] == texts
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(server, "/v1/completions",
+                  {"prompt": [1], "n": 99})
+        assert ei.value.code == 400
+
     def test_models_listing(self, server):
         out = json.loads(urllib.request.urlopen(
             f"http://127.0.0.1:{server}/v1/models", timeout=30).read())

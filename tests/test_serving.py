@@ -120,6 +120,30 @@ class TestEngineRecovery:
             e.stop()
 
 
+class TestCancellation:
+    def test_cancelled_request_frees_slot_and_engine_continues(self, params):
+        """future.cancel() (client timeout/disconnect) makes the engine
+        drop the request at its next step instead of generating to the
+        budget; later requests serve normally."""
+        e = ServingEngine(CFG, params,
+                          ServingConfig(slots=1, max_prefill_len=32,
+                                        cache_len=64, max_new_tokens=40)
+                          ).start()
+        try:
+            f = e.submit([5, 9, 2], max_new_tokens=40)
+            assert f.cancel()  # engine never marks futures running
+            # queued-or-decoding either way, the slot must free quickly
+            deadline = time.time() + 30
+            while (e.active_slots or e.queue_depth) and time.time() < deadline:
+                time.sleep(0.02)
+            assert e.active_slots == 0 and e.queue_depth == 0
+            out = e.submit([5, 9, 2], max_new_tokens=4).result(timeout=60)
+            assert len(out["tokens"]) == 4
+            assert "tpu_serving_cancelled_total 1" in e.metrics.render()
+        finally:
+            e.stop()
+
+
 class TestPrefillDecodeOverlap:
     def test_decode_cadence_unaffected_by_slow_prefill(self, params):
         """A long prompt's prefill must not stall in-flight decode streams:
