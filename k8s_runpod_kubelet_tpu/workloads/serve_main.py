@@ -11,9 +11,10 @@ The pod command for autoscaled inference. Endpoints:
                    line per decoded token, then the final result object
                    (JetStream-style streamed decode)
   POST /v1/completions  OpenAI-compatible completions (prompt/max_tokens/
-                   temperature/top_p/stop/logprobs/stream-SSE), so
+                   temperature/top_p/stop/logprobs/seed/n/stream-SSE), so
                    OpenAI-SDK clients point here unchanged; "model" selects
-                   a registered LoRA adapter (vLLM convention)
+                   a registered LoRA adapter (vLLM convention); client
+                   timeouts cancel the engine-side generation
   POST /v1/chat/completions  OpenAI chat (messages through the model's own
                    HF chat template when present), stream or not
   POST /prefix     register a shared prompt prefix (system prompt): its KV
