@@ -453,14 +453,11 @@ class _Handler(BaseHTTPRequestHandler):
                  "badreq": lambda msg: {"error": {
                      "message": msg, "type": "invalid_request_error"}}})
 
-        # n choices = n engine requests sharing the continuous batch; with
+        # n choices share ONE prefill (the engine fans the cache out); with
         # an explicit seed each choice offsets it so the samples differ
         # (OpenAI's n returns distinct samples, not n copies)
         base_seed = kw.pop("seed", None)
-        futs = []
-        for i in range(n):
-            seed_i = None if base_seed is None else base_seed + i
-            futs.append(self.engine.submit(tokens, seed=seed_i, **kw))
+        futs = self.engine.submit_group(tokens, n, seed=base_seed, **kw)
         deadline = _time.monotonic() + self.request_timeout_s  # SHARED:
         # per-future timeouts would let n=16 hold the connection 16x longer
         try:

@@ -124,6 +124,11 @@ class Request:
     # streaming: called with each generated token id, from the engine thread.
     # A raising callback (client gone) cancels the request at the next token.
     on_token: Optional[Any] = None
+    # co-submitted requests with the IDENTICAL prompt (OpenAI n>1): the
+    # prefill runs ONCE and its immutable cache fans out to every member
+    # (nothing donates the single cache, so sharing is safe); each member
+    # samples its own first token from the shared last-position logits
+    fanout: Optional[list] = None
 
 
 @dataclasses.dataclass
@@ -214,6 +219,9 @@ class ServingEngine:
         self._prefixes: list[tuple[list[int], Any, Params]] = []
         self._prefix_lock = threading.Lock()
         self._queue: "queue.Queue[Request]" = queue.Queue()
+        # extra members carried by queued groups (submit_group): adds to
+        # queue_depth so the HPA signal sees n requests, not 1
+        self._queued_fanout = 0
         # prefill thread -> engine thread: (request, single cache, first token)
         self._ready: "queue.Queue[tuple[Request, Params, int]]" = \
             queue.Queue(maxsize=sc.slots)
@@ -344,7 +352,7 @@ class ServingEngine:
                top_k: int = 0, top_p: float = 1.0,
                stop: Optional[list] = None, logprobs: bool = False,
                adapter: str = "", seed: Optional[int] = None,
-               on_token=None) -> Future:
+               on_token=None, _build_only: bool = False):
         """Enqueue a generation request; resolves to {tokens, latency_s, rid}
         (+ per-token "logprobs" when requested). ``on_token(tok)`` streams
         each generated token id as it decodes. ``top_k``/``top_p`` filter
@@ -427,9 +435,45 @@ class ServingEngine:
                       stop=[list(s) for s in stop], logprobs=bool(logprobs),
                       adapter_id=adapter_id, seed=seed & 0xFFFFFFFF,
                       on_token=on_token)
+        if _build_only:
+            return req
         self._queue.put(req)
-        self.metrics.set_gauge("tpu_serving_queue_depth", self._queue.qsize())
+        self.metrics.set_gauge("tpu_serving_queue_depth", self.queue_depth)
         return req.future
+
+    def submit_group(self, prompt: list[int], n: int,
+                     seed: Optional[int] = None, **kw) -> list[Future]:
+        """n co-submitted requests over the IDENTICAL prompt (OpenAI n>1):
+        the prompt prefills ONCE and the immutable cache fans out to all
+        members, so time-to-first-token is ~1x, not ~n-x. ``seed`` offsets
+        per member so sampled choices differ; kw matches submit()."""
+        if not isinstance(n, int) or isinstance(n, bool) or n < 1:
+            f: Future = Future()
+            f.set_exception(ValueError(f"n must be a positive int, got {n!r}"))
+            return [f]
+        # member 0 carries ALL the validation — members differ only in the
+        # seed offset, and submit's seed type check runs before any
+        # arithmetic can TypeError (member 0 gets the raw seed)
+        first = self.submit(prompt, seed=seed, _build_only=True, **kw)
+        if isinstance(first, Future):
+            exc = first.exception()
+            fs = [first]
+            for _ in range(n - 1):
+                f = Future()
+                f.set_exception(exc)
+                fs.append(f)
+            return fs
+        reqs = [first]
+        for i in range(1, n):
+            reqs.append(self.submit(prompt,
+                                    seed=None if seed is None else seed + i,
+                                    _build_only=True, **kw))
+        head = reqs[0]
+        head.fanout = reqs[1:]
+        self._queued_fanout += len(head.fanout)
+        self._queue.put(head)
+        self.metrics.set_gauge("tpu_serving_queue_depth", self.queue_depth)
+        return [r.future for r in reqs]
 
     @property
     def alive(self) -> bool:
@@ -447,7 +491,9 @@ class ServingEngine:
 
     @property
     def queue_depth(self) -> int:
-        return self._queue.qsize()
+        # counts every pending request: an n-member group is one queue
+        # entry but n requests (the HPA gauge must not undercount)
+        return self._queue.qsize() + self._queued_fanout
 
     @property
     def active_slots(self) -> int:
@@ -480,13 +526,15 @@ class ServingEngine:
                         req = self._queue.get_nowait()
                     except queue.Empty:
                         break
-                    _fail_future(req.future, exc)
+                    for member in [req] + list(req.fanout or []):
+                        _fail_future(member.future, exc)
                 while True:
                     try:
                         req, *_ = self._ready.get_nowait()
                     except queue.Empty:
                         break
                     _fail_future(req.future, exc)
+                self._queued_fanout = 0  # the queue was just drained
                 self.metrics.set_gauge("tpu_serving_queue_depth", 0)
                 self.metrics.set_gauge("tpu_serving_active_slots", 0)
                 # LAST, after every in-flight future is failed: the crashed
@@ -675,32 +723,43 @@ class ServingEngine:
                 req = self._queue.get(timeout=0.05)
             except queue.Empty:
                 continue
-            self.metrics.set_gauge("tpu_serving_queue_depth", self._queue.qsize())
-            if req.future.cancelled():
-                self.metrics.incr("tpu_serving_cancelled")
-                continue  # caller gave up while queued: skip the prefill
+            self.metrics.set_gauge("tpu_serving_queue_depth", self.queue_depth)
+            members = [req] + list(req.fanout or [])
+            self._queued_fanout -= len(members) - 1
+            live = [r for r in members if not r.future.cancelled()]
+            self.metrics.incr("tpu_serving_cancelled",
+                              len(members) - len(live))
+            if not live:
+                continue  # every caller gave up while queued
             try:
                 last_logits, single = self._prefill_tokens(req.prompt,
                                                            req.adapter_id)
-                keys = self._row_keys(jnp.asarray([req.seed], jnp.uint32),
-                                      jnp.asarray([0], jnp.int32))
-                first = int(_sample(last_logits, keys, [req.temperature],
-                                    [req.top_k], [req.top_p])[0])
-                first_lp = None
-                if req.logprobs:
-                    first_lp = float(jax.nn.log_softmax(
-                        last_logits[0].astype(jnp.float32))[first])
+                # one prefill, one ready entry PER live member: each samples
+                # its own first token from the shared last-position logits
+                entries = []
+                for r in live:
+                    keys = self._row_keys(jnp.asarray([r.seed], jnp.uint32),
+                                          jnp.asarray([0], jnp.int32))
+                    first = int(_sample(last_logits, keys, [r.temperature],
+                                        [r.top_k], [r.top_p])[0])
+                    first_lp = None
+                    if r.logprobs:
+                        first_lp = float(jax.nn.log_softmax(
+                            last_logits[0].astype(jnp.float32))[first])
+                    entries.append((r, single, first, first_lp))
             except Exception as exc:  # noqa: BLE001 — poisoned prompt only
                 log.exception("prefill of %s failed", req.rid)
                 self.metrics.incr("tpu_serving_prefill_errors")
-                _fail_future(req.future, exc)
+                for r in live:
+                    _fail_future(r.future, exc)
                 continue
-            while not self._stop.is_set():
-                try:
-                    self._ready.put((req, single, first, first_lp), timeout=0.1)
-                    break
-                except queue.Full:
-                    continue
+            for entry in entries:
+                while not self._stop.is_set():
+                    try:
+                        self._ready.put(entry, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
 
     def _admit(self) -> bool:
         """Insert ready-made prefilled caches into free slots (cheap donated

@@ -311,6 +311,26 @@ class TestOpenAiCompletions:
                   {"prompt": [1], "n": 99})
         assert ei.value.code == 400
 
+    def test_submit_group_matches_individual_submits(self, params):
+        """One shared prefill (submit_group) must produce exactly what n
+        separate submits with the same offset seeds produce."""
+        e = ServingEngine(CFG, params,
+                          ServingConfig(slots=4, max_prefill_len=16,
+                                        cache_len=64, max_new_tokens=10)
+                          ).start()
+        try:
+            prompt = [5, 9, 2, 31]
+            grouped = [f.result(timeout=60)["tokens"]
+                       for f in e.submit_group(prompt, 3, seed=7,
+                                               temperature=1.0,
+                                               max_new_tokens=10)]
+            solo = [e.submit(prompt, max_new_tokens=10, temperature=1.0,
+                             seed=7 + i).result(timeout=60)["tokens"]
+                    for i in range(3)]
+            assert grouped == solo
+        finally:
+            e.stop()
+
     def test_models_listing(self, server):
         out = json.loads(urllib.request.urlopen(
             f"http://127.0.0.1:{server}/v1/models", timeout=30).read())
