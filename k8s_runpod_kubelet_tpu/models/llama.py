@@ -508,16 +508,14 @@ def _attention_block(x, lp, cfg: LlamaConfig, cos, sin, mesh, positions=None,
     # (B,S,H,D) -> (B,H,S,D)
     qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
     if mesh is not None and mesh.shape.get(AXES.SEQ, 1) > 1:
-        if window is not None:
-            raise ValueError("sliding_window does not compose with the seq "
-                             "axis (ring attention) — window ≪ context makes "
-                             "sequence parallelism unnecessary; use "
-                             "fsdp/tensor for those devices")
-        if cfg.attn_logit_softcap is not None:
-            raise ValueError("attn_logit_softcap is not supported on the "
-                             "ring-attention (seq axis) path yet")
+        # softcap and sliding window ride the ring (band-masked chunks with
+        # out-of-band skip), so Gemma-2/3 interleaves get sequence
+        # parallelism: global sublayers ring the full context, local ones
+        # only pay for in-window chunks
         o = ring_attention(qt, kt, vt, mesh, causal=True,
-                           sm_scale=cfg.sm_scale)
+                           sm_scale=cfg.sm_scale,
+                           logit_soft_cap=cfg.attn_logit_softcap,
+                           sliding_window=window)
     else:
         o = flash_attention(qt, kt, vt, causal=True, sm_scale=cfg.sm_scale,
                             sliding_window=window,
@@ -670,11 +668,6 @@ class LlamaModel:
                 n_microbatches=cfg.pipeline_microbatches)
             aux_layers = aux_total[None]
         else:
-            if pat > 1 and mesh is not None and mesh.shape.get(AXES.SEQ, 1) > 1:
-                raise ValueError("sliding_window_pattern > 1 does not compose "
-                                 "with the seq axis: local sublayers cannot "
-                                 "ring-attend")
-
             def block(carry, lp_group):
                 y = carry
                 aux = jnp.float32(0.0)

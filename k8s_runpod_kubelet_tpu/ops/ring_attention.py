@@ -29,20 +29,32 @@ from ..parallel.mesh import AXES
 from .attention import NEG_INF
 
 
-def _chunk_update(q, kc, vc, acc, m, l, *, q_offset, k_offset, causal, sm_scale):
+def _chunk_update(q, kc, vc, acc, m, l, *, q_offset, k_offset, causal, sm_scale,
+                  soft_cap=None, window=None):
     """One online-softmax step: fold K/V chunk (global offset k_offset) into the
     running (acc, m, l) for Q (global offset q_offset). Shapes:
-    q (B,Hq,Sq,D), kc/vc (B,Hkv,Sk,D); GQA via group reshape."""
+    q (B,Hq,Sq,D), kc/vc (B,Hkv,Sk,D); GQA via group reshape.
+
+    ``soft_cap`` (Gemma-2): cap*tanh(s/cap) before the mask — same
+    scale→cap→mask order as ops/attention.py, and because this path is
+    plain jnp, JAX autodiff carries the tanh derivative exactly (the
+    Pallas kernels do it by hand; here it is free). ``window``: the
+    sliding-window band mask, composed with causal."""
     b, hq, sq, d = q.shape
     _, hkv, sk, _ = kc.shape
     group = hq // hkv
     qg = (q.astype(jnp.float32) * sm_scale).reshape(b, hkv, group, sq, d)
     s = jnp.einsum("bhgqd,bhkd->bhgqk", qg, kc.astype(jnp.float32))
     s = s.reshape(b, hq, sq, sk)
+    if soft_cap is not None:
+        s = jnp.tanh(s / soft_cap) * soft_cap
     if causal:
         q_pos = q_offset + jnp.arange(sq)
         k_pos = k_offset + jnp.arange(sk)
-        s = jnp.where((q_pos[:, None] >= k_pos[None, :])[None, None], s, NEG_INF)
+        keep = q_pos[:, None] >= k_pos[None, :]
+        if window is not None:
+            keep &= (q_pos[:, None] - k_pos[None, :]) < window
+        s = jnp.where(keep[None, None], s, NEG_INF)
     m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
     p = jnp.exp(s - m_new)
     corr = jnp.exp(m - m_new)
@@ -55,15 +67,28 @@ def _chunk_update(q, kc, vc, acc, m, l, *, q_offset, k_offset, causal, sm_scale)
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh, *,
                    causal: bool = True, sm_scale: Optional[float] = None,
+                   logit_soft_cap: Optional[float] = None,
+                   sliding_window: Optional[int] = None,
                    axis: str = AXES.SEQ) -> jax.Array:
     """Attention over sequence sharded on ``axis``. Global shapes:
-    q (B,Hq,S,D), k/v (B,Hkv,S,D), S divisible by the axis size."""
+    q (B,Hq,S,D), k/v (B,Hkv,S,D), S divisible by the axis size.
+
+    ``logit_soft_cap`` and ``sliding_window`` match flash_attention's
+    semantics, so Gemma-2/3 interleaves run under sequence parallelism:
+    windowed sublayers band-mask each visiting chunk and skip chunks fully
+    outside the band (the K/V still rotates — the ring schedule is fixed —
+    but the O(Sq*Sk) chunk math is conditional, so the per-device cost is
+    O(S_local * min(S, W + S_local)) like the Pallas block-skip)."""
     d = q.shape[-1]
     scale = sm_scale if sm_scale is not None else d ** -0.5
+    if sliding_window is not None and not causal:
+        raise ValueError("sliding_window requires causal attention")
     n = mesh.shape[axis]
     if n == 1:
         from .attention import flash_attention
-        return flash_attention(q, k, v, causal=causal, sm_scale=scale)
+        return flash_attention(q, k, v, causal=causal, sm_scale=scale,
+                               logit_soft_cap=logit_soft_cap,
+                               sliding_window=sliding_window)
 
     def local(qs, ks, vs):
         idx = jax.lax.axis_index(axis)
@@ -84,10 +109,31 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh, *,
         def step(t, carry):
             acc, m, l, kc, vc = carry
             src = (idx - t) % n  # whose shard we currently hold
-            acc, m, l = _chunk_update(
-                qs, kc, vc, acc, m, l,
-                q_offset=idx * s_local, k_offset=src * s_local,
-                causal=causal, sm_scale=scale)
+            q_off = idx * s_local
+            k_off = src * s_local
+
+            def update(args):
+                acc, m, l = args
+                return _chunk_update(
+                    qs, kc, vc, acc, m, l,
+                    q_offset=q_off, k_offset=k_off,
+                    causal=causal, sm_scale=scale,
+                    soft_cap=logit_soft_cap, window=sliding_window)
+
+            # chunk relevance: causal needs its first k pos <= the last
+            # q pos; windowed additionally needs its last k pos inside the
+            # band of some q. Skipping is pure compute saving — masks make
+            # an irrelevant chunk a no-op anyway (t=0 is always relevant:
+            # src==idx holds the diagonal, so m is finite from step one
+            # and the exp(s - m) math never sees NEG_INF - NEG_INF).
+            if causal:
+                relevant = k_off <= q_off + (s_local - 1)
+                if sliding_window is not None:
+                    relevant &= (q_off - (k_off + s_local - 1)) < sliding_window
+                acc, m, l = jax.lax.cond(relevant, update,
+                                         lambda args: args, (acc, m, l))
+            else:
+                acc, m, l = update((acc, m, l))
             kc = jax.lax.ppermute(kc, axis, perm)
             vc = jax.lax.ppermute(vc, axis, perm)
             return acc, m, l, kc, vc

@@ -38,7 +38,11 @@ def rope_frequencies(head_dim: int, max_seq_len: int, theta: float = 500_000.0,
         factor = scaling.get("factor", 8.0)
         low = scaling.get("low_freq_factor", 1.0)
         high = scaling.get("high_freq_factor", 4.0)
-        orig = scaling.get("original_max_position", 8192)
+        # HF configs spell this 'original_max_position_embeddings'; accept
+        # the short key too (both pass the rope_type validation above)
+        orig = scaling.get("original_max_position",
+                           scaling.get("original_max_position_embeddings",
+                                       8192))
         wavelen = 2 * jnp.pi / inv_freq
         low_wl = orig / low
         high_wl = orig / high

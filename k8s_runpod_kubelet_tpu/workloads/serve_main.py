@@ -203,6 +203,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send(504, {"error": "generation timed out"})
         except ValueError as e:
             return self._send(400, {"error": str(e)})
+        except Exception as e:  # engine crash: JSON 500, not a dropped socket
+            return self._send(500, {"error": str(e)})
         if self.tokenizer is not None:
             out = dict(out)
             out["text"] = self.tokenizer.decode(out["tokens"])
@@ -219,7 +221,10 @@ class _Handler(BaseHTTPRequestHandler):
         which would let a slow-but-steady stream run unboundedly (ADVICE r1).
 
         ``fmt`` callbacks each return a list of body bytes to emit:
-        token(t), timeout(), error(msg), end(result_dict)."""
+        token(t), timeout(), error(msg), end(result_dict), and an
+        optional start() emitted right after the headers (chat SSE uses
+        it for the role-delta chunk, so a generation that ends instantly
+        — or times out — still gives strict OpenAI clients a role)."""
         import queue as _q
         import time as _time
         q: "_q.Queue" = _q.Queue()
@@ -245,6 +250,8 @@ class _Handler(BaseHTTPRequestHandler):
 
         deadline = _time.monotonic() + self.request_timeout_s
         try:
+            for body in fmt.get("start", lambda: [])():
+                chunk(body)
             while True:
                 try:
                     remaining = deadline - _time.monotonic()
@@ -441,10 +448,15 @@ class _Handler(BaseHTTPRequestHandler):
                 bodies.append(sse("[DONE]"))
                 return bodies
 
+            def fmt_start() -> list:
+                # chat: lead with the role delta (OpenAI's own first chunk)
+                return [sse(chunk_obj(""))] if chat else []
+
             return self._stream_pump(
                 tokens, kw, "text/event-stream",
                 {"token": fmt_token,
                  "end": fmt_end,
+                 "start": fmt_start,
                  "timeout": lambda: [sse({"error": {
                      "message": "generation timed out",
                      "type": "timeout"}}), sse("[DONE]")],
@@ -473,6 +485,11 @@ class _Handler(BaseHTTPRequestHandler):
                 f.cancel()
             return self._send(400, {"error": {"message": str(e),
                                               "type": "invalid_request_error"}})
+        except Exception as e:  # engine crash (e.g. recovery-path RuntimeError)
+            for f in futs:
+                f.cancel()
+            return self._send(500, {"error": {"message": str(e),
+                                              "type": "server_error"}})
         choices = []
         for i, out in enumerate(outs):
             reason, toks = finish_reason(out["tokens"])

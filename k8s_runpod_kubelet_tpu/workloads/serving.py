@@ -220,8 +220,11 @@ class ServingEngine:
         self._prefix_lock = threading.Lock()
         self._queue: "queue.Queue[Request]" = queue.Queue()
         # extra members carried by queued groups (submit_group): adds to
-        # queue_depth so the HPA signal sees n requests, not 1
+        # queue_depth so the HPA signal sees n requests, not 1.
+        # += from HTTP submit threads, -= from the prefill thread: CPython
+        # int read-modify-write is not atomic, so the gauge needs a lock.
         self._queued_fanout = 0
+        self._fanout_lock = threading.Lock()
         # prefill thread -> engine thread: (request, single cache, first token)
         self._ready: "queue.Queue[tuple[Request, Params, int]]" = \
             queue.Queue(maxsize=sc.slots)
@@ -470,7 +473,8 @@ class ServingEngine:
                                     _build_only=True, **kw))
         head = reqs[0]
         head.fanout = reqs[1:]
-        self._queued_fanout += len(head.fanout)
+        with self._fanout_lock:
+            self._queued_fanout += len(head.fanout)
         self._queue.put(head)
         self.metrics.set_gauge("tpu_serving_queue_depth", self.queue_depth)
         return [r.future for r in reqs]
@@ -534,7 +538,8 @@ class ServingEngine:
                     except queue.Empty:
                         break
                     _fail_future(req.future, exc)
-                self._queued_fanout = 0  # the queue was just drained
+                with self._fanout_lock:
+                    self._queued_fanout = 0  # the queue was just drained
                 self.metrics.set_gauge("tpu_serving_queue_depth", 0)
                 self.metrics.set_gauge("tpu_serving_active_slots", 0)
                 # LAST, after every in-flight future is failed: the crashed
@@ -725,7 +730,8 @@ class ServingEngine:
                 continue
             self.metrics.set_gauge("tpu_serving_queue_depth", self.queue_depth)
             members = [req] + list(req.fanout or [])
-            self._queued_fanout -= len(members) - 1
+            with self._fanout_lock:
+                self._queued_fanout -= len(members) - 1
             live = [r for r in members if not r.future.cancelled()]
             self.metrics.incr("tpu_serving_cancelled",
                               len(members) - len(live))

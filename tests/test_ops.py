@@ -191,6 +191,69 @@ class TestRingAttention:
         ref = naive_attention(q, k, v, causal)
         np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-4, atol=2e-4)
 
+    def test_softcap_matches_xla_reference(self):
+        # Gemma-2 softcap on the ring path (VERDICT r2 item 4): parity vs
+        # the XLA reference with the same scale->cap->mask ordering
+        mesh = make_mesh(MeshConfig(data=1, seq=8))
+        ks = jax.random.split(jax.random.PRNGKey(7), 3)
+        q = jax.random.normal(ks[0], (1, 4, 256, 32))
+        k = jax.random.normal(ks[1], (1, 2, 256, 32))
+        v = jax.random.normal(ks[2], (1, 2, 256, 32))
+        got = ring_attention(q, k, v, mesh, causal=True, logit_soft_cap=50.0)
+        ref = _attention_xla(q, k, v, causal=True, sm_scale=32 ** -0.5,
+                             logit_soft_cap=50.0)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_softcap_grads_match_xla_reference(self):
+        # autodiff must carry the tanh derivative through the ring chunks
+        mesh = make_mesh(MeshConfig(data=1, seq=8))
+        ks = jax.random.split(jax.random.PRNGKey(8), 3)
+        q = jax.random.normal(ks[0], (1, 2, 128, 16))
+        k = jax.random.normal(ks[1], (1, 2, 128, 16))
+        v = jax.random.normal(ks[2], (1, 2, 128, 16))
+
+        def loss(fn):
+            def inner(q):
+                return jnp.mean(fn(q) ** 2)
+            return jax.grad(inner)(q)
+
+        g_ring = loss(lambda q: ring_attention(
+            q, k, v, mesh, causal=True, logit_soft_cap=30.0))
+        g_ref = loss(lambda q: _attention_xla(
+            q, k, v, causal=True, sm_scale=16 ** -0.5, logit_soft_cap=30.0))
+        np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_ref),
+                                   rtol=2e-3, atol=2e-3)
+
+    @pytest.mark.parametrize("window", [32, 100, 256])
+    def test_sliding_window_matches_xla_reference(self, window):
+        # windowed sublayers under sequence parallelism (Gemma-2/3, Mistral):
+        # band mask + out-of-band chunk skip must match the dense reference
+        mesh = make_mesh(MeshConfig(data=1, seq=8))
+        ks = jax.random.split(jax.random.PRNGKey(9), 3)
+        q = jax.random.normal(ks[0], (1, 4, 256, 32))
+        k = jax.random.normal(ks[1], (1, 2, 256, 32))
+        v = jax.random.normal(ks[2], (1, 2, 256, 32))
+        got = ring_attention(q, k, v, mesh, causal=True, sliding_window=window)
+        ref = _attention_xla(q, k, v, causal=True, sm_scale=32 ** -0.5,
+                             sliding_window=window)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_window_plus_softcap_compose_on_ring(self):
+        # the Gemma-2 local-sublayer combination: window AND softcap
+        mesh = make_mesh(MeshConfig(data=1, seq=8))
+        ks = jax.random.split(jax.random.PRNGKey(10), 3)
+        q = jax.random.normal(ks[0], (1, 2, 256, 16))
+        k = jax.random.normal(ks[1], (1, 2, 256, 16))
+        v = jax.random.normal(ks[2], (1, 2, 256, 16))
+        got = ring_attention(q, k, v, mesh, causal=True,
+                             sliding_window=64, logit_soft_cap=50.0)
+        ref = _attention_xla(q, k, v, causal=True, sm_scale=16 ** -0.5,
+                             sliding_window=64, logit_soft_cap=50.0)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
     def test_seq_axis_one_falls_through(self):
         mesh = make_mesh(MeshConfig(data=8, seq=1))
         ks = jax.random.split(jax.random.PRNGKey(3), 3)
