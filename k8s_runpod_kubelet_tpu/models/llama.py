@@ -747,7 +747,7 @@ class LlamaModel:
         return cache
 
     def init_mixed_cache(self, batch: int, max_len: int,
-                         ring_len: int) -> Params:
+                         ring_len: int, quantize: bool = False) -> Params:
         """Split cache for local/global interleave models (Gemma-2/3):
         LOCAL (windowed) sublayers get a ring of ``ring_len`` slots (they
         can never attend further back than the window), GLOBAL sublayers
@@ -757,7 +757,12 @@ class LlamaModel:
         local sublayers are rows g*(p-1)..), "k_g"/"v_g" (n_global, B,
         max_len, h, d); one shared "abs_pos" ring ownership map (every
         local layer writes the same slots). Same write-slack contract as
-        init_ring_cache."""
+        init_ring_cache.
+
+        ``quantize=True`` stores every section int8 with per-(position,
+        kv-head) f32 scales ("k_l_scale"/"v_l_scale"/"k_g_scale"/
+        "v_g_scale") — the ring's O(W) win and int8's 2x read-traffic win
+        compose, they shrink different axes."""
         cfg = self.cfg
         p = cfg.sliding_window_pattern
         if cfg.sliding_window is None or p <= 1:
@@ -773,14 +778,25 @@ class LlamaModel:
         n_groups = cfg.n_layers // p
         n_local = n_groups * (p - 1)
         h, d = cfg.n_kv_heads, cfg.head_dim_
-        return {
-            "k_l": jnp.zeros((n_local, batch, ring_len, h, d), cfg.dtype),
-            "v_l": jnp.zeros((n_local, batch, ring_len, h, d), cfg.dtype),
-            "k_g": jnp.zeros((n_groups, batch, max_len, h, d), cfg.dtype),
-            "v_g": jnp.zeros((n_groups, batch, max_len, h, d), cfg.dtype),
+        dt = jnp.int8 if quantize else cfg.dtype
+        cache = {
+            "k_l": jnp.zeros((n_local, batch, ring_len, h, d), dt),
+            "v_l": jnp.zeros((n_local, batch, ring_len, h, d), dt),
+            "k_g": jnp.zeros((n_groups, batch, max_len, h, d), dt),
+            "v_g": jnp.zeros((n_groups, batch, max_len, h, d), dt),
             "index": jnp.zeros((batch,), jnp.int32),
             "abs_pos": jnp.full((batch, ring_len), -1, jnp.int32),
         }
+        if quantize:
+            cache["k_l_scale"] = jnp.zeros((n_local, batch, ring_len, h),
+                                           jnp.float32)
+            cache["v_l_scale"] = jnp.zeros((n_local, batch, ring_len, h),
+                                           jnp.float32)
+            cache["k_g_scale"] = jnp.zeros((n_groups, batch, max_len, h),
+                                           jnp.float32)
+            cache["v_g_scale"] = jnp.zeros((n_groups, batch, max_len, h),
+                                           jnp.float32)
+        return cache
 
     def prefill(self, params: Params, tokens: jax.Array, cache: Params,
                 true_length: Optional[jax.Array] = None,
@@ -843,23 +859,36 @@ class LlamaModel:
                 raise ValueError(f"prompt chunk {s} exceeds cache sections "
                                  f"(ring {ring}, global {max_g})")
             n_groups = cfg.n_layers // pat
-            grouped_k = k_all.reshape((n_groups, pat) + k_all.shape[1:])
-            grouped_v = v_all.reshape((n_groups, pat) + v_all.shape[1:])
             loc_shape = (n_groups * (pat - 1),) + k_all.shape[1:]
             pad_l = [(0, 0), (0, 0), (0, ring - s), (0, 0), (0, 0)]
             pad_g = [(0, 0), (0, 0), (0, max_g - s), (0, 0), (0, 0)]
             slot_ids = jnp.arange(ring)[None, :]
-            return logits, {
-                "k_l": jnp.pad(grouped_k[:, :pat - 1].reshape(loc_shape),
-                               pad_l),
-                "v_l": jnp.pad(grouped_v[:, :pat - 1].reshape(loc_shape),
-                               pad_l),
-                "k_g": jnp.pad(grouped_k[:, pat - 1], pad_g),
-                "v_g": jnp.pad(grouped_v[:, pat - 1], pad_g),
+            new_cache = {
                 "index": true_length.astype(jnp.int32),
                 "abs_pos": jnp.where(slot_ids < true_length[:, None],
                                      slot_ids, -1).astype(jnp.int32),
             }
+            if "k_l_scale" in cache:  # int8 split cache: quantize first
+                k_all, k_sc = _kv_quant(k_all)       # (L,B,S,h,d) + (L,B,S,h)
+                v_all, v_sc = _kv_quant(v_all)
+                gk_sc = k_sc.reshape((n_groups, pat) + k_sc.shape[1:])
+                gv_sc = v_sc.reshape((n_groups, pat) + v_sc.shape[1:])
+                loc_sc = loc_shape[:-1]
+                new_cache["k_l_scale"] = jnp.pad(
+                    gk_sc[:, :pat - 1].reshape(loc_sc), pad_l[:-1])
+                new_cache["v_l_scale"] = jnp.pad(
+                    gv_sc[:, :pat - 1].reshape(loc_sc), pad_l[:-1])
+                new_cache["k_g_scale"] = jnp.pad(gk_sc[:, pat - 1], pad_g[:-1])
+                new_cache["v_g_scale"] = jnp.pad(gv_sc[:, pat - 1], pad_g[:-1])
+            grouped_k = k_all.reshape((n_groups, pat) + k_all.shape[1:])
+            grouped_v = v_all.reshape((n_groups, pat) + v_all.shape[1:])
+            new_cache["k_l"] = jnp.pad(
+                grouped_k[:, :pat - 1].reshape(loc_shape), pad_l)
+            new_cache["v_l"] = jnp.pad(
+                grouped_v[:, :pat - 1].reshape(loc_shape), pad_l)
+            new_cache["k_g"] = jnp.pad(grouped_k[:, pat - 1], pad_g)
+            new_cache["v_g"] = jnp.pad(grouped_v[:, pat - 1], pad_g)
+            return logits, new_cache
         max_len = cache["k"].shape[2]
         if s > max_len:
             raise ValueError(f"prompt length {s} exceeds cache length "
@@ -969,7 +998,7 @@ class LlamaModel:
             masks = [make_mask(pos_l, win) for win in windows]
             slot_map = [positions] * pat
 
-        quant = "k_scale" in cache
+        quant = "k_scale" in cache or "k_l_scale" in cache
 
         def sub_block(y, lp, k_cache, v_cache, k_scale, v_scale, valid, rope,
                       adj, slots):
@@ -1031,24 +1060,38 @@ class LlamaModel:
             if mixed:
                 kl, vl = inputs["kl"], inputs["vl"]   # (p-1, B, R, h, d)
                 kgl, vgl = inputs["kg"], inputs["vg"]  # (B, G, h, d)
-                kl_out, vl_out = [], []
-                kg_out = vg_out = None
+                kls, vls = inputs.get("kls"), inputs.get("vls")
+                kgs, vgs = inputs.get("kgs"), inputs.get("vgs")
+                kl_out, vl_out, kls_out, vls_out = [], [], [], []
+                kg_out = vg_out = kgs_out = vgs_out = None
                 for j in range(pat):
                     local = windows[j] is not None
-                    y, k_n, v_n, _, _ = sub_block(
+                    y, k_n, v_n, ks_n, vs_n = sub_block(
                         y, _sublayer(lp_g, j, pat),
                         kl[j] if local else kgl,
                         vl[j] if local else vgl,
-                        None, None, masks[j], _rope_for(ropes, windows[j]),
+                        None if kls is None else (kls[j] if local else kgs),
+                        None if vls is None else (vls[j] if local else vgs),
+                        masks[j], _rope_for(ropes, windows[j]),
                         None if ad_g is None else _sublayer(ad_g, j, pat),
                         slot_map[j])
                     if local:
                         kl_out.append(k_n)
                         vl_out.append(v_n)
+                        if quant:
+                            kls_out.append(ks_n)
+                            vls_out.append(vs_n)
                     else:
                         kg_out, vg_out = k_n, v_n
-                return y, {"kl": jnp.stack(kl_out), "vl": jnp.stack(vl_out),
-                           "kg": kg_out, "vg": vg_out}
+                        if quant:
+                            kgs_out, vgs_out = ks_n, vs_n
+                out = {"kl": jnp.stack(kl_out), "vl": jnp.stack(vl_out),
+                       "kg": kg_out, "vg": vg_out}
+                if quant:
+                    out.update(kls=jnp.stack(kls_out),
+                               vls=jnp.stack(vls_out),
+                               kgs=kgs_out, vgs=vgs_out)
+                return y, out
             k_g, v_g = inputs["k"], inputs["v"]
             ks_g, vs_g = inputs.get("ks"), inputs.get("vs")
             if pat == 1:
@@ -1084,6 +1127,13 @@ class LlamaModel:
                 (n_groups, pat - 1) + cache["v_l"].shape[1:])
             xs["kg"] = cache["k_g"]
             xs["vg"] = cache["v_g"]
+            if quant:
+                xs["kls"] = cache["k_l_scale"].reshape(
+                    (n_groups, pat - 1) + cache["k_l_scale"].shape[1:])
+                xs["vls"] = cache["v_l_scale"].reshape(
+                    (n_groups, pat - 1) + cache["v_l_scale"].shape[1:])
+                xs["kgs"] = cache["k_g_scale"]
+                xs["vgs"] = cache["v_g_scale"]
         else:
             xs["k"] = _group_layers(cache["k"], pat)
             xs["v"] = _group_layers(cache["v"], pat)
@@ -1101,6 +1151,13 @@ class LlamaModel:
                    "v_l": new_kv["vl"].reshape((-1,) + nl.shape[2:]),
                    "k_g": new_kv["kg"], "v_g": new_kv["vg"],
                    "index": idx, "abs_pos": new_abs}
+            if quant:
+                nls = new_kv["kls"]  # (n_groups, p-1, B, R, h)
+                out["k_l_scale"] = nls.reshape((-1,) + nls.shape[2:])
+                out["v_l_scale"] = new_kv["vls"].reshape(
+                    (-1,) + nls.shape[2:])
+                out["k_g_scale"] = new_kv["kgs"]
+                out["v_g_scale"] = new_kv["vgs"]
             return logits, out
         if pat > 1:  # (L//p, p, B, L, ...) -> (L, B, L, ...)
             new_kv = {kk_: a.reshape((cfg.n_layers,) + a.shape[2:])
@@ -1120,7 +1177,8 @@ class LlamaModel:
         out = {"index": cache["index"].at[slot].set(single["index"][0])}
         # every stacked-KV section shares the (layers, batch, ...) layout
         for sect in ("k", "v", "k_l", "v_l", "k_g", "v_g",
-                     "k_scale", "v_scale"):
+                     "k_scale", "v_scale", "k_l_scale", "v_l_scale",
+                     "k_g_scale", "v_g_scale"):
             if sect in cache:
                 out[sect] = cache[sect].at[:, slot].set(single[sect][:, 0])
         if "abs_pos" in cache:

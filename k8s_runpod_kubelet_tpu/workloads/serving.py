@@ -303,8 +303,9 @@ class ServingEngine:
         if self._ring_len is not None:
             if self.cfg.sliding_window_pattern > 1:
                 # Gemma-2/3: ring for local sublayers, full for global
-                return self.model.init_mixed_cache(batch, self.sc.cache_len,
-                                                   self._ring_len)
+                return self.model.init_mixed_cache(
+                    batch, self.sc.cache_len, self._ring_len,
+                    quantize=self.sc.quantize_kv_int8)
             return self.model.init_ring_cache(
                 batch, self._ring_len, quantize=self.sc.quantize_kv_int8)
         return self.model.init_cache(
@@ -318,20 +319,15 @@ class ServingEngine:
         keeps every in-window entry alive across chunked prefill and
         speculative rejections. Uniform-window models (Mistral) ring every
         layer; interleave models (Gemma-2/3) get the SPLIT cache — rings
-        for local sublayers, full length for global ones — which doesn't
-        compose with the int8 KV cache yet."""
+        for local sublayers, full length for global ones — both compose
+        with the int8 KV cache (int8 shrinks the read traffic, the ring
+        shrinks the position axis; orthogonal wins)."""
         windowed = cfg.sliding_window is not None
-        mixed = windowed and cfg.sliding_window_pattern > 1
         if sc.ring_cache is False or (sc.ring_cache is None and not windowed):
             return None
         if not windowed:
             raise ValueError("ring_cache=True needs a model with a "
                              "sliding window")
-        if mixed and sc.quantize_kv_int8:
-            if sc.ring_cache:  # explicit request that can't be honored
-                raise ValueError("the split (mixed) cache does not support "
-                                 "quantize_kv_int8 yet")
-            return None
         slack = max(sc.max_prefill_len, sc.speculate_k + 1)
         ring = -(-(cfg.sliding_window + slack) // 128) * 128
         if sc.ring_cache is None and ring >= sc.cache_len:
@@ -525,12 +521,15 @@ class ServingEngine:
                     req, slot.request = slot.request, None
                     if req is not None:
                         _fail_future(req.future, exc)
+                drained_fanout = 0
                 while True:
                     try:
                         req = self._queue.get_nowait()
                     except queue.Empty:
                         break
-                    for member in [req] + list(req.fanout or []):
+                    members = [req] + list(req.fanout or [])
+                    drained_fanout += len(members) - 1
+                    for member in members:
                         _fail_future(member.future, exc)
                 while True:
                     try:
@@ -538,9 +537,14 @@ class ServingEngine:
                     except queue.Empty:
                         break
                     _fail_future(req.future, exc)
+                # subtract only groups actually drained: a submit thread may
+                # have counted its group but not queued it yet — zeroing here
+                # would double-subtract when the dispatcher later pops it,
+                # driving the HPA gauge permanently negative
                 with self._fanout_lock:
-                    self._queued_fanout = 0  # the queue was just drained
-                self.metrics.set_gauge("tpu_serving_queue_depth", 0)
+                    self._queued_fanout -= drained_fanout
+                self.metrics.set_gauge("tpu_serving_queue_depth",
+                                       self.queue_depth)
                 self.metrics.set_gauge("tpu_serving_active_slots", 0)
                 # LAST, after every in-flight future is failed: the crashed
                 # step may have DONATED the cache buffers before raising, so

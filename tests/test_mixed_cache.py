@@ -140,15 +140,56 @@ class TestMixedCacheEngine:
             e_m.stop()
             e_l.stop()
 
-    def test_kv_int8_falls_back_to_linear(self, params):
-        e = self._engine(params, quantize_kv_int8=True)
+    def test_kv_int8_composes_with_split_cache(self, params):
+        """int8 KV on the split cache (VERDICT r2 item 4): both sections
+        store int8 + scales, and greedy decode matches the unquantized
+        mixed engine (f32 tiny model: quantization error stays below
+        argmax flip threshold on these prompts)."""
+        e_q = self._engine(params, quantize_kv_int8=True)
+        e_f = self._engine(params)
         try:
-            assert e._ring_len is None and "k" in e._cache
-            out = e.submit([1, 2, 3], max_new_tokens=4).result(timeout=60)
-            assert len(out["tokens"]) == 4
+            assert e_q._ring_len is not None and "k_l" in e_q._cache
+            assert e_q._cache["k_l"].dtype == jnp.int8
+            assert e_q._cache["k_g"].dtype == jnp.int8
+            assert "k_l_scale" in e_q._cache and "k_g_scale" in e_q._cache
+            # memory win preserved: local rings at R=128, not cache_len=256
+            assert e_q._cache["k_l"].shape[2] == 128
+            prompts = [[(7 * j + i) % 128 for j in range(1 + 5 * i)]
+                       for i in range(3)]
+            for p in prompts:
+                a = e_q.submit(p, max_new_tokens=16).result(timeout=60)
+                b = e_f.submit(p, max_new_tokens=16).result(timeout=60)
+                assert a["tokens"] == b["tokens"], p
         finally:
-            e.stop()
-        with pytest.raises(ValueError, match="mixed"):
-            ServingEngine(G2, params,
-                          ServingConfig(slots=1, ring_cache=True,
-                                        quantize_kv_int8=True))
+            e_q.stop()
+            e_f.stop()
+
+    def test_kv_int8_mixed_model_decode_wraparound(self, params):
+        """Model-level: quantized split cache survives ring wraparound and
+        stays near the full forward (int8 tolerance)."""
+        model = LlamaModel(G2)
+        toks = jax.random.randint(jax.random.PRNGKey(5), (1, 40), 0, 128)
+        full = model.forward(params, toks)
+        cache = model.init_mixed_cache(1, 64, RING, quantize=True)
+        assert cache["k_l"].dtype == jnp.int8
+        _, cache = model.prefill(params, toks[:, :6], cache)
+        for i in range(6, 40):
+            logits, cache = model.decode_step(params, toks[:, i], cache)
+            # int8 KV: compare argmax + coarse numeric agreement
+            assert int(jnp.argmax(logits)) == int(jnp.argmax(full[:, i])), i
+            np.testing.assert_allclose(np.asarray(logits),
+                                       np.asarray(full[:, i]),
+                                       rtol=0.2, atol=0.5,
+                                       err_msg=f"position {i}")
+
+    def test_kv_int8_mixed_speculative(self, params):
+        e_q = self._engine(params, quantize_kv_int8=True, speculate_k=3)
+        e_f = self._engine(params, speculate_k=3)
+        try:
+            prompt = [3, 1, 4, 1, 5, 9, 2, 6, 3, 1, 4, 1, 5]
+            a = e_q.submit(prompt, max_new_tokens=20).result(timeout=60)
+            b = e_f.submit(prompt, max_new_tokens=20).result(timeout=60)
+            assert a["tokens"] == b["tokens"]
+        finally:
+            e_q.stop()
+            e_f.stop()
