@@ -18,6 +18,8 @@ from .types import (
     select_accelerator,
 )
 from .tpu_client import TpuClient, TpuApiError, NotFoundError, QuotaError
+from .gcp_auth import (AdcUserTokenProvider, AuthError, MetadataTokenProvider,
+                       StaticTokenProvider, default_token_provider)
 from .transport import HttpTransport, TransportError
 from .workload_backend import (ApiWorkloadBackend, SshWorkloadBackend,
                                WorkloadBackend, WorkloadBackendError)
@@ -42,4 +44,9 @@ __all__ = [
     "QuotaError",
     "HttpTransport",
     "TransportError",
+    "AuthError",
+    "StaticTokenProvider",
+    "MetadataTokenProvider",
+    "AdcUserTokenProvider",
+    "default_token_provider",
 ]

@@ -1,0 +1,201 @@
+"""GCP bearer-token providers for the cloud transport.
+
+The reference's static API key lives forever (runpod_client.go:144 sets one
+Authorization header at client construction). GCP OAuth2 access tokens expire
+in ~1h, so a static TPU_API_TOKEN kubelet goes permanently unhealthy after the
+first expiry (VERDICT r2 item 5). The transport instead takes a *provider*
+callable: it returns a currently-valid token, caches it until shortly before
+expiry, and can be invalidated when the API answers 401 (token revoked early,
+clock skew) so the transport's single auth-retry fetches a fresh one.
+
+stdlib-only, like the rest of the control plane. Three providers:
+
+- ``StaticTokenProvider`` — wraps a fixed token (tests, api-key-style gateways,
+  and the fake server).
+- ``MetadataTokenProvider`` — the GCE/GKE metadata server
+  (``computeMetadata/v1/.../token``); the standard in-cluster path, no
+  credentials on disk.
+- ``AdcUserTokenProvider`` — an ``authorized_user`` Application Default
+  Credentials file (``gcloud auth application-default login``): exchanges the
+  refresh token at oauth2.googleapis.com. Service-account *key files* need
+  RS256 JWT signing, which stdlib cannot do — those deployments should use
+  workload identity / the metadata server instead (clear error, not a silent
+  wrong path).
+
+``default_token_provider(cfg_token)`` picks, in order: explicit static token →
+ADC file (GOOGLE_APPLICATION_CREDENTIALS or the gcloud well-known path) →
+metadata server.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+# refresh this long before expiry so an in-flight request never sends a
+# token that dies mid-request
+EXPIRY_SLACK_S = 300.0
+
+_METADATA_TOKEN_URL = ("http://metadata.google.internal/computeMetadata/v1/"
+                       "instance/service-accounts/default/token")
+_OAUTH_TOKEN_URL = "https://oauth2.googleapis.com/token"
+_ADC_WELL_KNOWN = os.path.join("~", ".config", "gcloud",
+                               "application_default_credentials.json")
+
+
+class AuthError(Exception):
+    """Could not obtain a bearer token."""
+
+
+class StaticTokenProvider:
+    """A fixed token: the reference's API-key behavior, provider-shaped.
+    Deliberately has NO ``invalidate()`` — the transport's 401-refresh
+    gate checks for that attribute, so a deterministic 401 with a fixed
+    token fails fast instead of re-issuing the identical request."""
+
+    def __init__(self, token: str):
+        self._token = token
+
+    def __call__(self) -> str:
+        return self._token
+
+
+class _CachingProvider:
+    """Shared cache + expiry logic; subclasses implement _fetch() ->
+    (token, lifetime_s)."""
+
+    def __init__(self, now=time.time):
+        self._now = now
+        self._lock = threading.Lock()
+        self._token: Optional[str] = None
+        self._expires_at = 0.0
+
+    def __call__(self) -> str:
+        with self._lock:
+            if self._token is None or \
+                    self._now() >= self._expires_at - EXPIRY_SLACK_S:
+                token, lifetime = self._fetch()
+                self._token = token
+                self._expires_at = self._now() + lifetime
+            return self._token
+
+    def invalidate(self) -> None:
+        """Drop the cached token (the API said 401) so the next call
+        fetches a fresh one."""
+        with self._lock:
+            self._token = None
+
+    def _fetch(self) -> tuple[str, float]:  # pragma: no cover — abstract
+        raise NotImplementedError
+
+
+class MetadataTokenProvider(_CachingProvider):
+    """GCE/GKE metadata-server tokens (workload identity / attached SA)."""
+
+    def __init__(self, url: str = _METADATA_TOKEN_URL, timeout_s: float = 10.0,
+                 now=time.time):
+        super().__init__(now)
+        self.url = url
+        self.timeout_s = timeout_s
+
+    def _fetch(self) -> tuple[str, float]:
+        req = urllib.request.Request(self.url,
+                                     headers={"Metadata-Flavor": "Google"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                payload = json.loads(resp.read())
+        except (urllib.error.URLError, TimeoutError, OSError,
+                json.JSONDecodeError) as e:
+            raise AuthError(f"metadata server token fetch failed: {e}") from e
+        try:
+            return payload["access_token"], float(payload.get("expires_in", 0))
+        except (KeyError, TypeError) as e:
+            raise AuthError(f"metadata server returned no access_token: "
+                            f"{payload!r}") from e
+
+
+class AdcUserTokenProvider(_CachingProvider):
+    """authorized_user ADC: refresh-token exchange at the OAuth2 endpoint."""
+
+    def __init__(self, adc: dict, token_url: str = _OAUTH_TOKEN_URL,
+                 timeout_s: float = 10.0, now=time.time):
+        super().__init__(now)
+        missing = {"client_id", "client_secret", "refresh_token"} - set(adc)
+        if missing:
+            raise AuthError(f"ADC file missing fields: {sorted(missing)}")
+        self._adc = adc
+        self.token_url = token_url
+        self.timeout_s = timeout_s
+
+    def _fetch(self) -> tuple[str, float]:
+        form = urllib.parse.urlencode({
+            "grant_type": "refresh_token",
+            "client_id": self._adc["client_id"],
+            "client_secret": self._adc["client_secret"],
+            "refresh_token": self._adc["refresh_token"],
+        }).encode()
+        req = urllib.request.Request(
+            self.token_url, data=form, method="POST",
+            headers={"Content-Type": "application/x-www-form-urlencoded"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                payload = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            raise AuthError(f"OAuth2 refresh failed: HTTP {e.code} "
+                            f"{e.read().decode(errors='replace')[:200]}") from e
+        except (urllib.error.URLError, TimeoutError, OSError,
+                json.JSONDecodeError) as e:
+            raise AuthError(f"OAuth2 refresh failed: {e}") from e
+        try:
+            return payload["access_token"], float(payload.get("expires_in", 0))
+        except (KeyError, TypeError) as e:
+            raise AuthError(f"OAuth2 endpoint returned no access_token: "
+                            f"{list(payload)}") from e
+
+
+def _adc_path() -> Optional[str]:
+    explicit = os.environ.get("GOOGLE_APPLICATION_CREDENTIALS")
+    if explicit:
+        return explicit
+    well_known = os.path.expanduser(_ADC_WELL_KNOWN)
+    return well_known if os.path.exists(well_known) else None
+
+
+def default_token_provider(static_token: str = ""):
+    """Provider resolution: explicit token → ADC file → metadata server.
+
+    Mirrors google-auth's ADC order without the dependency. A service-account
+    key file is rejected with guidance (stdlib can't RS256-sign); the
+    metadata-server fallback is returned UNPROBED — first use fails loudly if
+    the kubelet isn't on GCP, which beats hanging a constructor on a probe."""
+    if static_token:
+        return StaticTokenProvider(static_token)
+    path = _adc_path()
+    if path:
+        try:
+            with open(path) as f:
+                adc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise AuthError(f"cannot read ADC file {path}: {e}") from e
+        kind = adc.get("type", "")
+        if kind == "authorized_user":
+            log.info("auth: ADC authorized_user from %s", path)
+            return AdcUserTokenProvider(adc)
+        if kind == "service_account":
+            raise AuthError(
+                "service-account key files need RS256 JWT signing (not in "
+                "the stdlib); run the kubelet with workload identity / an "
+                "attached service account (metadata server) or set "
+                "TPU_API_TOKEN from an external token source")
+        raise AuthError(f"unsupported ADC credential type {kind!r} in {path}")
+    log.info("auth: no static token or ADC file — using the metadata server")
+    return MetadataTokenProvider()

@@ -35,13 +35,23 @@ class HttpTransport:
     """Tiny JSON-over-HTTP client: request(), with bearer auth and retry on 5xx/network.
 
     4xx responses are NOT retried (they are deterministic), mirroring the
-    reference's retry helper which only loops on transport errors and 5xx.
+    reference's retry helper which only loops on transport errors and 5xx —
+    EXCEPT 401 when a refreshable ``token_provider`` is set: GCP access
+    tokens expire hourly (unlike the reference's immortal API key,
+    runpod_client.go:144), so one 401 triggers provider.invalidate() and a
+    single re-issue with a fresh token before giving up.
+
+    ``token_provider`` is any callable returning the current bearer token
+    (see cloud/gcp_auth.py); an optional ``invalidate()`` attribute enables
+    the 401 refresh path. A plain ``token`` string still works and wins if
+    both are given (explicit beats ambient).
     """
 
     def __init__(
         self,
         base_url: str,
         token: str = "",
+        token_provider: Optional[Callable[[], str]] = None,
         timeout_s: float = DEFAULT_TIMEOUT_S,
         max_retries: int = MAX_RETRIES,
         sleep: Callable[[float], None] = time.sleep,
@@ -49,10 +59,18 @@ class HttpTransport:
     ):
         self.base_url = base_url.rstrip("/")
         self.token = token
+        self.token_provider = token_provider
         self.timeout_s = timeout_s
         self.max_retries = max_retries
         self._sleep = sleep
         self.user_agent = user_agent
+
+    def _bearer(self) -> str:
+        if self.token:
+            return self.token
+        if self.token_provider is not None:
+            return self.token_provider()
+        return ""
 
     def request(
         self,
@@ -66,12 +84,28 @@ class HttpTransport:
         url = self.base_url + path
         data = json.dumps(body).encode() if body is not None else None
         last_err: Optional[TransportError] = None
-        for attempt in range(1, self.max_retries + 1):
+        auth_retried = False
+        attempt = 0
+        while attempt < self.max_retries:
+            attempt += 1
             req = urllib.request.Request(url, data=data, method=method)
             req.add_header("Content-Type", "application/json")
             req.add_header("User-Agent", self.user_agent)
-            if self.token:
-                req.add_header("Authorization", f"Bearer {self.token}")
+            try:
+                bearer = self._bearer()
+            except Exception as e:
+                # transient token-fetch failure (metadata-server blip):
+                # rides the same retry/backoff and keeps the TransportError
+                # contract every caller catches
+                last_err = TransportError(
+                    f"{method} {path}: token fetch failed: {e}", status=0)
+                if attempt < self.max_retries:
+                    self._sleep(BACKOFF_BASE_S * attempt)
+                    log.debug("retrying %s %s (attempt %d): %s",
+                              method, path, attempt + 1, last_err)
+                continue
+            if bearer:
+                req.add_header("Authorization", f"Bearer {bearer}")
             try:
                 with urllib.request.urlopen(req, timeout=timeout_s or self.timeout_s) as resp:
                     raw = resp.read()
@@ -86,6 +120,17 @@ class HttpTransport:
                     return json.loads(body_text) if body_text else None
                 last_err = TransportError(
                     f"{method} {path}: HTTP {e.code}", status=e.code, body=body_text)
+                if e.code == 401 and not auth_retried and \
+                        hasattr(self.token_provider, "invalidate") and \
+                        not self.token:
+                    # expired/revoked token: refresh once, re-issue now
+                    # (does not consume a backoff-retry slot)
+                    auth_retried = True
+                    attempt -= 1
+                    self.token_provider.invalidate()
+                    log.info("401 on %s %s — refreshing bearer token",
+                             method, path)
+                    continue
                 if e.code < 500:  # deterministic failure — don't retry
                     raise last_err
             except (urllib.error.URLError, TimeoutError, ConnectionError, OSError) as e:
