@@ -94,31 +94,45 @@ class _Handler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length") or 0)
         return json.loads(self.rfile.read(length)) if length else {}
 
-    def _parse_stop(self, raw) -> list:
+    def _parse_stop(self, raw) -> tuple[list, list]:
         """OpenAI-style ``stop``: a string, list of strings (needs the
-        tokenizer), or list of token lists. Returns token sequences,
-        encoded WITHOUT special tokens (a BOS-prefixed sequence could
-        never match a generated tail). Matching is token-level: exact for
-        byte-level tokenizers; for BPE vocabularies a stop string only
-        matches when the model generates that same tokenization (the
-        common case for delimiters like newlines, but not guaranteed)."""
+        tokenizer), or list of token lists. Returns (token sequences,
+        stop strings) — tokens encoded WITHOUT special tokens (a
+        BOS-prefixed sequence could never match a generated tail).
+
+        Byte-level tokenizers match token-level only (already text-exact:
+        one tokenization per string). BPE vocabularies additionally match
+        the DECODED text in the engine, so a stop string straddling a
+        token boundary still stops generation (the token path stays as a
+        cheap fast path for whole-token delimiters)."""
         if raw is None:
-            return []
+            return [], []
         if isinstance(raw, str):
             raw = [raw]
-        out = []
+        toks_out, strs_out = [], []
         for s in raw:
             if isinstance(s, str):
                 if self.tokenizer is None:
                     raise ValueError("string stop sequences need --tokenizer")
                 toks = self.tokenizer.encode_plain(s)
                 if toks:
-                    out.append(toks)
+                    toks_out.append(toks)
+                if s and not getattr(self.tokenizer, "byte_exact", False):
+                    strs_out.append(s)
             elif isinstance(s, list):
-                out.append(s)
+                toks_out.append(s)
             else:
                 raise ValueError("stop must be string(s) or token lists")
-        return out
+        return toks_out, strs_out
+
+    def _cut_at_stop(self, text: str, stop_strs: list) -> tuple[str, bool]:
+        """Truncate at the first occurrence of any stop string (OpenAI
+        semantics: stop text never reaches the client)."""
+        idxs = [text.find(s) for s in stop_strs]
+        idxs = [i for i in idxs if i >= 0]
+        if idxs:
+            return text[:min(idxs)], True
+        return text, False
 
     def do_POST(self):
         if self.path == "/v1/completions":
@@ -186,14 +200,15 @@ class _Handler(BaseHTTPRequestHandler):
         if req.get("stream"):
             return self._generate_stream(tokens, req)
         try:
-            stop = self._parse_stop(req.get("stop"))
+            stop, stop_strs = self._parse_stop(req.get("stop"))
         except ValueError as e:
             return self._send(400, {"error": str(e)})
         fut = self.engine.submit(tokens, req.get("max_new_tokens"),
                                  req.get("temperature"),
                                  top_k=_or(req.get("top_k"), 0),
                                  top_p=_or(req.get("top_p"), 1.0),
-                                 stop=stop, logprobs=bool(req.get("logprobs")),
+                                 stop=stop, stop_text=stop_strs,
+                                 logprobs=bool(req.get("logprobs")),
                                  adapter=req.get("adapter") or "",
                                  seed=req.get("seed"))
         try:
@@ -207,7 +222,10 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send(500, {"error": str(e)})
         if self.tokenizer is not None:
             out = dict(out)
-            out["text"] = self.tokenizer.decode(out["tokens"])
+            text = self.tokenizer.decode(out["tokens"])
+            if stop_strs:  # BPE text stop: truncate at its first occurrence
+                text, _ = self._cut_at_stop(text, stop_strs)
+            out["text"] = text
         self._send(200, out)
 
     def _stream_pump(self, tokens: list, kw: dict, ctype: str, fmt: dict):
@@ -322,7 +340,7 @@ class _Handler(BaseHTTPRequestHandler):
                     raise ValueError("prompt must be a string or token list")
             if not tokens:
                 raise ValueError("empty prompt")
-            stop = self._parse_stop(req.get("stop"))
+            stop, stop_strs = self._parse_stop(req.get("stop"))
             n = req.get("n")
             n = 1 if n is None else n
             if not isinstance(n, int) or isinstance(n, bool) \
@@ -358,6 +376,7 @@ class _Handler(BaseHTTPRequestHandler):
             kw = dict(max_new_tokens=req.get("max_tokens"),
                       temperature=_or(req.get("temperature"), 1.0),
                       top_p=_or(req.get("top_p"), 1.0), stop=stop,
+                      stop_text=stop_strs,
                       logprobs=want_lp, adapter=adapter, seed=seed)
         except (json.JSONDecodeError, ValueError, TypeError) as e:
             return self._send(400, {"error": {"message": f"{e}",
@@ -380,6 +399,19 @@ class _Handler(BaseHTTPRequestHandler):
         def decode(toks: list) -> str:
             return (self.tokenizer.decode(toks) if self.tokenizer is not None
                     else "")
+
+        def finish_text(all_toks: list) -> tuple[str, list, str]:
+            """(reason, stripped tokens, final text) — token-level strip
+            first, then the BPE-exact text cut at the first stop-string
+            occurrence (a straddling stop survives the token strip but
+            must still never reach the client)."""
+            reason, toks = finish_reason(all_toks)
+            text = decode(toks)
+            if stop_strs:
+                text, hit = self._cut_at_stop(text, stop_strs)
+                if hit:
+                    reason = "stop"
+            return reason, toks, text
 
         first_chunk = [True]
 
@@ -411,6 +443,11 @@ class _Handler(BaseHTTPRequestHandler):
             pending: list = []   # tokens still inside the stop-tail window
             released: list = []  # tokens cleared for emission, cumulative
             sent = [0]           # chars of decode(released) already streamed
+            # text-exact stops additionally hold back the longest stop-
+            # string length - 1 CHARS: a partial stop at the text tail may
+            # still complete, and emitted text can't be retracted
+            char_hold = max([len(s) for s in stop_strs] or [1]) - 1
+            text_hit = [False]   # a stop string appeared in decoded text
 
             def text_delta(final: bool) -> str:
                 """Incremental decode by cumulative diff: per-fragment
@@ -421,6 +458,13 @@ class _Handler(BaseHTTPRequestHandler):
                 text = decode(released)
                 if not final and text.endswith("�"):
                     text = text[:-1]
+                if stop_strs:
+                    cut, hit = self._cut_at_stop(text, stop_strs)
+                    if hit:
+                        text_hit[0] = True
+                        text = cut
+                    elif not final and char_hold:
+                        text = text[:max(sent[0], len(text) - char_hold)]
                 delta = text[sent[0]:]
                 sent[0] += len(delta)
                 return delta
@@ -442,6 +486,8 @@ class _Handler(BaseHTTPRequestHandler):
                                 if n_strip else pending)
                 bodies = []
                 delta = text_delta(final=True)
+                if text_hit[0]:  # BPE text stop fired (or is being cut now)
+                    reason = "stop"
                 if delta:
                     bodies.append(sse(chunk_obj(delta)))
                 bodies.append(sse(chunk_obj("", reason)))
@@ -492,13 +538,13 @@ class _Handler(BaseHTTPRequestHandler):
                                               "type": "server_error"}})
         choices = []
         for i, out in enumerate(outs):
-            reason, toks = finish_reason(out["tokens"])
+            reason, toks, text = finish_text(out["tokens"])
             if chat:
                 choice: dict = {"index": i, "finish_reason": reason,
                                 "message": {"role": "assistant",
-                                            "content": decode(toks)}}
+                                            "content": text}}
             else:
-                choice = {"text": decode(toks), "index": i,
+                choice = {"text": text, "index": i,
                           "logprobs": None, "finish_reason": reason}
                 if kw["logprobs"]:
                     choice["logprobs"] = {
@@ -518,13 +564,14 @@ class _Handler(BaseHTTPRequestHandler):
         """Chunked NDJSON over the shared pump: one {"token": N} line per
         decoded token, then the final result object (or {"error": ...})."""
         try:
-            stop = self._parse_stop(req.get("stop"))
+            stop, stop_strs = self._parse_stop(req.get("stop"))
         except ValueError as e:
             return self._send(400, {"error": str(e)})
         kw = dict(max_new_tokens=req.get("max_new_tokens"),
                   temperature=req.get("temperature"),
                   top_k=_or(req.get("top_k"), 0),
                   top_p=_or(req.get("top_p"), 1.0), stop=stop,
+                  stop_text=stop_strs,
                   adapter=req.get("adapter") or "", seed=req.get("seed"))
 
         def line(payload: dict) -> bytes:
@@ -535,7 +582,11 @@ class _Handler(BaseHTTPRequestHandler):
         def fmt_end(out) -> list:
             if self.tokenizer is not None:
                 out = dict(out)
-                out["text"] = self.tokenizer.decode(out["tokens"])
+                text = self.tokenizer.decode(out["tokens"])
+                if stop_strs:  # raw token lines already streamed; the
+                    # text field honors the text-exact stop
+                    text, _ = self._cut_at_stop(text, stop_strs)
+                out["text"] = text
             return [line(out)]
 
         return self._stream_pump(
@@ -649,7 +700,11 @@ def main(argv=None) -> int:
         speculate_k=args.speculate,
         # text mode stops at the tokenizer's EOS instead of always burning
         # the full max_new_tokens budget
-        eos_token=(tokenizer.eos_id if tokenizer is not None else -1))).start()
+        eos_token=(tokenizer.eos_id if tokenizer is not None else -1)),
+        # decoded-text stop matching (BPE-exact stops) needs the engine
+        # to see text, not just token ids
+        decode_fn=(tokenizer.decode if tokenizer is not None else None)
+        ).start()
     httpd = serve(engine, args.port, tokenizer=tokenizer,
                   allow_adapters=args.dynamic_adapters)
     log.info("serving on :%d (POST /generate, GET /metrics)", args.port)

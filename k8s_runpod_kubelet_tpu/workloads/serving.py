@@ -111,6 +111,12 @@ class Request:
     # one (the matched sequence stays in the output; callers strip it).
     # Checked host-side per committed token — no jit impact.
     stop: list = dataclasses.field(default_factory=list)
+    # stop STRINGS matched on DECODED text (needs the engine's decode_fn):
+    # exact for BPE vocabularies where a stop string can straddle a token
+    # boundary and the token-sequence fast path above would miss it.
+    # Generation ends when the decoded output contains one; the matched
+    # text stays in the output (callers truncate at its first occurrence).
+    stop_texts: list = dataclasses.field(default_factory=list)
     # return per-token log P(token | prefix) of each generated token
     logprobs: bool = False
     # sampling seed (resolved at submit): the PRNG stream is a pure
@@ -129,6 +135,18 @@ class Request:
     # (nothing donates the single cache, so sharing is safe); each member
     # samples its own first token from the shared last-position logits
     fanout: Optional[list] = None
+
+
+@dataclasses.dataclass
+class _PrefixEntry:
+    """One registered prompt prefix. ``variants`` maps adapter_id ->
+    (last_logits, single-slot cache); id 0 (base model) is created at
+    registration, adapter variants fill lazily on first use (their KV
+    differs — adapter deltas flow into K/V). ``lru`` tracks adapter-variant
+    recency for eviction."""
+    tokens: list
+    variants: dict
+    lru: dict = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -200,9 +218,13 @@ def _sample(logits: jax.Array, keys: jax.Array, temps: list[float],
 
 class ServingEngine:
     def __init__(self, cfg: LlamaConfig, params: Params, sc: ServingConfig,
-                 metrics: Optional[Metrics] = None, seed: int = 0):
+                 metrics: Optional[Metrics] = None, seed: int = 0,
+                 decode_fn=None):
         self.cfg = cfg
         self.sc = sc
+        # tokens -> text, for text-exact (BPE-safe) stop strings; the
+        # engine stays tokenizer-agnostic — the HTTP layer injects this
+        self._decode_fn = decode_fn
         self.model = LlamaModel(cfg)
         if sc.quantize_int8:
             from ..models.quant import quantize_params
@@ -214,10 +236,15 @@ class ServingEngine:
         # the HPA scrapes from pod start — the signal must exist before traffic
         self.metrics.set_gauge("tpu_serving_queue_depth", 0)
         self.metrics.set_gauge("tpu_serving_active_slots", 0)
-        # registered prompt prefixes: (tokens, last_logits, single cache),
-        # longest first; read by the prefill thread, written by callers
-        self._prefixes: list[tuple[list[int], Any, Params]] = []
+        # registered prompt prefixes, longest first; read by the prefill
+        # thread, written by callers. Each entry holds per-ADAPTER KV
+        # variants (adapter KV differs from base KV for the same tokens),
+        # filled lazily on first hit so multi-LoRA tenants share the
+        # system-prompt cache too; adapter variants are LRU-bounded by
+        # max_prefixes while base variants stay pinned
+        self._prefixes: list[_PrefixEntry] = []
         self._prefix_lock = threading.Lock()
+        self._prefix_clock = 0  # LRU counter for adapter variants
         self._queue: "queue.Queue[Request]" = queue.Queue()
         # extra members carried by queued groups (submit_group): adds to
         # queue_depth so the HPA signal sees n requests, not 1.
@@ -349,7 +376,8 @@ class ServingEngine:
     def submit(self, prompt: list[int], max_new_tokens: Optional[int] = None,
                temperature: Optional[float] = None,
                top_k: int = 0, top_p: float = 1.0,
-               stop: Optional[list] = None, logprobs: bool = False,
+               stop: Optional[list] = None,
+               stop_text: Optional[list] = None, logprobs: bool = False,
                adapter: str = "", seed: Optional[int] = None,
                on_token=None, _build_only: bool = False):
         """Enqueue a generation request; resolves to {tokens, latency_s, rid}
@@ -408,6 +436,18 @@ class ServingEngine:
             f.set_exception(ValueError(
                 "stop must be a list of non-empty token lists"))
             return f
+        stop_text = stop_text or []
+        if not (isinstance(stop_text, list) and all(
+                isinstance(s, str) and s for s in stop_text)):
+            f = Future()
+            f.set_exception(ValueError(
+                "stop_text must be a list of non-empty strings"))
+            return f
+        if stop_text and self._decode_fn is None:
+            f = Future()
+            f.set_exception(ValueError(
+                "stop_text needs an engine decode_fn (tokenizer)"))
+            return f
         adapter_id = 0
         if adapter:
             with self._adapter_lock:
@@ -431,7 +471,8 @@ class ServingEngine:
                       submitted_at=time.perf_counter(),
                       temperature=float(temperature),
                       top_k=top_k, top_p=float(top_p),
-                      stop=[list(s) for s in stop], logprobs=bool(logprobs),
+                      stop=[list(s) for s in stop],
+                      stop_texts=list(stop_text), logprobs=bool(logprobs),
                       adapter_id=adapter_id, seed=seed & 0xFFFFFFFF,
                       on_token=on_token)
         if _build_only:
@@ -592,41 +633,74 @@ class ServingEngine:
             return None
         return jnp.asarray([adapter_id], jnp.int32)
 
+    def _prefill_raw(self, tokens: list[int], adapter_id: int,
+                     adapters) -> tuple[Any, Params]:
+        """Prefill WITHOUT prefix-cache consultation: head through the
+        bucketed prefill jit, remainder chunked through the verify kernel."""
+        single = self._fresh_cache(1)
+        head = tokens[:self.sc.max_prefill_len]
+        prompt, true_len = self._padded(head)
+        last_logits, single = self._prefill(
+            self.params, prompt, single, true_len, adapters,
+            self._single_ad_ids(adapter_id))
+        return self._append_chunks(single, tokens[len(head):], last_logits,
+                                   adapter_id, adapters)
+
     def _prefill_tokens(self, tokens: list[int], adapter_id: int = 0
                         ) -> tuple[Any, Params]:
         """Full prompt -> (last_logits, single-request cache). The head goes
         through the prefill jit (bucketed to a few fixed lengths so it
         compiles once per bucket, not per prompt length); a prompt longer
         than max_prefill_len continues CHUNKED through the verify kernel.
+
         A registered prefix of the prompt skips straight to its stored
-        cache and appends only the suffix (base-model requests only —
-        prefix KV computed with the base would be wrong under an
-        adapter)."""
-        start = 0
-        last_logits = None
-        single = None
-        hit = None
+        cache and appends only the suffix. Adapter requests hit the cache
+        too: the prefix KV under an adapter differs from the base's, so
+        each entry keeps PER-ADAPTER variants, computed lazily on an
+        adapter's first request (that request pays one prefix prefill;
+        every later one skips it) and LRU-evicted past max_prefixes."""
         adapters = self._adapters  # one snapshot per request: a concurrent
         # re-registration must not mix weights between head and chunks
-        if adapter_id == 0:
+        with self._prefix_lock:
+            entry = next((e for e in self._prefixes
+                          if len(e.tokens) <= len(tokens)
+                          and tokens[:len(e.tokens)] == e.tokens), None)
+            var = entry.variants.get(adapter_id) if entry is not None else None
+            if var is not None and adapter_id != 0:
+                self._prefix_clock += 1
+                entry.lru[adapter_id] = self._prefix_clock
+        if entry is None:
+            return self._prefill_raw(tokens, adapter_id, adapters)
+        if var is None:
+            # first request from this adapter: build its prefix variant
+            var = self._prefill_raw(entry.tokens, adapter_id, adapters)
             with self._prefix_lock:
-                hit = next((p for p in self._prefixes
-                            if len(p[0]) <= len(tokens)
-                            and tokens[:len(p[0])] == p[0]), None)
-        if hit is not None:
-            ptoks, last_logits, single = hit
-            start = len(ptoks)
-            self.metrics.incr("tpu_serving_prefix_hits")
+                if adapter_id not in entry.variants:
+                    entry.variants[adapter_id] = var
+                    self._prefix_clock += 1
+                    entry.lru[adapter_id] = self._prefix_clock
+                    self._evict_adapter_variants_locked()
+            self.metrics.incr("tpu_serving_prefix_adapter_fills")
         else:
-            single = self._fresh_cache(1)
-            head = tokens[:self.sc.max_prefill_len]
-            prompt, true_len = self._padded(head)
-            last_logits, single = self._prefill(
-                self.params, prompt, single, true_len, adapters,
-                self._single_ad_ids(adapter_id))
-            start = len(head)
-        return self._append_chunks(single, tokens[start:], last_logits,
-                                   adapter_id, adapters)
+            self.metrics.incr("tpu_serving_prefix_hits")
+        last_logits, single = var
+        return self._append_chunks(single, tokens[len(entry.tokens):],
+                                   last_logits, adapter_id, adapters)
+
+    def _evict_adapter_variants_locked(self):
+        """Drop least-recently-used ADAPTER prefix variants past the
+        max_prefixes budget (base variants stay pinned — they were
+        explicitly registered). Caller holds _prefix_lock."""
+        cap = self.sc.max_prefixes
+        while True:
+            ad_vars = [(e.lru.get(aid, 0), e, aid)
+                       for e in self._prefixes
+                       for aid in e.variants if aid != 0]
+            if len(ad_vars) <= cap:
+                return
+            _, entry, aid = min(ad_vars, key=lambda t: t[0])
+            del entry.variants[aid]
+            entry.lru.pop(aid, None)
 
     def register_adapter(self, name: str, source) -> None:
         """Install a LoRA adapter into a free slot of the preallocated
@@ -683,6 +757,12 @@ class ServingEngine:
                                "scale": ad["scale"].at[:, slot].set(scale)}
             self._adapters = new_tree
             self._adapter_names[name] = slot
+        # a RE-registered adapter slot carries new weights: its cached
+        # prefix variants were computed with the old ones — drop them
+        with self._prefix_lock:
+            for e in self._prefixes:
+                e.variants.pop(slot, None)
+                e.lru.pop(slot, None)
 
     def register_prefix(self, tokens: list[int]) -> None:
         """Cache the KV of a shared prompt prefix (system prompt) ONCE; any
@@ -701,7 +781,7 @@ class ServingEngine:
                              f"{self.sc.cache_len - 1}")
         tokens = list(tokens)
         with self._prefix_lock:
-            if any(p[0] == tokens for p in self._prefixes):
+            if any(e.tokens == tokens for e in self._prefixes):
                 return  # idempotent
             if len(self._prefixes) >= self.sc.max_prefixes:
                 raise ValueError(
@@ -710,7 +790,7 @@ class ServingEngine:
                     "restart to clear")
         logits, single = self._prefill_tokens(tokens)
         with self._prefix_lock:
-            if any(p[0] == tokens for p in self._prefixes):
+            if any(e.tokens == tokens for e in self._prefixes):
                 return  # raced with an identical registration
             if len(self._prefixes) >= self.sc.max_prefixes:
                 # re-check: a concurrent registration may have filled the
@@ -719,8 +799,9 @@ class ServingEngine:
                     f"prefix registry full ({self.sc.max_prefixes}); each "
                     "entry pins a KV cache in HBM — raise max_prefixes or "
                     "restart to clear")
-            self._prefixes.append((tokens, logits, single))
-            self._prefixes.sort(key=lambda p: -len(p[0]))  # longest first
+            self._prefixes.append(
+                _PrefixEntry(tokens=tokens, variants={0: (logits, single)}))
+            self._prefixes.sort(key=lambda e: -len(e.tokens))  # longest first
 
     def _prefill_loop(self):
         """Dedicated prefill worker: drains the request queue, runs the
@@ -991,8 +1072,20 @@ class ServingEngine:
         if slot.remaining <= 0 or slot.last_token == self.sc.eos_token:
             return True
         gen = slot.generated
-        return any(len(s) <= len(gen) and gen[-len(s):] == s
-                   for s in slot.request.stop)
+        if any(len(s) <= len(gen) and gen[-len(s):] == s
+               for s in slot.request.stop):
+            return True
+        if slot.request.stop_texts:
+            # BPE-exact: a stop string straddling a token boundary never
+            # equals a generated token tail, but it IS in the decoded text.
+            # Decode only a TAIL window (any new match must end in the
+            # newest token, so max-stop-chars of lookback + slack covers
+            # it): keeps this host-side check O(stop_len) per step instead
+            # of O(generated²) per request in the shared engine loop.
+            max_chars = max(len(s) for s in slot.request.stop_texts)
+            text = self._decode_fn(gen[-(max_chars + 8):])
+            return any(s in text for s in slot.request.stop_texts)
+        return False
 
     def _complete(self, slot_id: int, slot: _Slot):
         req = slot.request

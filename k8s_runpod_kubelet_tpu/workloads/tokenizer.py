@@ -39,6 +39,9 @@ class ByteTokenizer:
     for any text; needs model vocab >= 257 (EOS optional at >= 256)."""
 
     vocab_size = 257
+    # token-level stop matching is already text-exact here: every string
+    # has exactly one tokenization, so no decoded-text fallback is needed
+    byte_exact = True
 
     @property
     def eos_id(self) -> int:
@@ -60,6 +63,10 @@ class ByteTokenizer:
 
 
 class HfTokenizer:
+    # BPE: one string, many tokenizations — a stop string can straddle a
+    # token boundary, so text-exact stops need the decoded-text path
+    byte_exact = False
+
     def __init__(self, path: str):
         from transformers import AutoTokenizer
         self._tok = AutoTokenizer.from_pretrained(path)

@@ -13,6 +13,11 @@ import pytest
 from k8s_runpod_kubelet_tpu.models import init_params, tiny_llama
 from k8s_runpod_kubelet_tpu.workloads.serving import ServingConfig, ServingEngine
 
+import pytest as _pytest
+
+# ML tier: jax compiles dominate runtime; excluded by -m 'not slow'
+pytestmark = _pytest.mark.slow
+
 CFG = tiny_llama(vocab_size=128, embed_dim=64, n_layers=2, n_heads=4,
                  n_kv_heads=2, mlp_dim=128, max_seq_len=256,
                  dtype=jnp.float32, param_dtype=jnp.float32)
@@ -125,6 +130,125 @@ class TestPrefixCache:
         finally:
             e.stop()
             e_plain.stop()
+
+
+class TestPrefixWithLora:
+    """Adapter requests hit the prefix cache (VERDICT r2 item 7): per-
+    adapter KV variants fill lazily on first use, then later requests
+    skip the shared-prefix prefill like base requests do."""
+
+    RANK = 4
+    TARGETS = ("wq", "wv")
+
+    def _lora(self, params, seed):
+        from k8s_runpod_kubelet_tpu.models import LoraConfig, apply_lora
+        lc = LoraConfig(rank=self.RANK, alpha=8.0, targets=self.TARGETS)
+        wrapped = apply_lora(CFG, params, lc, jax.random.PRNGKey(seed))
+        layers = dict(wrapped["layers"])
+        key = jax.random.PRNGKey(seed + 100)
+        for t in self.TARGETS:
+            w = dict(layers[t])
+            key, sub = jax.random.split(key)
+            w["lora_b"] = jax.random.normal(sub, w["lora_b"].shape,
+                                            w["lora_b"].dtype) * 0.05
+            layers[t] = w
+        return {**wrapped, "layers": layers}
+
+    def _lora_engine(self, params, **kw):
+        sc = ServingConfig(slots=2, max_prefill_len=8, cache_len=64,
+                           max_new_tokens=12, lora_rank=self.RANK,
+                           lora_targets=self.TARGETS, **kw)
+        return ServingEngine(CFG, params, sc).start()
+
+    def test_adapter_requests_hit_prefix_cache(self, params):
+        e = self._lora_engine(params)
+        e_plain = self._lora_engine(params)   # no prefix registered
+        wrapped = self._lora(params, seed=1)
+        e.register_adapter("tenant-a", wrapped)
+        e_plain.register_adapter("tenant-a", wrapped)
+        e.register_prefix(PREFIX)
+        try:
+            p = PREFIX + [42, 17]
+            # first adapter request pays the lazy variant fill...
+            a1 = e.submit(p, max_new_tokens=12,
+                          adapter="tenant-a").result(timeout=60)
+            # ...later ones (same or different suffix) hit the cache
+            a2 = e.submit(p, max_new_tokens=12,
+                          adapter="tenant-a").result(timeout=60)
+            a3 = e.submit(PREFIX + [9], max_new_tokens=12,
+                          adapter="tenant-a").result(timeout=60)
+            b1 = e_plain.submit(p, max_new_tokens=12,
+                                adapter="tenant-a").result(timeout=60)
+            b3 = e_plain.submit(PREFIX + [9], max_new_tokens=12,
+                                adapter="tenant-a").result(timeout=60)
+            assert a1["tokens"] == b1["tokens"] == a2["tokens"]
+            assert a3["tokens"] == b3["tokens"]
+            m = e.metrics.render()
+            assert "tpu_serving_prefix_adapter_fills_total 1" in m
+            assert "tpu_serving_prefix_hits_total 2" in m
+        finally:
+            e.stop()
+            e_plain.stop()
+
+    def test_adapter_and_base_variants_are_distinct(self, params):
+        """The base's cached prefix KV must never serve an adapter request
+        (adapter deltas flow into K/V of the prefix span too)."""
+        e = self._lora_engine(params)
+        e.register_adapter("tenant-a", self._lora(params, seed=1))
+        e.register_prefix(PREFIX)
+        try:
+            p = PREFIX + [42]
+            base = e.submit(p, max_new_tokens=12).result(timeout=60)
+            ad1 = e.submit(p, max_new_tokens=12,
+                           adapter="tenant-a").result(timeout=60)
+            ad2 = e.submit(p, max_new_tokens=12,
+                           adapter="tenant-a").result(timeout=60)
+            assert ad1["tokens"] == ad2["tokens"]
+            assert base["tokens"] != ad1["tokens"]  # adapter really applied
+        finally:
+            e.stop()
+
+    def test_reregistration_drops_stale_variant(self, params):
+        """Re-registering an adapter name replaces its weights — a prefix
+        variant cached under the old weights must not serve the new ones."""
+        e = self._lora_engine(params)
+        e.register_prefix(PREFIX)
+        e.register_adapter("t", self._lora(params, seed=1))
+        try:
+            p = PREFIX + [42]
+            e.submit(p, max_new_tokens=8, adapter="t").result(timeout=60)
+            e.register_adapter("t", self._lora(params, seed=2))  # new weights
+            got = e.submit(p, max_new_tokens=8,
+                           adapter="t").result(timeout=60)
+            fresh = self._lora_engine(params)
+            fresh.register_adapter("t", self._lora(params, seed=2))
+            try:
+                want = fresh.submit(p, max_new_tokens=8,
+                                    adapter="t").result(timeout=60)
+            finally:
+                fresh.stop()
+            assert got["tokens"] == want["tokens"]
+        finally:
+            e.stop()
+
+    def test_adapter_variants_lru_bounded(self, params):
+        e = self._lora_engine(params, max_prefixes=2, max_adapters=4)
+        e.register_prefix(PREFIX)
+        for i in range(4):
+            e.register_adapter(f"t{i}", self._lora(params, seed=i + 1))
+        try:
+            for i in range(4):   # 4 adapter variants > cap of 2
+                e.submit(PREFIX + [i], max_new_tokens=4,
+                         adapter=f"t{i}").result(timeout=60)
+            n_vars = sum(1 for entry in e._prefixes
+                         for aid in entry.variants if aid != 0)
+            assert n_vars <= 2
+            # the cache still answers correctly after evictions
+            out = e.submit(PREFIX + [0], max_new_tokens=4,
+                           adapter="t0").result(timeout=60)
+            assert len(out["tokens"]) == 4
+        finally:
+            e.stop()
 
 
 class TestPrefixHttp:
