@@ -42,6 +42,14 @@ class Config:
     workload_path: str = "ssh"
     max_cost_per_hr: float = 0.0  # 0 = unlimited; actually enforced, unlike the
                                   # reference's --max-gpu-price (SURVEY.md §5.6)
+    # total google.com/tpu chips this node advertises as allocatable — the
+    # operator's cloud-quota ceiling. The K8s scheduler subtracts bound
+    # pods' requests from allocatable itself, so this single number is what
+    # bounds concurrently-bound chips (pods past it stay Unschedulable
+    # instead of queueing invisibly in the cloud). 0 = the largest catalog
+    # slice (parity-equivalent of the reference's static nvidia.com/gpu:4,
+    # kubelet.go:1129, but configurable and quota-honest).
+    max_total_chips: int = 0
 
     # control loop timing (reference parity, kubelet.go)
     reconcile_interval_s: float = 30.0       # status poll        (kubelet.go:293)
@@ -113,6 +121,7 @@ _ENV_MAP = {
     "NAMESPACE": "namespace",
     "SENTRY_URL": "sentry_url",
     "LOG_LEVEL": "log_level",
+    "TPU_MAX_TOTAL_CHIPS": "max_total_chips",
 }
 
 

@@ -18,7 +18,16 @@ TAINT_VALUE = "tpu"
 
 def build_node(cfg: Config, *, cloud_healthy: bool = True,
                kubelet_port: int = 10250) -> dict:
-    max_chips = max(a.chips for a in ACCELERATOR_CATALOG.values())
+    """``google.com/tpu`` capacity/allocatable comes from
+    ``cfg.max_total_chips`` (the operator's cloud-quota ceiling). The K8s
+    scheduler itself subtracts bound pods' requests from allocatable —
+    the kubelet must NOT pre-decrement (that would double-count every
+    bound chip) — so this one number is what bounds concurrently-bound
+    chips: pods past it go Unschedulable instead of queueing invisibly
+    in the cloud. Replaces the reference's static nvidia.com/gpu:4
+    fiction (kubelet.go:1129) with a configurable, quota-honest value."""
+    max_chips = cfg.max_total_chips or \
+        max(a.chips for a in ACCELERATOR_CATALOG.values())
     generations = sorted({a.generation for a in ACCELERATOR_CATALOG.values()})
     ready = "True" if cloud_healthy else "False"
     now = ko.now_iso()
@@ -41,6 +50,7 @@ def build_node(cfg: Config, *, cloud_healthy: bool = True,
         "pods": "100",          # parity: kubelet.go:1133
         "google.com/tpu": str(max_chips),
     }
+    allocatable = dict(capacity)  # scheduler subtracts bound pods itself
     return {
         "apiVersion": "v1",
         "kind": "Node",
@@ -62,7 +72,7 @@ def build_node(cfg: Config, *, cloud_healthy: bool = True,
         },
         "status": {
             "capacity": capacity,
-            "allocatable": dict(capacity),
+            "allocatable": allocatable,
             "conditions": conditions,
             "addresses": [
                 {"type": "InternalIP", "address": cfg.internal_ip},
