@@ -19,7 +19,8 @@ from .types import (
 )
 from .tpu_client import TpuClient, TpuApiError, NotFoundError, QuotaError
 from .gcp_auth import (AdcUserTokenProvider, AuthError, MetadataTokenProvider,
-                       StaticTokenProvider, default_token_provider)
+                       StaticTokenProvider, default_token_provider,
+                       is_google_api_endpoint)
 from .transport import HttpTransport, TransportError
 from .workload_backend import (ApiWorkloadBackend, SshWorkloadBackend,
                                WorkloadBackend, WorkloadBackendError)
@@ -49,4 +50,5 @@ __all__ = [
     "MetadataTokenProvider",
     "AdcUserTokenProvider",
     "default_token_provider",
+    "is_google_api_endpoint",
 ]

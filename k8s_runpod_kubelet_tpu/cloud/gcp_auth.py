@@ -162,6 +162,14 @@ class AdcUserTokenProvider(_CachingProvider):
                             f"{list(payload)}") from e
 
 
+def is_google_api_endpoint(url: str) -> bool:
+    """True iff the URL's HOST is googleapis.com (or a subdomain) — the gate
+    for attaching ambient GCP credentials. A substring check would match
+    attacker-controlled hosts like evilgoogleapis.com or path segments."""
+    host = urllib.parse.urlsplit(url).hostname or ""
+    return host == "googleapis.com" or host.endswith(".googleapis.com")
+
+
 def _adc_path() -> Optional[str]:
     explicit = os.environ.get("GOOGLE_APPLICATION_CREDENTIALS")
     if explicit:

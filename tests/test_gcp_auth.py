@@ -238,6 +238,19 @@ class TestTransport401Refresh:
             api.srv.shutdown()
 
 
+class TestGoogleEndpointGate:
+    def test_host_match_only(self):
+        from k8s_runpod_kubelet_tpu.cloud import is_google_api_endpoint
+        assert is_google_api_endpoint("https://tpu.googleapis.com")
+        assert is_google_api_endpoint("https://googleapis.com/v2")
+        # substring tricks must NOT attach ambient credentials
+        assert not is_google_api_endpoint("https://evilgoogleapis.com/v2")
+        assert not is_google_api_endpoint(
+            "https://aggregator.example/googleapis.com/proxy")
+        assert not is_google_api_endpoint("http://127.0.0.1:8080")
+        assert not is_google_api_endpoint("")
+
+
 class TestDefaultProviderResolution:
     def test_static_token_wins(self, monkeypatch, tmp_path):
         monkeypatch.setenv("GOOGLE_APPLICATION_CREDENTIALS",
