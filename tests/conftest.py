@@ -37,7 +37,21 @@ def _bound_xla_compiler_state():
     backend_compile_and_load) only deep into such runs — never when the
     same tests run standalone. Per-module clearing bounds that state at
     a small recompile cost; module-scoped fixtures (params trees etc.)
-    are plain arrays and survive just fine."""
+    are plain arrays and survive just fine.
+
+    PINNED REPRO (r2, twice observed; r3 keeps the workaround): run the
+    full ML tier WITHOUT this fixture —
+        python -m pytest tests/ -q -m slow -p no:cacheprovider
+    (comment out the jax.clear_caches() below first). The crash lands
+    ~350 distinct executables in, inside XLA:CPU's
+    backend_compile_and_load -> SimpleOrcJIT, i.e. JIT code-emission
+    state, not any single test's math — every module passes standalone
+    and the full run passes with per-module clearing. Suspected
+    accumulation bug in the CPU ORC JIT under hundreds of live
+    executables (jaxlib pinned by the image; not reproducible to fix
+    here). If a jaxlib upgrade lands, re-try the repro before deleting
+    the workaround. The fast tier (-m "not slow") never compiles, so it
+    is unaffected by construction."""
     yield
     try:
         import jax as _jax

@@ -11,6 +11,11 @@ import pytest
 from k8s_runpod_kubelet_tpu.data import (NativeTokenLoader, PyTokenLoader,
                                          make_loader, native_available)
 
+import pytest as _pytest
+
+# ML tier: jax compiles dominate runtime; excluded by -m 'not slow'
+pytestmark = _pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def token_file(tmp_path_factory):

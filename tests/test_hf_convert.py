@@ -22,6 +22,11 @@ from k8s_runpod_kubelet_tpu.models import LlamaModel, tiny_llama, tiny_moe
 from k8s_runpod_kubelet_tpu.models.convert import (from_hf_state_dict, load_hf,
                                                    to_hf_state_dict)
 
+import pytest as _pytest
+
+# ML tier: jax compiles dominate runtime; excluded by -m 'not slow'
+pytestmark = _pytest.mark.slow
+
 B, S = 2, 16
 
 

@@ -13,6 +13,11 @@ from k8s_runpod_kubelet_tpu.models import (LlamaModel, LoraConfig, apply_lora,
                                            tiny_llama)
 from k8s_runpod_kubelet_tpu.workloads.train import TrainConfig, Trainer
 
+import pytest as _pytest
+
+# ML tier: jax compiles dominate runtime; excluded by -m 'not slow'
+pytestmark = _pytest.mark.slow
+
 
 def _cfg(**kw):
     base = dict(vocab_size=128, embed_dim=64, n_layers=2, n_heads=4,

@@ -13,6 +13,11 @@ import pytest
 from k8s_runpod_kubelet_tpu.models import LlamaModel, init_params, tiny_llama
 from k8s_runpod_kubelet_tpu.workloads.serving import ServingConfig, ServingEngine
 
+import pytest as _pytest
+
+# ML tier: jax compiles dominate runtime; excluded by -m 'not slow'
+pytestmark = _pytest.mark.slow
+
 # Gemma-2-shaped tiny config: W=8 local / global alternating, soft caps,
 # sandwich norms; ring R=16 wraps quickly
 G2 = tiny_llama(name="tiny-g2", vocab_size=128, embed_dim=64, n_layers=4,

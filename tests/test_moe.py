@@ -20,6 +20,11 @@ from k8s_runpod_kubelet_tpu.parallel import (MeshConfig, make_mesh,
 from k8s_runpod_kubelet_tpu.workloads.train import (TrainConfig, Trainer,
                                                     synthetic_batches)
 
+import pytest as _pytest
+
+# ML tier: jax compiles dominate runtime; excluded by -m 'not slow'
+pytestmark = _pytest.mark.slow
+
 # capacity_factor = n_experts ⇒ capacity ≥ any possible expert load, so the
 # batched forward never drops tokens and decode/prefill agree with it exactly
 # (capacity drops are the one legitimate divergence between the two paths)

@@ -20,6 +20,11 @@ from k8s_runpod_kubelet_tpu.models import (LlamaModel, LoraConfig, apply_lora,
                                            init_params, merge_lora, tiny_llama)
 from k8s_runpod_kubelet_tpu.workloads.serving import ServingConfig, ServingEngine
 
+import pytest as _pytest
+
+# ML tier: jax compiles dominate runtime; excluded by -m 'not slow'
+pytestmark = _pytest.mark.slow
+
 CFG = tiny_llama(vocab_size=128, embed_dim=64, n_layers=2, n_heads=4,
                  n_kv_heads=2, mlp_dim=128, max_seq_len=256,
                  dtype=jnp.float32, param_dtype=jnp.float32)

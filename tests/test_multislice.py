@@ -26,6 +26,11 @@ from k8s_runpod_kubelet_tpu.kube import objects as ko
 
 from harness import FakeClock, make_pod
 
+import pytest as _pytest
+
+# ML tier: jax compiles dominate runtime; excluded by -m 'not slow'
+pytestmark = _pytest.mark.slow
+
 
 @pytest.fixture()
 def cluster():

@@ -11,6 +11,11 @@ from k8s_runpod_kubelet_tpu.ops import (apply_rope, flash_attention, rms_norm,
 from k8s_runpod_kubelet_tpu.ops.attention import _attention_xla
 from k8s_runpod_kubelet_tpu.parallel import MeshConfig, make_mesh
 
+import pytest as _pytest
+
+# ML tier: jax compiles dominate runtime; excluded by -m 'not slow'
+pytestmark = _pytest.mark.slow
+
 
 def test_devices_virtualized():
     assert jax.device_count() == 8  # conftest forced the CPU mesh

@@ -20,6 +20,11 @@ from k8s_runpod_kubelet_tpu.parallel import (
     shard_logical,
 )
 
+import pytest as _pytest
+
+# ML tier: jax compiles dominate runtime; excluded by -m 'not slow'
+pytestmark = _pytest.mark.slow
+
 
 class TestMesh:
     def test_resolve_fills_data_axis(self):

@@ -17,6 +17,11 @@ from k8s_runpod_kubelet_tpu.parallel import (MeshConfig, make_mesh,
 from k8s_runpod_kubelet_tpu.workloads.train import (TrainConfig, Trainer,
                                                     synthetic_batches)
 
+import pytest as _pytest
+
+# ML tier: jax compiles dominate runtime; excluded by -m 'not slow'
+pytestmark = _pytest.mark.slow
+
 CFG = tiny_llama(vocab_size=128, embed_dim=64, n_layers=4, n_heads=4,
                  n_kv_heads=2, mlp_dim=128, max_seq_len=128,
                  dtype=jnp.float32, param_dtype=jnp.float32)

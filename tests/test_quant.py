@@ -12,6 +12,11 @@ from k8s_runpod_kubelet_tpu.models import (LlamaModel, init_params,
                                            is_quantized, quantize_params,
                                            tiny_llama)
 
+import pytest as _pytest
+
+# ML tier: jax compiles dominate runtime; excluded by -m 'not slow'
+pytestmark = _pytest.mark.slow
+
 
 def _cfg(**kw):
     base = dict(vocab_size=256, embed_dim=64, n_layers=2, n_heads=4,

@@ -11,6 +11,11 @@ import time
 
 import pytest
 
+import pytest as _pytest
+
+# ML tier: jax compiles dominate runtime; excluded by -m 'not slow'
+pytestmark = _pytest.mark.slow
+
 
 def _tiny_serving():
     import jax

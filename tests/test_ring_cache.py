@@ -19,6 +19,11 @@ import pytest
 from k8s_runpod_kubelet_tpu.models import LlamaModel, init_params, tiny_llama
 from k8s_runpod_kubelet_tpu.workloads.serving import ServingConfig, ServingEngine
 
+import pytest as _pytest
+
+# ML tier: jax compiles dominate runtime; excluded by -m 'not slow'
+pytestmark = _pytest.mark.slow
+
 # W=8 window, ring R=16 (slack 8): positions wrap after 16 tokens
 WCFG = tiny_llama(name="tiny-window", vocab_size=128, embed_dim=64,
                   n_layers=2, n_heads=4, n_kv_heads=2, mlp_dim=128,

@@ -9,6 +9,11 @@ import numpy as np
 
 from k8s_runpod_kubelet_tpu.models import LlamaModel, init_params, tiny_llama
 
+import pytest as _pytest
+
+# ML tier: jax compiles dominate runtime; excluded by -m 'not slow'
+pytestmark = _pytest.mark.slow
+
 
 def _cfg(**kw):
     base = dict(vocab_size=128, embed_dim=64, n_layers=2, n_heads=4,

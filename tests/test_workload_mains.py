@@ -12,6 +12,11 @@ from k8s_runpod_kubelet_tpu.models import init_params, tiny_llama
 from k8s_runpod_kubelet_tpu.workloads.serving import ServingConfig, ServingEngine
 from k8s_runpod_kubelet_tpu.workloads.serve_main import serve
 
+import pytest as _pytest
+
+# ML tier: jax compiles dominate runtime; excluded by -m 'not slow'
+pytestmark = _pytest.mark.slow
+
 
 def test_mnist_main_learns(capsys):
     from k8s_runpod_kubelet_tpu.workloads.mnist_train import main
