@@ -26,6 +26,17 @@ Usage:
   python bench.py --serve    # serving bench: tokens/sec + p50/p99 latency
                              # under concurrent load (CPU-capable with the
                              # tiny model; real numbers on TPU)
+  python bench.py --serve --model llama3-8b --int8 --kv-int8
+                             # the BASELINE.md headline: tokens/sec/chip at
+                             # 8B geometry on one v5e (int8 weights + int8
+                             # KV fit the 16GB chip; zero-init weights —
+                             # throughput is weight-value-independent)
+  python bench.py --econ     # serving-economics A/B matrix: int8-KV,
+                             # donation, speculation on/off (needs TPU)
+  python bench.py --mfu-sweep  # training MFU levers: remat none/dots,
+                             # batch, 530M width (needs TPU)
+  python bench.py --attn-tune  # flash block-size grid at the training
+                             # geometry S=2048/hd=64 (needs TPU)
 """
 
 from __future__ import annotations
@@ -129,9 +140,14 @@ def run_bench(quick: bool, expect_tpu: bool = False) -> dict:
     batches = synthetic_batches(cfg, tc, mesh)
 
     trainer.run(steps=warmup_steps, batches=batches)  # compile + warm
+    profile_dir = _arg_value("--profile-dir", "")
+    if profile_dir:  # trace ONLY timed steps (VERDICT r2: profile, don't guess)
+        jax.profiler.start_trace(profile_dir)
     t0 = time.perf_counter()
     trainer.run(steps=timed_steps, batches=batches)
     wall = time.perf_counter() - t0
+    if profile_dir:
+        jax.profiler.stop_trace()
 
     tokens = tc.batch_size * tc.seq_len * timed_steps
     tok_s = tokens / wall
@@ -235,43 +251,102 @@ def run_attn_bench() -> int:
     return 0
 
 
-def run_serve_bench(quick: bool) -> int:
-    """Serving throughput/latency under concurrent load (VERDICT r1 item 8):
-    continuous batching with the prefill thread; reports tokens/sec, p50/p99
-    request latency, and the HPA queue-depth signal."""
-    _force_platform_from_env()
-    import jax
+def _arg_value(flag: str, default: str) -> str:
+    if flag in sys.argv:
+        i = sys.argv.index(flag)
+        if i + 1 < len(sys.argv):
+            return sys.argv[i + 1]
+    return default
+
+
+def _serve_model(name: str):
+    """Bench model configs. 'llama3-8b' is the BASELINE.md headline geometry
+    ("tokens/sec/chip at 8B"); throughput is weight-value-independent, so
+    random/zero init is honest for perf (zero egress: no real checkpoints)."""
+    from k8s_runpod_kubelet_tpu.models import (gemma2_9b, llama3_8b,
+                                               mistral_7b)
     from __graft_entry__ import _bench_config
+    if name == "bench-260m":
+        return _bench_config(tiny=False)
+    if name == "tiny":
+        return _bench_config(tiny=True)
+    table = {"llama3-8b": llama3_8b, "mistral-7b": mistral_7b,
+             "gemma2-9b": gemma2_9b}
+    if name not in table:  # parseable error, not a KeyError traceback
+        _emit({"metric": "serving_tokens_per_sec", "value": None,
+               "error": f"unknown --model {name!r}; choose from "
+                        f"{['tiny', 'bench-260m'] + sorted(table)}"})
+        raise SystemExit(1)
+    return table[name]()
+
+
+def _serve_params(cfg, int8: bool):
+    """DEVICE-ready param tree for serving benches, HBM-safe for 8B on one
+    16GB v5e: big trees are built as HOST zeros (eval_shape + np.zeros =
+    copy-on-write pages, no 32GB resident). With ``int8`` the tree is
+    quantized leaf-by-leaf onto the device here — the full-precision tree
+    never sits in HBM next to the int8 copy (same strategy as serve_main
+    --int8); without it the zeros are device_put once (an un-quantized 8B
+    genuinely doesn't fit a 16GB chip — that OOM is honest and loud)."""
+    import jax
+    import numpy as np
     from k8s_runpod_kubelet_tpu.models import init_params
+
+    if not int8 and cfg.param_count < 1e9:
+        return init_params(cfg, jax.random.PRNGKey(0))
+    shapes = jax.eval_shape(
+        lambda: init_params(cfg, jax.random.PRNGKey(0)))
+    host = jax.tree_util.tree_map(
+        lambda sd: np.zeros(sd.shape, sd.dtype), shapes)
+    if int8:
+        from k8s_runpod_kubelet_tpu.models.quant import quantize_params
+        return quantize_params(cfg, host)
+    return jax.device_put(host)
+
+
+def serve_once(model: str, *, slots: int, n_req: int, new_toks: int,
+               cache_len: int, prompt_len: int, int8: bool, kv_int8: bool,
+               speculate_k: int, donate: bool = True, params=None,
+               label: str = "") -> dict:
+    """One serving measurement; returns the result dict (not emitted)."""
+    import jax
     from k8s_runpod_kubelet_tpu.workloads.serving import (ServingConfig,
                                                           ServingEngine)
 
-    tiny = quick or jax.default_backend() != "tpu"
-    cfg = _bench_config(tiny=tiny)
-    params = init_params(cfg, jax.random.PRNGKey(0))
-    slots, n_req, new_toks = (4, 12, 16) if tiny else (8, 48, 64)
-    spec = 3 if "--speculate" in sys.argv else 0
-    sc = ServingConfig(slots=slots, max_prefill_len=64,
-                       cache_len=128 if tiny else 1024,
-                       max_new_tokens=new_toks,
-                       quantize_int8="--int8" in sys.argv,
-                       quantize_kv_int8="--kv-int8" in sys.argv,
-                       speculate_k=spec)
+    cfg = _serve_model(model)
+    if params is None:
+        params = _serve_params(cfg, int8)
+    # _serve_params already quantized when int8 (and _mm dispatches on the
+    # leaf structure), so the engine must NOT quantize again — the flag
+    # survives only as a record label
+    sc = ServingConfig(slots=slots, max_prefill_len=min(cache_len // 2, 512),
+                       cache_len=cache_len, max_new_tokens=new_toks,
+                       quantize_int8=False, quantize_kv_int8=kv_int8,
+                       speculate_k=speculate_k, donate_cache=donate)
     engine = ServingEngine(cfg, params, sc).start()
     try:
-        engine.submit([1, 2, 3], max_new_tokens=2).result(timeout=900)  # warm
+        engine.submit([1, 2, 3], max_new_tokens=2).result(timeout=1800)  # warm
         t0 = time.perf_counter()
-        futs = [engine.submit([(j % 250) + 1 for j in range(1 + i % 32)],
+        futs = [engine.submit([(j % 250) + 1
+                               for j in range(1 + (i * 37) % prompt_len)],
                               max_new_tokens=new_toks)
                 for i in range(n_req)]
         peak_queue = max(engine.queue_depth, 1)
-        outs = [f.result(timeout=900) for f in futs]
+        outs = [f.result(timeout=1800) for f in futs]
         wall = time.perf_counter() - t0
+        accepted = proposed = None
+        if speculate_k:
+            rendered = engine.metrics.render()
+            for line in rendered.splitlines():
+                if line.startswith("tpu_serving_spec_accepted_total"):
+                    accepted = float(line.split()[-1])
+                if line.startswith("tpu_serving_spec_proposed_total"):
+                    proposed = float(line.split()[-1])
     finally:
         engine.stop()
     toks = sum(len(o["tokens"]) for o in outs)
     lats = sorted(o["latency_s"] for o in outs)
-    _emit({
+    rec = {
         "metric": "serving_tokens_per_sec",
         "value": round(toks / wall, 1),
         "unit": "tok/s",
@@ -280,13 +355,226 @@ def run_serve_bench(quick: bool) -> int:
                                         int(len(lats) * 0.99))], 3),
         "requests": n_req, "slots": slots,
         "new_tokens_per_request": new_toks,
+        "cache_len": cache_len,
         "peak_queue_depth": peak_queue,
-        "int8": sc.quantize_int8,
-        "kv_int8": sc.quantize_kv_int8,
-        "speculate_k": sc.speculate_k,
-        "model": cfg.name,
+        "int8": int8, "kv_int8": kv_int8,
+        "speculate_k": speculate_k, "donate_cache": donate,
+        "model": cfg.name, "params": cfg.param_count,
         "backend": jax.default_backend(),
-    })
+    }
+    if label:
+        rec["label"] = label
+    if speculate_k and proposed:
+        rec["spec_accept_rate"] = round(accepted / proposed, 3)
+    return rec
+
+
+def run_serve_bench(quick: bool) -> int:
+    """Serving throughput/latency under concurrent load (VERDICT r1 item 8):
+    continuous batching with the prefill thread; reports tokens/sec, p50/p99
+    request latency, and the HPA queue-depth signal.
+
+    --model llama3-8b --int8 --kv-int8 is the BASELINE.md headline run
+    ("tokens/sec/chip at 8B"): int8 weights (~8GB) + int8 KV fit the 16GB
+    v5e chip."""
+    _force_platform_from_env()
+    import jax
+
+    tiny = quick or jax.default_backend() != "tpu"
+    model = _arg_value("--model", "tiny" if tiny else "bench-260m")
+    big = not tiny and model not in ("tiny", "bench-260m")
+    slots, n_req, new_toks = ((4, 12, 16) if tiny else
+                              (8, 32, 64) if big else (8, 48, 64))
+    rec = serve_once(
+        model,
+        slots=int(_arg_value("--slots", str(slots))),
+        n_req=n_req, new_toks=new_toks,
+        cache_len=int(_arg_value("--cache-len",
+                                 "128" if tiny else "2048" if big else "1024")),
+        prompt_len=32 if not big else 128,
+        int8="--int8" in sys.argv,
+        kv_int8="--kv-int8" in sys.argv,
+        speculate_k=3 if "--speculate" in sys.argv else 0)
+    _emit(rec)
+    return 0
+
+
+def run_econ_bench() -> int:
+    """Serving-economics A/B matrix (VERDICT r2 item 3): measure the HBM
+    claims — int8-KV on/off, cache donation on/off, speculation on/off —
+    same model, same load, one JSON line per cell. Needs the chip: these
+    are bandwidth effects CPU cannot show."""
+    _force_platform_from_env()
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    model = _arg_value("--model", "bench-260m" if on_tpu else "tiny")
+    kw = dict(slots=8, n_req=32, new_toks=64, cache_len=2048,
+              prompt_len=64) if on_tpu else \
+         dict(slots=4, n_req=8, new_toks=8, cache_len=128, prompt_len=16)
+    int8 = "--int8" in sys.argv
+    cells = [
+        ("baseline", dict(int8=int8, kv_int8=False, speculate_k=0,
+                          donate=True)),
+        ("kv_int8", dict(int8=int8, kv_int8=True, speculate_k=0,
+                         donate=True)),
+        ("no_donation", dict(int8=int8, kv_int8=False, speculate_k=0,
+                             donate=False)),
+        ("speculate3", dict(int8=int8, kv_int8=False, speculate_k=3,
+                            donate=True)),
+        ("kv_int8+speculate3", dict(int8=int8, kv_int8=True, speculate_k=3,
+                                    donate=True)),
+    ]
+    # one param tree for the whole matrix (int8 is constant across cells);
+    # per-cell engines/caches/jits still rebuild, which is what's measured
+    cfg = _serve_model(model)
+    params = _serve_params(cfg, int8)
+    base_val = None
+    for label, flags in cells:
+        try:
+            rec = serve_once(model, label=label, params=params, **kw, **flags)
+        except Exception as e:  # noqa: BLE001 — e.g. no_donation OOM: the
+            # failing cell IS a result; the rest of the matrix must run
+            rec = {"metric": "serving_tokens_per_sec", "value": None,
+                   "label": label, "error": f"{type(e).__name__}: {e}"[:300]}
+            _emit(rec)
+            continue
+        if label == "baseline":
+            base_val = rec["value"]
+        elif base_val:
+            rec["vs_econ_baseline"] = round(rec["value"] / base_val, 3)
+        _emit(rec)
+    return 0
+
+
+def run_attn_tune() -> int:
+    """Flash block-size tuner at the TRAINING bench geometry (S=2048,
+    hd=64 — the remaining queued MFU lever from ROUND2_NOTES): times the
+    fwd+bwd kernel over a (block_q, block_k) grid and prints the winner
+    vs the tuned_block_sizes default. Persist a better pick into
+    ops/attention.py's _BLOCK_CAPS table if one shows up."""
+    _force_platform_from_env()
+    import jax
+    import jax.numpy as jnp
+    from k8s_runpod_kubelet_tpu.ops.attention import (flash_attention,
+                                                      tuned_block_sizes)
+
+    if jax.default_backend() != "tpu":
+        _emit({"metric": "attn_tune", "value": None,
+               "error": "tuner needs the TPU"})
+        return 1
+    # bench-260m attention geometry: B=8, Hq=16, Hkv=8, S=2048, D=64
+    b, hq, hkv, s, d = 8, 16, 8, 2048, 64
+    ks = jax.random.split(jax.random.PRNGKey(0), 4)
+    q = jax.random.normal(ks[0], (b, hq, s, d), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, hkv, s, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, hkv, s, d), jnp.bfloat16)
+    g = jax.random.normal(ks[3], (b, hq, s, d), jnp.bfloat16)
+
+    def timed(bq, bk):
+        def run(q, k, v):
+            out, pull = jax.vjp(
+                lambda q, k, v: flash_attention(
+                    q, k, v, causal=True, use_pallas=True,
+                    block_q=bq, block_k=bk), q, k, v)
+            return pull(g)
+        fn = jax.jit(run)
+        jax.tree_util.tree_leaves(fn(q, k, v))[0].block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(20):
+            out = fn(q, k, v)
+        jax.tree_util.tree_leaves(out)[0].block_until_ready()
+        return (time.perf_counter() - t0) / 20
+
+    default = tuned_block_sizes(s, s)
+    grid = [(bq, bk) for bq in (128, 256, 512) for bk in (128, 256, 512, 1024)]
+    best = None
+    for bq, bk in grid:
+        try:
+            t = timed(bq, bk)
+        except Exception as e:  # noqa: BLE001 — VMEM overflow etc.
+            _emit({"metric": f"attn_tune_q{bq}_k{bk}", "value": None,
+                   "error": f"{type(e).__name__}"[:80]})
+            continue
+        rec = {"metric": f"attn_tune_q{bq}_k{bk}", "unit": "ms",
+               "value": round(t * 1e3, 3),
+               "is_default": [bq, bk] == list(default)}
+        _emit(rec)
+        if best is None or t < best[0]:
+            best = (t, bq, bk)
+    if best:
+        _emit({"metric": "attn_tune_best", "unit": "ms",
+               "value": round(best[0] * 1e3, 3),
+               "blocks": [best[1], best[2]], "default": list(default)})
+    return 0
+
+
+def run_mfu_sweep() -> int:
+    """Training MFU sweep (VERDICT r2 item 1): the queued levers from
+    ROUND2_NOTES, one JSON line per point, best-first summary at the end.
+    Levers: remat policy (none frees an extra fwd pass — the 260M model has
+    HBM headroom), global batch, a wider 530M model, and flash block sizes.
+    Run on the chip; each point is ~2 min including compile."""
+    _force_platform_from_env()
+    import dataclasses
+    import jax
+    from __graft_entry__ import _bench_config
+    from k8s_runpod_kubelet_tpu.models import tiny_llama
+    from k8s_runpod_kubelet_tpu.workloads.train import (TrainConfig, Trainer,
+                                                        synthetic_batches)
+
+    if jax.default_backend() != "tpu":
+        _emit({"metric": "mfu_sweep", "value": None,
+               "error": "sweep needs the TPU"})
+        return 1
+    gen = detect_generation()
+    peak = _PEAK_TFLOPS[gen]
+
+    def wider_530m():
+        return tiny_llama(name="llama-bench-530m", vocab_size=32768,
+                          embed_dim=1536, n_layers=12, n_heads=16,
+                          n_kv_heads=8, mlp_dim=6144, max_seq_len=2048,
+                          remat_policy="dots")
+
+    base = _bench_config(tiny=False)
+    points = [
+        ("260m_dots_b8", base, 8),                       # r2 best: MFU .318
+        ("260m_none_b8", dataclasses.replace(base, remat_policy="none"), 8),
+        ("260m_none_b12", dataclasses.replace(base, remat_policy="none"), 12),
+        ("530m_dots_b8", wider_530m(), 8),
+        ("530m_none_b8",
+         dataclasses.replace(wider_530m(), remat_policy="none"), 8),
+    ]
+    results = []
+    for label, cfg, batch in points:
+        try:
+            tc = TrainConfig(batch_size=batch, seq_len=2048, steps=20,
+                             warmup_steps=1)
+            trainer = Trainer(cfg, tc)
+            batches = synthetic_batches(cfg, tc)
+            trainer.run(steps=3, batches=batches)       # compile + warm
+            t0 = time.perf_counter()
+            trainer.run(steps=10, batches=batches)
+            wall = time.perf_counter() - t0
+            tok_s = batch * 2048 * 10 / wall
+            mfu = 6.0 * cfg.param_count * tok_s / (peak * 1e12)
+            rec = {"metric": f"mfu_{label}", "value": round(tok_s, 1),
+                   "unit": "tok/s/chip", "mfu": round(mfu, 3),
+                   "params": cfg.param_count, "global_batch": batch,
+                   "remat": cfg.remat_policy}
+            del trainer
+        except Exception as e:  # noqa: BLE001 — OOM etc: report, keep going
+            rec = {"metric": f"mfu_{label}", "value": None,
+                   "error": f"{type(e).__name__}: {e}"[:300]}
+        results.append(rec)
+        _emit(rec)
+        jax.clear_caches()
+    best = max((r for r in results if r.get("value")),
+               key=lambda r: r["mfu"], default=None)
+    if best:
+        _emit({"metric": "mfu_sweep_best", "value": best["mfu"],
+               "unit": "mfu", "point": best["metric"],
+               "vs_baseline": round(best["mfu"] / _TARGET_MFU, 3)})
     return 0
 
 
@@ -395,6 +683,12 @@ def main() -> int:
     quick = "--quick" in sys.argv
     if "--attn" in sys.argv:
         return run_attn_bench()
+    if "--econ" in sys.argv:
+        return run_econ_bench()
+    if "--mfu-sweep" in sys.argv:
+        return run_mfu_sweep()
+    if "--attn-tune" in sys.argv:
+        return run_attn_tune()
     if "--serve" in sys.argv:
         return run_serve_bench(quick)
     if "--run" in sys.argv:

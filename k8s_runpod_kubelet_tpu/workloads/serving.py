@@ -83,6 +83,10 @@ class ServingConfig:
     # quantize_int8 (weights). Accuracy: ~1e-2-level logit perturbation —
     # greedy outputs typically identical, pinned by tests on the tiny model.
     quantize_kv_int8: bool = False
+    # donate the engine cache through decode/verify (in-place K-token
+    # updates instead of a full-cache copy per step). The off-switch exists
+    # to MEASURE that HBM claim (bench.py --econ); leave on in production.
+    donate_cache: bool = True
     # registered-prefix cap: each register_prefix() pins one single-slot KV
     # cache in HBM until restart
     max_prefixes: int = 8
@@ -305,8 +309,9 @@ class ServingEngine:
         # the K-token slice in place instead of copying the whole
         # (L, slots, len, h, d) cache every step — on HBM that's the
         # difference between O(tokens written) and O(cache bytes) per step
-        self._decode = jax.jit(self.model.decode_step, donate_argnums=(2,))
-        self._verify = (jax.jit(self.model.verify_step, donate_argnums=(2,))
+        donate = (2,) if sc.donate_cache else ()
+        self._decode = jax.jit(self.model.decode_step, donate_argnums=donate)
+        self._verify = (jax.jit(self.model.verify_step, donate_argnums=donate)
                         if sc.speculate_k > 0 else None)
         # the prefill thread's verify is NOT donated: a prefix-cache hit
         # starts chunked appends from the stored registry cache, which must
