@@ -336,12 +336,8 @@ def serve_once(model: str, *, slots: int, n_req: int, new_toks: int,
         wall = time.perf_counter() - t0
         accepted = proposed = None
         if speculate_k:
-            rendered = engine.metrics.render()
-            for line in rendered.splitlines():
-                if line.startswith("tpu_serving_spec_accepted_total"):
-                    accepted = float(line.split()[-1])
-                if line.startswith("tpu_serving_spec_proposed_total"):
-                    proposed = float(line.split()[-1])
+            accepted = engine.metrics.get_counter("tpu_serving_spec_accepted")
+            proposed = engine.metrics.get_counter("tpu_serving_spec_proposed")
     finally:
         engine.stop()
     toks = sum(len(o["tokens"]) for o in outs)
@@ -547,6 +543,7 @@ def run_mfu_sweep() -> int:
     ]
     results = []
     for label, cfg, batch in points:
+        trainer = None
         try:
             tc = TrainConfig(batch_size=batch, seq_len=2048, steps=20,
                              warmup_steps=1)
@@ -562,10 +559,11 @@ def run_mfu_sweep() -> int:
                    "unit": "tok/s/chip", "mfu": round(mfu, 3),
                    "params": cfg.param_count, "global_batch": batch,
                    "remat": cfg.remat_policy}
-            del trainer
         except Exception as e:  # noqa: BLE001 — OOM etc: report, keep going
             rec = {"metric": f"mfu_{label}", "value": None,
                    "error": f"{type(e).__name__}: {e}"[:300]}
+        finally:
+            trainer = None  # release params+opt state HBM before next point
         results.append(rec)
         _emit(rec)
         jax.clear_caches()

@@ -633,9 +633,6 @@ class LlamaModel:
         windows = cfg.layer_windows()
         n_stages = pipeline_stages(mesh)
         if n_stages > 1:
-            if pat > 1:
-                raise ValueError("sliding_window_pattern > 1 does not "
-                                 "compose with pipeline parallelism yet")
             # GPipe over the stage axis (parallel/pipeline.py). Blocks run
             # mesh-free inside the vmapped stage: GSPMD shardings never change
             # values, and XLA still propagates the tensor-axis layout from the
@@ -649,18 +646,40 @@ class LlamaModel:
                     "runs mesh-free, so ring attention never engages and the "
                     "seq-axis devices would sit idle — use fsdp/tensor/data "
                     "for the remaining devices instead")
+            if cfg.n_layers % n_stages:
+                # fire the accurate error before the pattern guard below
+                # could report a fabricated layers-per-stage count
+                raise ValueError(f"n_layers={cfg.n_layers} not divisible by "
+                                 f"{n_stages} stages")
+            per_stage = cfg.n_layers // n_stages
+            if per_stage % pat:
+                # a local/global group straddling a stage boundary would put
+                # its sublayers on different devices mid-scan
+                raise ValueError(
+                    f"{per_stage} layers/stage not divisible by "
+                    f"sliding_window_pattern {pat}: each stage must hold "
+                    "whole local/global groups — pick n_stages so "
+                    "n_layers/n_stages is a multiple of the pattern")
 
-            def stage_block(carry, lp):
-                cs, sn = _rope_for(ropes, cfg.sliding_window)
-                y = _attention_block(carry, lp, cfg, cs, sn, None,
-                                     window=cfg.sliding_window)
-                y, aux = _mlp_block(y, lp, cfg, None)
+            def stage_block(carry, lp_group):
+                # same grouped-scan body as the non-pipeline path, mesh-free:
+                # each sublayer gets its STATIC window + rope table (Gemma-2/3
+                # interleaves pipeline like everything else)
+                y = carry
+                aux = jnp.float32(0.0)
+                for j, win in enumerate(windows):
+                    lp = _sublayer(lp_group, j, pat)
+                    cs, sn = _rope_for(ropes, win)
+                    y = _attention_block(y, lp, cfg, cs, sn, None, window=win)
+                    y, a = _mlp_block(y, lp, cfg, None)
+                    aux = aux + a
                 return y, aux
 
             sbody = _maybe_remat(stage_block, cfg)
 
             def stage_fn(stage_layers, x_mb):
-                y, auxes = jax.lax.scan(sbody, x_mb, stage_layers)
+                y, auxes = jax.lax.scan(sbody, x_mb,
+                                        _group_layers(stage_layers, pat))
                 return y, jnp.sum(auxes)
 
             x, aux_total = pipeline_spmd(

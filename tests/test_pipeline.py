@@ -105,6 +105,41 @@ class TestPipelineModel:
         np.testing.assert_allclose(float(aux_piped), float(aux_plain),
                                    rtol=0.05)
 
+    def test_pipelined_windowed_interleave_matches_plain(self):
+        """Gemma-2-style local/global interleave (pattern 2) through the
+        pipeline: per-sublayer windows/ropes inside each stage's grouped
+        scan must reproduce the plain forward (r3: this guard is gone)."""
+        g2 = tiny_llama(name="tiny-g2-pp", vocab_size=128, embed_dim=64,
+                        n_layers=4, n_heads=4, n_kv_heads=2, head_dim=32,
+                        mlp_dim=128, max_seq_len=128, sliding_window=8,
+                        sliding_window_pattern=2, attn_logit_softcap=50.0,
+                        query_pre_attn_scalar=64.0, post_norms=True,
+                        logit_softcap=30.0,
+                        dtype=jnp.float32, param_dtype=jnp.float32)
+        params = init_params(g2, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 128)
+        plain = LlamaModel(g2).forward(params, tokens)
+        mesh = self._meshes()   # stage=2: one local/global group per stage
+        model = LlamaModel(g2, mesh)
+        with mesh:
+            piped = jax.jit(model.forward)(params, tokens)
+        np.testing.assert_allclose(np.asarray(piped), np.asarray(plain),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_pipeline_rejects_group_straddling_stages(self):
+        """pattern 2 with 4 layers over 4 stages = 1 layer/stage: every
+        local/global group would straddle a stage boundary."""
+        g2 = tiny_llama(name="tiny-g2-bad", vocab_size=128, embed_dim=64,
+                        n_layers=4, n_heads=4, n_kv_heads=2, mlp_dim=128,
+                        max_seq_len=128, sliding_window=8,
+                        sliding_window_pattern=2,
+                        dtype=jnp.float32, param_dtype=jnp.float32)
+        params = init_params(g2, jax.random.PRNGKey(0))
+        tokens = jnp.zeros((4, 8), jnp.int32)
+        mesh = make_mesh(MeshConfig(data=-1, stage=4))
+        with pytest.raises(ValueError, match="whole local/global groups"):
+            LlamaModel(g2, mesh).forward(params, tokens)
+
     def test_train_step_on_pipeline_mesh(self):
         """Full training step with stage=2 + tensor=2: loss decreases."""
         mesh = self._meshes()
