@@ -631,6 +631,26 @@ class LlamaModel:
 
         pat = cfg.sliding_window_pattern
         windows = cfg.layer_windows()
+
+        def make_group_block(mesh_, positions_):
+            """Scan body over one layer GROUP: each sublayer gets its
+            STATIC window + rope table (Gemma-2/3 local/global interleave;
+            pat=1 is the degenerate single-sublayer group). Shared by the
+            plain and pipelined paths (pipeline: mesh_=None, mesh-free)."""
+            def block(carry, lp_group):
+                y = carry
+                aux = jnp.float32(0.0)
+                for j, win in enumerate(windows):
+                    lp = _sublayer(lp_group, j, pat)
+                    cs, sn = _rope_for(ropes, win)
+                    y = _attention_block(y, lp, cfg, cs, sn, mesh_,
+                                         positions_, window=win)
+                    y, a = _mlp_block(y, lp, cfg, mesh_)
+                    y = _constrain(y, mesh_, ("batch", "seq", "act_embed"))
+                    aux = aux + a
+                return y, aux
+            return block
+
         n_stages = pipeline_stages(mesh)
         if n_stages > 1:
             # GPipe over the stage axis (parallel/pipeline.py). Blocks run
@@ -661,21 +681,11 @@ class LlamaModel:
                     "whole local/global groups — pick n_stages so "
                     "n_layers/n_stages is a multiple of the pattern")
 
-            def stage_block(carry, lp_group):
-                # same grouped-scan body as the non-pipeline path, mesh-free:
-                # each sublayer gets its STATIC window + rope table (Gemma-2/3
-                # interleaves pipeline like everything else)
-                y = carry
-                aux = jnp.float32(0.0)
-                for j, win in enumerate(windows):
-                    lp = _sublayer(lp_group, j, pat)
-                    cs, sn = _rope_for(ropes, win)
-                    y = _attention_block(y, lp, cfg, cs, sn, None, window=win)
-                    y, a = _mlp_block(y, lp, cfg, None)
-                    aux = aux + a
-                return y, aux
-
-            sbody = _maybe_remat(stage_block, cfg)
+            # the ONE grouped-scan body (below) with mesh=None: stage blocks
+            # run mesh-free, and _constrain(_, None, _) is the identity —
+            # a single closure keeps the pipelined forward definitionally
+            # equal to the plain forward it is tested against
+            sbody = _maybe_remat(make_group_block(None, None), cfg)
 
             def stage_fn(stage_layers, x_mb):
                 y, auxes = jax.lax.scan(sbody, x_mb,
@@ -687,20 +697,7 @@ class LlamaModel:
                 n_microbatches=cfg.pipeline_microbatches)
             aux_layers = aux_total[None]
         else:
-            def block(carry, lp_group):
-                y = carry
-                aux = jnp.float32(0.0)
-                for j, win in enumerate(windows):
-                    lp = _sublayer(lp_group, j, pat)
-                    cs, sn = _rope_for(ropes, win)
-                    y = _attention_block(y, lp, cfg, cs, sn, mesh,
-                                         positions, window=win)
-                    y, a = _mlp_block(y, lp, cfg, mesh)
-                    y = _constrain(y, mesh, ("batch", "seq", "act_embed"))
-                    aux = aux + a
-                return y, aux
-
-            body = _maybe_remat(block, cfg)
+            body = _maybe_remat(make_group_block(mesh, positions), cfg)
             x, aux_layers = jax.lax.scan(body, x,
                                          _group_layers(params["layers"], pat))
         x = rms_norm(x, _norm_w(params["final_norm"], cfg), cfg.norm_eps)
