@@ -160,6 +160,11 @@ class _Slot:
     logprobs: list[float] = dataclasses.field(default_factory=list)
     remaining: int = 0
     last_token: int = 0
+    # prompt-lookup drafting state: bigram -> latest start position over
+    # prompt+generated, indexed lazily in _propose — amortized O(1)/token
+    # where a rescan would be O(context) Python per engine step
+    bigram_index: dict = dataclasses.field(default_factory=dict)
+    indexed_upto: int = 0
 
 
 def _fail_future(fut: Future, exc: BaseException) -> None:
@@ -879,6 +884,8 @@ class ServingEngine:
             slot.logprobs = [first_lp] if first_lp is not None else []
             slot.remaining = req.max_new_tokens - 1
             slot.last_token = first
+            slot.bigram_index = {}
+            slot.indexed_upto = 0
             self._emit(slot, first)
             admitted = True
             self.metrics.incr("tpu_serving_admitted")
@@ -892,16 +899,32 @@ class ServingEngine:
         context's final bigram and propose the k tokens that followed it —
         free accuracy on repetitive spans (code, quotes, lists). Falls back
         to repeating the last token (wrong guesses only cost the slack the
-        verify pass already paid for)."""
-        ctx = slot.request.prompt + slot.generated
+        verify pass already paid for).
+
+        The bigram index is maintained INCREMENTALLY (amortized O(1) per
+        committed token): a per-step rescan would be O(context) host-side
+        Python inside the engine loop — at 32k context that dominates the
+        step. Latest occurrence wins, matching the original backward scan
+        (which stopped at i <= len-3, hence the n-3 indexing bound)."""
+        prompt = slot.request.prompt
+        np_ = len(prompt)
+        gen = slot.generated
+        n = np_ + len(gen)
+
+        def tok(p: int) -> int:
+            return prompt[p] if p < np_ else gen[p - np_]
+
+        idx = slot.bigram_index
+        while slot.indexed_upto <= n - 3:
+            i = slot.indexed_upto
+            idx[(tok(i), tok(i + 1))] = i
+            slot.indexed_upto += 1
         draft: list[int] = []
-        if len(ctx) >= 3:
-            big = (ctx[-2], ctx[-1])
-            for i in range(len(ctx) - 3, -1, -1):
-                if (ctx[i], ctx[i + 1]) == big:
-                    draft = ctx[i + 2:i + 2 + k]
-                    break
-        last = ctx[-1]
+        if n >= 3:
+            i = idx.get((tok(n - 2), tok(n - 1)))
+            if i is not None:
+                draft = [tok(p) for p in range(i + 2, min(i + 2 + k, n))]
+        last = tok(n - 1)
         while len(draft) < k:
             draft.append(last)
         return draft[:k]

@@ -107,6 +107,42 @@ class TestSpeculativeServing:
         assert "tpu_serving_spec_proposed" in text
         assert "tpu_serving_spec_accepted" in text
 
+    def test_incremental_propose_matches_naive_scan(self):
+        """The amortized-O(1) bigram index must propose exactly what the
+        original O(context) backward scan proposed, across growing
+        contexts (index built lazily over prompt+generated)."""
+        import numpy as np
+        from k8s_runpod_kubelet_tpu.workloads.serving import (Request, _Slot,
+                                                              ServingEngine)
+
+        def naive(ctx, k):
+            draft = []
+            if len(ctx) >= 3:
+                big = (ctx[-2], ctx[-1])
+                for i in range(len(ctx) - 3, -1, -1):
+                    if (ctx[i], ctx[i + 1]) == big:
+                        draft = ctx[i + 2:i + 2 + k]
+                        break
+            last = ctx[-1]
+            while len(draft) < k:
+                draft.append(last)
+            return draft[:k]
+
+        rng = np.random.default_rng(0)
+        for trial in range(20):
+            prompt = [int(t) for t in rng.integers(0, 5, rng.integers(3, 30))]
+            slot = _Slot(request=Request(
+                prompt=prompt, max_new_tokens=64, rid="t", future=None,
+                submitted_at=0.0, temperature=0.0), generated=[])
+            # grow the generated tail one token at a time, proposing at each
+            # length — exercises the lazy indexing against every prefix
+            for t in rng.integers(0, 5, 40):
+                slot.generated.append(int(t))
+                ctx = prompt + slot.generated
+                k = int(rng.integers(1, 5))
+                got = ServingEngine._propose(None, slot, k)
+                assert got == naive(ctx, k), (trial, ctx, k)
+
 
 class TestChunkedPrefill:
     def test_chunked_cache_matches_full_prefill(self):
