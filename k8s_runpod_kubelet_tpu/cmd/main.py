@@ -34,7 +34,8 @@ from ..health import HealthServer
 from ..kube import RealKubeClient
 from ..logging_util import setup_logging
 from ..metrics import Metrics
-from ..node import KubeletApiServer, NodeController, PodController
+from ..node import (KubeletApiServer, NodeController, PodController,
+                    RefResourceController)
 from ..provider import Provider
 
 log = logging.getLogger("tpu-kubelet")
@@ -117,13 +118,17 @@ def build(cfg: config_mod.Config, kube=None, tpu=None, worker_transport=None,
                                      status_interval_s=cfg.node_status_interval_s)
     pod_controller = PodController(kube, provider, cfg.node_name,
                                    resync_interval_s=cfg.reconcile_interval_s)
+    # secret/configmap informer analog (main.go:180-193): object changes
+    # turn pending-deploy retries immediate
+    ref_controller = RefResourceController(kube, provider)
     api_server = KubeletApiServer(provider, port=cfg.listen_port,
                                   tls_cert=cfg.tls_cert_file,
                                   tls_key=cfg.tls_key_file,
                                   auth_token=cfg.api_auth_token)
     health = HealthServer(cfg.health_address, ready_func=provider.ping,
                           metrics=metrics)
-    return provider, node_controller, pod_controller, api_server, health
+    return (provider, node_controller, pod_controller, ref_controller,
+            api_server, health)
 
 
 def main(argv=None) -> int:
@@ -159,7 +164,8 @@ def main(argv=None) -> int:
                       "ADC, or run with workload identity (%s)", e)
             return 1
 
-    provider, nc, pc, api, health = build(cfg, token_provider=token_provider)
+    provider, nc, pc, rc, api, health = build(cfg,
+                                              token_provider=token_provider)
 
     stop = threading.Event()
 
@@ -173,6 +179,7 @@ def main(argv=None) -> int:
     health.start()
     nc.start()
     pc.start()
+    rc.start()
     api.start()
     provider.start()
     provider.load_running()  # crash recovery (main.go:425-426)
@@ -180,6 +187,10 @@ def main(argv=None) -> int:
              cfg.listen_port, cfg.health_address)
     stop.wait()
 
+    # reverse of startup: the ref watcher can kick deploys, so it must die
+    # BEFORE the provider — a secret event during shutdown must not create
+    # a billable slice on a stopped provider
+    rc.stop()
     provider.stop()
     pc.stop()
     nc.stop()

@@ -74,7 +74,19 @@ class KubeClient:
 
     # reads the spec translator needs
     def get_secret(self, ns: str, name: str) -> dict: raise NotImplementedError
+    def get_config_map(self, ns: str, name: str) -> dict: raise NotImplementedError
     def get_job(self, ns: str, name: str) -> dict: raise NotImplementedError
+
+    def watch_objects(self, kind: str,
+                      stop: Optional[threading.Event] = None,
+                      resource_version: Optional[str] = None
+                      ) -> Iterator[WatchEvent]:
+        """Cluster-wide watch on ``kind`` ("secrets" | "configmaps") — the
+        analog of the reference controller's secret/configmap informers
+        (main.go:180-193). Stream end = caller restarts (no RV continuity
+        contract here: consumers react to change notifications, they don't
+        mirror state the way the pod controller must)."""
+        raise NotImplementedError
 
     # node + lease (L3')
     def get_node(self, name: str) -> dict: raise NotImplementedError
@@ -243,16 +255,28 @@ class RealKubeClient(KubeClient):
         tracks the last-seen resourceVersion and resumes from it, relisting on
         410 Gone — client-go Reflector semantics). Yields WatchEvents until the
         stream or ``stop`` ends."""
+        yield from self._watch_stream("/api/v1/pods", "pods", field_selector,
+                                      label_selector, stop, resource_version)
+
+    def watch_objects(self, kind, stop=None, resource_version=None):
+        if kind not in ("secrets", "configmaps"):
+            raise ValueError(f"unsupported watch kind {kind!r}")
+        yield from self._watch_stream(f"/api/v1/{kind}", kind, "", "", stop,
+                                      resource_version)
+
+    def _watch_stream(self, path, what, field_selector, label_selector,
+                      stop, resource_version):
         extra = "watch=true&allowWatchBookmarks=true"
         if resource_version:
             extra += "&resourceVersion=" + urllib.parse.quote(resource_version)
         q = self._selector_query(field_selector, label_selector, extra=extra)
         conn = self._conn(timeout_s=330)  # server closes watches ~5min; outlive it
         try:
-            conn.request("GET", "/api/v1/pods" + q, headers=self._headers())
+            conn.request("GET", path + q, headers=self._headers())
             resp = conn.getresponse()
             if resp.status >= 400:
-                raise KubeApiError(f"watch pods: HTTP {resp.status}", status=resp.status)
+                raise KubeApiError(f"watch {what}: HTTP {resp.status}",
+                                   status=resp.status)
             buf = b""
             while not (stop and stop.is_set()):
                 chunk = resp.read1(65536)
@@ -271,16 +295,20 @@ class RealKubeClient(KubeClient):
                         # Status with code 410, not an HTTP error
                         code = obj.get("code", 0)
                         raise KubeApiError(
-                            f"watch pods: {obj.get('message', 'stream error')}",
+                            f"watch {what}: {obj.get('message', 'stream error')}",
                             status=code or 500)
                     yield WatchEvent(type=ev_type, object=obj)
         finally:
             conn.close()
 
-    # -- secrets / jobs --------------------------------------------------------
+    # -- secrets / configmaps / jobs -------------------------------------------
 
     def get_secret(self, ns, name):
         return self._request("GET", f"/api/v1/namespaces/{ns}/secrets/{name}")
+
+    def get_config_map(self, ns, name):
+        return self._request("GET",
+                             f"/api/v1/namespaces/{ns}/configmaps/{name}")
 
     def get_job(self, ns, name):
         return self._request("GET", f"/apis/batch/v1/namespaces/{ns}/jobs/{name}")

@@ -38,6 +38,8 @@ class FakeKubeClient(KubeClient):
         self.events: list[dict] = []
         self._rv = 0
         self._watchers: list[_Watcher] = []
+        # secret/configmap change watchers (the informer analog)
+        self._obj_watchers: dict[str, list[_Watcher]] = {}
         # pod watch history for resourceVersion resume: (rv, type, snapshot)
         self._pod_history: list[tuple[int, str, dict]] = []
         self._compacted_rv = 0  # RVs <= this are gone (watch from them -> 410)
@@ -217,20 +219,64 @@ class FakeKubeClient(KubeClient):
                 yield ev
         return gen()
 
-    # -- secrets / jobs --------------------------------------------------------
+    # -- secrets / configmaps / jobs -------------------------------------------
+
+    def _put_object(self, kind: str, ns: str, name: str, obj: dict):
+        """Upsert + notify object watchers (the informer analog)."""
+        with self.lock:
+            ev = "MODIFIED" if (kind, ns, name) in self.store else "ADDED"
+            self.store[(kind, ns, name)] = self._bump(obj)
+            for w in list(self._obj_watchers.get(kind, [])):
+                if w.stop.is_set():
+                    self._obj_watchers[kind].remove(w)
+                    continue
+                w.q.put(WatchEvent(type=ev, object=ko.deep_copy(obj)))
 
     def add_secret(self, ns: str, name: str, data: dict[str, str]):
-        """Test helper; ``data`` values are plain strings (stored base64 like K8s)."""
+        """Test helper; ``data`` values are plain strings (stored base64 like
+        K8s). Re-adding an existing name = a rotation (MODIFIED event)."""
         import base64
         enc = {k: base64.b64encode(v.encode()).decode() for k, v in data.items()}
-        with self.lock:
-            self.store[("secrets", ns, name)] = {
-                "metadata": {"name": name, "namespace": ns}, "data": enc}
+        self._put_object("secrets", ns, name, {
+            "metadata": {"name": name, "namespace": ns}, "data": enc})
 
     def get_secret(self, ns, name):
         with self.lock:
             self._maybe_fail("get_secret")
             return ko.deep_copy(self._get("secrets", ns, name))
+
+    def add_config_map(self, ns: str, name: str, data: dict[str, str]):
+        """Test helper; configmap data is plain strings (no base64)."""
+        self._put_object("configmaps", ns, name, {
+            "metadata": {"name": name, "namespace": ns}, "data": dict(data)})
+
+    def get_config_map(self, ns, name):
+        with self.lock:
+            self._maybe_fail("get_config_map")
+            return ko.deep_copy(self._get("configmaps", ns, name))
+
+    def watch_objects(self, kind, stop=None, resource_version=None):
+        if kind not in ("secrets", "configmaps"):
+            raise KubeApiError(f"unsupported watch kind {kind!r}", status=400)
+        w = _Watcher("", "", stop)
+        with self.lock:
+            self._obj_watchers.setdefault(kind, []).append(w)
+
+        def gen():
+            try:
+                while not w.stop.is_set():
+                    try:
+                        ev = w.q.get(timeout=0.1)
+                    except queue.Empty:
+                        continue
+                    if ev is None:
+                        return
+                    yield ev
+            finally:
+                with self.lock:
+                    if w in self._obj_watchers.get(kind, []):
+                        self._obj_watchers[kind].remove(w)
+        return gen()
 
     def add_job(self, job: dict):
         with self.lock:

@@ -64,6 +64,28 @@ def is_terminal(pod: dict) -> bool:
     return phase(pod) in ("Succeeded", "Failed")
 
 
+def pod_references_object(pod: dict, kind: str, name: str) -> bool:
+    """Does this pod's spec consume secret/configmap ``name``?
+    (env valueFrom refs, envFrom refs, and volumes — the same surfaces
+    translate.extract_env resolves.) ``kind``: "secrets" | "configmaps"."""
+    secret = kind == "secrets"
+    from_key, val_key = (("secretRef", "secretKeyRef") if secret
+                         else ("configMapRef", "configMapKeyRef"))
+    for c in containers(pod):
+        for ef in c.get("envFrom", []):
+            if ef.get(from_key, {}).get("name") == name:
+                return True
+        for e in c.get("env", []):
+            if e.get("valueFrom", {}).get(val_key, {}).get("name") == name:
+                return True
+    for vol in pod.get("spec", {}).get("volumes", []):
+        if secret and vol.get("secret", {}).get("secretName") == name:
+            return True
+        if not secret and vol.get("configMap", {}).get("name") == name:
+            return True
+    return False
+
+
 def now_iso(ts: Optional[float] = None) -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(ts if ts is not None else time.time()))
 

@@ -17,6 +17,8 @@ re-implements the reconciliation machinery from scratch (SURVEY.md §1 L3,
 
 from .node_controller import NodeController
 from .pod_controller import PodController
+from .ref_controller import RefResourceController
 from .api_server import KubeletApiServer
 
-__all__ = ["NodeController", "PodController", "KubeletApiServer"]
+__all__ = ["NodeController", "PodController", "RefResourceController",
+           "KubeletApiServer"]

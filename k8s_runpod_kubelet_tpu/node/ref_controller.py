@@ -1,0 +1,99 @@
+"""Secret/ConfigMap change watcher — the reference controller's
+secret/configmap informers (main.go:180-193), rebuilt for what they are
+actually FOR here: a pod that failed to deploy because a referenced
+Secret/ConfigMap was missing or stale sits Pending on a 30s retry ticker;
+a watch event for that object turns the next retry immediate.
+
+Services are deliberately NOT watched: the upstream virtual-kubelet
+library consumes service informers to inject ``*_SERVICE_HOST/PORT`` env,
+but Cloud TPU VMs are not on the cluster pod network — service ClusterIPs
+are unreachable from the slice, so injecting them would hand workloads
+dead addresses. The same reasoning already strips auto-injected cluster
+env at translate time (translate.is_auto_injected_env).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from ..kube.client import KubeClient
+from ..kube import objects as ko
+
+log = logging.getLogger(__name__)
+
+WATCH_KINDS = ("secrets", "configmaps")
+
+
+class RefResourceController:
+    """One watch thread per kind; a change to an object some PENDING pod
+    references kicks the provider's pending processor immediately."""
+
+    def __init__(self, kube: KubeClient, provider,
+                 kinds: tuple[str, ...] = WATCH_KINDS,
+                 backoff_s: float = 1.0, max_backoff_s: float = 60.0):
+        self.kube = kube
+        self.provider = provider
+        self.kinds = kinds
+        self.backoff_s = backoff_s
+        self.max_backoff_s = max_backoff_s
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    def start(self) -> "RefResourceController":
+        for kind in self.kinds:
+            t = threading.Thread(target=self._watch_loop, args=(kind,),
+                                 name=f"ref-watch-{kind}", daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def _watch_loop(self, kind: str):
+        # Resume from the last-seen resourceVersion so the server's
+        # periodic stream closes (~5min) don't replay ADDED for every
+        # existing object — which would spuriously "immediate-retry"
+        # pending pods on each reconnect. RV is tracked from EVERY event
+        # (incl. bookmarks) and reset on 410 Gone (compacted).
+        rv: str | None = None
+        backoff = self.backoff_s
+        while not self._stop.is_set():
+            try:
+                for ev in self.kube.watch_objects(kind, stop=self._stop,
+                                                  resource_version=rv):
+                    backoff = self.backoff_s  # stream is healthy
+                    new_rv = (ev.object.get("metadata", {})
+                              .get("resourceVersion"))
+                    if new_rv:
+                        rv = new_rv
+                    if ev.type not in ("ADDED", "MODIFIED"):
+                        continue
+                    self._on_change(kind, ev.object)
+            except Exception as e:  # noqa: BLE001 — watch streams break; resume
+                status = getattr(e, "status", None)
+                if status == 410:
+                    rv = None  # compacted: next connect replays, gate filters
+                    log.debug("%s watch RV compacted; restarting fresh", kind)
+                else:
+                    # a PERSISTENT failure (e.g. RBAC denies cluster-wide
+                    # secret watches) must be operator-visible, not a silent
+                    # 1/s hot loop: warn with the growing backoff
+                    log.warning("%s watch failed (%s) — pending-pod retries "
+                                "fall back to the %.0fs ticker; retrying the "
+                                "watch in %.0fs", kind, e,
+                                self.provider.cfg.pending_retry_interval_s,
+                                backoff)
+                    backoff = min(backoff * 2, self.max_backoff_s)
+            self._stop.wait(backoff)
+
+    def _on_change(self, kind: str, obj: dict):
+        ns, name = ko.namespace(obj), ko.name(obj)
+        if self.provider.has_pending_reference(kind, ns, name):
+            log.info("%s %s/%s changed — retrying pending deploys now "
+                     "(instead of the %.0fs ticker)", kind[:-1], ns, name,
+                     self.provider.cfg.pending_retry_interval_s)
+            self.provider.process_pending_pods()

@@ -264,6 +264,18 @@ class ReconcileMixin:
 
     # -- pending deploys -------------------------------------------------------
 
+    def has_pending_reference(self, kind: str, ns: str, name: str) -> bool:
+        """Does any PENDING (undeployed) pod consume this secret/configmap?
+        The ref-resource watcher uses this to turn an object change into an
+        immediate deploy retry instead of waiting out the 30s ticker."""
+        with self.lock:
+            return any(
+                ko.namespace(p) == ns
+                and ko.pod_references_object(p, kind, name)
+                for k, p in self.pods.items()
+                if (i := self.instances.get(k)) is not None
+                and not i.qr_name and i.pending_since is not None)
+
     def process_pending_pods(self):
         """Retry undeployed pods; give up after max_pending_s
         (parity: startPendingPodProcessor kubelet.go:734-814)."""

@@ -73,29 +73,51 @@ def _decode_secret(secret: dict, key: str) -> str:
 
 
 def extract_env(kube: KubeClient, pod: dict) -> dict[str, str]:
-    """Collect env from ALL containers: plain values, secretKeyRef, envFrom
-    secretRef, and secret volumes flattened to env (parity:
-    runpod_client.go:949-1054), minus auto-injected cluster vars."""
+    """Collect env from ALL containers: plain values, secretKeyRef /
+    configMapKeyRef, envFrom secretRef / configMapRef, and secret volumes
+    flattened to env (parity: runpod_client.go:949-1054 — which covered
+    secrets only; configmaps are what the reference controller's configmap
+    informer exists for, main.go:180-193), minus auto-injected cluster
+    vars."""
     env: dict[str, str] = {}
     ns = ko.namespace(pod)
     secret_cache: dict[str, dict] = {}
+    cm_cache: dict[str, dict] = {}
 
     def fetch_secret(name: str) -> dict:
         if name not in secret_cache:
             secret_cache[name] = kube.get_secret(ns, name)
         return secret_cache[name]
 
+    def fetch_cm(name: str) -> dict:
+        if name not in cm_cache:
+            cm_cache[name] = kube.get_config_map(ns, name)
+        return cm_cache[name]
+
     for c in ko.containers(pod):
         for ef in c.get("envFrom", []):
             ref = ef.get("secretRef")
-            if not ref:
-                continue
-            try:
-                secret = fetch_secret(ref["name"])
-            except KubeApiError as e:
-                raise TranslationError(f"envFrom secret {ref['name']}: {e}") from e
-            for key in secret.get("data", {}):
-                env[ef.get("prefix", "") + key] = _decode_secret(secret, key)
+            if ref:
+                try:
+                    secret = fetch_secret(ref["name"])
+                except KubeApiError as e:
+                    if ref.get("optional") and e.is_not_found:
+                        continue
+                    raise TranslationError(
+                        f"envFrom secret {ref['name']}: {e}") from e
+                for key in secret.get("data", {}):
+                    env[ef.get("prefix", "") + key] = _decode_secret(secret, key)
+            ref = ef.get("configMapRef")
+            if ref:
+                try:
+                    cm = fetch_cm(ref["name"])
+                except KubeApiError as e:
+                    if ref.get("optional") and e.is_not_found:
+                        continue
+                    raise TranslationError(
+                        f"envFrom configmap {ref['name']}: {e}") from e
+                for key, val in cm.get("data", {}).items():
+                    env[ef.get("prefix", "") + key] = val
         for e in c.get("env", []):
             name = e.get("name", "")
             if not name or is_auto_injected_env(name):
@@ -109,10 +131,20 @@ def extract_env(kube: KubeClient, pod: dict) -> dict[str, str]:
                 try:
                     secret = fetch_secret(ref["name"])
                 except KubeApiError as ex:
-                    if ref.get("optional"):
+                    if ref.get("optional") and ex.is_not_found:
                         continue
                     raise TranslationError(f"secret {ref['name']}: {ex}") from ex
                 env[name] = _decode_secret(secret, ref["key"])
+            elif "configMapKeyRef" in src:
+                ref = src["configMapKeyRef"]
+                try:
+                    cm = fetch_cm(ref["name"])
+                except KubeApiError as ex:
+                    if ref.get("optional") and ex.is_not_found:
+                        continue
+                    raise TranslationError(
+                        f"configmap {ref['name']}: {ex}") from ex
+                env[name] = cm.get("data", {}).get(ref["key"], "")
             elif "fieldRef" in src:
                 fp = src["fieldRef"].get("fieldPath", "")
                 if fp == "metadata.name":
@@ -127,7 +159,7 @@ def extract_env(kube: KubeClient, pod: dict) -> dict[str, str]:
         try:
             secret = fetch_secret(sec["secretName"])
         except KubeApiError as e:
-            if sec.get("optional"):
+            if sec.get("optional") and e.is_not_found:
                 continue
             raise TranslationError(f"volume secret {sec['secretName']}: {e}") from e
         for key in secret.get("data", {}):

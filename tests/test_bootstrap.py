@@ -159,7 +159,7 @@ class TestBuild:
     def test_build_wires_everything_with_fakes(self):
         h = make_harness()
         try:
-            provider, nc, pc, api, health = build(
+            provider, nc, pc, rc, api, health = build(
                 h.cfg, kube=h.kube, tpu=h.tpu, worker_transport=h.transport)
             # bring it up briefly and check the node registers
             nc.register_node()

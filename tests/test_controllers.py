@@ -89,6 +89,52 @@ class TestNodeController:
         assert conds["Ready"]["status"] == "False"
 
 
+class TestRefResourceController:
+    def test_secret_creation_kicks_pending_deploy(self, h):
+        """A pod whose deploy failed on a missing Secret sits Pending on
+        the 30s ticker; the secret/configmap watcher (the reference's
+        informer analog, main.go:180-193) turns the retry immediate."""
+        from k8s_runpod_kubelet_tpu.node import RefResourceController
+        pod = make_pod("needs-secret", chips=16)
+        pod["spec"]["containers"][0]["env"] = [
+            {"name": "TOKEN", "valueFrom":
+             {"secretKeyRef": {"name": "late-secret", "key": "t"}}}]
+        h.kube.create_pod(pod)
+        h.provider.create_pod(pod)       # secret missing -> stays pending
+        key = "default/needs-secret"
+        assert h.provider.instances[key].qr_name == ""
+        assert h.provider.instances[key].pending_since is not None
+        rc = RefResourceController(h.kube, h.provider).start()
+        try:
+            # an UNRELATED secret must not trigger anything
+            h.kube.add_secret("default", "unrelated", {"x": "y"})
+            time.sleep(0.3)
+            assert h.provider.instances[key].qr_name == ""
+            # the referenced secret appearing deploys the pod promptly
+            h.kube.add_secret("default", "late-secret", {"t": "v"})
+            wait_for(lambda: h.provider.instances[key].qr_name,
+                     msg="watch-driven deploy retry")
+        finally:
+            rc.stop()
+
+    def test_config_map_rotation_kicks_pending_deploy(self, h):
+        from k8s_runpod_kubelet_tpu.node import RefResourceController
+        pod = make_pod("needs-cm", chips=16)
+        pod["spec"]["containers"][0]["envFrom"] = [
+            {"configMapRef": {"name": "late-cm"}}]
+        h.kube.create_pod(pod)
+        h.provider.create_pod(pod)
+        key = "default/needs-cm"
+        assert h.provider.instances[key].qr_name == ""
+        rc = RefResourceController(h.kube, h.provider).start()
+        try:
+            h.kube.add_config_map("default", "late-cm", {"A": "1"})
+            wait_for(lambda: h.provider.instances[key].qr_name,
+                     msg="configmap watch-driven deploy retry")
+        finally:
+            rc.stop()
+
+
 class TestPodControllerE2E:
     def test_full_lifecycle_through_watch(self, h):
         pc = PodController(h.kube, h.provider, "virtual-tpu", resync_interval_s=3600)

@@ -107,6 +107,55 @@ class TestEnvExtraction:
         pod["spec"]["containers"][0]["env"][0]["valueFrom"]["secretKeyRef"]["optional"] = True
         assert extract_env(kube, pod) == {}
 
+    def test_config_map_key_ref_and_env_from(self, kube):
+        """ConfigMaps resolve like secrets (plain strings, no base64) —
+        the surface the reference's configmap informer exists for."""
+        kube.add_config_map("default", "settings",
+                            {"MODEL": "llama3-8b", "STEPS": "100"})
+        pod = make_pod(containers=[{
+            "name": "m", "image": "img",
+            "env": [{"name": "WHICH", "valueFrom":
+                     {"configMapKeyRef": {"name": "settings",
+                                          "key": "MODEL"}}}],
+            "envFrom": [{"configMapRef": {"name": "settings"},
+                         "prefix": "C_"}],
+        }])
+        env = extract_env(kube, pod)
+        assert env["WHICH"] == "llama3-8b"
+        assert env["C_MODEL"] == "llama3-8b" and env["C_STEPS"] == "100"
+
+    def test_missing_config_map_raises_unless_optional(self, kube):
+        pod = make_pod(containers=[{
+            "name": "m", "image": "img",
+            "env": [{"name": "K", "valueFrom":
+                     {"configMapKeyRef": {"name": "nope", "key": "k"}}}]}])
+        with pytest.raises(TranslationError):
+            extract_env(kube, pod)
+        pod["spec"]["containers"][0]["env"][0]["valueFrom"][
+            "configMapKeyRef"]["optional"] = True
+        assert extract_env(kube, pod) == {}
+        pod2 = make_pod(containers=[{
+            "name": "m", "image": "img",
+            "envFrom": [{"configMapRef": {"name": "nope",
+                                          "optional": True}}]}])
+        assert extract_env(kube, pod2) == {}
+
+    def test_optional_swallows_only_404(self, kube):
+        """`optional: true` covers a MISSING object (404) — a transient
+        API failure must still fail translation (retry with full env),
+        not silently deploy the workload with env dropped."""
+        from k8s_runpod_kubelet_tpu.kube.client import KubeApiError
+        kube.add_secret("default", "creds", {"K": "v"})
+        pod = make_pod(containers=[{
+            "name": "m", "image": "img",
+            "env": [{"name": "K", "valueFrom":
+                     {"secretKeyRef": {"name": "creds", "key": "K",
+                                       "optional": True}}}]}])
+        kube.fail_next["get_secret"] = KubeApiError("boom", status=500)
+        with pytest.raises(TranslationError):
+            extract_env(kube, pod)
+        assert extract_env(kube, pod)["K"] == "v"  # healthy API: resolves
+
     def test_volume_secret_flattened(self, kube):
         kube.add_secret("default", "vol-secret", {"service-account.json": "{}"})
         pod = make_pod()
