@@ -187,42 +187,70 @@ def _row_keys(seeds: jax.Array, draws: jax.Array) -> jax.Array:
     return jax.vmap(one)(seeds, draws)
 
 
+def _scaled_and_greedy(logits, temps):
+    """Shared head of both sampling kernels (inlines under jit): argmax for
+    the per-row greedy override, temperature-scaled f32 logits."""
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = (logits / jnp.maximum(temps, 1e-6)[:, None]).astype(jnp.float32)
+    return scaled, greedy
+
+
+@jax.jit
+def _sample_plain(logits: jax.Array, keys: jax.Array,
+                  temps: jax.Array) -> jax.Array:
+    """Unfiltered per-row sampling (no top-k/top-p in the batch): no (B, V)
+    sort on the per-token hot loop."""
+    scaled, greedy = _scaled_and_greedy(logits, temps)
+    sampled = jax.vmap(jax.random.categorical)(keys, scaled)
+    return jnp.where(temps > 0.0, sampled, greedy)
+
+
+@jax.jit
+def _sample_filtered(logits: jax.Array, keys: jax.Array, temps: jax.Array,
+                     top_ks: jax.Array, top_ps: jax.Array) -> jax.Array:
+    v = logits.shape[-1]
+    scaled, greedy = _scaled_and_greedy(logits, temps)
+    sorted_desc = -jnp.sort(-scaled, axis=-1)              # (B, V) desc
+    # top-k threshold: the k-th largest logit (k=0 -> keep all)
+    ks = jnp.where(top_ks > 0, top_ks, v)
+    thresh_k = jnp.take_along_axis(
+        sorted_desc, jnp.clip(ks - 1, 0, v - 1)[:, None], axis=-1)
+    # top-p threshold: smallest prefix of the sorted distribution with
+    # cumulative mass >= p; "cum before this token < p" keeps >= 1 token
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    before = jnp.cumsum(probs, axis=-1) - probs
+    keep = before < top_ps[:, None]
+    idx_p = jnp.sum(keep, axis=-1) - 1                     # last kept
+    thresh_p = jnp.take_along_axis(sorted_desc, idx_p[:, None], axis=-1)
+    thresh = jnp.maximum(thresh_k, thresh_p)
+    filtered = jnp.where(scaled >= thresh, scaled, -jnp.inf)
+    sampled = jax.vmap(jax.random.categorical)(keys, filtered)
+    return jnp.where(temps > 0.0, sampled, greedy)
+
+
 def _sample(logits: jax.Array, keys: jax.Array, temps: list[float],
             top_ks: Optional[list[int]] = None,
             top_ps: Optional[list[float]] = None) -> jax.Array:
     """Per-row temperature + top-k + nucleus (top-p) sampling with PER-ROW
     PRNG keys (``keys`` (B, 2) from _row_keys). Filters operate on the
     temperature-scaled distribution; the (B, V) sort is cheap at serving
-    batch sizes (JetStream does the same)."""
-    greedy = jnp.argmax(logits, axis=-1)
+    batch sizes (JetStream does the same).
+
+    Dispatches to JITTED kernels with per-row parameters as ARRAYS — the
+    sampler runs once per decode step, and an eager version costs ~10
+    separate device executions per step; only the all-greedy / any-filter
+    shape of the batch (two variants total) picks the compiled path."""
     if all(t <= 0.0 for t in temps):
-        return greedy
-    b, v = logits.shape
+        return jnp.argmax(logits, axis=-1)
+    b = logits.shape[0]
+    t = jnp.asarray(temps, jnp.float32)
     top_ks = top_ks or [0] * b
     top_ps = top_ps or [1.0] * b
-    t = jnp.asarray([max(tt, 1e-6) for tt in temps])[:, None]
-    scaled = (logits / t).astype(jnp.float32)
     if all(k <= 0 for k in top_ks) and all(p >= 1.0 for p in top_ps):
-        # unfiltered fast path: no (B, V) sort on the per-token hot loop
-        sampled = jax.vmap(jax.random.categorical)(keys, scaled)
-    else:
-        sorted_desc = -jnp.sort(-scaled, axis=-1)              # (B, V) desc
-        # top-k threshold: the k-th largest logit (k=0 -> keep all)
-        ks = jnp.asarray([k if k > 0 else v for k in top_ks])
-        thresh_k = jnp.take_along_axis(
-            sorted_desc, jnp.clip(ks - 1, 0, v - 1)[:, None], axis=-1)
-        # top-p threshold: smallest prefix of the sorted distribution with
-        # cumulative mass >= p; "cum before this token < p" keeps >= 1 token
-        probs = jax.nn.softmax(sorted_desc, axis=-1)
-        before = jnp.cumsum(probs, axis=-1) - probs
-        keep = before < jnp.asarray(top_ps)[:, None]
-        idx_p = jnp.sum(keep, axis=-1) - 1                     # last kept
-        thresh_p = jnp.take_along_axis(sorted_desc, idx_p[:, None], axis=-1)
-        thresh = jnp.maximum(thresh_k, thresh_p)
-        filtered = jnp.where(scaled >= thresh, scaled, -jnp.inf)
-        sampled = jax.vmap(jax.random.categorical)(keys, filtered)
-    use_sampled = jnp.asarray([tt > 0.0 for tt in temps])
-    return jnp.where(use_sampled, sampled, greedy)
+        return _sample_plain(logits, keys, t)
+    return _sample_filtered(logits, keys, t,
+                            jnp.asarray(top_ks, jnp.int32),
+                            jnp.asarray(top_ps, jnp.float32))
 
 
 class ServingEngine:
