@@ -653,6 +653,11 @@ def main(argv=None) -> int:
                    help="ring KV cache for sliding-window models: physical "
                         "cache shrinks to ~window while --cache-len stays "
                         "the logical budget (default auto)")
+    p.add_argument("--tensor-parallel", type=int, default=1,
+                   help="shard the model over this many chips (tensor "
+                        "parallelism): params by the logical-axis rules, "
+                        "KV cache on its kv-heads axis — 70B-class serving "
+                        "spans a slice this way")
     p.add_argument("--hf-checkpoint", default="",
                    help="HuggingFace model directory (safetensors/bin) to "
                         "load real weights from; empty = random init")
@@ -676,16 +681,43 @@ def main(argv=None) -> int:
     from .tokenizer import get_tokenizer
     tokenizer = get_tokenizer(args.tokenizer)  # before the expensive load:
     # a bad --tokenizer path must fail fast, not after minutes of weights
+    mesh = None
+    if args.tensor_parallel > 1:
+        # fail-fast BEFORE the expensive weight load, like the tokenizer
+        # check above
+        from ..parallel import MeshConfig, make_mesh
+        n = args.tensor_parallel
+        if args.int8:
+            log.error("--tensor-parallel does not compose with --int8 yet "
+                      "(quantized {q8, scale} leaves have no logical-axis "
+                      "rules); serve sharded in bf16")
+            return 1
+        if cfg.n_kv_heads % n or cfg.n_heads % n:
+            log.error("--tensor-parallel %d must divide the model's head "
+                      "counts (n_heads=%d, n_kv_heads=%d)",
+                      n, cfg.n_heads, cfg.n_kv_heads)
+            return 1
+        if len(jax.devices()) < n:
+            log.error("--tensor-parallel %d but jax sees %d device(s)",
+                      n, len(jax.devices()))
+            return 1
+        mesh = make_mesh(MeshConfig(data=1, tensor=n), jax.devices()[:n])
+        log.info("sharded serving: tensor=%d over %s", n, jax.devices()[:n])
     if args.hf_checkpoint:
         from ..models import load_hf
         params = load_hf(cfg, args.hf_checkpoint)  # host tree
-        if not args.int8:
+        if mesh is not None:
+            from ..models import param_logical_axes
+            from ..parallel import param_shardings
+            params = jax.device_put(
+                params, param_shardings(mesh, param_logical_axes(cfg)))
+        elif not args.int8:
             # one device_put (serving is single-host per replica); with
             # --int8 the engine quantizes from host instead, so the
             # full-precision tree never occupies HBM next to the int8 copy
             params = jax.device_put(params)
     else:
-        params = init_params(cfg, jax.random.PRNGKey(0))
+        params = init_params(cfg, jax.random.PRNGKey(0), mesh)
     engine = ServingEngine(cfg, params, ServingConfig(
         slots=args.slots, cache_len=args.cache_len,
         max_new_tokens=args.max_new_tokens,
@@ -703,8 +735,8 @@ def main(argv=None) -> int:
         eos_token=(tokenizer.eos_id if tokenizer is not None else -1)),
         # decoded-text stop matching (BPE-exact stops) needs the engine
         # to see text, not just token ids
-        decode_fn=(tokenizer.decode if tokenizer is not None else None)
-        ).start()
+        decode_fn=(tokenizer.decode if tokenizer is not None else None),
+        mesh=mesh).start()
     httpd = serve(engine, args.port, tokenizer=tokenizer,
                   allow_adapters=args.dynamic_adapters)
     log.info("serving on :%d (POST /generate, GET /metrics)", args.port)

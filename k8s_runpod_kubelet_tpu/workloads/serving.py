@@ -256,13 +256,24 @@ def _sample(logits: jax.Array, keys: jax.Array, temps: list[float],
 class ServingEngine:
     def __init__(self, cfg: LlamaConfig, params: Params, sc: ServingConfig,
                  metrics: Optional[Metrics] = None, seed: int = 0,
-                 decode_fn=None):
+                 decode_fn=None, mesh=None):
         self.cfg = cfg
         self.sc = sc
         # tokens -> text, for text-exact (BPE-safe) stop strings; the
         # engine stays tokenizer-agnostic — the HTTP layer injects this
         self._decode_fn = decode_fn
-        self.model = LlamaModel(cfg)
+        # SHARDED serving (70B-class models span chips): the model threads
+        # the mesh through prefill/decode/verify, params arrive pre-sharded
+        # (init_params(cfg, key, mesh) / device_put with param_shardings),
+        # and the KV cache shards its kv-heads axis over ``tensor`` — GSPMD
+        # inserts the collectives, exactly like the training forward
+        self.mesh = mesh
+        if mesh is not None and sc.quantize_int8:
+            raise ValueError("mesh serving with quantize_int8 is not "
+                             "supported yet: int8 leaves are {q8, scale} "
+                             "dicts the logical-axis rules don't cover — "
+                             "serve sharded in bf16 or quantize single-chip")
+        self.model = LlamaModel(cfg, mesh)
         if sc.quantize_int8:
             from ..models.quant import quantize_params
             params = quantize_params(cfg, params)
@@ -364,17 +375,45 @@ class ServingEngine:
 
     def _fresh_cache(self, batch: int) -> Params:
         """One construction path for every cache this engine makes (the
-        batch cache, prefill singles, and the post-crash rebuild)."""
-        if self._ring_len is not None:
-            if self.cfg.sliding_window_pattern > 1:
-                # Gemma-2/3: ring for local sublayers, full for global
-                return self.model.init_mixed_cache(
-                    batch, self.sc.cache_len, self._ring_len,
-                    quantize=self.sc.quantize_kv_int8)
-            return self.model.init_ring_cache(
-                batch, self._ring_len, quantize=self.sc.quantize_kv_int8)
-        return self.model.init_cache(
-            batch, self.sc.cache_len, quantize=self.sc.quantize_kv_int8)
+        batch cache, prefill singles, and the post-crash rebuild).
+
+        Mesh serving: the cache is built DIRECTLY under its sharding
+        (jit + out_shardings) — allocating the full (L, slots, len, h, d)
+        tree on one device and resharding after would OOM at construction
+        for exactly the 70B-class models sharding exists for. K/V
+        sections shard their kv-heads axis over ``tensor`` (the attention
+        compute layout); bookkeeping (index/abs_pos) replicates."""
+        def build() -> Params:
+            if self._ring_len is not None:
+                if self.cfg.sliding_window_pattern > 1:
+                    # Gemma-2/3: ring for local sublayers, full for global
+                    return self.model.init_mixed_cache(
+                        batch, self.sc.cache_len, self._ring_len,
+                        quantize=self.sc.quantize_kv_int8)
+                return self.model.init_ring_cache(
+                    batch, self._ring_len, quantize=self.sc.quantize_kv_int8)
+            return self.model.init_cache(
+                batch, self.sc.cache_len, quantize=self.sc.quantize_kv_int8)
+
+        if self.mesh is None:
+            return build()
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.mesh import AXES
+
+        def spec(name, ndim):
+            if name in ("index", "abs_pos"):
+                return P()
+            if name.endswith("_scale"):
+                # (L, B, len, h): heads last
+                return P(*([None] * (ndim - 1) + [AXES.TENSOR]))
+            # (L, B, len, h, d): heads second-to-last
+            return P(*([None] * (ndim - 2) + [AXES.TENSOR, None]))
+
+        shapes = jax.eval_shape(build)
+        shardings = {name: NamedSharding(self.mesh, spec(name, sd.ndim))
+                     for name, sd in shapes.items()}
+        return jax.jit(build, out_shardings=shardings)()
 
     @staticmethod
     def _pick_ring_len(cfg: LlamaConfig, sc: ServingConfig) -> Optional[int]:
