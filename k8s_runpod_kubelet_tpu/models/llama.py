@@ -95,6 +95,12 @@ class LlamaConfig:
     # FLOPs); "dots": save matmul outputs, recompute elementwise (MaxText's
     # default trade at scale — needs the activation HBM); "none": save all.
     remat_policy: str = "full"
+    # sequence-parallel attention chunks through the streamed Pallas
+    # kernels ("ring flash attention") instead of the XLA einsum
+    # recurrence: per-chunk scores never materialize in HBM and windowed
+    # rings truncate their rotation. CPU-parity-tested (interpret mode);
+    # default OFF until verified on real TPU — flip per ROUND3_NOTES.
+    ring_flash: bool = False
 
     @property
     def head_dim_(self) -> int:
@@ -515,7 +521,8 @@ def _attention_block(x, lp, cfg: LlamaConfig, cos, sin, mesh, positions=None,
         o = ring_attention(qt, kt, vt, mesh, causal=True,
                            sm_scale=cfg.sm_scale,
                            logit_soft_cap=cfg.attn_logit_softcap,
-                           sliding_window=window)
+                           sliding_window=window,
+                           use_flash=cfg.ring_flash)
     else:
         o = flash_attention(qt, kt, vt, causal=True, sm_scale=cfg.sm_scale,
                             sliding_window=window,

@@ -111,8 +111,12 @@ def _attention_xla(q, k, v, *, causal: bool, sm_scale: float,
     return o.reshape(b, hq, sq, d).astype(q.dtype)
 
 
-def _causal_mask(s, qi, kj, block_q, block_k, window=None):
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+def _causal_mask(s, qi, kj, block_q, block_k, window=None, q_offset=0):
+    """``q_offset``: static global offset of the q block's positions vs the
+    k positions — ring flash attention gives each visiting K/V chunk the
+    fixed offset t*S_local, so the same mask/skip logic serves both the
+    single-chunk and ring cases."""
+    q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     k_pos = kj * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
@@ -127,7 +131,7 @@ def _causal_mask(s, qi, kj, block_q, block_k, window=None):
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
                 block_q: int, block_k: int, num_k_blocks: int, causal: bool,
                 sm_scale: float, window: Optional[int] = None,
-                soft_cap: Optional[float] = None):
+                soft_cap: Optional[float] = None, q_offset: int = 0):
     import jax.experimental.pallas as pl  # noqa: F401 (kernel-only import)
     qi = pl.program_id(2)
     kj = pl.program_id(3)
@@ -147,7 +151,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         if soft_cap is not None:
             s = jnp.tanh(s / soft_cap) * soft_cap
         if causal:
-            s = _causal_mask(s, qi, kj, block_q, block_k, window)
+            s = _causal_mask(s, qi, kj, block_q, block_k, window, q_offset)
         m_prev = m_ref[:, :1]                                 # (bq, 1)
         l_prev = l_ref[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -163,9 +167,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
     if causal:
         # this k block participates iff its first k pos <= the last q pos
         # and (windowed) its last k pos is within the window of some q
-        cond = kj * block_k < (qi + 1) * block_q
+        cond = kj * block_k < (qi + 1) * block_q + q_offset
         if window is not None:
-            cond &= (kj + 1) * block_k > qi * block_q - window + 1
+            cond &= (kj + 1) * block_k > qi * block_q + q_offset - window + 1
         pl.when(cond)(_compute)
     else:
         _compute()
@@ -180,7 +184,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
 def _flash_fwd_pallas(q, k, v, causal: bool, scale: float, block_q: int,
                       block_k: int, interpret: bool = False,
                       window: Optional[int] = None,
-                      soft_cap: Optional[float] = None):
+                      soft_cap: Optional[float] = None, q_offset: int = 0):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -191,7 +195,7 @@ def _flash_fwd_pallas(q, k, v, causal: bool, scale: float, block_q: int,
     kernel = functools.partial(_fwd_kernel, block_q=block_q, block_k=block_k,
                                num_k_blocks=num_k_blocks, causal=causal,
                                sm_scale=scale, window=window,
-                               soft_cap=soft_cap)
+                               soft_cap=soft_cap, q_offset=q_offset)
     return pl.pallas_call(
         kernel,
         grid=(b, hq, sq // block_q, num_k_blocks),
@@ -230,7 +234,7 @@ def _flash_fwd_pallas(q, k, v, causal: bool, scale: float, block_q: int,
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                acc_ref, *, block_q: int, block_k: int, num_k_blocks: int,
                causal: bool, sm_scale: float, window: Optional[int] = None,
-               soft_cap: Optional[float] = None):
+               soft_cap: Optional[float] = None, q_offset: int = 0):
     import jax.experimental.pallas as pl  # noqa: F401
     qi = pl.program_id(2)
     kj = pl.program_id(3)
@@ -252,7 +256,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             t = jnp.tanh(s / soft_cap)
             s = t * soft_cap
         if causal:
-            s = _causal_mask(s, qi, kj, block_q, block_k, window)
+            s = _causal_mask(s, qi, kj, block_q, block_k, window, q_offset)
         p = jnp.exp(s - lse)                                  # (bq, bk)
         dp = jax.lax.dot_general(do, vc, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -264,9 +268,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             preferred_element_type=jnp.float32)
 
     if causal:
-        cond = kj * block_k < (qi + 1) * block_q
+        cond = kj * block_k < (qi + 1) * block_q + q_offset
         if window is not None:
-            cond &= (kj + 1) * block_k > qi * block_q - window + 1
+            cond &= (kj + 1) * block_k > qi * block_q + q_offset - window + 1
         pl.when(cond)(_compute)
     else:
         _compute()
@@ -280,7 +284,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_acc, dv_acc, *, block_q: int, block_k: int,
                 num_q_blocks: int, num_t: int, causal: bool, sm_scale: float,
                 window: Optional[int] = None,
-                soft_cap: Optional[float] = None):
+                soft_cap: Optional[float] = None, q_offset: int = 0):
     import jax.experimental.pallas as pl  # noqa: F401
     kj = pl.program_id(2)
     t = pl.program_id(3)          # t = qh_in_group * num_q_blocks + q_block
@@ -304,7 +308,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             th = jnp.tanh(s / soft_cap)  # NOT `t` — that's the grid index
             s = th * soft_cap
         if causal:
-            s = _causal_mask(s, qi, kj, block_q, block_k, window)
+            s = _causal_mask(s, qi, kj, block_q, block_k, window, q_offset)
         p = jnp.exp(s - lse)                                  # (bq, bk)
         dv_acc[...] += jax.lax.dot_general(
             p, doc, (((0,), (0,)), ((), ())),
@@ -321,9 +325,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     if causal:
         # this q block contributes iff its last q pos >= the first k pos
         # and (windowed) its first q pos still sees this k block
-        cond = (qi + 1) * block_q > kj * block_k
+        cond = (qi + 1) * block_q + q_offset > kj * block_k
         if window is not None:
-            cond &= qi * block_q < (kj + 1) * block_k + window - 1
+            cond &= qi * block_q + q_offset < (kj + 1) * block_k + window - 1
         pl.when(cond)(_compute)
     else:
         _compute()
@@ -337,7 +341,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_bwd_pallas(q, k, v, o, lse, do, causal: bool, scale: float,
                       block_q: int, block_k: int, interpret: bool = False,
                       window: Optional[int] = None,
-                      soft_cap: Optional[float] = None):
+                      soft_cap: Optional[float] = None, q_offset: int = 0):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -352,7 +356,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal: bool, scale: float,
     dq_kernel = functools.partial(_dq_kernel, block_q=block_q,
                                   block_k=block_k, num_k_blocks=num_k_blocks,
                                   causal=causal, sm_scale=scale, window=window,
-                                  soft_cap=soft_cap)
+                                  soft_cap=soft_cap, q_offset=q_offset)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(b, hq, num_q_blocks, num_k_blocks),
@@ -384,7 +388,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal: bool, scale: float,
                                    block_k=block_k,
                                    num_q_blocks=num_q_blocks, num_t=num_t,
                                    causal=causal, sm_scale=scale,
-                                   window=window, soft_cap=soft_cap)
+                                   window=window, soft_cap=soft_cap,
+                                   q_offset=q_offset)
 
     def _qh(bb, kh, j, t):
         return kh * group + t // num_q_blocks

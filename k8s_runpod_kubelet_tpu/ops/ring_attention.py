@@ -26,7 +26,8 @@ except ImportError:  # pragma: no cover — older jax
     from jax.experimental.shard_map import shard_map
 
 from ..parallel.mesh import AXES
-from .attention import NEG_INF
+from .attention import (NEG_INF, _flash_bwd_pallas, _flash_fwd_pallas,
+                        tuned_block_sizes)
 
 
 def _chunk_update(q, kc, vc, acc, m, l, *, q_offset, k_offset, causal, sm_scale,
@@ -65,10 +66,137 @@ def _chunk_update(q, kc, vc, acc, m, l, *, q_offset, k_offset, causal, sm_scale,
     return acc_new, m_new, l_new
 
 
+def _ring_steps(n: int, s_local: int, window: Optional[int]) -> int:
+    """How many ring steps carry any in-band work. Step t's chunk sits at
+    the FIXED offset delta = t*s_local behind the local queries (for the
+    devices where it is relevant at all), so with a sliding window the
+    band dies at a STATIC step: min qpos-kpos in step t is
+    (t-1)*s_local + 1 > window-1 => chunk fully out of band. Truncating
+    the loop there saves both the chunk compute and the remaining K/V
+    rotations — the O(S·W) block-skip property, at ring granularity."""
+    if window is None:
+        return n
+    # step t relevant iff t*s_local - (s_local - 1) < window
+    t_max = (window + s_local - 2) // s_local  # last relevant step index
+    return min(n, t_max + 1)
+
+
+def _ring_flash(qs, ks, vs, idx, *, n: int, axis: str, scale: float,
+                window: Optional[int], soft_cap: Optional[float],
+                block_q: int, block_k: int, interpret: bool):
+    """Ring attention with the STREAMED Pallas kernels per chunk ("ring
+    flash attention"): each visiting K/V chunk runs the flash forward at
+    its static global offset (t*s_local), chunk outputs merge by their
+    row log-sum-exp, and the backward makes the same ring pass feeding
+    the kernels the GLOBAL (o, lse) — exp(s - lse_global) is exactly the
+    global softmax row, so per-chunk grads sum to the exact gradient.
+    The XLA fallback path (_chunk_update) materializes each (Sq, Sk)
+    score chunk in HBM twice per step; the kernels stream it through
+    VMEM. Shapes per device: qs (B,Hq,Sq,D), ks/vs (B,Hkv,Sq,D)."""
+    s_local = qs.shape[2]
+    steps = _ring_steps(n, s_local, window)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def chunk_fwd(qs, t, kc, vc):
+        # t == 0: the device's own chunk — plain causal (+band). t >= 1:
+        # every k precedes every q by the fixed delta; causal=True stays
+        # correct (the mask test is always true) and the window mask/skip
+        # prune in-chunk blocks outside the band. qs is threaded, not
+        # closed over: custom_vjp re-traces with fresh tracers.
+        return _flash_fwd_pallas(qs, kc, vc, True, scale, block_q, block_k,
+                                 interpret, window, soft_cap,
+                                 q_offset=t * s_local)
+
+    def fwd_pass(qs, ks, vs, idx):
+        b, hq, sq, d = qs.shape
+        o_acc = jnp.zeros((b, hq, sq, d), jnp.float32)
+        lse_acc = jnp.full((b, hq, sq, 1), NEG_INF, jnp.float32)
+        kc, vc = ks, vs
+        for t in range(steps):
+            def run(qs=qs, kc=kc, vc=vc, t=t):
+                o_c, lse_c = chunk_fwd(qs, t, kc, vc)
+                return o_c.astype(jnp.float32), lse_c
+
+            def skip():
+                return (jnp.zeros_like(o_acc),
+                        jnp.full_like(lse_acc, NEG_INF))
+
+            # relevance is per-DEVICE (idx >= t: devices near the ring
+            # start have fewer prior chunks); both branches cost one
+            # kernel shape, cond picks at runtime. t=0 (the diagonal) is
+            # always relevant, so lse_acc is finite from the first merge
+            # and the -inf/-inf nan case never arises.
+            o_c, lse_c = jax.lax.cond(idx >= t, run, skip)
+            new_lse = jnp.logaddexp(lse_acc, lse_c)
+            o_acc = (o_acc * jnp.exp(lse_acc - new_lse)
+                     + o_c * jnp.exp(lse_c - new_lse))
+            lse_acc = new_lse
+            if t + 1 < steps:
+                kc = jax.lax.ppermute(kc, axis, perm)
+                vc = jax.lax.ppermute(vc, axis, perm)
+        return o_acc.astype(qs.dtype), lse_acc
+
+    @jax.custom_vjp
+    def ring(qs, ks, vs, idx):
+        return fwd_pass(qs, ks, vs, idx)[0]
+
+    def ring_fwd(qs, ks, vs, idx):
+        o, lse = fwd_pass(qs, ks, vs, idx)
+        return o, (qs, ks, vs, o, lse, idx)
+
+    def ring_bwd(res, g):
+        qs, ks, vs, o, lse, idx = res
+        dq = jnp.zeros(qs.shape, jnp.float32)
+        # dk/dv accumulators ROTATE with their chunks: after the loop each
+        # has collected every device's contribution for the chunk it rides
+        kc, vc = ks, vs
+        dk = jnp.zeros(ks.shape, jnp.float32)
+        dv = jnp.zeros(vs.shape, jnp.float32)
+        for t in range(steps):
+            def run(kc=kc, vc=vc, t=t):
+                # global (o, lse): exp(s - lse) is the GLOBAL softmax row,
+                # so these are the exact per-chunk gradient contributions
+                return _flash_bwd_pallas(qs, kc, vc, o, lse, g, True, scale,
+                                         block_q, block_k, interpret,
+                                         window, soft_cap,
+                                         q_offset=t * s_local)
+
+            def skip():
+                return (jnp.zeros(qs.shape, qs.dtype),
+                        jnp.zeros(ks.shape, ks.dtype),
+                        jnp.zeros(vs.shape, vs.dtype))
+
+            dq_c, dk_c, dv_c = jax.lax.cond(idx >= t, run, skip)
+            dq = dq + dq_c.astype(jnp.float32)
+            dk = dk + dk_c.astype(jnp.float32)
+            dv = dv + dv_c.astype(jnp.float32)
+            if t + 1 < steps:
+                kc = jax.lax.ppermute(kc, axis, perm)
+                vc = jax.lax.ppermute(vc, axis, perm)
+                dk = jax.lax.ppermute(dk, axis, perm)
+                dv = jax.lax.ppermute(dv, axis, perm)
+        # bring each chunk's accumulated dk/dv home: it has rotated
+        # steps-1 hops forward, so n - (steps-1) more completes the cycle
+        hops = (n - (steps - 1)) % n
+        if hops:
+            home = [(i, (i + hops) % n) for i in range(n)]
+            dk = jax.lax.ppermute(dk, axis, home)
+            dv = jax.lax.ppermute(dv, axis, home)
+        return (dq.astype(qs.dtype), dk.astype(ks.dtype),
+                dv.astype(vs.dtype), None)
+
+    ring.defvjp(ring_fwd, ring_bwd)
+    return ring(qs, ks, vs, idx)
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh, *,
                    causal: bool = True, sm_scale: Optional[float] = None,
                    logit_soft_cap: Optional[float] = None,
                    sliding_window: Optional[int] = None,
+                   use_flash: bool = False,
+                   block_q: Optional[int] = None,
+                   block_k: Optional[int] = None,
+                   interpret: bool = False,
                    axis: str = AXES.SEQ) -> jax.Array:
     """Attention over sequence sharded on ``axis``. Global shapes:
     q (B,Hq,S,D), k/v (B,Hkv,S,D), S divisible by the axis size.
@@ -78,17 +206,65 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh, *,
     windowed sublayers band-mask each visiting chunk and skip chunks fully
     outside the band (the K/V still rotates — the ring schedule is fixed —
     but the O(Sq*Sk) chunk math is conditional, so the per-device cost is
-    O(S_local * min(S, W + S_local)) like the Pallas block-skip)."""
+    O(S_local * min(S, W + S_local)) like the Pallas block-skip).
+
+    ``use_flash=True`` runs each chunk through the streamed Pallas kernels
+    instead of the XLA einsum recurrence ("ring flash attention"): the
+    per-chunk (S_local, S_local) scores never materialize in HBM, windowed
+    rings additionally TRUNCATE the rotation at the last in-band step, and
+    a custom VJP re-runs the ring feeding the kernels the global (o, lse)
+    — exact gradients without storing per-chunk probabilities. Requires
+    causal=True and S_local divisible by the block sizes; ``interpret``
+    runs the exact kernel code on CPU (tests)."""
     d = q.shape[-1]
     scale = sm_scale if sm_scale is not None else d ** -0.5
     if sliding_window is not None and not causal:
         raise ValueError("sliding_window requires causal attention")
+    if use_flash and not causal:
+        raise ValueError("ring flash attention requires causal=True")
+    if use_flash and not interpret and jax.default_backend() != "tpu":
+        use_flash = False  # kernels are TPU lowerings; XLA ring off-chip
+                           # (flash_attention's use_pallas auto-off, same)
     n = mesh.shape[axis]
     if n == 1:
         from .attention import flash_attention
         return flash_attention(q, k, v, causal=causal, sm_scale=scale,
                                logit_soft_cap=logit_soft_cap,
-                               sliding_window=sliding_window)
+                               sliding_window=sliding_window,
+                               use_pallas=use_flash or None,
+                               block_q=block_q, block_k=block_k,
+                               interpret=interpret)
+    if use_flash:
+        s_local = q.shape[2] // n
+        bq_t, bk_t = tuned_block_sizes(s_local, s_local)
+        bq = min(block_q or bq_t, s_local)
+        bk = min(block_k or bk_t, s_local)
+        if not bq or not bk or s_local % bq or s_local % bk:
+            # tuned_block_sizes returns 0 for non-multiple-of-128 shards
+            if block_q or block_k:  # explicit request that can't be honored
+                raise ValueError(f"S_local {s_local} not divisible by "
+                                 f"blocks ({bq}, {bk})")
+            use_flash = False  # no kernel-shaped blocking: XLA ring instead
+    if use_flash:
+        def local_flash(qs, ks, vs):
+            idx = jax.lax.axis_index(axis)
+            return _ring_flash(qs, ks, vs, idx, n=n, axis=axis, scale=scale,
+                               window=sliding_window, soft_cap=logit_soft_cap,
+                               block_q=bq, block_k=bk, interpret=interpret)
+
+        spec = P(None, None, axis, None)
+        try:
+            # pallas_call out_shapes carry no vma annotations, which jax>=0.8
+            # shard_map rejects under its default varying-mesh-axes typing.
+            # Only the CONSTRUCTOR probe sits in the try: a TypeError from
+            # tracing local_flash must surface as itself, not as a retry.
+            fn = shard_map(local_flash, mesh=mesh,
+                           in_specs=(spec, spec, spec), out_specs=spec,
+                           check_vma=False)
+        except TypeError:  # pragma: no cover — older jax: no check_vma kwarg
+            fn = shard_map(local_flash, mesh=mesh,
+                           in_specs=(spec, spec, spec), out_specs=spec)
+        return fn(q, k, v)
 
     def local(qs, ks, vs):
         idx = jax.lax.axis_index(axis)
