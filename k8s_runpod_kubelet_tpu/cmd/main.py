@@ -90,7 +90,8 @@ def build(cfg: config_mod.Config, kube=None, tpu=None, worker_transport=None,
 
     metrics = Metrics()
     kube = kube or RealKubeClient.from_env(cfg.kubeconfig)
-    gang = GangExecutor(worker_transport or SshWorkerTransport())
+    gang = GangExecutor(worker_transport or SshWorkerTransport(
+        killable_exec=cfg.exec_killable))
     # "ssh": workload launch/status over the worker transport — works against
     # the PLAIN Cloud TPU v2 surface. "api": the :workload/:detailed extension
     # endpoints (fake server or a worker-agent aggregator deployment).

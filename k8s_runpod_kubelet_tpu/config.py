@@ -50,6 +50,11 @@ class Config:
     # slice (parity-equivalent of the reference's static nvidia.com/gpu:4,
     # kubelet.go:1129, but configurable and quota-honest).
     max_total_chips: int = 0
+    # non-tty kubectl-exec processes are wrapped so client disconnect can
+    # TERM them remotely; requires /bin/sh in the workload image — set
+    # False for distroless/scratch images (plain direct exec, no
+    # disconnect-kill: kubectl-without-pty parity)
+    exec_killable: bool = True
 
     # control loop timing (reference parity, kubelet.go)
     reconcile_interval_s: float = 30.0       # status poll        (kubelet.go:293)

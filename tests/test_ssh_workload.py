@@ -179,3 +179,90 @@ class TestSshLifecycle:
         h.provider.update_all_pod_statuses()
         assert h.kube.get_pod("default", "train")["status"]["phase"] == "Running"
         assert extension_requests(h) == []
+
+
+def _qr_with_workers(n):
+    from k8s_runpod_kubelet_tpu.cloud.types import (QueuedResource,
+                                                    QueuedResourceState,
+                                                    TpuWorker)
+    return QueuedResource(
+        name="qr-x", accelerator_type="v5litepod-16",
+        runtime_version="v2-alpha-tpuv5-lite",
+        state=QueuedResourceState.ACTIVE,
+        workers=[TpuWorker(worker_id=i, hostname=f"w{i}",
+                           internal_ip=f"10.0.0.{i + 1}")
+                 for i in range(n)])
+
+
+class TestNonTtyExecRemoteKill:
+    """r2 weak-list item 8: killing the local ssh client orphans a non-tty
+    remote process (no pty to hang up). The transport wraps non-tty execs
+    with a pid file and exposes remote_kill() — a second short exec that
+    TERMs the recorded pid."""
+
+    def _transport_with_fake_ssh(self, monkeypatch):
+        import subprocess as sp
+        from k8s_runpod_kubelet_tpu.gang.exec import SshWorkerTransport
+        t = SshWorkerTransport()
+        captured = {"popen": None, "runs": []}
+
+        class FakeProc:
+            def poll(self):
+                return None
+
+        def fake_popen(argv, **kw):
+            captured["popen"] = argv
+            return FakeProc()
+
+        def fake_run(argv, **kw):
+            captured["runs"].append(argv)
+            class R:
+                returncode = 0
+                stdout = ""
+                stderr = ""
+            return R()
+
+        monkeypatch.setattr(sp, "Popen", fake_popen)
+        monkeypatch.setattr(sp, "run", fake_run)
+        return t, captured
+
+    def test_non_tty_wraps_with_pidfile_and_kills_remotely(self, monkeypatch):
+        t, cap = self._transport_with_fake_ssh(monkeypatch)
+        qr = _qr_with_workers(2)
+        proc = t.stream_exec(qr, 1, ["sleep", "1000"], tty=False)
+        remote_cmd = cap["popen"][-1]
+        assert "echo $$ > /tmp/.tpu-exec-" in remote_cmd
+        assert "exec sleep 1000" in remote_cmd
+        assert proc.remote_kill is not None
+        proc.remote_kill()
+        assert len(cap["runs"]) == 1
+        kill_cmd = cap["runs"][0][-1]
+        assert "kill -TERM -- -$p" in kill_cmd   # process-group first
+        assert "kill -TERM $p" in kill_cmd       # single-pid fallback
+        assert "rm -f /tmp/.tpu-exec-" in kill_cmd
+
+    def test_tty_keeps_pty_hangup_semantics(self, monkeypatch):
+        t, cap = self._transport_with_fake_ssh(monkeypatch)
+        qr = _qr_with_workers(1)
+        proc = t.stream_exec(qr, 0, ["bash"], tty=True)
+        assert "-tt" in cap["popen"]
+        assert "echo $$" not in cap["popen"][-1]  # no wrapper under a pty
+        assert proc.remote_kill is None
+
+    def test_killable_exec_off_keeps_direct_exec(self, monkeypatch):
+        """Shell-less workload images (distroless): killable_exec=False
+        preserves the plain direct exec (no sh dependency)."""
+        import subprocess as sp
+        from k8s_runpod_kubelet_tpu.gang.exec import SshWorkerTransport
+        t = SshWorkerTransport(killable_exec=False)
+        cap = {}
+
+        class FakeProc:
+            pass
+
+        monkeypatch.setattr(sp, "Popen",
+                            lambda argv, **kw: cap.setdefault("argv", argv)
+                            and FakeProc() or FakeProc())
+        proc = t.stream_exec(_qr_with_workers(1), 0, ["/app/tool"], tty=False)
+        assert cap["argv"][-1] == "docker exec -i workload /app/tool"
+        assert proc.remote_kill is None
