@@ -327,9 +327,16 @@ def serve_once(model: str, *, slots: int, n_req: int, new_toks: int,
     try:
         engine.submit([1, 2, 3], max_new_tokens=2).result(timeout=1800)  # warm
         t0 = time.perf_counter()
+        first_tok = {}
+
+        def on_first(i):
+            def cb(_tok):
+                first_tok.setdefault(i, time.perf_counter() - t0)
+            return cb
+
         futs = [engine.submit([(j % 250) + 1
                                for j in range(1 + (i * 37) % prompt_len)],
-                              max_new_tokens=new_toks)
+                              max_new_tokens=new_toks, on_token=on_first(i))
                 for i in range(n_req)]
         peak_queue = max(engine.queue_depth, 1)
         outs = [f.result(timeout=1800) for f in futs]
@@ -342,6 +349,8 @@ def serve_once(model: str, *, slots: int, n_req: int, new_toks: int,
         engine.stop()
     toks = sum(len(o["tokens"]) for o in outs)
     lats = sorted(o["latency_s"] for o in outs)
+    # TTFT is queue-inclusive (submit -> first token), the user-felt number
+    ttfts = sorted(first_tok.values())
     rec = {
         "metric": "serving_tokens_per_sec",
         "value": round(toks / wall, 1),
@@ -349,6 +358,10 @@ def serve_once(model: str, *, slots: int, n_req: int, new_toks: int,
         "p50_latency_s": round(lats[len(lats) // 2], 3),
         "p99_latency_s": round(lats[min(len(lats) - 1,
                                         int(len(lats) * 0.99))], 3),
+        "p50_ttft_s": round(ttfts[len(ttfts) // 2], 3) if ttfts else None,
+        "p99_ttft_s": (round(ttfts[min(len(ttfts) - 1,
+                                       int(len(ttfts) * 0.99))], 3)
+                       if ttfts else None),
         "requests": n_req, "slots": slots,
         "new_tokens_per_request": new_toks,
         "cache_len": cache_len,

@@ -122,16 +122,27 @@ class SshWorkerTransport(WorkerTransport):
             # worker agent (r2 weak-list item 8).
             import uuid
             pidfile = f"/tmp/.tpu-exec-{uuid.uuid4().hex[:12]}.pid"
-            payload = f"echo $$ > {pidfile}; exec {inner}"
+            # prune pidfiles of DEAD prior execs first (kill -0 = liveness
+            # probe): normal exits never reap remotely (see api_server), so
+            # this lazy sweep is what keeps /tmp bounded; live concurrent
+            # execs keep their files
+            prune = ("for f in /tmp/.tpu-exec-*.pid; do "
+                     "kill -0 \"$(cat \"$f\" 2>/dev/null)\" 2>/dev/null "
+                     "|| rm -f \"$f\"; done; ")
+            payload = f"{prune}echo $$ > {pidfile}; exec {inner}"
             remote_cmd = (f"docker exec {flags} {self.container_name} "
                           f"sh -c {shlex.quote(payload)}")
 
             def remote_kill(qr=qr, worker_id=worker_id, pidfile=pidfile):
-                # group kill first (covers forked children when the pid is
-                # a group leader), single-pid fallback; rm also runs after
-                # a NORMAL exit (the api_server reaps unconditionally), so
-                # pidfiles don't accumulate in long-lived containers
-                reap = (f"p=$(cat {pidfile} 2>/dev/null); "
+                # called only for ABORTED sessions. Wait briefly for the
+                # pidfile: a client that drops within the first second can
+                # beat the wrapper's `echo $$` over the other ssh session —
+                # without the poll, the process this feature exists to kill
+                # would survive. Then group kill first (covers forked
+                # children when the pid leads a group), single-pid fallback.
+                reap = (f"i=0; while [ ! -f {pidfile} ] && [ $i -lt 20 ]; "
+                        f"do sleep 0.1; i=$((i+1)); done; "
+                        f"p=$(cat {pidfile} 2>/dev/null); "
                         f"[ -n \"$p\" ] && "
                         f"{{ kill -TERM -- -$p 2>/dev/null || "
                         f"kill -TERM $p 2>/dev/null; }}; "

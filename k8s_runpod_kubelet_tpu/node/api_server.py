@@ -235,15 +235,17 @@ class _Handler(BaseHTTPRequestHandler):
                 proc.stdin.close()
             except (OSError, ValueError):
                 pass
-            # transports expose remote_kill when killing the LOCAL process
-            # (the ssh client) would orphan the REMOTE one (non-tty docker
-            # exec has no pty to hang up). Called even after a normal exit:
-            # the same command reaps the remote pidfile (kill of a
-            # long-gone pid is a swallowed no-op).
-            rk = getattr(proc, "remote_kill", None)
-            if rk is not None:
-                rk()
             if proc.poll() is None:
+                # ABORTED session: transports expose remote_kill when
+                # killing the LOCAL process (the ssh client) would orphan
+                # the REMOTE one (non-tty docker exec has no pty to hang
+                # up). Normal exits skip this — the pid may already be
+                # recycled (TERM would hit an innocent process) and the
+                # extra ssh round trip would tax every quick exec; stale
+                # pidfiles are pruned by the next exec's launch wrapper.
+                rk = getattr(proc, "remote_kill", None)
+                if rk is not None:
+                    rk()
                 proc.kill()
             pump.join(timeout=5)
 

@@ -233,10 +233,14 @@ class TestNonTtyExecRemoteKill:
         remote_cmd = cap["popen"][-1]
         assert "echo $$ > /tmp/.tpu-exec-" in remote_cmd
         assert "exec sleep 1000" in remote_cmd
+        # the launch wrapper prunes DEAD prior pidfiles (normal exits are
+        # never reaped remotely, so this sweep bounds /tmp)
+        assert "kill -0" in remote_cmd and "rm -f" in remote_cmd
         assert proc.remote_kill is not None
         proc.remote_kill()
         assert len(cap["runs"]) == 1
         kill_cmd = cap["runs"][0][-1]
+        assert "while [ ! -f /tmp/.tpu-exec-" in kill_cmd  # fast-abort race
         assert "kill -TERM -- -$p" in kill_cmd   # process-group first
         assert "kill -TERM $p" in kill_cmd       # single-pid fallback
         assert "rm -f /tmp/.tpu-exec-" in kill_cmd
