@@ -329,15 +329,20 @@ def serve_once(model: str, *, slots: int, n_req: int, new_toks: int,
         t0 = time.perf_counter()
         first_tok = {}
 
-        def on_first(i):
+        def on_first(i, t_sub):
             def cb(_tok):
-                first_tok.setdefault(i, time.perf_counter() - t0)
+                first_tok.setdefault(i, time.perf_counter() - t_sub)
             return cb
 
-        futs = [engine.submit([(j % 250) + 1
-                               for j in range(1 + (i * 37) % prompt_len)],
-                              max_new_tokens=new_toks, on_token=on_first(i))
-                for i in range(n_req)]
+        futs = []
+        for i in range(n_req):
+            prompt = [(j % 250) + 1 for j in range(1 + (i * 37) % prompt_len)]
+            # per-request submit stamp: TTFT is THIS request's submit ->
+            # first token (a shared t0 would fold earlier submits' wall
+            # time into later requests' numbers)
+            futs.append(engine.submit(prompt, max_new_tokens=new_toks,
+                                      on_token=on_first(
+                                          i, time.perf_counter())))
         peak_queue = max(engine.queue_depth, 1)
         outs = [f.result(timeout=1800) for f in futs]
         wall = time.perf_counter() - t0

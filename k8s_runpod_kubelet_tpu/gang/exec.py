@@ -129,7 +129,11 @@ class SshWorkerTransport(WorkerTransport):
             prune = ("for f in /tmp/.tpu-exec-*.pid; do "
                      "kill -0 \"$(cat \"$f\" 2>/dev/null)\" 2>/dev/null "
                      "|| rm -f \"$f\"; done; ")
-            payload = f"{prune}echo $$ > {pidfile}; exec {inner}"
+            # write-then-rename: the pidfile appears ATOMICALLY, so a
+            # concurrent exec's prune can never cat a truncated-but-
+            # unwritten file and reap a live session's record
+            payload = (f"{prune}echo $$ > {pidfile}.tmp && "
+                       f"mv {pidfile}.tmp {pidfile}; exec {inner}")
             remote_cmd = (f"docker exec {flags} {self.container_name} "
                           f"sh -c {shlex.quote(payload)}")
 

@@ -235,17 +235,21 @@ class _Handler(BaseHTTPRequestHandler):
                 proc.stdin.close()
             except (OSError, ValueError):
                 pass
-            if proc.poll() is None:
-                # ABORTED session: transports expose remote_kill when
-                # killing the LOCAL process (the ssh client) would orphan
-                # the REMOTE one (non-tty docker exec has no pty to hang
-                # up). Normal exits skip this — the pid may already be
-                # recycled (TERM would hit an innocent process) and the
-                # extra ssh round trip would tax every quick exec; stale
-                # pidfiles are pruned by the next exec's launch wrapper.
+            # Reap the REMOTE process unless it completed normally:
+            # - poll() is None: client-driven abort (we kill local ssh next)
+            # - returncode == 255: ssh TRANSPORT error (network blip, sshd
+            #   died) — the remote process may have survived its client
+            # - returncode < 0: the local ssh was signal-killed
+            # A normal remote completion (0..254) skips the reap: its pid
+            # may already be recycled (TERM would hit an innocent process)
+            # and the extra ssh round trip would tax every quick exec;
+            # stale pidfiles are pruned by the next exec's launch wrapper.
+            rc = proc.poll()
+            if rc is None or rc == 255 or rc < 0:
                 rk = getattr(proc, "remote_kill", None)
                 if rk is not None:
                     rk()
+            if proc.poll() is None:
                 proc.kill()
             pump.join(timeout=5)
 

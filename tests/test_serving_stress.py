@@ -1,0 +1,141 @@
+"""Randomized serving-engine stress: concurrent submits, cancellations,
+adapter traffic, prefix hits, and n>1 groups interleaved from many client
+threads (SURVEY.md §5.2 race discipline). Invariants checked:
+
+- every future RESOLVES (result, cancelled, or error) — nothing hangs;
+- greedy outputs are a pure function of the prompt (same prompt => same
+  tokens, no cross-request contamination), regardless of interleaving;
+- the HPA queue-depth gauge returns to exactly 0 when drained (the r3
+  fanout-gauge race made it drift negative — this is its regression net);
+- the engine thread survives the whole barrage (alive == True).
+"""
+
+import concurrent.futures
+import random
+import threading
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from k8s_runpod_kubelet_tpu.models import LoraConfig, apply_lora, init_params, tiny_llama
+from k8s_runpod_kubelet_tpu.workloads.serving import ServingConfig, ServingEngine
+
+# ML tier: jax compiles dominate runtime; excluded by -m 'not slow'
+pytestmark = pytest.mark.slow
+
+CFG = tiny_llama(vocab_size=128, embed_dim=64, n_layers=2, n_heads=4,
+                 n_kv_heads=2, mlp_dim=128, max_seq_len=256,
+                 dtype=jnp.float32, param_dtype=jnp.float32)
+PREFIX = [9, 8, 7, 6, 5]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _lora(params, seed):
+    lc = LoraConfig(rank=4, alpha=8.0, targets=("wq", "wv"))
+    wrapped = apply_lora(CFG, params, lc, jax.random.PRNGKey(seed))
+    layers = dict(wrapped["layers"])
+    key = jax.random.PRNGKey(seed + 50)
+    for t in ("wq", "wv"):
+        w = dict(layers[t])
+        key, sub = jax.random.split(key)
+        w["lora_b"] = jax.random.normal(sub, w["lora_b"].shape,
+                                        w["lora_b"].dtype) * 0.05
+        layers[t] = w
+    return {**wrapped, "layers": layers}
+
+
+class TestServingStress:
+    def test_interleaved_barrage_keeps_invariants(self, params):
+        e = ServingEngine(CFG, params,
+                          ServingConfig(slots=3, max_prefill_len=16,
+                                        cache_len=64, max_new_tokens=10,
+                                        lora_rank=4,
+                                        lora_targets=("wq", "wv"))).start()
+        e.register_adapter("t1", _lora(params, 1))
+        e.register_prefix(PREFIX)
+        results = []          # (kind, prompt_key, outcome)
+        res_lock = threading.Lock()
+
+        def client(cid):
+            r = random.Random(cid)
+            for i in range(12):
+                roll = r.random()
+                prompt = [1 + (cid * 13 + i * 7) % 120
+                          for _ in range(1 + (cid + i) % 9)]
+                if roll < 0.15:          # prefix-hitting request
+                    prompt = PREFIX + prompt
+                    fut = e.submit(prompt, max_new_tokens=8)
+                    kind = "prefix"
+                elif roll < 0.30:        # adapter request
+                    fut = e.submit(prompt, max_new_tokens=8, adapter="t1")
+                    kind = "adapter"
+                elif roll < 0.42:        # n>1 group
+                    futs = e.submit_group(prompt, 2, seed=cid * 100 + i,
+                                          temperature=0.8)
+                    for f in futs:
+                        try:
+                            out = f.result(timeout=120)
+                            with res_lock:
+                                results.append(("group", tuple(prompt),
+                                                tuple(out["tokens"])))
+                        except Exception as ex:  # noqa: BLE001
+                            with res_lock:
+                                results.append(("group-err", tuple(prompt),
+                                                repr(ex)))
+                    continue
+                elif roll < 0.55:        # immediate cancellation attempt
+                    fut = e.submit(prompt, max_new_tokens=8)
+                    fut.cancel()
+                    kind = "cancelled"
+                else:                    # plain greedy
+                    fut = e.submit(prompt, max_new_tokens=8)
+                    kind = "plain"
+                try:
+                    out = fut.result(timeout=120)
+                    with res_lock:
+                        results.append((kind, tuple(prompt),
+                                        tuple(out["tokens"])))
+                except concurrent.futures.CancelledError:
+                    with res_lock:
+                        results.append((kind, tuple(prompt), "cancelled"))
+                except Exception as ex:  # noqa: BLE001
+                    with res_lock:
+                        results.append((kind + "-err", tuple(prompt),
+                                        repr(ex)))
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+            assert not t.is_alive(), "client thread hung"
+        try:
+            # 1) engine survived
+            assert e.alive
+            # 2) no unexpected errors
+            errs = [r for r in results if r[0].endswith("-err")]
+            assert errs == [], errs
+            # 3) greedy determinism: same (kind-class, prompt) => same tokens
+            greedy: dict = {}
+            for kind, prompt, toks in results:
+                if toks == "cancelled" or kind in ("group", "cancelled"):
+                    continue
+                key = (kind in ("adapter",), prompt)  # adapter vs base
+                if key in greedy:
+                    assert greedy[key] == toks, (key, greedy[key], toks)
+                else:
+                    greedy[key] = toks
+            # 4) the HPA gauge drained back to EXACTLY zero
+            assert e.queue_depth == 0
+            rendered = e.metrics.render()
+            for line in rendered.splitlines():
+                if line.startswith("tpu_serving_queue_depth"):
+                    assert float(line.split()[-1]) == 0.0, line
+        finally:
+            e.stop()

@@ -232,6 +232,7 @@ class TestNonTtyExecRemoteKill:
         proc = t.stream_exec(qr, 1, ["sleep", "1000"], tty=False)
         remote_cmd = cap["popen"][-1]
         assert "echo $$ > /tmp/.tpu-exec-" in remote_cmd
+        assert ".tmp && mv " in remote_cmd  # atomic pidfile appearance
         assert "exec sleep 1000" in remote_cmd
         # the launch wrapper prunes DEAD prior pidfiles (normal exits are
         # never reaped remotely, so this sweep bounds /tmp)
