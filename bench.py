@@ -66,6 +66,32 @@ _PROBE_TIMEOUT_S = 300     # one cheap backend-init probe before the attempt
                            # must not be misread as dead.
 _CPU_TIMEOUT_S = 600
 
+# Session watcher (r3 VERDICT item 1b: a one-shot probe at driver time can
+# miss every usable window of a flapping tunnel). ``bench.py --watch`` probes
+# on an interval for a whole build session; the moment the chip answers it
+# runs the full staged runbook and persists every JSON line under
+# _RESULTS_DIR. The driver-time orchestrator then prefers a live TPU run but
+# falls back to the freshest persisted TPU result before falling back to CPU.
+_RESULTS_DIR = os.path.join(_HERE, "bench_results")
+_WATCH_INTERVAL_S = 600
+_WATCH_BUDGET_S = 8 * 3600
+_STEP_MAX_ATTEMPTS = 3     # consecutive failures with a HEALTHY tunnel
+_SESSION_MAX_AGE_S = float(os.environ.get("BENCH_SESSION_MAX_AGE_S",
+                                          str(24 * 3600)))
+
+# The staged runbook (ROUND3_NOTES.md order): name, child argv, per-step
+# timeout. Each step is a separate process so an OOM/hang is contained.
+_STAGED_QUEUE = [
+    ("headline", ["--run", "--expect-tpu"], 1800),
+    ("mfu_sweep", ["--mfu-sweep"], 3600),
+    ("attn_tune", ["--attn-tune"], 2400),
+    ("serve_8b", ["--serve", "--model", "llama3-8b", "--int8", "--kv-int8"],
+     2400),
+    ("econ", ["--econ"], 2400),
+    ("ring_flash", ["--ring-flash"], 1800),
+    ("attn", ["--attn"], 2400),  # 32k last inside; sacrificial process
+]
+
 
 # --------------------------------------------------------------------------
 # child: the actual benchmark, run in-process
@@ -249,6 +275,108 @@ def run_attn_bench() -> int:
                 rec["xla_error"] = f"{type(e).__name__}: {e}"[:120]
         _emit(rec)
     return 0
+
+
+def run_ring_flash_check() -> int:
+    """TPU verification for ring flash attention (ROUND3_NOTES step 6b).
+
+    Single chip cannot run a multi-device ring, but it CAN lower and run the
+    exact per-device program the ring executes: ``_ring_flash`` (streamed
+    Pallas chunk kernels under lax.cond/scan inside a custom VJP) via
+    shard_map over a 1-device seq mesh — the composition interpret mode
+    can't validate. Parity vs flash_attention (same math at n=1) for fwd AND
+    grads, then fwd+bwd timing vs the plain flash kernel (ring overhead at
+    n=1 should be noise). With >=2 chips: real ring, parity vs the XLA
+    einsum ring, plus timing."""
+    _force_platform_from_env()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    import importlib
+    # the package re-exports ring_attention the FUNCTION; we need the module
+    ra = importlib.import_module("k8s_runpod_kubelet_tpu.ops.ring_attention")
+    from k8s_runpod_kubelet_tpu.ops.attention import flash_attention
+    from k8s_runpod_kubelet_tpu.parallel import MeshConfig, make_mesh
+
+    if jax.default_backend() != "tpu":
+        _emit({"metric": "ring_flash_check", "value": None,
+               "error": f"needs a TPU, got {jax.default_backend()!r}"})
+        return 1
+
+    n = jax.device_count()
+    b, hq, hkv, s, d = 1, 8, 4, 4096, 128
+    ks = jax.random.split(jax.random.PRNGKey(0), 4)
+    q = jax.random.normal(ks[0], (b, hq, s, d), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, hkv, s, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, hkv, s, d), jnp.bfloat16)
+    g = jax.random.normal(ks[3], (b, hq, s, d), jnp.bfloat16)
+    scale = d ** -0.5
+
+    def timed(fn, iters=10):
+        fn(q, k, v)[0].block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(q, k, v)
+        jax.tree_util.tree_leaves(out)[0].block_until_ready()
+        return (time.perf_counter() - t0) / iters
+
+    def fwd_bwd(attn):
+        def run(q, k, v):
+            out, pull = jax.vjp(attn, q, k, v)
+            return pull(g)
+        return jax.jit(run)
+
+    if n >= 2:
+        mesh = make_mesh(MeshConfig(data=1, seq=n))
+        flash = lambda q, k, v: ra.ring_attention(  # noqa: E731
+            q, k, v, mesh, causal=True, use_flash=True)
+        xla_ring = lambda q, k, v: ra.ring_attention(  # noqa: E731
+            q, k, v, mesh, causal=True)
+        ref_fn, mode = xla_ring, f"ring_n{n}"
+    else:
+        mesh = make_mesh(MeshConfig(data=1, seq=1))
+        s_local = s
+        bq, bk = ra.tuned_block_sizes(s_local, s_local)
+
+        def local_flash(qs, ks_, vs):
+            idx = jax.lax.axis_index(ra.AXES.SEQ)
+            return ra._ring_flash(qs, ks_, vs, idx, n=1, axis=ra.AXES.SEQ,
+                                  scale=scale, window=None, soft_cap=None,
+                                  block_q=bq, block_k=bk, interpret=False)
+
+        spec = P(None, None, ra.AXES.SEQ, None)
+        flash = ra.shard_map_compat(local_flash, mesh=mesh,
+                                    in_specs=(spec, spec, spec),
+                                    out_specs=spec)
+        ref_fn = lambda q, k, v: flash_attention(  # noqa: E731
+            q, k, v, causal=True, sm_scale=scale, use_pallas=True)
+        mode = "single_chip_ring_body"
+
+    # fwd parity
+    got = jax.jit(flash)(q, k, v)
+    ref = jax.jit(ref_fn)(q, k, v)
+    fwd_err = float(jnp.max(jnp.abs(got.astype(jnp.float32)
+                                    - ref.astype(jnp.float32))))
+    # grad parity (the custom VJP vs autodiff of the reference path);
+    # bind the jitted fwd+bwd ONCE each so parity + timing share compiles
+    flash_fb, ref_fb = fwd_bwd(flash), fwd_bwd(ref_fn)
+    got_g = flash_fb(q, k, v)
+    ref_g = ref_fb(q, k, v)
+    grad_err = max(float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                         - b.astype(jnp.float32))))
+                   for a, b in zip(got_g, ref_g))
+    t_flash = timed(flash_fb)
+    t_ref = timed(ref_fb)
+    ok = bool(np.isfinite(fwd_err) and fwd_err < 0.08
+              and np.isfinite(grad_err) and grad_err < 0.25)  # bf16 ulps
+    _emit({"metric": "ring_flash_check", "value": round(t_flash * 1e3, 3),
+           "unit": "ms", "mode": mode, "chips": n, "seq_len": s,
+           "fwd_max_abs_err": round(fwd_err, 4),
+           "grad_max_abs_err": round(grad_err, 4),
+           "ref_ms": round(t_ref * 1e3, 3), "parity_ok": ok})
+    return 0 if ok else 1
 
 
 def _arg_value(flag: str, default: str) -> str:
@@ -655,12 +783,197 @@ def _run_child(quick: bool, platform: str | None, timeout_s: int):
         return None, -2, f"{type(e).__name__}: {e}"
 
 
+def _git_commit() -> str:
+    try:
+        out = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                             capture_output=True, text=True, cwd=_HERE,
+                             timeout=10)
+        return out.stdout.strip() or "unknown"
+    except Exception:  # noqa: BLE001
+        return "unknown"
+
+
+def _result_path(name: str) -> str:
+    return os.path.join(_RESULTS_DIR, f"{name}.json")
+
+
+def _load_result(name: str) -> dict | None:
+    try:
+        with open(_result_path(name), encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _run_staged_step(name: str, argv: list[str], timeout_s: int) -> dict:
+    """Run one runbook step in a child process; persist EVERY JSON line it
+    emits (some benches emit several) plus enough context to audit later."""
+    cmd = [sys.executable, os.path.abspath(__file__)] + argv
+    rec = {"name": name, "argv": argv,
+           "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+           "commit": _git_commit()}
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s, cwd=_HERE)
+        lines = []
+        for line in (proc.stdout or "").splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    lines.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass
+        rec.update(rc=proc.returncode, lines=lines,
+                   stderr_tail=(proc.stderr or "")[-800:],
+                   ok=proc.returncode == 0 and bool(lines))
+    except subprocess.TimeoutExpired as e:
+        partial = e.stderr or b""
+        if isinstance(partial, bytes):
+            partial = partial.decode(errors="replace")
+        rec.update(rc=-1, lines=[],
+                   stderr_tail=(f"timeout after {timeout_s}s; stderr tail: "
+                                f"{partial[-700:]}"),
+                   ok=False)
+    except Exception as e:  # noqa: BLE001
+        rec.update(rc=-2, lines=[], stderr_tail=f"{type(e).__name__}: {e}",
+                   ok=False)
+    os.makedirs(_RESULTS_DIR, exist_ok=True)
+    tmp = _result_path(name) + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(rec, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, _result_path(name))
+    return rec
+
+
+def _result_age_s(rec: dict) -> float:
+    """Age of a persisted result record, +inf if unparseable."""
+    try:
+        import calendar
+        ts = calendar.timegm(time.strptime(rec["ts"], "%Y-%m-%dT%H:%M:%SZ"))
+        return max(0.0, time.time() - ts)
+    except (KeyError, ValueError, TypeError):
+        return float("inf")
+
+
+def run_watch() -> int:
+    """Session watcher: probe the TPU on an interval for up to the budget; on
+    the first success run the staged runbook, persisting each step's JSON as
+    it lands so a short tunnel window mid-session still yields the round's
+    numbers. Steps with a RECENT ok persisted result (younger than
+    --max-age-s, default 8h ~ one build session) are skipped, so the watcher
+    is restartable and a tunnel that flaps mid-queue resumes where it left
+    off — while a new session never silently trusts a previous round's
+    numbers. Pass --fresh to rerun everything. A step that keeps failing
+    while the tunnel is UP (a real bug, not a flap) is retried at most
+    _STEP_MAX_ATTEMPTS times, with an interval sleep between queue passes so
+    a deterministic failure can't spin the whole budget away."""
+    budget = int(_arg_value("--budget-s", os.environ.get(
+        "BENCH_WATCH_BUDGET_S", str(_WATCH_BUDGET_S))))
+    interval = int(_arg_value("--interval-s", str(_WATCH_INTERVAL_S)))
+    max_age = float(_arg_value("--max-age-s", str(8 * 3600)))
+    fresh = "--fresh" in sys.argv
+    deadline = time.monotonic() + budget
+    attempts: dict[str, int] = {}
+
+    def log(msg: str) -> None:
+        print(f"[watch {time.strftime('%H:%M:%S')}] {msg}",
+              file=sys.stderr, flush=True)
+
+    def pending() -> list[tuple[str, list[str], int]]:
+        out = []
+        for name, argv, t in _STAGED_QUEUE:
+            if attempts.get(name, 0) >= _STEP_MAX_ATTEMPTS:
+                continue  # given up; recorded below
+            prior = None if fresh else _load_result(name)
+            if (prior is None or not prior.get("ok")
+                    or _result_age_s(prior) > max_age):
+                out.append((name, argv, t))
+        return out
+
+    gave_up: list[str] = []
+    while time.monotonic() < deadline:
+        todo = pending()
+        if not todo:
+            log("all staged steps have recent ok results; done"
+                + (f" (gave up on: {gave_up})" if gave_up else ""))
+            return 0 if not gave_up else 1
+        ok, diag = _probe_tpu()
+        if not ok:
+            log(f"probe failed ({diag[:120]}); {len(todo)} steps pending; "
+                f"sleeping {interval}s")
+            time.sleep(min(interval, max(0, deadline - time.monotonic())))
+            continue
+        log(f"TPU is UP — running {len(todo)} staged steps")
+        fresh = False  # one fresh pass per invocation, then resume semantics
+        any_failed_with_tpu_up = False
+        for name, argv, t in todo:
+            log(f"step {name}: {' '.join(argv)}")
+            rec = _run_staged_step(name, argv, t)
+            log(f"step {name}: ok={rec['ok']} rc={rec['rc']} "
+                f"lines={len(rec['lines'])}")
+            if rec["ok"]:
+                attempts[name] = 0  # only count consecutive failures
+                continue
+            # hang or error mid-queue: if the tunnel died this was a FLAP,
+            # not the step's fault — don't count it; go back to waiting
+            # (the step stays pending and reruns next window)
+            ok2, diag2 = _probe_tpu()
+            if not ok2:
+                log(f"tunnel died mid-queue ({diag2[:120]}); waiting")
+                break
+            attempts[name] = attempts.get(name, 0) + 1
+            if attempts[name] >= _STEP_MAX_ATTEMPTS:
+                gave_up.append(name)
+                log(f"step {name}: giving up after {attempts[name]} "
+                    f"attempts with a healthy tunnel")
+            any_failed_with_tpu_up = True
+        if any_failed_with_tpu_up:
+            # deterministic failure, tunnel healthy: don't re-spin instantly
+            time.sleep(min(interval, max(0, deadline - time.monotonic())))
+    left = [n for n, _, _ in pending()]
+    if left or gave_up:
+        log(f"budget exhausted; pending={left} gave_up={gave_up}")
+        return 1
+    return 0
+
+
+def _session_tpu_headline() -> dict | None:
+    """Persisted TPU headline from the session watcher, if recent enough.
+    Bounded by _SESSION_MAX_AGE_S (default 24h) so a weeks-old number can
+    never masquerade as this run's result; the emitted line carries
+    measured_ts + measured_commit for audit either way."""
+    rec = _load_result("headline")
+    if not rec or not rec.get("ok"):
+        return None
+    if _result_age_s(rec) > _SESSION_MAX_AGE_S:
+        return None
+    for line in reversed(rec.get("lines", [])):
+        if (line.get("metric") == "train_tokens_per_sec_per_chip"
+                and line.get("value") is not None
+                and line.get("generation") not in (None, "cpu")):
+            line = dict(line)
+            line["source"] = "session_watcher"
+            line["measured_ts"] = rec.get("ts")
+            line["measured_commit"] = rec.get("commit")
+            return line
+    return None
+
+
 def orchestrate(quick: bool) -> int:
     errors = []
-    # 0) one bounded probe: only gate the expensive attempts on it when the
-    # backend cannot initialize at all (hang or hard error) — a probe pass
-    # costs one init; a probe fail saves 3 x 1500s of guaranteed hangs.
-    ok, diag = _probe_tpu()
+    # 0) a bounded probe gates the expensive attempts: a probe pass costs one
+    # init; a probe fail saves 3 x 1500s of guaranteed hangs. The probe
+    # itself retries (r3 VERDICT: one instant's probe can miss a flapping
+    # tunnel's window) — bounded so the driver's own deadline survives.
+    retries = int(os.environ.get("BENCH_PROBE_RETRIES", "2"))
+    ok, diag = False, ""
+    for i in range(max(1, retries)):
+        ok, diag = _probe_tpu()
+        if ok:
+            break
+        if i + 1 < max(1, retries):
+            time.sleep(60)
     attempts = _TPU_ATTEMPTS if ok else 0
     if not ok:
         errors.append(f"tpu probe: {diag}")
@@ -679,7 +992,15 @@ def orchestrate(quick: bool) -> int:
         if attempt < attempts:
             time.sleep(_TPU_RETRY_SLEEP_S)
 
-    # 2) CPU fallback: quick config so it finishes in seconds-to-minutes.
+    # 2) No live TPU — prefer a real TPU number persisted by the session
+    # watcher over a meaningless CPU line (r3 VERDICT weak item 2).
+    session = _session_tpu_headline()
+    if session is not None:
+        session["tpu_errors"] = errors[-2:]
+        _emit(session)
+        return 0
+
+    # 3) CPU fallback: quick config so it finishes in seconds-to-minutes.
     parsed, rc, tail = _run_child(quick=True, platform="cpu",
                                   timeout_s=_CPU_TIMEOUT_S)
     if parsed is not None and parsed.get("value") is not None:
@@ -705,6 +1026,10 @@ def main() -> int:
         return run_mfu_sweep()
     if "--attn-tune" in sys.argv:
         return run_attn_tune()
+    if "--ring-flash" in sys.argv:
+        return run_ring_flash_check()
+    if "--watch" in sys.argv:
+        return run_watch()
     if "--serve" in sys.argv:
         return run_serve_bench(quick)
     if "--run" in sys.argv:

@@ -66,6 +66,20 @@ def _chunk_update(q, kc, vc, acc, m, l, *, q_offset, k_offset, causal, sm_scale,
     return acc_new, m_new, l_new
 
 
+def shard_map_compat(f, mesh, in_specs, out_specs):
+    """shard_map with vma typing off when the kwarg exists: pallas_call
+    out_shapes carry no vma annotations, which jax>=0.8 shard_map rejects
+    under its default varying-mesh-axes typing. Only the CONSTRUCTOR probe
+    sits in the try: a TypeError from tracing ``f`` later must surface as
+    itself, not as a retry."""
+    try:
+        return shard_map(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+    except TypeError:  # pragma: no cover — older jax: no check_vma kwarg
+        return shard_map(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs)
+
+
 def _ring_steps(n: int, s_local: int, window: Optional[int]) -> int:
     """How many ring steps carry any in-band work. Step t's chunk sits at
     the FIXED offset delta = t*s_local behind the local queries (for the
@@ -253,17 +267,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh, *,
                                block_q=bq, block_k=bk, interpret=interpret)
 
         spec = P(None, None, axis, None)
-        try:
-            # pallas_call out_shapes carry no vma annotations, which jax>=0.8
-            # shard_map rejects under its default varying-mesh-axes typing.
-            # Only the CONSTRUCTOR probe sits in the try: a TypeError from
-            # tracing local_flash must surface as itself, not as a retry.
-            fn = shard_map(local_flash, mesh=mesh,
-                           in_specs=(spec, spec, spec), out_specs=spec,
-                           check_vma=False)
-        except TypeError:  # pragma: no cover — older jax: no check_vma kwarg
-            fn = shard_map(local_flash, mesh=mesh,
-                           in_specs=(spec, spec, spec), out_specs=spec)
+        fn = shard_map_compat(local_flash, mesh=mesh,
+                              in_specs=(spec, spec, spec), out_specs=spec)
         return fn(q, k, v)
 
     def local(qs, ks, vs):
