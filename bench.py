@@ -872,7 +872,12 @@ def run_watch() -> int:
         "BENCH_WATCH_BUDGET_S", str(_WATCH_BUDGET_S))))
     interval = int(_arg_value("--interval-s", str(_WATCH_INTERVAL_S)))
     max_age = float(_arg_value("--max-age-s", str(8 * 3600)))
-    fresh = "--fresh" in sys.argv
+    # --fresh: every step must rerun THIS invocation regardless of prior
+    # results — tracked as a per-step set (not a flag cleared at the first
+    # window) so a flap mid-queue can't silently demote the rest of the
+    # queue back to resume semantics
+    force = ({name for name, _, _ in _STAGED_QUEUE}
+             if "--fresh" in sys.argv else set())
     deadline = time.monotonic() + budget
     attempts: dict[str, int] = {}
 
@@ -885,7 +890,7 @@ def run_watch() -> int:
         for name, argv, t in _STAGED_QUEUE:
             if attempts.get(name, 0) >= _STEP_MAX_ATTEMPTS:
                 continue  # given up; recorded below
-            prior = None if fresh else _load_result(name)
+            prior = None if name in force else _load_result(name)
             if (prior is None or not prior.get("ok")
                     or _result_age_s(prior) > max_age):
                 out.append((name, argv, t))
@@ -905,7 +910,6 @@ def run_watch() -> int:
             time.sleep(min(interval, max(0, deadline - time.monotonic())))
             continue
         log(f"TPU is UP — running {len(todo)} staged steps")
-        fresh = False  # one fresh pass per invocation, then resume semantics
         any_failed_with_tpu_up = False
         for name, argv, t in todo:
             log(f"step {name}: {' '.join(argv)}")
@@ -914,6 +918,7 @@ def run_watch() -> int:
                 f"lines={len(rec['lines'])}")
             if rec["ok"]:
                 attempts[name] = 0  # only count consecutive failures
+                force.discard(name)  # --fresh satisfied for this step
                 continue
             # hang or error mid-queue: if the tunnel died this was a FLAP,
             # not the step's fault — don't count it; go back to waiting

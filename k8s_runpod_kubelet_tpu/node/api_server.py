@@ -45,6 +45,35 @@ log = logging.getLogger(__name__)
 
 _LOGS_RE = re.compile(r"^/containerLogs/(?P<ns>[^/]+)/(?P<pod>[^/]+)/(?P<container>[^/]+)$")
 _RUN_RE = re.compile(r"^/run/(?P<ns>[^/]+)/(?P<pod>[^/]+)/(?P<container>[^/]+)$")
+
+# ssh's own transport-failure complaints (client stderr). Exit 255 alone is
+# ambiguous — the remote command may legitimately exit 255 — so the exec
+# reaper only fires the remote kill when one of these accompanies it.
+_SSH_TRANSPORT_ERRS = (b"connection closed", b"connection reset",
+                       b"connection timed out", b"timed out",
+                       b"broken pipe", b"lost connection",
+                       b"ssh_exchange_identification",
+                       b"kex_exchange_identification",
+                       b"no route to host", b"network is unreachable",
+                       b"could not resolve hostname",
+                       b"ssh: connect to host", b"client_loop",
+                       b"administratively prohibited")
+
+
+def _ssh_transport_failed(stderr_tail: bytes) -> bool:
+    low = stderr_tail.lower()
+    return any(sig in low for sig in _SSH_TRANSPORT_ERRS)
+
+
+def _should_reap_remote(rc, stderr_tail: bytes) -> bool:
+    """Whether the exec session's REMOTE process needs the remote kill:
+    client abort (rc None — local ssh still running), local signal kill
+    (rc < 0), or an ssh transport failure (rc 255 + stderr complaint).
+    A remote command's own exit 255 (no transport complaint) is a normal
+    completion — TERMing its possibly-recycled pid would be worse than
+    leaving the pidfile for the next exec's prune sweep."""
+    return rc is None or rc < 0 or (rc == 255
+                                    and _ssh_transport_failed(stderr_tail))
 _EXEC_RE = re.compile(r"^/exec/(?P<ns>[^/]+)/(?P<pod>[^/]+)/(?P<container>[^/]+)$")
 
 
@@ -165,15 +194,33 @@ class _Handler(BaseHTTPRequestHandler):
             with wlock:
                 ws.send_channel(self.wfile, channel, data)
 
+        # last bytes of the transport's stderr: ssh exits 255 both for its
+        # OWN transport failures and for a remote command that exits 255 —
+        # only the former should trigger the remote reap, and ssh writes a
+        # recognizable complaint to stderr when it is the transport dying
+        err_tail = bytearray()
+
         def pump_stream(stream, channel: int):
             import os as _os
             fd = stream.fileno()
+            client_gone = False
             try:
                 while True:
                     data = _os.read(fd, 65536)
                     if not data:
                         break
-                    send(channel, data)
+                    if channel == ws.STDERR:
+                        err_tail.extend(data)
+                        del err_tail[:-512]
+                    if not client_gone:
+                        try:
+                            send(channel, data)
+                        except (OSError, ValueError):
+                            # client is gone; KEEP draining so ssh's final
+                            # stderr complaint still lands in err_tail (the
+                            # reap decision needs it) and the remote side
+                            # never blocks on a full pipe
+                            client_gone = True
             except (OSError, ValueError):
                 pass
 
@@ -237,15 +284,25 @@ class _Handler(BaseHTTPRequestHandler):
                 pass
             # Reap the REMOTE process unless it completed normally:
             # - poll() is None: client-driven abort (we kill local ssh next)
-            # - returncode == 255: ssh TRANSPORT error (network blip, sshd
-            #   died) — the remote process may have survived its client
+            # - returncode == 255 AND ssh's stderr shows a transport
+            #   complaint: network blip / sshd died — the remote process
+            #   may have survived its client. A remote command that itself
+            #   exits 255 is indistinguishable by code alone (r3 advisor),
+            #   so without the stderr signature we treat 255 as a normal
+            #   completion rather than TERM a possibly-recycled pid.
             # - returncode < 0: the local ssh was signal-killed
             # A normal remote completion (0..254) skips the reap: its pid
             # may already be recycled (TERM would hit an innocent process)
             # and the extra ssh round trip would tax every quick exec;
             # stale pidfiles are pruned by the next exec's launch wrapper.
             rc = proc.poll()
-            if rc is None or rc == 255 or rc < 0:
+            if rc is not None:
+                # ssh exited: its pipes are at/near EOF — give the pumps a
+                # bounded moment to drain the LAST stderr chunk into
+                # err_tail before the reap decision reads it
+                for t in pumps:
+                    t.join(timeout=2)
+            if _should_reap_remote(rc, bytes(err_tail)):
                 rk = getattr(proc, "remote_kill", None)
                 if rk is not None:
                     rk()

@@ -73,6 +73,12 @@ class RefResourceController:
                     if ev.type not in ("ADDED", "MODIFIED"):
                         continue
                     self._on_change(kind, ev.object)
+                # generator exhausted = the server's NORMAL periodic close
+                # (~5min, possibly with zero events on a quiet cluster):
+                # that is a healthy stream, so the escalated backoff from
+                # an earlier transient failure must not persist (r3
+                # advisor) — reconnect promptly
+                backoff = self.backoff_s
             except Exception as e:  # noqa: BLE001 — watch streams break; resume
                 status = getattr(e, "status", None)
                 if status == 410:

@@ -72,6 +72,10 @@ def _decode_secret(secret: dict, key: str) -> str:
     return secret.get("stringData", {}).get(key, "")
 
 
+def _secret_has_key(secret: dict, key: str) -> bool:
+    return key in secret.get("data", {}) or key in secret.get("stringData", {})
+
+
 def extract_env(kube: KubeClient, pod: dict) -> dict[str, str]:
     """Collect env from ALL containers: plain values, secretKeyRef /
     configMapKeyRef, envFrom secretRef / configMapRef, and secret volumes
@@ -134,6 +138,14 @@ def extract_env(kube: KubeClient, pod: dict) -> dict[str, str]:
                     if ref.get("optional") and ex.is_not_found:
                         continue
                     raise TranslationError(f"secret {ref['name']}: {ex}") from ex
+                # missing KEY in an existing secret fails the pod in real
+                # K8s (CreateContainerConfigError) unless optional — a
+                # typo'd key must not launch a billable slice w/ empty env
+                if not _secret_has_key(secret, ref["key"]):
+                    if ref.get("optional"):
+                        continue
+                    raise TranslationError(
+                        f"secret {ref['name']} has no key {ref['key']!r}")
                 env[name] = _decode_secret(secret, ref["key"])
             elif "configMapKeyRef" in src:
                 ref = src["configMapKeyRef"]
@@ -144,7 +156,12 @@ def extract_env(kube: KubeClient, pod: dict) -> dict[str, str]:
                         continue
                     raise TranslationError(
                         f"configmap {ref['name']}: {ex}") from ex
-                env[name] = cm.get("data", {}).get(ref["key"], "")
+                if ref["key"] not in cm.get("data", {}):
+                    if ref.get("optional"):
+                        continue
+                    raise TranslationError(
+                        f"configmap {ref['name']} has no key {ref['key']!r}")
+                env[name] = cm["data"][ref["key"]]
             elif "fieldRef" in src:
                 fp = src["fieldRef"].get("fieldPath", "")
                 if fp == "metadata.name":

@@ -165,6 +165,12 @@ class _Slot:
     # where a rescan would be O(context) Python per engine step
     bigram_index: dict = dataclasses.field(default_factory=dict)
     indexed_upto: int = 0
+    # stop_texts running tail: token ids whose decode is kept just long
+    # enough (in CHARS) to contain any new stop-string match — trimming by
+    # decoded length (not token count) survives zero-char specials and
+    # detokenizer first-token artifacts (r3 advisor finding)
+    stop_tail: list[int] = dataclasses.field(default_factory=list)
+    stop_tail_upto: int = 0
 
 
 def _fail_future(fut: Future, exc: BaseException) -> None:
@@ -953,6 +959,8 @@ class ServingEngine:
             slot.last_token = first
             slot.bigram_index = {}
             slot.indexed_upto = 0
+            slot.stop_tail = []
+            slot.stop_tail_upto = 0
             self._emit(slot, first)
             admitted = True
             self.metrics.incr("tpu_serving_admitted")
@@ -1173,12 +1181,25 @@ class ServingEngine:
         if slot.request.stop_texts:
             # BPE-exact: a stop string straddling a token boundary never
             # equals a generated token tail, but it IS in the decoded text.
-            # Decode only a TAIL window (any new match must end in the
-            # newest token, so max-stop-chars of lookback + slack covers
-            # it): keeps this host-side check O(stop_len) per step instead
-            # of O(generated²) per request in the shared engine loop.
-            max_chars = max(len(s) for s in slot.request.stop_texts)
-            text = self._decode_fn(gen[-(max_chars + 8):])
+            # Keep a running TAIL of token ids trimmed by DECODED length:
+            # the front is popped only while the rest still decodes to >=
+            # max-stop-chars + slack, so zero-char specials can't shrink
+            # the effective lookback below a stop's length, and the
+            # detokenizer's first-token artifact (sentencepiece space
+            # stripping) stays >= slack chars away from where any NEW
+            # match (which must end in the newest token) can sit. Cost
+            # stays O(stop_len) decode per step, not O(generated²)/request.
+            need = max(len(s) for s in slot.request.stop_texts) + 8
+            tail = slot.stop_tail
+            tail.extend(gen[slot.stop_tail_upto:])
+            slot.stop_tail_upto = len(gen)
+            while len(tail) > 1 and (
+                    len(tail) > 4 * need  # hard token cap: a degenerate
+                    # run of all-zero-char specials must not grow the tail
+                    # (and this decode) without bound in the shared loop
+                    or len(self._decode_fn(tail[1:])) >= need):
+                tail.pop(0)
+            text = self._decode_fn(tail)
             return any(s in text for s in slot.request.stop_texts)
         return False
 

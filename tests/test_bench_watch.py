@@ -147,6 +147,23 @@ class TestWatch:
         assert rc == 0
         assert calls.count("headline") == 5 and calls.count("econ") == 1
 
+    def test_fresh_survives_mid_queue_flap(self, results_dir, monkeypatch):
+        # --fresh with recent ok results on disk: a flap after the first
+        # step must NOT demote the rest of the queue to resume semantics —
+        # econ still reruns in the next window despite its recent ok record
+        os.makedirs(str(results_dir), exist_ok=True)
+        for n in ("headline", "econ"):
+            (results_dir / f"{n}.json").write_text(json.dumps(
+                {"name": n, "ok": True, "ts": _now_ts(),
+                 "lines": [{"metric": n}]}))
+        outcomes = iter([True, False, True])  # headline ok, econ fails once
+        rc, calls = self._run(
+            monkeypatch,
+            probes=[(True, ""), (False, "died"), (True, ""), (True, "")],
+            step_ok=lambda n: next(outcomes), queue=self.QUEUE,
+            argv=["--fresh"])
+        assert rc == 0 and calls == ["headline", "econ", "econ"]
+
     def test_deterministic_failure_gives_up_not_spins(self, results_dir,
                                                       monkeypatch):
         # econ fails every attempt while the tunnel stays healthy: the

@@ -123,6 +123,73 @@ class TestBpeStraddlingStops:
             e.stop()
 
 
+class TestStopTailBuffer:
+    """The running decoded-text tail (r3 advisor): the lookback window is
+    trimmed by DECODED CHARS, not token count, so zero-char specials can't
+    shrink it below a stop string's length, and it stays bounded."""
+
+    def _mk(self, decode_fn, stop_texts):
+        import types
+        from concurrent.futures import Future
+        from k8s_runpod_kubelet_tpu.workloads import serving as sv
+        slot = sv._Slot()
+        slot.request = types.SimpleNamespace(
+            future=Future(), stop=[], stop_texts=stop_texts)
+        slot.remaining = 10_000
+        slot.last_token = 1
+        fake = types.SimpleNamespace(
+            _decode_fn=decode_fn,
+            sc=types.SimpleNamespace(eos_token=-1))
+        fin = sv.ServingEngine._finished
+        return lambda: fin(fake, slot), slot
+
+    @staticmethod
+    def _decode(toks):
+        # ids < 26 are single chars; anything else is a zero-char special
+        return "".join(chr(97 + t) for t in toks if t < 26)
+
+    def test_zero_char_specials_do_not_blind_the_window(self):
+        # stop "abc": 'a','b' land, then 20 zero-char specials, then 'c'.
+        # A token-counted window would have evicted 'a' and 'b'; the
+        # char-counted tail must still match when 'c' arrives.
+        fin, slot = self._mk(self._decode, ["abc"])
+        toks = [0, 1] + [100] * 20 + [2]
+        fired_at = None
+        for i, t in enumerate(toks):
+            slot.generated.append(t)
+            if fin():
+                fired_at = i
+                break
+        assert fired_at == len(toks) - 1  # exactly when 'c' lands
+
+    def test_tail_stays_bounded_by_chars(self):
+        fin, slot = self._mk(self._decode, ["zz"])  # never matches a..y run
+        for i in range(500):
+            slot.generated.append(i % 25)  # 'a'..'y' cycle
+            assert not fin()
+        # need = len("zz") + 8 = 10 chars; every token is 1 char, so the
+        # tail must hover near 10 tokens, not grow with the generation
+        assert len(slot.stop_tail) <= 12
+
+    def test_degenerate_special_flood_stays_bounded(self):
+        # a model stuck emitting zero-char specials: the char-trim can
+        # never fire, so the hard token cap (4x need) must bound the tail
+        # (and the per-step decode cost) in the shared engine loop
+        fin, slot = self._mk(self._decode, ["abc"])  # need = 11, cap = 44
+        for _ in range(500):
+            slot.generated.append(100)
+            assert not fin()
+        assert len(slot.stop_tail) <= 44
+
+    def test_multi_token_and_late_match(self):
+        fin, slot = self._mk(self._decode, ["ddd"])
+        for t in [0, 1, 2, 3, 3]:
+            slot.generated.append(t)
+            assert not fin()
+        slot.generated.append(3)  # "...ddd" completes
+        assert fin()
+
+
 def _post(port, path, payload):
     req = urllib.request.Request(
         f"http://127.0.0.1:{port}{path}", json.dumps(payload).encode(),

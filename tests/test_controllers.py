@@ -135,6 +135,39 @@ class TestRefResourceController:
             rc.stop()
 
 
+    def test_quiet_stream_resets_backoff(self):
+        """A healthy-but-quiet stream (the server's normal ~5min close with
+        zero events) must reset an escalated backoff — r3 advisor: only
+        events reset it, so one transient failure left a quiet watch
+        reconnecting at up to 60s forever."""
+        import types
+        from k8s_runpod_kubelet_tpu.node import RefResourceController
+
+        class StubKube:
+            def __init__(self):
+                self.n = 0
+
+            def watch_objects(self, kind, stop=None, resource_version=None):
+                self.n += 1
+                if self.n == 1:
+                    raise RuntimeError("transient blip")
+                if self.n >= 3:
+                    stop.set()
+                return iter(())  # healthy stream, no events
+
+        provider = types.SimpleNamespace(
+            cfg=types.SimpleNamespace(pending_retry_interval_s=30.0),
+            has_pending_reference=lambda *a: False,
+            process_pending_pods=lambda: None)
+        rc = RefResourceController(StubKube(), provider, kinds=("secrets",),
+                                   backoff_s=1.0, max_backoff_s=60.0)
+        waits = []
+        rc._stop.wait = lambda t=None: waits.append(t)  # type: ignore
+        rc._watch_loop("secrets")
+        assert waits[0] == 2.0   # escalated after the transient failure
+        assert waits[1] == 1.0   # quiet NORMAL close resets to base
+
+
 class TestPodControllerE2E:
     def test_full_lifecycle_through_watch(self, h):
         pc = PodController(h.kube, h.provider, "virtual-tpu", resync_interval_s=3600)

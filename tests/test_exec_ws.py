@@ -260,3 +260,32 @@ class TestExecChannelFixes:
                 srv.stop()
         finally:
             h.close()
+
+
+class TestRemoteReapDecision:
+    """Exit 255 is ambiguous (r3 advisor): ssh's OWN transport failures
+    exit 255, but so can the remote command itself. Only the former —
+    identified by ssh's stderr complaint — may fire the remote kill."""
+
+    def test_transport_failure_255_reaps(self):
+        from k8s_runpod_kubelet_tpu.node.api_server import _should_reap_remote
+        for msg in (b"client_loop: send disconnect: Broken pipe",
+                    b"Connection reset by 10.0.0.1 port 22",
+                    b"ssh: connect to host 10.0.0.1 port 22: "
+                    b"Connection timed out",
+                    b"kex_exchange_identification: read: "
+                    b"Connection reset by peer"):
+            assert _should_reap_remote(255, msg), msg
+
+    def test_remote_commands_own_255_is_normal_completion(self):
+        from k8s_runpod_kubelet_tpu.node.api_server import _should_reap_remote
+        # remote tool printed its own diagnostics and exited 255: no reap
+        assert not _should_reap_remote(255, b"fatal: retry budget exhausted")
+        assert not _should_reap_remote(255, b"")
+
+    def test_abort_and_signal_kill_always_reap(self):
+        from k8s_runpod_kubelet_tpu.node.api_server import _should_reap_remote
+        assert _should_reap_remote(None, b"")     # client abort, ssh alive
+        assert _should_reap_remote(-15, b"")      # local ssh TERMed
+        assert not _should_reap_remote(0, b"")    # clean exit
+        assert not _should_reap_remote(1, b"")    # normal failure

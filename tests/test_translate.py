@@ -140,6 +140,26 @@ class TestEnvExtraction:
                                           "optional": True}}]}])
         assert extract_env(kube, pod2) == {}
 
+    def test_missing_key_in_existing_object_raises_unless_optional(self, kube):
+        """The object EXISTS but the key is typo'd: real K8s fails the pod
+        (CreateContainerConfigError) unless optional — silently injecting
+        an empty string would launch a billable slice with wrong env
+        (r3 advisor finding)."""
+        kube.add_secret("default", "creds", {"GOOD": "v"})
+        kube.add_config_map("default", "settings", {"GOOD": "w"})
+        for src in ({"secretKeyRef": {"name": "creds", "key": "TYPO"}},
+                    {"configMapKeyRef": {"name": "settings", "key": "TYPO"}}):
+            pod = make_pod(containers=[{
+                "name": "m", "image": "img",
+                "env": [{"name": "K", "valueFrom": dict(src)}]}])
+            with pytest.raises(TranslationError, match="no key 'TYPO'"):
+                extract_env(kube, pod)
+            next(iter(src.values()))["optional"] = True
+            pod = make_pod(containers=[{
+                "name": "m", "image": "img",
+                "env": [{"name": "K", "valueFrom": dict(src)}]}])
+            assert extract_env(kube, pod) == {}  # optional: var dropped
+
     def test_optional_swallows_only_404(self, kube):
         """`optional: true` covers a MISSING object (404) — a transient
         API failure must still fail translation (retry with full env),
