@@ -89,6 +89,7 @@ _STAGED_QUEUE = [
      2400),
     ("econ", ["--econ"], 2400),
     ("ring_flash", ["--ring-flash"], 1800),
+    ("spec_drift", ["--spec-drift"], 2400),
     ("attn", ["--attn"], 2400),  # 32k last inside; sacrificial process
 ]
 
@@ -511,6 +512,77 @@ def serve_once(model: str, *, slots: int, n_req: int, new_toks: int,
     return rec
 
 
+def run_spec_drift() -> int:
+    """bf16 speculative greedy drift, measured (r3 VERDICT item 8).
+
+    Greedy speculative decoding is PROVEN token-exact in f32; at bf16,
+    K-wide verify and 1-wide decode reduce in different shapes, so logit
+    near-ties can tie-break differently (documented as inherent in
+    ROUND3_NOTES). This puts an error bar on it: same params, same greedy
+    prompts, speculate_k=3 vs 0, token-level divergence rate over a
+    corpus. Runs on CPU too (same-reduction-shape question exists there),
+    but the deployment claim needs the chip's bf16 units — the watcher
+    queues it for TPU."""
+    _force_platform_from_env()
+    import jax
+    from k8s_runpod_kubelet_tpu.workloads.serving import (ServingConfig,
+                                                          ServingEngine)
+
+    on_tpu = jax.default_backend() == "tpu"
+    model = _arg_value("--model", "bench-260m" if on_tpu else "tiny")
+    cfg = _serve_model(model)
+    params = _serve_params(cfg, int8=False)
+    n_req, new_toks, prompt_len = (48, 64, 64) if on_tpu else (12, 16, 16)
+    cache_len = 2048 if on_tpu else 128
+
+    def run_greedy(spec_k: int) -> list[list[int]]:
+        sc = ServingConfig(slots=8 if on_tpu else 4,
+                           max_prefill_len=min(cache_len // 2, 512),
+                           cache_len=cache_len, max_new_tokens=new_toks,
+                           speculate_k=spec_k)
+        engine = ServingEngine(cfg, params, sc).start()
+        try:
+            futs = []
+            for i in range(n_req):
+                # distinct prompt PER REQUEST (a corpus, not one prompt
+                # measured n times); repeated halves so prompt-lookup
+                # drafting actually fires
+                base = [((i * 131 + j * 7) % 97) + 1
+                        for j in range(prompt_len // 2)]
+                futs.append(engine.submit(base + base, temperature=0.0,
+                                          max_new_tokens=new_toks))
+            return [f.result(timeout=1800)["tokens"] for f in futs]
+        finally:
+            engine.stop()
+
+    plain = run_greedy(0)
+    spec = run_greedy(3)
+    diverged = 0
+    first_div_pos = []
+    tok_total = tok_same = 0
+    for a, b in zip(plain, spec):
+        n = min(len(a), len(b))
+        tok_total += n
+        same = next((i for i in range(n) if a[i] != b[i]), None)
+        if same is None and len(a) == len(b):
+            tok_same += n
+            continue
+        diverged += 1
+        pos = same if same is not None else n
+        first_div_pos.append(pos)
+        tok_same += pos
+    _emit({"metric": "spec_bf16_drift",
+           "value": round(diverged / n_req, 4),
+           "unit": "diverged_request_rate",
+           "token_match_rate": round(tok_same / max(tok_total, 1), 4),
+           "requests": n_req, "new_tokens": new_toks,
+           "first_divergence_positions": sorted(first_div_pos)[:10],
+           "dtype": str(cfg.dtype.__name__ if hasattr(cfg.dtype, "__name__")
+                        else cfg.dtype),
+           "backend": jax.default_backend(), "model": cfg.name})
+    return 0
+
+
 def run_serve_bench(quick: bool) -> int:
     """Serving throughput/latency under concurrent load (VERDICT r1 item 8):
     continuous batching with the prefill thread; reports tokens/sec, p50/p99
@@ -679,13 +751,22 @@ def run_mfu_sweep() -> int:
                           remat_policy="dots")
 
     base = _bench_config(tiny=False)
+    # Grid AOT-prevalidated against the v5e memory model (tools/aot_check.py,
+    # bench_results/aot_v5e.json): remat "none" OOMs at any batch (24GB at
+    # B=8), 530m "dots" OOMs at B=8 (18.9GB), and dots_b12 compiles but
+    # peaks at an estimated 21GB — XLA's buffer assignment for the v5e
+    # target, so they'd OOM on the chip too. What fits: dots_b8 (15.6GB),
+    # full_b16 (12.6GB; "full" recomputes activations, buying batch — its
+    # XLA roofline bound is 20% above dots_b8's), 530m_full_b8 (14.4GB).
+    # full_b20 interpolates toward full_b32's refusal point (18.2GB).
     points = [
         ("260m_dots_b8", base, 8),                       # r2 best: MFU .318
-        ("260m_none_b8", dataclasses.replace(base, remat_policy="none"), 8),
-        ("260m_none_b12", dataclasses.replace(base, remat_policy="none"), 12),
-        ("530m_dots_b8", wider_530m(), 8),
-        ("530m_none_b8",
-         dataclasses.replace(wider_530m(), remat_policy="none"), 8),
+        ("260m_full_b16",
+         dataclasses.replace(base, remat_policy="full"), 16),
+        ("260m_full_b20",
+         dataclasses.replace(base, remat_policy="full"), 20),
+        ("530m_full_b8",
+         dataclasses.replace(wider_530m(), remat_policy="full"), 8),
     ]
     results = []
     for label, cfg, batch in points:
@@ -1033,6 +1114,8 @@ def main() -> int:
         return run_attn_tune()
     if "--ring-flash" in sys.argv:
         return run_ring_flash_check()
+    if "--spec-drift" in sys.argv:
+        return run_spec_drift()
     if "--watch" in sys.argv:
         return run_watch()
     if "--serve" in sys.argv:

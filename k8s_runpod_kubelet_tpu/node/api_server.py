@@ -49,15 +49,24 @@ _RUN_RE = re.compile(r"^/run/(?P<ns>[^/]+)/(?P<pod>[^/]+)/(?P<container>[^/]+)$"
 # ssh's own transport-failure complaints (client stderr). Exit 255 alone is
 # ambiguous — the remote command may legitimately exit 255 — so the exec
 # reaper only fires the remote kill when one of these accompanies it.
-_SSH_TRANSPORT_ERRS = (b"connection closed", b"connection reset",
-                       b"connection timed out", b"timed out",
-                       b"broken pipe", b"lost connection",
+# Signatures are anchored to ssh's OWN message forms (client_loop:, kex_/
+# ssh_exchange_, "ssh: connect to host", "Connection to X closed by remote
+# host", "Timeout, server X not responding"); generic fragments like bare
+# "timed out"/"connection reset"/"broken pipe" are deliberately absent —
+# the remote command shares the stderr pipe, and e.g. a NESTED ssh failing
+# inside the container would otherwise false-positive the reap against a
+# possibly-recycled pid. (That nested-ssh case still matches the anchored
+# forms — perfect disambiguation is impossible on a shared pipe; the
+# anchored set trades a rare leaked remote process, pruned by the next
+# exec's pidfile sweep, against TERMing innocent pids on common tool
+# output.)
+_SSH_TRANSPORT_ERRS = (b"client_loop:",
                        b"ssh_exchange_identification",
                        b"kex_exchange_identification",
-                       b"no route to host", b"network is unreachable",
-                       b"could not resolve hostname",
-                       b"ssh: connect to host", b"client_loop",
-                       b"administratively prohibited")
+                       b"ssh: connect to host",
+                       b"closed by remote host",
+                       b"timeout, server",
+                       b"ssh: could not resolve hostname")
 
 
 def _ssh_transport_failed(stderr_tail: bytes) -> bool:

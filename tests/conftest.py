@@ -13,6 +13,18 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Persistent compilation cache (r3 VERDICT item 7: full suite hit ~30 min on
+# one core): compiled executables are reused across test modules AND suite
+# runs, so the per-module jax.clear_caches() below (the ORC-JIT segfault
+# fence) costs a disk hit instead of a recompile. Measured: test_moe.py
+# 116s cold -> 42s warm. Safe to delete the dir anytime.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_compile_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.1")
+
 # The axon image registers its TPU platform from sitecustomize.py at interpreter
 # start, before any conftest runs — the env var alone is too late. The config
 # update works as long as no backend has been initialized yet. jax stays an

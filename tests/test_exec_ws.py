@@ -270,9 +270,10 @@ class TestRemoteReapDecision:
     def test_transport_failure_255_reaps(self):
         from k8s_runpod_kubelet_tpu.node.api_server import _should_reap_remote
         for msg in (b"client_loop: send disconnect: Broken pipe",
-                    b"Connection reset by 10.0.0.1 port 22",
+                    b"Connection to 10.0.0.1 closed by remote host.",
                     b"ssh: connect to host 10.0.0.1 port 22: "
                     b"Connection timed out",
+                    b"Timeout, server 10.0.0.1 not responding",
                     b"kex_exchange_identification: read: "
                     b"Connection reset by peer"):
             assert _should_reap_remote(255, msg), msg
@@ -282,6 +283,13 @@ class TestRemoteReapDecision:
         # remote tool printed its own diagnostics and exited 255: no reap
         assert not _should_reap_remote(255, b"fatal: retry budget exhausted")
         assert not _should_reap_remote(255, b"")
+        # generic fragments shared with common tool output are deliberately
+        # NOT signatures (a nested tool timing out must not TERM a recycled
+        # pid); ssh's unprefixed mid-session reset line rides this tradeoff
+        assert not _should_reap_remote(255, b"curl: (28) Connection timed "
+                                            b"out after 5000 ms")
+        assert not _should_reap_remote(255,
+                                       b"Connection reset by 10.0.0.1 port 22")
 
     def test_abort_and_signal_kill_always_reap(self):
         from k8s_runpod_kubelet_tpu.node.api_server import _should_reap_remote

@@ -1,0 +1,117 @@
+"""HTTP-layer backpressure (r3 VERDICT weak item 7): the serving front end
+bounds in-flight connections; overload gets an immediate 503 + Retry-After
+on the raw socket instead of an unbounded thread pile-up.
+
+Uses a stub engine (serve_main has no jax at module level) — this is pure
+socket/threading behavior, fast tier."""
+
+import http.client
+import json
+import socket
+import time
+import types
+
+from k8s_runpod_kubelet_tpu.workloads.serve_main import serve
+
+
+class _Metrics:
+    def __init__(self):
+        self.counts = {}
+
+    def incr(self, name, n=1):
+        self.counts[name] = self.counts.get(name, 0) + n
+
+    def render(self):
+        return "".join(f"{k}_total {v}\n" for k, v in self.counts.items())
+
+
+def _stub_engine():
+    return types.SimpleNamespace(metrics=_Metrics(), alive=True)
+
+
+def _hold(port):
+    """A connection whose handler thread blocks mid-request (slowloris)."""
+    s = socket.create_connection(("127.0.0.1", port))
+    s.sendall(b"POST /generate HTTP/1.1\r\n")  # never finishes the request
+    return s
+
+
+class TestHttpBackpressure:
+    def test_overflow_rejected_with_503(self):
+        eng = _stub_engine()
+        httpd = serve(eng, 0, max_connections=2)
+        port = httpd.server_address[1]
+        holders = []
+        try:
+            holders = [_hold(port), _hold(port)]
+            time.sleep(0.3)  # both accepted; slots full
+            c = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+            c.request("POST", "/generate", body=json.dumps({"tokens": [1]}),
+                      headers={"Content-Type": "application/json"})
+            resp = c.getresponse()
+            assert resp.status == 503
+            assert resp.getheader("Retry-After") == "1"
+            assert "overloaded" in json.loads(resp.read())["error"]
+            c.close()
+            assert eng.metrics.counts["tpu_serving_http_rejected"] >= 1
+        finally:
+            for s in holders:
+                s.close()
+            httpd.shutdown()
+
+    def test_observability_survives_overload(self):
+        # the scrape that should SEE the overload must not be shed by it:
+        # /metrics and /healthz ride the reserved pool when the main pool
+        # is full of slowloris holds
+        eng = _stub_engine()
+        httpd = serve(eng, 0, max_connections=1)
+        port = httpd.server_address[1]
+        holders = []
+        try:
+            holders = [_hold(port)]
+            time.sleep(0.3)
+            c = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+            c.request("GET", "/healthz")
+            assert c.getresponse().status == 200
+            c.close()
+            # generate load is still shed while observability is served
+            c2 = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+            c2.request("POST", "/generate", body="{}")
+            assert c2.getresponse().status == 503
+            c2.close()
+            c3 = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+            c3.request("GET", "/metrics")
+            r3 = c3.getresponse()
+            assert r3.status == 200
+            assert "tpu_serving_http_rejected_total 1" in r3.read().decode()
+            c3.close()
+        finally:
+            for s in holders:
+                s.close()
+            httpd.shutdown()
+
+    def test_slot_release_restores_service(self):
+        eng = _stub_engine()
+        httpd = serve(eng, 0, max_connections=1)
+        port = httpd.server_address[1]
+        try:
+            h = _hold(port)
+            time.sleep(0.3)
+            # full: next connection is rejected outright
+            probe = socket.create_connection(("127.0.0.1", port))
+            probe.settimeout(3)
+            assert b"503" in probe.recv(64)
+            probe.close()
+            # handler finishes (client vanished) -> slot released
+            h.close()
+            time.sleep(0.3)
+            fresh = _hold(port)
+            fresh.settimeout(0.4)
+            try:
+                data = fresh.recv(64)  # no 503: server is waiting on us
+            except socket.timeout:
+                data = b""
+            assert b"503" not in data
+            fresh.close()
+        finally:
+            httpd.shutdown()

@@ -1,0 +1,344 @@
+"""Offline v5e compile evidence (r3 VERDICT item 6).
+
+With the TPU tunnel flapping across whole build sessions, this produces
+machine-generated evidence that the perf-critical programs COMPILE for real
+v5e hardware and what XLA's own cost model says about them — no chip needed:
+JAX AOT compilation against a device-less `TopologyDescription`
+(`jax.experimental.topologies`) runs the full XLA:TPU pipeline (including
+Mosaic for Pallas kernels) and exposes `cost_analysis()` (flops / bytes
+accessed) and `memory_analysis()` (argument/temp HBM) per compiled program.
+
+Not a substitute for measurement: the cost model's `optimal_seconds` is
+unreliable from a CPU client, so we derive roofline bounds ourselves from
+public v5e specs (197 bf16 TFLOP/s, 819 GB/s HBM) and label them as bounds.
+
+Programs covered (the round's headline benches):
+  - 260M train step, remat dots vs none, batch 8/12 (the --mfu-sweep grid)
+  - 530M train step (sweep point)
+  - llama3-8b int8 decode + prefill steps (the --serve 8B geometry)
+  - flash-attention fwd+bwd Pallas kernel at S=2048 (training geometry)
+  - ring flash attention over a seq=4 mesh on a v5e:2x2 topology
+
+Writes one JSON record per program to bench_results/aot_v5e.json and prints
+a summary line each. Usage: python tools/aot_check.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _HERE)
+
+# v5e public spec-sheet numbers (same source as bench.py's _PEAK_TFLOPS)
+_V5E_BF16_FLOPS = 197e12
+_V5E_HBM_BYTES_S = 819e9
+_V5E_HBM_BYTES = 16 * 1024**3
+
+
+def _force_cpu():
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass
+
+
+def _topo(name: str, **kw):
+    from jax.experimental import topologies
+    return topologies.get_topology_desc(topology_name=name, platform="tpu",
+                                        **kw)
+
+
+def _sds_tree(tree, sharding):
+    import jax
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding),
+        tree)
+
+
+def _analyze(compiled, *, tokens_per_step=None, model_flops_per_tok=None):
+    """Cost + memory analysis -> derived v5e roofline bounds."""
+    ca = compiled.cost_analysis() or {}
+    ma = compiled.memory_analysis()
+    flops = float(ca.get("flops", 0.0))
+    byts = float(ca.get("bytes accessed", 0.0))
+    t_compute = flops / _V5E_BF16_FLOPS
+    t_hbm = byts / _V5E_HBM_BYTES_S
+    bound = "compute" if t_compute >= t_hbm else "hbm"
+    rec = {
+        "xla_flops": flops,
+        "xla_bytes_accessed": byts,
+        "arithmetic_intensity": round(flops / byts, 2) if byts else None,
+        "roofline_s_compute": round(t_compute, 6),
+        "roofline_s_hbm": round(t_hbm, 6),
+        "roofline_bound": bound,
+        "hbm_argument_bytes": ma.argument_size_in_bytes,
+        "hbm_temp_bytes": ma.temp_size_in_bytes,
+        "hbm_alias_bytes": ma.alias_size_in_bytes,
+        "hbm_peak_est_bytes": (ma.argument_size_in_bytes
+                               + ma.temp_size_in_bytes
+                               + ma.output_size_in_bytes
+                               - ma.alias_size_in_bytes),
+        "fits_16gb": (ma.argument_size_in_bytes + ma.temp_size_in_bytes
+                      + ma.output_size_in_bytes - ma.alias_size_in_bytes)
+        < _V5E_HBM_BYTES,
+    }
+    if tokens_per_step:
+        t_bound = max(t_compute, t_hbm)
+        rec["tokens_per_step"] = tokens_per_step
+        rec["roofline_tok_s_bound"] = round(tokens_per_step / t_bound, 1)
+        if model_flops_per_tok:
+            # MFU ceiling IF the program ran exactly at the XLA cost-model
+            # roofline (real kernels won't; this bounds the sweep, it does
+            # not predict it)
+            rec["roofline_mfu_bound"] = round(
+                model_flops_per_tok * rec["roofline_tok_s_bound"]
+                / _V5E_BF16_FLOPS, 3)
+    return rec
+
+
+def _train_step_program(cfg, batch: int, dev):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import SingleDeviceSharding
+
+    from k8s_runpod_kubelet_tpu.models import LlamaModel, init_params
+    from k8s_runpod_kubelet_tpu.workloads.train import (TrainConfig,
+                                                        make_optimizer,
+                                                        make_train_step)
+    tc = TrainConfig(batch_size=batch, seq_len=2048, steps=1)
+    model = LlamaModel(cfg)
+    opt = make_optimizer(tc)
+    params_abs = jax.eval_shape(lambda k: init_params(cfg, k),
+                                jax.random.PRNGKey(0))
+    opt_abs = jax.eval_shape(opt.init, params_abs)
+    s = SingleDeviceSharding(dev)
+    step = make_train_step(model, opt)
+    batch_abs = jax.ShapeDtypeStruct((batch, tc.seq_len + 1), jnp.int32,
+                                     sharding=s)
+    return step.lower(_sds_tree(params_abs, s), _sds_tree(opt_abs, s),
+                      batch_abs)
+
+
+def check_train(results, dev):
+    import dataclasses
+    from __graft_entry__ import _bench_config
+    from k8s_runpod_kubelet_tpu.models import tiny_llama
+
+    def wider_530m():
+        return tiny_llama(name="llama-bench-530m", vocab_size=32768,
+                          embed_dim=1536, n_layers=12, n_heads=16,
+                          n_kv_heads=8, mlp_dim=6144, max_seq_len=2048,
+                          remat_policy="dots")
+
+    base = _bench_config(tiny=False)
+    # First AOT pass falsified the staged sweep grid: remat "none" OOMs at
+    # B=8 (24GB) and 530m "dots" OOMs at B=8 (18.9GB) — XLA's buffer
+    # assignment for the v5e target, so they would OOM on the chip too.
+    # This grid probes what DOES fit: "full" remat (recompute everything,
+    # lowest activation memory) buys batch, "dots" at the edge.
+    grid = [
+        ("train_260m_dots_b8", base, 8),
+        ("train_260m_none_b8",
+         dataclasses.replace(base, remat_policy="none"), 8),
+        ("train_260m_none_b12",
+         dataclasses.replace(base, remat_policy="none"), 12),
+        ("train_260m_dots_b12", base, 12),
+        ("train_260m_full_b16",
+         dataclasses.replace(base, remat_policy="full"), 16),
+        ("train_260m_full_b32",
+         dataclasses.replace(base, remat_policy="full"), 32),
+        ("train_530m_dots_b8", wider_530m(), 8),
+        ("train_530m_none_b8",
+         dataclasses.replace(wider_530m(), remat_policy="none"), 8),
+        ("train_530m_full_b8",
+         dataclasses.replace(wider_530m(), remat_policy="full"), 8),
+        ("train_530m_full_b16",
+         dataclasses.replace(wider_530m(), remat_policy="full"), 16),
+    ]
+    for name, cfg, b in grid:
+        results[name] = _run(name, lambda cfg=cfg, b=b: _analyze(
+            _train_step_program(cfg, b, dev).compile(),
+            tokens_per_step=b * 2048,
+            model_flops_per_tok=6.0 * cfg.param_count))
+
+
+def check_serving_8b(results, dev):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import SingleDeviceSharding
+
+    from k8s_runpod_kubelet_tpu.models import LlamaModel, init_params, llama3_8b
+    from k8s_runpod_kubelet_tpu.models.quant import quantize_params
+
+    cfg = llama3_8b()
+    model = LlamaModel(cfg)
+    slots, cache_len, prefill_len = 8, 2048, 512  # run_serve_bench 8B geometry
+    s = SingleDeviceSharding(dev)
+    try:
+        params_abs = jax.eval_shape(lambda k: init_params(cfg, k),
+                                    jax.random.PRNGKey(0))
+        # quantize_params is host-side numpy (not traceable): run it over a
+        # zeros host tree (copy-on-write pages, same trick as bench
+        # _serve_params) and keep only the SHAPES
+        host = jax.tree_util.tree_map(
+            lambda sd: np.zeros(sd.shape, sd.dtype), params_abs)
+        q_real = quantize_params(cfg, host)
+        q_abs = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), q_real)
+        del q_real, host
+        cache_abs = jax.eval_shape(
+            lambda: model.init_cache(slots, cache_len, quantize=True))
+    except Exception as e:  # noqa: BLE001 — record both programs as failed
+        err = {"compile_ok": False, "compile_wall_s": 0.0,
+               "error": f"setup: {type(e).__name__}: {e}"[:500]}
+        results["decode_8b_int8_kv8"] = dict(err)
+        results["prefill_8b_int8"] = dict(err)
+        print(f"[aot] serving_8b setup FAILED: {err['error'][:120]}",
+              flush=True)
+        return
+
+    def decode(params, token, cache, active):
+        return model.decode_step(params, token, cache, active)
+
+    def prog_decode():
+        lowered = jax.jit(decode, donate_argnums=(2,)).lower(
+            _sds_tree(q_abs, s),
+            jax.ShapeDtypeStruct((slots,), jnp.int32, sharding=s),
+            _sds_tree(cache_abs, s),
+            jax.ShapeDtypeStruct((slots,), bool, sharding=s))
+        rec = _analyze(lowered.compile(), tokens_per_step=slots)
+        rec["note"] = (f"int8 weights + int8 KV, {slots} slots, "
+                       f"cache_len {cache_len}")
+        return rec
+
+    def prog_prefill():
+        prefill_cache_abs = jax.eval_shape(
+            lambda: model.init_cache(1, cache_len, quantize=True))
+        lowered = jax.jit(model.prefill).lower(
+            _sds_tree(q_abs, s),
+            jax.ShapeDtypeStruct((1, prefill_len), jnp.int32, sharding=s),
+            _sds_tree(prefill_cache_abs, s))
+        return _analyze(lowered.compile(), tokens_per_step=prefill_len)
+
+    results["decode_8b_int8_kv8"] = _run("decode_8b_int8_kv8", prog_decode)
+    results["prefill_8b_int8"] = _run("prefill_8b_int8", prog_prefill)
+
+
+def check_flash_attention(results, dev):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import SingleDeviceSharding
+    from k8s_runpod_kubelet_tpu.ops.attention import flash_attention
+
+    s = SingleDeviceSharding(dev)
+    b, hq, hkv, d, sl = 8, 16, 8, 64, 2048  # the TRAINING geometry
+
+    def fwd_bwd(q, k, v):
+        def f(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, causal=True, use_pallas=True))
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    def prog():
+        args = [jax.ShapeDtypeStruct((b, h, sl, d), jnp.bfloat16, sharding=s)
+                for h in (hq, hkv, hkv)]
+        lowered = jax.jit(fwd_bwd).lower(*args)
+        rec = _analyze(lowered.compile())
+        rec["note"] = "Pallas kernels compiled by Mosaic for v5e (AOT)"
+        return rec
+
+    results["flash_attn_s2048_fwd_bwd"] = _run("flash_attn_s2048_fwd_bwd",
+                                               prog)
+
+
+def check_ring_flash(results):
+    import importlib
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    ra = importlib.import_module("k8s_runpod_kubelet_tpu.ops.ring_attention")
+
+    def prog():
+        topo = _topo("v5e:2x2")
+        devs = np.array(topo.devices).reshape(1, 4)
+        mesh = Mesh(devs, ("data", "seq"))
+        b, hq, hkv, d, sl = 1, 8, 4, 128, 4096  # S_local=1024, blockable
+
+        def f(q, k, v):
+            return ra.ring_attention(q, k, v, mesh, causal=True,
+                                     use_flash=True)
+
+        spec = NamedSharding(mesh, P(None, None, "seq", None))
+        args = [jax.ShapeDtypeStruct((b, h, sl, d), jnp.bfloat16,
+                                     sharding=spec)
+                for h in (hq, hkv, hkv)]
+        lowered = jax.jit(f).lower(*args)
+        rec = _analyze(lowered.compile())
+        rec["note"] = ("ring flash fwd over seq=4 mesh on v5e:2x2 — Pallas "
+                       "chunk kernels + ppermute collectives AOT-compiled")
+        return rec
+
+    results["ring_flash_sp4_fwd"] = _run("ring_flash_sp4_fwd", prog)
+
+
+def _run(name, fn):
+    t0 = time.time()
+    try:
+        rec = fn()
+        rec["compile_ok"] = True
+    except Exception as e:  # noqa: BLE001 — record, keep going
+        rec = {"compile_ok": False,
+               "error": f"{type(e).__name__}: {e}"[:500]}
+    rec["compile_wall_s"] = round(time.time() - t0, 1)
+    print(f"[aot] {name}: "
+          + (f"ok bound={rec.get('roofline_bound')} "
+             f"fits16gb={rec.get('fits_16gb')} "
+             f"tok/s<= {rec.get('roofline_tok_s_bound')}"
+             if rec["compile_ok"] else f"FAILED {rec['error'][:120]}"),
+          flush=True)
+    return rec
+
+
+def main() -> int:
+    _force_cpu()
+    import jax  # noqa: F401 — initialize before topologies
+
+    results: dict[str, dict] = {}
+    topo1 = _topo("v5e:1x1", chips_per_host_bounds=(1, 1, 1))
+    dev = topo1.devices[0]
+    check_train(results, dev)
+    check_serving_8b(results, dev)
+    check_flash_attention(results, dev)
+    check_ring_flash(results)
+
+    out = {
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "jax": jax.__version__,
+        "target": "v5e (device-less TopologyDescription AOT)",
+        "v5e_specs": {"bf16_flops": _V5E_BF16_FLOPS,
+                      "hbm_bytes_s": _V5E_HBM_BYTES_S,
+                      "hbm_bytes": _V5E_HBM_BYTES},
+        "programs": results,
+    }
+    os.makedirs(os.path.join(_HERE, "bench_results"), exist_ok=True)
+    path = os.path.join(_HERE, "bench_results", "aot_v5e.json")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    print(f"[aot] wrote {path}")
+    ok = sum(1 for r in results.values() if r.get("compile_ok"))
+    print(f"[aot] {ok}/{len(results)} programs compiled for v5e")
+    return 0 if ok == len(results) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
