@@ -732,8 +732,7 @@ def run_mfu_sweep() -> int:
     _force_platform_from_env()
     import dataclasses
     import jax
-    from __graft_entry__ import _bench_config
-    from k8s_runpod_kubelet_tpu.models import tiny_llama
+    from __graft_entry__ import _bench_config, _bench_config_530m
     from k8s_runpod_kubelet_tpu.workloads.train import (TrainConfig, Trainer,
                                                         synthetic_batches)
 
@@ -743,12 +742,7 @@ def run_mfu_sweep() -> int:
         return 1
     gen = detect_generation()
     peak = _PEAK_TFLOPS[gen]
-
-    def wider_530m():
-        return tiny_llama(name="llama-bench-530m", vocab_size=32768,
-                          embed_dim=1536, n_layers=12, n_heads=16,
-                          n_kv_heads=8, mlp_dim=6144, max_seq_len=2048,
-                          remat_policy="dots")
+    wider_530m = _bench_config_530m
 
     base = _bench_config(tiny=False)
     # Grid AOT-prevalidated against the v5e memory model (tools/aot_check.py,

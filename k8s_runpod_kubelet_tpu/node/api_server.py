@@ -65,6 +65,7 @@ _SSH_TRANSPORT_ERRS = (b"client_loop:",
                        b"kex_exchange_identification",
                        b"ssh: connect to host",
                        b"closed by remote host",
+                       b"connection closed by ",  # ssh's kex/auth-time form
                        b"timeout, server",
                        b"ssh: could not resolve hostname")
 

@@ -35,6 +35,7 @@ import argparse
 import json
 import logging
 import threading
+import time
 from concurrent.futures import TimeoutError as FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -66,10 +67,19 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        if self.close_connection:  # tell the client, don't just hang up
+            self.send_header("Connection", "close")
         self.end_headers()
         self.wfile.write(body)
 
     def do_GET(self):
+        if self.path in ("/healthz", "/metrics"):
+            # one response per connection on the observability routes: a
+            # connection admitted through the overload RESERVE by peeking
+            # "GET /healthz" must not keep-alive its way into POST
+            # /generate on the reserved slot (the reserve sheds engine
+            # work by contract); scrapes reconnect cheaply
+            self.close_connection = True
         if self.path == "/healthz":
             if not self.engine.alive:
                 return self._send(503, b"engine thread dead", "text/plain")
@@ -642,42 +652,55 @@ class BoundedThreadingHTTPServer(ThreadingHTTPServer):
                 pass
 
     def process_request(self, request, client_address):
-        sem = None
         if self._conn_sem.acquire(blocking=False):
-            sem = self._conn_sem
-        elif (self._is_observability(request)
-              and self._obs_sem.acquire(blocking=False)):
-            sem = self._obs_sem
-        if sem is None:
+            self._req_sem[id(request)] = self._conn_sem
             try:
-                engine = getattr(self.RequestHandlerClass, "engine", None)
-                if engine is not None:
-                    engine.metrics.incr("tpu_serving_http_rejected")
-            except Exception:  # noqa: BLE001 — metrics must never block 503
-                pass
+                super().process_request(request, client_address)
+            except BaseException:  # thread spawn failed: slot must not leak
+                self._req_sem.pop(id(request), None)
+                self._conn_sem.release()
+                raise
+            return
+        # Overload: triage OFF the accept thread — the peek and the reject
+        # drain both wait on the peer, and one slow peer must never stall
+        # serve_forever's accept loop (that would shed /metrics too, the
+        # exact failure the reserve exists to prevent). Triage threads are
+        # short-lived (~1s bounded) and only exist while overloaded.
+        threading.Thread(target=self._triage_overflow,
+                         args=(request, client_address), daemon=True).start()
+
+    def _triage_overflow(self, request, client_address):
+        if (self._is_observability(request)
+                and self._obs_sem.acquire(blocking=False)):
+            self._req_sem[id(request)] = self._obs_sem
+            # already on a dedicated thread: run the handler directly
+            self.process_request_thread(request, client_address)
+            return
+        try:
+            engine = getattr(self.RequestHandlerClass, "engine", None)
+            if engine is not None:
+                engine.metrics.incr("tpu_serving_http_rejected")
+        except Exception:  # noqa: BLE001 — metrics must never block 503
+            pass
+        try:
+            request.sendall(self._REJECT)
+            # drain so close doesn't RST away the buffered 503 — bounded
+            # by wall time AND bytes (a dribbling client must not pin the
+            # thread; each recv would otherwise reset the timeout)
+            deadline = time.monotonic() + 1.0
+            drained = 0
+            request.settimeout(0.25)
             try:
-                request.sendall(self._REJECT)
-                # drain until the client closes (bounded): closing with
-                # unread request bytes queued makes TCP send RST, which
-                # discards the buffered 503 on common stacks — the client
-                # would see ECONNRESET instead of Retry-After
-                request.settimeout(0.5)
-                try:
-                    while request.recv(4096):
-                        pass
-                except OSError:
-                    pass
+                while time.monotonic() < deadline and drained < 65536:
+                    data = request.recv(4096)
+                    if not data:
+                        break
+                    drained += len(data)
             except OSError:
                 pass
-            self.shutdown_request(request)
-            return
-        self._req_sem[id(request)] = sem
-        try:
-            super().process_request(request, client_address)
-        except BaseException:  # thread spawn failed: slot must not leak
-            self._req_sem.pop(id(request), None)
-            sem.release()
-            raise
+        except OSError:
+            pass
+        self.shutdown_request(request)
 
     def process_request_thread(self, request, client_address):
         try:

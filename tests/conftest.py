@@ -17,13 +17,14 @@ if "xla_force_host_platform_device_count" not in _flags:
 # one core): compiled executables are reused across test modules AND suite
 # runs, so the per-module jax.clear_caches() below (the ORC-JIT segfault
 # fence) costs a disk hit instead of a recompile. Measured: test_moe.py
-# 116s cold -> 42s warm. Safe to delete the dir anytime.
-os.environ.setdefault(
+# 116s cold -> 42s warm. Safe to delete the dir anytime. NOTE: set via
+# jax.config.update below, not env vars — the axon sitecustomize imports
+# jax at interpreter start, freezing env-derived config before conftest
+# runs (same reason the platform override needs config.update).
+_CACHE_DIR = os.environ.get(
     "JAX_COMPILATION_CACHE_DIR",
     os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                  ".jax_compile_cache"))
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.1")
 
 # The axon image registers its TPU platform from sitecustomize.py at interpreter
 # start, before any conftest runs — the env var alone is too late. The config
@@ -33,6 +34,9 @@ try:
     import jax  # noqa: E402
 
     jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
 except ImportError:  # pragma: no cover — jax-free environment
     pass
 

@@ -271,6 +271,7 @@ class TestRemoteReapDecision:
         from k8s_runpod_kubelet_tpu.node.api_server import _should_reap_remote
         for msg in (b"client_loop: send disconnect: Broken pipe",
                     b"Connection to 10.0.0.1 closed by remote host.",
+                    b"Connection closed by 10.0.0.1 port 22",  # kex/auth form
                     b"ssh: connect to host 10.0.0.1 port 22: "
                     b"Connection timed out",
                     b"Timeout, server 10.0.0.1 not responding",

@@ -8,6 +8,7 @@ socket/threading behavior, fast tier."""
 import http.client
 import json
 import socket
+import threading
 import time
 import types
 
@@ -87,6 +88,67 @@ class TestHttpBackpressure:
             c3.close()
         finally:
             for s in holders:
+                s.close()
+            httpd.shutdown()
+
+    def test_obs_admission_cannot_smuggle_engine_work(self):
+        # a connection admitted through the RESERVE by peeking GET /healthz
+        # is closed after that response — keep-alive must not let it run
+        # POST /generate on the reserved slot while overloaded
+        eng = _stub_engine()
+        httpd = serve(eng, 0, max_connections=1)
+        port = httpd.server_address[1]
+        holders = []
+        try:
+            holders = [_hold(port)]
+            time.sleep(0.3)
+            c = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+            c.request("GET", "/healthz")
+            r = c.getresponse()
+            assert r.status == 200
+            assert r.getheader("Connection") == "close"
+            r.read()
+        finally:
+            for s in holders:
+                s.close()
+            httpd.shutdown()
+
+    def test_dribbling_client_cannot_stall_accepts(self):
+        # the reject drain is bounded by wall time and bytes, and triage
+        # runs off the accept thread: while an overflow client dribbles
+        # bytes, an observability request must still be served promptly
+        eng = _stub_engine()
+        httpd = serve(eng, 0, max_connections=1)
+        port = httpd.server_address[1]
+        stop = threading.Event()
+
+        def dribble(sock):
+            try:
+                while not stop.wait(0.05):
+                    sock.sendall(b"x")
+            except OSError:
+                pass
+
+        holders, dribblers = [], []
+        try:
+            holders = [_hold(port)]
+            time.sleep(0.3)
+            for _ in range(3):  # overflow connections that keep sending
+                s = socket.create_connection(("127.0.0.1", port))
+                s.sendall(b"POST /generate HTTP/1.1\r\n")
+                t = threading.Thread(target=dribble, args=(s,), daemon=True)
+                t.start()
+                dribblers.append(s)
+            time.sleep(0.2)
+            t0 = time.monotonic()
+            c = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+            c.request("GET", "/healthz")
+            assert c.getresponse().status == 200
+            assert time.monotonic() - t0 < 3.0  # served while dribbling
+            c.close()
+        finally:
+            stop.set()
+            for s in holders + dribblers:
                 s.close()
             httpd.shutdown()
 
