@@ -62,15 +62,25 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def _send(self, status: int, payload: dict | bytes,
-              ctype: str = "application/json"):
+              ctype: str = "application/json",
+              extra_headers: dict | None = None):
         body = payload if isinstance(payload, bytes) else json.dumps(payload).encode()
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
         if self.close_connection:  # tell the client, don't just hang up
             self.send_header("Connection", "close")
         self.end_headers()
         self.wfile.write(body)
+
+    def _overloaded(self, e, openai: bool = False):
+        """429 + Retry-After for an EngineOverloaded admission rejection —
+        the bounded-latency contract's client-visible half."""
+        err = ({"error": {"message": str(e), "type": "overloaded_error"}}
+               if openai else {"error": str(e)})
+        return self._send(429, err, extra_headers={"Retry-After": "1"})
 
     def do_GET(self):
         if self.path in ("/healthz", "/metrics"):
@@ -229,6 +239,9 @@ class _Handler(BaseHTTPRequestHandler):
         except ValueError as e:
             return self._send(400, {"error": str(e)})
         except Exception as e:  # engine crash: JSON 500, not a dropped socket
+            from .serving import EngineOverloaded
+            if isinstance(e, EngineOverloaded):
+                return self._overloaded(e)
             return self._send(500, {"error": str(e)})
         if self.tokenizer is not None:
             out = dict(out)
@@ -265,7 +278,13 @@ class _Handler(BaseHTTPRequestHandler):
 
         fut = self.engine.submit(tokens, on_token=on_token, **kw)
         if fut.done() and fut.exception() is not None:
-            return self._send(400, fmt["badreq"](str(fut.exception())))
+            from .serving import EngineOverloaded
+            exc = fut.exception()
+            if isinstance(exc, EngineOverloaded):
+                overloaded = fmt.get("overloaded", fmt["badreq"])
+                return self._send(429, overloaded(str(exc)),
+                                  extra_headers={"Retry-After": "1"})
+            return self._send(400, fmt["badreq"](str(exc)))
         fut.add_done_callback(lambda f: q.put(("end", f)))
         self.send_response(200)
         self.send_header("Content-Type", ctype)
@@ -519,7 +538,11 @@ class _Handler(BaseHTTPRequestHandler):
                  "error": lambda msg: [sse({"error": {
                      "message": msg, "type": "server_error"}}), sse("[DONE]")],
                  "badreq": lambda msg: {"error": {
-                     "message": msg, "type": "invalid_request_error"}}})
+                     "message": msg, "type": "invalid_request_error"}},
+                 # same condition as _overloaded(): an SDK client branching
+                 # on type must see a retryable overload, not a bad request
+                 "overloaded": lambda msg: {"error": {
+                     "message": msg, "type": "overloaded_error"}}})
 
         # n choices share ONE prefill (the engine fans the cache out); with
         # an explicit seed each choice offsets it so the samples differ
@@ -544,6 +567,9 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:  # engine crash (e.g. recovery-path RuntimeError)
             for f in futs:
                 f.cancel()
+            from .serving import EngineOverloaded
+            if isinstance(e, EngineOverloaded):
+                return self._overloaded(e, openai=True)
             return self._send(500, {"error": {"message": str(e),
                                               "type": "server_error"}})
         choices = []
@@ -777,6 +803,11 @@ def main(argv=None) -> int:
                    help="HTTP-layer concurrency bound: connections beyond "
                         "this get an immediate 503 + Retry-After (the HPA "
                         "scale signal stays the engine queue depth)")
+    p.add_argument("--max-queue-depth", type=int, default=0,
+                   help="engine admission bound: requests beyond this many "
+                        "queued get 429 + Retry-After instead of an "
+                        "unbounded wait (0 = unbounded; HPA still scales "
+                        "on tpu_serving_queue_depth)")
     p.add_argument("--hf-checkpoint", default="",
                    help="HuggingFace model directory (safetensors/bin) to "
                         "load real weights from; empty = random init")
@@ -849,6 +880,7 @@ def main(argv=None) -> int:
         ring_cache={None: None, "auto": None, "on": True,
                     "off": False}[args.ring_cache],
         speculate_k=args.speculate,
+        max_queue_depth=args.max_queue_depth,
         # text mode stops at the tokenizer's EOS instead of always burning
         # the full max_new_tokens budget
         eos_token=(tokenizer.eos_id if tokenizer is not None else -1)),

@@ -98,6 +98,16 @@ class ServingConfig:
     lora_rank: int = 0
     lora_targets: tuple = ("wq", "wv")
     max_adapters: int = 8
+    # admission control: reject new requests once this many are queued
+    # (0 = unbounded). The queue depth GAUGE stays the HPA scale signal;
+    # this is the ceiling that keeps latency bounded until the autoscaler
+    # catches up — rejected submits resolve to EngineOverloaded, which the
+    # HTTP layer maps to 429 + Retry-After.
+    max_queue_depth: int = 0
+
+
+class EngineOverloaded(RuntimeError):
+    """Request rejected at admission: queue is at max_queue_depth."""
 
 
 @dataclasses.dataclass
@@ -306,6 +316,10 @@ class ServingEngine:
         # int read-modify-write is not atomic, so the gauge needs a lock.
         self._queued_fanout = 0
         self._fanout_lock = threading.Lock()
+        # admission (max_queue_depth) is check-then-put from concurrent
+        # HTTP handler threads — without a lock N racing submits could all
+        # pass the check and breach the bound by N-1
+        self._admit_lock = threading.Lock()
         # prefill thread -> engine thread: (request, single cache, first token)
         self._ready: "queue.Queue[tuple[Request, Params, int]]" = \
             queue.Queue(maxsize=sc.slots)
@@ -560,7 +574,19 @@ class ServingEngine:
                       on_token=on_token)
         if _build_only:
             return req
-        self._queue.put(req)
+        with self._admit_lock:  # atomic check+put: racing submits must not
+            # breach the bound by one each
+            if (self.sc.max_queue_depth
+                    and self.queue_depth >= self.sc.max_queue_depth):
+                # admission bound (bounded-latency contract): the client
+                # gets an immediate typed rejection, not an unbounded wait
+                self.metrics.incr("tpu_serving_admission_rejected")
+                f = Future()
+                f.set_exception(EngineOverloaded(
+                    f"queue depth {self.queue_depth} at max_queue_depth "
+                    f"{self.sc.max_queue_depth}; retry later"))
+                return f
+            self._queue.put(req)
         self.metrics.set_gauge("tpu_serving_queue_depth", self.queue_depth)
         return req.future
 
@@ -593,9 +619,23 @@ class ServingEngine:
                                     _build_only=True, **kw))
         head = reqs[0]
         head.fanout = reqs[1:]
-        with self._fanout_lock:
-            self._queued_fanout += len(head.fanout)
-        self._queue.put(head)
+        with self._admit_lock:  # atomic check+put, like submit()
+            if self.sc.max_queue_depth and (
+                    self.queue_depth + n > self.sc.max_queue_depth):
+                # group admission counts ALL members against the bound
+                self.metrics.incr("tpu_serving_admission_rejected")
+                exc = EngineOverloaded(
+                    f"queue depth {self.queue_depth} + group of {n} exceeds "
+                    f"max_queue_depth {self.sc.max_queue_depth}; retry later")
+                fs = []
+                for _ in range(n):
+                    f = Future()
+                    f.set_exception(exc)
+                    fs.append(f)
+                return fs
+            with self._fanout_lock:
+                self._queued_fanout += len(head.fanout)
+            self._queue.put(head)
         self.metrics.set_gauge("tpu_serving_queue_depth", self.queue_depth)
         return [r.future for r in reqs]
 

@@ -417,3 +417,88 @@ class TestMixedTrafficStress:
             assert eng.last_error is None
         finally:
             eng.stop()
+
+
+class TestAdmissionControl:
+    """max_queue_depth (r4): a bounded-latency admission ceiling. The
+    engine is deliberately NOT started — the queue can't drain, so the
+    bound is hit deterministically with no timing games."""
+
+    def _unstarted(self, params, depth, slots=1):
+        return ServingEngine(CFG, params,
+                             ServingConfig(slots=slots, max_prefill_len=32,
+                                           cache_len=64, max_new_tokens=8,
+                                           max_queue_depth=depth))
+
+    def test_submit_beyond_bound_rejected(self, params):
+        from k8s_runpod_kubelet_tpu.workloads.serving import EngineOverloaded
+        e = self._unstarted(params, depth=2)
+        f1 = e.submit([1, 2], max_new_tokens=4)
+        f2 = e.submit([3, 4], max_new_tokens=4)
+        assert not f1.done() and not f2.done()  # queued, admitted
+        f3 = e.submit([5, 6], max_new_tokens=4)
+        assert f3.done()
+        with pytest.raises(EngineOverloaded, match="max_queue_depth 2"):
+            f3.result(timeout=0)
+        assert e.metrics.get_counter("tpu_serving_admission_rejected") == 1
+
+    def test_group_counts_all_members(self, params):
+        from k8s_runpod_kubelet_tpu.workloads.serving import EngineOverloaded
+        e = self._unstarted(params, depth=3)
+        fs = e.submit_group([1, 2], n=4)   # 4 > 3: whole group rejected
+        assert len(fs) == 4
+        for f in fs:
+            with pytest.raises(EngineOverloaded):
+                f.result(timeout=0)
+        fs2 = e.submit_group([1, 2], n=3)  # fits exactly: admitted
+        assert all(not f.done() for f in fs2)
+
+    def test_zero_means_unbounded(self, params):
+        e = self._unstarted(params, depth=0)
+        futs = [e.submit([1], max_new_tokens=2) for _ in range(32)]
+        assert all(not f.done() for f in futs)
+
+    def test_http_429_with_retry_after(self, params):
+        import http.client
+        import json as _json
+        from k8s_runpod_kubelet_tpu.workloads.serve_main import serve
+        e = self._unstarted(params, depth=1)
+        e.submit([1, 2], max_new_tokens=4)  # fills the queue
+        httpd = serve(e, 0)
+        try:
+            port = httpd.server_address[1]
+            c = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+            c.request("POST", "/generate",
+                      body=_json.dumps({"tokens": [1, 2, 3]}),
+                      headers={"Content-Type": "application/json"})
+            r = c.getresponse()
+            assert r.status == 429
+            assert r.getheader("Retry-After") == "1"
+            assert "max_queue_depth" in _json.loads(r.read())["error"]
+            c.close()
+        finally:
+            httpd.shutdown()
+
+    def test_openai_stream_429_overloaded_type(self, params):
+        import http.client
+        import json as _json
+        from k8s_runpod_kubelet_tpu.workloads.serve_main import serve
+        e = self._unstarted(params, depth=1)
+        e.submit([1, 2], max_new_tokens=4)  # fills the queue
+        httpd = serve(e, 0)
+        try:
+            port = httpd.server_address[1]
+            c = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+            c.request("POST", "/v1/completions",
+                      body=_json.dumps({"prompt": [1, 2], "stream": True}),
+                      headers={"Content-Type": "application/json"})
+            r = c.getresponse()
+            assert r.status == 429
+            assert r.getheader("Retry-After") == "1"
+            err = _json.loads(r.read())["error"]
+            # retryable overload, NOT invalid_request_error: SDK clients
+            # branch on this type
+            assert err["type"] == "overloaded_error"
+            c.close()
+        finally:
+            httpd.shutdown()
