@@ -19,8 +19,16 @@ Programs covered (the round's headline benches):
   - flash-attention fwd+bwd Pallas kernel at S=2048 (training geometry)
   - ring flash attention over a seq=4 mesh on a v5e:2x2 topology
 
+  - Gemma-2 mixed-cache and Mistral ring-cache int8 decode (the exotic
+    cache index math, previously interpret/CPU-verified only)
+
 Writes one JSON record per program to bench_results/aot_v5e.json and prints
-a summary line each. Usage: python tools/aot_check.py
+a summary line each. RESOURCE_EXHAUSTED records are memory-boundary
+answers, not failures; only non-OOM compile failures exit nonzero.
+
+Usage: python tools/aot_check.py
+       python tools/aot_check.py --only train|serving|alt|flash|flash32k|ring|sharded
+       (--only merges its subset over the existing evidence file)
 """
 
 from __future__ import annotations
@@ -163,66 +171,83 @@ def check_train(results, dev):
             model_flops_per_tok=6.0 * cfg.param_count))
 
 
+def _quantized_params_abs(cfg):
+    """Abstract int8 param tree for a model config. quantize_params is
+    host-side numpy (not traceable), so run it over a zeros host tree
+    (copy-on-write pages, same trick as bench _serve_params) and keep only
+    the SHAPES."""
+    import jax
+    import numpy as np
+    from k8s_runpod_kubelet_tpu.models import init_params
+    from k8s_runpod_kubelet_tpu.models.quant import quantize_params
+
+    params_abs = jax.eval_shape(lambda k: init_params(cfg, k),
+                                jax.random.PRNGKey(0))
+    host = jax.tree_util.tree_map(
+        lambda sd: np.zeros(sd.shape, sd.dtype), params_abs)
+    q_real = quantize_params(cfg, host)
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), q_real)
+
+
+def _lower_decode(model, q_abs, cache_abs, n_slots, s, note):
+    """ONE lower/compile recipe for every int8 decode cell (8B econ A/B,
+    slot sweep, exotic-cache models) — changes here retune all of them."""
+    import jax
+    import jax.numpy as jnp
+
+    def decode(params, token, cache, active):
+        return model.decode_step(params, token, cache, active)
+
+    lowered = jax.jit(decode, donate_argnums=(2,)).lower(
+        _sds_tree(q_abs, s),
+        jax.ShapeDtypeStruct((n_slots,), jnp.int32, sharding=s),
+        _sds_tree(cache_abs, s),
+        jax.ShapeDtypeStruct((n_slots,), bool, sharding=s))
+    rec = _analyze(lowered.compile(), tokens_per_step=n_slots)
+    rec["note"] = note
+    return rec
+
+
+_SERVING_8B_KEYS = ("decode_8b_int8_kv8", "decode_8b_int8_kvbf16",
+                    "decode_8b_int8_kv8_slots16",
+                    "decode_8b_int8_kv8_slots32",
+                    "decode_8b_int8_kv8_slots48", "prefill_8b_int8",
+                    "econ_kv_int8_traffic_ratio")
+
+
 def check_serving_8b(results, dev):
     import jax
     import jax.numpy as jnp
-    import numpy as np
     from jax.sharding import SingleDeviceSharding
 
-    from k8s_runpod_kubelet_tpu.models import LlamaModel, init_params, llama3_8b
-    from k8s_runpod_kubelet_tpu.models.quant import quantize_params
+    from k8s_runpod_kubelet_tpu.models import LlamaModel, llama3_8b
 
     cfg = llama3_8b()
     model = LlamaModel(cfg)
     slots, cache_len, prefill_len = 8, 2048, 512  # run_serve_bench 8B geometry
     s = SingleDeviceSharding(dev)
     try:
-        params_abs = jax.eval_shape(lambda k: init_params(cfg, k),
-                                    jax.random.PRNGKey(0))
-        # quantize_params is host-side numpy (not traceable): run it over a
-        # zeros host tree (copy-on-write pages, same trick as bench
-        # _serve_params) and keep only the SHAPES
-        host = jax.tree_util.tree_map(
-            lambda sd: np.zeros(sd.shape, sd.dtype), params_abs)
-        q_real = quantize_params(cfg, host)
-        q_abs = jax.tree_util.tree_map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), q_real)
-        del q_real, host
-        cache_abs = jax.eval_shape(
-            lambda: model.init_cache(slots, cache_len, quantize=True))
-    except Exception as e:  # noqa: BLE001 — record EVERY serving key as
-        # failed: a partial failure record would let the --only merge
-        # carry stale slot-sweep entries under a fresh timestamp
+        q_abs = _quantized_params_abs(cfg)
+    except Exception as e:  # noqa: BLE001 — record EVERY serving key
+        # (econ ratio included) as failed: a partial failure record would
+        # let the --only merge carry stale entries under a fresh timestamp
         err = {"compile_ok": False, "compile_wall_s": 0.0,
                "error": f"setup: {type(e).__name__}: {e}"[:500]}
-        for key in ("decode_8b_int8_kv8", "decode_8b_int8_kvbf16",
-                    "decode_8b_int8_kv8_slots16",
-                    "decode_8b_int8_kv8_slots32",
-                    "decode_8b_int8_kv8_slots48", "prefill_8b_int8"):
+        for key in _SERVING_8B_KEYS:
             results[key] = dict(err)
         print(f"[aot] serving_8b setup FAILED: {err['error'][:120]}",
               flush=True)
         return
 
-    def decode(params, token, cache, active):
-        return model.decode_step(params, token, cache, active)
-
     def prog_decode_variant(n_slots, kv_int8, note):
-        # ONE lower/compile recipe for every decode cell: the int8-KV vs
-        # bf16-KV econ A/B and the slot sweep (decode is weight-
-        # amortization-bound — every step reads the whole int8 weight tree
-        # once regardless of batch, so tok/s scales with slots until KV
-        # traffic or HBM capacity pushes back; int8 KV buys the headroom)
+        # decode is weight-amortization-bound — every step reads the whole
+        # int8 weight tree once regardless of batch, so tok/s scales with
+        # slots until KV traffic or HBM capacity pushes back; int8 KV
+        # buys the headroom
         cache_n = jax.eval_shape(
             lambda: model.init_cache(n_slots, cache_len, quantize=kv_int8))
-        lowered = jax.jit(decode, donate_argnums=(2,)).lower(
-            _sds_tree(q_abs, s),
-            jax.ShapeDtypeStruct((n_slots,), jnp.int32, sharding=s),
-            _sds_tree(cache_n, s),
-            jax.ShapeDtypeStruct((n_slots,), bool, sharding=s))
-        rec = _analyze(lowered.compile(), tokens_per_step=n_slots)
-        rec["note"] = note
-        return rec
+        return _lower_decode(model, q_abs, cache_n, n_slots, s, note)
 
     def prog_prefill():
         prefill_cache_abs = jax.eval_shape(
@@ -262,6 +287,49 @@ def check_serving_8b(results, dev):
         print(f"[aot] econ: int8-KV decode moves "
               f"{results['econ_kv_int8_traffic_ratio']['ratio']:.0%} of the "
               f"bf16-KV bytes", flush=True)
+
+
+def check_serving_alt(results, dev):
+    """The EXOTIC cache paths compiled for the real target: Gemma-2's
+    mixed (local-ring/global-full) cache and Mistral's uniform ring cache,
+    both with int8 weights + int8 KV — these decode programs have the most
+    bespoke index math in the serving stack, exactly where an
+    interpret-mode-only check could hide a v5e lowering failure."""
+    import jax
+    from jax.sharding import SingleDeviceSharding
+
+    s = SingleDeviceSharding(dev)
+
+    def decode_prog(model_name, make_cache, slots, note):
+        # EVERYTHING (model import + config construction included) inside
+        # the prog so a models/ API drift is recorded by _run, not fatal
+        # to the tool
+        from k8s_runpod_kubelet_tpu import models as M
+        cfg = getattr(M, model_name)()
+        model = M.LlamaModel(cfg)
+        q_abs = _quantized_params_abs(cfg)
+        cache_abs = jax.eval_shape(lambda: make_cache(model, cfg))
+        return _lower_decode(model, q_abs, cache_abs, slots, s, note)
+
+    # gemma2: 2 slots / 6k context — gemma2-9b is HBM-tight on one v5e
+    # (9.2GB int8 weights + a 1.9GB bf16 embedding); 4 slots at 8k OOM'd
+    # at 19.6G (recorded in git history); this is the fitting point
+    results["decode_gemma2_9b_mixed_int8kv"] = _run(
+        "decode_gemma2_9b_mixed_int8kv",
+        lambda: decode_prog(
+            "gemma2_9b",
+            lambda m, c: m.init_mixed_cache(
+                2, 6144, (c.sliding_window or 4096) + 512, quantize=True),
+            2, "mixed cache: local sublayers ring at window+slack, global "
+               "full 6k; 2 slots, int8 weights + int8 KV"))
+    results["decode_mistral_7b_ring_int8kv"] = _run(
+        "decode_mistral_7b_ring_int8kv",
+        lambda: decode_prog(
+            "mistral_7b",
+            lambda m, c: m.init_ring_cache(
+                8, (c.sliding_window or 4096) + 512, quantize=True),
+            8, "uniform ring cache (abs_pos ownership map), 8 slots, int8 "
+               "weights + int8 KV"))
 
 
 def check_flash_attention(results, dev):
@@ -463,25 +531,26 @@ def main() -> int:
     results: dict[str, dict] = {}
     topo1 = _topo("v5e:1x1", chips_per_host_bounds=(1, 1, 1))
     dev = topo1.devices[0]
-    only = ""
-    if "--only" in sys.argv:
-        i = sys.argv.index("--only") + 1
-        if i >= len(sys.argv):
-            print("usage: aot_check.py [--only "
-                  "train|serving|flash|flash32k|ring|sharded]",
-                  file=sys.stderr)
-            return 2
-        only = sys.argv[i]
     checks = [
         ("train", lambda: check_train(results, dev)),
         ("serving", lambda: check_serving_8b(results, dev)),
+        ("alt", lambda: check_serving_alt(results, dev)),
         ("flash", lambda: check_flash_attention(results, dev)),
         ("flash32k", lambda: check_flash_32k(results, dev)),
         ("ring", lambda: check_ring_flash(results)),
         ("sharded", lambda: check_sharded_train(results)),
     ]
+    names = [n for n, _ in checks]
+    only = ""
+    if "--only" in sys.argv:
+        i = sys.argv.index("--only") + 1
+        only = sys.argv[i] if i < len(sys.argv) else ""
+        if only not in names:  # a typo must not rewrite the evidence file
+            print(f"usage: aot_check.py [--only {'|'.join(names)}]",
+                  file=sys.stderr)
+            return 2
     for name, fn in checks:
-        if only and only not in name:
+        if only and only != name:
             continue
         fn()
 
@@ -509,13 +578,17 @@ def main() -> int:
         f.write("\n")
     print(f"[aot] wrote {path}")
     ok = sum(1 for r in results.values() if r.get("compile_ok"))
+    # RESOURCE_EXHAUSTED records are memory-boundary ANSWERS (several
+    # grid points OOM by design), so they must not fail the run — but a
+    # NON-OOM compile failure (e.g. a Mosaic lowering regression) must
+    # still gate scripts chaining on the exit code
+    real_failures = [k for k, r in results.items()
+                     if not r.get("compile_ok")
+                     and "RESOURCE_EXHAUSTED" not in r.get("error", "")]
     print(f"[aot] {ok}/{len(results)} programs compiled for v5e "
-          f"(RESOURCE_EXHAUSTED records are memory-boundary ANSWERS, "
-          f"not failures)")
-    # exit 0 whenever the run produced evidence: several grid points OOM
-    # BY DESIGN (that refusal is the finding), so all-compiled can never
-    # hold and must not gate scripts chaining on the exit code
-    return 0 if ok else 1
+          f"(OOM records are memory-boundary answers; "
+          f"real failures: {real_failures or 'none'})")
+    return 1 if (real_failures or not results) else 0
 
 
 if __name__ == "__main__":
