@@ -190,21 +190,28 @@ def _quantized_params_abs(cfg):
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), q_real)
 
 
-def _lower_decode(model, q_abs, cache_abs, n_slots, s, note):
+def _lower_decode(model, q_abs, cache_abs, n_slots, s, note, k=1):
     """ONE lower/compile recipe for every int8 decode cell (8B econ A/B,
-    slot sweep, exotic-cache models) — changes here retune all of them."""
+    slot sweep, exotic-cache models, speculative verify) — changes here
+    retune all of them. ``k`` > 1 lowers verify_step with (slots, k)
+    candidate tokens (decode_step IS verify at K=1, same kernel);
+    tokens_per_step then assumes full acceptance (upper bound)."""
     import jax
     import jax.numpy as jnp
 
-    def decode(params, token, cache, active):
-        return model.decode_step(params, token, cache, active)
+    if k == 1:
+        def step(params, token, cache, active):
+            return model.decode_step(params, token, cache, active)
+        tok_sds = jax.ShapeDtypeStruct((n_slots,), jnp.int32, sharding=s)
+    else:
+        def step(params, toks, cache, active):
+            return model.verify_step(params, toks, cache, active)
+        tok_sds = jax.ShapeDtypeStruct((n_slots, k), jnp.int32, sharding=s)
 
-    lowered = jax.jit(decode, donate_argnums=(2,)).lower(
-        _sds_tree(q_abs, s),
-        jax.ShapeDtypeStruct((n_slots,), jnp.int32, sharding=s),
-        _sds_tree(cache_abs, s),
+    lowered = jax.jit(step, donate_argnums=(2,)).lower(
+        _sds_tree(q_abs, s), tok_sds, _sds_tree(cache_abs, s),
         jax.ShapeDtypeStruct((n_slots,), bool, sharding=s))
-    rec = _analyze(lowered.compile(), tokens_per_step=n_slots)
+    rec = _analyze(lowered.compile(), tokens_per_step=n_slots * k)
     rec["note"] = note
     return rec
 
@@ -213,6 +220,7 @@ _SERVING_8B_KEYS = ("decode_8b_int8_kv8", "decode_8b_int8_kvbf16",
                     "decode_8b_int8_kv8_slots16",
                     "decode_8b_int8_kv8_slots32",
                     "decode_8b_int8_kv8_slots48", "prefill_8b_int8",
+                    "verify_8b_int8_kv8_k4",
                     "econ_kv_int8_traffic_ratio")
 
 
@@ -258,6 +266,20 @@ def check_serving_8b(results, dev):
             _sds_tree(prefill_cache_abs, s))
         return _analyze(lowered.compile(), tokens_per_step=prefill_len)
 
+    def prog_verify_k4():
+        # speculative decoding's roofline case FOR the --econ speculate
+        # cell: one verify pass commits up to K=4 tokens while reading the
+        # weight tree ONCE — on a weight-amortization-bound decode that is
+        # the whole win, and this program's roofline vs decode_8b_int8_kv8
+        # bounds it (realized gain scales with the acceptance rate)
+        cache_n = jax.eval_shape(
+            lambda: model.init_cache(slots, cache_len, quantize=True))
+        return _lower_decode(
+            model, q_abs, cache_n, slots, s,
+            f"speculative verify, K=4, {slots} slots, int8 weights + int8 "
+            f"KV; tokens_per_step assumes 100% acceptance (upper bound)",
+            k=4)
+
     results["decode_8b_int8_kv8"] = _run(
         "decode_8b_int8_kv8", lambda: prog_decode_variant(
             slots, True, f"int8 weights + int8 KV, {slots} slots, "
@@ -272,6 +294,8 @@ def check_serving_8b(results, dev):
             lambda n=n_slots: prog_decode_variant(
                 n, True, f"{n} slots, int8 weights + int8 KV"))
     results["prefill_8b_int8"] = _run("prefill_8b_int8", prog_prefill)
+    results["verify_8b_int8_kv8_k4"] = _run("verify_8b_int8_kv8_k4",
+                                            prog_verify_k4)
     a = results.get("decode_8b_int8_kv8", {})
     b = results.get("decode_8b_int8_kvbf16", {})
     if a.get("compile_ok") and b.get("compile_ok"):
@@ -287,6 +311,12 @@ def check_serving_8b(results, dev):
         print(f"[aot] econ: int8-KV decode moves "
               f"{results['econ_kv_int8_traffic_ratio']['ratio']:.0%} of the "
               f"bf16-KV bytes", flush=True)
+    else:
+        # the ratio's INPUT cells failed: the econ record must fail WITH
+        # them, or a --only merge would carry the stale ratio forward
+        results["econ_kv_int8_traffic_ratio"] = {
+            "compile_ok": False, "compile_wall_s": 0.0,
+            "error": "input decode cells did not both compile"}
 
 
 def check_serving_alt(results, dev):
