@@ -205,6 +205,19 @@ class TestWatch:
         assert bench.run_watch() == 1
 
 
+class TestSweepConfigs:
+    def test_530m_config_is_the_single_source(self):
+        # bench --mfu-sweep and tools/aot_check.py must validate the SAME
+        # geometry: both import _bench_config_530m from __graft_entry__.
+        # Guard its identity so a retune is a deliberate act (the AOT
+        # memory prevalidation in bench.py's grid comment is tied to it).
+        from __graft_entry__ import _bench_config_530m
+        cfg = _bench_config_530m()
+        assert 4.5e8 < cfg.param_count < 6.5e8  # "530M-class"
+        assert cfg.remat_policy == "dots"
+        assert cfg.max_seq_len == 2048
+
+
 class TestSessionFallback:
     def test_headline_line_selected_and_stamped(self, results_dir):
         os.makedirs(str(results_dir), exist_ok=True)
