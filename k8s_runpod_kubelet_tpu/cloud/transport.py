@@ -79,14 +79,20 @@ class HttpTransport:
         body: Optional[dict] = None,
         timeout_s: Optional[float] = None,
         expect_status: tuple[int, ...] = (200,),
+        max_retries: Optional[int] = None,
     ) -> Any:
-        """Issue a JSON request; returns the decoded JSON body (or None for empty)."""
+        """Issue a JSON request; returns the decoded JSON body (or None for empty).
+
+        ``max_retries`` overrides the transport-wide attempt count for calls
+        whose caller would rather fail fast than block (e.g. the quota read
+        that rides the readiness probe's ping path)."""
         url = self.base_url + path
         data = json.dumps(body).encode() if body is not None else None
+        retries = self.max_retries if max_retries is None else max_retries
         last_err: Optional[TransportError] = None
         auth_retried = False
         attempt = 0
-        while attempt < self.max_retries:
+        while attempt < retries:
             attempt += 1
             req = urllib.request.Request(url, data=data, method=method)
             req.add_header("Content-Type", "application/json")
@@ -99,7 +105,7 @@ class HttpTransport:
                 # contract every caller catches
                 last_err = TransportError(
                     f"{method} {path}: token fetch failed: {e}", status=0)
-                if attempt < self.max_retries:
+                if attempt < retries:
                     self._sleep(BACKOFF_BASE_S * attempt)
                     log.debug("retrying %s %s (attempt %d): %s",
                               method, path, attempt + 1, last_err)
@@ -135,7 +141,7 @@ class HttpTransport:
                     raise last_err
             except (urllib.error.URLError, TimeoutError, ConnectionError, OSError) as e:
                 last_err = TransportError(f"{method} {path}: {e}", status=0)
-            if attempt < self.max_retries:
+            if attempt < retries:
                 self._sleep(BACKOFF_BASE_S * attempt)
                 log.debug("retrying %s %s (attempt %d): %s", method, path, attempt + 1, last_err)
         assert last_err is not None

@@ -59,6 +59,7 @@ def parse_flags(argv: list[str]) -> argparse.Namespace:
     p.add_argument("--zones", default=None, help="comma-separated allowed zones")
     p.add_argument("--default-generation", dest="default_generation", default=None)
     p.add_argument("--tpu-api-endpoint", dest="tpu_api_endpoint", default=None)
+    p.add_argument("--quota-api-endpoint", dest="quota_api_endpoint", default=None)
     p.add_argument("--log-level", dest="log_level", default=None)
     p.add_argument("--provider-config", dest="provider_config", default=None)
     p.add_argument("--os", dest="operating_system", default=None)
@@ -104,16 +105,39 @@ def build(cfg: config_mod.Config, kube=None, tpu=None, worker_transport=None,
     # server / worker-agent aggregator (or a typo-squatted host) must
     # never receive the operator's real OAuth token
     from ..cloud import default_token_provider, is_google_api_endpoint
-    if is_google_api_endpoint(cfg.tpu_api_endpoint):
-        transport = HttpTransport(
-            cfg.tpu_api_endpoint,
-            token_provider=(token_provider or
-                            default_token_provider(cfg.tpu_api_token)))
-    else:
-        transport = HttpTransport(cfg.tpu_api_endpoint,
-                                  token=cfg.tpu_api_token)
+
+    # The static token belongs to whatever host tpu_api_endpoint names. Only
+    # seed the Google provider chain with it when that host IS Google —
+    # otherwise a fake-server/aggregator credential would ride the quota
+    # transport to serviceusage.googleapis.com (foreign-token leak; the
+    # ambient ADC/metadata chain is the right credential there).
+    google_static_token = (cfg.tpu_api_token
+                           if is_google_api_endpoint(cfg.tpu_api_endpoint)
+                           else "")
+
+    def _make_transport(endpoint: str) -> HttpTransport:
+        nonlocal token_provider
+        if is_google_api_endpoint(endpoint):
+            # one shared caching provider across transports (same scopes)
+            token_provider = (token_provider or
+                              default_token_provider(google_static_token))
+            return HttpTransport(endpoint, token_provider=token_provider)
+        # the static token is the credential OF cfg.tpu_api_endpoint's host;
+        # any other non-Google host (e.g. a custom quota proxy) gets no
+        # token rather than someone else's
+        tok = cfg.tpu_api_token if endpoint == cfg.tpu_api_endpoint else ""
+        return HttpTransport(endpoint, token=tok)
+
+    transport = _make_transport(cfg.tpu_api_endpoint)
+    # Quota is a different HOST in production (serviceusage.googleapis.com,
+    # config.quota_api_endpoint); unset = the TPU transport, whose host 404s
+    # the quota path against the real API -> capacity falls back to the
+    # configured ceiling (get_chip_quota docstring).
+    quota_transport = (_make_transport(cfg.quota_api_endpoint)
+                       if cfg.quota_api_endpoint else None)
     tpu = tpu or TpuClient(transport, project=cfg.project, zone=cfg.zone,
-                           workload_backend=backend)
+                           workload_backend=backend,
+                           quota_transport=quota_transport)
     provider = Provider(cfg, kube, tpu, gang_executor=gang, metrics=metrics)
     node_controller = NodeController(kube, provider,
                                      status_interval_s=cfg.node_status_interval_s)

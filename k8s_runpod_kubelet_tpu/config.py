@@ -31,6 +31,12 @@ class Config:
     zone: str = "us-central2-b"
     zones: list[str] = dataclasses.field(default_factory=list)  # allowed zones filter
     tpu_api_endpoint: str = "https://tpu.googleapis.com"
+    # Where to read chip quota (Service Usage consumerQuotaMetrics). Empty =
+    # same endpoint/transport as the TPU API — right for fake-server setups
+    # whose one listener serves both surfaces; real deployments set
+    # https://serviceusage.googleapis.com (the TPU API host itself 404s the
+    # quota path, which degrades to the configured capacity ceiling).
+    quota_api_endpoint: str = ""
     tpu_api_token: str = ""
     default_generation: str = "v5e"
     default_runtime_version: str = ""
@@ -120,6 +126,7 @@ _ENV_MAP = {
     "KUBELET_API_TOKEN": "api_auth_token",
     "TPU_API_TOKEN": "tpu_api_token",
     "TPU_API_ENDPOINT": "tpu_api_endpoint",
+    "TPU_QUOTA_API_ENDPOINT": "quota_api_endpoint",
     "TPU_PROJECT": "project",
     "TPU_ZONE": "zone",
     "NODE_NAME": "node_name",

@@ -64,13 +64,12 @@ class NodeController:
             return updated
 
     def push_status(self):
+        # Probe BEFORE building the snapshot: ping() refreshes cloud health
+        # and the live chip quota, and get_node() reads both — built the
+        # other way round, this patch would overwrite the quota-change push
+        # from the probe's notify callback with stale capacity.
+        self.node_provider.ping()
         node = self.node_provider.get_node()
-        if not self.node_provider.ping():
-            for cond in node.get("status", {}).get("conditions", []):
-                if cond.get("type") == "Ready":
-                    cond["status"] = "False"
-                    cond["reason"] = "CloudAPIUnreachable"
-                    cond["message"] = "TPU API health check failing"
         self.kube.patch_node_status(ko.name(node), {"status": node.get("status", {})})
 
     def renew_lease(self):

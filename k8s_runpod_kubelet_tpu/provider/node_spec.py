@@ -17,16 +17,25 @@ TAINT_VALUE = "tpu"
 
 
 def build_node(cfg: Config, *, cloud_healthy: bool = True,
-               kubelet_port: int = 10250) -> dict:
-    """``google.com/tpu`` capacity/allocatable comes from
-    ``cfg.max_total_chips`` (the operator's cloud-quota ceiling). The K8s
+               kubelet_port: int = 10250,
+               quota_chips: int | None = None) -> dict:
+    """``google.com/tpu`` capacity/allocatable is the tightest of the live
+    cloud quota (``quota_chips``, read periodically from the quota API by the
+    provider) and the operator's configured ceiling ``cfg.max_total_chips``
+    (still useful to reserve LESS than quota for this cluster). The K8s
     scheduler itself subtracts bound pods' requests from allocatable —
     the kubelet must NOT pre-decrement (that would double-count every
     bound chip) — so this one number is what bounds concurrently-bound
     chips: pods past it go Unschedulable instead of queueing invisibly
     in the cloud. Replaces the reference's static nvidia.com/gpu:4
-    fiction (kubelet.go:1129) with a configurable, quota-honest value."""
-    max_chips = cfg.max_total_chips or \
+    fiction (kubelet.go:1129); with neither signal available, falls back
+    to the largest catalog slice."""
+    # max_total_chips uses 0-means-unset (config default); a LIVE quota of 0
+    # is a real answer — a project with no chip grant yet must advertise 0,
+    # not fall back to catalog capacity and bind pods that can never deploy.
+    bounds = [c for c in (cfg.max_total_chips or None, quota_chips)
+              if c is not None]
+    max_chips = min(bounds) if bounds else \
         max(a.chips for a in ACCELERATOR_CATALOG.values())
     generations = sorted({a.generation for a in ACCELERATOR_CATALOG.values()})
     ready = "True" if cloud_healthy else "False"

@@ -168,3 +168,53 @@ class TestBuild:
             health.stop()
         finally:
             h.close()
+
+
+class TestQuotaTransportCredentialScoping:
+    def test_foreign_tpu_token_never_rides_to_google_quota_host(self, monkeypatch, tmp_path):
+        """A static token configured for a NON-Google TPU endpoint (worker-
+        agent aggregator / fake server) must not seed the Google provider
+        chain used by the quota transport — that would transmit a third-party
+        credential to serviceusage.googleapis.com and 401 every quota read."""
+        from k8s_runpod_kubelet_tpu.cloud.gcp_auth import StaticTokenProvider
+        from k8s_runpod_kubelet_tpu.cmd.main import build
+        from k8s_runpod_kubelet_tpu.config import Config
+        from k8s_runpod_kubelet_tpu.kube.fake import FakeKubeClient
+        # ADC present so the ambient chain resolves without a metadata server
+        adc = tmp_path / "adc.json"
+        adc.write_text('{"type": "authorized_user", "client_id": "c", '
+                       '"client_secret": "s", "refresh_token": "r"}')
+        monkeypatch.setenv("GOOGLE_APPLICATION_CREDENTIALS", str(adc))
+        cfg = Config(node_name="n", tpu_api_endpoint="http://127.0.0.1:9",
+                     tpu_api_token="aggregator-secret",
+                     quota_api_endpoint="https://serviceusage.googleapis.com",
+                     workload_path="api", listen_port=0, health_address=":0")
+        provider, *_rest, health = build(cfg, kube=FakeKubeClient())
+        try:
+            qt = provider.tpu.quota_transport
+            # quota transport: Google host, ambient chain, NO static token
+            assert qt.token == ""
+            assert not isinstance(qt.token_provider, StaticTokenProvider)
+            # TPU transport keeps its aggregator token for its own host
+            assert provider.tpu.transport.token == "aggregator-secret"
+        finally:
+            health.stop()
+
+    def test_google_tpu_token_never_rides_to_foreign_quota_host(self, monkeypatch, tmp_path):
+        """Reverse direction: a REAL Google token (tpu endpoint is Google)
+        must not be attached to a non-Google quota proxy."""
+        from k8s_runpod_kubelet_tpu.cmd.main import build
+        from k8s_runpod_kubelet_tpu.config import Config
+        from k8s_runpod_kubelet_tpu.kube.fake import FakeKubeClient
+        cfg = Config(node_name="n",
+                     tpu_api_endpoint="https://tpu.googleapis.com",
+                     tpu_api_token="real-google-token",
+                     quota_api_endpoint="http://internal-quota-proxy:8080",
+                     workload_path="ssh", listen_port=0, health_address=":0")
+        provider, *_rest, health = build(cfg, kube=FakeKubeClient())
+        try:
+            qt = provider.tpu.quota_transport
+            assert qt.token == ""
+            assert qt.token_provider is None
+        finally:
+            health.stop()

@@ -191,3 +191,148 @@ class TestFaultInjection:
         with pytest.raises(NotFoundError):
             c.get_queued_resource("nope")
         assert sleeps == []
+
+
+class TestChipQuota:
+    """Live-quota read backing quota-honest node capacity (VERDICT r3 weak-6).
+    The real TPU v2 surface has no quota endpoint; the client speaks the
+    Service Usage consumerQuotaMetrics shape and treats 404 as 'not enabled'."""
+
+    def test_absent_endpoint_returns_none(self, client):
+        assert client.get_chip_quota() is None
+
+    def test_simple_quota(self, client, server):
+        server.service.chip_quota = 48
+        assert client.get_chip_quota() == 48
+
+    def test_regional_bucket_beats_default_and_unlimited_skipped(self, client, server):
+        server.service.chip_quota_metrics = [
+            {"metric": "tpu.googleapis.com/v5e_chips",
+             "consumerQuotaLimits": [{"quotaBuckets": [
+                 {"effectiveLimit": "16", "dimensions": {}},
+                 {"effectiveLimit": "32", "dimensions": {"region": "us-central2"}},
+                 {"effectiveLimit": "64", "dimensions": {"region": "europe-west4"}},
+             ]}]},
+            # unlimited (-1) never bounds capacity
+            {"metric": "tpu.googleapis.com/v4_chips",
+             "consumerQuotaLimits": [{"quotaBuckets": [
+                 {"effectiveLimit": "-1", "dimensions": {}}]}]},
+            # generations sum into the one pooled google.com/tpu capacity
+            {"metric": "tpu.googleapis.com/v5p_chips",
+             "consumerQuotaLimits": [{"quotaBuckets": [
+                 {"effectiveLimit": "8", "dimensions": {}}]}]},
+        ]
+        assert client.get_chip_quota() == 32 + 8
+
+    def test_all_unlimited_is_none(self, client, server):
+        server.service.chip_quota_metrics = [
+            {"metric": "tpu.googleapis.com/v5e_chips",
+             "consumerQuotaLimits": [{"quotaBuckets": [
+                 {"effectiveLimit": "-1", "dimensions": {}}]}]},
+        ]
+        assert client.get_chip_quota() is None
+
+    def test_rate_quota_metrics_ignored(self, client, server):
+        """The service listing also carries API request-rate quotas; only
+        *_chips metrics are chip capacity."""
+        server.service.chip_quota_metrics = [
+            {"metric": "tpu.googleapis.com/default_requests",
+             "consumerQuotaLimits": [{"quotaBuckets": [
+                 {"effectiveLimit": "600", "dimensions": {}}]}]},
+            {"metric": "tpu.googleapis.com/v5e_chips",
+             "consumerQuotaLimits": [{"quotaBuckets": [
+                 {"effectiveLimit": "8", "dimensions": {}}]}]},
+        ]
+        assert client.get_chip_quota() == 8
+
+    def test_equal_specificity_takes_tightest_limit(self, client, server):
+        server.service.chip_quota_metrics = [
+            {"metric": "tpu.googleapis.com/v5e_chips",
+             "consumerQuotaLimits": [
+                 {"quotaBuckets": [{"effectiveLimit": "64", "dimensions": {}}]},
+                 {"quotaBuckets": [{"effectiveLimit": "16", "dimensions": {}}]},
+             ]},
+        ]
+        assert client.get_chip_quota() == 16
+
+    def test_zero_quota_is_zero_not_none(self, client, server):
+        server.service.chip_quota = 0
+        assert client.get_chip_quota() == 0
+
+    def test_min_across_limits_specificity_within(self, client, server):
+        """Each consumerQuotaLimits entry is an independently applicable
+        limit (effective = min across limits); regional-beats-default holds
+        only among one limit's buckets."""
+        server.service.chip_quota_metrics = [
+            {"metric": "tpu.googleapis.com/v5e_chips",
+             "consumerQuotaLimits": [
+                 {"quotaBuckets": [{"effectiveLimit": "16", "dimensions": {}}]},
+                 {"quotaBuckets": [
+                     {"effectiveLimit": "32",
+                      "dimensions": {"region": "us-central2"}}]},
+             ]},
+        ]
+        assert client.get_chip_quota() == 16
+
+    def test_quota_rides_its_own_transport(self, client, server):
+        """Production quota lives on serviceusage.googleapis.com, not the TPU
+        API host — the client must route the quota read via quota_transport."""
+        from k8s_runpod_kubelet_tpu.cloud import HttpTransport, TpuClient
+        server.service.chip_quota = 24
+        quota_t = HttpTransport(server.base_url, token="t", sleep=lambda s: None)
+        # main transport points at a dead port: CRUD would fail, quota must not
+        dead_t = HttpTransport("http://127.0.0.1:1", token="t",
+                               sleep=lambda s: None)
+        c = TpuClient(dead_t, project="test-proj", zone="us-central2-b",
+                      quota_transport=quota_t)
+        assert c.get_chip_quota() == 24
+
+    def test_permission_denied_degrades_to_none(self):
+        """Real GCP answers 403 (SERVICE_DISABLED / missing
+        serviceusage.quotas.get) when the quota surface isn't usable — same
+        degrade-to-configured-ceiling path as 404."""
+        from k8s_runpod_kubelet_tpu.cloud.transport import TransportError
+
+        class Denied:
+            def request(self, *a, **k):
+                raise TransportError("GET: HTTP 403", status=403,
+                                     body="SERVICE_DISABLED")
+        c = TpuClient(Denied(), project="p", zone="us-central2-b")
+        assert c.get_chip_quota() is None
+
+    def test_quota_read_fails_fast(self):
+        """The quota read rides ping()/readyz: one attempt, short timeout —
+        a serviceusage outage must not block readiness for the transport's
+        full retry budget."""
+        seen = {}
+
+        class Spy:
+            def request(self, method, path, **kw):
+                seen.update(kw)
+                return {"metrics": []}
+        c = TpuClient(Spy(), project="p", zone="us-central2-b")
+        assert c.get_chip_quota() is None
+        assert seen["max_retries"] == 1
+        assert seen["timeout_s"] <= 5.0
+
+    def test_quota_listing_paginated(self):
+        """consumerQuotaMetrics is a paginated list API — chip metrics past
+        page 1 must be read (bounded pages)."""
+        pages = {
+            "": {"metrics": [
+                {"metric": "tpu.googleapis.com/default_requests",
+                 "consumerQuotaLimits": [{"quotaBuckets": [
+                     {"effectiveLimit": "600", "dimensions": {}}]}]}],
+                "nextPageToken": "p2"},
+            "p2": {"metrics": [
+                {"metric": "tpu.googleapis.com/v5e_chips",
+                 "consumerQuotaLimits": [{"quotaBuckets": [
+                     {"effectiveLimit": "32", "dimensions": {}}]}]}]},
+        }
+
+        class Paged:
+            def request(self, method, path, **kw):
+                token = path.split("pageToken=")[1] if "pageToken=" in path else ""
+                return pages[token]
+        c = TpuClient(Paged(), project="p", zone="us-central2-b")
+        assert c.get_chip_quota() == 32
