@@ -825,12 +825,19 @@ def _last_json_line(text: str) -> dict | None:
 
 
 def _probe_tpu() -> tuple[bool, str]:
-    """Can a child process even initialize the TPU backend? Bounded by
-    _PROBE_TIMEOUT_S so a hung tunnel costs minutes, not attempt-timeouts.
-    Returns (ok, diagnostic) — the stderr tail distinguishes a hang from a
-    deterministic init error."""
+    """Can a child process initialize the TPU backend AND run one tiny
+    computation on it? Bounded by _PROBE_TIMEOUT_S so a hung tunnel costs
+    minutes, not attempt-timeouts. The compute check matters: a half-up
+    tunnel can enumerate devices fine while the compile/execute channel is
+    dead (observed r4: headline died 26 min in with 'UNAVAILABLE: TPU
+    backend setup/compile error' after a clean init probe) — device init
+    alone would keep reporting UP and feed every staged step to the same
+    slow death. Returns (ok, diagnostic)."""
     code = ("import jax, sys; "
-            "sys.exit(0 if jax.default_backend() == 'tpu' else 1)")
+            "sys.exit(1) if jax.default_backend() != 'tpu' else None; "
+            "import jax.numpy as jnp; "
+            "v = int(jax.jit(lambda x: (x + 1).sum())(jnp.zeros((8, 8)))); "
+            "sys.exit(0 if v == 64 else 1)")
     try:
         proc = subprocess.run([sys.executable, "-c", code],
                               capture_output=True, text=True,
