@@ -765,21 +765,35 @@ def run_mfu_sweep() -> int:
     # full_b16 (12.6GB; "full" recomputes activations, buying batch — its
     # XLA roofline bound is 20% above dots_b8's), 530m_full_b8 (14.4GB).
     # full_b20 interpolates toward full_b32's refusal point (18.2GB).
+    from __graft_entry__ import _bench_config_v128k
     points = [
-        ("260m_dots_b8", base, 8),                       # r2 best: MFU .318
+        ("260m_dots_b8", base, 8, 0),                    # r2 best: MFU .318
         ("260m_full_b16",
-         dataclasses.replace(base, remat_policy="full"), 16),
+         dataclasses.replace(base, remat_policy="full"), 16, 0),
         ("260m_full_b20",
-         dataclasses.replace(base, remat_policy="full"), 20),
+         dataclasses.replace(base, remat_policy="full"), 20, 0),
         ("530m_full_b8",
-         dataclasses.replace(wider_530m(), remat_policy="full"), 8),
+         dataclasses.replace(wider_530m(), remat_policy="full"), 8, 0),
+        # fused chunked CE (ops/fused_ce.py): logits never materialize.
+        # Fit criterion: these cells COMPILED under the v5e compiler's
+        # 15.75G buffer-assignment budget (aot_v5e.json train_260m_fce8_*,
+        # compile_ok) — the authoritative check; the JSON's fits_16gb
+        # estimator double-counts donated/scan buffers and flags them
+        # false. XLA cost-model rooflines are NOT comparable across these
+        # cells either (scan bodies counted once) — chip wall-clock decides.
+        ("260m_fce8_dots_b8", base, 8, 8),
+        ("260m_fce8_full_b24",
+         dataclasses.replace(base, remat_policy="full"), 24, 8),
+        # Llama-3's real 128k vocab: the naive loss refuses at B=8 on v5e
+        # (4.2GB bf16 logits); fused is the only way to run this geometry
+        ("v128k_fce16_b8", _bench_config_v128k(), 8, 16),
     ]
     results = []
-    for label, cfg, batch in points:
+    for label, cfg, batch, fce in points:
         trainer = None
         try:
             tc = TrainConfig(batch_size=batch, seq_len=2048, steps=20,
-                             warmup_steps=1)
+                             warmup_steps=1, fused_ce_chunks=fce)
             trainer = Trainer(cfg, tc)
             batches = synthetic_batches(cfg, tc)
             trainer.run(steps=3, batches=batches)       # compile + warm

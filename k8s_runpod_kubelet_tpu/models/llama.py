@@ -627,10 +627,14 @@ class LlamaModel:
 
     def forward(self, params: Params, tokens: jax.Array,
                 positions: Optional[jax.Array] = None,
-                with_aux: bool = False):
+                with_aux: bool = False, return_hidden: bool = False):
         """tokens (B, S) int32 -> logits (B, S, V).
         ``with_aux=True`` additionally returns the summed (pre-scaled) router
-        aux loss — nonzero only for MoE configs; add it to the train loss."""
+        aux loss — nonzero only for MoE configs; add it to the train loss.
+        ``return_hidden=True`` stops BEFORE the LM head and returns the
+        final-norm hidden states (B, S, E) instead of logits — the input the
+        chunked fused cross-entropy (ops/fused_ce.py) consumes so the (B, S,
+        V) logits tensor never materializes."""
         cfg, mesh = self.cfg, self.mesh
         ropes = _rope_tables(cfg)
         x = _embed(params, tokens, cfg, mesh)
@@ -708,6 +712,10 @@ class LlamaModel:
             x, aux_layers = jax.lax.scan(body, x,
                                          _group_layers(params["layers"], pat))
         x = rms_norm(x, _norm_w(params["final_norm"], cfg), cfg.norm_eps)
+        if return_hidden:
+            if with_aux:
+                return x, jnp.sum(aux_layers)
+            return x
         logits = _head_logits(x, params, cfg)
         logits = _constrain(logits, mesh, ("batch", "seq", "act_vocab"))
         if with_aux:

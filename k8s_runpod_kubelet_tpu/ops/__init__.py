@@ -12,6 +12,7 @@ from .rmsnorm import rms_norm
 from .rope import apply_rope, rope_frequencies
 from .attention import flash_attention
 from .ring_attention import ring_attention
+from .fused_ce import fused_cross_entropy
 
 __all__ = ["rms_norm", "apply_rope", "rope_frequencies", "flash_attention",
-           "ring_attention"]
+           "ring_attention", "fused_cross_entropy"]

@@ -1,0 +1,213 @@
+"""Chunked-vocab fused cross-entropy: the LM-head loss without the logits.
+
+The train step's single largest activation is the (B, S, V) logits tensor —
+at the 260M bench geometry (B=8, S=2048, V=32k) that is ~1 GB bf16 from the
+head matmul plus ~2.1 GB once the naive loss upcasts to f32, all of it HBM
+traffic on both passes. The reference has no training stack at all
+(SURVEY.md §2.4 absence table; it ships opaque container images,
+runpod_client.go:1334-1342), so this op is net-new TPU capability: compute
+
+    ce  = mean_n( logsumexp_v(h_n · W) - (h_n · W)[t_n] )
+    z   = z_loss_coef * mean_n( logsumexp_v(h_n · W)^2 )
+
+by streaming the vocab axis in chunks — an online (max, sumexp) reduction
+exactly like flash attention's — so no (N, V) tensor ever exists. The
+backward pass recomputes each chunk's logits from the saved logsumexp
+(softmax_k = exp(logits_k - lse)), trading one extra head-matmul pass for
+the 3 GB of logits HBM, which is the right trade on an HBM-bound profile
+(the r4 AOT sweep: "full" remat beating "dots" for the same reason).
+
+Supports the tied head (W = tok_embed^T, scanned over embedding ROWS so no
+transposed copy is materialized), the untied (E, V) lm_head, and Gemma-2's
+tanh logit softcap (whose exact Jacobian 1 - (logits/cap)^2 rides the
+recompute). Pure XLA — chunk matmuls are MXU-shaped (N x E x V/K) and the
+online reduction fuses into their epilogues; a Pallas kernel would only
+re-schedule what the compiler already streams here.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["fused_cross_entropy"]
+
+
+def _pick_chunks(v: int, requested: int) -> int:
+    """Largest chunk count <= requested that divides the vocab evenly (static
+    shapes: every chunk matmul must be identical for one compiled program)."""
+    for k in range(min(requested, v), 0, -1):
+        if v % k == 0:
+            return k
+    return 1
+
+
+def _chunk_logits(h2: jax.Array, head, start, size: int,
+                  softcap: Optional[float]) -> jax.Array:
+    """f32 logits for vocab slice [start, start+size): one MXU matmul with
+    f32 accumulation (strictly better numerics than the naive path's
+    bf16-matmul-then-upcast). ``head`` is ("tied", tok_embed (V, E)) or
+    ("untied", lm_head (E, V)); the tied path slices ROWS so the (V, E)
+    table is never transposed into a copy. ``start`` may be a tracer
+    (lax.scan chunk index)."""
+    kind, w = head
+    if kind == "tied":
+        wk = jax.lax.dynamic_slice_in_dim(w, start, size, axis=0)
+        spec = "ne,ve->nv"
+    else:
+        wk = jax.lax.dynamic_slice_in_dim(w, start, size, axis=1)
+        spec = "ne,ev->nv"
+    # cast the slice to the COMPUTE dtype (matches _head_logits, llama.py
+    # _mm: param_dtype may be f32 while activations are bf16 — without the
+    # cast the einsum promotes to an f32 MXU matmul at ~1/6 throughput on
+    # exactly the large-vocab geometry this op exists for); accumulation
+    # stays f32 via preferred_element_type
+    logits = jnp.einsum(spec, h2, wk.astype(h2.dtype),
+                        preferred_element_type=jnp.float32)
+    if softcap:
+        cap = jnp.float32(softcap)
+        logits = jnp.tanh(logits / cap) * cap
+    return logits
+
+
+def _fwd_scan(h2, head, targets, n_chunks, softcap):
+    """Online logsumexp + target-logit pick, lax.scan'd over vocab chunks.
+
+    A scan (not a Python unroll) is load-bearing for memory: it forces the
+    chunks to execute sequentially, so exactly ONE (N, V/K) logits block is
+    live at a time — unrolled, XLA's scheduler may overlap chunks and peak
+    at several blocks, eating the very HBM this op exists to free (observed
+    in the first AOT pass: fused dots_b8 peaked ABOVE the naive cell)."""
+    n = h2.shape[0]
+    kind, w = head
+    v = w.shape[0] if kind == "tied" else w.shape[1]
+    size = v // n_chunks
+
+    def body(carry, k):
+        m, s, picked = carry
+        start = k * size
+        logits = _chunk_logits(h2, head, start, size, softcap)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1)
+        idx = targets - start
+        in_chunk = (idx >= 0) & (idx < size)
+        got = jnp.take_along_axis(
+            logits, jnp.clip(idx, 0, size - 1)[:, None], axis=-1)[:, 0]
+        picked = picked + jnp.where(in_chunk, got, 0.0)
+        return (m_new, s, picked), None
+
+    init = (jnp.full((n,), -jnp.inf, jnp.float32),   # running max
+            jnp.zeros((n,), jnp.float32),            # sumexp rescaled to max
+            jnp.zeros((n,), jnp.float32))            # picked target logit
+    (m, s, picked), _ = jax.lax.scan(body, init, jnp.arange(n_chunks))
+    lse = m + jnp.log(s)
+    return lse, picked
+
+
+def _ce_z(lse, picked, z_loss_coef):
+    ce = jnp.mean(lse - picked)
+    z = (jnp.float32(z_loss_coef) * jnp.mean(jnp.square(lse))
+         if z_loss_coef else jnp.float32(0.0))
+    return ce, z
+
+
+def fused_cross_entropy(hidden: jax.Array, head_w: jax.Array,
+                        targets: jax.Array, *, tied: bool = False,
+                        z_loss_coef: float = 0.0,
+                        logit_softcap: Optional[float] = None,
+                        n_chunks: int = 8) -> tuple[jax.Array, jax.Array]:
+    """(mean NLL, z-loss) of softmax(hidden @ head) vs targets, never
+    materializing the (N, V) logits.
+
+    hidden (..., E); targets (...) int32 matching hidden's leading shape;
+    head_w is lm_head (E, V), or tok_embed (V, E) with ``tied=True``.
+    Semantics match workloads.train._ce_and_zloss (one shared logsumexp
+    reduction feeding both terms); numerics differ only by the f32 matmul
+    accumulation. Differentiable in hidden and head_w.
+    """
+    n_chunks = _pick_chunks(head_w.shape[0] if tied else head_w.shape[1],
+                            n_chunks)
+    h2 = hidden.reshape(-1, hidden.shape[-1])
+    t1 = targets.reshape(-1)
+    kind = "tied" if tied else "untied"
+    return _fused_ce(h2, head_w, t1, kind, float(z_loss_coef),
+                     logit_softcap, n_chunks)
+
+
+# ``kind``/``z_loss_coef``/``softcap``/``n_chunks`` are static (hashable)
+# config, not tracers: nondiff_argnums keeps them out of differentiation
+# and lets the chunk loop unroll at trace time.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _fused_ce(h2, w, t1, kind, z_loss_coef, softcap, n_chunks):
+    lse, picked = _fwd_scan(h2, (kind, w), t1, n_chunks, softcap)
+    return _ce_z(lse, picked, z_loss_coef)
+
+
+def _fce_fwd(h2, w, t1, kind, z_loss_coef, softcap, n_chunks):
+    lse, picked = _fwd_scan(h2, (kind, w), t1, n_chunks, softcap)
+    return _ce_z(lse, picked, z_loss_coef), (h2, w, t1, lse)
+
+
+def _fce_bwd(kind, z_loss_coef, softcap, n_chunks, res, cts):
+    """Recompute each chunk's logits from the saved lse; one lax.scan'd pass
+    (sequential — see _fwd_scan on why) produces d_hidden (carry-accumulated)
+    and d_head (written chunk-by-chunk into the full-size buffer via
+    dynamic_update_slice, so no stacked (K, ...) copy + concatenate)."""
+    h2, w, t1, lse = res
+    g_ce, g_z = cts
+    n = h2.shape[0]
+    v = w.shape[0] if kind == "tied" else w.shape[1]
+    size = v // n_chunks
+    inv_n = 1.0 / n
+    # d(loss)/d(logits)[n, v] = softmax * (g_ce + 2*coef*lse_n*g_z)/N
+    #                           - onehot[target] * g_ce/N
+    row_coef = (g_ce + (2.0 * z_loss_coef) * lse * g_z) * inv_n   # (N,)
+    g_pick = g_ce * inv_n
+    head = (kind, w)
+    axis = 0 if kind == "tied" else 1
+    rows = jnp.arange(n)
+
+    def body(carry, k):
+        dh, dw = carry
+        start = k * size
+        logits = _chunk_logits(h2, head, start, size, softcap)
+        d_logits = jnp.exp(logits - lse[:, None]) * row_coef[:, None]
+        # the -onehot term as a scatter-add: no (N, V/K) one-hot tensor
+        idx = t1 - start
+        in_chunk = (idx >= 0) & (idx < size)
+        d_logits = d_logits.at[rows, jnp.clip(idx, 0, size - 1)].add(
+            jnp.where(in_chunk, -g_pick, 0.0))
+        if softcap:
+            # chain through cap*tanh(x/cap): logits here are POST-cap, so
+            # the Jacobian is exactly 1 - (logits/cap)^2
+            d_logits = d_logits * (1.0 - jnp.square(logits / softcap))
+        # bf16 operands for the two grad matmuls (f32 accumulation via
+        # preferred_element_type) — same dtype discipline as the forward
+        d16 = d_logits.astype(h2.dtype)
+        if kind == "tied":
+            wk = jax.lax.dynamic_slice_in_dim(w, start, size, axis=0)
+            dh = dh + jnp.einsum("nv,ve->ne", d16, wk.astype(h2.dtype),
+                                 preferred_element_type=jnp.float32)
+            dwk = jnp.einsum("nv,ne->ve", d16, h2,
+                             preferred_element_type=jnp.float32)
+        else:
+            wk = jax.lax.dynamic_slice_in_dim(w, start, size, axis=1)
+            dh = dh + jnp.einsum("nv,ev->ne", d16, wk.astype(h2.dtype),
+                                 preferred_element_type=jnp.float32)
+            dwk = jnp.einsum("ne,nv->ev", h2, d16,
+                             preferred_element_type=jnp.float32)
+        dw = jax.lax.dynamic_update_slice_in_dim(dw, dwk.astype(w.dtype),
+                                                 start, axis=axis)
+        return (dh, dw), None
+
+    init = (jnp.zeros(h2.shape, jnp.float32),
+            jnp.zeros(w.shape, w.dtype))
+    (dh, dw), _ = jax.lax.scan(body, init, jnp.arange(n_chunks))
+    return dh.astype(h2.dtype), dw, None   # no cotangent for int targets
+
+
+_fused_ce.defvjp(_fce_fwd, _fce_bwd)

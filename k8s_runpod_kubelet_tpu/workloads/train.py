@@ -42,6 +42,12 @@ class TrainConfig:
     # batches in fixed activation memory (activations scale with the
     # microbatch, optimizer cost is unchanged)
     grad_accum_steps: int = 1
+    # >0: compute the LM-head loss with the chunked fused cross-entropy
+    # (ops/fused_ce.py) streaming the vocab in this many chunks — the
+    # (B, S, V) logits tensor never materializes, freeing its HBM for batch.
+    # Costs one extra head-matmul pass in backward (recompute), the same
+    # trade remat "full" makes for the transformer stack.
+    fused_ce_chunks: int = 0
     checkpoint_dir: str = ""
     checkpoint_every: int = 1000
 
@@ -91,7 +97,8 @@ def make_optimizer(tc: TrainConfig, trainable_mask=None
 
 def make_train_step(model: LlamaModel, optimizer: optax.GradientTransformation,
                     donate: bool = True, trainable_mask=None,
-                    grad_accum_steps: int = 1, z_loss_coef: float = 0.0):
+                    grad_accum_steps: int = 1, z_loss_coef: float = 0.0,
+                    fused_ce_chunks: int = 0):
     """Returns jitted (params, opt_state, batch) -> (params, opt_state, metrics).
     batch: tokens (B, S+1) — inputs are [:, :-1], targets [:, 1:].
     ``trainable_mask``: frozen (False) leaves are stop_gradient'd INSIDE the
@@ -110,7 +117,26 @@ def make_train_step(model: LlamaModel, optimizer: optax.GradientTransformation,
                     p, trainable_mask)
             # optimize CE + router aux (+ z-loss), but report CE separately
             # so MoE/z-loss loss curves stay comparable (exp(loss) = ppl)
-            if model.cfg.n_experts:
+            cfg = model.cfg
+            head = p.get("tok_embed") if cfg.tie_embeddings else p.get("lm_head")
+            # the fused path needs a plain-array head: a LoRA/quant dict leaf
+            # (models/lora.py, models/quant.py) falls back to the naive loss
+            # — a trace-time (static) decision, no runtime branch
+            if fused_ce_chunks and not isinstance(head, dict):
+                from ..ops.fused_ce import fused_cross_entropy
+                if cfg.n_experts:
+                    hidden, aux = model.forward(p, inputs, with_aux=True,
+                                                return_hidden=True)
+                else:
+                    hidden = model.forward(p, inputs, return_hidden=True)
+                    aux = jnp.float32(0.0)
+                ce, z = fused_cross_entropy(
+                    hidden, head, targets, tied=cfg.tie_embeddings,
+                    z_loss_coef=z_loss_coef,
+                    logit_softcap=cfg.logit_softcap,
+                    n_chunks=fused_ce_chunks)
+                return ce + aux + z, (ce, aux)
+            if cfg.n_experts:
                 logits, aux = model.forward(p, inputs, with_aux=True)
             else:
                 logits = model.forward(p, inputs)
@@ -239,7 +265,8 @@ class Trainer:
         self.step_fn = make_train_step(self.model, self.optimizer,
                                        trainable_mask=mask,
                                        grad_accum_steps=tc.grad_accum_steps,
-                                       z_loss_coef=tc.z_loss_coef)
+                                       z_loss_coef=tc.z_loss_coef,
+                                       fused_ce_chunks=tc.fused_ce_chunks)
         self.step = 0
         self._eval_fn = None
         self._ckpt = None

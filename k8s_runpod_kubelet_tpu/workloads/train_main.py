@@ -44,6 +44,10 @@ def main(argv=None) -> int:
                    help="0 or -1 = auto: all non-tp/sp/pp devices")
     p.add_argument("--grad-accum", type=int, default=1,
                    help="microbatches per optimizer step (grad accumulation)")
+    p.add_argument("--fused-ce-chunks", type=int, default=0,
+                   help="stream the LM-head loss over this many vocab chunks "
+                        "(0 = materialize logits); frees the (B,S,V) logits "
+                        "HBM for batch at one extra head matmul in backward")
     p.add_argument("--eval-steps", type=int, default=0,
                    help="run a held-out eval of this many batches at the end "
                         "(and report eval_loss/eval_ppl)")
@@ -134,6 +138,7 @@ def main(argv=None) -> int:
                      seq_len=args.seq_len, steps=args.steps,
                      z_loss_coef=args.z_loss,
                      grad_accum_steps=args.grad_accum,
+                     fused_ce_chunks=args.fused_ce_chunks,
                      checkpoint_dir=args.checkpoint_dir,
                      checkpoint_every=args.checkpoint_every)
     initial = None

@@ -1,0 +1,177 @@
+"""Chunked fused cross-entropy (ops/fused_ce.py): parity vs the naive loss,
+gradients through custom VJP, head variants (untied / tied / softcap), and
+the sharded train-step integration.
+
+Net-new TPU capability (SURVEY.md §2.4: the reference has no training code);
+the parity target is workloads.train._ce_and_zloss, the naive loss these
+tests prove it can replace without changing semantics.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_runpod_kubelet_tpu.ops.fused_ce import _pick_chunks, fused_cross_entropy
+from k8s_runpod_kubelet_tpu.workloads.train import _ce_and_zloss
+
+
+def _mk(b=2, s=16, e=32, v=96, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 4)
+    h = jax.random.normal(ks[0], (b, s, e), jnp.float32)
+    wu = jax.random.normal(ks[1], (e, v), jnp.float32) * 0.1
+    wt = jax.random.normal(ks[2], (v, e), jnp.float32) * 0.1
+    t = jax.random.randint(ks[3], (b, s), 0, v)
+    return h, wu, wt, t
+
+
+CASES = [
+    ("untied", False, None, 0.0),
+    ("tied", True, None, 1e-4),
+    ("softcap", False, 30.0, 1e-4),
+    ("tied_softcap", True, 30.0, 0.0),  # Gemma shape: tied + capped
+]
+
+
+class TestParity:
+    @pytest.mark.parametrize("name,tied,cap,coef", CASES)
+    def test_values_and_grads(self, name, tied, cap, coef):
+        h, wu, wt, t = _mk()
+        w = wt if tied else wu
+
+        def naive(h, w):
+            logits = h @ (w.T if tied else w)
+            if cap:
+                logits = jnp.tanh(logits / cap) * cap
+            return _ce_and_zloss(logits, t, coef)
+
+        def fused(h, w):
+            return fused_cross_entropy(h, w, t, tied=tied, z_loss_coef=coef,
+                                       logit_softcap=cap, n_chunks=6)
+
+        ce0, z0 = naive(h, w)
+        ce1, z1 = jax.jit(fused)(h, w)
+        np.testing.assert_allclose(ce0, ce1, rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(z0, z1, rtol=2e-5, atol=2e-5)
+
+        g0 = jax.grad(lambda h, w: sum(naive(h, w)), argnums=(0, 1))(h, w)
+        g1 = jax.grad(lambda h, w: sum(fused(h, w)), argnums=(0, 1))(h, w)
+        for a, b in zip(g0, g1):
+            np.testing.assert_allclose(a, b, rtol=3e-4, atol=3e-5)
+
+    def test_single_chunk_degenerates_to_naive(self):
+        h, wu, _, t = _mk()
+        ce0, _ = _ce_and_zloss(h @ wu, t, 0.0)
+        ce1, _ = fused_cross_entropy(h, wu, t, n_chunks=1)
+        np.testing.assert_allclose(ce0, ce1, rtol=2e-5, atol=2e-5)
+
+    def test_chunks_pick_divisor(self):
+        # 96 is not divisible by 7 -> falls back to 6, result unchanged
+        assert _pick_chunks(96, 7) == 6
+        assert _pick_chunks(96, 8) == 8
+        assert _pick_chunks(97, 8) == 1  # prime vocab: single chunk
+        h, wu, _, t = _mk()
+        ce_a, _ = fused_cross_entropy(h, wu, t, n_chunks=7)
+        ce_b, _ = fused_cross_entropy(h, wu, t, n_chunks=6)
+        np.testing.assert_allclose(ce_a, ce_b, rtol=1e-6)
+
+    def test_bf16_inputs(self):
+        """Deployment dtype: fused f32-accumulated matmul vs naive bf16
+        matmul agree to bf16 tolerance."""
+        h, wu, _, t = _mk(v=128)
+        hb, wb = h.astype(jnp.bfloat16), wu.astype(jnp.bfloat16)
+        ce0, _ = _ce_and_zloss(hb @ wb, t, 0.0)
+        ce1, _ = fused_cross_entropy(hb, wb, t, n_chunks=4)
+        np.testing.assert_allclose(float(ce0), float(ce1), rtol=2e-2)
+
+
+class TestTrainStepIntegration:
+    def _train(self, fused_chunks, mesh=None, n_steps=3):
+        from k8s_runpod_kubelet_tpu.models import tiny_llama
+        from k8s_runpod_kubelet_tpu.workloads.train import (
+            TrainConfig, Trainer, synthetic_batches)
+        cfg = tiny_llama(vocab_size=96, embed_dim=64, n_layers=2, n_heads=4,
+                         n_kv_heads=2, mlp_dim=128, max_seq_len=64,
+                         dtype=jnp.float32, param_dtype=jnp.float32)
+        tc = TrainConfig(batch_size=4, seq_len=32, steps=n_steps,
+                         warmup_steps=1, fused_ce_chunks=fused_chunks,
+                         z_loss_coef=1e-4)
+        tr = Trainer(cfg, tc, mesh=mesh, seed=0)
+        batches = synthetic_batches(cfg, tc, mesh, seed=0)
+        metrics = tr.run(steps=n_steps, batches=batches)
+        return metrics, tr.params
+
+    def test_fused_step_matches_naive(self):
+        """Same seed, same data: the fused and naive loss paths must produce
+        near-identical training trajectories (f32 model)."""
+        m0, p0 = self._train(0)
+        m1, p1 = self._train(4)
+        np.testing.assert_allclose(m0["final_loss"], m1["final_loss"],
+                                   rtol=1e-4, atol=1e-4)
+        for a, b in zip(jax.tree_util.tree_leaves(p0),
+                        jax.tree_util.tree_leaves(p1)):
+            np.testing.assert_allclose(a, b, rtol=5e-3, atol=5e-4)
+
+    @pytest.mark.slow
+    def test_fused_step_sharded(self):
+        """The fused path under a real mesh (fsdp x tensor): the head weight
+        is vocab-sharded, chunk slices cross shard boundaries — machine-check
+        compile + run + finite loss."""
+        from k8s_runpod_kubelet_tpu.parallel import MeshConfig, make_mesh
+        mesh = make_mesh(MeshConfig(data=2, fsdp=2, tensor=2, seq=1))
+        m, _ = self._train(4, mesh=mesh)
+        assert np.isfinite(m["final_loss"])
+
+    def test_moe_aux_still_reported(self):
+        from k8s_runpod_kubelet_tpu.models import tiny_moe
+        from k8s_runpod_kubelet_tpu.workloads.train import (
+            TrainConfig, Trainer, synthetic_batches)
+        cfg = tiny_moe(vocab_size=96, embed_dim=64, n_layers=2, n_heads=4,
+                       n_kv_heads=2, mlp_dim=128, max_seq_len=64,
+                       dtype=jnp.float32, param_dtype=jnp.float32)
+        tc = TrainConfig(batch_size=4, seq_len=32, steps=2, warmup_steps=1,
+                         fused_ce_chunks=4)
+        tr = Trainer(cfg, tc, seed=0)
+        batches = synthetic_batches(cfg, tc, seed=0)
+        tr.params, tr.opt_state, metrics = tr.step_fn(
+            tr.params, tr.opt_state, next(batches))
+        assert np.isfinite(float(metrics["loss"]))
+        assert float(metrics["aux_loss"]) > 0.0  # router aux flowed through
+
+
+class TestComputeDtype:
+    def test_mixed_dtype_matches_naive_head(self):
+        """Default config combination (param_dtype=f32, activations bf16):
+        the fused matmuls must cast the head slice to the COMPUTE dtype like
+        _head_logits does — not silently promote to f32 matmuls."""
+        h, wu, _, t = _mk(v=128)
+        hb = h.astype(jnp.bfloat16)          # activations bf16
+        wf = wu.astype(jnp.float32)          # params f32
+        ce0, _ = _ce_and_zloss(hb @ wf.astype(jnp.bfloat16), t, 0.0)
+        ce1, _ = fused_cross_entropy(hb, wf, t, n_chunks=4)
+        np.testing.assert_allclose(float(ce0), float(ce1), rtol=2e-2)
+        # grads flow and land in the PARAM dtype
+        g = jax.grad(lambda w: fused_cross_entropy(hb, w, t, n_chunks=4)[0])(wf)
+        assert g.dtype == jnp.float32
+        assert np.isfinite(np.asarray(g)).all()
+
+    def test_fused_matmuls_run_in_compute_dtype(self):
+        """The compiled fwd must contain NO f32xf32 head matmul when
+        activations are bf16 (the silent-promotion regression)."""
+        h, wu, _, t = _mk(v=128)
+        hb = h.astype(jnp.bfloat16)
+        wf = wu.astype(jnp.float32)
+        txt = jax.jit(lambda h, w: fused_cross_entropy(h, w, t, n_chunks=4)[0]
+                      ).lower(hb, wf).as_text()
+        # every dot must consume bf16 operands (f32 ACCUMULATION is fine and
+        # shows as an f32 result type) — an (f32, f32) operand pair means the
+        # weight slice was never cast and the matmul silently promoted
+        import re
+        dots = re.findall(
+            r"dot_general[^\n]*:\s*\(tensor<[^>]*x(f32|bf16)>,\s*"
+            r"tensor<[^>]*x(f32|bf16)>\)", txt)
+        assert dots, "no dot_general found in lowered fused CE"
+        for ops in dots:
+            assert ops != ("f32", "f32"), f"promoted head matmul: {ops}"

@@ -109,7 +109,7 @@ def _analyze(compiled, *, tokens_per_step=None, model_flops_per_tok=None):
     return rec
 
 
-def _train_step_program(cfg, batch: int, dev):
+def _train_step_program(cfg, batch: int, dev, fused_ce_chunks: int = 0):
     import jax
     import jax.numpy as jnp
     from jax.sharding import SingleDeviceSharding
@@ -125,7 +125,7 @@ def _train_step_program(cfg, batch: int, dev):
                                 jax.random.PRNGKey(0))
     opt_abs = jax.eval_shape(opt.init, params_abs)
     s = SingleDeviceSharding(dev)
-    step = make_train_step(model, opt)
+    step = make_train_step(model, opt, fused_ce_chunks=fused_ce_chunks)
     batch_abs = jax.ShapeDtypeStruct((batch, tc.seq_len + 1), jnp.int32,
                                      sharding=s)
     return step.lower(_sds_tree(params_abs, s), _sds_tree(opt_abs, s),
@@ -164,9 +164,38 @@ def check_train(results, dev):
         ("train_530m_full_b16",
          dataclasses.replace(wider_530m(), remat_policy="full"), 16),
     ]
-    for name, cfg, b in grid:
-        results[name] = _run(name, lambda cfg=cfg, b=b: _analyze(
-            _train_step_program(cfg, b, dev).compile(),
+    grid = [(name, cfg, b, 0) for name, cfg, b in grid]
+    # Fused-CE cells (ops/fused_ce.py): the (B, S, V) logits tensor never
+    # materializes — ~1GB bf16 + ~2.1GB f32 at the 260m geometry — so the
+    # same remat policy should fit meaningfully more batch. 8 chunks =
+    # 4096-wide vocab slices (MXU-friendly N x 1024 x 4096 matmuls).
+    grid += [
+        ("train_260m_fce8_dots_b8", base, 8, 8),
+        ("train_260m_fce8_dots_b12", base, 12, 8),
+        ("train_260m_fce8_dots_b16", base, 16, 8),
+        ("train_260m_fce8_full_b24",
+         dataclasses.replace(base, remat_policy="full"), 24, 8),
+        ("train_260m_fce8_full_b32",
+         dataclasses.replace(base, remat_policy="full"), 32, 8),
+        ("train_530m_fce8_full_b16",
+         dataclasses.replace(wider_530m(), remat_policy="full"), 16, 8),
+    ]
+    # The 128k-vocab pair: the geometry fused CE exists for. Same body as
+    # the 260m bench but Llama-3's real vocabulary — the naive loss's
+    # logits are 4.2 GB bf16 at B=8; expectation is naive refuses / fused
+    # fits, which is the memory-enabler claim stated as a compile boundary.
+    from __graft_entry__ import _bench_config_v128k
+    v128k = _bench_config_v128k()
+    grid += [
+        ("train_v128k_naive_b8", v128k, 8, 0),
+        ("train_v128k_fce16_b8", v128k, 8, 16),
+        ("train_v128k_fce16_b12", v128k, 12, 16),
+    ]
+    for name, cfg, b, chunks in grid:
+        results[name] = _run(name, lambda cfg=cfg, b=b, chunks=chunks:
+                             _analyze(
+            _train_step_program(cfg, b, dev, fused_ce_chunks=chunks)
+            .compile(),
             tokens_per_step=b * 2048,
             model_flops_per_tok=6.0 * cfg.param_count))
 
