@@ -87,6 +87,10 @@ _STAGED_QUEUE = [
     ("attn_tune", ["--attn-tune"], 2400),
     ("serve_8b", ["--serve", "--model", "llama3-8b", "--int8", "--kv-int8"],
      2400),
+    # int4 weights via the Pallas unpack kernel (ops/int4_matmul.py):
+    # weight HBM halves again vs int8 — the chip decides what that buys
+    ("serve_8b_int4",
+     ["--serve", "--model", "llama3-8b", "--int4", "--kv-int8"], 2400),
     ("econ", ["--econ"], 2400),
     ("ring_flash", ["--ring-flash"], 1800),
     ("spec_drift", ["--spec-drift"], 2400),
@@ -409,34 +413,35 @@ def _serve_model(name: str):
     return table[name]()
 
 
-def _serve_params(cfg, int8: bool):
+def _serve_params(cfg, bits: int):
     """DEVICE-ready param tree for serving benches, HBM-safe for 8B on one
     16GB v5e: big trees are built as HOST zeros (eval_shape + np.zeros =
-    copy-on-write pages, no 32GB resident). With ``int8`` the tree is
-    quantized leaf-by-leaf onto the device here — the full-precision tree
-    never sits in HBM next to the int8 copy (same strategy as serve_main
-    --int8); without it the zeros are device_put once (an un-quantized 8B
-    genuinely doesn't fit a 16GB chip — that OOM is honest and loud)."""
+    copy-on-write pages, no 32GB resident). With ``bits`` 8 or 4 the tree
+    is quantized leaf-by-leaf onto the device here — the full-precision
+    tree never sits in HBM next to the quantized copy (same strategy as
+    serve_main --int8/--int4); bits=0 device_puts the zeros once (an
+    un-quantized 8B genuinely doesn't fit a 16GB chip — that OOM is honest
+    and loud)."""
     import jax
     import numpy as np
     from k8s_runpod_kubelet_tpu.models import init_params
 
-    if not int8 and cfg.param_count < 1e9:
+    if not bits and cfg.param_count < 1e9:
         return init_params(cfg, jax.random.PRNGKey(0))
     shapes = jax.eval_shape(
         lambda: init_params(cfg, jax.random.PRNGKey(0)))
     host = jax.tree_util.tree_map(
         lambda sd: np.zeros(sd.shape, sd.dtype), shapes)
-    if int8:
+    if bits:
         from k8s_runpod_kubelet_tpu.models.quant import quantize_params
-        return quantize_params(cfg, host)
+        return quantize_params(cfg, host, bits=bits)
     return jax.device_put(host)
 
 
 def serve_once(model: str, *, slots: int, n_req: int, new_toks: int,
                cache_len: int, prompt_len: int, int8: bool, kv_int8: bool,
                speculate_k: int, donate: bool = True, params=None,
-               label: str = "") -> dict:
+               label: str = "", int4: bool = False) -> dict:
     """One serving measurement; returns the result dict (not emitted)."""
     import jax
     from k8s_runpod_kubelet_tpu.workloads.serving import (ServingConfig,
@@ -444,7 +449,7 @@ def serve_once(model: str, *, slots: int, n_req: int, new_toks: int,
 
     cfg = _serve_model(model)
     if params is None:
-        params = _serve_params(cfg, int8)
+        params = _serve_params(cfg, 4 if int4 else (8 if int8 else 0))
     # _serve_params already quantized when int8 (and _mm dispatches on the
     # leaf structure), so the engine must NOT quantize again — the flag
     # survives only as a record label
@@ -500,7 +505,7 @@ def serve_once(model: str, *, slots: int, n_req: int, new_toks: int,
         "new_tokens_per_request": new_toks,
         "cache_len": cache_len,
         "peak_queue_depth": peak_queue,
-        "int8": int8, "kv_int8": kv_int8,
+        "int8": int8, "int4": int4, "kv_int8": kv_int8,
         "speculate_k": speculate_k, "donate_cache": donate,
         "model": cfg.name, "params": cfg.param_count,
         "backend": jax.default_backend(),
@@ -531,7 +536,7 @@ def run_spec_drift() -> int:
     on_tpu = jax.default_backend() == "tpu"
     model = _arg_value("--model", "bench-260m" if on_tpu else "tiny")
     cfg = _serve_model(model)
-    params = _serve_params(cfg, int8=False)
+    params = _serve_params(cfg, 0)
     n_req, new_toks, prompt_len = (48, 64, 64) if on_tpu else (12, 16, 16)
     cache_len = 2048 if on_tpu else 128
 
@@ -598,13 +603,14 @@ def run_serve_bench(quick: bool) -> int:
     model = _arg_value("--model", "tiny" if tiny else "bench-260m")
     big = not tiny and model not in ("tiny", "bench-260m")
     # big-model slots: decode re-reads the whole weight tree every step, so
-    # tok/s scales with batch until HBM pushes back — AOT slot sweep
-    # (aot_v5e.json decode_8b_int8_kv8_slots*): 16 fits (roofline 2076
-    # tok/s, +14% over 8), 32 OOMs at 16.42G. The sweep validated EXACTLY
-    # llama3-8b + int8 weights + int8 KV; other big configs keep the
-    # conservative 8 (bf16 KV alone adds ~2.1GB at 16 slots)
-    swept_16 = (model == "llama3-8b" and "--int8" in sys.argv
-                and "--kv-int8" in sys.argv)
+    # tok/s scales with batch until HBM pushes back — AOT slot sweeps
+    # (aot_v5e.json): int8+int8KV 16 fits (roofline 2076, +14% over 8; 32
+    # OOMs at 16.42G); int4+int8KV via the Pallas kernel also fits 16
+    # (decode_8b_int4pk_kv8_slots16, bound 2292). The sweeps validated
+    # EXACTLY llama3-8b + {int8|int4} weights + int8 KV; other big configs
+    # keep the conservative 8 (bf16 KV alone adds ~2.1GB at 16 slots)
+    swept_16 = (model == "llama3-8b" and "--kv-int8" in sys.argv
+                and ("--int8" in sys.argv or "--int4" in sys.argv))
     if tiny:
         slots, n_req, new_toks = 4, 12, 16
     elif big:
@@ -619,6 +625,7 @@ def run_serve_bench(quick: bool) -> int:
                                  "128" if tiny else "2048" if big else "1024")),
         prompt_len=32 if not big else 128,
         int8="--int8" in sys.argv,
+        int4="--int4" in sys.argv,
         kv_int8="--kv-int8" in sys.argv,
         speculate_k=3 if "--speculate" in sys.argv else 0)
     _emit(rec)
@@ -654,7 +661,7 @@ def run_econ_bench() -> int:
     # one param tree for the whole matrix (int8 is constant across cells);
     # per-cell engines/caches/jits still rebuild, which is what's measured
     cfg = _serve_model(model)
-    params = _serve_params(cfg, int8)
+    params = _serve_params(cfg, 8 if int8 else 0)
     base_val = None
     for label, flags in cells:
         try:

@@ -426,6 +426,9 @@ def _mm(h, w, dtype):
     - int8 weight-only quant {"q8", "scale"} (models/quant.py): the dequant
       multiply sits in the matmul epilogue where XLA fuses it — HBM reads
       stay int8.
+    - int4 weight-only quant {"q4", "scale"}: two weights per uint8 byte,
+      group-wise scales along the contraction axis; unpack + dequant fuse
+      into the matmul operand load, so HBM reads stay at a quarter of bf16.
     - LoRA adapter {"w", "lora_a", "lora_b", "scale"} (models/lora.py): the
       base weight is stop_gradient'd so backward exists only for A/B."""
     if isinstance(w, dict):
@@ -433,8 +436,37 @@ def _mm(h, w, dtype):
             base = h @ jax.lax.stop_gradient(w["w"]).astype(dtype)
             delta = (h @ w["lora_a"].astype(dtype)) @ w["lora_b"].astype(dtype)
             return base + delta * w["scale"].astype(dtype)
+        if "q4" in w:
+            return _mm_int4(h, w, dtype)
         return (h @ w["q8"].astype(dtype)) * w["scale"].astype(dtype)
     return h @ w.astype(dtype)
+
+
+def _mm_int4(h, w, dtype):
+    """h (..., in) @ int4-packed weight -> (..., out).
+
+    q4 is (in/2, out) uint8 (low nibble = in-element 2i, high = 2i+1),
+    scale (g, 1, out) with g groups along the contraction axis
+    (quant.py _quantize_leaf_int4). Two design rules keep HBM reads at a
+    quarter of bf16 (the point of int4), both learned from the AOT cost
+    model refuting a first draft that hit 3x the int8 bytes:
+
+    - NO nibble interleave: a stack+reshape to restore in-element order is
+      a permute XLA materializes (the dequantized bf16 weights land in
+      HBM). Instead the low/high nibble planes each stay contiguous and
+      contract against h's even/odd strides — two half-depth matmuls whose
+      operand chains (byte load -> mask/shift -> cast) fuse.
+    - scales apply to the small per-group PARTIALS after the matmul, not
+      to the weights before it, so the only op on the big tensor is the
+      cast. Even/odd elements of one group share its scale (group size is
+      even), so the group axis survives the split intact.
+
+    Even so, XLA materializes the cast nibble planes (AOT-measured 9.0GB
+    accessed vs int8's 6.3GB at the 8B decode) — on TPU the matmul runs as
+    a Pallas kernel (ops/int4_matmul.py) that unpacks in VMEM; this module
+    keeps only the XLA fallback for CPU/interpret paths."""
+    from ..ops.int4_matmul import int4_matmul
+    return int4_matmul(h.astype(dtype), w["q4"], w["scale"])
 
 
 def _norm_w(w, cfg: LlamaConfig):

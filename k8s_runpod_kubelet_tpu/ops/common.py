@@ -10,9 +10,13 @@ import jax
 
 def use_pallas(flag: Optional[bool]) -> bool:
     """Auto-select the Pallas path: explicit flag wins; env kill-switch
-    (TPU_KUBELET_NO_PALLAS=1) next; else Pallas on TPU backends only."""
+    (TPU_KUBELET_NO_PALLAS=1) next; force-on (TPU_KUBELET_FORCE_PALLAS=1,
+    for AOT compiles against a device-less TPU topology where the default
+    backend is the CPU host) next; else Pallas on TPU backends only."""
     if flag is not None:
         return flag
     if os.environ.get("TPU_KUBELET_NO_PALLAS") == "1":
         return False
+    if os.environ.get("TPU_KUBELET_FORCE_PALLAS") == "1":
+        return True
     return jax.default_backend() == "tpu"

@@ -1,0 +1,139 @@
+"""Pallas TPU kernel: matmul against int4-packed weights, unpacked in VMEM.
+
+Why a kernel: XLA:TPU fuses epilogues into a dot but NOT elementwise
+producer chains into the dot's operands, so the nibble unpack
+(mask/shift/offset/cast) of `models/quant._quantize_leaf_int4` weights
+materializes somewhere between HBM and the MXU. The AOT cost model measured
+it (bench_results/aot_v5e.json): an interleave-based XLA path tripled the
+int8 decode bytes (19.6GB vs 6.3GB), and even the fusion-friendly even/odd
+split still accessed 9.0GB — the dequantized planes land in HBM. This
+kernel streams the PACKED bytes HBM->VMEM (Pallas double-buffers the
+innermost grid dim), unpacks in registers, and accumulates — HBM traffic is
+the int4 payload, a quarter of bf16 and half of int8, which is the whole
+point of 4-bit weights on a bandwidth-bound decode.
+
+Layout contract (quant.py): q4 (in/2, out) uint8 — in-element 2i in the low
+nibble, 2i+1 in the high; scale (g, 1, out) f32, one group per 128
+(INT4_GROUP) contraction elements. The kernel contracts h's even strides
+against the low-nibble plane and odd strides against the high plane — the
+planes stay contiguous (no interleave permute), and both halves of a group
+share its scale, applied to the per-group partial AFTER the matmul.
+
+Grid (n_out, g): out-tiles parallel, groups innermost/sequential; one
+scale group per in step keeps the scale application exact. Forward-only
+(serving decode/prefill); there is deliberately no VJP — training never
+sees int4 weights.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .common import use_pallas as _use_pallas
+
+__all__ = ["int4_matmul"]
+
+
+def _pick_block_out(out: int, cap: int = 512) -> int:
+    for b in range(min(cap, out), 127, -128):
+        if out % b == 0:
+            return b
+    return out  # out < 128 or no 128-multiple divisor: whole axis
+
+
+def _matmul_2d(h2, q4, scale, interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, kin = h2.shape
+    kin2, out = q4.shape
+    g = scale.shape[0]
+    half = kin2 // g
+    block_out = _pick_block_out(out)
+    # row blocks must tile (8, ...): pad the handful of decode rows up
+    pad = (-b) % 8
+    if pad:
+        h2 = jnp.pad(h2, ((0, pad), (0, 0)))
+    he = h2[:, 0::2].reshape(h2.shape[0], g, half).swapaxes(0, 1)  # (g, B, half)
+    ho = h2[:, 1::2].reshape(h2.shape[0], g, half).swapaxes(0, 1)
+    q4g = q4.reshape(g, half, out)
+    res = pl.pallas_call(
+        functools.partial(_kernel, n_in=g),
+        grid=(out // block_out, g),
+        in_specs=[
+            pl.BlockSpec((1, h2.shape[0], half), lambda i, j: (j, 0, 0)),
+            pl.BlockSpec((1, h2.shape[0], half), lambda i, j: (j, 0, 0)),
+            pl.BlockSpec((1, half, block_out), lambda i, j: (j, 0, i)),
+            pl.BlockSpec((1, 1, block_out), lambda i, j: (j, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((h2.shape[0], block_out), lambda i, j: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((h2.shape[0], out), h2.dtype),
+        scratch_shapes=[pltpu.VMEM((h2.shape[0], block_out), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(he, ho, q4g, scale)
+    return res[:b] if pad else res
+
+
+def _kernel(he_ref, ho_ref, q4_ref, scale_ref, o_ref, acc_ref, *, n_in: int):
+    # refs carry a leading singleton group axis from the blocked layout
+    import jax.experimental.pallas as pl
+
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # nibble math in int32: Mosaic has no 8-bit subi legalization (the
+    # first kernel draft died there); i32 ops are native and the tiles are
+    # register-resident anyway
+    q = q4_ref[0].astype(jnp.int32)                   # (half, out_t)
+    dt = he_ref.dtype
+    lo = ((q & 0xF) - 8).astype(dt)
+    hi = ((q >> 4) - 8).astype(dt)
+    part = (jax.lax.dot(he_ref[0], lo, preferred_element_type=jnp.float32)
+            + jax.lax.dot(ho_ref[0], hi, preferred_element_type=jnp.float32))
+    acc_ref[...] += part * scale_ref[0, 0, :]
+
+    @pl.when(j == n_in - 1)
+    def _flush():
+        o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+def int4_matmul(h: jax.Array, q4: jax.Array, scale: jax.Array,
+                use_pallas: Optional[bool] = None,
+                interpret: bool = False) -> jax.Array:
+    """h (..., in) @ packed int4 weight (in/2, out) -> (..., out).
+
+    Kernel path on TPU (or ``interpret=True`` anywhere); XLA even/odd-split
+    fallback otherwise — same contraction order and f32 accumulation/scale
+    discipline (the fallback simply computes in f32 end to end, exact for
+    the integer nibbles), so the two paths agree to the final h.dtype
+    rounding; used by tests as the parity reference and by CPU/sharded
+    paths."""
+    kin = h.shape[-1]
+    kin2, out = q4.shape
+    g = scale.shape[0]
+    if _use_pallas(use_pallas) or interpret:
+        h2 = h.reshape(-1, kin)
+        res = _matmul_2d(h2, q4, scale, interpret)
+        return res.reshape(*h.shape[:-1], out)
+    half = kin2 // g
+    # fallback compute in f32 throughout: exact for the integer nibbles,
+    # matches the kernel's f32 accumulation, and sidesteps CPU dot thunks
+    # that reject mixed bf16-operand/f32-result dots; the cast back to
+    # h.dtype is the only rounding
+    lo = ((q4 & 0xF).astype(jnp.int8) - 8).astype(jnp.float32)
+    hi = ((q4 >> 4).astype(jnp.int8) - 8).astype(jnp.float32)
+    hf = h.astype(jnp.float32)
+    he = hf[..., 0::2].reshape(*h.shape[:-1], g, half)
+    ho = hf[..., 1::2].reshape(*h.shape[:-1], g, half)
+    part = (jnp.einsum("...gk,gko->...go", he, lo.reshape(g, half, out))
+            + jnp.einsum("...gk,gko->...go", ho, hi.reshape(g, half, out)))
+    return jnp.einsum("...go,go->...o", part, scale[:, 0, :]).astype(h.dtype)

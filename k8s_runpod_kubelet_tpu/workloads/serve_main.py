@@ -772,6 +772,11 @@ def main(argv=None) -> int:
     p.add_argument("--int8", action="store_true",
                    help="weight-only int8 quantization (halves decode HBM "
                         "traffic; JetStream-style serving optimization)")
+    p.add_argument("--int4", action="store_true",
+                   help="weight-only int4 quantization (group-wise scales, "
+                        "two weights per byte): quarter decode weight "
+                        "traffic — the rung after --int8; run an eval "
+                        "before production, 4-bit costs more accuracy")
     p.add_argument("--kv-int8", action="store_true",
                    help="int8 KV cache with per-position scales (halves "
                         "cache HBM traffic and doubles slot capacity)")
@@ -831,16 +836,20 @@ def main(argv=None) -> int:
     from .tokenizer import get_tokenizer
     tokenizer = get_tokenizer(args.tokenizer)  # before the expensive load:
     # a bad --tokenizer path must fail fast, not after minutes of weights
+    if args.int8 and args.int4:
+        log.error("--int8 and --int4 are mutually exclusive — pick one "
+                  "weight precision")
+        return 1
     mesh = None
     if args.tensor_parallel > 1:
         # fail-fast BEFORE the expensive weight load, like the tokenizer
         # check above
         from ..parallel import MeshConfig, make_mesh
         n = args.tensor_parallel
-        if args.int8:
-            log.error("--tensor-parallel does not compose with --int8 yet "
-                      "(quantized {q8, scale} leaves have no logical-axis "
-                      "rules); serve sharded in bf16")
+        if args.int8 or args.int4:
+            log.error("--tensor-parallel does not compose with --int8/--int4 "
+                      "yet (quantized {q8/q4, scale} leaves have no "
+                      "logical-axis rules); serve sharded in bf16")
             return 1
         if cfg.n_kv_heads % n or cfg.n_heads % n:
             log.error("--tensor-parallel %d must divide the model's head "
@@ -861,10 +870,11 @@ def main(argv=None) -> int:
             from ..parallel import param_shardings
             params = jax.device_put(
                 params, param_shardings(mesh, param_logical_axes(cfg)))
-        elif not args.int8:
+        elif not (args.int8 or args.int4):
             # one device_put (serving is single-host per replica); with
-            # --int8 the engine quantizes from host instead, so the
-            # full-precision tree never occupies HBM next to the int8 copy
+            # --int8/--int4 the engine quantizes from host instead, so the
+            # full-precision tree never occupies HBM next to the quantized
+            # copy
             params = jax.device_put(params)
     else:
         params = init_params(cfg, jax.random.PRNGKey(0), mesh)
@@ -873,6 +883,7 @@ def main(argv=None) -> int:
         max_new_tokens=args.max_new_tokens,
         max_prefill_len=args.cache_len // 2,
         quantize_int8=args.int8,
+        quantize_int4=args.int4,
         quantize_kv_int8=args.kv_int8,
         lora_rank=args.lora_rank,
         lora_targets=tuple(t for t in args.lora_targets.split(",") if t),

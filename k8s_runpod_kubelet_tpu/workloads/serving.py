@@ -60,6 +60,12 @@ class ServingConfig:
     quantize_int8: bool = False  # weight-only int8 (models/quant.py): halves
                                  # weight HBM traffic on the bandwidth-bound
                                  # decode step
+    # weight-only int4 (two weights per byte, group-wise scales): quarter
+    # weight HBM traffic — the next rung after int8 on the decode-bandwidth
+    # ladder. Accuracy drops more than int8's (4-bit resolution); the tiny
+    # pinned model stays argmax-stable in tests, real models deserve an
+    # eval before production. Mutually exclusive with quantize_int8.
+    quantize_int4: bool = False
     # speculative decoding via prompt-lookup (n-gram) proposals: draft this
     # many tokens per decode step and verify them in ONE forward pass
     # (models/llama.py verify_step). Greedy slots commit every matched draft
@@ -284,15 +290,19 @@ class ServingEngine:
         # and the KV cache shards its kv-heads axis over ``tensor`` — GSPMD
         # inserts the collectives, exactly like the training forward
         self.mesh = mesh
-        if mesh is not None and sc.quantize_int8:
-            raise ValueError("mesh serving with quantize_int8 is not "
-                             "supported yet: int8 leaves are {q8, scale} "
+        if sc.quantize_int8 and sc.quantize_int4:
+            raise ValueError("quantize_int8 and quantize_int4 are mutually "
+                             "exclusive — pick one weight precision")
+        if mesh is not None and (sc.quantize_int8 or sc.quantize_int4):
+            raise ValueError("mesh serving with quantized weights is not "
+                             "supported yet: {q8/q4, scale} leaves are "
                              "dicts the logical-axis rules don't cover — "
                              "serve sharded in bf16 or quantize single-chip")
         self.model = LlamaModel(cfg, mesh)
-        if sc.quantize_int8:
+        if sc.quantize_int8 or sc.quantize_int4:
             from ..models.quant import quantize_params
-            params = quantize_params(cfg, params)
+            params = quantize_params(cfg, params,
+                                     bits=4 if sc.quantize_int4 else 8)
         self.params = params
         self.metrics = metrics or Metrics()
         self.metrics.describe("tpu_serving_queue_depth",

@@ -318,6 +318,22 @@ class Trainer:
                                         seed=10_000_019)
         if self._eval_fn is None:
             def eval_loss(params, batch):
+                cfg = self.model.cfg
+                head = (params.get("tok_embed") if cfg.tie_embeddings
+                        else params.get("lm_head"))
+                # same fused/naive split as the train loss: a 128k-vocab
+                # model that only trains via fused CE must not OOM in its
+                # final eval by materializing eval logits
+                if self.tc.fused_ce_chunks and not isinstance(head, dict):
+                    from ..ops.fused_ce import fused_cross_entropy
+                    hidden = self.model.forward(params, batch[:, :-1],
+                                                return_hidden=True)
+                    ce, _ = fused_cross_entropy(
+                        hidden, head, batch[:, 1:],
+                        tied=cfg.tie_embeddings,
+                        logit_softcap=cfg.logit_softcap,
+                        n_chunks=self.tc.fused_ce_chunks)
+                    return ce
                 logits = self.model.forward(params, batch[:, :-1])
                 return cross_entropy_loss(logits, batch[:, 1:])
             self._eval_fn = jax.jit(eval_loss)

@@ -175,3 +175,22 @@ class TestComputeDtype:
         assert dots, "no dot_general found in lowered fused CE"
         for ops in dots:
             assert ops != ("f32", "f32"), f"promoted head matmul: {ops}"
+
+
+class TestEvalPath:
+    def test_eval_uses_fused_loss_and_matches(self):
+        """evaluate() must ride the fused path when configured (a 128k-vocab
+        model that only trains fused would OOM materializing eval logits)
+        and produce the same NLL as the naive eval."""
+        from k8s_runpod_kubelet_tpu.models import tiny_llama
+        from k8s_runpod_kubelet_tpu.workloads.train import TrainConfig, Trainer
+        cfg = tiny_llama(vocab_size=96, embed_dim=64, n_layers=2, n_heads=4,
+                         n_kv_heads=2, mlp_dim=128, max_seq_len=64,
+                         dtype=jnp.float32, param_dtype=jnp.float32)
+        res = {}
+        for chunks in (0, 4):
+            tc = TrainConfig(batch_size=4, seq_len=32, steps=1,
+                             warmup_steps=1, fused_ce_chunks=chunks)
+            tr = Trainer(cfg, tc, seed=0)
+            res[chunks] = tr.evaluate(steps=2)["eval_loss"]
+        np.testing.assert_allclose(res[0], res[4], rtol=1e-5, atol=1e-5)

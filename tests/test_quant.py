@@ -100,3 +100,85 @@ class TestServingQuantized:
                 eng.stop()
 
         assert run(False) == run(True)
+
+
+class TestInt4:
+    def test_leaf_layout_pack_roundtrip(self):
+        from k8s_runpod_kubelet_tpu.models.quant import (_quantize_leaf_int4,
+                                                         INT4_GROUP)
+        cfg = _cfg()
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        qp = quantize_params(cfg, params, bits=4)
+        for name in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"):
+            leaf = qp["layers"][name]
+            assert is_quantized(leaf)
+            assert leaf["q4"].dtype == jnp.uint8
+            full = params["layers"][name]
+            assert leaf["q4"].shape[-2] == full.shape[-2] // 2  # packed pairs
+            assert leaf["scale"].shape[-2] == 1                 # per group
+        # exact nibble round-trip: values quantized then dequantized match
+        # the quantization grid (reconstruction error <= scale/2 per elem)
+        w = np.asarray(params["layers"]["w_up"], np.float32)[0]
+        leaf = _quantize_leaf_int4(w)
+        q4 = np.asarray(leaf["q4"])
+        lo = (q4 & 0xF).astype(np.int8) - 8
+        hi = (q4 >> 4).astype(np.int8) - 8
+        q = np.stack((lo, hi), axis=-2).reshape(w.shape)
+        gs = w.shape[-2] if w.shape[-2] % INT4_GROUP else INT4_GROUP
+        scale = np.asarray(leaf["scale"])
+        wr = q.reshape(-1, scale.shape[-3], gs, w.shape[-1]) * scale
+        err = np.abs(wr.reshape(w.shape) - w)
+        assert (err <= np.repeat(scale[..., 0, :], gs, axis=-2)
+                .reshape(w.shape) * 0.5 + 1e-7).all()
+
+    def test_forward_logits_close_and_argmax_stable(self):
+        cfg = _cfg()
+        params = init_params(cfg, jax.random.PRNGKey(1))
+        qp = quantize_params(cfg, params, bits=4)
+        toks = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0,
+                                  cfg.vocab_size, jnp.int32)
+        model = LlamaModel(cfg)
+        ref = np.asarray(model.forward(params, toks), np.float32)
+        got = np.asarray(model.forward(qp, toks), np.float32)
+        cos = np.sum(ref * got) / (np.linalg.norm(ref) * np.linalg.norm(got))
+        # 4-bit on a RANDOM tiny model is the worst case (no outlier
+        # structure, absmax ~3.5 sigma -> coarse steps): cos ~0.985 is the
+        # honest number, far looser than int8's 0.999; real checkpoints
+        # quantize better and still deserve an eval before production
+        assert cos > 0.97, cos
+        # ranking stays sane: the fp argmax appears in int4's top-3
+        for b in range(ref.shape[0]):
+            top3 = np.argsort(got[b, -1])[-3:]
+            assert np.argmax(ref[b, -1], -1) in top3
+
+    def test_engine_generates_same_greedy_tokens_int4(self):
+        from k8s_runpod_kubelet_tpu.workloads.serving import (ServingConfig,
+                                                              ServingEngine)
+        cfg = _cfg()
+        params = init_params(cfg, jax.random.PRNGKey(3))
+        prompt = list(range(7, 19))
+        outs = []
+        for _ in range(2):
+            sc = ServingConfig(slots=2, cache_len=64, max_new_tokens=8,
+                               max_prefill_len=16, quantize_int4=True)
+            eng = ServingEngine(cfg, params, sc).start()
+            try:
+                # engine really is int4 (quantized internally from host)
+                assert "q4" in eng.params["layers"]["w_up"]
+                outs.append(eng.submit(prompt).result(timeout=240)["tokens"])
+            finally:
+                eng.stop()
+        # deterministic across engine instances, full length produced
+        # (greedy equality with bf16 is NOT promised at 4 bits — that is
+        # an eval question, unlike int8 where the tiny model pins it)
+        assert outs[0] == outs[1]
+        assert len(outs[0]) == 8
+
+    def test_int8_int4_mutually_exclusive(self):
+        from k8s_runpod_kubelet_tpu.workloads.serving import (ServingConfig,
+                                                              ServingEngine)
+        cfg = _cfg()
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        with _pytest.raises(ValueError, match="mutually exclusive"):
+            ServingEngine(cfg, params, ServingConfig(
+                slots=1, cache_len=32, quantize_int8=True, quantize_int4=True))

@@ -141,3 +141,11 @@ class TestServeHttp:
             with pytest.raises(urllib.error.HTTPError) as ei:
                 urllib.request.urlopen(req, timeout=10)
             assert ei.value.code == 400, payload
+
+
+def test_serve_main_int8_int4_conflict_is_clean_exit():
+    """--int8 --int4 must exit 1 with a log.error, not a ValueError
+    traceback from engine construction."""
+    from k8s_runpod_kubelet_tpu.workloads import serve_main
+    rc = serve_main.main(["--model", "tiny", "--int8", "--int4"])
+    assert rc == 1

@@ -200,8 +200,8 @@ def check_train(results, dev):
             model_flops_per_tok=6.0 * cfg.param_count))
 
 
-def _quantized_params_abs(cfg):
-    """Abstract int8 param tree for a model config. quantize_params is
+def _quantized_params_abs(cfg, bits: int = 8):
+    """Abstract int8/int4 param tree for a model config. quantize_params is
     host-side numpy (not traceable), so run it over a zeros host tree
     (copy-on-write pages, same trick as bench _serve_params) and keep only
     the SHAPES."""
@@ -214,7 +214,7 @@ def _quantized_params_abs(cfg):
                                 jax.random.PRNGKey(0))
     host = jax.tree_util.tree_map(
         lambda sd: np.zeros(sd.shape, sd.dtype), params_abs)
-    q_real = quantize_params(cfg, host)
+    q_real = quantize_params(cfg, host, bits=bits)
     return jax.tree_util.tree_map(
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), q_real)
 
@@ -250,7 +250,13 @@ _SERVING_8B_KEYS = ("decode_8b_int8_kv8", "decode_8b_int8_kvbf16",
                     "decode_8b_int8_kv8_slots32",
                     "decode_8b_int8_kv8_slots48", "prefill_8b_int8",
                     "verify_8b_int8_kv8_k4",
-                    "econ_kv_int8_traffic_ratio")
+                    "econ_kv_int8_traffic_ratio",
+                    "decode_8b_int4_kv8_slots16",
+                    "decode_8b_int4_kv8_slots32",
+                    "decode_8b_int4_kv8_slots64",
+                    "decode_8b_int4pk_kv8_slots16",
+                    "decode_8b_int4pk_kv8_slots32",
+                    "decode_8b_int4pk_kv8_slots64")
 
 
 def check_serving_8b(results, dev):
@@ -325,6 +331,49 @@ def check_serving_8b(results, dev):
     results["prefill_8b_int8"] = _run("prefill_8b_int8", prog_prefill)
     results["verify_8b_int8_kv8_k4"] = _run("verify_8b_int8_kv8_k4",
                                             prog_verify_k4)
+
+    # int4 weights (models/quant.py bits=4): weight bytes drop 2x vs int8
+    # (8GB -> ~4.3GB incl. group scales on 8B). Decode at low concurrency is
+    # weight-amortization-bound, so the roofline should rise and the freed
+    # HBM should admit more slots — the boundary answers recorded here.
+    q4_abs = _quantized_params_abs(cfg, bits=4)  # hoisted: shared by 6 cells
+
+    def prog_decode_int4(n_slots, pallas_kernel):
+        import os
+        cache_n = jax.eval_shape(
+            lambda: model.init_cache(n_slots, cache_len, quantize=True))
+        key = "TPU_KUBELET_FORCE_PALLAS"
+        prev = os.environ.get(key)
+        # AOT runs on a CPU host, so backend autodetection would pick the
+        # XLA fallback; force the Mosaic kernel path for the *pk cells
+        # (only the literal "1" means force — absent = autodetect)
+        if pallas_kernel:
+            os.environ[key] = "1"
+        else:
+            os.environ.pop(key, None)
+        try:
+            note = (f"{n_slots} slots, int4 weights + int8 KV"
+                    + (", Pallas unpack kernel" if pallas_kernel
+                       else ", XLA fallback path"))
+            return _lower_decode(model, q4_abs, cache_n, n_slots, s, note)
+        finally:
+            if prev is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = prev
+    for n_slots in (16, 32, 64):
+        results[f"decode_8b_int4_kv8_slots{n_slots}"] = _run(
+            f"decode_8b_int4_kv8_slots{n_slots}",
+            lambda n=n_slots: prog_decode_int4(n, False))
+    # Pallas kernel path (ops/int4_matmul.py): the XLA cost model cannot
+    # see inside Mosaic custom calls, so its byte counts understate these
+    # cells — the claims here are (a) the kernel Mosaic-compiles for v5e at
+    # the 8B geometry and (b) the HBM boundary (which slot counts fit);
+    # throughput comes from the chip (bench --serve --int4).
+    for n_slots in (16, 32, 64):
+        results[f"decode_8b_int4pk_kv8_slots{n_slots}"] = _run(
+            f"decode_8b_int4pk_kv8_slots{n_slots}",
+            lambda n=n_slots: prog_decode_int4(n, True))
     a = results.get("decode_8b_int8_kv8", {})
     b = results.get("decode_8b_int8_kvbf16", {})
     if a.get("compile_ok") and b.get("compile_ok"):
