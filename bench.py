@@ -94,6 +94,12 @@ _STAGED_QUEUE = [
     ("econ", ["--econ"], 2400),
     ("ring_flash", ["--ring-flash"], 1800),
     ("spec_drift", ["--spec-drift"], 2400),
+    # VERDICT r3 item 2: if the sweep tops out short of 0.40 MFU, the claim
+    # needs a profile, not a guess — capture an XLA trace of the headline's
+    # timed steps whenever the chip answers (TensorBoard-readable xplane)
+    ("headline_profile",
+     ["--run", "--expect-tpu", "--profile-dir",
+      os.path.join("bench_results", "tpu_profile")], 1800),
     ("attn", ["--attn"], 2400),  # 32k last inside; sacrificial process
 ]
 
