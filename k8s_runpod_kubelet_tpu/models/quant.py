@@ -32,22 +32,24 @@ import numpy as np
 
 from .llama import LlamaConfig, Params
 
-__all__ = ["quantize_params", "is_quantized"]
+__all__ = ["quantize_params", "is_quantized", "quantized_logical_axes"]
 
 # stacked-layer projection weights with (in, out) as the trailing dims,
 # plus the top-level lm head — the decode-bandwidth heavy hitters
 _LAYER_WEIGHTS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
 
 
-def _quantize_leaf(w) -> dict[str, jax.Array]:
+def _quantize_leaf(w) -> dict[str, np.ndarray]:
     # quantize on HOST (numpy): a stacked llama3-8b w_gate upcast to f32 on
     # device would transiently cost ~7.5GB HBM; this way the device only
-    # ever sees the int8 weights + f32 scales
+    # ever sees the int8 weights + f32 scales. Leaves stay NUMPY here —
+    # quantize_params commits them (or the caller device_puts them under
+    # shardings; a 70B leaf must never land whole on one device).
     w = np.asarray(w, np.float32)
     scale = np.max(np.abs(w), axis=-2, keepdims=True) / 127.0
     scale = np.maximum(scale, 1e-8)
     q8 = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
-    return {"q8": jnp.asarray(q8), "scale": jnp.asarray(scale)}
+    return {"q8": q8, "scale": scale}
 
 
 INT4_GROUP = 128  # contraction-axis group size for int4 scales
@@ -71,15 +73,40 @@ def _quantize_leaf_int4(w, group_size: int = INT4_GROUP) -> dict[str, jax.Array]
     q = np.clip(np.round(wr / scale), -7, 7).astype(np.int8) + 8  # 1..15
     q = q.reshape(*w.shape[:-2], kin, out).astype(np.uint8)
     packed = (q[..., 0::2, :] | (q[..., 1::2, :] << 4)).astype(np.uint8)
-    return {"q4": jnp.asarray(packed), "scale": jnp.asarray(scale)}
+    return {"q4": packed, "scale": scale}
 
 
 def is_quantized(w: Any) -> bool:
     return isinstance(w, dict) and ("q8" in w or "q4" in w)
 
 
+def quantized_logical_axes(cfg: LlamaConfig) -> Params:
+    """Logical-axis tree for an int8-quantized param tree (mirrors
+    quantize_params(bits=8) output), so 70B-class int8 serving can shard
+    over a mesh exactly like bf16 serving: ``q8`` keeps the base weight's
+    axes; ``scale`` (..., 1, out) replicates its singleton contraction dim
+    and keeps the output axis. int4 is excluded on purpose — its packed
+    contraction axis halves the logical length and the Pallas kernel is
+    not shard_map'd; shard int8 or serve int4 single-chip."""
+    from .llama import param_logical_axes
+    base = param_logical_axes(cfg)
+
+    def q_axes(axes):
+        return {"q8": axes, "scale": axes[:-2] + (None, axes[-1])}
+
+    out: Params = {"tok_embed": base["tok_embed"],
+                   "final_norm": base["final_norm"]}
+    out["layers"] = {
+        name: (q_axes(axes) if name in _LAYER_WEIGHTS else axes)
+        for name, axes in base["layers"].items()
+    }
+    if "lm_head" in base:
+        out["lm_head"] = q_axes(base["lm_head"])
+    return out
+
+
 def quantize_params(cfg: LlamaConfig, params: Params,
-                    bits: int = 8) -> Params:
+                    bits: int = 8, commit: bool = True) -> Params:
     """Returns a new tree with projection weights int8- or int4-quantized.
     Accepts host (numpy) or device trees; output leaves are device arrays.
     The embedding table (unquantized: gathers don't amortize dequant the
@@ -88,19 +115,31 @@ def quantize_params(cfg: LlamaConfig, params: Params,
     embedding's first use is already a cast-to-bf16 matmul input. Norms
     stay f32 (tiny, precision-sensitive). ``bits=4`` packs two weights per
     byte with group-wise scales (_quantize_leaf_int4) — weight HBM drops
-    4x vs bf16, the next rung of the decode-bandwidth ladder."""
+    4x vs bf16, the next rung of the decode-bandwidth ladder.
+
+    ``commit=False`` returns HOST (numpy) leaves: mesh serving must
+    device_put each leaf under its target sharding — a 70B stacked leaf
+    committed whole to one device (what jnp.asarray does) is itself
+    bigger than a v5e's HBM."""
     if bits not in (4, 8):
         raise ValueError(f"bits must be 4 or 8, got {bits}")
     quant = _quantize_leaf if bits == 8 else _quantize_leaf_int4
-    out: Params = {"tok_embed": jnp.asarray(params["tok_embed"], cfg.dtype),
-                   "final_norm": jnp.asarray(params["final_norm"])}
+    place = jnp.asarray if commit else (lambda x, *a: np.asarray(x, *a))
+    out: Params = {"tok_embed": place(params["tok_embed"],
+                                      np.dtype(cfg.dtype) if not commit
+                                      else cfg.dtype),
+                   "final_norm": place(params["final_norm"])}
     layers = {}
     for name, w in params["layers"].items():
         if name in _LAYER_WEIGHTS:
-            layers[name] = quant(w)
+            leaf = quant(w)
+            layers[name] = (jax.tree_util.tree_map(jnp.asarray, leaf)
+                            if commit else leaf)
         else:
-            layers[name] = jnp.asarray(w)
+            layers[name] = place(w)
     out["layers"] = layers
     if "lm_head" in params:
-        out["lm_head"] = quant(params["lm_head"])
+        leaf = quant(params["lm_head"])
+        out["lm_head"] = (jax.tree_util.tree_map(jnp.asarray, leaf)
+                          if commit else leaf)
     return out

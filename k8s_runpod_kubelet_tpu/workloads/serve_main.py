@@ -754,7 +754,8 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="gemma-7b",
                    choices=["gemma-7b", "gemma2-9b", "gemma3-12b",
-                            "llama3-8b", "llama31-8b", "mixtral-8x7b", "mistral-7b",
+                            "llama3-8b", "llama31-8b", "llama3-70b",
+                            "mixtral-8x7b", "mistral-7b",
                             "qwen2-7b", "tiny", "tiny-moe"])
     p.add_argument("--slots", type=int, default=8)
     p.add_argument("--port", type=int, default=8000)
@@ -820,14 +821,14 @@ def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO)
 
     import jax
-    from ..models import (gemma_7b, gemma2_9b, gemma3_12b, llama3_8b, llama31_8b,
-                          mixtral_8x7b, mistral_7b, qwen2_7b, tiny_llama,
-                          tiny_moe, init_params)
+    from ..models import (gemma_7b, gemma2_9b, gemma3_12b, llama3_8b,
+                          llama31_8b, llama3_70b, mixtral_8x7b, mistral_7b,
+                          qwen2_7b, tiny_llama, tiny_moe, init_params)
     from .serving import ServingConfig, ServingEngine
 
     cfg = {"gemma-7b": gemma_7b, "gemma2-9b": gemma2_9b,
            "gemma3-12b": gemma3_12b, "llama3-8b": llama3_8b,
-           "llama31-8b": llama31_8b,
+           "llama31-8b": llama31_8b, "llama3-70b": llama3_70b,
            "mixtral-8x7b": mixtral_8x7b, "mistral-7b": mistral_7b,
            "qwen2-7b": qwen2_7b, "tiny": tiny_llama,
            "tiny-moe": tiny_moe}[args.model]()
@@ -846,10 +847,11 @@ def main(argv=None) -> int:
         # check above
         from ..parallel import MeshConfig, make_mesh
         n = args.tensor_parallel
-        if args.int8 or args.int4:
-            log.error("--tensor-parallel does not compose with --int8/--int4 "
-                      "yet (quantized {q8/q4, scale} leaves have no "
-                      "logical-axis rules); serve sharded in bf16")
+        if args.int4:
+            log.error("--tensor-parallel does not compose with --int4 (the "
+                      "packed contraction axis halves the logical length "
+                      "and the unpack kernel is not shard_map'd); use "
+                      "--int8 for sharded quantized serving")
             return 1
         if cfg.n_kv_heads % n or cfg.n_heads % n:
             log.error("--tensor-parallel %d must divide the model's head "
@@ -865,12 +867,15 @@ def main(argv=None) -> int:
     if args.hf_checkpoint:
         from ..models import load_hf
         params = load_hf(cfg, args.hf_checkpoint)  # host tree
-        if mesh is not None:
+        if mesh is not None and not args.int8:
             from ..models import param_logical_axes
             from ..parallel import param_shardings
             params = jax.device_put(
                 params, param_shardings(mesh, param_logical_axes(cfg)))
         elif not (args.int8 or args.int4):
+            # (mesh + --int8 keeps the HOST tree: the engine quantizes it
+            # and device_puts the int8 leaves with quantized_logical_axes
+            # shardings — the bf16 tree never occupies a whole chip)
             # one device_put (serving is single-host per replica); with
             # --int8/--int4 the engine quantizes from host instead, so the
             # full-precision tree never occupies HBM next to the quantized

@@ -189,6 +189,21 @@ class _Slot:
     stop_tail_upto: int = 0
 
 
+def kv_cache_pspec(name: str, ndim: int):
+    """PartitionSpec for one KV-cache section under mesh serving — THE
+    layout contract between the engine (_fresh_cache) and the AOT evidence
+    tool (tools/aot_check.py check_sharded_serving): K/V (L, B, len, h, d)
+    shard the kv-heads axis (second-to-last) over ``tensor``; *_scale
+    (L, B, len, h) have heads last; index/abs_pos bookkeeping replicates."""
+    from jax.sharding import PartitionSpec as P
+    from ..parallel.mesh import AXES
+    if name in ("index", "abs_pos"):
+        return P()
+    if name.endswith("_scale"):
+        return P(*([None] * (ndim - 1) + [AXES.TENSOR]))
+    return P(*([None] * (ndim - 2) + [AXES.TENSOR, None]))
+
+
 def _fail_future(fut: Future, exc: BaseException) -> None:
     """set_exception tolerant of a client cancel landing between a done()
     check and the call — InvalidStateError here must never kill an engine
@@ -293,16 +308,30 @@ class ServingEngine:
         if sc.quantize_int8 and sc.quantize_int4:
             raise ValueError("quantize_int8 and quantize_int4 are mutually "
                              "exclusive — pick one weight precision")
-        if mesh is not None and (sc.quantize_int8 or sc.quantize_int4):
-            raise ValueError("mesh serving with quantized weights is not "
-                             "supported yet: {q8/q4, scale} leaves are "
-                             "dicts the logical-axis rules don't cover — "
-                             "serve sharded in bf16 or quantize single-chip")
+        if mesh is not None and sc.quantize_int4:
+            raise ValueError("mesh serving with int4 is not supported: the "
+                             "packed contraction axis halves the logical "
+                             "length and the unpack kernel is not "
+                             "shard_map'd — shard int8 or serve int4 "
+                             "single-chip")
         self.model = LlamaModel(cfg, mesh)
         if sc.quantize_int8 or sc.quantize_int4:
-            from ..models.quant import quantize_params
+            from ..models.quant import (quantize_params,
+                                        quantized_logical_axes)
+            # quantize on HOST (numpy pulls any device tree back), then
+            # shard the int8 tree exactly like bf16 params — 70B-class
+            # int8 over a slice is THE big-model production config. The
+            # host leaves go straight to their SHARDED placements
+            # (commit=False): a 70B stacked leaf committed whole to one
+            # device first would itself exceed a v5e's HBM.
             params = quantize_params(cfg, params,
-                                     bits=4 if sc.quantize_int4 else 8)
+                                     bits=4 if sc.quantize_int4 else 8,
+                                     commit=mesh is None)
+            if mesh is not None:
+                from ..parallel import param_shardings
+                params = jax.device_put(
+                    params,
+                    param_shardings(mesh, quantized_logical_axes(cfg)))
         self.params = params
         self.metrics = metrics or Metrics()
         self.metrics.describe("tpu_serving_queue_depth",
@@ -428,20 +457,11 @@ class ServingEngine:
         if self.mesh is None:
             return build()
         import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        from ..parallel.mesh import AXES
-
-        def spec(name, ndim):
-            if name in ("index", "abs_pos"):
-                return P()
-            if name.endswith("_scale"):
-                # (L, B, len, h): heads last
-                return P(*([None] * (ndim - 1) + [AXES.TENSOR]))
-            # (L, B, len, h, d): heads second-to-last
-            return P(*([None] * (ndim - 2) + [AXES.TENSOR, None]))
+        from jax.sharding import NamedSharding
 
         shapes = jax.eval_shape(build)
-        shardings = {name: NamedSharding(self.mesh, spec(name, sd.ndim))
+        shardings = {name: NamedSharding(self.mesh,
+                                         kv_cache_pspec(name, sd.ndim))
                      for name, sd in shapes.items()}
         return jax.jit(build, out_shardings=shardings)()
 

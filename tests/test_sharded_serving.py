@@ -111,11 +111,35 @@ class TestShardedServing:
             e.stop()
             plain.stop()
 
-    def test_mesh_rejects_int8_weights(self):
+    def test_tp2_int8_weights_match_single_device_int8(self):
+        """Sharded int8 serving (quantized_logical_axes): the engine
+        quantizes the host tree and device_puts q8/scale leaves with the
+        same logical rules as bf16 — 70B-class int8 over a slice. Output
+        must equal the SINGLE-device int8 engine's (same quantized
+        numbers, GSPMD shardings never change values)."""
+        host = jax.tree_util.tree_map(
+            lambda x: jax.device_get(x), init_params(CFG, jax.random.PRNGKey(0)))
+        plain = _engine(CFG, host, quantize_int8=True)
         mesh = _mesh(tensor=2)
-        with pytest.raises(ValueError, match="quantize_int8"):
+        sharded = _engine(CFG, host, mesh=mesh, quantize_int8=True)
+        try:
+            leaf = sharded.params["layers"]["wq"]
+            assert leaf["q8"].dtype == jnp.int8
+            assert len(leaf["q8"].sharding.device_set) == 2
+            assert len(leaf["scale"].sharding.device_set) == 2
+            for p in PROMPTS:
+                a = plain.submit(p, max_new_tokens=10).result(timeout=120)
+                b = sharded.submit(p, max_new_tokens=10).result(timeout=120)
+                assert a["tokens"] == b["tokens"], p
+        finally:
+            sharded.stop()
+            plain.stop()
+
+    def test_mesh_rejects_int4_weights(self):
+        mesh = _mesh(tensor=2)
+        with pytest.raises(ValueError, match="int4"):
             ServingEngine(CFG, init_params(CFG, jax.random.PRNGKey(0)),
-                          ServingConfig(slots=1, quantize_int8=True),
+                          ServingConfig(slots=1, quantize_int4=True),
                           mesh=mesh)
 
     def test_tp2_kv_int8_cache(self):

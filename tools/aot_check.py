@@ -62,9 +62,14 @@ def _topo(name: str, **kw):
 
 
 def _sds_tree(tree, sharding):
+    """ShapeDtypeStructs under ``sharding`` — EXCEPT leaves that already
+    carry one (the sharded-serving cell pre-assigns per-leaf mesh
+    shardings; the single-device cells pass bare shapes)."""
     import jax
     return jax.tree_util.tree_map(
-        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding),
+        lambda x: jax.ShapeDtypeStruct(
+            x.shape, x.dtype,
+            sharding=getattr(x, "sharding", None) or sharding),
         tree)
 
 
@@ -614,6 +619,79 @@ def check_sharded_train(results):
     results["train_260m_sharded_2x4"] = _run("train_260m_sharded_2x4", prog)
 
 
+def _quantized_abs_shapes(cfg):
+    """ShapeDtypeStruct tree of an int8-quantized param tree, computed from
+    shapes alone — the numpy path (_quantized_params_abs) would materialize
+    per-leaf f32 temporaries (a stacked llama3-70b w_gate is ~75GB), which
+    only SHAPES of are ever wanted here."""
+    import jax
+    import jax.numpy as jnp
+    from k8s_runpod_kubelet_tpu.models import init_params
+    from k8s_runpod_kubelet_tpu.models.quant import _LAYER_WEIGHTS
+
+    params_abs = jax.eval_shape(lambda k: init_params(cfg, k),
+                                jax.random.PRNGKey(0))
+
+    def q(sd):
+        return {"q8": jax.ShapeDtypeStruct(sd.shape, jnp.int8),
+                "scale": jax.ShapeDtypeStruct(
+                    sd.shape[:-2] + (1, sd.shape[-1]), jnp.float32)}
+
+    out = {"tok_embed": jax.ShapeDtypeStruct(params_abs["tok_embed"].shape,
+                                             cfg.dtype),
+           "final_norm": params_abs["final_norm"],
+           "layers": {name: (q(sd) if name in _LAYER_WEIGHTS else sd)
+                      for name, sd in params_abs["layers"].items()}}
+    if "lm_head" in params_abs:
+        out["lm_head"] = q(params_abs["lm_head"])
+    return out
+
+
+def check_sharded_serving(results):
+    """70B-class int8 decode over a v5e:2x4 mesh (tensor=8): the
+    quantized_logical_axes shardings compiled for the REAL target — the
+    big-model production config (a 70B does not fit ONE chip at any
+    precision; int8 + 8-way tensor parallel is how it serves)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def prog():
+        import jax.numpy as jnp
+        from k8s_runpod_kubelet_tpu.models import LlamaModel, llama3_70b
+        from k8s_runpod_kubelet_tpu.models.quant import quantized_logical_axes
+        from k8s_runpod_kubelet_tpu.parallel import (MeshConfig, make_mesh,
+                                                     param_shardings)
+        topo = _topo("v5e:2x4")
+        mesh = make_mesh(MeshConfig(data=1, tensor=8), list(topo.devices))
+        cfg = llama3_70b()
+        model = LlamaModel(cfg, mesh)
+        slots, cache_len = 8, 2048
+        q_abs = _quantized_abs_shapes(cfg)
+        shardings = param_shardings(mesh, quantized_logical_axes(cfg))
+        q_sds = jax.tree_util.tree_map(
+            lambda x, sh: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh),
+            q_abs, shardings)
+        cache_abs = jax.eval_shape(
+            lambda: model.init_cache(slots, cache_len, quantize=True))
+        repl = NamedSharding(mesh, P())
+        # the engine's OWN layout contract (one definition, serving.py)
+        from k8s_runpod_kubelet_tpu.workloads.serving import kv_cache_pspec
+        cache_sds = {
+            name: jax.ShapeDtypeStruct(
+                sd.shape, sd.dtype,
+                sharding=NamedSharding(mesh, kv_cache_pspec(name, sd.ndim)))
+            for name, sd in cache_abs.items()}
+        # same _lower_decode recipe as every single-device decode cell —
+        # pre-sharded trees pass through, repl covers token/active
+        return _lower_decode(
+            model, q_sds, cache_sds, slots, repl,
+            "llama3-70b int8 decode, tensor=8 over v5e:2x4, "
+            f"{slots} slots int8 KV — sharded quantized serving "
+            "compiled for the real target")
+
+    results["decode_70b_int8_tp8_2x4"] = _run("decode_70b_int8_tp8_2x4", prog)
+
+
 def _run(name, fn):
     t0 = time.time()
     try:
@@ -647,6 +725,7 @@ def main() -> int:
         ("flash32k", lambda: check_flash_32k(results, dev)),
         ("ring", lambda: check_ring_flash(results)),
         ("sharded", lambda: check_sharded_train(results)),
+        ("sharded_serving", lambda: check_sharded_serving(results)),
     ]
     names = [n for n, _ in checks]
     only = ""
