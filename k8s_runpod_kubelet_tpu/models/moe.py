@@ -64,13 +64,26 @@ def router_z_loss(router_logits: jax.Array) -> jax.Array:
     return jnp.mean(z ** 2)
 
 
-def moe_mlp(h: jax.Array, router_w: jax.Array, we_gate: jax.Array,
-            we_up: jax.Array, we_down: jax.Array, *, n_experts_per_tok: int,
+
+def _expert_w(w, dtype):
+    """(weight, scale_or_None) for an expert leaf: raw array, or int8
+    {q8 (..., E, in, out), scale (..., E, 1, out)} from models/quant.py —
+    the dequant multiply rides the einsum epilogue exactly like llama._mm,
+    so expert HBM reads stay int8 (Mixtral's experts are ~96% of its
+    params; without this --int8 barely touches an MoE model)."""
+    if isinstance(w, dict):
+        return w["q8"].astype(dtype), w["scale"].astype(dtype)
+    return w.astype(dtype), None
+
+
+def moe_mlp(h: jax.Array, router_w: jax.Array, we_gate,
+            we_up, we_down, *, n_experts_per_tok: int,
             capacity_factor: float, activation, dtype, constrain=None
             ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Sparse MoE MLP on normed activations.
 
-    h (B,S,E); router_w (E,X); we_* (X,E,M)/(X,M,E).
+    h (B,S,E); router_w (E,X); we_* (X,E,M)/(X,M,E) raw arrays, or int8
+    {q8, scale} dict leaves from models/quant.py (see _expert_w).
     Returns (out (B,S,E), load_balance_aux, router_z) — aux terms are
     UNSCALED; the caller applies its coefficients (so inference paths can
     just drop them).
@@ -107,10 +120,18 @@ def moe_mlp(h: jax.Array, router_w: jax.Array, we_gate: jax.Array,
     buf = cons(buf, ("expert", None, None))
 
     # all experts in one batched einsum each — MXU-shaped, weights stationary
-    gate = jnp.einsum("xce,xem->xcm", buf, we_gate.astype(dtype))
-    up = jnp.einsum("xce,xem->xcm", buf, we_up.astype(dtype))
+    wg, sg = _expert_w(we_gate, dtype)
+    wu, su = _expert_w(we_up, dtype)
+    wd, sd = _expert_w(we_down, dtype)
+    gate = jnp.einsum("xce,xem->xcm", buf, wg)
+    up = jnp.einsum("xce,xem->xcm", buf, wu)
+    if sg is not None:
+        gate = gate * sg          # (x, 1, m) broadcasts over capacity
+        up = up * su
     act = cons(activation(gate) * up, ("expert", None, "act_mlp"))
-    out = jnp.einsum("xcm,xme->xce", act, we_down.astype(dtype))
+    out = jnp.einsum("xcm,xme->xce", act, wd)
+    if sd is not None:
+        out = out * sd            # (x, 1, e)
     out_flat = out.reshape(x_experts * cap, e)
 
     # combine: gather each assignment's result, zero the dropped ones,
@@ -127,8 +148,8 @@ def moe_mlp(h: jax.Array, router_w: jax.Array, we_gate: jax.Array,
 
 
 def moe_mlp_dense_reference(h: jax.Array, router_w: jax.Array,
-                            we_gate: jax.Array, we_up: jax.Array,
-                            we_down: jax.Array, *, n_experts_per_tok: int,
+                            we_gate, we_up,
+                            we_down, *, n_experts_per_tok: int,
                             activation, dtype) -> jax.Array:
     """Dense reference: run EVERY expert on every token, combine with the
     renormalized top-k weights (zero elsewhere). X× the FLOPs of the sparse
@@ -140,8 +161,17 @@ def moe_mlp_dense_reference(h: jax.Array, router_w: jax.Array,
     top_p, top_idx, _ = route_top_k(logits, n_experts_per_tok)
     weights = jnp.zeros((b * s, x_experts), jnp.float32)
     weights = jax.vmap(lambda w, p, i: w.at[i].set(p))(weights, top_p, top_idx)
-    gate = jnp.einsum("ge,xem->gxm", ht, we_gate.astype(dtype))
-    up = jnp.einsum("ge,xem->gxm", ht, we_up.astype(dtype))
-    out = jnp.einsum("gxm,xme->gxe", activation(gate) * up, we_down.astype(dtype))
+    wg, sg = _expert_w(we_gate, dtype)
+    wu, su = _expert_w(we_up, dtype)
+    wd, sd = _expert_w(we_down, dtype)
+    gate = jnp.einsum("ge,xem->gxm", ht, wg)
+    up = jnp.einsum("ge,xem->gxm", ht, wu)
+    if sg is not None:
+        # scale (x, 1, m) -> (x, m): right-aligns against (g, x, m)
+        gate = gate * sg[..., 0, :]
+        up = up * su[..., 0, :]
+    out = jnp.einsum("gxm,xme->gxe", activation(gate) * up, wd)
+    if sd is not None:
+        out = out * sd[..., 0, :]
     y = jnp.einsum("gxe,gx->ge", out.astype(jnp.float32), weights)
     return y.reshape(b, s, e).astype(h.dtype)

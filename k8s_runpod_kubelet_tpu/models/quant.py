@@ -18,8 +18,11 @@ A quantized weight is a dict leaf ``{"q8": int8 (..., in, out),
 "scale": f32 (..., 1, out)}``; the model's matmul helper (llama._mm) accepts
 either form, so train/serve code paths are unchanged. Norms, biases, the
 embedding table (gather path + possible tied head), and the MoE router stay
-full precision — they are tiny and accuracy-critical. Sparse-MoE expert
-weights are left unquantized for now (einsum path).
+full precision — they are tiny and accuracy-critical. Sparse-MoE EXPERT
+weights quantize too at int8 (moe._expert_w applies the scale in the expert
+einsum's epilogue; Mixtral's experts are ~96% of its params, so --int8 on
+an MoE model lives or dies on them) — int4 leaves experts at full precision
+(the unpack kernel and einsum path don't compose yet).
 """
 
 from __future__ import annotations
@@ -37,6 +40,9 @@ __all__ = ["quantize_params", "is_quantized", "quantized_logical_axes"]
 # stacked-layer projection weights with (in, out) as the trailing dims,
 # plus the top-level lm head — the decode-bandwidth heavy hitters
 _LAYER_WEIGHTS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+# expert weights: int8-only (moe.py's einsums handle {q8, scale}; the int4
+# unpack kernel is a 2D-matmul kernel and doesn't cover the expert path)
+_EXPERT_WEIGHTS = ("we_gate", "we_up", "we_down")
 
 
 def _quantize_leaf(w) -> dict[str, np.ndarray]:
@@ -97,7 +103,9 @@ def quantized_logical_axes(cfg: LlamaConfig) -> Params:
     out: Params = {"tok_embed": base["tok_embed"],
                    "final_norm": base["final_norm"]}
     out["layers"] = {
-        name: (q_axes(axes) if name in _LAYER_WEIGHTS else axes)
+        name: (q_axes(axes)
+               if name in _LAYER_WEIGHTS or name in _EXPERT_WEIGHTS
+               else axes)
         for name, axes in base["layers"].items()
     }
     if "lm_head" in base:
@@ -131,7 +139,7 @@ def quantize_params(cfg: LlamaConfig, params: Params,
                    "final_norm": place(params["final_norm"])}
     layers = {}
     for name, w in params["layers"].items():
-        if name in _LAYER_WEIGHTS:
+        if name in _LAYER_WEIGHTS or (bits == 8 and name in _EXPERT_WEIGHTS):
             leaf = quant(w)
             layers[name] = (jax.tree_util.tree_map(jnp.asarray, leaf)
                             if commit else leaf)

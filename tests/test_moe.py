@@ -171,3 +171,29 @@ class TestMoeSharded:
             losses.append(float(m["loss"]))
         assert all(np.isfinite(losses))
         assert losses[-1] < losses[0]
+
+
+class TestQuantizedExperts:
+    def test_sparse_matches_dense_reference_int8(self):
+        """The {q8, scale} expert path through BOTH the sparse dispatch and
+        the dense reference (including the dense path's (x, m)-aligned
+        scale broadcast) — same ample-capacity parity as the fp test."""
+        from k8s_runpod_kubelet_tpu.models.quant import _quantize_leaf
+        w = _moe_weights(jax.random.PRNGKey(0))
+        qw = {name: (_quantize_leaf(np.asarray(w[name]))
+                     if name.startswith("we_") else w[name])
+              for name in w}
+        h = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 32), jnp.float32)
+        kw = dict(n_experts_per_tok=2, activation=jax.nn.silu,
+                  dtype=jnp.float32)
+        y, _, _ = moe_mlp(h, qw["router"], qw["we_gate"], qw["we_up"],
+                          qw["we_down"], capacity_factor=4.0, **kw)
+        y_ref = moe_mlp_dense_reference(h, qw["router"], qw["we_gate"],
+                                        qw["we_up"], qw["we_down"], **kw)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                                   rtol=1e-5, atol=1e-5)
+        # and both stay close to the full-precision output (int8 tolerance)
+        y_fp, _, _ = moe_mlp(h, w["router"], w["we_gate"], w["we_up"],
+                             w["we_down"], capacity_factor=4.0, **kw)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y_fp),
+                                   rtol=0.1, atol=0.05)
