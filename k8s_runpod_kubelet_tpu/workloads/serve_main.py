@@ -11,7 +11,8 @@ The pod command for autoscaled inference. Endpoints:
                    line per decoded token, then the final result object
                    (JetStream-style streamed decode)
   POST /v1/completions  OpenAI-compatible completions (prompt/max_tokens/
-                   temperature/top_p/stop/logprobs/seed/n/stream-SSE), so
+                   temperature/top_p/stop/logprobs/seed/n/presence_penalty/
+                   frequency_penalty/stream-SSE), so
                    OpenAI-SDK clients point here unchanged; "model" selects
                    a registered LoRA adapter (vLLM convention); client
                    timeouts cancel the engine-side generation
@@ -227,6 +228,10 @@ class _Handler(BaseHTTPRequestHandler):
                                  req.get("temperature"),
                                  top_k=_or(req.get("top_k"), 0),
                                  top_p=_or(req.get("top_p"), 1.0),
+                                 presence_penalty=_or(
+                                     req.get("presence_penalty"), 0.0),
+                                 frequency_penalty=_or(
+                                     req.get("frequency_penalty"), 0.0),
                                  stop=stop, stop_text=stop_strs,
                                  logprobs=bool(req.get("logprobs")),
                                  adapter=req.get("adapter") or "",
@@ -406,6 +411,8 @@ class _Handler(BaseHTTPRequestHandler):
                       temperature=_or(req.get("temperature"), 1.0),
                       top_p=_or(req.get("top_p"), 1.0), stop=stop,
                       stop_text=stop_strs,
+                      presence_penalty=_or(req.get("presence_penalty"), 0.0),
+                      frequency_penalty=_or(req.get("frequency_penalty"), 0.0),
                       logprobs=want_lp, adapter=adapter, seed=seed)
         except (json.JSONDecodeError, ValueError, TypeError) as e:
             return self._send(400, {"error": {"message": f"{e}",
@@ -608,6 +615,8 @@ class _Handler(BaseHTTPRequestHandler):
                   top_k=_or(req.get("top_k"), 0),
                   top_p=_or(req.get("top_p"), 1.0), stop=stop,
                   stop_text=stop_strs,
+                  presence_penalty=_or(req.get("presence_penalty"), 0.0),
+                  frequency_penalty=_or(req.get("frequency_penalty"), 0.0),
                   adapter=req.get("adapter") or "", seed=req.get("seed"))
 
         def line(payload: dict) -> bytes:

@@ -126,6 +126,16 @@ class Request:
     temperature: float
     top_k: int = 0          # 0 = no top-k filter
     top_p: float = 1.0      # 1.0 = no nucleus filter
+    # OpenAI sampling penalties, applied to the logits BEFORE temperature/
+    # filtering: presence subtracts once per token already in the text
+    # (prompt + generation), frequency per occurrence. A penalized request
+    # never takes the speculative K-wide greedy commit (each committed
+    # token changes the next step's penalties).
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    # prompt token bincount (np int32 (V,)), computed once by the prefill
+    # loop for penalized requests; _admit seeds the slot's counts from it
+    prompt_counts: Optional[Any] = None
     adapter_id: int = 0     # multi-LoRA slot (0 = base model)
     # stop token SEQUENCES: generation ends when the generated tail equals
     # one (the matched sequence stays in the output; callers strip it).
@@ -222,6 +232,39 @@ def _row_keys(seeds: jax.Array, draws: jax.Array) -> jax.Array:
     def one(s, d):
         return jax.random.fold_in(jax.random.PRNGKey(s), d)
     return jax.vmap(one)(seeds, draws)
+
+
+def _penalized(r) -> bool:
+    return r is not None and (r.presence_penalty != 0.0
+                              or r.frequency_penalty != 0.0)
+
+
+@jax.jit
+def _apply_penalties(logits: jax.Array, counts: jax.Array,
+                     presence: jax.Array, frequency: jax.Array) -> jax.Array:
+    """logits (B, V) minus OpenAI penalties from per-slot token counts
+    (B, V): presence once per seen token, frequency per occurrence. Rows
+    with zero penalties pass through unchanged (their counts still exist
+    but multiply by 0)."""
+    c = counts.astype(jnp.float32)
+    pen = (presence[:, None] * (c > 0).astype(jnp.float32)
+           + frequency[:, None] * c)
+    return logits.astype(jnp.float32) - pen
+
+
+@jax.jit
+def _bump_counts(counts: jax.Array, toks: jax.Array,
+                 mask: jax.Array) -> jax.Array:
+    """counts[i, toks[i]] += 1 where mask[i] — fixed (B,) shapes so the
+    per-step update never recompiles."""
+    rows = jnp.arange(counts.shape[0])
+    return counts.at[rows, toks].add(mask.astype(jnp.int32))
+
+
+@jax.jit
+def _set_count_row(counts: jax.Array, slot: jax.Array,
+                   row: jax.Array) -> jax.Array:
+    return counts.at[slot].set(row)
 
 
 def _scaled_and_greedy(logits, temps):
@@ -369,6 +412,11 @@ class ServingEngine:
         self._slot_seed = np.zeros((sc.slots,), np.uint32)
         self._slot_draws = np.zeros((sc.slots,), np.int32)
         self._row_keys = jax.jit(_row_keys)
+        # OpenAI penalties: per-slot token-occurrence counts (slots, V)
+        # int32 on device, allocated lazily at the first penalized request
+        # (slots x 128k-vocab x 4B = ~8MB at 16 slots — but zero cost for
+        # deployments that never send penalties)
+        self._tok_counts: Optional[jax.Array] = None
         # multi-LoRA: preallocated zero stacks; slot 0 stays zero forever
         # (= base model), so adapter selection needs no conditionals
         self._adapters: Optional[dict] = None
@@ -503,6 +551,7 @@ class ServingEngine:
     def submit(self, prompt: list[int], max_new_tokens: Optional[int] = None,
                temperature: Optional[float] = None,
                top_k: int = 0, top_p: float = 1.0,
+               presence_penalty: float = 0.0, frequency_penalty: float = 0.0,
                stop: Optional[list] = None,
                stop_text: Optional[list] = None, logprobs: bool = False,
                adapter: str = "", seed: Optional[int] = None,
@@ -555,6 +604,14 @@ class ServingEngine:
             f.set_exception(ValueError(
                 f"top_p must be in (0, 1], got {top_p!r}"))
             return f
+        for pname, pv in (("presence_penalty", presence_penalty),
+                          ("frequency_penalty", frequency_penalty)):
+            if not isinstance(pv, (int, float)) or isinstance(pv, bool) \
+                    or not -2.0 <= pv <= 2.0:
+                f = Future()
+                f.set_exception(ValueError(
+                    f"{pname} must be in [-2, 2], got {pv!r}"))
+                return f
         stop = stop or []
         if not (isinstance(stop, list) and all(
                 isinstance(s, list) and s
@@ -598,6 +655,8 @@ class ServingEngine:
                       submitted_at=time.perf_counter(),
                       temperature=float(temperature),
                       top_k=top_k, top_p=float(top_p),
+                      presence_penalty=float(presence_penalty),
+                      frequency_penalty=float(frequency_penalty),
                       stop=[list(s) for s in stop],
                       stop_texts=list(stop_text), logprobs=bool(logprobs),
                       adapter_id=adapter_id, seed=seed & 0xFFFFFFFF,
@@ -984,12 +1043,28 @@ class ServingEngine:
                 for r in live:
                     keys = self._row_keys(jnp.asarray([r.seed], jnp.uint32),
                                           jnp.asarray([0], jnp.int32))
-                    first = int(_sample(last_logits, keys, [r.temperature],
+                    row_logits = last_logits
+                    if _penalized(r):
+                        # first token's penalties come from the prompt
+                        # alone; ONE formula (_apply_penalties) and ONE
+                        # bincount per request — _admit reuses the row
+                        c = np.bincount(np.asarray(r.prompt),
+                                        minlength=self.cfg.vocab_size
+                                        )[:self.cfg.vocab_size].astype(
+                                            np.int32)
+                        r.prompt_counts = c
+                        row_logits = _apply_penalties(
+                            last_logits, jnp.asarray(c)[None],
+                            jnp.asarray([r.presence_penalty], jnp.float32),
+                            jnp.asarray([r.frequency_penalty], jnp.float32))
+                    first = int(_sample(row_logits, keys, [r.temperature],
                                         [r.top_k], [r.top_p])[0])
                     first_lp = None
                     if r.logprobs:
+                        # from the distribution actually sampled (penalized
+                        # when penalties are on — same as every later token)
                         first_lp = float(jax.nn.log_softmax(
-                            last_logits[0].astype(jnp.float32))[first])
+                            row_logits[0].astype(jnp.float32))[first])
                     entries.append((r, single, first, first_lp))
             except Exception as exc:  # noqa: BLE001 — poisoned prompt only
                 log.exception("prefill of %s failed", req.rid)
@@ -1022,6 +1097,28 @@ class ServingEngine:
             self._slot_adapter[slot_id] = req.adapter_id
             self._slot_seed[slot_id] = req.seed
             self._slot_draws[slot_id] = 1  # draw 0 was the prefill token
+            if _penalized(req):
+                # seed this slot's counts from prompt + the first token
+                # ("text so far", OpenAI semantics); the prompt bincount
+                # was computed once in the prefill loop
+                if self._tok_counts is None:
+                    self._tok_counts = jnp.zeros(
+                        (self.sc.slots, self.cfg.vocab_size), jnp.int32)
+                row = getattr(req, "prompt_counts", None)
+                if row is None:
+                    row = np.bincount(np.asarray(req.prompt),
+                                      minlength=self.cfg.vocab_size
+                                      )[:self.cfg.vocab_size].astype(np.int32)
+                row = row.copy()
+                row[first] += 1
+                self._tok_counts = _set_count_row(
+                    self._tok_counts, jnp.asarray(slot_id),
+                    jnp.asarray(row))
+            elif self._tok_counts is not None:
+                # a stale penalized row must not leak into this request
+                self._tok_counts = _set_count_row(
+                    self._tok_counts, jnp.asarray(slot_id),
+                    jnp.zeros((self.cfg.vocab_size,), jnp.int32))
             slot.request = req
             slot.generated = [first]
             slot.logprobs = [first_lp] if first_lp is not None else []
@@ -1083,8 +1180,10 @@ class ServingEngine:
         slots = self._slots
         b = len(slots)
         active = [s.request is not None for s in slots]
+        # penalized slots never K-commit: every committed token changes the
+        # next token's penalties, so a K-wide greedy run is stale after 1
         if not any(active[i] and slots[i].request.temperature <= 0.0
-                   for i in range(b)):
+                   and not _penalized(slots[i].request) for i in range(b)):
             return False
         active_mask = jnp.asarray(active)
         toks_in = np.zeros((b, k + 1), np.int32)
@@ -1093,7 +1192,7 @@ class ServingEngine:
             if not active[i]:
                 continue
             toks_in[i, 0] = slot.last_token
-            if slot.request.temperature <= 0.0:
+            if slot.request.temperature <= 0.0 and not _penalized(slot.request):
                 toks_in[i, 1:] = self._propose(slot, k)
                 n_greedy += 1
             else:
@@ -1112,28 +1211,32 @@ class ServingEngine:
         # full-precision; gate each on the slot kind that actually reads it
         greedy_lp = None
         if any(r is not None and r.logprobs and r.temperature <= 0.0
-               for r in reqs):
+               and not _penalized(r) for r in reqs):
             # lp of the argmax token = max - logsumexp, no (V,) gather
             greedy_lp = np.asarray(jnp.max(logits, axis=-1)
                                    - jax.nn.logsumexp(logits, axis=-1))
         sampled_np = sampled_lp = None
-        if any(t > 0.0 for t in temps):
+        if any(t > 0.0 for t in temps) or any(_penalized(r) for r in reqs):
+            l0 = self._maybe_penalize(logits[:, 0], reqs)
             sampled_np = np.asarray(self._sample_batch(
-                logits[:, 0], temps,
+                l0, temps,
                 [r.top_k if r else 0 for r in reqs],
                 [r.top_p if r else 1.0 for r in reqs]))
-            if any(r is not None and r.logprobs and r.temperature > 0.0
+            if any(r is not None and r.logprobs
+                   and (r.temperature > 0.0 or _penalized(r))
                    for r in reqs):
-                logp0 = jax.nn.log_softmax(logits[:, 0], axis=-1)
+                logp0 = jax.nn.log_softmax(l0.astype(jnp.float32), axis=-1)
                 sampled_lp = np.asarray(jnp.take_along_axis(
                     logp0, jnp.asarray(sampled_np)[:, None], axis=-1)[:, 0])
+            self._bump_penalty_counts(reqs, sampled_np)
         self.metrics.incr("tpu_serving_spec_proposed", k * n_greedy)
 
         advance = np.zeros((b,), np.int32)
         for i, slot in enumerate(slots):
             if not active[i]:
                 continue
-            greedy_slot = slot.request.temperature <= 0.0
+            greedy_slot = (slot.request.temperature <= 0.0
+                           and not _penalized(slot.request))
             if greedy_slot:
                 committed = []
                 for j in range(k + 1):
@@ -1186,8 +1289,10 @@ class ServingEngine:
         temps = [r.temperature if r else 0.0 for r in reqs]
         ks = [r.top_k if r else 0 for r in reqs]
         ps = [r.top_p if r else 1.0 for r in reqs]
+        logits = self._maybe_penalize(logits, reqs)
         # sample per slot (temperature / top-k / top-p can differ per request)
         next_np = np.asarray(self._sample_batch(logits, temps, ks, ps))
+        self._bump_penalty_counts(reqs, next_np)
         lp_np = None
         if any(r is not None and r.logprobs for r in reqs):
             logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
@@ -1208,6 +1313,28 @@ class ServingEngine:
                 self._complete(slot_id, slot)
         self._tokens = jnp.asarray(next_np, jnp.int32)
         self.metrics.incr("tpu_serving_decode_steps")
+
+    def _maybe_penalize(self, logits: jax.Array, reqs) -> jax.Array:
+        """Apply OpenAI presence/frequency penalties to (B, V) logits for
+        the slots that asked for them; identity (and zero device work)
+        when nobody did."""
+        if self._tok_counts is None or not any(_penalized(r) for r in reqs):
+            return logits
+        pres = jnp.asarray([r.presence_penalty if r else 0.0 for r in reqs],
+                           jnp.float32)
+        freq = jnp.asarray([r.frequency_penalty if r else 0.0 for r in reqs],
+                           jnp.float32)
+        return _apply_penalties(logits, self._tok_counts, pres, freq)
+
+    def _bump_penalty_counts(self, reqs, next_np):
+        """Record this step's committed token for each penalized slot
+        (fixed shapes: one jitted scatter regardless of who is penalized)."""
+        if self._tok_counts is None or not any(_penalized(r) for r in reqs):
+            return
+        mask = np.asarray([_penalized(r) for r in reqs])
+        self._tok_counts = _bump_counts(
+            self._tok_counts, jnp.asarray(np.asarray(next_np, np.int32)),
+            jnp.asarray(mask))
 
     def _sample_batch(self, logits: jax.Array, temps: list[float],
                       top_ks: Optional[list[int]] = None,

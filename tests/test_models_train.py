@@ -530,3 +530,45 @@ class TestGradAccumAndEval:
         tr.run(steps=6, batches=fixed_stream())
         b1 = tr.evaluate(batches=fixed_stream(), steps=1)
         assert b1["eval_loss"] < b0["eval_loss"], (b1, b0)
+
+
+class TestAsyncCheckpoint:
+    def test_async_save_is_durable_at_boundaries(self, tmp_path):
+        """Async save returns after staging; wait_pending() makes it
+        durable for a successor process (a crash before the write lands
+        loses that checkpoint BY DESIGN — orbax commit markers keep the
+        directory consistent; checkpoint_every bounds the loss)."""
+        tc = TrainConfig(batch_size=2, seq_len=16, steps=2,
+                         checkpoint_dir=str(tmp_path / "ckpt"),
+                         checkpoint_every=100, async_checkpoint=True)
+        t1 = Trainer(CFG, tc)
+        t1.run(steps=2)
+        t1.save()                      # staged; write in background
+        t1.wait_pending()              # what run()'s boundary does
+        t2 = Trainer(CFG, tc)
+        assert t2.restore() is True
+        assert t2.step == t1.step
+        np.testing.assert_allclose(np.asarray(t1.params["final_norm"]),
+                                   np.asarray(t2.params["final_norm"]))
+
+    def test_run_boundary_makes_loop_saves_durable(self, tmp_path):
+        """Saves triggered INSIDE run() by checkpoint_every are durable
+        when run() returns — a successor restores with no extra waiting."""
+        tc = TrainConfig(batch_size=2, seq_len=16, steps=4,
+                         checkpoint_dir=str(tmp_path / "ckpt"),
+                         checkpoint_every=2, async_checkpoint=True)
+        t1 = Trainer(CFG, tc)
+        t1.run(steps=4)                # saves at steps 2 and 4, waits at end
+        t2 = Trainer(CFG, tc)
+        assert t2.restore() is True
+        assert t2.step == 4
+
+    def test_blocking_save_still_available(self, tmp_path):
+        tc = TrainConfig(batch_size=2, seq_len=16, steps=1,
+                         checkpoint_dir=str(tmp_path / "ckpt"),
+                         checkpoint_every=100, async_checkpoint=False)
+        t1 = Trainer(CFG, tc)
+        t1.run(steps=1)
+        t1.save()                      # blocks until durable
+        t2 = Trainer(CFG, tc)
+        assert t2.restore() is True

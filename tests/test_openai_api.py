@@ -358,3 +358,36 @@ class TestOpenAiCompletions:
                     {"tokens": [65, 66], "max_new_tokens": 8,
                      "stop": stop_str})
         assert out["tokens"] == full["tokens"][:4]
+
+
+class TestPenaltiesHttp:
+    def test_penalties_flow_through_completions(self, tmp_path):
+        """presence/frequency penalties reach the engine from both
+        /v1/completions and /generate and change a greedy repetition."""
+        import jax
+        from k8s_runpod_kubelet_tpu.models import init_params
+        from k8s_runpod_kubelet_tpu.workloads.serve_main import serve
+        from k8s_runpod_kubelet_tpu.workloads.tokenizer import get_tokenizer
+        e = ServingEngine(CFG, init_params(CFG, jax.random.PRNGKey(0)),
+                          ServingConfig(slots=2, max_prefill_len=16,
+                                        cache_len=64, max_new_tokens=16)
+                          ).start()
+        httpd = serve(e, 0, tokenizer=get_tokenizer("bytes"))
+        port = httpd.server_address[1]
+        try:
+            base = _post(port, "/generate",
+                         {"tokens": [5, 9, 2, 5, 9, 2],
+                          "max_new_tokens": 8})["tokens"]
+            pen = _post(port, "/generate",
+                        {"tokens": [5, 9, 2, 5, 9, 2], "max_new_tokens": 8,
+                         "presence_penalty": 2.0,
+                         "frequency_penalty": 2.0})["tokens"]
+            assert base != pen
+            out = _post(port, "/v1/completions",
+                        {"prompt": [5, 9, 2], "max_tokens": 6,
+                         "temperature": 0,
+                         "presence_penalty": 1.5, "frequency_penalty": 1.0})
+            assert out["usage"]["completion_tokens"] == 6
+        finally:
+            httpd.shutdown()
+            e.stop()

@@ -1,0 +1,110 @@
+"""OpenAI presence/frequency penalties: engine semantics (counts seeded from
+the prompt, per-commit updates, slot-reuse isolation), speculative-path
+exclusion, and HTTP plumbing."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from k8s_runpod_kubelet_tpu.models import init_params, tiny_llama
+from k8s_runpod_kubelet_tpu.workloads.serving import (ServingConfig,
+                                                      ServingEngine,
+                                                      _apply_penalties)
+
+pytestmark = pytest.mark.slow
+
+CFG = tiny_llama(vocab_size=96, embed_dim=64, n_layers=2, n_heads=4,
+                 n_kv_heads=2, mlp_dim=128, max_seq_len=128,
+                 dtype=jnp.float32, param_dtype=jnp.float32)
+
+
+def _engine(**kw):
+    sc = ServingConfig(slots=2, cache_len=64, max_new_tokens=8,
+                       max_prefill_len=16, **kw)
+    return ServingEngine(CFG, init_params(CFG, jax.random.PRNGKey(0)),
+                         sc).start()
+
+
+class TestPenaltyMath:
+    def test_apply_penalties_formula(self):
+        logits = jnp.zeros((2, 5))
+        counts = jnp.asarray([[0, 1, 3, 0, 0], [0, 0, 0, 0, 0]], jnp.int32)
+        out = np.asarray(_apply_penalties(
+            logits, counts, jnp.asarray([0.5, 0.5]), jnp.asarray([0.25, 0.25])))
+        np.testing.assert_allclose(out[0], [0, -0.75, -1.25, 0, 0])
+        np.testing.assert_allclose(out[1], [0, 0, 0, 0, 0])  # no occurrences
+
+
+class TestEnginePenalties:
+    def test_frequency_penalty_changes_greedy_repetition(self):
+        """A strong frequency penalty must break the greedy path's loops:
+        the penalized output has strictly more distinct tokens (or differs)
+        vs the unpenalized greedy output for the same prompt."""
+        eng = _engine()
+        try:
+            prompt = [5, 9, 2, 5, 9, 2]
+            base = eng.submit(prompt, max_new_tokens=8).result(
+                timeout=240)["tokens"]
+            pen = eng.submit(prompt, max_new_tokens=8,
+                             frequency_penalty=2.0,
+                             presence_penalty=2.0).result(
+                timeout=240)["tokens"]
+        finally:
+            eng.stop()
+        assert base != pen
+        # the penalized run must not emit any token more than ~twice while
+        # the greedy run on a random tiny model typically cycles
+        counts = {t: pen.count(t) for t in pen}
+        assert max(counts.values()) <= 2, (pen, base)
+
+    def test_slot_reuse_resets_counts(self):
+        """A later UNpenalized request in the same slot must match the
+        engine's normal greedy output — no stale penalties leak."""
+        eng = _engine()
+        try:
+            prompt = [7, 3, 1]
+            clean = eng.submit(prompt, max_new_tokens=6).result(
+                timeout=240)["tokens"]
+            eng.submit(prompt, max_new_tokens=6, presence_penalty=2.0,
+                       frequency_penalty=2.0).result(timeout=240)
+            again = eng.submit(prompt, max_new_tokens=6).result(
+                timeout=240)["tokens"]
+        finally:
+            eng.stop()
+        assert clean == again
+
+    def test_penalized_skips_speculative_k_commit(self):
+        """With speculation on, a penalized greedy request must commit one
+        token per step (every commit changes the next step's penalties) —
+        and the output must equal the non-speculative engine's penalized
+        output."""
+        kw = dict(frequency_penalty=1.5, presence_penalty=0.5)
+        prompt = [5, 9, 2, 5, 9, 2]
+        eng1 = _engine()
+        try:
+            want = eng1.submit(prompt, max_new_tokens=8, **kw).result(
+                timeout=240)["tokens"]
+        finally:
+            eng1.stop()
+        eng2 = _engine(speculate_k=3)
+        try:
+            got = eng2.submit(prompt, max_new_tokens=8, **kw).result(
+                timeout=240)["tokens"]
+            accepted = eng2.metrics.get_counter("tpu_serving_spec_accepted")
+        finally:
+            eng2.stop()
+        assert got == want
+        assert not accepted  # no K-wide commits happened for this request
+
+    def test_validation(self):
+        eng = _engine()
+        try:
+            f = eng.submit([1, 2], presence_penalty=3.0)
+            with pytest.raises(ValueError, match="presence_penalty"):
+                f.result(timeout=10)
+            f = eng.submit([1, 2], frequency_penalty=-2.5)
+            with pytest.raises(ValueError, match="frequency_penalty"):
+                f.result(timeout=10)
+        finally:
+            eng.stop()
