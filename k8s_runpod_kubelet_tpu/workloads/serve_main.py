@@ -12,7 +12,7 @@ The pod command for autoscaled inference. Endpoints:
                    (JetStream-style streamed decode)
   POST /v1/completions  OpenAI-compatible completions (prompt/max_tokens/
                    temperature/top_p/stop/logprobs/seed/n/presence_penalty/
-                   frequency_penalty/stream-SSE), so
+                   frequency_penalty/logit_bias/stream-SSE), so
                    OpenAI-SDK clients point here unchanged; "model" selects
                    a registered LoRA adapter (vLLM convention); client
                    timeouts cancel the engine-side generation
@@ -232,6 +232,7 @@ class _Handler(BaseHTTPRequestHandler):
                                      req.get("presence_penalty"), 0.0),
                                  frequency_penalty=_or(
                                      req.get("frequency_penalty"), 0.0),
+                                 logit_bias=req.get("logit_bias"),
                                  stop=stop, stop_text=stop_strs,
                                  logprobs=bool(req.get("logprobs")),
                                  adapter=req.get("adapter") or "",
@@ -413,6 +414,7 @@ class _Handler(BaseHTTPRequestHandler):
                       stop_text=stop_strs,
                       presence_penalty=_or(req.get("presence_penalty"), 0.0),
                       frequency_penalty=_or(req.get("frequency_penalty"), 0.0),
+                      logit_bias=req.get("logit_bias"),
                       logprobs=want_lp, adapter=adapter, seed=seed)
         except (json.JSONDecodeError, ValueError, TypeError) as e:
             return self._send(400, {"error": {"message": f"{e}",
@@ -617,6 +619,7 @@ class _Handler(BaseHTTPRequestHandler):
                   stop_text=stop_strs,
                   presence_penalty=_or(req.get("presence_penalty"), 0.0),
                   frequency_penalty=_or(req.get("frequency_penalty"), 0.0),
+                  logit_bias=req.get("logit_bias"),
                   adapter=req.get("adapter") or "", seed=req.get("seed"))
 
         def line(payload: dict) -> bytes:

@@ -136,6 +136,9 @@ class Request:
     # prompt token bincount (np int32 (V,)), computed once by the prefill
     # loop for penalized requests; _admit seeds the slot's counts from it
     prompt_counts: Optional[Any] = None
+    # OpenAI logit_bias: {token_id: bias in [-100, 100]} added to that
+    # token's logit every step (-100 ~ ban, +100 ~ force)
+    logit_bias: Optional[dict] = None
     adapter_id: int = 0     # multi-LoRA slot (0 = base model)
     # stop token SEQUENCES: generation ends when the generated tail equals
     # one (the matched sequence stays in the output; callers strip it).
@@ -237,6 +240,22 @@ def _row_keys(seeds: jax.Array, draws: jax.Array) -> jax.Array:
 def _penalized(r) -> bool:
     return r is not None and (r.presence_penalty != 0.0
                               or r.frequency_penalty != 0.0)
+
+
+def _bias_row(logit_bias: dict, vocab_size: int) -> np.ndarray:
+    """Dense (V,) f32 additive row from an OpenAI logit_bias map — ONE
+    construction for the first-token path and the per-slot steady state."""
+    row = np.zeros((vocab_size,), np.float32)
+    for t, bias in logit_bias.items():
+        row[int(t)] = float(bias)
+    return row
+
+
+def _logit_modded(r) -> bool:
+    """Penalties or logit_bias: the next token must come from MODIFIED
+    logits, so the speculative K-wide greedy commit (which compares raw
+    argmaxes) is off the table for these requests."""
+    return _penalized(r) or (r is not None and bool(r.logit_bias))
 
 
 @jax.jit
@@ -417,6 +436,8 @@ class ServingEngine:
         # (slots x 128k-vocab x 4B = ~8MB at 16 slots — but zero cost for
         # deployments that never send penalties)
         self._tok_counts: Optional[jax.Array] = None
+        # OpenAI logit_bias: per-slot (V,) additive rows, same lazy scheme
+        self._logit_bias: Optional[jax.Array] = None
         # multi-LoRA: preallocated zero stacks; slot 0 stays zero forever
         # (= base model), so adapter selection needs no conditionals
         self._adapters: Optional[dict] = None
@@ -552,6 +573,7 @@ class ServingEngine:
                temperature: Optional[float] = None,
                top_k: int = 0, top_p: float = 1.0,
                presence_penalty: float = 0.0, frequency_penalty: float = 0.0,
+               logit_bias: Optional[dict] = None,
                stop: Optional[list] = None,
                stop_text: Optional[list] = None, logprobs: bool = False,
                adapter: str = "", seed: Optional[int] = None,
@@ -612,6 +634,23 @@ class ServingEngine:
                 f.set_exception(ValueError(
                     f"{pname} must be in [-2, 2], got {pv!r}"))
                 return f
+        if logit_bias:
+            try:
+                logit_bias = {int(t): float(bias)
+                              for t, bias in logit_bias.items()}
+            except (TypeError, ValueError, AttributeError):
+                f = Future()
+                f.set_exception(ValueError(
+                    "logit_bias must map token ids to numbers"))
+                return f
+            if not all(0 <= t < self.cfg.vocab_size
+                       and -100.0 <= bias <= 100.0
+                       for t, bias in logit_bias.items()):
+                f = Future()
+                f.set_exception(ValueError(
+                    "logit_bias keys must be valid token ids and biases "
+                    "in [-100, 100]"))
+                return f
         stop = stop or []
         if not (isinstance(stop, list) and all(
                 isinstance(s, list) and s
@@ -657,6 +696,7 @@ class ServingEngine:
                       top_k=top_k, top_p=float(top_p),
                       presence_penalty=float(presence_penalty),
                       frequency_penalty=float(frequency_penalty),
+                      logit_bias=logit_bias or None,
                       stop=[list(s) for s in stop],
                       stop_texts=list(stop_text), logprobs=bool(logprobs),
                       adapter_id=adapter_id, seed=seed & 0xFFFFFFFF,
@@ -1044,6 +1084,10 @@ class ServingEngine:
                     keys = self._row_keys(jnp.asarray([r.seed], jnp.uint32),
                                           jnp.asarray([0], jnp.int32))
                     row_logits = last_logits
+                    if r.logit_bias:
+                        brow = _bias_row(r.logit_bias, self.cfg.vocab_size)
+                        row_logits = (row_logits.astype(jnp.float32)
+                                      + jnp.asarray(brow)[None, :])
                     if _penalized(r):
                         # first token's penalties come from the prompt
                         # alone; ONE formula (_apply_penalties) and ONE
@@ -1054,7 +1098,7 @@ class ServingEngine:
                                             np.int32)
                         r.prompt_counts = c
                         row_logits = _apply_penalties(
-                            last_logits, jnp.asarray(c)[None],
+                            row_logits, jnp.asarray(c)[None],
                             jnp.asarray([r.presence_penalty], jnp.float32),
                             jnp.asarray([r.frequency_penalty], jnp.float32))
                     first = int(_sample(row_logits, keys, [r.temperature],
@@ -1119,6 +1163,18 @@ class ServingEngine:
                 self._tok_counts = _set_count_row(
                     self._tok_counts, jnp.asarray(slot_id),
                     jnp.zeros((self.cfg.vocab_size,), jnp.int32))
+            if req.logit_bias:
+                if self._logit_bias is None:
+                    self._logit_bias = jnp.zeros(
+                        (self.sc.slots, self.cfg.vocab_size), jnp.float32)
+                self._logit_bias = _set_count_row(
+                    self._logit_bias, jnp.asarray(slot_id),
+                    jnp.asarray(_bias_row(req.logit_bias,
+                                          self.cfg.vocab_size)))
+            elif self._logit_bias is not None:
+                self._logit_bias = _set_count_row(
+                    self._logit_bias, jnp.asarray(slot_id),
+                    jnp.zeros((self.cfg.vocab_size,), jnp.float32))
             slot.request = req
             slot.generated = [first]
             slot.logprobs = [first_lp] if first_lp is not None else []
@@ -1183,7 +1239,7 @@ class ServingEngine:
         # penalized slots never K-commit: every committed token changes the
         # next token's penalties, so a K-wide greedy run is stale after 1
         if not any(active[i] and slots[i].request.temperature <= 0.0
-                   and not _penalized(slots[i].request) for i in range(b)):
+                   and not _logit_modded(slots[i].request) for i in range(b)):
             return False
         active_mask = jnp.asarray(active)
         toks_in = np.zeros((b, k + 1), np.int32)
@@ -1192,7 +1248,8 @@ class ServingEngine:
             if not active[i]:
                 continue
             toks_in[i, 0] = slot.last_token
-            if slot.request.temperature <= 0.0 and not _penalized(slot.request):
+            if (slot.request.temperature <= 0.0
+                    and not _logit_modded(slot.request)):
                 toks_in[i, 1:] = self._propose(slot, k)
                 n_greedy += 1
             else:
@@ -1211,19 +1268,20 @@ class ServingEngine:
         # full-precision; gate each on the slot kind that actually reads it
         greedy_lp = None
         if any(r is not None and r.logprobs and r.temperature <= 0.0
-               and not _penalized(r) for r in reqs):
+               and not _logit_modded(r) for r in reqs):
             # lp of the argmax token = max - logsumexp, no (V,) gather
             greedy_lp = np.asarray(jnp.max(logits, axis=-1)
                                    - jax.nn.logsumexp(logits, axis=-1))
         sampled_np = sampled_lp = None
-        if any(t > 0.0 for t in temps) or any(_penalized(r) for r in reqs):
+        if any(t > 0.0 for t in temps) or any(_logit_modded(r)
+                                              for r in reqs):
             l0 = self._maybe_penalize(logits[:, 0], reqs)
             sampled_np = np.asarray(self._sample_batch(
                 l0, temps,
                 [r.top_k if r else 0 for r in reqs],
                 [r.top_p if r else 1.0 for r in reqs]))
             if any(r is not None and r.logprobs
-                   and (r.temperature > 0.0 or _penalized(r))
+                   and (r.temperature > 0.0 or _logit_modded(r))
                    for r in reqs):
                 logp0 = jax.nn.log_softmax(l0.astype(jnp.float32), axis=-1)
                 sampled_lp = np.asarray(jnp.take_along_axis(
@@ -1236,7 +1294,7 @@ class ServingEngine:
             if not active[i]:
                 continue
             greedy_slot = (slot.request.temperature <= 0.0
-                           and not _penalized(slot.request))
+                           and not _logit_modded(slot.request))
             if greedy_slot:
                 committed = []
                 for j in range(k + 1):
@@ -1315,16 +1373,19 @@ class ServingEngine:
         self.metrics.incr("tpu_serving_decode_steps")
 
     def _maybe_penalize(self, logits: jax.Array, reqs) -> jax.Array:
-        """Apply OpenAI presence/frequency penalties to (B, V) logits for
-        the slots that asked for them; identity (and zero device work)
-        when nobody did."""
-        if self._tok_counts is None or not any(_penalized(r) for r in reqs):
-            return logits
-        pres = jnp.asarray([r.presence_penalty if r else 0.0 for r in reqs],
-                           jnp.float32)
-        freq = jnp.asarray([r.frequency_penalty if r else 0.0 for r in reqs],
-                           jnp.float32)
-        return _apply_penalties(logits, self._tok_counts, pres, freq)
+        """Apply OpenAI presence/frequency penalties and logit_bias to
+        (B, V) logits for the slots that asked for them; identity (and
+        zero device work) when nobody did."""
+        if self._tok_counts is not None and any(_penalized(r) for r in reqs):
+            pres = jnp.asarray(
+                [r.presence_penalty if r else 0.0 for r in reqs], jnp.float32)
+            freq = jnp.asarray(
+                [r.frequency_penalty if r else 0.0 for r in reqs], jnp.float32)
+            logits = _apply_penalties(logits, self._tok_counts, pres, freq)
+        if self._logit_bias is not None and any(
+                r is not None and r.logit_bias for r in reqs):
+            logits = logits.astype(jnp.float32) + self._logit_bias
+        return logits
 
     def _bump_penalty_counts(self, reqs, next_np):
         """Record this step's committed token for each penalized slot
