@@ -18,6 +18,8 @@ The pod command for autoscaled inference. Endpoints:
                    timeouts cancel the engine-side generation
   POST /v1/chat/completions  OpenAI chat (messages through the model's own
                    HF chat template when present), stream or not
+  POST /v1/embeddings  OpenAI embeddings: mean-pooled final-norm hidden
+                   states (string/tokens/lists input)
   POST /prefix     register a shared prompt prefix (system prompt): its KV
                    prefills once; prompts starting with it skip it
   POST /adapters   {"name": ..., "path": adapter.npz} — register a trained
@@ -160,6 +162,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._openai_completion(chat=False)
         if self.path == "/v1/chat/completions":
             return self._openai_completion(chat=True)
+        if self.path == "/v1/embeddings":
+            return self._openai_embeddings()
         if self.path == "/adapters":
             # register a LoRA adapter from a save_adapter() .npz so trained
             # adapters go live without a restart (multi-LoRA serving).
@@ -335,6 +339,61 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.flush()
         except (BrokenPipeError, ConnectionError, OSError):
             dead.set()  # engine cancels at its next on_token call
+
+    def _openai_embeddings(self):
+        """OpenAI /v1/embeddings: mean-pooled final-norm hidden states.
+        ``input`` is a string, list of strings, token list, or list of
+        token lists (OpenAI accepts all four)."""
+        try:
+            req = self._read_json()
+            model_req = req.get("model")
+            if model_req and model_req != self.engine.cfg.name:
+                # adapters change only the projection weights the COMPLETION
+                # jits apply; the embed forward runs base weights, so
+                # silently answering for an adapter name would misattribute
+                # the result (known adapter or not: same honest refusal)
+                return self._send(
+                    404 if model_req not in self.engine.adapter_names
+                    else 400,
+                    {"error": {"message":
+                               f"model {model_req!r} is not served by "
+                               "/v1/embeddings (base model "
+                               f"{self.engine.cfg.name!r} only)",
+                               "type": "invalid_request_error"}})
+            raw = req.get("input")
+            if raw is None:
+                raise ValueError("missing input")
+            if isinstance(raw, str) or (
+                    isinstance(raw, list) and raw
+                    and all(isinstance(t, int) for t in raw)):
+                raw = [raw]
+            if not isinstance(raw, list) or not raw:
+                raise ValueError("input must be a non-empty string/list")
+            data = []
+            total_toks = 0
+            for i, item in enumerate(raw):
+                if isinstance(item, str):
+                    if self.tokenizer is None:
+                        raise ValueError("string input needs --tokenizer")
+                    toks = self.tokenizer.encode(item)
+                elif (isinstance(item, list) and item
+                      and all(isinstance(t, int) for t in item)):
+                    toks = item
+                else:
+                    raise ValueError(f"input[{i}] must be a string or a "
+                                     "non-empty token list")
+                total_toks += len(toks)
+                data.append({"object": "embedding", "index": i,
+                             "embedding": self.engine.embed(toks)})
+        except (json.JSONDecodeError, ValueError, TypeError,
+                OverflowError) as e:
+            return self._send(400, {"error": {"message": str(e),
+                                              "type": "invalid_request_error"}})
+        return self._send(200, {
+            "object": "list", "data": data,
+            "model": self.engine.cfg.name,
+            "usage": {"prompt_tokens": total_toks,
+                      "total_tokens": total_toks}})
 
     def _openai_completion(self, chat: bool):
         """OpenAI-compatible POST /v1/completions and /v1/chat/completions:

@@ -438,6 +438,8 @@ class ServingEngine:
         self._tok_counts: Optional[jax.Array] = None
         # OpenAI logit_bias: per-slot (V,) additive rows, same lazy scheme
         self._logit_bias: Optional[jax.Array] = None
+        # /v1/embeddings: one pooled-forward jit per prefill bucket
+        self._embed_fns: dict[int, Any] = {}
         # multi-LoRA: preallocated zero stacks; slot 0 stays zero forever
         # (= base model), so adapter selection needs no conditionals
         self._adapters: Optional[dict] = None
@@ -897,6 +899,45 @@ class ServingEngine:
             self._single_ad_ids(adapter_id))
         return self._append_chunks(single, tokens[len(head):], last_logits,
                                    adapter_id, adapters)
+
+    def embed(self, tokens: list[int]) -> list[float]:
+        """Mean-pooled final-norm hidden state of the prompt — the
+        /v1/embeddings backing. Reuses the prefill compile buckets (one
+        jit per bucket; the padding positions are masked out of the mean,
+        so the same prompt embeds identically in any bucket). Runs on the
+        caller's thread: device work serializes with decode steps, which
+        is the right trade for a secondary endpoint (no queueing machinery
+        for a forward pass)."""
+        if not tokens:
+            raise ValueError("empty input")
+        if len(tokens) > self.sc.max_prefill_len:
+            # erroring beats silent truncation (OpenAI rejects over-long
+            # embedding inputs too): usage/billing must reflect what was
+            # actually embedded
+            raise ValueError(
+                f"input length {len(tokens)} exceeds this server's "
+                f"embedding context ({self.sc.max_prefill_len} tokens)")
+        if not all(isinstance(t, int) and 0 <= t < self.cfg.vocab_size
+                   for t in tokens):
+            raise ValueError("input token ids must be within the vocabulary")
+        bucket = self._bucket_len(len(tokens))
+        fn = self._embed_fns.get(bucket)
+        if fn is None:
+            model = self.model
+
+            def pooled(params, toks, n):
+                hidden = model.forward(params, toks, return_hidden=True)
+                # pool in f32: bf16 accumulation over hundreds of positions
+                # loses ~1e-2 relative precision, and n itself may not be
+                # bf16-representable
+                h32 = hidden.astype(jnp.float32)
+                mask = (jnp.arange(h32.shape[1]) < n)[None, :, None]
+                s = jnp.sum(h32 * mask, axis=1)
+                return (s / n.astype(jnp.float32))[0]
+
+            fn = self._embed_fns[bucket] = jax.jit(pooled)
+        arr, n = self._padded(tokens)
+        return [float(x) for x in np.asarray(fn(self.params, arr, n[0]))]
 
     def _prefill_tokens(self, tokens: list[int], adapter_id: int = 0
                         ) -> tuple[Any, Params]:

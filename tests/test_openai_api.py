@@ -391,3 +391,66 @@ class TestPenaltiesHttp:
         finally:
             httpd.shutdown()
             e.stop()
+
+
+class TestEmbeddings:
+    @pytest.fixture(scope="class")
+    def eserver(self, params):
+        from k8s_runpod_kubelet_tpu.workloads.serve_main import serve
+        from k8s_runpod_kubelet_tpu.workloads.tokenizer import get_tokenizer
+        e = ServingEngine(CFG, params,
+                          ServingConfig(slots=2, max_prefill_len=32,
+                                        cache_len=64)).start()
+        httpd = serve(e, 0, tokenizer=get_tokenizer("bytes"))
+        yield httpd.server_address[1], e
+        httpd.shutdown()
+        e.stop()
+
+    def test_shape_and_usage(self, eserver):
+        port, e = eserver
+        out = _post(port, "/v1/embeddings", {"input": [5, 9, 2]})
+        assert out["object"] == "list"
+        assert len(out["data"]) == 1
+        emb = out["data"][0]["embedding"]
+        assert len(emb) == CFG.embed_dim
+        assert out["usage"]["prompt_tokens"] == 3
+
+    def test_padding_excluded_from_mean(self, eserver):
+        """engine.embed pads 4 tokens to the 16 bucket; the result must
+        equal the mean hidden state of an UNPADDED forward — the padding
+        positions are masked out of the pooling, not averaged in."""
+        import jax.numpy as jnp
+        import numpy as np
+        port, e = eserver
+        toks = [5, 9, 2, 7]
+        got = np.asarray(e.embed(toks))
+        hidden = e.model.forward(e.params, jnp.asarray([toks]),
+                                 return_hidden=True)
+        want = np.asarray(jnp.mean(hidden[0].astype(jnp.float32), axis=0))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+        # deterministic across calls (cached jit)
+        assert e.embed(toks) == e.embed(toks)
+        out = _post(port, "/v1/embeddings", {"input": ["hi", "there"]})
+        assert [d["index"] for d in out["data"]] == [0, 1]
+        assert len(out["data"][0]["embedding"]) == CFG.embed_dim
+
+    def test_bad_input_400(self, eserver):
+        port, _ = eserver
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(port, "/v1/embeddings", {"input": []})
+        assert ei.value.code == 400
+
+    def test_overlong_and_bad_ids_400(self, eserver):
+        port, _ = eserver
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(port, "/v1/embeddings", {"input": [1] * 200})  # > 32 ctx
+        assert ei.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(port, "/v1/embeddings", {"input": [70000000000000]})
+        assert ei.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(port, "/v1/embeddings",
+                  {"input": [1, 2], "model": "no-such-adapter"})
+        assert ei.value.code == 404
