@@ -198,3 +198,66 @@ class TestLogitBias:
         # penalties outweigh... they don't at these magnitudes, but the
         # first position is the regression's subject
         assert out[0] == 42, out
+
+
+class TestComposition:
+    def test_penalties_with_chunked_prefill(self):
+        """A prompt longer than max_prefill_len runs chunked; the prompt
+        bincount must still cover ALL of it."""
+        eng = _engine()   # max_prefill_len=16
+        try:
+            prompt = list(range(1, 41))  # 40 tokens -> chunked prefill
+            out = eng.submit(prompt, max_new_tokens=6,
+                             presence_penalty=2.0,
+                             frequency_penalty=2.0).result(
+                timeout=240)["tokens"]
+            # every prompt token is penalized: generation avoids them
+            # (random tiny model: at least the max-count property holds)
+            counts = {t: out.count(t) for t in out}
+            assert max(counts.values()) <= 2
+        finally:
+            eng.stop()
+
+    def test_bias_with_kv_int8_and_ring(self):
+        """logit_bias composes with the exotic cache paths (int8 KV)."""
+        eng = _engine(quantize_kv_int8=True)
+        try:
+            out = eng.submit([7, 3, 1], max_new_tokens=4,
+                             logit_bias={42: 100.0}).result(
+                timeout=240)["tokens"]
+        finally:
+            eng.stop()
+        assert out == [42] * 4
+
+    def test_penalties_with_int8_weights(self):
+        eng = _engine(quantize_int8=True)
+        try:
+            out = eng.submit([5, 9, 2, 5, 9, 2], max_new_tokens=8,
+                             presence_penalty=2.0,
+                             frequency_penalty=2.0).result(
+                timeout=240)["tokens"]
+        finally:
+            eng.stop()
+        counts = {t: out.count(t) for t in out}
+        assert max(counts.values()) <= 2
+
+    def test_embeddings_with_int8_weights(self):
+        from k8s_runpod_kubelet_tpu.workloads.serving import (ServingConfig,
+                                                              ServingEngine)
+        import jax
+        from k8s_runpod_kubelet_tpu.models import init_params
+        params = init_params(CFG, jax.random.PRNGKey(0))
+        e8 = ServingEngine(CFG, params, ServingConfig(
+            slots=1, cache_len=64, max_prefill_len=16,
+            quantize_int8=True)).start()
+        ef = ServingEngine(CFG, params, ServingConfig(
+            slots=1, cache_len=64, max_prefill_len=16)).start()
+        try:
+            a = np.asarray(e8.embed([5, 9, 2]))
+            b = np.asarray(ef.embed([5, 9, 2]))
+        finally:
+            e8.stop()
+            ef.stop()
+        assert a.shape == b.shape
+        cos = float(np.sum(a * b) / (np.linalg.norm(a) * np.linalg.norm(b)))
+        assert cos > 0.999  # int8 embeddings stay close to fp
