@@ -1,0 +1,164 @@
+"""Multi-head Latent Attention (MLA): DeepSeek-V2-style KV compression.
+
+The serving stack's cache economics (ring caches, int8 KV, donation) all
+attack the same number: KV bytes read per decode step. MLA attacks it at
+the ARCHITECTURE level — instead of caching per-head K/V
+(2 * n_heads * head_dim floats per token), cache one shared latent
+``c = h @ W_dkv`` of rank r plus one shared RoPE key of dim dr
+(r + dr floats per token; DeepSeek-V2 geometry: 512+64 = 576 vs
+2*128*128 = 32768 — 56.9x fewer).
+Per-head keys/values are LINEAR functions of the latent (k_h = c @ W_uk_h,
+v_h = c @ W_uv_h), which makes two decode-time forms equivalent:
+
+  direct:   materialize k/v from the cached latents, attend normally.
+  absorbed: fold W_uk into the query (q_lat_h = q_h @ W_uk_h^T) and W_uv
+            into the output — attention runs ENTIRELY in latent space:
+            scores = q_lat @ c^T (+ decoupled-RoPE term), out = (p @ c)
+            @ W_uv. Per step this reads r-dim latents instead of
+            H*dh-dim keys: the bandwidth win the cache compression
+            promised, realized at compute time too.
+
+RoPE cannot ride the latent (rotation does not commute with W_uk), so MLA
+splits the query per head into a no-position part (dh) scored against the
+latent and a positional part (dr) scored against ONE shared rope key per
+token — the "decoupled RoPE" of the paper (arXiv:2405.04434; net-new vs
+the reference, SURVEY.md §2.4: it has no model code at all).
+
+This module is the self-contained op + latent cache: mla_attention
+(prefill, full-sequence), mla_decode_step (absorbed, one token), and
+init_mla_cache, parity-tested against each other and against a dense
+reference. The cache carries a PER-ROW index (each slot at its own
+length) like the engine's caches; active-row masking and ring/int8
+composition are the engine-integration work a DeepSeek model family
+needs next round.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .rope import apply_rope
+
+__all__ = ["init_mla_params", "init_mla_cache",
+           "mla_attention", "mla_decode_step", "kv_bytes_per_token"]
+
+
+def init_mla_params(key, *, embed_dim: int, n_heads: int, head_dim: int,
+                    latent_dim: int, rope_dim: int,
+                    dtype=jnp.float32) -> dict:
+    """{w_q (E,H,dh+dr), w_dkv (E,r), w_uk (r,H,dh), w_uv (r,H,dh),
+    w_o (H*dh,E)} — the minimal MLA parameter set (the paper also
+    low-ranks the query; orthogonal to the cache story)."""
+    ks = jax.random.split(key, 5)
+    e, h, dh, dr, r = embed_dim, n_heads, head_dim, rope_dim, latent_dim
+
+    def init(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(dtype)
+
+    return {
+        "w_q": init(ks[0], (e, h, dh + dr), e),
+        "w_dkv": init(ks[1], (e, r + dr), e),   # latent + shared rope key
+        "w_uk": init(ks[2], (r, h, dh), r),
+        "w_uv": init(ks[3], (r, h, dh), r),
+        "w_o": init(ks[4], (h * dh, e), h * dh),
+    }
+
+
+def kv_bytes_per_token(*, n_heads: int, head_dim: int, latent_dim: int,
+                       rope_dim: int, bytes_per_el: int = 2) -> tuple[int, int]:
+    """(standard MHA cache bytes, MLA cache bytes) per token — the claim."""
+    return (2 * n_heads * head_dim * bytes_per_el,
+            (latent_dim + rope_dim) * bytes_per_el)
+
+
+def _project(h2, params, cos, sin, positions=None):
+    """Shared projections: q (B,S,H,dh+dr) with RoPE on its dr tail,
+    latent c (B,S,r), shared rope key kr (B,S,dr) (RoPE'd)."""
+    e, hn, dhr = params["w_q"].shape
+    r = params["w_uk"].shape[0]
+    dr = dhr - params["w_uk"].shape[2]
+    q = jnp.einsum("bse,ehd->bshd", h2, params["w_q"])
+    ckr = jnp.einsum("bse,er->bsr", h2, params["w_dkv"])
+    c, kr = ckr[..., :r], ckr[..., r:]
+    # decoupled RoPE: q's dr tail and the ONE shared key rotate; the
+    # latent-scored parts carry no position
+    q_nope, q_rope = q[..., :-dr], q[..., -dr:]
+    q_rope = apply_rope(q_rope, cos, sin, positions)
+    kr = apply_rope(kr[:, :, None, :], cos, sin, positions)[:, :, 0, :]
+    return q_nope, q_rope, c, kr
+
+
+def mla_attention(h2: jax.Array, params: dict, cos, sin,
+                  positions=None) -> tuple[jax.Array, dict]:
+    """Full-sequence (prefill/training) MLA, causal. Returns (out (B,S,E),
+    {"c": (B,S,r), "kr": (B,S,dr)}) — the latter IS the KV cache content.
+    Direct form: materializes per-head k/v for the sequence (prefill is
+    compute-bound; the latent trick matters for the DECODE reads)."""
+    q_nope, q_rope, c, kr = _project(h2, params, cos, sin, positions)
+    b, s, hn, dh = q_nope.shape
+    k_nope = jnp.einsum("bsr,rhd->bshd", c, params["w_uk"])
+    v = jnp.einsum("bsr,rhd->bshd", c, params["w_uv"])
+    scale = (dh + q_rope.shape[-1]) ** -0.5
+    scores = (jnp.einsum("bqhd,bkhd->bhqk", q_nope, k_nope)
+              + jnp.einsum("bqhd,bkd->bhqk", q_rope, kr)) * scale
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    scores = jnp.where(mask[None, None], scores.astype(jnp.float32),
+                       -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1).astype(h2.dtype)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(b, s, hn * dh)
+    out = o @ params["w_o"]
+    return out, {"c": c, "kr": kr}
+
+
+def init_mla_cache(batch: int, max_len: int, *, latent_dim: int,
+                   rope_dim: int, dtype=jnp.float32) -> dict:
+    """Latent KV cache: (latent_dim + rope_dim) per position instead of
+    2*H*dh — the whole point. ``index`` follows the engine's cache
+    contract (positions < index are committed)."""
+    return {
+        "c": jnp.zeros((batch, max_len, latent_dim), dtype),
+        "kr": jnp.zeros((batch, max_len, rope_dim), dtype),
+        "index": jnp.zeros((batch,), jnp.int32),   # per row, engine-style
+    }
+
+
+def mla_decode_step(h1: jax.Array, params: dict, cache: dict, cos, sin
+                    ) -> tuple[jax.Array, dict]:
+    """One-token decode in the ABSORBED form: the step reads the (L, r)
+    latents and the (L, dr) rope keys — never materializing per-head K/V.
+
+      q_lat_h = q_nope_h @ W_uk_h^T          (fold W_uk into the query)
+      scores  = q_lat @ c^T + q_rope @ kr^T  (latent-space attention)
+      out     = ((p @ c) @ W_uv) . W_o       (fold W_uv into the output)
+
+    h1 (B, 1, E); each row's position comes from its cache["index"][b]
+    (slots at different lengths, the continuous-batching shape). Returns
+    (out (B, 1, E), updated cache)."""
+    idx = cache["index"]                              # (B,)
+    pos = idx[:, None]                                # (B, 1)
+    q_nope, q_rope, c1, kr1 = _project(h1, params, cos, sin, pos)
+    b, _, hn, dh = q_nope.shape
+    dr = q_rope.shape[-1]
+    # commit this token's latent before scoring (self-attention sees it);
+    # per-row positions -> scatter, not a slice update
+    rows = jnp.arange(b)
+    cache = dict(cache)
+    cache["c"] = cache["c"].at[rows, idx].set(c1[:, 0])
+    cache["kr"] = cache["kr"].at[rows, idx].set(kr1[:, 0])
+    c, kr = cache["c"], cache["kr"]
+    q_lat = jnp.einsum("bohd,rhd->bohr", q_nope, params["w_uk"])  # (B,1,H,r)
+    scale = (dh + dr) ** -0.5
+    scores = (jnp.einsum("bohr,blr->bhol", q_lat, c)
+              + jnp.einsum("bohd,bld->bhol", q_rope, kr)) * scale
+    live = (jnp.arange(c.shape[1])[None] <= idx[:, None])[:, None, None, :]
+    scores = jnp.where(live, scores.astype(jnp.float32), -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1).astype(h1.dtype)
+    o_lat = jnp.einsum("bhol,blr->bohr", p, c)                    # (B,1,H,r)
+    o = jnp.einsum("bohr,rhd->bohd", o_lat, params["w_uv"])
+    out = o.reshape(b, 1, hn * dh) @ params["w_o"]
+    cache["index"] = idx + 1
+    return out, cache
