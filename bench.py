@@ -100,6 +100,7 @@ _STAGED_QUEUE = [
     ("headline_profile",
      ["--run", "--expect-tpu", "--profile-dir",
       os.path.join("bench_results", "tpu_profile")], 1800),
+    ("mla", ["--mla"], 1200),    # latent-attention op vs QKVO block
     ("attn", ["--attn"], 2400),  # 32k last inside; sacrificial process
 ]
 
@@ -1142,8 +1143,89 @@ def orchestrate(quick: bool) -> int:
     return 1
 
 
+def run_mla_bench() -> int:
+    """MLA absorbed decode vs a like-for-like standard QKVO block,
+    wall-clock on the chip (the AOT cells bound these; this measures).
+    One JSON line per program + the ratio."""
+    _force_platform_from_env()
+    import jax
+    import jax.numpy as jnp
+    from k8s_runpod_kubelet_tpu.ops.mla import (init_mla_cache,
+                                                init_mla_params,
+                                                mla_decode_step)
+    from k8s_runpod_kubelet_tpu.ops.rope import apply_rope, rope_frequencies
+
+    if jax.default_backend() != "tpu":
+        _emit({"metric": "mla_decode_speedup", "value": None,
+               "error": f"mla bench needs a TPU, got {jax.default_backend()!r}"})
+        return 1
+    b, e, h, dh, dr, r, cache_len = 8, 2048, 16, 128, 64, 512, 2048
+    key = jax.random.PRNGKey(0)
+    params = init_mla_params(key, embed_dim=e, n_heads=h, head_dim=dh,
+                             latent_dim=r, rope_dim=dr, dtype=jnp.bfloat16)
+    cos, sin = rope_frequencies(dr, max_seq_len=cache_len)
+    cache = init_mla_cache(b, cache_len, latent_dim=r, rope_dim=dr,
+                           dtype=jnp.bfloat16)
+    # mostly-full cache: decode reads scale with committed length
+    cache["index"] = jnp.full((b,), cache_len - 64, jnp.int32)
+    h1 = jax.random.normal(key, (b, 1, e), jnp.bfloat16)
+    step = jax.jit(lambda h1, p, c: mla_decode_step(h1, p, c, cos, sin),
+                   donate_argnums=(2,))
+    out, cache = step(h1, params, cache)        # compile + warm
+    out.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(50):
+        out, cache = step(h1, params, cache)    # donated cache threads through
+    out.block_until_ready()
+    t_mla = (time.perf_counter() - t0) / 50
+    _emit({"metric": "mla_decode_ms", "value": round(t_mla * 1e3, 3),
+           "unit": "ms", "tok_s": round(b / t_mla, 1)})
+
+    ks = jax.random.split(key, 5)
+    wq, wk, wv = (jax.random.normal(ks[i], (e, h * dh), jnp.bfloat16) * 0.02
+                  for i in range(3))
+    wo = jax.random.normal(ks[3], (h * dh, e), jnp.bfloat16) * 0.02
+    kc = jnp.zeros((b, cache_len, h, dh), jnp.bfloat16)
+    vc = jnp.zeros((b, cache_len, h, dh), jnp.bfloat16)
+    idx = jnp.full((b,), cache_len - 64, jnp.int32)
+    cos2, sin2 = rope_frequencies(dh, max_seq_len=cache_len)
+
+    @jax.jit
+    def std_step(h1, kc, vc):
+        q = (h1 @ wq).reshape(b, 1, h, dh)
+        k1 = (h1 @ wk).reshape(b, 1, h, dh)
+        v1 = (h1 @ wv).reshape(b, 1, h, dh)
+        pos = idx[:, None]
+        q = apply_rope(q, cos2, sin2, pos)
+        k1 = apply_rope(k1, cos2, sin2, pos)
+        rows = jnp.arange(b)
+        kc = kc.at[rows, idx].set(k1[:, 0])
+        vc = vc.at[rows, idx].set(v1[:, 0])
+        scores = jnp.einsum("bohd,blhd->bhol", q, kc) * dh ** -0.5
+        live = (jnp.arange(cache_len)[None] <= idx[:, None])[:, None, None]
+        scores = jnp.where(live, scores.astype(jnp.float32), -jnp.inf)
+        p = jax.nn.softmax(scores, axis=-1).astype(h1.dtype)
+        o = jnp.einsum("bhol,blhd->bohd", p, vc).reshape(b, 1, h * dh)
+        return o @ wo, kc, vc
+
+    std_step(h1, kc, vc)[0].block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(50):
+        out, kc, vc = std_step(h1, kc, vc)
+    out.block_until_ready()
+    t_std = (time.perf_counter() - t0) / 50
+    _emit({"metric": "std_attn_decode_ms", "value": round(t_std * 1e3, 3),
+           "unit": "ms", "tok_s": round(b / t_std, 1)})
+    _emit({"metric": "mla_decode_speedup", "value": round(t_std / t_mla, 2),
+           "unit": "x", "note": "like-for-like QKVO block vs absorbed MLA, "
+                                "16x128 heads, latent 512+64, cache 2048"})
+    return 0
+
+
 def main() -> int:
     quick = "--quick" in sys.argv
+    if "--mla" in sys.argv:
+        return run_mla_bench()
     if "--attn" in sys.argv:
         return run_attn_bench()
     if "--econ" in sys.argv:
