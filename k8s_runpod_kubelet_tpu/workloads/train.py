@@ -50,9 +50,10 @@ class TrainConfig:
     fused_ce_chunks: int = 0
     checkpoint_dir: str = ""
     checkpoint_every: int = 1000
-    # async checkpointing: save() stages device->host and returns; the
-    # storage write overlaps training (run()/restore() wait at their
-    # boundaries). False = every save blocks until durable.
+    # async checkpointing for the run() LOOP's periodic saves: stage
+    # device->host and let the storage write overlap training (run()
+    # waits at its boundary). Direct save() calls always block unless
+    # told otherwise. False = loop saves block too.
     async_checkpoint: bool = True
 
 
@@ -282,20 +283,21 @@ class Trainer:
 
     # -- checkpoint / resume ---------------------------------------------------
 
-    def save(self, block: Optional[bool] = None):
-        """Checkpoint params + optimizer state. ASYNC by default
-        (TrainConfig.async_checkpoint): orbax stages device->host, the
-        storage write overlaps the next training steps — at real model
-        sizes the write is seconds-to-minutes the accelerators would
-        otherwise idle (MaxText-style). run() and restore() call
-        wait_pending() at their boundaries so nothing is ever lost or
-        half-read; pass ``block=True`` to force a durable save now."""
+    def save(self, block: bool = True):
+        """Checkpoint params + optimizer state. DIRECT calls block until
+        durable (a caller that saves then exits or restores must never
+        race the write). The run() loop's periodic saves pass
+        ``block=False`` (TrainConfig.async_checkpoint): orbax stages
+        device->host and the storage write overlaps the next training
+        steps — at real model sizes that write is seconds-to-minutes the
+        accelerators would otherwise idle (MaxText-style) — and run()
+        waits at its boundary so nothing is lost."""
         if self._ckpt is None:
             return
         import orbax.checkpoint as ocp
         self._ckpt.save(self.step, args=ocp.args.StandardSave(
             {"params": self.params, "opt_state": self.opt_state}))
-        if (not self.tc.async_checkpoint) if block is None else block:
+        if block:
             self._ckpt.wait_until_finished()
             log.info("checkpoint saved at step %d", self.step)
         else:
@@ -386,7 +388,7 @@ class Trainer:
                 first_step_s = time.perf_counter() - t0
             self.step += 1
             if self.tc.checkpoint_dir and self.step % self.tc.checkpoint_every == 0:
-                self.save()
+                self.save(block=not self.tc.async_checkpoint)
         jax.block_until_ready(metrics["loss"])
         wall = time.perf_counter() - t0
         # async checkpoint boundary: the loop's staged writes must be

@@ -193,9 +193,7 @@ def main(argv=None) -> int:
         if loader is not None:
             loader.close()
     if args.checkpoint_dir:
-        # block: the process may exit right after — an async-staged final
-        # checkpoint must be durable, not racing interpreter teardown
-        trainer.save(block=True)
+        trainer.save()  # blocks: final checkpoint is durable before exit
     if args.export_adapter and pe.process_id == 0:
         # adapters are fully replicated across the mesh (apply_lora), so
         # process 0 holds every value even on multi-host runs

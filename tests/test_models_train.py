@@ -543,7 +543,7 @@ class TestAsyncCheckpoint:
                          checkpoint_every=100, async_checkpoint=True)
         t1 = Trainer(CFG, tc)
         t1.run(steps=2)
-        t1.save()                      # staged; write in background
+        t1.save(block=False)           # staged; write in background
         t1.wait_pending()              # what run()'s boundary does
         t2 = Trainer(CFG, tc)
         assert t2.restore() is True
@@ -569,6 +569,6 @@ class TestAsyncCheckpoint:
                          checkpoint_every=100, async_checkpoint=False)
         t1 = Trainer(CFG, tc)
         t1.run(steps=1)
-        t1.save()                      # blocks until durable
+        t1.save()                      # default: blocks until durable
         t2 = Trainer(CFG, tc)
         assert t2.restore() is True
