@@ -798,6 +798,8 @@ def run_mfu_sweep() -> int:
         ("260m_fce8_dots_b8", base, 8, 8),
         ("260m_fce8_full_b24",
          dataclasses.replace(base, remat_policy="full"), 24, 8),
+        ("530m_fce8_full_b12",
+         dataclasses.replace(wider_530m(), remat_policy="full"), 12, 8),
         # Llama-3's real 128k vocab: the naive loss refuses at B=8 on v5e
         # (4.2GB bf16 logits); fused is the only way to run this geometry
         ("v128k_fce16_b8", _bench_config_v128k(), 8, 16),

@@ -184,6 +184,10 @@ def check_train(results, dev):
          dataclasses.replace(base, remat_policy="full"), 32, 8),
         ("train_530m_fce8_full_b16",
          dataclasses.replace(wider_530m(), remat_policy="full"), 16, 8),
+        # b16 refused at 16.18G — probe the b12 point between known-fit
+        # 530m_full_b8 and that refusal
+        ("train_530m_fce8_full_b12",
+         dataclasses.replace(wider_530m(), remat_policy="full"), 12, 8),
     ]
     # The 128k-vocab pair: the geometry fused CE exists for. Same body as
     # the 260m bench but Llama-3's real vocabulary — the naive loss's
