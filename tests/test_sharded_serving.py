@@ -160,3 +160,25 @@ class TestShardedServing:
         finally:
             plain.stop()
             sharded.stop()
+
+
+def test_kv_cache_pspec_is_the_shared_contract():
+    """tools/aot_check.py compiles its sharded-serving evidence against
+    ServingEngine's OWN cache layout: both must import the same
+    kv_cache_pspec (a drifted copy would make the evidence file measure a
+    different program than production serves)."""
+    import importlib.util
+    import pathlib
+    from k8s_runpod_kubelet_tpu.workloads.serving import kv_cache_pspec
+    src = pathlib.Path(__file__).resolve().parents[1] / "tools" / "aot_check.py"
+    text = src.read_text()
+    assert "from k8s_runpod_kubelet_tpu.workloads.serving import kv_cache_pspec" in text
+    # and the engine's own builder goes through it too
+    eng = pathlib.Path(__file__).resolve().parents[1] / \
+        "k8s_runpod_kubelet_tpu" / "workloads" / "serving.py"
+    assert "kv_cache_pspec(name, sd.ndim)" in eng.read_text()
+    # spec semantics: K/V shard heads second-to-last, scales last, index repl
+    from k8s_runpod_kubelet_tpu.parallel.mesh import AXES
+    assert kv_cache_pspec("k", 5) == (None, None, None, AXES.TENSOR, None)
+    assert kv_cache_pspec("k_scale", 4) == (None, None, None, AXES.TENSOR)
+    assert kv_cache_pspec("index", 1) == ()
