@@ -31,7 +31,8 @@ def test_mnist_main_learns(capsys):
 def test_train_main_tiny(capsys):
     from k8s_runpod_kubelet_tpu.workloads.train_main import main
     rc = main(["--model", "tiny", "--steps", "2", "--batch", "2",
-               "--seq-len", "32", "--tensor", "2", "--seq", "1"])
+               "--seq-len", "32", "--tensor", "2", "--seq", "1",
+               "--fused-ce-chunks", "4"])  # CLI plumb of the fused loss
     assert rc == 0
     summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert summary["workload"] == "pretrain"
