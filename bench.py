@@ -617,11 +617,16 @@ def run_serve_bench(quick: bool) -> int:
     # EXACTLY llama3-8b + {int8|int4} weights + int8 KV; other big configs
     # keep the conservative 8 (bf16 KV alone adds ~2.1GB at 16 slots)
     swept_16 = (model == "llama3-8b" and "--kv-int8" in sys.argv
-                and ("--int8" in sys.argv or "--int4" in sys.argv))
+                and "--int8" in sys.argv)
+    # int4's smaller weights admit MORE slots: the AOT sweep compiles 32
+    # (decode_8b_int4pk_kv8_slots32, bound 2,402 vs 2,292 at 16; 64 OOMs)
+    swept_32 = (model == "llama3-8b" and "--kv-int8" in sys.argv
+                and "--int4" in sys.argv)
     if tiny:
         slots, n_req, new_toks = 4, 12, 16
     elif big:
-        slots, n_req, new_toks = (16, 48, 64) if swept_16 else (8, 32, 64)
+        slots, n_req, new_toks = ((32, 96, 64) if swept_32 else
+                                  (16, 48, 64) if swept_16 else (8, 32, 64))
     else:
         slots, n_req, new_toks = 8, 48, 64
     rec = serve_once(
