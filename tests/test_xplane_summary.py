@@ -1,0 +1,46 @@
+"""tools/xplane_summary.py: raw wire-format xplane parsing against a trace
+captured in-test (no TF dependency anywhere)."""
+
+import glob
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+from tools.xplane_summary import main, summarize  # noqa: E402
+
+pytestmark = pytest.mark.slow
+
+
+def test_summarize_real_trace(tmp_path, capsys):
+    jax.profiler.start_trace(str(tmp_path))
+    f = jax.jit(lambda x: (x @ x).sum())
+    x = jnp.ones((128, 128))
+    f(x).block_until_ready()
+    f(x).block_until_ready()
+    jax.profiler.stop_trace()
+    pbs = glob.glob(str(tmp_path / "**" / "*.xplane.pb"), recursive=True)
+    assert pbs, "profiler wrote no xplane.pb"
+    planes = summarize(pbs[0], top=10)
+    assert planes, "no planes parsed"
+    names = {p["plane"] for p in planes}
+    assert any("CPU" in n or "TPU" in n or "host" in n for n in names), names
+    for p in planes:
+        assert p["busy_ms"] > 0
+        for nm, ms, c, share in p["top"]:
+            assert ms >= 0 and c >= 1 and 0 <= share <= 1
+    # CLI end to end on the directory (picks the newest capture)
+    assert main([str(tmp_path), "--top", "5"]) == 0
+    out = capsys.readouterr().out
+    assert "== plane:" in out and "total_ms" in out
+
+
+def test_cli_errors():
+    assert main([]) == 2
+    assert main(["/nonexistent-dir-xyz"]) == 1
+    assert main(["--top"]) == 2          # missing value
+    assert main(["--top", "abc"]) == 2   # non-numeric
+    assert main(["--top", "5"]) == 2     # no path left

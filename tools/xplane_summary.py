@@ -1,0 +1,175 @@
+"""Summarize a JAX/XLA profiler trace (xplane.pb) with no TF dependency.
+
+The staged ``headline_profile`` bench step captures an XLA trace of the
+timed steps so an MFU shortfall gets a profile, not a guess (r3 VERDICT
+item 2). This image's tensorboard_plugin_profile cannot convert traces
+(its pywrap symbol set mismatches the installed TF), so this tool parses
+the protobuf WIRE FORMAT of tsl's XSpace directly — ~100 lines of varint
+walking against the public schema (tsl/profiler/protobuf/xplane.proto):
+
+  XSpace.planes=1 / XPlane{name=2, lines=3, event_metadata=4(map)}
+  XLine{name=2, events=4} / XEvent{metadata_id=1, duration_ps=3}
+  XEventMetadata{id=1, name=2, display_name=4}
+
+Per plane it aggregates event durations by op name and prints the top-N
+table (total ms, count, share of plane busy time) — the bottleneck view
+round 5 reads next to the chip's MFU number.
+
+Usage: python tools/xplane_summary.py <trace_dir_or_xplane.pb> [--top N]
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import sys
+from collections import defaultdict
+
+
+def _varint(buf: memoryview, i: int) -> tuple[int, int]:
+    out = shift = 0
+    while True:
+        b = buf[i]
+        i += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, i
+        shift += 7
+
+
+def _fields(buf: memoryview):
+    """Yield (field_number, wire_type, value) over one message's bytes.
+    value: int for varint/fixed, memoryview for length-delimited."""
+    i = 0
+    n = len(buf)
+    while i < n:
+        key, i = _varint(buf, i)
+        field, wt = key >> 3, key & 7
+        if wt == 0:                      # varint
+            v, i = _varint(buf, i)
+        elif wt == 1:                    # fixed64
+            v = int.from_bytes(buf[i:i + 8], "little")
+            i += 8
+        elif wt == 2:                    # length-delimited
+            ln, i = _varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 5:                    # fixed32
+            v = int.from_bytes(buf[i:i + 4], "little")
+            i += 4
+        else:
+            raise ValueError(f"unsupported wire type {wt} at {i}")
+        yield field, wt, v
+
+
+def _parse_event(buf) -> tuple[int, int]:
+    mid = dur = 0
+    for f, _, v in _fields(buf):
+        if f == 1:
+            mid = v
+        elif f == 3:
+            dur = v
+    return mid, dur
+
+
+def _parse_line(buf) -> tuple[str, list]:
+    name, events = "", []
+    for f, wt, v in _fields(buf):
+        if f == 2 and wt == 2:
+            name = bytes(v).decode(errors="replace")
+        elif f == 4 and wt == 2:
+            events.append(_parse_event(v))
+    return name, events
+
+
+def _parse_meta_entry(buf) -> tuple[int, str]:
+    """map<int64, XEventMetadata> entry -> (id, best name)."""
+    key, name = 0, ""
+    for f, wt, v in _fields(buf):
+        if f == 1 and wt == 0:
+            key = v
+        elif f == 2 and wt == 2:
+            disp = nm = ""
+            for f2, wt2, v2 in _fields(v):
+                if f2 == 2 and wt2 == 2:
+                    nm = bytes(v2).decode(errors="replace")
+                elif f2 == 4 and wt2 == 2:
+                    disp = bytes(v2).decode(errors="replace")
+            name = disp or nm
+    return key, name
+
+
+def summarize(path: str, top: int = 20) -> list[dict]:
+    """Returns one record per plane: {plane, busy_ms, top: [(name, ms,
+    count, share)]}. Pure parse — no TF, no protobuf package."""
+    buf = memoryview(open(path, "rb").read())
+    out = []
+    for f, wt, plane_buf in _fields(buf):
+        if f != 1 or wt != 2:
+            continue
+        plane_name, meta, agg = "", {}, defaultdict(lambda: [0, 0])
+        for pf, pwt, pv in _fields(plane_buf):
+            if pf == 2 and pwt == 2:
+                plane_name = bytes(pv).decode(errors="replace")
+            elif pf == 4 and pwt == 2:
+                k, nm = _parse_meta_entry(pv)
+                meta[k] = nm
+            elif pf == 3 and pwt == 2:
+                _, events = _parse_line(pv)
+                for mid, dur in events:
+                    agg[mid][0] += dur
+                    agg[mid][1] += 1
+        if not agg:
+            continue
+        busy_ps = sum(d for d, _ in agg.values())
+        rows = sorted(((meta.get(mid, f"metadata#{mid}"), d, c)
+                       for mid, (d, c) in agg.items()),
+                      key=lambda r: -r[1])[:top]
+        out.append({
+            "plane": plane_name,
+            "busy_ms": busy_ps / 1e9,
+            "top": [(nm, d / 1e9, c, d / busy_ps) for nm, d, c in rows],
+        })
+    return out
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv:
+        print(__doc__)
+        return 2
+    top = 20
+    if "--top" in argv:
+        i = argv.index("--top")
+        try:
+            top = int(argv[i + 1])
+        except (IndexError, ValueError):
+            print("--top needs an integer", file=sys.stderr)
+            return 2
+        argv = argv[:i] + argv[i + 2:]
+    if not argv:
+        print(__doc__)
+        return 2
+    path = argv[0]
+    if not os.path.exists(path):
+        print(f"no such path: {path}", file=sys.stderr)
+        return 1
+    if os.path.isdir(path):
+        pbs = sorted(glob.glob(os.path.join(
+            path, "**", "*.xplane.pb"), recursive=True))
+        if not pbs:
+            print(f"no *.xplane.pb under {path}", file=sys.stderr)
+            return 1
+        path = pbs[-1]  # newest capture
+    print(f"# {path}")
+    for plane in summarize(path, top):
+        print(f"\n== plane: {plane['plane']}  "
+              f"(busy {plane['busy_ms']:.2f} ms aggregated)")
+        print(f"{'total_ms':>10}  {'count':>6}  {'share':>6}  op")
+        for nm, ms, c, share in plane["top"]:
+            print(f"{ms:10.3f}  {c:6d}  {share:5.1%}  {nm[:90]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
