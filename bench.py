@@ -180,12 +180,35 @@ def run_bench(quick: bool, expect_tpu: bool = False) -> dict:
     trainer.run(steps=warmup_steps, batches=batches)  # compile + warm
     profile_dir = _arg_value("--profile-dir", "")
     if profile_dir:  # trace ONLY timed steps (VERDICT r2: profile, don't guess)
+        trace_started_at = time.time()   # wall clock: gates capture mtime
         jax.profiler.start_trace(profile_dir)
     t0 = time.perf_counter()
     trainer.run(steps=timed_steps, batches=batches)
     wall = time.perf_counter() - t0
     if profile_dir:
         jax.profiler.stop_trace()
+        # emit the bottleneck table alongside the number: top device-plane
+        # ops from THIS run's capture (mtime-gated on the timed window so a
+        # stale pb from a previous round is never misattributed)
+        try:
+            from tools.xplane_summary import newest_xplane, summarize
+            pb = newest_xplane(profile_dir, since=trace_started_at)
+            if pb is None:
+                _emit({"metric": "profile_top_ops", "value": None,
+                       "error": f"no fresh xplane.pb under {profile_dir}"})
+            else:
+                for plane in summarize(pb, top=6):
+                    name = plane["plane"]
+                    if "TPU" not in name and "host" not in name:
+                        continue
+                    _emit({"metric": "profile_top_ops", "plane": name,
+                           "busy_ms": round(plane["busy_ms"], 2),
+                           "top": [[nm[:80], round(ms, 3), c,
+                                    round(share, 3)]
+                                   for nm, ms, c, share in plane["top"]]})
+        except Exception as e:  # noqa: BLE001 — the number must still land
+            _emit({"metric": "profile_top_ops", "value": None,
+                   "error": f"{type(e).__name__}: {e}"[:200]})
 
     tokens = tc.batch_size * tc.seq_len * timed_steps
     tok_s = tokens / wall

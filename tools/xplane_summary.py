@@ -99,6 +99,17 @@ def _parse_meta_entry(buf) -> tuple[int, str]:
     return key, name
 
 
+def newest_xplane(trace_dir: str, since: float = 0.0):
+    """Newest *.xplane.pb under ``trace_dir`` modified after ``since``
+    (mtime epoch seconds), or None — the ONE definition of "this run's
+    capture" shared by the CLI and bench.py (a stale pb from a previous
+    round must never be attributed to the current run)."""
+    pbs = [(os.path.getmtime(f), f) for f in glob.glob(
+        os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True)]
+    pbs = [(m, f) for m, f in pbs if m >= since]
+    return max(pbs)[1] if pbs else None
+
+
 def summarize(path: str, top: int = 20) -> list[dict]:
     """Returns one record per plane: {plane, busy_ms, top: [(name, ms,
     count, share)]}. Pure parse — no TF, no protobuf package."""
@@ -155,12 +166,11 @@ def main(argv=None) -> int:
         print(f"no such path: {path}", file=sys.stderr)
         return 1
     if os.path.isdir(path):
-        pbs = sorted(glob.glob(os.path.join(
-            path, "**", "*.xplane.pb"), recursive=True))
-        if not pbs:
+        pb = newest_xplane(path)
+        if pb is None:
             print(f"no *.xplane.pb under {path}", file=sys.stderr)
             return 1
-        path = pbs[-1]  # newest capture
+        path = pb
     print(f"# {path}")
     for plane in summarize(path, top):
         print(f"\n== plane: {plane['plane']}  "
