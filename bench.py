@@ -37,6 +37,10 @@ Usage:
                              # batch, 530M width (needs TPU)
   python bench.py --attn-tune  # flash block-size grid at the training
                              # geometry S=2048/hd=64 (needs TPU)
+  python bench.py --mla      # MLA absorbed decode vs like-for-like QKVO
+                             # block, wall-clock (needs TPU)
+  python bench.py --watch    # session watcher: probe on an interval, run
+                             # the whole staged runbook on first success
 """
 
 from __future__ import annotations
