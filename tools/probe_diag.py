@@ -1,0 +1,233 @@
+"""TPU tunnel probe diagnosis: WHICH stage wedges, with the hang stack.
+
+Four rounds of "probe hung > 300s (tunnel wedged?)" is monitoring, not
+diagnosis (VERDICT r4 weak item 7). This tool decomposes the probe into
+stages and runs them across env variants, capturing the Python-level stack
+at the moment of a hang (faulthandler), so a wedged tunnel produces
+"backend_init blocked in PJRT client creation under variant default" rather
+than a bare timeout.
+
+Stages (each is a marker line on the child's stdout):
+  import_jax    -> pure import; never touches the tunnel
+  backend_init  -> jax.default_backend(); creates the PJRT client, i.e.
+                   dials the axon relay (the historically observed hang)
+  devices       -> jax.devices(); device enumeration over the live client
+  tiny_compile  -> jit((x+1).sum) on (8,8); exercises the (remote) compile
+                   path — r4 observed a HALF-UP state where init works and
+                   compile dies
+  tiny_execute  -> second call of the jitted fn; cached-executable dispatch
+
+Variants (parent env overrides; the axon sitecustomize reads these at
+interpreter start, so a child process is the unit of variation):
+  default            env as-is (JAX_PLATFORMS=axon, remote_compile per env)
+  no_remote_compile  PALLAS_AXON_REMOTE_COMPILE deleted -> register() with
+                     remote_compile=False; distinguishes "relay dead" from
+                     "remote-compile endpoint dead"
+  cpu_control        JAX_PLATFORMS=cpu; validates the harness itself
+
+Usage:
+  python tools/probe_diag.py            # full matrix, JSON to stdout,
+                                        # persisted to bench_results/
+  python tools/probe_diag.py --child    # internal: one variant's stages
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_RESULTS_DIR = os.path.join(_HERE, "bench_results")
+
+# (name, env_overrides, env_deletes)
+_VARIANTS = [
+    ("default", {}, []),
+    ("no_remote_compile", {}, ["PALLAS_AXON_REMOTE_COMPILE"]),
+    ("cpu_control", {"JAX_PLATFORMS": "cpu"}, []),
+]
+
+_STAGE_TIMEOUT_S = int(os.environ.get("PROBE_DIAG_STAGE_TIMEOUT_S", "120"))
+_COMPILE_TIMEOUT_S = int(os.environ.get("PROBE_DIAG_COMPILE_TIMEOUT_S", "300"))
+
+
+def _child() -> int:
+    """Run the stages in-process. A faulthandler timer is armed before each
+    stage and cancelled after it: if the stage hangs, the child dumps every
+    thread's stack to stderr and exits, and the parent attributes the hang
+    to the last stage with no ok-marker."""
+    import faulthandler
+
+    def marker(stage: str, ok: bool, t0: float, err: str = "") -> None:
+        print(json.dumps({"stage": stage, "ok": ok,
+                          "s": round(time.monotonic() - t0, 2),
+                          **({"error": err[:300]} if err else {})}),
+              flush=True)
+
+    def run_stage(stage: str, fn, timeout_s: int) -> bool:
+        t0 = time.monotonic()
+        faulthandler.dump_traceback_later(timeout_s, exit=True)
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001 — an ERROR is a diagnosis too
+            faulthandler.cancel_dump_traceback_later()
+            marker(stage, False, t0, f"{type(e).__name__}: {e}")
+            return False
+        faulthandler.cancel_dump_traceback_later()
+        marker(stage, True, t0)
+        return True
+
+    ns: dict = {}
+
+    def s_import():
+        import jax
+        ns["jax"] = jax
+        # The axon sitecustomize's register() wins over the env var (it runs
+        # at interpreter start and re-pins the platform); re-assert the env's
+        # choice so cpu_control is a true harness control rather than a
+        # second axon dial (observed: cpu_control wedged at backend_init
+        # with the axon 'experimental platform' warning).
+        if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except RuntimeError:
+                pass
+
+    def s_backend():
+        ns["backend"] = ns["jax"].default_backend()
+
+    def s_devices():
+        ns["devices"] = ns["jax"].devices()
+
+    def s_compile():
+        jax = ns["jax"]
+        import jax.numpy as jnp
+        ns["fn"] = jax.jit(lambda x: (x + 1).sum())
+        ns["x"] = jnp.zeros((8, 8))
+        ns["v"] = int(ns["fn"](ns["x"]))
+
+    def s_execute():
+        v = int(ns["fn"](ns["x"]))
+        if v != 64:
+            raise ValueError(f"wrong result {v}")
+
+    for stage, fn, to in [("import_jax", s_import, _STAGE_TIMEOUT_S),
+                          ("backend_init", s_backend, _STAGE_TIMEOUT_S),
+                          ("devices", s_devices, _STAGE_TIMEOUT_S),
+                          ("tiny_compile", s_compile, _COMPILE_TIMEOUT_S),
+                          ("tiny_execute", s_execute, _STAGE_TIMEOUT_S)]:
+        if not run_stage(stage, fn, to):
+            return 1
+    print(json.dumps({"stage": "all", "ok": True,
+                      "backend": ns.get("backend"),
+                      "n_devices": len(ns.get("devices", []))}), flush=True)
+    return 0
+
+
+def _listening_ports() -> list[int]:
+    """Local listening TCP ports from /proc/net/tcp{,6} (no psutil). The
+    axon relay lives on localhost — if nothing is listening, the PJRT dial
+    has nothing to reach and 'wedged' really means 'relay gone'."""
+    ports: set[int] = set()
+    for path in ("/proc/net/tcp", "/proc/net/tcp6"):
+        try:
+            with open(path, encoding="ascii") as f:
+                next(f)
+                for line in f:
+                    parts = line.split()
+                    if len(parts) > 3 and parts[3] == "0A":  # LISTEN
+                        ports.add(int(parts[1].rsplit(":", 1)[1], 16))
+        except (OSError, ValueError, IndexError):
+            continue
+    return sorted(ports)
+
+
+def run_variant(name: str, overrides: dict, deletes: list[str],
+                budget_s: int) -> dict:
+    env = dict(os.environ)
+    env.update(overrides)
+    for k in deletes:
+        env.pop(k, None)
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            capture_output=True, text=True, timeout=budget_s, env=env,
+            cwd=_HERE)
+        out, err, rc = proc.stdout or "", proc.stderr or "", proc.returncode
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout.decode(errors="replace") if isinstance(
+            e.stdout, bytes) else (e.stdout or "")
+        err = e.stderr.decode(errors="replace") if isinstance(
+            e.stderr, bytes) else (e.stderr or "")
+        rc = -9
+    stages = []
+    for line in out.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                stages.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    ok_names = [s["stage"] for s in stages if s.get("ok")]
+    all_ok = any(s.get("stage") == "all" for s in stages)
+    # the wedge is the first stage with no ok-marker (hang -> faulthandler
+    # exit, or error -> marker with ok=false)
+    order = ["import_jax", "backend_init", "devices", "tiny_compile",
+             "tiny_execute"]
+    wedge = None if all_ok else next(
+        (s for s in order if s not in ok_names), None)
+    errors = {s["stage"]: s["error"] for s in stages
+              if not s.get("ok") and s.get("error")}
+    # faulthandler writes "Timeout (0:02:00)!\nThread 0x...\n  File ..." to
+    # stderr; keep the current-thread stack (the tail) for the record
+    hang_stack = ""
+    if "Timeout" in err:
+        hang_stack = err[err.rindex("Timeout"):][:2000]
+    return {"variant": name, "rc": rc, "ok": all_ok, "wedged_stage": wedge,
+            "stage_errors": errors,
+            "stages": stages,
+            "hang_stack": hang_stack,
+            "stderr_tail": "" if hang_stack else err[-1200:],
+            "wall_s": round(time.monotonic() - t0, 1)}
+
+
+def main() -> int:
+    if "--child" in sys.argv:
+        return _child()
+    budget = 2 * _STAGE_TIMEOUT_S + _COMPILE_TIMEOUT_S + 3 * _STAGE_TIMEOUT_S
+    report = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+              "env": {k: os.environ.get(k, "") for k in
+                      ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS",
+                       "PALLAS_AXON_REMOTE_COMPILE", "AXON_LOOPBACK_RELAY",
+                       "PALLAS_AXON_TPU_GEN")},
+              "listening_ports": _listening_ports(),
+              "variants": []}
+    for name, overrides, deletes in _VARIANTS:
+        rec = run_variant(name, overrides, deletes, budget)
+        report["variants"].append(rec)
+        print(f"[diag] {name}: ok={rec['ok']} wedged={rec['wedged_stage']} "
+              f"errors={list(rec['stage_errors'])} wall={rec['wall_s']}s",
+              file=sys.stderr, flush=True)
+        # default wedging at import/backend means every axon variant will
+        # too; still run them (cheap signal: does no_remote_compile differ?)
+    os.makedirs(_RESULTS_DIR, exist_ok=True)
+    path = os.path.join(_RESULTS_DIR, "probe_diag.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)
+    print(json.dumps({"metric": "probe_diag",
+                      "variants": {v["variant"]:
+                                   (v["wedged_stage"] or
+                                    ("ok" if v["ok"] else "error"))
+                                   for v in report["variants"]},
+                      "path": path}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
