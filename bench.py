@@ -1095,6 +1095,23 @@ def _probe_diag_summary() -> dict | None:
         return None
 
 
+def _run_probe_diag(deadline: float):
+    """Spawn tools/probe_diag.py (bounded by the watch deadline) and return
+    its per-variant wedge summary. Separate function so tests mock it — a
+    real spawn under pytest's CPU env once clobbered the genuine tunnel
+    diagnosis with an all-cpu false pass."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_HERE, "tools", "probe_diag.py")],
+            capture_output=True, text=True,
+            timeout=min(3000, max(60, int(deadline - time.monotonic()))),
+            cwd=_HERE)
+        summ = _last_json_line(proc.stdout or "")
+        return (summ or {}).get("variants")
+    except Exception as e:  # noqa: BLE001 — diag must never kill the watch
+        return f"diag failed: {type(e).__name__}: {e}"
+
+
 def run_watch() -> int:
     """Session watcher: probe the TPU on an interval for up to the budget; on
     the first success run the staged runbook, persisting each step's JSON as
@@ -1152,18 +1169,7 @@ def run_watch() -> int:
             if time.monotonic() - last_diag > 7200:
                 last_diag = time.monotonic()
                 log("running probe-stage diagnosis (tools/probe_diag.py)")
-                try:
-                    proc = subprocess.run(
-                        [sys.executable,
-                         os.path.join(_HERE, "tools", "probe_diag.py")],
-                        capture_output=True, text=True,
-                        timeout=min(3000, max(
-                            60, int(deadline - time.monotonic()))),
-                        cwd=_HERE)
-                    summ = _last_json_line(proc.stdout or "")
-                    log(f"diag: {json.dumps((summ or {}).get('variants'))}")
-                except Exception as e:  # noqa: BLE001 — diag must not kill
-                    log(f"diag failed: {type(e).__name__}: {e}")
+                log(f"diag: {json.dumps(_run_probe_diag(deadline))}")
             time.sleep(min(interval, max(0, deadline - time.monotonic())))
             continue
         log(f"TPU is UP — running {len(todo)} staged steps")

@@ -92,6 +92,7 @@ class TestWatch:
 
         monkeypatch.setattr(bench, "_probe_tpu", fake_probe)
         monkeypatch.setattr(bench, "_run_staged_step", fake_step)
+        monkeypatch.setattr(bench, "_run_probe_diag", lambda d: {})
         monkeypatch.setattr(bench.time, "sleep", lambda s: None)
         if queue is not None:
             monkeypatch.setattr(bench, "_STAGED_QUEUE", queue)
@@ -190,6 +191,7 @@ class TestWatch:
     def test_budget_exhaustion_returns_nonzero(self, results_dir,
                                                monkeypatch):
         monkeypatch.setattr(bench, "_probe_tpu", lambda: (False, "down"))
+        monkeypatch.setattr(bench, "_run_probe_diag", lambda d: {})
         monkeypatch.setattr(bench.time, "sleep", lambda s: None)
         monkeypatch.setattr(bench, "_STAGED_QUEUE", self.QUEUE)
         # monotonic deadline passes immediately after the first iteration

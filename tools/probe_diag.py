@@ -42,11 +42,15 @@ import time
 _HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _RESULTS_DIR = os.path.join(_HERE, "bench_results")
 
-# (name, env_overrides, env_deletes)
+# (name, env_overrides, env_deletes, expected_backend)
+# JAX_PLATFORMS is pinned EXPLICITLY per variant: inheriting it from the
+# parent once let a pytest-env run (JAX_PLATFORMS=cpu) produce an all-pass
+# "axon" diagnosis that was really three CPU runs.
 _VARIANTS = [
-    ("default", {}, []),
-    ("no_remote_compile", {}, ["PALLAS_AXON_REMOTE_COMPILE"]),
-    ("cpu_control", {"JAX_PLATFORMS": "cpu"}, []),
+    ("default", {"JAX_PLATFORMS": "axon"}, [], "axon"),
+    ("no_remote_compile", {"JAX_PLATFORMS": "axon"},
+     ["PALLAS_AXON_REMOTE_COMPILE"], "axon"),
+    ("cpu_control", {"JAX_PLATFORMS": "cpu"}, [], "cpu"),
 ]
 
 _STAGE_TIMEOUT_S = int(os.environ.get("PROBE_DIAG_STAGE_TIMEOUT_S", "120"))
@@ -145,7 +149,7 @@ def _listening_ports() -> list[int]:
 
 
 def run_variant(name: str, overrides: dict, deletes: list[str],
-                budget_s: int) -> dict:
+                budget_s: int, expect_backend: str = "") -> dict:
     env = dict(os.environ)
     env.update(overrides)
     for k in deletes:
@@ -173,6 +177,14 @@ def run_variant(name: str, overrides: dict, deletes: list[str],
                 pass
     ok_names = [s["stage"] for s in stages if s.get("ok")]
     all_ok = any(s.get("stage") == "all" for s in stages)
+    got_backend = next((s.get("backend") for s in stages
+                        if s.get("stage") == "all"), None)
+    if all_ok and expect_backend and got_backend != expect_backend:
+        # a pass on the WRONG backend is a false positive, not a diagnosis
+        all_ok = False
+        stages.append({"stage": "backend_check", "ok": False,
+                       "error": f"expected backend {expect_backend!r}, "
+                                f"got {got_backend!r}"})
     # the wedge is the first stage with no ok-marker (hang -> faulthandler
     # exit, or error -> marker with ok=false)
     order = ["import_jax", "backend_init", "devices", "tiny_compile",
@@ -205,8 +217,8 @@ def main() -> int:
                        "PALLAS_AXON_TPU_GEN")},
               "listening_ports": _listening_ports(),
               "variants": []}
-    for name, overrides, deletes in _VARIANTS:
-        rec = run_variant(name, overrides, deletes, budget)
+    for name, overrides, deletes, expect in _VARIANTS:
+        rec = run_variant(name, overrides, deletes, budget, expect)
         report["variants"].append(rec)
         print(f"[diag] {name}: ok={rec['ok']} wedged={rec['wedged_stage']} "
               f"errors={list(rec['stage_errors'])} wall={rec['wall_s']}s",
