@@ -237,6 +237,14 @@ def load_hf(cfg: LlamaConfig,
             dtype: Optional[Any] = None) -> Params:
     """One-call import: ``src`` is a HF model directory path, a state dict,
     or a transformers model object."""
+    if cfg.is_mla:
+        # fail BEFORE reading a ~16B checkpoint: the mapping below stacks
+        # self_attn.{k,v}_proj which DeepSeek-V2 checkpoints don't have
+        # (they ship kv_a_proj_with_mqa/kv_b_proj for w_dkv/w_uk/w_uv)
+        raise NotImplementedError(
+            f"HF checkpoint import has no MLA weight mapping yet "
+            f"({cfg.name}: w_dkv/w_uk/w_uv); init randomly or convert "
+            "offline")
     if hasattr(src, "state_dict"):
         src = src.state_dict()
     if isinstance(src, str):

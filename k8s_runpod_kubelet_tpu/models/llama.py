@@ -101,10 +101,50 @@ class LlamaConfig:
     # rings truncate their rotation. CPU-parity-tested (interpret mode);
     # default OFF until verified on real TPU — flip per ROUND3_NOTES.
     ring_flash: bool = False
+    # Multi-head Latent Attention (DeepSeek-V2, arXiv:2405.04434): set
+    # mla_latent_dim (rank r) to replace K/V projections with a shared
+    # latent c = h @ w_dkv; per-head K/V are up-projections of c, so the
+    # cache stores (r + mla_rope_dim) floats per position instead of
+    # 2*n_kv_heads*head_dim — 8-57x smaller. Decode runs the ABSORBED form
+    # (w_uk folded into q, w_uv into the output): per step it reads the
+    # latent cache, never materialized K/V. RoPE is decoupled: q carries an
+    # extra mla_rope_dim tail scored against ONE shared rotated key per
+    # token (rotation does not commute with the up-projection). MLA ignores
+    # n_kv_heads and excludes sliding_window/qk_norm/qkv_bias (DeepSeek
+    # uses none of them). See ops/mla.py for the self-contained op.
+    mla_latent_dim: Optional[int] = None
+    mla_rope_dim: int = 64
+    # DeepSeek-MoE: this many always-on "shared" experts run as a dense
+    # MLP of width n_shared_experts * mlp_dim alongside the routed experts
+    # (their output is added, router ignores them). 0 = plain MoE/dense.
+    n_shared_experts: int = 0
 
     @property
     def head_dim_(self) -> int:
         return self.head_dim or self.embed_dim // self.n_heads
+
+    @property
+    def is_mla(self) -> bool:
+        return self.mla_latent_dim is not None
+
+    def validate_mla(self) -> None:
+        if not self.is_mla:
+            return
+        bad = [f for f, on in (("sliding_window",
+                                self.sliding_window is not None),
+                               ("qk_norm", self.qk_norm),
+                               ("qkv_bias", self.qkv_bias),
+                               ("attn_logit_softcap",
+                                self.attn_logit_softcap is not None),
+                               ("query_pre_attn_scalar",
+                                self.query_pre_attn_scalar is not None))
+               if on]
+        if bad:
+            raise ValueError(f"MLA does not compose with {bad} "
+                             "(DeepSeek-V2 uses none of them; the MLA "
+                             "paths score at (head_dim+rope_dim)**-0.5 "
+                             "with no softcap — rejecting beats silently "
+                             "ignoring the config)")
 
     @property
     def sm_scale(self) -> float:
@@ -126,13 +166,21 @@ class LlamaConfig:
     def param_count(self) -> int:
         e, m, l, v = self.embed_dim, self.mlp_dim, self.n_layers, self.vocab_size
         hd = self.head_dim_
-        attn = e * hd * (self.n_heads * 2 + self.n_kv_heads * 2)
+        if self.is_mla:
+            r, dr, h = self.mla_latent_dim, self.mla_rope_dim, self.n_heads
+            attn = (e * h * (hd + dr)      # w_q
+                    + e * (r + dr)         # w_dkv
+                    + 2 * r * h * hd       # w_uk, w_uv
+                    + h * hd * e)          # w_o
+        else:
+            attn = e * hd * (self.n_heads * 2 + self.n_kv_heads * 2)
         if self.qkv_bias:
             attn += hd * (self.n_heads + 2 * self.n_kv_heads)
         if self.qk_norm:
             attn += 2 * hd
         if self.n_experts:
             mlp = 3 * e * m * self.n_experts + e * self.n_experts  # experts + router
+            mlp += 3 * e * m * self.n_shared_experts
         else:
             mlp = 3 * e * m
         norms = (4 if self.post_norms else 2) * e
@@ -231,6 +279,34 @@ def qwen2_7b() -> LlamaConfig:
                        norm_eps=1e-6, qkv_bias=True)
 
 
+def deepseek_v2_lite() -> LlamaConfig:
+    """DeepSeek-V2-Lite-class: MLA (latent 512 + decoupled RoPE 64, heads
+    16x128) over a DeepSeek-MoE MLP (64 routed experts top-6 + 2 shared,
+    expert width 1408). Documented divergences from the HF checkpoint: the
+    real model's FIRST layer uses a dense 10944-wide MLP (layer
+    heterogeneity breaks the scan-over-layers layout; all layers are MoE
+    here) and q is full-rank (true for V2-Lite: q_lora_rank is null)."""
+    return LlamaConfig(name="deepseek-v2-lite", vocab_size=102400,
+                       embed_dim=2048, n_layers=27, n_heads=16,
+                       n_kv_heads=16, head_dim=128, mlp_dim=1408,
+                       max_seq_len=32768, rope_theta=10_000.0,
+                       norm_eps=1e-6,
+                       mla_latent_dim=512, mla_rope_dim=64,
+                       n_experts=64, n_experts_per_tok=6,
+                       n_shared_experts=2)
+
+
+def tiny_mla(**kw) -> LlamaConfig:
+    """Tiny MLA config for tests/CPU smoke: dense MLP under latent attention."""
+    kw.setdefault("name", "tiny-mla")
+    kw.setdefault("n_heads", 4)
+    kw.setdefault("n_kv_heads", 4)
+    kw.setdefault("head_dim", 32)
+    kw.setdefault("mla_latent_dim", 64)
+    kw.setdefault("mla_rope_dim", 16)
+    return dataclasses.replace(LlamaConfig(), **kw)
+
+
 def tiny_llama(**kw) -> LlamaConfig:
     return dataclasses.replace(LlamaConfig(), **kw)
 
@@ -246,14 +322,29 @@ def tiny_moe(**kw) -> LlamaConfig:
 
 def param_logical_axes(cfg: LlamaConfig) -> Params:
     """Pytree (matching init_params) of logical-axis tuples."""
-    layer = {
-        "attn_norm": ("layer", "norm"),
-        "wq": ("layer", "embed", "heads"),
-        "wk": ("layer", "embed", "kv_heads"),
-        "wv": ("layer", "embed", "kv_heads"),
-        "wo": ("layer", "heads", "embed"),
-        "mlp_norm": ("layer", "norm"),
-    }
+    if cfg.is_mla:
+        # latent axes stay replicated ("latent": None in LOGICAL_RULES):
+        # every tensor-parallel shard reads the WHOLE latent cache — its
+        # heads attend over all positions' latents — so only the per-head
+        # dims (w_q / w_uk / w_uv outputs, w_o input) shard over tensor.
+        layer = {
+            "attn_norm": ("layer", "norm"),
+            "wq": ("layer", "embed", "heads"),
+            "w_dkv": ("layer", "embed", "latent"),
+            "w_uk": ("layer", "latent", "heads"),
+            "w_uv": ("layer", "latent", "heads"),
+            "wo": ("layer", "heads", "embed"),
+            "mlp_norm": ("layer", "norm"),
+        }
+    else:
+        layer = {
+            "attn_norm": ("layer", "norm"),
+            "wq": ("layer", "embed", "heads"),
+            "wk": ("layer", "embed", "kv_heads"),
+            "wv": ("layer", "embed", "kv_heads"),
+            "wo": ("layer", "heads", "embed"),
+            "mlp_norm": ("layer", "norm"),
+        }
     if cfg.post_norms:
         layer.update({"attn_post_norm": ("layer", "norm"),
                       "mlp_post_norm": ("layer", "norm")})
@@ -271,6 +362,12 @@ def param_logical_axes(cfg: LlamaConfig) -> Params:
             "we_up": ("layer", "expert", "embed", "mlp"),
             "we_down": ("layer", "expert", "mlp", "embed"),
         })
+        if cfg.n_shared_experts:
+            layer.update({
+                "ws_gate": ("layer", "embed", "mlp"),
+                "ws_up": ("layer", "embed", "mlp"),
+                "ws_down": ("layer", "mlp", "embed"),
+            })
     else:
         layer.update({
             "w_gate": ("layer", "embed", "mlp"),
@@ -288,15 +385,28 @@ def param_logical_axes(cfg: LlamaConfig) -> Params:
 def init_params(cfg: LlamaConfig, key: jax.Array,
                 mesh: Optional[Mesh] = None) -> Params:
     """Initialize (optionally directly sharded onto ``mesh``)."""
+    cfg.validate_mla()
     e, hd = cfg.embed_dim, cfg.head_dim_
+    if cfg.is_mla:
+        r, dr = cfg.mla_latent_dim, cfg.mla_rope_dim
+        attn_shapes = {
+            "wq": (cfg.n_layers, e, cfg.n_heads * (hd + dr)),
+            "w_dkv": (cfg.n_layers, e, r + dr),
+            "w_uk": (cfg.n_layers, r, cfg.n_heads * hd),
+            "w_uv": (cfg.n_layers, r, cfg.n_heads * hd),
+        }
+    else:
+        attn_shapes = {
+            "wq": (cfg.n_layers, e, cfg.n_heads * hd),
+            "wk": (cfg.n_layers, e, cfg.n_kv_heads * hd),
+            "wv": (cfg.n_layers, e, cfg.n_kv_heads * hd),
+        }
     shapes = {
         "tok_embed": (cfg.vocab_size, e),
         "final_norm": (e,),
         "layers": {
             "attn_norm": (cfg.n_layers, e),
-            "wq": (cfg.n_layers, e, cfg.n_heads * hd),
-            "wk": (cfg.n_layers, e, cfg.n_kv_heads * hd),
-            "wv": (cfg.n_layers, e, cfg.n_kv_heads * hd),
+            **attn_shapes,
             "wo": (cfg.n_layers, cfg.n_heads * hd, e),
             "mlp_norm": (cfg.n_layers, e),
         },
@@ -324,6 +434,13 @@ def init_params(cfg: LlamaConfig, key: jax.Array,
             "we_up": (cfg.n_layers, cfg.n_experts, e, cfg.mlp_dim),
             "we_down": (cfg.n_layers, cfg.n_experts, cfg.mlp_dim, e),
         })
+        if cfg.n_shared_experts:
+            sw = cfg.n_shared_experts * cfg.mlp_dim
+            shapes["layers"].update({
+                "ws_gate": (cfg.n_layers, e, sw),
+                "ws_up": (cfg.n_layers, e, sw),
+                "ws_down": (cfg.n_layers, sw, e),
+            })
     else:
         shapes["layers"].update({
             "w_gate": (cfg.n_layers, e, cfg.mlp_dim),
@@ -373,11 +490,12 @@ def _rope_tables(cfg: LlamaConfig):
     """(global, local) RoPE tables. Local sublayers (windowed) rotate with
     rope_local_theta and NO position scaling (Gemma-3); without a local
     theta both kinds share the global table."""
-    g = rope_frequencies(cfg.head_dim_, cfg.max_seq_len, cfg.rope_theta,
+    rope_dim = cfg.mla_rope_dim if cfg.is_mla else cfg.head_dim_
+    g = rope_frequencies(rope_dim, cfg.max_seq_len, cfg.rope_theta,
                          cfg.rope_scaling)
     if cfg.rope_local_theta is None:
         return g, g
-    loc = rope_frequencies(cfg.head_dim_, cfg.max_seq_len,
+    loc = rope_frequencies(rope_dim, cfg.max_seq_len,
                            cfg.rope_local_theta, None)
     return g, loc
 
@@ -528,10 +646,73 @@ def _qkv(h, lp, cfg: LlamaConfig, b: int, s: int):
             v.reshape(b, s, cfg.n_kv_heads, hd))
 
 
+def _mla_project(h, lp, cfg: LlamaConfig, cos, sin, positions, b, s):
+    """MLA projections: q_nope (B,S,H,dh), q_rope (B,S,H,dr) rotated,
+    latent c (B,S,r), shared rope key kr (B,S,dr) rotated. One w_dkv
+    matmul yields both cache sections (DeepSeek-V2 decoupled RoPE)."""
+    hd, dr, r = cfg.head_dim_, cfg.mla_rope_dim, cfg.mla_latent_dim
+    q = _mm(h, lp["wq"], cfg.dtype).reshape(b, s, cfg.n_heads, hd + dr)
+    ckr = _mm(h, lp["w_dkv"], cfg.dtype)
+    c, kr = ckr[..., :r], ckr[..., r:]
+    q_nope, q_rope = q[..., :hd], q[..., hd:]
+    q_rope = apply_rope(q_rope, cos, sin, positions)
+    kr = apply_rope(kr[:, :, None, :], cos, sin, positions)[:, :, 0]
+    return q_nope, q_rope, c, kr
+
+
+def _mla_attention_block(x, lp, cfg: LlamaConfig, cos, sin, mesh,
+                         positions=None, return_kv: bool = False):
+    """Direct-form MLA for training/prefill (compute-bound phases):
+    materialize per-head K/V from the latent, then concatenate the shared
+    rotated key onto each head's K so the two-part MLA score
+    (q_nope . k_nope + q_rope . kr) is a SINGLE dot product — the existing
+    flash/ring kernels serve unchanged. V is zero-padded to the qk width
+    (its tail contributes nothing; sliced off after). Decode uses the
+    absorbed form (_verify_step_mla) — that is where the latent cache's
+    bandwidth win lives."""
+    b, s, e = x.shape
+    hd, dr = cfg.head_dim_, cfg.mla_rope_dim
+    hn = cfg.n_heads
+    h = rms_norm(x, _norm_w(lp["attn_norm"], cfg), cfg.norm_eps)
+    q_nope, q_rope, c, kr = _mla_project(h, lp, cfg, cos, sin, positions,
+                                         b, s)
+    k_nope = _mm(c, lp["w_uk"], cfg.dtype).reshape(b, s, hn, hd)
+    v = _mm(c, lp["w_uv"], cfg.dtype).reshape(b, s, hn, hd)
+    q_full = jnp.concatenate([q_nope, q_rope], axis=-1)
+    k_full = jnp.concatenate(
+        [k_nope, jnp.broadcast_to(kr[:, :, None, :], (b, s, hn, dr))],
+        axis=-1)
+    v_full = jnp.concatenate(
+        [v, jnp.zeros((b, s, hn, dr), v.dtype)], axis=-1)
+    q_full = _constrain(q_full, mesh, ("batch", "seq", "act_heads",
+                                       "head_dim"))
+    qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q_full, k_full, v_full))
+    scale = (hd + dr) ** -0.5
+    if mesh is not None and mesh.shape.get(AXES.SEQ, 1) > 1:
+        o = ring_attention(qt, kt, vt, mesh, causal=True, sm_scale=scale,
+                           use_flash=cfg.ring_flash)
+    else:
+        o = flash_attention(qt, kt, vt, causal=True, sm_scale=scale)
+    o = o.transpose(0, 2, 1, 3)[..., :hd].reshape(b, s, hn * hd)
+    o = _mm(o, lp["wo"], cfg.dtype)
+    if cfg.post_norms:
+        o = rms_norm(o, _norm_w(lp["attn_post_norm"], cfg), cfg.norm_eps)
+    if return_kv:
+        return x + o, c, kr  # the latent cache content (B,S,r)/(B,S,dr)
+    return x + o
+
+
 def _attention_block(x, lp, cfg: LlamaConfig, cos, sin, mesh, positions=None,
                      window: Optional[int] = None, return_kv: bool = False,
                      ad: Optional[dict] = None,
                      ad_ids: Optional[jax.Array] = None):
+    if cfg.is_mla:
+        if ad:
+            raise ValueError("multi-LoRA adapters do not target MLA "
+                             "projections (wq/w_dkv/w_uk/w_uv layout "
+                             "differs); serve MLA models without adapters")
+        return _mla_attention_block(x, lp, cfg, cos, sin, mesh, positions,
+                                    return_kv)
     b, s, e = x.shape
     hd = cfg.head_dim_
     h = rms_norm(x, _norm_w(lp["attn_norm"], cfg), cfg.norm_eps)
@@ -591,6 +772,15 @@ def _mlp_block(x, lp, cfg: LlamaConfig, mesh, train: bool = True,
             activation=_activation(cfg), dtype=cfg.dtype,
             constrain=(lambda t, axes: _constrain(t, mesh, axes)))
         aux = cfg.router_aux_coef * aux + cfg.router_z_coef * z
+        if cfg.n_shared_experts:
+            # DeepSeek-MoE shared experts: an always-on dense MLP (width
+            # n_shared * mlp_dim) added to the routed output; the router
+            # never sees it, so no aux-loss contribution
+            gate_s = _mm(h, lp["ws_gate"], cfg.dtype)
+            up_s = _mm(h, lp["ws_up"], cfg.dtype)
+            act_s = _constrain(_activation(cfg)(gate_s) * up_s, mesh,
+                               ("batch", "seq", "act_mlp"))
+            y = y + _mm(act_s, lp["ws_down"], cfg.dtype)
     else:
         gate = _mm(h, lp["w_gate"], cfg.dtype)
         up = _mm(h, lp["w_up"], cfg.dtype)
@@ -774,8 +964,22 @@ class LlamaModel:
 
     def _empty_cache(self, batch: int, length: int, quantize: bool) -> Params:
         cfg = self.cfg
-        shape = (cfg.n_layers, batch, length, cfg.n_kv_heads, cfg.head_dim_)
         dt = jnp.int8 if quantize else cfg.dtype
+        if cfg.is_mla:
+            # latent cache: (r + dr) per position instead of 2*h*d — the
+            # architecture-level answer to decode HBM traffic (int8 on top
+            # halves it again; the two compose like k/v int8 does)
+            r, dr = cfg.mla_latent_dim, cfg.mla_rope_dim
+            cache = {"c": jnp.zeros((cfg.n_layers, batch, length, r), dt),
+                     "kr": jnp.zeros((cfg.n_layers, batch, length, dr), dt),
+                     "index": jnp.zeros((batch,), jnp.int32)}
+            if quantize:
+                cache["c_scale"] = jnp.zeros((cfg.n_layers, batch, length),
+                                             jnp.float32)
+                cache["kr_scale"] = jnp.zeros((cfg.n_layers, batch, length),
+                                              jnp.float32)
+            return cache
+        shape = (cfg.n_layers, batch, length, cfg.n_kv_heads, cfg.head_dim_)
         cache = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt),
                  "index": jnp.zeros((batch,), jnp.int32)}
         if quantize:
@@ -915,6 +1119,22 @@ class LlamaModel:
         x = rms_norm(x, _norm_w(params["final_norm"], cfg), cfg.norm_eps)
         last = x[jnp.arange(b), true_length - 1]  # (B, E): each row's last real token
         logits = _head_logits(last, params, cfg)
+        if cfg.is_mla:  # k_all/v_all are the latent sections c/kr here
+            c_all, kr_all = k_all, v_all            # (L,B,S,r), (L,B,S,dr)
+            max_len = cache["c"].shape[2]
+            if s > max_len:
+                raise ValueError(f"prompt length {s} exceeds cache length "
+                                 f"{max_len}")
+            pad4 = [(0, 0), (0, 0), (0, max_len - s), (0, 0)]
+            new_cache = {"index": true_length.astype(jnp.int32)}
+            if "c_scale" in cache:  # int8 latent cache
+                c_all, c_sc = _kv_quant(c_all)       # (L,B,S,r) + (L,B,S)
+                kr_all, kr_sc = _kv_quant(kr_all)
+                new_cache["c_scale"] = jnp.pad(c_sc, pad4[:-1])
+                new_cache["kr_scale"] = jnp.pad(kr_sc, pad4[:-1])
+            new_cache["c"] = jnp.pad(c_all, pad4)
+            new_cache["kr"] = jnp.pad(kr_all, pad4)
+            return logits, new_cache
         if "k_l" in cache:  # mixed local/global split cache (Gemma-2/3)
             ring = cache["k_l"].shape[2]
             max_g = cache["k_g"].shape[2]
@@ -1011,6 +1231,9 @@ class LlamaModel:
         masks to ``<= index`` and later writes overwrite them (the same
         invariant decode_step relies on)."""
         cfg = self.cfg
+        if cfg.is_mla:
+            return self._verify_step_mla(params, tokens, cache, active,
+                                         adapters, adapter_ids)
         b, kk = tokens.shape
         idx = cache["index"]  # (B,)
         if active is None:
@@ -1232,6 +1455,106 @@ class LlamaModel:
             out["abs_pos"] = new_abs
         return logits, out
 
+    def _verify_step_mla(self, params: Params, tokens: jax.Array,
+                         cache: Params,
+                         active: Optional[jax.Array] = None,
+                         adapters: Optional[dict] = None,
+                         adapter_ids: Optional[jax.Array] = None
+                         ) -> tuple[jax.Array, Params]:
+        """verify_step for MLA models, in the ABSORBED form: fold w_uk into
+        the query (q_lat = q_nope @ w_uk) and w_uv into the output, so each
+        step reads the (L, r+dr) latent cache and never materializes
+        per-head K/V — the bandwidth win the latent compression promised
+        (ops/mla.py mla_decode_step is the self-contained single-token
+        statement of the same math; this is its K-token, int8-capable,
+        active-masked engine sibling). Same contract as verify_step:
+        all K latents written, ``index`` NOT advanced, rejected positions
+        invisible behind the <= index+j mask."""
+        cfg = self.cfg
+        if adapters:
+            raise ValueError("multi-LoRA adapters do not target MLA "
+                             "projections; serve MLA models without "
+                             "adapters")
+        b, kk = tokens.shape
+        idx = cache["index"]
+        if active is None:
+            active = jnp.ones((b,), bool)
+        cos, sin = _rope_tables(cfg)[0]            # MLA: single global table
+        x = _embed(params, tokens, cfg, self.mesh)                 # (B,K,E)
+        positions = idx[:, None] + jnp.arange(kk)[None, :]         # (B,K)
+        batch_ids = jnp.arange(b)[:, None]
+        cache_len = cache["c"].shape[2]
+        hd, dr, r = cfg.head_dim_, cfg.mla_rope_dim, cfg.mla_latent_dim
+        hn = cfg.n_heads
+        scale = (hd + dr) ** -0.5
+        # (B,1,K,L): query j of slot b sees committed positions <= idx[b]+j
+        pos_l = jnp.arange(cache_len)[None, None, :]
+        valid = (pos_l <= positions[:, :, None])[:, None]
+        quant = "c_scale" in cache
+        act2 = active[:, None]                     # (B,1) vs (B,K) writes
+        act3 = active[:, None, None]
+
+        def block(carry, inputs):
+            y = carry
+            lp = inputs["lp"]
+            c_cache, kr_cache = inputs["c"], inputs["kr"]
+            c_sc, kr_sc = inputs.get("cs"), inputs.get("krs")
+            h = rms_norm(y, _norm_w(lp["attn_norm"], cfg), cfg.norm_eps)
+            q_nope, q_rope, c1, kr1 = _mla_project(h, lp, cfg, cos, sin,
+                                                   positions, b, kk)
+            if quant:  # int8 latent cache: per-position scales
+                c1, c1_s = _kv_quant(c1)                       # (B,K,r),(B,K)
+                kr1, kr1_s = _kv_quant(kr1)
+                c_sc = c_sc.at[batch_ids, positions].set(
+                    jnp.where(act2, c1_s, c_sc[batch_ids, positions]))
+                kr_sc = kr_sc.at[batch_ids, positions].set(
+                    jnp.where(act2, kr1_s, kr_sc[batch_ids, positions]))
+            c_cache = c_cache.at[batch_ids, positions].set(
+                jnp.where(act3, c1, c_cache[batch_ids, positions]))
+            kr_cache = kr_cache.at[batch_ids, positions].set(
+                jnp.where(act3, kr1, kr_cache[batch_ids, positions]))
+            c_read = (_kv_dequant(c_cache, c_sc) if quant
+                      else c_cache.astype(jnp.float32))        # (B,L,r)
+            kr_read = (_kv_dequant(kr_cache, kr_sc) if quant
+                       else kr_cache.astype(jnp.float32))      # (B,L,dr)
+            w_uk = lp["w_uk"].reshape(r, hn, hd)
+            # absorbed query: latent-space scores + decoupled-RoPE term
+            q_lat = jnp.einsum("bkhd,rhd->bkhr",
+                               q_nope.astype(jnp.float32) * scale,
+                               w_uk.astype(jnp.float32))
+            s = (jnp.einsum("bkhr,blr->bhkl", q_lat, c_read)
+                 + jnp.einsum("bkhd,bld->bhkl",
+                              q_rope.astype(jnp.float32) * scale, kr_read))
+            s = jnp.where(valid, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            o_lat = jnp.einsum("bhkl,blr->bkhr", p, c_read)    # (B,K,H,r)
+            w_uv = lp["w_uv"].reshape(r, hn, hd)
+            o = jnp.einsum("bkhr,rhd->bkhd", o_lat,
+                           w_uv.astype(jnp.float32))
+            o = o.reshape(b, kk, hn * hd).astype(cfg.dtype)
+            o = _mm(o, lp["wo"], cfg.dtype)
+            if cfg.post_norms:
+                o = rms_norm(o, _norm_w(lp["attn_post_norm"], cfg),
+                             cfg.norm_eps)
+            y = y + o
+            y, _ = _mlp_block(y, lp, cfg, self.mesh, train=False)
+            out = {"c": c_cache, "kr": kr_cache}
+            if quant:
+                out["cs"], out["krs"] = c_sc, kr_sc
+            return y, out
+
+        xs = {"lp": params["layers"], "c": cache["c"], "kr": cache["kr"]}
+        if quant:
+            xs["cs"] = cache["c_scale"]
+            xs["krs"] = cache["kr_scale"]
+        x, new_kv = jax.lax.scan(block, x, xs)
+        x = rms_norm(x, _norm_w(params["final_norm"], cfg), cfg.norm_eps)
+        logits = _head_logits(x, params, cfg).astype(jnp.float32)  # (B,K,V)
+        out = {"c": new_kv["c"], "kr": new_kv["kr"], "index": idx}
+        if quant:
+            out["c_scale"], out["kr_scale"] = new_kv["cs"], new_kv["krs"]
+        return logits, out
+
     @staticmethod
     def insert_into_slot(cache: Params, single: Params, slot: int | jax.Array
                          ) -> Params:
@@ -1241,7 +1564,8 @@ class LlamaModel:
         # every stacked-KV section shares the (layers, batch, ...) layout
         for sect in ("k", "v", "k_l", "v_l", "k_g", "v_g",
                      "k_scale", "v_scale", "k_l_scale", "v_l_scale",
-                     "k_g_scale", "v_g_scale"):
+                     "k_g_scale", "v_g_scale",
+                     "c", "kr", "c_scale", "kr_scale"):
             if sect in cache:
                 out[sect] = cache[sect].at[:, slot].set(single[sect][:, 0])
         if "abs_pos" in cache:

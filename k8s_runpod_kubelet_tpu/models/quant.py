@@ -38,8 +38,13 @@ from .llama import LlamaConfig, Params
 __all__ = ["quantize_params", "is_quantized", "quantized_logical_axes"]
 
 # stacked-layer projection weights with (in, out) as the trailing dims,
-# plus the top-level lm head — the decode-bandwidth heavy hitters
-_LAYER_WEIGHTS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+# plus the top-level lm head — the decode-bandwidth heavy hitters.
+# MLA: w_dkv and the shared-expert MLP quantize (plain _mm consumers);
+# w_uk/w_uv stay full precision — the absorbed decode path consumes them
+# via reshape+einsum (not _mm), and at (r, H*dh) they are tiny next to
+# the latent-cache reads the absorbed form exists to shrink.
+_LAYER_WEIGHTS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+                  "w_dkv", "ws_gate", "ws_up", "ws_down")
 # expert weights: int8-only (moe.py's einsums handle {q8, scale}; the int4
 # unpack kernel is a 2D-matmul kernel and doesn't cover the expert path)
 _EXPERT_WEIGHTS = ("we_gate", "we_up", "we_down")
@@ -143,6 +148,12 @@ def quantize_params(cfg: LlamaConfig, params: Params,
             leaf = quant(w)
             layers[name] = (jax.tree_util.tree_map(jnp.asarray, leaf)
                             if commit else leaf)
+        elif name in ("w_uk", "w_uv"):
+            # MLA up-projections: unquantized (absorbed decode consumes
+            # them via reshape+einsum, not _mm) but stored in the COMPUTE
+            # dtype — f32 would double their HBM reads for nothing
+            layers[name] = place(w, np.dtype(cfg.dtype) if not commit
+                                 else cfg.dtype)
         else:
             layers[name] = place(w)
     out["layers"] = layers

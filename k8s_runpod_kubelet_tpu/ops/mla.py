@@ -138,7 +138,14 @@ def mla_decode_step(h1: jax.Array, params: dict, cache: dict, cos, sin
     h1 (B, 1, E); each row's position comes from its cache["index"][b]
     (slots at different lengths, the continuous-batching shape). Returns
     (out (B, 1, E), updated cache)."""
-    idx = cache["index"]                              # (B,)
+    # Guard a full row (ADVICE r4): without the clamp, a scatter at
+    # idx == max_len is silently DROPPED (JAX OOB semantics) while index
+    # keeps advancing, and the live mask (arange <= idx) then admits every
+    # position — zero latents included — into the softmax: silently wrong
+    # attention. Clamping pins a full row at its last slot (that slot is
+    # overwritten, attention stays over real latents); callers (the serving
+    # engine) must retire rows at max_len — this is the op-level backstop.
+    idx = jnp.minimum(cache["index"], cache["c"].shape[1] - 1)  # (B,)
     pos = idx[:, None]                                # (B, 1)
     q_nope, q_rope, c1, kr1 = _project(h1, params, cos, sin, pos)
     b, _, hn, dh = q_nope.shape

@@ -35,6 +35,9 @@ LOGICAL_RULES: dict[str, object] = {
     "kv_heads": AXES.TENSOR,
     "qkv": None,
     "head_dim": None,
+    # MLA latent rank: replicated — every tensor shard's heads attend over
+    # all positions' latents (models/llama.py param_logical_axes)
+    "latent": None,
     "vocab": AXES.TENSOR,
     "expert": AXES.EXPERT,
     "stage": AXES.STAGE,

@@ -827,7 +827,8 @@ def main(argv=None) -> int:
                    choices=["gemma-7b", "gemma2-9b", "gemma3-12b",
                             "llama3-8b", "llama31-8b", "llama3-70b",
                             "mixtral-8x7b", "mistral-7b",
-                            "qwen2-7b", "tiny", "tiny-moe"])
+                            "qwen2-7b", "deepseek-v2-lite",
+                            "tiny", "tiny-moe", "tiny-mla"])
     p.add_argument("--slots", type=int, default=8)
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--cache-len", type=int, default=2048)
@@ -894,15 +895,17 @@ def main(argv=None) -> int:
     import jax
     from ..models import (gemma_7b, gemma2_9b, gemma3_12b, llama3_8b,
                           llama31_8b, llama3_70b, mixtral_8x7b, mistral_7b,
-                          qwen2_7b, tiny_llama, tiny_moe, init_params)
+                          qwen2_7b, deepseek_v2_lite, tiny_llama, tiny_moe,
+                          tiny_mla, init_params)
     from .serving import ServingConfig, ServingEngine
 
     cfg = {"gemma-7b": gemma_7b, "gemma2-9b": gemma2_9b,
            "gemma3-12b": gemma3_12b, "llama3-8b": llama3_8b,
            "llama31-8b": llama31_8b, "llama3-70b": llama3_70b,
            "mixtral-8x7b": mixtral_8x7b, "mistral-7b": mistral_7b,
-           "qwen2-7b": qwen2_7b, "tiny": tiny_llama,
-           "tiny-moe": tiny_moe}[args.model]()
+           "qwen2-7b": qwen2_7b, "deepseek-v2-lite": deepseek_v2_lite,
+           "tiny": tiny_llama, "tiny-moe": tiny_moe,
+           "tiny-mla": tiny_mla}[args.model]()
     log.info("loading %s (%.2fB params) on %s", cfg.name,
              cfg.param_count / 1e9, jax.default_backend())
     from .tokenizer import get_tokenizer
@@ -911,6 +914,16 @@ def main(argv=None) -> int:
     if args.int8 and args.int4:
         log.error("--int8 and --int4 are mutually exclusive — pick one "
                   "weight precision")
+        return 1
+    if args.lora_rank > 0 and cfg.is_mla:
+        log.error("--lora-rank does not compose with MLA models (adapters "
+                  "target the wq/wk/wv layout; %s uses w_dkv/w_uk/w_uv)",
+                  cfg.name)
+        return 1
+    if args.hf_checkpoint and cfg.is_mla:
+        log.error("--hf-checkpoint has no MLA weight mapping yet (%s needs "
+                  "kv_a_proj_with_mqa/kv_b_proj -> w_dkv/w_uk/w_uv); serve "
+                  "with random init or convert offline", cfg.name)
         return 1
     mesh = None
     if args.tensor_parallel > 1:

@@ -212,6 +212,12 @@ def kv_cache_pspec(name: str, ndim: int):
     from ..parallel.mesh import AXES
     if name in ("index", "abs_pos"):
         return P()
+    if name in ("c", "kr", "c_scale", "kr_scale"):
+        # MLA latent cache: NO heads axis — every tensor shard's heads
+        # attend over all positions' latents, so the cache replicates.
+        # Even replicated it is 8-57x smaller than a tensor-sharded K/V
+        # cache (576 B/token at DeepSeek-V2 geometry vs 32k unsharded).
+        return P()
     if name.endswith("_scale"):
         return P(*([None] * (ndim - 1) + [AXES.TENSOR]))
     return P(*([None] * (ndim - 2) + [AXES.TENSOR, None]))
@@ -447,6 +453,10 @@ class ServingEngine:
         self._adapter_lock = threading.Lock()
         self._slot_adapter = np.zeros((sc.slots,), np.int32)
         if sc.lora_rank > 0:
+            if cfg.is_mla:
+                raise ValueError("multi-LoRA serving does not support MLA "
+                                 "models (adapters target the wq/wk/wv "
+                                 "layout; MLA has w_dkv/w_uk/w_uv)")
             e, hd, m = cfg.embed_dim, cfg.head_dim_, cfg.mlp_dim
             dims = {"wq": (e, cfg.n_heads * hd),
                     "wk": (e, cfg.n_kv_heads * hd),
