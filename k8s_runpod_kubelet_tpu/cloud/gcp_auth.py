@@ -98,6 +98,11 @@ class _CachingProvider:
         raise NotImplementedError
 
 
+# public name: kube/client.py's exec-credential plugin builds on the same
+# cache/skew/invalidate contract (one token-cache implementation project-wide)
+CachingTokenProvider = _CachingProvider
+
+
 class MetadataTokenProvider(_CachingProvider):
     """GCE/GKE metadata-server tokens (workload identity / attached SA)."""
 

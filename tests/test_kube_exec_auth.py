@@ -1,0 +1,319 @@
+"""Exec credential plugin kubeconfig auth (VERDICT r4 missing item 2).
+
+Real GKE kubeconfigs authenticate via an `exec` plugin
+(gke-gcloud-auth-plugin) — static token/client-cert users alone cannot
+drive the cluster class this kubelet targets. These tests run a GKE-shaped
+kubeconfig through RealKubeClient.from_kubeconfig against a real HTTP
+apiserver double, with a fake plugin binary that counts its invocations.
+"""
+
+import base64
+import json
+import os
+import stat
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from k8s_runpod_kubelet_tpu.kube.client import (ExecCredentialPlugin,
+                                                KubeApiError, RealKubeClient)
+
+
+class _ApiServer:
+    """Minimal apiserver double: serves GET /api/v1/namespaces/default/pods
+    iff the Authorization header carries an accepted bearer token; 401
+    otherwise. Records the tokens it saw."""
+
+    def __init__(self, accepted: set):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                tok = (self.headers.get("Authorization") or "")
+                tok = tok.removeprefix("Bearer ")
+                outer.seen.append(tok)
+                if tok not in outer.accepted:
+                    self.send_response(401)
+                    self.end_headers()
+                    self.wfile.write(b'{"kind":"Status","code":401}')
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(json.dumps(
+                    {"kind": "PodList", "items": []}).encode())
+
+            def log_message(self, *a):
+                pass
+
+        self.accepted = accepted
+        self.seen: list = []
+        self.httpd = HTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _write_plugin(tmp_path, token: str, expires_in_s: float = 3600.0,
+                  counter_name: str = "calls") -> str:
+    """A fake gke-gcloud-auth-plugin: prints an ExecCredential and bumps a
+    counter file per invocation. Token value = <token>-<call#> so tests can
+    see WHICH invocation minted the credential in use."""
+    counter = tmp_path / counter_name
+    script = tmp_path / "fake-auth-plugin"
+    script.write_text(f"""#!{sys.executable}
+import json, os, time
+path = {str(counter)!r}
+n = int(open(path).read()) + 1 if os.path.exists(path) else 1
+open(path, "w").write(str(n))
+exp = time.time() + {expires_in_s}
+out = {{"apiVersion": os.environ.get("KUBERNETES_EXEC_INFO") and
+        json.loads(os.environ["KUBERNETES_EXEC_INFO"])["apiVersion"]
+        or "client.authentication.k8s.io/v1beta1",
+       "kind": "ExecCredential",
+       "status": {{"token": {token!r} + "-" + str(n),
+                  "expirationTimestamp": time.strftime(
+                      "%Y-%m-%dT%H:%M:%SZ", time.gmtime(exp))}}}}
+print(json.dumps(out))
+""")
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    return str(script)
+
+
+def _write_kubeconfig(tmp_path, server: str, plugin: str,
+                      provide_cluster_info: bool = False) -> str:
+    cfg = {
+        "apiVersion": "v1", "kind": "Config", "current-context": "gke",
+        "contexts": [{"name": "gke",
+                      "context": {"cluster": "c1", "user": "u1"}}],
+        "clusters": [{"name": "c1", "cluster": {"server": server}}],
+        "users": [{"name": "u1", "user": {"exec": {
+            "apiVersion": "client.authentication.k8s.io/v1beta1",
+            "command": plugin,
+            "args": [],
+            "env": [{"name": "FAKE_PLUGIN_MODE", "value": "test"}],
+            "provideClusterInfo": provide_cluster_info,
+            "interactiveMode": "Never",
+        }}}],
+    }
+    import yaml
+    path = tmp_path / "kubeconfig.yaml"
+    path.write_text(yaml.safe_dump(cfg))
+    return str(path)
+
+
+class TestExecPluginKubeconfig:
+    def test_gke_shaped_kubeconfig_drives_real_client(self, tmp_path):
+        api = _ApiServer(accepted={"gke-tok-1"})
+        try:
+            plugin = _write_plugin(tmp_path, "gke-tok")
+            kc = _write_kubeconfig(tmp_path, f"http://127.0.0.1:{api.port}",
+                                   plugin)
+            client = RealKubeClient.from_kubeconfig(kc)
+            assert client.token_provider is not None
+            pods = client.list_pods("virtual-tpu")
+            assert pods == []
+            assert api.seen == ["gke-tok-1"]
+        finally:
+            api.stop()
+
+    def test_token_cached_until_expiry(self, tmp_path):
+        api = _ApiServer(accepted={"gke-tok-1"})
+        try:
+            plugin = _write_plugin(tmp_path, "gke-tok")
+            kc = _write_kubeconfig(tmp_path, f"http://127.0.0.1:{api.port}",
+                                   plugin)
+            client = RealKubeClient.from_kubeconfig(kc)
+            for _ in range(3):
+                client.list_pods("virtual-tpu")
+            assert (tmp_path / "calls").read_text() == "1"  # one exec only
+        finally:
+            api.stop()
+
+    def test_expired_token_reexecs(self, tmp_path):
+        api = _ApiServer(accepted={"gke-tok-1", "gke-tok-2"})
+        try:
+            # expires within the refresh skew -> every call re-execs
+            plugin = _write_plugin(tmp_path, "gke-tok", expires_in_s=10.0)
+            kc = _write_kubeconfig(tmp_path, f"http://127.0.0.1:{api.port}",
+                                   plugin)
+            client = RealKubeClient.from_kubeconfig(kc)
+            client.list_pods("virtual-tpu")
+            client.list_pods("virtual-tpu")
+            assert (tmp_path / "calls").read_text() == "2"
+            assert api.seen == ["gke-tok-1", "gke-tok-2"]
+        finally:
+            api.stop()
+
+    def test_401_invalidates_and_retries_once(self, tmp_path):
+        # the server only accepts the SECOND minted token: call 1 gets 401,
+        # the client must invalidate + re-exec + retry within one request
+        api = _ApiServer(accepted={"gke-tok-2"})
+        try:
+            plugin = _write_plugin(tmp_path, "gke-tok")
+            kc = _write_kubeconfig(tmp_path, f"http://127.0.0.1:{api.port}",
+                                   plugin)
+            client = RealKubeClient.from_kubeconfig(kc)
+            pods = client.list_pods("virtual-tpu")
+            assert pods == []
+            assert api.seen == ["gke-tok-1", "gke-tok-2"]
+        finally:
+            api.stop()
+
+    def test_watch_401_invalidates_token_cache(self, tmp_path):
+        """A revoked-before-expiry token must not be replayed on every
+        watch reconnect: the 401 drops the cache so the next connect
+        (watch or request) re-execs the plugin."""
+        api = _ApiServer(accepted={"gke-tok-2"})   # first minted token dead
+        try:
+            plugin = _write_plugin(tmp_path, "gke-tok")
+            kc = _write_kubeconfig(tmp_path, f"http://127.0.0.1:{api.port}",
+                                   plugin)
+            client = RealKubeClient.from_kubeconfig(kc)
+            with pytest.raises(KubeApiError) as ei:
+                next(iter(client.watch_pods()))
+            assert ei.value.status == 401
+            # the cache was invalidated: the next call mints token 2
+            client.list_pods("virtual-tpu")
+            assert api.seen[-1] == "gke-tok-2"
+            assert (tmp_path / "calls").read_text() == "2"
+        finally:
+            api.stop()
+
+    def test_plugin_failure_is_actionable(self, tmp_path):
+        api = _ApiServer(accepted=set())
+        try:
+            kc = _write_kubeconfig(tmp_path, f"http://127.0.0.1:{api.port}",
+                                   str(tmp_path / "no-such-plugin"))
+            client = RealKubeClient.from_kubeconfig(kc)
+            with pytest.raises(KubeApiError, match="not found"):
+                client.list_pods("virtual-tpu")
+        finally:
+            api.stop()
+
+    def test_provide_cluster_info_in_exec_env(self, tmp_path):
+        """provideClusterInfo: the plugin must receive spec.cluster.server
+        in KUBERNETES_EXEC_INFO."""
+        recorded = tmp_path / "exec_info.json"
+        script = tmp_path / "plugin2"
+        script.write_text(f"""#!{sys.executable}
+import json, os
+open({str(recorded)!r}, "w").write(os.environ.get("KUBERNETES_EXEC_INFO", ""))
+print(json.dumps({{"apiVersion": "client.authentication.k8s.io/v1beta1",
+                  "kind": "ExecCredential",
+                  "status": {{"token": "t1"}}}}))
+""")
+        script.chmod(script.stat().st_mode | stat.S_IEXEC)
+        api = _ApiServer(accepted={"t1"})
+        try:
+            kc = _write_kubeconfig(tmp_path, f"http://127.0.0.1:{api.port}",
+                                   str(script), provide_cluster_info=True)
+            client = RealKubeClient.from_kubeconfig(kc)
+            client.list_pods("virtual-tpu")
+            info = json.loads(recorded.read_text())
+            assert info["spec"]["cluster"]["server"].startswith("http://")
+            assert info["kind"] == "ExecCredential"
+        finally:
+            api.stop()
+
+    def test_no_expiry_caches_for_process_lifetime(self, tmp_path):
+        script = tmp_path / "plugin3"
+        counter = tmp_path / "calls3"
+        script.write_text(f"""#!{sys.executable}
+import json, os
+path = {str(counter)!r}
+n = int(open(path).read()) + 1 if os.path.exists(path) else 1
+open(path, "w").write(str(n))
+print(json.dumps({{"apiVersion": "client.authentication.k8s.io/v1beta1",
+                  "kind": "ExecCredential", "status": {{"token": "t1"}}}}))
+""")
+        script.chmod(script.stat().st_mode | stat.S_IEXEC)
+        api = _ApiServer(accepted={"t1"})
+        try:
+            kc = _write_kubeconfig(tmp_path, f"http://127.0.0.1:{api.port}",
+                                   str(script))
+            client = RealKubeClient.from_kubeconfig(kc)
+            for _ in range(3):
+                client.list_pods("virtual-tpu")
+            assert counter.read_text() == "1"
+        finally:
+            api.stop()
+
+
+class TestInlineDataFields:
+    def test_ca_data_loaded_without_touching_disk(self, tmp_path,
+                                                  monkeypatch):
+        """certificate-authority-data (how GKE ships its CA) feeds ssl via
+        cadata — the CA never lands in a file."""
+        captured = {}
+        real_create = __import__("ssl").create_default_context
+
+        def spy(cafile=None, cadata=None, **kw):
+            captured["cafile"] = cafile
+            captured["cadata"] = cadata
+            return real_create()   # a default ctx; we only spy on the args
+
+        import k8s_runpod_kubelet_tpu.kube.client as kc_mod
+        monkeypatch.setattr(kc_mod.ssl, "create_default_context", spy)
+        pem = b"-----BEGIN CERTIFICATE-----\nMIIfake\n-----END CERTIFICATE-----\n"
+        cfg = {
+            "apiVersion": "v1", "current-context": "gke",
+            "contexts": [{"name": "gke",
+                          "context": {"cluster": "c1", "user": "u1"}}],
+            "clusters": [{"name": "c1", "cluster": {
+                "server": "https://10.0.0.1:443",
+                "certificate-authority-data":
+                    base64.b64encode(pem).decode()}}],
+            "users": [{"name": "u1", "user": {"token": "static"}}],
+        }
+        import yaml
+        p = tmp_path / "kc.yaml"
+        p.write_text(yaml.safe_dump(cfg))
+        RealKubeClient.from_kubeconfig(str(p))
+        assert captured["cadata"] == pem.decode()
+        assert not captured["cafile"]   # no temp file for the CA
+
+    def test_client_key_tempfile_removed_after_load(self, tmp_path,
+                                                    monkeypatch):
+        """Inline client-key-data must not outlive from_kubeconfig on disk
+        (it is a PRIVATE KEY); the temp files are unlinked right after
+        load_cert_chain consumed them."""
+        seen = {}
+        import k8s_runpod_kubelet_tpu.kube.client as kc_mod
+        real = kc_mod._b64_to_tempfile
+
+        def spy(data_b64, suffix):
+            path = real(data_b64, suffix)
+            seen[suffix] = path
+            return path
+
+        monkeypatch.setattr(kc_mod, "_b64_to_tempfile", spy)
+        monkeypatch.setattr(
+            kc_mod.ssl.SSLContext, "load_cert_chain",
+            lambda self, cert, key=None: None)  # fake PEM won't parse; the
+        # test is about file LIFETIME, not TLS
+        cfg = {
+            "apiVersion": "v1", "current-context": "gke",
+            "contexts": [{"name": "gke",
+                          "context": {"cluster": "c1", "user": "u1"}}],
+            "clusters": [{"name": "c1",
+                          "cluster": {"server": "https://10.0.0.1:443"}}],
+            "users": [{"name": "u1", "user": {
+                "client-certificate-data":
+                    base64.b64encode(b"fake-cert").decode(),
+                "client-key-data":
+                    base64.b64encode(b"fake-key").decode()}}],
+        }
+        import yaml
+        p = tmp_path / "kc.yaml"
+        p.write_text(yaml.safe_dump(cfg))
+        RealKubeClient.from_kubeconfig(str(p))
+        assert set(seen) == {".crt", ".key"}
+        for path in seen.values():
+            assert not os.path.exists(path), f"{path} outlived the load"
