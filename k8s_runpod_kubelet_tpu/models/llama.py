@@ -18,6 +18,7 @@ zero-centered RMSNorm, optional logit softcap) — see the config constructors.
 
 from __future__ import annotations
 
+import contextvars
 import dataclasses
 import functools
 from typing import Any, Optional
@@ -560,6 +561,26 @@ def _mm(h, w, dtype):
     return h @ w.astype(dtype)
 
 
+# Trace-time mesh for the int4 kernel: _mm's signature stays mesh-free
+# across its ~20 call sites, and the model's public entry points (each
+# decorated with _with_int4_mesh) publish self.mesh here instead. Safe
+# because it is read during TRACING only — the jitted program bakes the
+# mesh in, exactly like the closure-captured mesh everywhere else.
+_INT4_MESH: "contextvars.ContextVar[Optional[Mesh]]" = \
+    contextvars.ContextVar("int4_mesh", default=None)
+
+
+def _with_int4_mesh(fn):
+    @functools.wraps(fn)
+    def wrapped(self, *a, **k):
+        tok = _INT4_MESH.set(self.mesh)
+        try:
+            return fn(self, *a, **k)
+        finally:
+            _INT4_MESH.reset(tok)
+    return wrapped
+
+
 def _mm_int4(h, w, dtype):
     """h (..., in) @ int4-packed weight -> (..., out).
 
@@ -583,7 +604,11 @@ def _mm_int4(h, w, dtype):
     accessed vs int8's 6.3GB at the 8B decode) — on TPU the matmul runs as
     a Pallas kernel (ops/int4_matmul.py) that unpacks in VMEM; this module
     keeps only the XLA fallback for CPU/interpret paths."""
-    from ..ops.int4_matmul import int4_matmul
+    from ..ops.int4_matmul import int4_matmul, int4_matmul_sharded
+    mesh = _INT4_MESH.get()
+    if mesh is not None and mesh.shape.get(AXES.TENSOR, 1) > 1:
+        return int4_matmul_sharded(h.astype(dtype), w["q4"], w["scale"],
+                                   mesh, axis=AXES.TENSOR)
     return int4_matmul(h.astype(dtype), w["q4"], w["scale"])
 
 
@@ -847,6 +872,7 @@ class LlamaModel:
         self.cfg = cfg
         self.mesh = mesh
 
+    @_with_int4_mesh
     def forward(self, params: Params, tokens: jax.Array,
                 positions: Optional[jax.Array] = None,
                 with_aux: bool = False, return_hidden: bool = False):
@@ -1065,6 +1091,7 @@ class LlamaModel:
                                            jnp.float32)
         return cache
 
+    @_with_int4_mesh
     def prefill(self, params: Params, tokens: jax.Array, cache: Params,
                 true_length: Optional[jax.Array] = None,
                 adapters: Optional[dict] = None,
@@ -1211,6 +1238,7 @@ class LlamaModel:
         cache["index"] = jnp.where(active, cache["index"] + 1, cache["index"])
         return logits[:, 0], cache
 
+    @_with_int4_mesh
     def verify_step(self, params: Params, tokens: jax.Array, cache: Params,
                     active: Optional[jax.Array] = None,
                     adapters: Optional[dict] = None,

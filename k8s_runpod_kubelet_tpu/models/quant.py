@@ -91,26 +91,42 @@ def is_quantized(w: Any) -> bool:
     return isinstance(w, dict) and ("q8" in w or "q4" in w)
 
 
-def quantized_logical_axes(cfg: LlamaConfig) -> Params:
-    """Logical-axis tree for an int8-quantized param tree (mirrors
-    quantize_params(bits=8) output), so 70B-class int8 serving can shard
-    over a mesh exactly like bf16 serving: ``q8`` keeps the base weight's
-    axes; ``scale`` (..., 1, out) replicates its singleton contraction dim
-    and keeps the output axis. int4 is excluded on purpose — its packed
-    contraction axis halves the logical length and the Pallas kernel is
-    not shard_map'd; shard int8 or serve int4 single-chip."""
+def quantized_logical_axes(cfg: LlamaConfig, bits: int = 8) -> Params:
+    """Logical-axis tree for a quantized param tree (mirrors
+    quantize_params output), so 70B-class quantized serving can shard over
+    a mesh exactly like bf16 serving.
+
+    bits=8: ``q8`` keeps the base weight's axes; ``scale`` (..., 1, out)
+    replicates its singleton contraction dim and keeps the output axis.
+
+    bits=4: every packed weight shards its OUTPUT axis over the dedicated
+    ``int4_out`` logical axis (-> tensor) and replicates the packed
+    contraction + group axes — the layout ops/int4_matmul.py's
+    int4_matmul_sharded (shard_map) partitions the Pallas kernel under. (The
+    contraction axis CANNOT shard: it is 2x-packed and 128-grouped, so a
+    propagated shard on the activation axis has no consistent image on
+    the byte/group axes; out-sharding keeps every weight distributed and
+    only the KB-scale activations replicate.)"""
     from .llama import param_logical_axes
     base = param_logical_axes(cfg)
 
-    def q_axes(axes):
-        return {"q8": axes, "scale": axes[:-2] + (None, axes[-1])}
+    if bits == 4:
+        def q_axes(axes):
+            lead = axes[:-2]   # ("layer",) for stacked weights, () for lm_head
+            return {"q4": lead + (None, "int4_out"),
+                    "scale": lead + (None, None, "int4_out")}
+
+        quantized = set(_LAYER_WEIGHTS)   # experts stay unquantized at int4
+    else:
+        def q_axes(axes):
+            return {"q8": axes, "scale": axes[:-2] + (None, axes[-1])}
+
+        quantized = set(_LAYER_WEIGHTS) | set(_EXPERT_WEIGHTS)
 
     out: Params = {"tok_embed": base["tok_embed"],
                    "final_norm": base["final_norm"]}
     out["layers"] = {
-        name: (q_axes(axes)
-               if name in _LAYER_WEIGHTS or name in _EXPERT_WEIGHTS
-               else axes)
+        name: (q_axes(axes) if name in quantized else axes)
         for name, axes in base["layers"].items()
     }
     if "lm_head" in base:

@@ -35,7 +35,7 @@ import jax.numpy as jnp
 
 from .common import use_pallas as _use_pallas
 
-__all__ = ["int4_matmul"]
+__all__ = ["int4_matmul", "int4_matmul_sharded"]
 
 
 def _pick_block_out(out: int, cap: int = 512) -> int:
@@ -106,6 +106,72 @@ def _kernel(he_ref, ho_ref, q4_ref, scale_ref, o_ref, acc_ref, *, n_in: int):
         o_ref[...] = acc_ref[...].astype(o_ref.dtype)
 
 
+def _fallback_2d(h2, q4, scale):
+    # fallback compute in f32 throughout: exact for the integer nibbles,
+    # matches the kernel's f32 accumulation, and sidesteps CPU dot thunks
+    # that reject mixed bf16-operand/f32-result dots; the cast back to
+    # h.dtype is the only rounding
+    kin2, out = q4.shape
+    g = scale.shape[0]
+    half = kin2 // g
+    lo = ((q4 & 0xF).astype(jnp.int8) - 8).astype(jnp.float32)
+    hi = ((q4 >> 4).astype(jnp.int8) - 8).astype(jnp.float32)
+    hf = h2.astype(jnp.float32)
+    he = hf[:, 0::2].reshape(h2.shape[0], g, half)
+    ho = hf[:, 1::2].reshape(h2.shape[0], g, half)
+    part = (jnp.einsum("bgk,gko->bgo", he, lo.reshape(g, half, out))
+            + jnp.einsum("bgk,gko->bgo", ho, hi.reshape(g, half, out)))
+    return jnp.einsum("bgo,go->bo", part, scale[:, 0, :]).astype(h2.dtype)
+
+
+def _dispatch_2d(h2, q4, scale):
+    """Backend pick at trace time: Pallas kernel on TPU, XLA fallback
+    elsewhere. Shared by the unpartitioned path and the per-shard
+    lower_fn, so single-chip and sharded serving run the same kernel."""
+    if _use_pallas(None):
+        return _matmul_2d(h2, q4, scale, interpret=False)
+    return _fallback_2d(h2, q4, scale)
+
+
+# -- tensor-parallel int4 (shard_map) ---------------------------------------
+#
+# A pallas_call is an opaque custom call: the SPMD partitioner cannot shard
+# it on its own, which is why int4 and --tensor-parallel used to be
+# mutually exclusive. shard_map supplies the missing partitioning — same
+# mechanism as ops/ring_attention.py, and unlike custom_partitioning its
+# manual sharding lives IN the IR, so the AOT evidence tool can compile it
+# without a live backend (custom_partitioning's Python callback has no
+# emitter under the device-less compile client: "Custom emitter for
+# CustomSPMDPartitioning not found").
+#
+# Layout contract with quant.quantized_logical_axes(bits=4): every int4
+# weight shards its OUTPUT axis over `tensor`, packed contraction + group
+# axes replicated. Per shard the kernel runs unmodified on its out-slice
+# with the FULL contraction — no psum, groups never straddle shard
+# boundaries, and the WEIGHTS (the 4-bit point of all this) stay fully
+# distributed. Activations replicate going in (KBs per decode step vs the
+# GBs of weight traffic the sharding splits); serving meshes are
+# tensor-only, so the blanket P() on h costs nothing extra.
+
+
+def int4_matmul_sharded(h: jax.Array, q4: jax.Array, scale: jax.Array,
+                        mesh, axis: str = "tensor") -> jax.Array:
+    """Tensor-parallel int4 matmul: out-sharded weights, per-shard kernel.
+    ``q4``/``scale`` must be placed with their out axis sharded over
+    ``axis`` (quantized_logical_axes bits=4 does this)."""
+    from jax.sharding import PartitionSpec as P
+    from .ring_attention import shard_map_compat
+
+    kin = h.shape[-1]
+    out = q4.shape[1]
+    h2 = h.reshape(-1, kin)
+    fn = shard_map_compat(
+        _dispatch_2d, mesh,
+        in_specs=(P(), P(None, axis), P(None, None, axis)),
+        out_specs=P(None, axis))
+    return fn(h2, q4, scale).reshape(*h.shape[:-1], out)
+
+
 def int4_matmul(h: jax.Array, q4: jax.Array, scale: jax.Array,
                 use_pallas: Optional[bool] = None,
                 interpret: bool = False) -> jax.Array:
@@ -116,24 +182,14 @@ def int4_matmul(h: jax.Array, q4: jax.Array, scale: jax.Array,
     discipline (the fallback simply computes in f32 end to end, exact for
     the integer nibbles), so the two paths agree to the final h.dtype
     rounding; used by tests as the parity reference and by CPU/sharded
-    paths."""
+    paths. Mesh serving goes through ``int4_matmul_sharded``."""
     kin = h.shape[-1]
     kin2, out = q4.shape
-    g = scale.shape[0]
-    if _use_pallas(use_pallas) or interpret:
-        h2 = h.reshape(-1, kin)
+    h2 = h.reshape(-1, kin)
+    if use_pallas is None and not interpret:
+        res = _dispatch_2d(h2, q4, scale)
+    elif _use_pallas(use_pallas) or interpret:
         res = _matmul_2d(h2, q4, scale, interpret)
-        return res.reshape(*h.shape[:-1], out)
-    half = kin2 // g
-    # fallback compute in f32 throughout: exact for the integer nibbles,
-    # matches the kernel's f32 accumulation, and sidesteps CPU dot thunks
-    # that reject mixed bf16-operand/f32-result dots; the cast back to
-    # h.dtype is the only rounding
-    lo = ((q4 & 0xF).astype(jnp.int8) - 8).astype(jnp.float32)
-    hi = ((q4 >> 4).astype(jnp.int8) - 8).astype(jnp.float32)
-    hf = h.astype(jnp.float32)
-    he = hf[..., 0::2].reshape(*h.shape[:-1], g, half)
-    ho = hf[..., 1::2].reshape(*h.shape[:-1], g, half)
-    part = (jnp.einsum("...gk,gko->...go", he, lo.reshape(g, half, out))
-            + jnp.einsum("...gk,gko->...go", ho, hi.reshape(g, half, out)))
-    return jnp.einsum("...go,go->...o", part, scale[:, 0, :]).astype(h.dtype)
+    else:
+        res = _fallback_2d(h2, q4, scale)
+    return res.reshape(*h.shape[:-1], out)

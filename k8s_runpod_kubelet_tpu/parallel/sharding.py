@@ -38,6 +38,9 @@ LOGICAL_RULES: dict[str, object] = {
     # MLA latent rank: replicated — every tensor shard's heads attend over
     # all positions' latents (models/llama.py param_logical_axes)
     "latent": None,
+    # int4-packed weights: OUT axis over tensor, contraction replicated
+    # (ops/int4_matmul.py int4_matmul_sharded shard_map layout contract)
+    "int4_out": AXES.TENSOR,
     "vocab": AXES.TENSOR,
     "expert": AXES.EXPERT,
     "stage": AXES.STAGE,

@@ -931,11 +931,9 @@ def main(argv=None) -> int:
         # check above
         from ..parallel import MeshConfig, make_mesh
         n = args.tensor_parallel
-        if args.int4:
-            log.error("--tensor-parallel does not compose with --int4 (the "
-                      "packed contraction axis halves the logical length "
-                      "and the unpack kernel is not shard_map'd); use "
-                      "--int8 for sharded quantized serving")
+        if args.int4 and cfg.n_experts:
+            log.error("--tensor-parallel with --int4 does not cover MoE "
+                      "models (expert weights are int8-only); use --int8")
             return 1
         if cfg.n_kv_heads % n or cfg.n_heads % n:
             log.error("--tensor-parallel %d must divide the model's head "
@@ -951,7 +949,7 @@ def main(argv=None) -> int:
     if args.hf_checkpoint:
         from ..models import load_hf
         params = load_hf(cfg, args.hf_checkpoint)  # host tree
-        if mesh is not None and not args.int8:
+        if mesh is not None and not (args.int8 or args.int4):
             from ..models import param_logical_axes
             from ..parallel import param_shardings
             params = jax.device_put(

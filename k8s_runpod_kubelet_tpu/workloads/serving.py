@@ -376,12 +376,10 @@ class ServingEngine:
         if sc.quantize_int8 and sc.quantize_int4:
             raise ValueError("quantize_int8 and quantize_int4 are mutually "
                              "exclusive — pick one weight precision")
-        if mesh is not None and sc.quantize_int4:
-            raise ValueError("mesh serving with int4 is not supported: the "
-                             "packed contraction axis halves the logical "
-                             "length and the unpack kernel is not "
-                             "shard_map'd — shard int8 or serve int4 "
-                             "single-chip")
+        if mesh is not None and sc.quantize_int4 and cfg.n_experts:
+            raise ValueError("mesh serving with int4 MoE is not supported "
+                             "(expert weights are int8-only); use int8 for "
+                             "sharded MoE serving")
         self.model = LlamaModel(cfg, mesh)
         if sc.quantize_int8 or sc.quantize_int4:
             from ..models.quant import (quantize_params,
@@ -399,7 +397,8 @@ class ServingEngine:
                 from ..parallel import param_shardings
                 params = jax.device_put(
                     params,
-                    param_shardings(mesh, quantized_logical_axes(cfg)))
+                    param_shardings(mesh, quantized_logical_axes(
+                        cfg, bits=4 if sc.quantize_int4 else 8)))
         self.params = params
         self.metrics = metrics or Metrics()
         self.metrics.describe("tpu_serving_queue_depth",

@@ -135,10 +135,41 @@ class TestShardedServing:
             sharded.stop()
             plain.stop()
 
-    def test_mesh_rejects_int4_weights(self):
+    def test_tp2_int4_weights_match_single_device_int4(self):
+        """int4 x tensor parallel (VERDICT r4 item 6): packed weights
+        shard their OUT axis over tensor (quantized_logical_axes bits=4 +
+        the int4_matmul_sharded shard_map layout); tokens must be
+        IDENTICAL to the single-device int4 engine's — same quantized
+        numbers, GSPMD shardings never change values."""
+        host = jax.tree_util.tree_map(
+            lambda x: jax.device_get(x), init_params(CFG, jax.random.PRNGKey(0)))
+        plain = _engine(CFG, host, quantize_int4=True)
         mesh = _mesh(tensor=2)
-        with pytest.raises(ValueError, match="int4"):
-            ServingEngine(CFG, init_params(CFG, jax.random.PRNGKey(0)),
+        sharded = _engine(CFG, host, mesh=mesh, quantize_int4=True)
+        try:
+            leaf = sharded.params["layers"]["wq"]
+            assert leaf["q4"].dtype == jnp.uint8
+            # the packed weight really spans the mesh (out axis sharded)
+            assert len(leaf["q4"].sharding.device_set) == 2
+            assert len(leaf["scale"].sharding.device_set) == 2
+            for p in PROMPTS:
+                a = plain.submit(p, max_new_tokens=10).result(timeout=120)
+                b = sharded.submit(p, max_new_tokens=10).result(timeout=120)
+                assert a["tokens"] == b["tokens"], p
+        finally:
+            sharded.stop()
+            plain.stop()
+
+    def test_mesh_rejects_int4_moe(self):
+        """Expert weights are int8-only; int4 x mesh on a MoE config stays
+        a loud error rather than silently serving f32 experts."""
+        from k8s_runpod_kubelet_tpu.models import tiny_moe
+        moe_cfg = tiny_moe(vocab_size=128, embed_dim=64, n_layers=2,
+                           n_heads=4, n_kv_heads=2, mlp_dim=64,
+                           dtype=jnp.float32, param_dtype=jnp.float32)
+        mesh = _mesh(tensor=2)
+        with pytest.raises(ValueError, match="int4 MoE"):
+            ServingEngine(moe_cfg, init_params(moe_cfg, jax.random.PRNGKey(0)),
                           ServingConfig(slots=1, quantize_int4=True),
                           mesh=mesh)
 
