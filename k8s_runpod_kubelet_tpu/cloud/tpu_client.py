@@ -220,9 +220,18 @@ class TpuClient:
         except TpuApiError:
             return False
 
-    def get_chip_quota(self) -> Optional[int]:
-        """The project's effective TPU chip quota, summed across per-generation
-        metrics, or None when the quota surface is unavailable.
+    def get_chip_quota(self, generation: str = "") -> Optional[int]:
+        """The project's effective TPU chip quota, or None when the quota
+        surface is unavailable.
+
+        ``generation`` (e.g. "v5e") selects that generation's ``*_chips``
+        metric — the honest capacity for a node that binds slices of ONE
+        generation (ADVICE r4: summing v4+v5e grants into one
+        ``google.com/tpu`` number can bind v5e pods beyond the v5e grant;
+        they then fail at provision time instead of going Unschedulable).
+        When the named metric is absent — or no generation is given — the
+        per-generation metrics are SUMMED, accepting that tradeoff for
+        projects whose metric names differ from <gen>_chips.
 
         The Cloud TPU v2 API itself exposes no quota read; real deployments
         read Service Usage ``consumerQuotaMetrics`` for tpu.googleapis.com and
@@ -259,12 +268,17 @@ class TpuClient:
             page_token = d.get("nextPageToken", "")
             if not page_token:
                 break
+        chip_metrics = [m for m in metrics
+                        if m.get("metric", "").endswith("_chips")]
+        if generation:
+            # the service listing also carries API request-rate quotas; a
+            # generation-named chip metric is the node's own capacity
+            named = [m for m in chip_metrics
+                     if m.get("metric", "").endswith(f"/{generation}_chips")]
+            if named:
+                chip_metrics = named
         total, found = 0, False
-        for metric in metrics:
-            # the service listing also carries API request-rate quotas; only
-            # chip-count metrics (tpu.googleapis.com/<gen>_chips) are capacity
-            if not metric.get("metric", "").endswith("_chips"):
-                continue
+        for metric in chip_metrics:
             # Each consumerQuotaLimits entry is an independently applicable
             # limit: the effective cap is the MIN across limits. Specificity
             # (region bucket beats the dimensionless default) applies only

@@ -184,11 +184,9 @@ def int4_matmul(h: jax.Array, q4: jax.Array, scale: jax.Array,
     rounding; used by tests as the parity reference and by CPU/sharded
     paths. Mesh serving goes through ``int4_matmul_sharded``."""
     kin = h.shape[-1]
-    kin2, out = q4.shape
+    out = q4.shape[1]
     h2 = h.reshape(-1, kin)
-    if use_pallas is None and not interpret:
-        res = _dispatch_2d(h2, q4, scale)
-    elif _use_pallas(use_pallas) or interpret:
+    if _use_pallas(use_pallas) or interpret:
         res = _matmul_2d(h2, q4, scale, interpret)
     else:
         res = _fallback_2d(h2, q4, scale)

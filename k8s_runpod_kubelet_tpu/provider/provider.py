@@ -171,7 +171,17 @@ class Provider(ReconcileMixin, RecoveryMixin):
             return
         self._last_quota_probe = now
         try:
-            quota = self.tpu.get_chip_quota()
+            # scope the read to this node's DEFAULT generation: its
+            # google.com/tpu capacity must reflect the grant its slices
+            # draw on, not the project-wide sum over generations (ADVICE
+            # r4). Known residual: a pod overriding generation via the
+            # tpu.dev/generation annotation draws on a DIFFERENT grant
+            # than the advertised capacity and can still fail at
+            # provision time — exact per-generation admission would need
+            # per-generation extended resources, which upstream K8s
+            # device accounting doesn't give a virtual node.
+            quota = self.tpu.get_chip_quota(
+                generation=self.cfg.default_generation)
         except TpuApiError as e:
             # keep last-known capacity (anti-flap) but make the failure
             # visible: warn on the first consecutive failure, and mark the

@@ -360,6 +360,20 @@ class _Handler(BaseHTTPRequestHandler):
                                "/v1/embeddings (base model "
                                f"{self.engine.cfg.name!r} only)",
                                "type": "invalid_request_error"}})
+            # encoding_format: the official openai-python client asks for
+            # base64 by default (ADVICE r4: always answering float lists
+            # breaks strict clients); unsupported ``dimensions`` is a loud
+            # 400, not a silent ignore
+            enc = req.get("encoding_format", "float")
+            if enc not in ("float", "base64"):
+                raise ValueError(
+                    f"encoding_format must be 'float' or 'base64', "
+                    f"got {enc!r}")
+            dims = req.get("dimensions")
+            if dims is not None and dims != self.engine.cfg.embed_dim:
+                raise ValueError(
+                    f"dimensions={dims} is not supported (embeddings are "
+                    f"the model's hidden size, {self.engine.cfg.embed_dim})")
             raw = req.get("input")
             if raw is None:
                 raise ValueError("missing input")
@@ -383,8 +397,15 @@ class _Handler(BaseHTTPRequestHandler):
                     raise ValueError(f"input[{i}] must be a string or a "
                                      "non-empty token list")
                 total_toks += len(toks)
+                vec = self.engine.embed(toks)
+                if enc == "base64":
+                    # little-endian f32 bytes, like the OpenAI API
+                    import base64
+                    import struct
+                    vec = base64.b64encode(struct.pack(
+                        f"<{len(vec)}f", *vec)).decode("ascii")
                 data.append({"object": "embedding", "index": i,
-                             "embedding": self.engine.embed(toks)})
+                             "embedding": vec})
         except (json.JSONDecodeError, ValueError, TypeError,
                 OverflowError) as e:
             return self._send(400, {"error": {"message": str(e),

@@ -127,15 +127,14 @@ class Request:
     top_k: int = 0          # 0 = no top-k filter
     top_p: float = 1.0      # 1.0 = no nucleus filter
     # OpenAI sampling penalties, applied to the logits BEFORE temperature/
-    # filtering: presence subtracts once per token already in the text
-    # (prompt + generation), frequency per occurrence. A penalized request
-    # never takes the speculative K-wide greedy commit (each committed
-    # token changes the next step's penalties).
+    # filtering: presence subtracts once per token SAMPLED DURING
+    # GENERATION (the prompt never contributes — OpenAI's published
+    # formula and vLLM both count output tokens only), frequency per
+    # occurrence. A penalized request never takes the speculative K-wide
+    # greedy commit (each committed token changes the next step's
+    # penalties).
     presence_penalty: float = 0.0
     frequency_penalty: float = 0.0
-    # prompt token bincount (np int32 (V,)), computed once by the prefill
-    # loop for penalized requests; _admit seeds the slot's counts from it
-    prompt_counts: Optional[Any] = None
     # OpenAI logit_bias: {token_id: bias in [-100, 100]} added to that
     # token's logit every step (-100 ~ ban, +100 ~ force)
     logit_bias: Optional[dict] = None
@@ -1138,25 +1137,20 @@ class ServingEngine:
                         brow = _bias_row(r.logit_bias, self.cfg.vocab_size)
                         row_logits = (row_logits.astype(jnp.float32)
                                       + jnp.asarray(brow)[None, :])
-                    if _penalized(r):
-                        # first token's penalties come from the prompt
-                        # alone; ONE formula (_apply_penalties) and ONE
-                        # bincount per request — _admit reuses the row
-                        c = np.bincount(np.asarray(r.prompt),
-                                        minlength=self.cfg.vocab_size
-                                        )[:self.cfg.vocab_size].astype(
-                                            np.int32)
-                        r.prompt_counts = c
-                        row_logits = _apply_penalties(
-                            row_logits, jnp.asarray(c)[None],
-                            jnp.asarray([r.presence_penalty], jnp.float32),
-                            jnp.asarray([r.frequency_penalty], jnp.float32))
+                    # penalties: OpenAI's published formula counts tokens
+                    # SAMPLED DURING GENERATION only (vLLM likewise) — at
+                    # the first token nothing has been generated, so no
+                    # penalty applies here; _admit seeds the slot's counts
+                    # from the first token alone (ADVICE r4: prompt-seeded
+                    # counts penalized long-prompt requests on an endpoint
+                    # advertised as OpenAI-compatible)
                     first = int(_sample(row_logits, keys, [r.temperature],
                                         [r.top_k], [r.top_p])[0])
                     first_lp = None
                     if r.logprobs:
-                        # from the distribution actually sampled (penalized
-                        # when penalties are on — same as every later token)
+                        # from the distribution actually sampled (biased
+                        # when logit_bias is set; NEVER penalized — counts
+                        # cover generated tokens only and none exist yet)
                         first_lp = float(jax.nn.log_softmax(
                             row_logits[0].astype(jnp.float32))[first])
                     entries.append((r, single, first, first_lp))
@@ -1192,18 +1186,13 @@ class ServingEngine:
             self._slot_seed[slot_id] = req.seed
             self._slot_draws[slot_id] = 1  # draw 0 was the prefill token
             if _penalized(req):
-                # seed this slot's counts from prompt + the first token
-                # ("text so far", OpenAI semantics); the prompt bincount
-                # was computed once in the prefill loop
+                # counts cover GENERATED tokens only (OpenAI/vLLM
+                # semantics): the slot starts from just the first sampled
+                # token — the prompt never contributes
                 if self._tok_counts is None:
                     self._tok_counts = jnp.zeros(
                         (self.sc.slots, self.cfg.vocab_size), jnp.int32)
-                row = getattr(req, "prompt_counts", None)
-                if row is None:
-                    row = np.bincount(np.asarray(req.prompt),
-                                      minlength=self.cfg.vocab_size
-                                      )[:self.cfg.vocab_size].astype(np.int32)
-                row = row.copy()
+                row = np.zeros((self.cfg.vocab_size,), np.int32)
                 row[first] += 1
                 self._tok_counts = _set_count_row(
                     self._tok_counts, jnp.asarray(slot_id),

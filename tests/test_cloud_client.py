@@ -259,6 +259,24 @@ class TestChipQuota:
         server.service.chip_quota = 0
         assert client.get_chip_quota() == 0
 
+    def test_generation_scopes_the_quota(self, client, server):
+        """ADVICE r4: a v5e node must advertise the v5e grant, not the
+        v4+v5e sum (the sum binds pods beyond the generation's quota and
+        they fail at provision time instead of going Unschedulable)."""
+        server.service.chip_quota_metrics = [
+            {"metric": "tpu.googleapis.com/v4_chips",
+             "consumerQuotaLimits": [{"quotaBuckets": [
+                 {"effectiveLimit": "64", "dimensions": {}}]}]},
+            {"metric": "tpu.googleapis.com/v5e_chips",
+             "consumerQuotaLimits": [{"quotaBuckets": [
+                 {"effectiveLimit": "16", "dimensions": {}}]}]},
+        ]
+        assert client.get_chip_quota(generation="v5e") == 16
+        assert client.get_chip_quota(generation="v4") == 64
+        assert client.get_chip_quota() == 80          # unscoped: the sum
+        # no matching metric name -> documented fallback to the sum
+        assert client.get_chip_quota(generation="v6e") == 80
+
     def test_min_across_limits_specificity_within(self, client, server):
         """Each consumerQuotaLimits entry is an independently applicable
         limit (effective = min across limits); regional-beats-default holds

@@ -454,3 +454,34 @@ class TestEmbeddings:
             _post(port, "/v1/embeddings",
                   {"input": [1, 2], "model": "no-such-adapter"})
         assert ei.value.code == 404
+
+    def test_encoding_format_base64(self, eserver):
+        """The official openai-python client requests base64 by default
+        (ADVICE r4): little-endian f32 bytes, round-trips to the float
+        list."""
+        import base64
+        import struct
+        port, _ = eserver
+        f = _post(port, "/v1/embeddings",
+                  {"input": [5, 9, 2], "encoding_format": "float"})
+        b = _post(port, "/v1/embeddings",
+                  {"input": [5, 9, 2], "encoding_format": "base64"})
+        enc = b["data"][0]["embedding"]
+        assert isinstance(enc, str)
+        dec = list(struct.unpack(f"<{CFG.embed_dim}f",
+                                 base64.b64decode(enc)))
+        import numpy as np
+        np.testing.assert_allclose(dec, f["data"][0]["embedding"],
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_bad_encoding_format_and_dimensions_400(self, eserver):
+        port, _ = eserver
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(port, "/v1/embeddings",
+                  {"input": [1, 2], "encoding_format": "hex"})
+        assert ei.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(port, "/v1/embeddings",
+                  {"input": [1, 2], "dimensions": 32})  # loud, not ignored
+        assert ei.value.code == 400

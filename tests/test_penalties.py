@@ -37,6 +37,25 @@ class TestPenaltyMath:
 
 
 class TestEnginePenalties:
+    def test_penalties_count_generation_only(self):
+        """OpenAI semantics (ADVICE r4): the PROMPT never contributes to
+        presence/frequency counts — a huge penalty with no generated
+        repetition must leave the first token identical to unpenalized
+        greedy, no matter how repetitive the prompt is."""
+        eng = _engine()
+        try:
+            prompt = [5] * 12 + [9, 2]   # token 5 saturates the prompt
+            base = eng.submit(prompt, max_new_tokens=1).result(
+                timeout=120)["tokens"]
+            pen = eng.submit(prompt, max_new_tokens=1, presence_penalty=2.0,
+                             frequency_penalty=2.0).result(
+                timeout=120)["tokens"]
+            # prompt-seeded counts would shift these logits by up to
+            # -26 on token 5 (2.0 + 2.0*12); generation-only cannot
+            assert pen == base
+        finally:
+            eng.stop()
+
     def test_frequency_penalty_changes_greedy_repetition(self):
         """A strong frequency penalty must break the greedy path's loops:
         the penalized output has strictly more distinct tokens (or differs)
