@@ -59,6 +59,95 @@ def _stack(sd: Mapping[str, Any], fmt: str, n_layers: int, dt: np.dtype,
     return np.stack(outs)
 
 
+def _rope_deinterleave(w: np.ndarray, dr: int) -> np.ndarray:
+    """Permute the LAST ``dr`` columns of a projection from DeepSeek's
+    pair-interleaved RoPE layout to our rotate-half layout.
+
+    DeepSeek-V2 rotates (x0,x1),(x2,x3),... as complex pairs
+    (apply_rotary_emb: view_as_complex on reshape(..., -1, 2)); our
+    apply_rope rotates ([first half], [second half]). Moving checkpoint
+    column 2i -> i and 2i+1 -> dr/2+i makes the two conventions compute
+    the IDENTICAL rotation — proven by the logits-parity test against
+    transformers' DeepseekV2ForCausalLM."""
+    perm = np.concatenate([np.arange(0, dr, 2), np.arange(1, dr, 2)])
+    out = w.copy()
+    out[..., -dr:] = w[..., -dr:][..., perm]
+    return out
+
+
+def _rope_reinterleave(w: np.ndarray, dr: int) -> np.ndarray:
+    """Inverse of _rope_deinterleave (export)."""
+    inv = np.empty(dr, np.int64)
+    inv[np.concatenate([np.arange(0, dr, 2), np.arange(1, dr, 2)])] = \
+        np.arange(dr)
+    out = w.copy()
+    out[..., -dr:] = w[..., -dr:][..., inv]
+    return out
+
+
+def _mla_attn_from_hf(cfg: LlamaConfig, sd: Mapping[str, Any],
+                      dt: np.dtype) -> dict[str, np.ndarray]:
+    """DeepSeek-V2 MLA attention mapping (per layer):
+      q_proj (H*(dh+dr), E)            -> wq (E, H, dh+dr flat), rope tail
+                                          de-interleaved per head
+      kv_a_proj_with_mqa (r+dr, E)     -> w_dkv (E, r+dr), rope tail
+                                          de-interleaved
+      kv_a_layernorm (r,)              -> c_norm
+      kv_b_proj (H*(dh+dv), r)         -> w_uk (r, H*dh) + w_uv (r, H*dv)
+                                          (per head: [k_nope; v])
+      o_proj (E, H*dv)                 -> wo (H*dv, E)
+    """
+    L = cfg.n_layers
+    hd, dr, r = cfg.head_dim_, cfg.mla_rope_dim, cfg.mla_latent_dim
+    hn = cfg.n_heads
+    wq, wdkv, cnorm, wuk, wuv, wo = [], [], [], [], [], []
+    for i in range(L):
+        p = f"layers.{i}.self_attn."
+        q = _np(sd[p + "q_proj.weight"], dt).T          # (E, H*(dh+dr))
+        q = q.reshape(q.shape[0], hn, hd + dr)
+        wq.append(_rope_deinterleave(q, dr).reshape(q.shape[0], -1))
+        a = _np(sd[p + "kv_a_proj_with_mqa.weight"], dt).T   # (E, r+dr)
+        wdkv.append(_rope_deinterleave(a, dr))
+        cnorm.append(_np(sd[p + "kv_a_layernorm.weight"], dt))
+        b = _np(sd[p + "kv_b_proj.weight"], dt).T       # (r, H*(dh+dv))
+        b = b.reshape(r, hn, -1)
+        dv = b.shape[-1] - hd
+        if dv != hd:
+            raise NotImplementedError(
+                f"v_head_dim {dv} != qk_nope_head_dim {hd}: this family "
+                "assumes square heads (true for V2-Lite)")
+        wuk.append(b[:, :, :hd].reshape(r, hn * hd))
+        wuv.append(b[:, :, hd:].reshape(r, hn * hd))
+        wo.append(_np(sd[p + "o_proj.weight"], dt).T)
+    return {"wq": np.stack(wq), "w_dkv": np.stack(wdkv),
+            "c_norm": np.stack(cnorm), "w_uk": np.stack(wuk),
+            "w_uv": np.stack(wuv), "wo": np.stack(wo)}
+
+
+def _check_mla_keys(cfg: LlamaConfig, keys) -> None:
+    """Pure key-name checks for DeepSeek-family checkpoints, run BEFORE any
+    tensor is read or converted (a real V2 checkpoint is hundreds of GB;
+    rejections must cost metadata, not RAM)."""
+    if not cfg.is_mla:
+        return
+    names = {k[len("model."):] if k.startswith("model.") else k
+             for k in keys}
+    if "layers.0.self_attn.q_a_proj.weight" in names:
+        raise NotImplementedError(
+            "low-rank q (q_lora_rank, DeepSeek-V2 full) is not supported; "
+            "this config family models V2-Lite's full-rank q")
+    if cfg.n_experts and any(".mlp.experts." in k for k in names):
+        for i in range(cfg.n_layers):
+            if f"layers.{i}.mlp.experts.0.gate_proj.weight" not in names:
+                raise NotImplementedError(
+                    f"layer {i} has a dense MLP where experts are expected "
+                    "(DeepSeek first_k_dense_replace > 0); this config "
+                    "family is uniformly MoE — the documented "
+                    "deepseek_v2_lite divergence. Export with "
+                    "first_k_dense_replace=0 or drop the dense prefix "
+                    "layers.")
+
+
 def from_hf_state_dict(cfg: LlamaConfig, sd: Mapping[str, Any],
                        dtype: Optional[Any] = None) -> Params:
     """Map a HF ``model.state_dict()``-shaped mapping onto our param tree.
@@ -75,17 +164,27 @@ def from_hf_state_dict(cfg: LlamaConfig, sd: Mapping[str, Any],
     for k, v in sd.items():
         norm[k[len("model."):] if k.startswith("model.") else k] = v
     sd = norm
+    _check_mla_keys(cfg, sd.keys())   # before ANY conversion work
     L = cfg.n_layers
     dt = np.dtype(dtype or cfg.param_dtype)  # jnp.bfloat16 works via ml_dtypes
     pre = "layers.{i}."
 
     layers: dict[str, np.ndarray] = {
         "attn_norm": _stack(sd, pre + "input_layernorm.weight", L, dt),
-        "wq": _stack(sd, pre + "self_attn.q_proj.weight", L, dt, transpose=True),
-        "wk": _stack(sd, pre + "self_attn.k_proj.weight", L, dt, transpose=True),
-        "wv": _stack(sd, pre + "self_attn.v_proj.weight", L, dt, transpose=True),
-        "wo": _stack(sd, pre + "self_attn.o_proj.weight", L, dt, transpose=True),
     }
+    if cfg.is_mla:
+        layers.update(_mla_attn_from_hf(cfg, sd, dt))
+    else:
+        layers.update({
+            "wq": _stack(sd, pre + "self_attn.q_proj.weight", L, dt,
+                         transpose=True),
+            "wk": _stack(sd, pre + "self_attn.k_proj.weight", L, dt,
+                         transpose=True),
+            "wv": _stack(sd, pre + "self_attn.v_proj.weight", L, dt,
+                         transpose=True),
+            "wo": _stack(sd, pre + "self_attn.o_proj.weight", L, dt,
+                         transpose=True),
+        })
     if cfg.post_norms:
         # Gemma-2 sandwich norms: HF's post_attention_layernorm is the
         # POST-attention output norm; the pre-MLP norm is
@@ -107,15 +206,24 @@ def from_hf_state_dict(cfg: LlamaConfig, sd: Mapping[str, Any],
         layers["wk_b"] = _stack(sd, pre + "self_attn.k_proj.bias", L, dt)
         layers["wv_b"] = _stack(sd, pre + "self_attn.v_proj.bias", L, dt)
     if cfg.n_experts:
-        layers["router"] = _stack(
-            sd, pre + "block_sparse_moe.gate.weight", L, dt, transpose=True)
+        deepseek_moe = any(".mlp.experts." in k for k in sd)
+        if deepseek_moe:  # dense-prefix layers rejected by _check_mla_keys
+            layers["router"] = _stack(sd, pre + "mlp.gate.weight", L, dt,
+                                      transpose=True)
+            names = ("gate_proj", "up_proj", "down_proj")
+            expert_fmt = "layers.{i}.mlp.experts.{e}.{w}.weight"
+        else:
+            layers["router"] = _stack(sd, pre + "block_sparse_moe.gate.weight",
+                                      L, dt, transpose=True)
+            names = ("w1", "w3", "w2")
+            expert_fmt = "layers.{i}.block_sparse_moe.experts.{e}.{w}.weight"
         gates, ups, downs = [], [], []
         for i in range(L):
-            g = [_np(sd[f"layers.{i}.block_sparse_moe.experts.{e}.w1.weight"], dt).T
+            g = [_np(sd[expert_fmt.format(i=i, e=e, w=names[0])], dt).T
                  for e in range(cfg.n_experts)]
-            u = [_np(sd[f"layers.{i}.block_sparse_moe.experts.{e}.w3.weight"], dt).T
+            u = [_np(sd[expert_fmt.format(i=i, e=e, w=names[1])], dt).T
                  for e in range(cfg.n_experts)]
-            d = [_np(sd[f"layers.{i}.block_sparse_moe.experts.{e}.w2.weight"], dt).T
+            d = [_np(sd[expert_fmt.format(i=i, e=e, w=names[2])], dt).T
                  for e in range(cfg.n_experts)]
             gates.append(np.stack(g))
             ups.append(np.stack(u))
@@ -123,6 +231,16 @@ def from_hf_state_dict(cfg: LlamaConfig, sd: Mapping[str, Any],
         layers["we_gate"] = np.stack(gates)
         layers["we_up"] = np.stack(ups)
         layers["we_down"] = np.stack(downs)
+        if cfg.n_shared_experts:
+            layers["ws_gate"] = _stack(
+                sd, pre + "mlp.shared_experts.gate_proj.weight", L, dt,
+                transpose=True)
+            layers["ws_up"] = _stack(
+                sd, pre + "mlp.shared_experts.up_proj.weight", L, dt,
+                transpose=True)
+            layers["ws_down"] = _stack(
+                sd, pre + "mlp.shared_experts.down_proj.weight", L, dt,
+                transpose=True)
     else:
         layers["w_gate"] = _stack(sd, pre + "mlp.gate_proj.weight", L, dt,
                                   transpose=True)
@@ -170,11 +288,29 @@ def to_hf_state_dict(cfg: LlamaConfig, params: Params) -> dict[str, np.ndarray]:
         else:
             put(i, "post_attention_layernorm.weight",
                 np.asarray(lp["mlp_norm"][i], np.float32))
-        for ours, theirs in (("wq", "self_attn.q_proj.weight"),
-                             ("wk", "self_attn.k_proj.weight"),
-                             ("wv", "self_attn.v_proj.weight"),
-                             ("wo", "self_attn.o_proj.weight")):
-            put(i, theirs, np.asarray(lp[ours][i], np.float32).T)
+        if cfg.is_mla:
+            hd, dr, r = cfg.head_dim_, cfg.mla_rope_dim, cfg.mla_latent_dim
+            hn = cfg.n_heads
+            q = np.asarray(lp["wq"][i], np.float32).reshape(-1, hn, hd + dr)
+            put(i, "self_attn.q_proj.weight",
+                _rope_reinterleave(q, dr).reshape(q.shape[0], -1).T)
+            put(i, "self_attn.kv_a_proj_with_mqa.weight",
+                _rope_reinterleave(
+                    np.asarray(lp["w_dkv"][i], np.float32), dr).T)
+            put(i, "self_attn.kv_a_layernorm.weight",
+                np.asarray(lp["c_norm"][i], np.float32))
+            uk = np.asarray(lp["w_uk"][i], np.float32).reshape(r, hn, hd)
+            uv = np.asarray(lp["w_uv"][i], np.float32).reshape(r, hn, hd)
+            put(i, "self_attn.kv_b_proj.weight",
+                np.concatenate([uk, uv], axis=-1).reshape(r, -1).T)
+            put(i, "self_attn.o_proj.weight",
+                np.asarray(lp["wo"][i], np.float32).T)
+        else:
+            for ours, theirs in (("wq", "self_attn.q_proj.weight"),
+                                 ("wk", "self_attn.k_proj.weight"),
+                                 ("wv", "self_attn.v_proj.weight"),
+                                 ("wo", "self_attn.o_proj.weight")):
+                put(i, theirs, np.asarray(lp[ours][i], np.float32).T)
         if cfg.qk_norm:
             put(i, "self_attn.q_norm.weight",
                 np.asarray(lp["q_norm"][i], np.float32))
@@ -186,15 +322,37 @@ def to_hf_state_dict(cfg: LlamaConfig, params: Params) -> dict[str, np.ndarray]:
                                  ("wv_b", "self_attn.v_proj.bias")):
                 put(i, theirs, np.asarray(lp[ours][i], np.float32))
         if cfg.n_experts:
-            put(i, "block_sparse_moe.gate.weight",
-                np.asarray(lp["router"][i], np.float32).T)
-            for e in range(cfg.n_experts):
-                put(i, f"block_sparse_moe.experts.{e}.w1.weight",
-                    np.asarray(lp["we_gate"][i, e], np.float32).T)
-                put(i, f"block_sparse_moe.experts.{e}.w3.weight",
-                    np.asarray(lp["we_up"][i, e], np.float32).T)
-                put(i, f"block_sparse_moe.experts.{e}.w2.weight",
-                    np.asarray(lp["we_down"][i, e], np.float32).T)
+            # family discriminates the naming (the SAME signal import
+            # uses): MLA => DeepSeek-MoE names, else Mixtral names — a
+            # chimera of MLA attention + block_sparse_moe would load
+            # into neither transformers architecture
+            if cfg.is_mla:
+                put(i, "mlp.gate.weight",
+                    np.asarray(lp["router"][i], np.float32).T)
+                for e in range(cfg.n_experts):
+                    put(i, f"mlp.experts.{e}.gate_proj.weight",
+                        np.asarray(lp["we_gate"][i, e], np.float32).T)
+                    put(i, f"mlp.experts.{e}.up_proj.weight",
+                        np.asarray(lp["we_up"][i, e], np.float32).T)
+                    put(i, f"mlp.experts.{e}.down_proj.weight",
+                        np.asarray(lp["we_down"][i, e], np.float32).T)
+                if cfg.n_shared_experts:
+                    put(i, "mlp.shared_experts.gate_proj.weight",
+                        np.asarray(lp["ws_gate"][i], np.float32).T)
+                    put(i, "mlp.shared_experts.up_proj.weight",
+                        np.asarray(lp["ws_up"][i], np.float32).T)
+                    put(i, "mlp.shared_experts.down_proj.weight",
+                        np.asarray(lp["ws_down"][i], np.float32).T)
+            else:
+                put(i, "block_sparse_moe.gate.weight",
+                    np.asarray(lp["router"][i], np.float32).T)
+                for e in range(cfg.n_experts):
+                    put(i, f"block_sparse_moe.experts.{e}.w1.weight",
+                        np.asarray(lp["we_gate"][i, e], np.float32).T)
+                    put(i, f"block_sparse_moe.experts.{e}.w3.weight",
+                        np.asarray(lp["we_up"][i, e], np.float32).T)
+                    put(i, f"block_sparse_moe.experts.{e}.w2.weight",
+                        np.asarray(lp["we_down"][i, e], np.float32).T)
         else:
             put(i, "mlp.gate_proj.weight", np.asarray(lp["w_gate"][i], np.float32).T)
             put(i, "mlp.up_proj.weight", np.asarray(lp["w_up"][i], np.float32).T)
@@ -237,16 +395,34 @@ def load_hf(cfg: LlamaConfig,
             dtype: Optional[Any] = None) -> Params:
     """One-call import: ``src`` is a HF model directory path, a state dict,
     or a transformers model object."""
-    if cfg.is_mla:
-        # fail BEFORE reading a ~16B checkpoint: the mapping below stacks
-        # self_attn.{k,v}_proj which DeepSeek-V2 checkpoints don't have
-        # (they ship kv_a_proj_with_mqa/kv_b_proj for w_dkv/w_uk/w_uv)
-        raise NotImplementedError(
-            f"HF checkpoint import has no MLA weight mapping yet "
-            f"({cfg.name}: w_dkv/w_uk/w_uv); init randomly or convert "
-            "offline")
     if hasattr(src, "state_dict"):
         src = src.state_dict()
     if isinstance(src, str):
+        # MLA rejections (q_lora_rank, dense-prefix layers) fire on KEY
+        # NAMES read from safetensors metadata — before materializing a
+        # checkpoint that can be hundreds of GB
+        names = _dir_key_names(src)
+        if names is not None:
+            _check_mla_keys(cfg, names)
         src = _read_dir_state_dict(src)
     return from_hf_state_dict(cfg, src, dtype=dtype)
+
+
+def _dir_key_names(path: str) -> Optional[list[str]]:
+    """Tensor names in a HF model dir from safetensors METADATA only
+    (f.keys() never reads tensor data); None when only .bin shards exist
+    (torch.load has no cheap header probe — the post-read check covers
+    those)."""
+    try:
+        st_files = sorted(f for f in os.listdir(path)
+                          if f.endswith(".safetensors"))
+    except OSError:
+        return None
+    if not st_files:
+        return None
+    from safetensors import safe_open
+    names: list[str] = []
+    for fname in st_files:
+        with safe_open(os.path.join(path, fname), framework="np") as f:
+            names.extend(f.keys())
+    return names

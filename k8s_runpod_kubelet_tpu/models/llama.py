@@ -84,6 +84,10 @@ class LlamaConfig:
     n_experts: int = 0
     n_experts_per_tok: int = 2
     capacity_factor: float = 1.25
+    # True (Mixtral): renormalize the top-k softmax weights to sum to 1;
+    # False (DeepSeek-V2-Lite norm_topk_prob=false): combine with the raw
+    # softmax-over-all-experts probabilities of the selected k
+    router_norm_topk: bool = True
     router_aux_coef: float = 0.02       # load-balance loss coefficient
     router_z_coef: float = 1e-3         # router z-loss coefficient
     # pipeline parallelism: microbatch count when the mesh has a stage axis
@@ -171,6 +175,7 @@ class LlamaConfig:
             r, dr, h = self.mla_latent_dim, self.mla_rope_dim, self.n_heads
             attn = (e * h * (hd + dr)      # w_q
                     + e * (r + dr)         # w_dkv
+                    + r                    # c_norm (kv_a_layernorm)
                     + 2 * r * h * hd       # w_uk, w_uv
                     + h * hd * e)          # w_o
         else:
@@ -294,7 +299,7 @@ def deepseek_v2_lite() -> LlamaConfig:
                        norm_eps=1e-6,
                        mla_latent_dim=512, mla_rope_dim=64,
                        n_experts=64, n_experts_per_tok=6,
-                       n_shared_experts=2)
+                       n_shared_experts=2, router_norm_topk=False)
 
 
 def tiny_mla(**kw) -> LlamaConfig:
@@ -332,6 +337,7 @@ def param_logical_axes(cfg: LlamaConfig) -> Params:
             "attn_norm": ("layer", "norm"),
             "wq": ("layer", "embed", "heads"),
             "w_dkv": ("layer", "embed", "latent"),
+            "c_norm": ("layer", "norm"),   # kv_a_layernorm, (r,) per layer
             "w_uk": ("layer", "latent", "heads"),
             "w_uv": ("layer", "latent", "heads"),
             "wo": ("layer", "heads", "embed"),
@@ -393,6 +399,7 @@ def init_params(cfg: LlamaConfig, key: jax.Array,
         attn_shapes = {
             "wq": (cfg.n_layers, e, cfg.n_heads * (hd + dr)),
             "w_dkv": (cfg.n_layers, e, r + dr),
+            "c_norm": (cfg.n_layers, r),
             "w_uk": (cfg.n_layers, r, cfg.n_heads * hd),
             "w_uv": (cfg.n_layers, r, cfg.n_heads * hd),
         }
@@ -473,6 +480,10 @@ def init_params(cfg: LlamaConfig, key: jax.Array,
         fill = 0.0 if cfg.norm_zero_centered else 1.0
         for name in ("q_norm", "k_norm"):
             params["layers"][name] = jnp.full_like(params["layers"][name], fill)
+    if cfg.is_mla:   # kv_a_layernorm: identity init ((L, r) misses the rule)
+        fill = 0.0 if cfg.norm_zero_centered else 1.0
+        params["layers"]["c_norm"] = jnp.full_like(
+            params["layers"]["c_norm"], fill)
     if mesh is not None:
         axes = param_logical_axes(cfg)
         params = jax.tree_util.tree_map(
@@ -673,12 +684,19 @@ def _qkv(h, lp, cfg: LlamaConfig, b: int, s: int):
 
 def _mla_project(h, lp, cfg: LlamaConfig, cos, sin, positions, b, s):
     """MLA projections: q_nope (B,S,H,dh), q_rope (B,S,H,dr) rotated,
-    latent c (B,S,r), shared rope key kr (B,S,dr) rotated. One w_dkv
-    matmul yields both cache sections (DeepSeek-V2 decoupled RoPE)."""
+    latent c (B,S,r) NORMED, shared rope key kr (B,S,dr) rotated. One
+    w_dkv matmul yields both cache sections (DeepSeek-V2 decoupled RoPE).
+
+    ``c_norm`` is DeepSeek's kv_a_layernorm: RMSNorm on the compressed
+    latent before the up-projections (the rope key bypasses it). The
+    NORMED latent is what gets cached — per-token and deterministic, so
+    caching post-norm is equivalent to norming on every read, and the
+    absorbed decode's q_lat . c stays a plain dot."""
     hd, dr, r = cfg.head_dim_, cfg.mla_rope_dim, cfg.mla_latent_dim
     q = _mm(h, lp["wq"], cfg.dtype).reshape(b, s, cfg.n_heads, hd + dr)
     ckr = _mm(h, lp["w_dkv"], cfg.dtype)
     c, kr = ckr[..., :r], ckr[..., r:]
+    c = rms_norm(c, _norm_w(lp["c_norm"], cfg), cfg.norm_eps)
     q_nope, q_rope = q[..., :hd], q[..., hd:]
     q_rope = apply_rope(q_rope, cos, sin, positions)
     kr = apply_rope(kr[:, :, None, :], cos, sin, positions)[:, :, 0]
@@ -795,7 +813,8 @@ def _mlp_block(x, lp, cfg: LlamaConfig, mesh, train: bool = True,
             capacity_factor=(cfg.capacity_factor if train
                              else cfg.n_experts / cfg.n_experts_per_tok),
             activation=_activation(cfg), dtype=cfg.dtype,
-            constrain=(lambda t, axes: _constrain(t, mesh, axes)))
+            constrain=(lambda t, axes: _constrain(t, mesh, axes)),
+            norm_topk=cfg.router_norm_topk)
         aux = cfg.router_aux_coef * aux + cfg.router_z_coef * z
         if cfg.n_shared_experts:
             # DeepSeek-MoE shared experts: an always-on dense MLP (width

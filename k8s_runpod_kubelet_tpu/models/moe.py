@@ -35,15 +35,18 @@ def moe_capacity(n_tokens: int, n_experts: int, k: int,
     return max(4, int(math.ceil(k * n_tokens / n_experts * capacity_factor)))
 
 
-def route_top_k(router_logits: jax.Array, k: int
+def route_top_k(router_logits: jax.Array, k: int, norm_topk: bool = True
                 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """(G, X) f32 logits -> (weights (G,k), expert ids (G,k), probs (G,X)).
 
-    Softmax over ALL experts, then top-k, then renormalize over the chosen k
-    (Mixtral's convention)."""
+    Softmax over ALL experts, then top-k. ``norm_topk=True`` renormalizes
+    over the chosen k (Mixtral's convention); False keeps the raw softmax
+    probabilities as the combine weights (DeepSeek-V2-Lite:
+    norm_topk_prob=false — the selected experts' weights sum to <1)."""
     probs = jax.nn.softmax(router_logits, axis=-1)
     top_p, top_idx = jax.lax.top_k(probs, k)
-    top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
+    if norm_topk:
+        top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
     return top_p, top_idx, probs
 
 
@@ -78,7 +81,8 @@ def _expert_w(w, dtype):
 
 def moe_mlp(h: jax.Array, router_w: jax.Array, we_gate,
             we_up, we_down, *, n_experts_per_tok: int,
-            capacity_factor: float, activation, dtype, constrain=None
+            capacity_factor: float, activation, dtype, constrain=None,
+            norm_topk: bool = True
             ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Sparse MoE MLP on normed activations.
 
@@ -98,7 +102,7 @@ def moe_mlp(h: jax.Array, router_w: jax.Array, we_gate,
 
     ht = h.reshape(g, e)
     router_logits = ht.astype(jnp.float32) @ router_w.astype(jnp.float32)
-    top_p, top_idx, probs = route_top_k(router_logits, k)
+    top_p, top_idx, probs = route_top_k(router_logits, k, norm_topk)
 
     # position of each (token, slot) assignment within its expert's buffer:
     # exclusive running count of earlier assignments to the same expert
@@ -150,15 +154,18 @@ def moe_mlp(h: jax.Array, router_w: jax.Array, we_gate,
 def moe_mlp_dense_reference(h: jax.Array, router_w: jax.Array,
                             we_gate, we_up,
                             we_down, *, n_experts_per_tok: int,
-                            activation, dtype) -> jax.Array:
+                            activation, dtype,
+                            norm_topk: bool = True) -> jax.Array:
     """Dense reference: run EVERY expert on every token, combine with the
-    renormalized top-k weights (zero elsewhere). X× the FLOPs of the sparse
-    path but no capacity drops — used by tests as ground truth."""
+    top-k weights (zero elsewhere; ``norm_topk`` as in route_top_k — the
+    reference must follow the SAME routing convention as the sparse path
+    it grounds). X× the FLOPs of the sparse path but no capacity drops —
+    used by tests as ground truth."""
     b, s, e = h.shape
     x_experts = router_w.shape[-1]
     ht = h.reshape(b * s, e)
     logits = ht.astype(jnp.float32) @ router_w.astype(jnp.float32)
-    top_p, top_idx, _ = route_top_k(logits, n_experts_per_tok)
+    top_p, top_idx, _ = route_top_k(logits, n_experts_per_tok, norm_topk)
     weights = jnp.zeros((b * s, x_experts), jnp.float32)
     weights = jax.vmap(lambda w, p, i: w.at[i].set(p))(weights, top_p, top_idx)
     wg, sg = _expert_w(we_gate, dtype)

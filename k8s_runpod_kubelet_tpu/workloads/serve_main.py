@@ -941,11 +941,6 @@ def main(argv=None) -> int:
                   "target the wq/wk/wv layout; %s uses w_dkv/w_uk/w_uv)",
                   cfg.name)
         return 1
-    if args.hf_checkpoint and cfg.is_mla:
-        log.error("--hf-checkpoint has no MLA weight mapping yet (%s needs "
-                  "kv_a_proj_with_mqa/kv_b_proj -> w_dkv/w_uk/w_uv); serve "
-                  "with random init or convert offline", cfg.name)
-        return 1
     mesh = None
     if args.tensor_parallel > 1:
         # fail-fast BEFORE the expensive weight load, like the tokenizer

@@ -310,3 +310,134 @@ class TestMistralSlidingWindow:
                               max_seq_len=64, rope_theta=10_000.0,
                               sliding_window=8))
         _compare(cfg, hf)
+
+
+class TestDeepseekV2Parity:
+    """MLA + DeepSeek-MoE fidelity, proven against transformers'
+    DeepseekV2ForCausalLM: pair-interleaved RoPE -> rotate-half
+    permutation, kv_a_layernorm (latent norm), kv_b split into
+    w_uk/w_uv, softmax-without-topk-renorm routing, fused shared
+    experts. first_k_dense_replace=0 here — the real Lite checkpoint's
+    single leading dense layer is the documented config divergence and
+    the loader rejects it loudly."""
+
+    def _tiny(self, n_experts=0, n_shared=0):
+        from transformers.models.deepseek_v2 import DeepseekV2Config
+        from transformers.models.deepseek_v2.modeling_deepseek_v2 import (
+            DeepseekV2ForCausalLM)
+        from k8s_runpod_kubelet_tpu.models import tiny_mla
+        torch.manual_seed(3)
+        hf = DeepseekV2ForCausalLM(DeepseekV2Config(
+            vocab_size=128, hidden_size=64,
+            intermediate_size=112, moe_intermediate_size=48,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=4, kv_lora_rank=32, q_lora_rank=None,
+            qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
+            n_routed_experts=n_experts or 1, n_shared_experts=n_shared,
+            num_experts_per_tok=2, first_k_dense_replace=0 if n_experts
+            else 99, norm_topk_prob=False, routed_scaling_factor=1.0,
+            max_position_embeddings=64, rope_theta=10_000.0,
+            rms_norm_eps=1e-6, tie_word_embeddings=False,
+            attention_bias=False, attn_implementation="eager"))
+        if n_experts:
+            # decisive routing: a freshly-initialized gate scores experts
+            # within ~1e-6 of each other, so torch and jax pick DIFFERENT
+            # top-k on f32 noise (observed: 15/32 tokens agreed, sorted
+            # weights within 5e-7). Scaling the gate separates the scores;
+            # the parity claim is about semantics, not tie-breaking.
+            # (the gate Parameter is torch.empty — never initialized by
+            # _init_weights — so its garbage values can be near-uniform)
+            with torch.no_grad():
+                for layer in hf.model.layers:
+                    layer.mlp.gate.weight.normal_(0.0, 1.0,
+                                                  generator=torch.Generator()
+                                                  .manual_seed(11))
+        cfg = _f32(tiny_mla(
+            vocab_size=128, embed_dim=64, n_layers=2, n_heads=4,
+            n_kv_heads=4, head_dim=16, mla_latent_dim=32, mla_rope_dim=8,
+            mlp_dim=48 if n_experts else 112, max_seq_len=64,
+            rope_theta=10_000.0, norm_eps=1e-6,
+            n_experts=n_experts, n_experts_per_tok=2,
+            n_shared_experts=n_shared, router_norm_topk=False))
+        return cfg, hf
+
+    def test_mla_dense_mlp(self):
+        # first_k_dense_replace=99 => every layer dense: isolates the MLA
+        # attention mapping (rope permute, latent norm, kv_b split)
+        cfg, hf = self._tiny()
+        _compare(cfg, hf)
+
+    def test_mla_moe_shared_experts(self):
+        """Routing near-ties are legitimate divergence: when two experts
+        score within f32 noise, torch and jax may pick different ones and
+        BOTH are correct — so this comparison allows a couple of flipped
+        TOKEN ROWS and requires tight parity everywhere else (the routed
+        module itself matches to 2.6e-4 standalone; see git history)."""
+        cfg, hf = self._tiny(n_experts=4, n_shared=2)
+        hf.eval()
+        toks = _tokens(cfg.vocab_size)
+        with torch.no_grad():
+            ref = hf(torch.from_numpy(
+                toks.astype(np.int64))).logits.numpy()
+        params = load_hf(cfg, hf)
+        ours = np.asarray(LlamaModel(cfg).forward(params, jnp.asarray(toks)))
+        bad = np.abs(ours - ref) > 3e-3          # (B, S, V)
+        flipped_rows = np.any(bad, axis=-1).sum()
+        assert flipped_rows <= 2, (
+            f"{flipped_rows} token rows diverged — more than routing "
+            "near-ties explain")
+        ok = ~np.any(bad, axis=-1)
+        np.testing.assert_allclose(ours[ok], ref[ok], atol=5e-4, rtol=5e-4)
+
+    def test_mla_decode_from_imported_weights(self):
+        """Imported weights drive the ABSORBED latent-cache decode:
+        greedy continuation matches the HF reference's."""
+        cfg, hf = self._tiny()
+        params = load_hf(cfg, hf)
+        model = LlamaModel(cfg)
+        toks = _tokens(cfg.vocab_size)[:1]
+        cache = model.init_cache(1, 48)
+        logits, cache = model.prefill(params, jnp.asarray(toks), cache)
+        ours = []
+        tok = jnp.argmax(logits, -1)
+        for _ in range(5):
+            ours.append(int(tok[0]))
+            logits, cache = model.decode_step(params, tok, cache)
+            tok = jnp.argmax(logits, -1)
+        with torch.no_grad():
+            ids = torch.from_numpy(toks.astype(np.int64))
+            theirs = []
+            for _ in range(5):
+                nxt = hf(ids).logits[:, -1].argmax(-1)
+                theirs.append(int(nxt[0]))
+                ids = torch.cat([ids, nxt[:, None]], dim=1)
+        assert ours == theirs
+
+    def test_roundtrip_export(self):
+        cfg, hf = self._tiny(n_experts=4, n_shared=2)
+        params = load_hf(cfg, hf)
+        sd2 = to_hf_state_dict(cfg, params)
+        params2 = from_hf_state_dict(cfg, sd2)
+        import jax
+        for a, b in zip(jax.tree_util.tree_leaves(params),
+                        jax.tree_util.tree_leaves(params2)):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b, np.float32),
+                                       rtol=1e-6, atol=1e-6)
+
+    def test_first_k_dense_rejected_loudly(self):
+        from transformers.models.deepseek_v2 import DeepseekV2Config
+        from transformers.models.deepseek_v2.modeling_deepseek_v2 import (
+            DeepseekV2ForCausalLM)
+        hf = DeepseekV2ForCausalLM(DeepseekV2Config(
+            vocab_size=128, hidden_size=64, intermediate_size=112,
+            moe_intermediate_size=48, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=4, kv_lora_rank=32,
+            q_lora_rank=None, qk_nope_head_dim=16, qk_rope_head_dim=8,
+            v_head_dim=16, n_routed_experts=4, n_shared_experts=2,
+            num_experts_per_tok=2, first_k_dense_replace=1,  # real Lite
+            norm_topk_prob=False, attention_bias=False,
+            attn_implementation="eager"))
+        cfg, _ = self._tiny(n_experts=4, n_shared=2)
+        with pytest.raises(NotImplementedError, match="first_k_dense"):
+            load_hf(cfg, hf)

@@ -328,13 +328,15 @@ class TestMlaGuards:
             init_params(tiny_mla(query_pre_attn_scalar=256.0),
                         jax.random.PRNGKey(0))
 
-    def test_hf_load_fails_fast(self):
+    def test_hf_low_rank_q_fails_fast(self):
+        """MLA HF import exists now (test_hf_convert.py proves parity);
+        the remaining unsupported variant — DeepSeek-V2 full's low-rank q
+        — still errors before any heavy lifting."""
         from k8s_runpod_kubelet_tpu.models.convert import load_hf
-        with pytest.raises(NotImplementedError, match="MLA"):
-            load_hf(MCFG, {})
-
-    def test_serve_main_refuses_hf_checkpoint(self, tmp_path):
-        from k8s_runpod_kubelet_tpu.workloads import serve_main
-        rc = serve_main.main(["--model", "tiny-mla",
-                              "--hf-checkpoint", str(tmp_path)])
-        assert rc == 1
+        sd = {f"model.layers.{i}.input_layernorm.weight":
+              np.ones((MCFG.embed_dim,), np.float32)
+              for i in range(MCFG.n_layers)}
+        sd["model.layers.0.self_attn.q_a_proj.weight"] = \
+            np.ones((8, MCFG.embed_dim), np.float32)
+        with pytest.raises(NotImplementedError, match="q_lora_rank"):
+            load_hf(MCFG, sd)
