@@ -48,9 +48,9 @@ def _np(t, dt: np.dtype) -> np.ndarray:
 
 
 def _stack(sd: Mapping[str, Any], fmt: str, n_layers: int, dt: np.dtype,
-           transpose: bool = False) -> np.ndarray:
+           transpose: bool = False, offset: int = 0) -> np.ndarray:
     outs = []
-    for i in range(n_layers):
+    for i in range(offset, offset + n_layers):
         name = fmt.format(i=i)
         if name not in sd:
             raise KeyError(f"HF checkpoint missing {name!r}")
@@ -86,7 +86,7 @@ def _rope_reinterleave(w: np.ndarray, dr: int) -> np.ndarray:
 
 
 def _mla_attn_from_hf(cfg: LlamaConfig, sd: Mapping[str, Any],
-                      dt: np.dtype) -> dict[str, np.ndarray]:
+                      dt: np.dtype, offset: int = 0) -> dict[str, np.ndarray]:
     """DeepSeek-V2 MLA attention mapping (per layer):
       q_proj (H*(dh+dr), E)            -> wq (E, H, dh+dr flat), rope tail
                                           de-interleaved per head
@@ -101,7 +101,7 @@ def _mla_attn_from_hf(cfg: LlamaConfig, sd: Mapping[str, Any],
     hd, dr, r = cfg.head_dim_, cfg.mla_rope_dim, cfg.mla_latent_dim
     hn = cfg.n_heads
     wq, wdkv, cnorm, wuk, wuv, wo = [], [], [], [], [], []
-    for i in range(L):
+    for i in range(offset, offset + L):
         p = f"layers.{i}.self_attn."
         q = _np(sd[p + "q_proj.weight"], dt).T          # (E, H*(dh+dr))
         q = q.reshape(q.shape[0], hn, hd + dr)
@@ -137,15 +137,21 @@ def _check_mla_keys(cfg: LlamaConfig, keys) -> None:
             "low-rank q (q_lora_rank, DeepSeek-V2 full) is not supported; "
             "this config family models V2-Lite's full-rank q")
     if cfg.n_experts and any(".mlp.experts." in k for k in names):
+        kpre = cfg.n_dense_prefix
         for i in range(cfg.n_layers):
-            if f"layers.{i}.mlp.experts.0.gate_proj.weight" not in names:
+            has_experts = (f"layers.{i}.mlp.experts.0.gate_proj.weight"
+                           in names)
+            if i < kpre and has_experts:
+                raise NotImplementedError(
+                    f"layer {i} has experts but the config expects a dense "
+                    f"prefix of {kpre} (n_dense_prefix mismatch — check "
+                    "the checkpoint's first_k_dense_replace)")
+            if i >= kpre and not has_experts:
                 raise NotImplementedError(
                     f"layer {i} has a dense MLP where experts are expected "
-                    "(DeepSeek first_k_dense_replace > 0); this config "
-                    "family is uniformly MoE — the documented "
-                    "deepseek_v2_lite divergence. Export with "
-                    "first_k_dense_replace=0 or drop the dense prefix "
-                    "layers.")
+                    "(the checkpoint's first_k_dense_replace exceeds the "
+                    f"config's n_dense_prefix={kpre}); set n_dense_prefix "
+                    "to match")
 
 
 def from_hf_state_dict(cfg: LlamaConfig, sd: Mapping[str, Any],
@@ -165,15 +171,38 @@ def from_hf_state_dict(cfg: LlamaConfig, sd: Mapping[str, Any],
         norm[k[len("model."):] if k.startswith("model.") else k] = v
     sd = norm
     _check_mla_keys(cfg, sd.keys())   # before ANY conversion work
-    L = cfg.n_layers
     dt = np.dtype(dtype or cfg.param_dtype)  # jnp.bfloat16 works via ml_dtypes
+    layers = _hf_layer_stack(cfg.main_cfg(), sd, dt,
+                             offset=cfg.n_dense_prefix)
+    params: Params = {
+        "tok_embed": _np(sd["embed_tokens.weight"], dt),
+        "final_norm": _np(sd["norm.weight"], dt),
+        "layers": layers,
+    }
+    if cfg.n_dense_prefix:
+        params["prefix_layers"] = _hf_layer_stack(cfg.prefix_cfg(), sd, dt,
+                                                  offset=0)
+    if not cfg.tie_embeddings:
+        if "lm_head.weight" in sd:
+            params["lm_head"] = _np(sd["lm_head.weight"], dt).T
+        else:  # checkpoint ties but config doesn't: materialize the tie
+            params["lm_head"] = params["tok_embed"].T.copy()
+    return params
+
+
+def _hf_layer_stack(cfg: LlamaConfig, sd: Mapping[str, Any], dt: np.dtype,
+                    offset: int = 0) -> dict[str, np.ndarray]:
+    """One stacked layer group (main or dense-prefix) from HF keys
+    ``layers.{offset}..{offset+n_layers-1}``."""
+    L = cfg.n_layers
     pre = "layers.{i}."
 
     layers: dict[str, np.ndarray] = {
-        "attn_norm": _stack(sd, pre + "input_layernorm.weight", L, dt),
+        "attn_norm": _stack(sd, pre + "input_layernorm.weight", L, dt,
+                            offset=offset),
     }
     if cfg.is_mla:
-        layers.update(_mla_attn_from_hf(cfg, sd, dt))
+        layers.update(_mla_attn_from_hf(cfg, sd, dt, offset=offset))
     else:
         layers.update({
             "wq": _stack(sd, pre + "self_attn.q_proj.weight", L, dt,
@@ -190,35 +219,42 @@ def from_hf_state_dict(cfg: LlamaConfig, sd: Mapping[str, Any],
         # POST-attention output norm; the pre-MLP norm is
         # pre_feedforward_layernorm
         layers["attn_post_norm"] = _stack(
-            sd, pre + "post_attention_layernorm.weight", L, dt)
+            sd, pre + "post_attention_layernorm.weight", L, dt, offset=offset)
         layers["mlp_norm"] = _stack(
-            sd, pre + "pre_feedforward_layernorm.weight", L, dt)
+            sd, pre + "pre_feedforward_layernorm.weight", L, dt,
+            offset=offset)
         layers["mlp_post_norm"] = _stack(
-            sd, pre + "post_feedforward_layernorm.weight", L, dt)
+            sd, pre + "post_feedforward_layernorm.weight", L, dt,
+            offset=offset)
     else:
         layers["mlp_norm"] = _stack(
-            sd, pre + "post_attention_layernorm.weight", L, dt)
+            sd, pre + "post_attention_layernorm.weight", L, dt, offset=offset)
     if cfg.qk_norm:
-        layers["q_norm"] = _stack(sd, pre + "self_attn.q_norm.weight", L, dt)
-        layers["k_norm"] = _stack(sd, pre + "self_attn.k_norm.weight", L, dt)
+        layers["q_norm"] = _stack(sd, pre + "self_attn.q_norm.weight", L, dt,
+                                  offset=offset)
+        layers["k_norm"] = _stack(sd, pre + "self_attn.k_norm.weight", L, dt,
+                                  offset=offset)
     if cfg.qkv_bias:
-        layers["wq_b"] = _stack(sd, pre + "self_attn.q_proj.bias", L, dt)
-        layers["wk_b"] = _stack(sd, pre + "self_attn.k_proj.bias", L, dt)
-        layers["wv_b"] = _stack(sd, pre + "self_attn.v_proj.bias", L, dt)
+        layers["wq_b"] = _stack(sd, pre + "self_attn.q_proj.bias", L, dt,
+                                offset=offset)
+        layers["wk_b"] = _stack(sd, pre + "self_attn.k_proj.bias", L, dt,
+                                offset=offset)
+        layers["wv_b"] = _stack(sd, pre + "self_attn.v_proj.bias", L, dt,
+                                offset=offset)
     if cfg.n_experts:
         deepseek_moe = any(".mlp.experts." in k for k in sd)
-        if deepseek_moe:  # dense-prefix layers rejected by _check_mla_keys
+        if deepseek_moe:  # prefix consistency enforced by _check_mla_keys
             layers["router"] = _stack(sd, pre + "mlp.gate.weight", L, dt,
-                                      transpose=True)
+                                      transpose=True, offset=offset)
             names = ("gate_proj", "up_proj", "down_proj")
             expert_fmt = "layers.{i}.mlp.experts.{e}.{w}.weight"
         else:
             layers["router"] = _stack(sd, pre + "block_sparse_moe.gate.weight",
-                                      L, dt, transpose=True)
+                                      L, dt, transpose=True, offset=offset)
             names = ("w1", "w3", "w2")
             expert_fmt = "layers.{i}.block_sparse_moe.experts.{e}.{w}.weight"
         gates, ups, downs = [], [], []
-        for i in range(L):
+        for i in range(offset, offset + L):
             g = [_np(sd[expert_fmt.format(i=i, e=e, w=names[0])], dt).T
                  for e in range(cfg.n_experts)]
             u = [_np(sd[expert_fmt.format(i=i, e=e, w=names[1])], dt).T
@@ -234,32 +270,21 @@ def from_hf_state_dict(cfg: LlamaConfig, sd: Mapping[str, Any],
         if cfg.n_shared_experts:
             layers["ws_gate"] = _stack(
                 sd, pre + "mlp.shared_experts.gate_proj.weight", L, dt,
-                transpose=True)
+                transpose=True, offset=offset)
             layers["ws_up"] = _stack(
                 sd, pre + "mlp.shared_experts.up_proj.weight", L, dt,
-                transpose=True)
+                transpose=True, offset=offset)
             layers["ws_down"] = _stack(
                 sd, pre + "mlp.shared_experts.down_proj.weight", L, dt,
-                transpose=True)
+                transpose=True, offset=offset)
     else:
         layers["w_gate"] = _stack(sd, pre + "mlp.gate_proj.weight", L, dt,
-                                  transpose=True)
+                                  transpose=True, offset=offset)
         layers["w_up"] = _stack(sd, pre + "mlp.up_proj.weight", L, dt,
-                                transpose=True)
+                                transpose=True, offset=offset)
         layers["w_down"] = _stack(sd, pre + "mlp.down_proj.weight", L, dt,
-                                  transpose=True)
-
-    params: Params = {
-        "tok_embed": _np(sd["embed_tokens.weight"], dt),
-        "final_norm": _np(sd["norm.weight"], dt),
-        "layers": layers,
-    }
-    if not cfg.tie_embeddings:
-        if "lm_head.weight" in sd:
-            params["lm_head"] = _np(sd["lm_head.weight"], dt).T
-        else:  # checkpoint ties but config doesn't: materialize the tie
-            params["lm_head"] = params["tok_embed"].T.copy()
-    return params
+                                  transpose=True, offset=offset)
+    return layers
 
 
 def to_hf_state_dict(cfg: LlamaConfig, params: Params) -> dict[str, np.ndarray]:
@@ -271,92 +296,98 @@ def to_hf_state_dict(cfg: LlamaConfig, params: Params) -> dict[str, np.ndarray]:
     }
     if not cfg.tie_embeddings:
         sd["lm_head.weight"] = np.asarray(params["lm_head"], np.float32).T
-    lp = params["layers"]
 
     def put(i: int, name: str, val: np.ndarray):
         sd[f"model.layers.{i}.{name}"] = val
 
-    for i in range(cfg.n_layers):
-        put(i, "input_layernorm.weight", np.asarray(lp["attn_norm"][i], np.float32))
-        if cfg.post_norms:
-            put(i, "post_attention_layernorm.weight",
+    kpre = cfg.n_dense_prefix
+    for gi in range(cfg.n_layers):
+        # dense-prefix layers export from their own stack under the
+        # GLOBAL layer index; cfg view switches the MLP naming with them
+        if kpre and gi < kpre:
+            lp, i, cfg_i = params["prefix_layers"], gi, cfg.prefix_cfg()
+        else:
+            lp, i, cfg_i = params["layers"], gi - kpre, cfg.main_cfg()
+        put(gi, "input_layernorm.weight", np.asarray(lp["attn_norm"][i], np.float32))
+        if cfg_i.post_norms:
+            put(gi, "post_attention_layernorm.weight",
                 np.asarray(lp["attn_post_norm"][i], np.float32))
-            put(i, "pre_feedforward_layernorm.weight",
+            put(gi, "pre_feedforward_layernorm.weight",
                 np.asarray(lp["mlp_norm"][i], np.float32))
-            put(i, "post_feedforward_layernorm.weight",
+            put(gi, "post_feedforward_layernorm.weight",
                 np.asarray(lp["mlp_post_norm"][i], np.float32))
         else:
-            put(i, "post_attention_layernorm.weight",
+            put(gi, "post_attention_layernorm.weight",
                 np.asarray(lp["mlp_norm"][i], np.float32))
-        if cfg.is_mla:
-            hd, dr, r = cfg.head_dim_, cfg.mla_rope_dim, cfg.mla_latent_dim
-            hn = cfg.n_heads
+        if cfg_i.is_mla:
+            hd, dr, r = cfg_i.head_dim_, cfg_i.mla_rope_dim, cfg_i.mla_latent_dim
+            hn = cfg_i.n_heads
             q = np.asarray(lp["wq"][i], np.float32).reshape(-1, hn, hd + dr)
-            put(i, "self_attn.q_proj.weight",
+            put(gi, "self_attn.q_proj.weight",
                 _rope_reinterleave(q, dr).reshape(q.shape[0], -1).T)
-            put(i, "self_attn.kv_a_proj_with_mqa.weight",
+            put(gi, "self_attn.kv_a_proj_with_mqa.weight",
                 _rope_reinterleave(
                     np.asarray(lp["w_dkv"][i], np.float32), dr).T)
-            put(i, "self_attn.kv_a_layernorm.weight",
+            put(gi, "self_attn.kv_a_layernorm.weight",
                 np.asarray(lp["c_norm"][i], np.float32))
             uk = np.asarray(lp["w_uk"][i], np.float32).reshape(r, hn, hd)
             uv = np.asarray(lp["w_uv"][i], np.float32).reshape(r, hn, hd)
-            put(i, "self_attn.kv_b_proj.weight",
+            put(gi, "self_attn.kv_b_proj.weight",
                 np.concatenate([uk, uv], axis=-1).reshape(r, -1).T)
-            put(i, "self_attn.o_proj.weight",
+            put(gi, "self_attn.o_proj.weight",
                 np.asarray(lp["wo"][i], np.float32).T)
         else:
             for ours, theirs in (("wq", "self_attn.q_proj.weight"),
                                  ("wk", "self_attn.k_proj.weight"),
                                  ("wv", "self_attn.v_proj.weight"),
                                  ("wo", "self_attn.o_proj.weight")):
-                put(i, theirs, np.asarray(lp[ours][i], np.float32).T)
-        if cfg.qk_norm:
-            put(i, "self_attn.q_norm.weight",
+                put(gi, theirs, np.asarray(lp[ours][i], np.float32).T)
+        if cfg_i.qk_norm:
+            put(gi, "self_attn.q_norm.weight",
                 np.asarray(lp["q_norm"][i], np.float32))
-            put(i, "self_attn.k_norm.weight",
+            put(gi, "self_attn.k_norm.weight",
                 np.asarray(lp["k_norm"][i], np.float32))
-        if cfg.qkv_bias:
+        if cfg_i.qkv_bias:
             for ours, theirs in (("wq_b", "self_attn.q_proj.bias"),
                                  ("wk_b", "self_attn.k_proj.bias"),
                                  ("wv_b", "self_attn.v_proj.bias")):
-                put(i, theirs, np.asarray(lp[ours][i], np.float32))
-        if cfg.n_experts:
+                put(gi, theirs, np.asarray(lp[ours][i], np.float32))
+        if cfg_i.n_experts:
             # family discriminates the naming (the SAME signal import
             # uses): MLA => DeepSeek-MoE names, else Mixtral names — a
             # chimera of MLA attention + block_sparse_moe would load
             # into neither transformers architecture
-            if cfg.is_mla:
-                put(i, "mlp.gate.weight",
+            if cfg_i.is_mla:
+                put(gi, "mlp.gate.weight",
                     np.asarray(lp["router"][i], np.float32).T)
-                for e in range(cfg.n_experts):
-                    put(i, f"mlp.experts.{e}.gate_proj.weight",
+                for e in range(cfg_i.n_experts):
+                    put(gi, f"mlp.experts.{e}.gate_proj.weight",
                         np.asarray(lp["we_gate"][i, e], np.float32).T)
-                    put(i, f"mlp.experts.{e}.up_proj.weight",
+                    put(gi, f"mlp.experts.{e}.up_proj.weight",
                         np.asarray(lp["we_up"][i, e], np.float32).T)
-                    put(i, f"mlp.experts.{e}.down_proj.weight",
+                    put(gi, f"mlp.experts.{e}.down_proj.weight",
                         np.asarray(lp["we_down"][i, e], np.float32).T)
-                if cfg.n_shared_experts:
-                    put(i, "mlp.shared_experts.gate_proj.weight",
+                if cfg_i.n_shared_experts:
+                    put(gi, "mlp.shared_experts.gate_proj.weight",
                         np.asarray(lp["ws_gate"][i], np.float32).T)
-                    put(i, "mlp.shared_experts.up_proj.weight",
+                    put(gi, "mlp.shared_experts.up_proj.weight",
                         np.asarray(lp["ws_up"][i], np.float32).T)
-                    put(i, "mlp.shared_experts.down_proj.weight",
+                    put(gi, "mlp.shared_experts.down_proj.weight",
                         np.asarray(lp["ws_down"][i], np.float32).T)
             else:
-                put(i, "block_sparse_moe.gate.weight",
+                put(gi, "block_sparse_moe.gate.weight",
                     np.asarray(lp["router"][i], np.float32).T)
-                for e in range(cfg.n_experts):
-                    put(i, f"block_sparse_moe.experts.{e}.w1.weight",
+                for e in range(cfg_i.n_experts):
+                    put(gi, f"block_sparse_moe.experts.{e}.w1.weight",
                         np.asarray(lp["we_gate"][i, e], np.float32).T)
-                    put(i, f"block_sparse_moe.experts.{e}.w3.weight",
+                    put(gi, f"block_sparse_moe.experts.{e}.w3.weight",
                         np.asarray(lp["we_up"][i, e], np.float32).T)
-                    put(i, f"block_sparse_moe.experts.{e}.w2.weight",
+                    put(gi, f"block_sparse_moe.experts.{e}.w2.weight",
                         np.asarray(lp["we_down"][i, e], np.float32).T)
         else:
-            put(i, "mlp.gate_proj.weight", np.asarray(lp["w_gate"][i], np.float32).T)
-            put(i, "mlp.up_proj.weight", np.asarray(lp["w_up"][i], np.float32).T)
-            put(i, "mlp.down_proj.weight", np.asarray(lp["w_down"][i], np.float32).T)
+            put(gi, "mlp.gate_proj.weight", np.asarray(lp["w_gate"][i], np.float32).T)
+            put(gi, "mlp.up_proj.weight", np.asarray(lp["w_up"][i], np.float32).T)
+            put(gi, "mlp.down_proj.weight", np.asarray(lp["w_down"][i], np.float32).T)
     return sd
 
 

@@ -123,6 +123,13 @@ class LlamaConfig:
     # MLP of width n_shared_experts * mlp_dim alongside the routed experts
     # (their output is added, router ignores them). 0 = plain MoE/dense.
     n_shared_experts: int = 0
+    # DeepSeek first_k_dense_replace: the first k layers use a DENSE MLP
+    # (width dense_prefix_mlp_dim, default mlp_dim) instead of the MoE —
+    # stored as a separate "prefix_layers" stack and scanned before the
+    # main layers. MLA-only (the windowed/ring cache machinery never
+    # composes with a prefix); V2-Lite: 1 dense layer at width 10944.
+    n_dense_prefix: int = 0
+    dense_prefix_mlp_dim: Optional[int] = None
 
     @property
     def head_dim_(self) -> int:
@@ -132,7 +139,33 @@ class LlamaConfig:
     def is_mla(self) -> bool:
         return self.mla_latent_dim is not None
 
+    def prefix_cfg(self) -> "LlamaConfig":
+        """Config view of the dense-prefix layers: same attention, dense
+        MLP at dense_prefix_mlp_dim, n_layers = the prefix length. The
+        layer machinery (blocks, shapes, axes) runs unchanged on it."""
+        return dataclasses.replace(
+            self, n_layers=self.n_dense_prefix, n_experts=0,
+            n_shared_experts=0,
+            mlp_dim=self.dense_prefix_mlp_dim or self.mlp_dim,
+            n_dense_prefix=0, dense_prefix_mlp_dim=None)
+
+    def main_cfg(self) -> "LlamaConfig":
+        """Config view of the main (post-prefix) layer stack."""
+        if not self.n_dense_prefix:
+            return self
+        return dataclasses.replace(
+            self, n_layers=self.n_layers - self.n_dense_prefix,
+            n_dense_prefix=0, dense_prefix_mlp_dim=None)
+
     def validate_mla(self) -> None:
+        if self.n_dense_prefix:
+            if not self.is_mla or not self.n_experts:
+                raise ValueError("n_dense_prefix models the DeepSeek shape: "
+                                 "MLA attention over a MoE body")
+            if self.n_dense_prefix >= self.n_layers:
+                raise ValueError(f"n_dense_prefix {self.n_dense_prefix} must "
+                                 f"leave MoE layers (n_layers "
+                                 f"{self.n_layers})")
         if not self.is_mla:
             return
         bad = [f for f, on in (("sliding_window",
@@ -191,7 +224,14 @@ class LlamaConfig:
             mlp = 3 * e * m
         norms = (4 if self.post_norms else 2) * e
         embed = v * e * (1 if self.tie_embeddings else 2)
-        return l * (attn + mlp + norms) + embed + e
+        k = self.n_dense_prefix
+        if k:
+            mlp_prefix = 3 * e * (self.dense_prefix_mlp_dim or m)
+            layer_total = ((l - k) * (attn + mlp + norms)
+                           + k * (attn + mlp_prefix + norms))
+        else:
+            layer_total = l * (attn + mlp + norms)
+        return layer_total + embed + e
 
 
 def llama3_8b() -> LlamaConfig:
@@ -288,10 +328,10 @@ def qwen2_7b() -> LlamaConfig:
 def deepseek_v2_lite() -> LlamaConfig:
     """DeepSeek-V2-Lite-class: MLA (latent 512 + decoupled RoPE 64, heads
     16x128) over a DeepSeek-MoE MLP (64 routed experts top-6 + 2 shared,
-    expert width 1408). Documented divergences from the HF checkpoint: the
-    real model's FIRST layer uses a dense 10944-wide MLP (layer
-    heterogeneity breaks the scan-over-layers layout; all layers are MoE
-    here) and q is full-rank (true for V2-Lite: q_lora_rank is null)."""
+    expert width 1408), with the real checkpoint's FIRST layer dense at
+    width 10944 (first_k_dense_replace=1 -> n_dense_prefix) and full-rank
+    q (true for V2-Lite: q_lora_rank is null). HF checkpoints load with
+    logits parity (tests/test_hf_convert.py TestDeepseekV2Parity)."""
     return LlamaConfig(name="deepseek-v2-lite", vocab_size=102400,
                        embed_dim=2048, n_layers=27, n_heads=16,
                        n_kv_heads=16, head_dim=128, mlp_dim=1408,
@@ -299,7 +339,8 @@ def deepseek_v2_lite() -> LlamaConfig:
                        norm_eps=1e-6,
                        mla_latent_dim=512, mla_rope_dim=64,
                        n_experts=64, n_experts_per_tok=6,
-                       n_shared_experts=2, router_norm_topk=False)
+                       n_shared_experts=2, router_norm_topk=False,
+                       n_dense_prefix=1, dense_prefix_mlp_dim=10944)
 
 
 def tiny_mla(**kw) -> LlamaConfig:
@@ -326,8 +367,8 @@ def tiny_moe(**kw) -> LlamaConfig:
 
 # -- params -------------------------------------------------------------------
 
-def param_logical_axes(cfg: LlamaConfig) -> Params:
-    """Pytree (matching init_params) of logical-axis tuples."""
+def _layer_axes(cfg: LlamaConfig) -> dict:
+    """Logical-axis dict for ONE stacked layer group (main or prefix)."""
     if cfg.is_mla:
         # latent axes stay replicated ("latent": None in LOGICAL_RULES):
         # every tensor-parallel shard reads the WHOLE latent cache — its
@@ -381,18 +422,24 @@ def param_logical_axes(cfg: LlamaConfig) -> Params:
             "w_up": ("layer", "embed", "mlp"),
             "w_down": ("layer", "mlp", "embed"),
         })
+    return layer
+
+
+def param_logical_axes(cfg: LlamaConfig) -> Params:
+    """Pytree (matching init_params) of logical-axis tuples."""
+    layer = _layer_axes(cfg.main_cfg())
     tree: Params = {"tok_embed": ("vocab", "embed"),
                     "final_norm": ("norm",),
                     "layers": layer}
+    if cfg.n_dense_prefix:
+        tree["prefix_layers"] = _layer_axes(cfg.prefix_cfg())
     if not cfg.tie_embeddings:
         tree["lm_head"] = ("embed", "vocab")
     return tree
 
 
-def init_params(cfg: LlamaConfig, key: jax.Array,
-                mesh: Optional[Mesh] = None) -> Params:
-    """Initialize (optionally directly sharded onto ``mesh``)."""
-    cfg.validate_mla()
+def _layer_shapes(cfg: LlamaConfig) -> dict:
+    """Shape dict for ONE stacked layer group (main or prefix)."""
     e, hd = cfg.embed_dim, cfg.head_dim_
     if cfg.is_mla:
         r, dr = cfg.mla_latent_dim, cfg.mla_rope_dim
@@ -409,34 +456,30 @@ def init_params(cfg: LlamaConfig, key: jax.Array,
             "wk": (cfg.n_layers, e, cfg.n_kv_heads * hd),
             "wv": (cfg.n_layers, e, cfg.n_kv_heads * hd),
         }
-    shapes = {
-        "tok_embed": (cfg.vocab_size, e),
-        "final_norm": (e,),
-        "layers": {
-            "attn_norm": (cfg.n_layers, e),
-            **attn_shapes,
-            "wo": (cfg.n_layers, cfg.n_heads * hd, e),
-            "mlp_norm": (cfg.n_layers, e),
-        },
+    layer = {
+        "attn_norm": (cfg.n_layers, e),
+        **attn_shapes,
+        "wo": (cfg.n_layers, cfg.n_heads * hd, e),
+        "mlp_norm": (cfg.n_layers, e),
     }
     if cfg.post_norms:
-        shapes["layers"].update({
+        layer.update({
             "attn_post_norm": (cfg.n_layers, e),
             "mlp_post_norm": (cfg.n_layers, e),
         })
     if cfg.qk_norm:
-        shapes["layers"].update({
+        layer.update({
             "q_norm": (cfg.n_layers, hd),
             "k_norm": (cfg.n_layers, hd),
         })
     if cfg.qkv_bias:
-        shapes["layers"].update({
+        layer.update({
             "wq_b": (cfg.n_layers, cfg.n_heads * hd),
             "wk_b": (cfg.n_layers, cfg.n_kv_heads * hd),
             "wv_b": (cfg.n_layers, cfg.n_kv_heads * hd),
         })
     if cfg.n_experts:
-        shapes["layers"].update({
+        layer.update({
             "router": (cfg.n_layers, e, cfg.n_experts),
             "we_gate": (cfg.n_layers, cfg.n_experts, e, cfg.mlp_dim),
             "we_up": (cfg.n_layers, cfg.n_experts, e, cfg.mlp_dim),
@@ -444,17 +487,32 @@ def init_params(cfg: LlamaConfig, key: jax.Array,
         })
         if cfg.n_shared_experts:
             sw = cfg.n_shared_experts * cfg.mlp_dim
-            shapes["layers"].update({
+            layer.update({
                 "ws_gate": (cfg.n_layers, e, sw),
                 "ws_up": (cfg.n_layers, e, sw),
                 "ws_down": (cfg.n_layers, sw, e),
             })
     else:
-        shapes["layers"].update({
+        layer.update({
             "w_gate": (cfg.n_layers, e, cfg.mlp_dim),
             "w_up": (cfg.n_layers, e, cfg.mlp_dim),
             "w_down": (cfg.n_layers, cfg.mlp_dim, e),
         })
+    return layer
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array,
+                mesh: Optional[Mesh] = None) -> Params:
+    """Initialize (optionally directly sharded onto ``mesh``)."""
+    cfg.validate_mla()
+    e, hd = cfg.embed_dim, cfg.head_dim_
+    shapes: Params = {
+        "tok_embed": (cfg.vocab_size, e),
+        "final_norm": (e,),
+        "layers": _layer_shapes(cfg.main_cfg()),
+    }
+    if cfg.n_dense_prefix:
+        shapes["prefix_layers"] = _layer_shapes(cfg.prefix_cfg())
     if not cfg.tie_embeddings:
         shapes["lm_head"] = (e, cfg.vocab_size)
 
@@ -463,9 +521,13 @@ def init_params(cfg: LlamaConfig, key: jax.Array,
     keys = jax.random.split(key, len(leaves))
 
     def make(shape, k):
-        if len(shape) <= 2 and shape[-1] == e and len(shape) < 3:
+        if len(shape) <= 2 and shape[-1] == e:
             # norm weights: identity scale — 1, or 0 when applied as (1+w)
-            if shape == (e,) or shape == (cfg.n_layers, e):
+            # ((e,) final norm; (L, e) / (k_prefix, e) stacked layer norms)
+            if len(shape) == 1 or shape[0] in (cfg.n_layers,
+                                               cfg.n_dense_prefix,
+                                               cfg.n_layers
+                                               - cfg.n_dense_prefix):
                 fill = 0.0 if cfg.norm_zero_centered else 1.0
                 return jnp.full(shape, fill, cfg.param_dtype)
         scale = 0.02
@@ -473,17 +535,19 @@ def init_params(cfg: LlamaConfig, key: jax.Array,
 
     params = jax.tree_util.tree_unflatten(
         treedef, [make(s, k) for s, k in zip(leaves, keys)])
-    if cfg.qkv_bias:
-        for name in ("wq_b", "wk_b", "wv_b"):
-            params["layers"][name] = jnp.zeros_like(params["layers"][name])
-    if cfg.qk_norm:  # identity norm init ((L, hd) misses make()'s (L, e) rule)
-        fill = 0.0 if cfg.norm_zero_centered else 1.0
-        for name in ("q_norm", "k_norm"):
-            params["layers"][name] = jnp.full_like(params["layers"][name], fill)
-    if cfg.is_mla:   # kv_a_layernorm: identity init ((L, r) misses the rule)
-        fill = 0.0 if cfg.norm_zero_centered else 1.0
-        params["layers"]["c_norm"] = jnp.full_like(
-            params["layers"]["c_norm"], fill)
+    stacks = [params["layers"]] + ([params["prefix_layers"]]
+                                   if cfg.n_dense_prefix else [])
+    for lp in stacks:
+        if cfg.qkv_bias:
+            for name in ("wq_b", "wk_b", "wv_b"):
+                lp[name] = jnp.zeros_like(lp[name])
+        if cfg.qk_norm:  # identity init ((L, hd) misses make()'s rule)
+            fill = 0.0 if cfg.norm_zero_centered else 1.0
+            for name in ("q_norm", "k_norm"):
+                lp[name] = jnp.full_like(lp[name], fill)
+        if cfg.is_mla:   # kv_a_layernorm: identity init ((L, r) ditto)
+            fill = 0.0 if cfg.norm_zero_centered else 1.0
+            lp["c_norm"] = jnp.full_like(lp["c_norm"], fill)
     if mesh is not None:
         axes = param_logical_axes(cfg)
         params = jax.tree_util.tree_map(
@@ -910,24 +974,41 @@ class LlamaModel:
         pat = cfg.sliding_window_pattern
         windows = cfg.layer_windows()
 
-        def make_group_block(mesh_, positions_):
+        def make_group_block(mesh_, positions_, cfg_=cfg, windows_=windows,
+                             pat_=pat):
             """Scan body over one layer GROUP: each sublayer gets its
             STATIC window + rope table (Gemma-2/3 local/global interleave;
             pat=1 is the degenerate single-sublayer group). Shared by the
-            plain and pipelined paths (pipeline: mesh_=None, mesh-free)."""
+            plain and pipelined paths (pipeline: mesh_=None, mesh-free) and
+            by the dense-prefix phase (cfg_=prefix_cfg: dense MLP, same
+            attention)."""
             def block(carry, lp_group):
                 y = carry
                 aux = jnp.float32(0.0)
-                for j, win in enumerate(windows):
-                    lp = _sublayer(lp_group, j, pat)
+                for j, win in enumerate(windows_):
+                    lp = _sublayer(lp_group, j, pat_)
                     cs, sn = _rope_for(ropes, win)
-                    y = _attention_block(y, lp, cfg, cs, sn, mesh_,
+                    y = _attention_block(y, lp, cfg_, cs, sn, mesh_,
                                          positions_, window=win)
-                    y, a = _mlp_block(y, lp, cfg, mesh_)
+                    y, a = _mlp_block(y, lp, cfg_, mesh_)
                     y = _constrain(y, mesh_, ("batch", "seq", "act_embed"))
                     aux = aux + a
                 return y, aux
             return block
+
+        if cfg.n_dense_prefix:
+            # dense-prefix phase (DeepSeek first_k_dense_replace): same
+            # attention, dense MLP, scanned BEFORE the main stack
+            if pipeline_stages(mesh) > 1:
+                raise ValueError("n_dense_prefix does not compose with "
+                                 "pipeline parallelism (heterogeneous "
+                                 "stages)")
+            pbody = _maybe_remat(
+                make_group_block(mesh, positions, cfg_=cfg.prefix_cfg(),
+                                 windows_=(None,), pat_=1), cfg)
+            x, aux_prefix = jax.lax.scan(pbody, x, params["prefix_layers"])
+        else:
+            aux_prefix = jnp.zeros((0,), jnp.float32)
 
         n_stages = pipeline_stages(mesh)
         if n_stages > 1:
@@ -981,12 +1062,12 @@ class LlamaModel:
         x = rms_norm(x, _norm_w(params["final_norm"], cfg), cfg.norm_eps)
         if return_hidden:
             if with_aux:
-                return x, jnp.sum(aux_layers)
+                return x, jnp.sum(aux_layers) + jnp.sum(aux_prefix)
             return x
         logits = _head_logits(x, params, cfg)
         logits = _constrain(logits, mesh, ("batch", "seq", "act_vocab"))
         if with_aux:
-            return logits, jnp.sum(aux_layers)
+            return logits, jnp.sum(aux_layers) + jnp.sum(aux_prefix)
         return logits
 
     def __call__(self, params, tokens, positions=None):
@@ -1013,16 +1094,30 @@ class LlamaModel:
         if cfg.is_mla:
             # latent cache: (r + dr) per position instead of 2*h*d — the
             # architecture-level answer to decode HBM traffic (int8 on top
-            # halves it again; the two compose like k/v int8 does)
+            # halves it again; the two compose like k/v int8 does).
+            # Dense-prefix layers get their OWN sections (c_pre/kr_pre):
+            # slicing one (L, ...) array per step would force a full-cache
+            # concat on the decode hot path and break donation aliasing
+            # (AOT-measured: +233MB temps, -34% roofline).
             r, dr = cfg.mla_latent_dim, cfg.mla_rope_dim
-            cache = {"c": jnp.zeros((cfg.n_layers, batch, length, r), dt),
-                     "kr": jnp.zeros((cfg.n_layers, batch, length, dr), dt),
+            kpre = cfg.n_dense_prefix
+            lm = cfg.n_layers - kpre
+            cache = {"c": jnp.zeros((lm, batch, length, r), dt),
+                     "kr": jnp.zeros((lm, batch, length, dr), dt),
                      "index": jnp.zeros((batch,), jnp.int32)}
             if quantize:
-                cache["c_scale"] = jnp.zeros((cfg.n_layers, batch, length),
+                cache["c_scale"] = jnp.zeros((lm, batch, length),
                                              jnp.float32)
-                cache["kr_scale"] = jnp.zeros((cfg.n_layers, batch, length),
+                cache["kr_scale"] = jnp.zeros((lm, batch, length),
                                               jnp.float32)
+            if kpre:
+                cache["c_pre"] = jnp.zeros((kpre, batch, length, r), dt)
+                cache["kr_pre"] = jnp.zeros((kpre, batch, length, dr), dt)
+                if quantize:
+                    cache["c_pre_scale"] = jnp.zeros((kpre, batch, length),
+                                                     jnp.float32)
+                    cache["kr_pre_scale"] = jnp.zeros((kpre, batch, length),
+                                                      jnp.float32)
             return cache
         shape = (cfg.n_layers, batch, length, cfg.n_kv_heads, cfg.head_dim_)
         cache = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt),
@@ -1155,6 +1250,19 @@ class LlamaModel:
                 return y, (jnp.stack(ks), jnp.stack(vs))
             return y, (ks[0], vs[0])
 
+        prefix_kv = None
+        if cfg.n_dense_prefix:  # MLA-only (validate_mla): collect c/kr
+            pcfg = cfg.prefix_cfg()
+
+            def pblock(carry, lp):
+                y = carry
+                cs, sn = _rope_for(ropes, None)
+                y, c, kr = _attention_block(y, lp, pcfg, cs, sn, None,
+                                            return_kv=True)
+                y, _ = _mlp_block(y, lp, pcfg, self.mesh, train=False)
+                return y, (c, kr)
+
+            x, prefix_kv = jax.lax.scan(pblock, x, params["prefix_layers"])
         xs = {"lp": _group_layers(params["layers"], pat)}
         if adapters:
             xs["ad"] = _group_layers(adapters, pat)
@@ -1166,20 +1274,28 @@ class LlamaModel:
         last = x[jnp.arange(b), true_length - 1]  # (B, E): each row's last real token
         logits = _head_logits(last, params, cfg)
         if cfg.is_mla:  # k_all/v_all are the latent sections c/kr here
-            c_all, kr_all = k_all, v_all            # (L,B,S,r), (L,B,S,dr)
             max_len = cache["c"].shape[2]
             if s > max_len:
                 raise ValueError(f"prompt length {s} exceeds cache length "
                                  f"{max_len}")
             pad4 = [(0, 0), (0, 0), (0, max_len - s), (0, 0)]
+            quantize = "c_scale" in cache
             new_cache = {"index": true_length.astype(jnp.int32)}
-            if "c_scale" in cache:  # int8 latent cache
-                c_all, c_sc = _kv_quant(c_all)       # (L,B,S,r) + (L,B,S)
-                kr_all, kr_sc = _kv_quant(kr_all)
-                new_cache["c_scale"] = jnp.pad(c_sc, pad4[:-1])
-                new_cache["kr_scale"] = jnp.pad(kr_sc, pad4[:-1])
-            new_cache["c"] = jnp.pad(c_all, pad4)
-            new_cache["kr"] = jnp.pad(kr_all, pad4)
+
+            def write(c_sect, kr_sect, suffix):
+                c_w, kr_w = c_sect, kr_sect
+                if quantize:  # int8 latent cache
+                    c_w, c_sc = _kv_quant(c_w)       # (L,B,S,r) + (L,B,S)
+                    kr_w, kr_sc = _kv_quant(kr_w)
+                    new_cache[f"c{suffix}_scale"] = jnp.pad(c_sc, pad4[:-1])
+                    new_cache[f"kr{suffix}_scale"] = jnp.pad(kr_sc,
+                                                             pad4[:-1])
+                new_cache[f"c{suffix}"] = jnp.pad(c_w, pad4)
+                new_cache[f"kr{suffix}"] = jnp.pad(kr_w, pad4)
+
+            write(k_all, v_all, "")                 # main stack
+            if prefix_kv is not None:               # dense-prefix stack
+                write(prefix_kv[0], prefix_kv[1], "_pre")
             return logits, new_cache
         if "k_l" in cache:  # mixed local/global split cache (Gemma-2/3)
             ring = cache["k_l"].shape[2]
@@ -1541,13 +1657,17 @@ class LlamaModel:
         act2 = active[:, None]                     # (B,1) vs (B,K) writes
         act3 = active[:, None, None]
 
-        def block(carry, inputs):
-            y = carry
+        def make_block(cfg_):
+            def block(carry, inputs):
+                return _mla_verify_block(carry, inputs, cfg_)
+            return block
+
+        def _mla_verify_block(y, inputs, cfg_):
             lp = inputs["lp"]
             c_cache, kr_cache = inputs["c"], inputs["kr"]
             c_sc, kr_sc = inputs.get("cs"), inputs.get("krs")
-            h = rms_norm(y, _norm_w(lp["attn_norm"], cfg), cfg.norm_eps)
-            q_nope, q_rope, c1, kr1 = _mla_project(h, lp, cfg, cos, sin,
+            h = rms_norm(y, _norm_w(lp["attn_norm"], cfg_), cfg_.norm_eps)
+            q_nope, q_rope, c1, kr1 = _mla_project(h, lp, cfg_, cos, sin,
                                                    positions, b, kk)
             if quant:  # int8 latent cache: per-position scales
                 c1, c1_s = _kv_quant(c1)                       # (B,K,r),(B,K)
@@ -1578,28 +1698,46 @@ class LlamaModel:
             w_uv = lp["w_uv"].reshape(r, hn, hd)
             o = jnp.einsum("bkhr,rhd->bkhd", o_lat,
                            w_uv.astype(jnp.float32))
-            o = o.reshape(b, kk, hn * hd).astype(cfg.dtype)
-            o = _mm(o, lp["wo"], cfg.dtype)
-            if cfg.post_norms:
-                o = rms_norm(o, _norm_w(lp["attn_post_norm"], cfg),
-                             cfg.norm_eps)
+            o = o.reshape(b, kk, hn * hd).astype(cfg_.dtype)
+            o = _mm(o, lp["wo"], cfg_.dtype)
+            if cfg_.post_norms:
+                o = rms_norm(o, _norm_w(lp["attn_post_norm"], cfg_),
+                             cfg_.norm_eps)
             y = y + o
-            y, _ = _mlp_block(y, lp, cfg, self.mesh, train=False)
+            y, _ = _mlp_block(y, lp, cfg_, self.mesh, train=False)
             out = {"c": c_cache, "kr": kr_cache}
             if quant:
                 out["cs"], out["krs"] = c_sc, kr_sc
             return y, out
 
-        xs = {"lp": params["layers"], "c": cache["c"], "kr": cache["kr"]}
-        if quant:
-            xs["cs"] = cache["c_scale"]
-            xs["krs"] = cache["kr_scale"]
-        x, new_kv = jax.lax.scan(block, x, xs)
+        def make_xs(lp_tree, suffix):
+            xs_ = {"lp": lp_tree, "c": cache[f"c{suffix}"],
+                   "kr": cache[f"kr{suffix}"]}
+            if quant:
+                xs_["cs"] = cache[f"c{suffix}_scale"]
+                xs_["krs"] = cache[f"kr{suffix}_scale"]
+            return xs_
+
+        # dense-prefix layers carry their OWN cache sections (c_pre/kr_pre):
+        # no slicing or re-concatenation of the (L, ...) cache per step, so
+        # the donated buffers alias straight through both scans
+        new_kv_pre = None
+        if cfg.n_dense_prefix:
+            x, new_kv_pre = jax.lax.scan(
+                make_block(cfg.prefix_cfg()), x,
+                make_xs(params["prefix_layers"], "_pre"))
+        x, new_kv = jax.lax.scan(make_block(cfg), x,
+                                 make_xs(params["layers"], ""))
         x = rms_norm(x, _norm_w(params["final_norm"], cfg), cfg.norm_eps)
         logits = _head_logits(x, params, cfg).astype(jnp.float32)  # (B,K,V)
         out = {"c": new_kv["c"], "kr": new_kv["kr"], "index": idx}
         if quant:
             out["c_scale"], out["kr_scale"] = new_kv["cs"], new_kv["krs"]
+        if new_kv_pre is not None:
+            out["c_pre"], out["kr_pre"] = new_kv_pre["c"], new_kv_pre["kr"]
+            if quant:
+                out["c_pre_scale"] = new_kv_pre["cs"]
+                out["kr_pre_scale"] = new_kv_pre["krs"]
         return logits, out
 
     @staticmethod
@@ -1612,7 +1750,8 @@ class LlamaModel:
         for sect in ("k", "v", "k_l", "v_l", "k_g", "v_g",
                      "k_scale", "v_scale", "k_l_scale", "v_l_scale",
                      "k_g_scale", "v_g_scale",
-                     "c", "kr", "c_scale", "kr_scale"):
+                     "c", "kr", "c_scale", "kr_scale",
+                     "c_pre", "kr_pre", "c_pre_scale", "kr_pre_scale"):
             if sect in cache:
                 out[sect] = cache[sect].at[:, slot].set(single[sect][:, 0])
         if "abs_pos" in cache:

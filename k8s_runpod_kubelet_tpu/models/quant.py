@@ -125,10 +125,12 @@ def quantized_logical_axes(cfg: LlamaConfig, bits: int = 8) -> Params:
 
     out: Params = {"tok_embed": base["tok_embed"],
                    "final_norm": base["final_norm"]}
-    out["layers"] = {
-        name: (q_axes(axes) if name in quantized else axes)
-        for name, axes in base["layers"].items()
-    }
+    for stack in ("layers", "prefix_layers"):
+        if stack in base:
+            out[stack] = {
+                name: (q_axes(axes) if name in quantized else axes)
+                for name, axes in base[stack].items()
+            }
     if "lm_head" in base:
         out["lm_head"] = q_axes(base["lm_head"])
     return out
@@ -158,21 +160,25 @@ def quantize_params(cfg: LlamaConfig, params: Params,
                                       np.dtype(cfg.dtype) if not commit
                                       else cfg.dtype),
                    "final_norm": place(params["final_norm"])}
-    layers = {}
-    for name, w in params["layers"].items():
-        if name in _LAYER_WEIGHTS or (bits == 8 and name in _EXPERT_WEIGHTS):
-            leaf = quant(w)
-            layers[name] = (jax.tree_util.tree_map(jnp.asarray, leaf)
-                            if commit else leaf)
-        elif name in ("w_uk", "w_uv"):
-            # MLA up-projections: unquantized (absorbed decode consumes
-            # them via reshape+einsum, not _mm) but stored in the COMPUTE
-            # dtype — f32 would double their HBM reads for nothing
-            layers[name] = place(w, np.dtype(cfg.dtype) if not commit
-                                 else cfg.dtype)
-        else:
-            layers[name] = place(w)
-    out["layers"] = layers
+    for stack in ("layers", "prefix_layers"):
+        if stack not in params:
+            continue
+        layers = {}
+        for name, w in params[stack].items():
+            if name in _LAYER_WEIGHTS or (bits == 8
+                                          and name in _EXPERT_WEIGHTS):
+                leaf = quant(w)
+                layers[name] = (jax.tree_util.tree_map(jnp.asarray, leaf)
+                                if commit else leaf)
+            elif name in ("w_uk", "w_uv"):
+                # MLA up-projections: unquantized (absorbed decode consumes
+                # them via reshape+einsum, not _mm) but stored in the
+                # COMPUTE dtype — f32 would double their HBM reads
+                layers[name] = place(w, np.dtype(cfg.dtype) if not commit
+                                     else cfg.dtype)
+            else:
+                layers[name] = place(w)
+        out[stack] = layers
     if "lm_head" in params:
         leaf = quant(params["lm_head"])
         out["lm_head"] = (jax.tree_util.tree_map(jnp.asarray, leaf)

@@ -211,7 +211,8 @@ def kv_cache_pspec(name: str, ndim: int):
     from ..parallel.mesh import AXES
     if name in ("index", "abs_pos"):
         return P()
-    if name in ("c", "kr", "c_scale", "kr_scale"):
+    if name in ("c", "kr", "c_scale", "kr_scale",
+                "c_pre", "kr_pre", "c_pre_scale", "kr_pre_scale"):
         # MLA latent cache: NO heads axis — every tensor shard's heads
         # attend over all positions' latents, so the cache replicates.
         # Even replicated it is 8-57x smaller than a tensor-sharded K/V

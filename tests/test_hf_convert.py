@@ -425,19 +425,93 @@ class TestDeepseekV2Parity:
                                        np.asarray(b, np.float32),
                                        rtol=1e-6, atol=1e-6)
 
-    def test_first_k_dense_rejected_loudly(self):
+    def _real_shape(self):
+        """The REAL V2-Lite layer layout: first_k_dense_replace=1 (dense
+        layer 0 at the wide MLP), MoE above it."""
         from transformers.models.deepseek_v2 import DeepseekV2Config
         from transformers.models.deepseek_v2.modeling_deepseek_v2 import (
             DeepseekV2ForCausalLM)
+        from k8s_runpod_kubelet_tpu.models import tiny_mla
+        torch.manual_seed(3)
         hf = DeepseekV2ForCausalLM(DeepseekV2Config(
             vocab_size=128, hidden_size=64, intermediate_size=112,
-            moe_intermediate_size=48, num_hidden_layers=2,
+            moe_intermediate_size=48, num_hidden_layers=3,
             num_attention_heads=4, num_key_value_heads=4, kv_lora_rank=32,
             q_lora_rank=None, qk_nope_head_dim=16, qk_rope_head_dim=8,
             v_head_dim=16, n_routed_experts=4, n_shared_experts=2,
-            num_experts_per_tok=2, first_k_dense_replace=1,  # real Lite
-            norm_topk_prob=False, attention_bias=False,
-            attn_implementation="eager"))
-        cfg, _ = self._tiny(n_experts=4, n_shared=2)
-        with pytest.raises(NotImplementedError, match="first_k_dense"):
-            load_hf(cfg, hf)
+            num_experts_per_tok=2, first_k_dense_replace=1,
+            norm_topk_prob=False, routed_scaling_factor=1.0,
+            max_position_embeddings=64, rope_theta=10_000.0,
+            rms_norm_eps=1e-6, tie_word_embeddings=False,
+            attention_bias=False, attn_implementation="eager"))
+        with torch.no_grad():  # decisive routing (empty-init gate)
+            for layer in hf.model.layers[1:]:
+                layer.mlp.gate.weight.normal_(
+                    0.0, 1.0, generator=torch.Generator().manual_seed(11))
+        cfg = _f32(tiny_mla(
+            vocab_size=128, embed_dim=64, n_layers=3, n_heads=4,
+            n_kv_heads=4, head_dim=16, mla_latent_dim=32, mla_rope_dim=8,
+            mlp_dim=48, max_seq_len=64, rope_theta=10_000.0, norm_eps=1e-6,
+            n_experts=4, n_experts_per_tok=2, n_shared_experts=2,
+            router_norm_topk=False, n_dense_prefix=1,
+            dense_prefix_mlp_dim=112,
+            # no-drop capacity so the TRAIN-mode forward (used as the
+            # prefill reference below) routes like inference does
+            capacity_factor=2.0))
+        return cfg, hf
+
+    def test_first_k_dense_real_shape_parity(self):
+        """Real V2-Lite checkpoints LOAD now (n_dense_prefix): dense layer
+        0 rides a separate prefix_layers stack scanned before the MoE
+        stack; logits match the HF reference (flip-tolerant on routing
+        near-ties, like the uniform-MoE test)."""
+        cfg, hf = self._real_shape()
+        hf.eval()
+        toks = _tokens(cfg.vocab_size)
+        with torch.no_grad():
+            ref = hf(torch.from_numpy(toks.astype(np.int64))).logits.numpy()
+        params = load_hf(cfg, hf)
+        assert "prefix_layers" in params
+        assert params["prefix_layers"]["w_gate"].shape == (1, 64, 112)
+        ours = np.asarray(LlamaModel(cfg).forward(params, jnp.asarray(toks)))
+        bad = np.abs(ours - ref) > 3e-3
+        assert np.any(bad, axis=-1).sum() <= 4   # routing near-ties only
+        ok = ~np.any(bad, axis=-1)
+        np.testing.assert_allclose(ours[ok], ref[ok], atol=5e-4, rtol=5e-4)
+
+    def test_first_k_dense_roundtrip_and_decode(self):
+        cfg, hf = self._real_shape()
+        params = load_hf(cfg, hf)
+        sd2 = to_hf_state_dict(cfg, params)
+        params2 = from_hf_state_dict(cfg, sd2)
+        import jax
+        for a, b in zip(jax.tree_util.tree_leaves(params),
+                        jax.tree_util.tree_leaves(params2)):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b, np.float32),
+                                       rtol=1e-6, atol=1e-6)
+        # absorbed decode from the latent cache, prefix rows included
+        model = LlamaModel(cfg)
+        toks = _tokens(cfg.vocab_size)[:1]
+        cache = model.init_cache(1, 48)
+        # prefix layers cache in their OWN sections (donation-friendly)
+        assert cache["c"].shape[0] == 2 and cache["c_pre"].shape[0] == 1
+        logits, cache = model.prefill(params, jnp.asarray(toks), cache)
+        full = model.forward(params, jnp.asarray(toks))
+        np.testing.assert_allclose(np.asarray(logits),
+                                   np.asarray(full[:, -1]),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_prefix_mismatch_rejected_loudly(self):
+        """Config says uniform MoE but the checkpoint has a dense layer 0
+        (or vice versa): metadata-level rejection with the fix named."""
+        cfg_real, hf_real = self._real_shape()
+        cfg_uniform, _ = self._tiny(n_experts=4, n_shared=2)
+        import dataclasses as _dc
+        cfg3 = _dc.replace(cfg_uniform, n_layers=3)
+        with pytest.raises(NotImplementedError, match="n_dense_prefix"):
+            load_hf(cfg3, hf_real)          # uniform cfg, prefixed ckpt
+        _, hf_uniform = self._tiny(n_experts=4, n_shared=2)
+        cfg2 = _dc.replace(cfg_real, n_layers=2)
+        with pytest.raises(NotImplementedError, match="n_dense_prefix"):
+            load_hf(cfg2, hf_uniform)       # prefixed cfg, uniform ckpt

@@ -388,3 +388,38 @@ class TestMlaSharded:
         rc = train_main.main(["--model", "tiny-mla", "--steps", "2",
                               "--batch", "2", "--seq-len", "32"])
         assert rc == 0
+
+
+class TestMlaPrefixEngine:
+    def test_engine_serves_dense_prefix_config(self):
+        """The serving engine runs a first_k_dense_replace-shaped model
+        (prefix_layers stack + MoE body) end to end: greedy output equals
+        the no-cache forward reference."""
+        cfg = tiny_mla(vocab_size=128, embed_dim=64, n_layers=3, n_heads=4,
+                       n_kv_heads=4, head_dim=16, mla_latent_dim=32,
+                       mla_rope_dim=8, mlp_dim=48, max_seq_len=256,
+                       n_experts=4, n_experts_per_tok=2, n_shared_experts=2,
+                       router_norm_topk=False, n_dense_prefix=1,
+                       dense_prefix_mlp_dim=112, capacity_factor=2.0,
+                       dtype=jnp.float32, param_dtype=jnp.float32)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        model = LlamaModel(cfg)
+
+        def ref(prompt, n_new):
+            tokens = list(prompt)
+            for _ in range(n_new):
+                lg = model.forward(params, jnp.asarray([tokens], jnp.int32))
+                tokens.append(int(jnp.argmax(lg[0, -1])))
+            return tokens[len(prompt):]
+
+        e = ServingEngine(cfg, params,
+                          ServingConfig(slots=2, max_prefill_len=32,
+                                        cache_len=64, max_new_tokens=8,
+                                        quantize_kv_int8=True,
+                                        speculate_k=2)).start()
+        try:
+            prompt = [5, 17, 99, 3, 5, 17]
+            got = e.submit(prompt, max_new_tokens=6).result(timeout=120)
+            assert got["tokens"] == ref(prompt, 6)
+        finally:
+            e.stop()

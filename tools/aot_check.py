@@ -662,10 +662,12 @@ def _quantized_abs_shapes(cfg, bits: int = 8):
 
     out = {"tok_embed": jax.ShapeDtypeStruct(params_abs["tok_embed"].shape,
                                              cfg.dtype),
-           "final_norm": params_abs["final_norm"],
-           "layers": {name: (q(sd) if name in quantized
-                             else passthrough(name, sd))
-                      for name, sd in params_abs["layers"].items()}}
+           "final_norm": params_abs["final_norm"]}
+    for stack in ("layers", "prefix_layers"):
+        if stack in params_abs:
+            out[stack] = {name: (q(sd) if name in quantized
+                                 else passthrough(name, sd))
+                          for name, sd in params_abs[stack].items()}
     if "lm_head" in params_abs:
         out["lm_head"] = q(params_abs["lm_head"])
     return out
