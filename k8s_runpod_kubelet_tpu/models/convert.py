@@ -100,12 +100,26 @@ def _mla_attn_from_hf(cfg: LlamaConfig, sd: Mapping[str, Any],
     L = cfg.n_layers
     hd, dr, r = cfg.head_dim_, cfg.mla_rope_dim, cfg.mla_latent_dim
     hn = cfg.n_heads
-    wq, wdkv, cnorm, wuk, wuv, wo = [], [], [], [], [], []
+    out_q: dict[str, list] = {}
+    wdkv, cnorm, wuk, wuv, wo = [], [], [], [], []
     for i in range(offset, offset + L):
         p = f"layers.{i}.self_attn."
-        q = _np(sd[p + "q_proj.weight"], dt).T          # (E, H*(dh+dr))
-        q = q.reshape(q.shape[0], hn, hd + dr)
-        wq.append(_rope_deinterleave(q, dr).reshape(q.shape[0], -1))
+        if cfg.mla_q_lora_rank is not None:
+            # low-rank q: q_a_proj -> wq_a, q_a_layernorm -> q_a_norm,
+            # q_b_proj -> wq_b (rope tail de-interleaved per head)
+            out_q.setdefault("w_qa", []).append(
+                _np(sd[p + "q_a_proj.weight"], dt).T)
+            out_q.setdefault("q_a_norm", []).append(
+                _np(sd[p + "q_a_layernorm.weight"], dt))
+            qb = _np(sd[p + "q_b_proj.weight"], dt).T   # (qr, H*(dh+dr))
+            qb = qb.reshape(qb.shape[0], hn, hd + dr)
+            out_q.setdefault("w_qb", []).append(
+                _rope_deinterleave(qb, dr).reshape(qb.shape[0], -1))
+        else:
+            q = _np(sd[p + "q_proj.weight"], dt).T      # (E, H*(dh+dr))
+            q = q.reshape(q.shape[0], hn, hd + dr)
+            out_q.setdefault("wq", []).append(
+                _rope_deinterleave(q, dr).reshape(q.shape[0], -1))
         a = _np(sd[p + "kv_a_proj_with_mqa.weight"], dt).T   # (E, r+dr)
         wdkv.append(_rope_deinterleave(a, dr))
         cnorm.append(_np(sd[p + "kv_a_layernorm.weight"], dt))
@@ -119,7 +133,8 @@ def _mla_attn_from_hf(cfg: LlamaConfig, sd: Mapping[str, Any],
         wuk.append(b[:, :, :hd].reshape(r, hn * hd))
         wuv.append(b[:, :, hd:].reshape(r, hn * hd))
         wo.append(_np(sd[p + "o_proj.weight"], dt).T)
-    return {"wq": np.stack(wq), "w_dkv": np.stack(wdkv),
+    return {**{name: np.stack(v) for name, v in out_q.items()},
+            "w_dkv": np.stack(wdkv),
             "c_norm": np.stack(cnorm), "w_uk": np.stack(wuk),
             "w_uv": np.stack(wuv), "wo": np.stack(wo)}
 
@@ -132,10 +147,17 @@ def _check_mla_keys(cfg: LlamaConfig, keys) -> None:
         return
     names = {k[len("model."):] if k.startswith("model.") else k
              for k in keys}
-    if "layers.0.self_attn.q_a_proj.weight" in names:
+    has_q_lora = "layers.0.self_attn.q_a_proj.weight" in names
+    if has_q_lora and cfg.mla_q_lora_rank is None:
         raise NotImplementedError(
-            "low-rank q (q_lora_rank, DeepSeek-V2 full) is not supported; "
-            "this config family models V2-Lite's full-rank q")
+            "checkpoint uses low-rank q (q_lora_rank, DeepSeek-V2 full) "
+            "but the config has mla_q_lora_rank=None; set it to the "
+            "checkpoint's q_lora_rank")
+    if not has_q_lora and cfg.mla_q_lora_rank is not None:
+        raise NotImplementedError(
+            f"config expects low-rank q (mla_q_lora_rank="
+            f"{cfg.mla_q_lora_rank}) but the checkpoint has a full-rank "
+            "q_proj; set mla_q_lora_rank=None")
     if cfg.n_experts and any(".mlp.experts." in k for k in names):
         kpre = cfg.n_dense_prefix
         for i in range(cfg.n_layers):
@@ -322,9 +344,20 @@ def to_hf_state_dict(cfg: LlamaConfig, params: Params) -> dict[str, np.ndarray]:
         if cfg_i.is_mla:
             hd, dr, r = cfg_i.head_dim_, cfg_i.mla_rope_dim, cfg_i.mla_latent_dim
             hn = cfg_i.n_heads
-            q = np.asarray(lp["wq"][i], np.float32).reshape(-1, hn, hd + dr)
-            put(gi, "self_attn.q_proj.weight",
-                _rope_reinterleave(q, dr).reshape(q.shape[0], -1).T)
+            if cfg_i.mla_q_lora_rank is not None:
+                put(gi, "self_attn.q_a_proj.weight",
+                    np.asarray(lp["w_qa"][i], np.float32).T)
+                put(gi, "self_attn.q_a_layernorm.weight",
+                    np.asarray(lp["q_a_norm"][i], np.float32))
+                qb = np.asarray(lp["w_qb"][i], np.float32).reshape(
+                    -1, hn, hd + dr)
+                put(gi, "self_attn.q_b_proj.weight",
+                    _rope_reinterleave(qb, dr).reshape(qb.shape[0], -1).T)
+            else:
+                q = np.asarray(lp["wq"][i], np.float32).reshape(
+                    -1, hn, hd + dr)
+                put(gi, "self_attn.q_proj.weight",
+                    _rope_reinterleave(q, dr).reshape(q.shape[0], -1).T)
             put(gi, "self_attn.kv_a_proj_with_mqa.weight",
                 _rope_reinterleave(
                     np.asarray(lp["w_dkv"][i], np.float32), dr).T)

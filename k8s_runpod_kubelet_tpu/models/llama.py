@@ -119,6 +119,10 @@ class LlamaConfig:
     # uses none of them). See ops/mla.py for the self-contained op.
     mla_latent_dim: Optional[int] = None
     mla_rope_dim: int = 64
+    # DeepSeek q_lora_rank: low-rank q projection (q = norm(h @ wq_a) @
+    # wq_b with q_a_layernorm between) — V2-full/V3 use it (1536); None =
+    # full-rank q (V2-Lite).
+    mla_q_lora_rank: Optional[int] = None
     # DeepSeek-MoE: this many always-on "shared" experts run as a dense
     # MLP of width n_shared_experts * mlp_dim alongside the routed experts
     # (their output is added, router ignores them). 0 = plain MoE/dense.
@@ -166,6 +170,10 @@ class LlamaConfig:
                 raise ValueError(f"n_dense_prefix {self.n_dense_prefix} must "
                                  f"leave MoE layers (n_layers "
                                  f"{self.n_layers})")
+        if self.mla_q_lora_rank is not None and not self.is_mla:
+            raise ValueError("mla_q_lora_rank requires MLA "
+                             "(set mla_latent_dim); on a plain-attention "
+                             "config the field would silently do nothing")
         if not self.is_mla:
             return
         bad = [f for f, on in (("sliding_window",
@@ -206,7 +214,11 @@ class LlamaConfig:
         hd = self.head_dim_
         if self.is_mla:
             r, dr, h = self.mla_latent_dim, self.mla_rope_dim, self.n_heads
-            attn = (e * h * (hd + dr)      # w_q
+            qr = self.mla_q_lora_rank
+            q_params = (e * qr + qr + qr * h * (hd + dr)  # wq_a/norm/wq_b
+                        if qr is not None
+                        else e * h * (hd + dr))           # full-rank wq
+            attn = (q_params
                     + e * (r + dr)         # w_dkv
                     + r                    # c_norm (kv_a_layernorm)
                     + 2 * r * h * hd       # w_uk, w_uv
@@ -374,9 +386,15 @@ def _layer_axes(cfg: LlamaConfig) -> dict:
         # every tensor-parallel shard reads the WHOLE latent cache — its
         # heads attend over all positions' latents — so only the per-head
         # dims (w_q / w_uk / w_uv outputs, w_o input) shard over tensor.
+        if cfg.mla_q_lora_rank is not None:
+            q_axes = {"w_qa": ("layer", "embed", "latent"),
+                      "q_a_norm": ("layer", "norm"),
+                      "w_qb": ("layer", "latent", "heads")}
+        else:
+            q_axes = {"wq": ("layer", "embed", "heads")}
         layer = {
             "attn_norm": ("layer", "norm"),
-            "wq": ("layer", "embed", "heads"),
+            **q_axes,
             "w_dkv": ("layer", "embed", "latent"),
             "c_norm": ("layer", "norm"),   # kv_a_layernorm, (r,) per layer
             "w_uk": ("layer", "latent", "heads"),
@@ -443,8 +461,16 @@ def _layer_shapes(cfg: LlamaConfig) -> dict:
     e, hd = cfg.embed_dim, cfg.head_dim_
     if cfg.is_mla:
         r, dr = cfg.mla_latent_dim, cfg.mla_rope_dim
+        qr = cfg.mla_q_lora_rank
+        if qr is not None:
+            q_shapes = {"w_qa": (cfg.n_layers, e, qr),
+                        "q_a_norm": (cfg.n_layers, qr),
+                        "w_qb": (cfg.n_layers, qr,
+                                 cfg.n_heads * (hd + dr))}
+        else:
+            q_shapes = {"wq": (cfg.n_layers, e, cfg.n_heads * (hd + dr))}
         attn_shapes = {
-            "wq": (cfg.n_layers, e, cfg.n_heads * (hd + dr)),
+            **q_shapes,
             "w_dkv": (cfg.n_layers, e, r + dr),
             "c_norm": (cfg.n_layers, r),
             "w_uk": (cfg.n_layers, r, cfg.n_heads * hd),
@@ -545,9 +571,11 @@ def init_params(cfg: LlamaConfig, key: jax.Array,
             fill = 0.0 if cfg.norm_zero_centered else 1.0
             for name in ("q_norm", "k_norm"):
                 lp[name] = jnp.full_like(lp[name], fill)
-        if cfg.is_mla:   # kv_a_layernorm: identity init ((L, r) ditto)
+        if cfg.is_mla:   # kv_a/q_a layernorms: identity init ((L, r) ditto)
             fill = 0.0 if cfg.norm_zero_centered else 1.0
             lp["c_norm"] = jnp.full_like(lp["c_norm"], fill)
+            if cfg.mla_q_lora_rank is not None:
+                lp["q_a_norm"] = jnp.full_like(lp["q_a_norm"], fill)
     if mesh is not None:
         axes = param_logical_axes(cfg)
         params = jax.tree_util.tree_map(
@@ -755,9 +783,18 @@ def _mla_project(h, lp, cfg: LlamaConfig, cos, sin, positions, b, s):
     latent before the up-projections (the rope key bypasses it). The
     NORMED latent is what gets cached — per-token and deterministic, so
     caching post-norm is equivalent to norming on every read, and the
-    absorbed decode's q_lat . c stays a plain dot."""
+    absorbed decode's q_lat . c stays a plain dot.
+
+    ``mla_q_lora_rank`` (V2-full/V3): q goes through its own low-rank
+    bottleneck — q = q_a_layernorm(h @ wq_a) @ wq_b — instead of wq."""
     hd, dr, r = cfg.head_dim_, cfg.mla_rope_dim, cfg.mla_latent_dim
-    q = _mm(h, lp["wq"], cfg.dtype).reshape(b, s, cfg.n_heads, hd + dr)
+    if cfg.mla_q_lora_rank is not None:
+        qa = _mm(h, lp["w_qa"], cfg.dtype)
+        qa = rms_norm(qa, _norm_w(lp["q_a_norm"], cfg), cfg.norm_eps)
+        q = _mm(qa, lp["w_qb"], cfg.dtype).reshape(b, s, cfg.n_heads,
+                                                   hd + dr)
+    else:
+        q = _mm(h, lp["wq"], cfg.dtype).reshape(b, s, cfg.n_heads, hd + dr)
     ckr = _mm(h, lp["w_dkv"], cfg.dtype)
     c, kr = ckr[..., :r], ckr[..., r:]
     c = rms_norm(c, _norm_w(lp["c_norm"], cfg), cfg.norm_eps)

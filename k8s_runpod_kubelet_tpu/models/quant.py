@@ -44,7 +44,7 @@ __all__ = ["quantize_params", "is_quantized", "quantized_logical_axes"]
 # via reshape+einsum (not _mm), and at (r, H*dh) they are tiny next to
 # the latent-cache reads the absorbed form exists to shrink.
 _LAYER_WEIGHTS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
-                  "w_dkv", "ws_gate", "ws_up", "ws_down")
+                  "w_dkv", "ws_gate", "ws_up", "ws_down", "w_qa", "w_qb")
 # expert weights: int8-only (moe.py's einsums handle {q8, scale}; the int4
 # unpack kernel is a 2D-matmul kernel and doesn't cover the expert path)
 _EXPERT_WEIGHTS = ("we_gate", "we_up", "we_down")

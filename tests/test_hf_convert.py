@@ -502,6 +502,50 @@ class TestDeepseekV2Parity:
                                    np.asarray(full[:, -1]),
                                    rtol=1e-3, atol=1e-3)
 
+    def test_q_lora_rank_parity(self):
+        """Low-rank q (DeepSeek-V2-full/V3's q_lora_rank): q_a_proj +
+        q_a_layernorm + q_b_proj map to wq_a/q_a_norm/wq_b with the rope
+        de-interleave on wq_b — logits parity against the HF reference."""
+        from transformers.models.deepseek_v2 import DeepseekV2Config
+        from transformers.models.deepseek_v2.modeling_deepseek_v2 import (
+            DeepseekV2ForCausalLM)
+        from k8s_runpod_kubelet_tpu.models import tiny_mla
+        torch.manual_seed(5)
+        hf = DeepseekV2ForCausalLM(DeepseekV2Config(
+            vocab_size=128, hidden_size=64, intermediate_size=112,
+            moe_intermediate_size=48, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=4, kv_lora_rank=32,
+            q_lora_rank=24, qk_nope_head_dim=16, qk_rope_head_dim=8,
+            v_head_dim=16, n_routed_experts=1, n_shared_experts=None,
+            num_experts_per_tok=2, first_k_dense_replace=99,  # all dense
+            norm_topk_prob=False, max_position_embeddings=64,
+            rope_theta=10_000.0, rms_norm_eps=1e-6,
+            tie_word_embeddings=False, attention_bias=False,
+            attn_implementation="eager"))
+        cfg = _f32(tiny_mla(
+            vocab_size=128, embed_dim=64, n_layers=2, n_heads=4,
+            n_kv_heads=4, head_dim=16, mla_latent_dim=32, mla_rope_dim=8,
+            mla_q_lora_rank=24, mlp_dim=112, max_seq_len=64,
+            rope_theta=10_000.0, norm_eps=1e-6))
+        _compare(cfg, hf)
+        # round-trip with the low-rank q leaves
+        params = load_hf(cfg, hf)
+        assert "w_qa" in params["layers"] and "wq" not in params["layers"]
+        sd2 = to_hf_state_dict(cfg, params)
+        params2 = from_hf_state_dict(cfg, sd2)
+        import jax
+        for a, b in zip(jax.tree_util.tree_leaves(params),
+                        jax.tree_util.tree_leaves(params2)):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b, np.float32),
+                                       rtol=1e-6, atol=1e-6)
+
+    def test_q_lora_mismatch_rejected(self):
+        cfg_full, hf_full = self._tiny()      # full-rank q checkpoint
+        import dataclasses as _dc
+        with pytest.raises(NotImplementedError, match="full-rank"):
+            load_hf(_dc.replace(cfg_full, mla_q_lora_rank=24), hf_full)
+
     def test_prefix_mismatch_rejected_loudly(self):
         """Config says uniform MoE but the checkpoint has a dense layer 0
         (or vice versa): metadata-level rejection with the fix named."""

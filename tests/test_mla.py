@@ -423,3 +423,46 @@ class TestMlaPrefixEngine:
             assert got["tokens"] == ref(prompt, 6)
         finally:
             e.stop()
+
+
+class TestMlaQLora:
+    QCFG = tiny_mla(vocab_size=128, embed_dim=64, n_layers=2, n_heads=4,
+                    n_kv_heads=4, head_dim=16, mla_latent_dim=32,
+                    mla_rope_dim=8, mla_q_lora_rank=24, mlp_dim=128,
+                    max_seq_len=256, dtype=jnp.float32,
+                    param_dtype=jnp.float32)
+
+    def test_absorbed_decode_and_int8_weights(self):
+        """Low-rank q through the ABSORBED decode path with int8 weights
+        (w_qa/w_qb quantize via _LAYER_WEIGHTS): engine greedy output
+        equals the full-precision no-cache forward."""
+        from k8s_runpod_kubelet_tpu.models.quant import quantize_params
+        params = init_params(self.QCFG, jax.random.PRNGKey(2))
+        model = LlamaModel(self.QCFG)
+
+        def ref(prompt, n_new):
+            toks = list(prompt)
+            for _ in range(n_new):
+                lg = model.forward(params, jnp.asarray([toks], jnp.int32))
+                toks.append(int(jnp.argmax(lg[0, -1])))
+            return toks[len(prompt):]
+
+        q = quantize_params(self.QCFG, params, bits=8)
+        assert "q8" in q["layers"]["w_qa"] and "q8" in q["layers"]["w_qb"]
+        prompt = [5, 17, 99, 3]
+        want = ref(prompt, 5)
+        cache = model.init_cache(1, 64)
+        logits, cache = model.prefill(q, jnp.asarray([prompt], jnp.int32),
+                                      cache)
+        out, tok = [], jnp.argmax(logits, -1)
+        for _ in range(5):
+            out.append(int(tok[0]))
+            logits, cache = model.decode_step(q, tok, cache)
+            tok = jnp.argmax(logits, -1)
+        assert out == want
+
+    def test_q_lora_requires_mla(self):
+        from k8s_runpod_kubelet_tpu.models import tiny_llama
+        with pytest.raises(ValueError, match="mla_q_lora_rank requires"):
+            init_params(tiny_llama(mla_q_lora_rank=24),
+                        jax.random.PRNGKey(0))
