@@ -268,6 +268,10 @@ def _hf_layer_stack(cfg: LlamaConfig, sd: Mapping[str, Any], dt: np.dtype,
         if deepseek_moe:  # prefix consistency enforced by _check_mla_keys
             layers["router"] = _stack(sd, pre + "mlp.gate.weight", L, dt,
                                       transpose=True, offset=offset)
+            if cfg.router_sigmoid_bias:  # V3 e_score_correction_bias
+                layers["router_bias"] = _stack(
+                    sd, pre + "mlp.gate.e_score_correction_bias", L,
+                    np.dtype(np.float32), offset=offset)
             names = ("gate_proj", "up_proj", "down_proj")
             expert_fmt = "layers.{i}.mlp.experts.{e}.{w}.weight"
         else:
@@ -393,6 +397,9 @@ def to_hf_state_dict(cfg: LlamaConfig, params: Params) -> dict[str, np.ndarray]:
             if cfg_i.is_mla:
                 put(gi, "mlp.gate.weight",
                     np.asarray(lp["router"][i], np.float32).T)
+                if cfg_i.router_sigmoid_bias:
+                    put(gi, "mlp.gate.e_score_correction_bias",
+                        np.asarray(lp["router_bias"][i], np.float32))
                 for e in range(cfg_i.n_experts):
                     put(gi, f"mlp.experts.{e}.gate_proj.weight",
                         np.asarray(lp["we_gate"][i, e], np.float32).T)

@@ -88,6 +88,15 @@ class LlamaConfig:
     # False (DeepSeek-V2-Lite norm_topk_prob=false): combine with the raw
     # softmax-over-all-experts probabilities of the selected k
     router_norm_topk: bool = True
+    # DeepSeek-V3 routing: sigmoid scores with an aux-free-balancing
+    # correction bias (a PARAM leaf "router_bias", updated outside the
+    # gradient) and group-limited selection over router_n_group groups,
+    # keeping router_topk_group; combine weights scale by
+    # routed_scaling_factor. moe.route_top_k_v3 is the exact math.
+    router_sigmoid_bias: bool = False
+    router_n_group: int = 0
+    router_topk_group: int = 0
+    routed_scaling_factor: float = 1.0
     router_aux_coef: float = 0.02       # load-balance loss coefficient
     router_z_coef: float = 1e-3         # router z-loss coefficient
     # pipeline parallelism: microbatch count when the mesh has a stage axis
@@ -174,6 +183,22 @@ class LlamaConfig:
             raise ValueError("mla_q_lora_rank requires MLA "
                              "(set mla_latent_dim); on a plain-attention "
                              "config the field would silently do nothing")
+        if self.router_sigmoid_bias:
+            ng, tg = self.router_n_group, self.router_topk_group
+            if not self.n_experts:
+                raise ValueError("router_sigmoid_bias needs a MoE config "
+                                 "(n_experts > 0); on a dense MLP it would "
+                                 "silently do nothing")
+            if ng <= 0 or tg <= 0 or tg > ng or self.n_experts % ng:
+                raise ValueError(
+                    f"V3 routing needs 0 < router_topk_group "
+                    f"({tg}) <= router_n_group ({ng}) and n_experts "
+                    f"({self.n_experts}) divisible by router_n_group")
+            if self.n_experts_per_tok > (self.n_experts // ng) * tg:
+                raise ValueError(
+                    f"n_experts_per_tok {self.n_experts_per_tok} exceeds "
+                    f"the {(self.n_experts // ng) * tg} experts the "
+                    "group-limited selection keeps eligible")
         if not self.is_mla:
             return
         bad = [f for f, on in (("sliding_window",
@@ -232,6 +257,8 @@ class LlamaConfig:
         if self.n_experts:
             mlp = 3 * e * m * self.n_experts + e * self.n_experts  # experts + router
             mlp += 3 * e * m * self.n_shared_experts
+            if self.router_sigmoid_bias:
+                mlp += self.n_experts   # e_score_correction_bias
         else:
             mlp = 3 * e * m
         norms = (4 if self.post_norms else 2) * e
@@ -355,6 +382,28 @@ def deepseek_v2_lite() -> LlamaConfig:
                        n_dense_prefix=1, dense_prefix_mlp_dim=10944)
 
 
+def deepseek_v3() -> LlamaConfig:
+    """DeepSeek-V3/R1-class: the V2 MLA (latent 512 + rope 64, heads
+    128x128, low-rank q 1536) with V3's sigmoid-scored, bias-corrected,
+    group-limited routing (256 experts top-8, 8 groups keep 4, scaling
+    2.5, renormalized) + 1 shared expert; first 3 layers dense at 18432.
+    671B total — a MULTI-HOST model: no single-chip or 8-chip AOT cell
+    exists on purpose; the config is here so checkpoints convert and the
+    tiny-geometry parity tests (test_hf_convert.py) pin the math."""
+    return LlamaConfig(name="deepseek-v3", vocab_size=129280,
+                       embed_dim=7168, n_layers=61, n_heads=128,
+                       n_kv_heads=128, head_dim=128, mlp_dim=2048,
+                       max_seq_len=163840, rope_theta=10_000.0,
+                       norm_eps=1e-6,
+                       mla_latent_dim=512, mla_rope_dim=64,
+                       mla_q_lora_rank=1536,
+                       n_experts=256, n_experts_per_tok=8,
+                       n_shared_experts=1, router_norm_topk=True,
+                       router_sigmoid_bias=True, router_n_group=8,
+                       router_topk_group=4, routed_scaling_factor=2.5,
+                       n_dense_prefix=3, dense_prefix_mlp_dim=18432)
+
+
 def tiny_mla(**kw) -> LlamaConfig:
     """Tiny MLA config for tests/CPU smoke: dense MLP under latent attention."""
     kw.setdefault("name", "tiny-mla")
@@ -428,6 +477,8 @@ def _layer_axes(cfg: LlamaConfig) -> dict:
             "we_up": ("layer", "expert", "embed", "mlp"),
             "we_down": ("layer", "expert", "mlp", "embed"),
         })
+        if cfg.router_sigmoid_bias:
+            layer.update({"router_bias": ("layer", "expert")})
         if cfg.n_shared_experts:
             layer.update({
                 "ws_gate": ("layer", "embed", "mlp"),
@@ -511,6 +562,8 @@ def _layer_shapes(cfg: LlamaConfig) -> dict:
             "we_up": (cfg.n_layers, cfg.n_experts, e, cfg.mlp_dim),
             "we_down": (cfg.n_layers, cfg.n_experts, cfg.mlp_dim, e),
         })
+        if cfg.router_sigmoid_bias:
+            layer.update({"router_bias": (cfg.n_layers, cfg.n_experts)})
         if cfg.n_shared_experts:
             sw = cfg.n_shared_experts * cfg.mlp_dim
             layer.update({
@@ -571,6 +624,8 @@ def init_params(cfg: LlamaConfig, key: jax.Array,
             fill = 0.0 if cfg.norm_zero_centered else 1.0
             for name in ("q_norm", "k_norm"):
                 lp[name] = jnp.full_like(lp[name], fill)
+        if cfg.router_sigmoid_bias and "router_bias" in lp:
+            lp["router_bias"] = jnp.zeros_like(lp["router_bias"])
         if cfg.is_mla:   # kv_a/q_a layernorms: identity init ((L, r) ditto)
             fill = 0.0 if cfg.norm_zero_centered else 1.0
             lp["c_norm"] = jnp.full_like(lp["c_norm"], fill)
@@ -915,7 +970,12 @@ def _mlp_block(x, lp, cfg: LlamaConfig, mesh, train: bool = True,
                              else cfg.n_experts / cfg.n_experts_per_tok),
             activation=_activation(cfg), dtype=cfg.dtype,
             constrain=(lambda t, axes: _constrain(t, mesh, axes)),
-            norm_topk=cfg.router_norm_topk)
+            norm_topk=cfg.router_norm_topk,
+            router_bias=(lp["router_bias"] if cfg.router_sigmoid_bias
+                         else None),
+            router_n_group=cfg.router_n_group,
+            router_topk_group=cfg.router_topk_group,
+            routed_scaling=cfg.routed_scaling_factor)
         aux = cfg.router_aux_coef * aux + cfg.router_z_coef * z
         if cfg.n_shared_experts:
             # DeepSeek-MoE shared experts: an always-on dense MLP (width

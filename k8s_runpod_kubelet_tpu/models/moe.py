@@ -50,6 +50,35 @@ def route_top_k(router_logits: jax.Array, k: int, norm_topk: bool = True
     return top_p, top_idx, probs
 
 
+def route_top_k_v3(router_logits: jax.Array, k: int, *,
+                   correction_bias: jax.Array, n_group: int,
+                   topk_group: int, norm_topk: bool,
+                   routed_scaling: float
+                   ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """DeepSeek-V3 routing: SIGMOID scores; selection adds the aux-free
+    load-balancing ``e_score_correction_bias`` and is GROUP-LIMITED
+    (per-group score = sum of its top-2 biased scores; only the best
+    ``topk_group`` groups' experts are eligible); combine weights gather
+    the RAW sigmoid scores at the chosen experts, renormalize over the k
+    (+1e-20), and scale by ``routed_scaling``. Returns (weights (G,k),
+    ids (G,k), raw scores (G,X))."""
+    g_tokens, x = router_logits.shape
+    scores = jax.nn.sigmoid(router_logits)                  # (G, X)
+    biased = scores + correction_bias[None, :]
+    per_group = biased.reshape(g_tokens, n_group, x // n_group)
+    group_scores = jnp.sum(jax.lax.top_k(per_group, 2)[0], axis=-1)
+    _, group_idx = jax.lax.top_k(group_scores, topk_group)  # (G, tg)
+    group_mask = jnp.sum(jax.nn.one_hot(group_idx, n_group,
+                                        dtype=biased.dtype), axis=1)
+    eligible = jnp.repeat(group_mask, x // n_group, axis=-1)
+    choice = jnp.where(eligible > 0, biased, 0.0)           # masked_fill 0
+    _, top_idx = jax.lax.top_k(choice, k)
+    top_w = jnp.take_along_axis(scores, top_idx, axis=1)    # RAW scores
+    if norm_topk:
+        top_w = top_w / (jnp.sum(top_w, axis=-1, keepdims=True) + 1e-20)
+    return top_w * routed_scaling, top_idx, scores
+
+
 def load_balance_loss(probs: jax.Array, top_idx: jax.Array,
                       n_experts: int, k: int) -> jax.Array:
     """Switch-transformer aux loss generalized to top-k: X · Σ_x f_x · p_x,
@@ -82,7 +111,9 @@ def _expert_w(w, dtype):
 def moe_mlp(h: jax.Array, router_w: jax.Array, we_gate,
             we_up, we_down, *, n_experts_per_tok: int,
             capacity_factor: float, activation, dtype, constrain=None,
-            norm_topk: bool = True
+            norm_topk: bool = True, router_bias=None,
+            router_n_group: int = 0, router_topk_group: int = 0,
+            routed_scaling: float = 1.0
             ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Sparse MoE MLP on normed activations.
 
@@ -102,7 +133,16 @@ def moe_mlp(h: jax.Array, router_w: jax.Array, we_gate,
 
     ht = h.reshape(g, e)
     router_logits = ht.astype(jnp.float32) @ router_w.astype(jnp.float32)
-    top_p, top_idx, probs = route_top_k(router_logits, k, norm_topk)
+    if router_bias is not None:
+        # DeepSeek-V3 sigmoid routing (aux-free balancing via the bias;
+        # the load-balance aux below is ZERO for this mode — V3 adjusts
+        # the bias outside the gradient instead of an aux loss)
+        top_p, top_idx, probs = route_top_k_v3(
+            router_logits, k, correction_bias=router_bias.astype(jnp.float32),
+            n_group=router_n_group, topk_group=router_topk_group,
+            norm_topk=norm_topk, routed_scaling=routed_scaling)
+    else:
+        top_p, top_idx, probs = route_top_k(router_logits, k, norm_topk)
 
     # position of each (token, slot) assignment within its expert's buffer:
     # exclusive running count of earlier assignments to the same expert
@@ -146,8 +186,16 @@ def moe_mlp(h: jax.Array, router_w: jax.Array, we_gate,
                 * top_p.reshape(g, k, 1).astype(h.dtype), axis=1)
     y = y.reshape(b, s, e)
 
-    aux = load_balance_loss(probs, top_idx, x_experts, k)
-    z = router_z_loss(router_logits)
+    if router_bias is not None:
+        # V3: aux-FREE balancing (the bias is adjusted outside the
+        # gradient) — both the load-balance aux AND the z-loss are zero;
+        # a softmax-style logsumexp pull on sigmoid logits would shift
+        # the score/bias balance the recipe depends on
+        aux = jnp.float32(0.0)
+        z = jnp.float32(0.0)
+    else:
+        aux = load_balance_loss(probs, top_idx, x_experts, k)
+        z = router_z_loss(router_logits)
     return y, aux, z
 
 

@@ -466,3 +466,26 @@ class TestMlaQLora:
         with pytest.raises(ValueError, match="mla_q_lora_rank requires"):
             init_params(tiny_llama(mla_q_lora_rank=24),
                         jax.random.PRNGKey(0))
+
+
+def test_prefix_cache_composes_with_latent_cache(mla_params):
+    """The engine's /prefix registry stores and re-inserts MLA latent
+    caches like any K/V cache (pytree-generic): two hits, outputs equal
+    the cold path's."""
+    e = ServingEngine(MCFG, mla_params,
+                      ServingConfig(slots=2, max_prefill_len=16,
+                                    cache_len=64, max_new_tokens=8)).start()
+    cold = ServingEngine(MCFG, mla_params,
+                         ServingConfig(slots=2, max_prefill_len=16,
+                                       cache_len=64,
+                                       max_new_tokens=8)).start()
+    try:
+        prefix = [7, 21, 3, 99, 14, 2, 81, 5, 40, 11]
+        e.register_prefix(prefix)
+        a = e.submit(prefix + [42], max_new_tokens=6).result(timeout=120)
+        b = cold.submit(prefix + [42], max_new_tokens=6).result(timeout=120)
+        assert a["tokens"] == b["tokens"]
+        assert "tpu_serving_prefix_hits_total 1" in e.metrics.render()
+    finally:
+        e.stop()
+        cold.stop()
