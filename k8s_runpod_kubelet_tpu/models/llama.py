@@ -375,6 +375,12 @@ def deepseek_v2_lite() -> LlamaConfig:
                        embed_dim=2048, n_layers=27, n_heads=16,
                        n_kv_heads=16, head_dim=128, mlp_dim=1408,
                        max_seq_len=32768, rope_theta=10_000.0,
+                       rope_scaling={"rope_type": "yarn", "factor": 40.0,
+                                     "beta_fast": 32, "beta_slow": 1,
+                                     "mscale": 0.707,
+                                     "mscale_all_dim": 0.707,
+                                     "original_max_position_embeddings":
+                                         4096},
                        norm_eps=1e-6,
                        mla_latent_dim=512, mla_rope_dim=64,
                        n_experts=64, n_experts_per_tok=6,
@@ -394,6 +400,11 @@ def deepseek_v3() -> LlamaConfig:
                        embed_dim=7168, n_layers=61, n_heads=128,
                        n_kv_heads=128, head_dim=128, mlp_dim=2048,
                        max_seq_len=163840, rope_theta=10_000.0,
+                       rope_scaling={"rope_type": "yarn", "factor": 40.0,
+                                     "beta_fast": 32, "beta_slow": 1,
+                                     "mscale": 1.0, "mscale_all_dim": 1.0,
+                                     "original_max_position_embeddings":
+                                         4096},
                        norm_eps=1e-6,
                        mla_latent_dim=512, mla_rope_dim=64,
                        mla_q_lora_rank=1536,
@@ -829,6 +840,23 @@ def _qkv(h, lp, cfg: LlamaConfig, b: int, s: int):
             v.reshape(b, s, cfg.n_kv_heads, hd))
 
 
+def yarn_mscale_sq(cfg: LlamaConfig) -> float:
+    """YaRN's other half: with rope_scaling mscale_all_dim, the attention
+    SOFTMAX scale multiplies by yarn_get_mscale(factor, mscale_all_dim)^2
+    (DeepseekV3Attention and DeepSeek's original remote code both apply
+    it; transformers' DeepseekV2 class omits it — we follow the original
+    semantics real checkpoints were trained with). 1.0 otherwise."""
+    sc = cfg.rope_scaling or {}
+    rt = sc.get("rope_type", sc.get("type"))
+    ms_all = sc.get("mscale_all_dim")
+    f = float(sc.get("factor", 1.0))
+    if rt != "yarn" or not ms_all or f <= 1:
+        return 1.0
+    import math
+    m = 0.1 * float(ms_all) * math.log(f) + 1.0
+    return m * m
+
+
 def _mla_project(h, lp, cfg: LlamaConfig, cos, sin, positions, b, s):
     """MLA projections: q_nope (B,S,H,dh), q_rope (B,S,H,dr) rotated,
     latent c (B,S,r) NORMED, shared rope key kr (B,S,dr) rotated. One
@@ -886,7 +914,7 @@ def _mla_attention_block(x, lp, cfg: LlamaConfig, cos, sin, mesh,
     q_full = _constrain(q_full, mesh, ("batch", "seq", "act_heads",
                                        "head_dim"))
     qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q_full, k_full, v_full))
-    scale = (hd + dr) ** -0.5
+    scale = (hd + dr) ** -0.5 * yarn_mscale_sq(cfg)
     if mesh is not None and mesh.shape.get(AXES.SEQ, 1) > 1:
         o = ring_attention(qt, kt, vt, mesh, causal=True, sm_scale=scale,
                            use_flash=cfg.ring_flash)
@@ -1746,7 +1774,7 @@ class LlamaModel:
         cache_len = cache["c"].shape[2]
         hd, dr, r = cfg.head_dim_, cfg.mla_rope_dim, cfg.mla_latent_dim
         hn = cfg.n_heads
-        scale = (hd + dr) ** -0.5
+        scale = (hd + dr) ** -0.5 * yarn_mscale_sq(cfg)
         # (B,1,K,L): query j of slot b sees committed positions <= idx[b]+j
         pos_l = jnp.arange(cache_len)[None, None, :]
         valid = (pos_l <= positions[:, :, None])[:, None]

@@ -7,6 +7,7 @@ scaling for long context.
 
 from __future__ import annotations
 
+import math
 from typing import Optional
 
 import jax
@@ -22,6 +23,7 @@ def rope_frequencies(head_dim: int, max_seq_len: int, theta: float = 500_000.0,
     linear position interpolation ({"rope_type": "linear", "factor": f} —
     Gemma-3 global layers): all frequencies divided by f.
     """
+    af = 1.0   # yarn attention factor folded into the tables (else 1)
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
     rope_type = (scaling or {}).get("rope_type",
                                     (scaling or {}).get("type", "llama3"))
@@ -29,11 +31,58 @@ def rope_frequencies(head_dim: int, max_seq_len: int, theta: float = 500_000.0,
         inv_freq = inv_freq / scaling.get("factor", 1.0)
     elif scaling and rope_type == "default":
         pass  # HF "default" = plain unscaled RoPE
+    elif scaling and rope_type == "yarn":
+        # YaRN (arXiv:2309.00071), transformers' _compute_yarn_parameters
+        # exactly — DeepSeek-V2/V3 ship rope_scaling type "yarn" (V2-Lite:
+        # factor 40 past a 4k original window), so real checkpoints need
+        # this for any context beyond original_max_position_embeddings.
+        # Per-dim blend between interpolation (freq/factor) and
+        # extrapolation (raw freq) over a linear ramp in "rotations at
+        # the original window", plus a global attention scaling folded
+        # into the cos/sin tables. NOTE: yarn with mscale_all_dim ALSO
+        # scales the attention softmax — that half lives at the attention
+        # call sites (llama.yarn_mscale_sq), not in these tables.
+        factor = float(scaling.get("factor", 1.0))
+        orig = float(scaling.get("original_max_position_embeddings",
+                                 scaling.get("original_max_position",
+                                             max_seq_len)))
+        beta_fast = float(scaling.get("beta_fast") or 32)
+        beta_slow = float(scaling.get("beta_slow") or 1)
+
+        def get_mscale(scale, ms=1.0):
+            return 1.0 if scale <= 1 else 0.1 * ms * math.log(scale) + 1.0
+
+        attention_factor = scaling.get("attention_factor")
+        if attention_factor is None:
+            ms = scaling.get("mscale")
+            ms_all = scaling.get("mscale_all_dim")
+            if ms and ms_all:
+                attention_factor = (get_mscale(factor, ms)
+                                    / get_mscale(factor, ms_all))
+            else:
+                attention_factor = get_mscale(factor)
+
+        def corr_dim(n_rot):
+            return (head_dim * math.log(orig / (n_rot * 2 * math.pi))
+                    / (2 * math.log(theta)))
+
+        low, high = corr_dim(beta_fast), corr_dim(beta_slow)
+        if scaling.get("truncate", True):
+            low, high = math.floor(low), math.ceil(high)
+        low, high = max(low, 0), min(high, head_dim - 1)
+        if low == high:
+            high += 0.001
+        ramp = jnp.clip((jnp.arange(head_dim // 2, dtype=jnp.float32)
+                         - low) / (high - low), 0, 1)
+        extrapolation_factor = 1.0 - ramp
+        inv_freq = ((inv_freq / factor) * (1 - extrapolation_factor)
+                    + inv_freq * extrapolation_factor)
+        af = float(attention_factor)
     elif scaling and rope_type != "llama3":
-        # refuse to silently misread a yarn/dynamic/... dict as the Llama-3.1
+        # refuse to silently misread a dynamic/... dict as the Llama-3.1
         # recipe — wrong tables degrade logits without erroring anywhere
         raise ValueError(f"unsupported rope_scaling type {rope_type!r} "
-                         "(supported: linear, llama3, default)")
+                         "(supported: linear, llama3, yarn, default)")
     elif scaling:
         factor = scaling.get("factor", 8.0)
         low = scaling.get("low_freq_factor", 1.0)
@@ -54,6 +103,8 @@ def rope_frequencies(head_dim: int, max_seq_len: int, theta: float = 500_000.0,
         inv_freq = scaled
     t = jnp.arange(max_seq_len, dtype=jnp.float32)
     freqs = jnp.outer(t, inv_freq)  # (S, D/2)
+    if af != 1.0:
+        return jnp.cos(freqs) * af, jnp.sin(freqs) * af
     return jnp.cos(freqs), jnp.sin(freqs)
 
 

@@ -672,3 +672,88 @@ def test_v3_routing_fields_validated():
     with pytest.raises(ValueError, match="n_experts > 0"):
         init_params(tiny_mla(router_sigmoid_bias=True),
                     jax.random.PRNGKey(0))
+
+
+def test_deepseek_yarn_rope_parity():
+    """Real DeepSeek checkpoints ship rope_scaling type 'yarn' (V2-Lite:
+    factor 40 past 4k). Pin ops/rope.py's yarn branch against the HF
+    reference with S well past original_max_position_embeddings, incl.
+    the mscale/mscale_all_dim attention factor."""
+    from transformers.models.deepseek_v2 import DeepseekV2Config
+    from transformers.models.deepseek_v2.modeling_deepseek_v2 import (
+        DeepseekV2ForCausalLM)
+    from k8s_runpod_kubelet_tpu.models import tiny_mla
+    torch.manual_seed(9)
+    # no mscale keys -> attention_factor = 0.1*ln(4)+1 = 1.139: a yarn
+    # branch that dropped the cos/sin scaling would fail this (DeepSeek's
+    # shipped mscale == mscale_all_dim makes the factor 1.0 — covered by
+    # the same formula but it would hide that bug)
+    yarn = {"rope_type": "yarn", "factor": 4.0, "beta_fast": 32,
+            "beta_slow": 1,
+            "original_max_position_embeddings": 16}
+    hf = DeepseekV2ForCausalLM(DeepseekV2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        moe_intermediate_size=48, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=4, kv_lora_rank=32,
+        q_lora_rank=None, qk_nope_head_dim=16, qk_rope_head_dim=8,
+        v_head_dim=16, n_routed_experts=1, n_shared_experts=None,
+        num_experts_per_tok=2, first_k_dense_replace=99,
+        norm_topk_prob=False, max_position_embeddings=64,
+        rope_theta=10_000.0, rope_scaling=dict(yarn), rms_norm_eps=1e-6,
+        tie_word_embeddings=False, attention_bias=False,
+        attn_implementation="eager"))
+    hf.eval()
+    cfg = _f32(tiny_mla(
+        vocab_size=128, embed_dim=64, n_layers=2, n_heads=4,
+        n_kv_heads=4, head_dim=16, mla_latent_dim=32, mla_rope_dim=8,
+        mlp_dim=112, max_seq_len=64, rope_theta=10_000.0, norm_eps=1e-6,
+        rope_scaling=dict(yarn)))
+    rng = np.random.default_rng(3)
+    toks = rng.integers(0, 128, (2, 48)).astype(np.int32)  # past orig=16
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(toks.astype(np.int64))).logits.numpy()
+    params = load_hf(cfg, hf)
+    ours = np.asarray(LlamaModel(cfg).forward(params, jnp.asarray(toks)))
+    np.testing.assert_allclose(ours, ref, atol=5e-4, rtol=5e-4)
+
+
+def test_v3_yarn_mscale_attention_scale_parity():
+    """YaRN's OTHER half: mscale_all_dim multiplies the attention softmax
+    scale by yarn_get_mscale(factor, mscale_all_dim)^2. Pinned against
+    DeepseekV3ForCausalLM (which applies it; transformers' V2 class
+    omits it — we follow the original-checkpoint semantics)."""
+    from transformers.models.deepseek_v3 import DeepseekV3Config
+    from transformers.models.deepseek_v3.modeling_deepseek_v3 import (
+        DeepseekV3ForCausalLM)
+    from k8s_runpod_kubelet_tpu.models import tiny_mla
+    torch.manual_seed(8)
+    yarn = {"rope_type": "yarn", "factor": 4.0, "beta_fast": 32,
+            "beta_slow": 1, "mscale": 1.0, "mscale_all_dim": 1.0,
+            "original_max_position_embeddings": 16}
+    hf = DeepseekV3ForCausalLM(DeepseekV3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        moe_intermediate_size=48, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=4, kv_lora_rank=32,
+        q_lora_rank=24, qk_nope_head_dim=16, qk_rope_head_dim=8,
+        v_head_dim=16, n_routed_experts=8, n_shared_experts=1,
+        num_experts_per_tok=2, n_group=4, topk_group=2,
+        norm_topk_prob=True, routed_scaling_factor=2.5,
+        first_k_dense_replace=99,  # all dense: isolate attention scaling
+        max_position_embeddings=64, rope_theta=10_000.0,
+        rope_scaling=dict(yarn), rms_norm_eps=1e-6,
+        tie_word_embeddings=False, attention_bias=False,
+        attn_implementation="eager"))
+    hf.eval()
+    cfg = _f32(tiny_mla(
+        vocab_size=128, embed_dim=64, n_layers=2, n_heads=4,
+        n_kv_heads=4, head_dim=16, mla_latent_dim=32, mla_rope_dim=8,
+        mla_q_lora_rank=24, mlp_dim=112, max_seq_len=64,
+        rope_theta=10_000.0, norm_eps=1e-6, rope_scaling=dict(yarn)))
+    rng = np.random.default_rng(4)
+    toks = rng.integers(0, 128, (2, 48)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(toks.astype(np.int64))).logits.numpy()
+    params = load_hf(cfg, hf)
+    ours = np.asarray(LlamaModel(cfg).forward(params, jnp.asarray(toks)))
+    # mscale^2 at factor 4 is 1.139^2 = 1.30: omitting it fails loudly
+    np.testing.assert_allclose(ours, ref, atol=5e-4, rtol=5e-4)
