@@ -130,6 +130,29 @@ def _child() -> int:
     return 0
 
 
+def _tcp_connect_report(ports: list[int], timeout_s: float = 3.0) -> dict:
+    """Can we complete a TCP handshake with each candidate relay port?
+    Distinguishes 'relay process gone' (connect refused) from 'relay up
+    but the pool grant never arrives' (connect ok, PJRT init still
+    hangs) — the difference decides whether restarting the relay could
+    help at all. Tries IPv4 then IPv6 loopback (the listener may be
+    bound to either family)."""
+    import socket
+    out = {}
+    for port in ports:
+        last = ""
+        for host in ("127.0.0.1", "::1"):
+            try:
+                with socket.create_connection((host, port),
+                                              timeout=timeout_s):
+                    last = "connect_ok"
+                    break
+            except OSError as e:
+                last = f"{type(e).__name__}: {e}"[:120]
+        out[port] = last
+    return out
+
+
 def _listening_ports() -> list[int]:
     """Local listening TCP ports from /proc/net/tcp{,6} (no psutil). The
     axon relay lives on localhost — if nothing is listening, the PJRT dial
@@ -217,6 +240,11 @@ def main() -> int:
                        "PALLAS_AXON_TPU_GEN")},
               "listening_ports": _listening_ports(),
               "variants": []}
+    # connect-probe only a bounded, relay-plausible subset: every listener
+    # on the box would block ~3s each and poke unrelated services (ssh
+    # forwards, one-shot accept loops)
+    report["tcp_connect"] = _tcp_connect_report(
+        report["listening_ports"][:8])
     for name, overrides, deletes, expect in _VARIANTS:
         rec = run_variant(name, overrides, deletes, budget, expect)
         report["variants"].append(rec)
