@@ -12,7 +12,7 @@
 """
 
 from .llama import (LlamaConfig, LlamaModel, llama3_8b, llama3_70b, llama31_8b, gemma_7b,
-                    gemma2_9b, gemma3_12b, mixtral_8x7b, mistral_7b, qwen2_7b,
+                    gemma2_9b, gemma3_12b, mixtral_8x7b, mistral_7b, qwen2_7b, qwen3_8b,
                     deepseek_v2_lite, deepseek_v3, tiny_llama, tiny_moe, tiny_mla, init_params, param_logical_axes)
 from .mnist import MnistCNN, mnist_config
 from .moe import moe_mlp, moe_mlp_dense_reference, moe_capacity
@@ -20,9 +20,22 @@ from .convert import load_hf, from_hf_state_dict, to_hf_state_dict
 from .quant import quantize_params, is_quantized
 from .lora import LoraConfig, apply_lora, merge_lora, lora_mask, lora_param_count
 
+# One name-keyed registry consumed by BOTH CLIs (serve_main/train_main)
+# for argparse choices AND dispatch — adding a model is one entry here,
+# not six coordinated edits across three files.
+MODEL_CONFIGS = {
+    "llama3-8b": llama3_8b, "llama3-70b": llama3_70b,
+    "llama31-8b": llama31_8b,
+    "gemma-7b": gemma_7b, "gemma2-9b": gemma2_9b, "gemma3-12b": gemma3_12b,
+    "mixtral-8x7b": mixtral_8x7b, "mistral-7b": mistral_7b,
+    "qwen2-7b": qwen2_7b, "qwen3-8b": qwen3_8b,
+    "deepseek-v2-lite": deepseek_v2_lite, "deepseek-v3": deepseek_v3,
+    "tiny": tiny_llama, "tiny-moe": tiny_moe, "tiny-mla": tiny_mla,
+}
+
 __all__ = ["LlamaConfig", "LlamaModel", "llama3_8b", "llama3_70b", "llama31_8b", "gemma_7b",
-           "gemma2_9b", "gemma3_12b", "mixtral_8x7b", "mistral_7b", "qwen2_7b",
-           "deepseek_v2_lite", "deepseek_v3", "tiny_llama", "tiny_moe", "tiny_mla", "init_params",
+           "gemma2_9b", "gemma3_12b", "mixtral_8x7b", "mistral_7b", "qwen2_7b", "qwen3_8b",
+           "deepseek_v2_lite", "deepseek_v3", "tiny_llama", "tiny_moe", "tiny_mla", "MODEL_CONFIGS", "init_params",
            "param_logical_axes", "MnistCNN", "mnist_config", "moe_mlp",
            "moe_mlp_dense_reference", "moe_capacity", "load_hf",
            "from_hf_state_dict", "to_hf_state_dict", "quantize_params",

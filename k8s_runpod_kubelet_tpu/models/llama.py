@@ -426,6 +426,15 @@ def tiny_mla(**kw) -> LlamaConfig:
     return dataclasses.replace(LlamaConfig(), **kw)
 
 
+def qwen3_8b() -> LlamaConfig:
+    """Qwen3-8B: Llama-shaped GQA decoder with per-head-dim RMSNorm on
+    q/k before RoPE (the Gemma-3-style qk_norm flag, no biases)."""
+    return LlamaConfig(name="qwen3-8b", vocab_size=151936, embed_dim=4096,
+                       n_layers=36, n_heads=32, n_kv_heads=8, head_dim=128,
+                       mlp_dim=12288, max_seq_len=32768,
+                       rope_theta=1_000_000.0, norm_eps=1e-6, qk_norm=True)
+
+
 def tiny_llama(**kw) -> LlamaConfig:
     return dataclasses.replace(LlamaConfig(), **kw)
 

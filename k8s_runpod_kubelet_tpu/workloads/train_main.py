@@ -24,11 +24,9 @@ log = logging.getLogger("train-main")
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
+    from ..models import MODEL_CONFIGS
     p.add_argument("--model", default="llama3-8b",
-                   choices=["llama3-8b", "llama3-70b", "llama31-8b", "gemma-7b",
-                            "gemma2-9b", "gemma3-12b", "mixtral-8x7b",
-                            "mistral-7b", "qwen2-7b", "deepseek-v2-lite",
-                            "tiny", "tiny-moe", "tiny-mla"])
+                   choices=list(MODEL_CONFIGS))
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--seq-len", type=int, default=2048)
@@ -93,22 +91,11 @@ def main(argv=None) -> int:
     if args.profiler_port:
         jax.profiler.start_server(args.profiler_port)
         log.info("jax profiler server on :%d", args.profiler_port)
-    from ..models import (llama3_8b, llama3_70b, llama31_8b, gemma_7b, gemma2_9b,
-                          gemma3_12b, mixtral_8x7b, mistral_7b, qwen2_7b,
-                          deepseek_v2_lite, tiny_llama, tiny_moe,
-                          tiny_mla)
     from ..parallel import MeshConfig, make_mesh
     from ..workloads.train import TrainConfig, Trainer
 
     n = jax.device_count()
-    cfg = {"llama3-8b": llama3_8b, "llama3-70b": llama3_70b,
-           "llama31-8b": llama31_8b,
-           "gemma-7b": gemma_7b, "gemma2-9b": gemma2_9b,
-           "gemma3-12b": gemma3_12b, "mixtral-8x7b": mixtral_8x7b,
-           "mistral-7b": mistral_7b, "qwen2-7b": qwen2_7b,
-           "deepseek-v2-lite": deepseek_v2_lite,
-           "tiny": tiny_llama, "tiny-moe": tiny_moe,
-           "tiny-mla": tiny_mla}[args.model]()
+    cfg = MODEL_CONFIGS[args.model]()
     if args.stage > 1:
         if cfg.n_layers % args.stage:
             raise SystemExit(f"--stage {args.stage} must divide "

@@ -757,3 +757,29 @@ def test_v3_yarn_mscale_attention_scale_parity():
     ours = np.asarray(LlamaModel(cfg).forward(params, jnp.asarray(toks)))
     # mscale^2 at factor 4 is 1.139^2 = 1.30: omitting it fails loudly
     np.testing.assert_allclose(ours, ref, atol=5e-4, rtol=5e-4)
+
+
+def test_qwen3_qk_norm_parity():
+    """Qwen3: per-head-dim RMSNorm on q/k before RoPE, no biases — maps
+    onto the qk_norm flag; logits parity against Qwen3ForCausalLM."""
+    torch.manual_seed(10)
+    hf = transformers.Qwen3ForCausalLM(transformers.Qwen3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64, rope_theta=10_000.0,
+        rms_norm_eps=1e-6, tie_word_embeddings=False,
+        attn_implementation="eager"))
+    assert "model.layers.0.self_attn.q_norm.weight" in hf.state_dict()
+    cfg = _f32(tiny_llama(vocab_size=128, embed_dim=64, n_layers=2,
+                          n_heads=4, n_kv_heads=2, head_dim=16,
+                          mlp_dim=112, max_seq_len=64,
+                          rope_theta=10_000.0, norm_eps=1e-6,
+                          qk_norm=True))
+    _compare(cfg, hf)
+
+
+def test_qwen3_8b_config_faithful():
+    from k8s_runpod_kubelet_tpu.models import qwen3_8b
+    cfg = qwen3_8b()
+    assert cfg.qk_norm and not cfg.qkv_bias
+    assert cfg.param_count == pytest.approx(8.2e9, rel=0.02)
