@@ -96,6 +96,11 @@ _STAGED_QUEUE = [
     ("serve_8b_int4",
      ["--serve", "--model", "llama3-8b", "--int4", "--kv-int8"], 2400),
     ("econ", ["--econ"], 2400),
+    # MLA latent-cache serving at the 8B weight class: the architecture
+    # A/B against serve_8b (same class; int8 cache 18.4KB/token over 32
+    # layers vs llama3-8b's 64KB K+V — 3.5x fewer cache bytes)
+    ("serve_mla_8b",
+     ["--serve", "--model", "mla-8b", "--int8", "--kv-int8"], 2400),
     ("ring_flash", ["--ring-flash"], 1800),
     ("spec_drift", ["--spec-drift"], 2400),
     # VERDICT r3 item 2: if the sweep tops out short of 0.40 MFU, the claim
@@ -437,8 +442,9 @@ def _serve_model(name: str):
         return _bench_config(tiny=False)
     if name == "tiny":
         return _bench_config(tiny=True)
+    from k8s_runpod_kubelet_tpu.models import mla_8b
     table = {"llama3-8b": llama3_8b, "mistral-7b": mistral_7b,
-             "gemma2-9b": gemma2_9b}
+             "gemma2-9b": gemma2_9b, "mla-8b": mla_8b}
     if name not in table:  # parseable error, not a KeyError traceback
         _emit({"metric": "serving_tokens_per_sec", "value": None,
                "error": f"unknown --model {name!r}; choose from "

@@ -13,7 +13,7 @@
 
 from .llama import (LlamaConfig, LlamaModel, llama3_8b, llama3_70b, llama31_8b, gemma_7b,
                     gemma2_9b, gemma3_12b, mixtral_8x7b, mistral_7b, qwen2_7b, qwen3_8b,
-                    deepseek_v2_lite, deepseek_v3, tiny_llama, tiny_moe, tiny_mla, init_params, param_logical_axes)
+                    deepseek_v2_lite, deepseek_v3, mla_8b, tiny_llama, tiny_moe, tiny_mla, init_params, param_logical_axes)
 from .mnist import MnistCNN, mnist_config
 from .moe import moe_mlp, moe_mlp_dense_reference, moe_capacity
 from .convert import load_hf, from_hf_state_dict, to_hf_state_dict
@@ -35,7 +35,7 @@ MODEL_CONFIGS = {
 
 __all__ = ["LlamaConfig", "LlamaModel", "llama3_8b", "llama3_70b", "llama31_8b", "gemma_7b",
            "gemma2_9b", "gemma3_12b", "mixtral_8x7b", "mistral_7b", "qwen2_7b", "qwen3_8b",
-           "deepseek_v2_lite", "deepseek_v3", "tiny_llama", "tiny_moe", "tiny_mla", "MODEL_CONFIGS", "init_params",
+           "deepseek_v2_lite", "deepseek_v3", "mla_8b", "tiny_llama", "tiny_moe", "tiny_mla", "MODEL_CONFIGS", "init_params",
            "param_logical_axes", "MnistCNN", "mnist_config", "moe_mlp",
            "moe_mlp_dense_reference", "moe_capacity", "load_hf",
            "from_hf_state_dict", "to_hf_state_dict", "quantize_params",

@@ -415,6 +415,20 @@ def deepseek_v3() -> LlamaConfig:
                        n_dense_prefix=3, dense_prefix_mlp_dim=18432)
 
 
+def mla_8b() -> LlamaConfig:
+    """8B-CLASS MLA benchmark geometry: llama3-8b's body (32L, 4096 wide,
+    14336 MLP, 128k vocab) with V2-Lite MLA attention (latent 512 + rope
+    64 at 32x128 heads) — the architecture A/B against llama3-8b at
+    matched weight class (8.25B). ONE definition: bench.py --serve and
+    tools/aot_check.py both consume this, so the AOT memory-fit proof
+    can never drift from the model the staged serve step runs."""
+    return LlamaConfig(name="mla-8b", vocab_size=128256, embed_dim=4096,
+                       n_layers=32, n_heads=32, n_kv_heads=32,
+                       head_dim=128, mla_latent_dim=512, mla_rope_dim=64,
+                       mlp_dim=14336, max_seq_len=8192,
+                       rope_theta=500_000.0)
+
+
 def tiny_mla(**kw) -> LlamaConfig:
     """Tiny MLA config for tests/CPU smoke: dense MLP under latent attention."""
     kw.setdefault("name", "tiny-mla")

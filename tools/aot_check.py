@@ -439,6 +439,17 @@ def check_serving_alt(results, dev):
                 2, 6144, (c.sliding_window or 4096) + 512, quantize=True),
             2, "mixed cache: local sublayers ring at window+slack, global "
                "full 6k; 2 slots, int8 weights + int8 KV"))
+    # MLA at the 8B weight class on ONE chip (the serve_mla_8b staged
+    # step's geometry — models.mla_8b, the SAME definition bench.py
+    # serves): int8 weights + int8 LATENT cache — memory-fit
+    # compile-proven so the watcher step can't OOM-surprise
+    results["decode_mla8b_int8_kv8"] = _run(
+        "decode_mla8b_int8_kv8",
+        lambda: decode_prog(
+            "mla_8b",
+            lambda m, c: m.init_cache(8, 2048, quantize=True),
+            8, "MLA absorbed decode, 8B weight class, int8 weights + "
+               "int8 latent cache, 8 slots"))
     results["decode_mistral_7b_ring_int8kv"] = _run(
         "decode_mistral_7b_ring_int8kv",
         lambda: decode_prog(
