@@ -182,7 +182,11 @@ class ExecCredentialPlugin(_CachingProvider):
                 f"exec plugin {self.command!r} returned no status.token "
                 "(client-cert exec credentials are not supported)")
         exp = status.get("expirationTimestamp")
-        lifetime = (max(0.0, _parse_rfc3339(exp) - time.time()) if exp
+        # lifetime against the INJECTED clock (self._now), not wall time:
+        # _CachingProvider's cache/skew bookkeeping runs on self._now, so
+        # a wall-clock lifetime would disagree with it under injected or
+        # adjusted clocks (ADVICE r5)
+        lifetime = (max(0.0, _parse_rfc3339(exp) - self._now()) if exp
                     else float("inf"))   # no expiry = process lifetime
         return token, lifetime
 
@@ -257,7 +261,10 @@ class RealKubeClient(KubeClient):
         static ``token``, client certificates, and ``exec`` credential
         plugins (gke-gcloud-auth-plugin et al). Inline base64 ``*-data``
         fields (how GKE ships its CA and certs) are materialized to
-        private temp files for ssl."""
+        private temp files for ssl. Relative ``certificate-authority``/
+        ``client-certificate``/``client-key`` paths resolve against the
+        kubeconfig file's directory, matching kubectl/client-go — as-is
+        they would only work when CWD happened to be that directory."""
         import yaml
         with open(path) as f:
             cfg = yaml.safe_load(f)
@@ -267,13 +274,18 @@ class RealKubeClient(KubeClient):
         user = next(u["user"] for u in cfg["users"] if u["name"] == ctx["user"])
 
         tempfiles: list[str] = []
+        base_dir = os.path.dirname(os.path.abspath(path))
+
+        def resolve(p: str) -> str:
+            return os.path.join(base_dir, p) if p and not os.path.isabs(p) \
+                else p
 
         def field(obj: dict, name: str, suffix: str) -> str:
             if obj.get(f"{name}-data"):
                 path_ = _b64_to_tempfile(obj[f"{name}-data"], suffix)
                 tempfiles.append(path_)
                 return path_
-            return obj.get(name, "")
+            return resolve(obj.get(name, ""))
 
         provider = None
         if "exec" in user:
@@ -300,7 +312,7 @@ class RealKubeClient(KubeClient):
             return cls(
                 cluster["server"],
                 token=user.get("token", ""),
-                ca_file=cluster.get("certificate-authority", ""),
+                ca_file=resolve(cluster.get("certificate-authority", "")),
                 ca_data=ca_data,
                 client_cert=field(user, "client-certificate", ".crt"),
                 client_key=field(user, "client-key", ".key"),

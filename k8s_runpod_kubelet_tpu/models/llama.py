@@ -370,11 +370,14 @@ def deepseek_v2_lite() -> LlamaConfig:
     expert width 1408), with the real checkpoint's FIRST layer dense at
     width 10944 (first_k_dense_replace=1 -> n_dense_prefix) and full-rank
     q (true for V2-Lite: q_lora_rank is null). HF checkpoints load with
-    logits parity (tests/test_hf_convert.py TestDeepseekV2Parity)."""
+    logits parity (tests/test_hf_convert.py TestDeepseekV2Parity).
+    max_seq_len matches the checkpoint's max_position_embeddings: 163840
+    = YaRN factor 40 x original 4096 — a shorter value here would
+    silently cap the context the YaRN tables were scaled for."""
     return LlamaConfig(name="deepseek-v2-lite", vocab_size=102400,
                        embed_dim=2048, n_layers=27, n_heads=16,
                        n_kv_heads=16, head_dim=128, mlp_dim=1408,
-                       max_seq_len=32768, rope_theta=10_000.0,
+                       max_seq_len=163840, rope_theta=10_000.0,
                        rope_scaling={"rope_type": "yarn", "factor": 40.0,
                                      "beta_fast": 32, "beta_slow": 1,
                                      "mscale": 0.707,
@@ -798,7 +801,13 @@ def _mm_int4(h, w, dtype):
     keeps only the XLA fallback for CPU/interpret paths."""
     from ..ops.int4_matmul import int4_matmul, int4_matmul_sharded
     mesh = _INT4_MESH.get()
-    if mesh is not None and mesh.shape.get(AXES.TENSOR, 1) > 1:
+    if mesh is not None and mesh.size > 1:
+        # ANY multi-device mesh goes through the shard_map wrapper, not
+        # just tensor>1: a bare pallas_call in a GSPMD program over a
+        # multi-device mesh (e.g. expert-parallel with tensor=1) fails
+        # with "Mosaic kernels cannot be automatically partitioned" —
+        # shard_map makes the partitioning manual either way, and at
+        # tensor=1 its specs degenerate to full-array (replicated) blocks
         return int4_matmul_sharded(h.astype(dtype), w["q4"], w["scale"],
                                    mesh, axis=AXES.TENSOR)
     return int4_matmul(h.astype(dtype), w["q4"], w["scale"])
@@ -1026,7 +1035,11 @@ def _mlp_block(x, lp, cfg: LlamaConfig, mesh, train: bool = True,
                          else None),
             router_n_group=cfg.router_n_group,
             router_topk_group=cfg.router_topk_group,
-            routed_scaling=cfg.routed_scaling_factor)
+            routed_scaling=cfg.routed_scaling_factor,
+            # inference threads the mesh so an expert axis (or int4 expert
+            # weights, opaque to GSPMD) runs the expert FFN under shard_map;
+            # training keeps the GSPMD/constraint path (moe_mlp docstring)
+            mesh=None if train else mesh)
         aux = cfg.router_aux_coef * aux + cfg.router_z_coef * z
         if cfg.n_shared_experts:
             # DeepSeek-MoE shared experts: an always-on dense MLP (width

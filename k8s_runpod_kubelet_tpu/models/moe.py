@@ -12,8 +12,14 @@ Design (vs a torch transliteration that loops over experts):
   on the MXU, and results gather back with routing weights. No data-dependent
   shapes, no per-expert Python loops — XLA sees three dense einsums.
 - **Expert parallelism**: the buffer's leading axis carries the logical
-  "expert" axis → sharded over the `expert` mesh axis. The scatter/gather
-  around it becomes an all-to-all that XLA inserts; expert weights never move.
+  "expert" axis → sharded over the `expert` mesh axis. Training leaves the
+  sharding to GSPMD (the scatter/gather around the constrained buffer
+  becomes the all-to-all); INFERENCE with an expert axis runs the expert
+  FFN under an explicit ``shard_map`` (_expert_ffn_sharded) so the int4
+  Pallas unpack kernel — an opaque custom call the SPMD partitioner cannot
+  shard — partitions too. Expert weights never move: each shard holds
+  X/ep experts, composable with tensor parallelism on the mlp axis
+  (EP4 x TP2 on a 2x4 mesh).
 - **f32 router** with optional z-loss, load-balance aux loss (Switch-style,
   generalized to top-k the way Mixtral's is), top-k weight renormalization.
 """
@@ -97,15 +103,116 @@ def router_z_loss(router_logits: jax.Array) -> jax.Array:
 
 
 
+def _is_int4(w) -> bool:
+    return isinstance(w, dict) and "q4" in w
+
+
 def _expert_w(w, dtype):
     """(weight, scale_or_None) for an expert leaf: raw array, or int8
     {q8 (..., E, in, out), scale (..., E, 1, out)} from models/quant.py —
     the dequant multiply rides the einsum epilogue exactly like llama._mm,
     so expert HBM reads stay int8 (Mixtral's experts are ~96% of its
-    params; without this --int8 barely touches an MoE model)."""
+    params; without this --int8 barely touches an MoE model). Used by the
+    dense reference only — the sparse path goes through _expert_matmul,
+    which additionally covers int4."""
+    if _is_int4(w):
+        raise ValueError("the dense MoE reference does not cover int4 "
+                         "expert weights; compare against the raw-weight "
+                         "reference instead (tests do)")
     if isinstance(w, dict):
         return w["q8"].astype(dtype), w["scale"].astype(dtype)
     return w.astype(dtype), None
+
+
+def _expert_matmul(x, w, dtype):
+    """Per-expert matmul x (X, C, in) @ w (X, in, out) -> (X, C, out) for
+    every expert-leaf form:
+
+    - raw array (X, in, out);
+    - int8 {q8 (X, in, out), scale (X, 1, out)} — dequant in the einsum
+      epilogue, HBM reads stay int8;
+    - int4 {q4 (X, in/2, out), scale (X, g, 1, out)} — each expert's
+      packed weight goes through the SAME 2D unpack kernel as the dense
+      int4 path (ops/int4_matmul.py), batched over the expert axis.
+    """
+    if _is_int4(w):
+        from ..ops.int4_matmul import int4_expert_matmul
+        return int4_expert_matmul(x.astype(dtype), w["q4"], w["scale"])
+    if isinstance(w, dict):
+        return (jnp.einsum("xci,xio->xco", x, w["q8"].astype(dtype))
+                * w["scale"].astype(dtype))   # (X, 1, out) broadcasts over C
+    return jnp.einsum("xci,xio->xco", x, w.astype(dtype))
+
+
+def _expert_ffn_sharded(buf, we_gate, we_up, we_down, *, mesh, activation,
+                        dtype):
+    """Expert-parallel FFN over the dispatch buffer via shard_map.
+
+    The serving path's EP island: each shard of the ``expert`` mesh axis
+    holds X/ep experts' weights and runs their gate/up/down matmuls
+    locally; the surrounding scatter/combine stays in GSPMD land, so the
+    slice-in / all-gather-out ARE the dispatch/combine collectives.
+    Composes with tensor parallelism: raw/int8 expert weights shard their
+    mlp axis over ``tensor`` (down contraction psums, megatron-style);
+    int4 packed weights replicate over ``tensor`` (their contraction axis
+    is 2x-packed and 128-grouped so it cannot shard, and out-sharding
+    would force an all-gather before the combine) — per-chip expert bytes
+    still drop by the EP factor, which is the memory lever int4 EP is
+    for. shard_map rather than GSPMD because the int4 Pallas kernel is an
+    opaque custom call the SPMD partitioner cannot shard (the same reason
+    ops/int4_matmul.int4_matmul_sharded exists for the dense path)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.ring_attention import shard_map_compat
+    from ..parallel.mesh import AXES
+
+    x_experts = buf.shape[0]
+    ep = mesh.shape.get(AXES.EXPERT, 1)
+    tp = mesh.shape.get(AXES.TENSOR, 1)
+    if x_experts % ep:
+        raise ValueError(f"expert mesh axis {ep} must divide n_experts "
+                         f"{x_experts}")
+    int4 = _is_int4(we_gate)
+    # mention tensor in the specs only when it is a real axis: at tp=1 a
+    # tensor-annotated input would type the output as non-replicated over
+    # tensor with no psum to restore it, tripping shard_map's rep check
+    tens = AXES.TENSOR if tp > 1 else None
+
+    def w_spec(w, *, down: bool):
+        if _is_int4(w):
+            return {"q4": P(AXES.EXPERT, None, None),
+                    "scale": P(AXES.EXPERT, None, None, None)}
+        if isinstance(w, dict):  # int8: scale (X, 1, out) follows the out axis
+            if down:
+                return {"q8": P(AXES.EXPERT, tens, None),
+                        "scale": P(AXES.EXPERT, None, None)}
+            return {"q8": P(AXES.EXPERT, None, tens),
+                    "scale": P(AXES.EXPERT, None, tens)}
+        return (P(AXES.EXPERT, tens, None) if down
+                else P(AXES.EXPERT, None, tens))
+
+    def ffn(buf_l, wg, wu, wd):
+        gate = _expert_matmul(buf_l, wg, dtype)
+        up = _expert_matmul(buf_l, wu, dtype)
+        out = _expert_matmul(activation(gate) * up, wd, dtype)
+        if tp > 1 and not int4:
+            # raw/int8 shard the mlp axis over tensor, so the down matmul
+            # holds a partial sum over the contraction — reduce it; int4
+            # replicates over tensor and needs none
+            out = jax.lax.psum(out, AXES.TENSOR)
+        return out
+
+    fn = shard_map_compat(
+        ffn, mesh,
+        in_specs=(P(AXES.EXPERT, None, None),
+                  w_spec(we_gate, down=False), w_spec(we_up, down=False),
+                  w_spec(we_down, down=True)),
+        out_specs=P(AXES.EXPERT, None, None),
+        # int4's pallas_call has no replication rule for the axes its
+        # replicated operands don't mention (shard_map_compat docstring);
+        # the raw/int8 einsum body type-checks, so keep the check there
+        check=not int4)
+    return fn(buf, we_gate, we_up, we_down)
 
 
 def moe_mlp(h: jax.Array, router_w: jax.Array, we_gate,
@@ -113,16 +220,22 @@ def moe_mlp(h: jax.Array, router_w: jax.Array, we_gate,
             capacity_factor: float, activation, dtype, constrain=None,
             norm_topk: bool = True, router_bias=None,
             router_n_group: int = 0, router_topk_group: int = 0,
-            routed_scaling: float = 1.0
+            routed_scaling: float = 1.0, mesh=None
             ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Sparse MoE MLP on normed activations.
 
     h (B,S,E); router_w (E,X); we_* (X,E,M)/(X,M,E) raw arrays, or int8
-    {q8, scale} dict leaves from models/quant.py (see _expert_w).
+    {q8, scale} / int4 {q4, scale} dict leaves from models/quant.py
+    (see _expert_matmul).
     Returns (out (B,S,E), load_balance_aux, router_z) — aux terms are
     UNSCALED; the caller applies its coefficients (so inference paths can
     just drop them).
     ``constrain(x, logical_axes)`` optionally applies sharding constraints.
+    ``mesh``: when it carries an ``expert`` axis (or the expert leaves are
+    int4, which GSPMD cannot partition), the expert FFN runs under an
+    explicit shard_map (_expert_ffn_sharded) — the serving EP path.
+    Training passes mesh=None and keeps the GSPMD/constraint path (the
+    shard_map island has no int4 VJP and training never needs one).
     """
     b, s, e = h.shape
     x_experts = router_w.shape[-1]
@@ -164,18 +277,17 @@ def moe_mlp(h: jax.Array, router_w: jax.Array, we_gate,
     buf = cons(buf, ("expert", None, None))
 
     # all experts in one batched einsum each — MXU-shaped, weights stationary
-    wg, sg = _expert_w(we_gate, dtype)
-    wu, su = _expert_w(we_up, dtype)
-    wd, sd = _expert_w(we_down, dtype)
-    gate = jnp.einsum("xce,xem->xcm", buf, wg)
-    up = jnp.einsum("xce,xem->xcm", buf, wu)
-    if sg is not None:
-        gate = gate * sg          # (x, 1, m) broadcasts over capacity
-        up = up * su
-    act = cons(activation(gate) * up, ("expert", None, "act_mlp"))
-    out = jnp.einsum("xcm,xme->xce", act, wd)
-    if sd is not None:
-        out = out * sd            # (x, 1, e)
+    from ..parallel.mesh import AXES
+    use_ep = mesh is not None and (mesh.shape.get(AXES.EXPERT, 1) > 1
+                                   or _is_int4(we_gate))
+    if use_ep:
+        out = _expert_ffn_sharded(buf, we_gate, we_up, we_down, mesh=mesh,
+                                  activation=activation, dtype=dtype)
+    else:
+        gate = _expert_matmul(buf, we_gate, dtype)
+        up = _expert_matmul(buf, we_up, dtype)
+        act = cons(activation(gate) * up, ("expert", None, "act_mlp"))
+        out = _expert_matmul(act, we_down, dtype)
     out_flat = out.reshape(x_experts * cap, e)
 
     # combine: gather each assignment's result, zero the dropped ones,

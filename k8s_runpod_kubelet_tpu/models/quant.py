@@ -19,10 +19,12 @@ A quantized weight is a dict leaf ``{"q8": int8 (..., in, out),
 either form, so train/serve code paths are unchanged. Norms, biases, the
 embedding table (gather path + possible tied head), and the MoE router stay
 full precision — they are tiny and accuracy-critical. Sparse-MoE EXPERT
-weights quantize too at int8 (moe._expert_w applies the scale in the expert
-einsum's epilogue; Mixtral's experts are ~96% of its params, so --int8 on
-an MoE model lives or dies on them) — int4 leaves experts at full precision
-(the unpack kernel and einsum path don't compose yet).
+weights quantize at BOTH widths (moe._expert_matmul applies the scale in
+the expert matmul's epilogue; Mixtral's experts are ~96% of its params, so
+weight-only quantization on an MoE model lives or dies on them): int8 rides
+the einsum, int4 goes per-expert through the ops/int4_matmul.py unpack
+kernel (int4_expert_matmul), group-wise scales along each expert's
+contraction axis exactly like the dense leaves.
 """
 
 from __future__ import annotations
@@ -45,8 +47,8 @@ __all__ = ["quantize_params", "is_quantized", "quantized_logical_axes"]
 # the latent-cache reads the absorbed form exists to shrink.
 _LAYER_WEIGHTS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
                   "w_dkv", "ws_gate", "ws_up", "ws_down", "w_qa", "w_qb")
-# expert weights: int8-only (moe.py's einsums handle {q8, scale}; the int4
-# unpack kernel is a 2D-matmul kernel and doesn't cover the expert path)
+# expert weights: {q8, scale} rides moe.py's einsums; int4 {q4, scale}
+# goes per-expert through the 2D unpack kernel (int4_expert_matmul)
 _EXPERT_WEIGHTS = ("we_gate", "we_up", "we_down")
 
 
@@ -113,15 +115,21 @@ def quantized_logical_axes(cfg: LlamaConfig, bits: int = 8) -> Params:
     if bits == 4:
         def q_axes(axes):
             lead = axes[:-2]   # ("layer",) for stacked weights, () for lm_head
+            if "expert" in lead:
+                # expert leaves shard their EXPERT axis only: the packed
+                # contraction axis cannot shard (2x-packed + grouped), and
+                # out-sharding over tensor would force an all-gather
+                # before the MoE combine — EP is the int4 experts' memory
+                # lever (moe._expert_ffn_sharded's layout contract)
+                return {"q4": lead + (None, None),
+                        "scale": lead + (None, None, None)}
             return {"q4": lead + (None, "int4_out"),
                     "scale": lead + (None, None, "int4_out")}
-
-        quantized = set(_LAYER_WEIGHTS)   # experts stay unquantized at int4
     else:
         def q_axes(axes):
             return {"q8": axes, "scale": axes[:-2] + (None, axes[-1])}
 
-        quantized = set(_LAYER_WEIGHTS) | set(_EXPERT_WEIGHTS)
+    quantized = set(_LAYER_WEIGHTS) | set(_EXPERT_WEIGHTS)
 
     out: Params = {"tok_embed": base["tok_embed"],
                    "final_norm": base["final_norm"]}
@@ -165,8 +173,7 @@ def quantize_params(cfg: LlamaConfig, params: Params,
             continue
         layers = {}
         for name, w in params[stack].items():
-            if name in _LAYER_WEIGHTS or (bits == 8
-                                          and name in _EXPERT_WEIGHTS):
+            if name in _LAYER_WEIGHTS or name in _EXPERT_WEIGHTS:
                 leaf = quant(w)
                 layers[name] = (jax.tree_util.tree_map(jnp.asarray, leaf)
                                 if commit else leaf)

@@ -39,7 +39,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from .common import use_pallas as _use_pallas
+from .common import tpu_compiler_params, use_pallas as _use_pallas
 
 NEG_INF = -1e30
 _STATS_LANES = 128  # stats scratch keeps a full 128-lane tile (Mosaic-native)
@@ -222,7 +222,7 @@ def _flash_fwd_pallas(q, k, v, causal: bool, scale: float, block_q: int,
             pltpu.VMEM((block_q, _STATS_LANES), jnp.float32),
             pltpu.VMEM((block_q, _STATS_LANES), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -374,7 +374,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal: bool, scale: float,
                                lambda bb, h, i, j: (bb, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -425,7 +425,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal: bool, scale: float,
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,

@@ -8,6 +8,16 @@ from typing import Optional
 import jax
 
 
+def tpu_compiler_params(**kw):
+    """Pallas TPU compiler params across jax releases: the class was
+    renamed TPUCompilerParams -> CompilerParams; resolve whichever this
+    jax ships (the pinned image and newer toolchains disagree)."""
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(**kw)
+
+
 def use_pallas(flag: Optional[bool]) -> bool:
     """Auto-select the Pallas path: explicit flag wins; env kill-switch
     (TPU_KUBELET_NO_PALLAS=1) next; force-on (TPU_KUBELET_FORCE_PALLAS=1,

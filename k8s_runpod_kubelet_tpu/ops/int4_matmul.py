@@ -33,9 +33,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from .common import use_pallas as _use_pallas
+from .common import tpu_compiler_params, use_pallas as _use_pallas
 
-__all__ = ["int4_matmul", "int4_matmul_sharded"]
+__all__ = ["int4_matmul", "int4_matmul_sharded", "int4_expert_matmul"]
 
 
 def _pick_block_out(out: int, cap: int = 512) -> int:
@@ -73,7 +73,7 @@ def _matmul_2d(h2, q4, scale, interpret: bool):
         out_specs=pl.BlockSpec((h2.shape[0], block_out), lambda i, j: (0, i)),
         out_shape=jax.ShapeDtypeStruct((h2.shape[0], out), h2.dtype),
         scratch_shapes=[pltpu.VMEM((h2.shape[0], block_out), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(he, ho, q4g, scale)
@@ -168,8 +168,34 @@ def int4_matmul_sharded(h: jax.Array, q4: jax.Array, scale: jax.Array,
     fn = shard_map_compat(
         _dispatch_2d, mesh,
         in_specs=(P(), P(None, axis), P(None, None, axis)),
-        out_specs=P(None, axis))
+        out_specs=P(None, axis),
+        # no replication rule exists for pallas_call, and h replicates
+        # over every mesh axis (and the weights over any >1 axis beyond
+        # ``axis``, e.g. ``expert`` on an EP x TP serving mesh) — the
+        # older-jax rep check cannot type this even though the values
+        # are replicated (shard_map_compat docstring)
+        check=False)
     return fn(h2, q4, scale).reshape(*h.shape[:-1], out)
+
+
+def int4_expert_matmul(h: jax.Array, q4: jax.Array,
+                       scale: jax.Array) -> jax.Array:
+    """Batched per-expert int4 matmul: h (X, C, in) @ q4 (X, in/2, out) ->
+    (X, C, out), scale (X, g, 1, out).
+
+    Each expert's (capacity, in) tokens contract against its own packed
+    weight through the SAME 2D kernel/fallback dispatch as the dense path
+    (_dispatch_2d) — ``lax.map`` compiles the kernel ONCE and runs it per
+    expert, so a 256-expert layer does not trace 256 kernels. MoE decode
+    is expert-weight-bandwidth-bound exactly like dense decode, so the
+    packed-payload HBM story carries over unchanged. Called per expert
+    SHARD under moe._expert_ffn_sharded (shard_map partitions the expert
+    axis; inside the body this sees only the local X/ep experts)."""
+    def one(args):
+        h_i, q_i, s_i = args
+        return _dispatch_2d(h_i, q_i, s_i)
+
+    return jax.lax.map(one, (h, q4, scale))
 
 
 def int4_matmul(h: jax.Array, q4: jax.Array, scale: jax.Array,

@@ -66,16 +66,25 @@ def _chunk_update(q, kc, vc, acc, m, l, *, q_offset, k_offset, causal, sm_scale,
     return acc_new, m_new, l_new
 
 
-def shard_map_compat(f, mesh, in_specs, out_specs):
+def shard_map_compat(f, mesh, in_specs, out_specs, check: bool = True):
     """shard_map with vma typing off when the kwarg exists: pallas_call
     out_shapes carry no vma annotations, which jax>=0.8 shard_map rejects
     under its default varying-mesh-axes typing. Only the CONSTRUCTOR probe
     sits in the try: a TypeError from tracing ``f`` later must surface as
-    itself, not as a retry."""
+    itself, not as a retry.
+
+    ``check=False`` additionally disables the replication CHECK on older
+    jax (check_rep): a pallas_call whose inputs are replicated over an
+    unmentioned mesh axis has no replication rule there, so bodies like
+    the int4 expert FFN (moe._expert_ffn_sharded, weights replicated over
+    ``tensor``) cannot type-check even though the values ARE replicated."""
     try:
         return shard_map(f, mesh=mesh, in_specs=in_specs,
                          out_specs=out_specs, check_vma=False)
     except TypeError:  # pragma: no cover — older jax: no check_vma kwarg
+        if not check:
+            return shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_rep=False)
         return shard_map(f, mesh=mesh, in_specs=in_specs,
                          out_specs=out_specs)
 
@@ -267,8 +276,13 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh, *,
                                block_q=bq, block_k=bk, interpret=interpret)
 
         spec = P(None, None, axis, None)
+        # check=False: the flash chunk kernels are pallas_calls, which the
+        # older-jax replication checker has no rule for whenever a mesh
+        # axis beyond ``axis`` exists (the 8-device test mesh; a seq-only
+        # mesh never trips it) — same reasoning as int4_matmul_sharded
         fn = shard_map_compat(local_flash, mesh=mesh,
-                              in_specs=(spec, spec, spec), out_specs=spec)
+                              in_specs=(spec, spec, spec), out_specs=spec,
+                              check=False)
         return fn(q, k, v)
 
     def local(qs, ks, vs):
@@ -324,5 +338,10 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh, *,
         return (acc / jnp.maximum(l, 1e-30)).astype(qs.dtype)
 
     spec = P(None, None, axis, None)
-    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec)(q, k, v)
+    # check=False: the masked lax.cond over ppermute'd carries trips the
+    # older-jax replication checker ("branches produced mismatched
+    # replication types") even though both branches carry the same
+    # device-varying values — the pcast fallback above covers the newer
+    # vma typing, this covers the old rep check
+    return shard_map_compat(local, mesh=mesh, in_specs=(spec, spec, spec),
+                            out_specs=spec, check=False)(q, k, v)

@@ -76,15 +76,22 @@ def make_mesh(config: MeshConfig, devices: Optional[list] = None) -> Mesh:
 
 
 def best_mesh_for(n_devices: int, *, tensor: int = 1, seq: int = 1,
-                  fsdp: Optional[int] = None) -> Mesh:
-    """Convenience: a sensible mesh for n devices — tensor/seq as asked, fsdp
-    absorbing what data-parallel doesn't need. Used by bench/dryrun paths."""
+                  expert: int = 1, fsdp: Optional[int] = None) -> Mesh:
+    """Convenience: a sensible mesh for n devices — tensor/seq/expert as
+    asked, fsdp absorbing what data-parallel doesn't need. Used by
+    bench/dryrun paths. ``expert`` carves out MoE expert parallelism
+    (serving: EPxTP composes, e.g. expert=4, tensor=2 on 8 chips)."""
     tensor = min(tensor, n_devices)
-    remaining = n_devices // (tensor * seq)
+    remaining = n_devices // (tensor * seq * expert)
+    if remaining < 1:
+        raise ValueError(
+            f"tensor={tensor} x seq={seq} x expert={expert} exceeds "
+            f"{n_devices} devices")
     if fsdp is None:
         fsdp = remaining
-    data = n_devices // (fsdp * tensor * seq)
-    cfg = MeshConfig(data=data, fsdp=fsdp, seq=seq, tensor=tensor)
+    data = n_devices // (fsdp * tensor * seq * expert)
+    cfg = MeshConfig(data=data, fsdp=fsdp, expert=expert, seq=seq,
+                     tensor=tensor)
     return make_mesh(cfg, jax.devices()[:n_devices])
 
 

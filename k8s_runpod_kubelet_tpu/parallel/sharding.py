@@ -39,9 +39,16 @@ LOGICAL_RULES: dict[str, object] = {
     # all positions' latents (models/llama.py param_logical_axes)
     "latent": None,
     # int4-packed weights: OUT axis over tensor, contraction replicated
-    # (ops/int4_matmul.py int4_matmul_sharded shard_map layout contract)
+    # (ops/int4_matmul.py int4_matmul_sharded shard_map layout contract).
+    # Int4 EXPERT leaves do NOT use this rule: they shard their expert
+    # axis only (quant.quantized_logical_axes bits=4 — out-sharding would
+    # force an all-gather before the MoE combine under
+    # moe._expert_ffn_sharded)
     "int4_out": AXES.TENSOR,
     "vocab": AXES.TENSOR,
+    # MoE expert axis: expert weights' leading dim and the dispatch
+    # buffer shard over it (EP serving composes with tensor on the mlp
+    # axis; moe.py's shard_map island is the inference consumer)
     "expert": AXES.EXPERT,
     "stage": AXES.STAGE,
     "norm": None,

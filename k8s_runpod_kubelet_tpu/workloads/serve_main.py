@@ -897,6 +897,12 @@ def main(argv=None) -> int:
                         "parallelism): params by the logical-axis rules, "
                         "KV cache on its kv-heads axis — 70B-class serving "
                         "spans a slice this way")
+    p.add_argument("--expert-parallel", type=int, default=1,
+                   help="shard MoE expert weights over this many chips "
+                        "(expert parallelism; composes with "
+                        "--tensor-parallel, e.g. EP4xTP2 on 8 chips): each "
+                        "chip holds n_experts/EP experts — the per-chip "
+                        "memory lever for 256-expert-class models")
     p.add_argument("--max-connections", type=int, default=128,
                    help="HTTP-layer concurrency bound: connections beyond "
                         "this get an immediate 503 + Retry-After (the HPA "
@@ -931,26 +937,36 @@ def main(argv=None) -> int:
                   cfg.name)
         return 1
     mesh = None
-    if args.tensor_parallel > 1:
+    if args.tensor_parallel < 1 or args.expert_parallel < 1:
+        # validated OUTSIDE the mesh gate: a 0/negative degree must error
+        # here, not silently fall through to unsharded single-chip serving
+        log.error("--tensor-parallel and --expert-parallel must be >= 1 "
+                  "(got %d, %d)", args.tensor_parallel, args.expert_parallel)
+        return 1
+    if args.tensor_parallel > 1 or args.expert_parallel > 1:
         # fail-fast BEFORE the expensive weight load, like the tokenizer
         # check above
         from ..parallel import MeshConfig, make_mesh
         n = args.tensor_parallel
-        if args.int4 and cfg.n_experts:
-            log.error("--tensor-parallel with --int4 does not cover MoE "
-                      "models (expert weights are int8-only); use --int8")
+        ep = args.expert_parallel
+        if ep > 1 and (not cfg.n_experts or cfg.n_experts % ep):
+            log.error("--expert-parallel %d needs an MoE model whose "
+                      "n_experts it divides (%s has n_experts=%d)",
+                      ep, cfg.name, cfg.n_experts)
             return 1
         if cfg.n_kv_heads % n or cfg.n_heads % n:
             log.error("--tensor-parallel %d must divide the model's head "
                       "counts (n_heads=%d, n_kv_heads=%d)",
                       n, cfg.n_heads, cfg.n_kv_heads)
             return 1
-        if len(jax.devices()) < n:
-            log.error("--tensor-parallel %d but jax sees %d device(s)",
-                      n, len(jax.devices()))
+        if len(jax.devices()) < n * ep:
+            log.error("--tensor-parallel %d x --expert-parallel %d but jax "
+                      "sees %d device(s)", n, ep, len(jax.devices()))
             return 1
-        mesh = make_mesh(MeshConfig(data=1, tensor=n), jax.devices()[:n])
-        log.info("sharded serving: tensor=%d over %s", n, jax.devices()[:n])
+        mesh = make_mesh(MeshConfig(data=1, expert=ep, tensor=n),
+                         jax.devices()[:n * ep])
+        log.info("sharded serving: expert=%d tensor=%d over %s", ep, n,
+                 jax.devices()[:n * ep])
     if args.hf_checkpoint:
         from ..models import load_hf
         params = load_hf(cfg, args.hf_checkpoint)  # host tree

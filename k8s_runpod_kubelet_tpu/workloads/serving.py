@@ -62,9 +62,11 @@ class ServingConfig:
                                  # decode step
     # weight-only int4 (two weights per byte, group-wise scales): quarter
     # weight HBM traffic — the next rung after int8 on the decode-bandwidth
-    # ladder. Accuracy drops more than int8's (4-bit resolution); the tiny
-    # pinned model stays argmax-stable in tests, real models deserve an
-    # eval before production. Mutually exclusive with quantize_int8.
+    # ladder. Covers MoE EXPERT weights too (per-expert unpack kernel,
+    # tests pin parity vs f32 within a threshold). Accuracy drops more
+    # than int8's (4-bit resolution); the tiny pinned model stays
+    # argmax-stable in tests, real models deserve an eval before
+    # production. Mutually exclusive with quantize_int8.
     quantize_int4: bool = False
     # speculative decoding via prompt-lookup (n-gram) proposals: draft this
     # many tokens per decode step and verify them in ONE forward pass
@@ -371,15 +373,23 @@ class ServingEngine:
         # the mesh through prefill/decode/verify, params arrive pre-sharded
         # (init_params(cfg, key, mesh) / device_put with param_shardings),
         # and the KV cache shards its kv-heads axis over ``tensor`` — GSPMD
-        # inserts the collectives, exactly like the training forward
+        # inserts the collectives, exactly like the training forward.
+        # MoE models additionally shard expert weights over the mesh's
+        # ``expert`` axis (EP x TP composes, e.g. EP4xTP2 on 2x4): the
+        # expert FFN runs under shard_map (moe._expert_ffn_sharded), which
+        # is also what lets int4 expert weights — a Pallas custom call
+        # GSPMD cannot partition — serve sharded.
         self.mesh = mesh
         if sc.quantize_int8 and sc.quantize_int4:
             raise ValueError("quantize_int8 and quantize_int4 are mutually "
                              "exclusive — pick one weight precision")
-        if mesh is not None and sc.quantize_int4 and cfg.n_experts:
-            raise ValueError("mesh serving with int4 MoE is not supported "
-                             "(expert weights are int8-only); use int8 for "
-                             "sharded MoE serving")
+        if mesh is not None:
+            from ..parallel.mesh import AXES
+            ep = mesh.shape.get(AXES.EXPERT, 1)
+            if ep > 1 and (not cfg.n_experts or cfg.n_experts % ep):
+                raise ValueError(
+                    f"expert mesh axis {ep} needs an MoE config whose "
+                    f"n_experts it divides (got n_experts={cfg.n_experts})")
         self.model = LlamaModel(cfg, mesh)
         if sc.quantize_int8 or sc.quantize_int4:
             from ..models.quant import (quantize_params,

@@ -246,6 +246,81 @@ print(json.dumps({{"apiVersion": "client.authentication.k8s.io/v1beta1",
             api.stop()
 
 
+class TestInjectedClockLifetime:
+    def test_fetch_lifetime_uses_injected_clock(self, tmp_path):
+        """ExecCredentialPlugin._fetch must compute the token lifetime
+        from the INJECTED self._now, not wall time: the inherited
+        _CachingProvider expiry bookkeeping runs on self._now, so a
+        wall-clock lifetime breaks the one-token cache under injected
+        clocks. The plugin below mints a token whose expiry is in the
+        WALL-CLOCK past but one hour ahead of the injected clock — the
+        fixed code caches it (1 exec); the wall-clock bug computes
+        lifetime 0 and re-execs every call."""
+        from k8s_runpod_kubelet_tpu.kube.client import (ExecCredentialPlugin,
+                                                        _parse_rfc3339)
+        exp = "2020-01-01T00:00:00Z"   # far in the wall-clock past
+        counter = tmp_path / "calls-now"
+        script = tmp_path / "plugin-now"
+        script.write_text(f"""#!{sys.executable}
+import json, os
+path = {str(counter)!r}
+n = int(open(path).read()) + 1 if os.path.exists(path) else 1
+open(path, "w").write(str(n))
+print(json.dumps({{"apiVersion": "client.authentication.k8s.io/v1beta1",
+                  "kind": "ExecCredential",
+                  "status": {{"token": "t-" + str(n),
+                             "expirationTimestamp": {exp!r}}}}}))
+""")
+        script.chmod(script.stat().st_mode | stat.S_IEXEC)
+        t0 = _parse_rfc3339(exp) - 3600.0   # injected clock: expiry +1h out
+        provider = ExecCredentialPlugin(str(script), now=lambda: t0)
+        assert provider() == "t-1"
+        assert provider() == "t-1"   # cached: lifetime judged by _now()
+        assert counter.read_text() == "1"
+
+
+class TestRelativeKubeconfigPaths:
+    def test_relative_cert_paths_resolve_against_kubeconfig_dir(
+            self, tmp_path, monkeypatch):
+        """kubectl/client-go resolve relative certificate-authority /
+        client-certificate / client-key paths against the kubeconfig
+        file's directory; passing them through as-is only works when CWD
+        happens to match. Absolute paths must pass through untouched."""
+        captured = {}
+        import k8s_runpod_kubelet_tpu.kube.client as kc_mod
+        real_create = kc_mod.ssl.create_default_context
+
+        def spy(cafile=None, cadata=None, **kw):
+            captured["cafile"] = cafile
+            return real_create()
+
+        monkeypatch.setattr(kc_mod.ssl, "create_default_context", spy)
+        monkeypatch.setattr(
+            kc_mod.ssl.SSLContext, "load_cert_chain",
+            lambda self, cert, key=None: captured.update(cert=cert, key=key))
+        abs_key = str(tmp_path / "elsewhere" / "client.key")
+        cfg = {
+            "apiVersion": "v1", "current-context": "gke",
+            "contexts": [{"name": "gke",
+                          "context": {"cluster": "c1", "user": "u1"}}],
+            "clusters": [{"name": "c1", "cluster": {
+                "server": "https://10.0.0.1:443",
+                "certificate-authority": "certs/ca.crt"}}],
+            "users": [{"name": "u1", "user": {
+                "client-certificate": "certs/client.crt",
+                "client-key": abs_key}}],
+        }
+        import yaml
+        p = tmp_path / "kubedir" / "kc.yaml"
+        p.parent.mkdir()
+        p.write_text(yaml.safe_dump(cfg))
+        RealKubeClient.from_kubeconfig(str(p))
+        base = str(tmp_path / "kubedir")
+        assert captured["cafile"] == os.path.join(base, "certs/ca.crt")
+        assert captured["cert"] == os.path.join(base, "certs/client.crt")
+        assert captured["key"] == abs_key   # absolute: untouched
+
+
 class TestInlineDataFields:
     def test_ca_data_loaded_without_touching_disk(self, tmp_path,
                                                   monkeypatch):

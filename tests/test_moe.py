@@ -197,3 +197,77 @@ class TestQuantizedExperts:
                              w["we_down"], capacity_factor=4.0, **kw)
         np.testing.assert_allclose(np.asarray(y), np.asarray(y_fp),
                                    rtol=0.1, atol=0.05)
+
+    def test_int4_experts_accuracy_parity(self):
+        """Group-wise int4 expert weights through the per-expert unpack
+        path (moe._expert_matmul -> ops.int4_matmul.int4_expert_matmul):
+        the ACCURACY-PARITY threshold test that replaced the old loud
+        'expert weights are int8-only' error. 4-bit resolution is lossy
+        by construction, so the pin is a relative-Frobenius-error budget
+        against the full-precision output, not exactness. Budget
+        calibration: absmax int4 on gaussian weights has a ~0.4sigma
+        quantization step -> ~12% per-weight error -> ~0.2 relative
+        output error through the three matmuls (measured 0.19-0.20
+        across geometries); 0.25 pins that with margin while catching
+        any packing/scale-alignment regression (which lands >0.5). int8
+        must sit an order of magnitude inside it (the ladder ordering)."""
+        from k8s_runpod_kubelet_tpu.models.quant import (_quantize_leaf,
+                                                         _quantize_leaf_int4)
+        w = _moe_weights(jax.random.PRNGKey(0), e=64, m=128)
+        h = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 64), jnp.float32)
+        kw = dict(n_experts_per_tok=2, capacity_factor=4.0,
+                  activation=jax.nn.silu, dtype=jnp.float32)
+
+        def quantized(leaf_fn):
+            q = {name: (jax.tree_util.tree_map(jnp.asarray,
+                                               leaf_fn(np.asarray(w[name])))
+                        if name.startswith("we_") else w[name])
+                 for name in w}
+            y, _, _ = moe_mlp(h, q["router"], q["we_gate"], q["we_up"],
+                              q["we_down"], **kw)
+            return np.asarray(y)
+
+        y_fp, _, _ = moe_mlp(h, w["router"], w["we_gate"], w["we_up"],
+                             w["we_down"], **kw)
+        y_fp = np.asarray(y_fp)
+
+        def rel_err(y):
+            return (np.linalg.norm(y - y_fp)
+                    / max(np.linalg.norm(y_fp), 1e-9))
+
+        err4 = rel_err(quantized(_quantize_leaf_int4))
+        err8 = rel_err(quantized(_quantize_leaf))
+        assert err4 < 0.25, f"int4 expert rel error {err4:.4f} over budget"
+        assert err8 < err4 / 10, (err8, err4)
+
+    def test_int4_experts_dense_reference_rejects(self):
+        """The dense reference does not cover int4 leaves — it must say so
+        loudly instead of KeyError'ing into a misleading trace."""
+        from k8s_runpod_kubelet_tpu.models.quant import _quantize_leaf_int4
+        w = _moe_weights(jax.random.PRNGKey(0), e=64, m=128)
+        q4 = jax.tree_util.tree_map(
+            jnp.asarray, _quantize_leaf_int4(np.asarray(w["we_gate"])))
+        h = jax.random.normal(jax.random.PRNGKey(1), (1, 4, 64), jnp.float32)
+        with pytest.raises(ValueError, match="dense MoE reference"):
+            moe_mlp_dense_reference(h, w["router"], q4, q4, q4,
+                                    n_experts_per_tok=2,
+                                    activation=jax.nn.silu,
+                                    dtype=jnp.float32)
+
+    def test_expert_parallel_shard_map_matches_unsharded(self):
+        """The serving EP island (_expert_ffn_sharded under a mesh with an
+        expert axis) computes the same MoE output as the meshless einsum
+        path — per-expert math is untouched by the partitioning."""
+        w = _moe_weights(jax.random.PRNGKey(0), e=64, m=128)
+        h = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 64), jnp.float32)
+        kw = dict(n_experts_per_tok=2, capacity_factor=4.0,
+                  activation=jax.nn.silu, dtype=jnp.float32)
+        y_ref, _, _ = moe_mlp(h, w["router"], w["we_gate"], w["we_up"],
+                              w["we_down"], **kw)
+        mesh = make_mesh(MeshConfig(data=1, expert=2, tensor=2),
+                         jax.devices()[:4])
+        y_ep = jax.jit(lambda h: moe_mlp(
+            h, w["router"], w["we_gate"], w["we_up"], w["we_down"],
+            mesh=mesh, **kw)[0])(h)
+        np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_ref),
+                                   rtol=1e-5, atol=1e-5)

@@ -1,0 +1,60 @@
+"""TCP connect-probe candidate selection (tools/probe_diag.py).
+
+A connect consumes a pending accept, so the probe must target only
+relay-plausible ports: when PALLAS_AXON_* env names the relay's ports the
+candidate set is exactly (hints ∩ listeners); the bounded first-8 scan is
+the fallback for unhinted environments only.
+"""
+
+import importlib.util
+import os
+import sys
+
+
+def _load_probe_diag():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "probe_diag.py")
+    spec = importlib.util.spec_from_file_location("probe_diag_under_test",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestRelayPortHints:
+    def test_no_env_means_no_hints(self, monkeypatch):
+        mod = _load_probe_diag()
+        for var in ("PALLAS_AXON_RELAY_PORT", "PALLAS_AXON_PORT",
+                    "PALLAS_AXON_POOL_IPS", "PALLAS_AXON_PORT_RANGE"):
+            monkeypatch.delenv(var, raising=False)
+        assert mod._relay_port_hints() == []
+
+    def test_pool_ips_ports_and_explicit_port(self, monkeypatch):
+        mod = _load_probe_diag()
+        monkeypatch.setenv("PALLAS_AXON_POOL_IPS",
+                           "127.0.0.1:8471, 10.0.0.2:8472,127.0.0.1")
+        monkeypatch.setenv("PALLAS_AXON_RELAY_PORT", "8470")
+        monkeypatch.delenv("PALLAS_AXON_PORT", raising=False)
+        monkeypatch.delenv("PALLAS_AXON_PORT_RANGE", raising=False)
+        # the bare-IP pool entry contributes nothing; no crash either
+        assert mod._relay_port_hints() == [8470, 8471, 8472]
+
+    def test_port_range_is_bounded(self, monkeypatch):
+        mod = _load_probe_diag()
+        for var in ("PALLAS_AXON_RELAY_PORT", "PALLAS_AXON_PORT",
+                    "PALLAS_AXON_POOL_IPS"):
+            monkeypatch.delenv(var, raising=False)
+        monkeypatch.setenv("PALLAS_AXON_PORT_RANGE", "8470-8473")
+        assert mod._relay_port_hints() == [8470, 8471, 8472, 8473]
+        # a typo'd giant range must not enumerate the port space
+        monkeypatch.setenv("PALLAS_AXON_PORT_RANGE", "1-65000")
+        assert mod._relay_port_hints() == []
+
+    def test_garbage_env_is_ignored(self, monkeypatch):
+        mod = _load_probe_diag()
+        monkeypatch.setenv("PALLAS_AXON_RELAY_PORT", "relay;8470x")
+        monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "::weird::,")
+        monkeypatch.setenv("PALLAS_AXON_PORT_RANGE", "abc-def")
+        monkeypatch.delenv("PALLAS_AXON_PORT", raising=False)
+        assert mod._relay_port_hints() == []

@@ -213,12 +213,27 @@ class TestMoEExpertInt8:
         assert cos > 0.999, cos
         assert (np.argmax(ref[:, -1], -1) == np.argmax(got[:, -1], -1)).all()
 
-    def test_int4_leaves_experts_full_precision(self):
+    def test_int4_quantizes_experts_packed(self):
+        """bits=4 covers expert weights too (the former full-precision
+        carve-out is gone): packed nibbles on the per-expert contraction
+        axis with group-wise scales, per the int4_expert_matmul layout."""
         cfg = self._moe_cfg()
         params = init_params(cfg, jax.random.PRNGKey(0))
         qp = quantize_params(cfg, params, bits=4)
         assert is_quantized(qp["layers"]["wq"])          # attention: int4
-        assert not is_quantized(qp["layers"]["we_gate"])  # experts: bf16
+        for name in ("we_gate", "we_up", "we_down"):
+            leaf = qp["layers"][name]
+            assert is_quantized(leaf), name
+            assert leaf["q4"].dtype == jnp.uint8
+            full = params["layers"][name]
+            # (L, X, in/2, out) — half the contraction axis, packed
+            assert leaf["q4"].shape == (full.shape[0], full.shape[1],
+                                        full.shape[2] // 2, full.shape[3])
+            # scale (L, X, g, 1, out): per-group along each expert's
+            # contraction axis
+            assert leaf["scale"].shape[-2:] == (1, full.shape[3])
+            assert leaf["scale"].shape[:2] == full.shape[:2]
+        assert not is_quantized(qp["layers"]["router"])  # accuracy-critical
 
     def test_moe_engine_serves_int8(self):
         from k8s_runpod_kubelet_tpu.workloads.serving import (ServingConfig,

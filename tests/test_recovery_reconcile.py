@@ -1,0 +1,127 @@
+"""Regression tests for the second review round: recovery resilience,
+reconcile reentrancy, preemption-requeue naming, DELETING status."""
+
+import threading
+
+import pytest
+
+from k8s_runpod_kubelet_tpu.cloud.types import QueuedResourceState as S
+from k8s_runpod_kubelet_tpu.kube import objects as ko
+from k8s_runpod_kubelet_tpu.provider import Provider
+from k8s_runpod_kubelet_tpu.provider.annotations import Annotations as A
+
+from harness import make_harness, make_pod
+
+
+@pytest.fixture()
+def h():
+    h = make_harness()
+    yield h
+    h.close()
+
+
+def bind_pod(h, pod):
+    created = h.kube.create_pod(pod)
+    h.provider.create_pod(created)
+    return h.kube.get_pod(ko.namespace(created), ko.name(created))
+
+
+class TestRecoveryResilience:
+    def test_cloud_outage_at_startup_does_not_fail_pods(self, h):
+        pod = bind_pod(h, make_pod(chips=16))
+        qr = ko.annotations(pod)[A.QUEUED_RESOURCE]
+        h.provider.update_all_pod_statuses()
+        # restart during a cloud blackout
+        h.fake.api_down = True
+        p2 = Provider(h.cfg, h.kube, h.tpu, clock=h.clock)
+        p2.load_running()
+        got = h.kube.get_pod("default", "train")
+        assert got["status"]["phase"] != "Failed"  # NOT falsely killed
+        assert ko.annotations(got)[A.QUEUED_RESOURCE] == qr  # binding intact
+        assert p2.instances["default/train"].qr_name == qr  # re-bound blind
+        # cloud comes back: reconcile completes the picture
+        h.fake.api_down = False
+        p2._probe_cloud(force=True)
+        p2.update_all_pod_statuses()
+        assert h.kube.get_pod("default", "train")["status"]["phase"] == "Running"
+
+    def test_one_bad_pod_does_not_abort_recovery_of_rest(self, h):
+        pod_a = bind_pod(h, make_pod(name="a", chips=16))
+        pod_b = bind_pod(h, make_pod(name="b", chips=16))
+        # pod a's slice will 500 on detailed-status during recovery
+        import k8s_runpod_kubelet_tpu.cloud.tpu_client as tc
+        p2 = Provider(h.cfg, h.kube, h.tpu, clock=h.clock)
+        real_detailed = p2.tpu.get_detailed_status
+        qr_a = ko.annotations(pod_a)[A.QUEUED_RESOURCE]
+
+        def flaky(name, zone=None):
+            if name == qr_a:
+                raise tc.TpuApiError("internal error", status=500)
+            return real_detailed(name, zone=zone)
+
+        p2.tpu.get_detailed_status = flaky
+        p2.load_running()
+        # b fully recovered, a recovered by annotation (not lost)
+        assert p2.instances["default/b"].qr_name
+        assert p2.instances["default/a"].qr_name == qr_a
+
+
+class TestReconcileReentrancy:
+    def test_concurrent_passes_single_gang_launch(self, h):
+        bind_pod(h, make_pod(chips=16))
+        barrier = threading.Barrier(2, timeout=5)
+        results = []
+
+        def run():
+            try:
+                barrier.wait()
+            except threading.BrokenBarrierError:
+                pass
+            h.provider.update_all_pod_statuses()
+            results.append(1)
+
+        threads = [threading.Thread(target=run) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        # exactly one workload launch despite two concurrent passes
+        qr = h.provider.instances["default/train"].qr_name
+        launches = [p for m, p in h.fake.request_log if p.endswith(":workload")]
+        assert len(launches) == 1
+        h.provider.update_all_pod_statuses()
+        assert h.kube.get_pod("default", "train")["status"]["phase"] == "Running"
+
+
+class TestPreemptionNaming:
+    def test_requeue_uses_fresh_slice_name(self, h):
+        h.cfg.preemption_requeue_limit = 1
+        pod = bind_pod(h, make_pod(chips=16))
+        qr1 = ko.annotations(pod)[A.QUEUED_RESOURCE]
+        h.provider.update_all_pod_statuses()
+        # make the dying slice LINGER (async delete, like the real API)
+        h.fake.preempt(qr1)
+        h.fake.stuck(qr1, S.SUSPENDED)
+        h.provider.update_all_pod_statuses()  # requeue
+        h.provider.process_pending_pods()     # redeploy
+        pod = h.kube.get_pod("default", "train")
+        qr2 = ko.annotations(pod)[A.QUEUED_RESOURCE]
+        assert qr2 != qr1  # never adopts the dying predecessor
+        assert qr2.endswith("-r1")
+        h.provider.update_all_pod_statuses()
+        assert h.kube.get_pod("default", "train")["status"]["phase"] == "Running"
+
+
+class TestDeletingStatus:
+    def test_deleting_never_reports_running_for_pending_pod(self, h):
+        pod = bind_pod(h, make_pod(chips=16))
+        qr = ko.annotations(pod)[A.QUEUED_RESOURCE]
+        # slice deleted out-of-band while pod was never launched
+        h.fake.stuck(qr, S.DELETING)
+        h.provider.update_all_pod_statuses()
+        status = h.kube.get_pod("default", "train")["status"]
+        assert status["phase"] == "Pending"
+        assert status["reason"] == "SliceDeleting"
+        # north-star metric did NOT record a bogus sample
+        obs = h.provider.metrics.get_observations("tpu_kubelet_schedule_to_ready_seconds")
+        assert obs == []

@@ -1,14 +1,17 @@
-"""Sharded (tensor-parallel) serving on the virtual CPU mesh: a 70B-class
-model spans chips, so the engine must run its prefill/decode/verify jits
-over a mesh with sharded params and a kv-heads-sharded KV cache — and
-produce exactly what the single-device engine produces (GSPMD shardings
-never change values)."""
+"""Sharded (tensor- and expert-parallel) serving on the virtual CPU mesh:
+a 70B-class model spans chips, so the engine must run its
+prefill/decode/verify jits over a mesh with sharded params and a
+kv-heads-sharded KV cache — and produce exactly what the single-device
+engine produces (GSPMD shardings never change values). MoE models
+additionally shard expert weights over the ``expert`` mesh axis
+(moe._expert_ffn_sharded's shard_map), composable with tensor parallelism,
+including int4 expert weights through the per-expert unpack kernel."""
 
 import jax
 import jax.numpy as jnp
 import pytest
 
-from k8s_runpod_kubelet_tpu.models import init_params, tiny_llama
+from k8s_runpod_kubelet_tpu.models import init_params, tiny_llama, tiny_moe
 from k8s_runpod_kubelet_tpu.parallel import MeshConfig, make_mesh
 from k8s_runpod_kubelet_tpu.workloads.serving import ServingConfig, ServingEngine
 
@@ -21,6 +24,12 @@ CFG = tiny_llama(vocab_size=128, embed_dim=64, n_layers=2, n_heads=4,
                  n_kv_heads=2, mlp_dim=128, max_seq_len=256,
                  dtype=jnp.float32, param_dtype=jnp.float32)
 
+# even dims throughout (int4 packs two contraction elements per byte)
+MOE = tiny_moe(vocab_size=128, embed_dim=64, n_layers=2, n_heads=4,
+               n_kv_heads=2, mlp_dim=64, max_seq_len=256,
+               n_experts=4, n_experts_per_tok=2,
+               dtype=jnp.float32, param_dtype=jnp.float32)
+
 G2 = tiny_llama(name="tiny-g2-sh", vocab_size=128, embed_dim=64, n_layers=4,
                 n_heads=4, n_kv_heads=2, head_dim=32, mlp_dim=128,
                 max_seq_len=256, sliding_window=8, sliding_window_pattern=2,
@@ -31,9 +40,9 @@ G2 = tiny_llama(name="tiny-g2-sh", vocab_size=128, embed_dim=64, n_layers=4,
 PROMPTS = [[5, 9, 2], [7, 3, 1, 4, 1, 5, 9, 2, 6], [11, 13]]
 
 
-def _mesh(tensor=2, data=1):
-    return make_mesh(MeshConfig(data=data, tensor=tensor),
-                     jax.devices()[:tensor * data])
+def _mesh(tensor=2, data=1, expert=1):
+    return make_mesh(MeshConfig(data=data, expert=expert, tensor=tensor),
+                     jax.devices()[:tensor * data * expert])
 
 
 def _engine(cfg, params, mesh=None, **kw):
@@ -160,18 +169,13 @@ class TestShardedServing:
             sharded.stop()
             plain.stop()
 
-    def test_mesh_rejects_int4_moe(self):
-        """Expert weights are int8-only; int4 x mesh on a MoE config stays
-        a loud error rather than silently serving f32 experts."""
-        from k8s_runpod_kubelet_tpu.models import tiny_moe
-        moe_cfg = tiny_moe(vocab_size=128, embed_dim=64, n_layers=2,
-                           n_heads=4, n_kv_heads=2, mlp_dim=64,
-                           dtype=jnp.float32, param_dtype=jnp.float32)
-        mesh = _mesh(tensor=2)
-        with pytest.raises(ValueError, match="int4 MoE"):
-            ServingEngine(moe_cfg, init_params(moe_cfg, jax.random.PRNGKey(0)),
-                          ServingConfig(slots=1, quantize_int4=True),
-                          mesh=mesh)
+    def test_mesh_rejects_expert_axis_on_dense_model(self):
+        """An expert mesh axis on a dense (or non-divisible) config is a
+        loud construction error, not a silently replicated axis."""
+        mesh = _mesh(tensor=1, expert=2)
+        with pytest.raises(ValueError, match="expert mesh axis"):
+            ServingEngine(CFG, init_params(CFG, jax.random.PRNGKey(0)),
+                          ServingConfig(slots=1), mesh=mesh)
 
     def test_tp2_kv_int8_cache(self):
         """int8 KV (cache-side) DOES compose with mesh serving: scales
@@ -191,6 +195,133 @@ class TestShardedServing:
         finally:
             plain.stop()
             sharded.stop()
+
+
+class TestExpertParallelServing:
+    """The EP tentpole's acceptance surface: EP-sharded MoE decode is
+    token-identical to the single-device engine on the hermetic 2x2 mesh
+    — plain decode, chunked prefill (PROMPTS[1] exceeds max_prefill_len=8),
+    and the speculative verify path — int4 expert weights included."""
+
+    def _host(self, key=0):
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_get(x), init_params(MOE,
+                                                     jax.random.PRNGKey(key)))
+
+    def test_ep2_matches_single_device(self):
+        """EP-only mesh (expert=2): expert weights shard their expert
+        axis; plain + chunked-prefill decode token-identical."""
+        plain = _engine(MOE, init_params(MOE, jax.random.PRNGKey(0)))
+        mesh = _mesh(tensor=1, expert=2)
+        sharded = _engine(MOE, init_params(MOE, jax.random.PRNGKey(0), mesh),
+                          mesh=mesh)
+        try:
+            we = sharded.params["layers"]["we_gate"]
+            assert len(we.sharding.device_set) == 2
+            for p in PROMPTS:
+                a = plain.submit(p, max_new_tokens=12).result(timeout=120)
+                b = sharded.submit(p, max_new_tokens=12).result(timeout=120)
+                assert a["tokens"] == b["tokens"], p
+        finally:
+            plain.stop()
+            sharded.stop()
+
+    def test_ep2_tp2_matches_single_device(self):
+        """EP x TP composed on the 2x2 mesh (expert=2, tensor=2): experts
+        shard both their expert axis AND their mlp axis; attention/KV
+        shard over tensor as before."""
+        plain = _engine(MOE, init_params(MOE, jax.random.PRNGKey(0)))
+        mesh = _mesh(tensor=2, expert=2)
+        sharded = _engine(MOE, init_params(MOE, jax.random.PRNGKey(0), mesh),
+                          mesh=mesh)
+        try:
+            we = sharded.params["layers"]["we_gate"]
+            assert len(we.sharding.device_set) == 4
+            assert len(sharded._cache["k"].sharding.device_set) == 4
+            for p in PROMPTS:
+                a = plain.submit(p, max_new_tokens=12).result(timeout=120)
+                b = sharded.submit(p, max_new_tokens=12).result(timeout=120)
+                assert a["tokens"] == b["tokens"], p
+        finally:
+            plain.stop()
+            sharded.stop()
+
+    def test_ep2_speculative_matches(self):
+        plain = _engine(MOE, init_params(MOE, jax.random.PRNGKey(0)),
+                        speculate_k=3)
+        mesh = _mesh(tensor=2, expert=2)
+        sharded = _engine(MOE, init_params(MOE, jax.random.PRNGKey(0), mesh),
+                          mesh=mesh, speculate_k=3)
+        try:
+            prompt = [3, 1, 4, 1, 5, 9, 2, 6, 3, 1, 4, 1, 5]
+            a = plain.submit(prompt, max_new_tokens=16).result(timeout=120)
+            b = sharded.submit(prompt, max_new_tokens=16).result(timeout=120)
+            assert a["tokens"] == b["tokens"]
+        finally:
+            plain.stop()
+            sharded.stop()
+
+    def test_ep2_int8_experts_match_single_device_int8(self):
+        """int8 expert weights under EP x TP: {q8, scale} leaves shard
+        expert + mlp axes, decode matches the single-device int8 engine."""
+        host = self._host()
+        plain = _engine(MOE, host, quantize_int8=True)
+        mesh = _mesh(tensor=2, expert=2)
+        sharded = _engine(MOE, host, mesh=mesh, quantize_int8=True)
+        try:
+            leaf = sharded.params["layers"]["we_gate"]
+            assert leaf["q8"].dtype == jnp.int8
+            assert len(leaf["q8"].sharding.device_set) == 4
+            for p in PROMPTS:
+                a = plain.submit(p, max_new_tokens=10).result(timeout=120)
+                b = sharded.submit(p, max_new_tokens=10).result(timeout=120)
+                assert a["tokens"] == b["tokens"], p
+        finally:
+            sharded.stop()
+            plain.stop()
+
+    def test_ep2_int4_experts_match_single_device_int4(self):
+        """int4 expert weights x EP (the formerly loud error): packed
+        expert leaves shard their EXPERT axis (tensor-replicated —
+        quantized_logical_axes bits=4 contract) and go through the
+        per-expert unpack kernel under shard_map. Tokens must be
+        IDENTICAL to the single-device int4 engine's — same quantized
+        numbers, shardings never change values."""
+        host = self._host()
+        plain = _engine(MOE, host, quantize_int4=True)
+        mesh = _mesh(tensor=2, expert=2)
+        sharded = _engine(MOE, host, mesh=mesh, quantize_int4=True)
+        try:
+            leaf = sharded.params["layers"]["we_gate"]
+            assert leaf["q4"].dtype == jnp.uint8
+            # sharded over the expert axis' 2 devices x replicated over
+            # tensor's 2 = spans all 4
+            assert len(leaf["q4"].sharding.device_set) == 4
+            for p in PROMPTS:
+                a = plain.submit(p, max_new_tokens=10).result(timeout=120)
+                b = sharded.submit(p, max_new_tokens=10).result(timeout=120)
+                assert a["tokens"] == b["tokens"], p
+        finally:
+            sharded.stop()
+            plain.stop()
+
+    def test_ep2_prefix_cache(self):
+        """Prefix-cache interaction: a registered prefix prefilled on the
+        EP mesh fans out into EP decode identically to the plain engine."""
+        mesh = _mesh(tensor=1, expert=2)
+        e = _engine(MOE, init_params(MOE, jax.random.PRNGKey(0), mesh),
+                    mesh=mesh)
+        plain = _engine(MOE, init_params(MOE, jax.random.PRNGKey(0)))
+        prefix = [7, 21, 3, 99, 14, 2, 81, 5, 40, 11]
+        try:
+            e.register_prefix(prefix)
+            a = e.submit(prefix + [42], max_new_tokens=8).result(timeout=120)
+            b = plain.submit(prefix + [42], max_new_tokens=8).result(timeout=120)
+            assert a["tokens"] == b["tokens"]
+            assert "tpu_serving_prefix_hits_total 1" in e.metrics.render()
+        finally:
+            e.stop()
+            plain.stop()
 
 
 def test_kv_cache_pspec_is_the_shared_contract():

@@ -27,7 +27,8 @@ a summary line each. RESOURCE_EXHAUSTED records are memory-boundary
 answers, not failures; only non-OOM compile failures exit nonzero.
 
 Usage: python tools/aot_check.py
-       python tools/aot_check.py --only train|serving|alt|flash|flash32k|ring|sharded
+       python tools/aot_check.py --only train|serving|alt|flash|flash32k|\
+ring|sharded|sharded_serving|ep_serving|mla
        (--only merges its subset over the existing evidence file)
 """
 
@@ -76,6 +77,8 @@ def _sds_tree(tree, sharding):
 def _analyze(compiled, *, tokens_per_step=None, model_flops_per_tok=None):
     """Cost + memory analysis -> derived v5e roofline bounds."""
     ca = compiled.cost_analysis() or {}
+    if isinstance(ca, (list, tuple)):   # some jax versions wrap the dict
+        ca = ca[0] if ca else {}
     ma = compiled.memory_analysis()
     flops = float(ca.get("flops", 0.0))
     byts = float(ca.get("bytes accessed", 0.0))
@@ -648,8 +651,7 @@ def _quantized_abs_shapes(cfg, bits: int = 8):
 
     params_abs = jax.eval_shape(lambda k: init_params(cfg, k),
                                 jax.random.PRNGKey(0))
-    quantized = (set(_LAYER_WEIGHTS) | set(_EXPERT_WEIGHTS) if bits == 8
-                 else set(_LAYER_WEIGHTS))   # experts are int8-only
+    quantized = set(_LAYER_WEIGHTS) | set(_EXPERT_WEIGHTS)
 
     def q(sd):
         if bits == 4:   # packed: (in/2, out) u8 + (g, 1, out) f32 scales
@@ -759,6 +761,126 @@ def check_sharded_serving(results):
     results["decode_70b_int4_tp8_2x4"] = _run(
         "decode_70b_int4_tp8_2x4",
         lambda: _cell("llama3_70b", "llama3-70b", bits=4))
+
+
+def _tree_bytes_per_chip(sds_tree) -> int:
+    """Per-chip bytes of a ShapeDtypeStruct tree whose leaves carry
+    NamedShardings: sum of each leaf's SHARD size. The memory-evidence
+    number AOT cost analysis cannot give (it reports whole-program HBM,
+    not which tree pays it)."""
+    import math
+
+    import jax
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(sds_tree):
+        shard = leaf.sharding.shard_shape(leaf.shape)
+        total += math.prod(shard) * leaf.dtype.itemsize
+    return total
+
+
+def _expert_bytes_per_chip(sds_tree) -> int:
+    """Per-chip bytes of just the EXPERT leaves (we_gate/we_up/we_down,
+    any quantized form) — the tree EP exists to divide."""
+    total = 0
+    for stack in ("layers", "prefix_layers"):
+        for name, leaf in sds_tree.get(stack, {}).items():
+            if name.startswith("we_"):
+                total += _tree_bytes_per_chip(leaf)
+    return total
+
+
+def check_ep_serving(results):
+    """Expert-parallel MoE decode over v5e:2x4 as EP4 x TP2: expert
+    weights shard their EXPERT axis (4-way) on top of tensor parallelism
+    (2-way), the expert FFN runs under moe._expert_ffn_sharded's
+    shard_map, and — the int4 cell — the per-expert Pallas unpack kernel
+    Mosaic-compiles inside it. Each record carries per-chip weight bytes
+    (computed from the shard shapes, not asserted) against a
+    tensor-only-at-the-same-TP-degree baseline: EP must divide the
+    expert bytes by the EP factor that tensor parallelism alone (TP2 +
+    replication over the remaining chips) cannot touch."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def prog(bits, ep=4, tp=2):
+        import os
+
+        from k8s_runpod_kubelet_tpu.models import LlamaModel, mixtral_8x7b
+        from k8s_runpod_kubelet_tpu.models.quant import quantized_logical_axes
+        from k8s_runpod_kubelet_tpu.parallel import (MeshConfig, make_mesh,
+                                                     param_shardings)
+        from k8s_runpod_kubelet_tpu.workloads.serving import kv_cache_pspec
+        topo = _topo("v5e:2x4")
+        mesh = make_mesh(MeshConfig(data=1, expert=ep, tensor=tp),
+                         list(topo.devices))
+        cfg = mixtral_8x7b()
+        model = LlamaModel(cfg, mesh)
+        slots, cache_len = 8, 2048
+        q_abs = _quantized_abs_shapes(cfg, bits=bits)
+        shardings = param_shardings(mesh,
+                                    quantized_logical_axes(cfg, bits=bits))
+        q_sds = jax.tree_util.tree_map(
+            lambda x, sh: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh),
+            q_abs, shardings)
+        # tensor-only baseline at the SAME TP degree: the other chips
+        # replicate — what the engine sharded like before the expert axis
+        # existed
+        base_mesh = make_mesh(MeshConfig(data=8 // tp, tensor=tp),
+                              list(topo.devices))
+        base_shardings = param_shardings(
+            base_mesh, quantized_logical_axes(cfg, bits=bits))
+        base_sds = jax.tree_util.tree_map(
+            lambda x, sh: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh),
+            q_abs, base_shardings)
+        cache_abs = jax.eval_shape(
+            lambda: model.init_cache(slots, cache_len, quantize=True))
+        repl = NamedSharding(mesh, P())
+        cache_sds = {
+            name: jax.ShapeDtypeStruct(
+                sd.shape, sd.dtype,
+                sharding=NamedSharding(mesh, kv_cache_pspec(name, sd.ndim)))
+            for name, sd in cache_abs.items()}
+        key = "TPU_KUBELET_FORCE_PALLAS"
+        prev = os.environ.get(key)
+        if bits == 4:
+            # AOT runs on a CPU host: force the Mosaic unpack kernel so
+            # the cell compiles the program production serves, not the
+            # XLA fallback (same discipline as the *pk dense cells)
+            os.environ[key] = "1"
+        try:
+            rec = _lower_decode(
+                model, q_sds, cache_sds, slots, repl,
+                f"mixtral-8x7b int{bits} decode, expert={ep} x tensor={tp} "
+                f"over v5e:2x4, {slots} slots int8 KV — expert-parallel MoE "
+                "serving compiled for the real target"
+                + (" (per-expert Pallas int4 unpack under shard_map)"
+                   if bits == 4 else ""))
+        finally:
+            if prev is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = prev
+        ep_chip = _expert_bytes_per_chip(q_sds)
+        tp_chip = _expert_bytes_per_chip(base_sds)
+        rec["weight_bytes_per_chip"] = _tree_bytes_per_chip(q_sds)
+        rec["weight_bytes_per_chip_tp_only"] = _tree_bytes_per_chip(base_sds)
+        rec["expert_bytes_per_chip"] = ep_chip
+        rec["expert_bytes_per_chip_tp_only"] = tp_chip
+        rec["expert_reduction_vs_tp_only"] = round(tp_chip / ep_chip, 2)
+        return rec
+
+    results["decode_mixtral_int8_ep4_tp2"] = _run(
+        "decode_mixtral_int8_ep4_tp2", lambda: prog(8))
+    results["decode_mixtral_int4_ep4_tp2"] = _run(
+        "decode_mixtral_int4_ep4_tp2", lambda: prog(4))
+    # int4's best shape is EP-heavy: packed experts replicate over tensor
+    # (their contraction cannot shard), so at EP4xTP2 the 2x packing win
+    # and the 2x tensor replication cancel — per-chip expert bytes equal
+    # int8's. EP8xTP1 keeps the full packing win: this cell records the
+    # int4-MoE memory headline (per-chip expert bytes ~half the EP4xTP2
+    # cells')
+    results["decode_mixtral_int4_ep8"] = _run(
+        "decode_mixtral_int4_ep8", lambda: prog(4, ep=8, tp=1))
 
 
 def check_mla(results, dev):
@@ -877,6 +999,7 @@ def main() -> int:
         ("ring", lambda: check_ring_flash(results)),
         ("sharded", lambda: check_sharded_train(results)),
         ("sharded_serving", lambda: check_sharded_serving(results)),
+        ("ep_serving", lambda: check_ep_serving(results)),
         ("mla", lambda: check_mla(results, dev)),
     ]
     names = [n for n, _ in checks]

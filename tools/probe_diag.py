@@ -153,6 +153,35 @@ def _tcp_connect_report(ports: list[int], timeout_s: float = 3.0) -> dict:
     return out
 
 
+def _relay_port_hints() -> list[int]:
+    """Ports the axon relay is CONFIGURED to use, from PALLAS_AXON_* env:
+    explicit single ports (PALLAS_AXON_RELAY_PORT / PALLAS_AXON_PORT),
+    host:port entries in PALLAS_AXON_POOL_IPS, and an inclusive
+    PALLAS_AXON_PORT_RANGE ("8470-8479"). Empty when nothing is
+    configured — the caller then falls back to the bounded scan."""
+    ports: set[int] = set()
+    for var in ("PALLAS_AXON_RELAY_PORT", "PALLAS_AXON_PORT"):
+        val = os.environ.get(var, "")
+        for part in val.split(","):
+            part = part.strip()
+            if part.isdigit():
+                ports.add(int(part))
+    for entry in os.environ.get("PALLAS_AXON_POOL_IPS", "").split(","):
+        _, sep, port = entry.strip().rpartition(":")
+        if sep and port.isdigit():
+            ports.add(int(port))
+    rng = os.environ.get("PALLAS_AXON_PORT_RANGE", "")
+    if "-" in rng:
+        lo, _, hi = rng.partition("-")
+        if lo.strip().isdigit() and hi.strip().isdigit():
+            lo_i, hi_i = int(lo), int(hi)
+            # inclusive (a single-port "8470-8470" range is a valid hint);
+            # bounded: a typo'd range must not enumerate the port space
+            if 0 <= hi_i - lo_i < 1024:
+                ports.update(range(lo_i, hi_i + 1))
+    return sorted(p for p in ports if 0 < p < 65536)
+
+
 def _listening_ports() -> list[int]:
     """Local listening TCP ports from /proc/net/tcp{,6} (no psutil). The
     axon relay lives on localhost — if nothing is listening, the PJRT dial
@@ -240,11 +269,19 @@ def main() -> int:
                        "PALLAS_AXON_TPU_GEN")},
               "listening_ports": _listening_ports(),
               "variants": []}
-    # connect-probe only a bounded, relay-plausible subset: every listener
-    # on the box would block ~3s each and poke unrelated services (ssh
-    # forwards, one-shot accept loops)
-    report["tcp_connect"] = _tcp_connect_report(
-        report["listening_ports"][:8])
+    # connect-probe only relay-plausible candidates: a connect consumes a
+    # pending accept, so poking every listener on the box (ssh forwards,
+    # one-shot accept loops — including, ironically, a fragile relay's
+    # sibling services) is harm, not diagnosis. When PALLAS_AXON_* env
+    # names the relay's ports, probe exactly those — INCLUDING ones with
+    # no listener (connecting to a dead port is harmless and an instant
+    # "connection refused on 8470" is the relay-down-vs-wedged evidence
+    # this report exists for); only with no hint at all fall back to the
+    # bounded first-8 listener scan.
+    hints = _relay_port_hints()
+    candidates = hints if hints else report["listening_ports"][:8]
+    report["relay_port_hints"] = hints
+    report["tcp_connect"] = _tcp_connect_report(candidates)
     for name, overrides, deletes, expect in _VARIANTS:
         rec = run_variant(name, overrides, deletes, budget, expect)
         report["variants"].append(rec)
