@@ -37,6 +37,7 @@ from ..metrics import Metrics
 from ..node import (KubeletApiServer, NodeController, PodController,
                     RefResourceController)
 from ..provider import Provider
+from ..tracing import Tracer
 
 log = logging.getLogger("tpu-kubelet")
 
@@ -79,6 +80,11 @@ def parse_flags(argv: list[str]) -> argparse.Namespace:
                    help="workload launch/status path: 'ssh' drives docker on "
                         "the TPU VMs (real Cloud TPU API); 'api' uses the "
                         ":workload/:detailed aggregator endpoints")
+    p.add_argument("--trace-export", dest="trace_export_path", default=None,
+                   help="append pod-lifecycle spans (deploy/provisioning/"
+                        "gang-launch/ready) to this JSONL file; render with "
+                        "tools/trace_summary.py. Empty = in-memory ring "
+                        "only, served at the health server's /debug/traces")
     return p.parse_args(argv)
 
 
@@ -90,6 +96,10 @@ def build(cfg: config_mod.Config, kube=None, tpu=None, worker_transport=None,
     from ..cloud import SshWorkloadBackend
 
     metrics = Metrics()
+    # one tracer per process: pod-lifecycle spans land in the ring behind
+    # the health server's /debug/traces (and the JSONL export when set)
+    tracer = Tracer(max_spans=cfg.trace_ring_size,
+                    export_path=cfg.trace_export_path)
     kube = kube or RealKubeClient.from_env(cfg.kubeconfig)
     gang = GangExecutor(worker_transport or SshWorkerTransport(
         killable_exec=cfg.exec_killable))
@@ -138,7 +148,8 @@ def build(cfg: config_mod.Config, kube=None, tpu=None, worker_transport=None,
     tpu = tpu or TpuClient(transport, project=cfg.project, zone=cfg.zone,
                            workload_backend=backend,
                            quota_transport=quota_transport)
-    provider = Provider(cfg, kube, tpu, gang_executor=gang, metrics=metrics)
+    provider = Provider(cfg, kube, tpu, gang_executor=gang, metrics=metrics,
+                        tracer=tracer)
     node_controller = NodeController(kube, provider,
                                      status_interval_s=cfg.node_status_interval_s)
     pod_controller = PodController(kube, provider, cfg.node_name,
@@ -151,7 +162,7 @@ def build(cfg: config_mod.Config, kube=None, tpu=None, worker_transport=None,
                                   tls_key=cfg.tls_key_file,
                                   auth_token=cfg.api_auth_token)
     health = HealthServer(cfg.health_address, ready_func=provider.ping,
-                          metrics=metrics)
+                          metrics=metrics, tracer=tracer)
     return (provider, node_controller, pod_controller, ref_controller,
             api_server, health)
 
@@ -221,6 +232,7 @@ def main(argv=None) -> int:
     nc.stop()
     api.stop()
     health.stop()
+    provider.tracer.close()  # flush the JSONL span export (daemon writer)
     log.info("shutdown complete")
     return 0
 

@@ -99,6 +99,10 @@ class Config:
     log_level: str = "info"
     sentry_url: str = ""
 
+    # tracing (pod-lifecycle spans; serving has its own --trace-export)
+    trace_export_path: str = ""   # JSONL span export; "" = in-memory ring only
+    trace_ring_size: int = 2048   # bounded span ring behind /debug/traces
+
     # paths
     kubeconfig: str = ""
 
@@ -117,6 +121,8 @@ class Config:
                         f"got {self.workload_path!r}")
         if self.zones and self.zone not in self.zones:
             errs.append(f"zone {self.zone!r} not in allowed zones {self.zones}")
+        if self.trace_ring_size <= 0:
+            errs.append("trace_ring_size must be > 0")
         if errs:
             raise ValueError("invalid config: " + "; ".join(errs))
         return self
@@ -134,6 +140,7 @@ _ENV_MAP = {
     "SENTRY_URL": "sentry_url",
     "LOG_LEVEL": "log_level",
     "TPU_MAX_TOTAL_CHIPS": "max_total_chips",
+    "TPU_TRACE_EXPORT_PATH": "trace_export_path",
 }
 
 

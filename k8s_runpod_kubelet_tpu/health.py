@@ -1,19 +1,29 @@
-"""Health + metrics server.
+"""Health + metrics + debug server.
 
 Parity with the reference's standalone health server (health.go:1-74:
 /healthz = liveness flag, /readyz = flag AND readyFunc — wired to provider.Ping
-at main.go:397-402), plus /metrics (Prometheus text) which the reference
-lacks entirely (SURVEY.md §5.5).
+at main.go:397-402), plus the observability surface the reference lacks
+entirely (SURVEY.md §5.5):
+
+  /metrics       Prometheus text (counters/gauges/histograms)
+  /debug/traces  recent finished spans as JSON; ?trace_id= filters to one
+                 trace (the span tree a traceparent header names)
+  /debug/engine  statusz-style snapshot from the injected callable (the
+                 serving engine's in-flight slots / queue / cache occupancy;
+                 404 when the process has no engine, e.g. the kubelet)
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
 from .metrics import Metrics
+from .tracing import Tracer
 
 log = logging.getLogger(__name__)
 
@@ -31,13 +41,17 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_json(self, status: int, payload) -> None:
+        self._send(status, json.dumps(payload).encode(), "application/json")
+
     def do_GET(self):
         hs = self.server_ref
-        if self.path == "/healthz":
+        path = urllib.parse.urlparse(self.path)
+        if path.path == "/healthz":
             if hs.healthy.is_set():
                 return self._send(200, b"ok")
             return self._send(503, b"unhealthy")
-        if self.path == "/readyz":
+        if path.path == "/readyz":
             ready = hs.healthy.is_set()
             if ready and hs.ready_func is not None:
                 try:
@@ -47,19 +61,32 @@ class _Handler(BaseHTTPRequestHandler):
                     ready = False
             return self._send(200 if ready else 503,
                               b"ready" if ready else b"not ready")
-        if self.path == "/metrics" and hs.metrics is not None:
+        if path.path == "/metrics" and hs.metrics is not None:
             return self._send(200, hs.metrics.render().encode(),
                               "text/plain; version=0.0.4")
+        if path.path == "/debug/traces" and hs.tracer is not None:
+            q = urllib.parse.parse_qs(path.query)
+            return self._send_json(200, hs.tracer.query(
+                (q.get("trace_id") or [""])[0]))
+        if path.path == "/debug/engine" and hs.engine_status is not None:
+            try:
+                return self._send_json(200, hs.engine_status())
+            except Exception as e:  # noqa: BLE001 — debug must not 500-loop
+                return self._send_json(500, {"error": str(e)})
         self._send(404, b"not found")
 
 
 class HealthServer:
     def __init__(self, address: str = ":8080",
                  ready_func: Optional[Callable[[], bool]] = None,
-                 metrics: Optional[Metrics] = None):
+                 metrics: Optional[Metrics] = None,
+                 tracer: Optional[Tracer] = None,
+                 engine_status: Optional[Callable[[], dict]] = None):
         host, _, port = address.rpartition(":")
         self.ready_func = ready_func
         self.metrics = metrics
+        self.tracer = tracer
+        self.engine_status = engine_status
         self.healthy = threading.Event()
         self.healthy.set()
         handler = type("BoundHandler", (_Handler,), {"server_ref": self})
@@ -73,7 +100,8 @@ class HealthServer:
 
     def start(self) -> "HealthServer":
         self._thread.start()
-        log.info("health server on :%d (/healthz /readyz /metrics)", self.port)
+        log.info("health server on :%d (/healthz /readyz /metrics "
+                 "/debug/traces /debug/engine)", self.port)
         return self
 
     @property

@@ -15,6 +15,7 @@ import json
 import logging
 import queue
 import threading
+import traceback
 import urllib.request
 from typing import Optional
 
@@ -49,6 +50,15 @@ class ErrorSinkHandler(logging.Handler):
             "environment": self.environment,
             "timestamp": record.created,
         }
+        if record.exc_info:
+            # log.exception() callers post the traceback, not a bare message
+            # — a sink event without the stack is useless for the crash it
+            # exists to report. exc_text caches the formatting across
+            # multi-handler setups (the stdlib Formatter convention).
+            if not record.exc_text:
+                record.exc_text = "".join(
+                    traceback.format_exception(*record.exc_info)).rstrip()
+            event["exception"] = record.exc_text
         self.recent.append(event)
         try:
             self._queue.put_nowait(event)
@@ -56,10 +66,15 @@ class ErrorSinkHandler(logging.Handler):
             self.dropped += 1
 
     def close(self):
+        """Flush: queue the sentinel BEHIND any pending events (FIFO) and
+        join the worker, so the last error before a shutdown/crash actually
+        reaches the sink instead of racing a daemon-thread exit. Bounded:
+        a wedged sink can delay close by ~the post timeout, never hang it."""
         try:
-            self._queue.put_nowait(None)  # wake the worker so it can exit
+            self._queue.put(None, timeout=1.0)
         except queue.Full:
-            pass
+            pass  # worker is far behind; the bounded join below still applies
+        self._worker.join(timeout=self.timeout_s + 2.0)
         super().close()
 
     def _drain(self):

@@ -3,8 +3,14 @@
 The reference has NO metrics (SURVEY.md §5.5: GetStatsSummary/GetMetricsResource
 left nil). This build makes the north-star metric first-class: the
 schedule->first-step latency is recorded as a histogram per pod, alongside
-deploy/reconcile timings and slice-state gauges, served as Prometheus text on
-the health server's /metrics.
+deploy/reconcile timings, slice-state gauges, and the serving SLO histograms
+(TTFT / inter-token latency, sub-second buckets via per-metric ``describe``),
+served as Prometheus text on the health server's /metrics.
+
+Exposition follows the Prometheus text format rules scrapers actually
+enforce: counters are exposed (HELP/TYPE and samples alike) under the
+``<name>_total`` family name, every family carries a ``# TYPE`` line, and
+label values escape ``\\``, ``"`` and newlines.
 """
 
 from __future__ import annotations
@@ -19,18 +25,21 @@ _DEFAULT_BUCKETS = (0.5, 1, 2.5, 5, 10, 30, 60, 120, 300, 600, 1800)
 class _Hist:
     """Fixed-size cumulative buckets + sum/count, plus a bounded tail of raw
     observations for tests/debugging — memory stays O(buckets) for a process
-    meant to run for months."""
+    meant to run for months. Bucket bounds are per-histogram (describe(...,
+    buckets=...)): sub-second TTFT/ITL histograms must not be crushed into a
+    0.5s first bucket sized for pod-provisioning latencies."""
 
-    __slots__ = ("bucket_counts", "sum", "count", "recent")
+    __slots__ = ("buckets", "bucket_counts", "sum", "count", "recent")
 
-    def __init__(self):
-        self.bucket_counts = [0] * len(_DEFAULT_BUCKETS)
+    def __init__(self, buckets: tuple = _DEFAULT_BUCKETS):
+        self.buckets = buckets
+        self.bucket_counts = [0] * len(buckets)
         self.sum = 0.0
         self.count = 0
         self.recent: list[float] = []
 
     def observe(self, value: float):
-        for i, b in enumerate(_DEFAULT_BUCKETS):
+        for i, b in enumerate(self.buckets):
             if value <= b:
                 self.bucket_counts[i] += 1
         self.sum += value
@@ -47,13 +56,23 @@ class Metrics:
         self.gauges: dict[tuple[str, tuple], float] = {}
         self.histograms: dict[tuple[str, tuple], _Hist] = {}
         self.help: dict[str, str] = {}
+        self.bucket_spec: dict[str, tuple] = {}  # name -> histogram bounds
 
     @staticmethod
     def _key(name: str, labels: Optional[dict]) -> tuple[str, tuple]:
         return name, tuple(sorted((labels or {}).items()))
 
-    def describe(self, name: str, help_text: str):
+    def describe(self, name: str, help_text: str,
+                 buckets: Optional[tuple] = None):
+        """HELP text for a metric; for histograms, optionally its bucket
+        bounds (applied to label-sets created AFTER the describe — declare
+        before first observe, as every call site in this repo does)."""
         self.help[name] = help_text
+        if buckets is not None:
+            bounds = tuple(sorted(float(b) for b in buckets))
+            if not bounds:
+                raise ValueError(f"{name}: buckets must be non-empty")
+            self.bucket_spec[name] = bounds
 
     def incr(self, name: str, value: float = 1.0, labels: Optional[dict] = None):
         k = self._key(name, labels)
@@ -66,7 +85,12 @@ class Metrics:
 
     def observe(self, name: str, value: float, labels: Optional[dict] = None):
         with self.lock:
-            self.histograms.setdefault(self._key(name, labels), _Hist()).observe(value)
+            key = self._key(name, labels)
+            h = self.histograms.get(key)
+            if h is None:
+                h = self.histograms[key] = _Hist(
+                    self.bucket_spec.get(name, _DEFAULT_BUCKETS))
+            h.observe(value)
 
     def time_block(self, name: str, labels: Optional[dict] = None):
         return _Timer(self, name, labels)
@@ -82,11 +106,31 @@ class Metrics:
     # -- exposition ------------------------------------------------------------
 
     @staticmethod
-    def _labels_str(labels: tuple) -> str:
+    def _esc_label(v) -> str:
+        """Label-value escaping per the exposition format: backslash first,
+        then quote and newline."""
+        return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+                .replace("\n", "\\n"))
+
+    @staticmethod
+    def _esc_help(v: str) -> str:
+        return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+    @classmethod
+    def _labels_str(cls, labels: tuple) -> str:
         if not labels:
             return ""
-        inner = ",".join(f'{k}="{v}"' for k, v in labels)
+        inner = ",".join(f'{k}="{cls._esc_label(v)}"' for k, v in labels)
         return "{" + inner + "}"
+
+    def _header(self, out: list[str], family: str, base_name: str, kind: str):
+        """HELP (if described) + TYPE under the EXPOSED family name: a
+        counter described as ``foo`` but sampled as ``foo_total`` must put
+        its metadata on ``foo_total`` too, or scrapers see two different
+        metrics (one with metadata and no samples, one untyped)."""
+        if base_name in self.help:
+            out.append(f"# HELP {family} {self._esc_help(self.help[base_name])}")
+        out.append(f"# TYPE {family} {kind}")
 
     def render(self) -> str:
         """Prometheus text exposition format."""
@@ -94,26 +138,32 @@ class Metrics:
         with self.lock:
             names = sorted({n for n, _ in (*self.counters, *self.gauges, *self.histograms)})
             for name in names:
-                if name in self.help:
-                    out.append(f"# HELP {name} {self.help[name]}")
-                for (n, lbls), v in sorted(self.counters.items()):
-                    if n == name:
+                counter_items = sorted((k, v) for k, v in self.counters.items()
+                                       if k[0] == name)
+                gauge_items = sorted((k, v) for k, v in self.gauges.items()
+                                     if k[0] == name)
+                hist_items = sorted(((k, h) for k, h in self.histograms.items()
+                                     if k[0] == name), key=lambda kv: kv[0])
+                if counter_items:
+                    self._header(out, f"{name}_total", name, "counter")
+                    for (_, lbls), v in counter_items:
                         out.append(f"{name}_total{self._labels_str(lbls)} {v}")
-                for (n, lbls), v in sorted(self.gauges.items()):
-                    if n == name:
+                if gauge_items:
+                    self._header(out, name, name, "gauge")
+                    for (_, lbls), v in gauge_items:
                         out.append(f"{name}{self._labels_str(lbls)} {v}")
-                for (n, lbls), h in sorted(self.histograms.items()):
-                    if n != name:
-                        continue
-                    for b, c in zip(_DEFAULT_BUCKETS, h.bucket_counts):
+                if hist_items:
+                    self._header(out, name, name, "histogram")
+                    for (_, lbls), h in hist_items:
+                        for b, c in zip(h.buckets, h.bucket_counts):
+                            lb = dict(lbls)
+                            lb["le"] = str(b)
+                            out.append(f"{name}_bucket{self._labels_str(tuple(sorted(lb.items())))} {c}")
                         lb = dict(lbls)
-                        lb["le"] = str(b)
-                        out.append(f"{name}_bucket{self._labels_str(tuple(sorted(lb.items())))} {c}")
-                    lb = dict(lbls)
-                    lb["le"] = "+Inf"
-                    out.append(f"{name}_bucket{self._labels_str(tuple(sorted(lb.items())))} {h.count}")
-                    out.append(f"{name}_sum{self._labels_str(lbls)} {h.sum}")
-                    out.append(f"{name}_count{self._labels_str(lbls)} {h.count}")
+                        lb["le"] = "+Inf"
+                        out.append(f"{name}_bucket{self._labels_str(tuple(sorted(lb.items())))} {h.count}")
+                        out.append(f"{name}_sum{self._labels_str(lbls)} {h.sum}")
+                        out.append(f"{name}_count{self._labels_str(lbls)} {h.count}")
         return "\n".join(out) + "\n"
 
 

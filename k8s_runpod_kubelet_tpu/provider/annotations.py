@@ -48,6 +48,12 @@ class Annotations:
     # bookkeeping
     EXTERNAL = "tpu.dev/external"                   # adopted orphan (kubelet.go:1580)
     PREEMPTION_COUNT = "tpu.dev/preemption-count"
+    # observability: the trace_id shared by this pod's lifecycle spans
+    # (create -> deploy -> ACTIVE -> ready). Durable on the pod so a slow
+    # serving request on the slice can be joined back to how it was born
+    # (clients send it as the traceparent trace id; /debug/traces?trace_id=
+    # then shows provisioning AND serving spans in one tree).
+    TRACE_ID = "tpu.dev/trace-id"
 
     VALID_CAPACITY_TYPES = ("on-demand", "spot", "reserved")
 

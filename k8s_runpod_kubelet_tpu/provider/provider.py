@@ -28,6 +28,7 @@ from ..gang import GangExecutor
 from ..kube.client import KubeApiError, KubeClient
 from ..kube import objects as ko
 from ..metrics import Metrics
+from ..tracing import Tracer
 from .annotations import Annotations as A
 from .node_spec import build_node
 from .reconcile import ReconcileMixin
@@ -59,12 +60,24 @@ class InstanceInfo:
     # pending-deploy bookkeeping (kubelet.go:747-814)
     pending_since: Optional[float] = None
     last_deploy_error: str = ""
+    # when the CURRENT slice's queued resource was created (reset on
+    # preemption requeue): the pod.provisioning span must time the current
+    # attempt's cloud wait, not the pod's whole life since schedule
+    deployed_at: Optional[float] = None
     # north-star latency timestamps
     created_at: float = 0.0
     active_at: Optional[float] = None
     launched_at: Optional[float] = None
     ready_at: Optional[float] = None
     preemption_count: int = 0
+    # lifecycle tracing: all of this pod's spans share trace_id (also
+    # annotated on the pod as tpu.dev/trace-id); trace_root is the
+    # pod.lifecycle root span id the phase spans parent under — derived
+    # DETERMINISTICALLY as trace_id[:16] so spans recorded before and
+    # after a kubelet restart (recovery restores only the trace_id) still
+    # parent under the same root
+    trace_id: str = ""
+    trace_root: str = ""
 
 
 @dataclasses.dataclass
@@ -83,13 +96,19 @@ class Provider(ReconcileMixin, RecoveryMixin):
     def __init__(self, cfg: Config, kube: KubeClient, tpu: TpuClient,
                  gang_executor: Optional[GangExecutor] = None,
                  metrics: Optional[Metrics] = None,
-                 clock: Callable[[], float] = time.time):
+                 clock: Callable[[], float] = time.time,
+                 tracer: Optional[Tracer] = None):
         self.cfg = cfg
         self.kube = kube
         self.tpu = tpu
         self.gang = gang_executor
         self.clock = clock
         self.metrics = metrics or Metrics()
+        # pod-lifecycle spans (deploy/provisioning/gang-launch/ready) share
+        # the injected clock so FakeClock tests see honest durations.
+        # `is None`, not `or`: an injected EMPTY tracer is falsy (len 0)
+        # and `or` would silently disconnect it from the health server
+        self.tracer = tracer if tracer is not None else Tracer(clock=clock)
 
         self.lock = threading.RLock()
         self._reconcile_guard = threading.Lock()  # one reconcile pass at a time
@@ -113,7 +132,23 @@ class Provider(ReconcileMixin, RecoveryMixin):
 
         self.metrics.describe("tpu_kubelet_schedule_to_ready_seconds",
                               "pod bound -> gang running (north-star latency)")
+        self.metrics.describe("tpu_kubelet_schedule_to_active_seconds",
+                              "pod bound -> slice ACTIVE")
         self.metrics.describe("tpu_kubelet_deploys", "queued-resource create attempts")
+        self.metrics.describe("tpu_kubelet_cloud_healthy",
+                              "TPU API health probe result (1 = healthy)")
+        self.metrics.describe("tpu_kubelet_chip_quota",
+                              "live cloud chip quota (-1 = unreadable)")
+        self.metrics.describe("tpu_kubelet_slices_released",
+                              "slices deleted after their pod went terminal")
+        self.metrics.describe("tpu_kubelet_preemption_requeues",
+                              "preempted slices resubmitted instead of failed")
+        self.metrics.describe("tpu_kubelet_gang_launches",
+                              "all-worker workload launches on ACTIVE slices")
+        self.metrics.describe("tpu_kubelet_missing_slices",
+                              "pods whose slice vanished out from under them")
+        self.metrics.describe("tpu_kubelet_loop_seconds",
+                              "background control-loop iteration latency")
         self._probe_cloud(force=True)
 
     # -- helpers ---------------------------------------------------------------
@@ -235,6 +270,12 @@ class Provider(ReconcileMixin, RecoveryMixin):
             info = self.instances.get(key) or InstanceInfo()
             info.created_at = info.created_at or now
             info.pending_since = info.pending_since or now
+            if not info.trace_id:
+                # a re-created pod carrying the annotation keeps its trace
+                # (the spans join up across kubelet restarts)
+                info.trace_id = (ko.annotations(pod).get(A.TRACE_ID)
+                                 or Tracer.new_trace_id())
+            info.trace_root = info.trace_root or info.trace_id[:16]
             self.instances[key] = info
         log.info("CreatePod %s", key)
         self.deploy_pod(pod)
@@ -307,6 +348,7 @@ class Provider(ReconcileMixin, RecoveryMixin):
                         "(parity: kubelet.go:458-460)", key)
             return False
         self.metrics.incr("tpu_kubelet_deploys")
+        deploy_started = self.clock()
         try:
             params = prepare_tpu_parameters(self.kube, pod, self.cfg)
         except TranslationError as e:
@@ -351,6 +393,16 @@ class Provider(ReconcileMixin, RecoveryMixin):
             info.cost_per_hr = cost
             info.pending_since = None
             info.last_deploy_error = ""
+            info.deployed_at = self.clock()
+            if not info.trace_id:  # deploy without create_pod (tests/tools)
+                info.trace_id = Tracer.new_trace_id()
+            info.trace_root = info.trace_root or info.trace_id[:16]
+            trace_id, trace_root = info.trace_id, info.trace_root
+        self.tracer.record("pod.deploy", deploy_started, self.clock(),
+                           trace_id=trace_id, parent_id=trace_root,
+                           attrs={"pod": key, "slice": qr.name,
+                                  "accelerator": qr.accelerator_type,
+                                  "zone": params.zone})
         self._annotate_binding(pod, qr.name, params.zone, qr.accelerator_type, cost)
         log.info("deployed %s -> slice %s (%s, $%.2f/hr, state %s)",
                  key, qr.name, qr.accelerator_type, cost, qr.state.value)
@@ -363,12 +415,21 @@ class Provider(ReconcileMixin, RecoveryMixin):
                           accelerator: str, cost: float):
         """Write the durable binding annotations
         (parity: updatePodWithRunPodInfo kubelet.go:505-562)."""
-        patch = {"metadata": {"annotations": {
+        with self.lock:
+            info = self.instances.get(self.key_of(pod))
+            trace_id = info.trace_id if info else ""
+        anns = {
             A.QUEUED_RESOURCE: qr_name,
             A.ZONE: zone,
             A.ACCELERATOR_TYPE: accelerator,
             A.COST_PER_HR: f"{cost:.4f}",
-        }}}
+        }
+        if trace_id:
+            # the durable join key: a serving request on this slice sends
+            # this as its traceparent trace id to land in the same tree as
+            # the provisioning spans
+            anns[A.TRACE_ID] = trace_id
+        patch = {"metadata": {"annotations": anns}}
         try:
             updated = self.kube.patch_pod(ko.namespace(pod), ko.name(pod), patch)
             with self.lock:

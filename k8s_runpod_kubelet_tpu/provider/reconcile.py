@@ -26,6 +26,7 @@ from ..cloud.types import QueuedResourceState as S
 from ..gang.env import compute_worker_env
 from ..kube.client import KubeApiError
 from ..kube import objects as ko
+from ..tracing import Tracer
 from .annotations import Annotations as A, AnnotationResolver
 from .status import gang_ready, status_fingerprint, translate_status
 from .translate import prepare_tpu_parameters, TranslationError
@@ -68,6 +69,29 @@ class ReconcileMixin:
                 log.exception("reconcile %s failed: %s", key, e)
 
     def _reconcile_one(self, key: str, pod: dict, info):
+        if not info.trace_id or not info.trace_root:
+            # recovered/adopted pods may arrive without (full) trace ids —
+            # prefer the pod's annotation, mint otherwise; the root is
+            # trace_id[:16] (deterministic), so a restart that restored only
+            # the annotated trace_id re-parents the remaining lifecycle
+            # spans under the SAME pre-restart root
+            annotated = ko.annotations(pod).get(A.TRACE_ID, "")
+            info.trace_id = info.trace_id or annotated or Tracer.new_trace_id()
+            info.trace_root = info.trace_root or info.trace_id[:16]
+            if not annotated:
+                # write the durable join key back (adopted orphans and pods
+                # whose deploy-time annotate never landed): the NEXT restart
+                # must restore this trace_id, not mint a third one
+                try:
+                    ns, name = key.split("/", 1)
+                    updated = self.kube.patch_pod(ns, name, {"metadata": {
+                        "annotations": {A.TRACE_ID: info.trace_id}}})
+                    with self.lock:
+                        if key in self.pods:
+                            self.pods[key] = updated
+                except KubeApiError as e:
+                    log.debug("trace-id annotate of %s failed (will retry "
+                              "next sweep): %s", key, e)
         detailed = self.tpu.get_detailed_status(info.qr_name, zone=info.zone)
         state = detailed.resource.state
 
@@ -80,6 +104,18 @@ class ReconcileMixin:
             info.active_at = now
             self.metrics.observe("tpu_kubelet_schedule_to_active_seconds",
                                  now - info.created_at)
+            # cloud-side provisioning wait: queued-resource accepted ->
+            # slice ACTIVE (the phase Gavel-style schedulers attribute
+            # placement cost to). Starts at the CURRENT attempt's deploy,
+            # not created_at: after a preemption requeue the span must time
+            # this slice's wait, not the pod's whole prior life.
+            self.tracer.record("pod.provisioning",
+                               info.deployed_at or info.created_at, now,
+                               trace_id=info.trace_id,
+                               parent_id=info.trace_root,
+                               attrs={"pod": key, "slice": info.qr_name,
+                                      "accelerator": info.accelerator_type,
+                                      "attempt": info.preemption_count})
         if not info.workload_launched and detailed.runtime:
             # a previous launch succeeded server-side but we never saw the
             # response (lost HTTP reply / restart) — adopt it, don't relaunch
@@ -119,14 +155,37 @@ class ReconcileMixin:
                         and any(c.get("type") == "Ready" and c.get("status") == "True"
                                 for c in status.get("conditions", [])))
             ready_now = is_ready and not info.ready
+            first_ready = ready_now and info.ready_at is None
             info.ready = is_ready
-            if ready_now and info.ready_at is None:
+            if first_ready:
                 info.ready_at = now
                 self.metrics.observe("tpu_kubelet_schedule_to_ready_seconds",
                                      now - info.created_at)
                 log.info("pod %s gang is RUNNING %.1fs after schedule "
                          "(north-star latency)", key, now - info.created_at)
         if ready_now:
+            # readiness wait (launch -> all workers Running), recorded per
+            # attempt (a preemption requeue re-enters ready)
+            start_ready = info.launched_at or info.active_at or info.created_at
+            self.tracer.record("pod.ready_wait", start_ready, now,
+                               trace_id=info.trace_id,
+                               parent_id=info.trace_root,
+                               attrs={"pod": key, "slice": info.qr_name,
+                                      "attempt": info.preemption_count})
+            if first_ready:
+                # the ROOT span the phase spans parent under — ONCE, like
+                # the north-star metric (a requeue re-ready must not emit a
+                # duplicate span_id into the ring/export); recorded last so
+                # exports stream children-first but the tree is complete the
+                # moment the pod serves traffic
+                self.tracer.record("pod.lifecycle", info.created_at, now,
+                                   trace_id=info.trace_id,
+                                   span_id=info.trace_root,
+                                   attrs={"pod": key, "slice": info.qr_name,
+                                          "accelerator":
+                                              info.accelerator_type,
+                                          "schedule_to_ready_s":
+                                              now - info.created_at})
             self.emit_event(pod, "GangRunning",
                             f"all workers of {info.qr_name} running "
                             f"{now - info.created_at:.1f}s after schedule")
@@ -189,6 +248,8 @@ class ReconcileMixin:
             info.ready = False
             info.fingerprint = ()
             info.active_at = None
+            info.deployed_at = None  # next attempt's provisioning span must
+            # start at ITS deploy, not this dead slice's
             info.pending_since = self.clock()
         self.metrics.incr("tpu_kubelet_preemption_requeues")
 
@@ -208,6 +269,7 @@ class ReconcileMixin:
         except TranslationError as e:
             log.error("gang launch of %s: translation failed post-deploy: %s", key, e)
             return
+        launch_started = self.clock()
         try:
             self.tpu.start_workload(info.qr_name, params.workload,
                                     worker_env=worker_env, zone=info.zone)
@@ -221,6 +283,11 @@ class ReconcileMixin:
         with self.lock:
             info.workload_launched = True
             info.launched_at = self.clock()
+        self.tracer.record("pod.gang_launch", launch_started,
+                           info.launched_at, trace_id=info.trace_id,
+                           parent_id=info.trace_root,
+                           attrs={"pod": key, "slice": info.qr_name,
+                                  "workers": len(qr.workers)})
         self.metrics.incr("tpu_kubelet_gang_launches")
         log.info("gang-launched %s on %s (%d workers, %d slice(s))",
                  key, info.qr_name, len(qr.workers), num_slices)

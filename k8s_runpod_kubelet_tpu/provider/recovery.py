@@ -135,6 +135,7 @@ class RecoveryMixin:
                 zone=ko.annotations(pod).get(A.ZONE, "") or self.cfg.zone,
                 accelerator_type=ko.annotations(pod).get(A.ACCELERATOR_TYPE, ""),
                 created_at=self.clock(),
+                trace_id=ko.annotations(pod).get(A.TRACE_ID, ""),
             )
 
     def _recover_instance(self, pod: dict, qr: QueuedResource):
@@ -151,6 +152,8 @@ class RecoveryMixin:
             cost_per_hr=acc.cost_per_hr if acc else 0.0,
             workload_launched=bool(detailed.runtime),
             created_at=qr.create_time or self.clock(),
+            # keep the lifecycle trace joinable across kubelet restarts
+            trace_id=ko.annotations(pod).get(A.TRACE_ID, ""),
         )
         with self.lock:
             self.pods[key] = ko.deep_copy(pod)

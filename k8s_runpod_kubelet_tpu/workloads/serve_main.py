@@ -25,8 +25,17 @@ The pod command for autoscaled inference. Endpoints:
   POST /adapters   {"name": ..., "path": adapter.npz} — register a trained
                    LoRA adapter (train_main --export-adapter) live
   GET  /metrics    Prometheus text incl. tpu_serving_queue_depth — the HPA
-                   signal (scale on queue depth, BASELINE.json config 5)
+                   signal (scale on queue depth, BASELINE.json config 5) —
+                   plus the SLO histograms (tpu_serving_ttft_seconds,
+                   tpu_serving_inter_token_seconds, queue-wait, batch
+                   utilization, KV-cache occupancy)
   GET  /healthz    liveness
+  GET  /debug/traces  recent request span trees as JSON (?trace_id= filters
+                   to the trace a traceparent header named); the generation
+                   routes parse inbound W3C ``traceparent`` headers and
+                   stamp one into the response so callers can correlate
+  GET  /debug/engine  statusz snapshot: per-slot request age/tokens, queue
+                   depth, prefix/adapter occupancy
 
 Run: python -m k8s_runpod_kubelet_tpu.workloads.serve_main \
         --model gemma-7b --slots 8 --port 8000
@@ -39,8 +48,11 @@ import json
 import logging
 import threading
 import time
+import urllib.parse
 from concurrent.futures import TimeoutError as FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..tracing import Tracer, format_traceparent, parse_traceparent
 
 log = logging.getLogger("serve-main")
 
@@ -78,6 +90,21 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _trace_ctx(self) -> tuple[dict, dict]:
+        """(submit kwargs, response headers) for this request's trace: an
+        inbound W3C ``traceparent`` donates the trace_id + parent span (so
+        the caller's tracing system owns the trace); otherwise a fresh
+        trace_id is minted. The request's ROOT span id is minted here —
+        before the request runs — so every response (stream or not) can
+        stamp a traceparent the caller can feed to /debug/traces."""
+        inbound = parse_traceparent(self.headers.get("traceparent"))
+        trace_id = inbound[0] if inbound else Tracer.new_trace_id()
+        parent = inbound[1] if inbound else ""
+        root = Tracer.new_span_id()
+        return ({"trace_id": trace_id, "parent_span": parent,
+                 "span_id": root},
+                {"traceparent": format_traceparent(trace_id, root)})
+
     def _overloaded(self, e, openai: bool = False):
         """429 + Retry-After for an EngineOverloaded admission rejection —
         the bounded-latency contract's client-visible half."""
@@ -110,6 +137,13 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/metrics":
             return self._send(200, self.engine.metrics.render().encode(),
                               "text/plain; version=0.0.4")
+        url = urllib.parse.urlparse(self.path)
+        if url.path == "/debug/traces":
+            q = urllib.parse.parse_qs(url.query)
+            return self._send(200, self.engine.tracer.query(
+                (q.get("trace_id") or [""])[0]))
+        if url.path == "/debug/engine":
+            return self._send(200, self.engine.debug_snapshot())
         self._send(404, {"error": f"no route {self.path}"})
 
     def _read_json(self) -> dict:
@@ -228,6 +262,7 @@ class _Handler(BaseHTTPRequestHandler):
             stop, stop_strs = self._parse_stop(req.get("stop"))
         except ValueError as e:
             return self._send(400, {"error": str(e)})
+        trace_kw, trace_hdrs = self._trace_ctx()
         fut = self.engine.submit(tokens, req.get("max_new_tokens"),
                                  req.get("temperature"),
                                  top_k=_or(req.get("top_k"), 0),
@@ -240,7 +275,7 @@ class _Handler(BaseHTTPRequestHandler):
                                  stop=stop, stop_text=stop_strs,
                                  logprobs=bool(req.get("logprobs")),
                                  adapter=req.get("adapter") or "",
-                                 seed=req.get("seed"))
+                                 seed=req.get("seed"), **trace_kw)
         try:
             out = fut.result(timeout=self.request_timeout_s)
         except FutureTimeout:
@@ -259,9 +294,10 @@ class _Handler(BaseHTTPRequestHandler):
             if stop_strs:  # BPE text stop: truncate at its first occurrence
                 text, _ = self._cut_at_stop(text, stop_strs)
             out["text"] = text
-        self._send(200, out)
+        self._send(200, out, extra_headers=trace_hdrs)
 
-    def _stream_pump(self, tokens: list, kw: dict, ctype: str, fmt: dict):
+    def _stream_pump(self, tokens: list, kw: dict, ctype: str, fmt: dict,
+                     extra_headers: dict | None = None):
         """Shared streamed-generation pump (NDJSON /generate and SSE
         /v1/completions ride the same concurrency/deadline machinery):
         engine thread pushes tokens into a queue, this handler thread
@@ -299,6 +335,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(200)
         self.send_header("Content-Type", ctype)
         self.send_header("Transfer-Encoding", "chunked")
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
 
         def chunk(body: bytes):
@@ -499,6 +537,8 @@ class _Handler(BaseHTTPRequestHandler):
         except (json.JSONDecodeError, ValueError, TypeError) as e:
             return self._send(400, {"error": {"message": f"{e}",
                                               "type": "invalid_request_error"}})
+        trace_kw, trace_hdrs = self._trace_ctx()
+        kw.update(trace_kw)
         rid = (f"chatcmpl-{_time.time_ns():x}" if chat
                else f"cmpl-{_time.time_ns():x}")
         created = int(_time.time())
@@ -631,7 +671,8 @@ class _Handler(BaseHTTPRequestHandler):
                  # same condition as _overloaded(): an SDK client branching
                  # on type must see a retryable overload, not a bad request
                  "overloaded": lambda msg: {"error": {
-                     "message": msg, "type": "overloaded_error"}}})
+                     "message": msg, "type": "overloaded_error"}}},
+                extra_headers=trace_hdrs)
 
         # n choices share ONE prefill (the engine fans the cache out); with
         # an explicit seed each choice offsets it so the samples differ
@@ -683,7 +724,8 @@ class _Handler(BaseHTTPRequestHandler):
             "model": model_name, "choices": choices,
             "usage": {"prompt_tokens": len(tokens),
                       "completion_tokens": gen_tokens,
-                      "total_tokens": len(tokens) + gen_tokens}})
+                      "total_tokens": len(tokens) + gen_tokens}},
+            extra_headers=trace_hdrs)
 
     def _generate_stream(self, tokens: list, req: dict):
         """Chunked NDJSON over the shared pump: one {"token": N} line per
@@ -692,6 +734,7 @@ class _Handler(BaseHTTPRequestHandler):
             stop, stop_strs = self._parse_stop(req.get("stop"))
         except ValueError as e:
             return self._send(400, {"error": str(e)})
+        trace_kw, trace_hdrs = self._trace_ctx()
         kw = dict(max_new_tokens=req.get("max_new_tokens"),
                   temperature=req.get("temperature"),
                   top_k=_or(req.get("top_k"), 0),
@@ -700,7 +743,8 @@ class _Handler(BaseHTTPRequestHandler):
                   presence_penalty=_or(req.get("presence_penalty"), 0.0),
                   frequency_penalty=_or(req.get("frequency_penalty"), 0.0),
                   logit_bias=req.get("logit_bias"),
-                  adapter=req.get("adapter") or "", seed=req.get("seed"))
+                  adapter=req.get("adapter") or "", seed=req.get("seed"),
+                  **trace_kw)
 
         def line(payload: dict) -> bytes:
             return (json.dumps(payload) + "\n").encode()
@@ -723,7 +767,8 @@ class _Handler(BaseHTTPRequestHandler):
              "end": fmt_end,
              "timeout": lambda: [line({"error": "generation timed out"})],
              "error": lambda msg: [line({"error": msg})],
-             "badreq": lambda msg: {"error": msg}})
+             "badreq": lambda msg: {"error": msg}},
+            extra_headers=trace_hdrs)
 
 
 class BoundedThreadingHTTPServer(ThreadingHTTPServer):
@@ -832,6 +877,11 @@ class BoundedThreadingHTTPServer(ThreadingHTTPServer):
 def serve(engine, port: int = 8000, request_timeout_s: float = 120.0,
           tokenizer=None, allow_adapters: bool = False,
           max_connections: int = 128):
+    # described here, not in the engine: the HTTP-layer shed counter belongs
+    # to this server (the engine never sees the rejected connection)
+    engine.metrics.describe(
+        "tpu_serving_http_rejected",
+        "connections 503-shed at the HTTP concurrency bound")
     handler = type("BoundHandler", (_Handler,),
                    {"engine": engine, "request_timeout_s": request_timeout_s,
                     "tokenizer": tokenizer, "allow_adapters": allow_adapters})
@@ -915,6 +965,10 @@ def main(argv=None) -> int:
     p.add_argument("--hf-checkpoint", default="",
                    help="HuggingFace model directory (safetensors/bin) to "
                         "load real weights from; empty = random init")
+    p.add_argument("--trace-export", default="",
+                   help="append finished request spans to this JSONL file "
+                        "(render with tools/trace_summary.py); empty = "
+                        "in-memory ring only (/debug/traces)")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -1006,7 +1060,8 @@ def main(argv=None) -> int:
         # decoded-text stop matching (BPE-exact stops) needs the engine
         # to see text, not just token ids
         decode_fn=(tokenizer.decode if tokenizer is not None else None),
-        mesh=mesh).start()
+        mesh=mesh,
+        tracer=Tracer(export_path=args.trace_export)).start()
     httpd = serve(engine, args.port, tokenizer=tokenizer,
                   allow_adapters=args.dynamic_adapters,
                   max_connections=args.max_connections)
@@ -1017,6 +1072,7 @@ def main(argv=None) -> int:
         pass
     httpd.shutdown()
     engine.stop()
+    engine.tracer.close()  # flush the JSONL export queue (daemon writer)
     return 0
 
 

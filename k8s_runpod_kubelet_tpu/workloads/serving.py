@@ -45,8 +45,18 @@ import numpy as np
 
 from ..metrics import Metrics
 from ..models.llama import LlamaConfig, LlamaModel, Params
+from ..tracing import Tracer
 
 log = logging.getLogger(__name__)
+
+# SLO histograms live sub-second: the default bucket ladder (0.5s first
+# bucket, sized for pod provisioning) would crush every TTFT/ITL sample
+# into one bin (ISSUE 2 satellite)
+_TTFT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                 10.0, 30.0, 60.0)
+_ITL_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                1.0, 2.5)
+_UTIL_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
 
 
 @dataclasses.dataclass
@@ -169,6 +179,22 @@ class Request:
     # (nothing donates the single cache, so sharing is safe); each member
     # samples its own first token from the shared last-position logits
     fanout: Optional[list] = None
+    # distributed-tracing context (W3C traceparent): trace_id groups this
+    # request's spans with the caller's trace; span_id is the REQUEST root
+    # span's id (the HTTP layer generates it so it can stamp the response
+    # header before the request finishes); parent_span_id is the caller's
+    # inbound span. Empty = the engine mints ids at completion.
+    trace_id: str = ""
+    span_id: str = ""
+    parent_span_id: str = ""
+    # span-boundary timestamps (perf_counter domain, like submitted_at):
+    # queue-wait = submitted->dequeued, prefill = dequeued->prefill_done,
+    # decode = prefill_done->finish (contiguous: ready-queue wait and slot
+    # insertion are decode-span preamble, so child durations sum to the
+    # request latency)
+    dequeued_at: float = 0.0
+    prefill_done_at: float = 0.0
+    first_token_at: float = 0.0
 
 
 @dataclasses.dataclass
@@ -201,6 +227,9 @@ class _Slot:
     # detokenizer first-token artifacts (r3 advisor finding)
     stop_tail: list[int] = dataclasses.field(default_factory=list)
     stop_tail_upto: int = 0
+    # inter-token-latency bookkeeping: perf_counter of the last token this
+    # slot streamed (0 = none yet)
+    last_emit_at: float = 0.0
 
 
 def kv_cache_pspec(name: str, ndim: int):
@@ -363,9 +392,16 @@ def _sample(logits: jax.Array, keys: jax.Array, temps: list[float],
 class ServingEngine:
     def __init__(self, cfg: LlamaConfig, params: Params, sc: ServingConfig,
                  metrics: Optional[Metrics] = None, seed: int = 0,
-                 decode_fn=None, mesh=None):
+                 decode_fn=None, mesh=None, tracer: Optional[Tracer] = None):
         self.cfg = cfg
         self.sc = sc
+        # per-request span source (queue-wait/prefill/decode/finish trees,
+        # joined to callers via W3C traceparent); always present so the
+        # engine never branches on "is tracing on" — the no-export tracer
+        # is a bounded deque append per request. `is None`, not `or`: a
+        # caller's still-EMPTY tracer is falsy (len 0) and `or` would
+        # silently swap in a fresh one, orphaning its export file
+        self.tracer = tracer if tracer is not None else Tracer()
         # tokens -> text, for text-exact (BPE-safe) stop strings; the
         # engine stays tokenizer-agnostic — the HTTP layer injects this
         self._decode_fn = decode_fn
@@ -411,11 +447,11 @@ class ServingEngine:
                         cfg, bits=4 if sc.quantize_int4 else 8)))
         self.params = params
         self.metrics = metrics or Metrics()
-        self.metrics.describe("tpu_serving_queue_depth",
-                              "requests waiting for a decode slot (HPA signal)")
+        self._describe_metrics(self.metrics)
         # the HPA scrapes from pod start — the signal must exist before traffic
         self.metrics.set_gauge("tpu_serving_queue_depth", 0)
         self.metrics.set_gauge("tpu_serving_active_slots", 0)
+        self.metrics.set_gauge("tpu_serving_kv_cache_tokens", 0)
         # registered prompt prefixes, longest first; read by the prefill
         # thread, written by callers. Each entry holds per-ADAPTER KV
         # variants (adapter KV differs from base KV for the same tokens),
@@ -522,6 +558,54 @@ class ServingEngine:
         self.total_generated = 0
         self.last_error: Optional[str] = None
 
+    @staticmethod
+    def _describe_metrics(m: Metrics):
+        """HELP/TYPE for every serving metric (tests/test_metrics_lint.py
+        fails any call site without a matching describe — the README
+        catalogue stays honest as metrics accumulate)."""
+        m.describe("tpu_serving_queue_depth",
+                   "requests waiting for a decode slot (HPA signal)")
+        m.describe("tpu_serving_active_slots",
+                   "decode slots currently holding a live request")
+        m.describe("tpu_serving_kv_cache_tokens",
+                   "tokens (prompt + generated) held in active KV slots")
+        m.describe("tpu_serving_admitted",
+                   "requests admitted into a decode slot")
+        m.describe("tpu_serving_admission_rejected",
+                   "submits rejected at max_queue_depth (mapped to HTTP 429)")
+        m.describe("tpu_serving_cancelled",
+                   "requests cancelled by their caller (timeout/disconnect)")
+        m.describe("tpu_serving_stream_cancelled",
+                   "streamed requests cancelled by a failing token callback")
+        m.describe("tpu_serving_decode_steps",
+                   "batched decode/verify steps executed by the engine loop")
+        m.describe("tpu_serving_engine_errors",
+                   "engine-loop steps that raised (in-flight requests failed)")
+        m.describe("tpu_serving_prefill_errors",
+                   "prefills that raised (poisoned prompt; request failed)")
+        m.describe("tpu_serving_prefix_hits",
+                   "prompts that skipped a registered prefix's prefill")
+        m.describe("tpu_serving_prefix_adapter_fills",
+                   "lazy per-adapter prefix variants computed on first use")
+        m.describe("tpu_serving_spec_proposed",
+                   "speculative draft tokens proposed")
+        m.describe("tpu_serving_spec_accepted",
+                   "speculative draft tokens accepted (committed for free)")
+        m.describe("tpu_serving_request_latency_seconds",
+                   "submit -> completion, whole request")
+        m.describe("tpu_serving_ttft_seconds",
+                   "submit -> first generated token (time to first token)",
+                   buckets=_TTFT_BUCKETS)
+        m.describe("tpu_serving_inter_token_seconds",
+                   "gap between consecutive streamed tokens of one request",
+                   buckets=_ITL_BUCKETS)
+        m.describe("tpu_serving_queue_wait_seconds",
+                   "submit -> prefill start (admission queue wait)",
+                   buckets=_TTFT_BUCKETS)
+        m.describe("tpu_serving_batch_utilization",
+                   "filled slots / max slots per decode step",
+                   buckets=_UTIL_BUCKETS)
+
     def _fresh_cache(self, batch: int) -> Params:
         """One construction path for every cache this engine makes (the
         batch cache, prefill singles, and the post-crash rebuild).
@@ -598,7 +682,8 @@ class ServingEngine:
                stop: Optional[list] = None,
                stop_text: Optional[list] = None, logprobs: bool = False,
                adapter: str = "", seed: Optional[int] = None,
-               on_token=None, _build_only: bool = False):
+               on_token=None, trace_id: str = "", parent_span: str = "",
+               span_id: str = "", _build_only: bool = False):
         """Enqueue a generation request; resolves to {tokens, latency_s, rid}
         (+ per-token "logprobs" when requested). ``on_token(tok)`` streams
         each generated token id as it decodes. ``top_k``/``top_p`` filter
@@ -721,7 +806,9 @@ class ServingEngine:
                       stop=[list(s) for s in stop],
                       stop_texts=list(stop_text), logprobs=bool(logprobs),
                       adapter_id=adapter_id, seed=seed & 0xFFFFFFFF,
-                      on_token=on_token)
+                      on_token=on_token, trace_id=str(trace_id or ""),
+                      span_id=str(span_id or ""),
+                      parent_span_id=str(parent_span or ""))
         if _build_only:
             return req
         with self._admit_lock:  # atomic check+put: racing submits must not
@@ -763,6 +850,8 @@ class ServingEngine:
                 fs.append(f)
             return fs
         reqs = [first]
+        kw.pop("span_id", None)  # the caller's root span id names member 0
+        # only; siblings mint their own (same trace_id still groups them)
         for i in range(1, n):
             reqs.append(self.submit(prompt,
                                     seed=None if seed is None else seed + i,
@@ -813,6 +902,51 @@ class ServingEngine:
     def active_slots(self) -> int:
         return sum(1 for s in self._slots if s.request is not None)
 
+    def debug_snapshot(self) -> dict:
+        """Statusz-style snapshot for /debug/engine: in-flight slots with
+        per-request age/token counts, queue depths, and prefix/adapter
+        occupancy. Read from HTTP handler threads while the engine mutates —
+        each field is a single GIL-atomic read, so a snapshot may straddle a
+        step (debug surface, not an invariant)."""
+        now = time.perf_counter()
+        slots = []
+        for i, s in enumerate(self._slots):
+            r = s.request
+            if r is None:
+                slots.append({"slot": i, "state": "free"})
+                continue
+            slots.append({
+                "slot": i, "state": "decoding", "rid": r.rid,
+                "trace_id": r.trace_id or None,
+                "age_s": round(now - r.submitted_at, 4),
+                "prompt_tokens": len(r.prompt),
+                "generated_tokens": len(s.generated),
+                "remaining_tokens": s.remaining,
+                "adapter_id": r.adapter_id,
+            })
+        with self._prefix_lock:
+            prefixes = [{"tokens": len(e.tokens),
+                         "adapter_variants": len(e.variants)}
+                        for e in self._prefixes]
+        kv_tokens = sum(s.get("prompt_tokens", 0) + s.get("generated_tokens", 0)
+                        for s in slots)
+        return {
+            "model": self.cfg.name,
+            "alive": self.alive,
+            "slots": slots,
+            "active_slots": sum(1 for s in slots if s["state"] != "free"),
+            "max_slots": self.sc.slots,
+            "queue_depth": self.queue_depth,
+            "ready_queue": self._ready.qsize(),
+            "kv_cache_tokens": kv_tokens,
+            "cache_len": self.sc.cache_len,
+            "prefixes": prefixes,
+            "max_prefixes": self.sc.max_prefixes,
+            "adapters": list(self.adapter_names),
+            "total_generated": self.total_generated,
+            "last_error": self.last_error,
+        }
+
     # -- engine loop -----------------------------------------------------------
 
     def _loop(self):
@@ -860,6 +994,7 @@ class ServingEngine:
                 self.metrics.set_gauge("tpu_serving_queue_depth",
                                        self.queue_depth)
                 self.metrics.set_gauge("tpu_serving_active_slots", 0)
+                self.metrics.set_gauge("tpu_serving_kv_cache_tokens", 0)
                 # LAST, after every in-flight future is failed: the crashed
                 # step may have DONATED the cache buffers before raising, so
                 # decode needs fresh ones. If even this allocation fails
@@ -1134,9 +1269,17 @@ class ServingEngine:
                               len(members) - len(live))
             if not live:
                 continue  # every caller gave up while queued
+            dequeued = time.perf_counter()
+            for r in live:
+                r.dequeued_at = dequeued
+                self.metrics.observe("tpu_serving_queue_wait_seconds",
+                                     dequeued - r.submitted_at)
             try:
                 last_logits, single = self._prefill_tokens(req.prompt,
                                                            req.adapter_id)
+                prefill_done = time.perf_counter()
+                for r in live:
+                    r.prefill_done_at = prefill_done
                 # one prefill, one ready entry PER live member: each samples
                 # its own first token from the shared last-position logits
                 entries = []
@@ -1234,12 +1377,21 @@ class ServingEngine:
             slot.indexed_upto = 0
             slot.stop_tail = []
             slot.stop_tail_upto = 0
+            # the first token becomes caller-visible HERE (the prefill
+            # thread sampled it, but _emit below is when it streams), so
+            # this is the honest TTFT instant
+            now = time.perf_counter()
+            req.first_token_at = now
+            slot.last_emit_at = now
+            self.metrics.observe("tpu_serving_ttft_seconds",
+                                 now - req.submitted_at)
             self._emit(slot, first)
             admitted = True
             self.metrics.incr("tpu_serving_admitted")
             if self._finished(slot):
                 self._complete(slot_id, slot)
         self.metrics.set_gauge("tpu_serving_active_slots", self.active_slots)
+        self._update_kv_gauge()
         return admitted
 
     def _propose(self, slot: _Slot, k: int) -> list[int]:
@@ -1340,6 +1492,7 @@ class ServingEngine:
         self.metrics.incr("tpu_serving_spec_proposed", k * n_greedy)
 
         advance = np.zeros((b,), np.int32)
+        step_now = time.perf_counter()
         for i, slot in enumerate(slots):
             if not active[i]:
                 continue
@@ -1373,6 +1526,7 @@ class ServingEngine:
                 if self._finished(slot):
                     self._complete(i, slot)
             advance[i] = appended
+            self._observe_itl(slot, appended, step_now)
             if greedy_slot and appended > 1:
                 # accepted = drafts actually CONSUMED (an early finish must
                 # not inflate the exported acceptance rate)
@@ -1382,7 +1536,33 @@ class ServingEngine:
         self._cache["index"] = idx + jnp.asarray(advance)
         self._tokens = jnp.asarray([s.last_token for s in slots], jnp.int32)
         self.metrics.incr("tpu_serving_decode_steps")
+        self._observe_step(sum(1 for a in active if a))
         return True
+
+    def _observe_itl(self, slot: _Slot, appended: int, now: float):
+        """Per-token inter-token latency: the step gap spread evenly over
+        the tokens it committed (speculative steps commit several at once —
+        the client-visible stream sees them back to back, but the SLO
+        series must count one sample per token)."""
+        if not appended:
+            return
+        if slot.last_emit_at:
+            per_tok = (now - slot.last_emit_at) / appended
+            for _ in range(appended):
+                self.metrics.observe("tpu_serving_inter_token_seconds",
+                                     per_tok)
+        slot.last_emit_at = now
+
+    def _observe_step(self, n_active: int):
+        """Per-decode-step batch health: slot-fill fraction + KV occupancy."""
+        self.metrics.observe("tpu_serving_batch_utilization",
+                             n_active / max(1, self.sc.slots))
+        self._update_kv_gauge()
+
+    def _update_kv_gauge(self):
+        self.metrics.set_gauge("tpu_serving_kv_cache_tokens", sum(
+            len(s.request.prompt) + len(s.generated)
+            for s in self._slots if s.request is not None))
 
     def _decode_once(self):
         if self._verify is not None and self._decode_once_speculative():
@@ -1406,9 +1586,12 @@ class ServingEngine:
             logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
             lp_np = np.asarray(jnp.take_along_axis(
                 logp, jnp.asarray(next_np)[:, None], axis=-1)[:, 0])
+        step_now = time.perf_counter()
+        n_active = 0
         for slot_id, slot in enumerate(self._slots):
             if slot.request is None:
                 continue
+            n_active += 1
             tok = int(next_np[slot_id])
             slot.generated.append(tok)
             if slot.request.logprobs and lp_np is not None:
@@ -1416,11 +1599,13 @@ class ServingEngine:
             slot.last_token = tok
             slot.remaining -= 1
             self._emit(slot, tok)
+            self._observe_itl(slot, 1, step_now)
             self.total_generated += 1
             if self._finished(slot):
                 self._complete(slot_id, slot)
         self._tokens = jnp.asarray(next_np, jnp.int32)
         self.metrics.incr("tpu_serving_decode_steps")
+        self._observe_step(n_active)
 
     def _maybe_penalize(self, logits: jax.Array, reqs) -> jax.Array:
         """Apply OpenAI presence/frequency penalties and logit_bias to
@@ -1511,12 +1696,58 @@ class ServingEngine:
             return any(s in text for s in slot.request.stop_texts)
         return False
 
+    def _record_request_spans(self, req: Request, slot: _Slot,
+                              latency: float):
+        """The request's span tree, recorded retroactively from the
+        timestamps the threads already keep (no live span objects cross the
+        submit/prefill/engine threads). Children are CONTIGUOUS — queue-wait
+        (submit->prefill dequeue), prefill (dequeue->prefill done), decode
+        (prefill done->finish, ready-queue wait included) — so their
+        durations sum to the recorded request latency."""
+        tr = self.tracer
+        now_perf = time.perf_counter()
+        now_wall = tr.clock()
+
+        def wall(t_perf: float) -> float:
+            return now_wall - (now_perf - t_perf)
+
+        trace_id = req.trace_id or Tracer.new_trace_id()
+        root = req.span_id or Tracer.new_span_id()
+        end = wall(req.submitted_at + latency)
+        ttft = (req.first_token_at - req.submitted_at
+                if req.first_token_at else None)
+        tr.record("serving.request", wall(req.submitted_at), end,
+                  trace_id=trace_id, span_id=root,
+                  parent_id=req.parent_span_id,
+                  attrs={"rid": req.rid, "prompt_tokens": len(req.prompt),
+                         "tokens": len(slot.generated),
+                         "ttft_s": ttft, "latency_s": latency,
+                         "adapter_id": req.adapter_id})
+        if req.dequeued_at:
+            tr.record("serving.queue_wait", wall(req.submitted_at),
+                      wall(req.dequeued_at), trace_id=trace_id,
+                      parent_id=root, attrs={"rid": req.rid})
+        if req.prefill_done_at:
+            tr.record("serving.prefill", wall(req.dequeued_at),
+                      wall(req.prefill_done_at), trace_id=trace_id,
+                      parent_id=root,
+                      attrs={"rid": req.rid,
+                             "prompt_tokens": len(req.prompt)})
+            tr.record("serving.decode", wall(req.prefill_done_at), end,
+                      trace_id=trace_id, parent_id=root,
+                      attrs={"rid": req.rid,
+                             "tokens": len(slot.generated)})
+
     def _complete(self, slot_id: int, slot: _Slot):
         req = slot.request
         slot.request = None
         self._slot_adapter[slot_id] = 0
         latency = time.perf_counter() - req.submitted_at
         self.metrics.observe("tpu_serving_request_latency_seconds", latency)
+        try:
+            self._record_request_spans(req, slot, latency)
+        except Exception:  # noqa: BLE001 — tracing must never fail a request
+            log.exception("span recording for %s failed", req.rid)
         out = {"rid": req.rid, "tokens": slot.generated,
                "latency_s": latency}
         if req.logprobs:

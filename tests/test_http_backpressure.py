@@ -18,9 +18,13 @@ from k8s_runpod_kubelet_tpu.workloads.serve_main import serve
 class _Metrics:
     def __init__(self):
         self.counts = {}
+        self.help = {}
 
     def incr(self, name, n=1):
         self.counts[name] = self.counts.get(name, 0) + n
+
+    def describe(self, name, help_text, buckets=None):
+        self.help[name] = help_text
 
     def render(self):
         return "".join(f"{k}_total {v}\n" for k, v in self.counts.items())

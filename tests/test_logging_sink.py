@@ -1,0 +1,92 @@
+"""ErrorSinkHandler satellites (ISSUE 2): tracebacks reach the sink, and
+close() flushes the queue instead of racing a daemon-thread exit."""
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from k8s_runpod_kubelet_tpu.logging_util import ErrorSinkHandler
+
+
+class _SinkServer:
+    def __init__(self):
+        self.received = []
+        self.all_in = threading.Event()
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            def do_POST(self):
+                outer.received.append(json.loads(
+                    self.rfile.read(int(self.headers["Content-Length"]))))
+                self.send_response(200)
+                self.end_headers()
+                outer.all_in.set()
+
+            def log_message(self, *a):
+                pass
+
+        self.srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.thread = threading.Thread(target=self.srv.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.srv.server_address[1]}"
+
+    def stop(self):
+        self.srv.shutdown()
+        self.srv.server_close()
+
+
+def test_exception_posts_formatted_traceback():
+    srv = _SinkServer()
+    try:
+        sink = ErrorSinkHandler(srv.url, environment="test")
+        logger = logging.getLogger("sink-tb-test")
+        logger.addHandler(sink)
+        try:
+            raise ValueError("kaboom in reconcile")
+        except ValueError:
+            logger.exception("reconcile pass failed")
+        assert srv.all_in.wait(5)
+        logger.removeHandler(sink)
+        sink.close()
+        event = srv.received[0]
+        assert event["message"] == "reconcile pass failed"
+        assert "Traceback (most recent call last)" in event["exception"]
+        assert "ValueError: kaboom in reconcile" in event["exception"]
+        assert "test_exception_posts_formatted_traceback" in event["exception"]
+        # the in-memory ring carries it too (kubelet debug surface)
+        assert "exception" in list(sink.recent)[0]
+    finally:
+        srv.stop()
+
+
+def test_close_flushes_pending_events():
+    """The last error before a crash must reach the sink: events queued
+    before close() are delivered, not abandoned with the daemon thread."""
+    srv = _SinkServer()
+    try:
+        sink = ErrorSinkHandler(srv.url, environment="test")
+        logger = logging.getLogger("sink-flush-test")
+        logger.addHandler(sink)
+        for i in range(5):
+            logger.error("pre-crash error %d", i)
+        logger.removeHandler(sink)
+        sink.close()  # joins the worker: everything queued is now posted
+        assert [e["message"] for e in srv.received] == \
+            [f"pre-crash error {i}" for i in range(5)]
+        assert not sink._worker.is_alive()
+    finally:
+        srv.stop()
+
+
+def test_close_is_bounded_when_sink_unreachable():
+    """close() must not hang on a dead sink — bounded join, then return."""
+    sink = ErrorSinkHandler("http://127.0.0.1:1/x", timeout_s=0.05)
+    rec = logging.LogRecord("t", logging.ERROR, __file__, 1, "m", (), None)
+    for _ in range(3):
+        sink.emit(rec)
+    sink.close()  # ECONNREFUSED drains fast; must return, not deadlock

@@ -1,0 +1,178 @@
+"""Prometheus text-exposition conformance for Metrics.render().
+
+Validates the renderer line-by-line against the rules scrapers enforce:
+TYPE/HELP precede samples and name the EXPOSED family (counters expose
+``<name>_total``), histogram samples carry cumulative ``le`` labels ending
+at +Inf, label values escape backslash/quote/newline, and per-metric bucket
+bounds (describe(..., buckets=...)) actually shape the output.
+"""
+
+import math
+import re
+
+from k8s_runpod_kubelet_tpu.metrics import _DEFAULT_BUCKETS, Metrics
+
+SAMPLE_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?P<labels>\{.*\})? (?P<value>[-+0-9.eE]+|NaN|[+-]Inf)$')
+
+
+def parse_exposition(text: str):
+    """(families, samples): families maps exposed family name -> kind;
+    samples is a list of (metric name, labels string, float value). Raises
+    on any line that is neither valid metadata nor a valid sample."""
+    families: dict[str, str] = {}
+    helps: dict[str, str] = {}
+    samples = []
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            fam, _, kind = rest.partition(" ")
+            assert kind in ("counter", "gauge", "histogram"), line
+            assert fam not in families, f"duplicate TYPE for {fam}"
+            families[fam] = kind
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            fam, _, help_text = rest.partition(" ")
+            assert fam not in helps, f"duplicate HELP for {fam}"
+            helps[fam] = help_text
+            continue
+        m = SAMPLE_RE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        samples.append((m.group("name"), m.group("labels") or "",
+                        float(m.group("value"))))
+    return families, helps, samples
+
+
+def family_of(sample_name: str, families: dict) -> str:
+    """The TYPE family a sample belongs to (histograms sample under
+    _bucket/_sum/_count of their family)."""
+    if sample_name in families:
+        return sample_name
+    for suffix in ("_bucket", "_sum", "_count"):
+        if sample_name.endswith(suffix) \
+                and sample_name[:-len(suffix)] in families:
+            return sample_name[:-len(suffix)]
+    raise AssertionError(f"sample {sample_name} has no TYPE family")
+
+
+class TestExpositionFormat:
+    def test_counter_exposed_under_total_family(self):
+        m = Metrics()
+        m.describe("reqs", "requests served")
+        m.incr("reqs", 3)
+        lines = m.render().splitlines()
+        # HELP and TYPE must name reqs_total — metadata under the base name
+        # while samples use _total reads as TWO metrics to a scraper
+        assert lines[0] == "# HELP reqs_total requests served"
+        assert lines[1] == "# TYPE reqs_total counter"
+        assert lines[2] == "reqs_total 3.0"
+
+    def test_gauge_and_histogram_type_lines(self):
+        m = Metrics()
+        m.describe("depth", "queue depth")
+        m.set_gauge("depth", 4)
+        m.observe("lat", 0.7)
+        text = m.render()
+        assert "# TYPE depth gauge" in text
+        assert "# TYPE lat histogram" in text
+        # undescribed metric still gets TYPE (scrapers need it), just no HELP
+        assert "# HELP lat" not in text
+
+    def test_label_value_escaping(self):
+        m = Metrics()
+        m.incr("errs", labels={"msg": 'a"b\\c\nd'})
+        text = m.render()
+        assert 'errs_total{msg="a\\"b\\\\c\\nd"} 1.0' in text
+        # escaped output must survive a strict re-parse
+        families, _, samples = parse_exposition(text)
+        assert families["errs_total"] == "counter"
+        assert samples == [("errs_total", '{msg="a\\"b\\\\c\\nd"}', 1.0)]
+
+    def test_help_newline_escaping(self):
+        m = Metrics()
+        m.describe("g", "line1\nline2")
+        m.set_gauge("g", 1)
+        assert "# HELP g line1\\nline2" in m.render()
+
+    def test_every_sample_has_a_typed_family(self):
+        """Full-registry sweep: everything render() emits parses and maps
+        to exactly one TYPE family, with metadata before samples."""
+        m = Metrics()
+        m.describe("a_counter", "c")
+        m.describe("b_gauge", "g")
+        m.describe("c_hist", "h", buckets=(0.01, 0.1, 1.0))
+        m.incr("a_counter", labels={"k": "v"})
+        m.incr("a_counter", labels={"k": "w"})
+        m.set_gauge("b_gauge", -1.0)
+        m.observe("c_hist", 0.05, labels={"route": "x"})
+        m.observe("undescribed_hist", 2.0)
+        text = m.render()
+        lines = text.splitlines()
+        families, helps, samples = parse_exposition(text)
+        for name, _, _ in samples:
+            family_of(name, families)
+        # described families carry HELP; metadata precedes the samples
+        for fam in ("a_counter_total", "b_gauge", "c_hist"):
+            assert fam in helps
+            type_line = lines.index(f"# TYPE {fam} " + families[fam])
+            first_sample = min(i for i, line in enumerate(lines)
+                               if not line.startswith("#")
+                               and line.startswith(fam))
+            assert type_line < first_sample, fam
+
+    def test_histogram_le_labels_cumulative_and_inf(self):
+        m = Metrics()
+        m.describe("lat", "latency", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 5.0, 50.0):
+            m.observe("lat", v)
+        text = m.render()
+        assert 'lat_bucket{le="0.1"} 1' in text
+        assert 'lat_bucket{le="1.0"} 2' in text
+        assert 'lat_bucket{le="10.0"} 3' in text
+        assert 'lat_bucket{le="+Inf"} 4' in text
+        assert "lat_count 4" in text
+        assert math.isclose(
+            float([l for l in text.splitlines()
+                   if l.startswith("lat_sum")][0].split()[-1]), 55.55)
+
+    def test_per_metric_buckets_not_crushed(self):
+        """The satellite bug: sub-second TTFT observations all landed in the
+        default 0.5s first bucket. Custom bounds must resolve them."""
+        m = Metrics()
+        m.describe("ttft", "ttft", buckets=(0.005, 0.01, 0.05, 0.1, 0.5))
+        m.observe("ttft", 0.007)
+        m.observe("ttft", 0.03)
+        m.observe("ttft", 0.2)
+        text = m.render()
+        assert 'ttft_bucket{le="0.005"} 0' in text
+        assert 'ttft_bucket{le="0.01"} 1' in text
+        assert 'ttft_bucket{le="0.05"} 2' in text
+        assert 'ttft_bucket{le="0.5"} 3' in text
+
+    def test_default_buckets_for_undeclared_histograms(self):
+        m = Metrics()
+        m.observe("x", 0.2)
+        h = m.histograms[("x", ())]
+        assert h.buckets == _DEFAULT_BUCKETS
+
+    def test_buckets_sorted_and_validated(self):
+        import pytest
+        m = Metrics()
+        m.describe("h", "x", buckets=(1.0, 0.1, 10.0))
+        m.observe("h", 0.5)
+        assert m.histograms[("h", ())].buckets == (0.1, 1.0, 10.0)
+        with pytest.raises(ValueError):
+            m.describe("h2", "x", buckets=())
+
+    def test_labeled_histogram_le_merges_with_labels(self):
+        m = Metrics()
+        m.describe("lat", "l", buckets=(1.0,))
+        m.observe("lat", 0.5, labels={"route": "a"})
+        text = m.render()
+        assert 'lat_bucket{le="1.0",route="a"} 1' in text
+        assert 'lat_bucket{le="+Inf",route="a"} 1' in text
+        assert 'lat_sum{route="a"} 0.5' in text

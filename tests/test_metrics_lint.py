@@ -1,0 +1,71 @@
+"""Metrics-lint: every metric a call site emits must carry a describe() HELP.
+
+Greps the package source for ``incr/set_gauge/observe/time_block`` call
+sites with literal metric names and fails if any name lacks a matching
+``describe()`` somewhere in the package — the README "Observability"
+catalogue stays honest as metrics accumulate (ISSUE 2 satellite). Literal
+names only: a dynamic name can't be linted statically, and this repo uses
+none (asserted below so one can't sneak in unnoticed).
+"""
+
+import pathlib
+import re
+
+PKG = pathlib.Path(__file__).resolve().parent.parent / "k8s_runpod_kubelet_tpu"
+
+# call sites: metrics.incr("name"...) etc., tolerant of a line break
+# between the paren and the name
+USE_RE = re.compile(
+    r'\.(?:incr|set_gauge|observe|time_block)\(\s*"([a-zA-Z0-9_]+)"', re.S)
+DESCRIBE_RE = re.compile(r'\.describe\(\s*\n?\s*"([a-zA-Z0-9_]+)"', re.S)
+# a metrics call whose first argument is NOT a string literal (dynamic name);
+# the receiver must literally end in "metrics" so the registry's own internal
+# plumbing (e.g. _Timer's self.m.observe(self.name, ...)) stays exempt
+DYNAMIC_RE = re.compile(
+    r'metrics\.(?:incr|set_gauge|observe|time_block)\(\s*[^")\s]', re.S)
+
+
+def _sources():
+    for path in sorted(PKG.rglob("*.py")):
+        yield path, path.read_text(encoding="utf-8")
+
+
+def test_every_emitted_metric_is_described():
+    used: dict[str, set] = {}
+    described: set[str] = set()
+    for path, src in _sources():
+        for name in USE_RE.findall(src):
+            used.setdefault(name, set()).add(path.name)
+        described.update(DESCRIBE_RE.findall(src))
+    assert used, "lint found no metric call sites — regex rotted?"
+    missing = {n: sorted(files) for n, files in sorted(used.items())
+               if n not in described}
+    assert not missing, (
+        "metrics emitted without a describe() HELP entry (add one next to "
+        f"the other describes, and catalogue it in README): {missing}")
+
+
+def test_no_dynamic_metric_names():
+    """The lint above only sees literals; a computed metric name would
+    silently escape it. This repo has none — keep it that way (build the
+    variability into labels instead)."""
+    offenders = []
+    for path, src in _sources():
+        for m in DYNAMIC_RE.finditer(src):
+            snippet = src[m.start():m.start() + 60].splitlines()[0]
+            offenders.append(f"{path.name}: {snippet}")
+    assert not offenders, offenders
+
+
+def test_known_metric_families_present():
+    """Spot-check the SLO metrics this PR introduces are described (guards
+    against a rename in one place but not the other)."""
+    described = set()
+    for _, src in _sources():
+        described.update(DESCRIBE_RE.findall(src))
+    for name in ("tpu_serving_ttft_seconds", "tpu_serving_inter_token_seconds",
+                 "tpu_serving_queue_wait_seconds",
+                 "tpu_serving_batch_utilization",
+                 "tpu_serving_kv_cache_tokens",
+                 "tpu_kubelet_schedule_to_ready_seconds"):
+        assert name in described, name

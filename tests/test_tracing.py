@@ -1,0 +1,451 @@
+"""Tracing subsystem: span nesting, ring bound, JSONL export, W3C
+traceparent parsing — plus the slow-tier end-to-end round trip through the
+OpenAI serving routes (ISSUE 2 acceptance criterion)."""
+
+import json
+
+import pytest
+
+from k8s_runpod_kubelet_tpu.tracing import (Tracer, format_traceparent,
+                                            parse_traceparent)
+
+
+class TestTraceparent:
+    def test_roundtrip(self):
+        t, s = Tracer.new_trace_id(), Tracer.new_span_id()
+        hdr = format_traceparent(t, s)
+        assert parse_traceparent(hdr) == (t, s)
+
+    def test_valid_w3c_example(self):
+        got = parse_traceparent(
+            "00-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7-01")
+        assert got == ("4bf92f3577b34da6a3ce929d0e0e4736", "00f067aa0ba902b7")
+
+    @pytest.mark.parametrize("bad", [
+        None, "", "garbage",
+        "00-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7",  # 3 fields
+        "ff-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7-01",  # ver ff
+        "00-00000000000000000000000000000000-00f067aa0ba902b7-01",  # zero tid
+        "00-4bf92f3577b34da6a3ce929d0e0e4736-0000000000000000-01",  # zero sid
+        "00-SHOUTY3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7-01",  # non-hex
+        "00-4bf92f3577b34da6-00f067aa0ba902b7-01",                  # short tid
+    ])
+    def test_malformed_rejected(self, bad):
+        assert parse_traceparent(bad) is None
+
+    def test_ids_have_w3c_shapes(self):
+        assert len(Tracer.new_trace_id()) == 32
+        assert len(Tracer.new_span_id()) == 16
+        int(Tracer.new_trace_id(), 16)
+        int(Tracer.new_span_id(), 16)
+
+
+class TestTracer:
+    def test_record_explicit_times_and_injected_clock_domain(self):
+        tr = Tracer()
+        s = tr.record("x", start=100.0, end=102.5, trace_id="t" * 32,
+                      attrs={"k": 1})
+        assert s.duration_s == 2.5
+        got = tr.get_trace("t" * 32)
+        assert len(got) == 1
+        assert got[0]["name"] == "x"
+        assert got[0]["duration_s"] == 2.5
+        assert got[0]["attrs"] == {"k": 1}
+
+    def test_span_nesting_inherits_trace_and_parent(self):
+        tr = Tracer()
+        with tr.span("outer") as outer:
+            with tr.span("inner") as inner:
+                pass
+        spans = {s["name"]: s for s in tr.recent()}
+        assert spans["inner"]["trace_id"] == spans["outer"]["trace_id"]
+        assert spans["inner"]["parent_id"] == spans["outer"]["span_id"]
+        assert spans["outer"]["parent_id"] == ""
+        assert inner.trace_id == outer.trace_id
+
+    def test_nesting_unwinds_after_exception(self):
+        tr = Tracer()
+        with pytest.raises(RuntimeError), tr.span("boom"):
+            raise RuntimeError("x")
+        with tr.span("after") as after:
+            pass
+        spans = {s["name"]: s for s in tr.recent()}
+        assert spans["boom"]["attrs"]["error"] == "RuntimeError"
+        # the failed span popped off the stack: "after" is a fresh root
+        assert after.parent_id == ""
+        assert spans["after"]["trace_id"] != spans["boom"]["trace_id"]
+
+    def test_ring_bounded(self):
+        tr = Tracer(max_spans=16)
+        for i in range(100):
+            tr.record(f"s{i}", start=float(i), end=float(i) + 1.0)
+        assert len(tr) == 16
+        names = [s["name"] for s in tr.recent()]
+        assert names == [f"s{i}" for i in range(84, 100)]  # newest survive
+
+    def test_get_trace_filters(self):
+        tr = Tracer()
+        tid = Tracer.new_trace_id()
+        tr.record("a", 0.0, 1.0, trace_id=tid)
+        tr.record("b", 0.0, 1.0)  # different trace
+        tr.record("c", 1.0, 2.0, trace_id=tid)
+        assert [s["name"] for s in tr.get_trace(tid)] == ["a", "c"]
+
+    def test_jsonl_export(self, tmp_path):
+        path = tmp_path / "sub" / "spans.jsonl"  # parent dir auto-created
+        tr = Tracer(export_path=str(path))
+        tid = Tracer.new_trace_id()
+        tr.record("one", 10.0, 11.5, trace_id=tid, attrs={"rid": "r1"})
+        tr.record("two", 11.5, 12.0, trace_id=tid)
+        tr.close()
+        lines = [json.loads(l) for l in
+                 path.read_text().strip().splitlines()]
+        assert [l["name"] for l in lines] == ["one", "two"]
+        assert lines[0]["trace_id"] == tid
+        assert lines[0]["duration_s"] == 1.5
+        assert lines[0]["attrs"] == {"rid": "r1"}
+
+    def test_injected_empty_tracer_keeps_identity(self):
+        """An EMPTY tracer is falsy (len 0) — consumers must select it with
+        `is None`, never `or`, or the caller's export-wired tracer gets
+        silently swapped for a fresh one (caught live by /verify: the
+        --trace-export file stayed empty while the ring filled)."""
+        from k8s_runpod_kubelet_tpu.provider import Provider
+        from harness import make_harness
+        tr = Tracer()
+        assert not tr  # the trap this test guards
+        h = make_harness()
+        try:
+            p = Provider(h.cfg, h.kube, h.tpu, clock=h.clock, tracer=tr)
+            assert p.tracer is tr
+        finally:
+            h.close()
+
+    def test_fake_clock_injection(self):
+        t = {"now": 1000.0}
+        tr = Tracer(clock=lambda: t["now"], monotonic=lambda: t["now"])
+        with tr.span("timed"):
+            t["now"] += 5.0
+        s = tr.recent()[-1]
+        assert s["start"] == 1000.0
+        assert s["duration_s"] == 5.0
+
+
+class TestTraceSummaryTool:
+    def test_rollups_and_waterfall(self, tmp_path, capsys):
+        import sys
+        sys.path.insert(0, str(
+            __import__("pathlib").Path(__file__).parent.parent / "tools"))
+        import trace_summary
+        tr = Tracer(export_path=str(tmp_path / "s.jsonl"))
+        for i in range(3):
+            tid = Tracer.new_trace_id()
+            root = Tracer.new_span_id()
+            t0 = 100.0 * i
+            tr.record("serving.request", t0, t0 + 1.0, trace_id=tid,
+                      span_id=root,
+                      attrs={"rid": f"r{i}", "ttft_s": 0.1 * (i + 1),
+                             "latency_s": 1.0, "tokens": 11})
+            tr.record("serving.queue_wait", t0, t0 + 0.05, trace_id=tid,
+                      parent_id=root)
+            tr.record("serving.prefill", t0 + 0.05, t0 + 0.1, trace_id=tid,
+                      parent_id=root)
+            tr.record("serving.decode", t0 + 0.1, t0 + 1.0, trace_id=tid,
+                      parent_id=root, attrs={"tokens": 11})
+        tr.close()
+        assert trace_summary.main([str(tmp_path / "s.jsonl")]) == 0
+        out = capsys.readouterr().out
+        assert "requests: 3" in out
+        assert "p50=" in out and "p99=" in out
+        assert "serving.decode" in out
+        # single-trace mode
+        spans = trace_summary.load_spans(str(tmp_path / "s.jsonl"))
+        tid = spans[0]["trace_id"]
+        assert trace_summary.main([str(tmp_path / "s.jsonl"),
+                                   "--trace", tid]) == 0
+        out = capsys.readouterr().out
+        assert tid in out and "serving.prefill" in out
+
+    def test_percentile_nearest_rank(self):
+        import sys
+        sys.path.insert(0, str(
+            __import__("pathlib").Path(__file__).parent.parent / "tools"))
+        import trace_summary
+        vals = sorted(float(i) for i in range(1, 101))
+        assert trace_summary.percentile(vals, 50) == 50.0
+        assert trace_summary.percentile(vals, 99) == 99.0
+        assert trace_summary.percentile([7.0], 95) == 7.0
+
+
+@pytest.mark.slow
+class TestServingTraceRoundTrip:
+    """ISSUE 2 acceptance: a /v1/completions request carrying a traceparent
+    header yields a queue-wait/prefill/decode span tree at
+    /debug/traces?trace_id=..., consistent with the recorded latency, and
+    the SLO histograms appear in /metrics with valid TYPE lines and
+    sub-second buckets."""
+
+    @pytest.fixture(scope="class")
+    def server(self):
+        import jax
+        import jax.numpy as jnp
+        from k8s_runpod_kubelet_tpu.models import init_params, tiny_llama
+        from k8s_runpod_kubelet_tpu.workloads.serve_main import serve
+        from k8s_runpod_kubelet_tpu.workloads.serving import (ServingConfig,
+                                                              ServingEngine)
+        from k8s_runpod_kubelet_tpu.workloads.tokenizer import get_tokenizer
+        cfg = tiny_llama(vocab_size=300, embed_dim=64, n_layers=2, n_heads=4,
+                         n_kv_heads=2, mlp_dim=128, max_seq_len=256,
+                         dtype=jnp.float32, param_dtype=jnp.float32)
+        e = ServingEngine(cfg, init_params(cfg, jax.random.PRNGKey(0)),
+                          ServingConfig(slots=2, max_prefill_len=16,
+                                        cache_len=64, max_new_tokens=16)
+                          ).start()
+        httpd = serve(e, 0, tokenizer=get_tokenizer("bytes"))
+        yield httpd.server_address[1], e
+        httpd.shutdown()
+        e.stop()
+
+    @staticmethod
+    def _post_raw(port, path, payload, headers=None):
+        import urllib.request
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", json.dumps(payload).encode(),
+            {"Content-Type": "application/json", **(headers or {})})
+        return urllib.request.urlopen(req, timeout=120)
+
+    @staticmethod
+    def _get_json(port, path):
+        import urllib.request
+        return json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30).read())
+
+    def test_traceparent_roundtrip_and_span_tree(self, server):
+        port, engine = server
+        tid = Tracer.new_trace_id()
+        caller_span = Tracer.new_span_id()
+        with self._post_raw(
+                port, "/v1/completions",
+                {"prompt": [5, 9, 2], "max_tokens": 6, "temperature": 0},
+                headers={"traceparent":
+                         format_traceparent(tid, caller_span)}) as resp:
+            body = json.loads(resp.read())
+            stamped = parse_traceparent(resp.headers["traceparent"])
+        assert body["usage"]["completion_tokens"] == 6
+        # response carries OUR trace id with the request's root span
+        assert stamped is not None and stamped[0] == tid
+        assert stamped[1] != caller_span
+        spans = self._get_json(
+            port, f"/debug/traces?trace_id={tid}")["spans"]
+        by_name = {s["name"]: s for s in spans}
+        for name in ("serving.request", "serving.queue_wait",
+                     "serving.prefill", "serving.decode"):
+            assert name in by_name, (name, sorted(by_name))
+        root = by_name["serving.request"]
+        assert root["span_id"] == stamped[1]
+        assert root["parent_id"] == caller_span  # joined to the caller
+        for name in ("serving.queue_wait", "serving.prefill",
+                     "serving.decode"):
+            assert by_name[name]["parent_id"] == root["span_id"]
+        # contiguous children: durations sum to the recorded request latency
+        child_sum = sum(by_name[n]["duration_s"] for n in
+                        ("serving.queue_wait", "serving.prefill",
+                         "serving.decode"))
+        assert child_sum == pytest.approx(root["duration_s"], rel=1e-3,
+                                          abs=1e-3)
+        lat = root["attrs"]["latency_s"]
+        assert any(abs(o - lat) < 1e-6 for o in engine.metrics.
+                   get_observations("tpu_serving_request_latency_seconds"))
+        assert 0.0 < root["attrs"]["ttft_s"] <= root["duration_s"] + 1e-9
+
+    def test_without_header_trace_is_minted_and_stamped(self, server):
+        port, _ = server
+        with self._post_raw(port, "/generate",
+                            {"tokens": [7, 3], "max_new_tokens": 4}) as resp:
+            json.loads(resp.read())
+            stamped = parse_traceparent(resp.headers["traceparent"])
+        assert stamped is not None
+        spans = self._get_json(
+            port, f"/debug/traces?trace_id={stamped[0]}")["spans"]
+        assert any(s["name"] == "serving.request"
+                   and s["parent_id"] == "" for s in spans)
+
+    def test_slo_metrics_exposed_with_subsecond_buckets(self, server):
+        port, _ = server
+        self._post_raw(port, "/v1/completions",
+                       {"prompt": [1, 2, 3], "max_tokens": 4}).read()
+        import urllib.request
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30).read().decode()
+        from test_metrics_exposition import family_of, parse_exposition
+        families, helps, samples = parse_exposition(text)
+        for fam in ("tpu_serving_ttft_seconds",
+                    "tpu_serving_inter_token_seconds",
+                    "tpu_serving_queue_wait_seconds",
+                    "tpu_serving_batch_utilization"):
+            assert families[fam] == "histogram", fam
+            assert fam in helps, fam
+        assert families["tpu_serving_kv_cache_tokens"] == "gauge"
+        assert families["tpu_serving_admitted_total"] == "counter"
+        for name, _, _ in samples:
+            family_of(name, families)
+        # sub-second resolution: the tiny CPU model decodes in millis, so
+        # sub-0.5s buckets must already be non-zero (the satellite bug put
+        # every sample in one giant first bucket)
+        assert 'tpu_serving_inter_token_seconds_bucket{le="0.001"}' in text
+        itl_count = float([l for l in text.splitlines() if l.startswith(
+            "tpu_serving_inter_token_seconds_count")][0].split()[-1])
+        assert itl_count > 0
+        assert 'tpu_serving_ttft_seconds_bucket{le="0.005"}' in text
+
+    def test_debug_route_requires_exact_path(self, server):
+        import urllib.error
+        import urllib.request
+        port, _ = server
+        for path in ("/debug/tracesfoo", "/debug/traces/x", "/debug/enginez"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=10)
+            assert ei.value.code == 404, path
+
+    def test_debug_engine_statusz(self, server):
+        port, engine = server
+        snap = self._get_json(port, "/debug/engine")
+        assert snap["max_slots"] == 2
+        assert snap["alive"] is True
+        assert len(snap["slots"]) == 2
+        assert snap["queue_depth"] == 0
+        assert snap["total_generated"] >= 1
+        assert snap["cache_len"] == 64
+        # shape matches the engine's own snapshot
+        assert set(snap) == set(engine.debug_snapshot())
+
+
+class TestPodLifecycleSpans:
+    def test_lifecycle_spans_share_annotated_trace_id(self):
+        """create -> deploy -> ACTIVE -> ready emits a span tree under ONE
+        trace_id, durably annotated on the pod (tpu.dev/trace-id) so a
+        serving request on the slice can be joined to its provisioning
+        history."""
+        from k8s_runpod_kubelet_tpu.kube import objects as ko
+        from k8s_runpod_kubelet_tpu.provider.annotations import Annotations as A
+        from harness import make_harness, make_pod
+        h = make_harness()
+        try:
+            created = h.kube.create_pod(make_pod(chips=16))
+            h.provider.create_pod(created)
+            pod = h.kube.get_pod("default", "train")
+            trace_id = ko.annotations(pod)[A.TRACE_ID]
+            assert len(trace_id) == 32
+            h.clock.advance(7.5)
+            h.provider.update_all_pod_statuses()  # gang launch -> Running
+            spans = {s["name"]: s for s in h.provider.tracer.get_trace(trace_id)}
+            for name in ("pod.deploy", "pod.provisioning", "pod.gang_launch",
+                         "pod.ready_wait", "pod.lifecycle"):
+                assert name in spans, (name, sorted(spans))
+            root = spans["pod.lifecycle"]
+            assert root["attrs"]["schedule_to_ready_s"] == pytest.approx(7.5)
+            assert root["duration_s"] == pytest.approx(7.5)
+            for name in ("pod.deploy", "pod.provisioning", "pod.gang_launch",
+                         "pod.ready_wait"):
+                assert spans[name]["parent_id"] == root["span_id"], name
+            # provisioning waited the advanced 7.5s (FakeClock-injected)
+            assert spans["pod.provisioning"]["duration_s"] == pytest.approx(7.5)
+            assert spans["pod.deploy"]["attrs"]["slice"].startswith("qr-")
+        finally:
+            h.close()
+
+    def test_preemption_requeue_spans_are_attempt_scoped(self):
+        """A requeued pod re-enters ready: the lifecycle ROOT must not be
+        re-recorded (duplicate span_id), and the second attempt's
+        pod.provisioning span times the REDEPLOY -> ACTIVE wait, not the
+        pod's whole life since schedule."""
+        from k8s_runpod_kubelet_tpu.kube import objects as ko
+        from k8s_runpod_kubelet_tpu.provider.annotations import Annotations as A
+        from harness import make_harness, make_pod
+        h = make_harness()
+        try:
+            created = h.kube.create_pod(make_pod(chips=16))
+            h.provider.create_pod(created)
+            pod = h.kube.get_pod("default", "train")
+            trace_id = ko.annotations(pod)[A.TRACE_ID]
+            h.provider.update_all_pod_statuses()  # attempt 1 -> ready
+            h.fake.preempt(ko.annotations(pod)[A.QUEUED_RESOURCE])
+            h.provider.update_all_pod_statuses()  # requeue
+            h.clock.advance(100.0)
+            h.provider.process_pending_pods()     # redeploy (attempt 2)
+            h.clock.advance(4.0)
+            h.provider.update_all_pod_statuses()  # attempt 2 -> ready
+            spans = h.provider.tracer.get_trace(trace_id)
+            lifecycle = [s for s in spans if s["name"] == "pod.lifecycle"]
+            assert len(lifecycle) == 1  # once, like the north-star metric
+            ids = [s["span_id"] for s in spans]
+            assert len(ids) == len(set(ids))  # no duplicate span ids
+            prov = [s for s in spans if s["name"] == "pod.provisioning"]
+            assert [p["attrs"]["attempt"] for p in prov] == [0, 1]
+            # attempt 2 waited 4s from ITS deploy, not 104s from schedule
+            assert prov[1]["duration_s"] == pytest.approx(4.0)
+            assert len([s for s in spans
+                        if s["name"] == "pod.ready_wait"]) == 2
+        finally:
+            h.close()
+
+    def test_trace_root_survives_kubelet_restart(self):
+        """Recovery restores only the annotated trace_id; the lifecycle
+        ROOT id is derived deterministically (trace_id[:16]), so spans
+        recorded before and after a restart parent under the same root."""
+        from k8s_runpod_kubelet_tpu.kube import objects as ko
+        from k8s_runpod_kubelet_tpu.provider import Provider
+        from k8s_runpod_kubelet_tpu.provider.annotations import Annotations as A
+        from harness import FakeClock, make_harness, make_pod
+        h = make_harness()
+        try:
+            created = h.kube.create_pod(make_pod(chips=16))
+            h.provider.create_pod(created)  # deploy span recorded pre-restart
+            pod = h.kube.get_pod("default", "train")
+            trace_id = ko.annotations(pod)[A.TRACE_ID]
+            pre = h.provider.tracer.get_trace(trace_id)
+            assert [s["name"] for s in pre] == ["pod.deploy"]
+            # "restart": a fresh provider over the same cluster state
+            p2 = Provider(h.cfg, h.kube, h.tpu, gang_executor=h.provider.gang,
+                          clock=FakeClock(h.clock.t + 5.0))
+            p2.load_running()
+            p2.update_all_pod_statuses()  # -> ready, post-restart spans
+            post = p2.tracer.get_trace(trace_id)
+            names = {s["name"] for s in post}
+            assert {"pod.provisioning", "pod.ready_wait",
+                    "pod.lifecycle"} <= names
+            root = trace_id[:16]
+            assert pre[0]["parent_id"] == root  # pre-restart child
+            lifecycle = next(s for s in post if s["name"] == "pod.lifecycle")
+            assert lifecycle["span_id"] == root  # same tree across restart
+            for s in post:
+                if s["name"] != "pod.lifecycle":
+                    assert s["parent_id"] == root, s["name"]
+        finally:
+            h.close()
+
+    def test_kubelet_health_server_serves_debug_traces(self):
+        import json as _json
+        import urllib.request
+        from k8s_runpod_kubelet_tpu.health import HealthServer
+        tr = Tracer()
+        tid = Tracer.new_trace_id()
+        tr.record("pod.deploy", 0.0, 1.0, trace_id=tid)
+        tr.record("other", 0.0, 1.0)
+        hs = HealthServer(":0", tracer=tr).start()
+        try:
+            base = f"http://127.0.0.1:{hs.port}"
+            out = _json.loads(urllib.request.urlopen(
+                f"{base}/debug/traces", timeout=10).read())
+            assert len(out["spans"]) == 2
+            out = _json.loads(urllib.request.urlopen(
+                f"{base}/debug/traces?trace_id={tid}", timeout=10).read())
+            assert [s["name"] for s in out["spans"]] == ["pod.deploy"]
+            # no engine wired on the kubelet: /debug/engine 404s
+            import urllib.error
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{base}/debug/engine", timeout=10)
+            assert ei.value.code == 404
+        finally:
+            hs.stop()

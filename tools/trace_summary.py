@@ -1,0 +1,177 @@
+"""Render a JSONL span export into per-request waterfalls + SLO rollups.
+
+The serving engine (`--trace-export`) and the kubelet (`--trace-export` /
+TPU_TRACE_EXPORT_PATH) append one JSON span per line:
+
+  {"trace_id": ..., "span_id": ..., "parent_id": ..., "name": ...,
+   "start": <wall seconds>, "duration_s": ..., "attrs": {...}}
+
+This tool groups spans by trace, prints each trace as an indented waterfall
+(offset + bar over the trace's own timeline), and rolls up the SLO currency
+across all `serving.request` spans: p50/p95/p99 of TTFT (the request span's
+``ttft_s`` attr) and of per-request mean inter-token latency (the
+``serving.decode`` span's duration over its tokens-1 gaps).
+
+Usage:
+  python tools/trace_summary.py spans.jsonl                 # rollups + slowest traces
+  python tools/trace_summary.py spans.jsonl --trace <id>    # one trace's waterfall
+  python tools/trace_summary.py spans.jsonl --top 10        # how many traces to draw
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+from collections import defaultdict
+
+_BAR_WIDTH = 40
+
+
+def load_spans(path: str) -> list[dict]:
+    spans = []
+    with open(path, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                s = json.loads(line)
+            except json.JSONDecodeError:
+                print(f"warning: {path}:{lineno}: bad JSON, skipped",
+                      file=sys.stderr)
+                continue
+            if isinstance(s, dict) and "trace_id" in s and "name" in s:
+                spans.append(s)
+    return spans
+
+
+def percentile(sorted_vals: list[float], p: float) -> float:
+    """Nearest-rank percentile over a pre-sorted list."""
+    if not sorted_vals:
+        return float("nan")
+    rank = max(1, min(len(sorted_vals),
+                      math.ceil(p / 100.0 * len(sorted_vals))))
+    return sorted_vals[rank - 1]
+
+
+def _tree_order(spans: list[dict]) -> list[tuple[int, dict]]:
+    """(depth, span) rows: children under their parent, siblings by start.
+    Spans whose parent is absent from the trace (e.g. the inbound caller's
+    span, or a root exported after its children rotated out of the file)
+    render as roots."""
+    by_id = {s["span_id"]: s for s in spans}
+    children: dict[str, list[dict]] = defaultdict(list)
+    roots = []
+    for s in spans:
+        parent = s.get("parent_id") or ""
+        if parent and parent in by_id and parent != s["span_id"]:
+            children[parent].append(s)
+        else:
+            roots.append(s)
+    rows: list[tuple[int, dict]] = []
+    seen: set[str] = set()
+
+    def walk(span: dict, depth: int):
+        if span["span_id"] in seen:  # defensive: malformed cyclic parents
+            return
+        seen.add(span["span_id"])
+        rows.append((depth, span))
+        for c in sorted(children.get(span["span_id"], []),
+                        key=lambda s: s.get("start", 0.0)):
+            walk(c, depth + 1)
+
+    for r in sorted(roots, key=lambda s: s.get("start", 0.0)):
+        walk(r, 0)
+    return rows
+
+
+def render_trace(trace_id: str, spans: list[dict]) -> str:
+    t0 = min(s.get("start", 0.0) for s in spans)
+    t1 = max(s.get("start", 0.0) + s.get("duration_s", 0.0) for s in spans)
+    total = max(t1 - t0, 1e-9)
+    out = [f"trace {trace_id}  ({total * 1000:.1f} ms, {len(spans)} spans)"]
+    for depth, s in _tree_order(spans):
+        start = s.get("start", 0.0) - t0
+        dur = s.get("duration_s", 0.0)
+        lo = int(start / total * _BAR_WIDTH)
+        hi = max(lo + 1, int((start + dur) / total * _BAR_WIDTH))
+        bar = " " * lo + "#" * (hi - lo)
+        bar = bar[:_BAR_WIDTH].ljust(_BAR_WIDTH)
+        label = "  " * depth + s["name"]
+        attrs = s.get("attrs") or {}
+        extra = " ".join(f"{k}={attrs[k]}" for k in ("rid", "pod", "tokens")
+                         if attrs.get(k) is not None)
+        out.append(f"  {label:<32} |{bar}| {start * 1000:8.1f} ms "
+                   f"+{dur * 1000:8.1f} ms  {extra}".rstrip())
+    return "\n".join(out)
+
+
+def rollups(spans: list[dict]) -> str:
+    ttfts, itls, latencies = [], [], []
+    for s in spans:
+        attrs = s.get("attrs") or {}
+        if s["name"] == "serving.request":
+            if isinstance(attrs.get("ttft_s"), (int, float)):
+                ttfts.append(float(attrs["ttft_s"]))
+            if isinstance(attrs.get("latency_s"), (int, float)):
+                latencies.append(float(attrs["latency_s"]))
+        elif s["name"] == "serving.decode":
+            tokens = attrs.get("tokens")
+            if isinstance(tokens, int) and tokens > 1:
+                itls.append(s.get("duration_s", 0.0) / (tokens - 1))
+    lines = [f"requests: {len(latencies)}"]
+    for label, vals in (("ttft_s", ttfts), ("itl_s (per-request mean)", itls),
+                        ("latency_s", latencies)):
+        if not vals:
+            lines.append(f"  {label:<28} (no samples)")
+            continue
+        vals = sorted(vals)
+        lines.append(
+            f"  {label:<28} p50={percentile(vals, 50):.4f}  "
+            f"p95={percentile(vals, 95):.4f}  p99={percentile(vals, 99):.4f}  "
+            f"n={len(vals)}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="waterfall + TTFT/ITL rollups from a JSONL span export")
+    p.add_argument("path", help="JSONL span file (--trace-export output)")
+    p.add_argument("--trace", default="",
+                   help="render only this trace_id's waterfall")
+    p.add_argument("--top", type=int, default=5,
+                   help="without --trace: draw the N slowest traces")
+    args = p.parse_args(argv)
+    spans = load_spans(args.path)
+    if not spans:
+        print(f"no spans in {args.path}", file=sys.stderr)
+        return 1
+    by_trace: dict[str, list[dict]] = defaultdict(list)
+    for s in spans:
+        by_trace[s["trace_id"]].append(s)
+    if args.trace:
+        if args.trace not in by_trace:
+            print(f"trace {args.trace} not found "
+                  f"({len(by_trace)} traces in file)", file=sys.stderr)
+            return 1
+        print(render_trace(args.trace, by_trace[args.trace]))
+        return 0
+    print(rollups(spans))
+    print()
+
+    def trace_span(tid: str) -> float:
+        ss = by_trace[tid]
+        return (max(s.get("start", 0.0) + s.get("duration_s", 0.0) for s in ss)
+                - min(s.get("start", 0.0) for s in ss))
+
+    slowest = sorted(by_trace, key=trace_span, reverse=True)[:args.top]
+    for tid in slowest:
+        print(render_trace(tid, by_trace[tid]))
+        print()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
