@@ -21,7 +21,9 @@ from .tpu_client import TpuClient, TpuApiError, NotFoundError, QuotaError
 from .gcp_auth import (AdcUserTokenProvider, AuthError, MetadataTokenProvider,
                        StaticTokenProvider, default_token_provider,
                        is_google_api_endpoint)
-from .transport import HttpTransport, TransportError
+from .transport import (CircuitBreaker, CircuitOpenError, HttpTransport,
+                        TransportError, parse_retry_after)
+from .faults import FaultPlan, FaultWindow
 from .workload_backend import (ApiWorkloadBackend, SshWorkloadBackend,
                                WorkloadBackend, WorkloadBackendError)
 
@@ -45,6 +47,11 @@ __all__ = [
     "QuotaError",
     "HttpTransport",
     "TransportError",
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "parse_retry_after",
+    "FaultPlan",
+    "FaultWindow",
     "AuthError",
     "StaticTokenProvider",
     "MetadataTokenProvider",

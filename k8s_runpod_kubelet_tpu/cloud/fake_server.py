@@ -10,7 +10,13 @@ REST surface TpuClient speaks, with
 - workload simulation (gang launch marks every worker running; finish_workload()
   or auto_finish_s drives per-worker exits), and
 - fault injection (SURVEY.md §5.3 gap): quota exhaustion, API blackout, worker
-  preemption, slice vanish (NOT_FOUND paths).
+  preemption, slice vanish (NOT_FOUND paths) — plus a pluggable seeded
+  ``FaultPlan`` (cloud/faults.py) that composes error bursts, latency spikes,
+  blackouts and preemption storms deterministically for chaos soaks.
+
+The service clock is injectable (``clock=``): chaos tests share one FakeClock
+across provider, transport and this server, so the whole state machine runs
+on simulated time with zero real sleeps.
 
 Tests drive failure paths the reference never covered.
 """
@@ -90,7 +96,7 @@ class _FakeResource:
         if (self.state is QueuedResourceState.ACTIVE and self.workload_started_at
                 and self.auto_finish_s is not None
                 and now - self.workload_started_at >= self.auto_finish_s):
-            self.finish_workload()
+            self.finish_workload(now=now)
 
     def _set(self, state: QueuedResourceState, msg: str, now: float):
         self.state = state
@@ -115,8 +121,8 @@ class _FakeResource:
             self.ports[port] = 30000 + port % 2000
 
     def finish_workload(self, exit_codes: Optional[list[int]] = None,
-                        message: str = ""):
-        now = time.time()
+                        message: str = "", now: Optional[float] = None):
+        now = time.time() if now is None else now
         for i, r in enumerate(self.runtime):
             code = exit_codes[i] if exit_codes and i < len(exit_codes) else 0
             r["workload_running"] = False
@@ -145,8 +151,10 @@ class FakeTpuService:
     """Shared mutable state + fault-injection switches (thread-safe)."""
 
     def __init__(self, provision_delay_s: float = 0.0,
-                 workload_auto_finish_s: Optional[float] = None):
+                 workload_auto_finish_s: Optional[float] = None,
+                 clock=time.time):
         self.lock = threading.RLock()
+        self.clock = clock
         self.resources: dict[str, _FakeResource] = {}
         self.provision_delay_s = provision_delay_s
         self.workload_auto_finish_s = workload_auto_finish_s
@@ -166,6 +174,10 @@ class FakeTpuService:
         # fault injection
         self.api_down = False            # every request -> 503
         self.fail_next_create: Optional[tuple[int, str]] = None  # (status, message)
+        # seeded composite chaos: when set, every request consults the plan
+        # (latency spikes advance the injected clock, storms preempt ACTIVE
+        # slices, blackouts/bursts reject) — see cloud/faults.py
+        self.fault_plan = None
         self.create_count = 0
         self.delete_count = 0
         self.request_log: list[tuple[str, str]] = []
@@ -181,10 +193,10 @@ class FakeTpuService:
         with self.lock:
             for r in self.resources.values():
                 if r.state is QueuedResourceState.ACCEPTED:
-                    r._set(QueuedResourceState.PROVISIONING, "creating TPU VMs", time.time())
+                    r._set(QueuedResourceState.PROVISIONING, "creating TPU VMs", self.clock())
                 if r.state is QueuedResourceState.PROVISIONING:
                     r._make_workers()
-                    r._set(QueuedResourceState.ACTIVE, "slice ready", time.time())
+                    r._set(QueuedResourceState.ACTIVE, "slice ready", self.clock())
 
     def preempt(self, name: str, worker_id: Optional[int] = None):
         """Simulate a maintenance event: whole slice (or one worker) goes away."""
@@ -192,7 +204,7 @@ class FakeTpuService:
             r = self.resources[name]
             if worker_id is None:
                 r._set(QueuedResourceState.SUSPENDED, "preempted by maintenance event",
-                       time.time())
+                       self.clock())
                 for w in r.workers:
                     w["state"] = "PREEMPTED"
                 for rt in r.runtime:
@@ -213,18 +225,29 @@ class FakeTpuService:
         """Pin a resource to a state (e.g. DELETING forever) for escalation tests."""
         with self.lock:
             r = self.resources[name]
-            r._set(state, message, time.time())
+            r._set(state, message, self.clock())
             r.provision_delay_s = float("inf")
 
     # -- request handling (called from the HTTP handler) -----------------------
 
     def handle(self, method: str, path: str, query: dict, body: Optional[dict]):
-        """Returns (status, json_body_or_None)."""
+        """Returns (status, json_body_or_None) or (status, body, headers)."""
         with self.lock:
             self.request_log.append((method, path))
             if self.api_down:
                 return 503, {"error": "service unavailable"}
-            now = time.time()
+            if self.fault_plan is not None:
+                # latency first (simulated time passes BEFORE the request is
+                # served), then storms mutate state, then reject decisions
+                self.fault_plan.apply_latency()
+                for victim in self.fault_plan.preempt_victims(
+                        [r.name for r in self.resources.values()
+                         if r.state is QueuedResourceState.ACTIVE]):
+                    self.preempt(victim)
+                fault = self.fault_plan.request_fault()
+                if fault is not None:
+                    return fault
+            now = self.clock()
             for r in self.resources.values():
                 r.advance(now)
 
@@ -330,15 +353,22 @@ class _Handler(BaseHTTPRequestHandler):
                 body = json.loads(self.rfile.read(length))
             except json.JSONDecodeError:
                 body = None
+        headers: dict = {}
         try:
-            status, payload = self.service.handle(method, parsed.path,
-                                                  parse_qs(parsed.query), body)
+            result = self.service.handle(method, parsed.path,
+                                         parse_qs(parsed.query), body)
+            if len(result) == 3:
+                status, payload, headers = result
+            else:
+                status, payload = result
         except (KeyError, TypeError, ValueError) as e:
             status, payload = 400, {"error": f"bad request: {e}"}
         data = json.dumps(payload).encode() if payload is not None else b""
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        for k, v in headers.items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
 
@@ -356,8 +386,10 @@ class FakeTpuServer:
     """Owns the HTTP listener; use as a context manager or start()/stop()."""
 
     def __init__(self, provision_delay_s: float = 0.0,
-                 workload_auto_finish_s: Optional[float] = None):
-        self.service = FakeTpuService(provision_delay_s, workload_auto_finish_s)
+                 workload_auto_finish_s: Optional[float] = None,
+                 clock=time.time):
+        self.service = FakeTpuService(provision_delay_s, workload_auto_finish_s,
+                                      clock=clock)
         handler = type("BoundHandler", (_Handler,), {"service": self.service})
         self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
         self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)

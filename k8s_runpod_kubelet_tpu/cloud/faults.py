@@ -1,0 +1,173 @@
+"""Seeded, clock-injected fault scheduling for deterministic chaos tests.
+
+The fake server (cloud/fake_server.py) already has manual fault switches
+(api_down, fail_next_create, preempt(), vanish()); what those can't do is
+COMPOSE into the messy overlapping reality of a real cloud week: an error
+burst during a preemption storm, a latency spike right as the API heals.
+``FaultPlan`` closes that gap: a seeded RNG lays out fault windows over a
+time horizon, an injected clock decides which are active, and the fake
+server consults the plan on every request — so a chaos soak is fully
+deterministic (same seed + same request sequence = same faults) and runs
+with NO real sleeps (latency is modeled by advancing the injected clock).
+
+Every random draw comes from the plan's own ``random.Random(seed)``; the
+seed is embedded in ``describe()`` so a failing soak prints its replay key.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Callable, Optional
+
+# window kinds, in rough escalation order
+ERROR_BURST = "error_burst"        # fraction of requests 500/503
+LATENCY_SPIKE = "latency_spike"    # every request takes `param` extra seconds
+BLACKOUT = "blackout"              # every request 503 (+ Retry-After)
+PREEMPTION_STORM = "preemption_storm"  # ACTIVE slices get preempted
+FLAKY_HEAL = "flaky_heal"          # error rate decays linearly to 0 over the window
+
+KINDS = (ERROR_BURST, LATENCY_SPIKE, BLACKOUT, PREEMPTION_STORM, FLAKY_HEAL)
+
+
+@dataclasses.dataclass
+class FaultWindow:
+    """One scheduled fault. ``start``/``end`` are offsets (seconds) from the
+    plan's birth; ``param`` is kind-specific: error probability for
+    ERROR_BURST/FLAKY_HEAL, added seconds for LATENCY_SPIKE, per-slice
+    preemption probability per poll for PREEMPTION_STORM, Retry-After
+    seconds for BLACKOUT."""
+
+    kind: str
+    start: float
+    end: float
+    param: float = 0.0
+
+    def active_at(self, t: float) -> bool:
+        return self.start <= t < self.end
+
+
+class FaultPlan:
+    """Deterministic chaos schedule the fake server executes.
+
+    ``clock`` is the shared injected clock (the same one the provider,
+    transport and fake server use). ``advance`` (optional) is how latency
+    spikes "happen": instead of sleeping, the plan advances the shared
+    clock by the spike amount — wall time is untouched, simulated time
+    pays the cost, and the transport's deadline budget sees it."""
+
+    def __init__(self, seed: int, clock: Callable[[], float], *,
+                 horizon_s: float = 600.0,
+                 windows: Optional[list[FaultWindow]] = None,
+                 advance: Optional[Callable[[float], None]] = None):
+        self.seed = seed
+        self.clock = clock
+        self.advance = advance
+        self.rng = random.Random(seed)
+        self.horizon_s = horizon_s
+        self.t0 = clock()
+        self.windows = list(windows) if windows is not None \
+            else self._generate(horizon_s)
+        # what actually fired, for post-mortems
+        self.injected_errors = 0
+        self.injected_latency_s = 0.0
+        self.preempted: list[tuple[float, str]] = []
+
+    # -- schedule generation ---------------------------------------------------
+
+    def _generate(self, horizon_s: float) -> list[FaultWindow]:
+        """Random walk over the horizon: quiet gap, then a fault window, and
+        again — ending with a mandatory quiet tail (>= 25% of the horizon)
+        so every plan gives the system room to converge."""
+        windows: list[FaultWindow] = []
+        t = self.rng.uniform(5.0, horizon_s * 0.1)
+        quiet_tail = horizon_s * 0.75
+        while t < quiet_tail:
+            kind = self.rng.choice(KINDS)
+            dur = self.rng.uniform(10.0, horizon_s * 0.15)
+            dur = min(dur, quiet_tail - t)
+            if dur <= 0:
+                break
+            if kind in (ERROR_BURST, FLAKY_HEAL):
+                param = self.rng.uniform(0.2, 0.8)
+            elif kind == LATENCY_SPIKE:
+                param = self.rng.uniform(0.5, 5.0)
+            elif kind == BLACKOUT:
+                param = self.rng.uniform(1.0, 10.0)  # Retry-After seconds
+            else:  # PREEMPTION_STORM
+                param = self.rng.uniform(0.1, 0.5)
+            windows.append(FaultWindow(kind, t, t + dur, param))
+            t += dur + self.rng.uniform(5.0, horizon_s * 0.1)
+        return windows
+
+    # -- queries (called by the fake server per request) -----------------------
+
+    def _now(self) -> float:
+        return self.clock() - self.t0
+
+    def active(self, kind: Optional[str] = None) -> list[FaultWindow]:
+        t = self._now()
+        return [w for w in self.windows if w.active_at(t)
+                and (kind is None or w.kind == kind)]
+
+    @property
+    def quiet(self) -> bool:
+        """Past every window — the plan is done injecting faults."""
+        return self._now() >= max((w.end for w in self.windows), default=0.0)
+
+    def apply_latency(self):
+        """Advance the injected clock by the active latency spike (if any).
+        Called once per request BEFORE it is served."""
+        for w in self.active(LATENCY_SPIKE):
+            if self.advance is not None:
+                self.advance(w.param)
+            self.injected_latency_s += w.param
+
+    def request_fault(self) -> Optional[tuple[int, dict, dict]]:
+        """Should this request fail? Returns (status, body, headers) or None.
+        Blackouts reject everything with a Retry-After; error bursts reject a
+        seeded fraction; flaky-heal windows reject a fraction that decays
+        linearly to zero across the window (the API getting better)."""
+        t = self._now()
+        for w in self.windows:
+            if not w.active_at(t):
+                continue
+            if w.kind == BLACKOUT:
+                self.injected_errors += 1
+                return 503, {"error": "injected blackout"}, \
+                    {"Retry-After": str(int(w.param))}
+            if w.kind == ERROR_BURST and self.rng.random() < w.param:
+                self.injected_errors += 1
+                status = 503 if self.rng.random() < 0.7 else 500
+                return status, {"error": "injected error burst"}, {}
+            if w.kind == FLAKY_HEAL:
+                frac = 1.0 - (t - w.start) / max(1e-9, w.end - w.start)
+                if self.rng.random() < w.param * frac:
+                    self.injected_errors += 1
+                    return 503, {"error": "injected flake (healing)"}, {}
+        return None
+
+    def preempt_victims(self, active_slices: list[str]) -> list[str]:
+        """During a preemption storm, pick victims among the ACTIVE slice
+        names (each independently with the window's probability). The fake
+        server calls this once per request and preempts the returned ones."""
+        storms = self.active(PREEMPTION_STORM)
+        if not storms:
+            return []
+        p = max(w.param for w in storms)
+        victims = [n for n in sorted(active_slices) if self.rng.random() < p]
+        for v in victims:
+            self.preempted.append((self._now(), v))
+        return victims
+
+    # -- replay/debug ----------------------------------------------------------
+
+    def describe(self) -> str:
+        lines = [f"FaultPlan(seed={self.seed}, horizon={self.horizon_s:.0f}s, "
+                 f"errors={self.injected_errors}, "
+                 f"latency={self.injected_latency_s:.1f}s, "
+                 f"preemptions={len(self.preempted)})"]
+        for w in self.windows:
+            lines.append(f"  [{w.start:7.1f}s - {w.end:7.1f}s] "
+                         f"{w.kind} param={w.param:.2f}")
+        return "\n".join(lines)

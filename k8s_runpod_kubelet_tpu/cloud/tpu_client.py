@@ -141,6 +141,15 @@ class TpuClient:
     def _base(self, zone: Optional[str] = None) -> str:
         return f"/v2/projects/{self.project}/locations/{zone or self.zone}"
 
+    @property
+    def breaker(self):
+        """The main transport's circuit breaker (None when not configured).
+        The provider watches its state to flip the node's TpuApiReachable
+        condition/taint; the quota transport deliberately has no breaker
+        (it already fails fast, and a serviceusage outage must not taint
+        the node while the TPU API itself is healthy)."""
+        return getattr(self.transport, "breaker", None)
+
     @staticmethod
     def _wrap(e: TransportError, what: str) -> TpuApiError:
         if e.status == 404:

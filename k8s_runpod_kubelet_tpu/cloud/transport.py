@@ -1,14 +1,29 @@
-"""HTTP transport with auth, timeouts and bounded retry.
+"""HTTP transport with auth, timeouts, bounded retry, and a circuit breaker.
 
 Analog of the reference's REST plumbing (runpod_client.go:742-770 makeRESTRequest:
-Bearer auth, 30s default / 60s deploy timeouts; retry w/ linear backoff x3
-:275-307). stdlib-only so the control plane has zero third-party deps.
+Bearer auth, 30s default / 60s deploy timeouts) — but where the reference retried
+with a linear no-jitter sleep (:275-307), this transport is hardened for the
+chaos that is the COMMON case on cloud APIs (ISSUE 3):
+
+- capped exponential backoff with decorrelated jitter (an API brownout must not
+  see every kubelet retry in lockstep);
+- a per-request total deadline budget that spans retries — a 30s call can never
+  become 90s of hidden sleeps;
+- ``Retry-After`` honored on 429/503 (seconds and HTTP-date forms);
+- a closed/open/half-open circuit breaker so a dead API fails fast instead of
+  soaking every control loop in timeout waits, with metrics + trace spans for
+  the retry path.
+
+stdlib-only so the control plane has zero third-party deps.
 """
 
 from __future__ import annotations
 
+import email.utils
 import json
 import logging
+import random
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -19,7 +34,13 @@ log = logging.getLogger(__name__)
 DEFAULT_TIMEOUT_S = 30.0
 DEPLOY_TIMEOUT_S = 60.0
 MAX_RETRIES = 3
-BACKOFF_BASE_S = 0.5  # sleep 0.5s * attempt, as the reference does (:302)
+BACKOFF_BASE_S = 0.5   # first-retry floor; jitter decorrelates from here
+BACKOFF_CAP_S = 15.0   # no single hidden sleep longer than this
+RETRY_AFTER_CAP_S = 60.0  # a hostile/buggy Retry-After can't park us for hours
+
+# circuit-breaker state encoding (also the tpu_cloud_circuit_state gauge value)
+CLOSED, OPEN, HALF_OPEN = 0, 1, 2
+_STATE_NAMES = {CLOSED: "closed", OPEN: "open", HALF_OPEN: "half-open"}
 
 
 class TransportError(Exception):
@@ -31,21 +52,184 @@ class TransportError(Exception):
         self.body = body
 
 
-class HttpTransport:
-    """Tiny JSON-over-HTTP client: request(), with bearer auth and retry on 5xx/network.
+class CircuitOpenError(TransportError):
+    """Fail-fast rejection: the breaker is open (or half-open with a probe
+    already in flight). No network I/O happened."""
 
-    4xx responses are NOT retried (they are deterministic), mirroring the
-    reference's retry helper which only loops on transport errors and 5xx —
-    EXCEPT 401 when a refreshable ``token_provider`` is set: GCP access
-    tokens expire hourly (unlike the reference's immortal API key,
-    runpod_client.go:144), so one 401 triggers provider.invalidate() and a
-    single re-issue with a fresh token before giving up.
+
+def parse_retry_after(value: Optional[str],
+                      now: Optional[float] = None) -> Optional[float]:
+    """``Retry-After`` header -> seconds to wait, or None if absent/garbage.
+
+    Handles both RFC 7231 forms: delta-seconds (``Retry-After: 7``) and
+    HTTP-date (``Retry-After: Fri, 31 Dec 1999 23:59:59 GMT``). ``now`` is
+    wall-clock seconds for the date math (defaults to time.time()); a date
+    in the past yields 0.0 (retry immediately), not a negative sleep."""
+    if not value:
+        return None
+    value = value.strip()
+    try:
+        return max(0.0, float(value))
+    except ValueError:
+        pass
+    try:
+        dt = email.utils.parsedate_to_datetime(value)
+    except (TypeError, ValueError):
+        return None
+    if dt is None:
+        return None
+    if dt.tzinfo is None:
+        import datetime
+        dt = dt.replace(tzinfo=datetime.timezone.utc)
+    now = time.time() if now is None else now
+    return max(0.0, dt.timestamp() - now)
+
+
+class CircuitBreaker:
+    """Closed/open/half-open breaker over consecutive transport failures.
+
+    - CLOSED: traffic flows; ``failure_threshold`` CONSECUTIVE failures trip
+      it OPEN (any success resets the streak).
+    - OPEN: every ``allow()`` is rejected (callers fail fast with
+      CircuitOpenError — no timeout soak) until ``reset_timeout_s`` elapses.
+    - HALF_OPEN: exactly ONE probe request is allowed through; its success
+      closes the breaker, its failure re-opens it for another full timeout.
+
+    ``clock`` is injectable (monotonic by default) so chaos tests drive the
+    state machine with a FakeClock. ``on_state_change(old, new)`` fires
+    OUTSIDE the internal lock — the provider uses it to flip the node's
+    ``TpuApiReachable`` condition + taint the moment the API goes dark."""
+
+    def __init__(self, failure_threshold: int = 5, reset_timeout_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 metrics=None, name: str = "tpu_cloud"):
+        self.failure_threshold = max(1, failure_threshold)
+        self.reset_timeout_s = reset_timeout_s
+        self.clock = clock
+        self.metrics = metrics
+        self.name = name
+        self.on_state_change: Optional[Callable[[int, int], None]] = None
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probe_in_flight = False
+        if metrics is not None:
+            metrics.describe("tpu_cloud_circuit_state",
+                            "circuit breaker over the cloud API: 0=closed "
+                            "1=open 2=half-open")
+            metrics.describe("tpu_cloud_breaker_trips",
+                            "times the breaker opened (API declared dark)")
+            metrics.set_gauge("tpu_cloud_circuit_state", float(CLOSED))
+
+    @property
+    def state(self) -> int:
+        with self._lock:
+            return self._state
+
+    @property
+    def state_name(self) -> str:
+        return _STATE_NAMES[self.state]
+
+    def _transition(self, new: int) -> Optional[tuple[int, int]]:
+        """Must hold self._lock. Returns (old, new) when the state changed."""
+        old = self._state
+        if old == new:
+            return None
+        self._state = new
+        return (old, new)
+
+    def _after(self, change: Optional[tuple[int, int]]):
+        """Fire metrics + callback outside the lock."""
+        if change is None:
+            return
+        old, new = change
+        log.warning("cloud circuit breaker: %s -> %s",
+                    _STATE_NAMES[old], _STATE_NAMES[new])
+        if self.metrics is not None:
+            self.metrics.set_gauge("tpu_cloud_circuit_state", float(new))
+            if new == OPEN:
+                self.metrics.incr("tpu_cloud_breaker_trips")
+        cb = self.on_state_change
+        if cb is not None:
+            try:
+                cb(old, new)
+            except Exception as e:  # noqa: BLE001 — observers must not break I/O
+                log.warning("breaker state-change callback failed: %s", e)
+
+    def allow(self) -> bool:
+        """May a request proceed right now? OPEN->HALF_OPEN transition happens
+        here (lazily, on the first call after the reset timeout)."""
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN:
+                if self.clock() - self._opened_at < self.reset_timeout_s:
+                    return False
+                change = self._transition(HALF_OPEN)
+                self._probe_in_flight = True
+            else:  # HALF_OPEN: one probe at a time
+                if self._probe_in_flight:
+                    return False
+                self._probe_in_flight = True
+                change = None
+        self._after(change)
+        return True
+
+    def record_success(self):
+        with self._lock:
+            self._failures = 0
+            self._probe_in_flight = False
+            change = self._transition(CLOSED)
+        self._after(change)
+
+    def release_probe(self):
+        """Release a claimed half-open probe slot WITHOUT recording an
+        outcome — for a request that aborted before any I/O happened
+        (degenerate deadline budget). The breaker stays half-open and the
+        next allow() may start a fresh probe."""
+        with self._lock:
+            self._probe_in_flight = False
+
+    def record_failure(self):
+        with self._lock:
+            self._failures += 1
+            change = None
+            if self._state == HALF_OPEN:
+                # the probe failed: straight back to OPEN, fresh timeout
+                self._probe_in_flight = False
+                self._opened_at = self.clock()
+                change = self._transition(OPEN)
+            elif self._state == CLOSED and self._failures >= self.failure_threshold:
+                self._opened_at = self.clock()
+                change = self._transition(OPEN)
+        self._after(change)
+
+
+class HttpTransport:
+    """Tiny JSON-over-HTTP client: request(), with bearer auth and hardened
+    retry on 5xx/network.
+
+    4xx responses are NOT retried (they are deterministic), with two carve-outs:
+    - 401 when a refreshable ``token_provider`` is set: GCP access tokens
+      expire hourly (unlike the reference's immortal API key,
+      runpod_client.go:144), so one 401 triggers provider.invalidate() and a
+      single re-issue with a fresh token before giving up.
+    - 429 WITH a ``Retry-After`` header: the server explicitly asked us to
+      come back, so we do — within the deadline budget. A bare 429 still
+      raises immediately (the quota-error path deploy requeues on).
 
     ``token_provider`` is any callable returning the current bearer token
     (see cloud/gcp_auth.py); an optional ``invalidate()`` attribute enables
     the 401 refresh path. A plain ``token`` string still works and wins if
     both are given (explicit beats ambient).
-    """
+
+    ``deadline_s`` is the TOTAL per-request budget spanning every attempt and
+    every backoff sleep (default: 2x the attempt timeout). ``clock`` must be
+    monotonic-ish and is injectable (chaos tests share one FakeClock across
+    transport, breaker, fake server and provider). ``rng`` seeds the
+    decorrelated jitter. ``breaker`` (optional) gates every request;
+    ``metrics``/``tracer`` make the retry path observable."""
 
     def __init__(
         self,
@@ -56,6 +240,14 @@ class HttpTransport:
         max_retries: int = MAX_RETRIES,
         sleep: Callable[[float], None] = time.sleep,
         user_agent: str = "tpu-virtual-kubelet/0.1",
+        clock: Callable[[], float] = time.monotonic,
+        rng: Optional[random.Random] = None,
+        deadline_s: Optional[float] = None,
+        backoff_base_s: float = BACKOFF_BASE_S,
+        backoff_cap_s: float = BACKOFF_CAP_S,
+        breaker: Optional[CircuitBreaker] = None,
+        metrics=None,
+        tracer=None,
     ):
         self.base_url = base_url.rstrip("/")
         self.token = token
@@ -64,6 +256,18 @@ class HttpTransport:
         self.max_retries = max_retries
         self._sleep = sleep
         self.user_agent = user_agent
+        self.clock = clock
+        self.rng = rng or random.Random()
+        self.deadline_s = deadline_s
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self.breaker = breaker
+        self.metrics = metrics
+        self.tracer = tracer
+        if metrics is not None:
+            metrics.describe("tpu_cloud_request_retries",
+                            "cloud API attempts retried after 5xx/network "
+                            "failures (labels: reason)")
 
     def _bearer(self) -> str:
         if self.token:
@@ -71,6 +275,29 @@ class HttpTransport:
         if self.token_provider is not None:
             return self.token_provider()
         return ""
+
+    def _next_backoff(self, prev: float) -> float:
+        """Decorrelated jitter (the AWS architecture-blog scheme): sleep is
+        uniform in [base, prev*3], capped — successive retries spread out
+        without synchronizing across kubelets."""
+        return min(self.backoff_cap_s,
+                   self.rng.uniform(self.backoff_base_s, max(self.backoff_base_s,
+                                                             prev * 3.0)))
+
+    def _note_retry(self, method: str, path: str, attempt: int,
+                    started: float, err: TransportError, reason: str):
+        if self.metrics is not None:
+            self.metrics.incr("tpu_cloud_request_retries",
+                              labels={"reason": reason})
+        if self.tracer is not None:
+            # one span per FAILED attempt: the retry ladder becomes visible
+            # in /debug/traces without tracing every healthy call
+            self.tracer.record("cloud.retry", started, self.clock(),
+                               attrs={"method": method, "path": path,
+                                      "attempt": attempt, "status": err.status,
+                                      "reason": reason, "error": str(err)})
+        log.debug("retrying %s %s (attempt %d failed): %s",
+                  method, path, attempt, err)
 
     def request(
         self,
@@ -80,20 +307,41 @@ class HttpTransport:
         timeout_s: Optional[float] = None,
         expect_status: tuple[int, ...] = (200,),
         max_retries: Optional[int] = None,
+        deadline_s: Optional[float] = None,
     ) -> Any:
         """Issue a JSON request; returns the decoded JSON body (or None for empty).
 
         ``max_retries`` overrides the transport-wide attempt count for calls
         whose caller would rather fail fast than block (e.g. the quota read
-        that rides the readiness probe's ping path)."""
+        that rides the readiness probe's ping path). ``deadline_s`` overrides
+        the total budget for this one request."""
         url = self.base_url + path
         data = json.dumps(body).encode() if body is not None else None
         retries = self.max_retries if max_retries is None else max_retries
+        attempt_timeout = timeout_s or self.timeout_s
+        budget = deadline_s if deadline_s is not None else \
+            (self.deadline_s if self.deadline_s is not None
+             else attempt_timeout * 2.0)
+        start = self.clock()
+        deadline = start + budget
+        if self.breaker is not None and not self.breaker.allow():
+            raise CircuitOpenError(
+                f"{method} {path}: circuit breaker is "
+                f"{self.breaker.state_name} — failing fast", status=0)
         last_err: Optional[TransportError] = None
         auth_retried = False
+        backoff = self.backoff_base_s
         attempt = 0
         while attempt < retries:
             attempt += 1
+            attempt_started = self.clock()
+            # never hand urlopen more time than the budget has left
+            remaining = deadline - attempt_started
+            if remaining <= 0:
+                break
+            this_timeout = min(attempt_timeout, remaining)
+            retry_after: Optional[float] = None
+            reason = ""
             req = urllib.request.Request(url, data=data, method=method)
             req.add_header("Content-Type", "application/json")
             req.add_header("User-Agent", self.user_agent)
@@ -102,47 +350,112 @@ class HttpTransport:
             except Exception as e:
                 # transient token-fetch failure (metadata-server blip):
                 # rides the same retry/backoff and keeps the TransportError
-                # contract every caller catches
+                # contract every caller catches. Counts as a breaker failure
+                # too — no token means no reachable API, and (crucially) a
+                # HALF_OPEN probe that dies here must release its probe slot
+                # or the breaker wedges half-open forever
                 last_err = TransportError(
                     f"{method} {path}: token fetch failed: {e}", status=0)
-                if attempt < retries:
-                    self._sleep(BACKOFF_BASE_S * attempt)
-                    log.debug("retrying %s %s (attempt %d): %s",
-                              method, path, attempt + 1, last_err)
-                continue
-            if bearer:
-                req.add_header("Authorization", f"Bearer {bearer}")
-            try:
-                with urllib.request.urlopen(req, timeout=timeout_s or self.timeout_s) as resp:
-                    raw = resp.read()
-                    if resp.status not in expect_status:
-                        raise TransportError(
-                            f"{method} {path}: unexpected status {resp.status}",
-                            status=resp.status, body=raw.decode(errors="replace"))
-                    return json.loads(raw) if raw else None
-            except urllib.error.HTTPError as e:
-                body_text = e.read().decode(errors="replace")
-                if e.code in expect_status:
-                    return json.loads(body_text) if body_text else None
+                reason = "token"
+                if self.breaker is not None:
+                    self.breaker.record_failure()
+            else:
+                if bearer:
+                    req.add_header("Authorization", f"Bearer {bearer}")
+                try:
+                    with urllib.request.urlopen(req, timeout=this_timeout) as resp:
+                        raw = resp.read()
+                        if resp.status not in expect_status:
+                            raise TransportError(
+                                f"{method} {path}: unexpected status {resp.status}",
+                                status=resp.status,
+                                body=raw.decode(errors="replace"))
+                        if self.breaker is not None:
+                            self.breaker.record_success()
+                        return json.loads(raw) if raw else None
+                except urllib.error.HTTPError as e:
+                    body_text = e.read().decode(errors="replace")
+                    if e.code in expect_status:
+                        if self.breaker is not None:
+                            self.breaker.record_success()
+                        return json.loads(body_text) if body_text else None
+                    last_err = TransportError(
+                        f"{method} {path}: HTTP {e.code}", status=e.code,
+                        body=body_text)
+                    retry_after = parse_retry_after(
+                        e.headers.get("Retry-After") if e.headers else None)
+                    if e.code == 401 and not auth_retried and \
+                            hasattr(self.token_provider, "invalidate") and \
+                            not self.token:
+                        # expired/revoked token: refresh once, re-issue now
+                        # (does not consume a backoff-retry slot)
+                        auth_retried = True
+                        attempt -= 1
+                        self.token_provider.invalidate()
+                        log.info("401 on %s %s — refreshing bearer token",
+                                 method, path)
+                        continue
+                    if e.code < 500:
+                        # any response proves the API is alive — a 4xx must
+                        # not push the breaker toward open
+                        if self.breaker is not None:
+                            self.breaker.record_success()
+                        if e.code == 429 and retry_after is not None:
+                            # throttled WITH guidance: obey it (within budget)
+                            reason = "retry-after"
+                        else:
+                            raise last_err  # deterministic failure
+                    else:
+                        reason = "5xx"
+                        if self.breaker is not None:
+                            self.breaker.record_failure()
+                except (urllib.error.URLError, TimeoutError, ConnectionError,
+                        OSError) as e:
+                    last_err = TransportError(f"{method} {path}: {e}", status=0)
+                    reason = "network"
+                    if self.breaker is not None:
+                        self.breaker.record_failure()
+            if attempt >= retries:
+                break
+            if self.breaker is not None and self.breaker.state != CLOSED:
+                # the breaker (re-)opened on an attempt of THIS request —
+                # e.g. a half-open probe whose first attempt failed, or a
+                # failure streak crossing the threshold mid-request.
+                # Retrying would backoff-sleep and do real I/O against an
+                # API just declared dark; stop now with the real error
+                # instead of soaking the remaining deadline budget.
+                # (A pure state READ, deliberately not allow(): allow() can
+                # claim the half-open probe slot, and an exit path that
+                # then breaks on the deadline would leak it — wedging the
+                # breaker half-open forever.)
+                assert last_err is not None
+                raise last_err
+            sleep_s = self._next_backoff(backoff)
+            backoff = sleep_s
+            if retry_after is not None:
+                # the server's ask wins over our jitter (capped: a hostile
+                # header can't park the control loop for an hour)
+                sleep_s = min(max(sleep_s, retry_after), RETRY_AFTER_CAP_S)
+            if self.clock() + sleep_s >= deadline:
+                # budget exhausted mid-backoff: surface the LAST REAL error,
+                # annotated — a deadline is a symptom, not a cause
+                assert last_err is not None
                 last_err = TransportError(
-                    f"{method} {path}: HTTP {e.code}", status=e.code, body=body_text)
-                if e.code == 401 and not auth_retried and \
-                        hasattr(self.token_provider, "invalidate") and \
-                        not self.token:
-                    # expired/revoked token: refresh once, re-issue now
-                    # (does not consume a backoff-retry slot)
-                    auth_retried = True
-                    attempt -= 1
-                    self.token_provider.invalidate()
-                    log.info("401 on %s %s — refreshing bearer token",
-                             method, path)
-                    continue
-                if e.code < 500:  # deterministic failure — don't retry
-                    raise last_err
-            except (urllib.error.URLError, TimeoutError, ConnectionError, OSError) as e:
-                last_err = TransportError(f"{method} {path}: {e}", status=0)
-            if attempt < retries:
-                self._sleep(BACKOFF_BASE_S * attempt)
-                log.debug("retrying %s %s (attempt %d): %s", method, path, attempt + 1, last_err)
-        assert last_err is not None
+                    f"{str(last_err)} (deadline budget {budget:.1f}s "
+                    f"exhausted after {attempt} attempt(s))",
+                    status=last_err.status, body=last_err.body)
+                break
+            self._note_retry(method, path, attempt, attempt_started,
+                             last_err, reason or "retry")
+            self._sleep(sleep_s)
+        if last_err is None:
+            # no attempt ever ran (degenerate budget): release a half-open
+            # probe slot we may have claimed in allow() — but record NO
+            # failure; the API was never contacted, and a client-side
+            # misconfiguration must not walk the breaker toward open
+            if self.breaker is not None:
+                self.breaker.release_probe()
+            last_err = TransportError(
+                f"{method} {path}: deadline budget {budget:.1f}s exhausted "
+                f"before any attempt", status=0)
         raise last_err

@@ -125,20 +125,32 @@ def build(cfg: config_mod.Config, kube=None, tpu=None, worker_transport=None,
                            if is_google_api_endpoint(cfg.tpu_api_endpoint)
                            else "")
 
-    def _make_transport(endpoint: str) -> HttpTransport:
+    # chaos hardening (ISSUE 3): ONE circuit breaker, attached to the MAIN
+    # TPU transport only (the provider watches it to degrade the node); the
+    # quota transport stays breaker-free even when it is configured to the
+    # same endpoint — it already fails fast, and a quota-surface outage must
+    # not taint the node (or pollute the breaker metrics) while the TPU API
+    # itself is healthy. Both transports get retry metrics + trace spans.
+    from ..cloud import CircuitBreaker
+    tpu_breaker = CircuitBreaker(
+        failure_threshold=cfg.breaker_failure_threshold,
+        reset_timeout_s=cfg.breaker_reset_s, metrics=metrics)
+
+    def _make_transport(endpoint: str, breaker=None) -> HttpTransport:
         nonlocal token_provider
+        kw = dict(breaker=breaker, metrics=metrics, tracer=tracer)
         if is_google_api_endpoint(endpoint):
             # one shared caching provider across transports (same scopes)
             token_provider = (token_provider or
                               default_token_provider(google_static_token))
-            return HttpTransport(endpoint, token_provider=token_provider)
+            return HttpTransport(endpoint, token_provider=token_provider, **kw)
         # the static token is the credential OF cfg.tpu_api_endpoint's host;
         # any other non-Google host (e.g. a custom quota proxy) gets no
         # token rather than someone else's
         tok = cfg.tpu_api_token if endpoint == cfg.tpu_api_endpoint else ""
-        return HttpTransport(endpoint, token=tok)
+        return HttpTransport(endpoint, token=tok, **kw)
 
-    transport = _make_transport(cfg.tpu_api_endpoint)
+    transport = _make_transport(cfg.tpu_api_endpoint, breaker=tpu_breaker)
     # Quota is a different HOST in production (serviceusage.googleapis.com,
     # config.quota_api_endpoint); unset = the TPU transport, whose host 404s
     # the quota path against the real API -> capacity falls back to the

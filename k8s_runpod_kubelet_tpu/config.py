@@ -83,6 +83,15 @@ class Config:
     # out of the box. 0 = fail the pod immediately (its Job restarts it).
     preemption_requeue_limit: int = 2
 
+    # chaos hardening (ISSUE 3): the cloud-API circuit breaker trips OPEN
+    # after this many consecutive transport failures and probes again
+    # (half-open) after breaker_reset_s. The same threshold bounds the
+    # reconcile loop's own API-error streak before the node goes degraded
+    # (TpuApiReachable=False condition + tpu.dev/api-unreachable:NoSchedule
+    # taint) even without a breaker wired.
+    breaker_failure_threshold: int = 5
+    breaker_reset_s: float = 30.0
+
     # servers
     listen_port: int = 10250
     health_address: str = ":8080"
@@ -123,6 +132,10 @@ class Config:
             errs.append(f"zone {self.zone!r} not in allowed zones {self.zones}")
         if self.trace_ring_size <= 0:
             errs.append("trace_ring_size must be > 0")
+        if self.breaker_failure_threshold <= 0:
+            errs.append("breaker_failure_threshold must be > 0")
+        if self.breaker_reset_s <= 0:
+            errs.append("breaker_reset_s must be > 0")
         if errs:
             raise ValueError("invalid config: " + "; ".join(errs))
         return self

@@ -14,6 +14,7 @@ Transports:
 from __future__ import annotations
 
 import logging
+import re
 import shlex
 import subprocess
 import threading
@@ -267,6 +268,22 @@ class GangExecutor:
             raise WorkerExecError(
                 f"gang command failed on {len(errors)}/{len(qr.workers)} workers: {detail}")
         return results
+
+    def find_in_logs(self, qr: QueuedResource, pattern: str,
+                     worker_id: int = 0, tail_lines: int = 500
+                     ) -> Optional["re.Match"]:
+        """Search one worker's recent logs for a regex — best-effort (None on
+        any transport failure or no match). Used by the reconcile loop's
+        preemption-recovery event to read the checkpoint step a relaunched
+        workload resumed from; observability only, never control flow."""
+        if not qr.workers or not 0 <= worker_id < len(qr.workers):
+            return None
+        try:
+            body = self.transport.logs(qr, worker_id, tail_lines)
+        except Exception as e:  # noqa: BLE001 — worker may be mid-boot/gone
+            log.debug("log probe on %s/w%d failed: %s", qr.name, worker_id, e)
+            return None
+        return re.search(pattern, body)
 
     def logs(self, qr: QueuedResource, worker_id: Optional[int] = None,
              tail_lines: Optional[int] = None) -> str:

@@ -40,6 +40,10 @@ class NodeController:
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self.ready = threading.Event()
+        # owned-taint set as of the last successful sync: lets the heartbeat
+        # skip the per-push get_node read when nothing changed (None = never
+        # synced / last update failed -> do the full read-compare-update)
+        self._synced_taint_keys: frozenset | None = None
 
     @property
     def node_name(self) -> str:
@@ -71,6 +75,50 @@ class NodeController:
         self.node_provider.ping()
         node = self.node_provider.get_node()
         self.kube.patch_node_status(ko.name(node), {"status": node.get("status", {})})
+        self._sync_taints(node)
+
+    def _sync_taints(self, desired_node: dict):
+        """Degraded-node signaling (ISSUE 3): taints live in node.spec, which
+        the status patch can't touch — when the desired taint set changes
+        (tpu.dev/api-unreachable appearing on breaker-open, vanishing on
+        heal), update the Node spec so the scheduler stops/starts binding.
+
+        Only taints whose keys THIS kubelet owns (the provider taint and the
+        degraded taint) are added/removed; taints set by operators or other
+        controllers (kubectl taint, node-lifecycle NoExecute...) are
+        preserved untouched. When the desired owned set matches what we last
+        successfully synced, the whole read-compare-update is skipped — the
+        common heartbeat must not cost an extra get_node (tradeoff: an
+        out-of-band edit of OUR taint keys is only repaired on the next
+        actual state change)."""
+        from ..provider.node_spec import DEGRADED_TAINT_KEY, TAINT_KEY
+        owned = {TAINT_KEY, DEGRADED_TAINT_KEY}
+        desired_owned = [t for t in desired_node.get("spec", {}).get("taints", [])
+                         if t.get("key") in owned]
+        desired_keys = frozenset(t.get("key") for t in desired_owned)
+        if desired_keys == self._synced_taint_keys:
+            return
+        try:
+            live = self.kube.get_node(ko.name(desired_node))
+        except KubeApiError as e:
+            log.warning("taint sync: get node failed: %s", e)
+            return
+        live_taints = live.get("spec", {}).get("taints", [])
+        live_owned = [t for t in live_taints if t.get("key") in owned]
+        if desired_keys == {t.get("key") for t in live_owned}:
+            self._synced_taint_keys = desired_keys
+            return
+        foreign = [t for t in live_taints if t.get("key") not in owned]
+        live.setdefault("spec", {})["taints"] = foreign + desired_owned
+        try:
+            self.kube.update_node(live)
+            self._synced_taint_keys = desired_keys
+            log.info("node taints updated: %s (foreign preserved: %s)",
+                     sorted(t.get("key", "") for t in desired_owned),
+                     sorted(t.get("key", "") for t in foreign))
+        except KubeApiError as e:
+            self._synced_taint_keys = None  # retry the full sync next push
+            log.warning("taint sync: update failed (next push retries): %s", e)
 
     def renew_lease(self):
         """Coordination-lease heartbeat — the liveness signal node controllers in

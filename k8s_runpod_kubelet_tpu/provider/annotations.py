@@ -45,9 +45,20 @@ class Annotations:
     SLICE_ID = "tpu.dev/slice-id"
     MEGASCALE_COORDINATOR = "tpu.dev/megascale-coordinator"
 
+    # checkpoint-aware preemption recovery (ISSUE 3): where the workload
+    # writes its orbax checkpoints. On a post-preemption relaunch the gang
+    # gets TPU_CHECKPOINT_DIR + TPU_RESTART_ATTEMPT injected so training
+    # resumes from the latest step instead of step 0 (workloads/train_main.py
+    # consumes both).
+    CHECKPOINT_DIR = "tpu.dev/checkpoint-dir"
+
     # bookkeeping
     EXTERNAL = "tpu.dev/external"                   # adopted orphan (kubelet.go:1580)
     PREEMPTION_COUNT = "tpu.dev/preemption-count"
+    # the attempt number whose RecoveredFromPreemption event was emitted —
+    # durable so a kubelet restart neither re-announces an already-announced
+    # recovery nor swallows one that hadn't been announced yet
+    RECOVERED_ATTEMPT = "tpu.dev/recovered-attempt"
     # observability: the trace_id shared by this pod's lifecycle spans
     # (create -> deploy -> ACTIVE -> ready). Durable on the pod so a slow
     # serving request on the slice can be joined back to how it was born

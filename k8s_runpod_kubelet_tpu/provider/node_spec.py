@@ -14,11 +14,20 @@ from ..kube import objects as ko
 
 TAINT_KEY = "virtual-kubelet.io/provider"
 TAINT_VALUE = "tpu"
+# degraded-node signaling (ISSUE 3): while the cloud-API circuit breaker is
+# open (or the reconcile loop sees a sustained error streak), this NoSchedule
+# taint stops the scheduler binding NEW pods to the node — existing bound
+# pods keep reconciling from cache and are never failed merely because the
+# API blinked. Removed (and TpuApiReachable flips back to True) when the
+# half-open probe succeeds.
+DEGRADED_TAINT_KEY = "tpu.dev/api-unreachable"
+API_CONDITION = "TpuApiReachable"
 
 
 def build_node(cfg: Config, *, cloud_healthy: bool = True,
                kubelet_port: int = 10250,
-               quota_chips: int | None = None) -> dict:
+               quota_chips: int | None = None,
+               api_reachable: bool = True) -> dict:
     """``google.com/tpu`` capacity/allocatable is the tightest of the live
     cloud quota (``quota_chips``, read periodically from the quota API by the
     provider) and the operator's configured ceiling ``cfg.max_total_chips``
@@ -52,7 +61,18 @@ def build_node(cfg: Config, *, cloud_healthy: bool = True,
          "lastHeartbeatTime": now, "lastTransitionTime": now},
         {"type": "PIDPressure", "status": "False", "reason": "KubeletHasSufficientPID",
          "lastHeartbeatTime": now, "lastTransitionTime": now},
+        {"type": API_CONDITION,
+         "status": "True" if api_reachable else "False",
+         "reason": "CloudAPIHealthy" if api_reachable else "CircuitOpen",
+         "message": ("TPU API reachable" if api_reachable else
+                     "TPU API circuit breaker open / sustained API errors — "
+                     "new pods tainted away; bound pods keep reconciling"),
+         "lastHeartbeatTime": now, "lastTransitionTime": now},
     ]
+    taints = [{"key": TAINT_KEY, "value": TAINT_VALUE, "effect": "NoSchedule"}]
+    if not api_reachable:
+        taints.append({"key": DEGRADED_TAINT_KEY, "value": "true",
+                       "effect": "NoSchedule"})
     capacity = {
         "cpu": "1000",          # a slice fleet's worth of host CPU
         "memory": "4Ti",
@@ -77,7 +97,7 @@ def build_node(cfg: Config, *, cloud_healthy: bool = True,
             },
         },
         "spec": {
-            "taints": [{"key": TAINT_KEY, "value": TAINT_VALUE, "effect": "NoSchedule"}],
+            "taints": taints,
         },
         "status": {
             "capacity": capacity,

@@ -70,6 +70,10 @@ class InstanceInfo:
     launched_at: Optional[float] = None
     ready_at: Optional[float] = None
     preemption_count: int = 0
+    # checkpoint-aware preemption recovery (ISSUE 3): set when this attempt's
+    # RecoveredFromPreemption event/span has been emitted (reset on requeue so
+    # every recovery announces itself exactly once)
+    recovery_event_emitted: bool = False
     # lifecycle tracing: all of this pod's spans share trace_id (also
     # annotated on the pod as tpu.dev/trace-id); trace_root is the
     # pod.lifecycle root span id the phase spans parent under — derived
@@ -123,6 +127,14 @@ class Provider(ReconcileMixin, RecoveryMixin):
         self._node_status_cb: Optional[Callable[[], None]] = None
         self._cloud_healthy = True
         self._last_health_probe = 0.0
+        # degraded-node signaling (ISSUE 3): the breaker (when the transport
+        # has one) plus the reconcile loop's own consecutive-API-error streak
+        # both feed api_reachable; either flips the TpuApiReachable condition
+        # and the NoSchedule taint
+        self._api_error_streak = 0
+        self._breaker = getattr(tpu, "breaker", None)
+        if self._breaker is not None:
+            self._breaker.on_state_change = self._on_breaker_change
         self._chip_quota: Optional[int] = None   # live cloud quota, if readable
         self._last_quota_probe = 0.0
         self._quota_probe_failing = False        # warn once per failure streak
@@ -149,6 +161,12 @@ class Provider(ReconcileMixin, RecoveryMixin):
                               "pods whose slice vanished out from under them")
         self.metrics.describe("tpu_kubelet_loop_seconds",
                               "background control-loop iteration latency")
+        self.metrics.describe("tpu_kubelet_api_degraded",
+                              "node degraded: breaker open or sustained API "
+                              "errors (1 = TpuApiReachable=False + taint)")
+        self.metrics.describe("tpu_kubelet_preemption_recoveries",
+                              "requeued pods that came back Ready "
+                              "(RecoveredFromPreemption)")
         self._probe_cloud(force=True)
 
     # -- helpers ---------------------------------------------------------------
@@ -181,6 +199,48 @@ class Provider(ReconcileMixin, RecoveryMixin):
         except KubeApiError as e:
             log.debug("event %s on %s failed: %s", reason, self.key_of(pod), e)
 
+    @property
+    def api_reachable(self) -> bool:
+        """Degraded-node signal (ISSUE 3): False while the cloud-API circuit
+        breaker is open/half-open OR the reconcile loop has seen a sustained
+        streak of API errors. Drives the TpuApiReachable node condition, the
+        tpu.dev/api-unreachable NoSchedule taint, and /readyz. Heals (True)
+        the moment the half-open probe succeeds / a cloud call works."""
+        if self._breaker is not None:
+            from ..cloud.transport import CLOSED
+            if self._breaker.state != CLOSED:
+                return False
+        return self._api_error_streak < self.cfg.breaker_failure_threshold
+
+    def _on_breaker_change(self, old: int, new: int):
+        """Breaker state flipped (fired by the transport outside its lock):
+        reflect it on the node immediately — don't wait for the 30s status
+        loop to notice the scheduler is binding pods into a black hole."""
+        from ..cloud.transport import CLOSED
+        if new == CLOSED:
+            self._api_error_streak = 0
+        self.metrics.set_gauge("tpu_kubelet_api_degraded",
+                               0.0 if self.api_reachable else 1.0)
+        self._notify_node_status()
+
+    def note_api_result(self, ok: bool):
+        """Reconcile-loop API outcome accounting: a consecutive-error streak
+        crossing the threshold degrades the node even when no breaker is
+        wired (e.g. errors that never hit the shared transport)."""
+        was = self.api_reachable
+        if ok:
+            self._api_error_streak = 0
+        else:
+            self._api_error_streak += 1
+        now_reachable = self.api_reachable
+        if was != now_reachable:
+            log.warning("TPU API degraded-state changed: reachable=%s "
+                        "(error streak %d)", now_reachable,
+                        self._api_error_streak)
+            self.metrics.set_gauge("tpu_kubelet_api_degraded",
+                                   0.0 if now_reachable else 1.0)
+            self._notify_node_status()
+
     def _probe_cloud(self, force: bool = False) -> bool:
         """Rate-limited cloud health probe (parity: checkRunPodAPIHealth
         kubelet.go:320-331, re-probed by Ping :1070-1076)."""
@@ -194,6 +254,10 @@ class Provider(ReconcileMixin, RecoveryMixin):
                 self._notify_node_status()
             self.metrics.set_gauge("tpu_kubelet_cloud_healthy", 1.0 if healthy else 0.0)
             if healthy:
+                # a successful probe is proof of reachability: heal the
+                # reconcile-loop error streak even when no pods reconcile
+                if self._api_error_streak:
+                    self.note_api_result(True)
                 self._refresh_chip_quota(now, force=force)
         return self._cloud_healthy
 
@@ -444,10 +508,14 @@ class Provider(ReconcileMixin, RecoveryMixin):
     def get_node(self) -> dict:
         return build_node(self.cfg, cloud_healthy=self._cloud_healthy,
                           kubelet_port=self.cfg.listen_port,
-                          quota_chips=self._chip_quota)
+                          quota_chips=self._chip_quota,
+                          api_reachable=self.api_reachable)
 
     def ping(self) -> bool:
-        return self._probe_cloud()
+        # /readyz reflects degradation: an open breaker or a sustained API
+        # error streak makes the node not-ready even while the rate-limited
+        # health probe still remembers a healthy answer
+        return self._probe_cloud() and self.api_reachable
 
     def set_status_listener(self, cb: Callable[[], None]):
         self._node_status_cb = cb

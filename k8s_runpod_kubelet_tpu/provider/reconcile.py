@@ -65,6 +65,18 @@ class ReconcileMixin:
                 continue  # pending deploy — the pending processor owns it (:841-844)
             try:
                 self._reconcile_one(key, pod, info)
+                self.note_api_result(True)
+            except TpuApiError as e:
+                # the API blinked: the pod is NOT failed (it keeps its last
+                # cached status); a sustained streak degrades the node
+                # (TpuApiReachable=False + NoSchedule taint) until a call
+                # succeeds again. Deterministic 4xx (quota 429/403...) is a
+                # RESPONSE — the API is alive; only network errors (status
+                # 0, incl. CircuitOpenError) and 5xx count as unreachability
+                # (mirrors the breaker's own success-on-4xx accounting).
+                log.warning("reconcile %s: cloud API error (pod keeps cached "
+                            "status): %s", key, e)
+                self.note_api_result(0 < e.status < 500)
             except Exception as e:  # noqa: BLE001 — one bad pod must not stop the sweep
                 log.exception("reconcile %s failed: %s", key, e)
 
@@ -125,6 +137,15 @@ class ReconcileMixin:
         if state is S.ACTIVE and not info.workload_launched:
             self._gang_launch(key, pod, info, detailed)
             detailed = self.tpu.get_detailed_status(info.qr_name, zone=info.zone)
+            # re-read the state from the refetch: a preemption landing in the
+            # launch->refetch window must hit the requeue path below, not
+            # slip past a stale ACTIVE into translate_status as PodFailed
+            # (found by the chaos soak: a storm preempting mid-launch
+            # permanently failed the pod instead of requeueing it)
+            state = detailed.resource.state
+            if state is S.NOT_FOUND:
+                self.handle_missing_instance(pod)
+                return
 
         # preemption requeue: a SUSPENDED slice can be resubmitted instead of
         # failing the pod, up to cfg.preemption_requeue_limit times
@@ -189,12 +210,71 @@ class ReconcileMixin:
             self.emit_event(pod, "GangRunning",
                             f"all workers of {info.qr_name} running "
                             f"{now - info.created_at:.1f}s after schedule")
+            if info.preemption_count > 0 and not info.recovery_event_emitted:
+                self._emit_preemption_recovery(key, pod, info, detailed, now)
         self._push_status(key, pod, status)
         if status.get("phase") in ("Succeeded", "Failed"):
             # Unlike a RunPod EXITED instance (stopped, not billing), an ACTIVE
             # TPU slice bills until deleted — release it as soon as the pod is
             # terminal. The binding annotation stays for post-mortem.
             self._release_slice(key, info)
+
+    # workloads log this on a successful orbax restore (train.py restore());
+    # the recovery event parses the step out of worker-0's logs, best-effort
+    _RESUME_STEP_RE = "resumed from checkpoint step (\\d+)"
+
+    def _emit_preemption_recovery(self, key: str, pod: dict, info, detailed,
+                                  now: float):
+        """A requeued pod came back Ready: close the preemption loop loudly
+        (ISSUE 3 part 3) — RecoveredFromPreemption event + span, with the
+        checkpoint step the workload actually resumed from when worker-0's
+        logs show one (train_main logs it; adopted/serving workloads won't)."""
+        resumed_step = None
+        if self.gang is not None:
+            m = self.gang.find_in_logs(detailed.resource, self._RESUME_STEP_RE)
+            if m:
+                resumed_step = int(m.group(1))
+        with self.lock:
+            info.recovery_event_emitted = True
+        attrs = {"pod": key, "slice": info.qr_name,
+                 "attempt": info.preemption_count}
+        if resumed_step is not None:
+            attrs["resumed_step"] = resumed_step
+        self.tracer.record("pod.preemption_recovery",
+                           info.launched_at or info.active_at or now, now,
+                           trace_id=info.trace_id, parent_id=info.trace_root,
+                           attrs=attrs)
+        self.metrics.incr("tpu_kubelet_preemption_recoveries")
+        step_note = (f", resumed from checkpoint step {resumed_step}"
+                     if resumed_step is not None else "")
+        self.emit_event(pod, "RecoveredFromPreemption",
+                        f"gang running again on {info.qr_name} after "
+                        f"{info.preemption_count} preemption(s){step_note}")
+        log.info("pod %s recovered from preemption on %s%s",
+                 key, info.qr_name, step_note)
+        # durable once-per-attempt marker: a kubelet restart reads this to
+        # know THIS attempt already announced (best-effort; a lost patch
+        # means at worst one duplicate event after a restart)
+        try:
+            ns, name = key.split("/", 1)
+            updated = self.kube.patch_pod(ns, name, {"metadata": {
+                "annotations": {A.RECOVERED_ATTEMPT:
+                                str(info.preemption_count)}}})
+            with self.lock:
+                if key in self.pods:
+                    self.pods[key] = updated
+        except KubeApiError as e:
+            log.debug("recovered-attempt annotate of %s failed: %s", key, e)
+
+    def _tombstone_slice(self, tomb_key: str, qr_name: str, zone: str):
+        """Remember a slice whose delete failed so the GC sweep keeps
+        re-terminating until it is confirmed gone — failed deletes must
+        never leak billable VMs. ``tomb_key`` is namespaced past the pod
+        key so it can't collide with delete_pod's own tombstone."""
+        from .provider import DeletedPodInfo
+        with self.lock:
+            self.deleted.setdefault(tomb_key, DeletedPodInfo(
+                qr_name=qr_name, zone=zone, deleted_at=self.clock()))
 
     def _release_slice(self, key: str, info):
         log.info("pod %s is terminal — deleting slice %s to stop billing",
@@ -205,10 +285,7 @@ class ReconcileMixin:
         except TpuApiError as e:
             log.warning("release of %s failed — tombstoning for the sweep: %s",
                         info.qr_name, e)
-            from .provider import DeletedPodInfo
-            with self.lock:
-                self.deleted.setdefault(key + "/released", DeletedPodInfo(
-                    qr_name=info.qr_name, zone=info.zone, deleted_at=self.clock()))
+            self._tombstone_slice(key + "/released", info.qr_name, info.zone)
 
     def _requeue_preempted(self, key: str, pod: dict, info):
         """Resubmit a preempted slice (net-new elasticity; SURVEY.md §5.3 notes
@@ -226,7 +303,11 @@ class ReconcileMixin:
         try:
             self.tpu.delete_queued_resource(info.qr_name, zone=info.zone)
         except TpuApiError as e:
-            log.warning("delete of preempted %s failed: %s", info.qr_name, e)
+            # a preempted slice whose delete raced a blackout must not leak
+            log.warning("delete of preempted %s failed — tombstoning for the "
+                        "sweep: %s", info.qr_name, e)
+            self._tombstone_slice(f"{key}/preempted-r{info.preemption_count}",
+                                  info.qr_name, info.zone)
         try:
             self.kube.patch_pod(pod["metadata"].get("namespace", "default"),
                                 pod["metadata"]["name"], {"metadata": {"annotations": {
@@ -251,6 +332,7 @@ class ReconcileMixin:
             info.deployed_at = None  # next attempt's provisioning span must
             # start at ITS deploy, not this dead slice's
             info.pending_since = self.clock()
+            info.recovery_event_emitted = False  # the NEXT recovery announces
         self.metrics.incr("tpu_kubelet_preemption_requeues")
 
     def _gang_launch(self, key: str, pod: dict, info, detailed):
@@ -269,6 +351,15 @@ class ReconcileMixin:
         except TranslationError as e:
             log.error("gang launch of %s: translation failed post-deploy: %s", key, e)
             return
+        # checkpoint-aware preemption recovery (ISSUE 3): every launch knows
+        # its attempt number; relaunches after a preemption also carry the
+        # checkpoint dir so training resumes from the latest orbax step
+        # instead of step 0 (workloads/train_main.py reads both)
+        params.workload.env["TPU_RESTART_ATTEMPT"] = str(info.preemption_count)
+        ckpt_dir = (resolver.get(A.CHECKPOINT_DIR)
+                    or params.workload.env.get("TPU_CHECKPOINT_DIR", ""))
+        if ckpt_dir:
+            params.workload.env["TPU_CHECKPOINT_DIR"] = ckpt_dir
         launch_started = self.clock()
         try:
             self.tpu.start_workload(info.qr_name, params.workload,

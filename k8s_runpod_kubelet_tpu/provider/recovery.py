@@ -25,6 +25,16 @@ from .status import status_fingerprint
 log = logging.getLogger(__name__)
 
 
+def _recovery_announced(pod: dict) -> bool:
+    """Was the CURRENT preemption attempt's RecoveredFromPreemption already
+    emitted (pre-restart)? The durable tpu.dev/recovered-attempt marker
+    equals tpu.dev/preemption-count exactly when it was — so a restart
+    neither duplicates an announced recovery nor swallows a pending one."""
+    anns = ko.annotations(pod)
+    count = anns.get(A.PREEMPTION_COUNT, "")
+    return bool(count) and anns.get(A.RECOVERED_ATTEMPT, "") == count
+
+
 class RecoveryMixin:
     def load_running(self):
         """Startup state recovery (parity: LoadRunning kubelet.go:1380-1535)."""
@@ -136,6 +146,9 @@ class RecoveryMixin:
                 accelerator_type=ko.annotations(pod).get(A.ACCELERATOR_TYPE, ""),
                 created_at=self.clock(),
                 trace_id=ko.annotations(pod).get(A.TRACE_ID, ""),
+                preemption_count=int(
+                    ko.annotations(pod).get(A.PREEMPTION_COUNT, "0") or 0),
+                recovery_event_emitted=_recovery_announced(pod),
             )
 
     def _recover_instance(self, pod: dict, qr: QueuedResource):
@@ -154,6 +167,12 @@ class RecoveryMixin:
             created_at=qr.create_time or self.clock(),
             # keep the lifecycle trace joinable across kubelet restarts
             trace_id=ko.annotations(pod).get(A.TRACE_ID, ""),
+            # the requeue budget survives restarts too: a pod on its 2nd
+            # requeue must not get a fresh allowance (and its recovery
+            # event keeps the true attempt number)
+            preemption_count=int(
+                ko.annotations(pod).get(A.PREEMPTION_COUNT, "0") or 0),
+            recovery_event_emitted=_recovery_announced(pod),
         )
         with self.lock:
             self.pods[key] = ko.deep_copy(pod)

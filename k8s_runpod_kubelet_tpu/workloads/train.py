@@ -317,9 +317,26 @@ class Trainer:
             return False
         import orbax.checkpoint as ocp
         target = {"params": self.params, "opt_state": self.opt_state}
+
+        # Restore onto an ABSTRACT target with explicit shardings. Passing
+        # the concrete values lets orbax commit leaves to whatever device
+        # they currently sit on — and optax's eager init() leaves its scalar
+        # counters on the default device while the params are mesh-sharded,
+        # so the first post-restore step_fn dies on "incompatible devices"
+        # (restored arrays are committed; fresh ones were movable). Found by
+        # the preemption-resume path, which is exactly a sharded restore.
+        # Mesh runs: keep NamedShardings, replicate everything else.
+        def _restore_spec(x):
+            s = x.sharding
+            if self.mesh is not None and not isinstance(
+                    s, jax.sharding.NamedSharding):
+                s = jax.sharding.NamedSharding(self.mesh,
+                                               jax.sharding.PartitionSpec())
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s)
+
         restored = self._ckpt.restore(
             self._ckpt.latest_step(),
-            args=ocp.args.StandardRestore(target))
+            args=ocp.args.StandardRestore(jax.tree.map(_restore_spec, target)))
         self.params = restored["params"]
         self.opt_state = restored["opt_state"]
         self.step = self._ckpt.latest_step()

@@ -15,6 +15,7 @@ import argparse
 import dataclasses
 import json
 import logging
+import os
 import time
 
 from ..parallel.distributed import initialize_from_env
@@ -84,6 +85,20 @@ def main(argv=None) -> int:
         p.error("--export-adapter needs --lora-rank")
     logging.basicConfig(level=logging.INFO)
 
+    # checkpoint-aware preemption recovery (ISSUE 3): the kubelet injects
+    # TPU_RESTART_ATTEMPT (>0 after a preemption requeue) and, when the pod
+    # carries the tpu.dev/checkpoint-dir annotation, TPU_CHECKPOINT_DIR —
+    # so a requeued gang resumes from its latest orbax step instead of
+    # step 0 without the pod spec having to thread flags through.
+    restart_attempt = int(os.environ.get("TPU_RESTART_ATTEMPT", "0") or 0)
+    if not args.checkpoint_dir and os.environ.get("TPU_CHECKPOINT_DIR"):
+        args.checkpoint_dir = os.environ["TPU_CHECKPOINT_DIR"]
+        log.info("checkpoint dir from TPU_CHECKPOINT_DIR: %s",
+                 args.checkpoint_dir)
+    if restart_attempt:
+        log.info("restart attempt %d (post-preemption relaunch)",
+                 restart_attempt)
+
     # 1. the gang forms (no-op single process)
     pe = initialize_from_env()
 
@@ -150,7 +165,18 @@ def main(argv=None) -> int:
                  args.lora_rank, lora_param_count(trainer.params) / 1e6,
                  cfg.param_count / 1e9)
     if args.checkpoint_dir:
-        trainer.restore()  # resume-from-preemption path (wins over --hf-checkpoint)
+        # resume-from-preemption path (wins over --hf-checkpoint). restore()
+        # logs "resumed from checkpoint step N" — the marker the kubelet's
+        # RecoveredFromPreemption event parses out of worker-0 logs.
+        restored = trainer.restore()
+        if restart_attempt and pe.process_id == 0:
+            if restored:
+                log.info("preemption recovery: attempt %d resumes at step %d",
+                         restart_attempt, trainer.step)
+            else:
+                log.warning("preemption recovery: attempt %d found NO "
+                            "checkpoint in %s — training restarts at step 0",
+                            restart_attempt, args.checkpoint_dir)
     batches = None
     loader = None
     if args.data:

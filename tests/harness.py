@@ -38,6 +38,8 @@ class Harness:
     clock: FakeClock
     transport: InMemoryWorkerTransport
     cfg: Config
+    metrics: object = None   # chaos harness: the shared Metrics registry
+    breaker: object = None   # chaos harness: the transport's CircuitBreaker
 
     def close(self):
         self.server.stop()
@@ -62,6 +64,48 @@ def make_harness(provision_delay_s: float = 0.0,
                         clock=clock)
     return Harness(server=server, kube=kube, tpu=tpu, provider=provider,
                    clock=clock, transport=transport, cfg=cfg)
+
+
+def make_chaos_harness(seed: int = 0, provision_delay_s: float = 20.0,
+                       cfg: Optional[Config] = None,
+                       breaker_threshold: int = 5,
+                       breaker_reset_s: float = 60.0) -> Harness:
+    """Chaos-soak harness (ISSUE 3): ONE FakeClock shared by the provider,
+    the HTTP transport (whose retry sleeps ADVANCE it — simulated time pays
+    for backoff, wall time doesn't), the circuit breaker, and the fake
+    server's slice state machine. Zero real sleeps; attach a FaultPlan via
+    ``h.fake.fault_plan``."""
+    import random as _random
+
+    from k8s_runpod_kubelet_tpu.cloud import CircuitBreaker
+    from k8s_runpod_kubelet_tpu.metrics import Metrics
+
+    clock = FakeClock()
+    server = FakeTpuServer(provision_delay_s=provision_delay_s,
+                           clock=clock).start()
+    kube = FakeKubeClient()
+    metrics = Metrics()
+    breaker = CircuitBreaker(failure_threshold=breaker_threshold,
+                             reset_timeout_s=breaker_reset_s,
+                             clock=clock, metrics=metrics)
+    http = HttpTransport(server.base_url, token="t", sleep=clock.advance,
+                         clock=clock, rng=_random.Random(seed),
+                         breaker=breaker, metrics=metrics)
+    tpu = TpuClient(http, project="test-proj", zone="us-central2-b")
+    cfg = cfg or Config(node_name="virtual-tpu", zone="us-central2-b",
+                        # a chaos plan may preempt the same pod many times
+                        # and black the API out for minutes; the soak proves
+                        # CONVERGENCE, not the give-up ladders
+                        preemption_requeue_limit=100,
+                        max_pending_s=7200.0,
+                        breaker_failure_threshold=breaker_threshold,
+                        breaker_reset_s=breaker_reset_s)
+    transport = InMemoryWorkerTransport()
+    provider = Provider(cfg, kube, tpu, gang_executor=GangExecutor(transport),
+                        metrics=metrics, clock=clock)
+    return Harness(server=server, kube=kube, tpu=tpu, provider=provider,
+                   clock=clock, transport=transport, cfg=cfg,
+                   metrics=metrics, breaker=breaker)
 
 
 def make_ssh_harness(provision_delay_s: float = 0.0,

@@ -170,6 +170,28 @@ class TestBuild:
             h.close()
 
 
+class TestBreakerWiring:
+    def test_quota_transport_never_gets_a_breaker(self):
+        """Exactly ONE breaker, on the MAIN transport — even when the quota
+        endpoint aliases the TPU endpoint (the hermetic fake-server setup).
+        A second breaker would double-write tpu_cloud_circuit_state and let
+        a quota-surface outage masquerade as TPU-API darkness."""
+        from k8s_runpod_kubelet_tpu.cmd.main import build
+        from k8s_runpod_kubelet_tpu.config import Config
+        from k8s_runpod_kubelet_tpu.kube.fake import FakeKubeClient
+        cfg = Config(node_name="n", tpu_api_endpoint="http://127.0.0.1:9",
+                     quota_api_endpoint="http://127.0.0.1:9",
+                     workload_path="api", listen_port=0, health_address=":0")
+        provider, *_rest, health = build(cfg, kube=FakeKubeClient())
+        try:
+            assert provider.tpu.transport.breaker is not None
+            assert provider.tpu.quota_transport.breaker is None
+            # the provider watches the main transport's breaker
+            assert provider._breaker is provider.tpu.transport.breaker
+        finally:
+            health.stop()
+
+
 class TestQuotaTransportCredentialScoping:
     def test_foreign_tpu_token_never_rides_to_google_quota_host(self, monkeypatch, tmp_path):
         """A static token configured for a NON-Google TPU endpoint (worker-

@@ -1,0 +1,256 @@
+"""Seeded chaos soak (ISSUE 3 tentpole part 4): N pods through the full
+provider loop under a composed fault plan, on a FakeClock, with ZERO real
+sleeps — deterministic, replayable (the seed is in every failure message),
+and fast enough for tier-1.
+
+What convergence means here:
+- every pod ends Running (ready) — preemption storms requeue, blackouts
+  stall, but nothing is failed merely because the API blinked;
+- zero leaked QueuedResources: the cloud holds exactly the live pods'
+  slices, every tombstone drained;
+- the circuit breaker tripped during the blackout (node went degraded:
+  TpuApiReachable=False + tpu.dev/api-unreachable NoSchedule taint) and
+  healed afterwards (condition True, taint gone, breaker CLOSED);
+- a preempted training pod demonstrably resumed from its checkpoint step
+  (RecoveredFromPreemption event + pod.preemption_recovery span carry the
+  step parsed from worker-0 logs).
+
+The tier-1 variant runs one seed with an explicit window list guaranteeing
+every fault kind fires; the slow variant soaks generated random plans.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from k8s_runpod_kubelet_tpu.cloud.faults import (
+    BLACKOUT, ERROR_BURST, FLAKY_HEAL, LATENCY_SPIKE, PREEMPTION_STORM,
+    FaultPlan, FaultWindow,
+)
+from k8s_runpod_kubelet_tpu.cloud.transport import CLOSED, OPEN
+from k8s_runpod_kubelet_tpu.kube import objects as ko
+from k8s_runpod_kubelet_tpu.node.node_controller import NodeController
+from k8s_runpod_kubelet_tpu.provider.annotations import Annotations as A
+from k8s_runpod_kubelet_tpu.provider.node_spec import (API_CONDITION,
+                                                       DEGRADED_TAINT_KEY)
+from k8s_runpod_kubelet_tpu.provider.translate import qr_name_for_pod
+
+from harness import make_chaos_harness, make_pod
+
+# an explicit plan that mixes every fault kind with room to converge;
+# offsets are seconds from soak start (the acceptance-criteria mix)
+TIER1_WINDOWS = [
+    FaultWindow(LATENCY_SPIKE, 40.0, 90.0, 2.0),
+    FaultWindow(ERROR_BURST, 110.0, 170.0, 0.5),
+    FaultWindow(BLACKOUT, 200.0, 360.0, 5.0),
+    FaultWindow(PREEMPTION_STORM, 380.0, 430.0, 0.4),
+    FaultWindow(BLACKOUT, 460.0, 560.0, 3.0),   # blackout DURING recovery
+    FaultWindow(FLAKY_HEAL, 580.0, 650.0, 0.6),
+]
+
+
+class SoakResult:
+    def __init__(self):
+        self.saw_breaker_open = False
+        self.saw_condition_false = False
+        self.saw_taint = False
+        self.preempted_pods: set = set()
+
+
+def run_soak(seed: int, *, n_pods: int = 4, windows=None,
+             horizon_s: float = 700.0, tick_s: float = 5.0,
+             max_sim_s: float = 5400.0):
+    """Drive the full provider loop under the plan until convergence (or the
+    sim-time budget runs out). Returns (harness, plan, result)."""
+    h = make_chaos_harness(seed=seed, provision_delay_s=15.0,
+                           breaker_threshold=5, breaker_reset_s=60.0)
+    plan = FaultPlan(seed, h.clock, horizon_s=horizon_s, windows=windows,
+                     advance=h.clock.advance)
+    h.fake.fault_plan = plan
+    res = SoakResult()
+    nc = NodeController(h.kube, h.provider)
+    nc.register_node()
+    nc.push_status()
+
+    for i in range(n_pods):
+        pod = make_pod(name=f"train-{i}", chips=16, uid=f"uid-{seed:02d}-{i}",
+                       annotations={A.CHECKPOINT_DIR: f"/ckpt/train-{i}"})
+        created = h.kube.create_pod(pod)
+        h.provider.create_pod(created)
+
+    resume_logged: set = set()
+    t0 = h.clock()
+    tick = 0
+    while h.clock() - t0 < max_sim_s:
+        tick += 1
+        h.clock.advance(tick_s)
+        # pre-stage the workload's resume log for any requeued pod: the gang
+        # that boots on the (deterministically named) next slice logs its
+        # orbax restore line, which the RecoveredFromPreemption event parses
+        with h.provider.lock:
+            pending_requeues = [(k, info.preemption_count)
+                                for k, info in h.provider.instances.items()
+                                if info.preemption_count > 0 and not info.qr_name]
+        for key, attempt in pending_requeues:
+            res.preempted_pods.add(key)
+            ns, name = key.split("/", 1)
+            pod = h.kube.get_pod(ns, name)
+            next_qr = qr_name_for_pod(pod)
+            if next_qr not in resume_logged:
+                resume_logged.add(next_qr)
+                h.transport.append_log(
+                    next_qr, 0,
+                    f"resumed from checkpoint step {100 * attempt}")
+        h.provider.update_all_pod_statuses()
+        if tick % 2 == 0:
+            h.provider.process_pending_pods()
+            nc.push_status()
+            node = h.kube.get_node("virtual-tpu")
+            conds = {c["type"]: c["status"]
+                     for c in node["status"]["conditions"]}
+            taints = {t["key"] for t in node["spec"].get("taints", [])}
+            if conds.get(API_CONDITION) == "False":
+                res.saw_condition_false = True
+            if DEGRADED_TAINT_KEY in taints:
+                res.saw_taint = True
+        if tick % 6 == 0:
+            h.provider.run_cleanup()
+        if h.breaker.state == OPEN:
+            res.saw_breaker_open = True
+        if plan.quiet and _converged(h, n_pods):
+            break
+    # one final heartbeat, as the real 30s status loop would deliver: the
+    # convergence break can land between pushes, with the kube-side node
+    # object still showing the pre-heal snapshot (the health probe is
+    # rate-limited to 10s, so step past it first)
+    h.clock.advance(15.0)
+    nc.push_status()
+    return h, plan, res
+
+
+def _converged(h, n_pods: int) -> bool:
+    with h.provider.lock:
+        infos = dict(h.provider.instances)
+        tombs = dict(h.provider.deleted)
+    if len(infos) != n_pods or tombs:
+        return False
+    for info in infos.values():
+        if not (info.ready and info.pod_status
+                and info.pod_status.get("phase") == "Running"):
+            return False
+    live_slices = {i.qr_name for i in infos.values()}
+    with h.fake.lock:
+        cloud = set(h.fake.resources)
+    return cloud == live_slices and h.breaker.state == CLOSED
+
+
+def _ctx(seed, plan, what: str) -> str:
+    return f"[chaos seed={seed}] {what}\n{plan.describe()}"
+
+
+def assert_soak_converged(seed, h, plan, res, n_pods: int,
+                          expect_degraded: bool = True):
+    # 1. every pod converged to Running/ready — nothing failed on a blink
+    for i in range(n_pods):
+        pod = h.kube.get_pod("default", f"train-{i}")
+        phase = pod.get("status", {}).get("phase")
+        assert phase in ("Running", "Succeeded"), \
+            _ctx(seed, plan, f"pod train-{i} ended {phase!r}: "
+                             f"{pod.get('status', {})}")
+    # 2. zero leaked slices: the cloud holds exactly the live bindings,
+    #    tombstones drained
+    with h.provider.lock:
+        live = {i.qr_name for i in h.provider.instances.values() if i.qr_name}
+        tombs = dict(h.provider.deleted)
+    with h.fake.lock:
+        cloud = set(h.fake.resources)
+    assert cloud == live, \
+        _ctx(seed, plan, f"leaked/missing slices: cloud={cloud} live={live}")
+    assert not tombs, _ctx(seed, plan, f"undrained tombstones: {tombs}")
+    # 3. the node degraded under fire and healed after
+    if expect_degraded:
+        assert res.saw_breaker_open, \
+            _ctx(seed, plan, "breaker never opened during the blackout")
+        assert res.saw_condition_false, \
+            _ctx(seed, plan, f"{API_CONDITION} never flipped False")
+        assert res.saw_taint, \
+            _ctx(seed, plan, f"{DEGRADED_TAINT_KEY} taint never appeared")
+    assert h.breaker.state == CLOSED, \
+        _ctx(seed, plan, f"breaker ended {h.breaker.state_name}")
+    node = h.kube.get_node("virtual-tpu")
+    conds = {c["type"]: c["status"] for c in node["status"]["conditions"]}
+    assert conds.get(API_CONDITION) == "True", \
+        _ctx(seed, plan, f"{API_CONDITION} did not heal: {conds}")
+    taints = {t["key"] for t in node["spec"].get("taints", [])}
+    assert DEGRADED_TAINT_KEY not in taints, \
+        _ctx(seed, plan, f"degraded taint not removed: {taints}")
+    assert conds.get("Ready") == "True", \
+        _ctx(seed, plan, f"node not Ready after heal: {conds}")
+
+
+def test_chaos_soak_tier1():
+    """Short-seeded deterministic soak: explicit windows mixing blackout +
+    preemption storm + latency spikes (the acceptance mix), one seed,
+    FakeClock, no real sleeps."""
+    seed, n_pods = 7, 4
+    h, plan, res = run_soak(seed, n_pods=n_pods, windows=TIER1_WINDOWS)
+    try:
+        assert_soak_converged(seed, h, plan, res, n_pods)
+        # 4. checkpoint-aware recovery: at least one pod was preempted, came
+        #    back, and the event/span records the step it resumed from
+        assert res.preempted_pods, \
+            _ctx(seed, plan, "the preemption storm preempted nothing")
+        recov = [e for e in h.kube.events
+                 if e["reason"] == "RecoveredFromPreemption"]
+        assert recov, _ctx(seed, plan, "no RecoveredFromPreemption event")
+        assert any("resumed from checkpoint step" in e["message"]
+                   for e in recov), \
+            _ctx(seed, plan, f"no resumed-step in events: "
+                             f"{[e['message'] for e in recov]}")
+        spans = [s for s in h.provider.tracer.recent(2048)
+                 if s["name"] == "pod.preemption_recovery"]
+        assert spans and any(s["attrs"].get("resumed_step", 0) > 0
+                             for s in spans), \
+            _ctx(seed, plan, f"no resumed_step span attr: {spans}")
+        # 5. the relaunched gang really carried the resume env
+        relaunched = [r for r in h.fake.resources.values()
+                      if r.name.rsplit("-r", 1)[-1].isdigit()]
+        assert relaunched, _ctx(seed, plan, "no relaunched slice in cloud")
+        for r in relaunched:
+            env = r.workload.get("env", {})
+            assert int(env.get("TPU_RESTART_ATTEMPT", "0")) > 0, \
+                _ctx(seed, plan, f"{r.name}: TPU_RESTART_ATTEMPT missing")
+            assert env.get("TPU_CHECKPOINT_DIR", "").startswith("/ckpt/"), \
+                _ctx(seed, plan, f"{r.name}: TPU_CHECKPOINT_DIR missing")
+        # 6. the fault plan actually did things (guards against a silent
+        #    plan wiring regression making this test vacuous)
+        assert plan.injected_errors > 0 and plan.injected_latency_s > 0, \
+            _ctx(seed, plan, "plan injected nothing")
+    finally:
+        h.close()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_chaos_soak_random_plans(seed):
+    """Longer soak under fully generated plans: whatever the seed schedules,
+    the system must converge. Degraded-node signaling is only asserted when
+    the plan actually contained a blackout long enough to plausibly trip the
+    breaker (generated plans vary)."""
+    n_pods = 6
+    h, plan, res = run_soak(seed, n_pods=n_pods, horizon_s=900.0,
+                            max_sim_s=10800.0)
+    try:
+        had_blackout = any(w.kind == BLACKOUT and w.end - w.start >= 30.0
+                           for w in plan.windows)
+        assert_soak_converged(seed, h, plan, res, n_pods,
+                              expect_degraded=had_blackout
+                              and res.saw_breaker_open)
+        if res.preempted_pods:
+            recov = [e for e in h.kube.events
+                     if e["reason"] == "RecoveredFromPreemption"]
+            assert recov, _ctx(seed, plan,
+                               f"pods {res.preempted_pods} requeued but no "
+                               "RecoveredFromPreemption event")
+    finally:
+        h.close()

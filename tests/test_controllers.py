@@ -155,6 +155,60 @@ class TestNodeController:
         conds = {c["type"]: c for c in h.kube.get_node("virtual-tpu")["status"]["conditions"]}
         assert conds["Ready"]["status"] == "False"
 
+    def test_sustained_api_errors_degrade_and_heal_node(self, h):
+        """Degraded-node signaling without a breaker (ISSUE 3): a sustained
+        reconcile-loop error streak flips TpuApiReachable=False and adds the
+        NoSchedule taint; one success heals both."""
+        from k8s_runpod_kubelet_tpu.provider.node_spec import (
+            API_CONDITION, DEGRADED_TAINT_KEY)
+        nc = NodeController(h.kube, h.provider)
+        nc.register_node()
+        nc.push_status()
+        for _ in range(h.cfg.breaker_failure_threshold):
+            h.provider.note_api_result(False)
+        assert not h.provider.api_reachable
+        assert not h.provider.ping()  # /readyz goes not-ready
+        nc.push_status()
+        node = h.kube.get_node("virtual-tpu")
+        conds = {c["type"]: c["status"] for c in node["status"]["conditions"]}
+        assert conds[API_CONDITION] == "False"
+        assert DEGRADED_TAINT_KEY in {t["key"]
+                                      for t in node["spec"]["taints"]}
+        # heal: one successful API interaction resets the streak
+        h.provider.note_api_result(True)
+        assert h.provider.api_reachable
+        nc.push_status()
+        node = h.kube.get_node("virtual-tpu")
+        conds = {c["type"]: c["status"] for c in node["status"]["conditions"]}
+        assert conds[API_CONDITION] == "True"
+        assert DEGRADED_TAINT_KEY not in {t["key"]
+                                          for t in node["spec"]["taints"]}
+
+    def test_taint_sync_preserves_foreign_taints(self, h):
+        """The degraded-taint sync owns ONLY its keys: an operator's
+        `kubectl taint` (or the node-lifecycle controller's NoExecute) must
+        survive both the degrade and the heal."""
+        from k8s_runpod_kubelet_tpu.provider.node_spec import (
+            DEGRADED_TAINT_KEY)
+        nc = NodeController(h.kube, h.provider)
+        nc.register_node()
+        node = h.kube.get_node("virtual-tpu")
+        node["spec"]["taints"].append(
+            {"key": "maintenance", "value": "true", "effect": "NoSchedule"})
+        h.kube.update_node(node)
+        for _ in range(h.cfg.breaker_failure_threshold):
+            h.provider.note_api_result(False)
+        nc.push_status()  # degrade: adds tpu.dev/api-unreachable
+        taints = {t["key"] for t in
+                  h.kube.get_node("virtual-tpu")["spec"]["taints"]}
+        assert DEGRADED_TAINT_KEY in taints and "maintenance" in taints
+        h.provider.note_api_result(True)
+        nc.push_status()  # heal: removes ONLY its own taint
+        taints = {t["key"] for t in
+                  h.kube.get_node("virtual-tpu")["spec"]["taints"]}
+        assert DEGRADED_TAINT_KEY not in taints
+        assert "maintenance" in taints
+
 
 class TestRefResourceController:
     def test_secret_creation_kicks_pending_deploy(self, h):

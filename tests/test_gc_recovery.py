@@ -153,6 +153,64 @@ class TestRecovery:
         p2.update_all_pod_statuses()
         assert h.kube.get_pod("default", "train")["status"]["phase"] == "Running"
 
+    def test_restart_does_not_reemit_recovery_event(self, h):
+        """A requeued pod that recovered BEFORE a kubelet restart must not
+        announce RecoveredFromPreemption again after it: the restarted
+        provider re-enters ready once, and a duplicate event/metric would
+        inflate the recovery count on every restart."""
+        h.cfg.preemption_requeue_limit = 2
+        pod = bind_pod(h, make_pod(chips=16))
+        qr1 = ko.annotations(pod)[A.QUEUED_RESOURCE]
+        h.provider.update_all_pod_statuses()
+        h.fake.preempt(qr1)
+        h.provider.update_all_pod_statuses()   # requeue
+        h.provider.process_pending_pods()      # redeploy
+        h.provider.update_all_pod_statuses()   # relaunch -> ready -> event
+        recov = [e for e in h.kube.events
+                 if e["reason"] == "RecoveredFromPreemption"]
+        assert len(recov) == 1
+        # simulate restart: fresh provider over the same kube + cloud
+        from k8s_runpod_kubelet_tpu.gang import GangExecutor
+        from k8s_runpod_kubelet_tpu.provider import Provider
+        p2 = Provider(h.cfg, h.kube, h.tpu,
+                      gang_executor=GangExecutor(h.transport), clock=h.clock)
+        p2.load_running()
+        info = p2.instances["default/train"]
+        assert info.preemption_count == 1  # budget survived the restart
+        p2.update_all_pod_statuses()       # re-enters ready exactly once
+        recov = [e for e in h.kube.events
+                 if e["reason"] == "RecoveredFromPreemption"]
+        assert len(recov) == 1, [e["message"] for e in recov]
+        assert p2.metrics.get_counter("tpu_kubelet_preemption_recoveries") == 0
+
+    def test_restart_between_relaunch_and_ready_still_announces(self, h):
+        """The mirror image of the no-duplicate case: if the kubelet dies
+        AFTER the post-preemption gang relaunch but BEFORE it ever observed
+        Ready (no RecoveredFromPreemption emitted, no tpu.dev/recovered-
+        attempt marker), the restarted kubelet must still announce the
+        recovery — a running gang alone is not proof it was announced."""
+        pod = bind_pod(h, make_pod(chips=16))
+        h.provider.update_all_pod_statuses()  # launch; pod Running
+        # simulate "this running gang is preemption attempt 1 and nobody
+        # announced it": the relaunch annotated the count, then the kubelet
+        # died before the ready-observation pass
+        h.kube.patch_pod("default", "train", {"metadata": {"annotations": {
+            A.PREEMPTION_COUNT: "1"}}})
+        from k8s_runpod_kubelet_tpu.gang import GangExecutor
+        from k8s_runpod_kubelet_tpu.provider import Provider
+        p2 = Provider(h.cfg, h.kube, h.tpu,
+                      gang_executor=GangExecutor(h.transport), clock=h.clock)
+        p2.load_running()
+        assert p2.instances["default/train"].recovery_event_emitted is False
+        p2.update_all_pod_statuses()
+        recov = [e for e in h.kube.events
+                 if e["reason"] == "RecoveredFromPreemption"]
+        assert len(recov) == 1
+        assert p2.metrics.get_counter("tpu_kubelet_preemption_recoveries") == 1
+        # and the durable marker now suppresses a SECOND restart's re-emit
+        assert ko.annotations(h.kube.get_pod("default", "train"))[
+            A.RECOVERED_ATTEMPT] == "1"
+
     def test_rebinds_by_pod_uid_label_when_annotation_lost(self, h):
         pod = bind_pod(h, make_pod(chips=16))
         qr = ko.annotations(pod)[A.QUEUED_RESOURCE]

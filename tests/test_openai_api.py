@@ -363,7 +363,19 @@ class TestOpenAiCompletions:
 class TestPenaltiesHttp:
     def test_penalties_flow_through_completions(self, tmp_path):
         """presence/frequency penalties reach the engine from both
-        /v1/completions and /generate and change a greedy repetition."""
+        /v1/completions and /generate and change a greedy decode.
+
+        Deflaked (ISSUE 3 satellite): the old form asserted that penalties
+        alter the natural greedy output of a prompt built from repeats —
+        but ADVICE r4 deliberately switched penalty counts to
+        GENERATED-tokens-only (OpenAI/vLLM semantics), so prompt repeats
+        stopped counting and the tiny random-init model's 8 greedy tokens
+        happened to contain no generated repeats: nothing for a penalty to
+        change, deterministic failure. Now logit_bias pins the repetition:
+        +30 on token 7 makes greedy emit 7 forever; +24 on runner-up 11
+        puts it 6 points behind, so with presence+frequency 2.0 the
+        accumulated penalty (2 + 2*count) MUST overtake the gap within a
+        few steps and swap in token 11 — model-independent and exact."""
         import jax
         from k8s_runpod_kubelet_tpu.models import init_params
         from k8s_runpod_kubelet_tpu.workloads.serve_main import serve
@@ -374,15 +386,19 @@ class TestPenaltiesHttp:
                           ).start()
         httpd = serve(e, 0, tokenizer=get_tokenizer("bytes"))
         port = httpd.server_address[1]
+        bias = {"7": 30.0, "11": 24.0}
         try:
             base = _post(port, "/generate",
-                         {"tokens": [5, 9, 2, 5, 9, 2],
-                          "max_new_tokens": 8})["tokens"]
+                         {"tokens": [5, 9, 2], "max_new_tokens": 8,
+                          "temperature": 0, "logit_bias": bias})["tokens"]
+            assert base == [7] * 8  # bias dominates: pure repetition
             pen = _post(port, "/generate",
-                        {"tokens": [5, 9, 2, 5, 9, 2], "max_new_tokens": 8,
+                        {"tokens": [5, 9, 2], "max_new_tokens": 8,
+                         "temperature": 0, "logit_bias": bias,
                          "presence_penalty": 2.0,
                          "frequency_penalty": 2.0})["tokens"]
-            assert base != pen
+            assert pen != base  # penalties broke the repetition
+            assert 11 in pen    # ...by promoting the runner-up
             out = _post(port, "/v1/completions",
                         {"prompt": [5, 9, 2], "max_tokens": 6,
                          "temperature": 0,

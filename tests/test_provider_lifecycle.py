@@ -145,6 +145,31 @@ class TestProvisioningStates:
 
 
 class TestFailurePaths:
+    def test_quota_throttle_does_not_degrade_node_but_outage_does(self, h):
+        """A sustained 429/403 streak is a RESPONSE — the API is alive, the
+        node must stay schedulable; only network/5xx streaks flip
+        api_reachable (mirrors the breaker's success-on-4xx accounting)."""
+        from k8s_runpod_kubelet_tpu.cloud.tpu_client import (QuotaError,
+                                                             TpuApiError)
+        bind_pod(h, make_pod(chips=16))
+        h.provider.update_all_pod_statuses()
+
+        def throttled(*a, **k):
+            raise QuotaError("throttled", status=429)
+
+        h.tpu.get_detailed_status = throttled
+        for _ in range(h.cfg.breaker_failure_threshold + 2):
+            h.provider.update_all_pod_statuses()
+        assert h.provider.api_reachable  # alive, just throttled
+
+        def dark(*a, **k):
+            raise TpuApiError("connection refused", status=0)
+
+        h.tpu.get_detailed_status = dark
+        for _ in range(h.cfg.breaker_failure_threshold):
+            h.provider.update_all_pod_statuses()
+        assert not h.provider.api_reachable  # a real outage degrades
+
     def test_deploy_failure_keeps_pod_pending_then_retry_succeeds(self, h):
         h.fake.fail_next_create = (429, "no v5e capacity")
         pod = bind_pod(h, make_pod(chips=16))

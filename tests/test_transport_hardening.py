@@ -1,0 +1,428 @@
+"""Transport hardening edge cases (ISSUE 3 tentpole part 1).
+
+Covers the retry machinery the chaos soak leans on, in isolation:
+- decorrelated-jitter backoff stays within [base, cap];
+- the per-request deadline budget spans retries (no hidden-sleep blowup) and
+  is exhausted mid-backoff rather than overshot;
+- ``Retry-After`` honored on 429/503, both delta-seconds and HTTP-date forms;
+- a 401 token refresh racing a 5xx burst: the refresh does not consume a
+  backoff-retry slot, and the burst still gets its full retry budget;
+- circuit breaker: trip on consecutive failures, fail-fast while open,
+  half-open probe that heals on success and re-trips on failure.
+
+A scripted in-process HTTP server plays the flaky cloud; sleeps are recorded,
+never slept; the breaker runs on a FakeClock.
+"""
+
+from __future__ import annotations
+
+import email.utils
+import json
+import random
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from k8s_runpod_kubelet_tpu.cloud.transport import (
+    CLOSED, OPEN, HALF_OPEN,
+    CircuitBreaker, CircuitOpenError, HttpTransport, TransportError,
+    parse_retry_after,
+)
+
+from harness import FakeClock
+
+
+class ScriptedServer:
+    """Serves a scripted sequence of (status, headers) responses; repeats the
+    last entry forever. Records every request's Authorization header."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.hits = 0
+        self.auth_seen: list[str] = []
+        self.lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                with outer.lock:
+                    i = min(outer.hits, len(outer.script) - 1)
+                    status, headers = outer.script[i]
+                    outer.hits += 1
+                    outer.auth_seen.append(
+                        self.headers.get("Authorization", ""))
+                body = json.dumps({"ok": status == 200}).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in headers.items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(target=self.httpd.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    @property
+    def url(self):
+        host, port = self.httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def make_transport(server, clock, sleeps, **kw):
+    kw.setdefault("rng", random.Random(42))
+    kw.setdefault("token", "t")
+
+    def sleep(s):
+        sleeps.append(s)
+        clock.advance(s)
+
+    return HttpTransport(server.url, sleep=sleep, clock=clock, **kw)
+
+
+class TestRetryAfterParsing:
+    def test_delta_seconds(self):
+        assert parse_retry_after("7") == 7.0
+        assert parse_retry_after(" 12.5 ") == 12.5
+        assert parse_retry_after("-3") == 0.0  # never a negative sleep
+
+    def test_http_date(self):
+        now = 1_700_000_000.0
+        future = email.utils.formatdate(now + 42, usegmt=True)
+        got = parse_retry_after(future, now=now)
+        assert got is not None and 41.0 <= got <= 43.0
+
+    def test_http_date_in_past_is_zero(self):
+        now = 1_700_000_000.0
+        past = email.utils.formatdate(now - 500, usegmt=True)
+        assert parse_retry_after(past, now=now) == 0.0
+
+    def test_garbage_is_none(self):
+        assert parse_retry_after(None) is None
+        assert parse_retry_after("") is None
+        assert parse_retry_after("soon-ish") is None
+
+
+class TestBackoffAndDeadline:
+    def test_jitter_within_bounds_and_decorrelated(self):
+        srv = ScriptedServer([(503, {})])
+        try:
+            clock, sleeps = FakeClock(), []
+            t = make_transport(srv, clock, sleeps, max_retries=6,
+                               deadline_s=10_000.0, backoff_base_s=0.5,
+                               backoff_cap_s=15.0)
+            with pytest.raises(TransportError):
+                t.request("GET", "/x")
+            assert len(sleeps) == 5  # 6 attempts -> 5 backoffs
+            assert all(0.5 <= s <= 15.0 for s in sleeps)
+            assert len(set(sleeps)) > 1, "jitter produced identical sleeps"
+        finally:
+            srv.stop()
+
+    def test_deadline_budget_exhausted_mid_backoff(self):
+        """A 30s-timeout call must not become 90s of hidden sleeps: once the
+        next backoff would cross the budget, the transport surfaces the last
+        real error instead of sleeping into overtime."""
+        srv = ScriptedServer([(503, {})])
+        try:
+            clock, sleeps = FakeClock(), []
+            t = make_transport(srv, clock, sleeps, max_retries=50,
+                               timeout_s=30.0, deadline_s=5.0,
+                               backoff_base_s=2.0, backoff_cap_s=15.0)
+            t0 = clock()
+            with pytest.raises(TransportError) as ei:
+                t.request("GET", "/x")
+            assert "deadline budget" in str(ei.value)
+            assert ei.value.status == 503  # the REAL error, not a timeout mask
+            assert clock() - t0 <= 5.0 + 1e-6
+            assert srv.hits < 50, "deadline did not bound the attempt count"
+        finally:
+            srv.stop()
+
+    def test_success_within_budget_untouched(self):
+        srv = ScriptedServer([(503, {}), (200, {})])
+        try:
+            clock, sleeps = FakeClock(), []
+            t = make_transport(srv, clock, sleeps, max_retries=3,
+                               deadline_s=100.0)
+            assert t.request("GET", "/x") == {"ok": True}
+            assert len(sleeps) == 1
+        finally:
+            srv.stop()
+
+
+class TestRetryAfterHonored:
+    def test_503_retry_after_stretches_the_sleep(self):
+        srv = ScriptedServer([(503, {"Retry-After": "9"}), (200, {})])
+        try:
+            clock, sleeps = FakeClock(), []
+            t = make_transport(srv, clock, sleeps, max_retries=3,
+                               deadline_s=100.0, backoff_cap_s=2.0)
+            assert t.request("GET", "/x") == {"ok": True}
+            assert sleeps and sleeps[0] >= 9.0
+        finally:
+            srv.stop()
+
+    def test_429_with_retry_after_is_retried(self):
+        srv = ScriptedServer([(429, {"Retry-After": "3"}), (200, {})])
+        try:
+            clock, sleeps = FakeClock(), []
+            t = make_transport(srv, clock, sleeps, max_retries=3,
+                               deadline_s=100.0)
+            assert t.request("GET", "/x") == {"ok": True}
+            assert sleeps and sleeps[0] >= 3.0
+        finally:
+            srv.stop()
+
+    def test_429_without_retry_after_still_fails_fast(self):
+        """A bare 429 stays a deterministic failure (the QuotaError requeue
+        path) — only explicit server guidance earns a retry."""
+        srv = ScriptedServer([(429, {}), (200, {})])
+        try:
+            clock, sleeps = FakeClock(), []
+            t = make_transport(srv, clock, sleeps, max_retries=3,
+                               deadline_s=100.0)
+            with pytest.raises(TransportError) as ei:
+                t.request("GET", "/x")
+            assert ei.value.status == 429
+            assert srv.hits == 1 and not sleeps
+        finally:
+            srv.stop()
+
+
+class _RefreshingProvider:
+    """Token provider with invalidate(): v1 until invalidated, then v2."""
+
+    def __init__(self):
+        self.version = 1
+        self.invalidations = 0
+
+    def __call__(self):
+        return f"tok-v{self.version}"
+
+    def invalidate(self):
+        self.invalidations += 1
+        self.version += 1
+
+
+class TestAuthRefreshUnder5xx:
+    def test_401_refresh_races_a_5xx_burst(self):
+        """401 -> refresh -> 503 -> backoff-retry -> 200. The refresh must
+        not consume a retry slot, the retries must carry the FRESH token,
+        and the whole thing stays within one request() call."""
+        srv = ScriptedServer([(401, {}), (503, {}), (503, {}), (200, {})])
+        try:
+            clock, sleeps = FakeClock(), []
+            prov = _RefreshingProvider()
+            t = make_transport(srv, clock, sleeps, token="",
+                               token_provider=prov, max_retries=3,
+                               deadline_s=100.0)
+            assert t.request("GET", "/x") == {"ok": True}
+            assert prov.invalidations == 1
+            assert srv.hits == 4  # 401 + 2x503 + 200: 3 "real" attempts
+            assert srv.auth_seen[0] == "Bearer tok-v1"
+            assert all(a == "Bearer tok-v2" for a in srv.auth_seen[1:])
+        finally:
+            srv.stop()
+
+    def test_second_401_is_terminal(self):
+        srv = ScriptedServer([(401, {}), (401, {})])
+        try:
+            clock, sleeps = FakeClock(), []
+            prov = _RefreshingProvider()
+            t = make_transport(srv, clock, sleeps, token="",
+                               token_provider=prov, max_retries=3,
+                               deadline_s=100.0)
+            with pytest.raises(TransportError) as ei:
+                t.request("GET", "/x")
+            assert ei.value.status == 401
+            assert prov.invalidations == 1  # refreshed once, not in a loop
+        finally:
+            srv.stop()
+
+
+class TestCircuitBreaker:
+    def test_trips_after_threshold_and_fails_fast(self):
+        srv = ScriptedServer([(503, {})])
+        try:
+            clock, sleeps = FakeClock(), []
+            br = CircuitBreaker(failure_threshold=4, reset_timeout_s=30.0,
+                                clock=clock)
+            t = make_transport(srv, clock, sleeps, max_retries=2,
+                               deadline_s=100.0, breaker=br)
+            with pytest.raises(TransportError):
+                t.request("GET", "/x")  # 2 failures
+            with pytest.raises(TransportError):
+                t.request("GET", "/x")  # 4 failures -> OPEN
+            assert br.state == OPEN
+            hits_before = srv.hits
+            with pytest.raises(CircuitOpenError):
+                t.request("GET", "/x")  # rejected, no I/O
+            assert srv.hits == hits_before
+        finally:
+            srv.stop()
+
+    def test_half_open_probe_heals(self):
+        srv = ScriptedServer([(503, {}), (503, {}), (200, {})])
+        try:
+            clock, sleeps = FakeClock(), []
+            br = CircuitBreaker(failure_threshold=2, reset_timeout_s=30.0,
+                                clock=clock)
+            t = make_transport(srv, clock, sleeps, max_retries=1,
+                               deadline_s=100.0, breaker=br)
+            for _ in range(2):
+                with pytest.raises(TransportError):
+                    t.request("GET", "/x")
+            assert br.state == OPEN
+            clock.advance(31.0)
+            assert t.request("GET", "/x") == {"ok": True}  # the probe
+            assert br.state == CLOSED
+        finally:
+            srv.stop()
+
+    def test_half_open_probe_retrips(self):
+        srv = ScriptedServer([(503, {})])
+        try:
+            clock, sleeps = FakeClock(), []
+            br = CircuitBreaker(failure_threshold=2, reset_timeout_s=30.0,
+                                clock=clock)
+            t = make_transport(srv, clock, sleeps, max_retries=1,
+                               deadline_s=100.0, breaker=br)
+            for _ in range(2):
+                with pytest.raises(TransportError):
+                    t.request("GET", "/x")
+            assert br.state == OPEN
+            clock.advance(31.0)
+            with pytest.raises(TransportError):
+                t.request("GET", "/x")  # probe fails
+            assert br.state == OPEN
+            # and stays rejecting until the NEXT full reset window
+            with pytest.raises(CircuitOpenError):
+                t.request("GET", "/x")
+            clock.advance(31.0)
+            assert br.allow()  # next probe window opens again
+            assert br.state == HALF_OPEN
+        finally:
+            srv.stop()
+
+    def test_half_open_probe_stops_after_first_failed_attempt(self):
+        """One probe means ONE attempt: when the probe's first attempt
+        re-opens the breaker, the remaining retries must not backoff-sleep
+        and do real I/O against an API just declared dark."""
+        srv = ScriptedServer([(503, {})])
+        try:
+            clock, sleeps = FakeClock(), []
+            br = CircuitBreaker(failure_threshold=2, reset_timeout_s=30.0,
+                                clock=clock)
+            t = make_transport(srv, clock, sleeps, max_retries=4,
+                               deadline_s=1000.0, breaker=br)
+            with pytest.raises(TransportError):
+                t.request("GET", "/x", max_retries=2)  # 2 failures -> OPEN
+            assert br.state == OPEN
+            hits, n_sleeps = srv.hits, len(sleeps)
+            clock.advance(31.0)
+            with pytest.raises(TransportError) as ei:
+                t.request("GET", "/x")  # the probe: max_retries=4 available
+            assert ei.value.status == 503  # the real error, not CircuitOpen
+            assert srv.hits == hits + 1, "probe did more than one attempt"
+            assert len(sleeps) == n_sleeps, "probe slept before giving up"
+            assert br.state == OPEN
+        finally:
+            srv.stop()
+
+    def test_half_open_probe_token_failure_releases_slot(self):
+        """A probe request that dies fetching its bearer token (metadata
+        blip) must release the half-open probe slot — the old path skipped
+        breaker accounting entirely, wedging the breaker half-open forever
+        (every later allow() refused, node degraded until restart)."""
+        class FlakyTokens:
+            ok = False
+
+            def __call__(self):
+                if not self.ok:
+                    raise RuntimeError("metadata server down")
+                return "tok"
+
+        srv = ScriptedServer([(200, {})])
+        try:
+            clock, sleeps = FakeClock(), []
+            tokens = FlakyTokens()
+            br = CircuitBreaker(failure_threshold=2, reset_timeout_s=30.0,
+                                clock=clock)
+            t = make_transport(srv, clock, sleeps, token="",
+                               token_provider=tokens, max_retries=1,
+                               deadline_s=100.0, breaker=br)
+            for _ in range(2):
+                with pytest.raises(TransportError):
+                    t.request("GET", "/x")
+            assert br.state == OPEN
+            clock.advance(31.0)
+            with pytest.raises(TransportError):
+                t.request("GET", "/x")  # the probe, dying on token fetch
+            assert br.state == OPEN  # re-tripped, NOT wedged half-open
+            tokens.ok = True
+            clock.advance(31.0)
+            assert t.request("GET", "/x") == {"ok": True}  # next probe heals
+            assert br.state == CLOSED
+        finally:
+            srv.stop()
+
+    def test_4xx_does_not_trip(self):
+        srv = ScriptedServer([(404, {})])
+        try:
+            clock, sleeps = FakeClock(), []
+            br = CircuitBreaker(failure_threshold=2, clock=clock)
+            t = make_transport(srv, clock, sleeps, max_retries=1,
+                               deadline_s=100.0, breaker=br)
+            for _ in range(5):
+                with pytest.raises(TransportError):
+                    t.request("GET", "/x")
+            assert br.state == CLOSED  # a response proves the API is alive
+        finally:
+            srv.stop()
+
+    def test_state_change_callback_fires(self):
+        clock = FakeClock()
+        br = CircuitBreaker(failure_threshold=2, reset_timeout_s=10.0,
+                            clock=clock)
+        changes = []
+        br.on_state_change = lambda old, new: changes.append((old, new))
+        br.record_failure()
+        br.record_failure()
+        assert changes == [(CLOSED, OPEN)]
+        clock.advance(11.0)
+        assert br.allow()
+        br.record_success()
+        assert changes == [(CLOSED, OPEN), (OPEN, HALF_OPEN),
+                           (HALF_OPEN, CLOSED)]
+
+
+class TestRetryObservability:
+    def test_retries_counted_and_spanned(self):
+        from k8s_runpod_kubelet_tpu.metrics import Metrics
+        from k8s_runpod_kubelet_tpu.tracing import Tracer
+        srv = ScriptedServer([(503, {}), (503, {}), (200, {})])
+        try:
+            clock, sleeps = FakeClock(), []
+            m, tr = Metrics(), Tracer(clock=time.time)
+            t = make_transport(srv, clock, sleeps, max_retries=3,
+                               deadline_s=100.0, metrics=m, tracer=tr)
+            assert t.request("GET", "/x") == {"ok": True}
+            assert m.get_counter("tpu_cloud_request_retries",
+                                 {"reason": "5xx"}) == 2
+            spans = [s for s in tr.recent() if s["name"] == "cloud.retry"]
+            assert len(spans) == 2
+            assert spans[0]["attrs"]["status"] == 503
+        finally:
+            srv.stop()

@@ -55,6 +55,42 @@ def test_train_main_profile_trace(capsys, tmp_path):
     assert summary["tokens_per_s_per_chip"] > 0
 
 
+def test_train_main_env_driven_preemption_resume(tmp_path):
+    """Checkpoint-aware preemption recovery, workload half (ISSUE 3): the
+    kubelet injects TPU_CHECKPOINT_DIR + TPU_RESTART_ATTEMPT on a
+    post-preemption relaunch; train_main must pick the dir up WITHOUT a
+    --checkpoint-dir flag and resume from the latest orbax step — logging
+    the 'resumed from checkpoint step N' marker the kubelet's
+    RecoveredFromPreemption event parses. Each life runs in its own
+    subprocess, exactly like a real relaunch (and unlike two mains in one
+    process, which trips the known XLA-CPU-JIT heap fragility the conftest
+    workaround documents)."""
+    import os
+    import subprocess
+    import sys
+
+    def life(attempt: int):
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   TPU_CHECKPOINT_DIR=str(tmp_path / "ckpt"))
+        if attempt:
+            env["TPU_RESTART_ATTEMPT"] = str(attempt)
+        return subprocess.run(
+            [sys.executable, "-m",
+             "k8s_runpod_kubelet_tpu.workloads.train_main",
+             "--model", "tiny", "--steps", "1", "--batch", "1",
+             "--seq-len", "16"],
+            env=env, capture_output=True, text=True, timeout=600)
+
+    first = life(0)
+    assert first.returncode == 0, first.stderr[-2000:]
+    relaunch = life(1)
+    assert relaunch.returncode == 0, relaunch.stderr[-2000:]
+    assert "resumed from checkpoint step 1" in relaunch.stderr, \
+        relaunch.stderr[-2000:]
+    assert "attempt 1 resumes at step 1" in relaunch.stderr, \
+        relaunch.stderr[-2000:]
+
+
 def test_train_main_with_data_file(capsys, tmp_path):
     import numpy as np
     from k8s_runpod_kubelet_tpu.workloads.train_main import main
