@@ -308,13 +308,16 @@ class HttpTransport:
         expect_status: tuple[int, ...] = (200,),
         max_retries: Optional[int] = None,
         deadline_s: Optional[float] = None,
+        extra_headers: Optional[dict] = None,
     ) -> Any:
         """Issue a JSON request; returns the decoded JSON body (or None for empty).
 
         ``max_retries`` overrides the transport-wide attempt count for calls
         whose caller would rather fail fast than block (e.g. the quota read
         that rides the readiness probe's ping path). ``deadline_s`` overrides
-        the total budget for this one request."""
+        the total budget for this one request. ``extra_headers`` adds
+        caller headers (the fleet router propagates ``traceparent`` so a
+        routed request's engine spans join the router's trace)."""
         url = self.base_url + path
         data = json.dumps(body).encode() if body is not None else None
         retries = self.max_retries if max_retries is None else max_retries
@@ -345,6 +348,8 @@ class HttpTransport:
             req = urllib.request.Request(url, data=data, method=method)
             req.add_header("Content-Type", "application/json")
             req.add_header("User-Agent", self.user_agent)
+            for hk, hv in (extra_headers or {}).items():
+                req.add_header(hk, hv)
             try:
                 bearer = self._bearer()
             except Exception as e:

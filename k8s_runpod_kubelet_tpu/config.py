@@ -92,6 +92,19 @@ class Config:
     breaker_failure_threshold: int = 5
     breaker_reset_s: float = 30.0
 
+    # fleet tier (ISSUE 4): the replica-aware router + SLO autoscaler.
+    # Same env-var conventions as the breaker knobs (TPU_FLEET_* in
+    # _ENV_MAP); flags live on fleet/router_main.py and serve_main.py.
+    fleet_router_port: int = 8090
+    fleet_heartbeat_interval_s: float = 2.0     # replica -> router cadence
+    fleet_heartbeat_timeout_s: float = 10.0     # staler = suspect -> probe
+    fleet_ttft_slo_s: float = 2.0               # scale-up SLO burn signal
+    fleet_target_queue_per_replica: float = 4.0  # scale-up queue signal
+    fleet_min_replicas: int = 1
+    fleet_max_replicas: int = 4
+    fleet_scale_up_cooldown_s: float = 30.0
+    fleet_scale_down_cooldown_s: float = 120.0
+
     # servers
     listen_port: int = 10250
     health_address: str = ":8080"
@@ -136,6 +149,24 @@ class Config:
             errs.append("breaker_failure_threshold must be > 0")
         if self.breaker_reset_s <= 0:
             errs.append("breaker_reset_s must be > 0")
+        if self.fleet_router_port <= 0:
+            errs.append("fleet_router_port must be > 0")
+        if self.fleet_heartbeat_interval_s <= 0:
+            errs.append("fleet_heartbeat_interval_s must be > 0")
+        if self.fleet_heartbeat_timeout_s < self.fleet_heartbeat_interval_s:
+            errs.append("fleet_heartbeat_timeout_s must be >= "
+                        "fleet_heartbeat_interval_s (a replica must get at "
+                        "least one beat per timeout window)")
+        if self.fleet_min_replicas < 0:
+            errs.append("fleet_min_replicas must be >= 0")
+        if self.fleet_max_replicas < max(1, self.fleet_min_replicas):
+            errs.append("fleet_max_replicas must be >= max(1, "
+                        "fleet_min_replicas)")
+        if self.fleet_target_queue_per_replica <= 0:
+            errs.append("fleet_target_queue_per_replica must be > 0")
+        if self.fleet_scale_up_cooldown_s < 0 \
+                or self.fleet_scale_down_cooldown_s < 0:
+            errs.append("fleet cooldowns must be >= 0")
         if errs:
             raise ValueError("invalid config: " + "; ".join(errs))
         return self
@@ -154,6 +185,15 @@ _ENV_MAP = {
     "LOG_LEVEL": "log_level",
     "TPU_MAX_TOTAL_CHIPS": "max_total_chips",
     "TPU_TRACE_EXPORT_PATH": "trace_export_path",
+    "TPU_FLEET_ROUTER_PORT": "fleet_router_port",
+    "TPU_FLEET_HEARTBEAT_INTERVAL_S": "fleet_heartbeat_interval_s",
+    "TPU_FLEET_HEARTBEAT_TIMEOUT_S": "fleet_heartbeat_timeout_s",
+    "TPU_FLEET_TTFT_SLO_S": "fleet_ttft_slo_s",
+    "TPU_FLEET_TARGET_QUEUE_PER_REPLICA": "fleet_target_queue_per_replica",
+    "TPU_FLEET_MIN_REPLICAS": "fleet_min_replicas",
+    "TPU_FLEET_MAX_REPLICAS": "fleet_max_replicas",
+    "TPU_FLEET_SCALE_UP_COOLDOWN_S": "fleet_scale_up_cooldown_s",
+    "TPU_FLEET_SCALE_DOWN_COOLDOWN_S": "fleet_scale_down_cooldown_s",
 }
 
 

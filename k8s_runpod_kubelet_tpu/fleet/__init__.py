@@ -1,0 +1,32 @@
+"""Fleet tier: replica-aware request routing + SLO-driven autoscaling.
+
+One serving replica (ServingEngine + serve_main) saturates at its slot
+count; the ROADMAP north star ("heavy traffic from millions of users")
+needs a tier ABOVE replicas. This package is that tier:
+
+- ``registry``  — replicas register/heartbeat with live load stats; stale
+  or probe-failing replicas are evicted (the router never routes blind).
+- ``router``    — an HTTP front door speaking the same ``/v1/*`` +
+  ``/generate`` API as serve_main: least-loaded routing with
+  prefix-affinity, streaming passthrough, per-replica circuit breakers
+  with retry-on-next-replica, 429 + Retry-After when the whole fleet is
+  saturated, and W3C traceparent propagation so a request's router span
+  parents its engine span tree.
+- ``autoscaler`` — an injected-clock control loop sizing the replica set
+  from queue depth + TTFT-SLO burn (hysteresis + cooldowns), creating
+  serving pods against the virtual node and drain-before-delete on the
+  way down so no request is dropped.
+
+Entry point: ``python -m k8s_runpod_kubelet_tpu.fleet.router_main``.
+"""
+
+from .autoscaler import AutoscalerConfig, FleetAutoscaler, KubePodScaler
+from .registry import (DRAINING, READY, Replica, ReplicaRegistry,
+                       ReplicaReporter, ReplicaStats)
+from .router import FleetRouter, RouterConfig, serve_router
+
+__all__ = [
+    "AutoscalerConfig", "FleetAutoscaler", "KubePodScaler",
+    "READY", "DRAINING", "Replica", "ReplicaRegistry", "ReplicaReporter",
+    "ReplicaStats", "FleetRouter", "RouterConfig", "serve_router",
+]

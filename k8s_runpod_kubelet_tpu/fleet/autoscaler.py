@@ -1,0 +1,429 @@
+"""SLO-driven fleet autoscaler: size the replica set to the traffic.
+
+An injected-clock control loop (``tick()``; router_main wraps it in a
+timer thread) that computes the desired replica count from the registry's
+live load view:
+
+- **scale up** when sustained queue depth per replica exceeds the target
+  OR the fleet's worst recent TTFT p95 burns the SLO — after
+  ``scale_up_stable_s`` of sustained overload and outside the up-cooldown
+  (hysteresis: one spiky scrape must not buy a TPU slice);
+- **scale down** when the fleet is sustained-idle (no queue, utilization
+  under the floor) — but ONLY via drain-first: the victim gets ``POST
+  /drain`` (stop admitting, finish in-flight, deregister), and its pod is
+  deleted only once the drain completes (or times out). No request is
+  ever dropped by a scale-down.
+
+Scale-up creates real serving pods against the virtual node through the
+existing kube client — the pod rides the whole QueuedResources
+provisioning path (deploy -> provisioning -> gang launch -> ready), which
+is exactly what the fleet soak exercises end to end.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+import threading
+import time
+from typing import Callable, Optional
+
+from ..cloud.transport import CircuitOpenError, TransportError
+from .registry import DRAINING, Replica, ReplicaRegistry
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class AutoscalerConfig:
+    min_replicas: int = 1
+    max_replicas: int = 4
+    # scale-up signals: sustained queue depth per ready replica, or the
+    # worst replica's recent TTFT p95 over the SLO
+    target_queue_per_replica: float = 4.0
+    ttft_slo_s: float = 2.0
+    # hysteresis: how long a signal must hold before acting
+    scale_up_stable_s: float = 10.0
+    scale_down_stable_s: float = 60.0
+    # cooldowns: minimum spacing between same-direction actions
+    scale_up_cooldown_s: float = 30.0
+    scale_down_cooldown_s: float = 120.0
+    # scale-down eligibility: fleet-wide slot utilization under this floor
+    scale_down_utilization: float = 0.25
+    # a drain that outlives this is force-completed (pod deleted anyway —
+    # the replica is presumed wedged; its breaker/eviction already stopped
+    # new traffic)
+    drain_timeout_s: float = 300.0
+    # a created pod that never registers a replica within this window is
+    # presumed failed and stops counting toward the fleet size
+    boot_timeout_s: float = 900.0
+
+
+class KubePodScaler:
+    """Creates/deletes serving pods on the virtual TPU node via the
+    existing kube client. ``on_create(pod)`` lets an embedding process
+    (or the hermetic soak) hand the created pod straight to the
+    provider, exactly as the pod controller would."""
+
+    def __init__(self, kube, node_name: str, namespace: str = "default",
+                 chips: int = 8, image: str = "",
+                 template_fn: Optional[Callable[[str], dict]] = None,
+                 on_create: Optional[Callable[[dict], None]] = None,
+                 on_delete: Optional[Callable[[dict], None]] = None):
+        self.kube = kube
+        self.node_name = node_name
+        self.namespace = namespace
+        self.chips = chips
+        self.image = image or "gcr.io/tpu-fleet/serve:latest"
+        self.template_fn = template_fn
+        self.on_create = on_create
+        # on_delete(pod) mirrors on_create: an embedding process hands the
+        # deletion to the provider too, so the slice is released and
+        # tombstoned exactly as if the pod controller saw the delete
+        self.on_delete = on_delete
+        self._seq = 0
+
+    # pods carrying this label are FLEET-OWNED: the autoscaler may reap
+    # one that no registered replica backs (a custom template_fn must
+    # include it for orphan reaping to see its pods)
+    FLEET_LABEL = "tpu.dev/fleet=serving"
+
+    def _pod(self, name: str) -> dict:
+        if self.template_fn is not None:
+            return self.template_fn(name)
+        return {"apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": name, "namespace": self.namespace,
+                             "labels": {"app": "tpu-serving",
+                                        "tpu.dev/fleet": "serving"}},
+                "spec": {"nodeName": self.node_name,
+                         "containers": [{
+                             "name": "serve", "image": self.image,
+                             "resources": {"limits": {
+                                 "google.com/tpu": str(self.chips)}}}]}}
+
+    def create(self) -> str:
+        self._seq += 1
+        name = f"tpu-serving-{self._seq}"
+        created = self.kube.create_pod(self._pod(name))
+        if self.on_create is not None:
+            self.on_create(created)
+        return name
+
+    def list_fleet_pods(self) -> list[str]:
+        """Names of fleet-owned serving pods (by label) — the orphan
+        reaper's ground truth of what exists in the cluster."""
+        return [p["metadata"]["name"]
+                for p in self.kube.list_pods(self.namespace,
+                                             label_selector=self.FLEET_LABEL)]
+
+    def delete(self, pod_name: str):
+        pod = None
+        if self.on_delete is not None:
+            try:
+                pod = self.kube.get_pod(self.namespace, pod_name)
+            except Exception as e:  # noqa: BLE001 — already gone is fine
+                log.info("fleet: pod %s gone before delete (%s)",
+                         pod_name, e)
+                pod = None
+        # grace 0: the autoscaler only deletes AFTER the drain emptied the
+        # engine (or timed out), so there is nothing left for a graceful
+        # termination period to protect
+        self.kube.delete_pod(self.namespace, pod_name, grace_period_s=0)
+        if pod is not None:
+            self.on_delete(pod)
+
+
+@dataclasses.dataclass
+class _Drain:
+    replica_id: str
+    pod_name: str
+    started_at: float
+
+
+class FleetAutoscaler:
+    """The control loop. All timing flows through the injected ``clock``;
+    ``tick()`` is side-effect-idempotent between signal changes (calling
+    it twice in one instant acts at most once)."""
+
+    def __init__(self, registry: ReplicaRegistry, scaler, cfg=None,
+                 metrics=None, tracer=None,
+                 clock: Callable[[], float] = time.monotonic,
+                 drain_fn: Optional[Callable[[Replica], None]] = None):
+        self.registry = registry
+        self.scaler = scaler
+        self.cfg = cfg or AutoscalerConfig()
+        if self.cfg.min_replicas < 0 or \
+                self.cfg.max_replicas < max(1, self.cfg.min_replicas):
+            raise ValueError("need 0 <= min_replicas <= max_replicas "
+                             f"(got {self.cfg.min_replicas}, "
+                             f"{self.cfg.max_replicas})")
+        self.metrics = metrics
+        self.tracer = tracer
+        self.clock = clock
+        self._drain_fn = drain_fn or self._http_drain
+        self._over_since: Optional[float] = None
+        self._under_since: Optional[float] = None
+        self._last_up = -math.inf
+        self._last_down = -math.inf
+        self._drains: dict[str, _Drain] = {}
+        # pods created but whose replica hasn't registered yet: they count
+        # toward fleet size, or every tick during a boot would scale again
+        self._pending: dict[str, float] = {}
+        # fleet-labeled pods observed with NO backing replica: first-seen
+        # times for the orphan reaper (a restarted autoscaler must not
+        # leak the pod of a drain its predecessor started)
+        self._orphan_seen: dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if metrics is not None:
+            self._describe(metrics)
+            metrics.set_gauge("tpu_fleet_desired_replicas",
+                              self.cfg.min_replicas)
+
+    @staticmethod
+    def _describe(m):
+        m.describe("tpu_fleet_desired_replicas",
+                   "replica count the autoscaler is steering toward")
+        m.describe("tpu_fleet_scale_ups", "scale-up actions (pods created)")
+        m.describe("tpu_fleet_scale_downs",
+                   "scale-down actions completed (drained pods deleted)")
+        m.describe("tpu_fleet_drain_timeouts",
+                   "drains force-completed after drain_timeout_s")
+        m.describe("tpu_fleet_orphans_reaped",
+                   "fleet-labeled pods deleted with no backing replica "
+                   "(e.g. a drain orphaned by an autoscaler restart)")
+
+    def _http_drain(self, replica: Replica):
+        replica.transport.request("POST", "/drain", body={})
+
+    # -- signal evaluation -----------------------------------------------------
+
+    def _fleet_size(self) -> tuple[list[Replica], int]:
+        """(ready replicas, effective fleet size). Size counts draining
+        pods OUT (their capacity is leaving) and still-booting pods IN."""
+        live = self.registry.live()
+        ready = [r for r in live if r.state != DRAINING]
+        return ready, len(ready) + len(self._pending)
+
+    def _overloaded(self, ready: list[Replica]) -> Optional[str]:
+        if not ready:
+            return None
+        queue = sum(r.stats.queue_depth for r in ready)
+        if queue / len(ready) > self.cfg.target_queue_per_replica:
+            return f"queue_depth {queue} over " \
+                   f"{self.cfg.target_queue_per_replica}/replica"
+        worst = max(r.stats.ttft_p95_s for r in ready)
+        # TTFT SLO burn needs CORROBORATING live load: the reporter's p95
+        # comes from the histogram's recent tail, which has no time window
+        # — after traffic stops it latches the last burst's value forever,
+        # and acting on it would scale an idle fleet to max and hold it
+        # there (the overload branch preempts underload)
+        busy = any(r.stats.queue_depth > 0 or r.stats.active_slots > 0
+                   for r in ready)
+        if self.cfg.ttft_slo_s > 0 and worst > self.cfg.ttft_slo_s and busy:
+            return f"ttft_p95 {worst:.3f}s over SLO {self.cfg.ttft_slo_s}s"
+        return None
+
+    def _underloaded(self, ready: list[Replica]) -> bool:
+        if not ready:
+            return False
+        if any(r.stats.queue_depth > 0 for r in ready):
+            return False
+        slots = sum(r.stats.max_slots for r in ready)
+        active = sum(r.stats.active_slots for r in ready)
+        if slots <= 0:
+            return active == 0
+        return active / slots < self.cfg.scale_down_utilization
+
+    # -- actions ---------------------------------------------------------------
+
+    def _record_scale(self, direction: str, size_from: int, size_to: int,
+                      reason: str, target: str = ""):
+        log.info("fleet: scale %s %d -> %d (%s)", direction, size_from,
+                 size_to, reason)
+        if self.metrics is not None:
+            self.metrics.set_gauge("tpu_fleet_desired_replicas", size_to)
+        if self.tracer is not None:
+            now = self.tracer.clock()
+            self.tracer.record("fleet.scale", now, now,
+                               attrs={"direction": direction,
+                                      "from": size_from, "to": size_to,
+                                      "reason": reason, "target": target})
+
+    def _scale_up(self, size: int, reason: str):
+        pod = self.scaler.create()
+        self._pending[pod] = self.clock()
+        self._last_up = self.clock()
+        self._over_since = None
+        if self.metrics is not None:
+            self.metrics.incr("tpu_fleet_scale_ups")
+        self._record_scale("up", size, size + 1, reason, target=pod)
+
+    def _start_drain(self, victim: Replica, size: int):
+        try:
+            self._drain_fn(victim)
+        except (TransportError, CircuitOpenError) as e:
+            # can't even reach it — the eviction sweep will reap it; do
+            # not delete a pod whose engine may still hold live requests
+            log.warning("fleet: drain of %s failed: %s", victim.replica_id, e)
+            return
+        self.registry.mark_draining(victim.replica_id)
+        self._drains[victim.replica_id] = _Drain(
+            victim.replica_id, victim.pod_name, self.clock())
+        self._under_since = None
+        self._record_scale("down", size, size - 1,
+                           "sustained idle; draining first",
+                           target=victim.replica_id)
+
+    def _progress_drains(self):
+        now = self.clock()
+        for rid, drain in list(self._drains.items()):
+            rep = self.registry.get(rid)
+            done = rep is None or (rep.stats.draining
+                                   and rep.stats.active_slots == 0
+                                   and rep.stats.queue_depth == 0)
+            timed_out = now - drain.started_at > self.cfg.drain_timeout_s
+            if not done and not timed_out:
+                continue
+            if timed_out and not done and self.metrics is not None:
+                self.metrics.incr("tpu_fleet_drain_timeouts")
+            if rep is not None:
+                self.registry.deregister(rid)
+            if drain.pod_name:
+                try:
+                    self.scaler.delete(drain.pod_name)
+                except Exception as e:  # noqa: BLE001 — retried next tick
+                    log.warning("fleet: delete of %s failed (will retry): %s",
+                                drain.pod_name, e)
+                    continue
+            del self._drains[rid]
+            self._last_down = now
+            if self.metrics is not None:
+                self.metrics.incr("tpu_fleet_scale_downs")
+
+    def _expire_pending(self):
+        now = self.clock()
+        registered_pods = self.registry.registered_pod_names()
+        for pod, created in list(self._pending.items()):
+            if pod in registered_pods:
+                del self._pending[pod]
+            elif now - created > self.cfg.boot_timeout_s:
+                log.warning("fleet: pod %s never registered a replica in "
+                            "%.0fs; dropping from fleet accounting", pod,
+                            self.cfg.boot_timeout_s)
+                del self._pending[pod]
+
+    # -- the loop --------------------------------------------------------------
+
+    def _adopt_draining(self):
+        """Pick up drains this process didn't start (an operator's direct
+        POST /drain, or a drain orphaned by an autoscaler restart — the
+        engine's drain is irreversible, so SOMEONE must finish the
+        delete): track them so _progress_drains completes them."""
+        for rep in self.registry.live():
+            if rep.state == DRAINING and rep.replica_id not in self._drains:
+                log.info("fleet: adopting in-progress drain of %s",
+                         rep.replica_id)
+                self._drains[rep.replica_id] = _Drain(
+                    rep.replica_id, rep.pod_name, self.clock())
+
+    def _reap_orphans(self):
+        """Delete fleet-labeled pods no registered replica backs (after a
+        boot_timeout_s grace): a drain whose replica deregistered just as
+        the autoscaler restarted leaves a pod nothing else will ever
+        delete — a leaked slice serving 503s forever."""
+        lister = getattr(self.scaler, "list_fleet_pods", None)
+        if lister is None:
+            return
+        try:
+            live = set(lister())
+        except Exception as e:  # noqa: BLE001 — listing can flake; next tick
+            log.warning("fleet: pod listing failed: %s", e)
+            return
+        now = self.clock()
+        backed = self.registry.registered_pod_names()
+        backed |= {d.pod_name for d in self._drains.values() if d.pod_name}
+        backed |= set(self._pending)
+        for pod in live:
+            if pod in backed:
+                self._orphan_seen.pop(pod, None)
+                continue
+            first = self._orphan_seen.setdefault(pod, now)
+            if now - first <= self.cfg.boot_timeout_s:
+                continue
+            log.warning("fleet: reaping orphaned pod %s (no replica for "
+                        "%.0fs)", pod, now - first)
+            try:
+                self.scaler.delete(pod)
+            except Exception as e:  # noqa: BLE001 — retried next tick
+                log.warning("fleet: orphan delete of %s failed: %s", pod, e)
+                continue
+            self._orphan_seen.pop(pod, None)
+            if self.metrics is not None:
+                self.metrics.incr("tpu_fleet_orphans_reaped")
+        for pod in list(self._orphan_seen):
+            if pod not in live:
+                del self._orphan_seen[pod]
+
+    def tick(self):
+        now = self.clock()
+        self._expire_pending()
+        self._adopt_draining()
+        self._progress_drains()
+        self._reap_orphans()
+        ready, size = self._fleet_size()
+        if size < self.cfg.min_replicas:
+            # the FLOOR needs no overload signal (an empty fleet reports
+            # no load at all — cold start, or every replica died): fill
+            # toward min_replicas, one pod per cooldown so a failing
+            # create doesn't spawn a pod per tick
+            if now - self._last_up >= self.cfg.scale_up_cooldown_s:
+                self._scale_up(size, f"fleet size {size} below "
+                                     f"min_replicas {self.cfg.min_replicas}")
+            return
+        overload = self._overloaded(ready)
+        if overload is not None:
+            self._under_since = None
+            if self._over_since is None:
+                self._over_since = now
+            if (size < self.cfg.max_replicas
+                    and now - self._over_since >= self.cfg.scale_up_stable_s
+                    and now - self._last_up >= self.cfg.scale_up_cooldown_s):
+                self._scale_up(size, overload)
+            return
+        self._over_since = None
+        if self._underloaded(ready):
+            if self._under_since is None:
+                self._under_since = now
+            if (size > self.cfg.min_replicas and not self._drains
+                    and now - self._under_since
+                    >= self.cfg.scale_down_stable_s
+                    and now - self._last_down
+                    >= self.cfg.scale_down_cooldown_s):
+                # drain the least-loaded ready replica (fewest in-flight
+                # requests = fastest drain); deterministic tie-break
+                victim = min(ready, key=lambda r: (r.stats.load_score,
+                                                   r.replica_id))
+                self._start_drain(victim, size)
+        else:
+            self._under_since = None
+
+    def run(self, interval_s: float = 5.0) -> "FleetAutoscaler":
+        """Production loop (real sleeps); tests call tick() directly."""
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.tick()
+                except Exception:  # noqa: BLE001 — the loop must survive a bad tick
+                    log.exception("autoscaler tick failed")
+                self._stop.wait(interval_s)
+        self._thread = threading.Thread(target=loop, name="fleet-autoscaler",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=5)

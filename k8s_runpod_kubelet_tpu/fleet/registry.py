@@ -1,0 +1,440 @@
+"""Replica registry: the fleet's live membership + load view.
+
+Serving replicas register and heartbeat with the load stats the router
+routes on (free slots, queue depth, KV-cache occupancy, recent TTFT p95 —
+all sourced from the surfaces the engine already exports via Metrics and
+``/debug/engine``). A replica that stops heartbeating, or whose health
+probe fails, is EVICTED — the router must never keep sending traffic to a
+corpse on the strength of its last optimistic heartbeat.
+
+Each replica carries its own ``cloud/transport.py`` HttpTransport with a
+per-replica CircuitBreaker: one dying replica fails fast (and gets routed
+around) without the timeout soak poisoning the other replicas' latency.
+
+Everything is clock-injected (the fleet soak drives eviction, breaker
+reset and autoscaler hysteresis from one FakeClock with zero real sleeps).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+import urllib.request
+from typing import Callable, Optional
+
+from ..cloud.transport import CircuitBreaker, HttpTransport, OPEN
+
+log = logging.getLogger(__name__)
+
+# replica lifecycle states (the tpu_fleet_replicas{state=...} gauge labels)
+READY = "ready"
+DRAINING = "draining"
+STATES = (READY, DRAINING)
+
+
+@dataclasses.dataclass
+class ReplicaStats:
+    """One heartbeat's load snapshot — the router's routing signal.
+
+    Field names match ``/debug/engine`` (debug_snapshot) where a
+    counterpart exists; ``ttft_p95_s`` is computed replica-side from the
+    tpu_serving_ttft_seconds histogram's recent tail (ReplicaReporter)."""
+
+    free_slots: int = 0
+    active_slots: int = 0
+    max_slots: int = 0
+    queue_depth: int = 0
+    max_queue_depth: int = 0     # the replica's admission bound (0 = none)
+    kv_cache_tokens: int = 0
+    ttft_p95_s: float = 0.0
+    draining: bool = False
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ReplicaStats":
+        known = {f.name for f in dataclasses.fields(cls)}
+        kw = {}
+        for k, v in (d or {}).items():
+            if k not in known or v is None:  # nulls fall to field defaults
+                continue
+            kw[k] = bool(v) if k == "draining" else \
+                (float(v) if k == "ttft_p95_s" else int(v))
+        return cls(**kw)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @property
+    def saturated(self) -> bool:
+        """No free slot AND the admission bound (when one exists) is full:
+        a submit forwarded here would 429. With no bound configured a
+        replica is never 'saturated' — it queues (the autoscaler's
+        signal), it doesn't reject."""
+        return (self.free_slots <= 0 and self.max_queue_depth > 0
+                and self.queue_depth >= self.max_queue_depth)
+
+    @property
+    def load_score(self) -> float:
+        """Least-loaded ordering: queued + running work minus headroom.
+        Lower routes first; ttft breaks ties in pick()."""
+        return float(self.queue_depth + self.active_slots - self.free_slots)
+
+
+@dataclasses.dataclass
+class Replica:
+    replica_id: str
+    base_url: str
+    pod_name: str = ""           # the k8s pod backing it (autoscaler's handle)
+    state: str = READY
+    registered_at: float = 0.0
+    last_heartbeat_at: float = 0.0
+    stats: ReplicaStats = dataclasses.field(default_factory=ReplicaStats)
+    transport: Optional[HttpTransport] = None
+
+    @property
+    def breaker_open(self) -> bool:
+        return (self.transport is not None
+                and self.transport.breaker is not None
+                and self.transport.breaker.state == OPEN)
+
+    def to_dict(self, now: float) -> dict:
+        return {"replica_id": self.replica_id, "base_url": self.base_url,
+                "pod_name": self.pod_name, "state": self.state,
+                "age_s": round(now - self.registered_at, 3),
+                "heartbeat_age_s": round(now - self.last_heartbeat_at, 3),
+                "breaker_open": self.breaker_open,
+                "stats": self.stats.to_dict()}
+
+
+def _default_probe(replica: Replica, timeout_s: float = 2.0) -> bool:
+    """GET /readyz on the replica: 200 = routable. /readyz (not /healthz)
+    on purpose — a DRAINING replica answers 503 there while its engine
+    thread is still perfectly alive (the serve_main status contract)."""
+    try:
+        with urllib.request.urlopen(replica.base_url.rstrip("/") + "/readyz",
+                                    timeout=timeout_s) as resp:
+            return resp.status == 200
+    except OSError:
+        return False
+
+
+class ReplicaRegistry:
+    """Thread-safe membership map + eviction sweep + fleet gauges.
+
+    ``probe_fn(replica) -> bool`` and ``transport_factory(base_url) ->
+    HttpTransport`` are injectable; defaults do real HTTP. ``sweep()`` is
+    the eviction tick — router_main runs it on a timer, tests call it
+    directly after advancing the injected clock."""
+
+    def __init__(self, metrics=None, tracer=None,
+                 clock: Callable[[], float] = time.monotonic,
+                 heartbeat_timeout_s: float = 10.0,
+                 probe_fn: Optional[Callable[[Replica], bool]] = None,
+                 transport_factory=None,
+                 breaker_failure_threshold: int = 3,
+                 breaker_reset_s: float = 10.0,
+                 request_timeout_s: float = 120.0):
+        self.metrics = metrics
+        self.tracer = tracer
+        self.clock = clock
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.probe_fn = probe_fn or _default_probe
+        self._breaker_failure_threshold = breaker_failure_threshold
+        self._breaker_reset_s = breaker_reset_s
+        self._request_timeout_s = request_timeout_s
+        self._transport_factory = transport_factory or self._make_transport
+        self._lock = threading.Lock()
+        self._replicas: dict[str, Replica] = {}
+        if metrics is not None:
+            self._describe(metrics)
+            self._update_gauges()
+
+    @staticmethod
+    def _describe(m):
+        m.describe("tpu_fleet_replicas",
+                   "registered serving replicas by lifecycle state "
+                   "(labels: state=ready|draining)")
+        m.describe("tpu_fleet_registered",
+                   "replica registrations accepted")
+        m.describe("tpu_fleet_deregistered",
+                   "replicas that deregistered cleanly (drain complete)")
+        m.describe("tpu_fleet_evictions",
+                   "replicas evicted by the registry (labels: reason="
+                   "stale|probe|dead)")
+
+    def _make_transport(self, base_url: str) -> HttpTransport:
+        # max_retries=1: same-replica retries are the ROUTER's call (it
+        # would rather fail over to a healthy replica than backoff against
+        # a sick one); the per-replica breaker still converts a failure
+        # streak into fail-fast rejections until its half-open probe heals.
+        return HttpTransport(
+            base_url, max_retries=1, timeout_s=self._request_timeout_s,
+            clock=self.clock,
+            breaker=CircuitBreaker(
+                failure_threshold=self._breaker_failure_threshold,
+                reset_timeout_s=self._breaker_reset_s, clock=self.clock))
+
+    # -- membership ------------------------------------------------------------
+
+    def register(self, replica_id: str, base_url: str,
+                 pod_name: str = "") -> Replica:
+        if not replica_id or not base_url:
+            raise ValueError("replica_id and base_url are required")
+        now = self.clock()
+        with self._lock:
+            rep = self._replicas.get(replica_id)
+            if rep is None or rep.base_url != base_url:
+                # fresh transport on a URL change: the old breaker's failure
+                # streak belongs to the old address
+                rep = Replica(replica_id=replica_id, base_url=base_url,
+                              pod_name=pod_name, registered_at=now,
+                              transport=self._transport_factory(base_url))
+                self._replicas[replica_id] = rep
+            rep.pod_name = pod_name or rep.pod_name
+            rep.state = READY
+            rep.last_heartbeat_at = now
+        if self.metrics is not None:
+            self.metrics.incr("tpu_fleet_registered")
+        self._update_gauges()
+        log.info("fleet: replica %s registered at %s", replica_id, base_url)
+        return rep
+
+    def heartbeat(self, replica_id: str, stats: dict) -> bool:
+        """Returns False for an unknown id — the replica should
+        re-register (it was evicted, or the router restarted)."""
+        with self._lock:
+            rep = self._replicas.get(replica_id)
+            if rep is None:
+                return False
+            rep.stats = ReplicaStats.from_dict(stats)
+            rep.last_heartbeat_at = self.clock()
+            # DRAINING is STICKY: the engine's drain() is irreversible, so
+            # a draining=False heartbeat after mark_draining() is a STALE
+            # snapshot (gathered before POST /drain landed) — honoring it
+            # would route traffic back to a draining replica for one beat
+            # (503s that poison its breaker and trip spurious evictions)
+            if rep.stats.draining:
+                rep.state = DRAINING
+        self._update_gauges()
+        return True
+
+    def mark_draining(self, replica_id: str):
+        """Flip a replica to DRAINING ahead of its own heartbeat saying so
+        (the autoscaler calls this the moment /drain is accepted, so the
+        router stops picking it immediately)."""
+        with self._lock:
+            rep = self._replicas.get(replica_id)
+            if rep is not None:
+                rep.state = DRAINING
+        self._update_gauges()
+
+    def registered_pod_names(self) -> set[str]:
+        with self._lock:
+            return {r.pod_name for r in self._replicas.values() if r.pod_name}
+
+    def deregister(self, replica_id: str) -> bool:
+        with self._lock:
+            rep = self._replicas.pop(replica_id, None)
+        if rep is not None and self.metrics is not None:
+            self.metrics.incr("tpu_fleet_deregistered")
+        self._update_gauges()
+        return rep is not None
+
+    def evict(self, replica_id: str, reason: str) -> bool:
+        """Remove a replica the fleet has declared dead. ``reason`` feeds
+        the eviction counter labels and the fleet.evict span."""
+        now = self.clock()
+        with self._lock:
+            rep = self._replicas.pop(replica_id, None)
+        if rep is None:
+            return False
+        log.warning("fleet: evicting replica %s (%s)", replica_id, reason)
+        if self.metrics is not None:
+            self.metrics.incr("tpu_fleet_evictions", labels={"reason": reason})
+        if self.tracer is not None:
+            self.tracer.record("fleet.evict", now, now,
+                               attrs={"replica_id": replica_id,
+                                      "reason": reason,
+                                      "base_url": rep.base_url})
+        self._update_gauges()
+        return True
+
+    def sweep(self) -> list[str]:
+        """Eviction tick: a replica whose heartbeat is stale OR whose
+        breaker is open gets ONE health probe; probe failure evicts it.
+        (A healthy-but-slow heartbeater survives the probe; a corpse
+        doesn't.) Returns the evicted ids."""
+        now = self.clock()
+        with self._lock:
+            suspects = [r for r in self._replicas.values()
+                        if (now - r.last_heartbeat_at
+                            > self.heartbeat_timeout_s) or r.breaker_open]
+        evicted = []
+        for rep in suspects:
+            stale = now - rep.last_heartbeat_at > self.heartbeat_timeout_s
+            try:
+                ok = self.probe_fn(rep)
+            except Exception as e:  # noqa: BLE001 — a raising probe is a failed probe
+                log.info("fleet: probe of %s raised: %s", rep.replica_id, e)
+                ok = False
+            if not ok:
+                if self.evict(rep.replica_id,
+                              reason="stale" if stale else "probe"):
+                    evicted.append(rep.replica_id)
+            elif rep.breaker_open:
+                # heal the breaker on probe success: ready() excludes
+                # breaker-open replicas, so no request would ever reach
+                # allow() (the only lazy OPEN->HALF_OPEN path) — without
+                # this a replica that blipped past the threshold would be
+                # a permanently unroutable zombie still counted as
+                # capacity
+                log.info("fleet: probe of %s succeeded; closing its "
+                         "breaker", rep.replica_id)
+                rep.transport.breaker.record_success()
+        return evicted
+
+    # -- reads -----------------------------------------------------------------
+
+    def get(self, replica_id: str) -> Optional[Replica]:
+        with self._lock:
+            return self._replicas.get(replica_id)
+
+    def live(self) -> list[Replica]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def ready(self) -> list[Replica]:
+        """Routable replicas: READY state, breaker not open."""
+        with self._lock:
+            return [r for r in self._replicas.values()
+                    if r.state == READY and not r.breaker_open]
+
+    def snapshot(self) -> dict:
+        """The /debug/fleet payload (also what tools/fleet_summary.py
+        renders): every replica with its age, state and last stats."""
+        now = self.clock()
+        with self._lock:
+            reps = [r.to_dict(now) for r in self._replicas.values()]
+        return {"replicas": sorted(reps, key=lambda r: r["replica_id"]),
+                "ready": sum(1 for r in reps
+                             if r["state"] == READY and not r["breaker_open"]),
+                "draining": sum(1 for r in reps if r["state"] == DRAINING)}
+
+    def _update_gauges(self):
+        if self.metrics is None:
+            return
+        with self._lock:
+            counts = {s: 0 for s in STATES}
+            for r in self._replicas.values():
+                counts[r.state] = counts.get(r.state, 0) + 1
+        for state, n in counts.items():
+            self.metrics.set_gauge("tpu_fleet_replicas", n,
+                                   labels={"state": state})
+
+
+class ReplicaReporter:
+    """Replica-side fleet client: register on start, heartbeat on an
+    interval with stats from the engine's own debug/metrics surfaces,
+    deregister when the drain completes.
+
+    Runs in serve_main when ``--fleet-router`` is set. ``post_fn(path,
+    payload) -> dict|None`` is injectable for tests; the default POSTs
+    JSON to the router. A router restart answers heartbeats with
+    ``registered: false`` and the reporter re-registers — membership
+    self-heals without operator action."""
+
+    def __init__(self, engine, router_url: str, replica_id: str,
+                 advertise_url: str, pod_name: str = "",
+                 interval_s: float = 2.0, post_fn=None):
+        self.engine = engine
+        self.router_url = router_url.rstrip("/")
+        self.replica_id = replica_id
+        self.advertise_url = advertise_url
+        self.pod_name = pod_name
+        self.interval_s = interval_s
+        self._post = post_fn or self._http_post
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="fleet-reporter", daemon=True)
+
+    def _http_post(self, path: str, payload: dict):
+        import json as _json
+        req = urllib.request.Request(
+            self.router_url + path, data=_json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=5.0) as resp:
+            raw = resp.read()
+            return _json.loads(raw) if raw else None
+
+    def stats(self) -> dict:
+        """The heartbeat payload, from surfaces the engine already exports
+        (debug_snapshot + the TTFT histogram's recent tail)."""
+        snap = self.engine.debug_snapshot()
+        recent = sorted(self.engine.metrics.get_observations(
+            "tpu_serving_ttft_seconds")[-100:])
+        p95 = recent[max(0, int(len(recent) * 0.95) - 1)] if recent else 0.0
+        return {
+            "free_slots": snap["max_slots"] - snap["active_slots"],
+            "active_slots": snap["active_slots"],
+            "max_slots": snap["max_slots"],
+            # pending work the ROUTER/autoscaler should see includes
+            # requests mid-hop (in_transit) and prefilled-but-not-inserted
+            # (ready_queue): a drain-progress check reading queue_depth==0
+            # while a request is between queues would delete the pod under
+            # it
+            "queue_depth": (snap["queue_depth"]
+                            + snap.get("in_transit", 0)
+                            + snap.get("ready_queue", 0)),
+            "max_queue_depth": self.engine.sc.max_queue_depth,
+            "kv_cache_tokens": snap["kv_cache_tokens"],
+            "ttft_p95_s": p95,
+            "draining": self.engine.draining,
+        }
+
+    def register(self):
+        self._post("/fleet/register",
+                   {"replica_id": self.replica_id,
+                    "base_url": self.advertise_url,
+                    "pod_name": self.pod_name})
+
+    def beat_once(self) -> bool:
+        """One heartbeat (re-registering if the router forgot us); returns
+        False once the reporter deregistered (drain complete)."""
+        if self.engine.draining and self.engine.drained:
+            try:
+                self._post("/fleet/deregister",
+                           {"replica_id": self.replica_id})
+            except Exception as e:  # noqa: BLE001 — best-effort goodbye
+                log.warning("fleet: deregister failed: %s", e)
+            return False
+        out = self._post("/fleet/heartbeat",
+                         {"replica_id": self.replica_id,
+                          "stats": self.stats()})
+        if isinstance(out, dict) and out.get("registered") is False:
+            self.register()
+        return True
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                if not self.beat_once():
+                    return
+            except Exception as e:  # noqa: BLE001 — router may be briefly down
+                log.warning("fleet: heartbeat to %s failed: %s",
+                            self.router_url, e)
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> "ReplicaReporter":
+        try:
+            self.register()
+        except Exception as e:  # noqa: BLE001 — the loop keeps retrying
+            log.warning("fleet: initial register failed "
+                        "(heartbeats will retry): %s", e)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5)

@@ -1,0 +1,601 @@
+"""Fleet request router: one front door over many serving replicas.
+
+Speaks the same ``/v1/*`` + ``/generate`` API as serve_main, so clients
+(and OpenAI SDKs) point here unchanged and the fleet scales behind them.
+Per request:
+
+- **pick** a replica: prefix-affinity first (a stable hash of the session
+  id / prompt prefix pins a conversation to the replica holding its
+  prefix cache — rendezvous hashing, so membership churn only remaps the
+  dead replica's keys), falling back to least-loaded (queue + active -
+  free slots, TTFT p95 breaking ties) when the pinned replica is
+  saturated or gone;
+- **forward** with the router's span id in the outbound ``traceparent``,
+  so the engine's ``serving.request`` tree parents under this router's
+  ``fleet.route`` span and one trace_id spans both layers;
+- **fail over**: a 5xx/network failure on an idempotent non-streamed
+  request marks the replica's breaker and retries on the next-best
+  replica (the generation never ran to completion on the corpse, so the
+  retry is safe); per-replica 429s try the next replica too;
+- **admission**: when every routable replica is saturated the router
+  answers 429 + Retry-After itself (serve_main's bounded-latency
+  contract, fleet-wide);
+- **stream passthrough**: SSE/NDJSON bytes relay chunk-by-chunk as they
+  arrive (never buffering the stream); a replica dying mid-stream ends
+  the client's chunked stream CLEANLY (terminator sent, counter bumped)
+  instead of hanging the connection.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import http.client
+import json
+import logging
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from ..cloud.transport import CircuitOpenError, TransportError
+from ..tracing import Tracer, format_traceparent, parse_traceparent
+from .registry import Replica, ReplicaRegistry
+
+log = logging.getLogger(__name__)
+
+# routes forwarded to exactly one replica (the serving API surface)
+_FORWARD_ROUTES = ("/generate", "/v1/completions", "/v1/chat/completions",
+                   "/v1/embeddings")
+# sub-second buckets: routing adds network hops, not decode steps
+_ROUTE_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                  1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+
+@dataclasses.dataclass
+class RouterConfig:
+    port: int = 8090
+    # how many distinct replicas one request may try before giving up
+    max_attempts: int = 3
+    # chars of prompt text / count of prompt tokens hashed for
+    # prefix-affinity when the request has no session id
+    affinity_prefix_chars: int = 64
+    affinity_prefix_tokens: int = 32
+    request_timeout_s: float = 120.0
+    retry_after_s: int = 1
+
+
+def affinity_key_for(path: str, body: dict, prefix_chars: int = 64,
+                     prefix_tokens: int = 32) -> str:
+    """The prefix-affinity key: an explicit session/user id when the
+    client sent one (conversations stay pinned across turns), else the
+    prompt's own prefix (same system prompt -> same replica -> its
+    registered prefix cache keeps hitting). The prefix lengths come from
+    RouterConfig (the router passes its own)."""
+    if not isinstance(body, dict):
+        return ""
+    for field in ("session_id", "user"):
+        v = body.get(field)
+        if isinstance(v, str) and v:
+            return f"sid:{v}"
+    if path == "/v1/chat/completions":
+        msgs = body.get("messages")
+        if isinstance(msgs, list) and msgs and isinstance(msgs[0], dict):
+            head = str(msgs[0].get("content", ""))[:prefix_chars]
+            return f"chat:{head}" if head else ""
+        return ""
+    prompt = body.get("prompt", body.get("tokens", body.get("text")))
+    if isinstance(prompt, str) and prompt:
+        return f"txt:{prompt[:prefix_chars]}"
+    if isinstance(prompt, list) and prompt:
+        head = prompt[:prefix_tokens]
+        return "tok:" + ",".join(str(t) for t in head)
+    return ""
+
+
+class FleetRouter:
+    """Routing policy + forwarding machinery (transport-level); the HTTP
+    handler below is a thin shim over ``forward``/``stream_forward``."""
+
+    def __init__(self, registry: ReplicaRegistry, cfg: RouterConfig = None,
+                 metrics=None, tracer: Optional[Tracer] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.registry = registry
+        self.cfg = cfg or RouterConfig()
+        self.metrics = metrics
+        self.tracer = tracer if tracer is not None else Tracer()
+        self.clock = clock
+        if metrics is not None:
+            self._describe(metrics)
+            # scrape-from-start: the dashboards' series must exist before
+            # the first routed request
+            metrics.incr("tpu_fleet_requests", 0, labels={"outcome": "ok"})
+
+    @staticmethod
+    def _describe(m):
+        m.describe("tpu_fleet_requests",
+                   "requests routed through the fleet front door "
+                   "(labels: outcome=ok|rejected|failed|no_replicas)")
+        m.describe("tpu_fleet_failovers",
+                   "mid-call replica failures retried on the next replica")
+        m.describe("tpu_fleet_stream_aborted",
+                   "streams cleanly truncated by a replica dying mid-stream")
+        m.describe("tpu_fleet_rejected_saturated",
+                   "requests 429-rejected with every replica saturated")
+        m.describe("tpu_fleet_route_seconds",
+                   "router-side request latency (pick + forward + relay)",
+                   buckets=_ROUTE_BUCKETS)
+
+    # -- picking ---------------------------------------------------------------
+
+    @staticmethod
+    def _rendezvous(key: str, replica_id: str) -> int:
+        return int.from_bytes(hashlib.sha256(
+            f"{key}|{replica_id}".encode()).digest()[:8], "big")
+
+    def pick(self, affinity_key: str = "",
+             exclude: frozenset = frozenset()) -> tuple[Optional[Replica], str]:
+        """(replica, reason) — reason names the policy leg that chose it
+        (exported on the fleet.route span for tools/fleet_summary.py)."""
+        candidates = [r for r in self.registry.ready()
+                      if r.replica_id not in exclude]
+        if not candidates:
+            return None, "no_replicas"
+        if affinity_key:
+            pinned = max(candidates,
+                         key=lambda r: self._rendezvous(affinity_key,
+                                                        r.replica_id))
+            if not pinned.stats.saturated:
+                return pinned, "affinity"
+        best = min(candidates,
+                   key=lambda r: (r.stats.load_score, r.stats.ttft_p95_s,
+                                  r.replica_id))
+        return best, "least_loaded"
+
+    def all_saturated(self) -> bool:
+        ready = self.registry.ready()
+        return bool(ready) and all(r.stats.saturated for r in ready)
+
+    def _affinity_key(self, path: str, body: dict) -> str:
+        return affinity_key_for(path, body,
+                                prefix_chars=self.cfg.affinity_prefix_chars,
+                                prefix_tokens=self.cfg.affinity_prefix_tokens)
+
+    # -- tracing ---------------------------------------------------------------
+
+    def trace_ctx(self, inbound_header: Optional[str]) -> dict:
+        """Per-request trace context: the inbound traceparent's trace_id
+        (caller owns the trace) or a fresh one; a router span id minted
+        NOW so the outbound traceparent makes the engine's request tree a
+        CHILD of the router's fleet.route span."""
+        inbound = parse_traceparent(inbound_header)
+        trace_id = inbound[0] if inbound else Tracer.new_trace_id()
+        span_id = Tracer.new_span_id()
+        return {"trace_id": trace_id, "span_id": span_id,
+                "parent_id": inbound[1] if inbound else "",
+                "header": format_traceparent(trace_id, span_id)}
+
+    def _record_route(self, trace: dict, path: str, started_mono: float,
+                      replica_id: str, status: int, reason: str,
+                      attempts: int, streamed: bool):
+        dur = self.clock() - started_mono
+        if self.metrics is not None:
+            self.metrics.observe("tpu_fleet_route_seconds", dur)
+        end = self.tracer.clock()
+        try:
+            self.tracer.record("fleet.route", end - dur, end,
+                               trace_id=trace["trace_id"],
+                               span_id=trace["span_id"],
+                               parent_id=trace["parent_id"],
+                               attrs={"path": path, "replica_id": replica_id,
+                                      "status": status, "reason": reason,
+                                      "attempts": attempts,
+                                      "streamed": streamed})
+        except Exception:  # noqa: BLE001 — tracing must never fail a request
+            log.exception("fleet.route span recording failed")
+
+    def _outcome(self, outcome: str):
+        if self.metrics is not None:
+            self.metrics.incr("tpu_fleet_requests",
+                              labels={"outcome": outcome})
+
+    # -- non-streamed forwarding -----------------------------------------------
+
+    def forward(self, path: str, payload: dict,
+                trace: dict) -> tuple[int, dict, dict]:
+        """Route one idempotent non-streamed request. Returns (status,
+        body, extra response headers). Generation requests are idempotent
+        from the fleet's view — a replica that died mid-call never
+        completed the generation, so re-running it elsewhere double-spends
+        some decode steps but never double-delivers a result."""
+        started = self.clock()
+        headers = {"traceparent": trace["header"]}
+        if self.all_saturated():
+            self._outcome("rejected")
+            if self.metrics is not None:
+                self.metrics.incr("tpu_fleet_rejected_saturated")
+            self._record_route(trace, path, started, "", 429,
+                               "all_saturated", 0, False)
+            return (429, {"error": {"message": "every replica is saturated; "
+                                               "retry later",
+                                    "type": "overloaded_error"}},
+                    {**headers, "Retry-After": str(self.cfg.retry_after_s)})
+        key = self._affinity_key(path, payload)
+        tried: set[str] = set()
+        last: Optional[TransportError] = None
+        reason = "no_replicas"
+        attempts = 0
+        for _ in range(max(1, self.cfg.max_attempts)):
+            replica, reason = self.pick(key, exclude=frozenset(tried))
+            if replica is None:
+                break
+            attempts += 1
+            tried.add(replica.replica_id)
+            try:
+                out = replica.transport.request(
+                    "POST", path, body=payload,
+                    timeout_s=self.cfg.request_timeout_s,
+                    extra_headers={"traceparent": trace["header"]})
+                self._outcome("ok")
+                self._record_route(trace, path, started, replica.replica_id,
+                                   200, reason, attempts, False)
+                return 200, (out if isinstance(out, dict) else {}), headers
+            except CircuitOpenError:
+                # fail-fast skip: no I/O happened, don't count a failover
+                continue
+            except TransportError as e:
+                last = e
+                if e.status == 429:
+                    # THIS replica is full; another may admit (stats lag)
+                    continue
+                if 400 <= e.status < 500:
+                    # deterministic client error: relay verbatim, no failover
+                    self._outcome("rejected")
+                    self._record_route(trace, path, started,
+                                       replica.replica_id, e.status, reason,
+                                       attempts, False)
+                    return e.status, self._error_body(e), headers
+                # network/5xx: the replica is (half-)dead — its breaker
+                # already recorded the failure; try the next-best one
+                if self.metrics is not None:
+                    self.metrics.incr("tpu_fleet_failovers")
+                log.warning("fleet: %s on %s failed (%s); failing over",
+                            path, replica.replica_id, e)
+                continue
+        if last is not None and last.status == 429:
+            self._outcome("rejected")
+            if self.metrics is not None:
+                self.metrics.incr("tpu_fleet_rejected_saturated")
+            self._record_route(trace, path, started, "", 429, "saturated",
+                               attempts, False)
+            return (429, self._error_body(last),
+                    {**headers, "Retry-After": str(self.cfg.retry_after_s)})
+        if attempts == 0:
+            self._outcome("no_replicas")
+            self._record_route(trace, path, started, "", 503, reason, 0,
+                               False)
+            return (503, {"error": {"message": "no ready replicas",
+                                    "type": "overloaded_error"}},
+                    {**headers, "Retry-After": str(self.cfg.retry_after_s)})
+        self._outcome("failed")
+        self._record_route(trace, path, started, "", 502, "exhausted",
+                           attempts, False)
+        return (502, {"error": {"message": f"all {attempts} replica "
+                                           f"attempt(s) failed: {last}",
+                                "type": "server_error"}}, headers)
+
+    @staticmethod
+    def _error_body(e: TransportError) -> dict:
+        try:
+            body = json.loads(e.body) if e.body else None
+        except json.JSONDecodeError:
+            body = None
+        if isinstance(body, dict):
+            return body
+        return {"error": {"message": str(e), "type": "server_error"}}
+
+    # -- streamed forwarding ---------------------------------------------------
+
+    def open_stream(self, path: str, raw_body: bytes,
+                    trace: dict) -> tuple[Optional[Replica], object, object,
+                                          str, int]:
+        """Pick a replica and open the upstream response WITHOUT reading
+        its body. Failover happens only HERE (before any byte reached the
+        client); once the stream is open the relay is committed to this
+        replica. Returns (replica, conn, resp, reason, attempts) — replica
+        None means no stream could be opened (resp carries (status, body,
+        headers) for a plain error response instead)."""
+        key = self._affinity_key(path, self._safe_json(raw_body))
+        tried: set[str] = set()
+        attempts = 0
+        last_err: tuple[int, dict, dict] = (
+            503, {"error": {"message": "no ready replicas",
+                            "type": "overloaded_error"}},
+            {"Retry-After": str(self.cfg.retry_after_s)})
+        for _ in range(max(1, self.cfg.max_attempts)):
+            replica, reason = self.pick(key, exclude=frozenset(tried))
+            if replica is None:
+                break
+            attempts += 1
+            tried.add(replica.replica_id)
+            breaker = replica.transport.breaker
+            if breaker is not None and not breaker.allow():
+                continue
+            parsed = urllib.parse.urlsplit(replica.base_url)
+            conn = http.client.HTTPConnection(
+                parsed.hostname, parsed.port or 80,
+                timeout=self.cfg.request_timeout_s)
+            try:
+                conn.request("POST", path, body=raw_body,
+                             headers={"Content-Type": "application/json",
+                                      "traceparent": trace["header"]})
+                resp = conn.getresponse()
+            except OSError as e:
+                if breaker is not None:
+                    breaker.record_failure()
+                if self.metrics is not None:
+                    self.metrics.incr("tpu_fleet_failovers")
+                log.warning("fleet: stream open to %s failed (%s)",
+                            replica.replica_id, e)
+                conn.close()
+                continue
+            if resp.status >= 500:
+                # the replica's engine is sick; no byte has reached the
+                # client yet, so this is still failover territory — and
+                # the breaker must LEARN (an all-streaming workload would
+                # otherwise pin a corpse forever: success below would keep
+                # its breaker closed and sweep() would never suspect it)
+                if breaker is not None:
+                    breaker.record_failure()
+                if self.metrics is not None:
+                    self.metrics.incr("tpu_fleet_failovers")
+                log.warning("fleet: stream open to %s answered %d; "
+                            "failing over", replica.replica_id, resp.status)
+                last_err = (502, self._read_error_body(resp) or
+                            {"error": {"message": "replica error",
+                                       "type": "server_error"}}, {})
+                conn.close()
+                continue
+            if breaker is not None:
+                breaker.record_success()  # a non-5xx answer: alive
+            if resp.status != 200:
+                body = self._read_error_body(resp)
+                conn.close()
+                if resp.status == 429:
+                    last_err = (429, body or {"error": {
+                        "message": "replica saturated",
+                        "type": "overloaded_error"}},
+                        {"Retry-After": str(self.cfg.retry_after_s)})
+                    continue
+                return None, None, (resp.status, body or
+                                    {"error": {"message": "replica error",
+                                               "type": "server_error"}},
+                                    {}), reason, attempts
+            return replica, conn, resp, reason, attempts
+        return None, None, last_err, "exhausted", attempts
+
+    def _read_error_body(self, resp) -> dict:
+        """Read a non-200 response body tolerating a replica that died
+        after the status line: the error path must never raise (it would
+        crash the handler and defeat the failover it exists for)."""
+        try:
+            return self._safe_json(resp.read())
+        except (http.client.HTTPException, OSError):
+            return {}
+
+    @staticmethod
+    def _safe_json(raw) -> dict:
+        try:
+            out = json.loads(raw) if raw else {}
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return {}
+        return out if isinstance(out, dict) else {}
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    router: FleetRouter = None  # bound in serve_router
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _send(self, status: int, payload, ctype: str = "application/json",
+              extra_headers: Optional[dict] = None):
+        body = payload if isinstance(payload, bytes) \
+            else json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
+        if self.close_connection:
+            self.send_header("Connection", "close")
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_json(self) -> tuple[bytes, dict]:
+        length = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(length) if length else b""
+        try:
+            body = json.loads(raw) if raw else {}
+        except json.JSONDecodeError:
+            return raw, {}
+        return raw, (body if isinstance(body, dict) else {})
+
+    def do_GET(self):
+        rt = self.router
+        url = urllib.parse.urlparse(self.path)
+        if url.path == "/healthz":
+            return self._send(200, b"ok", "text/plain")
+        if url.path == "/readyz":
+            # ready = the router can route SOMEWHERE
+            if rt.registry.ready():
+                return self._send(200, b"ready", "text/plain")
+            return self._send(503, b"no ready replicas", "text/plain")
+        if url.path == "/metrics" and rt.metrics is not None:
+            return self._send(200, rt.metrics.render().encode(),
+                              "text/plain; version=0.0.4")
+        if url.path == "/debug/fleet":
+            return self._send(200, rt.registry.snapshot())
+        if url.path == "/debug/traces":
+            q = urllib.parse.parse_qs(url.query)
+            return self._send(200, rt.tracer.query(
+                (q.get("trace_id") or [""])[0]))
+        if url.path == "/v1/models":
+            # every replica serves the same base model (+ adapters), so
+            # one healthy replica's answer IS the fleet's answer — OpenAI
+            # SDK model discovery must work pointed at the router
+            tried: set = set()
+            for _ in range(max(1, rt.cfg.max_attempts)):
+                rep, _reason = rt.pick("", exclude=frozenset(tried))
+                if rep is None:
+                    break
+                tried.add(rep.replica_id)
+                try:
+                    out = rep.transport.request("GET", "/v1/models",
+                                                timeout_s=10.0)
+                    return self._send(200, out if isinstance(out, dict)
+                                      else {"object": "list", "data": []})
+                except (TransportError, CircuitOpenError) as e:
+                    log.warning("fleet: /v1/models via %s failed: %s",
+                                rep.replica_id, e)
+            return self._send(503, {"error": {"message": "no ready replicas",
+                                              "type": "overloaded_error"}},
+                              extra_headers={"Retry-After":
+                                             str(rt.cfg.retry_after_s)})
+        self._send(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):
+        rt = self.router
+        raw, body = self._read_json()
+        if self.path == "/fleet/register":
+            try:
+                rep = rt.registry.register(str(body.get("replica_id") or ""),
+                                           str(body.get("base_url") or ""),
+                                           str(body.get("pod_name") or ""))
+            except ValueError as e:
+                return self._send(400, {"error": str(e)})
+            return self._send(200, {"registered": rep.replica_id})
+        if self.path == "/fleet/heartbeat":
+            try:
+                ok = rt.registry.heartbeat(str(body.get("replica_id") or ""),
+                                           body.get("stats") or {})
+            except (TypeError, ValueError) as e:
+                return self._send(400, {"error": f"bad stats: {e}"})
+            # registered:false tells the replica to re-register (evicted,
+            # or the router restarted with an empty registry)
+            return self._send(200, {"registered": ok})
+        if self.path == "/fleet/deregister":
+            rt.registry.deregister(str(body.get("replica_id") or ""))
+            return self._send(200, {"ok": True})
+        if self.path == "/prefix":
+            return self._broadcast_prefix(body)
+        if self.path not in _FORWARD_ROUTES:
+            return self._send(404, {"error": f"no route {self.path}"})
+        trace = rt.trace_ctx(self.headers.get("traceparent"))
+        if body.get("stream"):
+            return self._relay_stream(self.path, raw, trace)
+        status, out, headers = rt.forward(self.path, body, trace)
+        return self._send(status, out, extra_headers=headers)
+
+    def _broadcast_prefix(self, body: dict):
+        """Prefix registration fans out to EVERY replica: the affinity
+        hash may route any given conversation anywhere after membership
+        churn, so the shared system prompt must be cached fleet-wide.
+        The fan-out is CONCURRENT — one blackholed replica costs one
+        timeout total, not a serial timeout per replica (a prefill is
+        legitimately slow, so the per-replica budget stays the full
+        request timeout)."""
+        rt = self.router
+        ready = rt.registry.ready()
+        results = {}
+
+        def one(rep):
+            try:
+                rep.transport.request("POST", "/prefix", body=body,
+                                      timeout_s=rt.cfg.request_timeout_s)
+                results[rep.replica_id] = "ok"
+            except (TransportError, CircuitOpenError) as e:
+                results[rep.replica_id] = f"error: {e}"
+
+        threads = [threading.Thread(target=one, args=(rep,), daemon=True)
+                   for rep in ready]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=rt.cfg.request_timeout_s + 5.0)
+        for rep in ready:  # a still-running thread = that replica timed out
+            results.setdefault(rep.replica_id, "error: timed out")
+        status = 200 if results and all(
+            v == "ok" for v in results.values()) else 502
+        if not results:
+            status = 503
+        return self._send(status, {"replicas": results})
+
+    def _relay_stream(self, path: str, raw: bytes, trace: dict):
+        rt = self.router
+        started = rt.clock()
+        replica, conn, resp, reason, attempts = rt.open_stream(path, raw,
+                                                               trace)
+        if replica is None:
+            status, body, headers = resp
+            rt._outcome("rejected" if status in (429, 503) else "failed")
+            rt._record_route(trace, path, started, "", status, reason,
+                             attempts, True)
+            return self._send(status, body,
+                              extra_headers={**headers,
+                                             "traceparent": trace["header"]})
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         resp.getheader("Content-Type",
+                                        "application/octet-stream"))
+        self.send_header("Transfer-Encoding", "chunked")
+        self.send_header("traceparent", trace["header"])
+        self.end_headers()
+        status, outcome = 200, "ok"
+        try:
+            try:
+                while True:
+                    # read1: returns as soon as the replica produced bytes —
+                    # the relay must never buffer the whole stream
+                    chunk = resp.read1(65536)
+                    if not chunk:
+                        break
+                    self.wfile.write(f"{len(chunk):x}\r\n".encode()
+                                     + chunk + b"\r\n")
+                    self.wfile.flush()
+            except (http.client.HTTPException, OSError):
+                # replica died mid-stream: its breaker learns, the client
+                # gets a CLEAN truncated stream (terminator below), and
+                # the counter records it — a half-relayed generation is
+                # not idempotent, so no failover here
+                breaker = replica.transport.breaker
+                if breaker is not None:
+                    breaker.record_failure()
+                if rt.metrics is not None:
+                    rt.metrics.incr("tpu_fleet_stream_aborted")
+                status, outcome = 502, "failed"
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+        except OSError:
+            # OUR client went away mid-relay; nothing to tell it
+            status, outcome = 499, "failed"
+        finally:
+            conn.close()
+        rt._outcome(outcome)
+        rt._record_route(trace, path, started, replica.replica_id, status,
+                         reason, attempts, True)
+
+
+def serve_router(router: FleetRouter, port: Optional[int] = None
+                 ) -> ThreadingHTTPServer:
+    handler = type("BoundRouterHandler", (_RouterHandler,),
+                   {"router": router})
+    httpd = ThreadingHTTPServer(
+        ("0.0.0.0", router.cfg.port if port is None else port), handler)
+    httpd.daemon_threads = True
+    thread = threading.Thread(target=httpd.serve_forever,
+                              name="fleet-router", daemon=True)
+    thread.start()
+    return httpd

@@ -1,0 +1,158 @@
+"""Fleet router entrypoint: registry + front door (+ optional autoscaler).
+
+Run: python -m k8s_runpod_kubelet_tpu.fleet.router_main \
+        --port 8090 --min-replicas 1 --max-replicas 4
+
+Replicas point at it with ``serve_main --fleet-router http://router:8090
+--fleet-advertise http://$(POD_IP):8000`` and self-register; the router
+then load-balances ``/v1/*`` + ``/generate`` across them. With
+``--autoscale`` (and kube credentials) the SLO control loop creates and
+drains serving pods against the virtual TPU node.
+
+Every knob is also a config field (fleet_* in config.py) with the
+TPU_FLEET_* env vars, same precedence as the kubelet: flags > env > file
+> defaults.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import logging
+import sys
+import threading
+
+from .. import config as config_mod
+from ..metrics import Metrics
+from ..tracing import Tracer
+from .autoscaler import AutoscalerConfig, FleetAutoscaler, KubePodScaler
+from .registry import ReplicaRegistry
+from .router import FleetRouter, RouterConfig, serve_router
+
+log = logging.getLogger("fleet-router")
+
+
+def parse_flags(argv):
+    p = argparse.ArgumentParser("tpu-fleet-router")
+    p.add_argument("--port", dest="fleet_router_port", type=int, default=None)
+    p.add_argument("--heartbeat-interval", dest="fleet_heartbeat_interval_s",
+                   type=float, default=None,
+                   help="how often replicas heartbeat (informs the timeout)")
+    p.add_argument("--heartbeat-timeout", dest="fleet_heartbeat_timeout_s",
+                   type=float, default=None,
+                   help="heartbeats older than this mark a replica suspect "
+                        "(probed, then evicted)")
+    p.add_argument("--ttft-slo", dest="fleet_ttft_slo_s", type=float,
+                   default=None, help="scale up when any replica's recent "
+                                      "TTFT p95 exceeds this many seconds")
+    p.add_argument("--target-queue-per-replica",
+                   dest="fleet_target_queue_per_replica", type=float,
+                   default=None)
+    p.add_argument("--min-replicas", dest="fleet_min_replicas", type=int,
+                   default=None)
+    p.add_argument("--max-replicas", dest="fleet_max_replicas", type=int,
+                   default=None)
+    p.add_argument("--scale-up-cooldown", dest="fleet_scale_up_cooldown_s",
+                   type=float, default=None)
+    p.add_argument("--scale-down-cooldown",
+                   dest="fleet_scale_down_cooldown_s", type=float,
+                   default=None)
+    p.add_argument("--autoscale", action="store_true",
+                   help="run the SLO autoscaler (needs kube credentials); "
+                        "off = routing + registry only")
+    p.add_argument("--node-name", dest="node_name", default=None,
+                   help="virtual node serving pods are created on")
+    p.add_argument("--namespace", default=None)
+    p.add_argument("--kubeconfig", default=None)
+    p.add_argument("--serving-image", default="",
+                   help="image for autoscaler-created serving pods")
+    p.add_argument("--serving-chips", type=int, default=8,
+                   help="google.com/tpu chips each serving pod requests")
+    p.add_argument("--provider-config", dest="provider_config", default=None)
+    p.add_argument("--trace-export", dest="trace_export_path", default=None,
+                   help="append fleet.route/fleet.scale spans to this JSONL "
+                        "(render with tools/fleet_summary.py)")
+    p.add_argument("--log-level", dest="log_level", default=None)
+    return p.parse_args(argv)
+
+
+def build(cfg: config_mod.Config, kube=None, autoscale: bool = False,
+          serving_image: str = "", serving_chips: int = 8):
+    """Wire registry + router (+ autoscaler); injectable kube for tests."""
+    metrics = Metrics()
+    tracer = Tracer(max_spans=cfg.trace_ring_size,
+                    export_path=cfg.trace_export_path)
+    registry = ReplicaRegistry(
+        metrics=metrics, tracer=tracer,
+        heartbeat_timeout_s=cfg.fleet_heartbeat_timeout_s,
+        breaker_failure_threshold=cfg.breaker_failure_threshold,
+        breaker_reset_s=cfg.breaker_reset_s)
+    router = FleetRouter(registry, RouterConfig(port=cfg.fleet_router_port),
+                         metrics=metrics, tracer=tracer)
+    autoscaler = None
+    if autoscale:
+        from ..kube import RealKubeClient
+        kube = kube or RealKubeClient.from_env(cfg.kubeconfig)
+        scaler = KubePodScaler(kube, cfg.node_name, cfg.namespace,
+                               chips=serving_chips, image=serving_image)
+        autoscaler = FleetAutoscaler(
+            registry, scaler,
+            AutoscalerConfig(
+                min_replicas=cfg.fleet_min_replicas,
+                max_replicas=cfg.fleet_max_replicas,
+                target_queue_per_replica=cfg.fleet_target_queue_per_replica,
+                ttft_slo_s=cfg.fleet_ttft_slo_s,
+                scale_up_cooldown_s=cfg.fleet_scale_up_cooldown_s,
+                scale_down_cooldown_s=cfg.fleet_scale_down_cooldown_s),
+            metrics=metrics, tracer=tracer)
+    return registry, router, autoscaler
+
+
+def main(argv=None) -> int:
+    args = parse_flags(argv if argv is not None else sys.argv[1:])
+    known = {f.name for f in dataclasses.fields(config_mod.Config)}
+    overrides = {k: v for k, v in vars(args).items()
+                 if v is not None and k in known}
+    cfg = config_mod.load(file_path=args.provider_config, overrides=overrides)
+    logging.basicConfig(level=getattr(logging, cfg.log_level.upper(),
+                                      logging.INFO))
+    registry, router, autoscaler = build(
+        cfg, autoscale=args.autoscale, serving_image=args.serving_image,
+        serving_chips=args.serving_chips)
+    httpd = serve_router(router)
+    log.info("fleet router on :%d (/v1/*, /generate, /fleet/*, /metrics, "
+             "/debug/fleet)", httpd.server_address[1])
+
+    stop = threading.Event()
+    # eviction sweep at the heartbeat cadence: a dead replica is suspect
+    # after one missed timeout window, gone after its failed probe
+    def sweep_loop():
+        while not stop.is_set():
+            try:
+                registry.sweep()
+            except Exception:  # noqa: BLE001 — the sweep must survive bad probes
+                log.exception("registry sweep failed")
+            stop.wait(cfg.fleet_heartbeat_interval_s)
+
+    threading.Thread(target=sweep_loop, name="fleet-sweep",
+                     daemon=True).start()
+    if autoscaler is not None:
+        autoscaler.run(interval_s=cfg.fleet_heartbeat_interval_s)
+        log.info("autoscaler on: %d..%d replicas, queue target %.1f, "
+                 "TTFT SLO %.2fs", cfg.fleet_min_replicas,
+                 cfg.fleet_max_replicas, cfg.fleet_target_queue_per_replica,
+                 cfg.fleet_ttft_slo_s)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    stop.set()
+    if autoscaler is not None:
+        autoscaler.stop()
+    httpd.shutdown()
+    router.tracer.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

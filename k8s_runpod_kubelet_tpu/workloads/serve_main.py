@@ -29,7 +29,11 @@ The pod command for autoscaled inference. Endpoints:
                    plus the SLO histograms (tpu_serving_ttft_seconds,
                    tpu_serving_inter_token_seconds, queue-wait, batch
                    utilization, KV-cache occupancy)
-  GET  /healthz    liveness
+  GET  /healthz    liveness (200 while the engine thread lives, even
+                   draining); GET /readyz is the ROUTABILITY probe (503
+                   while draining) — see do_GET for the full contract
+  POST /drain      graceful drain (fleet scale-down): stop admitting,
+                   finish in-flight, then the fleet reporter deregisters
   GET  /debug/traces  recent request span trees as JSON (?trace_id= filters
                    to the trace a traceparent header named); the generation
                    routes parse inbound W3C ``traceparent`` headers and
@@ -107,10 +111,15 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _overloaded(self, e, openai: bool = False):
         """429 + Retry-After for an EngineOverloaded admission rejection —
-        the bounded-latency contract's client-visible half."""
+        the bounded-latency contract's client-visible half. An
+        EngineDraining rejection rides the same shape at 503 (retryable
+        against ANOTHER replica — the fleet router already stopped
+        routing here, this answers clients that connected directly)."""
+        from .serving import EngineDraining
+        status = 503 if isinstance(e, EngineDraining) else 429
         err = ({"error": {"message": str(e), "type": "overloaded_error"}}
                if openai else {"error": str(e)})
-        return self._send(429, err, extra_headers={"Retry-After": "1"})
+        return self._send(status, err, extra_headers={"Retry-After": "1"})
 
     def do_GET(self):
         if self.path in ("/healthz", "/metrics"):
@@ -121,9 +130,25 @@ class _Handler(BaseHTTPRequestHandler):
             # work by contract); scrapes reconnect cheaply
             self.close_connection = True
         if self.path == "/healthz":
+            # STATUS CONTRACT (drain and health must not fight):
+            #   /healthz = LIVENESS (kubelet restarts on 503): 200 while
+            #     the engine thread lives — a draining engine is healthy
+            #     (body says "draining" for humans), killing it would drop
+            #     its in-flight requests; 503 only when the thread died.
+            #   /readyz = ROUTABILITY (the fleet router's probe): 503
+            #     while draining or dead, 200 only when admitting.
             if not self.engine.alive:
                 return self._send(503, b"engine thread dead", "text/plain")
+            if getattr(self.engine, "draining", False):
+                return self._send(200, b"draining", "text/plain")
             return self._send(200, b"ok", "text/plain")
+        if self.path == "/readyz":
+            self.close_connection = True
+            if not self.engine.alive:
+                return self._send(503, b"engine thread dead", "text/plain")
+            if getattr(self.engine, "draining", False):
+                return self._send(503, b"draining", "text/plain")
+            return self._send(200, b"ready", "text/plain")
         if self.path == "/v1/models":
             # OpenAI model listing: the base model plus registered adapters
             import time as _time
@@ -192,6 +217,17 @@ class _Handler(BaseHTTPRequestHandler):
         return text, False
 
     def do_POST(self):
+        if self.path == "/drain":
+            # graceful scale-down (fleet autoscaler contract): stop
+            # admitting, finish in-flight. Idempotent; progress is
+            # observable via /readyz (503 once draining) and
+            # /debug/engine ("drained": true when empty).
+            self._read_json()  # drain the body: unread bytes would be
+            # parsed as the NEXT request line on this keep-alive connection
+            self.engine.drain()
+            return self._send(200, {"draining": True,
+                                    "queue_depth": self.engine.queue_depth,
+                                    "active_slots": self.engine.active_slots})
         if self.path == "/v1/completions":
             return self._openai_completion(chat=False)
         if self.path == "/v1/chat/completions":
@@ -284,8 +320,8 @@ class _Handler(BaseHTTPRequestHandler):
         except ValueError as e:
             return self._send(400, {"error": str(e)})
         except Exception as e:  # engine crash: JSON 500, not a dropped socket
-            from .serving import EngineOverloaded
-            if isinstance(e, EngineOverloaded):
+            from .serving import EngineDraining, EngineOverloaded
+            if isinstance(e, (EngineOverloaded, EngineDraining)):
                 return self._overloaded(e)
             return self._send(500, {"error": str(e)})
         if self.tokenizer is not None:
@@ -324,12 +360,14 @@ class _Handler(BaseHTTPRequestHandler):
 
         fut = self.engine.submit(tokens, on_token=on_token, **kw)
         if fut.done() and fut.exception() is not None:
-            from .serving import EngineOverloaded
+            from .serving import EngineDraining, EngineOverloaded
             exc = fut.exception()
-            if isinstance(exc, EngineOverloaded):
+            if isinstance(exc, (EngineOverloaded, EngineDraining)):
                 overloaded = fmt.get("overloaded", fmt["badreq"])
-                return self._send(429, overloaded(str(exc)),
-                                  extra_headers={"Retry-After": "1"})
+                return self._send(
+                    503 if isinstance(exc, EngineDraining) else 429,
+                    overloaded(str(exc)),
+                    extra_headers={"Retry-After": "1"})
             return self._send(400, fmt["badreq"](str(exc)))
         fut.add_done_callback(lambda f: q.put(("end", f)))
         self.send_response(200)
@@ -697,8 +735,8 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:  # engine crash (e.g. recovery-path RuntimeError)
             for f in futs:
                 f.cancel()
-            from .serving import EngineOverloaded
-            if isinstance(e, EngineOverloaded):
+            from .serving import EngineDraining, EngineOverloaded
+            if isinstance(e, (EngineOverloaded, EngineDraining)):
                 return self._overloaded(e, openai=True)
             return self._send(500, {"error": {"message": str(e),
                                               "type": "server_error"}})
@@ -969,6 +1007,19 @@ def main(argv=None) -> int:
                    help="append finished request spans to this JSONL file "
                         "(render with tools/trace_summary.py); empty = "
                         "in-memory ring only (/debug/traces)")
+    p.add_argument("--fleet-router", default="",
+                   help="fleet router URL (fleet/router_main.py): register "
+                        "this replica and heartbeat load stats so the "
+                        "router balances traffic here; empty = standalone")
+    p.add_argument("--fleet-advertise", default="",
+                   help="URL the ROUTER should reach this replica at "
+                        "(e.g. http://$POD_IP:8000); defaults to "
+                        "http://<hostname>:<port>")
+    p.add_argument("--fleet-replica-id", default="",
+                   help="stable replica identity; defaults to the hostname "
+                        "(= pod name in k8s)")
+    p.add_argument("--fleet-heartbeat-interval", type=float, default=2.0,
+                   help="seconds between heartbeats to the fleet router")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -1066,10 +1117,31 @@ def main(argv=None) -> int:
                   allow_adapters=args.dynamic_adapters,
                   max_connections=args.max_connections)
     log.info("serving on :%d (POST /generate, GET /metrics)", args.port)
+    reporter = None
+    if args.fleet_router:
+        import socket
+        from ..fleet.registry import ReplicaReporter
+        host = socket.gethostname()
+        reporter = ReplicaReporter(
+            engine, args.fleet_router,
+            replica_id=args.fleet_replica_id or host,
+            advertise_url=(args.fleet_advertise
+                           or f"http://{host}:{args.port}"),
+            # pod_name is the autoscaler's DELETE handle and must be the
+            # real k8s pod name (= hostname), NOT the free-form replica
+            # id: a custom --fleet-replica-id would otherwise make
+            # scale-down delete a nonexistent pod (404 swallowed) and
+            # leak the real one
+            pod_name=host,
+            interval_s=args.fleet_heartbeat_interval).start()
+        log.info("fleet: reporting to %s as %s", args.fleet_router,
+                 reporter.replica_id)
     try:
         threading.Event().wait()
     except KeyboardInterrupt:
         pass
+    if reporter is not None:
+        reporter.stop()
     httpd.shutdown()
     engine.stop()
     engine.tracer.close()  # flush the JSONL export queue (daemon writer)

@@ -128,6 +128,13 @@ class EngineOverloaded(RuntimeError):
     """Request rejected at admission: queue is at max_queue_depth."""
 
 
+class EngineDraining(RuntimeError):
+    """Request rejected at admission: the engine is draining (fleet
+    scale-down). In-flight and already-queued requests still finish; the
+    HTTP layer maps this to 503 + Retry-After so clients re-resolve to
+    another replica."""
+
+
 @dataclasses.dataclass
 class Request:
     prompt: list[int]
@@ -452,6 +459,7 @@ class ServingEngine:
         self.metrics.set_gauge("tpu_serving_queue_depth", 0)
         self.metrics.set_gauge("tpu_serving_active_slots", 0)
         self.metrics.set_gauge("tpu_serving_kv_cache_tokens", 0)
+        self.metrics.set_gauge("tpu_serving_draining", 0)
         # registered prompt prefixes, longest first; read by the prefill
         # thread, written by callers. Each entry holds per-ADAPTER KV
         # variants (adapter KV differs from base KV for the same tokens),
@@ -472,6 +480,26 @@ class ServingEngine:
         # HTTP handler threads — without a lock N racing submits could all
         # pass the check and breach the bound by N-1
         self._admit_lock = threading.Lock()
+        # drain (fleet scale-down): once set, admission rejects with
+        # EngineDraining while everything already accepted runs to
+        # completion. Checked under _admit_lock so drain() is atomic
+        # against racing submits — nothing slips in after the flag flips.
+        self._draining = threading.Event()
+        # requests IN TRANSIT between containers (popped from _queue but
+        # still prefilling; popped from _ready but not yet slot.request):
+        # invisible to queue_depth/_ready.qsize()/active_slots, so
+        # ``drained`` reading only those could report empty while a live
+        # request is mid-hop — and the fleet would delete the pod under
+        # it. Every queue->transit transition happens under this lock, so
+        # ``drained`` reads {queues, transit} atomically.
+        self._transit_lock = threading.Lock()
+        self._transit = 0
+        # submit wake-up for the prefill loop: the transit-safe pop is a
+        # get_nowait (the lock must never be held across a blocking get),
+        # so without this event an idle engine would poll — up to 50ms of
+        # pure wait added to every quiet-replica TTFT. set() on every put;
+        # a stale set costs one extra get_nowait, never a missed request.
+        self._queue_event = threading.Event()
         # prefill thread -> engine thread: (request, single cache, first token)
         self._ready: "queue.Queue[tuple[Request, Params, int]]" = \
             queue.Queue(maxsize=sc.slots)
@@ -573,6 +601,10 @@ class ServingEngine:
                    "requests admitted into a decode slot")
         m.describe("tpu_serving_admission_rejected",
                    "submits rejected at max_queue_depth (mapped to HTTP 429)")
+        m.describe("tpu_serving_drain_rejected",
+                   "submits rejected while draining (mapped to HTTP 503)")
+        m.describe("tpu_serving_draining",
+                   "1 while the engine is draining (fleet scale-down)")
         m.describe("tpu_serving_cancelled",
                    "requests cancelled by their caller (timeout/disconnect)")
         m.describe("tpu_serving_stream_cancelled",
@@ -813,6 +845,12 @@ class ServingEngine:
             return req
         with self._admit_lock:  # atomic check+put: racing submits must not
             # breach the bound by one each
+            if self._draining.is_set():
+                self.metrics.incr("tpu_serving_drain_rejected")
+                f = Future()
+                f.set_exception(EngineDraining(
+                    "engine is draining; submit to another replica"))
+                return f
             if (self.sc.max_queue_depth
                     and self.queue_depth >= self.sc.max_queue_depth):
                 # admission bound (bounded-latency contract): the client
@@ -824,6 +862,7 @@ class ServingEngine:
                     f"{self.sc.max_queue_depth}; retry later"))
                 return f
             self._queue.put(req)
+            self._queue_event.set()
         self.metrics.set_gauge("tpu_serving_queue_depth", self.queue_depth)
         return req.future
 
@@ -859,6 +898,16 @@ class ServingEngine:
         head = reqs[0]
         head.fanout = reqs[1:]
         with self._admit_lock:  # atomic check+put, like submit()
+            if self._draining.is_set():
+                self.metrics.incr("tpu_serving_drain_rejected")
+                exc = EngineDraining(
+                    "engine is draining; submit to another replica")
+                fs = []
+                for _ in range(n):
+                    f = Future()
+                    f.set_exception(exc)
+                    fs.append(f)
+                return fs
             if self.sc.max_queue_depth and (
                     self.queue_depth + n > self.sc.max_queue_depth):
                 # group admission counts ALL members against the bound
@@ -875,8 +924,42 @@ class ServingEngine:
             with self._fanout_lock:
                 self._queued_fanout += len(head.fanout)
             self._queue.put(head)
+            self._queue_event.set()
         self.metrics.set_gauge("tpu_serving_queue_depth", self.queue_depth)
         return [r.future for r in reqs]
+
+    def drain(self):
+        """Begin a graceful drain (fleet scale-down contract): stop
+        admitting new requests (submits resolve to EngineDraining ->
+        HTTP 503), finish everything in flight or already queued.
+        Idempotent. ``drained`` flips True when the last request
+        completes; the fleet reporter then deregisters and the autoscaler
+        deletes the pod — no request is ever dropped by a scale-down."""
+        if not self._draining.is_set():
+            log.info("serving engine draining: %d queued, %d active",
+                     self.queue_depth, self.active_slots)
+        with self._admit_lock:  # atomic vs racing submits (see submit())
+            self._draining.set()
+        self.metrics.set_gauge("tpu_serving_draining", 1)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    @property
+    def drained(self) -> bool:
+        """Drain complete: nothing queued, in transit, prefilled, or
+        decoding. The transit count closes the mid-hop windows: a request
+        popped from a queue is counted as transit BEFORE the pop (same
+        lock), and a slot's ``request`` is set before its transit count
+        drops — so at ``transit == 0 and ready == 0``, anything admitted
+        is visible in active_slots."""
+        if not self._draining.is_set():
+            return False
+        with self._transit_lock:
+            if self._transit or self.queue_depth or self._ready.qsize():
+                return False
+        return self.active_slots == 0
 
     @property
     def alive(self) -> bool:
@@ -933,11 +1016,17 @@ class ServingEngine:
         return {
             "model": self.cfg.name,
             "alive": self.alive,
+            "draining": self.draining,
+            "drained": self.drained,
             "slots": slots,
             "active_slots": sum(1 for s in slots if s["state"] != "free"),
             "max_slots": self.sc.slots,
             "queue_depth": self.queue_depth,
             "ready_queue": self._ready.qsize(),
+            # requests mid-hop between queues/slots (see drained): the
+            # fleet reporter folds this into its queue_depth so a remote
+            # drain-progress check can't see "empty" during a hop
+            "in_transit": self._transit,
             "kv_cache_tokens": kv_tokens,
             "cache_len": self.sc.cache_len,
             "prefixes": prefixes,
@@ -1256,72 +1345,95 @@ class ServingEngine:
         The bounded ready queue provides backpressure so at most ``slots``
         prefilled caches are in flight."""
         while not self._stop.is_set():
-            try:
-                req = self._queue.get(timeout=0.05)
-            except queue.Empty:
+            # pop + transit-count under one lock (get_nowait, not a blocking
+            # get: the lock must never be held while waiting) so `drained`
+            # can never observe the request in neither place
+            with self._transit_lock:
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    req = None
+                else:
+                    self._transit += 1
+            if req is None:
+                # wait for a submit's set() (immediate wake), clear, then
+                # loop — the pop-first ordering above means a put racing
+                # the clear is still found on the next pass. The timeout
+                # is only a liveness backstop for the stop flag.
+                self._queue_event.wait(0.05)
+                self._queue_event.clear()
                 continue
-            self.metrics.set_gauge("tpu_serving_queue_depth", self.queue_depth)
-            members = [req] + list(req.fanout or [])
-            with self._fanout_lock:
-                self._queued_fanout -= len(members) - 1
-            live = [r for r in members if not r.future.cancelled()]
-            self.metrics.incr("tpu_serving_cancelled",
-                              len(members) - len(live))
-            if not live:
-                continue  # every caller gave up while queued
-            dequeued = time.perf_counter()
-            for r in live:
-                r.dequeued_at = dequeued
-                self.metrics.observe("tpu_serving_queue_wait_seconds",
-                                     dequeued - r.submitted_at)
             try:
-                last_logits, single = self._prefill_tokens(req.prompt,
-                                                           req.adapter_id)
-                prefill_done = time.perf_counter()
-                for r in live:
-                    r.prefill_done_at = prefill_done
-                # one prefill, one ready entry PER live member: each samples
-                # its own first token from the shared last-position logits
-                entries = []
-                for r in live:
-                    keys = self._row_keys(jnp.asarray([r.seed], jnp.uint32),
-                                          jnp.asarray([0], jnp.int32))
-                    row_logits = last_logits
-                    if r.logit_bias:
-                        brow = _bias_row(r.logit_bias, self.cfg.vocab_size)
-                        row_logits = (row_logits.astype(jnp.float32)
-                                      + jnp.asarray(brow)[None, :])
-                    # penalties: OpenAI's published formula counts tokens
-                    # SAMPLED DURING GENERATION only (vLLM likewise) — at
-                    # the first token nothing has been generated, so no
-                    # penalty applies here; _admit seeds the slot's counts
-                    # from the first token alone (ADVICE r4: prompt-seeded
-                    # counts penalized long-prompt requests on an endpoint
-                    # advertised as OpenAI-compatible)
-                    first = int(_sample(row_logits, keys, [r.temperature],
-                                        [r.top_k], [r.top_p])[0])
-                    first_lp = None
-                    if r.logprobs:
-                        # from the distribution actually sampled (biased
-                        # when logit_bias is set; NEVER penalized — counts
-                        # cover generated tokens only and none exist yet)
-                        first_lp = float(jax.nn.log_softmax(
-                            row_logits[0].astype(jnp.float32))[first])
-                    entries.append((r, single, first, first_lp))
-            except Exception as exc:  # noqa: BLE001 — poisoned prompt only
-                log.exception("prefill of %s failed", req.rid)
-                self.metrics.incr("tpu_serving_prefill_errors")
-                for r in live:
-                    _fail_future(r.future, exc)
-                continue
-            for entry in entries:
-                while not self._stop.is_set():
-                    try:
-                        self._ready.put(entry, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
+                self._prefill_one(req)
+            finally:
+                with self._transit_lock:
+                    self._transit -= 1
 
+    def _prefill_one(self, req: Request):
+        """One dequeued request (plus fanout members): run the prefill
+        and hand (request, cache, first token) entries to the engine.
+        Runs with the transit count held by _prefill_loop."""
+        self.metrics.set_gauge("tpu_serving_queue_depth", self.queue_depth)
+        members = [req] + list(req.fanout or [])
+        with self._fanout_lock:
+            self._queued_fanout -= len(members) - 1
+        live = [r for r in members if not r.future.cancelled()]
+        self.metrics.incr("tpu_serving_cancelled",
+                          len(members) - len(live))
+        if not live:
+            return  # every caller gave up while queued
+        dequeued = time.perf_counter()
+        for r in live:
+            r.dequeued_at = dequeued
+            self.metrics.observe("tpu_serving_queue_wait_seconds",
+                                 dequeued - r.submitted_at)
+        try:
+            last_logits, single = self._prefill_tokens(req.prompt,
+                                                       req.adapter_id)
+            prefill_done = time.perf_counter()
+            for r in live:
+                r.prefill_done_at = prefill_done
+            # one prefill, one ready entry PER live member: each samples
+            # its own first token from the shared last-position logits
+            entries = []
+            for r in live:
+                keys = self._row_keys(jnp.asarray([r.seed], jnp.uint32),
+                                      jnp.asarray([0], jnp.int32))
+                row_logits = last_logits
+                if r.logit_bias:
+                    brow = _bias_row(r.logit_bias, self.cfg.vocab_size)
+                    row_logits = (row_logits.astype(jnp.float32)
+                                  + jnp.asarray(brow)[None, :])
+                # penalties: OpenAI's published formula counts tokens
+                # SAMPLED DURING GENERATION only (vLLM likewise) — at
+                # the first token nothing has been generated, so no
+                # penalty applies here; _admit seeds the slot's counts
+                # from the first token alone (ADVICE r4: prompt-seeded
+                # counts penalized long-prompt requests on an endpoint
+                # advertised as OpenAI-compatible)
+                first = int(_sample(row_logits, keys, [r.temperature],
+                                    [r.top_k], [r.top_p])[0])
+                first_lp = None
+                if r.logprobs:
+                    # from the distribution actually sampled (biased
+                    # when logit_bias is set; NEVER penalized — counts
+                    # cover generated tokens only and none exist yet)
+                    first_lp = float(jax.nn.log_softmax(
+                        row_logits[0].astype(jnp.float32))[first])
+                entries.append((r, single, first, first_lp))
+        except Exception as exc:  # noqa: BLE001 — poisoned prompt only
+            log.exception("prefill of %s failed", req.rid)
+            self.metrics.incr("tpu_serving_prefill_errors")
+            for r in live:
+                _fail_future(r.future, exc)
+            return
+        for entry in entries:
+            while not self._stop.is_set():
+                try:
+                    self._ready.put(entry, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
     def _admit(self) -> bool:
         """Insert ready-made prefilled caches into free slots (cheap donated
         update — the engine thread never runs a prefill itself)."""
@@ -1329,70 +1441,86 @@ class ServingEngine:
         for slot_id, slot in enumerate(self._slots):
             if slot.request is not None:
                 continue
+            # pop + transit-count under one lock (see drained): between
+            # this pop and slot.request below the request is in neither a
+            # queue nor a slot
+            with self._transit_lock:
+                try:
+                    req, single, first, first_lp = self._ready.get_nowait()
+                except queue.Empty:
+                    break
+                self._transit += 1
             try:
-                req, single, first, first_lp = self._ready.get_nowait()
-            except queue.Empty:
-                break
-            self._cache = self._insert(self._cache, single,
-                                       jnp.asarray(slot_id, jnp.int32))
-            self._tokens = self._tokens.at[slot_id].set(first)
-            self._slot_adapter[slot_id] = req.adapter_id
-            self._slot_seed[slot_id] = req.seed
-            self._slot_draws[slot_id] = 1  # draw 0 was the prefill token
-            if _penalized(req):
-                # counts cover GENERATED tokens only (OpenAI/vLLM
-                # semantics): the slot starts from just the first sampled
-                # token — the prompt never contributes
-                if self._tok_counts is None:
-                    self._tok_counts = jnp.zeros(
-                        (self.sc.slots, self.cfg.vocab_size), jnp.int32)
-                row = np.zeros((self.cfg.vocab_size,), np.int32)
-                row[first] += 1
-                self._tok_counts = _set_count_row(
-                    self._tok_counts, jnp.asarray(slot_id),
-                    jnp.asarray(row))
-            elif self._tok_counts is not None:
-                # a stale penalized row must not leak into this request
-                self._tok_counts = _set_count_row(
-                    self._tok_counts, jnp.asarray(slot_id),
-                    jnp.zeros((self.cfg.vocab_size,), jnp.int32))
-            if req.logit_bias:
-                if self._logit_bias is None:
-                    self._logit_bias = jnp.zeros(
-                        (self.sc.slots, self.cfg.vocab_size), jnp.float32)
-                self._logit_bias = _set_count_row(
-                    self._logit_bias, jnp.asarray(slot_id),
-                    jnp.asarray(_bias_row(req.logit_bias,
-                                          self.cfg.vocab_size)))
-            elif self._logit_bias is not None:
-                self._logit_bias = _set_count_row(
-                    self._logit_bias, jnp.asarray(slot_id),
-                    jnp.zeros((self.cfg.vocab_size,), jnp.float32))
-            slot.request = req
-            slot.generated = [first]
-            slot.logprobs = [first_lp] if first_lp is not None else []
-            slot.remaining = req.max_new_tokens - 1
-            slot.last_token = first
-            slot.bigram_index = {}
-            slot.indexed_upto = 0
-            slot.stop_tail = []
-            slot.stop_tail_upto = 0
-            # the first token becomes caller-visible HERE (the prefill
-            # thread sampled it, but _emit below is when it streams), so
-            # this is the honest TTFT instant
-            now = time.perf_counter()
-            req.first_token_at = now
-            slot.last_emit_at = now
-            self.metrics.observe("tpu_serving_ttft_seconds",
-                                 now - req.submitted_at)
-            self._emit(slot, first)
+                self._admit_into_slot(slot_id, slot, req, single, first,
+                                      first_lp)
+            finally:
+                with self._transit_lock:
+                    self._transit -= 1
             admitted = True
-            self.metrics.incr("tpu_serving_admitted")
             if self._finished(slot):
                 self._complete(slot_id, slot)
         self.metrics.set_gauge("tpu_serving_active_slots", self.active_slots)
         self._update_kv_gauge()
         return admitted
+
+    def _admit_into_slot(self, slot_id: int, slot: _Slot, req: Request,
+                     single: Params, first: int, first_lp):
+        """Insert one prefilled cache into a free slot; runs with the
+        transit count held by _admit."""
+        self._cache = self._insert(self._cache, single,
+                                   jnp.asarray(slot_id, jnp.int32))
+        self._tokens = self._tokens.at[slot_id].set(first)
+        self._slot_adapter[slot_id] = req.adapter_id
+        self._slot_seed[slot_id] = req.seed
+        self._slot_draws[slot_id] = 1  # draw 0 was the prefill token
+        if _penalized(req):
+            # counts cover GENERATED tokens only (OpenAI/vLLM
+            # semantics): the slot starts from just the first sampled
+            # token — the prompt never contributes
+            if self._tok_counts is None:
+                self._tok_counts = jnp.zeros(
+                    (self.sc.slots, self.cfg.vocab_size), jnp.int32)
+            row = np.zeros((self.cfg.vocab_size,), np.int32)
+            row[first] += 1
+            self._tok_counts = _set_count_row(
+                self._tok_counts, jnp.asarray(slot_id),
+                jnp.asarray(row))
+        elif self._tok_counts is not None:
+            # a stale penalized row must not leak into this request
+            self._tok_counts = _set_count_row(
+                self._tok_counts, jnp.asarray(slot_id),
+                jnp.zeros((self.cfg.vocab_size,), jnp.int32))
+        if req.logit_bias:
+            if self._logit_bias is None:
+                self._logit_bias = jnp.zeros(
+                    (self.sc.slots, self.cfg.vocab_size), jnp.float32)
+            self._logit_bias = _set_count_row(
+                self._logit_bias, jnp.asarray(slot_id),
+                jnp.asarray(_bias_row(req.logit_bias,
+                                      self.cfg.vocab_size)))
+        elif self._logit_bias is not None:
+            self._logit_bias = _set_count_row(
+                self._logit_bias, jnp.asarray(slot_id),
+                jnp.zeros((self.cfg.vocab_size,), jnp.float32))
+        slot.request = req
+        slot.generated = [first]
+        slot.logprobs = [first_lp] if first_lp is not None else []
+        slot.remaining = req.max_new_tokens - 1
+        slot.last_token = first
+        slot.bigram_index = {}
+        slot.indexed_upto = 0
+        slot.stop_tail = []
+        slot.stop_tail_upto = 0
+        # the first token becomes caller-visible HERE (the prefill
+        # thread sampled it, but _emit below is when it streams), so
+        # this is the honest TTFT instant
+        now = time.perf_counter()
+        req.first_token_at = now
+        slot.last_emit_at = now
+        self.metrics.observe("tpu_serving_ttft_seconds",
+                             now - req.submitted_at)
+        self._emit(slot, first)
+        self.metrics.incr("tpu_serving_admitted")
 
     def _propose(self, slot: _Slot, k: int) -> list[int]:
         """Prompt-lookup drafting: find the latest prior occurrence of the

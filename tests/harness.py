@@ -131,6 +131,176 @@ def make_ssh_harness(provision_delay_s: float = 0.0,
                    clock=clock, transport=transport, cfg=cfg)
 
 
+class FakeReplica:
+    """In-process fake serving replica: the serve_main surface the fleet
+    router touches (/generate, /v1/*, /drain, /readyz, /healthz, /prefix),
+    with scriptable stats, fault switches, and a kill() that drops the
+    listener so new connections are refused — no jax, fast tier.
+
+    Streams: ``stream_chunks`` bytes are sent one chunked frame at a time;
+    ``stream_gates[i]`` (threading.Event) blocks chunk i+1 until the test
+    sets it (proves the router relays without buffering); ``die_after``
+    aborts the socket after N chunks WITHOUT the chunked terminator (a
+    replica dying mid-stream). A shared ``tracer`` records a
+    serving.request span per generate call, parented on the inbound
+    traceparent — the router->engine trace-join evidence."""
+
+    def __init__(self, replica_id: str, tracer=None):
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        self.replica_id = replica_id
+        self.tracer = tracer
+        self.lock = threading.Lock()
+        self.requests: list = []       # (path, body) of every POST served
+        self.generated = 0
+        self.draining = False
+        self.fail_next = 0             # next N generation POSTs answer 500
+        self.reject_429 = False        # generation POSTs answer 429
+        self.reject_400 = False        # generation POSTs answer 400
+        self.stream_chunks = [b'{"token": 1}\n', b'{"token": 2}\n',
+                              b'{"tokens": [1, 2], "rid": "fake"}\n']
+        self.stream_gates: list = []   # Event before chunk i+1 (i = index)
+        self.die_after = None          # abort socket after this many chunks
+        self.stats = {"free_slots": 4, "active_slots": 0, "max_slots": 4,
+                      "queue_depth": 0, "max_queue_depth": 0,
+                      "kv_cache_tokens": 0, "ttft_p95_s": 0.0,
+                      "draining": False}
+        rep = self
+
+        class _H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _json(self, status, payload, headers=None):
+                import json as _j
+                body = _j.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    return self._json(200, {"ok": True})
+                if self.path == "/readyz":
+                    return self._json(503 if rep.draining else 200,
+                                      {"draining": rep.draining})
+                if self.path == "/v1/models":
+                    return self._json(200, {"object": "list", "data": [
+                        {"id": "fake-model", "object": "model",
+                         "owned_by": rep.replica_id}]})
+                return self._json(404, {"error": "no route"})
+
+            def _record_span(self):
+                if rep.tracer is None:
+                    return
+                from k8s_runpod_kubelet_tpu.tracing import parse_traceparent
+                inbound = parse_traceparent(self.headers.get("traceparent"))
+                now = rep.tracer.clock()
+                rep.tracer.record(
+                    "serving.request", now, now,
+                    trace_id=inbound[0] if inbound else None,
+                    parent_id=inbound[1] if inbound else "",
+                    attrs={"replica_id": rep.replica_id})
+
+            def do_POST(self):
+                import json as _j
+                length = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(length) if length else b""
+                try:
+                    body = _j.loads(raw) if raw else {}
+                except _j.JSONDecodeError:
+                    body = {}
+                with rep.lock:
+                    rep.requests.append((self.path, body))
+                if self.path == "/drain":
+                    rep.draining = True
+                    with rep.lock:
+                        rep.stats["draining"] = True
+                    return self._json(200, {"draining": True})
+                if self.path == "/prefix":
+                    return self._json(200, {"registered": True})
+                # generation routes
+                if rep.draining:
+                    return self._json(503, {"error": {
+                        "message": "engine is draining",
+                        "type": "overloaded_error"}},
+                        {"Retry-After": "1"})
+                if rep.reject_429:
+                    return self._json(429, {"error": {
+                        "message": "queue at max_queue_depth",
+                        "type": "overloaded_error"}},
+                        {"Retry-After": "1"})
+                if rep.reject_400:
+                    return self._json(400, {"error": {
+                        "message": "bad prompt",
+                        "type": "invalid_request_error"}})
+                with rep.lock:
+                    if rep.fail_next > 0:
+                        rep.fail_next -= 1
+                        return self._json(500, {"error": "injected failure"})
+                self._record_span()
+                if body.get("stream"):
+                    return self._stream()
+                with rep.lock:
+                    rep.generated += 1
+                return self._json(200, {"tokens": [1, 2, 3],
+                                        "rid": f"{rep.replica_id}-r",
+                                        "replica_id": rep.replica_id})
+
+            def _stream(self):
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                with rep.lock:
+                    rep.generated += 1
+                for i, chunk in enumerate(rep.stream_chunks):
+                    if rep.die_after is not None and i >= rep.die_after:
+                        # mid-stream death: abort the socket, NO terminator
+                        self.close_connection = True
+                        self.connection.close()
+                        return
+                    self.wfile.write(f"{len(chunk):x}\r\n".encode()
+                                     + chunk + b"\r\n")
+                    self.wfile.flush()
+                    if i < len(rep.stream_gates):
+                        # released only once the TEST saw chunk i relayed:
+                        # a buffering router deadlocks here (and the
+                        # client's socket timeout fails the test loudly)
+                        rep.stream_gates[i].wait(10.0)
+                self.wfile.write(b"0\r\n\r\n")
+                self.wfile.flush()
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), _H)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        self.url = f"http://127.0.0.1:{self._httpd.server_address[1]}"
+
+    def set_stats(self, **kw):
+        with self.lock:
+            self.stats.update(kw)
+
+    def heartbeat_payload(self) -> dict:
+        with self.lock:
+            return {"replica_id": self.replica_id, "stats": dict(self.stats)}
+
+    def kill(self):
+        """Drop the listener: in-flight handlers die with their sockets,
+        new connections are refused (the dead-replica failure mode)."""
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    close = kill
+
+
 def make_pod(name="train", ns="default", node="virtual-tpu", chips=16,
              annotations: Optional[dict] = None, ports: Optional[list] = None,
              containers: Optional[list] = None, uid: Optional[str] = None):

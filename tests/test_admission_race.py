@@ -1,0 +1,124 @@
+"""Regression: max_queue_depth admission is ATOMIC under concurrent
+submitters (ISSUE 4 satellite). The check-then-put in submit() runs from
+many HTTP handler threads at once; without the _admit_lock, N racing
+submits could all read queue_depth < bound and overshoot the cap by N-1.
+
+The engine is built but NEVER started (the same trick as
+TestAdmissionControl in test_serving.py): the queue cannot drain, so the
+admitted count is exact. A barrier maximizes the race window. Uses the
+tiny f32 model on CPU — construction only (no jit runs), fast tier.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from k8s_runpod_kubelet_tpu.models import init_params, tiny_llama
+from k8s_runpod_kubelet_tpu.workloads.serving import (EngineDraining,
+                                                      EngineOverloaded,
+                                                      ServingConfig,
+                                                      ServingEngine)
+
+CFG = tiny_llama(vocab_size=64, embed_dim=32, n_layers=1, n_heads=2,
+                 n_kv_heads=2, mlp_dim=64, max_seq_len=128,
+                 dtype=jnp.float32, param_dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _unstarted(params, depth: int) -> ServingEngine:
+    return ServingEngine(CFG, params,
+                         ServingConfig(slots=1, max_prefill_len=16,
+                                       cache_len=32, max_new_tokens=4,
+                                       max_queue_depth=depth))
+
+
+def test_concurrent_submitters_cannot_overshoot_bound(params):
+    depth, submitters = 4, 24
+    eng = _unstarted(params, depth)
+    barrier = threading.Barrier(submitters)
+
+    def submit(i):
+        barrier.wait()  # all threads hit the admission check together
+        return eng.submit([1, 2, i % 50], max_new_tokens=2)
+
+    with ThreadPoolExecutor(max_workers=submitters) as pool:
+        futs = list(pool.map(submit, range(submitters)))
+    admitted = [f for f in futs if not f.done()]
+    rejected = [f for f in futs if f.done()]
+    assert len(admitted) == depth, \
+        (f"admission bound breached: {len(admitted)} admitted at "
+         f"max_queue_depth={depth} with {submitters} concurrent submitters")
+    for f in rejected:
+        with pytest.raises(EngineOverloaded):
+            f.result(timeout=0)
+    assert eng.metrics.get_counter("tpu_serving_admission_rejected") == \
+        submitters - depth
+    assert eng.queue_depth == depth  # the gauge's source stayed exact
+
+
+def test_concurrent_group_submitters_cannot_overshoot_bound(params):
+    depth, submitters, n = 6, 16, 3
+    eng = _unstarted(params, depth)
+    barrier = threading.Barrier(submitters)
+
+    def submit(i):
+        barrier.wait()
+        return eng.submit_group([1, 2, i % 50], n=n, max_new_tokens=2)
+
+    with ThreadPoolExecutor(max_workers=submitters) as pool:
+        groups = list(pool.map(submit, range(submitters)))
+    admitted = sum(1 for fs in groups if not fs[0].done())
+    # each admitted group counts ALL n members against the bound
+    assert admitted == depth // n, \
+        (f"group admission breached: {admitted} groups of {n} admitted at "
+         f"max_queue_depth={depth}")
+    assert eng.queue_depth == admitted * n
+
+
+def test_drain_races_submit_atomically(params):
+    """drain() and concurrent submits serialize on the same lock: every
+    submit either lands before the drain (queued) or rejects with
+    EngineDraining — none is silently dropped."""
+    eng = _unstarted(params, depth=0)
+    start = threading.Barrier(9)
+    results = []
+
+    def submit(i):
+        start.wait()
+        results.append(eng.submit([1, i % 50], max_new_tokens=2))
+
+    def drain():
+        start.wait()
+        eng.drain()
+
+    threads = [threading.Thread(target=submit, args=(i,)) for i in range(8)]
+    threads.append(threading.Thread(target=drain))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert eng.draining
+    queued = sum(1 for f in results if not f.done())
+    drained_rejects = 0
+    for f in results:
+        if f.done():
+            with pytest.raises(EngineDraining):
+                f.result(timeout=0)
+            drained_rejects += 1
+    assert queued + drained_rejects == 8
+    assert eng.queue_depth == queued
+    # post-drain submits always reject
+    f = eng.submit([1, 2], max_new_tokens=2)
+    with pytest.raises(EngineDraining):
+        f.result(timeout=0)
+    assert eng.metrics.get_counter("tpu_serving_drain_rejected") == \
+        drained_rejects + 1

@@ -1,0 +1,337 @@
+"""Fleet autoscaler units (injected clock, no sleeps): hysteresis +
+cooldowns on the way up, drain-before-delete on the way down, pending-pod
+accounting during boots — plus the serve_main /drain + /healthz//readyz
+status contract (ISSUE 4 satellite) over a stub engine.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+
+import pytest
+
+from k8s_runpod_kubelet_tpu.fleet.autoscaler import (AutoscalerConfig,
+                                                     FleetAutoscaler,
+                                                     KubePodScaler)
+from k8s_runpod_kubelet_tpu.fleet.registry import DRAINING, ReplicaRegistry
+from k8s_runpod_kubelet_tpu.kube import FakeKubeClient
+from k8s_runpod_kubelet_tpu.metrics import Metrics
+from k8s_runpod_kubelet_tpu.tracing import Tracer
+
+from harness import FakeClock
+
+
+CFG = AutoscalerConfig(min_replicas=1, max_replicas=3,
+                       target_queue_per_replica=4.0, ttft_slo_s=2.0,
+                       scale_up_stable_s=5.0, scale_down_stable_s=10.0,
+                       scale_up_cooldown_s=8.0, scale_down_cooldown_s=8.0,
+                       scale_down_utilization=0.25, drain_timeout_s=30.0,
+                       boot_timeout_s=60.0)
+
+
+class Fixture:
+    def __init__(self, cfg=CFG):
+        self.clock = FakeClock()
+        self.metrics = Metrics()
+        self.tracer = Tracer()
+        self.registry = ReplicaRegistry(metrics=self.metrics,
+                                        tracer=self.tracer, clock=self.clock,
+                                        heartbeat_timeout_s=1e9)
+        self.kube = FakeKubeClient()
+        self.scaler = KubePodScaler(self.kube, "virtual-tpu", chips=8)
+        self.drained: list = []
+        self.autoscaler = FleetAutoscaler(
+            self.registry, self.scaler, cfg, metrics=self.metrics,
+            tracer=self.tracer, clock=self.clock,
+            drain_fn=lambda rep: self.drained.append(rep.replica_id))
+
+    def add_replica(self, rid, pod_name="", **stats):
+        self.registry.register(rid, f"http://127.0.0.1:1/{rid}",
+                               pod_name=pod_name)
+        base = {"free_slots": 4, "active_slots": 0, "max_slots": 4,
+                "queue_depth": 0}
+        base.update(stats)
+        self.registry.heartbeat(rid, base)
+
+    def tick(self, dt=1.0, n=1):
+        for _ in range(n):
+            self.clock.advance(dt)
+            self.autoscaler.tick()
+
+    def pods(self):
+        return sorted(p["metadata"]["name"] for p in self.kube.list_pods())
+
+
+class TestScaleUp:
+    def test_sustained_queue_scales_up_once(self):
+        f = Fixture()
+        f.add_replica("a", queue_depth=9)
+        f.tick(n=3)                     # 3s sustained < stable_s: no action
+        assert f.pods() == []
+        f.tick(n=3)                     # crosses 5s stable
+        assert f.pods() == ["tpu-serving-1"]
+        # cooldown: still overloaded, but no second pod yet
+        f.add_replica("b", pod_name="tpu-serving-1", queue_depth=9)
+        f.tick(n=4)
+        assert f.pods() == ["tpu-serving-1"]
+        f.tick(n=10)                    # past cooldown + stable again
+        assert f.pods() == ["tpu-serving-1", "tpu-serving-2"]
+        assert f.metrics.get_counter("tpu_fleet_scale_ups") == 2
+        spans = [s for s in f.tracer.recent() if s["name"] == "fleet.scale"]
+        assert [s["attrs"]["direction"] for s in spans] == ["up", "up"]
+
+    def test_ttft_slo_burn_scales_up(self):
+        f = Fixture()
+        # live traffic corroborates the p95 (see stale-latch test below)
+        f.add_replica("a", ttft_p95_s=5.0, active_slots=1)  # SLO is 2s
+        f.tick(n=6)
+        assert f.pods() == ["tpu-serving-1"]
+        spans = [s for s in f.tracer.recent() if s["name"] == "fleet.scale"]
+        assert "ttft_p95" in spans[0]["attrs"]["reason"]
+
+    def test_stale_ttft_without_traffic_does_not_scale(self):
+        """The reporter's p95 has no time window: after a burst it latches
+        the last value forever. With NO live load it must not count as
+        overload (it would scale an idle fleet to max and pin it there)."""
+        f = Fixture()
+        f.add_replica("a", ttft_p95_s=5.0)   # idle: no queue, no slots
+        f.tick(n=20)
+        assert f.pods() == []
+
+    def test_blip_resets_hysteresis(self):
+        f = Fixture()
+        f.add_replica("a", queue_depth=9)
+        f.tick(n=3)
+        f.registry.heartbeat("a", {"queue_depth": 0, "free_slots": 4,
+                                   "max_slots": 4})
+        f.tick()                         # signal gone: stability resets
+        f.registry.heartbeat("a", {"queue_depth": 9, "free_slots": 0,
+                                    "max_slots": 4})
+        f.tick(n=3)                      # only 3s of the NEW episode
+        assert f.pods() == []
+
+    def test_max_replicas_capped(self):
+        f = Fixture()
+        f.add_replica("a", queue_depth=99)
+        f.add_replica("b", queue_depth=99)
+        f.add_replica("c", queue_depth=99)
+        f.tick(n=30)
+        assert f.pods() == []            # already at max_replicas=3
+
+    def test_pending_boot_counts_toward_size(self):
+        f = Fixture()
+        f.add_replica("a", queue_depth=9)
+        f.tick(n=6)
+        assert f.pods() == ["tpu-serving-1"]
+        # still booting (never registers): size stays 2, and max isn't hit,
+        # but a SECOND scale-up for the same sustained signal waits out the
+        # cooldown rather than firing every tick
+        f.tick(n=2)
+        assert f.pods() == ["tpu-serving-1"]
+        # boot timeout passes: the pod stops counting, capacity planning
+        # moves on (it would be recreated by the next sustained signal)
+        f.tick(dt=30.0, n=3)
+        assert "tpu-serving-1" not in f.autoscaler._pending
+
+
+class TestScaleDown:
+    def _idle_pair(self):
+        f = Fixture()
+        f.add_replica("a", pod_name="pod-a")
+        f.add_replica("b", pod_name="pod-b")
+        f.kube.create_pod({"metadata": {"name": "pod-a",
+                                        "namespace": "default"},
+                           "spec": {}})
+        f.kube.create_pod({"metadata": {"name": "pod-b",
+                                        "namespace": "default"},
+                           "spec": {}})
+        return f
+
+    def test_drain_before_delete(self):
+        f = self._idle_pair()
+        f.tick(n=11)                     # sustained idle crosses 10s
+        assert len(f.drained) == 1       # exactly one victim drained
+        victim = f.drained[0]
+        assert f.registry.get(victim).state == DRAINING
+        # pod NOT deleted yet: the replica still reports in-flight work
+        f.registry.heartbeat(victim, {"draining": True, "active_slots": 2,
+                                      "queue_depth": 0})
+        f.tick()
+        assert len(f.pods()) == 2
+        # drain completes -> deregistered + pod deleted
+        f.registry.heartbeat(victim, {"draining": True, "active_slots": 0,
+                                      "queue_depth": 0})
+        f.tick()
+        assert len(f.pods()) == 1
+        assert f.registry.get(victim) is None
+        assert f.metrics.get_counter("tpu_fleet_scale_downs") == 1
+
+    def test_min_replicas_floor(self):
+        f = Fixture()
+        f.add_replica("only", pod_name="pod-only")
+        f.tick(n=30)
+        assert f.drained == []           # min_replicas=1: never drained
+
+    def test_queue_blocks_scale_down(self):
+        f = self._idle_pair()
+        f.registry.heartbeat("a", {"queue_depth": 1, "free_slots": 4,
+                                   "max_slots": 4})
+        f.tick(n=30)
+        assert f.drained == []
+
+    def test_drain_timeout_force_completes(self):
+        f = self._idle_pair()
+        f.tick(n=11)
+        victim = f.drained[0]
+        # the replica wedges: reports in-flight work forever
+        f.registry.heartbeat(victim, {"draining": True, "active_slots": 1})
+        f.tick(dt=31.0)                  # past drain_timeout_s
+        assert len(f.pods()) == 1
+        assert f.metrics.get_counter("tpu_fleet_drain_timeouts") == 1
+
+    def test_one_drain_at_a_time(self):
+        f = Fixture()
+        for i in range(3):
+            f.add_replica(f"r{i}", pod_name=f"pod-{i}")
+            f.kube.create_pod({"metadata": {"name": f"pod-{i}",
+                                            "namespace": "default"},
+                               "spec": {}})
+        f.tick(n=30)
+        assert len(f.drained) == 1       # no second drain while one runs
+
+
+class TestLifecycleRecovery:
+    def test_floor_fill_from_zero_replicas(self):
+        """A cold-start (or all-replicas-dead) fleet has no load signal at
+        all; min_replicas is a FLOOR, not just a scale-down bound."""
+        import dataclasses
+        f = Fixture(dataclasses.replace(CFG, min_replicas=2))
+        f.tick()                        # no signal needed; _last_up=-inf
+        assert f.pods() == ["tpu-serving-1"]
+        f.tick(n=3)                     # second floor-fill waits cooldown
+        assert f.pods() == ["tpu-serving-1"]
+        f.tick(n=8)
+        assert f.pods() == ["tpu-serving-1", "tpu-serving-2"]
+        # pending pods count toward the floor: no third pod
+        f.tick(n=20)
+        assert len(f.pods()) == 2
+
+    def test_adopts_drain_started_elsewhere(self):
+        """An autoscaler restart (or an operator's direct POST /drain)
+        must still finish the drain with a pod delete — the engine side is
+        irreversible, so an unadopted drain is a leaked pod."""
+        f = Fixture()
+        for rid in ("a", "b"):
+            f.add_replica(rid, pod_name=f"pod-{rid}")
+            f.kube.create_pod({"metadata": {"name": f"pod-{rid}",
+                                            "namespace": "default"},
+                               "spec": {}})
+        # drain started OUTSIDE this autoscaler: only the heartbeat says so
+        f.registry.heartbeat("a", {"draining": True, "active_slots": 1})
+        f.tick()
+        assert "a" in f.autoscaler._drains      # adopted
+        f.registry.heartbeat("a", {"draining": True, "active_slots": 0,
+                                   "queue_depth": 0})
+        f.tick()
+        assert f.pods() == ["pod-b"]            # completed with the delete
+        assert f.registry.get("a") is None
+
+    def test_reaps_orphaned_fleet_pod(self):
+        """A fleet-LABELED pod no replica backs (drain's replica
+        deregistered just as the old autoscaler died) is deleted after the
+        boot grace; unlabeled pods are never touched."""
+        f = Fixture()
+        f.add_replica("a", pod_name="pod-a")    # healthy, keeps its pod
+        for name, labeled in (("pod-a", True), ("tpu-serving-9", True),
+                              ("train-7", False)):
+            f.kube.create_pod({
+                "metadata": {"name": name, "namespace": "default",
+                             "labels": ({"tpu.dev/fleet": "serving"}
+                                        if labeled else {})},
+                "spec": {}})
+        f.tick()                                # first sighting: grace
+        assert "tpu-serving-9" in f.pods()
+        f.tick(dt=CFG.boot_timeout_s + 1)
+        f.tick()
+        assert f.pods() == ["pod-a", "train-7"]
+        assert f.metrics.get_counter("tpu_fleet_orphans_reaped") == 1
+
+
+class TestConfigValidation:
+    def test_bad_bounds_rejected(self):
+        f = Fixture.__new__(Fixture)  # unused; just build args
+        with pytest.raises(ValueError, match="min_replicas"):
+            FleetAutoscaler(ReplicaRegistry(), None,
+                            AutoscalerConfig(min_replicas=5, max_replicas=2))
+
+    def test_fleet_config_knobs_env_and_validation(self):
+        from k8s_runpod_kubelet_tpu import config as config_mod
+        cfg = config_mod.load(env={"TPU_FLEET_MAX_REPLICAS": "9",
+                                   "TPU_FLEET_TTFT_SLO_S": "1.5"})
+        assert cfg.fleet_max_replicas == 9
+        assert cfg.fleet_ttft_slo_s == 1.5
+        with pytest.raises(ValueError, match="fleet_max_replicas"):
+            config_mod.load(env={"TPU_FLEET_MIN_REPLICAS": "6",
+                                 "TPU_FLEET_MAX_REPLICAS": "2"})
+        with pytest.raises(ValueError, match="fleet_heartbeat_timeout_s"):
+            config_mod.load(env={"TPU_FLEET_HEARTBEAT_TIMEOUT_S": "0.5"})
+
+
+class _StubEngine:
+    """serve_main needs only this surface for the status-contract routes."""
+
+    def __init__(self):
+        self.alive = True
+        self.draining = False
+        self.drained = False
+        self.queue_depth = 0
+        self.active_slots = 0
+        from k8s_runpod_kubelet_tpu.metrics import Metrics as _M
+        self.metrics = _M()
+        self.tracer = Tracer()
+
+    def drain(self):
+        self.draining = True
+
+
+class TestDrainStatusContract:
+    """The satellite contract: /healthz stays 200 while draining (kubelet
+    liveness must NOT restart a draining pod) while /readyz goes 503 (the
+    router stops routing here) — drain and health don't fight."""
+
+    def _serve(self, engine):
+        from k8s_runpod_kubelet_tpu.workloads.serve_main import serve
+        return serve(engine, 0)
+
+    def _get(self, port, path):
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        c.request("GET", path)
+        r = c.getresponse()
+        body = r.read()
+        c.close()
+        return r.status, body
+
+    def test_healthz_readyz_through_drain(self):
+        eng = _StubEngine()
+        httpd = self._serve(eng)
+        port = httpd.server_address[1]
+        try:
+            assert self._get(port, "/healthz") == (200, b"ok")
+            assert self._get(port, "/readyz") == (200, b"ready")
+            # POST /drain flips readiness, not liveness
+            c = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+            c.request("POST", "/drain", body=b"{}",
+                      headers={"Content-Type": "application/json"})
+            r = c.getresponse()
+            assert r.status == 200
+            assert json.loads(r.read())["draining"] is True
+            c.close()
+            assert eng.draining
+            assert self._get(port, "/healthz") == (200, b"draining")
+            assert self._get(port, "/readyz") == (503, b"draining")
+            # liveness still flips on a dead engine thread
+            eng.alive = False
+            assert self._get(port, "/healthz")[0] == 503
+        finally:
+            httpd.shutdown()

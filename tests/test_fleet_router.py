@@ -1,0 +1,459 @@
+"""Fleet tier units: registry membership/eviction, routing policy, HTTP
+forwarding (failover, saturation 429, traceparent propagation), and the
+streaming-passthrough contract (ISSUE 4 satellite): SSE/NDJSON chunks
+relay as they arrive (never whole-stream buffered), traceparent is
+stamped, and a replica dying mid-stream yields a CLEAN truncated stream
+plus a counter — not a hang. Stdlib + localhost sockets only, fast tier.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+
+import pytest
+
+from k8s_runpod_kubelet_tpu.fleet.registry import (DRAINING, READY,
+                                                   ReplicaRegistry)
+from k8s_runpod_kubelet_tpu.fleet.router import (FleetRouter, RouterConfig,
+                                                 affinity_key_for,
+                                                 serve_router)
+from k8s_runpod_kubelet_tpu.metrics import Metrics
+from k8s_runpod_kubelet_tpu.tracing import Tracer, parse_traceparent
+
+from harness import FakeClock, FakeReplica
+
+
+def make_registry(clock=None, **kw):
+    return ReplicaRegistry(metrics=Metrics(), tracer=Tracer(),
+                           clock=clock or FakeClock(),
+                           heartbeat_timeout_s=kw.pop("timeout", 10.0), **kw)
+
+
+class TestRegistry:
+    def test_register_heartbeat_snapshot(self):
+        clock = FakeClock()
+        reg = make_registry(clock)
+        reg.register("a", "http://127.0.0.1:1")
+        assert reg.heartbeat("a", {"queue_depth": 3, "free_slots": 1})
+        snap = reg.snapshot()
+        assert snap["ready"] == 1
+        assert snap["replicas"][0]["stats"]["queue_depth"] == 3
+        # unknown id tells the replica to re-register
+        assert not reg.heartbeat("ghost", {})
+
+    def test_stale_heartbeat_evicts_when_probe_fails(self):
+        clock = FakeClock()
+        reg = make_registry(clock, probe_fn=lambda r: False)
+        reg.register("a", "http://127.0.0.1:1")
+        assert reg.sweep() == []          # fresh: not suspect, not probed
+        clock.advance(11.0)
+        assert reg.sweep() == ["a"]
+        assert reg.live() == []
+        assert reg.metrics.get_counter("tpu_fleet_evictions",
+                                       labels={"reason": "stale"}) == 1
+        spans = [s for s in reg.tracer.recent() if s["name"] == "fleet.evict"]
+        assert spans and spans[0]["attrs"]["replica_id"] == "a"
+
+    def test_stale_heartbeat_survives_on_probe_success(self):
+        clock = FakeClock()
+        reg = make_registry(clock, probe_fn=lambda r: True)
+        reg.register("a", "http://127.0.0.1:1")
+        clock.advance(11.0)
+        assert reg.sweep() == []          # slow heartbeater, alive probe
+        assert [r.replica_id for r in reg.ready()] == ["a"]
+
+    def test_breaker_open_replica_heals_on_probe_success(self):
+        """ready() excludes breaker-open replicas, so nothing would ever
+        call allow() again — the sweep's successful probe must close the
+        breaker or a blipped replica stays an unroutable zombie."""
+        clock = FakeClock()
+        reg = make_registry(clock, probe_fn=lambda r: True)
+        rep = reg.register("a", "http://127.0.0.1:1")
+        reg.heartbeat("a", {"free_slots": 4, "max_slots": 4})
+        for _ in range(3):  # default breaker_failure_threshold
+            rep.transport.breaker.record_failure()
+        assert reg.ready() == []          # excluded while open
+        assert reg.sweep() == []          # probe succeeds -> heal, no evict
+        assert [r.replica_id for r in reg.ready()] == ["a"]
+
+    def test_draining_state_from_heartbeat_and_gauges(self):
+        reg = make_registry()
+        reg.register("a", "http://127.0.0.1:1")
+        reg.heartbeat("a", {"draining": True})
+        assert reg.live()[0].state == DRAINING
+        assert reg.ready() == []
+        rendered = reg.metrics.render()
+        assert 'tpu_fleet_replicas{state="draining"} 1' in rendered
+        assert 'tpu_fleet_replicas{state="ready"} 0' in rendered
+        # DRAINING is sticky: engine drains are irreversible, so a stale
+        # draining=False heartbeat (snapshot taken before /drain landed)
+        # must NOT flip the replica back into the routable set
+        reg.heartbeat("a", {"draining": False})
+        assert reg.live()[0].state == DRAINING
+        # only a fresh REGISTRATION (process restart) resets to READY
+        reg.register("a", "http://127.0.0.1:1")
+        assert reg.live()[0].state == READY
+
+
+class TestRoutingPolicy:
+    def _router(self, n=3):
+        reg = make_registry()
+        for i in range(n):
+            reg.register(f"rep-{i}", f"http://127.0.0.1:{i + 1}")
+            reg.heartbeat(f"rep-{i}", {"free_slots": 4, "max_slots": 4})
+        return FleetRouter(reg, RouterConfig(), metrics=Metrics(),
+                           tracer=Tracer())
+
+    def test_affinity_is_sticky_and_spread(self):
+        rt = self._router()
+        picks = {key: rt.pick(f"sid:{key}")[0].replica_id
+                 for key in ("alpha", "bravo", "charlie", "delta", "echo")}
+        for key, first in picks.items():
+            for _ in range(3):
+                rep, reason = rt.pick(f"sid:{key}")
+                assert (rep.replica_id, reason) == (first, "affinity")
+        assert len(set(picks.values())) > 1  # rendezvous spreads keys
+
+    def test_affinity_falls_back_when_pinned_saturated(self):
+        rt = self._router()
+        pinned, _ = rt.pick("sid:alpha")
+        rt.registry.heartbeat(pinned.replica_id,
+                              {"free_slots": 0, "queue_depth": 8,
+                               "max_queue_depth": 8, "max_slots": 4})
+        rep, reason = rt.pick("sid:alpha")
+        assert rep.replica_id != pinned.replica_id
+        assert reason == "least_loaded"
+
+    def test_least_loaded_orders_by_queue_and_headroom(self):
+        rt = self._router()
+        rt.registry.heartbeat("rep-0", {"queue_depth": 9, "free_slots": 0,
+                                        "active_slots": 4, "max_slots": 4})
+        rt.registry.heartbeat("rep-1", {"queue_depth": 0, "free_slots": 4,
+                                        "max_slots": 4})
+        rt.registry.heartbeat("rep-2", {"queue_depth": 2, "free_slots": 2,
+                                        "active_slots": 2, "max_slots": 4})
+        rep, reason = rt.pick("")  # no affinity key
+        assert (rep.replica_id, reason) == ("rep-1", "least_loaded")
+
+    def test_exclusion_and_exhaustion(self):
+        rt = self._router(n=2)
+        rep, _ = rt.pick("", exclude=frozenset({"rep-0"}))
+        assert rep.replica_id == "rep-1"
+        rep, reason = rt.pick("", exclude=frozenset({"rep-0", "rep-1"}))
+        assert rep is None and reason == "no_replicas"
+
+    def test_affinity_key_extraction(self):
+        assert affinity_key_for("/generate", {"session_id": "s1"}) == "sid:s1"
+        assert affinity_key_for("/v1/completions",
+                                {"user": "u9"}) == "sid:u9"
+        assert affinity_key_for("/generate",
+                                {"tokens": [1, 2, 3]}) == "tok:1,2,3"
+        assert affinity_key_for("/v1/completions",
+                                {"prompt": "x" * 200}) == "txt:" + "x" * 64
+        chat = affinity_key_for("/v1/chat/completions",
+                                {"messages": [{"role": "system",
+                                               "content": "be terse"}]})
+        assert chat == "chat:be terse"
+        assert affinity_key_for("/generate", {}) == ""
+
+
+@pytest.fixture()
+def fleet():
+    """Router HTTP server over two live FakeReplicas (shared tracer)."""
+    tracer = Tracer()
+    metrics = Metrics()
+    reg = ReplicaRegistry(metrics=metrics, tracer=tracer,
+                          heartbeat_timeout_s=60.0)
+    router = FleetRouter(reg, RouterConfig(max_attempts=3,
+                                           request_timeout_s=10.0),
+                         metrics=metrics, tracer=tracer)
+    httpd = serve_router(router, port=0)
+    port = httpd.server_address[1]
+    reps = [FakeReplica(f"rep-{i}", tracer=tracer) for i in range(2)]
+    for r in reps:
+        reg.register(r.replica_id, r.url)
+        reg.heartbeat(r.replica_id, r.stats)
+    try:
+        yield router, port, reps
+    finally:
+        httpd.shutdown()
+        for r in reps:
+            r.kill()
+
+
+def _post(port, path, payload, headers=None, timeout=10.0):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    c.request("POST", path, body=json.dumps(payload).encode(),
+              headers={"Content-Type": "application/json", **(headers or {})})
+    return c, c.getresponse()
+
+
+class TestRouterHttp:
+    def test_forward_and_trace_join(self, fleet):
+        router, port, reps = fleet
+        inbound_trace = "0af7651916cd43dd8448eb211c80319c"
+        c, r = _post(port, "/generate", {"tokens": [1, 2, 3]},
+                     headers={"traceparent":
+                              f"00-{inbound_trace}-b7ad6b7169203331-01"})
+        assert r.status == 200
+        out = json.loads(r.read())
+        assert out["tokens"] == [1, 2, 3]
+        # response traceparent carries the caller's trace_id + router span
+        tp = parse_traceparent(r.getheader("traceparent"))
+        assert tp is not None and tp[0] == inbound_trace
+        spans = {s["name"]: s for s in router.tracer.get_trace(inbound_trace)}
+        route, serving = spans["fleet.route"], spans["serving.request"]
+        # router span parents the engine span — one trace, two layers
+        assert route["parent_id"] == "b7ad6b7169203331"
+        assert serving["parent_id"] == route["span_id"]
+        assert route["attrs"]["replica_id"] == serving["attrs"]["replica_id"]
+        c.close()
+
+    def test_failover_on_dead_replica(self, fleet):
+        router, port, reps = fleet
+        reps[0].kill()
+        survivors = {reps[1].replica_id}
+        for i in range(6):  # some picks would land on the corpse first
+            c, r = _post(port, "/generate", {"tokens": [i]})
+            assert r.status == 200
+            assert json.loads(r.read())["replica_id"] in survivors
+            c.close()
+        assert router.metrics.get_counter("tpu_fleet_failovers") >= 1
+
+    def test_replica_429_tries_next_then_relays(self, fleet):
+        router, port, reps = fleet
+        reps[0].reject_429 = True
+        reps[1].reject_429 = True
+        c, r = _post(port, "/v1/completions", {"prompt": [1, 2]})
+        assert r.status == 429
+        assert r.getheader("Retry-After") == "1"
+        assert json.loads(r.read())["error"]["type"] == "overloaded_error"
+        c.close()
+        # one replica healthy again: requests flow (the 429 replica was
+        # tried and skipped)
+        reps[1].reject_429 = False
+        c, r = _post(port, "/generate", {"tokens": [9]})
+        assert r.status == 200
+        c.close()
+
+    def test_all_saturated_is_router_side_429(self, fleet):
+        router, port, reps = fleet
+        for r in reps:
+            router.registry.heartbeat(r.replica_id,
+                                      {"free_slots": 0, "queue_depth": 8,
+                                       "max_queue_depth": 8, "max_slots": 4})
+        c, resp = _post(port, "/generate", {"tokens": [1]})
+        assert resp.status == 429
+        assert resp.getheader("Retry-After") == "1"
+        c.close()
+        assert router.metrics.get_counter("tpu_fleet_rejected_saturated") == 1
+        # no replica even saw the request
+        assert all(not rep.requests for rep in reps)
+
+    def test_no_replicas_is_503(self, fleet):
+        router, port, reps = fleet
+        for r in reps:
+            router.registry.deregister(r.replica_id)
+        c, resp = _post(port, "/generate", {"tokens": [1]})
+        assert resp.status == 503
+        assert resp.getheader("Retry-After") == "1"
+        c.close()
+
+    def test_client_4xx_relayed_verbatim_without_failover(self, fleet):
+        router, port, reps = fleet
+        for rep in reps:
+            rep.reject_400 = True
+        c, resp = _post(port, "/v1/completions", {"prompt": [1]})
+        assert resp.status == 400
+        # the REPLICA's error body reaches the client unchanged...
+        assert json.loads(resp.read())["error"]["type"] == \
+            "invalid_request_error"
+        c.close()
+        # ...and a deterministic 4xx never fails over: one replica saw it
+        assert sum(len(rep.requests) for rep in reps) == 1
+        assert router.metrics.get_counter("tpu_fleet_failovers") == 0
+        # router-side unknown routes stay a local 404
+        c, resp = _post(port, "/unknown-route", {"x": 1})
+        assert resp.status == 404
+        c.close()
+
+    def test_prefix_broadcasts_to_every_replica(self, fleet):
+        router, port, reps = fleet
+        c, resp = _post(port, "/prefix", {"tokens": [1, 2, 3]})
+        assert resp.status == 200
+        out = json.loads(resp.read())
+        assert set(out["replicas"]) == {r.replica_id for r in reps}
+        c.close()
+        for rep in reps:
+            assert ("/prefix", {"tokens": [1, 2, 3]}) in rep.requests
+
+    def test_v1_models_relayed_from_a_replica(self, fleet):
+        """OpenAI SDK model discovery must work pointed at the router."""
+        router, port, reps = fleet
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        c.request("GET", "/v1/models")
+        r = c.getresponse()
+        assert r.status == 200
+        out = json.loads(r.read())
+        assert out["data"] and out["data"][0]["id"] == "fake-model"
+        c.close()
+        for rep in reps:
+            router.registry.deregister(rep.replica_id)
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        c.request("GET", "/v1/models")
+        assert c.getresponse().status == 503
+        c.close()
+
+    def test_affinity_prefix_knobs_are_live(self):
+        """RouterConfig.affinity_prefix_* must actually change the key."""
+        reg = make_registry()
+        reg.register("a", "http://127.0.0.1:1")
+        reg.heartbeat("a", {"free_slots": 1, "max_slots": 1})
+        rt = FleetRouter(reg, RouterConfig(affinity_prefix_chars=8,
+                                           affinity_prefix_tokens=2))
+        assert rt._affinity_key("/generate",
+                                {"text": "x" * 100}) == "txt:" + "x" * 8
+        assert rt._affinity_key("/generate",
+                                {"tokens": [1, 2, 3, 4]}) == "tok:1,2"
+
+    def test_draining_replica_not_picked(self, fleet):
+        router, port, reps = fleet
+        router.registry.heartbeat(reps[0].replica_id, {"draining": True})
+        for i in range(4):
+            c, r = _post(port, "/generate", {"tokens": [i]})
+            assert r.status == 200
+            assert json.loads(r.read())["replica_id"] == reps[1].replica_id
+            c.close()
+
+
+class TestStreamingPassthrough:
+    """ISSUE 4 satellite: the router relays token chunks WITHOUT buffering
+    the whole stream, stamps traceparent, and surfaces a replica death
+    mid-stream as a clean truncated stream + counter (not a hang)."""
+
+    def test_chunks_relayed_before_stream_ends(self, fleet):
+        router, port, reps = fleet
+        # route deterministically to reps[0] via a session pinned there
+        key = self._key_for(router, reps[0].replica_id)
+        gate = threading.Event()
+        reps[0].stream_gates = [gate]  # replica HOLDS chunk 2 until set
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        c.request("POST", "/generate",
+                  body=json.dumps({"tokens": [1], "stream": True,
+                                   "session_id": key}).encode(),
+                  headers={"Content-Type": "application/json"})
+        resp = c.getresponse()
+        assert resp.status == 200
+        assert parse_traceparent(resp.getheader("traceparent")) is not None
+        # chunk 1 must arrive WHILE the replica still holds chunk 2: a
+        # whole-stream-buffering router would block here until timeout
+        first = resp.read1(65536)
+        assert b'{"token": 1}' in first
+        gate.set()  # only now may the replica finish the stream
+        rest = first
+        while True:
+            chunk = resp.read(65536)
+            if not chunk:
+                break
+            rest += chunk
+        assert b'"rid"' in rest  # final NDJSON object made it through
+        c.close()
+
+    def test_mid_stream_replica_death_truncates_cleanly(self, fleet):
+        router, port, reps = fleet
+        key = self._key_for(router, reps[0].replica_id)
+        reps[0].die_after = 2  # socket aborted after 2 chunks, no terminator
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        c.request("POST", "/generate",
+                  body=json.dumps({"tokens": [1], "stream": True,
+                                   "session_id": key}).encode(),
+                  headers={"Content-Type": "application/json"})
+        resp = c.getresponse()
+        assert resp.status == 200
+        # the client reads a VALID truncated chunked body: two token lines,
+        # then the terminator the ROUTER inserted — read() returns, no
+        # IncompleteRead, no hang
+        body = b""
+        while True:
+            chunk = resp.read(65536)
+            if not chunk:
+                break
+            body += chunk
+        assert b'{"token": 1}' in body and b'{"token": 2}' in body
+        assert b'"rid"' not in body  # the stream really was truncated
+        assert router.metrics.get_counter("tpu_fleet_stream_aborted") == 1
+        c.close()
+
+    def test_stream_open_5xx_fails_over_before_first_byte(self, fleet):
+        """A 5xx at stream OPEN (no byte relayed yet) is failover
+        territory — and the sick replica's breaker must LEARN, or an
+        all-streaming workload would pin a corpse forever."""
+        router, port, reps = fleet
+        key = self._key_for(router, reps[0].replica_id)
+        reps[0].fail_next = 1
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        c.request("POST", "/generate",
+                  body=json.dumps({"tokens": [1], "stream": True,
+                                   "session_id": key}).encode(),
+                  headers={"Content-Type": "application/json"})
+        resp = c.getresponse()
+        assert resp.status == 200  # served by the OTHER replica
+        body = b""
+        while True:
+            chunk = resp.read(65536)
+            if not chunk:
+                break
+            body += chunk
+        assert b'"rid"' in body
+        c.close()
+        assert reps[1].generated == 1
+        assert router.metrics.get_counter("tpu_fleet_failovers") == 1
+
+    @staticmethod
+    def _key_for(router, replica_id: str) -> str:
+        for i in range(64):
+            key = f"pin-{i}"
+            rep, _ = router.pick(f"sid:{key}")
+            if rep.replica_id == replica_id:
+                return key
+        raise AssertionError(f"no affinity key maps to {replica_id}")
+
+
+class TestFleetSummaryTool:
+    def test_renders_routes_loads_and_events(self, tmp_path):
+        import pathlib
+        import sys
+        sys.path.insert(0, str(pathlib.Path(__file__).parent.parent
+                               / "tools"))
+        import fleet_summary
+        path = tmp_path / "fleet.jsonl"
+        lines = [
+            {"trace_id": "t1", "span_id": "a", "parent_id": "",
+             "name": "fleet.route", "start": 1.0, "duration_s": 0.01,
+             "attrs": {"replica_id": "rep-0", "reason": "affinity",
+                       "attempts": 1, "status": 200, "streamed": False,
+                       "path": "/generate"}},
+            {"trace_id": "t2", "span_id": "b", "parent_id": "",
+             "name": "fleet.route", "start": 2.0, "duration_s": 0.05,
+             "attrs": {"replica_id": "rep-1", "reason": "least_loaded",
+                       "attempts": 2, "status": 200, "streamed": True,
+                       "path": "/generate"}},
+            {"trace_id": "t3", "span_id": "c", "parent_id": "",
+             "name": "fleet.scale", "start": 3.0, "duration_s": 0.0,
+             "attrs": {"direction": "up", "from": 2, "to": 3,
+                       "reason": "queue_depth", "target": "tpu-serving-3"}},
+            {"replicas": [{"replica_id": "rep-0", "state": "ready",
+                           "heartbeat_age_s": 0.5,
+                           "stats": {"active_slots": 2, "max_slots": 4,
+                                     "queue_depth": 1, "kv_cache_tokens": 77,
+                                     "ttft_p95_s": 0.25}}]},
+        ]
+        path.write_text("\n".join(json.dumps(x) for x in lines) + "\n")
+        spans, snaps = fleet_summary.load(str(path))
+        assert len(spans) == 3 and len(snaps) == 1
+        out = fleet_summary.render(spans, snaps)
+        assert "rep-0" in out and "rep-1" in out
+        assert "scale up 2 -> 3" in out
+        assert "77" in out  # kv tokens column from the snapshot

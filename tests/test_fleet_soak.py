@@ -1,0 +1,313 @@
+"""Deterministic fleet soak (ISSUE 4 acceptance): 3 registered fake
+replicas behind the real router HTTP server, a seeded FaultPlan killing
+one, driven through evict -> reroute -> scale-up -> drain -> scale-down
+on ONE injected clock (no real sleeps; localhost sockets only, fast tier).
+
+What convergence means here:
+- every submitted request completes (200) or is CLEANLY rejected (429 +
+  Retry-After when the whole fleet is saturated) — zero hangs, zero drops
+  (client socket timeouts fail the test loudly);
+- the killed replica is evicted (breaker + stale-heartbeat probe) and its
+  traffic rebalances onto the survivors, including a pinned conversation;
+- sustained queue depth scales the fleet UP through the real provider:
+  the autoscaler's pod rides the whole QueuedResources provisioning path
+  to Running in the fake cloud;
+- calm traffic scales DOWN drain-first: the victim gets POST /drain,
+  finishes, deregisters, and only then is its pod deleted (slice released
+  — zero leaked QueuedResources at the end);
+- a routed request's exported trace shows router -> engine spans under
+  ONE trace_id (fleet.route parenting serving.request).
+
+The seed is embedded in every assertion message for replay.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+
+from k8s_runpod_kubelet_tpu.cloud.faults import (PREEMPTION_STORM, FaultPlan,
+                                                 FaultWindow)
+from k8s_runpod_kubelet_tpu.fleet.autoscaler import (AutoscalerConfig,
+                                                     FleetAutoscaler,
+                                                     KubePodScaler)
+from k8s_runpod_kubelet_tpu.fleet.registry import ReplicaRegistry
+from k8s_runpod_kubelet_tpu.fleet.router import (FleetRouter, RouterConfig,
+                                                 serve_router)
+from k8s_runpod_kubelet_tpu.metrics import Metrics
+from k8s_runpod_kubelet_tpu.tracing import Tracer, parse_traceparent
+
+from harness import FakeReplica, make_harness
+
+SEED = 11
+# the seeded storm window (sim seconds): exactly one replica dies in it
+KILL_WINDOW = FaultWindow(PREEMPTION_STORM, 10.0, 14.0, 1.0)
+OVERLOAD = range(15, 21)    # ticks where survivors report deep queues
+CALM_FROM = 21              # queues empty; scale-down territory
+
+
+def _ctx(what: str, plan=None) -> str:
+    msg = f"[fleet seed={SEED}] {what}"
+    if plan is not None:
+        msg += "\n" + plan.describe()
+    return msg
+
+
+class Soak:
+    """Wiring for one soak run; every moving part shares h.clock."""
+
+    def __init__(self, tmp_path):
+        self.h = make_harness(provision_delay_s=0.0)
+        self.clock = self.h.clock
+        self.metrics = Metrics()
+        self.export = str(tmp_path / "fleet_spans.jsonl")
+        self.tracer = Tracer(export_path=self.export, clock=self.clock)
+        self.registry = ReplicaRegistry(
+            metrics=self.metrics, tracer=self.tracer, clock=self.clock,
+            heartbeat_timeout_s=8.0, breaker_failure_threshold=3,
+            breaker_reset_s=30.0)
+        self.router = FleetRouter(
+            self.registry, RouterConfig(max_attempts=3,
+                                        request_timeout_s=10.0),
+            metrics=self.metrics, tracer=self.tracer, clock=self.clock)
+        self.httpd = serve_router(self.router, port=0)
+        self.port = self.httpd.server_address[1]
+        self.scaler = KubePodScaler(self.h.kube, "virtual-tpu", chips=8,
+                                    on_create=self.h.provider.create_pod,
+                                    on_delete=self.h.provider.delete_pod)
+        self.autoscaler = FleetAutoscaler(
+            self.registry, self.scaler,
+            AutoscalerConfig(min_replicas=2, max_replicas=4,
+                             target_queue_per_replica=4.0, ttft_slo_s=2.0,
+                             scale_up_stable_s=3.0, scale_down_stable_s=5.0,
+                             scale_up_cooldown_s=8.0,
+                             scale_down_cooldown_s=5.0,
+                             drain_timeout_s=60.0, boot_timeout_s=120.0),
+            metrics=self.metrics, tracer=self.tracer, clock=self.clock)
+        self.plan = FaultPlan(SEED, self.clock, horizon_s=60.0,
+                              windows=[KILL_WINDOW])
+        self.replicas: dict[str, FakeReplica] = {}
+        self.killed: set[str] = set()
+        self.responses: list[tuple[int, int]] = []  # (tick, status)
+
+    def close(self):
+        self.tracer.close()
+        self.httpd.shutdown()
+        for rep in self.replicas.values():
+            rep.kill()
+        self.h.close()
+
+    # -- router HTTP helpers ---------------------------------------------------
+
+    def post(self, path: str, payload: dict, headers=None,
+             timeout: float = 15.0):
+        """One request through the router; a hang (socket timeout) raises
+        and fails the soak — the zero-hangs invariant is enforced by
+        construction."""
+        c = http.client.HTTPConnection("127.0.0.1", self.port,
+                                       timeout=timeout)
+        try:
+            c.request("POST", path, body=json.dumps(payload).encode(),
+                      headers={"Content-Type": "application/json",
+                               **(headers or {})})
+            r = c.getresponse()
+            body = r.read()
+            return r.status, (json.loads(body) if body else {}), dict(
+                r.getheaders())
+        finally:
+            c.close()
+
+    def add_replica(self, rid: str, pod_name: str = "") -> FakeReplica:
+        rep = FakeReplica(rid, tracer=self.tracer)
+        self.replicas[rid] = rep
+        status, out, _ = self.post("/fleet/register",
+                                   {"replica_id": rid, "base_url": rep.url,
+                                    "pod_name": pod_name})
+        assert status == 200, _ctx(f"register {rid} -> {status} {out}")
+        return rep
+
+    def alive(self) -> list[FakeReplica]:
+        return [r for rid, r in sorted(self.replicas.items())
+                if rid not in self.killed]
+
+    def heartbeat_all(self):
+        for rep in self.alive():
+            status, out, _ = self.post("/fleet/heartbeat",
+                                       rep.heartbeat_payload())
+            assert status == 200 and out.get("registered") is not None, \
+                _ctx(f"heartbeat {rep.replica_id} -> {status} {out}")
+
+
+def test_fleet_soak_tier1(tmp_path):
+    s = Soak(tmp_path)
+    plan = s.plan
+    try:
+        for i in range(3):
+            s.add_replica(f"rep-{i}")
+        pinned_traces = []
+        scale_pod_running = False
+        trace_probe = None
+
+        for tick in range(60):
+            s.clock.advance(1.0)
+            t = tick + 1
+
+            # phase-scripted load stats (the autoscaler's signal)
+            for rep in s.alive():
+                if t in OVERLOAD and not rep.replica_id.startswith("boot"):
+                    rep.set_stats(queue_depth=10, free_slots=0,
+                                  active_slots=4)
+                elif t >= CALM_FROM:
+                    if rep.replica_id.startswith("boot"):
+                        rep.set_stats(queue_depth=0, free_slots=4,
+                                      active_slots=0)
+                    else:
+                        # a little residual work pins originals above the
+                        # booted replica in load order -> deterministic
+                        # drain victim
+                        rep.set_stats(queue_depth=0, free_slots=3,
+                                      active_slots=1)
+                else:
+                    rep.set_stats(queue_depth=1, free_slots=3,
+                                  active_slots=1)
+            s.heartbeat_all()
+
+            # the seeded storm kills exactly one replica
+            victims = plan.preempt_victims(
+                sorted(rid for rid in s.replicas if rid not in s.killed))
+            if victims and not s.killed:
+                victim = victims[0]
+                s.replicas[victim].kill()
+                s.killed.add(victim)
+
+            s.registry.sweep()
+            s.autoscaler.tick()
+            s.h.provider.process_pending_pods()
+            s.h.provider.update_all_pod_statuses()
+            s.h.provider.run_cleanup()
+
+            # the scaled-up pod "boots": once Running, its replica
+            # registers (what serve_main --fleet-router does on start)
+            if not scale_pod_running:
+                for pod in s.h.kube.list_pods():
+                    name = pod["metadata"]["name"]
+                    if name.startswith("tpu-serving-") and \
+                            pod.get("status", {}).get("phase") == "Running":
+                        s.add_replica("boot-0", pod_name=name)
+                        scale_pod_running = True
+
+            # steady traffic, all phases: 2 fresh + 1 pinned conversation
+            if t < 45:
+                for j in range(2):
+                    status, out, _ = s.post(
+                        "/generate", {"tokens": [t, j], "max_new_tokens": 4})
+                    s.responses.append((t, status))
+                    assert status == 200, \
+                        _ctx(f"t={t} request {j} -> {status} {out}", plan)
+                hdr = {}
+                if t == 5:
+                    trace_probe = ("0" * 31 + "a", "b7ad6b7169203331")
+                    hdr = {"traceparent":
+                           f"00-{trace_probe[0]}-{trace_probe[1]}-01"}
+                status, out, rhdr = s.post(
+                    "/generate", {"tokens": [9, 9], "session_id": "conv-A"},
+                    headers=hdr)
+                s.responses.append((t, status))
+                assert status == 200, \
+                    _ctx(f"t={t} pinned conversation -> {status} {out}",
+                         plan)
+                tp = parse_traceparent(rhdr.get("traceparent", ""))
+                assert tp is not None, \
+                    _ctx(f"t={t} response missing traceparent", plan)
+                pinned_traces.append(out.get("replica_id"))
+
+        # -- 1. zero hangs / zero drops: every request answered 200 ----------
+        assert len(s.responses) == 44 * 3, \
+            _ctx(f"expected 132 responses, got {len(s.responses)}", plan)
+        assert all(st == 200 for _, st in s.responses), \
+            _ctx(f"non-200 in steady traffic: "
+                 f"{[r for r in s.responses if r[1] != 200]}", plan)
+
+        # -- 2. the kill happened, the corpse was evicted, traffic moved -----
+        assert len(s.killed) == 1, \
+            _ctx(f"storm killed {len(s.killed)} replicas", plan)
+        killed = next(iter(s.killed))
+        assert plan.preempted, _ctx("plan recorded no preemptions", plan)
+        live_ids = {r.replica_id for r in s.registry.live()}
+        assert killed not in live_ids, \
+            _ctx(f"killed replica {killed} still registered: {live_ids}",
+                 plan)
+        evictions = sum(s.metrics.get_counter("tpu_fleet_evictions",
+                                              labels={"reason": reason})
+                        for reason in ("stale", "probe"))
+        assert evictions >= 1, _ctx("no eviction recorded", plan)
+        # the pinned conversation kept completing and settled on a survivor
+        assert killed not in pinned_traces[-10:], \
+            _ctx(f"pinned conversation still answered by {killed}", plan)
+        survivors = [r for r in s.alive()
+                     if not r.replica_id.startswith("boot")]
+        for rep in survivors:
+            assert rep.generated >= 1, \
+                _ctx(f"{rep.replica_id} served nothing after rebalance",
+                     plan)
+
+        # -- 3. sustained queue depth scaled UP through the real provider ----
+        assert s.metrics.get_counter("tpu_fleet_scale_ups") >= 1, \
+            _ctx("autoscaler never scaled up", plan)
+        assert scale_pod_running, \
+            _ctx("scaled-up pod never reached Running", plan)
+        up_spans = [sp for sp in s.tracer.recent(2048)
+                    if sp["name"] == "fleet.scale"
+                    and sp["attrs"]["direction"] == "up"]
+        assert up_spans and "queue_depth" in up_spans[0]["attrs"]["reason"], \
+            _ctx(f"no queue-driven fleet.scale up span: {up_spans}", plan)
+
+        # -- 4. scale-down drained FIRST, then deleted pod + slice -----------
+        boot = s.replicas.get("boot-0")
+        assert boot is not None and any(
+            path == "/drain" for path, _ in boot.requests), \
+            _ctx(f"booted replica never got /drain: "
+                 f"{[p for p, _ in (boot.requests if boot else [])]}", plan)
+        assert s.metrics.get_counter("tpu_fleet_scale_downs") >= 1, \
+            _ctx("drain never completed into a scale-down", plan)
+        pods = [p["metadata"]["name"] for p in s.h.kube.list_pods()]
+        assert not any(p.startswith("tpu-serving-") for p in pods), \
+            _ctx(f"scaled-down pod still present: {pods}", plan)
+        with s.h.fake.lock:
+            cloud = set(s.h.fake.resources)
+        assert not cloud, _ctx(f"leaked QueuedResources: {cloud}", plan)
+
+        # -- 5. router -> engine spans under ONE trace id --------------------
+        assert trace_probe is not None
+        spans = {sp["name"]: sp
+                 for sp in s.tracer.get_trace(trace_probe[0])}
+        assert {"fleet.route", "serving.request"} <= set(spans), \
+            _ctx(f"trace {trace_probe[0]} spans: {sorted(spans)}", plan)
+        route, serving = spans["fleet.route"], spans["serving.request"]
+        assert route["parent_id"] == trace_probe[1], \
+            _ctx("fleet.route not parented on the caller's span", plan)
+        assert serving["parent_id"] == route["span_id"], \
+            _ctx("serving.request not parented on fleet.route", plan)
+
+        # -- 6. full-fleet saturation is a CLEAN 429, not a hang -------------
+        for rep in s.alive():
+            rep.set_stats(free_slots=0, queue_depth=4, max_queue_depth=4)
+        s.heartbeat_all()
+        status, out, rhdr = s.post("/generate", {"tokens": [1]})
+        assert status == 429 and rhdr.get("Retry-After") == "1", \
+            _ctx(f"saturated fleet -> {status} {rhdr}", plan)
+
+        # -- 7. the exported JSONL renders (tools/fleet_summary.py) ----------
+        s.tracer.close()
+        import pathlib
+        import sys
+        sys.path.insert(0, str(pathlib.Path(__file__).parent.parent
+                               / "tools"))
+        import fleet_summary
+        spans_l, snaps = fleet_summary.load(s.export)
+        assert spans_l, _ctx("trace export is empty", plan)
+        out_text = fleet_summary.render(spans_l, snaps)
+        assert "rep-" in out_text and "scale up" in out_text, \
+            _ctx(f"fleet_summary output incomplete:\n{out_text}", plan)
+    finally:
+        s.close()

@@ -67,5 +67,12 @@ def test_known_metric_families_present():
                  "tpu_serving_queue_wait_seconds",
                  "tpu_serving_batch_utilization",
                  "tpu_serving_kv_cache_tokens",
-                 "tpu_kubelet_schedule_to_ready_seconds"):
+                 "tpu_kubelet_schedule_to_ready_seconds",
+                 # fleet tier (ISSUE 4): registry + router + autoscaler
+                 "tpu_fleet_replicas", "tpu_fleet_evictions",
+                 "tpu_fleet_requests", "tpu_fleet_failovers",
+                 "tpu_fleet_stream_aborted", "tpu_fleet_rejected_saturated",
+                 "tpu_fleet_route_seconds", "tpu_fleet_desired_replicas",
+                 "tpu_fleet_scale_ups", "tpu_fleet_scale_downs",
+                 "tpu_serving_draining", "tpu_serving_drain_rejected"):
         assert name in described, name

@@ -479,6 +479,50 @@ class TestAdmissionControl:
         finally:
             httpd.shutdown()
 
+    def test_drain_rejects_new_finishes_inflight(self, params):
+        """Fleet scale-down contract (ISSUE 4): drain() stops admitting
+        (EngineDraining -> HTTP 503) but every already-accepted request
+        runs to completion, after which ``drained`` flips True."""
+        from k8s_runpod_kubelet_tpu.workloads.serving import EngineDraining
+        e = ServingEngine(CFG, params,
+                          ServingConfig(slots=2, max_prefill_len=32,
+                                        cache_len=64,
+                                        max_new_tokens=8)).start()
+        try:
+            futs = [e.submit([1, 2, 3 + i], max_new_tokens=6)
+                    for i in range(3)]
+            e.drain()
+            assert e.draining and not e.drained
+            rejected = e.submit([9, 9], max_new_tokens=2)
+            with pytest.raises(EngineDraining):
+                rejected.result(timeout=0)
+            # drained must never report True while a request is anywhere
+            # in flight — including the mid-hop windows (popped from the
+            # queue but still prefilling / popped from ready but not yet
+            # in a slot). Read drained FIRST: futures only move toward
+            # done, so "drained yet some future not done afterwards" is a
+            # genuine violation regardless of interleaving.
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                was_drained = e.drained
+                undone = [f for f in futs if not f.done()]
+                if undone:
+                    assert not was_drained, \
+                        (f"drained reported True with {len(undone)} "
+                         "request(s) still in flight — the fleet would "
+                         "delete this pod under them")
+                else:
+                    break
+            outs = [f.result(timeout=120) for f in futs]  # nothing dropped
+            assert all(1 <= len(o["tokens"]) <= 6 for o in outs)
+            deadline = time.time() + 30
+            while not e.drained and time.time() < deadline:
+                time.sleep(0.01)
+            assert e.drained
+            assert e.debug_snapshot()["draining"] is True
+        finally:
+            e.stop()
+
     def test_openai_stream_429_overloaded_type(self, params):
         import http.client
         import json as _json

@@ -1,0 +1,171 @@
+"""Render fleet JSONL (router spans and/or registry snapshots) into
+per-replica load + routing-decision tables.
+
+Input lines may be either:
+- **spans** from the router's ``--trace-export`` JSONL (``fleet.route``,
+  ``fleet.scale``, ``fleet.evict``; other span names are ignored), or
+- **registry snapshots** — the ``/debug/fleet`` payload (an object with a
+  ``"replicas"`` list), e.g. appended periodically by
+  ``curl router:8090/debug/fleet >> fleet.jsonl``.
+
+Both may be mixed in one file. Output:
+- a per-replica routing table: requests routed, affinity vs least-loaded
+  vs failover share, error count, p50/p95 router-side latency;
+- the latest load snapshot per replica (state, slots, queue, KV tokens,
+  TTFT p95) when snapshots are present;
+- the scale/evict event timeline.
+
+Usage:
+  python tools/fleet_summary.py fleet.jsonl
+  python tools/fleet_summary.py spans.jsonl --top 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+from collections import defaultdict
+
+
+def load(path: str) -> tuple[list[dict], list[dict]]:
+    """(spans, registry snapshots) from a mixed JSONL file."""
+    spans, snapshots = [], []
+    with open(path, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                print(f"warning: {path}:{lineno}: bad JSON, skipped",
+                      file=sys.stderr)
+                continue
+            if not isinstance(obj, dict):
+                continue
+            if "replicas" in obj and isinstance(obj["replicas"], list):
+                snapshots.append(obj)
+            elif "name" in obj and "trace_id" in obj:
+                spans.append(obj)
+    return spans, snapshots
+
+
+def percentile(sorted_vals: list[float], p: float) -> float:
+    if not sorted_vals:
+        return float("nan")
+    rank = max(1, min(len(sorted_vals),
+                      math.ceil(p / 100.0 * len(sorted_vals))))
+    return sorted_vals[rank - 1]
+
+
+def _fmt_ms(v: float) -> str:
+    return "-" if math.isnan(v) else f"{v * 1000:.1f}ms"
+
+
+def routing_table(spans: list[dict]) -> list[str]:
+    routes = [s for s in spans if s.get("name") == "fleet.route"]
+    if not routes:
+        return ["(no fleet.route spans)"]
+    per: dict[str, dict] = defaultdict(
+        lambda: {"n": 0, "affinity": 0, "least_loaded": 0, "failover": 0,
+                 "errors": 0, "streams": 0, "durs": []})
+    for s in routes:
+        a = s.get("attrs", {})
+        rid = a.get("replica_id") or "(unrouted)"
+        row = per[rid]
+        row["n"] += 1
+        reason = a.get("reason", "")
+        if reason in ("affinity", "least_loaded"):
+            row[reason] += 1
+        if int(a.get("attempts", 1) or 1) > 1:
+            row["failover"] += 1
+        if int(a.get("status", 200) or 200) >= 400:
+            row["errors"] += 1
+        if a.get("streamed"):
+            row["streams"] += 1
+        row["durs"].append(float(s.get("duration_s", 0.0)))
+    out = ["== routing decisions (fleet.route spans) ==",
+           f"{'replica':<20} {'reqs':>6} {'affin':>6} {'least':>6} "
+           f"{'failov':>6} {'stream':>6} {'errors':>6} {'p50':>9} {'p95':>9}"]
+    for rid in sorted(per, key=lambda r: -per[r]["n"]):
+        row = per[rid]
+        durs = sorted(row["durs"])
+        out.append(f"{rid:<20} {row['n']:>6} {row['affinity']:>6} "
+                   f"{row['least_loaded']:>6} {row['failover']:>6} "
+                   f"{row['streams']:>6} {row['errors']:>6} "
+                   f"{_fmt_ms(percentile(durs, 50)):>9} "
+                   f"{_fmt_ms(percentile(durs, 95)):>9}")
+    return out
+
+
+def load_table(snapshots: list[dict]) -> list[str]:
+    if not snapshots:
+        return []
+    latest: dict[str, dict] = {}
+    for snap in snapshots:  # later lines win: the file is appended in order
+        for rep in snap.get("replicas", []):
+            if isinstance(rep, dict) and rep.get("replica_id"):
+                latest[rep["replica_id"]] = rep
+    out = ["", "== latest replica load (registry snapshots) ==",
+           f"{'replica':<20} {'state':<9} {'slots':>11} {'queue':>6} "
+           f"{'kv_tokens':>10} {'ttft_p95':>9} {'hb_age':>7}"]
+    for rid in sorted(latest):
+        rep = latest[rid]
+        st = rep.get("stats", {})
+        slots = f"{st.get('active_slots', 0)}/{st.get('max_slots', 0)}"
+        out.append(f"{rid:<20} {rep.get('state', '?'):<9} {slots:>11} "
+                   f"{st.get('queue_depth', 0):>6} "
+                   f"{st.get('kv_cache_tokens', 0):>10} "
+                   f"{st.get('ttft_p95_s', 0.0):>8.3f}s "
+                   f"{rep.get('heartbeat_age_s', 0.0):>6.1f}s")
+    return out
+
+
+def event_timeline(spans: list[dict], top: int) -> list[str]:
+    events = [s for s in spans
+              if s.get("name") in ("fleet.scale", "fleet.evict")]
+    if not events:
+        return []
+    events.sort(key=lambda s: s.get("start", 0.0))
+    out = ["", f"== scale/evict timeline (last {top}) =="]
+    for s in events[-top:]:
+        a = s.get("attrs", {})
+        if s["name"] == "fleet.scale":
+            out.append(f"  t={s.get('start', 0.0):.1f} scale {a.get('direction')} "
+                       f"{a.get('from')} -> {a.get('to')} "
+                       f"[{a.get('target', '')}] — {a.get('reason', '')}")
+        else:
+            out.append(f"  t={s.get('start', 0.0):.1f} evict "
+                       f"{a.get('replica_id')} — {a.get('reason', '')}")
+    return out
+
+
+def render(spans: list[dict], snapshots: list[dict], top: int = 20) -> str:
+    lines = routing_table(spans)
+    lines += load_table(snapshots)
+    lines += event_timeline(spans, top)
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="per-replica load + routing-decision tables from "
+                    "fleet JSONL")
+    p.add_argument("path", help="JSONL file: router span export and/or "
+                                "appended /debug/fleet snapshots")
+    p.add_argument("--top", type=int, default=20,
+                   help="scale/evict timeline length")
+    args = p.parse_args(argv)
+    spans, snapshots = load(args.path)
+    if not spans and not snapshots:
+        print(f"{args.path}: no fleet spans or registry snapshots found",
+              file=sys.stderr)
+        return 1
+    print(render(spans, snapshots, args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
