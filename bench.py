@@ -54,9 +54,15 @@ import time
 _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, _HERE)
 
-# bf16 peak TFLOP/s per chip by generation (public spec sheets)
-_PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0,
-                "cpu": 0.1}
+# bf16 peak TFLOP/s per chip by generation — ONE table shared with the live
+# telemetry layer (workloads/telemetry.py), so the bench's offline MFU and a
+# running worker's tpu_training_mfu_ratio gauge use the same roofline.
+try:
+    from k8s_runpod_kubelet_tpu.workloads.telemetry import (
+        PEAK_TFLOPS_BF16 as _PEAK_TFLOPS)
+except Exception:  # noqa: BLE001 — bench must run even on a broken tree
+    _PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0,
+                    "cpu": 0.1}
 _TARGET_MFU = 0.40
 
 _TPU_ATTEMPTS = 3          # orchestrator: tries at the TPU backend
@@ -183,7 +189,20 @@ def run_bench(quick: bool, expect_tpu: bool = False) -> dict:
         mesh = make_mesh(MeshConfig())  # pure data-parallel over chips
         tc.batch_size *= n_chips
 
-    trainer = Trainer(cfg, tc, mesh=mesh)
+    # goodput ledger on the timed run (ISSUE 5): the headline row records
+    # where wall time went (productive vs compile/checkpoint/stall), so
+    # BENCH_rXX.json carries goodput next to MFU and the perf trajectory is
+    # self-reporting. Attached for the warmup too — warmup compile lands in
+    # the compile bucket, exactly what a goodput report should show.
+    try:
+        from k8s_runpod_kubelet_tpu.workloads.telemetry import (
+            TrainingTelemetry)
+        tel = TrainingTelemetry(tokens_per_step=tc.batch_size * tc.seq_len,
+                                model_params=cfg.param_count, n_chips=n_chips,
+                                accelerator_type=gen)
+    except Exception:  # noqa: BLE001 — same contract as the peak-table
+        tel = None     # fallback: the number still lands, minus goodput
+    trainer = Trainer(cfg, tc, mesh=mesh, telemetry=tel)
     batches = synthetic_batches(cfg, tc, mesh)
 
     trainer.run(steps=warmup_steps, batches=batches)  # compile + warm
@@ -239,6 +258,10 @@ def run_bench(quick: bool, expect_tpu: bool = False) -> dict:
         "model": cfg.name,
         "params": n_params,
         "mfu": round(mfu, 3),
+        "goodput": round(tel.ledger.goodput, 3) if tel else None,
+        "goodput_buckets": {k: round(v, 3) for k, v in
+                            tel.ledger.snapshot()["buckets"].items()
+                            if v > 0} if tel else None,
         "seq_len": tc.seq_len,
         "global_batch": tc.batch_size,
     }

@@ -85,6 +85,19 @@ def parse_flags(argv: list[str]) -> argparse.Namespace:
                         "gang-launch/ready) to this JSONL file; render with "
                         "tools/trace_summary.py. Empty = in-memory ring "
                         "only, served at the health server's /debug/traces")
+    p.add_argument("--telemetry-port", dest="telemetry_port", type=int,
+                   default=None,
+                   help="training-telemetry port injected into gang workers "
+                        "(TPU_TELEMETRY_PORT; worker-0 aggregates step "
+                        "heartbeats there; 0 = don't inject)")
+    p.add_argument("--straggler-factor", dest="straggler_factor", type=float,
+                   default=None,
+                   help="workload watchdog: flag a host whose step time "
+                        "exceeds this multiple of the across-host median")
+    p.add_argument("--stall-timeout-s", dest="stall_timeout_s", type=float,
+                   default=None,
+                   help="emit TrainingStalled when a Running training pod's "
+                        "scraped step counter stops advancing for this long")
     return p.parse_args(argv)
 
 
@@ -174,7 +187,8 @@ def build(cfg: config_mod.Config, kube=None, tpu=None, worker_transport=None,
                                   tls_key=cfg.tls_key_file,
                                   auth_token=cfg.api_auth_token)
     health = HealthServer(cfg.health_address, ready_func=provider.ping,
-                          metrics=metrics, tracer=tracer)
+                          metrics=metrics, tracer=tracer,
+                          train_status=provider.training_status)
     return (provider, node_controller, pod_controller, ref_controller,
             api_server, health)
 

@@ -105,6 +105,18 @@ class Config:
     fleet_scale_up_cooldown_s: float = 30.0
     fleet_scale_down_cooldown_s: float = 120.0
 
+    # training telemetry (ISSUE 5). telemetry_port is a gang COORDINATION
+    # var: injected into every worker's env (TPU_TELEMETRY_PORT +
+    # TPU_TELEMETRY_ADDRESS = worker-0) at gang launch so peers can post
+    # step heartbeats to worker-0's aggregator; 0 disables injection.
+    # stall_timeout_s doubles as the kubelet-side deadline: a Running
+    # training pod whose scraped step counter stops advancing for this long
+    # gets a TrainingStalled event + pod.training_stalled span.
+    # straggler_factor is the workload watchdog's k×median step-time flag.
+    telemetry_port: int = 8478
+    straggler_factor: float = 3.0
+    stall_timeout_s: float = 300.0
+
     # servers
     listen_port: int = 10250
     health_address: str = ":8080"
@@ -167,6 +179,13 @@ class Config:
         if self.fleet_scale_up_cooldown_s < 0 \
                 or self.fleet_scale_down_cooldown_s < 0:
             errs.append("fleet cooldowns must be >= 0")
+        if not 0 <= self.telemetry_port <= 65535:
+            errs.append("telemetry_port must be in [0, 65535] (0 = off)")
+        if self.straggler_factor <= 1.0:
+            errs.append("straggler_factor must be > 1 (1x median would flag "
+                        "half the fleet)")
+        if self.stall_timeout_s <= 0:
+            errs.append("stall_timeout_s must be > 0")
         if errs:
             raise ValueError("invalid config: " + "; ".join(errs))
         return self
@@ -194,6 +213,9 @@ _ENV_MAP = {
     "TPU_FLEET_MAX_REPLICAS": "fleet_max_replicas",
     "TPU_FLEET_SCALE_UP_COOLDOWN_S": "fleet_scale_up_cooldown_s",
     "TPU_FLEET_SCALE_DOWN_COOLDOWN_S": "fleet_scale_down_cooldown_s",
+    "TPU_TELEMETRY_PORT": "telemetry_port",
+    "TPU_STRAGGLER_FACTOR": "straggler_factor",
+    "TPU_STALL_TIMEOUT_S": "stall_timeout_s",
 }
 
 

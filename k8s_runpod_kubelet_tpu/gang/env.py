@@ -35,6 +35,9 @@ def compute_worker_env(
     slice_id: int = 0,
     megascale_coordinator: Optional[str] = None,
     megascale_port: int = DEFAULT_MEGASCALE_PORT,
+    telemetry_port: int = 0,
+    straggler_factor: float = 0.0,
+    stall_timeout_s: float = 0.0,
 ) -> list[dict[str, str]]:
     """Build the per-worker env overlay for a gang launch.
 
@@ -79,6 +82,26 @@ def compute_worker_env(
             "TPU_SLICE_NAME": qr.name,
             "TPU_ZONE": qr.zone,
         }
+        if telemetry_port:
+            # training telemetry (ISSUE 5): the GLOBAL process 0 serves
+            # /metrics + /debug/train + POST /heartbeat; peers post their
+            # per-step heartbeats to TPU_TELEMETRY_ADDRESS. Multislice: that
+            # aggregator lives on slice 0's worker-0 — the SAME host the
+            # megascale coordinator convention names — NOT this slice's own
+            # worker-0 (train_main only starts the server where
+            # JAX_PROCESS_ID == 0, so a per-slice address would drop every
+            # beat from slices > 0 and false-flag all their hosts stalled)
+            tel_host = (megascale_coordinator if num_slices > 1
+                        else ((hosts[0].hostname or hosts[0].internal_ip)
+                              if hosts else ""))
+            e["TPU_TELEMETRY_PORT"] = str(telemetry_port)
+            e["TPU_TELEMETRY_ADDRESS"] = f"{tel_host}:{telemetry_port}"
+        # the watchdog knobs ride the same injection so the operator's
+        # helm/config values actually reach train_main's env-driven defaults
+        if straggler_factor > 0:
+            e["TPU_STRAGGLER_FACTOR"] = str(straggler_factor)
+        if stall_timeout_s > 0:
+            e["TPU_STALL_TIMEOUT_S"] = str(stall_timeout_s)
         if num_slices > 1:
             # DCN multislice (MegaScale) wiring — SURVEY.md §5.8
             e.update({

@@ -285,6 +285,24 @@ class GangExecutor:
             return None
         return re.search(pattern, body)
 
+    def last_in_logs(self, qr: QueuedResource, pattern: str,
+                     worker_id: int = 0, tail_lines: int = 500
+                     ) -> Optional["re.Match"]:
+        """Like find_in_logs but the LAST match wins — the shape telemetry
+        scrapes need (a worker logs one TPU_TELEMETRY state line per step;
+        only the newest describes the pod's current progress)."""
+        if not qr.workers or not 0 <= worker_id < len(qr.workers):
+            return None
+        try:
+            body = self.transport.logs(qr, worker_id, tail_lines)
+        except Exception as e:  # noqa: BLE001 — worker may be mid-boot/gone
+            log.debug("log probe on %s/w%d failed: %s", qr.name, worker_id, e)
+            return None
+        match = None
+        for match in re.finditer(pattern, body):
+            pass
+        return match
+
     def logs(self, qr: QueuedResource, worker_id: Optional[int] = None,
              tail_lines: Optional[int] = None) -> str:
         """One worker's logs, or all workers' logs with [worker N] prefixes."""

@@ -82,6 +82,24 @@ class FakeWorkerHost(WorkerTransport):
             if c:
                 c.log_lines.append(line)
 
+    # -- the training-telemetry line protocol (ISSUE 5) --------------------------
+    # The fake host speaks the same wire format train_main emits, so the
+    # kubelet's log-scrape path (GangExecutor.last_in_logs + parse_telemetry)
+    # is exercised verbatim by the straggler soak.
+
+    def heartbeat(self, qr_name: str, worker_id: int, step: int,
+                  step_time_s: float):
+        """Worker logs one TPU_STEP_HEARTBEAT protocol line."""
+        from ..workloads.telemetry import format_heartbeat
+        self.append_log(qr_name, worker_id,
+                        format_heartbeat(worker_id, step, step_time_s))
+
+    def telemetry(self, qr_name: str, payload: dict, worker_id: int = 0):
+        """Worker-0 logs one TPU_TELEMETRY state line (the kubelet's
+        scrape target)."""
+        from ..workloads.telemetry import format_telemetry
+        self.append_log(qr_name, worker_id, format_telemetry(payload))
+
     # -- the docker-lite grammar ------------------------------------------------
 
     def host_run(self, qr, worker_id, cmd, timeout_s=60.0):

@@ -11,6 +11,12 @@ entirely (SURVEY.md §5.5):
   /debug/engine  statusz-style snapshot from the injected callable (the
                  serving engine's in-flight slots / queue / cache occupancy;
                  404 when the process has no engine, e.g. the kubelet)
+  /debug/train   training-telemetry statusz from the injected callable: the
+                 goodput ledger buckets, step/MFU stats, per-host watchdog
+                 table on a training worker-0 — or, on the kubelet, the
+                 per-pod telemetry the reconcile loop scraped (ISSUE 5)
+  /heartbeat     POST (training worker-0 only): peers' step-heartbeat
+                 protocol lines, fed to the straggler watchdog
 """
 
 from __future__ import annotations
@@ -73,6 +79,25 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send_json(200, hs.engine_status())
             except Exception as e:  # noqa: BLE001 — debug must not 500-loop
                 return self._send_json(500, {"error": str(e)})
+        if path.path == "/debug/train" and hs.train_status is not None:
+            try:
+                return self._send_json(200, hs.train_status())
+            except Exception as e:  # noqa: BLE001 — debug must not 500-loop
+                return self._send_json(500, {"error": str(e)})
+        self._send(404, b"not found")
+
+    def do_POST(self):
+        hs = self.server_ref
+        path = urllib.parse.urlparse(self.path)
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        if path.path == "/heartbeat" and hs.heartbeat_sink is not None:
+            try:
+                hs.heartbeat_sink(body.decode("utf-8", errors="replace"))
+            except Exception as e:  # noqa: BLE001 — a bad beat must not 500-loop
+                log.debug("heartbeat ingest failed: %s", e)
+                return self._send_json(400, {"error": str(e)})
+            return self._send_json(200, {"ok": True})
         self._send(404, b"not found")
 
 
@@ -81,12 +106,16 @@ class HealthServer:
                  ready_func: Optional[Callable[[], bool]] = None,
                  metrics: Optional[Metrics] = None,
                  tracer: Optional[Tracer] = None,
-                 engine_status: Optional[Callable[[], dict]] = None):
+                 engine_status: Optional[Callable[[], dict]] = None,
+                 train_status: Optional[Callable[[], dict]] = None,
+                 heartbeat_sink: Optional[Callable[[str], None]] = None):
         host, _, port = address.rpartition(":")
         self.ready_func = ready_func
         self.metrics = metrics
         self.tracer = tracer
         self.engine_status = engine_status
+        self.train_status = train_status
+        self.heartbeat_sink = heartbeat_sink
         self.healthy = threading.Event()
         self.healthy.set()
         handler = type("BoundHandler", (_Handler,), {"server_ref": self})
@@ -101,7 +130,7 @@ class HealthServer:
     def start(self) -> "HealthServer":
         self._thread.start()
         log.info("health server on :%d (/healthz /readyz /metrics "
-                 "/debug/traces /debug/engine)", self.port)
+                 "/debug/traces /debug/engine /debug/train)", self.port)
         return self
 
     @property

@@ -83,6 +83,13 @@ class Metrics:
         with self.lock:
             self.gauges[self._key(name, labels)] = value
 
+    def remove_gauge(self, name: str, labels: Optional[dict] = None):
+        """Drop one labeled gauge series. For per-entity gauges (per-pod,
+        per-replica) whose entity was deleted: a phantom series — e.g. a
+        stalled=1 for a pod that no longer exists — must not alert forever."""
+        with self.lock:
+            self.gauges.pop(self._key(name, labels), None)
+
     def observe(self, name: str, value: float, labels: Optional[dict] = None):
         with self.lock:
             key = self._key(name, labels)

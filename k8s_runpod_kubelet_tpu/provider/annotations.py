@@ -59,6 +59,14 @@ class Annotations:
     # durable so a kubelet restart neither re-announces an already-announced
     # recovery nor swallows one that hadn't been announced yet
     RECOVERED_ATTEMPT = "tpu.dev/recovered-attempt"
+    # training telemetry (ISSUE 5): the reconcile loop scrapes worker-0's
+    # TPU_TELEMETRY log line for Running training pods and mirrors the
+    # progress signals here, so `kubectl get pod -o yaml` (and the fleet
+    # tier) can read goodput/MFU/progress without touching the workers
+    GOODPUT = "tpu.dev/goodput"
+    MFU = "tpu.dev/mfu"
+    LAST_STEP = "tpu.dev/last-step"
+
     # observability: the trace_id shared by this pod's lifecycle spans
     # (create -> deploy -> ACTIVE -> ready). Durable on the pod so a slow
     # serving request on the slice can be joined back to how it was born

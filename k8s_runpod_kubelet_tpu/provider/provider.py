@@ -33,6 +33,7 @@ from .annotations import Annotations as A
 from .node_spec import build_node
 from .reconcile import ReconcileMixin
 from .recovery import RecoveryMixin
+from .training_watch import TrainingWatchMixin
 from .translate import TranslationError, prepare_tpu_parameters
 
 log = logging.getLogger(__name__)
@@ -74,6 +75,20 @@ class InstanceInfo:
     # RecoveredFromPreemption event/span has been emitted (reset on requeue so
     # every recovery announces itself exactly once)
     recovery_event_emitted: bool = False
+    # training telemetry (ISSUE 5): the reconcile loop's scrape of worker-0's
+    # TPU_TELEMETRY line. train_step_at is when the step counter last
+    # ADVANCED (the stall clock); train_annotated is the last annotation
+    # fingerprint patched (no per-sweep patch spam); train_stalled marks an
+    # announced stall episode (one TrainingStalled event per episode)
+    train_last_step: Optional[int] = None
+    train_step_at: Optional[float] = None
+    train_stalled: bool = False
+    train_annotated: tuple = ()
+    # scrape backoff: when the first probe happened, and the last one —
+    # a pod that never emits telemetry (serving) drops to a slow probe
+    # cadence instead of paying a log fetch every sweep forever
+    train_first_probe_at: Optional[float] = None
+    train_probe_at: Optional[float] = None
     # lifecycle tracing: all of this pod's spans share trace_id (also
     # annotated on the pod as tpu.dev/trace-id); trace_root is the
     # pod.lifecycle root span id the phase spans parent under — derived
@@ -96,7 +111,7 @@ class DeletedPodInfo:
     unreachable_since: Optional[float] = None
 
 
-class Provider(ReconcileMixin, RecoveryMixin):
+class Provider(ReconcileMixin, RecoveryMixin, TrainingWatchMixin):
     def __init__(self, cfg: Config, kube: KubeClient, tpu: TpuClient,
                  gang_executor: Optional[GangExecutor] = None,
                  metrics: Optional[Metrics] = None,
@@ -167,6 +182,7 @@ class Provider(ReconcileMixin, RecoveryMixin):
         self.metrics.describe("tpu_kubelet_preemption_recoveries",
                               "requeued pods that came back Ready "
                               "(RecoveredFromPreemption)")
+        self._describe_training_metrics()
         self._probe_cloud(force=True)
 
     # -- helpers ---------------------------------------------------------------
@@ -364,6 +380,7 @@ class Provider(ReconcileMixin, RecoveryMixin):
                 self.deleted[key] = DeletedPodInfo(
                     qr_name=qr_name, zone=zone, deleted_at=self.clock())
         log.info("DeletePod %s (slice=%s)", key, qr_name or "<none>")
+        self._clear_training_gauges(key)
         if qr_name:
             try:
                 self.tpu.delete_queued_resource(qr_name, zone=zone)

@@ -163,6 +163,16 @@ class ReconcileMixin:
             self._release_slice(key, info)
             return
 
+        # training telemetry (ISSUE 5): scrape worker-0's TPU_TELEMETRY line
+        # for running training workloads — annotations, per-pod gauges, and
+        # the stall watchdog (TrainingStalled). Best-effort: a scrape
+        # failure must never fail the reconcile pass.
+        if state is S.ACTIVE and info.workload_launched:
+            try:
+                self._scrape_training(key, pod, info, detailed, now)
+            except Exception as e:  # noqa: BLE001 — observability only
+                log.debug("training scrape of %s failed: %s", key, e)
+
         status = translate_status(pod, detailed,
                                   workload_launched=info.workload_launched)
         fp = status_fingerprint(status)
@@ -279,6 +289,7 @@ class ReconcileMixin:
     def _release_slice(self, key: str, info):
         log.info("pod %s is terminal — deleting slice %s to stop billing",
                  key, info.qr_name)
+        self._clear_training_gauges(key)
         try:
             self.tpu.delete_queued_resource(info.qr_name, zone=info.zone)
             self.metrics.incr("tpu_kubelet_slices_released")
@@ -315,6 +326,10 @@ class ReconcileMixin:
                                     A.PREEMPTION_COUNT: str(info.preemption_count)}}})
         except KubeApiError as e:
             log.warning("preemption-count annotate of %s failed: %s", key, e)
+        # the dead attempt's per-pod gauges go with it — BEFORE the reset
+        # below wipes train_last_step (and with it the memory that a
+        # stalled=1 series was ever exported)
+        self._clear_training_gauges(key)
         with self.lock:
             # keep the cached pod in sync even if the API patch failed: the
             # preemption count feeds qr_name_for_pod, which must never reuse
@@ -333,6 +348,14 @@ class ReconcileMixin:
             # start at ITS deploy, not this dead slice's
             info.pending_since = self.clock()
             info.recovery_event_emitted = False  # the NEXT recovery announces
+            # the relaunch starts a fresh telemetry stream: a stale stall
+            # clock must not flag the new attempt before its first scrape
+            info.train_last_step = None
+            info.train_step_at = None
+            info.train_stalled = False
+            info.train_annotated = ()
+            info.train_first_probe_at = None
+            info.train_probe_at = None
         self.metrics.incr("tpu_kubelet_preemption_requeues")
 
     def _gang_launch(self, key: str, pod: dict, info, detailed):
@@ -343,9 +366,12 @@ class ReconcileMixin:
         num_slices = max(1, resolver.get_int(A.NUM_SLICES, 1))
         slice_id = resolver.get_int(A.SLICE_ID, 0)
         mega = resolver.get(A.MEGASCALE_COORDINATOR) or None
-        worker_env = compute_worker_env(qr, num_slices=num_slices,
-                                        slice_id=slice_id,
-                                        megascale_coordinator=mega)
+        worker_env = compute_worker_env(
+            qr, num_slices=num_slices, slice_id=slice_id,
+            megascale_coordinator=mega,
+            telemetry_port=self.cfg.telemetry_port,
+            straggler_factor=self.cfg.straggler_factor,
+            stall_timeout_s=self.cfg.stall_timeout_s)
         try:
             params = prepare_tpu_parameters(self.kube, pod, self.cfg)
         except TranslationError as e:

@@ -1,0 +1,196 @@
+"""Kubelet-side training-progress watch: scrape, annotate, stall detection.
+
+The control-plane half of ISSUE 5. For every Running pod whose workload
+emits the TPU_TELEMETRY line protocol (workloads/telemetry.py — train_main
+prints one state line per step on worker-0), the reconcile loop:
+
+- scrapes the NEWEST line out of worker-0's logs through the same
+  ``GangExecutor`` log surface the preemption-recovery event already uses
+  (so the fake-cloud path exercises the real parse),
+- mirrors progress onto the pod as ``tpu.dev/goodput`` / ``tpu.dev/mfu`` /
+  ``tpu.dev/last-step`` annotations (patched only on change),
+- re-exports fleet-visible ``tpu_training_*`` gauges labeled by pod,
+- flags a pod whose step counter stops advancing for ``cfg.stall_timeout_s``
+  with a ``TrainingStalled`` Warning event + ``pod.training_stalled`` span
+  (the degraded-signal vocabulary ISSUE 3 established), clearing the flag
+  loudly when progress resumes.
+
+Pods that never emit a telemetry line (serving, adopted workloads) get a
+grace window of one stall timeout worth of per-sweep probes (first-step
+compile can be long), then drop to one log-tail fetch per stall_timeout_s
+— and are otherwise untouched.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+from ..kube.client import KubeApiError
+from ..workloads.telemetry import TELEMETRY_PATTERN
+from .annotations import Annotations as A
+
+log = logging.getLogger(__name__)
+
+
+class TrainingWatchMixin:
+    def _describe_training_metrics(self):
+        m = self.metrics
+        m.describe("tpu_training_pod_goodput",
+                   "scraped per-pod goodput ratio (worker-0 telemetry)")
+        m.describe("tpu_training_pod_mfu",
+                   "scraped per-pod MFU (worker-0 telemetry)")
+        m.describe("tpu_training_pod_tokens_per_second",
+                   "scraped per-pod training throughput")
+        m.describe("tpu_training_pod_last_step",
+                   "scraped per-pod last completed optimizer step")
+        m.describe("tpu_training_pod_stalled",
+                   "1 while a training pod's step counter is not advancing")
+        m.describe("tpu_kubelet_training_stalls",
+                   "TrainingStalled events emitted (stall episodes seen)")
+
+    def _scrape_training(self, key: str, pod: dict, info, detailed, now: float):
+        """One telemetry pass for a Running training pod. Best-effort by
+        construction: any transport/parse failure leaves the pod exactly as
+        the last sweep did (the stall clock keeps running — a worker whose
+        logs went dark IS not provably progressing)."""
+        if self.gang is None or not info.workload_launched:
+            return
+        if not self._should_probe(info, now):
+            return
+        info.train_probe_at = now
+        if info.train_first_probe_at is None:
+            info.train_first_probe_at = now
+        payload = None
+        m = self.gang.last_in_logs(detailed.resource, TELEMETRY_PATTERN)
+        if m is not None:
+            try:
+                payload = json.loads(m.group(1))
+            except (json.JSONDecodeError, IndexError):
+                payload = None
+        if payload is not None and isinstance(payload.get("step"), int):
+            self._note_training_progress(key, pod, info, payload, now)
+        # the stall deadline applies from the FIRST telemetry sighting: a
+        # pod that never reported is not known to be training at all
+        if info.train_step_at is not None:
+            self._check_training_stall(key, pod, info, now)
+
+    def _should_probe(self, info, now: float) -> bool:
+        """Known training pods (telemetry seen) probe every sweep. A pod
+        that has never emitted a line gets a grace window of one stall
+        timeout (first-step compile can be long), then drops to one probe
+        per stall_timeout_s — serving pods must not pay a worker log fetch
+        on every reconcile pass forever, but a late-blooming training pod
+        is still picked up eventually."""
+        if info.train_last_step is not None:
+            return True
+        if info.train_first_probe_at is None:
+            return True
+        if now - info.train_first_probe_at <= self.cfg.stall_timeout_s:
+            return True
+        return (info.train_probe_at is None
+                or now - info.train_probe_at >= self.cfg.stall_timeout_s)
+
+    def _note_training_progress(self, key: str, pod: dict, info,
+                                payload: dict, now: float):
+        step = int(payload["step"])
+        goodput = float(payload.get("goodput") or 0.0)
+        mfu = float(payload.get("mfu") or 0.0)
+        tok_s = float(payload.get("tokens_per_sec") or 0.0)
+        with self.lock:
+            advanced = info.train_last_step is None or step > info.train_last_step
+            if advanced:
+                info.train_last_step = step
+                info.train_step_at = now
+            elif info.train_step_at is None:
+                info.train_step_at = now
+            was_stalled = info.train_stalled
+            if advanced and was_stalled:
+                info.train_stalled = False
+        labels = {"pod": key}
+        self.metrics.set_gauge("tpu_training_pod_goodput", goodput, labels)
+        self.metrics.set_gauge("tpu_training_pod_mfu", mfu, labels)
+        self.metrics.set_gauge("tpu_training_pod_tokens_per_second", tok_s,
+                               labels)
+        self.metrics.set_gauge("tpu_training_pod_last_step", float(step),
+                               labels)
+        if advanced and was_stalled:
+            self.metrics.set_gauge("tpu_training_pod_stalled", 0.0, labels)
+            log.info("pod %s training progress resumed at step %d", key, step)
+            self.emit_event(pod, "TrainingProgressing",
+                            f"step counter advancing again (step {step})")
+        self._annotate_training(key, pod, info, step, goodput, mfu)
+
+    def _annotate_training(self, key: str, pod: dict, info, step: int,
+                           goodput: float, mfu: float):
+        anns = {A.LAST_STEP: str(step), A.GOODPUT: f"{goodput:.3f}",
+                A.MFU: f"{mfu:.3f}"}
+        fingerprint = tuple(sorted(anns.items()))
+        with self.lock:
+            if fingerprint == info.train_annotated:
+                return
+        try:
+            ns, name = key.split("/", 1)
+            updated = self.kube.patch_pod(ns, name,
+                                          {"metadata": {"annotations": anns}})
+            with self.lock:
+                info.train_annotated = fingerprint
+                if key in self.pods:
+                    self.pods[key] = updated
+        except KubeApiError as e:
+            log.debug("training annotate of %s failed (next sweep retries): %s",
+                      key, e)
+
+    def _check_training_stall(self, key: str, pod: dict, info, now: float):
+        stalled_for = now - info.train_step_at
+        if stalled_for <= self.cfg.stall_timeout_s:
+            return
+        with self.lock:
+            if info.train_stalled:
+                return  # one event/span per episode, not per sweep
+            info.train_stalled = True
+        self.metrics.set_gauge("tpu_training_pod_stalled", 1.0, {"pod": key})
+        self.metrics.incr("tpu_kubelet_training_stalls")
+        self.tracer.record("pod.training_stalled", info.train_step_at, now,
+                           trace_id=info.trace_id, parent_id=info.trace_root,
+                           attrs={"pod": key, "slice": info.qr_name,
+                                  "last_step": info.train_last_step,
+                                  "stalled_for_s": round(stalled_for, 3)})
+        log.warning("pod %s training STALLED: step %s for %.0fs (> %.0fs)",
+                    key, info.train_last_step, stalled_for,
+                    self.cfg.stall_timeout_s)
+        self.emit_event(pod, "TrainingStalled",
+                        f"step counter stuck at {info.train_last_step} for "
+                        f"{stalled_for:.0f}s (stall_timeout_s="
+                        f"{self.cfg.stall_timeout_s:.0f})",
+                        event_type="Warning")
+
+    def _clear_training_gauges(self, key: str):
+        """Drop the pod's labeled gauge series when it leaves (deleted,
+        terminal, or requeued) — a phantom tpu_training_pod_stalled=1 for a
+        pod that no longer exists would page someone forever. Unconditional
+        (removal is idempotent): gating on train_last_step would leak the
+        series of a pod whose requeue already reset that field."""
+        labels = {"pod": key}
+        for name in ("tpu_training_pod_goodput", "tpu_training_pod_mfu",
+                     "tpu_training_pod_tokens_per_second",
+                     "tpu_training_pod_last_step", "tpu_training_pod_stalled"):
+            self.metrics.remove_gauge(name, labels)
+
+    def training_status(self) -> dict:
+        """/debug/train on the kubelet health server: the per-pod training
+        telemetry the reconcile loop has scraped."""
+        with self.lock:
+            pods = {}
+            for key, info in self.instances.items():
+                if info.train_last_step is None:
+                    continue
+                pods[key] = {
+                    "last_step": info.train_last_step,
+                    "stalled": info.train_stalled,
+                    "last_advance_age_s": round(
+                        self.clock() - info.train_step_at, 3)
+                    if info.train_step_at is not None else None,
+                    "slice": info.qr_name,
+                }
+        return {"pods": pods, "stall_timeout_s": self.cfg.stall_timeout_s}

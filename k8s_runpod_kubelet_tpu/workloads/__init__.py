@@ -2,8 +2,21 @@
 
 These are what the kubelet's pods actually run — the in-repo implementations of
 the north-star workloads (BASELINE.json configs 2-5).
+
+Imports are LAZY: ``workloads.telemetry`` is dependency-free and the kubelet
+imports it (provider/training_watch.py parses the telemetry line protocol);
+an eager ``from .train import ...`` here would drag jax into the control
+plane just to reach a stdlib module.
 """
 
-from .train import TrainConfig, Trainer, make_train_step, synthetic_batches
+_TRAIN_EXPORTS = ("TrainConfig", "Trainer", "make_train_step",
+                  "synthetic_batches")
 
-__all__ = ["TrainConfig", "Trainer", "make_train_step", "synthetic_batches"]
+__all__ = list(_TRAIN_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _TRAIN_EXPORTS:
+        from . import train
+        return getattr(train, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

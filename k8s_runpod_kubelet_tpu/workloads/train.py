@@ -238,10 +238,17 @@ class Trainer:
     def __init__(self, cfg: LlamaConfig, tc: TrainConfig,
                  mesh: Optional[Mesh] = None, seed: int = 0,
                  initial_params: Optional[Params] = None,
-                 lora: Optional[Any] = None):
+                 lora: Optional[Any] = None, telemetry: Optional[Any] = None):
         self.cfg = cfg
         self.tc = tc
         self.mesh = mesh
+        # ISSUE 5: a workloads.telemetry.TrainingTelemetry — when present the
+        # loop device-syncs EVERY step (true per-step wall times are the
+        # point; the per-step overhead is the cost of the health signal) and
+        # feeds the goodput ledger / step stats / spans. None = the original
+        # fire-and-forget loop, unchanged.
+        self.telemetry = telemetry
+        self._compiled = False  # True once any step has run (bench re-runs)
         self.model = LlamaModel(cfg, mesh)
         if initial_params is not None:
             # host (e.g. HF-converted) tree: commit straight to the target
@@ -295,10 +302,19 @@ class Trainer:
         if self._ckpt is None:
             return
         import orbax.checkpoint as ocp
-        self._ckpt.save(self.step, args=ocp.args.StandardSave(
-            {"params": self.params, "opt_state": self.opt_state}))
+        import contextlib
+        # block=False only STAGES the write: the telemetry exposure marker
+        # must not reset until wait_pending() proves it durable — a
+        # preemption mid-background-write loses those steps
+        span = (self.telemetry.checkpoint("save", step=self.step,
+                                          durable=block)
+                if self.telemetry is not None else contextlib.nullcontext())
+        with span:
+            self._ckpt.save(self.step, args=ocp.args.StandardSave(
+                {"params": self.params, "opt_state": self.opt_state}))
+            if block:
+                self._ckpt.wait_until_finished()
         if block:
-            self._ckpt.wait_until_finished()
             log.info("checkpoint saved at step %d", self.step)
         else:
             log.info("checkpoint staged at step %d (write in background)",
@@ -308,6 +324,10 @@ class Trainer:
         """Block until any in-flight async checkpoint write is durable."""
         if self._ckpt is not None:
             self._ckpt.wait_until_finished()
+            if self.telemetry is not None:
+                # any staged save is now durable: the telemetry exposure
+                # baseline moves to its staging point
+                self.telemetry.checkpoint_durable()
 
     def restore(self) -> bool:
         # an in-flight async write of the newest step must land before
@@ -315,6 +335,13 @@ class Trainer:
         self.wait_pending()
         if self._ckpt is None or self._ckpt.latest_step() is None:
             return False
+        if self.telemetry is not None:
+            with self.telemetry.checkpoint("restore",
+                                           step=self._ckpt.latest_step()):
+                return self._restore_inner()
+        return self._restore_inner()
+
+    def _restore_inner(self) -> bool:
         import orbax.checkpoint as ocp
         target = {"params": self.params, "opt_state": self.opt_state}
 
@@ -393,9 +420,13 @@ class Trainer:
         steps = steps or self.tc.steps
         batches = batches or synthetic_batches(self.cfg, self.tc, self.mesh)
         metrics: dict = {}
+        tel = self.telemetry
+        if tel is not None:
+            tel.run_started(self.step, compiled=self._compiled)
         t0 = time.perf_counter()
         tokens_per_batch = self.tc.batch_size * self.tc.seq_len
         first_step_s = None
+        t_step = t0
         for _ in range(steps):
             batch = next(batches)
             self.params, self.opt_state, metrics = self.step_fn(
@@ -404,15 +435,25 @@ class Trainer:
                 jax.block_until_ready(metrics["loss"])
                 first_step_s = time.perf_counter() - t0
             self.step += 1
+            if tel is not None:
+                # sync EVERY step: the recorded step time must be device
+                # time, not dispatch time (the telemetry contract)
+                jax.block_until_ready(metrics["loss"])
+                now = time.perf_counter()
+                tel.record_step(self.step, now - t_step,
+                                loss=float(metrics["loss"]))
+                t_step = now
             if self.tc.checkpoint_dir and self.step % self.tc.checkpoint_every == 0:
                 self.save(block=not self.tc.async_checkpoint)
+                t_step = time.perf_counter()  # save time is not step time
         jax.block_until_ready(metrics["loss"])
+        self._compiled = True
         wall = time.perf_counter() - t0
         # async checkpoint boundary: the loop's staged writes must be
         # durable before the run reports done (wall above excludes this
         # wait on purpose — overlapping it with training IS the feature)
         self.wait_pending()
-        return {
+        out = {
             "steps": steps,
             "final_loss": float(metrics["loss"]),
             "grad_norm": float(metrics["grad_norm"]),
@@ -420,3 +461,6 @@ class Trainer:
             "first_step_s": first_step_s,
             "tokens_per_s": tokens_per_batch * steps / wall,
         }
+        if tel is not None:
+            out.update(tel.run_finished({"steps": steps}))
+        return out

@@ -79,6 +79,31 @@ def main(argv=None) -> int:
                    help="start the on-demand jax.profiler server on this port "
                         "(0 = off); lets an operator capture traces from a "
                         "running worker without restarting it")
+    # training telemetry (ISSUE 5): goodput ledger + step/MFU stats +
+    # straggler watchdog. Defaults come from the kubelet-injected env
+    # (gang/env.py coordination vars) so the pod spec needs no flags.
+    p.add_argument("--telemetry-port", type=int,
+                   default=int(os.environ.get("TPU_TELEMETRY_PORT", "0") or 0),
+                   help="worker-0 serves /metrics + /debug/train + POST "
+                        "/heartbeat on this port (0 = off); peers POST "
+                        "their step heartbeats here")
+    p.add_argument("--straggler-factor", type=float,
+                   default=float(os.environ.get("TPU_STRAGGLER_FACTOR",
+                                                "3.0") or 3.0),
+                   help="flag a host whose mean step time exceeds this "
+                        "multiple of the across-host median")
+    p.add_argument("--stall-timeout-s", type=float,
+                   default=float(os.environ.get("TPU_STALL_TIMEOUT_S",
+                                                "120") or 120),
+                   help="flag a host whose step counter stops advancing "
+                        "for this many seconds")
+    p.add_argument("--telemetry-every", type=int, default=1,
+                   help="emit the TPU_TELEMETRY state line every N steps "
+                        "(heartbeats go every step regardless)")
+    p.add_argument("--trace-export",
+                   default=os.environ.get("TPU_TRACE_EXPORT_PATH", ""),
+                   help="append training.* spans to this JSONL file; render "
+                        "with tools/goodput_summary.py / trace_summary.py")
     args = p.parse_args(argv)
     if args.export_adapter and args.lora_rank <= 0:
         # fail at arg time, not after a multi-hour run
@@ -158,7 +183,75 @@ def main(argv=None) -> int:
         lora = LoraConfig(rank=args.lora_rank, alpha=args.lora_alpha,
                           targets=tuple(t for t in
                                         args.lora_targets.split(",") if t))
-    trainer = Trainer(cfg, tc, mesh=mesh, initial_params=initial, lora=lora)
+
+    # -- training telemetry (ISSUE 5) ------------------------------------------
+    # Every worker keeps a ledger + step stats and prints the heartbeat /
+    # TPU_TELEMETRY protocol lines to stderr (docker logs carry them — the
+    # kubelet scrapes worker-0's). Worker-0 additionally aggregates peers'
+    # heartbeats (POST /heartbeat) into the straggler watchdog and serves
+    # /metrics + /debug/train.
+    import sys as _sys
+
+    from ..health import HealthServer
+    from ..metrics import Metrics
+    from ..tracing import Tracer
+    from .telemetry import (HeartbeatPoster, TrainingTelemetry, state_path_for)
+
+    tel_metrics = Metrics()
+    tracer = Tracer(export_path=args.trace_export)
+    poster = None
+    tel_address = os.environ.get("TPU_TELEMETRY_ADDRESS", "")
+    if pe.process_id != 0 and args.telemetry_port and tel_address:
+        poster = HeartbeatPoster(tel_address)
+
+    def emit_line(line: str, _poster=poster):
+        print(line, file=_sys.stderr, flush=True)
+        if _poster is not None and line.startswith("TPU_STEP_HEARTBEAT"):
+            _poster(line)
+
+    tel = TrainingTelemetry(
+        tokens_per_step=batch * args.seq_len,
+        model_params=cfg.param_count, n_chips=n,
+        accelerator_type=pe.accelerator_type
+        or os.environ.get("TPU_ACCELERATOR_TYPE", ""),
+        num_hosts=pe.num_processes, host_id=pe.process_id,
+        metrics=tel_metrics, tracer=tracer,
+        straggler_factor=args.straggler_factor,
+        stall_timeout_s=args.stall_timeout_s,
+        attempt=restart_attempt,
+        state_path=state_path_for(args.checkpoint_dir),
+        telemetry_every=args.telemetry_every,
+        emit_line=emit_line)
+    if restart_attempt and tel.restart_lost_s > 0 and pe.process_id == 0:
+        log.info("goodput ledger: %.1fs charged to restart_lost "
+                 "(attempt %d, prior step %d)",
+                 tel.restart_lost_s, restart_attempt, tel.resumed_from_step)
+    tel_server = None
+    if pe.process_id == 0 and args.telemetry_port:
+        tel_server = HealthServer(f":{args.telemetry_port}",
+                                  metrics=tel_metrics, tracer=tracer,
+                                  train_status=tel.snapshot,
+                                  heartbeat_sink=tel.ingest_heartbeat).start()
+        log.info("telemetry server on :%d (/metrics /debug/train "
+                 "POST /heartbeat)", tel_server.port)
+    sweeper_stop = None
+    if pe.process_id == 0 and pe.num_processes > 1:
+        # the straggler sweep must fire even while worker-0 itself is wedged
+        # in a collective (record_step stops being called) — a tiny thread,
+        # real clock, worker-0 only
+        import threading as _threading
+        sweeper_stop = _threading.Event()
+
+        def _sweep():
+            interval = max(0.5, args.stall_timeout_s / 4.0)
+            while not sweeper_stop.wait(interval):
+                tel.check_stragglers()
+
+        _threading.Thread(target=_sweep, name="straggler-sweep",
+                          daemon=True).start()
+
+    trainer = Trainer(cfg, tc, mesh=mesh, initial_params=initial, lora=lora,
+                      telemetry=tel)
     if lora is not None and pe.process_id == 0:
         from ..models import lora_param_count
         log.info("LoRA r=%d: %.2fM trainable of %.2fB total",
@@ -220,6 +313,13 @@ def main(argv=None) -> int:
 
     if args.eval_steps > 0:
         out.update(trainer.evaluate(steps=args.eval_steps))
+    if sweeper_stop is not None:
+        sweeper_stop.set()
+    if poster is not None:
+        poster.close()
+    if tel_server is not None:
+        tel_server.stop()
+    tracer.close()  # flush the JSONL span export before the summary prints
     if pe.process_id == 0:
         out.update({"workload": "pretrain", "model": cfg.name,
                     "devices": n, "mesh": {k: v for k, v in mesh.shape.items()},

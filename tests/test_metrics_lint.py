@@ -74,5 +74,16 @@ def test_known_metric_families_present():
                  "tpu_fleet_stream_aborted", "tpu_fleet_rejected_saturated",
                  "tpu_fleet_route_seconds", "tpu_fleet_desired_replicas",
                  "tpu_fleet_scale_ups", "tpu_fleet_scale_downs",
-                 "tpu_serving_draining", "tpu_serving_drain_rejected"):
+                 "tpu_serving_draining", "tpu_serving_drain_rejected",
+                 # training telemetry (ISSUE 5): workload side...
+                 "tpu_training_step_seconds", "tpu_training_tokens_per_second",
+                 "tpu_training_mfu_ratio", "tpu_training_goodput_ratio",
+                 "tpu_training_lost_seconds", "tpu_training_last_step",
+                 "tpu_training_checkpoint_seconds",
+                 "tpu_training_straggler_events",
+                 # ...and the kubelet's per-pod scrape re-exports
+                 "tpu_training_pod_goodput", "tpu_training_pod_mfu",
+                 "tpu_training_pod_tokens_per_second",
+                 "tpu_training_pod_last_step", "tpu_training_pod_stalled",
+                 "tpu_kubelet_training_stalls"):
         assert name in described, name

@@ -1,0 +1,293 @@
+"""Deterministic straggler/stall soak (ISSUE 5 acceptance, tier-1).
+
+Drives the WHOLE detection chain on injected clocks with zero real sleeps,
+over the real-cloud path (plain v2 surface + docker-lite FakeWorkerHost
+speaking the telemetry line protocol):
+
+  worker hosts emit heartbeat/telemetry protocol lines
+    -> worker-0's watchdog flags the stalled host (training.straggler span
+       + structured log line with host index and lag)
+    -> the kubelet's reconcile scrape reads worker-0's TPU_TELEMETRY line,
+       annotates tpu.dev/last-step / goodput / mfu, exports per-pod gauges
+    -> progress halts past stall_timeout_s -> TrainingStalled event +
+       pod.training_stalled span, then a loud recovery when steps resume.
+
+Every assertion message embeds SEED so a failure reproduces exactly.
+"""
+
+import random
+
+import pytest
+
+from k8s_runpod_kubelet_tpu.config import Config
+from k8s_runpod_kubelet_tpu.kube import objects as ko
+from k8s_runpod_kubelet_tpu.metrics import Metrics
+from k8s_runpod_kubelet_tpu.provider.annotations import Annotations as A
+from k8s_runpod_kubelet_tpu.tracing import Tracer
+from k8s_runpod_kubelet_tpu.workloads.telemetry import (
+    TrainingTelemetry, format_heartbeat)
+
+from harness import FakeClock, make_ssh_harness, make_pod
+
+SEED = 987654321
+STALL_TIMEOUT_S = 120.0
+
+
+def _ctx(msg: str) -> str:
+    return f"{msg} (seed={SEED})"
+
+
+@pytest.fixture()
+def h():
+    h = make_ssh_harness(cfg=Config(node_name="virtual-tpu",
+                                    zone="us-central2-b",
+                                    stall_timeout_s=STALL_TIMEOUT_S))
+    yield h
+    h.close()
+
+
+def _launch_training_pod(h):
+    pod = h.kube.create_pod(make_pod(chips=16))  # v5litepod-16: 4 hosts
+    h.provider.create_pod(pod)
+    pod = h.kube.get_pod("default", "train")
+    qr = ko.annotations(pod)[A.QUEUED_RESOURCE]
+    h.provider.update_all_pod_statuses()  # gang launch over "ssh"
+    assert h.kube.get_pod("default", "train")["status"]["phase"] == "Running"
+    return pod, qr
+
+
+def _events(h, reason):
+    return [e for e in h.kube.events if e["reason"] == reason]
+
+
+def _spans(h, name):
+    return [s for s in h.provider.tracer.recent() if s["name"] == name]
+
+
+class TestStragglerSoak:
+    def test_stall_to_event_to_annotation_chain(self, h):
+        """The acceptance chain: hosts heartbeat through fake_host, one
+        stalls; the watchdog flags it; the kubelet scrape annotates
+        progress, then emits TrainingStalled when steps halt, then clears
+        it when they resume — all on FakeClocks."""
+        rng = random.Random(SEED)
+        pod, qr = _launch_training_pod(h)
+
+        # -- worker-0's workload-side telemetry, on its own injected clocks
+        wd_clock = FakeClock(0.0)
+        wall = FakeClock(5_000.0)
+        tel_lines: list[str] = []
+        tel = TrainingTelemetry(
+            tokens_per_step=4 * 2048, model_params=8_000_000_000, n_chips=16,
+            accelerator_type="v5litepod-16", num_hosts=4, host_id=0,
+            metrics=Metrics(), tracer=Tracer(clock=wall), clock=wall,
+            mono=wd_clock, stall_timeout_s=STALL_TIMEOUT_S,
+            straggler_factor=3.0, emit_line=tel_lines.append)
+        tel.run_started()
+
+        def one_step(step: int, stalled_host=None):
+            """10s pass; every live host heartbeats (lines land in ITS
+            fake-host log, worker-0 ingests them), worker-0 records its
+            step and logs the TPU_TELEMETRY state line."""
+            dt = 10.0
+            wd_clock.advance(dt)
+            wall.advance(dt)
+            h.clock.advance(dt)
+            for host in range(1, 4):
+                if host == stalled_host:
+                    continue
+                line = format_heartbeat(host, step,
+                                        dt + rng.uniform(-0.2, 0.2))
+                h.transport.append_log(qr, host, line)     # its own log
+                tel.ingest_heartbeat(line)                 # POST /heartbeat
+            if stalled_host != 0:
+                tel.record_step(step, dt)
+            for line in tel_lines:
+                h.transport.append_log(qr, 0, line)        # worker-0 stderr
+            tel_lines.clear()
+
+        # -- healthy progress: scrape annotates and exports gauges --------
+        for step in range(1, 4):
+            one_step(step)
+        h.provider.update_all_pod_statuses()
+        pod_now = h.kube.get_pod("default", "train")
+        anns = ko.annotations(pod_now)
+        assert anns.get(A.LAST_STEP) == "3", _ctx(f"annotations: {anns}")
+        assert float(anns[A.GOODPUT]) > 0, _ctx(f"goodput ann: {anns}")
+        assert float(anns[A.MFU]) > 0, _ctx(f"mfu ann: {anns}")
+        key = "default/train"
+        g = h.provider.metrics.gauges
+        assert g[("tpu_training_pod_last_step", (("pod", key),))] == 3.0, \
+            _ctx("per-pod last-step gauge missing")
+        assert g[("tpu_training_pod_mfu", (("pod", key),))] > 0, \
+            _ctx("per-pod mfu gauge missing")
+        assert _events(h, "TrainingStalled") == [], \
+            _ctx("stall announced while progressing")
+
+        # -- host 2 stalls: worker-0's watchdog flags it (record_step runs
+        # the sweep; the span/flag state is the observable) ----------------
+        for step in range(4, 18):  # 140s > stall_timeout
+            one_step(step, stalled_host=2)
+            tel.check_stragglers()  # the sweeper thread's cadence
+        assert tel.watchdog.flagged == {2: "stall"}, \
+            _ctx(f"watchdog flags: {tel.watchdog.flagged}")
+        straggler_spans = [s for s in tel.tracer.recent()
+                           if s["name"] == "training.straggler"]
+        assert len(straggler_spans) == 1, \
+            _ctx("one straggler span per episode, not per sweep")
+        assert straggler_spans[0]["attrs"]["host"] == 2, \
+            _ctx(str(straggler_spans))
+        assert straggler_spans[0]["attrs"]["lag_s"] > STALL_TIMEOUT_S, \
+            _ctx(str(straggler_spans))
+        # the structured log line (kubelet/fleet-greppable) was emitted
+        # into worker-0's log via emit_line -> append_log on the NEXT step
+        one_step(18, stalled_host=2)
+        assert h.provider.gang.find_in_logs(
+            h.tpu.get_queued_resource(qr), r"TPU_STRAGGLER host=2 kind=stall"
+        ) is not None, _ctx("structured straggler line not in worker-0 logs")
+
+        # worker-0 kept stepping, so the KUBELET sees progress: no stall yet
+        h.provider.update_all_pod_statuses()
+        assert _events(h, "TrainingStalled") == [], \
+            _ctx("kubelet stalled while worker-0 still advancing")
+
+        # -- global halt: the collective blocks, steps stop ---------------
+        last_step_before_halt = tel.stats.last_step
+        for _ in range(14):  # 140s of silence, several reconcile sweeps
+            h.clock.advance(10.0)
+            h.provider.update_all_pod_statuses()
+        stalls = _events(h, "TrainingStalled")
+        assert len(stalls) == 1, _ctx(f"stall events: {stalls}")
+        assert str(last_step_before_halt) in stalls[0]["message"], \
+            _ctx(f"event message lacks the stuck step: {stalls[0]}")
+        stall_spans = _spans(h, "pod.training_stalled")
+        assert len(stall_spans) == 1, _ctx("pod.training_stalled span missing")
+        assert stall_spans[0]["attrs"]["last_step"] == last_step_before_halt
+        # same trace as the pod's lifecycle spans (the ISSUE 2 join key)
+        assert stall_spans[0]["trace_id"] == ko.annotations(
+            h.kube.get_pod("default", "train"))[A.TRACE_ID], \
+            _ctx("stall span not joined to the pod's trace")
+        assert g[("tpu_training_pod_stalled", (("pod", key),))] == 1.0, \
+            _ctx("stalled gauge not set")
+        assert ko.annotations(h.kube.get_pod("default", "train"))[
+            A.LAST_STEP] == str(last_step_before_halt), \
+            _ctx("last-step annotation should pin the stuck step")
+
+        # -- recovery: steps resume, the kubelet announces it loudly ------
+        for step in range(19, 22):
+            one_step(step)
+        h.provider.update_all_pod_statuses()
+        assert len(_events(h, "TrainingStalled")) == 1, \
+            _ctx("recovery must not re-announce the old stall")
+        resumed = _events(h, "TrainingProgressing")
+        assert len(resumed) == 1, _ctx(f"progress-resumed events: {resumed}")
+        assert g[("tpu_training_pod_stalled", (("pod", key),))] == 0.0, \
+            _ctx("stalled gauge not cleared on recovery")
+        assert ko.annotations(h.kube.get_pod("default", "train"))[
+            A.LAST_STEP] == "21", _ctx("annotation didn't catch back up")
+        # goodput ledger stayed coherent through the whole soak
+        snap = tel.ledger.snapshot()
+        assert sum(snap["buckets"].values()) == pytest.approx(
+            snap["wall_s"], rel=1e-9), _ctx(f"ledger broke: {snap}")
+        assert snap["buckets"]["stalled"] > 0, \
+            _ctx("the halt never reached the stalled bucket")
+
+    def test_serving_pods_are_untouched_by_the_scrape(self, h):
+        """A pod that never emits the telemetry protocol gets no training
+        annotations, no gauges, and can never stall."""
+        pod = h.kube.create_pod(make_pod(name="serve", chips=16))
+        h.provider.create_pod(pod)
+        qr = ko.annotations(h.kube.get_pod("default", "serve"))[
+            A.QUEUED_RESOURCE]
+        h.provider.update_all_pod_statuses()
+        h.transport.append_log(qr, 0, "serving chatter, no protocol lines")
+        for _ in range(30):  # way past stall_timeout_s
+            h.clock.advance(60.0)
+            h.provider.update_all_pod_statuses()
+        anns = ko.annotations(h.kube.get_pod("default", "serve"))
+        assert A.LAST_STEP not in anns, _ctx(f"phantom annotation: {anns}")
+        assert _events(h, "TrainingStalled") == [], \
+            _ctx("a non-training pod can never stall")
+        assert h.provider.training_status()["pods"] == {}, \
+            _ctx("debug/train should be empty")
+
+    def test_preemption_requeue_resets_the_stall_clock(self, h):
+        """A requeued pod must not inherit the dead attempt's stall state:
+        the relaunch gets a fresh telemetry stream AND fresh gauges (a
+        stalled=1 series surviving the requeue would alert on a healthy
+        relaunch forever)."""
+        pod, qr = _launch_training_pod(h)
+        h.transport.telemetry(qr, {"step": 7, "goodput": 0.9, "mfu": 0.3,
+                                   "tokens_per_sec": 100.0})
+        h.provider.update_all_pod_statuses()
+        info = h.provider.instances["default/train"]
+        assert info.train_last_step == 7, _ctx("scrape missed the line")
+        # force the dead attempt into an announced stall first
+        h.clock.advance(STALL_TIMEOUT_S * 2)
+        h.provider.update_all_pod_statuses()
+        stalled_key = ("tpu_training_pod_stalled",
+                       (("pod", "default/train"),))
+        assert h.provider.metrics.gauges[stalled_key] == 1.0, \
+            _ctx("precondition: stall announced")
+        # preempt -> requeue -> new slice goes ACTIVE -> relaunch
+        h.fake.preempt(qr)
+        h.provider.update_all_pod_statuses()
+        info = h.provider.instances["default/train"]
+        assert info.train_last_step is None, \
+            _ctx("stall clock leaked across the requeue")
+        assert info.train_stalled is False
+        assert stalled_key not in h.provider.metrics.gauges, \
+            _ctx("stalled=1 gauge leaked across the requeue")
+        h.provider.process_pending_pods()
+        h.provider.update_all_pod_statuses()
+        pod_now = h.kube.get_pod("default", "train")
+        assert pod_now["status"]["phase"] == "Running", \
+            _ctx(f"requeue didn't recover: {pod_now['status']}")
+        # stale silence right after relaunch must NOT stall the new attempt
+        # (the single event on record is the pre-requeue precondition's)
+        h.clock.advance(STALL_TIMEOUT_S * 3)
+        h.provider.update_all_pod_statuses()
+        assert len(_events(h, "TrainingStalled")) == 1, \
+            _ctx("fresh attempt stalled off the old attempt's clock")
+        assert stalled_key not in h.provider.metrics.gauges, \
+            _ctx("stalled gauge resurrected without telemetry")
+
+    def test_deleted_pod_gauges_are_removed(self, h):
+        """A deleted pod's labeled gauges must not leave a phantom
+        stalled=1 series alerting forever."""
+        pod, qr = _launch_training_pod(h)
+        h.transport.telemetry(qr, {"step": 5, "goodput": 0.8, "mfu": 0.2,
+                                   "tokens_per_sec": 10.0})
+        h.provider.update_all_pod_statuses()
+        key = ("tpu_training_pod_last_step", (("pod", "default/train"),))
+        assert h.provider.metrics.gauges[key] == 5.0, _ctx("gauge missing")
+        h.provider.delete_pod(h.kube.get_pod("default", "train"))
+        assert key not in h.provider.metrics.gauges, \
+            _ctx("per-pod gauges must die with the pod")
+        assert ("tpu_training_pod_stalled", (("pod", "default/train"),)) \
+            not in h.provider.metrics.gauges, _ctx("stalled gauge leaked")
+
+    def test_watchdog_knobs_reach_the_worker_env(self, h):
+        """The operator's helm/config straggler knobs must actually reach
+        train_main's env-driven defaults at gang launch."""
+        h.cfg.straggler_factor = 5.0
+        h.cfg.stall_timeout_s = 600.0
+        pod, qr = _launch_training_pod(h)
+        c = h.transport.container(qr, 1)
+        assert c.env["TPU_TELEMETRY_PORT"] == str(h.cfg.telemetry_port), \
+            _ctx(f"env: {c.env}")
+        assert c.env["TPU_TELEMETRY_ADDRESS"].endswith(
+            f":{h.cfg.telemetry_port}"), _ctx(f"env: {c.env}")
+        assert c.env["TPU_STRAGGLER_FACTOR"] == "5.0", _ctx(f"env: {c.env}")
+        assert c.env["TPU_STALL_TIMEOUT_S"] == "600.0", _ctx(f"env: {c.env}")
+
+    def test_debug_train_statusz_reports_scraped_pods(self, h):
+        pod, qr = _launch_training_pod(h)
+        h.transport.telemetry(qr, {"step": 42, "goodput": 0.8, "mfu": 0.31,
+                                   "tokens_per_sec": 5000.0})
+        h.provider.update_all_pod_statuses()
+        status = h.provider.training_status()
+        assert status["pods"]["default/train"]["last_step"] == 42, \
+            _ctx(str(status))
+        assert status["pods"]["default/train"]["stalled"] is False
+        assert status["stall_timeout_s"] == STALL_TIMEOUT_S

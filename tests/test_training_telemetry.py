@@ -1,0 +1,667 @@
+"""Training-telemetry unit tests (ISSUE 5, workload side).
+
+The acceptance-critical properties, all on injected clocks with zero real
+sleeps: the GoodputLedger's buckets are EXCLUSIVE and sum to wall clock —
+including across a simulated preemption/restart cycle where the lost work
+is charged to ``restart_lost`` — and the step stats produce the same MFU
+the bench's 6N roofline does.
+"""
+
+import json
+import random
+import urllib.request
+
+import pytest
+
+from k8s_runpod_kubelet_tpu.metrics import Metrics
+from k8s_runpod_kubelet_tpu.tracing import Tracer
+from k8s_runpod_kubelet_tpu.workloads.telemetry import (
+    GoodputLedger, HEARTBEAT_MARKER, PEAK_TFLOPS_BF16, StepStats,
+    StragglerWatchdog, TrainingTelemetry, format_heartbeat, format_telemetry,
+    generation_of, parse_heartbeat, parse_telemetry, peak_tflops_per_chip,
+    read_lost_state, state_path_for, write_state)
+
+SEED = 20260804
+
+
+class FakeMono:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float):
+        self.t += dt
+
+
+# -- peak-FLOPs table ----------------------------------------------------------
+
+def test_generation_parsing_covers_the_catalog():
+    from k8s_runpod_kubelet_tpu.cloud.types import ACCELERATOR_CATALOG
+    for name, acc in ACCELERATOR_CATALOG.items():
+        assert generation_of(name) == acc.generation, name
+        assert peak_tflops_per_chip(name) == PEAK_TFLOPS_BF16[acc.generation]
+    assert generation_of("") == "cpu"
+    assert generation_of("weird-thing") == "cpu"
+
+
+# -- goodput ledger ------------------------------------------------------------
+
+def test_ledger_buckets_are_exclusive_and_sum_to_wall():
+    """Structural invariant: after any seeded sequence of switches/spends,
+    the bucket totals sum to exactly the injected wall-clock elapsed."""
+    clock = FakeMono()
+    led = GoodputLedger(clock=clock)
+    rng = random.Random(SEED)
+    buckets = list(GoodputLedger.BUCKETS)
+    for _ in range(200):
+        clock.advance(rng.uniform(0.0, 7.3))
+        led.switch(rng.choice(buckets))
+    clock.advance(rng.uniform(0.0, 3.0))
+    snap = led.snapshot()
+    total = sum(snap["buckets"].values())
+    assert total == pytest.approx(snap["wall_s"], abs=1e-6), \
+        f"buckets {snap['buckets']} don't sum to wall (seed={SEED})"
+    assert snap["wall_s"] == pytest.approx(clock.t - 100.0, abs=1e-6), \
+        f"wall drifted from the injected clock (seed={SEED})"
+    # exclusivity: exactly one bucket accrues while time passes
+    before = led.total("productive")
+    led.switch("productive")
+    clock.advance(5.0)
+    assert led.total("productive") == pytest.approx(before + 5.0, abs=1e-6)
+    for b in buckets:
+        if b != "productive":
+            frozen = led.total(b)
+            clock.advance(0.0)
+            assert led.total(b) == frozen, f"{b} accrued while productive open"
+
+
+def test_ledger_spend_nesting_restores_the_outer_bucket():
+    clock = FakeMono()
+    led = GoodputLedger(clock=clock)
+    led.switch("productive")
+    clock.advance(2.0)
+    with led.spend("checkpoint_save") as sp:
+        clock.advance(1.5)
+        with led.spend("checkpoint_restore"):
+            clock.advance(0.25)
+        clock.advance(0.25)
+    assert led.open_bucket == "productive"
+    assert sp.duration_s == pytest.approx(2.0, abs=1e-9)  # incl. nested
+    clock.advance(1.0)
+    snap = led.snapshot()
+    assert snap["buckets"]["productive"] == pytest.approx(3.0, abs=1e-6)
+    assert snap["buckets"]["checkpoint_save"] == pytest.approx(1.75, abs=1e-6)
+    assert snap["buckets"]["checkpoint_restore"] == pytest.approx(0.25, abs=1e-6)
+    assert sum(snap["buckets"].values()) == pytest.approx(snap["wall_s"],
+                                                         abs=1e-6)
+
+
+def test_ledger_rejects_unknown_buckets():
+    led = GoodputLedger(clock=FakeMono())
+    with pytest.raises(ValueError):
+        led.switch("billable")
+    with pytest.raises(ValueError):
+        led.charge("nope", 1.0)
+    with pytest.raises(ValueError):
+        led.charge("restart_lost", -1.0)
+
+
+def test_preemption_attribution_across_a_simulated_restart(tmp_path):
+    """The acceptance scenario: attempt 0 trains, checkpoints, trains more,
+    then dies; attempt 1 charges (post-checkpoint work + downtime) to
+    ``restart_lost`` from the persisted state — and its ledger still sums
+    to wall clock WITH the external charge counted."""
+    state = state_path_for(str(tmp_path))
+    mono0, wall0 = FakeMono(0.0), FakeMono(1000.0)
+    t0 = TrainingTelemetry(tokens_per_step=1024, model_params=1_000_000,
+                           clock=wall0, mono=mono0, attempt=0,
+                           state_path=state, state_interval_s=0.0)
+    t0.run_started()
+    for step in (1, 2, 3):
+        mono0.advance(2.0)
+        wall0.advance(2.0)
+        t0.record_step(step, 2.0)
+    with t0.checkpoint("save", step=3):
+        mono0.advance(1.0)
+        wall0.advance(1.0)
+    # 2 more steps after the durable checkpoint: this is the lost work
+    for step in (4, 5):
+        mono0.advance(2.0)
+        wall0.advance(2.0)
+        t0.record_step(step, 2.0)
+    # attempt 0 dies here; 30s of downtime pass before the relaunch
+    lost, prev_step = read_lost_state(state, wall0.t + 30.0)
+    assert prev_step == 5
+    assert lost == pytest.approx(4.0 + 30.0, abs=1e-6), \
+        f"expected post-ckpt work (4s) + downtime (30s), got {lost}"
+
+    mono1, wall1 = FakeMono(0.0), FakeMono(wall0.t + 30.0)
+    t1 = TrainingTelemetry(tokens_per_step=1024, model_params=1_000_000,
+                           clock=wall1, mono=mono1, attempt=1,
+                           state_path=state)
+    assert t1.restart_lost_s == pytest.approx(34.0, abs=1e-6)
+    assert t1.resumed_from_step == 5
+    t1.run_started()
+    mono1.advance(1.0)
+    wall1.advance(1.0)
+    t1.record_step(4, 1.0)
+    snap = t1.ledger.snapshot()
+    assert snap["buckets"]["restart_lost"] == pytest.approx(34.0, abs=1e-6)
+    assert sum(snap["buckets"].values()) == pytest.approx(snap["wall_s"],
+                                                         abs=1e-6), \
+        "external restart charge broke the sum-to-wall invariant"
+    assert snap["wall_s"] == pytest.approx(1.0 + 34.0, abs=1e-6)
+
+
+def test_attempt_zero_never_charges_restart_lost(tmp_path):
+    state = state_path_for(str(tmp_path))
+    write_state(state, step=9, unsaved_work_s=50.0, ts=0.0)
+    tel = TrainingTelemetry(tokens_per_step=1, clock=FakeMono(10.0),
+                            mono=FakeMono(), attempt=0, state_path=state)
+    assert tel.restart_lost_s == 0.0
+    assert tel.ledger.total("restart_lost") == 0.0
+
+
+# -- step stats / MFU ----------------------------------------------------------
+
+def test_step_stats_mfu_matches_the_6n_roofline():
+    # 8B params, v5e (197 TF), 4 chips, 8k tokens/step, 1s steps
+    st = StepStats(tokens_per_step=8192, model_params=8_000_000_000,
+                   n_chips=4, accelerator_type="v5litepod-16")
+    for step in range(1, 5):
+        st.record(step, 1.0)
+    tok_s_chip = 8192 / 1.0 / 4
+    expected = 6.0 * 8_000_000_000 * tok_s_chip / (197.0 * 1e12)
+    assert st.tokens_per_sec == pytest.approx(8192.0)
+    assert st.mfu == pytest.approx(expected, rel=1e-9)
+    assert st.last_step == 4
+    s = st.summary()
+    assert s["step"] == 4 and s["mfu"] == pytest.approx(expected, abs=1e-6)
+
+
+def test_step_stats_without_params_reports_zero_mfu():
+    st = StepStats(tokens_per_step=128)
+    st.record(1, 0.5)
+    assert st.mfu == 0.0
+    assert st.tokens_per_sec == pytest.approx(256.0)
+
+
+# -- line protocol -------------------------------------------------------------
+
+def test_heartbeat_roundtrip_and_garbage_rejection():
+    line = format_heartbeat(3, 117, 0.523)
+    assert line.startswith(HEARTBEAT_MARKER)
+    assert parse_heartbeat(line) == (3, 117, pytest.approx(0.523))
+    assert parse_heartbeat("TPU_STEP_HEARTBEAT host=x step=1") is None
+    assert parse_heartbeat("random log chatter") is None
+
+
+def test_telemetry_line_roundtrip_last_wins():
+    body = "\n".join([
+        "some noise",
+        format_telemetry({"step": 1, "goodput": 0.5}),
+        "more noise",
+        format_telemetry({"step": 7, "goodput": 0.9}),
+        "TPU_TELEMETRY {broken json",
+    ])
+    got = parse_telemetry(body)
+    assert got == {"step": 7, "goodput": 0.9}
+    assert parse_telemetry("nothing here") is None
+
+
+# -- straggler watchdog --------------------------------------------------------
+
+def test_watchdog_flags_stall_once_per_episode_and_recovers():
+    clock = FakeMono()
+    wd = StragglerWatchdog(4, stall_timeout_s=60.0, clock=clock)
+    rng = random.Random(SEED)
+
+    def advance_healthy(step):
+        for host in range(4):
+            if host != 2:
+                wd.observe(host, step, 10.0 + rng.uniform(-0.5, 0.5))
+
+    for step in range(1, 6):
+        clock.advance(10.0)
+        advance_healthy(step)
+        if step <= 2:
+            wd.observe(2, step, 10.0)  # host 2 stops advancing after step 2
+    assert wd.check() == [], f"no host past timeout yet (seed={SEED})"
+    for step in range(6, 10):  # host 2's lag crosses 60s; peers keep moving
+        clock.advance(10.0)
+        advance_healthy(step)
+    events = wd.check()
+    assert [e["host"] for e in events] == [2], f"{events} (seed={SEED})"
+    assert events[0]["kind"] == "stall"
+    assert events[0]["last_step"] == 2
+    assert events[0]["lag_s"] > 60.0
+    # dedupe: still stalled -> no NEW event
+    clock.advance(10.0)
+    advance_healthy(10)
+    assert wd.check() == []
+    assert wd.flagged == {2: "stall"}
+    # recovery clears the flag; a later stall is a new episode
+    wd.observe(2, 11, 10.0)
+    assert wd.check() == []
+    assert wd.flagged == {}
+    for step in range(12, 20):
+        clock.advance(10.0)
+        advance_healthy(step)
+    again = wd.check()
+    assert [e["host"] for e in again] == [2], f"{again} (seed={SEED})"
+
+
+def test_watchdog_flags_slow_host_vs_median():
+    clock = FakeMono()
+    wd = StragglerWatchdog(4, straggler_factor=3.0, stall_timeout_s=1e9,
+                           clock=clock)
+    for step in range(1, 4):
+        clock.advance(1.0)
+        for host in range(4):
+            wd.observe(host, step, 4.0 if host == 1 else 1.0)
+    events = wd.check()
+    assert [(e["host"], e["kind"]) for e in events] == [(1, "slow")], events
+    assert events[0]["median_step_s"] == pytest.approx(1.0)
+
+
+def test_watchdog_never_heard_host_counts_as_stalled():
+    clock = FakeMono()
+    wd = StragglerWatchdog(2, stall_timeout_s=30.0, clock=clock)
+    wd.observe(0, 5, 1.0)
+    clock.advance(20.0)
+    wd.observe(0, 6, 1.0)   # host 0 stays fresh; host 1 never reported
+    clock.advance(15.0)
+    events = wd.check()
+    assert [e["host"] for e in events] == [1]
+    assert events[0]["last_step"] == -1
+
+
+def test_watchdog_is_silent_while_the_gang_compiles():
+    """No heartbeats at all = the gang is still in first-step compile
+    (which routinely exceeds any sane stall timeout) — flagging every host
+    on every cold start would be noise, not signal."""
+    clock = FakeMono()
+    wd = StragglerWatchdog(4, stall_timeout_s=60.0, clock=clock)
+    clock.advance(100 * 60.0)  # a very long compile
+    assert wd.check() == []
+    # first heartbeat starts the clock for everyone
+    wd.observe(0, 1, 1.0)
+    clock.advance(61.0)
+    wd.observe(0, 2, 1.0)
+    events = wd.check()
+    assert sorted(e["host"] for e in events) == [1, 2, 3]
+    assert all(e["kind"] == "stall" and e["last_step"] == -1 for e in events)
+
+
+def test_watchdog_flags_slow_host_in_a_two_host_gang():
+    """Peer-median (excluding the candidate) — with a plain median over
+    both hosts, a 2-host gang's slow member is half its own median and
+    could never be flagged."""
+    clock = FakeMono()
+    wd = StragglerWatchdog(2, straggler_factor=3.0, stall_timeout_s=1e9,
+                           clock=clock)
+    for step in range(1, 4):
+        clock.advance(1.0)
+        wd.observe(0, step, 1.0)
+        wd.observe(1, step, 10.0)
+    events = wd.check()
+    assert [(e["host"], e["kind"]) for e in events] == [(1, "slow")], events
+    assert events[0]["median_step_s"] == pytest.approx(1.0)
+
+
+def test_watchdog_ingests_the_line_protocol():
+    clock = FakeMono()
+    wd = StragglerWatchdog(2, clock=clock)
+    assert wd.ingest(format_heartbeat(1, 42, 0.5)) is True
+    assert wd.ingest("not a heartbeat") is False
+    assert wd.snapshot()["1"]["step"] == 42
+
+
+# -- the TrainingTelemetry bundle ----------------------------------------------
+
+def test_record_step_emits_metrics_spans_and_protocol_lines():
+    mono, wall = FakeMono(), FakeMono(5000.0)
+    metrics, tracer = Metrics(), Tracer(clock=wall)
+    lines = []
+    tel = TrainingTelemetry(tokens_per_step=2048, model_params=10_000_000,
+                            n_chips=2, accelerator_type="v5litepod-16",
+                            num_hosts=2, host_id=0, metrics=metrics,
+                            tracer=tracer, clock=wall, mono=mono,
+                            emit_line=lines.append)
+    tel.run_started()
+    mono.advance(3.0)
+    wall.advance(3.0)
+    tel.record_step(1, 3.0, loss=2.5)     # first step -> compile bucket
+    mono.advance(1.0)
+    wall.advance(1.0)
+    tel.record_step(2, 1.0, loss=2.4)
+    assert tel.ledger.total("compile") == pytest.approx(3.0, abs=1e-6)
+    assert tel.ledger.total("productive") == pytest.approx(1.0, abs=1e-6)
+    obs = metrics.get_observations("tpu_training_step_seconds")
+    assert obs == [pytest.approx(3.0), pytest.approx(1.0)]
+    assert metrics.gauges[("tpu_training_last_step", ())] == 2.0
+    assert metrics.gauges[("tpu_training_mfu_ratio", ())] > 0
+    # lost-seconds counter carries the compile bucket under its cause label
+    assert metrics.get_counter("tpu_training_lost_seconds",
+                               {"cause": "compile"}) == pytest.approx(
+        3.0, abs=1e-6)
+    names = [s["name"] for s in tracer.recent()]
+    assert names.count("training.step") == 2
+    step_span = [s for s in tracer.recent()
+                 if s["name"] == "training.step"][-1]
+    assert step_span["attrs"]["step"] == 2
+    assert step_span["attrs"]["loss"] == pytest.approx(2.4)
+    assert step_span["duration_s"] == pytest.approx(1.0, abs=1e-6)
+    hb = [ln for ln in lines if ln.startswith("TPU_STEP_HEARTBEAT")]
+    st = [ln for ln in lines if ln.startswith("TPU_TELEMETRY ")]
+    assert len(hb) == 2 and len(st) == 2
+    assert parse_heartbeat(hb[-1]) == (0, 2, pytest.approx(1.0))
+    payload = parse_telemetry(st[-1])
+    assert payload["step"] == 2 and payload["stalled"] is False
+
+
+def test_checkpoint_and_run_finished_spans_and_summary():
+    mono, wall = FakeMono(), FakeMono(0.0)
+    tracer = Tracer(clock=wall)
+    tel = TrainingTelemetry(tokens_per_step=100, model_params=1000,
+                            metrics=Metrics(), tracer=tracer,
+                            clock=wall, mono=mono)
+    tel.run_started()
+    mono.advance(1.0)
+    wall.advance(1.0)
+    tel.record_step(1, 1.0)
+    with tel.checkpoint("save", step=1):
+        mono.advance(0.5)
+        wall.advance(0.5)
+    mono.advance(1.0)
+    wall.advance(1.0)
+    tel.record_step(2, 1.0)
+    out = tel.run_finished()
+    assert set(out) == {"goodput", "mfu", "lost_s"}
+    names = [s["name"] for s in tracer.recent()]
+    assert "training.checkpoint" in names and "training.run" in names
+    run = [s for s in tracer.recent() if s["name"] == "training.run"][-1]
+    b = run["attrs"]["buckets"]
+    assert b["checkpoint_save"] == pytest.approx(0.5, abs=1e-6)
+    assert b["compile"] == pytest.approx(1.0, abs=1e-6)   # first step
+    assert b["productive"] == pytest.approx(1.0, abs=1e-6)  # second step
+    assert sum(b.values()) == pytest.approx(run["attrs"]["wall_s"], abs=1e-6)
+    assert run["attrs"]["goodput"] == pytest.approx(1.0 / 2.5, abs=1e-6)
+    assert tel.ledger.open_bucket == "idle"
+
+
+def test_stalled_bucket_reattribution_on_straggler_episode():
+    """A peer goes silent: both hosts stop advancing (worker-0 blocks in
+    the collective), the sweep flags them, and the ledger charges the
+    blocked interval to ``stalled`` — then flips back on recovery."""
+    mono, wall = FakeMono(), FakeMono(0.0)
+    tel = TrainingTelemetry(tokens_per_step=10, num_hosts=2, host_id=0,
+                            metrics=Metrics(), tracer=Tracer(clock=wall),
+                            clock=wall, mono=mono, stall_timeout_s=30.0)
+    tel.run_started()
+    mono.advance(1.0)
+    tel.record_step(1, 1.0)
+    tel.ingest_heartbeat(format_heartbeat(1, 1, 1.0))
+    mono.advance(1.0)
+    tel.record_step(2, 1.0)  # host 1 silent from here; worker-0 blocks too
+    mono.advance(40.0)
+    events = tel.check_stragglers()  # the sweeper thread's view
+    assert sorted(e["host"] for e in events) == [0, 1]
+    assert tel.ledger.open_bucket == "stalled"
+    assert tel.straggler_events == 2
+    # 20 more blocked seconds accrue to the stalled bucket
+    mono.advance(20.0)
+    # both hosts resume; worker-0's own next step closes the episode
+    tel.ingest_heartbeat(format_heartbeat(1, 3, 1.0))
+    tel.record_step(3, 1.0)
+    assert tel.ledger.open_bucket == "productive"
+    assert tel.watchdog.flagged == {}
+    assert tel.ledger.total("stalled") == pytest.approx(20.0, abs=1e-6)
+    # the pre-flag 40s stayed productive (detection latency is honest),
+    # and the invariant still holds
+    snap = tel.ledger.snapshot()
+    assert sum(snap["buckets"].values()) == pytest.approx(snap["wall_s"],
+                                                          abs=1e-6)
+    # one training.straggler span per flagged host, not per sweep
+    spans = [s for s in tel.tracer.recent()
+             if s["name"] == "training.straggler"]
+    assert len(spans) == 2
+
+
+def test_telemetry_http_surface_debug_train_and_heartbeat():
+    """The worker-0 statusz (HealthServer reuse): GET /debug/train serves
+    the snapshot, POST /heartbeat feeds the watchdog."""
+    from k8s_runpod_kubelet_tpu.health import HealthServer
+    mono, wall = FakeMono(), FakeMono(0.0)
+    metrics = Metrics()
+    tel = TrainingTelemetry(tokens_per_step=64, num_hosts=2, host_id=0,
+                            metrics=metrics, tracer=Tracer(clock=wall),
+                            clock=wall, mono=mono)
+    tel.run_started()
+    mono.advance(1.0)
+    tel.record_step(1, 1.0)
+    hs = HealthServer(":0", metrics=metrics, train_status=tel.snapshot,
+                      heartbeat_sink=tel.ingest_heartbeat).start()
+    try:
+        base = f"http://127.0.0.1:{hs.port}"
+        with urllib.request.urlopen(f"{base}/debug/train", timeout=5) as r:
+            snap = json.loads(r.read())
+        assert snap["step"] == 1
+        assert snap["hosts"]["0"]["step"] == 1
+        req = urllib.request.Request(
+            f"{base}/heartbeat", data=format_heartbeat(1, 9, 0.25).encode())
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert json.loads(r.read()) == {"ok": True}
+        with urllib.request.urlopen(f"{base}/debug/train", timeout=5) as r:
+            snap = json.loads(r.read())
+        assert snap["hosts"]["1"]["step"] == 9
+        with urllib.request.urlopen(f"{base}/metrics", timeout=5) as r:
+            body = r.read().decode()
+        assert "tpu_training_step_seconds" in body
+        assert "tpu_training_mfu_ratio" in body
+    finally:
+        hs.stop()
+
+
+def test_async_staged_save_defers_the_exposure_reset(tmp_path):
+    """A block=False save only STAGES the orbax write: dying before the
+    background write lands must still charge the since-last-DURABLE work
+    to restart_lost. The baseline moves at checkpoint_durable() — to the
+    STAGING point, since steps run while the write was in flight are not
+    in the checkpoint."""
+    state = state_path_for(str(tmp_path))
+    mono, wall = FakeMono(0.0), FakeMono(1000.0)
+    tel = TrainingTelemetry(tokens_per_step=10, clock=wall, mono=mono,
+                            state_path=state, state_interval_s=0.0)
+    tel.run_started()
+    mono.advance(1.0)
+    wall.advance(1.0)
+    tel.record_step(1, 1.0)   # first step -> compile bucket
+    mono.advance(4.0)
+    wall.advance(4.0)
+    tel.record_step(2, 4.0)   # 4s of productive exposure
+    with tel.checkpoint("save", step=2, durable=False):  # staged only
+        mono.advance(1.0)
+        wall.advance(1.0)
+    # exposure did NOT reset: a preemption now loses step 2's work (4s of
+    # unsaved productive time) plus the 1s since the last state write
+    lost, _ = read_lost_state(state, wall.t)
+    assert lost == pytest.approx(4.0 + 1.0, abs=1e-6), \
+        "staged-but-not-durable save must keep the work exposed"
+    # 2 more seconds of work while the write is in flight
+    mono.advance(2.0)
+    wall.advance(2.0)
+    tel.record_step(3, 2.0)
+    tel.checkpoint_durable()  # Trainer.wait_pending boundary
+    lost, step = read_lost_state(state, wall.t)
+    assert step == 2
+    assert lost == pytest.approx(2.0, abs=1e-6), \
+        "post-staging work stays exposed; pre-staging work is durable"
+    # idempotent: a second wait with nothing staged changes nothing
+    tel.checkpoint_durable()
+    lost2, _ = read_lost_state(state, wall.t)
+    assert lost2 == pytest.approx(lost, abs=1e-9)
+
+
+def test_multislice_telemetry_address_names_slice0_worker0():
+    """Slices > 0 must post heartbeats to the GLOBAL process 0 (slice 0's
+    worker-0, the megascale-coordinator host) — their own worker-0 runs no
+    aggregator and every beat would be dropped."""
+    from k8s_runpod_kubelet_tpu.gang.env import compute_worker_env
+    from k8s_runpod_kubelet_tpu.cloud.types import (QueuedResource,
+                                                    QueuedResourceState,
+                                                    TpuWorker)
+    qr = QueuedResource(
+        name="slice-1", accelerator_type="v5litepod-16",
+        runtime_version="v2", state=QueuedResourceState.ACTIVE,
+        workers=[TpuWorker(worker_id=i, hostname=f"s1-w{i}",
+                           internal_ip=f"10.0.1.{i}") for i in range(4)])
+    envs = compute_worker_env(qr, num_slices=2, slice_id=1,
+                              megascale_coordinator="s0-w0",
+                              telemetry_port=8478,
+                              straggler_factor=4.0, stall_timeout_s=240.0)
+    for e in envs:
+        assert e["TPU_TELEMETRY_ADDRESS"] == "s0-w0:8478", e
+        assert e["TPU_STRAGGLER_FACTOR"] == "4.0"
+        assert e["TPU_STALL_TIMEOUT_S"] == "240.0"
+    # single slice: the local worker-0 IS the aggregator
+    envs0 = compute_worker_env(qr, telemetry_port=8478)
+    assert envs0[0]["TPU_TELEMETRY_ADDRESS"] == "s1-w0:8478"
+
+
+# -- tools: goodput_summary + trace_summary training families ------------------
+
+def _tools_path():
+    import pathlib
+    import sys
+    sys.path.insert(0, str(pathlib.Path(__file__).parent.parent / "tools"))
+
+
+def _export_training_spans(tmp_path) -> str:
+    """A two-attempt run with a checkpoint, restore, and straggler —
+    the goodput-report fixture."""
+    path = str(tmp_path / "train_spans.jsonl")
+    mono, wall = FakeMono(), FakeMono(10_000.0)
+    tel = TrainingTelemetry(tokens_per_step=1024, model_params=1_000_000,
+                            num_hosts=2, host_id=0,
+                            tracer=Tracer(clock=wall, export_path=path),
+                            clock=wall, mono=mono, stall_timeout_s=30.0)
+    tel.run_started()
+    for step in (1, 2, 3):
+        mono.advance(2.0)
+        wall.advance(2.0)
+        tel.ingest_heartbeat(format_heartbeat(1, step, 2.0))
+        tel.record_step(step, 2.0)
+    with tel.checkpoint("save", step=3):
+        mono.advance(1.0)
+        wall.advance(1.0)
+    mono.advance(40.0)
+    wall.advance(40.0)
+    tel.check_stragglers()  # host 1 silent 40s -> straggler + stalled open
+    mono.advance(10.0)      # 10 more blocked seconds accrue to stalled
+    wall.advance(10.0)
+    tel.run_finished()
+    # a second attempt, restart cost attributed
+    tel2 = TrainingTelemetry(tokens_per_step=1024, model_params=1_000_000,
+                             tracer=Tracer(clock=wall, export_path=path),
+                             clock=wall, mono=mono, attempt=1)
+    tel2.ledger.charge("restart_lost", 12.0)
+    tel2.run_started()
+    with tel2.checkpoint("restore", step=3):
+        mono.advance(0.5)
+        wall.advance(0.5)
+    mono.advance(2.0)
+    wall.advance(2.0)
+    tel2.record_step(4, 2.0)
+    tel2.run_finished()
+    tel.tracer.close()
+    tel2.tracer.close()
+    return path
+
+
+def test_goodput_summary_renders_waterfall_and_host_table(tmp_path, capsys):
+    _tools_path()
+    import goodput_summary
+    path = _export_training_spans(tmp_path)
+    assert goodput_summary.main([path, "--steps"]) == 0
+    out = capsys.readouterr().out
+    assert "runs: 2" in out
+    assert "goodput waterfall" in out
+    assert "restart_lost" in out, "attempt 1's charge must show in the bars"
+    assert "stalled" in out
+    assert "per-host step times" in out
+    assert "straggler host=1" in out
+    assert "restore" in out
+    assert "step-time rollup" in out
+    assert "host 0:" in out
+
+
+def test_goodput_summary_empty_file_fails_cleanly(tmp_path, capsys):
+    _tools_path()
+    import goodput_summary
+    p = tmp_path / "empty.jsonl"
+    p.write_text('{"trace_id": "t", "name": "serving.request", "start": 0}\n')
+    assert goodput_summary.main([str(p)]) == 1
+    assert "no training.* spans" in capsys.readouterr().err
+
+
+def test_trace_summary_rolls_up_training_spans(tmp_path, capsys):
+    """The ISSUE 5 satellite: ONE tool renders serving AND training."""
+    _tools_path()
+    import trace_summary
+    path = _export_training_spans(tmp_path)
+    assert trace_summary.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "training steps: 4" in out
+    # both hosts flag: host 1 went silent, so host 0 blocks in the collective
+    assert "straggler events: 2" in out
+    assert "step_time_s" in out and "p95=" in out
+    assert "run attempt=1" in out and "goodput=" in out
+
+
+# -- Trainer integration (tiny model, CPU jax) ---------------------------------
+
+def test_trainer_run_feeds_the_ledger_and_spans(tmp_path):
+    from k8s_runpod_kubelet_tpu.models import tiny_llama
+    from k8s_runpod_kubelet_tpu.workloads.train import TrainConfig, Trainer
+
+    cfg = tiny_llama(vocab_size=64, embed_dim=32, n_layers=1, n_heads=2,
+                     max_seq_len=64)
+    tc = TrainConfig(batch_size=2, seq_len=16, steps=3, warmup_steps=1,
+                     checkpoint_dir=str(tmp_path / "ckpt"),
+                     checkpoint_every=2, async_checkpoint=False)
+    tracer = Tracer()
+    tel = TrainingTelemetry(tokens_per_step=tc.batch_size * tc.seq_len,
+                            model_params=cfg.param_count, metrics=Metrics(),
+                            tracer=tracer, state_interval_s=0.0,
+                            state_path=state_path_for(tc.checkpoint_dir))
+    trainer = Trainer(cfg, tc, telemetry=tel)
+    out = trainer.run()
+    assert out["steps"] == 3
+    assert "goodput" in out and 0 < out["goodput"] <= 1
+    assert "mfu" in out
+    snap = tel.ledger.snapshot()
+    assert snap["buckets"]["compile"] > 0, "first step should land in compile"
+    assert snap["buckets"]["productive"] > 0
+    assert snap["buckets"]["checkpoint_save"] > 0, "step 2 checkpointed"
+    assert sum(snap["buckets"].values()) == pytest.approx(snap["wall_s"],
+                                                          rel=1e-6)
+    names = [s["name"] for s in tracer.recent()]
+    assert names.count("training.step") == 3
+    assert "training.checkpoint" in names
+    assert "training.run" in names
+    # the restart-attribution state persisted alongside the checkpoints
+    lost, step = read_lost_state(state_path_for(tc.checkpoint_dir), 1e18)
+    assert step == 3
+
+    # restore path: a fresh trainer resumes and records training.restore
+    tracer2 = Tracer()
+    tel2 = TrainingTelemetry(tokens_per_step=tc.batch_size * tc.seq_len,
+                             model_params=cfg.param_count, tracer=tracer2)
+    trainer2 = Trainer(cfg, tc, telemetry=tel2)
+    assert trainer2.restore() is True
+    assert trainer2.step == 2
+    assert [s["name"] for s in tracer2.recent()] == ["training.restore"]
+    assert tel2.ledger.total("checkpoint_restore") > 0

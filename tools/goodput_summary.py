@@ -1,0 +1,177 @@
+"""Render training.* spans into a goodput waterfall + per-host step table.
+
+Input is the same JSONL span export the rest of the repo writes
+(train_main ``--trace-export`` / TPU_TRACE_EXPORT_PATH, one JSON span per
+line). The training telemetry layer (workloads/telemetry.py) emits:
+
+  training.run        one per run()/attempt segment; attrs carry the full
+                      goodput-ledger snapshot (buckets, goodput, mfu,
+                      tokens_per_sec, attempt) + the watchdog's per-host
+                      table on worker-0
+  training.step       per optimizer step (step/host/tokens/loss attrs)
+  training.checkpoint / training.restore   blocking save/restore intervals
+  training.straggler  a host newly flagged stalled/slow (host/kind/lag)
+
+This tool answers "where did the time go across restarts": a per-attempt
+bucket waterfall (productive / compile / checkpoint / restart_lost /
+stalled / idle), the restore/straggler timeline, and the per-host step-time
+table from the newest training.run snapshot.
+
+Usage:
+  python tools/goodput_summary.py spans.jsonl
+  python tools/goodput_summary.py spans.jsonl --steps   # + step-time rollup
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from trace_summary import load_spans, percentile  # noqa: E402
+
+_BAR_WIDTH = 40
+_BUCKET_ORDER = ("productive", "compile", "checkpoint_save",
+                 "checkpoint_restore", "restart_lost", "stalled", "idle")
+
+
+def _fmt_s(v: float) -> str:
+    return f"{v:10.3f}s"
+
+
+def render_run_waterfall(runs: list[dict]) -> str:
+    """Per-attempt goodput bars: one block per training.run span, buckets
+    scaled against that attempt's wall clock."""
+    out = ["goodput waterfall (one block per run segment):"]
+    for i, span in enumerate(runs):
+        attrs = span.get("attrs") or {}
+        buckets = attrs.get("buckets") or {}
+        wall = float(attrs.get("wall_s") or sum(buckets.values()) or 0.0)
+        out.append(
+            f"  run[{i}] attempt={attrs.get('attempt', 0)} "
+            f"steps->{attrs.get('step', '?')} wall={wall:.3f}s "
+            f"goodput={attrs.get('goodput', 0.0):.3f} "
+            f"mfu={attrs.get('mfu', 0.0):.4f} "
+            f"tokens/s={attrs.get('tokens_per_sec', 0.0):.1f}")
+        for bucket in _BUCKET_ORDER:
+            v = float(buckets.get(bucket, 0.0))
+            if v <= 0:
+                continue
+            frac = v / wall if wall > 0 else 0.0
+            bar = "#" * max(1, int(frac * _BAR_WIDTH))
+            out.append(f"    {bucket:<20} |{bar:<{_BAR_WIDTH}}| "
+                       f"{_fmt_s(v)} ({frac * 100:5.1f}%)")
+    return "\n".join(out)
+
+
+def render_host_table(runs: list[dict]) -> str:
+    """Per-host step-time table from the NEWEST run snapshot's watchdog
+    view (worker-0 aggregates peers' heartbeats)."""
+    hosts = None
+    for span in reversed(runs):
+        hosts = (span.get("attrs") or {}).get("hosts")
+        if hosts:
+            break
+    if not hosts:
+        return "per-host table: (single-host run or no watchdog snapshot)"
+    out = ["per-host step times (newest snapshot):",
+           f"  {'host':>4}  {'step':>8}  {'mean_step_s':>12}  "
+           f"{'age_s':>8}  flag"]
+    for host in sorted(hosts, key=lambda h: int(h)):
+        row = hosts[host]
+        out.append(f"  {host:>4}  {row.get('step', -1):>8}  "
+                   f"{row.get('mean_step_s', 0.0):>12.4f}  "
+                   f"{row.get('age_s', 0.0):>8.1f}  "
+                   f"{row.get('flagged', '') or '-'}")
+    return "\n".join(out)
+
+
+def render_events(spans: list[dict]) -> str:
+    """Restore + straggler timeline, oldest first."""
+    rows = []
+    for s in spans:
+        attrs = s.get("attrs") or {}
+        if s["name"] == "training.restore":
+            rows.append((s.get("start", 0.0),
+                         f"restore   step={attrs.get('step', '?')} "
+                         f"took={s.get('duration_s', 0.0):.3f}s"))
+        elif s["name"] == "training.checkpoint":
+            rows.append((s.get("start", 0.0),
+                         f"checkpoint step={attrs.get('step', '?')} "
+                         f"took={s.get('duration_s', 0.0):.3f}s"))
+        elif s["name"] == "training.straggler":
+            rows.append((s.get("start", 0.0),
+                         f"straggler host={attrs.get('host', '?')} "
+                         f"kind={attrs.get('kind', '?')} "
+                         f"last_step={attrs.get('last_step', '?')} "
+                         f"lag_s={attrs.get('lag_s', '?')}"))
+    if not rows:
+        return "events: (no checkpoint/restore/straggler spans)"
+    rows.sort(key=lambda r: r[0])
+    t0 = rows[0][0]
+    return "\n".join(["events:"] + [f"  +{t - t0:9.3f}s  {msg}"
+                                    for t, msg in rows])
+
+
+def render_steps(spans: list[dict]) -> str:
+    by_host: dict[int, list[float]] = {}
+    for s in spans:
+        if s["name"] != "training.step":
+            continue
+        host = int((s.get("attrs") or {}).get("host", 0))
+        by_host.setdefault(host, []).append(s.get("duration_s", 0.0))
+    if not by_host:
+        return "step rollup: (no training.step spans)"
+    out = ["step-time rollup (from training.step spans):"]
+    for host in sorted(by_host):
+        vals = sorted(by_host[host])
+        out.append(f"  host {host}: n={len(vals)} "
+                   f"p50={percentile(vals, 50):.4f}s "
+                   f"p95={percentile(vals, 95):.4f}s "
+                   f"p99={percentile(vals, 99):.4f}s")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="goodput waterfall + per-host step table from a JSONL "
+                    "span export (train_main --trace-export)")
+    p.add_argument("path", help="JSONL span file")
+    p.add_argument("--steps", action="store_true",
+                   help="also roll up per-host training.step durations")
+    args = p.parse_args(argv)
+    spans = load_spans(args.path)
+    training = [s for s in spans if s["name"].startswith("training.")]
+    if not training:
+        print(f"no training.* spans in {args.path}", file=sys.stderr)
+        return 1
+    runs = sorted((s for s in training if s["name"] == "training.run"),
+                  key=lambda s: s.get("start", 0.0))
+    total_lost = 0.0
+    total_wall = 0.0
+    for s in runs:
+        attrs = s.get("attrs") or {}
+        buckets = attrs.get("buckets") or {}
+        total_wall += float(attrs.get("wall_s") or 0.0)
+        total_lost += sum(float(v) for b, v in buckets.items()
+                          if b != "productive")
+    if runs:
+        print(f"runs: {len(runs)}  total_wall={total_wall:.3f}s  "
+              f"lost={total_lost:.3f}s  "
+              f"overall_goodput="
+              f"{(1 - total_lost / total_wall) if total_wall else 0:.3f}")
+        print()
+        print(render_run_waterfall(runs))
+        print()
+        print(render_host_table(runs))
+        print()
+    print(render_events(training))
+    if args.steps:
+        print()
+        print(render_steps(training))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

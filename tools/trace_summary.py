@@ -101,7 +101,8 @@ def render_trace(trace_id: str, spans: list[dict]) -> str:
         bar = bar[:_BAR_WIDTH].ljust(_BAR_WIDTH)
         label = "  " * depth + s["name"]
         attrs = s.get("attrs") or {}
-        extra = " ".join(f"{k}={attrs[k]}" for k in ("rid", "pod", "tokens")
+        extra = " ".join(f"{k}={attrs[k]}"
+                         for k in ("rid", "pod", "tokens", "step", "host")
                          if attrs.get(k) is not None)
         out.append(f"  {label:<32} |{bar}| {start * 1000:8.1f} ms "
                    f"+{dur * 1000:8.1f} ms  {extra}".rstrip())
@@ -110,6 +111,7 @@ def render_trace(trace_id: str, spans: list[dict]) -> str:
 
 def rollups(spans: list[dict]) -> str:
     ttfts, itls, latencies = [], [], []
+    steps, stragglers, runs = [], 0, []
     for s in spans:
         attrs = s.get("attrs") or {}
         if s["name"] == "serving.request":
@@ -121,6 +123,14 @@ def rollups(spans: list[dict]) -> str:
             tokens = attrs.get("tokens")
             if isinstance(tokens, int) and tokens > 1:
                 itls.append(s.get("duration_s", 0.0) / (tokens - 1))
+        # training span families (ISSUE 5: one tool renders both layers;
+        # tools/goodput_summary.py draws the full goodput waterfall)
+        elif s["name"] == "training.step":
+            steps.append(s.get("duration_s", 0.0))
+        elif s["name"] == "training.straggler":
+            stragglers += 1
+        elif s["name"] == "training.run":
+            runs.append(s)
     lines = [f"requests: {len(latencies)}"]
     for label, vals in (("ttft_s", ttfts), ("itl_s (per-request mean)", itls),
                         ("latency_s", latencies)):
@@ -132,6 +142,25 @@ def rollups(spans: list[dict]) -> str:
             f"  {label:<28} p50={percentile(vals, 50):.4f}  "
             f"p95={percentile(vals, 95):.4f}  p99={percentile(vals, 99):.4f}  "
             f"n={len(vals)}")
+    if steps or runs:
+        lines.append(f"training steps: {len(steps)}"
+                     + (f"  straggler events: {stragglers}" if stragglers
+                        else ""))
+        if steps:
+            vals = sorted(steps)
+            lines.append(
+                f"  {'step_time_s':<28} p50={percentile(vals, 50):.4f}  "
+                f"p95={percentile(vals, 95):.4f}  "
+                f"p99={percentile(vals, 99):.4f}  n={len(vals)}")
+        for r in runs:
+            attrs = r.get("attrs") or {}
+            lines.append(
+                f"  run attempt={attrs.get('attempt', 0)}: "
+                f"goodput={attrs.get('goodput', 0.0):.3f}  "
+                f"mfu={attrs.get('mfu', 0.0):.4f}  "
+                f"tokens/s={attrs.get('tokens_per_sec', 0.0):.1f}  "
+                f"wall={attrs.get('wall_s', 0.0):.3f}s  "
+                f"(waterfall: tools/goodput_summary.py)")
     return "\n".join(lines)
 
 
