@@ -104,18 +104,40 @@ class _FakeResource:
         self.state_since = now
 
     def start_workload(self, spec: dict, worker_env: list[dict], now: float,
-                       auto_finish_s: Optional[float]):
+                       auto_finish_s: Optional[float],
+                       worker_ids: Optional[list[int]] = None):
+        """``worker_ids`` restricts the (re)launch to a subset — the elastic
+        resize path. Subset launches REPLACE those workers' runtime entries
+        and keep the others' (a dead worker's unhealthy record must survive
+        the surviving gang's relaunch, exactly as real per-VM state would)."""
         self.workload = spec or self.workload
         self.worker_env = worker_env
         self.workload_started_at = now
         self.auto_finish_s = auto_finish_s
-        self.runtime = [
-            {"worker_id": w["worker_id"], "hostname": w["hostname"],
-             "internal_ip": w["internal_ip"], "healthy": True,
-             "workload_running": True, "exit_code": None, "exit_message": "",
-             "started_at": now, "finished_at": None}
-            for w in self.workers
-        ]
+
+        def entry(w):
+            return {"worker_id": w["worker_id"], "hostname": w["hostname"],
+                    "internal_ip": w["internal_ip"],
+                    "healthy": w.get("state") != "PREEMPTED",
+                    "workload_running": w.get("state") != "PREEMPTED",
+                    "exit_code": None, "exit_message": "",
+                    "started_at": now, "finished_at": None}
+
+        if worker_ids is None:
+            self.runtime = [entry(w) for w in self.workers]
+        else:
+            wanted = set(worker_ids)
+            prior = {r["worker_id"]: r for r in self.runtime}
+            self.runtime = [entry(w) if w["worker_id"] in wanted
+                            else prior.get(w["worker_id"], {
+                                "worker_id": w["worker_id"],
+                                "hostname": w["hostname"],
+                                "internal_ip": w["internal_ip"],
+                                "healthy": w.get("state") != "PREEMPTED",
+                                "workload_running": False, "exit_code": None,
+                                "exit_message": "", "started_at": None,
+                                "finished_at": None})
+                            for w in self.workers]
         for p in self.workload.get("ports", []):
             port = int(str(p).split("/")[0])
             self.ports[port] = 30000 + port % 2000
@@ -176,8 +198,15 @@ class FakeTpuService:
         self.fail_next_create: Optional[tuple[int, str]] = None  # (status, message)
         # seeded composite chaos: when set, every request consults the plan
         # (latency spikes advance the injected clock, storms preempt ACTIVE
-        # slices, blackouts/bursts reject) — see cloud/faults.py
+        # slices, host_loss kills ONE worker of a multi-host slice and
+        # restores it when the window closes, blackouts/bursts reject) —
+        # see cloud/faults.py
         self.fault_plan = None
+        # elastic soaks over the SSH path bridge the fake cloud's worker
+        # state to the docker-lite FakeWorkerHost: called as
+        # hook(slice_name, worker_id, lost) after the server applies a
+        # host_loss transition to its own records
+        self.host_loss_hook = None
         self.create_count = 0
         self.delete_count = 0
         self.request_log: list[tuple[str, str]] = []
@@ -216,6 +245,22 @@ class FakeTpuService:
                     r.runtime[worker_id]["healthy"] = False
                     r.runtime[worker_id]["workload_running"] = False
 
+    def restore_worker(self, name: str, worker_id: int):
+        """Capacity returned: the lost worker's replacement VM is READY
+        again (its container is NOT running — the kubelet's grow path
+        relaunches the gang). The host_loss fault window calls this when
+        it closes; tests call it directly."""
+        with self.lock:
+            r = self.resources.get(name)
+            if r is None:
+                return
+            if worker_id < len(r.workers):
+                r.workers[worker_id]["state"] = "READY"
+            for rt in r.runtime:
+                if rt["worker_id"] == worker_id:
+                    rt["healthy"] = True
+                    rt["workload_running"] = False
+
     def vanish(self, name: str):
         """Simulate the slice disappearing entirely (NOT_FOUND path)."""
         with self.lock:
@@ -238,12 +283,24 @@ class FakeTpuService:
                 return 503, {"error": "service unavailable"}
             if self.fault_plan is not None:
                 # latency first (simulated time passes BEFORE the request is
-                # served), then storms mutate state, then reject decisions
+                # served), then storms/host-losses mutate state, then reject
+                # decisions
                 self.fault_plan.apply_latency()
                 for victim in self.fault_plan.preempt_victims(
                         [r.name for r in self.resources.values()
                          if r.state is QueuedResourceState.ACTIVE]):
                     self.preempt(victim)
+                for name, wid, lost in self.fault_plan.host_loss_transitions(
+                        [(r.name, len(r.workers))
+                         for r in self.resources.values()
+                         if r.state is QueuedResourceState.ACTIVE]):
+                    if name in self.resources:
+                        if lost:
+                            self.preempt(name, worker_id=wid)
+                        else:
+                            self.restore_worker(name, wid)
+                        if self.host_loss_hook is not None:
+                            self.host_loss_hook(name, wid, lost)
                 fault = self.fault_plan.request_fault()
                 if fault is not None:
                     return fault
@@ -301,7 +358,8 @@ class FakeTpuService:
                 if r.state is not QueuedResourceState.ACTIVE:
                     return 409, {"error": f"slice {name} is {r.state.value}, not ACTIVE"}
                 r.start_workload(body.get("workload", {}), body.get("workerEnv", []),
-                                 now, self.workload_auto_finish_s)
+                                 now, self.workload_auto_finish_s,
+                                 worker_ids=body.get("workerIds"))
                 return 200, {}
             if method == "DELETE":
                 self.delete_count += 1

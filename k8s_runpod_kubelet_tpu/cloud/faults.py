@@ -26,8 +26,13 @@ LATENCY_SPIKE = "latency_spike"    # every request takes `param` extra seconds
 BLACKOUT = "blackout"              # every request 503 (+ Retry-After)
 PREEMPTION_STORM = "preemption_storm"  # ACTIVE slices get preempted
 FLAKY_HEAL = "flaky_heal"          # error rate decays linearly to 0 over the window
+HOST_LOSS = "host_loss"            # ONE worker of a multi-host slice dies for
+                                   # the window; capacity returns when it ends
 
 KINDS = (ERROR_BURST, LATENCY_SPIKE, BLACKOUT, PREEMPTION_STORM, FLAKY_HEAL)
+# host_loss is opt-in (explicit windows): random plans keep the legacy mix so
+# existing seeded soaks replay identically; elastic soaks schedule it by hand
+ALL_KINDS = KINDS + (HOST_LOSS,)
 
 
 @dataclasses.dataclass
@@ -72,6 +77,11 @@ class FaultPlan:
         self.injected_errors = 0
         self.injected_latency_s = 0.0
         self.preempted: list[tuple[float, str]] = []
+        self.host_losses: list[tuple[float, str, int]] = []
+        # host_loss bookkeeping: window index -> (slice, worker) chosen when
+        # the window opened; moved to _restored once the close fired
+        self._host_loss_live: dict[int, tuple[str, int]] = {}
+        self._host_loss_done: set[int] = set()
 
     # -- schedule generation ---------------------------------------------------
 
@@ -147,6 +157,39 @@ class FaultPlan:
                     return 503, {"error": "injected flake (healing)"}, {}
         return None
 
+    def host_loss_transitions(self, candidates: list[tuple[str, int]]
+                              ) -> list[tuple[str, int, bool]]:
+        """Open/close host_loss windows against the current world.
+        ``candidates``: (slice name, worker count) of ACTIVE multi-host
+        slices. Returns (slice, worker_id, lost) transitions the caller must
+        apply: lost=True when a window opens (kill exactly ONE worker of one
+        slice — the partial-gang failure preemption storms can't model),
+        lost=False when it closes (the cloud restores capacity). Victim
+        choice is seeded: same seed + same request sequence = same victim.
+        ``param`` >= 1 pins the worker id (int(param) % workers) for fully
+        scripted soaks; param < 1 draws it from the plan's RNG."""
+        t = self._now()
+        out: list[tuple[str, int, bool]] = []
+        for idx, w in enumerate(self.windows):
+            if w.kind != HOST_LOSS:
+                continue
+            if w.active_at(t) and idx not in self._host_loss_live \
+                    and idx not in self._host_loss_done:
+                multi = sorted((n, c) for n, c in candidates if c > 1)
+                if not multi:
+                    continue  # nothing to lose a host from yet; retry next call
+                name, count = multi[self.rng.randrange(len(multi))]
+                wid = (int(w.param) % count if w.param >= 1.0
+                       else self.rng.randrange(count))
+                self._host_loss_live[idx] = (name, wid)
+                self.host_losses.append((t, name, wid))
+                out.append((name, wid, True))
+            elif t >= w.end and idx in self._host_loss_live:
+                name, wid = self._host_loss_live.pop(idx)
+                self._host_loss_done.add(idx)
+                out.append((name, wid, False))
+        return out
+
     def preempt_victims(self, active_slices: list[str]) -> list[str]:
         """During a preemption storm, pick victims among the ACTIVE slice
         names (each independently with the window's probability). The fake
@@ -166,7 +209,8 @@ class FaultPlan:
         lines = [f"FaultPlan(seed={self.seed}, horizon={self.horizon_s:.0f}s, "
                  f"errors={self.injected_errors}, "
                  f"latency={self.injected_latency_s:.1f}s, "
-                 f"preemptions={len(self.preempted)})"]
+                 f"preemptions={len(self.preempted)}, "
+                 f"host_losses={len(self.host_losses)})"]
         for w in self.windows:
             lines.append(f"  [{w.start:7.1f}s - {w.end:7.1f}s] "
                          f"{w.kind} param={w.param:.2f}")

@@ -323,12 +323,16 @@ class TpuClient:
 
     def start_workload(self, name: str, spec: WorkloadSpec,
                        worker_env: Optional[list[dict[str, str]]] = None,
-                       zone: Optional[str] = None) -> None:
+                       zone: Optional[str] = None,
+                       worker_ids: Optional[list[int]] = None) -> None:
         """Launch the workload on every worker of an ACTIVE slice (gang launch)
         via the workload backend. ``worker_env`` is the per-worker env overlay
-        (TPU_WORKER_ID, coordinator...) computed by gang/env.py."""
+        (TPU_WORKER_ID, coordinator...) computed by gang/env.py.
+        ``worker_ids`` restricts the launch to a surviving subset (elastic
+        resize, ISSUE 6); None = the whole gang."""
         from .workload_backend import WorkloadBackendError
         try:
-            self.workload_backend.start(self, name, spec, worker_env, zone)
+            self.workload_backend.start(self, name, spec, worker_env, zone,
+                                        worker_ids=worker_ids)
         except WorkloadBackendError as e:
             raise TpuApiError(str(e)) from e

@@ -38,9 +38,12 @@ class WorkloadBackendError(Exception):
 
 
 class WorkloadBackend:
-    """Protocol. ``client`` is the owning TpuClient (for resource reads)."""
+    """Protocol. ``client`` is the owning TpuClient (for resource reads).
+    ``worker_ids`` (elastic resize, ISSUE 6) restricts a launch to a
+    surviving subset of the slice's workers; None = the whole gang."""
 
-    def start(self, client, name: str, spec, worker_env, zone) -> None:
+    def start(self, client, name: str, spec, worker_env, zone,
+              worker_ids=None) -> None:
         raise NotImplementedError
 
     def detailed_status(self, client, name: str, zone) -> DetailedStatus:
@@ -50,11 +53,13 @@ class WorkloadBackend:
 class ApiWorkloadBackend(WorkloadBackend):
     """Extension endpoints over the cloud transport (fake server / aggregator)."""
 
-    def start(self, client, name, spec, worker_env, zone):
+    def start(self, client, name, spec, worker_env, zone, worker_ids=None):
         from .transport import TransportError
         body: dict[str, Any] = {"workload": spec.to_json()}
         if worker_env is not None:
             body["workerEnv"] = worker_env
+        if worker_ids is not None:
+            body["workerIds"] = sorted(worker_ids)
         try:
             client.transport.request(
                 "POST", f"{client._base(zone)}/queuedResources/{name}:workload",
@@ -126,32 +131,42 @@ class SshWorkloadBackend(WorkloadBackend):
             parts.append(shlex.quote(c))
         return ["sh", "-c", " ".join(parts)]
 
-    def start(self, client, name, spec, worker_env, zone):
+    def start(self, client, name, spec, worker_env, zone, worker_ids=None):
         qr = client.get_queued_resource(name, zone=zone)
         if not qr.workers:
             raise WorkloadBackendError(f"slice {name} reports no workers")
-        n = len(qr.workers)
+        workers = qr.workers
+        if worker_ids is not None:
+            wanted = set(worker_ids)
+            workers = [w for w in qr.workers if w.worker_id in wanted]
+            if len(workers) != len(wanted):
+                have = {w.worker_id for w in qr.workers}
+                raise WorkloadBackendError(
+                    f"slice {name} has no workers {sorted(wanted - have)}")
+        n = len(workers)
         envs = worker_env if worker_env is not None else [{} for _ in range(n)]
         if len(envs) != n:
             raise WorkloadBackendError(
                 f"worker_env has {len(envs)} entries for {n} workers")
         cmds = {w.worker_id: self._run_script(spec, envs[i])
-                for i, w in enumerate(qr.workers)}
+                for i, w in enumerate(workers)}
         try:
             self.executor.run_per_worker(qr, cmds, timeout_s=120.0, host=True)
         except WorkerExecError as e:
             # all-or-nothing: tear down any worker that did start, so the
             # retry next reconcile pass begins from a clean slate
-            self._teardown(qr)
+            self._teardown(qr, worker_ids=worker_ids)
             raise WorkloadBackendError(f"gang launch on {name} failed: {e}") from e
         with self._lock:
             self._ports[name] = {int(p.split("/")[0]): int(p.split("/")[0])
                                  for p in spec.ports}
-        log.info("ssh backend: launched %s on all %d workers of %s",
-                 spec.image, n, name)
+        log.info("ssh backend: launched %s on %d/%d workers of %s",
+                 spec.image, n, len(qr.workers), name)
 
-    def _teardown(self, qr: QueuedResource):
-        for w in qr.workers:
+    def _teardown(self, qr: QueuedResource, worker_ids=None):
+        workers = (qr.workers if worker_ids is None
+                   else [w for w in qr.workers if w.worker_id in set(worker_ids)])
+        for w in workers:
             try:
                 self.executor.run_on_worker(
                     qr, w.worker_id,

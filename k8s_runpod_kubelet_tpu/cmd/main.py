@@ -98,6 +98,17 @@ def parse_flags(argv: list[str]) -> argparse.Namespace:
                    default=None,
                    help="emit TrainingStalled when a Running training pod's "
                         "scraped step counter stops advancing for this long")
+    p.add_argument("--elastic-resize", dest="elastic_resize", default=None,
+                   choices=["true", "false"],
+                   help="honor the tpu.dev/elastic pod annotation: on "
+                        "partial host loss, relaunch the gang on the "
+                        "surviving workers (resharded from the latest "
+                        "checkpoint) instead of requeueing the whole slice")
+    p.add_argument("--elastic-grow-grace-s", dest="elastic_grow_grace_s",
+                   type=float, default=None,
+                   help="grow a shrunk gang back this long after capacity "
+                        "returns even when no fresh checkpoint boundary is "
+                        "seen in worker logs")
     return p.parse_args(argv)
 
 

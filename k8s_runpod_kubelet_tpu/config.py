@@ -117,6 +117,16 @@ class Config:
     straggler_factor: float = 3.0
     stall_timeout_s: float = 300.0
 
+    # elastic gang training (ISSUE 6). elastic_resize is the global gate for
+    # the tpu.dev/elastic pod annotation: on partial host loss an elastic
+    # gang is relaunched on the SURVIVING workers (mesh rebuilt at the
+    # surviving DP width, state resharded from the latest checkpoint)
+    # instead of requeueing the whole slice, and grown back when capacity
+    # returns — preferring a checkpoint boundary, with elastic_grow_grace_s
+    # as the fallback deadline for workloads that never checkpoint.
+    elastic_resize: bool = True
+    elastic_grow_grace_s: float = 120.0
+
     # servers
     listen_port: int = 10250
     health_address: str = ":8080"
@@ -186,6 +196,8 @@ class Config:
                         "half the fleet)")
         if self.stall_timeout_s <= 0:
             errs.append("stall_timeout_s must be > 0")
+        if self.elastic_grow_grace_s < 0:
+            errs.append("elastic_grow_grace_s must be >= 0")
         if errs:
             raise ValueError("invalid config: " + "; ".join(errs))
         return self
@@ -216,6 +228,8 @@ _ENV_MAP = {
     "TPU_TELEMETRY_PORT": "telemetry_port",
     "TPU_STRAGGLER_FACTOR": "straggler_factor",
     "TPU_STALL_TIMEOUT_S": "stall_timeout_s",
+    "TPU_ELASTIC_RESIZE_ENABLED": "elastic_resize",
+    "TPU_ELASTIC_GROW_GRACE_S": "elastic_grow_grace_s",
 }
 
 

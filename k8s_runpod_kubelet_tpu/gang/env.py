@@ -21,9 +21,16 @@ DEFAULT_COORDINATOR_PORT = 8476
 DEFAULT_MEGASCALE_PORT = 8080
 
 
-def coordinator_address(qr: QueuedResource, port: int = DEFAULT_COORDINATOR_PORT) -> str:
-    """Worker 0 is the jax.distributed coordinator, by convention."""
-    host = qr.workers[0].internal_ip or qr.workers[0].hostname if qr.workers else ""
+def coordinator_address(qr: QueuedResource, port: int = DEFAULT_COORDINATOR_PORT,
+                        worker_ids: Optional[list[int]] = None) -> str:
+    """Worker 0 is the jax.distributed coordinator, by convention. On an
+    elastic resize launch over a surviving subset, the LOWEST surviving
+    worker takes the role (worker 0 may be the one that died)."""
+    workers = qr.workers
+    if worker_ids is not None:
+        by_id = {w.worker_id: w for w in qr.workers}
+        workers = [by_id[i] for i in sorted(worker_ids) if i in by_id]
+    host = workers[0].internal_ip or workers[0].hostname if workers else ""
     return f"{host}:{port}"
 
 
@@ -38,6 +45,7 @@ def compute_worker_env(
     telemetry_port: int = 0,
     straggler_factor: float = 0.0,
     stall_timeout_s: float = 0.0,
+    worker_ids: Optional[list[int]] = None,
 ) -> list[dict[str, str]]:
     """Build the per-worker env overlay for a gang launch.
 
@@ -50,12 +58,26 @@ def compute_worker_env(
     worker-0 coordinator; ICI needs no config beyond "same program, all hosts".
     Multislice: MEGASCALE_* vars describe the DCN mesh across slices; process
     ids are globally offset so jax sees one flat process space.
+
+    ``worker_ids`` (elastic resize, ISSUE 6): launch over this SUBSET of the
+    slice's workers — a shrink after host loss, or a targeted relaunch.
+    JAX process ids are renumbered densely over the subset (jax.distributed
+    wants a contiguous 0..k-1 process space), the lowest surviving worker
+    becomes the coordinator, and TPU_WORKER_ID keeps the PHYSICAL id so
+    docker/log targeting still addresses the right VM.
     """
     acc = lookup_accelerator(qr.accelerator_type)
     hosts = qr.workers
+    if worker_ids is not None:
+        by_id = {w.worker_id: w for w in qr.workers}
+        missing = [i for i in worker_ids if i not in by_id]
+        if missing:
+            raise ValueError(f"slice {qr.name} has no workers {missing}")
+        hosts = [by_id[i] for i in sorted(worker_ids)]
     n = len(hosts)
+    dense = {w.worker_id: i for i, w in enumerate(hosts)}
     hostnames = ",".join(w.hostname for w in hosts)
-    coord = coordinator_address(qr, coordinator_port)
+    coord = coordinator_address(qr, coordinator_port, worker_ids=worker_ids)
     if megascale_coordinator is None:
         # prefer the hostname: slice 0's default must equal the string other
         # slices put in their tpu.dev/megascale-coordinator annotation (the
@@ -77,7 +99,7 @@ def compute_worker_env(
             # jax.distributed bootstrap (multi-controller)
             "JAX_COORDINATOR_ADDRESS": coord,
             "JAX_NUM_PROCESSES": str(n * num_slices),
-            "JAX_PROCESS_ID": str(slice_id * n + w.worker_id),
+            "JAX_PROCESS_ID": str(slice_id * n + dense[w.worker_id]),
             # slice identity for logging/metrics
             "TPU_SLICE_NAME": qr.name,
             "TPU_ZONE": qr.zone,

@@ -58,6 +58,25 @@ class FakeWorkerHost(WorkerTransport):
         with self.lock:
             self.dead_workers.add((qr_name, worker_id))
 
+    def revive_worker(self, qr_name: str, worker_id: int):
+        """Capacity returned (host_loss window closed): the replacement VM
+        is reachable again, but as a FRESH host — whatever containers the
+        dead VM ran are gone; the kubelet's elastic grow path relaunches
+        the gang on it. The natural partner of kill_worker for host_loss
+        chaos windows (cloud/faults.py)."""
+        with self.lock:
+            self.dead_workers.discard((qr_name, worker_id))
+            self.hosts.pop((qr_name, worker_id), None)
+
+    def host_loss_hook(self, qr_name: str, worker_id: int, lost: bool):
+        """FaultPlan bridge: wire as ``fake_service.host_loss_hook`` so a
+        host_loss window kills/revives the docker-lite VM in lockstep with
+        the fake cloud's worker records (the SSH-path elastic soak)."""
+        if lost:
+            self.kill_worker(qr_name, worker_id)
+        else:
+            self.revive_worker(qr_name, worker_id)
+
     def finish(self, qr_name: str, exit_codes: Optional[list[int]] = None,
                container: str = "workload"):
         """Workload exits on every worker (exit_codes[i] or 0)."""

@@ -12,7 +12,8 @@ or is needed (SURVEY.md §5.8).
                    (gang/env.py) — the two halves meet here.
 """
 
-from .mesh import AXES, MeshConfig, make_mesh, best_mesh_for
+from .mesh import (AXES, MeshConfig, make_mesh, best_mesh_for, dp_width,
+                   make_resized_mesh, resize_config)
 from .sharding import (
     LOGICAL_RULES,
     logical_sharding,
@@ -20,13 +21,17 @@ from .sharding import (
     shard_logical,
     param_shardings,
 )
-from .distributed import initialize_from_env, process_env_summary
+from .distributed import (initialize_from_env, process_env_summary,
+                          reinitialize_from_env, resize_env_summary,
+                          surviving_process_env)
 from .pipeline import pipeline_spmd, pipeline_stages
 
 __all__ = [
     "AXES", "MeshConfig", "make_mesh", "best_mesh_for",
+    "dp_width", "make_resized_mesh", "resize_config",
     "LOGICAL_RULES", "logical_sharding", "logical_spec", "shard_logical",
     "param_shardings",
     "initialize_from_env", "process_env_summary",
+    "reinitialize_from_env", "resize_env_summary", "surviving_process_env",
     "pipeline_spmd", "pipeline_stages",
 ]

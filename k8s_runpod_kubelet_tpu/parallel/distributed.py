@@ -68,3 +68,89 @@ def initialize_from_env(env: Optional[dict] = None, timeout_s: int = 300) -> Pro
         initialization_timeout=timeout_s,
     )
     return pe
+
+
+# -- elastic gang resize (ISSUE 6) ---------------------------------------------
+
+@dataclasses.dataclass
+class ResizeEnv:
+    """How a gang looks after an elastic shrink/grow relaunch. The kubelet
+    injects the regular JAX_* vars already renumbered for the survivors
+    (gang/env.py computes them over the surviving worker subset), plus:
+
+      TPU_GANG_FULL_HOSTS   the slice's original host count
+      TPU_ELASTIC_RESIZE    cumulative shrink/grow count (>0 on a resize
+                            relaunch; rides the same injection path as
+                            TPU_RESTART_ATTEMPT / TPU_CHECKPOINT_DIR)
+      TPU_ELASTIC_BATCH_MODE  "global" (hold global batch via grad
+                            accumulation) or "per_host" (hold per-host
+                            batch; global batch scales with the gang)
+    """
+
+    full_hosts: int
+    resize_count: int
+    batch_mode: str
+
+    @property
+    def is_resized(self) -> bool:
+        return self.resize_count > 0
+
+    def shrunk(self, pe: ProcessEnv) -> bool:
+        return self.is_resized and pe.num_processes < self.full_hosts
+
+
+def resize_env_summary(pe: ProcessEnv, env: Optional[dict] = None) -> ResizeEnv:
+    e = os.environ if env is None else env
+    return ResizeEnv(
+        full_hosts=int(e.get("TPU_GANG_FULL_HOSTS",
+                             str(pe.num_processes)) or pe.num_processes),
+        resize_count=int(e.get("TPU_ELASTIC_RESIZE", "0") or 0),
+        batch_mode=e.get("TPU_ELASTIC_BATCH_MODE", "global") or "global",
+    )
+
+
+def surviving_process_env(pe: ProcessEnv, lost_workers: set[int],
+                          my_worker_id: Optional[int] = None) -> ProcessEnv:
+    """The ProcessEnv a surviving host assumes after ``lost_workers`` leave
+    the gang: process ids renumbered densely over the survivors (jax wants
+    a contiguous 0..n-1 process space), worker identity preserved. This is
+    the SAME renumbering gang/env.py applies on a resize relaunch — shared
+    here so an in-process rendezvous (single-controller runs, tests) and
+    the kubelet-driven relaunch agree on who is process 0."""
+    wid = pe.worker_id if my_worker_id is None else my_worker_id
+    if wid in lost_workers:
+        raise ValueError(f"worker {wid} is in the lost set — it has no "
+                         "place in the resized gang")
+    survivors = [w for w in range(pe.num_processes) if w not in lost_workers]
+    return dataclasses.replace(
+        pe,
+        num_processes=len(survivors),
+        process_id=survivors.index(wid),
+        worker_id=wid,
+    )
+
+
+def reinitialize_from_env(env: Optional[dict] = None,
+                          timeout_s: int = 300) -> ProcessEnv:
+    """Tear down and re-form the multi-controller runtime after a resize:
+    the surviving hosts rendezvous at the (possibly new) coordinator with
+    their renumbered process ids. Single-process runs no-op, like
+    initialize_from_env — the mesh rebuild alone carries the resize."""
+    pe = process_env_summary(env)
+    if not pe.is_distributed:
+        return pe
+    import jax
+    try:
+        jax.distributed.shutdown()
+    except (RuntimeError, ValueError):
+        pass  # never initialized, or the old coordinator died with the host
+    log.info("elastic resize: re-forming gang (coordinator=%s, "
+             "num_processes=%d, process_id=%d)",
+             pe.coordinator, pe.num_processes, pe.process_id)
+    jax.distributed.initialize(
+        coordinator_address=pe.coordinator,
+        num_processes=pe.num_processes,
+        process_id=pe.process_id,
+        initialization_timeout=timeout_s,
+    )
+    return pe

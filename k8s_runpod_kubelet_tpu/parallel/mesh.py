@@ -98,3 +98,42 @@ def best_mesh_for(n_devices: int, *, tensor: int = 1, seq: int = 1,
 def mesh_summary(mesh: Mesh) -> str:
     parts = [f"{name}={size}" for name, size in mesh.shape.items() if size > 1]
     return ",".join(parts) or "single-device"
+
+
+# -- elastic resize (ISSUE 6) --------------------------------------------------
+
+def dp_width(mesh: Mesh) -> int:
+    """The mesh's data-parallel width: the product of the batch-carrying
+    axes (data x fsdp). This is the dimension elastic training resizes —
+    model-parallel axes (tensor/seq/stage/expert) are pinned to the slice
+    topology and never shrink on host loss."""
+    return mesh.shape[AXES.DATA] * mesh.shape[AXES.FSDP]
+
+
+def resize_config(config: MeshConfig, n_devices: int) -> MeshConfig:
+    """The same parallelism layout over a different device count: the
+    model-parallel axes (tensor/seq/stage/expert) keep their degrees, and
+    data/fsdp absorb the surviving devices. FSDP shrinks proportionally
+    when it can (param shards grow; memory headroom is the caller's
+    problem to have provisioned), else collapses into pure data parallel.
+    Raises ValueError when the survivors can't host the model axes at all
+    — the caller falls back to requeueing the whole gang."""
+    model = config.stage * config.expert * config.seq * config.tensor
+    if n_devices < model or n_devices % model:
+        raise ValueError(
+            f"{n_devices} surviving devices cannot carry the model axes "
+            f"(stage*expert*seq*tensor={model}); requeue instead of resizing")
+    budget = n_devices // model
+    fsdp = min(config.fsdp, budget)
+    while fsdp > 1 and budget % fsdp:
+        fsdp -= 1
+    return dataclasses.replace(config, data=budget // fsdp, fsdp=fsdp)
+
+
+def make_resized_mesh(config: MeshConfig, devices: list) -> Mesh:
+    """Rebuild the mesh over a surviving (or restored) device list at the
+    width ``resize_config`` chooses. The returned mesh uses the same axis
+    names, so logical sharding rules (parallel/sharding.py) re-apply
+    unchanged and an orbax restore with the new NamedShardings reshards
+    params/optimizer state onto it (the PR 3 StandardRestore seam)."""
+    return make_mesh(resize_config(config, len(devices)), devices)

@@ -52,6 +52,28 @@ class Annotations:
     # consumes both).
     CHECKPOINT_DIR = "tpu.dev/checkpoint-dir"
 
+    # elastic gang training (ISSUE 6): opt-in resize-instead-of-restart on
+    # partial host loss. ELASTIC="true" makes the kubelet relaunch the gang
+    # on the surviving hosts (mesh rebuilt at the surviving DP width, state
+    # resharded from the latest checkpoint) instead of requeueing the whole
+    # slice; MIN_HOSTS is the floor below which it requeues after all.
+    # RESIZE_COUNT / LOST_WORKERS are durable state (mirrors of
+    # InstanceInfo, restored on kubelet restart): the cumulative shrink/grow
+    # count — deliberately SEPARATE from preemption-count, a resize never
+    # consumes the requeue budget — and the currently-excluded worker ids.
+    # GANG_WIDTH ("surviving/total") is the operator-visible width.
+    ELASTIC = "tpu.dev/elastic"
+    ELASTIC_MIN_HOSTS = "tpu.dev/elastic-min-hosts"
+    ELASTIC_BATCH_MODE = "tpu.dev/elastic-batch-mode"  # global | per_host
+    RESIZE_COUNT = "tpu.dev/resize-count"
+    LOST_WORKERS = "tpu.dev/lost-workers"
+    GANG_WIDTH = "tpu.dev/gang-width"
+    # the scraped training step when the shrink happened: the grow path only
+    # treats a `checkpoint saved/staged at step N` log line as a boundary
+    # when N is at least this — durable so a kubelet restart can't mistake a
+    # PRE-shrink checkpoint line for a fresh boundary
+    RESIZE_STEP = "tpu.dev/resize-step"
+
     # bookkeeping
     EXTERNAL = "tpu.dev/external"                   # adopted orphan (kubelet.go:1580)
     PREEMPTION_COUNT = "tpu.dev/preemption-count"

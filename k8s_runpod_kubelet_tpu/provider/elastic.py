@@ -1,0 +1,365 @@
+"""Elastic gang resizing: shrink to the survivors on host loss, grow back.
+
+The control-plane half of ISSUE 6. Preemption recovery (recovery.py +
+reconcile.py's requeue) restarts the SAME-SIZE gang from its checkpoint —
+correct for whole-slice preemption, wasteful for a single lost host: the
+surviving N-1 hosts idle until the cloud grants a full replacement slice,
+and the GoodputLedger charges all of it to ``restart_lost``. This mixin
+distinguishes the two:
+
+- **whole-slice preemption** (SUSPENDED/SUSPENDING): unchanged — requeue,
+  consuming ``preemption_requeue_limit`` budget;
+- **partial-gang loss** (slice ACTIVE, some workers unhealthy): for a pod
+  annotated ``tpu.dev/elastic=true``, relaunch the workload on the
+  SURVIVING workers only — gang/env.py renumbers JAX process ids densely,
+  the lowest survivor becomes coordinator, and the injected
+  ``TPU_ELASTIC_RESIZE`` / ``TPU_GANG_FULL_HOSTS`` ride the same
+  env-injection path as ``TPU_RESTART_ATTEMPT`` so train_main reshards
+  from the latest orbax checkpoint at the surviving DP width
+  (workloads/train.py ``Trainer.resize`` is the in-process analog). A
+  resize never consumes the preemption-requeue budget (resize-count is
+  tracked separately, and pinned by a regression test).
+
+While shrunk, the kubelet keeps a replacement request open (the
+``ReplacementRequested`` event; on Cloud TPU a queued-resource's worker is
+re-delivered by the infrastructure — the fake cloud models it as the
+host_loss fault window closing) and **grows back** once every worker is
+healthy again — preferring the next checkpoint boundary (a `checkpoint
+saved at step N` line in worker-0 logs newer than the shrink) so the
+re-restore loses nothing, with ``elastic_grow_grace_s`` as the fallback
+when the workload never checkpoints. Both directions emit a ``GangResized``
+event and a ``pod.gang_resize`` span joined to the pod's lifecycle trace.
+
+Pods below ``tpu.dev/elastic-min-hosts`` survivors (or non-elastic pods
+with a checkpoint dir and requeue budget) fall back to the requeue path;
+pods with neither keep the original gang-fail contract (GangBroken ->
+Failed, the owning Job recreates).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+from ..cloud.tpu_client import TpuApiError
+from ..cloud.types import DetailedStatus
+from ..gang.env import compute_worker_env
+from ..kube.client import KubeApiError
+from ..kube import objects as ko
+from .annotations import Annotations as A, AnnotationResolver
+from .translate import prepare_tpu_parameters, TranslationError
+
+log = logging.getLogger(__name__)
+
+# train.py logs "saved" on blocking saves and "staged" on async ones (the
+# run() loop's default); the grow path greps either to grow at a checkpoint
+# boundary. A staged write may be seconds from durable, but the grown gang's
+# orbax restore only ever reads COMMITTED steps, so the worst case is
+# resuming one checkpoint earlier — still bounded, unlike growing blind.
+_CHECKPOINT_SAVED_RE = r"checkpoint (?:saved|staged) at step (\d+)"
+
+
+def _lost_worker_ids(detailed: DetailedStatus) -> set[int]:
+    return {w.worker_id for w in detailed.runtime if not w.healthy}
+
+
+class ElasticGangMixin:
+    def _describe_elastic_metrics(self):
+        m = self.metrics
+        m.describe("tpu_kubelet_gang_resizes",
+                   "elastic gang resizes performed (kind label: shrink/grow)")
+        m.describe("tpu_kubelet_gang_resize_failures",
+                   "resize relaunches that failed (retried next sweep)")
+        m.describe("tpu_kubelet_host_loss_requeues",
+                   "partial-gang losses handled by a full requeue "
+                   "(non-elastic pod with checkpoint dir + budget)")
+
+    # -- policy ----------------------------------------------------------------
+
+    def _elastic_enabled(self, pod: dict) -> bool:
+        if not getattr(self.cfg, "elastic_resize", True):
+            return False
+        return ko.annotations(pod).get(A.ELASTIC, "").lower() in ("1", "true",
+                                                                  "yes")
+
+    def _elastic_min_hosts(self, pod: dict) -> int:
+        try:
+            return max(1, int(ko.annotations(pod).get(
+                A.ELASTIC_MIN_HOSTS, "1") or 1))
+        except ValueError:
+            return 1
+
+    def _is_multislice(self, pod: dict) -> bool:
+        resolver = AnnotationResolver(self.kube, pod)
+        return max(1, resolver.get_int(A.NUM_SLICES, 1)) > 1
+
+    # -- the reconcile hook ----------------------------------------------------
+
+    #: sentinel: the elastic pass requeued the pod; the reconcile pass must
+    #: stop (the slice is being deleted; its stale status must not be pushed)
+    REQUEUED = "requeued"
+
+    def _elastic_reconcile(self, key: str, pod: dict, info, detailed,
+                           now: float):
+        """Called by _reconcile_one for an ACTIVE, launched slice whose
+        runtime is known. Applies the shrink/grow state machine and returns
+        the DetailedStatus the rest of the pass should see — with the
+        currently-excluded workers FILTERED OUT, so translate_status judges
+        the surviving gang (Running while the survivors run) instead of
+        failing the pod for a loss the resize already absorbed. Returns
+        ``ElasticGangMixin.REQUEUED`` when it routed the pod to the requeue
+        ladder (caller stops the pass), or None when the pod is not elastic
+        or nothing needs hiding."""
+        if not detailed.runtime:
+            return None
+        lost = _lost_worker_ids(detailed)
+        excluded = set(info.lost_workers)
+        total = len(detailed.resource.workers)
+
+        if not self._elastic_enabled(pod):
+            if lost and len(lost) < total \
+                    and self._host_loss_requeue(key, pod, info, lost):
+                return self.REQUEUED
+            return None
+
+        min_hosts = self._elastic_min_hosts(pod)
+        survivors = sorted(w.worker_id for w in detailed.resource.workers
+                           if w.worker_id not in lost)
+
+        resized = False
+        if lost - excluded:
+            if self._is_multislice(pod):
+                # shrinking ONE slice of a multislice gang would renumber
+                # only this slice's process space while the sibling slices
+                # keep the old JAX_NUM_PROCESSES — the cross-slice
+                # rendezvous deadlocks. Until multislice-wide coordination
+                # exists, host loss on a multislice pod requeues.
+                log.warning("pod %s: host loss on a multislice gang — "
+                            "resize is single-slice only, requeueing", key)
+                if self._host_loss_requeue(key, pod, info, lost, force=True):
+                    return self.REQUEUED
+                return None
+            if not survivors or len(survivors) < min_hosts:
+                # nothing (or too little) left to resize onto: the loss
+                # degenerates to the requeue/gang-fail ladder
+                log.warning("pod %s: %d/%d workers lost — below elastic "
+                            "min_hosts=%d, falling back to requeue",
+                            key, len(lost), total, min_hosts)
+                if self._host_loss_requeue(key, pod, info, lost,
+                                           force=True):
+                    return self.REQUEUED
+                return None
+            self._resize_gang(key, pod, info, detailed, survivors,
+                              kind="shrink", lost=sorted(lost), now=now)
+            resized = True
+        elif excluded and not lost and self._grow_ready(info, detailed, now):
+            # every excluded worker is healthy again: capacity returned —
+            # grow back at the checkpoint boundary (or after the grace)
+            self._resize_gang(key, pod, info, detailed,
+                              [w.worker_id
+                               for w in detailed.resource.workers],
+                              kind="grow", lost=[], now=now)
+            resized = True
+        # else: steady shrunk state, or a PARTIAL return (some excluded
+        # workers healed, others still dead) — keep waiting; growing in two
+        # steps would thrash the gang with restarts
+
+        if resized:
+            # judge THIS pass on the post-relaunch world (the gang-launch
+            # refetch pattern): a stale runtime would show the pre-resize
+            # container states
+            detailed = self.tpu.get_detailed_status(info.qr_name,
+                                                    zone=info.zone)
+        with self.lock:
+            excluded_now = set(info.lost_workers)
+        if not excluded_now:
+            if not resized and ko.annotations(pod).get(A.LOST_WORKERS):
+                # a grow whose annotation clear failed: retry, else a
+                # kubelet restart would re-exclude healthy workers (when
+                # resized, _resize_gang just patched this pass)
+                self._annotate_resize(key, pod, info, total, total)
+            return dataclasses.replace(detailed) if resized else None
+        if not resized:
+            # steady shrunk state: re-issue the durable-state patch when a
+            # prior attempt failed (the "next sweep retries" promise) — a
+            # kubelet restart reading a stale empty tpu.dev/lost-workers
+            # would otherwise re-shrink an already-shrunk gang
+            want = ",".join(str(w) for w in sorted(excluded_now))
+            if ko.annotations(pod).get(A.LOST_WORKERS, "") != want:
+                self._annotate_resize(key, pod, info,
+                                      total - len(excluded_now), total)
+        filtered = [w for w in detailed.runtime
+                    if w.worker_id not in excluded_now]
+        return dataclasses.replace(detailed, runtime=filtered)
+
+    def _grow_ready(self, info, detailed, now: float) -> bool:
+        """Grow at a checkpoint boundary: a `checkpoint saved at step N` log
+        line on the scrape worker NEWER than the shrink means the restore
+        after the grow re-loses nothing. Workloads that never checkpoint
+        (or whose logs are unreadable) grow after elastic_grow_grace_s —
+        staying shrunk forever is strictly worse."""
+        if info.resized_at is None:
+            return True
+        grace = getattr(self.cfg, "elastic_grow_grace_s", 120.0)
+        if self.gang is not None:
+            m = self.gang.last_in_logs(detailed.resource, _CHECKPOINT_SAVED_RE,
+                                       worker_id=self.scrape_worker_id(info))
+            if m is not None and int(m.group(1)) >= (info.resize_step or 0):
+                return True
+        return now - info.resized_at >= grace
+
+    def scrape_worker_id(self, info) -> int:
+        """The worker whose logs carry worker-0 output: the lowest SURVIVING
+        id — after an elastic shrink that excluded worker 0, the renumbered
+        process 0 (coordinator, telemetry aggregator) lives on the next
+        surviving VM."""
+        excluded = set(info.lost_workers)
+        wid = 0
+        while wid in excluded:
+            wid += 1
+        return wid
+
+    # -- the two transitions ---------------------------------------------------
+
+    def _resize_gang(self, key: str, pod: dict, info, detailed,
+                     worker_ids: list[int], *, kind: str, lost: list[int],
+                     now: float):
+        """Relaunch the workload on ``worker_ids`` (all workers for a grow),
+        riding the TPU_RESTART_ATTEMPT/TPU_CHECKPOINT_DIR injection path
+        plus the elastic vars, and record the event/span/annotations. A
+        failed relaunch leaves the exclusion state UNCHANGED so the next
+        sweep retries."""
+        qr = detailed.resource
+        resolver = AnnotationResolver(self.kube, pod)
+        num_slices = max(1, resolver.get_int(A.NUM_SLICES, 1))
+        slice_id = resolver.get_int(A.SLICE_ID, 0)
+        mega = resolver.get(A.MEGASCALE_COORDINATOR) or None
+        subset = worker_ids if kind == "shrink" else None
+        worker_env = compute_worker_env(
+            qr, num_slices=num_slices, slice_id=slice_id,
+            megascale_coordinator=mega,
+            telemetry_port=self.cfg.telemetry_port,
+            straggler_factor=self.cfg.straggler_factor,
+            stall_timeout_s=self.cfg.stall_timeout_s,
+            worker_ids=subset)
+        try:
+            params = prepare_tpu_parameters(self.kube, pod, self.cfg)
+        except TranslationError as e:
+            log.error("resize of %s: translation failed: %s", key, e)
+            return
+        next_count = info.resize_count + 1
+        env = params.workload.env
+        # the SAME attempt number: a resize is not a requeue, and the
+        # workload-side ledger uses the (attempt, resize) pair to charge
+        # the downtime to `resize` instead of `restart_lost`
+        env["TPU_RESTART_ATTEMPT"] = str(info.preemption_count)
+        env["TPU_ELASTIC_RESIZE"] = str(next_count)
+        env["TPU_GANG_FULL_HOSTS"] = str(len(qr.workers))
+        batch_mode = resolver.get(A.ELASTIC_BATCH_MODE)
+        if batch_mode:
+            env["TPU_ELASTIC_BATCH_MODE"] = batch_mode
+        ckpt_dir = (resolver.get(A.CHECKPOINT_DIR)
+                    or env.get("TPU_CHECKPOINT_DIR", ""))
+        if ckpt_dir:
+            env["TPU_CHECKPOINT_DIR"] = ckpt_dir
+        started = self.clock()
+        try:
+            self.tpu.start_workload(info.qr_name, params.workload,
+                                    worker_env=worker_env, zone=info.zone,
+                                    worker_ids=subset)
+        except TpuApiError as e:
+            log.warning("elastic %s of %s on %s failed (retrying next "
+                        "sweep): %s", kind, key, info.qr_name, e)
+            self.metrics.incr("tpu_kubelet_gang_resize_failures")
+            self.emit_event(pod, "GangResizeFailed",
+                            f"elastic {kind} relaunch on {info.qr_name} "
+                            f"failed (will retry): {e}",
+                            event_type="Warning")
+            return
+        width = len(worker_ids)
+        total = len(qr.workers)
+        with self.lock:
+            info.resize_count = next_count
+            info.lost_workers = tuple(lost)
+            info.resized_at = self.clock()
+            info.resize_step = info.train_last_step
+            info.ready = False          # the resized gang re-enters ready
+            info.fingerprint = ()
+            # fresh telemetry stream at the new width: the stall clock must
+            # not flag the resized gang off the old attempt's silence
+            info.train_step_at = None
+            info.train_stalled = False
+        self.tracer.record("pod.gang_resize", started, info.resized_at,
+                           trace_id=info.trace_id, parent_id=info.trace_root,
+                           attrs={"pod": key, "slice": info.qr_name,
+                                  "kind": kind, "width": width,
+                                  "full_width": total,
+                                  "lost_workers": lost,
+                                  "resize": next_count})
+        self.metrics.incr("tpu_kubelet_gang_resizes", labels={"kind": kind})
+        if kind == "shrink":
+            msg = (f"host loss on {info.qr_name}: workers {lost} lost — gang "
+                   f"resized to {width}/{total} surviving hosts (resize "
+                   f"#{next_count}); requeue budget untouched")
+        else:
+            msg = (f"capacity returned on {info.qr_name}: gang grown back to "
+                   f"{width}/{total} hosts from the latest checkpoint "
+                   f"(resize #{next_count})")
+        log.warning("pod %s: %s", key, msg)
+        self.emit_event(pod, "GangResized", msg,
+                        event_type="Warning" if kind == "shrink" else "Normal")
+        if kind == "shrink":
+            # keep the replacement ask visible: on Cloud TPU the queued
+            # resource's missing worker is re-delivered by the service; the
+            # fake cloud models it as the host_loss window closing
+            self.emit_event(pod, "ReplacementRequested",
+                            f"waiting for {total - width} replacement "
+                            f"host(s) on {info.qr_name}; will grow back at "
+                            "the next checkpoint boundary")
+        self._annotate_resize(key, pod, info, width, total)
+
+    def _annotate_resize(self, key: str, pod: dict, info, width: int,
+                         total: int):
+        """Durable mirrors of the resize state (restored by recovery.py so a
+        kubelet restart mid-shrink neither forgets the exclusion nor
+        re-shrinks an already-shrunk gang)."""
+        anns = {A.RESIZE_COUNT: str(info.resize_count),
+                A.GANG_WIDTH: f"{width}/{total}",
+                A.LOST_WORKERS: ",".join(str(w) for w in info.lost_workers)
+                or None,
+                A.RESIZE_STEP: str(info.resize_step)
+                if info.resize_step is not None and info.lost_workers
+                else None}
+        try:
+            ns, name = key.split("/", 1)
+            updated = self.kube.patch_pod(ns, name,
+                                          {"metadata": {"annotations": anns}})
+            with self.lock:
+                if key in self.pods:
+                    self.pods[key] = updated
+        except KubeApiError as e:
+            log.debug("resize annotate of %s failed (next sweep retries): %s",
+                      key, e)
+
+    def _host_loss_requeue(self, key: str, pod: dict, info, lost: set[int],
+                           force: bool = False) -> bool:
+        """Partial-gang loss routed to a full requeue: pods that opted into
+        checkpointing (tpu.dev/checkpoint-dir) with requeue budget left get
+        the restart-from-checkpoint-of-the-same-size-gang treatment — the
+        PR 3 baseline the elastic path is measured against — instead of a
+        hard GangBroken failure. Pods with neither keep the original
+        gang-fail contract (translate_status Fails them this same pass).
+        ``force``: an elastic pod below its min-hosts floor requeues even
+        without a checkpoint annotation (it opted into staying alive).
+        Returns True when the pod was requeued."""
+        anns = ko.annotations(pod)
+        if not force and not anns.get(A.CHECKPOINT_DIR):
+            return False
+        if info.preemption_count >= self.cfg.preemption_requeue_limit:
+            return False
+        log.warning("pod %s: workers %s lost on %s — requeueing the whole "
+                    "slice (restart-from-checkpoint at full width)",
+                    key, sorted(lost), info.qr_name)
+        self.metrics.incr("tpu_kubelet_host_loss_requeues")
+        self._requeue_preempted(key, pod, info)
+        return True

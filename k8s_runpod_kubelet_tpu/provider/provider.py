@@ -30,6 +30,7 @@ from ..kube import objects as ko
 from ..metrics import Metrics
 from ..tracing import Tracer
 from .annotations import Annotations as A
+from .elastic import ElasticGangMixin
 from .node_spec import build_node
 from .reconcile import ReconcileMixin
 from .recovery import RecoveryMixin
@@ -75,6 +76,17 @@ class InstanceInfo:
     # RecoveredFromPreemption event/span has been emitted (reset on requeue so
     # every recovery announces itself exactly once)
     recovery_event_emitted: bool = False
+    # elastic gang resizing (ISSUE 6): cumulative shrink/grow count (NEVER
+    # counted against preemption_requeue_limit), the worker ids currently
+    # excluded from the gang (non-empty = running shrunk), when the last
+    # resize happened, and the scraped step at that moment (the grow path
+    # prefers a checkpoint NEWER than this). resize_count/lost_workers are
+    # mirrored to tpu.dev/resize-count / tpu.dev/lost-workers and restored
+    # by recovery.py across kubelet restarts.
+    resize_count: int = 0
+    lost_workers: tuple = ()
+    resized_at: Optional[float] = None
+    resize_step: Optional[int] = None
     # training telemetry (ISSUE 5): the reconcile loop's scrape of worker-0's
     # TPU_TELEMETRY line. train_step_at is when the step counter last
     # ADVANCED (the stall clock); train_annotated is the last annotation
@@ -111,7 +123,8 @@ class DeletedPodInfo:
     unreachable_since: Optional[float] = None
 
 
-class Provider(ReconcileMixin, RecoveryMixin, TrainingWatchMixin):
+class Provider(ReconcileMixin, RecoveryMixin, TrainingWatchMixin,
+               ElasticGangMixin):
     def __init__(self, cfg: Config, kube: KubeClient, tpu: TpuClient,
                  gang_executor: Optional[GangExecutor] = None,
                  metrics: Optional[Metrics] = None,
@@ -183,6 +196,7 @@ class Provider(ReconcileMixin, RecoveryMixin, TrainingWatchMixin):
                               "requeued pods that came back Ready "
                               "(RecoveredFromPreemption)")
         self._describe_training_metrics()
+        self._describe_elastic_metrics()
         self._probe_cloud(force=True)
 
     # -- helpers ---------------------------------------------------------------

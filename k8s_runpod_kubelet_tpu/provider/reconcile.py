@@ -163,6 +163,18 @@ class ReconcileMixin:
             self._release_slice(key, info)
             return
 
+        # elastic gang resizing (ISSUE 6): partial-gang loss on an ACTIVE
+        # slice is NOT whole-slice preemption — an elastic pod shrinks to
+        # the survivors (and grows back when capacity returns) instead of
+        # requeueing; a checkpointing non-elastic pod requeues instead of
+        # hard-failing; everything else keeps the GangBroken contract.
+        if state is S.ACTIVE and info.workload_launched and detailed.runtime:
+            handled = self._elastic_reconcile(key, pod, info, detailed, now)
+            if handled == self.REQUEUED:
+                return
+            if handled is not None:
+                detailed = handled
+
         # training telemetry (ISSUE 5): scrape worker-0's TPU_TELEMETRY line
         # for running training workloads — annotations, per-pod gauges, and
         # the stall watchdog (TrainingStalled). Best-effort: a scrape
@@ -323,7 +335,15 @@ class ReconcileMixin:
             self.kube.patch_pod(pod["metadata"].get("namespace", "default"),
                                 pod["metadata"]["name"], {"metadata": {"annotations": {
                                     A.QUEUED_RESOURCE: None,
-                                    A.PREEMPTION_COUNT: str(info.preemption_count)}}})
+                                    A.PREEMPTION_COUNT: str(info.preemption_count),
+                                    # the replacement slice starts at full
+                                    # width: any elastic exclusion dies with
+                                    # the old slice (resize-count history
+                                    # stays — it never counts against the
+                                    # requeue budget)
+                                    A.LOST_WORKERS: None,
+                                    A.GANG_WIDTH: None,
+                                    A.RESIZE_STEP: None}}})
         except KubeApiError as e:
             log.warning("preemption-count annotate of %s failed: %s", key, e)
         # the dead attempt's per-pod gauges go with it — BEFORE the reset
@@ -338,12 +358,20 @@ class ReconcileMixin:
             if cached is not None:
                 anns = cached.setdefault("metadata", {}).setdefault("annotations", {})
                 anns.pop(A.QUEUED_RESOURCE, None)
+                anns.pop(A.LOST_WORKERS, None)
+                anns.pop(A.GANG_WIDTH, None)
+                anns.pop(A.RESIZE_STEP, None)
                 anns[A.PREEMPTION_COUNT] = str(info.preemption_count)
             info.qr_name = ""
             info.workload_launched = False
             info.ready = False
             info.fingerprint = ()
             info.active_at = None
+            # elastic state dies with the slice: the replacement gang is
+            # launched at full width
+            info.lost_workers = ()
+            info.resized_at = None
+            info.resize_step = None
             info.deployed_at = None  # next attempt's provisioning span must
             # start at ITS deploy, not this dead slice's
             info.pending_since = self.clock()

@@ -35,6 +35,29 @@ def _recovery_announced(pod: dict) -> bool:
     return bool(count) and anns.get(A.RECOVERED_ATTEMPT, "") == count
 
 
+def _elastic_state(pod: dict) -> tuple[int, tuple, "int | None"]:
+    """(resize_count, lost_workers, resize_step) from the durable
+    annotations — a kubelet restart mid-shrink must neither forget the
+    exclusion (the dead worker would GangBroken-fail the pod) nor re-shrink
+    an already-shrunk gang (double-bumping resize-count and restarting the
+    survivors for nothing); resize_step keeps the grow path's
+    checkpoint-boundary check honest (a PRE-shrink checkpoint log line must
+    not pass for a fresh boundary after the restart)."""
+    anns = ko.annotations(pod)
+    try:
+        count = int(anns.get(A.RESIZE_COUNT, "0") or 0)
+    except ValueError:
+        count = 0
+    lost = []
+    for tok in (anns.get(A.LOST_WORKERS, "") or "").split(","):
+        tok = tok.strip()
+        if tok.isdigit():
+            lost.append(int(tok))
+    step_s = anns.get(A.RESIZE_STEP, "")
+    step = int(step_s) if step_s.isdigit() else None
+    return count, tuple(sorted(lost)), step
+
+
 class RecoveryMixin:
     def load_running(self):
         """Startup state recovery (parity: LoadRunning kubelet.go:1380-1535)."""
@@ -138,6 +161,7 @@ class RecoveryMixin:
         if not qr_name:
             return
         key = ko.namespaced_name(pod)
+        resize_count, lost_workers, resize_step = _elastic_state(pod)
         with self.lock:
             self.pods[key] = ko.deep_copy(pod)
             self.instances[key] = InstanceInfo(
@@ -149,6 +173,12 @@ class RecoveryMixin:
                 preemption_count=int(
                     ko.annotations(pod).get(A.PREEMPTION_COUNT, "0") or 0),
                 recovery_event_emitted=_recovery_announced(pod),
+                resize_count=resize_count,
+                lost_workers=lost_workers,
+                resize_step=resize_step,
+                # the shrink time didn't survive the restart: restart the
+                # grow grace from now rather than growing immediately
+                resized_at=self.clock() if lost_workers else None,
             )
 
     def _recover_instance(self, pod: dict, qr: QueuedResource):
@@ -157,6 +187,7 @@ class RecoveryMixin:
         key = ko.namespaced_name(pod)
         acc = qr.accelerator
         detailed = self.tpu.get_detailed_status(qr.name, zone=qr.zone or self.cfg.zone)
+        resize_count, lost_workers, resize_step = _elastic_state(pod)
         info = InstanceInfo(
             qr_name=qr.name,
             zone=qr.zone or self.cfg.zone,
@@ -173,6 +204,12 @@ class RecoveryMixin:
             preemption_count=int(
                 ko.annotations(pod).get(A.PREEMPTION_COUNT, "0") or 0),
             recovery_event_emitted=_recovery_announced(pod),
+            # elastic state survives too: a restart mid-shrink must not
+            # re-shrink (or GangBroken-fail) an already-resized gang
+            resize_count=resize_count,
+            lost_workers=lost_workers,
+            resize_step=resize_step,
+            resized_at=self.clock() if lost_workers else None,
         )
         with self.lock:
             self.pods[key] = ko.deep_copy(pod)

@@ -62,7 +62,11 @@ class TrainingWatchMixin:
         if info.train_first_probe_at is None:
             info.train_first_probe_at = now
         payload = None
-        m = self.gang.last_in_logs(detailed.resource, TELEMETRY_PATTERN)
+        # elastic shrink can exclude worker 0: the renumbered process 0
+        # (coordinator + telemetry aggregator) lives on the lowest SURVIVING
+        # worker — scrape that VM's logs
+        m = self.gang.last_in_logs(detailed.resource, TELEMETRY_PATTERN,
+                                   worker_id=self.scrape_worker_id(info))
         if m is not None:
             try:
                 payload = json.loads(m.group(1))

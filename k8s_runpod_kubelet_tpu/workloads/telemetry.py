@@ -135,7 +135,8 @@ class GoodputLedger:
     """
 
     BUCKETS = ("productive", "compile", "checkpoint_save",
-               "checkpoint_restore", "restart_lost", "stalled", "idle")
+               "checkpoint_restore", "restart_lost", "resize", "stalled",
+               "idle")
 
     def __init__(self, clock: Callable[[], float] = time.monotonic,
                  start_bucket: str = "idle"):
@@ -442,14 +443,19 @@ def state_path_for(checkpoint_dir: str) -> str:
     return os.path.join(checkpoint_dir, STATE_FILE) if checkpoint_dir else ""
 
 
-def write_state(path: str, *, step: int, unsaved_work_s: float, ts: float):
+def write_state(path: str, *, step: int, unsaved_work_s: float, ts: float,
+                attempt: int = 0, resize: int = 0):
     """Atomically persist the running attempt's exposure: how much work
     would be lost if it died right now (productive seconds since the last
-    durable checkpoint) plus a wall timestamp for downtime accounting."""
+    durable checkpoint) plus a wall timestamp for downtime accounting.
+    ``attempt``/``resize`` record WHICH launch wrote the state (the requeue
+    count and the elastic-resize count), so the next launch can attribute
+    the loss to the right cause: ``restart_lost`` for a full requeue,
+    ``resize`` for an elastic shrink/grow relaunch."""
     if not path:
         return
     payload = {"step": step, "unsaved_work_s": round(unsaved_work_s, 6),
-               "ts": ts}
+               "ts": ts, "attempt": attempt, "resize": resize}
     tmp = f"{path}.tmp.{os.getpid()}"  # never share a staging file
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     with open(tmp, "w", encoding="utf-8") as f:
@@ -457,19 +463,30 @@ def write_state(path: str, *, step: int, unsaved_work_s: float, ts: float):
     os.replace(tmp, path)
 
 
+def read_state(path: str) -> Optional[dict]:
+    """The raw persisted exposure record, or None when unreadable."""
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        with open(path, encoding="utf-8") as f:
+            prev = json.load(f)
+        return prev if isinstance(prev, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
 def read_lost_state(path: str, now: float) -> tuple[float, int]:
     """(lost seconds, prior step) a restarting attempt should charge to
     ``restart_lost``: the prior attempt's unsaved work plus the downtime
     between its last state write and now. (0.0, -1) when unknowable."""
-    if not path or not os.path.exists(path):
+    prev = read_state(path)
+    if prev is None:
         return 0.0, -1
     try:
-        with open(path, encoding="utf-8") as f:
-            prev = json.load(f)
         unsaved = max(0.0, float(prev.get("unsaved_work_s", 0.0)))
         downtime = max(0.0, now - float(prev.get("ts", now)))
         return unsaved + downtime, int(prev.get("step", -1))
-    except (OSError, ValueError, TypeError):
+    except (ValueError, TypeError):
         return 0.0, -1
 
 
@@ -550,7 +567,8 @@ class TrainingTelemetry:
                  mono: Callable[[], float] = time.monotonic,
                  straggler_factor: float = 3.0,
                  stall_timeout_s: float = 120.0,
-                 attempt: int = 0, state_path: str = "",
+                 attempt: int = 0, resize_attempt: int = 0,
+                 dp_width: int = 0, state_path: str = "",
                  telemetry_every: int = 1, state_interval_s: float = 10.0,
                  emit_line: Optional[Callable[[str], None]] = None):
         self.metrics = metrics
@@ -559,6 +577,12 @@ class TrainingTelemetry:
         self.host_id = host_id
         self.num_hosts = max(1, num_hosts)
         self.attempt = attempt
+        # elastic gang training (ISSUE 6): resize_attempt is the kubelet's
+        # cumulative shrink/grow count (TPU_ELASTIC_RESIZE); dp_width is the
+        # current data-parallel width, surfaced on the TPU_TELEMETRY line so
+        # the kubelet and goodput_summary can render the resize timeline
+        self.resize_attempt = resize_attempt
+        self.dp_width = dp_width
         # ONLY worker-0 owns the restart-attribution state: the checkpoint
         # dir is shared across hosts (orbax requires it), and N hosts
         # rewriting one goodput_state.json every step would race — worker-0's
@@ -594,15 +618,41 @@ class TrainingTelemetry:
         self._staged_ckpt: Optional[tuple[int, float]] = None
         self._exported_lost: dict[str, float] = {}
         self.restart_lost_s = 0.0
+        self.resize_lost_s = 0.0
         self.resumed_from_step = -1
-        if attempt > 0 and state_path:
-            lost, prev_step = read_lost_state(state_path, clock())
+        if (attempt > 0 or resize_attempt > 0) and state_path:
+            # ONE read: the lost amount and the (attempt, resize) pair used
+            # to attribute it must come from the same state version — a
+            # second read could race a writer and mix versions
+            prev = read_state(state_path) or {}
+            now = clock()
+            try:
+                unsaved = max(0.0, float(prev.get("unsaved_work_s", 0.0)))
+                lost = unsaved + max(0.0, now - float(prev.get("ts", now)))
+                prev_step = int(prev.get("step", -1))
+            except (ValueError, TypeError):
+                lost, prev_step = 0.0, -1
             if lost > 0:
-                self.ledger.charge("restart_lost", lost)
-                self.restart_lost_s = lost
+                # Attribute the prior launch's unsaved work + downtime to the
+                # cause of THIS relaunch. A bumped requeue attempt means a
+                # full restart (restart_lost); an unchanged attempt with a
+                # bumped resize count means the kubelet shrank/grew the gang
+                # (the new exclusive `resize` bucket) — so elastic downtime
+                # never double-charges restart_lost (the A/B the soak runs).
+                prev_attempt = int(prev.get("attempt", 0) or 0)
+                prev_resize = int(prev.get("resize", 0) or 0)
+                if attempt <= prev_attempt and resize_attempt > prev_resize:
+                    self.ledger.charge("resize", lost)
+                    self.resize_lost_s = lost
+                else:
+                    self.ledger.charge("restart_lost", lost)
+                    self.restart_lost_s = lost
                 self.resumed_from_step = prev_step
         if metrics is not None:
             self._describe(metrics)
+            if dp_width:
+                metrics.set_gauge("tpu_training_resize_dp_width",
+                                  float(dp_width) if resize_attempt else 0.0)
 
     @staticmethod
     def _describe(m):
@@ -624,6 +674,16 @@ class TrainingTelemetry:
                    "blocking checkpoint save/restore time (kind label)")
         m.describe("tpu_training_straggler_events",
                    "hosts newly flagged stalled/slow by the watchdog")
+        m.describe("tpu_training_resize_events",
+                   "elastic gang resizes seen by this process (kind label: "
+                   "shrink/grow)")
+        m.describe("tpu_training_resize_seconds",
+                   "wall time spent rebuilding the mesh + resharding state "
+                   "for an elastic resize",
+                   buckets=(0.1, 0.5, 1, 2.5, 5, 10, 30, 60, 120, 300))
+        m.describe("tpu_training_resize_dp_width",
+                   "current data-parallel width after the last elastic "
+                   "resize (0 = never resized)")
 
     # -- hooks (called by Trainer / train_main) --------------------------------
 
@@ -675,7 +735,9 @@ class TrainingTelemetry:
                                - self._productive_at_ckpt)
                 try:
                     write_state(self.state_path, step=step,
-                                unsaved_work_s=max(0.0, unsaved), ts=now)
+                                unsaved_work_s=max(0.0, unsaved), ts=now,
+                                attempt=self.attempt,
+                                resize=self.resize_attempt)
                     self._state_written_at = mono_now
                 except OSError:
                     pass  # read-only checkpoint volume must not kill training
@@ -707,10 +769,23 @@ class TrainingTelemetry:
         if self.state_path:
             try:
                 write_state(self.state_path, step=step,
-                            unsaved_work_s=max(0.0, unsaved), ts=self.clock())
+                            unsaved_work_s=max(0.0, unsaved), ts=self.clock(),
+                            attempt=self.attempt, resize=self.resize_attempt)
                 self._state_written_at = self.ledger._clock()
             except OSError:
                 log.debug("state write at durable boundary failed")
+
+    def resize(self, kind: str, *, old_width: int, new_width: int,
+               step: Optional[int] = None):
+        """Context manager around an IN-PROCESS elastic resize (mesh rebuild
+        + reshard-restore): charges the exclusive ``resize`` ledger bucket,
+        records a ``training.resize`` span (kind=shrink/grow, old/new DP
+        width) and the ``tpu_training_resize_*`` metrics, and updates the
+        advertised ``dp_width``. A kubelet-driven resize RELAUNCH instead
+        charges the bucket at boot via ``resize_attempt`` (see __init__)."""
+        if kind not in ("shrink", "grow"):
+            raise ValueError(f"resize kind must be shrink/grow, not {kind!r}")
+        return _ResizeSpan(self, kind, old_width, new_width, step)
 
     def ingest_heartbeat(self, body: str):
         """POST /heartbeat sink (worker-0): one or more protocol lines."""
@@ -766,6 +841,10 @@ class TrainingTelemetry:
                      "step": snap["step"],
                      "tokens_per_sec": snap["tokens_per_sec"],
                      "buckets": snap["buckets"]}
+            if self.dp_width:
+                attrs["dp_width"] = self.dp_width
+            if self.resize_attempt:
+                attrs["resize"] = self.resize_attempt
             if self.watchdog is not None:
                 attrs["hosts"] = self.watchdog.snapshot()
             if extra:
@@ -796,11 +875,16 @@ class TrainingTelemetry:
     def telemetry_payload(self) -> dict:
         """The compact TPU_TELEMETRY line body (kubelet scrape surface)."""
         s = self.stats
-        return {"step": s.last_step, "tokens_per_sec": round(s.tokens_per_sec, 3),
-                "mfu": round(s.mfu, 6), "goodput": round(self.ledger.goodput, 6),
-                "attempt": self.attempt, "host": self.host_id,
-                "stalled": bool(self.watchdog.flagged)
-                if self.watchdog is not None else False}
+        out = {"step": s.last_step, "tokens_per_sec": round(s.tokens_per_sec, 3),
+               "mfu": round(s.mfu, 6), "goodput": round(self.ledger.goodput, 6),
+               "attempt": self.attempt, "host": self.host_id,
+               "stalled": bool(self.watchdog.flagged)
+               if self.watchdog is not None else False}
+        if self.dp_width:
+            out["dp_width"] = self.dp_width
+        if self.resize_attempt:
+            out["resize"] = self.resize_attempt
+        return out
 
     def snapshot(self) -> dict:
         """The /debug/train statusz payload."""
@@ -815,11 +899,62 @@ class TrainingTelemetry:
                "attempt": self.attempt, "host": self.host_id,
                "num_hosts": self.num_hosts,
                "restart_lost_s": round(self.restart_lost_s, 6),
+               "resize_lost_s": round(self.resize_lost_s, 6),
+               "resize_attempt": self.resize_attempt,
+               "dp_width": self.dp_width,
                "straggler_events": self.straggler_events}
         if self.watchdog is not None:
             out["hosts"] = self.watchdog.snapshot()
             out["stalled_hosts"] = sorted(self.watchdog.flagged)
         return out
+
+
+class _ResizeSpan:
+    def __init__(self, tel: TrainingTelemetry, kind: str, old_width: int,
+                 new_width: int, step: Optional[int]):
+        self._tel = tel
+        self._kind = kind
+        self._old = old_width
+        self._new = new_width
+        self._step = step
+        self.duration_s = 0.0
+
+    def __enter__(self) -> "_ResizeSpan":
+        self._spend = self._tel.ledger.spend("resize")
+        self._spend.__enter__()
+        self._start_wall = self._tel.clock()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self._spend.__exit__(exc_type, exc, tb)
+        self.duration_s = self._spend.duration_s
+        tel = self._tel
+        step = self._step if self._step is not None else tel.stats.last_step
+        if exc_type is None:
+            tel.resize_attempt += 1
+            tel.dp_width = self._new
+            # the new width changes tokens-per-chip math only through the
+            # caller's batch rescale; stats keep their tokens_per_step, which
+            # the caller updates when the global batch changed
+        attrs = {"kind": self._kind, "old_width": self._old,
+                 "new_width": self._new, "step": step,
+                 "resize": tel.resize_attempt}
+        if exc_type is not None:
+            attrs["error"] = exc_type.__name__
+        if tel.tracer is not None:
+            tel.tracer.record("training.resize", self._start_wall,
+                              self._start_wall + self.duration_s,
+                              trace_id=tel.trace_id, attrs=attrs)
+        if tel.metrics is not None:
+            tel.metrics.incr("tpu_training_resize_events",
+                             labels={"kind": self._kind})
+            tel.metrics.observe("tpu_training_resize_seconds", self.duration_s)
+            if exc_type is None:
+                # a FAILED resize never reached the new width — the gauge
+                # must keep advertising the width the gang actually runs at
+                tel.metrics.set_gauge("tpu_training_resize_dp_width",
+                                      float(self._new))
+        return False
 
 
 class _CheckpointSpan:
@@ -856,7 +991,9 @@ class _CheckpointSpan:
                 if tel.state_path:
                     try:
                         write_state(tel.state_path, step=step,
-                                    unsaved_work_s=0.0, ts=tel.clock())
+                                    unsaved_work_s=0.0, ts=tel.clock(),
+                                    attempt=tel.attempt,
+                                    resize=tel.resize_attempt)
                         tel._state_written_at = tel.ledger._clock()
                     except OSError:
                         log.debug("state write after save failed (stale "

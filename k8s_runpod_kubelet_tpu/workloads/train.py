@@ -55,6 +55,15 @@ class TrainConfig:
     # waits at its boundary). Direct save() calls always block unless
     # told otherwise. False = loop saves block too.
     async_checkpoint: bool = True
+    # elastic resize (ISSUE 6): what happens to the batch when the
+    # data-parallel width changes on host loss.
+    #   "global"   hold the GLOBAL batch — grad accumulation absorbs the
+    #              width change, so the loss trajectory and per-device
+    #              activation memory are unchanged (steps get slower);
+    #   "per_host" hold the PER-HOST batch — the global batch scales with
+    #              the gang (step time holds; the optimizer sees a
+    #              different batch size).
+    elastic_batch_mode: str = "global"
 
 
 def cross_entropy_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
@@ -249,6 +258,8 @@ class Trainer:
         # fire-and-forget loop, unchanged.
         self.telemetry = telemetry
         self._compiled = False  # True once any step has run (bench re-runs)
+        self._seed = seed
+        self._lora = lora
         self.model = LlamaModel(cfg, mesh)
         if initial_params is not None:
             # host (e.g. HF-converted) tree: commit straight to the target
@@ -370,6 +381,80 @@ class Trainer:
         log.info("resumed from checkpoint step %d", self.step)
         return True
 
+    # -- elastic resize (ISSUE 6) ----------------------------------------------
+
+    def resize(self, mesh: Mesh) -> bool:
+        """Continue training on a DIFFERENT mesh: rebuild the model/step over
+        the surviving (or restored) devices, rescale the batch per
+        ``tc.elastic_batch_mode``, and reshard params + optimizer state from
+        the latest durable orbax checkpoint under the new NamedShardings —
+        the same StandardRestore-with-shardings seam preemption recovery
+        uses, so a shrink is "restore onto fewer devices", not a bespoke
+        gather/scatter. Returns True when a checkpoint was restored; False
+        means none exists and training restarts from a fresh init at the
+        new width (step 0 — nothing durable to continue from)."""
+        import dataclasses as _dc
+
+        from ..parallel.mesh import dp_width
+        old_dp = dp_width(self.mesh) if self.mesh is not None else 1
+        new_dp = dp_width(mesh)
+        tc = self.tc
+        if tc.elastic_batch_mode == "per_host":
+            # hold the per-DP-shard batch: global batch scales with the gang
+            batch = max(1, (tc.batch_size * new_dp) // max(1, old_dp))
+            accum = tc.grad_accum_steps
+        else:  # "global": hold the global batch, let grad accum absorb it
+            batch = tc.batch_size
+            accum = max(1, round(tc.grad_accum_steps * old_dp / new_dp))
+        multiple = new_dp * accum
+        rounded = ((batch + multiple - 1) // multiple) * multiple
+        if rounded != batch:
+            log.info("resize: batch %d -> %d (must divide dp %d x accum %d)",
+                     batch, rounded, new_dp, accum)
+        # a pending async save must land BEFORE the old mesh's arrays are
+        # dropped — orbax is still staging from them
+        self.wait_pending()
+        # drop the dead width's executables and traces: every program must
+        # re-trace for the new mesh anyway (a stale jit cache entry keyed on
+        # the old shardings would silently recommit arrays to dead devices),
+        # and freeing them bounds live-executable accumulation across
+        # repeated resizes (tests/conftest.py pins an XLA:CPU bug there)
+        jax.clear_caches()
+        self.mesh = mesh
+        self.tc = _dc.replace(tc, batch_size=rounded, grad_accum_steps=accum)
+        self.model = LlamaModel(self.cfg, mesh)
+        self.params = init_params(self.cfg, jax.random.PRNGKey(self._seed),
+                                  mesh)
+        mask = None
+        if self._lora is not None:
+            from ..models.lora import apply_lora, lora_mask
+            self.params = apply_lora(self.cfg, self.params, self._lora,
+                                     jax.random.PRNGKey(self._seed + 1), mesh)
+            mask = lora_mask(self.params)
+        self.opt_state = self.optimizer.init(self.params)
+        self.step_fn = make_train_step(self.model, self.optimizer,
+                                       trainable_mask=mask,
+                                       grad_accum_steps=accum,
+                                       z_loss_coef=self.tc.z_loss_coef,
+                                       fused_ce_chunks=self.tc.fused_ce_chunks)
+        self._eval_fn = None
+        self._compiled = False  # the new width compiles fresh programs
+        restored = self.restore()
+        if not restored:
+            self.step = 0
+            log.warning("resize to dp=%d found no checkpoint in %r — "
+                        "training restarts at step 0", new_dp,
+                        self.tc.checkpoint_dir)
+        else:
+            log.info("resized dp %d -> %d: resumed from checkpoint step %d "
+                     "(batch %d, grad_accum %d)", old_dp, new_dp, self.step,
+                     self.tc.batch_size, self.tc.grad_accum_steps)
+        if self.telemetry is not None:
+            # throughput math follows the (possibly rescaled) global batch
+            self.telemetry.stats.tokens_per_step = (self.tc.batch_size
+                                                    * self.tc.seq_len)
+        return restored
+
     # -- eval ------------------------------------------------------------------
 
     def evaluate(self, batches: Optional[Iterator] = None,
@@ -416,7 +501,14 @@ class Trainer:
     # -- loop ------------------------------------------------------------------
 
     def run(self, steps: Optional[int] = None,
-            batches: Optional[Iterator] = None) -> dict:
+            batches: Optional[Iterator] = None,
+            resize_signal: Optional[Any] = None) -> dict:
+        """``resize_signal``: optional zero-arg callable polled after every
+        step (the elastic host-loss trigger — a watchdog stall flag, a
+        heartbeat timeout, a test hook). A truthy return stops the loop
+        cleanly at the step boundary and is surfaced as
+        ``out["resize_request"]``; the caller resizes the mesh
+        (``Trainer.resize``) and calls run() again for the remaining steps."""
         steps = steps or self.tc.steps
         batches = batches or synthetic_batches(self.cfg, self.tc, self.mesh)
         metrics: dict = {}
@@ -427,6 +519,8 @@ class Trainer:
         tokens_per_batch = self.tc.batch_size * self.tc.seq_len
         first_step_s = None
         t_step = t0
+        done = 0
+        resize_request = None
         for _ in range(steps):
             batch = next(batches)
             self.params, self.opt_state, metrics = self.step_fn(
@@ -435,6 +529,7 @@ class Trainer:
                 jax.block_until_ready(metrics["loss"])
                 first_step_s = time.perf_counter() - t0
             self.step += 1
+            done += 1
             if tel is not None:
                 # sync EVERY step: the recorded step time must be device
                 # time, not dispatch time (the telemetry contract)
@@ -446,6 +541,13 @@ class Trainer:
             if self.tc.checkpoint_dir and self.step % self.tc.checkpoint_every == 0:
                 self.save(block=not self.tc.async_checkpoint)
                 t_step = time.perf_counter()  # save time is not step time
+            if resize_signal is not None:
+                resize_request = resize_signal()
+                if resize_request:
+                    log.warning("host-loss signal at step %d — stopping the "
+                                "loop for an elastic resize: %s", self.step,
+                                resize_request)
+                    break
         jax.block_until_ready(metrics["loss"])
         self._compiled = True
         wall = time.perf_counter() - t0
@@ -454,13 +556,15 @@ class Trainer:
         # wait on purpose — overlapping it with training IS the feature)
         self.wait_pending()
         out = {
-            "steps": steps,
+            "steps": done,
             "final_loss": float(metrics["loss"]),
             "grad_norm": float(metrics["grad_norm"]),
             "wall_s": wall,
             "first_step_s": first_step_s,
-            "tokens_per_s": tokens_per_batch * steps / wall,
+            "tokens_per_s": tokens_per_batch * done / wall,
         }
+        if resize_request:
+            out["resize_request"] = resize_request
         if tel is not None:
-            out.update(tel.run_finished({"steps": steps}))
+            out.update(tel.run_finished({"steps": done}))
         return out

@@ -104,6 +104,15 @@ def main(argv=None) -> int:
                    default=os.environ.get("TPU_TRACE_EXPORT_PATH", ""),
                    help="append training.* spans to this JSONL file; render "
                         "with tools/goodput_summary.py / trace_summary.py")
+    p.add_argument("--elastic-batch-mode",
+                   default=os.environ.get("TPU_ELASTIC_BATCH_MODE",
+                                          "global") or "global",
+                   choices=("global", "per_host"),
+                   help="elastic gang training (ISSUE 6): when the kubelet "
+                        "resizes the gang on host loss, either hold the "
+                        "GLOBAL batch via grad accumulation (loss "
+                        "trajectory unchanged, steps slower) or hold the "
+                        "PER-HOST batch (global batch scales with the gang)")
     args = p.parse_args(argv)
     if args.export_adapter and args.lora_rank <= 0:
         # fail at arg time, not after a multi-hour run
@@ -126,6 +135,30 @@ def main(argv=None) -> int:
 
     # 1. the gang forms (no-op single process)
     pe = initialize_from_env()
+
+    # elastic gang resize (ISSUE 6): on a resize relaunch the kubelet has
+    # already renumbered JAX_NUM_PROCESSES/JAX_PROCESS_ID over the surviving
+    # hosts and injected TPU_ELASTIC_RESIZE + TPU_GANG_FULL_HOSTS — the gang
+    # simply forms at the surviving width and this block (a) logs the marker
+    # line the operator greps, (b) rescales the batch per the chosen mode.
+    from ..parallel.distributed import resize_env_summary
+    re_env = resize_env_summary(pe)
+    if re_env.is_resized and pe.process_id == 0:
+        log.info("elastic resize %d: continuing at %d/%d hosts",
+                 re_env.resize_count, pe.num_processes, re_env.full_hosts)
+    if re_env.shrunk(pe):
+        scale = re_env.full_hosts / max(1, pe.num_processes)
+        if args.elastic_batch_mode == "global":
+            # hold the global batch: grad accumulation absorbs the lost
+            # hosts, so per-device activation memory and the loss
+            # trajectory are unchanged (steps get slower)
+            args.grad_accum = max(1, round(max(1, args.grad_accum) * scale))
+        else:  # per_host: the global batch shrinks with the gang
+            args.batch = max(1, round(args.batch / scale))
+        if pe.process_id == 0:
+            log.info("elastic resize: batch_mode=%s -> global batch %d, "
+                     "grad_accum %d", args.elastic_batch_mode, args.batch,
+                     args.grad_accum)
 
     import jax
     if args.profiler_port:
@@ -171,7 +204,8 @@ def main(argv=None) -> int:
                      grad_accum_steps=args.grad_accum,
                      fused_ce_chunks=args.fused_ce_chunks,
                      checkpoint_dir=args.checkpoint_dir,
-                     checkpoint_every=args.checkpoint_every)
+                     checkpoint_every=args.checkpoint_every,
+                     elastic_batch_mode=args.elastic_batch_mode)
     initial = None
     if args.hf_checkpoint:
         from ..models import load_hf
@@ -219,6 +253,8 @@ def main(argv=None) -> int:
         straggler_factor=args.straggler_factor,
         stall_timeout_s=args.stall_timeout_s,
         attempt=restart_attempt,
+        resize_attempt=re_env.resize_count,
+        dp_width=mesh.shape["data"] * mesh.shape["fsdp"],
         state_path=state_path_for(args.checkpoint_dir),
         telemetry_every=args.telemetry_every,
         emit_line=emit_line)
@@ -226,6 +262,10 @@ def main(argv=None) -> int:
         log.info("goodput ledger: %.1fs charged to restart_lost "
                  "(attempt %d, prior step %d)",
                  tel.restart_lost_s, restart_attempt, tel.resumed_from_step)
+    if re_env.resize_count and tel.resize_lost_s > 0 and pe.process_id == 0:
+        log.info("goodput ledger: %.1fs charged to resize "
+                 "(resize %d, prior step %d)",
+                 tel.resize_lost_s, re_env.resize_count, tel.resumed_from_step)
     tel_server = None
     if pe.process_id == 0 and args.telemetry_port:
         tel_server = HealthServer(f":{args.telemetry_port}",

@@ -25,6 +25,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import bench
 
 
+@pytest.fixture(autouse=True)
+def round_dir(tmp_path, monkeypatch):
+    """Every orchestrate() path that sees a dead TPU writes an unreachable
+    BENCH_r<NN>.json round — keep those out of the real repo root."""
+    d = tmp_path / "rounds"
+    d.mkdir()
+    monkeypatch.setattr(bench, "_ROUND_DIR", str(d))
+    return d
+
+
 @pytest.fixture()
 def results_dir(tmp_path, monkeypatch):
     d = tmp_path / "bench_results"
@@ -334,7 +344,7 @@ class TestSessionFallback:
 
     def test_orchestrate_prefers_session_result_over_cpu(self, results_dir,
                                                          monkeypatch,
-                                                         capsys):
+                                                         capsys, tmp_path):
         os.makedirs(str(results_dir), exist_ok=True)
         rec = {"name": "headline", "ok": True, "ts": _now_ts(),
                "commit": "abc",
@@ -343,6 +353,8 @@ class TestSessionFallback:
                           "vs_baseline": 0.78}]}
         with open(bench._result_path("headline"), "w") as f:
             json.dump(rec, f)
+        rounds = tmp_path / "rounds"  # the autouse round_dir fixture's dir
+        (rounds / "BENCH_r05.json").write_text('{"n": 5, "parsed": {}}')
         monkeypatch.setenv("BENCH_PROBE_RETRIES", "1")
         monkeypatch.setattr(bench, "_probe_tpu", lambda: (False, "wedged"))
         monkeypatch.setattr(bench.time, "sleep", lambda s: None)
@@ -353,3 +365,55 @@ class TestSessionFallback:
         assert parsed["source"] == "session_watcher"
         assert parsed["generation"] == "v5e"
         assert "tpu_errors" in parsed
+        # ISSUE 6 satellite: the wedged round is LOUD — the emitted line is
+        # flagged and a fresh round file records it
+        assert parsed["unreachable"] is True
+        assert (rounds / "BENCH_r06.json").exists(), \
+            "stale trajectory not refreshed with an unreachable row"
+
+
+class TestUnreachableRound:
+    """ISSUE 6 satellite: a wedged TPU probe tunnel must fail loudly into a
+    FRESH BENCH_r<NN>.json instead of silently re-serving the last measured
+    round (how BENCH_r05 stayed the headline for two rounds)."""
+
+    def _row(self):
+        return {"metric": "train_tokens_per_sec_per_chip", "value": None,
+                "unreachable": True,
+                "tpu_errors": ["tpu probe: probe hung > 300s"]}
+
+    def test_writes_the_next_round_number(self, tmp_path):
+        (tmp_path / "BENCH_r04.json").write_text('{"n": 4, "parsed": {}}')
+        (tmp_path / "BENCH_r05.json").write_text('{"n": 5, "parsed": {}}')
+        path = bench._write_unreachable_round(self._row(), root=str(tmp_path))
+        assert path == str(tmp_path / "BENCH_r06.json")
+        rec = json.loads((tmp_path / "BENCH_r06.json").read_text())
+        assert rec["n"] == 6
+        assert rec["parsed"]["unreachable"] is True
+        assert rec["parsed"]["tpu_errors"]
+
+    def test_repeated_wedges_overwrite_not_proliferate(self, tmp_path):
+        (tmp_path / "BENCH_r05.json").write_text('{"n": 5, "parsed": {}}')
+        first = bench._write_unreachable_round(self._row(), root=str(tmp_path))
+        row2 = self._row()
+        row2["tpu_errors"] = ["second wedge"]
+        second = bench._write_unreachable_round(row2, root=str(tmp_path))
+        assert first == second == str(tmp_path / "BENCH_r06.json")
+        assert not (tmp_path / "BENCH_r07.json").exists(), \
+            "every wedged run must reuse the same unreachable round"
+        rec = json.loads((tmp_path / "BENCH_r06.json").read_text())
+        assert rec["parsed"]["tpu_errors"] == ["second wedge"]
+
+    def test_measured_round_is_never_overwritten(self, tmp_path):
+        measured = '{"n": 6, "parsed": {"value": 40823.8}}'
+        (tmp_path / "BENCH_r06.json").write_text(measured)
+        path = bench._write_unreachable_round(self._row(), root=str(tmp_path))
+        assert path == str(tmp_path / "BENCH_r07.json")
+        assert (tmp_path / "BENCH_r06.json").read_text() == measured
+
+    def test_noop_without_a_trajectory(self, tmp_path):
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        assert bench._write_unreachable_round(self._row(),
+                                              root=str(empty)) is None
+        assert list(empty.iterdir()) == []

@@ -1,0 +1,415 @@
+"""Elastic-gang control-plane semantics, pinned one behavior at a time
+(ISSUE 6 satellites; the end-to-end chain lives in test_elastic_soak.py).
+
+Budget semantics: a resize must NEVER consume the pod's
+preemption_requeue_limit allowance — only a full requeue should.
+Continuity: tpu.dev/recovered-attempt, tpu.dev/preemption-count and the
+goodput exposure state must survive a shrink->grow cycle without
+double-charging restart_lost. Recovery: a kubelet restart mid-shrink must
+neither re-shrink nor GangBroken-fail the already-resized gang.
+"""
+
+from k8s_runpod_kubelet_tpu.cloud.faults import HOST_LOSS, FaultPlan, FaultWindow
+from k8s_runpod_kubelet_tpu.gang.env import compute_worker_env
+from k8s_runpod_kubelet_tpu.kube import objects as ko
+from k8s_runpod_kubelet_tpu.provider import Provider
+from k8s_runpod_kubelet_tpu.provider.annotations import Annotations as A
+
+from harness import FakeClock, make_harness, make_pod
+
+import pytest
+
+SEED = 41_2026
+
+
+def _ctx(msg: str) -> str:
+    return f"{msg} (seed={SEED})"
+
+
+def _launch(h, annotations, name="train"):
+    pod = h.kube.create_pod(make_pod(name=name, chips=16,
+                                     annotations=annotations))
+    h.provider.create_pod(pod)
+    pod = h.kube.get_pod("default", name)
+    qr = ko.annotations(pod)[A.QUEUED_RESOURCE]
+    h.provider.update_all_pod_statuses()
+    assert h.kube.get_pod("default", name)["status"]["phase"] == "Running"
+    return pod, qr
+
+
+def _events(h, reason):
+    return [e for e in h.kube.events if e["reason"] == reason]
+
+
+ELASTIC_ANNS = {A.ELASTIC: "true", A.CHECKPOINT_DIR: "/ckpt/train"}
+
+
+class TestResizeBudgetSemantics:
+    def test_resizes_never_consume_the_requeue_allowance(self):
+        """config.py:84 budget pin: THREE shrink/grow cycles, then the pod
+        still has its FULL preemption_requeue_limit=2 allowance — two
+        whole-slice preemptions requeue, the third fails the pod."""
+        h = make_harness()
+        try:
+            pod, qr = _launch(h, ELASTIC_ANNS)
+            for cycle in range(3):
+                h.fake.preempt(qr, worker_id=1)
+                h.provider.update_all_pod_statuses()
+                info = h.provider.instances["default/train"]
+                assert info.lost_workers == (1,), _ctx(f"cycle {cycle}")
+                h.clock.advance(h.cfg.elastic_grow_grace_s + 1)
+                h.fake.restore_worker(qr, 1)
+                h.provider.update_all_pod_statuses()
+                info = h.provider.instances["default/train"]
+                assert info.lost_workers == (), _ctx(f"cycle {cycle}")
+            info = h.provider.instances["default/train"]
+            assert info.resize_count == 6, _ctx(str(info))
+            assert info.preemption_count == 0, \
+                _ctx("resizes consumed the requeue budget")
+
+            # now the whole slice preempts — the FULL allowance is intact
+            for attempt in (1, 2):
+                qr_now = ko.annotations(h.kube.get_pod("default", "train"))[
+                    A.QUEUED_RESOURCE]
+                h.fake.preempt(qr_now)
+                h.provider.update_all_pod_statuses()   # requeue
+                h.provider.process_pending_pods()      # redeploy
+                h.provider.update_all_pod_statuses()   # relaunch
+                pod_now = h.kube.get_pod("default", "train")
+                assert pod_now["status"]["phase"] == "Running", \
+                    _ctx(f"requeue {attempt} should still be in budget: "
+                         f"{pod_now['status']}")
+                assert h.provider.instances["default/train"]\
+                    .preemption_count == attempt
+            qr_now = ko.annotations(h.kube.get_pod("default", "train"))[
+                A.QUEUED_RESOURCE]
+            h.fake.preempt(qr_now)
+            h.provider.update_all_pod_statuses()
+            status = h.kube.get_pod("default", "train")["status"]
+            assert status["phase"] == "Failed" \
+                and status["reason"] == "Preempted", \
+                _ctx(f"3rd preemption must exhaust the budget: {status}")
+        finally:
+            h.close()
+
+    def test_whole_slice_preemption_of_shrunk_gang_requeues_full_width(self):
+        """Preemption DURING a shrunk phase: the elastic exclusion dies with
+        the slice — the replacement launches at full width with a clean
+        lost-workers slate (and the requeue consumed budget, as it must)."""
+        h = make_harness()
+        try:
+            pod, qr = _launch(h, ELASTIC_ANNS)
+            h.fake.preempt(qr, worker_id=3)
+            h.provider.update_all_pod_statuses()
+            assert h.provider.instances["default/train"].lost_workers == (3,)
+            h.fake.preempt(qr)  # now the whole slice goes
+            h.provider.update_all_pod_statuses()
+            h.provider.process_pending_pods()
+            h.provider.update_all_pod_statuses()
+            info = h.provider.instances["default/train"]
+            assert info.preemption_count == 1, _ctx(str(info))
+            assert info.lost_workers == (), \
+                _ctx("elastic exclusion leaked across the requeue")
+            anns = ko.annotations(h.kube.get_pod("default", "train"))
+            assert A.LOST_WORKERS not in anns, _ctx(str(anns))
+            assert A.GANG_WIDTH not in anns, _ctx(str(anns))
+            new_qr = anns[A.QUEUED_RESOURCE]
+            r = h.fake.get(new_qr)
+            assert len(r.worker_env) == 4, \
+                _ctx("replacement must launch the FULL gang")
+            assert r.workload.get("env", {}).get("TPU_RESTART_ATTEMPT") == "1"
+        finally:
+            h.close()
+
+    def test_min_hosts_floor_falls_back_to_requeue(self):
+        h = make_harness()
+        try:
+            pod, qr = _launch(h, {**ELASTIC_ANNS,
+                                  A.ELASTIC_MIN_HOSTS: "4"})
+            h.fake.preempt(qr, worker_id=0)
+            h.provider.update_all_pod_statuses()
+            info = h.provider.instances["default/train"]
+            assert info.preemption_count == 1, \
+                _ctx("below min-hosts must requeue, not resize")
+            assert info.resize_count == 0
+            assert _events(h, "GangResized") == []
+        finally:
+            h.close()
+
+    def test_multislice_pods_requeue_instead_of_resizing(self):
+        """Shrinking one slice of a multislice gang would renumber only its
+        own process space while sibling slices keep the old
+        JAX_NUM_PROCESSES — the cross-slice rendezvous would deadlock, so
+        host loss on a multislice pod routes to the requeue ladder."""
+        h = make_harness()
+        try:
+            pod, qr = _launch(h, {**ELASTIC_ANNS, A.NUM_SLICES: "2",
+                                  A.SLICE_ID: "0"})
+            h.fake.preempt(qr, worker_id=1)
+            h.provider.update_all_pod_statuses()
+            info = h.provider.instances["default/train"]
+            assert info.resize_count == 0, \
+                _ctx("multislice gang must never shrink")
+            assert info.preemption_count == 1
+            assert _events(h, "GangResized") == []
+        finally:
+            h.close()
+
+    def test_non_elastic_checkpoint_pod_requeues_on_host_loss(self):
+        """The PR 3 baseline behavior host loss now routes to: a pod with a
+        checkpoint dir (but no elastic opt-in) restarts the SAME-SIZE gang
+        via the requeue ladder instead of hard-failing."""
+        h = make_harness()
+        try:
+            pod, qr = _launch(h, {A.CHECKPOINT_DIR: "/ckpt/train"})
+            h.fake.preempt(qr, worker_id=2)
+            h.provider.update_all_pod_statuses()
+            h.provider.process_pending_pods()
+            h.provider.update_all_pod_statuses()
+            info = h.provider.instances["default/train"]
+            assert info.preemption_count == 1
+            assert info.resize_count == 0
+            assert h.kube.get_pod("default", "train")["status"]["phase"] \
+                == "Running", _ctx("requeue should have recovered the pod")
+        finally:
+            h.close()
+
+    def test_plain_pod_keeps_the_gang_broken_contract(self):
+        """No elastic opt-in, no checkpoint: host loss still fails the pod
+        (the owning Job is the retry mechanism — unchanged since PR 0)."""
+        h = make_harness()
+        try:
+            pod, qr = _launch(h, None)
+            h.fake.preempt(qr, worker_id=2)
+            h.provider.update_all_pod_statuses()
+            status = h.kube.get_pod("default", "train")["status"]
+            assert status["phase"] == "Failed" \
+                and status["reason"] == "GangBroken", _ctx(str(status))
+        finally:
+            h.close()
+
+
+class TestRecoveryContinuity:
+    def test_recovered_attempt_and_preemption_count_survive_shrink_grow(self):
+        """Satellite: the PR 3 recovery annotations must ride through a
+        shrink->grow cycle untouched — a resize is not a new attempt, so it
+        must neither bump the count nor re-trigger (or swallow) the
+        RecoveredFromPreemption announcement."""
+        h = make_harness()
+        try:
+            pod, qr = _launch(h, ELASTIC_ANNS)
+            # one real preemption first, fully recovered + announced
+            h.fake.preempt(qr)
+            h.provider.update_all_pod_statuses()
+            h.provider.process_pending_pods()
+            h.provider.update_all_pod_statuses()
+            pod_now = h.kube.get_pod("default", "train")
+            anns = ko.annotations(pod_now)
+            assert anns[A.PREEMPTION_COUNT] == "1", _ctx(str(anns))
+            assert anns[A.RECOVERED_ATTEMPT] == "1", _ctx(str(anns))
+            assert len(_events(h, "RecoveredFromPreemption")) == 1
+            qr2 = anns[A.QUEUED_RESOURCE]
+
+            # shrink -> grow on the recovered slice
+            h.fake.preempt(qr2, worker_id=1)
+            h.provider.update_all_pod_statuses()
+            h.clock.advance(h.cfg.elastic_grow_grace_s + 1)
+            h.fake.restore_worker(qr2, 1)
+            h.provider.update_all_pod_statuses()
+            h.provider.update_all_pod_statuses()  # settle post-grow status
+
+            anns = ko.annotations(h.kube.get_pod("default", "train"))
+            assert anns[A.PREEMPTION_COUNT] == "1", \
+                _ctx(f"resize changed preemption-count: {anns}")
+            assert anns[A.RECOVERED_ATTEMPT] == "1", \
+                _ctx(f"resize changed recovered-attempt: {anns}")
+            assert anns[A.RESIZE_COUNT] == "2", _ctx(str(anns))
+            assert len(_events(h, "RecoveredFromPreemption")) == 1, \
+                _ctx("a resize must not re-announce the old recovery")
+            # the resize relaunch kept the TRUE attempt number so the
+            # workload-side ledger attributes its downtime to `resize`,
+            # not a fresh restart_lost (test_training_telemetry pins the
+            # ledger half of this)
+            r = h.fake.get(qr2)
+            env = r.workload.get("env", {})
+            assert env.get("TPU_RESTART_ATTEMPT") == "1", _ctx(str(env))
+            assert env.get("TPU_ELASTIC_RESIZE") == "2", _ctx(str(env))
+        finally:
+            h.close()
+
+    def test_kubelet_restart_mid_shrink_is_idempotent(self):
+        """Recovery restores resize-count + lost-workers from the durable
+        annotations: the fresh kubelet must keep the pod Running on the
+        surviving gang WITHOUT relaunching or double-counting."""
+        h = make_harness()
+        try:
+            pod, qr = _launch(h, ELASTIC_ANNS)
+            h.fake.preempt(qr, worker_id=2)
+            h.provider.update_all_pod_statuses()
+            assert h.provider.instances["default/train"].resize_count == 1
+            launches_before = sum(
+                1 for m, p in h.fake.request_log if p.endswith(":workload"))
+
+            p2 = Provider(h.cfg, h.kube, h.tpu, gang_executor=h.provider.gang,
+                          clock=h.clock)
+            p2.load_running()
+            p2.update_all_pod_statuses()
+            info = p2.instances["default/train"]
+            assert info.resize_count == 1, _ctx("resize-count lost")
+            assert info.lost_workers == (2,), _ctx("exclusion lost")
+            assert h.kube.get_pod("default", "train")["status"]["phase"] \
+                == "Running", _ctx("restart broke the shrunk gang")
+            launches_after = sum(
+                1 for m, p in h.fake.request_log if p.endswith(":workload"))
+            assert launches_after == launches_before, \
+                _ctx("restart re-shrank an already-shrunk gang")
+            assert len(_events(h, "GangResized")) == 1
+        finally:
+            h.close()
+
+    def test_resize_step_is_durable_so_stale_checkpoints_cannot_grow(self):
+        """The grow boundary compares checkpoint log lines against the
+        step scraped AT THE SHRINK; that step must survive a kubelet
+        restart — otherwise a PRE-shrink `checkpoint saved` line would
+        pass for a fresh boundary and grow immediately."""
+        h = make_harness()
+        try:
+            pod, qr = _launch(h, ELASTIC_ANNS)
+            h.transport.append_log(
+                qr, 0, 'TPU_TELEMETRY {"step": 17, "goodput": 0.9, '
+                       '"mfu": 0.3, "tokens_per_sec": 10.0}')
+            h.transport.append_log(qr, 0, "checkpoint saved at step 16")
+            h.provider.update_all_pod_statuses()  # scrape: last step 17
+            h.fake.preempt(qr, worker_id=2)
+            h.provider.update_all_pod_statuses()  # shrink at step 17
+            anns = ko.annotations(h.kube.get_pod("default", "train"))
+            assert anns.get(A.RESIZE_STEP) == "17", _ctx(str(anns))
+
+            p2 = Provider(h.cfg, h.kube, h.tpu, gang_executor=h.provider.gang,
+                          clock=h.clock)
+            p2.load_running()
+            info = p2.instances["default/train"]
+            assert info.resize_step == 17, _ctx("resize_step lost on restart")
+            # capacity returns, but the only checkpoint line predates the
+            # shrink: the fresh kubelet must NOT grow yet
+            h.fake.restore_worker(qr, 2)
+            p2.update_all_pod_statuses()
+            assert p2.instances["default/train"].lost_workers == (2,), \
+                _ctx("grew off a PRE-shrink checkpoint line")
+            # a post-shrink boundary (async 'staged' counts) unlocks it
+            h.transport.append_log(qr, 0, "checkpoint staged at step 20 "
+                                          "(write in background)")
+            p2.update_all_pod_statuses()
+            assert p2.instances["default/train"].lost_workers == (), \
+                _ctx("post-shrink checkpoint boundary did not unlock grow")
+        finally:
+            h.close()
+
+    def test_scrape_follows_the_surviving_coordinator(self):
+        """Worker 0 is the victim: the renumbered process 0 lives on worker
+        1, and the kubelet's telemetry scrape must read THAT log."""
+        h = make_harness()
+        try:
+            pod, qr = _launch(h, ELASTIC_ANNS)
+            h.fake.preempt(qr, worker_id=0)
+            h.provider.update_all_pod_statuses()
+            info = h.provider.instances["default/train"]
+            assert info.lost_workers == (0,)
+            assert h.provider.scrape_worker_id(info) == 1
+            r = h.fake.get(qr)
+            # the shrink env renumbered worker 1 as process 0 and pointed
+            # the telemetry address at it
+            by_wid = {e["TPU_WORKER_ID"]: e for e in r.worker_env}
+            assert by_wid["1"]["JAX_PROCESS_ID"] == "0", _ctx(str(by_wid))
+            r_qr = h.tpu.get_queued_resource(qr)
+            coord = by_wid["1"]["JAX_COORDINATOR_ADDRESS"].split(":")[0]
+            assert coord == r_qr.workers[1].internal_ip, \
+                _ctx(f"coordinator must move to worker 1: {by_wid['1']}")
+            h.transport.append_log(
+                qr, 1, 'TPU_TELEMETRY {"step": 17, "goodput": 0.9, '
+                       '"mfu": 0.3, "tokens_per_sec": 10.0, "dp_width": 3}')
+            h.provider.update_all_pod_statuses()
+            assert h.provider.instances["default/train"].train_last_step \
+                == 17, _ctx("scrape still reading the dead worker 0")
+        finally:
+            h.close()
+
+
+class TestHostLossFaultKind:
+    def test_same_seed_same_victim_and_restore(self):
+        clock_a, clock_b = FakeClock(0.0), FakeClock(0.0)
+        plans = [FaultPlan(SEED, c, windows=[
+            FaultWindow(HOST_LOSS, 10.0, 30.0, 0.0)]) for c in (clock_a,
+                                                                clock_b)]
+        seen = []
+        for clock, plan in zip((clock_a, clock_b), plans):
+            clock.advance(15.0)
+            opened = plan.host_loss_transitions([("qr-a", 4), ("qr-b", 8)])
+            clock.advance(20.0)
+            closed = plan.host_loss_transitions([("qr-a", 4), ("qr-b", 8)])
+            seen.append((opened, closed))
+        assert seen[0] == seen[1], _ctx(f"host_loss not seeded: {seen}")
+        opened, closed = seen[0]
+        assert len(opened) == 1 and opened[0][2] is True
+        assert closed == [(opened[0][0], opened[0][1], False)], \
+            _ctx("window close must restore the SAME worker")
+
+    def test_param_pins_the_worker_and_single_host_slices_are_skipped(self):
+        clock = FakeClock(0.0)
+        plan = FaultPlan(SEED, clock,
+                         windows=[FaultWindow(HOST_LOSS, 0.0, 10.0, 3.0)])
+        assert plan.host_loss_transitions([("solo", 1)]) == [], \
+            _ctx("host_loss must only hit MULTI-host slices")
+        out = plan.host_loss_transitions([("gang", 4)])
+        assert out == [("gang", 3, True)], _ctx(str(out))
+
+    def test_fake_server_applies_and_heals_host_loss(self):
+        """End-to-end through the fake server's request hook, including the
+        FakeWorkerHost bridge (the satellite's gang/fake_host.py half)."""
+        h = make_harness()
+        try:
+            pod, qr = _launch(h, ELASTIC_ANNS)
+            plan = FaultPlan(SEED, h.clock, windows=[
+                FaultWindow(HOST_LOSS, 5.0, 50.0, 1.0)])
+            h.fake.fault_plan = plan
+            killed = []
+            h.fake.host_loss_hook = lambda name, wid, lost: killed.append(
+                (name, wid, lost))
+            h.clock.advance(10.0)
+            h.provider.update_all_pod_statuses()
+            assert killed == [(qr, 1, True)], _ctx(str(killed))
+            r = h.fake.get(qr)
+            assert r.workers[1]["state"] == "PREEMPTED"
+            h.clock.advance(50.0)
+            h.provider.update_all_pod_statuses()
+            assert killed[-1] == (qr, 1, False), _ctx(str(killed))
+            assert h.fake.get(qr).workers[1]["state"] == "READY"
+        finally:
+            h.close()
+
+
+class TestSubsetWorkerEnv:
+    def test_worker_ids_renumber_and_relocate_the_coordinator(self):
+        h = make_harness()
+        try:
+            pod, qr_name = _launch(h, None, name="envcheck")
+            qr = h.tpu.get_queued_resource(qr_name)
+            envs = compute_worker_env(qr, worker_ids=[0, 1, 3],
+                                      telemetry_port=8478)
+            assert [e["TPU_WORKER_ID"] for e in envs] == ["0", "1", "3"]
+            assert [e["JAX_PROCESS_ID"] for e in envs] == ["0", "1", "2"]
+            assert {e["JAX_NUM_PROCESSES"] for e in envs} == {"3"}
+            hosts = envs[0]["TPU_WORKER_HOSTNAMES"].split(",")
+            assert len(hosts) == 3 and f"{qr_name}-w2" not in hosts
+            # worker 0 lost: the next survivor takes coordinator + telemetry
+            envs2 = compute_worker_env(qr, worker_ids=[1, 2, 3],
+                                       telemetry_port=8478)
+            coord_host = envs2[0]["JAX_COORDINATOR_ADDRESS"].split(":")[0]
+            assert coord_host == qr.workers[1].internal_ip \
+                or coord_host == qr.workers[1].hostname
+            assert envs2[0]["TPU_TELEMETRY_ADDRESS"].startswith(
+                qr.workers[1].hostname)
+            with pytest.raises(ValueError, match="no workers"):
+                compute_worker_env(qr, worker_ids=[0, 9])
+        finally:
+            h.close()

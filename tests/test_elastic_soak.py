@@ -1,0 +1,351 @@
+"""Deterministic tier-1 elastic-gang soak (ISSUE 6 acceptance).
+
+The full control-plane chain over the REAL-cloud path (plain v2 surface +
+SSH workload backend + docker-lite FakeWorkerHost), everything on ONE
+FakeClock with zero real sleeps:
+
+  seeded `host_loss` fault window kills ONE worker of the 4-host slice
+    -> the kubelet distinguishes partial-gang loss from whole-slice
+       preemption: GangResized(shrink) + pod.gang_resize span, workload
+       relaunched on the 3 survivors with renumbered JAX env and
+       TPU_ELASTIC_RESIZE riding the TPU_RESTART_ATTEMPT injection path
+    -> the (simulated) workload continues FROM ITS LAST DURABLE STEP at
+       the surviving DP width, charging the transition to the ledger's
+       exclusive `resize` bucket — the requeue budget is untouched
+    -> the window closes (the fake cloud restores capacity) and the gang
+       grows back to full width at the next checkpoint boundary
+    -> zero leaked slices; every attempt's ledger buckets still sum to
+       wall clock; goodput_summary renders the shrink/grow timeline.
+
+The same fault plan is replayed against a RESTART-ONLY baseline (same pod,
+no elastic annotation: host loss requeues the whole slice, PR 3 style) and
+the soak asserts the elastic path's `restart_lost` share of wall clock is
+STRICTLY lower. Every failure message embeds SEED for replay.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+
+import pytest
+
+from k8s_runpod_kubelet_tpu.cloud import HttpTransport, SshWorkloadBackend, TpuClient
+from k8s_runpod_kubelet_tpu.cloud.fake_server import FakeTpuServer
+from k8s_runpod_kubelet_tpu.cloud.faults import HOST_LOSS, FaultPlan, FaultWindow
+from k8s_runpod_kubelet_tpu.config import Config
+from k8s_runpod_kubelet_tpu.gang import FakeWorkerHost, GangExecutor
+from k8s_runpod_kubelet_tpu.kube import FakeKubeClient
+from k8s_runpod_kubelet_tpu.kube import objects as ko
+from k8s_runpod_kubelet_tpu.provider import Provider
+from k8s_runpod_kubelet_tpu.provider.annotations import Annotations as A
+from k8s_runpod_kubelet_tpu.tracing import Tracer
+from k8s_runpod_kubelet_tpu.workloads.telemetry import (
+    TrainingTelemetry, state_path_for)
+
+from harness import FakeClock, Harness, make_pod
+
+SEED = 60_2026
+TICK_S = 5.0
+CKPT_EVERY = 4          # sim checkpoints every 4 steps = every 20s
+PROVISION_DELAY_S = 60  # a replacement slice takes a minute to come up
+HOST_LOSS_WINDOW = FaultWindow(HOST_LOSS, 120.0, 240.0, 2.0)  # pins worker 2
+
+
+def _ctx(msg: str) -> str:
+    return f"{msg} (seed={SEED})"
+
+
+def make_elastic_harness(tmp_path, variant: str) -> Harness:
+    """Chaos-grade SSH harness: ONE FakeClock shared by the provider, the
+    fake cloud's slice state machine, the fault plan, and the workload
+    sim's telemetry ledgers."""
+    clock = FakeClock()
+    server = FakeTpuServer(provision_delay_s=PROVISION_DELAY_S,
+                           clock=clock).start()
+    server.service.extensions_enabled = False  # plain v2: SSH carries launch
+    kube = FakeKubeClient()
+    transport = FakeWorkerHost()
+    gang = GangExecutor(transport)
+    tpu = TpuClient(HttpTransport(server.base_url, token="t",
+                                  sleep=lambda s: None),
+                    project="test-proj", zone="us-central2-b",
+                    workload_backend=SshWorkloadBackend(gang))
+    cfg = Config(node_name="virtual-tpu", zone="us-central2-b",
+                 stall_timeout_s=600.0,
+                 # the grow path must go through the checkpoint-boundary
+                 # grep, not the grace fallback — make the fallback
+                 # unreachable within the soak horizon
+                 elastic_grow_grace_s=100_000.0)
+    tracer = Tracer(clock=clock,
+                    export_path=str(tmp_path / f"spans-{variant}.jsonl"))
+    provider = Provider(cfg, kube, tpu, gang_executor=gang, clock=clock,
+                        tracer=tracer)
+    return Harness(server=server, kube=kube, tpu=tpu, provider=provider,
+                   clock=clock, transport=transport, cfg=cfg)
+
+
+class WorkloadSim:
+    """train_main's observable behavior, simulated on the shared clock: it
+    boots from whatever env the kubelet injected into the coordinator's
+    (fake) container — TPU_RESTART_ATTEMPT / TPU_ELASTIC_RESIZE /
+    TPU_CHECKPOINT_DIR / JAX_NUM_PROCESSES — keeps a REAL TrainingTelemetry
+    ledger (so restart-vs-resize attribution runs the production code
+    against the real goodput_state.json), emits the TPU_TELEMETRY line
+    protocol into the coordinator's docker log for the kubelet scrape, and
+    checkpoints every CKPT_EVERY steps, logging the `checkpoint saved at
+    step N` line the grow path greps for its boundary."""
+
+    def __init__(self, h: Harness, tracer: Tracer, pod_key="default/train"):
+        self.h = h
+        self.tracer = tracer
+        self.ns, self.name = pod_key.split("/")
+        self.tel = None
+        self.container_id = None
+        self.qr = ""
+        self.worker = 0
+        self.step = 0
+        self.durable_step = 0
+        self.finished: list[dict] = []   # dead attempts' last snapshots
+        self.current_snapshot: dict = {}
+        self.boots: list[dict] = []      # env each attempt booted with
+
+    def _coordinator(self, qr):
+        for wid in range(8):
+            c = self.h.transport.container(qr, wid)
+            if c is not None and c.status == "running" \
+                    and c.env.get("JAX_PROCESS_ID") == "0":
+                return wid, c
+        return None, None
+
+    @staticmethod
+    def _identity(qr, wid, c):
+        # NOT id(c): CPython reuses a freed container's address, so a
+        # relaunch can produce a new object with the old id. started_at is
+        # a real-time stamp taken at docker-run, unique per launch.
+        return (qr, wid, c.started_at)
+
+    def _emit(self, line: str):
+        self.h.transport.append_log(self.qr, self.worker, line)
+
+    def _boot(self, qr, wid, c):
+        if self.tel is not None:
+            self.finished.append(self.current_snapshot)
+        env = c.env
+        self.qr, self.worker = qr, wid
+        self.container_id = self._identity(qr, wid, c)
+        self.boots.append({
+            "attempt": int(env.get("TPU_RESTART_ATTEMPT", "0") or 0),
+            "resize": int(env.get("TPU_ELASTIC_RESIZE", "0") or 0),
+            "hosts": int(env.get("JAX_NUM_PROCESSES", "1")),
+            "boot_step": self.durable_step,
+        })
+        self.tel = TrainingTelemetry(
+            tokens_per_step=1024, model_params=1_000_000, n_chips=16,
+            accelerator_type="v5litepod-16",
+            num_hosts=int(env.get("JAX_NUM_PROCESSES", "1")), host_id=0,
+            clock=self.h.clock, mono=self.h.clock, tracer=self.tracer,
+            attempt=int(env.get("TPU_RESTART_ATTEMPT", "0") or 0),
+            resize_attempt=int(env.get("TPU_ELASTIC_RESIZE", "0") or 0),
+            dp_width=int(env.get("JAX_NUM_PROCESSES", "1")),
+            state_path=state_path_for(env.get("TPU_CHECKPOINT_DIR", "")),
+            # only the coordinator is simulated — peers never heartbeat, so
+            # the workload-side watchdog must not flip the ledger to
+            # `stalled` mid-soak (stall detection has its own tier-1 soak)
+            stall_timeout_s=1e9,
+            state_interval_s=0.0, emit_line=self._emit)
+        # "resumed from checkpoint step N" — what train_main logs and the
+        # recovery event parses; continuing FROM THE DURABLE STEP is the
+        # elastic contract
+        self.step = self.durable_step
+        self._emit(f"resumed from checkpoint step {self.step}")
+        self.tel.run_started(self.step)
+        self.current_snapshot = self.tel.ledger.snapshot()
+
+    def tick(self):
+        pod = self.h.kube.get_pod(self.ns, self.name)
+        qr = ko.annotations(pod).get(A.QUEUED_RESOURCE, "")
+        if not qr:
+            return
+        wid, c = self._coordinator(qr)
+        if c is None:
+            return
+        if self._identity(qr, wid, c) != self.container_id:
+            self._boot(qr, wid, c)
+        self.step += 1
+        self.tel.record_step(self.step, TICK_S)
+        if self.step % CKPT_EVERY == 0:
+            with self.tel.checkpoint("save", step=self.step):
+                pass
+            self._emit(f"checkpoint saved at step {self.step}")
+            self.durable_step = self.step
+        self.current_snapshot = self.tel.ledger.snapshot()
+
+    def bucket_totals(self) -> dict:
+        """Buckets summed across every attempt (dead + live)."""
+        out: dict = {}
+        for snap in self.finished + [self.current_snapshot]:
+            for bucket, v in (snap.get("buckets") or {}).items():
+                out[bucket] = out.get(bucket, 0.0) + v
+        out["wall_s"] = sum(s.get("wall_s", 0.0)
+                            for s in self.finished + [self.current_snapshot])
+        return out
+
+
+def run_soak(tmp_path, elastic: bool) -> dict:
+    variant = "elastic" if elastic else "baseline"
+    h = make_elastic_harness(tmp_path, variant)
+    plan = FaultPlan(SEED, h.clock, horizon_s=300.0,
+                     windows=[HOST_LOSS_WINDOW])
+    h.fake.fault_plan = plan
+    h.fake.host_loss_hook = h.transport.host_loss_hook
+    anns = {A.CHECKPOINT_DIR: str(tmp_path / f"ckpt-{variant}")}
+    if elastic:
+        anns[A.ELASTIC] = "true"
+    pod = h.kube.create_pod(make_pod(chips=16, annotations=anns))
+    h.provider.create_pod(pod)
+    sim = WorkloadSim(h, h.provider.tracer)
+
+    phases = set()
+    tick = 0
+    t0 = h.clock()
+    while h.clock() - t0 < 420.0:
+        tick += 1
+        h.clock.advance(TICK_S)
+        sim.tick()
+        h.provider.update_all_pod_statuses()
+        if tick % 2 == 0:
+            h.provider.process_pending_pods()
+        if tick % 12 == 0:
+            h.provider.run_cleanup()
+        phases.add(h.kube.get_pod("default", "train")
+                   .get("status", {}).get("phase"))
+    h.provider.run_cleanup()
+    h.provider.tracer.close()
+    info = h.provider.instances["default/train"]
+    out = {
+        "h": h, "sim": sim, "plan": plan, "info": info, "phases": phases,
+        "events": [e["reason"] for e in h.kube.events],
+        "event_msgs": {e["reason"]: e["message"] for e in h.kube.events},
+        "spans": list(h.provider.tracer.recent(2048)),
+        "totals": sim.bucket_totals(),
+        "span_path": str(tmp_path / f"spans-{variant}.jsonl"),
+    }
+    h.close()
+    return out
+
+
+@pytest.fixture(scope="module")
+def soaks(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("elastic-soak")
+    return run_soak(tmp, elastic=True), run_soak(tmp, elastic=False)
+
+
+class TestElasticSoak:
+    def test_shrink_then_grow_converges_running(self, soaks):
+        e, _ = soaks
+        assert "Failed" not in e["phases"], \
+            _ctx(f"elastic pod failed during the soak: {e['phases']}\n"
+                 f"{e['plan'].describe()}")
+        assert e["events"].count("GangResized") == 2, \
+            _ctx(f"expected shrink+grow: {e['events']}")
+        assert "ReplacementRequested" in e["events"], _ctx(str(e["events"]))
+        kinds = [s["attrs"]["kind"] for s in e["spans"]
+                 if s["name"] == "pod.gang_resize"]
+        assert kinds == ["shrink", "grow"], _ctx(f"resize spans: {kinds}")
+        # converged back to the full gang, Running
+        info = e["info"]
+        assert info.lost_workers == (), _ctx(f"still shrunk: {info}")
+        assert info.resize_count == 2
+        assert info.pod_status.get("phase") == "Running", \
+            _ctx(str(info.pod_status))
+        # the fault plan actually fired exactly one host loss
+        assert len(e["plan"].host_losses) == 1, \
+            _ctx(e["plan"].describe())
+        assert e["plan"].host_losses[0][2] == 2, \
+            _ctx(f"param=2.0 must pin worker 2: {e['plan'].host_losses}")
+
+    def test_shrunk_gang_env_and_durable_step_continuity(self, soaks):
+        e, _ = soaks
+        boots = e["sim"].boots
+        assert [b["hosts"] for b in boots] == [4, 3, 4], \
+            _ctx(f"boot widths: {boots}")
+        assert [b["resize"] for b in boots] == [0, 1, 2], _ctx(str(boots))
+        assert [b["attempt"] for b in boots] == [0, 0, 0], \
+            _ctx(f"a resize must NOT look like a requeue: {boots}")
+        # each relaunch continued from the last DURABLE step (checkpoint
+        # boundary), never from 0 and never from an unsaved step
+        for b in boots[1:]:
+            assert b["boot_step"] > 0, _ctx(f"restarted from scratch: {b}")
+            assert b["boot_step"] % CKPT_EVERY == 0, \
+                _ctx(f"resumed off-boundary: {b}")
+        # the shrunk relaunch renumbered the gang over the 3 survivors
+        # (worker 2 was pinned as the victim)
+        grow_qr = e["info"].qr_name
+        final_env = [e["h"].transport.container(grow_qr, w).env
+                     for w in range(4)
+                     if e["h"].transport.container(grow_qr, w)]
+        assert len(final_env) == 4, _ctx("grow must relaunch all 4 workers")
+        assert {en["JAX_NUM_PROCESSES"] for en in final_env} == {"4"}
+
+    def test_requeue_budget_untouched_and_no_leaked_slices(self, soaks):
+        e, _ = soaks
+        assert e["info"].preemption_count == 0, \
+            _ctx("a resize consumed the preemption-requeue allowance")
+        assert "Preempted" not in e["events"], _ctx(str(e["events"]))
+        with e["h"].fake.lock:
+            cloud = set(e["h"].fake.resources)
+        assert cloud == {e["info"].qr_name}, \
+            _ctx(f"leaked slices: cloud={cloud}")
+        assert not e["h"].provider.deleted, _ctx("undrained tombstones")
+
+    def test_ledger_buckets_sum_to_wall_with_resize_bucket(self, soaks):
+        for out, variant in zip(soaks, ("elastic", "baseline")):
+            for snap in out["sim"].finished + [out["sim"].current_snapshot]:
+                assert sum(snap["buckets"].values()) == pytest.approx(
+                    snap["wall_s"], rel=1e-9), \
+                    _ctx(f"{variant} ledger broke sum-to-wall: {snap}")
+        e, b = soaks
+        assert e["totals"].get("resize", 0.0) > 0, \
+            _ctx(f"elastic downtime not charged to resize: {e['totals']}")
+        assert b["totals"].get("resize", 0.0) == 0, \
+            _ctx(f"baseline must never charge resize: {b['totals']}")
+
+    def test_elastic_restart_lost_share_strictly_below_baseline(self, soaks):
+        """THE acceptance number: same fault plan, restart_lost share of
+        wall clock must drop under the elastic path."""
+        e, b = soaks
+        e_share = e["totals"].get("restart_lost", 0.0) / e["totals"]["wall_s"]
+        b_share = b["totals"].get("restart_lost", 0.0) / b["totals"]["wall_s"]
+        assert b_share > 0, \
+            _ctx(f"baseline never paid restart_lost — vacuous A/B: "
+                 f"{b['totals']}")
+        assert e_share < b_share, \
+            _ctx(f"elastic restart_lost share {e_share:.4f} not below "
+                 f"baseline {b_share:.4f}\n"
+                 f"elastic={e['totals']}\nbaseline={b['totals']}")
+
+    def test_baseline_requeued_instead_of_failing(self, soaks):
+        """The restart-only baseline is restart-from-checkpoint of the
+        same-size gang (PR 3), not a hard GangBroken failure."""
+        _, b = soaks
+        assert "Preempted" in b["events"], _ctx(str(b["events"]))
+        assert b["info"].preemption_count == 1, _ctx(str(b["info"]))
+        assert "GangResized" not in b["events"], _ctx(str(b["events"]))
+        boots = b["sim"].boots
+        assert [x["hosts"] for x in boots] == [4, 4], \
+            _ctx(f"baseline must restart at FULL width: {boots}")
+        assert [x["attempt"] for x in boots] == [0, 1], _ctx(str(boots))
+        assert b["info"].pod_status.get("phase") == "Running", \
+            _ctx(f"baseline never recovered: {b['info'].pod_status}")
+
+    def test_goodput_summary_renders_the_resize_timeline(self, soaks, capsys):
+        e, _ = soaks
+        sys.path.insert(0, str(pathlib.Path(__file__).parent.parent / "tools"))
+        import goodput_summary
+        assert goodput_summary.main([e["span_path"]]) == 0
+        out = capsys.readouterr().out
+        assert "resize timeline" in out, _ctx(out)
+        assert "shrink -> dp_width=3" in out, _ctx(out)
+        assert "grow   -> dp_width=4" in out, _ctx(out)
+        assert "resize" in out and "kind=shrink" in out, _ctx(out)

@@ -1,0 +1,272 @@
+"""Elastic mesh resize at the Trainer level (ISSUE 6 tentpole, workload half).
+
+On an 8-device virtual CPU mesh: a gang training at DP width 4 loses half
+its devices mid-run, rebuilds the mesh at the surviving width, reshards
+params + optimizer state from the latest durable orbax checkpoint (the
+PR 3 StandardRestore-with-shardings seam), continues LOSS-CONSISTENTLY
+from that step, and grows back to the original width when "capacity
+returns" — with the goodput ledger charging the transition to the new
+exclusive ``resize`` bucket and still summing to wall clock.
+
+ISOLATION NOTE (pinned repro): the jax scenarios run in a fresh
+subprocess (`python tests/test_elastic_training.py`), not in the pytest
+process. Executables compiled for meshes over *device subsets* trigger
+heap corruption in this image's XLA:CPU (`corrupted double-linked list` /
+segfaults inside the compile path) when they share a process with the
+suite's accumulated compiler state and/or the persistent compilation
+cache — same jaxlib-pinned family as the ORC-JIT workaround in
+conftest.py. Standalone, the identical scenarios pass 100% of runs;
+in-suite they crash at heap-layout-dependent points. The subprocess costs
+~20s of import+compile and buys determinism; revisit on a jaxlib upgrade.
+The pure-math resize helpers stay in-process below.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from k8s_runpod_kubelet_tpu.parallel import (MeshConfig, resize_config,
+                                             surviving_process_env)
+from k8s_runpod_kubelet_tpu.parallel.distributed import (ProcessEnv,
+                                                         resize_env_summary)
+
+SEED = 20260804
+_REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _ctx(msg: str) -> str:
+    return f"{msg} (seed={SEED})"
+
+
+class TestResizeConfigMath:
+    def test_data_absorbs_survivors(self):
+        cfg = resize_config(MeshConfig(data=4, fsdp=1, tensor=2), 6)
+        assert (cfg.data, cfg.fsdp, cfg.tensor) == (3, 1, 2)
+
+    def test_fsdp_shrinks_when_it_must(self):
+        cfg = resize_config(MeshConfig(data=2, fsdp=4), 6)
+        # 6 devices: fsdp 4 can't divide — falls to 3, data absorbs the rest
+        assert cfg.data * cfg.fsdp == 6
+        assert cfg.fsdp <= 4
+
+    def test_model_axes_are_inelastic(self):
+        with pytest.raises(ValueError, match="requeue instead"):
+            resize_config(MeshConfig(data=2, tensor=4), 3)
+
+    def test_surviving_process_env_renumbers_densely(self):
+        pe = ProcessEnv(coordinator="w0:8476", num_processes=4, process_id=3,
+                        worker_id=3, num_slices=1, slice_id=0,
+                        accelerator_type="v5litepod-16", topology="4x4")
+        out = surviving_process_env(pe, {1})
+        assert (out.num_processes, out.process_id) == (3, 2)
+        with pytest.raises(ValueError, match="lost set"):
+            surviving_process_env(pe, {3})
+
+    def test_resize_env_summary_reads_the_injected_vars(self):
+        pe = ProcessEnv(coordinator="w1:8476", num_processes=3, process_id=0,
+                        worker_id=1, num_slices=1, slice_id=0,
+                        accelerator_type="v5litepod-16", topology="4x4")
+        re_env = resize_env_summary(pe, env={
+            "TPU_GANG_FULL_HOSTS": "4", "TPU_ELASTIC_RESIZE": "1",
+            "TPU_ELASTIC_BATCH_MODE": "per_host"})
+        assert re_env.is_resized and re_env.shrunk(pe)
+        assert (re_env.full_hosts, re_env.batch_mode) == (4, "per_host")
+        # no injection = not a resize launch
+        plain = resize_env_summary(pe, env={})
+        assert not plain.is_resized and not plain.shrunk(pe)
+
+
+def test_trainer_resize_scenarios_in_a_clean_process():
+    """Spawns the jax scenarios below in a fresh interpreter (see the
+    ISOLATION NOTE in the module docstring). The subprocess prints one
+    marker per scenario; anything else — including the XLA:CPU heap
+    corruption this isolates against — fails loudly with the tail."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    env["PYTHONPATH"] = str(_REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    # the persistent compile cache is part of the pinned repro — keep the
+    # child on the default in-memory-only path
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                          env=env, capture_output=True, text=True,
+                          timeout=540, cwd=str(_REPO))
+    assert proc.returncode == 0, _ctx(
+        f"elastic scenarios failed (rc={proc.returncode}):\n"
+        f"stdout tail: {proc.stdout[-1500:]}\n"
+        f"stderr tail: {proc.stderr[-1500:]}")
+    for marker in ("SHRINK_GROW_OK", "PER_HOST_OK", "NO_CHECKPOINT_OK"):
+        assert marker in proc.stdout, _ctx(
+            f"{marker} missing:\n{proc.stdout[-1500:]}")
+
+
+# --------------------------------------------------------------------------
+# jax scenarios — executed by the subprocess test above
+# --------------------------------------------------------------------------
+
+def _scenario_shrink_grow(tmp_path):
+    """The acceptance chain, in-process: signal at step 3 -> resize to the
+    surviving width resumes from durable step 2 -> the replayed step-3 loss
+    equals the dp=4 original (resharding correctness through orbax) ->
+    grow back to full width from the next checkpoint -> ledger coherent."""
+    import jax
+    import numpy as np
+
+    from k8s_runpod_kubelet_tpu.metrics import Metrics
+    from k8s_runpod_kubelet_tpu.parallel import dp_width
+    from k8s_runpod_kubelet_tpu.tracing import Tracer
+    from k8s_runpod_kubelet_tpu.workloads.telemetry import TrainingTelemetry
+    from k8s_runpod_kubelet_tpu.workloads.train import (Trainer,
+                                                        synthetic_batches)
+
+    cfg, tc, mesh = _tiny(tmp_path)
+    tracer = Tracer()
+    tel = TrainingTelemetry(tokens_per_step=tc.batch_size * tc.seq_len,
+                            model_params=cfg.param_count, n_chips=4,
+                            metrics=Metrics(), tracer=tracer, dp_width=4)
+    trainer = Trainer(cfg, tc, mesh=mesh(4), seed=1, telemetry=tel)
+
+    # -- steps 1..3; the host-loss signal fires after step 3 (durable: 2) --
+    out = trainer.run(
+        steps=4, batches=synthetic_batches(cfg, tc, trainer.mesh, seed=0),
+        resize_signal=lambda: ("host 2 lost" if trainer.step >= 3 else None))
+    assert out["resize_request"] == "host 2 lost", _ctx(str(out))
+    assert out["steps"] == 3, _ctx("signal must stop the loop at the step")
+    assert trainer.step == 3
+
+    # -- shrink 4 -> 2 devices ------------------------------------------------
+    with tel.resize("shrink", old_width=4, new_width=2):
+        assert trainer.resize(mesh(2)) is True, _ctx("no checkpoint found")
+    assert trainer.step == 2, _ctx("must continue from the DURABLE step")
+    assert dp_width(trainer.mesh) == 2
+    assert trainer.tc.batch_size == 4, _ctx("global mode holds the batch")
+    assert trainer.tc.grad_accum_steps == 2, \
+        _ctx("global mode absorbs the width change via grad accumulation")
+    # every param + optimizer leaf actually lives on the 2-device mesh now
+    for leaf in jax.tree_util.tree_leaves(trainer.params) \
+            + jax.tree_util.tree_leaves(trainer.opt_state):
+        if hasattr(leaf, "sharding"):
+            assert leaf.sharding.mesh.devices.size == 2, \
+                _ctx(f"leaf not resharded: {leaf.sharding}")
+
+    # -- loss consistency: replay step 3 at the surviving width ----------------
+    out_elastic = trainer.run(
+        steps=1, batches=synthetic_batches(cfg, trainer.tc, trainer.mesh,
+                                           seed=2))
+    assert abs(out_elastic["final_loss"] - out["final_loss"]) \
+        <= 1e-4 * abs(out["final_loss"]), \
+        _ctx(f"post-resize replay of step 3 diverged: "
+             f"{out_elastic['final_loss']} vs dp=4 {out['final_loss']}")
+    assert trainer.step == 3
+    trainer.run(steps=1, batches=synthetic_batches(cfg, trainer.tc,
+                                                   trainer.mesh, seed=3))
+    assert trainer.step == 4  # durable checkpoint landed at step 4
+
+    # -- capacity returns: grow back to 4 devices ------------------------------
+    with tel.resize("grow", old_width=2, new_width=4):
+        assert trainer.resize(mesh(4)) is True
+    assert trainer.step == 4, _ctx("grow resumes from the latest checkpoint")
+    assert dp_width(trainer.mesh) == 4
+    assert trainer.tc.grad_accum_steps == 1, _ctx("accum restored on grow")
+    out2 = trainer.run(steps=2,
+                       batches=synthetic_batches(cfg, trainer.tc,
+                                                 trainer.mesh, seed=4))
+    assert np.isfinite(out2["final_loss"]), _ctx(str(out2))
+    assert trainer.step == 6
+
+    # -- telemetry: resize bucket charged, spans emitted, ledger coherent ------
+    snap = tel.ledger.snapshot()
+    assert snap["buckets"]["resize"] > 0, _ctx(f"resize bucket empty: {snap}")
+    assert abs(sum(snap["buckets"].values()) - snap["wall_s"]) \
+        <= 1e-6 * max(1.0, snap["wall_s"]), _ctx(f"ledger broke: {snap}")
+    resizes = [s for s in tracer.recent() if s["name"] == "training.resize"]
+    assert [s["attrs"]["kind"] for s in resizes] == ["shrink", "grow"], \
+        _ctx(str(resizes))
+    assert resizes[0]["attrs"]["new_width"] == 2
+    assert resizes[1]["attrs"]["new_width"] == 4
+    assert tel.dp_width == 4 and tel.resize_attempt == 2
+    assert tel.telemetry_payload()["dp_width"] == 4
+    print("SHRINK_GROW_OK", flush=True)
+
+
+def _scenario_per_host(tmp_path):
+    """per_host mode: the global batch shrinks with the gang (step time
+    holds, the optimizer sees a smaller batch)."""
+    import numpy as np
+
+    from k8s_runpod_kubelet_tpu.workloads.train import (Trainer,
+                                                        synthetic_batches)
+
+    cfg, tc, mesh = _tiny(tmp_path, elastic_batch_mode="per_host")
+    trainer = Trainer(cfg, tc, mesh=mesh(4), seed=1)
+    trainer.run(steps=2,
+                batches=synthetic_batches(cfg, tc, trainer.mesh, seed=0))
+    assert trainer.resize(mesh(2)) is True
+    assert trainer.tc.batch_size == 2, _ctx("per_host halves the batch")
+    assert trainer.tc.grad_accum_steps == 1
+    out = trainer.run(steps=1,
+                      batches=synthetic_batches(cfg, trainer.tc,
+                                                trainer.mesh, seed=2))
+    assert np.isfinite(out["final_loss"]), _ctx(str(out))
+    print("PER_HOST_OK", flush=True)
+
+
+def _scenario_no_checkpoint(tmp_path):
+    """No durable step to continue from: the resize is honest about it —
+    fresh init at the new width, step 0 (and the Trainer said so)."""
+    from k8s_runpod_kubelet_tpu.workloads.train import (Trainer,
+                                                        synthetic_batches)
+
+    cfg, tc, mesh = _tiny(tmp_path, checkpoint_dir=str(
+        pathlib.Path(tmp_path) / "never-written"), checkpoint_every=10_000)
+    trainer = Trainer(cfg, tc, mesh=mesh(4), seed=1)
+    trainer.run(steps=1,
+                batches=synthetic_batches(cfg, tc, trainer.mesh, seed=0))
+    assert trainer.step == 1
+    assert trainer.resize(mesh(2)) is False
+    assert trainer.step == 0, _ctx("nothing durable -> restart at 0")
+    print("NO_CHECKPOINT_OK", flush=True)
+
+
+def _tiny(tmp_path, **kw):
+    import jax.numpy as jnp
+
+    from k8s_runpod_kubelet_tpu.models import tiny_llama
+    from k8s_runpod_kubelet_tpu.parallel import MeshConfig, make_mesh
+    from k8s_runpod_kubelet_tpu.workloads.train import TrainConfig
+
+    cfg = tiny_llama(vocab_size=64, embed_dim=32, n_layers=1, n_heads=2,
+                     max_seq_len=64, dtype=jnp.float32,
+                     param_dtype=jnp.float32)
+    base = dict(batch_size=4, seq_len=16, steps=8, warmup_steps=1,
+                checkpoint_dir=str(pathlib.Path(tmp_path) / "ckpt"),
+                checkpoint_every=2, async_checkpoint=False,
+                elastic_batch_mode="global")
+    base.update(kw)
+
+    def mesh(n):
+        import jax
+        return make_mesh(MeshConfig(data=-1), jax.devices()[:n])
+
+    return cfg, TrainConfig(**base), mesh
+
+
+def _main() -> int:
+    import tempfile
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    for fn in (_scenario_shrink_grow, _scenario_per_host,
+               _scenario_no_checkpoint):
+        fn(pathlib.Path(tempfile.mkdtemp()))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(_main())

@@ -85,5 +85,12 @@ def test_known_metric_families_present():
                  "tpu_training_pod_goodput", "tpu_training_pod_mfu",
                  "tpu_training_pod_tokens_per_second",
                  "tpu_training_pod_last_step", "tpu_training_pod_stalled",
-                 "tpu_kubelet_training_stalls"):
+                 "tpu_kubelet_training_stalls",
+                 # elastic gang training (ISSUE 6): workload-side resize
+                 # telemetry + the kubelet's resize counters
+                 "tpu_training_resize_events", "tpu_training_resize_seconds",
+                 "tpu_training_resize_dp_width",
+                 "tpu_kubelet_gang_resizes",
+                 "tpu_kubelet_gang_resize_failures",
+                 "tpu_kubelet_host_loss_requeues"):
         assert name in described, name

@@ -33,7 +33,8 @@ from trace_summary import load_spans, percentile  # noqa: E402
 
 _BAR_WIDTH = 40
 _BUCKET_ORDER = ("productive", "compile", "checkpoint_save",
-                 "checkpoint_restore", "restart_lost", "stalled", "idle")
+                 "checkpoint_restore", "restart_lost", "resize", "stalled",
+                 "idle")
 
 
 def _fmt_s(v: float) -> str:
@@ -106,12 +107,49 @@ def render_events(spans: list[dict]) -> str:
                          f"kind={attrs.get('kind', '?')} "
                          f"last_step={attrs.get('last_step', '?')} "
                          f"lag_s={attrs.get('lag_s', '?')}"))
+        elif s["name"] in ("training.resize", "pod.gang_resize"):
+            # kubelet-side spans carry no training step — print '?' rather
+            # than falling back to a number that isn't one (the resize
+            # count would read as "shrunk at step 2")
+            width = attrs.get("new_width", attrs.get("width", "?"))
+            rows.append((s.get("start", 0.0),
+                         f"resize    kind={attrs.get('kind', '?')} "
+                         f"dp_width->{width} "
+                         f"step={attrs.get('step', '?')} "
+                         f"took={s.get('duration_s', 0.0):.3f}s"))
     if not rows:
-        return "events: (no checkpoint/restore/straggler spans)"
+        return "events: (no checkpoint/restore/straggler/resize spans)"
     rows.sort(key=lambda r: r[0])
     t0 = rows[0][0]
     return "\n".join(["events:"] + [f"  +{t - t0:9.3f}s  {msg}"
                                     for t, msg in rows])
+
+
+def render_resize_timeline(spans: list[dict]) -> str:
+    """Elastic shrink/grow timeline (ISSUE 6): one row per resize with the
+    DP width each segment ran at — from workload-side ``training.resize``
+    spans and/or kubelet-side ``pod.gang_resize`` spans (the soak exports
+    both; either alone renders)."""
+    events = []
+    for s in spans:
+        if s["name"] not in ("training.resize", "pod.gang_resize"):
+            continue
+        attrs = s.get("attrs") or {}
+        width = attrs.get("new_width", attrs.get("width"))
+        old = attrs.get("old_width", attrs.get("full_width"))
+        events.append((s.get("start", 0.0), attrs.get("kind", "?"),
+                       old, width, attrs.get("lost_workers")))
+    if not events:
+        return ""
+    events.sort(key=lambda e: e[0])
+    t0 = events[0][0]
+    initial = events[0][2]
+    out = ["resize timeline (DP width per segment):",
+           f"  +{0.0:9.3f}s  start            dp_width={initial}"]
+    for t, kind, _old, width, lost in events:
+        note = f"  lost_workers={lost}" if kind == "shrink" and lost else ""
+        out.append(f"  +{t - t0:9.3f}s  {kind:<6} -> dp_width={width}{note}")
+    return "\n".join(out)
 
 
 def render_steps(spans: list[dict]) -> str:
@@ -142,7 +180,8 @@ def main(argv=None) -> int:
                    help="also roll up per-host training.step durations")
     args = p.parse_args(argv)
     spans = load_spans(args.path)
-    training = [s for s in spans if s["name"].startswith("training.")]
+    training = [s for s in spans if s["name"].startswith("training.")
+                or s["name"] == "pod.gang_resize"]
     if not training:
         print(f"no training.* spans in {args.path}", file=sys.stderr)
         return 1
@@ -165,6 +204,10 @@ def main(argv=None) -> int:
         print(render_run_waterfall(runs))
         print()
         print(render_host_table(runs))
+        print()
+    resize = render_resize_timeline(training)
+    if resize:
+        print(resize)
         print()
     print(render_events(training))
     if args.steps:
