@@ -8,12 +8,24 @@ COPY k8s_runpod_kubelet_tpu/ k8s_runpod_kubelet_tpu/
 COPY pyproject.toml .
 RUN python -m compileall -q k8s_runpod_kubelet_tpu
 
+# CI gate: graftlint (README "Static analysis") — the runtime stage copies
+# the package FROM this stage, so an image cannot build with findings or
+# stale allowlist entries. README + helm ride along because the
+# config-plumbing and observability checkers lint the whole chain
+# (config -> env -> flag -> helm template, metric/span -> catalogue).
+FROM builder AS check
+COPY README.md .
+COPY helm/ helm/
+RUN pip install --no-cache-dir "pyyaml>=6" \
+    && python -m k8s_runpod_kubelet_tpu.analysis --format=github \
+    && python -m compileall -q k8s_runpod_kubelet_tpu
+
 FROM python:3.12-slim
 LABEL org.opencontainers.image.source=https://github.com/tpu-virtual-kubelet/tpu-virtual-kubelet
 WORKDIR /app
 # pyyaml is the one required dep (pyproject.toml): --provider-config / kubeconfig parsing
 RUN pip install --no-cache-dir "pyyaml>=6" && pip cache purge || true
-COPY --from=builder /build/k8s_runpod_kubelet_tpu/ k8s_runpod_kubelet_tpu/
+COPY --from=check /build/k8s_runpod_kubelet_tpu/ k8s_runpod_kubelet_tpu/
 # nonroot (parity: distroless nonroot uid 65532, Dockerfile:20)
 RUN groupadd -g 65532 nonroot && useradd -u 65532 -g 65532 -m nonroot
 USER 65532:65532

@@ -1,0 +1,25 @@
+"""graftlint: project-specific static analysis for the TPU virtual kubelet.
+
+Mechanizes the bug classes five PRs of review-hardening kept re-finding by
+hand: raw wall-clock calls that break injected-clock soak determinism,
+state mutated outside its admission lock, config knobs that never reach
+the gang env, telemetry emitted under uncatalogued names, and
+fire-and-forget threads.
+
+Run it three ways, all off ONE shared parse of the package:
+
+- ``python -m k8s_runpod_kubelet_tpu.analysis`` (CLI; ``--format=github``
+  for CI annotations; exits nonzero on findings or stale allowlists);
+- ``graftlint`` (console script, same thing);
+- tier-1 pytest (``tests/test_static_analysis.py`` plus the migrated
+  exception-hygiene/metrics lints share the cached index).
+"""
+
+from .core import Checker, CheckResult, Finding, SuiteResult, run_checkers
+from .index import (PACKAGE_NAME, FileInfo, PackageIndex,
+                    get_package_index)
+from .checkers import ALL_CHECKERS
+
+__all__ = ["ALL_CHECKERS", "Checker", "CheckResult", "FileInfo", "Finding",
+           "PACKAGE_NAME", "PackageIndex", "SuiteResult",
+           "get_package_index", "run_checkers"]

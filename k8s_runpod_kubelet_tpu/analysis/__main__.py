@@ -1,0 +1,56 @@
+"""CLI: ``python -m k8s_runpod_kubelet_tpu.analysis`` / ``graftlint``.
+
+Exit status is the CI contract: 0 = clean, 1 = findings or stale allowlist
+entries, 2 = bad invocation. ``--format=github`` renders findings as
+``::error`` workflow annotations; the default text format is
+``file:line (in func): message`` like the repo's other lints.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+
+from .checkers import ALL_CHECKERS
+from .core import run_checkers
+from .index import get_package_index
+
+
+def main(argv=None) -> int:
+    by_name = {c.name: c for c in ALL_CHECKERS}
+    p = argparse.ArgumentParser(
+        "graftlint",
+        description="project-specific static analysis (see README "
+                    "'Static analysis' for the checker catalogue)")
+    p.add_argument("--format", choices=["text", "github"], default="text",
+                   help="github = ::error workflow annotations for CI")
+    p.add_argument("--checker", action="append", choices=sorted(by_name),
+                   help="run only these checkers (repeatable); default all")
+    p.add_argument("--package", default=None,
+                   help="package root to analyze (default: the installed "
+                        "k8s_runpod_kubelet_tpu package)")
+    p.add_argument("--repo-root", default=None,
+                   help="repo root holding README.md and helm/ (default: "
+                        "the package root's parent)")
+    p.add_argument("--list", action="store_true",
+                   help="list checkers and exit")
+    args = p.parse_args(argv)
+
+    if args.list:
+        for c in ALL_CHECKERS:
+            print(f"{c.name}: {c.description}")
+        return 0
+
+    pkg_root = pathlib.Path(args.package).resolve() if args.package else None
+    repo_root = pathlib.Path(args.repo_root).resolve() \
+        if args.repo_root else None
+    index = get_package_index(pkg_root, repo_root)
+    names = args.checker or [c.name for c in ALL_CHECKERS]
+    suite = run_checkers(index, [by_name[n]() for n in names])
+    print(suite.render(args.format))
+    return 0 if suite.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

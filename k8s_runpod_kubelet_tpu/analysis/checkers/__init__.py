@@ -1,0 +1,28 @@
+"""The graftlint checker registry.
+
+Each checker mechanizes a bug class a real review pass kept re-finding;
+the module docstrings cite the motivating PR. Add new checkers here and
+they ride the shared single-parse index automatically — both under
+``python -m k8s_runpod_kubelet_tpu.analysis`` and the tier-1 pytest gate
+(``tests/test_static_analysis.py``).
+"""
+
+from .config_plumbing import ConfigPlumbingChecker
+from .determinism import DeterminismChecker
+from .exception_hygiene import ExceptionHygieneChecker
+from .lock_discipline import LockDisciplineChecker
+from .observability import ObservabilityChecker
+from .thread_hygiene import ThreadHygieneChecker
+
+ALL_CHECKERS = (
+    DeterminismChecker,
+    LockDisciplineChecker,
+    ConfigPlumbingChecker,
+    ObservabilityChecker,
+    ThreadHygieneChecker,
+    ExceptionHygieneChecker,
+)
+
+__all__ = ["ALL_CHECKERS", "ConfigPlumbingChecker", "DeterminismChecker",
+           "ExceptionHygieneChecker", "LockDisciplineChecker",
+           "ObservabilityChecker", "ThreadHygieneChecker"]

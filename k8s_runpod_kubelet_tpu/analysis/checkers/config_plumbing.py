@@ -1,0 +1,381 @@
+"""Config-plumbing checker: no dead knobs, no unwired channels.
+
+PR 5's review found helm ``stragglerFactor``/``stallTimeoutSeconds`` keys
+that reached nothing, and the reference design this repo reproduces shipped
+``--max-gpu-price`` parsed-but-never-used (SURVEY §5.6). The knob classes
+keep multiplying (config -> env -> flag -> helm is four layers that must
+agree), so this checker makes the whole chain structural. For every field
+of ``Config``:
+
+- **read**: the field must be consumed somewhere outside ``config.py``
+  (attribute-name match across the package) — a field nothing reads is the
+  ``PendingJobThreshold`` dead-knob class;
+- **env**: an ``_ENV_MAP`` entry must map to it (``TPU_*`` convention);
+- **flag**: a ``cmd/main.py`` or ``fleet/router_main.py`` ``add_argument``
+  must have it as dest;
+- **validated**: numeric fields must be range-checked in ``validate()``
+  (an unvalidated interval accepts ``-30`` and spins a hot loop);
+- **helm**: one of the field's env names or flag spellings must appear in a
+  helm template (values.yaml alone is not wiring — that was the PR 5 bug).
+
+And in the other direction:
+
+- every ``_ENV_MAP`` value and every ``cmd/main.py`` dest must be a real
+  field (typo guard);
+- every scalar leaf in helm ``values.yaml`` must be referenced by some
+  template (``.Values.<path>``, prefix-matching for ``toYaml`` blocks);
+- every ``TPU_*``/``KUBELET_*`` env name a template renders must exist in
+  ``_ENV_MAP`` (template-vs-code drift guard).
+
+Fields where a channel is intentionally absent carry an allowlist entry
+keyed ``(dimension, field)`` with the reason — secrets never ride argv,
+identity comes from the downward API, etc.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, Optional
+
+from ..core import Checker, Finding
+from ..index import PackageIndex
+
+_FLAG_FILES = ("cmd/main.py", "fleet/router_main.py")
+# must END on an alnum: "TPU_FLEET_*" in a template comment is prose, not
+# an env name
+_ENV_NAME_RE = re.compile(r"\b(?:TPU|KUBELET)_[A-Z0-9_]*[A-Z0-9]\b")
+
+
+def _numeric_default(node: Optional[ast.expr]) -> bool:
+    """True when the field default is an int/float (incl. simple arithmetic
+    like ``15 * 60``) — the fields validate() must range-check."""
+    if node is None:
+        return False
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, float)) \
+            and not isinstance(node.value, bool)
+    if isinstance(node, ast.BinOp):
+        return _numeric_default(node.left) and _numeric_default(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _numeric_default(node.operand)
+    return False
+
+
+def _config_fields(tree: ast.Module) -> dict[str, bool]:
+    """Field name -> is_numeric for the ``Config`` dataclass."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "Config":
+            out = {}
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) \
+                        and isinstance(stmt.target, ast.Name):
+                    out[stmt.target.id] = _numeric_default(stmt.value)
+            return out
+    return {}
+
+
+def _env_map(tree: ast.Module) -> dict[str, str]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) \
+                and any(isinstance(t, ast.Name) and t.id == "_ENV_MAP"
+                        for t in node.targets) \
+                and isinstance(node.value, ast.Dict):
+            return {k.value: v.value
+                    for k, v in zip(node.value.keys, node.value.values)
+                    if isinstance(k, ast.Constant)
+                    and isinstance(v, ast.Constant)}
+    return {}
+
+
+def _validated_fields(tree: ast.Module) -> set[str]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == "validate":
+            out = {n.attr for n in ast.walk(node)
+                   if isinstance(n, ast.Attribute)
+                   and isinstance(n.value, ast.Name)
+                   and n.value.id == "self"}
+            # the `for f in ("a_s", "b_s"): getattr(self, f)` batch idiom:
+            # string literals inside validate() count as referenced fields
+            out |= {n.value for n in ast.walk(node)
+                    if isinstance(n, ast.Constant)
+                    and isinstance(n.value, str)}
+            return out
+    return set()
+
+
+def _flags_by_file(index: PackageIndex) -> dict[str, dict[str, list[str]]]:
+    """file -> (argparse dest -> option strings), for the flag-owning
+    mains — read off the SHARED index, never a second parse."""
+    out: dict[str, dict[str, list[str]]] = {}
+    for rel in _FLAG_FILES:
+        fi = index.file(rel)
+        if fi is None:
+            continue
+        per_file = out.setdefault(rel, {})
+        for node in ast.walk(fi.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "add_argument"):
+                continue
+            opts = [a.value for a in node.args
+                    if isinstance(a, ast.Constant)
+                    and isinstance(a.value, str) and a.value.startswith("--")]
+            if not opts:
+                continue
+            dest = next((kw.value.value for kw in node.keywords
+                         if kw.arg == "dest"
+                         and isinstance(kw.value, ast.Constant)), None)
+            if dest is None:
+                dest = opts[0].lstrip("-").replace("-", "_")
+            per_file.setdefault(dest, []).extend(opts)
+    return out
+
+
+def _merge_flags(by_file: dict[str, dict[str, list[str]]]) -> dict[str, list[str]]:
+    merged: dict[str, list[str]] = {}
+    for per_file in by_file.values():
+        for dest, opts in per_file.items():
+            merged.setdefault(dest, []).extend(opts)
+    return merged
+
+
+def _values_leaves(values_text: str) -> list[str]:
+    """Dotted paths of every leaf in values.yaml (maps recursed; a scalar,
+    list, or empty map is a leaf)."""
+    import yaml
+    data = yaml.safe_load(values_text) or {}
+    leaves: list[str] = []
+
+    def rec(prefix: str, node):
+        if isinstance(node, dict) and node:
+            for k, v in node.items():
+                rec(f"{prefix}.{k}" if prefix else str(k), v)
+        else:
+            leaves.append(prefix)
+
+    rec("", data)
+    return leaves
+
+
+class ConfigPlumbingChecker(Checker):
+    name = "config-plumbing"
+    description = ("every Config field wired through env/flag/validate/helm; "
+                   "no dead values.yaml knobs or template drift")
+
+    # (dimension, name) -> why the missing channel is intentional.
+    allowlist = {
+        # -- secrets: must not ride argv (visible in `ps`/pod spec) ----------
+        ("flag", "tpu_api_token"):
+            "secret: env/Secret-mount only, never argv (visible in ps)",
+        ("flag", "api_auth_token"):
+            "secret: env/Secret-mount only, never argv (visible in ps)",
+        # -- identity/paths resolved by the runtime environment --------------
+        ("env", "internal_ip"):
+            "pod IP comes from the runtime (downward API / default "
+            "127.0.0.1 for dev); the flag exists for bare-process runs",
+        ("helm", "internal_ip"):
+            "in-cluster the pod IP is discovered, not configured",
+        ("env", "operating_system"):
+            "reference-parity --os flag only; never varies in a chart deploy",
+        ("helm", "operating_system"):
+            "chart deploys are always Linux; --os is a dev/testing flag",
+        ("env", "kubeconfig"):
+            "standard KUBECONFIG discovery happens in RealKubeClient."
+            "from_env; a second env var would shadow the convention",
+        ("helm", "kubeconfig"):
+            "in-cluster service-account auth; kubeconfig is for dev runs",
+        ("env", "tls_cert_file"):
+            "paths are fixed by the tlsSecretName mount (templates pass the "
+            "flags); an env override would desync cert and key",
+        ("env", "tls_key_file"):
+            "paths are fixed by the tlsSecretName mount (see tls_cert_file)",
+        # -- control-loop timing parity knobs (kubelet.go defaults):
+        #    provider-config file only, deliberately not operator-facing ----
+        ("env", "notify_interval_s"): "file-only parity timing knob",
+        ("flag", "notify_interval_s"): "file-only parity timing knob",
+        ("helm", "notify_interval_s"): "file-only parity timing knob",
+        ("env", "pending_retry_interval_s"): "file-only parity timing knob",
+        ("flag", "pending_retry_interval_s"): "file-only parity timing knob",
+        ("helm", "pending_retry_interval_s"): "file-only parity timing knob",
+        ("env", "max_pending_s"): "file-only parity timing knob",
+        ("flag", "max_pending_s"): "file-only parity timing knob",
+        ("helm", "max_pending_s"): "file-only parity timing knob",
+        ("env", "cleanup_interval_s"): "file-only parity timing knob",
+        ("flag", "cleanup_interval_s"): "file-only parity timing knob",
+        ("helm", "cleanup_interval_s"): "file-only parity timing knob",
+        ("env", "node_status_interval_s"): "file-only parity timing knob",
+        ("flag", "node_status_interval_s"): "file-only parity timing knob",
+        ("helm", "node_status_interval_s"): "file-only parity timing knob",
+        ("env", "stuck_reterminate_s"): "file-only parity timing knob "
+            "(5/10/15-min stuck-terminating ladder, kubelet.go:1333)",
+        ("flag", "stuck_reterminate_s"): "file-only parity timing knob",
+        ("helm", "stuck_reterminate_s"): "file-only parity timing knob",
+        ("env", "stuck_unreachable_force_s"): "file-only parity timing knob",
+        ("flag", "stuck_unreachable_force_s"): "file-only parity timing knob",
+        ("helm", "stuck_unreachable_force_s"): "file-only parity timing knob",
+        ("env", "stuck_force_delete_s"): "file-only parity timing knob",
+        ("flag", "stuck_force_delete_s"): "file-only parity timing knob",
+        ("helm", "stuck_force_delete_s"): "file-only parity timing knob",
+        # -- misc deliberate gaps --------------------------------------------
+        ("flag", "sentry_url"):
+            "reference parity: SENTRY_URL is env-only (main.go:111)",
+        ("env", "exec_killable"):
+            "workload-image property, set per provider-config file; the "
+            "helm chart has no distroless-image toggle yet",
+        ("flag", "exec_killable"): "see (env, exec_killable)",
+        ("helm", "exec_killable"): "see (env, exec_killable)",
+        ("env", "metrics_enabled"): "dev-only off-switch, file-only",
+        ("flag", "metrics_enabled"): "dev-only off-switch, file-only",
+        ("helm", "metrics_enabled"): "dev-only off-switch, file-only",
+        ("env", "trace_ring_size"):
+            "debug sizing knob, provider-config file only",
+        ("flag", "trace_ring_size"): "see (env, trace_ring_size)",
+        ("helm", "trace_ring_size"): "see (env, trace_ring_size)",
+    }
+
+    def collect(self, index: PackageIndex) -> Iterable[Finding]:
+        cfg = index.file("config.py")
+        if cfg is None:
+            return
+        fields = _config_fields(cfg.tree)
+        if not fields:
+            return
+        env_map = _env_map(cfg.tree)
+        env_by_field: dict[str, list[str]] = {}
+        for env_key, field in env_map.items():
+            env_by_field.setdefault(field, []).append(env_key)
+        validated = _validated_fields(cfg.tree)
+        flags_by_file = _flags_by_file(index)
+        flags = _merge_flags(flags_by_file)
+
+        field_def_lines = {
+            stmt.target.id: stmt.lineno
+            for node in ast.walk(cfg.tree)
+            if isinstance(node, ast.ClassDef) and node.name == "Config"
+            for stmt in node.body
+            if isinstance(stmt, ast.AnnAssign)
+            and isinstance(stmt.target, ast.Name)}
+
+        # one pass: every attribute name accessed outside config.py —
+        # including getattr(cfg, "field", ...) string literals, the
+        # defensive-read idiom some consumers use
+        attrs_read: set[str] = set()
+        for fi in index.files():
+            if fi.rel == "config.py":
+                continue
+            for node in ast.walk(fi.tree):
+                if isinstance(node, ast.Attribute):
+                    attrs_read.add(node.attr)
+                elif isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Name) \
+                        and node.func.id == "getattr" \
+                        and len(node.args) >= 2 \
+                        and isinstance(node.args[1], ast.Constant) \
+                        and isinstance(node.args[1].value, str):
+                    attrs_read.add(node.args[1].value)
+
+        templates = {n: index.resource(n) for n in index.resource_names("helm/")
+                     if "/templates/" in n and n.endswith((".yaml", ".tpl"))}
+        template_text = "\n".join(templates.values())
+        values_name = next((n for n in index.resource_names("helm/")
+                            if n.endswith("values.yaml")), None)
+        values_text = index.resource(values_name) if values_name else None
+
+        def helm_wired(field: str) -> bool:
+            spellings = list(env_by_field.get(field, []))
+            spellings += flags.get(field, [])
+            # boundary-matched: "--zone" must not count as wired via a
+            # surviving "--zones" line (prefix spellings are exactly the
+            # dead-knob class this check exists to catch)
+            return any(re.search(re.escape(s) + r"(?![\w-])", template_text)
+                       for s in spellings)
+
+        for field, numeric in fields.items():
+            line = field_def_lines.get(field, 1)
+            if field not in attrs_read:
+                yield Finding(
+                    self.name, "config.py", line, "Config",
+                    f"dead knob: Config.{field} is never read outside "
+                    f"config.py — delete it or wire it to behavior",
+                    key=("read", field))
+            if field not in env_by_field:
+                yield Finding(
+                    self.name, "config.py", line, "Config",
+                    f"Config.{field} has no _ENV_MAP env var (TPU_* "
+                    f"convention) — containerized deploys can't set it",
+                    key=("env", field))
+            if field not in flags:
+                yield Finding(
+                    self.name, "config.py", line, "Config",
+                    f"Config.{field} has no argparse flag in "
+                    f"{' or '.join(_FLAG_FILES)}",
+                    key=("flag", field))
+            if numeric and field not in validated:
+                yield Finding(
+                    self.name, "config.py", line, "Config",
+                    f"numeric Config.{field} is not range-checked in "
+                    f"validate() — a negative/zero value would misbehave "
+                    f"silently at runtime",
+                    key=("validated", field))
+            if template_text and not helm_wired(field):
+                yield Finding(
+                    self.name, "config.py", line, "Config",
+                    f"Config.{field} is reachable by no helm template (none "
+                    f"of its env/flag spellings appear) — the PR 5 "
+                    f"dead-helm-knob class",
+                    key=("helm", field))
+
+        for env_key, field in env_map.items():
+            if field not in fields:
+                yield Finding(
+                    self.name, "config.py", 1, "_ENV_MAP",
+                    f"_ENV_MAP[{env_key!r}] -> {field!r} is not a Config "
+                    f"field (typo? renamed field?)",
+                    key=("env-unknown", env_key))
+
+        if "cmd/main.py" in index:
+            known_extra = {"provider_config"}
+            for dest, opts in flags_by_file.get("cmd/main.py", {}).items():
+                if dest not in fields and dest not in known_extra:
+                    yield Finding(
+                        self.name, "cmd/main.py", 1, "parse_flags",
+                        f"flag {opts[0]} (dest={dest}) is not a Config field "
+                        f"— parsed but can never be applied (the reference's "
+                        f"--max-gpu-price bug class)",
+                        key=("flag-unknown", dest))
+
+        if values_text and template_text:
+            for path in _values_leaves(values_text):
+                parts = path.split(".")
+                prefixes = [".".join(parts[:i + 1]) for i in range(len(parts))]
+                # a PREFIX only counts when consumed whole (`toYaml
+                # .Values.resources`): it must not be followed by a deeper
+                # `.key` — else a sibling's wiring would mask a dead leaf
+                if not any(re.search(r"\.Values\." + re.escape(p)
+                                     + r"(?![.\w])", template_text)
+                           for p in prefixes):
+                    yield Finding(
+                        self.name, "", 1, values_name,
+                        f"values.yaml key {path!r} is referenced by no "
+                        f"template — a knob operators can set that changes "
+                        f"nothing (the PR 5 stragglerFactor bug class)",
+                        key=("helm-dead", path))
+            for env_name in sorted(set(_ENV_NAME_RE.findall(template_text))):
+                if env_name not in env_map:
+                    yield Finding(
+                        self.name, "", 1, "helm/templates",
+                        f"template renders env var {env_name} but _ENV_MAP "
+                        f"has no such key — the container sets it, the "
+                        f"kubelet ignores it",
+                        key=("template-env-unknown", env_name))
+        elif values_text is None and "cmd/main.py" in index:
+            # real-package run without helm resources: that's a broken
+            # invocation (the helm dimension silently passing would defeat
+            # the checker), so say it loudly
+            yield Finding(
+                self.name, "", 1, "helm/values.yaml",
+                "helm/values.yaml not indexed — run from the repo root (or "
+                "pass --repo-root) so the helm dimensions actually run",
+                key=("resource", "helm/values.yaml"))

@@ -1,0 +1,177 @@
+"""Determinism checker: no raw wall-clock / RNG calls in the control plane.
+
+All six soak suites (chaos, fleet, elastic, straggler, serving-stress,
+admission-race) depend on injected FakeClock/seeded-RNG determinism: one
+raw ``time.time()`` buried in a control-plane module silently turns a
+reproducible soak into a flaky one (the PR 3–6 review passes each caught
+at least one). This checker mechanizes the rule:
+
+- banned in scoped modules: calls to ``time.time/time_ns/monotonic/
+  monotonic_ns/perf_counter/perf_counter_ns/sleep``, ``datetime.now/
+  utcnow/today``, ``date.today``, and module-level ``random.*`` draws
+  (``random.Random(seed)``/``SystemRandom`` CONSTRUCTION is fine — building
+  an injectable rng is the seam, drawing from the shared global is not);
+- allowed seams: the lazy-default idiom where the raw call only fires when
+  an injected parameter was omitted —
+  ``now = time.time() if now is None else now``,
+  ``if clock is None: clock = time.time()``, ``p = p or time.time()`` —
+  keeps the production default while tests inject;
+- everything else is a finding, fixable by threading a ``clock``/``rng``
+  parameter (constructor default-arg seam, the repo-wide idiom) or
+  allowlisted by (file, function) with a written reason.
+
+Scope: control-plane and fleet modules (cloud/fleet/node/provider/kube/
+gang + the shared infra files) plus the serving stack the fleet soaks
+drive. The ML tier (models/ops/parallel/training mains) measures real
+wall time by design and stays out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..core import Checker, Finding
+from ..index import PackageIndex
+
+SCOPED_DIRS = ("cloud/", "fleet/", "node/", "provider/", "kube/", "gang/")
+SCOPED_FILES = {
+    "config.py", "health.py", "tracing.py", "metrics.py", "logging_util.py",
+    "workloads/serving.py", "workloads/serve_main.py", "workloads/telemetry.py",
+}
+
+_TIME_BANNED = {"time", "time_ns", "monotonic", "monotonic_ns",
+                "perf_counter", "perf_counter_ns", "sleep"}
+_DATETIME_BANNED = {"now", "utcnow", "today"}
+_RANDOM_OK = {"Random", "SystemRandom"}
+
+
+def in_scope(rel: str) -> bool:
+    return rel in SCOPED_FILES or rel.startswith(SCOPED_DIRS)
+
+
+def _module_aliases(tree: ast.Module) -> dict[str, str]:
+    """Local name -> stdlib module for ``import time [as _time]`` and the
+    ``from datetime import datetime`` / ``from time import time`` forms
+    (the latter mapped to pseudo-module ``time.time`` handled below)."""
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name in ("time", "random", "datetime"):
+                    aliases[a.asname or a.name] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module == "datetime":
+            for a in node.names:
+                if a.name in ("datetime", "date"):
+                    aliases[a.asname or a.name] = f"datetime.{a.name}"
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name in _TIME_BANNED:
+                    aliases[a.asname or a.name] = f"time.{a.name}"
+    return aliases
+
+
+def _banned_call(node: ast.Call, aliases: dict[str, str]) -> str | None:
+    """Dotted name of a banned call, or None."""
+    f = node.func
+    if isinstance(f, ast.Name):  # from time import sleep; sleep(...)
+        target = aliases.get(f.id, "")
+        if target.startswith("time."):
+            return target
+        return None
+    if not isinstance(f, ast.Attribute):
+        return None
+    recv = f.value
+    if isinstance(recv, ast.Name):
+        mod = aliases.get(recv.id)
+        if mod == "time" and f.attr in _TIME_BANNED:
+            return f"time.{f.attr}"
+        if mod == "random" and f.attr not in _RANDOM_OK:
+            return f"random.{f.attr}"
+        if mod in ("datetime.datetime", "datetime.date") \
+                and f.attr in _DATETIME_BANNED:
+            return f"{mod}.{f.attr}"
+        return None
+    # datetime.datetime.now(...) — Attribute(Attribute(Name))
+    if isinstance(recv, ast.Attribute) and isinstance(recv.value, ast.Name):
+        if aliases.get(recv.value.id) == "datetime" \
+                and recv.attr in ("datetime", "date") \
+                and f.attr in _DATETIME_BANNED:
+            return f"datetime.{recv.attr}.{f.attr}"
+    return None
+
+
+def _is_param_none_test(test: ast.expr, params: set[str]) -> bool:
+    return (isinstance(test, ast.Compare)
+            and isinstance(test.left, ast.Name)
+            and test.left.id in params
+            and len(test.ops) == 1
+            and isinstance(test.ops[0], (ast.Is, ast.IsNot))
+            and isinstance(test.comparators[0], ast.Constant)
+            and test.comparators[0].value is None)
+
+
+def _seam_lines(func: ast.AST) -> set[int]:
+    """Line numbers covered by a lazy-default seam inside ``func``: an
+    IfExp / if-statement / ``or`` fallback keyed on a parameter being
+    None (or falsy), where the raw call is the documented default for an
+    omitted injection."""
+    if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return set()
+    params = {a.arg for a in (func.args.args + func.args.kwonlyargs
+                              + func.args.posonlyargs)}
+    lines: set[int] = set()
+
+    def cover(node: ast.AST):
+        for n in ast.walk(node):
+            if hasattr(n, "lineno"):
+                lines.add(n.lineno)
+
+    for node in ast.walk(func):
+        if isinstance(node, ast.IfExp) and _is_param_none_test(node.test, params):
+            cover(node)  # cover both arms; only one holds the raw call
+        elif isinstance(node, ast.If) and _is_param_none_test(node.test, params):
+            cover(node)
+        elif isinstance(node, ast.BoolOp) and isinstance(node.op, ast.Or) \
+                and any(isinstance(v, ast.Name) and v.id in params
+                        for v in node.values[:-1]):
+            cover(node)
+    return lines
+
+
+class DeterminismChecker(Checker):
+    name = "determinism"
+    description = ("raw time/random calls in control-plane and fleet modules "
+                   "break injected-clock soak determinism")
+
+    # (file, enclosing function) -> why a raw call is correct THERE.
+    allowlist: dict = {}
+
+    def collect(self, index: PackageIndex) -> Iterable[Finding]:
+        for fi in index.files():
+            if not in_scope(fi.rel):
+                continue
+            aliases = _module_aliases(fi.tree)
+            if not aliases:
+                continue
+            seam_cache: dict[int, set[int]] = {}
+            for node in ast.walk(fi.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                banned = _banned_call(node, aliases)
+                if banned is None:
+                    continue
+                func_node = fi.enclosing_function_node(node.lineno)
+                if func_node is not None:
+                    key = id(func_node)
+                    if key not in seam_cache:
+                        seam_cache[key] = _seam_lines(func_node)
+                    if node.lineno in seam_cache[key]:
+                        continue  # lazy-default seam for an injected param
+                func = fi.enclosing_function(node.lineno)
+                yield Finding(
+                    self.name, fi.rel, node.lineno, func,
+                    f"raw {banned}() call: thread an injected clock/rng "
+                    f"through (constructor default-arg seam) so soak tests "
+                    f"stay deterministic",
+                    key=(fi.rel, func))

@@ -1,0 +1,97 @@
+"""Exception-hygiene checker: no silent broad excepts.
+
+The framework port of ``tests/test_exception_hygiene.py`` (ISSUE 3
+satellite) — same rule, same allowlist, one shared parse. Chaos bugs hide
+inside ``except Exception: pass``; every broad handler (bare ``except``,
+``Exception``, ``BaseException``) must do SOMETHING visible with the
+failure:
+
+- re-raise, or
+- call a logger (``log.exception``/``error``/``warning`` preferred;
+  ``info``/``debug`` accepted where a comment justifies the downgrade —
+  the lint cares about silence, not volume), or
+- USE the bound exception value (``except ... as e`` with ``e`` read in
+  the body: folding the error into a response/result/error-list is
+  handling, not swallowing).
+
+True silent swallows are allowlisted by (file, enclosing function) with a
+justification — adding one is a conscious, reviewed act, not an accident.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..core import Checker, Finding
+from ..index import PackageIndex
+
+_LOG_METHODS = {"exception", "error", "warning", "info", "debug", "log"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Name):
+        names = [t.id]
+    elif isinstance(t, ast.Tuple):
+        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+def _handles(handler: ast.ExceptHandler) -> bool:
+    bound = handler.name  # "e" in `except Exception as e`
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in _LOG_METHODS:
+                return True
+        if bound and isinstance(node, ast.Name) and node.id == bound \
+                and isinstance(node.ctx, ast.Load):
+            return True  # the error value flows somewhere visible
+    return False
+
+
+class ExceptionHygieneChecker(Checker):
+    name = "exception-hygiene"
+    description = ("broad except blocks must re-raise, log, or use the "
+                   "caught error — silent swallows are how chaos bugs hide")
+
+    # (file, enclosing function) -> why a silent swallow is correct THERE.
+    allowlist = {
+        ("gang/exec.py", "remote_kill"):
+            "best-effort disconnect-kill cleanup: worker gone / process "
+            "exited",
+        ("workloads/serving.py", "_fail_future"):
+            "racing future.cancel(); the future already carries a result",
+        ("workloads/serving.py", "_complete"):
+            "future already resolved elsewhere; nothing to report",
+        ("workloads/serve_main.py", "_triage_overflow"):
+            "metrics bump around a raw-socket 503 must never block the "
+            "reject",
+        ("ops/attention.py", "_generation"):
+            "backend not initialized; documented fallback to cpu kernels",
+        ("logging_util.py", "_drain"):
+            "the error sink must never raise; drops are counted "
+            "(self.dropped)",
+    }
+
+    def collect(self, index: PackageIndex) -> Iterable[Finding]:
+        for fi in index.files():
+            for node in ast.walk(fi.tree):
+                if not isinstance(node, ast.ExceptHandler) \
+                        or not _is_broad(node):
+                    continue
+                if _handles(node):
+                    continue
+                func = fi.enclosing_function(node.lineno)
+                yield Finding(
+                    self.name, fi.rel, node.lineno, func,
+                    "broad except that neither re-raises, nor logs, nor "
+                    "uses the caught error — surface the failure or "
+                    "(rarely, with justification) allowlist it",
+                    key=(fi.rel, func))

@@ -1,0 +1,164 @@
+"""Observability-contract checker: names on the wire match the catalogue.
+
+Generalizes ``tests/test_metrics_lint.py`` (metric names need describe())
+and extends the same honesty contract to spans: dashboards and the
+summary tools (``trace_summary``/``fleet_summary``/``goodput_summary``)
+are written against the README Observability catalogue, so a span or
+metric emitted under an uncatalogued name is invisible telemetry — it
+exists in the ring but nobody queries it, which is how renames rot
+observability one PR at a time.
+
+Rules:
+
+- every METRIC name passed to ``incr/set_gauge/observe/time_block/
+  remove_gauge`` as a string literal must have a ``describe()`` somewhere
+  in the package AND appear in README.md;
+- every SPAN name passed to ``tracer.record(...)``/``tracer.span(...)``
+  as a string literal must appear in README.md;
+- metric/span call sites whose name is NOT a literal are findings too —
+  a computed name escapes this lint, so each needs an allowlist entry
+  explaining why (build variability into labels/attrs instead);
+- a ``describe()`` for a name no call site emits is dead catalogue.
+
+Allowlist keys: ``("metric", name)`` / ``("span", name)`` for catalogue
+gaps, ``("dynamic", file, func)`` for computed names,
+``("undescribed", name)`` / ``("unemitted", name)`` for describe gaps.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from ..core import Checker, Finding
+from ..index import PackageIndex
+
+# remove_gauge deliberately absent: dropping a phantom series is not
+# emission, and the names it drops are linted at their set_gauge sites
+_METRIC_METHODS = {"incr", "set_gauge", "observe", "time_block"}
+_SPAN_METHODS = {"record", "span"}
+
+
+def _first_arg_literal(node: ast.Call) -> Optional[str]:
+    if node.args and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, str):
+        return node.args[0].value
+    return None
+
+
+def _recv_text(func: ast.Attribute) -> str:
+    """Receiver spelling: 'self.metrics', 'tracer', 'self.m'."""
+    return ast.unparse(func.value)
+
+
+def _is_metrics_recv(recv: str) -> bool:
+    # mirrors test_metrics_lint's rule: the receiver must *end* in
+    # "metrics" so registry-internal plumbing (_Timer's self.m.observe)
+    # stays exempt from the dynamic-name rule
+    return recv.endswith("metrics") or recv == "m"
+
+
+def _is_tracer_recv(recv: str) -> bool:
+    return recv.endswith(("tracer", "tr"))
+
+
+class ObservabilityChecker(Checker):
+    name = "observability"
+    description = ("every emitted metric/span name is described and "
+                   "catalogued in the README Observability section")
+
+    allowlist = {
+        ("dynamic", "workloads/telemetry.py", "__exit__"):
+            "_CheckpointTimer.__exit__ picks between exactly two literals "
+            "four lines above ('training.checkpoint' save / "
+            "'training.restore' restore), both in the README catalogue; "
+            "splitting the record() call per branch would duplicate the "
+            "attrs/trace plumbing for no new information",
+    }
+
+    def collect(self, index: PackageIndex) -> Iterable[Finding]:
+        readme = index.resource("README.md")
+        used_metrics: dict[str, tuple[str, int, str]] = {}
+        described: dict[str, tuple[str, int, str]] = {}
+        used_spans: dict[str, tuple[str, int, str]] = {}
+
+        for fi in index.files():
+            if fi.rel.startswith("analysis/"):
+                continue  # the lint's own name tables are not telemetry
+            # tracing.py's Span.__exit__ records self.name — registry
+            # plumbing, like metrics' _Timer; the literal names live at
+            # the tracer.span(...) call sites, which ARE collected
+            is_tracing_internals = fi.rel == "tracing.py"
+            for node in ast.walk(fi.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)):
+                    continue
+                attr = node.func.attr
+                recv = _recv_text(node.func)
+                site = (fi.rel, node.lineno,
+                        fi.enclosing_function(node.lineno))
+                if attr in _METRIC_METHODS:
+                    name = _first_arg_literal(node)
+                    if name is not None:
+                        used_metrics.setdefault(name, site)
+                    elif node.args and _is_metrics_recv(recv):
+                        yield Finding(
+                            self.name, fi.rel, node.lineno, site[2],
+                            f"dynamic metric name in .{attr}(...) — a "
+                            f"computed name escapes this lint; put the "
+                            f"variability in labels, or allowlist with the "
+                            f"reason the name set is closed",
+                            key=("dynamic", fi.rel, site[2]))
+                elif attr == "describe" and _is_metrics_recv(recv):
+                    name = _first_arg_literal(node)
+                    if name is not None:
+                        described.setdefault(name, site)
+                elif attr in _SPAN_METHODS and _is_tracer_recv(recv) \
+                        and not is_tracing_internals:
+                    name = _first_arg_literal(node)
+                    if name is not None:
+                        used_spans.setdefault(name, site)
+                    elif node.args:
+                        yield Finding(
+                            self.name, fi.rel, node.lineno, site[2],
+                            f"dynamic span name in .{attr}(...) — record a "
+                            f"literal in each branch (or allowlist with the "
+                            f"reason the name set is closed and catalogued)",
+                            key=("dynamic", fi.rel, site[2]))
+
+        for name, (rel, line, func) in sorted(used_metrics.items()):
+            if name not in described:
+                yield Finding(
+                    self.name, rel, line, func,
+                    f"metric {name!r} emitted without a describe() HELP "
+                    f"entry — scrapers see an untyped, undocumented family",
+                    key=("undescribed", name))
+            if readme is not None and name not in readme:
+                yield Finding(
+                    self.name, rel, line, func,
+                    f"metric {name!r} missing from the README Observability "
+                    f"catalogue — invisible telemetry nobody dashboards",
+                    key=("metric", name))
+        for name, (rel, line, func) in sorted(described.items()):
+            if name not in used_metrics:
+                yield Finding(
+                    self.name, rel, line, func,
+                    f"describe({name!r}) but no call site ever emits it — "
+                    f"dead catalogue entry (renamed metric?)",
+                    key=("unemitted", name))
+        for name, (rel, line, func) in sorted(used_spans.items()):
+            if readme is not None and name not in readme:
+                yield Finding(
+                    self.name, rel, line, func,
+                    f"span {name!r} missing from the README Observability "
+                    f"catalogue — trace consumers can't know to query it",
+                    key=("span", name))
+
+        if readme is None and len(index) > 20:
+            # real-package run without the README resource: the catalogue
+            # dimension silently passing would defeat the checker
+            yield Finding(
+                self.name, "", 1, "README.md",
+                "README.md not indexed — run from the repo root (or pass "
+                "--repo-root) so the catalogue checks actually run",
+                key=("resource", "README.md"))

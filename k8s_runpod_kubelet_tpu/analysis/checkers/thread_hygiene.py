@@ -1,0 +1,90 @@
+"""Thread-hygiene checker: no fire-and-forget non-daemon threads.
+
+A ``threading.Thread`` that is neither daemonized nor joined outlives
+shutdown: the kubelet's signal handler returns, ``main()`` exits, and the
+interpreter hangs waiting on a worker nobody will stop — or worse, the
+thread keeps mutating state during teardown (the chaos soaks' zombie
+class). The discipline is mechanical:
+
+- ``daemon=True`` at construction, or
+- a discoverable join/close path: a ``.join(`` call somewhere in the same
+  class (for ``self._thread``-style members, usually in ``stop()``/
+  ``close()``) or — for module-level/local threads — in the same
+  function or module.
+
+Anything else is a finding, allowlisted by (file, enclosing function)
+with the reason the thread's lifetime is actually bounded.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from ..core import Checker, Finding
+from ..index import PackageIndex
+
+
+def _is_thread_ctor(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == "Thread":
+        return True
+    if isinstance(f, ast.Name) and f.id == "Thread":
+        return True
+    return False
+
+
+def _daemon_kwarg(node: ast.Call) -> Optional[bool]:
+    for kw in node.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return None
+
+
+class ThreadHygieneChecker(Checker):
+    name = "thread-hygiene"
+    description = ("threading.Thread creations must be daemon=True or have "
+                   "a join/close path in the same scope")
+
+    # (file, enclosing function) -> why the thread's lifetime is bounded.
+    allowlist: dict = {}
+
+    def collect(self, index: PackageIndex) -> Iterable[Finding]:
+        for fi in index.files():
+            # class spans, so "a join exists in the same class" is cheap
+            class_spans = [(s.start, s.end) for s in fi.scopes
+                           if s.kind == "class"]
+            join_lines = [n.lineno for n in ast.walk(fi.tree)
+                          if isinstance(n, ast.Call)
+                          and isinstance(n.func, ast.Attribute)
+                          and n.func.attr == "join"
+                          and not (n.args and isinstance(n.args[0],
+                                                         ast.Constant)
+                                   and isinstance(n.args[0].value, str))]
+
+            def scope_has_join(lineno: int) -> bool:
+                # innermost class containing the ctor; else whole module
+                spans = [s for s in class_spans if s[0] <= lineno <= s[1]]
+                if spans:
+                    start, end = min(spans, key=lambda s: s[1] - s[0])
+                else:
+                    start, end = 1, len(fi.source.splitlines()) + 1
+                return any(start <= j <= end for j in join_lines)
+
+            for node in ast.walk(fi.tree):
+                if not (isinstance(node, ast.Call)
+                        and _is_thread_ctor(node)):
+                    continue
+                daemon = _daemon_kwarg(node)
+                if daemon is True:
+                    continue
+                if scope_has_join(node.lineno):
+                    continue
+                func = fi.enclosing_function(node.lineno)
+                yield Finding(
+                    self.name, fi.rel, node.lineno, func,
+                    "non-daemon Thread with no join in scope: it will "
+                    "outlive shutdown (interpreter hang / teardown "
+                    "mutation) — pass daemon=True or join it in "
+                    "stop()/close()",
+                    key=(fi.rel, func))

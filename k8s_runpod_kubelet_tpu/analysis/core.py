@@ -1,0 +1,131 @@
+"""Checker framework: findings, justification-carrying allowlists, reports.
+
+Every checker follows the contract ``tests/test_exception_hygiene.py``
+pioneered:
+
+- ``collect(index)`` yields RAW findings — every violation the heuristic
+  sees, before any suppression;
+- the checker's ``allowlist`` maps a finding key to a WRITTEN justification
+  (adding an entry is a conscious, reviewed act, never an accident);
+- ``run(index)`` splits raw findings into live findings (not allowlisted)
+  and suppressed ones, and reports STALE allowlist entries — an entry that
+  no longer suppresses anything is dead weight, and a typo'd entry would
+  silently protect nothing, so staleness fails as loudly as a finding.
+
+Report format is ``file:line (in func): message`` for humans and GitHub
+``::error`` annotations for CI (``--format=github``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Hashable, Iterable, Optional
+
+from .index import PACKAGE_NAME, PackageIndex
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    checker: str
+    file: str          # package-relative path ("" for package-wide findings)
+    line: int
+    func: str          # enclosing function, "<module>", or a logical scope
+    message: str
+    key: Hashable      # allowlist key; conventionally (file, func) or a name
+
+    def text(self) -> str:
+        loc = f"{self.file}:{self.line}" if self.file else "<package>"
+        return f"{loc} (in {self.func}): {self.message}"
+
+    def github(self) -> str:
+        if self.file:
+            path = f"{PACKAGE_NAME}/{self.file}"
+        elif "/" in self.func:
+            path = self.func  # package-wide finding located by resource path
+        else:
+            path = "README.md"
+        # GitHub annotation message is a single line; commas in file are fine
+        msg = self.message.replace("\n", " ")
+        return (f"::error file={path},line={max(self.line, 1)},"
+                f"title=graftlint/{self.checker}::{msg}")
+
+
+@dataclasses.dataclass
+class CheckResult:
+    checker: str
+    findings: list[Finding]            # live, not allowlisted
+    suppressed: list[Finding]          # allowlisted, with justification
+    stale_allowlist: list[Hashable]    # entries that suppressed nothing
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.stale_allowlist
+
+
+class Checker:
+    """Base class: subclasses set ``name``/``description``/``allowlist`` and
+    implement ``collect``. The allowlist may be overridden per instance so
+    snippet tests can exercise the allowlisted path."""
+
+    name: str = ""
+    description: str = ""
+    allowlist: dict = {}
+
+    def __init__(self, allowlist: Optional[dict] = None):
+        if allowlist is not None:
+            self.allowlist = dict(allowlist)
+
+    def collect(self, index: PackageIndex) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def run(self, index: PackageIndex) -> CheckResult:
+        raw = list(self.collect(index))
+        live = [f for f in raw if f.key not in self.allowlist]
+        suppressed = [f for f in raw if f.key in self.allowlist]
+        seen = {f.key for f in raw}
+        stale = sorted((k for k in self.allowlist if k not in seen), key=repr)
+        return CheckResult(self.name, live, suppressed, stale)
+
+
+@dataclasses.dataclass
+class SuiteResult:
+    results: list[CheckResult]
+    files_parsed: int
+    elapsed_s: float
+
+    @property
+    def ok(self) -> bool:
+        return all(r.ok for r in self.results)
+
+    @property
+    def findings(self) -> list[Finding]:
+        return [f for r in self.results for f in r.findings]
+
+    def render(self, fmt: str = "text") -> str:
+        out: list[str] = []
+        for r in self.results:
+            for f in sorted(r.findings, key=lambda f: (f.file, f.line)):
+                out.append(f.github() if fmt == "github"
+                           else f"[{r.checker}] {f.text()}")
+            for key in r.stale_allowlist:
+                msg = (f"stale allowlist entry {key!r}: it no longer "
+                       f"suppresses any finding — remove it (or fix the typo; "
+                       f"a typo'd entry protects nothing)")
+                out.append(f"::error title=graftlint/{r.checker}::{msg}"
+                           if fmt == "github" else f"[{r.checker}] {msg}")
+        n_sup = sum(len(r.suppressed) for r in self.results)
+        n_live = len(self.findings)
+        n_stale = sum(len(r.stale_allowlist) for r in self.results)
+        out.append(f"graftlint: {len(self.results)} checkers over "
+                   f"{self.files_parsed} files in {self.elapsed_s:.2f}s — "
+                   f"{n_live} finding(s), {n_sup} allowlisted, "
+                   f"{n_stale} stale allowlist entr(ies)")
+        return "\n".join(out)
+
+
+def run_checkers(index: PackageIndex,
+                 checkers: Iterable[Checker]) -> SuiteResult:
+    started = time.monotonic()
+    results = [c.run(index) for c in checkers]
+    return SuiteResult(results, len(index), time.monotonic() - started)

@@ -1,0 +1,158 @@
+"""Single-parse package index shared by every checker and lint test.
+
+Before this existed, each lint test (exception hygiene, metrics lint)
+re-walked and re-parsed the whole package independently; every new checker
+would have added another full parse. This module parses each package file
+exactly ONCE per process (``get_package_index`` is cached) and hands
+checkers an indexed view: per-file ASTs, source text, enclosing
+function/class lookup by line, and the non-Python resources the
+cross-layer checkers need (README, helm values + templates).
+
+A ``PackageIndex`` can also be built from in-memory snippets
+(``PackageIndex(files={...}, resources={...})``) so each checker is unit
+-testable against small synthetic positive/negative cases without touching
+the real tree.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import functools
+import pathlib
+from typing import Iterable, Optional
+
+PACKAGE_NAME = "k8s_runpod_kubelet_tpu"
+
+
+@dataclasses.dataclass
+class _Scope:
+    """One function or class body: name + inclusive line span."""
+    kind: str  # "func" | "class"
+    name: str
+    start: int
+    end: int
+    node: ast.AST
+
+
+@dataclasses.dataclass
+class FileInfo:
+    rel: str          # posix path relative to the package root, e.g. "fleet/router.py"
+    source: str
+    tree: ast.Module
+    _scopes: Optional[list[_Scope]] = None
+
+    @property
+    def scopes(self) -> list[_Scope]:
+        if self._scopes is None:
+            out = []
+            for node in ast.walk(self.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out.append(_Scope("func", node.name, node.lineno,
+                                      getattr(node, "end_lineno", node.lineno),
+                                      node))
+                elif isinstance(node, ast.ClassDef):
+                    out.append(_Scope("class", node.name, node.lineno,
+                                      getattr(node, "end_lineno", node.lineno),
+                                      node))
+            self._scopes = out
+        return self._scopes
+
+    def _innermost(self, kind: str, lineno: int) -> Optional[_Scope]:
+        best: Optional[_Scope] = None
+        for s in self.scopes:
+            if s.kind == kind and s.start <= lineno <= s.end:
+                if best is None or s.end - s.start < best.end - best.start:
+                    best = s
+        return best
+
+    def enclosing_function(self, lineno: int) -> str:
+        """Name of the innermost def containing the line (or <module>)."""
+        s = self._innermost("func", lineno)
+        return s.name if s else "<module>"
+
+    def enclosing_function_node(self, lineno: int) -> Optional[ast.AST]:
+        s = self._innermost("func", lineno)
+        return s.node if s else None
+
+    def enclosing_class(self, lineno: int) -> Optional[str]:
+        s = self._innermost("class", lineno)
+        return s.name if s else None
+
+
+class PackageIndex:
+    """All package files parsed once, plus cross-layer text resources.
+
+    ``files`` maps package-relative posix paths to source text; ``resources``
+    maps repo-relative names (``README.md``, ``helm/values.yaml``,
+    ``helm/templates/deployment.yaml``) to raw text. Checkers that need a
+    missing resource must report that loudly, never skip silently.
+    """
+
+    def __init__(self, files: dict[str, str],
+                 resources: Optional[dict[str, str]] = None):
+        self._files: dict[str, FileInfo] = {}
+        for rel, source in sorted(files.items()):
+            self._files[rel] = FileInfo(
+                rel=rel, source=source,
+                tree=ast.parse(source, filename=rel))
+        self._resources = dict(resources or {})
+
+    @classmethod
+    def from_package(cls, pkg_root: pathlib.Path,
+                     repo_root: Optional[pathlib.Path] = None) -> "PackageIndex":
+        pkg_root = pathlib.Path(pkg_root)
+        files = {p.relative_to(pkg_root).as_posix(): p.read_text(encoding="utf-8")
+                 for p in sorted(pkg_root.rglob("*.py"))}
+        resources: dict[str, str] = {}
+        if repo_root is None:
+            repo_root = pkg_root.parent
+        for name in ("README.md",):
+            p = repo_root / name
+            if p.is_file():
+                resources[name] = p.read_text(encoding="utf-8")
+        helm = repo_root / "helm"
+        if helm.is_dir():
+            for p in sorted(helm.rglob("*")):
+                if p.suffix in (".yaml", ".yml", ".tpl", ".txt") and p.is_file():
+                    resources["helm/" + p.relative_to(helm).as_posix()] = \
+                        p.read_text(encoding="utf-8")
+        return cls(files, resources)
+
+    # -- files -----------------------------------------------------------------
+
+    def files(self) -> Iterable[FileInfo]:
+        return self._files.values()
+
+    def file(self, rel: str) -> Optional[FileInfo]:
+        return self._files.get(rel)
+
+    def __contains__(self, rel: str) -> bool:
+        return rel in self._files
+
+    def __len__(self) -> int:
+        return len(self._files)
+
+    # -- resources -------------------------------------------------------------
+
+    def resource(self, name: str) -> Optional[str]:
+        return self._resources.get(name)
+
+    def resource_names(self, prefix: str = "") -> list[str]:
+        return sorted(n for n in self._resources if n.startswith(prefix))
+
+
+@functools.lru_cache(maxsize=4)
+def _cached_index(pkg_root: str, repo_root: Optional[str]) -> PackageIndex:
+    return PackageIndex.from_package(
+        pathlib.Path(pkg_root),
+        pathlib.Path(repo_root) if repo_root else None)
+
+
+def get_package_index(pkg_root: Optional[pathlib.Path] = None,
+                      repo_root: Optional[pathlib.Path] = None) -> PackageIndex:
+    """The process-wide shared index: one AST parse per file per process,
+    whether five lint tests or the CLI ask for it."""
+    if pkg_root is None:
+        pkg_root = pathlib.Path(__file__).resolve().parent.parent
+    return _cached_index(str(pkg_root), str(repo_root) if repo_root else None)

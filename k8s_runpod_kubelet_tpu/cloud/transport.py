@@ -241,6 +241,7 @@ class HttpTransport:
         sleep: Callable[[float], None] = time.sleep,
         user_agent: str = "tpu-virtual-kubelet/0.1",
         clock: Callable[[], float] = time.monotonic,
+        wall_clock: Callable[[], float] = time.time,
         rng: Optional[random.Random] = None,
         deadline_s: Optional[float] = None,
         backoff_base_s: float = BACKOFF_BASE_S,
@@ -257,6 +258,9 @@ class HttpTransport:
         self._sleep = sleep
         self.user_agent = user_agent
         self.clock = clock
+        # wall time ONLY for HTTP-date Retry-After math (clock is monotonic
+        # and useless against an absolute date); injectable like clock
+        self.wall_clock = wall_clock
         self.rng = rng or random.Random()
         self.deadline_s = deadline_s
         self.backoff_base_s = backoff_base_s
@@ -388,7 +392,8 @@ class HttpTransport:
                         f"{method} {path}: HTTP {e.code}", status=e.code,
                         body=body_text)
                     retry_after = parse_retry_after(
-                        e.headers.get("Retry-After") if e.headers else None)
+                        e.headers.get("Retry-After") if e.headers else None,
+                        now=self.wall_clock())
                     if e.code == 401 and not auth_retried and \
                             hasattr(self.token_provider, "invalidate") and \
                             not self.token:

@@ -59,6 +59,23 @@ def parse_flags(argv: list[str]) -> argparse.Namespace:
     p.add_argument("--zone", default=None)
     p.add_argument("--zones", default=None, help="comma-separated allowed zones")
     p.add_argument("--default-generation", dest="default_generation", default=None)
+    p.add_argument("--default-runtime-version", dest="default_runtime_version",
+                   default=None,
+                   help="TPU software/runtime version requested for created "
+                        "slices (empty = the generation's catalog default)")
+    p.add_argument("--max-total-chips", dest="max_total_chips", type=int,
+                   default=None,
+                   help="total google.com/tpu chips advertised as "
+                        "allocatable (0 = largest catalog slice / live "
+                        "quota when configured)")
+    p.add_argument("--breaker-failure-threshold",
+                   dest="breaker_failure_threshold", type=int, default=None,
+                   help="consecutive cloud-API failures that trip the "
+                        "circuit breaker open (and degrade the node)")
+    p.add_argument("--breaker-reset-s", dest="breaker_reset_s", type=float,
+                   default=None,
+                   help="seconds an open breaker waits before its half-open "
+                        "probe")
     p.add_argument("--tpu-api-endpoint", dest="tpu_api_endpoint", default=None)
     p.add_argument("--quota-api-endpoint", dest="quota_api_endpoint", default=None)
     p.add_argument("--log-level", dest="log_level", default=None)
@@ -197,8 +214,11 @@ def build(cfg: config_mod.Config, kube=None, tpu=None, worker_transport=None,
                                   tls_cert=cfg.tls_cert_file,
                                   tls_key=cfg.tls_key_file,
                                   auth_token=cfg.api_auth_token)
+    # metrics_enabled=False keeps /metrics dark (dev/airgapped runs);
+    # the registry still exists so call sites never branch
     health = HealthServer(cfg.health_address, ready_func=provider.ping,
-                          metrics=metrics, tracer=tracer,
+                          metrics=metrics if cfg.metrics_enabled else None,
+                          tracer=tracer,
                           train_status=provider.training_status)
     return (provider, node_controller, pod_controller, ref_controller,
             api_server, health)

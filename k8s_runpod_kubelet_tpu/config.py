@@ -156,8 +156,33 @@ class Config:
             errs.append("node_name must be set")
         if self.reconcile_interval_s <= 0:
             errs.append("reconcile_interval_s must be > 0")
+        for interval in ("notify_interval_s", "pending_retry_interval_s",
+                         "cleanup_interval_s", "node_status_interval_s"):
+            if getattr(self, interval) <= 0:
+                errs.append(f"{interval} must be > 0 (a non-positive "
+                            f"interval spins the loop hot)")
         if self.max_pending_s <= 0:
             errs.append("max_pending_s must be > 0")
+        # the stuck-terminating ladder must escalate in order, or a pod
+        # would be force-deleted before it was ever re-terminated
+        if not 0 < self.stuck_reterminate_s <= self.stuck_unreachable_force_s \
+                <= self.stuck_force_delete_s:
+            errs.append("stuck_* ladder must satisfy 0 < reterminate <= "
+                        "unreachable_force <= force_delete")
+        if self.max_provisioning_s < 0:
+            errs.append("max_provisioning_s must be >= 0 (0 = queue forever)")
+        if self.preemption_requeue_limit < 0:
+            errs.append("preemption_requeue_limit must be >= 0 (0 = fail "
+                        "the pod immediately)")
+        if self.max_cost_per_hr < 0:
+            errs.append("max_cost_per_hr must be >= 0 (0 = unlimited)")
+        if self.max_total_chips < 0:
+            errs.append("max_total_chips must be >= 0 (0 = largest catalog "
+                        "slice)")
+        if not 0 < self.listen_port <= 65535:
+            errs.append("listen_port must be in [1, 65535]")
+        if self.fleet_ttft_slo_s <= 0:
+            errs.append("fleet_ttft_slo_s must be > 0")
         if self.log_level.lower() not in ("debug", "info", "warning", "error"):
             errs.append(f"unknown log_level {self.log_level!r}")
         if self.workload_path not in ("ssh", "api"):
@@ -210,11 +235,23 @@ _ENV_MAP = {
     "TPU_QUOTA_API_ENDPOINT": "quota_api_endpoint",
     "TPU_PROJECT": "project",
     "TPU_ZONE": "zone",
+    "TPU_ZONES": "zones",
     "NODE_NAME": "node_name",
     "NAMESPACE": "namespace",
     "SENTRY_URL": "sentry_url",
     "LOG_LEVEL": "log_level",
+    "TPU_DEFAULT_GENERATION": "default_generation",
+    "TPU_DEFAULT_RUNTIME_VERSION": "default_runtime_version",
+    "TPU_WORKLOAD_PATH": "workload_path",
+    "TPU_MAX_COST_PER_HR": "max_cost_per_hr",
     "TPU_MAX_TOTAL_CHIPS": "max_total_chips",
+    "TPU_LISTEN_PORT": "listen_port",
+    "TPU_HEALTH_ADDRESS": "health_address",
+    "TPU_RECONCILE_INTERVAL_S": "reconcile_interval_s",
+    "TPU_MAX_PROVISIONING_S": "max_provisioning_s",
+    "TPU_PREEMPTION_REQUEUE_LIMIT": "preemption_requeue_limit",
+    "TPU_BREAKER_FAILURE_THRESHOLD": "breaker_failure_threshold",
+    "TPU_BREAKER_RESET_S": "breaker_reset_s",
     "TPU_TRACE_EXPORT_PATH": "trace_export_path",
     "TPU_FLEET_ROUTER_PORT": "fleet_router_port",
     "TPU_FLEET_HEARTBEAT_INTERVAL_S": "fleet_heartbeat_interval_s",

@@ -80,6 +80,8 @@ class FakeKubeClient(KubeClient):
         return ko.deep_copy(obj)
 
     def _notify(self, ev_type: str, pod: dict):
+        """Caller holds self.lock (every mutator notifies inside its
+        critical section, so history order == resourceVersion order)."""
         snapshot = ko.deep_copy(pod)
         rv = int(ko.meta(snapshot).get("resourceVersion", "0") or 0)
         self._pod_history.append((rv, ev_type, snapshot))

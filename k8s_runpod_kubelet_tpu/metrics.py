@@ -50,7 +50,10 @@ class _Hist:
 
 
 class Metrics:
-    def __init__(self):
+    def __init__(self, clock=time.monotonic):
+        # duration source for time_block timers; injectable so soak tests
+        # driving a FakeClock see deterministic histogram durations
+        self._clock = clock
         self.lock = threading.Lock()
         self.counters: dict[tuple[str, tuple], float] = {}
         self.gauges: dict[tuple[str, tuple], float] = {}
@@ -179,8 +182,8 @@ class _Timer:
         self.m, self.name, self.labels = m, name, labels
 
     def __enter__(self):
-        self.t0 = time.monotonic()
+        self.t0 = self.m._clock()
         return self
 
     def __exit__(self, *exc):
-        self.m.observe(self.name, time.monotonic() - self.t0, self.labels)
+        self.m.observe(self.name, self.m._clock() - self.t0, self.labels)

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Callable, Optional
 
 from ..kube.client import KubeApiError, KubeClient
@@ -32,9 +33,11 @@ class NodeController:
 
     def __init__(self, kube: KubeClient, node_provider, *,
                  status_interval_s: float = DEFAULT_STATUS_INTERVAL_S,
-                 lease_duration_s: int = DEFAULT_LEASE_DURATION_S):
+                 lease_duration_s: int = DEFAULT_LEASE_DURATION_S,
+                 clock: Callable[[], float] = time.time):
         self.kube = kube
         self.node_provider = node_provider
+        self.clock = clock  # wall clock for lease renewTime (injectable)
         self.status_interval_s = status_interval_s
         self.lease_duration_s = lease_duration_s
         self._stop = threading.Event()
@@ -125,8 +128,10 @@ class NodeController:
         the cluster watch. Create on first renew, then bump renewTime."""
         import datetime
         name = self.node_name
-        # metav1.MicroTime: fractional seconds BEFORE the zone designator
-        now_micro = datetime.datetime.now(datetime.timezone.utc).strftime(
+        # metav1.MicroTime: fractional seconds BEFORE the zone designator.
+        # Rendered from the injected clock so lease-renewal tests replay.
+        now_micro = datetime.datetime.fromtimestamp(
+            self.clock(), datetime.timezone.utc).strftime(
             "%Y-%m-%dT%H:%M:%S.%fZ")
         lease_spec = {
             "holderIdentity": name,

@@ -19,7 +19,6 @@ from __future__ import annotations
 import logging
 import queue
 import threading
-import time
 from typing import Optional
 
 from ..kube.client import KubeApiError, KubeClient
@@ -166,7 +165,10 @@ class PodController:
                 op, pod, attempt = self._queue.get(timeout=0.1)
             except queue.Empty:
                 continue
-            time.sleep(min(0.2 * attempt, 1.0))
+            # backoff on the stop event, not time.sleep: shutdown must not
+            # wait out a retry delay, and soaks can release it instantly
+            if self._stop.wait(min(0.2 * attempt, 1.0)):
+                return
             self._dispatch(op, pod, attempt)
 
     def _resync_loop(self):

@@ -48,6 +48,7 @@ Run: python -m k8s_runpod_kubelet_tpu.workloads.serve_main \
 from __future__ import annotations
 
 import argparse
+import itertools
 import json
 import logging
 import threading
@@ -59,6 +60,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from ..tracing import Tracer, format_traceparent, parse_traceparent
 
 log = logging.getLogger("serve-main")
+
+# request-id uniqueness tail (see _openai_completion: the wall stamp alone
+# repeats under an injected test clock)
+_RID_SEQ = itertools.count()
 
 
 def _or(value, default):
@@ -72,6 +77,11 @@ class _Handler(BaseHTTPRequestHandler):
     tokenizer = None  # bound below; None = token-ids-only API
     request_timeout_s = 120.0
     allow_adapters = False  # POST /adapters opt-in (--dynamic-adapters)
+    # clock seams, rebound by serve(clock=..., mono=...): wall time for
+    # OpenAI `created` stamps / request ids, monotonic for deadlines —
+    # injected so stress/soak tests drive HTTP-layer timeouts deterministically
+    clock = staticmethod(time.time)
+    mono = staticmethod(time.monotonic)
     # chunked transfer framing is an HTTP/1.1 construct; 1.0 clients would
     # read raw chunk framing as the body (non-stream responses all send
     # Content-Length, so keep-alive stays correct)
@@ -151,8 +161,7 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send(200, b"ready", "text/plain")
         if self.path == "/v1/models":
             # OpenAI model listing: the base model plus registered adapters
-            import time as _time
-            now = int(_time.time())
+            now = int(self.clock())
             data = [{"id": self.engine.cfg.name, "object": "model",
                      "created": now, "owned_by": "base"}]
             data += [{"id": n, "object": "model", "created": now,
@@ -349,7 +358,6 @@ class _Handler(BaseHTTPRequestHandler):
         it for the role-delta chunk, so a generation that ends instantly
         — or times out — still gives strict OpenAI clients a role)."""
         import queue as _q
-        import time as _time
         q: "_q.Queue" = _q.Queue()
         dead = threading.Event()
 
@@ -381,13 +389,13 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(f"{len(body):x}\r\n".encode() + body + b"\r\n")
             self.wfile.flush()
 
-        deadline = _time.monotonic() + self.request_timeout_s
+        deadline = self.mono() + self.request_timeout_s
         try:
             for body in fmt.get("start", lambda: [])():
                 chunk(body)
             while True:
                 try:
-                    remaining = deadline - _time.monotonic()
+                    remaining = deadline - self.mono()
                     if remaining <= 0:
                         raise _q.Empty
                     kind, val = q.get(timeout=remaining)
@@ -503,7 +511,6 @@ class _Handler(BaseHTTPRequestHandler):
         sequence (or EOS) never appears in the returned text, stream or
         not (OpenAI semantics) — streaming holds back the longest-possible
         stop tail until it is known not to be one."""
-        import time as _time
         try:
             req = self._read_json()
             if chat:
@@ -577,9 +584,11 @@ class _Handler(BaseHTTPRequestHandler):
                                               "type": "invalid_request_error"}})
         trace_kw, trace_hdrs = self._trace_ctx()
         kw.update(trace_kw)
-        rid = (f"chatcmpl-{_time.time_ns():x}" if chat
-               else f"cmpl-{_time.time_ns():x}")
-        created = int(_time.time())
+        # ns-scale wall stamp + process-wide counter: unique even when an
+        # injected test clock stands still
+        ns = int(self.clock() * 1e9) + next(_RID_SEQ)
+        rid = f"chatcmpl-{ns:x}" if chat else f"cmpl-{ns:x}"
+        created = int(self.clock())
         model_name = req.get("model") or self.engine.cfg.name
         obj = "chat.completion" if chat else "text_completion"
 
@@ -717,10 +726,10 @@ class _Handler(BaseHTTPRequestHandler):
         # (OpenAI's n returns distinct samples, not n copies)
         base_seed = kw.pop("seed", None)
         futs = self.engine.submit_group(tokens, n, seed=base_seed, **kw)
-        deadline = _time.monotonic() + self.request_timeout_s  # SHARED:
+        deadline = self.mono() + self.request_timeout_s  # SHARED:
         # per-future timeouts would let n=16 hold the connection 16x longer
         try:
-            outs = [f.result(timeout=max(0.0, deadline - _time.monotonic()))
+            outs = [f.result(timeout=max(0.0, deadline - self.mono()))
                     for f in futs]
         except FutureTimeout:
             for f in futs:
@@ -831,8 +840,10 @@ class BoundedThreadingHTTPServer(ThreadingHTTPServer):
                b'{"error": "server overloaded"}\n')
     _OBS_RESERVE = 2
 
-    def __init__(self, addr, handler, max_connections: int = 128):
+    def __init__(self, addr, handler, max_connections: int = 128,
+                 mono=time.monotonic):
         super().__init__(addr, handler)
+        self._mono = mono  # deadline source for overflow triage (injectable)
         self.max_connections = max_connections
         self._conn_sem = threading.BoundedSemaphore(max_connections)
         self._obs_sem = threading.BoundedSemaphore(self._OBS_RESERVE)
@@ -888,11 +899,11 @@ class BoundedThreadingHTTPServer(ThreadingHTTPServer):
             # drain so close doesn't RST away the buffered 503 — bounded
             # by wall time AND bytes (a dribbling client must not pin the
             # thread; each recv would otherwise reset the timeout)
-            deadline = time.monotonic() + 1.0
+            deadline = self._mono() + 1.0
             drained = 0
             request.settimeout(0.25)
             try:
-                while time.monotonic() < deadline and drained < 65536:
+                while self._mono() < deadline and drained < 65536:
                     data = request.recv(4096)
                     if not data:
                         break
@@ -914,7 +925,8 @@ class BoundedThreadingHTTPServer(ThreadingHTTPServer):
 
 def serve(engine, port: int = 8000, request_timeout_s: float = 120.0,
           tokenizer=None, allow_adapters: bool = False,
-          max_connections: int = 128):
+          max_connections: int = 128,
+          clock=time.time, mono=time.monotonic):
     # described here, not in the engine: the HTTP-layer shed counter belongs
     # to this server (the engine never sees the rejected connection)
     engine.metrics.describe(
@@ -922,9 +934,11 @@ def serve(engine, port: int = 8000, request_timeout_s: float = 120.0,
         "connections 503-shed at the HTTP concurrency bound")
     handler = type("BoundHandler", (_Handler,),
                    {"engine": engine, "request_timeout_s": request_timeout_s,
-                    "tokenizer": tokenizer, "allow_adapters": allow_adapters})
+                    "tokenizer": tokenizer, "allow_adapters": allow_adapters,
+                    "clock": staticmethod(clock), "mono": staticmethod(mono)})
     httpd = BoundedThreadingHTTPServer(("0.0.0.0", port), handler,
-                                       max_connections=max_connections)
+                                       max_connections=max_connections,
+                                       mono=mono)
     thread = threading.Thread(target=httpd.serve_forever, daemon=True)
     thread.start()
     return httpd

@@ -399,9 +399,14 @@ def _sample(logits: jax.Array, keys: jax.Array, temps: list[float],
 class ServingEngine:
     def __init__(self, cfg: LlamaConfig, params: Params, sc: ServingConfig,
                  metrics: Optional[Metrics] = None, seed: int = 0,
-                 decode_fn=None, mesh=None, tracer: Optional[Tracer] = None):
+                 decode_fn=None, mesh=None, tracer: Optional[Tracer] = None,
+                 perf=None):
         self.cfg = cfg
         self.sc = sc
+        # duration clock for TTFT/ITL/queue-wait stamps and span math
+        # (perf_counter: monotonic, ns resolution); injectable so stress
+        # tests measure with a deterministic clock
+        self._perf = perf if perf is not None else time.perf_counter
         # per-request span source (queue-wait/prefill/decode/finish trees,
         # joined to callers via W3C traceparent); always present so the
         # engine never branches on "is tracing on" — the no-export tracer
@@ -829,7 +834,7 @@ class ServingEngine:
                       max_new_tokens=min(max_new_tokens,
                                          self.sc.cache_len - len(prompt)),
                       rid=uuid.uuid4().hex[:8], future=Future(),
-                      submitted_at=time.perf_counter(),
+                      submitted_at=self._perf(),
                       temperature=float(temperature),
                       top_k=top_k, top_p=float(top_p),
                       presence_penalty=float(presence_penalty),
@@ -978,8 +983,11 @@ class ServingEngine:
     @property
     def queue_depth(self) -> int:
         # counts every pending request: an n-member group is one queue
-        # entry but n requests (the HPA gauge must not undercount)
-        return self._queue.qsize() + self._queued_fanout
+        # entry but n requests (the HPA gauge must not undercount); the
+        # fanout counter is += / -= under _fanout_lock, so read it there too
+        with self._fanout_lock:
+            fanout = self._queued_fanout
+        return self._queue.qsize() + fanout
 
     @property
     def active_slots(self) -> int:
@@ -991,7 +999,7 @@ class ServingEngine:
         occupancy. Read from HTTP handler threads while the engine mutates —
         each field is a single GIL-atomic read, so a snapshot may straddle a
         step (debug surface, not an invariant)."""
-        now = time.perf_counter()
+        now = self._perf()
         slots = []
         for i, s in enumerate(self._slots):
             r = s.request
@@ -1044,7 +1052,7 @@ class ServingEngine:
                 admitted = self._admit()
                 if self.active_slots == 0:
                     if not admitted:
-                        time.sleep(0.002)
+                        self._stop.wait(0.002)
                     continue
                 self._decode_once()
             except Exception as exc:  # noqa: BLE001 — engine must survive bad steps
@@ -1382,7 +1390,7 @@ class ServingEngine:
                           len(members) - len(live))
         if not live:
             return  # every caller gave up while queued
-        dequeued = time.perf_counter()
+        dequeued = self._perf()
         for r in live:
             r.dequeued_at = dequeued
             self.metrics.observe("tpu_serving_queue_wait_seconds",
@@ -1390,7 +1398,7 @@ class ServingEngine:
         try:
             last_logits, single = self._prefill_tokens(req.prompt,
                                                        req.adapter_id)
-            prefill_done = time.perf_counter()
+            prefill_done = self._perf()
             for r in live:
                 r.prefill_done_at = prefill_done
             # one prefill, one ready entry PER live member: each samples
@@ -1514,7 +1522,7 @@ class ServingEngine:
         # the first token becomes caller-visible HERE (the prefill
         # thread sampled it, but _emit below is when it streams), so
         # this is the honest TTFT instant
-        now = time.perf_counter()
+        now = self._perf()
         req.first_token_at = now
         slot.last_emit_at = now
         self.metrics.observe("tpu_serving_ttft_seconds",
@@ -1620,7 +1628,7 @@ class ServingEngine:
         self.metrics.incr("tpu_serving_spec_proposed", k * n_greedy)
 
         advance = np.zeros((b,), np.int32)
-        step_now = time.perf_counter()
+        step_now = self._perf()
         for i, slot in enumerate(slots):
             if not active[i]:
                 continue
@@ -1714,7 +1722,7 @@ class ServingEngine:
             logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
             lp_np = np.asarray(jnp.take_along_axis(
                 logp, jnp.asarray(next_np)[:, None], axis=-1)[:, 0])
-        step_now = time.perf_counter()
+        step_now = self._perf()
         n_active = 0
         for slot_id, slot in enumerate(self._slots):
             if slot.request is None:
@@ -1833,7 +1841,7 @@ class ServingEngine:
         (prefill done->finish, ready-queue wait included) — so their
         durations sum to the recorded request latency."""
         tr = self.tracer
-        now_perf = time.perf_counter()
+        now_perf = self._perf()
         now_wall = tr.clock()
 
         def wall(t_perf: float) -> float:
@@ -1870,7 +1878,7 @@ class ServingEngine:
         req = slot.request
         slot.request = None
         self._slot_adapter[slot_id] = 0
-        latency = time.perf_counter() - req.submitted_at
+        latency = self._perf() - req.submitted_at
         self.metrics.observe("tpu_serving_request_latency_seconds", latency)
         try:
             self._record_request_spans(req, slot, latency)

@@ -152,7 +152,8 @@ class GoodputLedger:
 
     @property
     def open_bucket(self) -> str:
-        return self._open
+        with self._lock:  # _open flips under the lock in switch()
+            return self._open
 
     def switch(self, bucket: str) -> float:
         """Close the open bucket into its accumulator, open ``bucket``.

@@ -1,0 +1,379 @@
+"""Per-checker unit tests on small synthetic snippets (ISSUE 7 satellite):
+for each checker one violating case, one clean case, and one allowlisted
+case — so a checker regression fails HERE on a five-line snippet, not as a
+confusing package-wide diff in test_static_analysis."""
+
+from k8s_runpod_kubelet_tpu.analysis import PackageIndex
+from k8s_runpod_kubelet_tpu.analysis.checkers import (
+    ConfigPlumbingChecker, DeterminismChecker, ExceptionHygieneChecker,
+    LockDisciplineChecker, ObservabilityChecker, ThreadHygieneChecker)
+
+
+def _run(checker, files, resources=None):
+    return checker.run(PackageIndex(files, resources))
+
+
+# -- determinism ---------------------------------------------------------------
+
+BAD_TIME = "import time\n\ndef f():\n    return time.time()\n"
+
+
+def test_determinism_flags_raw_time():
+    r = _run(DeterminismChecker(allowlist={}), {"node/x.py": BAD_TIME})
+    assert len(r.findings) == 1
+    f = r.findings[0]
+    assert f.key == ("node/x.py", "f") and "time.time" in f.message
+
+
+def test_determinism_flags_aliased_import_and_datetime_and_random():
+    src = ("import time as _t\nimport random\nimport datetime\n"
+           "def f():\n"
+           "    a = _t.monotonic()\n"
+           "    b = random.uniform(0, 1)\n"
+           "    c = datetime.datetime.now()\n"
+           "    return a, b, c\n")
+    r = _run(DeterminismChecker(allowlist={}), {"fleet/x.py": src})
+    msgs = " ".join(f.message for f in r.findings)
+    assert len(r.findings) == 3
+    assert "time.monotonic" in msgs and "random.uniform" in msgs \
+        and "datetime.datetime.now" in msgs
+
+
+def test_determinism_clean_cases():
+    src = ("import time\nimport random\n"
+           # default-arg seam: a REFERENCE to time.time, not a call
+           "def g(clock=time.time):\n"
+           "    return clock()\n"
+           # lazy-default seam: the raw call only fires when the injected
+           # param was omitted
+           "def h(now=None):\n"
+           "    now = time.time() if now is None else now\n"
+           "    return now\n"
+           "def i(clock=None):\n"
+           "    if clock is None:\n"
+           "        clock = time.monotonic\n"
+           "    return clock()\n"
+           # seeded-rng construction is the seam, not a draw
+           "def j(seed):\n"
+           "    return random.Random(seed)\n")
+    r = _run(DeterminismChecker(allowlist={}), {"provider/x.py": src})
+    assert r.findings == []
+
+
+def test_determinism_out_of_scope_ml_tier():
+    r = _run(DeterminismChecker(allowlist={}), {"models/x.py": BAD_TIME,
+                                                "ops/y.py": BAD_TIME})
+    assert r.findings == []
+
+
+def test_determinism_allowlisted():
+    r = _run(DeterminismChecker(
+        allowlist={("node/x.py", "f"): "snippet test justification"}),
+        {"node/x.py": BAD_TIME})
+    assert r.findings == [] and len(r.suppressed) == 1
+    assert r.stale_allowlist == []
+
+
+# -- lock-discipline -----------------------------------------------------------
+
+LOCKED_CLASS = (
+    "import threading\n"
+    "class C:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self._n = 0\n"
+    "    def bump(self):\n"
+    "        with self._lock:\n"
+    "            self._n += 1\n"
+    "    def peek(self):\n"
+    "        return self._n\n")
+
+
+def test_lock_discipline_flags_bare_access():
+    r = _run(LockDisciplineChecker(allowlist={}), {"fleet/c.py": LOCKED_CLASS})
+    assert len(r.findings) == 1
+    f = r.findings[0]
+    assert f.key == ("fleet/c.py", "C._n") and "peek" in f.message
+
+
+def test_lock_discipline_clean_cases():
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._n = 0\n"
+        "        self._stop = threading.Event()\n"
+        "    def bump(self):\n"
+        "        with self._lock:\n"
+        "            self._n += 1\n"
+        "    def peek(self):\n"
+        "        with self._lock:\n"
+        "            return self._n\n"
+        # *_locked naming convention: the caller holds the lock
+        "    def _drain_locked(self):\n"
+        "        return self._n\n"
+        # docstring convention
+        "    def helper(self):\n"
+        "        \"\"\"Caller holds self._lock.\"\"\"\n"
+        "        return self._n\n"
+        # Events are self-synchronizing; reading one bare is fine
+        "    def done(self):\n"
+        "        return self._stop.is_set()\n")
+    r = _run(LockDisciplineChecker(allowlist={}), {"fleet/c.py": src})
+    assert r.findings == []
+
+
+def test_lock_discipline_allowlisted():
+    r = _run(LockDisciplineChecker(
+        allowlist={("fleet/c.py", "C._n"): "single-reader invariant (test)"}),
+        {"fleet/c.py": LOCKED_CLASS})
+    assert r.findings == [] and len(r.suppressed) == 1
+
+
+# -- config-plumbing -----------------------------------------------------------
+
+MINI_CONFIG = (
+    "import dataclasses\n"
+    "@dataclasses.dataclass\n"
+    "class Config:\n"
+    "    knob_s: float = 5.0\n"
+    "    name: str = \"x\"\n"
+    "_ENV_MAP = {\"TPU_KNOB_S\": \"knob_s\"}\n")
+MINI_MAIN = (
+    "import argparse\n"
+    "def parse_flags(argv):\n"
+    "    p = argparse.ArgumentParser()\n"
+    "    p.add_argument(\"--knob-s\", dest=\"knob_s\", type=float)\n"
+    "    p.add_argument(\"--name\", default=None)\n"
+    "    return p.parse_args(argv)\n")
+MINI_CONSUMER = "def use(cfg):\n    return cfg.knob_s + len(cfg.name)\n"
+MINI_VALUES = "kubelet:\n  knobSeconds: 5\n  deadKey: 1\n"
+MINI_TEMPLATE = "args:\n  - --knob-s={{ .Values.kubelet.knobSeconds }}\n"
+
+
+def _mini(files_extra=None, values=MINI_VALUES, template=MINI_TEMPLATE):
+    files = {"config.py": MINI_CONFIG, "cmd/main.py": MINI_MAIN,
+             "provider/use.py": MINI_CONSUMER}
+    files.update(files_extra or {})
+    return files, {"helm/values.yaml": values,
+                   "helm/templates/deployment.yaml": template}
+
+
+def test_config_plumbing_violations():
+    files, resources = _mini()
+    r = _run(ConfigPlumbingChecker(allowlist={}), files, resources)
+    keys = {f.key for f in r.findings}
+    # knob_s is fully wired except validate(); name has no env and no helm;
+    # deadKey is a values.yaml knob no template reads
+    assert ("validated", "knob_s") in keys
+    assert ("env", "name") in keys
+    assert ("helm", "name") in keys
+    assert ("helm-dead", "kubelet.deadKey") in keys
+    # wired dimensions must NOT fire
+    assert ("env", "knob_s") not in keys
+    assert ("flag", "knob_s") not in keys
+    assert ("helm", "knob_s") not in keys
+    assert ("read", "knob_s") not in keys
+
+
+def test_config_plumbing_dead_field_and_bad_references():
+    files, resources = _mini(files_extra={"provider/use.py":
+                                          "def use(cfg):\n    return 0\n"})
+    files["config.py"] = MINI_CONFIG.replace(
+        '_ENV_MAP = {"TPU_KNOB_S": "knob_s"}',
+        '_ENV_MAP = {"TPU_KNOB_S": "knob_s", "TPU_TYPO": "no_such_field"}')
+    r = _run(ConfigPlumbingChecker(allowlist={}), files, resources)
+    keys = {f.key for f in r.findings}
+    assert ("read", "knob_s") in keys          # nothing consumes it now
+    assert ("env-unknown", "TPU_TYPO") in keys  # typo'd env mapping
+
+
+def test_config_plumbing_clean_and_allowlisted():
+    files, resources = _mini(
+        values="kubelet:\n  knobSeconds: 5\n",
+        template=MINI_TEMPLATE)
+    files["config.py"] = (
+        "import dataclasses\n"
+        "@dataclasses.dataclass\n"
+        "class Config:\n"
+        "    knob_s: float = 5.0\n"
+        "    name: str = \"x\"\n"
+        "    def validate(self):\n"
+        "        if self.knob_s <= 0:\n"
+        "            raise ValueError(\"knob_s must be > 0\")\n"
+        "        return self\n"
+        "_ENV_MAP = {\"TPU_KNOB_S\": \"knob_s\"}\n")
+    checker = ConfigPlumbingChecker(allowlist={
+        ("env", "name"): "dev-only knob, file/flag only (snippet test)",
+        ("helm", "name"): "dev-only knob, file/flag only (snippet test)",
+    })
+    r = checker.run(PackageIndex(files, resources))
+    assert r.findings == []
+    assert len(r.suppressed) == 2
+    assert r.stale_allowlist == []
+
+
+def test_config_plumbing_helm_wiring_is_boundary_matched():
+    """A surviving `--zones` line must not count `--zone` as helm-wired
+    (prefix spellings are exactly the dead-knob class)."""
+    files = {
+        "config.py": (
+            "import dataclasses\n"
+            "@dataclasses.dataclass\n"
+            "class Config:\n"
+            "    zone: str = \"z\"\n"
+            "    zones: str = \"\"\n"
+            "_ENV_MAP = {\"TPU_ZONE\": \"zone\", \"TPU_ZONES\": \"zones\"}\n"),
+        "cmd/main.py": (
+            "import argparse\n"
+            "def parse_flags(argv):\n"
+            "    p = argparse.ArgumentParser()\n"
+            "    p.add_argument(\"--zone\", default=None)\n"
+            "    p.add_argument(\"--zones\", default=None)\n"
+            "    return p.parse_args(argv)\n"),
+        "provider/use.py": "def use(cfg):\n    return cfg.zone, cfg.zones\n",
+    }
+    resources = {"helm/values.yaml": "kubelet:\n  zones: []\n",
+                 "helm/templates/deployment.yaml":
+                 "args:\n  - --zones={{ join \",\" .Values.kubelet.zones }}\n"}
+    r = _run(ConfigPlumbingChecker(allowlist={}), files, resources)
+    keys = {f.key for f in r.findings}
+    assert ("helm", "zone") in keys      # NOT masked by --zones
+    assert ("helm", "zones") not in keys
+
+
+def test_config_plumbing_getattr_counts_as_read():
+    files, resources = _mini(files_extra={
+        "provider/use.py":
+        "def use(cfg):\n"
+        "    return getattr(cfg, \"knob_s\", 1.0) + len(getattr(cfg, "
+        "\"name\", \"\"))\n"})
+    r = _run(ConfigPlumbingChecker(allowlist={}), files, resources)
+    keys = {f.key for f in r.findings}
+    assert ("read", "knob_s") not in keys and ("read", "name") not in keys
+
+
+# -- observability -------------------------------------------------------------
+
+README_OK = "catalogue: `my_metric` and `my.span` live here\n"
+
+
+def test_observability_violations():
+    src = ("def f(metrics, tracer, name):\n"
+           "    metrics.incr(\"my_metric\")\n"          # no describe
+           "    metrics.observe(\"other_metric\", 1)\n"  # not in README
+           "    tracer.record(\"secret.span\", 0, 1)\n"  # not in README
+           "    tracer.record(name, 0, 1)\n"            # dynamic
+           "    metrics.describe(\"ghost_metric\", \"h\")\n")  # unemitted
+    r = _run(ObservabilityChecker(allowlist={}), {"fleet/m.py": src},
+             {"README.md": README_OK + "`other?` no\n"})
+    keys = {f.key for f in r.findings}
+    assert ("undescribed", "my_metric") in keys
+    assert ("metric", "other_metric") in keys
+    assert ("span", "secret.span") in keys
+    assert ("dynamic", "fleet/m.py", "f") in keys
+    assert ("unemitted", "ghost_metric") in keys
+
+
+def test_observability_clean():
+    src = ("def f(metrics, tracer):\n"
+           "    metrics.describe(\"my_metric\", \"help text\")\n"
+           "    metrics.incr(\"my_metric\")\n"
+           "    tracer.record(\"my.span\", 0, 1)\n"
+           "    stats.record(object(), 0)\n"         # not a tracer receiver
+           "    plan.describe()\n")                  # not a metrics describe
+    r = _run(ObservabilityChecker(allowlist={}), {"fleet/m.py": src},
+             {"README.md": README_OK})
+    assert r.findings == []
+
+
+def test_observability_allowlisted_dynamic():
+    src = ("def f(tracer, kind):\n"
+           "    name = \"a.b\" if kind else \"a.c\"\n"
+           "    tracer.record(name, 0, 1)\n")
+    r = _run(ObservabilityChecker(allowlist={
+        ("dynamic", "fleet/m.py", "f"): "closed two-literal set (test)"}),
+        {"fleet/m.py": src}, {"README.md": "`a.b` `a.c`\n"})
+    assert r.findings == [] and len(r.suppressed) == 1
+
+
+# -- thread-hygiene ------------------------------------------------------------
+
+def test_thread_hygiene_flags_fire_and_forget():
+    src = ("import threading\n"
+           "def f(work):\n"
+           "    threading.Thread(target=work).start()\n")
+    r = _run(ThreadHygieneChecker(allowlist={}), {"node/t.py": src})
+    assert len(r.findings) == 1
+    assert r.findings[0].key == ("node/t.py", "f")
+
+
+def test_thread_hygiene_clean_daemon_and_joined():
+    src = ("import threading\n"
+           "def f(work):\n"
+           "    threading.Thread(target=work, daemon=True).start()\n"
+           "class C:\n"
+           "    def start(self, work):\n"
+           "        self._t = threading.Thread(target=work)\n"
+           "        self._t.start()\n"
+           "    def stop(self):\n"
+           "        self._t.join(timeout=2)\n")
+    r = _run(ThreadHygieneChecker(allowlist={}), {"node/t.py": src})
+    assert r.findings == []
+
+
+def test_thread_hygiene_allowlisted():
+    src = ("import threading\n"
+           "def f(work):\n"
+           "    threading.Thread(target=work).start()\n")
+    r = _run(ThreadHygieneChecker(
+        allowlist={("node/t.py", "f"): "bounded by test harness (snippet)"}),
+        {"node/t.py": src})
+    assert r.findings == [] and len(r.suppressed) == 1
+
+
+# -- exception-hygiene ---------------------------------------------------------
+
+SWALLOW = ("def f():\n"
+           "    try:\n"
+           "        risky()\n"
+           "    except Exception:\n"
+           "        pass\n")
+
+
+def test_exception_hygiene_flags_silent_swallow():
+    r = _run(ExceptionHygieneChecker(allowlist={}), {"cloud/e.py": SWALLOW})
+    assert len(r.findings) == 1
+    assert r.findings[0].key == ("cloud/e.py", "f")
+
+
+def test_exception_hygiene_clean_handlers():
+    src = ("import logging\nlog = logging.getLogger()\n"
+           "def a():\n"
+           "    try:\n"
+           "        risky()\n"
+           "    except Exception:\n"
+           "        log.warning(\"failed\")\n"
+           "def b():\n"
+           "    try:\n"
+           "        risky()\n"
+           "    except Exception as e:\n"
+           "        return {\"error\": str(e)}\n"
+           "def c():\n"
+           "    try:\n"
+           "        risky()\n"
+           "    except ValueError:\n"   # narrow: out of scope
+           "        pass\n")
+    r = _run(ExceptionHygieneChecker(allowlist={}), {"cloud/e.py": src})
+    assert r.findings == []
+
+
+def test_exception_hygiene_allowlisted_and_stale():
+    checker = ExceptionHygieneChecker(allowlist={
+        ("cloud/e.py", "f"): "best-effort cleanup (snippet test)",
+        ("cloud/e.py", "gone"): "refactored away",
+    })
+    r = checker.run(PackageIndex({"cloud/e.py": SWALLOW}))
+    assert r.findings == [] and len(r.suppressed) == 1
+    assert r.stale_allowlist == [("cloud/e.py", "gone")]

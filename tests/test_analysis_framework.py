@@ -1,0 +1,127 @@
+"""Framework tests for graftlint: index caching, report formats, allowlist
+staleness, and the CLI's exit-code contract (ISSUE 7 satellites)."""
+
+import ast
+
+import pytest
+
+from k8s_runpod_kubelet_tpu.analysis import (Checker, Finding, PackageIndex,
+                                             get_package_index, run_checkers)
+from k8s_runpod_kubelet_tpu.analysis.__main__ import main as cli_main
+
+
+class _StubChecker(Checker):
+    name = "stub"
+    description = "flags every module named bad_*.py"
+    allowlist = {}
+
+    def collect(self, index):
+        for fi in index.files():
+            if fi.rel.startswith("bad"):
+                yield Finding(self.name, fi.rel, 1, "<module>",
+                              "flagged by stub", key=(fi.rel, "<module>"))
+
+
+def test_package_index_parses_each_file_once_per_process():
+    """The tentpole's whole point: five lint tests + the CLI share ONE
+    parse. The cached index must be the same object on every call."""
+    assert get_package_index() is get_package_index()
+
+
+def test_index_enclosing_lookups():
+    src = ("class C:\n"
+           "    def m(self):\n"
+           "        x = 1\n"
+           "        return x\n"
+           "\n"
+           "def top():\n"
+           "    pass\n")
+    idx = PackageIndex({"mod.py": src})
+    fi = idx.file("mod.py")
+    assert fi.enclosing_function(3) == "m"
+    assert fi.enclosing_class(3) == "C"
+    assert fi.enclosing_function(7) == "top"
+    assert fi.enclosing_class(7) is None
+    assert fi.enclosing_function(1) == "<module>"
+    assert isinstance(fi.tree, ast.Module)
+
+
+def test_report_formats():
+    f = Finding("stub", "fleet/router.py", 42, "route", "the message",
+                key=("fleet/router.py", "route"))
+    assert f.text() == "fleet/router.py:42 (in route): the message"
+    gh = f.github()
+    assert gh.startswith("::error file=k8s_runpod_kubelet_tpu/fleet/"
+                         "router.py,line=42,")
+    assert "title=graftlint/stub" in gh and "the message" in gh
+
+
+def test_live_vs_suppressed_vs_stale():
+    idx = PackageIndex({"bad_one.py": "x = 1\n", "bad_two.py": "y = 2\n",
+                        "ok.py": "z = 3\n"})
+    checker = _StubChecker(allowlist={
+        ("bad_one.py", "<module>"): "known, justified",
+        ("gone.py", "<module>"): "this handler was refactored away",
+    })
+    result = checker.run(idx)
+    assert [f.file for f in result.findings] == ["bad_two.py"]
+    assert [f.file for f in result.suppressed] == ["bad_one.py"]
+    # the stale entry fails LOUDLY, mirroring
+    # test_allowlist_entries_still_exist
+    assert result.stale_allowlist == [("gone.py", "<module>")]
+    assert not result.ok
+
+
+def test_stale_allowlist_fails_the_suite_even_with_zero_findings():
+    idx = PackageIndex({"ok.py": "z = 3\n"})
+    checker = _StubChecker(allowlist={("typo.py", "<module>"): "typo'd"})
+    suite = run_checkers(idx, [checker])
+    assert not suite.findings          # nothing live...
+    assert not suite.ok                # ...but the suite still fails
+    assert "stale allowlist entry" in suite.render()
+
+
+def test_cli_clean_repo_exits_zero(capsys):
+    assert cli_main([]) == 0
+    out = capsys.readouterr().out
+    assert "0 finding(s)" in out and "0 stale" in out
+
+
+def test_cli_exits_nonzero_on_findings(tmp_path, capsys):
+    pkg = tmp_path / "pkg"
+    (pkg / "node").mkdir(parents=True)
+    (pkg / "node" / "bad.py").write_text(
+        "import time\n\ndef f():\n    return time.time()\n")
+    rc = cli_main(["--package", str(pkg), "--repo-root", str(tmp_path)])
+    assert rc == 1
+    assert "raw time.time() call" in capsys.readouterr().out
+
+
+def test_cli_github_format(tmp_path, capsys):
+    pkg = tmp_path / "pkg"
+    (pkg / "node").mkdir(parents=True)
+    (pkg / "node" / "bad.py").write_text(
+        "import time\n\ndef f():\n    return time.time()\n")
+    rc = cli_main(["--package", str(pkg), "--repo-root", str(tmp_path),
+                   "--format=github"])
+    assert rc == 1
+    assert "::error file=" in capsys.readouterr().out
+
+
+def test_cli_checker_selection(tmp_path, capsys):
+    pkg = tmp_path / "pkg"
+    (pkg / "node").mkdir(parents=True)
+    (pkg / "node" / "bad.py").write_text(
+        "import time\n\ndef f():\n    return time.time()\n")
+    # only thread-hygiene runs -> the determinism finding is invisible
+    rc = cli_main(["--package", str(pkg), "--repo-root", str(tmp_path),
+                   "--checker", "thread-hygiene"])
+    assert rc == 0
+
+
+def test_cli_list(capsys):
+    assert cli_main(["--list"]) == 0
+    out = capsys.readouterr().out
+    for name in ("determinism", "lock-discipline", "config-plumbing",
+                 "observability", "thread-hygiene", "exception-hygiene"):
+        assert name in out

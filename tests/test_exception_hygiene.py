@@ -1,120 +1,39 @@
 """Exception-hygiene lint: no silent broad excepts (ISSUE 3 satellite).
 
-Chaos bugs hide inside ``except Exception: pass``. This AST lint walks every
-broad handler (bare ``except``, ``Exception``, ``BaseException``) in the
-package and requires it to do SOMETHING visible with the failure:
-
-- re-raise, or
-- call a logger (``log.exception``/``error``/``warning`` preferred;
-  ``info``/``debug`` accepted where the handler's docstring/comment justifies
-  the downgrade — the lint cares about silence, not volume), or
-- USE the bound exception value (``except ... as e`` with ``e`` referenced in
-  the body: folding the error into a response/result/error-list is handling,
-  not swallowing).
-
-The handful of TRUE silent swallows that survive are individually allowlisted
-by (file, enclosing function) with a justification — adding a new one is a
-conscious, reviewed act, not an accident.
+Now a thin shim over the shared graftlint framework (ISSUE 7): the rule,
+rationale, and allowlist live in
+``k8s_runpod_kubelet_tpu/analysis/checkers/exception_hygiene.py`` and run
+off the ONE cached package parse every lint test shares — this file keeps
+the historical test names (and the standalone CLI reports the same
+findings as ``python -m k8s_runpod_kubelet_tpu.analysis``).
 """
 
-import ast
-import pathlib
-
-PKG = pathlib.Path(__file__).resolve().parent.parent / "k8s_runpod_kubelet_tpu"
-
-_LOG_METHODS = {"exception", "error", "warning", "info", "debug", "log"}
+from k8s_runpod_kubelet_tpu.analysis import get_package_index
+from k8s_runpod_kubelet_tpu.analysis.checkers import ExceptionHygieneChecker
 
 # (file, enclosing function) -> why a silent swallow is correct THERE.
-# Keep this list short; every entry must carry a real justification.
-ALLOWED_SILENT = {
-    ("gang/exec.py", "remote_kill"):
-        "best-effort disconnect-kill cleanup: worker gone / process exited",
-    ("workloads/serving.py", "_fail_future"):
-        "racing future.cancel(); the future already carries a result",
-    ("workloads/serving.py", "_complete"):
-        "future already resolved elsewhere; nothing to report",
-    ("workloads/serve_main.py", "_triage_overflow"):
-        "metrics bump around a raw-socket 503 must never block the reject",
-    ("ops/attention.py", "_generation"):
-        "backend not initialized; documented fallback to cpu kernels",
-    ("logging_util.py", "_drain"):
-        "the error sink must never raise; drops are counted (self.dropped)",
-}
-
-
-def _is_broad(handler: ast.ExceptHandler) -> bool:
-    t = handler.type
-    if t is None:
-        return True
-    names = []
-    if isinstance(t, ast.Name):
-        names = [t.id]
-    elif isinstance(t, ast.Tuple):
-        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
-    return any(n in ("Exception", "BaseException") for n in names)
-
-
-def _handles(handler: ast.ExceptHandler) -> bool:
-    bound = handler.name  # "e" in `except Exception as e`
-    for node in ast.walk(handler):
-        if isinstance(node, ast.Raise):
-            return True
-        if isinstance(node, ast.Call):
-            f = node.func
-            if isinstance(f, ast.Attribute) and f.attr in _LOG_METHODS:
-                return True
-        if bound and isinstance(node, ast.Name) and node.id == bound \
-                and isinstance(node.ctx, ast.Load):
-            return True  # the error value flows somewhere visible
-    return False
-
-
-def _enclosing_function(tree: ast.AST, lineno: int) -> str:
-    """Name of the innermost def containing the line (or <module>)."""
-    best, best_span = "<module>", float("inf")
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            end = getattr(node, "end_lineno", node.lineno)
-            if node.lineno <= lineno <= end and end - node.lineno < best_span:
-                best, best_span = node.name, end - node.lineno
-    return best
-
-
-def _violations():
-    out = []
-    for path in sorted(PKG.rglob("*.py")):
-        rel = str(path.relative_to(PKG))
-        tree = ast.parse(path.read_text(encoding="utf-8"), filename=rel)
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.ExceptHandler) or not _is_broad(node):
-                continue
-            if _handles(node):
-                continue
-            func = _enclosing_function(tree, node.lineno)
-            if (rel, func) in ALLOWED_SILENT:
-                continue
-            out.append(f"{rel}:{node.lineno} (in {func})")
-    return out
+# Re-exported for anything that imported it from here; the source of truth
+# is the checker class.
+ALLOWED_SILENT = ExceptionHygieneChecker.allowlist
 
 
 def test_no_silent_broad_excepts():
-    violations = _violations()
-    assert not violations, (
+    result = ExceptionHygieneChecker().run(get_package_index())
+    assert not result.findings, (
         "broad except blocks that neither re-raise, nor log, nor use the "
         "caught error — silent swallows are how chaos bugs hide. Either "
         "surface the failure or (rarely, with justification) add the "
-        f"(file, function) to ALLOWED_SILENT: {violations}")
+        "(file, function) to ExceptionHygieneChecker.allowlist: "
+        + "; ".join(f.text() for f in result.findings))
 
 
 def test_allowlist_entries_still_exist():
-    """An allowlist entry whose handler was refactored away is dead weight —
-    and a typo'd entry would silently fail to protect anything."""
-    live: set = set()
-    for path in sorted(PKG.rglob("*.py")):
-        rel = str(path.relative_to(PKG))
-        tree = ast.parse(path.read_text(encoding="utf-8"), filename=rel)
-        for node in ast.walk(tree):
-            if isinstance(node, ast.ExceptHandler) and _is_broad(node):
-                live.add((rel, _enclosing_function(tree, node.lineno)))
-    stale = [k for k in ALLOWED_SILENT if k not in live]
-    assert not stale, f"ALLOWED_SILENT entries with no matching handler: {stale}"
+    """An allowlist entry whose handler was refactored away (or cleaned up
+    to actually handle) is dead weight — and a typo'd entry would silently
+    fail to protect anything. The framework's staleness rule is STRICTER
+    than the original: the entry must suppress a live silent-swallow, not
+    merely point at some broad handler."""
+    result = ExceptionHygieneChecker().run(get_package_index())
+    assert not result.stale_allowlist, (
+        f"allowlist entries with no matching silent swallow: "
+        f"{result.stale_allowlist}")

@@ -1,68 +1,52 @@
 """Metrics-lint: every metric a call site emits must carry a describe() HELP.
 
-Greps the package source for ``incr/set_gauge/observe/time_block`` call
-sites with literal metric names and fails if any name lacks a matching
-``describe()`` somewhere in the package — the README "Observability"
-catalogue stays honest as metrics accumulate (ISSUE 2 satellite). Literal
-names only: a dynamic name can't be linted statically, and this repo uses
-none (asserted below so one can't sneak in unnoticed).
+Now a thin shim over the shared graftlint framework (ISSUE 7): the
+AST-based observability checker subsumes the old regexes (and extends the
+contract to span names + the README catalogue); this file keeps the
+historical test names and the spot-check list, all off the ONE cached
+package parse.
 """
 
-import pathlib
-import re
+import ast
 
-PKG = pathlib.Path(__file__).resolve().parent.parent / "k8s_runpod_kubelet_tpu"
-
-# call sites: metrics.incr("name"...) etc., tolerant of a line break
-# between the paren and the name
-USE_RE = re.compile(
-    r'\.(?:incr|set_gauge|observe|time_block)\(\s*"([a-zA-Z0-9_]+)"', re.S)
-DESCRIBE_RE = re.compile(r'\.describe\(\s*\n?\s*"([a-zA-Z0-9_]+)"', re.S)
-# a metrics call whose first argument is NOT a string literal (dynamic name);
-# the receiver must literally end in "metrics" so the registry's own internal
-# plumbing (e.g. _Timer's self.m.observe(self.name, ...)) stays exempt
-DYNAMIC_RE = re.compile(
-    r'metrics\.(?:incr|set_gauge|observe|time_block)\(\s*[^")\s]', re.S)
+from k8s_runpod_kubelet_tpu.analysis import get_package_index
+from k8s_runpod_kubelet_tpu.analysis.checkers import ObservabilityChecker
 
 
-def _sources():
-    for path in sorted(PKG.rglob("*.py")):
-        yield path, path.read_text(encoding="utf-8")
+def _result():
+    return ObservabilityChecker().run(get_package_index())
 
 
 def test_every_emitted_metric_is_described():
-    used: dict[str, set] = {}
-    described: set[str] = set()
-    for path, src in _sources():
-        for name in USE_RE.findall(src):
-            used.setdefault(name, set()).add(path.name)
-        described.update(DESCRIBE_RE.findall(src))
-    assert used, "lint found no metric call sites — regex rotted?"
-    missing = {n: sorted(files) for n, files in sorted(used.items())
-               if n not in described}
-    assert not missing, (
+    bad = [f for f in _result().findings if f.key[0] == "undescribed"]
+    assert not bad, (
         "metrics emitted without a describe() HELP entry (add one next to "
-        f"the other describes, and catalogue it in README): {missing}")
+        "the other describes, and catalogue it in README): "
+        + "; ".join(f.text() for f in bad))
 
 
 def test_no_dynamic_metric_names():
-    """The lint above only sees literals; a computed metric name would
-    silently escape it. This repo has none — keep it that way (build the
-    variability into labels instead)."""
-    offenders = []
-    for path, src in _sources():
-        for m in DYNAMIC_RE.finditer(src):
-            snippet = src[m.start():m.start() + 60].splitlines()[0]
-            offenders.append(f"{path.name}: {snippet}")
-    assert not offenders, offenders
+    """The lint only sees literals; a computed metric/span name would
+    silently escape it. Keep the set closed (build variability into labels
+    instead) — the rare justified case is allowlisted on the checker."""
+    bad = [f for f in _result().findings if f.key[0] == "dynamic"]
+    assert not bad, "; ".join(f.text() for f in bad)
 
 
 def test_known_metric_families_present():
-    """Spot-check the SLO metrics this PR introduces are described (guards
-    against a rename in one place but not the other)."""
+    """Spot-check the SLO metric families accumulated across ISSUEs 2-6 are
+    still described (guards against a rename in one place but not the
+    other) — collected from the SHARED parse, not a private regex pass."""
     described = set()
-    for _, src in _sources():
-        described.update(DESCRIBE_RE.findall(src))
+    for fi in get_package_index().files():
+        for node in ast.walk(fi.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "describe" \
+                    and node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                described.add(node.args[0].value)
+    assert described, "lint found no describe() call sites — walker rotted?"
     for name in ("tpu_serving_ttft_seconds", "tpu_serving_inter_token_seconds",
                  "tpu_serving_queue_wait_seconds",
                  "tpu_serving_batch_utilization",

@@ -1,0 +1,107 @@
+"""Tier-1 gate: graftlint is clean at HEAD (ISSUE 7 tentpole).
+
+One test per checker (failure granularity: a determinism regression should
+not read as a helm regression), all sharing the ONE cached package parse
+(`get_package_index`), plus the <10s wall budget for the whole suite and a
+regression pin on the breaker-knob wiring the config checker first caught
+(PR 5 precedent: dead knobs reappear; this PR's instance was
+breaker_failure_threshold/breaker_reset_s reachable by no env/flag/helm
+channel).
+"""
+
+import pathlib
+
+import pytest
+
+from k8s_runpod_kubelet_tpu.analysis import (ALL_CHECKERS, get_package_index,
+                                             run_checkers)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _index():
+    return get_package_index()
+
+
+@pytest.mark.parametrize("checker_cls", ALL_CHECKERS,
+                         ids=[c.name for c in ALL_CHECKERS])
+def test_checker_clean_at_head(checker_cls):
+    result = checker_cls().run(_index())
+    assert not result.findings, (
+        f"{checker_cls.name} findings at HEAD — fix them or (with a written "
+        f"justification) allowlist:\n  "
+        + "\n  ".join(f.text() for f in result.findings))
+    assert not result.stale_allowlist, (
+        f"{checker_cls.name} allowlist entries that no longer suppress "
+        f"anything (remove them, or fix the typo — a typo'd entry protects "
+        f"nothing): {result.stale_allowlist}")
+
+
+def test_full_suite_under_wall_budget():
+    """The acceptance bar: one shared parse, all checkers, < 10s on CPU.
+    (Typically <2s; the generous bound keeps slow CI from flaking.)"""
+    suite = run_checkers(_index(), [c() for c in ALL_CHECKERS])
+    assert suite.ok
+    assert suite.files_parsed > 50, "index rotted — most of the package missing"
+    assert suite.elapsed_s < 10.0, (
+        f"analysis took {suite.elapsed_s:.1f}s — the single-parse contract "
+        f"(parse once, run many) has regressed")
+
+
+def test_every_allowlist_entry_is_justified():
+    """An allowlist entry with an empty/trivial justification is an
+    unreviewed suppression — the whole point is the written reason."""
+    for cls in ALL_CHECKERS:
+        for key, why in cls().allowlist.items():
+            assert isinstance(why, str) and len(why) >= 15, (
+                f"{cls.name} allowlist {key!r}: justification too thin "
+                f"({why!r})")
+
+
+def test_breaker_knobs_wired_end_to_end():
+    """Regression pin for the dead-knob instance this PR's config checker
+    caught: the circuit-breaker thresholds existed only in provider-config
+    files — no env var, no flag, no helm key. Pin every channel explicitly
+    so a revert fails here even if the checker's heuristics drift."""
+    from k8s_runpod_kubelet_tpu.config import _ENV_MAP, load
+    assert _ENV_MAP["TPU_BREAKER_FAILURE_THRESHOLD"] == \
+        "breaker_failure_threshold"
+    assert _ENV_MAP["TPU_BREAKER_RESET_S"] == "breaker_reset_s"
+    cfg = load(env={"TPU_BREAKER_FAILURE_THRESHOLD": "9",
+                    "TPU_BREAKER_RESET_S": "7.5"})
+    assert cfg.breaker_failure_threshold == 9
+    assert cfg.breaker_reset_s == 7.5
+
+    from k8s_runpod_kubelet_tpu.cmd.main import parse_flags
+    args = parse_flags(["--breaker-failure-threshold=3",
+                        "--breaker-reset-s=11"])
+    assert args.breaker_failure_threshold == 3
+    assert args.breaker_reset_s == 11.0
+
+    chart = REPO / "helm" / "tpu-virtual-kubelet"
+    values = (chart / "values.yaml").read_text()
+    deployment = (chart / "templates" / "deployment.yaml").read_text()
+    assert "breakerFailureThreshold" in values
+    assert "breakerResetSeconds" in values
+    assert "--breaker-failure-threshold" in deployment
+    assert "--breaker-reset-s" in deployment
+
+
+def test_fleet_heartbeat_interval_reaches_router_template():
+    """Second dead-knob instance: fleet_heartbeat_interval_s had a config
+    field, env var, and router flag — but the router Deployment template
+    never set it, so helm operators could not change the sweep cadence."""
+    chart = REPO / "helm" / "tpu-virtual-kubelet"
+    router = (chart / "templates" / "router-deployment.yaml").read_text()
+    assert "TPU_FLEET_HEARTBEAT_INTERVAL_S" in router
+    assert "heartbeatIntervalSeconds" in (chart / "values.yaml").read_text()
+
+
+def test_kubelet_api_token_reaches_secret_template():
+    """Third instance: values.yaml documented the credentials secret's
+    KUBELET_API_TOKEN key, but secret.yaml never rendered it — setting
+    credentials.kubeletApiToken changed nothing."""
+    chart = REPO / "helm" / "tpu-virtual-kubelet"
+    secret = (chart / "templates" / "secret.yaml").read_text()
+    assert "KUBELET_API_TOKEN" in secret
+    assert "kubeletApiToken" in (chart / "values.yaml").read_text()
