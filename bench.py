@@ -33,6 +33,11 @@ Usage:
                              # throughput is weight-value-independent)
   python bench.py --econ     # serving-economics A/B matrix: int8-KV,
                              # donation, speculation on/off (needs TPU)
+  python bench.py --paged-attn  # paged-attention decode microbench: the
+                             # page-table-gather kernel vs contiguous
+                             # decode attention at the same geometry
+                             # (CPU runs the reference path; the kernel
+                             # claim needs a TPU)
   python bench.py --mfu-sweep  # training MFU levers: remat none/dots,
                              # batch, 530M width (needs TPU)
   python bench.py --attn-tune  # flash block-size grid at the training
@@ -95,6 +100,9 @@ _STAGED_QUEUE = [
     ("headline", ["--run", "--expect-tpu"], 1800),
     ("mfu_sweep", ["--mfu-sweep"], 3600),
     ("attn_tune", ["--attn-tune"], 2400),
+    # paged-attention decode (ISSUE 8): the serving engine's prefix-pool
+    # layout driven through the Pallas kernel vs contiguous decode
+    ("paged_attn", ["--paged-attn"], 1800),
     ("serve_8b", ["--serve", "--model", "llama3-8b", "--int8", "--kv-int8"],
      2400),
     # int4 weights via the Pallas unpack kernel (ops/int4_matmul.py):
@@ -344,6 +352,73 @@ def run_attn_bench() -> int:
     return 0
 
 
+def run_paged_attn_bench() -> int:
+    """Paged-attention decode microbench (ISSUE 8): the page-table-gather
+    kernel over the serving engine's paged prefix-pool layout vs
+    contiguous decode attention at the same geometry (llama3-8b heads on
+    TPU). One JSON line per sequence length, carrying kv_page_bytes (per
+    layer, K+V) so the row ties back to the pool-sizing knobs. CPU runs
+    the pure-jnp reference path — a shape/ratio smoke, not a kernel
+    claim; the watcher queues this step for the chip."""
+    _force_platform_from_env()
+    import jax
+    import jax.numpy as jnp
+    from k8s_runpod_kubelet_tpu.ops.attention import (_attention_xla,
+                                                      paged_attention)
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        b, hq, hkv, d, t = 8, 32, 8, 128, 64
+        dtype, seqs, iters = jnp.bfloat16, (2048, 8192), 50
+    else:
+        b, hq, hkv, d, t = 4, 8, 2, 128, 8
+        dtype, seqs, iters = jnp.float32, (256,), 10
+    key = jax.random.PRNGKey(0)
+
+    def timed(f, iters=iters):
+        f().block_until_ready()  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = f()
+        out.block_until_ready()
+        return (time.perf_counter() - t0) / iters
+
+    for s in seqs:
+        n = s // t
+        n_pages = n * b
+        ks = jax.random.split(key, 3)
+        q = jax.random.normal(ks[0], (b, hq, d), dtype)
+        k_pages = jax.random.normal(ks[1], (n_pages, t, hkv, d), dtype)
+        v_pages = jax.random.normal(ks[2], (n_pages, t, hkv, d), dtype)
+        # a shuffled table: the kernel must win THROUGH the indirection,
+        # not because pages happen to be laid out contiguously
+        import numpy as _np
+        pt = jnp.asarray(_np.random.default_rng(0).permutation(n_pages)
+                         .reshape(b, n), jnp.int32)
+        lengths = jnp.full((b,), s, jnp.int32)
+        paged_s = timed(lambda: paged_attention(
+            q, k_pages, v_pages, pt, lengths, use_pallas=on_tpu))
+        # contiguous baseline: same data pre-gathered to (B, Hkv, S, D),
+        # causal decode attention at the last position
+        kc = k_pages[pt].reshape(b, s, hkv, d).transpose(0, 2, 1, 3)
+        vc = v_pages[pt].reshape(b, s, hkv, d).transpose(0, 2, 1, 3)
+        qc = q[:, :, None, :]
+        contig = jax.jit(lambda qq, kk, vv, _s=s: _attention_xla(
+            qq, kk, vv, causal=True, sm_scale=d ** -0.5, q_offset=_s - 1))
+        contig_s = timed(lambda: contig(qc, kc, vc))
+        _emit({"metric": "paged_attn_decode_us",
+               "value": round(paged_s * 1e6, 1), "unit": "us/step",
+               "contiguous_us": round(contig_s * 1e6, 1),
+               "paged_over_contiguous": round(paged_s / contig_s, 3),
+               "seq_len": s, "page_tokens": t,
+               "kv_page_bytes": 2 * t * hkv * d * dtype(0).nbytes,
+               "batch": b, "q_heads": hq, "kv_heads": hkv, "head_dim": d,
+               "pallas": bool(on_tpu),
+               "dtype": dtype.__name__,
+               "backend": jax.default_backend()})
+    return 0
+
+
 def run_ring_flash_check() -> int:
     """TPU verification for ring flash attention (ROUND3_NOTES step 6b).
 
@@ -547,6 +622,13 @@ def serve_once(model: str, *, slots: int, n_req: int, new_toks: int,
         if speculate_k:
             accepted = engine.metrics.get_counter("tpu_serving_spec_accepted")
             proposed = engine.metrics.get_counter("tpu_serving_spec_proposed")
+        # paged prefix pool (ISSUE 8): the bench prompts share long heads
+        # ([1, 2, 3...] prefixes), so the cross-request hit rate here is a
+        # real number, not a synthetic one
+        kv_stats = engine.prefix_cache_stats()
+        pc_hits = engine.metrics.get_counter("tpu_serving_prefix_cache_hits")
+        pc_misses = engine.metrics.get_counter(
+            "tpu_serving_prefix_cache_misses")
     finally:
         engine.stop()
     toks = sum(len(o["tokens"]) for o in outs)
@@ -570,6 +652,11 @@ def serve_once(model: str, *, slots: int, n_req: int, new_toks: int,
         "peak_queue_depth": peak_queue,
         "int8": int8, "int4": int4, "kv_int8": kv_int8,
         "speculate_k": speculate_k, "donate_cache": donate,
+        "kv_page_tokens": kv_stats.get("page_tokens"),
+        "kv_page_bytes": kv_stats.get("page_bytes", 0),
+        "kv_pages_shared": kv_stats.get("pages_shared", 0),
+        "prefix_hit_rate": (round(pc_hits / (pc_hits + pc_misses), 3)
+                            if pc_hits + pc_misses else None),
         "model": cfg.name, "params": cfg.param_count,
         "backend": jax.default_backend(),
     }
@@ -1583,6 +1670,8 @@ def main() -> int:
         return run_mfu_sweep()
     if "--attn-tune" in sys.argv:
         return run_attn_tune()
+    if "--paged-attn" in sys.argv:
+        return run_paged_attn_bench()
     if "--ring-flash" in sys.argv:
         return run_ring_flash_check()
     if "--spec-drift" in sys.argv:

@@ -41,7 +41,8 @@ from typing import Iterable, Optional
 from ..core import Checker, Finding
 from ..index import PackageIndex
 
-_FLAG_FILES = ("cmd/main.py", "fleet/router_main.py")
+_FLAG_FILES = ("cmd/main.py", "fleet/router_main.py",
+               "workloads/serve_main.py")
 # must END on an alnum: "TPU_FLEET_*" in a template comment is prose, not
 # an env name
 _ENV_NAME_RE = re.compile(r"\b(?:TPU|KUBELET)_[A-Z0-9_]*[A-Z0-9]\b")

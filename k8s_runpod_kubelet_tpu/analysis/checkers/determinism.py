@@ -34,10 +34,11 @@ from typing import Iterable
 from ..core import Checker, Finding
 from ..index import PackageIndex
 
-SCOPED_DIRS = ("cloud/", "fleet/", "node/", "provider/", "kube/", "gang/")
+SCOPED_DIRS = ("cloud/", "fleet/", "node/", "provider/", "kube/", "gang/",
+               "workloads/serving/")
 SCOPED_FILES = {
     "config.py", "health.py", "tracing.py", "metrics.py", "logging_util.py",
-    "workloads/serving.py", "workloads/serve_main.py", "workloads/telemetry.py",
+    "workloads/serve_main.py", "workloads/telemetry.py",
 }
 
 _TIME_BANNED = {"time", "time_ns", "monotonic", "monotonic_ns",

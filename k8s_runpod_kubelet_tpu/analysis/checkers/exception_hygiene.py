@@ -66,9 +66,9 @@ class ExceptionHygieneChecker(Checker):
         ("gang/exec.py", "remote_kill"):
             "best-effort disconnect-kill cleanup: worker gone / process "
             "exited",
-        ("workloads/serving.py", "_fail_future"):
+        ("workloads/serving/scheduler.py", "_fail_future"):
             "racing future.cancel(); the future already carries a result",
-        ("workloads/serving.py", "_complete"):
+        ("workloads/serving/engine.py", "_complete"):
             "future already resolved elsewhere; nothing to report",
         ("workloads/serve_main.py", "_triage_overflow"):
             "metrics bump around a raw-socket 503 must never block the "

@@ -75,7 +75,7 @@ class LockDisciplineChecker(Checker):
 
     # (file, "Class.attr") -> why the unlocked accesses are correct.
     allowlist = {
-        ("workloads/serving.py", "ServingEngine._adapters"):
+        ("workloads/serving/engine.py", "ServingEngine._adapters"):
             "None-vs-dict is fixed at construction (lora_rank gate), so the "
             "`is None` reads are stable; the leaf arrays inside are only "
             "REPLACED wholesale under _adapter_lock (register_adapter), and "
@@ -83,7 +83,7 @@ class LockDisciplineChecker(Checker):
             "reference they observe for that step — per-step staleness is "
             "the documented multi-LoRA contract, a lock here would serialize "
             "decode against adapter registration",
-        ("workloads/serving.py", "ServingEngine._transit"):
+        ("workloads/serving/engine.py", "ServingEngine._transit"):
             "debug_snapshot is the documented lock-free statusz surface "
             "(its docstring: single GIL-atomic reads, may straddle a step); "
             "the authoritative drain check (`drained`) reads _transit under "

@@ -117,6 +117,18 @@ class Config:
     straggler_factor: float = 3.0
     stall_timeout_s: float = 300.0
 
+    # serving: paged KV prefix cache (ISSUE 8). kv_page_tokens is the
+    # pool's allocation/trie-match granule (tokens per KV page);
+    # kv_pool_pages sizes the preallocated HBM arena (0 = auto: one
+    # decode-cache's worth); prefix_cache_enabled gates the cross-request
+    # radix trie (register_prefix keeps working either way). Flags live on
+    # workloads/serve_main.py; the helm chart wires the TPU_KV_* env onto
+    # the router, whose autoscaler passes them through to the serving pods
+    # it creates.
+    kv_page_tokens: int = 16
+    kv_pool_pages: int = 0
+    prefix_cache_enabled: bool = True
+
     # elastic gang training (ISSUE 6). elastic_resize is the global gate for
     # the tpu.dev/elastic pod annotation: on partial host loss an elastic
     # gang is relaunched on the SURVIVING workers (mesh rebuilt at the
@@ -223,6 +235,10 @@ class Config:
             errs.append("stall_timeout_s must be > 0")
         if self.elastic_grow_grace_s < 0:
             errs.append("elastic_grow_grace_s must be >= 0")
+        if self.kv_page_tokens < 1:
+            errs.append("kv_page_tokens must be >= 1 (tokens per KV page)")
+        if self.kv_pool_pages < 0:
+            errs.append("kv_pool_pages must be >= 0 (0 = auto-size)")
         if errs:
             raise ValueError("invalid config: " + "; ".join(errs))
         return self
@@ -262,6 +278,9 @@ _ENV_MAP = {
     "TPU_FLEET_MAX_REPLICAS": "fleet_max_replicas",
     "TPU_FLEET_SCALE_UP_COOLDOWN_S": "fleet_scale_up_cooldown_s",
     "TPU_FLEET_SCALE_DOWN_COOLDOWN_S": "fleet_scale_down_cooldown_s",
+    "TPU_KV_PAGE_TOKENS": "kv_page_tokens",
+    "TPU_KV_POOL_PAGES": "kv_pool_pages",
+    "TPU_PREFIX_CACHE_ENABLED": "prefix_cache_enabled",
     "TPU_TELEMETRY_PORT": "telemetry_port",
     "TPU_STRAGGLER_FACTOR": "straggler_factor",
     "TPU_STALL_TIMEOUT_S": "stall_timeout_s",

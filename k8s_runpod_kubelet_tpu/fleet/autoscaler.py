@@ -25,6 +25,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import math
+import os
 import threading
 import time
 from typing import Callable, Optional
@@ -33,6 +34,13 @@ from ..cloud.transport import CircuitOpenError, TransportError
 from .registry import DRAINING, Replica, ReplicaRegistry
 
 log = logging.getLogger(__name__)
+
+# Serving knobs the autoscaler forwards from its own environment into
+# every serving pod it creates (the helm chart sets them on the router
+# deployment; serve_main reads them via config._ENV_MAP) — the wiring
+# path for the paged-KV prefix cache (ISSUE 8) at fleet scale.
+SERVING_PASSTHROUGH_ENV = ("TPU_KV_PAGE_TOKENS", "TPU_KV_POOL_PAGES",
+                           "TPU_PREFIX_CACHE_ENABLED")
 
 
 @dataclasses.dataclass
@@ -92,15 +100,19 @@ class KubePodScaler:
     def _pod(self, name: str) -> dict:
         if self.template_fn is not None:
             return self.template_fn(name)
+        container = {"name": "serve", "image": self.image,
+                     "resources": {"limits": {
+                         "google.com/tpu": str(self.chips)}}}
+        env = [{"name": k, "value": os.environ[k]}
+               for k in SERVING_PASSTHROUGH_ENV if os.environ.get(k)]
+        if env:
+            container["env"] = env
         return {"apiVersion": "v1", "kind": "Pod",
                 "metadata": {"name": name, "namespace": self.namespace,
                              "labels": {"app": "tpu-serving",
                                         "tpu.dev/fleet": "serving"}},
                 "spec": {"nodeName": self.node_name,
-                         "containers": [{
-                             "name": "serve", "image": self.image,
-                             "resources": {"limits": {
-                                 "google.com/tpu": str(self.chips)}}}]}}
+                         "containers": [container]}}
 
     def create(self) -> str:
         self._seq += 1

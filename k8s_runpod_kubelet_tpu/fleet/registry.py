@@ -374,6 +374,13 @@ class ReplicaReporter:
         recent = sorted(self.engine.metrics.get_observations(
             "tpu_serving_ttft_seconds")[-100:])
         p95 = recent[max(0, int(len(recent) * 0.95) - 1)] if recent else 0.0
+        # prefix-cache hit rate (paged KV pool, ISSUE 8): the per-replica
+        # signal that shows whether the router's rendezvous prefix-affinity
+        # is paying off — fleet_summary.py renders it per replica
+        hits = self.engine.metrics.get_counter("tpu_serving_prefix_cache_hits")
+        misses = self.engine.metrics.get_counter(
+            "tpu_serving_prefix_cache_misses")
+        hit_rate = hits / (hits + misses) if hits + misses else 0.0
         return {
             "free_slots": snap["max_slots"] - snap["active_slots"],
             "active_slots": snap["active_slots"],
@@ -389,6 +396,7 @@ class ReplicaReporter:
             "max_queue_depth": self.engine.sc.max_queue_depth,
             "kv_cache_tokens": snap["kv_cache_tokens"],
             "ttft_p95_s": p95,
+            "prefix_hit_rate": round(hit_rate, 4),
             "draining": self.engine.draining,
         }
 

@@ -27,7 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
-from ..ops import apply_rope, flash_attention, ring_attention, rms_norm, rope_frequencies
+from ..ops import (apply_rope, flash_attention, paged_attention,
+                   ring_attention, rms_norm, rope_frequencies)
 from .moe import moe_mlp
 from ..parallel.mesh import AXES
 from ..parallel.pipeline import pipeline_spmd, pipeline_stages
@@ -1533,6 +1534,92 @@ class LlamaModel:
         cache = dict(cache)
         cache["index"] = jnp.where(active, cache["index"] + 1, cache["index"])
         return logits[:, 0], cache
+
+    def init_paged_arena(self, n_pages: int, page_tokens: int) -> Params:
+        """K/V page arena for ``paged_decode_step``: (L, P, T, h, d) per
+        section, page-major — page p's T positions are one contiguous tile,
+        and a sequence is a page-table row over the shared pool (the
+        serving engine's prefix arena uses the identical layout, so pages
+        move between the two without reshapes; kv_cache_pspec applies
+        verbatim for TP). Standard dense-attention layouts only."""
+        cfg = self.cfg
+        if cfg.is_mla or cfg.sliding_window is not None:
+            raise ValueError("paged decode covers standard full-attention "
+                             "K/V layouts (no MLA / sliding-window yet)")
+        shape = (cfg.n_layers, n_pages, page_tokens, cfg.n_kv_heads,
+                 cfg.head_dim_)
+        return {"k": jnp.zeros(shape, cfg.dtype),
+                "v": jnp.zeros(shape, cfg.dtype)}
+
+    @_with_int4_mesh
+    def paged_decode_step(self, params: Params, token: jax.Array,
+                          arena: Params, page_tables: jax.Array,
+                          lengths: jax.Array,
+                          active: Optional[jax.Array] = None, *,
+                          use_pallas: Optional[bool] = None,
+                          interpret: bool = False
+                          ) -> tuple[jax.Array, Params, jax.Array]:
+        """One decode token per slot over PAGED KV (ops.paged_attention):
+        token (B,) -> (logits (B, V) f32, arena, lengths'). Slot b's KV
+        lives in pages page_tables[b] of the shared arena; the new token's
+        K/V is written at logical position lengths[b] (page pos//T, offset
+        pos%%T — the caller allocates a fresh page whenever a slot's
+        length crosses a page boundary, so the target entry is always
+        this slot's private tail page while matched PREFIX pages stay
+        shared copy-on-write). ``active`` freezes slots exactly like
+        decode_step. Token-identical to decode_step on the same history
+        (tests pin it); this is the decode path disaggregated prefill/
+        decode (ROADMAP item 2) ships KV pages into."""
+        cfg = self.cfg
+        if cfg.is_mla or cfg.sliding_window is not None:
+            raise ValueError("paged decode covers standard full-attention "
+                             "K/V layouts (no MLA / sliding-window yet)")
+        b = token.shape[0]
+        if active is None:
+            active = jnp.ones((b,), bool)
+        t = arena["k"].shape[2]
+        positions = lengths                                    # (B,) write pos
+        pages_b = jnp.take_along_axis(
+            page_tables, (positions // t)[:, None], axis=1)[:, 0]
+        offs = positions % t
+        cos, sin = _rope_for(_rope_tables(cfg), None)
+        x = _embed(params, token[:, None], cfg, self.mesh)     # (B, 1, E)
+        att_len = positions + 1  # the just-written token attends itself
+        act = active[:, None, None]
+
+        def block(y, inputs):
+            lp, kp, vp = inputs["lp"], inputs["k"], inputs["v"]
+            h = rms_norm(y, _norm_w(lp["attn_norm"], cfg), cfg.norm_eps)
+            q, k, v = _qkv(h, lp, cfg, b, 1)
+            if cfg.qk_norm:
+                q = rms_norm(q, _norm_w(lp["q_norm"], cfg), cfg.norm_eps)
+                k = rms_norm(k, _norm_w(lp["k_norm"], cfg), cfg.norm_eps)
+            q = apply_rope(q, cos, sin, positions[:, None])
+            k = apply_rope(k, cos, sin, positions[:, None])
+            old_k = kp[pages_b, offs]                          # (B, h, d)
+            old_v = vp[pages_b, offs]
+            kp = kp.at[pages_b, offs].set(jnp.where(act, k[:, 0], old_k))
+            vp = vp.at[pages_b, offs].set(jnp.where(act, v[:, 0], old_v))
+            o = paged_attention(q[:, 0], kp, vp, page_tables, att_len,
+                                sm_scale=cfg.sm_scale,
+                                logit_soft_cap=cfg.attn_logit_softcap,
+                                use_pallas=use_pallas, interpret=interpret)
+            o = o.reshape(b, 1, cfg.n_heads * cfg.head_dim_).astype(cfg.dtype)
+            o = _mm(o, lp["wo"], cfg.dtype)
+            if cfg.post_norms:
+                o = rms_norm(o, _norm_w(lp["attn_post_norm"], cfg),
+                             cfg.norm_eps)
+            y = y + o
+            y, _ = _mlp_block(y, lp, cfg, self.mesh, train=False)
+            return y, {"k": kp, "v": vp}
+
+        xs = {"lp": _group_layers(params["layers"], 1),
+              "k": arena["k"], "v": arena["v"]}
+        x, new_kv = jax.lax.scan(block, x, xs)
+        x = rms_norm(x, _norm_w(params["final_norm"], cfg), cfg.norm_eps)
+        logits = _head_logits(x, params, cfg).astype(jnp.float32)[:, 0]
+        new_lengths = jnp.where(active, lengths + 1, lengths)
+        return logits, {"k": new_kv["k"], "v": new_kv["v"]}, new_lengths
 
     @_with_int4_mesh
     def verify_step(self, params: Params, tokens: jax.Array, cache: Params,

@@ -10,11 +10,11 @@ Every op has two paths:
 
 from .rmsnorm import rms_norm
 from .rope import apply_rope, rope_frequencies
-from .attention import flash_attention
+from .attention import flash_attention, paged_attention
 from .ring_attention import ring_attention
 from .fused_ce import fused_cross_entropy
 from .mla import mla_attention, mla_decode_step
 
 __all__ = ["rms_norm", "apply_rope", "rope_frequencies", "flash_attention",
-           "ring_attention", "fused_cross_entropy", "mla_attention",
-           "mla_decode_step"]
+           "paged_attention", "ring_attention", "fused_cross_entropy",
+           "mla_attention", "mla_decode_step"]

@@ -460,6 +460,188 @@ def _flash_diff_bwd(causal, scale, block_q, block_k, interpret, window,
 _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
 
 
+# -- paged-attention decode kernel (ISSUE 8) ----------------------------------
+
+def _paged_attention_xla(q, k_pages, v_pages, page_table, lengths, *,
+                         sm_scale: float,
+                         logit_soft_cap: Optional[float] = None) -> jax.Array:
+    """Pure-jnp reference path: gather the page table back into a
+    contiguous (B, S, Hkv, D) view and run ordinary masked decode
+    attention. Identical math to the Pallas kernel (f32 statistics, input
+    dtype matmuls via f32 here — decode is 1 query so precision is cheap);
+    also the CPU/odd-shape fallback."""
+    b, hq, d = q.shape
+    _, t, hkv, _ = k_pages.shape
+    n = page_table.shape[1]
+    group = hq // hkv
+    k = k_pages[page_table].reshape(b, n * t, hkv, d)      # (B, S, Hkv, D)
+    v = v_pages[page_table].reshape(b, n * t, hkv, d)
+    qg = (q.astype(jnp.float32) * sm_scale).reshape(b, hkv, group, d)
+    s = jnp.einsum("bhgd,bLhd->bhgL", qg, k.astype(jnp.float32))
+    if logit_soft_cap is not None:
+        s = jnp.tanh(s / logit_soft_cap) * logit_soft_cap
+    valid = jnp.arange(n * t)[None, :] < lengths[:, None]  # (B, S)
+    s = jnp.where(valid[:, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhgL,bLhd->bhgd", p, v.astype(jnp.float32))
+    return o.reshape(b, hq, d).astype(q.dtype)
+
+
+def _paged_fwd_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                      acc_ref, m_ref, l_ref, *, page_tokens: int,
+                      num_pages: int, sm_scale: float,
+                      soft_cap: Optional[float] = None):
+    """One (batch row, kv head, page) program: online-softmax accumulate
+    the page's contribution. The PAGE TABLE is scalar-prefetched, so the
+    BlockSpec index map DMAs exactly the page this program needs — the
+    K/V gather over non-contiguous HBM pages IS the index map; no
+    contiguous copy of the sequence ever exists."""
+    import jax.experimental.pallas as pl  # noqa: F401 (kernel-only import)
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    length = len_ref[b]
+
+    @pl.when(i * page_tokens < length)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale      # (Gp, D)
+        kc = k_ref[0, :, 0].astype(jnp.float32)             # (T, D)
+        vc = v_ref[0, :, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kc, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # (Gp, T)
+        if soft_cap is not None:
+            s = jnp.tanh(s / soft_cap) * soft_cap
+        pos = i * page_tokens + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(pos < length, s, NEG_INF)
+        m_prev = m_ref[:, :1]                               # (Gp, 1)
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+            p, vc, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(i == num_pages - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def _paged_attention_pallas(q, k_pages, v_pages, page_table, lengths,
+                            scale: float, interpret: bool,
+                            soft_cap: Optional[float] = None) -> jax.Array:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, hq, d = q.shape
+    _, t, hkv, _ = k_pages.shape
+    n = page_table.shape[1]
+    group = hq // hkv
+    # pad the GQA group to a full sublane tile (f32 min 8): padded q rows
+    # are zeros, their outputs are sliced off — wasted lanes, not wrong math
+    gp = -(-group // 8) * 8
+    qr = q.reshape(b, hkv, group, d)
+    if gp != group:
+        qr = jnp.pad(qr, ((0, 0), (0, 0), (0, gp - group), (0, 0)))
+    kernel = functools.partial(_paged_fwd_kernel, page_tokens=t, num_pages=n,
+                               sm_scale=scale, soft_cap=soft_cap)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # page_table, lengths
+        grid=(b, hkv, n),
+        in_specs=[
+            pl.BlockSpec((1, 1, gp, d),
+                         lambda bb, h, i, pt, ln: (bb, h, 0, 0)),
+            # THE paged gather: the k/v block for program (b, h, i) is
+            # page page_table[b, i] — non-contiguous pages stream through
+            # VMEM without ever materializing a contiguous sequence
+            pl.BlockSpec((1, t, 1, d),
+                         lambda bb, h, i, pt, ln: (pt[bb, i], 0, h, 0)),
+            pl.BlockSpec((1, t, 1, d),
+                         lambda bb, h, i, pt, ln: (pt[bb, i], 0, h, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, gp, d),
+                               lambda bb, h, i, pt, ln: (bb, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((gp, d), jnp.float32),
+            pltpu.VMEM((gp, _STATS_LANES), jnp.float32),
+            pltpu.VMEM((gp, _STATS_LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, gp, d), q.dtype),
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      qr, k_pages, v_pages)
+    return out[:, :, :group].reshape(b, hq, d)
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale", "use_pallas",
+                                             "interpret", "logit_soft_cap"))
+def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
+                    page_table: jax.Array, lengths: jax.Array, *,
+                    sm_scale: Optional[float] = None,
+                    use_pallas: Optional[bool] = None,
+                    interpret: bool = False,
+                    logit_soft_cap: Optional[float] = None) -> jax.Array:
+    """Paged-attention DECODE: one query token per sequence attends over
+    KV scattered across fixed-size pages of a shared arena (the serving
+    engine's paged prefix pool; ROADMAP item 2's transfer unit).
+
+    Shapes: q (B, Hq, D); k_pages/v_pages (P, T, Hkv, D) — the whole
+    arena, page-major; page_table (B, N) int32 page ids, row b's logical
+    positions [i*T, (i+1)*T) living in page page_table[b, i]; lengths (B,)
+    valid token counts (position length-1 is the newest written KV).
+    Entries of page_table at/after ceil(length/T) are never READ for
+    attention but must still be VALID page indices (the grid touches them;
+    callers keep them 0). Returns (B, Hq, D) in q's dtype.
+
+    The Pallas kernel scalar-prefetches the page table so each (b, head,
+    page) program DMAs its page directly HBM->VMEM (no contiguous copy of
+    the sequence exists anywhere), accumulating online softmax across the
+    page grid dimension. GQA is native: the group's q heads ride one
+    program, padded to a full sublane tile. Falls back to the pure-jnp
+    gather reference off-TPU or when (T, D) don't tile (T % 8, D % 128).
+
+    Composes with TP sharding exactly like the contiguous cache:
+    k/v_pages shard the kv-heads axis (kv_cache_pspec — same rank/axis as
+    the engine cache), q/o shard heads; shard_map the call over ``tensor``
+    with the page table and lengths replicated."""
+    b, hq, d = q.shape
+    _, t, hkv, _ = k_pages.shape
+    if hq % hkv != 0:
+        raise ValueError(f"Hq={hq} not a multiple of Hkv={hkv}")
+    if v_pages.shape != k_pages.shape:
+        raise ValueError(f"k_pages {k_pages.shape} != v_pages "
+                         f"{v_pages.shape}")
+    if logit_soft_cap is not None and logit_soft_cap <= 0:
+        raise ValueError(f"logit_soft_cap must be positive, "
+                         f"got {logit_soft_cap}")
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    pallas_ok = (_use_pallas(use_pallas) or interpret) \
+        and d % 128 == 0 and t % 8 == 0
+    if not pallas_ok:
+        return _paged_attention_xla(q, k_pages, v_pages, page_table, lengths,
+                                    sm_scale=scale,
+                                    logit_soft_cap=logit_soft_cap)
+    return _paged_attention_pallas(q, k_pages, v_pages, page_table, lengths,
+                                   scale, interpret, logit_soft_cap)
+
+
 @functools.partial(jax.jit, static_argnames=("causal", "sm_scale", "use_pallas",
                                              "block_q", "block_k", "interpret",
                                              "sliding_window",

@@ -1014,6 +1014,28 @@ def main(argv=None) -> int:
                         "queued get 429 + Retry-After instead of an "
                         "unbounded wait (0 = unbounded; HPA still scales "
                         "on tpu_serving_queue_depth)")
+    p.add_argument("--config", default="",
+                   help="provider-config YAML: serving reads the paged-KV "
+                        "knobs (kv_page_tokens / kv_pool_pages / "
+                        "prefix_cache_enabled) from it; TPU_KV_* env "
+                        "overrides the file, these flags override both")
+    p.add_argument("--kv-page-tokens", type=int, default=None,
+                   dest="kv_page_tokens",
+                   help="tokens per KV page in the paged prefix pool (the "
+                        "allocation and trie-match granule; default from "
+                        "config/TPU_KV_PAGE_TOKENS, 16)")
+    p.add_argument("--kv-pool-pages", type=int, default=None,
+                   dest="kv_pool_pages",
+                   help="pages in the preallocated prefix arena (0 = auto: "
+                        "one decode-cache's worth; default from config/"
+                        "TPU_KV_POOL_PAGES)")
+    p.add_argument("--prefix-cache", default=None, choices=["on", "off"],
+                   dest="prefix_cache_enabled",
+                   help="cross-request paged prefix cache: every prompt "
+                        "matches a radix trie of shared KV pages and skips "
+                        "the matched span's prefill (default from config/"
+                        "TPU_PREFIX_CACHE_ENABLED, on; register_prefix "
+                        "works either way)")
     p.add_argument("--hf-checkpoint", default="",
                    help="HuggingFace model directory (safetensors/bin) to "
                         "load real weights from; empty = random init")
@@ -1038,8 +1060,19 @@ def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO)
 
     import jax
+    from ..config import load as load_provider_config
     from ..models import init_params
     from .serving import ServingConfig, ServingEngine
+    # paged-KV knob precedence: flag > TPU_KV_* env > --config file >
+    # defaults — load() already applies env-over-file, flags land here
+    base_cfg = load_provider_config(args.config or None)
+    kv_page_tokens = (args.kv_page_tokens if args.kv_page_tokens is not None
+                      else base_cfg.kv_page_tokens)
+    kv_pool_pages = (args.kv_pool_pages if args.kv_pool_pages is not None
+                     else base_cfg.kv_pool_pages)
+    prefix_cache_enabled = (base_cfg.prefix_cache_enabled
+                            if args.prefix_cache_enabled is None
+                            else args.prefix_cache_enabled == "on")
     cfg = MODEL_CONFIGS[args.model]()
     log.info("loading %s (%.2fB params) on %s", cfg.name,
              cfg.param_count / 1e9, jax.default_backend())
@@ -1119,6 +1152,9 @@ def main(argv=None) -> int:
                     "off": False}[args.ring_cache],
         speculate_k=args.speculate,
         max_queue_depth=args.max_queue_depth,
+        kv_page_tokens=kv_page_tokens,
+        kv_pool_pages=kv_pool_pages,
+        prefix_cache_enabled=prefix_cache_enabled,
         # text mode stops at the tokenizer's EOS instead of always burning
         # the full max_new_tokens budget
         eos_token=(tokenizer.eos_id if tokenizer is not None else -1)),

@@ -1,0 +1,28 @@
+"""Serving engine package (split from the former 1.9k-line serving.py so
+the paged KV pool, prefix trie, sampler, and queueing state land as
+testable units):
+
+- ``engine``     — ServingEngine: the threads, the decode loop, admission
+- ``kv_manager`` — paged KV prefix pool: PagePool / PrefixTrie /
+                   PagedKVStore / DensePrefixStore, kv_cache_pspec
+- ``sampler``    — seeded per-request sampling, penalties, logit_bias
+- ``scheduler``  — ServingConfig, Request, _Slot, admission exceptions
+
+The public import surface is unchanged: everything previously importable
+from ``workloads.serving`` re-exports here."""
+
+from .engine import ServingEngine  # noqa: F401
+from .kv_manager import (DensePrefixStore, MatchResult, PagedKVStore,  # noqa: F401
+                         PagePool, PoolExhausted, PrefixTrie,
+                         kv_cache_pspec)
+from .sampler import _apply_penalties, _sample  # noqa: F401 — test seams
+# (sampling / penalty formula unit tests import these directly)
+from .scheduler import (EngineDraining, EngineOverloaded, Request,  # noqa: F401
+                        ServingConfig, _fail_future, _Slot)
+
+__all__ = [
+    "ServingEngine", "ServingConfig", "Request", "_Slot",
+    "EngineDraining", "EngineOverloaded",
+    "PagePool", "PrefixTrie", "PagedKVStore", "DensePrefixStore",
+    "MatchResult", "PoolExhausted", "kv_cache_pspec",
+]

@@ -20,17 +20,26 @@ config 5). TPU-first decisions:
   (in-place updates, not full-cache copies per step); sliding-window models
   ring at O(window) memory (Gemma-2/3 interleaves split local-ring/
   global-full); optional int8 KV halves cache read bandwidth.
+- **Paged prefix KV pool** (ISSUE 8): every prompt is matched against a
+  radix trie of page-granular shared KV (kv_manager.py) — matched pages
+  GATHER from one preallocated HBM arena instead of re-prefilling, every
+  prefill's full pages are cached back (refcounted, LRU-leaf eviction),
+  and register_prefix() pins trie paths instead of whole single-slot
+  caches. At fleet scale this is what makes the router's prefix-affinity
+  pay off in TTFT and KV bytes.
 - **Multi-tenant**: prefix caching (shared system prompts prefill once),
   multi-LoRA (per-request adapters inside one decode batch), per-request
   seeds/stop sequences/logprobs, speculative decoding.
 
 Threading: callers submit() from anywhere; one engine thread owns the model
-state (JAX objects never cross threads mid-step).
+state (JAX objects never cross threads mid-step). The prefix pool (trie +
+arena) is shared by the prefill thread and register_prefix callers — every
+access runs under ``_prefix_lock`` (arena writes DONATE buffers, so even
+reads must not race them).
 """
 
 from __future__ import annotations
 
-import dataclasses
 import logging
 import queue
 import threading
@@ -43,357 +52,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..metrics import Metrics
-from ..models.llama import LlamaConfig, LlamaModel, Params
-from ..tracing import Tracer
+from ...metrics import Metrics
+from ...models.llama import LlamaConfig, LlamaModel, Params
+from ...tracing import Tracer
+from .kv_manager import DensePrefixStore, PagedKVStore, kv_cache_pspec  # noqa: F401 — kv_cache_pspec re-exported (layout contract)
+from .sampler import (_apply_penalties, _bias_row, _bump_counts,
+                      _logit_modded, _penalized, _row_keys, _sample,
+                      _set_count_row)
+from .scheduler import (ITL_BUCKETS, TTFT_BUCKETS, UTIL_BUCKETS,
+                        EngineDraining, EngineOverloaded, Request,
+                        ServingConfig, _fail_future, _Slot)
 
 log = logging.getLogger(__name__)
-
-# SLO histograms live sub-second: the default bucket ladder (0.5s first
-# bucket, sized for pod provisioning) would crush every TTFT/ITL sample
-# into one bin (ISSUE 2 satellite)
-_TTFT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
-                 10.0, 30.0, 60.0)
-_ITL_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
-                1.0, 2.5)
-_UTIL_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
-
-
-@dataclasses.dataclass
-class ServingConfig:
-    slots: int = 4               # concurrent decode streams
-    max_prefill_len: int = 512
-    cache_len: int = 1024        # per-slot KV budget (prompt + generation)
-    max_new_tokens: int = 128
-    eos_token: int = -1          # -1 = never stop on a token
-    temperature: float = 0.0     # 0 = greedy
-    quantize_int8: bool = False  # weight-only int8 (models/quant.py): halves
-                                 # weight HBM traffic on the bandwidth-bound
-                                 # decode step
-    # weight-only int4 (two weights per byte, group-wise scales): quarter
-    # weight HBM traffic — the next rung after int8 on the decode-bandwidth
-    # ladder. Covers MoE EXPERT weights too (per-expert unpack kernel,
-    # tests pin parity vs f32 within a threshold). Accuracy drops more
-    # than int8's (4-bit resolution); the tiny pinned model stays
-    # argmax-stable in tests, real models deserve an eval before
-    # production. Mutually exclusive with quantize_int8.
-    quantize_int4: bool = False
-    # speculative decoding via prompt-lookup (n-gram) proposals: draft this
-    # many tokens per decode step and verify them in ONE forward pass
-    # (models/llama.py verify_step). Greedy slots commit every matched draft
-    # token "for free" (decode is memory-bound, so a K-token verify costs
-    # about one decode step); sampled slots fall back to 1 token/step.
-    # Greedy output equals the non-speculative engine's on the pinned f32
-    # test model; the K-wide and 1-wide kernels can reduce in different
-    # orders, so logits within ~1 ulp of a tie may tie-break differently
-    # (bf16 especially) — same model quality, not a correctness loss.
-    speculate_k: int = 0
-    # Ring KV cache for uniformly-windowed models (Mistral): physical cache
-    # per slot shrinks to ~window + write slack while cache_len stays the
-    # LOGICAL budget (prompt + generation length cap). None = auto: on
-    # whenever the model has a uniform sliding window and the ring is
-    # actually smaller; True forces it (error if the model can't); False
-    # disables.
-    ring_cache: Optional[bool] = None
-    # int8 KV cache with per-(position, kv-head) scales: decode reads the
-    # whole cache every step (HBM-bound), so int8 halves that traffic and
-    # doubles how many slots fit a chip. Composes with ring_cache and
-    # quantize_int8 (weights). Accuracy: ~1e-2-level logit perturbation —
-    # greedy outputs typically identical, pinned by tests on the tiny model.
-    quantize_kv_int8: bool = False
-    # donate the engine cache through decode/verify (in-place K-token
-    # updates instead of a full-cache copy per step). The off-switch exists
-    # to MEASURE that HBM claim (bench.py --econ); leave on in production.
-    donate_cache: bool = True
-    # registered-prefix cap: each register_prefix() pins one single-slot KV
-    # cache in HBM until restart
-    max_prefixes: int = 8
-    # multi-LoRA serving (vLLM-style multi-tenant adapters): rank > 0
-    # preallocates zero-filled adapter stacks of this rank over
-    # ``lora_targets`` so adapters register WITHOUT recompiling the decode
-    # jit (the adapter axis is fixed at max_adapters+1; slot 0 = all-zeros
-    # = base model). Requests pick an adapter by name via submit(adapter=).
-    lora_rank: int = 0
-    lora_targets: tuple = ("wq", "wv")
-    max_adapters: int = 8
-    # admission control: reject new requests once this many are queued
-    # (0 = unbounded). The queue depth GAUGE stays the HPA scale signal;
-    # this is the ceiling that keeps latency bounded until the autoscaler
-    # catches up — rejected submits resolve to EngineOverloaded, which the
-    # HTTP layer maps to 429 + Retry-After.
-    max_queue_depth: int = 0
-
-
-class EngineOverloaded(RuntimeError):
-    """Request rejected at admission: queue is at max_queue_depth."""
-
-
-class EngineDraining(RuntimeError):
-    """Request rejected at admission: the engine is draining (fleet
-    scale-down). In-flight and already-queued requests still finish; the
-    HTTP layer maps this to 503 + Retry-After so clients re-resolve to
-    another replica."""
-
-
-@dataclasses.dataclass
-class Request:
-    prompt: list[int]
-    max_new_tokens: int
-    rid: str
-    future: Future
-    submitted_at: float
-    temperature: float
-    top_k: int = 0          # 0 = no top-k filter
-    top_p: float = 1.0      # 1.0 = no nucleus filter
-    # OpenAI sampling penalties, applied to the logits BEFORE temperature/
-    # filtering: presence subtracts once per token SAMPLED DURING
-    # GENERATION (the prompt never contributes — OpenAI's published
-    # formula and vLLM both count output tokens only), frequency per
-    # occurrence. A penalized request never takes the speculative K-wide
-    # greedy commit (each committed token changes the next step's
-    # penalties).
-    presence_penalty: float = 0.0
-    frequency_penalty: float = 0.0
-    # OpenAI logit_bias: {token_id: bias in [-100, 100]} added to that
-    # token's logit every step (-100 ~ ban, +100 ~ force)
-    logit_bias: Optional[dict] = None
-    adapter_id: int = 0     # multi-LoRA slot (0 = base model)
-    # stop token SEQUENCES: generation ends when the generated tail equals
-    # one (the matched sequence stays in the output; callers strip it).
-    # Checked host-side per committed token — no jit impact.
-    stop: list = dataclasses.field(default_factory=list)
-    # stop STRINGS matched on DECODED text (needs the engine's decode_fn):
-    # exact for BPE vocabularies where a stop string can straddle a token
-    # boundary and the token-sequence fast path above would miss it.
-    # Generation ends when the decoded output contains one; the matched
-    # text stays in the output (callers truncate at its first occurrence).
-    stop_texts: list = dataclasses.field(default_factory=list)
-    # return per-token log P(token | prefix) of each generated token
-    logprobs: bool = False
-    # sampling seed (resolved at submit): the PRNG stream is a pure
-    # function of (seed, draw index), independent of slot placement and
-    # neighbors. On speculative engines bit-exactness additionally needs
-    # the logits to be batch-independent — a bf16 near-tie can round
-    # differently between the K-wide and 1-wide kernels (ServingConfig.
-    # speculate_k caveat), so there "same seed = same distribution" is
-    # the hard guarantee and exact tokens the overwhelmingly common case.
-    seed: int = 0
-    # streaming: called with each generated token id, from the engine thread.
-    # A raising callback (client gone) cancels the request at the next token.
-    on_token: Optional[Any] = None
-    # co-submitted requests with the IDENTICAL prompt (OpenAI n>1): the
-    # prefill runs ONCE and its immutable cache fans out to every member
-    # (nothing donates the single cache, so sharing is safe); each member
-    # samples its own first token from the shared last-position logits
-    fanout: Optional[list] = None
-    # distributed-tracing context (W3C traceparent): trace_id groups this
-    # request's spans with the caller's trace; span_id is the REQUEST root
-    # span's id (the HTTP layer generates it so it can stamp the response
-    # header before the request finishes); parent_span_id is the caller's
-    # inbound span. Empty = the engine mints ids at completion.
-    trace_id: str = ""
-    span_id: str = ""
-    parent_span_id: str = ""
-    # span-boundary timestamps (perf_counter domain, like submitted_at):
-    # queue-wait = submitted->dequeued, prefill = dequeued->prefill_done,
-    # decode = prefill_done->finish (contiguous: ready-queue wait and slot
-    # insertion are decode-span preamble, so child durations sum to the
-    # request latency)
-    dequeued_at: float = 0.0
-    prefill_done_at: float = 0.0
-    first_token_at: float = 0.0
-
-
-@dataclasses.dataclass
-class _PrefixEntry:
-    """One registered prompt prefix. ``variants`` maps adapter_id ->
-    (last_logits, single-slot cache); id 0 (base model) is created at
-    registration, adapter variants fill lazily on first use (their KV
-    differs — adapter deltas flow into K/V). ``lru`` tracks adapter-variant
-    recency for eviction."""
-    tokens: list
-    variants: dict
-    lru: dict = dataclasses.field(default_factory=dict)
-
-
-@dataclasses.dataclass
-class _Slot:
-    request: Optional[Request] = None
-    generated: list[int] = dataclasses.field(default_factory=list)
-    logprobs: list[float] = dataclasses.field(default_factory=list)
-    remaining: int = 0
-    last_token: int = 0
-    # prompt-lookup drafting state: bigram -> latest start position over
-    # prompt+generated, indexed lazily in _propose — amortized O(1)/token
-    # where a rescan would be O(context) Python per engine step
-    bigram_index: dict = dataclasses.field(default_factory=dict)
-    indexed_upto: int = 0
-    # stop_texts running tail: token ids whose decode is kept just long
-    # enough (in CHARS) to contain any new stop-string match — trimming by
-    # decoded length (not token count) survives zero-char specials and
-    # detokenizer first-token artifacts (r3 advisor finding)
-    stop_tail: list[int] = dataclasses.field(default_factory=list)
-    stop_tail_upto: int = 0
-    # inter-token-latency bookkeeping: perf_counter of the last token this
-    # slot streamed (0 = none yet)
-    last_emit_at: float = 0.0
-
-
-def kv_cache_pspec(name: str, ndim: int):
-    """PartitionSpec for one KV-cache section under mesh serving — THE
-    layout contract between the engine (_fresh_cache) and the AOT evidence
-    tool (tools/aot_check.py check_sharded_serving): K/V (L, B, len, h, d)
-    shard the kv-heads axis (second-to-last) over ``tensor``; *_scale
-    (L, B, len, h) have heads last; index/abs_pos bookkeeping replicates."""
-    from jax.sharding import PartitionSpec as P
-    from ..parallel.mesh import AXES
-    if name in ("index", "abs_pos"):
-        return P()
-    if name in ("c", "kr", "c_scale", "kr_scale",
-                "c_pre", "kr_pre", "c_pre_scale", "kr_pre_scale"):
-        # MLA latent cache: NO heads axis — every tensor shard's heads
-        # attend over all positions' latents, so the cache replicates.
-        # Even replicated it is 8-57x smaller than a tensor-sharded K/V
-        # cache (576 B/token at DeepSeek-V2 geometry vs 32k unsharded).
-        return P()
-    if name.endswith("_scale"):
-        return P(*([None] * (ndim - 1) + [AXES.TENSOR]))
-    return P(*([None] * (ndim - 2) + [AXES.TENSOR, None]))
-
-
-def _fail_future(fut: Future, exc: BaseException) -> None:
-    """set_exception tolerant of a client cancel landing between a done()
-    check and the call — InvalidStateError here must never kill an engine
-    or prefill thread."""
-    try:
-        if not fut.done():
-            fut.set_exception(exc)
-    except Exception:  # noqa: BLE001 — racing future.cancel()
-        pass
-
-
-def _row_keys(seeds: jax.Array, draws: jax.Array) -> jax.Array:
-    """Per-row PRNG keys from (request seed, samples drawn so far): sampling
-    is reproducible PER REQUEST (OpenAI ``seed``) and independent of which
-    slot a request lands in or what else shares the batch."""
-    def one(s, d):
-        return jax.random.fold_in(jax.random.PRNGKey(s), d)
-    return jax.vmap(one)(seeds, draws)
-
-
-def _penalized(r) -> bool:
-    return r is not None and (r.presence_penalty != 0.0
-                              or r.frequency_penalty != 0.0)
-
-
-def _bias_row(logit_bias: dict, vocab_size: int) -> np.ndarray:
-    """Dense (V,) f32 additive row from an OpenAI logit_bias map — ONE
-    construction for the first-token path and the per-slot steady state."""
-    row = np.zeros((vocab_size,), np.float32)
-    for t, bias in logit_bias.items():
-        row[int(t)] = float(bias)
-    return row
-
-
-def _logit_modded(r) -> bool:
-    """Penalties or logit_bias: the next token must come from MODIFIED
-    logits, so the speculative K-wide greedy commit (which compares raw
-    argmaxes) is off the table for these requests."""
-    return _penalized(r) or (r is not None and bool(r.logit_bias))
-
-
-@jax.jit
-def _apply_penalties(logits: jax.Array, counts: jax.Array,
-                     presence: jax.Array, frequency: jax.Array) -> jax.Array:
-    """logits (B, V) minus OpenAI penalties from per-slot token counts
-    (B, V): presence once per seen token, frequency per occurrence. Rows
-    with zero penalties pass through unchanged (their counts still exist
-    but multiply by 0)."""
-    c = counts.astype(jnp.float32)
-    pen = (presence[:, None] * (c > 0).astype(jnp.float32)
-           + frequency[:, None] * c)
-    return logits.astype(jnp.float32) - pen
-
-
-@jax.jit
-def _bump_counts(counts: jax.Array, toks: jax.Array,
-                 mask: jax.Array) -> jax.Array:
-    """counts[i, toks[i]] += 1 where mask[i] — fixed (B,) shapes so the
-    per-step update never recompiles."""
-    rows = jnp.arange(counts.shape[0])
-    return counts.at[rows, toks].add(mask.astype(jnp.int32))
-
-
-@jax.jit
-def _set_count_row(counts: jax.Array, slot: jax.Array,
-                   row: jax.Array) -> jax.Array:
-    return counts.at[slot].set(row)
-
-
-def _scaled_and_greedy(logits, temps):
-    """Shared head of both sampling kernels (inlines under jit): argmax for
-    the per-row greedy override, temperature-scaled f32 logits."""
-    greedy = jnp.argmax(logits, axis=-1)
-    scaled = (logits / jnp.maximum(temps, 1e-6)[:, None]).astype(jnp.float32)
-    return scaled, greedy
-
-
-@jax.jit
-def _sample_plain(logits: jax.Array, keys: jax.Array,
-                  temps: jax.Array) -> jax.Array:
-    """Unfiltered per-row sampling (no top-k/top-p in the batch): no (B, V)
-    sort on the per-token hot loop."""
-    scaled, greedy = _scaled_and_greedy(logits, temps)
-    sampled = jax.vmap(jax.random.categorical)(keys, scaled)
-    return jnp.where(temps > 0.0, sampled, greedy)
-
-
-@jax.jit
-def _sample_filtered(logits: jax.Array, keys: jax.Array, temps: jax.Array,
-                     top_ks: jax.Array, top_ps: jax.Array) -> jax.Array:
-    v = logits.shape[-1]
-    scaled, greedy = _scaled_and_greedy(logits, temps)
-    sorted_desc = -jnp.sort(-scaled, axis=-1)              # (B, V) desc
-    # top-k threshold: the k-th largest logit (k=0 -> keep all)
-    ks = jnp.where(top_ks > 0, top_ks, v)
-    thresh_k = jnp.take_along_axis(
-        sorted_desc, jnp.clip(ks - 1, 0, v - 1)[:, None], axis=-1)
-    # top-p threshold: smallest prefix of the sorted distribution with
-    # cumulative mass >= p; "cum before this token < p" keeps >= 1 token
-    probs = jax.nn.softmax(sorted_desc, axis=-1)
-    before = jnp.cumsum(probs, axis=-1) - probs
-    keep = before < top_ps[:, None]
-    idx_p = jnp.sum(keep, axis=-1) - 1                     # last kept
-    thresh_p = jnp.take_along_axis(sorted_desc, idx_p[:, None], axis=-1)
-    thresh = jnp.maximum(thresh_k, thresh_p)
-    filtered = jnp.where(scaled >= thresh, scaled, -jnp.inf)
-    sampled = jax.vmap(jax.random.categorical)(keys, filtered)
-    return jnp.where(temps > 0.0, sampled, greedy)
-
-
-def _sample(logits: jax.Array, keys: jax.Array, temps: list[float],
-            top_ks: Optional[list[int]] = None,
-            top_ps: Optional[list[float]] = None) -> jax.Array:
-    """Per-row temperature + top-k + nucleus (top-p) sampling with PER-ROW
-    PRNG keys (``keys`` (B, 2) from _row_keys). Filters operate on the
-    temperature-scaled distribution; the (B, V) sort is cheap at serving
-    batch sizes (JetStream does the same).
-
-    Dispatches to JITTED kernels with per-row parameters as ARRAYS — the
-    sampler runs once per decode step, and an eager version costs ~10
-    separate device executions per step; only the all-greedy / any-filter
-    shape of the batch (two variants total) picks the compiled path."""
-    if all(t <= 0.0 for t in temps):
-        return jnp.argmax(logits, axis=-1)
-    b = logits.shape[0]
-    t = jnp.asarray(temps, jnp.float32)
-    top_ks = top_ks or [0] * b
-    top_ps = top_ps or [1.0] * b
-    if all(k <= 0 for k in top_ks) and all(p >= 1.0 for p in top_ps):
-        return _sample_plain(logits, keys, t)
-    return _sample_filtered(logits, keys, t,
-                            jnp.asarray(top_ks, jnp.int32),
-                            jnp.asarray(top_ps, jnp.float32))
 
 
 class ServingEngine:
@@ -431,8 +101,14 @@ class ServingEngine:
         if sc.quantize_int8 and sc.quantize_int4:
             raise ValueError("quantize_int8 and quantize_int4 are mutually "
                              "exclusive — pick one weight precision")
+        if sc.kv_page_tokens < 1:
+            raise ValueError(f"kv_page_tokens must be >= 1, "
+                             f"got {sc.kv_page_tokens}")
+        if sc.kv_pool_pages < 0:
+            raise ValueError(f"kv_pool_pages must be >= 0 (0 = auto), "
+                             f"got {sc.kv_pool_pages}")
         if mesh is not None:
-            from ..parallel.mesh import AXES
+            from ...parallel.mesh import AXES
             ep = mesh.shape.get(AXES.EXPERT, 1)
             if ep > 1 and (not cfg.n_experts or cfg.n_experts % ep):
                 raise ValueError(
@@ -440,8 +116,8 @@ class ServingEngine:
                     f"n_experts it divides (got n_experts={cfg.n_experts})")
         self.model = LlamaModel(cfg, mesh)
         if sc.quantize_int8 or sc.quantize_int4:
-            from ..models.quant import (quantize_params,
-                                        quantized_logical_axes)
+            from ...models.quant import (quantize_params,
+                                         quantized_logical_axes)
             # quantize on HOST (numpy pulls any device tree back), then
             # shard the int8 tree exactly like bf16 params — 70B-class
             # int8 over a slice is THE big-model production config. The
@@ -452,7 +128,7 @@ class ServingEngine:
                                      bits=4 if sc.quantize_int4 else 8,
                                      commit=mesh is None)
             if mesh is not None:
-                from ..parallel import param_shardings
+                from ...parallel import param_shardings
                 params = jax.device_put(
                     params,
                     param_shardings(mesh, quantized_logical_axes(
@@ -465,15 +141,6 @@ class ServingEngine:
         self.metrics.set_gauge("tpu_serving_active_slots", 0)
         self.metrics.set_gauge("tpu_serving_kv_cache_tokens", 0)
         self.metrics.set_gauge("tpu_serving_draining", 0)
-        # registered prompt prefixes, longest first; read by the prefill
-        # thread, written by callers. Each entry holds per-ADAPTER KV
-        # variants (adapter KV differs from base KV for the same tokens),
-        # filled lazily on first hit so multi-LoRA tenants share the
-        # system-prompt cache too; adapter variants are LRU-bounded by
-        # max_prefixes while base variants stay pinned
-        self._prefixes: list[_PrefixEntry] = []
-        self._prefix_lock = threading.Lock()
-        self._prefix_clock = 0  # LRU counter for adapter variants
         self._queue: "queue.Queue[Request]" = queue.Queue()
         # extra members carried by queued groups (submit_group): adds to
         # queue_depth so the HPA signal sees n requests, not 1.
@@ -511,6 +178,37 @@ class ServingEngine:
         self._slots = [_Slot() for _ in range(sc.slots)]
         self._ring_len = self._pick_ring_len(cfg, sc)
         self._cache = self._fresh_cache(sc.slots)
+        # -- prefix cache (paged pool or dense fallback) -------------------
+        # the paged pool (kv_manager.py): radix trie over page-granular
+        # shared KV in one preallocated arena. Ring/mixed layouts cannot
+        # page (positions ring-overwrite by design) and a disabled cache
+        # skips the arena entirely — both keep register_prefix() working
+        # through the dense fallback store. All prefix state — trie, pool,
+        # arena reads AND writes (writes donate) — is serialized under
+        # _prefix_lock; registered-prefix dedup/cap rides the same lock.
+        self._prefix_lock = threading.Lock()
+        self._registered: list[list[int]] = []
+        self._kv_store: Optional[PagedKVStore] = None
+        self._dense_prefixes: Optional[DensePrefixStore] = None
+        if sc.prefix_cache_enabled and self._ring_len is None \
+                and sc.kv_page_tokens < sc.cache_len:
+            n_pages = sc.kv_pool_pages or max(
+                1, sc.slots * sc.cache_len // sc.kv_page_tokens)
+            quant = sc.quantize_kv_int8
+            self._kv_store = PagedKVStore(
+                n_pages, sc.kv_page_tokens,
+                lambda: self.model.init_cache(1, sc.cache_len,
+                                              quantize=quant),
+                mesh=mesh)
+        else:
+            self._dense_prefixes = DensePrefixStore(
+                max_adapter_variants=sc.max_prefixes)
+        # hit-rate series visible from pod start (the fleet reporter and
+        # dashboards divide them; zero-seeding keeps the series defined)
+        self.metrics.incr("tpu_serving_prefix_cache_hits", 0)
+        self.metrics.incr("tpu_serving_prefix_cache_misses", 0)
+        self.metrics.incr("tpu_serving_prefix_cache_evictions", 0)
+        self._update_page_gauges()
         # per-slot sampling state: (request seed, draws so far) -> PRNG key
         self._slot_seed = np.zeros((sc.slots,), np.uint32)
         self._slot_draws = np.zeros((sc.slots,), np.int32)
@@ -576,7 +274,7 @@ class ServingEngine:
         self._verify = (jax.jit(self.model.verify_step, donate_argnums=donate)
                         if sc.speculate_k > 0 else None)
         # the prefill thread's verify is NOT donated: a prefix-cache hit
-        # starts chunked appends from the stored registry cache, which must
+        # starts chunked appends from a gathered/stored cache, which must
         # survive for future hits
         self._verify_fn = jax.jit(self.model.verify_step)
         if self._verify is not None:
@@ -624,6 +322,22 @@ class ServingEngine:
                    "prompts that skipped a registered prefix's prefill")
         m.describe("tpu_serving_prefix_adapter_fills",
                    "lazy per-adapter prefix variants computed on first use")
+        m.describe("tpu_serving_prefix_cache_hits",
+                   "prompts that reused >= 1 shared KV page (prefill skipped "
+                   "for the matched span)")
+        m.describe("tpu_serving_prefix_cache_misses",
+                   "prompts the prefix trie matched nothing for (full "
+                   "prefill)")
+        m.describe("tpu_serving_prefix_cache_evictions",
+                   "KV pages evicted from the prefix trie (LRU leaves) to "
+                   "make room")
+        m.describe("tpu_serving_kv_pages_total",
+                   "KV pages in the preallocated paged-prefix arena")
+        m.describe("tpu_serving_kv_pages_free",
+                   "KV pages on the free list (unreferenced)")
+        m.describe("tpu_serving_kv_pages_shared",
+                   "KV pages serving more than one cached sequence "
+                   "(trie-interior or multiply-referenced: the dedup win)")
         m.describe("tpu_serving_spec_proposed",
                    "speculative draft tokens proposed")
         m.describe("tpu_serving_spec_accepted",
@@ -632,16 +346,16 @@ class ServingEngine:
                    "submit -> completion, whole request")
         m.describe("tpu_serving_ttft_seconds",
                    "submit -> first generated token (time to first token)",
-                   buckets=_TTFT_BUCKETS)
+                   buckets=TTFT_BUCKETS)
         m.describe("tpu_serving_inter_token_seconds",
                    "gap between consecutive streamed tokens of one request",
-                   buckets=_ITL_BUCKETS)
+                   buckets=ITL_BUCKETS)
         m.describe("tpu_serving_queue_wait_seconds",
                    "submit -> prefill start (admission queue wait)",
-                   buckets=_TTFT_BUCKETS)
+                   buckets=TTFT_BUCKETS)
         m.describe("tpu_serving_batch_utilization",
                    "filled slots / max slots per decode step",
-                   buckets=_UTIL_BUCKETS)
+                   buckets=UTIL_BUCKETS)
 
     def _fresh_cache(self, batch: int) -> Params:
         """One construction path for every cache this engine makes (the
@@ -993,6 +707,23 @@ class ServingEngine:
     def active_slots(self) -> int:
         return sum(1 for s in self._slots if s.request is not None)
 
+    def prefix_cache_stats(self) -> dict:
+        """Pool/trie occupancy + registered count — the kv_pages gauges'
+        source of truth, also consumed by tests and the fleet reporter."""
+        with self._prefix_lock:
+            if self._kv_store is not None:
+                out = self._kv_store.stats()
+            else:
+                out = {"pages_total": 0, "pages_free": 0, "pages_shared": 0,
+                       "nodes": 0, "pinned": 0, "adapters": []}
+                if self._dense_prefixes is not None:
+                    out["dense_entries"] = len(self._dense_prefixes)
+            out["registered"] = len(self._registered)
+            out["page_tokens"] = self.sc.kv_page_tokens
+            if self._kv_store is not None:
+                out["page_bytes"] = self._kv_store.page_bytes
+            return out
+
     def debug_snapshot(self) -> dict:
         """Statusz-style snapshot for /debug/engine: in-flight slots with
         per-request age/token counts, queue depths, and prefix/adapter
@@ -1016,9 +747,10 @@ class ServingEngine:
                 "adapter_id": r.adapter_id,
             })
         with self._prefix_lock:
-            prefixes = [{"tokens": len(e.tokens),
-                         "adapter_variants": len(e.variants)}
-                        for e in self._prefixes]
+            if self._dense_prefixes is not None:
+                prefixes = self._dense_prefixes.snapshot()
+            else:
+                prefixes = [{"tokens": len(t)} for t in self._registered]
         kv_tokens = sum(s.get("prompt_tokens", 0) + s.get("generated_tokens", 0)
                         for s in slots)
         return {
@@ -1039,6 +771,7 @@ class ServingEngine:
             "cache_len": self.sc.cache_len,
             "prefixes": prefixes,
             "max_prefixes": self.sc.max_prefixes,
+            "prefix_cache": self.prefix_cache_stats(),
             "adapters": list(self.adapter_names),
             "total_generated": self.total_generated,
             "last_error": self.last_error,
@@ -1190,61 +923,123 @@ class ServingEngine:
         arr, n = self._padded(tokens)
         return [float(x) for x in np.asarray(fn(self.params, arr, n[0]))]
 
-    def _prefill_tokens(self, tokens: list[int], adapter_id: int = 0
-                        ) -> tuple[Any, Params]:
-        """Full prompt -> (last_logits, single-request cache). The head goes
-        through the prefill jit (bucketed to a few fixed lengths so it
-        compiles once per bucket, not per prompt length); a prompt longer
-        than max_prefill_len continues CHUNKED through the verify kernel.
+    # -- prefix cache ----------------------------------------------------------
 
-        A registered prefix of the prompt skips straight to its stored
-        cache and appends only the suffix. Adapter requests hit the cache
-        too: the prefix KV under an adapter differs from the base's, so
-        each entry keeps PER-ADAPTER variants, computed lazily on an
-        adapter's first request (that request pays one prefix prefill;
-        every later one skips it) and LRU-evicted past max_prefixes."""
+    def _covers_registered(self, tokens: list[int]) -> bool:
+        """Does this prompt start with some register_prefix() prefix? The
+        registered list is small (max_prefixes) and host-side, so this is
+        the cheap back-compat signal behind tpu_serving_prefix_hits."""
+        return any(len(r) <= len(tokens) and tokens[:len(r)] == r
+                   for r in self._registered)
+
+    def _update_page_gauges(self):
+        if self._kv_store is None:
+            self.metrics.set_gauge("tpu_serving_kv_pages_total", 0)
+            self.metrics.set_gauge("tpu_serving_kv_pages_free", 0)
+            self.metrics.set_gauge("tpu_serving_kv_pages_shared", 0)
+            return
+        with self._prefix_lock:
+            stats = self._kv_store.stats()
+        self.metrics.set_gauge("tpu_serving_kv_pages_total",
+                               stats["pages_total"])
+        self.metrics.set_gauge("tpu_serving_kv_pages_free",
+                               stats["pages_free"])
+        self.metrics.set_gauge("tpu_serving_kv_pages_shared",
+                               stats["pages_shared"])
+
+    def _prefill_tokens(self, tokens: list[int], adapter_id: int = 0
+                        ) -> tuple[Any, Params, int]:
+        """Full prompt -> (last_logits, single-request cache, tokens served
+        from the prefix cache). The head goes through the prefill jit
+        (bucketed to a few fixed lengths so it compiles once per bucket,
+        not per prompt length); a prompt longer than max_prefill_len
+        continues CHUNKED through the verify kernel.
+
+        Paged engines (the default): the prompt's full pages are matched
+        against the radix trie — matched KV GATHERS from the shared arena
+        (no recompute; at least the final token always recomputes for its
+        logits) and the suffix appends through the verify kernel; then the
+        prompt's own full pages are inserted back so the NEXT request
+        sharing this prefix skips it, registered or not. Ring/mixed
+        layouts (and prefix_cache_enabled=False) fall back to the dense
+        registered-prefix store with per-adapter variants."""
         adapters = self._adapters  # one snapshot per request: a concurrent
         # re-registration must not mix weights between head and chunks
+        if self._kv_store is not None:
+            return self._prefill_paged(tokens, adapter_id, adapters)
+        return self._prefill_dense(tokens, adapter_id, adapters)
+
+    def _prefill_paged(self, tokens: list[int], adapter_id: int,
+                       adapters) -> tuple[Any, Params, int]:
+        store = self._kv_store
+        single = None
         with self._prefix_lock:
-            entry = next((e for e in self._prefixes
-                          if len(e.tokens) <= len(tokens)
-                          and tokens[:len(e.tokens)] == e.tokens), None)
+            m = store.match(adapter_id, tokens)
+            if m.pages:
+                try:
+                    single = store.gather(m.pages, self._fresh_cache(1))
+                finally:
+                    store.release(m.pages)
+        if single is not None:
+            self.metrics.incr("tpu_serving_prefix_cache_hits")
+            if self._covers_registered(tokens):
+                # back-compat series: the registered (pinned) prefix's
+                # prefill was skipped, same meaning as the old registry
+                self.metrics.incr("tpu_serving_prefix_hits")
+            last_logits, single = self._append_chunks(
+                single, tokens[m.matched_tokens:], None, adapter_id, adapters)
+        else:
+            self.metrics.incr("tpu_serving_prefix_cache_misses")
+            if adapter_id != 0 and self._covers_registered(tokens):
+                # first request from this adapter over a registered prefix
+                # computes the adapter-variant KV the trie will now cache —
+                # the paged equivalent of the old lazy variant fill
+                self.metrics.incr("tpu_serving_prefix_adapter_fills")
+            last_logits, single = self._prefill_raw(tokens, adapter_id,
+                                                    adapters)
+        # cache admission: insert this prompt's full pages (refcount-shared
+        # with whatever prefix of them is already cached). Best-effort —
+        # a failure here must cost this request nothing but the cache.
+        try:
+            with self._prefix_lock:
+                _, evicted = store.insert(adapter_id, tokens, single)
+            if evicted:
+                self.metrics.incr("tpu_serving_prefix_cache_evictions",
+                                  evicted)
+        except Exception:  # noqa: BLE001 — caching is best-effort
+            log.exception("prefix-cache insert failed; serving uncached")
+        self._update_page_gauges()
+        return last_logits, single, m.matched_tokens
+
+    def _prefill_dense(self, tokens: list[int], adapter_id: int,
+                       adapters) -> tuple[Any, Params, int]:
+        """Registered-prefix path for layouts the paged pool cannot serve
+        (ring/mixed) and for prefix_cache_enabled=False: longest registered
+        prefix wins, per-adapter variants fill lazily (one prefix prefill
+        on an adapter's first request) and are LRU-bounded."""
+        dense = self._dense_prefixes
+        with self._prefix_lock:
+            entry = dense.lookup(tokens)
             var = entry.variants.get(adapter_id) if entry is not None else None
             if var is not None and adapter_id != 0:
-                self._prefix_clock += 1
-                entry.lru[adapter_id] = self._prefix_clock
+                dense.touch(entry, adapter_id)
         if entry is None:
-            return self._prefill_raw(tokens, adapter_id, adapters)
+            last_logits, single = self._prefill_raw(tokens, adapter_id,
+                                                    adapters)
+            return last_logits, single, 0
         if var is None:
             # first request from this adapter: build its prefix variant
             var = self._prefill_raw(entry.tokens, adapter_id, adapters)
             with self._prefix_lock:
-                if adapter_id not in entry.variants:
-                    entry.variants[adapter_id] = var
-                    self._prefix_clock += 1
-                    entry.lru[adapter_id] = self._prefix_clock
-                    self._evict_adapter_variants_locked()
+                dense.put_variant(entry, adapter_id, var)
             self.metrics.incr("tpu_serving_prefix_adapter_fills")
         else:
             self.metrics.incr("tpu_serving_prefix_hits")
         last_logits, single = var
-        return self._append_chunks(single, tokens[len(entry.tokens):],
-                                   last_logits, adapter_id, adapters)
-
-    def _evict_adapter_variants_locked(self):
-        """Drop least-recently-used ADAPTER prefix variants past the
-        max_prefixes budget (base variants stay pinned — they were
-        explicitly registered). Caller holds _prefix_lock."""
-        cap = self.sc.max_prefixes
-        while True:
-            ad_vars = [(e.lru.get(aid, 0), e, aid)
-                       for e in self._prefixes
-                       for aid in e.variants if aid != 0]
-            if len(ad_vars) <= cap:
-                return
-            _, entry, aid = min(ad_vars, key=lambda t: t[0])
-            del entry.variants[aid]
-            entry.lru.pop(aid, None)
+        last_logits, single = self._append_chunks(
+            single, tokens[len(entry.tokens):], last_logits, adapter_id,
+            adapters)
+        return last_logits, single, len(entry.tokens)
 
     def register_adapter(self, name: str, source) -> None:
         """Install a LoRA adapter into a free slot of the preallocated
@@ -1259,7 +1054,7 @@ class ServingEngine:
                              "ServingConfig.lora_rank to enable adapters")
         if not name:
             raise ValueError("adapter name required")
-        from ..models.lora import is_lora
+        from ...models.lora import is_lora
         if isinstance(source, dict) and "layers" in source:
             src = {t: {"a": w["lora_a"], "b": w["lora_b"],
                        "scale": w["scale"]}
@@ -1302,22 +1097,29 @@ class ServingEngine:
             self._adapters = new_tree
             self._adapter_names[name] = slot
         # a RE-registered adapter slot carries new weights: its cached
-        # prefix variants were computed with the old ones — drop them
+        # prefix KV (trie subtree / dense variants) was computed with the
+        # old ones — drop it
         with self._prefix_lock:
-            for e in self._prefixes:
-                e.variants.pop(slot, None)
-                e.lru.pop(slot, None)
+            if self._kv_store is not None:
+                self._kv_store.trie.drop_adapter(slot)
+            if self._dense_prefixes is not None:
+                self._dense_prefixes.drop_adapter(slot)
+        self._update_page_gauges()
 
     def register_prefix(self, tokens: list[int]) -> None:
-        """Cache the KV of a shared prompt prefix (system prompt) ONCE; any
-        later prompt that starts with it skips its prefill entirely (the
-        stored immutable cache is the starting point — verify-kernel writes
-        produce fresh buffers, never mutating it). Longest match wins.
+        """Cache the KV of a shared prompt prefix (system prompt) ONCE and
+        PIN it: its trie pages are never evicted, so any later prompt that
+        starts with it skips its full pages' prefill entirely (gathered
+        from the arena — verify-kernel writes produce fresh buffers, never
+        mutating shared pages). Longest match wins naturally in the trie.
 
-        Each entry pins one single-slot KV cache in HBM, so registrations
-        are DEDUPED (re-registering the same tokens is a no-op) and capped
-        at ``max_prefixes`` — a restart/retry loop against /prefix must not
-        leak a cache per POST until the pod OOMs."""
+        Registrations are DEDUPED (re-registering the same tokens is a
+        no-op) and capped at ``max_prefixes`` — a restart/retry loop
+        against /prefix must not pin pages per POST until the pod OOMs.
+        Note page granularity: the prefix's tail past its last full page
+        (and prefixes shorter than one page) still recompute per request.
+        Ring/mixed engines pin a dense single-slot cache copy instead
+        (their positions ring-overwrite, so pages cannot represent them)."""
         if not tokens:
             raise ValueError("empty prefix")
         if len(tokens) > self.sc.cache_len - 1:
@@ -1325,27 +1127,35 @@ class ServingEngine:
                              f"{self.sc.cache_len - 1}")
         tokens = list(tokens)
         with self._prefix_lock:
-            if any(e.tokens == tokens for e in self._prefixes):
+            if tokens in self._registered:
                 return  # idempotent
-            if len(self._prefixes) >= self.sc.max_prefixes:
+            if len(self._registered) >= self.sc.max_prefixes:
                 raise ValueError(
                     f"prefix registry full ({self.sc.max_prefixes}); each "
-                    "entry pins a KV cache in HBM — raise max_prefixes or "
-                    "restart to clear")
-        logits, single = self._prefill_tokens(tokens)
+                    "entry pins KV in HBM — raise max_prefixes or restart "
+                    "to clear")
+        logits, single, _ = self._prefill_tokens(tokens)
         with self._prefix_lock:
-            if any(e.tokens == tokens for e in self._prefixes):
+            if tokens in self._registered:
                 return  # raced with an identical registration
-            if len(self._prefixes) >= self.sc.max_prefixes:
+            if len(self._registered) >= self.sc.max_prefixes:
                 # re-check: a concurrent registration may have filled the
                 # registry while we prefilled outside the lock
                 raise ValueError(
                     f"prefix registry full ({self.sc.max_prefixes}); each "
-                    "entry pins a KV cache in HBM — raise max_prefixes or "
-                    "restart to clear")
-            self._prefixes.append(
-                _PrefixEntry(tokens=tokens, variants={0: (logits, single)}))
-            self._prefixes.sort(key=lambda e: -len(e.tokens))  # longest first
+                    "entry pins KV in HBM — raise max_prefixes or restart "
+                    "to clear")
+            self._registered.append(tokens)
+            if self._kv_store is not None:
+                _, evicted = self._kv_store.insert(0, tokens, single,
+                                                   pin=True)
+            else:
+                evicted = 0
+                if not self._dense_prefixes.has(tokens):
+                    self._dense_prefixes.add(tokens, (logits, single))
+        if evicted:
+            self.metrics.incr("tpu_serving_prefix_cache_evictions", evicted)
+        self._update_page_gauges()
 
     def _prefill_loop(self):
         """Dedicated prefill worker: drains the request queue, runs the
@@ -1396,11 +1206,12 @@ class ServingEngine:
             self.metrics.observe("tpu_serving_queue_wait_seconds",
                                  dequeued - r.submitted_at)
         try:
-            last_logits, single = self._prefill_tokens(req.prompt,
-                                                       req.adapter_id)
+            last_logits, single, matched = self._prefill_tokens(
+                req.prompt, req.adapter_id)
             prefill_done = self._perf()
             for r in live:
                 r.prefill_done_at = prefill_done
+                r.matched_prefix_tokens = matched
             # one prefill, one ready entry PER live member: each samples
             # its own first token from the shared last-position logits
             entries = []
@@ -1442,6 +1253,7 @@ class ServingEngine:
                     break
                 except queue.Full:
                     continue
+
     def _admit(self) -> bool:
         """Insert ready-made prefilled caches into free slots (cheap donated
         update — the engine thread never runs a prefill itself)."""
@@ -1472,7 +1284,7 @@ class ServingEngine:
         return admitted
 
     def _admit_into_slot(self, slot_id: int, slot: _Slot, req: Request,
-                     single: Params, first: int, first_lp):
+                         single: Params, first: int, first_lp):
         """Insert one prefilled cache into a free slot; runs with the
         transit count held by _admit."""
         self._cache = self._insert(self._cache, single,
@@ -1858,7 +1670,11 @@ class ServingEngine:
                   attrs={"rid": req.rid, "prompt_tokens": len(req.prompt),
                          "tokens": len(slot.generated),
                          "ttft_s": ttft, "latency_s": latency,
-                         "adapter_id": req.adapter_id})
+                         "adapter_id": req.adapter_id,
+                         # prefix-cache outcome: dashboards join hit-rate
+                         # to TTFT per request (the router-affinity payoff)
+                         "prefix_hit": req.matched_prefix_tokens > 0,
+                         "matched_prefix_tokens": req.matched_prefix_tokens})
         if req.dequeued_at:
             tr.record("serving.queue_wait", wall(req.submitted_at),
                       wall(req.dequeued_at), trace_id=trace_id,
@@ -1868,7 +1684,9 @@ class ServingEngine:
                       wall(req.prefill_done_at), trace_id=trace_id,
                       parent_id=root,
                       attrs={"rid": req.rid,
-                             "prompt_tokens": len(req.prompt)})
+                             "prompt_tokens": len(req.prompt),
+                             "matched_prefix_tokens":
+                                 req.matched_prefix_tokens})
             tr.record("serving.decode", wall(req.prefill_done_at), end,
                       trace_id=trace_id, parent_id=root,
                       attrs={"rid": req.rid,

@@ -1,0 +1,553 @@
+"""Paged KV prefix pool: fixed-size pages in one preallocated HBM arena,
+a free-list allocator, and a radix trie of copy-on-write-shared prefix KV.
+
+At millions-of-users scale most requests share long system prompts, and
+the fleet router's rendezvous prefix-affinity already concentrates
+same-prefix traffic on one replica — this module is where that affinity
+pays off. The design (ParvaGPU / vLLM / JetStream lineage):
+
+- **PagePool** — pure host-side bookkeeping: a free list plus per-page
+  refcounts over a fixed page count. Pages are never handed out twice
+  (the free list is the single source of allocation), and a page returns
+  to the free list exactly when its refcount hits zero. Sharing is
+  copy-on-write in the allocate-on-write form: shared pages are NEVER
+  written in place — readers gather, writers get fresh allocations.
+  ``cow()`` is the explicit claim primitive (exclusive owner keeps the
+  page, a shared page swaps for a fresh copy): unit-tested here, and the
+  write path the zero-copy per-slot page-table decode (ROADMAP item 2's
+  engine integration) claims its private tail page through.
+
+- **PrefixTrie** — a radix trie over PAGE-SIZED token chunks, one KV page
+  per node, one root per LoRA adapter id (adapter deltas flow into K/V,
+  so adapter prefix KV legitimately differs from the base's). ``match``
+  walks a prompt's full chunks and returns the shared pages with a
+  reference held, so a concurrent eviction can NEVER free a page someone
+  is still gathering from — eviction detaches the node and drops the
+  trie's reference; the pool frees the page only when the last reader
+  releases it. Eviction is LRU over unpinned leaves; ``register_prefix``
+  pins its path (never evicted), subsuming the old ``_PrefixEntry``
+  registry without pinning whole single-slot caches.
+
+- **PagedKVStore** — the device side: one arena array per KV cache
+  section, shaped like the section with (batch -> pages, positions ->
+  page_tokens). Works unchanged for plain K/V, int8-quantized K/V
+  (scale sections page alongside), and MLA latent caches (c/kr and the
+  dense-prefix sections) because it is generic over the section dict.
+  ``gather`` copies matched pages into a fresh single-request cache
+  (positions 0..matched) so the engine skips exactly that much prefill;
+  ``write`` chops a prefilled cache's full pages back into the arena.
+  Ring/mixed (``abs_pos``) layouts cannot page — position p lives at
+  slot p %% ring and early positions are overwritten by design — so
+  registered prefixes there fall back to **DensePrefixStore**, a pinned
+  dense-cache registry with the old per-adapter variant semantics.
+
+Thread-safety: PagePool/PrefixTrie/PagedKVStore do no locking of their
+own — the engine serializes every call (and every arena read/write, which
+matters because ``write`` DONATES the arena buffers) under its
+``_prefix_lock``. Docstrings below say so where it is load-bearing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable, Optional
+
+
+def kv_cache_pspec(name: str, ndim: int):
+    """PartitionSpec for one KV-cache section under mesh serving — THE
+    layout contract between the engine (_fresh_cache), the paged arena
+    (PagedKVStore: same section names, batch axis -> pages, positions ->
+    page_tokens, SAME rank) and the AOT evidence tool (tools/aot_check.py
+    check_sharded_serving): K/V (L, B, len, h, d) shard the kv-heads axis
+    (second-to-last) over ``tensor``; *_scale (L, B, len, h) have heads
+    last; index/abs_pos bookkeeping replicates."""
+    from jax.sharding import PartitionSpec as P
+    from ...parallel.mesh import AXES
+    if name in ("index", "abs_pos"):
+        return P()
+    if name in ("c", "kr", "c_scale", "kr_scale",
+                "c_pre", "kr_pre", "c_pre_scale", "kr_pre_scale"):
+        # MLA latent cache: NO heads axis — every tensor shard's heads
+        # attend over all positions' latents, so the cache replicates.
+        # Even replicated it is 8-57x smaller than a tensor-sharded K/V
+        # cache (576 B/token at DeepSeek-V2 geometry vs 32k unsharded).
+        return P()
+    if name.endswith("_scale"):
+        return P(*([None] * (ndim - 1) + [AXES.TENSOR]))
+    return P(*([None] * (ndim - 2) + [AXES.TENSOR, None]))
+
+
+class PoolExhausted(RuntimeError):
+    """No free page and nothing evictable — the caller stops inserting
+    (prefix caching degrades to plain prefill, never an engine error)."""
+
+
+class PagePool:
+    """Free-list page allocator with refcounts. Host bookkeeping only —
+    the page PAYLOAD lives in PagedKVStore's arena; a page id is an index
+    into it. Not thread-safe: the engine serializes calls under its
+    prefix lock."""
+
+    def __init__(self, n_pages: int):
+        if n_pages < 1:
+            raise ValueError(f"n_pages must be >= 1, got {n_pages}")
+        self.n_pages = n_pages
+        # LIFO free list: recently-freed pages are re-used first (their
+        # arena tiles are the warmest)
+        self._free = list(range(n_pages - 1, -1, -1))
+        self._refs = [0] * n_pages
+
+    def alloc(self) -> int:
+        """One free page at refcount 1; PoolExhausted when empty (the
+        free list is the ONLY allocation source, so a page can never be
+        handed out twice)."""
+        if not self._free:
+            raise PoolExhausted(f"all {self.n_pages} KV pages in use")
+        page = self._free.pop()
+        self._refs[page] = 1
+        return page
+
+    def ref(self, page: int) -> None:
+        if self._refs[page] <= 0:
+            raise ValueError(f"ref of free page {page}")
+        self._refs[page] += 1
+
+    def unref(self, page: int) -> bool:
+        """Drop one reference; returns True when this freed the page."""
+        r = self._refs[page] - 1
+        if r < 0:
+            raise ValueError(f"unref of free page {page}")
+        self._refs[page] = r
+        if r == 0:
+            self._free.append(page)
+            return True
+        return False
+
+    def cow(self, page: int) -> tuple[int, bool]:
+        """Copy-on-write claim: exclusive owner keeps the page (False);
+        a shared page is swapped for a fresh allocation (True — the
+        caller must copy the payload) and the share is released. Refs
+        balance by construction: +1 alloc, -1 unref."""
+        if self._refs[page] <= 0:
+            raise ValueError(f"cow of free page {page}")
+        if self._refs[page] == 1:
+            return page, False
+        fresh = self.alloc()
+        self.unref(page)
+        return fresh, True
+
+    def refcount(self, page: int) -> int:
+        return self._refs[page]
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def shared_count(self) -> int:
+        """Pages referenced more than once (the dedup win the gauges show)."""
+        return sum(1 for r in self._refs if r > 1)
+
+
+@dataclasses.dataclass
+class _Node:
+    """One page-sized chunk of a cached prefix. The trie holds exactly one
+    pool reference per node (dropped on eviction)."""
+    chunk: tuple
+    page: int
+    parent: Optional["_Node"]
+    children: dict = dataclasses.field(default_factory=dict)
+    pinned: bool = False      # on a register_prefix path: never evicted
+    last_used: int = 0
+
+
+@dataclasses.dataclass
+class MatchResult:
+    pages: list          # matched page ids, in prompt order, ONE REF HELD EACH
+    matched_tokens: int  # pages * page_tokens
+
+
+class PrefixTrie:
+    """Radix trie over page-sized token chunks; one root per adapter id.
+    Not thread-safe — the engine serializes under its prefix lock."""
+
+    def __init__(self, pool: PagePool, page_tokens: int):
+        if page_tokens < 1:
+            raise ValueError(f"page_tokens must be >= 1, got {page_tokens}")
+        self.pool = pool
+        self.page_tokens = page_tokens
+        self._roots: dict[int, dict] = {}
+        # flat registry for LRU scans, keyed by id() so eviction and
+        # adapter teardown remove in O(1) (a list's remove() would make
+        # drop_adapter O(N^2) under the engine's prefix lock)
+        self._nodes: dict[int, _Node] = {}
+        self._clock = 0
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def _chunks(self, tokens: list, n: int):
+        t = self.page_tokens
+        return [tuple(tokens[i * t:(i + 1) * t]) for i in range(n)]
+
+    def match(self, adapter_id: int, tokens: list) -> MatchResult:
+        """Longest full-page prefix of ``tokens`` present in the trie,
+        capped so AT LEAST ONE prompt token remains to compute (the
+        engine needs last-position logits, so a fully-cached prompt still
+        recomputes its final token — vLLM does the same). Every returned
+        page carries one extra pool reference; the caller MUST
+        ``release`` after gathering."""
+        self._clock += 1
+        max_chunks = max(0, (len(tokens) - 1) // self.page_tokens)
+        node_map = self._roots.get(adapter_id, {})
+        pages: list[int] = []
+        for chunk in self._chunks(tokens, max_chunks):
+            node = node_map.get(chunk)
+            if node is None:
+                break
+            node.last_used = self._clock
+            self.pool.ref(node.page)
+            pages.append(node.page)
+            node_map = node.children
+        return MatchResult(pages, len(pages) * self.page_tokens)
+
+    def release(self, pages: list) -> None:
+        for p in pages:
+            self.pool.unref(p)
+
+    def insert(self, adapter_id: int, tokens: list,
+               write_pages: Callable[[list, int], None],
+               pin: bool = False) -> tuple[int, int]:
+        """Cache every full page of ``tokens`` not already present.
+        ``write_pages(page_ids, start_chunk)`` copies the KV payload into
+        the arena BEFORE the nodes become matchable (same lock, so no
+        reader can race it). Evicts LRU leaves when the pool runs dry —
+        never a node on the path being extended. Returns (pages added,
+        pages evicted)."""
+        self._clock += 1
+        want = len(tokens) // self.page_tokens
+        node_map = self._roots.setdefault(adapter_id, {})
+        parent: Optional[_Node] = None
+        chunks = self._chunks(tokens, want)
+        depth = 0
+        path: list[_Node] = []
+        for chunk in chunks:
+            node = node_map.get(chunk)
+            if node is None:
+                break
+            node.last_used = self._clock
+            if pin:
+                node.pinned = True
+            parent, node_map, depth = node, node.children, depth + 1
+            path.append(node)
+        evicted = 0
+        new_nodes: list[_Node] = []
+        protect = set(id(n) for n in path)
+        for chunk in chunks[depth:]:
+            try:
+                page = self.pool.alloc()
+            except PoolExhausted:
+                evicted += self._evict_lru(protect)
+                try:
+                    page = self.pool.alloc()
+                except PoolExhausted:
+                    break  # nothing evictable: cache what we could
+            node = _Node(chunk=chunk, page=page, parent=parent, pinned=pin,
+                         last_used=self._clock)
+            new_nodes.append(node)
+            protect.add(id(node))
+            parent = node
+        if new_nodes:
+            # payload first, visibility second (one lock, but the order
+            # keeps a future finer-locking refactor honest)
+            write_pages([n.page for n in new_nodes], depth)
+            node_map = (self._roots[adapter_id] if not path
+                        else path[-1].children)
+            for node in new_nodes:
+                node_map[node.chunk] = node
+                self._nodes[id(node)] = node
+                node_map = node.children
+        return len(new_nodes), evicted
+
+    def _evict_lru(self, protect: set) -> int:
+        """Drop the least-recently-used unpinned LEAF (children would
+        orphan otherwise; parents become leaves as their subtrees drain).
+        The pool frees the page only if no in-flight match still holds it
+        — eviction never frees a referenced page. Returns 1/0."""
+        victim: Optional[_Node] = None
+        for node in self._nodes.values():
+            if node.children or node.pinned or id(node) in protect:
+                continue
+            if victim is None or node.last_used < victim.last_used:
+                victim = node
+        if victim is None:
+            return 0
+        owner = (victim.parent.children if victim.parent is not None
+                 else self._roots_containing(victim))
+        owner.pop(victim.chunk, None)
+        del self._nodes[id(victim)]
+        self.pool.unref(victim.page)
+        return 1
+
+    def _roots_containing(self, node: _Node) -> dict:
+        for root in self._roots.values():
+            if root.get(node.chunk) is node:
+                return root
+        return {}
+
+    def drop_adapter(self, adapter_id: int) -> int:
+        """Forget an adapter's whole subtree (its weights were replaced,
+        so its cached prefix KV is stale). Returns pages released."""
+        root = self._roots.pop(adapter_id, None)
+        if root is None:
+            return 0
+        dropped = 0
+        stack = list(root.values())
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            del self._nodes[id(node)]
+            self.pool.unref(node.page)
+            dropped += 1
+        return dropped
+
+    def shared_pages(self) -> int:
+        """Pages whose KV serves more than one cached sequence: an interior
+        node's page backs its own path AND every extension under it, and a
+        refcount > 1 means an in-flight gather also holds it."""
+        return sum(1 for n in self._nodes.values()
+                   if n.children or self.pool.refcount(n.page) > 1)
+
+    def stats(self) -> dict:
+        return {"nodes": len(self._nodes),
+                "pinned": sum(1 for n in self._nodes.values()
+                              if n.pinned),
+                "adapters": sorted(self._roots)}
+
+
+class DensePrefixStore:
+    """Registered-prefix fallback for ring/mixed (``abs_pos``) cache
+    layouts, which cannot page: position p lives at ring slot p %% R and
+    early positions are overwritten by design, so the only faithful
+    snapshot is the whole single-slot cache at prefix end — exactly what
+    the pre-paged registry stored. Same semantics as before: longest
+    registered prefix wins, per-adapter variants fill lazily (adapter
+    deltas flow into K/V) and are LRU-bounded by ``max_adapter_variants``
+    while base variants stay pinned. Not thread-safe (engine lock)."""
+
+    @dataclasses.dataclass
+    class _Entry:
+        tokens: list
+        variants: dict
+        lru: dict = dataclasses.field(default_factory=dict)
+
+    def __init__(self, max_adapter_variants: int):
+        self.max_adapter_variants = max_adapter_variants
+        self._entries: list[DensePrefixStore._Entry] = []
+        self._clock = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def lookup(self, tokens: list):
+        """Longest registered prefix of ``tokens`` (entries are kept
+        longest-first), or None."""
+        return next((e for e in self._entries
+                     if len(e.tokens) <= len(tokens)
+                     and tokens[:len(e.tokens)] == e.tokens), None)
+
+    def has(self, tokens: list) -> bool:
+        return any(e.tokens == tokens for e in self._entries)
+
+    def add(self, tokens: list, base_variant) -> None:
+        self._entries.append(self._Entry(tokens=list(tokens),
+                                         variants={0: base_variant}))
+        self._entries.sort(key=lambda e: -len(e.tokens))  # longest first
+
+    def touch(self, entry, adapter_id: int) -> None:
+        self._clock += 1
+        entry.lru[adapter_id] = self._clock
+
+    def put_variant(self, entry, adapter_id: int, var) -> bool:
+        """Store a lazily-built adapter variant (False if a racing fill
+        won); evicts LRU adapter variants past the budget — base
+        variants were explicitly registered and stay pinned."""
+        if adapter_id in entry.variants:
+            return False
+        entry.variants[adapter_id] = var
+        self.touch(entry, adapter_id)
+        cap = self.max_adapter_variants
+        while True:
+            ad_vars = [(e.lru.get(aid, 0), e, aid)
+                       for e in self._entries
+                       for aid in e.variants if aid != 0]
+            if len(ad_vars) <= cap:
+                return True
+            _, victim, aid = min(ad_vars, key=lambda t: t[0])
+            del victim.variants[aid]
+            victim.lru.pop(aid, None)
+
+    def drop_adapter(self, adapter_id: int) -> None:
+        for e in self._entries:
+            e.variants.pop(adapter_id, None)
+            e.lru.pop(adapter_id, None)
+
+    def snapshot(self) -> list:
+        return [{"tokens": len(e.tokens),
+                 "adapter_variants": len(e.variants)}
+                for e in self._entries]
+
+
+# -- device arena -------------------------------------------------------------
+# jax imports stay inside the builders: PagePool/PrefixTrie/DensePrefixStore
+# are jax-free, so the tier-1 unit tests run host-only.
+
+def _build_gather(t: int):
+    """One jit per POWER-OF-TWO page count: callers pad ``ids`` up to a
+    bucket (repeating a valid page id) and pass the true token count as
+    ``index_val`` — padded positions land beyond ``index``, which the
+    attention mask never exposes and later writes overwrite (the same
+    decode-path invariant padded prefill relies on). Bounds compile
+    variants to log2(cache_len / page_tokens) instead of one per distinct
+    prefix length."""
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def gather(single, arena, ids, index_val):
+        n = ids.shape[0]
+        out = dict(single)
+        for name, a in arena.items():
+            frag = a[:, ids]  # (l, n, T, ...)
+            frag = frag.reshape((a.shape[0], 1, n * t) + a.shape[3:])
+            out[name] = single[name].at[:, :, :n * t].set(frag)
+        out["index"] = jnp.broadcast_to(
+            index_val.astype(jnp.int32), (1,))
+        return out
+
+    return gather
+
+
+def _build_write(t: int):
+    """One jit per POWER-OF-TWO page count (callers binary-decompose a
+    run of new pages); the token offset is a TRACED dynamic-slice start,
+    so it never forces a recompile."""
+    import jax
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def write(arena, single, ids, start_tok):
+        n = ids.shape[0]
+        out = {}
+        for name, a in arena.items():
+            frag = jax.lax.dynamic_slice_in_dim(single[name], start_tok,
+                                                n * t, axis=2)
+            frag = frag.reshape((a.shape[0], n, t) + a.shape[3:])
+            out[name] = a.at[:, ids].set(frag)
+        return out
+
+    return write
+
+
+class PagedKVStore:
+    """The HBM arena behind PagePool/PrefixTrie: one array per KV cache
+    section, section shape with batch -> n_pages and positions ->
+    page_tokens (rank preserved, so ``kv_cache_pspec`` applies verbatim
+    and the kv-heads axis stays tensor-sharded under mesh serving).
+
+    Generic over the section dict, so plain K/V, int8 K/V (+ scales) and
+    MLA latent caches all page; ring/mixed layouts are the caller-gated
+    exception (DensePrefixStore). All methods — including every arena
+    read — must run under the engine's prefix lock: ``write`` donates the
+    arena, and a gather racing a donation would read freed buffers."""
+
+    def __init__(self, n_pages: int, page_tokens: int,
+                 single_shape_fn: Callable, mesh=None):
+        import jax
+        import jax.numpy as jnp
+
+        self.page_tokens = page_tokens
+        self.pool = PagePool(n_pages)
+        self.trie = PrefixTrie(self.pool, page_tokens)
+        shapes = jax.eval_shape(single_shape_fn)
+        sections = {name: sd for name, sd in shapes.items()
+                    if name != "index"}
+        if any(name == "abs_pos" for name in sections):
+            raise ValueError("ring/mixed (abs_pos) caches cannot page; "
+                             "gate on the engine's ring_len")
+
+        def build() -> dict:
+            return {name: jnp.zeros(
+                (sd.shape[0], n_pages, page_tokens) + sd.shape[3:], sd.dtype)
+                for name, sd in sections.items()}
+
+        if mesh is None:
+            self.arena = build()
+        else:
+            from jax.sharding import NamedSharding
+            ashapes = jax.eval_shape(build)
+            shardings = {name: NamedSharding(mesh,
+                                             kv_cache_pspec(name, sd.ndim))
+                         for name, sd in ashapes.items()}
+            self.arena = jax.jit(build, out_shardings=shardings)()
+        self._gather = _build_gather(page_tokens)
+        self._write = _build_write(page_tokens)
+
+    @property
+    def page_bytes(self) -> int:
+        """HBM bytes one page pins across all sections (K+V+scales, all
+        layers) — the bench/telemetry sizing number."""
+        return sum(int(a.dtype.itemsize)
+                   * int(a.size) // a.shape[1]
+                   for a in self.arena.values())
+
+    def match(self, adapter_id: int, tokens: list) -> MatchResult:
+        return self.trie.match(adapter_id, tokens)
+
+    def gather(self, pages: list, fresh_single: dict) -> dict:
+        """Matched pages -> a single-request cache with positions
+        0..matched filled and ``index`` set; ``fresh_single`` is donated.
+        Caller still owns the match references (release after). The page
+        list is padded to a power-of-two bucket (see _build_gather) so
+        gathers compile O(log) variants, not one per prefix length."""
+        import jax.numpy as jnp
+        matched = len(pages) * self.page_tokens
+        # position capacity of the single cache, from any paged section
+        cap = next(s.shape[2] for n, s in fresh_single.items()
+                   if n != "index") // self.page_tokens
+        bucket = min(1 << (len(pages) - 1).bit_length(), cap)
+        padded = list(pages) + [pages[0]] * (bucket - len(pages))
+        return self._gather(fresh_single, self.arena,
+                            jnp.asarray(padded, jnp.int32),
+                            jnp.asarray(matched, jnp.int32))
+
+    def release(self, pages: list) -> None:
+        self.trie.release(pages)
+
+    def insert(self, adapter_id: int, tokens: list, single: dict,
+               pin: bool = False) -> tuple[int, int]:
+        """Cache ``tokens``' full pages from a prefilled single-request
+        cache (KV for positions 0..len(tokens) present). Returns
+        (pages added, pages evicted)."""
+        import jax.numpy as jnp
+
+        def write_pages(page_ids: list, start_chunk: int):
+            # binary decomposition: at most log2(run) jitted writes, each
+            # compiled once per power-of-two size (see _build_write)
+            off = 0
+            while off < len(page_ids):
+                size = 1 << ((len(page_ids) - off).bit_length() - 1)
+                self.arena = self._write(
+                    self.arena, single,
+                    jnp.asarray(page_ids[off:off + size], jnp.int32),
+                    jnp.asarray((start_chunk + off) * self.page_tokens,
+                                jnp.int32))
+                off += size
+
+        return self.trie.insert(adapter_id, tokens, write_pages, pin=pin)
+
+    def stats(self) -> dict:
+        return {"pages_total": self.pool.n_pages,
+                "pages_free": self.pool.free_count,
+                "pages_shared": self.trie.shared_pages(),
+                **self.trie.stats()}

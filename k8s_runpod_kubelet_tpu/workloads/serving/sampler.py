@@ -1,0 +1,141 @@
+"""Per-request sampling for the serving engine: seeded PRNG streams,
+temperature / top-k / nucleus filtering, OpenAI presence/frequency
+penalties and logit_bias.
+
+Everything here is a pure function over (logits, per-row parameters) —
+the engine owns the bookkeeping arrays (per-slot seeds/draw counts/token
+counts) and calls in with fixed (B,) shapes so nothing recompiles as
+requests come and go. Split out of the engine so the sampling math is a
+testable unit (and the paged-KV engine rewrite didn't have to carry it)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _row_keys(seeds: jax.Array, draws: jax.Array) -> jax.Array:
+    """Per-row PRNG keys from (request seed, samples drawn so far): sampling
+    is reproducible PER REQUEST (OpenAI ``seed``) and independent of which
+    slot a request lands in or what else shares the batch."""
+    def one(s, d):
+        return jax.random.fold_in(jax.random.PRNGKey(s), d)
+    return jax.vmap(one)(seeds, draws)
+
+
+def _penalized(r) -> bool:
+    return r is not None and (r.presence_penalty != 0.0
+                              or r.frequency_penalty != 0.0)
+
+
+def _bias_row(logit_bias: dict, vocab_size: int) -> np.ndarray:
+    """Dense (V,) f32 additive row from an OpenAI logit_bias map — ONE
+    construction for the first-token path and the per-slot steady state."""
+    row = np.zeros((vocab_size,), np.float32)
+    for t, bias in logit_bias.items():
+        row[int(t)] = float(bias)
+    return row
+
+
+def _logit_modded(r) -> bool:
+    """Penalties or logit_bias: the next token must come from MODIFIED
+    logits, so the speculative K-wide greedy commit (which compares raw
+    argmaxes) is off the table for these requests."""
+    return _penalized(r) or (r is not None and bool(r.logit_bias))
+
+
+@jax.jit
+def _apply_penalties(logits: jax.Array, counts: jax.Array,
+                     presence: jax.Array, frequency: jax.Array) -> jax.Array:
+    """logits (B, V) minus OpenAI penalties from per-slot token counts
+    (B, V): presence once per seen token, frequency per occurrence. Rows
+    with zero penalties pass through unchanged (their counts still exist
+    but multiply by 0)."""
+    c = counts.astype(jnp.float32)
+    pen = (presence[:, None] * (c > 0).astype(jnp.float32)
+           + frequency[:, None] * c)
+    return logits.astype(jnp.float32) - pen
+
+
+@jax.jit
+def _bump_counts(counts: jax.Array, toks: jax.Array,
+                 mask: jax.Array) -> jax.Array:
+    """counts[i, toks[i]] += 1 where mask[i] — fixed (B,) shapes so the
+    per-step update never recompiles."""
+    rows = jnp.arange(counts.shape[0])
+    return counts.at[rows, toks].add(mask.astype(jnp.int32))
+
+
+@jax.jit
+def _set_count_row(counts: jax.Array, slot: jax.Array,
+                   row: jax.Array) -> jax.Array:
+    return counts.at[slot].set(row)
+
+
+def _scaled_and_greedy(logits, temps):
+    """Shared head of both sampling kernels (inlines under jit): argmax for
+    the per-row greedy override, temperature-scaled f32 logits."""
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = (logits / jnp.maximum(temps, 1e-6)[:, None]).astype(jnp.float32)
+    return scaled, greedy
+
+
+@jax.jit
+def _sample_plain(logits: jax.Array, keys: jax.Array,
+                  temps: jax.Array) -> jax.Array:
+    """Unfiltered per-row sampling (no top-k/top-p in the batch): no (B, V)
+    sort on the per-token hot loop."""
+    scaled, greedy = _scaled_and_greedy(logits, temps)
+    sampled = jax.vmap(jax.random.categorical)(keys, scaled)
+    return jnp.where(temps > 0.0, sampled, greedy)
+
+
+@jax.jit
+def _sample_filtered(logits: jax.Array, keys: jax.Array, temps: jax.Array,
+                     top_ks: jax.Array, top_ps: jax.Array) -> jax.Array:
+    v = logits.shape[-1]
+    scaled, greedy = _scaled_and_greedy(logits, temps)
+    sorted_desc = -jnp.sort(-scaled, axis=-1)              # (B, V) desc
+    # top-k threshold: the k-th largest logit (k=0 -> keep all)
+    ks = jnp.where(top_ks > 0, top_ks, v)
+    thresh_k = jnp.take_along_axis(
+        sorted_desc, jnp.clip(ks - 1, 0, v - 1)[:, None], axis=-1)
+    # top-p threshold: smallest prefix of the sorted distribution with
+    # cumulative mass >= p; "cum before this token < p" keeps >= 1 token
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    before = jnp.cumsum(probs, axis=-1) - probs
+    keep = before < top_ps[:, None]
+    idx_p = jnp.sum(keep, axis=-1) - 1                     # last kept
+    thresh_p = jnp.take_along_axis(sorted_desc, idx_p[:, None], axis=-1)
+    thresh = jnp.maximum(thresh_k, thresh_p)
+    filtered = jnp.where(scaled >= thresh, scaled, -jnp.inf)
+    sampled = jax.vmap(jax.random.categorical)(keys, filtered)
+    return jnp.where(temps > 0.0, sampled, greedy)
+
+
+def _sample(logits: jax.Array, keys: jax.Array, temps: list[float],
+            top_ks: Optional[list[int]] = None,
+            top_ps: Optional[list[float]] = None) -> jax.Array:
+    """Per-row temperature + top-k + nucleus (top-p) sampling with PER-ROW
+    PRNG keys (``keys`` (B, 2) from _row_keys). Filters operate on the
+    temperature-scaled distribution; the (B, V) sort is cheap at serving
+    batch sizes (JetStream does the same).
+
+    Dispatches to JITTED kernels with per-row parameters as ARRAYS — the
+    sampler runs once per decode step, and an eager version costs ~10
+    separate device executions per step; only the all-greedy / any-filter
+    shape of the batch (two variants total) picks the compiled path."""
+    if all(t <= 0.0 for t in temps):
+        return jnp.argmax(logits, axis=-1)
+    b = logits.shape[0]
+    t = jnp.asarray(temps, jnp.float32)
+    top_ks = top_ks or [0] * b
+    top_ps = top_ps or [1.0] * b
+    if all(k <= 0 for k in top_ks) and all(p >= 1.0 for p in top_ps):
+        return _sample_plain(logits, keys, t)
+    return _sample_filtered(logits, keys, t,
+                            jnp.asarray(top_ks, jnp.int32),
+                            jnp.asarray(top_ps, jnp.float32))

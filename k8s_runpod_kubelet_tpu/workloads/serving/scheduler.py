@@ -1,0 +1,226 @@
+"""Serving request/slot state and admission types.
+
+The queueing DATA for the engine: ServingConfig (the knob surface),
+Request (everything a submit carries through the prefill and decode
+threads), _Slot (per-decode-slot host state), and the typed admission
+rejections the HTTP layer maps to 429/503. The engine (engine.py) owns
+the threads and locks; this module owns the shapes they exchange, so the
+paged-KV manager and the sampler can be tested against plain dataclasses
+without spinning an engine."""
+
+from __future__ import annotations
+
+import dataclasses
+from concurrent.futures import Future
+from typing import Any, Optional
+
+# SLO histograms live sub-second: the default bucket ladder (0.5s first
+# bucket, sized for pod provisioning) would crush every TTFT/ITL sample
+# into one bin (ISSUE 2 satellite)
+TTFT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                10.0, 30.0, 60.0)
+ITL_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+               1.0, 2.5)
+UTIL_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+
+
+@dataclasses.dataclass
+class ServingConfig:
+    slots: int = 4               # concurrent decode streams
+    max_prefill_len: int = 512
+    cache_len: int = 1024        # per-slot KV budget (prompt + generation)
+    max_new_tokens: int = 128
+    eos_token: int = -1          # -1 = never stop on a token
+    temperature: float = 0.0     # 0 = greedy
+    quantize_int8: bool = False  # weight-only int8 (models/quant.py): halves
+                                 # weight HBM traffic on the bandwidth-bound
+                                 # decode step
+    # weight-only int4 (two weights per byte, group-wise scales): quarter
+    # weight HBM traffic — the next rung after int8 on the decode-bandwidth
+    # ladder. Covers MoE EXPERT weights too (per-expert unpack kernel,
+    # tests pin parity vs f32 within a threshold). Accuracy drops more
+    # than int8's (4-bit resolution); the tiny pinned model stays
+    # argmax-stable in tests, real models deserve an eval before
+    # production. Mutually exclusive with quantize_int8.
+    quantize_int4: bool = False
+    # speculative decoding via prompt-lookup (n-gram) proposals: draft this
+    # many tokens per decode step and verify them in ONE forward pass
+    # (models/llama.py verify_step). Greedy slots commit every matched draft
+    # token "for free" (decode is memory-bound, so a K-token verify costs
+    # about one decode step); sampled slots fall back to 1 token/step.
+    # Greedy output equals the non-speculative engine's on the pinned f32
+    # test model; the K-wide and 1-wide kernels can reduce in different
+    # orders, so logits within ~1 ulp of a tie may tie-break differently
+    # (bf16 especially) — same model quality, not a correctness loss.
+    speculate_k: int = 0
+    # Ring KV cache for uniformly-windowed models (Mistral): physical cache
+    # per slot shrinks to ~window + write slack while cache_len stays the
+    # LOGICAL budget (prompt + generation length cap). None = auto: on
+    # whenever the model has a uniform sliding window and the ring is
+    # actually smaller; True forces it (error if the model can't); False
+    # disables.
+    ring_cache: Optional[bool] = None
+    # int8 KV cache with per-(position, kv-head) scales: decode reads the
+    # whole cache every step (HBM-bound), so int8 halves that traffic and
+    # doubles how many slots fit a chip. Composes with ring_cache and
+    # quantize_int8 (weights). Accuracy: ~1e-2-level logit perturbation —
+    # greedy outputs typically identical, pinned by tests on the tiny model.
+    quantize_kv_int8: bool = False
+    # donate the engine cache through decode/verify (in-place K-token
+    # updates instead of a full-cache copy per step). The off-switch exists
+    # to MEASURE that HBM claim (bench.py --econ); leave on in production.
+    donate_cache: bool = True
+    # registered-prefix cap: how many DISTINCT prefixes register_prefix()
+    # will pin (as never-evicted trie nodes in the paged pool, or — on
+    # ring/mixed cache layouts that cannot page — as dense single-slot
+    # cache copies)
+    max_prefixes: int = 8
+    # -- paged KV prefix pool (ISSUE 8) ----------------------------------
+    # cross-request prefix cache: every prompt is matched against a radix
+    # trie of KV pages; matched full pages are GATHERED from the shared
+    # HBM arena instead of re-prefilled, and every prefill's full pages
+    # are inserted back (refcounted, LRU-leaf eviction). Off = the trie
+    # and arena are never allocated; register_prefix still works on
+    # ring/mixed layouts via the dense fallback.
+    prefix_cache_enabled: bool = True
+    # tokens per KV page (the pool's allocation and trie-match granule).
+    # Prefixes shorter than one page gain nothing; 16 matches vLLM's
+    # default block and divides every power-of-two prefill bucket.
+    kv_page_tokens: int = 16
+    # pages in the preallocated arena. 0 = auto: one decode-cache's worth
+    # (slots * cache_len / kv_page_tokens), so the prefix pool can at most
+    # double KV HBM and is usually far under it.
+    kv_pool_pages: int = 0
+    # multi-LoRA serving (vLLM-style multi-tenant adapters): rank > 0
+    # preallocates zero-filled adapter stacks of this rank over
+    # ``lora_targets`` so adapters register WITHOUT recompiling the decode
+    # jit (the adapter axis is fixed at max_adapters+1; slot 0 = all-zeros
+    # = base model). Requests pick an adapter by name via submit(adapter=).
+    lora_rank: int = 0
+    lora_targets: tuple = ("wq", "wv")
+    max_adapters: int = 8
+    # admission control: reject new requests once this many are queued
+    # (0 = unbounded). The queue depth GAUGE stays the HPA scale signal;
+    # this is the ceiling that keeps latency bounded until the autoscaler
+    # catches up — rejected submits resolve to EngineOverloaded, which the
+    # HTTP layer maps to 429 + Retry-After.
+    max_queue_depth: int = 0
+
+
+class EngineOverloaded(RuntimeError):
+    """Request rejected at admission: queue is at max_queue_depth."""
+
+
+class EngineDraining(RuntimeError):
+    """Request rejected at admission: the engine is draining (fleet
+    scale-down). In-flight and already-queued requests still finish; the
+    HTTP layer maps this to 503 + Retry-After so clients re-resolve to
+    another replica."""
+
+
+@dataclasses.dataclass
+class Request:
+    prompt: list[int]
+    max_new_tokens: int
+    rid: str
+    future: Future
+    submitted_at: float
+    temperature: float
+    top_k: int = 0          # 0 = no top-k filter
+    top_p: float = 1.0      # 1.0 = no nucleus filter
+    # OpenAI sampling penalties, applied to the logits BEFORE temperature/
+    # filtering: presence subtracts once per token SAMPLED DURING
+    # GENERATION (the prompt never contributes — OpenAI's published
+    # formula and vLLM both count output tokens only), frequency per
+    # occurrence. A penalized request never takes the speculative K-wide
+    # greedy commit (each committed token changes the next step's
+    # penalties).
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    # OpenAI logit_bias: {token_id: bias in [-100, 100]} added to that
+    # token's logit every step (-100 ~ ban, +100 ~ force)
+    logit_bias: Optional[dict] = None
+    adapter_id: int = 0     # multi-LoRA slot (0 = base model)
+    # stop token SEQUENCES: generation ends when the generated tail equals
+    # one (the matched sequence stays in the output; callers strip it).
+    # Checked host-side per committed token — no jit impact.
+    stop: list = dataclasses.field(default_factory=list)
+    # stop STRINGS matched on DECODED text (needs the engine's decode_fn):
+    # exact for BPE vocabularies where a stop string can straddle a token
+    # boundary and the token-sequence fast path above would miss it.
+    # Generation ends when the decoded output contains one; the matched
+    # text stays in the output (callers truncate at its first occurrence).
+    stop_texts: list = dataclasses.field(default_factory=list)
+    # return per-token log P(token | prefix) of each generated token
+    logprobs: bool = False
+    # sampling seed (resolved at submit): the PRNG stream is a pure
+    # function of (seed, draw index), independent of slot placement and
+    # neighbors. On speculative engines bit-exactness additionally needs
+    # the logits to be batch-independent — a bf16 near-tie can round
+    # differently between the K-wide and 1-wide kernels (ServingConfig.
+    # speculate_k caveat), so there "same seed = same distribution" is
+    # the hard guarantee and exact tokens the overwhelmingly common case.
+    seed: int = 0
+    # streaming: called with each generated token id, from the engine thread.
+    # A raising callback (client gone) cancels the request at the next token.
+    on_token: Optional[Any] = None
+    # co-submitted requests with the IDENTICAL prompt (OpenAI n>1): the
+    # prefill runs ONCE and its immutable cache fans out to every member
+    # (nothing donates the single cache, so sharing is safe); each member
+    # samples its own first token from the shared last-position logits
+    fanout: Optional[list] = None
+    # distributed-tracing context (W3C traceparent): trace_id groups this
+    # request's spans with the caller's trace; span_id is the REQUEST root
+    # span's id (the HTTP layer generates it so it can stamp the response
+    # header before the request finishes); parent_span_id is the caller's
+    # inbound span. Empty = the engine mints ids at completion.
+    trace_id: str = ""
+    span_id: str = ""
+    parent_span_id: str = ""
+    # span-boundary timestamps (perf_counter domain, like submitted_at):
+    # queue-wait = submitted->dequeued, prefill = dequeued->prefill_done,
+    # decode = prefill_done->finish (contiguous: ready-queue wait and slot
+    # insertion are decode-span preamble, so child durations sum to the
+    # request latency)
+    dequeued_at: float = 0.0
+    prefill_done_at: float = 0.0
+    first_token_at: float = 0.0
+    # prefix-cache outcome, stamped by the prefill thread: how many prompt
+    # tokens were served from shared KV pages instead of being prefilled
+    # (0 = full prefill). Rides the serving.request span as
+    # prefix_hit/matched_prefix_tokens attrs.
+    matched_prefix_tokens: int = 0
+
+
+@dataclasses.dataclass
+class _Slot:
+    request: Optional[Request] = None
+    generated: list[int] = dataclasses.field(default_factory=list)
+    logprobs: list[float] = dataclasses.field(default_factory=list)
+    remaining: int = 0
+    last_token: int = 0
+    # prompt-lookup drafting state: bigram -> latest start position over
+    # prompt+generated, indexed lazily in _propose — amortized O(1)/token
+    # where a rescan would be O(context) Python per engine step
+    bigram_index: dict = dataclasses.field(default_factory=dict)
+    indexed_upto: int = 0
+    # stop_texts running tail: token ids whose decode is kept just long
+    # enough (in CHARS) to contain any new stop-string match — trimming by
+    # decoded length (not token count) survives zero-char specials and
+    # detokenizer first-token artifacts (r3 advisor finding)
+    stop_tail: list[int] = dataclasses.field(default_factory=list)
+    stop_tail_upto: int = 0
+    # inter-token-latency bookkeeping: perf_counter of the last token this
+    # slot streamed (0 = none yet)
+    last_emit_at: float = 0.0
+
+
+def _fail_future(fut: Future, exc: BaseException) -> None:
+    """set_exception tolerant of a client cancel landing between a done()
+    check and the call — InvalidStateError here must never kill an engine
+    or prefill thread."""
+    try:
+        if not fut.done():
+            fut.set_exception(exc)
+    except Exception:  # noqa: BLE001 — racing future.cancel()
+        pass

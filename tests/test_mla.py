@@ -469,12 +469,14 @@ class TestMlaQLora:
 
 
 def test_prefix_cache_composes_with_latent_cache(mla_params):
-    """The engine's /prefix registry stores and re-inserts MLA latent
-    caches like any K/V cache (pytree-generic): two hits, outputs equal
-    the cold path's."""
+    """MLA latent caches PAGE like any K/V layout (the arena is generic
+    over cache sections, so c/kr page alongside k/v): a registered prefix
+    pins latent pages, later prompts gather them, outputs equal the cold
+    path's. kv_page_tokens=4 so the 10-token prefix spans full pages."""
     e = ServingEngine(MCFG, mla_params,
                       ServingConfig(slots=2, max_prefill_len=16,
-                                    cache_len=64, max_new_tokens=8)).start()
+                                    cache_len=64, max_new_tokens=8,
+                                    kv_page_tokens=4)).start()
     cold = ServingEngine(MCFG, mla_params,
                          ServingConfig(slots=2, max_prefill_len=16,
                                        cache_len=64,

@@ -1,0 +1,188 @@
+"""Paged prefix-cache engine soak (ISSUE 8 acceptance): seeded mixed
+shared-prefix + disjoint traffic through the REAL engine, asserting
+
+- byte-identical outputs vs a prefix_cache_enabled=False engine (greedy
+  and seeded-sampled alike — the cache is a layout/skip optimization,
+  never a distribution change on the pinned f32 model);
+- shared-prefix requests actually SKIP prefill: prefix_cache hits
+  counted, serving.request spans carry prefix_hit/matched_prefix_tokens,
+  and the hit cohort's prefill span is strictly faster than the miss
+  cohort's (medians — the skipped chunks are real wall time);
+- zero page leaks at drain: after the engine drains, every pool page is
+  either free or owned by exactly one trie node (match references all
+  released, eviction/insert refcounts balanced).
+"""
+
+import statistics
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from k8s_runpod_kubelet_tpu.models import init_params, tiny_llama
+from k8s_runpod_kubelet_tpu.workloads.serving import (ServingConfig,
+                                                      ServingEngine)
+
+# ML tier: jax compiles dominate runtime; excluded by -m 'not slow'
+pytestmark = pytest.mark.slow
+
+CFG = tiny_llama(vocab_size=128, embed_dim=64, n_layers=2, n_heads=4,
+                 n_kv_heads=2, mlp_dim=128, max_seq_len=512,
+                 dtype=jnp.float32, param_dtype=jnp.float32)
+SEED = 20260804
+# long shared system prompt: 12 full pages at kv_page_tokens=8, so a hit
+# skips 96 of ~100 prompt tokens — the TTFT claim is about THIS span
+SHARED = [((i * 37) % 120) + 1 for i in range(96)]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _engine(params, enabled: bool) -> ServingEngine:
+    sc = ServingConfig(slots=4, max_prefill_len=32, cache_len=256,
+                       max_new_tokens=16, kv_page_tokens=8,
+                       prefix_cache_enabled=enabled)
+    return ServingEngine(CFG, params, sc).start()
+
+
+def _traffic(rng):
+    """Seeded mix: ~half extend SHARED, half are disjoint prompts."""
+    reqs = []
+    for i in range(24):
+        if rng.random() < 0.5:
+            suffix = [int(rng.integers(1, 120)) for _ in range(
+                int(rng.integers(1, 12)))]
+            reqs.append(SHARED + suffix)
+        else:
+            reqs.append([int(rng.integers(1, 120)) for _ in range(
+                int(rng.integers(3, 40)))])
+    return reqs
+
+
+class TestPagedEngineSoak:
+    def test_soak_identical_outputs_hits_and_zero_leaks(self, params):
+        import numpy as np
+        rng = np.random.default_rng(SEED)
+        prompts = _traffic(rng)
+        # prompts the hit/miss timing comparison leans on: the miss cohort
+        # must contain prompts AS LONG as the shared prefix, or the
+        # comparison would pit a 96-token prefill against 20-token ones
+        long_misses = [[((i * 13 + j * 7) % 110) + 1 for j in range(97)]
+                       for i in range(4)]
+        e_paged = _engine(params, enabled=True)
+        e_plain = _engine(params, enabled=False)
+        try:
+            # warm every jit OUTSIDE the measured cohorts (prefill buckets,
+            # verify chunks, gather/write pow2 buckets) with a same-length
+            # throwaway prefix pair, so the medians compare work, not
+            # compilation
+            warm = [((i * 31) % 110) + 1 for i in range(96)]
+            for e in (e_paged, e_plain):
+                e.submit(warm + [1], max_new_tokens=2).result(timeout=300)
+                e.submit(warm + [2], max_new_tokens=2).result(timeout=300)
+            e_paged.register_prefix(SHARED)
+            futs_a, futs_b = [], []
+            for i, p in enumerate(long_misses + prompts):
+                kw = dict(max_new_tokens=12)
+                if i % 3 == 2:  # every third request samples, seeded
+                    kw.update(temperature=0.8, seed=1000 + i)
+                futs_a.append(e_paged.submit(p, **kw))
+                futs_b.append(e_plain.submit(p, **kw))
+            outs_a = [f.result(timeout=300) for f in futs_a]
+            outs_b = [f.result(timeout=300) for f in futs_b]
+            for i, (a, b) in enumerate(zip(outs_a, outs_b)):
+                assert a["tokens"] == b["tokens"], \
+                    f"seed {SEED} prompt {i}: paged != contiguous"
+
+            hits = e_paged.metrics.get_counter("tpu_serving_prefix_cache_hits")
+            misses = e_paged.metrics.get_counter(
+                "tpu_serving_prefix_cache_misses")
+            n_shared = sum(1 for p in prompts if p[:len(SHARED)] == SHARED)
+            assert hits >= n_shared  # every shared-prefix prompt hit
+            assert misses >= 1
+            # the registered-prefix back-compat series counts the same skips
+            assert e_paged.metrics.get_counter(
+                "tpu_serving_prefix_hits") >= n_shared
+
+            # span evidence: hit cohort carries the attrs and a strictly
+            # faster prefill than the miss cohort (96 tokens skipped)
+            spans = e_paged.tracer.recent(4096)
+            cohort = {o["rid"] for o in outs_a}  # not the warmup requests
+            req_spans = [s for s in spans if s["name"] == "serving.request"
+                         and s["attrs"]["rid"] in cohort]
+            hit_spans = [s for s in req_spans if s["attrs"]["prefix_hit"]]
+            miss_spans = [s for s in req_spans
+                          if not s["attrs"]["prefix_hit"]]
+            assert hit_spans and miss_spans
+            assert all(s["attrs"]["matched_prefix_tokens"] >= 88
+                       for s in hit_spans)
+            by_rid = {s["attrs"]["rid"]: s for s in spans
+                      if s["name"] == "serving.prefill"}
+            def prefill_s(req_span):
+                return by_rid[req_span["attrs"]["rid"]]["duration_s"]
+            hit_med = statistics.median(prefill_s(s) for s in hit_spans)
+            miss_med = statistics.median(
+                prefill_s(s) for s in miss_spans
+                if s["attrs"]["prompt_tokens"] >= 90)  # the long_misses
+            assert hit_med < miss_med, (
+                f"prefix hits should prefill strictly faster: "
+                f"hit median {hit_med:.4f}s vs miss median {miss_med:.4f}s "
+                f"(seed {SEED})")
+
+            # drain and account for every page: free + trie-owned == total,
+            # nothing multiply-referenced once traffic stops
+            e_paged.drain()
+            assert e_paged.drained
+            store = e_paged._kv_store
+            stats = e_paged.prefix_cache_stats()
+            assert stats["pages_free"] + stats["nodes"] \
+                == stats["pages_total"], f"leaked pages (seed {SEED})"
+            for node in store.trie._nodes.values():
+                assert store.pool.refcount(node.page) == 1, \
+                    f"dangling match reference on page {node.page}"
+        finally:
+            e_paged.stop()
+            e_plain.stop()
+
+    def test_cross_request_reuse_without_registration(self, params):
+        """The trie is a CACHE, not a registry: the second request sharing
+        an (unregistered) prefix skips it."""
+        e = _engine(params, enabled=True)
+        try:
+            p1 = SHARED[:40] + [1, 2]
+            p2 = SHARED[:40] + [3, 4, 5]
+            e.submit(p1, max_new_tokens=4).result(timeout=300)
+            before = e.metrics.get_counter("tpu_serving_prefix_cache_hits")
+            e.submit(p2, max_new_tokens=4).result(timeout=300)
+            assert e.metrics.get_counter(
+                "tpu_serving_prefix_cache_hits") == before + 1
+            # registered-series untouched: nothing was registered
+            assert e.metrics.get_counter("tpu_serving_prefix_hits") == 0
+        finally:
+            e.stop()
+
+    def test_pool_exhaustion_degrades_not_fails(self, params):
+        """A pool too small for the traffic caches what it can and keeps
+        serving correct outputs (PoolExhausted never escapes)."""
+        import numpy as np
+        sc = ServingConfig(slots=2, max_prefill_len=32, cache_len=256,
+                           max_new_tokens=8, kv_page_tokens=8,
+                           kv_pool_pages=3)
+        e = ServingEngine(CFG, params, sc).start()
+        e_plain = _engine(params, enabled=False)
+        try:
+            rng = np.random.default_rng(SEED + 1)
+            for _ in range(6):
+                p = [int(rng.integers(1, 120)) for _ in range(30)]
+                a = e.submit(p, max_new_tokens=6).result(timeout=300)
+                b = e_plain.submit(p, max_new_tokens=6).result(timeout=300)
+                assert a["tokens"] == b["tokens"]
+            stats = e.prefix_cache_stats()
+            assert stats["pages_total"] == 3
+            assert stats["pages_free"] + stats["nodes"] == 3
+        finally:
+            e.stop()
+            e_plain.stop()

@@ -1,0 +1,202 @@
+"""Paged KV pool + prefix trie unit tests (ISSUE 8 tier-1).
+
+Pure host-side bookkeeping — no jax arrays, no engine — so the allocator
+and trie invariants the serving engine leans on are pinned
+deterministically:
+
+- the free list never hands a page out twice, and refs balance;
+- COW claims balance refcounts (shared -> fresh copy, exclusive -> same);
+- trie match = longest common FULL-PAGE token prefix, capped so >= 1
+  prompt token remains to compute;
+- eviction is LRU-leaves-first, never a pinned node, and never FREES a
+  page an in-flight match still references.
+"""
+
+import pytest
+
+from k8s_runpod_kubelet_tpu.workloads.serving.kv_manager import (
+    DensePrefixStore, PagePool, PoolExhausted, PrefixTrie)
+
+
+class TestPagePool:
+    def test_never_double_allocates(self):
+        pool = PagePool(8)
+        got = [pool.alloc() for _ in range(8)]
+        assert sorted(got) == list(range(8))      # every page exactly once
+        with pytest.raises(PoolExhausted):
+            pool.alloc()
+
+    def test_unref_to_zero_frees_and_refs_balance(self):
+        pool = PagePool(2)
+        p = pool.alloc()
+        pool.ref(p)
+        assert pool.refcount(p) == 2
+        assert pool.unref(p) is False             # still referenced
+        assert pool.unref(p) is True              # freed
+        assert pool.free_count == 2
+        # freed page is allocatable again, exactly once
+        a, b = pool.alloc(), pool.alloc()
+        assert sorted((a, b)) == [0, 1]
+
+    def test_unref_below_zero_raises(self):
+        pool = PagePool(1)
+        p = pool.alloc()
+        pool.unref(p)
+        with pytest.raises(ValueError):
+            pool.unref(p)
+
+    def test_ref_of_free_page_raises(self):
+        pool = PagePool(1)
+        with pytest.raises(ValueError):
+            pool.ref(0)
+
+    def test_cow_exclusive_keeps_page(self):
+        pool = PagePool(2)
+        p = pool.alloc()
+        q, copied = pool.cow(p)
+        assert (q, copied) == (p, False)
+        assert pool.refcount(p) == 1              # unchanged
+
+    def test_cow_shared_allocates_and_balances(self):
+        pool = PagePool(2)
+        p = pool.alloc()
+        pool.ref(p)                               # shared: two holders
+        q, copied = pool.cow(p)
+        assert copied and q != p
+        assert pool.refcount(p) == 1              # the other holder remains
+        assert pool.refcount(q) == 1              # the caller's copy
+        # total references conserved: 2 before, 2 after
+        pool.unref(p)
+        pool.unref(q)
+        assert pool.free_count == 2
+
+    def test_shared_count(self):
+        pool = PagePool(3)
+        a = pool.alloc()
+        pool.alloc()
+        pool.ref(a)
+        assert pool.shared_count == 1
+
+
+def _write_noop(page_ids, start_chunk):
+    pass
+
+
+class TestPrefixTrie:
+    def _trie(self, n_pages=16, t=4):
+        pool = PagePool(n_pages)
+        return PrefixTrie(pool, t), pool
+
+    def test_match_is_longest_common_full_page_prefix(self):
+        trie, _ = self._trie()
+        toks = list(range(10))                    # pages: [0..3], [4..7]
+        trie.insert(0, toks, _write_noop)
+        assert len(trie) == 2                     # only FULL pages cached
+        m = trie.match(0, list(range(10)) + [99])
+        assert m.matched_tokens == 8
+        trie.release(m.pages)
+        m = trie.match(0, list(range(6)))         # shares page 1 only
+        assert m.matched_tokens == 4
+        trie.release(m.pages)
+        m = trie.match(0, [7, 7, 7, 7])           # diverges at page 1
+        assert m.matched_tokens == 0
+
+    def test_match_leaves_one_token_to_compute(self):
+        trie, _ = self._trie()
+        toks = list(range(8))
+        trie.insert(0, toks, _write_noop)
+        m = trie.match(0, toks)                   # prompt == cached exactly
+        assert m.matched_tokens == 4              # last page recomputes
+        trie.release(m.pages)
+
+    def test_insert_shares_common_prefix_pages(self):
+        trie, pool = self._trie()
+        trie.insert(0, list(range(8)), _write_noop)
+        used_before = pool.n_pages - pool.free_count
+        # same first page, new second page
+        trie.insert(0, [0, 1, 2, 3, 9, 9, 9, 9], _write_noop)
+        assert pool.n_pages - pool.free_count == used_before + 1
+        assert trie.shared_pages() >= 1           # the common page is interior
+
+    def test_adapter_roots_are_distinct(self):
+        trie, _ = self._trie()
+        toks = list(range(8))
+        trie.insert(0, toks, _write_noop)
+        assert trie.match(1, toks).matched_tokens == 0
+        trie.insert(1, toks, _write_noop)
+        m = trie.match(1, toks + [1])
+        assert m.matched_tokens == 8
+        trie.release(m.pages)
+        assert trie.drop_adapter(1) == 2
+        assert trie.match(1, toks).matched_tokens == 0
+
+    def test_eviction_lru_leaves_first_never_pinned(self):
+        trie, pool = self._trie(n_pages=3, t=4)
+        trie.insert(0, list(range(4)), _write_noop, pin=True)     # pinned
+        trie.insert(0, [8] * 4, _write_noop)                      # leaf A
+        trie.insert(0, [9] * 4, _write_noop)                      # leaf B
+        assert pool.free_count == 0
+        # touch A so B becomes the LRU leaf
+        m = trie.match(0, [8] * 4 + [0])
+        trie.release(m.pages)
+        added, evicted = trie.insert(0, [7] * 4 + [1], _write_noop)
+        assert (added, evicted) == (1, 1)
+        stats = trie.stats()
+        assert stats["pinned"] == 1                               # survived
+        # the LRU leaf (B) was the victim; A and the pinned page remain
+        assert trie.match(0, [9] * 4 + [0]).matched_tokens == 0
+        for probe in ([8] * 4 + [0], [7] * 4 + [1],
+                      list(range(4)) + [99]):
+            m = trie.match(0, probe)
+            assert m.matched_tokens == 4, probe
+            trie.release(m.pages)
+
+    def test_eviction_never_frees_a_referenced_page(self):
+        trie, pool = self._trie(n_pages=2, t=4)
+        trie.insert(0, [1] * 4, _write_noop)
+        trie.insert(0, [2] * 4, _write_noop)
+        m = trie.match(0, [1] * 4 + [0])          # holds a ref on page A
+        assert m.matched_tokens == 4
+        held = m.pages[0]
+        # pool is full; a new insert must evict a node — possibly A's —
+        # but A's PAGE cannot return to the free list while we hold it
+        trie.insert(0, [3] * 4 + [0], _write_noop)
+        assert held not in pool._free
+        trie.release(m.pages)                     # last ref drops -> free OK
+
+    def test_partial_insert_when_nothing_evictable(self):
+        trie, pool = self._trie(n_pages=1, t=4)
+        trie.insert(0, [1] * 4, _write_noop, pin=True)
+        added, evicted = trie.insert(0, [2] * 8, _write_noop)
+        assert (added, evicted) == (0, 0)         # degraded, not an error
+        assert pool.free_count == 0
+
+    def test_insert_write_callback_gets_new_pages_and_offset(self):
+        trie, _ = self._trie()
+        calls = []
+        trie.insert(0, list(range(8)),
+                    lambda ids, start: calls.append((list(ids), start)))
+        assert calls == [([0, 1], 0)]
+        calls.clear()
+        trie.insert(0, list(range(8)) + [9] * 4,
+                    lambda ids, start: calls.append((list(ids), start)))
+        assert calls == [([2], 2)]                # only the NEW tail chunk
+
+
+class TestDensePrefixStore:
+    def test_longest_registered_wins_and_variants_bounded(self):
+        store = DensePrefixStore(max_adapter_variants=2)
+        store.add([1, 2], "short")
+        store.add([1, 2, 3, 4], "long")
+        entry = store.lookup([1, 2, 3, 4, 5])
+        assert entry.tokens == [1, 2, 3, 4]
+        assert store.lookup([9]) is None
+        # adapter variants LRU-bound at 2; base variants stay pinned
+        for aid in (1, 2, 3):
+            assert store.put_variant(entry, aid, f"v{aid}")
+        n_vars = sum(1 for e in store._entries
+                     for aid in e.variants if aid != 0)
+        assert n_vars == 2
+        assert 0 in entry.variants                # base never evicted
+        store.drop_adapter(2)
+        assert 2 not in entry.variants

@@ -30,6 +30,10 @@ def params():
 
 
 def _engine(params, **kw):
+    # kv_page_tokens=4 so the 10-token PREFIX spans full pages (2 pages +
+    # a 2-token tail the trie recomputes) — the default 16 would make it
+    # sub-page and cache nothing
+    kw.setdefault("kv_page_tokens", 4)
     sc = ServingConfig(slots=2, max_prefill_len=8, cache_len=64,
                        max_new_tokens=12, **kw)
     return ServingEngine(CFG, params, sc).start()
@@ -101,9 +105,14 @@ class TestPrefixCache:
         pins a KV cache in HBM until restart)."""
         e = _engine(params, max_prefixes=2)
         try:
+            pinned_before = None
             for _ in range(5):
-                e.register_prefix(PREFIX)     # idempotent, not 5 caches
-            assert len(e._prefixes) == 1
+                e.register_prefix(PREFIX)     # idempotent, not 5 cache sets
+                stats = e.prefix_cache_stats()
+                assert stats["registered"] == 1
+                if pinned_before is None:
+                    pinned_before = stats["pinned"]
+                assert stats["pinned"] == pinned_before  # no re-pin growth
             e.register_prefix(PREFIX[:3])
             with pytest.raises(ValueError, match="registry full"):
                 e.register_prefix(PREFIX[:5])
@@ -155,6 +164,7 @@ class TestPrefixWithLora:
         return {**wrapped, "layers": layers}
 
     def _lora_engine(self, params, **kw):
+        kw.setdefault("kv_page_tokens", 4)  # see _engine
         sc = ServingConfig(slots=2, max_prefill_len=8, cache_len=64,
                            max_new_tokens=12, lora_rank=self.RANK,
                            lora_targets=self.TARGETS, **kw)
@@ -231,18 +241,25 @@ class TestPrefixWithLora:
         finally:
             e.stop()
 
-    def test_adapter_variants_lru_bounded(self, params):
-        e = self._lora_engine(params, max_prefixes=2, max_adapters=4)
-        e.register_prefix(PREFIX)
+    def test_adapter_variants_pool_bounded(self, params):
+        """Per-adapter prefix KV is pool-bounded: with a deliberately tiny
+        page pool, four adapters' variants can't all stay cached — LRU
+        leaves evict, pinned (registered) pages survive, and the engine
+        keeps answering correctly through the churn."""
+        e = self._lora_engine(params, max_adapters=4, kv_pool_pages=6)
+        e.register_prefix(PREFIX)     # pins 2 pages of the 6
         for i in range(4):
             e.register_adapter(f"t{i}", self._lora(params, seed=i + 1))
         try:
-            for i in range(4):   # 4 adapter variants > cap of 2
+            for i in range(4):   # 4 adapters x ~3 pages each >> 4 free pages
                 e.submit(PREFIX + [i], max_new_tokens=4,
                          adapter=f"t{i}").result(timeout=60)
-            n_vars = sum(1 for entry in e._prefixes
-                         for aid in entry.variants if aid != 0)
-            assert n_vars <= 2
+            stats = e.prefix_cache_stats()
+            assert stats["pages_total"] == 6
+            assert stats["pinned"] >= 2          # registered pages survive
+            assert stats["pages_free"] >= 0
+            assert e.metrics.get_counter(
+                "tpu_serving_prefix_cache_evictions") > 0
             # the cache still answers correctly after evictions
             out = e.submit(PREFIX + [0], max_new_tokens=4,
                            adapter="t0").result(timeout=60)

@@ -47,6 +47,9 @@ def _mesh(tensor=2, data=1, expert=1):
 
 def _engine(cfg, params, mesh=None, **kw):
     kw.setdefault("cache_len", 64)
+    # page granule below the 10-token test prefixes, so the paged prefix
+    # pool (and its mesh-sharded arena) is exercised, not bypassed
+    kw.setdefault("kv_page_tokens", 4)
     sc = ServingConfig(slots=2, max_prefill_len=8, max_new_tokens=12, **kw)
     return ServingEngine(cfg, params, sc, mesh=mesh).start()
 
@@ -335,10 +338,13 @@ def test_kv_cache_pspec_is_the_shared_contract():
     src = pathlib.Path(__file__).resolve().parents[1] / "tools" / "aot_check.py"
     text = src.read_text()
     assert "from k8s_runpod_kubelet_tpu.workloads.serving import kv_cache_pspec" in text
-    # and the engine's own builder goes through it too
-    eng = pathlib.Path(__file__).resolve().parents[1] / \
-        "k8s_runpod_kubelet_tpu" / "workloads" / "serving.py"
-    assert "kv_cache_pspec(name, sd.ndim)" in eng.read_text()
+    # and the engine's own cache builder AND the paged arena builder go
+    # through it too (one layout contract, three consumers)
+    pkg = pathlib.Path(__file__).resolve().parents[1] / \
+        "k8s_runpod_kubelet_tpu" / "workloads" / "serving"
+    assert "kv_cache_pspec(name, sd.ndim)" in (pkg / "engine.py").read_text()
+    assert "kv_cache_pspec(name, sd.ndim)" in \
+        (pkg / "kv_manager.py").read_text()
     # spec semantics: K/V shard heads second-to-last, scales last, index repl
     from k8s_runpod_kubelet_tpu.parallel.mesh import AXES
     assert kv_cache_pspec("k", 5) == (None, None, None, AXES.TENSOR, None)

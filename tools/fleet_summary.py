@@ -110,15 +110,20 @@ def load_table(snapshots: list[dict]) -> list[str]:
                 latest[rep["replica_id"]] = rep
     out = ["", "== latest replica load (registry snapshots) ==",
            f"{'replica':<20} {'state':<9} {'slots':>11} {'queue':>6} "
-           f"{'kv_tokens':>10} {'ttft_p95':>9} {'hb_age':>7}"]
+           f"{'kv_tokens':>10} {'ttft_p95':>9} {'prefix%':>8} {'hb_age':>7}"]
     for rid in sorted(latest):
         rep = latest[rid]
         st = rep.get("stats", {})
         slots = f"{st.get('active_slots', 0)}/{st.get('max_slots', 0)}"
+        # prefix-cache hit rate: per-replica proof the router's
+        # prefix-affinity concentrates reusable prompts (ISSUE 8)
+        hit = st.get("prefix_hit_rate")
+        hit_s = "-" if hit is None else f"{100.0 * float(hit):.1f}%"
         out.append(f"{rid:<20} {rep.get('state', '?'):<9} {slots:>11} "
                    f"{st.get('queue_depth', 0):>6} "
                    f"{st.get('kv_cache_tokens', 0):>10} "
                    f"{st.get('ttft_p95_s', 0.0):>8.3f}s "
+                   f"{hit_s:>8} "
                    f"{rep.get('heartbeat_age_s', 0.0):>6.1f}s")
     return out
 
