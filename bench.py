@@ -38,6 +38,11 @@ Usage:
                              # decode attention at the same geometry
                              # (CPU runs the reference path; the kernel
                              # claim needs a TPU)
+  python bench.py --disagg   # disaggregated serving: KV handoff
+                             # bytes/sec (serialize -> adopt across two
+                             # paged arenas) + per-role TTFT/ITL through
+                             # real engines (--smoke = codec cell only;
+                             # CPU runs tiny geometry, claims need TPU)
   python bench.py --mfu-sweep  # training MFU levers: remat none/dots,
                              # batch, 530M width (needs TPU)
   python bench.py --attn-tune  # flash block-size grid at the training
@@ -103,6 +108,10 @@ _STAGED_QUEUE = [
     # paged-attention decode (ISSUE 8): the serving engine's prefix-pool
     # layout driven through the Pallas kernel vs contiguous decode
     ("paged_attn", ["--paged-attn"], 1800),
+    # disaggregated serving (ISSUE 9): KV handoff bytes/sec at the 8B KV
+    # geometry + per-role TTFT/ITL (prefill hop, decode-with-adopted-KV,
+    # unified cold) through real engines on the paged decode loop
+    ("disagg", ["--disagg"], 2400),
     ("serve_8b", ["--serve", "--model", "llama3-8b", "--int8", "--kv-int8"],
      2400),
     # int4 weights via the Pallas unpack kernel (ops/int4_matmul.py):
@@ -416,6 +425,163 @@ def run_paged_attn_bench() -> int:
                "pallas": bool(on_tpu),
                "dtype": dtype.__name__,
                "backend": jax.default_backend()})
+    return 0
+
+
+def run_disagg_bench(smoke: bool = False) -> int:
+    """Disaggregated serving cells (ISSUE 9).
+
+    Cell 1 — KV handoff throughput: a prompt's full pages leave one paged
+    arena through fleet/handoff.py's wire format and adopt into another
+    (serialize -> deserialize -> trie adoption), reported as bytes/sec at
+    the llama3-8b KV geometry on TPU (a tiny-geometry smoke on CPU). This
+    is the payload path a prefill replica pushes to a decode replica.
+
+    Cell 2 (skipped under ``smoke``) — per-role TTFT/ITL through REAL
+    engines: a prefill-role engine's hop latency (prefill compute +
+    export + serialize), then a decode-role engine that adopted the pages
+    serving the same prompt (TTFT with zero-copy adopted KV, ITL from the
+    paged decode loop), against a unified engine's cold TTFT — the
+    interference number disaggregation exists to improve."""
+    _force_platform_from_env()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from k8s_runpod_kubelet_tpu.fleet.handoff import (deserialize_pages,
+                                                      serialize_pages)
+    from k8s_runpod_kubelet_tpu.workloads.serving.kv_manager import \
+        PagedKVStore
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:   # llama3-8b KV geometry: 32 layers, 8 kv heads, hd 128
+        layers, hkv, d, t, n_tokens = 32, 8, 128, 16, 2048
+        dtype = jnp.bfloat16
+    else:
+        layers, hkv, d, t, n_tokens = 2, 2, 64, 16, 256
+        dtype = jnp.float32
+    cache_len = n_tokens
+    n_pages = 2 * (n_tokens // t)
+
+    def factory():
+        return {"k": jnp.zeros((layers, 1, cache_len, hkv, d), dtype),
+                "v": jnp.zeros((layers, 1, cache_len, hkv, d), dtype),
+                "index": jnp.zeros((1,), jnp.int32)}
+
+    src, dst = PagedKVStore(n_pages, t, factory), \
+        PagedKVStore(n_pages, t, factory)
+    tokens = [(i * 17) % 1000 + 1 for i in range(n_tokens)]
+    key = jax.random.PRNGKey(0)
+    single = {"k": jax.random.normal(key, (layers, 1, cache_len, hkv, d),
+                                     dtype),
+              "v": jax.random.normal(key, (layers, 1, cache_len, hkv, d),
+                                     dtype),
+              "index": jnp.asarray([n_tokens], jnp.int32)}
+    src.insert(0, tokens, single)
+    t0 = time.perf_counter()
+    m = src.match_full(0, tokens)
+    frags = src.export_pages(m.pages)
+    sections = {name: np.asarray(a) for name, a in frags.items()}
+    src.release(m.pages)
+    blob = serialize_pages(tokens[:m.matched_tokens], t, sections)
+    ser_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    header, got = deserialize_pages(blob, expect_page_tokens=t,
+                                    expect_sections=dst.section_spec())
+    dst.adopt(0, header["tokens"], got)
+    adopt_s = time.perf_counter() - t0
+    _emit({"metric": "kv_handoff_bytes_per_sec",
+           "value": round(len(blob) / (ser_s + adopt_s), 1),
+           "unit": "B/s", "bytes": len(blob),
+           "pages": header["n_pages"], "page_tokens": t,
+           "tokens": n_tokens, "layers": layers, "kv_heads": hkv,
+           "head_dim": d, "dtype": np.dtype(dtype).name,
+           "serialize_us": round(ser_s * 1e6, 1),
+           "adopt_us": round(adopt_s * 1e6, 1),
+           "backend": jax.default_backend()})
+    if smoke:
+        return 0
+
+    # -- cell 2: per-role TTFT/ITL through real engines ----------------------
+    from k8s_runpod_kubelet_tpu.workloads.serving import (ServingConfig,
+                                                          ServingEngine)
+    if on_tpu:
+        cfg = _serve_model("llama3-8b")
+        params = _serve_params(cfg, 8)
+        sc = ServingConfig(slots=8, max_prefill_len=512, cache_len=2048,
+                           max_new_tokens=64, quantize_int8=False,
+                           kv_page_tokens=16)
+        prompt = [(j % 250) + 1 for j in range(1024)]
+        new_toks = 64
+    else:
+        from k8s_runpod_kubelet_tpu.models import tiny_llama
+        cfg = tiny_llama(vocab_size=128, embed_dim=64, n_layers=2,
+                         n_heads=4, n_kv_heads=2, mlp_dim=128,
+                         max_seq_len=512, dtype=jnp.float32,
+                         param_dtype=jnp.float32)
+        from k8s_runpod_kubelet_tpu.models import init_params
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        sc = ServingConfig(slots=2, max_prefill_len=32, cache_len=256,
+                           max_new_tokens=16, kv_page_tokens=8)
+        prompt = [(j % 100) + 1 for j in range(96)]
+        new_toks = 12
+
+    def ttft_of(engine, label_prompt) -> float:
+        t_sub = time.perf_counter()
+        first = []
+        engine.submit(label_prompt, max_new_tokens=new_toks,
+                      on_token=lambda _t: first.append(
+                          time.perf_counter() - t_sub)
+                      if not first else None).result(timeout=1800)
+        return first[0]
+
+    e_pre = ServingEngine(cfg, params, sc).start()      # prefill role
+    e_dec = ServingEngine(cfg, params, sc).start()      # decode role
+    e_uni = ServingEngine(cfg, params, sc).start()      # unified contrast
+    try:
+        # warm every jit with a sequence DISJOINT from the measured
+        # prompt: a shared prefix would seed each prefix cache and turn
+        # the "cold" unified TTFT into a half-cached prefill, understating
+        # the very interference contrast this cell publishes
+        warm = [((j * 7) % 89) + 2 for j in range(len(prompt) // 2 + 1)]
+        assert warm[:8] != prompt[:8]
+        for e in (e_pre, e_dec, e_uni):
+            e.submit(warm, max_new_tokens=2).result(timeout=1800)
+        t0 = time.perf_counter()
+        out = e_pre.export_handoff(prompt)
+        hop_s = time.perf_counter() - t0                # the prefill hop
+        t0 = time.perf_counter()
+        adopted = e_dec.adopt_handoff(out["blob"])
+        adopt_s = time.perf_counter() - t0
+        ttft_dec = ttft_of(e_dec, prompt)               # adopted KV: hit
+        ttft_uni = ttft_of(e_uni, prompt)               # cold: full prefill
+        itl = sorted(e_dec.metrics.get_observations(
+            "tpu_serving_inter_token_seconds"))
+        _emit({"metric": "disagg_ttft_ms", "role": "prefill",
+               "value": round(hop_s * 1e3, 2), "unit": "ms",
+               "what": "prefill compute + page export + serialize",
+               "pages": out["pages"], "bytes": len(out["blob"]),
+               "adopt_ms": round(adopt_s * 1e3, 2),
+               "adopted_pages": adopted["pages"],
+               "model": cfg.name, "backend": jax.default_backend()})
+        _emit({"metric": "disagg_ttft_ms", "role": "decode",
+               "value": round(ttft_dec * 1e3, 2), "unit": "ms",
+               "what": "submit -> first token with adopted (zero-copy) KV",
+               "unified_cold_ttft_ms": round(ttft_uni * 1e3, 2),
+               "paged_decode_loop": bool(e_dec.debug_snapshot()
+                                         .get("paged_decode")),
+               "model": cfg.name, "backend": jax.default_backend()})
+        _emit({"metric": "disagg_itl_ms", "role": "decode",
+               "value": (round(itl[len(itl) // 2] * 1e3, 3) if itl
+                         else None),
+               "unit": "ms",
+               "p95_ms": (round(itl[max(0, int(len(itl) * 0.95) - 1)]
+                                * 1e3, 3) if itl else None),
+               "steps": len(itl),
+               "model": cfg.name, "backend": jax.default_backend()})
+    finally:
+        e_pre.stop()
+        e_dec.stop()
+        e_uni.stop()
     return 0
 
 
@@ -1354,8 +1520,10 @@ def _write_unreachable_round(line: dict, root: str | None = None) -> str | None:
     silently leaving the trajectory stale on the last measured round
     (ROADMAP cross-cutting note: BENCH_r05 served stale single-chip numbers
     for two rounds because the wedged tunnel only surfaced in stderr).
-    Repeated wedged runs overwrite the same unreachable round rather than
-    minting a new file each time. Returns the path written, or None."""
+    Repeated wedged runs AT THE SAME COMMIT overwrite the same unreachable
+    round rather than minting a new file each time; a new commit is a new
+    round — each PR's trajectory entry stays its own file even when the
+    tunnel never heals. Returns the path written, or None."""
     global _BENCH_ROUND_RE
     import re as _re
     if _BENCH_ROUND_RE is None:
@@ -1375,8 +1543,10 @@ def _write_unreachable_round(line: dict, root: str | None = None) -> str | None:
     n = newest_n + 1
     try:  # overwrite our own unreachable marker instead of proliferating
         with open(os.path.join(root, newest_name), encoding="utf-8") as f:
-            if (json.load(f).get("parsed") or {}).get("unreachable"):
-                n = newest_n
+            newest = json.load(f)
+        if (newest.get("parsed") or {}).get("unreachable") \
+                and newest.get("commit") in (None, _git_commit()):
+            n = newest_n
     except (OSError, json.JSONDecodeError):
         pass
     path = os.path.join(root, f"BENCH_r{n:02d}.json")
@@ -1396,6 +1566,30 @@ def _write_unreachable_round(line: dict, root: str | None = None) -> str | None:
     print(f"[bench] TPU unreachable — wrote explicit row to {path}",
           file=sys.stderr, flush=True)
     return path
+
+
+def _disagg_smoke_lines() -> list | None:
+    """The ISSUE 9 handoff cell on CPU, in a subprocess (the orchestrator
+    process stays jax-free): an unreachable round still records a REAL
+    measured handoff-codec number — explicitly backend=cpu, never a chip
+    claim — next to the loud `unreachable` flag."""
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.join(_HERE, "bench.py"),
+             "--disagg", "--smoke"],
+            capture_output=True, text=True, timeout=300,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    except Exception:  # noqa: BLE001 — the smoke must never sink the round
+        return None
+    lines = []
+    for ln in out.stdout.splitlines():
+        try:
+            obj = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict) and obj.get("metric"):
+            lines.append(obj)
+    return lines or None
 
 
 def orchestrate(quick: bool) -> int:
@@ -1439,12 +1633,15 @@ def orchestrate(quick: bool) -> int:
     # can never leave the perf trajectory silently stale (this is how two
     # rounds quietly re-served the r02 measurement).
     diag = _probe_diag_summary()
+    smoke = None if quick else _disagg_smoke_lines()
     session = _session_tpu_headline()
     if session is not None:
         session["tpu_errors"] = errors[-2:]
         session["unreachable"] = True
         if diag is not None:
             session["probe_diag"] = diag
+        if smoke is not None:
+            session["disagg_cpu_smoke"] = smoke
         if not quick:
             _write_unreachable_round(session)
         _emit(session)
@@ -1465,6 +1662,8 @@ def orchestrate(quick: bool) -> int:
                     tpu_errors=errors[-2:])
         if diag is not None:
             line["probe_diag"] = diag
+        if smoke is not None:
+            line["disagg_cpu_smoke"] = smoke
         if not quick:
             _write_unreachable_round(line)
         _emit(line)
@@ -1672,6 +1871,8 @@ def main() -> int:
         return run_attn_tune()
     if "--paged-attn" in sys.argv:
         return run_paged_attn_bench()
+    if "--disagg" in sys.argv:
+        return run_disagg_bench(smoke="--smoke" in sys.argv)
     if "--ring-flash" in sys.argv:
         return run_ring_flash_check()
     if "--spec-drift" in sys.argv:

@@ -234,6 +234,10 @@ class ConfigPlumbingChecker(Checker):
             "debug sizing knob, provider-config file only",
         ("flag", "trace_ring_size"): "see (env, trace_ring_size)",
         ("helm", "trace_ring_size"): "see (env, trace_ring_size)",
+        ("helm", "serving_role"):
+            "per-pool role is stamped on each serving pod by the pool "
+            "autoscaler (TPU_SERVING_ROLE env + tpu.dev/fleet-role label), "
+            "not by the chart — the chart only sizes the pools",
     }
 
     def collect(self, index: PackageIndex) -> Iterable[Finding]:

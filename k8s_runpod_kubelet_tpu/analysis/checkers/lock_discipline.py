@@ -88,6 +88,14 @@ class LockDisciplineChecker(Checker):
             "(its docstring: single GIL-atomic reads, may straddle a step); "
             "the authoritative drain check (`drained`) reads _transit under "
             "_transit_lock",
+        ("workloads/serving/engine.py", "ServingEngine._kv_store"):
+            "the reference is rebound ONLY by the engine thread's crash "
+            "recovery (under _prefix_lock, after every in-flight future "
+            "was failed); all trie/arena OPERATIONS re-enter via "
+            "_prefix_lock, so the worst a stale reference can do is "
+            "operate on the pre-crash store whose buffers the crash "
+            "already invalidated (the request then fails like any "
+            "poisoned prefill) — it can never corrupt the rebuilt store",
     }
 
     def collect(self, index: PackageIndex) -> Iterable[Finding]:

@@ -105,6 +105,26 @@ class Config:
     fleet_scale_up_cooldown_s: float = 30.0
     fleet_scale_down_cooldown_s: float = 120.0
 
+    # disaggregated prefill/decode serving (ISSUE 9). serving_role is what
+    # a serve_main replica registers to the fleet as: "unified" (default —
+    # prefills and decodes, the single-pool mode and the fallback target),
+    # "prefill" (computes KV, hands pages off) or "decode" (adopts pages,
+    # streams tokens). Configuring BOTH pool ceilings > 0 switches
+    # router_main's autoscaler to per-pool loops: the prefill pool scales
+    # on TTFT burn + queue depth, the decode pool on ITL p95
+    # (fleet_itl_slo_s) + pool-wide free KV pages
+    # (fleet_min_free_kv_page_frac). fleet_handoff_timeout_s budgets the
+    # prefill hop (compute + page push); past it the router falls back to
+    # a single-hop route.
+    serving_role: str = "unified"
+    fleet_prefill_min_replicas: int = 0
+    fleet_prefill_max_replicas: int = 0
+    fleet_decode_min_replicas: int = 0
+    fleet_decode_max_replicas: int = 0
+    fleet_itl_slo_s: float = 0.25
+    fleet_min_free_kv_page_frac: float = 0.1
+    fleet_handoff_timeout_s: float = 30.0
+
     # training telemetry (ISSUE 5). telemetry_port is a gang COORDINATION
     # var: injected into every worker's env (TPU_TELEMETRY_PORT +
     # TPU_TELEMETRY_ADDRESS = worker-0) at gang launch so peers can post
@@ -128,6 +148,11 @@ class Config:
     kv_page_tokens: int = 16
     kv_pool_pages: int = 0
     prefix_cache_enabled: bool = True
+    # paged decode loop (ISSUE 9): decode on per-slot page tables over the
+    # shared arena — prefix hits and handed-off KV referenced zero-copy.
+    # True = auto (on whenever the model/layout allows it); False pins the
+    # contiguous slot-cache loop.
+    kv_paged_decode: bool = True
 
     # elastic gang training (ISSUE 6). elastic_resize is the global gate for
     # the tpu.dev/elastic pod annotation: on partial host loss an elastic
@@ -226,6 +251,41 @@ class Config:
         if self.fleet_scale_up_cooldown_s < 0 \
                 or self.fleet_scale_down_cooldown_s < 0:
             errs.append("fleet cooldowns must be >= 0")
+        if self.serving_role not in ("unified", "prefill", "decode"):
+            errs.append(f"serving_role must be unified/prefill/decode, "
+                        f"got {self.serving_role!r}")
+        for pool_field in ("fleet_prefill_min_replicas",
+                           "fleet_prefill_max_replicas",
+                           "fleet_decode_min_replicas",
+                           "fleet_decode_max_replicas"):
+            if getattr(self, pool_field) < 0:
+                errs.append(f"{pool_field} must be >= 0 (0 = pool disabled)")
+        if 0 < self.fleet_prefill_max_replicas \
+                < self.fleet_prefill_min_replicas:
+            errs.append("fleet_prefill_max_replicas must be >= "
+                        "fleet_prefill_min_replicas when the pool is on")
+        if 0 < self.fleet_decode_max_replicas \
+                < self.fleet_decode_min_replicas:
+            errs.append("fleet_decode_max_replicas must be >= "
+                        "fleet_decode_min_replicas when the pool is on")
+        if (self.fleet_prefill_max_replicas > 0) \
+                != (self.fleet_decode_max_replicas > 0):
+            # half a disaggregated fleet is not a mode: build() would
+            # silently run the legacy whole-fleet loop and the operator
+            # would believe the configured pool is managed
+            errs.append(
+                "disaggregated pools are configured together: set BOTH "
+                "fleet_prefill_max_replicas and fleet_decode_max_replicas "
+                "> 0 (or neither for the single-pool fleet); got "
+                f"prefill_max={self.fleet_prefill_max_replicas}, "
+                f"decode_max={self.fleet_decode_max_replicas}")
+        if self.fleet_itl_slo_s < 0:
+            errs.append("fleet_itl_slo_s must be >= 0 (0 = signal off)")
+        if not 0 <= self.fleet_min_free_kv_page_frac < 1:
+            errs.append("fleet_min_free_kv_page_frac must be in [0, 1) "
+                        "(0 = signal off)")
+        if self.fleet_handoff_timeout_s <= 0:
+            errs.append("fleet_handoff_timeout_s must be > 0")
         if not 0 <= self.telemetry_port <= 65535:
             errs.append("telemetry_port must be in [0, 65535] (0 = off)")
         if self.straggler_factor <= 1.0:
@@ -281,6 +341,15 @@ _ENV_MAP = {
     "TPU_KV_PAGE_TOKENS": "kv_page_tokens",
     "TPU_KV_POOL_PAGES": "kv_pool_pages",
     "TPU_PREFIX_CACHE_ENABLED": "prefix_cache_enabled",
+    "TPU_KV_PAGED_DECODE": "kv_paged_decode",
+    "TPU_SERVING_ROLE": "serving_role",
+    "TPU_FLEET_PREFILL_MIN_REPLICAS": "fleet_prefill_min_replicas",
+    "TPU_FLEET_PREFILL_MAX_REPLICAS": "fleet_prefill_max_replicas",
+    "TPU_FLEET_DECODE_MIN_REPLICAS": "fleet_decode_min_replicas",
+    "TPU_FLEET_DECODE_MAX_REPLICAS": "fleet_decode_max_replicas",
+    "TPU_FLEET_ITL_SLO_S": "fleet_itl_slo_s",
+    "TPU_FLEET_MIN_FREE_KV_PAGE_FRAC": "fleet_min_free_kv_page_frac",
+    "TPU_FLEET_HANDOFF_TIMEOUT_S": "fleet_handoff_timeout_s",
     "TPU_TELEMETRY_PORT": "telemetry_port",
     "TPU_STRAGGLER_FACTOR": "straggler_factor",
     "TPU_STALL_TIMEOUT_S": "stall_timeout_s",

@@ -31,7 +31,7 @@ import time
 from typing import Callable, Optional
 
 from ..cloud.transport import CircuitOpenError, TransportError
-from .registry import DRAINING, Replica, ReplicaRegistry
+from .registry import DECODE, DRAINING, PREFILL, Replica, ReplicaRegistry
 
 log = logging.getLogger(__name__)
 
@@ -40,7 +40,8 @@ log = logging.getLogger(__name__)
 # deployment; serve_main reads them via config._ENV_MAP) — the wiring
 # path for the paged-KV prefix cache (ISSUE 8) at fleet scale.
 SERVING_PASSTHROUGH_ENV = ("TPU_KV_PAGE_TOKENS", "TPU_KV_POOL_PAGES",
-                           "TPU_PREFIX_CACHE_ENABLED")
+                           "TPU_PREFIX_CACHE_ENABLED",
+                           "TPU_KV_PAGED_DECODE")
 
 
 @dataclasses.dataclass
@@ -51,6 +52,17 @@ class AutoscalerConfig:
     # worst replica's recent TTFT p95 over the SLO
     target_queue_per_replica: float = 4.0
     ttft_slo_s: float = 2.0
+    # disaggregated pools (ISSUE 9): ``role`` scopes this control loop to
+    # one pool — it sizes, drains and reaps ONLY replicas/pods of that
+    # role ("" = the whole fleet, the single-pool default). A decode-role
+    # loop scales on its OWN signals: sustained ITL p95 over itl_slo_s
+    # (decode is what disaggregation protects from prefill interference)
+    # or free KV pages across the pool under min_free_kv_page_frac (page
+    # exhaustion rejects admissions before slots fill). 0 disables a
+    # signal.
+    role: str = ""
+    itl_slo_s: float = 0.0
+    min_free_kv_page_frac: float = 0.0
     # hysteresis: how long a signal must hold before acting
     scale_up_stable_s: float = 10.0
     scale_down_stable_s: float = 60.0
@@ -78,7 +90,8 @@ class KubePodScaler:
                  chips: int = 8, image: str = "",
                  template_fn: Optional[Callable[[str], dict]] = None,
                  on_create: Optional[Callable[[dict], None]] = None,
-                 on_delete: Optional[Callable[[dict], None]] = None):
+                 on_delete: Optional[Callable[[dict], None]] = None,
+                 role: str = ""):
         self.kube = kube
         self.node_name = node_name
         self.namespace = namespace
@@ -90,33 +103,61 @@ class KubePodScaler:
         # deletion to the provider too, so the slice is released and
         # tombstoned exactly as if the pod controller saw the delete
         self.on_delete = on_delete
+        # disaggregated pool (ISSUE 9): pods carry the role as a label
+        # (so each pool's reaper sees only its own pods) and as
+        # TPU_SERVING_ROLE env (so serve_main registers into the right
+        # pool). "" = the legacy single-pool scaler.
+        self.role = role
         self._seq = 0
 
     # pods carrying this label are FLEET-OWNED: the autoscaler may reap
     # one that no registered replica backs (a custom template_fn must
     # include it for orphan reaping to see its pods)
     FLEET_LABEL = "tpu.dev/fleet=serving"
+    ROLE_LABEL = "tpu.dev/fleet-role"
 
     def _pod(self, name: str) -> dict:
         if self.template_fn is not None:
-            return self.template_fn(name)
+            return self._stamp_role(self.template_fn(name))
         container = {"name": "serve", "image": self.image,
                      "resources": {"limits": {
                          "google.com/tpu": str(self.chips)}}}
         env = [{"name": k, "value": os.environ[k]}
                for k in SERVING_PASSTHROUGH_ENV if os.environ.get(k)]
+        if self.role:
+            env.append({"name": "TPU_SERVING_ROLE", "value": self.role})
         if env:
             container["env"] = env
+        labels = {"app": "tpu-serving", "tpu.dev/fleet": "serving"}
+        if self.role:
+            labels[self.ROLE_LABEL] = self.role
         return {"apiVersion": "v1", "kind": "Pod",
                 "metadata": {"name": name, "namespace": self.namespace,
-                             "labels": {"app": "tpu-serving",
-                                        "tpu.dev/fleet": "serving"}},
+                             "labels": labels},
                 "spec": {"nodeName": self.node_name,
                          "containers": [container]}}
 
+    def _stamp_role(self, pod: dict) -> dict:
+        """Role-scope a custom template's pod: without the role label the
+        pool's reaper never sees it, and without TPU_SERVING_ROLE it
+        registers as `unified` — the pool loop would boot-timeout and
+        recreate it forever. Stamped onto the template's output (unlike
+        FLEET_LABEL, which templates must carry themselves, the role is
+        the SCALER's identity, not the template's)."""
+        if not self.role:
+            return pod
+        pod.setdefault("metadata", {}).setdefault("labels", {})[
+            self.ROLE_LABEL] = self.role
+        for container in pod.get("spec", {}).get("containers", []):
+            env = container.setdefault("env", [])
+            if not any(e.get("name") == "TPU_SERVING_ROLE" for e in env):
+                env.append({"name": "TPU_SERVING_ROLE", "value": self.role})
+        return pod
+
     def create(self) -> str:
         self._seq += 1
-        name = f"tpu-serving-{self._seq}"
+        name = (f"tpu-serving-{self.role}-{self._seq}" if self.role
+                else f"tpu-serving-{self._seq}")
         created = self.kube.create_pod(self._pod(name))
         if self.on_create is not None:
             self.on_create(created)
@@ -124,10 +165,15 @@ class KubePodScaler:
 
     def list_fleet_pods(self) -> list[str]:
         """Names of fleet-owned serving pods (by label) — the orphan
-        reaper's ground truth of what exists in the cluster."""
+        reaper's ground truth of what exists in the cluster. A
+        role-scoped scaler lists ONLY its pool's pods, so two pool
+        reapers can never fight over (or reap) each other's pods."""
+        selector = self.FLEET_LABEL
+        if self.role:
+            selector += f",{self.ROLE_LABEL}={self.role}"
         return [p["metadata"]["name"]
                 for p in self.kube.list_pods(self.namespace,
-                                             label_selector=self.FLEET_LABEL)]
+                                             label_selector=selector)]
 
     def delete(self, pod_name: str):
         pod = None
@@ -179,6 +225,9 @@ class FleetAutoscaler:
         self._last_up = -math.inf
         self._last_down = -math.inf
         self._drains: dict[str, _Drain] = {}
+        # per-replica handoffs_total baselines for the prefill pool's
+        # scale-down check (see _handoff_activity)
+        self._last_handoffs: dict[str, int] = {}
         # pods created but whose replica hasn't registered yet: they count
         # toward fleet size, or every tick during a boot would scale again
         self._pending: dict[str, float] = {}
@@ -188,15 +237,21 @@ class FleetAutoscaler:
         self._orphan_seen: dict[str, float] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # a role-scoped loop labels its gauge so two pool loops don't
+        # clobber one series (the legacy whole-fleet loop stays unlabeled)
+        self._gauge_labels = {"role": self.cfg.role} if self.cfg.role \
+            else None
         if metrics is not None:
             self._describe(metrics)
             metrics.set_gauge("tpu_fleet_desired_replicas",
-                              self.cfg.min_replicas)
+                              self.cfg.min_replicas,
+                              labels=self._gauge_labels)
 
     @staticmethod
     def _describe(m):
         m.describe("tpu_fleet_desired_replicas",
-                   "replica count the autoscaler is steering toward")
+                   "replica count the autoscaler is steering toward "
+                   "(role-scoped pool loops label with role=)")
         m.describe("tpu_fleet_scale_ups", "scale-up actions (pods created)")
         m.describe("tpu_fleet_scale_downs",
                    "scale-down actions completed (drained pods deleted)")
@@ -213,13 +268,35 @@ class FleetAutoscaler:
 
     def _fleet_size(self) -> tuple[list[Replica], int]:
         """(ready replicas, effective fleet size). Size counts draining
-        pods OUT (their capacity is leaving) and still-booting pods IN."""
-        live = self.registry.live()
+        pods OUT (their capacity is leaving) and still-booting pods IN.
+        A role-scoped loop sees only its own pool's replicas."""
+        live = (self.registry.live_role(self.cfg.role) if self.cfg.role
+                else self.registry.live())
         ready = [r for r in live if r.state != DRAINING]
         return ready, len(ready) + len(self._pending)
 
     def _overloaded(self, ready: list[Replica]) -> Optional[str]:
         if not ready:
+            return None
+        # the DECODE pool scales on its own signals: sustained ITL p95
+        # over the SLO (the interference disaggregation removes) and free
+        # KV pages running out pool-wide (admissions start failing before
+        # slots do). The prefill/unified signals below — queue depth and
+        # TTFT burn — stay the compute-side pair.
+        if self.cfg.role == DECODE:
+            busy = any(r.stats.queue_depth > 0 or r.stats.active_slots > 0
+                       for r in ready)
+            worst_itl = max(r.stats.itl_p95_s for r in ready)
+            if self.cfg.itl_slo_s > 0 and worst_itl > self.cfg.itl_slo_s \
+                    and busy:
+                return f"itl_p95 {worst_itl:.4f}s over SLO " \
+                       f"{self.cfg.itl_slo_s}s"
+            total = sum(r.stats.kv_pages_total for r in ready)
+            free = sum(r.stats.kv_pages_free for r in ready)
+            if self.cfg.min_free_kv_page_frac > 0 and total > 0 \
+                    and free / total < self.cfg.min_free_kv_page_frac:
+                return (f"free KV pages {free}/{total} under "
+                        f"{self.cfg.min_free_kv_page_frac:.0%} floor")
             return None
         queue = sum(r.stats.queue_depth for r in ready)
         if queue / len(ready) > self.cfg.target_queue_per_replica:
@@ -242,26 +319,56 @@ class FleetAutoscaler:
             return False
         if any(r.stats.queue_depth > 0 for r in ready):
             return False
+        # prefill replicas do their whole job on HTTP handler threads
+        # (export_handoff never touches the scheduler queue or a slot),
+        # so slot utilization below is structurally ZERO for them and
+        # the sampled inflight count aliases steady short hops to idle
+        # (~100ms hops vs ~2s heartbeats). The cumulative counter can't
+        # alias: any hop completed since the last tick is load.
+        if self.cfg.role == PREFILL and self._handoff_activity(ready):
+            return False
         slots = sum(r.stats.max_slots for r in ready)
         active = sum(r.stats.active_slots for r in ready)
         if slots <= 0:
             return active == 0
         return active / slots < self.cfg.scale_down_utilization
 
+    def _handoff_activity(self, ready: list[Replica]) -> bool:
+        """Did any ready replica complete a /kv_prefill hop since the
+        last check? Advances the per-replica baselines either way; a
+        replica's FIRST sighting sets its baseline without counting as
+        activity (registration is not load)."""
+        active = False
+        seen = set()
+        for r in ready:
+            seen.add(r.replica_id)
+            total = r.stats.handoffs_total
+            last = self._last_handoffs.get(r.replica_id)
+            if last is not None and total > last:
+                active = True
+            self._last_handoffs[r.replica_id] = total
+        for rid in list(self._last_handoffs):
+            if rid not in seen:
+                del self._last_handoffs[rid]
+        return active
+
     # -- actions ---------------------------------------------------------------
 
     def _record_scale(self, direction: str, size_from: int, size_to: int,
                       reason: str, target: str = ""):
-        log.info("fleet: scale %s %d -> %d (%s)", direction, size_from,
-                 size_to, reason)
+        log.info("fleet%s: scale %s %d -> %d (%s)",
+                 f"[{self.cfg.role}]" if self.cfg.role else "", direction,
+                 size_from, size_to, reason)
         if self.metrics is not None:
-            self.metrics.set_gauge("tpu_fleet_desired_replicas", size_to)
+            self.metrics.set_gauge("tpu_fleet_desired_replicas", size_to,
+                                   labels=self._gauge_labels)
         if self.tracer is not None:
             now = self.tracer.clock()
             self.tracer.record("fleet.scale", now, now,
                                attrs={"direction": direction,
                                       "from": size_from, "to": size_to,
-                                      "reason": reason, "target": target})
+                                      "reason": reason, "target": target,
+                                      "role": self.cfg.role or "unified"})
 
     def _scale_up(self, size: int, reason: str):
         pod = self.scaler.create()
@@ -332,8 +439,12 @@ class FleetAutoscaler:
         """Pick up drains this process didn't start (an operator's direct
         POST /drain, or a drain orphaned by an autoscaler restart — the
         engine's drain is irreversible, so SOMEONE must finish the
-        delete): track them so _progress_drains completes them."""
-        for rep in self.registry.live():
+        delete): track them so _progress_drains completes them. A
+        role-scoped loop adopts only ITS pool's drains — two pool loops
+        double-adopting one drain would double-delete the pod."""
+        live = (self.registry.live_role(self.cfg.role) if self.cfg.role
+                else self.registry.live())
+        for rep in live:
             if rep.state == DRAINING and rep.replica_id not in self._drains:
                 log.info("fleet: adopting in-progress drain of %s",
                          rep.replica_id)

@@ -1,0 +1,211 @@
+"""KV page-run handoff codec: the wire format between a prefill replica's
+arena and a decode replica's (disaggregated serving, ROADMAP item 2).
+
+A handoff moves the KV of a prompt's FULL pages — exactly what the paged
+prefix pool already treats as the shareable unit — from the replica that
+computed them to the replica that will decode against them. The decode
+side adopts the pages into its own arena through the prefix trie, so the
+engine's normal prompt match then references them zero-copy and only the
+sub-page tail recomputes.
+
+Wire format (one blob, streamable over the existing HTTP surface):
+
+    MAGIC(6) | u32 header_len | header JSON | section payloads
+
+The header carries ``version``, ``page_tokens``, ``n_pages``, the token
+ids the pages cover (the trie key — adoption is meaningless without
+them), and per-section name/dtype/shape/byte-length. Section payloads
+follow in header order as C-contiguous bytes. The codec is generic over
+the section dict, so plain K/V, int8-KV (scales page alongside) and MLA
+latent layouts all serialize through the same two functions — layout
+differences are just different section names/shapes, validated on the
+receiving side against the adopting arena.
+
+Validation is deliberately paranoid: a truncated stream, a bad magic, a
+future version, a page-size or dtype mismatch each raise a typed
+``HandoffError`` — the router treats any of them as a failed handoff and
+falls back, never half-adopting KV.
+
+numpy-only on purpose (no jax import): the codec must be usable by the
+router tier and by tier-1 tests without touching a device runtime.
+bfloat16 rides numpy's ml_dtypes registration (jax ships it).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+import numpy as np
+
+MAGIC = b"TPUKV\x01"
+VERSION = 1
+# refuse absurd headers before json.loads touches them (a corrupt length
+# prefix must not allocate gigabytes)
+_MAX_HEADER_BYTES = 16 * 1024 * 1024
+
+
+class HandoffError(ValueError):
+    """A KV handoff blob that must not be adopted (truncated, foreign
+    version, or shaped for a different arena). Callers treat it as a
+    failed handoff and fall back to a full prefill."""
+
+
+def _dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # bfloat16 and friends register with numpy when ml_dtypes imports
+        import ml_dtypes  # noqa: F401 — import registers the dtypes
+        return np.dtype(name)
+
+
+def serialize_pages(tokens: list, page_tokens: int,
+                    sections: dict[str, np.ndarray],
+                    model: str = "") -> bytes:
+    """Pack a page run into one blob. ``sections[name]`` is the page
+    payload for one arena section, shaped ``(L, n_pages, page_tokens,
+    ...)`` — i.e. the arena section sliced to the run's page ids, in
+    prompt order. ``tokens`` are the token ids those pages hold
+    (``n_pages * page_tokens`` of them)."""
+    if not sections:
+        raise HandoffError("no sections to serialize")
+    n_pages = next(iter(sections.values())).shape[1]
+    if len(tokens) != n_pages * page_tokens:
+        raise HandoffError(
+            f"token count {len(tokens)} != n_pages {n_pages} * "
+            f"page_tokens {page_tokens}")
+    metas = []
+    payloads = []
+    for name, arr in sections.items():
+        arr = np.ascontiguousarray(arr)
+        if arr.ndim < 3 or arr.shape[1] != n_pages \
+                or arr.shape[2] != page_tokens:
+            raise HandoffError(
+                f"section {name!r} shape {arr.shape} is not "
+                f"(L, {n_pages}, {page_tokens}, ...)")
+        raw = arr.tobytes()
+        metas.append({"name": name, "dtype": arr.dtype.name,
+                      "shape": list(arr.shape), "bytes": len(raw)})
+        payloads.append(raw)
+    header = json.dumps({
+        "version": VERSION, "page_tokens": page_tokens, "n_pages": n_pages,
+        "tokens": [int(t) for t in tokens], "model": model,
+        "sections": metas}).encode()
+    return b"".join([MAGIC, len(header).to_bytes(4, "big"), header]
+                    + payloads)
+
+
+def deserialize_pages(blob: bytes, *,
+                      expect_page_tokens: Optional[int] = None,
+                      expect_sections: Optional[dict] = None,
+                      expect_model: Optional[str] = None
+                      ) -> tuple[dict, dict[str, np.ndarray]]:
+    """Unpack a handoff blob into (header dict, {name: array}).
+
+    ``expect_page_tokens`` rejects a run paged at a different granule
+    (the pages could not be re-chunked without re-deriving positions);
+    ``expect_sections`` maps section name -> (dtype name, per-page
+    trailing shape) — the adopting arena's layout — and rejects missing/
+    extra sections, dtype mismatches, and trailing-shape mismatches.
+    ``expect_model`` rejects KV computed by a DIFFERENT model whose
+    arena geometry happens to match (e.g. two checkpoints of one
+    architecture during a rollout) — adopting it would serve garbage
+    completions with no error, and the poisoned pages would stay cached.
+    Every failure mode raises HandoffError with the reason."""
+    if len(blob) < len(MAGIC) + 4:
+        raise HandoffError(f"truncated blob: {len(blob)} bytes is shorter "
+                           "than the fixed header")
+    if blob[:len(MAGIC)] != MAGIC:
+        raise HandoffError("bad magic: not a KV handoff blob")
+    hlen = int.from_bytes(blob[len(MAGIC):len(MAGIC) + 4], "big")
+    if hlen > _MAX_HEADER_BYTES:
+        raise HandoffError(f"header length {hlen} exceeds sanity cap")
+    off = len(MAGIC) + 4
+    if len(blob) < off + hlen:
+        raise HandoffError(f"truncated header: need {hlen} bytes, "
+                           f"have {len(blob) - off}")
+    try:
+        header = json.loads(blob[off:off + hlen])
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise HandoffError(f"unparseable header: {e}") from e
+    off += hlen
+    if not isinstance(header, dict):
+        raise HandoffError("header is not an object")
+    version = header.get("version")
+    if version != VERSION:
+        raise HandoffError(f"version {version!r} not supported "
+                           f"(this build speaks {VERSION})")
+    page_tokens = header.get("page_tokens")
+    n_pages = header.get("n_pages")
+    tokens = header.get("tokens")
+    metas = header.get("sections")
+    if not (isinstance(page_tokens, int) and page_tokens >= 1
+            and isinstance(n_pages, int) and n_pages >= 1
+            and isinstance(tokens, list) and isinstance(metas, list)
+            and metas):
+        raise HandoffError("header missing page_tokens/n_pages/tokens/"
+                           "sections")
+    if len(tokens) != n_pages * page_tokens:
+        raise HandoffError(f"header token count {len(tokens)} != "
+                           f"{n_pages} pages * {page_tokens} tokens")
+    if expect_page_tokens is not None and page_tokens != expect_page_tokens:
+        raise HandoffError(
+            f"page-size mismatch: blob paged at {page_tokens} tokens, "
+            f"this arena at {expect_page_tokens}")
+    if expect_model is not None \
+            and header.get("model", "") != expect_model:
+        raise HandoffError(
+            f"model mismatch: blob holds KV from "
+            f"{header.get('model', '')!r}, this replica serves "
+            f"{expect_model!r}")
+    if expect_sections is not None:
+        got = {m.get("name") for m in metas if isinstance(m, dict)}
+        want = set(expect_sections)
+        if got != want:
+            raise HandoffError(f"section-set mismatch: blob has "
+                               f"{sorted(got)}, arena needs {sorted(want)}")
+    sections: dict[str, np.ndarray] = {}
+    for meta in metas:
+        if not isinstance(meta, dict):
+            raise HandoffError("malformed section meta")
+        name, dtype_name = meta.get("name"), meta.get("dtype")
+        shape, nbytes = meta.get("shape"), meta.get("bytes")
+        if not (isinstance(name, str) and isinstance(dtype_name, str)
+                and isinstance(shape, list) and isinstance(nbytes, int)):
+            raise HandoffError(f"malformed section meta: {meta}")
+        try:
+            dt = _dtype(dtype_name)
+        except TypeError as e:
+            raise HandoffError(f"section {name!r}: unknown dtype "
+                               f"{dtype_name!r}") from e
+        shape = tuple(int(s) for s in shape)
+        if len(shape) < 3 or shape[1] != n_pages or shape[2] != page_tokens:
+            raise HandoffError(f"section {name!r} shape {shape} is not "
+                               f"(L, {n_pages}, {page_tokens}, ...)")
+        want_bytes = int(np.prod(shape)) * dt.itemsize
+        if nbytes != want_bytes:
+            raise HandoffError(f"section {name!r}: declared {nbytes} bytes "
+                               f"but shape/dtype imply {want_bytes}")
+        if len(blob) < off + nbytes:
+            raise HandoffError(
+                f"truncated stream: section {name!r} needs {nbytes} bytes, "
+                f"{len(blob) - off} remain")
+        if expect_sections is not None:
+            exp_dtype, exp_tail = expect_sections[name]
+            if dt != _dtype(exp_dtype):
+                raise HandoffError(
+                    f"dtype mismatch on {name!r}: blob {dt.name}, "
+                    f"arena {_dtype(exp_dtype).name}")
+            if tuple(exp_tail) != shape[3:]:
+                raise HandoffError(
+                    f"section {name!r} trailing shape {shape[3:]} != "
+                    f"arena's {tuple(exp_tail)}")
+        sections[name] = np.frombuffer(
+            blob, dtype=dt, count=int(np.prod(shape)),
+            offset=off).reshape(shape)
+        off += nbytes
+    if off != len(blob):
+        raise HandoffError(f"{len(blob) - off} trailing bytes after the "
+                           "declared sections")
+    return header, sections

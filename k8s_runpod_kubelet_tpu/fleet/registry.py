@@ -33,14 +33,28 @@ READY = "ready"
 DRAINING = "draining"
 STATES = (READY, DRAINING)
 
+# disaggregated-serving roles (ISSUE 9): a replica registers as one of
+# these and the router/autoscaler treat the pools separately — prefill
+# replicas compute KV and hand it off, decode replicas adopt KV and
+# stream tokens, unified replicas do both (the single-pool default and
+# the fallback target when a pool is empty or a handoff fails).
+UNIFIED = "unified"
+PREFILL = "prefill"
+DECODE = "decode"
+ROLES = (UNIFIED, PREFILL, DECODE)
+
 
 @dataclasses.dataclass
 class ReplicaStats:
     """One heartbeat's load snapshot — the router's routing signal.
 
     Field names match ``/debug/engine`` (debug_snapshot) where a
-    counterpart exists; ``ttft_p95_s`` is computed replica-side from the
-    tpu_serving_ttft_seconds histogram's recent tail (ReplicaReporter)."""
+    counterpart exists; ``ttft_p95_s``/``itl_p95_s`` are computed
+    replica-side from the serving histograms' recent tails
+    (ReplicaReporter). ``kv_pages_free`` is the arena's reclaimable
+    HEADROOM (free + evictable-now trie pages, not the raw free count —
+    see ReplicaReporter.stats) over ``kv_pages_total`` — the decode
+    pool's scale signal."""
 
     free_slots: int = 0
     active_slots: int = 0
@@ -49,7 +63,18 @@ class ReplicaStats:
     max_queue_depth: int = 0     # the replica's admission bound (0 = none)
     kv_cache_tokens: int = 0
     ttft_p95_s: float = 0.0
+    # role-appropriate load extras (ISSUE 9): decode pools scale on ITL
+    # p95 and free KV pages, prefill pools on TTFT/queue (above)
+    itl_p95_s: float = 0.0
+    kv_pages_free: int = 0
+    kv_pages_total: int = 0
+    # cumulative completed /kv_prefill hops: the prefill pool's
+    # scale-down check watches this ADVANCE between ticks — hops are too
+    # short for the sampled inflight count to register steady traffic
+    handoffs_total: int = 0
     draining: bool = False
+
+    _FLOATS = ("ttft_p95_s", "itl_p95_s")
 
     @classmethod
     def from_dict(cls, d: dict) -> "ReplicaStats":
@@ -59,7 +84,7 @@ class ReplicaStats:
             if k not in known or v is None:  # nulls fall to field defaults
                 continue
             kw[k] = bool(v) if k == "draining" else \
-                (float(v) if k == "ttft_p95_s" else int(v))
+                (float(v) if k in cls._FLOATS else int(v))
         return cls(**kw)
 
     def to_dict(self) -> dict:
@@ -86,6 +111,7 @@ class Replica:
     replica_id: str
     base_url: str
     pod_name: str = ""           # the k8s pod backing it (autoscaler's handle)
+    role: str = UNIFIED          # disaggregated pool membership (ISSUE 9)
     state: str = READY
     registered_at: float = 0.0
     last_heartbeat_at: float = 0.0
@@ -100,7 +126,8 @@ class Replica:
 
     def to_dict(self, now: float) -> dict:
         return {"replica_id": self.replica_id, "base_url": self.base_url,
-                "pod_name": self.pod_name, "state": self.state,
+                "pod_name": self.pod_name, "role": self.role,
+                "state": self.state,
                 "age_s": round(now - self.registered_at, 3),
                 "heartbeat_age_s": round(now - self.last_heartbeat_at, 3),
                 "breaker_open": self.breaker_open,
@@ -162,6 +189,9 @@ class ReplicaRegistry:
         m.describe("tpu_fleet_evictions",
                    "replicas evicted by the registry (labels: reason="
                    "stale|probe|dead)")
+        m.describe("tpu_fleet_pool_replicas",
+                   "registered replicas per disaggregated-serving pool "
+                   "(labels: role=unified|prefill|decode)")
 
     def _make_transport(self, base_url: str) -> HttpTransport:
         # max_retries=1: same-replica retries are the ROUTER's call (it
@@ -178,9 +208,12 @@ class ReplicaRegistry:
     # -- membership ------------------------------------------------------------
 
     def register(self, replica_id: str, base_url: str,
-                 pod_name: str = "") -> Replica:
+                 pod_name: str = "", role: str = UNIFIED) -> Replica:
         if not replica_id or not base_url:
             raise ValueError("replica_id and base_url are required")
+        role = role or UNIFIED
+        if role not in ROLES:
+            raise ValueError(f"unknown role {role!r} (one of {ROLES})")
         now = self.clock()
         with self._lock:
             rep = self._replicas.get(replica_id)
@@ -188,16 +221,19 @@ class ReplicaRegistry:
                 # fresh transport on a URL change: the old breaker's failure
                 # streak belongs to the old address
                 rep = Replica(replica_id=replica_id, base_url=base_url,
-                              pod_name=pod_name, registered_at=now,
+                              pod_name=pod_name, role=role,
+                              registered_at=now,
                               transport=self._transport_factory(base_url))
                 self._replicas[replica_id] = rep
             rep.pod_name = pod_name or rep.pod_name
+            rep.role = role
             rep.state = READY
             rep.last_heartbeat_at = now
         if self.metrics is not None:
             self.metrics.incr("tpu_fleet_registered")
         self._update_gauges()
-        log.info("fleet: replica %s registered at %s", replica_id, base_url)
+        log.info("fleet: replica %s (%s) registered at %s", replica_id,
+                 role, base_url)
         return rep
 
     def heartbeat(self, replica_id: str, stats: dict) -> bool:
@@ -304,33 +340,48 @@ class ReplicaRegistry:
         with self._lock:
             return list(self._replicas.values())
 
-    def ready(self) -> list[Replica]:
-        """Routable replicas: READY state, breaker not open."""
+    def ready(self, role: Optional[str] = None) -> list[Replica]:
+        """Routable replicas: READY state, breaker not open. ``role``
+        filters to one disaggregated pool (None = every pool)."""
         with self._lock:
             return [r for r in self._replicas.values()
-                    if r.state == READY and not r.breaker_open]
+                    if r.state == READY and not r.breaker_open
+                    and (role is None or r.role == role)]
+
+    def live_role(self, role: str) -> list[Replica]:
+        """Every registered replica of one pool, any state — the pool
+        autoscaler's membership view."""
+        with self._lock:
+            return [r for r in self._replicas.values() if r.role == role]
 
     def snapshot(self) -> dict:
         """The /debug/fleet payload (also what tools/fleet_summary.py
-        renders): every replica with its age, state and last stats."""
+        renders): every replica with its age, role, state and last stats."""
         now = self.clock()
         with self._lock:
             reps = [r.to_dict(now) for r in self._replicas.values()]
         return {"replicas": sorted(reps, key=lambda r: r["replica_id"]),
                 "ready": sum(1 for r in reps
                              if r["state"] == READY and not r["breaker_open"]),
-                "draining": sum(1 for r in reps if r["state"] == DRAINING)}
+                "draining": sum(1 for r in reps if r["state"] == DRAINING),
+                "pools": {role: sum(1 for r in reps if r["role"] == role)
+                          for role in ROLES}}
 
     def _update_gauges(self):
         if self.metrics is None:
             return
         with self._lock:
             counts = {s: 0 for s in STATES}
+            roles = {r: 0 for r in ROLES}
             for r in self._replicas.values():
                 counts[r.state] = counts.get(r.state, 0) + 1
+                roles[r.role] = roles.get(r.role, 0) + 1
         for state, n in counts.items():
             self.metrics.set_gauge("tpu_fleet_replicas", n,
                                    labels={"state": state})
+        for role, n in roles.items():
+            self.metrics.set_gauge("tpu_fleet_pool_replicas", n,
+                                   labels={"role": role})
 
 
 class ReplicaReporter:
@@ -346,12 +397,14 @@ class ReplicaReporter:
 
     def __init__(self, engine, router_url: str, replica_id: str,
                  advertise_url: str, pod_name: str = "",
-                 interval_s: float = 2.0, post_fn=None):
+                 interval_s: float = 2.0, post_fn=None,
+                 role: str = UNIFIED):
         self.engine = engine
         self.router_url = router_url.rstrip("/")
         self.replica_id = replica_id
         self.advertise_url = advertise_url
         self.pod_name = pod_name
+        self.role = role or UNIFIED
         self.interval_s = interval_s
         self._post = post_fn or self._http_post
         self._stop = threading.Event()
@@ -374,6 +427,13 @@ class ReplicaReporter:
         recent = sorted(self.engine.metrics.get_observations(
             "tpu_serving_ttft_seconds")[-100:])
         p95 = recent[max(0, int(len(recent) * 0.95) - 1)] if recent else 0.0
+        # ITL p95 (recent tail, like TTFT): the DECODE pool's SLO signal —
+        # long prefills inflating inter-token gaps is the interference
+        # disaggregation exists to remove, so the autoscaler watches it
+        itl = sorted(self.engine.metrics.get_observations(
+            "tpu_serving_inter_token_seconds")[-200:])
+        itl_p95 = itl[max(0, int(len(itl) * 0.95) - 1)] if itl else 0.0
+        pool = snap.get("prefix_cache", {})
         # prefix-cache hit rate (paged KV pool, ISSUE 8): the per-replica
         # signal that shows whether the router's rendezvous prefix-affinity
         # is paying off — fleet_summary.py renders it per replica
@@ -392,10 +452,29 @@ class ReplicaReporter:
             # it
             "queue_depth": (snap["queue_depth"]
                             + snap.get("in_transit", 0)
-                            + snap.get("ready_queue", 0)),
+                            + snap.get("ready_queue", 0)
+                            # in-flight /kv_prefill hops: a prefill-role
+                            # replica's whole load lives here (handler
+                            # threads, never the scheduler queue) — the
+                            # router's load score and the prefill pool's
+                            # queue/TTFT-burn signals must see it
+                            + snap.get("handoff_inflight", 0)),
             "max_queue_depth": self.engine.sc.max_queue_depth,
             "kv_cache_tokens": snap["kv_cache_tokens"],
             "ttft_p95_s": p95,
+            "itl_p95_s": itl_p95,
+            # KV headroom for the decode pool's scale signal. Raw free
+            # count is the WRONG number: a healthy prefix trie fills the
+            # whole arena over time (pages only evict on allocation
+            # pressure), so pages_free trends to ~0 at steady state and a
+            # naive free/total floor would pin the pool at max. Headroom
+            # = free + evictable-NOW (unpinned, trie-only-referenced
+            # pages — kv_manager.stats): it only shrinks when live slots
+            # and pins genuinely hold residency.
+            "kv_pages_free": int(pool.get("pages_free", 0))
+            + int(pool.get("pages_evictable", 0)),
+            "kv_pages_total": int(pool.get("pages_total", 0)),
+            "handoffs_total": snap.get("handoffs_total", 0),
             "prefix_hit_rate": round(hit_rate, 4),
             "draining": self.engine.draining,
         }
@@ -404,7 +483,8 @@ class ReplicaReporter:
         self._post("/fleet/register",
                    {"replica_id": self.replica_id,
                     "base_url": self.advertise_url,
-                    "pod_name": self.pod_name})
+                    "pod_name": self.pod_name,
+                    "role": self.role})
 
     def beat_once(self) -> bool:
         """One heartbeat (re-registering if the router forgot us); returns

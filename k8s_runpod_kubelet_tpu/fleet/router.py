@@ -41,7 +41,7 @@ from typing import Callable, Optional
 
 from ..cloud.transport import CircuitOpenError, TransportError
 from ..tracing import Tracer, format_traceparent, parse_traceparent
-from .registry import Replica, ReplicaRegistry
+from .registry import DECODE, PREFILL, UNIFIED, Replica, ReplicaRegistry
 
 log = logging.getLogger(__name__)
 
@@ -64,6 +64,10 @@ class RouterConfig:
     affinity_prefix_tokens: int = 32
     request_timeout_s: float = 120.0
     retry_after_s: int = 1
+    # disaggregated serving (ISSUE 9): budget for the prefill hop (the
+    # prefill replica's compute + its page push to the decode replica);
+    # a hop that outlives it falls back to a single-hop route
+    handoff_timeout_s: float = 30.0
 
 
 def affinity_key_for(path: str, body: dict, prefix_chars: int = 64,
@@ -111,6 +115,7 @@ class FleetRouter:
             # scrape-from-start: the dashboards' series must exist before
             # the first routed request
             metrics.incr("tpu_fleet_requests", 0, labels={"outcome": "ok"})
+            metrics.incr("tpu_fleet_handoffs", 0, labels={"outcome": "ok"})
 
     @staticmethod
     def _describe(m):
@@ -126,6 +131,15 @@ class FleetRouter:
         m.describe("tpu_fleet_route_seconds",
                    "router-side request latency (pick + forward + relay)",
                    buckets=_ROUTE_BUCKETS)
+        m.describe("tpu_fleet_handoffs",
+                   "disaggregated prefill->decode KV handoffs (labels: "
+                   "outcome=ok|failed|skipped; skipped = the prefill "
+                   "replica declined without computing — prompt under one "
+                   "page or an untokenizable route — an expected quiet "
+                   "fallback, not a failure)")
+        m.describe("tpu_fleet_handoff_seconds",
+                   "prefill-hop latency: prefill compute + page push to "
+                   "the decode replica", buckets=_ROUTE_BUCKETS)
 
     # -- picking ---------------------------------------------------------------
 
@@ -135,11 +149,14 @@ class FleetRouter:
             f"{key}|{replica_id}".encode()).digest()[:8], "big")
 
     def pick(self, affinity_key: str = "",
-             exclude: frozenset = frozenset()) -> tuple[Optional[Replica], str]:
+             exclude: frozenset = frozenset(),
+             roles: Optional[tuple] = None) -> tuple[Optional[Replica], str]:
         """(replica, reason) — reason names the policy leg that chose it
-        (exported on the fleet.route span for tools/fleet_summary.py)."""
+        (exported on the fleet.route span for tools/fleet_summary.py).
+        ``roles`` restricts candidates to those pools (None = any)."""
         candidates = [r for r in self.registry.ready()
-                      if r.replica_id not in exclude]
+                      if r.replica_id not in exclude
+                      and (roles is None or r.role in roles)]
         if not candidates:
             return None, "no_replicas"
         if affinity_key:
@@ -152,6 +169,28 @@ class FleetRouter:
                    key=lambda r: (r.stats.load_score, r.stats.ttft_p95_s,
                                   r.replica_id))
         return best, "least_loaded"
+
+    def disagg_ready(self) -> bool:
+        """Two-hop routing is on the table: both role pools have a ready
+        member. Role presence IS the mode switch — an all-unified fleet
+        routes exactly as before."""
+        return bool(self.registry.ready(PREFILL)) \
+            and bool(self.registry.ready(DECODE))
+
+    def _single_hop_roles(self, tried: frozenset = frozenset()
+                          ) -> Optional[tuple]:
+        """Candidate pools for a single-hop route (the non-disaggregated
+        path AND the fallback when a pool is empty or a handoff failed):
+        unified replicas first — they exist to absorb exactly this — and
+        only when none are USABLE, any pool (every engine can prefill for
+        itself, just without the batch-shape isolation). ``tried`` is the
+        attempt loop's exclusion set: once every unified replica has
+        failed this request, retries must widen to the role pools rather
+        than dead-end on an exhausted unified pool."""
+        if any(r.replica_id not in tried
+               for r in self.registry.ready(UNIFIED)):
+            return (UNIFIED,)
+        return None
 
     def all_saturated(self) -> bool:
         ready = self.registry.ready()
@@ -200,6 +239,81 @@ class FleetRouter:
             self.metrics.incr("tpu_fleet_requests",
                               labels={"outcome": outcome})
 
+    # -- disaggregated two-hop (ISSUE 9) ---------------------------------------
+
+    def plan_two_hop(self, path: str, payload: dict, key: str,
+                     trace: dict) -> Optional[Replica]:
+        """The prefill hop: pick one replica per pool (prefix-affinity on
+        BOTH — the prefill replica's own trie hit shrinks its compute,
+        the decode replica accumulates a conversation's adopted pages),
+        POST /kv_prefill on the prefill replica (it computes the KV and
+        pushes the page run straight to the decode replica's /kv_adopt),
+        and return the decode replica the request should now be forwarded
+        to. Returns None when either pool is empty or the hop failed —
+        the caller falls back to a single-hop route (the decision table
+        in the README). A ``fleet.handoff`` span child of this request's
+        fleet.route records the hop; the engines' serving.kv_prefill /
+        serving.kv_adopt spans parent under it via the traceparent it
+        forwards, joining both engines under one trace_id."""
+        decode_rep, _ = self.pick(key, roles=(DECODE,))
+        prefill_rep, _ = self.pick(key, roles=(PREFILL,))
+        if decode_rep is None or prefill_rep is None:
+            return None
+        started = self.clock()
+        span_id = Tracer.new_span_id()
+        ok, skipped, pages, nbytes, err = False, False, 0, 0, ""
+        try:
+            out = prefill_rep.transport.request(
+                "POST", "/kv_prefill",
+                body={"path": path, "request": payload,
+                      "handoff_to": decode_rep.base_url},
+                timeout_s=self.cfg.handoff_timeout_s,
+                extra_headers={"traceparent": format_traceparent(
+                    trace["trace_id"], span_id)})
+            if isinstance(out, dict) and out.get("ok"):
+                ok = True
+                pages = int(out.get("pages") or 0)
+                nbytes = int(out.get("bytes") or 0)
+            elif isinstance(out, dict) and out.get("skip"):
+                # the prefill replica DECLINED without computing (prompt
+                # under one page, no tokenizer for this route): an
+                # expected condition, not a failure — fall back quietly
+                # and keep the failure series meaningful for alerts
+                skipped = True
+                err = str(out.get("error") or "skipped")
+            else:
+                err = f"unexpected /kv_prefill reply: {out!r}"
+        except (CircuitOpenError, TransportError) as e:
+            err = str(e)
+        dur = self.clock() - started
+        outcome = "ok" if ok else ("skipped" if skipped else "failed")
+        if self.metrics is not None:
+            self.metrics.incr("tpu_fleet_handoffs",
+                              labels={"outcome": outcome})
+            self.metrics.observe("tpu_fleet_handoff_seconds", dur)
+        end = self.tracer.clock()
+        try:
+            self.tracer.record(
+                "fleet.handoff", end - dur, end,
+                trace_id=trace["trace_id"], span_id=span_id,
+                parent_id=trace["span_id"],
+                attrs={"prefill_replica": prefill_rep.replica_id,
+                       "decode_replica": decode_rep.replica_id,
+                       "ok": ok, "outcome": outcome, "pages": pages,
+                       "bytes": nbytes, "error": err or None})
+        except Exception:  # noqa: BLE001 — tracing must never fail a request
+            log.exception("fleet.handoff span recording failed")
+        if skipped:
+            log.debug("fleet: handoff %s -> %s skipped (%s)",
+                      prefill_rep.replica_id, decode_rep.replica_id, err)
+            return None
+        if not ok:
+            log.warning("fleet: handoff %s -> %s failed (%s); falling "
+                        "back to single-hop", prefill_rep.replica_id,
+                        decode_rep.replica_id, err)
+            return None
+        return decode_rep
+
     # -- non-streamed forwarding -----------------------------------------------
 
     def forward(self, path: str, payload: dict,
@@ -222,12 +336,24 @@ class FleetRouter:
                                     "type": "overloaded_error"}},
                     {**headers, "Retry-After": str(self.cfg.retry_after_s)})
         key = self._affinity_key(path, payload)
+        # disaggregated two-hop: prefill hop first, then forward to the
+        # decode replica it fed. Embeddings stay single-hop (no KV to
+        # move); a failed/unavailable hop falls back to the unified pool
+        preferred: Optional[Replica] = None
+        if path != "/v1/embeddings" and self.disagg_ready():
+            preferred = self.plan_two_hop(path, payload, key, trace)
         tried: set[str] = set()
         last: Optional[TransportError] = None
         reason = "no_replicas"
         attempts = 0
         for _ in range(max(1, self.cfg.max_attempts)):
-            replica, reason = self.pick(key, exclude=frozenset(tried))
+            if preferred is not None:
+                replica, reason = preferred, "two_hop"
+                preferred = None
+            else:
+                excl = frozenset(tried)
+                replica, reason = self.pick(
+                    key, exclude=excl, roles=self._single_hop_roles(excl))
             if replica is None:
                 break
             attempts += 1
@@ -297,16 +423,24 @@ class FleetRouter:
 
     # -- streamed forwarding ---------------------------------------------------
 
-    def open_stream(self, path: str, raw_body: bytes,
-                    trace: dict) -> tuple[Optional[Replica], object, object,
-                                          str, int]:
+    def open_stream(self, path: str, raw_body: bytes, trace: dict,
+                    prefer: Optional[Replica] = None,
+                    key: Optional[str] = None
+                    ) -> tuple[Optional[Replica], object, object,
+                               str, int]:
         """Pick a replica and open the upstream response WITHOUT reading
         its body. Failover happens only HERE (before any byte reached the
         client); once the stream is open the relay is committed to this
-        replica. Returns (replica, conn, resp, reason, attempts) — replica
-        None means no stream could be opened (resp carries (status, body,
-        headers) for a plain error response instead)."""
-        key = self._affinity_key(path, self._safe_json(raw_body))
+        replica. ``prefer`` pins the first attempt (the two-hop decode
+        replica whose arena just adopted this prompt's KV); later
+        attempts fall back through the single-hop pools. ``key`` is the
+        precomputed affinity key when the caller already parsed the body
+        (the two-hop planner did). Returns
+        (replica, conn, resp, reason, attempts) — replica None means no
+        stream could be opened (resp carries (status, body, headers) for
+        a plain error response instead)."""
+        if key is None:
+            key = self._affinity_key(path, self._safe_json(raw_body))
         tried: set[str] = set()
         attempts = 0
         last_err: tuple[int, dict, dict] = (
@@ -314,7 +448,13 @@ class FleetRouter:
                             "type": "overloaded_error"}},
             {"Retry-After": str(self.cfg.retry_after_s)})
         for _ in range(max(1, self.cfg.max_attempts)):
-            replica, reason = self.pick(key, exclude=frozenset(tried))
+            if prefer is not None:
+                replica, reason = prefer, "two_hop"
+                prefer = None
+            else:
+                excl = frozenset(tried)
+                replica, reason = self.pick(
+                    key, exclude=excl, roles=self._single_hop_roles(excl))
             if replica is None:
                 break
             attempts += 1
@@ -473,10 +613,12 @@ class _RouterHandler(BaseHTTPRequestHandler):
             try:
                 rep = rt.registry.register(str(body.get("replica_id") or ""),
                                            str(body.get("base_url") or ""),
-                                           str(body.get("pod_name") or ""))
+                                           str(body.get("pod_name") or ""),
+                                           role=str(body.get("role") or ""))
             except ValueError as e:
                 return self._send(400, {"error": str(e)})
-            return self._send(200, {"registered": rep.replica_id})
+            return self._send(200, {"registered": rep.replica_id,
+                                    "role": rep.role})
         if self.path == "/fleet/heartbeat":
             try:
                 ok = rt.registry.heartbeat(str(body.get("replica_id") or ""),
@@ -536,8 +678,14 @@ class _RouterHandler(BaseHTTPRequestHandler):
     def _relay_stream(self, path: str, raw: bytes, trace: dict):
         rt = self.router
         started = rt.clock()
-        replica, conn, resp, reason, attempts = rt.open_stream(path, raw,
-                                                               trace)
+        body = rt._safe_json(raw)
+        key = rt._affinity_key(path, body)
+        prefer = None
+        # same gate as forward(): embeddings carry no KV to move
+        if path != "/v1/embeddings" and rt.disagg_ready():
+            prefer = rt.plan_two_hop(path, body, key, trace)
+        replica, conn, resp, reason, attempts = rt.open_stream(
+            path, raw, trace, prefer=prefer, key=key)
         if replica is None:
             status, body, headers = resp
             rt._outcome("rejected" if status in (429, 503) else "failed")

@@ -52,6 +52,33 @@ def parse_flags(argv):
                    default=None)
     p.add_argument("--max-replicas", dest="fleet_max_replicas", type=int,
                    default=None)
+    # disaggregated pools (ISSUE 9): configuring a prefill AND a decode
+    # pool (max > 0) switches the autoscaler to per-pool control loops —
+    # prefill scales on TTFT burn + queue depth, decode on ITL p95 +
+    # free KV pages — and the router two-hops generation requests
+    p.add_argument("--prefill-min-replicas",
+                   dest="fleet_prefill_min_replicas", type=int, default=None)
+    p.add_argument("--prefill-max-replicas",
+                   dest="fleet_prefill_max_replicas", type=int, default=None,
+                   help="prefill pool ceiling (0 = pool disabled)")
+    p.add_argument("--decode-min-replicas",
+                   dest="fleet_decode_min_replicas", type=int, default=None)
+    p.add_argument("--decode-max-replicas",
+                   dest="fleet_decode_max_replicas", type=int, default=None,
+                   help="decode pool ceiling (0 = pool disabled)")
+    p.add_argument("--itl-slo", dest="fleet_itl_slo_s", type=float,
+                   default=None,
+                   help="decode pool scale-up signal: any decode replica's "
+                        "recent inter-token p95 over this many seconds")
+    p.add_argument("--min-free-kv-page-frac",
+                   dest="fleet_min_free_kv_page_frac", type=float,
+                   default=None,
+                   help="decode pool scale-up signal: pool-wide free KV "
+                        "page fraction under this floor")
+    p.add_argument("--handoff-timeout",
+                   dest="fleet_handoff_timeout_s", type=float, default=None,
+                   help="budget for the prefill hop (compute + page push); "
+                        "past it the router falls back to single-hop")
     p.add_argument("--scale-up-cooldown", dest="fleet_scale_up_cooldown_s",
                    type=float, default=None)
     p.add_argument("--scale-down-cooldown",
@@ -78,7 +105,13 @@ def parse_flags(argv):
 
 def build(cfg: config_mod.Config, kube=None, autoscale: bool = False,
           serving_image: str = "", serving_chips: int = 8):
-    """Wire registry + router (+ autoscaler); injectable kube for tests."""
+    """Wire registry + router (+ autoscalers); injectable kube for tests.
+
+    Returns (registry, router, autoscalers): an empty list without
+    --autoscale, ONE whole-fleet loop in the single-pool default, or one
+    loop PER POOL (prefill + decode, each with its role's signals and its
+    own pod scaler/reaper) when both disaggregated pools are configured
+    (fleet_prefill_max_replicas > 0 and fleet_decode_max_replicas > 0)."""
     metrics = Metrics()
     tracer = Tracer(max_spans=cfg.trace_ring_size,
                     export_path=cfg.trace_export_path)
@@ -87,25 +120,45 @@ def build(cfg: config_mod.Config, kube=None, autoscale: bool = False,
         heartbeat_timeout_s=cfg.fleet_heartbeat_timeout_s,
         breaker_failure_threshold=cfg.breaker_failure_threshold,
         breaker_reset_s=cfg.breaker_reset_s)
-    router = FleetRouter(registry, RouterConfig(port=cfg.fleet_router_port),
-                         metrics=metrics, tracer=tracer)
-    autoscaler = None
+    router = FleetRouter(
+        registry,
+        RouterConfig(port=cfg.fleet_router_port,
+                     handoff_timeout_s=cfg.fleet_handoff_timeout_s),
+        metrics=metrics, tracer=tracer)
+    autoscalers = []
     if autoscale:
         from ..kube import RealKubeClient
         kube = kube or RealKubeClient.from_env(cfg.kubeconfig)
-        scaler = KubePodScaler(kube, cfg.node_name, cfg.namespace,
-                               chips=serving_chips, image=serving_image)
-        autoscaler = FleetAutoscaler(
-            registry, scaler,
-            AutoscalerConfig(
-                min_replicas=cfg.fleet_min_replicas,
-                max_replicas=cfg.fleet_max_replicas,
-                target_queue_per_replica=cfg.fleet_target_queue_per_replica,
-                ttft_slo_s=cfg.fleet_ttft_slo_s,
-                scale_up_cooldown_s=cfg.fleet_scale_up_cooldown_s,
-                scale_down_cooldown_s=cfg.fleet_scale_down_cooldown_s),
-            metrics=metrics, tracer=tracer)
-    return registry, router, autoscaler
+        disagg = (cfg.fleet_prefill_max_replicas > 0
+                  and cfg.fleet_decode_max_replicas > 0)
+        base = dict(
+            target_queue_per_replica=cfg.fleet_target_queue_per_replica,
+            ttft_slo_s=cfg.fleet_ttft_slo_s,
+            scale_up_cooldown_s=cfg.fleet_scale_up_cooldown_s,
+            scale_down_cooldown_s=cfg.fleet_scale_down_cooldown_s)
+        if disagg:
+            pools = [
+                ("prefill", cfg.fleet_prefill_min_replicas,
+                 cfg.fleet_prefill_max_replicas, {}),
+                ("decode", cfg.fleet_decode_min_replicas,
+                 cfg.fleet_decode_max_replicas,
+                 {"itl_slo_s": cfg.fleet_itl_slo_s,
+                  "min_free_kv_page_frac":
+                      cfg.fleet_min_free_kv_page_frac}),
+            ]
+        else:
+            pools = [("", cfg.fleet_min_replicas,
+                      cfg.fleet_max_replicas, {})]
+        for role, mn, mx, extra in pools:
+            scaler = KubePodScaler(kube, cfg.node_name, cfg.namespace,
+                                   chips=serving_chips, image=serving_image,
+                                   role=role)
+            autoscalers.append(FleetAutoscaler(
+                registry, scaler,
+                AutoscalerConfig(min_replicas=mn, max_replicas=mx,
+                                 role=role, **base, **extra),
+                metrics=metrics, tracer=tracer))
+    return registry, router, autoscalers
 
 
 def main(argv=None) -> int:
@@ -116,7 +169,7 @@ def main(argv=None) -> int:
     cfg = config_mod.load(file_path=args.provider_config, overrides=overrides)
     logging.basicConfig(level=getattr(logging, cfg.log_level.upper(),
                                       logging.INFO))
-    registry, router, autoscaler = build(
+    registry, router, autoscalers = build(
         cfg, autoscale=args.autoscale, serving_image=args.serving_image,
         serving_chips=args.serving_chips)
     httpd = serve_router(router)
@@ -136,18 +189,19 @@ def main(argv=None) -> int:
 
     threading.Thread(target=sweep_loop, name="fleet-sweep",
                      daemon=True).start()
-    if autoscaler is not None:
+    for autoscaler in autoscalers:
         autoscaler.run(interval_s=cfg.fleet_heartbeat_interval_s)
-        log.info("autoscaler on: %d..%d replicas, queue target %.1f, "
-                 "TTFT SLO %.2fs", cfg.fleet_min_replicas,
-                 cfg.fleet_max_replicas, cfg.fleet_target_queue_per_replica,
-                 cfg.fleet_ttft_slo_s)
+        ac = autoscaler.cfg
+        log.info("autoscaler[%s] on: %d..%d replicas, queue target %.1f, "
+                 "TTFT SLO %.2fs, ITL SLO %.3fs",
+                 ac.role or "fleet", ac.min_replicas, ac.max_replicas,
+                 ac.target_queue_per_replica, ac.ttft_slo_s, ac.itl_slo_s)
     try:
         threading.Event().wait()
     except KeyboardInterrupt:
         pass
     stop.set()
-    if autoscaler is not None:
+    for autoscaler in autoscalers:
         autoscaler.stop()
     httpd.shutdown()
     router.tracer.close()

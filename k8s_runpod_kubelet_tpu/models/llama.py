@@ -1581,11 +1581,17 @@ class LlamaModel:
         positions = lengths                                    # (B,) write pos
         pages_b = jnp.take_along_axis(
             page_tables, (positions // t)[:, None], axis=1)[:, 0]
+        # an INACTIVE slot must not scatter at all: its page-table row is
+        # stale (page 0 may since belong to another slot's tail), and a
+        # duplicate-index scatter against that slot's genuine write would
+        # resolve in undefined order — clobbering live KV. An out-of-bounds
+        # page id + mode="drop" elides the write instead of masking its
+        # value.
+        pages_b = jnp.where(active, pages_b, arena["k"].shape[1])
         offs = positions % t
         cos, sin = _rope_for(_rope_tables(cfg), None)
         x = _embed(params, token[:, None], cfg, self.mesh)     # (B, 1, E)
         att_len = positions + 1  # the just-written token attends itself
-        act = active[:, None, None]
 
         def block(y, inputs):
             lp, kp, vp = inputs["lp"], inputs["k"], inputs["v"]
@@ -1596,10 +1602,8 @@ class LlamaModel:
                 k = rms_norm(k, _norm_w(lp["k_norm"], cfg), cfg.norm_eps)
             q = apply_rope(q, cos, sin, positions[:, None])
             k = apply_rope(k, cos, sin, positions[:, None])
-            old_k = kp[pages_b, offs]                          # (B, h, d)
-            old_v = vp[pages_b, offs]
-            kp = kp.at[pages_b, offs].set(jnp.where(act, k[:, 0], old_k))
-            vp = vp.at[pages_b, offs].set(jnp.where(act, v[:, 0], old_v))
+            kp = kp.at[pages_b, offs].set(k[:, 0], mode="drop")
+            vp = vp.at[pages_b, offs].set(v[:, 0], mode="drop")
             o = paged_attention(q[:, 0], kp, vp, page_tables, att_len,
                                 sm_scale=cfg.sm_scale,
                                 logit_soft_cap=cfg.attn_logit_softcap,

@@ -32,6 +32,13 @@ The pod command for autoscaled inference. Endpoints:
   GET  /healthz    liveness (200 while the engine thread lives, even
                    draining); GET /readyz is the ROUTABILITY probe (503
                    while draining) — see do_GET for the full contract
+  POST /kv_prefill disaggregated prefill hop (router -> prefill replica):
+                   tokenize the forwarded request, prefill its KV through
+                   the prefix-cache path, and push the serialized page
+                   run to the decode replica named by "handoff_to"
+  POST /kv_adopt   decode-side adoption: a pushed KV page run lands in
+                   this engine's arena via the prefix trie, so the
+                   upcoming request references it zero-copy
   POST /drain      graceful drain (fleet scale-down): stop admitting,
                    finish in-flight, then the fleet reporter deregisters
   GET  /debug/traces  recent request span trees as JSON (?trace_id= filters
@@ -225,7 +232,177 @@ class _Handler(BaseHTTPRequestHandler):
             return text[:min(idxs)], True
         return text, False
 
+    def _request_tokens(self, path: str, body: dict) -> list:
+        """Tokenize a request body into prompt token ids — the ONE
+        tokenization path shared by the live routes (/generate, /prefix,
+        /v1/completions, /v1/chat/completions) and the /kv_prefill
+        handoff hop. Sharing is load-bearing: the prefill replica must
+        produce the token ids the decode replica's prompt will match, or
+        the handed-off pages never hit — a divergent copy would be a
+        silent perf regression, not an error."""
+        if not isinstance(body, dict):
+            raise ValueError("request must be an object")
+        if path == "/v1/chat/completions":
+            messages = body.get("messages")
+            if not (isinstance(messages, list) and messages and all(
+                    isinstance(m, dict) and isinstance(m.get("role"), str)
+                    and isinstance(m.get("content"), str) for m in messages)):
+                raise ValueError("messages must be a non-empty list of "
+                                 "{role, content} objects")
+            if self.tokenizer is None:
+                raise ValueError("chat completions need --tokenizer")
+            tokens = list(self.tokenizer.apply_chat(messages))
+            if not tokens:
+                raise ValueError("empty prompt")
+            return tokens
+        if path == "/v1/completions":
+            prompt = body.get("prompt", "")
+            if isinstance(prompt, list) and all(
+                    isinstance(t, int) for t in prompt):
+                tokens = prompt
+            elif isinstance(prompt, str):
+                if self.tokenizer is None:
+                    raise ValueError("string prompts need --tokenizer; "
+                                     "send a token list instead")
+                tokens = self.tokenizer.encode(prompt)
+            else:
+                raise ValueError("prompt must be a string or token list")
+            if not tokens:
+                raise ValueError("empty prompt")
+            return tokens
+        # /generate and /prefix share the tokens/text body format
+        if "text" in body and "tokens" not in body:
+            if self.tokenizer is None:
+                raise ValueError(
+                    'server has no tokenizer (start with --tokenizer '
+                    'bytes or a HF tokenizer dir) — send "tokens"')
+            if not isinstance(body["text"], str):
+                raise ValueError("text must be a string")
+            tokens = self.tokenizer.encode(body["text"])
+            if not tokens:
+                raise ValueError("text tokenized to nothing")
+            return tokens
+        tokens = body.get("tokens")
+        if not isinstance(tokens, list) or not all(
+                isinstance(t, int) for t in tokens):
+            raise ValueError("tokens must be a list of ints")
+        return tokens
+
+    def _kv_prefill(self):
+        """Disaggregated prefill hop (router -> prefill replica): compute
+        the prompt's KV through the engine's prefix-cache prefill path
+        and PUSH the serialized page run straight to the decode replica's
+        /kv_adopt. Runs on this handler thread (a prefill-role replica's
+        whole job). The serving.kv_prefill span parents under the
+        router's fleet.handoff via the inbound traceparent — one trace_id
+        joins both engines' spans."""
+        tr = self.engine.tracer
+        inbound = parse_traceparent(self.headers.get("traceparent"))
+        trace_id = inbound[0] if inbound else Tracer.new_trace_id()
+        parent = inbound[1] if inbound else ""
+        span_id = Tracer.new_span_id()
+        started = tr.clock()
+
+        def span(ok: bool, attrs: dict):
+            try:
+                tr.record("serving.kv_prefill", started, tr.clock(),
+                          trace_id=trace_id, span_id=span_id,
+                          parent_id=parent, attrs={"ok": ok, **attrs})
+            except Exception:  # noqa: BLE001 — tracing never fails the hop
+                log.exception("serving.kv_prefill span failed")
+
+        try:
+            req = self._read_json()
+            target = req.get("handoff_to")
+            if not (isinstance(target, str) and target):
+                raise ValueError('need "handoff_to" (decode replica URL)')
+        except (json.JSONDecodeError, ValueError, TypeError) as e:
+            span(False, {"error": str(e)})
+            return self._send(400, {"ok": False, "error": str(e)})
+        try:
+            tokens = self._request_tokens(
+                str(req.get("path") or "/generate"),
+                req.get("request") or {})
+            # preflight BEFORE any compute: a prompt under one full page
+            # has nothing to hand off — running the prefill here would
+            # just double it (the fallback replica prefills again)
+            if len(tokens) < self.engine.sc.kv_page_tokens:
+                raise ValueError(
+                    f"prompt of {len(tokens)} tokens is under one "
+                    f"{self.engine.sc.kv_page_tokens}-token page")
+        except (ValueError, TypeError) as e:
+            # expected decline (short prompt, no tokenizer for this
+            # route), not a failure: the router falls back quietly and
+            # neither side's failure counter moves
+            span(False, {"skip": True, "error": str(e)})
+            return self._send(200, {"ok": False, "skip": True,
+                                    "error": str(e)})
+        try:
+            out = self.engine.export_handoff(tokens)
+        except Exception as e:  # noqa: BLE001 — export counts its own failures
+            span(False, {"tokens": len(tokens), "error": str(e)})
+            return self._send(502, {"ok": False, "error": str(e)})
+        blob = out["blob"]
+        try:
+            import urllib.request
+            push = urllib.request.Request(
+                target.rstrip("/") + "/kv_adopt", data=blob,
+                headers={"Content-Type": "application/octet-stream",
+                         "traceparent": format_traceparent(trace_id,
+                                                           span_id)},
+                method="POST")
+            with urllib.request.urlopen(
+                    push, timeout=self.request_timeout_s) as resp:
+                adopted = json.loads(resp.read() or b"{}")
+            if not adopted.get("ok"):
+                raise OSError(f"decode replica refused adoption: {adopted}")
+        except Exception as e:  # noqa: BLE001 — any push failure = failed hop
+            self.engine.metrics.incr("tpu_serving_kv_handoff_failures")
+            span(False, {"tokens": len(tokens), "pages": out["pages"],
+                         "error": str(e)})
+            return self._send(502, {"ok": False, "error": str(e)})
+        span(True, {"tokens": len(tokens), "pages": out["pages"],
+                    "bytes": len(blob),
+                    "matched_tokens": out["matched_tokens"]})
+        return self._send(200, {
+            "ok": True, "pages": out["pages"], "bytes": len(blob),
+            "covered_tokens": out["covered_tokens"],
+            "matched_tokens": out["matched_tokens"],
+            "adopted": adopted.get("pages")})
+
+    def _kv_adopt(self):
+        """Decode-side half: adopt a pushed KV page run into this
+        engine's arena (prefix trie) so the upcoming request's prompt
+        match references it zero-copy."""
+        tr = self.engine.tracer
+        inbound = parse_traceparent(self.headers.get("traceparent"))
+        trace_id = inbound[0] if inbound else Tracer.new_trace_id()
+        parent = inbound[1] if inbound else ""
+        started = tr.clock()
+        length = int(self.headers.get("Content-Length") or 0)
+        blob = self.rfile.read(length) if length else b""
+
+        def span(ok: bool, attrs: dict):
+            try:
+                tr.record("serving.kv_adopt", started, tr.clock(),
+                          trace_id=trace_id, parent_id=parent,
+                          attrs={"ok": ok, **attrs})
+            except Exception:  # noqa: BLE001 — tracing never fails the hop
+                log.exception("serving.kv_adopt span failed")
+
+        try:
+            out = self.engine.adopt_handoff(blob)
+        except Exception as e:  # noqa: BLE001 — adopt counts its own failures
+            span(False, {"bytes": len(blob), "error": str(e)})
+            return self._send(400, {"ok": False, "error": str(e)})
+        span(True, out)
+        return self._send(200, {"ok": True, **out})
+
     def do_POST(self):
+        if self.path == "/kv_prefill":
+            return self._kv_prefill()
+        if self.path == "/kv_adopt":
+            return self._kv_adopt()
         if self.path == "/drain":
             # graceful scale-down (fleet autoscaler contract): stop
             # admitting, finish in-flight. Idempotent; progress is
@@ -275,21 +452,7 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send(404, {"error": f"no route {self.path}"})
         try:
             req = self._read_json()
-            if "text" in req and "tokens" not in req:
-                if self.tokenizer is None:
-                    raise ValueError(
-                        'server has no tokenizer (start with --tokenizer '
-                        'bytes or a HF tokenizer dir) — send "tokens"')
-                if not isinstance(req["text"], str):
-                    raise ValueError("text must be a string")
-                tokens = self.tokenizer.encode(req["text"])
-                if not tokens:
-                    raise ValueError("text tokenized to nothing")
-            else:
-                tokens = req["tokens"]
-            if not isinstance(tokens, list) or not all(
-                    isinstance(t, int) for t in tokens):
-                raise ValueError("tokens must be a list of ints")
+            tokens = self._request_tokens(self.path, req)
         except (json.JSONDecodeError, KeyError, ValueError, TypeError) as e:
             return self._send(400, {"error": f"bad request: {e}"})
         if self.path == "/prefix":
@@ -513,31 +676,8 @@ class _Handler(BaseHTTPRequestHandler):
         stop tail until it is known not to be one."""
         try:
             req = self._read_json()
-            if chat:
-                messages = req.get("messages")
-                if not (isinstance(messages, list) and messages and all(
-                        isinstance(m, dict) and isinstance(m.get("role"), str)
-                        and isinstance(m.get("content"), str)
-                        for m in messages)):
-                    raise ValueError("messages must be a non-empty list of "
-                                     "{role, content} objects")
-                if self.tokenizer is None:
-                    raise ValueError("chat completions need --tokenizer")
-                tokens = list(self.tokenizer.apply_chat(messages))
-            else:
-                prompt = req.get("prompt", "")
-                if isinstance(prompt, list) and all(
-                        isinstance(t, int) for t in prompt):
-                    tokens = prompt
-                elif isinstance(prompt, str):
-                    if self.tokenizer is None:
-                        raise ValueError("string prompts need --tokenizer; "
-                                         "send a token list instead")
-                    tokens = self.tokenizer.encode(prompt)
-                else:
-                    raise ValueError("prompt must be a string or token list")
-            if not tokens:
-                raise ValueError("empty prompt")
+            tokens = self._request_tokens(
+                "/v1/chat/completions" if chat else "/v1/completions", req)
             stop, stop_strs = self._parse_stop(req.get("stop"))
             n = req.get("n")
             n = 1 if n is None else n
@@ -1036,6 +1176,20 @@ def main(argv=None) -> int:
                         "the matched span's prefill (default from config/"
                         "TPU_PREFIX_CACHE_ENABLED, on; register_prefix "
                         "works either way)")
+    p.add_argument("--paged-decode", default=None, choices=["auto", "off"],
+                   dest="kv_paged_decode",
+                   help="decode hot loop on per-slot page tables over the "
+                        "shared arena: prefix hits and handed-off KV are "
+                        "referenced zero-copy (default from config/"
+                        "TPU_KV_PAGED_DECODE, auto — on whenever the "
+                        "model/layout allows it)")
+    p.add_argument("--serving-role", default=None, dest="serving_role",
+                   choices=["unified", "prefill", "decode"],
+                   help="disaggregated-serving pool this replica registers "
+                        "into: prefill computes KV and hands pages off, "
+                        "decode adopts KV and streams tokens, unified does "
+                        "both (default from config/TPU_SERVING_ROLE, "
+                        "unified)")
     p.add_argument("--hf-checkpoint", default="",
                    help="HuggingFace model directory (safetensors/bin) to "
                         "load real weights from; empty = random init")
@@ -1073,6 +1227,12 @@ def main(argv=None) -> int:
     prefix_cache_enabled = (base_cfg.prefix_cache_enabled
                             if args.prefix_cache_enabled is None
                             else args.prefix_cache_enabled == "on")
+    # paged decode: config True = auto (engine decides eligibility),
+    # False pins the contiguous loop; the flag overrides either way
+    kv_paged_decode = (base_cfg.kv_paged_decode
+                       if args.kv_paged_decode is None
+                       else args.kv_paged_decode == "auto")
+    serving_role = args.serving_role or base_cfg.serving_role
     cfg = MODEL_CONFIGS[args.model]()
     log.info("loading %s (%.2fB params) on %s", cfg.name,
              cfg.param_count / 1e9, jax.default_backend())
@@ -1155,6 +1315,7 @@ def main(argv=None) -> int:
         kv_page_tokens=kv_page_tokens,
         kv_pool_pages=kv_pool_pages,
         prefix_cache_enabled=prefix_cache_enabled,
+        paged_decode=None if kv_paged_decode else False,
         # text mode stops at the tokenizer's EOS instead of always burning
         # the full max_new_tokens budget
         eos_token=(tokenizer.eos_id if tokenizer is not None else -1)),
@@ -1183,9 +1344,10 @@ def main(argv=None) -> int:
             # scale-down delete a nonexistent pod (404 swallowed) and
             # leak the real one
             pod_name=host,
-            interval_s=args.fleet_heartbeat_interval).start()
-        log.info("fleet: reporting to %s as %s", args.fleet_router,
-                 reporter.replica_id)
+            interval_s=args.fleet_heartbeat_interval,
+            role=serving_role).start()
+        log.info("fleet: reporting to %s as %s (role %s)",
+                 args.fleet_router, reporter.replica_id, serving_role)
     try:
         threading.Event().wait()
     except KeyboardInterrupt:

@@ -177,7 +177,35 @@ class ServingEngine:
             queue.Queue(maxsize=sc.slots)
         self._slots = [_Slot() for _ in range(sc.slots)]
         self._ring_len = self._pick_ring_len(cfg, sc)
-        self._cache = self._fresh_cache(sc.slots)
+        # -- paged decode loop eligibility (ISSUE 9) -----------------------
+        # the decode hot loop runs on per-slot page tables over the shared
+        # arena (paged_decode_step) whenever the layout allows it: plain
+        # dense K/V only (paged_decode_step covers no MLA / sliding-window
+        # / int8-KV), single host (the paged step is not yet shard_mapped
+        # over ``tensor``), no adapters or speculation (the paged kernel
+        # takes neither), prefix cache on (the arena IS the slot storage),
+        # and — under an EXPLICIT kv_pool_pages — a pool big enough to hold
+        # every slot's full residency (a smaller pool would reject
+        # admissions under load; auto sizing below always suffices).
+        t = sc.kv_page_tokens
+        slot_pages = -(-sc.cache_len // t)  # ceil: pages one full slot needs
+        pageable = (sc.prefix_cache_enabled and self._ring_len is None
+                    and t < sc.cache_len)
+        eligible = (pageable and not cfg.is_mla
+                    and cfg.sliding_window is None
+                    and not sc.quantize_kv_int8 and sc.speculate_k == 0
+                    and sc.lora_rank == 0 and mesh is None
+                    and (sc.kv_pool_pages == 0
+                         or sc.kv_pool_pages >= sc.slots * slot_pages))
+        if sc.paged_decode is True and not eligible:
+            raise ValueError(
+                "paged_decode=True needs a plain dense K/V layout (no "
+                "MLA/sliding-window/int8-KV), no mesh, no adapters, no "
+                "speculation, prefix_cache_enabled, kv_page_tokens < "
+                "cache_len, and kv_pool_pages 0 (auto) or >= slots * "
+                f"ceil(cache_len / kv_page_tokens) = "
+                f"{sc.slots * slot_pages}")
+        self._paged_loop = eligible and sc.paged_decode is not False
         # -- prefix cache (paged pool or dense fallback) -------------------
         # the paged pool (kv_manager.py): radix trie over page-granular
         # shared KV in one preallocated arena. Ring/mixed layouts cannot
@@ -186,28 +214,60 @@ class ServingEngine:
         # through the dense fallback store. All prefix state — trie, pool,
         # arena reads AND writes (writes donate) — is serialized under
         # _prefix_lock; registered-prefix dedup/cap rides the same lock.
+        # With the paged decode LOOP on, the engine thread's decode step
+        # also reads+donates the arena — its dispatch rides the same lock,
+        # so every arena-touching dispatch is serialized and always sees
+        # the latest buffer handles.
         self._prefix_lock = threading.Lock()
         self._registered: list[list[int]] = []
+        # in-flight /kv_prefill hops (prefill-role load: they run on
+        # handler threads, never in the queue/slots — see export_handoff)
+        self._handoff_lock = threading.Lock()
+        self.handoff_inflight = 0
+        # cumulative completed hops: heartbeats carry it so the prefill
+        # pool's autoscaler can see steady short-hop traffic that the
+        # sampled inflight count aliases to zero (hops last ~100ms,
+        # heartbeats sample every ~2s — most samples would see idle)
+        self.handoffs_total = 0
         self._kv_store: Optional[PagedKVStore] = None
         self._dense_prefixes: Optional[DensePrefixStore] = None
-        if sc.prefix_cache_enabled and self._ring_len is None \
-                and sc.kv_page_tokens < sc.cache_len:
+        if pageable:
+            # paged-loop auto sizing DOUBLES the arena: the decode slots
+            # now live in it (one decode-cache's worth) on top of the
+            # shared prefix pool (the other)
             n_pages = sc.kv_pool_pages or max(
-                1, sc.slots * sc.cache_len // sc.kv_page_tokens)
+                1, (2 * sc.slots * slot_pages) if self._paged_loop
+                else sc.slots * sc.cache_len // t)
             quant = sc.quantize_kv_int8
-            self._kv_store = PagedKVStore(
-                n_pages, sc.kv_page_tokens,
+            self._make_store = lambda: PagedKVStore(
+                n_pages, t,
                 lambda: self.model.init_cache(1, sc.cache_len,
                                               quantize=quant),
                 mesh=mesh)
+            self._kv_store = self._make_store()
         else:
             self._dense_prefixes = DensePrefixStore(
                 max_adapter_variants=sc.max_prefixes)
+        # contiguous batch cache — not allocated in paged-loop mode (the
+        # slots' KV lives in the arena; skipping it is the memory win)
+        self._cache = None if self._paged_loop \
+            else self._fresh_cache(sc.slots)
+        # per-slot page tables: (slots, max pages a slot can span). Rows
+        # are maintained host-side (np) and shipped to device per step;
+        # entries past a slot's run stay 0 — paged_attention requires
+        # never-read entries to still be VALID page indices.
+        self._slot_pages_max = slot_pages
+        self._page_tables_np = np.zeros((sc.slots, slot_pages), np.int32)
         # hit-rate series visible from pod start (the fleet reporter and
         # dashboards divide them; zero-seeding keeps the series defined)
         self.metrics.incr("tpu_serving_prefix_cache_hits", 0)
         self.metrics.incr("tpu_serving_prefix_cache_misses", 0)
         self.metrics.incr("tpu_serving_prefix_cache_evictions", 0)
+        # handoff series visible from pod start (fleet dashboards join
+        # sender and receiver sides per trace)
+        self.metrics.incr("tpu_serving_kv_handoff_pages", 0)
+        self.metrics.incr("tpu_serving_kv_handoff_bytes", 0)
+        self.metrics.incr("tpu_serving_kv_handoff_failures", 0)
         self._update_page_gauges()
         # per-slot sampling state: (request seed, draws so far) -> PRNG key
         self._slot_seed = np.zeros((sc.slots,), np.uint32)
@@ -271,6 +331,13 @@ class ServingEngine:
         # difference between O(tokens written) and O(cache bytes) per step
         donate = (2,) if sc.donate_cache else ()
         self._decode = jax.jit(self.model.decode_step, donate_argnums=donate)
+        # paged decode loop: arg 2 is the ARENA (donated in place of the
+        # batch cache — same in-place-update economics, shared storage)
+        self._paged_step = (jax.jit(self.model.paged_decode_step,
+                                    donate_argnums=donate)
+                            if self._paged_loop else None)
+        self.metrics.set_gauge("tpu_serving_paged_decode",
+                               1 if self._paged_loop else 0)
         self._verify = (jax.jit(self.model.verify_step, donate_argnums=donate)
                         if sc.speculate_k > 0 else None)
         # the prefill thread's verify is NOT donated: a prefix-cache hit
@@ -338,6 +405,19 @@ class ServingEngine:
         m.describe("tpu_serving_kv_pages_shared",
                    "KV pages serving more than one cached sequence "
                    "(trie-interior or multiply-referenced: the dedup win)")
+        m.describe("tpu_serving_paged_decode",
+                   "1 when the decode hot loop runs on per-slot page "
+                   "tables over the shared arena (zero-copy prefix/"
+                   "handoff adoption), 0 on the contiguous slot cache")
+        m.describe("tpu_serving_kv_handoff_pages",
+                   "KV pages moved by prefill->decode handoffs (sender "
+                   "counts serialized pages, receiver counts adopted)")
+        m.describe("tpu_serving_kv_handoff_bytes",
+                   "serialized KV bytes moved by prefill->decode handoffs")
+        m.describe("tpu_serving_kv_handoff_failures",
+                   "KV handoffs that failed (serialization, validation, "
+                   "or adoption) — the router falls back to a full "
+                   "prefill on the target")
         m.describe("tpu_serving_spec_proposed",
                    "speculative draft tokens proposed")
         m.describe("tpu_serving_spec_accepted",
@@ -737,7 +817,7 @@ class ServingEngine:
             if r is None:
                 slots.append({"slot": i, "state": "free"})
                 continue
-            slots.append({
+            entry = {
                 "slot": i, "state": "decoding", "rid": r.rid,
                 "trace_id": r.trace_id or None,
                 "age_s": round(now - r.submitted_at, 4),
@@ -745,7 +825,10 @@ class ServingEngine:
                 "generated_tokens": len(s.generated),
                 "remaining_tokens": s.remaining,
                 "adapter_id": r.adapter_id,
-            })
+            }
+            if self._paged_loop:
+                entry["pages"] = len(s.pages)
+            slots.append(entry)
         with self._prefix_lock:
             if self._dense_prefixes is not None:
                 prefixes = self._dense_prefixes.snapshot()
@@ -753,6 +836,9 @@ class ServingEngine:
                 prefixes = [{"tokens": len(t)} for t in self._registered]
         kv_tokens = sum(s.get("prompt_tokens", 0) + s.get("generated_tokens", 0)
                         for s in slots)
+        with self._handoff_lock:
+            handoff_inflight = self.handoff_inflight
+            handoffs_total = self.handoffs_total
         return {
             "model": self.cfg.name,
             "alive": self.alive,
@@ -767,8 +853,11 @@ class ServingEngine:
             # fleet reporter folds this into its queue_depth so a remote
             # drain-progress check can't see "empty" during a hop
             "in_transit": self._transit,
+            "handoff_inflight": handoff_inflight,
+            "handoffs_total": handoffs_total,
             "kv_cache_tokens": kv_tokens,
             "cache_len": self.sc.cache_len,
+            "paged_decode": self._paged_loop,
             "prefixes": prefixes,
             "max_prefixes": self.sc.max_prefixes,
             "prefix_cache": self.prefix_cache_stats(),
@@ -830,7 +919,22 @@ class ServingEngine:
                 # decode needs fresh ones. If even this allocation fails
                 # (e.g. the same HBM OOM), the engine thread dies — but no
                 # caller is left hanging, and `alive` flips for the probes.
-                self._cache = self._fresh_cache(self.sc.slots)
+                if self._paged_loop:
+                    # the crashed step may have donated the ARENA: rebuild
+                    # the whole store (fresh arena + empty trie + full free
+                    # list) and drop every slot's page state. Registered
+                    # prefixes survive in _registered (dedup keeps working)
+                    # but their pinned KV is gone — the next prompt re-
+                    # prefills and re-caches it, a latency blip, not a
+                    # correctness loss.
+                    for slot in self._slots:
+                        slot.pages = []
+                        slot.kv_len = 0
+                    self._page_tables_np[:] = 0
+                    with self._prefix_lock:
+                        self._kv_store = self._make_store()
+                else:
+                    self._cache = self._fresh_cache(self.sc.slots)
                 self._tokens = jnp.zeros((self.sc.slots,), jnp.int32)
                 self._slot_adapter[:] = 0
 
@@ -1157,6 +1261,120 @@ class ServingEngine:
             self.metrics.incr("tpu_serving_prefix_cache_evictions", evicted)
         self._update_page_gauges()
 
+    # -- disaggregated KV handoff (ISSUE 9) ------------------------------------
+
+    def export_handoff(self, tokens: list[int]) -> dict:
+        """Prefill-role half of a handoff: run ``tokens`` through the
+        normal prefix-cache prefill path (matched pages skip compute; the
+        prompt's full pages land in this arena) and serialize the run for
+        a decode replica to adopt. Returns {"blob", "pages",
+        "covered_tokens", "matched_tokens"} — matched_tokens is how much
+        THIS replica's cache already held before the prefill.
+
+        Runs on the caller's (HTTP handler) thread like ``embed()``:
+        device work serializes with the engine loop's dispatches, which a
+        prefill-role replica — the intended caller — barely has. The hop
+        is this replica's LOAD: it never touches the scheduler queue or a
+        slot, so ``handoff_inflight`` (surfaced via debug_snapshot ->
+        ReplicaReporter queue_depth) and a TTFT observation make the
+        prefill pool's autoscaler signals see the work — without them a
+        saturated prefill pool reports itself idle and scales to min."""
+        from ...fleet.handoff import HandoffError, serialize_pages
+        if self._kv_store is None:
+            raise HandoffError("this replica has no paged KV arena "
+                               "(ring/mixed layout or prefix cache "
+                               "disabled) — it cannot hand off KV")
+        tokens = list(tokens)
+        if not tokens:
+            raise ValueError("empty prompt")
+        if len(tokens) > self.sc.cache_len - 1:
+            raise ValueError(f"prompt length {len(tokens)} > cache budget "
+                             f"{self.sc.cache_len - 1}")
+        started = self._perf()
+        with self._handoff_lock:
+            self.handoff_inflight += 1
+        try:
+            _, _single, matched = self._prefill_tokens(tokens)
+            # ONE store reference for match -> export -> release: crash
+            # recovery may rebind self._kv_store between these steps, and
+            # releasing old-store page ids against the rebuilt pool would
+            # corrupt refcounts (releasing on the discarded store is
+            # harmless — it is dropped wholesale)
+            with self._prefix_lock:
+                store = self._kv_store
+                m = store.match_full(0, tokens)
+                frags = store.export_pages(m.pages) if m.pages else {}
+            try:
+                if not m.pages:
+                    raise HandoffError(
+                        f"no full pages to hand off for a {len(tokens)}-"
+                        f"token prompt at page size "
+                        f"{self.sc.kv_page_tokens} (prompt shorter than "
+                        "one page, or the pool evicted it)")
+                # host copies OUTSIDE the lock: the fragments are private
+                # device buffers, valid across later arena donations
+                sections = {name: np.asarray(a) for name, a in frags.items()}
+                blob = serialize_pages(tokens[:m.matched_tokens],
+                                       self.sc.kv_page_tokens, sections,
+                                       model=self.cfg.name)
+            finally:
+                with self._prefix_lock:
+                    store.release(m.pages)
+        except Exception:
+            self.metrics.incr("tpu_serving_kv_handoff_failures")
+            raise
+        finally:
+            with self._handoff_lock:
+                self.handoff_inflight -= 1
+        with self._handoff_lock:
+            self.handoffs_total += 1
+        self.metrics.incr("tpu_serving_kv_handoff_pages", len(m.pages))
+        self.metrics.incr("tpu_serving_kv_handoff_bytes", len(blob))
+        # the hop IS a prefill replica's time-to-first-token contribution:
+        # feed the TTFT histogram so the pool's TTFT-burn signal has data
+        self.metrics.observe("tpu_serving_ttft_seconds",
+                             self._perf() - started)
+        return {"blob": blob, "pages": len(m.pages),
+                "covered_tokens": m.matched_tokens,
+                "matched_tokens": matched}
+
+    def adopt_handoff(self, blob: bytes) -> dict:
+        """Decode-role half: validate and adopt a serialized page run
+        into this arena through the trie — the engine's next prompt match
+        then references the adopted pages zero-copy and only the sub-page
+        tail recomputes. The handoff counters move ONLY after the
+        adoption actually landed (a failed adoption is a failure, never
+        an optimistic hit). Returns {pages, tokens, bytes, evicted}."""
+        from ...fleet.handoff import HandoffError, deserialize_pages
+        try:
+            if self._kv_store is None:
+                raise HandoffError("this replica has no paged KV arena "
+                                   "(ring/mixed layout or prefix cache "
+                                   "disabled) — it cannot adopt KV")
+            with self._prefix_lock:
+                spec = self._kv_store.section_spec()
+            header, sections = deserialize_pages(
+                blob, expect_page_tokens=self.sc.kv_page_tokens,
+                expect_sections=spec, expect_model=self.cfg.name)
+            if len(header["tokens"]) > self.sc.cache_len:
+                raise HandoffError(
+                    f"handoff spans {len(header['tokens'])} tokens, over "
+                    f"this replica's cache budget {self.sc.cache_len}")
+            with self._prefix_lock:
+                added, evicted = self._kv_store.adopt(
+                    0, header["tokens"], sections)
+        except Exception:
+            self.metrics.incr("tpu_serving_kv_handoff_failures")
+            raise
+        self.metrics.incr("tpu_serving_kv_handoff_pages", header["n_pages"])
+        self.metrics.incr("tpu_serving_kv_handoff_bytes", len(blob))
+        if evicted:
+            self.metrics.incr("tpu_serving_prefix_cache_evictions", evicted)
+        self._update_page_gauges()
+        return {"pages": header["n_pages"], "added": added,
+                "tokens": len(header["tokens"]), "bytes": len(blob),
+                "evicted": evicted}
+
     def _prefill_loop(self):
         """Dedicated prefill worker: drains the request queue, runs the
         prefill jit, and hands (request, cache, first token) to the engine.
@@ -1277,18 +1495,60 @@ class ServingEngine:
                 with self._transit_lock:
                     self._transit -= 1
             admitted = True
-            if self._finished(slot):
+            # a failed paged bind (pool exhausted) leaves the slot FREE —
+            # the request was already failed; _finished would deref None
+            if slot.request is not None and self._finished(slot):
                 self._complete(slot_id, slot)
         self.metrics.set_gauge("tpu_serving_active_slots", self.active_slots)
         self._update_kv_gauge()
         return admitted
 
+    def _bind_paged_slot(self, slot_id: int, slot: _Slot,
+                         req: Request, single: Params) -> bool:
+        """Build the slot's page-table row (paged decode loop): reference
+        the prompt's cached full pages ZERO-COPY (the prefill thread's
+        insert already wrote them; shared pages are read-only — decode
+        writes only ever land in the slot's private tail), allocate
+        private pages for whatever the trie doesn't hold, and fill those
+        from the prefilled single cache. Returns False (request failed,
+        slot stays free) when the pool can't supply the tail pages."""
+        from .kv_manager import PoolExhausted
+        store = self._kv_store
+        t = self.sc.kv_page_tokens
+        n_prompt = len(req.prompt)
+        with self._prefix_lock:
+            m = store.match_full(req.adapter_id, req.prompt)
+            covered = m.matched_tokens
+            n_tail = -(-(n_prompt - covered) // t)
+            try:
+                tail = store.alloc_run(n_tail) if n_tail else []
+            except PoolExhausted as exc:
+                store.release(m.pages)
+                _fail_future(req.future, EngineOverloaded(
+                    f"KV pool exhausted admitting {req.rid}: {exc}; "
+                    "retry later or raise kv_pool_pages"))
+                self.metrics.incr("tpu_serving_admission_rejected")
+                return False
+            if tail:
+                store.fill_pages(single, tail, covered)
+            slot.pages = list(m.pages) + tail
+            slot.kv_len = n_prompt
+        row = self._page_tables_np[slot_id]
+        row[:] = 0
+        row[:len(slot.pages)] = slot.pages
+        return True
+
     def _admit_into_slot(self, slot_id: int, slot: _Slot, req: Request,
                          single: Params, first: int, first_lp):
         """Insert one prefilled cache into a free slot; runs with the
-        transit count held by _admit."""
-        self._cache = self._insert(self._cache, single,
-                                   jnp.asarray(slot_id, jnp.int32))
+        transit count held by _admit. Paged loop: the slot references
+        shared arena pages instead of receiving a contiguous copy."""
+        if self._paged_loop:
+            if not self._bind_paged_slot(slot_id, slot, req, single):
+                return
+        else:
+            self._cache = self._insert(self._cache, single,
+                                       jnp.asarray(slot_id, jnp.int32))
         self._tokens = self._tokens.at[slot_id].set(first)
         self._slot_adapter[slot_id] = req.adapter_id
         self._slot_seed[slot_id] = req.seed
@@ -1513,6 +1773,8 @@ class ServingEngine:
             for s in self._slots if s.request is not None))
 
     def _decode_once(self):
+        if self._paged_loop:
+            return self._decode_once_paged()
         if self._verify is not None and self._decode_once_speculative():
             return
         active_mask = jnp.asarray([s.request is not None for s in self._slots])
@@ -1521,6 +1783,62 @@ class ServingEngine:
             self._adapters,
             None if self._adapters is None
             else jnp.asarray(self._slot_adapter.copy()))
+        self._commit_decode(logits)
+
+    def _decode_once_paged(self):
+        """One decode step on per-slot page tables over the shared arena
+        (paged_decode_step): matched prefix pages and adopted handoff
+        pages are attended IN PLACE — no per-slot contiguous copy exists
+        anywhere. The step's dispatch rides _prefix_lock because it
+        DONATES the arena; the lock covers dispatch only (async), never
+        the device wait, so prefill-thread arena ops interleave at
+        dispatch granularity."""
+        from .kv_manager import PoolExhausted
+        store = self._kv_store
+        t = self.sc.kv_page_tokens
+        # tail-page allocation: a slot whose next write position starts a
+        # fresh page gets a PRIVATE page before the step — shared prefix
+        # pages are never written (allocate-on-write COW discipline)
+        for slot_id, slot in enumerate(self._slots):
+            if slot.request is None:
+                continue
+            if slot.kv_len % t == 0 and len(slot.pages) * t <= slot.kv_len:
+                with self._prefix_lock:
+                    try:
+                        page = store.alloc_run(1)[0]
+                    except PoolExhausted as exc:
+                        # fail THIS request; the engine (and every other
+                        # slot) keeps serving — prefix caching degrades,
+                        # decode capacity does not crash
+                        store.release(slot.pages)
+                        slot.pages = []
+                        slot.kv_len = 0
+                        self._page_tables_np[slot_id][:] = 0
+                        req, slot.request = slot.request, None
+                        _fail_future(req.future, RuntimeError(
+                            f"KV pool exhausted mid-decode for {req.rid}: "
+                            f"{exc}"))
+                        continue
+                slot.pages.append(page)
+                self._page_tables_np[slot_id][len(slot.pages) - 1] = page
+        active = [s.request is not None for s in self._slots]
+        if not any(active):
+            self.metrics.set_gauge("tpu_serving_active_slots", 0)
+            return
+        lengths = jnp.asarray([s.kv_len for s in self._slots], jnp.int32)
+        page_tables = jnp.asarray(self._page_tables_np)
+        with self._prefix_lock:
+            logits, arena, _ = self._paged_step(
+                self.params, self._tokens, store.arena, page_tables,
+                lengths, jnp.asarray(active))
+            store.arena = arena
+        self._commit_decode(logits)
+
+    def _commit_decode(self, logits):
+        """Host-side half of a decode step, shared by the contiguous and
+        paged loops: per-slot sampling (temperature/top-k/top-p,
+        penalties, logit_bias), logprobs, stream emission, stop checks,
+        and the step metrics."""
         reqs = [s.request for s in self._slots]
         temps = [r.temperature if r else 0.0 for r in reqs]
         ks = [r.top_k if r else 0 for r in reqs]
@@ -1540,6 +1858,9 @@ class ServingEngine:
             if slot.request is None:
                 continue
             n_active += 1
+            if self._paged_loop:
+                # the step wrote this slot's input token's KV at kv_len
+                slot.kv_len += 1
             tok = int(next_np[slot_id])
             slot.generated.append(tok)
             if slot.request.logprobs and lp_np is not None:
@@ -1696,6 +2017,14 @@ class ServingEngine:
         req = slot.request
         slot.request = None
         self._slot_adapter[slot_id] = 0
+        if self._paged_loop and slot.pages:
+            # drop the slot's references: shared prefix pages stay in the
+            # trie for the next hit, private tail pages free immediately
+            with self._prefix_lock:
+                self._kv_store.release(slot.pages)
+            slot.pages = []
+            slot.kv_len = 0
+            self._page_tables_np[slot_id][:] = 0
         latency = self._perf() - req.submitted_at
         self.metrics.observe("tpu_serving_request_latency_seconds", latency)
         try:

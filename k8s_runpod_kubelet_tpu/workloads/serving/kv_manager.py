@@ -198,8 +198,21 @@ class PrefixTrie:
         recomputes its final token — vLLM does the same). Every returned
         page carries one extra pool reference; the caller MUST
         ``release`` after gathering."""
-        self._clock += 1
         max_chunks = max(0, (len(tokens) - 1) // self.page_tokens)
+        return self._match_chunks(adapter_id, tokens, max_chunks)
+
+    def match_full(self, adapter_id: int, tokens: list) -> MatchResult:
+        """Like ``match`` but WITHOUT the one-token-remaining cap: every
+        full page of ``tokens`` that the trie holds, reference held. The
+        paged decode loop builds a slot's page table from this — the slot
+        references shared prefix pages read-only, so the final-token cap
+        (a prefill/logits concern) does not apply."""
+        return self._match_chunks(adapter_id, tokens,
+                                  len(tokens) // self.page_tokens)
+
+    def _match_chunks(self, adapter_id: int, tokens: list,
+                      max_chunks: int) -> MatchResult:
+        self._clock += 1
         node_map = self._roots.get(adapter_id, {})
         pages: list[int] = []
         for chunk in self._chunks(tokens, max_chunks):
@@ -449,6 +462,34 @@ def _build_write(t: int):
     return write
 
 
+def _build_fill(t: int):
+    """``_build_write`` with a T-token pad on the source: a slot's tail
+    fill copies ceil(remaining / T) pages from a single-request cache, and
+    the last page's slice may reach up to T-1 positions past the cache's
+    length — dynamic_slice would CLAMP the start and silently misalign
+    the data. The pad makes the overshoot read zeros instead (positions
+    beyond the slot's length: masked by attention, overwritten by decode
+    writes — the standard decode-path invariant)."""
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def fill(arena, single, ids, start_tok):
+        n = ids.shape[0]
+        out = {}
+        for name, a in arena.items():
+            src = jnp.pad(single[name],
+                          [(0, 0), (0, 0), (0, t)]
+                          + [(0, 0)] * (single[name].ndim - 3))
+            frag = jax.lax.dynamic_slice_in_dim(src, start_tok, n * t,
+                                                axis=2)
+            frag = frag.reshape((a.shape[0], n, t) + a.shape[3:])
+            out[name] = a.at[:, ids].set(frag)
+        return out
+
+    return fill
+
+
 class PagedKVStore:
     """The HBM arena behind PagePool/PrefixTrie: one array per KV cache
     section, section shape with batch -> n_pages and positions ->
@@ -492,6 +533,7 @@ class PagedKVStore:
             self.arena = jax.jit(build, out_shardings=shardings)()
         self._gather = _build_gather(page_tokens)
         self._write = _build_write(page_tokens)
+        self._fill = _build_fill(page_tokens)
 
     @property
     def page_bytes(self) -> int:
@@ -503,6 +545,87 @@ class PagedKVStore:
 
     def match(self, adapter_id: int, tokens: list) -> MatchResult:
         return self.trie.match(adapter_id, tokens)
+
+    def match_full(self, adapter_id: int, tokens: list) -> MatchResult:
+        """Every cached full page of ``tokens``, no final-token cap —
+        the paged decode loop's slot-table source (see PrefixTrie)."""
+        return self.trie.match_full(adapter_id, tokens)
+
+    def alloc_run(self, n: int) -> list[int]:
+        """``n`` private pages (refcount 1 each), evicting LRU trie
+        leaves as needed. All-or-nothing: on exhaustion the partial run
+        is released and PoolExhausted raised — a slot with half its
+        positions backed would decode garbage."""
+        pages: list[int] = []
+        try:
+            for _ in range(n):
+                try:
+                    pages.append(self.pool.alloc())
+                except PoolExhausted:
+                    if not self.trie._evict_lru(set()):
+                        raise
+                    pages.append(self.pool.alloc())
+        except PoolExhausted:
+            for p in pages:
+                self.pool.unref(p)
+            raise
+        return pages
+
+    def fill_pages(self, single: dict, pages: list, start_tok: int) -> None:
+        """Copy positions ``start_tok ..`` of a single-request cache into
+        ``pages`` (binary decomposition over pow2 jit buckets, padded
+        source so the last page's overshoot cannot misalign — see
+        _build_fill). The caller owns the pages' references."""
+        import jax.numpy as jnp
+        off = 0
+        while off < len(pages):
+            size = 1 << ((len(pages) - off).bit_length() - 1)
+            self.arena = self._fill(
+                self.arena, single,
+                jnp.asarray(pages[off:off + size], jnp.int32),
+                jnp.asarray(start_tok + off * self.page_tokens, jnp.int32))
+            off += size
+
+    def section_spec(self) -> dict:
+        """{name: (dtype name, per-page trailing shape)} — what a handoff
+        blob must match to adopt into this arena (fleet/handoff.py's
+        ``expect_sections``)."""
+        return {name: (str(a.dtype), tuple(int(s) for s in a.shape[3:]))
+                for name, a in self.arena.items()}
+
+    def export_pages(self, pages: list) -> dict:
+        """Device-side copies of the run's payload, {name: (L, n, T, ...)}.
+        Returns fresh device arrays (the arena is read, never donated):
+        the caller may np.asarray them OUTSIDE the engine's prefix lock —
+        the copies stay valid across later arena donations. The caller
+        holds the pages' references while this dispatches."""
+        import jax.numpy as jnp
+        ids = jnp.asarray(pages, jnp.int32)
+        return {name: a[:, ids] for name, a in self.arena.items()}
+
+    def adopt(self, adapter_id: int, tokens: list, sections: dict
+              ) -> tuple[int, int]:
+        """Insert a deserialized handoff run into the trie/arena.
+        ``sections[name]`` is (L, n, T, ...) host or device data for the
+        run's pages, in prompt order; ``tokens`` the n*T token ids they
+        hold. Pages already cached dedup through the trie walk; only the
+        missing suffix allocates. Returns (pages added, pages evicted)."""
+        import jax.numpy as jnp
+        n = len(tokens) // self.page_tokens
+        # pad the position axis to a pow2 page count so the write jits
+        # compile O(log) source variants, not one per adopted run length
+        cap = 1 << max(0, (n - 1).bit_length())
+        single_like = {}
+        for name, arr in sections.items():
+            a = jnp.asarray(arr)
+            a = a.reshape((a.shape[0], 1, n * self.page_tokens)
+                          + a.shape[3:])
+            if cap > n:
+                a = jnp.pad(a, [(0, 0), (0, 0),
+                                (0, (cap - n) * self.page_tokens)]
+                            + [(0, 0)] * (a.ndim - 3))
+            single_like[name] = a
+        return self.insert(adapter_id, list(tokens), single_like)
 
     def gather(self, pages: list, fresh_single: dict) -> dict:
         """Matched pages -> a single-request cache with positions
@@ -547,7 +670,16 @@ class PagedKVStore:
         return self.trie.insert(adapter_id, tokens, write_pages, pin=pin)
 
     def stats(self) -> dict:
+        # evictable = unpinned trie pages ONLY the trie references
+        # (refcount 1): evicting the node returns the page to the free
+        # list NOW. A slot-referenced shared page is NOT reclaimable
+        # until the slot completes — counting it would overstate the
+        # decode pool's headroom and mute the page-exhaustion signal.
+        evictable = sum(
+            1 for node in self.trie._nodes.values()
+            if not node.pinned and self.pool.refcount(node.page) == 1)
         return {"pages_total": self.pool.n_pages,
                 "pages_free": self.pool.free_count,
                 "pages_shared": self.trie.shared_pages(),
+                "pages_evictable": evictable,
                 **self.trie.stats()}

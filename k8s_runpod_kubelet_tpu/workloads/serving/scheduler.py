@@ -89,8 +89,20 @@ class ServingConfig:
     kv_page_tokens: int = 16
     # pages in the preallocated arena. 0 = auto: one decode-cache's worth
     # (slots * cache_len / kv_page_tokens), so the prefix pool can at most
-    # double KV HBM and is usually far under it.
+    # double KV HBM and is usually far under it. With the paged decode
+    # loop on, auto doubles (decode slots live IN the arena, so it must
+    # hold the slots' residency plus the shared prefix pool).
     kv_pool_pages: int = 0
+    # -- paged decode loop (ISSUE 9) -------------------------------------
+    # run the decode hot loop on per-slot page tables over the shared
+    # arena (LlamaModel.paged_decode_step): prefix hits and handed-off KV
+    # are REFERENCED zero-copy instead of gathered into a contiguous slot
+    # cache, and each admission writes only its un-cached tail pages.
+    # None = auto: on whenever the layout allows it (plain dense K/V —
+    # no MLA / sliding window / int8-KV — single host, no adapters, no
+    # speculation, prefix cache on); True errors if the layout can't;
+    # False keeps the contiguous slot-cache loop.
+    paged_decode: Optional[bool] = None
     # multi-LoRA serving (vLLM-style multi-tenant adapters): rank > 0
     # preallocates zero-filled adapter stacks of this rank over
     # ``lora_targets`` so adapters register WITHOUT recompiling the decode
@@ -213,6 +225,13 @@ class _Slot:
     # inter-token-latency bookkeeping: perf_counter of the last token this
     # slot streamed (0 = none yet)
     last_emit_at: float = 0.0
+    # paged decode loop (ISSUE 9): the slot's page-table row — page ids in
+    # position order, ONE pool reference held per entry (shared prefix
+    # pages read-only, tail pages private); kv_len is the committed token
+    # count = the next decode write position. Empty/0 on the contiguous
+    # engine.
+    pages: list[int] = dataclasses.field(default_factory=list)
+    kv_len: int = 0
 
 
 def _fail_future(fut: Future, exc: BaseException) -> None:

@@ -1,0 +1,460 @@
+"""Disaggregated prefill/decode soak (ISSUE 9 acceptance): real router +
+registry over localhost HTTP, replicas registered as prefill/decode/
+unified roles, REAL paged-KV arenas behind the replica fakes (no model —
+the KV payload is a deterministic function of token id and position, so
+bit-true transfer is checkable without jax compiles dominating the tier).
+
+What it pins:
+
+- a generation request two-hops: the router's prefill hop POSTs
+  /kv_prefill on the prefill replica, which computes KV pages and pushes
+  the serialized run to the decode replica's /kv_adopt; the decode
+  replica's arena then holds the prompt's pages BIT-IDENTICAL to the
+  prefill replica's, and the request is answered by the decode replica
+  (``reason=two_hop``);
+- a seeded FaultPlan kills the prefill replica MID-HANDOFF (the page
+  stream truncates, then the listener drops): the decode side rejects
+  the torn blob (never half-adopts), the router records a failed
+  handoff, and the SAME request still completes via fallback to the
+  unified pool — zero hangs, zero 5xx to the client;
+- zero leaked pages on BOTH arenas afterwards: every page free or
+  trie-owned exactly once, refcounts balanced, truncated adoption
+  included;
+- one trace_id joins the whole two-hop:
+  fleet.route -> fleet.handoff -> serving.kv_prefill -> serving.kv_adopt.
+
+The seed is embedded in every assertion message for replay.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from k8s_runpod_kubelet_tpu.cloud.faults import (PREEMPTION_STORM, FaultPlan,
+                                                 FaultWindow)
+from k8s_runpod_kubelet_tpu.fleet.handoff import (HandoffError,
+                                                  deserialize_pages,
+                                                  serialize_pages)
+from k8s_runpod_kubelet_tpu.fleet.registry import ReplicaRegistry
+from k8s_runpod_kubelet_tpu.fleet.router import (FleetRouter, RouterConfig,
+                                                 serve_router)
+from k8s_runpod_kubelet_tpu.metrics import Metrics
+from k8s_runpod_kubelet_tpu.tracing import (Tracer, format_traceparent,
+                                            parse_traceparent)
+from k8s_runpod_kubelet_tpu.workloads.serving.kv_manager import PagedKVStore
+
+from harness import FakeClock
+
+SEED = 23
+T = 8               # page_tokens
+CACHE_LEN = 64
+N_PAGES = 32
+# the seeded storm window: the prefill replica dies inside it
+KILL_WINDOW = FaultWindow(PREEMPTION_STORM, 6.0, 10.0, 1.0)
+
+
+def _ctx(what: str, plan=None) -> str:
+    msg = f"[disagg seed={SEED}] {what}"
+    if plan is not None:
+        msg += "\n" + plan.describe()
+    return msg
+
+
+def _kv_value(token: int, pos: int, head: int, dim: int) -> float:
+    """Deterministic stand-in for computed KV: any reorder, misalignment
+    or page mixup breaks equality."""
+    return float(token) + pos / 100.0 + head / 10.0 + dim / 1000.0
+
+
+def _expected_pages(tokens: list) -> np.ndarray:
+    """(1, n_pages, T, 2, 4) of _kv_value for the run's FULL pages."""
+    n = len(tokens) // T
+    out = np.zeros((1, n, T, 2, 4), np.float32)
+    for p in range(n):
+        for o in range(T):
+            pos = p * T + o
+            for h in range(2):
+                for d in range(4):
+                    out[0, p, o, h, d] = _kv_value(tokens[pos], pos, h, d)
+    return out
+
+
+def _make_store() -> PagedKVStore:
+    def factory():
+        return {"k": jnp.zeros((1, 1, CACHE_LEN, 2, 4), jnp.float32),
+                "v": jnp.zeros((1, 1, CACHE_LEN, 2, 4), jnp.float32),
+                "index": jnp.zeros((1,), jnp.int32)}
+    return PagedKVStore(N_PAGES, T, factory)
+
+
+class RoleReplica:
+    """In-process fake replica with a REAL paged arena: the serve_main
+    surface the disaggregated router touches (/kv_prefill on prefill,
+    /kv_adopt + /generate on decode, /generate on unified)."""
+
+    def __init__(self, replica_id: str, role: str, tracer: Tracer):
+        self.replica_id = replica_id
+        self.role = role
+        self.tracer = tracer
+        self.store = _make_store()
+        self.lock = threading.Lock()
+        self.generated = 0
+        self.adopted_runs: list = []     # token lists whose adoption landed
+        self.handoff_failures = 0
+        self.die_mid_handoff = False     # next /kv_prefill truncates + dies
+        self.stats = {"free_slots": 4, "active_slots": 0, "max_slots": 4,
+                      "queue_depth": 0, "draining": False}
+        rep = self
+
+        class _H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _json(self, status, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _read(self) -> bytes:
+                length = int(self.headers.get("Content-Length") or 0)
+                return self.rfile.read(length) if length else b""
+
+            def do_POST(self):
+                if self.path == "/kv_prefill":
+                    return rep._kv_prefill(self)
+                if self.path == "/kv_adopt":
+                    return rep._kv_adopt(self)
+                # generation: record the serving span for the trace join
+                body = json.loads(self._read() or b"{}")
+                inbound = parse_traceparent(self.headers.get("traceparent"))
+                now = rep.tracer.clock()
+                rep.tracer.record(
+                    "serving.request", now, now,
+                    trace_id=inbound[0] if inbound else None,
+                    parent_id=inbound[1] if inbound else "",
+                    attrs={"replica_id": rep.replica_id})
+                with rep.lock:
+                    rep.generated += 1
+                covered = 0
+                if rep.role == "decode":
+                    # how much of this prompt the arena already holds —
+                    # the zero-copy span a real engine would reference
+                    m = rep.store.match_full(0, body.get("tokens") or [])
+                    rep.store.release(m.pages)
+                    covered = m.matched_tokens
+                return self._json(200, {"tokens": [1, 2, 3],
+                                        "replica_id": rep.replica_id,
+                                        "covered_tokens": covered})
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), _H)
+        self._httpd.daemon_threads = True
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self._httpd.server_address[1]}"
+
+    # -- prefill half ----------------------------------------------------------
+
+    def _compute_and_export(self, tokens: list) -> bytes:
+        """'Prefill' the prompt: deterministic KV into this arena, then
+        serialize its full pages — the engine.export_handoff analogue."""
+        single = {"k": jnp.asarray(_seq_cache(tokens)),
+                  "v": jnp.asarray(_seq_cache(tokens)),
+                  "index": jnp.asarray([len(tokens)], jnp.int32)}
+        self.store.insert(0, tokens, single)
+        m = self.store.match_full(0, tokens)
+        try:
+            frags = self.store.export_pages(m.pages)
+            sections = {name: np.asarray(a) for name, a in frags.items()}
+        finally:
+            self.store.release(m.pages)
+        return serialize_pages(tokens[:m.matched_tokens], T, sections)
+
+    def _kv_prefill(self, h):
+        req = json.loads(h._read() or b"{}")
+        tokens = list(req.get("request", {}).get("tokens") or [])
+        target = req.get("handoff_to", "")
+        inbound = parse_traceparent(h.headers.get("traceparent"))
+        trace_id = inbound[0] if inbound else Tracer.new_trace_id()
+        span_id = Tracer.new_span_id()
+        now = self.tracer.clock()
+        self.tracer.record("serving.kv_prefill", now, now,
+                           trace_id=trace_id, span_id=span_id,
+                           parent_id=inbound[1] if inbound else "",
+                           attrs={"replica_id": self.replica_id,
+                                  "tokens": len(tokens)})
+        blob = self._compute_and_export(tokens)
+        if self.die_mid_handoff:
+            # the seeded kill: half the page stream reaches the decode
+            # replica, then the process is gone — response socket included
+            self.handoff_failures += 1
+            try:
+                conn = http.client.HTTPConnection(
+                    target.replace("http://", "").split("/")[0], timeout=5)
+                conn.putrequest("POST", "/kv_adopt")
+                conn.putheader("Content-Length", str(len(blob)))
+                conn.putheader("traceparent",
+                               format_traceparent(trace_id, span_id))
+                conn.endheaders()
+                conn.send(blob[:len(blob) // 2])
+                conn.sock.close()                     # torn mid-transfer
+            except OSError:
+                pass
+            self.kill()                               # replica dies too
+            try:
+                h.connection.close()                  # no /kv_prefill reply
+            except OSError:
+                pass
+            return None
+        push = urllib.request.Request(
+            target.rstrip("/") + "/kv_adopt", data=blob,
+            headers={"Content-Type": "application/octet-stream",
+                     "traceparent": format_traceparent(trace_id, span_id)},
+            method="POST")
+        with urllib.request.urlopen(push, timeout=5) as resp:
+            adopted = json.loads(resp.read() or b"{}")
+        if not adopted.get("ok"):
+            self.handoff_failures += 1
+            return h._json(502, {"ok": False, "error": str(adopted)})
+        n_pages = len(tokens) // T
+        return h._json(200, {"ok": True, "pages": n_pages,
+                             "bytes": len(blob)})
+
+    # -- decode half -----------------------------------------------------------
+
+    def _kv_adopt(self, h):
+        blob = h._read()
+        inbound = parse_traceparent(h.headers.get("traceparent"))
+        now = self.tracer.clock()
+        try:
+            header, sections = deserialize_pages(
+                blob, expect_page_tokens=T,
+                expect_sections=self.store.section_spec())
+            with self.lock:
+                self.store.adopt(0, header["tokens"], sections)
+                self.adopted_runs.append(list(header["tokens"]))
+        except HandoffError as e:
+            self.tracer.record("serving.kv_adopt", now, now,
+                               trace_id=inbound[0] if inbound else None,
+                               parent_id=inbound[1] if inbound else "",
+                               attrs={"replica_id": self.replica_id,
+                                      "ok": False, "error": str(e)})
+            return h._json(400, {"ok": False, "error": str(e)})
+        self.tracer.record("serving.kv_adopt", now, now,
+                           trace_id=inbound[0] if inbound else None,
+                           parent_id=inbound[1] if inbound else "",
+                           attrs={"replica_id": self.replica_id, "ok": True,
+                                  "pages": header["n_pages"]})
+        return h._json(200, {"ok": True, "pages": header["n_pages"]})
+
+    def heartbeat_payload(self) -> dict:
+        stats = dict(self.stats)
+        if self.role == "decode":
+            s = self.store.stats()
+            stats["kv_pages_free"] = s["pages_free"]
+            stats["kv_pages_total"] = s["pages_total"]
+        return {"replica_id": self.replica_id, "stats": stats}
+
+    def assert_no_leaks(self, plan):
+        s = self.store.stats()
+        assert s["pages_free"] + s["nodes"] == s["pages_total"], _ctx(
+            f"{self.replica_id}: leaked pages — free {s['pages_free']} + "
+            f"trie {s['nodes']} != total {s['pages_total']}", plan)
+        for node in self.store.trie._nodes.values():
+            assert self.store.pool.refcount(node.page) == 1, _ctx(
+                f"{self.replica_id}: dangling reference on page "
+                f"{node.page}", plan)
+
+    def kill(self):
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except OSError:
+            pass
+
+
+def _seq_cache(tokens: list) -> np.ndarray:
+    """(1, 1, CACHE_LEN, 2, 4) single-request cache of _kv_value."""
+    out = np.zeros((1, 1, CACHE_LEN, 2, 4), np.float32)
+    for pos, tok in enumerate(tokens):
+        for h in range(2):
+            for d in range(4):
+                out[0, 0, pos, h, d] = _kv_value(tok, pos, h, d)
+    return out
+
+
+def test_disagg_soak_tier1(tmp_path):
+    clock = FakeClock()
+    metrics = Metrics()
+    tracer = Tracer(export_path=str(tmp_path / "spans.jsonl"), clock=clock)
+    registry = ReplicaRegistry(metrics=metrics, tracer=tracer, clock=clock,
+                               heartbeat_timeout_s=8.0,
+                               breaker_failure_threshold=3,
+                               breaker_reset_s=60.0)
+    router = FleetRouter(
+        registry, RouterConfig(max_attempts=3, request_timeout_s=10.0,
+                               handoff_timeout_s=10.0),
+        metrics=metrics, tracer=tracer, clock=clock)
+    httpd = serve_router(router, port=0)
+    port = httpd.server_address[1]
+    plan = FaultPlan(SEED, clock, horizon_s=30.0, windows=[KILL_WINDOW])
+
+    def post(path, payload, headers=None):
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=15)
+        try:
+            c.request("POST", path, body=json.dumps(payload).encode(),
+                      headers={"Content-Type": "application/json",
+                               **(headers or {})})
+            r = c.getresponse()
+            body = r.read()
+            return r.status, (json.loads(body) if body else {})
+        finally:
+            c.close()
+
+    reps = {rid: RoleReplica(rid, role, tracer)
+            for rid, role in (("pf-0", "prefill"), ("dc-0", "decode"),
+                              ("un-0", "unified"))}
+    killed: set = set()
+    try:
+        for rid, rep in reps.items():
+            status, out = post("/fleet/register",
+                               {"replica_id": rid, "base_url": rep.url,
+                                "role": rep.role})
+            assert status == 200 and out["role"] == rep.role, \
+                _ctx(f"register {rid} -> {status} {out}")
+        snap = registry.snapshot()
+        assert snap["pools"] == {"unified": 1, "prefill": 1, "decode": 1}, \
+            _ctx(f"pools miscounted: {snap['pools']}")
+
+        prompt = [((i * 11) % 90) + 1 for i in range(20)]   # 2 full pages
+        outcomes = []                       # (tick, status, replica_id)
+        snapshots = []                      # per-tick /debug/fleet payloads
+        probe = ("c" * 32, "b7ad6b7169203331")
+        for tick in range(12):
+            clock.advance(1.0)
+            t = tick + 1
+            for rid, rep in reps.items():
+                if rid not in killed:
+                    st, out = post("/fleet/heartbeat",
+                                   rep.heartbeat_payload())
+                    assert st == 200, _ctx(f"heartbeat {rid}: {st} {out}")
+            victims = plan.preempt_victims(
+                sorted(r for r in reps if reps[r].role == "prefill"
+                       and r not in killed))
+            if victims:
+                # the NEXT handoff tears mid-transfer and the replica dies
+                reps[victims[0]].die_mid_handoff = True
+                killed.add(victims[0])
+            registry.sweep()
+            hdr = {}
+            if t == 2:      # a traced two-hop request (pre-kill)
+                hdr = {"traceparent": f"00-{probe[0]}-{probe[1]}-01"}
+            status, out = post("/generate",
+                               {"tokens": [t] + prompt[1:],
+                                "max_new_tokens": 4}, headers=hdr)
+            outcomes.append((t, status, out.get("replica_id")))
+            assert status == 200, _ctx(f"t={t} -> {status} {out}", plan)
+            snapshots.append(registry.snapshot())
+
+        # -- 1. zero hangs/drops; two-hop requests answered by DECODE --------
+        assert all(st == 200 for _, st, _ in outcomes), \
+            _ctx(f"non-200: {outcomes}", plan)
+        pre_kill = [rid for t, _, rid in outcomes if t < KILL_WINDOW.start]
+        assert set(pre_kill) == {"dc-0"}, \
+            _ctx(f"two-hop requests not decoded by the decode pool: "
+                 f"{outcomes}", plan)
+
+        # -- 2. the handoff landed bit-identical on the decode arena ---------
+        assert reps["dc-0"].adopted_runs, _ctx("no adoption landed", plan)
+        run = reps["dc-0"].adopted_runs[0]
+        assert len(run) == 16, _ctx(f"adopted {len(run)} tokens", plan)
+        m = reps["dc-0"].store.match_full(0, run)
+        try:
+            got = np.asarray(reps["dc-0"].store.export_pages(m.pages)["k"])
+        finally:
+            reps["dc-0"].store.release(m.pages)
+        np.testing.assert_allclose(got, _expected_pages(run), rtol=0,
+                                   atol=0, err_msg=_ctx(
+                                       "adopted KV != prefill KV", plan))
+        assert metrics.get_counter("tpu_fleet_handoffs",
+                                   labels={"outcome": "ok"}) >= 1
+
+        # -- 3. the kill produced a FAILED handoff, a fallback 200, and no
+        # half-adoption ------------------------------------------------------
+        assert killed, _ctx("storm never fired", plan)
+        assert reps["pf-0"].handoff_failures >= 1, \
+            _ctx("prefill never died mid-handoff", plan)
+        post_kill = [rid for t, _, rid in outcomes if t >= KILL_WINDOW.start]
+        assert "un-0" in post_kill, \
+            _ctx(f"no fallback to the unified pool: {outcomes}", plan)
+        assert metrics.get_counter("tpu_fleet_handoffs",
+                                   labels={"outcome": "failed"}) >= 1, \
+            _ctx("failed handoff not counted", plan)
+        fail_spans = [s for s in tracer.recent(4096)
+                      if s["name"] == "fleet.handoff"
+                      and not s["attrs"]["ok"]]
+        assert fail_spans, _ctx("no failed fleet.handoff span", plan)
+        # the torn blob was REJECTED: only complete runs ever adopted
+        assert all(len(r) == 16 for r in reps["dc-0"].adopted_runs), \
+            _ctx(f"partial adoption: {reps['dc-0'].adopted_runs}", plan)
+
+        # -- 4. zero leaked pages on BOTH arenas -----------------------------
+        reps["pf-0"].assert_no_leaks(plan)
+        reps["dc-0"].assert_no_leaks(plan)
+
+        # -- 5. one trace_id joins the two engines' halves -------------------
+        spans = {s["name"]: s for s in tracer.get_trace(probe[0])}
+        want = {"fleet.route", "fleet.handoff", "serving.kv_prefill",
+                "serving.kv_adopt", "serving.request"}
+        assert want <= set(spans), \
+            _ctx(f"trace {probe[0]}: {sorted(spans)}", plan)
+        assert spans["fleet.route"]["parent_id"] == probe[1]
+        assert spans["fleet.handoff"]["parent_id"] \
+            == spans["fleet.route"]["span_id"], _ctx(
+                "fleet.handoff not a child of fleet.route", plan)
+        assert spans["serving.kv_prefill"]["parent_id"] \
+            == spans["fleet.handoff"]["span_id"], _ctx(
+                "kv_prefill not under fleet.handoff", plan)
+        assert spans["serving.kv_adopt"]["parent_id"] \
+            == spans["serving.kv_prefill"]["span_id"], _ctx(
+                "kv_adopt not under kv_prefill", plan)
+        assert spans["serving.kv_adopt"]["attrs"]["ok"] is True
+
+        # -- 6. the exported JSONL renders the two-hop timeline --------------
+        tracer.close()
+        import pathlib
+        import sys
+        sys.path.insert(0, str(pathlib.Path(__file__).parent.parent
+                               / "tools"))
+        import fleet_summary
+        spans_l, snaps = fleet_summary.load(str(tmp_path / "spans.jsonl"))
+        assert spans_l, _ctx("trace export is empty", plan)
+        # registry snapshots carry the roles: the per-tick captures above
+        # are what `curl /debug/fleet >> fleet.jsonl` would have appended
+        out_text = fleet_summary.render(spans_l, snapshots)
+        assert "two-hop requests" in out_text, _ctx(out_text, plan)
+        assert "prefill pf-0" in out_text and "decode dc-0" in out_text, \
+            _ctx(f"two-hop timeline incomplete:\n{out_text}", plan)
+        assert "FAILED" in out_text, \
+            _ctx("failed handoff missing from the timeline", plan)
+        assert "pool: prefill" in out_text and "pool: decode" in out_text, \
+            _ctx(f"per-pool load tables missing:\n{out_text}", plan)
+    finally:
+        tracer.close()
+        httpd.shutdown()
+        for rep in reps.values():
+            rep.kill()

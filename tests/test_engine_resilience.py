@@ -36,7 +36,9 @@ class TestEngineResilience:
     def test_poisoned_step_fails_requests_but_engine_survives(self):
         e = _tiny_serving()
         boom = RuntimeError("injected step failure")
-        real_decode, calls = e._decode, []
+        # poison the ACTIVE decode loop (paged on plain layouts)
+        attr = "_paged_step" if e._paged_loop else "_decode"
+        real_decode, calls = getattr(e, attr), []
 
         def exploding(*a, **k):
             if not calls:
@@ -44,7 +46,7 @@ class TestEngineResilience:
                 raise boom
             return real_decode(*a, **k)
 
-        e._decode = exploding
+        setattr(e, attr, exploding)
         e.start()
         try:
             # first request hits the injected failure -> future fails, not hangs

@@ -278,6 +278,297 @@ class TestConfigValidation:
             config_mod.load(env={"TPU_FLEET_HEARTBEAT_TIMEOUT_S": "0.5"})
 
 
+class PoolFixture:
+    """Two role-scoped control loops (prefill + decode) over ONE registry
+    and ONE fake cluster — the disaggregated wiring router_main.build()
+    produces when both pool ceilings are configured."""
+
+    def __init__(self):
+        self.clock = FakeClock()
+        self.metrics = Metrics()
+        self.tracer = Tracer()
+        self.registry = ReplicaRegistry(metrics=self.metrics,
+                                        tracer=self.tracer, clock=self.clock,
+                                        heartbeat_timeout_s=1e9)
+        self.kube = FakeKubeClient()
+        self.drained: list = []
+        self.loops = {}
+        for role, extra in (("prefill", {}),
+                            ("decode", {"itl_slo_s": 0.25,
+                                        "min_free_kv_page_frac": 0.2})):
+            scaler = KubePodScaler(self.kube, "virtual-tpu", chips=8,
+                                   role=role)
+            self.loops[role] = FleetAutoscaler(
+                self.registry, scaler,
+                AutoscalerConfig(min_replicas=1, max_replicas=3, role=role,
+                                 target_queue_per_replica=4.0, ttft_slo_s=2.0,
+                                 scale_up_stable_s=5.0,
+                                 scale_down_stable_s=10.0,
+                                 scale_up_cooldown_s=8.0,
+                                 scale_down_cooldown_s=8.0,
+                                 scale_down_utilization=0.25,
+                                 drain_timeout_s=30.0, boot_timeout_s=60.0,
+                                 **extra),
+                metrics=self.metrics, tracer=self.tracer, clock=self.clock,
+                drain_fn=lambda rep: self.drained.append(rep.replica_id))
+
+    def add_replica(self, rid, role, pod_name="", **stats):
+        self.registry.register(rid, f"http://127.0.0.1:1/{rid}",
+                               pod_name=pod_name, role=role)
+        base = {"free_slots": 4, "active_slots": 0, "max_slots": 4,
+                "queue_depth": 0}
+        base.update(stats)
+        self.registry.heartbeat(rid, base)
+
+    def tick(self, dt=1.0, n=1, roles=("prefill", "decode")):
+        for _ in range(n):
+            self.clock.advance(dt)
+            for role in roles:
+                self.loops[role].tick()
+
+    def pods(self):
+        return sorted(p["metadata"]["name"] for p in self.kube.list_pods())
+
+    def scale_reasons(self, role):
+        return [s["attrs"]["reason"] for s in self.tracer.recent()
+                if s["name"] == "fleet.scale"
+                and s["attrs"]["role"] == role]
+
+
+class TestDisaggregatedPools:
+    """ISSUE 9 acceptance: the two pools scale on their DISTINCT signals
+    (prefill: TTFT burn + queue depth; decode: ITL p95 + free KV pages)
+    and each loop sizes/drains/reaps ONLY its own pool."""
+
+    def _steady(self, f):
+        # both pools at their floor so neither loop floor-fills mid-test
+        f.add_replica("p0", "prefill", pod_name="pod-p0")
+        f.add_replica("d0", "decode", pod_name="pod-d0")
+
+    def test_decode_pool_scales_on_itl_p95(self):
+        f = PoolFixture()
+        self._steady(f)
+        f.registry.heartbeat("d0", {"itl_p95_s": 0.9, "active_slots": 2,
+                                    "free_slots": 2, "max_slots": 4})
+        f.tick(n=6)
+        assert f.pods() == ["tpu-serving-decode-1"]
+        assert any("itl_p95" in r for r in f.scale_reasons("decode"))
+        # the prefill loop saw no prefill-side signal: no prefill pod
+        assert f.scale_reasons("prefill") == []
+
+    def test_decode_pool_scales_on_free_page_floor(self):
+        f = PoolFixture()
+        self._steady(f)
+        f.registry.heartbeat("d0", {"kv_pages_total": 100, "kv_pages_free": 5,
+                                    "free_slots": 4, "max_slots": 4})
+        f.tick(n=6)
+        assert f.pods() == ["tpu-serving-decode-1"]
+        assert any("free KV pages" in r for r in f.scale_reasons("decode"))
+
+    def test_latched_idle_itl_does_not_scale(self):
+        """The reporter's ITL p95 latches after a burst exactly like TTFT:
+        with no live decode load it must not scale the pool."""
+        f = PoolFixture()
+        self._steady(f)
+        f.registry.heartbeat("d0", {"itl_p95_s": 0.9, "active_slots": 0,
+                                    "queue_depth": 0, "free_slots": 4,
+                                    "max_slots": 4})
+        f.tick(n=20)
+        assert f.pods() == []
+
+    def test_decode_pool_ignores_queue_depth(self):
+        """Queue depth is the PREFILL/unified signal: a deep decode-side
+        queue alone (e.g. admission backlog during adoption) must not
+        double-scale both pools."""
+        f = PoolFixture()
+        self._steady(f)
+        f.registry.heartbeat("d0", {"queue_depth": 50, "free_slots": 0,
+                                    "active_slots": 4, "max_slots": 4})
+        f.tick(n=20, roles=("decode",))
+        assert f.pods() == []
+
+    def test_prefill_pool_scales_on_its_own_queue_only(self):
+        """The prefill loop keeps the queue/TTFT pair but sees ONLY its
+        pool: a drowning decode replica must not scale prefill."""
+        f = PoolFixture()
+        self._steady(f)
+        f.registry.heartbeat("d0", {"queue_depth": 99, "free_slots": 0,
+                                    "active_slots": 4, "max_slots": 4})
+        f.tick(n=20)
+        assert f.pods() == []           # decode ignores queue, prefill
+        # can't see it
+        f.registry.heartbeat("p0", {"queue_depth": 9, "free_slots": 0,
+                                    "active_slots": 4, "max_slots": 4})
+        f.tick(n=6)
+        assert f.pods() == ["tpu-serving-prefill-1"]
+        assert any("queue_depth" in r for r in f.scale_reasons("prefill"))
+
+    def test_prefill_pool_scales_on_ttft_burn(self):
+        """The acceptance pair: prefill pools scale on TTFT, decode pools
+        on ITL — a TTFT burn on a prefill replica buys a prefill pod and
+        leaves the decode pool alone."""
+        f = PoolFixture()
+        self._steady(f)
+        f.registry.heartbeat("p0", {"ttft_p95_s": 5.0, "active_slots": 2,
+                                    "free_slots": 2, "max_slots": 4})
+        f.tick(n=6)
+        assert f.pods() == ["tpu-serving-prefill-1"]
+        assert any("ttft_p95" in r for r in f.scale_reasons("prefill"))
+        assert f.scale_reasons("decode") == []
+
+    def test_prefill_pool_holds_under_steady_short_hops(self):
+        """Prefill replicas serve their whole load on handler threads:
+        slot utilization is structurally zero and ~100ms hops alias to
+        queue_depth==0 in ~2s heartbeat samples. The ADVANCING
+        handoffs_total counter is the scale-down guard — without it the
+        pool drains to min while actively serving hops."""
+        f = PoolFixture()
+        self._steady(f)
+        f.add_replica("p1", "prefill", pod_name="pod-p1")
+        total = 0
+        for _ in range(30):
+            total += 3      # hops completed between ticks; samples see 0
+            f.registry.heartbeat("p1", {"queue_depth": 0, "free_slots": 4,
+                                        "active_slots": 0, "max_slots": 4,
+                                        "handoffs_total": total})
+            f.tick(roles=("prefill",))
+        assert f.drained == []
+        # traffic stops: the counter freezes and the pool drains normally
+        for _ in range(30):
+            f.registry.heartbeat("p1", {"queue_depth": 0, "free_slots": 4,
+                                        "active_slots": 0, "max_slots": 4,
+                                        "handoffs_total": total})
+            f.tick(roles=("prefill",))
+        assert len(f.drained) == 1
+
+    def test_role_pod_carries_label_and_env(self):
+        """The pod a pool loop creates must register into the SAME pool:
+        role label (the reaper's scope) + TPU_SERVING_ROLE env (what
+        serve_main reads) + role-tagged name."""
+        f = PoolFixture()
+        self._steady(f)
+        f.registry.heartbeat("p0", {"queue_depth": 9, "free_slots": 0,
+                                    "max_slots": 4})
+        f.tick(n=6)
+        (pod,) = [p for p in f.kube.list_pods()
+                  if p["metadata"]["name"].startswith("tpu-serving-")]
+        labels = pod["metadata"]["labels"]
+        assert labels["tpu.dev/fleet-role"] == "prefill"
+        assert labels["tpu.dev/fleet"] == "serving"
+        env = {e["name"]: e["value"]
+               for e in pod["spec"]["containers"][0].get("env", [])}
+        assert env.get("TPU_SERVING_ROLE") == "prefill"
+
+    def test_reaper_scoped_to_own_pool(self):
+        """An orphaned decode pod is the DECODE loop's to reap; the
+        prefill loop must never see (or delete) it."""
+        f = PoolFixture()
+        self._steady(f)
+        f.kube.create_pod({
+            "metadata": {"name": "tpu-serving-decode-9",
+                         "namespace": "default",
+                         "labels": {"tpu.dev/fleet": "serving",
+                                    "tpu.dev/fleet-role": "decode"}},
+            "spec": {}})
+        # only the prefill loop runs: the orphan survives its boot grace
+        f.tick(roles=("prefill",))
+        f.tick(dt=61.0, roles=("prefill",))
+        f.tick(n=3, roles=("prefill",))
+        assert "tpu-serving-decode-9" in f.pods()
+        # the decode loop reaps it (fresh sighting + its own grace)
+        f.tick(roles=("decode",))
+        f.tick(dt=61.0, roles=("decode",))
+        f.tick(roles=("decode",))
+        assert "tpu-serving-decode-9" not in f.pods()
+        assert f.metrics.get_counter("tpu_fleet_orphans_reaped") == 1
+
+    def test_drain_adoption_scoped_to_own_pool(self):
+        """An operator-initiated prefill drain is adopted by the prefill
+        loop ONLY — two loops adopting one drain would double-delete."""
+        f = PoolFixture()
+        self._steady(f)
+        f.add_replica("p1", "prefill", pod_name="pod-p1")
+        f.registry.heartbeat("p1", {"draining": True, "active_slots": 1})
+        f.tick()
+        assert "p1" in f.loops["prefill"]._drains
+        assert "p1" not in f.loops["decode"]._drains
+
+    def test_desired_gauge_labeled_per_role(self):
+        f = PoolFixture()
+        gauges = {k: v for k, v in f.metrics.gauges.items()
+                  if k[0] == "tpu_fleet_desired_replicas"}
+        assert gauges == {
+            ("tpu_fleet_desired_replicas", (("role", "prefill"),)): 1,
+            ("tpu_fleet_desired_replicas", (("role", "decode"),)): 1}
+
+
+class TestBuildPools:
+    def test_build_one_loop_without_pools(self):
+        from k8s_runpod_kubelet_tpu import config as config_mod
+        from k8s_runpod_kubelet_tpu.fleet import router_main
+        cfg = config_mod.load(env={})
+        _, _, autoscalers = router_main.build(cfg, kube=FakeKubeClient(),
+                                              autoscale=True)
+        assert [a.cfg.role for a in autoscalers] == [""]
+
+    def test_build_two_pool_loops_when_configured(self):
+        from k8s_runpod_kubelet_tpu import config as config_mod
+        from k8s_runpod_kubelet_tpu.fleet import router_main
+        cfg = config_mod.load(env={
+            "TPU_FLEET_PREFILL_MIN_REPLICAS": "1",
+            "TPU_FLEET_PREFILL_MAX_REPLICAS": "4",
+            "TPU_FLEET_DECODE_MIN_REPLICAS": "2",
+            "TPU_FLEET_DECODE_MAX_REPLICAS": "6",
+            "TPU_FLEET_ITL_SLO_S": "0.3",
+            "TPU_FLEET_MIN_FREE_KV_PAGE_FRAC": "0.15"})
+        _, router, autoscalers = router_main.build(
+            cfg, kube=FakeKubeClient(), autoscale=True)
+        by_role = {a.cfg.role: a.cfg for a in autoscalers}
+        assert set(by_role) == {"prefill", "decode"}
+        assert (by_role["prefill"].min_replicas,
+                by_role["prefill"].max_replicas) == (1, 4)
+        assert (by_role["decode"].min_replicas,
+                by_role["decode"].max_replicas) == (2, 6)
+        # the decode loop got the decode signals; prefill kept the defaults
+        assert by_role["decode"].itl_slo_s == 0.3
+        assert by_role["decode"].min_free_kv_page_frac == 0.15
+        assert by_role["prefill"].itl_slo_s == 0.0
+
+    def test_disagg_config_validation(self):
+        from k8s_runpod_kubelet_tpu import config as config_mod
+        with pytest.raises(ValueError, match="serving_role"):
+            config_mod.load(env={"TPU_SERVING_ROLE": "both"})
+        with pytest.raises(ValueError, match="fleet_decode_max_replicas"):
+            config_mod.load(env={"TPU_FLEET_DECODE_MIN_REPLICAS": "5",
+                                 "TPU_FLEET_DECODE_MAX_REPLICAS": "2"})
+        with pytest.raises(ValueError, match="fleet_min_free_kv_page_frac"):
+            config_mod.load(env={"TPU_FLEET_MIN_FREE_KV_PAGE_FRAC": "1.5"})
+        with pytest.raises(ValueError, match="fleet_handoff_timeout_s"):
+            config_mod.load(env={"TPU_FLEET_HANDOFF_TIMEOUT_S": "0"})
+        # half a disaggregated fleet is a config error, not a silent
+        # fallback to the single-pool loop
+        with pytest.raises(ValueError, match="configured together"):
+            config_mod.load(env={"TPU_FLEET_PREFILL_MAX_REPLICAS": "4"})
+
+    def test_custom_template_pods_get_role_stamp(self):
+        """A role-scoped scaler must role-stamp custom-template pods too:
+        without the label/env the pod registers as unified, the pool loop
+        boot-times-out and recreates it forever."""
+        kube = FakeKubeClient()
+        scaler = KubePodScaler(
+            kube, "virtual-tpu", role="decode",
+            template_fn=lambda name: {
+                "metadata": {"name": name,
+                             "labels": {"tpu.dev/fleet": "serving"}},
+                "spec": {"containers": [{"name": "serve"}]}})
+        scaler.create()
+        (pod,) = kube.list_pods()
+        assert pod["metadata"]["labels"]["tpu.dev/fleet-role"] == "decode"
+        env = {e["name"]: e["value"]
+               for e in pod["spec"]["containers"][0]["env"]}
+        assert env["TPU_SERVING_ROLE"] == "decode"
+
+
 class _StubEngine:
     """serve_main needs only this surface for the status-contract routes."""
 

@@ -190,6 +190,82 @@ def _post(port, path, payload, headers=None, timeout=10.0):
     return c, c.getresponse()
 
 
+class TestDisaggRouting:
+    """ISSUE 9 router units: role-filtered picking, fallback widening,
+    and the quiet-skip vs failed handoff outcomes."""
+
+    def _roles_router(self):
+        reg = make_registry()
+        for rid, role in (("uni-0", "unified"), ("pf-0", "prefill"),
+                          ("dc-0", "decode")):
+            reg.register(rid, f"http://127.0.0.1:1/{rid}", role=role)
+            reg.heartbeat(rid, {"free_slots": 4, "max_slots": 4})
+        return FleetRouter(reg, RouterConfig(), metrics=Metrics(),
+                           tracer=Tracer())
+
+    def test_pick_filters_by_role(self):
+        rt = self._roles_router()
+        assert rt.pick("", roles=("decode",))[0].replica_id == "dc-0"
+        assert rt.pick("", roles=("prefill",))[0].replica_id == "pf-0"
+        assert rt.disagg_ready()
+
+    def test_single_hop_widens_when_unified_exhausted(self):
+        """Retries must not dead-end on an exhausted unified pool while
+        role replicas sit ready: once every unified replica is in the
+        attempt's exclusion set, the role restriction lifts (every
+        engine can prefill for itself)."""
+        rt = self._roles_router()
+        assert rt._single_hop_roles(frozenset()) == ("unified",)
+        assert rt._single_hop_roles(frozenset({"uni-0"})) is None
+        rep, _ = rt.pick("", exclude=frozenset({"uni-0"}),
+                         roles=rt._single_hop_roles(frozenset({"uni-0"})))
+        assert rep is not None and rep.role in ("prefill", "decode")
+
+    def _two_hop(self, reply):
+        rt = self._roles_router()
+
+        class _Stub:
+            breaker = None
+
+            def request(self, *a, **k):
+                if callable(reply):
+                    return reply()
+                return reply
+
+        rt.registry.get("pf-0").transport = _Stub()
+        trace = rt.trace_ctx(None)
+        return rt, rt.plan_two_hop("/generate", {"tokens": [1]}, "", trace)
+
+    def test_skip_reply_falls_back_quietly(self):
+        """A prefill replica DECLINING (short prompt, no tokenizer) is an
+        expected condition: outcome=skipped, never outcome=failed — the
+        failure series stays meaningful for alerts."""
+        rt, preferred = self._two_hop(
+            {"ok": False, "skip": True, "error": "under one page"})
+        assert preferred is None
+        m = rt.metrics
+        assert m.get_counter("tpu_fleet_handoffs",
+                             labels={"outcome": "skipped"}) == 1
+        assert m.get_counter("tpu_fleet_handoffs",
+                             labels={"outcome": "failed"}) == 0
+        span = [s for s in rt.tracer.recent()
+                if s["name"] == "fleet.handoff"][0]
+        assert span["attrs"]["outcome"] == "skipped"
+
+    def test_bad_reply_counts_failed(self):
+        rt, preferred = self._two_hop({"unexpected": True})
+        assert preferred is None
+        assert rt.metrics.get_counter("tpu_fleet_handoffs",
+                                      labels={"outcome": "failed"}) == 1
+
+    def test_ok_reply_prefers_decode_replica(self):
+        rt, preferred = self._two_hop({"ok": True, "pages": 2,
+                                       "bytes": 128})
+        assert preferred is not None and preferred.replica_id == "dc-0"
+        assert rt.metrics.get_counter("tpu_fleet_handoffs",
+                                      labels={"outcome": "ok"}) == 1
+
+
 class TestRouterHttp:
     def test_forward_and_trace_join(self, fleet):
         router, port, reps = fleet

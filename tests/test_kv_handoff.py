@@ -1,0 +1,225 @@
+"""KV handoff codec tests (ISSUE 9 satellite): the wire format a prefill
+replica ships page runs over must round-trip every arena layout bit-for-bit
+and refuse — with a typed HandoffError, never a half-adoption — anything
+truncated, foreign-versioned, or shaped for a different arena.
+
+numpy-only (mirrors the codec's own no-jax constraint), so these run in
+the fast tier alongside the page-pool unit tests.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from k8s_runpod_kubelet_tpu.fleet.handoff import (MAGIC, VERSION,
+                                                  HandoffError,
+                                                  deserialize_pages,
+                                                  serialize_pages)
+
+T = 8  # page_tokens used throughout
+
+
+def _tokens(n_pages: int) -> list:
+    return [(i * 7) % 120 + 1 for i in range(n_pages * T)]
+
+
+def _plain_sections(n_pages: int, layers=2, heads=2, hd=4,
+                    dtype=np.float32) -> dict:
+    """Dense K/V layout: (L, n, T, H, D) per section, values a function of
+    the index so any reorder/misalignment breaks equality."""
+    rng = np.random.default_rng(1234 + n_pages)
+    shape = (layers, n_pages, T, heads, hd)
+    return {"k": rng.standard_normal(shape).astype(dtype),
+            "v": rng.standard_normal(shape).astype(dtype)}
+
+
+def _int8_sections(n_pages: int) -> dict:
+    """int8-KV layout: quantized payload plus per-(position, head) scales
+    riding alongside as their own sections."""
+    rng = np.random.default_rng(99)
+    qshape = (2, n_pages, T, 2, 4)
+    sshape = (2, n_pages, T, 2)
+    return {"k": rng.integers(-128, 128, qshape).astype(np.int8),
+            "v": rng.integers(-128, 128, qshape).astype(np.int8),
+            "k_scale": rng.standard_normal(sshape).astype(np.float32),
+            "v_scale": rng.standard_normal(sshape).astype(np.float32)}
+
+
+def _mla_sections(n_pages: int) -> dict:
+    """MLA latent layout: one compressed kv latent + decoupled rope key —
+    different section NAMES and ranks, same codec."""
+    rng = np.random.default_rng(7)
+    return {"ckv": rng.standard_normal((2, n_pages, T, 16))
+            .astype(np.float32),
+            "k_rope": rng.standard_normal((2, n_pages, T, 1, 8))
+            .astype(np.float32)}
+
+
+def _spec(sections: dict) -> dict:
+    """The adopting arena's section_spec for these sections."""
+    return {name: (str(a.dtype), a.shape[3:])
+            for name, a in sections.items()}
+
+
+class TestRoundTrip:
+    @pytest.mark.parametrize("make", [_plain_sections, _int8_sections,
+                                      _mla_sections],
+                             ids=["plain", "int8_kv", "mla"])
+    def test_layout_round_trips_bit_identical(self, make):
+        sections = make(3)
+        tokens = _tokens(3)
+        blob = serialize_pages(tokens, T, sections, model="m")
+        header, out = deserialize_pages(blob, expect_page_tokens=T,
+                                        expect_sections=_spec(sections))
+        assert header["version"] == VERSION
+        assert header["page_tokens"] == T
+        assert header["n_pages"] == 3
+        assert header["tokens"] == tokens
+        assert header["model"] == "m"
+        assert set(out) == set(sections)
+        for name, a in sections.items():
+            assert out[name].dtype == a.dtype
+            assert out[name].shape == a.shape
+            np.testing.assert_array_equal(out[name], a)
+
+    def test_bfloat16_rides_ml_dtypes(self):
+        import ml_dtypes
+        bf16 = np.dtype(ml_dtypes.bfloat16)
+        sections = {"k": np.arange(2 * T * 4, dtype=np.float32)
+                    .reshape(1, 2, T, 4).astype(bf16)}
+        blob = serialize_pages(_tokens(2), T, sections)
+        _, out = deserialize_pages(
+            blob, expect_sections={"k": ("bfloat16", (4,))})
+        assert out["k"].dtype == bf16
+        np.testing.assert_array_equal(out["k"], sections["k"])
+
+    def test_single_page_and_no_expectations(self):
+        sections = _plain_sections(1)
+        blob = serialize_pages(_tokens(1), T, sections)
+        header, out = deserialize_pages(blob)  # expectations optional
+        assert header["n_pages"] == 1
+        np.testing.assert_array_equal(out["k"], sections["k"])
+
+
+class TestSerializeRejections:
+    def test_token_count_must_match_pages(self):
+        with pytest.raises(HandoffError, match="token count"):
+            serialize_pages(_tokens(2)[:-1], T, _plain_sections(2))
+
+    def test_empty_sections_rejected(self):
+        with pytest.raises(HandoffError, match="no sections"):
+            serialize_pages(_tokens(1), T, {})
+
+    def test_misshapen_section_rejected(self):
+        bad = {"k": np.zeros((2, 3, T + 1, 4), np.float32)}
+        with pytest.raises(HandoffError, match="shape"):
+            serialize_pages(_tokens(3), T, bad)
+
+
+class TestDeserializeRejections:
+    def _blob(self, n_pages=2, sections=None):
+        sections = sections if sections is not None \
+            else _plain_sections(n_pages)
+        return serialize_pages(_tokens(n_pages), T, sections), sections
+
+    def test_truncated_at_every_boundary(self):
+        """Any prefix of a valid blob is rejected, never half-adopted —
+        the mid-transfer-kill case the disaggregated soak exercises."""
+        blob, _ = self._blob()
+        # fixed header, inside the JSON header, inside each payload, and
+        # one byte short of complete
+        for cut in (0, 3, len(MAGIC) + 2, len(MAGIC) + 8,
+                    len(blob) // 2, len(blob) - 1):
+            with pytest.raises(HandoffError):
+                deserialize_pages(blob[:cut])
+
+    def test_trailing_garbage_rejected(self):
+        blob, _ = self._blob()
+        with pytest.raises(HandoffError, match="trailing"):
+            deserialize_pages(blob + b"\x00")
+
+    def test_bad_magic(self):
+        blob, _ = self._blob()
+        with pytest.raises(HandoffError, match="magic"):
+            deserialize_pages(b"NOTKV\x01" + blob[len(MAGIC):])
+
+    def test_future_version_rejected(self):
+        blob, sections = self._blob()
+        hlen = int.from_bytes(blob[len(MAGIC):len(MAGIC) + 4], "big")
+        header = json.loads(blob[len(MAGIC) + 4:len(MAGIC) + 4 + hlen])
+        header["version"] = VERSION + 1
+        raw = json.dumps(header).encode()
+        doctored = (MAGIC + len(raw).to_bytes(4, "big") + raw
+                    + blob[len(MAGIC) + 4 + hlen:])
+        with pytest.raises(HandoffError, match="version"):
+            deserialize_pages(doctored)
+
+    def test_unparseable_header(self):
+        raw = b"{not json"
+        blob = MAGIC + len(raw).to_bytes(4, "big") + raw
+        with pytest.raises(HandoffError, match="header"):
+            deserialize_pages(blob)
+
+    def test_absurd_header_length_capped(self):
+        """A corrupt length prefix must be refused BEFORE anything tries
+        to slice/parse gigabytes."""
+        blob = MAGIC + (1 << 31).to_bytes(4, "big") + b"x"
+        with pytest.raises(HandoffError, match="sanity cap"):
+            deserialize_pages(blob)
+
+    def test_page_size_mismatch(self):
+        blob, _ = self._blob()
+        with pytest.raises(HandoffError, match="page-size"):
+            deserialize_pages(blob, expect_page_tokens=T * 2)
+
+    def test_model_mismatch(self):
+        """KV computed by a different model with the SAME arena geometry
+        (e.g. two checkpoints of one architecture mid-rollout) must be
+        refused — adopting it would serve garbage with no error and the
+        poisoned pages would stay cached for later prompts."""
+        blob = serialize_pages(_tokens(2), T, _plain_sections(2),
+                               model="llama3-8b")
+        with pytest.raises(HandoffError, match="model mismatch"):
+            deserialize_pages(blob, expect_model="llama3.1-8b")
+        # an unstamped blob is just as foreign to a named replica
+        blob = serialize_pages(_tokens(2), T, _plain_sections(2))
+        with pytest.raises(HandoffError, match="model mismatch"):
+            deserialize_pages(blob, expect_model="llama3-8b")
+        header, _ = deserialize_pages(blob, expect_model="")
+        assert header["model"] == ""
+
+    def test_dtype_mismatch(self):
+        blob, sections = self._blob()
+        spec = _spec(sections)
+        spec["k"] = ("float16", spec["k"][1])
+        with pytest.raises(HandoffError, match="dtype mismatch"):
+            deserialize_pages(blob, expect_sections=spec)
+
+    def test_section_set_mismatch(self):
+        """An int8 blob must not adopt into a plain arena (and missing
+        scale sections must not silently drop)."""
+        blob = serialize_pages(_tokens(2), T, _int8_sections(2))
+        plain_spec = _spec(_plain_sections(2))
+        with pytest.raises(HandoffError, match="section-set"):
+            deserialize_pages(blob, expect_sections=plain_spec)
+
+    def test_trailing_shape_mismatch(self):
+        blob, sections = self._blob()
+        spec = _spec(sections)
+        spec["k"] = (spec["k"][0], (4, 2))  # arena pages heads*dim differently
+        with pytest.raises(HandoffError, match="trailing shape"):
+            deserialize_pages(blob, expect_sections=spec)
+
+    def test_declared_bytes_must_match_shape(self):
+        blob, sections = self._blob()
+        hlen = int.from_bytes(blob[len(MAGIC):len(MAGIC) + 4], "big")
+        header = json.loads(blob[len(MAGIC) + 4:len(MAGIC) + 4 + hlen])
+        header["sections"][0]["bytes"] += 4
+        raw = json.dumps(header).encode()
+        doctored = (MAGIC + len(raw).to_bytes(4, "big") + raw
+                    + blob[len(MAGIC) + 4 + hlen:])
+        with pytest.raises(HandoffError, match="declared"):
+            deserialize_pages(doctored)

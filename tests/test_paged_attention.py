@@ -197,6 +197,33 @@ class TestPagedDecodeStep:
         # slot 1's pages untouched (its table rows are pages 4..7)
         assert float(jnp.abs(arena["k"][:, 4:]).sum()) == 0.0
 
+    def test_stale_inactive_table_never_clobbers_live_pages(self, params):
+        """An inactive slot's page-table row is STALE — after its pages
+        free and re-allocate, entry 0 can alias an ACTIVE slot's tail
+        page. The inactive slot's scatter must be DROPPED entirely
+        (OOB index + mode=drop), not value-masked: a duplicate-index
+        scatter against the active slot's genuine write resolves in
+        undefined order and can revert the just-written KV."""
+        model = LlamaModel(self.CFG)
+        tok = jnp.asarray([5, 7], jnp.int32)
+        lengths = jnp.asarray([0, 0], jnp.int32)
+        active = jnp.asarray([True, False])
+        outs = []
+        # slot 1 inactive: first with a stale row ALIASING slot 0's write
+        # target (page 3, entry 0), then pointing elsewhere — the arena
+        # slot 0 writes must be identical either way
+        for stale_row in ([3, 0, 0, 0], [7, 0, 0, 0]):
+            arena = model.init_paged_arena(8, 4)
+            pt = jnp.asarray([[3, 4, 5, 6], stale_row], jnp.int32)
+            _, arena, _ = model.paged_decode_step(params, tok, arena, pt,
+                                                  lengths, active)
+            outs.append(np.asarray(arena["k"][:, 3]))
+        assert np.abs(outs[0]).sum() > 0, "active slot's write vanished"
+        np.testing.assert_array_equal(
+            outs[0], outs[1],
+            err_msg="inactive slot's stale table row corrupted the active "
+                    "slot's page")
+
     def test_unsupported_layouts_raise(self, params):
         wcfg = tiny_llama(name="tiny-window-paged", vocab_size=64,
                           embed_dim=32, n_layers=2, n_heads=4, n_kv_heads=2,

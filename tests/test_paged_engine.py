@@ -164,6 +164,93 @@ class TestPagedEngineSoak:
         finally:
             e.stop()
 
+    def test_handoff_export_adopt_between_real_engines(self, params):
+        """ISSUE 9: the disaggregated handoff halves over REAL engines —
+        engine A (prefill role) exports a prompt's KV pages, engine B
+        (decode role) adopts them, and B's next request on that prompt is
+        a prefix HIT decoding token-identically to A — the pages crossed
+        engines bit-true and the paged decode loop references them
+        zero-copy. Counters move only after the adoption actually lands:
+        a torn blob counts ONE failure and no pages/bytes."""
+        from k8s_runpod_kubelet_tpu.fleet.handoff import HandoffError
+        e_a = _engine(params, enabled=True)
+        e_b = _engine(params, enabled=True)
+        try:
+            prompt = SHARED + [5, 6, 7]
+            out = e_a.export_handoff(prompt)
+            assert out["pages"] == len(SHARED) // 8    # 12 full pages
+            assert out["covered_tokens"] == len(SHARED)
+            res = e_b.adopt_handoff(out["blob"])
+            assert res["pages"] == out["pages"]
+            assert e_b.metrics.get_counter(
+                "tpu_serving_kv_handoff_pages") == out["pages"]
+            assert e_b.metrics.get_counter(
+                "tpu_serving_kv_handoff_bytes") == len(out["blob"])
+
+            # the adopted pages ARE the prefix cache: B's first request on
+            # this prompt hits (counted only after the gather succeeded)
+            # and decodes token-identically to A
+            hits0 = e_b.metrics.get_counter("tpu_serving_prefix_cache_hits")
+            fut_b = e_b.submit(prompt, max_new_tokens=8)
+            fut_a = e_a.submit(prompt, max_new_tokens=8)
+            assert fut_b.result(timeout=300)["tokens"] \
+                == fut_a.result(timeout=300)["tokens"], \
+                "adopted KV decoded differently from the engine that " \
+                "computed it"
+            assert e_b.metrics.get_counter(
+                "tpu_serving_prefix_cache_hits") == hits0 + 1
+
+            # a torn blob: one failure, no optimistic pages/bytes
+            pages0 = e_b.metrics.get_counter("tpu_serving_kv_handoff_pages")
+            with pytest.raises(HandoffError):
+                e_b.adopt_handoff(out["blob"][:len(out["blob"]) // 2])
+            assert e_b.metrics.get_counter(
+                "tpu_serving_kv_handoff_failures") == 1
+            assert e_b.metrics.get_counter(
+                "tpu_serving_kv_handoff_pages") == pages0
+
+            # zero leaked pages on both arenas after drain
+            for e in (e_a, e_b):
+                e.drain()
+                stats = e.prefix_cache_stats()
+                assert stats["pages_free"] + stats["nodes"] \
+                    == stats["pages_total"], "leaked pages after handoff"
+        finally:
+            e_a.stop()
+            e_b.stop()
+
+    def test_failed_paged_bind_frees_slot_without_crashing_admit(self,
+                                                                 params):
+        """A failed slot bind (pool exhausted) leaves the slot FREE with
+        its request already failed; _admit must not then dereference the
+        empty slot (_finished reads slot.request.future) — that would
+        trip whole-step crash recovery and fail every in-flight request
+        for one overloaded admission."""
+        import time as _time
+        from k8s_runpod_kubelet_tpu.workloads.serving.engine import (
+            EngineOverloaded, _fail_future)
+        e = _engine(params, enabled=True)
+        try:
+            assert e._paged_loop
+
+            def failing_bind(slot_id, slot, req, single):
+                _fail_future(req.future, EngineOverloaded(
+                    "injected pool exhaustion"))
+                return False
+
+            e._bind_paged_slot = failing_bind
+            f = e.submit([1, 2, 3], max_new_tokens=4)
+            with pytest.raises(EngineOverloaded, match="injected"):
+                f.result(timeout=60)
+            _time.sleep(0.2)
+            assert e.alive, "engine loop died on a freed-slot admit"
+            assert e.last_error is None
+            del e._bind_paged_slot          # back to the class method
+            out = e.submit([4, 5, 6], max_new_tokens=2).result(timeout=60)
+            assert len(out["tokens"]) == 2
+        finally:
+            e.stop()
+
     def test_pool_exhaustion_degrades_not_fails(self, params):
         """A pool too small for the traffic caches what it can and keeps
         serving correct outputs (PoolExhausted never escapes)."""

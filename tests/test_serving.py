@@ -98,19 +98,23 @@ class TestEngineRecovery:
                           ).start()
         try:
             good = e.submit([5, 9, 2], max_new_tokens=6).result(timeout=60)
-            real_decode = e._decode
+            # poison whichever decode loop is ACTIVE: the paged loop (the
+            # plain-layout default — crash recovery rebuilds the whole
+            # arena/trie store) or the contiguous one (rebuilds the cache)
+            attr = "_paged_step" if e._paged_loop else "_decode"
+            real_decode = getattr(e, attr)
             calls = {"n": 0}
 
             def bomb(*a, **kw):
                 calls["n"] += 1
                 raise RuntimeError("injected decode failure")
 
-            e._decode = bomb
+            setattr(e, attr, bomb)
             f = e.submit([5, 9, 2], max_new_tokens=6)
             with pytest.raises(RuntimeError, match="injected"):
                 f.result(timeout=60)
             assert calls["n"] >= 1
-            e._decode = real_decode
+            setattr(e, attr, real_decode)
             # the handler drains the queues AFTER failing f; wait until it
             # finishes (active slots gauge reset happens at the end) or a
             # fresh submit could be swept up in the drain

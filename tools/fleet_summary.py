@@ -10,10 +10,14 @@ Input lines may be either:
 
 Both may be mixed in one file. Output:
 - a per-replica routing table: requests routed, affinity vs least-loaded
-  vs failover share, error count, p50/p95 router-side latency;
-- the latest load snapshot per replica (state, slots, queue, KV tokens,
-  TTFT p95) when snapshots are present;
-- the scale/evict event timeline.
+  vs two-hop vs failover share, error count, p50/p95 router-side latency;
+- the latest load snapshot per replica — grouped per disaggregated POOL
+  (unified / prefill / decode) — with state, slots, queue, KV tokens,
+  TTFT/ITL p95 and free KV pages, when snapshots are present;
+- the two-hop request timeline: route -> prefill -> handoff -> decode,
+  joined per trace_id from the fleet.handoff span and the two engines'
+  serving.kv_prefill / serving.kv_adopt spans riding the same trace;
+- the scale/evict event timeline (scale events carry their pool's role).
 
 Usage:
   python tools/fleet_summary.py fleet.jsonl
@@ -69,15 +73,15 @@ def routing_table(spans: list[dict]) -> list[str]:
     if not routes:
         return ["(no fleet.route spans)"]
     per: dict[str, dict] = defaultdict(
-        lambda: {"n": 0, "affinity": 0, "least_loaded": 0, "failover": 0,
-                 "errors": 0, "streams": 0, "durs": []})
+        lambda: {"n": 0, "affinity": 0, "least_loaded": 0, "two_hop": 0,
+                 "failover": 0, "errors": 0, "streams": 0, "durs": []})
     for s in routes:
         a = s.get("attrs", {})
         rid = a.get("replica_id") or "(unrouted)"
         row = per[rid]
         row["n"] += 1
         reason = a.get("reason", "")
-        if reason in ("affinity", "least_loaded"):
+        if reason in ("affinity", "least_loaded", "two_hop"):
             row[reason] += 1
         if int(a.get("attempts", 1) or 1) > 1:
             row["failover"] += 1
@@ -88,12 +92,14 @@ def routing_table(spans: list[dict]) -> list[str]:
         row["durs"].append(float(s.get("duration_s", 0.0)))
     out = ["== routing decisions (fleet.route spans) ==",
            f"{'replica':<20} {'reqs':>6} {'affin':>6} {'least':>6} "
-           f"{'failov':>6} {'stream':>6} {'errors':>6} {'p50':>9} {'p95':>9}"]
+           f"{'2hop':>6} {'failov':>6} {'stream':>6} {'errors':>6} "
+           f"{'p50':>9} {'p95':>9}"]
     for rid in sorted(per, key=lambda r: -per[r]["n"]):
         row = per[rid]
         durs = sorted(row["durs"])
         out.append(f"{rid:<20} {row['n']:>6} {row['affinity']:>6} "
-                   f"{row['least_loaded']:>6} {row['failover']:>6} "
+                   f"{row['least_loaded']:>6} {row['two_hop']:>6} "
+                   f"{row['failover']:>6} "
                    f"{row['streams']:>6} {row['errors']:>6} "
                    f"{_fmt_ms(percentile(durs, 50)):>9} "
                    f"{_fmt_ms(percentile(durs, 95)):>9}")
@@ -108,23 +114,81 @@ def load_table(snapshots: list[dict]) -> list[str]:
         for rep in snap.get("replicas", []):
             if isinstance(rep, dict) and rep.get("replica_id"):
                 latest[rep["replica_id"]] = rep
-    out = ["", "== latest replica load (registry snapshots) ==",
-           f"{'replica':<20} {'state':<9} {'slots':>11} {'queue':>6} "
-           f"{'kv_tokens':>10} {'ttft_p95':>9} {'prefix%':>8} {'hb_age':>7}"]
-    for rid in sorted(latest):
-        rep = latest[rid]
-        st = rep.get("stats", {})
-        slots = f"{st.get('active_slots', 0)}/{st.get('max_slots', 0)}"
-        # prefix-cache hit rate: per-replica proof the router's
-        # prefix-affinity concentrates reusable prompts (ISSUE 8)
-        hit = st.get("prefix_hit_rate")
-        hit_s = "-" if hit is None else f"{100.0 * float(hit):.1f}%"
-        out.append(f"{rid:<20} {rep.get('state', '?'):<9} {slots:>11} "
-                   f"{st.get('queue_depth', 0):>6} "
-                   f"{st.get('kv_cache_tokens', 0):>10} "
-                   f"{st.get('ttft_p95_s', 0.0):>8.3f}s "
-                   f"{hit_s:>8} "
-                   f"{rep.get('heartbeat_age_s', 0.0):>6.1f}s")
+    # group by disaggregated pool: each pool scales on different signals,
+    # so its replicas are only comparable to each other (prefill: queue/
+    # TTFT; decode: ITL + free KV pages; unified: all of them)
+    pools: dict[str, list[str]] = defaultdict(list)
+    for rid, rep in latest.items():
+        pools[rep.get("role") or "unified"].append(rid)
+    out = ["", "== latest replica load (registry snapshots) =="]
+    for role in ("unified", "prefill", "decode",
+                 *sorted(set(pools) - {"unified", "prefill", "decode"})):
+        if role not in pools:
+            continue
+        out += [f"-- pool: {role} ({len(pools[role])} replica(s)) --",
+                f"{'replica':<20} {'state':<9} {'slots':>11} {'queue':>6} "
+                f"{'kv_tokens':>10} {'ttft_p95':>9} {'itl_p95':>8} "
+                f"{'kv_free':>9} {'prefix%':>8} {'hb_age':>7}"]
+        for rid in sorted(pools[role]):
+            rep = latest[rid]
+            st = rep.get("stats", {})
+            slots = f"{st.get('active_slots', 0)}/{st.get('max_slots', 0)}"
+            # prefix-cache hit rate: per-replica proof the router's
+            # prefix-affinity concentrates reusable prompts (ISSUE 8)
+            hit = st.get("prefix_hit_rate")
+            hit_s = "-" if hit is None else f"{100.0 * float(hit):.1f}%"
+            total = st.get("kv_pages_total", 0)
+            free_s = f"{st.get('kv_pages_free', 0)}/{total}" if total \
+                else "-"
+            out.append(f"{rid:<20} {rep.get('state', '?'):<9} {slots:>11} "
+                       f"{st.get('queue_depth', 0):>6} "
+                       f"{st.get('kv_cache_tokens', 0):>10} "
+                       f"{st.get('ttft_p95_s', 0.0):>8.3f}s "
+                       f"{st.get('itl_p95_s', 0.0):>7.3f}s "
+                       f"{free_s:>9} "
+                       f"{hit_s:>8} "
+                       f"{rep.get('heartbeat_age_s', 0.0):>6.1f}s")
+    return out
+
+
+def two_hop_table(spans: list[dict], top: int) -> list[str]:
+    """Per-trace two-hop timeline: route -> prefill -> handoff -> decode.
+    The fleet.handoff span names both replicas; the engines' halves
+    (serving.kv_prefill / serving.kv_adopt / serving.request) ride the
+    same trace_id via the forwarded traceparent, so one trace joins the
+    router and BOTH engines."""
+    handoffs = [s for s in spans if s.get("name") == "fleet.handoff"]
+    if not handoffs:
+        return []
+    by_trace: dict[str, dict[str, dict]] = defaultdict(dict)
+    for s in spans:
+        if s.get("name") in ("fleet.route", "serving.kv_prefill",
+                             "serving.kv_adopt", "serving.request"):
+            by_trace[s.get("trace_id", "")][s["name"]] = s
+    handoffs.sort(key=lambda s: s.get("start", 0.0))
+    out = ["", f"== two-hop requests (fleet.handoff spans, last {top}) =="]
+    for s in handoffs[-top:]:
+        a = s.get("attrs", {})
+        tid = s.get("trace_id", "")
+        sibs = by_trace.get(tid, {})
+
+        def dur(name):
+            sp = sibs.get(name)
+            return "-" if sp is None else _fmt_ms(
+                float(sp.get("duration_s", 0.0)))
+
+        ok = a.get("ok")
+        tail = (f"pages={a.get('pages', 0)} bytes={a.get('bytes', 0)}"
+                if ok else
+                f"FAILED ({a.get('error') or '?'}) -> fell back to "
+                f"{sibs.get('fleet.route', {}).get('attrs', {}).get('replica_id', '?')}")
+        out.append(
+            f"  trace={tid[:16]} route[{dur('fleet.route')}] -> "
+            f"prefill {a.get('prefill_replica', '?')}"
+            f"[{dur('serving.kv_prefill')}] -> "
+            f"handoff[{_fmt_ms(float(s.get('duration_s', 0.0)))}] -> "
+            f"decode {a.get('decode_replica', '?')}"
+            f"[{dur('serving.kv_adopt')}] {tail}")
     return out
 
 
@@ -138,7 +202,12 @@ def event_timeline(spans: list[dict], top: int) -> list[str]:
     for s in events[-top:]:
         a = s.get("attrs", {})
         if s["name"] == "fleet.scale":
-            out.append(f"  t={s.get('start', 0.0):.1f} scale {a.get('direction')} "
+            # pool loops stamp their role; the whole-fleet loop renders as
+            # before ("unified" doubles as its span attr default)
+            role = a.get("role")
+            tag = f"[{role}]" if role and role != "unified" else ""
+            out.append(f"  t={s.get('start', 0.0):.1f} scale{tag} "
+                       f"{a.get('direction')} "
                        f"{a.get('from')} -> {a.get('to')} "
                        f"[{a.get('target', '')}] — {a.get('reason', '')}")
         else:
@@ -150,6 +219,7 @@ def event_timeline(spans: list[dict], top: int) -> list[str]:
 def render(spans: list[dict], snapshots: list[dict], top: int = 20) -> str:
     lines = routing_table(spans)
     lines += load_table(snapshots)
+    lines += two_hop_table(spans, top)
     lines += event_timeline(spans, top)
     return "\n".join(lines)
 
