@@ -43,6 +43,12 @@ Usage:
                              # paged arenas) + per-role TTFT/ITL through
                              # real engines (--smoke = codec cell only;
                              # CPU runs tiny geometry, claims need TPU)
+  python bench.py --chunked  # chunked prefill + streamed handoff:
+                             # serial-vs-streamed two-hop TTFT per
+                             # prompt length (overlap efficiency) and
+                             # co-resident ITL under a long prefill,
+                             # chunked vs monolithic (--smoke = short
+                             # sweep; CPU-capable, claims need TPU)
   python bench.py --mfu-sweep  # training MFU levers: remat none/dots,
                              # batch, 530M width (needs TPU)
   python bench.py --attn-tune  # flash block-size grid at the training
@@ -112,6 +118,9 @@ _STAGED_QUEUE = [
     # geometry + per-role TTFT/ITL (prefill hop, decode-with-adopted-KV,
     # unified cold) through real engines on the paged decode loop
     ("disagg", ["--disagg"], 2400),
+    # chunked prefill + streamed handoff (ISSUE 10): serial-vs-streamed
+    # two-hop TTFT sweep + ITL-under-long-prefill, chunked vs monolithic
+    ("chunked", ["--chunked"], 2400),
     ("serve_8b", ["--serve", "--model", "llama3-8b", "--int8", "--kv-int8"],
      2400),
     # int4 weights via the Pallas unpack kernel (ops/int4_matmul.py):
@@ -582,6 +591,278 @@ def run_disagg_bench(smoke: bool = False) -> int:
         e_pre.stop()
         e_dec.stop()
         e_uni.stop()
+    return 0
+
+
+def run_chunked_bench(smoke: bool = False) -> int:
+    """Chunked-prefill + streamed-handoff cells (ISSUE 10).
+
+    Cell 1 — TTFT-vs-prompt-length sweep, serial vs streamed two-hop:
+    for each prompt length, the SERIAL path is PR 9's stacked pipeline
+    (prefill compute + export + serialize, THEN adopt, THEN decode-side
+    TTFT) and the STREAMED path runs export_handoff_stream with a sender
+    thread serializing + adopting each chunk frame while the next chunk
+    computes. Each length reports both two-hop TTFTs (min of ``reps``
+    runs — scheduler noise must not masquerade as overlap), the realized
+    overlap ratio, and streamed/serial. The claim the CPU smoke pins:
+    streamed < serial at the longest prompt — the overlap is real even
+    in-process, because serialization/adoption are C-level work that
+    releases the GIL under the compute.
+
+    The inter-replica hop crosses an EMULATED LINK (a store-and-forward
+    proxy pacing bytes at ``link_gbps`` — labeled on every line): real
+    disaggregated fleets move KV across a pod network, and that wire time
+    is precisely what the stream hides behind compute. In-process
+    localhost alone has no wire (and a 1-core host has no second core to
+    overlap CPU work onto), so without the labeled link model the cell
+    would measure scheduler noise, not the overlap it exists to pin. The
+    pipeline under test is the production code end to end — serve_main
+    handlers, sender thread, chunk frames, assembler — only the wire is
+    modeled.
+
+    Cell 2 — ITL under long prefill: a decode stream is mid-generation
+    when a long prompt is admitted; max inter-token gap with chunking ON
+    (decode steps interleave between chunks) vs OFF (the monolithic
+    prefill monopolizes the device). Chunked must bound the spike the
+    monolithic engine reproduces."""
+    _force_platform_from_env()
+    import json as _json
+    import statistics
+    import urllib.error
+    import urllib.request
+    import jax
+    import jax.numpy as jnp
+    from k8s_runpod_kubelet_tpu.workloads.serve_main import serve
+    from k8s_runpod_kubelet_tpu.workloads.serving import (ServingConfig,
+                                                          ServingEngine)
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = _serve_model("llama3-8b")
+        params = _serve_params(cfg, 8)
+        page_t, chunk_t, max_pref, cache_len = 16, 256, 512, 8192
+        lengths = [1024, 2048, 4096] if not smoke else [1024, 4096]
+        slots, reps, new_toks = 8, 3, 32
+    else:
+        # KV-HEAVY tiny geometry (full-MHA 8x64 heads over a small MLP):
+        # the transfer leg must be material next to compute, or the
+        # overlap claim degenerates into dispatch-overhead noise — at 8B
+        # scale KV bytes/token dwarf this ratio anyway
+        from k8s_runpod_kubelet_tpu.models import init_params, tiny_llama
+        cfg = tiny_llama(vocab_size=256, embed_dim=128, n_layers=4,
+                         n_heads=8, n_kv_heads=8, head_dim=64, mlp_dim=128,
+                         max_seq_len=1024, dtype=jnp.float32,
+                         param_dtype=jnp.float32)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        page_t, chunk_t, max_pref, cache_len = 8, 128, 128, 960
+        lengths = [96, 224, 448] if not smoke else [96, 448]
+        slots, reps, new_toks = 2, 5, 8
+
+    def make_engine(chunk: int) -> ServingEngine:
+        sc = ServingConfig(slots=slots, max_prefill_len=max_pref,
+                           cache_len=cache_len, max_new_tokens=64,
+                           kv_page_tokens=page_t,
+                           serving_chunk_tokens=chunk)
+        return ServingEngine(cfg, params, sc).start()
+
+    def prompt_of(length: int, salt: int) -> list:
+        v = cfg.vocab_size - 2
+        return [((j * 7 + salt * 131) % v) + 1 for j in range(length)]
+
+    def ttft_of(engine, prompt) -> float:
+        t_sub = time.perf_counter()
+        first = []
+        engine.submit(prompt, max_new_tokens=new_toks,
+                      on_token=lambda _t: first.append(
+                          time.perf_counter() - t_sub)
+                      if not first else None).result(timeout=1800)
+        return first[0]
+
+    # -- cell 1: serial vs streamed two-hop TTFT sweep, over the REAL
+    # serve_main HTTP surface (the production path: /kv_prefill on the
+    # prefill replica pushing to the decode replica — monolithic blob
+    # push from the chunking-off engine, chunk-frame stream from the
+    # chunking-on engine) -----------------------------------------------------
+    # per-host DCN share on TPU; a deliberately CONSERVATIVE shared-pod
+    # link for the CPU smoke — the smoke's job is to pin the overlap
+    # MECHANISM on a small noisy host, which needs the wire leg to
+    # dominate scheduler jitter (the rate is labeled on every line; the
+    # chip run models the faster real DCN)
+    link_gbps = 8.0 if on_tpu else 0.2
+    link_rtt_s = 0.0003
+    e_ser = make_engine(0)          # serial prefill side (monolithic hop)
+    e_str = make_engine(chunk_t)    # streamed prefill side (chunked)
+    e_dse = make_engine(0)          # decode side for the serial hops
+    e_dst = make_engine(0)          # decode side for the streamed hops
+    engines = [e_ser, e_str, e_dse, e_dst]
+    servers = [serve(e, port=0) for e in engines]
+    urls = [f"http://127.0.0.1:{s.server_address[1]}" for s in servers]
+
+    def link_proxy(target: str):
+        """The emulated inter-replica wire: forward each POST with a
+        sleep budget of rtt + bytes/rate. The sleep is pure wait (socket
+        time on a real link) — compute proceeds under it, which is
+        exactly the overlap streamed handoff monetizes. The proxy keeps
+        ONE persistent downstream connection per inbound connection
+        (Nagle off on both hops): a real NIC has no per-frame
+        connection-setup cost, and paying one here 4x per stream vs 1x
+        per blob would charge the streamed path an emulation artifact,
+        not wire time."""
+        import http.client
+        import socket as _socket
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        parsed = urllib.parse.urlsplit(target)
+
+        class _Link(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            disable_nagle_algorithm = True
+
+            def log_message(self, *a):
+                pass
+
+            def setup(self):
+                super().setup()
+                self._down = None
+
+            def finish(self):
+                if self._down is not None:
+                    self._down.close()
+                super().finish()
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n) if n else b""
+                time.sleep(link_rtt_s + len(body) * 8 / (link_gbps * 1e9))
+                if self._down is None:
+                    self._down = http.client.HTTPConnection(
+                        parsed.hostname, parsed.port or 80, timeout=1800)
+                    self._down.connect()
+                    self._down.sock.setsockopt(_socket.IPPROTO_TCP,
+                                               _socket.TCP_NODELAY, 1)
+                self._down.request(
+                    "POST", self.path, body=body,
+                    headers={k: v for k, v in self.headers.items()
+                             if k.lower() in ("content-type",
+                                              "traceparent")})
+                resp = self._down.getresponse()
+                out, status = resp.read(), resp.status
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Link)
+        httpd.daemon_threads = True
+        import threading as _threading
+        _threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    proxies = [link_proxy(urls[2]), link_proxy(urls[3])]
+    link_urls = {2: proxies[0][1], 3: proxies[1][1]}
+
+    def hop(pre_idx: int, dec_idx: int, prompt) -> dict:
+        body = _json.dumps({"path": "/generate",
+                            "request": {"tokens": prompt},
+                            "handoff_to": link_urls[dec_idx]}).encode()
+        req = urllib.request.Request(
+            urls[pre_idx] + "/kv_prefill", data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(req, timeout=1800) as resp:
+            out = _json.loads(resp.read())
+        out["hop_s"] = time.perf_counter() - t0
+        if not out.get("ok"):
+            raise RuntimeError(f"hop failed: {out}")
+        return out
+
+    try:
+        warm = prompt_of(max(lengths), salt=999)
+        for e in engines:
+            e.submit(warm, max_new_tokens=2).result(timeout=1800)
+        # warm the hop jits/buckets end to end (export/serialize/adopt)
+        hop(0, 2, prompt_of(max(lengths), salt=998))
+        hop(1, 3, prompt_of(max(lengths), salt=997))
+        for li, length in enumerate(lengths):
+            serial_ms, streamed_ms = [], []
+            chunks, overlap = 0, None
+            for rep in range(reps):
+                # fresh prompt per rep/mode: a prefix hit would turn the
+                # measured hop into a cache read
+                p_s = prompt_of(length, salt=li * 100 + rep)
+                out = hop(0, 2, p_s)
+                serial_ms.append((out["hop_s"] + ttft_of(e_dse, p_s)) * 1e3)
+                p_t = prompt_of(length, salt=li * 100 + rep + 50)
+                out = hop(1, 3, p_t)
+                chunks = out.get("chunks", 0)
+                overlap = out.get("overlap_ratio")
+                streamed_ms.append((out["hop_s"]
+                                    + ttft_of(e_dst, p_t)) * 1e3)
+            # headline = MEDIANS: on a small/shared host one descheduled
+            # rep swings a min by tens of ms; the claim must survive noise
+            s_med = statistics.median(serial_ms)
+            t_med = statistics.median(streamed_ms)
+            _emit({"metric": "chunked_two_hop_ttft_ms",
+                   "prompt_tokens": length,
+                   "serial_ms": round(s_med, 2),
+                   "streamed_ms": round(t_med, 2),
+                   "streamed_over_serial": round(t_med / s_med, 3),
+                   "serial_ms_best": round(min(serial_ms), 2),
+                   "streamed_ms_best": round(min(streamed_ms), 2),
+                   "chunks": chunks, "overlap_ratio": overlap,
+                   "chunk_tokens": chunk_t,
+                   "page_tokens": page_t, "reps": reps,
+                   "emulated_link": True, "link_gbps": link_gbps,
+                   "link_rtt_ms": round(link_rtt_s * 1e3, 3),
+                   "model": cfg.name,
+                   "backend": jax.default_backend()})
+    finally:
+        for httpd, _u in proxies:
+            httpd.shutdown()
+        for s in servers:
+            s.shutdown()
+        for e in engines:
+            e.stop()
+
+    # -- cell 2: ITL under long prefill, chunked vs monolithic ---------------
+    long_prompt = prompt_of(max(lengths), salt=7)
+    results = {}
+    for label, chunk in (("chunked", chunk_t), ("monolithic", 0)):
+        e = make_engine(chunk)
+        try:
+            e.submit(prompt_of(max(lengths), salt=997),
+                     max_new_tokens=2).result(timeout=1800)
+            gaps: list = []
+            last = [None]
+
+            def on_token(_t):
+                now = time.perf_counter()
+                if last[0] is not None:
+                    gaps.append(now - last[0])
+                last[0] = now
+
+            stream_fut = e.submit(prompt_of(8, salt=5),
+                                  max_new_tokens=48, on_token=on_token)
+            while not gaps:           # the stream is actually decoding
+                time.sleep(0.005)
+            e.submit(long_prompt, max_new_tokens=2).result(timeout=1800)
+            stream_fut.result(timeout=1800)
+            results[label] = {
+                "max_gap_ms": round(max(gaps) * 1e3, 2),
+                "p50_gap_ms": round(statistics.median(gaps) * 1e3, 3),
+                "interleaved_steps": e.metrics.get_counter(
+                    "tpu_serving_chunk_interleaved_steps"),
+            }
+        finally:
+            e.stop()
+    _emit({"metric": "chunked_itl_under_prefill_ms",
+           "value": results["chunked"]["max_gap_ms"],
+           "unit": "ms (max co-resident ITL gap during a "
+                   f"{max(lengths)}-token prefill)",
+           "chunked": results["chunked"],
+           "monolithic": results["monolithic"],
+           "chunk_tokens": chunk_t, "model": cfg.name,
+           "backend": jax.default_backend()})
     return 0
 
 
@@ -1568,16 +1849,16 @@ def _write_unreachable_round(line: dict, root: str | None = None) -> str | None:
     return path
 
 
-def _disagg_smoke_lines() -> list | None:
-    """The ISSUE 9 handoff cell on CPU, in a subprocess (the orchestrator
-    process stays jax-free): an unreachable round still records a REAL
-    measured handoff-codec number — explicitly backend=cpu, never a chip
-    claim — next to the loud `unreachable` flag."""
+def _cpu_smoke_lines(flag: str, timeout_s: int = 300) -> list | None:
+    """One bench cell on CPU, in a subprocess (the orchestrator process
+    stays jax-free): an unreachable round still records REAL measured
+    numbers — explicitly backend=cpu, never a chip claim — next to the
+    loud `unreachable` flag."""
     try:
         out = subprocess.run(
             [sys.executable, os.path.join(_HERE, "bench.py"),
-             "--disagg", "--smoke"],
-            capture_output=True, text=True, timeout=300,
+             flag, "--smoke"],
+            capture_output=True, text=True, timeout=timeout_s,
             env={**os.environ, "JAX_PLATFORMS": "cpu"})
     except Exception:  # noqa: BLE001 — the smoke must never sink the round
         return None
@@ -1590,6 +1871,19 @@ def _disagg_smoke_lines() -> list | None:
         if isinstance(obj, dict) and obj.get("metric"):
             lines.append(obj)
     return lines or None
+
+
+def _disagg_smoke_lines() -> list | None:
+    """The ISSUE 9 handoff cell on CPU (see _cpu_smoke_lines)."""
+    return _cpu_smoke_lines("--disagg")
+
+
+def _chunked_smoke_lines() -> list | None:
+    """The ISSUE 10 chunked-prefill cells on CPU (see _cpu_smoke_lines):
+    the streamed-vs-serial two-hop TTFT sweep + the ITL-under-prefill
+    contrast ride every unreachable round, so the overlap claim is
+    re-measured per commit even with the chip away."""
+    return _cpu_smoke_lines("--chunked", timeout_s=900)
 
 
 def orchestrate(quick: bool) -> int:
@@ -1634,6 +1928,7 @@ def orchestrate(quick: bool) -> int:
     # rounds quietly re-served the r02 measurement).
     diag = _probe_diag_summary()
     smoke = None if quick else _disagg_smoke_lines()
+    chunked_smoke = None if quick else _chunked_smoke_lines()
     session = _session_tpu_headline()
     if session is not None:
         session["tpu_errors"] = errors[-2:]
@@ -1642,6 +1937,8 @@ def orchestrate(quick: bool) -> int:
             session["probe_diag"] = diag
         if smoke is not None:
             session["disagg_cpu_smoke"] = smoke
+        if chunked_smoke is not None:
+            session["chunked_cpu_smoke"] = chunked_smoke
         if not quick:
             _write_unreachable_round(session)
         _emit(session)
@@ -1664,6 +1961,8 @@ def orchestrate(quick: bool) -> int:
             line["probe_diag"] = diag
         if smoke is not None:
             line["disagg_cpu_smoke"] = smoke
+        if chunked_smoke is not None:
+            line["chunked_cpu_smoke"] = chunked_smoke
         if not quick:
             _write_unreachable_round(line)
         _emit(line)
@@ -1873,6 +2172,8 @@ def main() -> int:
         return run_paged_attn_bench()
     if "--disagg" in sys.argv:
         return run_disagg_bench(smoke="--smoke" in sys.argv)
+    if "--chunked" in sys.argv:
+        return run_chunked_bench(smoke="--smoke" in sys.argv)
     if "--ring-flash" in sys.argv:
         return run_ring_flash_check()
     if "--spec-drift" in sys.argv:

@@ -153,6 +153,17 @@ class Config:
     # True = auto (on whenever the model/layout allows it); False pins the
     # contiguous slot-cache loop.
     kv_paged_decode: bool = True
+    # chunked prefill + streamed handoff (ISSUE 10). serving_chunk_tokens:
+    # process prompts in chunks of this many tokens, yielding a decode
+    # step to the engine between chunks (bounds co-resident streams' ITL
+    # under long prefills) and — on disaggregated prefill replicas —
+    # streaming each completed chunk's KV pages to the decode replica
+    # while the next chunk computes (two-hop TTFT -> max(compute,
+    # transfer)). 0 = monolithic. handoff_stream_window bounds the chunk
+    # frames queued between prefill compute and the push (the overlap
+    # window; compute blocks when transfer falls that far behind).
+    serving_chunk_tokens: int = 0
+    handoff_stream_window: int = 8
 
     # elastic gang training (ISSUE 6). elastic_resize is the global gate for
     # the tpu.dev/elastic pod annotation: on partial host loss an elastic
@@ -299,6 +310,12 @@ class Config:
             errs.append("kv_page_tokens must be >= 1 (tokens per KV page)")
         if self.kv_pool_pages < 0:
             errs.append("kv_pool_pages must be >= 0 (0 = auto-size)")
+        if self.serving_chunk_tokens < 0:
+            errs.append("serving_chunk_tokens must be >= 0 (0 = "
+                        "monolithic prefill)")
+        if self.handoff_stream_window < 1:
+            errs.append("handoff_stream_window must be >= 1 (at least one "
+                        "frame in flight, or the stream cannot move)")
         if errs:
             raise ValueError("invalid config: " + "; ".join(errs))
         return self
@@ -342,6 +359,8 @@ _ENV_MAP = {
     "TPU_KV_POOL_PAGES": "kv_pool_pages",
     "TPU_PREFIX_CACHE_ENABLED": "prefix_cache_enabled",
     "TPU_KV_PAGED_DECODE": "kv_paged_decode",
+    "TPU_SERVING_CHUNK_TOKENS": "serving_chunk_tokens",
+    "TPU_HANDOFF_STREAM_WINDOW": "handoff_stream_window",
     "TPU_SERVING_ROLE": "serving_role",
     "TPU_FLEET_PREFILL_MIN_REPLICAS": "fleet_prefill_min_replicas",
     "TPU_FLEET_PREFILL_MAX_REPLICAS": "fleet_prefill_max_replicas",

@@ -41,7 +41,9 @@ log = logging.getLogger(__name__)
 # path for the paged-KV prefix cache (ISSUE 8) at fleet scale.
 SERVING_PASSTHROUGH_ENV = ("TPU_KV_PAGE_TOKENS", "TPU_KV_POOL_PAGES",
                            "TPU_PREFIX_CACHE_ENABLED",
-                           "TPU_KV_PAGED_DECODE")
+                           "TPU_KV_PAGED_DECODE",
+                           "TPU_SERVING_CHUNK_TOKENS",
+                           "TPU_HANDOFF_STREAM_WINDOW")
 
 
 @dataclasses.dataclass

@@ -34,12 +34,19 @@ bfloat16 rides numpy's ml_dtypes registration (jax ships it).
 from __future__ import annotations
 
 import json
-from typing import Optional
+import time
+from typing import Callable, Optional
 
 import numpy as np
 
 MAGIC = b"TPUKV\x01"
 VERSION = 1
+# streaming handoff (ISSUE 10): sequence-numbered CHUNK FRAMES, each
+# wrapping one page-run blob, pushed while the next prefill chunk is
+# still computing. Distinct magic so a whole-run blob can never be fed
+# to the stream path (or vice versa) silently.
+CHUNK_MAGIC = b"TPUKC\x01"
+CHUNK_VERSION = 1
 # refuse absurd headers before json.loads touches them (a corrupt length
 # prefix must not allocate gigabytes)
 _MAX_HEADER_BYTES = 16 * 1024 * 1024
@@ -209,3 +216,182 @@ def deserialize_pages(blob: bytes, *,
         raise HandoffError(f"{len(blob) - off} trailing bytes after the "
                            "declared sections")
     return header, sections
+
+
+# -- streaming chunk frames (ISSUE 10) ----------------------------------------
+
+def serialize_chunk_frame(stream_id: str, seq: int, payload: bytes, *,
+                          final: bool = False,
+                          total_tokens: Optional[int] = None) -> bytes:
+    """One stream frame: CHUNK_MAGIC | u32 header_len | header JSON |
+    payload. ``payload`` is a ``serialize_pages`` blob for this chunk's
+    completed pages (empty on a pure close frame). The FINAL frame
+    carries ``total_tokens`` — the token count the whole stream claims —
+    so a receiver can detect a torn stream even when every individual
+    frame parsed (all-or-nothing adoption needs a stream-level length
+    check, not just per-frame ones)."""
+    if not stream_id:
+        raise HandoffError("empty stream id")
+    if seq < 0:
+        raise HandoffError(f"negative seq {seq}")
+    if final and total_tokens is None:
+        raise HandoffError("final frame needs total_tokens")
+    header = {"version": CHUNK_VERSION, "stream": str(stream_id),
+              "seq": int(seq), "final": bool(final),
+              "payload_bytes": len(payload)}
+    if total_tokens is not None:
+        header["total_tokens"] = int(total_tokens)
+    raw = json.dumps(header).encode()
+    return b"".join([CHUNK_MAGIC, len(raw).to_bytes(4, "big"), raw, payload])
+
+
+def parse_chunk_frame(blob: bytes) -> tuple[dict, bytes]:
+    """(header, payload bytes) of one chunk frame; every malformation —
+    truncation, bad magic, foreign version, length drift, trailing
+    garbage — raises HandoffError (the assembler then drops the whole
+    stream: a stream that ever carried a bad frame must not adopt)."""
+    if len(blob) < len(CHUNK_MAGIC) + 4:
+        raise HandoffError(f"truncated chunk frame: {len(blob)} bytes")
+    if blob[:len(CHUNK_MAGIC)] != CHUNK_MAGIC:
+        raise HandoffError("bad magic: not a KV chunk frame")
+    hlen = int.from_bytes(blob[len(CHUNK_MAGIC):len(CHUNK_MAGIC) + 4], "big")
+    if hlen > _MAX_HEADER_BYTES:
+        raise HandoffError(f"chunk header length {hlen} exceeds sanity cap")
+    off = len(CHUNK_MAGIC) + 4
+    if len(blob) < off + hlen:
+        raise HandoffError(f"truncated chunk header: need {hlen} bytes, "
+                           f"have {len(blob) - off}")
+    try:
+        header = json.loads(blob[off:off + hlen])
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise HandoffError(f"unparseable chunk header: {e}") from e
+    off += hlen
+    if not isinstance(header, dict):
+        raise HandoffError("chunk header is not an object")
+    if header.get("version") != CHUNK_VERSION:
+        raise HandoffError(f"chunk version {header.get('version')!r} not "
+                           f"supported (this build speaks {CHUNK_VERSION})")
+    stream, seq = header.get("stream"), header.get("seq")
+    nbytes = header.get("payload_bytes")
+    if not (isinstance(stream, str) and stream and isinstance(seq, int)
+            and seq >= 0 and isinstance(nbytes, int) and nbytes >= 0):
+        raise HandoffError("chunk header missing stream/seq/payload_bytes")
+    if len(blob) - off != nbytes:
+        raise HandoffError(
+            f"torn chunk frame: payload declares {nbytes} bytes, "
+            f"{len(blob) - off} present")
+    return header, blob[off:]
+
+
+class _StreamState:
+    __slots__ = ("next_seq", "tokens", "sections", "nbytes", "last_seen")
+
+    def __init__(self, now: float):
+        self.next_seq = 0
+        self.tokens: list = []
+        self.sections: list[dict] = []     # per-frame {name: (L,n,T,...)}
+        self.nbytes = 0
+        self.last_seen = now
+
+
+class HandoffStreamAssembler:
+    """Strict-order chunk-stream assembly on the decode side: frames are
+    buffered HOST-side per stream and the arena is touched only when the
+    FINAL frame lands and the whole stream checks out — all-or-nothing
+    page accounting by construction (a torn/duplicate/reordered/stale
+    stream leaves both arenas exactly as they were).
+
+    Rejection surface (each raises HandoffError and DROPS the stream —
+    once a stream carried one bad frame nothing later may resurrect it):
+    out-of-order or duplicate ``seq``; a frame for an unknown stream not
+    starting at seq 0 (stale sender, or the stream was already dropped);
+    per-frame payload validation (deserialize_pages with the adopting
+    arena's expectations); a final ``total_tokens`` that disagrees with
+    what actually arrived; idle streams past ``ttl_s`` (GC'd on every
+    feed — an abandoned sender must not pin host memory forever).
+
+    Not thread-safe: the engine serializes ``feed`` under its handoff
+    lock. ``clock`` is injectable (tests drive the TTL deterministically).
+    """
+
+    def __init__(self, *, expect_page_tokens: int,
+                 expect_sections: Optional[dict] = None,
+                 expect_model: Optional[str] = None,
+                 max_streams: int = 8, ttl_s: float = 60.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.expect_page_tokens = expect_page_tokens
+        self.expect_sections = expect_sections
+        self.expect_model = expect_model
+        self.max_streams = max_streams
+        self.ttl_s = ttl_s
+        self.clock = clock
+        self._streams: dict[str, _StreamState] = {}
+
+    def __len__(self) -> int:
+        return len(self._streams)
+
+    def _gc(self, now: float) -> int:
+        dead = [sid for sid, st in self._streams.items()
+                if now - st.last_seen > self.ttl_s]
+        for sid in dead:
+            del self._streams[sid]
+        return len(dead)
+
+    def feed(self, blob: bytes) -> dict:
+        """One frame in. Returns {"final": False, "seq"} while the stream
+        is still open, or — on a valid final frame — {"final": True,
+        "seq", "tokens", "sections", "bytes", "frames"} ready for arena
+        adoption. Raises HandoffError (stream dropped) on any
+        rejection."""
+        now = self.clock()
+        self._gc(now)
+        header, payload = parse_chunk_frame(blob)
+        sid, seq = header["stream"], header["seq"]
+        st = self._streams.get(sid)
+        if st is None:
+            if seq != 0:
+                raise HandoffError(
+                    f"stale stream {sid!r}: frame seq {seq} for a stream "
+                    "this side never opened (expired, dropped, or the "
+                    "open frame was lost)")
+            if len(self._streams) >= self.max_streams:
+                raise HandoffError(
+                    f"too many open handoff streams ({self.max_streams})")
+            st = self._streams[sid] = _StreamState(now)
+        if seq != st.next_seq:
+            del self._streams[sid]
+            kind = "duplicate" if seq < st.next_seq else "reordered/lost"
+            raise HandoffError(
+                f"stream {sid!r}: {kind} frame (got seq {seq}, expected "
+                f"{st.next_seq}) — stream dropped, nothing adopted")
+        st.last_seen = now
+        st.next_seq += 1
+        try:
+            if payload:
+                hdr, sections = deserialize_pages(
+                    payload, expect_page_tokens=self.expect_page_tokens,
+                    expect_sections=self.expect_sections,
+                    expect_model=self.expect_model)
+                st.tokens.extend(hdr["tokens"])
+                st.sections.append(sections)
+            st.nbytes += len(blob)
+            if not header.get("final"):
+                return {"final": False, "seq": seq}
+            total = header.get("total_tokens")
+            if total != len(st.tokens):
+                raise HandoffError(
+                    f"torn stream {sid!r}: final frame claims {total} "
+                    f"tokens, {len(st.tokens)} arrived")
+            if not st.tokens:
+                raise HandoffError(
+                    f"stream {sid!r} closed with no pages")
+        except HandoffError:
+            self._streams.pop(sid, None)
+            raise
+        frames = st.next_seq
+        del self._streams[sid]
+        sections = {name: np.concatenate([s[name] for s in st.sections],
+                                         axis=1)
+                    for name in st.sections[0]}
+        return {"final": True, "seq": seq, "tokens": list(st.tokens),
+                "sections": sections, "bytes": st.nbytes, "frames": frames}

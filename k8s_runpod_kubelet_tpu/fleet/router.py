@@ -262,6 +262,7 @@ class FleetRouter:
         started = self.clock()
         span_id = Tracer.new_span_id()
         ok, skipped, pages, nbytes, err = False, False, 0, 0, ""
+        streamed, chunks, overlap = False, 0, None
         try:
             out = prefill_rep.transport.request(
                 "POST", "/kv_prefill",
@@ -274,6 +275,12 @@ class FleetRouter:
                 ok = True
                 pages = int(out.get("pages") or 0)
                 nbytes = int(out.get("bytes") or 0)
+                # streamed hop (ISSUE 10): chunk count + realized
+                # compute/transfer overlap ride the fleet.handoff span
+                # (fleet_summary's overlap column)
+                streamed = bool(out.get("streamed"))
+                chunks = int(out.get("chunks") or 0)
+                overlap = out.get("overlap_ratio")
             elif isinstance(out, dict) and out.get("skip"):
                 # the prefill replica DECLINED without computing (prompt
                 # under one page, no tokenizer for this route): an
@@ -300,7 +307,9 @@ class FleetRouter:
                 attrs={"prefill_replica": prefill_rep.replica_id,
                        "decode_replica": decode_rep.replica_id,
                        "ok": ok, "outcome": outcome, "pages": pages,
-                       "bytes": nbytes, "error": err or None})
+                       "bytes": nbytes, "streamed": streamed,
+                       "chunks": chunks, "overlap_ratio": overlap,
+                       "error": err or None})
         except Exception:  # noqa: BLE001 — tracing must never fail a request
             log.exception("fleet.handoff span recording failed")
         if skipped:

@@ -29,6 +29,7 @@ from jax.sharding import Mesh
 
 from ..ops import (apply_rope, flash_attention, paged_attention,
                    ring_attention, rms_norm, rope_frequencies)
+from ..ops.attention import paged_attention_mla, paged_attention_quant
 from .moe import moe_mlp
 from ..parallel.mesh import AXES
 from ..parallel.pipeline import pipeline_spmd, pipeline_stages
@@ -1535,21 +1536,49 @@ class LlamaModel:
         cache["index"] = jnp.where(active, cache["index"] + 1, cache["index"])
         return logits[:, 0], cache
 
-    def init_paged_arena(self, n_pages: int, page_tokens: int) -> Params:
-        """K/V page arena for ``paged_decode_step``: (L, P, T, h, d) per
-        section, page-major — page p's T positions are one contiguous tile,
+    def init_paged_arena(self, n_pages: int, page_tokens: int,
+                         quantize: bool = False) -> Params:
+        """KV page arena for ``paged_decode_step``: per section (L, P, T,
+        ...), page-major — page p's T positions are one contiguous tile,
         and a sequence is a page-table row over the shared pool (the
         serving engine's prefix arena uses the identical layout, so pages
         move between the two without reshapes; kv_cache_pspec applies
-        verbatim for TP). Standard dense-attention layouts only."""
+        verbatim for TP). Covers plain dense K/V, int8 K/V
+        (``quantize=True``: int8 payload + per-(position, kv-head) f32
+        scale sections paged alongside) and MLA latent layouts (c/kr —
+        and c_pre/kr_pre for dense-prefix models — no heads axis).
+        Sliding-window layouts cannot page (positions ring-overwrite);
+        the int8 LATENT combination is not paged yet."""
         cfg = self.cfg
-        if cfg.is_mla or cfg.sliding_window is not None:
-            raise ValueError("paged decode covers standard full-attention "
-                             "K/V layouts (no MLA / sliding-window yet)")
+        if cfg.sliding_window is not None:
+            raise ValueError("paged decode covers full-attention layouts "
+                             "(no sliding-window yet)")
+        if cfg.is_mla:
+            if quantize:
+                raise ValueError("paged decode does not cover the int8 "
+                                 "LATENT cache yet (plain-K/V int8 pages "
+                                 "fine)")
+            r, dr = cfg.mla_latent_dim, cfg.mla_rope_dim
+            kpre = cfg.n_dense_prefix
+            lm = cfg.n_layers - kpre
+            arena = {"c": jnp.zeros((lm, n_pages, page_tokens, r),
+                                    cfg.dtype),
+                     "kr": jnp.zeros((lm, n_pages, page_tokens, dr),
+                                     cfg.dtype)}
+            if kpre:
+                arena["c_pre"] = jnp.zeros((kpre, n_pages, page_tokens, r),
+                                           cfg.dtype)
+                arena["kr_pre"] = jnp.zeros((kpre, n_pages, page_tokens, dr),
+                                            cfg.dtype)
+            return arena
         shape = (cfg.n_layers, n_pages, page_tokens, cfg.n_kv_heads,
                  cfg.head_dim_)
-        return {"k": jnp.zeros(shape, cfg.dtype),
-                "v": jnp.zeros(shape, cfg.dtype)}
+        dt = jnp.int8 if quantize else cfg.dtype
+        arena = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+        if quantize:
+            arena["k_scale"] = jnp.zeros(shape[:-1], jnp.float32)
+            arena["v_scale"] = jnp.zeros(shape[:-1], jnp.float32)
+        return arena
 
     @_with_int4_mesh
     def paged_decode_step(self, params: Params, token: jax.Array,
@@ -1569,11 +1598,23 @@ class LlamaModel:
         shared copy-on-write). ``active`` freezes slots exactly like
         decode_step. Token-identical to decode_step on the same history
         (tests pin it); this is the decode path disaggregated prefill/
-        decode (ROADMAP item 2) ships KV pages into."""
+        decode (ROADMAP item 2) ships KV pages into.
+
+        Layouts (ISSUE 10 lifted the plain-dense-only gate): plain K/V,
+        int8 K/V (k_scale/v_scale sections page alongside; the new
+        token's row quantizes exactly like the contiguous int8 cache and
+        attention dequantizes in kernel — paged_attention_quant), and MLA
+        latents (c/kr ± dense-prefix sections — paged_attention_mla).
+        Sliding-window layouts still cannot page."""
         cfg = self.cfg
-        if cfg.is_mla or cfg.sliding_window is not None:
-            raise ValueError("paged decode covers standard full-attention "
-                             "K/V layouts (no MLA / sliding-window yet)")
+        if cfg.sliding_window is not None:
+            raise ValueError("paged decode covers full-attention layouts "
+                             "(no sliding-window yet)")
+        if cfg.is_mla:
+            return self._paged_decode_step_mla(
+                params, token, arena, page_tables, lengths, active,
+                use_pallas=use_pallas, interpret=interpret)
+        quant = "k_scale" in arena
         b = token.shape[0]
         if active is None:
             active = jnp.ones((b,), bool)
@@ -1595,6 +1636,7 @@ class LlamaModel:
 
         def block(y, inputs):
             lp, kp, vp = inputs["lp"], inputs["k"], inputs["v"]
+            ks, vs = inputs.get("ks"), inputs.get("vs")
             h = rms_norm(y, _norm_w(lp["attn_norm"], cfg), cfg.norm_eps)
             q, k, v = _qkv(h, lp, cfg, b, 1)
             if cfg.qk_norm:
@@ -1602,12 +1644,28 @@ class LlamaModel:
                 k = rms_norm(k, _norm_w(lp["k_norm"], cfg), cfg.norm_eps)
             q = apply_rope(q, cos, sin, positions[:, None])
             k = apply_rope(k, cos, sin, positions[:, None])
-            kp = kp.at[pages_b, offs].set(k[:, 0], mode="drop")
-            vp = vp.at[pages_b, offs].set(v[:, 0], mode="drop")
-            o = paged_attention(q[:, 0], kp, vp, page_tables, att_len,
-                                sm_scale=cfg.sm_scale,
-                                logit_soft_cap=cfg.attn_logit_softcap,
-                                use_pallas=use_pallas, interpret=interpret)
+            if quant:
+                # same per-row symmetric scheme as the contiguous int8
+                # cache (_kv_quant), so pages and slot caches interchange
+                k_w, k_s = _kv_quant(k[:, 0])          # (B,h,d), (B,h)
+                v_w, v_s = _kv_quant(v[:, 0])
+                ks = ks.at[pages_b, offs].set(k_s, mode="drop")
+                vs = vs.at[pages_b, offs].set(v_s, mode="drop")
+                kp = kp.at[pages_b, offs].set(k_w, mode="drop")
+                vp = vp.at[pages_b, offs].set(v_w, mode="drop")
+                o = paged_attention_quant(
+                    q[:, 0], kp, vp, ks, vs, page_tables, att_len,
+                    sm_scale=cfg.sm_scale,
+                    logit_soft_cap=cfg.attn_logit_softcap,
+                    use_pallas=use_pallas, interpret=interpret)
+            else:
+                kp = kp.at[pages_b, offs].set(k[:, 0], mode="drop")
+                vp = vp.at[pages_b, offs].set(v[:, 0], mode="drop")
+                o = paged_attention(q[:, 0], kp, vp, page_tables, att_len,
+                                    sm_scale=cfg.sm_scale,
+                                    logit_soft_cap=cfg.attn_logit_softcap,
+                                    use_pallas=use_pallas,
+                                    interpret=interpret)
             o = o.reshape(b, 1, cfg.n_heads * cfg.head_dim_).astype(cfg.dtype)
             o = _mm(o, lp["wo"], cfg.dtype)
             if cfg.post_norms:
@@ -1615,15 +1673,139 @@ class LlamaModel:
                              cfg.norm_eps)
             y = y + o
             y, _ = _mlp_block(y, lp, cfg, self.mesh, train=False)
-            return y, {"k": kp, "v": vp}
+            out = {"k": kp, "v": vp}
+            if quant:
+                out["ks"], out["vs"] = ks, vs
+            return y, out
 
         xs = {"lp": _group_layers(params["layers"], 1),
               "k": arena["k"], "v": arena["v"]}
+        if quant:
+            xs["ks"] = arena["k_scale"]
+            xs["vs"] = arena["v_scale"]
         x, new_kv = jax.lax.scan(block, x, xs)
         x = rms_norm(x, _norm_w(params["final_norm"], cfg), cfg.norm_eps)
         logits = _head_logits(x, params, cfg).astype(jnp.float32)[:, 0]
         new_lengths = jnp.where(active, lengths + 1, lengths)
-        return logits, {"k": new_kv["k"], "v": new_kv["v"]}, new_lengths
+        out = {"k": new_kv["k"], "v": new_kv["v"]}
+        if quant:
+            out["k_scale"], out["v_scale"] = new_kv["ks"], new_kv["vs"]
+        return logits, out, new_lengths
+
+    def _paged_decode_step_mla(self, params: Params, token: jax.Array,
+                               arena: Params, page_tables: jax.Array,
+                               lengths: jax.Array,
+                               active: Optional[jax.Array] = None, *,
+                               use_pallas: Optional[bool] = None,
+                               interpret: bool = False
+                               ) -> tuple[jax.Array, Params, jax.Array]:
+        """``paged_decode_step`` for MLA latent arenas, in the ABSORBED
+        form (_verify_step_mla's math at K=1 over pages): the new token's
+        normed latent c and rope key kr write at (page, offset) — latents
+        have no heads axis, so a page row is (T, r)/(T, dr) — and
+        attention runs latent-space scores + the decoupled-RoPE term over
+        the page table (ops.paged_attention_mla), never materializing
+        per-head K/V. Dense-prefix models' c_pre/kr_pre sections page
+        under the SAME page ids (a page spans every layer's slice, like
+        the plain arena's layer axis)."""
+        cfg = self.cfg
+        if "c_scale" in arena:
+            raise ValueError("paged decode does not cover the int8 LATENT "
+                             "cache yet")
+        b = token.shape[0]
+        if active is None:
+            active = jnp.ones((b,), bool)
+        t = arena["c"].shape[2]
+        positions = lengths                                  # (B,) write pos
+        pages_b = jnp.take_along_axis(
+            page_tables, (positions // t)[:, None], axis=1)[:, 0]
+        # inactive slots must not scatter at all (stale table rows alias
+        # live tail pages): OOB page id + mode="drop" elides the write —
+        # the same hazard the plain paged step closes
+        pages_b = jnp.where(active, pages_b, arena["c"].shape[1])
+        offs = positions % t
+        cos, sin = _rope_tables(cfg)[0]          # MLA: single global table
+        hd, dr, r = cfg.head_dim_, cfg.mla_rope_dim, cfg.mla_latent_dim
+        hn = cfg.n_heads
+        scale = (hd + dr) ** -0.5 * yarn_mscale_sq(cfg)
+        x = _embed(params, token[:, None], cfg, self.mesh)   # (B, 1, E)
+        att_len = positions + 1
+        pos2 = positions[:, None]                            # (B, 1)
+
+        def make_block(cfg_):
+            def block(y, inputs):
+                lp, cp, krp = inputs["lp"], inputs["c"], inputs["kr"]
+                h = rms_norm(y, _norm_w(lp["attn_norm"], cfg_),
+                             cfg_.norm_eps)
+                q_nope, q_rope, c1, kr1 = _mla_project(h, lp, cfg_, cos,
+                                                       sin, pos2, b, 1)
+                cp = cp.at[pages_b, offs].set(c1[:, 0], mode="drop")
+                krp = krp.at[pages_b, offs].set(kr1[:, 0], mode="drop")
+                w_uk = lp["w_uk"].reshape(r, hn, hd)
+                # absorbed query: the w_uk fold happens HERE, once per
+                # step, so attention reads the (r + dr) latents directly
+                q_lat = jnp.einsum("bhd,rhd->bhr",
+                                   q_nope[:, 0].astype(jnp.float32),
+                                   w_uk.astype(jnp.float32))
+                o_lat = paged_attention_mla(
+                    q_lat, q_rope[:, 0].astype(jnp.float32), cp, krp,
+                    page_tables, att_len, sm_scale=scale,
+                    use_pallas=use_pallas, interpret=interpret)
+                w_uv = lp["w_uv"].reshape(r, hn, hd)
+                o = jnp.einsum("bhr,rhd->bhd", o_lat.astype(jnp.float32),
+                               w_uv.astype(jnp.float32))
+                o = o.reshape(b, 1, hn * hd).astype(cfg_.dtype)
+                o = _mm(o, lp["wo"], cfg_.dtype)
+                if cfg_.post_norms:
+                    o = rms_norm(o, _norm_w(lp["attn_post_norm"], cfg_),
+                                 cfg_.norm_eps)
+                y = y + o
+                y, _ = _mlp_block(y, lp, cfg_, self.mesh, train=False)
+                return y, {"c": cp, "kr": krp}
+            return block
+
+        new_pre = None
+        if cfg.n_dense_prefix:
+            x, new_pre = jax.lax.scan(
+                make_block(cfg.prefix_cfg()), x,
+                {"lp": params["prefix_layers"], "c": arena["c_pre"],
+                 "kr": arena["kr_pre"]})
+        x, new_kv = jax.lax.scan(make_block(cfg), x,
+                                 {"lp": params["layers"], "c": arena["c"],
+                                  "kr": arena["kr"]})
+        x = rms_norm(x, _norm_w(params["final_norm"], cfg), cfg.norm_eps)
+        logits = _head_logits(x, params, cfg).astype(jnp.float32)[:, 0]
+        out = {"c": new_kv["c"], "kr": new_kv["kr"]}
+        if new_pre is not None:
+            out["c_pre"], out["kr_pre"] = new_pre["c"], new_pre["kr"]
+        new_lengths = jnp.where(active, lengths + 1, lengths)
+        return logits, out, new_lengths
+
+    def prefill_chunk_step(self, params: Params, tokens: jax.Array,
+                           cache: Params, true_length: jax.Array,
+                           adapters: Optional[dict] = None,
+                           adapter_ids: Optional[jax.Array] = None
+                           ) -> tuple[jax.Array, Params]:
+        """One CHUNK of a prompt appended to a running single-request
+        cache (serving chunked prefill, ISSUE 10): ``tokens`` (B, S_pad)
+        is the chunk zero-padded to its compile bucket, ``true_length``
+        (B,) the real token count — TRACED, so chunk lengths never force
+        a recompile. The chunk consumes the cache's running KV (all prior
+        chunks') through the verify kernel; padded positions' KV lands
+        beyond the committed index, never attended and overwritten by the
+        next chunk (the decode-path invariant), and ``index`` advances by
+        ``true_length``. Returns (last-real-token logits (B, V), cache).
+        Token-identical to one monolithic prefill of the concatenation
+        (pinned by tests) — the win is that the scheduler can interleave
+        decode steps between chunk dispatches, so a long prompt no longer
+        freezes co-resident streams' ITL."""
+        b = tokens.shape[0]
+        logits, cache = self.verify_step(params, tokens, cache, None,
+                                         adapters, adapter_ids)
+        cache = dict(cache)
+        tl = true_length.astype(jnp.int32)
+        cache["index"] = cache["index"] + tl
+        return logits[jnp.arange(b), tl - 1], cache
 
     @_with_int4_mesh
     def verify_step(self, params: Params, tokens: jax.Array, cache: Params,

@@ -642,6 +642,356 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
                                    scale, interpret, logit_soft_cap)
 
 
+# -- paged-attention variants: int8-KV (dequant in kernel) + MLA latents ------
+# (ISSUE 10: the paged decode LOOP covered plain dense K/V only; these are
+# the kernels that let int8-KV and MLA arenas serve zero-copy per-slot page
+# tables — and adopt handed-off pages without a gather.)
+
+def _paged_attention_quant_xla(q, k_pages, v_pages, k_scale, v_scale,
+                               page_table, lengths, *, sm_scale: float,
+                               logit_soft_cap: Optional[float] = None
+                               ) -> jax.Array:
+    """Reference path: gather the page table's WORKING SET first, then
+    dequantize only that — identical math to the contiguous int8 decode
+    (dequant then f32 attention), so parity tests compare the same
+    numbers. Order matters for memory: dequantizing the whole arena
+    before the gather would materialize ~8x the arena's int8 bytes in
+    f32 per layer per step (the arena is sized to hold every slot's full
+    residency — on the fallback path that transient could OOM HBM)."""
+    b, hq, d = q.shape
+    _, t, hkv, _ = k_pages.shape
+    n = page_table.shape[1]
+    group = hq // hkv
+    k = (k_pages[page_table].astype(jnp.float32)
+         * k_scale[page_table][..., None]).reshape(b, n * t, hkv, d)
+    v = (v_pages[page_table].astype(jnp.float32)
+         * v_scale[page_table][..., None]).reshape(b, n * t, hkv, d)
+    qg = (q.astype(jnp.float32) * sm_scale).reshape(b, hkv, group, d)
+    s = jnp.einsum("bhgd,bLhd->bhgL", qg, k)
+    if logit_soft_cap is not None:
+        s = jnp.tanh(s / logit_soft_cap) * logit_soft_cap
+    valid = jnp.arange(n * t)[None, :] < lengths[:, None]
+    s = jnp.where(valid[:, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhgL,bLhd->bhgd", p, v)
+    return o.reshape(b, hq, d).astype(q.dtype)
+
+
+def _paged_fwd_quant_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref,
+                            ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                            page_tokens: int, num_pages: int, n_kv: int,
+                            sm_scale: float,
+                            soft_cap: Optional[float] = None):
+    """The plain paged kernel with int8 K/V pages dequantized IN KERNEL:
+    HBM reads stay int8 (the bandwidth win), the f32 scales ride a small
+    (T, Hkv) block per page and this program's head column is selected by
+    an iota mask (a (T, 1) lane slice cannot tile)."""
+    import jax.experimental.pallas as pl  # noqa: F401 (kernel-only import)
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    length = len_ref[b]
+
+    @pl.when(i * page_tokens < length)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale      # (Gp, D)
+        hsel = jax.lax.broadcasted_iota(
+            jnp.int32, (page_tokens, n_kv), 1) == h
+        k_s = jnp.sum(jnp.where(hsel, ks_ref[0], 0.0), axis=1,
+                      keepdims=True)                        # (T, 1)
+        v_s = jnp.sum(jnp.where(hsel, vs_ref[0], 0.0), axis=1,
+                      keepdims=True)
+        kc = k_ref[0, :, 0].astype(jnp.float32) * k_s       # (T, D)
+        vc = v_ref[0, :, 0].astype(jnp.float32) * v_s
+        s = jax.lax.dot_general(q, kc, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # (Gp, T)
+        if soft_cap is not None:
+            s = jnp.tanh(s / soft_cap) * soft_cap
+        pos = i * page_tokens + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(pos < length, s, NEG_INF)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+            p, vc, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(i == num_pages - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def _paged_attention_quant_pallas(q, k_pages, v_pages, k_scale, v_scale,
+                                  page_table, lengths, scale: float,
+                                  interpret: bool,
+                                  soft_cap: Optional[float] = None
+                                  ) -> jax.Array:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, hq, d = q.shape
+    _, t, hkv, _ = k_pages.shape
+    n = page_table.shape[1]
+    group = hq // hkv
+    gp = -(-group // 8) * 8
+    qr = q.reshape(b, hkv, group, d)
+    if gp != group:
+        qr = jnp.pad(qr, ((0, 0), (0, 0), (0, gp - group), (0, 0)))
+    kernel = functools.partial(_paged_fwd_quant_kernel, page_tokens=t,
+                               num_pages=n, n_kv=hkv, sm_scale=scale,
+                               soft_cap=soft_cap)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # page_table, lengths
+        grid=(b, hkv, n),
+        in_specs=[
+            pl.BlockSpec((1, 1, gp, d),
+                         lambda bb, h, i, pt, ln: (bb, h, 0, 0)),
+            pl.BlockSpec((1, t, 1, d),
+                         lambda bb, h, i, pt, ln: (pt[bb, i], 0, h, 0)),
+            pl.BlockSpec((1, t, 1, d),
+                         lambda bb, h, i, pt, ln: (pt[bb, i], 0, h, 0)),
+            # scales: the whole (T, Hkv) tile per page — a (T, 1) head
+            # column cannot tile on lanes, and the tile is tiny next to
+            # the int8 payload it scales
+            pl.BlockSpec((1, t, hkv),
+                         lambda bb, h, i, pt, ln: (pt[bb, i], 0, 0)),
+            pl.BlockSpec((1, t, hkv),
+                         lambda bb, h, i, pt, ln: (pt[bb, i], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, gp, d),
+                               lambda bb, h, i, pt, ln: (bb, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((gp, d), jnp.float32),
+            pltpu.VMEM((gp, _STATS_LANES), jnp.float32),
+            pltpu.VMEM((gp, _STATS_LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, gp, d), q.dtype),
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      qr, k_pages, v_pages, k_scale, v_scale)
+    return out[:, :, :group].reshape(b, hq, d)
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale", "use_pallas",
+                                             "interpret", "logit_soft_cap"))
+def paged_attention_quant(q: jax.Array, k_pages: jax.Array,
+                          v_pages: jax.Array, k_scale: jax.Array,
+                          v_scale: jax.Array, page_table: jax.Array,
+                          lengths: jax.Array, *,
+                          sm_scale: Optional[float] = None,
+                          use_pallas: Optional[bool] = None,
+                          interpret: bool = False,
+                          logit_soft_cap: Optional[float] = None
+                          ) -> jax.Array:
+    """``paged_attention`` over an int8-quantized KV arena: k/v_pages are
+    int8 (P, T, Hkv, D) with per-(position, kv-head) f32 scales (P, T,
+    Hkv) paged alongside — the same per-row symmetric scheme the
+    contiguous int8 cache uses (models/llama.py _kv_quant), so an int8-KV
+    engine's pages serve the paged decode loop AND hand off through the
+    codec without requantization. Dequantization happens after the VMEM
+    load; HBM reads stay int8, which is the entire point of the layout on
+    a bandwidth-bound decode step. Same shape/validity contract as
+    paged_attention; falls back to the dequant-reference off-TPU or when
+    (T, D) don't tile."""
+    b, hq, d = q.shape
+    _, t, hkv, _ = k_pages.shape
+    if hq % hkv != 0:
+        raise ValueError(f"Hq={hq} not a multiple of Hkv={hkv}")
+    if k_scale.shape != k_pages.shape[:3] \
+            or v_scale.shape != v_pages.shape[:3]:
+        raise ValueError(
+            f"scale shapes {k_scale.shape}/{v_scale.shape} must be the "
+            f"pages' (P, T, Hkv) = {k_pages.shape[:3]}")
+    if logit_soft_cap is not None and logit_soft_cap <= 0:
+        raise ValueError(f"logit_soft_cap must be positive, "
+                         f"got {logit_soft_cap}")
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    pallas_ok = (_use_pallas(use_pallas) or interpret) \
+        and d % 128 == 0 and t % 8 == 0
+    if not pallas_ok:
+        return _paged_attention_quant_xla(q, k_pages, v_pages, k_scale,
+                                          v_scale, page_table, lengths,
+                                          sm_scale=scale,
+                                          logit_soft_cap=logit_soft_cap)
+    return _paged_attention_quant_pallas(q, k_pages, v_pages, k_scale,
+                                         v_scale, page_table, lengths,
+                                         scale, interpret, logit_soft_cap)
+
+
+def _paged_attention_mla_xla(q_lat, q_rope, c_pages, kr_pages, page_table,
+                             lengths, *, sm_scale: float) -> jax.Array:
+    """Reference path for MLA paged decode, in the ABSORBED form: scores
+    are a latent-space dot plus the decoupled-RoPE term, the output is the
+    attention-weighted LATENT (the caller up-projects through w_uv) —
+    exactly the per-layer math of llama.py's MLA decode, over gathered
+    pages. Latents have no heads axis: every query head reads the same
+    (L, r + dr) cache rows."""
+    b, hq, r = q_lat.shape
+    _, t, _ = c_pages.shape
+    n = page_table.shape[1]
+    c = c_pages[page_table].reshape(b, n * t, r).astype(jnp.float32)
+    kr = kr_pages[page_table].reshape(b, n * t, -1).astype(jnp.float32)
+    s = (jnp.einsum("bhr,bLr->bhL",
+                    q_lat.astype(jnp.float32) * sm_scale, c)
+         + jnp.einsum("bhd,bLd->bhL",
+                      q_rope.astype(jnp.float32) * sm_scale, kr))
+    valid = jnp.arange(n * t)[None, :] < lengths[:, None]
+    s = jnp.where(valid[:, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhL,bLr->bhr", p, c)
+    return o.astype(q_lat.dtype)
+
+
+def _paged_fwd_mla_kernel(pt_ref, len_ref, ql_ref, qr_ref, c_ref, kr_ref,
+                          o_ref, acc_ref, m_ref, l_ref, *, page_tokens: int,
+                          num_pages: int, sm_scale: float):
+    """One (batch row, page) program: latent pages are HEADLESS, so the
+    grid drops the kv-head dimension and every query head shares the one
+    streamed (T, r)+(T, dr) tile — the bandwidth shape MLA exists for."""
+    import jax.experimental.pallas as pl  # noqa: F401 (kernel-only import)
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    length = len_ref[b]
+
+    @pl.when(i * page_tokens < length)
+    def _compute():
+        ql = ql_ref[0].astype(jnp.float32) * sm_scale       # (Gp, R)
+        qr = qr_ref[0].astype(jnp.float32) * sm_scale       # (Gp, Dr)
+        cc = c_ref[0].astype(jnp.float32)                   # (T, R)
+        krc = kr_ref[0].astype(jnp.float32)                 # (T, Dr)
+        s = (jax.lax.dot_general(ql, cc, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+             + jax.lax.dot_general(qr, krc, (((1,), (1,)), ((), ())),
+                                   preferred_element_type=jnp.float32))
+        pos = i * page_tokens + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(pos < length, s, NEG_INF)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+            p, cc, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(i == num_pages - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def _paged_attention_mla_pallas(q_lat, q_rope, c_pages, kr_pages, page_table,
+                                lengths, scale: float,
+                                interpret: bool) -> jax.Array:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, hq, r = q_lat.shape
+    _, t, _ = c_pages.shape
+    dr = kr_pages.shape[2]
+    n = page_table.shape[1]
+    gp = -(-hq // 8) * 8
+    if gp != hq:
+        q_lat = jnp.pad(q_lat, ((0, 0), (0, gp - hq), (0, 0)))
+        q_rope = jnp.pad(q_rope, ((0, 0), (0, gp - hq), (0, 0)))
+    kernel = functools.partial(_paged_fwd_mla_kernel, page_tokens=t,
+                               num_pages=n, sm_scale=scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # page_table, lengths
+        grid=(b, n),
+        in_specs=[
+            pl.BlockSpec((1, gp, r), lambda bb, i, pt, ln: (bb, 0, 0)),
+            pl.BlockSpec((1, gp, dr), lambda bb, i, pt, ln: (bb, 0, 0)),
+            pl.BlockSpec((1, t, r), lambda bb, i, pt, ln: (pt[bb, i], 0, 0)),
+            pl.BlockSpec((1, t, dr), lambda bb, i, pt, ln: (pt[bb, i], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, gp, r), lambda bb, i, pt, ln: (bb, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((gp, r), jnp.float32),
+            pltpu.VMEM((gp, _STATS_LANES), jnp.float32),
+            pltpu.VMEM((gp, _STATS_LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, gp, r), q_lat.dtype),
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      q_lat, q_rope, c_pages, kr_pages)
+    return out[:, :hq]
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale", "use_pallas",
+                                             "interpret"))
+def paged_attention_mla(q_lat: jax.Array, q_rope: jax.Array,
+                        c_pages: jax.Array, kr_pages: jax.Array,
+                        page_table: jax.Array, lengths: jax.Array, *,
+                        sm_scale: Optional[float] = None,
+                        use_pallas: Optional[bool] = None,
+                        interpret: bool = False) -> jax.Array:
+    """Paged-attention decode over an MLA LATENT arena (absorbed form):
+    q_lat (B, Hq, R) is the w_uk-absorbed query, q_rope (B, Hq, Dr) the
+    decoupled-RoPE query; c_pages (P, T, R) / kr_pages (P, T, Dr) are the
+    latent pages — no kv-heads axis, every head attends the same rows.
+    Returns the attention-weighted latent (B, Hq, R) in q_lat's dtype;
+    the caller up-projects it through w_uv (exactly the contiguous MLA
+    decode split in models/llama.py). Same page-table/lengths contract as
+    paged_attention. Pallas needs R and Dr lane-aligned (each %% 128) and
+    T %% 8; anything else runs the gathered reference — still zero-copy
+    paged, just XLA-fused (DeepSeek's dr=64 lands there today)."""
+    b, hq, r = q_lat.shape
+    _, t, _ = c_pages.shape
+    dr = kr_pages.shape[2]
+    if q_rope.shape != (b, hq, dr):
+        raise ValueError(f"q_rope {q_rope.shape} != (B, Hq, Dr) = "
+                         f"{(b, hq, dr)}")
+    if c_pages.shape[:2] != kr_pages.shape[:2]:
+        raise ValueError(f"c_pages {c_pages.shape} / kr_pages "
+                         f"{kr_pages.shape} disagree on (P, T)")
+    scale = sm_scale if sm_scale is not None else (r + dr) ** -0.5
+    pallas_ok = (_use_pallas(use_pallas) or interpret) \
+        and r % 128 == 0 and dr % 128 == 0 and t % 8 == 0
+    if not pallas_ok:
+        return _paged_attention_mla_xla(q_lat, q_rope, c_pages, kr_pages,
+                                        page_table, lengths, sm_scale=scale)
+    return _paged_attention_mla_pallas(q_lat, q_rope, c_pages, kr_pages,
+                                       page_table, lengths, scale, interpret)
+
+
 @functools.partial(jax.jit, static_argnames=("causal", "sm_scale", "use_pallas",
                                              "block_q", "block_k", "interpret",
                                              "sliding_window",

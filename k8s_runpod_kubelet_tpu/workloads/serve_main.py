@@ -35,10 +35,17 @@ The pod command for autoscaled inference. Endpoints:
   POST /kv_prefill disaggregated prefill hop (router -> prefill replica):
                    tokenize the forwarded request, prefill its KV through
                    the prefix-cache path, and push the serialized page
-                   run to the decode replica named by "handoff_to"
+                   run to the decode replica named by "handoff_to" —
+                   with chunked prefill on (--serving-chunk-tokens) the
+                   hop STREAMS sequence-numbered chunk frames to the
+                   decode replica's /kv_adopt_chunk while the next chunk
+                   is still computing (compute/transfer overlap)
   POST /kv_adopt   decode-side adoption: a pushed KV page run lands in
                    this engine's arena via the prefix trie, so the
                    upcoming request references it zero-copy
+  POST /kv_adopt_chunk  streamed adoption: one chunk frame in, buffered
+                   strictly in order; the arena moves only when the final
+                   frame closes a fully-valid stream (all-or-nothing)
   POST /drain      graceful drain (fleet scale-down): stop admitting,
                    finish in-flight, then the fleet reporter deregisters
   GET  /debug/traces  recent request span trees as JSON (?trace_id= filters
@@ -84,6 +91,12 @@ class _Handler(BaseHTTPRequestHandler):
     tokenizer = None  # bound below; None = token-ids-only API
     request_timeout_s = 120.0
     allow_adapters = False  # POST /adapters opt-in (--dynamic-adapters)
+    # streamed handoff (ISSUE 10): max chunk fragments queued between the
+    # engine's chunked prefill and the sender thread pushing them to the
+    # decode replica — the compute/transfer overlap window. Engine compute
+    # BLOCKS when the window is full (bounds host memory; transfer is the
+    # bottleneck then anyway).
+    handoff_stream_window = 8
     # clock seams, rebound by serve(clock=..., mono=...): wall time for
     # OpenAI `created` stamps / request ids, monotonic for deadlines —
     # injected so stress/soak tests drive HTTP-layer timeouts deterministically
@@ -337,6 +350,13 @@ class _Handler(BaseHTTPRequestHandler):
             span(False, {"skip": True, "error": str(e)})
             return self._send(200, {"ok": False, "skip": True,
                                     "error": str(e)})
+        if self.engine.sc.serving_chunk_tokens > 0:
+            # ISSUE 10: chunked engines STREAM the handoff — each
+            # completed chunk's page run pushes to the decode replica
+            # while the next chunk computes (frames to /kv_adopt_chunk),
+            # overlapping compute with transfer
+            return self._kv_prefill_streamed(tokens, target, trace_id,
+                                             span_id, span)
         try:
             out = self.engine.export_handoff(tokens)
         except Exception as e:  # noqa: BLE001 — export counts its own failures
@@ -369,6 +389,264 @@ class _Handler(BaseHTTPRequestHandler):
             "covered_tokens": out["covered_tokens"],
             "matched_tokens": out["matched_tokens"],
             "adopted": adopted.get("pages")})
+
+    def _kv_prefill_streamed(self, tokens: list, target: str,
+                             trace_id: str, span_id: str, span):
+        """The chunked/overlapped prefill hop: the engine's
+        export_handoff_stream computes chunk by chunk and hands each
+        completed page run to a SENDER THREAD here, which serializes the
+        frame and POSTs it to the decode replica's /kv_adopt_chunk while
+        the next chunk is still computing. The queue between them is the
+        handoff_stream_window — compute blocks when transfer falls that
+        far behind. Per-chunk serving.kv_chunk (compute) and
+        serving.kv_push (serialize + POST) spans parent under this hop's
+        serving.kv_prefill, so the chunk timeline renders per trace
+        (tools/fleet_summary.py). Any frame failure aborts the stream:
+        502 to the router, which falls back — the decode side's partial
+        stream buffer expires without ever touching its arena."""
+        import queue as _q
+        import uuid
+
+        import numpy as np
+
+        from ..fleet.handoff import (serialize_chunk_frame,
+                                     serialize_pages)
+        tr = self.engine.tracer
+        stream_id = uuid.uuid4().hex
+        page_tokens = self.engine.sc.kv_page_tokens
+        sendq: "_q.Queue" = _q.Queue(
+            maxsize=max(1, int(self.handoff_stream_window)))
+        push_err: list = []
+        stats = {"frames": 0, "bytes": 0, "push_s": 0.0}
+
+        def chunk_span(t0, attrs):
+            try:
+                tr.record("serving.kv_chunk", t0, tr.clock(),
+                          trace_id=trace_id, parent_id=span_id, attrs=attrs)
+            except Exception:  # noqa: BLE001 — tracing never fails the hop
+                log.exception("serving.kv_chunk span failed")
+
+        def push_span(t0, attrs):
+            try:
+                tr.record("serving.kv_push", t0, tr.clock(),
+                          trace_id=trace_id, parent_id=span_id, attrs=attrs)
+            except Exception:  # noqa: BLE001 — tracing never fails the hop
+                log.exception("serving.kv_push span failed")
+
+        def sender():
+            # ONE keep-alive connection for the whole stream: a fresh TCP
+            # (and in real fleets TLS/proxy) handshake per frame would
+            # serialize setup RTTs into the push leg — the very wire time
+            # the stream exists to hide. Any failure aborts the hop, so
+            # there is no reconnect path to maintain.
+            import http.client
+            parsed = urllib.parse.urlsplit(target)
+            path = parsed.path.rstrip("/") + "/kv_adopt_chunk"
+            conn = None
+            try:
+                while True:
+                    frag = sendq.get()
+                    if frag is None:
+                        return
+                    t0w, t0 = tr.clock(), self.mono()
+                    try:
+                        payload = b""
+                        if frag["sections"]:
+                            # host copy + pow2-padding trim happen HERE,
+                            # on the sender thread — never on the compute
+                            # thread (the export_handoff_stream fragment
+                            # contract)
+                            n = len(frag["tokens"]) // page_tokens
+                            sections = {
+                                name: np.asarray(a)[:, :n]
+                                for name, a in frag["sections"].items()}
+                            payload = serialize_pages(
+                                frag["tokens"], page_tokens, sections,
+                                model=self.engine.cfg.name)
+                        blob = serialize_chunk_frame(
+                            stream_id, frag["seq"], payload,
+                            final=frag["final"],
+                            total_tokens=frag.get("total_tokens"))
+                        if conn is None:
+                            import socket as _socket
+                            if parsed.scheme == "https":
+                                # a TLS-fronted decode replica must work
+                                # on the streamed path exactly like the
+                                # monolithic urllib push does
+                                conn = http.client.HTTPSConnection(
+                                    parsed.hostname, parsed.port or 443,
+                                    timeout=self.request_timeout_s)
+                            else:
+                                conn = http.client.HTTPConnection(
+                                    parsed.hostname, parsed.port or 80,
+                                    timeout=self.request_timeout_s)
+                            conn.connect()
+                            # headers and body go out as separate writes
+                            # (write-write-read): on a keep-alive
+                            # connection Nagle + delayed ACK turn that
+                            # into ~40ms per frame — disable Nagle
+                            conn.sock.setsockopt(_socket.IPPROTO_TCP,
+                                                 _socket.TCP_NODELAY, 1)
+                        conn.request(
+                            "POST", path, body=blob,
+                            headers={"Content-Type":
+                                     "application/octet-stream",
+                                     "traceparent": format_traceparent(
+                                         trace_id, span_id)})
+                        resp = conn.getresponse()
+                        reply = json.loads(resp.read() or b"{}")
+                        if resp.status != 200 or not reply.get("ok"):
+                            raise OSError(f"decode replica refused frame "
+                                          f"{frag['seq']}: {resp.status} "
+                                          f"{reply}")
+                        stats["frames"] += 1
+                        stats["bytes"] += len(blob)
+                        stats["push_s"] += self.mono() - t0
+                        self.engine.metrics.incr(
+                            "tpu_serving_kv_handoff_bytes", len(blob))
+                        push_span(t0w,
+                                  {"seq": frag["seq"],
+                                   "final": frag["final"],
+                                   "bytes": len(blob),
+                                   "pages": len(frag["tokens"])
+                                   // page_tokens})
+                    except Exception as e:  # noqa: BLE001 — any failure
+                        # = failed hop; emit sees push_err and aborts the
+                        # export, finish_sender lands the sentinel
+                        push_err.append(e)
+                        push_span(t0w, {"seq": frag["seq"], "ok": False,
+                                        "error": str(e)})
+                        return
+            finally:
+                if conn is not None:
+                    conn.close()
+
+        chunk_t0 = [tr.clock()]
+
+        def emit(frag):
+            chunk_span(chunk_t0[0],
+                       {"seq": frag["seq"], "final": frag["final"],
+                        "tokens": len(frag["tokens"]),
+                        "pages": len(frag["tokens"]) // page_tokens})
+            chunk_t0[0] = tr.clock()
+            while True:
+                if push_err:
+                    raise OSError(f"stream push failed: {push_err[0]}")
+                try:
+                    sendq.put(frag, timeout=0.1)
+                    return
+                except _q.Full:
+                    continue
+
+        thread = threading.Thread(target=sender, name="kv-handoff-sender",
+                                  daemon=True)
+
+        def finish_sender(abort: bool):
+            """Land the close sentinel UNCONDITIONALLY — a dropped
+            sentinel would strand the sender in get() forever and leak a
+            thread per failed hop. On abort, pending frames are stale:
+            drain them (the handler is the only producer and it has
+            stopped, so capacity for the sentinel is then guaranteed).
+            On success the sender must still push everything queued, so
+            wait for slots — falling back to the drain only if the
+            sender dies mid-flush."""
+            if not abort:
+                while not push_err:
+                    try:
+                        sendq.put(None, timeout=0.1)
+                        thread.join(timeout=self.request_timeout_s)
+                        return
+                    except _q.Full:
+                        continue
+            while True:
+                try:
+                    sendq.get_nowait()
+                except _q.Empty:
+                    break
+            sendq.put(None)
+            thread.join(timeout=self.request_timeout_s)
+
+        t_start = self.mono()
+        thread.start()
+        try:
+            out = self.engine.export_handoff_stream(tokens, emit)
+            compute_s = self.mono() - t_start
+        except Exception as e:  # noqa: BLE001 — export counts its failures
+            span(False, {"streamed": True, "tokens": len(tokens),
+                         "error": str(e)})
+            finish_sender(abort=True)
+            return self._send(502, {"ok": False, "error": str(e)})
+        finish_sender(abort=False)
+        wall_s = self.mono() - t_start
+        if thread.is_alive():
+            # the transfer outlived the request budget: the final frame's
+            # adoption is UNCONFIRMED — reporting ok here would record a
+            # successful handoff (and racy stats) while the decode side
+            # may never adopt. Fail the hop; the router falls back. The
+            # daemon sender drains to its sentinel and exits on its own.
+            push_err.append(OSError(
+                f"transfer outlived request_timeout_s="
+                f"{self.request_timeout_s}; adoption unconfirmed"))
+        if push_err:
+            self.engine.metrics.incr("tpu_serving_kv_handoff_failures")
+            span(False, {"streamed": True, "tokens": len(tokens),
+                         "chunks": out["chunks"],
+                         "error": str(push_err[0])})
+            return self._send(502, {"ok": False,
+                                    "error": str(push_err[0])})
+        # realized overlap: how much of the smaller leg (compute or
+        # transfer) actually hid behind the other — the "serial vs
+        # streamed" efficiency the bench sweep records
+        floor = min(compute_s, stats["push_s"])
+        overlap = max(0.0, compute_s + stats["push_s"] - wall_s)
+        overlap_ratio = round(min(1.0, overlap / floor), 3) if floor > 1e-9 \
+            else 0.0
+        span(True, {"streamed": True, "tokens": len(tokens),
+                    "pages": out["pages"], "chunks": out["chunks"],
+                    "bytes": stats["bytes"],
+                    "matched_tokens": out["matched_tokens"],
+                    "overlap_ratio": overlap_ratio})
+        return self._send(200, {
+            "ok": True, "streamed": True, "pages": out["pages"],
+            "bytes": stats["bytes"], "chunks": out["chunks"],
+            "covered_tokens": out["covered_tokens"],
+            "matched_tokens": out["matched_tokens"],
+            "overlap_ratio": overlap_ratio,
+            "compute_s": round(compute_s, 6),
+            "push_s": round(stats["push_s"], 6),
+            "wall_s": round(wall_s, 6)})
+
+    def _kv_adopt_chunk(self):
+        """Decode-side half of a STREAMED handoff: one chunk frame in,
+        buffered in strict order; the arena moves only when the final
+        frame closes a fully-valid stream (engine.adopt_handoff_chunk —
+        all-or-nothing). 400 on any rejection: the sender aborts the
+        stream and the router falls back."""
+        tr = self.engine.tracer
+        inbound = parse_traceparent(self.headers.get("traceparent"))
+        trace_id = inbound[0] if inbound else Tracer.new_trace_id()
+        parent = inbound[1] if inbound else ""
+        started = tr.clock()
+        length = int(self.headers.get("Content-Length") or 0)
+        blob = self.rfile.read(length) if length else b""
+
+        def span(ok: bool, attrs: dict):
+            try:
+                tr.record("serving.kv_adopt_chunk", started, tr.clock(),
+                          trace_id=trace_id, parent_id=parent,
+                          attrs={"ok": ok, **attrs})
+            except Exception:  # noqa: BLE001 — tracing never fails the hop
+                log.exception("serving.kv_adopt_chunk span failed")
+
+        try:
+            out = self.engine.adopt_handoff_chunk(blob)
+        except Exception as e:  # noqa: BLE001 — engine counts its failures
+            span(False, {"bytes": len(blob), "error": str(e)})
+            return self._send(400, {"ok": False, "error": str(e)})
+        span(True, {"bytes": len(blob), "seq": out.get("seq"),
+                    "final": out["final"],
+                    **({"pages": out["pages"]} if out["final"] else {})})
+        return self._send(200, out)
 
     def _kv_adopt(self):
         """Decode-side half: adopt a pushed KV page run into this
@@ -403,6 +681,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._kv_prefill()
         if self.path == "/kv_adopt":
             return self._kv_adopt()
+        if self.path == "/kv_adopt_chunk":
+            return self._kv_adopt_chunk()
         if self.path == "/drain":
             # graceful scale-down (fleet autoscaler contract): stop
             # admitting, finish in-flight. Idempotent; progress is
@@ -1065,7 +1345,7 @@ class BoundedThreadingHTTPServer(ThreadingHTTPServer):
 
 def serve(engine, port: int = 8000, request_timeout_s: float = 120.0,
           tokenizer=None, allow_adapters: bool = False,
-          max_connections: int = 128,
+          max_connections: int = 128, handoff_stream_window: int = 8,
           clock=time.time, mono=time.monotonic):
     # described here, not in the engine: the HTTP-layer shed counter belongs
     # to this server (the engine never sees the rejected connection)
@@ -1075,6 +1355,7 @@ def serve(engine, port: int = 8000, request_timeout_s: float = 120.0,
     handler = type("BoundHandler", (_Handler,),
                    {"engine": engine, "request_timeout_s": request_timeout_s,
                     "tokenizer": tokenizer, "allow_adapters": allow_adapters,
+                    "handoff_stream_window": handoff_stream_window,
                     "clock": staticmethod(clock), "mono": staticmethod(mono)})
     httpd = BoundedThreadingHTTPServer(("0.0.0.0", port), handler,
                                        max_connections=max_connections,
@@ -1183,6 +1464,22 @@ def main(argv=None) -> int:
                         "referenced zero-copy (default from config/"
                         "TPU_KV_PAGED_DECODE, auto — on whenever the "
                         "model/layout allows it)")
+    p.add_argument("--serving-chunk-tokens", type=int, default=None,
+                   dest="serving_chunk_tokens",
+                   help="chunked prefill: process prompts in chunks of "
+                        "this many tokens, interleaving decode steps "
+                        "between chunks (bounds co-resident streams' ITL "
+                        "under long prefills) and streaming each chunk's "
+                        "KV pages during disaggregated handoffs; 0 = "
+                        "monolithic (default from config/"
+                        "TPU_SERVING_CHUNK_TOKENS)")
+    p.add_argument("--handoff-stream-window", type=int, default=None,
+                   dest="handoff_stream_window",
+                   help="streamed handoff: max chunk frames queued "
+                        "between prefill compute and the push to the "
+                        "decode replica — the compute/transfer overlap "
+                        "window (default from config/"
+                        "TPU_HANDOFF_STREAM_WINDOW, 8)")
     p.add_argument("--serving-role", default=None, dest="serving_role",
                    choices=["unified", "prefill", "decode"],
                    help="disaggregated-serving pool this replica registers "
@@ -1233,6 +1530,12 @@ def main(argv=None) -> int:
                        if args.kv_paged_decode is None
                        else args.kv_paged_decode == "auto")
     serving_role = args.serving_role or base_cfg.serving_role
+    serving_chunk_tokens = (args.serving_chunk_tokens
+                            if args.serving_chunk_tokens is not None
+                            else base_cfg.serving_chunk_tokens)
+    handoff_stream_window = (args.handoff_stream_window
+                             if args.handoff_stream_window is not None
+                             else base_cfg.handoff_stream_window)
     cfg = MODEL_CONFIGS[args.model]()
     log.info("loading %s (%.2fB params) on %s", cfg.name,
              cfg.param_count / 1e9, jax.default_backend())
@@ -1316,6 +1619,7 @@ def main(argv=None) -> int:
         kv_pool_pages=kv_pool_pages,
         prefix_cache_enabled=prefix_cache_enabled,
         paged_decode=None if kv_paged_decode else False,
+        serving_chunk_tokens=serving_chunk_tokens,
         # text mode stops at the tokenizer's EOS instead of always burning
         # the full max_new_tokens budget
         eos_token=(tokenizer.eos_id if tokenizer is not None else -1)),
@@ -1326,7 +1630,8 @@ def main(argv=None) -> int:
         tracer=Tracer(export_path=args.trace_export)).start()
     httpd = serve(engine, args.port, tokenizer=tokenizer,
                   allow_adapters=args.dynamic_adapters,
-                  max_connections=args.max_connections)
+                  max_connections=args.max_connections,
+                  handoff_stream_window=handoff_stream_window)
     log.info("serving on :%d (POST /generate, GET /metrics)", args.port)
     reporter = None
     if args.fleet_router:

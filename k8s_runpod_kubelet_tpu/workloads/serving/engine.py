@@ -60,8 +60,8 @@ from .sampler import (_apply_penalties, _bias_row, _bump_counts,
                       _logit_modded, _penalized, _row_keys, _sample,
                       _set_count_row)
 from .scheduler import (ITL_BUCKETS, TTFT_BUCKETS, UTIL_BUCKETS,
-                        EngineDraining, EngineOverloaded, Request,
-                        ServingConfig, _fail_future, _Slot)
+                        ChunkArbiter, EngineDraining, EngineOverloaded,
+                        Request, ServingConfig, _fail_future, _Slot)
 
 log = logging.getLogger(__name__)
 
@@ -107,6 +107,17 @@ class ServingEngine:
         if sc.kv_pool_pages < 0:
             raise ValueError(f"kv_pool_pages must be >= 0 (0 = auto), "
                              f"got {sc.kv_pool_pages}")
+        if sc.serving_chunk_tokens < 0:
+            raise ValueError(f"serving_chunk_tokens must be >= 0 (0 = "
+                             f"monolithic), got {sc.serving_chunk_tokens}")
+        # chunked prefill (ISSUE 10): prompts process in chunks of this
+        # many tokens, yielding one decode step to the engine loop between
+        # chunks (ChunkArbiter) — capped at max_prefill_len (the largest
+        # compile bucket a chunk can ride)
+        self._chunk_tokens = min(sc.serving_chunk_tokens,
+                                 sc.max_prefill_len) \
+            if sc.serving_chunk_tokens else 0
+        self._arbiter = ChunkArbiter()
         if mesh is not None:
             from ...parallel.mesh import AXES
             ep = mesh.shape.get(AXES.EXPERT, 1)
@@ -177,32 +188,36 @@ class ServingEngine:
             queue.Queue(maxsize=sc.slots)
         self._slots = [_Slot() for _ in range(sc.slots)]
         self._ring_len = self._pick_ring_len(cfg, sc)
-        # -- paged decode loop eligibility (ISSUE 9) -----------------------
+        # -- paged decode loop eligibility (ISSUE 9; layouts lifted by
+        # ISSUE 10) --------------------------------------------------------
         # the decode hot loop runs on per-slot page tables over the shared
         # arena (paged_decode_step) whenever the layout allows it: plain
-        # dense K/V only (paged_decode_step covers no MLA / sliding-window
-        # / int8-KV), single host (the paged step is not yet shard_mapped
-        # over ``tensor``), no adapters or speculation (the paged kernel
-        # takes neither), prefix cache on (the arena IS the slot storage),
-        # and — under an EXPLICIT kv_pool_pages — a pool big enough to hold
-        # every slot's full residency (a smaller pool would reject
-        # admissions under load; auto sizing below always suffices).
+        # dense K/V, int8-KV (dequant-in-kernel paged attention, scales
+        # paged alongside) and MLA latent arenas all qualify — the int8
+        # LATENT combination and sliding windows do not — on a single host
+        # (the paged step is not yet shard_mapped over ``tensor``), with no
+        # adapters or speculation (the paged kernel takes neither), prefix
+        # cache on (the arena IS the slot storage), and — under an EXPLICIT
+        # kv_pool_pages — a pool big enough to hold every slot's full
+        # residency (a smaller pool would reject admissions under load;
+        # auto sizing below always suffices).
         t = sc.kv_page_tokens
         slot_pages = -(-sc.cache_len // t)  # ceil: pages one full slot needs
         pageable = (sc.prefix_cache_enabled and self._ring_len is None
                     and t < sc.cache_len)
-        eligible = (pageable and not cfg.is_mla
-                    and cfg.sliding_window is None
-                    and not sc.quantize_kv_int8 and sc.speculate_k == 0
+        eligible = (pageable and cfg.sliding_window is None
+                    and not (cfg.is_mla and sc.quantize_kv_int8)
+                    and sc.speculate_k == 0
                     and sc.lora_rank == 0 and mesh is None
                     and (sc.kv_pool_pages == 0
                          or sc.kv_pool_pages >= sc.slots * slot_pages))
         if sc.paged_decode is True and not eligible:
             raise ValueError(
-                "paged_decode=True needs a plain dense K/V layout (no "
-                "MLA/sliding-window/int8-KV), no mesh, no adapters, no "
-                "speculation, prefix_cache_enabled, kv_page_tokens < "
-                "cache_len, and kv_pool_pages 0 (auto) or >= slots * "
+                "paged_decode=True needs a full-attention KV layout (plain "
+                "dense, int8-KV, or MLA — no sliding window, no int8 "
+                "LATENT cache), no mesh, no adapters, no speculation, "
+                "prefix_cache_enabled, kv_page_tokens < cache_len, and "
+                "kv_pool_pages 0 (auto) or >= slots * "
                 f"ceil(cache_len / kv_page_tokens) = "
                 f"{sc.slots * slot_pages}")
         self._paged_loop = eligible and sc.paged_decode is not False
@@ -229,6 +244,11 @@ class ServingEngine:
         # sampled inflight count aliases to zero (hops last ~100ms,
         # heartbeats sample every ~2s — most samples would see idle)
         self.handoffs_total = 0
+        # streaming handoff (ISSUE 10): strict-order chunk-frame assembly
+        # on the decode side, built lazily (needs the arena's section
+        # spec). Fed under _handoff_lock; pages land in the arena only
+        # when a whole stream checks out.
+        self._stream_assembler = None
         self._kv_store: Optional[PagedKVStore] = None
         self._dense_prefixes: Optional[DensePrefixStore] = None
         if pageable:
@@ -268,6 +288,12 @@ class ServingEngine:
         self.metrics.incr("tpu_serving_kv_handoff_pages", 0)
         self.metrics.incr("tpu_serving_kv_handoff_bytes", 0)
         self.metrics.incr("tpu_serving_kv_handoff_failures", 0)
+        self.metrics.incr("tpu_serving_kv_handoff_stream_frames", 0)
+        self.metrics.incr("tpu_serving_kv_handoff_stream_rejects", 0)
+        # chunked-prefill series (dashboards divide interleaved steps by
+        # chunks for the ITL-protection ratio)
+        self.metrics.incr("tpu_serving_prefill_chunks", 0)
+        self.metrics.incr("tpu_serving_chunk_interleaved_steps", 0)
         self._update_page_gauges()
         # per-slot sampling state: (request seed, draws so far) -> PRNG key
         self._slot_seed = np.zeros((sc.slots,), np.uint32)
@@ -340,10 +366,11 @@ class ServingEngine:
                                1 if self._paged_loop else 0)
         self._verify = (jax.jit(self.model.verify_step, donate_argnums=donate)
                         if sc.speculate_k > 0 else None)
-        # the prefill thread's verify is NOT donated: a prefix-cache hit
-        # starts chunked appends from a gathered/stored cache, which must
-        # survive for future hits
-        self._verify_fn = jax.jit(self.model.verify_step)
+        # the prefill thread's per-chunk step (prefill_chunk_step: verify
+        # kernel + traced index advance) is NOT donated: a prefix-cache
+        # hit starts chunked appends from a gathered/stored cache, which
+        # must survive for future hits
+        self._chunk_step = jax.jit(self.model.prefill_chunk_step)
         if self._verify is not None:
             # zero-seed so acceptance-rate dashboards see the series from
             # pod start, not first acceptance
@@ -418,6 +445,19 @@ class ServingEngine:
                    "KV handoffs that failed (serialization, validation, "
                    "or adoption) — the router falls back to a full "
                    "prefill on the target")
+        m.describe("tpu_serving_kv_handoff_stream_frames",
+                   "streamed-handoff chunk frames moved (sender counts "
+                   "pushed frames, receiver counts accepted)")
+        m.describe("tpu_serving_kv_handoff_stream_rejects",
+                   "chunk frames rejected on the decode side (torn/"
+                   "duplicate/reordered/stale stream) — the whole stream "
+                   "drops, nothing is adopted")
+        m.describe("tpu_serving_prefill_chunks",
+                   "prompt chunks processed by chunked prefill "
+                   "(serving_chunk_tokens > 0)")
+        m.describe("tpu_serving_chunk_interleaved_steps",
+                   "decode steps the engine ran BETWEEN prefill chunks "
+                   "(the co-resident ITL protection chunking exists for)")
         m.describe("tpu_serving_spec_proposed",
                    "speculative draft tokens proposed")
         m.describe("tpu_serving_spec_accepted",
@@ -877,6 +917,9 @@ class ServingEngine:
                         self._stop.wait(0.002)
                     continue
                 self._decode_once()
+                # wake chunked prefills waiting their one-step turn
+                # (ChunkArbiter contract; a no-waiter notify is ~free)
+                self._arbiter.decode_step_done()
             except Exception as exc:  # noqa: BLE001 — engine must survive bad steps
                 # Fail everything in flight so no caller hangs, then keep
                 # serving: one poisoned request must not be a permanent outage.
@@ -952,22 +995,47 @@ class ServingEngine:
         return min(b, self.sc.max_prefill_len)
 
     def _append_chunks(self, single: Params, toks: list[int], last_logits,
-                       adapter_id: int = 0, adapters: Optional[dict] = None):
-        """Append ``toks`` to a single-request cache in max_prefill_len
-        chunks through the verify kernel (each chunk's padding KV lands
+                       adapter_id: int = 0, adapters: Optional[dict] = None,
+                       on_chunk=None, done: int = 0):
+        """Append ``toks`` to a single-request cache chunk by chunk
+        through ``prefill_chunk_step`` (each chunk's padding KV lands
         beyond the committed index, so it is never attended and is later
-        overwritten — the decode-path invariant). Returns (logits, cache).
+        overwritten — the decode-path invariant). Chunk size is
+        ``serving_chunk_tokens`` when chunked prefill is on (yielding one
+        decode step to the engine loop between chunks via the
+        ChunkArbiter — the co-resident ITL protection), else
+        ``max_prefill_len``. Returns (logits, cache).
+
         ``adapters`` is the caller's SNAPSHOT of the adapter tree, so one
-        request never mixes weights across a concurrent re-registration."""
+        request never mixes weights across a concurrent re-registration.
+        ``on_chunk(single, done_total)`` fires after every chunk with the
+        cumulative token count committed (``done`` counts tokens already
+        in the cache before this call) — the streaming-handoff hook."""
         ad_ids = self._single_ad_ids(adapter_id)
-        for start in range(0, len(toks), self.sc.max_prefill_len):
-            chunk = toks[start:start + self.sc.max_prefill_len]
-            ctoks, _ = self._padded(chunk)
-            logits_k, single = self._verify_fn(self.params, ctoks, single,
-                                               None, adapters, ad_ids)
-            single = dict(single)
-            single["index"] = single["index"] + len(chunk)
-            last_logits = logits_k[:, len(chunk) - 1]
+        step = self._chunk_tokens or self.sc.max_prefill_len
+        for start in range(0, len(toks), step):
+            chunk = toks[start:start + step]
+            ctoks, true_len = self._padded(chunk)
+            last_logits, single = self._chunk_step(
+                self.params, ctoks, single, true_len, adapters, ad_ids)
+            done += len(chunk)
+            # on_chunk BEFORE the yield: the streaming hook hands this
+            # chunk's pages to the sender, whose push should ride under
+            # the decode step (and the next chunk) — yielding first would
+            # hold every frame back one ITL and erode the very overlap
+            # the stream exists for
+            if on_chunk is not None:
+                on_chunk(single, done)
+            if self._chunk_tokens:
+                self.metrics.incr("tpu_serving_prefill_chunks")
+                if start + step < len(toks):
+                    # between chunks only — the final chunk's successor is
+                    # this request's own first decode step
+                    ran = self._arbiter.yield_for_decode(
+                        lambda: self.active_slots > 0)
+                    if ran:
+                        self.metrics.incr(
+                            "tpu_serving_chunk_interleaved_steps", ran)
         return last_logits, single
 
     def _single_ad_ids(self, adapter_id: int):
@@ -976,17 +1044,30 @@ class ServingEngine:
         return jnp.asarray([adapter_id], jnp.int32)
 
     def _prefill_raw(self, tokens: list[int], adapter_id: int,
-                     adapters) -> tuple[Any, Params]:
+                     adapters, on_chunk=None) -> tuple[Any, Params]:
         """Prefill WITHOUT prefix-cache consultation: head through the
-        bucketed prefill jit, remainder chunked through the verify kernel."""
+        bucketed prefill jit, remainder chunked through the verify
+        kernel. With chunked prefill on, the head is one chunk too — even
+        the first dispatch stays small enough to interleave behind."""
         single = self._fresh_cache(1)
-        head = tokens[:self.sc.max_prefill_len]
+        head = tokens[:self._chunk_tokens or self.sc.max_prefill_len]
         prompt, true_len = self._padded(head)
         last_logits, single = self._prefill(
             self.params, prompt, single, true_len, adapters,
             self._single_ad_ids(adapter_id))
+        if on_chunk is not None:
+            on_chunk(single, len(head))
+        if self._chunk_tokens:
+            self.metrics.incr("tpu_serving_prefill_chunks")
+            if len(tokens) > len(head):
+                ran = self._arbiter.yield_for_decode(
+                    lambda: self.active_slots > 0)
+                if ran:
+                    self.metrics.incr("tpu_serving_chunk_interleaved_steps",
+                                      ran)
         return self._append_chunks(single, tokens[len(head):], last_logits,
-                                   adapter_id, adapters)
+                                   adapter_id, adapters, on_chunk=on_chunk,
+                                   done=len(head))
 
     def embed(self, tokens: list[int]) -> list[float]:
         """Mean-pooled final-norm hidden state of the prompt — the
@@ -1374,6 +1455,226 @@ class ServingEngine:
         return {"pages": header["n_pages"], "added": added,
                 "tokens": len(header["tokens"]), "bytes": len(blob),
                 "evicted": evicted}
+
+    # -- streaming chunked handoff (ISSUE 10) ----------------------------------
+
+    def export_handoff_stream(self, tokens: list[int], emit) -> dict:
+        """Streaming half of a handoff: run ``tokens`` through the
+        CHUNKED prefill path, inserting each completed chunk's full pages
+        into this arena as a page run and handing them to ``emit`` while
+        the next chunk is still computing — the caller's sender thread
+        serializes and pushes frames, so two-hop TTFT approaches
+        max(compute, transfer) instead of their sum.
+
+        ``emit(fragment)`` fires in strict order with {"seq", "final",
+        "tokens", "sections"} — sections are FRESH DEVICE copies padded
+        to a pow2 page bucket (PagedKVStore.export_run), valid across
+        later arena donations: the consumer thread does the host copy and
+        trims to ``len(tokens) // kv_page_tokens`` pages, so compute
+        never stalls on the sync; the closing fragment carries empty
+        sections and ``total_tokens``. A raising emit aborts the export
+        (the hop fails loudly; the router falls back). Pages the trie
+        already holds stream FIRST — a prefix hit's pages move with zero
+        recompute. Eviction racing the stream degrades cleanly: the
+        stream closes with the contiguous prefix it could export (a
+        partial handoff is valid, exactly like the monolithic path's).
+
+        Needs chunked prefill on (serving_chunk_tokens > 0) — without
+        chunks there is nothing to overlap; callers use export_handoff.
+
+        Returns {"pages", "chunks", "covered_tokens", "matched_tokens"}.
+        """
+        from ...fleet.handoff import HandoffError
+        if self._kv_store is None:
+            raise HandoffError("this replica has no paged KV arena "
+                               "(ring/mixed layout or prefix cache "
+                               "disabled) — it cannot hand off KV")
+        if not self._chunk_tokens:
+            raise HandoffError("streamed handoff needs chunked prefill "
+                               "(serving_chunk_tokens > 0); use "
+                               "export_handoff")
+        tokens = list(tokens)
+        if not tokens:
+            raise ValueError("empty prompt")
+        if len(tokens) > self.sc.cache_len - 1:
+            raise ValueError(f"prompt length {len(tokens)} > cache budget "
+                             f"{self.sc.cache_len - 1}")
+        t = self.sc.kv_page_tokens
+        total_pages = len(tokens) // t
+        if total_pages == 0:
+            raise HandoffError(
+                f"no full pages to hand off for a {len(tokens)}-token "
+                f"prompt at page size {t}")
+        started = self._perf()
+        with self._handoff_lock:
+            self.handoff_inflight += 1
+        state = {"seq": 0, "sent": 0, "stopped": False}
+
+        def flush(done: int):
+            """Export pages [sent, done // t) — the contiguous prefix the
+            trie still holds. ONE store reference per flush (crash
+            recovery may rebind _kv_store; releasing against the captured
+            store is always safe — a discarded store drops wholesale)."""
+            if state["stopped"]:
+                return
+            want = min(done // t, total_pages)
+            if want <= state["sent"]:
+                return
+            with self._prefix_lock:
+                store = self._kv_store
+                m = store.match_full(0, tokens[:done])
+                take = min(want, m.matched_tokens // t)
+                if take <= state["sent"]:
+                    # eviction raced the stream: close with what we sent
+                    store.release(m.pages)
+                    state["stopped"] = True
+                    return
+                frags = store.export_run(m.pages[state["sent"]:take])
+                # export_run returns FRESH device copies (pow2-padded)
+                # valid across later arena donations, and the refs only
+                # guard the DISPATCH (its contract) — so release here and
+                # ship the device arrays: the consumer thread does the
+                # host copy + padding trim, keeping that sync OFF the
+                # compute thread. Copying here would serialize transfer
+                # back into compute — the very stall the stream exists to
+                # hide.
+                store.release(m.pages)
+            emit({"seq": state["seq"], "final": False,
+                  "tokens": tokens[state["sent"] * t:take * t],
+                  "sections": frags})
+            state["seq"] += 1
+            state["sent"] = take
+            if take < want:
+                state["stopped"] = True
+
+        matched0 = 0
+        try:
+            adapters = self._adapters  # one snapshot, like _prefill_tokens
+            with self._prefix_lock:
+                store = self._kv_store
+                m = store.match(0, tokens)
+                single = None
+                if m.pages:
+                    try:
+                        single = store.gather(m.pages, self._fresh_cache(1))
+                    finally:
+                        store.release(m.pages)
+            covered = m.matched_tokens if single is not None else 0
+            matched0 = covered
+            if single is not None:
+                self.metrics.incr("tpu_serving_prefix_cache_hits")
+            else:
+                self.metrics.incr("tpu_serving_prefix_cache_misses")
+            flush(covered)  # already-cached pages move before any compute
+
+            def on_chunk(sgl, done):
+                # cache admission per chunk: the chunk's completed full
+                # pages land in the arena as a page run, then stream out.
+                # Best-effort like the monolithic insert — a failure
+                # closes the stream short, never fails the prefill.
+                try:
+                    with self._prefix_lock:
+                        _, evicted = self._kv_store.insert(
+                            0, tokens[:done], sgl)
+                    if evicted:
+                        self.metrics.incr(
+                            "tpu_serving_prefix_cache_evictions", evicted)
+                except Exception:  # noqa: BLE001 — caching is best-effort
+                    log.exception("chunk insert failed; handoff stream "
+                                  "closes short")
+                flush(done)
+
+            if single is None:
+                self._prefill_raw(tokens, 0, adapters, on_chunk=on_chunk)
+            else:
+                self._append_chunks(single, tokens[covered:], None, 0,
+                                    adapters, on_chunk=on_chunk,
+                                    done=covered)
+            flush(len(tokens))
+            if state["sent"] == 0:
+                raise HandoffError("no pages survived to hand off (the "
+                                   "pool evicted the stream as it was "
+                                   "computed)")
+            data_frames = state["seq"]
+            emit({"seq": state["seq"], "final": True, "tokens": [],
+                  "sections": {}, "total_tokens": state["sent"] * t})
+            state["seq"] += 1
+        except Exception:
+            self.metrics.incr("tpu_serving_kv_handoff_failures")
+            raise
+        finally:
+            with self._handoff_lock:
+                self.handoff_inflight -= 1
+        with self._handoff_lock:
+            self.handoffs_total += 1
+        self.metrics.incr("tpu_serving_kv_handoff_pages", state["sent"])
+        self.metrics.incr("tpu_serving_kv_handoff_stream_frames",
+                          state["seq"])
+        self._update_page_gauges()
+        # the hop IS this prefill replica's TTFT contribution (see
+        # export_handoff)
+        self.metrics.observe("tpu_serving_ttft_seconds",
+                             self._perf() - started)
+        # "chunks" counts DATA frames — the number an operator correlates
+        # with tpu_serving_prefill_chunks and the timeline's page rows;
+        # "frames" includes the empty close frame (what actually moved)
+        return {"pages": state["sent"], "chunks": data_frames,
+                "frames": state["seq"],
+                "covered_tokens": state["sent"] * t,
+                "matched_tokens": matched0}
+
+    def adopt_handoff_chunk(self, blob: bytes) -> dict:
+        """Decode-role half of a STREAMED handoff: one sequence-numbered
+        chunk frame in. Frames buffer HOST-side in strict order
+        (fleet/handoff.HandoffStreamAssembler); the arena — and every
+        counter — moves ONLY when the final frame lands and the whole
+        stream checks out: all-or-nothing page accounting, so a torn,
+        duplicate, reordered or stale stream drops whole and the arena
+        stays exactly as it was. Returns {"ok": True, "final": False}
+        mid-stream, adoption stats on the final frame."""
+        from ...fleet.handoff import HandoffError, HandoffStreamAssembler
+        try:
+            if self._kv_store is None:
+                raise HandoffError("this replica has no paged KV arena "
+                                   "(ring/mixed layout or prefix cache "
+                                   "disabled) — it cannot adopt KV")
+            with self._handoff_lock:
+                if self._stream_assembler is None:
+                    with self._prefix_lock:
+                        spec = self._kv_store.section_spec()
+                    self._stream_assembler = HandoffStreamAssembler(
+                        expect_page_tokens=self.sc.kv_page_tokens,
+                        expect_sections=spec, expect_model=self.cfg.name,
+                        clock=self._perf)
+                try:
+                    done = self._stream_assembler.feed(blob)
+                except HandoffError:
+                    self.metrics.incr(
+                        "tpu_serving_kv_handoff_stream_rejects")
+                    raise
+            self.metrics.incr("tpu_serving_kv_handoff_stream_frames")
+            if not done["final"]:
+                return {"ok": True, "final": False, "seq": done["seq"]}
+            if len(done["tokens"]) > self.sc.cache_len:
+                raise HandoffError(
+                    f"stream spans {len(done['tokens'])} tokens, over "
+                    f"this replica's cache budget {self.sc.cache_len}")
+            with self._prefix_lock:
+                added, evicted = self._kv_store.adopt(
+                    0, done["tokens"], done["sections"])
+        except Exception:
+            self.metrics.incr("tpu_serving_kv_handoff_failures")
+            raise
+        n_pages = len(done["tokens"]) // self.sc.kv_page_tokens
+        self.metrics.incr("tpu_serving_kv_handoff_pages", n_pages)
+        self.metrics.incr("tpu_serving_kv_handoff_bytes", done["bytes"])
+        if evicted:
+            self.metrics.incr("tpu_serving_prefix_cache_evictions", evicted)
+        self._update_page_gauges()
+        return {"ok": True, "final": True, "seq": done["seq"],
+                "pages": n_pages, "added": added,
+                "tokens": len(done["tokens"]), "bytes": done["bytes"],
+                "frames": done["frames"], "evicted": evicted}
 
     def _prefill_loop(self):
         """Dedicated prefill worker: drains the request queue, runs the

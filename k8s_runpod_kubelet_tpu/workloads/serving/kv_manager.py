@@ -462,6 +462,22 @@ def _build_write(t: int):
     return write
 
 
+def _build_export():
+    """One jitted gather over ALL sections for the streaming export path:
+    a per-chunk flush calling eager per-section gathers would pay ~ms of
+    dispatch per section per chunk — at streaming granularity that
+    overhead would eat the very overlap the stream exists to create.
+    Callers pad ``ids`` to a power-of-two bucket (compile O(log)
+    variants) and slice the padding off after their host copy."""
+    import jax
+
+    @jax.jit
+    def export(arena, ids):
+        return {name: a[:, ids] for name, a in arena.items()}
+
+    return export
+
+
 def _build_fill(t: int):
     """``_build_write`` with a T-token pad on the source: a slot's tail
     fill copies ceil(remaining / T) pages from a single-request cache, and
@@ -534,6 +550,7 @@ class PagedKVStore:
         self._gather = _build_gather(page_tokens)
         self._write = _build_write(page_tokens)
         self._fill = _build_fill(page_tokens)
+        self._export = _build_export()
 
     @property
     def page_bytes(self) -> int:
@@ -602,6 +619,19 @@ class PagedKVStore:
         import jax.numpy as jnp
         ids = jnp.asarray(pages, jnp.int32)
         return {name: a[:, ids] for name, a in self.arena.items()}
+
+    def export_run(self, pages: list) -> dict:
+        """``export_pages`` for the STREAMING path: one jitted dispatch
+        over all sections per call (a per-chunk flush cannot afford eager
+        per-section gathers), page list padded to a pow2 compile bucket
+        by repeating the first id. Returns PADDED fresh device arrays —
+        callers slice ``[:, :n]`` after their host copy (numpy slicing is
+        free; a device-side trim would be one more dispatch). Same
+        lifetime contract as export_pages."""
+        import jax.numpy as jnp
+        bucket = 1 << max(0, (len(pages) - 1).bit_length())
+        padded = list(pages) + [pages[0]] * (bucket - len(pages))
+        return self._export(self.arena, jnp.asarray(padded, jnp.int32))
 
     def adopt(self, adapter_id: int, tokens: list, sections: dict
               ) -> tuple[int, int]:

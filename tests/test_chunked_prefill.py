@@ -1,0 +1,296 @@
+"""Chunked prefill + engine-level streamed handoff (ISSUE 10).
+
+What this file pins:
+
+- chunked prefill is TOKEN-IDENTICAL to monolithic (greedy and seeded
+  sampling, prefix-cache hits included) — chunking is a scheduling
+  change, never a math change;
+- the ITL-protection regression: a long prompt admitted next to an
+  active decode stream keeps that stream's worst inter-token gap bounded
+  with chunking ON (decode steps interleave between chunks — counted by
+  tpu_serving_chunk_interleaved_steps), and the monolithic engine
+  reproduces the spike chunking removes;
+- export_handoff_stream -> adopt_handoff_chunk between REAL engines:
+  adopted pages decode token-identically, frames arrive in strict order,
+  a mid-stream sender death (emit raising after k frames) fails the
+  export loudly, adopts NOTHING on the decode side, and leaks zero pages
+  on either arena — the engine half of the chunk-stream kill soak.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from k8s_runpod_kubelet_tpu.fleet.handoff import (HandoffError,
+                                                  serialize_chunk_frame,
+                                                  serialize_pages)
+from k8s_runpod_kubelet_tpu.models import init_params, tiny_llama
+from k8s_runpod_kubelet_tpu.workloads.serving import (ServingConfig,
+                                                      ServingEngine)
+from k8s_runpod_kubelet_tpu.workloads.serving.scheduler import ChunkArbiter
+
+# ML tier: jax compiles dominate runtime; excluded by -m 'not slow'
+pytestmark = pytest.mark.slow
+
+CFG = tiny_llama(vocab_size=128, embed_dim=64, n_layers=2, n_heads=4,
+                 n_kv_heads=2, mlp_dim=128, max_seq_len=512,
+                 dtype=jnp.float32, param_dtype=jnp.float32)
+SEED = 20260804
+T = 8  # kv_page_tokens
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _engine(params, chunk: int, **kw) -> ServingEngine:
+    sc = ServingConfig(slots=4, max_prefill_len=32, cache_len=256,
+                       max_new_tokens=16, kv_page_tokens=T,
+                       serving_chunk_tokens=chunk, **kw)
+    return ServingEngine(CFG, params, sc).start()
+
+
+def _prompt(n: int, salt: int) -> list:
+    return [((j * 7 + salt * 131) % 120) + 1 for j in range(n)]
+
+
+def _stream_frames(engine, tokens, sink, stream="s", fail_after=None):
+    """Drive export_handoff_stream, serializing each fragment into a real
+    chunk frame and handing it to ``sink(blob)`` synchronously (strict
+    order by construction). ``fail_after``: emit raises after that many
+    fragments — the mid-stream sender death."""
+    n_emitted = [0]
+
+    def emit(frag):
+        if fail_after is not None and n_emitted[0] >= fail_after:
+            raise OSError("injected mid-stream death")
+        n_emitted[0] += 1
+        payload = b""
+        if frag["sections"]:
+            n = len(frag["tokens"]) // T
+            sections = {name: np.asarray(a)[:, :n]
+                        for name, a in frag["sections"].items()}
+            payload = serialize_pages(frag["tokens"], T, sections,
+                                      model=CFG.name)
+        sink(serialize_chunk_frame(stream, frag["seq"], payload,
+                                   final=frag["final"],
+                                   total_tokens=frag.get("total_tokens")))
+
+    return engine.export_handoff_stream(tokens, emit)
+
+
+def _assert_no_leaks(engine, what: str):
+    stats = engine.prefix_cache_stats()
+    assert stats["pages_free"] + stats["nodes"] == stats["pages_total"], \
+        f"[seed={SEED}] {what}: leaked pages — {stats}"
+    store = engine._kv_store
+    for node in store.trie._nodes.values():
+        assert store.pool.refcount(node.page) == 1, \
+            f"[seed={SEED}] {what}: dangling reference on page {node.page}"
+
+
+class TestChunkedTokenIdentity:
+    def test_chunked_equals_monolithic(self, params):
+        """Greedy and seeded-sampled outputs are byte-identical across
+        chunk sizes — including prompts that hit the prefix cache and
+        prompts spanning several max_prefill_len buckets."""
+        rng = np.random.default_rng(SEED)
+        e_mono = _engine(params, chunk=0)
+        e_c8 = _engine(params, chunk=8)
+        e_c20 = _engine(params, chunk=20)  # deliberately page-misaligned
+        engines = [e_mono, e_c8, e_c20]
+        try:
+            shared = _prompt(96, salt=1)
+            for e in engines:
+                e.register_prefix(shared)
+            prompts = [shared + [1, 2, 3],          # prefix hit + tail
+                       _prompt(100, salt=2),        # long miss
+                       _prompt(5, salt=3),          # under one chunk
+                       shared[:40] + [9, 9]]        # partial-prefix hit
+            for i in range(6):
+                prompts.append(_prompt(int(rng.integers(3, 120)),
+                                       salt=10 + i))
+            for i, p in enumerate(prompts):
+                kw = dict(max_new_tokens=10)
+                if i % 3 == 2:
+                    kw.update(temperature=0.9, seed=1000 + i)
+                outs = [e.submit(p, **kw).result(timeout=300)
+                        for e in engines]
+                assert outs[0]["tokens"] == outs[1]["tokens"] \
+                    == outs[2]["tokens"], \
+                    f"[seed={SEED}] prompt {i}: chunked != monolithic"
+            assert e_c8.metrics.get_counter(
+                "tpu_serving_prefill_chunks") > 0
+        finally:
+            for e in engines:
+                e.stop()
+
+
+class TestItlUnderLongPrefill:
+    def _drive(self, params, chunk: int) -> tuple[list, float]:
+        """One engine: start a decode stream, admit a long prompt while
+        it decodes, return (stream's inter-token gaps, interleaved-step
+        count)."""
+        e = _engine(params, chunk=chunk)
+        try:
+            # warm every jit (prefill buckets + chunk steps + decode) so
+            # measured gaps are work, not compilation
+            e.submit(_prompt(100, salt=99), max_new_tokens=2).result(
+                timeout=300)
+            gaps, last = [], [None]
+
+            def on_token(_t):
+                import time
+                now = time.perf_counter()
+                if last[0] is not None:
+                    gaps.append(now - last[0])
+                last[0] = now
+
+            stream = e.submit(_prompt(6, salt=5), max_new_tokens=60,
+                              on_token=on_token)
+            while len(gaps) < 3:     # genuinely mid-decode
+                import time
+                time.sleep(0.002)
+            e.submit(_prompt(100, salt=7), max_new_tokens=2).result(
+                timeout=300)
+            stream.result(timeout=300)
+            return gaps, e.metrics.get_counter(
+                "tpu_serving_chunk_interleaved_steps")
+        finally:
+            e.stop()
+
+    def test_chunked_bounds_the_spike_monolithic_reproduces(self, params):
+        gaps_c, interleaved = self._drive(params, chunk=8)
+        gaps_m, _ = self._drive(params, chunk=0)
+        assert interleaved > 0, \
+            f"[seed={SEED}] no decode steps interleaved between chunks"
+        # the structural claim: with chunking the engine decoded BETWEEN
+        # chunks, so the stream's worst gap is bounded by ~a chunk, not
+        # the whole prefill; the monolithic engine's worst gap contains
+        # the full 100-token prefill. Compare the two (comparative, not
+        # absolute — CI boxes are noisy).
+        assert max(gaps_c) < max(gaps_m), \
+            (f"[seed={SEED}] chunked max gap {max(gaps_c):.4f}s not below "
+             f"monolithic {max(gaps_m):.4f}s (interleaved={interleaved})")
+
+
+class TestStreamedHandoffBetweenEngines:
+    def test_stream_adopts_and_decodes_identically(self, params):
+        e_pre = _engine(params, chunk=8)
+        e_dec = _engine(params, chunk=0)
+        try:
+            prompt = _prompt(100, salt=21)
+            frames: list = []
+            out = _stream_frames(e_pre, prompt, frames.append)
+            assert out["pages"] == len(prompt) // T
+            assert out["frames"] == len(frames)
+            assert out["chunks"] == len(frames) - 1 >= 3
+            res = None
+            for blob in frames:
+                res = e_dec.adopt_handoff_chunk(blob)
+            assert res["final"] and res["pages"] == out["pages"]
+            # counters moved only at the final adoption
+            assert e_dec.metrics.get_counter(
+                "tpu_serving_kv_handoff_pages") == out["pages"]
+            a = e_pre.submit(prompt, max_new_tokens=8).result(timeout=300)
+            b = e_dec.submit(prompt, max_new_tokens=8).result(timeout=300)
+            assert a["tokens"] == b["tokens"], \
+                f"[seed={SEED}] adopted KV decoded differently"
+            assert e_dec.metrics.get_counter(
+                "tpu_serving_prefix_cache_hits") == 1
+            _assert_no_leaks(e_pre, "prefill arena")
+            _assert_no_leaks(e_dec, "decode arena")
+        finally:
+            e_pre.stop()
+            e_dec.stop()
+
+    def test_mid_stream_death_adopts_nothing_and_leaks_nothing(self,
+                                                               params):
+        e_pre = _engine(params, chunk=8)
+        e_dec = _engine(params, chunk=0)
+        try:
+            prompt = _prompt(100, salt=22)
+            frames: list = []
+            fails0 = e_pre.metrics.get_counter(
+                "tpu_serving_kv_handoff_failures")
+            with pytest.raises(OSError, match="injected"):
+                _stream_frames(e_pre, prompt, frames.append, fail_after=2)
+            assert e_pre.metrics.get_counter(
+                "tpu_serving_kv_handoff_failures") == fails0 + 1
+            # the decode side got a PARTIAL stream: frames buffer but the
+            # final frame never arrives — nothing touches the arena, and
+            # the half-open stream expires instead of pinning memory
+            free0 = e_dec.prefix_cache_stats()["pages_free"]
+            for blob in frames:
+                e_dec.adopt_handoff_chunk(blob)
+            assert e_dec.prefix_cache_stats()["pages_free"] == free0, \
+                f"[seed={SEED}] partial stream touched the arena"
+            assert e_dec.metrics.get_counter(
+                "tpu_serving_kv_handoff_pages") == 0
+            # a later stream with the same id must not resume the corpse:
+            # the stream id is fresh per hop, and a stale-seq frame is
+            # rejected outright
+            with pytest.raises(HandoffError, match="duplicate|reordered"):
+                e_dec.adopt_handoff_chunk(frames[-1])
+            assert e_dec.metrics.get_counter(
+                "tpu_serving_kv_handoff_stream_rejects") >= 1
+            _assert_no_leaks(e_pre, "prefill arena after kill")
+            _assert_no_leaks(e_dec, "decode arena after kill")
+            # both engines still serve (the fallback request completes)
+            out = e_dec.submit(prompt, max_new_tokens=4).result(timeout=300)
+            assert len(out["tokens"]) == 4
+        finally:
+            e_pre.stop()
+            e_dec.stop()
+
+    def test_streamed_requires_chunked_prefill(self, params):
+        e = _engine(params, chunk=0)
+        try:
+            with pytest.raises(HandoffError, match="chunked prefill"):
+                e.export_handoff_stream(_prompt(40, salt=1), lambda f: None)
+        finally:
+            e.stop()
+
+
+class TestChunkArbiter:
+    """Host-only arbitration contract (no jax in these assertions)."""
+
+    def test_idle_yield_is_free(self):
+        arb = ChunkArbiter()
+        assert arb.yield_for_decode(lambda: False) == 0
+
+    def test_yield_waits_for_a_step(self):
+        arb = ChunkArbiter()
+        ran = []
+
+        def prefiller():
+            ran.append(arb.yield_for_decode(lambda: True, timeout_s=5.0))
+
+        th = threading.Thread(target=prefiller)
+        th.start()
+        import time
+        time.sleep(0.05)
+        assert not ran, "yield returned before any decode step"
+        arb.decode_step_done()
+        th.join(timeout=5.0)
+        assert ran == [1]
+
+    def test_yield_unblocks_when_slots_empty(self):
+        arb = ChunkArbiter()
+        active = [True]
+        ran = []
+
+        def prefiller():
+            ran.append(arb.yield_for_decode(lambda: active[0],
+                                            timeout_s=0.2))
+
+        th = threading.Thread(target=prefiller)
+        th.start()
+        active[0] = False   # last slot completed without a step
+        th.join(timeout=5.0)
+        assert ran == [0]

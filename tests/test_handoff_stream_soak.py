@@ -1,0 +1,519 @@
+"""Streamed chunked-handoff soak (ISSUE 10 acceptance): real router +
+registry over localhost HTTP, role replicas with REAL paged arenas and a
+REAL HandoffStreamAssembler on the decode side — the prefill replica
+"computes" deterministic KV chunk by chunk and pushes sequence-numbered
+chunk frames (real codec) to /kv_adopt_chunk while later chunks compute.
+
+What it pins:
+
+- a streamed two-hop lands bit-identical KV on the decode arena, frame
+  by frame, adopted ONLY when the final frame closes the stream; the
+  fleet.handoff span carries streamed/chunks/overlap_ratio and the
+  per-chunk serving.kv_chunk / serving.kv_push / serving.kv_adopt_chunk
+  spans join the same trace;
+- a seeded FaultPlan kills the prefill replica MID-STREAM (k frames
+  sent, then the process is gone): the decode side's partial buffer
+  never touches its arena (all-or-nothing), expires via TTL instead of
+  pinning host memory, the router records a FAILED handoff, and the SAME
+  request completes via the unified pool — zero hangs, zero client 5xx;
+- torn / duplicate / reordered / stale frames fired at /kv_adopt_chunk
+  are each rejected with nothing adopted;
+- zero leaked pages on BOTH arenas at the end (partial streams
+  included), and tools/fleet_summary.py renders the chunk timeline and
+  the two-hop overlap column from the exported JSONL.
+
+The seed is embedded in every assertion message for replay.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from k8s_runpod_kubelet_tpu.cloud.faults import (PREEMPTION_STORM, FaultPlan,
+                                                 FaultWindow)
+from k8s_runpod_kubelet_tpu.fleet.handoff import (HandoffError,
+                                                  HandoffStreamAssembler,
+                                                  serialize_chunk_frame,
+                                                  serialize_pages)
+from k8s_runpod_kubelet_tpu.fleet.registry import ReplicaRegistry
+from k8s_runpod_kubelet_tpu.fleet.router import (FleetRouter, RouterConfig,
+                                                 serve_router)
+from k8s_runpod_kubelet_tpu.metrics import Metrics
+from k8s_runpod_kubelet_tpu.tracing import (Tracer, format_traceparent,
+                                            parse_traceparent)
+from k8s_runpod_kubelet_tpu.workloads.serving.kv_manager import PagedKVStore
+
+from harness import FakeClock
+
+SEED = 31
+T = 8                     # page_tokens
+CHUNK_PAGES = 1           # one full page per streamed chunk frame
+CACHE_LEN = 64
+N_PAGES = 32
+KILL_WINDOW = FaultWindow(PREEMPTION_STORM, 6.0, 10.0, 1.0)
+KILL_AFTER_FRAMES = 2     # frames that escape before the replica dies
+
+
+def _ctx(what: str, plan=None) -> str:
+    msg = f"[stream-soak seed={SEED}] {what}"
+    if plan is not None:
+        msg += "\n" + plan.describe()
+    return msg
+
+
+def _kv_value(token: int, pos: int, head: int, dim: int) -> float:
+    return float(token) + pos / 100.0 + head / 10.0 + dim / 1000.0
+
+
+def _expected_pages(tokens: list) -> np.ndarray:
+    n = len(tokens) // T
+    out = np.zeros((1, n, T, 2, 4), np.float32)
+    for p in range(n):
+        for o in range(T):
+            pos = p * T + o
+            for h in range(2):
+                for d in range(4):
+                    out[0, p, o, h, d] = _kv_value(tokens[pos], pos, h, d)
+    return out
+
+
+def _seq_cache(tokens: list) -> np.ndarray:
+    out = np.zeros((1, 1, CACHE_LEN, 2, 4), np.float32)
+    for pos, tok in enumerate(tokens):
+        for h in range(2):
+            for d in range(4):
+                out[0, 0, pos, h, d] = _kv_value(tok, pos, h, d)
+    return out
+
+
+def _make_store() -> PagedKVStore:
+    def factory():
+        return {"k": jnp.zeros((1, 1, CACHE_LEN, 2, 4), jnp.float32),
+                "v": jnp.zeros((1, 1, CACHE_LEN, 2, 4), jnp.float32),
+                "index": jnp.zeros((1,), jnp.int32)}
+    return PagedKVStore(N_PAGES, T, factory)
+
+
+class StreamReplica:
+    """Role replica with a real paged arena. Prefill streams chunk
+    frames; decode assembles them strictly in order (real assembler) and
+    adopts only complete streams."""
+
+    def __init__(self, replica_id: str, role: str, tracer: Tracer,
+                 clock: FakeClock):
+        self.replica_id = replica_id
+        self.role = role
+        self.tracer = tracer
+        self.clock = clock
+        self.store = _make_store()
+        self.lock = threading.Lock()
+        self.generated = 0
+        self.adopted_runs: list = []
+        self.frame_rejects = 0
+        self.handoff_failures = 0
+        self.die_mid_stream = False
+        self._stream_seq = 0
+        self.assembler = HandoffStreamAssembler(
+            expect_page_tokens=T,
+            expect_sections=self.store.section_spec(),
+            clock=clock, ttl_s=20.0)
+        self.stats = {"free_slots": 4, "active_slots": 0, "max_slots": 4,
+                      "queue_depth": 0, "draining": False}
+        rep = self
+
+        class _H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _json(self, status, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _read(self) -> bytes:
+                length = int(self.headers.get("Content-Length") or 0)
+                return self.rfile.read(length) if length else b""
+
+            def do_POST(self):
+                if self.path == "/kv_prefill":
+                    return rep._kv_prefill(self)
+                if self.path == "/kv_adopt_chunk":
+                    return rep._kv_adopt_chunk(self)
+                body = json.loads(self._read() or b"{}")
+                inbound = parse_traceparent(self.headers.get("traceparent"))
+                now = rep.tracer.clock()
+                rep.tracer.record(
+                    "serving.request", now, now,
+                    trace_id=inbound[0] if inbound else None,
+                    parent_id=inbound[1] if inbound else "",
+                    attrs={"replica_id": rep.replica_id})
+                with rep.lock:
+                    rep.generated += 1
+                return self._json(200, {"tokens": [1, 2, 3],
+                                        "replica_id": rep.replica_id})
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), _H)
+        self._httpd.daemon_threads = True
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self._httpd.server_address[1]}"
+
+    # -- prefill half: chunked compute + frame stream --------------------------
+
+    def _kv_prefill(self, h):
+        req = json.loads(h._read() or b"{}")
+        tokens = list(req.get("request", {}).get("tokens") or [])
+        target = req.get("handoff_to", "")
+        inbound = parse_traceparent(h.headers.get("traceparent"))
+        trace_id = inbound[0] if inbound else Tracer.new_trace_id()
+        span_id = Tracer.new_span_id()
+        now = self.tracer.clock()
+        self.tracer.record("serving.kv_prefill", now, now,
+                           trace_id=trace_id, span_id=span_id,
+                           parent_id=inbound[1] if inbound else "",
+                           attrs={"replica_id": self.replica_id,
+                                  "streamed": True,
+                                  "tokens": len(tokens)})
+        with self.lock:
+            self._stream_seq += 1
+            stream_id = f"{self.replica_id}-s{self._stream_seq}"
+        total_pages = len(tokens) // T
+        sent = 0
+        seq = 0
+        nbytes = 0
+        try:
+            while sent < total_pages:
+                take = min(sent + CHUNK_PAGES, total_pages)
+                chunk_tokens = tokens[:take * T]
+                # "compute" this chunk: its KV lands in the arena as a
+                # page run (the chunked-prefill insert), then exports
+                single = {"k": jnp.asarray(_seq_cache(chunk_tokens)),
+                          "v": jnp.asarray(_seq_cache(chunk_tokens)),
+                          "index": jnp.asarray([len(chunk_tokens)],
+                                               jnp.int32)}
+                with self.lock:
+                    self.store.insert(0, chunk_tokens, single)
+                    m = self.store.match_full(0, chunk_tokens)
+                    frags = self.store.export_run(m.pages[sent:take])
+                    self.store.release(m.pages)
+                n = take - sent
+                sections = {name: np.asarray(a)[:, :n]
+                            for name, a in frags.items()}
+                payload = serialize_pages(tokens[sent * T:take * T], T,
+                                          sections)
+                frame = serialize_chunk_frame(stream_id, seq, payload)
+                now = self.tracer.clock()
+                self.tracer.record("serving.kv_chunk", now, now,
+                                   trace_id=trace_id, parent_id=span_id,
+                                   attrs={"seq": seq, "pages": n,
+                                          "final": False})
+                if self.die_mid_stream and seq >= KILL_AFTER_FRAMES:
+                    # the seeded kill: frames 0..k-1 reached the decode
+                    # replica, the rest never will — process gone,
+                    # /kv_prefill reply socket included
+                    self.handoff_failures += 1
+                    self.kill()
+                    try:
+                        h.connection.close()
+                    except OSError:
+                        pass
+                    return None
+                self._push(target, frame, trace_id, span_id, seq, False)
+                nbytes += len(frame)
+                sent, seq = take, seq + 1
+            final = serialize_chunk_frame(stream_id, seq, b"", final=True,
+                                          total_tokens=sent * T)
+            adopted = self._push(target, final, trace_id, span_id, seq,
+                                 True)
+            nbytes += len(final)
+            if not adopted.get("ok"):
+                raise OSError(f"final frame refused: {adopted}")
+        except OSError as e:
+            self.handoff_failures += 1
+            return h._json(502, {"ok": False, "error": str(e)})
+        return h._json(200, {"ok": True, "streamed": True,
+                             "pages": sent, "chunks": seq,
+                             "bytes": nbytes, "overlap_ratio": 0.5,
+                             "covered_tokens": sent * T,
+                             "matched_tokens": 0})
+
+    def _push(self, target: str, frame: bytes, trace_id: str,
+              span_id: str, seq: int, final: bool) -> dict:
+        now = self.tracer.clock()
+        push = urllib.request.Request(
+            target.rstrip("/") + "/kv_adopt_chunk", data=frame,
+            headers={"Content-Type": "application/octet-stream",
+                     "traceparent": format_traceparent(trace_id, span_id)},
+            method="POST")
+        with urllib.request.urlopen(push, timeout=5) as resp:
+            out = json.loads(resp.read() or b"{}")
+        self.tracer.record("serving.kv_push", now, self.tracer.clock(),
+                           trace_id=trace_id, parent_id=span_id,
+                           attrs={"seq": seq, "final": final,
+                                  "bytes": len(frame)})
+        if not out.get("ok"):
+            raise OSError(f"frame {seq} refused: {out}")
+        return out
+
+    # -- decode half: strict-order assembly, all-or-nothing adoption -----------
+
+    def _kv_adopt_chunk(self, h):
+        blob = h._read()
+        inbound = parse_traceparent(h.headers.get("traceparent"))
+        now = self.tracer.clock()
+
+        def span(ok, attrs):
+            self.tracer.record(
+                "serving.kv_adopt_chunk", now, now,
+                trace_id=inbound[0] if inbound else None,
+                parent_id=inbound[1] if inbound else "",
+                attrs={"replica_id": self.replica_id, "ok": ok, **attrs})
+
+        try:
+            with self.lock:
+                done = self.assembler.feed(blob)
+                if done["final"]:
+                    self.store.adopt(0, done["tokens"], done["sections"])
+                    self.adopted_runs.append(list(done["tokens"]))
+        except HandoffError as e:
+            self.frame_rejects += 1
+            span(False, {"error": str(e)})
+            return h._json(400, {"ok": False, "error": str(e)})
+        span(True, {"seq": done["seq"], "final": done["final"]})
+        return h._json(200, {"ok": True, **{k: v for k, v in done.items()
+                                            if k in ("final", "seq")}})
+
+    def heartbeat_payload(self) -> dict:
+        stats = dict(self.stats)
+        if self.role == "decode":
+            s = self.store.stats()
+            stats["kv_pages_free"] = s["pages_free"]
+            stats["kv_pages_total"] = s["pages_total"]
+        return {"replica_id": self.replica_id, "stats": stats}
+
+    def assert_no_leaks(self, plan):
+        s = self.store.stats()
+        assert s["pages_free"] + s["nodes"] == s["pages_total"], _ctx(
+            f"{self.replica_id}: leaked pages — free {s['pages_free']} + "
+            f"trie {s['nodes']} != total {s['pages_total']}", plan)
+        for node in self.store.trie._nodes.values():
+            assert self.store.pool.refcount(node.page) == 1, _ctx(
+                f"{self.replica_id}: dangling reference on page "
+                f"{node.page}", plan)
+
+    def kill(self):
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except OSError:
+            pass
+
+
+def test_chunk_stream_soak_tier1(tmp_path):
+    clock = FakeClock()
+    metrics = Metrics()
+    tracer = Tracer(export_path=str(tmp_path / "spans.jsonl"), clock=clock)
+    registry = ReplicaRegistry(metrics=metrics, tracer=tracer, clock=clock,
+                               heartbeat_timeout_s=8.0,
+                               breaker_failure_threshold=3,
+                               breaker_reset_s=60.0)
+    router = FleetRouter(
+        registry, RouterConfig(max_attempts=3, request_timeout_s=10.0,
+                               handoff_timeout_s=10.0),
+        metrics=metrics, tracer=tracer, clock=clock)
+    httpd = serve_router(router, port=0)
+    port = httpd.server_address[1]
+    plan = FaultPlan(SEED, clock, horizon_s=30.0, windows=[KILL_WINDOW])
+
+    def post(path, payload, headers=None):
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=15)
+        try:
+            c.request("POST", path, body=json.dumps(payload).encode(),
+                      headers={"Content-Type": "application/json",
+                               **(headers or {})})
+            r = c.getresponse()
+            body = r.read()
+            return r.status, (json.loads(body) if body else {})
+        finally:
+            c.close()
+
+    reps = {rid: StreamReplica(rid, role, tracer, clock)
+            for rid, role in (("pf-0", "prefill"), ("dc-0", "decode"),
+                              ("un-0", "unified"))}
+    killed: set = set()
+    try:
+        for rid, rep in reps.items():
+            status, out = post("/fleet/register",
+                               {"replica_id": rid, "base_url": rep.url,
+                                "role": rep.role})
+            assert status == 200 and out["role"] == rep.role, \
+                _ctx(f"register {rid} -> {status} {out}")
+
+        prompt = [((i * 13) % 90) + 1 for i in range(27)]   # 3 full pages
+        outcomes = []
+        probe = ("d" * 32, "b7ad6b7169203331")
+        for tick in range(12):
+            clock.advance(1.0)
+            t = tick + 1
+            for rid, rep in reps.items():
+                if rid not in killed:
+                    st, out = post("/fleet/heartbeat",
+                                   rep.heartbeat_payload())
+                    assert st == 200, _ctx(f"heartbeat {rid}: {st} {out}")
+            victims = plan.preempt_victims(
+                sorted(r for r in reps if reps[r].role == "prefill"
+                       and r not in killed))
+            if victims:
+                reps[victims[0]].die_mid_stream = True
+                killed.add(victims[0])
+            registry.sweep()
+            hdr = {}
+            if t == 2:
+                hdr = {"traceparent": f"00-{probe[0]}-{probe[1]}-01"}
+            status, out = post("/generate",
+                               {"tokens": [t] + prompt[1:],
+                                "max_new_tokens": 4}, headers=hdr)
+            outcomes.append((t, status, out.get("replica_id")))
+            assert status == 200, _ctx(f"t={t} -> {status} {out}", plan)
+
+        # -- 1. zero drops; pre-kill requests streamed to the decode pool ----
+        assert all(st == 200 for _, st, _ in outcomes), \
+            _ctx(f"non-200: {outcomes}", plan)
+        pre_kill = [rid for t, _, rid in outcomes if t < KILL_WINDOW.start]
+        assert set(pre_kill) == {"dc-0"}, \
+            _ctx(f"streamed two-hop not decoded by the decode pool: "
+                 f"{outcomes}", plan)
+
+        # -- 2. adopted streams are COMPLETE and bit-identical ---------------
+        assert reps["dc-0"].adopted_runs, _ctx("no stream adopted", plan)
+        assert all(len(r) == 24 for r in reps["dc-0"].adopted_runs), \
+            _ctx(f"partial adoption: "
+                 f"{[len(r) for r in reps['dc-0'].adopted_runs]}", plan)
+        run = reps["dc-0"].adopted_runs[0]
+        m = reps["dc-0"].store.match_full(0, run)
+        try:
+            got = np.asarray(reps["dc-0"].store.export_pages(m.pages)["k"])
+        finally:
+            reps["dc-0"].store.release(m.pages)
+        np.testing.assert_allclose(got, _expected_pages(run), rtol=0,
+                                   atol=0, err_msg=_ctx(
+                                       "streamed KV != prefill KV", plan))
+        ok_handoffs = [s for s in tracer.recent(4096)
+                       if s["name"] == "fleet.handoff" and s["attrs"]["ok"]]
+        assert ok_handoffs and all(
+            s["attrs"]["streamed"] and s["attrs"]["chunks"] == 3
+            for s in ok_handoffs), \
+            _ctx("fleet.handoff spans missing streamed/chunks", plan)
+
+        # -- 3. the mid-stream kill: failed handoff, fallback 200, nothing
+        # adopted from the torn stream, buffer expired --------------------------
+        assert killed and reps["pf-0"].handoff_failures >= 1, \
+            _ctx("prefill never died mid-stream", plan)
+        post_kill = [rid for t, _, rid in outcomes
+                     if t >= KILL_WINDOW.start]
+        assert "un-0" in post_kill, \
+            _ctx(f"no fallback to the unified pool: {outcomes}", plan)
+        assert metrics.get_counter("tpu_fleet_handoffs",
+                                   labels={"outcome": "failed"}) >= 1, \
+            _ctx("failed handoff not counted", plan)
+        # the partial stream buffered mid-kill expires (TTL is 20s; the
+        # soak advanced 12): advance past it and feed any frame to GC
+        assert len(reps["dc-0"].assembler) <= 1, \
+            _ctx("more than the killed stream buffered", plan)
+        clock.advance(25.0)
+        with pytest.raises(HandoffError):
+            reps["dc-0"].assembler.feed(b"garbage")
+        assert len(reps["dc-0"].assembler) == 0, \
+            _ctx("killed stream's buffer never expired", plan)
+
+        # -- 4. torn/duplicate/reordered/stale frames all reject -------------
+        dc = reps["dc-0"]
+        rejects0 = dc.frame_rejects
+        adopted0 = len(dc.adopted_runs)
+        chunk_tokens = [((i * 7) % 80) + 1 for i in range(T)]
+        single = {"k": jnp.asarray(_seq_cache(chunk_tokens)),
+                  "v": jnp.asarray(_seq_cache(chunk_tokens)),
+                  "index": jnp.asarray([T], jnp.int32)}
+        src = _make_store()
+        src.insert(0, chunk_tokens, single)
+        mm = src.match_full(0, chunk_tokens)
+        payload = serialize_pages(
+            chunk_tokens, T,
+            {n: np.asarray(a) for n, a in src.export_pages(mm.pages).items()})
+        src.release(mm.pages)
+
+        def push_raw(frame) -> int:
+            c = http.client.HTTPConnection(
+                dc.url.replace("http://", "").split(":")[0],
+                int(dc.url.rsplit(":", 1)[1]), timeout=5)
+            try:
+                c.request("POST", "/kv_adopt_chunk", body=frame)
+                return c.getresponse().status
+            finally:
+                c.close()
+
+        ok_f = serialize_chunk_frame("probe", 0, payload)
+        assert push_raw(ok_f) == 200
+        assert push_raw(ok_f[:len(ok_f) // 2]) == 400          # torn
+        assert push_raw(serialize_chunk_frame("probe", 0, payload)) == 400
+        # the duplicate DROPPED the stream; restart and test reorder
+        assert push_raw(serialize_chunk_frame("probe", 0, payload)) == 200
+        assert push_raw(serialize_chunk_frame("probe", 2, payload)) == 400
+        assert push_raw(serialize_chunk_frame("ghost", 5, payload)) == 400
+        assert dc.frame_rejects == rejects0 + 4, \
+            _ctx(f"rejects {dc.frame_rejects} != {rejects0} + 4", plan)
+        assert len(dc.adopted_runs) == adopted0, \
+            _ctx("a rejected frame adopted pages", plan)
+
+        # -- 5. zero leaked pages on BOTH arenas -----------------------------
+        reps["pf-0"].assert_no_leaks(plan)
+        reps["dc-0"].assert_no_leaks(plan)
+
+        # -- 6. one trace joins router + both engines' chunk spans -----------
+        spans = [s for s in tracer.get_trace(probe[0])]
+        names = {s["name"] for s in spans}
+        want = {"fleet.route", "fleet.handoff", "serving.kv_prefill",
+                "serving.kv_chunk", "serving.kv_push",
+                "serving.kv_adopt_chunk", "serving.request"}
+        assert want <= names, _ctx(f"trace {probe[0]}: {sorted(names)}",
+                                   plan)
+        seqs = sorted((s["attrs"] or {}).get("seq") for s in spans
+                      if s["name"] == "serving.kv_adopt_chunk")
+        assert seqs == [0, 1, 2, 3], \
+            _ctx(f"adopt-chunk seqs out of order: {seqs}", plan)
+
+        # -- 7. the exported JSONL renders the chunk timeline ----------------
+        tracer.close()
+        import pathlib
+        import sys
+        sys.path.insert(0, str(pathlib.Path(__file__).parent.parent
+                               / "tools"))
+        import fleet_summary
+        spans_l, _snaps = fleet_summary.load(str(tmp_path / "spans.jsonl"))
+        out_text = fleet_summary.render(spans_l, [])
+        assert "streamed-handoff chunk timelines" in out_text, \
+            _ctx(f"chunk timeline missing:\n{out_text}", plan)
+        assert "chunks=3 overlap=50%" in out_text, \
+            _ctx(f"overlap column missing:\n{out_text}", plan)
+        assert "FAILED" in out_text, \
+            _ctx("failed streamed handoff missing from timeline", plan)
+    finally:
+        tracer.close()
+        httpd.shutdown()
+        for rep in reps.values():
+            rep.kill()

@@ -223,3 +223,203 @@ class TestDeserializeRejections:
                     + blob[len(MAGIC) + 4 + hlen:])
         with pytest.raises(HandoffError, match="declared"):
             deserialize_pages(doctored)
+
+
+# -- streaming chunk frames + strict-order assembly (ISSUE 10) ----------------
+
+from k8s_runpod_kubelet_tpu.fleet.handoff import (  # noqa: E402
+    CHUNK_MAGIC, CHUNK_VERSION, HandoffStreamAssembler, parse_chunk_frame,
+    serialize_chunk_frame)
+
+
+def _frame(stream: str, seq: int, n_pages: int, *, final=False,
+           total=None, start_page: int = 0, model: str = "") -> bytes:
+    """One chunk frame whose payload is a fresh page-run blob; page VALUES
+    keyed by (stream, start_page) so cross-frame mixups break equality."""
+    payload = b""
+    if n_pages:
+        rng = np.random.default_rng(hash((stream, start_page)) % (2**32))
+        shape = (2, n_pages, T, 2, 4)
+        sections = {"k": rng.standard_normal(shape).astype(np.float32),
+                    "v": rng.standard_normal(shape).astype(np.float32)}
+        tokens = [(start_page * T + i) % 120 + 1 for i in range(n_pages * T)]
+        payload = serialize_pages(tokens, T, sections, model=model)
+    return serialize_chunk_frame(stream, seq, payload, final=final,
+                                 total_tokens=total)
+
+
+def _assembler(clock=None, **kw) -> HandoffStreamAssembler:
+    spec = _spec(_plain_sections(1))
+    kw.setdefault("expect_page_tokens", T)
+    kw.setdefault("expect_sections", spec)
+    if clock is not None:
+        kw["clock"] = clock
+    return HandoffStreamAssembler(**kw)
+
+
+class TestChunkFrameCodec:
+    def test_round_trip(self):
+        blob = _frame("s1", 3, 2)
+        header, payload = parse_chunk_frame(blob)
+        assert header["stream"] == "s1" and header["seq"] == 3
+        assert not header["final"]
+        hdr, sections = deserialize_pages(payload)
+        assert hdr["n_pages"] == 2
+
+    def test_final_requires_total_tokens(self):
+        with pytest.raises(HandoffError, match="total_tokens"):
+            serialize_chunk_frame("s", 1, b"", final=True)
+
+    def test_whole_run_blob_is_not_a_frame(self):
+        """The two magics must never cross paths silently."""
+        blob = serialize_pages(_tokens(1), T, _plain_sections(1))
+        with pytest.raises(HandoffError, match="magic"):
+            parse_chunk_frame(blob)
+        assert blob[:len(MAGIC)] != CHUNK_MAGIC
+
+    def test_torn_frame_rejected_at_every_boundary(self):
+        blob = _frame("s1", 0, 2)
+        for cut in (0, 3, len(CHUNK_MAGIC) + 2, len(CHUNK_MAGIC) + 8,
+                    len(blob) // 2, len(blob) - 1):
+            with pytest.raises(HandoffError):
+                parse_chunk_frame(blob[:cut])
+
+    def test_foreign_version_rejected(self):
+        blob = _frame("s1", 0, 1)
+        hlen = int.from_bytes(
+            blob[len(CHUNK_MAGIC):len(CHUNK_MAGIC) + 4], "big")
+        header = json.loads(
+            blob[len(CHUNK_MAGIC) + 4:len(CHUNK_MAGIC) + 4 + hlen])
+        header["version"] = CHUNK_VERSION + 1
+        raw = json.dumps(header).encode()
+        doctored = (CHUNK_MAGIC + len(raw).to_bytes(4, "big") + raw
+                    + blob[len(CHUNK_MAGIC) + 4 + hlen:])
+        with pytest.raises(HandoffError, match="version"):
+            parse_chunk_frame(doctored)
+
+    def test_payload_length_drift_rejected(self):
+        blob = _frame("s1", 0, 1)
+        with pytest.raises(HandoffError, match="torn"):
+            parse_chunk_frame(blob + b"\x00")
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestStreamAssembler:
+    def test_in_order_stream_assembles_bit_identical(self):
+        asm = _assembler()
+        out = asm.feed(_frame("s1", 0, 2, start_page=0))
+        assert out == {"final": False, "seq": 0}
+        out = asm.feed(_frame("s1", 1, 3, start_page=2))
+        assert out == {"final": False, "seq": 1}
+        out = asm.feed(serialize_chunk_frame("s1", 2, b"", final=True,
+                                             total_tokens=5 * T))
+        assert out["final"] and len(out["tokens"]) == 5 * T
+        assert out["frames"] == 3
+        assert out["sections"]["k"].shape == (2, 5, T, 2, 4)
+        # the concat preserves frame payloads exactly
+        rng = np.random.default_rng(hash(("s1", 0)) % (2**32))
+        np.testing.assert_array_equal(
+            out["sections"]["k"][:, :2],
+            rng.standard_normal((2, 2, T, 2, 4)).astype(np.float32))
+        assert len(asm) == 0  # stream closed and forgotten
+
+    def test_interleaved_streams_keep_their_lanes(self):
+        asm = _assembler()
+        asm.feed(_frame("a", 0, 1, start_page=0))
+        asm.feed(_frame("b", 0, 2, start_page=0))
+        asm.feed(_frame("a", 1, 1, start_page=1))
+        out_b = asm.feed(serialize_chunk_frame("b", 1, b"", final=True,
+                                               total_tokens=2 * T))
+        out_a = asm.feed(serialize_chunk_frame("a", 2, b"", final=True,
+                                               total_tokens=2 * T))
+        assert out_a["final"] and out_b["final"]
+        assert out_a["sections"]["k"].shape[1] == 2
+        assert out_b["sections"]["k"].shape[1] == 2
+
+    def test_duplicate_seq_drops_stream(self):
+        asm = _assembler()
+        asm.feed(_frame("s1", 0, 1))
+        asm.feed(_frame("s1", 1, 1, start_page=1))
+        with pytest.raises(HandoffError, match="duplicate"):
+            asm.feed(_frame("s1", 1, 1, start_page=1))
+        assert len(asm) == 0
+        # nothing may resurrect the dropped stream mid-sequence
+        with pytest.raises(HandoffError, match="stale"):
+            asm.feed(_frame("s1", 2, 1, start_page=2))
+
+    def test_reordered_frame_drops_stream(self):
+        asm = _assembler()
+        asm.feed(_frame("s1", 0, 1))
+        with pytest.raises(HandoffError, match="reordered|lost"):
+            asm.feed(_frame("s1", 2, 1, start_page=2))
+        assert len(asm) == 0
+
+    def test_stale_stream_rejected(self):
+        """A frame for a stream this side never opened (seq > 0 first) is
+        a stale sender — rejected without state."""
+        asm = _assembler()
+        with pytest.raises(HandoffError, match="stale"):
+            asm.feed(_frame("ghost", 3, 1))
+        assert len(asm) == 0
+
+    def test_torn_stream_total_mismatch(self):
+        """Every frame valid but the final total disagrees: the stream
+        lost a frame somewhere — all-or-nothing means nothing adopts."""
+        asm = _assembler()
+        asm.feed(_frame("s1", 0, 1))
+        with pytest.raises(HandoffError, match="torn"):
+            asm.feed(serialize_chunk_frame("s1", 1, b"", final=True,
+                                           total_tokens=5 * T))
+        assert len(asm) == 0
+
+    def test_bad_payload_drops_stream(self):
+        asm = _assembler()
+        asm.feed(_frame("s1", 0, 1))
+        good = _frame("s1", 1, 1, start_page=1)
+        hlen = int.from_bytes(
+            good[len(CHUNK_MAGIC):len(CHUNK_MAGIC) + 4], "big")
+        header = json.loads(
+            good[len(CHUNK_MAGIC) + 4:len(CHUNK_MAGIC) + 4 + hlen])
+        payload = good[len(CHUNK_MAGIC) + 4 + hlen:]
+        torn = payload[:-3]
+        header["payload_bytes"] = len(torn)
+        raw = json.dumps(header).encode()
+        with pytest.raises(HandoffError):
+            asm.feed(CHUNK_MAGIC + len(raw).to_bytes(4, "big") + raw + torn)
+        assert len(asm) == 0
+
+    def test_empty_stream_rejected(self):
+        asm = _assembler()
+        with pytest.raises(HandoffError, match="no pages"):
+            asm.feed(serialize_chunk_frame("s1", 0, b"", final=True,
+                                           total_tokens=0))
+
+    def test_model_mismatch_rejected_per_frame(self):
+        asm = _assembler(expect_model="llama3-8b")
+        with pytest.raises(HandoffError, match="model mismatch"):
+            asm.feed(_frame("s1", 0, 1, model="llama3.1-8b"))
+
+    def test_idle_streams_expire(self):
+        clock = _Clock()
+        asm = _assembler(clock=clock, ttl_s=10.0)
+        asm.feed(_frame("s1", 0, 1))
+        clock.t = 11.0
+        # GC runs on the next feed; the expired stream is then stale
+        asm.feed(_frame("s2", 0, 1))
+        assert len(asm) == 1
+        with pytest.raises(HandoffError, match="stale"):
+            asm.feed(_frame("s1", 1, 1, start_page=1))
+
+    def test_max_streams_bounded(self):
+        asm = _assembler(max_streams=2)
+        asm.feed(_frame("a", 0, 1))
+        asm.feed(_frame("b", 0, 1))
+        with pytest.raises(HandoffError, match="too many"):
+            asm.feed(_frame("c", 0, 1))

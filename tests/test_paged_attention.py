@@ -232,3 +232,206 @@ class TestPagedDecodeStep:
         model = LlamaModel(wcfg)
         with pytest.raises(ValueError, match="paged decode"):
             model.init_paged_arena(4, 4)
+
+
+# -- int8-KV + MLA paged variants (ISSUE 10) ----------------------------------
+
+from k8s_runpod_kubelet_tpu.models import tiny_mla  # noqa: E402
+from k8s_runpod_kubelet_tpu.ops.attention import (  # noqa: E402
+    paged_attention_mla, paged_attention_quant)
+
+
+def _quant_pages(rng, hkv, d, t, n_pages):
+    k = rng.integers(-127, 128, (n_pages, t, hkv, d)).astype(np.int8)
+    v = rng.integers(-127, 128, (n_pages, t, hkv, d)).astype(np.int8)
+    ks = (rng.random((n_pages, t, hkv)).astype(np.float32) * 0.01 + 1e-3)
+    vs = (rng.random((n_pages, t, hkv)).astype(np.float32) * 0.01 + 1e-3)
+    return map(jnp.asarray, (k, v, ks, vs))
+
+
+class TestPagedAttentionQuantParity:
+    def test_reference_equals_dequantized_plain(self):
+        """int8 pages + scales through the quant reference must equal the
+        PLAIN paged reference over the dequantized pages — the kernel is
+        a layout/bandwidth change, not new math."""
+        rng = np.random.default_rng(10)
+        b, hq, hkv, d, t, n = 3, 8, 2, 128, 8, 4
+        k, v, ks, vs = _quant_pages(rng, hkv, d, t, 16)
+        pt = jnp.asarray(rng.permutation(16)[:b * n].reshape(b, n),
+                         jnp.int32)
+        q = jnp.asarray(rng.normal(size=(b, hq, d)), jnp.float32)
+        lengths = jnp.asarray([5, 17, 32], jnp.int32)
+        out = paged_attention_quant(q, k, v, ks, vs, pt, lengths,
+                                    use_pallas=False)
+        plain = paged_attention(q, k.astype(jnp.float32) * ks[..., None],
+                                v.astype(jnp.float32) * vs[..., None],
+                                pt, lengths, use_pallas=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(plain),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_pallas_kernel_matches_reference(self):
+        """interpret=True runs the EXACT dequant-in-kernel code on CPU
+        (iota-masked per-head scale select included)."""
+        rng = np.random.default_rng(11)
+        b, hq, hkv, d, t, n = 2, 16, 4, 128, 8, 6
+        k, v, ks, vs = _quant_pages(rng, hkv, d, t, 12)
+        pt = jnp.asarray(rng.permutation(12)[:b * n].reshape(b, n),
+                         jnp.int32)
+        q = jnp.asarray(rng.normal(size=(b, hq, d)), jnp.float32)
+        for lengths in ([1, 48], [7, 9], [48, 33]):
+            lengths = jnp.asarray(lengths, jnp.int32)
+            ref = paged_attention_quant(q, k, v, ks, vs, pt, lengths,
+                                        use_pallas=False)
+            pal = paged_attention_quant(q, k, v, ks, vs, pt, lengths,
+                                        interpret=True)
+            np.testing.assert_allclose(np.asarray(pal), np.asarray(ref),
+                                       rtol=1e-5, atol=1e-5)
+
+    def test_scale_shape_validated(self):
+        rng = np.random.default_rng(12)
+        k, v, ks, vs = _quant_pages(rng, 2, 128, 8, 4)
+        pt = jnp.zeros((1, 2), jnp.int32)
+        with pytest.raises(ValueError, match="scale shapes"):
+            paged_attention_quant(jnp.zeros((1, 4, 128)), k, v,
+                                  ks[:, :4], vs, pt,
+                                  jnp.asarray([3], jnp.int32))
+
+
+class TestPagedAttentionMlaParity:
+    def test_reference_equals_contiguous_mla_math(self):
+        """The gathered-latent reference equals the contiguous absorbed
+        MLA attention (scores = latent dot + rope dot, output = p @ c) at
+        the last position, per row."""
+        rng = np.random.default_rng(13)
+        b, hq, r, dr, t, n = 2, 4, 64, 16, 8, 4
+        P = 12
+        c_pages = jnp.asarray(rng.normal(size=(P, t, r)), jnp.float32)
+        kr_pages = jnp.asarray(rng.normal(size=(P, t, dr)), jnp.float32)
+        pt = jnp.asarray(rng.permutation(P)[:b * n].reshape(b, n),
+                         jnp.int32)
+        ql = jnp.asarray(rng.normal(size=(b, hq, r)), jnp.float32)
+        qr = jnp.asarray(rng.normal(size=(b, hq, dr)), jnp.float32)
+        lengths = jnp.asarray([5, 29], jnp.int32)
+        scale = 0.123
+        out = paged_attention_mla(ql, qr, c_pages, kr_pages, pt, lengths,
+                                  sm_scale=scale, use_pallas=False)
+        for row in range(b):
+            L = int(lengths[row])
+            c = np.asarray(c_pages[pt[row]]).reshape(n * t, r)[:L]
+            kr = np.asarray(kr_pages[pt[row]]).reshape(n * t, dr)[:L]
+            s = (np.asarray(ql[row]) * scale) @ c.T \
+                + (np.asarray(qr[row]) * scale) @ kr.T
+            p = np.exp(s - s.max(-1, keepdims=True))
+            p /= p.sum(-1, keepdims=True)
+            np.testing.assert_allclose(np.asarray(out[row]), p @ c,
+                                       rtol=1e-5, atol=1e-5)
+
+    def test_pallas_kernel_matches_reference(self):
+        """Lane-aligned latent geometry (r, dr both %128) through the
+        EXACT kernel in interpret mode."""
+        rng = np.random.default_rng(14)
+        b, hq, r, dr, t, n = 2, 8, 128, 128, 8, 4
+        P = 8
+        c_pages = jnp.asarray(rng.normal(size=(P, t, r)), jnp.float32)
+        kr_pages = jnp.asarray(rng.normal(size=(P, t, dr)), jnp.float32)
+        pt = jnp.asarray(rng.permutation(P)[:b * n].reshape(b, n),
+                         jnp.int32)
+        ql = jnp.asarray(rng.normal(size=(b, hq, r)), jnp.float32)
+        qr = jnp.asarray(rng.normal(size=(b, hq, dr)), jnp.float32)
+        for lengths in ([1, 30], [9, 25]):
+            lengths = jnp.asarray(lengths, jnp.int32)
+            ref = paged_attention_mla(ql, qr, c_pages, kr_pages, pt,
+                                      lengths, use_pallas=False)
+            pal = paged_attention_mla(ql, qr, c_pages, kr_pages, pt,
+                                      lengths, interpret=True)
+            np.testing.assert_allclose(np.asarray(pal), np.asarray(ref),
+                                       rtol=1e-5, atol=1e-5)
+
+
+class _LayoutDriver:
+    """Teacher-force a prompt through decode_step (contiguous) and
+    paged_decode_step side by side, then decode greedily on both —
+    logits must agree at every prompt position and generated tokens must
+    match exactly."""
+
+    @staticmethod
+    def drive(cfg, quantize: bool):
+        model = LlamaModel(cfg)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        b, t, n_cols = 2, 4, 8
+        prompts = [[3, 9, 1, 7, 2], [11, 4, 6]]
+        lens = [len(p) for p in prompts]
+        cache = model.init_cache(b, 64, quantize=quantize)
+        arena = model.init_paged_arena(b * n_cols, t, quantize=quantize)
+        pt = jnp.asarray(np.arange(b * n_cols,
+                                   dtype=np.int32).reshape(b, n_cols))
+        lengths = jnp.asarray([0] * b, jnp.int32)
+        pstep = jax.jit(lambda pr, tk, a, p2, ln, act:
+                        model.paged_decode_step(pr, tk, a, p2, ln, act))
+        dstep = jax.jit(lambda pr, tk, c, act:
+                        model.decode_step(pr, tk, c, act))
+        for i in range(max(lens)):
+            tok = jnp.asarray([p[i] if i < len(p) else 0 for p in prompts],
+                              jnp.int32)
+            act = jnp.asarray([i < n for n in lens])
+            lg_p, arena, lengths = pstep(params, tok, arena, pt, lengths,
+                                         act)
+            lg_c, cache = dstep(params, tok, cache, act)
+            for row in range(b):
+                if i < lens[row]:
+                    np.testing.assert_allclose(
+                        np.asarray(lg_p[row]), np.asarray(lg_c[row]),
+                        rtol=1e-5, atol=1e-5)
+        cur_c, cur_p = jnp.argmax(lg_c, -1), jnp.argmax(lg_p, -1)
+        for _ in range(8):
+            lc, cache = dstep(params, cur_c, cache,
+                              jnp.asarray([True] * b))
+            lp, arena, lengths = pstep(params, cur_p, arena, pt, lengths,
+                                       jnp.asarray([True] * b))
+            cur_c, cur_p = jnp.argmax(lc, -1), jnp.argmax(lp, -1)
+            np.testing.assert_array_equal(np.asarray(cur_c),
+                                          np.asarray(cur_p))
+
+
+class TestPagedDecodeStepInt8:
+    def test_token_identity_with_contiguous_int8_decode(self):
+        cfg = tiny_llama(vocab_size=64, embed_dim=32, n_layers=2,
+                         n_heads=4, n_kv_heads=2, mlp_dim=64,
+                         max_seq_len=128, dtype=jnp.float32,
+                         param_dtype=jnp.float32)
+        _LayoutDriver.drive(cfg, quantize=True)
+
+    def test_arena_sections_include_scales(self):
+        cfg = tiny_llama(vocab_size=64, embed_dim=32, n_layers=2,
+                         n_heads=4, n_kv_heads=2, mlp_dim=64,
+                         max_seq_len=128, dtype=jnp.float32,
+                         param_dtype=jnp.float32)
+        arena = LlamaModel(cfg).init_paged_arena(4, 4, quantize=True)
+        assert set(arena) == {"k", "v", "k_scale", "v_scale"}
+        assert arena["k"].dtype == jnp.int8
+        assert arena["k_scale"].shape == (2, 4, 4, 2)
+
+
+class TestPagedDecodeStepMla:
+    MCFG = tiny_mla(vocab_size=64, embed_dim=32, n_layers=2, mlp_dim=64,
+                    max_seq_len=128, dtype=jnp.float32,
+                    param_dtype=jnp.float32)
+
+    def test_token_identity_with_contiguous_mla_decode(self):
+        _LayoutDriver.drive(self.MCFG, quantize=False)
+
+    def test_dense_prefix_sections_page_too(self):
+        cfg = tiny_mla(vocab_size=64, embed_dim=32, n_layers=3,
+                       mlp_dim=64, max_seq_len=128, n_dense_prefix=1,
+                       dense_prefix_mlp_dim=64, n_experts=4,
+                       n_experts_per_tok=2, dtype=jnp.float32,
+                       param_dtype=jnp.float32)
+        arena = LlamaModel(cfg).init_paged_arena(4, 4)
+        assert set(arena) == {"c", "kr", "c_pre", "kr_pre"}
+        assert arena["c"].shape[0] == 2 and arena["c_pre"].shape[0] == 1
+        _LayoutDriver.drive(cfg, quantize=False)
+
+    def test_int8_latent_combination_still_gated(self):
+        model = LlamaModel(self.MCFG)
+        with pytest.raises(ValueError, match="int8 LATENT"):
+            model.init_paged_arena(4, 4, quantize=True)

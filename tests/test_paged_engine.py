@@ -273,3 +273,106 @@ class TestPagedEngineSoak:
         finally:
             e.stop()
             e_plain.stop()
+
+
+class TestPagedLayoutsInt8AndMla:
+    """ISSUE 10: the paged decode LOOP covers int8-KV and MLA arenas —
+    token-identical to the contiguous loop (paged_decode=False pins it),
+    zero-copy handoff adoption included, zero leaked pages."""
+
+    def _engines(self, cfg, params, **sc_kw):
+        base = dict(slots=2, max_prefill_len=32, cache_len=256,
+                    max_new_tokens=12, kv_page_tokens=8)
+        base.update(sc_kw)
+        paged = ServingEngine(cfg, params,
+                              ServingConfig(**base)).start()
+        contig = ServingEngine(cfg, params, ServingConfig(
+            **base, paged_decode=False)).start()
+        return paged, contig
+
+    def _soak(self, cfg, params, what, **sc_kw):
+        import numpy as np
+        paged, contig = self._engines(cfg, params, **sc_kw)
+        try:
+            assert paged._paged_loop, f"{what}: paged loop not eligible"
+            assert not contig._paged_loop
+            rng = np.random.default_rng(SEED + 7)
+            shared = [((i * 31) % (cfg.vocab_size - 8)) + 1
+                      for i in range(40)]
+            prompts = [shared + [1, 2], shared + [3, 4, 5]]
+            for _ in range(5):
+                prompts.append([int(rng.integers(1, cfg.vocab_size - 8))
+                                for _ in range(int(rng.integers(3, 60)))])
+            for i, p in enumerate(prompts):
+                kw = dict(max_new_tokens=8)
+                if i % 3 == 2:
+                    kw.update(temperature=0.8, seed=100 + i)
+                a = paged.submit(p, **kw).result(timeout=300)
+                b = contig.submit(p, **kw).result(timeout=300)
+                assert a["tokens"] == b["tokens"], \
+                    f"[seed={SEED}] {what} prompt {i}: paged != contiguous"
+            # zero-copy handoff adoption decodes identically too
+            out = paged.export_handoff(shared)
+            paged2 = ServingEngine(cfg, params, ServingConfig(
+                slots=2, max_prefill_len=32, cache_len=256,
+                max_new_tokens=12, kv_page_tokens=8, **sc_kw)).start()
+            try:
+                paged2.adopt_handoff(out["blob"])
+                fa = paged2.submit(shared + [7], max_new_tokens=6).result(
+                    timeout=300)
+                fb = paged.submit(shared + [7], max_new_tokens=6).result(
+                    timeout=300)
+                assert fa["tokens"] == fb["tokens"], \
+                    f"[seed={SEED}] {what}: adopted KV decoded differently"
+                assert paged2.metrics.get_counter(
+                    "tpu_serving_prefix_cache_hits") >= 1
+            finally:
+                paged2.stop()
+                stats = paged2.prefix_cache_stats()
+                assert stats["pages_free"] + stats["nodes"] \
+                    == stats["pages_total"]
+            paged.drain()
+            assert paged.drained
+            stats = paged.prefix_cache_stats()
+            assert stats["pages_free"] + stats["nodes"] \
+                == stats["pages_total"], \
+                f"[seed={SEED}] {what}: leaked pages"
+        finally:
+            paged.stop()
+            contig.stop()
+
+    def test_int8_kv_paged_loop(self, params):
+        self._soak(CFG, params, "int8-KV", quantize_kv_int8=True)
+
+    def test_mla_paged_loop(self):
+        from k8s_runpod_kubelet_tpu.models import tiny_mla
+        mcfg = tiny_mla(vocab_size=128, embed_dim=64, n_layers=2,
+                        mlp_dim=128, max_seq_len=512, dtype=jnp.float32,
+                        param_dtype=jnp.float32)
+        mparams = init_params(mcfg, jax.random.PRNGKey(1))
+        self._soak(mcfg, mparams, "MLA")
+
+    def test_mla_int8_combination_stays_contiguous(self):
+        """The one unpaged combination: MLA + int8 latent cache falls
+        back to the contiguous loop (auto mode), and forcing
+        paged_decode=True errors loudly."""
+        from k8s_runpod_kubelet_tpu.models import tiny_mla
+        mcfg = tiny_mla(vocab_size=128, embed_dim=64, n_layers=2,
+                        mlp_dim=128, max_seq_len=512, dtype=jnp.float32,
+                        param_dtype=jnp.float32)
+        mparams = init_params(mcfg, jax.random.PRNGKey(1))
+        e = ServingEngine(mcfg, mparams, ServingConfig(
+            slots=2, max_prefill_len=32, cache_len=256,
+            kv_page_tokens=8, quantize_kv_int8=True)).start()
+        try:
+            assert not e._paged_loop
+            out = e.submit([1, 2, 3, 4], max_new_tokens=4).result(
+                timeout=300)
+            assert len(out["tokens"]) == 4
+        finally:
+            e.stop()
+        with pytest.raises(ValueError, match="paged_decode=True"):
+            ServingEngine(mcfg, mparams, ServingConfig(
+                slots=2, max_prefill_len=32, cache_len=256,
+                kv_page_tokens=8, quantize_kv_int8=True,
+                paged_decode=True))

@@ -16,7 +16,11 @@ Both may be mixed in one file. Output:
   TTFT/ITL p95 and free KV pages, when snapshots are present;
 - the two-hop request timeline: route -> prefill -> handoff -> decode,
   joined per trace_id from the fleet.handoff span and the two engines'
-  serving.kv_prefill / serving.kv_adopt spans riding the same trace;
+  serving.kv_prefill / serving.kv_adopt spans riding the same trace
+  (streamed hops add a chunks count + realized overlap fraction);
+- per-stream CHUNK timelines for streamed handoffs: each frame's
+  compute (serving.kv_chunk), push (serving.kv_push) and decode-side
+  adopt (serving.kv_adopt_chunk) spans joined per seq;
 - the scale/evict event timeline (scale events carry their pool's role).
 
 Usage:
@@ -178,10 +182,17 @@ def two_hop_table(spans: list[dict], top: int) -> list[str]:
                 float(sp.get("duration_s", 0.0)))
 
         ok = a.get("ok")
-        tail = (f"pages={a.get('pages', 0)} bytes={a.get('bytes', 0)}"
-                if ok else
-                f"FAILED ({a.get('error') or '?'}) -> fell back to "
-                f"{sibs.get('fleet.route', {}).get('attrs', {}).get('replica_id', '?')}")
+        if ok:
+            tail = f"pages={a.get('pages', 0)} bytes={a.get('bytes', 0)}"
+            if a.get("streamed"):
+                # streamed hop (ISSUE 10): chunk count + realized
+                # compute/transfer overlap fraction
+                ov = a.get("overlap_ratio")
+                tail += (f" chunks={a.get('chunks', 0)}"
+                         f" overlap={'-' if ov is None else f'{ov:.0%}'}")
+        else:
+            tail = (f"FAILED ({a.get('error') or '?'}) -> fell back to "
+                    f"{sibs.get('fleet.route', {}).get('attrs', {}).get('replica_id', '?')}")
         out.append(
             f"  trace={tid[:16]} route[{dur('fleet.route')}] -> "
             f"prefill {a.get('prefill_replica', '?')}"
@@ -189,6 +200,56 @@ def two_hop_table(spans: list[dict], top: int) -> list[str]:
             f"handoff[{_fmt_ms(float(s.get('duration_s', 0.0)))}] -> "
             f"decode {a.get('decode_replica', '?')}"
             f"[{dur('serving.kv_adopt')}] {tail}")
+    return out
+
+
+def chunk_timeline(spans: list[dict], top: int) -> list[str]:
+    """Per-stream chunk timeline for STREAMED handoffs (ISSUE 10): the
+    prefill side's serving.kv_chunk (compute) / serving.kv_push
+    (serialize + POST) spans and the decode side's serving.kv_adopt_chunk
+    spans share the hop's trace_id; rows join per seq so the overlap —
+    push k riding under compute k+1 — is visible span by span."""
+    names = ("serving.kv_chunk", "serving.kv_push",
+             "serving.kv_adopt_chunk")
+    by_trace: dict[str, dict[int, dict]] = defaultdict(
+        lambda: defaultdict(dict))
+    order: dict[str, float] = {}
+    for s in spans:
+        if s.get("name") not in names:
+            continue
+        seq = (s.get("attrs") or {}).get("seq")
+        if seq is None:
+            continue
+        tid = s.get("trace_id", "")
+        by_trace[tid][int(seq)][s["name"]] = s
+        order.setdefault(tid, s.get("start", 0.0))
+    if not by_trace:
+        return []
+    out = ["", f"== streamed-handoff chunk timelines (last {top}) =="]
+    for tid in sorted(order, key=order.get)[-top:]:
+        rows = by_trace[tid]
+        total_pages = sum(
+            (r.get("serving.kv_chunk", {}).get("attrs") or {})
+            .get("pages", 0) for r in rows.values())
+        out.append(f"  trace={tid[:16]} ({len(rows)} frames, "
+                   f"{total_pages} pages)")
+        for seq in sorted(rows):
+            row = rows[seq]
+
+            def dur(name):
+                sp = row.get(name)
+                return "-" if sp is None else _fmt_ms(
+                    float(sp.get("duration_s", 0.0)))
+
+            a = (row.get("serving.kv_chunk", {}).get("attrs") or {})
+            final = " FINAL" if a.get("final") or (
+                row.get("serving.kv_adopt_chunk", {})
+                .get("attrs") or {}).get("final") else ""
+            out.append(
+                f"    seq={seq:<3} compute[{dur('serving.kv_chunk')}] "
+                f"push[{dur('serving.kv_push')}] "
+                f"adopt[{dur('serving.kv_adopt_chunk')}] "
+                f"pages={a.get('pages', 0)}{final}")
     return out
 
 
@@ -220,6 +281,7 @@ def render(spans: list[dict], snapshots: list[dict], top: int = 20) -> str:
     lines = routing_table(spans)
     lines += load_table(snapshots)
     lines += two_hop_table(spans, top)
+    lines += chunk_timeline(spans, top)
     lines += event_timeline(spans, top)
     return "\n".join(lines)
 

@@ -102,7 +102,8 @@ def render_trace(trace_id: str, spans: list[dict]) -> str:
         label = "  " * depth + s["name"]
         attrs = s.get("attrs") or {}
         extra = " ".join(f"{k}={attrs[k]}"
-                         for k in ("rid", "pod", "tokens", "step", "host")
+                         for k in ("rid", "pod", "tokens", "step", "host",
+                                   "seq")
                          if attrs.get(k) is not None)
         out.append(f"  {label:<32} |{bar}| {start * 1000:8.1f} ms "
                    f"+{dur * 1000:8.1f} ms  {extra}".rstrip())
@@ -112,6 +113,7 @@ def render_trace(trace_id: str, spans: list[dict]) -> str:
 def rollups(spans: list[dict]) -> str:
     ttfts, itls, latencies = [], [], []
     steps, stragglers, runs = [], 0, []
+    chunk_computes, chunk_pushes = [], []
     for s in spans:
         attrs = s.get("attrs") or {}
         if s["name"] == "serving.request":
@@ -123,6 +125,11 @@ def rollups(spans: list[dict]) -> str:
             tokens = attrs.get("tokens")
             if isinstance(tokens, int) and tokens > 1:
                 itls.append(s.get("duration_s", 0.0) / (tokens - 1))
+        # streamed chunked handoff (ISSUE 10): per-frame compute/push
+        elif s["name"] == "serving.kv_chunk":
+            chunk_computes.append(s.get("duration_s", 0.0))
+        elif s["name"] == "serving.kv_push":
+            chunk_pushes.append(s.get("duration_s", 0.0))
         # training span families (ISSUE 5: one tool renders both layers;
         # tools/goodput_summary.py draws the full goodput waterfall)
         elif s["name"] == "training.step":
@@ -142,6 +149,13 @@ def rollups(spans: list[dict]) -> str:
             f"  {label:<28} p50={percentile(vals, 50):.4f}  "
             f"p95={percentile(vals, 95):.4f}  p99={percentile(vals, 99):.4f}  "
             f"n={len(vals)}")
+    if chunk_computes or chunk_pushes:
+        cc, cp = sorted(chunk_computes), sorted(chunk_pushes)
+        lines.append(
+            f"handoff chunks: {len(cc)} computed / {len(cp)} pushed  "
+            f"compute p50={percentile(cc, 50):.4f}s  "
+            f"push p50={percentile(cp, 50):.4f}s  "
+            f"(per-stream timelines: tools/fleet_summary.py)")
     if steps or runs:
         lines.append(f"training steps: {len(steps)}"
                      + (f"  straggler events: {stragglers}" if stragglers
