@@ -49,6 +49,11 @@ Usage:
                              # co-resident ITL under a long prefill,
                              # chunked vs monolithic (--smoke = short
                              # sweep; CPU-capable, claims need TPU)
+  python bench.py --handoff-path  # device-native vs wire KV handoff:
+                             # page-run bytes/sec across two real arenas
+                             # per path, and two-hop TTFT per path
+                             # through real engines (--smoke = throughput
+                             # cell only; CPU runs tiny geometry)
   python bench.py --mfu-sweep  # training MFU levers: remat none/dots,
                              # batch, 530M width (needs TPU)
   python bench.py --attn-tune  # flash block-size grid at the training
@@ -121,6 +126,9 @@ _STAGED_QUEUE = [
     # chunked prefill + streamed handoff (ISSUE 10): serial-vs-streamed
     # two-hop TTFT sweep + ITL-under-long-prefill, chunked vs monolithic
     ("chunked", ["--chunked"], 2400),
+    # device-native KV handoff (ISSUE 11): device vs wire page-run
+    # throughput + two-hop TTFT per path on the same arena geometry
+    ("handoff_path", ["--handoff-path"], 2400),
     ("serve_8b", ["--serve", "--model", "llama3-8b", "--int8", "--kv-int8"],
      2400),
     # int4 weights via the Pallas unpack kernel (ops/int4_matmul.py):
@@ -591,6 +599,191 @@ def run_disagg_bench(smoke: bool = False) -> int:
         e_pre.stop()
         e_dec.stop()
         e_uni.stop()
+    return 0
+
+
+def run_handoff_path_bench(smoke: bool = False) -> int:
+    """Device-native vs wire KV handoff cells (ISSUE 11).
+
+    Cell 1 — page-run throughput per path, same arena geometry: a
+    prompt's full pages leave one paged arena and adopt into another,
+    once through the WIRE codec (device->host gather, numpy
+    serialization, deserialize, host->device scatter — exactly the
+    /kv_prefill push payload path) and once DEVICE-NATIVE
+    (export_pages device buffers adopted directly — zero numpy bytes).
+    Both legs block on the destination arena before the clock stops, so
+    the device number is real transfer+scatter, not dispatch. Reported
+    as bytes/sec per path + the device/wire speedup; the acceptance bar
+    is device strictly above wire on the same geometry.
+
+    Cell 2 (skipped under ``smoke``) — two-hop TTFT per path through
+    REAL engines: the prefill engine hands a prompt's KV to a decode
+    engine over each path (device via the DeviceTransferBus, wire via
+    export/serialize/adopt), then the decode engine serves that prompt —
+    TTFT includes the hop the way a router-planned two-hop would."""
+    _force_platform_from_env()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from k8s_runpod_kubelet_tpu.fleet.handoff import (deserialize_pages,
+                                                      serialize_pages)
+    from k8s_runpod_kubelet_tpu.workloads.serving.kv_manager import \
+        PagedKVStore
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:   # llama3-8b KV geometry: 32 layers, 8 kv heads, hd 128
+        layers, hkv, d, t, n_tokens = 32, 8, 128, 16, 2048
+        dtype = jnp.bfloat16
+    else:
+        # KV-heavy CPU geometry (~17MB payload): the wire path's extra
+        # legs (host gather copy + serialize + deserialize) must be
+        # MATERIAL next to the shared scatter work, or the ratio
+        # degenerates into jit-dispatch noise — at chip geometry the
+        # payload dwarfs this anyway
+        layers, hkv, d, t, n_tokens = 4, 4, 128, 16, 1024
+        dtype = jnp.float32
+    cache_len = n_tokens
+    n_pages = 2 * (n_tokens // t)
+
+    def factory():
+        return {"k": jnp.zeros((layers, 1, cache_len, hkv, d), dtype),
+                "v": jnp.zeros((layers, 1, cache_len, hkv, d), dtype),
+                "index": jnp.zeros((1,), jnp.int32)}
+
+    key = jax.random.PRNGKey(0)
+    single = {"k": jax.random.normal(key, (layers, 1, cache_len, hkv, d),
+                                     dtype),
+              "v": jax.random.normal(key, (layers, 1, cache_len, hkv, d),
+                                     dtype),
+              "index": jnp.asarray([n_tokens], jnp.int32)}
+    tokens = [(i * 17) % 1000 + 1 for i in range(n_tokens)]
+
+    def run_path(device: bool) -> tuple[float, int]:
+        """(seconds, payload bytes) for one src-arena -> dst-arena move."""
+        src = PagedKVStore(n_pages, t, factory)
+        dst = PagedKVStore(n_pages, t, factory)
+        src.insert(0, tokens, dict(single))
+        jax.block_until_ready(src.arena)
+        t0 = time.perf_counter()
+        m = src.match_full(0, tokens)
+        frags = src.export_pages(m.pages)
+        if device:
+            src.release(m.pages)
+            dst.adopt(0, tokens[:m.matched_tokens], frags)
+            nbytes = sum(int(a.size) * int(a.dtype.itemsize)
+                         for a in frags.values())
+        else:
+            sections = {name: np.asarray(a) for name, a in frags.items()}
+            src.release(m.pages)
+            blob = serialize_pages(tokens[:m.matched_tokens], t, sections)
+            header, got = deserialize_pages(
+                blob, expect_page_tokens=t,
+                expect_sections=dst.section_spec())
+            dst.adopt(0, header["tokens"], got)
+            nbytes = len(blob)
+        jax.block_until_ready(dst.arena)  # the scatter actually landed
+        return time.perf_counter() - t0, nbytes
+
+    run_path(device=True)   # warm the gather/adopt jits out of the timings
+    run_path(device=False)
+    results = {}
+    for device in (False, True):
+        best = None
+        for _ in range(3):
+            secs, nbytes = run_path(device)
+            if best is None or secs < best[0]:
+                best = (secs, nbytes)
+        results["device" if device else "wire"] = best
+    for path, (secs, nbytes) in results.items():
+        _emit({"metric": "handoff_path_bytes_per_sec", "path": path,
+               "value": round(nbytes / secs, 1), "unit": "B/s",
+               "bytes": nbytes, "seconds": round(secs, 6),
+               "pages": n_tokens // t, "page_tokens": t,
+               "tokens": n_tokens, "layers": layers, "kv_heads": hkv,
+               "head_dim": d, "dtype": np.dtype(dtype).name,
+               "backend": jax.default_backend()})
+    dev_bps = results["device"][1] / results["device"][0]
+    wire_bps = results["wire"][1] / results["wire"][0]
+    _emit({"metric": "handoff_path_device_over_wire",
+           "value": round(dev_bps / wire_bps, 3), "unit": "x",
+           "device_bytes_per_sec": round(dev_bps, 1),
+           "wire_bytes_per_sec": round(wire_bps, 1),
+           "backend": jax.default_backend()})
+    if smoke:
+        return 0
+
+    # -- cell 2: two-hop TTFT per path through real engines -------------------
+    from k8s_runpod_kubelet_tpu.fleet.device_transfer import (
+        BUS, detect_placement_domain, device_push)
+    from k8s_runpod_kubelet_tpu.workloads.serving import (ServingConfig,
+                                                          ServingEngine)
+    if on_tpu:
+        cfg = _serve_model("llama3-8b")
+        params = _serve_params(cfg, 8)
+        sc = ServingConfig(slots=8, max_prefill_len=512, cache_len=2048,
+                           max_new_tokens=64, kv_page_tokens=16)
+        plen, new_toks = 1024, 32
+    else:
+        from k8s_runpod_kubelet_tpu.models import init_params, tiny_llama
+        cfg = tiny_llama(vocab_size=128, embed_dim=64, n_layers=2,
+                         n_heads=4, n_kv_heads=2, mlp_dim=128,
+                         max_seq_len=512, dtype=jnp.float32,
+                         param_dtype=jnp.float32)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        sc = ServingConfig(slots=2, max_prefill_len=32, cache_len=256,
+                           max_new_tokens=16, kv_page_tokens=8)
+        plen, new_toks = 96, 8
+
+    def prompt_of(salt: int) -> list:
+        return [((j * 7 + salt * 131) % (cfg.vocab_size - 2)) + 1
+                for j in range(plen)]
+
+    def ttft_of(engine, prompt) -> float:
+        t_sub = time.perf_counter()
+        first = []
+        engine.submit(prompt, max_new_tokens=new_toks,
+                      on_token=lambda _t: first.append(
+                          time.perf_counter() - t_sub)
+                      if not first else None).result(timeout=1800)
+        return first[0]
+
+    e_pre = ServingEngine(cfg, params, sc).start()
+    e_dw = ServingEngine(cfg, params, sc).start()   # wire-path decoder
+    e_dd = ServingEngine(cfg, params, sc).start()   # device-path decoder
+    domain = detect_placement_domain()
+    BUS.register("bench://decode-device", e_dd, domain)
+    try:
+        warm = prompt_of(999)
+        for e in (e_pre, e_dw, e_dd):
+            e.submit(warm, max_new_tokens=2).result(timeout=1800)
+        # wire: export+serialize on the prefill engine, adopt on e_dw
+        p_w = prompt_of(1)
+        t0 = time.perf_counter()
+        out = e_pre.export_handoff(p_w)
+        e_dw.adopt_handoff(out["blob"])
+        hop_wire = time.perf_counter() - t0
+        ttft_wire = hop_wire + ttft_of(e_dw, p_w)
+        # device: arena-to-arena through the bus
+        p_d = prompt_of(2)
+        t0 = time.perf_counter()
+        dres = device_push(e_pre, "bench://decode-device", p_d,
+                           domain=domain)
+        jax.block_until_ready(e_dd._kv_store.arena)
+        hop_dev = time.perf_counter() - t0
+        ttft_dev = hop_dev + ttft_of(e_dd, p_d)
+        for path, hop_s, ttft_s, extra in (
+                ("wire", hop_wire, ttft_wire, {"bytes": len(out["blob"])}),
+                ("device", hop_dev, ttft_dev, {"bytes": dres["bytes"]})):
+            _emit({"metric": "handoff_path_two_hop_ttft_ms", "path": path,
+                   "value": round(ttft_s * 1e3, 2), "unit": "ms",
+                   "hop_ms": round(hop_s * 1e3, 2),
+                   "prompt_tokens": plen, **extra,
+                   "model": cfg.name, "backend": jax.default_backend()})
+    finally:
+        BUS.unregister("bench://decode-device")
+        e_pre.stop()
+        e_dw.stop()
+        e_dd.stop()
     return 0
 
 
@@ -1886,6 +2079,14 @@ def _chunked_smoke_lines() -> list | None:
     return _cpu_smoke_lines("--chunked", timeout_s=900)
 
 
+def _handoff_path_smoke_lines() -> list | None:
+    """The ISSUE 11 device-vs-wire throughput cell on CPU (see
+    _cpu_smoke_lines): the device/wire ratio is re-measured per commit —
+    tiny geometry, explicitly backend=cpu, but the mechanism (zero
+    serialization on the device leg) is the same one the chip runs."""
+    return _cpu_smoke_lines("--handoff-path")
+
+
 def orchestrate(quick: bool) -> int:
     errors = []
     # 0) a bounded probe gates the expensive attempts: a probe pass costs one
@@ -1929,6 +2130,7 @@ def orchestrate(quick: bool) -> int:
     diag = _probe_diag_summary()
     smoke = None if quick else _disagg_smoke_lines()
     chunked_smoke = None if quick else _chunked_smoke_lines()
+    handoff_smoke = None if quick else _handoff_path_smoke_lines()
     session = _session_tpu_headline()
     if session is not None:
         session["tpu_errors"] = errors[-2:]
@@ -1939,6 +2141,8 @@ def orchestrate(quick: bool) -> int:
             session["disagg_cpu_smoke"] = smoke
         if chunked_smoke is not None:
             session["chunked_cpu_smoke"] = chunked_smoke
+        if handoff_smoke is not None:
+            session["handoff_path_cpu_smoke"] = handoff_smoke
         if not quick:
             _write_unreachable_round(session)
         _emit(session)
@@ -1963,6 +2167,8 @@ def orchestrate(quick: bool) -> int:
             line["disagg_cpu_smoke"] = smoke
         if chunked_smoke is not None:
             line["chunked_cpu_smoke"] = chunked_smoke
+        if handoff_smoke is not None:
+            line["handoff_path_cpu_smoke"] = handoff_smoke
         if not quick:
             _write_unreachable_round(line)
         _emit(line)
@@ -2174,6 +2380,8 @@ def main() -> int:
         return run_disagg_bench(smoke="--smoke" in sys.argv)
     if "--chunked" in sys.argv:
         return run_chunked_bench(smoke="--smoke" in sys.argv)
+    if "--handoff-path" in sys.argv:
+        return run_handoff_path_bench(smoke="--smoke" in sys.argv)
     if "--ring-flash" in sys.argv:
         return run_ring_flash_check()
     if "--spec-drift" in sys.argv:

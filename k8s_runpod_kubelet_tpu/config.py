@@ -124,6 +124,15 @@ class Config:
     fleet_itl_slo_s: float = 0.25
     fleet_min_free_kv_page_frac: float = 0.1
     fleet_handoff_timeout_s: float = 30.0
+    # device-native KV transfer (ISSUE 11): replicas advertising EQUAL
+    # non-empty placement domains hand KV pages arena-to-arena (zero host
+    # copies) on two-hop routes; every device-path failure downgrades to
+    # the wire codec, then the unified fallback. fleet_placement_domain
+    # overrides the auto-detected domain (proc:<host>:<pid> — the
+    # co-location the in-process bus can prove); operators with a real
+    # same-slice ICI transport set it per pool.
+    fleet_device_transfer_enabled: bool = True
+    fleet_placement_domain: str = ""
 
     # training telemetry (ISSUE 5). telemetry_port is a gang COORDINATION
     # var: injected into every worker's env (TPU_TELEMETRY_PORT +
@@ -369,6 +378,8 @@ _ENV_MAP = {
     "TPU_FLEET_ITL_SLO_S": "fleet_itl_slo_s",
     "TPU_FLEET_MIN_FREE_KV_PAGE_FRAC": "fleet_min_free_kv_page_frac",
     "TPU_FLEET_HANDOFF_TIMEOUT_S": "fleet_handoff_timeout_s",
+    "TPU_FLEET_DEVICE_TRANSFER_ENABLED": "fleet_device_transfer_enabled",
+    "TPU_FLEET_PLACEMENT_DOMAIN": "fleet_placement_domain",
     "TPU_TELEMETRY_PORT": "telemetry_port",
     "TPU_STRAGGLER_FACTOR": "straggler_factor",
     "TPU_STALL_TIMEOUT_S": "stall_timeout_s",

@@ -43,7 +43,9 @@ SERVING_PASSTHROUGH_ENV = ("TPU_KV_PAGE_TOKENS", "TPU_KV_POOL_PAGES",
                            "TPU_PREFIX_CACHE_ENABLED",
                            "TPU_KV_PAGED_DECODE",
                            "TPU_SERVING_CHUNK_TOKENS",
-                           "TPU_HANDOFF_STREAM_WINDOW")
+                           "TPU_HANDOFF_STREAM_WINDOW",
+                           "TPU_FLEET_DEVICE_TRANSFER_ENABLED",
+                           "TPU_FLEET_PLACEMENT_DOMAIN")
 
 
 @dataclasses.dataclass

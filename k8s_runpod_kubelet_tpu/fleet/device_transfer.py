@@ -1,0 +1,272 @@
+"""Device-native KV page handoff (ISSUE 11): arena-to-arena page movement
+for co-located prefill/decode replicas, with ZERO host copies.
+
+The wire path (fleet/handoff.py) moves every page through a device→host
+gather, a numpy serialization, an HTTP frame and a host→device scatter —
+NIC-order bandwidth on a path the hardware could run at memory-bandwidth
+order (the TPU concurrency study in PAPERS.md quantifies the gap:
+intra-slice ICI is orders of magnitude above the host/NIC path). This
+module is the fast tier above it:
+
+- **Placement domains.** Every replica advertises a *placement domain*
+  (``detect_placement_domain``): replicas in the same domain can hand
+  device buffers to each other directly. The auto-detected domain is
+  ``proc:<host>:<pid>`` — the one co-location this build can PROVE
+  supports zero-copy buffer donation (in the fake cloud, every
+  FakeWorkerHost replica is a thread of one process, so a whole emulated
+  slice shares a domain). Operators with a real same-slice ICI transport
+  override it per pool (``TPU_FLEET_PLACEMENT_DOMAIN`` / flag); a domain
+  claim the bus can't back simply downgrades to wire — the ladder is
+  device → wire → unified fallback, never an error the client sees.
+
+- **DeviceTransferBus.** A process-local registry mapping a replica's
+  advertise URL to its live engine + domain. serve_main registers its
+  engine at startup (when ``fleet_device_transfer_enabled``); the
+  prefill side's ``device_push`` looks the decode replica up by the SAME
+  URL the router hands it for the wire push, so the two paths are
+  interchangeable per hop.
+
+- **device_push.** The prefill half: same-domain hops run
+  ``export_handoff_device`` → ``adopt_handoff_device`` (monolithic) or
+  ``export_handoff_stream`` feeding ``adopt_handoff_chunk_device``
+  fragments through the decode engine's HandoffStreamAssembler
+  (streamed — the PR 10 seq/TTL state machine, just without
+  serialize/deserialize in the middle). Page payloads stay device
+  arrays end to end: the exporter's jitted gather produces fresh device
+  buffers, the adopter's jitted scatter writes them into its arena, and
+  refcount/COW accounting moves only after the adoption lands — the
+  same all-or-nothing contract the wire path enforces.
+
+Any failure raises ``DeviceTransferError`` (or the engine's
+HandoffError); the caller (serve_main's /kv_prefill) counts a downgrade
+and falls back to the wire codec unchanged.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import threading
+import uuid
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+
+class DeviceTransferError(RuntimeError):
+    """A device-path hop that cannot proceed (no bus entry, domain
+    mismatch, dead peer). The caller downgrades to the wire codec — this
+    is a routing downgrade, never a request failure."""
+
+
+def detect_placement_domain(override: str = "",
+                            env: Optional[dict] = None) -> str:
+    """This replica's placement domain: explicit override first (flag >
+    TPU_FLEET_PLACEMENT_DOMAIN env), else ``proc:<host>:<pid>`` — the
+    co-location the in-process bus can actually serve. Two replicas with
+    EQUAL non-empty domains are device-reachable; everything else rides
+    the wire."""
+    if override:
+        return override
+    env = os.environ if env is None else env
+    from_env = env.get("TPU_FLEET_PLACEMENT_DOMAIN", "")
+    if from_env:
+        return from_env
+    return f"proc:{socket.gethostname()}:{os.getpid()}"
+
+
+class DeviceTransferBus:
+    """Process-local advertise-URL -> (engine, domain) registry. Thread
+    safe (handler threads race registration against lookups); entries are
+    overwritten on re-registration (a restarted engine under the same
+    URL wins)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: dict[str, tuple[object, str]] = {}
+
+    @staticmethod
+    def _key(url: str) -> str:
+        return (url or "").rstrip("/")
+
+    def register(self, url: str, engine, domain: str) -> None:
+        if not url or not domain:
+            raise ValueError("device bus registration needs a URL and a "
+                             "placement domain")
+        with self._lock:
+            self._entries[self._key(url)] = (engine, domain)
+
+    def unregister(self, url: str) -> None:
+        with self._lock:
+            self._entries.pop(self._key(url), None)
+
+    def lookup(self, url: str) -> Optional[tuple[object, str]]:
+        with self._lock:
+            return self._entries.get(self._key(url))
+
+    def clear(self) -> None:
+        """Test hook: the bus is process-global, suites must not leak
+        engines between cases."""
+        with self._lock:
+            self._entries.clear()
+
+
+# the process-wide bus: serve_main registers engines here; tests register
+# theirs directly and clear() between cases
+BUS = DeviceTransferBus()
+
+
+def _streamed_device_push(engine, peer, tokens: list, model: str,
+                          window: int) -> dict:
+    """The chunked leg of device_push: export_handoff_stream's fragments
+    cross to the peer's assembler on a SENDER THREAD behind a bounded
+    queue — the same compute/transfer decoupling the wire path's
+    serve_main sender has. Adoption takes the PEER's handoff/prefix
+    locks (its own admissions hold them through jitted dispatches), so
+    running it inline in ``emit`` would stall the prefill compute thread
+    mid-hop and give back the overlap the stream exists for; the queue
+    bounds fragments in flight exactly like handoff_stream_window does
+    for wire frames (fragments pin fresh device buffers, so the bound is
+    HBM, not host memory)."""
+    import queue as _q
+
+    stream_id = uuid.uuid4().hex
+    t = engine.sc.kv_page_tokens
+    sendq: "_q.Queue" = _q.Queue(maxsize=max(1, int(window)))
+    stats = {"frames": 0, "bytes": 0, "result": None}
+    push_err: list = []
+
+    def sender():
+        while True:
+            frag = sendq.get()
+            if frag is None:
+                return
+            try:
+                if frag["final"]:
+                    out = peer.adopt_handoff_chunk_device(
+                        stream_id, frag["seq"], [], {}, final=True,
+                        total_tokens=frag["total_tokens"], model=model)
+                else:
+                    # pow2-padding trim is a device-side slice — on the
+                    # sender thread, never the compute thread
+                    n = len(frag["tokens"]) // t
+                    sections = {name: a[:, :n]
+                                for name, a in frag["sections"].items()}
+                    out = peer.adopt_handoff_chunk_device(
+                        stream_id, frag["seq"], frag["tokens"], sections,
+                        model=model)
+                stats["frames"] += 1
+                stats["bytes"] += int(out.get("bytes") or 0)
+                if out.get("final"):
+                    stats["result"] = out
+            except Exception as e:  # noqa: BLE001 — any adoption failure
+                # aborts the hop; emit sees push_err and stops the export
+                push_err.append(e)
+                return
+
+    thread = threading.Thread(target=sender, name="kv-device-sender",
+                              daemon=True)
+
+    def emit(frag):
+        while True:
+            if push_err:
+                raise DeviceTransferError(
+                    f"device stream adoption failed: {push_err[0]}")
+            try:
+                sendq.put(frag, timeout=0.1)
+                return
+            except _q.Full:
+                continue
+
+    def finish(abort: bool):
+        """Land the close sentinel unconditionally (a stranded sender
+        would leak a thread per failed hop) — drain stale fragments on
+        abort, wait for slots on success (serve_main's finish_sender
+        discipline)."""
+        if not abort:
+            while not push_err:
+                try:
+                    sendq.put(None, timeout=0.1)
+                    thread.join(timeout=120.0)
+                    return
+                except _q.Full:
+                    continue
+        while True:
+            try:
+                sendq.get_nowait()
+            except _q.Empty:
+                break
+        sendq.put(None)
+        thread.join(timeout=120.0)
+
+    thread.start()
+    try:
+        out = engine.export_handoff_stream(tokens, emit)
+    except Exception:
+        finish(abort=True)
+        raise
+    finish(abort=False)
+    adopted = stats["result"]
+    if push_err or adopted is None:
+        # the export closed without the peer confirming adoption —
+        # treat exactly like an unconfirmed wire push
+        raise DeviceTransferError(
+            f"device stream closed without a final adoption"
+            f"{f': {push_err[0]}' if push_err else ''}")
+    # sender-side device accounting (the catalogue's 'sender counts
+    # exports': export_handoff_stream is path-agnostic, so the device
+    # series moves HERE for streamed hops, mirroring
+    # export_handoff_device on the monolithic leg)
+    engine.metrics.incr("tpu_serving_kv_handoff_device_runs")
+    engine.metrics.incr("tpu_serving_kv_handoff_device_bytes",
+                        adopted["bytes"])
+    return {"pages": out["pages"], "chunks": out["chunks"],
+            "frames": stats["frames"], "bytes": adopted["bytes"],
+            "covered_tokens": out["covered_tokens"],
+            "matched_tokens": out["matched_tokens"],
+            "streamed": True, "adopted": adopted["pages"],
+            "path": "device"}
+
+
+def device_push(engine, target_url: str, tokens: list, *,
+                domain: str, bus: Optional[DeviceTransferBus] = None,
+                window: int = 8) -> dict:
+    """Prefill half of a DEVICE-path handoff: resolve the decode replica
+    on the bus, verify co-location, and move the prompt's page run
+    arena-to-arena with no serialization. Chunked engines
+    (serving_chunk_tokens > 0) stream per-chunk device fragments through
+    the decode engine's assembler (strict seq, all-or-nothing adoption)
+    with a sender thread overlapping adoption under the next chunk's
+    compute (``window`` bounds fragments in flight — serve_main passes
+    its handoff_stream_window); monolithic engines move the whole run in
+    one export/adopt pair.
+
+    Returns the same shape as the wire hop's reply ({"pages", "bytes",
+    "covered_tokens", "matched_tokens"} + streamed/chunks when chunked)
+    with ``path: "device"``. Raises DeviceTransferError when the target
+    is not device-reachable (caller downgrades to wire) and lets engine
+    HandoffErrors propagate (caller downgrades too — mismatched geometry
+    or a failed adoption must not kill the request)."""
+    bus = bus or BUS
+    entry = bus.lookup(target_url)
+    if entry is None:
+        raise DeviceTransferError(
+            f"no device-reachable engine registered at {target_url!r} "
+            "(bus miss — replica in another process or not registered)")
+    peer, peer_domain = entry
+    if not domain or peer_domain != domain:
+        raise DeviceTransferError(
+            f"placement-domain mismatch: this replica is in {domain!r}, "
+            f"{target_url!r} advertises {peer_domain!r}")
+    model = engine.cfg.name
+    if engine.sc.serving_chunk_tokens > 0:
+        return _streamed_device_push(engine, peer, tokens, model, window)
+    out = engine.export_handoff_device(tokens)
+    adopted = peer.adopt_handoff_device(out["tokens"], out["sections"],
+                                        model=model)
+    return {"pages": out["pages"], "bytes": adopted["bytes"],
+            "covered_tokens": out["covered_tokens"],
+            "matched_tokens": out["matched_tokens"],
+            "streamed": False, "adopted": adopted["pages"],
+            "path": "device"}

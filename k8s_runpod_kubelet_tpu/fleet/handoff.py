@@ -218,6 +218,64 @@ def deserialize_pages(blob: bytes, *,
     return header, sections
 
 
+def check_device_sections(tokens: list, sections: dict, *,
+                          expect_page_tokens: int,
+                          expect_sections: Optional[dict] = None,
+                          expect_model: Optional[str] = None,
+                          model: str = "",
+                          allow_padded: bool = False
+                          ) -> tuple[int, dict, int]:
+    """The deserialization-side contract (``deserialize_pages``'
+    model/section-set/dtype/trailing-shape/page-geometry checks) applied
+    directly to LIVE arrays — ONE definition for every device-path door
+    (the stream assembler's per-fragment check and the engine's
+    monolithic adopt), so the wire and device contracts cannot drift.
+    Duck-typed on ``.dtype``/``.shape``: device buffers never touch
+    numpy. ``allow_padded`` accepts pow2-padded runs (export_run) and
+    returns them trimmed to the true page count (a device-side slice —
+    how the padding dies without a host copy); exact-width callers get
+    their sections back untouched. Returns (n_pages, sections, nbytes);
+    raises HandoffError on any mismatch."""
+    t = expect_page_tokens
+    if not tokens or len(tokens) % t:
+        raise HandoffError(
+            f"device run token count {len(tokens)} is not a multiple of "
+            f"page_tokens {t}")
+    n = len(tokens) // t
+    if expect_model is not None and model != expect_model:
+        raise HandoffError(
+            f"model mismatch: device run holds KV from {model!r}, "
+            f"this replica serves {expect_model!r}")
+    if expect_sections is not None:
+        got, want = set(sections), set(expect_sections)
+        if got != want:
+            raise HandoffError(
+                f"section-set mismatch: device run has {sorted(got)}, "
+                f"arena needs {sorted(want)}")
+    nbytes = 0
+    out = {}
+    for name, a in sections.items():
+        page_ok = a.shape[1] >= n if allow_padded else a.shape[1] == n
+        if a.ndim < 3 or not page_ok or a.shape[2] != t:
+            raise HandoffError(
+                f"device section {name!r} shape {tuple(a.shape)} is not "
+                f"(L, {n}, {t}, ...)")
+        if expect_sections is not None:
+            exp_dtype, exp_tail = expect_sections[name]
+            if str(a.dtype) != exp_dtype \
+                    and str(a.dtype) != _dtype(exp_dtype).name:
+                raise HandoffError(
+                    f"dtype mismatch on {name!r}: device run {a.dtype}, "
+                    f"arena {exp_dtype}")
+            if tuple(exp_tail) != tuple(a.shape[3:]):
+                raise HandoffError(
+                    f"device section {name!r} trailing shape "
+                    f"{tuple(a.shape[3:])} != arena's {tuple(exp_tail)}")
+        out[name] = a[:, :n] if a.shape[1] != n else a
+        nbytes += int(out[name].size) * int(out[name].dtype.itemsize)
+    return n, out, nbytes
+
+
 # -- streaming chunk frames (ISSUE 10) ----------------------------------------
 
 def serialize_chunk_frame(stream_id: str, seq: int, payload: bytes, *,
@@ -283,6 +341,19 @@ def parse_chunk_frame(blob: bytes) -> tuple[dict, bytes]:
     return header, blob[off:]
 
 
+def merge_section_frames(done: dict) -> dict[str, np.ndarray]:
+    """One {name: (L, n, T, ...)} dict from a closed stream's per-frame
+    section dicts (``_close`` hands frames back unmerged so the adoption
+    hot path chooses WHERE the concat runs). This is the HOST merge for
+    wire-tier consumers; device adopters concatenate device-side instead
+    (the serving engine's _merged_stream_sections)."""
+    frames = done["section_frames"]
+    if len(frames) == 1:
+        return dict(frames[0])
+    return {name: np.concatenate([f[name] for f in frames], axis=1)
+            for name in frames[0]}
+
+
 class _StreamState:
     __slots__ = ("next_seq", "tokens", "sections", "nbytes", "last_seen")
 
@@ -301,18 +372,30 @@ class HandoffStreamAssembler:
     page accounting by construction (a torn/duplicate/reordered/stale
     stream leaves both arenas exactly as they were).
 
+    Two entry points share ONE seq/TTL state machine: ``feed`` takes wire
+    chunk frames (parse + deserialize, the HTTP path), ``feed_fragment``
+    takes already-materialized section arrays (the DEVICE transfer path,
+    ISSUE 11 — same ordering/TTL/total_tokens discipline, just no
+    serialize/deserialize in the middle; fragments buffer as device
+    arrays and never touch numpy). A stream id is one stream regardless
+    of which door its frames came through — a sender that mixed paths
+    mid-stream still gets strict-seq treatment.
+
     Rejection surface (each raises HandoffError and DROPS the stream —
     once a stream carried one bad frame nothing later may resurrect it):
     out-of-order or duplicate ``seq``; a frame for an unknown stream not
     starting at seq 0 (stale sender, or the stream was already dropped);
     per-frame payload validation (deserialize_pages with the adopting
-    arena's expectations); a final ``total_tokens`` that disagrees with
+    arena's expectations, or the same geometry checks applied directly to
+    device fragments); a final ``total_tokens`` that disagrees with
     what actually arrived; idle streams past ``ttl_s`` (GC'd on every
-    feed — an abandoned sender must not pin host memory forever).
+    feed — an abandoned sender must not pin host memory forever, and a
+    final frame arriving AFTER its stream expired is stale, not a
+    resurrection).
 
-    Not thread-safe: the engine serializes ``feed`` under its handoff
-    lock. ``clock`` is injectable (tests drive the TTL deterministically).
-    """
+    Not thread-safe: the engine serializes ``feed``/``feed_fragment``
+    under its handoff lock. ``clock`` is injectable (tests drive the TTL
+    deterministically)."""
 
     def __init__(self, *, expect_page_tokens: int,
                  expect_sections: Optional[dict] = None,
@@ -337,16 +420,10 @@ class HandoffStreamAssembler:
             del self._streams[sid]
         return len(dead)
 
-    def feed(self, blob: bytes) -> dict:
-        """One frame in. Returns {"final": False, "seq"} while the stream
-        is still open, or — on a valid final frame — {"final": True,
-        "seq", "tokens", "sections", "bytes", "frames"} ready for arena
-        adoption. Raises HandoffError (stream dropped) on any
-        rejection."""
-        now = self.clock()
-        self._gc(now)
-        header, payload = parse_chunk_frame(blob)
-        sid, seq = header["stream"], header["seq"]
+    def _advance(self, sid: str, seq: int, now: float) -> _StreamState:
+        """The shared seq/TTL state machine: open-at-0, strict order,
+        bounded stream count. Raises HandoffError (dropping the stream on
+        an order violation) — both feed doors go through here."""
         st = self._streams.get(sid)
         if st is None:
             if seq != 0:
@@ -366,6 +443,41 @@ class HandoffStreamAssembler:
                 f"{st.next_seq}) — stream dropped, nothing adopted")
         st.last_seen = now
         st.next_seq += 1
+        return st
+
+    def _close(self, sid: str, st: _StreamState, seq: int,
+               total_tokens) -> dict:
+        """Final-frame checks + result assembly. The payload comes back
+        as ``section_frames`` — the per-frame dicts, NOT concatenated:
+        the adopter merges them itself (``merge_section_frames`` below,
+        device-side for device fragments), so the close never pays a
+        host-side copy of the whole run on the adoption hot path."""
+        if total_tokens != len(st.tokens):
+            self._streams.pop(sid, None)
+            raise HandoffError(
+                f"torn stream {sid!r}: final frame claims {total_tokens} "
+                f"tokens, {len(st.tokens)} arrived")
+        if not st.tokens:
+            self._streams.pop(sid, None)
+            raise HandoffError(f"stream {sid!r} closed with no pages")
+        frames = st.next_seq
+        del self._streams[sid]
+        return {"final": True, "seq": seq, "tokens": list(st.tokens),
+                "bytes": st.nbytes, "frames": frames,
+                "section_frames": list(st.sections)}
+
+    def feed(self, blob: bytes) -> dict:
+        """One WIRE frame in. Returns {"final": False, "seq"} while the
+        stream is still open, or — on a valid final frame — {"final":
+        True, "seq", "tokens", "section_frames", "bytes", "frames"}
+        ready for arena adoption (merge the frames with
+        ``merge_section_frames`` or device-side). Raises HandoffError
+        (stream dropped) on any rejection."""
+        now = self.clock()
+        self._gc(now)
+        header, payload = parse_chunk_frame(blob)
+        sid, seq = header["stream"], header["seq"]
+        st = self._advance(sid, seq, now)
         try:
             if payload:
                 hdr, sections = deserialize_pages(
@@ -375,23 +487,45 @@ class HandoffStreamAssembler:
                 st.tokens.extend(hdr["tokens"])
                 st.sections.append(sections)
             st.nbytes += len(blob)
-            if not header.get("final"):
-                return {"final": False, "seq": seq}
-            total = header.get("total_tokens")
-            if total != len(st.tokens):
-                raise HandoffError(
-                    f"torn stream {sid!r}: final frame claims {total} "
-                    f"tokens, {len(st.tokens)} arrived")
-            if not st.tokens:
-                raise HandoffError(
-                    f"stream {sid!r} closed with no pages")
         except HandoffError:
             self._streams.pop(sid, None)
             raise
-        frames = st.next_seq
-        del self._streams[sid]
-        sections = {name: np.concatenate([s[name] for s in st.sections],
-                                         axis=1)
-                    for name in st.sections[0]}
-        return {"final": True, "seq": seq, "tokens": list(st.tokens),
-                "sections": sections, "bytes": st.nbytes, "frames": frames}
+        if not header.get("final"):
+            return {"final": False, "seq": seq}
+        return self._close(sid, st, seq, header.get("total_tokens"))
+
+    def feed_fragment(self, stream_id: str, seq: int, tokens: list,
+                      sections: dict, *, final: bool = False,
+                      total_tokens=None, model: str = "") -> dict:
+        """One DEVICE fragment in — the zero-serialization door (ISSUE
+        11): ``sections[name]`` is an (L, n, T, ...) device (or host)
+        array for this fragment's pages, already trimmed to its true page
+        count. Same state machine, TTL and all-or-nothing close as
+        ``feed``; the final result carries ``section_frames`` (per-frame
+        dicts, NOT concatenated — the adopter concatenates device-side).
+        A pure close fragment passes empty tokens/sections and
+        ``final=True`` with ``total_tokens``."""
+        if not stream_id:
+            raise HandoffError("empty stream id")
+        if final and total_tokens is None:
+            raise HandoffError("final fragment needs total_tokens")
+        now = self.clock()
+        self._gc(now)
+        st = self._advance(str(stream_id), int(seq), now)
+        sid = str(stream_id)
+        try:
+            if tokens or sections:
+                _, checked, nbytes = check_device_sections(
+                    list(tokens), sections,
+                    expect_page_tokens=self.expect_page_tokens,
+                    expect_sections=self.expect_sections,
+                    expect_model=self.expect_model, model=model)
+                st.nbytes += nbytes
+                st.tokens.extend(int(tk) for tk in tokens)
+                st.sections.append(checked)
+        except HandoffError:
+            self._streams.pop(sid, None)
+            raise
+        if not final:
+            return {"final": False, "seq": seq}
+        return self._close(sid, st, seq, total_tokens)

@@ -112,6 +112,13 @@ class Replica:
     base_url: str
     pod_name: str = ""           # the k8s pod backing it (autoscaler's handle)
     role: str = UNIFIED          # disaggregated pool membership (ISSUE 9)
+    # device-native KV transfer (ISSUE 11): replicas advertising EQUAL
+    # non-empty placement domains are co-located closely enough to hand
+    # device buffers arena-to-arena — the router plans same-domain
+    # prefill->decode hops over the device path, everything else rides
+    # the wire codec. "" = wire-only (the safe default for replicas that
+    # never advertised one).
+    placement_domain: str = ""
     state: str = READY
     registered_at: float = 0.0
     last_heartbeat_at: float = 0.0
@@ -127,6 +134,7 @@ class Replica:
     def to_dict(self, now: float) -> dict:
         return {"replica_id": self.replica_id, "base_url": self.base_url,
                 "pod_name": self.pod_name, "role": self.role,
+                "placement_domain": self.placement_domain,
                 "state": self.state,
                 "age_s": round(now - self.registered_at, 3),
                 "heartbeat_age_s": round(now - self.last_heartbeat_at, 3),
@@ -208,7 +216,8 @@ class ReplicaRegistry:
     # -- membership ------------------------------------------------------------
 
     def register(self, replica_id: str, base_url: str,
-                 pod_name: str = "", role: str = UNIFIED) -> Replica:
+                 pod_name: str = "", role: str = UNIFIED,
+                 placement_domain: str = "") -> Replica:
         if not replica_id or not base_url:
             raise ValueError("replica_id and base_url are required")
         role = role or UNIFIED
@@ -227,6 +236,11 @@ class ReplicaRegistry:
                 self._replicas[replica_id] = rep
             rep.pod_name = pod_name or rep.pod_name
             rep.role = role
+            # registration-level (not heartbeat): co-location cannot
+            # change without a restart, and a re-registration that stops
+            # advertising a domain must drop to wire-only, not keep a
+            # stale device claim
+            rep.placement_domain = str(placement_domain or "")
             rep.state = READY
             rep.last_heartbeat_at = now
         if self.metrics is not None:
@@ -398,13 +412,15 @@ class ReplicaReporter:
     def __init__(self, engine, router_url: str, replica_id: str,
                  advertise_url: str, pod_name: str = "",
                  interval_s: float = 2.0, post_fn=None,
-                 role: str = UNIFIED):
+                 role: str = UNIFIED, placement_domain: str = ""):
         self.engine = engine
         self.router_url = router_url.rstrip("/")
         self.replica_id = replica_id
         self.advertise_url = advertise_url
         self.pod_name = pod_name
         self.role = role or UNIFIED
+        # device-transfer co-location claim (ISSUE 11); "" = wire-only
+        self.placement_domain = placement_domain
         self.interval_s = interval_s
         self._post = post_fn or self._http_post
         self._stop = threading.Event()
@@ -484,7 +500,8 @@ class ReplicaReporter:
                    {"replica_id": self.replica_id,
                     "base_url": self.advertise_url,
                     "pod_name": self.pod_name,
-                    "role": self.role})
+                    "role": self.role,
+                    "placement_domain": self.placement_domain})
 
     def beat_once(self) -> bool:
         """One heartbeat (re-registering if the router forgot us); returns

@@ -68,6 +68,12 @@ class RouterConfig:
     # prefill replica's compute + its page push to the decode replica);
     # a hop that outlives it falls back to a single-hop route
     handoff_timeout_s: float = 30.0
+    # device-native KV transfer (ISSUE 11): when both hop replicas
+    # advertise the SAME non-empty placement domain, ask the prefill
+    # replica to hand pages arena-to-arena (zero host copies); it
+    # downgrades to the wire codec itself on any device-path failure.
+    # False = every hop rides the wire.
+    device_transfer_enabled: bool = True
 
 
 def affinity_key_for(path: str, body: dict, prefix_chars: int = 64,
@@ -263,11 +269,21 @@ class FleetRouter:
         span_id = Tracer.new_span_id()
         ok, skipped, pages, nbytes, err = False, False, 0, 0, ""
         streamed, chunks, overlap = False, 0, None
+        # device-path annotation (ISSUE 11): same non-empty placement
+        # domain on both replicas = the prefill side may hand pages
+        # arena-to-arena. The router only ANNOTATES; the prefill replica
+        # decides per hop and reports the path it actually took (it
+        # downgrades device -> wire itself on any failure).
+        domain = prefill_rep.placement_domain
+        device_ok = bool(self.cfg.device_transfer_enabled and domain
+                         and domain == decode_rep.placement_domain)
+        hop_path = "wire"
         try:
             out = prefill_rep.transport.request(
                 "POST", "/kv_prefill",
                 body={"path": path, "request": payload,
-                      "handoff_to": decode_rep.base_url},
+                      "handoff_to": decode_rep.base_url,
+                      "device": device_ok},
                 timeout_s=self.cfg.handoff_timeout_s,
                 extra_headers={"traceparent": format_traceparent(
                     trace["trace_id"], span_id)})
@@ -275,6 +291,7 @@ class FleetRouter:
                 ok = True
                 pages = int(out.get("pages") or 0)
                 nbytes = int(out.get("bytes") or 0)
+                hop_path = str(out.get("path") or "wire")
                 # streamed hop (ISSUE 10): chunk count + realized
                 # compute/transfer overlap ride the fleet.handoff span
                 # (fleet_summary's overlap column)
@@ -309,6 +326,11 @@ class FleetRouter:
                        "ok": ok, "outcome": outcome, "pages": pages,
                        "bytes": nbytes, "streamed": streamed,
                        "chunks": chunks, "overlap_ratio": overlap,
+                       # the transfer path the hop ACTUALLY took
+                       # (device|wire) + the co-location the router saw:
+                       # fleet_summary rolls handoffs up per path/domain
+                       "path": hop_path,
+                       "domain": domain if device_ok else "",
                        "error": err or None})
         except Exception:  # noqa: BLE001 — tracing must never fail a request
             log.exception("fleet.handoff span recording failed")
@@ -620,10 +642,13 @@ class _RouterHandler(BaseHTTPRequestHandler):
         raw, body = self._read_json()
         if self.path == "/fleet/register":
             try:
-                rep = rt.registry.register(str(body.get("replica_id") or ""),
-                                           str(body.get("base_url") or ""),
-                                           str(body.get("pod_name") or ""),
-                                           role=str(body.get("role") or ""))
+                rep = rt.registry.register(
+                    str(body.get("replica_id") or ""),
+                    str(body.get("base_url") or ""),
+                    str(body.get("pod_name") or ""),
+                    role=str(body.get("role") or ""),
+                    placement_domain=str(body.get("placement_domain")
+                                         or ""))
             except ValueError as e:
                 return self._send(400, {"error": str(e)})
             return self._send(200, {"registered": rep.replica_id,

@@ -79,6 +79,12 @@ def parse_flags(argv):
                    dest="fleet_handoff_timeout_s", type=float, default=None,
                    help="budget for the prefill hop (compute + page push); "
                         "past it the router falls back to single-hop")
+    p.add_argument("--device-transfer", default=None, choices=["on", "off"],
+                   dest="fleet_device_transfer_enabled",
+                   help="annotate same-placement-domain two-hop routes for "
+                        "device-native KV handoff (arena-to-arena, zero "
+                        "host copies); off = every hop rides the wire "
+                        "codec")
     p.add_argument("--scale-up-cooldown", dest="fleet_scale_up_cooldown_s",
                    type=float, default=None)
     p.add_argument("--scale-down-cooldown",
@@ -123,7 +129,9 @@ def build(cfg: config_mod.Config, kube=None, autoscale: bool = False,
     router = FleetRouter(
         registry,
         RouterConfig(port=cfg.fleet_router_port,
-                     handoff_timeout_s=cfg.fleet_handoff_timeout_s),
+                     handoff_timeout_s=cfg.fleet_handoff_timeout_s,
+                     device_transfer_enabled=(
+                         cfg.fleet_device_transfer_enabled)),
         metrics=metrics, tracer=tracer)
     autoscalers = []
     if autoscale:
@@ -163,6 +171,11 @@ def build(cfg: config_mod.Config, kube=None, autoscale: bool = False,
 
 def main(argv=None) -> int:
     args = parse_flags(argv if argv is not None else sys.argv[1:])
+    if args.fleet_device_transfer_enabled is not None:
+        # choices are "on"/"off"; config's bool coercion only knows
+        # true/false/1/yes spellings
+        args.fleet_device_transfer_enabled = \
+            args.fleet_device_transfer_enabled == "on"
     known = {f.name for f in dataclasses.fields(config_mod.Config)}
     overrides = {k: v for k, v in vars(args).items()
                  if v is not None and k in known}

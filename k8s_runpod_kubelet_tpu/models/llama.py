@@ -29,7 +29,8 @@ from jax.sharding import Mesh
 
 from ..ops import (apply_rope, flash_attention, paged_attention,
                    ring_attention, rms_norm, rope_frequencies)
-from ..ops.attention import paged_attention_mla, paged_attention_quant
+from ..ops.attention import (paged_attention_mla, paged_attention_mla_quant,
+                             paged_attention_quant)
 from .moe import moe_mlp
 from ..parallel.mesh import AXES
 from ..parallel.pipeline import pipeline_spmd, pipeline_stages
@@ -1546,30 +1547,41 @@ class LlamaModel:
         verbatim for TP). Covers plain dense K/V, int8 K/V
         (``quantize=True``: int8 payload + per-(position, kv-head) f32
         scale sections paged alongside) and MLA latent layouts (c/kr —
-        and c_pre/kr_pre for dense-prefix models — no heads axis).
-        Sliding-window layouts cannot page (positions ring-overwrite);
-        the int8 LATENT combination is not paged yet."""
+        and c_pre/kr_pre for dense-prefix models — no heads axis),
+        INCLUDING the int8 LATENT combination (``quantize=True`` on an
+        MLA config: int8 c/kr with per-position f32 scale sections).
+        UNIFORM sliding-window models (pattern 1) page too: positions
+        store linearly, the decode kernel masks/skips outside the window,
+        and the serving engine recycles out-of-window pages through the
+        slot's ring run — only the windowed INTERLEAVE (pattern > 1,
+        split ring/global cache) cannot page."""
         cfg = self.cfg
-        if cfg.sliding_window is not None:
-            raise ValueError("paged decode covers full-attention layouts "
-                             "(no sliding-window yet)")
+        if cfg.sliding_window is not None and cfg.sliding_window_pattern != 1:
+            raise ValueError("paged decode covers uniform sliding windows "
+                             "only (pattern 1); the windowed interleave's "
+                             "split ring/global cache cannot page")
         if cfg.is_mla:
-            if quantize:
-                raise ValueError("paged decode does not cover the int8 "
-                                 "LATENT cache yet (plain-K/V int8 pages "
-                                 "fine)")
+            dt = jnp.int8 if quantize else cfg.dtype
             r, dr = cfg.mla_latent_dim, cfg.mla_rope_dim
             kpre = cfg.n_dense_prefix
             lm = cfg.n_layers - kpre
-            arena = {"c": jnp.zeros((lm, n_pages, page_tokens, r),
-                                    cfg.dtype),
-                     "kr": jnp.zeros((lm, n_pages, page_tokens, dr),
-                                     cfg.dtype)}
+            arena = {"c": jnp.zeros((lm, n_pages, page_tokens, r), dt),
+                     "kr": jnp.zeros((lm, n_pages, page_tokens, dr), dt)}
+            if quantize:
+                arena["c_scale"] = jnp.zeros((lm, n_pages, page_tokens),
+                                             jnp.float32)
+                arena["kr_scale"] = jnp.zeros((lm, n_pages, page_tokens),
+                                              jnp.float32)
             if kpre:
                 arena["c_pre"] = jnp.zeros((kpre, n_pages, page_tokens, r),
-                                           cfg.dtype)
+                                           dt)
                 arena["kr_pre"] = jnp.zeros((kpre, n_pages, page_tokens, dr),
-                                            cfg.dtype)
+                                            dt)
+                if quantize:
+                    arena["c_pre_scale"] = jnp.zeros(
+                        (kpre, n_pages, page_tokens), jnp.float32)
+                    arena["kr_pre_scale"] = jnp.zeros(
+                        (kpre, n_pages, page_tokens), jnp.float32)
             return arena
         shape = (cfg.n_layers, n_pages, page_tokens, cfg.n_kv_heads,
                  cfg.head_dim_)
@@ -1600,16 +1612,22 @@ class LlamaModel:
         (tests pin it); this is the decode path disaggregated prefill/
         decode (ROADMAP item 2) ships KV pages into.
 
-        Layouts (ISSUE 10 lifted the plain-dense-only gate): plain K/V,
-        int8 K/V (k_scale/v_scale sections page alongside; the new
-        token's row quantizes exactly like the contiguous int8 cache and
-        attention dequantizes in kernel — paged_attention_quant), and MLA
-        latents (c/kr ± dense-prefix sections — paged_attention_mla).
-        Sliding-window layouts still cannot page."""
+        Layouts (ISSUE 10 lifted the plain-dense-only gate; ISSUE 11
+        finished the matrix): plain K/V, int8 K/V (k_scale/v_scale
+        sections page alongside; the new token's row quantizes exactly
+        like the contiguous int8 cache and attention dequantizes in
+        kernel — paged_attention_quant), MLA latents (c/kr ± dense-prefix
+        sections — paged_attention_mla), the int8 LATENT combination
+        (paged_attention_mla_quant), and UNIFORM sliding windows (the
+        kernels mask/skip outside the window; table entries behind
+        ``length - window`` are never read, so the caller may recycle
+        their physical pages — the engine's ring run). Only the windowed
+        interleave (pattern > 1) still cannot page."""
         cfg = self.cfg
-        if cfg.sliding_window is not None:
-            raise ValueError("paged decode covers full-attention layouts "
-                             "(no sliding-window yet)")
+        if cfg.sliding_window is not None and cfg.sliding_window_pattern != 1:
+            raise ValueError("paged decode covers uniform sliding windows "
+                             "only (pattern 1); the windowed interleave's "
+                             "split ring/global cache cannot page")
         if cfg.is_mla:
             return self._paged_decode_step_mla(
                 params, token, arena, page_tables, lengths, active,
@@ -1630,7 +1648,10 @@ class LlamaModel:
         # value.
         pages_b = jnp.where(active, pages_b, arena["k"].shape[1])
         offs = positions % t
-        cos, sin = _rope_for(_rope_tables(cfg), None)
+        # uniform-window models rotate with the LOCAL table when one
+        # exists (same selection the prefill/verify paths make per layer;
+        # pattern == 1 means every layer is the windowed kind)
+        cos, sin = _rope_for(_rope_tables(cfg), cfg.sliding_window)
         x = _embed(params, token[:, None], cfg, self.mesh)     # (B, 1, E)
         att_len = positions + 1  # the just-written token attends itself
 
@@ -1657,6 +1678,7 @@ class LlamaModel:
                     q[:, 0], kp, vp, ks, vs, page_tables, att_len,
                     sm_scale=cfg.sm_scale,
                     logit_soft_cap=cfg.attn_logit_softcap,
+                    sliding_window=cfg.sliding_window,
                     use_pallas=use_pallas, interpret=interpret)
             else:
                 kp = kp.at[pages_b, offs].set(k[:, 0], mode="drop")
@@ -1664,6 +1686,7 @@ class LlamaModel:
                 o = paged_attention(q[:, 0], kp, vp, page_tables, att_len,
                                     sm_scale=cfg.sm_scale,
                                     logit_soft_cap=cfg.attn_logit_softcap,
+                                    sliding_window=cfg.sliding_window,
                                     use_pallas=use_pallas,
                                     interpret=interpret)
             o = o.reshape(b, 1, cfg.n_heads * cfg.head_dim_).astype(cfg.dtype)
@@ -1707,11 +1730,12 @@ class LlamaModel:
         the page table (ops.paged_attention_mla), never materializing
         per-head K/V. Dense-prefix models' c_pre/kr_pre sections page
         under the SAME page ids (a page spans every layer's slice, like
-        the plain arena's layer axis)."""
+        the plain arena's layer axis). int8 LATENT arenas (``c_scale`` in
+        the arena) quantize the new row exactly like the contiguous int8
+        latent cache (_kv_quant per position) and attend through
+        ops.paged_attention_mla_quant (dequant in kernel)."""
         cfg = self.cfg
-        if "c_scale" in arena:
-            raise ValueError("paged decode does not cover the int8 LATENT "
-                             "cache yet")
+        quant = "c_scale" in arena
         b = token.shape[0]
         if active is None:
             active = jnp.ones((b,), bool)
@@ -1735,22 +1759,38 @@ class LlamaModel:
         def make_block(cfg_):
             def block(y, inputs):
                 lp, cp, krp = inputs["lp"], inputs["c"], inputs["kr"]
+                cs, krs = inputs.get("cs"), inputs.get("krs")
                 h = rms_norm(y, _norm_w(lp["attn_norm"], cfg_),
                              cfg_.norm_eps)
                 q_nope, q_rope, c1, kr1 = _mla_project(h, lp, cfg_, cos,
                                                        sin, pos2, b, 1)
-                cp = cp.at[pages_b, offs].set(c1[:, 0], mode="drop")
-                krp = krp.at[pages_b, offs].set(kr1[:, 0], mode="drop")
+                c_w, kr_w = c1[:, 0], kr1[:, 0]
+                if quant:
+                    # same per-position symmetric scheme as the contiguous
+                    # int8 latent cache, so pages and slot caches
+                    # interchange (and hand off) without requantization
+                    c_w, c_s = _kv_quant(c_w)          # (B,r) i8, (B,)
+                    kr_w, kr_s = _kv_quant(kr_w)
+                    cs = cs.at[pages_b, offs].set(c_s, mode="drop")
+                    krs = krs.at[pages_b, offs].set(kr_s, mode="drop")
+                cp = cp.at[pages_b, offs].set(c_w, mode="drop")
+                krp = krp.at[pages_b, offs].set(kr_w, mode="drop")
                 w_uk = lp["w_uk"].reshape(r, hn, hd)
                 # absorbed query: the w_uk fold happens HERE, once per
                 # step, so attention reads the (r + dr) latents directly
                 q_lat = jnp.einsum("bhd,rhd->bhr",
                                    q_nope[:, 0].astype(jnp.float32),
                                    w_uk.astype(jnp.float32))
-                o_lat = paged_attention_mla(
-                    q_lat, q_rope[:, 0].astype(jnp.float32), cp, krp,
-                    page_tables, att_len, sm_scale=scale,
-                    use_pallas=use_pallas, interpret=interpret)
+                if quant:
+                    o_lat = paged_attention_mla_quant(
+                        q_lat, q_rope[:, 0].astype(jnp.float32), cp, krp,
+                        cs, krs, page_tables, att_len, sm_scale=scale,
+                        use_pallas=use_pallas, interpret=interpret)
+                else:
+                    o_lat = paged_attention_mla(
+                        q_lat, q_rope[:, 0].astype(jnp.float32), cp, krp,
+                        page_tables, att_len, sm_scale=scale,
+                        use_pallas=use_pallas, interpret=interpret)
                 w_uv = lp["w_uv"].reshape(r, hn, hd)
                 o = jnp.einsum("bhr,rhd->bhd", o_lat.astype(jnp.float32),
                                w_uv.astype(jnp.float32))
@@ -1761,23 +1801,37 @@ class LlamaModel:
                                  cfg_.norm_eps)
                 y = y + o
                 y, _ = _mlp_block(y, lp, cfg_, self.mesh, train=False)
-                return y, {"c": cp, "kr": krp}
+                out = {"c": cp, "kr": krp}
+                if quant:
+                    out["cs"], out["krs"] = cs, krs
+                return y, out
             return block
+
+        def make_xs(lp_tree, suffix):
+            xs_ = {"lp": lp_tree, "c": arena[f"c{suffix}"],
+                   "kr": arena[f"kr{suffix}"]}
+            if quant:
+                xs_["cs"] = arena[f"c{suffix}_scale"]
+                xs_["krs"] = arena[f"kr{suffix}_scale"]
+            return xs_
 
         new_pre = None
         if cfg.n_dense_prefix:
             x, new_pre = jax.lax.scan(
                 make_block(cfg.prefix_cfg()), x,
-                {"lp": params["prefix_layers"], "c": arena["c_pre"],
-                 "kr": arena["kr_pre"]})
+                make_xs(params["prefix_layers"], "_pre"))
         x, new_kv = jax.lax.scan(make_block(cfg), x,
-                                 {"lp": params["layers"], "c": arena["c"],
-                                  "kr": arena["kr"]})
+                                 make_xs(params["layers"], ""))
         x = rms_norm(x, _norm_w(params["final_norm"], cfg), cfg.norm_eps)
         logits = _head_logits(x, params, cfg).astype(jnp.float32)[:, 0]
         out = {"c": new_kv["c"], "kr": new_kv["kr"]}
+        if quant:
+            out["c_scale"], out["kr_scale"] = new_kv["cs"], new_kv["krs"]
         if new_pre is not None:
             out["c_pre"], out["kr_pre"] = new_pre["c"], new_pre["kr"]
+            if quant:
+                out["c_pre_scale"] = new_pre["cs"]
+                out["kr_pre_scale"] = new_pre["krs"]
         new_lengths = jnp.where(active, lengths + 1, lengths)
         return logits, out, new_lengths
 

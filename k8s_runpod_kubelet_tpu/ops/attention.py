@@ -462,9 +462,23 @@ _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
 
 # -- paged-attention decode kernel (ISSUE 8) ----------------------------------
 
+def _paged_valid(n_tokens: int, lengths, window: Optional[int]):
+    """(B, S) mask of attendable positions for a decode query at position
+    ``lengths - 1``: causal (< length) and — for uniform sliding-window
+    models — within the last ``window`` positions (>= length - window).
+    One definition shared by every paged reference path, so the window
+    semantics can't drift between layouts."""
+    pos = jnp.arange(n_tokens)[None, :]
+    valid = pos < lengths[:, None]
+    if window is not None:
+        valid &= pos >= lengths[:, None] - window
+    return valid
+
+
 def _paged_attention_xla(q, k_pages, v_pages, page_table, lengths, *,
                          sm_scale: float,
-                         logit_soft_cap: Optional[float] = None) -> jax.Array:
+                         logit_soft_cap: Optional[float] = None,
+                         sliding_window: Optional[int] = None) -> jax.Array:
     """Pure-jnp reference path: gather the page table back into a
     contiguous (B, S, Hkv, D) view and run ordinary masked decode
     attention. Identical math to the Pallas kernel (f32 statistics, input
@@ -480,7 +494,7 @@ def _paged_attention_xla(q, k_pages, v_pages, page_table, lengths, *,
     s = jnp.einsum("bhgd,bLhd->bhgL", qg, k.astype(jnp.float32))
     if logit_soft_cap is not None:
         s = jnp.tanh(s / logit_soft_cap) * logit_soft_cap
-    valid = jnp.arange(n * t)[None, :] < lengths[:, None]  # (B, S)
+    valid = _paged_valid(n * t, lengths, sliding_window)   # (B, S)
     s = jnp.where(valid[:, None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bhgL,bLhd->bhgd", p, v.astype(jnp.float32))
@@ -490,12 +504,17 @@ def _paged_attention_xla(q, k_pages, v_pages, page_table, lengths, *,
 def _paged_fwd_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
                       acc_ref, m_ref, l_ref, *, page_tokens: int,
                       num_pages: int, sm_scale: float,
-                      soft_cap: Optional[float] = None):
+                      soft_cap: Optional[float] = None,
+                      window: Optional[int] = None):
     """One (batch row, kv head, page) program: online-softmax accumulate
     the page's contribution. The PAGE TABLE is scalar-prefetched, so the
     BlockSpec index map DMAs exactly the page this program needs — the
     K/V gather over non-contiguous HBM pages IS the index map; no
-    contiguous copy of the sequence ever exists."""
+    contiguous copy of the sequence ever exists. ``window`` (uniform
+    sliding-window models on a paged ring run): pages fully behind
+    ``length - window`` are SKIPPED entirely — their table entries may
+    alias recycled physical pages, so they must never be read — making
+    the per-step work O(window), not O(context)."""
     import jax.experimental.pallas as pl  # noqa: F401 (kernel-only import)
     b = pl.program_id(0)
     i = pl.program_id(2)
@@ -507,8 +526,11 @@ def _paged_fwd_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
 
     length = len_ref[b]
+    live = i * page_tokens < length
+    if window is not None:
+        live &= (i + 1) * page_tokens > length - window
 
-    @pl.when(i * page_tokens < length)
+    @pl.when(live)
     def _compute():
         q = q_ref[0, 0].astype(jnp.float32) * sm_scale      # (Gp, D)
         kc = k_ref[0, :, 0].astype(jnp.float32)             # (T, D)
@@ -519,7 +541,10 @@ def _paged_fwd_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
             s = jnp.tanh(s / soft_cap) * soft_cap
         pos = i * page_tokens + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1)
-        s = jnp.where(pos < length, s, NEG_INF)
+        keep = pos < length
+        if window is not None:
+            keep &= pos >= length - window
+        s = jnp.where(keep, s, NEG_INF)
         m_prev = m_ref[:, :1]                               # (Gp, 1)
         l_prev = l_ref[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -540,7 +565,8 @@ def _paged_fwd_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
 
 def _paged_attention_pallas(q, k_pages, v_pages, page_table, lengths,
                             scale: float, interpret: bool,
-                            soft_cap: Optional[float] = None) -> jax.Array:
+                            soft_cap: Optional[float] = None,
+                            window: Optional[int] = None) -> jax.Array:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -555,7 +581,8 @@ def _paged_attention_pallas(q, k_pages, v_pages, page_table, lengths,
     if gp != group:
         qr = jnp.pad(qr, ((0, 0), (0, 0), (0, gp - group), (0, 0)))
     kernel = functools.partial(_paged_fwd_kernel, page_tokens=t, num_pages=n,
-                               sm_scale=scale, soft_cap=soft_cap)
+                               sm_scale=scale, soft_cap=soft_cap,
+                               window=window)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # page_table, lengths
         grid=(b, hkv, n),
@@ -591,13 +618,15 @@ def _paged_attention_pallas(q, k_pages, v_pages, page_table, lengths,
 
 
 @functools.partial(jax.jit, static_argnames=("sm_scale", "use_pallas",
-                                             "interpret", "logit_soft_cap"))
+                                             "interpret", "logit_soft_cap",
+                                             "sliding_window"))
 def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
                     page_table: jax.Array, lengths: jax.Array, *,
                     sm_scale: Optional[float] = None,
                     use_pallas: Optional[bool] = None,
                     interpret: bool = False,
-                    logit_soft_cap: Optional[float] = None) -> jax.Array:
+                    logit_soft_cap: Optional[float] = None,
+                    sliding_window: Optional[int] = None) -> jax.Array:
     """Paged-attention DECODE: one query token per sequence attends over
     KV scattered across fixed-size pages of a shared arena (the serving
     engine's paged prefix pool; ROADMAP item 2's transfer unit).
@@ -617,6 +646,12 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     program, padded to a full sublane tile. Falls back to the pure-jnp
     gather reference off-TPU or when (T, D) don't tile (T % 8, D % 128).
 
+    ``sliding_window`` (uniform-window models on a paged ring run): the
+    query attends only the last W positions — table entries whose pages
+    sit fully behind the window are never read (the engine recycles their
+    physical pages through the slot's ring run), so they only need to be
+    VALID indices, not live data.
+
     Composes with TP sharding exactly like the contiguous cache:
     k/v_pages shard the kv-heads axis (kv_cache_pspec — same rank/axis as
     the engine cache), q/o shard heads; shard_map the call over ``tensor``
@@ -631,15 +666,20 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     if logit_soft_cap is not None and logit_soft_cap <= 0:
         raise ValueError(f"logit_soft_cap must be positive, "
                          f"got {logit_soft_cap}")
+    if sliding_window is not None and sliding_window <= 0:
+        raise ValueError(f"sliding_window must be positive, "
+                         f"got {sliding_window}")
     scale = sm_scale if sm_scale is not None else d ** -0.5
     pallas_ok = (_use_pallas(use_pallas) or interpret) \
         and d % 128 == 0 and t % 8 == 0
     if not pallas_ok:
         return _paged_attention_xla(q, k_pages, v_pages, page_table, lengths,
                                     sm_scale=scale,
-                                    logit_soft_cap=logit_soft_cap)
+                                    logit_soft_cap=logit_soft_cap,
+                                    sliding_window=sliding_window)
     return _paged_attention_pallas(q, k_pages, v_pages, page_table, lengths,
-                                   scale, interpret, logit_soft_cap)
+                                   scale, interpret, logit_soft_cap,
+                                   sliding_window)
 
 
 # -- paged-attention variants: int8-KV (dequant in kernel) + MLA latents ------
@@ -649,7 +689,8 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
 
 def _paged_attention_quant_xla(q, k_pages, v_pages, k_scale, v_scale,
                                page_table, lengths, *, sm_scale: float,
-                               logit_soft_cap: Optional[float] = None
+                               logit_soft_cap: Optional[float] = None,
+                               sliding_window: Optional[int] = None
                                ) -> jax.Array:
     """Reference path: gather the page table's WORKING SET first, then
     dequantize only that — identical math to the contiguous int8 decode
@@ -670,7 +711,7 @@ def _paged_attention_quant_xla(q, k_pages, v_pages, k_scale, v_scale,
     s = jnp.einsum("bhgd,bLhd->bhgL", qg, k)
     if logit_soft_cap is not None:
         s = jnp.tanh(s / logit_soft_cap) * logit_soft_cap
-    valid = jnp.arange(n * t)[None, :] < lengths[:, None]
+    valid = _paged_valid(n * t, lengths, sliding_window)
     s = jnp.where(valid[:, None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bhgL,bLhd->bhgd", p, v)
@@ -681,11 +722,14 @@ def _paged_fwd_quant_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref,
                             ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref, *,
                             page_tokens: int, num_pages: int, n_kv: int,
                             sm_scale: float,
-                            soft_cap: Optional[float] = None):
+                            soft_cap: Optional[float] = None,
+                            window: Optional[int] = None):
     """The plain paged kernel with int8 K/V pages dequantized IN KERNEL:
     HBM reads stay int8 (the bandwidth win), the f32 scales ride a small
     (T, Hkv) block per page and this program's head column is selected by
-    an iota mask (a (T, 1) lane slice cannot tile)."""
+    an iota mask (a (T, 1) lane slice cannot tile). ``window``: same
+    page-skip + position mask as the plain kernel (out-of-window table
+    entries may alias recycled pages and must never be read)."""
     import jax.experimental.pallas as pl  # noqa: F401 (kernel-only import)
     b = pl.program_id(0)
     h = pl.program_id(1)
@@ -698,8 +742,11 @@ def _paged_fwd_quant_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
 
     length = len_ref[b]
+    live = i * page_tokens < length
+    if window is not None:
+        live &= (i + 1) * page_tokens > length - window
 
-    @pl.when(i * page_tokens < length)
+    @pl.when(live)
     def _compute():
         q = q_ref[0, 0].astype(jnp.float32) * sm_scale      # (Gp, D)
         hsel = jax.lax.broadcasted_iota(
@@ -716,7 +763,10 @@ def _paged_fwd_quant_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref,
             s = jnp.tanh(s / soft_cap) * soft_cap
         pos = i * page_tokens + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1)
-        s = jnp.where(pos < length, s, NEG_INF)
+        keep = pos < length
+        if window is not None:
+            keep &= pos >= length - window
+        s = jnp.where(keep, s, NEG_INF)
         m_prev = m_ref[:, :1]
         l_prev = l_ref[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -738,7 +788,8 @@ def _paged_fwd_quant_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref,
 def _paged_attention_quant_pallas(q, k_pages, v_pages, k_scale, v_scale,
                                   page_table, lengths, scale: float,
                                   interpret: bool,
-                                  soft_cap: Optional[float] = None
+                                  soft_cap: Optional[float] = None,
+                                  window: Optional[int] = None
                                   ) -> jax.Array:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -753,7 +804,7 @@ def _paged_attention_quant_pallas(q, k_pages, v_pages, k_scale, v_scale,
         qr = jnp.pad(qr, ((0, 0), (0, 0), (0, gp - group), (0, 0)))
     kernel = functools.partial(_paged_fwd_quant_kernel, page_tokens=t,
                                num_pages=n, n_kv=hkv, sm_scale=scale,
-                               soft_cap=soft_cap)
+                               soft_cap=soft_cap, window=window)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # page_table, lengths
         grid=(b, hkv, n),
@@ -793,7 +844,8 @@ def _paged_attention_quant_pallas(q, k_pages, v_pages, k_scale, v_scale,
 
 
 @functools.partial(jax.jit, static_argnames=("sm_scale", "use_pallas",
-                                             "interpret", "logit_soft_cap"))
+                                             "interpret", "logit_soft_cap",
+                                             "sliding_window"))
 def paged_attention_quant(q: jax.Array, k_pages: jax.Array,
                           v_pages: jax.Array, k_scale: jax.Array,
                           v_scale: jax.Array, page_table: jax.Array,
@@ -801,7 +853,8 @@ def paged_attention_quant(q: jax.Array, k_pages: jax.Array,
                           sm_scale: Optional[float] = None,
                           use_pallas: Optional[bool] = None,
                           interpret: bool = False,
-                          logit_soft_cap: Optional[float] = None
+                          logit_soft_cap: Optional[float] = None,
+                          sliding_window: Optional[int] = None
                           ) -> jax.Array:
     """``paged_attention`` over an int8-quantized KV arena: k/v_pages are
     int8 (P, T, Hkv, D) with per-(position, kv-head) f32 scales (P, T,
@@ -825,6 +878,9 @@ def paged_attention_quant(q: jax.Array, k_pages: jax.Array,
     if logit_soft_cap is not None and logit_soft_cap <= 0:
         raise ValueError(f"logit_soft_cap must be positive, "
                          f"got {logit_soft_cap}")
+    if sliding_window is not None and sliding_window <= 0:
+        raise ValueError(f"sliding_window must be positive, "
+                         f"got {sliding_window}")
     scale = sm_scale if sm_scale is not None else d ** -0.5
     pallas_ok = (_use_pallas(use_pallas) or interpret) \
         and d % 128 == 0 and t % 8 == 0
@@ -832,10 +888,12 @@ def paged_attention_quant(q: jax.Array, k_pages: jax.Array,
         return _paged_attention_quant_xla(q, k_pages, v_pages, k_scale,
                                           v_scale, page_table, lengths,
                                           sm_scale=scale,
-                                          logit_soft_cap=logit_soft_cap)
+                                          logit_soft_cap=logit_soft_cap,
+                                          sliding_window=sliding_window)
     return _paged_attention_quant_pallas(q, k_pages, v_pages, k_scale,
                                          v_scale, page_table, lengths,
-                                         scale, interpret, logit_soft_cap)
+                                         scale, interpret, logit_soft_cap,
+                                         sliding_window)
 
 
 def _paged_attention_mla_xla(q_lat, q_rope, c_pages, kr_pages, page_table,
@@ -918,6 +976,14 @@ def _paged_attention_mla_pallas(q_lat, q_rope, c_pages, kr_pages, page_table,
     from jax.experimental.pallas import tpu as pltpu
 
     b, hq, r = q_lat.shape
+    # lane alignment: latent (r) and rope (dr) blocks ride at their
+    # NATIVE widths — a block whose minor dims EQUAL the array dims is
+    # always tileable, and Mosaic pads sub-128 lane tiles internally
+    # (the score tile (Gp, T) is already sub-128 at T=8/16), so
+    # DeepSeek's dr=64 runs the real kernel with wasted lanes, not wrong
+    # math — and crucially with NO per-step pad copy of the page arena
+    # (an early draft padded kr_pages to 128 per dispatch: O(pool) bytes
+    # per layer per token, dwarfing the kernel's O(attended pages) reads)
     _, t, _ = c_pages.shape
     dr = kr_pages.shape[2]
     n = page_table.shape[1]
@@ -970,9 +1036,11 @@ def paged_attention_mla(q_lat: jax.Array, q_rope: jax.Array,
     Returns the attention-weighted latent (B, Hq, R) in q_lat's dtype;
     the caller up-projects it through w_uv (exactly the contiguous MLA
     decode split in models/llama.py). Same page-table/lengths contract as
-    paged_attention. Pallas needs R and Dr lane-aligned (each %% 128) and
-    T %% 8; anything else runs the gathered reference — still zero-copy
-    paged, just XLA-fused (DeepSeek's dr=64 lands there today)."""
+    paged_attention. Pallas needs T %% 8; R and Dr ride NATIVE-width
+    blocks (minor dims equal to the array dims always tile; Mosaic pads
+    sub-128 lane tiles in registers — wasted lanes, not wrong math, and
+    no pad copy of the arena), so DeepSeek's dr=64 runs the real kernel
+    and only an untileable page size falls to the gathered reference."""
     b, hq, r = q_lat.shape
     _, t, _ = c_pages.shape
     dr = kr_pages.shape[2]
@@ -983,13 +1051,195 @@ def paged_attention_mla(q_lat: jax.Array, q_rope: jax.Array,
         raise ValueError(f"c_pages {c_pages.shape} / kr_pages "
                          f"{kr_pages.shape} disagree on (P, T)")
     scale = sm_scale if sm_scale is not None else (r + dr) ** -0.5
-    pallas_ok = (_use_pallas(use_pallas) or interpret) \
-        and r % 128 == 0 and dr % 128 == 0 and t % 8 == 0
+    pallas_ok = (_use_pallas(use_pallas) or interpret) and t % 8 == 0
     if not pallas_ok:
         return _paged_attention_mla_xla(q_lat, q_rope, c_pages, kr_pages,
                                         page_table, lengths, sm_scale=scale)
     return _paged_attention_mla_pallas(q_lat, q_rope, c_pages, kr_pages,
                                        page_table, lengths, scale, interpret)
+
+
+def _paged_attention_mla_quant_xla(q_lat, q_rope, c_pages, kr_pages,
+                                   c_scale, kr_scale, page_table, lengths, *,
+                                   sm_scale: float) -> jax.Array:
+    """Reference path for int8-LATENT MLA paged decode: gather the page
+    table's working set, dequantize it (per-position f32 scales — the
+    same scheme as the contiguous int8 latent cache in _verify_step_mla),
+    then the absorbed-form attention. Working-set-first like the int8-K/V
+    reference: dequantizing the whole arena would materialize 4x its
+    bytes in f32 per layer per step."""
+    b, hq, r = q_lat.shape
+    _, t, _ = c_pages.shape
+    n = page_table.shape[1]
+    c = (c_pages[page_table].astype(jnp.float32)
+         * c_scale[page_table][..., None]).reshape(b, n * t, r)
+    kr = (kr_pages[page_table].astype(jnp.float32)
+          * kr_scale[page_table][..., None]).reshape(b, n * t, -1)
+    s = (jnp.einsum("bhr,bLr->bhL",
+                    q_lat.astype(jnp.float32) * sm_scale, c)
+         + jnp.einsum("bhd,bLd->bhL",
+                      q_rope.astype(jnp.float32) * sm_scale, kr))
+    valid = jnp.arange(n * t)[None, :] < lengths[:, None]
+    s = jnp.where(valid[:, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhL,bLr->bhr", p, c)
+    return o.astype(q_lat.dtype)
+
+
+def _paged_fwd_mla_quant_kernel(pt_ref, len_ref, ql_ref, qr_ref, c_ref,
+                                kr_ref, cs_ref, krs_ref, o_ref, acc_ref,
+                                m_ref, l_ref, *, page_tokens: int,
+                                num_pages: int, sm_scale: float):
+    """The MLA paged kernel over int8 latent pages, dequantized IN KERNEL
+    without ever transposing the scale: a per-POSITION scale factors out
+    of the latent dot — ql·(c*s_t) = (ql·c)*s_t and p@(c*s) = (p⊙s)@c —
+    so the (1, T) scale row broadcasts along the score LANE axis instead
+    of needing a (T, 1) reshape Mosaic can't tile. HBM reads stay int8
+    (int8 latents are the smallest KV representation this engine has: r+dr
+    bytes/position/layer)."""
+    import jax.experimental.pallas as pl  # noqa: F401 (kernel-only import)
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    length = len_ref[b]
+
+    @pl.when(i * page_tokens < length)
+    def _compute():
+        ql = ql_ref[0].astype(jnp.float32) * sm_scale       # (Gp, R)
+        qr = qr_ref[0].astype(jnp.float32) * sm_scale       # (Gp, Dr)
+        cc = c_ref[0].astype(jnp.float32)                   # (T, R) int8->f32
+        krc = kr_ref[0].astype(jnp.float32)                 # (T, Dr)
+        cs = cs_ref[...]                                    # (1, T) f32
+        krs = krs_ref[...]
+        s = (jax.lax.dot_general(ql, cc, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32) * cs
+             + jax.lax.dot_general(qr, krc, (((1,), (1,)), ((), ())),
+                                   preferred_element_type=jnp.float32) * krs)
+        pos = i * page_tokens + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(pos < length, s, NEG_INF)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        # output = p @ (c * scale) == (p ⊙ scale_row) @ c: dequant rides
+        # the probability row, never a transposed scale column
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+            p * cs, cc, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(i == num_pages - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def _paged_attention_mla_quant_pallas(q_lat, q_rope, c_pages, kr_pages,
+                                      c_scale, kr_scale, page_table, lengths,
+                                      scale: float,
+                                      interpret: bool) -> jax.Array:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, hq, r = q_lat.shape
+    # native-width latent blocks, like the unquantized dispatch: block
+    # minor dims equal to the array dims always tile, sub-128 lanes are
+    # wasted (not wrong) — and the int8 page arena is never pad-copied
+    _, t, _ = c_pages.shape
+    dr = kr_pages.shape[2]
+    n = page_table.shape[1]
+    gp = -(-hq // 8) * 8
+    if gp != hq:
+        q_lat = jnp.pad(q_lat, ((0, 0), (0, gp - hq), (0, 0)))
+        q_rope = jnp.pad(q_rope, ((0, 0), (0, gp - hq), (0, 0)))
+    kernel = functools.partial(_paged_fwd_mla_quant_kernel, page_tokens=t,
+                               num_pages=n, sm_scale=scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # page_table, lengths
+        grid=(b, n),
+        in_specs=[
+            pl.BlockSpec((1, gp, r), lambda bb, i, pt, ln: (bb, 0, 0)),
+            pl.BlockSpec((1, gp, dr), lambda bb, i, pt, ln: (bb, 0, 0)),
+            pl.BlockSpec((1, t, r), lambda bb, i, pt, ln: (pt[bb, i], 0, 0)),
+            pl.BlockSpec((1, t, dr), lambda bb, i, pt, ln: (pt[bb, i], 0, 0)),
+            # per-position scales: one (1, T) row per page — T is the full
+            # minor dim, so the block tiles; the row broadcasts over lanes
+            pl.BlockSpec((1, t), lambda bb, i, pt, ln: (pt[bb, i], 0)),
+            pl.BlockSpec((1, t), lambda bb, i, pt, ln: (pt[bb, i], 0)),
+        ],
+        out_specs=pl.BlockSpec((1, gp, r), lambda bb, i, pt, ln: (bb, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((gp, r), jnp.float32),
+            pltpu.VMEM((gp, _STATS_LANES), jnp.float32),
+            pltpu.VMEM((gp, _STATS_LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, gp, r), q_lat.dtype),
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      q_lat, q_rope, c_pages, kr_pages, c_scale, kr_scale)
+    return out[:, :hq]
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale", "use_pallas",
+                                             "interpret"))
+def paged_attention_mla_quant(q_lat: jax.Array, q_rope: jax.Array,
+                              c_pages: jax.Array, kr_pages: jax.Array,
+                              c_scale: jax.Array, kr_scale: jax.Array,
+                              page_table: jax.Array, lengths: jax.Array, *,
+                              sm_scale: Optional[float] = None,
+                              use_pallas: Optional[bool] = None,
+                              interpret: bool = False) -> jax.Array:
+    """``paged_attention_mla`` over an int8-quantized latent arena — the
+    MLA+int8 combination the paged matrix was missing (ISSUE 11).
+    c_pages/kr_pages are int8 (P, T, R)/(P, T, Dr) with per-POSITION f32
+    scales (P, T) paged alongside — the same per-row symmetric scheme the
+    contiguous int8 latent cache uses (llama.py _kv_quant over the last
+    axis), so pages serve the paged decode loop AND hand off through the
+    codec without requantization. Dequantization happens after the VMEM
+    load in score space (scales broadcast on the lane axis; see the
+    kernel); HBM reads stay int8, the densest KV representation in the
+    repo: (r + dr) BYTES per position per layer. Same shape/validity
+    contract as paged_attention_mla; native-width latent blocks like
+    it."""
+    b, hq, r = q_lat.shape
+    _, t, _ = c_pages.shape
+    dr = kr_pages.shape[2]
+    if q_rope.shape != (b, hq, dr):
+        raise ValueError(f"q_rope {q_rope.shape} != (B, Hq, Dr) = "
+                         f"{(b, hq, dr)}")
+    if c_pages.shape[:2] != kr_pages.shape[:2]:
+        raise ValueError(f"c_pages {c_pages.shape} / kr_pages "
+                         f"{kr_pages.shape} disagree on (P, T)")
+    if c_scale.shape != c_pages.shape[:2] \
+            or kr_scale.shape != kr_pages.shape[:2]:
+        raise ValueError(
+            f"scale shapes {c_scale.shape}/{kr_scale.shape} must be the "
+            f"pages' (P, T) = {c_pages.shape[:2]}")
+    scale = sm_scale if sm_scale is not None else (r + dr) ** -0.5
+    pallas_ok = (_use_pallas(use_pallas) or interpret) and t % 8 == 0
+    if not pallas_ok:
+        return _paged_attention_mla_quant_xla(
+            q_lat, q_rope, c_pages, kr_pages, c_scale, kr_scale,
+            page_table, lengths, sm_scale=scale)
+    return _paged_attention_mla_quant_pallas(
+        q_lat, q_rope, c_pages, kr_pages, c_scale, kr_scale,
+        page_table, lengths, scale, interpret)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "sm_scale", "use_pallas",

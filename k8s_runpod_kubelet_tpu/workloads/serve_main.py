@@ -34,12 +34,16 @@ The pod command for autoscaled inference. Endpoints:
                    while draining) — see do_GET for the full contract
   POST /kv_prefill disaggregated prefill hop (router -> prefill replica):
                    tokenize the forwarded request, prefill its KV through
-                   the prefix-cache path, and push the serialized page
-                   run to the decode replica named by "handoff_to" —
-                   with chunked prefill on (--serving-chunk-tokens) the
-                   hop STREAMS sequence-numbered chunk frames to the
-                   decode replica's /kv_adopt_chunk while the next chunk
-                   is still computing (compute/transfer overlap)
+                   the prefix-cache path, and push the page run to the
+                   decode replica named by "handoff_to". When the router
+                   annotates "device": true (both replicas advertise the
+                   same placement domain) the run moves DEVICE-NATIVE —
+                   arena-to-arena buffers, zero numpy/HTTP bytes — and
+                   downgrades to the wire codec on any failure; with
+                   chunked prefill on (--serving-chunk-tokens) either
+                   path STREAMS sequence-numbered chunk frames/fragments
+                   while the next chunk is still computing
+                   (compute/transfer overlap)
   POST /kv_adopt   decode-side adoption: a pushed KV page run lands in
                    this engine's arena via the prefix trie, so the
                    upcoming request references it zero-copy
@@ -97,6 +101,12 @@ class _Handler(BaseHTTPRequestHandler):
     # BLOCKS when the window is full (bounds host memory; transfer is the
     # bottleneck then anyway).
     handoff_stream_window = 8
+    # device-native KV transfer (ISSUE 11): this replica's placement
+    # domain ("" = device path off — every hop rides the wire codec).
+    # When the router annotates a hop with device:true, /kv_prefill tries
+    # the arena-to-arena path first and DOWNGRADES to wire on any failure
+    # (bus miss, domain mismatch, geometry, failed adoption).
+    device_domain = ""
     # clock seams, rebound by serve(clock=..., mono=...): wall time for
     # OpenAI `created` stamps / request ids, monotonic for deadlines —
     # injected so stress/soak tests drive HTTP-layer timeouts deterministically
@@ -350,6 +360,31 @@ class _Handler(BaseHTTPRequestHandler):
             span(False, {"skip": True, "error": str(e)})
             return self._send(200, {"ok": False, "skip": True,
                                     "error": str(e)})
+        if req.get("device") and self.device_domain:
+            # device-native path (ISSUE 11): the router saw matching
+            # placement domains — hand the run arena-to-arena with zero
+            # host copies. ANY failure here downgrades to the wire codec
+            # below (then the router's unified fallback catches a wire
+            # failure too): the ladder is device -> wire -> unified, and
+            # a downgrade is an observability event, never a client error.
+            from ..fleet.device_transfer import device_push
+            try:
+                out = device_push(self.engine, target, tokens,
+                                  domain=self.device_domain,
+                                  window=self.handoff_stream_window)
+            except Exception as e:  # noqa: BLE001 — every device failure
+                # downgrades; the wire path below is the handler
+                self.engine.metrics.incr(
+                    "tpu_serving_kv_handoff_device_downgrades")
+                log.warning("device handoff to %s downgraded to wire: %s",
+                            target, e)
+            else:
+                span(True, {"path": "device", "tokens": len(tokens),
+                            "pages": out["pages"], "bytes": out["bytes"],
+                            "streamed": out["streamed"],
+                            "chunks": out.get("chunks"),
+                            "matched_tokens": out["matched_tokens"]})
+                return self._send(200, {"ok": True, **out})
         if self.engine.sc.serving_chunk_tokens > 0:
             # ISSUE 10: chunked engines STREAM the handoff — each
             # completed chunk's page run pushes to the decode replica
@@ -381,11 +416,12 @@ class _Handler(BaseHTTPRequestHandler):
             span(False, {"tokens": len(tokens), "pages": out["pages"],
                          "error": str(e)})
             return self._send(502, {"ok": False, "error": str(e)})
-        span(True, {"tokens": len(tokens), "pages": out["pages"],
-                    "bytes": len(blob),
+        span(True, {"path": "wire", "tokens": len(tokens),
+                    "pages": out["pages"], "bytes": len(blob),
                     "matched_tokens": out["matched_tokens"]})
         return self._send(200, {
-            "ok": True, "pages": out["pages"], "bytes": len(blob),
+            "ok": True, "path": "wire", "pages": out["pages"],
+            "bytes": len(blob),
             "covered_tokens": out["covered_tokens"],
             "matched_tokens": out["matched_tokens"],
             "adopted": adopted.get("pages")})
@@ -601,13 +637,15 @@ class _Handler(BaseHTTPRequestHandler):
         overlap = max(0.0, compute_s + stats["push_s"] - wall_s)
         overlap_ratio = round(min(1.0, overlap / floor), 3) if floor > 1e-9 \
             else 0.0
-        span(True, {"streamed": True, "tokens": len(tokens),
+        span(True, {"path": "wire", "streamed": True,
+                    "tokens": len(tokens),
                     "pages": out["pages"], "chunks": out["chunks"],
                     "bytes": stats["bytes"],
                     "matched_tokens": out["matched_tokens"],
                     "overlap_ratio": overlap_ratio})
         return self._send(200, {
-            "ok": True, "streamed": True, "pages": out["pages"],
+            "ok": True, "path": "wire", "streamed": True,
+            "pages": out["pages"],
             "bytes": stats["bytes"], "chunks": out["chunks"],
             "covered_tokens": out["covered_tokens"],
             "matched_tokens": out["matched_tokens"],
@@ -1346,6 +1384,7 @@ class BoundedThreadingHTTPServer(ThreadingHTTPServer):
 def serve(engine, port: int = 8000, request_timeout_s: float = 120.0,
           tokenizer=None, allow_adapters: bool = False,
           max_connections: int = 128, handoff_stream_window: int = 8,
+          device_domain: str = "",
           clock=time.time, mono=time.monotonic):
     # described here, not in the engine: the HTTP-layer shed counter belongs
     # to this server (the engine never sees the rejected connection)
@@ -1356,6 +1395,7 @@ def serve(engine, port: int = 8000, request_timeout_s: float = 120.0,
                    {"engine": engine, "request_timeout_s": request_timeout_s,
                     "tokenizer": tokenizer, "allow_adapters": allow_adapters,
                     "handoff_stream_window": handoff_stream_window,
+                    "device_domain": device_domain,
                     "clock": staticmethod(clock), "mono": staticmethod(mono)})
     httpd = BoundedThreadingHTTPServer(("0.0.0.0", port), handler,
                                        max_connections=max_connections,
@@ -1487,6 +1527,21 @@ def main(argv=None) -> int:
                         "decode adopts KV and streams tokens, unified does "
                         "both (default from config/TPU_SERVING_ROLE, "
                         "unified)")
+    p.add_argument("--device-transfer", default=None, choices=["on", "off"],
+                   dest="fleet_device_transfer_enabled",
+                   help="device-native KV handoff: co-located replicas "
+                        "(same placement domain) move pages arena-to-arena "
+                        "with zero host copies; any device-path failure "
+                        "downgrades to the wire codec (default from "
+                        "config/TPU_FLEET_DEVICE_TRANSFER_ENABLED, on)")
+    p.add_argument("--placement-domain", default=None,
+                   dest="fleet_placement_domain",
+                   help="placement domain this replica advertises for "
+                        "device-native handoffs; replicas with EQUAL "
+                        "domains hand device buffers directly (default "
+                        "from config/TPU_FLEET_PLACEMENT_DOMAIN, else "
+                        "auto-detected as proc:<host>:<pid> — the "
+                        "co-location the in-process bus can serve)")
     p.add_argument("--hf-checkpoint", default="",
                    help="HuggingFace model directory (safetensors/bin) to "
                         "load real weights from; empty = random init")
@@ -1536,6 +1591,15 @@ def main(argv=None) -> int:
     handoff_stream_window = (args.handoff_stream_window
                              if args.handoff_stream_window is not None
                              else base_cfg.handoff_stream_window)
+    # device-native handoff (ISSUE 11): flag > env/config; the domain
+    # auto-detects to this process when nothing overrides it
+    from ..fleet.device_transfer import detect_placement_domain
+    device_transfer = (base_cfg.fleet_device_transfer_enabled
+                       if args.fleet_device_transfer_enabled is None
+                       else args.fleet_device_transfer_enabled == "on")
+    placement_domain = detect_placement_domain(
+        args.fleet_placement_domain or base_cfg.fleet_placement_domain) \
+        if device_transfer else ""
     cfg = MODEL_CONFIGS[args.model]()
     log.info("loading %s (%.2fB params) on %s", cfg.name,
              cfg.param_count / 1e9, jax.default_backend())
@@ -1631,18 +1695,27 @@ def main(argv=None) -> int:
     httpd = serve(engine, args.port, tokenizer=tokenizer,
                   allow_adapters=args.dynamic_adapters,
                   max_connections=args.max_connections,
-                  handoff_stream_window=handoff_stream_window)
+                  handoff_stream_window=handoff_stream_window,
+                  device_domain=placement_domain)
     log.info("serving on :%d (POST /generate, GET /metrics)", args.port)
+    import socket
+    host = socket.gethostname()
+    advertise_url = args.fleet_advertise or f"http://{host}:{args.port}"
+    if placement_domain:
+        # same-domain prefill replicas resolve this engine by the URL the
+        # router hands them for the wire push — the two paths share one
+        # address per replica, so a hop can downgrade without re-planning
+        from ..fleet.device_transfer import BUS
+        BUS.register(advertise_url, engine, placement_domain)
+        log.info("device transfer: %s registered in domain %s",
+                 advertise_url, placement_domain)
     reporter = None
     if args.fleet_router:
-        import socket
         from ..fleet.registry import ReplicaReporter
-        host = socket.gethostname()
         reporter = ReplicaReporter(
             engine, args.fleet_router,
             replica_id=args.fleet_replica_id or host,
-            advertise_url=(args.fleet_advertise
-                           or f"http://{host}:{args.port}"),
+            advertise_url=advertise_url,
             # pod_name is the autoscaler's DELETE handle and must be the
             # real k8s pod name (= hostname), NOT the free-form replica
             # id: a custom --fleet-replica-id would otherwise make
@@ -1650,7 +1723,8 @@ def main(argv=None) -> int:
             # leak the real one
             pod_name=host,
             interval_s=args.fleet_heartbeat_interval,
-            role=serving_role).start()
+            role=serving_role,
+            placement_domain=placement_domain).start()
         log.info("fleet: reporting to %s as %s (role %s)",
                  args.fleet_router, reporter.replica_id, serving_role)
     try:

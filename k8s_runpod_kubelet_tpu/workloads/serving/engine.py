@@ -189,38 +189,61 @@ class ServingEngine:
         self._slots = [_Slot() for _ in range(sc.slots)]
         self._ring_len = self._pick_ring_len(cfg, sc)
         # -- paged decode loop eligibility (ISSUE 9; layouts lifted by
-        # ISSUE 10) --------------------------------------------------------
+        # ISSUES 10/11 — the matrix is now TOTAL) --------------------------
         # the decode hot loop runs on per-slot page tables over the shared
         # arena (paged_decode_step) whenever the layout allows it: plain
         # dense K/V, int8-KV (dequant-in-kernel paged attention, scales
-        # paged alongside) and MLA latent arenas all qualify — the int8
-        # LATENT combination and sliding windows do not — on a single host
-        # (the paged step is not yet shard_mapped over ``tensor``), with no
-        # adapters or speculation (the paged kernel takes neither), prefix
-        # cache on (the arena IS the slot storage), and — under an EXPLICIT
+        # paged alongside), MLA latent arenas, the int8 LATENT combination
+        # (paged_attention_mla_quant) and UNIFORM sliding windows (window
+        # pages recycle through the slot's table as a fixed circular run —
+        # see _decode_once_paged) all qualify; only the windowed INTERLEAVE
+        # (pattern > 1, split ring/global cache) and an operator-pinned
+        # ring_cache=True stay contiguous. Single host only (the paged
+        # step is not yet shard_mapped over ``tensor``), no adapters or
+        # speculation (the paged kernel takes neither), prefix cache on
+        # (the arena IS the slot storage), and — under an EXPLICIT
         # kv_pool_pages — a pool big enough to hold every slot's full
         # residency (a smaller pool would reject admissions under load;
         # auto sizing below always suffices).
         t = sc.kv_page_tokens
         slot_pages = -(-sc.cache_len // t)  # ceil: pages one full slot needs
-        pageable = (sc.prefix_cache_enabled and self._ring_len is None
-                    and t < sc.cache_len)
-        eligible = (pageable and cfg.sliding_window is None
-                    and not (cfg.is_mla and sc.quantize_kv_int8)
+        uniform_window = (cfg.sliding_window is not None
+                          and cfg.sliding_window_pattern == 1)
+        layout_pageable = cfg.sliding_window is None or uniform_window
+        eligible = (sc.prefix_cache_enabled and t < sc.cache_len
+                    and layout_pageable and sc.ring_cache is not True
                     and sc.speculate_k == 0
                     and sc.lora_rank == 0 and mesh is None
                     and (sc.kv_pool_pages == 0
                          or sc.kv_pool_pages >= sc.slots * slot_pages))
         if sc.paged_decode is True and not eligible:
             raise ValueError(
-                "paged_decode=True needs a full-attention KV layout (plain "
-                "dense, int8-KV, or MLA — no sliding window, no int8 "
-                "LATENT cache), no mesh, no adapters, no speculation, "
-                "prefix_cache_enabled, kv_page_tokens < cache_len, and "
-                "kv_pool_pages 0 (auto) or >= slots * "
-                f"ceil(cache_len / kv_page_tokens) = "
+                "paged_decode=True needs a pageable KV layout (plain dense, "
+                "int8-KV, MLA, MLA+int8, or a UNIFORM sliding window — the "
+                "windowed interleave's split ring/global cache cannot page, "
+                "and ring_cache=True pins the contiguous ring), no mesh, "
+                "no adapters, no speculation, prefix_cache_enabled, "
+                "kv_page_tokens < cache_len, and kv_pool_pages 0 (auto) or "
+                f">= slots * ceil(cache_len / kv_page_tokens) = "
                 f"{sc.slots * slot_pages}")
         self._paged_loop = eligible and sc.paged_decode is not False
+        if self._paged_loop:
+            # paged slots live in the arena: windowed models drop the
+            # contiguous ring (prefill singles stay linear; the window's
+            # memory win comes back as page RECYCLING in the slot table)
+            self._ring_len = None
+        # sliding-window paged ring run: a slot's table entry j >= _win_pages
+        # recycles the physical page at entry j - _win_pages — by then that
+        # page's positions sit entirely behind length - window, and the
+        # paged kernels never read out-of-window entries. The +2 covers
+        # page-boundary misalignment of the window edge plus the entry
+        # being written.
+        self._window = (cfg.sliding_window
+                        if self._paged_loop and uniform_window else None)
+        self._win_pages = ((self._window // t) + 2
+                           if self._window is not None else 0)
+        pageable = (sc.prefix_cache_enabled and self._ring_len is None
+                    and t < sc.cache_len)
         # -- prefix cache (paged pool or dense fallback) -------------------
         # the paged pool (kv_manager.py): radix trie over page-granular
         # shared KV in one preallocated arena. Ring/mixed layouts cannot
@@ -290,6 +313,12 @@ class ServingEngine:
         self.metrics.incr("tpu_serving_kv_handoff_failures", 0)
         self.metrics.incr("tpu_serving_kv_handoff_stream_frames", 0)
         self.metrics.incr("tpu_serving_kv_handoff_stream_rejects", 0)
+        # device-native handoff series (ISSUE 11): dashboards divide
+        # device runs by total hops for the co-location hit rate, and a
+        # nonzero downgrade rate flags a misdeclared placement domain
+        self.metrics.incr("tpu_serving_kv_handoff_device_runs", 0)
+        self.metrics.incr("tpu_serving_kv_handoff_device_bytes", 0)
+        self.metrics.incr("tpu_serving_kv_handoff_device_downgrades", 0)
         # chunked-prefill series (dashboards divide interleaved steps by
         # chunks for the ITL-protection ratio)
         self.metrics.incr("tpu_serving_prefill_chunks", 0)
@@ -452,6 +481,17 @@ class ServingEngine:
                    "chunk frames rejected on the decode side (torn/"
                    "duplicate/reordered/stale stream) — the whole stream "
                    "drops, nothing is adopted")
+        m.describe("tpu_serving_kv_handoff_device_runs",
+                   "KV page runs moved DEVICE-NATIVE (arena-to-arena, "
+                   "zero host copies) between co-located replicas — "
+                   "sender counts exports, receiver counts adoptions")
+        m.describe("tpu_serving_kv_handoff_device_bytes",
+                   "device-array bytes moved by device-native handoffs "
+                   "(payload never touches numpy or HTTP)")
+        m.describe("tpu_serving_kv_handoff_device_downgrades",
+                   "device-path hops that fell back to the wire codec "
+                   "(bus miss, domain mismatch, geometry/adoption "
+                   "failure) — the ladder is device -> wire -> unified")
         m.describe("tpu_serving_prefill_chunks",
                    "prompt chunks processed by chunked prefill "
                    "(serving_chunk_tokens > 0)")
@@ -973,6 +1013,7 @@ class ServingEngine:
                     for slot in self._slots:
                         slot.pages = []
                         slot.kv_len = 0
+                        slot.table_len = 0
                     self._page_tables_np[:] = 0
                     with self._prefix_lock:
                         self._kv_store = self._make_store()
@@ -1456,6 +1497,194 @@ class ServingEngine:
                 "tokens": len(header["tokens"]), "bytes": len(blob),
                 "evicted": evicted}
 
+    # -- device-native handoff (ISSUE 11) --------------------------------------
+
+    def export_handoff_device(self, tokens: list[int]) -> dict:
+        """``export_handoff`` minus the host round-trip: run the prompt
+        through the prefix-cache prefill path and hand back the run's
+        FRESH DEVICE buffers (export_pages — valid across later arena
+        donations) plus the tokens they cover. Nothing is serialized and
+        nothing touches numpy: a co-located decode engine adopts the
+        arrays directly (fleet/device_transfer.device_push). Same load
+        accounting as the wire export (handoff_inflight, TTFT
+        observation, handoffs_total)."""
+        from ...fleet.handoff import HandoffError
+        if self._kv_store is None:
+            raise HandoffError("this replica has no paged KV arena "
+                               "(ring/mixed layout or prefix cache "
+                               "disabled) — it cannot hand off KV")
+        tokens = list(tokens)
+        if not tokens:
+            raise ValueError("empty prompt")
+        if len(tokens) > self.sc.cache_len - 1:
+            raise ValueError(f"prompt length {len(tokens)} > cache budget "
+                             f"{self.sc.cache_len - 1}")
+        started = self._perf()
+        with self._handoff_lock:
+            self.handoff_inflight += 1
+        try:
+            _, _single, matched = self._prefill_tokens(tokens)
+            # ONE store reference across match -> export -> release, like
+            # export_handoff (crash recovery may rebind _kv_store)
+            with self._prefix_lock:
+                store = self._kv_store
+                m = store.match_full(0, tokens)
+                frags = store.export_pages(m.pages) if m.pages else {}
+            try:
+                if not m.pages:
+                    raise HandoffError(
+                        f"no full pages to hand off for a {len(tokens)}-"
+                        f"token prompt at page size "
+                        f"{self.sc.kv_page_tokens} (prompt shorter than "
+                        "one page, or the pool evicted it)")
+                nbytes = sum(int(a.size) * int(a.dtype.itemsize)
+                             for a in frags.values())
+            finally:
+                with self._prefix_lock:
+                    store.release(m.pages)
+        except Exception:
+            self.metrics.incr("tpu_serving_kv_handoff_failures")
+            raise
+        finally:
+            with self._handoff_lock:
+                self.handoff_inflight -= 1
+        with self._handoff_lock:
+            self.handoffs_total += 1
+        self.metrics.incr("tpu_serving_kv_handoff_pages", len(m.pages))
+        self.metrics.incr("tpu_serving_kv_handoff_device_runs")
+        self.metrics.incr("tpu_serving_kv_handoff_device_bytes", nbytes)
+        self.metrics.observe("tpu_serving_ttft_seconds",
+                             self._perf() - started)
+        return {"tokens": tokens[:m.matched_tokens], "sections": frags,
+                "pages": len(m.pages), "bytes": nbytes,
+                "covered_tokens": m.matched_tokens,
+                "matched_tokens": matched}
+
+    def adopt_handoff_device(self, tokens: list, sections: dict, *,
+                             model: str = "") -> dict:
+        """Decode half of a device-path handoff: validate the run's
+        geometry against this arena (fleet/handoff.check_device_sections
+        — the ONE device-contract definition the stream assembler shares,
+        here with pow2-padded export_run widths accepted and trimmed by a
+        device-side slice) and adopt the DEVICE arrays through the trie —
+        the scatter into the arena is the only data movement; no
+        deserialization, no host staging. Counters move only after the
+        adoption lands (all-or-nothing, like the wire path)."""
+        from ...fleet.handoff import HandoffError, check_device_sections
+        try:
+            if self._kv_store is None:
+                raise HandoffError("this replica has no paged KV arena "
+                                   "(ring/mixed layout or prefix cache "
+                                   "disabled) — it cannot adopt KV")
+            tokens = list(tokens)
+            if len(tokens) > self.sc.cache_len:
+                raise HandoffError(
+                    f"device run spans {len(tokens)} tokens, over this "
+                    f"replica's cache budget {self.sc.cache_len}")
+            with self._prefix_lock:
+                spec = self._kv_store.section_spec()
+            n, trimmed, nbytes = check_device_sections(
+                tokens, sections,
+                expect_page_tokens=self.sc.kv_page_tokens,
+                expect_sections=spec, expect_model=self.cfg.name,
+                model=model, allow_padded=True)
+            with self._prefix_lock:
+                added, evicted = self._kv_store.adopt(
+                    0, [int(tk) for tk in tokens], trimmed)
+        except Exception:
+            self.metrics.incr("tpu_serving_kv_handoff_failures")
+            raise
+        self.metrics.incr("tpu_serving_kv_handoff_pages", n)
+        self.metrics.incr("tpu_serving_kv_handoff_device_runs")
+        self.metrics.incr("tpu_serving_kv_handoff_device_bytes", nbytes)
+        if evicted:
+            self.metrics.incr("tpu_serving_prefix_cache_evictions", evicted)
+        self._update_page_gauges()
+        return {"pages": n, "added": added, "tokens": len(tokens),
+                "bytes": nbytes, "evicted": evicted}
+
+    def adopt_handoff_chunk_device(self, stream_id: str, seq: int,
+                                   tokens: list, sections: dict, *,
+                                   final: bool = False,
+                                   total_tokens=None,
+                                   model: str = "") -> dict:
+        """Decode half of a STREAMED device handoff: one device fragment
+        through the same HandoffStreamAssembler seq/TTL state machine the
+        wire frames use (feed_fragment — strict order, idle-TTL expiry,
+        all-or-nothing close), just without serialize/deserialize in the
+        middle. Fragments buffer as device arrays; the arena moves only
+        when the final fragment closes a fully-valid stream."""
+        from ...fleet.handoff import HandoffError
+        try:
+            if self._kv_store is None:
+                raise HandoffError("this replica has no paged KV arena "
+                                   "(ring/mixed layout or prefix cache "
+                                   "disabled) — it cannot adopt KV")
+            with self._handoff_lock:
+                assembler = self._assembler()
+                try:
+                    done = assembler.feed_fragment(
+                        stream_id, seq, tokens, sections, final=final,
+                        total_tokens=total_tokens, model=model)
+                except HandoffError:
+                    self.metrics.incr(
+                        "tpu_serving_kv_handoff_stream_rejects")
+                    raise
+            self.metrics.incr("tpu_serving_kv_handoff_stream_frames")
+            if not done["final"]:
+                return {"ok": True, "final": False, "seq": done["seq"]}
+            if len(done["tokens"]) > self.sc.cache_len:
+                raise HandoffError(
+                    f"stream spans {len(done['tokens'])} tokens, over "
+                    f"this replica's cache budget {self.sc.cache_len}")
+            merged = self._merged_stream_sections(done)
+            nbytes = sum(int(a.size) * int(a.dtype.itemsize)
+                         for a in merged.values())
+            with self._prefix_lock:
+                added, evicted = self._kv_store.adopt(
+                    0, done["tokens"], merged)
+        except Exception:
+            self.metrics.incr("tpu_serving_kv_handoff_failures")
+            raise
+        n_pages = len(done["tokens"]) // self.sc.kv_page_tokens
+        self.metrics.incr("tpu_serving_kv_handoff_pages", n_pages)
+        self.metrics.incr("tpu_serving_kv_handoff_device_runs")
+        self.metrics.incr("tpu_serving_kv_handoff_device_bytes", nbytes)
+        if evicted:
+            self.metrics.incr("tpu_serving_prefix_cache_evictions", evicted)
+        self._update_page_gauges()
+        return {"ok": True, "final": True, "seq": done["seq"],
+                "pages": n_pages, "added": added,
+                "tokens": len(done["tokens"]), "bytes": nbytes,
+                "frames": done["frames"], "evicted": evicted}
+
+    @staticmethod
+    def _merged_stream_sections(done: dict) -> dict:
+        """One {name: (L, n, T, ...)} dict from a closed stream's
+        per-frame section dicts, concatenated DEVICE-side (jnp accepts
+        numpy frames too, so a stream whose frames arrived through BOTH
+        doors — wire frames and device fragments share one seq lane —
+        still merges instead of KeyError-ing on a missing wire-only
+        field)."""
+        frames = done["section_frames"]
+        if len(frames) == 1:
+            return frames[0]
+        return {name: jnp.concatenate([f[name] for f in frames], axis=1)
+                for name in frames[0]}
+
+    def _assembler(self):
+        """The decode side's stream assembler, built lazily (needs the
+        arena's section spec). Caller holds _handoff_lock."""
+        from ...fleet.handoff import HandoffStreamAssembler
+        if self._stream_assembler is None:
+            with self._prefix_lock:
+                spec = self._kv_store.section_spec()
+            self._stream_assembler = HandoffStreamAssembler(
+                expect_page_tokens=self.sc.kv_page_tokens,
+                expect_sections=spec, expect_model=self.cfg.name,
+                clock=self._perf)
+        return self._stream_assembler
+
     # -- streaming chunked handoff (ISSUE 10) ----------------------------------
 
     def export_handoff_stream(self, tokens: list[int], emit) -> dict:
@@ -1632,22 +1861,16 @@ class ServingEngine:
         duplicate, reordered or stale stream drops whole and the arena
         stays exactly as it was. Returns {"ok": True, "final": False}
         mid-stream, adoption stats on the final frame."""
-        from ...fleet.handoff import HandoffError, HandoffStreamAssembler
+        from ...fleet.handoff import HandoffError
         try:
             if self._kv_store is None:
                 raise HandoffError("this replica has no paged KV arena "
                                    "(ring/mixed layout or prefix cache "
                                    "disabled) — it cannot adopt KV")
             with self._handoff_lock:
-                if self._stream_assembler is None:
-                    with self._prefix_lock:
-                        spec = self._kv_store.section_spec()
-                    self._stream_assembler = HandoffStreamAssembler(
-                        expect_page_tokens=self.sc.kv_page_tokens,
-                        expect_sections=spec, expect_model=self.cfg.name,
-                        clock=self._perf)
+                assembler = self._assembler()
                 try:
-                    done = self._stream_assembler.feed(blob)
+                    done = assembler.feed(blob)
                 except HandoffError:
                     self.metrics.incr(
                         "tpu_serving_kv_handoff_stream_rejects")
@@ -1661,7 +1884,10 @@ class ServingEngine:
                     f"this replica's cache budget {self.sc.cache_len}")
             with self._prefix_lock:
                 added, evicted = self._kv_store.adopt(
-                    0, done["tokens"], done["sections"])
+                    # the per-frame merge (not _close's numpy concat):
+                    # a stream may legally mix wire frames and device
+                    # fragments on one seq lane
+                    0, done["tokens"], self._merged_stream_sections(done))
         except Exception:
             self.metrics.incr("tpu_serving_kv_handoff_failures")
             raise
@@ -1834,6 +2060,7 @@ class ServingEngine:
                 store.fill_pages(single, tail, covered)
             slot.pages = list(m.pages) + tail
             slot.kv_len = n_prompt
+            slot.table_len = len(slot.pages)
         row = self._page_tables_np[slot_id]
         row[:] = 0
         row[:len(slot.pages)] = slot.pages
@@ -2099,14 +2326,38 @@ class ServingEngine:
         t = self.sc.kv_page_tokens
         # tail-page allocation: a slot whose next write position starts a
         # fresh page gets a PRIVATE page before the step — shared prefix
-        # pages are never written (allocate-on-write COW discipline)
+        # pages are never written (allocate-on-write COW discipline).
+        # Sliding-window slots RECYCLE instead of allocating once the
+        # table is _win_pages deep: entry j - _win_pages' positions are
+        # entirely behind the window by the time entry j is written (the
+        # paged kernels skip out-of-window entries, so the aliased table
+        # rows are never read), making a slot's steady-state residency
+        # O(window) pages — the ring cache's memory win, paged.
         for slot_id, slot in enumerate(self._slots):
             if slot.request is None:
                 continue
-            if slot.kv_len % t == 0 and len(slot.pages) * t <= slot.kv_len:
+            if slot.kv_len % t == 0 and slot.table_len * t <= slot.kv_len:
+                j = slot.table_len
+                row = self._page_tables_np[slot_id]
                 with self._prefix_lock:
                     try:
-                        page = store.alloc_run(1)[0]
+                        if self._window is not None and j >= self._win_pages:
+                            old = int(row[j - self._win_pages])
+                            if store.pool.refcount(old) == 1:
+                                # only this slot holds it: reuse in place
+                                page = old
+                            else:
+                                # shared with the trie (or an in-flight
+                                # match): allocate-on-write — the slot
+                                # swaps its reference for a private page,
+                                # the shared copy stays cached
+                                page = store.alloc_run(1)[0]
+                                store.pool.unref(old)
+                                slot.pages.remove(old)
+                                slot.pages.append(page)
+                        else:
+                            page = store.alloc_run(1)[0]
+                            slot.pages.append(page)
                     except PoolExhausted as exc:
                         # fail THIS request; the engine (and every other
                         # slot) keeps serving — prefix caching degrades,
@@ -2114,14 +2365,15 @@ class ServingEngine:
                         store.release(slot.pages)
                         slot.pages = []
                         slot.kv_len = 0
+                        slot.table_len = 0
                         self._page_tables_np[slot_id][:] = 0
                         req, slot.request = slot.request, None
                         _fail_future(req.future, RuntimeError(
                             f"KV pool exhausted mid-decode for {req.rid}: "
                             f"{exc}"))
                         continue
-                slot.pages.append(page)
-                self._page_tables_np[slot_id][len(slot.pages) - 1] = page
+                row[j] = page
+                slot.table_len = j + 1
         active = [s.request is not None for s in self._slots]
         if not any(active):
             self.metrics.set_gauge("tpu_serving_active_slots", 0)
@@ -2320,11 +2572,14 @@ class ServingEngine:
         self._slot_adapter[slot_id] = 0
         if self._paged_loop and slot.pages:
             # drop the slot's references: shared prefix pages stay in the
-            # trie for the next hit, private tail pages free immediately
+            # trie for the next hit, private tail pages free immediately.
+            # slot.pages holds each DISTINCT physical page once (windowed
+            # recycling aliases table entries, never duplicates the list)
             with self._prefix_lock:
                 self._kv_store.release(slot.pages)
             slot.pages = []
             slot.kv_len = 0
+            slot.table_len = 0
             self._page_tables_np[slot_id][:] = 0
         latency = self._perf() - req.submitted_at
         self.metrics.observe("tpu_serving_request_latency_seconds", latency)

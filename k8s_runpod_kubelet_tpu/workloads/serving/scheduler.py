@@ -240,12 +240,17 @@ class _Slot:
     # slot streamed (0 = none yet)
     last_emit_at: float = 0.0
     # paged decode loop (ISSUE 9): the slot's page-table row — page ids in
-    # position order, ONE pool reference held per entry (shared prefix
-    # pages read-only, tail pages private); kv_len is the committed token
-    # count = the next decode write position. Empty/0 on the contiguous
-    # engine.
+    # position order, ONE pool reference held per DISTINCT physical page
+    # (shared prefix pages read-only, tail pages private); kv_len is the
+    # committed token count = the next decode write position. Empty/0 on
+    # the contiguous engine. table_len counts LOGICAL table entries
+    # populated — it equals len(pages) on full-attention slots but runs
+    # ahead of it on sliding-window slots, whose out-of-window physical
+    # pages RECYCLE through later entries (ISSUE 11's paged ring run), so
+    # one physical page may back several logical entries.
     pages: list[int] = dataclasses.field(default_factory=list)
     kv_len: int = 0
+    table_len: int = 0
 
 
 class ChunkArbiter:

@@ -1,0 +1,569 @@
+"""Device-native KV handoff tests (ISSUE 11).
+
+Fast tier: DeviceTransferBus units, placement-domain detection, the
+router's device annotation on two-hop plans, and PagedKVStore.export_run's
+pow2 padding contract (exact-pow2 run lengths included — previously only
+covered incidentally by the soaks).
+
+Slow tier (real engines): the acceptance pins —
+
+- a same-domain hop moves ZERO bytes through numpy/HTTP (the wire
+  serializer is monkeypatched to explode; the device path never calls
+  it), monolithic and streamed alike;
+- adopted KV is bit-identical to the wire path's (token-identical decode
+  on the adopting engine);
+- a seeded mid-transfer kill leaves ZERO leaked pages on both arenas
+  (the decode side's partial device stream TTL-expires without touching
+  its arena);
+- every device-path failure (bus miss, domain mismatch, arena-geometry
+  mismatch) DOWNGRADES to the wire codec under the same /kv_prefill hop
+  — the ladder is device -> wire -> unified, and the downgrade counter
+  moves.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from k8s_runpod_kubelet_tpu.fleet.device_transfer import (
+    BUS, DeviceTransferBus, DeviceTransferError, detect_placement_domain,
+    device_push)
+
+
+@pytest.fixture(autouse=True)
+def _clean_bus():
+    BUS.clear()
+    yield
+    BUS.clear()
+
+
+class TestPlacementDomain:
+    def test_override_wins(self):
+        assert detect_placement_domain("rack:7") == "rack:7"
+
+    def test_env_beats_autodetect(self):
+        assert detect_placement_domain(
+            "", env={"TPU_FLEET_PLACEMENT_DOMAIN": "slice:a"}) == "slice:a"
+
+    def test_autodetect_is_process_scoped(self):
+        import os
+        import socket
+        d = detect_placement_domain("", env={})
+        assert d == f"proc:{socket.gethostname()}:{os.getpid()}"
+        # stable within a process: two replicas here share a domain
+        assert d == detect_placement_domain("", env={})
+
+
+class TestDeviceTransferBus:
+    def test_register_lookup_url_normalized(self):
+        bus = DeviceTransferBus()
+        bus.register("http://a:1/", "engine", "dom")
+        assert bus.lookup("http://a:1") == ("engine", "dom")
+        assert bus.lookup("http://a:1/") == ("engine", "dom")
+        bus.unregister("http://a:1")
+        assert bus.lookup("http://a:1/") is None
+
+    def test_reregistration_overwrites(self):
+        bus = DeviceTransferBus()
+        bus.register("http://a:1", "old", "dom")
+        bus.register("http://a:1", "new", "dom2")
+        assert bus.lookup("http://a:1") == ("new", "dom2")
+
+    def test_registration_requires_url_and_domain(self):
+        bus = DeviceTransferBus()
+        with pytest.raises(ValueError):
+            bus.register("", "e", "dom")
+        with pytest.raises(ValueError):
+            bus.register("http://a:1", "e", "")
+
+    def test_push_requires_bus_entry_and_matching_domain(self):
+        bus = DeviceTransferBus()
+        with pytest.raises(DeviceTransferError, match="bus miss"):
+            device_push(None, "http://gone:1", [1], domain="d", bus=bus)
+        bus.register("http://a:1", "peer", "other")
+        with pytest.raises(DeviceTransferError, match="domain mismatch"):
+            device_push(None, "http://a:1", [1], domain="mine", bus=bus)
+
+
+class TestRouterDeviceAnnotation:
+    """plan_two_hop annotates same-domain hops device:true and records
+    the path the prefill replica reports on the fleet.handoff span."""
+
+    def _router(self, pf_domain, dc_domain, reply, enabled=True):
+        from k8s_runpod_kubelet_tpu.fleet.registry import ReplicaRegistry
+        from k8s_runpod_kubelet_tpu.fleet.router import (FleetRouter,
+                                                         RouterConfig)
+        from k8s_runpod_kubelet_tpu.metrics import Metrics
+        from k8s_runpod_kubelet_tpu.tracing import Tracer
+        reg = ReplicaRegistry(transport_factory=lambda url: None,
+                              probe_fn=lambda rep: True)
+        reg.register("pf-0", "http://127.0.0.1:1/pf", role="prefill",
+                     placement_domain=pf_domain)
+        reg.register("dc-0", "http://127.0.0.1:1/dc", role="decode",
+                     placement_domain=dc_domain)
+        for rid in ("pf-0", "dc-0"):
+            reg.heartbeat(rid, {"free_slots": 4, "max_slots": 4})
+        seen = {}
+
+        class _Stub:
+            breaker = None
+
+            def request(self, method, path, body=None, **kw):
+                seen.update(body or {})
+                return reply
+
+        reg.get("pf-0").transport = _Stub()
+        rt = FleetRouter(reg, RouterConfig(
+            device_transfer_enabled=enabled),
+            metrics=Metrics(), tracer=Tracer())
+        return rt, seen
+
+    def _plan(self, rt):
+        trace = rt.trace_ctx(None)
+        return rt.plan_two_hop("/generate", {"tokens": [1] * 8}, "", trace)
+
+    def test_same_domain_annotates_device_and_records_path(self):
+        rt, seen = self._router(
+            "slice:a", "slice:a",
+            {"ok": True, "path": "device", "pages": 2, "bytes": 64})
+        preferred = self._plan(rt)
+        assert preferred is not None and preferred.replica_id == "dc-0"
+        assert seen["device"] is True
+        span = [s for s in rt.tracer.recent()
+                if s["name"] == "fleet.handoff"][0]
+        assert span["attrs"]["path"] == "device"
+        assert span["attrs"]["domain"] == "slice:a"
+
+    def test_mismatched_domains_ride_the_wire(self):
+        rt, seen = self._router(
+            "slice:a", "slice:b",
+            {"ok": True, "path": "wire", "pages": 2, "bytes": 64})
+        assert self._plan(rt) is not None
+        assert seen["device"] is False
+        span = [s for s in rt.tracer.recent()
+                if s["name"] == "fleet.handoff"][0]
+        assert span["attrs"]["path"] == "wire"
+        assert span["attrs"]["domain"] == ""
+
+    def test_empty_domains_never_claim_colocation(self):
+        rt, seen = self._router(
+            "", "", {"ok": True, "pages": 1, "bytes": 8})
+        assert self._plan(rt) is not None
+        assert seen["device"] is False
+
+    def test_kill_switch_disables_annotation(self):
+        rt, seen = self._router(
+            "slice:a", "slice:a",
+            {"ok": True, "path": "wire", "pages": 1, "bytes": 8},
+            enabled=False)
+        assert self._plan(rt) is not None
+        assert seen["device"] is False
+
+    def test_downgraded_hop_records_wire_path(self):
+        """The prefill replica tried device, failed, downgraded: the
+        router records what actually happened, not what it asked for."""
+        rt, seen = self._router(
+            "slice:a", "slice:a",
+            {"ok": True, "path": "wire", "pages": 2, "bytes": 64})
+        assert self._plan(rt) is not None
+        assert seen["device"] is True
+        span = [s for s in rt.tracer.recent()
+                if s["name"] == "fleet.handoff"][0]
+        assert span["attrs"]["path"] == "wire"
+
+
+class TestExportRunPadding:
+    """export_run pads the page list to a pow2 compile bucket and returns
+    PADDED device arrays; callers trim to the true page count. At an
+    EXACT pow2 run length no padding exists — the trim must be the
+    identity, and the payload must equal export_pages' bit for bit."""
+
+    def _store(self):
+        import jax.numpy as jnp
+        from k8s_runpod_kubelet_tpu.workloads.serving.kv_manager import \
+            PagedKVStore
+
+        def factory():
+            return {"k": jnp.zeros((1, 1, 64, 1, 2), jnp.float32),
+                    "v": jnp.zeros((1, 1, 64, 1, 2), jnp.float32),
+                    "index": jnp.zeros((1,), jnp.int32)}
+
+        return PagedKVStore(32, 4, factory)
+
+    def _insert(self, store, n_pages):
+        import jax
+        import jax.numpy as jnp
+        tokens = [(i % 50) + 1 for i in range(n_pages * 4)]
+        key = jax.random.PRNGKey(n_pages)
+        single = {"k": jax.random.normal(key, (1, 1, 64, 1, 2)),
+                  "v": jax.random.normal(key, (1, 1, 64, 1, 2)),
+                  "index": jnp.asarray([n_pages * 4], jnp.int32)}
+        store.insert(0, tokens, single)
+        return tokens
+
+    @pytest.mark.parametrize("n_pages", [1, 3, 4, 5, 8],
+                             ids=["one", "pad3to4", "exact4", "pad5to8",
+                                  "exact8"])
+    def test_padded_export_trims_to_export_pages(self, n_pages):
+        store = self._store()
+        tokens = self._insert(store, n_pages)
+        m = store.match_full(0, tokens)
+        assert len(m.pages) == n_pages
+        try:
+            run = store.export_run(m.pages)
+            exact = store.export_pages(m.pages)
+            bucket = 1 << max(0, (n_pages - 1).bit_length())
+            for name in ("k", "v"):
+                assert run[name].shape[1] == bucket
+                np.testing.assert_array_equal(
+                    np.asarray(run[name][:, :n_pages]),
+                    np.asarray(exact[name]))
+                if bucket == n_pages:
+                    # exact pow2: no padding to trim — the whole array
+                    # IS the run
+                    assert run[name].shape == exact[name].shape
+        finally:
+            store.release(m.pages)
+        # references balanced: every page back to trie-only ownership
+        for node in store.trie._nodes.values():
+            assert store.pool.refcount(node.page) == 1
+
+
+# -- real engines (slow tier) --------------------------------------------------
+
+SEED = 20260804
+
+
+def _no_leaks(engine, what=""):
+    stats = engine.prefix_cache_stats()
+    assert stats["pages_free"] + stats["nodes"] == stats["pages_total"], \
+        f"[seed={SEED}] {what}: leaked pages ({stats})"
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    import jax
+    import jax.numpy as jnp
+    from k8s_runpod_kubelet_tpu.models import init_params, tiny_llama
+    cfg = tiny_llama(vocab_size=128, embed_dim=64, n_layers=2, n_heads=4,
+                     n_kv_heads=2, mlp_dim=128, max_seq_len=512,
+                     dtype=jnp.float32, param_dtype=jnp.float32)
+    return cfg, init_params(cfg, jax.random.PRNGKey(0))
+
+
+def _engine(tiny, **kw):
+    from k8s_runpod_kubelet_tpu.workloads.serving import (ServingConfig,
+                                                          ServingEngine)
+    cfg, params = tiny
+    base = dict(slots=2, max_prefill_len=32, cache_len=256,
+                max_new_tokens=16, kv_page_tokens=8)
+    base.update(kw)
+    return ServingEngine(cfg, params, ServingConfig(**base)).start()
+
+
+def _forbid_wire(monkeypatch):
+    """The acceptance pin: device-path hops must move ZERO bytes through
+    the wire codec — serialize_pages exploding proves no call site was
+    reached (engine and serve_main both resolve it at call time)."""
+    import k8s_runpod_kubelet_tpu.fleet.handoff as handoff_mod
+
+    def boom(*a, **k):
+        raise AssertionError("wire serializer called on a device-path hop")
+
+    monkeypatch.setattr(handoff_mod, "serialize_pages", boom)
+
+
+PROMPT = [((i * 37) % 120) + 1 for i in range(44)]
+
+
+@pytest.mark.slow
+class TestDeviceHandoffEngines:
+    def test_monolithic_device_hop_never_serializes(self, tiny,
+                                                    monkeypatch):
+        dom = detect_placement_domain()
+        pre, dec = _engine(tiny), _engine(tiny)
+        BUS.register("http://dec:1", dec, dom)
+        try:
+            _forbid_wire(monkeypatch)
+            out = device_push(pre, "http://dec:1", PROMPT, domain=dom)
+            assert out["path"] == "device" and not out["streamed"]
+            assert out["pages"] == len(PROMPT) // 8 == out["adopted"]
+            assert dec.metrics.get_counter(
+                "tpu_serving_kv_handoff_device_runs") == 1
+            assert dec.metrics.get_counter(
+                "tpu_serving_kv_handoff_device_bytes") == out["bytes"] > 0
+            # wire byte counter NEVER moved on either side
+            for e in (pre, dec):
+                assert e.metrics.get_counter(
+                    "tpu_serving_kv_handoff_bytes") == 0
+            # adopted KV is bit-true: the decode engine serves the prompt
+            # as a prefix hit, token-identical to the engine that
+            # computed it
+            fa = pre.submit(PROMPT, max_new_tokens=8).result(timeout=300)
+            fb = dec.submit(PROMPT, max_new_tokens=8).result(timeout=300)
+            assert fa["tokens"] == fb["tokens"]
+            assert dec.metrics.get_counter(
+                "tpu_serving_prefix_cache_hits") == 1
+            for e, what in ((pre, "prefill"), (dec, "decode")):
+                e.drain()
+                _no_leaks(e, what)
+        finally:
+            pre.stop()
+            dec.stop()
+
+    def test_streamed_device_hop_never_serializes(self, tiny, monkeypatch):
+        dom = detect_placement_domain()
+        pre = _engine(tiny, serving_chunk_tokens=16)
+        dec = _engine(tiny)
+        BUS.register("http://dec:2", dec, dom)
+        try:
+            _forbid_wire(monkeypatch)
+            out = device_push(pre, "http://dec:2", PROMPT, domain=dom)
+            assert out["path"] == "device" and out["streamed"]
+            assert out["chunks"] >= 2, "stream must actually chunk"
+            assert out["pages"] == len(PROMPT) // 8
+            # strict-seq frames counted on the receiver (data + close)
+            assert dec.metrics.get_counter(
+                "tpu_serving_kv_handoff_stream_frames") == out["frames"]
+            fa = pre.submit(PROMPT, max_new_tokens=8).result(timeout=300)
+            fb = dec.submit(PROMPT, max_new_tokens=8).result(timeout=300)
+            assert fa["tokens"] == fb["tokens"]
+            for e in (pre, dec):
+                e.drain()
+                _no_leaks(e)
+        finally:
+            pre.stop()
+            dec.stop()
+
+    def test_device_equals_wire_adoption_bit_for_bit(self, tiny):
+        """Same prompt through both paths into two fresh decode engines:
+        the adopted arenas produce identical generations — the device
+        path is a transport change, never a data change."""
+        pre = _engine(tiny)
+        d_wire, d_dev = _engine(tiny), _engine(tiny)
+        try:
+            wire = pre.export_handoff(PROMPT)
+            d_wire.adopt_handoff(wire["blob"])
+            dev = pre.export_handoff_device(PROMPT)
+            d_dev.adopt_handoff_device(dev["tokens"], dev["sections"],
+                                       model=pre.cfg.name)
+            fa = d_wire.submit(PROMPT, max_new_tokens=8).result(timeout=300)
+            fb = d_dev.submit(PROMPT, max_new_tokens=8).result(timeout=300)
+            assert fa["tokens"] == fb["tokens"]
+            for e in (d_wire, d_dev):
+                assert e.metrics.get_counter(
+                    "tpu_serving_prefix_cache_hits") == 1
+        finally:
+            pre.stop()
+            d_wire.stop()
+            d_dev.stop()
+
+    def test_mid_transfer_kill_leaks_nothing(self, tiny):
+        """Seeded mid-stream kill: the device push dies after a seeded
+        number of fragments. The export fails loudly (the hop would
+        downgrade), the decode side's PARTIAL stream buffer expires by
+        TTL without ever touching its arena, and NEITHER arena leaks a
+        page."""
+        import time as _time
+        rng = np.random.default_rng(SEED)
+        kill_after = int(rng.integers(1, 3))     # fragment index to die at
+        dom = detect_placement_domain()
+        # injectable decode clock so the TTL expiry is deterministic
+        fake_now = [0.0]
+        pre = _engine(tiny, serving_chunk_tokens=16)
+        dec = _engine(tiny)
+        dec._perf = lambda: fake_now[0]
+        dec._stream_assembler = None  # rebuild with the injected clock
+        real_adopt = dec.adopt_handoff_chunk_device
+        calls = {"n": 0}
+
+        def dying_adopt(*a, **k):
+            calls["n"] += 1
+            if calls["n"] > kill_after:
+                raise OSError(f"replica died mid-transfer "
+                              f"(seed {SEED}, fragment {calls['n']})")
+            return real_adopt(*a, **k)
+
+        dec.adopt_handoff_chunk_device = dying_adopt
+        BUS.register("http://dec:3", dec, dom)
+        try:
+            # the hop must FAIL LOUDLY (the handler would downgrade to
+            # wire); whether the prefill-side export also aborted depends
+            # on where the sender thread was when the peer died — either
+            # way nothing may be adopted and nothing may leak
+            with pytest.raises(Exception):
+                device_push(pre, "http://dec:3", PROMPT, domain=dom)
+            assert pre.metrics.get_counter(
+                "tpu_serving_kv_handoff_device_runs") == 0, \
+                "a killed stream must never count a completed device run"
+            # the decode arena never moved: no pages adopted, the partial
+            # stream still buffered host-side
+            assert dec.metrics.get_counter(
+                "tpu_serving_kv_handoff_pages") == 0
+            stats = dec.prefix_cache_stats()
+            assert stats["pages_free"] == stats["pages_total"]
+            # TTL expiry: advance the decode clock past the assembler TTL
+            # and feed an unrelated stream — the corpse stream is GC'd,
+            # its late final frame is stale
+            from k8s_runpod_kubelet_tpu.fleet.handoff import HandoffError
+            assert len(dec._stream_assembler) == 1
+            fake_now[0] = 120.0
+            dec.adopt_handoff_chunk_device = real_adopt
+            with pytest.raises(HandoffError, match="stale"):
+                real_adopt("never-opened", 5, [], {}, final=True,
+                           total_tokens=8)
+            assert len(dec._stream_assembler) == 0
+            # prefill arena balanced too (its trie may cache the chunks
+            # it computed — that is residency, not a leak)
+            _time.sleep(0.05)
+            _no_leaks(pre, "prefill after kill")
+            _no_leaks(dec, "decode after kill")
+        finally:
+            pre.stop()
+            dec.stop()
+
+    def test_mixed_door_stream_closes_cleanly(self, tiny):
+        """One stream id, both doors: a DEVICE fragment (jax arrays)
+        buffered via adopt_handoff_chunk_device, then the CLOSE arrives
+        as a WIRE frame via adopt_handoff_chunk — the shared seq lane
+        must merge the device frames and adopt, not KeyError on the
+        wire door's numpy-only sections field."""
+        from k8s_runpod_kubelet_tpu.fleet.handoff import \
+            serialize_chunk_frame
+        pre, dec = _engine(tiny), _engine(tiny)
+        try:
+            out = pre.export_handoff_device(PROMPT)
+            res = dec.adopt_handoff_chunk_device(
+                "mixed", 0, out["tokens"], out["sections"],
+                model=pre.cfg.name)
+            assert not res["final"]
+            res = dec.adopt_handoff_chunk(serialize_chunk_frame(
+                "mixed", 1, b"", final=True,
+                total_tokens=len(out["tokens"])))
+            assert res["final"] and res["pages"] == out["pages"]
+            fa = pre.submit(PROMPT, max_new_tokens=6).result(timeout=300)
+            fb = dec.submit(PROMPT, max_new_tokens=6).result(timeout=300)
+            assert fa["tokens"] == fb["tokens"]
+        finally:
+            pre.stop()
+            dec.stop()
+
+    def test_geometry_mismatch_raises_for_downgrade(self, tiny):
+        """A co-located decode engine with a DIFFERENT arena granule
+        rejects the run before any accounting moves — the error the
+        /kv_prefill handler turns into a wire downgrade."""
+        from k8s_runpod_kubelet_tpu.fleet.handoff import HandoffError
+        dom = detect_placement_domain()
+        pre = _engine(tiny)
+        dec = _engine(tiny, kv_page_tokens=4)     # mismatched granule
+        BUS.register("http://dec:4", dec, dom)
+        try:
+            with pytest.raises(HandoffError):
+                device_push(pre, "http://dec:4", PROMPT, domain=dom)
+            assert dec.metrics.get_counter(
+                "tpu_serving_kv_handoff_pages") == 0
+            stats = dec.prefix_cache_stats()
+            assert stats["pages_free"] == stats["pages_total"]
+        finally:
+            pre.stop()
+            dec.stop()
+
+
+@pytest.mark.slow
+class TestKvPrefillDeviceLadder:
+    """The /kv_prefill handler's transfer ladder over real HTTP servers:
+    device when co-located, DOWNGRADE to wire (counter moves, hop still
+    succeeds) when the device path can't serve the hop."""
+
+    def _serve(self, engine, domain):
+        from k8s_runpod_kubelet_tpu.workloads.serve_main import serve
+        httpd = serve(engine, port=0, device_domain=domain)
+        return httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    def _hop(self, pre_url, dec_url, device=True):
+        body = json.dumps({"path": "/generate",
+                           "request": {"tokens": PROMPT},
+                           "handoff_to": dec_url,
+                           "device": device}).encode()
+        req = urllib.request.Request(
+            pre_url + "/kv_prefill", data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            return json.loads(resp.read())
+
+    def test_device_hop_over_http_then_prefix_hit(self, tiny, monkeypatch):
+        dom = detect_placement_domain()
+        pre, dec = _engine(tiny), _engine(tiny)
+        s_pre, pre_url = self._serve(pre, dom)
+        s_dec, dec_url = self._serve(dec, dom)
+        BUS.register(dec_url, dec, dom)
+        try:
+            _forbid_wire(monkeypatch)  # the whole hop must stay device
+            out = self._hop(pre_url, dec_url)
+            assert out["ok"] and out["path"] == "device"
+            assert out["pages"] == len(PROMPT) // 8
+            fa = pre.submit(PROMPT, max_new_tokens=6).result(timeout=300)
+            fb = dec.submit(PROMPT, max_new_tokens=6).result(timeout=300)
+            assert fa["tokens"] == fb["tokens"]
+            assert dec.metrics.get_counter(
+                "tpu_serving_kv_handoff_device_runs") == 1
+            # spans carry the path
+            spans = [s for s in pre.tracer.recent()
+                     if s["name"] == "serving.kv_prefill"]
+            assert spans and spans[-1]["attrs"]["path"] == "device"
+        finally:
+            s_pre.shutdown()
+            s_dec.shutdown()
+            pre.stop()
+            dec.stop()
+
+    def test_bus_miss_downgrades_to_wire_same_hop(self, tiny):
+        """Router said device (domains matched at registration) but the
+        decode engine is not on this process' bus: the hop DOWNGRADES to
+        the wire codec and still succeeds — the client never sees the
+        device failure."""
+        dom = detect_placement_domain()
+        pre, dec = _engine(tiny), _engine(tiny)
+        s_pre, pre_url = self._serve(pre, dom)
+        s_dec, dec_url = self._serve(dec, dom)
+        # note: NO BUS.register for dec_url
+        try:
+            out = self._hop(pre_url, dec_url)
+            assert out["ok"] and out["path"] == "wire"
+            assert pre.metrics.get_counter(
+                "tpu_serving_kv_handoff_device_downgrades") == 1
+            # the wire adoption really landed
+            assert dec.metrics.get_counter(
+                "tpu_serving_kv_handoff_pages") == len(PROMPT) // 8
+            assert dec.metrics.get_counter(
+                "tpu_serving_kv_handoff_device_runs") == 0
+        finally:
+            s_pre.shutdown()
+            s_dec.shutdown()
+            pre.stop()
+            dec.stop()
+
+    def test_wire_requested_stays_wire(self, tiny):
+        """device:false from the router (mismatched domains) never
+        touches the bus even when the engines ARE co-located."""
+        dom = detect_placement_domain()
+        pre, dec = _engine(tiny), _engine(tiny)
+        s_pre, pre_url = self._serve(pre, dom)
+        s_dec, dec_url = self._serve(dec, dom)
+        BUS.register(dec_url, dec, dom)
+        try:
+            out = self._hop(pre_url, dec_url, device=False)
+            assert out["ok"] and out["path"] == "wire"
+            assert pre.metrics.get_counter(
+                "tpu_serving_kv_handoff_device_downgrades") == 0
+            assert dec.metrics.get_counter(
+                "tpu_serving_kv_handoff_device_runs") == 0
+        finally:
+            s_pre.shutdown()
+            s_dec.shutdown()
+            pre.stop()
+            dec.stop()

@@ -288,7 +288,10 @@ class StreamReplica:
             with self.lock:
                 done = self.assembler.feed(blob)
                 if done["final"]:
-                    self.store.adopt(0, done["tokens"], done["sections"])
+                    from k8s_runpod_kubelet_tpu.fleet.handoff import \
+                        merge_section_frames
+                    self.store.adopt(0, done["tokens"],
+                                     merge_section_frames(done))
                     self.adopted_runs.append(list(done["tokens"]))
         except HandoffError as e:
             self.frame_rejects += 1

@@ -228,8 +228,8 @@ class TestDeserializeRejections:
 # -- streaming chunk frames + strict-order assembly (ISSUE 10) ----------------
 
 from k8s_runpod_kubelet_tpu.fleet.handoff import (  # noqa: E402
-    CHUNK_MAGIC, CHUNK_VERSION, HandoffStreamAssembler, parse_chunk_frame,
-    serialize_chunk_frame)
+    CHUNK_MAGIC, CHUNK_VERSION, HandoffStreamAssembler,
+    merge_section_frames, parse_chunk_frame, serialize_chunk_frame)
 
 
 def _frame(stream: str, seq: int, n_pages: int, *, final=False,
@@ -322,11 +322,12 @@ class TestStreamAssembler:
                                              total_tokens=5 * T))
         assert out["final"] and len(out["tokens"]) == 5 * T
         assert out["frames"] == 3
-        assert out["sections"]["k"].shape == (2, 5, T, 2, 4)
+        merged = merge_section_frames(out)
+        assert merged["k"].shape == (2, 5, T, 2, 4)
         # the concat preserves frame payloads exactly
         rng = np.random.default_rng(hash(("s1", 0)) % (2**32))
         np.testing.assert_array_equal(
-            out["sections"]["k"][:, :2],
+            merged["k"][:, :2],
             rng.standard_normal((2, 2, T, 2, 4)).astype(np.float32))
         assert len(asm) == 0  # stream closed and forgotten
 
@@ -340,8 +341,8 @@ class TestStreamAssembler:
         out_a = asm.feed(serialize_chunk_frame("a", 2, b"", final=True,
                                                total_tokens=2 * T))
         assert out_a["final"] and out_b["final"]
-        assert out_a["sections"]["k"].shape[1] == 2
-        assert out_b["sections"]["k"].shape[1] == 2
+        assert merge_section_frames(out_a)["k"].shape[1] == 2
+        assert merge_section_frames(out_b)["k"].shape[1] == 2
 
     def test_duplicate_seq_drops_stream(self):
         asm = _assembler()
@@ -423,3 +424,104 @@ class TestStreamAssembler:
         asm.feed(_frame("b", 0, 1))
         with pytest.raises(HandoffError, match="too many"):
             asm.feed(_frame("c", 0, 1))
+
+    def test_idle_ttl_expiry_racing_a_late_final_frame(self):
+        """ISSUE 11 satellite: a stream idles past its TTL, and its FINAL
+        frame then arrives late (slow sender, GC won the race). The
+        expired stream must be stale — the late final can neither adopt
+        its own buffered pages (they were GC'd) nor resurrect the stream
+        — and the assembler must hold zero state for it afterwards, on
+        the wire door AND the device door of the same state machine."""
+        clock = _Clock()
+        asm = _assembler(clock=clock, ttl_s=10.0)
+        asm.feed(_frame("s1", 0, 2, start_page=0))
+        asm.feed(_frame("s1", 1, 1, start_page=2))
+        assert len(asm) == 1
+        clock.t = 10.1  # idle past TTL; GC runs on the NEXT feed
+        with pytest.raises(HandoffError, match="stale"):
+            asm.feed(serialize_chunk_frame("s1", 2, b"", final=True,
+                                           total_tokens=3 * T))
+        assert len(asm) == 0  # buffered fragments gone, nothing adopted
+        # a fresh stream under the same id starts clean at seq 0
+        out = asm.feed(_frame("s1", 0, 1, start_page=0))
+        assert out == {"final": False, "seq": 0}
+        # same race through the DEVICE door: fragments buffered, TTL
+        # expiry, late final fragment -> stale, zero state
+        clock.t = 20.0
+        asm2 = _assembler(clock=clock, ttl_s=10.0)
+        secs = _plain_sections(1)
+        asm2.feed_fragment("d1", 0, _tokens(1), secs)
+        clock.t = 30.5
+        with pytest.raises(HandoffError, match="stale"):
+            asm2.feed_fragment("d1", 1, [], {}, final=True,
+                               total_tokens=1 * T)
+        assert len(asm2) == 0
+
+
+class TestDeviceFragmentDoor:
+    """feed_fragment (ISSUE 11): the zero-serialization door must share
+    the seq/TTL state machine with wire frames and enforce the SAME
+    geometry contract deserialize_pages does — duck-typed on the arrays,
+    so device buffers never touch numpy on the happy path."""
+
+    def test_fragment_stream_assembles(self):
+        asm = _assembler()
+        s0, s1 = _plain_sections(2), _plain_sections(1)
+        out = asm.feed_fragment("d", 0, _tokens(2), s0)
+        assert out == {"final": False, "seq": 0}
+        asm.feed_fragment("d", 1, _tokens(1), s1)
+        out = asm.feed_fragment("d", 2, [], {}, final=True,
+                                total_tokens=3 * T)
+        assert out["final"] and len(out["tokens"]) == 3 * T
+        # device door returns per-frame section dicts (the adopter
+        # concatenates device-side), plus the numpy concat since these
+        # test arrays ARE numpy
+        assert len(out["section_frames"]) == 2
+        np.testing.assert_array_equal(out["section_frames"][0]["k"],
+                                      s0["k"])
+        assert len(asm) == 0
+
+    def test_one_stream_id_one_seq_lane_across_doors(self):
+        """A stream that mixed doors still gets strict-seq treatment:
+        frame 0 through the wire, fragment 1 through the device door,
+        duplicate seq 1 drops the stream whole."""
+        asm = _assembler()
+        asm.feed(_frame("x", 0, 1, start_page=0))
+        asm.feed_fragment("x", 1, _tokens(1), _plain_sections(1))
+        with pytest.raises(HandoffError, match="duplicate"):
+            asm.feed_fragment("x", 1, _tokens(1), _plain_sections(1))
+        assert len(asm) == 0
+
+    def test_geometry_rejections_drop_stream(self):
+        base = _plain_sections(1)
+        for mutate, pat in (
+                (lambda s: {k: v for k, v in s.items() if k != "v"},
+                 "section-set"),
+                (lambda s: {**s, "v": s["v"].astype(np.float16)},
+                 "dtype mismatch"),
+                (lambda s: {**s, "v": s["v"][:, :, :, :, :2]},
+                 "trailing shape"),
+                (lambda s: {**s, "v": s["v"][:, :, :4]},
+                 "not \\(L, 1"),
+        ):
+            asm = _assembler()
+            with pytest.raises(HandoffError, match=pat):
+                asm.feed_fragment("g", 0, _tokens(1), mutate(base))
+            assert len(asm) == 0
+
+    def test_model_mismatch_rejected(self):
+        asm = _assembler(expect_model="llama3-8b")
+        with pytest.raises(HandoffError, match="model mismatch"):
+            asm.feed_fragment("m", 0, _tokens(1), _plain_sections(1),
+                              model="llama3.1-8b")
+
+    def test_partial_page_token_count_rejected(self):
+        asm = _assembler()
+        with pytest.raises(HandoffError, match="not a multiple"):
+            asm.feed_fragment("p", 0, _tokens(1)[:-1], _plain_sections(1))
+
+    def test_final_fragment_requires_total(self):
+        asm = _assembler()
+        asm.feed_fragment("f", 0, _tokens(1), _plain_sections(1))
+        with pytest.raises(HandoffError, match="total_tokens"):
+            asm.feed_fragment("f", 1, [], {}, final=True)

@@ -224,14 +224,24 @@ class TestPagedDecodeStep:
             err_msg="inactive slot's stale table row corrupted the active "
                     "slot's page")
 
-    def test_unsupported_layouts_raise(self, params):
+    def test_windowed_interleave_still_raises(self, params):
+        """ISSUE 11 lifted the uniform-window gate; only the windowed
+        INTERLEAVE (pattern > 1, split ring/global cache) stays out."""
+        gcfg = tiny_llama(name="tiny-interleave-paged", vocab_size=64,
+                          embed_dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+                          mlp_dim=64, max_seq_len=128, sliding_window=8,
+                          sliding_window_pattern=2,
+                          dtype=jnp.float32, param_dtype=jnp.float32)
+        model = LlamaModel(gcfg)
+        with pytest.raises(ValueError, match="interleave"):
+            model.init_paged_arena(4, 4)
+        # a UNIFORM window builds the same linear arena as plain layouts
         wcfg = tiny_llama(name="tiny-window-paged", vocab_size=64,
                           embed_dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
                           mlp_dim=64, max_seq_len=128, sliding_window=8,
                           dtype=jnp.float32, param_dtype=jnp.float32)
-        model = LlamaModel(wcfg)
-        with pytest.raises(ValueError, match="paged decode"):
-            model.init_paged_arena(4, 4)
+        arena = LlamaModel(wcfg).init_paged_arena(4, 4)
+        assert set(arena) == {"k", "v"}
 
 
 # -- int8-KV + MLA paged variants (ISSUE 10) ----------------------------------
@@ -431,7 +441,233 @@ class TestPagedDecodeStepMla:
         assert arena["c"].shape[0] == 2 and arena["c_pre"].shape[0] == 1
         _LayoutDriver.drive(cfg, quantize=False)
 
-    def test_int8_latent_combination_still_gated(self):
-        model = LlamaModel(self.MCFG)
-        with pytest.raises(ValueError, match="int8 LATENT"):
-            model.init_paged_arena(4, 4, quantize=True)
+    def test_int8_latent_combination_pages(self):
+        """ISSUE 11: the MLA+int8 combination pages — int8 c/kr sections
+        with per-position f32 scales, token-identical to the contiguous
+        int8 latent decode."""
+        arena = LlamaModel(self.MCFG).init_paged_arena(4, 4, quantize=True)
+        assert set(arena) == {"c", "kr", "c_scale", "kr_scale"}
+        assert arena["c"].dtype == jnp.int8
+        assert arena["c_scale"].shape == (2, 4, 4)
+        _LayoutDriver.drive(self.MCFG, quantize=True)
+
+    def test_int8_latent_dense_prefix_pages(self):
+        cfg = tiny_mla(vocab_size=64, embed_dim=32, n_layers=3,
+                       mlp_dim=64, max_seq_len=128, n_dense_prefix=1,
+                       dense_prefix_mlp_dim=64, n_experts=4,
+                       n_experts_per_tok=2, dtype=jnp.float32,
+                       param_dtype=jnp.float32)
+        arena = LlamaModel(cfg).init_paged_arena(4, 4, quantize=True)
+        assert set(arena) == {"c", "kr", "c_scale", "kr_scale",
+                              "c_pre", "kr_pre", "c_pre_scale",
+                              "kr_pre_scale"}
+        _LayoutDriver.drive(cfg, quantize=True)
+
+
+class TestPagedDecodeStepSlidingWindow:
+    """ISSUE 11: uniform sliding-window models run the paged decode step
+    (kernels mask + skip outside the window) token-identically to the
+    contiguous windowed decode."""
+
+    WCFG = tiny_llama(name="tiny-window", vocab_size=64, embed_dim=32,
+                      n_layers=2, n_heads=4, n_kv_heads=2, mlp_dim=64,
+                      max_seq_len=128, sliding_window=8,
+                      dtype=jnp.float32, param_dtype=jnp.float32)
+
+    def test_token_identity_with_contiguous_windowed_decode(self):
+        # the drive generates past the window, so the mask genuinely
+        # excludes old positions on both paths
+        _LayoutDriver.drive(self.WCFG, quantize=False)
+
+    def test_window_with_int8_kv(self):
+        _LayoutDriver.drive(self.WCFG, quantize=True)
+
+
+class TestPagedAttentionWindowParity:
+    """The kernel-level window contract: positions behind
+    ``length - window`` are masked AND their pages skipped — a recycled
+    (garbage) out-of-window page must not change the result."""
+
+    def _setup(self, rng, b=2, hq=8, hkv=2, d=128, t=8, n=6):
+        k_pages, v_pages, pt = _pages(rng, b, hkv, d, t, 16, n)
+        q = jnp.asarray(rng.normal(size=(b, hq, d)), jnp.float32)
+        return q, k_pages, v_pages, pt
+
+    def test_reference_masks_to_window(self):
+        rng = np.random.default_rng(20)
+        q, k_pages, v_pages, pt = self._setup(rng)
+        lengths = jnp.asarray([13, 40], jnp.int32)
+        W = 7
+        out = paged_attention(q, k_pages, v_pages, pt, lengths,
+                              sliding_window=W, use_pallas=False)
+        b, hq, d = q.shape
+        hkv, t = k_pages.shape[2], k_pages.shape[1]
+        n = pt.shape[1]
+        for row in range(b):
+            length = int(lengths[row])
+            lo = max(0, length - W)
+            kc = k_pages[pt[row]].reshape(n * t, hkv, d)[lo:length]
+            vc = v_pages[pt[row]].reshape(n * t, hkv, d)[lo:length]
+            ref = _attention_xla(q[row][None, :, None, :],
+                                 kc.transpose(1, 0, 2)[None],
+                                 vc.transpose(1, 0, 2)[None],
+                                 causal=True, sm_scale=d ** -0.5,
+                                 q_offset=length - 1 - lo)
+            np.testing.assert_allclose(np.asarray(out[row]),
+                                       np.asarray(ref[0, :, 0]),
+                                       rtol=1e-5, atol=1e-5)
+
+    def test_pallas_kernel_matches_reference_with_window(self):
+        rng = np.random.default_rng(21)
+        q, k_pages, v_pages, pt = self._setup(rng)
+        for W in (5, 8, 23):
+            for lengths in ([1, 48], [9, 25]):
+                lengths = jnp.asarray(lengths, jnp.int32)
+                ref = paged_attention(q, k_pages, v_pages, pt, lengths,
+                                      sliding_window=W, use_pallas=False)
+                pal = paged_attention(q, k_pages, v_pages, pt, lengths,
+                                      sliding_window=W, interpret=True)
+                np.testing.assert_allclose(np.asarray(pal), np.asarray(ref),
+                                           rtol=1e-5, atol=1e-5)
+
+    def test_out_of_window_pages_never_read(self):
+        """Clobber every page fully behind the window with garbage: the
+        result must not move — this is what makes the engine's page
+        RECYCLING sound (aliased table entries are dead to the kernel),
+        on the reference and the Pallas kernel alike."""
+        rng = np.random.default_rng(22)
+        q, k_pages, v_pages, pt = self._setup(rng)
+        t, W = 8, 7
+        lengths = jnp.asarray([44, 41], jnp.int32)
+        base_ref = paged_attention(q, k_pages, v_pages, pt, lengths,
+                                   sliding_window=W, use_pallas=False)
+        base_pal = paged_attention(q, k_pages, v_pages, pt, lengths,
+                                   sliding_window=W, interpret=True)
+        # pages of row 0 wholly behind length-W: page index i with
+        # (i+1)*t <= length - W
+        dead = [int(pt[0, i]) for i in range(pt.shape[1])
+                if (i + 1) * t <= int(lengths[0]) - W]
+        assert dead, "test geometry must yield dead pages"
+        k_g = k_pages.at[jnp.asarray(dead)].set(1e9)
+        v_g = v_pages.at[jnp.asarray(dead)].set(-1e9)
+        got_ref = paged_attention(q, k_g, v_g, pt, lengths,
+                                  sliding_window=W, use_pallas=False)
+        got_pal = paged_attention(q, k_g, v_g, pt, lengths,
+                                  sliding_window=W, interpret=True)
+        np.testing.assert_array_equal(np.asarray(got_ref)[0],
+                                      np.asarray(base_ref)[0])
+        np.testing.assert_array_equal(np.asarray(got_pal)[0],
+                                      np.asarray(base_pal)[0])
+
+    def test_quant_kernel_window_parity(self):
+        rng = np.random.default_rng(23)
+        k, v, ks, vs = _quant_pages(rng, 4, 128, 8, 12)
+        pt = jnp.asarray(rng.permutation(12)[:2 * 6].reshape(2, 6),
+                         jnp.int32)
+        q = jnp.asarray(rng.normal(size=(2, 16, 128)), jnp.float32)
+        lengths = jnp.asarray([11, 39], jnp.int32)
+        ref = paged_attention_quant(q, k, v, ks, vs, pt, lengths,
+                                    sliding_window=9, use_pallas=False)
+        pal = paged_attention_quant(q, k, v, ks, vs, pt, lengths,
+                                    sliding_window=9, interpret=True)
+        np.testing.assert_allclose(np.asarray(pal), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+        # window genuinely narrows the attention span
+        full = paged_attention_quant(q, k, v, ks, vs, pt, lengths,
+                                     use_pallas=False)
+        assert not np.allclose(np.asarray(full), np.asarray(ref))
+
+
+class TestPagedAttentionMlaLaneAlignment:
+    """ISSUE 11: Pallas no longer requires r/dr %% 128 — latent blocks
+    ride at native width (block minor dims equal to the array dims
+    always tile), so DeepSeek's dr=64 (and V2-Lite-ish r=512, dr=64)
+    runs the real kernel with no pad copy of the arena."""
+
+    @pytest.mark.parametrize("r,dr", [(128, 64), (512, 64), (64, 16)],
+                             ids=["dr64", "deepseek_shape", "tiny_both"])
+    def test_unaligned_latents_run_kernel_and_match_reference(self, r, dr):
+        rng = np.random.default_rng(30)
+        b, hq, t, n, P = 2, 8, 8, 4, 8
+        c_pages = jnp.asarray(rng.normal(size=(P, t, r)), jnp.float32)
+        kr_pages = jnp.asarray(rng.normal(size=(P, t, dr)), jnp.float32)
+        pt = jnp.asarray(rng.permutation(P)[:b * n].reshape(b, n),
+                         jnp.int32)
+        ql = jnp.asarray(rng.normal(size=(b, hq, r)), jnp.float32)
+        qr = jnp.asarray(rng.normal(size=(b, hq, dr)), jnp.float32)
+        for lengths in ([1, 30], [9, 25]):
+            lengths = jnp.asarray(lengths, jnp.int32)
+            ref = paged_attention_mla(ql, qr, c_pages, kr_pages, pt,
+                                      lengths, use_pallas=False)
+            pal = paged_attention_mla(ql, qr, c_pages, kr_pages, pt,
+                                      lengths, interpret=True)
+            assert pal.shape == (b, hq, r)
+            np.testing.assert_allclose(np.asarray(pal), np.asarray(ref),
+                                       rtol=1e-5, atol=1e-5)
+
+
+class TestPagedAttentionMlaQuantParity:
+    """paged_attention_mla_quant (ISSUE 11): int8 latent pages with
+    per-position scales — reference equals the dequantized plain-MLA
+    reference, and the score-space-dequant kernel equals the
+    reference."""
+
+    def _quant_latents(self, rng, P, t, r, dr):
+        c = jnp.asarray(rng.integers(-127, 128, (P, t, r)), jnp.int8)
+        kr = jnp.asarray(rng.integers(-127, 128, (P, t, dr)), jnp.int8)
+        cs = jnp.asarray(rng.uniform(5e-3, 2e-2, (P, t)), jnp.float32)
+        krs = jnp.asarray(rng.uniform(5e-3, 2e-2, (P, t)), jnp.float32)
+        return c, kr, cs, krs
+
+    def test_reference_equals_dequantized_mla(self):
+        from k8s_runpod_kubelet_tpu.ops.attention import \
+            paged_attention_mla_quant
+        rng = np.random.default_rng(31)
+        b, hq, r, dr, t, n, P = 2, 4, 64, 16, 8, 4, 12
+        c, kr, cs, krs = self._quant_latents(rng, P, t, r, dr)
+        pt = jnp.asarray(rng.permutation(P)[:b * n].reshape(b, n),
+                         jnp.int32)
+        ql = jnp.asarray(rng.normal(size=(b, hq, r)), jnp.float32)
+        qr = jnp.asarray(rng.normal(size=(b, hq, dr)), jnp.float32)
+        lengths = jnp.asarray([5, 29], jnp.int32)
+        got = paged_attention_mla_quant(ql, qr, c, kr, cs, krs, pt,
+                                        lengths, use_pallas=False)
+        ref = paged_attention_mla(
+            ql, qr, c.astype(jnp.float32) * cs[..., None],
+            kr.astype(jnp.float32) * krs[..., None], pt, lengths,
+            use_pallas=False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_pallas_kernel_matches_reference(self):
+        """interpret=True runs the EXACT score-space-dequant kernel —
+        including native-width blocks (r=128/dr=64 is the
+        aligned/unaligned mix)."""
+        from k8s_runpod_kubelet_tpu.ops.attention import \
+            paged_attention_mla_quant
+        rng = np.random.default_rng(32)
+        b, hq, r, dr, t, n, P = 2, 8, 128, 64, 8, 4, 8
+        c, kr, cs, krs = self._quant_latents(rng, P, t, r, dr)
+        pt = jnp.asarray(rng.permutation(P)[:b * n].reshape(b, n),
+                         jnp.int32)
+        ql = jnp.asarray(rng.normal(size=(b, hq, r)), jnp.float32)
+        qr = jnp.asarray(rng.normal(size=(b, hq, dr)), jnp.float32)
+        for lengths in ([1, 30], [9, 25]):
+            lengths = jnp.asarray(lengths, jnp.int32)
+            ref = paged_attention_mla_quant(ql, qr, c, kr, cs, krs, pt,
+                                            lengths, use_pallas=False)
+            pal = paged_attention_mla_quant(ql, qr, c, kr, cs, krs, pt,
+                                            lengths, interpret=True)
+            np.testing.assert_allclose(np.asarray(pal), np.asarray(ref),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_scale_shape_validated(self):
+        from k8s_runpod_kubelet_tpu.ops.attention import \
+            paged_attention_mla_quant
+        rng = np.random.default_rng(33)
+        c, kr, cs, krs = self._quant_latents(rng, 4, 8, 64, 16)
+        with pytest.raises(ValueError, match="scale shapes"):
+            paged_attention_mla_quant(
+                jnp.zeros((1, 4, 64)), jnp.zeros((1, 4, 16)), c, kr,
+                cs[:, :4], krs, jnp.zeros((1, 2), jnp.int32),
+                jnp.asarray([3], jnp.int32))

@@ -275,104 +275,174 @@ class TestPagedEngineSoak:
             e_plain.stop()
 
 
-class TestPagedLayoutsInt8AndMla:
-    """ISSUE 10: the paged decode LOOP covers int8-KV and MLA arenas —
-    token-identical to the contiguous loop (paged_decode=False pins it),
-    zero-copy handoff adoption included, zero leaked pages."""
+# -- the TOTAL layout matrix (ISSUE 11 CI satellite) ---------------------------
+# Every cache layout x every KV arrival path must keep the paged loop
+# token-identical to the contiguous engine and leak-free — parametrized
+# so a future layout cannot land without handoff parity.
 
-    def _engines(self, cfg, params, **sc_kw):
-        base = dict(slots=2, max_prefill_len=32, cache_len=256,
-                    max_new_tokens=12, kv_page_tokens=8)
-        base.update(sc_kw)
-        paged = ServingEngine(cfg, params,
-                              ServingConfig(**base)).start()
+def _mla_cfg():
+    from k8s_runpod_kubelet_tpu.models import tiny_mla
+    return tiny_mla(vocab_size=128, embed_dim=64, n_layers=2,
+                    mlp_dim=128, max_seq_len=512, dtype=jnp.float32,
+                    param_dtype=jnp.float32)
+
+
+def _window_cfg():
+    return tiny_llama(name="tiny-window", vocab_size=128, embed_dim=64,
+                      n_layers=2, n_heads=4, n_kv_heads=2, mlp_dim=128,
+                      max_seq_len=512, sliding_window=24,
+                      dtype=jnp.float32, param_dtype=jnp.float32)
+
+
+LAYOUTS = {
+    "plain": (lambda: CFG, {}),
+    "int8_kv": (lambda: CFG, {"quantize_kv_int8": True}),
+    "mla": (_mla_cfg, {}),
+    "mla_int8": (_mla_cfg, {"quantize_kv_int8": True}),
+    "sliding_window": (_window_cfg, {}),
+}
+MODES = ("direct", "adopted_wire", "adopted_device")
+_LAYOUT_CACHE: dict = {}
+
+
+def _layout(name):
+    """(cfg, params, sc_extra) per layout, params cached per module run."""
+    if name not in _LAYOUT_CACHE:
+        cfg_fn, extra = LAYOUTS[name]
+        cfg = cfg_fn()
+        # deterministic key per layout: Python str hash() is salted per
+        # process, which would make a marginal failure unreproducible
+        key = jax.random.PRNGKey(sorted(LAYOUTS).index(name))
+        _LAYOUT_CACHE[name] = (cfg, init_params(cfg, key), extra)
+    return _LAYOUT_CACHE[name]
+
+
+class TestPagedLayoutMatrix:
+    """5 layouts x (direct, adopted-wire, adopted-device): token
+    identity vs the contiguous engine, handoff-adoption prefix hits, and
+    zero leaked pages. Sliding-window engines additionally generate past
+    the window so the paged ring run actually recycles."""
+
+    @pytest.mark.parametrize("mode", MODES)
+    @pytest.mark.parametrize("layout", sorted(LAYOUTS))
+    def test_layout_times_path(self, layout, mode):
+        cfg, params, extra = _layout(layout)
+        sc_kw = dict(slots=2, max_prefill_len=32, cache_len=256,
+                     max_new_tokens=64, kv_page_tokens=8, **extra)
+        paged = ServingEngine(cfg, params, ServingConfig(**sc_kw)).start()
         contig = ServingEngine(cfg, params, ServingConfig(
-            **base, paged_decode=False)).start()
-        return paged, contig
-
-    def _soak(self, cfg, params, what, **sc_kw):
-        import numpy as np
-        paged, contig = self._engines(cfg, params, **sc_kw)
+            **sc_kw, paged_decode=False)).start()
+        engines = [paged, contig]
+        shared = [((i * 31) % (cfg.vocab_size - 8)) + 1 for i in range(40)]
+        # long enough generation that a windowed slot crosses its ring
+        # and recycles pages (win_pages = 24//8 + 2 = 5 table entries)
+        new_toks = 48 if layout == "sliding_window" else 10
         try:
-            assert paged._paged_loop, f"{what}: paged loop not eligible"
+            assert paged._paged_loop, f"{layout}: paged loop not eligible"
             assert not contig._paged_loop
-            rng = np.random.default_rng(SEED + 7)
-            shared = [((i * 31) % (cfg.vocab_size - 8)) + 1
-                      for i in range(40)]
+            if mode == "direct":
+                serve_on = paged
+            else:
+                # KV arrives by HANDOFF: a fresh decode engine adopts the
+                # prefill engine's pages over the chosen path, then must
+                # serve the prompt as a prefix hit
+                dec = ServingEngine(cfg, params,
+                                    ServingConfig(**sc_kw)).start()
+                engines.append(dec)
+                if mode == "adopted_wire":
+                    out = paged.export_handoff(shared)
+                    res = dec.adopt_handoff(out["blob"])
+                else:
+                    out = paged.export_handoff_device(shared)
+                    res = dec.adopt_handoff_device(
+                        out["tokens"], out["sections"], model=cfg.name)
+                    assert dec.metrics.get_counter(
+                        "tpu_serving_kv_handoff_device_runs") == 1
+                assert res["pages"] == len(shared) // 8
+                serve_on = dec
             prompts = [shared + [1, 2], shared + [3, 4, 5]]
-            for _ in range(5):
-                prompts.append([int(rng.integers(1, cfg.vocab_size - 8))
-                                for _ in range(int(rng.integers(3, 60)))])
             for i, p in enumerate(prompts):
-                kw = dict(max_new_tokens=8)
-                if i % 3 == 2:
+                kw = dict(max_new_tokens=new_toks)
+                if i % 2 == 1:
                     kw.update(temperature=0.8, seed=100 + i)
-                a = paged.submit(p, **kw).result(timeout=300)
+                a = serve_on.submit(p, **kw).result(timeout=300)
                 b = contig.submit(p, **kw).result(timeout=300)
-                assert a["tokens"] == b["tokens"], \
-                    f"[seed={SEED}] {what} prompt {i}: paged != contiguous"
-            # zero-copy handoff adoption decodes identically too
-            out = paged.export_handoff(shared)
-            paged2 = ServingEngine(cfg, params, ServingConfig(
-                slots=2, max_prefill_len=32, cache_len=256,
-                max_new_tokens=12, kv_page_tokens=8, **sc_kw)).start()
-            try:
-                paged2.adopt_handoff(out["blob"])
-                fa = paged2.submit(shared + [7], max_new_tokens=6).result(
-                    timeout=300)
-                fb = paged.submit(shared + [7], max_new_tokens=6).result(
-                    timeout=300)
-                assert fa["tokens"] == fb["tokens"], \
-                    f"[seed={SEED}] {what}: adopted KV decoded differently"
-                assert paged2.metrics.get_counter(
-                    "tpu_serving_prefix_cache_hits") >= 1
-            finally:
-                paged2.stop()
-                stats = paged2.prefix_cache_stats()
+                assert a["tokens"] == b["tokens"], (
+                    f"[seed={SEED}] {layout}/{mode} prompt {i}: paged != "
+                    f"contiguous")
+            if mode != "direct":
+                # the adopted pages WERE the prefix cache
+                assert serve_on.metrics.get_counter(
+                    "tpu_serving_prefix_cache_hits") >= 1, \
+                    f"{layout}/{mode}: adoption never hit"
+            for e in engines:
+                if e is contig:
+                    continue
+                e.drain()
+                assert e.drained
+                stats = e.prefix_cache_stats()
                 assert stats["pages_free"] + stats["nodes"] \
-                    == stats["pages_total"]
-            paged.drain()
-            assert paged.drained
-            stats = paged.prefix_cache_stats()
-            assert stats["pages_free"] + stats["nodes"] \
-                == stats["pages_total"], \
-                f"[seed={SEED}] {what}: leaked pages"
+                    == stats["pages_total"], \
+                    f"[seed={SEED}] {layout}/{mode}: leaked pages ({stats})"
         finally:
-            paged.stop()
-            contig.stop()
+            for e in engines:
+                e.stop()
 
-    def test_int8_kv_paged_loop(self, params):
-        self._soak(CFG, params, "int8-KV", quantize_kv_int8=True)
+    def test_gate_error_names_only_what_is_left(self):
+        """The eligibility gate must no longer blame int8-LATENT or
+        sliding windows — the matrix is total; what's left is the
+        windowed interleave + explicit ring pin (and the structural
+        no-mesh/adapters/speculation constraints)."""
+        with pytest.raises(ValueError) as ei:
+            ServingEngine(CFG, _layout("plain")[1], ServingConfig(
+                slots=2, cache_len=256, kv_page_tokens=8,
+                paged_decode=True, speculate_k=2))
+        msg = str(ei.value)
+        assert "interleave" in msg and "ring_cache=True" in msg
+        assert "no int8 LATENT" not in msg
+        assert "no sliding window" not in msg
 
-    def test_mla_paged_loop(self):
-        from k8s_runpod_kubelet_tpu.models import tiny_mla
-        mcfg = tiny_mla(vocab_size=128, embed_dim=64, n_layers=2,
-                        mlp_dim=128, max_seq_len=512, dtype=jnp.float32,
-                        param_dtype=jnp.float32)
-        mparams = init_params(mcfg, jax.random.PRNGKey(1))
-        self._soak(mcfg, mparams, "MLA")
-
-    def test_mla_int8_combination_stays_contiguous(self):
-        """The one unpaged combination: MLA + int8 latent cache falls
-        back to the contiguous loop (auto mode), and forcing
-        paged_decode=True errors loudly."""
-        from k8s_runpod_kubelet_tpu.models import tiny_mla
-        mcfg = tiny_mla(vocab_size=128, embed_dim=64, n_layers=2,
-                        mlp_dim=128, max_seq_len=512, dtype=jnp.float32,
-                        param_dtype=jnp.float32)
-        mparams = init_params(mcfg, jax.random.PRNGKey(1))
-        e = ServingEngine(mcfg, mparams, ServingConfig(
+    def test_explicit_ring_pin_stays_contiguous(self):
+        cfg, params, _ = _layout("sliding_window")
+        e = ServingEngine(cfg, params, ServingConfig(
             slots=2, max_prefill_len=32, cache_len=256,
-            kv_page_tokens=8, quantize_kv_int8=True)).start()
+            kv_page_tokens=8, ring_cache=True)).start()
         try:
-            assert not e._paged_loop
+            assert not e._paged_loop and e._ring_len is not None
             out = e.submit([1, 2, 3, 4], max_new_tokens=4).result(
                 timeout=300)
             assert len(out["tokens"]) == 4
         finally:
             e.stop()
-        with pytest.raises(ValueError, match="paged_decode=True"):
-            ServingEngine(mcfg, mparams, ServingConfig(
+        with pytest.raises(ValueError, match="ring_cache=True"):
+            ServingEngine(cfg, params, ServingConfig(
                 slots=2, max_prefill_len=32, cache_len=256,
-                kv_page_tokens=8, quantize_kv_int8=True,
-                paged_decode=True))
+                kv_page_tokens=8, ring_cache=True, paged_decode=True))
+
+    def test_windowed_slot_recycles_pages(self):
+        """The paged ring run is real: a windowed slot's table grows past
+        win_pages while its HELD page count stays bounded at ~win_pages —
+        out-of-window physical pages recycle instead of accumulating."""
+        cfg, params, _ = _layout("sliding_window")
+        e = ServingEngine(cfg, params, ServingConfig(
+            slots=1, max_prefill_len=32, cache_len=256,
+            max_new_tokens=200, kv_page_tokens=8)).start()
+        try:
+            assert e._window == 24 and e._win_pages == 5
+            held = []
+
+            def on_token(_t):
+                held.append(len(e._slots[0].pages))
+
+            e.submit([1, 2, 3, 4, 5], max_new_tokens=150,
+                     on_token=on_token).result(timeout=300)
+            # 155 positions = 20 logical pages; held physical pages must
+            # stay at the ring bound, not grow with the table
+            assert max(held) <= e._win_pages + 1, (
+                f"slot held {max(held)} pages — recycling never engaged")
+            e.drain()
+            stats = e.prefix_cache_stats()
+            assert stats["pages_free"] + stats["nodes"] \
+                == stats["pages_total"]
+        finally:
+            e.stop()

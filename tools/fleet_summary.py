@@ -17,7 +17,10 @@ Both may be mixed in one file. Output:
 - the two-hop request timeline: route -> prefill -> handoff -> decode,
   joined per trace_id from the fleet.handoff span and the two engines'
   serving.kv_prefill / serving.kv_adopt spans riding the same trace
-  (streamed hops add a chunks count + realized overlap fraction);
+  (streamed hops add a chunks count + realized overlap fraction; each
+  hop shows the transfer path it took, device or wire);
+- a per-path / per-domain handoff rollup (device-native vs wire KV
+  movement, hop latency percentiles per placement domain);
 - per-stream CHUNK timelines for streamed handoffs: each frame's
   compute (serving.kv_chunk), push (serving.kv_push) and decode-side
   adopt (serving.kv_adopt_chunk) spans joined per seq;
@@ -197,9 +200,46 @@ def two_hop_table(spans: list[dict], top: int) -> list[str]:
             f"  trace={tid[:16]} route[{dur('fleet.route')}] -> "
             f"prefill {a.get('prefill_replica', '?')}"
             f"[{dur('serving.kv_prefill')}] -> "
-            f"handoff[{_fmt_ms(float(s.get('duration_s', 0.0)))}] -> "
+            # the transfer path the hop took (ISSUE 11): device =
+            # arena-to-arena, wire = the HTTP codec
+            f"handoff[{_fmt_ms(float(s.get('duration_s', 0.0)))}"
+            f" path={a.get('path') or 'wire'}] -> "
             f"decode {a.get('decode_replica', '?')}"
             f"[{dur('serving.kv_adopt')}] {tail}")
+    return out
+
+
+def handoff_rollup(spans: list[dict]) -> list[str]:
+    """Per-path / per-domain handoff rollup (ISSUE 11): how much KV moved
+    device-native vs over the wire, per placement domain — a domain whose
+    hops keep landing on `wire` is a misdeclared co-location claim (the
+    downgrade counter's per-fleet view)."""
+    handoffs = [s for s in spans if s.get("name") == "fleet.handoff"]
+    if not handoffs:
+        return []
+    per: dict[tuple, dict] = defaultdict(
+        lambda: {"n": 0, "ok": 0, "pages": 0, "bytes": 0, "durs": []})
+    for s in handoffs:
+        a = s.get("attrs", {})
+        key = (str(a.get("path") or "wire"), str(a.get("domain") or "-"))
+        row = per[key]
+        row["n"] += 1
+        if a.get("ok"):
+            row["ok"] += 1
+            row["pages"] += int(a.get("pages") or 0)
+            row["bytes"] += int(a.get("bytes") or 0)
+        row["durs"].append(float(s.get("duration_s", 0.0)))
+    out = ["", "== handoff paths (fleet.handoff spans) ==",
+           f"{'path':<8} {'domain':<24} {'hops':>6} {'ok':>5} "
+           f"{'pages':>8} {'bytes':>12} {'p50':>9} {'p95':>9}"]
+    for key in sorted(per):
+        path, domain = key
+        row = per[key]
+        durs = sorted(row["durs"])
+        out.append(f"{path:<8} {domain:<24} {row['n']:>6} {row['ok']:>5} "
+                   f"{row['pages']:>8} {row['bytes']:>12} "
+                   f"{_fmt_ms(percentile(durs, 50)):>9} "
+                   f"{_fmt_ms(percentile(durs, 95)):>9}")
     return out
 
 
@@ -281,6 +321,7 @@ def render(spans: list[dict], snapshots: list[dict], top: int = 20) -> str:
     lines = routing_table(spans)
     lines += load_table(snapshots)
     lines += two_hop_table(spans, top)
+    lines += handoff_rollup(spans)
     lines += chunk_timeline(spans, top)
     lines += event_timeline(spans, top)
     return "\n".join(lines)

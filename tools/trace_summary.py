@@ -114,6 +114,7 @@ def rollups(spans: list[dict]) -> str:
     ttfts, itls, latencies = [], [], []
     steps, stragglers, runs = [], 0, []
     chunk_computes, chunk_pushes = [], []
+    handoff_paths: dict[str, list[float]] = defaultdict(list)
     for s in spans:
         attrs = s.get("attrs") or {}
         if s["name"] == "serving.request":
@@ -130,6 +131,10 @@ def rollups(spans: list[dict]) -> str:
             chunk_computes.append(s.get("duration_s", 0.0))
         elif s["name"] == "serving.kv_push":
             chunk_pushes.append(s.get("duration_s", 0.0))
+        # transfer-path families (ISSUE 11): fleet.handoff{path=device|wire}
+        elif s["name"] == "fleet.handoff":
+            handoff_paths[str(attrs.get("path") or "wire")].append(
+                s.get("duration_s", 0.0))
         # training span families (ISSUE 5: one tool renders both layers;
         # tools/goodput_summary.py draws the full goodput waterfall)
         elif s["name"] == "training.step":
@@ -156,6 +161,14 @@ def rollups(spans: list[dict]) -> str:
             f"compute p50={percentile(cc, 50):.4f}s  "
             f"push p50={percentile(cp, 50):.4f}s  "
             f"(per-stream timelines: tools/fleet_summary.py)")
+    if handoff_paths:
+        parts = []
+        for path in sorted(handoff_paths):
+            durs = sorted(handoff_paths[path])
+            parts.append(f"{path}={len(durs)} "
+                         f"(p50={percentile(durs, 50):.4f}s)")
+        lines.append("fleet handoffs by path: " + "  ".join(parts)
+                     + "  (per-domain rollup: tools/fleet_summary.py)")
     if steps or runs:
         lines.append(f"training steps: {len(steps)}"
                      + (f"  straggler events: {stragglers}" if stragglers
