@@ -378,14 +378,27 @@ def run_attn_bench() -> int:
     return 0
 
 
-def run_paged_attn_bench() -> int:
+def run_paged_attn_bench(smoke: bool = False) -> int:
     """Paged-attention decode microbench (ISSUE 8): the page-table-gather
     kernel over the serving engine's paged prefix-pool layout vs
     contiguous decode attention at the same geometry (llama3-8b heads on
     TPU). One JSON line per sequence length, carrying kv_page_bytes (per
     layer, K+V) so the row ties back to the pool-sizing knobs. CPU runs
     the pure-jnp reference path — a shape/ratio smoke, not a kernel
-    claim; the watcher queues this step for the chip."""
+    claim; the watcher queues this step for the chip.
+
+    ISSUE 12 adds the TP cell (_paged_tp_cell): per-chip decode step
+    time for paged vs contiguous MESH engines at tp=2 (and tp=4 on a
+    big-enough chip count) — the number the eligibility-gate lift is
+    for."""
+    # the TP cell needs >= 2 devices: on a CPU run, split the host into
+    # virtual devices BEFORE jax initializes (harmless for the microbench)
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu" \
+            and "xla_force_host_platform_device_count" \
+            not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=2").strip()
     _force_platform_from_env()
     import jax
     import jax.numpy as jnp
@@ -442,7 +455,126 @@ def run_paged_attn_bench() -> int:
                "pallas": bool(on_tpu),
                "dtype": dtype.__name__,
                "backend": jax.default_backend()})
+    _paged_tp_cell(smoke)
     return 0
+
+
+def _paged_tp_cell(smoke: bool) -> None:
+    """Tensor-parallel paged serving cell (ISSUE 12): per-chip decode
+    step time through REAL mesh engines, paged vs contiguous, per tp
+    degree. Both engines are built over the SAME mesh and measured at
+    full slot occupancy on identical shapes — the paged step runs the
+    shard_mapped page-table kernels over the sharded arena, the
+    contiguous step the mesh decode the gate used to force. The win
+    paged serving buys is memory/zero-copy (no per-slot contiguous
+    cache, zero-copy prefix/handoff reuse); this cell pins that the hot
+    step itself holds >= parity. CPU runs tp=2 over virtual devices
+    with the tiny model — an overhead smoke, explicitly backend=cpu;
+    the chip claim (llama3-8b int8 at tp=2/tp=4) waits on the tunnel."""
+    import numpy as _np
+    import jax
+    import jax.numpy as jnp
+    from k8s_runpod_kubelet_tpu.models import tiny_llama
+    from k8s_runpod_kubelet_tpu.parallel import MeshConfig, make_mesh
+    from k8s_runpod_kubelet_tpu.workloads.serving import (ServingConfig,
+                                                          ServingEngine)
+
+    on_tpu = jax.default_backend() == "tpu"
+    n_dev = len(jax.devices())
+    degrees = [d for d in ((2, 4) if on_tpu else (2,)) if d <= n_dev]
+    if not degrees:
+        _emit({"metric": "paged_tp_decode_step_us", "value": None,
+               "unit": "us/step", "error": f"needs >= 2 devices, jax "
+               f"sees {n_dev}", "backend": jax.default_backend()})
+        return
+    if on_tpu:
+        from k8s_runpod_kubelet_tpu.models import init_params
+        cfg = _serve_model("llama3-8b")
+        # HOST zeros: the engine quantizes to int8 and device_puts the
+        # sharded tree (serve_main --int8 strategy; bf16 8B never sits
+        # whole in HBM)
+        shapes = jax.eval_shape(
+            lambda: init_params(cfg, jax.random.PRNGKey(0)))
+        params = jax.tree_util.tree_map(
+            lambda sd: _np.zeros(sd.shape, sd.dtype), shapes)
+        slots, cache_len, page_tokens, int8 = 8, 2048, 16, True
+        iters = 10 if smoke else 50
+    else:
+        from k8s_runpod_kubelet_tpu.models import init_params
+        cfg = tiny_llama(vocab_size=128, embed_dim=64, n_layers=2,
+                         n_heads=4, n_kv_heads=2, mlp_dim=128,
+                         max_seq_len=256, dtype=jnp.float32,
+                         param_dtype=jnp.float32)
+        params = None  # init per mesh below
+        slots, cache_len, page_tokens, int8 = 2, 64, 4, False
+        iters = 5 if smoke else 20
+
+    for tp in degrees:
+        mesh = make_mesh(MeshConfig(data=1, tensor=tp), jax.devices()[:tp])
+        mesh_params = (params if on_tpu
+                       else init_params(cfg, jax.random.PRNGKey(0), mesh))
+        sc_kw = dict(slots=slots, cache_len=cache_len,
+                     max_prefill_len=cache_len // 2,
+                     kv_page_tokens=page_tokens, quantize_int8=int8)
+        paged = ServingEngine(cfg, mesh_params, ServingConfig(**sc_kw),
+                              mesh=mesh)
+        contig = ServingEngine(cfg, mesh_params,
+                               ServingConfig(**sc_kw, paged_decode=False),
+                               mesh=mesh)
+        assert paged._paged_loop and not contig._paged_loop
+        b = slots
+        tokens = jnp.ones((b,), jnp.int32)
+        active = jnp.ones((b,), bool)
+        # near-full residency so both steps attend real context; every
+        # slot gets its own distinct page run (the shuffled-table spirit
+        # of the microbench above)
+        length = cache_len - page_tokens
+        lengths = jnp.full((b,), length, jnp.int32)
+        slot_pages = -(-cache_len // page_tokens)
+        tables = jnp.asarray(
+            _np.arange(b * slot_pages).reshape(b, slot_pages), jnp.int32)
+
+        state = {"arena": paged._kv_store.arena}
+
+        def paged_once():
+            logits, state["arena"], _ = paged._paged_step(
+                paged.params, tokens, state["arena"], tables, lengths,
+                active)
+            return logits
+
+        cache = {"c": contig._cache}
+
+        def contig_once():
+            logits, cache["c"] = contig._decode(
+                contig.params, tokens, cache["c"], active, None, None)
+            return logits
+
+        def timed(f):
+            f().block_until_ready()  # compile + warm
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = f()
+            out.block_until_ready()
+            return (time.perf_counter() - t0) / iters
+
+        paged_s = timed(paged_once)
+        contig_s = timed(contig_once)
+        # per-chip decode throughput at this occupancy: b tokens per
+        # step over tp chips
+        _emit({"metric": "paged_tp_decode_step_us",
+               "value": round(paged_s * 1e6, 1), "unit": "us/step",
+               "contiguous_us": round(contig_s * 1e6, 1),
+               "paged_over_contiguous": round(paged_s / contig_s, 3),
+               "paged_tok_s_per_chip": round(b / paged_s / tp, 1),
+               "contiguous_tok_s_per_chip": round(b / contig_s / tp, 1),
+               "tp": tp, "slots": b, "cache_len": cache_len,
+               "attended_tokens": int(length),
+               "page_tokens": page_tokens, "int8": int8,
+               "arena_devices": len(next(iter(
+                   paged._kv_store.arena.values())).sharding.device_set),
+               "paged_step_compiles": paged._paged_step._cache_size(),
+               "model": cfg.name,
+               "backend": jax.default_backend()})
 
 
 def run_disagg_bench(smoke: bool = False) -> int:
@@ -2087,6 +2219,14 @@ def _handoff_path_smoke_lines() -> list | None:
     return _cpu_smoke_lines("--handoff-path")
 
 
+def _paged_tp_smoke_lines() -> list | None:
+    """The ISSUE 12 TP paged-decode cell on CPU (see _cpu_smoke_lines):
+    paged-vs-contiguous mesh decode step time at tp=2 over virtual
+    devices — the shard_map/GSPMD overhead contrast is re-measured per
+    commit; the per-chip chip claim waits on the tunnel."""
+    return _cpu_smoke_lines("--paged-attn", timeout_s=900)
+
+
 def orchestrate(quick: bool) -> int:
     errors = []
     # 0) a bounded probe gates the expensive attempts: a probe pass costs one
@@ -2131,6 +2271,7 @@ def orchestrate(quick: bool) -> int:
     smoke = None if quick else _disagg_smoke_lines()
     chunked_smoke = None if quick else _chunked_smoke_lines()
     handoff_smoke = None if quick else _handoff_path_smoke_lines()
+    paged_tp_smoke = None if quick else _paged_tp_smoke_lines()
     session = _session_tpu_headline()
     if session is not None:
         session["tpu_errors"] = errors[-2:]
@@ -2143,6 +2284,8 @@ def orchestrate(quick: bool) -> int:
             session["chunked_cpu_smoke"] = chunked_smoke
         if handoff_smoke is not None:
             session["handoff_path_cpu_smoke"] = handoff_smoke
+        if paged_tp_smoke is not None:
+            session["paged_tp_cpu_smoke"] = paged_tp_smoke
         if not quick:
             _write_unreachable_round(session)
         _emit(session)
@@ -2169,6 +2312,8 @@ def orchestrate(quick: bool) -> int:
             line["chunked_cpu_smoke"] = chunked_smoke
         if handoff_smoke is not None:
             line["handoff_path_cpu_smoke"] = handoff_smoke
+        if paged_tp_smoke is not None:
+            line["paged_tp_cpu_smoke"] = paged_tp_smoke
         if not quick:
             _write_unreachable_round(line)
         _emit(line)
@@ -2375,7 +2520,7 @@ def main() -> int:
     if "--attn-tune" in sys.argv:
         return run_attn_tune()
     if "--paged-attn" in sys.argv:
-        return run_paged_attn_bench()
+        return run_paged_attn_bench(smoke="--smoke" in sys.argv)
     if "--disagg" in sys.argv:
         return run_disagg_bench(smoke="--smoke" in sys.argv)
     if "--chunked" in sys.argv:

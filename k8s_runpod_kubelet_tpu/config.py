@@ -162,6 +162,14 @@ class Config:
     # True = auto (on whenever the model/layout allows it); False pins the
     # contiguous slot-cache loop.
     kv_paged_decode: bool = True
+    # TP paged serving (ISSUE 12): how the paged arena places over a
+    # tensor-parallel serving mesh. "auto" shards each section's kv-heads
+    # axis over ``tensor`` like the contiguous cache (MLA latents
+    # replicate — headless), degrading to a replicated arena when the
+    # mesh doesn't divide the kv-head count; "replicate" pins the
+    # replicated layout (pays HBM, keeps paged decode — an
+    # odd-geometry/debugging escape hatch).
+    kv_arena_sharding: str = "auto"
     # chunked prefill + streamed handoff (ISSUE 10). serving_chunk_tokens:
     # process prompts in chunks of this many tokens, yielding a decode
     # step to the engine between chunks (bounds co-resident streams' ITL
@@ -319,6 +327,8 @@ class Config:
             errs.append("kv_page_tokens must be >= 1 (tokens per KV page)")
         if self.kv_pool_pages < 0:
             errs.append("kv_pool_pages must be >= 0 (0 = auto-size)")
+        if self.kv_arena_sharding not in ("auto", "replicate"):
+            errs.append("kv_arena_sharding must be 'auto' or 'replicate'")
         if self.serving_chunk_tokens < 0:
             errs.append("serving_chunk_tokens must be >= 0 (0 = "
                         "monolithic prefill)")
@@ -368,6 +378,7 @@ _ENV_MAP = {
     "TPU_KV_POOL_PAGES": "kv_pool_pages",
     "TPU_PREFIX_CACHE_ENABLED": "prefix_cache_enabled",
     "TPU_KV_PAGED_DECODE": "kv_paged_decode",
+    "TPU_KV_ARENA_SHARDING": "kv_arena_sharding",
     "TPU_SERVING_CHUNK_TOKENS": "serving_chunk_tokens",
     "TPU_HANDOFF_STREAM_WINDOW": "handoff_stream_window",
     "TPU_SERVING_ROLE": "serving_role",

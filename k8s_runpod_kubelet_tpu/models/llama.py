@@ -1598,7 +1598,8 @@ class LlamaModel:
                           lengths: jax.Array,
                           active: Optional[jax.Array] = None, *,
                           use_pallas: Optional[bool] = None,
-                          interpret: bool = False
+                          interpret: bool = False,
+                          shard_kv: bool = True
                           ) -> tuple[jax.Array, Params, jax.Array]:
         """One decode token per slot over PAGED KV (ops.paged_attention):
         token (B,) -> (logits (B, V) f32, arena, lengths'). Slot b's KV
@@ -1622,7 +1623,13 @@ class LlamaModel:
         kernels mask/skip outside the window; table entries behind
         ``length - window`` are never read, so the caller may recycle
         their physical pages — the engine's ring run). Only the windowed
-        interleave (pattern > 1) still cannot page."""
+        interleave (pattern > 1) still cannot page.
+
+        Mesh serving (ISSUE 12): the attention dispatches run under
+        shard_map over ``tensor`` (kv-head axis local per shard when
+        ``shard_kv``, fully replicated specs when the engine pinned a
+        replicated arena) and the new row's scatter partitions through
+        GSPMD — the write lands on the owning shard."""
         cfg = self.cfg
         if cfg.sliding_window is not None and cfg.sliding_window_pattern != 1:
             raise ValueError("paged decode covers uniform sliding windows "
@@ -1679,7 +1686,8 @@ class LlamaModel:
                     sm_scale=cfg.sm_scale,
                     logit_soft_cap=cfg.attn_logit_softcap,
                     sliding_window=cfg.sliding_window,
-                    use_pallas=use_pallas, interpret=interpret)
+                    use_pallas=use_pallas, interpret=interpret,
+                    mesh=self.mesh, shard_heads=shard_kv)
             else:
                 kp = kp.at[pages_b, offs].set(k[:, 0], mode="drop")
                 vp = vp.at[pages_b, offs].set(v[:, 0], mode="drop")
@@ -1688,7 +1696,8 @@ class LlamaModel:
                                     logit_soft_cap=cfg.attn_logit_softcap,
                                     sliding_window=cfg.sliding_window,
                                     use_pallas=use_pallas,
-                                    interpret=interpret)
+                                    interpret=interpret, mesh=self.mesh,
+                                    shard_heads=shard_kv)
             o = o.reshape(b, 1, cfg.n_heads * cfg.head_dim_).astype(cfg.dtype)
             o = _mm(o, lp["wo"], cfg.dtype)
             if cfg.post_norms:
@@ -1785,12 +1794,14 @@ class LlamaModel:
                     o_lat = paged_attention_mla_quant(
                         q_lat, q_rope[:, 0].astype(jnp.float32), cp, krp,
                         cs, krs, page_tables, att_len, sm_scale=scale,
-                        use_pallas=use_pallas, interpret=interpret)
+                        use_pallas=use_pallas, interpret=interpret,
+                        mesh=self.mesh)
                 else:
                     o_lat = paged_attention_mla(
                         q_lat, q_rope[:, 0].astype(jnp.float32), cp, krp,
                         page_tables, att_len, sm_scale=scale,
-                        use_pallas=use_pallas, interpret=interpret)
+                        use_pallas=use_pallas, interpret=interpret,
+                        mesh=self.mesh)
                 w_uv = lp["w_uv"].reshape(r, hn, hd)
                 o = jnp.einsum("bhr,rhd->bhd", o_lat.astype(jnp.float32),
                                w_uv.astype(jnp.float32))

@@ -617,16 +617,49 @@ def _paged_attention_pallas(q, k_pages, v_pages, page_table, lengths,
     return out[:, :, :group].reshape(b, hq, d)
 
 
+def _paged_head_specs(mesh, hq: int, hkv: Optional[int]):
+    """The TP layout decision for one paged dispatch under ``shard_map``
+    over ``tensor``: shard the head axes when the counts divide (GQA
+    stays aligned — a shard's contiguous q-head block maps exactly onto
+    its contiguous kv-head block, zero cross-shard attention traffic),
+    else fall back to FULLY REPLICATED specs (every device redundantly
+    computes the whole dispatch — correct, no TP win; the price of a
+    head count the mesh doesn't divide). ``hkv=None`` for MLA latents
+    (headless pages always replicate; only q shards). Returns the head
+    axis name or None."""
+    from ..parallel.mesh import AXES
+    tp = mesh.shape.get(AXES.TENSOR, 1)
+    shard = tp > 1 and hq % tp == 0 and (hkv is None or hkv % tp == 0)
+    return AXES.TENSOR if shard else None
+
+
+def _shard_paged_call(mesh, local, in_specs, out_specs, *args):
+    """Run one paged-attention dispatch under shard_map over the serving
+    mesh. check=False is the PR 1 Pallas-in-shard_map plumbing: a
+    pallas_call's outputs carry no vma/replication typing, which strict
+    shard_map rejects even when the values are honestly sharded. Used
+    for EVERY multi-device mesh — a bare pallas_call in a GSPMD program
+    over >1 device fails with "Mosaic kernels cannot be automatically
+    partitioned" regardless of the tensor degree (the int4 kernel
+    learned the same lesson)."""
+    from .ring_attention import shard_map_compat
+    fn = shard_map_compat(local, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check=False)
+    return fn(*args)
+
+
 @functools.partial(jax.jit, static_argnames=("sm_scale", "use_pallas",
                                              "interpret", "logit_soft_cap",
-                                             "sliding_window"))
+                                             "sliding_window", "mesh",
+                                             "shard_heads"))
 def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
                     page_table: jax.Array, lengths: jax.Array, *,
                     sm_scale: Optional[float] = None,
                     use_pallas: Optional[bool] = None,
                     interpret: bool = False,
                     logit_soft_cap: Optional[float] = None,
-                    sliding_window: Optional[int] = None) -> jax.Array:
+                    sliding_window: Optional[int] = None,
+                    mesh=None, shard_heads: bool = True) -> jax.Array:
     """Paged-attention DECODE: one query token per sequence attends over
     KV scattered across fixed-size pages of a shared arena (the serving
     engine's paged prefix pool; ROADMAP item 2's transfer unit).
@@ -654,8 +687,14 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
 
     Composes with TP sharding exactly like the contiguous cache:
     k/v_pages shard the kv-heads axis (kv_cache_pspec — same rank/axis as
-    the engine cache), q/o shard heads; shard_map the call over ``tensor``
-    with the page table and lengths replicated."""
+    the engine cache), q/o shard heads. Pass ``mesh`` (ISSUE 12) to run
+    the dispatch under shard_map over ``tensor`` with the page table and
+    lengths replicated and the kv-head axis LOCAL to each shard — the
+    TP serving engine's paged hot path; head counts the mesh doesn't
+    divide degrade to replicated (redundant) compute, never wrong
+    math. ``shard_heads=False`` pins the replicated specs — for a
+    REPLICATED arena (kv_arena_sharding="replicate"), where sharded
+    specs would reshard the whole arena every step."""
     b, hq, d = q.shape
     _, t, hkv, _ = k_pages.shape
     if hq % hkv != 0:
@@ -672,14 +711,25 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     scale = sm_scale if sm_scale is not None else d ** -0.5
     pallas_ok = (_use_pallas(use_pallas) or interpret) \
         and d % 128 == 0 and t % 8 == 0
-    if not pallas_ok:
-        return _paged_attention_xla(q, k_pages, v_pages, page_table, lengths,
-                                    sm_scale=scale,
-                                    logit_soft_cap=logit_soft_cap,
-                                    sliding_window=sliding_window)
-    return _paged_attention_pallas(q, k_pages, v_pages, page_table, lengths,
-                                   scale, interpret, logit_soft_cap,
-                                   sliding_window)
+
+    def dispatch(qs, ks, vs, pt, ln):
+        if not pallas_ok:
+            return _paged_attention_xla(qs, ks, vs, pt, ln, sm_scale=scale,
+                                        logit_soft_cap=logit_soft_cap,
+                                        sliding_window=sliding_window)
+        return _paged_attention_pallas(qs, ks, vs, pt, ln, scale, interpret,
+                                       logit_soft_cap, sliding_window)
+
+    if mesh is not None and mesh.devices.size > 1:
+        from jax.sharding import PartitionSpec as P
+        hs = _paged_head_specs(mesh, hq, hkv) if shard_heads else None
+        return _shard_paged_call(
+            mesh, dispatch,
+            (P(None, hs, None), P(None, None, hs, None),
+             P(None, None, hs, None), P(), P()),
+            P(None, hs, None),
+            q, k_pages, v_pages, page_table, lengths)
+    return dispatch(q, k_pages, v_pages, page_table, lengths)
 
 
 # -- paged-attention variants: int8-KV (dequant in kernel) + MLA latents ------
@@ -845,7 +895,8 @@ def _paged_attention_quant_pallas(q, k_pages, v_pages, k_scale, v_scale,
 
 @functools.partial(jax.jit, static_argnames=("sm_scale", "use_pallas",
                                              "interpret", "logit_soft_cap",
-                                             "sliding_window"))
+                                             "sliding_window", "mesh",
+                                             "shard_heads"))
 def paged_attention_quant(q: jax.Array, k_pages: jax.Array,
                           v_pages: jax.Array, k_scale: jax.Array,
                           v_scale: jax.Array, page_table: jax.Array,
@@ -854,8 +905,8 @@ def paged_attention_quant(q: jax.Array, k_pages: jax.Array,
                           use_pallas: Optional[bool] = None,
                           interpret: bool = False,
                           logit_soft_cap: Optional[float] = None,
-                          sliding_window: Optional[int] = None
-                          ) -> jax.Array:
+                          sliding_window: Optional[int] = None,
+                          mesh=None, shard_heads: bool = True) -> jax.Array:
     """``paged_attention`` over an int8-quantized KV arena: k/v_pages are
     int8 (P, T, Hkv, D) with per-(position, kv-head) f32 scales (P, T,
     Hkv) paged alongside — the same per-row symmetric scheme the
@@ -865,7 +916,9 @@ def paged_attention_quant(q: jax.Array, k_pages: jax.Array,
     load; HBM reads stay int8, which is the entire point of the layout on
     a bandwidth-bound decode step. Same shape/validity contract as
     paged_attention; falls back to the dequant-reference off-TPU or when
-    (T, D) don't tile."""
+    (T, D) don't tile. ``mesh``: run under shard_map over ``tensor``
+    (paged_attention's TP contract) — int8 pages AND their scale
+    sections keep the kv-head axis local to each shard."""
     b, hq, d = q.shape
     _, t, hkv, _ = k_pages.shape
     if hq % hkv != 0:
@@ -884,16 +937,29 @@ def paged_attention_quant(q: jax.Array, k_pages: jax.Array,
     scale = sm_scale if sm_scale is not None else d ** -0.5
     pallas_ok = (_use_pallas(use_pallas) or interpret) \
         and d % 128 == 0 and t % 8 == 0
-    if not pallas_ok:
-        return _paged_attention_quant_xla(q, k_pages, v_pages, k_scale,
-                                          v_scale, page_table, lengths,
-                                          sm_scale=scale,
-                                          logit_soft_cap=logit_soft_cap,
-                                          sliding_window=sliding_window)
-    return _paged_attention_quant_pallas(q, k_pages, v_pages, k_scale,
-                                         v_scale, page_table, lengths,
-                                         scale, interpret, logit_soft_cap,
-                                         sliding_window)
+
+    def dispatch(qs, ks, vs, kss, vss, pt, ln):
+        if not pallas_ok:
+            return _paged_attention_quant_xla(qs, ks, vs, kss, vss, pt, ln,
+                                              sm_scale=scale,
+                                              logit_soft_cap=logit_soft_cap,
+                                              sliding_window=sliding_window)
+        return _paged_attention_quant_pallas(qs, ks, vs, kss, vss, pt, ln,
+                                             scale, interpret,
+                                             logit_soft_cap, sliding_window)
+
+    if mesh is not None and mesh.devices.size > 1:
+        from jax.sharding import PartitionSpec as P
+        hs = _paged_head_specs(mesh, hq, hkv) if shard_heads else None
+        return _shard_paged_call(
+            mesh, dispatch,
+            (P(None, hs, None), P(None, None, hs, None),
+             P(None, None, hs, None), P(None, None, hs),
+             P(None, None, hs), P(), P()),
+            P(None, hs, None),
+            q, k_pages, v_pages, k_scale, v_scale, page_table, lengths)
+    return dispatch(q, k_pages, v_pages, k_scale, v_scale, page_table,
+                    lengths)
 
 
 def _paged_attention_mla_xla(q_lat, q_rope, c_pages, kr_pages, page_table,
@@ -1022,13 +1088,13 @@ def _paged_attention_mla_pallas(q_lat, q_rope, c_pages, kr_pages, page_table,
 
 
 @functools.partial(jax.jit, static_argnames=("sm_scale", "use_pallas",
-                                             "interpret"))
+                                             "interpret", "mesh"))
 def paged_attention_mla(q_lat: jax.Array, q_rope: jax.Array,
                         c_pages: jax.Array, kr_pages: jax.Array,
                         page_table: jax.Array, lengths: jax.Array, *,
                         sm_scale: Optional[float] = None,
                         use_pallas: Optional[bool] = None,
-                        interpret: bool = False) -> jax.Array:
+                        interpret: bool = False, mesh=None) -> jax.Array:
     """Paged-attention decode over an MLA LATENT arena (absorbed form):
     q_lat (B, Hq, R) is the w_uk-absorbed query, q_rope (B, Hq, Dr) the
     decoupled-RoPE query; c_pages (P, T, R) / kr_pages (P, T, Dr) are the
@@ -1040,7 +1106,11 @@ def paged_attention_mla(q_lat: jax.Array, q_rope: jax.Array,
     blocks (minor dims equal to the array dims always tile; Mosaic pads
     sub-128 lane tiles in registers — wasted lanes, not wrong math, and
     no pad copy of the arena), so DeepSeek's dr=64 runs the real kernel
-    and only an untileable page size falls to the gathered reference."""
+    and only an untileable page size falls to the gathered reference.
+    ``mesh``: run under shard_map over ``tensor`` — latent pages are
+    HEADLESS so they stay REPLICATED per shard (every head attends the
+    same rows; the replicated latent cache is still 8-57x smaller than
+    a sharded K/V cache), while q_lat/q_rope/o shard the head axis."""
     b, hq, r = q_lat.shape
     _, t, _ = c_pages.shape
     dr = kr_pages.shape[2]
@@ -1052,11 +1122,23 @@ def paged_attention_mla(q_lat: jax.Array, q_rope: jax.Array,
                          f"{kr_pages.shape} disagree on (P, T)")
     scale = sm_scale if sm_scale is not None else (r + dr) ** -0.5
     pallas_ok = (_use_pallas(use_pallas) or interpret) and t % 8 == 0
-    if not pallas_ok:
-        return _paged_attention_mla_xla(q_lat, q_rope, c_pages, kr_pages,
-                                        page_table, lengths, sm_scale=scale)
-    return _paged_attention_mla_pallas(q_lat, q_rope, c_pages, kr_pages,
-                                       page_table, lengths, scale, interpret)
+
+    def dispatch(ql, qr, cp, krp, pt, ln):
+        if not pallas_ok:
+            return _paged_attention_mla_xla(ql, qr, cp, krp, pt, ln,
+                                            sm_scale=scale)
+        return _paged_attention_mla_pallas(ql, qr, cp, krp, pt, ln, scale,
+                                           interpret)
+
+    if mesh is not None and mesh.devices.size > 1:
+        from jax.sharding import PartitionSpec as P
+        hs = _paged_head_specs(mesh, hq, None)
+        return _shard_paged_call(
+            mesh, dispatch,
+            (P(None, hs, None), P(None, hs, None), P(), P(), P(), P()),
+            P(None, hs, None),
+            q_lat, q_rope, c_pages, kr_pages, page_table, lengths)
+    return dispatch(q_lat, q_rope, c_pages, kr_pages, page_table, lengths)
 
 
 def _paged_attention_mla_quant_xla(q_lat, q_rope, c_pages, kr_pages,
@@ -1197,14 +1279,15 @@ def _paged_attention_mla_quant_pallas(q_lat, q_rope, c_pages, kr_pages,
 
 
 @functools.partial(jax.jit, static_argnames=("sm_scale", "use_pallas",
-                                             "interpret"))
+                                             "interpret", "mesh"))
 def paged_attention_mla_quant(q_lat: jax.Array, q_rope: jax.Array,
                               c_pages: jax.Array, kr_pages: jax.Array,
                               c_scale: jax.Array, kr_scale: jax.Array,
                               page_table: jax.Array, lengths: jax.Array, *,
                               sm_scale: Optional[float] = None,
                               use_pallas: Optional[bool] = None,
-                              interpret: bool = False) -> jax.Array:
+                              interpret: bool = False,
+                              mesh=None) -> jax.Array:
     """``paged_attention_mla`` over an int8-quantized latent arena — the
     MLA+int8 combination the paged matrix was missing (ISSUE 11).
     c_pages/kr_pages are int8 (P, T, R)/(P, T, Dr) with per-POSITION f32
@@ -1216,7 +1299,8 @@ def paged_attention_mla_quant(q_lat: jax.Array, q_rope: jax.Array,
     kernel); HBM reads stay int8, the densest KV representation in the
     repo: (r + dr) BYTES per position per layer. Same shape/validity
     contract as paged_attention_mla; native-width latent blocks like
-    it."""
+    it, and the same TP contract (``mesh``: latent pages + scales
+    replicated per shard, q/o head-sharded)."""
     b, hq, r = q_lat.shape
     _, t, _ = c_pages.shape
     dr = kr_pages.shape[2]
@@ -1233,13 +1317,26 @@ def paged_attention_mla_quant(q_lat: jax.Array, q_rope: jax.Array,
             f"pages' (P, T) = {c_pages.shape[:2]}")
     scale = sm_scale if sm_scale is not None else (r + dr) ** -0.5
     pallas_ok = (_use_pallas(use_pallas) or interpret) and t % 8 == 0
-    if not pallas_ok:
-        return _paged_attention_mla_quant_xla(
+
+    def dispatch(ql, qr, cp, krp, cs, krs, pt, ln):
+        if not pallas_ok:
+            return _paged_attention_mla_quant_xla(ql, qr, cp, krp, cs, krs,
+                                                  pt, ln, sm_scale=scale)
+        return _paged_attention_mla_quant_pallas(ql, qr, cp, krp, cs, krs,
+                                                 pt, ln, scale, interpret)
+
+    if mesh is not None and mesh.devices.size > 1:
+        from jax.sharding import PartitionSpec as P
+        hs = _paged_head_specs(mesh, hq, None)
+        return _shard_paged_call(
+            mesh, dispatch,
+            (P(None, hs, None), P(None, hs, None), P(), P(), P(), P(),
+             P(), P()),
+            P(None, hs, None),
             q_lat, q_rope, c_pages, kr_pages, c_scale, kr_scale,
-            page_table, lengths, sm_scale=scale)
-    return _paged_attention_mla_quant_pallas(
-        q_lat, q_rope, c_pages, kr_pages, c_scale, kr_scale,
-        page_table, lengths, scale, interpret)
+            page_table, lengths)
+    return dispatch(q_lat, q_rope, c_pages, kr_pages, c_scale, kr_scale,
+                    page_table, lengths)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "sm_scale", "use_pallas",

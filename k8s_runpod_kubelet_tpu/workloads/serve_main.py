@@ -1503,7 +1503,17 @@ def main(argv=None) -> int:
                         "shared arena: prefix hits and handed-off KV are "
                         "referenced zero-copy (default from config/"
                         "TPU_KV_PAGED_DECODE, auto — on whenever the "
-                        "model/layout allows it)")
+                        "model/layout allows it; tensor-parallel engines "
+                        "included — the arena shards over the mesh)")
+    p.add_argument("--kv-arena-sharding", default=None,
+                   choices=["auto", "replicate"],
+                   dest="kv_arena_sharding",
+                   help="paged-arena placement under --tensor-parallel: "
+                        "auto shards the kv-heads axis over the mesh like "
+                        "the contiguous cache (MLA latents replicate), "
+                        "replicate pins every shard a full arena copy — "
+                        "pays HBM, keeps paged decode on odd geometries "
+                        "(default from config/TPU_KV_ARENA_SHARDING, auto)")
     p.add_argument("--serving-chunk-tokens", type=int, default=None,
                    dest="serving_chunk_tokens",
                    help="chunked prefill: process prompts in chunks of "
@@ -1584,6 +1594,7 @@ def main(argv=None) -> int:
     kv_paged_decode = (base_cfg.kv_paged_decode
                        if args.kv_paged_decode is None
                        else args.kv_paged_decode == "auto")
+    kv_arena_sharding = args.kv_arena_sharding or base_cfg.kv_arena_sharding
     serving_role = args.serving_role or base_cfg.serving_role
     serving_chunk_tokens = (args.serving_chunk_tokens
                             if args.serving_chunk_tokens is not None
@@ -1683,6 +1694,7 @@ def main(argv=None) -> int:
         kv_pool_pages=kv_pool_pages,
         prefix_cache_enabled=prefix_cache_enabled,
         paged_decode=None if kv_paged_decode else False,
+        kv_arena_sharding=kv_arena_sharding,
         serving_chunk_tokens=serving_chunk_tokens,
         # text mode stops at the tokenizer's EOS instead of always burning
         # the full max_new_tokens budget

@@ -189,7 +189,8 @@ class ServingEngine:
         self._slots = [_Slot() for _ in range(sc.slots)]
         self._ring_len = self._pick_ring_len(cfg, sc)
         # -- paged decode loop eligibility (ISSUE 9; layouts lifted by
-        # ISSUES 10/11 — the matrix is now TOTAL) --------------------------
+        # ISSUES 10/11, the mesh clause by ISSUE 12 — the matrix is now
+        # TOTAL and tensor-parallel) ---------------------------------------
         # the decode hot loop runs on per-slot page tables over the shared
         # arena (paged_decode_step) whenever the layout allows it: plain
         # dense K/V, int8-KV (dequant-in-kernel paged attention, scales
@@ -198,13 +199,18 @@ class ServingEngine:
         # pages recycle through the slot's table as a fixed circular run —
         # see _decode_once_paged) all qualify; only the windowed INTERLEAVE
         # (pattern > 1, split ring/global cache) and an operator-pinned
-        # ring_cache=True stay contiguous. Single host only (the paged
-        # step is not yet shard_mapped over ``tensor``), no adapters or
-        # speculation (the paged kernel takes neither), prefix cache on
-        # (the arena IS the slot storage), and — under an EXPLICIT
-        # kv_pool_pages — a pool big enough to hold every slot's full
-        # residency (a smaller pool would reject admissions under load;
-        # auto sizing below always suffices).
+        # ring_cache=True stay contiguous. Mesh engines page too: the
+        # arena shards its kv-heads axis over ``tensor`` exactly like the
+        # contiguous cache (kv_cache_pspec; MLA latents replicate — no
+        # head axis) and the paged step runs under shard_map with the
+        # kv-head axis local to each shard — a head count the mesh
+        # doesn't divide replicates the arena instead (correct, no TP
+        # memory win; see kv_arena_sharding). Still excluded: adapters
+        # and speculation (the paged kernel takes neither), prefix cache
+        # off (the arena IS the slot storage), and — under an EXPLICIT
+        # kv_pool_pages — a pool too small to hold every slot's full
+        # residency (it would reject admissions under load; auto sizing
+        # below always suffices).
         t = sc.kv_page_tokens
         slot_pages = -(-sc.cache_len // t)  # ceil: pages one full slot needs
         uniform_window = (cfg.sliding_window is not None
@@ -213,7 +219,7 @@ class ServingEngine:
         eligible = (sc.prefix_cache_enabled and t < sc.cache_len
                     and layout_pageable and sc.ring_cache is not True
                     and sc.speculate_k == 0
-                    and sc.lora_rank == 0 and mesh is None
+                    and sc.lora_rank == 0
                     and (sc.kv_pool_pages == 0
                          or sc.kv_pool_pages >= sc.slots * slot_pages))
         if sc.paged_decode is True and not eligible:
@@ -221,12 +227,32 @@ class ServingEngine:
                 "paged_decode=True needs a pageable KV layout (plain dense, "
                 "int8-KV, MLA, MLA+int8, or a UNIFORM sliding window — the "
                 "windowed interleave's split ring/global cache cannot page, "
-                "and ring_cache=True pins the contiguous ring), no mesh, "
+                "and ring_cache=True pins the contiguous ring), "
                 "no adapters, no speculation, prefix_cache_enabled, "
                 "kv_page_tokens < cache_len, and kv_pool_pages 0 (auto) or "
                 f">= slots * ceil(cache_len / kv_page_tokens) = "
                 f"{sc.slots * slot_pages}")
+        # TP paged serving (ISSUE 12): how the arena sections place over
+        # the mesh. "auto" shards kv-heads over ``tensor`` (kv_cache_pspec
+        # — the contiguous cache's layout); a head count the mesh doesn't
+        # divide falls back to a fully replicated arena so paged decode
+        # never silently turns off; "replicate" pins that fallback.
+        if sc.kv_arena_sharding not in ("auto", "replicate"):
+            raise ValueError(f"kv_arena_sharding must be 'auto' or "
+                             f"'replicate', got {sc.kv_arena_sharding!r}")
+        if mesh is not None:
+            from ...parallel.mesh import AXES as _AXES
+            tp = mesh.shape.get(_AXES.TENSOR, 1)
+        else:
+            tp = 1
+        self._arena_sharding = sc.kv_arena_sharding
+        if (mesh is not None and self._arena_sharding == "auto"
+                and not cfg.is_mla and cfg.n_kv_heads % tp != 0):
+            self._arena_sharding = "replicate"
         self._paged_loop = eligible and sc.paged_decode is not False
+        # tensor shards the paged step spans (bench/debug surface; 0 =
+        # loop off, 1 = single device)
+        self._paged_tp = tp if self._paged_loop else 0
         if self._paged_loop:
             # paged slots live in the arena: windowed models drop the
             # contiguous ring (prefill singles stay linear; the window's
@@ -286,7 +312,7 @@ class ServingEngine:
                 n_pages, t,
                 lambda: self.model.init_cache(1, sc.cache_len,
                                               quantize=quant),
-                mesh=mesh)
+                mesh=mesh, arena_sharding=self._arena_sharding)
             self._kv_store = self._make_store()
         else:
             self._dense_prefixes = DensePrefixStore(
@@ -387,12 +413,42 @@ class ServingEngine:
         donate = (2,) if sc.donate_cache else ()
         self._decode = jax.jit(self.model.decode_step, donate_argnums=donate)
         # paged decode loop: arg 2 is the ARENA (donated in place of the
-        # batch cache — same in-place-update economics, shared storage)
-        self._paged_step = (jax.jit(self.model.paged_decode_step,
-                                    donate_argnums=donate)
-                            if self._paged_loop else None)
+        # batch cache — same in-place-update economics, shared storage).
+        # Mesh serving PINS out_shardings to the arena's construction
+        # shardings: without the pin, GSPMD normalizes the output pspec
+        # (trailing-None form differs), the donated-back arena's sharding
+        # key changes after step 1, and the step compiles a second time —
+        # the compile-once contract the TP tests assert. Logits and
+        # lengths come back replicated (the engine pulls both to host
+        # every step anyway).
+        if not self._paged_loop:
+            self._paged_step = None
+        elif mesh is None:
+            self._paged_step = jax.jit(self.model.paged_decode_step,
+                                       donate_argnums=donate)
+        else:
+            import functools
+            from jax.sharding import NamedSharding, PartitionSpec
+            repl = NamedSharding(mesh, PartitionSpec())
+            arena_sh = {name: a.sharding
+                        for name, a in self._kv_store.arena.items()}
+            # a replicated arena pins replicated shard_map specs in the
+            # step (sharded specs would reshard the whole arena per step)
+            self._paged_step = jax.jit(
+                functools.partial(
+                    self.model.paged_decode_step,
+                    shard_kv=self._arena_sharding != "replicate"),
+                donate_argnums=donate,
+                out_shardings=(repl, arena_sh, repl))
         self.metrics.set_gauge("tpu_serving_paged_decode",
                                1 if self._paged_loop else 0)
+        # TP paged serving (ISSUE 12): dashboards join this to the decode
+        # throughput series for the per-chip number. Always the mesh's
+        # tensor degree while the loop runs — a replicated arena still
+        # occupies (and should divide by) tp chips; 1 = single device,
+        # 0 = loop off
+        self.metrics.set_gauge("tpu_serving_paged_tp_shards",
+                               self._paged_tp)
         self._verify = (jax.jit(self.model.verify_step, donate_argnums=donate)
                         if sc.speculate_k > 0 else None)
         # the prefill thread's per-chunk step (prefill_chunk_step: verify
@@ -465,6 +521,10 @@ class ServingEngine:
                    "1 when the decode hot loop runs on per-slot page "
                    "tables over the shared arena (zero-copy prefix/"
                    "handoff adoption), 0 on the contiguous slot cache")
+        m.describe("tpu_serving_paged_tp_shards",
+                   "tensor-parallel shards the paged decode step runs "
+                   "over (shard_mapped arena; 1 = single device, 0 = "
+                   "paged loop off)")
         m.describe("tpu_serving_kv_handoff_pages",
                    "KV pages moved by prefill->decode handoffs (sender "
                    "counts serialized pages, receiver counts adopted)")
@@ -938,6 +998,8 @@ class ServingEngine:
             "kv_cache_tokens": kv_tokens,
             "cache_len": self.sc.cache_len,
             "paged_decode": self._paged_loop,
+            "paged_tp_shards": self._paged_tp,
+            "kv_arena_sharding": self._arena_sharding,
             "prefixes": prefixes,
             "max_prefixes": self.sc.max_prefixes,
             "prefix_cache": self.prefix_cache_stats(),

@@ -50,7 +50,6 @@ matters because ``write`` DONATES the arena buffers) under its
 from __future__ import annotations
 
 import dataclasses
-import functools
 from typing import Callable, Optional
 
 
@@ -416,18 +415,24 @@ class DensePrefixStore:
 # jax imports stay inside the builders: PagePool/PrefixTrie/DensePrefixStore
 # are jax-free, so the tier-1 unit tests run host-only.
 
-def _build_gather(t: int):
+def _build_gather(t: int, out_shardings=None):
     """One jit per POWER-OF-TWO page count: callers pad ``ids`` up to a
     bucket (repeating a valid page id) and pass the true token count as
     ``index_val`` — padded positions land beyond ``index``, which the
     attention mask never exposes and later writes overwrite (the same
     decode-path invariant padded prefill relies on). Bounds compile
     variants to log2(cache_len / page_tokens) instead of one per distinct
-    prefix length."""
+    prefix length.
+
+    ``out_shardings`` (mesh serving): pin the produced single cache to
+    the engine's construction shardings — left to GSPMD, each arena jit
+    would pick (and normalize) its own layout, the arrays' sharding keys
+    would flap between producers, and every consumer jit (the paged
+    decode step above all) would recompile per producer. One pinned
+    form everywhere = one executable everywhere."""
     import jax
     import jax.numpy as jnp
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
     def gather(single, arena, ids, index_val):
         n = ids.shape[0]
         out = dict(single)
@@ -439,16 +444,18 @@ def _build_gather(t: int):
             index_val.astype(jnp.int32), (1,))
         return out
 
-    return gather
+    if out_shardings is None:
+        return jax.jit(gather, donate_argnums=(0,))
+    return jax.jit(gather, donate_argnums=(0,), out_shardings=out_shardings)
 
 
-def _build_write(t: int):
+def _build_write(t: int, out_shardings=None):
     """One jit per POWER-OF-TWO page count (callers binary-decompose a
     run of new pages); the token offset is a TRACED dynamic-slice start,
-    so it never forces a recompile."""
+    so it never forces a recompile. ``out_shardings`` pins the arena's
+    layout under mesh serving (see _build_gather)."""
     import jax
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
     def write(arena, single, ids, start_tok):
         n = ids.shape[0]
         out = {}
@@ -459,37 +466,49 @@ def _build_write(t: int):
             out[name] = a.at[:, ids].set(frag)
         return out
 
-    return write
+    if out_shardings is None:
+        return jax.jit(write, donate_argnums=(0,))
+    return jax.jit(write, donate_argnums=(0,), out_shardings=out_shardings)
 
 
-def _build_export():
+def _build_export(mesh=None):
     """One jitted gather over ALL sections for the streaming export path:
     a per-chunk flush calling eager per-section gathers would pay ~ms of
     dispatch per section per chunk — at streaming granularity that
     overhead would eat the very overlap the stream exists to create.
     Callers pad ``ids`` to a power-of-two bucket (compile O(log)
-    variants) and slice the padding off after their host copy."""
+    variants) and slice the padding off after their host copy.
+
+    Mesh serving (ISSUE 12): the export is jitted with REPLICATED
+    out_shardings, so a sharded arena's run leaves as a host-replicated
+    fragment — the wire codec, the stream assembler and np.asarray on a
+    handler thread all see exactly the single-device layout (one gather
+    here instead of one per consumer); device-path adoption re-shards on
+    insert, where the write jit owns the layout anyway."""
     import jax
 
-    @jax.jit
     def export(arena, ids):
         return {name: a[:, ids] for name, a in arena.items()}
 
-    return export
+    if mesh is None:
+        return jax.jit(export)
+    from jax.sharding import NamedSharding, PartitionSpec
+    return jax.jit(export,
+                   out_shardings=NamedSharding(mesh, PartitionSpec()))
 
 
-def _build_fill(t: int):
+def _build_fill(t: int, out_shardings=None):
     """``_build_write`` with a T-token pad on the source: a slot's tail
     fill copies ceil(remaining / T) pages from a single-request cache, and
     the last page's slice may reach up to T-1 positions past the cache's
     length — dynamic_slice would CLAMP the start and silently misalign
     the data. The pad makes the overshoot read zeros instead (positions
     beyond the slot's length: masked by attention, overwritten by decode
-    writes — the standard decode-path invariant)."""
+    writes — the standard decode-path invariant). ``out_shardings`` pins
+    the arena's layout under mesh serving (see _build_gather)."""
     import jax
     import jax.numpy as jnp
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
     def fill(arena, single, ids, start_tok):
         n = ids.shape[0]
         out = {}
@@ -503,7 +522,9 @@ def _build_fill(t: int):
             out[name] = a.at[:, ids].set(frag)
         return out
 
-    return fill
+    if out_shardings is None:
+        return jax.jit(fill, donate_argnums=(0,))
+    return jax.jit(fill, donate_argnums=(0,), out_shardings=out_shardings)
 
 
 class PagedKVStore:
@@ -519,11 +540,25 @@ class PagedKVStore:
     arena, and a gather racing a donation would read freed buffers."""
 
     def __init__(self, n_pages: int, page_tokens: int,
-                 single_shape_fn: Callable, mesh=None):
+                 single_shape_fn: Callable, mesh=None,
+                 arena_sharding: str = "auto"):
+        """``mesh``: allocate the arena DIRECTLY under its NamedSharding
+        (ISSUE 12: a TP engine's paged hot path serves from a sharded
+        arena — constructing replicated and resharding after would
+        transiently double HBM at exactly the scale sharding exists
+        for). ``arena_sharding``: "auto" shards each section per
+        kv_cache_pspec (kv-heads over ``tensor``; MLA latents replicate
+        — they have no head axis); "replicate" pins every section
+        replicated — the fallback for head counts the mesh doesn't
+        divide (pays memory, keeps paged decode)."""
         import jax
         import jax.numpy as jnp
 
+        if arena_sharding not in ("auto", "replicate"):
+            raise ValueError(f"arena_sharding must be 'auto' or "
+                             f"'replicate', got {arena_sharding!r}")
         self.page_tokens = page_tokens
+        self.arena_sharding = arena_sharding
         self.pool = PagePool(n_pages)
         self.trie = PrefixTrie(self.pool, page_tokens)
         shapes = jax.eval_shape(single_shape_fn)
@@ -538,19 +573,35 @@ class PagedKVStore:
                 (sd.shape[0], n_pages, page_tokens) + sd.shape[3:], sd.dtype)
                 for name, sd in sections.items()}
 
+        arena_sh = single_sh = self._replicated = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            ashapes = jax.eval_shape(build)
+            arena_sh = {
+                name: NamedSharding(
+                    mesh,
+                    PartitionSpec() if arena_sharding == "replicate"
+                    else kv_cache_pspec(name, sd.ndim))
+                for name, sd in ashapes.items()}
+            # the single-request caches the gather produces follow the
+            # SAME construction shardings the engine's _fresh_cache uses
+            # (kv_cache_pspec) — equal sharding objects everywhere keep
+            # every consumer jit at one executable
+            single_sh = {name: NamedSharding(mesh,
+                                             kv_cache_pspec(name, sd.ndim))
+                         for name, sd in shapes.items()}
+            # replicated-export target for the eager per-section path
+            # (export_pages); the jitted all-section path bakes it into
+            # _build_export's out_shardings
+            self._replicated = NamedSharding(mesh, PartitionSpec())
         if mesh is None:
             self.arena = build()
         else:
-            from jax.sharding import NamedSharding
-            ashapes = jax.eval_shape(build)
-            shardings = {name: NamedSharding(mesh,
-                                             kv_cache_pspec(name, sd.ndim))
-                         for name, sd in ashapes.items()}
-            self.arena = jax.jit(build, out_shardings=shardings)()
-        self._gather = _build_gather(page_tokens)
-        self._write = _build_write(page_tokens)
-        self._fill = _build_fill(page_tokens)
-        self._export = _build_export()
+            self.arena = jax.jit(build, out_shardings=arena_sh)()
+        self._gather = _build_gather(page_tokens, out_shardings=single_sh)
+        self._write = _build_write(page_tokens, out_shardings=arena_sh)
+        self._fill = _build_fill(page_tokens, out_shardings=arena_sh)
+        self._export = _build_export(mesh)
 
     @property
     def page_bytes(self) -> int:
@@ -615,10 +666,18 @@ class PagedKVStore:
         Returns fresh device arrays (the arena is read, never donated):
         the caller may np.asarray them OUTSIDE the engine's prefix lock —
         the copies stay valid across later arena donations. The caller
-        holds the pages' references while this dispatches."""
+        holds the pages' references while this dispatches. Mesh serving:
+        the copies come back HOST-REPLICATED (one gather at the source)
+        so the wire codec and the device-handoff validators see the
+        single-device layout; adoption re-shards on insert."""
+        import jax
         import jax.numpy as jnp
         ids = jnp.asarray(pages, jnp.int32)
-        return {name: a[:, ids] for name, a in self.arena.items()}
+        out = {name: a[:, ids] for name, a in self.arena.items()}
+        if self._replicated is not None:
+            out = {name: jax.device_put(a, self._replicated)
+                   for name, a in out.items()}
+        return out
 
     def export_run(self, pages: list) -> dict:
         """``export_pages`` for the STREAMING path: one jitted dispatch

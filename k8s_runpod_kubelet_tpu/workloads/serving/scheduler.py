@@ -94,6 +94,16 @@ class ServingConfig:
     # loop on, auto doubles (decode slots live IN the arena, so it must
     # hold the slots' residency plus the shared prefix pool).
     kv_pool_pages: int = 0
+    # -- TP paged serving (ISSUE 12) -------------------------------------
+    # how the arena sections place over a serving mesh. "auto": K/V (and
+    # scale) sections shard their kv-heads axis over ``tensor`` exactly
+    # like the contiguous cache (kv_cache_pspec; MLA latent sections
+    # replicate — headless), degrading to a fully replicated arena when
+    # the mesh doesn't divide the kv-head count. "replicate" pins the
+    # replicated layout (every shard holds the whole arena — pays HBM,
+    # keeps paged decode; a debugging/odd-geometry escape hatch).
+    # Ignored off-mesh.
+    kv_arena_sharding: str = "auto"
     # -- paged decode loop (ISSUE 9) -------------------------------------
     # run the decode hot loop on per-slot page tables over the shared
     # arena (LlamaModel.paged_decode_step): prefix hits and handed-off KV

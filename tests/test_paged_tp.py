@@ -1,0 +1,322 @@
+"""Tensor-parallel paged serving (ISSUE 12): the paged decode hot path on
+a mesh — sharded arena, shard_mapped paged attention, lifted eligibility
+gate.
+
+Coverage:
+- gate text (tier-1, in-process, no mesh): the eligibility error no
+  longer blames the mesh — a TP engine pages; what's left is the
+  windowed interleave, explicit ring pins, adapters and speculation;
+- compile stability (tier-1, clean subprocess): the shard_mapped paged
+  step compiles ONCE across decode steps with varying live-slot counts,
+  page-table contents and lengths, and the store's pow2 gather/write
+  bucketing holds under the mesh (PR 8's contract must survive
+  shard_map);
+- the layout x path matrix's mesh dimension (slow, clean subprocess per
+  scenario): plain and int8-KV engines on a tp=2 CPU mesh decode
+  token-identically to the CONTIGUOUS mesh loop (greedy + seeded
+  sampling), adopt handed-off pages as prefix hits (wire and device
+  paths), and leak zero pages on both arenas; the replicate-arena
+  escape hatch gets the same identity + leak checks.
+
+ISOLATION NOTE (PR 6 device-subset-mesh precedent): every jax scenario
+runs in a fresh subprocess (`python tests/test_paged_tp.py <scenario>`).
+Executables compiled for meshes over device subsets trigger heap
+corruption in this image's XLA:CPU when they share a process with the
+suite's accumulated compiler state; standalone they pass 100% of runs.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+SEED = 20260804
+_REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _ctx(msg: str) -> str:
+    return f"{msg} (seed={SEED})"
+
+
+def _run_scenario(name: str, marker: str, timeout: int = 540):
+    """One scenario in a clean interpreter (see the ISOLATION NOTE)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    env["PYTHONPATH"] = str(_REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    # the persistent compile cache composes badly with device-subset
+    # meshes (the PR 6 pinned repro) — keep the child in-memory only
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    proc = subprocess.run([sys.executable, os.path.abspath(__file__), name],
+                          env=env, capture_output=True, text=True,
+                          timeout=timeout, cwd=str(_REPO))
+    assert proc.returncode == 0, _ctx(
+        f"tp scenario {name} failed (rc={proc.returncode}):\n"
+        f"stdout tail: {proc.stdout[-1500:]}\n"
+        f"stderr tail: {proc.stderr[-1500:]}")
+    assert marker in proc.stdout, _ctx(
+        f"{marker} missing:\n{proc.stdout[-1500:]}")
+
+
+def test_gate_error_does_not_blame_the_mesh():
+    """ISSUE 12 gate-text regression: mesh engines page now, so the
+    paged_decode=True error must name only the TRUE exclusions —
+    windowed interleave, ring_cache=True pins, adapters, speculation —
+    and never 'no mesh' / single-host."""
+    import jax
+    import jax.numpy as jnp
+    from k8s_runpod_kubelet_tpu.models import init_params, tiny_llama
+    from k8s_runpod_kubelet_tpu.workloads.serving import (ServingConfig,
+                                                          ServingEngine)
+    cfg = tiny_llama(vocab_size=64, embed_dim=32, n_layers=1, n_heads=2,
+                     n_kv_heads=2, mlp_dim=64, max_seq_len=128,
+                     dtype=jnp.float32, param_dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError) as ei:
+        ServingEngine(cfg, params, ServingConfig(
+            slots=2, cache_len=128, kv_page_tokens=8,
+            paged_decode=True, speculate_k=2))
+    msg = str(ei.value)
+    assert "interleave" in msg and "ring_cache=True" in msg
+    assert "no adapters" in msg and "no speculation" in msg
+    assert "no mesh" not in msg and "Single host" not in msg \
+        and "single host" not in msg
+
+
+def test_bad_kv_arena_sharding_is_a_loud_error():
+    import jax
+    import jax.numpy as jnp
+    from k8s_runpod_kubelet_tpu.models import init_params, tiny_llama
+    from k8s_runpod_kubelet_tpu.workloads.serving import (ServingConfig,
+                                                          ServingEngine)
+    cfg = tiny_llama(vocab_size=64, embed_dim=32, n_layers=1, n_heads=2,
+                     n_kv_heads=2, mlp_dim=64, max_seq_len=128,
+                     dtype=jnp.float32, param_dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="kv_arena_sharding"):
+        ServingEngine(cfg, params, ServingConfig(
+            slots=1, cache_len=128, kv_page_tokens=8,
+            kv_arena_sharding="sideways"))
+
+
+def test_shard_mapped_paged_step_compiles_once_in_clean_process():
+    """Tier-1 compile-stability pin: the tp=2 paged step stays at ONE
+    jit cache entry across steps whose live-slot mix, page-table
+    contents and lengths all vary, and the mesh store's pow2
+    gather/write bucketing compiles O(log) variants (the PR 8 contract
+    survives shard_map)."""
+    _run_scenario("compile", "COMPILE_ONCE_OK")
+
+
+@pytest.mark.slow
+def test_tp2_plain_matrix_in_clean_process():
+    """Mesh row of the layout x path matrix, plain K/V: token identity
+    vs the contiguous mesh loop, wire + device adoption hits, zero
+    leaks, sharded-arena evidence."""
+    _run_scenario("plain", "PLAIN_TP2_OK", timeout=720)
+
+
+@pytest.mark.slow
+def test_tp2_int8_kv_matrix_in_clean_process():
+    """Mesh row, int8-KV: dequant-in-kernel paged decode under shard_map
+    (scales shard alongside), adoption hit, zero leaks."""
+    _run_scenario("int8", "INT8_TP2_OK", timeout=720)
+
+
+@pytest.mark.slow
+def test_tp2_replicate_arena_in_clean_process():
+    """kv_arena_sharding="replicate": the escape hatch keeps paged
+    decode token-identical with a fully replicated arena (and still
+    compiles once — replicated specs, no per-step arena reshard)."""
+    _run_scenario("replicate", "REPLICATE_TP2_OK", timeout=720)
+
+
+# --------------------------------------------------------------------------
+# jax scenarios — executed by the subprocess tests above
+# --------------------------------------------------------------------------
+
+def _tiny_cfg():
+    import jax.numpy as jnp
+    from k8s_runpod_kubelet_tpu.models import tiny_llama
+    return tiny_llama(vocab_size=128, embed_dim=64, n_layers=2, n_heads=4,
+                      n_kv_heads=2, mlp_dim=128, max_seq_len=256,
+                      dtype=jnp.float32, param_dtype=jnp.float32)
+
+
+def _mesh2():
+    import jax
+    from k8s_runpod_kubelet_tpu.parallel import MeshConfig, make_mesh
+    return make_mesh(MeshConfig(data=1, tensor=2), jax.devices()[:2])
+
+
+_SC = dict(slots=2, max_prefill_len=8, cache_len=64, max_new_tokens=12,
+           kv_page_tokens=4)
+
+
+def _scenario_compile():
+    """Varying live slots / page tables / lengths -> ONE paged-step
+    executable; store gather/write stay pow2-bucketed on the mesh."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from k8s_runpod_kubelet_tpu.models import init_params
+    from k8s_runpod_kubelet_tpu.workloads.serving import (ServingConfig,
+                                                          ServingEngine)
+
+    cfg, mesh = _tiny_cfg(), _mesh2()
+    params = init_params(cfg, jax.random.PRNGKey(0), mesh)
+    e = ServingEngine(cfg, params, ServingConfig(**_SC), mesh=mesh).start()
+    try:
+        assert e._paged_loop and e.mesh is not None, _ctx(
+            "paged loop must be ON for a tp=2 engine")
+        assert e._paged_tp == 2, _ctx(f"paged_tp={e._paged_tp}")
+        rng = np.random.default_rng(SEED)
+        # live-slot counts vary naturally: 1 then 2 concurrent, lengths
+        # and table contents differ per request
+        e.submit([5, 9, 2], max_new_tokens=6).result(timeout=300)
+        futs = [e.submit([int(rng.integers(1, 120)) for _ in range(n)],
+                         max_new_tokens=6) for n in (3, 9)]
+        for f in futs:
+            f.result(timeout=300)
+        assert e._paged_step._cache_size() == 1, _ctx(
+            f"paged step compiled {e._paged_step._cache_size()} times — "
+            "the shard_mapped step must compile ONCE across varying "
+            "live-slot counts and page tables")
+        # pow2 bucketing on the mesh store: distinct run lengths share
+        # log-many write/gather executables, never one per length
+        st = e._kv_store
+        assert st._write._cache_size() <= 4, _ctx(
+            f"write jit compiled {st._write._cache_size()} variants")
+        assert st._gather._cache_size() <= 4, _ctx(
+            f"gather jit compiled {st._gather._cache_size()} variants")
+        e.drain()
+        s = e.prefix_cache_stats()
+        assert s["pages_free"] + s["nodes"] == s["pages_total"], _ctx(str(s))
+    finally:
+        e.stop()
+    print("COMPILE_ONCE_OK", flush=True)
+
+
+def _matrix(extra: dict, marker: str, check_device_path: bool):
+    """Shared body for the mesh matrix scenarios: identity vs the
+    contiguous mesh loop, adoption hit, zero leaks."""
+    import jax
+
+    from k8s_runpod_kubelet_tpu.models import init_params
+    from k8s_runpod_kubelet_tpu.workloads.serving import (ServingConfig,
+                                                          ServingEngine)
+
+    cfg, mesh = _tiny_cfg(), _mesh2()
+    params = init_params(cfg, jax.random.PRNGKey(0), mesh)
+    paged = ServingEngine(cfg, params, ServingConfig(**_SC, **extra),
+                          mesh=mesh).start()
+    contig = ServingEngine(cfg, params,
+                           ServingConfig(**_SC, **extra, paged_decode=False),
+                           mesh=mesh).start()
+    engines = [paged]
+    try:
+        assert paged._paged_loop and not contig._paged_loop, _ctx(marker)
+        if extra.get("kv_arena_sharding") == "replicate":
+            assert paged._kv_store.arena["k"].sharding.is_fully_replicated, \
+                _ctx(str(paged._kv_store.arena["k"].sharding))
+        else:
+            # the arena genuinely spans the mesh (kv-heads sharded)
+            some = next(iter(paged._kv_store.arena.values()))
+            assert len(some.sharding.device_set) == 2, _ctx(
+                str(some.sharding))
+        prompts = [[5, 9, 2], [7, 3, 1, 4, 1, 5, 9, 2, 6], [11, 13]]
+        for i, p in enumerate(prompts):
+            kw = dict(max_new_tokens=12)
+            if i % 3 == 2:  # seeded sampling rides the same identity bar
+                kw.update(temperature=0.8, seed=1000 + i)
+            a = paged.submit(p, **kw).result(timeout=300)
+            b = contig.submit(p, **kw).result(timeout=300)
+            assert a["tokens"] == b["tokens"], _ctx(
+                f"{marker} prompt {i}: paged != contiguous mesh loop")
+        assert paged._paged_step._cache_size() == 1, _ctx(
+            f"{marker}: paged step compiled "
+            f"{paged._paged_step._cache_size()} times")
+
+        # adoption-hit: a second mesh engine adopts this engine's pages
+        # (wire codec), then serves the prompt as a prefix hit,
+        # token-identical to the contiguous loop
+        shared = [((i * 31) % 120) + 1 for i in range(16)]
+        paged.submit(shared + [1], max_new_tokens=2).result(timeout=300)
+        dec = ServingEngine(cfg, params, ServingConfig(**_SC, **extra),
+                            mesh=mesh).start()
+        engines.append(dec)
+        out = paged.export_handoff(shared)
+        res = dec.adopt_handoff(out["blob"])
+        assert res["pages"] == len(shared) // _SC["kv_page_tokens"], _ctx(
+            str(res))
+        a = dec.submit(shared + [9, 9], max_new_tokens=6).result(timeout=300)
+        b = contig.submit(shared + [9, 9], max_new_tokens=6).result(
+            timeout=300)
+        assert a["tokens"] == b["tokens"], _ctx(f"{marker}: adopted KV "
+                                                "decoded differently")
+        assert dec.metrics.get_counter(
+            "tpu_serving_prefix_cache_hits") >= 1, _ctx(
+            f"{marker}: adoption never hit")
+
+        if check_device_path:
+            # device-path adoption between two mesh engines: the export
+            # comes back host-replicated, adoption re-shards on insert
+            expd = paged.export_handoff_device(shared)
+            assert all(a_.sharding.is_fully_replicated
+                       for a_ in expd["sections"].values()), _ctx(
+                "device export sections must be host-replicated")
+            dec2 = ServingEngine(cfg, params, ServingConfig(**_SC, **extra),
+                                 mesh=mesh).start()
+            engines.append(dec2)
+            dec2.adopt_handoff_device(expd["tokens"], expd["sections"],
+                                      model=cfg.name)
+            a = dec2.submit(shared + [7], max_new_tokens=6).result(
+                timeout=300)
+            b = contig.submit(shared + [7], max_new_tokens=6).result(
+                timeout=300)
+            assert a["tokens"] == b["tokens"], _ctx(
+                f"{marker}: device-adopted KV decoded differently")
+
+        for e in engines:
+            e.drain()
+            assert e.drained, _ctx(marker)
+            s = e.prefix_cache_stats()
+            assert s["pages_free"] + s["nodes"] == s["pages_total"], _ctx(
+                f"{marker}: leaked pages ({s})")
+    finally:
+        for e in engines + [contig]:
+            e.stop()
+    print(marker, flush=True)
+
+
+def _scenario_plain():
+    _matrix({}, "PLAIN_TP2_OK", check_device_path=True)
+
+
+def _scenario_int8():
+    _matrix({"quantize_kv_int8": True}, "INT8_TP2_OK",
+            check_device_path=False)
+
+
+def _scenario_replicate():
+    _matrix({"kv_arena_sharding": "replicate"}, "REPLICATE_TP2_OK",
+            check_device_path=False)
+
+
+def _main(argv: list) -> int:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    scenarios = {"compile": _scenario_compile, "plain": _scenario_plain,
+                 "int8": _scenario_int8, "replicate": _scenario_replicate}
+    scenarios[argv[0]]()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(_main(sys.argv[1:]))

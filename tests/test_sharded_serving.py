@@ -63,8 +63,12 @@ class TestShardedServing:
         try:
             # params really are sharded across the mesh devices
             assert len(sharded.params["layers"]["wq"].sharding.device_set) == 2
-            # ...and so is the KV cache's kv-heads axis
-            assert len(sharded._cache["k"].sharding.device_set) == 2
+            # ...and so is the paged KV arena's kv-heads axis (ISSUE 12:
+            # TP engines run the PAGED loop — the contiguous batch cache
+            # no longer exists; the arena IS the slot storage)
+            assert sharded._paged_loop and sharded._cache is None
+            assert len(sharded._kv_store.arena["k"]
+                       .sharding.device_set) == 2
             for p in PROMPTS:
                 a = plain.submit(p, max_new_tokens=12).result(timeout=120)
                 b = sharded.submit(p, max_new_tokens=12).result(timeout=120)
@@ -189,8 +193,12 @@ class TestShardedServing:
         sharded = _engine(CFG, init_params(CFG, jax.random.PRNGKey(0), mesh),
                           mesh=mesh, quantize_kv_int8=True)
         try:
-            assert sharded._cache["k"].dtype == jnp.int8
-            assert len(sharded._cache["k_scale"].sharding.device_set) == 2
+            # int8-KV mesh engines page too (ISSUE 12): the int8 payload
+            # and its scale sections live in the sharded arena
+            assert sharded._paged_loop and sharded._cache is None
+            assert sharded._kv_store.arena["k"].dtype == jnp.int8
+            assert len(sharded._kv_store.arena["k_scale"]
+                       .sharding.device_set) == 2
             p = PROMPTS[1]
             a = plain.submit(p, max_new_tokens=10).result(timeout=120)
             b = sharded.submit(p, max_new_tokens=10).result(timeout=120)
@@ -240,7 +248,11 @@ class TestExpertParallelServing:
         try:
             we = sharded.params["layers"]["we_gate"]
             assert len(we.sharding.device_set) == 4
-            assert len(sharded._cache["k"].sharding.device_set) == 4
+            # EP x TP engines page too (ISSUE 12): the arena spans the
+            # whole mesh (kv-heads over tensor, replicated over expert)
+            assert sharded._paged_loop and sharded._cache is None
+            assert len(sharded._kv_store.arena["k"]
+                       .sharding.device_set) == 4
             for p in PROMPTS:
                 a = plain.submit(p, max_new_tokens=12).result(timeout=120)
                 b = sharded.submit(p, max_new_tokens=12).result(timeout=120)
