@@ -456,7 +456,163 @@ def run_paged_attn_bench(smoke: bool = False) -> int:
                "dtype": dtype.__name__,
                "backend": jax.default_backend()})
     _paged_tp_cell(smoke)
+    _paged_prefill_cell(smoke)
+    _paged_spec_cell(smoke)
     return 0
+
+
+def _paged_prefill_cell(smoke: bool) -> None:
+    """Prefill-into-arena TTFT cell (ISSUE 14): end-to-end submit->first-
+    token latency through REAL engines, paged-NATIVE prefill (chunks
+    scatter K/V straight into the arena pages) vs the dense-scratch
+    route (prefill into a contiguous scratch cache, then fill_pages-copy
+    into the pool) — the copy the native path deletes. Distinct prompts
+    per iteration so the prefix cache never shortcuts the measured span.
+    CPU numbers are an overhead smoke (explicitly backend=cpu); the chip
+    claim waits on the tunnel."""
+    import statistics
+    import numpy as _np
+    import jax
+    import jax.numpy as jnp
+    from k8s_runpod_kubelet_tpu.models import init_params, tiny_llama
+    from k8s_runpod_kubelet_tpu.workloads.serving import (ServingConfig,
+                                                          ServingEngine)
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = _serve_model("llama3-8b")
+        shapes = jax.eval_shape(
+            lambda: init_params(cfg, jax.random.PRNGKey(0)))
+        params = jax.tree_util.tree_map(
+            lambda sd: _np.zeros(sd.shape, sd.dtype), shapes)
+        prompt_len, int8 = 1024, True
+        sc_kw = dict(slots=4, cache_len=2048, max_prefill_len=1024,
+                     kv_page_tokens=16, quantize_int8=True)
+        iters = 3 if smoke else 10
+    else:
+        cfg = tiny_llama(vocab_size=128, embed_dim=64, n_layers=2,
+                         n_heads=4, n_kv_heads=2, mlp_dim=128,
+                         max_seq_len=512, dtype=jnp.float32,
+                         param_dtype=jnp.float32)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        prompt_len, int8 = 96, False
+        sc_kw = dict(slots=2, cache_len=256, max_prefill_len=128,
+                     kv_page_tokens=8)
+        iters = 3 if smoke else 8
+
+    native = ServingEngine(cfg, params, ServingConfig(**sc_kw)).start()
+    dense = ServingEngine(cfg, params, ServingConfig(
+        **sc_kw, paged_prefill=False)).start()
+    try:
+        assert native._paged_prefill_on and not dense._paged_prefill_on
+        rng = _np.random.default_rng(0)
+
+        def prompts(n):
+            return [[int(x) for x in rng.integers(
+                1, cfg.vocab_size - 8, prompt_len)] for _ in range(n)]
+
+        def ttft_ms(e):
+            for p in prompts(2):  # compile + warm outside the cohort
+                e.submit(p, max_new_tokens=1).result(timeout=600)
+            samples = []
+            for p in prompts(iters):
+                t0 = time.perf_counter()
+                e.submit(p, max_new_tokens=1).result(timeout=600)
+                samples.append((time.perf_counter() - t0) * 1e3)
+            return statistics.median(samples)
+
+        native_ms = ttft_ms(native)
+        dense_ms = ttft_ms(dense)
+        assert native.metrics.get_counter(
+            "tpu_serving_paged_prefill_tokens") > 0
+        _emit({"metric": "paged_prefill_ttft_ms",
+               "value": round(native_ms, 2), "unit": "ms",
+               "dense_fill_ttft_ms": round(dense_ms, 2),
+               "native_over_dense": round(native_ms / dense_ms, 3),
+               "prompt_tokens": prompt_len,
+               "page_tokens": sc_kw["kv_page_tokens"], "int8": int8,
+               "iters": iters, "model": cfg.name,
+               "backend": jax.default_backend()})
+    finally:
+        native.stop()
+        dense.stop()
+
+
+def _paged_spec_cell(smoke: bool) -> None:
+    """Speculative-decode throughput cell (ISSUE 14): generated tokens/s
+    through REAL engines with speculate_k drafts, the paged loop (multi-
+    token verify over per-slot page tables, page-native rollback) vs the
+    contiguous speculative loop. Greedy repetitive traffic so the bigram
+    proposer lands accepts on both sides; acceptance counters ride the
+    row so a throughput delta can be read against draft quality. CPU is
+    an overhead smoke (backend=cpu); the chip claim waits on the
+    tunnel."""
+    import numpy as _np
+    import jax
+    import jax.numpy as jnp
+    from k8s_runpod_kubelet_tpu.models import init_params, tiny_llama
+    from k8s_runpod_kubelet_tpu.workloads.serving import (ServingConfig,
+                                                          ServingEngine)
+
+    on_tpu = jax.default_backend() == "tpu"
+    k = 3
+    if on_tpu:
+        cfg = _serve_model("llama3-8b")
+        shapes = jax.eval_shape(
+            lambda: init_params(cfg, jax.random.PRNGKey(0)))
+        params = jax.tree_util.tree_map(
+            lambda sd: _np.zeros(sd.shape, sd.dtype), shapes)
+        sc_kw = dict(slots=4, cache_len=2048, max_prefill_len=256,
+                     kv_page_tokens=16, quantize_int8=True,
+                     max_new_tokens=256, speculate_k=k)
+        new_toks = 128 if smoke else 256
+    else:
+        cfg = tiny_llama(vocab_size=128, embed_dim=64, n_layers=2,
+                         n_heads=4, n_kv_heads=2, mlp_dim=128,
+                         max_seq_len=512, dtype=jnp.float32,
+                         param_dtype=jnp.float32)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        sc_kw = dict(slots=2, cache_len=256, max_prefill_len=32,
+                     kv_page_tokens=8, max_new_tokens=128, speculate_k=k)
+        new_toks = 48 if smoke else 96
+    prompt = [5, 6, 7] * 4
+
+    paged = ServingEngine(cfg, params, ServingConfig(**sc_kw)).start()
+    contig = ServingEngine(cfg, params, ServingConfig(
+        **sc_kw, paged_decode=False)).start()
+    try:
+        assert paged._paged_loop and paged._paged_verify is not None
+
+        def tok_s(e):
+            # full-length warm run: a short warm leaves the longer run's
+            # compile buckets (eviction, slot-finish shapes) cold and the
+            # measured span would compare compiles, not decode
+            e.submit(prompt, max_new_tokens=new_toks).result(timeout=600)
+            t0 = time.perf_counter()
+            out = e.submit(prompt, max_new_tokens=new_toks).result(
+                timeout=600)
+            return len(out["tokens"]) / (time.perf_counter() - t0)
+
+        paged_tok_s = tok_s(paged)
+        contig_tok_s = tok_s(contig)
+        prop = paged.metrics.get_counter("tpu_serving_spec_proposed")
+        acc = paged.metrics.get_counter("tpu_serving_spec_accepted")
+        _emit({"metric": "paged_spec_decode_tok_s",
+               "value": round(paged_tok_s, 1), "unit": "tok/s",
+               "contiguous_tok_s": round(contig_tok_s, 1),
+               "paged_over_contiguous": round(
+                   paged_tok_s / contig_tok_s, 3),
+               "speculate_k": k, "new_tokens": new_toks,
+               "spec_acceptance": round(acc / prop, 3) if prop else None,
+               "paged_spec_steps": paged.metrics.get_counter(
+                   "tpu_serving_paged_speculative_steps"),
+               "rollback_pages": paged.metrics.get_counter(
+                   "tpu_serving_paged_speculative_rollback_pages"),
+               "model": cfg.name,
+               "backend": jax.default_backend()})
+    finally:
+        paged.stop()
+        contig.stop()
 
 
 def _paged_tp_cell(smoke: bool) -> None:

@@ -162,6 +162,11 @@ class Config:
     # True = auto (on whenever the model/layout allows it); False pins the
     # contiguous slot-cache loop.
     kv_paged_decode: bool = True
+    # paged-native prefill (ISSUE 14): scatter prefill chunks straight
+    # into arena pages — no dense scratch cache or page-copy on the hot
+    # path. True = auto (on whenever the paged loop runs); False pins the
+    # dense-scratch prefill + adoption-copy route.
+    kv_paged_prefill: bool = True
     # TP paged serving (ISSUE 12): how the paged arena places over a
     # tensor-parallel serving mesh. "auto" shards each section's kv-heads
     # axis over ``tensor`` like the contiguous cache (MLA latents
@@ -378,6 +383,7 @@ _ENV_MAP = {
     "TPU_KV_POOL_PAGES": "kv_pool_pages",
     "TPU_PREFIX_CACHE_ENABLED": "prefix_cache_enabled",
     "TPU_KV_PAGED_DECODE": "kv_paged_decode",
+    "TPU_KV_PAGED_PREFILL": "kv_paged_prefill",
     "TPU_KV_ARENA_SHARDING": "kv_arena_sharding",
     "TPU_SERVING_CHUNK_TOKENS": "serving_chunk_tokens",
     "TPU_HANDOFF_STREAM_WINDOW": "handoff_stream_window",

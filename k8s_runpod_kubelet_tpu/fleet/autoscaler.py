@@ -42,6 +42,7 @@ log = logging.getLogger(__name__)
 SERVING_PASSTHROUGH_ENV = ("TPU_KV_PAGE_TOKENS", "TPU_KV_POOL_PAGES",
                            "TPU_PREFIX_CACHE_ENABLED",
                            "TPU_KV_PAGED_DECODE",
+                           "TPU_KV_PAGED_PREFILL",
                            "TPU_KV_ARENA_SHARDING",
                            "TPU_SERVING_CHUNK_TOKENS",
                            "TPU_HANDOFF_STREAM_WINDOW",

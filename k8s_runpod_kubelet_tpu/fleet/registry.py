@@ -457,6 +457,14 @@ class ReplicaReporter:
         misses = self.engine.metrics.get_counter(
             "tpu_serving_prefix_cache_misses")
         hit_rate = hits / (hits + misses) if hits + misses else 0.0
+        # speculative-decode acceptance (ISSUE 14): accepted/proposed draft
+        # tokens — per-replica proof the proposer matches its traffic
+        # (fleet_summary.py renders it next to prefix%); None when the
+        # replica never proposed (speculate_k=0 or all-sampled traffic)
+        spec_prop = self.engine.metrics.get_counter(
+            "tpu_serving_spec_proposed")
+        spec_acc = self.engine.metrics.get_counter(
+            "tpu_serving_spec_accepted")
         return {
             "free_slots": snap["max_slots"] - snap["active_slots"],
             "active_slots": snap["active_slots"],
@@ -492,6 +500,8 @@ class ReplicaReporter:
             "kv_pages_total": int(pool.get("pages_total", 0)),
             "handoffs_total": snap.get("handoffs_total", 0),
             "prefix_hit_rate": round(hit_rate, 4),
+            "spec_acceptance_rate": (round(spec_acc / spec_prop, 4)
+                                     if spec_prop else None),
             "draining": self.engine.draining,
         }
 

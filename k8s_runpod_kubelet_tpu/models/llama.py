@@ -30,6 +30,10 @@ from jax.sharding import Mesh
 from ..ops import (apply_rope, flash_attention, paged_attention,
                    ring_attention, rms_norm, rope_frequencies)
 from ..ops.attention import (paged_attention_mla, paged_attention_mla_quant,
+                             paged_attention_multi,
+                             paged_attention_multi_mla,
+                             paged_attention_multi_mla_quant,
+                             paged_attention_multi_quant,
                              paged_attention_quant)
 from .moe import moe_mlp
 from ..parallel.mesh import AXES
@@ -1596,7 +1600,9 @@ class LlamaModel:
     def paged_decode_step(self, params: Params, token: jax.Array,
                           arena: Params, page_tables: jax.Array,
                           lengths: jax.Array,
-                          active: Optional[jax.Array] = None, *,
+                          active: Optional[jax.Array] = None,
+                          adapters: Optional[dict] = None,
+                          adapter_ids: Optional[jax.Array] = None, *,
                           use_pallas: Optional[bool] = None,
                           interpret: bool = False,
                           shard_kv: bool = True
@@ -1613,98 +1619,164 @@ class LlamaModel:
         (tests pin it); this is the decode path disaggregated prefill/
         decode (ROADMAP item 2) ships KV pages into.
 
+        This is the K=1 case of ``paged_verify_step`` (one kernel to
+        maintain — the same delegation decode_step makes to verify_step),
+        plus the lengths advance the verify path leaves to its caller.
+        ``adapters``/``adapter_ids`` thread per-request multi-LoRA deltas
+        exactly like decode_step (ISSUE 14 lifted the paged loop's
+        no-adapters exclusion).
+
         Layouts (ISSUE 10 lifted the plain-dense-only gate; ISSUE 11
         finished the matrix): plain K/V, int8 K/V (k_scale/v_scale
-        sections page alongside; the new token's row quantizes exactly
-        like the contiguous int8 cache and attention dequantizes in
-        kernel — paged_attention_quant), MLA latents (c/kr ± dense-prefix
-        sections — paged_attention_mla), the int8 LATENT combination
-        (paged_attention_mla_quant), and UNIFORM sliding windows (the
-        kernels mask/skip outside the window; table entries behind
-        ``length - window`` are never read, so the caller may recycle
-        their physical pages — the engine's ring run). Only the windowed
-        interleave (pattern > 1) still cannot page.
+        sections page alongside), MLA latents (c/kr ± dense-prefix
+        sections), the int8 LATENT combination, and UNIFORM sliding
+        windows (the kernels mask/skip outside the window; table entries
+        behind ``length - window`` are never read, so the caller may
+        recycle their physical pages — the engine's ring run). Only the
+        windowed interleave (pattern > 1) still cannot page.
 
         Mesh serving (ISSUE 12): the attention dispatches run under
         shard_map over ``tensor`` (kv-head axis local per shard when
         ``shard_kv``, fully replicated specs when the engine pinned a
         replicated arena) and the new row's scatter partitions through
         GSPMD — the write lands on the owning shard."""
+        b = token.shape[0]
+        if active is None:
+            active = jnp.ones((b,), bool)
+        logits, arena = self.paged_verify_step(
+            params, token[:, None], arena, page_tables, lengths, active,
+            adapters, adapter_ids, use_pallas=use_pallas,
+            interpret=interpret, shard_kv=shard_kv)
+        new_lengths = jnp.where(active, lengths + 1, lengths)
+        return logits[:, 0], arena, new_lengths
+
+    @_with_int4_mesh
+    def paged_verify_step(self, params: Params, tokens: jax.Array,
+                          arena: Params, page_tables: jax.Array,
+                          lengths: jax.Array,
+                          active: Optional[jax.Array] = None,
+                          adapters: Optional[dict] = None,
+                          adapter_ids: Optional[jax.Array] = None,
+                          n_tokens: Optional[jax.Array] = None, *,
+                          use_pallas: Optional[bool] = None,
+                          interpret: bool = False,
+                          shard_kv: bool = True
+                          ) -> tuple[jax.Array, Params]:
+        """K tokens per slot in ONE pass over PAGED KV (the multi-token
+        siblings of ops.paged_attention): tokens (B, K) -> (logits
+        (B, K, V) f32, arena). Slot b's query j sits at logical position
+        lengths[b] + j; its K/V row scatters into page
+        page_tables[b, pos // T] at offset pos %% T, and attention runs
+        the causal intra-block mask through ops.paged_attention_multi
+        (and the _quant/_mla/_mla_quant siblings), so ``logits[:, j]``
+        is exactly what paged_decode_step would produce sequentially —
+        speculative verification and paged-native chunked prefill in one
+        memory-bound sweep instead of K dispatches.
+
+        ``n_tokens`` (B,) limits how many of the K rows are REAL per
+        slot (a prefill chunk's true length; a non-greedy slot riding a
+        speculative batch verifies only its 1 committed token): rows at
+        or beyond n_tokens[b] scatter nothing — an out-of-bounds page id
+        + mode="drop" elides the write, the same hazard-closure the
+        single-token step applies to inactive slots, whose stale table
+        rows may alias another slot's live tail page — and their logits
+        are garbage the caller must ignore. ``active`` is the
+        n_tokens = 0 degenerate (kept for decode-step delegation).
+        ``lengths`` is NOT advanced — the caller commits the accepted
+        prefix, and uncommitted tail pages simply drop back to the pool
+        (append-only pages make speculative rollback a refcount
+        operation, not the ring-invariant rewind the contiguous
+        speculative path needs).
+
+        ``adapters``/``adapter_ids`` thread per-request multi-LoRA
+        deltas exactly like the contiguous verify_step (_ml_qkv_deltas,
+        the wo delta, and the MLP deltas, all with per-row adapter
+        selection) — base-only slots ride adapter id 0's all-zero
+        entry."""
         cfg = self.cfg
         if cfg.sliding_window is not None and cfg.sliding_window_pattern != 1:
             raise ValueError("paged decode covers uniform sliding windows "
                              "only (pattern 1); the windowed interleave's "
                              "split ring/global cache cannot page")
         if cfg.is_mla:
-            return self._paged_decode_step_mla(
-                params, token, arena, page_tables, lengths, active,
-                use_pallas=use_pallas, interpret=interpret)
+            if adapters:
+                raise ValueError("multi-LoRA adapters do not target MLA "
+                                 "projections; serve MLA models without "
+                                 "adapters")
+            return self._paged_verify_step_mla(
+                params, tokens, arena, page_tables, lengths, active,
+                n_tokens, use_pallas=use_pallas, interpret=interpret)
         quant = "k_scale" in arena
-        b = token.shape[0]
+        b, kk = tokens.shape
         if active is None:
             active = jnp.ones((b,), bool)
+        if n_tokens is None:
+            n_tokens = jnp.where(active, kk, 0)
+        n_tokens = n_tokens.astype(jnp.int32)
         t = arena["k"].shape[2]
-        positions = lengths                                    # (B,) write pos
-        pages_b = jnp.take_along_axis(
-            page_tables, (positions // t)[:, None], axis=1)[:, 0]
-        # an INACTIVE slot must not scatter at all: its page-table row is
-        # stale (page 0 may since belong to another slot's tail), and a
-        # duplicate-index scatter against that slot's genuine write would
-        # resolve in undefined order — clobbering live KV. An out-of-bounds
-        # page id + mode="drop" elides the write instead of masking its
-        # value.
-        pages_b = jnp.where(active, pages_b, arena["k"].shape[1])
+        positions = lengths[:, None] + jnp.arange(kk)[None, :]     # (B,K)
+        pages_bk = jnp.take_along_axis(page_tables, positions // t, axis=1)
+        write_ok = jnp.arange(kk)[None, :] < n_tokens[:, None]     # (B,K)
+        pages_bk = jnp.where(write_ok, pages_bk, arena["k"].shape[1])
         offs = positions % t
         # uniform-window models rotate with the LOCAL table when one
-        # exists (same selection the prefill/verify paths make per layer;
-        # pattern == 1 means every layer is the windowed kind)
+        # exists (pattern == 1 means every layer is the windowed kind)
         cos, sin = _rope_for(_rope_tables(cfg), cfg.sliding_window)
-        x = _embed(params, token[:, None], cfg, self.mesh)     # (B, 1, E)
-        att_len = positions + 1  # the just-written token attends itself
+        x = _embed(params, tokens, cfg, self.mesh)               # (B,K,E)
+        # kernel contract: its ``lengths`` INCLUDES the K query tokens —
+        # query j attends positions <= att_len - K + j = lengths + j
+        att_len = lengths + kk
 
         def block(y, inputs):
             lp, kp, vp = inputs["lp"], inputs["k"], inputs["v"]
             ks, vs = inputs.get("ks"), inputs.get("vs")
+            adj = inputs.get("ad")
             h = rms_norm(y, _norm_w(lp["attn_norm"], cfg), cfg.norm_eps)
-            q, k, v = _qkv(h, lp, cfg, b, 1)
+            q, k, v = _qkv(h, lp, cfg, b, kk)
+            q, k, v = _ml_qkv_deltas(h, q, k, v, adj, adapter_ids)
             if cfg.qk_norm:
                 q = rms_norm(q, _norm_w(lp["q_norm"], cfg), cfg.norm_eps)
                 k = rms_norm(k, _norm_w(lp["k_norm"], cfg), cfg.norm_eps)
-            q = apply_rope(q, cos, sin, positions[:, None])
-            k = apply_rope(k, cos, sin, positions[:, None])
+            q = apply_rope(q, cos, sin, positions)
+            k = apply_rope(k, cos, sin, positions)
             if quant:
                 # same per-row symmetric scheme as the contiguous int8
                 # cache (_kv_quant), so pages and slot caches interchange
-                k_w, k_s = _kv_quant(k[:, 0])          # (B,h,d), (B,h)
-                v_w, v_s = _kv_quant(v[:, 0])
-                ks = ks.at[pages_b, offs].set(k_s, mode="drop")
-                vs = vs.at[pages_b, offs].set(v_s, mode="drop")
-                kp = kp.at[pages_b, offs].set(k_w, mode="drop")
-                vp = vp.at[pages_b, offs].set(v_w, mode="drop")
-                o = paged_attention_quant(
-                    q[:, 0], kp, vp, ks, vs, page_tables, att_len,
+                k_w, k_s = _kv_quant(k)             # (B,K,h,d), (B,K,h)
+                v_w, v_s = _kv_quant(v)
+                ks = ks.at[pages_bk, offs].set(k_s, mode="drop")
+                vs = vs.at[pages_bk, offs].set(v_s, mode="drop")
+                kp = kp.at[pages_bk, offs].set(k_w, mode="drop")
+                vp = vp.at[pages_bk, offs].set(v_w, mode="drop")
+                o = paged_attention_multi_quant(
+                    q, kp, vp, ks, vs, page_tables, att_len,
                     sm_scale=cfg.sm_scale,
                     logit_soft_cap=cfg.attn_logit_softcap,
                     sliding_window=cfg.sliding_window,
                     use_pallas=use_pallas, interpret=interpret,
                     mesh=self.mesh, shard_heads=shard_kv)
             else:
-                kp = kp.at[pages_b, offs].set(k[:, 0], mode="drop")
-                vp = vp.at[pages_b, offs].set(v[:, 0], mode="drop")
-                o = paged_attention(q[:, 0], kp, vp, page_tables, att_len,
-                                    sm_scale=cfg.sm_scale,
-                                    logit_soft_cap=cfg.attn_logit_softcap,
-                                    sliding_window=cfg.sliding_window,
-                                    use_pallas=use_pallas,
-                                    interpret=interpret, mesh=self.mesh,
-                                    shard_heads=shard_kv)
-            o = o.reshape(b, 1, cfg.n_heads * cfg.head_dim_).astype(cfg.dtype)
+                kp = kp.at[pages_bk, offs].set(k, mode="drop")
+                vp = vp.at[pages_bk, offs].set(v, mode="drop")
+                o = paged_attention_multi(
+                    q, kp, vp, page_tables, att_len,
+                    sm_scale=cfg.sm_scale,
+                    logit_soft_cap=cfg.attn_logit_softcap,
+                    sliding_window=cfg.sliding_window,
+                    use_pallas=use_pallas, interpret=interpret,
+                    mesh=self.mesh, shard_heads=shard_kv)
+            o = o.reshape(b, kk,
+                          cfg.n_heads * cfg.head_dim_).astype(cfg.dtype)
+            o_in = o
             o = _mm(o, lp["wo"], cfg.dtype)
+            if adj and "wo" in adj:
+                o = o + _ml_delta(o_in, adj["wo"], adapter_ids)
             if cfg.post_norms:
                 o = rms_norm(o, _norm_w(lp["attn_post_norm"], cfg),
                              cfg.norm_eps)
             y = y + o
-            y, _ = _mlp_block(y, lp, cfg, self.mesh, train=False)
+            y, _ = _mlp_block(y, lp, cfg, self.mesh, train=False,
+                              ad=adj, ad_ids=adapter_ids)
             out = {"k": kp, "v": vp}
             if quant:
                 out["ks"], out["vs"] = ks, vs
@@ -1715,55 +1787,59 @@ class LlamaModel:
         if quant:
             xs["ks"] = arena["k_scale"]
             xs["vs"] = arena["v_scale"]
+        if adapters:
+            xs["ad"] = _group_layers(adapters, 1)
         x, new_kv = jax.lax.scan(block, x, xs)
         x = rms_norm(x, _norm_w(params["final_norm"], cfg), cfg.norm_eps)
-        logits = _head_logits(x, params, cfg).astype(jnp.float32)[:, 0]
-        new_lengths = jnp.where(active, lengths + 1, lengths)
+        logits = _head_logits(x, params, cfg).astype(jnp.float32)  # (B,K,V)
         out = {"k": new_kv["k"], "v": new_kv["v"]}
         if quant:
             out["k_scale"], out["v_scale"] = new_kv["ks"], new_kv["vs"]
-        return logits, out, new_lengths
+        return logits, out
 
-    def _paged_decode_step_mla(self, params: Params, token: jax.Array,
+    def _paged_verify_step_mla(self, params: Params, tokens: jax.Array,
                                arena: Params, page_tables: jax.Array,
                                lengths: jax.Array,
-                               active: Optional[jax.Array] = None, *,
+                               active: Optional[jax.Array] = None,
+                               n_tokens: Optional[jax.Array] = None, *,
                                use_pallas: Optional[bool] = None,
                                interpret: bool = False
-                               ) -> tuple[jax.Array, Params, jax.Array]:
-        """``paged_decode_step`` for MLA latent arenas, in the ABSORBED
-        form (_verify_step_mla's math at K=1 over pages): the new token's
-        normed latent c and rope key kr write at (page, offset) — latents
-        have no heads axis, so a page row is (T, r)/(T, dr) — and
-        attention runs latent-space scores + the decoupled-RoPE term over
-        the page table (ops.paged_attention_mla), never materializing
-        per-head K/V. Dense-prefix models' c_pre/kr_pre sections page
-        under the SAME page ids (a page spans every layer's slice, like
-        the plain arena's layer axis). int8 LATENT arenas (``c_scale`` in
-        the arena) quantize the new row exactly like the contiguous int8
-        latent cache (_kv_quant per position) and attend through
-        ops.paged_attention_mla_quant (dequant in kernel)."""
+                               ) -> tuple[jax.Array, Params]:
+        """``paged_verify_step`` for MLA latent arenas, in the ABSORBED
+        form (_verify_step_mla's math over pages): each of the K new
+        tokens' normed latent c and rope key kr writes at its (page,
+        offset) — latents have no heads axis, so a page row is
+        (T, r)/(T, dr) — and attention runs latent-space scores + the
+        decoupled-RoPE term over the page table
+        (ops.paged_attention_multi_mla), never materializing per-head
+        K/V. Dense-prefix models' c_pre/kr_pre sections page under the
+        SAME page ids; int8 LATENT arenas (``c_scale`` present) quantize
+        each new row exactly like the contiguous int8 latent cache and
+        attend through ops.paged_attention_multi_mla_quant (dequant in
+        kernel). Same n_tokens write-mask and no-lengths-advance
+        contract as the plain sibling."""
         cfg = self.cfg
         quant = "c_scale" in arena
-        b = token.shape[0]
+        b, kk = tokens.shape
         if active is None:
             active = jnp.ones((b,), bool)
+        if n_tokens is None:
+            n_tokens = jnp.where(active, kk, 0)
+        n_tokens = n_tokens.astype(jnp.int32)
         t = arena["c"].shape[2]
-        positions = lengths                                  # (B,) write pos
-        pages_b = jnp.take_along_axis(
-            page_tables, (positions // t)[:, None], axis=1)[:, 0]
-        # inactive slots must not scatter at all (stale table rows alias
-        # live tail pages): OOB page id + mode="drop" elides the write —
-        # the same hazard the plain paged step closes
-        pages_b = jnp.where(active, pages_b, arena["c"].shape[1])
+        positions = lengths[:, None] + jnp.arange(kk)[None, :]     # (B,K)
+        pages_bk = jnp.take_along_axis(page_tables, positions // t, axis=1)
+        # rows at/beyond n_tokens must not scatter (stale table rows
+        # alias live tail pages): OOB page id + mode="drop"
+        write_ok = jnp.arange(kk)[None, :] < n_tokens[:, None]
+        pages_bk = jnp.where(write_ok, pages_bk, arena["c"].shape[1])
         offs = positions % t
         cos, sin = _rope_tables(cfg)[0]          # MLA: single global table
         hd, dr, r = cfg.head_dim_, cfg.mla_rope_dim, cfg.mla_latent_dim
         hn = cfg.n_heads
         scale = (hd + dr) ** -0.5 * yarn_mscale_sq(cfg)
-        x = _embed(params, token[:, None], cfg, self.mesh)   # (B, 1, E)
-        att_len = positions + 1
-        pos2 = positions[:, None]                            # (B, 1)
+        x = _embed(params, tokens, cfg, self.mesh)               # (B,K,E)
+        att_len = lengths + kk
 
         def make_block(cfg_):
             def block(y, inputs):
@@ -1772,40 +1848,42 @@ class LlamaModel:
                 h = rms_norm(y, _norm_w(lp["attn_norm"], cfg_),
                              cfg_.norm_eps)
                 q_nope, q_rope, c1, kr1 = _mla_project(h, lp, cfg_, cos,
-                                                       sin, pos2, b, 1)
-                c_w, kr_w = c1[:, 0], kr1[:, 0]
+                                                       sin, positions, b,
+                                                       kk)
+                c_w, kr_w = c1, kr1                 # (B,K,r) / (B,K,dr)
                 if quant:
                     # same per-position symmetric scheme as the contiguous
                     # int8 latent cache, so pages and slot caches
                     # interchange (and hand off) without requantization
-                    c_w, c_s = _kv_quant(c_w)          # (B,r) i8, (B,)
+                    c_w, c_s = _kv_quant(c_w)          # i8, (B,K)
                     kr_w, kr_s = _kv_quant(kr_w)
-                    cs = cs.at[pages_b, offs].set(c_s, mode="drop")
-                    krs = krs.at[pages_b, offs].set(kr_s, mode="drop")
-                cp = cp.at[pages_b, offs].set(c_w, mode="drop")
-                krp = krp.at[pages_b, offs].set(kr_w, mode="drop")
+                    cs = cs.at[pages_bk, offs].set(c_s, mode="drop")
+                    krs = krs.at[pages_bk, offs].set(kr_s, mode="drop")
+                cp = cp.at[pages_bk, offs].set(c_w, mode="drop")
+                krp = krp.at[pages_bk, offs].set(kr_w, mode="drop")
                 w_uk = lp["w_uk"].reshape(r, hn, hd)
                 # absorbed query: the w_uk fold happens HERE, once per
                 # step, so attention reads the (r + dr) latents directly
-                q_lat = jnp.einsum("bhd,rhd->bhr",
-                                   q_nope[:, 0].astype(jnp.float32),
+                q_lat = jnp.einsum("bkhd,rhd->bkhr",
+                                   q_nope.astype(jnp.float32),
                                    w_uk.astype(jnp.float32))
                 if quant:
-                    o_lat = paged_attention_mla_quant(
-                        q_lat, q_rope[:, 0].astype(jnp.float32), cp, krp,
+                    o_lat = paged_attention_multi_mla_quant(
+                        q_lat, q_rope.astype(jnp.float32), cp, krp,
                         cs, krs, page_tables, att_len, sm_scale=scale,
                         use_pallas=use_pallas, interpret=interpret,
                         mesh=self.mesh)
                 else:
-                    o_lat = paged_attention_mla(
-                        q_lat, q_rope[:, 0].astype(jnp.float32), cp, krp,
+                    o_lat = paged_attention_multi_mla(
+                        q_lat, q_rope.astype(jnp.float32), cp, krp,
                         page_tables, att_len, sm_scale=scale,
                         use_pallas=use_pallas, interpret=interpret,
                         mesh=self.mesh)
                 w_uv = lp["w_uv"].reshape(r, hn, hd)
-                o = jnp.einsum("bhr,rhd->bhd", o_lat.astype(jnp.float32),
+                o = jnp.einsum("bkhr,rhd->bkhd",
+                               o_lat.astype(jnp.float32),
                                w_uv.astype(jnp.float32))
-                o = o.reshape(b, 1, hn * hd).astype(cfg_.dtype)
+                o = o.reshape(b, kk, hn * hd).astype(cfg_.dtype)
                 o = _mm(o, lp["wo"], cfg_.dtype)
                 if cfg_.post_norms:
                     o = rms_norm(o, _norm_w(lp["attn_post_norm"], cfg_),
@@ -1834,7 +1912,7 @@ class LlamaModel:
         x, new_kv = jax.lax.scan(make_block(cfg), x,
                                  make_xs(params["layers"], ""))
         x = rms_norm(x, _norm_w(params["final_norm"], cfg), cfg.norm_eps)
-        logits = _head_logits(x, params, cfg).astype(jnp.float32)[:, 0]
+        logits = _head_logits(x, params, cfg).astype(jnp.float32)  # (B,K,V)
         out = {"c": new_kv["c"], "kr": new_kv["kr"]}
         if quant:
             out["c_scale"], out["kr_scale"] = new_kv["cs"], new_kv["krs"]
@@ -1843,8 +1921,40 @@ class LlamaModel:
             if quant:
                 out["c_pre_scale"] = new_pre["cs"]
                 out["kr_pre_scale"] = new_pre["krs"]
-        new_lengths = jnp.where(active, lengths + 1, lengths)
-        return logits, out, new_lengths
+        return logits, out
+
+    def paged_prefill_chunk_step(self, params: Params, tokens: jax.Array,
+                                 arena: Params, page_tables: jax.Array,
+                                 lengths: jax.Array,
+                                 true_length: jax.Array,
+                                 adapters: Optional[dict] = None,
+                                 adapter_ids: Optional[jax.Array] = None, *,
+                                 use_pallas: Optional[bool] = None,
+                                 interpret: bool = False,
+                                 shard_kv: bool = True
+                                 ) -> tuple[jax.Array, Params, jax.Array]:
+        """One CHUNK of a prompt scattered STRAIGHT INTO arena pages
+        (paged-native chunked prefill, ISSUE 14): ``tokens`` (B, S_pad)
+        is the chunk zero-padded to its compile bucket, ``true_length``
+        (B,) the real token count — TRACED, so chunk lengths never force
+        a recompile — and ``lengths`` (B,) how many tokens the run
+        already holds (prior chunks + any prefix-cache hit). The chunk's
+        K/V rows land at logical positions lengths..lengths+true_length-1
+        of the slot's page run: no dense scratch cache, no fill_pages
+        copy afterwards — the pages ARE the prefill output, ready for
+        decode, trie insertion, or streamed handoff export the moment
+        the dispatch returns. Padded rows scatter nothing (n_tokens
+        write-mask) and attend garbage nobody reads. Returns (last-real-
+        token logits (B, V), arena, lengths + true_length).
+        Token-identical to the dense prefill_chunk_step + fill_pages
+        route (pinned by tests)."""
+        b = tokens.shape[0]
+        tl = true_length.astype(jnp.int32)
+        logits, arena = self.paged_verify_step(
+            params, tokens, arena, page_tables, lengths, None, adapters,
+            adapter_ids, n_tokens=tl, use_pallas=use_pallas,
+            interpret=interpret, shard_kv=shard_kv)
+        return logits[jnp.arange(b), tl - 1], arena, lengths + tl
 
     def prefill_chunk_step(self, params: Params, tokens: jax.Array,
                            cache: Params, true_length: jax.Array,

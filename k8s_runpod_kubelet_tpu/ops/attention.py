@@ -1339,6 +1339,835 @@ def paged_attention_mla_quant(q_lat: jax.Array, q_rope: jax.Array,
                     page_table, lengths)
 
 
+# -- paged-attention MULTI-TOKEN kernels (ISSUE 14) ---------------------------
+# K query tokens per sequence against page-table-indexed KV with a CAUSAL
+# intra-block mask: query j of row b sits at absolute position
+# lengths[b] - K + j (``lengths`` INCLUDES the K tokens being attended/
+# written this call). K folds into the kernels' sublane axis — each
+# (batch, [kv head,] page) program carries all K queries' online-softmax
+# state, and the per-row query index recovers causality in-kernel — so
+# speculative verify (K = k+1 drafts) and paged-native prefill chunks
+# (K = chunk bucket) ride the SAME paged gather as single-token decode.
+# At K=1 the math reduces exactly to the single-token dispatches.
+
+def _paged_valid_multi(n_tokens: int, lengths, kq: int,
+                       window: Optional[int]):
+    """(B, K, S) mask of attendable positions for K queries whose last
+    token sits at ``lengths - 1``: query j attends positions <= lengths -
+    kq + j (causal across the block's own tokens) and — for uniform
+    sliding-window models — only the ``window`` positions ending at its
+    own. The multi-token generalization of _paged_valid (identical at
+    kq=1); one definition shared by every multi reference path."""
+    pos = jnp.arange(n_tokens)[None, None, :]
+    qpos = (lengths[:, None] - kq + jnp.arange(kq)[None, :])[:, :, None]
+    valid = pos <= qpos
+    if window is not None:
+        valid &= pos > qpos - window
+    return valid
+
+
+def _paged_attention_multi_xla(q, k_pages, v_pages, page_table, lengths, *,
+                               sm_scale: float,
+                               logit_soft_cap: Optional[float] = None,
+                               sliding_window: Optional[int] = None
+                               ) -> jax.Array:
+    """Pure-jnp reference: gather the page table back into a contiguous
+    view and run masked multi-query decode attention with the per-query
+    causal mask. Also the CPU/odd-shape fallback."""
+    b, kq, hq, d = q.shape
+    _, t, hkv, _ = k_pages.shape
+    n = page_table.shape[1]
+    group = hq // hkv
+    k = k_pages[page_table].reshape(b, n * t, hkv, d)
+    v = v_pages[page_table].reshape(b, n * t, hkv, d)
+    qg = (q.astype(jnp.float32) * sm_scale).reshape(b, kq, hkv, group, d)
+    s = jnp.einsum("bkhgd,bLhd->bkhgL", qg, k.astype(jnp.float32))
+    if logit_soft_cap is not None:
+        s = jnp.tanh(s / logit_soft_cap) * logit_soft_cap
+    valid = _paged_valid_multi(n * t, lengths, kq, sliding_window)
+    s = jnp.where(valid[:, :, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bkhgL,bLhd->bkhgd", p, v.astype(jnp.float32))
+    return o.reshape(b, kq, hq, d).astype(q.dtype)
+
+
+def _paged_fwd_multi_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                            acc_ref, m_ref, l_ref, *, page_tokens: int,
+                            num_pages: int, n_q: int, gp: int,
+                            sm_scale: float,
+                            soft_cap: Optional[float] = None,
+                            window: Optional[int] = None):
+    """One (batch row, kv head, page) program over K queries: the sublane
+    axis carries the K queries' padded GQA groups stacked query-major
+    (row = j * gp + g), so one page stream feeds every query's online
+    softmax and the CAUSAL intra-block mask is just a per-row position
+    floor recovered from the row index. ``window``: pages fully behind
+    the OLDEST query's window are skipped (their table entries may alias
+    recycled pages — never read them)."""
+    import jax.experimental.pallas as pl  # noqa: F401 (kernel-only import)
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    length = len_ref[b]
+    live = i * page_tokens < length
+    if window is not None:
+        # the oldest query (j=0, position length - n_q) still attends
+        # back to length - n_q - window + 1; reduces to the single-token
+        # skip at n_q=1
+        live &= (i + 1) * page_tokens > length - n_q - window + 1
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale      # (K*Gp, D)
+        kc = k_ref[0, :, 0].astype(jnp.float32)             # (T, D)
+        vc = v_ref[0, :, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kc, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # (K*Gp, T)
+        if soft_cap is not None:
+            s = jnp.tanh(s / soft_cap) * soft_cap
+        pos = i * page_tokens + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        # row r = j * gp + g: query index j = r // gp; query j's absolute
+        # position is length - n_q + j — the causal intra-block floor
+        qpos = length - n_q + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 0) // gp
+        keep = pos <= qpos
+        if window is not None:
+            keep &= pos > qpos - window
+        s = jnp.where(keep, s, NEG_INF)
+        m_prev = m_ref[:, :1]                               # (K*Gp, 1)
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if window is not None:
+            # a live page can still be FULLY behind an older query's
+            # window (live keys off the oldest floor, this row's floor is
+            # later): that row's stats are all NEG_INF and exp(s - m)
+            # would turn the masked row into uniform 1s — zero the masked
+            # probabilities explicitly
+            p = jnp.where(keep, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+            p, vc, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(i == num_pages - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def _paged_multi_q(q, hkv: int, group: int, gp: int):
+    """(B, K, Hq, D) -> (B, Hkv, K*gp, D): split GQA groups, pad each to a
+    full sublane tile, stack query-major so the kernel's row -> query-index
+    division is exact."""
+    b, kq, hq, d = q.shape
+    qr = q.reshape(b, kq, hkv, group, d)
+    if gp != group:
+        qr = jnp.pad(qr, ((0, 0), (0, 0), (0, 0), (0, gp - group), (0, 0)))
+    return qr.transpose(0, 2, 1, 3, 4).reshape(b, hkv, kq * gp, d)
+
+
+def _paged_multi_o(out, kq: int, hq: int, group: int, gp: int):
+    """(B, Hkv, K*gp, D) -> (B, K, Hq, D): undo _paged_multi_q, dropping
+    the padded group rows."""
+    b, hkv, _, d = out.shape
+    o = out.reshape(b, hkv, kq, gp, d)[:, :, :, :group]
+    return o.transpose(0, 2, 1, 3, 4).reshape(b, kq, hq, d)
+
+
+def _paged_attention_multi_pallas(q, k_pages, v_pages, page_table, lengths,
+                                  scale: float, interpret: bool,
+                                  soft_cap: Optional[float] = None,
+                                  window: Optional[int] = None) -> jax.Array:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, kq, hq, d = q.shape
+    _, t, hkv, _ = k_pages.shape
+    n = page_table.shape[1]
+    group = hq // hkv
+    gp = -(-group // 8) * 8
+    qr = _paged_multi_q(q, hkv, group, gp)
+    rows = kq * gp
+    kernel = functools.partial(_paged_fwd_multi_kernel, page_tokens=t,
+                               num_pages=n, n_q=kq, gp=gp, sm_scale=scale,
+                               soft_cap=soft_cap, window=window)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # page_table, lengths
+        grid=(b, hkv, n),
+        in_specs=[
+            pl.BlockSpec((1, 1, rows, d),
+                         lambda bb, h, i, pt, ln: (bb, h, 0, 0)),
+            pl.BlockSpec((1, t, 1, d),
+                         lambda bb, h, i, pt, ln: (pt[bb, i], 0, h, 0)),
+            pl.BlockSpec((1, t, 1, d),
+                         lambda bb, h, i, pt, ln: (pt[bb, i], 0, h, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, rows, d),
+                               lambda bb, h, i, pt, ln: (bb, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((rows, d), jnp.float32),
+            pltpu.VMEM((rows, _STATS_LANES), jnp.float32),
+            pltpu.VMEM((rows, _STATS_LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, rows, d), q.dtype),
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      qr, k_pages, v_pages)
+    return _paged_multi_o(out, kq, hq, group, gp)
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale", "use_pallas",
+                                             "interpret", "logit_soft_cap",
+                                             "sliding_window", "mesh",
+                                             "shard_heads"))
+def paged_attention_multi(q: jax.Array, k_pages: jax.Array,
+                          v_pages: jax.Array, page_table: jax.Array,
+                          lengths: jax.Array, *,
+                          sm_scale: Optional[float] = None,
+                          use_pallas: Optional[bool] = None,
+                          interpret: bool = False,
+                          logit_soft_cap: Optional[float] = None,
+                          sliding_window: Optional[int] = None,
+                          mesh=None, shard_heads: bool = True) -> jax.Array:
+    """``paged_attention`` over K query tokens per sequence (ISSUE 14):
+    the multi-token form that speculative verify (K = k+1 drafts) and
+    paged-native prefill chunks ride. q is (B, K, Hq, D); ``lengths``
+    counts valid tokens INCLUDING the K being attended (query j sits at
+    position lengths - K + j, and its KV row must already be written —
+    the model steps scatter the block's K/V before dispatching), so the
+    intra-block mask is causal: query j sees positions <= lengths - K + j.
+    At K=1 this is exactly ``paged_attention``. Same page-table validity
+    contract (entries at/after ceil(lengths/T) never read, must be valid
+    ids), same sliding-window page-skip semantics (relative to the OLDEST
+    query), same TP contract via ``mesh``/``shard_heads``. Returns
+    (B, K, Hq, D) in q's dtype."""
+    b, kq, hq, d = q.shape
+    _, t, hkv, _ = k_pages.shape
+    if hq % hkv != 0:
+        raise ValueError(f"Hq={hq} not a multiple of Hkv={hkv}")
+    if v_pages.shape != k_pages.shape:
+        raise ValueError(f"k_pages {k_pages.shape} != v_pages "
+                         f"{v_pages.shape}")
+    if logit_soft_cap is not None and logit_soft_cap <= 0:
+        raise ValueError(f"logit_soft_cap must be positive, "
+                         f"got {logit_soft_cap}")
+    if sliding_window is not None and sliding_window <= 0:
+        raise ValueError(f"sliding_window must be positive, "
+                         f"got {sliding_window}")
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    pallas_ok = (_use_pallas(use_pallas) or interpret) \
+        and d % 128 == 0 and t % 8 == 0
+
+    def dispatch(qs, ks, vs, pt, ln):
+        if not pallas_ok:
+            return _paged_attention_multi_xla(qs, ks, vs, pt, ln,
+                                              sm_scale=scale,
+                                              logit_soft_cap=logit_soft_cap,
+                                              sliding_window=sliding_window)
+        return _paged_attention_multi_pallas(qs, ks, vs, pt, ln, scale,
+                                             interpret, logit_soft_cap,
+                                             sliding_window)
+
+    if mesh is not None and mesh.devices.size > 1:
+        from jax.sharding import PartitionSpec as P
+        hs = _paged_head_specs(mesh, hq, hkv) if shard_heads else None
+        return _shard_paged_call(
+            mesh, dispatch,
+            (P(None, None, hs, None), P(None, None, hs, None),
+             P(None, None, hs, None), P(), P()),
+            P(None, None, hs, None),
+            q, k_pages, v_pages, page_table, lengths)
+    return dispatch(q, k_pages, v_pages, page_table, lengths)
+
+
+def _paged_attention_multi_quant_xla(q, k_pages, v_pages, k_scale, v_scale,
+                                     page_table, lengths, *, sm_scale: float,
+                                     logit_soft_cap: Optional[float] = None,
+                                     sliding_window: Optional[int] = None
+                                     ) -> jax.Array:
+    """Multi-token int8 reference: working-set gather first, dequantize
+    only that (the memory-order argument of _paged_attention_quant_xla),
+    then the per-query causal mask."""
+    b, kq, hq, d = q.shape
+    _, t, hkv, _ = k_pages.shape
+    n = page_table.shape[1]
+    group = hq // hkv
+    k = (k_pages[page_table].astype(jnp.float32)
+         * k_scale[page_table][..., None]).reshape(b, n * t, hkv, d)
+    v = (v_pages[page_table].astype(jnp.float32)
+         * v_scale[page_table][..., None]).reshape(b, n * t, hkv, d)
+    qg = (q.astype(jnp.float32) * sm_scale).reshape(b, kq, hkv, group, d)
+    s = jnp.einsum("bkhgd,bLhd->bkhgL", qg, k)
+    if logit_soft_cap is not None:
+        s = jnp.tanh(s / logit_soft_cap) * logit_soft_cap
+    valid = _paged_valid_multi(n * t, lengths, kq, sliding_window)
+    s = jnp.where(valid[:, :, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bkhgL,bLhd->bkhgd", p, v)
+    return o.reshape(b, kq, hq, d).astype(q.dtype)
+
+
+def _paged_fwd_multi_quant_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref,
+                                  ks_ref, vs_ref, o_ref, acc_ref, m_ref,
+                                  l_ref, *, page_tokens: int, num_pages: int,
+                                  n_kv: int, n_q: int, gp: int,
+                                  sm_scale: float,
+                                  soft_cap: Optional[float] = None,
+                                  window: Optional[int] = None):
+    """The multi-token kernel with int8 K/V pages dequantized in kernel —
+    the iota head-select of _paged_fwd_quant_kernel composed with the
+    per-row causal floor of _paged_fwd_multi_kernel."""
+    import jax.experimental.pallas as pl  # noqa: F401 (kernel-only import)
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    length = len_ref[b]
+    live = i * page_tokens < length
+    if window is not None:
+        live &= (i + 1) * page_tokens > length - n_q - window + 1
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale      # (K*Gp, D)
+        hsel = jax.lax.broadcasted_iota(
+            jnp.int32, (page_tokens, n_kv), 1) == h
+        k_s = jnp.sum(jnp.where(hsel, ks_ref[0], 0.0), axis=1,
+                      keepdims=True)                        # (T, 1)
+        v_s = jnp.sum(jnp.where(hsel, vs_ref[0], 0.0), axis=1,
+                      keepdims=True)
+        kc = k_ref[0, :, 0].astype(jnp.float32) * k_s       # (T, D)
+        vc = v_ref[0, :, 0].astype(jnp.float32) * v_s
+        s = jax.lax.dot_general(q, kc, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # (K*Gp, T)
+        if soft_cap is not None:
+            s = jnp.tanh(s / soft_cap) * soft_cap
+        pos = i * page_tokens + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        qpos = length - n_q + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 0) // gp
+        keep = pos <= qpos
+        if window is not None:
+            keep &= pos > qpos - window
+        s = jnp.where(keep, s, NEG_INF)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if window is not None:
+            # see _paged_fwd_multi_kernel: zero rows whose window starts
+            # past this (live-for-the-oldest-query) page
+            p = jnp.where(keep, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+            p, vc, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(i == num_pages - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def _paged_attention_multi_quant_pallas(q, k_pages, v_pages, k_scale,
+                                        v_scale, page_table, lengths,
+                                        scale: float, interpret: bool,
+                                        soft_cap: Optional[float] = None,
+                                        window: Optional[int] = None
+                                        ) -> jax.Array:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, kq, hq, d = q.shape
+    _, t, hkv, _ = k_pages.shape
+    n = page_table.shape[1]
+    group = hq // hkv
+    gp = -(-group // 8) * 8
+    qr = _paged_multi_q(q, hkv, group, gp)
+    rows = kq * gp
+    kernel = functools.partial(_paged_fwd_multi_quant_kernel, page_tokens=t,
+                               num_pages=n, n_kv=hkv, n_q=kq, gp=gp,
+                               sm_scale=scale, soft_cap=soft_cap,
+                               window=window)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # page_table, lengths
+        grid=(b, hkv, n),
+        in_specs=[
+            pl.BlockSpec((1, 1, rows, d),
+                         lambda bb, h, i, pt, ln: (bb, h, 0, 0)),
+            pl.BlockSpec((1, t, 1, d),
+                         lambda bb, h, i, pt, ln: (pt[bb, i], 0, h, 0)),
+            pl.BlockSpec((1, t, 1, d),
+                         lambda bb, h, i, pt, ln: (pt[bb, i], 0, h, 0)),
+            pl.BlockSpec((1, t, hkv),
+                         lambda bb, h, i, pt, ln: (pt[bb, i], 0, 0)),
+            pl.BlockSpec((1, t, hkv),
+                         lambda bb, h, i, pt, ln: (pt[bb, i], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, rows, d),
+                               lambda bb, h, i, pt, ln: (bb, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((rows, d), jnp.float32),
+            pltpu.VMEM((rows, _STATS_LANES), jnp.float32),
+            pltpu.VMEM((rows, _STATS_LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, rows, d), q.dtype),
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      qr, k_pages, v_pages, k_scale, v_scale)
+    return _paged_multi_o(out, kq, hq, group, gp)
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale", "use_pallas",
+                                             "interpret", "logit_soft_cap",
+                                             "sliding_window", "mesh",
+                                             "shard_heads"))
+def paged_attention_multi_quant(q: jax.Array, k_pages: jax.Array,
+                                v_pages: jax.Array, k_scale: jax.Array,
+                                v_scale: jax.Array, page_table: jax.Array,
+                                lengths: jax.Array, *,
+                                sm_scale: Optional[float] = None,
+                                use_pallas: Optional[bool] = None,
+                                interpret: bool = False,
+                                logit_soft_cap: Optional[float] = None,
+                                sliding_window: Optional[int] = None,
+                                mesh=None,
+                                shard_heads: bool = True) -> jax.Array:
+    """``paged_attention_multi`` over an int8-quantized KV arena: K query
+    tokens, int8 pages dequantized in kernel (paged_attention_quant's
+    scheme), per-query causal intra-block mask. Same shape/validity/TP
+    contracts as paged_attention_multi with paged_attention_quant's scale
+    sections."""
+    b, kq, hq, d = q.shape
+    _, t, hkv, _ = k_pages.shape
+    if hq % hkv != 0:
+        raise ValueError(f"Hq={hq} not a multiple of Hkv={hkv}")
+    if k_scale.shape != k_pages.shape[:3] \
+            or v_scale.shape != v_pages.shape[:3]:
+        raise ValueError(
+            f"scale shapes {k_scale.shape}/{v_scale.shape} must be the "
+            f"pages' (P, T, Hkv) = {k_pages.shape[:3]}")
+    if logit_soft_cap is not None and logit_soft_cap <= 0:
+        raise ValueError(f"logit_soft_cap must be positive, "
+                         f"got {logit_soft_cap}")
+    if sliding_window is not None and sliding_window <= 0:
+        raise ValueError(f"sliding_window must be positive, "
+                         f"got {sliding_window}")
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    pallas_ok = (_use_pallas(use_pallas) or interpret) \
+        and d % 128 == 0 and t % 8 == 0
+
+    def dispatch(qs, ks, vs, kss, vss, pt, ln):
+        if not pallas_ok:
+            return _paged_attention_multi_quant_xla(
+                qs, ks, vs, kss, vss, pt, ln, sm_scale=scale,
+                logit_soft_cap=logit_soft_cap,
+                sliding_window=sliding_window)
+        return _paged_attention_multi_quant_pallas(
+            qs, ks, vs, kss, vss, pt, ln, scale, interpret,
+            logit_soft_cap, sliding_window)
+
+    if mesh is not None and mesh.devices.size > 1:
+        from jax.sharding import PartitionSpec as P
+        hs = _paged_head_specs(mesh, hq, hkv) if shard_heads else None
+        return _shard_paged_call(
+            mesh, dispatch,
+            (P(None, None, hs, None), P(None, None, hs, None),
+             P(None, None, hs, None), P(None, None, hs),
+             P(None, None, hs), P(), P()),
+            P(None, None, hs, None),
+            q, k_pages, v_pages, k_scale, v_scale, page_table, lengths)
+    return dispatch(q, k_pages, v_pages, k_scale, v_scale, page_table,
+                    lengths)
+
+
+def _paged_attention_multi_mla_xla(q_lat, q_rope, c_pages, kr_pages,
+                                   page_table, lengths, *,
+                                   sm_scale: float) -> jax.Array:
+    """Multi-token MLA reference in the absorbed form, per-query causal
+    mask over gathered latent pages."""
+    b, kq, hq, r = q_lat.shape
+    _, t, _ = c_pages.shape
+    n = page_table.shape[1]
+    c = c_pages[page_table].reshape(b, n * t, r).astype(jnp.float32)
+    kr = kr_pages[page_table].reshape(b, n * t, -1).astype(jnp.float32)
+    s = (jnp.einsum("bkhr,bLr->bkhL",
+                    q_lat.astype(jnp.float32) * sm_scale, c)
+         + jnp.einsum("bkhd,bLd->bkhL",
+                      q_rope.astype(jnp.float32) * sm_scale, kr))
+    valid = _paged_valid_multi(n * t, lengths, kq, None)
+    s = jnp.where(valid[:, :, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bkhL,bLr->bkhr", p, c)
+    return o.astype(q_lat.dtype)
+
+
+def _paged_fwd_multi_mla_kernel(pt_ref, len_ref, ql_ref, qr_ref, c_ref,
+                                kr_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                                page_tokens: int, num_pages: int, n_q: int,
+                                gp: int, sm_scale: float):
+    """One (batch row, page) program over K queries' padded head blocks
+    stacked query-major on the sublane axis (row = j * gp + h): headless
+    latent pages stream once for all K queries, causality comes back from
+    the row index."""
+    import jax.experimental.pallas as pl  # noqa: F401 (kernel-only import)
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    length = len_ref[b]
+
+    @pl.when(i * page_tokens < length)
+    def _compute():
+        ql = ql_ref[0].astype(jnp.float32) * sm_scale       # (K*Gp, R)
+        qr = qr_ref[0].astype(jnp.float32) * sm_scale       # (K*Gp, Dr)
+        cc = c_ref[0].astype(jnp.float32)                   # (T, R)
+        krc = kr_ref[0].astype(jnp.float32)                 # (T, Dr)
+        s = (jax.lax.dot_general(ql, cc, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+             + jax.lax.dot_general(qr, krc, (((1,), (1,)), ((), ())),
+                                   preferred_element_type=jnp.float32))
+        pos = i * page_tokens + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        qpos = length - n_q + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 0) // gp
+        s = jnp.where(pos <= qpos, s, NEG_INF)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+            p, cc, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(i == num_pages - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def _paged_multi_mla_q(q, gp: int):
+    """(B, K, Hq, R) -> (B, K*gp, R): pad the head axis to a sublane tile,
+    stack query-major."""
+    b, kq, hq, r = q.shape
+    if gp != hq:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, gp - hq), (0, 0)))
+    return q.reshape(b, kq * gp, r)
+
+
+def _paged_attention_multi_mla_pallas(q_lat, q_rope, c_pages, kr_pages,
+                                      page_table, lengths, scale: float,
+                                      interpret: bool) -> jax.Array:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, kq, hq, r = q_lat.shape
+    # native-width latent blocks (see _paged_attention_mla_pallas)
+    _, t, _ = c_pages.shape
+    dr = kr_pages.shape[2]
+    n = page_table.shape[1]
+    gp = -(-hq // 8) * 8
+    ql = _paged_multi_mla_q(q_lat, gp)
+    qr = _paged_multi_mla_q(q_rope, gp)
+    rows = kq * gp
+    kernel = functools.partial(_paged_fwd_multi_mla_kernel, page_tokens=t,
+                               num_pages=n, n_q=kq, gp=gp, sm_scale=scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # page_table, lengths
+        grid=(b, n),
+        in_specs=[
+            pl.BlockSpec((1, rows, r), lambda bb, i, pt, ln: (bb, 0, 0)),
+            pl.BlockSpec((1, rows, dr), lambda bb, i, pt, ln: (bb, 0, 0)),
+            pl.BlockSpec((1, t, r), lambda bb, i, pt, ln: (pt[bb, i], 0, 0)),
+            pl.BlockSpec((1, t, dr), lambda bb, i, pt, ln: (pt[bb, i], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, rows, r), lambda bb, i, pt, ln: (bb, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((rows, r), jnp.float32),
+            pltpu.VMEM((rows, _STATS_LANES), jnp.float32),
+            pltpu.VMEM((rows, _STATS_LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, rows, r), q_lat.dtype),
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      ql, qr, c_pages, kr_pages)
+    return out.reshape(b, kq, gp, r)[:, :, :hq]
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale", "use_pallas",
+                                             "interpret", "mesh"))
+def paged_attention_multi_mla(q_lat: jax.Array, q_rope: jax.Array,
+                              c_pages: jax.Array, kr_pages: jax.Array,
+                              page_table: jax.Array, lengths: jax.Array, *,
+                              sm_scale: Optional[float] = None,
+                              use_pallas: Optional[bool] = None,
+                              interpret: bool = False,
+                              mesh=None) -> jax.Array:
+    """``paged_attention_mla`` over K query tokens (absorbed form): q_lat
+    (B, K, Hq, R), q_rope (B, K, Hq, Dr); ``lengths`` includes the K
+    tokens (paged_attention_multi's position convention). Returns the
+    attention-weighted latent (B, K, Hq, R). Same native-width latent
+    blocks and TP contract as paged_attention_mla."""
+    b, kq, hq, r = q_lat.shape
+    _, t, _ = c_pages.shape
+    dr = kr_pages.shape[2]
+    if q_rope.shape != (b, kq, hq, dr):
+        raise ValueError(f"q_rope {q_rope.shape} != (B, K, Hq, Dr) = "
+                         f"{(b, kq, hq, dr)}")
+    if c_pages.shape[:2] != kr_pages.shape[:2]:
+        raise ValueError(f"c_pages {c_pages.shape} / kr_pages "
+                         f"{kr_pages.shape} disagree on (P, T)")
+    scale = sm_scale if sm_scale is not None else (r + dr) ** -0.5
+    pallas_ok = (_use_pallas(use_pallas) or interpret) and t % 8 == 0
+
+    def dispatch(ql, qr, cp, krp, pt, ln):
+        if not pallas_ok:
+            return _paged_attention_multi_mla_xla(ql, qr, cp, krp, pt, ln,
+                                                  sm_scale=scale)
+        return _paged_attention_multi_mla_pallas(ql, qr, cp, krp, pt, ln,
+                                                 scale, interpret)
+
+    if mesh is not None and mesh.devices.size > 1:
+        from jax.sharding import PartitionSpec as P
+        hs = _paged_head_specs(mesh, hq, None)
+        return _shard_paged_call(
+            mesh, dispatch,
+            (P(None, None, hs, None), P(None, None, hs, None),
+             P(), P(), P(), P()),
+            P(None, None, hs, None),
+            q_lat, q_rope, c_pages, kr_pages, page_table, lengths)
+    return dispatch(q_lat, q_rope, c_pages, kr_pages, page_table, lengths)
+
+
+def _paged_attention_multi_mla_quant_xla(q_lat, q_rope, c_pages, kr_pages,
+                                         c_scale, kr_scale, page_table,
+                                         lengths, *,
+                                         sm_scale: float) -> jax.Array:
+    """Multi-token int8-latent MLA reference: working-set gather,
+    per-position dequant, per-query causal mask."""
+    b, kq, hq, r = q_lat.shape
+    _, t, _ = c_pages.shape
+    n = page_table.shape[1]
+    c = (c_pages[page_table].astype(jnp.float32)
+         * c_scale[page_table][..., None]).reshape(b, n * t, r)
+    kr = (kr_pages[page_table].astype(jnp.float32)
+          * kr_scale[page_table][..., None]).reshape(b, n * t, -1)
+    s = (jnp.einsum("bkhr,bLr->bkhL",
+                    q_lat.astype(jnp.float32) * sm_scale, c)
+         + jnp.einsum("bkhd,bLd->bkhL",
+                      q_rope.astype(jnp.float32) * sm_scale, kr))
+    valid = _paged_valid_multi(n * t, lengths, kq, None)
+    s = jnp.where(valid[:, :, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bkhL,bLr->bkhr", p, c)
+    return o.astype(q_lat.dtype)
+
+
+def _paged_fwd_multi_mla_quant_kernel(pt_ref, len_ref, ql_ref, qr_ref,
+                                      c_ref, kr_ref, cs_ref, krs_ref, o_ref,
+                                      acc_ref, m_ref, l_ref, *,
+                                      page_tokens: int, num_pages: int,
+                                      n_q: int, gp: int, sm_scale: float):
+    """Multi-token int8-latent MLA kernel: the score-space dequant of
+    _paged_fwd_mla_quant_kernel (per-position scales broadcast on lanes,
+    never transposed) composed with the per-row causal floor."""
+    import jax.experimental.pallas as pl  # noqa: F401 (kernel-only import)
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    length = len_ref[b]
+
+    @pl.when(i * page_tokens < length)
+    def _compute():
+        ql = ql_ref[0].astype(jnp.float32) * sm_scale       # (K*Gp, R)
+        qr = qr_ref[0].astype(jnp.float32) * sm_scale       # (K*Gp, Dr)
+        cc = c_ref[0].astype(jnp.float32)                   # (T, R) int8->f32
+        krc = kr_ref[0].astype(jnp.float32)                 # (T, Dr)
+        cs = cs_ref[...]                                    # (1, T) f32
+        krs = krs_ref[...]
+        s = (jax.lax.dot_general(ql, cc, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32) * cs
+             + jax.lax.dot_general(qr, krc, (((1,), (1,)), ((), ())),
+                                   preferred_element_type=jnp.float32) * krs)
+        pos = i * page_tokens + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        qpos = length - n_q + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 0) // gp
+        s = jnp.where(pos <= qpos, s, NEG_INF)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+            p * cs, cc, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(i == num_pages - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def _paged_attention_multi_mla_quant_pallas(q_lat, q_rope, c_pages,
+                                            kr_pages, c_scale, kr_scale,
+                                            page_table, lengths,
+                                            scale: float,
+                                            interpret: bool) -> jax.Array:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, kq, hq, r = q_lat.shape
+    _, t, _ = c_pages.shape
+    dr = kr_pages.shape[2]
+    n = page_table.shape[1]
+    gp = -(-hq // 8) * 8
+    ql = _paged_multi_mla_q(q_lat, gp)
+    qr = _paged_multi_mla_q(q_rope, gp)
+    rows = kq * gp
+    kernel = functools.partial(_paged_fwd_multi_mla_quant_kernel,
+                               page_tokens=t, num_pages=n, n_q=kq, gp=gp,
+                               sm_scale=scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # page_table, lengths
+        grid=(b, n),
+        in_specs=[
+            pl.BlockSpec((1, rows, r), lambda bb, i, pt, ln: (bb, 0, 0)),
+            pl.BlockSpec((1, rows, dr), lambda bb, i, pt, ln: (bb, 0, 0)),
+            pl.BlockSpec((1, t, r), lambda bb, i, pt, ln: (pt[bb, i], 0, 0)),
+            pl.BlockSpec((1, t, dr), lambda bb, i, pt, ln: (pt[bb, i], 0, 0)),
+            pl.BlockSpec((1, t), lambda bb, i, pt, ln: (pt[bb, i], 0)),
+            pl.BlockSpec((1, t), lambda bb, i, pt, ln: (pt[bb, i], 0)),
+        ],
+        out_specs=pl.BlockSpec((1, rows, r), lambda bb, i, pt, ln: (bb, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((rows, r), jnp.float32),
+            pltpu.VMEM((rows, _STATS_LANES), jnp.float32),
+            pltpu.VMEM((rows, _STATS_LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, rows, r), q_lat.dtype),
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      ql, qr, c_pages, kr_pages, c_scale, kr_scale)
+    return out.reshape(b, kq, gp, r)[:, :, :hq]
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale", "use_pallas",
+                                             "interpret", "mesh"))
+def paged_attention_multi_mla_quant(q_lat: jax.Array, q_rope: jax.Array,
+                                    c_pages: jax.Array, kr_pages: jax.Array,
+                                    c_scale: jax.Array, kr_scale: jax.Array,
+                                    page_table: jax.Array,
+                                    lengths: jax.Array, *,
+                                    sm_scale: Optional[float] = None,
+                                    use_pallas: Optional[bool] = None,
+                                    interpret: bool = False,
+                                    mesh=None) -> jax.Array:
+    """``paged_attention_multi_mla`` over an int8-quantized latent arena:
+    K query tokens, score-space in-kernel dequant
+    (paged_attention_mla_quant's scheme), per-query causal mask. Same
+    contracts as paged_attention_multi_mla with
+    paged_attention_mla_quant's scale sections."""
+    b, kq, hq, r = q_lat.shape
+    _, t, _ = c_pages.shape
+    dr = kr_pages.shape[2]
+    if q_rope.shape != (b, kq, hq, dr):
+        raise ValueError(f"q_rope {q_rope.shape} != (B, K, Hq, Dr) = "
+                         f"{(b, kq, hq, dr)}")
+    if c_pages.shape[:2] != kr_pages.shape[:2]:
+        raise ValueError(f"c_pages {c_pages.shape} / kr_pages "
+                         f"{kr_pages.shape} disagree on (P, T)")
+    if c_scale.shape != c_pages.shape[:2] \
+            or kr_scale.shape != kr_pages.shape[:2]:
+        raise ValueError(
+            f"scale shapes {c_scale.shape}/{kr_scale.shape} must be the "
+            f"pages' (P, T) = {c_pages.shape[:2]}")
+    scale = sm_scale if sm_scale is not None else (r + dr) ** -0.5
+    pallas_ok = (_use_pallas(use_pallas) or interpret) and t % 8 == 0
+
+    def dispatch(ql, qr, cp, krp, cs, krs, pt, ln):
+        if not pallas_ok:
+            return _paged_attention_multi_mla_quant_xla(
+                ql, qr, cp, krp, cs, krs, pt, ln, sm_scale=scale)
+        return _paged_attention_multi_mla_quant_pallas(
+            ql, qr, cp, krp, cs, krs, pt, ln, scale, interpret)
+
+    if mesh is not None and mesh.devices.size > 1:
+        from jax.sharding import PartitionSpec as P
+        hs = _paged_head_specs(mesh, hq, None)
+        return _shard_paged_call(
+            mesh, dispatch,
+            (P(None, None, hs, None), P(None, None, hs, None),
+             P(), P(), P(), P(), P(), P()),
+            P(None, None, hs, None),
+            q_lat, q_rope, c_pages, kr_pages, c_scale, kr_scale,
+            page_table, lengths)
+    return dispatch(q_lat, q_rope, c_pages, kr_pages, c_scale, kr_scale,
+                    page_table, lengths)
+
+
 @functools.partial(jax.jit, static_argnames=("causal", "sm_scale", "use_pallas",
                                              "block_q", "block_k", "interpret",
                                              "sliding_window",

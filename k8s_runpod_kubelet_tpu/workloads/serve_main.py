@@ -1505,6 +1505,14 @@ def main(argv=None) -> int:
                         "TPU_KV_PAGED_DECODE, auto — on whenever the "
                         "model/layout allows it; tensor-parallel engines "
                         "included — the arena shards over the mesh)")
+    p.add_argument("--paged-prefill", default=None, choices=["auto", "off"],
+                   dest="kv_paged_prefill",
+                   help="paged-native prefill: scatter prefill chunks "
+                        "straight into the slot's arena pages — no dense "
+                        "scratch cache or page copy on the hot path "
+                        "(default from config/TPU_KV_PAGED_PREFILL, auto — "
+                        "on whenever the paged decode loop runs; off keeps "
+                        "the dense-scratch + adoption-copy route)")
     p.add_argument("--kv-arena-sharding", default=None,
                    choices=["auto", "replicate"],
                    dest="kv_arena_sharding",
@@ -1594,6 +1602,9 @@ def main(argv=None) -> int:
     kv_paged_decode = (base_cfg.kv_paged_decode
                        if args.kv_paged_decode is None
                        else args.kv_paged_decode == "auto")
+    kv_paged_prefill = (base_cfg.kv_paged_prefill
+                        if args.kv_paged_prefill is None
+                        else args.kv_paged_prefill == "auto")
     kv_arena_sharding = args.kv_arena_sharding or base_cfg.kv_arena_sharding
     serving_role = args.serving_role or base_cfg.serving_role
     serving_chunk_tokens = (args.serving_chunk_tokens
@@ -1694,6 +1705,7 @@ def main(argv=None) -> int:
         kv_pool_pages=kv_pool_pages,
         prefix_cache_enabled=prefix_cache_enabled,
         paged_decode=None if kv_paged_decode else False,
+        paged_prefill=None if kv_paged_prefill else False,
         kv_arena_sharding=kv_arena_sharding,
         serving_chunk_tokens=serving_chunk_tokens,
         # text mode stops at the tokenizer's EOS instead of always burning

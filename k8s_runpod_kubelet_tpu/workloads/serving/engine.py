@@ -66,6 +66,23 @@ from .scheduler import (ITL_BUCKETS, TTFT_BUCKETS, UTIL_BUCKETS,
 log = logging.getLogger(__name__)
 
 
+class _PagedRun:
+    """A prefill that ran PAGED-NATIVE (ISSUE 14): the prompt's KV already
+    sits in arena pages this run holds references to — there is no dense
+    single-request cache. Travels the ready queue in the `single` position;
+    _bind_paged_slot transfers the page run to the slot wholesale (no
+    match_full, no alloc, no fill_pages copy). ``store`` pins which arena
+    the pages belong to: a crash-recovery rebuild discards the old store
+    wholesale, so a stale run must fail its request, never bind."""
+
+    __slots__ = ("pages", "kv_len", "store")
+
+    def __init__(self, pages: list, kv_len: int, store):
+        self.pages = pages
+        self.kv_len = kv_len
+        self.store = store
+
+
 class ServingEngine:
     def __init__(self, cfg: LlamaConfig, params: Params, sc: ServingConfig,
                  metrics: Optional[Metrics] = None, seed: int = 0,
@@ -189,8 +206,9 @@ class ServingEngine:
         self._slots = [_Slot() for _ in range(sc.slots)]
         self._ring_len = self._pick_ring_len(cfg, sc)
         # -- paged decode loop eligibility (ISSUE 9; layouts lifted by
-        # ISSUES 10/11, the mesh clause by ISSUE 12 — the matrix is now
-        # TOTAL and tensor-parallel) ---------------------------------------
+        # ISSUES 10/11, the mesh clause by ISSUE 12, adapters and
+        # speculation by ISSUE 14 — the matrix is now TOTAL,
+        # tensor-parallel, and multi-tenant) -------------------------------
         # the decode hot loop runs on per-slot page tables over the shared
         # arena (paged_decode_step) whenever the layout allows it: plain
         # dense K/V, int8-KV (dequant-in-kernel paged attention, scales
@@ -205,12 +223,15 @@ class ServingEngine:
         # head axis) and the paged step runs under shard_map with the
         # kv-head axis local to each shard — a head count the mesh
         # doesn't divide replicates the arena instead (correct, no TP
-        # memory win; see kv_arena_sharding). Still excluded: adapters
-        # and speculation (the paged kernel takes neither), prefix cache
-        # off (the arena IS the slot storage), and — under an EXPLICIT
-        # kv_pool_pages — a pool too small to hold every slot's full
-        # residency (it would reject admissions under load; auto sizing
-        # below always suffices).
+        # memory win; see kv_arena_sharding). Speculative decoding rides
+        # the multi-token paged kernels (paged_verify_step; rejection
+        # rollback drops uncommitted tail pages) and multi-LoRA threads
+        # adapter snapshots through the paged steps exactly like the
+        # contiguous ones. Still excluded: prefix cache off (the arena IS
+        # the slot storage) and — under an EXPLICIT kv_pool_pages — a
+        # pool too small to hold every slot's full residency (it would
+        # reject admissions under load; auto sizing below always
+        # suffices).
         t = sc.kv_page_tokens
         slot_pages = -(-sc.cache_len // t)  # ceil: pages one full slot needs
         uniform_window = (cfg.sliding_window is not None
@@ -218,8 +239,6 @@ class ServingEngine:
         layout_pageable = cfg.sliding_window is None or uniform_window
         eligible = (sc.prefix_cache_enabled and t < sc.cache_len
                     and layout_pageable and sc.ring_cache is not True
-                    and sc.speculate_k == 0
-                    and sc.lora_rank == 0
                     and (sc.kv_pool_pages == 0
                          or sc.kv_pool_pages >= sc.slots * slot_pages))
         if sc.paged_decode is True and not eligible:
@@ -228,7 +247,7 @@ class ServingEngine:
                 "int8-KV, MLA, MLA+int8, or a UNIFORM sliding window — the "
                 "windowed interleave's split ring/global cache cannot page, "
                 "and ring_cache=True pins the contiguous ring), "
-                "no adapters, no speculation, prefix_cache_enabled, "
+                "prefix_cache_enabled, "
                 "kv_page_tokens < cache_len, and kv_pool_pages 0 (auto) or "
                 f">= slots * ceil(cache_len / kv_page_tokens) = "
                 f"{sc.slots * slot_pages}")
@@ -250,6 +269,19 @@ class ServingEngine:
                 and not cfg.is_mla and cfg.n_kv_heads % tp != 0):
             self._arena_sharding = "replicate"
         self._paged_loop = eligible and sc.paged_decode is not False
+        # paged-native prefill (ISSUE 14): chunks scatter straight into
+        # pre-allocated arena pages (paged_prefill_chunk_step) — no dense
+        # scratch cache, no fill_pages copy on the admission path. Rides
+        # the paged loop (the pages ARE the slot storage); None = auto
+        # (on whenever the loop is), False keeps the dense-scratch route,
+        # True errors if the loop is off.
+        if sc.paged_prefill is True and not self._paged_loop:
+            raise ValueError(
+                "paged_prefill=True needs the paged decode loop (a "
+                "paged_decode-eligible layout with paged_decode not "
+                "disabled) — the prefilled pages ARE the slot storage")
+        self._paged_prefill_on = (self._paged_loop
+                                  and sc.paged_prefill is not False)
         # tensor shards the paged step spans (bench/debug surface; 0 =
         # loop off, 1 = single device)
         self._paged_tp = tp if self._paged_loop else 0
@@ -349,6 +381,13 @@ class ServingEngine:
         # chunks for the ITL-protection ratio)
         self.metrics.incr("tpu_serving_prefill_chunks", 0)
         self.metrics.incr("tpu_serving_chunk_interleaved_steps", 0)
+        # paged-native prefill + paged speculative series (ISSUE 14):
+        # dashboards read prefill_tokens against prefill_chunks for the
+        # into-arena fraction, and rollback_pages against spec_proposed
+        # for the rejection cost of paged drafting
+        self.metrics.incr("tpu_serving_paged_prefill_tokens", 0)
+        self.metrics.incr("tpu_serving_paged_speculative_steps", 0)
+        self.metrics.incr("tpu_serving_paged_speculative_rollback_pages", 0)
         self._update_page_gauges()
         # per-slot sampling state: (request seed, draws so far) -> PRNG key
         self._slot_seed = np.zeros((sc.slots,), np.uint32)
@@ -421,25 +460,46 @@ class ServingEngine:
         # the compile-once contract the TP tests assert. Logits and
         # lengths come back replicated (the engine pulls both to host
         # every step anyway).
-        if not self._paged_loop:
-            self._paged_step = None
-        elif mesh is None:
-            self._paged_step = jax.jit(self.model.paged_decode_step,
-                                       donate_argnums=donate)
-        else:
-            import functools
-            from jax.sharding import NamedSharding, PartitionSpec
-            repl = NamedSharding(mesh, PartitionSpec())
-            arena_sh = {name: a.sharding
-                        for name, a in self._kv_store.arena.items()}
-            # a replicated arena pins replicated shard_map specs in the
-            # step (sharded specs would reshard the whole arena per step)
-            self._paged_step = jax.jit(
-                functools.partial(
-                    self.model.paged_decode_step,
-                    shard_kv=self._arena_sharding != "replicate"),
-                donate_argnums=donate,
-                out_shardings=(repl, arena_sh, repl))
+        self._paged_step = None
+        self._paged_verify = None
+        self._paged_chunk = None
+        if self._paged_loop:
+            if mesh is None:
+                self._paged_step = jax.jit(self.model.paged_decode_step,
+                                           donate_argnums=donate)
+                if sc.speculate_k > 0:
+                    self._paged_verify = jax.jit(self.model.paged_verify_step,
+                                                 donate_argnums=donate)
+                if self._paged_prefill_on:
+                    self._paged_chunk = jax.jit(
+                        self.model.paged_prefill_chunk_step,
+                        donate_argnums=donate)
+            else:
+                import functools
+                from jax.sharding import NamedSharding, PartitionSpec
+                repl = NamedSharding(mesh, PartitionSpec())
+                arena_sh = {name: a.sharding
+                            for name, a in self._kv_store.arena.items()}
+                shard_kv = self._arena_sharding != "replicate"
+                # a replicated arena pins replicated shard_map specs in the
+                # step (sharded specs would reshard the whole arena per step)
+                self._paged_step = jax.jit(
+                    functools.partial(self.model.paged_decode_step,
+                                      shard_kv=shard_kv),
+                    donate_argnums=donate,
+                    out_shardings=(repl, arena_sh, repl))
+                if sc.speculate_k > 0:
+                    self._paged_verify = jax.jit(
+                        functools.partial(self.model.paged_verify_step,
+                                          shard_kv=shard_kv),
+                        donate_argnums=donate,
+                        out_shardings=(repl, arena_sh))
+                if self._paged_prefill_on:
+                    self._paged_chunk = jax.jit(
+                        functools.partial(self.model.paged_prefill_chunk_step,
+                                          shard_kv=shard_kv),
+                        donate_argnums=donate,
+                        out_shardings=(repl, arena_sh, repl))
         self.metrics.set_gauge("tpu_serving_paged_decode",
                                1 if self._paged_loop else 0)
         # TP paged serving (ISSUE 12): dashboards join this to the decode
@@ -449,14 +509,18 @@ class ServingEngine:
         # 0 = loop off
         self.metrics.set_gauge("tpu_serving_paged_tp_shards",
                                self._paged_tp)
+        # the contiguous loop's verify jit; the paged loop verifies
+        # through _paged_verify instead (same speculative bookkeeping,
+        # page tables for KV)
         self._verify = (jax.jit(self.model.verify_step, donate_argnums=donate)
-                        if sc.speculate_k > 0 else None)
+                        if sc.speculate_k > 0 and not self._paged_loop
+                        else None)
         # the prefill thread's per-chunk step (prefill_chunk_step: verify
         # kernel + traced index advance) is NOT donated: a prefix-cache
         # hit starts chunked appends from a gathered/stored cache, which
         # must survive for future hits
         self._chunk_step = jax.jit(self.model.prefill_chunk_step)
-        if self._verify is not None:
+        if sc.speculate_k > 0:
             # zero-seed so acceptance-rate dashboards see the series from
             # pod start, not first acceptance
             self.metrics.incr("tpu_serving_spec_proposed", 0)
@@ -562,6 +626,17 @@ class ServingEngine:
                    "speculative draft tokens proposed")
         m.describe("tpu_serving_spec_accepted",
                    "speculative draft tokens accepted (committed for free)")
+        m.describe("tpu_serving_paged_prefill_tokens",
+                   "prompt tokens prefilled STRAIGHT INTO arena pages "
+                   "(paged-native chunks — no dense scratch cache, no "
+                   "fill_pages copy on the admission path)")
+        m.describe("tpu_serving_paged_speculative_steps",
+                   "speculative verify steps run on the paged loop "
+                   "(multi-token paged kernels over per-slot page tables)")
+        m.describe("tpu_serving_paged_speculative_rollback_pages",
+                   "tail pages dropped by speculative rejection rollback "
+                   "on the paged loop (uncommitted drafts' pages returned "
+                   "to the pool)")
         m.describe("tpu_serving_request_latency_seconds",
                    "submit -> completion, whole request")
         m.describe("tpu_serving_ttft_seconds",
@@ -627,7 +702,22 @@ class ServingEngine:
         if not windowed:
             raise ValueError("ring_cache=True needs a model with a "
                              "sliding window")
-        slack = max(sc.max_prefill_len, sc.speculate_k + 1)
+        # effective max in-flight tokens of ONE cache-writing call: with
+        # chunked prefill on, every call (head included) writes one chunk
+        # padded to its pow2 compile bucket (capped at max_prefill_len) —
+        # and a serving_chunk_tokens ABOVE max_prefill_len writes the raw
+        # chunk, which the bucket cap cannot shrink (the old
+        # max(max_prefill_len, ...) slack UNDER-reserved there, letting a
+        # big chunk ring-overwrite live in-window entries). Without
+        # chunking the head is a full max_prefill_len bucket.
+        if sc.serving_chunk_tokens:
+            b = 16
+            while b < sc.serving_chunk_tokens:
+                b *= 2
+            eff = max(sc.serving_chunk_tokens, min(b, sc.max_prefill_len))
+        else:
+            eff = sc.max_prefill_len
+        slack = max(eff, sc.speculate_k + 1)
         ring = -(-(cfg.sliding_window + slack) // 128) * 128
         if sc.ring_cache is None and ring >= sc.cache_len:
             return None  # no memory win — stay linear
@@ -998,6 +1088,7 @@ class ServingEngine:
             "kv_cache_tokens": kv_tokens,
             "cache_len": self.sc.cache_len,
             "paged_decode": self._paged_loop,
+            "paged_prefill": self._paged_prefill_on,
             "paged_tp_shards": self._paged_tp,
             "kv_arena_sharding": self._arena_sharding,
             "prefixes": prefixes,
@@ -1235,30 +1326,142 @@ class ServingEngine:
         self.metrics.set_gauge("tpu_serving_kv_pages_shared",
                                stats["pages_shared"])
 
-    def _prefill_tokens(self, tokens: list[int], adapter_id: int = 0
-                        ) -> tuple[Any, Params, int]:
-        """Full prompt -> (last_logits, single-request cache, tokens served
-        from the prefix cache). The head goes through the prefill jit
-        (bucketed to a few fixed lengths so it compiles once per bucket,
-        not per prompt length); a prompt longer than max_prefill_len
-        continues CHUNKED through the verify kernel.
+    def _prefill_tokens(self, tokens: list[int], adapter_id: int = 0,
+                        single_only: bool = False
+                        ) -> tuple[Any, Any, int]:
+        """Full prompt -> (last_logits, single-request cache OR _PagedRun,
+        tokens served from the prefix cache). The head goes through the
+        prefill jit (bucketed to a few fixed lengths so it compiles once
+        per bucket, not per prompt length); a prompt longer than
+        max_prefill_len continues CHUNKED through the verify kernel.
 
-        Paged engines (the default): the prompt's full pages are matched
-        against the radix trie — matched KV GATHERS from the shared arena
-        (no recompute; at least the final token always recomputes for its
-        logits) and the suffix appends through the verify kernel; then the
-        prompt's own full pages are inserted back so the NEXT request
-        sharing this prefix skips it, registered or not. Ring/mixed
-        layouts (and prefix_cache_enabled=False) fall back to the dense
-        registered-prefix store with per-adapter variants."""
+        Paged engines (the default): with paged-native prefill on the
+        chunks scatter STRAIGHT into pre-allocated arena pages
+        (_prefill_paged_native — no dense scratch cache exists) and a
+        _PagedRun rides the ready queue instead of a cache; otherwise the
+        prompt's full pages are matched against the radix trie — matched
+        KV GATHERS from the shared arena (no recompute; at least the
+        final token always recomputes for its logits) and the suffix
+        appends through the verify kernel; then the prompt's own full
+        pages are inserted back so the NEXT request sharing this prefix
+        skips it, registered or not. Ring/mixed layouts (and
+        prefix_cache_enabled=False) fall back to the dense
+        registered-prefix store with per-adapter variants.
+
+        ``single_only`` forces the dense-scratch route (fanout groups:
+        every member needs its own slot binding, but one _PagedRun's
+        partial tail page can belong to exactly one slot)."""
         adapters = self._adapters  # one snapshot per request: a concurrent
         # re-registration must not mix weights between head and chunks
         if self._kv_store is not None:
-            return self._prefill_paged(tokens, adapter_id, adapters)
+            return self._prefill_paged(tokens, adapter_id, adapters,
+                                       single_only=single_only)
         return self._prefill_dense(tokens, adapter_id, adapters)
 
+    def _prefill_paged_native(self, tokens: list[int], adapter_id: int,
+                              adapters, on_chunk=None
+                              ) -> Optional[tuple[Any, _PagedRun, int]]:
+        """Prefill straight into the arena (ISSUE 14): allocate the
+        prompt's whole page run up front, then scatter each chunk's K/V
+        rows into those pages through paged_prefill_chunk_step — the
+        dense scratch cache and the fill_pages copy never exist on this
+        path. A prefix hit's matched pages join the run IN PLACE (no
+        gather, no recompute — the chunk step attends them through the
+        page table), and the finished run's full pages enter the trie by
+        REFERENCE (insert_ready — zero-copy admission). Returns None when
+        the pool can't hold the run (caller falls back to the
+        dense-scratch route, which degrades per chunk instead).
+
+        ``on_chunk(pages, done)`` fires after every chunk with the run's
+        page list and the cumulative committed token count — the
+        streamed-handoff hook (the pages the chunk JUST wrote are what
+        the stream exports)."""
+        from .kv_manager import PoolExhausted
+        store = self._kv_store
+        t = self.sc.kv_page_tokens
+        n = len(tokens)
+        n_pages = -(-n // t)
+        with self._prefix_lock:
+            m = store.match(adapter_id, tokens)
+            try:
+                tail = (store.alloc_run(n_pages - len(m.pages))
+                        if n_pages > len(m.pages) else [])
+            except PoolExhausted:
+                store.release(m.pages)
+                return None
+        covered = m.matched_tokens
+        pages = list(m.pages) + tail
+        if covered:
+            self.metrics.incr("tpu_serving_prefix_cache_hits")
+            if self._covers_registered(tokens):
+                self.metrics.incr("tpu_serving_prefix_hits")
+        else:
+            self.metrics.incr("tpu_serving_prefix_cache_misses")
+            if adapter_id != 0 and self._covers_registered(tokens):
+                self.metrics.incr("tpu_serving_prefix_adapter_fills")
+        # fixed-width table row (the slot-table shape): the chunk step
+        # compiles once per chunk bucket, not per run length; entries past
+        # the run stay 0 — a VALID page index the kernels may read but
+        # the causal mask never lets contribute
+        row = np.zeros((1, self._slot_pages_max), np.int32)
+        row[0, :len(pages)] = pages
+        table = jnp.asarray(row)
+        ad_ids = self._single_ad_ids(adapter_id)
+        step = self._chunk_tokens or self.sc.max_prefill_len
+        lengths = jnp.asarray([covered], jnp.int32)
+        rest = tokens[covered:]
+        last_logits = None
+        done = covered
+        try:
+            for start in range(0, len(rest), step):
+                chunk = rest[start:start + step]
+                ctoks, true_len = self._padded(chunk)
+                # the dispatch donates the arena, so it rides _prefix_lock
+                # like every arena-touching dispatch (lock covers dispatch
+                # only — the wait happens outside)
+                with self._prefix_lock:
+                    last_logits, arena, lengths = self._paged_chunk(
+                        self.params, ctoks, store.arena, table, lengths,
+                        true_len, adapters, ad_ids)
+                    store.arena = arena
+                done += len(chunk)
+                self.metrics.incr("tpu_serving_paged_prefill_tokens",
+                                  len(chunk))
+                if on_chunk is not None:
+                    on_chunk(pages, done)
+                if self._chunk_tokens:
+                    self.metrics.incr("tpu_serving_prefill_chunks")
+                    if start + step < len(rest):
+                        ran = self._arbiter.yield_for_decode(
+                            lambda: self.active_slots > 0)
+                        if ran:
+                            self.metrics.incr(
+                                "tpu_serving_chunk_interleaved_steps", ran)
+            # cache admission BY REFERENCE: the run's full pages join the
+            # trie with no copy (the partial tail page stays private).
+            # Best-effort like the dense insert.
+            try:
+                with self._prefix_lock:
+                    store.insert_ready(adapter_id, tokens, pages)
+            except Exception:  # noqa: BLE001 — caching is best-effort
+                log.exception("prefix-cache insert_ready failed; "
+                              "serving uncached")
+        except Exception:
+            with self._prefix_lock:
+                store.release(pages)
+            raise
+        self._update_page_gauges()
+        return last_logits, _PagedRun(pages, n, store), covered
+
     def _prefill_paged(self, tokens: list[int], adapter_id: int,
-                       adapters) -> tuple[Any, Params, int]:
+                       adapters, single_only: bool = False
+                       ) -> tuple[Any, Any, int]:
+        if self._paged_prefill_on and not single_only:
+            out = self._prefill_paged_native(tokens, adapter_id, adapters)
+            if out is not None:
+                return out
+            # pool too full for an up-front run: the dense-scratch route
+            # below still works page-by-page (and may evict as it goes)
         store = self._kv_store
         single = None
         with self._prefix_lock:
@@ -1425,16 +1628,30 @@ class ServingEngine:
         logits, single, _ = self._prefill_tokens(tokens)
         with self._prefix_lock:
             if tokens in self._registered:
+                if isinstance(single, _PagedRun):
+                    single.store.release(single.pages)
                 return  # raced with an identical registration
             if len(self._registered) >= self.sc.max_prefixes:
                 # re-check: a concurrent registration may have filled the
                 # registry while we prefilled outside the lock
+                if isinstance(single, _PagedRun):
+                    single.store.release(single.pages)
                 raise ValueError(
                     f"prefix registry full ({self.sc.max_prefixes}); each "
                     "entry pins KV in HBM — raise max_prefixes or restart "
                     "to clear")
             self._registered.append(tokens)
-            if self._kv_store is not None:
+            if isinstance(single, _PagedRun):
+                # paged-native prefill: the prefix's pages already sit in
+                # the arena (insert_ready adopted them unpinned) — this
+                # second walk PINS them, then the run's own references
+                # drop (the trie's pinned refs keep the KV)
+                evicted = 0
+                if single.store is self._kv_store:
+                    self._kv_store.insert_ready(0, tokens, single.pages,
+                                                pin=True)
+                single.store.release(single.pages)
+            elif self._kv_store is not None:
                 _, evicted = self._kv_store.insert(0, tokens, single,
                                                    pin=True)
             else:
@@ -1479,6 +1696,12 @@ class ServingEngine:
             self.handoff_inflight += 1
         try:
             _, _single, matched = self._prefill_tokens(tokens)
+            if isinstance(_single, _PagedRun):
+                # native paged prefill returns the run's own references;
+                # the trie already holds its copies (insert_ready), so the
+                # match_full below still finds the pages after we let go
+                with self._prefix_lock:
+                    _single.store.release(_single.pages)
             # ONE store reference for match -> export -> release: crash
             # recovery may rebind self._kv_store between these steps, and
             # releasing old-store page ids against the rebuilt pool would
@@ -1586,6 +1809,11 @@ class ServingEngine:
             self.handoff_inflight += 1
         try:
             _, _single, matched = self._prefill_tokens(tokens)
+            if isinstance(_single, _PagedRun):
+                # drop the run's own references; the trie's insert_ready
+                # copies keep the pages alive for the match_full below
+                with self._prefix_lock:
+                    _single.store.release(_single.pages)
             # ONE store reference across match -> export -> release, like
             # export_handoff (crash recovery may rebind _kv_store)
             with self._prefix_lock:
@@ -1839,49 +2067,90 @@ class ServingEngine:
                 state["stopped"] = True
 
         matched0 = 0
+        run = None
         try:
             adapters = self._adapters  # one snapshot, like _prefill_tokens
-            with self._prefix_lock:
-                store = self._kv_store
-                m = store.match(0, tokens)
-                single = None
-                if m.pages:
+            if self._paged_prefill_on:
+                # paged-NATIVE export (ISSUE 14): chunks scatter straight
+                # into arena pages and the stream exports the pages each
+                # chunk JUST wrote — no dense scratch cache, no gather,
+                # no fill_pages between compute and wire.
+                with self._prefix_lock:
+                    m0 = self._kv_store.match(0, tokens)
+                    self._kv_store.release(m0.pages)
+                flush(m0.matched_tokens)  # cached pages move pre-compute
+
+                def on_chunk_native(pages, done):
+                    # cache admission BY REFERENCE per chunk
+                    # (insert_ready): the chunk's completed full pages
+                    # enter the trie with no copy, then stream out.
+                    # Best-effort like the dense insert — a failure
+                    # closes the stream short, never fails the prefill.
                     try:
-                        single = store.gather(m.pages, self._fresh_cache(1))
-                    finally:
-                        store.release(m.pages)
-            covered = m.matched_tokens if single is not None else 0
-            matched0 = covered
-            if single is not None:
-                self.metrics.incr("tpu_serving_prefix_cache_hits")
-            else:
-                self.metrics.incr("tpu_serving_prefix_cache_misses")
-            flush(covered)  # already-cached pages move before any compute
+                        with self._prefix_lock:
+                            self._kv_store.insert_ready(0, tokens[:done],
+                                                        pages)
+                    except Exception:  # noqa: BLE001 — best-effort
+                        log.exception("chunk insert_ready failed; handoff "
+                                      "stream closes short")
+                    flush(done)
 
-            def on_chunk(sgl, done):
-                # cache admission per chunk: the chunk's completed full
-                # pages land in the arena as a page run, then stream out.
-                # Best-effort like the monolithic insert — a failure
-                # closes the stream short, never fails the prefill.
-                try:
-                    with self._prefix_lock:
-                        _, evicted = self._kv_store.insert(
-                            0, tokens[:done], sgl)
-                    if evicted:
-                        self.metrics.incr(
-                            "tpu_serving_prefix_cache_evictions", evicted)
-                except Exception:  # noqa: BLE001 — caching is best-effort
-                    log.exception("chunk insert failed; handoff stream "
-                                  "closes short")
-                flush(done)
+                out = self._prefill_paged_native(
+                    tokens, 0, adapters, on_chunk=on_chunk_native)
+                if out is not None:
+                    _, run, matched0 = out
+            if run is None:
+                # dense-scratch route: paged_prefill off, or the pool
+                # couldn't hold the whole run up front
+                with self._prefix_lock:
+                    store = self._kv_store
+                    m = store.match(0, tokens)
+                    single = None
+                    if m.pages:
+                        try:
+                            single = store.gather(m.pages,
+                                                  self._fresh_cache(1))
+                        finally:
+                            store.release(m.pages)
+                covered = m.matched_tokens if single is not None else 0
+                matched0 = covered
+                if single is not None:
+                    self.metrics.incr("tpu_serving_prefix_cache_hits")
+                else:
+                    self.metrics.incr("tpu_serving_prefix_cache_misses")
+                flush(covered)  # already-cached pages move before compute
 
-            if single is None:
-                self._prefill_raw(tokens, 0, adapters, on_chunk=on_chunk)
-            else:
-                self._append_chunks(single, tokens[covered:], None, 0,
-                                    adapters, on_chunk=on_chunk,
-                                    done=covered)
+                def on_chunk(sgl, done):
+                    # cache admission per chunk: the chunk's completed full
+                    # pages land in the arena as a page run, then stream out.
+                    # Best-effort like the monolithic insert — a failure
+                    # closes the stream short, never fails the prefill.
+                    try:
+                        with self._prefix_lock:
+                            _, evicted = self._kv_store.insert(
+                                0, tokens[:done], sgl)
+                        if evicted:
+                            self.metrics.incr(
+                                "tpu_serving_prefix_cache_evictions", evicted)
+                    except Exception:  # noqa: BLE001 — caching is best-effort
+                        log.exception("chunk insert failed; handoff stream "
+                                      "closes short")
+                    flush(done)
+
+                if single is None:
+                    self._prefill_raw(tokens, 0, adapters, on_chunk=on_chunk)
+                else:
+                    self._append_chunks(single, tokens[covered:], None, 0,
+                                        adapters, on_chunk=on_chunk,
+                                        done=covered)
             flush(len(tokens))
+            if run is not None:
+                # the export holds no decode slot: once the final flush has
+                # moved everything, the run's own references drop — the
+                # trie's refs (insert_ready) keep the pages cached
+                with self._prefix_lock:
+                    run.store.release(run.pages)
+                run = None
             if state["sent"] == 0:
                 raise HandoffError("no pages survived to hand off (the "
                                    "pool evicted the stream as it was "
@@ -1891,6 +2160,10 @@ class ServingEngine:
                   "sections": {}, "total_tokens": state["sent"] * t})
             state["seq"] += 1
         except Exception:
+            if run is not None:
+                # a failed export must not strand the run's references
+                with self._prefix_lock:
+                    run.store.release(run.pages)
             self.metrics.incr("tpu_serving_kv_handoff_failures")
             raise
         finally:
@@ -2012,9 +2285,13 @@ class ServingEngine:
             r.dequeued_at = dequeued
             self.metrics.observe("tpu_serving_queue_wait_seconds",
                                  dequeued - r.submitted_at)
+        single = None
         try:
+            # fanout groups need one bindable cache PER member — a paged
+            # run's pages can only ever belong to one slot, so groups ride
+            # the dense-scratch route
             last_logits, single, matched = self._prefill_tokens(
-                req.prompt, req.adapter_id)
+                req.prompt, req.adapter_id, single_only=len(live) > 1)
             prefill_done = self._perf()
             for r in live:
                 r.prefill_done_at = prefill_done
@@ -2050,6 +2327,11 @@ class ServingEngine:
         except Exception as exc:  # noqa: BLE001 — poisoned prompt only
             log.exception("prefill of %s failed", req.rid)
             self.metrics.incr("tpu_serving_prefill_errors")
+            if isinstance(single, _PagedRun):
+                # the run completed but first-token sampling failed: its
+                # page references must not outlive the request
+                with self._prefix_lock:
+                    single.store.release(single.pages)
             for r in live:
                 _fail_future(r.future, exc)
             return
@@ -2093,9 +2375,15 @@ class ServingEngine:
         return admitted
 
     def _bind_paged_slot(self, slot_id: int, slot: _Slot,
-                         req: Request, single: Params) -> bool:
-        """Build the slot's page-table row (paged decode loop): reference
-        the prompt's cached full pages ZERO-COPY (the prefill thread's
+                         req: Request, single) -> bool:
+        """Build the slot's page-table row (paged decode loop). A
+        _PagedRun (paged-native prefill) transfers WHOLESALE: the run's
+        pages — references and all — become the slot's, no trie match,
+        no allocation, no fill_pages copy (this is the admission half of
+        the hot path the dense scratch cache vanished from). A dense
+        single cache (fanout members, the pool-exhausted fallback,
+        paged_prefill=False) takes the classic route: reference the
+        prompt's cached full pages ZERO-COPY (the prefill thread's
         insert already wrote them; shared pages are read-only — decode
         writes only ever land in the slot's private tail), allocate
         private pages for whatever the trie doesn't hold, and fill those
@@ -2103,6 +2391,22 @@ class ServingEngine:
         slot stays free) when the pool can't supply the tail pages."""
         from .kv_manager import PoolExhausted
         store = self._kv_store
+        if isinstance(single, _PagedRun):
+            if single.store is not store:
+                # the engine recovered mid-flight: the run's pages died
+                # with the discarded arena — there is no KV to bind
+                _fail_future(req.future, RuntimeError(
+                    f"engine recovered while {req.rid} was in flight; "
+                    "its prefilled pages were discarded — retry"))
+                self.metrics.incr("tpu_serving_admission_rejected")
+                return False
+            slot.pages = list(single.pages)
+            slot.kv_len = single.kv_len
+            slot.table_len = len(single.pages)
+            row = self._page_tables_np[slot_id]
+            row[:] = 0
+            row[:len(slot.pages)] = slot.pages
+            return True
         t = self.sc.kv_page_tokens
         n_prompt = len(req.prompt)
         with self._prefix_lock:
@@ -2375,6 +2679,60 @@ class ServingEngine:
             else jnp.asarray(self._slot_adapter.copy()))
         self._commit_decode(logits)
 
+    def _grow_slot_table(self, slot_id: int, slot: _Slot, need: int) -> bool:
+        """Extend the slot's page table to cover positions
+        [0, kv_len + need) before a step writes them: a slot whose next
+        write positions cross into fresh pages gets PRIVATE pages —
+        shared prefix pages are never written (allocate-on-write COW
+        discipline). Sliding-window slots RECYCLE instead of allocating
+        once the table is _win_pages deep: entry j - _win_pages'
+        positions are entirely behind the window by the time entry j is
+        written (the paged kernels skip out-of-window entries, so the
+        aliased table rows are never read), making a slot's steady-state
+        residency O(window) pages — the ring cache's memory win, paged.
+        Returns False when the pool is exhausted: THIS request fails and
+        the engine (and every other slot) keeps serving — prefix caching
+        degrades, decode capacity does not crash."""
+        from .kv_manager import PoolExhausted
+        store = self._kv_store
+        t = self.sc.kv_page_tokens
+        row = self._page_tables_np[slot_id]
+        while slot.table_len * t < slot.kv_len + need:
+            j = slot.table_len
+            with self._prefix_lock:
+                try:
+                    if self._window is not None and j >= self._win_pages:
+                        old = int(row[j - self._win_pages])
+                        if store.pool.refcount(old) == 1:
+                            # only this slot holds it: reuse in place
+                            page = old
+                        else:
+                            # shared with the trie (or an in-flight
+                            # match): allocate-on-write — the slot
+                            # swaps its reference for a private page,
+                            # the shared copy stays cached
+                            page = store.alloc_run(1)[0]
+                            store.pool.unref(old)
+                            slot.pages.remove(old)
+                            slot.pages.append(page)
+                    else:
+                        page = store.alloc_run(1)[0]
+                        slot.pages.append(page)
+                except PoolExhausted as exc:
+                    store.release(slot.pages)
+                    slot.pages = []
+                    slot.kv_len = 0
+                    slot.table_len = 0
+                    self._page_tables_np[slot_id][:] = 0
+                    req, slot.request = slot.request, None
+                    _fail_future(req.future, RuntimeError(
+                        f"KV pool exhausted mid-decode for {req.rid}: "
+                        f"{exc}"))
+                    return False
+            row[j] = page
+            slot.table_len = j + 1
+        return True
+
     def _decode_once_paged(self):
         """One decode step on per-slot page tables over the shared arena
         (paged_decode_step): matched prefix pages and adopted handoff
@@ -2382,60 +2740,20 @@ class ServingEngine:
         anywhere. The step's dispatch rides _prefix_lock because it
         DONATES the arena; the lock covers dispatch only (async), never
         the device wait, so prefill-thread arena ops interleave at
-        dispatch granularity."""
-        from .kv_manager import PoolExhausted
+        dispatch granularity. Speculative engines verify k+1 drafts
+        through the multi-token kernels first
+        (_decode_once_speculative_paged); windowed slots skip that (page
+        recycling aliases table entries, which rollback can't untangle)
+        and decode one token at a time — still token-identical, just
+        without the free drafts."""
+        if (self._paged_verify is not None and self._window is None
+                and self._decode_once_speculative_paged()):
+            return
         store = self._kv_store
-        t = self.sc.kv_page_tokens
-        # tail-page allocation: a slot whose next write position starts a
-        # fresh page gets a PRIVATE page before the step — shared prefix
-        # pages are never written (allocate-on-write COW discipline).
-        # Sliding-window slots RECYCLE instead of allocating once the
-        # table is _win_pages deep: entry j - _win_pages' positions are
-        # entirely behind the window by the time entry j is written (the
-        # paged kernels skip out-of-window entries, so the aliased table
-        # rows are never read), making a slot's steady-state residency
-        # O(window) pages — the ring cache's memory win, paged.
         for slot_id, slot in enumerate(self._slots):
             if slot.request is None:
                 continue
-            if slot.kv_len % t == 0 and slot.table_len * t <= slot.kv_len:
-                j = slot.table_len
-                row = self._page_tables_np[slot_id]
-                with self._prefix_lock:
-                    try:
-                        if self._window is not None and j >= self._win_pages:
-                            old = int(row[j - self._win_pages])
-                            if store.pool.refcount(old) == 1:
-                                # only this slot holds it: reuse in place
-                                page = old
-                            else:
-                                # shared with the trie (or an in-flight
-                                # match): allocate-on-write — the slot
-                                # swaps its reference for a private page,
-                                # the shared copy stays cached
-                                page = store.alloc_run(1)[0]
-                                store.pool.unref(old)
-                                slot.pages.remove(old)
-                                slot.pages.append(page)
-                        else:
-                            page = store.alloc_run(1)[0]
-                            slot.pages.append(page)
-                    except PoolExhausted as exc:
-                        # fail THIS request; the engine (and every other
-                        # slot) keeps serving — prefix caching degrades,
-                        # decode capacity does not crash
-                        store.release(slot.pages)
-                        slot.pages = []
-                        slot.kv_len = 0
-                        slot.table_len = 0
-                        self._page_tables_np[slot_id][:] = 0
-                        req, slot.request = slot.request, None
-                        _fail_future(req.future, RuntimeError(
-                            f"KV pool exhausted mid-decode for {req.rid}: "
-                            f"{exc}"))
-                        continue
-                row[j] = page
-                slot.table_len = j + 1
+            self._grow_slot_table(slot_id, slot, 1)
         active = [s.request is not None for s in self._slots]
         if not any(active):
             self.metrics.set_gauge("tpu_serving_active_slots", 0)
@@ -2445,9 +2763,166 @@ class ServingEngine:
         with self._prefix_lock:
             logits, arena, _ = self._paged_step(
                 self.params, self._tokens, store.arena, page_tables,
-                lengths, jnp.asarray(active))
+                lengths, jnp.asarray(active), self._adapters,
+                None if self._adapters is None
+                else jnp.asarray(self._slot_adapter.copy()))
             store.arena = arena
         self._commit_decode(logits)
+
+    def _decode_once_speculative_paged(self) -> bool:
+        """Speculative verification on the paged loop (ISSUE 14): one
+        multi-token pass over [last_token, draft...] through per-slot
+        page tables (paged_verify_step). Greedy slots commit the matched
+        prefix plus one corrected token; sampled slots ride along with
+        n_tokens = 1 — their KV write and their logits[:, 0] are exactly
+        the plain step's. Rejection rollback is page-native: the
+        committed length simply stops where the first mismatch landed
+        and the table entries past it DROP back to the pool — the
+        append-only pages need none of the ring-invariant contortions
+        the contiguous speculative path carries. Returns False
+        (deferring to the plain paged step) when no active slot is
+        greedy — a (k+1)-wide verify would then be pure overhead."""
+        k = self.sc.speculate_k
+        slots = self._slots
+        b = len(slots)
+        t = self.sc.kv_page_tokens
+        store = self._kv_store
+        active = [s.request is not None for s in slots]
+
+        def greedy(i: int) -> bool:
+            return (active[i] and slots[i].request is not None
+                    and slots[i].request.temperature <= 0.0
+                    and not _logit_modded(slots[i].request))
+
+        if not any(greedy(i) for i in range(b)):
+            return False
+        # table growth BEFORE the step: a greedy slot may write k+1 rows
+        # this pass, a sampled slot exactly 1
+        for i, slot in enumerate(slots):
+            if not active[i]:
+                continue
+            self._grow_slot_table(i, slot, k + 1 if greedy(i) else 1)
+        active = [s.request is not None for s in slots]  # growth may fail
+        if not any(active):
+            self.metrics.set_gauge("tpu_serving_active_slots", 0)
+            return True
+        toks_in = np.zeros((b, k + 1), np.int32)
+        n_tokens = np.zeros((b,), np.int32)
+        n_greedy = 0
+        for i, slot in enumerate(slots):
+            if not active[i]:
+                continue
+            toks_in[i, 0] = slot.last_token
+            if greedy(i):
+                toks_in[i, 1:] = self._propose(slot, k)
+                n_tokens[i] = k + 1
+                n_greedy += 1
+            else:
+                toks_in[i, 1:] = slot.last_token  # placeholder, never checked
+                n_tokens[i] = 1
+        lengths = jnp.asarray([s.kv_len for s in slots], jnp.int32)
+        page_tables = jnp.asarray(self._page_tables_np)
+        with self._prefix_lock:
+            logits, arena = self._paged_verify(
+                self.params, jnp.asarray(toks_in), store.arena,
+                page_tables, lengths, jnp.asarray(active), self._adapters,
+                None if self._adapters is None
+                else jnp.asarray(self._slot_adapter.copy()),
+                jnp.asarray(n_tokens))
+            store.arena = arena
+        greedy_np = np.asarray(jnp.argmax(logits, axis=-1))   # (B, K+1)
+        reqs = [s.request for s in slots]
+        temps = [r.temperature if r else 0.0 for r in reqs]
+        # paged_verify_step logits are f32 by contract, so these lp
+        # reductions are full-precision; gate each on the slot kind that
+        # actually reads it
+        greedy_lp = None
+        if any(r is not None and r.logprobs and r.temperature <= 0.0
+               and not _logit_modded(r) for r in reqs):
+            greedy_lp = np.asarray(jnp.max(logits, axis=-1)
+                                   - jax.nn.logsumexp(logits, axis=-1))
+        sampled_np = sampled_lp = None
+        if any(tm > 0.0 for tm in temps) or any(_logit_modded(r)
+                                                for r in reqs):
+            l0 = self._maybe_penalize(logits[:, 0], reqs)
+            sampled_np = np.asarray(self._sample_batch(
+                l0, temps,
+                [r.top_k if r else 0 for r in reqs],
+                [r.top_p if r else 1.0 for r in reqs]))
+            if any(r is not None and r.logprobs
+                   and (r.temperature > 0.0 or _logit_modded(r))
+                   for r in reqs):
+                logp0 = jax.nn.log_softmax(l0.astype(jnp.float32), axis=-1)
+                sampled_lp = np.asarray(jnp.take_along_axis(
+                    logp0, jnp.asarray(sampled_np)[:, None], axis=-1)[:, 0])
+            self._bump_penalty_counts(reqs, sampled_np)
+        self.metrics.incr("tpu_serving_spec_proposed", k * n_greedy)
+
+        step_now = self._perf()
+        rolled_back = 0
+        for i, slot in enumerate(slots):
+            if not active[i]:
+                continue
+            greedy_slot = greedy(i)
+            if greedy_slot:
+                committed = []
+                for j in range(k + 1):
+                    g = int(greedy_np[i, j])
+                    committed.append(g)
+                    if j >= k or g != int(toks_in[i, j + 1]):
+                        break  # mismatch: g is the corrected token
+            else:
+                committed = [int(sampled_np[i])]
+            appended = 0
+            for jc, tok in enumerate(committed):
+                if slot.request is None:
+                    break  # finished mid-run (eos / budget)
+                slot.generated.append(tok)
+                if slot.request.logprobs:
+                    slot.logprobs.append(
+                        float(greedy_lp[i, jc]) if greedy_slot
+                        else float(sampled_lp[i]))
+                slot.last_token = tok
+                slot.remaining -= 1
+                appended += 1
+                # the step wrote row jc's KV at position kv_len:
+                # committing token jc commits that row
+                slot.kv_len += 1
+                self._emit(slot, tok)
+                self.total_generated += 1
+                if self._finished(slot):
+                    self._complete(i, slot)
+            self._observe_itl(slot, appended, step_now)
+            if greedy_slot and appended > 1:
+                # accepted = drafts actually CONSUMED (an early finish must
+                # not inflate the exported acceptance rate)
+                self.metrics.incr("tpu_serving_spec_accepted", appended - 1)
+            if slot.request is None:
+                continue  # _complete released every page already
+            # rejection rollback: table entries past the committed length
+            # hold only rejected rows — drop them back to the pool. All
+            # fresh private pages (window is None on this path, so
+            # entries map 1:1 to distinct pages, and shared prefix pages
+            # all sit below the committed length).
+            keep = -(-slot.kv_len // t)
+            if slot.table_len > keep:
+                row = self._page_tables_np[i]
+                dropped = [int(row[j]) for j in range(keep, slot.table_len)]
+                for page in dropped:
+                    slot.pages.remove(page)
+                row[keep:slot.table_len] = 0
+                slot.table_len = keep
+                with self._prefix_lock:
+                    store.release(dropped)
+                rolled_back += len(dropped)
+        if rolled_back:
+            self.metrics.incr(
+                "tpu_serving_paged_speculative_rollback_pages", rolled_back)
+        self._tokens = jnp.asarray([s.last_token for s in slots], jnp.int32)
+        self.metrics.incr("tpu_serving_decode_steps")
+        self.metrics.incr("tpu_serving_paged_speculative_steps")
+        self._observe_step(sum(1 for a in active if a))
+        return True
 
     def _commit_decode(self, logits):
         """Host-side half of a decode step, shared by the contiguous and

@@ -282,6 +282,43 @@ class PrefixTrie:
                 node_map = node.children
         return len(new_nodes), evicted
 
+    def insert_ready(self, adapter_id: int, tokens: list, pages: list,
+                     pin: bool = False) -> int:
+        """Cache full pages of ``tokens`` whose KV payload ALREADY sits
+        in the arena pages the caller owns (paged-native prefill
+        scattered the run in place — there is nothing to copy, the
+        zero-copy sibling of ``insert``). ``pages[i]`` backs chunk i;
+        each adopted node takes its OWN pool reference, so the caller's
+        run references stay the caller's to release. Chunks already
+        present dedup through the walk (the caller's duplicate page is
+        simply not adopted — the slot keeps decoding from its own run).
+        Returns pages adopted."""
+        self._clock += 1
+        want = min(len(pages), len(tokens) // self.page_tokens)
+        node_map = self._roots.setdefault(adapter_id, {})
+        parent: Optional[_Node] = None
+        depth = 0
+        chunks = self._chunks(tokens, want)
+        for chunk in chunks:
+            node = node_map.get(chunk)
+            if node is None:
+                break
+            node.last_used = self._clock
+            if pin:
+                node.pinned = True
+            parent, node_map, depth = node, node.children, depth + 1
+        added = 0
+        for i, chunk in enumerate(chunks[depth:]):
+            page = pages[depth + i]
+            self.pool.ref(page)
+            node = _Node(chunk=chunk, page=page, parent=parent, pinned=pin,
+                         last_used=self._clock)
+            node_map[chunk] = node
+            self._nodes[id(node)] = node
+            parent, node_map = node, node.children
+            added += 1
+        return added
+
     def _evict_lru(self, protect: set) -> int:
         """Drop the least-recently-used unpinned LEAF (children would
         orphan otherwise; parents become leaves as their subtrees drain).
@@ -757,6 +794,15 @@ class PagedKVStore:
                 off += size
 
         return self.trie.insert(adapter_id, tokens, write_pages, pin=pin)
+
+    def insert_ready(self, adapter_id: int, tokens: list, pages: list,
+                     pin: bool = False) -> int:
+        """Adopt a paged-native prefill's run into the trie WITHOUT a
+        copy: the run's pages already hold the KV payload (the chunk
+        steps scattered straight into the arena), so the trie only takes
+        references (PrefixTrie.insert_ready). The caller keeps its own
+        run references and releases them when the slot completes."""
+        return self.trie.insert_ready(adapter_id, tokens, pages, pin=pin)
 
     def stats(self) -> dict:
         # evictable = unpinned trie pages ONLY the trie references

@@ -109,11 +109,23 @@ class ServingConfig:
     # arena (LlamaModel.paged_decode_step): prefix hits and handed-off KV
     # are REFERENCED zero-copy instead of gathered into a contiguous slot
     # cache, and each admission writes only its un-cached tail pages.
-    # None = auto: on whenever the layout allows it (plain dense K/V —
-    # no MLA / sliding window / int8-KV — single host, no adapters, no
-    # speculation, prefix cache on); True errors if the layout can't;
-    # False keeps the contiguous slot-cache loop.
+    # None = auto: on whenever the config allows it (prefix cache on,
+    # kv_page_tokens < cache_len, not a contiguous ring cache, no
+    # interleaved sliding-window pattern, pool sized for the fleet).
+    # Every cache layout pages (plain/int8-KV/MLA/MLA+int8/uniform
+    # window), mesh-sharded arenas page (ISSUE 13), and since ISSUE 14
+    # adapters and speculation ride the paged loop too. True errors if
+    # the config can't; False keeps the contiguous slot-cache loop.
     paged_decode: Optional[bool] = None
+    # paged-NATIVE prefill (ISSUE 14): when the paged loop is on, prefill
+    # chunks scatter K/V straight into the slot's pre-allocated arena
+    # pages (LlamaModel.paged_prefill_chunk_step) — no dense scratch
+    # cache, no fill_pages copy on the hot path. None = auto: on whenever
+    # the paged loop runs; False keeps the dense-scratch prefill +
+    # page-copy adoption path; True errors unless the paged loop is on.
+    # Fanout admissions (one prefill seeding several slots) and pool
+    # exhaustion fall back to the dense route per-request either way.
+    paged_prefill: Optional[bool] = None
     # multi-LoRA serving (vLLM-style multi-tenant adapters): rank > 0
     # preallocates zero-filled adapter stacks of this rank over
     # ``lora_targets`` so adapters register WITHOUT recompiling the decode

@@ -780,3 +780,223 @@ class TestPagedAttentionMlaQuantParity:
                 jnp.zeros((1, 4, 64)), jnp.zeros((1, 4, 16)), c, kr,
                 cs[:, :4], krs, jnp.zeros((1, 2), jnp.int32),
                 jnp.asarray([3], jnp.int32))
+
+
+# -- multi-token form (ISSUE 14): K query tokens per sequence ------------------
+# The kernels speculative verify (K = k+1 drafts) and paged-native
+# prefill chunks ride. `lengths` INCLUDES the K tokens being attended
+# (query j sits at position lengths - K + j); the intra-block mask is
+# causal between the K new positions.
+
+
+class TestPagedAttentionMulti:
+    def test_reference_equals_per_query_contiguous(self):
+        """Gathering the table back to contiguous and running the causal
+        kernel over the K query positions (q_offset = lengths - K) must
+        reproduce the multi reference per row — GQA grouping included."""
+        from k8s_runpod_kubelet_tpu.ops.attention import \
+            paged_attention_multi
+        rng = np.random.default_rng(40)
+        b, kq, hq, hkv, d, t, n = 3, 4, 8, 2, 128, 8, 4
+        k_pages, v_pages, pt = _pages(rng, b, hkv, d, t, 16, n)
+        q = jnp.asarray(rng.normal(size=(b, kq, hq, d)), jnp.float32)
+        lengths = jnp.asarray([5, 17, 32], jnp.int32)  # include the K=4
+        out = paged_attention_multi(q, k_pages, v_pages, pt, lengths,
+                                    use_pallas=False)
+        for row in range(b):
+            length = int(lengths[row])
+            kc = k_pages[pt[row]].reshape(n * t, hkv, d)[:length]
+            vc = v_pages[pt[row]].reshape(n * t, hkv, d)[:length]
+            ref = _attention_xla(q[row].transpose(1, 0, 2)[None],
+                                 kc.transpose(1, 0, 2)[None],
+                                 vc.transpose(1, 0, 2)[None],
+                                 causal=True, sm_scale=d ** -0.5,
+                                 q_offset=length - kq)
+            np.testing.assert_allclose(
+                np.asarray(out[row]),
+                np.asarray(ref[0].transpose(1, 0, 2)),
+                rtol=1e-5, atol=1e-5)
+
+    def test_k1_degenerates_to_single_token(self):
+        from k8s_runpod_kubelet_tpu.ops.attention import \
+            paged_attention_multi
+        rng = np.random.default_rng(41)
+        b, hq, hkv, d, t, n = 2, 8, 4, 128, 8, 4
+        k_pages, v_pages, pt = _pages(rng, b, hkv, d, t, 8, n)
+        q = jnp.asarray(rng.normal(size=(b, hq, d)), jnp.float32)
+        lengths = jnp.asarray([9, 27], jnp.int32)
+        single = paged_attention(q, k_pages, v_pages, pt, lengths,
+                                 use_pallas=False)
+        multi = paged_attention_multi(q[:, None], k_pages, v_pages, pt,
+                                      lengths, use_pallas=False)
+        np.testing.assert_allclose(np.asarray(multi[:, 0]),
+                                   np.asarray(single),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_pallas_kernel_matches_reference(self):
+        """interpret=True runs the EXACT multi-token kernel (causal
+        intra-block mask in the online softmax) on CPU — short rows where
+        the K block IS most of the sequence included."""
+        from k8s_runpod_kubelet_tpu.ops.attention import \
+            paged_attention_multi
+        rng = np.random.default_rng(42)
+        b, kq, hq, hkv, d, t, n = 2, 3, 16, 4, 128, 8, 6
+        k_pages, v_pages, pt = _pages(rng, b, hkv, d, t, 12, n)
+        q = jnp.asarray(rng.normal(size=(b, kq, hq, d)), jnp.float32)
+        for lengths in ([3, 48], [7, 9], [48, 33]):
+            lengths = jnp.asarray(lengths, jnp.int32)
+            ref = paged_attention_multi(q, k_pages, v_pages, pt, lengths,
+                                        use_pallas=False)
+            pal = paged_attention_multi(q, k_pages, v_pages, pt, lengths,
+                                        interpret=True)
+            np.testing.assert_allclose(np.asarray(pal), np.asarray(ref),
+                                       rtol=1e-5, atol=1e-5)
+
+    def test_pallas_soft_cap_and_window(self):
+        from k8s_runpod_kubelet_tpu.ops.attention import \
+            paged_attention_multi
+        rng = np.random.default_rng(43)
+        b, kq, hq, hkv, d, t, n = 2, 3, 8, 8, 128, 8, 6
+        k_pages, v_pages, pt = _pages(rng, b, hkv, d, t, 12, n)
+        q = jnp.asarray(rng.normal(size=(b, kq, hq, d)), jnp.float32)
+        lengths = jnp.asarray([11, 41], jnp.int32)
+        for kw in ({"logit_soft_cap": 30.0}, {"sliding_window": 12},
+                   {"logit_soft_cap": 30.0, "sliding_window": 12}):
+            ref = paged_attention_multi(q, k_pages, v_pages, pt, lengths,
+                                        use_pallas=False, **kw)
+            pal = paged_attention_multi(q, k_pages, v_pages, pt, lengths,
+                                        interpret=True, **kw)
+            np.testing.assert_allclose(np.asarray(pal), np.asarray(ref),
+                                       rtol=1e-5, atol=1e-5, err_msg=str(kw))
+
+    def test_quant_equals_dequantized_plain_multi(self):
+        from k8s_runpod_kubelet_tpu.ops.attention import (
+            paged_attention_multi, paged_attention_multi_quant)
+        rng = np.random.default_rng(44)
+        b, kq, hq, hkv, d, t, n = 2, 3, 8, 2, 128, 8, 4
+        k, v, ks, vs = _quant_pages(rng, hkv, d, t, 16)
+        pt = jnp.asarray(rng.permutation(16)[:b * n].reshape(b, n),
+                         jnp.int32)
+        q = jnp.asarray(rng.normal(size=(b, kq, hq, d)), jnp.float32)
+        lengths = jnp.asarray([6, 30], jnp.int32)
+        out = paged_attention_multi_quant(q, k, v, ks, vs, pt, lengths,
+                                          use_pallas=False)
+        plain = paged_attention_multi(
+            q, k.astype(jnp.float32) * ks[..., None],
+            v.astype(jnp.float32) * vs[..., None], pt, lengths,
+            use_pallas=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(plain),
+                                   rtol=1e-5, atol=1e-5)
+        pal = paged_attention_multi_quant(q, k, v, ks, vs, pt, lengths,
+                                          interpret=True)
+        np.testing.assert_allclose(np.asarray(pal), np.asarray(out),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_mla_multi_parity(self):
+        """K=1 degenerates to paged_attention_mla; K>1 interpret kernel
+        equals the multi reference."""
+        from k8s_runpod_kubelet_tpu.ops.attention import (
+            paged_attention_mla, paged_attention_multi_mla)
+        rng = np.random.default_rng(45)
+        b, kq, hq, r, dr, t, n = 2, 3, 8, 128, 128, 8, 4
+        P = 8
+        c_pages = jnp.asarray(rng.normal(size=(P, t, r)), jnp.float32)
+        kr_pages = jnp.asarray(rng.normal(size=(P, t, dr)), jnp.float32)
+        pt = jnp.asarray(rng.permutation(P)[:b * n].reshape(b, n),
+                         jnp.int32)
+        ql1 = jnp.asarray(rng.normal(size=(b, hq, r)), jnp.float32)
+        qr1 = jnp.asarray(rng.normal(size=(b, hq, dr)), jnp.float32)
+        lengths = jnp.asarray([6, 22], jnp.int32)
+        single = paged_attention_mla(ql1, qr1, c_pages, kr_pages, pt,
+                                     lengths, use_pallas=False)
+        multi1 = paged_attention_multi_mla(ql1[:, None], qr1[:, None],
+                                           c_pages, kr_pages, pt, lengths,
+                                           use_pallas=False)
+        np.testing.assert_allclose(np.asarray(multi1[:, 0]),
+                                   np.asarray(single),
+                                   rtol=1e-6, atol=1e-6)
+        ql = jnp.asarray(rng.normal(size=(b, kq, hq, r)), jnp.float32)
+        qr = jnp.asarray(rng.normal(size=(b, kq, hq, dr)), jnp.float32)
+        for lengths in ([3, 30], [9, 25]):
+            lengths = jnp.asarray(lengths, jnp.int32)
+            ref = paged_attention_multi_mla(ql, qr, c_pages, kr_pages, pt,
+                                            lengths, use_pallas=False)
+            pal = paged_attention_multi_mla(ql, qr, c_pages, kr_pages, pt,
+                                            lengths, interpret=True)
+            np.testing.assert_allclose(np.asarray(pal), np.asarray(ref),
+                                       rtol=1e-5, atol=1e-5)
+
+    def test_mla_quant_multi_parity(self):
+        from k8s_runpod_kubelet_tpu.ops.attention import (
+            paged_attention_multi_mla, paged_attention_multi_mla_quant)
+        rng = np.random.default_rng(46)
+        b, kq, hq, r, dr, t, n = 2, 3, 4, 64, 16, 8, 4
+        P = 8
+        c = jnp.asarray(rng.integers(-127, 127, size=(P, t, r)), jnp.int8)
+        kr = jnp.asarray(rng.integers(-127, 127, size=(P, t, dr)), jnp.int8)
+        cs = jnp.asarray(rng.uniform(0.01, 0.05, size=(P, t)), jnp.float32)
+        krs = jnp.asarray(rng.uniform(0.01, 0.05, size=(P, t)), jnp.float32)
+        pt = jnp.asarray(rng.permutation(P)[:b * n].reshape(b, n),
+                         jnp.int32)
+        ql = jnp.asarray(rng.normal(size=(b, kq, hq, r)), jnp.float32)
+        qr = jnp.asarray(rng.normal(size=(b, kq, hq, dr)), jnp.float32)
+        lengths = jnp.asarray([10, 31], jnp.int32)
+        out = paged_attention_multi_mla_quant(ql, qr, c, kr, cs, krs, pt,
+                                              lengths, use_pallas=False)
+        plain = paged_attention_multi_mla(
+            ql, qr, c.astype(jnp.float32) * cs[..., None],
+            kr.astype(jnp.float32) * krs[..., None], pt, lengths,
+            use_pallas=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(plain),
+                                   rtol=1e-4, atol=1e-4)
+        pal = paged_attention_multi_mla_quant(ql, qr, c, kr, cs, krs, pt,
+                                              lengths, interpret=True)
+        np.testing.assert_allclose(np.asarray(pal), np.asarray(out),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_mesh_entrypoints_match_reference(self):
+        """All four multi dispatches through the mesh= wrapper on the
+        virtual CPU mesh — sharded heads must equal single-device."""
+        from jax.sharding import Mesh
+        from k8s_runpod_kubelet_tpu.ops.attention import (
+            paged_attention_multi, paged_attention_multi_mla,
+            paged_attention_multi_mla_quant, paged_attention_multi_quant)
+        mesh = Mesh(np.array(jax.devices()[:2]), ("tensor",))
+        rng = np.random.default_rng(47)
+        b, kq, hq, hkv, d, t, n = 2, 3, 8, 4, 128, 8, 4
+        k_pages, v_pages, pt = _pages(rng, b, hkv, d, t, 8, n)
+        q = jnp.asarray(rng.normal(size=(b, kq, hq, d)), jnp.float32)
+        lengths = jnp.asarray([9, 30], jnp.int32)
+        ref = paged_attention_multi(q, k_pages, v_pages, pt, lengths)
+        out = paged_attention_multi(q, k_pages, v_pages, pt, lengths,
+                                    mesh=mesh)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+        k8, v8, ks, vs = _quant_pages(rng, hkv, d, t, 8)
+        ref = paged_attention_multi_quant(q, k8, v8, ks, vs, pt, lengths)
+        out = paged_attention_multi_quant(q, k8, v8, ks, vs, pt, lengths,
+                                          mesh=mesh)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+        r, dr = 64, 16
+        c_pages = jnp.asarray(rng.normal(size=(8, t, r)), jnp.float32)
+        kr_pages = jnp.asarray(rng.normal(size=(8, t, dr)), jnp.float32)
+        ql = jnp.asarray(rng.normal(size=(b, kq, hq, r)), jnp.float32)
+        qr = jnp.asarray(rng.normal(size=(b, kq, hq, dr)), jnp.float32)
+        ref = paged_attention_multi_mla(ql, qr, c_pages, kr_pages, pt,
+                                        lengths)
+        out = paged_attention_multi_mla(ql, qr, c_pages, kr_pages, pt,
+                                        lengths, mesh=mesh)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+        c8 = jnp.asarray(rng.integers(-127, 127, size=(8, t, r)), jnp.int8)
+        kr8 = jnp.asarray(rng.integers(-127, 127, size=(8, t, dr)),
+                          jnp.int8)
+        cs = jnp.asarray(rng.uniform(0.01, 0.05, size=(8, t)), jnp.float32)
+        krs = jnp.asarray(rng.uniform(0.01, 0.05, size=(8, t)), jnp.float32)
+        ref = paged_attention_multi_mla_quant(ql, qr, c8, kr8, cs, krs, pt,
+                                              lengths)
+        out = paged_attention_multi_mla_quant(ql, qr, c8, kr8, cs, krs, pt,
+                                              lengths, mesh=mesh)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)

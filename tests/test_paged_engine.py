@@ -389,18 +389,35 @@ class TestPagedLayoutMatrix:
                 e.stop()
 
     def test_gate_error_names_only_what_is_left(self):
-        """The eligibility gate must no longer blame int8-LATENT or
-        sliding windows — the matrix is total; what's left is the
-        windowed interleave + explicit ring pin (and the structural
-        no-mesh/adapters/speculation constraints)."""
+        """The eligibility gate must no longer blame int8-LATENT, sliding
+        windows, adapters or speculation — the matrix is total and
+        multi-tenant (ISSUE 14); what's left is the windowed interleave +
+        explicit ring pin + the structural pool/prefix-cache constraints."""
         with pytest.raises(ValueError) as ei:
             ServingEngine(CFG, _layout("plain")[1], ServingConfig(
                 slots=2, cache_len=256, kv_page_tokens=8,
-                paged_decode=True, speculate_k=2))
+                paged_decode=True, prefix_cache_enabled=False))
         msg = str(ei.value)
         assert "interleave" in msg and "ring_cache=True" in msg
         assert "no int8 LATENT" not in msg
         assert "no sliding window" not in msg
+        assert "speculation" not in msg and "adapters" not in msg
+
+    def test_speculation_and_adapters_no_longer_excluded(self):
+        """ISSUE 14 acceptance: paged_decode=True with speculate_k > 0
+        and with lora_rank > 0 CONSTRUCTS (the old gate raised) and runs
+        the paged loop."""
+        e = ServingEngine(CFG, _layout("plain")[1], ServingConfig(
+            slots=2, max_prefill_len=32, cache_len=256, kv_page_tokens=8,
+            paged_decode=True, speculate_k=2, lora_rank=4))
+        assert e._paged_loop and e._paged_verify is not None
+        assert e._paged_prefill_on
+
+    def test_paged_prefill_true_needs_paged_loop(self):
+        with pytest.raises(ValueError, match="paged_prefill=True"):
+            ServingEngine(CFG, _layout("plain")[1], ServingConfig(
+                slots=2, max_prefill_len=32, cache_len=256,
+                kv_page_tokens=8, paged_decode=False, paged_prefill=True))
 
     def test_explicit_ring_pin_stays_contiguous(self):
         cfg, params, _ = _layout("sliding_window")
@@ -446,3 +463,227 @@ class TestPagedLayoutMatrix:
                 == stats["pages_total"]
         finally:
             e.stop()
+
+
+# -- speculation + adapter axes (ISSUE 14) ------------------------------------
+# The last request classes the gate excluded now ride the paged loop:
+# speculative decoding verifies drafts through the multi-token kernel
+# (rejections rewind lengths and drop uncommitted tail pages), and
+# multi-LoRA threads adapter snapshots through paged prefill + decode.
+
+
+class TestPagedSpeculation:
+    @pytest.mark.parametrize("layout", sorted(LAYOUTS))
+    def test_speculative_token_identity_and_rollback(self, layout):
+        """Per layout: the paged speculative engine is token-identical to
+        the contiguous speculative engine on draft-friendly (repetitive),
+        draft-hostile (rejecting) and seeded-sampled (no K-commit)
+        traffic, and rollback leaks zero pages."""
+        cfg, params, extra = _layout(layout)
+        sc_kw = dict(slots=2, max_prefill_len=32, cache_len=256,
+                     max_new_tokens=64, kv_page_tokens=8, speculate_k=3,
+                     **extra)
+        paged = ServingEngine(cfg, params, ServingConfig(**sc_kw)).start()
+        contig = ServingEngine(cfg, params, ServingConfig(
+            **sc_kw, paged_decode=False)).start()
+        try:
+            assert paged._paged_loop
+            # repetitive: the bigram proposer lands accepts; arbitrary:
+            # drafts reject (the rollback path); third samples seeded
+            rep = [5, 6, 7, 5, 6, 7, 5, 6, 7, 5, 6]
+            hostile = [3, 1, 4, 1, 5, 9, 2, 6, 8, 10]
+            for i, p in enumerate([rep, hostile,
+                                   [11, 12, 13, 11, 12, 13, 11]]):
+                kw = dict(max_new_tokens=24)
+                if i == 2:
+                    kw.update(temperature=0.8, seed=SEED + i)
+                a = paged.submit(p, **kw).result(timeout=300)
+                b = contig.submit(p, **kw).result(timeout=300)
+                assert a["tokens"] == b["tokens"], (
+                    f"[seed={SEED}] {layout} spec prompt {i}: paged != "
+                    f"contiguous")
+            if layout == "sliding_window":
+                # windowed slots keep 1-token paged decode (ring
+                # recycling aliases table entries — incompatible with
+                # rollback); identity above is the contract that matters
+                assert paged.metrics.get_counter(
+                    "tpu_serving_paged_speculative_steps") == 0
+            else:
+                assert paged.metrics.get_counter(
+                    "tpu_serving_paged_speculative_steps") > 0
+                assert paged.metrics.get_counter(
+                    "tpu_serving_spec_accepted") > 0
+            paged.drain()
+            stats = paged.prefix_cache_stats()
+            assert stats["pages_free"] + stats["nodes"] \
+                == stats["pages_total"], (
+                f"[seed={SEED}] {layout}: speculative rollback leaked "
+                f"pages ({stats})")
+        finally:
+            paged.stop()
+            contig.stop()
+
+
+def _trained_lora(cfg, params, seed, targets=("wq", "wv"), rank=4):
+    """A LoRA tree with NON-zero B (zero-init B would be a no-op and the
+    adapter axis vacuous) — the test_multi_lora idiom."""
+    from k8s_runpod_kubelet_tpu.models import LoraConfig, apply_lora
+    lc = LoraConfig(rank=rank, alpha=8.0, targets=targets)
+    wrapped = apply_lora(cfg, params, lc, jax.random.PRNGKey(seed))
+    layers = dict(wrapped["layers"])
+    key = jax.random.PRNGKey(seed + 100)
+    for t in targets:
+        w = dict(layers[t])
+        key, sub = jax.random.split(key)
+        w["lora_b"] = jax.random.normal(sub, w["lora_b"].shape,
+                                        w["lora_b"].dtype) * 0.05
+        layers[t] = w
+    out = dict(wrapped)
+    out["layers"] = layers
+    return out
+
+
+class TestPagedAdapters:
+    def _engines(self, params, **kw):
+        sc_kw = dict(slots=2, max_prefill_len=32, cache_len=256,
+                     max_new_tokens=16, kv_page_tokens=8, lora_rank=4,
+                     max_adapters=2, **kw)
+        paged = ServingEngine(CFG, params, ServingConfig(**sc_kw)).start()
+        contig = ServingEngine(CFG, params, ServingConfig(
+            **sc_kw, paged_decode=False)).start()
+        return paged, contig
+
+    def test_adapter_token_identity_on_paged_loop(self, params):
+        paged, contig = self._engines(params)
+        try:
+            assert paged._paged_loop, \
+                "adapters must no longer exclude the paged loop"
+            ad_a = _trained_lora(CFG, params, seed=1)
+            ad_b = _trained_lora(CFG, params, seed=2)
+            for e in (paged, contig):
+                e.register_adapter("a", ad_a)
+                e.register_adapter("b", ad_b)
+            p = SHARED[:24] + [2, 3]
+            for kw in (dict(max_new_tokens=10),
+                       dict(max_new_tokens=10, temperature=0.8,
+                            seed=SEED)):
+                for ad in ("a", "b", ""):
+                    x = paged.submit(p, adapter=ad, **kw).result(
+                        timeout=300)
+                    y = contig.submit(p, adapter=ad, **kw).result(
+                        timeout=300)
+                    assert x["tokens"] == y["tokens"], (
+                        f"[seed={SEED}] adapter={ad!r} {kw}: paged != "
+                        f"contiguous")
+            # the adapters actually bite: a and b diverge from base
+            base = paged.submit(p, max_new_tokens=10).result(timeout=300)
+            wa = paged.submit(p, adapter="a", max_new_tokens=10).result(
+                timeout=300)
+            assert base["tokens"] != wa["tokens"], \
+                "adapter a was a no-op — the identity check is vacuous"
+            paged.drain()
+            stats = paged.prefix_cache_stats()
+            assert stats["pages_free"] + stats["nodes"] \
+                == stats["pages_total"]
+        finally:
+            paged.stop()
+            contig.stop()
+
+    def test_prefix_reuse_keyed_per_adapter_root(self, params):
+        """The trie keys cached KV by adapter id: the same prefix under
+        the same adapter HITS, under a different adapter MISSES (adapter
+        deltas change the KV — cross-adapter reuse would be wrong math)."""
+        paged, _contig = self._engines(params)
+        _contig.stop()
+        try:
+            ad_a = _trained_lora(CFG, params, seed=1)
+            ad_b = _trained_lora(CFG, params, seed=2)
+            paged.register_adapter("a", ad_a)
+            paged.register_adapter("b", ad_b)
+            prefix = SHARED[:40]
+
+            def hits():
+                return paged.metrics.get_counter(
+                    "tpu_serving_prefix_cache_hits")
+
+            paged.submit(prefix + [1, 2], adapter="a",
+                         max_new_tokens=4).result(timeout=300)
+            h0 = hits()
+            paged.submit(prefix + [3, 4], adapter="a",
+                         max_new_tokens=4).result(timeout=300)
+            assert hits() == h0 + 1, "same adapter root must hit"
+            paged.submit(prefix + [5, 6], adapter="b",
+                         max_new_tokens=4).result(timeout=300)
+            assert hits() == h0 + 1, \
+                "a different adapter root must NOT reuse adapter a's KV"
+            paged.submit(prefix + [7, 8], adapter="b",
+                         max_new_tokens=4).result(timeout=300)
+            assert hits() == h0 + 2, "adapter b's own root now hits"
+        finally:
+            paged.stop()
+
+
+class TestPagedNativePrefill:
+    """ISSUE 14 acceptance: the prefill hot path performs no dense
+    scratch allocation and no fill_pages copy — prefill scatters straight
+    into the arena pages the slot will decode from."""
+
+    def test_dense_scratch_never_allocated_for_paged_eligible_prefill(
+            self, params):
+        e = _engine(params, enabled=True)
+        try:
+            assert e._paged_prefill_on
+
+            def boom(batch):
+                raise AssertionError(
+                    "dense scratch cache allocated on a paged-eligible "
+                    "prefill — the native path must not copy through it")
+
+            e._fresh_cache = boom
+            # sequential single admissions (no fanout): miss, then a
+            # prefix hit, then a registered prefix — all native
+            p = SHARED[:40] + [1, 2]
+            out = e.submit(p, max_new_tokens=6).result(timeout=300)
+            assert len(out["tokens"]) == 6
+            out2 = e.submit(SHARED[:40] + [3], max_new_tokens=6).result(
+                timeout=300)
+            assert len(out2["tokens"]) == 6
+            e.register_prefix(SHARED[:16])
+            assert e.metrics.get_counter(
+                "tpu_serving_paged_prefill_tokens") > 0
+            assert e.metrics.get_counter(
+                "tpu_serving_prefix_cache_hits") >= 1
+            assert e.alive and e.last_error is None
+            e.drain()
+            stats = e.prefix_cache_stats()
+            assert stats["pages_free"] + stats["nodes"] \
+                == stats["pages_total"]
+        finally:
+            e.stop()
+
+    def test_paged_prefill_off_is_token_identical(self, params):
+        sc_kw = dict(slots=2, max_prefill_len=32, cache_len=256,
+                     max_new_tokens=16, kv_page_tokens=8)
+        native = ServingEngine(CFG, params, ServingConfig(**sc_kw)).start()
+        dense = ServingEngine(CFG, params, ServingConfig(
+            **sc_kw, paged_prefill=False)).start()
+        try:
+            assert native._paged_prefill_on and not dense._paged_prefill_on
+            for i, p in enumerate([SHARED[:40] + [1], [9, 8, 7, 6]]):
+                kw = dict(max_new_tokens=8)
+                if i == 1:
+                    kw.update(temperature=0.8, seed=SEED)
+                a = native.submit(p, **kw).result(timeout=300)
+                b = dense.submit(p, **kw).result(timeout=300)
+                assert a["tokens"] == b["tokens"], \
+                    f"[seed={SEED}] prompt {i}: native != dense-scratch"
+            assert dense.metrics.get_counter(
+                "tpu_serving_paged_prefill_tokens") == 0
+            for e in (native, dense):
+                e.drain()
+                stats = e.prefix_cache_stats()
+                assert stats["pages_free"] + stats["nodes"] \
+                    == stats["pages_total"]
+        finally:
+            native.stop()
+            dense.stop()

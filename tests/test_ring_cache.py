@@ -145,8 +145,12 @@ class TestRingCacheEngine:
         return ServingEngine(WCFG, params, sc).start()
 
     def test_auto_on_for_windowed_model_and_matches_linear(self, params):
-        e_ring = self._engine(params, ring=None)
-        e_lin = self._engine(params, ring=False)
+        # paged_decode=False: since the uniform-window paged loop (ISSUE
+        # 13) the paged slot table wins ring_cache=None by default (its
+        # page recycling IS the memory win) — the contiguous ring is the
+        # paged-off path this test pins
+        e_ring = self._engine(params, ring=None, paged_decode=False)
+        e_lin = self._engine(params, ring=False, paged_decode=False)
         try:
             # 8 window + 16 slack -> rounds up to one 128 lane tile, and
             # 128 < cache_len 256 so auto enables
@@ -187,7 +191,20 @@ class TestRingCacheEngine:
 
     def test_auto_off_when_no_memory_win(self, params):
         sc = ServingConfig(slots=1, max_prefill_len=16, cache_len=64,
-                           ring_cache=None)
+                           ring_cache=None, paged_decode=False)
         e = ServingEngine(WCFG, params, sc)
-        # ring would be 128 >= cache_len 64 -> linear
+        # ring would be 128 >= cache_len 64 -> linear (paged_decode=False
+        # so the contiguous cache exists to inspect at all)
         assert e._ring_len is None and "abs_pos" not in e._cache
+
+    def test_paged_loop_wins_ring_auto(self, params):
+        """ring_cache=None on a paged-eligible windowed engine: the paged
+        slot table takes the window's memory win (page recycling), the
+        contiguous ring never builds."""
+        e = self._engine(params, ring=None)
+        try:
+            assert e._paged_loop and e._ring_len is None
+            assert e._cache is None
+            assert e._window == WCFG.sliding_window
+        finally:
+            e.stop()

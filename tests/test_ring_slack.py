@@ -1,0 +1,78 @@
+"""Regression: ring slack accounts for chunked prefill (ISSUE 14
+satellite). The old slack ``max(sc.max_prefill_len, sc.speculate_k + 1)``
+ignored ``serving_chunk_tokens`` entirely: a chunk size above
+``max_prefill_len`` under-reserved (the raw chunk is the largest span one
+cache-writing call can touch — list padding with a negative count does
+not truncate), and a chunk size below it over-reserved (every call is
+capped at the chunk's pow2 bucket, so the ring was paying
+``max_prefill_len`` of slack for writes that never exceed the bucket).
+
+``_pick_ring_len`` is a staticmethod — pure config arithmetic, no jit —
+so this pins the slack table in the fast tier.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from k8s_runpod_kubelet_tpu.models import tiny_llama
+from k8s_runpod_kubelet_tpu.workloads.serving import ServingConfig
+from k8s_runpod_kubelet_tpu.workloads.serving.engine import ServingEngine
+
+WINDOW = 256
+CFG = tiny_llama(vocab_size=64, embed_dim=32, n_layers=1, n_heads=2,
+                 n_kv_heads=2, mlp_dim=64, max_seq_len=4096,
+                 sliding_window=WINDOW, dtype=jnp.float32,
+                 param_dtype=jnp.float32)
+
+
+def _ring(**kw):
+    sc = ServingConfig(slots=1, cache_len=4096, **kw)
+    return ServingEngine._pick_ring_len(CFG, sc)
+
+
+def _expect(slack: int) -> int:
+    return -(-(WINDOW + slack) // 128) * 128
+
+
+def test_monolithic_prefill_reserves_max_prefill_len():
+    assert _ring(max_prefill_len=512) == _expect(512)
+
+
+def test_oversized_chunk_reserves_the_raw_chunk():
+    """The under-reserve class the fix exists for: one call can write
+    serving_chunk_tokens (> max_prefill_len) positions, so the ring must
+    cover window + chunk — the old slack stopped at max_prefill_len."""
+    ring = _ring(max_prefill_len=512, serving_chunk_tokens=900)
+    assert ring == _expect(900)
+    assert ring > _expect(512), "oversized chunk must grow the ring"
+
+
+def test_small_chunk_shrinks_slack_to_its_bucket():
+    """With chunking on, every cache-writing call (head included) is one
+    chunk padded to its pow2 bucket — the ring no longer reserves the
+    full max_prefill_len for writes that cannot happen."""
+    assert _ring(max_prefill_len=512, serving_chunk_tokens=100) \
+        == _expect(128)  # bucket(100) = 128
+    assert _ring(max_prefill_len=512, serving_chunk_tokens=100) \
+        < _ring(max_prefill_len=512)
+
+
+def test_chunk_bucket_capped_at_max_prefill_len():
+    # chunk 100 but max_prefill 64: the bucket cannot exceed the largest
+    # compile bucket, and the raw chunk (100) dominates the reserve
+    assert _ring(max_prefill_len=64, serving_chunk_tokens=100) \
+        == _expect(100)
+
+
+def test_speculation_still_floors_the_slack():
+    assert _ring(max_prefill_len=512, serving_chunk_tokens=100,
+                 speculate_k=300) == _expect(301)
+
+
+def test_unwindowed_model_stays_linear():
+    plain = tiny_llama(vocab_size=64, embed_dim=32, n_layers=1, n_heads=2,
+                       n_kv_heads=2, mlp_dim=64, max_seq_len=4096,
+                       dtype=jnp.float32, param_dtype=jnp.float32)
+    sc = ServingConfig(slots=1, cache_len=4096, max_prefill_len=512)
+    assert ServingEngine._pick_ring_len(plain, sc) is None

@@ -135,7 +135,7 @@ def load_table(snapshots: list[dict]) -> list[str]:
         out += [f"-- pool: {role} ({len(pools[role])} replica(s)) --",
                 f"{'replica':<20} {'state':<9} {'slots':>11} {'queue':>6} "
                 f"{'kv_tokens':>10} {'ttft_p95':>9} {'itl_p95':>8} "
-                f"{'kv_free':>9} {'prefix%':>8} {'hb_age':>7}"]
+                f"{'kv_free':>9} {'prefix%':>8} {'spec%':>7} {'hb_age':>7}"]
         for rid in sorted(pools[role]):
             rep = latest[rid]
             st = rep.get("stats", {})
@@ -144,6 +144,10 @@ def load_table(snapshots: list[dict]) -> list[str]:
             # prefix-affinity concentrates reusable prompts (ISSUE 8)
             hit = st.get("prefix_hit_rate")
             hit_s = "-" if hit is None else f"{100.0 * float(hit):.1f}%"
+            # speculative acceptance rate (ISSUE 14): accepted/proposed
+            # drafts — "-" when the replica never proposed (speculate_k=0)
+            spec = st.get("spec_acceptance_rate")
+            spec_s = "-" if spec is None else f"{100.0 * float(spec):.1f}%"
             total = st.get("kv_pages_total", 0)
             free_s = f"{st.get('kv_pages_free', 0)}/{total}" if total \
                 else "-"
@@ -154,6 +158,7 @@ def load_table(snapshots: list[dict]) -> list[str]:
                        f"{st.get('itl_p95_s', 0.0):>7.3f}s "
                        f"{free_s:>9} "
                        f"{hit_s:>8} "
+                       f"{spec_s:>7} "
                        f"{rep.get('heartbeat_age_s', 0.0):>6.1f}s")
     return out
 
