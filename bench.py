@@ -54,6 +54,12 @@ Usage:
                              # per path, and two-hop TTFT per path
                              # through real engines (--smoke = throughput
                              # cell only; CPU runs tiny geometry)
+  python bench.py --kv-fabric  # fleet KV fabric (directory pulls): cold-
+                             # replica TTFT with the prompt's KV pulled
+                             # from its owner per rung (device/shm/wire
+                             # through the real /kv_fetch ladder) vs the
+                             # same replica re-prefilling cold (CPU runs
+                             # tiny geometry, claims need TPU)
   python bench.py --mfu-sweep  # training MFU levers: remat none/dots,
                              # batch, 530M width (needs TPU)
   python bench.py --attn-tune  # flash block-size grid at the training
@@ -129,6 +135,9 @@ _STAGED_QUEUE = [
     # device-native KV handoff (ISSUE 11): device vs wire page-run
     # throughput + two-hop TTFT per path on the same arena geometry
     ("handoff_path", ["--handoff-path"], 2400),
+    # fleet KV fabric (ISSUE 16): directory-pull TTFT per rung through
+    # the real /kv_fetch ladder vs cold re-prefill on the same replica
+    ("kv_fabric", ["--kv-fabric"], 2400),
     ("serve_8b", ["--serve", "--model", "llama3-8b", "--int8", "--kv-int8"],
      2400),
     # int4 weights via the Pallas unpack kernel (ops/int4_matmul.py):
@@ -1072,6 +1081,169 @@ def run_handoff_path_bench(smoke: bool = False) -> int:
         e_pre.stop()
         e_dw.stop()
         e_dd.stop()
+    return 0
+
+
+def run_kv_fabric_bench(smoke: bool = False) -> int:
+    """Fleet KV fabric cell (ISSUE 16): what a directory pull buys a
+    COLD replica, per rung, against the alternative it replaces.
+
+    One owner engine computes a prompt's KV once (its trie holds the
+    full-page run the fleet directory would advertise). Three fresh
+    cold replicas then each serve the SAME prompt after fetching that
+    run through the real /kv_fetch ladder over HTTP — one pinned to
+    each rung by the production selection rules (device: owner on this
+    process' bus; shm: domains match but the owner is off-bus; wire:
+    the owner advertises another placement domain). A fourth fresh
+    replica serves the prompt with NO pull — the cold re-prefill every
+    rung must beat. Reported TTFT includes the fetch hop (the router
+    plans the pull before the request lands, so the hop is on the
+    request's critical path exactly like a two-hop handoff).
+
+    The acceptance bar: pull TTFT strictly below cold re-prefill on
+    EVERY rung — otherwise the directory consult is pure overhead and
+    the fabric should answer misses with a plain re-prefill."""
+    _force_platform_from_env()
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+    from k8s_runpod_kubelet_tpu.fleet.device_transfer import (
+        BUS, detect_placement_domain)
+    from k8s_runpod_kubelet_tpu.workloads.serve_main import serve
+    from k8s_runpod_kubelet_tpu.workloads.serving import (ServingConfig,
+                                                          ServingEngine)
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = _serve_model("llama3-8b")
+        params = _serve_params(cfg, 8)
+        # cache sized for three co-resident page runs (each replica's
+        # own warm prompt + the pull-warming prompt + the timed prompt)
+        sc = ServingConfig(slots=8, max_prefill_len=512, cache_len=4096,
+                           max_new_tokens=64, kv_page_tokens=16)
+        plen, new_toks = 1024, 32
+    else:
+        # CPU geometry with MATERIAL prefill compute (wide embed/mlp)
+        # next to a modest KV payload — the regime the fabric exists
+        # for; the usual 64-wide tiny model prefills a 96-token prompt
+        # in single-digit ms, cheaper than ANY transfer, and the cell
+        # degenerates into HTTP-overhead noise
+        from k8s_runpod_kubelet_tpu.models import init_params, tiny_llama
+        cfg = tiny_llama(vocab_size=128, embed_dim=256, n_layers=4,
+                         n_heads=8, n_kv_heads=4, mlp_dim=512,
+                         max_seq_len=1024, dtype=jnp.float32,
+                         param_dtype=jnp.float32)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        sc = ServingConfig(slots=2, max_prefill_len=64, cache_len=1024,
+                           max_new_tokens=16, kv_page_tokens=8)
+        plen, new_toks = 192, 8
+
+    prompt = [((j * 7 + 131) % (cfg.vocab_size - 2)) + 1
+              for j in range(plen)]
+    warm = [((j * 11 + 977) % (cfg.vocab_size - 2)) + 1
+            for j in range(plen)]
+    # computed on the OWNER only: the per-rung warm-up pull must really
+    # scatter into the cold arena (a prompt the cold replica already
+    # holds would dedup in its trie and leave the write jits cold)
+    warm_pull = [((j * 13 + 577) % (cfg.vocab_size - 2)) + 1
+                 for j in range(plen)]
+
+    def ttft_of(engine, toks) -> float:
+        t_sub = time.perf_counter()
+        first = []
+        engine.submit(toks, max_new_tokens=new_toks,
+                      on_token=lambda _t: first.append(
+                          time.perf_counter() - t_sub)
+                      if not first else None).result(timeout=1800)
+        return first[0]
+
+    def fetch(cold_url, own_url, owner_domain, toks) -> tuple[float, dict]:
+        """(seconds, reply) for one /kv_fetch POST — the pull hop the
+        router puts on the request's critical path."""
+        body = json.dumps({"tokens": toks, "owner_url": own_url,
+                           "owner_domain": owner_domain,
+                           "model": cfg.name}).encode()
+        req = urllib.request.Request(
+            cold_url + "/kv_fetch", data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(req, timeout=1800) as resp:
+            out = json.loads(resp.read())
+        return time.perf_counter() - t0, out
+
+    dom = detect_placement_domain()
+    owner = ServingEngine(cfg, params, sc).start()
+    colds = {rung: ServingEngine(cfg, params, sc).start()
+             for rung in ("device", "shm", "wire", "reprefill")}
+    s_own = serve(owner, port=0, device_domain=dom)
+    own_url = f"http://127.0.0.1:{s_own.server_address[1]}"
+    servers = {}
+    for rung in ("device", "shm", "wire"):
+        servers[rung] = serve(colds[rung], port=0, device_domain=dom)
+    try:
+        for e in (owner, *colds.values()):
+            e.submit(warm, max_new_tokens=2).result(timeout=1800)
+        owner.submit(warm_pull, max_new_tokens=2).result(timeout=1800)
+        owner.submit(prompt, max_new_tokens=2).result(timeout=1800)
+        baseline_s = ttft_of(colds["reprefill"], prompt)
+        _emit({"metric": "kv_fabric_cold_prefill_ttft_ms",
+               "value": round(baseline_s * 1e3, 2), "unit": "ms",
+               "prompt_tokens": plen, "model": cfg.name,
+               "backend": jax.default_backend()})
+        rung_plans = (("device", dom, True),
+                      ("shm", dom, False),
+                      ("wire", "slice:elsewhere:far-host", False))
+        ratios = {}
+        for rung, owner_domain, on_bus in rung_plans:
+            if on_bus:
+                BUS.register(own_url, owner, dom)
+            try:
+                cold = colds[rung]
+                cold_url = (f"http://127.0.0.1:"
+                            f"{servers[rung].server_address[1]}")
+                # warm this rung's whole machinery (export gather,
+                # adopt scatter, the prefix-hit decode) out of the
+                # timings with a prompt only the OWNER holds — the
+                # baseline's prefill/decode jits got the same
+                # treatment above
+                _, w_out = fetch(cold_url, own_url, owner_domain,
+                                 warm_pull)
+                if w_out.get("ok"):
+                    ttft_of(cold, warm_pull)
+                pull_s, out = fetch(cold_url, own_url, owner_domain,
+                                    prompt)
+                if not out.get("ok") or out.get("path") != rung:
+                    _emit({"metric": "kv_fabric_pull_ttft_ms",
+                           "rung": rung, "value": None,
+                           "error": f"pull landed on "
+                                    f"{out.get('path') or out}"})
+                    continue
+                ttft_s = pull_s + ttft_of(cold, prompt)
+                ratios[rung] = baseline_s / ttft_s
+                _emit({"metric": "kv_fabric_pull_ttft_ms", "rung": rung,
+                       "value": round(ttft_s * 1e3, 2), "unit": "ms",
+                       "pull_ms": round(pull_s * 1e3, 2),
+                       "pages": out["pages"],
+                       "covered_tokens": out["covered_tokens"],
+                       "prompt_tokens": plen, "model": cfg.name,
+                       "backend": jax.default_backend()})
+            finally:
+                if on_bus:
+                    BUS.unregister(own_url)
+        for rung, ratio in ratios.items():
+            _emit({"metric": "kv_fabric_pull_speedup", "rung": rung,
+                   "value": round(ratio, 3), "unit": "x",
+                   "note": "cold re-prefill TTFT / (pull hop + TTFT); "
+                           ">1 means the directory pull paid for itself",
+                   "backend": jax.default_backend()})
+    finally:
+        s_own.shutdown()
+        for httpd in servers.values():
+            httpd.shutdown()
+        owner.stop()
+        for e in colds.values():
+            e.stop()
     return 0
 
 
@@ -2375,6 +2547,14 @@ def _handoff_path_smoke_lines() -> list | None:
     return _cpu_smoke_lines("--handoff-path")
 
 
+def _kv_fabric_smoke_lines() -> list | None:
+    """The ISSUE 16 directory-pull cell on CPU (see _cpu_smoke_lines):
+    per-rung pull TTFT vs cold re-prefill through the real /kv_fetch
+    ladder — tiny geometry, but the mechanism (match-only export, shm
+    blob transport, downgrade discipline) is the one the chip runs."""
+    return _cpu_smoke_lines("--kv-fabric", timeout_s=900)
+
+
 def _paged_tp_smoke_lines() -> list | None:
     """The ISSUE 12 TP paged-decode cell on CPU (see _cpu_smoke_lines):
     paged-vs-contiguous mesh decode step time at tp=2 over virtual
@@ -2427,6 +2607,7 @@ def orchestrate(quick: bool) -> int:
     smoke = None if quick else _disagg_smoke_lines()
     chunked_smoke = None if quick else _chunked_smoke_lines()
     handoff_smoke = None if quick else _handoff_path_smoke_lines()
+    kv_fabric_smoke = None if quick else _kv_fabric_smoke_lines()
     paged_tp_smoke = None if quick else _paged_tp_smoke_lines()
     session = _session_tpu_headline()
     if session is not None:
@@ -2440,6 +2621,8 @@ def orchestrate(quick: bool) -> int:
             session["chunked_cpu_smoke"] = chunked_smoke
         if handoff_smoke is not None:
             session["handoff_path_cpu_smoke"] = handoff_smoke
+        if kv_fabric_smoke is not None:
+            session["kv_fabric_cpu_smoke"] = kv_fabric_smoke
         if paged_tp_smoke is not None:
             session["paged_tp_cpu_smoke"] = paged_tp_smoke
         if not quick:
@@ -2468,6 +2651,8 @@ def orchestrate(quick: bool) -> int:
             line["chunked_cpu_smoke"] = chunked_smoke
         if handoff_smoke is not None:
             line["handoff_path_cpu_smoke"] = handoff_smoke
+        if kv_fabric_smoke is not None:
+            line["kv_fabric_cpu_smoke"] = kv_fabric_smoke
         if paged_tp_smoke is not None:
             line["paged_tp_cpu_smoke"] = paged_tp_smoke
         if not quick:
@@ -2683,6 +2868,8 @@ def main() -> int:
         return run_chunked_bench(smoke="--smoke" in sys.argv)
     if "--handoff-path" in sys.argv:
         return run_handoff_path_bench(smoke="--smoke" in sys.argv)
+    if "--kv-fabric" in sys.argv:
+        return run_kv_fabric_bench(smoke="--smoke" in sys.argv)
     if "--ring-flash" in sys.argv:
         return run_ring_flash_check()
     if "--spec-drift" in sys.argv:

@@ -133,6 +133,19 @@ class Config:
     # same-slice ICI transport set it per pool.
     fleet_device_transfer_enabled: bool = True
     fleet_placement_domain: str = ""
+    # KV fabric (ISSUE 16): the fleet-wide prefix directory + pull hop.
+    # fleet_placement_domain_mode governs auto-detection when no explicit
+    # domain is set: "auto" prefers the gang scheduler's slice identity
+    # (TPU_SLICE_NAME, host-qualified for the shm rung) and falls back to
+    # proc:<host>:<pid>; "slice" warns when the slice identity is missing;
+    # "proc" pins the ISSUE 11 one-process-per-domain behavior.
+    # fleet_prefix_broadcast restores the pre-directory /prefix fan-out
+    # (register on EVERY ready replica up front) for operators who prefer
+    # eager replication over lazy pulls.
+    fleet_prefix_directory_enabled: bool = True
+    fleet_pull_timeout_s: float = 10.0      # one pull hop, export->adopt
+    fleet_placement_domain_mode: str = "auto"
+    fleet_prefix_broadcast: bool = False
 
     # training telemetry (ISSUE 5). telemetry_port is a gang COORDINATION
     # var: injected into every worker's env (TPU_TELEMETRY_PORT +
@@ -319,6 +332,12 @@ class Config:
                         "(0 = signal off)")
         if self.fleet_handoff_timeout_s <= 0:
             errs.append("fleet_handoff_timeout_s must be > 0")
+        if self.fleet_pull_timeout_s <= 0:
+            errs.append("fleet_pull_timeout_s must be > 0")
+        if self.fleet_placement_domain_mode not in ("auto", "proc", "slice"):
+            errs.append(f"fleet_placement_domain_mode must be "
+                        f"auto/proc/slice, got "
+                        f"{self.fleet_placement_domain_mode!r}")
         if not 0 <= self.telemetry_port <= 65535:
             errs.append("telemetry_port must be in [0, 65535] (0 = off)")
         if self.straggler_factor <= 1.0:
@@ -397,6 +416,10 @@ _ENV_MAP = {
     "TPU_FLEET_HANDOFF_TIMEOUT_S": "fleet_handoff_timeout_s",
     "TPU_FLEET_DEVICE_TRANSFER_ENABLED": "fleet_device_transfer_enabled",
     "TPU_FLEET_PLACEMENT_DOMAIN": "fleet_placement_domain",
+    "TPU_FLEET_PREFIX_DIRECTORY_ENABLED": "fleet_prefix_directory_enabled",
+    "TPU_FLEET_PULL_TIMEOUT_S": "fleet_pull_timeout_s",
+    "TPU_FLEET_PLACEMENT_DOMAIN_MODE": "fleet_placement_domain_mode",
+    "TPU_FLEET_PREFIX_BROADCAST": "fleet_prefix_broadcast",
     "TPU_TELEMETRY_PORT": "telemetry_port",
     "TPU_STRAGGLER_FACTOR": "straggler_factor",
     "TPU_STALL_TIMEOUT_S": "stall_timeout_s",

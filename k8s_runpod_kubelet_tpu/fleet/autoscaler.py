@@ -47,7 +47,10 @@ SERVING_PASSTHROUGH_ENV = ("TPU_KV_PAGE_TOKENS", "TPU_KV_POOL_PAGES",
                            "TPU_SERVING_CHUNK_TOKENS",
                            "TPU_HANDOFF_STREAM_WINDOW",
                            "TPU_FLEET_DEVICE_TRANSFER_ENABLED",
-                           "TPU_FLEET_PLACEMENT_DOMAIN")
+                           "TPU_FLEET_PLACEMENT_DOMAIN",
+                           "TPU_FLEET_PREFIX_DIRECTORY_ENABLED",
+                           "TPU_FLEET_PULL_TIMEOUT_S",
+                           "TPU_FLEET_PLACEMENT_DOMAIN_MODE")
 
 
 @dataclasses.dataclass

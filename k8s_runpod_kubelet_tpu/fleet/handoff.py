@@ -58,6 +58,13 @@ class HandoffError(ValueError):
     failed handoff and fall back to a full prefill."""
 
 
+class KVPullMiss(HandoffError):
+    """A /kv_pull export found the owner's trie no longer holds the
+    requested page run (evicted since the directory publish). The door
+    answers 404 {"gone": true}; the router invalidates the directory
+    entry and the puller falls back to prefill — one miss, no retry."""
+
+
 def _dtype(name: str) -> np.dtype:
     try:
         return np.dtype(name)

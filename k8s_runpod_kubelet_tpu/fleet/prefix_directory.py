@@ -1,0 +1,241 @@
+"""Global prefix directory (ISSUE 16): the fleet-level map from prefix
+keys to the replicas whose arenas hold those pages.
+
+PR 11 left every replica's prefix trie an island: the router's rendezvous
+affinity was the ONLY mechanism keeping a conversation near its cached
+KV, and a flash crowd spilling over affinity re-prefilled the same system
+prompt on every replica it touched. The directory makes cached KV a
+fleet-wide asset: replicas publish the page-aligned prefixes they hold
+(on trie insert, carried by their heartbeats), the router consults the
+directory when the replica it picked is not a holder, and plans a PULL
+hop — the cold replica fetches the page run from a holder over the
+fastest reachable rung instead of recomputing it. Rendezvous affinity
+becomes an optimization, not a correctness crutch.
+
+**Keys.** A prefix key is an incremental SHA-256 over the page-sized
+token chunks of a prompt, seeded with the page size and the adapter
+root (``prefix_key_chain``). Both sides of the fabric compute it
+identically: the engine keys what it inserts, the router keys the
+request it is about to route — chunk hashing makes every page boundary
+of a longer prompt yield the key a shorter cached prefix published
+under, so one published key serves every request that extends it. The
+MODEL is deliberately NOT in the key: the router does not know the
+fleet's model name, so entries carry it as data instead and the pull
+doors reject cross-model adoption exactly like ``adopt_handoff`` does
+(``deserialize_pages``' expect_model, twice: once at the export door,
+once at adoption).
+
+**Lifecycle.** publish (trie insert / adoption, via heartbeat) → hit
+(router lookup on a directory-keyed request) → invalidate (a pull that
+came back GONE — the holder's trie evicted the pages since publish — or
+the holder leaving the fleet: eviction, drain, deregistration drop ALL
+of a replica's entries in the same registry transaction). Entries are a
+bounded LRU: the directory is a routing cache over heartbeat-refreshed
+claims, never the source of truth — a stale entry costs one failed pull
+that falls back to prefill, nothing worse.
+
+Thread-safe, clock-injected, numpy/jax-free: it lives in the registry
+tier next to ReplicaRegistry and must be importable by tier-1 tests and
+the router without a device runtime.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Optional
+
+_KEY_SEED = "tpukvf1"
+
+
+def prefix_key_chain(tokens: list, page_tokens: int,
+                     adapter: str = "") -> list[str]:
+    """One key per FULL-page boundary of ``tokens``, shortest first:
+    ``keys[i]`` covers pages ``0..i`` (``(i + 1) * page_tokens`` tokens).
+    Incremental hashing means a prompt's chain contains, as a prefix,
+    the chain of every shorter prompt it extends — so a holder
+    publishing its run's LONGEST key is findable from any longer
+    request's chain. The seed binds page size and adapter root: a
+    fleet re-paged at a different granule (or another adapter's
+    variant pages) can never alias."""
+    if page_tokens < 1:
+        raise ValueError(f"page_tokens must be >= 1, got {page_tokens}")
+    h = hashlib.sha256(f"{_KEY_SEED}|{page_tokens}|{adapter}".encode())
+    keys = []
+    for start in range(0, len(tokens) - page_tokens + 1, page_tokens):
+        chunk = tokens[start:start + page_tokens]
+        h.update(",".join(str(int(t)) for t in chunk).encode())
+        keys.append(h.copy().hexdigest()[:32])
+    return keys
+
+
+def prefix_key(tokens: list, page_tokens: int, adapter: str = "") -> str:
+    """The longest-boundary key of ``tokens`` (what a holder publishes
+    for an inserted run); "" when the run is shorter than one page."""
+    chain = prefix_key_chain(tokens, page_tokens, adapter)
+    return chain[-1] if chain else ""
+
+
+class _Entry:
+    __slots__ = ("pages", "model", "adapter", "holders")
+
+    def __init__(self, pages: int, model: str, adapter: str):
+        self.pages = pages
+        self.model = model
+        self.adapter = adapter
+        self.holders: dict[str, float] = {}   # replica_id -> published_at
+
+    def to_dict(self) -> dict:
+        return {"pages": self.pages, "model": self.model,
+                "adapter": self.adapter,
+                "holders": sorted(self.holders)}
+
+
+class PrefixDirectory:
+    """Bounded-LRU prefix-key -> {holders, pages, model, adapter-root}
+    map. ``publish`` upserts a holder claim, ``lookup`` walks a request's
+    key chain longest-first to the first entry with a holder,
+    ``invalidate`` drops ONE holder claim (a pull that came back gone),
+    ``drop_replica`` drops every claim a departing replica made — the
+    registry calls it inside evict/deregister/drain so directory and
+    membership can never disagree for longer than one call."""
+
+    def __init__(self, metrics=None, max_entries: int = 4096,
+                 clock: Callable[[], float] = time.monotonic):
+        if max_entries < 1:
+            raise ValueError("max_entries must be >= 1")
+        self.metrics = metrics
+        self.max_entries = max_entries
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
+        if metrics is not None:
+            self._describe(metrics)
+            # scrape-from-start: the series exist before the first publish
+            metrics.set_gauge("tpu_fleet_prefix_directory_entries", 0)
+            metrics.incr("tpu_fleet_prefix_directory_hits", 0)
+            metrics.incr("tpu_fleet_prefix_directory_invalidations", 0,
+                         labels={"reason": "gone"})
+
+    @staticmethod
+    def _describe(m):
+        m.describe("tpu_fleet_prefix_directory_entries",
+                   "prefix keys the global directory currently maps to at "
+                   "least one holder replica")
+        m.describe("tpu_fleet_prefix_directory_hits",
+                   "directory lookups that found a published entry for the "
+                   "request's prefix chain")
+        m.describe("tpu_fleet_prefix_directory_invalidations",
+                   "holder claims dropped from the directory (labels: "
+                   "reason=gone|departed — gone: a pull found the holder's "
+                   "trie no longer has the pages; departed: the holder was "
+                   "evicted/drained/deregistered)")
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def _gauge(self):
+        if self.metrics is not None:
+            self.metrics.set_gauge("tpu_fleet_prefix_directory_entries",
+                                   len(self._entries))
+
+    def publish(self, replica_id: str, publishes: list) -> int:
+        """Upsert holder claims. Each publish is a dict with ``key``
+        (required), ``pages``, ``model``, ``adapter``. Returns how many
+        claims landed; malformed items are skipped (heartbeats carry
+        these — one bad item must not poison the beat)."""
+        if not replica_id:
+            return 0
+        now = self.clock()
+        landed = 0
+        with self._lock:
+            for pub in publishes or []:
+                if not isinstance(pub, dict):
+                    continue
+                key = pub.get("key")
+                if not isinstance(key, str) or not key:
+                    continue
+                entry = self._entries.get(key)
+                if entry is None:
+                    entry = self._entries[key] = _Entry(
+                        int(pub.get("pages") or 0),
+                        str(pub.get("model") or ""),
+                        str(pub.get("adapter") or ""))
+                entry.holders[replica_id] = now
+                self._entries.move_to_end(key)
+                landed += 1
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+            self._gauge()
+        return landed
+
+    def lookup(self, keys: list) -> Optional[tuple[str, dict]]:
+        """First entry (with at least one holder) along ``keys`` — the
+        caller passes the request's chain LONGEST-FIRST so the deepest
+        cached prefix wins. Returns (key, entry dict with ``holders`` as
+        a sorted list) or None; a hit counts the hits series and
+        refreshes the entry's LRU position."""
+        with self._lock:
+            for key in keys or []:
+                entry = self._entries.get(key)
+                if entry is not None and entry.holders:
+                    self._entries.move_to_end(key)
+                    out = (key, entry.to_dict())
+                    break
+            else:
+                return None
+        if self.metrics is not None:
+            self.metrics.incr("tpu_fleet_prefix_directory_hits")
+        return out
+
+    def invalidate(self, key: str, replica_id: str,
+                   reason: str = "gone") -> bool:
+        """Drop ONE holder claim (the pull found it stale); the entry
+        itself dies with its last holder. Returns whether a claim was
+        actually dropped (idempotent — a raced double-invalidate must
+        not double-count)."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None or replica_id not in entry.holders:
+                return False
+            del entry.holders[replica_id]
+            if not entry.holders:
+                del self._entries[key]
+            self._gauge()
+        if self.metrics is not None:
+            self.metrics.incr("tpu_fleet_prefix_directory_invalidations",
+                              labels={"reason": reason})
+        return True
+
+    def drop_replica(self, replica_id: str) -> int:
+        """Drop EVERY claim ``replica_id`` holds — the registry's
+        evict/deregister/drain transaction. Returns claims dropped;
+        counted under reason=departed."""
+        dropped = 0
+        with self._lock:
+            dead = []
+            for key, entry in self._entries.items():
+                if replica_id in entry.holders:
+                    del entry.holders[replica_id]
+                    dropped += 1
+                    if not entry.holders:
+                        dead.append(key)
+            for key in dead:
+                del self._entries[key]
+            self._gauge()
+        if dropped and self.metrics is not None:
+            self.metrics.incr("tpu_fleet_prefix_directory_invalidations",
+                              dropped, labels={"reason": "departed"})
+        return dropped
+
+    def snapshot(self) -> dict:
+        """The /debug/fleet ``directory`` payload: every entry with its
+        holders (bounded by max_entries, so this is scrape-safe)."""
+        with self._lock:
+            return {"entries": {k: e.to_dict()
+                                for k, e in self._entries.items()},
+                    "size": len(self._entries),
+                    "max_entries": self.max_entries}

@@ -169,10 +169,17 @@ class ReplicaRegistry:
                  transport_factory=None,
                  breaker_failure_threshold: int = 3,
                  breaker_reset_s: float = 10.0,
-                 request_timeout_s: float = 120.0):
+                 request_timeout_s: float = 120.0,
+                 directory=None):
         self.metrics = metrics
         self.tracer = tracer
         self.clock = clock
+        # global prefix directory (ISSUE 16): membership changes and the
+        # directory's holder claims move together — evict/deregister/
+        # drain drop a replica's claims in the same call, so the router
+        # can never plan a pull against a replica the registry just
+        # declared dead
+        self.directory = directory
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.probe_fn = probe_fn or _default_probe
         self._breaker_failure_threshold = breaker_failure_threshold
@@ -250,9 +257,13 @@ class ReplicaRegistry:
                  role, base_url)
         return rep
 
-    def heartbeat(self, replica_id: str, stats: dict) -> bool:
+    def heartbeat(self, replica_id: str, stats: dict,
+                  prefixes: Optional[list] = None) -> bool:
         """Returns False for an unknown id — the replica should
-        re-register (it was evicted, or the router restarted)."""
+        re-register (it was evicted, or the router restarted).
+        ``prefixes`` is the beat's piggybacked prefix-directory publish
+        batch (ISSUE 16) — accepted only from a READY replica; a
+        draining one is leaving, so its claims drop instead."""
         with self._lock:
             rep = self._replicas.get(replica_id)
             if rep is None:
@@ -266,6 +277,12 @@ class ReplicaRegistry:
             # (503s that poison its breaker and trip spurious evictions)
             if rep.stats.draining:
                 rep.state = DRAINING
+            state = rep.state
+        if self.directory is not None:
+            if state == DRAINING:
+                self.directory.drop_replica(replica_id)
+            elif prefixes:
+                self.directory.publish(replica_id, prefixes)
         self._update_gauges()
         return True
 
@@ -277,6 +294,10 @@ class ReplicaRegistry:
             rep = self._replicas.get(replica_id)
             if rep is not None:
                 rep.state = DRAINING
+        if self.directory is not None:
+            # a draining replica is leaving: pulls planned against it
+            # would race its exit, so its holder claims drop NOW
+            self.directory.drop_replica(replica_id)
         self._update_gauges()
 
     def registered_pod_names(self) -> set[str]:
@@ -286,6 +307,8 @@ class ReplicaRegistry:
     def deregister(self, replica_id: str) -> bool:
         with self._lock:
             rep = self._replicas.pop(replica_id, None)
+        if self.directory is not None:
+            self.directory.drop_replica(replica_id)
         if rep is not None and self.metrics is not None:
             self.metrics.incr("tpu_fleet_deregistered")
         self._update_gauges()
@@ -297,6 +320,11 @@ class ReplicaRegistry:
         now = self.clock()
         with self._lock:
             rep = self._replicas.pop(replica_id, None)
+        if self.directory is not None:
+            # same-transaction consistency (ISSUE 16): the moment the
+            # fleet declares a replica dead, its directory claims die
+            # too — no pull can be planned against a corpse
+            self.directory.drop_replica(replica_id)
         if rep is None:
             return False
         log.warning("fleet: evicting replica %s (%s)", replica_id, reason)
@@ -424,8 +452,16 @@ class ReplicaReporter:
         self.interval_s = interval_s
         self._post = post_fn or self._http_post
         self._stop = threading.Event()
+        # prefix-directory publish wake (ISSUE 16): the engine's
+        # prefix_publish_hook sets this so a fresh trie insert reaches
+        # the directory on the NEXT beat, not up to one interval later
+        self._wake = threading.Event()
         self._thread = threading.Thread(target=self._loop,
                                         name="fleet-reporter", daemon=True)
+
+    def wake(self):
+        """Engine-side publish hook target: schedule an early beat."""
+        self._wake.set()
 
     def _http_post(self, path: str, payload: dict):
         import json as _json
@@ -523,9 +559,21 @@ class ReplicaReporter:
             except Exception as e:  # noqa: BLE001 — best-effort goodbye
                 log.warning("fleet: deregister failed: %s", e)
             return False
-        out = self._post("/fleet/heartbeat",
-                         {"replica_id": self.replica_id,
-                          "stats": self.stats()})
+        # piggyback pending prefix-directory publishes (ISSUE 16):
+        # pending-until-acked — a failed beat puts them back so the
+        # directory eventually hears about every inserted run
+        take = getattr(self.engine, "take_prefix_publishes", None)
+        pubs = take() if take is not None else []
+        body = {"replica_id": self.replica_id, "stats": self.stats()}
+        if pubs:
+            body["prefixes"] = pubs
+        try:
+            out = self._post("/fleet/heartbeat", body)
+        except Exception:
+            requeue = getattr(self.engine, "requeue_prefix_publishes", None)
+            if pubs and requeue is not None:
+                requeue(pubs)
+            raise
         if isinstance(out, dict) and out.get("registered") is False:
             self.register()
         return True
@@ -538,7 +586,10 @@ class ReplicaReporter:
             except Exception as e:  # noqa: BLE001 — router may be briefly down
                 log.warning("fleet: heartbeat to %s failed: %s",
                             self.router_url, e)
-            self._stop.wait(self.interval_s)
+            # interval sleep, interruptible by stop() AND by the engine's
+            # publish hook (wake()) so fresh prefixes beat immediately
+            self._wake.wait(self.interval_s)
+            self._wake.clear()
 
     def start(self) -> "ReplicaReporter":
         try:
@@ -551,5 +602,6 @@ class ReplicaReporter:
 
     def stop(self):
         self._stop.set()
+        self._wake.set()  # break the interval wait immediately
         if self._thread.is_alive():
             self._thread.join(timeout=5)

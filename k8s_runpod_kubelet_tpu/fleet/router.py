@@ -74,6 +74,20 @@ class RouterConfig:
     # downgrades to the wire codec itself on any device-path failure.
     # False = every hop rides the wire.
     device_transfer_enabled: bool = True
+    # fleet-wide KV fabric (ISSUE 16): consult the global prefix
+    # directory on token-prompt requests and plan a pull hop — the
+    # picked replica fetches the cached page run from a holder instead
+    # of re-prefilling. The pull is strictly an optimization; any
+    # failure falls back to the normal prefill path.
+    prefix_directory_enabled: bool = True
+    # budget for one /kv_fetch pull hop (lookup is in-process and free)
+    pull_timeout_s: float = 10.0
+    # legacy /prefix fan-out (pre-directory): register on EVERY replica
+    # up front instead of one replica + lazy pulls
+    prefix_broadcast: bool = False
+    # page granule for router-side prefix keys — MUST match the fleet's
+    # ServingConfig.kv_page_tokens or directory keys never match
+    kv_page_tokens: int = 16
 
 
 def affinity_key_for(path: str, body: dict, prefix_chars: int = 64,
@@ -110,12 +124,17 @@ class FleetRouter:
 
     def __init__(self, registry: ReplicaRegistry, cfg: RouterConfig = None,
                  metrics=None, tracer: Optional[Tracer] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 directory=None):
         self.registry = registry
         self.cfg = cfg or RouterConfig()
         self.metrics = metrics
         self.tracer = tracer if tracer is not None else Tracer()
         self.clock = clock
+        # global prefix directory (ISSUE 16) — router_main wires the
+        # SAME instance into the registry (publish/evict) and here
+        # (lookup/invalidate); None = directory routing off
+        self.directory = directory
         if metrics is not None:
             self._describe(metrics)
             # scrape-from-start: the dashboards' series must exist before
@@ -245,6 +264,119 @@ class FleetRouter:
             self.metrics.incr("tpu_fleet_requests",
                               labels={"outcome": outcome})
 
+    # -- directory pull hop (ISSUE 16) -----------------------------------------
+
+    @staticmethod
+    def _token_prompt(path: str, body: dict) -> Optional[list]:
+        """The request's prompt as a TOKEN list, or None. The router has
+        no tokenizer, so directory keys (token-space hashes) are only
+        computable for routes that carry tokens directly; text prompts
+        keep riding rendezvous affinity + local prefill (documented
+        limitation in the README)."""
+        if not isinstance(body, dict):
+            return None
+        if path == "/generate":
+            prompt = body.get("tokens")
+        elif path == "/v1/completions":
+            prompt = body.get("prompt")
+        else:
+            return None
+        if (isinstance(prompt, list) and prompt
+                and all(isinstance(t, int) for t in prompt)):
+            return prompt
+        return None
+
+    def maybe_pull(self, path: str, payload: dict, replica: Replica,
+                   trace: dict) -> None:
+        """Directory-planned pull hop: when the replica about to serve
+        this request is NOT a holder of its longest cached prefix, ask it
+        (POST /kv_fetch) to fetch the page run from a holder over the
+        fastest reachable rung before the forward lands — adoption
+        instead of re-prefill. Strictly best-effort and never raises: a
+        miss, a gone (holder evicted since publish — invalidate the
+        claim, no retry), or any transport failure just leaves the
+        request on its normal prefill path. One fleet.directory_lookup
+        span per consulted request records the outcome."""
+        if (not self.cfg.prefix_directory_enabled
+                or self.directory is None or replica is None):
+            return
+        tokens = self._token_prompt(path, payload)
+        if not tokens or len(tokens) < self.cfg.kv_page_tokens:
+            return
+        adapter = str(payload.get("adapter") or "")
+        started = self.clock()
+        span_id = Tracer.new_span_id()
+        outcome, hit_key, owner_id, pull_path, pages = "miss", "", "", "", 0
+        try:
+            from .prefix_directory import prefix_key_chain
+            chain = prefix_key_chain(tokens, self.cfg.kv_page_tokens,
+                                     adapter)
+            # longest-first: the deepest cached prefix wins
+            found = self.directory.lookup(list(reversed(chain)))
+            if found is None:
+                return
+            hit_key, entry = found
+            holders = set(entry.get("holders") or [])
+            if replica.replica_id in holders:
+                outcome = "local"  # the pick already holds the pages
+                return
+            ready = {r.replica_id: r for r in self.registry.ready()}
+            owners = [ready[h] for h in sorted(holders) if h in ready]
+            if not owners:
+                outcome = "no_owner"
+                return
+            # prefer a same-domain holder: the pull can then ride the
+            # device/shm rungs instead of the wire
+            domain = replica.placement_domain
+            owner = next((o for o in owners
+                          if domain and o.placement_domain == domain),
+                         owners[0])
+            owner_id = owner.replica_id
+            out = replica.transport.request(
+                "POST", "/kv_fetch",
+                body={"tokens": tokens, "adapter": adapter,
+                      "owner_url": owner.base_url,
+                      "owner_domain": owner.placement_domain,
+                      "model": str(entry.get("model") or "")},
+                timeout_s=self.cfg.pull_timeout_s,
+                extra_headers={"traceparent": format_traceparent(
+                    trace["trace_id"], span_id)})
+            if isinstance(out, dict) and out.get("ok"):
+                outcome = "pulled"
+                pull_path = str(out.get("path") or "")
+                pages = int(out.get("pages") or 0)
+            elif isinstance(out, dict) and out.get("gone"):
+                # the holder's trie evicted the run since its publish:
+                # drop THAT claim and fall back to prefill — one miss,
+                # one invalidation, no retry storm
+                outcome = "gone"
+                self.directory.invalidate(hit_key, owner_id,
+                                          reason="gone")
+            else:
+                outcome = "failed"
+        except (CircuitOpenError, TransportError) as e:
+            outcome = "failed"
+            log.debug("fleet: pull hop to %s failed: %s",
+                      replica.replica_id, e)
+        except Exception:  # noqa: BLE001 — a pull must never fail a request
+            outcome = "failed"
+            log.exception("fleet: directory pull planning failed")
+        finally:
+            dur = self.clock() - started
+            end = self.tracer.clock()
+            try:
+                self.tracer.record(
+                    "fleet.directory_lookup", end - dur, end,
+                    trace_id=trace["trace_id"], span_id=span_id,
+                    parent_id=trace["span_id"],
+                    attrs={"outcome": outcome, "key": hit_key,
+                           "owner": owner_id,
+                           "replica_id": replica.replica_id,
+                           "path": pull_path, "pages": pages})
+            except Exception:  # noqa: BLE001 — tracing must never fail a request
+                log.exception("fleet.directory_lookup span recording "
+                              "failed")
+
     # -- disaggregated two-hop (ISSUE 9) ---------------------------------------
 
     def plan_two_hop(self, path: str, payload: dict, key: str,
@@ -283,7 +415,12 @@ class FleetRouter:
                 "POST", "/kv_prefill",
                 body={"path": path, "request": payload,
                       "handoff_to": decode_rep.base_url,
-                      "device": device_ok},
+                      "device": device_ok,
+                      # the hop's shared placement domain: on a bus miss
+                      # the sender cannot see the peer's domain locally,
+                      # and the cross-process shm rung needs to know the
+                      # target is the same host (ISSUE 16)
+                      "device_domain": domain if device_ok else ""},
                 timeout_s=self.cfg.handoff_timeout_s,
                 extra_headers={"traceparent": format_traceparent(
                     trace["trace_id"], span_id)})
@@ -389,6 +526,12 @@ class FleetRouter:
                 break
             attempts += 1
             tried.add(replica.replica_id)
+            if attempts == 1 and reason != "two_hop":
+                # directory pull (ISSUE 16): give a cold pick the chance
+                # to adopt this prompt's cached pages from a holder
+                # before the forward lands (two-hop decode replicas just
+                # adopted via the handoff — nothing to pull)
+                self.maybe_pull(path, payload, replica, trace)
             try:
                 out = replica.transport.request(
                     "POST", path, body=payload,
@@ -490,6 +633,11 @@ class FleetRouter:
                 break
             attempts += 1
             tried.add(replica.replica_id)
+            if attempts == 1 and reason != "two_hop":
+                # same pre-forward pull chance as forward() — streamed
+                # requests re-prefill identically without it
+                self.maybe_pull(path, self._safe_json(raw_body), replica,
+                                trace)
             breaker = replica.transport.breaker
             if breaker is not None and not breaker.allow():
                 continue
@@ -608,7 +756,10 @@ class _RouterHandler(BaseHTTPRequestHandler):
             return self._send(200, rt.metrics.render().encode(),
                               "text/plain; version=0.0.4")
         if url.path == "/debug/fleet":
-            return self._send(200, rt.registry.snapshot())
+            snap = rt.registry.snapshot()
+            if rt.directory is not None:
+                snap["directory"] = rt.directory.snapshot()
+            return self._send(200, snap)
         if url.path == "/debug/traces":
             q = urllib.parse.parse_qs(url.query)
             return self._send(200, rt.tracer.query(
@@ -656,7 +807,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
         if self.path == "/fleet/heartbeat":
             try:
                 ok = rt.registry.heartbeat(str(body.get("replica_id") or ""),
-                                           body.get("stats") or {})
+                                           body.get("stats") or {},
+                                           prefixes=body.get("prefixes"))
             except (TypeError, ValueError) as e:
                 return self._send(400, {"error": f"bad stats: {e}"})
             # registered:false tells the replica to re-register (evicted,
@@ -666,7 +818,10 @@ class _RouterHandler(BaseHTTPRequestHandler):
             rt.registry.deregister(str(body.get("replica_id") or ""))
             return self._send(200, {"ok": True})
         if self.path == "/prefix":
-            return self._broadcast_prefix(body)
+            if (rt.cfg.prefix_broadcast or rt.directory is None
+                    or not rt.cfg.prefix_directory_enabled):
+                return self._broadcast_prefix(body)
+            return self._register_prefix(body)
         if self.path not in _FORWARD_ROUTES:
             return self._send(404, {"error": f"no route {self.path}"})
         trace = rt.trace_ctx(self.headers.get("traceparent"))
@@ -674,6 +829,36 @@ class _RouterHandler(BaseHTTPRequestHandler):
             return self._relay_stream(self.path, raw, trace)
         status, out, headers = rt.forward(self.path, body, trace)
         return self._send(status, out, extra_headers=headers)
+
+    def _register_prefix(self, body: dict):
+        """Directory-backed /prefix (ISSUE 16): register the prefix on
+        ONE replica (failing over through the ready set) instead of
+        fanning out N POSTs. The replica's trie insert publishes the
+        prefix to the global directory on its next heartbeat, and every
+        other replica adopts the pages lazily — a directory-planned pull
+        on its first matching request. The old fan-out stays available
+        behind --prefix-broadcast."""
+        rt = self.router
+        tried: set = set()
+        errors: dict = {}
+        for _ in range(max(1, rt.cfg.max_attempts)):
+            rep, _reason = rt.pick("", exclude=frozenset(tried))
+            if rep is None:
+                break
+            tried.add(rep.replica_id)
+            try:
+                rep.transport.request("POST", "/prefix", body=body,
+                                      timeout_s=rt.cfg.request_timeout_s)
+                return self._send(200, {"mode": "directory",
+                                        "registered_on": rep.replica_id,
+                                        "errors": errors or None})
+            except (TransportError, CircuitOpenError) as e:
+                errors[rep.replica_id] = str(e)
+        if not errors:
+            return self._send(503, {"error": "no ready replicas"})
+        return self._send(502, {"error": "prefix registration failed on "
+                                         "every attempted replica",
+                                "errors": errors})
 
     def _broadcast_prefix(self, body: dict):
         """Prefix registration fans out to EVERY replica: the affinity

@@ -85,6 +85,24 @@ def parse_flags(argv):
                         "device-native KV handoff (arena-to-arena, zero "
                         "host copies); off = every hop rides the wire "
                         "codec")
+    p.add_argument("--prefix-directory", default=None, choices=["on", "off"],
+                   dest="fleet_prefix_directory_enabled",
+                   help="run the fleet-wide KV prefix directory (ISSUE 16): "
+                        "replicas publish their cached prefix keys via "
+                        "heartbeats and the router plans PULL hops — a "
+                        "cold replica fetches matched pages from the "
+                        "owning replica instead of re-prefilling; off = "
+                        "routing only, no directory")
+    p.add_argument("--pull-timeout", dest="fleet_pull_timeout_s",
+                   type=float, default=None,
+                   help="budget for one directory-pull hop (owner export "
+                        "+ transfer + adoption); past it the request "
+                        "just re-prefills")
+    p.add_argument("--prefix-broadcast", default=None, choices=["on", "off"],
+                   dest="fleet_prefix_broadcast",
+                   help="restore the pre-directory POST /prefix fan-out "
+                        "(register the prefix on EVERY ready replica up "
+                        "front) instead of register-once + lazy pulls")
     p.add_argument("--scale-up-cooldown", dest="fleet_scale_up_cooldown_s",
                    type=float, default=None)
     p.add_argument("--scale-down-cooldown",
@@ -121,18 +139,28 @@ def build(cfg: config_mod.Config, kube=None, autoscale: bool = False,
     metrics = Metrics()
     tracer = Tracer(max_spans=cfg.trace_ring_size,
                     export_path=cfg.trace_export_path)
+    directory = None
+    if cfg.fleet_prefix_directory_enabled:
+        from .prefix_directory import PrefixDirectory
+        directory = PrefixDirectory(metrics=metrics)
     registry = ReplicaRegistry(
         metrics=metrics, tracer=tracer,
         heartbeat_timeout_s=cfg.fleet_heartbeat_timeout_s,
         breaker_failure_threshold=cfg.breaker_failure_threshold,
-        breaker_reset_s=cfg.breaker_reset_s)
+        breaker_reset_s=cfg.breaker_reset_s,
+        directory=directory)
     router = FleetRouter(
         registry,
         RouterConfig(port=cfg.fleet_router_port,
                      handoff_timeout_s=cfg.fleet_handoff_timeout_s,
                      device_transfer_enabled=(
-                         cfg.fleet_device_transfer_enabled)),
-        metrics=metrics, tracer=tracer)
+                         cfg.fleet_device_transfer_enabled),
+                     prefix_directory_enabled=(
+                         cfg.fleet_prefix_directory_enabled),
+                     pull_timeout_s=cfg.fleet_pull_timeout_s,
+                     prefix_broadcast=cfg.fleet_prefix_broadcast,
+                     kv_page_tokens=cfg.kv_page_tokens),
+        metrics=metrics, tracer=tracer, directory=directory)
     autoscalers = []
     if autoscale:
         from ..kube import RealKubeClient
@@ -171,11 +199,13 @@ def build(cfg: config_mod.Config, kube=None, autoscale: bool = False,
 
 def main(argv=None) -> int:
     args = parse_flags(argv if argv is not None else sys.argv[1:])
-    if args.fleet_device_transfer_enabled is not None:
+    for onoff in ("fleet_device_transfer_enabled",
+                  "fleet_prefix_directory_enabled",
+                  "fleet_prefix_broadcast"):
         # choices are "on"/"off"; config's bool coercion only knows
         # true/false/1/yes spellings
-        args.fleet_device_transfer_enabled = \
-            args.fleet_device_transfer_enabled == "on"
+        if getattr(args, onoff) is not None:
+            setattr(args, onoff, getattr(args, onoff) == "on")
     known = {f.name for f in dataclasses.fields(config_mod.Config)}
     overrides = {k: v for k, v in vars(args).items()
                  if v is not None and k in known}

@@ -50,6 +50,17 @@ The pod command for autoscaled inference. Endpoints:
   POST /kv_adopt_chunk  streamed adoption: one chunk frame in, buffered
                    strictly in order; the arena moves only when the final
                    frame closes a fully-valid stream (all-or-nothing)
+  POST /kv_adopt_shm  cross-process push adoption (ISSUE 16): mmap a
+                   sender-parked tmpfs blob (path-validated) and adopt it
+                   through the wire codec's validators; the sender unlinks
+  POST /kv_pull    owner side of a directory pull: export an
+                   already-computed page run match-only (404 {"gone"} when
+                   the arena evicted it) as a response blob, or as a
+                   tmpfs path for same-host pullers ("via": "shm")
+  POST /kv_fetch   cold-replica side of a directory pull: fetch a
+                   directory-matched prefix from its owner over the
+                   fastest reachable rung (device → shm → wire) and adopt
+                   it; always HTTP 200 — a failed pull just re-prefills
   POST /drain      graceful drain (fleet scale-down): stop admitting,
                    finish in-flight, then the fleet reporter deregisters
   GET  /debug/traces  recent request span trees as JSON (?trace_id= filters
@@ -69,6 +80,7 @@ import argparse
 import itertools
 import json
 import logging
+import os
 import threading
 import time
 import urllib.parse
@@ -107,6 +119,12 @@ class _Handler(BaseHTTPRequestHandler):
     # the arena-to-arena path first and DOWNGRADES to wire on any failure
     # (bus miss, domain mismatch, geometry, failed adoption).
     device_domain = ""
+    # KV-fabric pull (ISSUE 16): budget for one hop of a directory pull
+    # (owner export + transfer + adoption)
+    pull_timeout_s = 10.0
+    # owner-side GC for shm pull blobs a dead puller never unlinked
+    # (fleet/device_transfer.ShmBlobGC, bound in serve() with the domain)
+    shm_gc = None
     # clock seams, rebound by serve(clock=..., mono=...): wall time for
     # OpenAI `created` stamps / request ids, monotonic for deadlines —
     # injected so stress/soak tests drive HTTP-layer timeouts deterministically
@@ -371,7 +389,14 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 out = device_push(self.engine, target, tokens,
                                   domain=self.device_domain,
-                                  window=self.handoff_stream_window)
+                                  window=self.handoff_stream_window,
+                                  # the router's view of the hop's shared
+                                  # domain: on a bus miss, an equal domain
+                                  # means same host — the run can ride the
+                                  # cross-process shm rung (ISSUE 16)
+                                  target_domain=str(
+                                      req.get("device_domain") or ""),
+                                  timeout_s=self.request_timeout_s)
             except Exception as e:  # noqa: BLE001 — every device failure
                 # downgrades; the wire path below is the handler
                 self.engine.metrics.incr(
@@ -379,7 +404,8 @@ class _Handler(BaseHTTPRequestHandler):
                 log.warning("device handoff to %s downgraded to wire: %s",
                             target, e)
             else:
-                span(True, {"path": "device", "tokens": len(tokens),
+                span(True, {"path": out.get("path", "device"),
+                            "tokens": len(tokens),
                             "pages": out["pages"], "bytes": out["bytes"],
                             "streamed": out["streamed"],
                             "chunks": out.get("chunks"),
@@ -714,6 +740,308 @@ class _Handler(BaseHTTPRequestHandler):
         span(True, out)
         return self._send(200, {"ok": True, **out})
 
+    def _kv_adopt_shm(self):
+        """Receiver half of the cross-process PUSH rung (ISSUE 16): the
+        sender parked a handoff blob in the shm dir and POSTs only its
+        PATH; mmap it and adopt through the same deserialize_pages
+        validation the wire door runs (the codec slices an mmap like
+        bytes — zero socket payload, zero extra copies). The SENDER owns
+        the file's lifecycle (it unlinks in a finally whether or not
+        this adoption lands), so this door only closes its mapping. 400
+        on any refusal: the sender downgrades to wire."""
+        tr = self.engine.tracer
+        inbound = parse_traceparent(self.headers.get("traceparent"))
+        trace_id = inbound[0] if inbound else Tracer.new_trace_id()
+        parent = inbound[1] if inbound else ""
+        started = tr.clock()
+
+        def span(ok: bool, attrs: dict):
+            try:
+                tr.record("serving.kv_adopt", started, tr.clock(),
+                          trace_id=trace_id, parent_id=parent,
+                          attrs={"ok": ok, "path": "shm", **attrs})
+            except Exception:  # noqa: BLE001 — tracing never fails the hop
+                log.exception("serving.kv_adopt span failed")
+
+        from ..fleet.device_transfer import open_shm_blob
+        try:
+            req = self._read_json()
+            blob = open_shm_blob(str(req.get("path") or ""))
+        except Exception as e:  # noqa: BLE001 — a vanished/foreign/torn
+            # path is the sender's downgrade signal, never a crash here
+            span(False, {"error": str(e)})
+            return self._send(400, {"ok": False, "error": str(e)})
+        try:
+            out = self.engine.adopt_handoff(blob)
+        except Exception as e:  # noqa: BLE001 — adopt counts its own failures
+            span(False, {"bytes": len(blob), "error": str(e)})
+            return self._send(400, {"ok": False, "error": str(e)})
+        finally:
+            blob.close()
+        span(True, out)
+        return self._send(200, {"ok": True, **out})
+
+    def _kv_pull(self):
+        """OWNER side of a directory pull (ISSUE 16): a cold replica's
+        /kv_fetch asks this engine for an already-computed page run.
+        export_pull is MATCH-ONLY — it never prefills — so a run the
+        arena evicted answers 404 {"gone": true}: the puller reports
+        GONE, the router invalidates the directory entry, and the
+        request re-prefills (every pull rung reads this same trie —
+        walking the ladder after a miss would be a retry storm against
+        pages that no longer exist). ``via: "shm"`` parks the blob in
+        tmpfs and replies with its path (a same-host puller mmaps it and
+        unlinks after adoption; ShmBlobGC sweeps what dead pullers
+        leave); the default answers the blob in the response body
+        (wire)."""
+        tr = self.engine.tracer
+        inbound = parse_traceparent(self.headers.get("traceparent"))
+        trace_id = inbound[0] if inbound else Tracer.new_trace_id()
+        parent = inbound[1] if inbound else ""
+        started = tr.clock()
+
+        def span(ok: bool, attrs: dict):
+            try:
+                tr.record("serving.kv_pull", started, tr.clock(),
+                          trace_id=trace_id, parent_id=parent,
+                          attrs={"ok": ok, "side": "owner", **attrs})
+            except Exception:  # noqa: BLE001 — tracing never fails the hop
+                log.exception("serving.kv_pull span failed")
+
+        from ..fleet.handoff import KVPullMiss
+        try:
+            req = self._read_json()
+            tokens = req.get("tokens")
+            if not (isinstance(tokens, list)
+                    and all(isinstance(t, int) for t in tokens)):
+                raise ValueError("tokens must be a list of ints")
+            adapter = str(req.get("adapter") or "")
+            via = str(req.get("via") or "wire")
+        except (json.JSONDecodeError, ValueError, TypeError) as e:
+            span(False, {"error": str(e)})
+            return self._send(400, {"ok": False, "error": str(e)})
+        try:
+            out = self.engine.export_pull(tokens, adapter=adapter)
+        except KVPullMiss as e:
+            # NOT a failure: the run is gone — directory staleness, which
+            # the router's invalidation counter tracks, not this engine's
+            span(False, {"gone": True, "error": str(e)})
+            return self._send(404, {"ok": False, "gone": True,
+                                    "error": str(e)})
+        except Exception as e:  # noqa: BLE001 — export counts its failures
+            span(False, {"error": str(e)})
+            return self._send(502, {"ok": False, "error": str(e)})
+        blob = out["blob"]
+        if via == "shm":
+            from ..fleet.device_transfer import write_shm_blob
+            gc = self.shm_gc
+            if gc is not None:
+                gc.sweep()  # reap blobs a dead puller never unlinked
+            try:
+                path = write_shm_blob(blob)
+            except OSError as e:
+                self.engine.metrics.incr("tpu_serving_kv_pull_failures")
+                span(False, {"via": "shm", "error": str(e)})
+                return self._send(502, {"ok": False, "error": str(e)})
+            if gc is not None:
+                gc.track(path)
+            span(True, {"via": "shm", "pages": out["pages"],
+                        "bytes": len(blob)})
+            return self._send(200, {
+                "ok": True, "path": path, "pages": out["pages"],
+                "bytes": len(blob),
+                "covered_tokens": out["covered_tokens"]})
+        span(True, {"via": "wire", "pages": out["pages"],
+                    "bytes": len(blob)})
+        return self._send(
+            200, blob, "application/octet-stream",
+            extra_headers={
+                "X-KV-Pages": str(out["pages"]),
+                "X-KV-Covered-Tokens": str(out["covered_tokens"])})
+
+    def _owner_pull(self, owner_url: str, payload: dict,
+                    trace_id: str, span_id: str):
+        """One control POST to the owner's /kv_pull. Returns
+        ("gone", msg) when the owner answered that the run no longer
+        exists, ("blob", bytes) for a wire-rung body, ("json", dict) for
+        a shm-rung path reply; raises OSError on transport-shaped
+        failures (the caller walks to the next rung)."""
+        import urllib.error
+        import urllib.request
+        req = urllib.request.Request(
+            owner_url.rstrip("/") + "/kv_pull",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json",
+                     "traceparent": format_traceparent(trace_id, span_id)},
+            method="POST")
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self.pull_timeout_s) as resp:
+                ctype = resp.headers.get("Content-Type") or ""
+                raw = resp.read()
+        except urllib.error.HTTPError as e:
+            body = e.read()
+            try:
+                parsed = json.loads(body or b"{}")
+            except json.JSONDecodeError:
+                parsed = {}
+            if e.code == 404 and parsed.get("gone"):
+                return ("gone", str(parsed.get("error") or "gone"))
+            raise OSError(f"owner /kv_pull answered {e.code}: "
+                          f"{parsed.get('error') or body[:200]!r}") from e
+        if "octet-stream" in ctype:
+            return ("blob", raw)
+        out = json.loads(raw or b"{}")
+        if not isinstance(out, dict):
+            raise OSError(f"owner /kv_pull answered non-object: {out!r}")
+        if out.get("gone"):
+            return ("gone", str(out.get("error") or "gone"))
+        if not out.get("ok"):
+            raise OSError(f"owner /kv_pull refused: {out}")
+        return ("json", out)
+
+    def _kv_fetch(self):
+        """COLD-REPLICA side of a directory pull (ISSUE 16): the router
+        found this request's prompt prefix in the fleet directory under
+        ANOTHER replica and asks this engine to fetch the pages before
+        the request lands, instead of re-prefilling them. Walks the pull
+        ladder fastest-first — device (owner in this process, zero
+        copies) → shm (same host, blob through tmpfs) → wire (blob in
+        the owner's response body) — with the push ladder's downgrade
+        discipline: transport failures walk DOWN a rung, but a
+        KVPullMiss at ANY rung answers {"gone": true} immediately (every
+        rung reads the owner's one trie; the run is gone at all of them,
+        and the router must invalidate the directory entry, not retry).
+        Always HTTP 200: a failed pull is a missed optimization — the
+        request simply prefills — never an error the client sees."""
+        tr = self.engine.tracer
+        inbound = parse_traceparent(self.headers.get("traceparent"))
+        trace_id = inbound[0] if inbound else Tracer.new_trace_id()
+        parent = inbound[1] if inbound else ""
+        span_id = Tracer.new_span_id()
+        started = tr.clock()
+
+        def span(ok: bool, attrs: dict):
+            try:
+                tr.record("serving.kv_pull", started, tr.clock(),
+                          trace_id=trace_id, span_id=span_id,
+                          parent_id=parent,
+                          attrs={"ok": ok, "side": "puller", **attrs})
+            except Exception:  # noqa: BLE001 — tracing never fails the hop
+                log.exception("serving.kv_pull span failed")
+
+        from ..fleet.device_transfer import device_pull, open_shm_blob
+        from ..fleet.handoff import KVPullMiss
+        try:
+            req = self._read_json()
+            tokens = req.get("tokens")
+            if not (isinstance(tokens, list) and tokens
+                    and all(isinstance(t, int) for t in tokens)):
+                raise ValueError("tokens must be a non-empty list of ints")
+            owner_url = str(req.get("owner_url") or "")
+            if not owner_url:
+                raise ValueError('need "owner_url"')
+            adapter = str(req.get("adapter") or "")
+            owner_domain = str(req.get("owner_domain") or "")
+            model = str(req.get("model") or "")
+        except (json.JSONDecodeError, ValueError, TypeError) as e:
+            span(False, {"error": str(e)})
+            return self._send(400, {"ok": False, "error": str(e)})
+        # preflight the local half of the adoption contract BEFORE any
+        # owner traffic: a cross-model entry or an adapter this replica
+        # never registered can never adopt — and neither means the
+        # OWNER's pages are gone, so answer a plain failure (router
+        # proceeds without invalidating)
+        if model and model != self.engine.cfg.name:
+            msg = (f"directory entry is for model {model!r}, this replica "
+                   f"serves {self.engine.cfg.name!r}")
+            span(False, {"owner": owner_url, "error": msg})
+            return self._send(200, {"ok": False, "error": msg})
+        if adapter and adapter not in self.engine.adapter_names:
+            msg = f"adapter {adapter!r} is not registered on this replica"
+            span(False, {"owner": owner_url, "error": msg})
+            return self._send(200, {"ok": False, "error": msg})
+
+        def gone(e):
+            span(False, {"gone": True, "owner": owner_url,
+                         "error": str(e)})
+            return self._send(200, {"ok": False, "gone": True,
+                                    "error": str(e)})
+
+        def pulled(pages: int, nbytes: int, covered: int, rung: str):
+            self.engine.metrics.incr("tpu_serving_kv_pull_runs")
+            self.engine.metrics.incr("tpu_serving_kv_pull_bytes", nbytes)
+            span(True, {"path": rung, "owner": owner_url,
+                        "pages": pages, "bytes": nbytes,
+                        "covered_tokens": covered})
+            return self._send(200, {"ok": True, "path": rung,
+                                    "pages": pages,
+                                    "covered_tokens": covered})
+
+        errors = []
+        same_domain = bool(self.device_domain
+                           and owner_domain == self.device_domain)
+        if same_domain:
+            # rung 1: device-local — the owner lives in this very
+            # process (bus hit); pages move arena-to-arena
+            try:
+                out = device_pull(self.engine, owner_url, tokens,
+                                  adapter=adapter,
+                                  domain=self.device_domain)
+                return pulled(out["pages"], out["bytes"],
+                              out["covered_tokens"], "device")
+            except KVPullMiss as e:
+                return gone(e)
+            except Exception as e:  # noqa: BLE001 — transport-shaped
+                # (bus miss = owner in another process); the shm rung
+                # reads the same trie through the codec
+                errors.append(f"device: {e}")
+            # rung 2: shm — same host, different process: the owner
+            # parks the blob in tmpfs, we mmap + adopt + unlink
+            try:
+                kind, reply = self._owner_pull(
+                    owner_url, {"tokens": tokens, "adapter": adapter,
+                                "via": "shm"}, trace_id, span_id)
+                if kind == "gone":
+                    return gone(reply)
+                path = str(reply.get("path") or "")
+                blob = open_shm_blob(path)
+                try:
+                    out = self.engine.adopt_handoff(blob, adapter=adapter)
+                finally:
+                    blob.close()
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass  # the owner's GC sweeps it
+                return pulled(out["pages"], out["bytes"], out["tokens"],
+                              "shm")
+            except KVPullMiss as e:
+                return gone(e)
+            except Exception as e:  # noqa: BLE001 — walk to the wire rung
+                errors.append(f"shm: {e}")
+        # rung 3: wire — the blob rides the owner's response body
+        try:
+            kind, reply = self._owner_pull(
+                owner_url, {"tokens": tokens, "adapter": adapter},
+                trace_id, span_id)
+            if kind == "gone":
+                return gone(reply)
+            if kind != "blob":
+                raise OSError(f"owner answered a {kind} reply to a wire "
+                              "pull")
+            out = self.engine.adopt_handoff(reply, adapter=adapter)
+            return pulled(out["pages"], out["bytes"], out["tokens"],
+                          "wire")
+        except KVPullMiss as e:
+            return gone(e)
+        except Exception as e:  # noqa: BLE001 — the ladder is exhausted;
+            # the request re-prefills (the unified fallback)
+            errors.append(f"wire: {e}")
+        self.engine.metrics.incr("tpu_serving_kv_pull_failures")
+        span(False, {"owner": owner_url, "error": "; ".join(errors)})
+        return self._send(200, {"ok": False, "error": "; ".join(errors)})
+
     def do_POST(self):
         if self.path == "/kv_prefill":
             return self._kv_prefill()
@@ -721,6 +1049,12 @@ class _Handler(BaseHTTPRequestHandler):
             return self._kv_adopt()
         if self.path == "/kv_adopt_chunk":
             return self._kv_adopt_chunk()
+        if self.path == "/kv_adopt_shm":
+            return self._kv_adopt_shm()
+        if self.path == "/kv_pull":
+            return self._kv_pull()
+        if self.path == "/kv_fetch":
+            return self._kv_fetch()
         if self.path == "/drain":
             # graceful scale-down (fleet autoscaler contract): stop
             # admitting, finish in-flight. Idempotent; progress is
@@ -1384,18 +1718,25 @@ class BoundedThreadingHTTPServer(ThreadingHTTPServer):
 def serve(engine, port: int = 8000, request_timeout_s: float = 120.0,
           tokenizer=None, allow_adapters: bool = False,
           max_connections: int = 128, handoff_stream_window: int = 8,
-          device_domain: str = "",
+          device_domain: str = "", pull_timeout_s: float = 10.0,
           clock=time.time, mono=time.monotonic):
     # described here, not in the engine: the HTTP-layer shed counter belongs
     # to this server (the engine never sees the rejected connection)
     engine.metrics.describe(
         "tpu_serving_http_rejected",
         "connections 503-shed at the HTTP concurrency bound")
+    # owner-side shm-blob GC for the pull path: only a replica in a
+    # placement domain can be asked for via=shm pulls (ISSUE 16)
+    shm_gc = None
+    if device_domain:
+        from ..fleet.device_transfer import ShmBlobGC
+        shm_gc = ShmBlobGC(clock=mono)
     handler = type("BoundHandler", (_Handler,),
                    {"engine": engine, "request_timeout_s": request_timeout_s,
                     "tokenizer": tokenizer, "allow_adapters": allow_adapters,
                     "handoff_stream_window": handoff_stream_window,
                     "device_domain": device_domain,
+                    "pull_timeout_s": pull_timeout_s, "shm_gc": shm_gc,
                     "clock": staticmethod(clock), "mono": staticmethod(mono)})
     httpd = BoundedThreadingHTTPServer(("0.0.0.0", port), handler,
                                        max_connections=max_connections,
@@ -1560,6 +1901,20 @@ def main(argv=None) -> int:
                         "from config/TPU_FLEET_PLACEMENT_DOMAIN, else "
                         "auto-detected as proc:<host>:<pid> — the "
                         "co-location the in-process bus can serve)")
+    p.add_argument("--placement-domain-mode", default=None,
+                   dest="fleet_placement_domain_mode",
+                   choices=["auto", "proc", "slice"],
+                   help="how the placement domain auto-detects when no "
+                        "explicit domain is set: 'auto' prefers the gang "
+                        "scheduler's slice identity (TPU_SLICE_NAME, "
+                        "host-qualified) and falls back to the process "
+                        "domain; 'slice' warns when the slice identity is "
+                        "missing; 'proc' pins one-process-per-domain")
+    p.add_argument("--pull-timeout", type=float, default=None,
+                   dest="fleet_pull_timeout_s",
+                   help="budget in seconds for one KV directory-pull hop "
+                        "(owner export + transfer + adoption); default "
+                        "from config/TPU_FLEET_PULL_TIMEOUT_S")
     p.add_argument("--hf-checkpoint", default="",
                    help="HuggingFace model directory (safetensors/bin) to "
                         "load real weights from; empty = random init")
@@ -1619,9 +1974,15 @@ def main(argv=None) -> int:
     device_transfer = (base_cfg.fleet_device_transfer_enabled
                        if args.fleet_device_transfer_enabled is None
                        else args.fleet_device_transfer_enabled == "on")
+    placement_domain_mode = (args.fleet_placement_domain_mode
+                             or base_cfg.fleet_placement_domain_mode)
     placement_domain = detect_placement_domain(
-        args.fleet_placement_domain or base_cfg.fleet_placement_domain) \
+        args.fleet_placement_domain or base_cfg.fleet_placement_domain,
+        mode=placement_domain_mode) \
         if device_transfer else ""
+    pull_timeout_s = (args.fleet_pull_timeout_s
+                      if args.fleet_pull_timeout_s is not None
+                      else base_cfg.fleet_pull_timeout_s)
     cfg = MODEL_CONFIGS[args.model]()
     log.info("loading %s (%.2fB params) on %s", cfg.name,
              cfg.param_count / 1e9, jax.default_backend())
@@ -1720,7 +2081,8 @@ def main(argv=None) -> int:
                   allow_adapters=args.dynamic_adapters,
                   max_connections=args.max_connections,
                   handoff_stream_window=handoff_stream_window,
-                  device_domain=placement_domain)
+                  device_domain=placement_domain,
+                  pull_timeout_s=pull_timeout_s)
     log.info("serving on :%d (POST /generate, GET /metrics)", args.port)
     import socket
     host = socket.gethostname()
@@ -1749,6 +2111,11 @@ def main(argv=None) -> int:
             interval_s=args.fleet_heartbeat_interval,
             role=serving_role,
             placement_domain=placement_domain).start()
+        if base_cfg.fleet_prefix_directory_enabled:
+            # publish-on-trie-insert (ISSUE 16): a fresh prefix key wakes
+            # the reporter so the directory learns about it on the NEXT
+            # beat, not up to a full interval later
+            engine.prefix_publish_hook = reporter.wake
         log.info("fleet: reporting to %s as %s (role %s)",
                  args.fleet_router, reporter.replica_id, serving_role)
     try:

@@ -45,6 +45,7 @@ import queue
 import threading
 import time
 import uuid
+from collections import OrderedDict
 from concurrent.futures import Future
 from typing import Any, Optional
 
@@ -388,6 +389,25 @@ class ServingEngine:
         self.metrics.incr("tpu_serving_paged_prefill_tokens", 0)
         self.metrics.incr("tpu_serving_paged_speculative_steps", 0)
         self.metrics.incr("tpu_serving_paged_speculative_rollback_pages", 0)
+        # KV-fabric pull series (ISSUE 16): dashboards divide pull runs
+        # by directory hits for the realized pull rate; failures flag
+        # transport/validation trouble — a GONE miss is NOT a failure
+        # (the directory's invalidation series carries staleness)
+        self.metrics.incr("tpu_serving_kv_pull_runs", 0)
+        self.metrics.incr("tpu_serving_kv_pull_bytes", 0)
+        self.metrics.incr("tpu_serving_kv_pull_failures", 0)
+        # global prefix directory (ISSUE 16): longest-boundary keys of
+        # runs this arena inserted/adopted, pending their ride on the
+        # next heartbeat (ReplicaReporter drains take_prefix_publishes
+        # and re-queues on a failed beat). Keyed by prefix key so
+        # re-inserting one run dedups; bounded FIFO-drop-oldest — the
+        # oldest pending runs are the likeliest already evicted.
+        self._publish_lock = threading.Lock()
+        self._prefix_publishes: "OrderedDict[str, dict]" = OrderedDict()
+        # serve_main points this at the reporter's wake event so a fresh
+        # publish reaches the directory on the next beat rather than one
+        # interval later; invoked outside engine locks, best-effort
+        self.prefix_publish_hook: Optional[Any] = None
         self._update_page_gauges()
         # per-slot sampling state: (request seed, draws so far) -> PRNG key
         self._slot_seed = np.zeros((sc.slots,), np.uint32)
@@ -616,6 +636,19 @@ class ServingEngine:
                    "device-path hops that fell back to the wire codec "
                    "(bus miss, domain mismatch, geometry/adoption "
                    "failure) — the ladder is device -> wire -> unified")
+        m.describe("tpu_serving_kv_pull_runs",
+                   "KV page runs moved by directory-planned pulls "
+                   "(owner counts exports, cold replica counts "
+                   "adoptions)")
+        m.describe("tpu_serving_kv_pull_bytes",
+                   "bytes moved by directory pulls (serialized blob "
+                   "bytes on the shm/wire rungs, device-array bytes on "
+                   "the device rung)")
+        m.describe("tpu_serving_kv_pull_failures",
+                   "pull hops that failed in transport or validation — "
+                   "a GONE miss (owner evicted the pages since publish) "
+                   "is counted by the directory's invalidations series "
+                   "instead, not here")
         m.describe("tpu_serving_prefill_chunks",
                    "prompt chunks processed by chunked prefill "
                    "(serving_chunk_tokens > 0)")
@@ -1443,6 +1476,7 @@ class ServingEngine:
             try:
                 with self._prefix_lock:
                     store.insert_ready(adapter_id, tokens, pages)
+                self._publish_prefix(adapter_id, tokens)
             except Exception:  # noqa: BLE001 — caching is best-effort
                 log.exception("prefix-cache insert_ready failed; "
                               "serving uncached")
@@ -1497,6 +1531,7 @@ class ServingEngine:
             if evicted:
                 self.metrics.incr("tpu_serving_prefix_cache_evictions",
                                   evicted)
+            self._publish_prefix(adapter_id, tokens)
         except Exception:  # noqa: BLE001 — caching is best-effort
             log.exception("prefix-cache insert failed; serving uncached")
         self._update_page_gauges()
@@ -1660,7 +1695,96 @@ class ServingEngine:
                     self._dense_prefixes.add(tokens, (logits, single))
         if evicted:
             self.metrics.incr("tpu_serving_prefix_cache_evictions", evicted)
+        if self._kv_store is not None:
+            # registered prefixes are the directory's best customers:
+            # pinned pages can never go GONE under a pull
+            self._publish_prefix(0, tokens)
         self._update_page_gauges()
+
+    # -- fleet prefix directory (ISSUE 16) -------------------------------------
+
+    def _adapter_name_for(self, adapter_id: int) -> Optional[str]:
+        """The registered name behind an adapter slot ("" = base slot 0);
+        None when the slot has no live name (adapter unregistered while
+        its request was in flight) — the caller skips the publish rather
+        than key the run under the wrong adapter."""
+        if adapter_id == 0:
+            return ""
+        with self._adapter_lock:
+            for name, slot in self._adapter_names.items():
+                if slot == adapter_id:
+                    return name
+        return None
+
+    def _adapter_root_id(self, adapter: str) -> int:
+        """Adapter NAME -> trie root slot, the inverse of
+        ``_adapter_name_for`` ("" = base root 0). The pull/adopt doors
+        resolve directory-carried adapter names through this; an unknown
+        name raises KVPullMiss — the directory claimed an adapter this
+        replica does not hold, same fall-back-to-prefill as evicted
+        pages."""
+        from ...fleet.handoff import KVPullMiss
+        if not adapter:
+            return 0
+        with self._adapter_lock:
+            slot = self._adapter_names.get(adapter)
+        if slot is None:
+            raise KVPullMiss(f"adapter {adapter!r} is not registered on "
+                             "this replica")
+        return slot
+
+    def _publish_prefix(self, adapter_id: int, tokens: list) -> None:
+        """Queue this run's LONGEST page-boundary key for the global
+        prefix directory (ReplicaReporter drains the queue into
+        heartbeats). One key per run suffices: the router walks a
+        request's chain longest-first, and incremental chunk hashing
+        makes every extension's chain contain this key. Best-effort by
+        design — a lost publish costs the fleet one pull opportunity,
+        never a request."""
+        try:
+            t = self.sc.kv_page_tokens
+            n_pages = len(tokens) // t
+            if n_pages < 1:
+                return
+            adapter = self._adapter_name_for(adapter_id)
+            if adapter is None:
+                return
+            from ...fleet.prefix_directory import prefix_key
+            key = prefix_key(tokens[:n_pages * t], t, adapter)
+            with self._publish_lock:
+                self._prefix_publishes[key] = {
+                    "key": key, "pages": n_pages,
+                    "model": self.cfg.name, "adapter": adapter}
+                self._prefix_publishes.move_to_end(key)
+                while len(self._prefix_publishes) > 256:
+                    self._prefix_publishes.popitem(last=False)
+            hook = self.prefix_publish_hook
+            if hook is not None:
+                hook()
+        except Exception:  # noqa: BLE001 — publishing is best-effort
+            log.exception("prefix publish failed; the directory misses "
+                          "this run until its next insert")
+
+    def take_prefix_publishes(self) -> list:
+        """Drain pending directory publishes for a heartbeat. The caller
+        (ReplicaReporter) re-queues what it drained if the beat fails —
+        publishes are pending-until-acked, not fire-and-forget."""
+        with self._publish_lock:
+            out = list(self._prefix_publishes.values())
+            self._prefix_publishes.clear()
+        return out
+
+    def requeue_prefix_publishes(self, publishes: list) -> None:
+        """Give back publishes from a FAILED heartbeat. Newer pending
+        entries win a key collision (they carry fresher page counts)."""
+        with self._publish_lock:
+            for pub in publishes:
+                key = pub.get("key")
+                if key and key not in self._prefix_publishes:
+                    self._prefix_publishes[key] = pub
+                    self._prefix_publishes.move_to_end(key, last=False)
+            while len(self._prefix_publishes) > 256:
+                self._prefix_publishes.popitem(last=False)
 
     # -- disaggregated KV handoff (ISSUE 9) ------------------------------------
 
@@ -1745,19 +1869,23 @@ class ServingEngine:
                 "covered_tokens": m.matched_tokens,
                 "matched_tokens": matched}
 
-    def adopt_handoff(self, blob: bytes) -> dict:
+    def adopt_handoff(self, blob: bytes, adapter: str = "") -> dict:
         """Decode-role half: validate and adopt a serialized page run
         into this arena through the trie — the engine's next prompt match
         then references the adopted pages zero-copy and only the sub-page
         tail recomputes. The handoff counters move ONLY after the
         adoption actually landed (a failed adoption is a failure, never
-        an optimistic hit). Returns {pages, tokens, bytes, evicted}."""
+        an optimistic hit). ``adapter`` names the trie root the run
+        belongs under ("" = base) — directory pulls adopt adapter-variant
+        runs through the same door. Returns {pages, tokens, bytes,
+        evicted}."""
         from ...fleet.handoff import HandoffError, deserialize_pages
         try:
             if self._kv_store is None:
                 raise HandoffError("this replica has no paged KV arena "
                                    "(ring/mixed layout or prefix cache "
                                    "disabled) — it cannot adopt KV")
+            root = self._adapter_root_id(adapter)
             with self._prefix_lock:
                 spec = self._kv_store.section_spec()
             header, sections = deserialize_pages(
@@ -1769,7 +1897,7 @@ class ServingEngine:
                     f"this replica's cache budget {self.sc.cache_len}")
             with self._prefix_lock:
                 added, evicted = self._kv_store.adopt(
-                    0, header["tokens"], sections)
+                    root, header["tokens"], sections)
         except Exception:
             self.metrics.incr("tpu_serving_kv_handoff_failures")
             raise
@@ -1778,6 +1906,9 @@ class ServingEngine:
         if evicted:
             self.metrics.incr("tpu_serving_prefix_cache_evictions", evicted)
         self._update_page_gauges()
+        # adopted pages are as pullable as locally-prefilled ones: tell
+        # the directory this replica is now a holder too
+        self._publish_prefix(root, header["tokens"])
         return {"pages": header["n_pages"], "added": added,
                 "tokens": len(header["tokens"]), "bytes": len(blob),
                 "evicted": evicted}
@@ -1851,7 +1982,7 @@ class ServingEngine:
                 "matched_tokens": matched}
 
     def adopt_handoff_device(self, tokens: list, sections: dict, *,
-                             model: str = "") -> dict:
+                             model: str = "", adapter: str = "") -> dict:
         """Decode half of a device-path handoff: validate the run's
         geometry against this arena (fleet/handoff.check_device_sections
         — the ONE device-contract definition the stream assembler shares,
@@ -1871,6 +2002,7 @@ class ServingEngine:
                 raise HandoffError(
                     f"device run spans {len(tokens)} tokens, over this "
                     f"replica's cache budget {self.sc.cache_len}")
+            root = self._adapter_root_id(adapter)
             with self._prefix_lock:
                 spec = self._kv_store.section_spec()
             n, trimmed, nbytes = check_device_sections(
@@ -1880,7 +2012,7 @@ class ServingEngine:
                 model=model, allow_padded=True)
             with self._prefix_lock:
                 added, evicted = self._kv_store.adopt(
-                    0, [int(tk) for tk in tokens], trimmed)
+                    root, [int(tk) for tk in tokens], trimmed)
         except Exception:
             self.metrics.incr("tpu_serving_kv_handoff_failures")
             raise
@@ -1890,6 +2022,7 @@ class ServingEngine:
         if evicted:
             self.metrics.incr("tpu_serving_prefix_cache_evictions", evicted)
         self._update_page_gauges()
+        self._publish_prefix(root, [int(tk) for tk in tokens])
         return {"pages": n, "added": added, "tokens": len(tokens),
                 "bytes": nbytes, "evicted": evicted}
 
@@ -1943,6 +2076,7 @@ class ServingEngine:
         if evicted:
             self.metrics.incr("tpu_serving_prefix_cache_evictions", evicted)
         self._update_page_gauges()
+        self._publish_prefix(0, done["tokens"])
         return {"ok": True, "final": True, "seq": done["seq"],
                 "pages": n_pages, "added": added,
                 "tokens": len(done["tokens"]), "bytes": nbytes,
@@ -1961,6 +2095,107 @@ class ServingEngine:
             return frames[0]
         return {name: jnp.concatenate([f[name] for f in frames], axis=1)
                 for name in frames[0]}
+
+    # -- KV-fabric pull doors (ISSUE 16) ---------------------------------------
+
+    def export_pull(self, tokens: list[int], adapter: str = "") -> dict:
+        """Owner half of a directory pull: serialize the pages this trie
+        ALREADY holds for ``tokens`` — match-only, never prefilling. The
+        whole point of a pull is skipping compute; an owner that lost
+        the pages since its publish raises KVPullMiss (the /kv_pull door
+        answers 404 gone, the router invalidates the directory entry and
+        the cold replica prefills for itself — one miss, no retry). Same
+        ONE-store-reference discipline and load accounting as
+        export_handoff. Returns {"blob", "pages", "covered_tokens"}."""
+        from ...fleet.handoff import KVPullMiss, serialize_pages
+        if self._kv_store is None:
+            raise KVPullMiss("this replica has no paged KV arena — "
+                             "nothing to pull")
+        tokens = list(tokens)
+        if not tokens:
+            raise ValueError("empty prompt")
+        root = self._adapter_root_id(adapter)
+        with self._handoff_lock:
+            self.handoff_inflight += 1
+        try:
+            with self._prefix_lock:
+                store = self._kv_store
+                m = store.match_full(root, tokens)
+                frags = store.export_pages(m.pages) if m.pages else {}
+            try:
+                if not m.pages:
+                    raise KVPullMiss(
+                        f"no cached full pages for a {len(tokens)}-token "
+                        f"prompt at page size {self.sc.kv_page_tokens} "
+                        "(evicted since the directory publish)")
+                # host copies OUTSIDE the lock, like export_handoff
+                sections = {name: np.asarray(a)
+                            for name, a in frags.items()}
+                blob = serialize_pages(tokens[:m.matched_tokens],
+                                       self.sc.kv_page_tokens, sections,
+                                       model=self.cfg.name)
+            finally:
+                with self._prefix_lock:
+                    store.release(m.pages)
+        except KVPullMiss:
+            raise  # clean GONE — directory staleness, not a failure
+        except Exception:
+            self.metrics.incr("tpu_serving_kv_pull_failures")
+            raise
+        finally:
+            with self._handoff_lock:
+                self.handoff_inflight -= 1
+        self.metrics.incr("tpu_serving_kv_pull_runs")
+        self.metrics.incr("tpu_serving_kv_pull_bytes", len(blob))
+        return {"blob": blob, "pages": len(m.pages),
+                "covered_tokens": m.matched_tokens}
+
+    def export_pull_device(self, tokens: list[int],
+                           adapter: str = "") -> dict:
+        """``export_pull`` minus serialization: fresh device buffers for
+        the matched run, adopted in-process by device_pull on the cold
+        engine. Carries the owner's model name so the puller's own adopt
+        door enforces cross-model rejection even device-native."""
+        from ...fleet.handoff import KVPullMiss
+        if self._kv_store is None:
+            raise KVPullMiss("this replica has no paged KV arena — "
+                             "nothing to pull")
+        tokens = list(tokens)
+        if not tokens:
+            raise ValueError("empty prompt")
+        root = self._adapter_root_id(adapter)
+        with self._handoff_lock:
+            self.handoff_inflight += 1
+        try:
+            with self._prefix_lock:
+                store = self._kv_store
+                m = store.match_full(root, tokens)
+                frags = store.export_pages(m.pages) if m.pages else {}
+            try:
+                if not m.pages:
+                    raise KVPullMiss(
+                        f"no cached full pages for a {len(tokens)}-token "
+                        f"prompt at page size {self.sc.kv_page_tokens} "
+                        "(evicted since the directory publish)")
+                nbytes = sum(int(a.size) * int(a.dtype.itemsize)
+                             for a in frags.values())
+            finally:
+                with self._prefix_lock:
+                    store.release(m.pages)
+        except KVPullMiss:
+            raise  # clean GONE — directory staleness, not a failure
+        except Exception:
+            self.metrics.incr("tpu_serving_kv_pull_failures")
+            raise
+        finally:
+            with self._handoff_lock:
+                self.handoff_inflight -= 1
+        self.metrics.incr("tpu_serving_kv_pull_runs")
+        self.metrics.incr("tpu_serving_kv_pull_bytes", nbytes)
+        return {"tokens": tokens[:m.matched_tokens], "sections": frags,
+                "pages": len(m.pages), "bytes": nbytes,
+                "covered_tokens": m.matched_tokens,
+                "model": self.cfg.name}
 
     def _assembler(self):
         """The decode side's stream assembler, built lazily (needs the
@@ -2232,6 +2467,7 @@ class ServingEngine:
         if evicted:
             self.metrics.incr("tpu_serving_prefix_cache_evictions", evicted)
         self._update_page_gauges()
+        self._publish_prefix(0, done["tokens"])
         return {"ok": True, "final": True, "seq": done["seq"],
                 "pages": n_pages, "added": added,
                 "tokens": len(done["tokens"]), "bytes": done["bytes"],

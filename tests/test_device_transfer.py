@@ -19,19 +19,30 @@ Slow tier (real engines): the acceptance pins —
   mismatch) DOWNGRADES to the wire codec under the same /kv_prefill hop
   — the ladder is device -> wire -> unified, and the downgrade counter
   moves.
+
+ISSUE 16 widens the fast tier with the cross-process rung (slice-scoped
+placement domains, the tmpfs blob + mmap transport with its path
+validation and owner-side GC, and device_push's bus-miss -> shm
+fallback) and the slow tier with the /kv_fetch PULL ladder over real
+engines: device-local -> shm -> wire, GONE on an evicted run, and the
+cross-model preflight that refuses without invalidating.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import threading
 import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 import pytest
 
 from k8s_runpod_kubelet_tpu.fleet.device_transfer import (
-    BUS, DeviceTransferBus, DeviceTransferError, detect_placement_domain,
-    device_push)
+    BUS, DeviceTransferBus, DeviceTransferError, ShmBlobGC,
+    detect_placement_domain, device_push, open_shm_blob, shm_push,
+    write_shm_blob)
 
 
 @pytest.fixture(autouse=True)
@@ -56,6 +67,206 @@ class TestPlacementDomain:
         assert d == f"proc:{socket.gethostname()}:{os.getpid()}"
         # stable within a process: two replicas here share a domain
         assert d == detect_placement_domain("", env={})
+
+    def test_slice_metadata_scopes_the_domain_host_qualified(self):
+        """auto mode reads the gang scheduler's slice identity — but the
+        domain stays HOST-qualified: the shm rung needs one kernel."""
+        import socket
+        d = detect_placement_domain("", env={"TPU_SLICE_NAME": "pod-3"})
+        assert d == f"slice:pod-3:{socket.gethostname()}"
+        # gang members on the SAME host converge on one domain
+        assert d == detect_placement_domain(
+            "", env={"TPU_SLICE_NAME": "pod-3"})
+
+    def test_proc_mode_pins_pr11_behavior(self):
+        import os
+        import socket
+        d = detect_placement_domain("", env={"TPU_SLICE_NAME": "pod-3"},
+                                    mode="proc")
+        assert d == f"proc:{socket.gethostname()}:{os.getpid()}"
+
+    def test_slice_mode_without_metadata_warns_and_falls_back(self, caplog):
+        import logging
+        with caplog.at_level(logging.WARNING,
+                             logger="k8s_runpod_kubelet_tpu.fleet"
+                                    ".device_transfer"):
+            d = detect_placement_domain("", env={}, mode="slice")
+        assert d.startswith("proc:")
+        assert any("TPU_SLICE_NAME" in r.message for r in caplog.records)
+
+    def test_override_beats_slice_metadata(self):
+        assert detect_placement_domain(
+            "rack:9", env={"TPU_SLICE_NAME": "pod-3"}) == "rack:9"
+
+
+class TestShmBlobTransport:
+    """The cross-process rung's tmpfs file transport: private creation,
+    network-path validation on open, and the owner-side GC for pull
+    blobs a dead puller never unlinked."""
+
+    def test_write_open_round_trip(self, tmp_path):
+        path = write_shm_blob(b"kv-payload", dir=str(tmp_path))
+        assert os.path.basename(path).startswith("tpukv-")
+        assert (os.stat(path).st_mode & 0o777) == 0o600
+        m = open_shm_blob(path, dir=str(tmp_path))
+        try:
+            assert bytes(m) == b"kv-payload"
+            assert m[:2] == b"kv", "mmap must slice like bytes (the codec)"
+        finally:
+            m.close()
+            os.unlink(path)
+
+    def test_open_refuses_paths_outside_the_shm_dir(self, tmp_path):
+        outside = tmp_path / "elsewhere"
+        outside.mkdir()
+        victim = outside / "tpukv-secret.kv"
+        victim.write_bytes(b"not yours")
+        with pytest.raises(DeviceTransferError, match="outside"):
+            open_shm_blob(str(victim), dir=str(tmp_path))
+        # traversal through the dir must not escape it either
+        with pytest.raises(DeviceTransferError, match="outside"):
+            open_shm_blob(str(tmp_path / ".." / "elsewhere"
+                          / "tpukv-secret.kv"), dir=str(tmp_path))
+
+    def test_open_refuses_foreign_prefixes(self, tmp_path):
+        p = tmp_path / "passwd"
+        p.write_bytes(b"root:x")
+        with pytest.raises(DeviceTransferError, match="outside"):
+            open_shm_blob(str(p), dir=str(tmp_path))
+
+    def test_open_vanished_and_torn_files_downgrade(self, tmp_path):
+        with pytest.raises(DeviceTransferError, match="cannot map"):
+            open_shm_blob(str(tmp_path / "tpukv-gone.kv"),
+                          dir=str(tmp_path))
+        empty = tmp_path / "tpukv-torn.kv"
+        empty.write_bytes(b"")     # a torn writer: mmap raises ValueError
+        with pytest.raises(DeviceTransferError, match="cannot map"):
+            open_shm_blob(str(empty), dir=str(tmp_path))
+
+    def test_gc_sweeps_expired_only_and_tolerates_puller_unlinks(
+            self, tmp_path):
+        now = [0.0]
+        gc = ShmBlobGC(ttl_s=10.0, clock=lambda: now[0])
+        old = write_shm_blob(b"old", dir=str(tmp_path))
+        gc.track(old)
+        taken = write_shm_blob(b"taken", dir=str(tmp_path))
+        gc.track(taken)
+        os.unlink(taken)           # the puller's success path already ran
+        now[0] = 6.0
+        fresh = write_shm_blob(b"fresh", dir=str(tmp_path))
+        gc.track(fresh)
+        now[0] = 11.0
+        assert gc.sweep() == 1, "only the expired, still-present blob dies"
+        assert not os.path.exists(old) and os.path.exists(fresh)
+        assert len(gc) == 1        # ENOENT untracked without counting
+        os.unlink(fresh)
+        with pytest.raises(ValueError):
+            ShmBlobGC(ttl_s=0)
+
+
+class _FakeExportEngine:
+    """Just enough engine for shm_push/device_push routing: a canned
+    export_handoff blob and the config fields the ladder consults."""
+
+    class _SC:
+        serving_chunk_tokens = 0
+
+    class _Cfg:
+        name = "fake"
+
+    sc = _SC()
+    cfg = _Cfg()
+
+    def export_handoff(self, tokens):
+        return {"blob": b"BLOB:" + bytes(tokens), "pages": 2,
+                "covered_tokens": len(tokens), "matched_tokens": len(tokens)}
+
+
+class _ShmAdoptServer:
+    """A /kv_adopt_shm endpoint that mmaps the posted path like
+    serve_main's door (never unlinking — the SENDER owns the file)."""
+
+    def __init__(self, reply_ok=True):
+        srv = self
+        self.seen: list = []
+        self.paths: list = []
+
+        class _H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                req = json.loads(self.rfile.read(length) or b"{}")
+                srv.paths.append(str(req.get("path")))
+                m = open_shm_blob(str(req.get("path")))
+                try:
+                    srv.seen.append(bytes(m))
+                finally:
+                    m.close()
+                body = json.dumps({"ok": reply_ok, "pages": 2}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), _H)
+        self._httpd.daemon_threads = True
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self._httpd.server_address[1]}"
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class TestShmPushRung:
+    def test_bus_miss_same_domain_takes_the_shm_rung(self):
+        """The ISSUE 11 dead-end becomes the ISSUE 16 rung: a bus miss
+        with the router vouching the target shares this domain parks the
+        blob in tmpfs and POSTs only its path — and the sender unlinks
+        the file whether or not adoption landed."""
+        srv = _ShmAdoptServer()
+        try:
+            out = device_push(_FakeExportEngine(), srv.url, [1, 2, 3],
+                              domain="slice:a:h", target_domain="slice:a:h")
+            assert out["path"] == "shm" and out["adopted"] == 2
+            assert srv.seen == [b"BLOB:\x01\x02\x03"], \
+                "the receiver mapped exactly the exported blob"
+            assert not os.path.exists(srv.paths[0]), \
+                "push-path blobs must be unlinked synchronously"
+        finally:
+            srv.close()
+
+    def test_refused_adoption_downgrades_and_unlinks(self):
+        srv = _ShmAdoptServer(reply_ok=False)
+        try:
+            with pytest.raises(DeviceTransferError, match="refused"):
+                device_push(_FakeExportEngine(), srv.url, [7],
+                            domain="d", target_domain="d")
+            assert srv.paths and not os.path.exists(srv.paths[0])
+        finally:
+            srv.close()
+
+    def test_dead_peer_downgrades_to_wire(self):
+        with pytest.raises(DeviceTransferError, match="POST"):
+            shm_push(_FakeExportEngine(), "http://127.0.0.1:9", [1],
+                     timeout_s=0.5)
+
+    def test_unvouched_or_chunked_bus_miss_still_dead_ends(self):
+        eng = _FakeExportEngine()
+        with pytest.raises(DeviceTransferError, match="bus miss"):
+            device_push(eng, "http://gone:1", [1], domain="d",
+                        target_domain="other")
+        chunked = _FakeExportEngine()
+        chunked.sc = type("SC", (), {"serving_chunk_tokens": 16})()
+        with pytest.raises(DeviceTransferError, match="wire"):
+            device_push(chunked, "http://gone:1", [1], domain="d",
+                        target_domain="d")
 
 
 class TestDeviceTransferBus:
@@ -567,3 +778,175 @@ class TestKvPrefillDeviceLadder:
             s_dec.shutdown()
             pre.stop()
             dec.stop()
+
+
+@pytest.mark.slow
+class TestKvFetchPullLadder:
+    """The /kv_fetch PULL ladder over real engines (ISSUE 16): a cold
+    replica fetches an already-computed page run from its owner, walking
+    device-local -> shm -> wire with the push ladder's downgrade
+    discipline — except a KVPullMiss at ANY rung answers GONE
+    immediately (every rung reads the owner's one trie)."""
+
+    def _serve(self, engine, domain):
+        from k8s_runpod_kubelet_tpu.workloads.serve_main import serve
+        httpd = serve(engine, port=0, device_domain=domain)
+        return httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    def _fetch(self, cold_url, owner_url, *, owner_domain="",
+               model="", tokens=PROMPT):
+        body = json.dumps({"tokens": tokens, "owner_url": owner_url,
+                           "owner_domain": owner_domain,
+                           "model": model}).encode()
+        req = urllib.request.Request(
+            cold_url + "/kv_fetch", data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            return json.loads(resp.read())
+
+    def _warm(self, owner):
+        """First decode inserts the prompt's full pages into the
+        owner's trie — the generation it returns doubles as the
+        bit-identity reference for the pulled side."""
+        return owner.submit(PROMPT, max_new_tokens=8).result(timeout=300)
+
+    def test_device_rung_never_serializes_then_prefix_hit(
+            self, tiny, monkeypatch):
+        dom = detect_placement_domain()
+        owner, cold = _engine(tiny), _engine(tiny)
+        s_own, own_url = self._serve(owner, dom)
+        s_cold, cold_url = self._serve(cold, dom)
+        BUS.register(own_url, owner, dom)
+        try:
+            ref = self._warm(owner)
+            _forbid_wire(monkeypatch)  # the whole pull must stay device
+            out = self._fetch(cold_url, own_url, owner_domain=dom,
+                              model=owner.cfg.name)
+            assert out["ok"] and out["path"] == "device"
+            assert out["pages"] == len(PROMPT) // 8
+            assert out["covered_tokens"] == (len(PROMPT) // 8) * 8
+            # the pulled KV is bit-true: the cold engine serves the
+            # prompt as a prefix hit, token-identical to the owner
+            got = cold.submit(PROMPT, max_new_tokens=8).result(timeout=300)
+            assert got["tokens"] == ref["tokens"]
+            assert cold.metrics.get_counter(
+                "tpu_serving_prefix_cache_hits") == 1
+            assert cold.metrics.get_counter(
+                "tpu_serving_kv_pull_runs") == 1
+            spans = [s for s in cold.tracer.recent()
+                     if s["name"] == "serving.kv_pull"
+                     and (s["attrs"] or {}).get("side") == "puller"]
+            assert spans and spans[-1]["attrs"]["path"] == "device"
+            for e, what in ((owner, "owner"), (cold, "puller")):
+                e.drain()
+                _no_leaks(e, what)
+        finally:
+            s_own.shutdown()
+            s_cold.shutdown()
+            owner.stop()
+            cold.stop()
+
+    def test_bus_miss_downgrades_to_the_shm_rung(self, tiny):
+        """Domains match but the owner is not on this process' bus (the
+        cross-process-same-slice case the shm rung exists for): the
+        blob rides tmpfs, the puller mmaps + adopts + unlinks."""
+        dom = detect_placement_domain()
+        owner, cold = _engine(tiny), _engine(tiny)
+        s_own, own_url = self._serve(owner, dom)
+        s_cold, cold_url = self._serve(cold, dom)
+        # note: NO BUS.register — the device rung bus-misses
+        try:
+            ref = self._warm(owner)
+            out = self._fetch(cold_url, own_url, owner_domain=dom,
+                              model=owner.cfg.name)
+            assert out["ok"] and out["path"] == "shm"
+            assert out["pages"] == len(PROMPT) // 8
+            got = cold.submit(PROMPT, max_new_tokens=8).result(timeout=300)
+            assert got["tokens"] == ref["tokens"]
+            # the owner answered the shm door and the puller unlinked
+            # the blob it adopted (GC tracked it; nothing left to sweep)
+            own_spans = [s for s in owner.tracer.recent()
+                         if s["name"] == "serving.kv_pull"
+                         and (s["attrs"] or {}).get("side") == "owner"]
+            assert own_spans and own_spans[-1]["attrs"]["via"] == "shm"
+            assert s_own.RequestHandlerClass.shm_gc.sweep() == 0
+            for e in (owner, cold):
+                e.drain()
+                _no_leaks(e)
+        finally:
+            s_own.shutdown()
+            s_cold.shutdown()
+            owner.stop()
+            cold.stop()
+
+    def test_mismatched_domains_ride_the_wire(self, tiny):
+        """An owner in another placement domain skips straight to the
+        wire rung: blob in the owner's response body."""
+        dom = detect_placement_domain()
+        owner, cold = _engine(tiny), _engine(tiny)
+        s_own, own_url = self._serve(owner, "slice:other:remote-host")
+        s_cold, cold_url = self._serve(cold, dom)
+        try:
+            ref = self._warm(owner)
+            out = self._fetch(cold_url, own_url,
+                              owner_domain="slice:other:remote-host",
+                              model=owner.cfg.name)
+            assert out["ok"] and out["path"] == "wire"
+            assert out["pages"] == len(PROMPT) // 8
+            got = cold.submit(PROMPT, max_new_tokens=8).result(timeout=300)
+            assert got["tokens"] == ref["tokens"]
+            assert cold.metrics.get_counter(
+                "tpu_serving_prefix_cache_hits") == 1
+        finally:
+            s_own.shutdown()
+            s_cold.shutdown()
+            owner.stop()
+            cold.stop()
+
+    def test_evicted_run_answers_gone_not_failed(self, tiny):
+        """The owner never computed this prompt (the published run was
+        evicted): export_pull is match-only, so the first rung reached
+        answers GONE — no ladder walk, no pages adopted, the router
+        invalidates and the request re-prefills."""
+        dom = detect_placement_domain()
+        owner, cold = _engine(tiny), _engine(tiny)
+        s_own, own_url = self._serve(owner, dom)
+        s_cold, cold_url = self._serve(cold, dom)
+        try:
+            out = self._fetch(cold_url, own_url, owner_domain=dom,
+                              model=owner.cfg.name)
+            assert not out["ok"] and out["gone"] is True
+            stats = cold.prefix_cache_stats()
+            assert stats["pages_free"] == stats["pages_total"]
+            assert cold.metrics.get_counter(
+                "tpu_serving_kv_pull_runs") == 0
+        finally:
+            s_own.shutdown()
+            s_cold.shutdown()
+            owner.stop()
+            cold.stop()
+
+    def test_cross_model_preflight_refuses_without_gone(self, tiny):
+        """A directory entry for a different model can never adopt here
+        — but the OWNER's pages are fine, so the refusal is a plain
+        failure (no "gone": the router must NOT invalidate) and no
+        owner traffic happens at all."""
+        dom = detect_placement_domain()
+        owner, cold = _engine(tiny), _engine(tiny)
+        s_own, own_url = self._serve(owner, dom)
+        s_cold, cold_url = self._serve(cold, dom)
+        try:
+            self._warm(owner)
+            runs_before = owner.metrics.get_counter(
+                "tpu_serving_kv_pull_runs")
+            out = self._fetch(cold_url, own_url, owner_domain=dom,
+                              model="somebody-elses-model")
+            assert not out["ok"] and not out.get("gone")
+            assert "model" in out["error"]
+            assert owner.metrics.get_counter(
+                "tpu_serving_kv_pull_runs") == runs_before
+        finally:
+            s_own.shutdown()
+            s_cold.shutdown()
+            owner.stop()
+            cold.stop()

@@ -1,0 +1,564 @@
+"""Fleet-wide KV fabric soak (ISSUE 16 acceptance): real router +
+registry + prefix directory over localhost HTTP, replica fakes with REAL
+paged-KV arenas (the KV payload is a deterministic function of token id
+and position — bit-true transfer is checkable without jax compiles
+dominating the tier).
+
+What it pins:
+
+- a replica that prefills a prompt PUBLISHES its longest page-boundary
+  key via its heartbeat; when the router later picks a COLD replica for
+  the same prompt, the directory lookup plans a pull hop (POST /kv_fetch)
+  and the cold replica adopts the page run from the owner instead of
+  re-prefilling — the adopted KV is BIT-IDENTICAL to the owner's;
+- a pull that comes back GONE (published key whose pages the owner no
+  longer holds) invalidates the directory claim after exactly ONE owner
+  round-trip (no retry storm) and the request still answers 200 via
+  local prefill;
+- a seeded FaultPlan kills the owner MID-PULL (the blob truncates, then
+  the listener drops): the cold side rejects the torn blob, the request
+  still answers 200 via re-prefill, ZERO pages leak on either arena, and
+  the registry sweep that evicts the corpse drops its directory claims
+  in the same transaction — the directory ends empty;
+- one trace_id joins the whole pull path:
+  fleet.route -> fleet.directory_lookup -> serving.kv_pull (puller) ->
+  {serving.kv_pull (owner), serving.kv_adopt} -> serving.request;
+- the exported spans + /debug/fleet snapshots render the directory and
+  per-rung pull tables in tools/fleet_summary.py.
+
+The seed is embedded in every assertion message for replay.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from k8s_runpod_kubelet_tpu.cloud.faults import (PREEMPTION_STORM, FaultPlan,
+                                                 FaultWindow)
+from k8s_runpod_kubelet_tpu.fleet.handoff import (HandoffError,
+                                                  deserialize_pages,
+                                                  serialize_pages)
+from k8s_runpod_kubelet_tpu.fleet.prefix_directory import (PrefixDirectory,
+                                                           prefix_key)
+from k8s_runpod_kubelet_tpu.fleet.registry import ReplicaRegistry
+from k8s_runpod_kubelet_tpu.fleet.router import (FleetRouter, RouterConfig,
+                                                 serve_router)
+from k8s_runpod_kubelet_tpu.metrics import Metrics
+from k8s_runpod_kubelet_tpu.tracing import (Tracer, format_traceparent,
+                                            parse_traceparent)
+from k8s_runpod_kubelet_tpu.workloads.serving.kv_manager import PagedKVStore
+
+from harness import FakeClock
+
+SEED = 41
+T = 8               # page_tokens
+CACHE_LEN = 64
+N_PAGES = 32
+MODEL = "fabric-fake"
+# the seeded storm window: the OWNER replica dies mid-pull inside it
+KILL_WINDOW = FaultWindow(PREEMPTION_STORM, 5.0, 9.0, 1.0)
+
+PROMPT_A = [((i * 11) % 90) + 1 for i in range(16)]    # pulled (2 pages)
+PROMPT_B = [((i * 13) % 90) + 2 for i in range(16)]    # published-then-gone
+PROMPT_C = [((i * 17) % 90) + 3 for i in range(16)]    # pull torn by kill
+
+
+def _ctx(what: str, plan=None) -> str:
+    msg = f"[kv-fabric seed={SEED}] {what}"
+    if plan is not None:
+        msg += "\n" + plan.describe()
+    return msg
+
+
+def _kv_value(token: int, pos: int, head: int, dim: int) -> float:
+    return float(token) + pos / 100.0 + head / 10.0 + dim / 1000.0
+
+
+def _expected_pages(tokens: list) -> np.ndarray:
+    """(1, n_pages, T, 2, 4) of _kv_value for the run's FULL pages."""
+    n = len(tokens) // T
+    out = np.zeros((1, n, T, 2, 4), np.float32)
+    for p in range(n):
+        for o in range(T):
+            pos = p * T + o
+            for h in range(2):
+                for d in range(4):
+                    out[0, p, o, h, d] = _kv_value(tokens[pos], pos, h, d)
+    return out
+
+
+def _seq_cache(tokens: list) -> np.ndarray:
+    out = np.zeros((1, 1, CACHE_LEN, 2, 4), np.float32)
+    for pos, tok in enumerate(tokens):
+        for h in range(2):
+            for d in range(4):
+                out[0, 0, pos, h, d] = _kv_value(tok, pos, h, d)
+    return out
+
+
+def _make_store() -> PagedKVStore:
+    def factory():
+        return {"k": jnp.zeros((1, 1, CACHE_LEN, 2, 4), jnp.float32),
+                "v": jnp.zeros((1, 1, CACHE_LEN, 2, 4), jnp.float32),
+                "index": jnp.zeros((1,), jnp.int32)}
+    return PagedKVStore(N_PAGES, T, factory)
+
+
+class FabricReplica:
+    """In-process fake replica with a REAL paged arena exposing the KV
+    fabric surface the router touches: /generate (prefill-on-miss +
+    publish), /kv_fetch (cold puller door), /kv_pull (owner door)."""
+
+    def __init__(self, replica_id: str, tracer: Tracer):
+        self.replica_id = replica_id
+        self.tracer = tracer
+        self.store = _make_store()
+        self.lock = threading.Lock()
+        self.pending: list = []          # prefix publishes for the next beat
+        self.prefills: list = []         # token lists this arena computed
+        self.pull_calls: list = []       # token lists /kv_pull was asked for
+        self.saturated = False           # heartbeat advertises zero headroom
+        self.die_mid_pull = False        # next /kv_pull truncates + dies
+        rep = self
+
+        class _H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _json(self, status, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _read(self) -> bytes:
+                length = int(self.headers.get("Content-Length") or 0)
+                return self.rfile.read(length) if length else b""
+
+            def do_POST(self):
+                if self.path == "/kv_fetch":
+                    return rep._kv_fetch(self)
+                if self.path == "/kv_pull":
+                    return rep._kv_pull(self)
+                return rep._generate(self)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), _H)
+        self._httpd.daemon_threads = True
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self._httpd.server_address[1]}"
+
+    # -- serving ---------------------------------------------------------------
+
+    def _generate(self, h):
+        body = json.loads(h._read() or b"{}")
+        tokens = list(body.get("tokens") or [])
+        inbound = parse_traceparent(h.headers.get("traceparent"))
+        now = self.tracer.clock()
+        self.tracer.record(
+            "serving.request", now, now,
+            trace_id=inbound[0] if inbound else None,
+            parent_id=inbound[1] if inbound else "",
+            attrs={"replica_id": self.replica_id})
+        with self.lock:
+            m = self.store.match_full(0, tokens)
+            self.store.release(m.pages)
+            covered = m.matched_tokens
+            if covered < (len(tokens) // T) * T:
+                # prefill: deterministic KV for the whole prompt, then
+                # queue the run's LONGEST key for the next heartbeat —
+                # the engine's _publish_prefix analogue
+                single = {"k": jnp.asarray(_seq_cache(tokens)),
+                          "v": jnp.asarray(_seq_cache(tokens)),
+                          "index": jnp.asarray([len(tokens)], jnp.int32)}
+                self.store.insert(0, tokens, single)
+                self.prefills.append(list(tokens))
+                full = (len(tokens) // T) * T
+                self.pending.append(
+                    {"key": prefix_key(tokens[:full], T),
+                     "pages": full // T, "model": MODEL, "adapter": ""})
+        return h._json(200, {"tokens": [1, 2, 3],
+                             "replica_id": self.replica_id,
+                             "covered_tokens": covered})
+
+    # -- owner door ------------------------------------------------------------
+
+    def _kv_pull(self, h):
+        req = json.loads(h._read() or b"{}")
+        tokens = list(req.get("tokens") or [])
+        self.pull_calls.append(tokens)
+        inbound = parse_traceparent(h.headers.get("traceparent"))
+        now = self.tracer.clock()
+        with self.lock:
+            m = self.store.match_full(0, tokens)
+            try:
+                if m.matched_tokens == 0:
+                    self.tracer.record(
+                        "serving.kv_pull", now, now,
+                        trace_id=inbound[0] if inbound else None,
+                        parent_id=inbound[1] if inbound else "",
+                        attrs={"ok": False, "side": "owner", "gone": True})
+                    return h._json(404, {"ok": False, "gone": True,
+                                         "error": "run not resident"})
+                frags = self.store.export_pages(m.pages)
+                sections = {k: np.asarray(a) for k, a in frags.items()}
+                blob = serialize_pages(tokens[:m.matched_tokens], T,
+                                       sections)
+                n_pages = len(m.pages)
+            finally:
+                self.store.release(m.pages)
+        if self.die_mid_pull:
+            # the seeded kill: headers promise the full blob, half of it
+            # arrives, then the process is gone
+            try:
+                h.send_response(200)
+                h.send_header("Content-Type", "application/octet-stream")
+                h.send_header("Content-Length", str(len(blob)))
+                h.end_headers()
+                h.wfile.write(blob[:len(blob) // 2])
+                h.wfile.flush()
+                h.connection.close()
+            except OSError:
+                pass
+            self.kill()
+            return None
+        self.tracer.record(
+            "serving.kv_pull", now, now,
+            trace_id=inbound[0] if inbound else None,
+            parent_id=inbound[1] if inbound else "",
+            attrs={"ok": True, "side": "owner", "via": "wire",
+                   "pages": n_pages, "bytes": len(blob)})
+        h.send_response(200)
+        h.send_header("Content-Type", "application/octet-stream")
+        h.send_header("Content-Length", str(len(blob)))
+        h.send_header("X-KV-Pages", str(n_pages))
+        h.send_header("X-KV-Covered-Tokens", str(len(tokens)))
+        h.end_headers()
+        h.wfile.write(blob)
+        return None
+
+    # -- cold puller door ------------------------------------------------------
+
+    def _kv_fetch(self, h):
+        req = json.loads(h._read() or b"{}")
+        tokens = list(req.get("tokens") or [])
+        owner_url = str(req.get("owner_url") or "")
+        inbound = parse_traceparent(h.headers.get("traceparent"))
+        trace_id = inbound[0] if inbound else Tracer.new_trace_id()
+        parent = inbound[1] if inbound else ""
+        span_id = Tracer.new_span_id()
+        now = self.tracer.clock()
+
+        def span(ok: bool, attrs: dict):
+            self.tracer.record("serving.kv_pull", now, now,
+                               trace_id=trace_id, span_id=span_id,
+                               parent_id=parent,
+                               attrs={"ok": ok, "side": "puller", **attrs})
+
+        pull = urllib.request.Request(
+            owner_url.rstrip("/") + "/kv_pull",
+            data=json.dumps({"tokens": tokens}).encode(),
+            headers={"Content-Type": "application/json",
+                     "traceparent": format_traceparent(trace_id, span_id)},
+            method="POST")
+        try:
+            with urllib.request.urlopen(pull, timeout=5) as resp:
+                blob = resp.read()
+        except urllib.error.HTTPError as e:
+            body = e.read()
+            parsed = json.loads(body or b"{}") if e.code == 404 else {}
+            if parsed.get("gone"):
+                span(False, {"gone": True, "owner": owner_url})
+                return h._json(200, {"ok": False, "gone": True,
+                                     "error": str(parsed.get("error"))})
+            span(False, {"owner": owner_url, "error": f"HTTP {e.code}"})
+            return h._json(200, {"ok": False, "error": f"HTTP {e.code}"})
+        except Exception as e:  # noqa: BLE001 — transport-shaped: the
+            # torn-blob / dead-owner path the soak exists to exercise
+            span(False, {"owner": owner_url, "error": str(e)})
+            return h._json(200, {"ok": False, "error": str(e)})
+        try:
+            header, sections = deserialize_pages(
+                blob, expect_page_tokens=T,
+                expect_sections=self.store.section_spec())
+            with self.lock:
+                self.store.adopt(0, header["tokens"], sections)
+        except HandoffError as e:
+            span(False, {"owner": owner_url, "error": str(e)})
+            return h._json(200, {"ok": False, "error": str(e)})
+        self.tracer.record("serving.kv_adopt", now, now,
+                           trace_id=trace_id, parent_id=span_id,
+                           attrs={"ok": True, "pages": header["n_pages"],
+                                  "replica_id": self.replica_id})
+        span(True, {"path": "wire", "owner": owner_url,
+                    "pages": header["n_pages"], "bytes": len(blob),
+                    "covered_tokens": len(header["tokens"])})
+        return h._json(200, {"ok": True, "path": "wire",
+                             "pages": header["n_pages"],
+                             "covered_tokens": len(header["tokens"])})
+
+    # -- fleet plumbing --------------------------------------------------------
+
+    def heartbeat_payload(self) -> dict:
+        stats = {"free_slots": 0 if self.saturated else 4,
+                 "active_slots": 4 if self.saturated else 0,
+                 "max_slots": 4, "max_queue_depth": 8,
+                 "queue_depth": 8 if self.saturated else 0,
+                 "draining": False}
+        body = {"replica_id": self.replica_id, "stats": stats}
+        with self.lock:
+            if self.pending:
+                body["prefixes"], self.pending = self.pending, []
+        return body
+
+    def assert_no_leaks(self, plan):
+        s = self.store.stats()
+        assert s["pages_free"] + s["nodes"] == s["pages_total"], _ctx(
+            f"{self.replica_id}: leaked pages — free {s['pages_free']} + "
+            f"trie {s['nodes']} != total {s['pages_total']}", plan)
+        for node in self.store.trie._nodes.values():
+            assert self.store.pool.refcount(node.page) == 1, _ctx(
+                f"{self.replica_id}: dangling reference on page "
+                f"{node.page}", plan)
+
+    def kill(self):
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except OSError:
+            pass
+
+
+def test_kv_fabric_soak_tier1(tmp_path):
+    clock = FakeClock()
+    metrics = Metrics()
+    tracer = Tracer(export_path=str(tmp_path / "spans.jsonl"), clock=clock)
+    directory = PrefixDirectory(metrics=metrics)
+    registry = ReplicaRegistry(metrics=metrics, tracer=tracer, clock=clock,
+                               heartbeat_timeout_s=4.0,
+                               breaker_failure_threshold=3,
+                               breaker_reset_s=60.0, directory=directory)
+    router = FleetRouter(
+        registry, RouterConfig(max_attempts=3, request_timeout_s=10.0,
+                               kv_page_tokens=T, pull_timeout_s=5.0),
+        metrics=metrics, tracer=tracer, clock=clock, directory=directory)
+    httpd = serve_router(router, port=0)
+    port = httpd.server_address[1]
+    plan = FaultPlan(SEED, clock, horizon_s=30.0, windows=[KILL_WINDOW])
+
+    def post(path, payload, headers=None):
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=15)
+        try:
+            c.request("POST", path, body=json.dumps(payload).encode(),
+                      headers={"Content-Type": "application/json",
+                               **(headers or {})})
+            r = c.getresponse()
+            body = r.read()
+            return r.status, (json.loads(body) if body else {})
+        finally:
+            c.close()
+
+    def debug_fleet() -> dict:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/fleet", timeout=5) as resp:
+            return json.loads(resp.read())
+
+    owner = FabricReplica("own-0", tracer)
+    cold = FabricReplica("cold-0", tracer)
+    reps = {"own-0": owner, "cold-0": cold}
+    killed: set = set()
+    probe = ("f" * 32, "9a7d6b7169203331")
+    key_a, key_b = prefix_key(PROMPT_A, T), prefix_key(PROMPT_B, T)
+    try:
+        for rid, rep in reps.items():
+            status, out = post("/fleet/register",
+                               {"replica_id": rid, "base_url": rep.url})
+            assert status == 200, _ctx(f"register {rid} -> {status} {out}")
+
+        # warm the owner DIRECTLY (the router pick is exercised on the
+        # cold side): it prefills A and C, and claims B it never kept —
+        # the published-then-evicted staleness the gone path exists for
+        for prompt in (PROMPT_A, PROMPT_C):
+            with urllib.request.urlopen(urllib.request.Request(
+                    owner.url + "/generate",
+                    data=json.dumps({"tokens": prompt}).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST"), timeout=5) as resp:
+                assert json.loads(resp.read())["covered_tokens"] == 0
+        owner.pending.append({"key": key_b, "pages": 2, "model": MODEL,
+                              "adapter": ""})
+        # from here the owner advertises ZERO headroom: every routed
+        # request deterministically picks the cold replica
+        owner.saturated = True
+
+        outcomes = []                    # (tick, prompt, status, body)
+        snapshots = []                   # per-tick /debug/fleet payloads
+        kill_tick = None
+        for tick in range(16):
+            clock.advance(1.0)
+            t = tick + 1
+            for rid, rep in reps.items():
+                if rid not in killed:
+                    st, out = post("/fleet/heartbeat",
+                                   rep.heartbeat_payload())
+                    assert st == 200, _ctx(f"heartbeat {rid}: {st} {out}")
+            victims = plan.preempt_victims(
+                sorted(r for r in reps if r not in killed
+                       and r == "own-0"))
+            if victims:
+                owner.die_mid_pull = True
+                killed.add("own-0")
+                kill_tick = t
+            registry.sweep()
+            req = None
+            if t == 2:
+                # the traced pull round: cold pick adopts A from the owner
+                req = (PROMPT_A,
+                       {"traceparent": f"00-{probe[0]}-{probe[1]}-01"})
+            elif t == 4:
+                req = (PROMPT_B, {})     # published-then-gone
+            elif kill_tick == t:
+                req = (PROMPT_C, {})     # the pull the kill tears
+            if req is not None:
+                status, out = post("/generate",
+                                   {"tokens": list(req[0]),
+                                    "max_new_tokens": 4}, headers=req[1])
+                outcomes.append((t, req[0], status, out))
+                assert status == 200, _ctx(f"t={t} -> {status} {out}", plan)
+            snapshots.append(debug_fleet())
+
+        # -- 1. every request answered 200, all by the COLD replica ----------
+        assert len(outcomes) == 3 and killed, \
+            _ctx(f"storm/requests misfired: {outcomes}", plan)
+        assert all(o[3].get("replica_id") == "cold-0" for o in outcomes), \
+            _ctx(f"saturated owner still picked: {outcomes}", plan)
+
+        # -- 2. the pull round adopted instead of re-prefilling, BIT-true ----
+        a_out = outcomes[0][3]
+        assert a_out["covered_tokens"] == 16, \
+            _ctx(f"cold replica did not hold A's pages: {a_out}", plan)
+        assert PROMPT_A not in cold.prefills, \
+            _ctx("cold replica re-prefilled a pulled prompt", plan)
+        m = cold.store.match_full(0, PROMPT_A)
+        try:
+            got = np.asarray(cold.store.export_pages(m.pages)["k"])
+        finally:
+            cold.store.release(m.pages)
+        np.testing.assert_allclose(
+            got, _expected_pages(PROMPT_A), rtol=0, atol=0,
+            err_msg=_ctx("pulled KV != owner's prefilled KV", plan))
+
+        # -- 3. GONE: one owner round-trip, claim invalidated, prefilled ----
+        assert [c for c in owner.pull_calls if c == PROMPT_B] == [PROMPT_B], \
+            _ctx(f"gone pull retried: {owner.pull_calls}", plan)
+        # the OWNER's stale claim dropped; the entry seen now is the cold
+        # replica's own republish after it prefilled B for itself
+        found = directory.lookup([key_b])
+        assert found is None or found[1]["holders"] == ["cold-0"], \
+            _ctx(f"gone claim survived in the directory: {found}", plan)
+        assert metrics.get_counter(
+            "tpu_fleet_prefix_directory_invalidations",
+            labels={"reason": "gone"}) == 1, _ctx("gone not counted", plan)
+        assert PROMPT_B in cold.prefills, \
+            _ctx("request after gone pull never prefilled", plan)
+
+        # -- 4. the mid-pull kill: torn blob rejected, request prefilled,
+        # the sweep dropped the corpse's claims ------------------------------
+        assert PROMPT_C in cold.prefills, \
+            _ctx("request after torn pull never prefilled", plan)
+        assert [c for c in owner.pull_calls if c == PROMPT_C] == [PROMPT_C], \
+            _ctx(f"torn pull retried: {owner.pull_calls}", plan)
+        # only the dead owner ever held A (the cold side ADOPTED it, which
+        # is not a publish in this fake): its eviction must have dropped
+        # the claim, and every surviving entry belongs to the cold replica
+        assert directory.lookup([key_a]) is None, _ctx(
+            f"directory kept a dead replica's claims: "
+            f"{directory.snapshot()}", plan)
+        assert all(e["holders"] == ["cold-0"]
+                   for e in directory.snapshot()["entries"].values()), \
+            _ctx(f"corpse claims survive: {directory.snapshot()}", plan)
+        assert metrics.get_counter(
+            "tpu_fleet_prefix_directory_invalidations",
+            labels={"reason": "departed"}) >= 1, \
+            _ctx("eviction never dropped the owner's claims", plan)
+        assert "own-0" not in {r.replica_id for r in registry.ready()}, \
+            _ctx("dead owner still ready", plan)
+        fail_spans = [s for s in tracer.recent(4096)
+                      if s["name"] == "fleet.directory_lookup"
+                      and s["attrs"]["outcome"] == "failed"]
+        assert fail_spans, _ctx("torn pull recorded no failed lookup", plan)
+
+        # -- 5. zero leaked pages on BOTH arenas -----------------------------
+        owner.assert_no_leaks(plan)
+        cold.assert_no_leaks(plan)
+
+        # -- 6. one trace_id joins the pull path -----------------------------
+        spans = {}
+        for s in tracer.get_trace(probe[0]):
+            spans.setdefault((s["name"],
+                              s["attrs"].get("side", "")), s)
+        route = spans[("fleet.route", "")]
+        lookup = spans[("fleet.directory_lookup", "")]
+        puller = spans[("serving.kv_pull", "puller")]
+        owner_s = spans[("serving.kv_pull", "owner")]
+        adopt = spans[("serving.kv_adopt", "")]
+        served = spans[("serving.request", "")]
+        assert route["parent_id"] == probe[1]
+        assert lookup["parent_id"] == route["span_id"], \
+            _ctx("directory_lookup not under fleet.route", plan)
+        assert lookup["attrs"]["outcome"] == "pulled" \
+            and lookup["attrs"]["key"] == key_a \
+            and lookup["attrs"]["owner"] == "own-0", \
+            _ctx(f"lookup span wrong: {lookup['attrs']}", plan)
+        assert puller["parent_id"] == lookup["span_id"], \
+            _ctx("puller kv_pull not under directory_lookup", plan)
+        assert owner_s["parent_id"] == puller["span_id"], \
+            _ctx("owner kv_pull not under the puller's span", plan)
+        assert adopt["parent_id"] == puller["span_id"], \
+            _ctx("kv_adopt not under the puller's span", plan)
+        assert served["parent_id"] == route["span_id"], \
+            _ctx("serving.request not under fleet.route", plan)
+        assert puller["attrs"]["path"] == "wire" \
+            and puller["attrs"]["pages"] == 2
+
+        # -- 7. the exported JSONL renders the fabric tables -----------------
+        tracer.close()
+        import pathlib
+        import sys
+        sys.path.insert(0, str(pathlib.Path(__file__).parent.parent
+                               / "tools"))
+        import fleet_summary
+        spans_l, _ = fleet_summary.load(str(tmp_path / "spans.jsonl"))
+        assert spans_l, _ctx("trace export is empty", plan)
+        # trim to the pre-kill captures: the directory snapshot table
+        # renders the LATEST capture, and the fabric was warm then
+        out_text = fleet_summary.render(spans_l, snapshots[:4])
+        assert "directory lookups" in out_text, _ctx(out_text, plan)
+        assert "KV pulls per rung" in out_text, _ctx(out_text, plan)
+        assert "wire" in out_text and "cold-0" in out_text, \
+            _ctx(f"pull tables incomplete:\n{out_text}", plan)
+        assert "prefix directory snapshot" in out_text, \
+            _ctx(f"directory snapshot missing:\n{out_text}", plan)
+        assert key_a[:16] in out_text, \
+            _ctx(f"published key missing from the snapshot:\n{out_text}",
+                 plan)
+    finally:
+        tracer.close()
+        httpd.shutdown()
+        for rep in reps.values():
+            rep.kill()

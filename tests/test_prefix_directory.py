@@ -1,0 +1,364 @@
+"""Global prefix directory units (ISSUE 16): key-chain algebra, the
+bounded-LRU claim table, registry/directory same-transaction consistency,
+the heartbeat publish loop (pending-until-acked), and the router's
+directory-planned pull hop with every outcome the fleet.directory_lookup
+span can record — miss / local / no_owner / pulled / gone / failed —
+including the two consistency pins the satellites name:
+
+- a pull that comes back GONE invalidates exactly ONE holder claim and
+  never retries (no retry storm);
+- evict / drain / deregister drop the departing replica's claims in the
+  same call that changes membership, so no pull can be planned against a
+  corpse.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from k8s_runpod_kubelet_tpu.cloud.transport import TransportError
+from k8s_runpod_kubelet_tpu.fleet.prefix_directory import (PrefixDirectory,
+                                                           prefix_key,
+                                                           prefix_key_chain)
+from k8s_runpod_kubelet_tpu.fleet.registry import (ReplicaRegistry,
+                                                   ReplicaReporter)
+from k8s_runpod_kubelet_tpu.fleet.router import FleetRouter, RouterConfig
+from k8s_runpod_kubelet_tpu.metrics import Metrics
+from k8s_runpod_kubelet_tpu.tracing import Tracer
+
+T = 8
+PROMPT = [((i * 7) % 90) + 1 for i in range(T * 3)]     # 3 full pages
+
+
+class TestKeyChain:
+    def test_one_key_per_full_page_boundary(self):
+        assert len(prefix_key_chain(PROMPT, T)) == 3
+        # a partial tail page never gets a key
+        assert len(prefix_key_chain(PROMPT + [5, 6], T)) == 3
+        assert prefix_key_chain(PROMPT[:T - 1], T) == []
+        assert prefix_key(PROMPT[:T - 1], T) == ""
+
+    def test_extension_chain_contains_shorter_prompts_chain(self):
+        """The property the whole directory rides on: a holder publishes
+        its run's LONGEST key, and any longer request's chain contains
+        it — so incremental hashing, not substring luck."""
+        short = prefix_key_chain(PROMPT[:T * 2], T)
+        long = prefix_key_chain(PROMPT + [9] * T, T)
+        assert long[:2] == short
+        assert prefix_key(PROMPT[:T * 2], T) == long[1]
+
+    def test_keys_diverge_at_first_differing_page(self):
+        other = list(PROMPT)
+        other[T] += 1                       # mutate page 1, page 0 intact
+        a, b = prefix_key_chain(PROMPT, T), prefix_key_chain(other, T)
+        assert a[0] == b[0] and a[1] != b[1] and a[2] != b[2]
+
+    def test_seed_binds_page_size_and_adapter(self):
+        base = prefix_key(PROMPT, T)
+        assert prefix_key(PROMPT, T, adapter="lora-a") != base
+        assert prefix_key(PROMPT[:T * 2], T * 2) != prefix_key(
+            PROMPT[:T * 2], T)
+
+    def test_bad_page_tokens_raises(self):
+        with pytest.raises(ValueError):
+            prefix_key_chain(PROMPT, 0)
+
+
+class TestPrefixDirectory:
+    def _pub(self, key, pages=3, model="m", adapter=""):
+        return {"key": key, "pages": pages, "model": model,
+                "adapter": adapter}
+
+    def test_publish_lookup_longest_first(self):
+        d = PrefixDirectory(metrics=Metrics())
+        chain = prefix_key_chain(PROMPT, T)
+        assert d.publish("rep-a", [self._pub(chain[1], pages=2)]) == 1
+        # the router walks LONGEST-first: the deepest published key wins
+        key, entry = d.lookup(list(reversed(chain)))
+        assert key == chain[1]
+        assert entry == {"pages": 2, "model": "m", "adapter": "",
+                         "holders": ["rep-a"]}
+        assert d.metrics.get_counter(
+            "tpu_fleet_prefix_directory_hits") == 1
+        assert d.lookup(["nope"]) is None
+
+    def test_malformed_publishes_skipped_not_poisonous(self):
+        d = PrefixDirectory()
+        landed = d.publish("rep-a", [None, {"pages": 1}, {"key": ""},
+                                     self._pub("good"), "junk"])
+        assert landed == 1 and len(d) == 1
+
+    def test_empty_replica_id_publishes_nothing(self):
+        d = PrefixDirectory()
+        assert d.publish("", [self._pub("k")]) == 0 and len(d) == 0
+
+    def test_lru_bound_evicts_coldest(self):
+        d = PrefixDirectory(metrics=Metrics(), max_entries=3)
+        for i in range(3):
+            d.publish("rep-a", [self._pub(f"k{i}")])
+        assert d.lookup(["k0"]) is not None    # refresh k0's position
+        d.publish("rep-a", [self._pub("k3")])
+        assert len(d) == 3
+        assert d.lookup(["k1"]) is None, "k1 was coldest, must evict"
+        assert d.lookup(["k0"]) is not None
+        assert d.metrics.gauges[
+            ("tpu_fleet_prefix_directory_entries", ())] == 3
+
+    def test_invalidate_drops_one_claim_entry_dies_with_last(self):
+        d = PrefixDirectory(metrics=Metrics())
+        d.publish("rep-a", [self._pub("k")])
+        d.publish("rep-b", [self._pub("k")])
+        assert d.invalidate("k", "rep-a") is True
+        _, entry = d.lookup(["k"])
+        assert entry["holders"] == ["rep-b"]
+        # idempotent: the raced double-invalidate neither throws nor
+        # double-counts
+        assert d.invalidate("k", "rep-a") is False
+        assert d.metrics.get_counter(
+            "tpu_fleet_prefix_directory_invalidations",
+            labels={"reason": "gone"}) == 1
+        assert d.invalidate("k", "rep-b") is True
+        assert d.lookup(["k"]) is None and len(d) == 0
+
+    def test_drop_replica_clears_every_claim(self):
+        d = PrefixDirectory(metrics=Metrics())
+        d.publish("rep-a", [self._pub("k1"), self._pub("k2")])
+        d.publish("rep-b", [self._pub("k2")])
+        assert d.drop_replica("rep-a") == 2
+        assert d.lookup(["k1"]) is None
+        _, entry = d.lookup(["k2"])
+        assert entry["holders"] == ["rep-b"]
+        assert d.metrics.get_counter(
+            "tpu_fleet_prefix_directory_invalidations",
+            labels={"reason": "departed"}) == 2
+        assert d.drop_replica("rep-a") == 0
+
+    def test_snapshot_shape(self):
+        d = PrefixDirectory(max_entries=16)
+        d.publish("rep-a", [self._pub("k", pages=4, model="tiny",
+                                      adapter="lo")])
+        snap = d.snapshot()
+        assert snap == {"entries": {"k": {"pages": 4, "model": "tiny",
+                                          "adapter": "lo",
+                                          "holders": ["rep-a"]}},
+                        "size": 1, "max_entries": 16}
+
+    def test_bad_max_entries_raises(self):
+        with pytest.raises(ValueError):
+            PrefixDirectory(max_entries=0)
+
+
+class TestRegistryDirectoryConsistency:
+    """Membership changes and directory claims move in the SAME call."""
+
+    def _fleet(self):
+        d = PrefixDirectory(metrics=Metrics())
+        reg = ReplicaRegistry(transport_factory=lambda url: None,
+                              probe_fn=lambda rep: True, directory=d)
+        reg.register("rep-a", "http://a:1")
+        reg.heartbeat("rep-a", {"free_slots": 4, "max_slots": 4},
+                      prefixes=[{"key": "k", "pages": 2, "model": "m"}])
+        assert len(d) == 1
+        return d, reg
+
+    def test_heartbeat_publishes_for_ready_replica(self):
+        d, _ = self._fleet()
+        _, entry = d.lookup(["k"])
+        assert entry["holders"] == ["rep-a"]
+
+    def test_draining_heartbeat_drops_instead_of_publishing(self):
+        d, reg = self._fleet()
+        reg.heartbeat("rep-a", {"free_slots": 4, "max_slots": 4,
+                                "draining": True},
+                      prefixes=[{"key": "k2", "pages": 1}])
+        assert len(d) == 0, "a leaving replica's claims must drop, and " \
+                            "its publish batch must be refused"
+
+    @pytest.mark.parametrize("leave", ["evict", "deregister",
+                                       "mark_draining"])
+    def test_departure_drops_claims_same_transaction(self, leave):
+        d, reg = self._fleet()
+        if leave == "evict":
+            reg.evict("rep-a", "probe_failed")
+        elif leave == "deregister":
+            reg.deregister("rep-a")
+        else:
+            reg.mark_draining("rep-a")
+        assert len(d) == 0
+        assert d.metrics.get_counter(
+            "tpu_fleet_prefix_directory_invalidations",
+            labels={"reason": "departed"}) == 1
+
+
+class TestReporterPublishLoop:
+    """beat_once piggybacks pending publishes and gives them back when
+    the beat fails — pending-until-acked, not fire-and-forget."""
+
+    class _Eng:
+        draining = False
+        drained = False
+
+        def __init__(self):
+            self.pending = [{"key": "k", "pages": 2, "model": "m",
+                             "adapter": ""}]
+            self.requeued = []
+
+        def take_prefix_publishes(self):
+            out, self.pending = self.pending, []
+            return out
+
+        def requeue_prefix_publishes(self, pubs):
+            self.requeued.extend(pubs)
+
+    def _reporter(self, post_fn):
+        eng = self._Eng()
+        rep = ReplicaReporter(eng, "http://router:1", "rep-a",
+                              "http://a:1", post_fn=post_fn)
+        rep.stats = lambda: {"free_slots": 4, "max_slots": 4}
+        return eng, rep
+
+    def test_beat_carries_prefixes_once(self):
+        beats = []
+        eng, rep = self._reporter(lambda p, body: beats.append((p, body))
+                                  or {"registered": True})
+        assert rep.beat_once() and rep.beat_once()
+        hb = [b for p, b in beats if p == "/fleet/heartbeat"]
+        assert hb[0]["prefixes"] == [{"key": "k", "pages": 2, "model": "m",
+                                      "adapter": ""}]
+        assert "prefixes" not in hb[1], "acked publishes must not repeat"
+
+    def test_failed_beat_requeues_publishes(self):
+        def boom(path, body):
+            raise TransportError("router down")
+
+        eng, rep = self._reporter(boom)
+        with pytest.raises(TransportError):
+            rep.beat_once()
+        assert eng.requeued and eng.requeued[0]["key"] == "k"
+
+
+class TestRouterPullHop:
+    """maybe_pull plans the /kv_fetch hop and records one
+    fleet.directory_lookup span per consulted request."""
+
+    def _fleet(self, reply=None, exc=None, holder="own-0",
+               pick="cold-0", domains=("", ""), enabled=True):
+        metrics = Metrics()
+        directory = PrefixDirectory(metrics=metrics)
+        reg = ReplicaRegistry(transport_factory=lambda url: None,
+                              probe_fn=lambda rep: True,
+                              directory=directory)
+        calls = []
+
+        class _Stub:
+            breaker = None
+
+            def request(self, method, path, body=None, **kw):
+                calls.append((path, body))
+                if exc is not None:
+                    raise exc
+                return reply
+
+        for rid, dom in (("own-0", domains[0]), ("cold-0", domains[1])):
+            reg.register(rid, f"http://{rid}:1", placement_domain=dom)
+            reg.heartbeat(rid, {"free_slots": 4, "max_slots": 4})
+            reg.get(rid).transport = _Stub()
+        rt = FleetRouter(reg, RouterConfig(kv_page_tokens=T,
+                                           prefix_directory_enabled=enabled),
+                         metrics=metrics, tracer=Tracer(),
+                         directory=directory)
+        key = prefix_key(PROMPT, T)
+        directory.publish(holder, [{"key": key, "pages": 3, "model": "m",
+                                    "adapter": ""}])
+        return rt, reg, directory, calls, key
+
+    def _pull(self, rt, reg, pick="cold-0", payload=None):
+        trace = rt.trace_ctx(None)
+        rt.maybe_pull("/generate", payload or {"tokens": list(PROMPT)},
+                      reg.get(pick), trace)
+        return [s for s in rt.tracer.recent()
+                if s["name"] == "fleet.directory_lookup"]
+
+    def test_pulled_outcome_posts_kv_fetch_with_owner(self):
+        rt, reg, d, calls, key = self._fleet(
+            reply={"ok": True, "path": "wire", "pages": 3})
+        spans = self._pull(rt, reg)
+        (path, body), = calls
+        assert path == "/kv_fetch"
+        assert body["tokens"] == PROMPT and body["adapter"] == ""
+        assert body["owner_url"] == "http://own-0:1"
+        assert body["model"] == "m"
+        attrs = spans[-1]["attrs"]
+        assert attrs["outcome"] == "pulled" and attrs["path"] == "wire"
+        assert attrs["pages"] == 3 and attrs["key"] == key
+        assert attrs["owner"] == "own-0"
+
+    def test_local_holder_never_fetches(self):
+        rt, reg, d, calls, _ = self._fleet(holder="cold-0")
+        spans = self._pull(rt, reg)
+        assert not calls
+        assert spans[-1]["attrs"]["outcome"] == "local"
+
+    def test_miss_and_short_prompts_skip_quietly(self):
+        rt, reg, d, calls, _ = self._fleet()
+        spans = self._pull(rt, reg,
+                           payload={"tokens": [3] * (T * 2)})  # unpublished
+        assert spans[-1]["attrs"]["outcome"] == "miss" and not calls
+        # under one page / text prompts: no lookup, no span at all
+        n = len(spans)
+        assert len(self._pull(rt, reg,
+                              payload={"tokens": [1] * (T - 1)})) == n
+        assert len(self._pull(rt, reg, payload={"text": "hi"})) == n
+
+    def test_no_ready_owner(self):
+        rt, reg, d, calls, _ = self._fleet()
+        reg.evict("own-0", "probe_failed")     # also drops the claim...
+        d.publish("own-0", [{"key": prefix_key(PROMPT, T), "pages": 3}])
+        spans = self._pull(rt, reg)            # ...so re-publish a corpse
+        assert spans[-1]["attrs"]["outcome"] == "no_owner" and not calls
+
+    def test_gone_invalidates_exactly_one_claim_no_retry(self):
+        rt, reg, d, calls, key = self._fleet(
+            reply={"ok": False, "gone": True, "error": "evicted"})
+        d.publish("other-0", [{"key": key, "pages": 3}])
+        spans = self._pull(rt, reg)
+        assert len(calls) == 1, "GONE must never retry"
+        assert spans[-1]["attrs"]["outcome"] == "gone"
+        _, entry = d.lookup([key])
+        assert entry["holders"] == ["other-0"], \
+            "only the gone holder's claim drops"
+        assert d.metrics.get_counter(
+            "tpu_fleet_prefix_directory_invalidations",
+            labels={"reason": "gone"}) == 1
+
+    def test_transport_failure_keeps_the_claim(self):
+        rt, reg, d, calls, key = self._fleet(
+            exc=TransportError("replica hiccup"))
+        spans = self._pull(rt, reg)
+        assert spans[-1]["attrs"]["outcome"] == "failed"
+        assert d.lookup([key]) is not None, \
+            "a transport failure says nothing about the owner's pages"
+
+    def test_plain_failure_keeps_the_claim(self):
+        rt, reg, d, calls, key = self._fleet(
+            reply={"ok": False, "error": "cross-model"})
+        spans = self._pull(rt, reg)
+        assert spans[-1]["attrs"]["outcome"] == "failed"
+        assert d.lookup([key]) is not None
+
+    def test_same_domain_owner_preferred(self):
+        rt, reg, d, calls, key = self._fleet(
+            reply={"ok": True, "path": "shm", "pages": 3},
+            domains=("slice:a:h1", "slice:a:h1"))
+        d.publish("far-0", [{"key": key, "pages": 3}])
+        reg.register("far-0", "http://far-0:1",
+                     placement_domain="slice:b:h9")
+        reg.heartbeat("far-0", {"free_slots": 4, "max_slots": 4})
+        self._pull(rt, reg)
+        (_, body), = calls
+        assert body["owner_url"] == "http://own-0:1"
+        assert body["owner_domain"] == "slice:a:h1"
+
+    def test_disabled_directory_is_a_noop(self):
+        rt, reg, d, calls, _ = self._fleet(enabled=False)
+        assert self._pull(rt, reg) == [] and not calls

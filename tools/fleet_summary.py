@@ -21,6 +21,11 @@ Both may be mixed in one file. Output:
   hop shows the transfer path it took, device or wire);
 - a per-path / per-domain handoff rollup (device-native vs wire KV
   movement, hop latency percentiles per placement domain);
+- the KV-fabric view (ISSUE 16): directory-lookup outcomes per routed
+  replica (fleet.directory_lookup spans — pulled / local / miss / gone /
+  no_owner / failed), a per-rung pull rollup (serving.kv_pull spans:
+  device / shm / wire pages+bytes+latency), and the latest directory
+  snapshot (entries + holders) when /debug/fleet lines carry one;
 - per-stream CHUNK timelines for streamed handoffs: each frame's
   compute (serving.kv_chunk), push (serving.kv_push) and decode-side
   adopt (serving.kv_adopt_chunk) spans joined per seq;
@@ -248,6 +253,97 @@ def handoff_rollup(spans: list[dict]) -> list[str]:
     return out
 
 
+def directory_table(spans: list[dict],
+                    snapshots: list[dict]) -> list[str]:
+    """KV-fabric directory view (ISSUE 16): lookup outcomes per routed
+    replica (how often the fleet directory turned a would-be re-prefill
+    into a pull — or answered local / miss / gone) and the latest
+    directory contents when a /debug/fleet snapshot carries them."""
+    lookups = [s for s in spans
+               if s.get("name") == "fleet.directory_lookup"]
+    out: list[str] = []
+    if lookups:
+        outcomes = ("pulled", "local", "miss", "no_owner", "gone",
+                    "failed")
+        per: dict[str, dict] = defaultdict(
+            lambda: {o: 0 for o in outcomes} | {"n": 0, "durs": []})
+        for s in lookups:
+            a = s.get("attrs", {})
+            row = per[str(a.get("replica_id") or "(unrouted)")]
+            row["n"] += 1
+            oc = str(a.get("outcome") or "")
+            if oc in outcomes:
+                row[oc] += 1
+            row["durs"].append(float(s.get("duration_s", 0.0)))
+        out += ["", "== directory lookups (fleet.directory_lookup "
+                    "spans) ==",
+                f"{'replica':<20} {'lookups':>8} {'pulled':>7} "
+                f"{'local':>6} {'miss':>6} {'noown':>6} {'gone':>5} "
+                f"{'failed':>7} {'p95':>9}"]
+        for rid in sorted(per, key=lambda r: -per[r]["n"]):
+            row = per[rid]
+            durs = sorted(row["durs"])
+            out.append(f"{rid:<20} {row['n']:>8} {row['pulled']:>7} "
+                       f"{row['local']:>6} {row['miss']:>6} "
+                       f"{row['no_owner']:>6} {row['gone']:>5} "
+                       f"{row['failed']:>7} "
+                       f"{_fmt_ms(percentile(durs, 95)):>9}")
+    latest = None
+    for snap in snapshots:  # later lines win, like load_table
+        if isinstance(snap.get("directory"), dict):
+            latest = snap["directory"]
+    if latest is not None:
+        entries = latest.get("entries") or {}
+        out += ["", f"== prefix directory snapshot "
+                    f"({latest.get('size', len(entries))} entries, "
+                    f"cap {latest.get('max_entries', '?')}) =="]
+        for key in sorted(entries):
+            e = entries[key] or {}
+            adapter = e.get("adapter") or "-"
+            out.append(f"  {key[:16]} pages={e.get('pages', 0)} "
+                       f"model={e.get('model', '?')} adapter={adapter} "
+                       f"holders={','.join(e.get('holders') or []) or '-'}")
+    return out
+
+
+def pull_rollup(spans: list[dict]) -> list[str]:
+    """Per-rung pull rollup (ISSUE 16): how much KV the fabric moved via
+    directory pulls on each rung (device / shm / wire) — the pull-side
+    sibling of handoff_rollup. Puller-side serving.kv_pull spans only
+    (the owner's export span would double-count the hop)."""
+    pulls = [s for s in spans if s.get("name") == "serving.kv_pull"
+             and (s.get("attrs") or {}).get("side") == "puller"]
+    if not pulls:
+        return []
+    per: dict[str, dict] = defaultdict(
+        lambda: {"n": 0, "ok": 0, "gone": 0, "pages": 0, "bytes": 0,
+                 "durs": []})
+    for s in pulls:
+        a = s.get("attrs", {})
+        key = str(a.get("path") or ("gone" if a.get("gone") else "failed"))
+        row = per[key]
+        row["n"] += 1
+        if a.get("ok"):
+            row["ok"] += 1
+            row["pages"] += int(a.get("pages") or 0)
+            row["bytes"] += int(a.get("bytes") or 0)
+        if a.get("gone"):
+            row["gone"] += 1
+        row["durs"].append(float(s.get("duration_s", 0.0)))
+    out = ["", "== KV pulls per rung (serving.kv_pull spans) ==",
+           f"{'rung':<8} {'pulls':>6} {'ok':>5} {'gone':>5} "
+           f"{'pages':>8} {'bytes':>12} {'p50':>9} {'p95':>9}"]
+    for key in sorted(per):
+        row = per[key]
+        durs = sorted(row["durs"])
+        out.append(f"{key:<8} {row['n']:>6} {row['ok']:>5} "
+                   f"{row['gone']:>5} {row['pages']:>8} "
+                   f"{row['bytes']:>12} "
+                   f"{_fmt_ms(percentile(durs, 50)):>9} "
+                   f"{_fmt_ms(percentile(durs, 95)):>9}")
+    return out
+
+
 def chunk_timeline(spans: list[dict], top: int) -> list[str]:
     """Per-stream chunk timeline for STREAMED handoffs (ISSUE 10): the
     prefill side's serving.kv_chunk (compute) / serving.kv_push
@@ -327,6 +423,8 @@ def render(spans: list[dict], snapshots: list[dict], top: int = 20) -> str:
     lines += load_table(snapshots)
     lines += two_hop_table(spans, top)
     lines += handoff_rollup(spans)
+    lines += directory_table(spans, snapshots)
+    lines += pull_rollup(spans)
     lines += chunk_timeline(spans, top)
     lines += event_timeline(spans, top)
     return "\n".join(lines)

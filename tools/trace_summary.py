@@ -115,6 +115,8 @@ def rollups(spans: list[dict]) -> str:
     steps, stragglers, runs = [], 0, []
     chunk_computes, chunk_pushes = [], []
     handoff_paths: dict[str, list[float]] = defaultdict(list)
+    dir_outcomes: dict[str, int] = defaultdict(int)
+    pull_paths: dict[str, list[float]] = defaultdict(list)
     for s in spans:
         attrs = s.get("attrs") or {}
         if s["name"] == "serving.request":
@@ -135,6 +137,14 @@ def rollups(spans: list[dict]) -> str:
         elif s["name"] == "fleet.handoff":
             handoff_paths[str(attrs.get("path") or "wire")].append(
                 s.get("duration_s", 0.0))
+        # KV fabric (ISSUE 16): directory lookups + per-rung pulls
+        elif s["name"] == "fleet.directory_lookup":
+            dir_outcomes[str(attrs.get("outcome") or "?")] += 1
+        elif s["name"] == "serving.kv_pull" \
+                and attrs.get("side") == "puller":
+            rung = str(attrs.get("path")
+                       or ("gone" if attrs.get("gone") else "failed"))
+            pull_paths[rung].append(s.get("duration_s", 0.0))
         # training span families (ISSUE 5: one tool renders both layers;
         # tools/goodput_summary.py draws the full goodput waterfall)
         elif s["name"] == "training.step":
@@ -169,6 +179,17 @@ def rollups(spans: list[dict]) -> str:
                          f"(p50={percentile(durs, 50):.4f}s)")
         lines.append("fleet handoffs by path: " + "  ".join(parts)
                      + "  (per-domain rollup: tools/fleet_summary.py)")
+    if dir_outcomes:
+        lines.append("directory lookups: " + "  ".join(
+            f"{oc}={dir_outcomes[oc]}" for oc in sorted(dir_outcomes)))
+    if pull_paths:
+        parts = []
+        for rung in sorted(pull_paths):
+            durs = sorted(pull_paths[rung])
+            parts.append(f"{rung}={len(durs)} "
+                         f"(p50={percentile(durs, 50):.4f}s)")
+        lines.append("KV pulls by rung: " + "  ".join(parts)
+                     + "  (per-rung rollup: tools/fleet_summary.py)")
     if steps or runs:
         lines.append(f"training steps: {len(steps)}"
                      + (f"  straggler events: {stragglers}" if stragglers
