@@ -88,6 +88,12 @@ class LockDisciplineChecker(Checker):
             "(its docstring: single GIL-atomic reads, may straddle a step); "
             "the authoritative drain check (`drained`) reads _transit under "
             "_transit_lock",
+        ("workloads/serving/engine.py", "ServingEngine._ring_recycled"):
+            "engine-thread-only counter: the ring-window recycle in "
+            "_grow_slot_table and the drain in _arena_step_stats both run "
+            "on the engine thread (decode loop), so no concurrent access "
+            "exists — the increment merely happens to sit inside the "
+            "prefix-lock block that guards the ARENA mutation next to it",
         ("workloads/serving/engine.py", "ServingEngine._kv_store"):
             "the reference is rebound ONLY by the engine thread's crash "
             "recovery (under _prefix_lock, after every in-flight future "

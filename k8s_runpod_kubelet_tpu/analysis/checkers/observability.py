@@ -18,11 +18,18 @@ Rules:
 - metric/span call sites whose name is NOT a literal are findings too —
   a computed name escapes this lint, so each needs an allowlist entry
   explaining why (build variability into labels/attrs instead);
-- a ``describe()`` for a name no call site emits is dead catalogue.
+- a ``describe()`` for a name no call site emits is dead catalogue;
+- a gauge set with a PER-ENTITY label (``pod``/``pod_name``/``replica``/
+  ``replica_id`` in a literal labels dict) must have a
+  ``remove_gauge(name)`` call somewhere in the package — the PR 5
+  stalled-gauge-leak class: a labeled series for an entity that left
+  (pod deleted, replica deregistered) pages someone forever unless the
+  delete path drops it.
 
 Allowlist keys: ``("metric", name)`` / ``("span", name)`` for catalogue
 gaps, ``("dynamic", file, func)`` for computed names,
-``("undescribed", name)`` / ``("unemitted", name)`` for describe gaps.
+``("undescribed", name)`` / ``("unemitted", name)`` for describe gaps,
+``("leak", name)`` for per-entity gauges with no removal call.
 """
 
 from __future__ import annotations
@@ -37,6 +44,10 @@ from ..index import PackageIndex
 # emission, and the names it drops are linted at their set_gauge sites
 _METRIC_METHODS = {"incr", "set_gauge", "observe", "time_block"}
 _SPAN_METHODS = {"record", "span"}
+# labels keys that mark a gauge series as per-entity: the entity can
+# leave (pod deleted, replica deregistered), so the series needs a
+# removal call or it outlives its referent
+_ENTITY_LABEL_KEYS = {"pod", "pod_name", "replica", "replica_id"}
 
 
 def _first_arg_literal(node: ast.Call) -> Optional[str]:
@@ -62,6 +73,51 @@ def _is_tracer_recv(recv: str) -> bool:
     return recv.endswith(("tracer", "tr"))
 
 
+def _labels_dict(node: ast.Call) -> Optional[ast.Dict]:
+    """The labels argument of a gauge call, when it is a LITERAL dict
+    (keyword ``labels=...`` or the third positional). A labels variable
+    returns None — the leak rule only judges what it can see."""
+    for kw in node.keywords:
+        if kw.arg == "labels" and isinstance(kw.value, ast.Dict):
+            return kw.value
+    if len(node.args) >= 3 and isinstance(node.args[2], ast.Dict):
+        return node.args[2]
+    return None
+
+
+def _entity_labeled(node: ast.Call) -> bool:
+    d = _labels_dict(node)
+    if d is None:
+        return False
+    return any(isinstance(k, ast.Constant) and k.value in _ENTITY_LABEL_KEYS
+               for k in d.keys)
+
+
+def _removal_names(tree) -> set:
+    """Gauge names some remove_gauge call drops: literal first args,
+    plus every string in a constant tuple/list a for-loop iterates when
+    the loop body calls remove_gauge (training_watch's
+    _clear_training_gauges idiom)."""
+    out: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "remove_gauge":
+            name = _first_arg_literal(node)
+            if name is not None:
+                out.add(name)
+        elif isinstance(node, ast.For) \
+                and isinstance(node.iter, (ast.Tuple, ast.List)):
+            if any(isinstance(n, ast.Call)
+                   and isinstance(n.func, ast.Attribute)
+                   and n.func.attr == "remove_gauge"
+                   for n in ast.walk(node)):
+                out.update(e.value for e in node.iter.elts
+                           if isinstance(e, ast.Constant)
+                           and isinstance(e.value, str))
+    return out
+
+
 class ObservabilityChecker(Checker):
     name = "observability"
     description = ("every emitted metric/span name is described and "
@@ -81,10 +137,13 @@ class ObservabilityChecker(Checker):
         used_metrics: dict[str, tuple[str, int, str]] = {}
         described: dict[str, tuple[str, int, str]] = {}
         used_spans: dict[str, tuple[str, int, str]] = {}
+        entity_gauges: dict[str, tuple[str, int, str]] = {}
+        removal_names: set = set()
 
         for fi in index.files():
             if fi.rel.startswith("analysis/"):
                 continue  # the lint's own name tables are not telemetry
+            removal_names |= _removal_names(fi.tree)
             # tracing.py's Span.__exit__ records self.name — registry
             # plumbing, like metrics' _Timer; the literal names live at
             # the tracer.span(...) call sites, which ARE collected
@@ -101,6 +160,8 @@ class ObservabilityChecker(Checker):
                     name = _first_arg_literal(node)
                     if name is not None:
                         used_metrics.setdefault(name, site)
+                        if attr == "set_gauge" and _entity_labeled(node):
+                            entity_gauges.setdefault(name, site)
                     elif node.args and _is_metrics_recv(recv):
                         yield Finding(
                             self.name, fi.rel, node.lineno, site[2],
@@ -146,6 +207,16 @@ class ObservabilityChecker(Checker):
                     f"describe({name!r}) but no call site ever emits it — "
                     f"dead catalogue entry (renamed metric?)",
                     key=("unemitted", name))
+        for name, (rel, line, func) in sorted(entity_gauges.items()):
+            if name not in removal_names:
+                yield Finding(
+                    self.name, rel, line, func,
+                    f"gauge {name!r} is set with a per-entity label "
+                    f"({'/'.join(sorted(_ENTITY_LABEL_KEYS))}) but no "
+                    f"remove_gauge({name!r}) exists anywhere — the series "
+                    f"outlives its entity (the stalled-gauge-leak class): "
+                    f"drop it from the delete/deregister path",
+                    key=("leak", name))
         for name, (rel, line, func) in sorted(used_spans.items()):
             if readme is not None and name not in readme:
                 yield Finding(

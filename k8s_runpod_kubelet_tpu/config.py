@@ -199,6 +199,30 @@ class Config:
     # window; compute blocks when transfer falls that far behind).
     serving_chunk_tokens: int = 0
     handoff_stream_window: int = 8
+    # serving observability (ISSUE 17). serving_flight_recorder gates the
+    # engine's per-decode-step flight recorder (bounded ring at GET
+    # /debug/steps, phase split folded into serving.request spans);
+    # serving_profiler_port starts the on-demand jax.profiler server
+    # (train_main parity; 0 = off); serving_profile_capture enables the
+    # GET /debug/profile?seconds= trace endpoint — off by default because
+    # a capture stalls the device and writes replica-local files.
+    serving_flight_recorder: bool = True
+    serving_profiler_port: int = 0
+    serving_profile_capture: bool = False
+    # fleet SLO burn rates (ISSUE 17): multi-window breach fractions over
+    # the TTFT/ITL/error-rate objectives, computed from registry
+    # heartbeats on the injected clock. A signal "burns" when BOTH the
+    # short and the long window consume error budget faster than
+    # fleet_slo_burn_threshold x the sustainable rate; the autoscaler
+    # uses that crossing (not a latched p95 sample) as its latency
+    # corroboration. fleet_slo_budget_frac is the error budget (fraction
+    # of time the SLO may be breached); fleet_slo_error_rate is the
+    # request-error-ratio objective.
+    fleet_slo_short_window_s: float = 300.0
+    fleet_slo_long_window_s: float = 3600.0
+    fleet_slo_burn_threshold: float = 2.0
+    fleet_slo_budget_frac: float = 0.05
+    fleet_slo_error_rate: float = 0.01
 
     # elastic gang training (ISSUE 6). elastic_resize is the global gate for
     # the tpu.dev/elastic pod annotation: on partial host loss an elastic
@@ -359,6 +383,22 @@ class Config:
         if self.handoff_stream_window < 1:
             errs.append("handoff_stream_window must be >= 1 (at least one "
                         "frame in flight, or the stream cannot move)")
+        if not 0 <= self.serving_profiler_port <= 65535:
+            errs.append("serving_profiler_port must be in [0, 65535] "
+                        "(0 = off)")
+        if self.fleet_slo_short_window_s <= 0:
+            errs.append("fleet_slo_short_window_s must be > 0")
+        if self.fleet_slo_long_window_s < self.fleet_slo_short_window_s:
+            errs.append("fleet_slo_long_window_s must be >= "
+                        "fleet_slo_short_window_s (the long window "
+                        "confirms the short one)")
+        if self.fleet_slo_burn_threshold <= 0:
+            errs.append("fleet_slo_burn_threshold must be > 0")
+        if not 0 < self.fleet_slo_budget_frac < 1:
+            errs.append("fleet_slo_budget_frac must be in (0, 1) — it is "
+                        "the fraction of time the SLO may be breached")
+        if not 0 < self.fleet_slo_error_rate < 1:
+            errs.append("fleet_slo_error_rate must be in (0, 1)")
         if errs:
             raise ValueError("invalid config: " + "; ".join(errs))
         return self
@@ -406,6 +446,14 @@ _ENV_MAP = {
     "TPU_KV_ARENA_SHARDING": "kv_arena_sharding",
     "TPU_SERVING_CHUNK_TOKENS": "serving_chunk_tokens",
     "TPU_HANDOFF_STREAM_WINDOW": "handoff_stream_window",
+    "TPU_SERVING_FLIGHT_RECORDER": "serving_flight_recorder",
+    "TPU_SERVING_PROFILER_PORT": "serving_profiler_port",
+    "TPU_SERVING_PROFILE_CAPTURE": "serving_profile_capture",
+    "TPU_FLEET_SLO_SHORT_WINDOW_S": "fleet_slo_short_window_s",
+    "TPU_FLEET_SLO_LONG_WINDOW_S": "fleet_slo_long_window_s",
+    "TPU_FLEET_SLO_BURN_THRESHOLD": "fleet_slo_burn_threshold",
+    "TPU_FLEET_SLO_BUDGET_FRAC": "fleet_slo_budget_frac",
+    "TPU_FLEET_SLO_ERROR_RATE": "fleet_slo_error_rate",
     "TPU_SERVING_ROLE": "serving_role",
     "TPU_FLEET_PREFILL_MIN_REPLICAS": "fleet_prefill_min_replicas",
     "TPU_FLEET_PREFILL_MAX_REPLICAS": "fleet_prefill_max_replicas",

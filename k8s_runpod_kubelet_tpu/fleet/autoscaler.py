@@ -50,7 +50,10 @@ SERVING_PASSTHROUGH_ENV = ("TPU_KV_PAGE_TOKENS", "TPU_KV_POOL_PAGES",
                            "TPU_FLEET_PLACEMENT_DOMAIN",
                            "TPU_FLEET_PREFIX_DIRECTORY_ENABLED",
                            "TPU_FLEET_PULL_TIMEOUT_S",
-                           "TPU_FLEET_PLACEMENT_DOMAIN_MODE")
+                           "TPU_FLEET_PLACEMENT_DOMAIN_MODE",
+                           "TPU_SERVING_FLIGHT_RECORDER",
+                           "TPU_SERVING_PROFILER_PORT",
+                           "TPU_SERVING_PROFILE_CAPTURE")
 
 
 @dataclasses.dataclass
@@ -216,9 +219,17 @@ class FleetAutoscaler:
     def __init__(self, registry: ReplicaRegistry, scaler, cfg=None,
                  metrics=None, tracer=None,
                  clock: Callable[[], float] = time.monotonic,
-                 drain_fn: Optional[Callable[[Replica], None]] = None):
+                 drain_fn: Optional[Callable[[Replica], None]] = None,
+                 slo=None):
         self.registry = registry
         self.scaler = scaler
+        # SLO burn-rate corroboration (ISSUE 17): when a tracker is
+        # wired, latency scale-ups trigger on multi-window budget burn
+        # (slo.burning) instead of the latched-p95-plus-busy heuristic —
+        # a single slow beat can't scale the fleet, and a sustained
+        # breach can't hide behind one fast one. None keeps the legacy
+        # point-sample path.
+        self.slo = slo
         self.cfg = cfg or AutoscalerConfig()
         if self.cfg.min_replicas < 0 or \
                 self.cfg.max_replicas < max(1, self.cfg.min_replicas):
@@ -293,13 +304,20 @@ class FleetAutoscaler:
         # slots do). The prefill/unified signals below — queue depth and
         # TTFT burn — stay the compute-side pair.
         if self.cfg.role == DECODE:
-            busy = any(r.stats.queue_depth > 0 or r.stats.active_slots > 0
-                       for r in ready)
-            worst_itl = max(r.stats.itl_p95_s for r in ready)
-            if self.cfg.itl_slo_s > 0 and worst_itl > self.cfg.itl_slo_s \
-                    and busy:
-                return f"itl_p95 {worst_itl:.4f}s over SLO " \
-                       f"{self.cfg.itl_slo_s}s"
+            if self.slo is not None:
+                if self.cfg.itl_slo_s > 0 and self.slo.burning("itl"):
+                    short, long_ = self.slo.burn_rates("itl")
+                    return (f"itl SLO burn {short:.2f}x/{long_:.2f}x "
+                            f"(short/long) over "
+                            f"{self.slo.burn_threshold:.1f}x threshold")
+            else:
+                busy = any(r.stats.queue_depth > 0
+                           or r.stats.active_slots > 0 for r in ready)
+                worst_itl = max(r.stats.itl_p95_s for r in ready)
+                if self.cfg.itl_slo_s > 0 \
+                        and worst_itl > self.cfg.itl_slo_s and busy:
+                    return f"itl_p95 {worst_itl:.4f}s over SLO " \
+                           f"{self.cfg.itl_slo_s}s"
             total = sum(r.stats.kv_pages_total for r in ready)
             free = sum(r.stats.kv_pages_free for r in ready)
             if self.cfg.min_free_kv_page_frac > 0 and total > 0 \
@@ -311,6 +329,17 @@ class FleetAutoscaler:
         if queue / len(ready) > self.cfg.target_queue_per_replica:
             return f"queue_depth {queue} over " \
                    f"{self.cfg.target_queue_per_replica}/replica"
+        if self.slo is not None:
+            # burn-rate corroboration (ISSUE 17): the tracker already
+            # busy-gates each heartbeat observation and demands BOTH
+            # windows over threshold, replacing the latched-p95+busy
+            # hand-patch below
+            if self.cfg.ttft_slo_s > 0 and self.slo.burning("ttft"):
+                short, long_ = self.slo.burn_rates("ttft")
+                return (f"ttft SLO burn {short:.2f}x/{long_:.2f}x "
+                        f"(short/long) over "
+                        f"{self.slo.burn_threshold:.1f}x threshold")
+            return None
         worst = max(r.stats.ttft_p95_s for r in ready)
         # TTFT SLO burn needs CORROBORATING live load: the reporter's p95
         # comes from the histogram's recent tail, which has no time window

@@ -72,6 +72,11 @@ class ReplicaStats:
     # scale-down check watches this ADVANCE between ticks — hops are too
     # short for the sampled inflight count to register steady traffic
     handoffs_total: int = 0
+    # cumulative error/request counters for the SLO layer's error-rate
+    # burn signal (ISSUE 17): the tracker takes per-beat DELTAS, so these
+    # ride the heartbeat as monotonic totals
+    errors_total: int = 0
+    requests_total: int = 0
     draining: bool = False
 
     _FLOATS = ("ttft_p95_s", "itl_p95_s")
@@ -170,10 +175,14 @@ class ReplicaRegistry:
                  breaker_failure_threshold: int = 3,
                  breaker_reset_s: float = 10.0,
                  request_timeout_s: float = 120.0,
-                 directory=None):
+                 directory=None, slo=None):
         self.metrics = metrics
         self.tracer = tracer
         self.clock = clock
+        # SLO burn-rate tracker (ISSUE 17): every accepted heartbeat is
+        # one good/bad observation per signal; membership exits drop the
+        # replica's error-counter baseline
+        self.slo = slo
         # global prefix directory (ISSUE 16): membership changes and the
         # directory's holder claims move together — evict/deregister/
         # drain drop a replica's claims in the same call, so the router
@@ -278,6 +287,11 @@ class ReplicaRegistry:
             if rep.stats.draining:
                 rep.state = DRAINING
             state = rep.state
+            stats_obj = rep.stats
+        if self.slo is not None:
+            # outside the membership lock: the tracker has its own, and
+            # a heartbeat must not serialize against sweep()/ready()
+            self.slo.ingest(replica_id, stats_obj)
         if self.directory is not None:
             if state == DRAINING:
                 self.directory.drop_replica(replica_id)
@@ -307,6 +321,8 @@ class ReplicaRegistry:
     def deregister(self, replica_id: str) -> bool:
         with self._lock:
             rep = self._replicas.pop(replica_id, None)
+        if self.slo is not None:
+            self.slo.forget(replica_id)
         if self.directory is not None:
             self.directory.drop_replica(replica_id)
         if rep is not None and self.metrics is not None:
@@ -320,6 +336,8 @@ class ReplicaRegistry:
         now = self.clock()
         with self._lock:
             rep = self._replicas.pop(replica_id, None)
+        if self.slo is not None:
+            self.slo.forget(replica_id)
         if self.directory is not None:
             # same-transaction consistency (ISSUE 16): the moment the
             # fleet declares a replica dead, its directory claims die
@@ -535,6 +553,15 @@ class ReplicaReporter:
             + int(pool.get("pages_evictable", 0)),
             "kv_pages_total": int(pool.get("pages_total", 0)),
             "handoffs_total": snap.get("handoffs_total", 0),
+            # cumulative error/request totals for the router's SLO
+            # error-rate burn signal (ISSUE 17): the tracker diffs
+            # successive beats, so cumulative is the right shape
+            "errors_total": (
+                self.engine.metrics.get_counter("tpu_serving_engine_errors")
+                + self.engine.metrics.get_counter(
+                    "tpu_serving_prefill_errors")),
+            "requests_total": self.engine.metrics.get_counter(
+                "tpu_serving_admitted"),
             "prefix_hit_rate": round(hit_rate, 4),
             "spec_acceptance_rate": (round(spec_acc / spec_prop, 4)
                                      if spec_prop else None),

@@ -125,9 +125,12 @@ class FleetRouter:
     def __init__(self, registry: ReplicaRegistry, cfg: RouterConfig = None,
                  metrics=None, tracer: Optional[Tracer] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 directory=None):
+                 directory=None, slo=None):
         self.registry = registry
         self.cfg = cfg or RouterConfig()
+        # SLO burn-rate tracker (ISSUE 17) behind GET /debug/slo; the
+        # registry feeds it heartbeats, the autoscaler reads burning()
+        self.slo = slo
         self.metrics = metrics
         self.tracer = tracer if tracer is not None else Tracer()
         self.clock = clock
@@ -764,6 +767,13 @@ class _RouterHandler(BaseHTTPRequestHandler):
             q = urllib.parse.parse_qs(url.query)
             return self._send(200, rt.tracer.query(
                 (q.get("trace_id") or [""])[0]))
+        if url.path == "/debug/slo":
+            # SLO burn-rate state (ISSUE 17): objectives, per-signal
+            # burn, crossing counts and the bounded burn history
+            # (tools/slo_summary.py renders timelines from it)
+            if rt.slo is None:
+                return self._send(200, {"enabled": False})
+            return self._send(200, rt.slo.snapshot())
         if url.path == "/v1/models":
             # every replica serves the same base model (+ adapters), so
             # one healthy replica's answer IS the fleet's answer — OpenAI

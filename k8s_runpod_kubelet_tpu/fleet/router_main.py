@@ -103,6 +103,26 @@ def parse_flags(argv):
                    help="restore the pre-directory POST /prefix fan-out "
                         "(register the prefix on EVERY ready replica up "
                         "front) instead of register-once + lazy pulls")
+    p.add_argument("--slo-short-window", dest="fleet_slo_short_window_s",
+                   type=float, default=None,
+                   help="SLO burn-rate short window in seconds (fast "
+                        "detection; default 300)")
+    p.add_argument("--slo-long-window", dest="fleet_slo_long_window_s",
+                   type=float, default=None,
+                   help="SLO burn-rate long window in seconds (sustained "
+                        "evidence; default 3600)")
+    p.add_argument("--slo-burn-threshold", dest="fleet_slo_burn_threshold",
+                   type=float, default=None,
+                   help="a signal burns when BOTH windows consume error "
+                        "budget this many times faster than sustainable")
+    p.add_argument("--slo-budget-frac", dest="fleet_slo_budget_frac",
+                   type=float, default=None,
+                   help="error budget: fraction of time each SLO may be "
+                        "breached (default 0.05)")
+    p.add_argument("--slo-error-rate", dest="fleet_slo_error_rate",
+                   type=float, default=None,
+                   help="request error-ratio objective for the error_rate "
+                        "burn signal (default 0.01)")
     p.add_argument("--scale-up-cooldown", dest="fleet_scale_up_cooldown_s",
                    type=float, default=None)
     p.add_argument("--scale-down-cooldown",
@@ -143,12 +163,24 @@ def build(cfg: config_mod.Config, kube=None, autoscale: bool = False,
     if cfg.fleet_prefix_directory_enabled:
         from .prefix_directory import PrefixDirectory
         directory = PrefixDirectory(metrics=metrics)
+    # SLO burn-rate layer (ISSUE 17): fed by every accepted heartbeat,
+    # read by GET /debug/slo and the autoscalers' latency corroboration
+    from .slo import SLOTracker
+    slo = SLOTracker(
+        ttft_slo_s=cfg.fleet_ttft_slo_s,
+        itl_slo_s=cfg.fleet_itl_slo_s,
+        error_rate_slo=cfg.fleet_slo_error_rate,
+        short_window_s=cfg.fleet_slo_short_window_s,
+        long_window_s=cfg.fleet_slo_long_window_s,
+        burn_threshold=cfg.fleet_slo_burn_threshold,
+        budget_frac=cfg.fleet_slo_budget_frac,
+        metrics=metrics, tracer=tracer)
     registry = ReplicaRegistry(
         metrics=metrics, tracer=tracer,
         heartbeat_timeout_s=cfg.fleet_heartbeat_timeout_s,
         breaker_failure_threshold=cfg.breaker_failure_threshold,
         breaker_reset_s=cfg.breaker_reset_s,
-        directory=directory)
+        directory=directory, slo=slo)
     router = FleetRouter(
         registry,
         RouterConfig(port=cfg.fleet_router_port,
@@ -160,7 +192,7 @@ def build(cfg: config_mod.Config, kube=None, autoscale: bool = False,
                      pull_timeout_s=cfg.fleet_pull_timeout_s,
                      prefix_broadcast=cfg.fleet_prefix_broadcast,
                      kv_page_tokens=cfg.kv_page_tokens),
-        metrics=metrics, tracer=tracer, directory=directory)
+        metrics=metrics, tracer=tracer, directory=directory, slo=slo)
     autoscalers = []
     if autoscale:
         from ..kube import RealKubeClient
@@ -193,7 +225,7 @@ def build(cfg: config_mod.Config, kube=None, autoscale: bool = False,
                 registry, scaler,
                 AutoscalerConfig(min_replicas=mn, max_replicas=mx,
                                  role=role, **base, **extra),
-                metrics=metrics, tracer=tracer))
+                metrics=metrics, tracer=tracer, slo=slo))
     return registry, router, autoscalers
 
 

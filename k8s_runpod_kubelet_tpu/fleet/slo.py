@@ -1,0 +1,261 @@
+"""Fleet SLO burn rates: multi-window breach fractions over heartbeats.
+
+The autoscaler's original latency signal was a single latched TTFT p95
+sample (PR 4 had to hand-patch it with a busy-gate so an idle fleet's
+stale histogram tail couldn't pin scale-ups). This module replaces that
+point sample with the SRE-workbook construction: each heartbeat becomes
+a good/bad observation per signal, and a signal *burns* when BOTH a
+short window (fast detection) and a long window (sustained evidence)
+consume error budget faster than ``burn_threshold`` times the
+sustainable rate.
+
+    burn(window) = breach_fraction(window) / budget_frac
+
+With the default budget_frac=0.05 and burn_threshold=2.0, a signal burns
+when more than 10% of recent heartbeats breached the objective — on both
+windows at once, so a single slow beat (short window spikes, long stays
+flat) and a slowly-draining budget (long elevated, short recovered)
+both stay quiet.
+
+Signals:
+
+- ``ttft``: heartbeat ``ttft_p95_s`` over the TTFT objective, counted
+  only while the replica is BUSY (queued or active work) — an idle
+  replica's histogram tail is history, not load.
+- ``itl``: ``itl_p95_s`` over the ITL objective, same busy gate.
+- ``error_rate``: per-replica DELTAS of the cumulative
+  ``errors_total``/``requests_total`` heartbeat counters — a beat is bad
+  when the interval's error ratio exceeds the objective.
+
+Everything rides the injected clock (monotonic domain, the registry's),
+so the fleet soak drives hours of burn history from one FakeClock.
+Dependency-free like tracing.py/recorder.py.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+log = logging.getLogger(__name__)
+
+SIGNALS = ("ttft", "itl", "error_rate")
+WINDOWS = ("short", "long")
+
+# bounded burn-history ring for /debug/slo timelines (one entry per
+# ingest; tools/slo_summary.py renders it)
+_HISTORY_LIMIT = 512
+
+
+def describe_metrics(m):
+    """Register the tpu_fleet_slo_* family (called by whoever owns the
+    Metrics instance — router_main's build())."""
+    m.describe("tpu_fleet_slo_burn_rate",
+               "error-budget burn rate per SLO signal and window "
+               "(labels: signal=ttft|itl|error_rate, window=short|long); "
+               "burn = breach fraction / budget fraction, >1 consumes "
+               "budget faster than sustainable")
+    m.describe("tpu_fleet_slo_crossings",
+               "burn-rate threshold crossings (onsets, edge-triggered "
+               "per signal; labels: signal=ttft|itl|error_rate)")
+
+
+class SLOTracker:
+    """Multi-window burn-rate evaluation over registry heartbeats.
+
+    ``ingest(replica_id, stats)`` is called per accepted heartbeat (the
+    registry does it outside its membership lock); ``burning(signal)``
+    is the autoscaler's corroboration read; ``snapshot()`` backs the
+    router's ``GET /debug/slo``. Thread-safe: heartbeats arrive on HTTP
+    handler threads while the autoscaler reads from its tick thread.
+    """
+
+    def __init__(self, ttft_slo_s: float = 2.0, itl_slo_s: float = 0.25,
+                 error_rate_slo: float = 0.01,
+                 short_window_s: float = 300.0,
+                 long_window_s: float = 3600.0,
+                 burn_threshold: float = 2.0,
+                 budget_frac: float = 0.05,
+                 metrics=None, tracer=None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.objectives = {"ttft": ttft_slo_s, "itl": itl_slo_s,
+                           "error_rate": error_rate_slo}
+        self.short_window_s = short_window_s
+        self.long_window_s = long_window_s
+        self.burn_threshold = burn_threshold
+        self.budget_frac = budget_frac
+        self.metrics = metrics
+        self.tracer = tracer
+        self.clock = clock
+        self._lock = threading.Lock()
+        # per-signal deques of (t, breached) observations, pruned past
+        # the long window (the short window is a suffix of the long one)
+        self._samples = {s: collections.deque() for s in SIGNALS}
+        # per-replica last cumulative counters, for error-rate deltas; a
+        # restart (counter going backwards) resets the baseline instead
+        # of producing a negative delta
+        self._counters: dict[str, tuple[int, int]] = {}
+        self._burning = {s: False for s in SIGNALS}
+        self._crossings = {s: 0 for s in SIGNALS}
+        self._history = collections.deque(maxlen=_HISTORY_LIMIT)
+        if metrics is not None:
+            describe_metrics(metrics)
+            for sig in SIGNALS:
+                self._crossing_seed(sig)
+
+    def _crossing_seed(self, sig: str):
+        # zero-seed so "crossings == 0" is a rendered fact, not a
+        # missing series (the stalled-gauge lesson from PR 5)
+        self.metrics.incr("tpu_fleet_slo_crossings", 0,
+                          labels={"signal": sig})
+
+    # -- ingest ----------------------------------------------------------------
+
+    def ingest(self, replica_id: str, stats) -> None:
+        """Fold one heartbeat into the windows. ``stats`` is the
+        registry's ReplicaStats (or any object with its attributes)."""
+        now = self.clock()
+        busy = (int(getattr(stats, "queue_depth", 0)) > 0
+                or int(getattr(stats, "active_slots", 0)) > 0)
+        obs = {
+            # busy-gated latency breaches: an idle replica observes a
+            # GOOD sample (its histogram tail is stale, not evidence),
+            # keeping the denominator honest while traffic pauses
+            "ttft": busy and float(getattr(stats, "ttft_p95_s", 0.0))
+            > self.objectives["ttft"],
+            "itl": busy and float(getattr(stats, "itl_p95_s", 0.0))
+            > self.objectives["itl"],
+            "error_rate": self._error_breach(replica_id, stats),
+        }
+        spans = []
+        with self._lock:
+            for sig, breached in obs.items():
+                dq = self._samples[sig]
+                dq.append((now, bool(breached)))
+                self._prune(dq, now)
+            burns = {sig: (self._burn(sig, now, self.short_window_s),
+                           self._burn(sig, now, self.long_window_s))
+                     for sig in SIGNALS}
+            for sig, (short, long_) in burns.items():
+                burning = (short >= self.burn_threshold
+                           and long_ >= self.burn_threshold)
+                if burning and not self._burning[sig]:
+                    # onset, edge-triggered: one span + one crossing
+                    # count per excursion, not per beat inside it
+                    self._crossings[sig] += 1
+                    spans.append((sig, short, long_))
+                self._burning[sig] = burning
+            self._history.append(
+                (round(now, 3),
+                 {sig: round(b[0], 3) for sig, b in burns.items()}))
+        if self.metrics is not None:
+            for sig, (short, long_) in burns.items():
+                self.metrics.set_gauge(
+                    "tpu_fleet_slo_burn_rate", round(short, 4),
+                    labels={"signal": sig, "window": "short"})
+                self.metrics.set_gauge(
+                    "tpu_fleet_slo_burn_rate", round(long_, 4),
+                    labels={"signal": sig, "window": "long"})
+            for sig, _, _ in spans:
+                self.metrics.incr("tpu_fleet_slo_crossings",
+                                  labels={"signal": sig})
+        for sig, short, long_ in spans:
+            log.warning(
+                "fleet: SLO burn crossing on %s (short=%.2fx long=%.2fx, "
+                "threshold %.2fx of budget_frac=%.3f)", sig, short, long_,
+                self.burn_threshold, self.budget_frac)
+            if self.tracer is not None:
+                self.tracer.record(
+                    "fleet.slo_burn", now, now,
+                    attrs={"signal": sig, "short_burn": round(short, 4),
+                           "long_burn": round(long_, 4),
+                           "threshold": self.burn_threshold,
+                           "objective": self.objectives[sig],
+                           "replica_id": replica_id})
+
+    def _error_breach(self, replica_id: str, stats) -> bool:
+        errors = int(getattr(stats, "errors_total", 0))
+        requests = int(getattr(stats, "requests_total", 0))
+        prev = self._counters.get(replica_id)
+        self._counters[replica_id] = (errors, requests)
+        if prev is None:
+            return False
+        d_err = errors - prev[0]
+        d_req = requests - prev[1]
+        if d_err < 0 or d_req < 0:  # replica restarted: new baseline
+            return False
+        if d_req <= 0:
+            return False
+        return d_err / d_req > self.objectives["error_rate"]
+
+    def _prune(self, dq, now: float):
+        horizon = now - self.long_window_s
+        while dq and dq[0][0] < horizon:
+            dq.popleft()
+
+    def _burn(self, sig: str, now: float, window_s: float) -> float:
+        """breach_fraction(window) / budget_frac (0.0 with no samples)."""
+        cutoff = now - window_s
+        total = bad = 0
+        for t, breached in self._samples[sig]:
+            if t >= cutoff:
+                total += 1
+                bad += breached
+        if total == 0:
+            return 0.0
+        return (bad / total) / self.budget_frac
+
+    def forget(self, replica_id: str) -> None:
+        """Drop a replica's error-counter baseline (evict/deregister):
+        its next registration starts a fresh delta stream."""
+        with self._lock:
+            self._counters.pop(replica_id, None)
+
+    # -- reads -----------------------------------------------------------------
+
+    def burning(self, signal: str) -> bool:
+        """The autoscaler's corroboration read: is this signal consuming
+        error budget faster than threshold on BOTH windows right now?"""
+        with self._lock:
+            return self._burning.get(signal, False)
+
+    def burn_rates(self, signal: str) -> tuple[float, float]:
+        """(short, long) burn for one signal, recomputed at read time so
+        an ingest-quiet fleet still decays toward zero."""
+        now = self.clock()
+        with self._lock:
+            return (self._burn(signal, now, self.short_window_s),
+                    self._burn(signal, now, self.long_window_s))
+
+    def snapshot(self) -> dict:
+        """The ``GET /debug/slo`` payload (tools/slo_summary.py renders
+        it): objectives, per-signal burn state, and the bounded burn
+        history for timelines."""
+        now = self.clock()
+        with self._lock:
+            signals = {}
+            for sig in SIGNALS:
+                short = self._burn(sig, now, self.short_window_s)
+                long_ = self._burn(sig, now, self.long_window_s)
+                dq = self._samples[sig]
+                cutoff = now - self.short_window_s
+                signals[sig] = {
+                    "objective": self.objectives[sig],
+                    "burning": self._burning[sig],
+                    "short_burn": round(short, 4),
+                    "long_burn": round(long_, 4),
+                    "crossings": self._crossings[sig],
+                    "samples_long": len(dq),
+                    "samples_short": sum(1 for t, _ in dq if t >= cutoff),
+                }
+            history = [{"t": t, "burn": dict(b)} for t, b in self._history]
+        return {"enabled": True,
+                "burn_threshold": self.burn_threshold,
+                "budget_frac": self.budget_frac,
+                "windows": {"short_s": self.short_window_s,
+                            "long_s": self.long_window_s},
+                "signals": signals,
+                "history": history}

@@ -200,7 +200,11 @@ class Tracer:
         try:
             self._export_queue.put_nowait(json.dumps(span.to_dict()) + "\n")
         except queue.Full:  # writer far behind (stalled disk): drop, count
-            self.dropped_exports += 1
+            # under the ring lock: callers race the writer thread's OSError
+            # path on this counter, and += on an instance attribute is not
+            # atomic — two threads can read the same value and lose a drop
+            with self._lock:
+                self.dropped_exports += 1
 
     def _drain_exports(self):
         f = None
@@ -218,8 +222,11 @@ class Tracer:
                     f.write(line)
                     f.flush()
                 except OSError:
-                    # full/readonly disk must never take down serving
-                    self.dropped_exports += 1
+                    # full/readonly disk must never take down serving;
+                    # same lock as _export's queue-full path — the two
+                    # threads share this counter
+                    with self._lock:
+                        self.dropped_exports += 1
         finally:
             if f is not None:
                 try:

@@ -130,6 +130,11 @@ class _Handler(BaseHTTPRequestHandler):
     # injected so stress/soak tests drive HTTP-layer timeouts deterministically
     clock = staticmethod(time.time)
     mono = staticmethod(time.monotonic)
+    # GET /debug/profile gate (ISSUE 17): capture stalls the device and
+    # writes local files, so it stays 403 unless the operator opted in;
+    # sleep is seamed like the clocks so tests capture without real waits
+    profile_capture = False
+    sleep = staticmethod(time.sleep)
     # chunked transfer framing is an HTTP/1.1 construct; 1.0 clients would
     # read raw chunk framing as the body (non-stream responses all send
     # Content-Length, so keep-alive stays correct)
@@ -226,6 +231,41 @@ class _Handler(BaseHTTPRequestHandler):
                 (q.get("trace_id") or [""])[0]))
         if url.path == "/debug/engine":
             return self._send(200, self.engine.debug_snapshot())
+        if url.path == "/debug/steps":
+            # flight-recorder tail + rollup (ISSUE 17): newest-n step
+            # records (oldest first) plus phase/occupancy medians and the
+            # per-fn recompile table
+            q = urllib.parse.parse_qs(url.query)
+            try:
+                n = int((q.get("n") or ["64"])[0])
+            except ValueError:
+                return self._send(400, {"error": "n must be an integer"})
+            return self._send(200, self.engine.debug_steps(n))
+        if url.path == "/debug/profile":
+            # on-demand jax.profiler capture, OFF by default: a trace
+            # capture stalls the device and writes to the replica's disk,
+            # so an unauthenticated GET must not be able to trigger it
+            # unless the operator opted in (--profile-capture /
+            # TPU_SERVING_PROFILE_CAPTURE)
+            if not self.profile_capture:
+                return self._send(
+                    403, {"error": "profile capture disabled; start with "
+                                   "--profile-capture to enable"})
+            q = urllib.parse.parse_qs(url.query)
+            try:
+                seconds = float((q.get("seconds") or ["1"])[0])
+            except ValueError:
+                return self._send(400, {"error": "seconds must be a number"})
+            if not 0 < seconds <= 30:
+                return self._send(
+                    400, {"error": "seconds must be in (0, 30]"})
+            import tempfile
+            import jax
+            out_dir = tempfile.mkdtemp(prefix="tpu-serving-profile-")
+            with jax.profiler.trace(out_dir):
+                self.sleep(seconds)
+            return self._send(200, {"profile_dir": out_dir,
+                                    "seconds": seconds})
         self._send(404, {"error": f"no route {self.path}"})
 
     def _read_json(self) -> dict:
@@ -1719,6 +1759,7 @@ def serve(engine, port: int = 8000, request_timeout_s: float = 120.0,
           tokenizer=None, allow_adapters: bool = False,
           max_connections: int = 128, handoff_stream_window: int = 8,
           device_domain: str = "", pull_timeout_s: float = 10.0,
+          profile_capture: bool = False,
           clock=time.time, mono=time.monotonic):
     # described here, not in the engine: the HTTP-layer shed counter belongs
     # to this server (the engine never sees the rejected connection)
@@ -1737,6 +1778,7 @@ def serve(engine, port: int = 8000, request_timeout_s: float = 120.0,
                     "handoff_stream_window": handoff_stream_window,
                     "device_domain": device_domain,
                     "pull_timeout_s": pull_timeout_s, "shm_gc": shm_gc,
+                    "profile_capture": profile_capture,
                     "clock": staticmethod(clock), "mono": staticmethod(mono)})
     httpd = BoundedThreadingHTTPServer(("0.0.0.0", port), handler,
                                        max_connections=max_connections,
@@ -1918,6 +1960,29 @@ def main(argv=None) -> int:
     p.add_argument("--hf-checkpoint", default="",
                    help="HuggingFace model directory (safetensors/bin) to "
                         "load real weights from; empty = random init")
+    p.add_argument("--flight-recorder", default=None, choices=["on", "off"],
+                   dest="serving_flight_recorder",
+                   help="per-decode-step flight recorder: a bounded ring "
+                        "of step records (batch composition, schedule/"
+                        "kernel/sample/commit phase split, arena page "
+                        "counts, speculative accounting) at GET "
+                        "/debug/steps, folded into serving.request spans "
+                        "(default from config/"
+                        "TPU_SERVING_FLIGHT_RECORDER, on)")
+    p.add_argument("--profiler-port", type=int, default=None,
+                   dest="serving_profiler_port",
+                   help="start the on-demand jax.profiler server on this "
+                        "port (parity with train_main): connect TensorBoard "
+                        "or `jax.profiler.trace_server` tooling for live "
+                        "captures; 0 = off (default from config/"
+                        "TPU_SERVING_PROFILER_PORT)")
+    p.add_argument("--profile-capture", default=None, choices=["on", "off"],
+                   dest="serving_profile_capture",
+                   help="enable GET /debug/profile?seconds= trace captures "
+                        "(writes a jax.profiler trace on the replica's "
+                        "disk); off by default because any API client "
+                        "could otherwise stall the device (default from "
+                        "config/TPU_SERVING_PROFILE_CAPTURE)")
     p.add_argument("--trace-export", default="",
                    help="append finished request spans to this JSONL file "
                         "(render with tools/trace_summary.py); empty = "
@@ -1983,6 +2048,19 @@ def main(argv=None) -> int:
     pull_timeout_s = (args.fleet_pull_timeout_s
                       if args.fleet_pull_timeout_s is not None
                       else base_cfg.fleet_pull_timeout_s)
+    # observability knobs (ISSUE 17): flag > TPU_SERVING_* env > config
+    flight_recorder = (base_cfg.serving_flight_recorder
+                       if args.serving_flight_recorder is None
+                       else args.serving_flight_recorder == "on")
+    profiler_port = (args.serving_profiler_port
+                     if args.serving_profiler_port is not None
+                     else base_cfg.serving_profiler_port)
+    profile_capture = (base_cfg.serving_profile_capture
+                       if args.serving_profile_capture is None
+                       else args.serving_profile_capture == "on")
+    if profiler_port:
+        jax.profiler.start_server(profiler_port)
+        log.info("jax profiler server on :%d", profiler_port)
     cfg = MODEL_CONFIGS[args.model]()
     log.info("loading %s (%.2fB params) on %s", cfg.name,
              cfg.param_count / 1e9, jax.default_backend())
@@ -2069,6 +2147,7 @@ def main(argv=None) -> int:
         paged_prefill=None if kv_paged_prefill else False,
         kv_arena_sharding=kv_arena_sharding,
         serving_chunk_tokens=serving_chunk_tokens,
+        flight_recorder=flight_recorder,
         # text mode stops at the tokenizer's EOS instead of always burning
         # the full max_new_tokens budget
         eos_token=(tokenizer.eos_id if tokenizer is not None else -1)),
@@ -2082,7 +2161,8 @@ def main(argv=None) -> int:
                   max_connections=args.max_connections,
                   handoff_stream_window=handoff_stream_window,
                   device_domain=placement_domain,
-                  pull_timeout_s=pull_timeout_s)
+                  pull_timeout_s=pull_timeout_s,
+                  profile_capture=profile_capture)
     log.info("serving on :%d (POST /generate, GET /metrics)", args.port)
     import socket
     host = socket.gethostname()

@@ -57,9 +57,10 @@ from ...metrics import Metrics
 from ...models.llama import LlamaConfig, LlamaModel, Params
 from ...tracing import Tracer
 from .kv_manager import DensePrefixStore, PagedKVStore, kv_cache_pspec  # noqa: F401 — kv_cache_pspec re-exported (layout contract)
+from .recorder import STEP_BUCKETS, CompileWatchdog, FlightRecorder
 from .sampler import (_apply_penalties, _bias_row, _bump_counts,
                       _logit_modded, _penalized, _row_keys, _sample,
-                      _set_count_row)
+                      _sample_filtered, _sample_plain, _set_count_row)
 from .scheduler import (ITL_BUCKETS, TTFT_BUCKETS, UTIL_BUCKETS,
                         ChunkArbiter, EngineDraining, EngineOverloaded,
                         Request, ServingConfig, _fail_future, _Slot)
@@ -408,11 +409,34 @@ class ServingEngine:
         # publish reaches the directory on the next beat rather than one
         # interval later; invoked outside engine locks, best-effort
         self.prefix_publish_hook: Optional[Any] = None
+        # flight recorder (ISSUE 17): bounded per-decode-step timeline
+        # ring, served at /debug/steps and folded into serving.request
+        # spans. None when off — every hot-path mark site gates on
+        # `is not None`, so a disabled recorder costs one attribute load
+        # per site and holds no memory
+        self.recorder: Optional[FlightRecorder] = None
+        if sc.flight_recorder:
+            self.recorder = FlightRecorder(
+                max_steps=sc.recorder_steps, max_bytes=sc.recorder_bytes,
+                perf=self._perf, metrics=self.metrics,
+                max_requests=max(64, 4 * sc.slots))
+        # XLA recompile watchdog (ISSUE 17): ALWAYS on — its per-call
+        # cost is one cache-size read, and the PR 12 flap class (a
+        # cache-key change recompiling the hot loop every other step)
+        # is exactly the bug that hides until production traffic
+        self.watchdog = CompileWatchdog(metrics=self.metrics,
+                                        tracer=self.tracer)
+        # sliding-window ring pages recycled since the last step record
+        self._ring_recycled = 0
+        # hot-path jits ride the watchdog: fns with an alarm budget warn
+        # past it; bucketed fns (budget=None — prefill-length buckets,
+        # 1-row prefill vs B-row batch forms) track without alarming
+        wd = self.watchdog.wrap
         self._update_page_gauges()
         # per-slot sampling state: (request seed, draws so far) -> PRNG key
         self._slot_seed = np.zeros((sc.slots,), np.uint32)
         self._slot_draws = np.zeros((sc.slots,), np.int32)
-        self._row_keys = jax.jit(_row_keys)
+        self._row_keys = wd("row_keys", jax.jit(_row_keys), budget=None)
         # OpenAI penalties: per-slot token-occurrence counts (slots, V)
         # int32 on device, allocated lazily at the first penalized request
         # (slots x 128k-vocab x 4B = ~8MB at 16 slots — but zero cost for
@@ -470,7 +494,8 @@ class ServingEngine:
         # (L, slots, len, h, d) cache every step — on HBM that's the
         # difference between O(tokens written) and O(cache bytes) per step
         donate = (2,) if sc.donate_cache else ()
-        self._decode = jax.jit(self.model.decode_step, donate_argnums=donate)
+        self._decode = wd("decode", jax.jit(self.model.decode_step,
+                                            donate_argnums=donate))
         # paged decode loop: arg 2 is the ARENA (donated in place of the
         # batch cache — same in-place-update economics, shared storage).
         # Mesh serving PINS out_shardings to the arena's construction
@@ -485,15 +510,16 @@ class ServingEngine:
         self._paged_chunk = None
         if self._paged_loop:
             if mesh is None:
-                self._paged_step = jax.jit(self.model.paged_decode_step,
-                                           donate_argnums=donate)
+                self._paged_step = wd("paged_step", jax.jit(
+                    self.model.paged_decode_step, donate_argnums=donate))
                 if sc.speculate_k > 0:
-                    self._paged_verify = jax.jit(self.model.paged_verify_step,
-                                                 donate_argnums=donate)
+                    self._paged_verify = wd("paged_verify", jax.jit(
+                        self.model.paged_verify_step,
+                        donate_argnums=donate))
                 if self._paged_prefill_on:
-                    self._paged_chunk = jax.jit(
+                    self._paged_chunk = wd("paged_chunk", jax.jit(
                         self.model.paged_prefill_chunk_step,
-                        donate_argnums=donate)
+                        donate_argnums=donate), budget=None)
             else:
                 import functools
                 from jax.sharding import NamedSharding, PartitionSpec
@@ -503,23 +529,24 @@ class ServingEngine:
                 shard_kv = self._arena_sharding != "replicate"
                 # a replicated arena pins replicated shard_map specs in the
                 # step (sharded specs would reshard the whole arena per step)
-                self._paged_step = jax.jit(
+                self._paged_step = wd("paged_step", jax.jit(
                     functools.partial(self.model.paged_decode_step,
                                       shard_kv=shard_kv),
                     donate_argnums=donate,
-                    out_shardings=(repl, arena_sh, repl))
+                    out_shardings=(repl, arena_sh, repl)))
                 if sc.speculate_k > 0:
-                    self._paged_verify = jax.jit(
+                    self._paged_verify = wd("paged_verify", jax.jit(
                         functools.partial(self.model.paged_verify_step,
                                           shard_kv=shard_kv),
                         donate_argnums=donate,
-                        out_shardings=(repl, arena_sh))
+                        out_shardings=(repl, arena_sh)))
                 if self._paged_prefill_on:
-                    self._paged_chunk = jax.jit(
+                    self._paged_chunk = wd("paged_chunk", jax.jit(
                         functools.partial(self.model.paged_prefill_chunk_step,
                                           shard_kv=shard_kv),
                         donate_argnums=donate,
-                        out_shardings=(repl, arena_sh, repl))
+                        out_shardings=(repl, arena_sh, repl)),
+                        budget=None)
         self.metrics.set_gauge("tpu_serving_paged_decode",
                                1 if self._paged_loop else 0)
         # TP paged serving (ISSUE 12): dashboards join this to the decode
@@ -532,23 +559,43 @@ class ServingEngine:
         # the contiguous loop's verify jit; the paged loop verifies
         # through _paged_verify instead (same speculative bookkeeping,
         # page tables for KV)
-        self._verify = (jax.jit(self.model.verify_step, donate_argnums=donate)
-                        if sc.speculate_k > 0 and not self._paged_loop
-                        else None)
+        self._verify = wd("verify",
+                          jax.jit(self.model.verify_step,
+                                  donate_argnums=donate)
+                          if sc.speculate_k > 0 and not self._paged_loop
+                          else None)
         # the prefill thread's per-chunk step (prefill_chunk_step: verify
         # kernel + traced index advance) is NOT donated: a prefix-cache
         # hit starts chunked appends from a gathered/stored cache, which
         # must survive for future hits
-        self._chunk_step = jax.jit(self.model.prefill_chunk_step)
+        self._chunk_step = wd("chunk_step",
+                              jax.jit(self.model.prefill_chunk_step),
+                              budget=None)
         if sc.speculate_k > 0:
             # zero-seed so acceptance-rate dashboards see the series from
             # pod start, not first acceptance
             self.metrics.incr("tpu_serving_spec_proposed", 0)
             self.metrics.incr("tpu_serving_spec_accepted", 0)
-        self._prefill = jax.jit(self.model.prefill)
+        self._prefill = wd("prefill", jax.jit(self.model.prefill),
+                           budget=None)
         # donate the old cache so XLA updates the slot in place instead of
         # copying the whole multi-layer K/V on every admission
-        self._insert = jax.jit(LlamaModel.insert_into_slot, donate_argnums=(0,))
+        self._insert = wd("insert",
+                          jax.jit(LlamaModel.insert_into_slot,
+                                  donate_argnums=(0,)), budget=None)
+        # module-level sampler jits are SHARED across engines, and the
+        # arena's write/gather jits live inside the store — the watchdog
+        # POLLS their cache sizes once per decode step (_observe_step)
+        # instead of wrapping: step-granular attribution, which is
+        # enough to catch a flap without aliasing other engines' calls
+        att = self.watchdog.attach
+        att("sample_plain", _sample_plain)
+        att("sample_filtered", _sample_filtered)
+        att("apply_penalties", _apply_penalties)
+        att("bump_counts", _bump_counts)
+        if self._kv_store is not None:
+            att("kv_write", getattr(self._kv_store, "_write", None))
+            att("kv_gather", getattr(self._kv_store, "_gather", None))
         self.total_generated = 0
         self.last_error: Optional[str] = None
 
@@ -684,6 +731,41 @@ class ServingEngine:
         m.describe("tpu_serving_batch_utilization",
                    "filled slots / max slots per decode step",
                    buckets=UTIL_BUCKETS)
+        m.describe("tpu_serving_step_wall_seconds",
+                   "decode-step wall time (flight recorder; the four "
+                   "phase histograms below sum to it per step)",
+                   buckets=STEP_BUCKETS)
+        m.describe("tpu_serving_step_schedule_seconds",
+                   "step phase: host-side batch assembly — slot-table "
+                   "growth, lengths/page-table staging, draft proposals",
+                   buckets=STEP_BUCKETS)
+        m.describe("tpu_serving_step_kernel_seconds",
+                   "step phase: device DISPATCH of the decode/verify jit "
+                   "(async — materialization lands in the sample phase)",
+                   buckets=STEP_BUCKETS)
+        m.describe("tpu_serving_step_sample_seconds",
+                   "step phase: logits materialization + per-slot "
+                   "sampling (temperature/top-k/top-p, penalties, "
+                   "logprobs)",
+                   buckets=STEP_BUCKETS)
+        m.describe("tpu_serving_step_commit_seconds",
+                   "step phase: host-side token commit — stream "
+                   "emission, stop checks, slot bookkeeping, rollback",
+                   buckets=STEP_BUCKETS)
+        m.describe("tpu_serving_step_tokens",
+                   "tokens committed per decode step (speculative steps "
+                   "commit several per slot)",
+                   buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0))
+        m.describe("tpu_serving_step_ring_records",
+                   "records currently held by the flight-recorder ring")
+        m.describe("tpu_serving_step_ring_bytes",
+                   "serialized bytes held by the flight-recorder ring "
+                   "(hard-bounded by recorder_bytes)")
+        m.describe("tpu_serving_recompiles",
+                   "hot-path jit compiles BEYOND the first, per alarmed "
+                   "function — any rise is a cache-key flap (changed "
+                   "avals, shardings, or donation pattern recompiling "
+                   "the hot loop)")
 
     def _fresh_cache(self, batch: int) -> Params:
         """One construction path for every cache this engine makes (the
@@ -1263,6 +1345,9 @@ class ServingEngine:
                     if ran:
                         self.metrics.incr(
                             "tpu_serving_chunk_interleaved_steps", ran)
+                        if self.recorder is not None:
+                            self.recorder.event("chunk_interleave",
+                                                steps=ran)
         return last_logits, single
 
     def _single_ad_ids(self, adapter_id: int):
@@ -1292,6 +1377,8 @@ class ServingEngine:
                 if ran:
                     self.metrics.incr("tpu_serving_chunk_interleaved_steps",
                                       ran)
+                    if self.recorder is not None:
+                        self.recorder.event("chunk_interleave", steps=ran)
         return self._append_chunks(single, tokens[len(head):], last_logits,
                                    adapter_id, adapters, on_chunk=on_chunk,
                                    done=len(head))
@@ -1331,7 +1418,8 @@ class ServingEngine:
                 s = jnp.sum(h32 * mask, axis=1)
                 return (s / n.astype(jnp.float32))[0]
 
-            fn = self._embed_fns[bucket] = jax.jit(pooled)
+            fn = self._embed_fns[bucket] = self.watchdog.wrap(
+                f"embed_{bucket}", jax.jit(pooled))
         arr, n = self._padded(tokens)
         return [float(x) for x in np.asarray(fn(self.params, arr, n[0]))]
 
@@ -1349,9 +1437,14 @@ class ServingEngine:
             self.metrics.set_gauge("tpu_serving_kv_pages_total", 0)
             self.metrics.set_gauge("tpu_serving_kv_pages_free", 0)
             self.metrics.set_gauge("tpu_serving_kv_pages_shared", 0)
+            self._page_stats = None
             return
         with self._prefix_lock:
             stats = self._kv_store.stats()
+        # cached for per-step records: shared_count walks the refcount
+        # list, too heavy per decode step — step records read this
+        # snapshot (refreshed on every arena mutation) instead
+        self._page_stats = stats
         self.metrics.set_gauge("tpu_serving_kv_pages_total",
                                stats["pages_total"])
         self.metrics.set_gauge("tpu_serving_kv_pages_free",
@@ -1470,6 +1563,9 @@ class ServingEngine:
                         if ran:
                             self.metrics.incr(
                                 "tpu_serving_chunk_interleaved_steps", ran)
+                            if self.recorder is not None:
+                                self.recorder.event("chunk_interleave",
+                                                    steps=ran)
             # cache admission BY REFERENCE: the run's full pages join the
             # trie with no copy (the partial tail page stays private).
             # Best-effort like the dense insert.
@@ -2781,6 +2877,9 @@ class ServingEngine:
         if not any(active[i] and slots[i].request.temperature <= 0.0
                    and not _logit_modded(slots[i].request) for i in range(b)):
             return False
+        rec = self.recorder
+        if rec is not None:
+            rec.step_begin()
         active_mask = jnp.asarray(active)
         toks_in = np.zeros((b, k + 1), np.int32)
         n_greedy = 0
@@ -2794,11 +2893,15 @@ class ServingEngine:
                 n_greedy += 1
             else:
                 toks_in[i, 1:] = slot.last_token  # placeholder, never checked
+        if rec is not None:
+            rec.mark("schedule")
         logits, self._cache = self._verify(
             self.params, jnp.asarray(toks_in), self._cache, active_mask,
             self._adapters,
             None if self._adapters is None
             else jnp.asarray(self._slot_adapter.copy()))
+        if rec is not None:
+            rec.mark("kernel")
         greedy_np = np.asarray(jnp.argmax(logits, axis=-1))   # (B, K+1)
         # sampled slots draw token 1 from the same distribution decode_step
         # would have produced (logits[:, 0])
@@ -2830,6 +2933,9 @@ class ServingEngine:
         self.metrics.incr("tpu_serving_spec_proposed", k * n_greedy)
 
         advance = np.zeros((b,), np.int32)
+        accepted_total = 0
+        if rec is not None:
+            rec.mark("sample")
         step_now = self._perf()
         for i, slot in enumerate(slots):
             if not active[i]:
@@ -2869,12 +2975,23 @@ class ServingEngine:
                 # accepted = drafts actually CONSUMED (an early finish must
                 # not inflate the exported acceptance rate)
                 self.metrics.incr("tpu_serving_spec_accepted", appended - 1)
+                accepted_total += appended - 1
         idx = self._cache["index"]
         self._cache = dict(self._cache)
         self._cache["index"] = idx + jnp.asarray(advance)
         self._tokens = jnp.asarray([s.last_token for s in slots], jnp.int32)
         self.metrics.incr("tpu_serving_decode_steps")
         self._observe_step(sum(1 for a in active if a))
+        if rec is not None:
+            rec.step_end(
+                mode="spec_verify", active=sum(1 for a in active if a),
+                draining=self._draining.is_set(), paged=False, spec_k=k,
+                adapters=int((self._slot_adapter != 0).sum()),
+                tokens=int(advance.sum()),
+                rids=[s.request.rid for s in slots
+                      if s.request is not None],
+                spec={"proposed": k * n_greedy,
+                      "accepted": accepted_total, "rolled_back_pages": 0})
         return True
 
     def _observe_itl(self, slot: _Slot, appended: int, now: float):
@@ -2896,6 +3013,33 @@ class ServingEngine:
         self.metrics.observe("tpu_serving_batch_utilization",
                              n_active / max(1, self.sc.slots))
         self._update_kv_gauge()
+        # compile detection for the POLLED (shared module-level) jits —
+        # one dict-len read per attached fn per step
+        self.watchdog.poll()
+
+    def _arena_step_stats(self) -> Optional[dict]:
+        """O(1) arena occupancy for a step record: live counts from the
+        pool, trie-shared from the last gauge refresh (walking refcounts
+        per step would cost more than the step), plus the window-ring
+        pages recycled since the last record."""
+        store = self._kv_store
+        if store is None:
+            return None
+        recycled, self._ring_recycled = self._ring_recycled, 0
+        stats = self._page_stats
+        return {"pages_total": store.pool.n_pages,
+                "pages_free": store.pool.free_count,
+                "pages_shared": stats["pages_shared"] if stats else 0,
+                "ring_recycled": recycled}
+
+    def debug_steps(self, n: int = 64) -> dict:
+        """The GET /debug/steps payload: the step-record tail + rollup
+        (when the recorder is on) and the watchdog's per-fn compile
+        counts (always)."""
+        out = ({"enabled": False} if self.recorder is None
+               else self.recorder.snapshot(n))
+        out["recompiles"] = self.watchdog.snapshot()
+        return out
 
     def _update_kv_gauge(self):
         self.metrics.set_gauge("tpu_serving_kv_cache_tokens", sum(
@@ -2907,12 +3051,19 @@ class ServingEngine:
             return self._decode_once_paged()
         if self._verify is not None and self._decode_once_speculative():
             return
+        rec = self.recorder
+        if rec is not None:
+            rec.step_begin()
         active_mask = jnp.asarray([s.request is not None for s in self._slots])
+        if rec is not None:
+            rec.mark("schedule")
         logits, self._cache = self._decode(
             self.params, self._tokens, self._cache, active_mask,
             self._adapters,
             None if self._adapters is None
             else jnp.asarray(self._slot_adapter.copy()))
+        if rec is not None:
+            rec.mark("kernel")
         self._commit_decode(logits)
 
     def _grow_slot_table(self, slot_id: int, slot: _Slot, need: int) -> bool:
@@ -2951,6 +3102,9 @@ class ServingEngine:
                             store.pool.unref(old)
                             slot.pages.remove(old)
                             slot.pages.append(page)
+                        # engine-thread-only counter, drained into the
+                        # next step record (_arena_step_stats)
+                        self._ring_recycled += 1
                     else:
                         page = store.alloc_run(1)[0]
                         slot.pages.append(page)
@@ -2985,6 +3139,9 @@ class ServingEngine:
         if (self._paged_verify is not None and self._window is None
                 and self._decode_once_speculative_paged()):
             return
+        rec = self.recorder
+        if rec is not None:
+            rec.step_begin()
         store = self._kv_store
         for slot_id, slot in enumerate(self._slots):
             if slot.request is None:
@@ -2996,6 +3153,8 @@ class ServingEngine:
             return
         lengths = jnp.asarray([s.kv_len for s in self._slots], jnp.int32)
         page_tables = jnp.asarray(self._page_tables_np)
+        if rec is not None:
+            rec.mark("schedule")
         with self._prefix_lock:
             logits, arena, _ = self._paged_step(
                 self.params, self._tokens, store.arena, page_tables,
@@ -3003,6 +3162,8 @@ class ServingEngine:
                 None if self._adapters is None
                 else jnp.asarray(self._slot_adapter.copy()))
             store.arena = arena
+        if rec is not None:
+            rec.mark("kernel")
         self._commit_decode(logits)
 
     def _decode_once_speculative_paged(self) -> bool:
@@ -3032,6 +3193,9 @@ class ServingEngine:
 
         if not any(greedy(i) for i in range(b)):
             return False
+        rec = self.recorder
+        if rec is not None:
+            rec.step_begin()
         # table growth BEFORE the step: a greedy slot may write k+1 rows
         # this pass, a sampled slot exactly 1
         for i, slot in enumerate(slots):
@@ -3058,6 +3222,8 @@ class ServingEngine:
                 n_tokens[i] = 1
         lengths = jnp.asarray([s.kv_len for s in slots], jnp.int32)
         page_tables = jnp.asarray(self._page_tables_np)
+        if rec is not None:
+            rec.mark("schedule")
         with self._prefix_lock:
             logits, arena = self._paged_verify(
                 self.params, jnp.asarray(toks_in), store.arena,
@@ -3066,6 +3232,8 @@ class ServingEngine:
                 else jnp.asarray(self._slot_adapter.copy()),
                 jnp.asarray(n_tokens))
             store.arena = arena
+        if rec is not None:
+            rec.mark("kernel")
         greedy_np = np.asarray(jnp.argmax(logits, axis=-1))   # (B, K+1)
         reqs = [s.request for s in slots]
         temps = [r.temperature if r else 0.0 for r in reqs]
@@ -3094,8 +3262,12 @@ class ServingEngine:
             self._bump_penalty_counts(reqs, sampled_np)
         self.metrics.incr("tpu_serving_spec_proposed", k * n_greedy)
 
+        if rec is not None:
+            rec.mark("sample")
         step_now = self._perf()
         rolled_back = 0
+        accepted_total = 0
+        committed_total = 0
         for i, slot in enumerate(slots):
             if not active[i]:
                 continue
@@ -3129,10 +3301,12 @@ class ServingEngine:
                 if self._finished(slot):
                     self._complete(i, slot)
             self._observe_itl(slot, appended, step_now)
+            committed_total += appended
             if greedy_slot and appended > 1:
                 # accepted = drafts actually CONSUMED (an early finish must
                 # not inflate the exported acceptance rate)
                 self.metrics.incr("tpu_serving_spec_accepted", appended - 1)
+                accepted_total += appended - 1
             if slot.request is None:
                 continue  # _complete released every page already
             # rejection rollback: table entries past the committed length
@@ -3158,6 +3332,18 @@ class ServingEngine:
         self.metrics.incr("tpu_serving_decode_steps")
         self.metrics.incr("tpu_serving_paged_speculative_steps")
         self._observe_step(sum(1 for a in active if a))
+        if rec is not None:
+            rec.step_end(
+                mode="spec_verify", active=sum(1 for a in active if a),
+                draining=self._draining.is_set(), paged=True, spec_k=k,
+                adapters=int((self._slot_adapter != 0).sum()),
+                tokens=committed_total,
+                rids=[s.request.rid for s in slots
+                      if s.request is not None],
+                arena=self._arena_step_stats(),
+                spec={"proposed": k * n_greedy,
+                      "accepted": accepted_total,
+                      "rolled_back_pages": rolled_back})
         return True
 
     def _commit_decode(self, logits):
@@ -3178,6 +3364,9 @@ class ServingEngine:
             logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
             lp_np = np.asarray(jnp.take_along_axis(
                 logp, jnp.asarray(next_np)[:, None], axis=-1)[:, 0])
+        rec = self.recorder
+        if rec is not None:
+            rec.mark("sample")
         step_now = self._perf()
         n_active = 0
         for slot_id, slot in enumerate(self._slots):
@@ -3201,6 +3390,16 @@ class ServingEngine:
         self._tokens = jnp.asarray(next_np, jnp.int32)
         self.metrics.incr("tpu_serving_decode_steps")
         self._observe_step(n_active)
+        if rec is not None:
+            rec.step_end(
+                mode="decode", active=n_active,
+                draining=self._draining.is_set(),
+                paged=self._paged_loop, spec_k=0,
+                adapters=int((self._slot_adapter != 0).sum()),
+                tokens=n_active,
+                rids=[s.request.rid for s in self._slots
+                      if s.request is not None],
+                arena=self._arena_step_stats())
 
     def _maybe_penalize(self, logits: jax.Array, reqs) -> jax.Array:
         """Apply OpenAI presence/frequency penalties and logit_bias to
@@ -3311,17 +3510,27 @@ class ServingEngine:
         end = wall(req.submitted_at + latency)
         ttft = (req.first_token_at - req.submitted_at
                 if req.first_token_at else None)
+        attrs = {"rid": req.rid, "prompt_tokens": len(req.prompt),
+                 "tokens": len(slot.generated),
+                 "ttft_s": ttft, "latency_s": latency,
+                 "adapter_id": req.adapter_id,
+                 # prefix-cache outcome: dashboards join hit-rate
+                 # to TTFT per request (the router-affinity payoff)
+                 "prefix_hit": req.matched_prefix_tokens > 0,
+                 "matched_prefix_tokens": req.matched_prefix_tokens}
+        if self.recorder is not None:
+            # flight-recorder attribution: how many engine steps this
+            # request rode and its even share of their wall/kernel time
+            # — the join from a slow request to the step timeline that
+            # served it (/debug/steps)
+            acc = self.recorder.pop_request(req.rid)
+            if acc is not None:
+                attrs["decode_steps"] = acc["steps"]
+                attrs["step_wall_share_s"] = round(acc["step_wall_s"], 6)
+                attrs["step_kernel_share_s"] = round(acc["kernel_s"], 6)
         tr.record("serving.request", wall(req.submitted_at), end,
                   trace_id=trace_id, span_id=root,
-                  parent_id=req.parent_span_id,
-                  attrs={"rid": req.rid, "prompt_tokens": len(req.prompt),
-                         "tokens": len(slot.generated),
-                         "ttft_s": ttft, "latency_s": latency,
-                         "adapter_id": req.adapter_id,
-                         # prefix-cache outcome: dashboards join hit-rate
-                         # to TTFT per request (the router-affinity payoff)
-                         "prefix_hit": req.matched_prefix_tokens > 0,
-                         "matched_prefix_tokens": req.matched_prefix_tokens})
+                  parent_id=req.parent_span_id, attrs=attrs)
         if req.dequeued_at:
             tr.record("serving.queue_wait", wall(req.submitted_at),
                       wall(req.dequeued_at), trace_id=trace_id,

@@ -1,0 +1,491 @@
+"""Serving flight recorder: per-step engine timeline + XLA recompile
+watchdog (ISSUE 17).
+
+The engine's request spans answer "why was THIS request slow?"; nothing
+answered "what did the ENGINE do on step 48123?" — the PR 12 jit-cache
+flap was found by eyeballing compile logs, and the bench rounds carry
+opaque step medians with no phase attribution. Two dependency-free
+pieces (stdlib only, tracing.py's design constraints):
+
+- **FlightRecorder**: a bounded ring of per-decode-step records — batch
+  composition, the step wall time split into contiguous
+  schedule/kernel/sample/commit phases (phase marks telescope, so the
+  phases SUM to the step wall time by construction), arena page
+  occupancy, speculative accounting, chunk-interleave events — plus a
+  bounded per-request accumulator the engine folds into its
+  ``serving.request`` spans. Served at ``GET /debug/steps``.
+- **CompileWatchdog**: wraps the engine's hot-path jits in a
+  compile-tracking seam (jax.jit's compile cache grows exactly when a
+  call compiled), counts ``tpu_serving_recompiles{fn=}``, records a loud
+  ``serving.recompile`` span with the old/new abstract-value diff, and
+  log-once warns when a hot function compiles past its budget —
+  mechanizing the PR 12 bug class (an out_shardings normalization flip
+  recompiled the paged step every other batch) the way graftlint
+  mechanized review findings.
+
+Threading: phase marks (``step_begin``/``mark``/``step_end``) are
+engine-thread-only and lock-free on the hot path; the ring and the
+per-request table are guarded by one lock so ``snapshot()`` (HTTP
+threads) and ``pop_request`` (engine thread) stay consistent. ``event()``
+may be called from any thread.
+
+Overhead discipline: a disabled recorder is ``None`` on the engine — the
+hot path pays one attribute load and an ``is not None`` test per mark
+site, nothing else. The watchdog's per-call cost is one ``_cache_size()``
+read (a dict ``len`` under the jit wrapper); fingerprints are computed
+only when a compile is DETECTED, never per call.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Callable, Optional
+
+log = logging.getLogger(__name__)
+
+# phase marks in hot-path order; step_end closes "commit"
+PHASES = ("schedule", "kernel", "sample", "commit")
+
+# decode steps live in the single-digit-millisecond to ~100ms band on
+# real hardware (CPU smoke runs slower); the TTFT ladder's 0.5s first
+# bucket would crush every sample into one bin
+STEP_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                0.25, 0.5, 1.0)
+
+
+class FlightRecorder:
+    """Bounded ring of per-decode-step records + per-request attribution.
+
+    ``max_steps`` bounds the record count and ``max_bytes`` bounds the
+    ring's serialized size (each record is JSON-sized once at append;
+    oldest records evict until both bounds hold) — the ring can never
+    exceed its byte budget no matter how attr-heavy the steps get.
+    ``perf`` is the engine's ``_perf`` seam (perf_counter, injectable),
+    so the deterministic soaks drive phase math from a fake clock."""
+
+    def __init__(self, max_steps: int = 512, max_bytes: int = 262144,
+                 perf: Callable[[], float] = time.perf_counter,
+                 metrics=None, max_requests: int = 64):
+        if max_steps < 1:
+            raise ValueError(f"max_steps must be >= 1, got {max_steps}")
+        if max_bytes < 1024:
+            raise ValueError(f"max_bytes must be >= 1024, got {max_bytes}")
+        self.max_steps = max_steps
+        self.max_bytes = max_bytes
+        self.max_requests = max_requests
+        self._perf = perf
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        # ring of (record_dict, serialized_bytes); bytes tracked so the
+        # budget is enforced on real serialized size, not a guess
+        self._ring: "deque[tuple[dict, int]]" = deque()
+        self._bytes = 0
+        self._seq = 0
+        self.dropped_records = 0
+        # per-request phase accumulators, folded into serving.request
+        # spans at completion; bounded FIFO-drop-oldest (a dropped entry
+        # costs one request its step attribution, never memory)
+        self._by_request: "OrderedDict[str, dict]" = OrderedDict()
+        # engine-thread step state (no lock: marks never cross threads)
+        self._t0: Optional[float] = None
+        self._marks: list[tuple[str, float]] = []
+
+    # -- hot path (engine thread only) -----------------------------------------
+
+    def step_begin(self):
+        """Arm a step: t0 for the schedule phase (slot-table growth,
+        lengths/page-table assembly)."""
+        self._t0 = self._perf()
+        self._marks = []
+
+    def mark(self, phase: str):
+        """Close the named phase at now; the next phase opens here."""
+        if self._t0 is None:
+            return
+        self._marks.append((phase, self._perf()))
+
+    def step_end(self, mode: str = "decode", active: int = 0,
+                 draining: bool = False, paged: bool = False,
+                 spec_k: int = 0, adapters: int = 0, tokens: int = 0,
+                 rids: Optional[list] = None, arena: Optional[dict] = None,
+                 spec: Optional[dict] = None, interleaved: bool = False):
+        """Close the step ("commit" phase ends now), build the record,
+        observe the step histograms, and charge the step to ``rids``."""
+        if self._t0 is None:
+            return
+        t_end = self._perf()
+        phases: dict[str, float] = {}
+        prev = self._t0
+        for name, t in self._marks:
+            phases[name] = phases.get(name, 0.0) + (t - prev)
+            prev = t
+        phases["commit"] = phases.get("commit", 0.0) + (t_end - prev)
+        wall = t_end - self._t0
+        self._t0 = None
+        self._marks = []
+        record = {
+            "seq": self._seq,
+            "t": round(t_end, 6),
+            "wall_s": wall,
+            "phases": {f"{p}_s": phases.get(p, 0.0) for p in PHASES},
+            "batch": {"mode": mode, "active": active,
+                      "draining": bool(draining), "paged": bool(paged),
+                      "spec_k": spec_k, "adapters": adapters,
+                      "interleaved": bool(interleaved)},
+            "tokens": tokens,
+        }
+        if arena:
+            record["arena"] = arena
+        if spec:
+            record["spec"] = spec
+        self._seq += 1
+        self._append(record)
+        if self.metrics is not None:
+            m = self.metrics
+            m.observe("tpu_serving_step_wall_seconds", wall)
+            # one literal per phase (not a loop over PHASES): the
+            # observability lint reads names statically
+            m.observe("tpu_serving_step_schedule_seconds",
+                      phases.get("schedule", 0.0))
+            m.observe("tpu_serving_step_kernel_seconds",
+                      phases.get("kernel", 0.0))
+            m.observe("tpu_serving_step_sample_seconds",
+                      phases.get("sample", 0.0))
+            m.observe("tpu_serving_step_commit_seconds",
+                      phases.get("commit", 0.0))
+            m.observe("tpu_serving_step_tokens", float(tokens))
+        if rids:
+            share = wall / len(rids)
+            with self._lock:
+                for rid in rids:
+                    acc = self._by_request.get(rid)
+                    if acc is None:
+                        while len(self._by_request) >= self.max_requests:
+                            self._by_request.popitem(last=False)
+                        acc = self._by_request[rid] = {
+                            "steps": 0, "step_wall_s": 0.0,
+                            "kernel_s": 0.0}
+                    acc["steps"] += 1
+                    acc["step_wall_s"] += share
+                    acc["kernel_s"] += phases.get("kernel", 0.0) / len(rids)
+
+    def event(self, kind: str, **attrs):
+        """Out-of-band timeline entry (chunk-interleave yields, prefill
+        chunk completions); any thread."""
+        record = {"seq": self._seq, "t": round(self._perf(), 6),
+                  "event": kind}
+        if attrs:
+            record.update(attrs)
+        self._seq += 1
+        self._append(record)
+
+    def _append(self, record: dict):
+        try:
+            # compact separators: sized AND stored compact, so the byte
+            # budget buys more records and the dumps stays cheap
+            nbytes = len(json.dumps(record, separators=(",", ":")))
+        except (TypeError, ValueError):
+            # a non-serializable attr must never kill the engine thread
+            with self._lock:
+                self.dropped_records += 1
+            return
+        with self._lock:
+            if nbytes > self.max_bytes:
+                self.dropped_records += 1
+                return
+            self._ring.append((record, nbytes))
+            self._bytes += nbytes
+            while (len(self._ring) > self.max_steps
+                   or self._bytes > self.max_bytes):
+                _, old = self._ring.popleft()
+                self._bytes -= old
+            n_records, n_bytes = len(self._ring), self._bytes
+        # occupancy gauges refresh every 16th append (plus first): the
+        # ring turns over hundreds of times between scrapes, so per-append
+        # gauge writes are pure hot-path cost with no observability gain
+        if self.metrics is not None and (self._seq & 0xF) == 1:
+            self.metrics.set_gauge("tpu_serving_step_ring_records",
+                                   n_records)
+            self.metrics.set_gauge("tpu_serving_step_ring_bytes", n_bytes)
+
+    # -- request attribution ---------------------------------------------------
+
+    def pop_request(self, rid: str) -> Optional[dict]:
+        """Take (and forget) a request's accumulated step attribution —
+        the engine folds it into the serving.request span at completion."""
+        with self._lock:
+            return self._by_request.pop(rid, None)
+
+    # -- reads -----------------------------------------------------------------
+
+    @property
+    def ring_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def records(self, n: int = 0) -> list[dict]:
+        """The newest ``n`` records (0 = all), oldest first."""
+        with self._lock:
+            recs = [r for r, _ in self._ring]
+        return recs[-n:] if n else recs
+
+    def rollup(self) -> dict:
+        """Phase medians + batch composition over the current ring — the
+        /debug/steps summary (and the bench cell's in-row numbers)."""
+        with self._lock:
+            recs = [r for r, _ in self._ring]
+            nbytes = self._bytes
+            dropped = self.dropped_records
+        steps = [r for r in recs if "wall_s" in r]
+        out: dict[str, Any] = {
+            "records": len(recs), "steps": len(steps),
+            "events": len(recs) - len(steps), "bytes": nbytes,
+            "max_bytes": self.max_bytes, "dropped": dropped,
+        }
+        if not steps:
+            return out
+        out["wall_ms_p50"] = _median([r["wall_s"] for r in steps]) * 1e3
+        for p in PHASES:
+            out[f"{p}_ms_p50"] = _median(
+                [r["phases"].get(f"{p}_s", 0.0) for r in steps]) * 1e3
+        out["active_p50"] = _median(
+            [r["batch"]["active"] for r in steps])
+        out["tokens_total"] = sum(r.get("tokens", 0) for r in steps)
+        out["spec_steps"] = sum(
+            1 for r in steps if r["batch"]["mode"] == "spec_verify")
+        return out
+
+    def snapshot(self, n: int = 64) -> dict:
+        """The /debug/steps payload: a JSONL-ready tail plus the rollup."""
+        return {"enabled": True, "steps": self.records(n),
+                "rollup": self.rollup()}
+
+
+def _median(vals: list) -> float:
+    s = sorted(vals)
+    return float(s[len(s) // 2]) if s else 0.0
+
+
+# -- compile watchdog ----------------------------------------------------------
+
+
+def _fingerprint(args: tuple, kwargs: dict, depth: int = 0) -> list[str]:
+    """Duck-typed abstract-value summary of a call's arguments: leaves
+    render as ``dtype[shape]@sharding`` via getattr (no jax import — the
+    module stays dependency-free and the fingerprints work on any array
+    library). Computed ONLY when a compile was detected; the diff of two
+    fingerprints is the serving.recompile span's payload."""
+    out: list[str] = []
+
+    def walk(x, path):
+        if len(out) >= 512:  # bound pathological pytrees
+            return
+        if isinstance(x, dict):
+            for k in sorted(x, key=str):
+                walk(x[k], f"{path}.{k}")
+        elif isinstance(x, (list, tuple)):
+            for i, v in enumerate(x):
+                walk(v, f"{path}[{i}]")
+        elif x is None or isinstance(x, (bool, int, float, str)):
+            out.append(f"{path}={x!r}")
+        else:
+            aval = getattr(x, "aval", None)
+            shape = getattr(x, "shape", None)
+            dtype = getattr(x, "dtype", None)
+            sharding = getattr(x, "sharding", None)
+            if aval is not None:
+                desc = str(aval)
+            elif shape is not None:
+                desc = f"{dtype}{tuple(shape)}"
+            else:
+                desc = type(x).__name__
+            if sharding is not None:
+                desc += f"@{sharding}"
+            out.append(f"{path}:{desc}")
+
+    for i, a in enumerate(args):
+        walk(a, f"a{i}")
+    for k in sorted(kwargs):
+        walk(kwargs[k], f"kw.{k}")
+    return out
+
+
+def _diff(old: list[str], new: list[str], limit: int = 8) -> list[str]:
+    """First few positions where two fingerprints disagree (the avals
+    that CHANGED are the recompile's cause)."""
+    changes = []
+    o_set = set(old)
+    for line in new:
+        if line not in o_set:
+            changes.append(f"+{line}")
+            if len(changes) >= limit:
+                return changes
+    n_set = set(new)
+    for line in old:
+        if line not in n_set:
+            changes.append(f"-{line}")
+            if len(changes) >= limit:
+                break
+    return changes
+
+
+class _TrackedJit:
+    """One wrapped jit: passes calls straight through, then reads the
+    wrapper's compile-cache size — growth means THIS call compiled."""
+
+    __slots__ = ("name", "fn", "budget", "_watchdog", "_size", "compiles",
+                 "_last_fp", "_warned")
+
+    def __init__(self, watchdog: "CompileWatchdog", name: str, fn,
+                 budget: Optional[int]):
+        self.name = name
+        self.fn = fn
+        self.budget = budget
+        self._watchdog = watchdog
+        self._size = self._cache_size()
+        self.compiles = 0
+        self._last_fp: Optional[list[str]] = None
+        self._warned = False
+
+    def _cache_size(self) -> Optional[int]:
+        # jax.jit wrappers expose _cache_size() (0.4.x); a toolchain
+        # without it degrades to no detection, never to a crash
+        getter = getattr(self.fn, "_cache_size", None)
+        if getter is None:
+            return None
+        try:
+            return int(getter())
+        except Exception as e:  # noqa: BLE001 — introspection must never fail a step
+            log.debug("compile-cache introspection of %s failed "
+                      "(watchdog degrades to no detection): %s",
+                      self.name, e)
+            return None
+
+    def __call__(self, *args, **kwargs):
+        out = self.fn(*args, **kwargs)
+        size = self._cache_size()
+        if size is not None and self._size is not None \
+                and size > self._size:
+            self._size = size
+            self._on_compile(args, kwargs)
+        elif size is not None:
+            self._size = size
+        return out
+
+    def poll(self):
+        """Cache-size check WITHOUT a call — for shared module-level jits
+        the engine cannot wrap (the sampler fns), polled once per step."""
+        size = self._cache_size()
+        if size is not None and self._size is not None \
+                and size > self._size:
+            self._size = size
+            self._on_compile((), {})
+
+    def _on_compile(self, args: tuple, kwargs: dict):
+        self.compiles += 1
+        fp = _fingerprint(args, kwargs) if (args or kwargs) else None
+        self._watchdog._compiled(self, fp)
+        self._last_fp = fp if fp is not None else self._last_fp
+
+    def snapshot(self) -> dict:
+        return {"compiles": self.compiles,
+                "recompiles": max(0, self.compiles - 1),
+                "budget": self.budget, "warned": self._warned}
+
+
+class CompileWatchdog:
+    """Tracks compiles across the engine's hot-path jits.
+
+    ``wrap(name, fn, budget)`` returns a call-compatible ``_TrackedJit``
+    (None passes through, so optional jits wire transparently);
+    ``attach`` registers a shared module-level jit for per-step polling
+    instead. Every compile past a function's FIRST increments
+    ``tpu_serving_recompiles{fn=}`` and records a ``serving.recompile``
+    span carrying the fingerprint diff; compiles past ``budget`` trip a
+    log-once warning. Bucket-compiling functions (prefill/chunk/insert —
+    one legitimate compile per prompt-length bucket) pass ``budget=None``
+    to keep tracking without the alarm."""
+
+    DEFAULT_BUDGET = 2
+
+    def __init__(self, metrics=None, tracer=None):
+        self.metrics = metrics
+        self.tracer = tracer
+        self._tracked: dict[str, _TrackedJit] = {}
+        self._polled: list[_TrackedJit] = []
+
+    def wrap(self, name: str, fn, budget: Optional[int] = DEFAULT_BUDGET):
+        if fn is None:
+            return None
+        tracked = _TrackedJit(self, name, fn, budget)
+        self._tracked[name] = tracked
+        if self.metrics is not None and budget is not None:
+            # zero-seed at wrap: the per-fn series must exist before the
+            # first (expected) compile, so dashboards alert on ANY rise
+            self.metrics.incr("tpu_serving_recompiles", 0,
+                              labels={"fn": name})
+        return tracked
+
+    def attach(self, name: str, fn, budget: Optional[int] = None):
+        """Track a jit the engine doesn't own (module-level, shared
+        across engines) by polling its cache size once per decode step
+        (``poll()``): compile attribution is step-granular instead of
+        call-granular, which is exactly enough to catch a flap."""
+        if fn is None:
+            return
+        tracked = _TrackedJit(self, name, fn, budget)
+        self._tracked[name] = tracked
+        self._polled.append(tracked)
+        if self.metrics is not None and budget is not None:
+            self.metrics.incr("tpu_serving_recompiles", 0,
+                              labels={"fn": name})
+
+    def poll(self):
+        for tracked in self._polled:
+            tracked.poll()
+
+    def _compiled(self, tracked: _TrackedJit, fp: Optional[list[str]]):
+        if tracked.compiles <= 1:
+            return  # the first compile is the contract, not a finding
+        # the counter covers only ALARMED fns (budget set): bucketed fns
+        # legitimately compile once per shape, so counting them would
+        # make "recompiles > 0" useless as an alert condition — their
+        # full counts still show in snapshot()/debug/steps
+        if self.metrics is not None and tracked.budget is not None:
+            self.metrics.incr("tpu_serving_recompiles",
+                              labels={"fn": tracked.name})
+        if self.tracer is not None:
+            try:
+                now = self.tracer.clock()
+                diff = (_diff(tracked._last_fp, fp)
+                        if tracked._last_fp and fp else [])
+                self.tracer.record(
+                    "serving.recompile", now, now,
+                    attrs={"fn": tracked.name,
+                           "compiles": tracked.compiles,
+                           "aval_diff": diff})
+            except Exception:  # noqa: BLE001 — tracing must never fail a step
+                log.exception("recompile span for %s failed", tracked.name)
+        if (tracked.budget is not None
+                and tracked.compiles > tracked.budget
+                and not tracked._warned):
+            tracked._warned = True
+            log.warning(
+                "serving: hot-path jit %r compiled %d times (budget %d) — "
+                "a cache-key flap (changed avals/shardings/donation "
+                "pattern) is recompiling the hot loop; see the "
+                "serving.recompile spans for the aval diff",
+                tracked.name, tracked.compiles, tracked.budget)
+
+    def snapshot(self) -> dict:
+        """Per-fn compile counts — /debug/steps carries this next to the
+        step ring (and the bench cell records it in-row)."""
+        return {name: t.snapshot()
+                for name, t in sorted(self._tracked.items())}
+
+    def total_recompiles(self) -> int:
+        return sum(max(0, t.compiles - 1) for t in self._tracked.values())

@@ -153,6 +153,18 @@ class ServingConfig:
     # the knob trades the prefilling request's own TTFT (one decode-step
     # wait per chunk) for everyone else's ITL.
     serving_chunk_tokens: int = 0
+    # -- flight recorder (ISSUE 17) --------------------------------------
+    # per-decode-step timeline: a bounded ring of step records (batch
+    # composition, schedule/kernel/sample/commit phase split on the
+    # engine's _perf clock, arena page counts, speculative accounting)
+    # served at GET /debug/steps and folded into serving.request spans.
+    # Off means the engine holds no recorder at all — the hot path pays
+    # one `is not None` test per mark site and nothing else. The ring is
+    # double-bounded: at most recorder_steps records AND at most
+    # recorder_bytes of serialized payload (oldest evict first).
+    flight_recorder: bool = True
+    recorder_steps: int = 512
+    recorder_bytes: int = 262144
 
 
 class EngineOverloaded(RuntimeError):

@@ -298,6 +298,72 @@ def test_observability_allowlisted_dynamic():
     assert r.findings == [] and len(r.suppressed) == 1
 
 
+GAUGE_SET = ("def f(metrics, rid):\n"
+             "    metrics.describe(\"my_gauge\", \"h\")\n"
+             "    metrics.set_gauge(\"my_gauge\", 1, "
+             "labels={\"replica\": rid})\n")
+GAUGE_README = "catalogue: `my_gauge`\n"
+
+
+def test_observability_entity_gauge_leak_flagged():
+    # per-entity labeled series with NO removal path anywhere: the PR 5
+    # stalled-gauge-leak class (series outlives its departed entity)
+    r = _run(ObservabilityChecker(allowlist={}), {"fleet/g.py": GAUGE_SET},
+             {"README.md": GAUGE_README})
+    assert [f.key for f in r.findings] == [("leak", "my_gauge")]
+    assert "stalled-gauge-leak" in r.findings[0].message
+
+
+def test_observability_entity_gauge_clean_with_removal_anywhere():
+    # the remove_gauge may live in a DIFFERENT file (the deregister path
+    # usually does) — the rule is package-wide, not per-file
+    cleanup = "def g(metrics, rid):\n" \
+              "    metrics.remove_gauge(\"my_gauge\", " \
+              "labels={\"replica\": rid})\n"
+    r = _run(ObservabilityChecker(allowlist={}),
+             {"fleet/g.py": GAUGE_SET, "fleet/cleanup.py": cleanup},
+             {"README.md": GAUGE_README})
+    assert r.findings == []
+
+
+def test_observability_entity_gauge_loop_removal_idiom():
+    # training_watch's _clear_training_gauges shape: a for-loop over a
+    # constant tuple whose body removes each name counts as removal for
+    # every name in the tuple
+    src = ("def f(metrics, pod):\n"
+           "    metrics.describe(\"g_a\", \"h\")\n"
+           "    metrics.describe(\"g_b\", \"h\")\n"
+           "    metrics.set_gauge(\"g_a\", 1, labels={\"pod\": pod})\n"
+           "    metrics.set_gauge(\"g_b\", 2, labels={\"pod\": pod})\n"
+           "def clear(metrics, pod):\n"
+           "    for name in (\"g_a\", \"g_b\"):\n"
+           "        metrics.remove_gauge(name, labels={\"pod\": pod})\n")
+    r = _run(ObservabilityChecker(allowlist={}), {"provider/w.py": src},
+             {"README.md": "`g_a` `g_b`\n"})
+    assert r.findings == []
+
+
+def test_observability_entity_gauge_leak_scoping():
+    # non-entity labels don't trip the rule, and a labels VARIABLE is
+    # invisible to it (the rule only judges literal dicts)
+    src = ("def f(metrics, labels):\n"
+           "    metrics.describe(\"g_c\", \"h\")\n"
+           "    metrics.set_gauge(\"g_c\", 1, labels={\"phase\": \"x\"})\n"
+           "    metrics.set_gauge(\"g_c\", 2, labels=labels)\n")
+    r = _run(ObservabilityChecker(allowlist={}), {"fleet/g.py": src},
+             {"README.md": "`g_c`\n"})
+    assert r.findings == []
+
+
+def test_observability_entity_gauge_leak_allowlisted():
+    r = _run(ObservabilityChecker(allowlist={
+        ("leak", "my_gauge"): "entity series dropped via computed-name "
+                              "helper (test justification)"}),
+        {"fleet/g.py": GAUGE_SET}, {"README.md": GAUGE_README})
+    assert r.findings == [] and len(r.suppressed) == 1
+    assert r.stale_allowlist == []
+
+
 # -- thread-hygiene ------------------------------------------------------------
 
 def test_thread_hygiene_flags_fire_and_forget():

@@ -1,0 +1,484 @@
+"""Serving flight recorder + XLA recompile watchdog (ISSUE 17): ring
+bounds and phase telescoping on a fake clock, watchdog compile detection
+through real jax.jit cache keys (the PR 12 flap class must fail LOUDLY:
+metric + serving.recompile span + log-once warning), the /debug/steps
+and /debug/profile HTTP surfaces over a stub engine, and a slow-tier
+deterministic soak through the real engine (phases sum to the step wall,
+the double bound holds, no alarmed hot-path jit recompiles on varied
+traffic).
+"""
+
+import http.client
+import json
+import logging
+import threading
+
+import pytest
+
+from k8s_runpod_kubelet_tpu.metrics import Metrics
+from k8s_runpod_kubelet_tpu.tracing import Tracer
+from k8s_runpod_kubelet_tpu.workloads.serving.recorder import (
+    PHASES, CompileWatchdog, FlightRecorder)
+
+
+class TickClock:
+    """Monotonic fake perf counter: every CALL advances 1ms, so phase
+    durations are exact multiples of 1e-3 and the telescoping-sum
+    assertions are deterministic. Thread-safe (event() is any-thread)."""
+
+    def __init__(self, step: float = 1e-3):
+        self.t = 0.0
+        self.step = step
+        self._lock = threading.Lock()
+
+    def __call__(self) -> float:
+        with self._lock:
+            self.t += self.step
+            return self.t
+
+
+def _step(rec, rids=None, tokens=2, **kw):
+    rec.step_begin()
+    rec.mark("schedule")
+    rec.mark("kernel")
+    rec.mark("sample")
+    rec.step_end(active=1, tokens=tokens, rids=rids, **kw)
+
+
+class TestFlightRecorderRing:
+    def test_phases_telescope_and_sum_to_wall(self):
+        rec = FlightRecorder(perf=TickClock())
+        _step(rec)
+        (r,) = rec.records()
+        # 4 clock reads after t0: schedule/kernel/sample marks + t_end,
+        # one tick each; commit is the t_end - last-mark remainder
+        assert r["wall_s"] == pytest.approx(4e-3)
+        for p in PHASES:
+            assert r["phases"][f"{p}_s"] == pytest.approx(1e-3)
+        assert sum(r["phases"].values()) == pytest.approx(r["wall_s"])
+
+    def test_unmarked_phases_fold_into_commit(self):
+        rec = FlightRecorder(perf=TickClock())
+        rec.step_begin()
+        rec.step_end(active=1)  # no marks at all: the whole step is commit
+        (r,) = rec.records()
+        assert r["phases"]["commit_s"] == pytest.approx(r["wall_s"])
+        assert r["phases"]["kernel_s"] == 0.0
+        assert sum(r["phases"].values()) == pytest.approx(r["wall_s"])
+
+    def test_mark_without_begin_is_inert(self):
+        rec = FlightRecorder(perf=TickClock())
+        rec.mark("kernel")
+        rec.step_end(active=1)
+        assert rec.records() == []
+
+    def test_double_bound_never_exceeds_budget(self):
+        rec = FlightRecorder(max_steps=8, max_bytes=1024, perf=TickClock())
+        for i in range(200):
+            rec.event("pad", blob="x" * (i % 97))
+            assert rec.ring_bytes <= rec.max_bytes
+            assert len(rec.records()) <= rec.max_steps
+        assert rec.dropped_records == 0
+        assert len(rec.records()) > 0
+
+    def test_oversized_single_record_dropped_not_wedged(self):
+        rec = FlightRecorder(max_bytes=1024, perf=TickClock())
+        rec.event("ok", n=1)
+        rec.event("huge", blob="y" * 4096)  # alone over budget: dropped
+        assert rec.dropped_records == 1
+        kinds = [r.get("event") for r in rec.records()]
+        assert kinds == ["ok"]
+        rec.event("after", n=2)  # the ring keeps working afterwards
+        assert [r.get("event") for r in rec.records()] == ["ok", "after"]
+
+    def test_non_serializable_attr_dropped_counted(self):
+        rec = FlightRecorder(perf=TickClock())
+        rec.event("bad", obj=object())
+        assert rec.dropped_records == 1
+        assert rec.records() == []
+        assert rec.rollup()["dropped"] == 1
+
+    def test_constructor_validation(self):
+        with pytest.raises(ValueError):
+            FlightRecorder(max_steps=0)
+        with pytest.raises(ValueError):
+            FlightRecorder(max_bytes=512)
+
+    def test_request_attribution_pop_once(self):
+        rec = FlightRecorder(perf=TickClock())
+        _step(rec, rids=["a", "b"])
+        _step(rec, rids=["a"])
+        acc = rec.pop_request("a")
+        assert acc["steps"] == 2
+        # step 1's wall split across two rids, step 2's charged whole
+        assert acc["step_wall_s"] == pytest.approx(4e-3 / 2 + 4e-3)
+        assert acc["kernel_s"] == pytest.approx(1e-3 / 2 + 1e-3)
+        assert rec.pop_request("a") is None  # pop forgets
+        assert rec.pop_request("b")["steps"] == 1
+
+    def test_request_table_bounded_fifo(self):
+        rec = FlightRecorder(perf=TickClock(), max_requests=2)
+        for rid in ("r0", "r1", "r2"):
+            _step(rec, rids=[rid])
+        assert rec.pop_request("r0") is None  # oldest dropped, not memory
+        assert rec.pop_request("r2") is not None
+
+    def test_step_histograms_and_ring_gauges(self):
+        m = Metrics()
+        rec = FlightRecorder(perf=TickClock(), metrics=m)
+        _step(rec, tokens=3)
+        assert m.get_observations("tpu_serving_step_wall_seconds") \
+            == [pytest.approx(4e-3)]
+        for p in PHASES:
+            assert m.get_observations(
+                f"tpu_serving_step_{p}_seconds") == [pytest.approx(1e-3)]
+        assert m.get_observations("tpu_serving_step_tokens") == [3.0]
+        # the first append lands on the every-16th gauge refresh
+        assert m.gauges[("tpu_serving_step_ring_records", ())] == 1
+        assert m.gauges[("tpu_serving_step_ring_bytes", ())] \
+            == rec.ring_bytes
+
+    def test_rollup_and_snapshot_shape(self):
+        rec = FlightRecorder(perf=TickClock())
+        for _ in range(5):
+            _step(rec, tokens=2)
+        rec.event("chunk_interleave", steps=1)
+        roll = rec.rollup()
+        assert roll["records"] == 6 and roll["steps"] == 5 \
+            and roll["events"] == 1
+        assert roll["wall_ms_p50"] == pytest.approx(4.0)
+        assert roll["kernel_ms_p50"] == pytest.approx(1.0)
+        assert roll["tokens_total"] == 10
+        snap = rec.snapshot(n=3)
+        assert snap["enabled"] is True
+        assert len(snap["steps"]) == 3
+        assert snap["rollup"]["steps"] == 5
+        json.dumps(snap)  # the /debug/steps payload must serialize
+
+
+class _FakeJit:
+    """Call-compatible stand-in exposing jax.jit's _cache_size seam: the
+    test decides when a call 'compiles' by bumping the size."""
+
+    def __init__(self):
+        self.size = 0
+        self.calls = 0
+        self.compile_next = True
+
+    def _cache_size(self):
+        return self.size
+
+    def __call__(self, *a, **kw):
+        self.calls += 1
+        if self.compile_next:
+            self.size += 1
+        return None
+
+
+class _Arr:
+    """Duck-typed array leaf for fingerprinting."""
+
+    def __init__(self, shape, dtype="f32"):
+        self.shape = shape
+        self.dtype = dtype
+
+
+class TestCompileWatchdog:
+    def test_first_compile_is_contract_not_finding(self):
+        m, tr = Metrics(), Tracer()
+        wd = CompileWatchdog(metrics=m, tracer=tr)
+        fake = _FakeJit()
+        f = wd.wrap("hot", fake, budget=2)
+        f(_Arr((2, 4)))
+        # zero-seeded at wrap, still zero after the expected first compile
+        assert m.get_counter("tpu_serving_recompiles",
+                             {"fn": "hot"}) == 0
+        assert [s for s in tr.recent()
+                if s["name"] == "serving.recompile"] == []
+        assert wd.snapshot()["hot"] == {"compiles": 1, "recompiles": 0,
+                                        "budget": 2, "warned": False}
+
+    def test_recompiles_metric_span_diff_and_log_once(self, caplog):
+        m, tr = Metrics(), Tracer()
+        wd = CompileWatchdog(metrics=m, tracer=tr)
+        fake = _FakeJit()
+        f = wd.wrap("hot", fake, budget=2)
+        with caplog.at_level(logging.WARNING,
+                             logger="k8s_runpod_kubelet_tpu.workloads"
+                                    ".serving.recorder"):
+            f(_Arr((2, 4)))            # compile 1: free
+            f(_Arr((3, 4)))            # compile 2: counted, within budget
+            f(_Arr((5, 4)))            # compile 3: past budget -> warn
+            f(_Arr((7, 4)))            # compile 4: warning NOT repeated
+            fake.compile_next = False
+            f(_Arr((7, 4)))            # cache hit: nothing
+        assert m.get_counter("tpu_serving_recompiles", {"fn": "hot"}) == 3
+        spans = [s for s in tr.recent() if s["name"] == "serving.recompile"]
+        assert [s["attrs"]["compiles"] for s in spans] == [2, 3, 4]
+        # the aval diff names the leaf that changed shape
+        assert any("(3, 4)" in line for line in spans[0]["attrs"]["aval_diff"])
+        warnings = [r for r in caplog.records if "hot" in r.getMessage()]
+        assert len(warnings) == 1
+        assert "budget" in warnings[0].getMessage()
+        assert wd.snapshot()["hot"]["warned"] is True
+        assert wd.total_recompiles() == 3
+
+    def test_bucketed_budget_none_tracks_without_alarm(self, caplog):
+        m, tr = Metrics(), Tracer()
+        wd = CompileWatchdog(metrics=m, tracer=tr)
+        f = wd.wrap("prefill", _FakeJit(), budget=None)
+        with caplog.at_level(logging.WARNING):
+            for i in range(6):  # one legitimate compile per length bucket
+                f(_Arr((1, 2 ** i)))
+        # full counts visible in the snapshot, but no metric (the counter
+        # covers alarmed fns only so recompiles>0 stays alertable), no
+        # warning, and recompile SPANS still record (the diff is useful)
+        assert wd.snapshot()["prefill"]["compiles"] == 6
+        assert m.get_counter("tpu_serving_recompiles",
+                             {"fn": "prefill"}) == 0
+        assert ("tpu_serving_recompiles",
+                (("fn", "prefill"),)) not in m.counters
+        assert not [r for r in caplog.records if "prefill" in r.getMessage()]
+
+    def test_attach_polls_shared_jits_step_granular(self):
+        m, tr = Metrics(), Tracer()
+        wd = CompileWatchdog(metrics=m, tracer=tr)
+        fake = _FakeJit()
+        wd.attach("sample_plain", fake, budget=2)
+        fake.size = 1   # module-level jit compiled somewhere else
+        wd.poll()
+        fake.size = 2   # ...and again (a flap the engine can't see)
+        wd.poll()
+        wd.poll()       # size stable: no new detection
+        assert wd.snapshot()["sample_plain"]["compiles"] == 2
+        assert m.get_counter("tpu_serving_recompiles",
+                             {"fn": "sample_plain"}) == 1
+
+    def test_wrap_none_passes_through(self):
+        wd = CompileWatchdog()
+        assert wd.wrap("missing", None) is None
+
+    def test_no_cache_size_degrades_to_no_detection(self):
+        wd = CompileWatchdog(metrics=Metrics())
+        calls = []
+        f = wd.wrap("plain", lambda x: calls.append(x), budget=2)
+        f(1)
+        f(2)
+        assert calls == [1, 2]  # calls pass through untracked
+        assert wd.snapshot()["plain"]["compiles"] == 0
+
+
+class TestJitFlapRegression:
+    """The PR 12 class against REAL jax.jit: a cache-key flap (here,
+    changing avals) past budget must be flagged loudly on all three
+    channels — metric, span, warning — and a stable key must stay
+    silent (the compile-exactly-once contract)."""
+
+    def test_real_jit_flap_flags_loudly(self, caplog):
+        import jax
+        import jax.numpy as jnp
+        m, tr = Metrics(), Tracer()
+        wd = CompileWatchdog(metrics=m, tracer=tr)
+        f = wd.wrap("hot_step", jax.jit(lambda x: x * 2), budget=2)
+        with caplog.at_level(logging.WARNING,
+                             logger="k8s_runpod_kubelet_tpu.workloads"
+                                    ".serving.recorder"):
+            for n in (1, 2, 3, 4):  # every call a fresh aval: 4 compiles
+                f(jnp.zeros((n,), jnp.float32))
+        assert m.get_counter("tpu_serving_recompiles",
+                             {"fn": "hot_step"}) == 3
+        spans = [s for s in tr.recent() if s["name"] == "serving.recompile"]
+        assert len(spans) == 3
+        assert spans[-1]["attrs"]["fn"] == "hot_step"
+        assert spans[-1]["attrs"]["aval_diff"]  # shape change named
+        assert len([r for r in caplog.records
+                    if "hot_step" in r.getMessage()]) == 1
+
+    def test_stable_key_compiles_exactly_once(self):
+        import jax
+        import jax.numpy as jnp
+        m = Metrics()
+        wd = CompileWatchdog(metrics=m)
+        f = wd.wrap("hot_step", jax.jit(lambda x: x + 1), budget=2)
+        x = jnp.zeros((4,), jnp.float32)
+        f(x)  # warmup: the one contractual compile
+        for i in range(20):  # varied values, identical avals
+            f(x + i)
+        assert wd.snapshot()["hot_step"]["compiles"] == 1
+        assert m.get_counter("tpu_serving_recompiles",
+                             {"fn": "hot_step"}) == 0
+
+
+class _StubEngine:
+    """The /debug surface needs only this much engine."""
+
+    def __init__(self, recorder=None):
+        self.alive = True
+        self.draining = False
+        self.metrics = Metrics()
+        self.tracer = Tracer()
+        self.recorder = recorder
+        self.watchdog = CompileWatchdog(metrics=self.metrics,
+                                        tracer=self.tracer)
+
+    def debug_steps(self, n: int = 64) -> dict:
+        out = ({"enabled": False} if self.recorder is None
+               else self.recorder.snapshot(n))
+        out["recompiles"] = self.watchdog.snapshot()
+        return out
+
+
+def _get(port, path, timeout=10):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    c.request("GET", path)
+    r = c.getresponse()
+    body = r.read()
+    c.close()
+    return r.status, body
+
+
+class TestDebugHTTP:
+    def _serve(self, engine, **kw):
+        from k8s_runpod_kubelet_tpu.workloads.serve_main import serve
+        httpd = serve(engine, 0, **kw)
+        return httpd, httpd.server_address[1]
+
+    def test_debug_steps_tail_rollup_and_bad_n(self):
+        rec = FlightRecorder(perf=TickClock())
+        for _ in range(7):
+            _step(rec)
+        eng = _StubEngine(recorder=rec)
+        httpd, port = self._serve(eng)
+        try:
+            status, body = _get(port, "/debug/steps?n=3")
+            assert status == 200
+            out = json.loads(body)
+            assert out["enabled"] is True
+            assert len(out["steps"]) == 3
+            assert out["rollup"]["steps"] == 7
+            assert "recompiles" in out
+            assert _get(port, "/debug/steps?n=bogus")[0] == 400
+        finally:
+            httpd.shutdown()
+
+    def test_debug_steps_disabled_recorder(self):
+        httpd, port = self._serve(_StubEngine(recorder=None))
+        try:
+            out = json.loads(_get(port, "/debug/steps")[1])
+            assert out["enabled"] is False and "recompiles" in out
+        finally:
+            httpd.shutdown()
+
+    def test_debug_profile_403_unless_opted_in(self):
+        httpd, port = self._serve(_StubEngine())
+        try:
+            status, body = _get(port, "/debug/profile")
+            assert status == 403
+            assert "profile capture disabled" in json.loads(body)["error"]
+        finally:
+            httpd.shutdown()
+
+    def test_debug_profile_capture_and_bounds(self, tmp_path):
+        httpd, port = self._serve(_StubEngine(), profile_capture=True)
+        # seam the capture wait so the test never sleeps for real
+        httpd.RequestHandlerClass.sleep = staticmethod(lambda s: None)
+        try:
+            assert _get(port, "/debug/profile?seconds=bogus")[0] == 400
+            assert _get(port, "/debug/profile?seconds=0")[0] == 400
+            assert _get(port, "/debug/profile?seconds=31")[0] == 400
+            # the sleep is seamed out but profiler start/stop itself runs
+            # for real and takes tens of seconds on some toolchains
+            status, body = _get(port, "/debug/profile?seconds=5",
+                                timeout=120)
+            assert status == 200
+            out = json.loads(body)
+            assert out["seconds"] == 5.0 and out["profile_dir"]
+        finally:
+            httpd.shutdown()
+
+
+# -- real-engine soak (ML tier: jax compiles dominate runtime) -----------------
+
+
+@pytest.fixture(scope="module")
+def soak_engine():
+    import jax
+    import jax.numpy as jnp
+    from k8s_runpod_kubelet_tpu.models import init_params, tiny_llama
+    from k8s_runpod_kubelet_tpu.workloads.serving import (ServingConfig,
+                                                          ServingEngine)
+    cfg = tiny_llama(vocab_size=128, embed_dim=64, n_layers=2, n_heads=4,
+                     n_kv_heads=2, mlp_dim=128, max_seq_len=256,
+                     dtype=jnp.float32, param_dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    sc = ServingConfig(slots=2, max_prefill_len=32, cache_len=128,
+                       max_new_tokens=8, flight_recorder=True,
+                       recorder_steps=64, recorder_bytes=65536)
+    e = ServingEngine(cfg, params, sc).start()
+    yield cfg, params, e
+    e.stop()
+
+
+@pytest.mark.slow
+class TestEngineSoak:
+    def test_soak_phases_bounds_attribution_no_alarmed_recompiles(
+            self, soak_engine):
+        _, _, e = soak_engine
+        # warmup covers every prefill-length bucket the soak will hit
+        e.submit([1, 2, 3], max_new_tokens=4).result(timeout=120)
+        warm = {name: t["compiles"]
+                for name, t in e.watchdog.snapshot().items()
+                if t["budget"] is not None}
+        futs = [e.submit([(7 * i + j) % 120 + 1 for j in range(3 + i % 5)],
+                         max_new_tokens=6) for i in range(8)]
+        for f in futs:
+            f.result(timeout=120)
+        rec = e.recorder
+        steps = [r for r in rec.records() if "wall_s" in r]
+        assert steps, "soak produced no step records"
+        for r in steps:
+            assert sum(r["phases"].values()) \
+                == pytest.approx(r["wall_s"], abs=1e-6)
+            assert set(r["phases"]) == {f"{p}_s" for p in PHASES}
+        assert rec.ring_bytes <= rec.max_bytes
+        assert len(rec.records()) <= rec.max_steps
+        assert rec.dropped_records == 0
+        # varied traffic over warmed buckets: ALARMED hot-path jits
+        # (budget set) compiled exactly once, in warmup
+        after = {name: t["compiles"]
+                 for name, t in e.watchdog.snapshot().items()
+                 if t["budget"] is not None}
+        assert after == warm, f"hot-path recompile during soak: {after}"
+        for name, t in e.watchdog.snapshot().items():
+            if t["budget"] is not None:
+                assert e.metrics.get_counter(
+                    "tpu_serving_recompiles", {"fn": name}) == 0, name
+        # per-request attribution folded into the serving.request spans
+        reqs = [s for s in e.tracer.recent()
+                if s["name"] == "serving.request"]
+        assert reqs
+        charged = [s for s in reqs if "decode_steps" in s["attrs"]]
+        assert charged, "no request span carries step attribution"
+        for s in charged:
+            assert s["attrs"]["decode_steps"] >= 1
+            assert s["attrs"]["step_wall_share_s"] > 0
+        payload = e.debug_steps(16)
+        assert payload["enabled"] is True
+        json.dumps(payload)
+
+    def test_disabled_recorder_is_none_and_debug_reports_it(
+            self, soak_engine):
+        cfg, params, _ = soak_engine
+        from k8s_runpod_kubelet_tpu.workloads.serving import (ServingConfig,
+                                                              ServingEngine)
+        sc = ServingConfig(slots=2, max_prefill_len=32, cache_len=128,
+                           max_new_tokens=8, flight_recorder=False)
+        e = ServingEngine(cfg, params, sc).start()
+        try:
+            e.submit([5, 6, 7], max_new_tokens=4).result(timeout=120)
+            assert e.recorder is None
+            out = e.debug_steps()
+            assert out["enabled"] is False
+            assert "recompiles" in out  # the watchdog is ALWAYS on
+        finally:
+            e.stop()

@@ -1,0 +1,379 @@
+"""Fleet SLO burn rates (ISSUE 17): multi-window breach-fraction math on
+a fake clock (busy gating, restart-safe error deltas, edge-triggered
+crossings), the autoscaler's burn-rate corroboration path, the router's
+GET /debug/slo surface end-to-end with a seeded TTFT burn driving a
+scale-up, and the slo_summary tool rendering the whole chain from one
+mixed JSONL.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import sys
+from pathlib import Path
+from types import SimpleNamespace
+
+import pytest
+
+from k8s_runpod_kubelet_tpu.fleet.autoscaler import (AutoscalerConfig,
+                                                     FleetAutoscaler,
+                                                     KubePodScaler)
+from k8s_runpod_kubelet_tpu.fleet.registry import ReplicaRegistry
+from k8s_runpod_kubelet_tpu.fleet.router import (FleetRouter, RouterConfig,
+                                                 serve_router)
+from k8s_runpod_kubelet_tpu.fleet.slo import SLOTracker
+from k8s_runpod_kubelet_tpu.kube import FakeKubeClient
+from k8s_runpod_kubelet_tpu.metrics import Metrics
+from k8s_runpod_kubelet_tpu.tracing import Tracer
+
+from harness import FakeClock
+
+sys.path.insert(0, str(Path(__file__).parent.parent / "tools"))
+import slo_summary  # noqa: E402
+
+
+def _stats(busy=True, ttft=0.0, itl=0.0, errors=0, requests=0):
+    return SimpleNamespace(queue_depth=2 if busy else 0,
+                           active_slots=1 if busy else 0,
+                           ttft_p95_s=ttft, itl_p95_s=itl,
+                           errors_total=errors, requests_total=requests)
+
+
+def _tracker(clock, metrics=None, tracer=None, **kw):
+    base = dict(ttft_slo_s=2.0, itl_slo_s=0.25, error_rate_slo=0.01,
+                short_window_s=60.0, long_window_s=600.0,
+                burn_threshold=2.0, budget_frac=0.05)
+    base.update(kw)
+    return SLOTracker(metrics=metrics, tracer=tracer, clock=clock, **base)
+
+
+class TestSLOTracker:
+    def test_idle_replica_high_ttft_never_burns(self):
+        clock = FakeClock()
+        slo = _tracker(clock)
+        # the latched-p95 class: traffic stopped, the histogram tail
+        # still reads 5s — idle beats must count as GOOD observations
+        for _ in range(70):
+            clock.advance(10.0)
+            slo.ingest("a", _stats(busy=False, ttft=5.0))
+        assert slo.burning("ttft") is False
+        assert slo.burn_rates("ttft") == (0.0, 0.0)
+        assert slo.snapshot()["signals"]["ttft"]["crossings"] == 0
+
+    def test_busy_breaches_burn_and_crossings_are_edge_triggered(self):
+        clock = FakeClock()
+        m, tr = Metrics(), Tracer()
+        slo = _tracker(clock, metrics=m, tracer=tr)
+        for _ in range(12):  # sustained breach: every beat bad
+            clock.advance(10.0)
+            slo.ingest("a", _stats(busy=True, ttft=5.0))
+        assert slo.burning("ttft") is True
+        short, long_ = slo.burn_rates("ttft")
+        assert short >= 2.0 and long_ >= 2.0
+        # one excursion = one crossing, however many beats inside it
+        assert m.get_counter("tpu_fleet_slo_crossings",
+                             {"signal": "ttft"}) == 1
+        burns = [s for s in tr.recent() if s["name"] == "fleet.slo_burn"]
+        assert len(burns) == 1
+        a = burns[0]["attrs"]
+        assert a["signal"] == "ttft" and a["replica_id"] == "a"
+        assert a["short_burn"] >= 2.0 and a["threshold"] == 2.0
+        # burn-rate gauges exported per signal+window
+        assert m.gauges[("tpu_fleet_slo_burn_rate",
+                         (("signal", "ttft"), ("window", "short")))] >= 2.0
+        # recovery: bad samples age out of the long window, good beats
+        # take over -> burning clears...
+        clock.advance(700.0)
+        for _ in range(12):
+            clock.advance(10.0)
+            slo.ingest("a", _stats(busy=True, ttft=0.1))
+        assert slo.burning("ttft") is False
+        # ...and a SECOND excursion is a second crossing
+        for _ in range(30):
+            clock.advance(10.0)
+            slo.ingest("a", _stats(busy=True, ttft=5.0))
+        assert slo.burning("ttft") is True
+        assert m.get_counter("tpu_fleet_slo_crossings",
+                             {"signal": "ttft"}) == 2
+        assert len([s for s in tr.recent()
+                    if s["name"] == "fleet.slo_burn"]) == 2
+
+    def test_short_spike_without_long_evidence_stays_quiet(self):
+        clock = FakeClock()
+        slo = _tracker(clock)
+        # 570s of good busy beats fill the long window...
+        for _ in range(57):
+            clock.advance(10.0)
+            slo.ingest("a", _stats(busy=True, ttft=0.1))
+        # ...then a 6-beat spike inside the short window
+        for _ in range(6):
+            clock.advance(1.0)
+            slo.ingest("a", _stats(busy=True, ttft=5.0))
+        short, long_ = slo.burn_rates("ttft")
+        assert short >= 2.0          # fast window sees the spike
+        assert long_ < 2.0           # no sustained evidence yet
+        assert slo.burning("ttft") is False
+
+    def test_error_rate_deltas_restart_baseline_and_forget(self):
+        clock = FakeClock()
+        slo = _tracker(clock)
+
+        def frac():
+            # breach fraction back out of the burn (snapshot rounds the
+            # burn to 4 decimals, hence the loose approx at call sites)
+            sig = slo.snapshot()["signals"]["error_rate"]
+            return sig["short_burn"] * slo.budget_frac
+
+        clock.advance(1.0)
+        slo.ingest("a", _stats(errors=0, requests=100))   # baseline beat
+        assert frac() == 0.0
+        clock.advance(1.0)
+        slo.ingest("a", _stats(errors=10, requests=200))  # 10/100 = 10%
+        assert frac() == pytest.approx(0.5, abs=1e-3)               # 1 bad / 2 beats
+        clock.advance(1.0)
+        # counters went BACKWARDS (replica restart): new baseline, not a
+        # negative delta and not a breach
+        slo.ingest("a", _stats(errors=1, requests=10))
+        assert frac() == pytest.approx(1 / 3, abs=1e-3)
+        clock.advance(1.0)
+        slo.ingest("a", _stats(errors=1, requests=110))   # 0/100: good
+        assert frac() == pytest.approx(1 / 4, abs=1e-3)
+        # forget() drops the baseline: the next beat re-baselines instead
+        # of computing a delta against the dead replica's counters
+        slo.forget("a")
+        clock.advance(1.0)
+        slo.ingest("a", _stats(errors=999, requests=1000))
+        assert frac() == pytest.approx(1 / 5, abs=1e-3)
+
+    def test_crossings_zero_seeded_at_construction(self):
+        m = Metrics()
+        _tracker(FakeClock(), metrics=m)
+        for sig in ("ttft", "itl", "error_rate"):
+            key = ("tpu_fleet_slo_crossings", (("signal", sig),))
+            assert key in m.counters and m.counters[key] == 0
+
+    def test_snapshot_shape_and_history_ring(self):
+        clock = FakeClock()
+        slo = _tracker(clock)
+        for _ in range(5):
+            clock.advance(10.0)
+            slo.ingest("a", _stats(busy=True, itl=1.0))
+        snap = slo.snapshot()
+        assert snap["enabled"] is True
+        assert snap["windows"] == {"short_s": 60.0, "long_s": 600.0}
+        assert set(snap["signals"]) == {"ttft", "itl", "error_rate"}
+        itl = snap["signals"]["itl"]
+        assert itl["burning"] is True and itl["samples_long"] == 5
+        assert len(snap["history"]) == 5
+        for entry in snap["history"]:
+            assert set(entry) == {"t", "burn"}
+            assert set(entry["burn"]) == {"ttft", "itl", "error_rate"}
+        json.dumps(snap)  # the /debug/slo payload must serialize
+
+
+CFG = AutoscalerConfig(min_replicas=1, max_replicas=3,
+                       target_queue_per_replica=4.0, ttft_slo_s=2.0,
+                       scale_up_stable_s=5.0, scale_down_stable_s=10.0,
+                       scale_up_cooldown_s=8.0, scale_down_cooldown_s=8.0,
+                       scale_down_utilization=0.25, drain_timeout_s=30.0,
+                       boot_timeout_s=60.0)
+
+
+class Fleet:
+    """Registry + SLO tracker + autoscaler + router on one FakeClock —
+    the burn chain end-to-end: heartbeats feed the tracker through the
+    registry, the autoscaler corroborates via burning(), /debug/slo
+    serves the snapshot."""
+
+    def __init__(self, cfg=CFG):
+        self.clock = FakeClock()
+        self.metrics = Metrics()
+        self.tracer = Tracer()
+        self.slo = SLOTracker(ttft_slo_s=cfg.ttft_slo_s,
+                              short_window_s=30.0, long_window_s=120.0,
+                              metrics=self.metrics, tracer=self.tracer,
+                              clock=self.clock)
+        self.registry = ReplicaRegistry(metrics=self.metrics,
+                                        tracer=self.tracer, clock=self.clock,
+                                        heartbeat_timeout_s=1e9,
+                                        slo=self.slo)
+        self.kube = FakeKubeClient()
+        self.scaler = KubePodScaler(self.kube, "virtual-tpu", chips=8)
+        self.autoscaler = FleetAutoscaler(
+            self.registry, self.scaler, cfg, metrics=self.metrics,
+            tracer=self.tracer, clock=self.clock, slo=self.slo,
+            drain_fn=lambda rep: None)
+        self.router = FleetRouter(self.registry, RouterConfig(),
+                                  metrics=self.metrics, tracer=self.tracer,
+                                  clock=self.clock, slo=self.slo)
+
+    def beat(self, rid, **stats):
+        base = {"free_slots": 0, "active_slots": 4, "max_slots": 4,
+                "queue_depth": 1}
+        base.update(stats)
+        self.registry.heartbeat(rid, base)
+
+    def pods(self):
+        return sorted(p["metadata"]["name"] for p in self.kube.list_pods())
+
+
+class TestAutoscalerBurnCorroboration:
+    def test_seeded_ttft_burn_triggers_scale_up_with_burn_reason(self):
+        f = Fleet()
+        f.registry.register("a", "http://127.0.0.1:1/a")
+        # 12s of sustained breach: past scale_up_stable_s (one scale-up)
+        # but short of the 8s post-scale cooldown firing a second
+        for _ in range(6):
+            f.clock.advance(2.0)
+            f.beat("a", ttft_p95_s=5.0)
+            f.autoscaler.tick()
+        assert f.slo.burning("ttft") is True
+        assert f.pods() == ["tpu-serving-1"]
+        spans = [s for s in f.tracer.recent() if s["name"] == "fleet.scale"]
+        assert len(spans) == 1
+        reason = spans[0]["attrs"]["reason"]
+        assert "ttft SLO burn" in reason and "threshold" in reason
+        assert "ttft_p95" not in reason  # the legacy point-sample string
+        # the crossing preceded the scale-up in the same trace export
+        burns = [s for s in f.tracer.recent()
+                 if s["name"] == "fleet.slo_burn"]
+        assert burns and burns[0]["start"] <= spans[0]["start"]
+
+    def test_single_slow_beat_does_not_scale(self):
+        """The point-sample path scaled on one latched p95 + busy; the
+        burn path demands sustained evidence on the long window too."""
+        f = Fleet()
+        f.registry.register("a", "http://127.0.0.1:1/a")
+        # plenty of good traffic first, then ONE bad beat
+        for _ in range(20):
+            f.clock.advance(2.0)
+            f.beat("a", ttft_p95_s=0.1)
+            f.autoscaler.tick()
+        f.clock.advance(2.0)
+        f.beat("a", ttft_p95_s=5.0)
+        for _ in range(6):
+            f.clock.advance(1.0)
+            f.beat("a", ttft_p95_s=0.1)
+            f.autoscaler.tick()
+        assert f.pods() == []
+
+    def test_idle_breach_never_scales_through_burn_path(self):
+        f = Fleet()
+        f.registry.register("a", "http://127.0.0.1:1/a")
+        for _ in range(20):
+            f.clock.advance(2.0)
+            f.beat("a", ttft_p95_s=5.0, queue_depth=0, active_slots=0,
+                   free_slots=4)
+            f.autoscaler.tick()
+        assert f.pods() == []
+
+
+class TestDebugSloEndpointAndSummaryTool:
+    def _get(self, port, path):
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        c.request("GET", path)
+        r = c.getresponse()
+        body = r.read()
+        c.close()
+        return r.status, json.loads(body)
+
+    def test_fleet_soak_debug_slo_and_summary_render(self, tmp_path,
+                                                     capsys):
+        f = Fleet()
+        httpd = serve_router(f.router, port=0)
+        port = httpd.server_address[1]
+        try:
+            f.registry.register("a", "http://127.0.0.1:1/a")
+            # 10s of seeded breach: one scale-up (cooldown holds #2)
+            for _ in range(10):
+                f.clock.advance(1.0)
+                f.beat("a", ttft_p95_s=5.0)
+                f.autoscaler.tick()
+            status, snap = self._get(port, "/debug/slo")
+            assert status == 200
+            assert snap["enabled"] is True
+            assert snap["signals"]["ttft"]["burning"] is True
+            assert snap["signals"]["ttft"]["crossings"] == 1
+            assert snap["history"]
+            assert f.pods() == ["tpu-serving-1"]
+        finally:
+            httpd.shutdown()
+        # the soak's own telemetry renders in the summary tool: snapshot
+        # + span export in one mixed JSONL
+        path = tmp_path / "slo.jsonl"
+        with path.open("w") as fh:
+            fh.write(json.dumps(snap) + "\n")
+            for s in f.tracer.recent():
+                fh.write(json.dumps(s) + "\n")
+        assert slo_summary.main([str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "BURNING" in out
+        assert "burn-rate timeline" in out
+        assert "BURN ttft" in out
+        assert "scale" in out and "ttft SLO burn" in out
+
+    def test_debug_slo_disabled_when_no_tracker(self):
+        reg = ReplicaRegistry(metrics=Metrics(), tracer=Tracer(),
+                              clock=FakeClock(), heartbeat_timeout_s=1e9)
+        rt = FleetRouter(reg, RouterConfig(), metrics=Metrics(),
+                         tracer=Tracer())
+        httpd = serve_router(rt, port=0)
+        try:
+            status, out = self._get(httpd.server_address[1], "/debug/slo")
+            assert status == 200 and out == {"enabled": False}
+        finally:
+            httpd.shutdown()
+
+    def test_summary_renders_step_waterfall_and_recompile_table(
+            self, tmp_path, capsys):
+        # a /debug/steps dump + a serving.recompile span, no SLO data:
+        # the tool's serving-side half stands alone
+        steps = []
+        for i in range(4):
+            wall = 0.002 + 0.001 * i
+            steps.append({"seq": i, "t": 100.0 + i, "wall_s": wall,
+                          "phases": {"schedule_s": 0.0002,
+                                     "kernel_s": wall - 0.0008,
+                                     "sample_s": 0.0004,
+                                     "commit_s": 0.0002},
+                          "batch": {"mode": "decode", "active": 2,
+                                    "draining": False, "paged": True,
+                                    "spec_k": 0, "adapters": 0,
+                                    "interleaved": False},
+                          "tokens": 2})
+        dump = {"enabled": True, "steps": steps,
+                "rollup": {"records": 4, "steps": 4, "events": 0,
+                           "bytes": 900, "max_bytes": 262144, "dropped": 0,
+                           "wall_ms_p50": 3.0, "schedule_ms_p50": 0.2,
+                           "kernel_ms_p50": 2.2, "sample_ms_p50": 0.4,
+                           "commit_ms_p50": 0.2, "active_p50": 2,
+                           "tokens_total": 8, "spec_steps": 0},
+                "recompiles": {
+                    "decode": {"compiles": 4, "recompiles": 3,
+                               "budget": 2, "warned": True},
+                    "prefill": {"compiles": 3, "recompiles": 2,
+                                "budget": None, "warned": False}}}
+        span = {"name": "serving.recompile", "trace_id": "t" * 32,
+                "span_id": "s" * 16, "parent_id": "", "start": 101.0,
+                "end": 101.0,
+                "attrs": {"fn": "decode", "compiles": 4,
+                          "aval_diff": ["+a0:float32(3, 4)",
+                                        "-a0:float32(2, 4)"]}}
+        path = tmp_path / "steps.jsonl"
+        with path.open("w") as fh:
+            fh.write(json.dumps(dump) + "\n")
+            fh.write(json.dumps(span) + "\n")
+        assert slo_summary.main([str(path), "--steps", "3"]) == 0
+        out = capsys.readouterr().out
+        assert "step rollup" in out and "step waterfall" in out
+        assert out.count("ms |") == 3          # --steps bounds the rows
+        assert "hot-path compiles" in out
+        assert "decode" in out and "YES" in out        # warned column
+        assert "recompile spans" in out
+        assert "+a0:float32(3, 4)" in out
+
+    def test_summary_empty_file_is_an_error(self, tmp_path, capsys):
+        path = tmp_path / "empty.jsonl"
+        path.write_text("not json\n")
+        assert slo_summary.main([str(path)]) == 1

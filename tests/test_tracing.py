@@ -449,3 +449,54 @@ class TestPodLifecycleSpans:
             assert ei.value.code == 404
         finally:
             hs.stop()
+
+
+class TestDroppedExportsLockDiscipline:
+    """AST pin for the export-drop counter (ISSUE 17): ``dropped_exports``
+    is shared by the caller threads (queue-full path) and the writer
+    thread (OSError path), and ``+=`` on an instance attribute is not
+    atomic — every write in tracing.py must sit inside a ``with
+    self._lock`` block, or drops silently undercount under contention."""
+
+    def test_every_dropped_exports_write_is_locked(self):
+        import ast
+        import inspect
+        import k8s_runpod_kubelet_tpu.tracing as tracing
+        tree = ast.parse(inspect.getsource(tracing))
+        parents = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+
+        def locked(node):
+            while node in parents:
+                node = parents[node]
+                if isinstance(node, ast.With) and any(
+                        "self._lock" in ast.unparse(item.context_expr)
+                        for item in node.items):
+                    return True
+            return False
+
+        writes = []
+        for node in ast.walk(tree):
+            targets = []
+            if isinstance(node, ast.AugAssign):
+                targets = [node.target]
+            elif isinstance(node, ast.Assign):
+                targets = node.targets
+            for t in targets:
+                if isinstance(t, ast.Attribute) \
+                        and t.attr == "dropped_exports":
+                    writes.append(node)
+        # the __init__ zero-init plus both drop paths, at minimum
+        assert len(writes) >= 3
+        unlocked = [w.lineno for w in writes
+                    if not locked(w)
+                    # the __init__ = 0 runs before any thread exists
+                    and not (isinstance(w, ast.Assign)
+                             and isinstance(w.value, ast.Constant)
+                             and w.value.value == 0)]
+        assert unlocked == [], (
+            f"unlocked dropped_exports write(s) at tracing.py:{unlocked} — "
+            f"both the queue-full and writer-OSError paths must take "
+            f"self._lock")

@@ -1,0 +1,297 @@
+"""Render SLO burn-rate timelines and per-step phase waterfalls (ISSUE 17).
+
+Input lines may be any mix of:
+- **spans** from a ``--trace-export`` JSONL (``fleet.slo_burn`` crossings,
+  ``fleet.scale`` events for corroboration, ``serving.recompile`` from the
+  engine's compile watchdog; other names are ignored),
+- **SLO snapshots** — the router's ``GET /debug/slo`` payload (an object
+  with a ``"signals"`` dict and a bounded ``"history"`` ring), e.g.
+  appended periodically by ``curl router:8090/debug/slo >> slo.jsonl``,
+- **step dumps** — the serving server's ``GET /debug/steps`` payload (an
+  object with a ``"steps"`` record list, a ``"rollup"``, and the
+  watchdog's ``"recompiles"`` table).
+
+Output:
+- the latest per-signal SLO status (objective, burning flag, short/long
+  burn multiples, crossing count, window sample depths);
+- a burn-rate timeline per signal rendered from the snapshot history —
+  one character column per time bucket, height-coded by the short-window
+  burn relative to the threshold (``#`` = at/over threshold);
+- the crossing/scale timeline: every ``fleet.slo_burn`` onset interleaved
+  with the autoscaler's ``fleet.scale`` events, so burn -> scale-up
+  causality reads off one list;
+- the per-step phase waterfall: the rollup's phase medians, then the last
+  N step records as schedule/kernel/sample/commit bars (see "Reading a
+  step waterfall" in the README);
+- the recompile table: per-fn compile counts vs budget from the step
+  dumps, plus each ``serving.recompile`` span's aval diff.
+
+Usage:
+  python tools/slo_summary.py slo.jsonl
+  python tools/slo_summary.py spans.jsonl --steps 12 --width 72
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+
+PHASES = ("schedule", "kernel", "sample", "commit")
+# burn magnitude -> glyph, in fractions of the threshold; '#' means the
+# short window alone is at/over the scale-up bar
+_BURN_GLYPHS = ((1.0, "#"), (0.75, "="), (0.5, "-"), (0.25, "."), (0.0, " "))
+
+
+def load(path: str) -> tuple[list[dict], list[dict], list[dict]]:
+    """(spans, slo snapshots, step dumps) from a mixed JSONL file."""
+    spans, slo_snaps, step_dumps = [], [], []
+    with open(path, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                print(f"warning: {path}:{lineno}: bad JSON, skipped",
+                      file=sys.stderr)
+                continue
+            if not isinstance(obj, dict):
+                continue
+            if "name" in obj and "trace_id" in obj:
+                spans.append(obj)
+            elif isinstance(obj.get("signals"), dict):
+                slo_snaps.append(obj)
+            elif isinstance(obj.get("steps"), list) \
+                    or isinstance(obj.get("recompiles"), dict):
+                step_dumps.append(obj)
+    return spans, slo_snaps, step_dumps
+
+
+def _burn_glyph(burn: float, threshold: float) -> str:
+    frac = burn / threshold if threshold > 0 else 0.0
+    for floor, glyph in _BURN_GLYPHS:
+        if frac >= floor and (floor > 0 or frac > 0):
+            return glyph
+    return " "
+
+
+def status_table(slo_snaps: list[dict]) -> list[str]:
+    if not slo_snaps:
+        return []
+    snap = slo_snaps[-1]  # later lines win: the file is appended in order
+    w = snap.get("windows", {})
+    out = [f"== SLO status (latest /debug/slo; threshold "
+           f"{snap.get('burn_threshold', '?')}x of budget_frac="
+           f"{snap.get('budget_frac', '?')}, windows "
+           f"{w.get('short_s', '?')}s/{w.get('long_s', '?')}s) ==",
+           f"{'signal':<12} {'objective':>10} {'burning':>8} "
+           f"{'short':>8} {'long':>8} {'cross':>6} {'n_short':>8} "
+           f"{'n_long':>7}"]
+    for sig in sorted(snap["signals"]):
+        s = snap["signals"][sig]
+        out.append(f"{sig:<12} {s.get('objective', 0.0):>10.3f} "
+                   f"{'BURNING' if s.get('burning') else 'ok':>8} "
+                   f"{s.get('short_burn', 0.0):>7.2f}x "
+                   f"{s.get('long_burn', 0.0):>7.2f}x "
+                   f"{s.get('crossings', 0):>6} "
+                   f"{s.get('samples_short', 0):>8} "
+                   f"{s.get('samples_long', 0):>7}")
+    return out
+
+
+def burn_timeline(slo_snaps: list[dict], width: int) -> list[str]:
+    """One char column per time bucket, short-window burn vs threshold.
+    History entries from EVERY snapshot line merge (deduped on t), so a
+    file of periodic /debug/slo appends renders one continuous timeline
+    even though each snapshot only carries the bounded ring."""
+    if not slo_snaps:
+        return []
+    threshold = float(slo_snaps[-1].get("burn_threshold", 2.0) or 2.0)
+    merged: dict[float, dict] = {}
+    for snap in slo_snaps:
+        for entry in snap.get("history", []):
+            t = entry.get("t")
+            if t is not None and isinstance(entry.get("burn"), dict):
+                merged[float(t)] = entry["burn"]
+    if len(merged) < 2:
+        return []
+    times = sorted(merged)
+    t0, t1 = times[0], times[-1]
+    span = max(t1 - t0, 1e-9)
+    # bucket by time, keep the max burn per bucket (a burst must not
+    # average away just because the file over-samples quiet periods)
+    buckets: dict[str, list[float]] = {}
+    signals = sorted({sig for b in merged.values() for sig in b})
+    for sig in signals:
+        cols = [0.0] * width
+        for t in times:
+            burn = float(merged[t].get(sig, 0.0) or 0.0)
+            i = min(width - 1, int((t - t0) / span * width))
+            cols[i] = max(cols[i], burn)
+        buckets[sig] = cols
+    out = ["", f"== burn-rate timeline (short window, {len(merged)} "
+               f"ingests over {span:.0f}s; '#' >= {threshold:.1f}x "
+               f"threshold) =="]
+    for sig in signals:
+        line = "".join(_burn_glyph(b, threshold) for b in buckets[sig])
+        peak = max(buckets[sig])
+        out.append(f"{sig:<12} |{line}| peak {peak:.2f}x")
+    out.append(f"{'':<12}  t={t0:.0f}{'':>{max(0, width - 18)}}t={t1:.0f}")
+    return out
+
+
+def crossing_timeline(spans: list[dict], top: int) -> list[str]:
+    """fleet.slo_burn onsets interleaved with fleet.scale events: the
+    burn -> scale-up causality chain, one line per event."""
+    events = [s for s in spans
+              if s.get("name") in ("fleet.slo_burn", "fleet.scale")]
+    if not events:
+        return []
+    events.sort(key=lambda s: s.get("start", 0.0))
+    out = ["", f"== SLO crossings + scale events (last {top}) =="]
+    for s in events[-top:]:
+        a = s.get("attrs", {})
+        if s["name"] == "fleet.slo_burn":
+            out.append(f"  t={s.get('start', 0.0):.1f} BURN "
+                       f"{a.get('signal')} short={a.get('short_burn')}x "
+                       f"long={a.get('long_burn')}x "
+                       f"(threshold {a.get('threshold')}x, objective "
+                       f"{a.get('objective')})")
+        else:
+            role = a.get("role")
+            tag = f"[{role}]" if role and role != "unified" else ""
+            out.append(f"  t={s.get('start', 0.0):.1f} scale{tag} "
+                       f"{a.get('direction')} {a.get('from')} -> "
+                       f"{a.get('to')} — {a.get('reason', '')}")
+    return out
+
+
+def _bar(frac: float, width: int) -> str:
+    return "#" * max(0, round(frac * width))
+
+
+def step_waterfall(step_dumps: list[dict], n: int,
+                   width: int) -> list[str]:
+    """Phase medians from the rollup, then the last n step records as
+    stacked phase bars scaled to the slowest shown step."""
+    if not step_dumps:
+        return []
+    dump = step_dumps[-1]  # later lines win
+    out: list[str] = []
+    roll = dump.get("rollup")
+    if isinstance(roll, dict) and roll.get("steps"):
+        med = "  ".join(f"{p} {roll.get(f'{p}_ms_p50', 0.0):.2f}ms"
+                        for p in PHASES)
+        out += ["", f"== step rollup ({roll['steps']} steps, "
+                    f"{roll.get('tokens_total', 0)} tokens, "
+                    f"{roll.get('spec_steps', 0)} spec-verify, "
+                    f"ring {roll.get('bytes', 0)}/"
+                    f"{roll.get('max_bytes', 0)}B, "
+                    f"dropped {roll.get('dropped', 0)}) ==",
+                f"  wall p50 {roll.get('wall_ms_p50', 0.0):.2f}ms — {med}"]
+    steps = [r for r in dump.get("steps", []) if "wall_s" in r]
+    if not steps:
+        return out
+    steps = steps[-n:]
+    max_wall = max(r["wall_s"] for r in steps) or 1e-9
+    bar_w = max(16, width - 34)
+    out += ["", f"== step waterfall (last {len(steps)} steps; bars "
+                f"scaled to {max_wall * 1e3:.2f}ms; "
+                f"s=schedule k=kernel a=sample c=commit) =="]
+    for r in steps:
+        ph = r.get("phases", {})
+        wall = r["wall_s"]
+        cells = []
+        for p, ch in zip(PHASES, "skac"):
+            frac = ph.get(f"{p}_s", 0.0) / max_wall
+            cells.append(ch * max(1 if ph.get(f"{p}_s", 0.0) > 0 else 0,
+                                  round(frac * bar_w)))
+        b = r.get("batch", {})
+        tag = b.get("mode", "?")
+        if b.get("spec_k"):
+            tag += f" k={b['spec_k']}"
+        if b.get("interleaved"):
+            tag += " interleave"
+        out.append(f"  seq={r.get('seq', '?'):<5} "
+                   f"{wall * 1e3:>7.2f}ms |{''.join(cells):<{bar_w}}| "
+                   f"n={b.get('active', 0)} {tag}")
+    return out
+
+
+def recompile_table(spans: list[dict],
+                    step_dumps: list[dict]) -> list[str]:
+    """Per-fn compile counts (watchdog snapshot riding /debug/steps) and
+    each serving.recompile span's aval diff — the flap's smoking gun."""
+    table = {}
+    for dump in step_dumps:  # later lines win per fn
+        rec = dump.get("recompiles")
+        if isinstance(rec, dict):
+            table.update(rec)
+    recompiles = [s for s in spans if s.get("name") == "serving.recompile"]
+    out: list[str] = []
+    if table:
+        out += ["", "== hot-path compiles (watchdog) ==",
+                f"{'fn':<24} {'compiles':>9} {'recompiles':>11} "
+                f"{'budget':>7} {'warned':>7}"]
+        for fn in sorted(table):
+            t = table[fn] or {}
+            budget = t.get("budget")
+            out.append(f"{fn:<24} {t.get('compiles', 0):>9} "
+                       f"{t.get('recompiles', 0):>11} "
+                       f"{'-' if budget is None else budget:>7} "
+                       f"{'YES' if t.get('warned') else '-':>7}")
+    if recompiles:
+        by_fn: dict[str, list[dict]] = defaultdict(list)
+        for s in recompiles:
+            by_fn[str((s.get("attrs") or {}).get("fn") or "?")].append(s)
+        out += ["", "== recompile spans (serving.recompile) =="]
+        for fn in sorted(by_fn):
+            last = by_fn[fn][-1].get("attrs", {})
+            diff = last.get("aval_diff") or []
+            if isinstance(diff, str):
+                diff = [diff]
+            out.append(f"  {fn}: {len(by_fn[fn])} recompile(s); last diff:")
+            for line in diff[:8]:
+                out.append(f"    {line}")
+    return out
+
+
+def render(spans: list[dict], slo_snaps: list[dict],
+           step_dumps: list[dict], steps: int = 12, top: int = 20,
+           width: int = 64) -> str:
+    lines = status_table(slo_snaps)
+    lines += burn_timeline(slo_snaps, width)
+    lines += crossing_timeline(spans, top)
+    lines += step_waterfall(step_dumps, steps, width)
+    lines += recompile_table(spans, step_dumps)
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="SLO burn-rate timelines + per-step phase waterfalls "
+                    "from mixed JSONL (span export, /debug/slo and "
+                    "/debug/steps appends)")
+    p.add_argument("path", help="JSONL file")
+    p.add_argument("--steps", type=int, default=12,
+                   help="step-waterfall rows")
+    p.add_argument("--top", type=int, default=20,
+                   help="crossing/scale timeline length")
+    p.add_argument("--width", type=int, default=64,
+                   help="timeline/bar column width")
+    args = p.parse_args(argv)
+    spans, slo_snaps, step_dumps = load(args.path)
+    if not spans and not slo_snaps and not step_dumps:
+        print(f"{args.path}: no SLO snapshots, step dumps, or spans found",
+              file=sys.stderr)
+        return 1
+    print(render(spans, slo_snaps, step_dumps, args.steps, args.top,
+                 args.width))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
