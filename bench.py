@@ -88,14 +88,13 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, _HERE)
 
 # bf16 peak TFLOP/s per chip by generation — ONE table shared with the live
-# telemetry layer (workloads/telemetry.py), so the bench's offline MFU and a
-# running worker's tpu_training_mfu_ratio gauge use the same roofline.
-try:
-    from k8s_runpod_kubelet_tpu.workloads.telemetry import (
-        PEAK_TFLOPS_BF16 as _PEAK_TFLOPS)
-except Exception:  # noqa: BLE001 — bench must run even on a broken tree
-    _PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0,
-                    "cpu": 0.1}
+# telemetry layer and the fleet scheduler (k8s_runpod_kubelet_tpu/
+# generations.py, ISSUE 19), so the bench's offline MFU, a running worker's
+# tpu_training_mfu_ratio gauge and the scheduler's goodput-per-dollar math
+# all use the same roofline. No dict-literal fallback: test_generations.py
+# pins this module as the single source of truth.
+from k8s_runpod_kubelet_tpu.generations import (
+    GENERATIONS as _GENERATIONS, PEAK_TFLOPS_BF16 as _PEAK_TFLOPS)
 _TARGET_MFU = 0.40
 
 _TPU_ATTEMPTS = 3          # orchestrator: tries at the TPU backend
@@ -147,6 +146,10 @@ _STAGED_QUEUE = [
     # serving flight recorder (ISSUE 17): recorder overhead on identical
     # seeded traffic + the step-phase/recompile numbers it surfaces
     ("flight_recorder", ["--flight-recorder"], 2400),
+    # heterogeneous fleet scheduler (ISSUE 19): hetero goodput-per-dollar
+    # placement vs round-robin on identical seeded traffic over a fake
+    # cloud of mixed generations — pure control plane, no chip needed
+    ("scheduler", ["--scheduler"], 900),
     ("serve_8b", ["--serve", "--model", "llama3-8b", "--int8", "--kv-int8"],
      2400),
     # int4 weights via the Pallas unpack kernel (ops/int4_matmul.py):
@@ -2705,6 +2708,14 @@ def _flight_recorder_smoke_lines() -> list | None:
     return _cpu_smoke_lines("--flight-recorder", timeout_s=900)
 
 
+def _scheduler_smoke_lines() -> list | None:
+    """The ISSUE 19 fleet-scheduler cell (see _cpu_smoke_lines): hetero
+    vs round-robin goodput-per-dollar over the deterministic fake cloud.
+    Pure control plane — it never dials the chip, so the placement win
+    is re-measured per commit on every unreachable round."""
+    return _cpu_smoke_lines("--scheduler")
+
+
 def _paged_tp_smoke_lines() -> list | None:
     """The ISSUE 12 TP paged-decode cell on CPU (see _cpu_smoke_lines):
     paged-vs-contiguous mesh decode step time at tp=2 over virtual
@@ -2760,6 +2771,7 @@ def orchestrate(quick: bool) -> int:
     kv_fabric_smoke = None if quick else _kv_fabric_smoke_lines()
     fr_smoke = None if quick else _flight_recorder_smoke_lines()
     paged_tp_smoke = None if quick else _paged_tp_smoke_lines()
+    scheduler_smoke = None if quick else _scheduler_smoke_lines()
     session = _session_tpu_headline()
     if session is not None:
         session["tpu_errors"] = errors[-2:]
@@ -2778,6 +2790,8 @@ def orchestrate(quick: bool) -> int:
             session["flight_recorder_cpu_smoke"] = fr_smoke
         if paged_tp_smoke is not None:
             session["paged_tp_cpu_smoke"] = paged_tp_smoke
+        if scheduler_smoke is not None:
+            session["scheduler_cpu_smoke"] = scheduler_smoke
         if not quick:
             _write_unreachable_round(session)
         _emit(session)
@@ -2810,6 +2824,8 @@ def orchestrate(quick: bool) -> int:
             line["flight_recorder_cpu_smoke"] = fr_smoke
         if paged_tp_smoke is not None:
             line["paged_tp_cpu_smoke"] = paged_tp_smoke
+        if scheduler_smoke is not None:
+            line["scheduler_cpu_smoke"] = scheduler_smoke
         if not quick:
             _write_unreachable_round(line)
         _emit(line)
@@ -2836,6 +2852,116 @@ def orchestrate(quick: bool) -> int:
            "unit": "tok/s/chip", "vs_baseline": None,
            "error": "; ".join(errors)[:1500]})
     return 1
+
+
+def run_scheduler_bench(smoke: bool = False) -> int:
+    """Heterogeneous fleet-scheduler cell (ISSUE 19): goodput-per-dollar
+    (hetero) placement vs round-robin over a deterministic fake cloud of
+    mixed TPU generations, on IDENTICAL seeded traffic. Pure control
+    plane — no jax import, no chip: the placement matrix is seeded from
+    the generations.py rooflines and refined online from the same
+    scripted heartbeats both policies see, so the hetero-vs-RR ratio is
+    re-measured per commit even while the tunnel is wedged.
+
+    Shared trace: a serving fleet ramps decode 2->8 and prefill 1->3
+    replicas (8 chips each); three best-effort 16-chip training gangs
+    pack onto idle capacity at t=H/4; a guaranteed 32-chip gang arrives
+    at t=H/2 into a near-full fleet and must preempt (lowest
+    unsaved-work loss first). Goodput integrates FleetScheduler.rates() — the
+    scheduler's own objective — and serving tokens/$ integrates the
+    scripted token streams, so the headline is measured twice."""
+    import types as _types
+
+    from k8s_runpod_kubelet_tpu.fleet.scheduler import (DECODE, HETERO,
+                                                        PREFILL,
+                                                        ROUND_ROBIN,
+                                                        TRAINING,
+                                                        FleetScheduler)
+
+    pools = "v5e:64,v5p:64,v6e:32"
+    horizon_s = 120 if smoke else 600
+    # scripted tokens/sec-per-chip the fake replicas report, keyed by
+    # (kind, generation): decode is bandwidth-bound (v5e punches above
+    # its price), prefill flops-bound (v6e/v5p). Tuple keys on purpose —
+    # per-generation NUMBER tables live in generations.py only
+    # (tests/test_generations.py scans for drifting copies).
+    tok_rate = {(DECODE, "v5e"): 48.0, (DECODE, "v5p"): 96.0,
+                (DECODE, "v6e"): 96.0,
+                (PREFILL, "v5e"): 30.0, (PREFILL, "v5p"): 70.0,
+                (PREFILL, "v6e"): 140.0}
+
+    def drive(policy: str) -> dict:
+        t = [0.0]
+        preempted: list[str] = []
+        sched = FleetScheduler(pools, clock=lambda: t[0], policy=policy,
+                               preempt_fn=lambda p: preempted.append(p.tag),
+                               default_serving_chips=8)
+        tokens: dict[str, float] = {}
+        be_placed_at: dict[str, float] = {}
+        gang = None
+        goodput = dollars = serve_tokens = serve_dollars = 0.0
+        for step in range(horizon_s):
+            t[0] = float(step)
+            # serving ramp (identical under both policies)
+            n_dec = min(8, 2 + (8 * step) // horizon_s)
+            n_pre = min(3, 1 + (3 * step) // horizon_s)
+            for i in range(n_dec):
+                sched.place(DECODE, 8, f"dec-{i}")
+            for i in range(n_pre):
+                sched.place(PREFILL, 8, f"pre-{i}")
+            if step == horizon_s // 4:       # best-effort packing
+                for i in range(3):
+                    if sched.place(TRAINING, 16, f"be-{i}",
+                                   best_effort=True) is not None:
+                        be_placed_at[f"be-{i}"] = t[0]
+            if step == horizon_s // 2:       # guaranteed gang arrives
+                gang = sched.place(TRAINING, 32, "gang-prod")
+            # heartbeats: cumulative token counters at the scripted rate
+            # of whatever generation the placement actually landed on
+            for p in sched.placements():
+                if p.kind not in (DECODE, PREFILL):
+                    if p.tag in be_placed_at:   # telemetry scrape
+                        sched.observe_training(
+                            p.tag, mfu=0.35, goodput=0.9,
+                            unsaved_work_s=t[0] - be_placed_at[p.tag])
+                    continue
+                rate = tok_rate[(p.kind, p.generation)] * p.chips
+                tokens[p.tag] = tokens.get(p.tag, 0.0) + rate
+                sched.observe_serving(
+                    p.tag, p.kind, p.generation,
+                    _types.SimpleNamespace(tokens_total=int(tokens[p.tag])))
+                serve_tokens += rate
+                serve_dollars += (p.chips / 3600.0
+                                  * sched.pools[p.pool].spec.cost_per_chip_hr)
+            g, c = sched.rates()
+            goodput += g             # effective-throughput-seconds
+            dollars += c / 3600.0    # $/hr integrated per 1s step
+        return {"goodput_per_dollar": round(goodput / max(dollars, 1e-9), 1),
+                "serve_tokens_per_dollar": round(
+                    serve_tokens / max(serve_dollars, 1e-9), 1),
+                "dollars": round(dollars, 2),
+                "preempted": preempted,
+                "gang_pool": gang.pool if gang is not None else None,
+                "placements": len(sched.placements())}
+
+    results = {policy: drive(policy) for policy in (HETERO, ROUND_ROBIN)}
+    for policy in (HETERO, ROUND_ROBIN):
+        r = results[policy]
+        _emit({"metric": "scheduler_goodput_per_dollar", "policy": policy,
+               "value": r["goodput_per_dollar"], "unit": "eff/$",
+               "serve_tokens_per_dollar": r["serve_tokens_per_dollar"],
+               "dollars": r["dollars"], "preempted": r["preempted"],
+               "gang_pool": r["gang_pool"],
+               "pools": pools, "horizon_s": horizon_s, "backend": "none"})
+    ratio = (results[HETERO]["goodput_per_dollar"]
+             / max(results[ROUND_ROBIN]["goodput_per_dollar"], 1e-9))
+    token_ratio = (results[HETERO]["serve_tokens_per_dollar"]
+                   / max(results[ROUND_ROBIN]["serve_tokens_per_dollar"],
+                         1e-9))
+    _emit({"metric": "scheduler_hetero_vs_rr", "value": round(ratio, 3),
+           "unit": "x", "serve_tokens_ratio": round(token_ratio, 3),
+           "pools": pools, "horizon_s": horizon_s, "backend": "none"})
+    return 0 if ratio > 1.0 and token_ratio > 1.0 else 1
 
 
 def run_northstar_bench() -> int:
@@ -3027,6 +3153,8 @@ def main() -> int:
         return run_kv_fabric_bench(smoke="--smoke" in sys.argv)
     if "--flight-recorder" in sys.argv:
         return run_flight_recorder_bench(smoke="--smoke" in sys.argv)
+    if "--scheduler" in sys.argv:
+        return run_scheduler_bench(smoke="--smoke" in sys.argv)
     if "--ring-flash" in sys.argv:
         return run_ring_flash_check()
     if "--spec-drift" in sys.argv:

@@ -20,6 +20,8 @@ import enum
 import time
 from typing import Optional
 
+from ..generations import GENERATIONS
+
 
 class QueuedResourceState(str, enum.Enum):
     """Lifecycle of a Cloud TPU queued resource (plus synthetic terminal states).
@@ -84,7 +86,11 @@ class AcceleratorType:
 
 
 def _gen(generation: str, prefix: str, runtime: str, chips_per_host: int,
-         hbm: int, cost: float, slices: list[tuple[int, str]]) -> list[AcceleratorType]:
+         hbm: int, slices: list[tuple[int, str]]) -> list[AcceleratorType]:
+    # $/chip-hr comes from the shared generations table (ISSUE 19) so the
+    # catalog, the scheduler's goodput-per-dollar math and bench all price
+    # a chip identically
+    cost = GENERATIONS[generation].cost_per_chip_hr
     out = []
     for chips, topology in slices:
         hosts = max(1, chips // chips_per_host)
@@ -100,19 +106,19 @@ def _gen(generation: str, prefix: str, runtime: str, chips_per_host: int,
 ACCELERATOR_CATALOG: dict[str, AcceleratorType] = {
     a.name: a
     for a in (
-        _gen("v4", "v4", "tpu-vm-v4-base", 4, 32, 3.22, [
+        _gen("v4", "v4", "tpu-vm-v4-base", 4, 32, [
             (8, "2x2x1"), (16, "2x2x2"), (32, "2x2x4"), (64, "2x4x4"),
             (128, "4x4x4"), (256, "4x4x8"), (512, "4x8x8"),
         ])
-        + _gen("v5e", "v5litepod", "v2-alpha-tpuv5-lite", 4, 16, 1.20, [
+        + _gen("v5e", "v5litepod", "v2-alpha-tpuv5-lite", 4, 16, [
             (1, "1x1"), (4, "2x2"), (8, "2x4"), (16, "4x4"),
             (32, "4x8"), (64, "8x8"), (128, "8x16"), (256, "16x16"),
         ])
-        + _gen("v5p", "v5p", "v2-alpha-tpuv5", 4, 95, 4.20, [
+        + _gen("v5p", "v5p", "v2-alpha-tpuv5", 4, 95, [
             (8, "2x2x1"), (16, "2x2x2"), (32, "2x2x4"), (64, "2x4x4"),
             (128, "4x4x4"), (256, "4x4x8"), (512, "4x8x8"),
         ])
-        + _gen("v6e", "v6e", "v2-alpha-tpuv6e", 4, 32, 2.70, [
+        + _gen("v6e", "v6e", "v2-alpha-tpuv6e", 4, 32, [
             (1, "1x1"), (4, "2x2"), (8, "2x4"), (16, "4x4"),
             (32, "4x8"), (64, "8x8"), (128, "8x16"), (256, "16x16"),
         ])

@@ -146,6 +146,16 @@ class Config:
     fleet_pull_timeout_s: float = 10.0      # one pull hop, export->adopt
     fleet_placement_domain_mode: str = "auto"
     fleet_prefix_broadcast: bool = False
+    # global prefix-directory size (ISSUE 19 satellite): entries the
+    # router-side LRU holds before evicting the least-recently-touched
+    # prefix claim. The 4096 default matches the old hardcoded cap.
+    fleet_directory_capacity: int = 4096
+    # heterogeneous node pools (ISSUE 19): "[name=]generation:chips"
+    # comma-list, e.g. "v5e:32,v5p:64". Non-empty switches router_main to
+    # scheduler-routed capacity: autoscalers place through the
+    # goodput-per-dollar FleetScheduler instead of creating pods
+    # directly. "" = the legacy homogeneous fleet (no scheduler).
+    fleet_pools: str = ""
 
     # training telemetry (ISSUE 5). telemetry_port is a gang COORDINATION
     # var: injected into every worker's env (TPU_TELEMETRY_PORT +
@@ -358,6 +368,16 @@ class Config:
             errs.append("fleet_handoff_timeout_s must be > 0")
         if self.fleet_pull_timeout_s <= 0:
             errs.append("fleet_pull_timeout_s must be > 0")
+        if self.fleet_directory_capacity <= 0:
+            errs.append("fleet_directory_capacity must be > 0 (the "
+                        "directory needs room for at least one prefix)")
+        if self.fleet_pools:
+            # parse errors surface at startup, not at first scale-up
+            from .fleet.scheduler import PoolSpecError, parse_pools
+            try:
+                parse_pools(self.fleet_pools)
+            except PoolSpecError as e:
+                errs.append(f"fleet_pools: {e}")
         if self.fleet_placement_domain_mode not in ("auto", "proc", "slice"):
             errs.append(f"fleet_placement_domain_mode must be "
                         f"auto/proc/slice, got "
@@ -468,6 +488,8 @@ _ENV_MAP = {
     "TPU_FLEET_PULL_TIMEOUT_S": "fleet_pull_timeout_s",
     "TPU_FLEET_PLACEMENT_DOMAIN_MODE": "fleet_placement_domain_mode",
     "TPU_FLEET_PREFIX_BROADCAST": "fleet_prefix_broadcast",
+    "TPU_FLEET_DIRECTORY_CAPACITY": "fleet_directory_capacity",
+    "TPU_FLEET_POOLS": "fleet_pools",
     "TPU_TELEMETRY_PORT": "telemetry_port",
     "TPU_STRAGGLER_FACTOR": "straggler_factor",
     "TPU_STALL_TIMEOUT_S": "stall_timeout_s",

@@ -16,6 +16,11 @@ needs a tier ABOVE replicas. This package is that tier:
   from queue depth + TTFT-SLO burn (hysteresis + cooldowns), creating
   serving pods against the virtual node and drain-before-delete on the
   way down so no request is dropped.
+- ``scheduler``  — heterogeneity- and goodput-aware placement over mixed
+  TPU generations (ISSUE 19): declared node pools, a live effective-
+  throughput matrix refined from fleet telemetry, goodput-per-dollar
+  placement, best-effort packing with lowest-goodput-loss-first
+  preemption.
 
 Entry point: ``python -m k8s_runpod_kubelet_tpu.fleet.router_main``.
 """
@@ -24,9 +29,13 @@ from .autoscaler import AutoscalerConfig, FleetAutoscaler, KubePodScaler
 from .registry import (DRAINING, READY, Replica, ReplicaRegistry,
                        ReplicaReporter, ReplicaStats)
 from .router import FleetRouter, RouterConfig, serve_router
+from .scheduler import (FleetScheduler, NodePool, Placement,
+                        PoolSpecError, ThroughputMatrix, parse_pools)
 
 __all__ = [
     "AutoscalerConfig", "FleetAutoscaler", "KubePodScaler",
     "READY", "DRAINING", "Replica", "ReplicaRegistry", "ReplicaReporter",
     "ReplicaStats", "FleetRouter", "RouterConfig", "serve_router",
+    "FleetScheduler", "NodePool", "Placement", "PoolSpecError",
+    "ThroughputMatrix", "parse_pools",
 ]

@@ -104,6 +104,10 @@ class KubePodScaler:
                  on_create: Optional[Callable[[dict], None]] = None,
                  on_delete: Optional[Callable[[dict], None]] = None,
                  role: str = ""):
+        # NB: when a FleetScheduler is wired (ISSUE 19) the autoscaler
+        # calls create(name=..., placement=...) — the pod is born carrying
+        # its reservation as tpu.dev/pool* annotations, so a restarted
+        # scheduler can rebuild its table from live pods (adopt()).
         self.kube = kube
         self.node_name = node_name
         self.namespace = namespace
@@ -166,26 +170,65 @@ class KubePodScaler:
                 env.append({"name": "TPU_SERVING_ROLE", "value": self.role})
         return pod
 
-    def create(self) -> str:
+    def next_name(self) -> str:
+        """Reserve the NEXT pod name without creating the pod — the
+        scheduler-aware scale-up path places against the name first
+        (place() is idempotent by tag), then creates, so a crash between
+        the two leaves a reservation a retry reuses instead of a pod
+        nothing accounted for."""
         self._seq += 1
-        name = (f"tpu-serving-{self.role}-{self._seq}" if self.role
+        return (f"tpu-serving-{self.role}-{self._seq}" if self.role
                 else f"tpu-serving-{self._seq}")
-        created = self.kube.create_pod(self._pod(name))
+
+    def create(self, name: Optional[str] = None, placement=None) -> str:
+        if name is None:
+            name = self.next_name()
+        pod = self._pod(name)
+        if placement is not None:
+            self._stamp_placement(pod, placement)
+        created = self.kube.create_pod(pod)
         if self.on_create is not None:
             self.on_create(created)
         return name
+
+    @staticmethod
+    def _stamp_placement(pod: dict, placement):
+        """Bake the scheduler's reservation into the pod: annotations are
+        the durable record adopt() rebuilds from after a restart; the env
+        vars let serve_main's reporter register with its generation/pool
+        so heartbeats teach the right throughput-matrix cell; the
+        generation annotation pins gang launch (translate.select_slice)
+        to the pool's hardware."""
+        from ..provider.annotations import Annotations as A
+        anns = pod.setdefault("metadata", {}).setdefault("annotations", {})
+        anns[A.POOL] = placement.pool
+        anns[A.POOL_KIND] = placement.kind
+        anns[A.GENERATION] = placement.generation
+        if placement.best_effort:
+            anns[A.BEST_EFFORT] = "true"
+        for container in pod.get("spec", {}).get("containers", []):
+            env = container.setdefault("env", [])
+            env.append({"name": "TPU_SERVING_GENERATION",
+                        "value": placement.generation})
+            env.append({"name": "TPU_SERVING_POOL",
+                        "value": placement.pool})
 
     def list_fleet_pods(self) -> list[str]:
         """Names of fleet-owned serving pods (by label) — the orphan
         reaper's ground truth of what exists in the cluster. A
         role-scoped scaler lists ONLY its pool's pods, so two pool
         reapers can never fight over (or reap) each other's pods."""
+        return [p["metadata"]["name"]
+                for p in self.list_fleet_pod_objects()]
+
+    def list_fleet_pod_objects(self) -> list[dict]:
+        """Full fleet-owned pod objects — FleetScheduler.adopt() rebuilds
+        reservations from their tpu.dev/pool annotations on restart."""
         selector = self.FLEET_LABEL
         if self.role:
             selector += f",{self.ROLE_LABEL}={self.role}"
-        return [p["metadata"]["name"]
-                for p in self.kube.list_pods(self.namespace,
-                                             label_selector=selector)]
+        return self.kube.list_pods(self.namespace,
+                                   label_selector=selector)
 
     def delete(self, pod_name: str):
         pod = None
@@ -220,9 +263,16 @@ class FleetAutoscaler:
                  metrics=None, tracer=None,
                  clock: Callable[[], float] = time.monotonic,
                  drain_fn: Optional[Callable[[Replica], None]] = None,
-                 slo=None):
+                 slo=None, scheduler=None):
         self.registry = registry
         self.scaler = scaler
+        # heterogeneity-aware placement (ISSUE 19): when a FleetScheduler
+        # is wired, scale-ups REQUEST capacity through it (place() picks
+        # the goodput-per-dollar pool; the scale-event reason cites the
+        # choice) instead of creating pods directly, and every pod exit
+        # releases its reservation. None keeps the legacy single-pool
+        # create path.
+        self.scheduler = scheduler
         # SLO burn-rate corroboration (ISSUE 17): when a tracker is
         # wired, latency scale-ups trigger on multi-window budget burn
         # (slo.burning) instead of the latched-p95-plus-busy heuristic —
@@ -255,6 +305,8 @@ class FleetAutoscaler:
         # times for the orphan reaper (a restarted autoscaler must not
         # leak the pod of a drain its predecessor started)
         self._orphan_seen: dict[str, float] = {}
+        # restart adoption (ISSUE 19) runs once, on the first tick
+        self._adopted_restart = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # a role-scoped loop labels its gauge so two pool loops don't
@@ -409,7 +461,27 @@ class FleetAutoscaler:
                                       "role": self.cfg.role or "unified"})
 
     def _scale_up(self, size: int, reason: str):
-        pod = self.scaler.create()
+        if self.scheduler is not None:
+            # place-then-create: the reservation is keyed by the pod name
+            # (idempotent), so a crash between place and create costs a
+            # reservation the next attempt reuses — never an unaccounted
+            # pod. kind = the pool role (unified for the legacy loop).
+            name = self.scaler.next_name()
+            placement = self.scheduler.place(
+                self.cfg.role or "unified",
+                getattr(self.scaler, "chips", 8) or 8, name)
+            if placement is None:
+                # capacity exhaustion is not an error: stay overloaded and
+                # retry next tick (a drain/release may free chips)
+                log.warning("fleet%s: scale up blocked — no pool has "
+                            "capacity (%s)",
+                            f"[{self.cfg.role}]" if self.cfg.role else "",
+                            reason)
+                return
+            pod = self.scaler.create(name=name, placement=placement)
+            reason = f"{reason}; {placement.reason}"
+        else:
+            pod = self.scaler.create()
         self._pending[pod] = self.clock()
         self._last_up = self.clock()
         self._over_since = None
@@ -454,6 +526,9 @@ class FleetAutoscaler:
                     log.warning("fleet: delete of %s failed (will retry): %s",
                                 drain.pod_name, e)
                     continue
+            if drain.pod_name and self.scheduler is not None:
+                self.scheduler.release(drain.pod_name,
+                                       reason="drained and deleted")
             del self._drains[rid]
             self._last_down = now
             if self.metrics is not None:
@@ -470,6 +545,10 @@ class FleetAutoscaler:
                             "%.0fs; dropping from fleet accounting", pod,
                             self.cfg.boot_timeout_s)
                 del self._pending[pod]
+                if self.scheduler is not None:
+                    # its chips must not stay reserved for a pod that never
+                    # came up (the orphan reaper deletes the pod itself)
+                    self.scheduler.release(pod, reason="boot timeout")
 
     # -- the loop --------------------------------------------------------------
 
@@ -521,14 +600,49 @@ class FleetAutoscaler:
                 log.warning("fleet: orphan delete of %s failed: %s", pod, e)
                 continue
             self._orphan_seen.pop(pod, None)
+            if self.scheduler is not None:
+                self.scheduler.release(pod, reason="orphan reaped")
             if self.metrics is not None:
                 self.metrics.incr("tpu_fleet_orphans_reaped")
         for pod in list(self._orphan_seen):
             if pod not in live:
                 del self._orphan_seen[pod]
 
+    def _adopt_restart(self):
+        """First tick after a (re)start: rebuild state from live pods.
+        The scheduler re-learns every fleet pod's reservation from its
+        tpu.dev/pool annotations (idempotent — already-known tags are
+        skipped), and a pod created by a predecessor that hasn't
+        registered a replica yet goes into _pending: it counts toward
+        fleet size again (no double-place for the same demand) and gets
+        the boot-timeout grace instead of being orphan-reaped."""
+        self._adopted_restart = True
+        if self.scheduler is None:
+            return
+        lister = getattr(self.scaler, "list_fleet_pod_objects", None)
+        if lister is None:
+            return
+        try:
+            pods = lister()
+        except Exception as e:  # noqa: BLE001 — next restart retries
+            log.warning("fleet: restart adoption listing failed: %s", e)
+            return
+        self.scheduler.adopt(pods)
+        now = self.clock()
+        backed = self.registry.registered_pod_names()
+        for pod in pods:
+            name = pod.get("metadata", {}).get("name", "")
+            if (name and name not in backed and name not in self._pending
+                    and not any(d.pod_name == name
+                                for d in self._drains.values())):
+                log.info("fleet: adopting pending pod %s after restart",
+                         name)
+                self._pending[name] = now
+
     def tick(self):
         now = self.clock()
+        if not self._adopted_restart:
+            self._adopt_restart()
         self._expire_pending()
         self._adopt_draining()
         self._progress_drains()

@@ -77,6 +77,11 @@ class ReplicaStats:
     # ride the heartbeat as monotonic totals
     errors_total: int = 0
     requests_total: int = 0
+    # cumulative decode steps (~ tokens emitted): the fleet scheduler's
+    # throughput matrix turns successive beats' deltas into measured
+    # tokens/sec-per-chip per generation (ISSUE 19) — same
+    # monotonic-total shape as the SLO counters, no new wire protocol
+    tokens_total: int = 0
     draining: bool = False
 
     _FLOATS = ("ttft_p95_s", "itl_p95_s")
@@ -124,6 +129,13 @@ class Replica:
     # the wire codec. "" = wire-only (the safe default for replicas that
     # never advertised one).
     placement_domain: str = ""
+    # mixed-fleet placement identity (ISSUE 19): which TPU generation the
+    # replica runs on and which scheduler node pool reserved its chips.
+    # Registration-level like placement_domain — hardware can't change
+    # under a live process. "" = unplaced legacy replica (still routable;
+    # the scheduler just can't attribute its throughput to a pool).
+    generation: str = ""
+    pool: str = ""
     state: str = READY
     registered_at: float = 0.0
     last_heartbeat_at: float = 0.0
@@ -140,6 +152,7 @@ class Replica:
         return {"replica_id": self.replica_id, "base_url": self.base_url,
                 "pod_name": self.pod_name, "role": self.role,
                 "placement_domain": self.placement_domain,
+                "generation": self.generation, "pool": self.pool,
                 "state": self.state,
                 "age_s": round(now - self.registered_at, 3),
                 "heartbeat_age_s": round(now - self.last_heartbeat_at, 3),
@@ -175,10 +188,14 @@ class ReplicaRegistry:
                  breaker_failure_threshold: int = 3,
                  breaker_reset_s: float = 10.0,
                  request_timeout_s: float = 120.0,
-                 directory=None, slo=None):
+                 directory=None, slo=None, scheduler=None):
         self.metrics = metrics
         self.tracer = tracer
         self.clock = clock
+        # fleet scheduler (ISSUE 19): every accepted heartbeat teaches its
+        # effective-throughput matrix (tokens/sec-per-chip per generation)
+        # — called outside the membership lock like slo/directory
+        self.scheduler = scheduler
         # SLO burn-rate tracker (ISSUE 17): every accepted heartbeat is
         # one good/bad observation per signal; membership exits drop the
         # replica's error-counter baseline
@@ -233,7 +250,8 @@ class ReplicaRegistry:
 
     def register(self, replica_id: str, base_url: str,
                  pod_name: str = "", role: str = UNIFIED,
-                 placement_domain: str = "") -> Replica:
+                 placement_domain: str = "", generation: str = "",
+                 pool: str = "") -> Replica:
         if not replica_id or not base_url:
             raise ValueError("replica_id and base_url are required")
         role = role or UNIFIED
@@ -257,6 +275,8 @@ class ReplicaRegistry:
             # advertising a domain must drop to wire-only, not keep a
             # stale device claim
             rep.placement_domain = str(placement_domain or "")
+            rep.generation = str(generation or "")
+            rep.pool = str(pool or "")
             rep.state = READY
             rep.last_heartbeat_at = now
         if self.metrics is not None:
@@ -288,6 +308,14 @@ class ReplicaRegistry:
                 rep.state = DRAINING
             state = rep.state
             stats_obj = rep.stats
+            pod_name, role = rep.pod_name, rep.role
+            generation = rep.generation
+        if self.scheduler is not None:
+            # matrix refinement (ISSUE 19): outside the membership lock
+            # like slo/directory — the scheduler has its own lock and a
+            # heartbeat must not serialize against place()
+            self.scheduler.observe_serving(pod_name or replica_id, role,
+                                           generation, stats_obj)
         if self.slo is not None:
             # outside the membership lock: the tracker has its own, and
             # a heartbeat must not serialize against sweep()/ready()
@@ -425,7 +453,13 @@ class ReplicaRegistry:
                              if r["state"] == READY and not r["breaker_open"]),
                 "draining": sum(1 for r in reps if r["state"] == DRAINING),
                 "pools": {role: sum(1 for r in reps if r["role"] == role)
-                          for role in ROLES}}
+                          for role in ROLES},
+                # mixed-fleet membership (ISSUE 19): replicas per
+                # scheduler node pool ("" = legacy/unplaced)
+                "node_pools": {pool: sum(1 for r in reps
+                                         if r["pool"] == pool)
+                               for pool in sorted({r["pool"]
+                                                   for r in reps})}}
 
     def _update_gauges(self):
         if self.metrics is None:
@@ -458,7 +492,8 @@ class ReplicaReporter:
     def __init__(self, engine, router_url: str, replica_id: str,
                  advertise_url: str, pod_name: str = "",
                  interval_s: float = 2.0, post_fn=None,
-                 role: str = UNIFIED, placement_domain: str = ""):
+                 role: str = UNIFIED, placement_domain: str = "",
+                 generation: str = "", pool: str = ""):
         self.engine = engine
         self.router_url = router_url.rstrip("/")
         self.replica_id = replica_id
@@ -467,6 +502,10 @@ class ReplicaReporter:
         self.role = role or UNIFIED
         # device-transfer co-location claim (ISSUE 11); "" = wire-only
         self.placement_domain = placement_domain
+        # mixed-fleet identity (ISSUE 19): from TPU_SERVING_GENERATION /
+        # TPU_SERVING_POOL stamped by the scheduler-aware pod scaler
+        self.generation = generation
+        self.pool = pool
         self.interval_s = interval_s
         self._post = post_fn or self._http_post
         self._stop = threading.Event()
@@ -562,6 +601,10 @@ class ReplicaReporter:
                     "tpu_serving_prefill_errors")),
             "requests_total": self.engine.metrics.get_counter(
                 "tpu_serving_admitted"),
+            # cumulative decode steps ~= tokens emitted: the scheduler's
+            # serving-throughput signal (ISSUE 19)
+            "tokens_total": self.engine.metrics.get_counter(
+                "tpu_serving_decode_steps"),
             "prefix_hit_rate": round(hit_rate, 4),
             "spec_acceptance_rate": (round(spec_acc / spec_prop, 4)
                                      if spec_prop else None),
@@ -574,7 +617,9 @@ class ReplicaReporter:
                     "base_url": self.advertise_url,
                     "pod_name": self.pod_name,
                     "role": self.role,
-                    "placement_domain": self.placement_domain})
+                    "placement_domain": self.placement_domain,
+                    "generation": self.generation,
+                    "pool": self.pool})
 
     def beat_once(self) -> bool:
         """One heartbeat (re-registering if the router forgot us); returns

@@ -125,12 +125,15 @@ class FleetRouter:
     def __init__(self, registry: ReplicaRegistry, cfg: RouterConfig = None,
                  metrics=None, tracer: Optional[Tracer] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 directory=None, slo=None):
+                 directory=None, slo=None, scheduler=None):
         self.registry = registry
         self.cfg = cfg or RouterConfig()
         # SLO burn-rate tracker (ISSUE 17) behind GET /debug/slo; the
         # registry feeds it heartbeats, the autoscaler reads burning()
         self.slo = slo
+        # fleet scheduler (ISSUE 19) behind GET /debug/scheduler; the
+        # pool autoscalers request capacity through it
+        self.scheduler = scheduler
         self.metrics = metrics
         self.tracer = tracer if tracer is not None else Tracer()
         self.clock = clock
@@ -762,7 +765,15 @@ class _RouterHandler(BaseHTTPRequestHandler):
             snap = rt.registry.snapshot()
             if rt.directory is not None:
                 snap["directory"] = rt.directory.snapshot()
+            if rt.scheduler is not None:
+                snap["scheduler"] = rt.scheduler.snapshot()
             return self._send(200, snap)
+        if url.path == "/debug/scheduler":
+            # pool capacity, placements and the throughput matrix
+            # (ISSUE 19); tools/fleet_summary.py renders the pool table
+            if rt.scheduler is None:
+                return self._send(200, {"enabled": False})
+            return self._send(200, rt.scheduler.snapshot())
         if url.path == "/debug/traces":
             q = urllib.parse.parse_qs(url.query)
             return self._send(200, rt.tracer.query(
@@ -809,7 +820,9 @@ class _RouterHandler(BaseHTTPRequestHandler):
                     str(body.get("pod_name") or ""),
                     role=str(body.get("role") or ""),
                     placement_domain=str(body.get("placement_domain")
-                                         or ""))
+                                         or ""),
+                    generation=str(body.get("generation") or ""),
+                    pool=str(body.get("pool") or ""))
             except ValueError as e:
                 return self._send(400, {"error": str(e)})
             return self._send(200, {"registered": rep.replica_id,

@@ -103,6 +103,16 @@ def parse_flags(argv):
                    help="restore the pre-directory POST /prefix fan-out "
                         "(register the prefix on EVERY ready replica up "
                         "front) instead of register-once + lazy pulls")
+    p.add_argument("--directory-capacity", dest="fleet_directory_capacity",
+                   type=int, default=None,
+                   help="prefix-directory LRU size: entries held before "
+                        "the least-recently-touched claim evicts "
+                        "(default 4096)")
+    p.add_argument("--pools", dest="fleet_pools", default=None,
+                   help="heterogeneous node pools as [name=]generation:"
+                        "chips, comma-separated (e.g. v5e:32,v5p:64); "
+                        "non-empty routes every scale-up through the "
+                        "goodput-per-dollar fleet scheduler")
     p.add_argument("--slo-short-window", dest="fleet_slo_short_window_s",
                    type=float, default=None,
                    help="SLO burn-rate short window in seconds (fast "
@@ -162,7 +172,18 @@ def build(cfg: config_mod.Config, kube=None, autoscale: bool = False,
     directory = None
     if cfg.fleet_prefix_directory_enabled:
         from .prefix_directory import PrefixDirectory
-        directory = PrefixDirectory(metrics=metrics)
+        directory = PrefixDirectory(metrics=metrics,
+                                    max_entries=cfg.fleet_directory_capacity)
+    # heterogeneous node pools (ISSUE 19): a declared fleet_pools spec
+    # stands up the goodput-per-dollar scheduler — heartbeats refine its
+    # throughput matrix via the registry, the autoscalers request
+    # capacity through it, /debug/scheduler exposes it
+    scheduler = None
+    if cfg.fleet_pools:
+        from .scheduler import FleetScheduler
+        scheduler = FleetScheduler(cfg.fleet_pools, metrics=metrics,
+                                   tracer=tracer,
+                                   default_serving_chips=serving_chips)
     # SLO burn-rate layer (ISSUE 17): fed by every accepted heartbeat,
     # read by GET /debug/slo and the autoscalers' latency corroboration
     from .slo import SLOTracker
@@ -180,7 +201,7 @@ def build(cfg: config_mod.Config, kube=None, autoscale: bool = False,
         heartbeat_timeout_s=cfg.fleet_heartbeat_timeout_s,
         breaker_failure_threshold=cfg.breaker_failure_threshold,
         breaker_reset_s=cfg.breaker_reset_s,
-        directory=directory, slo=slo)
+        directory=directory, slo=slo, scheduler=scheduler)
     router = FleetRouter(
         registry,
         RouterConfig(port=cfg.fleet_router_port,
@@ -192,7 +213,8 @@ def build(cfg: config_mod.Config, kube=None, autoscale: bool = False,
                      pull_timeout_s=cfg.fleet_pull_timeout_s,
                      prefix_broadcast=cfg.fleet_prefix_broadcast,
                      kv_page_tokens=cfg.kv_page_tokens),
-        metrics=metrics, tracer=tracer, directory=directory, slo=slo)
+        metrics=metrics, tracer=tracer, directory=directory, slo=slo,
+        scheduler=scheduler)
     autoscalers = []
     if autoscale:
         from ..kube import RealKubeClient
@@ -225,7 +247,8 @@ def build(cfg: config_mod.Config, kube=None, autoscale: bool = False,
                 registry, scaler,
                 AutoscalerConfig(min_replicas=mn, max_replicas=mx,
                                  role=role, **base, **extra),
-                metrics=metrics, tracer=tracer, slo=slo))
+                metrics=metrics, tracer=tracer, slo=slo,
+                scheduler=scheduler))
     return registry, router, autoscalers
 
 

@@ -1,0 +1,660 @@
+"""Heterogeneity- and goodput-aware fleet scheduler over mixed TPU pools.
+
+ROADMAP item 2 (ISSUE 19). The kubelet stops pretending the fleet is one
+homogeneous node: the operator declares **node pools** — per-generation
+chip counts (``fleet_pools="v5e:32,v5p:64"``) priced and roofline-rated
+by the shared generations table — and every capacity request (serving
+scale-ups via the pool autoscalers, training gangs, best-effort packing)
+flows through ``place()``, which maximizes **goodput-per-dollar**:
+
+- the scheduler keeps an **effective-throughput matrix** per (workload
+  kind x generation), seeded from the roofline the disagg split exposes —
+  prefill is FLOPs-bound, decode is HBM-bandwidth-bound, training tracks
+  FLOPs x target-MFU — and refined online from the fleet's own telemetry
+  (tokens/sec-per-chip out of serving heartbeats, measured MFU out of the
+  kubelet's TPU_TELEMETRY scrape). No new wire protocol: both signals
+  already flow (registry heartbeats, training_watch scrapes).
+- placement picks the pool with the best ``effective-throughput / $``
+  among those with room, Gavel-style ("Heterogeneity-Aware Cluster
+  Scheduling Policies", PAPERS.md) — under contention the 1.5x per-dollar
+  prefill advantage of a v5e beats its 1.04x decode advantage, so
+  prefill lands on the FLOPs-per-dollar pool and decode takes the
+  bandwidth-rich one.
+- **best-effort training** packs onto chips the serving autoscalers
+  aren't using and is the preemption buffer: when a non-best-effort
+  request finds its pool full, victims are evicted
+  **lowest-goodput-loss-first**, where loss is the PR 5/6 ledger's
+  unsaved work since the last durable checkpoint (goodput-weighted
+  chip-seconds that preemption would destroy).
+
+Everything is injected-clock and lock-disciplined like the rest of the
+fleet tier; the deterministic scheduler soak drives it from a FakeClock
+with a seeded FaultPlan. A ``round_robin`` policy ships alongside for the
+bench's like-for-like goodput-per-dollar comparison (``bench.py
+--scheduler``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from ..generations import GENERATIONS, GenerationSpec, generation_of
+
+log = logging.getLogger(__name__)
+
+# workload kinds the throughput matrix is indexed by. Serving kinds match
+# the registry's pool roles; "training" covers gangs (best-effort is a
+# training placement with the preemptible flag, not a separate kind).
+PREFILL = "prefill"
+DECODE = "decode"
+UNIFIED = "unified"
+TRAINING = "training"
+WORKLOAD_KINDS = (PREFILL, DECODE, UNIFIED, TRAINING)
+
+HETERO = "hetero"
+ROUND_ROBIN = "round_robin"
+POLICIES = (HETERO, ROUND_ROBIN)
+
+# matrix seed for training: a healthy gang runs at roughly this MFU
+# (bench.py's _TARGET_MFU) until a measured value replaces the guess
+_SEED_TRAINING_MFU = 0.4
+
+
+class PoolSpecError(ValueError):
+    """A fleet_pools spec that cannot be parsed or priced."""
+
+
+@dataclasses.dataclass(frozen=True)
+class NodePool:
+    """One homogeneous slab of capacity: a generation and a chip count.
+
+    ``name`` defaults to the generation but an operator can run two pools
+    of one generation (``"edge=v5e:16,bulk=v5e:64"``) — e.g. different
+    zones or reservations — and place onto them separately."""
+
+    name: str
+    generation: str
+    total_chips: int
+
+    @property
+    def spec(self) -> GenerationSpec:
+        return GENERATIONS[self.generation]
+
+
+def parse_pools(spec: str) -> list[NodePool]:
+    """``"v5e:32,v5p:64"`` (or ``"name=v5e:32"``) -> NodePool list.
+
+    The generation must be a row of the shared generations table — an
+    unpriced pool can't be placed onto by goodput-per-dollar."""
+    pools: list[NodePool] = []
+    seen: set[str] = set()
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, rest = part.partition("=")
+        if not rest:
+            name, rest = "", name
+        gen, _, chips_s = rest.partition(":")
+        gen = gen.strip().lower()
+        name = (name.strip() or gen)
+        if gen not in GENERATIONS:
+            raise PoolSpecError(
+                f"pool {part!r}: unknown generation {gen!r} "
+                f"(one of {sorted(GENERATIONS)})")
+        try:
+            chips = int(chips_s)
+        except ValueError:
+            raise PoolSpecError(
+                f"pool {part!r}: chip count {chips_s!r} is not an int")
+        if chips <= 0:
+            raise PoolSpecError(f"pool {part!r}: chip count must be > 0")
+        if name in seen:
+            raise PoolSpecError(f"duplicate pool name {name!r}")
+        seen.add(name)
+        pools.append(NodePool(name=name, generation=gen, total_chips=chips))
+    return pools
+
+
+@dataclasses.dataclass
+class Placement:
+    """One granted reservation: ``tag`` is the caller's handle (the pod
+    name for serving replicas and training gangs) and the release key."""
+
+    tag: str
+    kind: str
+    pool: str
+    generation: str
+    chips: int
+    best_effort: bool = False
+    reason: str = ""
+    placed_at: float = 0.0
+    # preemption-cost estimate for best-effort placements: unsaved work
+    # since the last durable checkpoint in goodput-weighted chip-seconds
+    # (the PR 5/6 ledger's unsaved_work_s x goodput x chips), refreshed
+    # by observe_training. Lowest loss is preempted first.
+    goodput_loss: float = 0.0
+
+    def to_dict(self) -> dict:
+        return {"tag": self.tag, "kind": self.kind, "pool": self.pool,
+                "generation": self.generation, "chips": self.chips,
+                "best_effort": self.best_effort,
+                "goodput_loss": round(self.goodput_loss, 3),
+                "reason": self.reason}
+
+
+class ThroughputMatrix:
+    """Effective throughput per (workload kind x generation).
+
+    Seeded from the roofline — prefill/training follow peak bf16 TFLOP/s,
+    decode follows peak HBM GB/s, unified the geometric mean of both (it
+    does each half of the request) — and refined online with an EWMA of
+    measured values. A generation nobody has measured yet borrows the
+    best-measured sibling's value scaled by the ROOFLINE RATIO (Gavel's
+    trick: relative throughput transfers across hardware long before
+    absolute numbers are known everywhere).
+
+    Units per kind are arbitrary but consistent across generations
+    (placement only compares ratios), so roofline seeds and measured
+    tokens/sec-per-chip (serving) or effective TFLOP/s (training) mix."""
+
+    def __init__(self, ewma_alpha: float = 0.25):
+        if not 0 < ewma_alpha <= 1:
+            raise ValueError("ewma_alpha must be in (0, 1]")
+        self.alpha = ewma_alpha
+        self._lock = threading.Lock()
+        # (kind, generation) -> (ewma value, observation count)
+        self._measured: dict[tuple[str, str], tuple[float, int]] = {}
+
+    @staticmethod
+    def roofline(kind: str, generation: str) -> float:
+        spec = GENERATIONS[generation_of(generation)]
+        if kind == DECODE:
+            return spec.peak_hbm_gbps
+        if kind == UNIFIED:
+            return (spec.peak_tflops_bf16 * spec.peak_hbm_gbps) ** 0.5
+        if kind == TRAINING:
+            return spec.peak_tflops_bf16 * _SEED_TRAINING_MFU
+        return spec.peak_tflops_bf16  # PREFILL (and any unknown kind)
+
+    def observe(self, kind: str, generation: str, value: float):
+        """Fold one measured throughput sample (workload-native units,
+        e.g. tokens/sec-per-chip or achieved TFLOP/s) into the EWMA."""
+        if value <= 0:
+            return
+        generation = generation_of(generation)
+        key = (kind, generation)
+        with self._lock:
+            prev = self._measured.get(key)
+            if prev is None:
+                self._measured[key] = (value, 1)
+            else:
+                ewma, n = prev
+                self._measured[key] = (
+                    ewma + self.alpha * (value - ewma), n + 1)
+
+    def effective(self, kind: str, generation: str) -> float:
+        """Best current estimate for (kind, generation): measured EWMA,
+        else the best-measured sibling scaled by roofline ratio, else the
+        roofline seed itself."""
+        generation = generation_of(generation)
+        with self._lock:
+            hit = self._measured.get((kind, generation))
+            if hit is not None:
+                return hit[0]
+            # sibling transfer: most-observed first, name tie-break for
+            # determinism
+            siblings = [(n, g, v) for (k, g), (v, n)
+                        in self._measured.items() if k == kind]
+        if siblings:
+            _, sib_gen, sib_val = max(
+                siblings, key=lambda s: (s[0], s[1]))
+            ratio = (self.roofline(kind, generation)
+                     / self.roofline(kind, sib_gen))
+            return sib_val * ratio
+        return self.roofline(kind, generation)
+
+    def snapshot(self) -> dict:
+        """``{kind: {generation: {eff, measured, samples}}}`` across the
+        declared generations — the /debug and fleet_summary surface."""
+        with self._lock:
+            measured = dict(self._measured)
+        out: dict = {}
+        for kind in WORKLOAD_KINDS:
+            row = {}
+            for gen in GENERATIONS:
+                hit = measured.get((kind, gen))
+                row[gen] = {"eff": round(self.effective(kind, gen), 3),
+                            "measured": hit is not None,
+                            "samples": hit[1] if hit else 0}
+            out[kind] = row
+        return out
+
+
+class FleetScheduler:
+    """Pool-aware placement maximizing goodput-per-dollar.
+
+    ``place()/release()`` are the only capacity-mutating entry points —
+    the per-pool serving autoscalers request chips here instead of
+    creating pods directly, training gang translation honors the
+    resulting ``tpu.dev/pool`` annotation, and a restarted control plane
+    rebuilds its reservations from those annotations via ``adopt()``
+    (placement must survive the scheduler's death without double-placing
+    a pod that already exists).
+
+    ``preempt_fn(placement)`` is the eviction side-effect hook (delete
+    the pod / requeue the gang); the scheduler only picks victims and
+    frees their chips."""
+
+    def __init__(self, pools, metrics=None, tracer=None,
+                 clock: Callable[[], float] = time.monotonic,
+                 policy: str = HETERO,
+                 preempt_fn: Optional[Callable[[Placement], None]] = None,
+                 matrix: Optional[ThroughputMatrix] = None,
+                 default_serving_chips: int = 8):
+        if isinstance(pools, str):
+            pools = parse_pools(pools)
+        if not pools:
+            raise PoolSpecError("a scheduler needs at least one pool")
+        if policy not in POLICIES:
+            raise ValueError(f"unknown policy {policy!r} (one of {POLICIES})")
+        self.pools: dict[str, NodePool] = {p.name: p for p in pools}
+        self._pool_order = [p.name for p in pools]  # spec order, for RR
+        self.policy = policy
+        self.metrics = metrics
+        self.tracer = tracer
+        self.clock = clock
+        self.matrix = matrix or ThroughputMatrix()
+        self.preempt_fn = preempt_fn
+        self.default_serving_chips = default_serving_chips
+        self._lock = threading.Lock()
+        self._placements: dict[str, Placement] = {}
+        self._rr_next = 0
+        # per-replica (tokens_total, at) baselines for the serving
+        # throughput refinement — keyed by pod_name like placements
+        self._token_baseline: dict[str, tuple[int, float]] = {}
+        if metrics is not None:
+            self._describe(metrics)
+            self._update_gauges()
+
+    @staticmethod
+    def _describe(m):
+        m.describe("tpu_fleet_pool_chips",
+                   "node-pool chip accounting (labels: pool=, "
+                   "state=free|reserved)")
+        m.describe("tpu_fleet_pool_placements",
+                   "placements granted per pool (labels: pool=, kind=, "
+                   "best_effort=true|false)")
+        m.describe("tpu_fleet_pool_rejections",
+                   "place() requests no pool had room for (labels: kind=)")
+        m.describe("tpu_fleet_preemptions",
+                   "best-effort placements evicted to make room (labels: "
+                   "reason=goodput)")
+
+    # -- scoring ---------------------------------------------------------------
+
+    def _reserved(self, pool_name: str) -> int:
+        return sum(p.chips for p in self._placements.values()
+                   if p.pool == pool_name)
+
+    def free_chips(self, pool_name: str) -> int:
+        with self._lock:
+            return (self.pools[pool_name].total_chips
+                    - self._reserved(pool_name))
+
+    def _score(self, kind: str, pool: NodePool) -> float:
+        """Goodput-per-dollar: effective throughput per chip over
+        $/chip-hr (chip counts cancel)."""
+        return (self.matrix.effective(kind, pool.generation)
+                / pool.spec.cost_per_chip_hr)
+
+    def _rank(self, kind: str) -> list[tuple[float, NodePool]]:
+        """Pools best-first by per-dollar score; name tie-break."""
+        scored = [(self._score(kind, p), p) for p in self.pools.values()]
+        scored.sort(key=lambda sp: (-sp[0], sp[1].name))
+        return scored
+
+    @staticmethod
+    def _cite(kind: str, chosen: NodePool,
+              ranked: list[tuple[float, NodePool]]) -> str:
+        """The human-readable scale-event reason: the chosen pool's
+        per-dollar score and the alternatives it beat (or lost to on
+        capacity)."""
+        parts = []
+        for score, pool in ranked:
+            mark = "->" if pool.name == chosen.name else "  "
+            parts.append(f"{mark}{pool.name}({pool.generation}) "
+                         f"{score:.1f}/$ ")
+        return (f"{kind} per-dollar ranking: "
+                + "".join(parts).rstrip())
+
+    # -- placement -------------------------------------------------------------
+
+    def place(self, kind: str, chips: int, tag: str,
+              best_effort: bool = False) -> Optional[Placement]:
+        """Reserve ``chips`` for ``tag``; None when no pool has room (and
+        preemption couldn't make any — callers must treat that as
+        capacity exhaustion, not an error). Idempotent per tag: a retry
+        after a crash gets the existing reservation back instead of
+        double-placing."""
+        if kind not in WORKLOAD_KINDS:
+            raise ValueError(f"unknown kind {kind!r} "
+                             f"(one of {WORKLOAD_KINDS})")
+        if chips <= 0:
+            raise ValueError("chips must be > 0")
+        if not tag:
+            raise ValueError("a placement needs a tag")
+        now = self.clock()
+        victims: list[Placement] = []
+        with self._lock:
+            existing = self._placements.get(tag)
+            if existing is not None:
+                return existing
+            placement = self._place_locked(kind, chips, tag, best_effort,
+                                           now, victims)
+        # side effects outside the lock: preemption callbacks do pod
+        # deletes (HTTP), and gauges/spans take their own locks
+        for victim in victims:
+            self._record_preemption(victim, for_tag=tag, now=now)
+        if placement is None:
+            if self.metrics is not None:
+                self.metrics.incr("tpu_fleet_pool_rejections",
+                                  labels={"kind": kind})
+            self._span(now, action="no_capacity", kind=kind, chips=chips,
+                       tag=tag)
+            log.warning("fleet-scheduler: no pool has %d chips for %s %s",
+                        chips, kind, tag)
+            return None
+        if self.metrics is not None:
+            self.metrics.incr(
+                "tpu_fleet_pool_placements",
+                labels={"pool": placement.pool, "kind": kind,
+                        "best_effort": str(best_effort).lower()})
+        self._update_gauges()
+        self._span(now, action="place", kind=kind, chips=chips, tag=tag,
+                   pool=placement.pool, generation=placement.generation,
+                   best_effort=best_effort, reason=placement.reason)
+        log.info("fleet-scheduler: %s", placement.reason)
+        return placement
+
+    def _place_locked(self, kind, chips, tag, best_effort, now,
+                      victims: list) -> Optional[Placement]:
+        ranked = self._rank(kind)
+        if self.policy == ROUND_ROBIN:
+            order = [self.pools[self._pool_order[
+                (self._rr_next + i) % len(self._pool_order)]]
+                for i in range(len(self._pool_order))]
+            chosen = next((p for p in order
+                           if self.pools[p.name].total_chips
+                           - self._reserved(p.name) >= chips), None)
+            if chosen is None:
+                return None
+            self._rr_next = (self._pool_order.index(chosen.name) + 1) \
+                % len(self._pool_order)
+            reason = (f"{kind}@{chips} -> pool {chosen.name} "
+                      f"(round-robin, heterogeneity-blind)")
+            return self._grant(kind, chips, tag, best_effort, chosen,
+                               reason, now)
+        for score, pool in ranked:
+            free = pool.total_chips - self._reserved(pool.name)
+            if free >= chips:
+                reason = (f"{kind}@{chips} -> pool {pool.name} "
+                          f"({pool.generation}, "
+                          f"eff {self.matrix.effective(kind, pool.generation):.1f}/chip"
+                          f" / ${pool.spec.cost_per_chip_hr:.2f}/chip-hr"
+                          f" = {score:.1f}/$); "
+                          + self._cite(kind, pool, ranked))
+                return self._grant(kind, chips, tag, best_effort, pool,
+                                   reason, now)
+            if best_effort:
+                continue  # best-effort never preempts anyone
+            # capacity crunch: can evicting best-effort work make room in
+            # this (the best-scoring) pool? Victims leave
+            # lowest-goodput-loss-first — the cheapest unsaved work dies
+            # first.
+            preemptible = sorted(
+                (p for p in self._placements.values()
+                 if p.pool == pool.name and p.best_effort),
+                key=lambda p: (p.goodput_loss, p.tag))
+            reclaim, chosen_victims = free, []
+            for victim in preemptible:
+                if reclaim >= chips:
+                    break
+                reclaim += victim.chips
+                chosen_victims.append(victim)
+            if reclaim < chips:
+                continue  # even preemption can't fit it here; next pool
+            for victim in chosen_victims:
+                del self._placements[victim.tag]
+                victims.append(victim)
+            reason = (f"{kind}@{chips} -> pool {pool.name} "
+                      f"({pool.generation}, {score:.1f}/$) after "
+                      f"preempting {len(chosen_victims)} best-effort "
+                      f"placement(s), lowest goodput-loss first; "
+                      + self._cite(kind, pool, ranked))
+            return self._grant(kind, chips, tag, best_effort, pool,
+                               reason, now)
+        return None
+
+    def _grant(self, kind, chips, tag, best_effort, pool: NodePool,
+               reason: str, now: float) -> Placement:
+        placement = Placement(tag=tag, kind=kind, pool=pool.name,
+                              generation=pool.generation, chips=chips,
+                              best_effort=best_effort, reason=reason,
+                              placed_at=now)
+        self._placements[tag] = placement
+        return placement
+
+    def _record_preemption(self, victim: Placement, for_tag: str,
+                           now: float):
+        log.warning("fleet-scheduler: preempting best-effort %s "
+                    "(goodput loss %.1f chip-s) for %s",
+                    victim.tag, victim.goodput_loss, for_tag)
+        if self.metrics is not None:
+            self.metrics.incr("tpu_fleet_preemptions",
+                              labels={"reason": "goodput"})
+        self._span(now, action="preempt", kind=victim.kind,
+                   chips=victim.chips, tag=victim.tag, pool=victim.pool,
+                   generation=victim.generation,
+                   reason=f"preempted for {for_tag}; unsaved work "
+                          f"{victim.goodput_loss:.1f} chip-s was the "
+                          f"lowest in pool")
+        if self.preempt_fn is not None:
+            try:
+                self.preempt_fn(victim)
+            except Exception:  # noqa: BLE001 — eviction hooks must not kill placement
+                log.exception("fleet-scheduler: preempt_fn failed for %s",
+                              victim.tag)
+
+    def release(self, tag: str, reason: str = "released") -> bool:
+        """Free a reservation (pod deleted, gang finished). Unknown tags
+        are fine — release is the cleanup path and must be idempotent."""
+        now = self.clock()
+        with self._lock:
+            placement = self._placements.pop(tag, None)
+        self._token_baseline.pop(tag, None)
+        if placement is None:
+            return False
+        self._update_gauges()
+        self._span(now, action="release", kind=placement.kind,
+                   chips=placement.chips, tag=tag, pool=placement.pool,
+                   generation=placement.generation, reason=reason)
+        return True
+
+    def adopt(self, pods: list) -> int:
+        """Rebuild reservations from live pods' ``tpu.dev/pool``
+        annotations after a restart. A pod already placed is skipped
+        (idempotent), an unknown pool is logged and skipped (the operator
+        shrank the spec under running pods — don't guess). Returns the
+        number of placements adopted."""
+        from ..provider.annotations import Annotations as A
+        adopted = 0
+        now = self.clock()
+        for pod in pods or []:
+            meta = pod.get("metadata", {})
+            anns = meta.get("annotations", {}) or {}
+            pool_name = anns.get(A.POOL)
+            if not pool_name:
+                continue
+            tag = meta.get("name", "")
+            if pool_name not in self.pools:
+                log.warning("fleet-scheduler: pod %s names unknown pool "
+                            "%s; not adopting", tag, pool_name)
+                continue
+            kind = anns.get(A.POOL_KIND) or UNIFIED
+            if kind not in WORKLOAD_KINDS:
+                kind = UNIFIED
+            chips = _pod_chips(pod)
+            best_effort = (anns.get(A.BEST_EFFORT, "")
+                           .lower() in ("1", "true", "yes"))
+            with self._lock:
+                if tag in self._placements:
+                    continue
+                pool = self.pools[pool_name]
+                self._grant(kind, chips, tag, best_effort, pool,
+                            f"adopted from pod {tag} annotations "
+                            f"after restart", now)
+            adopted += 1
+            self._span(now, action="adopt", kind=kind, chips=chips,
+                       tag=tag, pool=pool_name,
+                       generation=self.pools[pool_name].generation)
+        if adopted:
+            self._update_gauges()
+            log.info("fleet-scheduler: adopted %d placement(s) from pod "
+                     "annotations", adopted)
+        return adopted
+
+    # -- telemetry refinement --------------------------------------------------
+
+    def observe_serving(self, pod_name: str, role: str, generation: str,
+                        stats, now: Optional[float] = None):
+        """Refine the serving columns from a replica heartbeat the
+        registry already receives: tokens/sec-per-chip from the
+        cumulative ``tokens_total`` counter's delta. Replicas the
+        scheduler didn't place (legacy fleets) still teach the matrix —
+        chips fall back to the autoscaler's per-replica default."""
+        tokens = int(getattr(stats, "tokens_total", 0) or 0)
+        if not pod_name or tokens <= 0:
+            return
+        now = self.clock() if now is None else now
+        kind = role if role in WORKLOAD_KINDS else UNIFIED
+        with self._lock:
+            placement = self._placements.get(pod_name)
+            chips = placement.chips if placement is not None \
+                else self.default_serving_chips
+            if placement is not None:
+                generation = placement.generation
+            baseline = self._token_baseline.get(pod_name)
+            self._token_baseline[pod_name] = (tokens, now)
+        if not generation:
+            return  # nothing to attribute the throughput to
+        if baseline is None:
+            return  # first sighting sets the baseline, not a rate
+        last_tokens, last_at = baseline
+        dt = now - last_at
+        if dt <= 0 or tokens < last_tokens:  # restart reset the counter
+            return
+        rate_per_chip = (tokens - last_tokens) / dt / max(1, chips)
+        if rate_per_chip > 0:
+            self.matrix.observe(kind, generation, rate_per_chip)
+
+    def observe_training(self, tag: str, generation: str = "",
+                         mfu: float = 0.0, goodput: float = 1.0,
+                         unsaved_work_s: Optional[float] = None):
+        """Refine the training column (+ the placement's preemption-cost
+        estimate) from the kubelet's existing TPU_TELEMETRY scrape.
+        ``unsaved_work_s`` is the ledger's productive time since the last
+        durable checkpoint — goodput-weighted and chip-scaled it becomes
+        the loss preemption would cause."""
+        with self._lock:
+            placement = self._placements.get(tag)
+            if placement is not None:
+                generation = placement.generation
+                if unsaved_work_s is not None:
+                    placement.goodput_loss = (max(0.0, unsaved_work_s)
+                                              * max(0.0, goodput)
+                                              * placement.chips)
+        if generation and mfu > 0:
+            spec = GENERATIONS[generation_of(generation)]
+            self.matrix.observe(TRAINING, generation,
+                                mfu * spec.peak_tflops_bf16)
+
+    # -- read surfaces ---------------------------------------------------------
+
+    def placements(self) -> list[Placement]:
+        with self._lock:
+            return sorted(self._placements.values(), key=lambda p: p.tag)
+
+    def rates(self) -> tuple[float, float]:
+        """(goodput rate, cost rate) of the CURRENT reservations:
+        effective throughput summed over placements, and $/hr burned.
+        Integrated over a trace this is the bench's goodput-per-dollar."""
+        with self._lock:
+            placements = list(self._placements.values())
+        goodput = sum(self.matrix.effective(p.kind, p.generation) * p.chips
+                      for p in placements)
+        cost = sum(GENERATIONS[p.generation].cost_per_chip_hr * p.chips
+                   for p in placements)
+        return goodput, cost
+
+    def snapshot(self) -> dict:
+        """The /debug/scheduler + fleet_summary surface."""
+        with self._lock:
+            placements = sorted(self._placements.values(),
+                                key=lambda p: p.tag)
+            pools = []
+            for name in self._pool_order:
+                pool = self.pools[name]
+                reserved = self._reserved(name)
+                pools.append({
+                    "pool": name, "generation": pool.generation,
+                    "total_chips": pool.total_chips,
+                    "reserved_chips": reserved,
+                    "free_chips": pool.total_chips - reserved,
+                    "cost_per_chip_hr": pool.spec.cost_per_chip_hr})
+        return {"policy": self.policy, "pools": pools,
+                "placements": [p.to_dict() for p in placements],
+                "matrix": self.matrix.snapshot()}
+
+    # -- plumbing --------------------------------------------------------------
+
+    def _update_gauges(self):
+        if self.metrics is None:
+            return
+        with self._lock:
+            per_pool = [(name, self.pools[name].total_chips,
+                         self._reserved(name))
+                        for name in self._pool_order]
+        for name, total, reserved in per_pool:
+            self.metrics.set_gauge("tpu_fleet_pool_chips", reserved,
+                                   labels={"pool": name,
+                                           "state": "reserved"})
+            self.metrics.set_gauge("tpu_fleet_pool_chips", total - reserved,
+                                   labels={"pool": name, "state": "free"})
+
+    def _span(self, now: float, action: str, kind: str, chips: int,
+              tag: str, pool: str = "", generation: str = "",
+              best_effort: bool = False, reason: str = ""):
+        if self.tracer is None:
+            return
+        self.tracer.record("fleet.schedule", now, now,
+                           attrs={"action": action, "kind": kind,
+                                  "chips": chips, "tag": tag,
+                                  "pool": pool, "generation": generation,
+                                  "best_effort": best_effort,
+                                  "reason": reason})
+
+
+def _pod_chips(pod: dict) -> int:
+    total = 0
+    for container in pod.get("spec", {}).get("containers", []):
+        limits = container.get("resources", {}).get("limits", {})
+        try:
+            total += int(limits.get("google.com/tpu", 0))
+        except (TypeError, ValueError):
+            pass
+    return max(1, total)

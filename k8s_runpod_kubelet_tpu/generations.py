@@ -1,0 +1,104 @@
+"""The per-generation TPU roofline + price table — ONE source of truth.
+
+Every layer that reasons about generations reads THIS module:
+
+- ``workloads/telemetry.py`` re-exports ``PEAK_TFLOPS_BF16`` /
+  ``generation_of`` for the training-side MFU math (back-compat names);
+- ``cloud/types.py`` prices its accelerator catalog from
+  ``cost_per_chip_hr`` here;
+- ``fleet/scheduler.py`` seeds its effective-throughput matrix from the
+  FLOPs and HBM-bandwidth columns (prefill is FLOPs-bound, decode is
+  HBM-bandwidth-bound — the disagg roofline split, ISSUE 9/19);
+- ``bench.py`` reports roofline fractions against the same numbers.
+
+PR 19 review history: PEAK_TFLOPS_BF16 used to live in telemetry.py with
+a drifting copy in bench.py — ``tests/test_generations.py`` now pins the
+dict literal to this module alone.
+
+Deliberately stdlib-only and import-light: the kubelet control plane and
+the router import it, neither may pull jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerationSpec:
+    """One TPU generation's public roofline + on-demand list price."""
+
+    name: str                 # catalog/node-label key ("v5e", not "v5litepod")
+    peak_tflops_bf16: float   # per chip, public spec sheets
+    peak_hbm_gbps: float      # per chip HBM bandwidth, GB/s
+    cost_per_chip_hr: float   # USD, on-demand list price
+
+    @property
+    def flops_per_dollar(self) -> float:
+        """TFLOP/s per $/hr — the prefill/training-side value ratio."""
+        return self.peak_tflops_bf16 / self.cost_per_chip_hr
+
+    @property
+    def hbm_gbps_per_dollar(self) -> float:
+        """HBM GB/s per $/hr — the decode-side value ratio."""
+        return self.peak_hbm_gbps / self.cost_per_chip_hr
+
+
+# Public spec-sheet rooflines and on-demand list prices. ``cpu`` is the
+# honest floor for local dev runs so MFU/placement math never divides by
+# zero (same convention the old telemetry table used).
+GENERATIONS = {
+    "v4": GenerationSpec("v4", peak_tflops_bf16=275.0,
+                         peak_hbm_gbps=1228.0, cost_per_chip_hr=3.22),
+    "v5e": GenerationSpec("v5e", peak_tflops_bf16=197.0,
+                          peak_hbm_gbps=819.0, cost_per_chip_hr=1.20),
+    "v5p": GenerationSpec("v5p", peak_tflops_bf16=459.0,
+                          peak_hbm_gbps=2765.0, cost_per_chip_hr=4.20),
+    "v6e": GenerationSpec("v6e", peak_tflops_bf16=918.0,
+                          peak_hbm_gbps=1640.0, cost_per_chip_hr=2.70),
+    "cpu": GenerationSpec("cpu", peak_tflops_bf16=0.1,
+                          peak_hbm_gbps=10.0, cost_per_chip_hr=0.01),
+}
+
+# the back-compat view telemetry/bench historically exposed
+PEAK_TFLOPS_BF16 = {name: spec.peak_tflops_bf16
+                    for name, spec in GENERATIONS.items()}
+
+_GENERATION_PREFIXES = (
+    ("v5litepod", "v5e"),
+    ("v5p", "v5p"),
+    ("v6e", "v6e"),
+    ("v4", "v4"),
+)
+
+
+def generation_of(accelerator_type: str) -> str:
+    """Accelerator-type name -> generation key of GENERATIONS
+    ("v5litepod-16" -> "v5e"). Unknown/empty -> "cpu" (local dev)."""
+    name = (accelerator_type or "").lower()
+    if name in GENERATIONS:
+        return name
+    for prefix, gen in _GENERATION_PREFIXES:
+        if name.startswith(prefix):
+            return gen
+    return "cpu"
+
+
+def spec_of(accelerator_type: str) -> GenerationSpec:
+    """Full roofline row for an accelerator type or generation name."""
+    return GENERATIONS[generation_of(accelerator_type)]
+
+
+def peak_tflops_per_chip(accelerator_type: str) -> float:
+    """Per-chip bf16 peak for an accelerator type or generation name."""
+    return spec_of(accelerator_type).peak_tflops_bf16
+
+
+def peak_hbm_gbps_per_chip(accelerator_type: str) -> float:
+    """Per-chip HBM bandwidth for an accelerator type or generation."""
+    return spec_of(accelerator_type).peak_hbm_gbps
+
+
+def cost_per_chip_hr(accelerator_type: str) -> float:
+    """On-demand list $/chip-hr for an accelerator type or generation."""
+    return spec_of(accelerator_type).cost_per_chip_hr

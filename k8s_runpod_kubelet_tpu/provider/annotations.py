@@ -28,6 +28,15 @@ class Annotations:
     # slice selection (replaces cloud-type/templateId/required-gpu-memory)
     ACCELERATOR_TYPE = "tpu.dev/accelerator-type"   # exact, e.g. v5litepod-16
     GENERATION = "tpu.dev/generation"               # e.g. v5e
+
+    # fleet scheduler placement (ISSUE 19): which node pool the fleet
+    # scheduler reserved for this pod. POOL pins slice selection to the
+    # pool's generation at gang launch; POOL_KIND + BEST_EFFORT let a
+    # restarted scheduler rebuild its reservation table from live pods
+    # (FleetScheduler.adopt) without double-placing or orphaning anything.
+    POOL = "tpu.dev/pool"
+    POOL_KIND = "tpu.dev/pool-kind"                 # prefill|decode|unified|training
+    BEST_EFFORT = "tpu.dev/best-effort"             # "true" => preemptible filler
     TOPOLOGY = "tpu.dev/topology"                   # e.g. 4x4
     RUNTIME_VERSION = "tpu.dev/runtime-version"
     CAPACITY_TYPE = "tpu.dev/capacity-type"         # on-demand | spot | reserved

@@ -42,7 +42,18 @@ def build_node(cfg: Config, *, cloud_healthy: bool = True,
     # max_total_chips uses 0-means-unset (config default); a LIVE quota of 0
     # is a real answer — a project with no chip grant yet must advertise 0,
     # not fall back to catalog capacity and bind pods that can never deploy.
-    bounds = [c for c in (cfg.max_total_chips or None, quota_chips)
+    # declared node pools (ISSUE 19): with fleet_pools set the node's chip
+    # capacity is the pools' SUM (bounded below by quota/config like any
+    # other ceiling) and each pool advertises itself as a label —
+    # tpu.dev/pool.<name>=<generation>:<chips> — so operators and the
+    # fleet scheduler see the same per-generation capacity split the
+    # scheduler places against.
+    pools = []
+    if cfg.fleet_pools:
+        from ..fleet.scheduler import parse_pools
+        pools = parse_pools(cfg.fleet_pools)
+    bounds = [c for c in (cfg.max_total_chips or None, quota_chips,
+                          sum(p.total_chips for p in pools) or None)
               if c is not None]
     max_chips = min(bounds) if bounds else \
         max(a.chips for a in ACCELERATOR_CATALOG.values())
@@ -80,21 +91,27 @@ def build_node(cfg: Config, *, cloud_healthy: bool = True,
         "google.com/tpu": str(max_chips),
     }
     allocatable = dict(capacity)  # scheduler subtracts bound pods itself
+    labels = {
+        "type": "virtual-kubelet",
+        "kubernetes.io/role": "agent",
+        "kubernetes.io/hostname": cfg.node_name,
+        "kubernetes.io/os": cfg.operating_system.lower(),
+        "node.kubernetes.io/instance-type": "cloud-tpu-slice",
+        "tpu.dev/generations": "_".join(generations),
+        "tpu.dev/default-generation": cfg.default_generation,
+        "tpu.dev/zone": cfg.zone,
+    }
+    for pool in pools:
+        # label VALUES may not contain ":", so generation and chips join
+        # with "-" (e.g. tpu.dev/pool.v5e=v5e-32)
+        labels[f"tpu.dev/pool.{pool.name}"] = \
+            f"{pool.generation}-{pool.total_chips}"
     return {
         "apiVersion": "v1",
         "kind": "Node",
         "metadata": {
             "name": cfg.node_name,
-            "labels": {
-                "type": "virtual-kubelet",
-                "kubernetes.io/role": "agent",
-                "kubernetes.io/hostname": cfg.node_name,
-                "kubernetes.io/os": cfg.operating_system.lower(),
-                "node.kubernetes.io/instance-type": "cloud-tpu-slice",
-                "tpu.dev/generations": "_".join(generations),
-                "tpu.dev/default-generation": cfg.default_generation,
-                "tpu.dev/zone": cfg.zone,
-            },
+            "labels": labels,
         },
         "spec": {
             "taints": taints,

@@ -163,6 +163,17 @@ class Provider(ReconcileMixin, RecoveryMixin, TrainingWatchMixin,
         self._breaker = getattr(tpu, "breaker", None)
         if self._breaker is not None:
             self._breaker.on_state_change = self._on_breaker_change
+        # fleet scheduler (ISSUE 19): with declared node pools the
+        # training watch feeds measured MFU + unsaved-work into the
+        # scheduler's throughput matrix / preemption-cost estimates.
+        # Embedding processes (router_main-in-kubelet setups, the soak)
+        # may inject a SHARED instance instead.
+        self.fleet_scheduler = None
+        if cfg.fleet_pools:
+            from ..fleet.scheduler import FleetScheduler
+            self.fleet_scheduler = FleetScheduler(
+                cfg.fleet_pools, metrics=self.metrics, tracer=self.tracer,
+                clock=clock)
         self._chip_quota: Optional[int] = None   # live cloud quota, if readable
         self._last_quota_probe = 0.0
         self._quota_probe_failing = False        # warn once per failure streak

@@ -123,6 +123,21 @@ class TrainingWatchMixin:
             log.info("pod %s training progress resumed at step %d", key, step)
             self.emit_event(pod, "TrainingProgressing",
                             f"step counter advancing again (step {step})")
+        # fleet scheduler refinement (ISSUE 19): the SAME scrape teaches
+        # the throughput matrix (measured MFU x roofline peak) and
+        # refreshes the placement's preemption cost — unsaved work since
+        # the last durable checkpoint (the ledger's telemetry field), so
+        # a capacity crunch evicts the gang with the least to lose
+        scheduler = getattr(self, "fleet_scheduler", None)
+        if scheduler is not None:
+            anns = pod.get("metadata", {}).get("annotations", {}) or {}
+            unsaved = payload.get("unsaved_work_s")
+            scheduler.observe_training(
+                pod.get("metadata", {}).get("name", key),
+                generation=anns.get(A.GENERATION, ""), mfu=mfu,
+                goodput=goodput,
+                unsaved_work_s=(float(unsaved)
+                                if unsaved is not None else None))
         self._annotate_training(key, pod, info, step, goodput, mfu)
 
     def _annotate_training(self, key: str, pod: dict, info, step: int,

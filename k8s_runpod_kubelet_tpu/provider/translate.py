@@ -221,7 +221,23 @@ def select_slice(pod: dict, resolver: AnnotationResolver, cfg: Config) -> Accele
         raise TranslationError(
             "pod requests no google.com/tpu chips and sets no "
             f"{A.ACCELERATOR_TYPE} annotation")
+    # fleet-scheduler placement (ISSUE 19): a tpu.dev/pool annotation pins
+    # the slice to the POOL's generation — the scheduler already paid for
+    # that hardware's goodput-per-dollar, so gang launch must not drift to
+    # default_generation (an explicit generation annotation, stamped by
+    # the same placement, agrees; a conflicting hand-set one loses).
     generation = resolver.get(A.GENERATION) or cfg.default_generation
+    pool_name = resolver.get(A.POOL)
+    if pool_name and cfg.fleet_pools:
+        from ..fleet.scheduler import parse_pools
+        for pool in parse_pools(cfg.fleet_pools):
+            if pool.name == pool_name:
+                generation = pool.generation
+                break
+        else:
+            raise TranslationError(
+                f"pod pinned to unknown pool {pool_name!r} "
+                f"(fleet_pools={cfg.fleet_pools!r})")
     topology = resolver.get(A.TOPOLOGY) or None
     min_hbm = resolver.get_int(A.MIN_HBM_GIB, 0) or None
     # the pod annotation may only LOWER the operator's ceiling, never raise it

@@ -2190,7 +2190,14 @@ def main(argv=None) -> int:
             pod_name=host,
             interval_s=args.fleet_heartbeat_interval,
             role=serving_role,
-            placement_domain=placement_domain).start()
+            placement_domain=placement_domain,
+            # mixed-fleet identity (ISSUE 19): the scheduler-aware pod
+            # scaler stamps these into the pod env at creation so the
+            # replica registers with the generation/pool its chips were
+            # reserved on — heartbeats then refine the right cell of the
+            # throughput matrix
+            generation=os.environ.get("TPU_SERVING_GENERATION", ""),
+            pool=os.environ.get("TPU_SERVING_POOL", "")).start()
         if base_cfg.fleet_prefix_directory_enabled:
             # publish-on-trie-insert (ISSUE 16): a fresh prefix key wakes
             # the reporter so the directory learns about it on the NEXT

@@ -40,36 +40,11 @@ log = logging.getLogger(__name__)
 
 # -- peak-FLOPs table ----------------------------------------------------------
 
-# bf16 peak TFLOP/s per chip by TPU generation (public spec sheets). Keyed by
-# the generation names the accelerator catalog (cloud/types.py) / node labels
-# (provider/node_spec.py ``tpu.dev/generations``) already use; ``cpu`` is the
-# honest floor for local runs so MFU never divides by zero.
-PEAK_TFLOPS_BF16 = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0,
-                    "cpu": 0.1}
-
-_GENERATION_PREFIXES = (
-    ("v5litepod", "v5e"),
-    ("v5p", "v5p"),
-    ("v6e", "v6e"),
-    ("v4", "v4"),
-)
-
-
-def generation_of(accelerator_type: str) -> str:
-    """Accelerator-type name -> generation key of PEAK_TFLOPS_BF16
-    ("v5litepod-16" -> "v5e"). Unknown/empty -> "cpu" (local dev)."""
-    name = (accelerator_type or "").lower()
-    if name in PEAK_TFLOPS_BF16:
-        return name
-    for prefix, gen in _GENERATION_PREFIXES:
-        if name.startswith(prefix):
-            return gen
-    return "cpu"
-
-
-def peak_tflops_per_chip(accelerator_type: str) -> float:
-    """Per-chip bf16 peak for an accelerator type or generation name."""
-    return PEAK_TFLOPS_BF16[generation_of(accelerator_type)]
+# The roofline table moved to the shared generations module (ISSUE 19) —
+# bench.py, cloud/types.py and fleet/scheduler.py read the SAME rows; the
+# names below stay importable from here for the training-side MFU math.
+from ..generations import (PEAK_TFLOPS_BF16, generation_of,  # noqa: F401
+                           peak_tflops_per_chip)
 
 
 # -- the line protocol ---------------------------------------------------------
@@ -881,6 +856,15 @@ class TrainingTelemetry:
                "attempt": self.attempt, "host": self.host_id,
                "stalled": bool(self.watchdog.flagged)
                if self.watchdog is not None else False}
+        # preemption-cost exposure (ISSUE 19): productive seconds since
+        # the last DURABLE checkpoint — what a preemption right now would
+        # destroy. Same number the crash-recovery state file records; the
+        # kubelet's scrape feeds it to the fleet scheduler, which evicts
+        # best-effort gangs lowest-loss-first.
+        with self._lock:
+            unsaved = (self.ledger.total("productive")
+                       - self._productive_at_ckpt)
+        out["unsaved_work_s"] = round(max(0.0, unsaved), 3)
         if self.dp_width:
             out["dp_width"] = self.dp_width
         if self.resize_attempt:

@@ -1,0 +1,431 @@
+"""Unit tests for the heterogeneity-aware fleet scheduler (ISSUE 19):
+pool-spec parsing, the throughput matrix's seed/EWMA/sibling-transfer
+ladder, goodput-per-dollar placement, best-effort packing + preemption
+ordering, idempotency, restart adoption, and the telemetry refinement
+hooks — plus the registry's generation/pool round-trip and the
+fleet_summary rendering of the new columns.
+"""
+
+from __future__ import annotations
+
+import types
+
+import pytest
+
+from k8s_runpod_kubelet_tpu.fleet.scheduler import (
+    DECODE, HETERO, PREFILL, ROUND_ROBIN, TRAINING, UNIFIED, FleetScheduler,
+    PoolSpecError, ThroughputMatrix, parse_pools)
+from k8s_runpod_kubelet_tpu.generations import GENERATIONS
+from k8s_runpod_kubelet_tpu.metrics import Metrics
+from k8s_runpod_kubelet_tpu.provider.annotations import Annotations as A
+from k8s_runpod_kubelet_tpu.tracing import Tracer
+
+from harness import FakeClock
+
+
+# -- pool spec parsing ---------------------------------------------------------
+
+def test_parse_pools():
+    pools = parse_pools("v5e:32, v5p:64")
+    assert [(p.name, p.generation, p.total_chips) for p in pools] == \
+        [("v5e", "v5e", 32), ("v5p", "v5p", 64)]
+    assert pools[0].spec is GENERATIONS["v5e"]
+
+
+def test_parse_pools_named():
+    pools = parse_pools("edge=v5e:16,bulk=v5e:64")
+    assert [(p.name, p.generation) for p in pools] == \
+        [("edge", "v5e"), ("bulk", "v5e")]
+
+
+@pytest.mark.parametrize("spec,msg", [
+    ("bogus:8", "unknown generation"),
+    ("v5e:eight", "not an int"),
+    ("v5e:0", "must be > 0"),
+    ("v5e:8,v5e:16", "duplicate pool name"),
+])
+def test_parse_pools_rejects(spec, msg):
+    with pytest.raises(PoolSpecError, match=msg):
+        parse_pools(spec)
+
+
+# -- throughput matrix ---------------------------------------------------------
+
+def test_matrix_roofline_seeds():
+    m = ThroughputMatrix()
+    v5e, v5p = GENERATIONS["v5e"], GENERATIONS["v5p"]
+    assert m.effective(PREFILL, "v5e") == v5e.peak_tflops_bf16
+    assert m.effective(DECODE, "v5p") == v5p.peak_hbm_gbps
+    assert m.effective(UNIFIED, "v5e") == pytest.approx(
+        (v5e.peak_tflops_bf16 * v5e.peak_hbm_gbps) ** 0.5)
+    assert m.effective(TRAINING, "v5p") == pytest.approx(
+        v5p.peak_tflops_bf16 * 0.4)
+    # accelerator-type names resolve through generation_of
+    assert m.effective(PREFILL, "v5litepod-16") == v5e.peak_tflops_bf16
+
+
+def test_matrix_ewma_refinement():
+    m = ThroughputMatrix(ewma_alpha=0.5)
+    m.observe(DECODE, "v5e", 100.0)
+    assert m.effective(DECODE, "v5e") == 100.0
+    m.observe(DECODE, "v5e", 200.0)
+    assert m.effective(DECODE, "v5e") == 150.0  # 100 + 0.5*(200-100)
+    # non-positive samples are dropped, other cells untouched
+    m.observe(DECODE, "v5e", 0.0)
+    assert m.effective(DECODE, "v5e") == 150.0
+    assert m.effective(PREFILL, "v5e") == GENERATIONS["v5e"].peak_tflops_bf16
+
+
+def test_matrix_sibling_transfer():
+    """An unmeasured generation borrows the best-measured sibling scaled
+    by roofline ratio — relative throughput transfers before absolute
+    numbers exist everywhere (Gavel's trick)."""
+    m = ThroughputMatrix()
+    m.observe(DECODE, "v5e", 500.0)
+    ratio = (GENERATIONS["v5p"].peak_hbm_gbps
+             / GENERATIONS["v5e"].peak_hbm_gbps)
+    assert m.effective(DECODE, "v5p") == pytest.approx(500.0 * ratio)
+    # the measured cell itself is untouched by the transfer
+    assert m.effective(DECODE, "v5e") == 500.0
+
+
+def test_matrix_snapshot_marks_measured():
+    m = ThroughputMatrix()
+    m.observe(PREFILL, "v5e", 42.0)
+    snap = m.snapshot()
+    assert snap[PREFILL]["v5e"] == {"eff": 42.0, "measured": True,
+                                    "samples": 1}
+    assert snap[DECODE]["v5e"]["measured"] is False
+
+
+# -- placement -----------------------------------------------------------------
+
+def make_scheduler(spec="v5e:32,v5p:64", **kw):
+    clock = kw.pop("clock", FakeClock())
+    kw.setdefault("metrics", Metrics())
+    return FleetScheduler(spec, clock=clock, **kw), clock
+
+
+def test_prefill_lands_on_flops_per_dollar_pool():
+    """v5e wins prefill per-dollar (197/1.2 = 164 vs 459/4.2 = 109); the
+    reason cites the ranking for the scale-event log."""
+    s, _ = make_scheduler()
+    p = s.place(PREFILL, 8, "prefill-0")
+    assert p.pool == "v5e" and p.generation == "v5e"
+    assert "per-dollar ranking" in p.reason
+    assert "->v5e" in p.reason
+
+
+def test_decode_prefers_bandwidth_pool_under_contention():
+    """decode per-dollar: v5e 819/1.2 = 682 vs v5p 2765/4.2 = 658 — v5e
+    wins narrowly with free chips, but once v5e is full decode spills to
+    the bandwidth-rich pool instead of failing."""
+    s, _ = make_scheduler()
+    first = s.place(DECODE, 32, "decode-0")
+    assert first.pool == "v5e"
+    second = s.place(DECODE, 8, "decode-1")
+    assert second.pool == "v5p"
+
+
+def test_measured_throughput_flips_placement():
+    """Online refinement overrides the roofline seed: measured decode
+    tokens/sec-per-chip showing v5p 4x better per-chip makes it the
+    per-dollar winner too."""
+    s, _ = make_scheduler()
+    s.matrix.observe(DECODE, "v5e", 100.0)
+    s.matrix.observe(DECODE, "v5p", 400.0)
+    assert s.place(DECODE, 8, "d0").pool == "v5p"
+
+
+def test_place_is_idempotent_by_tag():
+    s, _ = make_scheduler()
+    a = s.place(PREFILL, 8, "pod-1")
+    b = s.place(PREFILL, 8, "pod-1")
+    assert a is b
+    assert s.free_chips("v5e") == 32 - 8
+
+
+def test_place_validates_inputs():
+    s, _ = make_scheduler()
+    with pytest.raises(ValueError):
+        s.place("mystery", 8, "t")
+    with pytest.raises(ValueError):
+        s.place(PREFILL, 0, "t")
+    with pytest.raises(ValueError):
+        s.place(PREFILL, 8, "")
+
+
+def test_capacity_exhaustion_returns_none_and_counts():
+    s, _ = make_scheduler("v5e:8")
+    m = s.metrics
+    assert s.place(PREFILL, 8, "a") is not None
+    assert s.place(PREFILL, 8, "b") is None
+    assert m.get_counter("tpu_fleet_pool_rejections",
+                         labels={"kind": PREFILL}) == 1
+    # the reservation survives; release frees it for the retry
+    assert s.release("a") is True
+    assert s.place(PREFILL, 8, "b") is not None
+
+
+def test_release_is_idempotent():
+    s, _ = make_scheduler()
+    s.place(PREFILL, 8, "a")
+    assert s.release("a") is True
+    assert s.release("a") is False
+    assert s.release("never-existed") is False
+    assert s.free_chips("v5e") == 32
+
+
+def test_best_effort_packs_and_never_preempts():
+    s, _ = make_scheduler("v5e:16")
+    s.place(UNIFIED, 8, "serving-0")
+    # best-effort training packs onto the idle half
+    be = s.place(TRAINING, 8, "be-0", best_effort=True)
+    assert be is not None and be.best_effort
+    # a second best-effort request can't preempt the first
+    assert s.place(TRAINING, 8, "be-1", best_effort=True) is None
+
+
+def test_preemption_lowest_goodput_loss_first():
+    """Under crunch the victims leave lowest-unsaved-work-first; the
+    preempt_fn sees each victim, the counter and placement both record
+    it."""
+    evicted = []
+    s, _ = make_scheduler("v5e:32", preempt_fn=lambda p: evicted.append(p.tag))
+    s.place(TRAINING, 8, "be-a", best_effort=True)
+    s.place(TRAINING, 8, "be-b", best_effort=True)
+    s.place(TRAINING, 8, "be-c", best_effort=True)
+    # unsaved work: be-b cheapest, then be-c, then be-a
+    s.observe_training("be-a", goodput=1.0, unsaved_work_s=300.0)
+    s.observe_training("be-b", goodput=0.5, unsaved_work_s=10.0)
+    s.observe_training("be-c", goodput=1.0, unsaved_work_s=60.0)
+    # 16 chips wanted, 8 free -> exactly one victim needed: the cheapest
+    p = s.place(UNIFIED, 16, "serving-big")
+    assert p is not None and p.pool == "v5e"
+    assert evicted == ["be-b"]
+    assert s.metrics.get_counter("tpu_fleet_preemptions",
+                                 labels={"reason": "goodput"}) == 1
+    tags = {pl.tag for pl in s.placements()}
+    assert tags == {"be-a", "be-c", "serving-big"}
+    # needing more evicts the next-cheapest too (be-c before be-a)
+    evicted.clear()
+    assert s.place(UNIFIED, 8, "serving-2") is not None
+    assert evicted == ["be-c"]
+
+
+def test_preempt_fn_failure_does_not_kill_placement():
+    def boom(placement):
+        raise RuntimeError("evictor crashed")
+    s, _ = make_scheduler("v5e:8", preempt_fn=boom)
+    s.place(TRAINING, 8, "be-0", best_effort=True)
+    assert s.place(UNIFIED, 8, "serving-0") is not None
+
+
+def test_round_robin_ignores_scores():
+    s, _ = make_scheduler(policy=ROUND_ROBIN)
+    pools = [s.place(UNIFIED, 8, f"p{i}").pool for i in range(4)]
+    assert pools == ["v5e", "v5p", "v5e", "v5p"]
+    for r in (s.place(UNIFIED, 8, f"p{i}").reason for i in range(4, 6)):
+        assert "round-robin" in r
+
+
+def _gauge(m, name, **labels):
+    return m.gauges[m._key(name, labels)]
+
+
+def test_gauges_track_chip_states():
+    s, _ = make_scheduler()
+    s.place(PREFILL, 8, "a")
+    m = s.metrics
+    assert _gauge(m, "tpu_fleet_pool_chips", pool="v5e", state="reserved") == 8
+    assert _gauge(m, "tpu_fleet_pool_chips", pool="v5e", state="free") == 24
+    s.release("a")
+    assert _gauge(m, "tpu_fleet_pool_chips", pool="v5e", state="reserved") == 0
+
+
+def test_spans_cover_place_preempt_release():
+    clock = FakeClock()
+    tracer = Tracer(clock=clock)
+    s = FleetScheduler("v5e:8", tracer=tracer, clock=clock)
+    s.place(TRAINING, 8, "be-0", best_effort=True)
+    s.place(UNIFIED, 8, "serving-0")     # preempts be-0
+    s.release("serving-0")
+    s.place(UNIFIED, 16, "too-big")      # no pool fits -> no_capacity
+    actions = [sp["attrs"]["action"] for sp in tracer.recent()
+               if sp["name"] == "fleet.schedule"]
+    assert actions == ["place", "preempt", "place", "release", "no_capacity"]
+
+
+# -- restart adoption ----------------------------------------------------------
+
+def _pod(name, pool, kind=UNIFIED, chips=8, best_effort=False, extra=None):
+    anns = {A.POOL: pool, A.POOL_KIND: kind}
+    if best_effort:
+        anns[A.BEST_EFFORT] = "true"
+    anns.update(extra or {})
+    return {"metadata": {"name": name, "annotations": anns},
+            "spec": {"containers": [{"resources": {
+                "limits": {"google.com/tpu": str(chips)}}}]}}
+
+
+def test_adopt_rebuilds_reservations():
+    s, _ = make_scheduler()
+    n = s.adopt([_pod("pod-a", "v5e", PREFILL, chips=16),
+                 _pod("pod-b", "v5p", TRAINING, chips=8, best_effort=True)])
+    assert n == 2
+    assert s.free_chips("v5e") == 16 and s.free_chips("v5p") == 56
+    by_tag = {p.tag: p for p in s.placements()}
+    assert by_tag["pod-a"].kind == PREFILL
+    assert by_tag["pod-b"].best_effort is True
+    # idempotent: a second adopt (or an adopt after place) changes nothing
+    assert s.adopt([_pod("pod-a", "v5e", PREFILL, chips=16)]) == 0
+    assert s.free_chips("v5e") == 16
+
+
+def test_adopt_skips_unknown_pools_and_unannotated_pods():
+    s, _ = make_scheduler()
+    pods = [_pod("ghost", "retired-pool"),
+            {"metadata": {"name": "legacy", "annotations": {}}, "spec": {}}]
+    assert s.adopt(pods) == 0
+    assert s.placements() == []
+
+
+# -- telemetry refinement ------------------------------------------------------
+
+class _Stats:
+    def __init__(self, tokens_total):
+        self.tokens_total = tokens_total
+
+
+def test_observe_serving_learns_tokens_per_chip():
+    s, clock = make_scheduler()
+    s.place(DECODE, 8, "pod-1")
+    s.observe_serving("pod-1", DECODE, "", _Stats(1000))   # baseline only
+    assert s.matrix.snapshot()[DECODE]["v5e"]["measured"] is False
+    clock.advance(10.0)
+    s.observe_serving("pod-1", DECODE, "", _Stats(1800))
+    # (1800-1000)/10s/8 chips = 10 tokens/s/chip; generation comes from
+    # the placement, not the (empty) heartbeat field
+    assert s.matrix.effective(DECODE, "v5e") == pytest.approx(10.0)
+
+
+def test_observe_serving_unplaced_replica_uses_default_chips():
+    s, clock = make_scheduler(default_serving_chips=4)
+    s.observe_serving("legacy-pod", DECODE, "v5p", _Stats(100))
+    clock.advance(5.0)
+    s.observe_serving("legacy-pod", DECODE, "v5p", _Stats(300))
+    assert s.matrix.effective(DECODE, "v5p") == pytest.approx(10.0)
+
+
+def test_observe_serving_counter_reset_is_ignored():
+    s, clock = make_scheduler()
+    s.place(DECODE, 8, "pod-1")
+    s.observe_serving("pod-1", DECODE, "", _Stats(1000))
+    clock.advance(5.0)
+    s.observe_serving("pod-1", DECODE, "", _Stats(200))  # engine restarted
+    assert s.matrix.snapshot()[DECODE]["v5e"]["measured"] is False
+    clock.advance(5.0)
+    s.observe_serving("pod-1", DECODE, "", _Stats(600))  # new baseline works
+    assert s.matrix.effective(DECODE, "v5e") == pytest.approx(10.0)
+
+
+def test_observe_training_updates_loss_and_matrix():
+    s, _ = make_scheduler()
+    s.place(TRAINING, 16, "gang-0", best_effort=True)
+    s.observe_training("gang-0", mfu=0.5, goodput=0.9, unsaved_work_s=100.0)
+    p = s.placements()[0]
+    assert p.goodput_loss == pytest.approx(100.0 * 0.9 * 16)
+    assert s.matrix.effective(TRAINING, "v5e") == pytest.approx(
+        0.5 * GENERATIONS["v5e"].peak_tflops_bf16)
+
+
+def test_rates_and_snapshot():
+    s, _ = make_scheduler()
+    s.place(PREFILL, 8, "a")
+    goodput, cost = s.rates()
+    assert goodput == pytest.approx(
+        GENERATIONS["v5e"].peak_tflops_bf16 * 8)
+    assert cost == pytest.approx(GENERATIONS["v5e"].cost_per_chip_hr * 8)
+    snap = s.snapshot()
+    assert snap["policy"] == HETERO
+    assert snap["pools"][0] == {
+        "pool": "v5e", "generation": "v5e", "total_chips": 32,
+        "reserved_chips": 8, "free_chips": 24, "cost_per_chip_hr": 1.2}
+    assert snap["placements"][0]["tag"] == "a"
+    assert PREFILL in snap["matrix"]
+
+
+# -- registry round-trip (satellite: generation/pool through heartbeats) -------
+
+def make_registry(scheduler=None):
+    from k8s_runpod_kubelet_tpu.fleet.registry import ReplicaRegistry
+    clock = FakeClock()
+    return ReplicaRegistry(metrics=Metrics(), clock=clock,
+                           transport_factory=lambda url:
+                           types.SimpleNamespace(breaker=None),
+                           scheduler=scheduler), clock
+
+
+def test_registry_generation_pool_round_trip():
+    reg, _ = make_registry()
+    reg.register("rep-1", "http://r1", pod_name="pod-1", role="decode",
+                 generation="v5p", pool="bulk")
+    rep = reg.get("rep-1")
+    assert rep.generation == "v5p" and rep.pool == "bulk"
+    d = rep.to_dict(now=0.0)
+    assert d["generation"] == "v5p" and d["pool"] == "bulk"
+    # the /debug/fleet surface groups node pools
+    snap = reg.snapshot()
+    assert snap["node_pools"] == {"bulk": 1}
+    assert snap["replicas"][0]["generation"] == "v5p"
+
+
+def test_registry_heartbeat_feeds_scheduler_matrix():
+    scheduler, sched_clock = make_scheduler()
+    reg, clock = make_registry(scheduler=scheduler)
+    scheduler.clock = clock  # one clock for baselines and heartbeats
+    reg.register("rep-1", "http://r1", pod_name="pod-1", role="decode",
+                 generation="v5e", pool="v5e")
+    reg.heartbeat("rep-1", {"tokens_total": 1000})
+    clock.advance(10.0)
+    reg.heartbeat("rep-1", {"tokens_total": 1800})
+    # default_serving_chips=8: (800/10)/8 = 10 tokens/s/chip on v5e
+    assert scheduler.matrix.effective("decode", "v5e") == pytest.approx(10.0)
+
+
+# -- fleet_summary rendering ---------------------------------------------------
+
+def test_fleet_summary_renders_pool_columns(tmp_path):
+    import json
+    import sys
+    sys.path.insert(0, str((tmp_path / "_nothing")))  # keep sys.path shape
+    from tools.fleet_summary import render
+
+    snap = {
+        "replicas": [{
+            "replica_id": "rep-1", "state": "ready", "role": "decode",
+            "generation": "v5p", "pool": "bulk", "heartbeat_age_s": 1.0,
+            "stats": {"active_slots": 1, "max_slots": 4, "queue_depth": 0,
+                      "kv_cache_tokens": 10, "ttft_p95_s": 0.1,
+                      "itl_p95_s": 0.01}}],
+        "scheduler": {
+            "policy": "hetero",
+            "pools": [{"pool": "bulk", "generation": "v5p",
+                       "total_chips": 64, "reserved_chips": 8,
+                       "free_chips": 56, "cost_per_chip_hr": 4.2}],
+            "placements": [{"tag": "pod-1", "kind": "decode",
+                            "pool": "bulk", "chips": 8,
+                            "best_effort": False, "goodput_loss": 0.0,
+                            "reason": "x"}],
+            "matrix": {"decode": {"v5p": {"eff": 2765.0, "measured": False,
+                                          "samples": 0}}}},
+    }
+    path = tmp_path / "fleet.jsonl"
+    path.write_text(json.dumps(snap) + "\n", encoding="utf-8")
+    from tools.fleet_summary import load
+    spans, snapshots = load(str(path))
+    out = render(spans, snapshots)
+    assert "v5p" in out and "bulk" in out
+    assert "node pools (scheduler snapshot" in out
+    assert "pod-1" in out
+    assert "effective throughput" in out

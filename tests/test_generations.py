@@ -1,0 +1,102 @@
+"""The generations table is the ONE source of truth (ISSUE 19).
+
+PEAK_TFLOPS_BF16 historically lived in workloads/telemetry.py with a
+drifting copy in bench.py; the roofline + price table now lives in
+k8s_runpod_kubelet_tpu/generations.py and every consumer — telemetry's
+MFU math, bench's roofline fractions, the cloud catalog's prices, the
+fleet scheduler's matrix seeds — must import THAT object, not carry a
+literal of its own.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+
+import pytest
+
+from k8s_runpod_kubelet_tpu import generations as G
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_table_is_complete_and_priced():
+    assert set(G.GENERATIONS) == {"v4", "v5e", "v5p", "v6e", "cpu"}
+    for name, spec in G.GENERATIONS.items():
+        assert spec.name == name
+        assert spec.peak_tflops_bf16 > 0
+        assert spec.peak_hbm_gbps > 0
+        assert spec.cost_per_chip_hr > 0
+        # the ratios placement divides by must be finite and positive
+        assert spec.flops_per_dollar > 0
+        assert spec.hbm_gbps_per_dollar > 0
+
+
+def test_backcompat_view_mirrors_table():
+    assert G.PEAK_TFLOPS_BF16 == {
+        n: s.peak_tflops_bf16 for n, s in G.GENERATIONS.items()}
+
+
+@pytest.mark.parametrize("acc,gen", [
+    ("v5litepod-16", "v5e"), ("v5p-128", "v5p"), ("v6e-8", "v6e"),
+    ("v4-32", "v4"), ("v5e", "v5e"), ("", "cpu"), ("weird-9000", "cpu"),
+])
+def test_generation_of(acc, gen):
+    assert G.generation_of(acc) == gen
+    assert G.spec_of(acc) is G.GENERATIONS[gen]
+    assert G.peak_tflops_per_chip(acc) == G.GENERATIONS[gen].peak_tflops_bf16
+    assert G.peak_hbm_gbps_per_chip(acc) == G.GENERATIONS[gen].peak_hbm_gbps
+    assert G.cost_per_chip_hr(acc) == G.GENERATIONS[gen].cost_per_chip_hr
+
+
+def test_consumers_import_the_shared_table():
+    """telemetry, bench and the cloud catalog read generations.py."""
+    from k8s_runpod_kubelet_tpu.workloads import telemetry
+    assert telemetry.PEAK_TFLOPS_BF16 is G.PEAK_TFLOPS_BF16
+    assert telemetry.generation_of is G.generation_of
+
+    from k8s_runpod_kubelet_tpu.cloud.types import ACCELERATOR_CATALOG
+    for acc in ACCELERATOR_CATALOG.values():
+        # every catalog row of one generation carries the table's price
+        assert acc.cost_per_chip_hr == \
+            G.GENERATIONS[acc.generation].cost_per_chip_hr
+
+    from k8s_runpod_kubelet_tpu.fleet.scheduler import ThroughputMatrix
+    assert ThroughputMatrix.roofline("prefill", "v5p") == \
+        G.GENERATIONS["v5p"].peak_tflops_bf16
+    assert ThroughputMatrix.roofline("decode", "v5e") == \
+        G.GENERATIONS["v5e"].peak_hbm_gbps
+
+
+def _peak_dict_literals(path: pathlib.Path) -> list:
+    """Dict literals that look like a private copy of the peak table:
+    string keys naming TPU generations mapped to number literals."""
+    tree = ast.parse(path.read_text(encoding="utf-8"))
+    hits = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        keys = {k.value for k in node.keys
+                if isinstance(k, ast.Constant) and isinstance(k.value, str)}
+        numeric = all(isinstance(v, ast.Constant)
+                      and isinstance(v.value, (int, float))
+                      for v in node.values) and node.values
+        if numeric and {"v5e", "v5p"} <= keys:
+            hits.append(node.lineno)
+    return hits
+
+
+@pytest.mark.parametrize("rel", [
+    "bench.py",
+    "k8s_runpod_kubelet_tpu/workloads/telemetry.py",
+    "k8s_runpod_kubelet_tpu/cloud/types.py",
+    "k8s_runpod_kubelet_tpu/fleet/scheduler.py",
+])
+def test_no_drifting_copies(rel):
+    """No consumer re-declares a generation->number dict literal — the
+    drift bug this module exists to kill."""
+    path = REPO / rel
+    hits = _peak_dict_literals(path)
+    assert not hits, (f"{rel}:{hits} re-declares a per-generation number "
+                      f"table; import k8s_runpod_kubelet_tpu.generations "
+                      f"instead")

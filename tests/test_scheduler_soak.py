@@ -1,0 +1,363 @@
+"""Deterministic scheduler soak (ISSUE 19 acceptance): mixed v5e/v5p
+pools on ONE FakeClock with a seeded FaultPlan killing a replica
+mid-run. What convergence means here:
+
+- every serving scale-up requests capacity THROUGH the scheduler —
+  place-then-create — and the fleet.scale reason cites the pool choice
+  (the per-dollar ranking), never a bare pod create;
+- placement starts roofline-seeded and is REFINED by measured
+  tokens/sec-per-chip flowing through the registry's ordinary
+  heartbeats (no new wire protocol);
+- best-effort training packs onto idle chips and is preempted
+  lowest-goodput-loss-first when a non-best-effort request hits a full
+  pool;
+- a control-plane restart mid-placement neither double-places the
+  pending pod's demand nor orphan-reaps the pod (adopt() rebuilds the
+  table from tpu.dev/pool annotations);
+- zero leaked reservations at the end: scheduler chips == live fleet
+  pods' chips, bijectively;
+- the hetero policy STRICTLY beats round-robin on goodput-per-dollar
+  over the same seeded trace.
+
+The seed is embedded in assertion messages for replay.
+"""
+
+from __future__ import annotations
+
+import json
+
+from k8s_runpod_kubelet_tpu.cloud.faults import (PREEMPTION_STORM, FaultPlan,
+                                                 FaultWindow)
+from k8s_runpod_kubelet_tpu.fleet.autoscaler import (AutoscalerConfig,
+                                                     FleetAutoscaler,
+                                                     KubePodScaler)
+from k8s_runpod_kubelet_tpu.fleet.registry import ReplicaRegistry
+from k8s_runpod_kubelet_tpu.fleet.scheduler import (DECODE, HETERO,
+                                                    ROUND_ROBIN, TRAINING,
+                                                    FleetScheduler)
+from k8s_runpod_kubelet_tpu.kube import FakeKubeClient
+from k8s_runpod_kubelet_tpu.metrics import Metrics
+from k8s_runpod_kubelet_tpu.provider.annotations import Annotations as A
+from k8s_runpod_kubelet_tpu.tracing import Tracer
+
+from harness import FakeClock
+
+SEED = 19
+POOLS = "v5e:32,v5p:64"
+# the seeded storm window (sim seconds): exactly one replica dies in it.
+# It opens in the CALM phase (after the t=40 capacity crunch) so the
+# kill exercises evict -> orphan-reap -> release without the replacement
+# scale-up racing the crunch for the same chips.
+KILL_WINDOW = FaultWindow(PREEMPTION_STORM, 56.0, 60.0, 1.0)
+# measured decode tokens/sec-per-chip the fake replicas report, by
+# generation: v5p really is ~3x better per chip here, which keeps it the
+# per-dollar decode winner once measurements replace the roofline seeds
+TOKENS_PER_CHIP_S = {"v5e": 40.0, "v5p": 120.0}
+
+
+def _ctx(what: str, plan=None) -> str:
+    msg = f"[scheduler seed={SEED}] {what}"
+    if plan is not None:
+        msg += "\n" + plan.describe()
+    return msg
+
+
+class Soak:
+    """One control plane: registry + scheduler + autoscaler sharing a
+    FakeClock and a FakeKubeClient. Replicas are simulated as registry
+    entries whose heartbeats carry a deterministic tokens_total ramp."""
+
+    def __init__(self, policy=HETERO):
+        self.clock = FakeClock()
+        self.kube = FakeKubeClient()
+        self.metrics = Metrics()
+        self.tracer = Tracer(clock=self.clock)
+        self.preempted: list = []
+        self.scheduler = FleetScheduler(
+            POOLS, metrics=self.metrics, tracer=self.tracer,
+            clock=self.clock, policy=policy,
+            preempt_fn=lambda p: self.preempted.append(p.tag),
+            default_serving_chips=8)
+        self.killed: set[str] = set()
+        self.registry = ReplicaRegistry(
+            metrics=self.metrics, tracer=self.tracer, clock=self.clock,
+            heartbeat_timeout_s=8.0,
+            probe_fn=lambda rep: rep.replica_id not in self.killed,
+            scheduler=self.scheduler)
+        self.scaler = KubePodScaler(self.kube, "virtual-tpu", chips=8,
+                                    role=DECODE)
+        self.autoscaler = self.make_autoscaler()
+        self.tokens: dict[str, float] = {}  # replica -> cumulative tokens
+
+    def make_autoscaler(self) -> FleetAutoscaler:
+        return FleetAutoscaler(
+            self.registry, self.scaler,
+            AutoscalerConfig(min_replicas=1, max_replicas=8, role=DECODE,
+                             itl_slo_s=0.2, target_queue_per_replica=4.0,
+                             scale_up_stable_s=2.0, scale_down_stable_s=30.0,
+                             scale_up_cooldown_s=3.0,
+                             scale_down_cooldown_s=30.0,
+                             drain_timeout_s=30.0, boot_timeout_s=15.0),
+            metrics=self.metrics, tracer=self.tracer, clock=self.clock,
+            scheduler=self.scheduler)
+
+    # -- simulated serving pods ------------------------------------------------
+
+    def fleet_pods(self) -> list[dict]:
+        return self.scaler.list_fleet_pod_objects()
+
+    def boot_replicas(self):
+        """A Running fleet pod whose replica hasn't registered yet
+        registers now — what serve_main --fleet-router does on start,
+        generation/pool from the env the scaler stamped."""
+        registered = self.registry.registered_pod_names()
+        for pod in self.fleet_pods():
+            name = pod["metadata"]["name"]
+            if name in registered or f"rep-{name}" in self.killed:
+                continue  # a storm-killed pod stays dead until reaped
+            env = {e["name"]: e["value"]
+                   for c in pod["spec"]["containers"]
+                   for e in c.get("env", [])}
+            self.registry.register(
+                f"rep-{name}", f"http://fake/{name}", pod_name=name,
+                role=DECODE, generation=env.get("TPU_SERVING_GENERATION", ""),
+                pool=env.get("TPU_SERVING_POOL", ""))
+            self.tokens.setdefault(f"rep-{name}", 0.0)
+
+    def heartbeat_all(self, busy: bool):
+        """Each live replica's beat: an ITL over/under the SLO (the
+        scale-up signal) and the cumulative token counter advancing at
+        the generation's true rate — the matrix-refinement signal."""
+        for rep in self.registry.live():
+            if rep.replica_id in self.killed:
+                continue
+            rate = TOKENS_PER_CHIP_S.get(rep.generation, 10.0) * 8
+            self.tokens[rep.replica_id] = \
+                self.tokens.get(rep.replica_id, 0.0) + rate
+            stats = {"active_slots": 4 if busy else 1, "max_slots": 4,
+                     "queue_depth": 8 if busy else 0,
+                     "itl_p95_s": 0.5 if busy else 0.05,
+                     "tokens_total": int(self.tokens[rep.replica_id])}
+            self.registry.heartbeat(rep.replica_id, stats)
+
+    def tick(self, busy: bool):
+        self.clock.advance(1.0)
+        self.boot_replicas()
+        self.heartbeat_all(busy=busy)
+        self.registry.sweep()
+        self.autoscaler.tick()
+
+    def reserved_total(self) -> int:
+        return sum(p.chips for p in self.scheduler.placements())
+
+
+def drive(s: Soak, plan: FaultPlan, ticks: int = 90) -> None:
+    """The shared trace: sustained overload (scale-ups), best-effort
+    training packed at t=30, a capacity crunch at t=40 (training gang
+    demanding more than any pool has free -> preemption), a seeded
+    replica kill, then calm."""
+    for t in range(1, ticks + 1):
+        busy = t < 55
+        s.tick(busy=busy)
+
+        if t == 30:
+            # the training packer drops best-effort fillers onto idle
+            # chips (directly via place(): training doesn't ride the
+            # serving autoscaler)
+            for i, unsaved in enumerate((120.0, 5.0, 60.0)):
+                p = s.scheduler.place(TRAINING, 16, f"be-{i}",
+                                      best_effort=True)
+                if p is not None:
+                    s.scheduler.observe_training(
+                        f"be-{i}", mfu=0.35, goodput=1.0,
+                        unsaved_work_s=unsaved)
+
+        if t == 40:
+            # capacity crunch: a guaranteed training gang wants 32 chips
+            # — no pool has that free, so best-effort dies cheapest-first
+            s.scheduler.place(TRAINING, 32, "gang-prod")
+
+        victims = plan.preempt_victims(
+            sorted(r.replica_id for r in s.registry.live()
+                   if r.replica_id not in s.killed))
+        if victims and not s.killed:
+            s.killed.add(victims[0])
+
+
+def test_scheduler_soak_tier1():
+    s = Soak()
+    plan = FaultPlan(SEED, s.clock, horizon_s=120.0, windows=[KILL_WINDOW])
+    drive(s, plan)
+
+    # -- every scale-up went through the scheduler and cites its choice
+    scale_ups = [sp for sp in s.tracer.recent(2048)
+                 if sp["name"] == "fleet.scale"
+                 and sp["attrs"]["direction"] == "up"]
+    assert scale_ups, _ctx("no scale-ups happened", plan)
+    for sp in scale_ups:
+        assert "per-dollar ranking" in sp["attrs"]["reason"], \
+            _ctx(f"scale-up did not cite pool choice: {sp['attrs']}", plan)
+
+    # -- placement was refined by measured throughput: the matrix holds
+    # measured decode cells near the scripted per-chip rates
+    snap = s.scheduler.matrix.snapshot()
+    for gen, rate in TOKENS_PER_CHIP_S.items():
+        cell = snap["decode"][gen]
+        if cell["measured"]:
+            assert abs(cell["eff"] - rate) < rate * 0.5, \
+                _ctx(f"measured decode[{gen}] drifted: {cell}", plan)
+    assert any(snap["decode"][g]["measured"] for g in TOKENS_PER_CHIP_S), \
+        _ctx(f"heartbeats never taught the matrix: {snap['decode']}", plan)
+
+    # -- the crunch preempted best-effort work, cheapest unsaved first
+    assert s.preempted and s.preempted[0] == "be-1", \
+        _ctx(f"preemption order wrong: {s.preempted}", plan)
+    assert s.metrics.get_counter("tpu_fleet_preemptions",
+                                 labels={"reason": "goodput"}) >= 1
+    assert any(p.tag == "gang-prod" for p in s.scheduler.placements()), \
+        _ctx("the guaranteed gang never got its chips", plan)
+
+    # -- the seeded kill converged: the replica was evicted
+    assert s.killed, _ctx("the storm never killed a replica", plan)
+    live_ids = {r.replica_id for r in s.registry.live()}
+    assert not (s.killed & live_ids), \
+        _ctx(f"killed replica still registered: {s.killed & live_ids}", plan)
+
+    # -- zero leaked reservations: serving placements == live fleet pods,
+    # bijectively, and chip accounting agrees
+    pod_names = {p["metadata"]["name"] for p in s.fleet_pods()}
+    serving_tags = {p.tag for p in s.scheduler.placements()
+                    if p.kind == DECODE}
+    assert serving_tags == pod_names, \
+        _ctx(f"placements {serving_tags} != pods {pod_names}", plan)
+    for pool in ("v5e", "v5p"):
+        assert s.scheduler.free_chips(pool) >= 0
+    assert s.reserved_total() == 8 * len(pod_names) + sum(
+        p.chips for p in s.scheduler.placements() if p.kind == TRAINING), \
+        _ctx("chip accounting drifted", plan)
+
+
+def test_restart_mid_placement_no_double_place_no_orphan():
+    """Kill the control plane between place+create and its pod's replica
+    registration: the successor adopts the reservation from the pod's
+    annotations, counts the pod toward fleet size (no double-place for
+    the same demand), and does NOT orphan-reap it within the boot
+    grace."""
+    s = Soak()
+    # drive to the first scale-up, stopping BEFORE its replica boots
+    for _ in range(6):
+        s.clock.advance(1.0)
+        s.heartbeat_all(busy=True)
+        s.autoscaler.tick()
+    pods = s.fleet_pods()
+    assert len(pods) == 1, _ctx(f"expected 1 pending pod, got {len(pods)}")
+    pod = pods[0]
+    name = pod["metadata"]["name"]
+    assert pod["metadata"]["annotations"][A.POOL], \
+        _ctx("pod lacks its durable pool annotation")
+    placed_before = {p.tag: (p.pool, p.chips)
+                     for p in s.scheduler.placements()}
+
+    # the restart: fresh scheduler + autoscaler over the same cluster
+    s.scheduler = FleetScheduler(
+        POOLS, metrics=Metrics(), clock=s.clock,
+        default_serving_chips=8)
+    s.registry.scheduler = s.scheduler
+    s.autoscaler = s.make_autoscaler()
+    s.clock.advance(1.0)
+    s.heartbeat_all(busy=True)
+    s.autoscaler.tick()
+
+    # adopted, not re-placed: same reservation, no second pod for the
+    # same demand, pod not reaped
+    placed_after = {p.tag: (p.pool, p.chips)
+                    for p in s.scheduler.placements()}
+    assert placed_after == placed_before, \
+        _ctx(f"restart changed placements: {placed_before} -> "
+             f"{placed_after}")
+    assert len(s.fleet_pods()) == 1, \
+        _ctx(f"restart double-placed: {[p['metadata']['name'] for p in s.fleet_pods()]}")
+    assert name in s.autoscaler._pending, \
+        _ctx("pending pod not adopted into fleet accounting")
+    # ... and once the replica does boot, everything reconciles
+    for _ in range(3):
+        s.tick(busy=False)
+    assert name in s.registry.registered_pod_names(), \
+        _ctx("pending pod's replica failed to register after restart")
+    assert len(s.fleet_pods()) == 1
+
+
+def test_hetero_strictly_beats_round_robin():
+    """Same seeded trace, two policies: integrate goodput and cost over
+    the run; hetero must win goodput-per-dollar STRICTLY."""
+    totals = {}
+    for policy in (HETERO, ROUND_ROBIN):
+        s = Soak(policy=policy)
+        plan = FaultPlan(SEED, s.clock, horizon_s=120.0,
+                         windows=[KILL_WINDOW])
+        goodput_integral = cost_integral = 0.0
+        for t in range(1, 91):
+            busy = t < 55
+            s.tick(busy=busy)
+            if t == 30:
+                for i in range(3):
+                    s.scheduler.place(TRAINING, 16, f"be-{i}",
+                                      best_effort=True)
+            if t == 40:
+                s.scheduler.place(TRAINING, 32, "gang-prod")
+            victims = plan.preempt_victims(
+                sorted(r.replica_id for r in s.registry.live()
+                       if r.replica_id not in s.killed))
+            if victims and not s.killed:
+                s.killed.add(victims[0])
+            goodput, cost = s.scheduler.rates()
+            goodput_integral += goodput
+            cost_integral += cost
+        totals[policy] = goodput_integral / max(cost_integral, 1e-9)
+    assert totals[HETERO] > totals[ROUND_ROBIN], _ctx(
+        f"goodput-per-dollar hetero={totals[HETERO]:.3f} "
+        f"<= round_robin={totals[ROUND_ROBIN]:.3f}")
+
+
+def test_gang_launch_honors_pool_annotation():
+    """provider/translate pins the slice generation to the annotated
+    pool — the kubelet half of 'tpu.dev/pool honored at gang launch'."""
+    from k8s_runpod_kubelet_tpu.config import Config
+    from k8s_runpod_kubelet_tpu.provider.annotations import AnnotationResolver
+    from k8s_runpod_kubelet_tpu.provider.translate import (TranslationError,
+                                                           select_slice)
+    import pytest
+
+    cfg = Config(node_name="n", zone="us-central2-b", fleet_pools=POOLS)
+    pod = {"metadata": {"name": "p", "annotations": {A.POOL: "v5p"}},
+           "spec": {"containers": [{"resources": {
+               "limits": {"google.com/tpu": "8"}}}]}}
+    kube = FakeKubeClient()
+    acc = select_slice(pod, AnnotationResolver(kube, pod), cfg)
+    assert acc.generation == "v5p", acc
+
+    pod["metadata"]["annotations"][A.POOL] = "retired"
+    with pytest.raises(TranslationError, match="unknown pool"):
+        select_slice(pod, AnnotationResolver(kube, pod), cfg)
+
+
+def test_debug_fleet_carries_scheduler_and_node_pools(tmp_path):
+    """The /debug/fleet payload joins the registry's node_pools view with
+    the scheduler snapshot, and fleet_summary renders pool columns from
+    the soak's own JSONL — the observability half of the acceptance."""
+    from tools.fleet_summary import load, render
+
+    s = Soak()
+    for _ in range(8):
+        s.tick(busy=True)
+    snap = s.registry.snapshot()
+    snap["scheduler"] = s.scheduler.snapshot()
+    assert any(pool for pool in snap["node_pools"] if pool), \
+        _ctx(f"no node pool attribution in snapshot: {snap['node_pools']}")
+
+    path = tmp_path / "soak.jsonl"
+    path.write_text(json.dumps(snap) + "\n", encoding="utf-8")
+    spans, snapshots = load(str(path))
+    out = render(spans, snapshots)
+    assert "node pools (scheduler snapshot" in out
+    assert "v5e" in out and "gen" in out

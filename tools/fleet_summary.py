@@ -138,7 +138,8 @@ def load_table(snapshots: list[dict]) -> list[str]:
         if role not in pools:
             continue
         out += [f"-- pool: {role} ({len(pools[role])} replica(s)) --",
-                f"{'replica':<20} {'state':<9} {'slots':>11} {'queue':>6} "
+                f"{'replica':<20} {'state':<9} {'gen':<5} {'npool':<8} "
+                f"{'slots':>11} {'queue':>6} "
                 f"{'kv_tokens':>10} {'ttft_p95':>9} {'itl_p95':>8} "
                 f"{'kv_free':>9} {'prefix%':>8} {'spec%':>7} {'hb_age':>7}"]
         for rid in sorted(pools[role]):
@@ -156,7 +157,12 @@ def load_table(snapshots: list[dict]) -> list[str]:
             total = st.get("kv_pages_total", 0)
             free_s = f"{st.get('kv_pages_free', 0)}/{total}" if total \
                 else "-"
-            out.append(f"{rid:<20} {rep.get('state', '?'):<9} {slots:>11} "
+            # node-pool identity (ISSUE 19): which generation/pool the
+            # scheduler placed this replica onto — "-" for legacy fleets
+            gen = rep.get("generation") or "-"
+            npool = rep.get("pool") or "-"
+            out.append(f"{rid:<20} {rep.get('state', '?'):<9} "
+                       f"{gen:<5} {npool:<8} {slots:>11} "
                        f"{st.get('queue_depth', 0):>6} "
                        f"{st.get('kv_cache_tokens', 0):>10} "
                        f"{st.get('ttft_p95_s', 0.0):>8.3f}s "
@@ -165,6 +171,52 @@ def load_table(snapshots: list[dict]) -> list[str]:
                        f"{hit_s:>8} "
                        f"{spec_s:>7} "
                        f"{rep.get('heartbeat_age_s', 0.0):>6.1f}s")
+    return out
+
+
+def scheduler_table(snapshots: list[dict]) -> list[str]:
+    """Node-pool scheduler view (ISSUE 19): the latest snapshot's
+    ``scheduler`` payload — per-pool chip accounting, live placements
+    with their goodput-loss preemption estimates, and the
+    effective-throughput matrix (measured cells marked ``*``)."""
+    sched = None
+    for snap in snapshots:  # later lines win
+        if isinstance(snap.get("scheduler"), dict):
+            sched = snap["scheduler"]
+    if not sched:
+        return []
+    out = ["", f"== node pools (scheduler snapshot, "
+               f"policy={sched.get('policy', '?')}) ==",
+           f"{'pool':<10} {'gen':<5} {'chips':>6} {'reserved':>9} "
+           f"{'free':>6} {'$/chip-hr':>10}"]
+    for p in sched.get("pools", []):
+        out.append(f"{p.get('pool', '?'):<10} {p.get('generation', '?'):<5} "
+                   f"{p.get('total_chips', 0):>6} "
+                   f"{p.get('reserved_chips', 0):>9} "
+                   f"{p.get('free_chips', 0):>6} "
+                   f"{p.get('cost_per_chip_hr', 0.0):>10.2f}")
+    placements = sched.get("placements", [])
+    if placements:
+        out += ["", f"{'placement':<24} {'kind':<9} {'pool':<10} "
+                    f"{'chips':>6} {'BE':>3} {'goodput_loss':>13}"]
+        for pl in placements:
+            out.append(f"{pl.get('tag', '?'):<24} {pl.get('kind', '?'):<9} "
+                       f"{pl.get('pool', '?'):<10} {pl.get('chips', 0):>6} "
+                       f"{'y' if pl.get('best_effort') else '-':>3} "
+                       f"{pl.get('goodput_loss', 0.0):>13.1f}")
+    matrix = sched.get("matrix", {})
+    if matrix:
+        gens = sorted({g for row in matrix.values() for g in row})
+        out += ["", "-- effective throughput (kind x generation, "
+                    "* = measured) --",
+                f"{'kind':<10} " + " ".join(f"{g:>12}" for g in gens)]
+        for kind in sorted(matrix):
+            cells = []
+            for g in gens:
+                cell = matrix[kind].get(g, {})
+                mark = "*" if cell.get("measured") else " "
+                cells.append(f"{cell.get('eff', 0.0):>11.1f}{mark}")
+            out.append(f"{kind:<10} " + " ".join(cells))
     return out
 
 
@@ -421,6 +473,7 @@ def event_timeline(spans: list[dict], top: int) -> list[str]:
 def render(spans: list[dict], snapshots: list[dict], top: int = 20) -> str:
     lines = routing_table(spans)
     lines += load_table(snapshots)
+    lines += scheduler_table(snapshots)
     lines += two_hop_table(spans, top)
     lines += handoff_rollup(spans)
     lines += directory_table(spans, snapshots)

@@ -1,0 +1,294 @@
+"""Bisect the ``backend_init`` wedge probe_diag has reported since BENCH_r05.
+
+probe_diag.py answers WHICH stage hangs (backend_init, i.e. PJRT client
+creation dialing the axon relay) and captures the hang stack. This tool
+answers the next question — WHY — by bisecting backend_init across the
+inputs it depends on, then writing the round file the trajectory needs
+(BENCH_r<NN>.json: a measured row if the chip answers, a loud
+``unreachable: true`` row carrying the doctor's findings otherwise).
+
+Bisection axes (each a probe_diag child variant under a SHORT
+faulthandler budget, so five hanging variants stay under ~5 minutes):
+
+  cpu_control         JAX_PLATFORMS=cpu — is the harness itself sound?
+  default             env as-is — the baseline wedge
+  no_remote_compile   remote-compile endpoint out of the dial path
+  no_pool_ips         PALLAS_AXON_POOL_IPS deleted — does the dial
+                      target matter, or does init wedge before it ever
+                      reads the pool?
+  no_ports            every explicit PALLAS_AXON_*PORT* hint deleted —
+                      same question for the port plumbing
+
+Alongside the child matrix the parent collects the cheap evidence that
+decides what a wedge MEANS: is anything listening on the configured
+relay ports (relay process gone vs relay up but the pool grant never
+arrives), and how long the trajectory has carried this wedge (scan of
+BENCH_r*.json probe_diag summaries — the "since BENCH_r05" claim is
+measured, not remembered).
+
+Usage:
+  python tools/probe_doctor.py              # bisect + write BENCH round
+  python tools/probe_doctor.py --no-round   # bisect only
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_TOOLS)
+_RESULTS_DIR = os.path.join(_REPO, "bench_results")
+sys.path.insert(0, _TOOLS)   # probe_diag is a sibling script, not a package
+sys.path.insert(0, _REPO)    # bench.py, for the round-writing machinery
+
+import probe_diag  # noqa: E402
+
+# short per-stage budget: the doctor runs MORE variants than probe_diag,
+# and a wedge that survives 45s of PJRT init is the same wedge at 120s
+_STAGE_S = int(os.environ.get("PROBE_DOCTOR_STAGE_TIMEOUT_S", "45"))
+_COMPILE_S = int(os.environ.get("PROBE_DOCTOR_COMPILE_TIMEOUT_S", "90"))
+
+_PORT_VARS = ["PALLAS_AXON_RELAY_PORT", "PALLAS_AXON_PORT",
+              "PALLAS_AXON_PORT_RANGE"]
+
+# (name, env_overrides, env_deletes, expected_backend) — the bisection
+# matrix; cpu_control first so a broken harness is diagnosed before five
+# 45s hangs are spent on it
+_BISECT = [
+    ("cpu_control", {"JAX_PLATFORMS": "cpu"}, [], "cpu"),
+    ("default", {"JAX_PLATFORMS": "axon"}, [], "axon"),
+    ("no_remote_compile", {"JAX_PLATFORMS": "axon"},
+     ["PALLAS_AXON_REMOTE_COMPILE"], "axon"),
+    ("no_pool_ips", {"JAX_PLATFORMS": "axon"},
+     ["PALLAS_AXON_POOL_IPS"], "axon"),
+    ("no_ports", {"JAX_PLATFORMS": "axon"},
+     ["PALLAS_AXON_POOL_IPS"] + _PORT_VARS, "axon"),
+]
+
+
+def _round_history() -> list:
+    """(round, wedged stage of the default variant) from every
+    BENCH_r*.json that carried a probe_diag summary — the measured
+    history of the wedge this doctor is bisecting."""
+    import re
+    out = []
+    try:
+        names = sorted(os.listdir(_REPO))
+    except OSError:
+        return out
+    for name in names:
+        m = re.match(r"^BENCH_r(\d+)\.json$", name)
+        if not m:
+            continue
+        try:
+            with open(os.path.join(_REPO, name), encoding="utf-8") as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        parsed = rec.get("parsed") or {}
+        diag = parsed.get("probe_diag") or {}
+        wedge = (diag.get("variants") or {}).get("default")
+        out.append({"round": int(m.group(1)),
+                    "unreachable": bool(parsed.get("unreachable")),
+                    "default_wedge": wedge})
+    return out
+
+
+def _env_audit() -> dict:
+    """The PALLAS_AXON_*/JAX_PLATFORMS surface the axon sitecustomize
+    reads at interpreter start — values included verbatim because the
+    diagnosis often IS a value (a stale pool IP, an odd port range)."""
+    keys = sorted(k for k in os.environ
+                  if k.startswith("PALLAS_AXON") or k == "JAX_PLATFORMS")
+    return {k: os.environ[k] for k in keys}
+
+
+def _port_evidence() -> dict:
+    hints = probe_diag._relay_port_hints()
+    listening = probe_diag._listening_ports()
+    connect = probe_diag._tcp_connect_report(hints) if hints else {}
+    return {"configured_ports": hints,
+            "listening_ports": listening,
+            "configured_and_listening": sorted(
+                set(hints) & set(listening)),
+            "connect": {str(p): v for p, v in connect.items()}}
+
+
+def _findings(variants: list, ports: dict, history: list) -> list:
+    """Human-readable verdicts, most load-bearing first. Each one is a
+    claim the evidence above supports — the point of the doctor is that
+    'wedged' stops being a mood and becomes a mechanism."""
+    by_name = {v["variant"]: v for v in variants}
+    out = []
+
+    cpu = by_name.get("cpu_control")
+    if cpu is not None and not cpu.get("ok"):
+        out.append("harness UNSOUND: the cpu control wedged at "
+                   f"{cpu.get('wedged_stage')!r} — every axon verdict "
+                   "below is suspect until the control passes")
+    elif cpu is not None:
+        out.append("harness sound: cpu control ran all five stages")
+
+    axon = [v for v in variants if v["variant"] != "cpu_control"]
+    wedges = {v["variant"]: v.get("wedged_stage") for v in axon}
+    if axon and all(w == "backend_init" for w in wedges.values()):
+        errs = {v["variant"]: (v.get("stage_errors") or {})
+                .get("backend_init", "") for v in axon}
+        if all(errs.values()) and all(
+                "not in the list of known backends" in e
+                for e in errs.values()):
+            out.append("axon backend NOT REGISTERED: backend_init "
+                       "fast-fails under every axon variant ('axon' is "
+                       "absent from jax's known backends) — the relay's "
+                       "sitecustomize/PJRT plugin never registered in "
+                       "this interpreter, so there is nothing to dial "
+                       "and no pool/port/remote-compile knob can matter; "
+                       "fix is provisioning the axon plugin, not "
+                       "retrying bench")
+        elif any(v.get("hang_stack") for v in axon):
+            out.append("backend_init HANGS under every axon variant "
+                       f"({', '.join(sorted(wedges))}) — the wedge is in "
+                       "PJRT client creation itself, upstream of the "
+                       "pool-IP, port and remote-compile plumbing the "
+                       "variants removed; no env change on this host "
+                       "can route around it")
+        else:
+            out.append("backend_init fails under every axon variant "
+                       f"({', '.join(sorted(wedges))}): "
+                       + "; ".join(sorted(set(filter(None,
+                                                     errs.values()))))[:400])
+    else:
+        for name, wedge in sorted(wedges.items()):
+            if wedge is None and by_name[name].get("ok"):
+                out.append(f"variant {name} PASSED — the axes it removes "
+                           "are implicated in the default wedge")
+            elif wedge != "backend_init":
+                out.append(f"variant {name} moved the wedge to {wedge!r} "
+                           "— backend_init is past that axis")
+
+    hints = ports.get("configured_ports") or []
+    live = ports.get("configured_and_listening") or []
+    if not hints:
+        out.append("no relay port is configured (no PALLAS_AXON_*PORT*/"
+                   "POOL_IPS hints): the PJRT dial has no explicit "
+                   "target, consistent with an init that blocks waiting "
+                   "for a relay that was never provisioned here")
+    elif not live:
+        out.append(f"relay GONE: nothing listens on configured ports "
+                   f"{hints} — restarting/reprovisioning the relay is "
+                   "the fix; retrying bench is not")
+    else:
+        out.append(f"relay LISTENING on {live} yet backend_init still "
+                   "hangs — the TCP handshake succeeds but the pool "
+                   "grant never arrives; the wedge is server-side "
+                   "(relay up, pool empty or grant path dead)")
+
+    wedged_rounds = [h["round"] for h in history
+                     if h.get("default_wedge") == "backend_init"]
+    if wedged_rounds:
+        out.append("trajectory: backend_init wedge recorded on rounds "
+                   f"{wedged_rounds} (first r{min(wedged_rounds):02d}) — "
+                   "a persistent environment state, not a flake")
+
+    stack = next((v.get("hang_stack") for v in axon
+                  if v.get("hang_stack")), "")
+    if stack:
+        first = next((ln.strip() for ln in stack.splitlines()
+                      if ln.strip().startswith("File")), "")
+        if first:
+            out.append(f"hang site (faulthandler): {first}")
+    return out
+
+
+def main() -> int:
+    write_round = "--no-round" not in sys.argv
+    budget = 2 * _STAGE_S + _COMPILE_S + 2 * _STAGE_S + 30
+    child_env = {"PROBE_DIAG_STAGE_TIMEOUT_S": str(_STAGE_S),
+                 "PROBE_DIAG_COMPILE_TIMEOUT_S": str(_COMPILE_S)}
+
+    audit = _env_audit()
+    ports = _port_evidence()
+    history = _round_history()
+    variants = []
+    for name, overrides, deletes, expect in _BISECT:
+        print(f"[doctor] variant {name} "
+              f"(budget {budget}s)...", file=sys.stderr, flush=True)
+        v = probe_diag.run_variant(name, {**overrides, **child_env},
+                                   deletes, budget, expect)
+        variants.append(v)
+        print(f"[doctor]   -> "
+              f"{'ok' if v['ok'] else 'wedged@' + str(v['wedged_stage'])} "
+              f"({v['wall_s']}s)", file=sys.stderr, flush=True)
+        if name == "cpu_control" and not v["ok"]:
+            break  # a broken harness makes the axon matrix meaningless
+
+    findings = _findings(variants, ports, history)
+    report = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+              "stage_timeout_s": _STAGE_S,
+              "env_audit": audit, "ports": ports,
+              "round_history": history,
+              "variants": variants, "findings": findings}
+    os.makedirs(_RESULTS_DIR, exist_ok=True)
+    path = os.path.join(_RESULTS_DIR, "probe_doctor.json")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=1)
+        f.write("\n")
+
+    reachable = any(v["variant"] != "cpu_control" and v.get("ok")
+                    for v in variants)
+    print(json.dumps({"metric": "probe_doctor", "reachable": reachable,
+                      "findings": findings, "path": path}), flush=True)
+    if write_round:
+        _write_round(reachable, findings)
+    return 0
+
+
+def _write_round(reachable: bool, findings: list) -> None:
+    """The round file this diagnosis belongs to. Reachable: one real
+    headline attempt through bench's own child runner (the measured
+    row). Unreachable: bench's best-known on-chip record, stamped
+    ``unreachable`` with the doctor's findings and the control-plane
+    cells that need no chip — the same shape orchestrate() writes, so
+    the trajectory stays uniform."""
+    import bench
+
+    if reachable:
+        parsed, rc, tail = bench._run_child(quick=False, platform=None,
+                                            timeout_s=1800)
+        if parsed is not None and parsed.get("value") is not None:
+            bench._append_tpu_record(parsed, source="probe_doctor_live")
+            bench._emit(parsed)
+            return
+        print(f"[doctor] reachable probe but headline failed "
+              f"(rc={rc}): {tail[-200:]}", file=sys.stderr)
+
+    best = bench._best_known_record()
+    if best is None:
+        print("[doctor] no best-known record; nothing to anchor a round",
+              file=sys.stderr)
+        return
+    line = dict(best["line"])
+    line.update(source="best_known_record", stale=True, unreachable=True,
+                measured_ts=best.get("ts"),
+                measured_commit=best.get("commit"),
+                measured_source=best.get("source"),
+                age_h=round(bench._result_age_s(best) / 3600, 1),
+                tpu_errors=["probe_doctor: backend_init bisect, "
+                            "see probe_doctor"])
+    diag = bench._probe_diag_summary()
+    if diag is not None:
+        line["probe_diag"] = diag
+    line["probe_doctor"] = {"findings": findings,
+                            "path": "bench_results/probe_doctor.json"}
+    smoke = bench._scheduler_smoke_lines()
+    if smoke is not None:
+        line["scheduler_cpu_smoke"] = smoke
+    bench._write_unreachable_round(line)
+    bench._emit(line)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
