@@ -66,6 +66,13 @@ Usage:
                              # step-phase p50s and the watchdog's
                              # recompile count from the enabled arm
                              # (CPU-capable; chip phases need TPU)
+  python bench.py --cost     # cost attribution meter: attributed
+                             # chip-seconds vs externally timed request
+                             # walls x chips (telescope identity), meter
+                             # on/off step-wall overhead, and idle burn
+                             # on a saturated arm (CPU-capable; the
+                             # $/Mtok headline needs TPU list prices to
+                             # mean anything)
   python bench.py --mfu-sweep  # training MFU levers: remat none/dots,
                              # batch, 530M width (needs TPU)
   python bench.py --attn-tune  # flash block-size grid at the training
@@ -150,6 +157,10 @@ _STAGED_QUEUE = [
     # placement vs round-robin on identical seeded traffic over a fake
     # cloud of mixed generations — pure control plane, no chip needed
     ("scheduler", ["--scheduler"], 900),
+    # cost attribution meter (ISSUE 20): telescope identity + on/off
+    # overhead + saturated-arm idle burn through real engines, and the
+    # $/Mtok headline priced off generations.py when the chip answers
+    ("cost", ["--cost"], 2400),
     ("serve_8b", ["--serve", "--model", "llama3-8b", "--int8", "--kv-int8"],
      2400),
     # int4 weights via the Pallas unpack kernel (ops/int4_matmul.py):
@@ -1389,6 +1400,175 @@ def run_flight_recorder_bench(smoke: bool = False) -> int:
                    "(the double bound holds at every append)",
            "backend": backend})
     return 0
+
+
+def run_cost_bench(smoke: bool = False) -> int:
+    """Cost-attribution cell (ISSUE 20): the meter's three acceptance
+    bars, measured through real engines draining real traffic.
+
+    (a) TELESCOPE: the meter derives per-phase chip-seconds from the
+        engine's internal span stamps; this cell times the SAME requests
+        from outside (perf_counter before submit, a done-callback at
+        completion) and checks attributed chip-seconds == external wall
+        x chips within 1%. The two clocks only agree if the monotone
+        boundary clamp loses nothing.
+    (b) OVERHEAD: meter on vs off on identical seeded traffic,
+        interleaved repeats, median per-step wall — the meter folds one
+        ledger entry per COMPLETED request (never per token or step), so
+        its budget is the flight-recorder bar: < 2%.
+    (c) IDLE BURN: a saturated arm (queue never empty from meter birth
+        to last completion) must attribute ~all paid chip-seconds; the
+        idle gauge reading non-zero under saturation would mean the
+        meter leaks paid time it should be attributing."""
+    _force_platform_from_env()
+    import jax
+    import jax.numpy as jnp
+    from k8s_runpod_kubelet_tpu.workloads.serving import (ServingConfig,
+                                                          ServingEngine)
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = _serve_model("llama3-8b")
+        params = _serve_params(cfg, 8)
+        base = dict(slots=8, max_prefill_len=512, cache_len=2048,
+                    max_new_tokens=64)
+        plens, new_toks, repeats = (64, 192, 384), 48, 7
+    else:
+        # same widened CPU geometry as the flight-recorder cell: the
+        # meter's per-request cost is FIXED, so a step must carry
+        # material compute for the overhead fraction to mean what it
+        # means on a chip
+        from k8s_runpod_kubelet_tpu.models import init_params, tiny_llama
+        cfg = tiny_llama(vocab_size=128, embed_dim=256, n_layers=4,
+                         n_heads=8, n_kv_heads=4, mlp_dim=512,
+                         max_seq_len=512, dtype=jnp.float32,
+                         param_dtype=jnp.float32)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        base = dict(slots=4, max_prefill_len=64, cache_len=512,
+                    max_new_tokens=32)
+        plens, new_toks, repeats = (12, 24, 48), 16, (6 if smoke else 10)
+    backend = jax.default_backend()
+
+    def prompts_for(r: int) -> list[list[int]]:
+        return [[((j * 7 + 31 * (r + 1) + i) % (cfg.vocab_size - 2)) + 1
+                 for j in range(plen)]
+                for i, plen in enumerate(plens)]
+
+    # --- (b) overhead: meter off vs on, interleaved identical traffic ---
+    engines = {}
+    for enabled in (False, True):
+        sc = ServingConfig(cost_meter=enabled, **base)
+        engines[enabled] = ServingEngine(cfg, params, sc).start()
+    per_repeat = {False: [], True: []}
+    try:
+        for e in engines.values():  # warm every bucket out of the timings
+            for toks in prompts_for(0):
+                e.submit(toks, max_new_tokens=4).result(timeout=1800)
+        # interleaved repeats (the flight-recorder lesson): both arms
+        # sample the same machine state, so drift never reads as
+        # overhead. The arm ORDER alternates per repeat and the headline
+        # is the median of PAIRED per-repeat ratios — within-repeat
+        # pairing cancels slow-machine windows that a median of absolute
+        # walls would misread as meter cost
+        ratios = []
+        for r in range(1, repeats + 1):
+            batch = prompts_for(r)
+            wall_per_step = {}
+            order = (False, True) if r % 2 else (True, False)
+            for enabled in order:
+                e = engines[enabled]
+                s0 = e.metrics.get_counter("tpu_serving_decode_steps")
+                t0 = time.perf_counter()
+                futs = [e.submit(toks, max_new_tokens=new_toks)
+                        for toks in batch]
+                for f in futs:
+                    f.result(timeout=1800)
+                wall = time.perf_counter() - t0
+                steps = (e.metrics.get_counter("tpu_serving_decode_steps")
+                         - s0)
+                if steps:
+                    per_repeat[enabled].append(wall / steps)
+                    wall_per_step[enabled] = wall / steps
+            if len(wall_per_step) == 2:
+                ratios.append(wall_per_step[True] / wall_per_step[False])
+    finally:
+        for e in engines.values():
+            e.stop()
+    med = {en: sorted(v)[len(v) // 2] * 1e3
+           for en, v in per_repeat.items()}
+    overhead = sorted(ratios)[len(ratios) // 2] - 1.0
+    _emit({"metric": "cost_step_ms", "arm": "disabled",
+           "value": round(med[False], 4), "unit": "ms",
+           "model": cfg.name, "backend": backend})
+    _emit({"metric": "cost_step_ms", "arm": "enabled",
+           "value": round(med[True], 4), "unit": "ms",
+           "model": cfg.name, "backend": backend})
+    _emit({"metric": "cost_meter_overhead_frac", "value": round(overhead, 4),
+           "unit": "frac",
+           "note": "median PAIRED per-repeat step-wall ratio (metered / "
+                   "unmetered - 1) on identical seeded traffic, arm order "
+                   "alternating; acceptance < 0.02",
+           "backend": backend})
+
+    # --- (a) telescope + (c) idle burn: one fresh saturated engine ---
+    # Every request from meter birth is timed externally; the whole
+    # stream is queued at once so the engine is never idle until the
+    # last completion.
+    walls: list[float] = []  # list.append is atomic; callbacks race safely
+    sat = ServingEngine(cfg, params,
+                        ServingConfig(cost_meter=True, **base)).start()
+    try:
+        futs = []
+        for r in range(repeats + 1):
+            for toks in prompts_for(r):
+                t0 = time.perf_counter()
+                f = sat.submit(toks, max_new_tokens=new_toks)
+                # the done-callback fires in the engine thread right
+                # after metering, so the external wall closes at (not
+                # after) completion even when futures finish out of the
+                # wait order below
+                f.add_done_callback(
+                    lambda _f, t0=t0:
+                    walls.append(time.perf_counter() - t0))
+                futs.append(f)
+        for f in futs:
+            f.result(timeout=1800)
+        snap = sat.costmeter.snapshot()  # before stop(): idle still live
+    finally:
+        sat.stop()
+    attributed = sum(snap["totals"]["chip_seconds"].values())
+    expected = sum(walls) * snap["chips"]
+    telescope_err = abs(attributed - expected) / expected
+    idle_frac = (snap["idle_chip_seconds"]
+                 / max(snap["paid_chip_seconds"], 1e-9))
+    tokens = snap["totals"]["tokens"]
+    _emit({"metric": "cost_telescope_err_frac",
+           "value": round(telescope_err, 6), "unit": "frac",
+           "attributed_chip_s": round(attributed, 4),
+           "external_chip_s": round(expected, 4),
+           "requests": snap["totals"]["requests"],
+           "note": "meter-attributed chip-seconds vs externally timed "
+                   "submit->done walls x chips; acceptance < 0.01",
+           "backend": backend})
+    _emit({"metric": "cost_idle_burn_frac", "value": round(idle_frac, 6),
+           "unit": "frac",
+           "paid_chip_s": snap["paid_chip_seconds"],
+           "idle_chip_s": snap["idle_chip_seconds"],
+           "note": "idle/paid on the saturated arm (queue never empty); "
+                   "acceptance < 0.05",
+           "backend": backend})
+    _emit({"metric": "cost_dollars_per_mtok",
+           "value": round(snap["totals"]["cost_dollars"]
+                          / max(tokens, 1) * 1e6, 4),
+           "unit": "$/Mtok", "model": cfg.name,
+           "generation": snap["generation"],
+           "price_per_chip_hr": snap["price_per_chip_hr"],
+           "tokens": tokens,
+           "note": "generated tokens only; CPU rows price the wall at "
+                   "the fallback list price — the headline needs a chip",
+           "backend": backend})
+    ok = telescope_err < 0.01 and overhead < 0.02 and idle_frac < 0.05
+    return 0 if ok else 1
 
 
 def run_chunked_bench(smoke: bool = False) -> int:
@@ -2716,6 +2896,15 @@ def _scheduler_smoke_lines() -> list | None:
     return _cpu_smoke_lines("--scheduler")
 
 
+def _cost_smoke_lines() -> list | None:
+    """The ISSUE 20 cost-attribution cell on CPU (see _cpu_smoke_lines):
+    the telescope identity, meter overhead and saturated-arm idle burn
+    re-measured per commit — the mechanism (boundary clamp, one fold per
+    completed request) is the one the chip runs; only the $/Mtok
+    headline waits on the tunnel."""
+    return _cpu_smoke_lines("--cost", timeout_s=900)
+
+
 def _paged_tp_smoke_lines() -> list | None:
     """The ISSUE 12 TP paged-decode cell on CPU (see _cpu_smoke_lines):
     paged-vs-contiguous mesh decode step time at tp=2 over virtual
@@ -2772,6 +2961,7 @@ def orchestrate(quick: bool) -> int:
     fr_smoke = None if quick else _flight_recorder_smoke_lines()
     paged_tp_smoke = None if quick else _paged_tp_smoke_lines()
     scheduler_smoke = None if quick else _scheduler_smoke_lines()
+    cost_smoke = None if quick else _cost_smoke_lines()
     session = _session_tpu_headline()
     if session is not None:
         session["tpu_errors"] = errors[-2:]
@@ -2792,6 +2982,8 @@ def orchestrate(quick: bool) -> int:
             session["paged_tp_cpu_smoke"] = paged_tp_smoke
         if scheduler_smoke is not None:
             session["scheduler_cpu_smoke"] = scheduler_smoke
+        if cost_smoke is not None:
+            session["cost_cpu_smoke"] = cost_smoke
         if not quick:
             _write_unreachable_round(session)
         _emit(session)
@@ -2826,6 +3018,8 @@ def orchestrate(quick: bool) -> int:
             line["paged_tp_cpu_smoke"] = paged_tp_smoke
         if scheduler_smoke is not None:
             line["scheduler_cpu_smoke"] = scheduler_smoke
+        if cost_smoke is not None:
+            line["cost_cpu_smoke"] = cost_smoke
         if not quick:
             _write_unreachable_round(line)
         _emit(line)
@@ -3155,6 +3349,8 @@ def main() -> int:
         return run_flight_recorder_bench(smoke="--smoke" in sys.argv)
     if "--scheduler" in sys.argv:
         return run_scheduler_bench(smoke="--smoke" in sys.argv)
+    if "--cost" in sys.argv:
+        return run_cost_bench(smoke="--smoke" in sys.argv)
     if "--ring-flash" in sys.argv:
         return run_ring_flash_check()
     if "--spec-drift" in sys.argv:

@@ -24,12 +24,23 @@ Rules:
   ``remove_gauge(name)`` call somewhere in the package — the PR 5
   stalled-gauge-leak class: a labeled series for an entity that left
   (pod deleted, replica deregistered) pages someone forever unless the
-  delete path drops it.
+  delete path drops it;
+- **merged-counter discipline** (ISSUE 20): every counter the fleet
+  heartbeat reads cumulative via ``get_counter(...)`` in
+  ``fleet/registry.py`` must (a) appear in that module's
+  ``GUARDED_HEARTBEAT_COUNTERS`` tuple — the registry-tier consumers'
+  contract that a RestartGuard differences it — and (b) have a
+  zero-seed ``incr(name, 0, ...)`` site somewhere in the package. A
+  counter that first appears mid-flight, or whose merge side lacks a
+  restart guard, fabricates fleet deltas on replica restart (the
+  SLOTracker bug class this tuple exists to prevent).
 
 Allowlist keys: ``("metric", name)`` / ``("span", name)`` for catalogue
 gaps, ``("dynamic", file, func)`` for computed names,
 ``("undescribed", name)`` / ``("unemitted", name)`` for describe gaps,
-``("leak", name)`` for per-entity gauges with no removal call.
+``("leak", name)`` for per-entity gauges with no removal call,
+``("merge-unguarded", name)`` / ``("merge-unseeded", name)`` /
+``("merge-dead-guard", name)`` for merged-counter discipline gaps.
 """
 
 from __future__ import annotations
@@ -93,6 +104,28 @@ def _entity_labeled(node: ast.Call) -> bool:
                for k in d.keys)
 
 
+def _is_zero_seed(node: ast.Call) -> bool:
+    """incr(name, 0, ...) — the scrape-from-zero discipline."""
+    return (node.func.attr == "incr" and len(node.args) >= 2
+            and isinstance(node.args[1], ast.Constant)
+            and node.args[1].value == 0)
+
+
+def _guarded_tuple(tree) -> Optional[set]:
+    """The GUARDED_HEARTBEAT_COUNTERS module constant as a set of
+    names (None when the module doesn't define it)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) \
+                and any(isinstance(t, ast.Name)
+                        and t.id == "GUARDED_HEARTBEAT_COUNTERS"
+                        for t in node.targets) \
+                and isinstance(node.value, (ast.Tuple, ast.List)):
+            return {e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)}
+    return None
+
+
 def _removal_names(tree) -> set:
     """Gauge names some remove_gauge call drops: literal first args,
     plus every string in a constant tuple/list a for-loop iterates when
@@ -139,11 +172,16 @@ class ObservabilityChecker(Checker):
         used_spans: dict[str, tuple[str, int, str]] = {}
         entity_gauges: dict[str, tuple[str, int, str]] = {}
         removal_names: set = set()
+        zero_seeded: set = set()
+        merged_counters: dict[str, tuple[str, int, str]] = {}
+        guarded: Optional[set] = None
 
         for fi in index.files():
             if fi.rel.startswith("analysis/"):
                 continue  # the lint's own name tables are not telemetry
             removal_names |= _removal_names(fi.tree)
+            if fi.rel == "fleet/registry.py":
+                guarded = _guarded_tuple(fi.tree)
             # tracing.py's Span.__exit__ records self.name — registry
             # plumbing, like metrics' _Timer; the literal names live at
             # the tracer.span(...) call sites, which ARE collected
@@ -156,10 +194,19 @@ class ObservabilityChecker(Checker):
                 recv = _recv_text(node.func)
                 site = (fi.rel, node.lineno,
                         fi.enclosing_function(node.lineno))
+                if attr == "get_counter" and fi.rel == "fleet/registry.py":
+                    # a cumulative read the heartbeat ships for
+                    # registry-tier differencing — the merged-counter
+                    # discipline's input set
+                    name = _first_arg_literal(node)
+                    if name is not None:
+                        merged_counters.setdefault(name, site)
                 if attr in _METRIC_METHODS:
                     name = _first_arg_literal(node)
                     if name is not None:
                         used_metrics.setdefault(name, site)
+                        if _is_zero_seed(node):
+                            zero_seeded.add(name)
                         if attr == "set_gauge" and _entity_labeled(node):
                             entity_gauges.setdefault(name, site)
                     elif node.args and _is_metrics_recv(recv):
@@ -217,6 +264,34 @@ class ObservabilityChecker(Checker):
                     f"outlives its entity (the stalled-gauge-leak class): "
                     f"drop it from the delete/deregister path",
                     key=("leak", name))
+        for name, (rel, line, func) in sorted(merged_counters.items()):
+            if guarded is not None and name not in guarded:
+                yield Finding(
+                    self.name, rel, line, func,
+                    f"heartbeat reads counter {name!r} cumulative but it "
+                    f"is not in GUARDED_HEARTBEAT_COUNTERS — the registry "
+                    f"tier differences these per beat, and an unguarded "
+                    f"merge fabricates fleet deltas on replica restart: "
+                    f"add it to the tuple (and RestartGuard the consumer)",
+                    key=("merge-unguarded", name))
+            if name not in zero_seeded:
+                yield Finding(
+                    self.name, rel, line, func,
+                    f"heartbeat-merged counter {name!r} has no zero-seed "
+                    f"incr({name!r}, 0, ...) site — a series first "
+                    f"appearing mid-flight reads as a restart to the "
+                    f"merge guards: seed it where it is described",
+                    key=("merge-unseeded", name))
+        if guarded:
+            for name in sorted(guarded - set(merged_counters)):
+                site = merged_counters.get(name) or ("fleet/registry.py",
+                                                     1, "<module>")
+                yield Finding(
+                    self.name, site[0], site[1], site[2],
+                    f"GUARDED_HEARTBEAT_COUNTERS lists {name!r} but no "
+                    f"get_counter({name!r}) read exists in the heartbeat "
+                    f"path — dead guard entry (renamed counter?)",
+                    key=("merge-dead-guard", name))
         for name, (rel, line, func) in sorted(used_spans.items()):
             if readme is not None and name not in readme:
                 yield Finding(

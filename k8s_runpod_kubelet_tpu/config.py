@@ -219,6 +219,12 @@ class Config:
     serving_flight_recorder: bool = True
     serving_profiler_port: int = 0
     serving_profile_capture: bool = False
+    # cost attribution plane (ISSUE 20): per-request chip-second/dollar
+    # metering on the engine (costmeter.py — phase walls priced through
+    # the generations.py table, per-tenant ledger at GET /debug/costs,
+    # cumulative snapshots riding the fleet heartbeat into the router's
+    # fleet-wide /metrics/fleet + /debug/costs).
+    serving_cost_meter: bool = True
     # fleet SLO burn rates (ISSUE 17): multi-window breach fractions over
     # the TTFT/ITL/error-rate objectives, computed from registry
     # heartbeats on the injected clock. A signal "burns" when BOTH the
@@ -469,6 +475,7 @@ _ENV_MAP = {
     "TPU_SERVING_FLIGHT_RECORDER": "serving_flight_recorder",
     "TPU_SERVING_PROFILER_PORT": "serving_profiler_port",
     "TPU_SERVING_PROFILE_CAPTURE": "serving_profile_capture",
+    "TPU_SERVING_COST_METER": "serving_cost_meter",
     "TPU_FLEET_SLO_SHORT_WINDOW_S": "fleet_slo_short_window_s",
     "TPU_FLEET_SLO_LONG_WINDOW_S": "fleet_slo_long_window_s",
     "TPU_FLEET_SLO_BURN_THRESHOLD": "fleet_slo_burn_threshold",

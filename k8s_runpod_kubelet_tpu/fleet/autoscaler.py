@@ -53,7 +53,8 @@ SERVING_PASSTHROUGH_ENV = ("TPU_KV_PAGE_TOKENS", "TPU_KV_POOL_PAGES",
                            "TPU_FLEET_PLACEMENT_DOMAIN_MODE",
                            "TPU_SERVING_FLIGHT_RECORDER",
                            "TPU_SERVING_PROFILER_PORT",
-                           "TPU_SERVING_PROFILE_CAPTURE")
+                           "TPU_SERVING_PROFILE_CAPTURE",
+                           "TPU_SERVING_COST_METER")
 
 
 @dataclasses.dataclass

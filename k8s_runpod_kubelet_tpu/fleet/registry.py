@@ -43,6 +43,32 @@ PREFILL = "prefill"
 DECODE = "decode"
 ROLES = (UNIFIED, PREFILL, DECODE)
 
+# Counters the heartbeat reads CUMULATIVE off the engine's Metrics and a
+# registry-tier consumer differences per beat — the SLO tracker's burn
+# windows (errors/requests), the scheduler's throughput matrix (decode
+# steps), the fleet metrics merge (all of them, via the full snapshot).
+# graftlint's merged-counter rule (analysis/checkers/observability.py)
+# pins every get_counter literal in this module to this tuple AND to a
+# zero-seed site: a counter that starts life mid-flight, or whose merge
+# side lacks a RestartGuard, would fabricate fleet deltas on replica
+# restart.
+GUARDED_HEARTBEAT_COUNTERS = (
+    "tpu_serving_prefix_cache_hits",
+    "tpu_serving_prefix_cache_misses",
+    "tpu_serving_spec_proposed",
+    "tpu_serving_spec_accepted",
+    "tpu_serving_engine_errors",
+    "tpu_serving_prefill_errors",
+    "tpu_serving_admitted",
+    "tpu_serving_decode_steps",
+)
+
+# /debug/costs wire shape (must match workloads/serving/costmeter.py's
+# COSTS_SCHEMA_VERSION — stated as a literal here because the fleet tier
+# is jax-free by contract and must not import the serving package;
+# tests/test_costmeter.py pins the two literals equal)
+COSTS_SCHEMA_VERSION = 1
+
 
 @dataclasses.dataclass
 class ReplicaStats:
@@ -188,10 +214,19 @@ class ReplicaRegistry:
                  breaker_failure_threshold: int = 3,
                  breaker_reset_s: float = 10.0,
                  request_timeout_s: float = 120.0,
-                 directory=None, slo=None, scheduler=None):
+                 directory=None, slo=None, scheduler=None,
+                 aggregator=None, cost_ledger=None):
         self.metrics = metrics
         self.tracer = tracer
         self.clock = clock
+        # fleet metrics merge + cost rollup (ISSUE 20): every accepted
+        # heartbeat may carry a full Metrics.snapshot() and a CostMeter
+        # snapshot; both are cumulative (idempotent to re-ingest), so
+        # they ride every beat with no requeue-on-failure, unlike
+        # prefixes. Ingested outside the membership lock like
+        # slo/directory/scheduler.
+        self.aggregator = aggregator
+        self.cost_ledger = cost_ledger
         # fleet scheduler (ISSUE 19): every accepted heartbeat teaches its
         # effective-throughput matrix (tokens/sec-per-chip per generation)
         # — called outside the membership lock like slo/directory
@@ -287,12 +322,17 @@ class ReplicaRegistry:
         return rep
 
     def heartbeat(self, replica_id: str, stats: dict,
-                  prefixes: Optional[list] = None) -> bool:
+                  prefixes: Optional[list] = None,
+                  metrics_snap: Optional[dict] = None,
+                  costs: Optional[dict] = None) -> bool:
         """Returns False for an unknown id — the replica should
         re-register (it was evicted, or the router restarted).
         ``prefixes`` is the beat's piggybacked prefix-directory publish
         batch (ISSUE 16) — accepted only from a READY replica; a
-        draining one is leaving, so its claims drop instead."""
+        draining one is leaving, so its claims drop instead.
+        ``metrics_snap``/``costs`` are the beat's cumulative metric and
+        cost snapshots (ISSUE 20) — accepted from draining replicas too:
+        their final tokens still cost money."""
         with self._lock:
             rep = self._replicas.get(replica_id)
             if rep is None:
@@ -325,6 +365,20 @@ class ReplicaRegistry:
                 self.directory.drop_replica(replica_id)
             elif prefixes:
                 self.directory.publish(replica_id, prefixes)
+        if self.aggregator is not None and metrics_snap is not None:
+            # own-lock consumer, outside the membership lock; a bad
+            # snapshot must not fail the beat (membership > metrics)
+            try:
+                self.aggregator.ingest(replica_id, metrics_snap)
+            except Exception:  # noqa: BLE001
+                log.exception("fleet: metrics snapshot from %s rejected",
+                              replica_id)
+        if self.cost_ledger is not None and costs is not None:
+            try:
+                self.cost_ledger.ingest(replica_id, costs)
+            except Exception:  # noqa: BLE001
+                log.exception("fleet: cost snapshot from %s rejected",
+                              replica_id)
         self._update_gauges()
         return True
 
@@ -351,6 +405,12 @@ class ReplicaRegistry:
             rep = self._replicas.pop(replica_id, None)
         if self.slo is not None:
             self.slo.forget(replica_id)
+        if self.aggregator is not None:
+            # merged counter/histogram totals SURVIVE the forget — only
+            # the replica's gauges and delta baselines drop (ISSUE 20)
+            self.aggregator.forget(replica_id)
+        if self.cost_ledger is not None:
+            self.cost_ledger.forget(replica_id)
         if self.directory is not None:
             self.directory.drop_replica(replica_id)
         if rep is not None and self.metrics is not None:
@@ -366,6 +426,10 @@ class ReplicaRegistry:
             rep = self._replicas.pop(replica_id, None)
         if self.slo is not None:
             self.slo.forget(replica_id)
+        if self.aggregator is not None:
+            self.aggregator.forget(replica_id)
+        if self.cost_ledger is not None:
+            self.cost_ledger.forget(replica_id)
         if self.directory is not None:
             # same-transaction consistency (ISSUE 16): the moment the
             # fleet declares a replica dead, its directory claims die
@@ -448,7 +512,8 @@ class ReplicaRegistry:
         now = self.clock()
         with self._lock:
             reps = [r.to_dict(now) for r in self._replicas.values()]
-        return {"replicas": sorted(reps, key=lambda r: r["replica_id"]),
+        return {"schema_version": 1,
+                "replicas": sorted(reps, key=lambda r: r["replica_id"]),
                 "ready": sum(1 for r in reps
                              if r["state"] == READY and not r["breaker_open"]),
                 "draining": sum(1 for r in reps if r["state"] == DRAINING),
@@ -476,6 +541,197 @@ class ReplicaRegistry:
         for role, n in roles.items():
             self.metrics.set_gauge("tpu_fleet_pool_replicas", n,
                                    labels={"role": role})
+
+
+_COST_PHASES = ("queue", "prefill", "decode")
+
+
+def _tot_zero() -> dict:
+    return {"requests": 0, "tokens": 0, "prompt_tokens": 0,
+            "chip_seconds": {p: 0.0 for p in _COST_PHASES},
+            "kv_page_seconds": 0.0, "cost_dollars": 0.0}
+
+
+def _tot_fold(dst: dict, src: dict) -> None:
+    """Fold one cost bucket into another (shape-tolerant: a malformed
+    heartbeat contributes zeros, never a KeyError)."""
+    dst["requests"] += int(src.get("requests", 0) or 0)
+    dst["tokens"] += int(src.get("tokens", 0) or 0)
+    dst["prompt_tokens"] += int(src.get("prompt_tokens", 0) or 0)
+    cs = src.get("chip_seconds") or {}
+    for p in _COST_PHASES:
+        dst["chip_seconds"][p] += float(cs.get(p, 0.0) or 0.0)
+    dst["kv_page_seconds"] += float(src.get("kv_page_seconds", 0.0) or 0.0)
+    dst["cost_dollars"] += float(src.get("cost_dollars", 0.0) or 0.0)
+
+
+def _group_zero() -> dict:
+    g = _tot_zero()
+    g.update({"generation": "", "paid_chip_seconds": 0.0,
+              "idle_chip_seconds": 0.0, "handoff_bytes": 0, "replicas": 0})
+    return g
+
+
+def _group_fold_snap(group: dict, snap: dict) -> None:
+    _tot_fold(group, snap.get("totals") or {})
+    group["paid_chip_seconds"] += float(snap.get("paid_chip_seconds", 0.0)
+                                        or 0.0)
+    group["idle_chip_seconds"] += float(snap.get("idle_chip_seconds", 0.0)
+                                        or 0.0)
+    group["handoff_bytes"] += int(snap.get("handoff_bytes", 0) or 0)
+    group["replicas"] += 1
+
+
+def _group_fold_group(dst: dict, src: dict) -> None:
+    _tot_fold(dst, src)
+    dst["paid_chip_seconds"] += src["paid_chip_seconds"]
+    dst["idle_chip_seconds"] += src["idle_chip_seconds"]
+    dst["handoff_bytes"] += src["handoff_bytes"]
+    dst["replicas"] += src["replicas"]
+    if not dst["generation"]:
+        dst["generation"] = src["generation"]
+
+
+class FleetCostLedger:
+    """Registry-tier cost rollup (ISSUE 20): merges the cumulative
+    CostMeter snapshots riding each heartbeat into fleet totals by
+    (model, pool) and by tenant — the ``/debug/costs`` payload on the
+    router and the input to tools/cost_summary.py.
+
+    Each replica's snapshot is CUMULATIVE since its own start, so the
+    merge is last-write-wins per replica; a restart (the snapshot's
+    request count going BACKWARDS) and a membership exit both fold the
+    superseded snapshot into a retired bucket first — fleet spend never
+    un-happens because a replica died. That is the same discipline
+    metrics.RestartGuard enforces for merged counters, specialized to
+    whole-snapshot epochs."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # replica_id -> last cost snapshot (current epoch)
+        self._live: dict[str, dict] = {}
+        # finished epochs, folded by (model, pool) and by tenant
+        self._retired_groups: dict[tuple, dict] = {}
+        self._retired_tenants: dict[str, dict] = {}
+        # replica_id -> unknown schema_version it sent (visible in
+        # /debug/costs instead of silently dropping on the floor)
+        self._schema_skews: dict[str, object] = {}
+        self._ingested = 0
+
+    def ingest(self, replica_id: str, snap) -> None:
+        if not isinstance(snap, dict):
+            return
+        ver = snap.get("schema_version")
+        if ver != COSTS_SCHEMA_VERSION:
+            with self._lock:
+                self._schema_skews[str(replica_id)] = ver
+            return
+        with self._lock:
+            self._ingested += 1
+            self._schema_skews.pop(str(replica_id), None)
+            prev = self._live.get(replica_id)
+            if prev is not None and self._requests(snap) < self._requests(prev):
+                # the meter restarted: last-write-wins would erase the
+                # old epoch's spend, so retire it first
+                self._retire_locked(prev)
+            self._live[replica_id] = snap
+
+    @staticmethod
+    def _requests(snap: dict) -> int:
+        try:
+            return int((snap.get("totals") or {}).get("requests", 0))
+        except (TypeError, ValueError):
+            return 0
+
+    def forget(self, replica_id: str) -> None:
+        """Membership exit: the replica's spend moves to the retired
+        rollup (fleet totals survive, per-replica detail drops)."""
+        with self._lock:
+            prev = self._live.pop(replica_id, None)
+            self._schema_skews.pop(str(replica_id), None)
+            if prev is not None:
+                self._retire_locked(prev)
+
+    def _retire_locked(self, snap: dict) -> None:
+        key = (str(snap.get("model", "")), str(snap.get("pool", "")))
+        group = self._retired_groups.setdefault(key, _group_zero())
+        if not group["generation"]:
+            group["generation"] = str(snap.get("generation", ""))
+        _group_fold_snap(group, snap)
+        # retired epochs count capacity, not membership
+        group["replicas"] -= 1
+        for tenant, bucket in (snap.get("tenants") or {}).items():
+            _tot_fold(self._retired_tenants.setdefault(str(tenant),
+                                                       _tot_zero()),
+                      bucket)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            groups: dict[tuple, dict] = {}
+            tenants: dict[str, dict] = {}
+            for key, g in self._retired_groups.items():
+                _group_fold_group(groups.setdefault(key, _group_zero()), g)
+            for t, b in self._retired_tenants.items():
+                _tot_fold(tenants.setdefault(t, _tot_zero()), b)
+            for snap in self._live.values():
+                key = (str(snap.get("model", "")), str(snap.get("pool", "")))
+                group = groups.setdefault(key, _group_zero())
+                if not group["generation"]:
+                    group["generation"] = str(snap.get("generation", ""))
+                _group_fold_snap(group, snap)
+                for t, b in (snap.get("tenants") or {}).items():
+                    _tot_fold(tenants.setdefault(str(t), _tot_zero()), b)
+            live = {rid: self._live[rid] for rid in sorted(self._live)}
+            skews = dict(sorted(self._schema_skews.items()))
+            ingested = self._ingested
+        out_groups = []
+        for (model, pool) in sorted(groups):
+            g = groups[(model, pool)]
+            paid = g["paid_chip_seconds"]
+            spent = sum(g["chip_seconds"].values())
+            tokens = g["tokens"]
+            out_groups.append({
+                "model": model, "pool": pool,
+                "generation": g["generation"],
+                "replicas": max(0, g["replicas"]),
+                "requests": g["requests"],
+                "tokens": tokens,
+                "prompt_tokens": g["prompt_tokens"],
+                "chip_seconds": {p: round(v, 6)
+                                 for p, v in g["chip_seconds"].items()},
+                "kv_page_seconds": round(g["kv_page_seconds"], 6),
+                "cost_dollars": round(g["cost_dollars"], 9),
+                "paid_chip_seconds": round(paid, 3),
+                "idle_chip_seconds": round(g["idle_chip_seconds"], 3),
+                "handoff_bytes": g["handoff_bytes"],
+                "utilization": (round(spent / paid, 4)
+                                if paid > 0 else None),
+                "tokens_per_sec_per_chip": (round(tokens / paid, 4)
+                                            if paid > 0 else None),
+                "dollars_per_mtok": (round(g["cost_dollars"]
+                                           / tokens * 1e6, 6)
+                                     if tokens else None),
+            })
+        out_tenants = {}
+        for t in sorted(tenants):
+            b = tenants[t]
+            out_tenants[t] = {
+                "requests": b["requests"], "tokens": b["tokens"],
+                "prompt_tokens": b["prompt_tokens"],
+                "chip_seconds": {p: round(v, 6)
+                                 for p, v in b["chip_seconds"].items()},
+                "kv_page_seconds": round(b["kv_page_seconds"], 6),
+                "cost_dollars": round(b["cost_dollars"], 9),
+                "dollars_per_mtok": (round(b["cost_dollars"]
+                                           / b["tokens"] * 1e6, 6)
+                                     if b["tokens"] else None),
+            }
+        return {"schema_version": COSTS_SCHEMA_VERSION,
+                "groups": out_groups,
+                "tenants": out_tenants,
+                "replicas": live,
+                "schema_skews": skews,
+                "ingested": ingested}
 
 
 class ReplicaReporter:
@@ -639,6 +895,21 @@ class ReplicaReporter:
         body = {"replica_id": self.replica_id, "stats": self.stats()}
         if pubs:
             body["prefixes"] = pubs
+        # cost attribution plane (ISSUE 20): the full metric snapshot +
+        # the cost meter's ledger ride every beat. Both are CUMULATIVE —
+        # re-ingesting is idempotent at the registry — so unlike
+        # prefixes there is no requeue-on-failure: the next beat's
+        # snapshot supersedes this one.
+        try:
+            body["metrics"] = self.engine.metrics.snapshot()
+        except Exception:  # noqa: BLE001 — the beat itself must survive
+            log.exception("fleet: metrics snapshot failed")
+        costmeter = getattr(self.engine, "costmeter", None)
+        if costmeter is not None:
+            try:
+                body["costs"] = costmeter.snapshot()
+            except Exception:  # noqa: BLE001
+                log.exception("fleet: cost snapshot failed")
         try:
             out = self._post("/fleet/heartbeat", body)
         except Exception:

@@ -490,8 +490,8 @@ class FleetRouter:
 
     # -- non-streamed forwarding -----------------------------------------------
 
-    def forward(self, path: str, payload: dict,
-                trace: dict) -> tuple[int, dict, dict]:
+    def forward(self, path: str, payload: dict, trace: dict,
+                tenant: str = "") -> tuple[int, dict, dict]:
         """Route one idempotent non-streamed request. Returns (status,
         body, extra response headers). Generation requests are idempotent
         from the fleet's view — a replica that died mid-call never
@@ -499,6 +499,11 @@ class FleetRouter:
         some decode steps but never double-delivers a result."""
         started = self.clock()
         headers = {"traceparent": trace["header"]}
+        # tenant attribution (ISSUE 20): the front door's X-Tenant rides
+        # to the replica so the cost meter books the request to its payer
+        fwd_headers = {"traceparent": trace["header"]}
+        if tenant:
+            fwd_headers["X-Tenant"] = tenant
         if self.all_saturated():
             self._outcome("rejected")
             if self.metrics is not None:
@@ -542,7 +547,7 @@ class FleetRouter:
                 out = replica.transport.request(
                     "POST", path, body=payload,
                     timeout_s=self.cfg.request_timeout_s,
-                    extra_headers={"traceparent": trace["header"]})
+                    extra_headers=fwd_headers)
                 self._outcome("ok")
                 self._record_route(trace, path, started, replica.replica_id,
                                    200, reason, attempts, False)
@@ -605,7 +610,7 @@ class FleetRouter:
 
     def open_stream(self, path: str, raw_body: bytes, trace: dict,
                     prefer: Optional[Replica] = None,
-                    key: Optional[str] = None
+                    key: Optional[str] = None, tenant: str = ""
                     ) -> tuple[Optional[Replica], object, object,
                                str, int]:
         """Pick a replica and open the upstream response WITHOUT reading
@@ -651,10 +656,13 @@ class FleetRouter:
             conn = http.client.HTTPConnection(
                 parsed.hostname, parsed.port or 80,
                 timeout=self.cfg.request_timeout_s)
+            stream_headers = {"Content-Type": "application/json",
+                              "traceparent": trace["header"]}
+            if tenant:
+                stream_headers["X-Tenant"] = tenant
             try:
                 conn.request("POST", path, body=raw_body,
-                             headers={"Content-Type": "application/json",
-                                      "traceparent": trace["header"]})
+                             headers=stream_headers)
                 resp = conn.getresponse()
             except OSError as e:
                 if breaker is not None:
@@ -761,6 +769,29 @@ class _RouterHandler(BaseHTTPRequestHandler):
         if url.path == "/metrics" and rt.metrics is not None:
             return self._send(200, rt.metrics.render().encode(),
                               "text/plain; version=0.0.4")
+        if url.path == "/metrics/fleet":
+            # fleet-merged exposition (ISSUE 20): every replica's full
+            # metric snapshot, restart-guard merged at the registry —
+            # one scrape target for the whole serving fleet, exemplars
+            # preserved
+            agg = rt.registry.aggregator
+            if agg is None:
+                return self._send(404, {"error": "fleet metrics merge "
+                                                 "disabled"})
+            return self._send(200, agg.render().encode(),
+                              "text/plain; version=0.0.4")
+        if url.path == "/debug/costs":
+            # fleet cost rollup (ISSUE 20): per-(model, pool) and
+            # per-tenant spend from the replicas' heartbeat cost
+            # snapshots; tools/cost_summary.py renders the headline table
+            ledger = rt.registry.cost_ledger
+            if ledger is None:
+                return self._send(404, {"error": "fleet cost ledger "
+                                                 "disabled"})
+            snap = ledger.snapshot()
+            if rt.registry.aggregator is not None:
+                snap["aggregator"] = rt.registry.aggregator.stats()
+            return self._send(200, snap)
         if url.path == "/debug/fleet":
             snap = rt.registry.snapshot()
             if rt.directory is not None:
@@ -831,7 +862,9 @@ class _RouterHandler(BaseHTTPRequestHandler):
             try:
                 ok = rt.registry.heartbeat(str(body.get("replica_id") or ""),
                                            body.get("stats") or {},
-                                           prefixes=body.get("prefixes"))
+                                           prefixes=body.get("prefixes"),
+                                           metrics_snap=body.get("metrics"),
+                                           costs=body.get("costs"))
             except (TypeError, ValueError) as e:
                 return self._send(400, {"error": f"bad stats: {e}"})
             # registered:false tells the replica to re-register (evicted,
@@ -848,9 +881,14 @@ class _RouterHandler(BaseHTTPRequestHandler):
         if self.path not in _FORWARD_ROUTES:
             return self._send(404, {"error": f"no route {self.path}"})
         trace = rt.trace_ctx(self.headers.get("traceparent"))
+        # length-bound the tenant at the front door (the serving tier
+        # does the same for direct traffic) — cost-ledger cardinality
+        # must not be client-controlled beyond the replica's overflow cap
+        tenant = str(self.headers.get("X-Tenant") or "")[:64]
         if body.get("stream"):
-            return self._relay_stream(self.path, raw, trace)
-        status, out, headers = rt.forward(self.path, body, trace)
+            return self._relay_stream(self.path, raw, trace, tenant=tenant)
+        status, out, headers = rt.forward(self.path, body, trace,
+                                          tenant=tenant)
         return self._send(status, out, extra_headers=headers)
 
     def _register_prefix(self, body: dict):
@@ -917,7 +955,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
             status = 503
         return self._send(status, {"replicas": results})
 
-    def _relay_stream(self, path: str, raw: bytes, trace: dict):
+    def _relay_stream(self, path: str, raw: bytes, trace: dict,
+                      tenant: str = ""):
         rt = self.router
         started = rt.clock()
         body = rt._safe_json(raw)
@@ -927,7 +966,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
         if path != "/v1/embeddings" and rt.disagg_ready():
             prefer = rt.plan_two_hop(path, body, key, trace)
         replica, conn, resp, reason, attempts = rt.open_stream(
-            path, raw, trace, prefer=prefer, key=key)
+            path, raw, trace, prefer=prefer, key=key, tenant=tenant)
         if replica is None:
             status, body, headers = resp
             rt._outcome("rejected" if status in (429, 503) else "failed")

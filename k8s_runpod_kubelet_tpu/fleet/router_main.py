@@ -196,12 +196,19 @@ def build(cfg: config_mod.Config, kube=None, autoscale: bool = False,
         burn_threshold=cfg.fleet_slo_burn_threshold,
         budget_frac=cfg.fleet_slo_budget_frac,
         metrics=metrics, tracer=tracer)
+    # cost attribution plane (ISSUE 20): heartbeat metric snapshots merge
+    # into /metrics/fleet, cost snapshots roll up into /debug/costs
+    from ..metrics import MetricsAggregator
+    from .registry import FleetCostLedger
+    aggregator = MetricsAggregator()
+    cost_ledger = FleetCostLedger()
     registry = ReplicaRegistry(
         metrics=metrics, tracer=tracer,
         heartbeat_timeout_s=cfg.fleet_heartbeat_timeout_s,
         breaker_failure_threshold=cfg.breaker_failure_threshold,
         breaker_reset_s=cfg.breaker_reset_s,
-        directory=directory, slo=slo, scheduler=scheduler)
+        directory=directory, slo=slo, scheduler=scheduler,
+        aggregator=aggregator, cost_ledger=cost_ledger)
     router = FleetRouter(
         registry,
         RouterConfig(port=cfg.fleet_router_port,
@@ -272,7 +279,8 @@ def main(argv=None) -> int:
         serving_chips=args.serving_chips)
     httpd = serve_router(router)
     log.info("fleet router on :%d (/v1/*, /generate, /fleet/*, /metrics, "
-             "/debug/fleet)", httpd.server_address[1])
+             "/metrics/fleet, /debug/fleet, /debug/costs)",
+             httpd.server_address[1])
 
     stop = threading.Event()
     # eviction sweep at the heartbeat cadence: a dead replica is suspect

@@ -40,6 +40,8 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ..metrics import RestartGuard
+
 log = logging.getLogger(__name__)
 
 SIGNALS = ("ttft", "itl", "error_rate")
@@ -94,10 +96,14 @@ class SLOTracker:
         # per-signal deques of (t, breached) observations, pruned past
         # the long window (the short window is a suffix of the long one)
         self._samples = {s: collections.deque() for s in SIGNALS}
-        # per-replica last cumulative counters, for error-rate deltas; a
-        # restart (counter going backwards) resets the baseline instead
-        # of producing a negative delta
-        self._counters: dict[str, tuple[int, int]] = {}
+        # per-replica cumulative-counter guards for error-rate deltas
+        # (metrics.RestartGuard, extracted from the idiom born here):
+        # first sighting and post-restart beats both contribute ZERO —
+        # an old error total is history, not a fresh breach signal
+        self._err_guard = RestartGuard(count_first=False,
+                                       count_restart=False)
+        self._req_guard = RestartGuard(count_first=False,
+                                       count_restart=False)
         self._burning = {s: False for s in SIGNALS}
         self._crossings = {s: 0 for s in SIGNALS}
         self._history = collections.deque(maxlen=_HISTORY_LIMIT)
@@ -177,16 +183,14 @@ class SLOTracker:
                            "replica_id": replica_id})
 
     def _error_breach(self, replica_id: str, stats) -> bool:
-        errors = int(getattr(stats, "errors_total", 0))
-        requests = int(getattr(stats, "requests_total", 0))
-        prev = self._counters.get(replica_id)
-        self._counters[replica_id] = (errors, requests)
-        if prev is None:
-            return False
-        d_err = errors - prev[0]
-        d_req = requests - prev[1]
-        if d_err < 0 or d_req < 0:  # replica restarted: new baseline
-            return False
+        # guards zero out first-sight and restart beats, so a replica
+        # restart (counters going backwards) re-baselines instead of
+        # subtracting its whole history — and a beat where only ONE
+        # counter reset still can't breach (d_req clamps to 0)
+        d_err = self._err_guard.delta(
+            replica_id, int(getattr(stats, "errors_total", 0)))
+        d_req = self._req_guard.delta(
+            replica_id, int(getattr(stats, "requests_total", 0)))
         if d_req <= 0:
             return False
         return d_err / d_req > self.objectives["error_rate"]
@@ -212,7 +216,8 @@ class SLOTracker:
         """Drop a replica's error-counter baseline (evict/deregister):
         its next registration starts a fresh delta stream."""
         with self._lock:
-            self._counters.pop(replica_id, None)
+            self._err_guard.forget(replica_id)
+            self._req_guard.forget(replica_id)
 
     # -- reads -----------------------------------------------------------------
 
@@ -253,6 +258,7 @@ class SLOTracker:
                 }
             history = [{"t": t, "burn": dict(b)} for t, b in self._history]
         return {"enabled": True,
+                "schema_version": 1,
                 "burn_threshold": self.burn_threshold,
                 "budget_frac": self.budget_frac,
                 "windows": {"short_s": self.short_window_s,

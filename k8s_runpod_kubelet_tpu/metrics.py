@@ -21,15 +21,24 @@ from typing import Optional
 
 _DEFAULT_BUCKETS = (0.5, 1, 2.5, 5, 10, 30, 60, 120, 300, 600, 1800)
 
+# heartbeat metric-snapshot wire shape (Metrics.snapshot /
+# MetricsAggregator.ingest); readers warn, not crash, on unknown versions
+SNAPSHOT_SCHEMA_VERSION = 1
+
 
 class _Hist:
     """Fixed-size cumulative buckets + sum/count, plus a bounded tail of raw
     observations for tests/debugging — memory stays O(buckets) for a process
     meant to run for months. Bucket bounds are per-histogram (describe(...,
     buckets=...)): sub-second TTFT/ITL histograms must not be crushed into a
-    0.5s first bucket sized for pod-provisioning latencies."""
+    0.5s first bucket sized for pod-provisioning latencies.
 
-    __slots__ = ("buckets", "bucket_counts", "sum", "count", "recent")
+    Exemplars: each bucket (plus +Inf) keeps at most the LATEST
+    ``(trace_id, value)`` pair observed into it — O(buckets) storage, enough
+    for "p99 bucket -> trace_id -> /debug/traces waterfall" navigation."""
+
+    __slots__ = ("buckets", "bucket_counts", "sum", "count", "recent",
+                 "exemplars")
 
     def __init__(self, buckets: tuple = _DEFAULT_BUCKETS):
         self.buckets = buckets
@@ -37,11 +46,21 @@ class _Hist:
         self.sum = 0.0
         self.count = 0
         self.recent: list[float] = []
+        # one slot per bucket + one for +Inf; None or (trace_id, value)
+        self.exemplars: list = [None] * (len(buckets) + 1)
 
-    def observe(self, value: float):
+    def observe(self, value: float, exemplar: Optional[str] = None):
+        placed = exemplar is None
         for i, b in enumerate(self.buckets):
             if value <= b:
                 self.bucket_counts[i] += 1
+                if not placed:
+                    # attach to the LOWEST bucket containing the value (the
+                    # bucket a non-cumulative view would file it under)
+                    self.exemplars[i] = (exemplar, value)
+                    placed = True
+        if not placed:
+            self.exemplars[len(self.buckets)] = (exemplar, value)
         self.sum += value
         self.count += 1
         self.recent.append(value)
@@ -93,14 +112,18 @@ class Metrics:
         with self.lock:
             self.gauges.pop(self._key(name, labels), None)
 
-    def observe(self, name: str, value: float, labels: Optional[dict] = None):
+    def observe(self, name: str, value: float, labels: Optional[dict] = None,
+                exemplar: Optional[str] = None):
+        """Record one histogram observation. ``exemplar`` is an optional
+        trace_id; the containing bucket keeps the latest one so exposition
+        can link a tail bucket straight to a replayable trace."""
         with self.lock:
             key = self._key(name, labels)
             h = self.histograms.get(key)
             if h is None:
                 h = self.histograms[key] = _Hist(
                     self.bucket_spec.get(name, _DEFAULT_BUCKETS))
-            h.observe(value)
+            h.observe(value, exemplar=exemplar)
 
     def time_block(self, name: str, labels: Optional[dict] = None):
         return _Timer(self, name, labels)
@@ -165,16 +188,60 @@ class Metrics:
                 if hist_items:
                     self._header(out, name, name, "histogram")
                     for (_, lbls), h in hist_items:
-                        for b, c in zip(h.buckets, h.bucket_counts):
+                        for i, (b, c) in enumerate(zip(h.buckets,
+                                                       h.bucket_counts)):
                             lb = dict(lbls)
                             lb["le"] = str(b)
-                            out.append(f"{name}_bucket{self._labels_str(tuple(sorted(lb.items())))} {c}")
+                            out.append(f"{name}_bucket{self._labels_str(tuple(sorted(lb.items())))} {c}"
+                                       f"{self._exemplar_str(h.exemplars[i])}")
                         lb = dict(lbls)
                         lb["le"] = "+Inf"
-                        out.append(f"{name}_bucket{self._labels_str(tuple(sorted(lb.items())))} {h.count}")
+                        out.append(f"{name}_bucket{self._labels_str(tuple(sorted(lb.items())))} {h.count}"
+                                   f"{self._exemplar_str(h.exemplars[len(h.buckets)])}")
                         out.append(f"{name}_sum{self._labels_str(lbls)} {h.sum}")
                         out.append(f"{name}_count{self._labels_str(lbls)} {h.count}")
         return "\n".join(out) + "\n"
+
+    @classmethod
+    def _exemplar_str(cls, ex) -> str:
+        """OpenMetrics exemplar suffix for a _bucket sample:
+        ``... # {trace_id="abc"} 0.07``. Timestamp deliberately omitted so
+        fleet-merged exposition stays byte-deterministic."""
+        if ex is None:
+            return ""
+        trace_id, value = ex
+        return f' # {{trace_id="{cls._esc_label(trace_id)}"}} {value}'
+
+    # -- heartbeat snapshot / fleet merge -------------------------------------
+
+    def snapshot(self) -> dict:
+        """Compact JSON-safe dump of every counter/gauge/histogram with
+        metadata (help + bucket bounds). Cumulative, so it can ride every
+        fleet heartbeat idempotently; ``MetricsAggregator.ingest`` turns a
+        stream of these into fleet-wide totals with restart guards."""
+        with self.lock:
+            hists = []
+            for (n, lbls), h in sorted(self.histograms.items()):
+                hists.append([n, [list(p) for p in lbls], {
+                    "buckets": list(h.buckets),
+                    "bucket_counts": list(h.bucket_counts),
+                    "sum": h.sum,
+                    "count": h.count,
+                    "exemplars": [[i, ex[0], ex[1]]
+                                  for i, ex in enumerate(h.exemplars)
+                                  if ex is not None],
+                }])
+            return {
+                "schema_version": SNAPSHOT_SCHEMA_VERSION,
+                "counters": [[n, [list(p) for p in lbls], v]
+                             for (n, lbls), v in sorted(self.counters.items())],
+                "gauges": [[n, [list(p) for p in lbls], v]
+                           for (n, lbls), v in sorted(self.gauges.items())],
+                "hists": hists,
+                "help": dict(self.help),
+                "bucket_spec": {k: list(v)
+                                for k, v in self.bucket_spec.items()},
+            }
 
 
 class _Timer:
@@ -187,3 +254,195 @@ class _Timer:
 
     def __exit__(self, *exc):
         self.m.observe(self.name, self.m._clock() - self.t0, self.labels)
+
+
+class RestartGuard:
+    """Non-negative delta extraction from cumulative counters pushed by
+    restartable processes — the SLOTracker idiom (fleet/slo.py), extracted
+    so every heartbeat-merged counter shares one guard class.
+
+    A replica restart resets its in-process counters to ~0, so a cumulative
+    push can go BACKWARDS; naively differencing would subtract the replica's
+    whole history from a fleet total. Policy knobs:
+
+    - ``count_first``: on the first sighting of a key, is the full cumulative
+      value the delta (fleet totals: yes — traffic before the aggregator
+      existed still happened) or zero (SLO windows: no — an old error total
+      is not a fresh breach signal)?
+    - ``count_restart``: after a detected reset, is the new (small) cumulative
+      value the delta (fleet totals: yes — it accrued since restart) or zero
+      (SLO windows: conservative skip, re-baseline)?
+
+    Deltas are never negative under either policy."""
+
+    def __init__(self, count_first: bool = True, count_restart: bool = True):
+        self._prev: dict = {}
+        self._count_first = count_first
+        self._count_restart = count_restart
+
+    def delta(self, key, value: float) -> float:
+        prev = self._prev.get(key)
+        value = float(value)
+        self._prev[key] = value
+        if prev is None:
+            return value if self._count_first else 0.0
+        d = value - prev
+        if d < 0:
+            return value if self._count_restart else 0.0
+        return d
+
+    def forget(self, owner):
+        """Drop every baseline whose key's first element is ``owner`` (keys
+        are ``(replica_id, ...)`` tuples by convention) — a deregistered
+        replica that re-registers must be treated as fresh."""
+        stale = [k for k in self._prev
+                 if (isinstance(k, tuple) and k and k[0] == owner)
+                 or k == owner]
+        for k in stale:
+            del self._prev[k]
+
+
+class MetricsAggregator:
+    """Registry-tier fleet-wide metric merge: replicas push cumulative
+    ``Metrics.snapshot()`` payloads on the existing heartbeat; this class
+    folds them into one merged registry whose ``render()`` is served as
+    ``GET /metrics/fleet``.
+
+    Merge semantics:
+
+    - counters and histogram bucket/sum/count: per-(replica, series)
+      RestartGuard deltas accumulated into fleet totals that SURVIVE replica
+      exit (a dead replica's traffic still happened — fleet counters never
+      dip);
+    - gauges: latest per replica, SUMMED across live replicas at render time
+      (queue depths, KV pages); dropped on ``forget``;
+    - exemplars: incoming per-bucket exemplars overwrite the merged slot
+      (best-effort latest — any surviving exemplar must resolve via
+      /debug/traces, which push order does not change);
+    - help text and bucket bounds ride the snapshot, so the merged
+      exposition is line-identical to a single process observing the union
+      stream (tests/test_metrics_merge.py pins this property)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self._guard = RestartGuard()          # count_first/count_restart True
+        self._merged = Metrics()
+        self._replica_gauges: dict[str, dict] = {}
+        self._hist_prev: dict[tuple, dict] = {}   # (rid, key) -> prev state
+        self._last_ingest: dict[str, int] = {}    # rid -> snapshots ingested
+        self._schema_warned: set = set()
+
+    @staticmethod
+    def _norm_key(name, lbls) -> tuple:
+        return name, tuple(sorted((k, v) for k, v in (tuple(p) for p in lbls)))
+
+    def ingest(self, replica_id: str, snap: Optional[dict]):
+        """Fold one replica heartbeat snapshot into the fleet merge.
+        Malformed payloads are dropped whole (a bad replica must not poison
+        the fleet view); unknown schema versions are skipped with one log
+        line worth of state (the caller logs)."""
+        if not isinstance(snap, dict):
+            return
+        ver = snap.get("schema_version")
+        if ver != SNAPSHOT_SCHEMA_VERSION:
+            self._schema_warned.add((replica_id, ver))
+            return
+        with self.lock:
+            m = self._merged
+            with m.lock:
+                m.help.update({str(k): str(v)
+                               for k, v in (snap.get("help") or {}).items()})
+                for name, bounds in (snap.get("bucket_spec") or {}).items():
+                    m.bucket_spec[str(name)] = tuple(float(b) for b in bounds)
+                for name, lbls, value in snap.get("counters") or ():
+                    key = self._norm_key(name, lbls)
+                    d = self._guard.delta((replica_id, "c", key), value)
+                    m.counters[key] = m.counters.get(key, 0.0) + d
+                gauges = {}
+                for name, lbls, value in snap.get("gauges") or ():
+                    gauges[self._norm_key(name, lbls)] = float(value)
+                self._replica_gauges[replica_id] = gauges
+                for name, lbls, state in snap.get("hists") or ():
+                    self._ingest_hist(replica_id, self._norm_key(name, lbls),
+                                      state)
+            self._last_ingest[replica_id] = \
+                self._last_ingest.get(replica_id, 0) + 1
+
+    def _ingest_hist(self, replica_id: str, key: tuple, state: dict):
+        """Apply one histogram's cumulative snapshot as deltas. Restart is
+        detected on the count going backwards (ints, monotonic per process);
+        the whole prev baseline is then discarded so the new cumulative
+        state counts once, like the counter guard."""
+        # keep bound values EXACTLY as snapshotted (no float coercion): the
+        # le="..." label is str(bound), and line-identity with the source
+        # process needs int bounds to stay ints
+        buckets = tuple(state.get("buckets") or ())
+        counts = [int(c) for c in state.get("bucket_counts") or ()]
+        if not buckets or len(counts) != len(buckets):
+            return
+        h = self._merged.histograms.get(key)
+        if h is None:
+            h = self._merged.histograms[key] = _Hist(buckets)
+        elif h.buckets != buckets:
+            return  # replicas disagree on bounds: refuse a corrupt merge
+        pkey = (replica_id, "h", key)
+        prev = self._hist_prev.get(pkey)
+        count = int(state.get("count") or 0)
+        if prev is not None and count < prev["count"]:
+            prev = None  # replica restarted: new baseline, count it whole
+        if prev is None:
+            prev = {"bucket_counts": [0] * len(buckets),
+                    "sum": 0.0, "count": 0}
+        for i, c in enumerate(counts):
+            h.bucket_counts[i] += max(0, c - prev["bucket_counts"][i])
+        h.sum += float(state.get("sum") or 0.0) - prev["sum"]
+        h.count += max(0, count - prev["count"])
+        for entry in state.get("exemplars") or ():
+            try:
+                i, trace_id, value = entry
+                i = int(i)
+            except (TypeError, ValueError):
+                continue
+            if 0 <= i < len(h.exemplars):
+                h.exemplars[i] = (trace_id, float(value))
+        self._hist_prev[pkey] = {"bucket_counts": counts,
+                                 "sum": float(state.get("sum") or 0.0),
+                                 "count": count}
+
+    def forget(self, replica_id: str):
+        """Replica left the fleet: drop its gauge contributions and delta
+        baselines. Counter and histogram TOTALS stay — fleet history is not
+        un-happened by a deregistration."""
+        with self.lock:
+            self._replica_gauges.pop(replica_id, None)
+            self._guard.forget(replica_id)
+            for k in [k for k in self._hist_prev if k[0] == replica_id]:
+                del self._hist_prev[k]
+            self._last_ingest.pop(replica_id, None)
+
+    def render(self) -> str:
+        """Merged Prometheus/OpenMetrics exposition for GET /metrics/fleet."""
+        with self.lock:
+            agg: dict = {}
+            for per in self._replica_gauges.values():
+                for k, v in per.items():
+                    agg[k] = agg.get(k, 0.0) + v
+            with self._merged.lock:
+                self._merged.gauges = agg
+            return self._merged.render()
+
+    def stats(self) -> dict:
+        """Aggregation-plane introspection for /debug/costs."""
+        with self.lock:
+            return {
+                "replicas": dict(self._last_ingest),
+                "series": {
+                    "counters": len(self._merged.counters),
+                    "gauges": sum(len(g)
+                                  for g in self._replica_gauges.values()),
+                    "histograms": len(self._merged.histograms),
+                },
+                "schema_skews": sorted(
+                    [[rid, ver] for rid, ver in self._schema_warned],
+                    key=str),
+            }

@@ -26,6 +26,8 @@ from __future__ import annotations
 import json
 import logging
 
+from ..cloud.types import lookup_accelerator
+from ..generations import cost_per_chip_hr, generation_of
 from ..kube.client import KubeApiError
 from ..workloads.telemetry import TELEMETRY_PATTERN
 from .annotations import Annotations as A
@@ -198,18 +200,40 @@ class TrainingWatchMixin:
 
     def training_status(self) -> dict:
         """/debug/train on the kubelet health server: the per-pod training
-        telemetry the reconcile loop has scraped."""
+        telemetry the reconcile loop has scraped, joined with chip-second
+        spend (ISSUE 20) so tools/cost_summary.py can report training and
+        serving dollars side by side from one JSONL."""
+        now = self.clock()
         with self.lock:
             pods = {}
             for key, info in self.instances.items():
                 if info.train_last_step is None:
                     continue
-                pods[key] = {
+                entry = {
                     "last_step": info.train_last_step,
                     "stalled": info.train_stalled,
                     "last_advance_age_s": round(
-                        self.clock() - info.train_step_at, 3)
+                        now - info.train_step_at, 3)
                     if info.train_step_at is not None else None,
                     "slice": info.qr_name,
                 }
-        return {"pods": pods, "stall_timeout_s": self.cfg.stall_timeout_s}
+                # cost join: chips x elapsed-since-first-telemetry-probe,
+                # priced off the ONE generations.py table (the scrape
+                # epoch slightly undercounts provisioning time — the
+                # slice's own binding annotations carry the full-lease
+                # cost rate; this is the TRAINING-attributed share)
+                acc = str(getattr(info, "accelerator_type", "") or "")
+                first = getattr(info, "train_first_probe_at", None)
+                if acc and first is not None:
+                    gen = generation_of(acc)
+                    spec = lookup_accelerator(acc)
+                    chips = spec.chips if spec is not None else 0
+                    chip_seconds = chips * max(0.0, now - first)
+                    entry["generation"] = gen
+                    entry["chips"] = chips
+                    entry["chip_seconds"] = round(chip_seconds, 3)
+                    entry["cost_dollars"] = round(
+                        chip_seconds * cost_per_chip_hr(gen) / 3600.0, 6)
+                pods[key] = entry
+        return {"schema_version": 1, "pods": pods,
+                "stall_timeout_s": self.cfg.stall_timeout_s}

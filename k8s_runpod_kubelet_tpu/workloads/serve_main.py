@@ -172,6 +172,15 @@ class _Handler(BaseHTTPRequestHandler):
                  "span_id": root},
                 {"traceparent": format_traceparent(trace_id, root)})
 
+    def _tenant_kw(self, body: dict) -> dict:
+        """Cost-attribution tenant (ISSUE 20 — the ROADMAP item-4
+        accounting seam): the optional ``X-Tenant`` header wins (the fleet
+        router forwards it verbatim); the OpenAI ``user`` field is the
+        SDK-compatible fallback. Length-bounded — the string becomes a
+        cost-ledger key, and the ledger caps tenant cardinality."""
+        tenant = self.headers.get("X-Tenant") or body.get("user") or ""
+        return {"tenant": str(tenant)[:64]}
+
     def _overloaded(self, e, openai: bool = False):
         """429 + Retry-After for an EngineOverloaded admission rejection —
         the bounded-latency contract's client-visible half. An
@@ -231,6 +240,14 @@ class _Handler(BaseHTTPRequestHandler):
                 (q.get("trace_id") or [""])[0]))
         if url.path == "/debug/engine":
             return self._send(200, self.engine.debug_snapshot())
+        if url.path == "/debug/costs":
+            # replica cost ledger (ISSUE 20): cumulative per-tenant
+            # chip-seconds/dollars; the same snapshot rides the fleet
+            # heartbeat into the router's fleet-wide /debug/costs
+            if self.engine.costmeter is None:
+                return self._send(404, {"error": "cost meter disabled "
+                                                 "(--cost-meter off)"})
+            return self._send(200, self.engine.costmeter.snapshot())
         if url.path == "/debug/steps":
             # flight-recorder tail + rollup (ISSUE 17): newest-n step
             # records (oldest first) plus phase/occupancy medians and the
@@ -1175,7 +1192,8 @@ class _Handler(BaseHTTPRequestHandler):
                                  stop=stop, stop_text=stop_strs,
                                  logprobs=bool(req.get("logprobs")),
                                  adapter=req.get("adapter") or "",
-                                 seed=req.get("seed"), **trace_kw)
+                                 seed=req.get("seed"), **trace_kw,
+                                 **self._tenant_kw(req))
         try:
             out = fut.result(timeout=self.request_timeout_s)
         except FutureTimeout:
@@ -1416,6 +1434,7 @@ class _Handler(BaseHTTPRequestHandler):
                                               "type": "invalid_request_error"}})
         trace_kw, trace_hdrs = self._trace_ctx()
         kw.update(trace_kw)
+        kw.update(self._tenant_kw(req))
         # ns-scale wall stamp + process-wide counter: unique even when an
         # injected test clock stands still
         ns = int(self.clock() * 1e9) + next(_RID_SEQ)
@@ -1623,7 +1642,7 @@ class _Handler(BaseHTTPRequestHandler):
                   frequency_penalty=_or(req.get("frequency_penalty"), 0.0),
                   logit_bias=req.get("logit_bias"),
                   adapter=req.get("adapter") or "", seed=req.get("seed"),
-                  **trace_kw)
+                  **trace_kw, **self._tenant_kw(req))
 
         def line(payload: dict) -> bytes:
             return (json.dumps(payload) + "\n").encode()
@@ -1969,6 +1988,14 @@ def main(argv=None) -> int:
                         "/debug/steps, folded into serving.request spans "
                         "(default from config/"
                         "TPU_SERVING_FLIGHT_RECORDER, on)")
+    p.add_argument("--cost-meter", default=None, choices=["on", "off"],
+                   dest="serving_cost_meter",
+                   help="per-request chip-second/dollar attribution "
+                        "(ISSUE 20): phase walls priced via the "
+                        "generations.py table, per-tenant ledger at GET "
+                        "/debug/costs, zero-seeded cost metrics, span "
+                        "cost attrs (default from config/"
+                        "TPU_SERVING_COST_METER, on)")
     p.add_argument("--profiler-port", type=int, default=None,
                    dest="serving_profiler_port",
                    help="start the on-demand jax.profiler server on this "
@@ -2052,6 +2079,9 @@ def main(argv=None) -> int:
     flight_recorder = (base_cfg.serving_flight_recorder
                        if args.serving_flight_recorder is None
                        else args.serving_flight_recorder == "on")
+    cost_meter = (base_cfg.serving_cost_meter
+                  if args.serving_cost_meter is None
+                  else args.serving_cost_meter == "on")
     profiler_port = (args.serving_profiler_port
                      if args.serving_profiler_port is not None
                      else base_cfg.serving_profiler_port)
@@ -2148,6 +2178,7 @@ def main(argv=None) -> int:
         kv_arena_sharding=kv_arena_sharding,
         serving_chunk_tokens=serving_chunk_tokens,
         flight_recorder=flight_recorder,
+        cost_meter=cost_meter,
         # text mode stops at the tokenizer's EOS instead of always burning
         # the full max_new_tokens budget
         eos_token=(tokenizer.eos_id if tokenizer is not None else -1)),

@@ -41,6 +41,7 @@ reads must not race them).
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import threading
 import time
@@ -56,6 +57,7 @@ import numpy as np
 from ...metrics import Metrics
 from ...models.llama import LlamaConfig, LlamaModel, Params
 from ...tracing import Tracer
+from .costmeter import CostMeter
 from .kv_manager import DensePrefixStore, PagedKVStore, kv_cache_pspec  # noqa: F401 — kv_cache_pspec re-exported (layout contract)
 from .recorder import STEP_BUCKETS, CompileWatchdog, FlightRecorder
 from .sampler import (_apply_penalties, _bias_row, _bump_counts,
@@ -598,6 +600,19 @@ class ServingEngine:
             att("kv_gather", getattr(self._kv_store, "_gather", None))
         self.total_generated = 0
         self.last_error: Optional[str] = None
+        # cost meter (ISSUE 20): per-request chip-second/dollar attribution
+        # through the generations.py price table, keyed (model, pool,
+        # tenant). None when off — completion pays one `is not None` test
+        # and nothing else (the flight-recorder bargain). One call per
+        # COMPLETED request keeps it far under the 2% hot-loop bar.
+        self.costmeter: Optional[CostMeter] = None
+        if sc.cost_meter:
+            self.costmeter = CostMeter(
+                self.metrics, model=cfg.name,
+                accelerator=os.environ.get("TPU_ACCELERATOR_TYPE", ""),
+                chips=int(mesh.devices.size) if mesh is not None else 1,
+                pool=os.environ.get("TPU_SERVING_POOL", ""),
+                clock=self._perf)
 
     @staticmethod
     def _describe_metrics(m: Metrics):
@@ -766,6 +781,16 @@ class ServingEngine:
                    "function — any rise is a cache-key flap (changed "
                    "avals, shardings, or donation pattern recompiling "
                    "the hot loop)")
+        # zero-seed every heartbeat-merged counter (ISSUE 20): the fleet
+        # reporter reads these cumulative and the registry tier
+        # differences them per beat (SLO windows, scheduler matrix, the
+        # metrics merge) — a series that first appears mid-flight reads
+        # as a restart to the guards. graftlint's merged-counter rule
+        # pins each name to a seed site like this one.
+        m.incr("tpu_serving_admitted", 0)
+        m.incr("tpu_serving_decode_steps", 0)
+        m.incr("tpu_serving_engine_errors", 0)
+        m.incr("tpu_serving_prefill_errors", 0)
 
     def _fresh_cache(self, batch: int) -> Params:
         """One construction path for every cache this engine makes (the
@@ -859,7 +884,8 @@ class ServingEngine:
                stop_text: Optional[list] = None, logprobs: bool = False,
                adapter: str = "", seed: Optional[int] = None,
                on_token=None, trace_id: str = "", parent_span: str = "",
-               span_id: str = "", _build_only: bool = False):
+               span_id: str = "", tenant: str = "",
+               _build_only: bool = False):
         """Enqueue a generation request; resolves to {tokens, latency_s, rid}
         (+ per-token "logprobs" when requested). ``on_token(tok)`` streams
         each generated token id as it decodes. ``top_k``/``top_p`` filter
@@ -984,7 +1010,8 @@ class ServingEngine:
                       adapter_id=adapter_id, seed=seed & 0xFFFFFFFF,
                       on_token=on_token, trace_id=str(trace_id or ""),
                       span_id=str(span_id or ""),
-                      parent_span_id=str(parent_span or ""))
+                      parent_span_id=str(parent_span or ""),
+                      tenant=str(tenant or ""))
         if _build_only:
             return req
         with self._admit_lock:  # atomic check+put: racing submits must not
@@ -1185,6 +1212,8 @@ class ServingEngine:
             handoff_inflight = self.handoff_inflight
             handoffs_total = self.handoffs_total
         return {
+            # /debug/engine wire shape; tools warn on unknown versions
+            "schema_version": 1,
             "model": self.cfg.name,
             "alive": self.alive,
             "draining": self.draining,
@@ -2823,8 +2852,11 @@ class ServingEngine:
         now = self._perf()
         req.first_token_at = now
         slot.last_emit_at = now
+        # exemplar: the tail TTFT bucket links straight to a replayable
+        # trace (/debug/traces), fleet-wide once heartbeats merge it
         self.metrics.observe("tpu_serving_ttft_seconds",
-                             now - req.submitted_at)
+                             now - req.submitted_at,
+                             exemplar=req.trace_id or None)
         self._emit(slot, first)
         self.metrics.incr("tpu_serving_admitted")
 
@@ -3491,7 +3523,7 @@ class ServingEngine:
         return False
 
     def _record_request_spans(self, req: Request, slot: _Slot,
-                              latency: float):
+                              latency: float, cost: Optional[dict] = None):
         """The request's span tree, recorded retroactively from the
         timestamps the threads already keep (no live span objects cross the
         submit/prefill/engine threads). Children are CONTIGUOUS — queue-wait
@@ -3528,6 +3560,11 @@ class ServingEngine:
                 attrs["decode_steps"] = acc["steps"]
                 attrs["step_wall_share_s"] = round(acc["step_wall_s"], 6)
                 attrs["step_kernel_share_s"] = round(acc["kernel_s"], 6)
+        if cost is not None and self.costmeter is not None:
+            # cost attribution (ISSUE 20): dollars + per-phase chip-seconds
+            # + KV page-seconds ride the request root span, so a trace
+            # waterfall prices itself
+            attrs.update(self.costmeter.span_attrs(cost))
         tr.record("serving.request", wall(req.submitted_at), end,
                   trace_id=trace_id, span_id=root,
                   parent_id=req.parent_span_id, attrs=attrs)
@@ -3552,6 +3589,9 @@ class ServingEngine:
         req = slot.request
         slot.request = None
         self._slot_adapter[slot_id] = 0
+        # KV page-seconds need the slot's page count AT COMPLETION — capture
+        # before release empties the list
+        pages_end = len(slot.pages)
         if self._paged_loop and slot.pages:
             # drop the slot's references: shared prefix pages stay in the
             # trie for the next hit, private tail pages free immediately.
@@ -3564,9 +3604,20 @@ class ServingEngine:
             slot.table_len = 0
             self._page_tables_np[slot_id][:] = 0
         latency = self._perf() - req.submitted_at
-        self.metrics.observe("tpu_serving_request_latency_seconds", latency)
+        self.metrics.observe("tpu_serving_request_latency_seconds", latency,
+                             exemplar=req.trace_id or None)
+        cost = None
+        if self.costmeter is not None:
+            try:
+                cost = self.costmeter.meter_request(
+                    req, end_at=req.submitted_at + latency,
+                    generated_tokens=len(slot.generated),
+                    pages_end=pages_end,
+                    page_tokens=self.sc.kv_page_tokens)
+            except Exception:  # noqa: BLE001 — metering must never fail a request
+                log.exception("cost metering for %s failed", req.rid)
         try:
-            self._record_request_spans(req, slot, latency)
+            self._record_request_spans(req, slot, latency, cost=cost)
         except Exception:  # noqa: BLE001 — tracing must never fail a request
             log.exception("span recording for %s failed", req.rid)
         out = {"rid": req.rid, "tokens": slot.generated,

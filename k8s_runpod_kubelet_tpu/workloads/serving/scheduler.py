@@ -165,6 +165,14 @@ class ServingConfig:
     flight_recorder: bool = True
     recorder_steps: int = 512
     recorder_bytes: int = 262144
+    # -- cost attribution (ISSUE 20) -------------------------------------
+    # per-request chip-second metering (workloads/serving/costmeter.py):
+    # phase walls the engine already stamps (queue/prefill/decode) priced
+    # through the generations.py table, KV page-seconds of arena occupancy,
+    # per-tenant ledger, idle-burn gauge. Off = the engine holds no meter;
+    # the hot path pays one `is not None` test per completion and nothing
+    # else (the flight-recorder bargain).
+    cost_meter: bool = True
 
 
 class EngineOverloaded(RuntimeError):
@@ -250,6 +258,12 @@ class Request:
     # (0 = full prefill). Rides the serving.request span as
     # prefix_hit/matched_prefix_tokens attrs.
     matched_prefix_tokens: int = 0
+    # cost-attribution tenant (ISSUE 20, the ROADMAP item-4 accounting
+    # seam): optional X-Tenant header / OpenAI `user` field, threaded
+    # router -> HTTP layer -> engine. Empty = unattributed ("-" in the
+    # ledger). Purely an accounting label today; per-tenant QoS will hang
+    # admission policy off the same field.
+    tenant: str = ""
 
 
 @dataclasses.dataclass

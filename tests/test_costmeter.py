@@ -1,0 +1,180 @@
+"""CostMeter unit contract (ISSUE 20): per-phase chip-seconds TELESCOPE
+exactly to request wall x chips, dollars come from the ONE generations.py
+price table, the tenant ledger is cardinality-bounded, idle burn is
+paid-minus-attributed, and the snapshot schema is pinned to what the
+registry-tier FleetCostLedger (jax-free, so it duplicates the literal)
+expects. No jax, no sockets — a fake clock and a real Metrics registry.
+"""
+
+from __future__ import annotations
+
+import math
+
+import pytest
+
+from k8s_runpod_kubelet_tpu.fleet import registry as fleet_registry
+from k8s_runpod_kubelet_tpu.generations import cost_per_chip_hr
+from k8s_runpod_kubelet_tpu.metrics import Metrics
+from k8s_runpod_kubelet_tpu.workloads.serving.costmeter import (
+    COSTS_SCHEMA_VERSION, MAX_TENANTS, NO_TENANT, OVERFLOW_TENANT, PHASES,
+    CostMeter)
+from k8s_runpod_kubelet_tpu.workloads.serving.scheduler import Request
+
+
+def _req(submitted=0.0, dequeued=0.0, prefill_done=0.0, prompt_len=8,
+         tenant="", trace_id=""):
+    return Request(prompt=list(range(prompt_len)), max_new_tokens=4,
+                   rid="r", future=None, submitted_at=submitted,
+                   temperature=0.0, dequeued_at=dequeued,
+                   prefill_done_at=prefill_done, tenant=tenant,
+                   trace_id=trace_id)
+
+
+def _meter(chips=4, accelerator="v5litepod-8", clock=None, **kw):
+    t = [0.0]
+    clk = clock if clock is not None else (lambda: t[0])
+    m = CostMeter(Metrics(), model="test-model", accelerator=accelerator,
+                  chips=chips, clock=clk, **kw)
+    return m, t
+
+
+def test_phases_telescope_to_wall_times_chips():
+    m, _ = _meter(chips=4)
+    req = _req(submitted=10.0, dequeued=10.5, prefill_done=11.25)
+    attr = m.meter_request(req, end_at=13.0, generated_tokens=7,
+                           pages_end=3, page_tokens=16)
+    cs = attr["chip_seconds"]
+    assert cs["queue"] == pytest.approx(0.5 * 4)
+    assert cs["prefill"] == pytest.approx(0.75 * 4)
+    assert cs["decode"] == pytest.approx(1.75 * 4)
+    # the acceptance identity: sum of phases == wall x chips, EXACTLY
+    assert math.isclose(sum(cs.values()), (13.0 - 10.0) * 4,
+                        rel_tol=0, abs_tol=1e-9)
+
+
+def test_missing_boundary_stamps_still_telescope():
+    # a failed prefill never stamps prefill_done_at (0.0); the monotone
+    # clamp must keep the identity instead of producing a negative phase
+    m, _ = _meter(chips=2)
+    req = _req(submitted=5.0, dequeued=5.5, prefill_done=0.0)
+    attr = m.meter_request(req, end_at=6.0, generated_tokens=0,
+                           pages_end=0, page_tokens=16)
+    cs = attr["chip_seconds"]
+    assert all(v >= 0 for v in cs.values())
+    assert sum(cs.values()) == pytest.approx((6.0 - 5.0) * 2)
+    # never-dequeued either (rejected in queue)
+    req = _req(submitted=7.0, dequeued=0.0, prefill_done=0.0)
+    attr = m.meter_request(req, end_at=8.0, generated_tokens=0,
+                           pages_end=0, page_tokens=16)
+    assert sum(attr["chip_seconds"].values()) == pytest.approx(2.0)
+
+
+def test_dollars_come_from_the_generations_price_table():
+    m, _ = _meter(chips=8, accelerator="v5litepod-8")
+    req = _req(submitted=0.0, dequeued=0.0, prefill_done=1.0)
+    attr = m.meter_request(req, end_at=2.0, generated_tokens=4,
+                           pages_end=1, page_tokens=16)
+    # 2s wall x 8 chips = 16 chip-seconds at the v5e list price
+    want = 16.0 * cost_per_chip_hr("v5litepod-8") / 3600.0
+    assert attr["cost_dollars"] == pytest.approx(want)
+    assert m.generation == "v5e"
+
+
+def test_kv_page_seconds_trapezoid():
+    m, _ = _meter(chips=1)
+    # 32-token prompt / 16-token pages = 2 prefill pages; grew to 6 by end
+    req = _req(submitted=0.0, dequeued=0.0, prefill_done=2.0, prompt_len=32)
+    attr = m.meter_request(req, end_at=6.0, generated_tokens=64,
+                           pages_end=6, page_tokens=16)
+    # prefill: 2 pages x 2s; decode: mean (2+6)/2 pages x 4s
+    assert attr["kv_page_seconds"] == pytest.approx(2 * 2.0 + 4.0 * 4.0)
+
+
+def test_tenant_ledger_and_overflow_cap():
+    m, _ = _meter(chips=1)
+    kw = dict(end_at=1.0, generated_tokens=1, pages_end=1, page_tokens=16)
+    m.meter_request(_req(tenant=""), **kw)          # untagged -> "-"
+    m.meter_request(_req(tenant="acme"), **kw)
+    m.meter_request(_req(tenant="acme"), **kw)
+    snap = m.snapshot()
+    assert snap["tenants"][NO_TENANT]["requests"] == 1
+    assert snap["tenants"]["acme"]["requests"] == 2
+    # cardinality bound: past MAX_TENANTS distinct names, new tenants fold
+    # into the overflow bucket — spend still counts, just not separably
+    for i in range(MAX_TENANTS + 10):
+        m.meter_request(_req(tenant=f"tenant-{i:03d}"), **kw)
+    snap = m.snapshot()
+    assert len(snap["tenants"]) <= MAX_TENANTS + 1  # +1: overflow bucket
+    assert snap["tenants"][OVERFLOW_TENANT]["requests"] >= 10
+    total_reqs = sum(b["requests"] for b in snap["tenants"].values())
+    assert total_reqs == snap["totals"]["requests"] == 3 + MAX_TENANTS + 10
+
+
+def test_idle_burn_is_paid_minus_attributed():
+    m, t = _meter(chips=4)
+    t[0] = 10.0  # replica has been up 10s: paid 40 chip-seconds
+    snap = m.snapshot()
+    assert snap["paid_chip_seconds"] == pytest.approx(40.0)
+    assert snap["idle_chip_seconds"] == pytest.approx(40.0)  # no requests
+    # a request spanning the whole uptime leaves zero idle burn
+    req = _req(submitted=0.0, dequeued=0.0, prefill_done=5.0)
+    m.meter_request(req, end_at=10.0, generated_tokens=4,
+                    pages_end=1, page_tokens=16)
+    snap = m.snapshot()
+    assert snap["idle_chip_seconds"] == pytest.approx(0.0)
+    gauge = m.metrics.gauges[("tpu_serving_idle_chip_seconds", ())]
+    assert gauge == pytest.approx(0.0)
+
+
+def test_metrics_and_exemplar_emission():
+    m, _ = _meter(chips=2)
+    req = _req(submitted=0.0, dequeued=0.5, prefill_done=1.0,
+               trace_id="ab" * 16)
+    m.meter_request(req, end_at=2.0, generated_tokens=4,
+                    pages_end=1, page_tokens=16)
+    mm = m.metrics
+    assert mm.get_counter("tpu_serving_metered_requests") == 1
+    for phase in PHASES:
+        assert mm.get_counter("tpu_serving_chip_seconds",
+                              labels={"phase": phase}) >= 0.0
+    total = sum(mm.get_counter("tpu_serving_chip_seconds",
+                               labels={"phase": p}) for p in PHASES)
+    assert total == pytest.approx(2.0 * 2)
+    # the cost histogram carries the request's trace as an exemplar: the
+    # expensive bucket on /metrics links to a replayable trace
+    text = mm.render()
+    assert 'trace_id="' + "ab" * 16 + '"' in text
+
+
+def test_span_attrs_shape():
+    m, _ = _meter(chips=1)
+    req = _req(submitted=0.0, dequeued=0.1, prefill_done=0.2, tenant="acme")
+    attr = m.meter_request(req, end_at=1.0, generated_tokens=3,
+                           pages_end=1, page_tokens=16)
+    sa = m.span_attrs(attr)
+    assert set(sa) == {"cost_dollars", "chip_seconds_queue",
+                       "chip_seconds_prefill", "chip_seconds_decode",
+                       "kv_page_seconds", "tenant"}
+    assert sa["tenant"] == "acme"
+    assert sa["cost_dollars"] >= 0
+
+
+def test_snapshot_schema_and_registry_literal_pinned():
+    m, _ = _meter(chips=2)
+    snap = m.snapshot()
+    assert snap["schema_version"] == COSTS_SCHEMA_VERSION
+    for key in ("model", "pool", "generation", "chips", "price_per_chip_hr",
+                "elapsed_s", "paid_chip_seconds", "idle_chip_seconds",
+                "handoff_bytes", "totals", "tenants"):
+        assert key in snap, key
+    # fleet/registry.py is jax-free by contract so it cannot import this
+    # module's constant; it duplicates the literal. Pin the two equal so a
+    # schema bump cannot land on one side only.
+    assert fleet_registry.COSTS_SCHEMA_VERSION == COSTS_SCHEMA_VERSION
+
+
+def test_handoff_bytes_accumulate():
+    m, _ = _meter(chips=1)
+    m.note_handoff_bytes(1024)
+    m.note_handoff_bytes(4096)
+    assert m.snapshot()["handoff_bytes"] == 5120

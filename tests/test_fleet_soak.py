@@ -26,6 +26,8 @@ from __future__ import annotations
 import http.client
 import json
 
+import pytest
+
 from k8s_runpod_kubelet_tpu.cloud.faults import (PREEMPTION_STORM, FaultPlan,
                                                  FaultWindow)
 from k8s_runpod_kubelet_tpu.fleet.autoscaler import (AutoscalerConfig,
@@ -311,3 +313,220 @@ def test_fleet_soak_tier1(tmp_path):
             _ctx(f"fleet_summary output incomplete:\n{out_text}", plan)
     finally:
         s.close()
+
+
+# -- cost attribution plane soak (ISSUE 20) -----------------------------------
+
+def _parse_exposition(text: str) -> dict:
+    """{sample line without exemplar: float value} — comments skipped."""
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        if not line.strip() or line.startswith("#"):
+            continue
+        line = line.split(" # ")[0].rstrip()  # strip exemplar suffix
+        series, value = line.rsplit(" ", 1)
+        out[series] = float(value)
+    return out
+
+
+def test_fleet_cost_plane_soak_tier1(tmp_path):
+    """Deterministic cost-plane soak: 3 fake replicas push cumulative
+    metric + cost snapshots on their heartbeats; the router's
+    /metrics/fleet must equal the SUM of the replicas' own /metrics
+    (sample for sample), /debug/costs must roll spend up per
+    model/pool/tenant across a mid-soak replica restart and a
+    deregistration, the merged p99 TTFT bucket's exemplar must resolve
+    to a replayable trace via the router's /debug/traces, and
+    tools/cost_summary.py must render the headline from the rollup."""
+    import pathlib
+    import sys
+    import urllib.request
+
+    from k8s_runpod_kubelet_tpu.fleet.registry import FleetCostLedger
+    from k8s_runpod_kubelet_tpu.metrics import Metrics as _Metrics
+    from k8s_runpod_kubelet_tpu.metrics import MetricsAggregator
+    from k8s_runpod_kubelet_tpu.workloads.serving.costmeter import CostMeter
+    from k8s_runpod_kubelet_tpu.workloads.serving.scheduler import Request
+
+    from harness import FakeClock
+
+    clock = FakeClock()
+    metrics = Metrics()
+    tracer = Tracer(clock=clock)
+    registry = ReplicaRegistry(
+        metrics=metrics, tracer=tracer, clock=clock,
+        heartbeat_timeout_s=120.0, aggregator=MetricsAggregator(),
+        cost_ledger=FleetCostLedger())
+    router = FleetRouter(registry, RouterConfig(max_attempts=3,
+                                                request_timeout_s=10.0),
+                         metrics=metrics, tracer=tracer, clock=clock)
+    httpd = serve_router(router, port=0)
+    port = httpd.server_address[1]
+
+    def post(path, payload, headers=None):
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=15.0)
+        try:
+            c.request("POST", path, body=json.dumps(payload).encode(),
+                      headers={"Content-Type": "application/json",
+                               **(headers or {})})
+            r = c.getresponse()
+            body = r.read()
+            return r.status, (json.loads(body) if body else {})
+        finally:
+            c.close()
+
+    def get(path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=15.0) as r:
+            return r.read().decode()
+
+    def fresh_shadow():
+        """One replica's in-process metric registry + cost meter (what a
+        real serve_main replica snapshots onto its heartbeat)."""
+        m = _Metrics(clock=clock)
+        m.describe("tpu_serving_ttft_seconds", "time to first token",
+                   buckets=(0.05, 0.25, 1.0, 4.0))
+        meter = CostMeter(m, model="fake-model", accelerator="v5litepod-8",
+                          chips=4, clock=clock)
+        return {"metrics": m, "meter": meter, "metered": 0}
+
+    def meter_one(shadow, ttft, trace_id, tenant):
+        now = clock()
+        shadow["metrics"].observe("tpu_serving_ttft_seconds", ttft,
+                                  exemplar=trace_id)
+        req = Request(prompt=[1, 2, 3, 4], max_new_tokens=4, rid="r",
+                      future=None, submitted_at=now - ttft - 0.5,
+                      temperature=0.0, dequeued_at=now - ttft - 0.25,
+                      prefill_done_at=now - ttft, tenant=tenant,
+                      trace_id=trace_id)
+        shadow["meter"].meter_request(req, end_at=now, generated_tokens=4,
+                                      pages_end=2, page_tokens=16)
+        shadow["metered"] += 1
+
+    replicas, shadows = {}, {}
+    try:
+        for i in range(3):
+            rid = f"rep-{i}"
+            rep = FakeReplica(rid, tracer=tracer)
+            replicas[rid] = rep
+            shadows[rid] = fresh_shadow()
+            status, out = post("/fleet/register",
+                               {"replica_id": rid, "base_url": rep.url})
+            assert status == 200, f"register {rid} -> {status} {out}"
+
+        tids = {}
+        slow_tid = None
+        for t in range(1, 21):
+            clock.advance(1.0)
+            tid = f"{t:032x}"
+            tids[t] = tid
+            span_id = "b7ad6b7169203331"
+            status, out = post(
+                "/generate", {"tokens": [t], "max_new_tokens": 2},
+                headers={"traceparent": f"00-{tid}-{span_id}-01",
+                         "X-Tenant": "acme" if t % 3 else ""})
+            assert status == 200, f"t={t} -> {status} {out}"
+            served_by = out["replica_id"]
+            # t=15 is the one slow request: the ONLY observation in the
+            # top TTFT bucket, so the merged tail exemplar is known
+            ttft = 9.5 if t == 15 else 0.03 + (t % 3) * 0.07
+            if t == 15:
+                slow_tid = tid
+            meter_one(shadows[served_by], ttft, tid,
+                      "acme" if t % 3 else "")
+            for rid, rep in replicas.items():
+                sh = shadows[rid]
+                status, out = post("/fleet/heartbeat", {
+                    "replica_id": rid, "stats": dict(rep.stats),
+                    "metrics": sh["metrics"].snapshot(),
+                    "costs": sh["meter"].snapshot()})
+                assert status == 200, f"heartbeat {rid} -> {status} {out}"
+
+        # -- 1. /metrics/fleet == SUM of the replicas' own /metrics ----------
+        merged = _parse_exposition(get("/metrics/fleet"))
+        want: dict[str, float] = {}
+        for sh in shadows.values():
+            for series, v in _parse_exposition(
+                    sh["metrics"].render()).items():
+                want[series] = want.get(series, 0.0) + v
+        assert set(merged) == set(want), (
+            f"series mismatch: only-merged="
+            f"{sorted(set(merged) - set(want))} only-replicas="
+            f"{sorted(set(want) - set(merged))}")
+        for series, v in want.items():
+            assert merged[series] == pytest.approx(v, abs=1e-9), \
+                f"{series}: fleet={merged[series]} sum-of-replicas={v}"
+        total_metered = sum(sh["metered"] for sh in shadows.values())
+        assert total_metered == 20
+        assert merged["tpu_serving_metered_requests_total"] == 20
+
+        # -- 2. the merged tail-TTFT exemplar resolves to a real trace -------
+        expo = get("/metrics/fleet")
+        tail = [ln for ln in expo.splitlines()
+                if ln.startswith("tpu_serving_ttft_seconds_bucket")
+                and 'le="+Inf"' in ln]
+        assert tail and f'trace_id="{slow_tid}"' in tail[0], \
+            f"slow request's exemplar missing from the tail bucket: {tail}"
+        traces = json.loads(get(f"/debug/traces?trace_id={slow_tid}"))
+        names = {s["name"] for s in traces["spans"]}
+        assert {"fleet.route", "serving.request"} <= names, \
+            f"exemplar {slow_tid} did not replay: {names}"
+
+        # -- 3. a replica restart never dips fleet totals --------------------
+        shadows["rep-0"] = fresh_shadow()     # process restart: counters ~0
+        clock.advance(1.0)
+        meter_one(shadows["rep-0"], 0.04, "c" * 32, "acme")
+        sh = shadows["rep-0"]
+        status, _ = post("/fleet/heartbeat", {
+            "replica_id": "rep-0", "stats": dict(replicas["rep-0"].stats),
+            "metrics": sh["metrics"].snapshot(),
+            "costs": sh["meter"].snapshot()})
+        assert status == 200
+        merged = _parse_exposition(get("/metrics/fleet"))
+        assert merged["tpu_serving_metered_requests_total"] == 21, \
+            "restart dipped the fleet counter"
+
+        # -- 4. /debug/costs rolls up per model/pool/tenant ------------------
+        costs = json.loads(get("/debug/costs"))
+        assert costs["schema_version"] == 1
+        assert len(costs["groups"]) == 1
+        g = costs["groups"][0]
+        assert (g["model"], g["pool"]) == ("fake-model", "v5e")
+        assert g["requests"] == 21, \
+            "ledger lost the restarted replica's prior epoch"
+        assert g["replicas"] == 3
+        assert g["utilization"] is not None and 0.0 < g["utilization"] <= 1.0
+        assert g["dollars_per_mtok"] is not None
+        by_tenant = costs["tenants"]
+        assert by_tenant["acme"]["requests"] + \
+            by_tenant["-"]["requests"] == 21
+        assert costs["aggregator"]["replicas"]["rep-1"] >= 1
+
+        # -- 5. deregistration retires spend, never un-counts it -------------
+        status, _ = post("/fleet/deregister", {"replica_id": "rep-2"})
+        assert status == 200
+        merged = _parse_exposition(get("/metrics/fleet"))
+        assert merged["tpu_serving_metered_requests_total"] == 21, \
+            "deregistration erased fleet history"
+        costs = json.loads(get("/debug/costs"))
+        assert costs["groups"][0]["requests"] == 21
+        assert "rep-2" not in costs["replicas"]
+
+        # -- 6. tools/cost_summary.py renders the headline from the file -----
+        out_path = tmp_path / "costs.jsonl"
+        with open(out_path, "w", encoding="utf-8") as f:
+            f.write(json.dumps(costs) + "\n")
+        sys.path.insert(0, str(pathlib.Path(__file__).parent.parent
+                               / "tools"))
+        import cost_summary
+        fleet_lines, rep_lines, train_lines = cost_summary.load(
+            str(out_path))
+        assert fleet_lines, "cost_summary did not classify the rollup"
+        text = cost_summary.render(fleet_lines, rep_lines, train_lines)
+        assert "cost headline" in text and "fake-model" in text \
+            and "acme" in text, f"headline incomplete:\n{text}"
+    finally:
+        httpd.shutdown()
+        for rep in replicas.values():
+            rep.kill()
+        tracer.close()

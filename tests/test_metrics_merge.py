@@ -1,0 +1,168 @@
+"""MetricsAggregator line-identity property (ISSUE 20): a random event
+stream split across N fake replicas, pushed as cumulative heartbeat
+snapshots, must merge into an exposition LINE-IDENTICAL to one process
+observing the union stream. Values are dyadic rationals (k/64) so float
+accumulation is exact regardless of fold order — any mismatch is a merge
+bug, never rounding. Restart/forget/exemplar semantics ride along.
+
+The seed is embedded in every assertion message for replay.
+"""
+
+from __future__ import annotations
+
+import random
+
+from k8s_runpod_kubelet_tpu.metrics import Metrics, MetricsAggregator
+
+COUNTERS = [("reqs_total_series", None), ("reqs_total_series", {"code": "200"}),
+            ("reqs_total_series", {"code": "429"}), ("bytes_moved", None)]
+HISTS = ["lat_seconds", "cost_dollars_series"]
+GAUGES = [("depth", None), ("depth", {"pool": "a"}), ("pages_free", None)]
+BUCKETS = {"lat_seconds": (0.25, 1, 4, 16), "cost_dollars_series": (1, 8)}
+
+
+def _describe(m: Metrics):
+    for name, _ in COUNTERS:
+        m.help.setdefault(name, f"test counter {name}")
+    for name in HISTS:
+        m.describe(name, f"test histogram {name}", buckets=BUCKETS[name])
+    for name, _ in GAUGES:
+        m.help.setdefault(name, f"test gauge {name}")
+
+
+def _rand_events(rng: random.Random, n: int) -> list:
+    """(kind, name, labels, value) — values k/64: exact in binary."""
+    events = []
+    for _ in range(n):
+        kind = rng.choice(("counter", "hist", "gauge"))
+        value = rng.randint(1, 1000) / 64
+        if kind == "counter":
+            name, labels = rng.choice(COUNTERS)
+        elif kind == "hist":
+            name, labels = rng.choice(HISTS), None
+        else:
+            name, labels = rng.choice(GAUGES)
+        events.append((kind, name, labels, value))
+    return events
+
+
+def _apply(m: Metrics, ev):
+    kind, name, labels, value = ev
+    if kind == "counter":
+        m.incr(name, value, labels=labels)
+    elif kind == "hist":
+        m.observe(name, value, labels=labels)
+    else:
+        m.set_gauge(name, value, labels=labels)
+
+
+def test_merge_line_identical_to_union_stream():
+    for seed in (1, 7, 42, 1234, 99999):
+        rng = random.Random(seed)
+        n_replicas = rng.randint(2, 5)
+        replicas = [Metrics() for _ in range(n_replicas)]
+        union = Metrics()
+        for m in (*replicas, union):
+            _describe(m)
+        events = _rand_events(rng, 400)
+        for i, ev in enumerate(events):
+            _apply(replicas[i % n_replicas], ev)
+            if ev[0] != "gauge":
+                _apply(union, ev)
+        # union gauges: the aggregator SUMS latest-per-replica at render
+        gauge_sum: dict = {}
+        for m in replicas:
+            for key, v in m.gauges.items():
+                gauge_sum[key] = gauge_sum.get(key, 0.0) + v
+        for (name, lbls), v in gauge_sum.items():
+            union.set_gauge(name, v, labels=dict(lbls))
+
+        agg = MetricsAggregator()
+        # several rounds of cumulative pushes, shuffled order: idempotent
+        # by construction, so extra beats must not change the totals
+        for _ in range(3):
+            order = list(range(n_replicas))
+            rng.shuffle(order)
+            for i in order:
+                agg.ingest(f"rep-{i}", replicas[i].snapshot())
+        merged, expected = agg.render(), union.render()
+        assert merged == expected, (
+            f"[merge seed={seed}] merged exposition diverged from the "
+            f"union stream:\n--- merged ---\n{merged}\n--- union ---\n"
+            f"{expected}")
+
+
+def test_restart_counts_post_reset_traffic_once():
+    agg = Metrics(), MetricsAggregator()
+    m, agg = agg
+    _describe(m)
+    m.incr("bytes_moved", 100.0)
+    m.observe("lat_seconds", 0.5)
+    m.observe("lat_seconds", 2.0)
+    agg.ingest("rep-0", m.snapshot())
+    # replica restarts: fresh process, smaller cumulative values
+    m2 = Metrics()
+    _describe(m2)
+    m2.incr("bytes_moved", 30.0)
+    m2.observe("lat_seconds", 8.0)
+    agg.ingest("rep-0", m2.snapshot())
+    text = agg.render()
+    assert "bytes_moved_total 130.0" in text, text  # 100 pre + 30 post
+    assert "lat_seconds_count 3" in text, text      # 2 pre + 1 post
+    # and never a negative dip: a third identical push changes nothing
+    agg.ingest("rep-0", m2.snapshot())
+    assert agg.render() == text
+
+
+def test_forget_drops_gauges_keeps_totals():
+    m, agg = Metrics(), MetricsAggregator()
+    _describe(m)
+    m.incr("bytes_moved", 64.0)
+    m.set_gauge("depth", 9.0)
+    agg.ingest("rep-0", m.snapshot())
+    agg.forget("rep-0")
+    text = agg.render()
+    assert "bytes_moved_total 64.0" in text, text   # history survives exit
+    assert "depth 9.0" not in text, text            # gauge contribution gone
+    # re-registration after forget is a FRESH baseline (count_first=True:
+    # its cumulative traffic counts whole, once)
+    agg.ingest("rep-0", m.snapshot())
+    assert "bytes_moved_total 128.0" in agg.render()
+
+
+def test_exemplars_survive_the_merge():
+    m, agg = Metrics(), MetricsAggregator()
+    _describe(m)
+    m.observe("lat_seconds", 0.1, exemplar="a" * 32)
+    m.observe("lat_seconds", 9.0, exemplar="b" * 32)
+    agg.ingest("rep-0", m.snapshot())
+    # a second replica with no exemplars must not erase the first's
+    m2 = Metrics()
+    _describe(m2)
+    m2.observe("lat_seconds", 0.2)
+    agg.ingest("rep-1", m2.snapshot())
+    text = agg.render()
+    assert f'# {{trace_id="{"a" * 32}"}} 0.1' in text, text
+    assert f'# {{trace_id="{"b" * 32}"}} 9.0' in text, text
+
+
+def test_bucket_disagreement_refused_not_corrupted():
+    m, agg = Metrics(), MetricsAggregator()
+    _describe(m)
+    m.observe("lat_seconds", 0.5)
+    agg.ingest("rep-0", m.snapshot())
+    rogue = Metrics()
+    rogue.describe("lat_seconds", "rogue bounds", buckets=(0.5, 2))
+    rogue.observe("lat_seconds", 0.5)
+    snap = rogue.snapshot()
+    # strip the rogue bucket_spec so only the per-hist state disagrees
+    snap["bucket_spec"] = {}
+    agg.ingest("rep-1", snap)
+    assert "lat_seconds_count 1" in agg.render()  # rogue hist not merged
+
+
+def test_unknown_snapshot_schema_skipped_and_recorded():
+    agg = MetricsAggregator()
+    agg.ingest("rep-9", {"schema_version": 99, "counters": [["x", [], 5]]})
+    assert agg.stats()["schema_skews"] == [["rep-9", 99]]
+    assert "x_total" not in agg.render()

@@ -1,0 +1,281 @@
+"""Every tools/*_summary.py must render its committed fixture (ISSUE 20):
+``main()`` returns 0 and prints a non-empty table. The fixtures live in
+tests/fixtures/ and are REGENERATED (never hand-edited) with:
+
+    python tests/test_tools_smoke.py --write-fixture
+
+so a reader-side format change ships with its fixture in the same diff,
+and a producer-side schema change that breaks a reader fails tier-1
+instead of some operator's terminal three weeks later.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+import pytest
+
+FIXDIR = pathlib.Path(__file__).parent / "fixtures"
+TOOLS_DIR = pathlib.Path(__file__).parent.parent / "tools"
+sys.path.insert(0, str(TOOLS_DIR))
+
+_T0 = 1000.0  # fixture epoch (fixtures are committed: no wall-clock reads)
+
+
+def _span(name, start, dur, trace="0" * 31 + "1", span_id="s1",
+          parent="", **attrs):
+    return {"trace_id": trace, "span_id": span_id, "parent_id": parent,
+            "name": name, "start": start, "duration_s": dur, "attrs": attrs}
+
+
+def _gen_trace_spans() -> str:
+    """serve_main --trace-export shape: serving.request trees."""
+    lines = []
+    for i in range(3):
+        trace = f"{i + 1:032x}"
+        t0 = _T0 + i * 10
+        lat = 0.8 + 0.3 * i
+        lines += [
+            _span("serving.request", t0, lat, trace=trace, span_id="root",
+                  rid=f"r-{i}", ttft_s=0.1 + 0.05 * i, latency_s=lat,
+                  prompt_tokens=16, tokens=8, cost_dollars=0.00021,
+                  tenant="acme" if i else "-"),
+            _span("serving.queue_wait", t0, 0.05, trace=trace,
+                  span_id="q", parent="root"),
+            _span("serving.prefill", t0 + 0.05, 0.1, trace=trace,
+                  span_id="p", parent="root"),
+            _span("serving.decode", t0 + 0.15, lat - 0.15, trace=trace,
+                  span_id="d", parent="root", tokens=8),
+        ]
+    return "\n".join(json.dumps(s) for s in lines) + "\n"
+
+
+def _gen_goodput_spans() -> str:
+    """train_main --trace-export shape: training.* span families."""
+    hosts = {"0": {"step": 40, "mean_step_s": 0.21, "age_s": 1.0,
+                   "flagged": ""},
+             "1": {"step": 38, "mean_step_s": 0.34, "age_s": 1.2,
+                   "flagged": "slow"}}
+    lines = [
+        _span("training.run", _T0, 30.0, span_id="run0", attempt=0,
+              step=25, goodput=0.72, mfu=0.31, tokens_per_sec=15000.0,
+              wall_s=30.0, buckets={"productive": 21.5, "compile": 6.0,
+                                    "checkpoint_save": 2.5}),
+        _span("training.run", _T0 + 40, 20.0, span_id="run1", attempt=1,
+              step=40, goodput=0.55, mfu=0.29, tokens_per_sec=14000.0,
+              wall_s=20.0, hosts=hosts,
+              buckets={"productive": 11.0, "restart_lost": 8.0,
+                       "checkpoint_restore": 1.0}),
+        _span("training.restore", _T0 + 40.5, 1.0, span_id="re", step=25),
+        _span("training.straggler", _T0 + 50, 0.0, span_id="st", host=1,
+              kind="slow", last_step=38, lag_s=2.4),
+    ]
+    lines += [_span("training.step", _T0 + 41 + 0.25 * i, 0.2 + 0.01 * i,
+                    span_id=f"step{i}", step=26 + i, host=i % 2)
+              for i in range(8)]
+    return "\n".join(json.dumps(s) for s in lines) + "\n"
+
+
+def _gen_fleet_jsonl() -> str:
+    """Router span export + appended /debug/fleet registry snapshots."""
+    lines = [
+        _span("fleet.route", _T0 + i, 0.2, trace=f"{i + 1:032x}",
+              span_id=f"rt{i}", replica_id=f"rep-{i % 2}",
+              reason="least_loaded", attempts=1, status=200)
+        for i in range(4)
+    ]
+    lines.append(_span("fleet.scale", _T0 + 9, 0.0, span_id="sc",
+                       direction="up", **{"from": 2, "to": 3},
+                       reason="queue_depth 9.0 > target", target=3))
+    snap = {"schema_version": 1, "now": _T0 + 10, "replicas": [
+        {"replica_id": f"rep-{i}", "state": "ready", "role": "unified",
+         "heartbeat_age_s": 1.0,
+         "stats": {"active_slots": i, "max_slots": 4, "queue_depth": i,
+                   "kv_cache_tokens": 100 * i, "ttft_p95_s": 0.2}}
+        for i in range(2)]}
+    return "\n".join(json.dumps(s) for s in (*lines, snap)) + "\n"
+
+
+def _gen_slo_jsonl() -> str:
+    """/debug/slo + /debug/steps appends, plus fleet.slo_burn spans."""
+    def slo_snap(t, burning):
+        return {
+            "schema_version": 1, "enabled": True, "burn_threshold": 2.0,
+            "budget_frac": 0.05,
+            "windows": {"short_s": 300, "long_s": 3600},
+            "signals": {"ttft": {
+                "objective": 0.5, "burning": burning,
+                "short_burn": 3.1 if burning else 0.4,
+                "long_burn": 2.2 if burning else 0.3, "crossings": 1,
+                "samples_short": 40, "samples_long": 300}},
+            "history": [{"t": t - 60, "burn": {"ttft": 0.4}},
+                        {"t": t, "burn": {"ttft": 3.1 if burning
+                                          else 0.5}}]}
+    steps = {"schema_version": 1, "steps": [
+        {"seq": i, "wall_s": 0.004 + 0.001 * i,
+         "phases": {"schedule_s": 0.0005, "kernel_s": 0.0025,
+                    "sample_s": 0.0007, "commit_s": 0.0003 + 0.001 * i},
+         "batch": {"active": 3, "mode": "paged"}} for i in range(6)],
+        "rollup": {"steps": 6, "tokens_total": 18, "spec_steps": 0,
+                   "bytes": 2048, "max_bytes": 262144, "dropped": 0,
+                   "wall_ms_p50": 4.5, "schedule_ms_p50": 0.5,
+                   "kernel_ms_p50": 2.5, "sample_ms_p50": 0.7,
+                   "commit_ms_p50": 0.8},
+        "recompiles": {"decode_step": {"compiles": 1, "recompiles": 0,
+                                       "budget": 2, "warned": False}}}
+    burn = _span("fleet.slo_burn", _T0 + 120, 0.0, span_id="bu",
+                 signal="ttft", short_burn=3.1, long_burn=2.2,
+                 threshold=2.0, objective=0.5, replicas=3)
+    rows = [slo_snap(_T0, False), burn, slo_snap(_T0 + 120, True), steps]
+    return "\n".join(json.dumps(r) for r in rows) + "\n"
+
+
+def _gen_costs_jsonl() -> str:
+    """Router /debug/costs rollup + one replica ledger + /debug/train."""
+    totals = {"requests": 42, "tokens": 8400, "prompt_tokens": 2100,
+              "chip_seconds": {"queue": 4.2, "prefill": 21.0,
+                               "decode": 310.8},
+              "kv_page_seconds": 5100.0, "cost_dollars": 0.112}
+    replica = {"schema_version": 1, "model": "fixture-13b", "pool": "v5e",
+               "generation": "v5e", "chips": 4, "price_per_chip_hr": 1.2,
+               "elapsed_s": 100.0, "paid_chip_seconds": 400.0,
+               "idle_chip_seconds": 64.0, "handoff_bytes": 1048576,
+               "totals": totals,
+               "tenants": {"acme": totals, "-": totals}}
+    fleet = {"schema_version": 1, "groups": [{
+        "model": "fixture-13b", "pool": "v5e", "generation": "v5e",
+        "replicas": 2, "requests": 84, "tokens": 16800,
+        "chip_seconds": {"queue": 8.4, "prefill": 42.0, "decode": 621.6},
+        "cost_dollars": 0.224, "paid_chip_seconds": 800.0,
+        "idle_chip_seconds": 128.0, "handoff_bytes": 2097152,
+        "utilization": 0.84, "tokens_per_sec_per_chip": 21.0,
+        "dollars_per_mtok": 13.33}],
+        "tenants": {"acme": {**totals, "dollars_per_mtok": 13.33},
+                    "-": {**totals, "dollars_per_mtok": 13.33}},
+        "replicas": {"rep-0": replica}, "schema_skews": [],
+        "ingested": {"rep-0": 12}}
+    train = {"schema_version": 1, "stall_timeout_s": 300.0, "pods": {
+        "default/train-0": {"last_step": 120, "stalled": False,
+                            "accelerator_type": "v5litepod-8",
+                            "generation": "v5e", "chips": 8,
+                            "chip_seconds": 960.0,
+                            "cost_dollars": 0.32}}}
+    return "\n".join(json.dumps(r)
+                     for r in (replica, fleet, train)) + "\n"
+
+
+def _pb_varint(v: int) -> bytes:
+    out = b""
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _pb_len(field: int, payload: bytes) -> bytes:
+    return _pb_varint(field << 3 | 2) + _pb_varint(len(payload)) + payload
+
+
+def _pb_int(field: int, v: int) -> bytes:
+    return _pb_varint(field << 3 | 0) + _pb_varint(v)
+
+
+def _gen_xplane_pb() -> bytes:
+    """A minimal tsl XSpace on the public wire schema xplane_summary.py
+    parses: one plane, two ops, three events."""
+    events = (_pb_len(4, _pb_int(1, 1) + _pb_int(3, 2_000_000_000))
+              + _pb_len(4, _pb_int(1, 1) + _pb_int(3, 1_000_000_000))
+              + _pb_len(4, _pb_int(1, 2) + _pb_int(3, 500_000_000)))
+    line = _pb_len(2, b"ops") + events
+    meta = (_pb_len(4, _pb_int(1, 1)
+                    + _pb_len(2, _pb_int(1, 1) + _pb_len(2, b"fusion.1")))
+            + _pb_len(4, _pb_int(1, 2)
+                      + _pb_len(2, _pb_int(1, 2) + _pb_len(2, b"copy.2"))))
+    plane = _pb_len(2, b"/device:TPU:0") + _pb_len(3, line) + meta
+    return _pb_len(1, plane)
+
+
+FIXTURES = {
+    "trace_spans.jsonl": _gen_trace_spans,
+    "goodput_spans.jsonl": _gen_goodput_spans,
+    "fleet.jsonl": _gen_fleet_jsonl,
+    "slo.jsonl": _gen_slo_jsonl,
+    "costs.jsonl": _gen_costs_jsonl,
+    "profile.xplane.pb": _gen_xplane_pb,
+}
+
+# (tool module, fixture, extra argv, strings the table must contain)
+CASES = [
+    ("trace_summary", "trace_spans.jsonl", [],
+     ["ttft_s", "serving.request"]),
+    ("goodput_summary", "goodput_spans.jsonl", ["--steps"],
+     ["goodput waterfall", "restart_lost", "straggler"]),
+    ("fleet_summary", "fleet.jsonl", [],
+     ["rep-", "scale up"]),
+    ("slo_summary", "slo.jsonl", [],
+     ["BURNING", "step waterfall", "decode_step"]),
+    ("cost_summary", "costs.jsonl", [],
+     ["cost headline", "fixture-13b", "acme", "train-0"]),
+    ("xplane_summary", "profile.xplane.pb", [],
+     ["TPU:0", "fusion.1"]),
+]
+
+
+def write_fixtures() -> list[str]:
+    FIXDIR.mkdir(exist_ok=True)
+    written = []
+    for name, gen in FIXTURES.items():
+        content = gen()
+        path = FIXDIR / name
+        if isinstance(content, bytes):
+            path.write_bytes(content)
+        else:
+            path.write_text(content, encoding="utf-8")
+        written.append(str(path))
+    return written
+
+
+@pytest.mark.parametrize("tool,fixture,extra,expect",
+                         CASES, ids=[c[0] for c in CASES])
+def test_summary_tool_renders_fixture(tool, fixture, extra, expect,
+                                      capsys):
+    path = FIXDIR / fixture
+    assert path.exists(), (
+        f"missing fixture {path} — regenerate with "
+        f"`python tests/test_tools_smoke.py --write-fixture`")
+    mod = __import__(tool)
+    rc = mod.main([str(path), *extra])
+    out = capsys.readouterr().out
+    assert rc == 0, f"{tool} exited {rc} on its committed fixture"
+    assert out.strip(), f"{tool} printed nothing"
+    for needle in expect:
+        assert needle in out, (
+            f"{tool} output lost {needle!r}:\n{out}")
+
+
+def test_fixtures_match_generators():
+    """Committed fixtures are generator OUTPUT, not hand edits: a format
+    change regenerates them (--write-fixture) in the same diff."""
+    for name, gen in FIXTURES.items():
+        path = FIXDIR / name
+        assert path.exists(), f"missing fixture {path}"
+        want = gen()
+        got = path.read_bytes() if isinstance(want, bytes) \
+            else path.read_text(encoding="utf-8")
+        assert got == want, (
+            f"{path} drifted from its generator — regenerate with "
+            f"`python tests/test_tools_smoke.py --write-fixture`")
+
+
+if __name__ == "__main__":
+    if "--write-fixture" in sys.argv:
+        for p in write_fixtures():
+            print(f"wrote {p}")
+    else:
+        print(__doc__)
+        raise SystemExit(2)

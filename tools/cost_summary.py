@@ -1,0 +1,243 @@
+"""Render the fleet cost headline: $/1M tokens, chip utilization, idle burn.
+
+Input lines may be any mix of (ISSUE 20):
+- **fleet cost rollups** — the router's ``GET /debug/costs`` payload (an
+  object with a ``"groups"`` list and a ``"tenants"`` dict), e.g. appended
+  periodically by ``curl router:8090/debug/costs >> costs.jsonl``,
+- **replica cost snapshots** — a single replica's ``GET /debug/costs``
+  (the CostMeter ledger: ``"totals"`` + ``"price_per_chip_hr"``),
+- **training status** — the kubelet's ``GET /debug/train`` payload (a
+  ``"pods"`` dict with per-pod chip-seconds/dollars), so training and
+  serving spend render side by side from one file.
+
+Later lines win (snapshots are cumulative); unknown ``schema_version``
+values warn to stderr and render best-effort instead of crashing.
+
+Usage:
+  python tools/cost_summary.py costs.jsonl
+  python tools/cost_summary.py costs.jsonl --top 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# /debug/costs + /debug/train schema versions this reader understands
+KNOWN_SCHEMA_VERSIONS = {1}
+
+_PHASES = ("queue", "prefill", "decode")
+
+
+def _check_schema(obj: dict, path: str, lineno: int,
+                  warned: set) -> None:
+    ver = obj.get("schema_version")
+    if ver is not None and ver not in KNOWN_SCHEMA_VERSIONS and \
+            ver not in warned:
+        warned.add(ver)
+        print(f"warning: {path}:{lineno}: schema_version {ver!r} is newer "
+              f"than this tool understands ({sorted(KNOWN_SCHEMA_VERSIONS)})"
+              f"; rendering best-effort", file=sys.stderr)
+
+
+def load(path: str) -> tuple[list[dict], list[dict], list[dict]]:
+    """(fleet rollups, replica snapshots, training statuses) from a
+    mixed JSONL file."""
+    fleet, replicas, training = [], [], []
+    warned: set = set()
+    with open(path, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                print(f"warning: {path}:{lineno}: bad JSON, skipped",
+                      file=sys.stderr)
+                continue
+            if not isinstance(obj, dict):
+                continue
+            _check_schema(obj, path, lineno, warned)
+            if isinstance(obj.get("groups"), list):
+                fleet.append(obj)
+            elif isinstance(obj.get("totals"), dict) \
+                    and "price_per_chip_hr" in obj:
+                replicas.append(obj)
+            elif isinstance(obj.get("pods"), dict) \
+                    and "stall_timeout_s" in obj:
+                training.append(obj)
+    return fleet, replicas, training
+
+
+def _fmt_dollars(v) -> str:
+    return "-" if v is None else f"${v:,.4f}"
+
+
+def _fmt_rate(v, suffix: str = "") -> str:
+    return "-" if v is None else f"{v:,.2f}{suffix}"
+
+
+def _replica_to_group(snap: dict) -> dict:
+    """Shape a lone replica snapshot like one fleet rollup group so a
+    file of replica-only appends still renders the headline table."""
+    t = snap.get("totals") or {}
+    paid = float(snap.get("paid_chip_seconds", 0.0) or 0.0)
+    spent = sum(float((t.get("chip_seconds") or {}).get(p, 0.0) or 0.0)
+                for p in _PHASES)
+    tokens = int(t.get("tokens", 0) or 0)
+    cost = float(t.get("cost_dollars", 0.0) or 0.0)
+    return {"model": snap.get("model", ""), "pool": snap.get("pool", ""),
+            "generation": snap.get("generation", ""), "replicas": 1,
+            "requests": t.get("requests", 0), "tokens": tokens,
+            "chip_seconds": t.get("chip_seconds") or {},
+            "cost_dollars": cost,
+            "paid_chip_seconds": paid,
+            "idle_chip_seconds": snap.get("idle_chip_seconds", 0.0),
+            "utilization": (spent / paid) if paid > 0 else None,
+            "tokens_per_sec_per_chip": (tokens / paid) if paid > 0
+            else None,
+            "dollars_per_mtok": (cost / tokens * 1e6) if tokens else None}
+
+
+def headline_table(groups: list[dict]) -> list[str]:
+    if not groups:
+        return []
+    out = ["== cost headline (per model/pool) ==",
+           f"{'model':<18} {'pool':<8} {'gen':<5} {'reps':>4} "
+           f"{'requests':>9} {'tokens':>10} {'$/1Mtok':>10} "
+           f"{'tok/s/chip':>10} {'util':>6} {'idle chip-s':>12} "
+           f"{'spend':>11}"]
+    for g in groups:
+        util = g.get("utilization")
+        out.append(
+            f"{str(g.get('model', ''))[:18]:<18} "
+            f"{str(g.get('pool', ''))[:8]:<8} "
+            f"{str(g.get('generation', ''))[:5]:<5} "
+            f"{g.get('replicas', 0):>4} "
+            f"{g.get('requests', 0):>9} "
+            f"{g.get('tokens', 0):>10} "
+            f"{_fmt_dollars(g.get('dollars_per_mtok')):>10} "
+            f"{_fmt_rate(g.get('tokens_per_sec_per_chip')):>10} "
+            f"{'-' if util is None else f'{util * 100:.1f}%':>6} "
+            f"{g.get('idle_chip_seconds', 0.0):>12,.1f} "
+            f"{_fmt_dollars(g.get('cost_dollars')):>11}")
+    return out
+
+
+def tenant_table(tenants: dict, top: int) -> list[str]:
+    if not tenants:
+        return []
+    ranked = sorted(tenants.items(),
+                    key=lambda kv: -float(kv[1].get("cost_dollars", 0.0)
+                                          or 0.0))[:top]
+    out = ["", f"== spend by tenant (top {len(ranked)}; '-' = untagged, "
+               f"'~other' = overflow) ==",
+           f"{'tenant':<20} {'requests':>9} {'tokens':>10} "
+           f"{'$/1Mtok':>10} {'spend':>11}"]
+    for tenant, b in ranked:
+        out.append(f"{str(tenant)[:20]:<20} {b.get('requests', 0):>9} "
+                   f"{b.get('tokens', 0):>10} "
+                   f"{_fmt_dollars(b.get('dollars_per_mtok')):>10} "
+                   f"{_fmt_dollars(b.get('cost_dollars')):>11}")
+    return out
+
+
+def replica_table(replicas: dict) -> list[str]:
+    if not replicas:
+        return []
+    out = ["", "== per-replica ledgers (live) ==",
+           f"{'replica':<22} {'gen':<5} {'chips':>5} {'requests':>9} "
+           f"{'tokens':>10} {'idle chip-s':>12} {'spend':>11}"]
+    for rid in sorted(replicas):
+        snap = replicas[rid] or {}
+        t = snap.get("totals") or {}
+        out.append(f"{str(rid)[:22]:<22} "
+                   f"{str(snap.get('generation', ''))[:5]:<5} "
+                   f"{snap.get('chips', 0):>5} "
+                   f"{t.get('requests', 0):>9} {t.get('tokens', 0):>10} "
+                   f"{snap.get('idle_chip_seconds', 0.0):>12,.1f} "
+                   f"{_fmt_dollars(t.get('cost_dollars')):>11}")
+    return out
+
+
+def training_table(training: list[dict]) -> list[str]:
+    if not training:
+        return []
+    pods = {}
+    for status in training:  # later lines win per pod
+        pods.update(status.get("pods") or {})
+    priced = {k: p for k, p in pods.items()
+              if isinstance(p, dict) and "chip_seconds" in p}
+    if not priced:
+        return []
+    out = ["", "== training spend (/debug/train join) ==",
+           f"{'pod':<28} {'gen':<5} {'chips':>5} {'step':>8} "
+           f"{'chip-s':>12} {'spend':>11}"]
+    total = 0.0
+    for key in sorted(priced):
+        p = priced[key]
+        total += float(p.get("cost_dollars", 0.0) or 0.0)
+        out.append(f"{str(key)[:28]:<28} "
+                   f"{str(p.get('generation', ''))[:5]:<5} "
+                   f"{p.get('chips', 0):>5} {p.get('last_step', 0):>8} "
+                   f"{p.get('chip_seconds', 0.0):>12,.1f} "
+                   f"{_fmt_dollars(p.get('cost_dollars')):>11}")
+    out.append(f"{'total':<28} {'':<5} {'':>5} {'':>8} {'':>12} "
+               f"{_fmt_dollars(total):>11}")
+    return out
+
+
+def render(fleet: list[dict], replicas: list[dict],
+           training: list[dict], top: int = 10) -> str:
+    groups: list[dict] = []
+    tenants: dict = {}
+    live_replicas: dict = {}
+    if fleet:
+        latest = fleet[-1]  # cumulative: later lines win
+        groups = [g for g in latest.get("groups", []) if isinstance(g, dict)]
+        tenants = latest.get("tenants") or {}
+        live_replicas = latest.get("replicas") or {}
+        skews = latest.get("schema_skews") or {}
+        if skews:
+            print(f"warning: replicas sent unmerged schema versions: "
+                  f"{skews}", file=sys.stderr)
+    elif replicas:
+        # no fleet rollup in the file: the newest snapshot per
+        # (model, pool) stands in for a group
+        newest: dict[tuple, dict] = {}
+        for snap in replicas:
+            newest[(snap.get("model"), snap.get("pool"))] = snap
+        groups = [_replica_to_group(s) for s in newest.values()]
+        tenants = {}
+        for snap in newest.values():
+            for tenant, b in (snap.get("tenants") or {}).items():
+                tenants.setdefault(tenant, b)
+    lines = headline_table(groups)
+    lines += tenant_table(tenants, top)
+    lines += replica_table(live_replicas)
+    lines += training_table(training)
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Fleet cost headline ($/1M tokens, utilization, idle "
+                    "burn) from mixed JSONL (/debug/costs and /debug/train "
+                    "appends)")
+    p.add_argument("path", help="JSONL file")
+    p.add_argument("--top", type=int, default=10,
+                   help="tenant rows to show (by spend)")
+    args = p.parse_args(argv)
+    fleet, replicas, training = load(args.path)
+    if not fleet and not replicas and not training:
+        print(f"{args.path}: no cost rollups, replica ledgers, or training "
+              f"statuses found", file=sys.stderr)
+        return 1
+    print(render(fleet, replicas, training, args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

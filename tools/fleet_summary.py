@@ -45,9 +45,13 @@ import sys
 from collections import defaultdict
 
 
+KNOWN_SCHEMA_VERSIONS = {1}
+
+
 def load(path: str) -> tuple[list[dict], list[dict]]:
     """(spans, registry snapshots) from a mixed JSONL file."""
     spans, snapshots = [], []
+    warned: set = set()
     with open(path, encoding="utf-8") as f:
         for lineno, line in enumerate(f, 1):
             line = line.strip()
@@ -61,6 +65,13 @@ def load(path: str) -> tuple[list[dict], list[dict]]:
                 continue
             if not isinstance(obj, dict):
                 continue
+            ver = obj.get("schema_version")
+            if ver is not None and ver not in KNOWN_SCHEMA_VERSIONS \
+                    and ver not in warned:
+                # newer producer than this reader: render best-effort
+                warned.add(ver)
+                print(f"warning: {path}:{lineno}: unknown schema_version "
+                      f"{ver!r}; rendering best-effort", file=sys.stderr)
             if "replicas" in obj and isinstance(obj["replicas"], list):
                 snapshots.append(obj)
             elif "name" in obj and "trace_id" in obj:

@@ -44,9 +44,13 @@ PHASES = ("schedule", "kernel", "sample", "commit")
 _BURN_GLYPHS = ((1.0, "#"), (0.75, "="), (0.5, "-"), (0.25, "."), (0.0, " "))
 
 
+KNOWN_SCHEMA_VERSIONS = {1}
+
+
 def load(path: str) -> tuple[list[dict], list[dict], list[dict]]:
     """(spans, slo snapshots, step dumps) from a mixed JSONL file."""
     spans, slo_snaps, step_dumps = [], [], []
+    warned: set = set()
     with open(path, encoding="utf-8") as f:
         for lineno, line in enumerate(f, 1):
             line = line.strip()
@@ -60,6 +64,13 @@ def load(path: str) -> tuple[list[dict], list[dict], list[dict]]:
                 continue
             if not isinstance(obj, dict):
                 continue
+            ver = obj.get("schema_version")
+            if ver is not None and ver not in KNOWN_SCHEMA_VERSIONS \
+                    and ver not in warned:
+                # newer producer than this reader: render best-effort
+                warned.add(ver)
+                print(f"warning: {path}:{lineno}: unknown schema_version "
+                      f"{ver!r}; rendering best-effort", file=sys.stderr)
             if "name" in obj and "trace_id" in obj:
                 spans.append(obj)
             elif isinstance(obj.get("signals"), dict):

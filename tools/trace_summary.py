@@ -29,8 +29,12 @@ from collections import defaultdict
 _BAR_WIDTH = 40
 
 
+KNOWN_SCHEMA_VERSIONS = {1}
+
+
 def load_spans(path: str) -> list[dict]:
     spans = []
+    warned: set = set()
     with open(path, encoding="utf-8") as f:
         for lineno, line in enumerate(f, 1):
             line = line.strip()
@@ -42,6 +46,15 @@ def load_spans(path: str) -> list[dict]:
                 print(f"warning: {path}:{lineno}: bad JSON, skipped",
                       file=sys.stderr)
                 continue
+            if isinstance(s, dict):
+                ver = s.get("schema_version")
+                if ver is not None and ver not in KNOWN_SCHEMA_VERSIONS \
+                        and ver not in warned:
+                    # newer producer than this reader: render best-effort
+                    warned.add(ver)
+                    print(f"warning: {path}:{lineno}: unknown "
+                          f"schema_version {ver!r}; rendering best-effort",
+                          file=sys.stderr)
             if isinstance(s, dict) and "trace_id" in s and "name" in s:
                 spans.append(s)
     return spans
